@@ -1,0 +1,96 @@
+"""CLI subcommands (reference registry: cli/AdamMain.scala:23-37).
+
+Implemented so far:
+  * ``flagstat``  — cli/FlagStat.scala:38-109
+  * ``bam2adam``  — cli/Bam2Adam.scala:41-126 (SAM/BAM -> Parquet dataset)
+  * ``print``     — cli/PrintAdam.scala:35-50
+  * ``listdict``  — cli/ListDict.scala:36-53
+"""
+
+from __future__ import annotations
+
+import argparse
+
+from .main import Command, register
+
+
+@register
+class FlagStatCommand(Command):
+    name = "flagstat"
+    help = "Print statistics on reads (identical counters to samtools flagstat)"
+
+    def add_args(self, p: argparse.ArgumentParser) -> None:
+        p.add_argument("input", help="SAM/BAM file or ADAM Parquet dataset")
+
+    def run(self, args) -> int:
+        from ..io.dispatch import FLAGSTAT_COLUMNS, load_reads
+        from ..ops.flagstat import flagstat, format_report
+        from ..packing import pack_reads
+
+        # project just the 4 flagstat columns
+        # (the reference's 13-field projection, cli/FlagStat.scala:50-57)
+        table, _, _ = load_reads(args.input, columns=FLAGSTAT_COLUMNS)
+        batch = pack_reads(table, with_bases=False, with_cigar=False)
+        failed, passed = flagstat(batch)
+        print(format_report(failed, passed))
+        return 0
+
+
+@register
+class Bam2AdamCommand(Command):
+    name = "bam2adam"
+    help = "Convert a SAM/BAM file to an ADAM Parquet dataset"
+
+    def add_args(self, p: argparse.ArgumentParser) -> None:
+        p.add_argument("input", help="SAM/BAM file")
+        p.add_argument("output", help="output Parquet dataset directory")
+        p.add_argument("-parts", type=int, default=1,
+                       help="number of part files to write")
+        p.add_argument("-compression", default="zstd",
+                       choices=["zstd", "snappy", "gzip", "none"])
+
+    def run(self, args) -> int:
+        from ..io.dispatch import load_reads
+        from ..io.parquet import save_table
+
+        table, _, _ = load_reads(args.input)
+        save_table(table, args.output,
+                   compression=None if args.compression == "none" else args.compression,
+                   n_parts=args.parts)
+        print(f"wrote {table.num_rows} reads to {args.output}")
+        return 0
+
+
+@register
+class PrintCommand(Command):
+    name = "print"
+    help = "Print an ADAM Parquet dataset (or SAM) as records"
+
+    def add_args(self, p: argparse.ArgumentParser) -> None:
+        p.add_argument("input")
+        p.add_argument("-limit", type=int, default=25)
+
+    def run(self, args) -> int:
+        from ..io.dispatch import load_reads
+        table, _, _ = load_reads(args.input)
+        for row in table.slice(0, args.limit).to_pylist():
+            print({k: v for k, v in row.items() if v is not None})
+        return 0
+
+
+@register
+class ListDictCommand(Command):
+    name = "listdict"
+    help = "Print the sequence dictionary of a reads file"
+
+    def add_args(self, p: argparse.ArgumentParser) -> None:
+        p.add_argument("input")
+
+    def run(self, args) -> int:
+        from ..io.dispatch import load_reads, sequence_dictionary_from_reads
+        table, seq_dict, _ = load_reads(args.input)
+        if seq_dict is None:
+            seq_dict = sequence_dictionary_from_reads(table)
+        for rec in seq_dict:
+            print(f"{rec.id}\t{rec.name}\t{rec.length}\t{rec.url or ''}")
+        return 0

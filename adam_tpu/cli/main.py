@@ -1,0 +1,64 @@
+"""``adam-tpu`` command-line interface.
+
+Re-designs the reference CLI framework (cli/AdamMain.scala:23-64,
+AdamCommand.scala:22-50): a registry of subcommands, each a small class with
+an argparse parser and a ``run``.  Commands are registered lazily so ``--help``
+stays fast and optional deps stay optional.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from typing import Callable, Dict
+
+_COMMANDS: Dict[str, Callable[[], "Command"]] = {}
+
+
+class Command:
+    name: str = ""
+    help: str = ""
+
+    def add_args(self, p: argparse.ArgumentParser) -> None:  # pragma: no cover
+        pass
+
+    def run(self, args: argparse.Namespace) -> int:
+        raise NotImplementedError
+
+
+def register(factory: Callable[[], Command]) -> Callable[[], Command]:
+    cmd = factory()
+    _COMMANDS[cmd.name] = lambda c=cmd: c
+    return factory
+
+
+def _load_commands() -> None:
+    # import for side effect of @register
+    from . import commands  # noqa: F401
+
+
+def main(argv=None) -> int:
+    _load_commands()
+    parser = argparse.ArgumentParser(
+        prog="adam-tpu",
+        description="TPU-native genomics read processing "
+                    "(capabilities of the ADAM genomic data system)")
+    sub = parser.add_subparsers(dest="command", metavar="COMMAND")
+    for name in sorted(_COMMANDS):
+        cmd = _COMMANDS[name]()
+        p = sub.add_parser(name, help=cmd.help)
+        cmd.add_args(p)
+        p.set_defaults(_cmd=cmd)
+    args = parser.parse_args(argv)
+    if not getattr(args, "_cmd", None):
+        parser.print_help()
+        return 1
+    try:
+        return args._cmd.run(args) or 0
+    except (FileNotFoundError, IsADirectoryError) as e:
+        print(f"adam-tpu {args.command}: {e}", file=sys.stderr)
+        return 2
+
+
+if __name__ == "__main__":  # pragma: no cover
+    sys.exit(main())

@@ -1,0 +1,68 @@
+"""Format dispatch by file extension — the reference's ``adamLoad``
+(rdd/AdamContext.scala:106-161,318-332): .sam/.bam -> SAM parsing, .vcf ->
+VCF, anything else -> Parquet dataset."""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import pyarrow as pa
+
+from ..models.dictionary import RecordGroupDictionary, SequenceDictionary
+from . import parquet as pqio
+from .sam import read_sam
+
+#: columns the flagstat command projects — the 13-field projection of
+#: cli/FlagStat.scala:50-57 collapses to 4 columns with packed flags.
+FLAGSTAT_COLUMNS = ("flags", "mapq", "referenceId", "mateReferenceId")
+
+
+def load_reads(path: str, *, columns: Optional[Sequence[str]] = None,
+               filters=None
+               ) -> Tuple[pa.Table, Optional[SequenceDictionary],
+                          Optional[RecordGroupDictionary]]:
+    """Load reads from SAM or Parquet; returns (table, seq_dict, rg_dict).
+
+    Dictionaries come from the header for SAM; for Parquet they are
+    reconstructed from the denormalized columns on demand (the reference
+    rebuilds them by scanning and deduplicating, AdamContext.scala:175-236).
+    """
+    p = str(path)
+    if p.endswith(".sam") or p.endswith(".bam"):
+        if p.endswith(".bam"):
+            try:
+                from .bam import read_bam
+            except ImportError as e:
+                raise FileNotFoundError(
+                    f"BAM support not available yet ({e}); convert to SAM") from e
+            table, sd, rg = read_bam(p)
+        else:
+            table, sd, rg = read_sam(p)
+        if columns is not None:
+            table = table.select([c for c in columns])
+        if filters is not None:
+            table = table.filter(filters)
+        return table, sd, rg
+    table = pqio.load_table(p, columns=columns, filters=filters)
+    return table, None, None
+
+
+def sequence_dictionary_from_reads(table: pa.Table) -> SequenceDictionary:
+    """Rebuild the sequence dictionary from denormalized read fields
+    (AdamContext.scala:175-236: scan + dedup of
+    referenceId/Name/Length/Url and the mate variants)."""
+    from ..models.dictionary import SequenceRecord
+    cols = ("referenceId", "referenceName", "referenceLength", "referenceUrl")
+    mate_cols = ("mateReferenceId", "mateReference", "mateReferenceLength",
+                 "mateReferenceUrl")
+    seen = {}
+    for cset in (cols, mate_cols):
+        if not all(c in table.column_names for c in cset):
+            continue
+        sub = table.select(cset).to_pydict()
+        ids, names, lens, urls = (sub[c] for c in cset)
+        for i, n, l, u in zip(ids, names, lens, urls):
+            if i is None or n is None:
+                continue
+            seen[(i, n)] = SequenceRecord(i, n, l or 0, u)
+    return SequenceDictionary(seen.values())

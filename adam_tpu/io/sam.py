@@ -1,0 +1,191 @@
+"""SAM text import/export.
+
+The reference gets SAM/BAM parsing from samtools-jar + hadoop-bam and converts
+each ``SAMRecord`` to an Avro ``ADAMRecord`` in
+``converters/SAMRecordConverter.scala:25-146``.  We parse SAM text directly
+into Arrow columns matching :data:`adam_tpu.schema.READ_SCHEMA`.
+
+Field semantics follow SAMRecordConverter:
+  * reference fields only set when the read has a reference (rname != "*");
+    start = SAM POS - 1 (0-based), unset when POS == 0
+    (SAMRecordConverter.scala:36-54).
+  * mate fields analogous (:57-72).
+  * MD tag is lifted out of the attributes into ``mismatchingPositions``;
+    the remaining tags are flattened "TAG:TYPE:VALUE" joined by tabs
+    (:110-121, AttributeUtils.scala:26-103).
+  * record-group metadata denormalized into each read (:123-141).
+
+One deliberate divergence: the reference only decodes flag booleans when the
+whole SAM flag word is non-zero (SAMRecordConverter.scala:75-101), so a read
+with flags == 0 is recorded as unmapped/non-primary — a bug.  We keep the SAM
+flag word itself (schema.FLAG_* bits), so flags == 0 means mapped, forward,
+primary, as the SAM spec defines.
+"""
+
+from __future__ import annotations
+
+import io
+from typing import List, Optional, Tuple
+
+import pyarrow as pa
+
+from ..models.dictionary import (RecordGroup, RecordGroupDictionary,
+                                 SequenceDictionary)
+from .. import schema as S
+
+_MAPQ_UNKNOWN = 255
+
+
+def read_sam(path_or_file) -> Tuple[pa.Table, SequenceDictionary, RecordGroupDictionary]:
+    """Parse a SAM text file into (reads table, seq dict, record groups)."""
+    if hasattr(path_or_file, "read"):
+        text = path_or_file.read()
+    else:
+        with open(path_or_file, "rt") as f:
+            text = f.read()
+    header_lines = []
+    body_start = 0
+    for line in io.StringIO(text):
+        if line.startswith("@"):
+            header_lines.append(line)
+            body_start += len(line)
+        else:
+            break
+    seq_dict = SequenceDictionary.from_sam_header_lines(header_lines)
+    rg_dict = RecordGroupDictionary.from_sam_header_lines(header_lines)
+
+    cols = {name: [] for name in S.READ_SCHEMA.names}
+
+    def put(**kwargs):
+        for name in S.READ_SCHEMA.names:
+            cols[name].append(kwargs.get(name))
+
+    for line in io.StringIO(text[body_start:]):
+        line = line.rstrip("\n")
+        if not line:
+            continue
+        f = line.split("\t")
+        qname, flag, rname, pos, mapq, cigar, rnext, pnext, _tlen, seq, qual = f[:11]
+        flag = int(flag)
+        row = {
+            "readName": qname if qname != "*" else None,
+            "flags": flag,
+            "sequence": seq if seq != "*" else None,
+            "qual": qual if qual != "*" else None,
+            "cigar": cigar if cigar != "*" else None,
+        }
+        if rname != "*":
+            rec = seq_dict.get(rname)
+            row["referenceName"] = rname
+            row["referenceId"] = rec.id if rec else None
+            if rec:
+                row["referenceLength"] = rec.length
+                row["referenceUrl"] = rec.url
+            if int(pos) != 0:
+                row["start"] = int(pos) - 1
+            if int(mapq) != _MAPQ_UNKNOWN:
+                row["mapq"] = int(mapq)
+        mate_rname = rname if rnext == "=" else rnext
+        if mate_rname != "*":
+            rec = seq_dict.get(mate_rname)
+            row["mateReference"] = mate_rname
+            row["mateReferenceId"] = rec.id if rec else None
+            if rec:
+                row["mateReferenceLength"] = rec.length
+                row["mateReferenceUrl"] = rec.url
+            if int(pnext) > 0:
+                row["mateAlignmentStart"] = int(pnext) - 1
+        attrs = []
+        rg: Optional[RecordGroup] = None
+        for tag_field in f[11:]:
+            tag, typ, value = tag_field.split(":", 2)
+            if tag == "MD":
+                row["mismatchingPositions"] = value
+            elif tag == "RG":
+                rg = rg_dict.get(value)
+                if rg is None:
+                    # tolerate RG tags without a header line: register so each
+                    # distinct group still gets a distinct dense index
+                    rg = RecordGroup(id=value, index=len(rg_dict))
+                    rg_dict.add(rg)
+            else:
+                attrs.append(f"{tag}:{typ}:{value}")
+        if attrs:
+            row["attributes"] = "\t".join(attrs)
+        if rg is not None:
+            row.update(
+                recordGroupName=rg.id, recordGroupId=rg.index,
+                recordGroupSequencingCenter=rg.sequencing_center,
+                recordGroupDescription=rg.description,
+                recordGroupRunDateEpoch=rg.run_date_epoch,
+                recordGroupFlowOrder=rg.flow_order,
+                recordGroupKeySequence=rg.key_sequence,
+                recordGroupLibrary=rg.library,
+                recordGroupPredictedMedianInsertSize=rg.predicted_median_insert_size,
+                recordGroupPlatform=rg.platform,
+                recordGroupPlatformUnit=rg.platform_unit,
+                recordGroupSample=rg.sample,
+            )
+        put(**row)
+
+    table = pa.Table.from_pydict(cols, schema=S.READ_SCHEMA)
+    return table, seq_dict, rg_dict
+
+
+def write_sam(table: pa.Table, seq_dict: SequenceDictionary, path_or_file,
+              rg_dict: Optional[RecordGroupDictionary] = None) -> None:
+    """Serialize a reads table back to SAM text (inverse of :func:`read_sam`)."""
+    close = False
+    if hasattr(path_or_file, "write"):
+        out = path_or_file
+    else:
+        out = open(path_or_file, "wt")
+        close = True
+    try:
+        out.write("@HD\tVN:1.0\tSO:unsorted\n")
+        for line in seq_dict.to_sam_header_lines():
+            out.write(line + "\n")
+        if rg_dict:
+            for g in rg_dict:
+                parts = [f"@RG\tID:{g.id}"]
+                for code, val in (("CN", g.sequencing_center), ("DS", g.description),
+                                  ("FO", g.flow_order), ("KS", g.key_sequence),
+                                  ("LB", g.library), ("PI", g.predicted_median_insert_size),
+                                  ("PL", g.platform), ("PU", g.platform_unit),
+                                  ("SM", g.sample)):
+                    if val is not None:
+                        parts.append(f"{code}:{val}")
+                out.write("\t".join(parts) + "\n")
+        d = table.to_pydict()
+        n = table.num_rows
+        for i in range(n):
+            flag = d["flags"][i] or 0
+            rname = d["referenceName"][i] or "*"
+            start = d["start"][i]
+            mate_ref = d["mateReference"][i] or "*"
+            if mate_ref != "*" and mate_ref == rname:
+                mate_ref = "="
+            mate_start = d["mateAlignmentStart"][i]
+            fields = [
+                d["readName"][i] or "*",
+                str(flag),
+                rname,
+                str(start + 1 if start is not None else 0),
+                str(d["mapq"][i] if d["mapq"][i] is not None else _MAPQ_UNKNOWN),
+                d["cigar"][i] or "*",
+                mate_ref,
+                str(mate_start + 1 if mate_start is not None else 0),
+                "0",
+                d["sequence"][i] or "*",
+                d["qual"][i] or "*",
+            ]
+            if d["mismatchingPositions"][i] is not None:
+                fields.append(f"MD:Z:{d['mismatchingPositions'][i]}")
+            if d["recordGroupName"][i] is not None:
+                fields.append(f"RG:Z:{d['recordGroupName'][i]}")
+            if d["attributes"][i]:
+                fields.extend(d["attributes"][i].split("\t"))
+            out.write("\t".join(fields) + "\n")
+    finally:
+        if close:
+            out.close()

@@ -1,0 +1,218 @@
+"""Sequence and record-group dictionaries.
+
+Re-designs ``models/SequenceDictionary.scala:31-490`` and
+``models/RecordGroupDictionary.scala:23-44`` from the reference: a bijective
+id <-> contig-name map with compatibility checking and id-reconciliation
+(``mapTo``/``remap`` with ``nonoverlappingHash``) used when unioning files
+whose headers assign different ids to the same contig.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, Optional
+
+
+@dataclass(frozen=True)
+class SequenceRecord:
+    """One contig: mirrors SequenceRecord (SequenceDictionary.scala:380-430)."""
+    id: int
+    name: str
+    length: int
+    url: Optional[str] = None
+
+    def compatible(self, other: "SequenceRecord") -> bool:
+        # same name+length ⇒ same contig, even if ids differ
+        return self.name == other.name and self.length == other.length
+
+
+class SequenceDictionary:
+    """Bijective id<->name contig map (SequenceDictionary.scala:31-275)."""
+
+    def __init__(self, records: Iterable[SequenceRecord] = ()):
+        self._by_id: Dict[int, SequenceRecord] = {}
+        self._by_name: Dict[str, SequenceRecord] = {}
+        for rec in records:
+            self.add(rec)
+
+    def add(self, rec: SequenceRecord) -> None:
+        existing = self._by_id.get(rec.id)
+        if existing is not None and not existing.compatible(rec):
+            raise ValueError(
+                f"incompatible records share id {rec.id}: {existing} vs {rec}")
+        existing_name = self._by_name.get(rec.name)
+        if existing_name is not None and existing_name.id != rec.id:
+            raise ValueError(
+                f"contig {rec.name!r} appears with ids "
+                f"{existing_name.id} and {rec.id}")
+        self._by_id[rec.id] = rec
+        self._by_name[rec.name] = rec
+
+    # -- lookups ---------------------------------------------------------
+    def __contains__(self, key) -> bool:
+        return key in self._by_id or key in self._by_name
+
+    def __getitem__(self, key) -> SequenceRecord:
+        if isinstance(key, str):
+            return self._by_name[key]
+        return self._by_id[key]
+
+    def get(self, key, default=None):
+        try:
+            return self[key]
+        except KeyError:
+            return default
+
+    def __len__(self) -> int:
+        return len(self._by_id)
+
+    def __iter__(self):
+        return iter(sorted(self._by_id.values(), key=lambda r: r.id))
+
+    def records(self):
+        return list(self)
+
+    def __eq__(self, other) -> bool:
+        return isinstance(other, SequenceDictionary) and \
+            self._by_id == other._by_id
+
+    def __repr__(self) -> str:
+        return f"SequenceDictionary({self.records()})"
+
+    # -- set algebra (SequenceDictionary.scala:120-220) ------------------
+    def is_compatible_with(self, other: "SequenceDictionary") -> bool:
+        """True when no contig name maps to conflicting (length) records."""
+        for name, rec in self._by_name.items():
+            o = other._by_name.get(name)
+            if o is not None and not rec.compatible(o):
+                return False
+        return True
+
+    def __add__(self, other: "SequenceDictionary") -> "SequenceDictionary":
+        merged = SequenceDictionary(self.records())
+        for rec in other:
+            if rec.name in merged._by_name:
+                if not merged._by_name[rec.name].compatible(rec):
+                    raise ValueError(f"incompatible contig {rec.name}")
+            else:
+                merged.add(rec)
+        return merged
+
+    def map_to(self, target: "SequenceDictionary") -> Dict[int, int]:
+        """id-remap table taking this dictionary's ids onto ``target``'s.
+
+        Mirrors SequenceDictionary.mapTo (SequenceDictionary.scala:150-220):
+        contigs present in ``target`` (by name) take target's id; contigs
+        absent take a fresh id not used by either side
+        (``nonoverlappingHash``).
+        """
+        used = set(target._by_id) | set(self._by_id)
+
+        def fresh(start: int) -> int:
+            h = start
+            while h in used:
+                h += 1
+            used.add(h)
+            return h
+
+        import zlib
+        remap: Dict[int, int] = {}
+        for rec in self:
+            t = target._by_name.get(rec.name)
+            # crc32: deterministic across processes, unlike Python's salted hash
+            remap[rec.id] = t.id if t is not None else \
+                fresh(zlib.crc32(rec.name.encode()) % (1 << 30))
+        return remap
+
+    def remap(self, id_map: Dict[int, int]) -> "SequenceDictionary":
+        return SequenceDictionary(
+            SequenceRecord(id_map.get(r.id, r.id), r.name, r.length, r.url)
+            for r in self)
+
+    # -- SAM header conversion ------------------------------------------
+    @classmethod
+    def from_sam_header_lines(cls, lines: Iterable[str]) -> "SequenceDictionary":
+        """Build from @SQ header lines (SequenceDictionary.scala:232-275)."""
+        recs = []
+        idx = 0
+        for line in lines:
+            if not line.startswith("@SQ"):
+                continue
+            fields = dict(f.split(":", 1) for f in line.rstrip("\n").split("\t")[1:]
+                          if ":" in f)
+            recs.append(SequenceRecord(idx, fields["SN"], int(fields.get("LN", 0)),
+                                       fields.get("UR")))
+            idx += 1
+        return cls(recs)
+
+    def to_sam_header_lines(self):
+        out = []
+        for rec in self:
+            line = f"@SQ\tSN:{rec.name}\tLN:{rec.length}"
+            if rec.url:
+                line += f"\tUR:{rec.url}"
+            out.append(line)
+        return out
+
+
+@dataclass
+class RecordGroup:
+    """One @RG header line's metadata (denormalized into reads on convert)."""
+    id: str
+    index: int
+    sequencing_center: Optional[str] = None
+    description: Optional[str] = None
+    run_date_epoch: Optional[int] = None
+    flow_order: Optional[str] = None
+    key_sequence: Optional[str] = None
+    library: Optional[str] = None
+    predicted_median_insert_size: Optional[int] = None
+    platform: Optional[str] = None
+    platform_unit: Optional[str] = None
+    sample: Optional[str] = None
+
+
+class RecordGroupDictionary:
+    """name -> dense index map (RecordGroupDictionary.scala:23-44)."""
+
+    def __init__(self, groups: Iterable[RecordGroup] = ()):
+        self._by_name: Dict[str, RecordGroup] = {}
+        for g in groups:
+            self.add(g)
+
+    def add(self, group: RecordGroup) -> None:
+        self._by_name[group.id] = group
+
+    @classmethod
+    def from_sam_header_lines(cls, lines: Iterable[str]) -> "RecordGroupDictionary":
+        groups = []
+        for line in lines:
+            if not line.startswith("@RG"):
+                continue
+            fields = dict(f.split(":", 1) for f in line.rstrip("\n").split("\t")[1:]
+                          if ":" in f)
+            g = RecordGroup(
+                id=fields.get("ID", str(len(groups))), index=len(groups),
+                sequencing_center=fields.get("CN"), description=fields.get("DS"),
+                flow_order=fields.get("FO"), key_sequence=fields.get("KS"),
+                library=fields.get("LB"), platform=fields.get("PL"),
+                platform_unit=fields.get("PU"), sample=fields.get("SM"),
+                predicted_median_insert_size=(int(fields["PI"]) if "PI" in fields else None),
+            )
+            groups.append(g)
+        return cls(groups)
+
+    def __contains__(self, name: str) -> bool:
+        return name in self._by_name
+
+    def __getitem__(self, name: str) -> RecordGroup:
+        return self._by_name[name]
+
+    def get(self, name: str, default=None):
+        return self._by_name.get(name, default)
+
+    def __len__(self) -> int:
+        return len(self._by_name)
+
+    def __iter__(self):
+        return iter(sorted(self._by_name.values(), key=lambda g: g.index))

@@ -1,0 +1,211 @@
+"""Ragged read records -> fixed-shape device tensors.
+
+This is the TPU substrate replacing the reference's per-record JVM objects:
+instead of an ``RDD[ADAMRecord]`` we carry a structure-of-arrays
+:class:`ReadBatch` — padded int8/int32 tensors in HBM — and every kernel
+(flagstat, markdup scoring, BQSR, pileup, realignment sweep) is a batched
+tensor op over it.  Columnar projection (the reference's Parquet trick,
+cli/FlagStat.scala:50-57) becomes "only pack the columns you need".
+
+Packing policy (SURVEY.md §7 hard part (a)): bases/quals pad to a length
+bucket (reads are ~100-150 bp; the bucket is rounded up to a multiple of 128
+so rows map cleanly onto TPU lanes), batch row-count pads to a multiple of
+``pad_rows_to`` so the batch splits evenly across a device mesh.  Padded rows
+have ``valid == False`` and are ignored by every kernel.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, fields as dc_fields
+from typing import Optional
+
+import numpy as np
+import pyarrow as pa
+
+try:  # keep importable without jax for host-only tooling
+    import jax
+    _HAVE_JAX = True
+except Exception:  # pragma: no cover
+    _HAVE_JAX = False
+
+from . import schema as S
+
+_BASE_LUT = np.full(256, S.BASE_PAD, np.int8)
+for _ch, _code in S.BASE_CODE.items():
+    _BASE_LUT[ord(_ch)] = _code
+
+_CIGAR_LUT = np.full(256, -1, np.int8)
+for _ch, _code in S.CIGAR_CODE.items():
+    _CIGAR_LUT[ord(_ch)] = _code
+
+QUAL_PAD = -1
+MAX_CIGAR_OPS = 16  # default op-slot budget per read
+
+
+@dataclass
+class ReadBatch:
+    """Fixed-shape columnar batch of reads (device pytree).
+
+    Scalar-per-read columns are always present; base-level and cigar-level
+    columns are optional (None when not packed).  ``row_index`` maps each row
+    back to its source row in the originating Arrow table so host-side string
+    fields (readName, cigar/MD rewrites) can be joined back after device
+    compute.
+    """
+    flags: np.ndarray          # int32 [N] SAM flag word
+    refid: np.ndarray          # int32 [N], -1 = null/unmapped
+    start: np.ndarray          # int32 [N], -1 = null (0-based)
+    mapq: np.ndarray           # int32 [N], -1 = null
+    mate_refid: np.ndarray     # int32 [N], -1 = null
+    mate_start: np.ndarray     # int32 [N], -1 = null
+    read_group: np.ndarray     # int32 [N], -1 = null (dense record-group index)
+    valid: np.ndarray          # bool  [N]
+    row_index: np.ndarray      # int32 [N], -1 for padding rows
+    read_len: Optional[np.ndarray] = None    # int32 [N]
+    bases: Optional[np.ndarray] = None       # int8 [N, L] codes, -1 pad
+    quals: Optional[np.ndarray] = None       # int8 [N, L] phred, -1 pad
+    cigar_ops: Optional[np.ndarray] = None   # int8 [N, C], -1 pad
+    cigar_lens: Optional[np.ndarray] = None  # int32 [N, C], 0 pad
+    n_cigar: Optional[np.ndarray] = None     # int32 [N]
+
+    @property
+    def n_reads(self) -> int:
+        return int(self.flags.shape[0])
+
+    @property
+    def max_len(self) -> int:
+        return 0 if self.bases is None else int(self.bases.shape[1])
+
+    def device_put(self, sharding=None) -> "ReadBatch":
+        kw = {}
+        for f in dc_fields(self):
+            v = getattr(self, f.name)
+            kw[f.name] = None if v is None else jax.device_put(v, sharding)
+        return ReadBatch(**kw)
+
+
+if _HAVE_JAX:
+    jax.tree_util.register_pytree_node(
+        ReadBatch,
+        lambda rb: (tuple(getattr(rb, f.name) for f in dc_fields(rb)), None),
+        lambda _, children: ReadBatch(*children),
+    )
+
+
+def _round_up(x: int, mult: int) -> int:
+    return ((x + mult - 1) // mult) * mult if mult > 1 else x
+
+
+def _string_column_to_padded(col: pa.ChunkedArray, n_rows: int, pad_to: int,
+                             lut: np.ndarray, pad_value: int,
+                             offset: int = 0) -> tuple[np.ndarray, np.ndarray]:
+    """Vectorized: Arrow string column -> (padded int8 [N,L], lengths int32 [N])."""
+    arr = col.combine_chunks()
+    if isinstance(arr, pa.ChunkedArray):  # zero-chunk edge case
+        arr = pa.concat_arrays(arr.chunks) if arr.num_chunks else pa.array([], pa.string())
+    # offsets/data straight from the Arrow buffers — no per-row Python
+    bufs = arr.buffers()
+    offsets = np.frombuffer(bufs[1], np.int32, count=len(arr) + 1, offset=arr.offset * 4)
+    data = np.frombuffer(bufs[2], np.uint8) if bufs[2] is not None else np.zeros(0, np.uint8)
+    lens = (offsets[1:] - offsets[:-1]).astype(np.int32)
+    if arr.null_count:
+        nulls = np.asarray(arr.is_null())
+        lens = np.where(nulls, 0, lens)
+    L = max(int(lens.max(initial=0)), 1)
+    L = _round_up(L, 128) if pad_to == 0 else pad_to
+    if lens.max(initial=0) > L:
+        raise ValueError(f"read length {lens.max()} exceeds bucket {L}")
+    out = np.full((n_rows, L), pad_value, np.int8)
+    lens_full = np.zeros(n_rows, np.int32)
+    lens_full[:len(arr)] = lens
+    if data.size == 0:
+        return out, lens_full
+    pos = np.arange(L)[None, :]
+    mask = pos < lens[:len(arr), None]
+    # gather source byte for every (row, pos) inside the mask
+    src = offsets[:-1, None] + pos
+    vals = data[np.where(mask, src, 0)]
+    decoded = (lut[vals].astype(np.int16) - offset).astype(np.int8) if offset == 0 \
+        else (vals.astype(np.int16) - offset).astype(np.int8)
+    out[:len(arr)][mask] = decoded[mask]
+    return out, lens_full
+
+
+def _int_column(table: pa.Table, name: str, n_rows: int, null_value=-1) -> np.ndarray:
+    if name not in table.column_names:  # projected-out column
+        return np.full(n_rows, null_value, np.int32)
+    col = table.column(name)
+    np_col = col.to_numpy(zero_copy_only=False)
+    out = np.full(n_rows, null_value, np.int32)
+    vals = np.where(np.isnan(np_col.astype(np.float64)), null_value, np_col) \
+        if np_col.dtype.kind == "f" else np_col
+    vals = vals.astype(np.int64)
+    if vals.size and (vals.max(initial=0) > np.iinfo(np.int32).max or
+                      vals.min(initial=0) < np.iinfo(np.int32).min):
+        # device columns are int32; contigs longer than 2^31 bp would need a
+        # (refid, offset) split which no current genome requires
+        raise OverflowError(f"column {name!r} exceeds int32 range")
+    out[:len(vals)] = vals.astype(np.int32)
+    return out
+
+
+def pack_cigars(cigars, n_rows: int, max_ops: int = MAX_CIGAR_OPS):
+    """CIGAR strings -> (ops int8 [N,C], lens int32 [N,C], n_ops int32 [N]).
+
+    Replaces the samtools TextCigarCodec the reference leans on
+    (rich/RichADAMRecord.scala:58-60).
+    """
+    ops = np.full((n_rows, max_ops), -1, np.int8)
+    lens = np.zeros((n_rows, max_ops), np.int32)
+    n_ops = np.zeros(n_rows, np.int32)
+    for i, c in enumerate(cigars):
+        if c is None or c == "*":
+            continue
+        j = 0
+        num = 0
+        for ch in c:
+            if ch.isdigit():
+                num = num * 10 + ord(ch) - 48
+            else:
+                if j >= max_ops:
+                    raise ValueError(f"cigar {c!r} exceeds {max_ops} ops")
+                ops[i, j] = S.CIGAR_CODE[ch]
+                lens[i, j] = num
+                num = 0
+                j += 1
+        n_ops[i] = j
+    return ops, lens, n_ops
+
+
+def pack_reads(table: pa.Table, *, with_bases: bool = True,
+               with_cigar: bool = True, bucket_len: int = 0,
+               pad_rows_to: int = 1, max_cigar_ops: int = MAX_CIGAR_OPS) -> ReadBatch:
+    """Pack an Arrow reads table (READ_SCHEMA) into a :class:`ReadBatch`."""
+    n = table.num_rows
+    n_pad = _round_up(max(n, 1), pad_rows_to)
+
+    flags = _int_column(table, "flags", n_pad, null_value=0)
+    batch = dict(
+        flags=flags,
+        refid=_int_column(table, "referenceId", n_pad),
+        start=_int_column(table, "start", n_pad),
+        mapq=_int_column(table, "mapq", n_pad),
+        mate_refid=_int_column(table, "mateReferenceId", n_pad),
+        mate_start=_int_column(table, "mateAlignmentStart", n_pad),
+        read_group=_int_column(table, "recordGroupId", n_pad),
+        valid=np.arange(n_pad) < n,
+        row_index=np.where(np.arange(n_pad) < n,
+                           np.arange(n_pad), -1).astype(np.int32),
+    )
+    if with_bases:
+        bases, read_len = _string_column_to_padded(
+            table.column("sequence"), n_pad, bucket_len, _BASE_LUT, S.BASE_PAD)
+        quals, _ = _string_column_to_padded(
+            table.column("qual"), n_pad, bases.shape[1], _BASE_LUT, QUAL_PAD,
+            offset=33)
+        batch.update(bases=bases, quals=quals, read_len=read_len)
+    if with_cigar:
+        ops, lens, n_ops = pack_cigars(
+            table.column("cigar").to_pylist(), n_pad, max_cigar_ops)
+        batch.update(cigar_ops=ops, cigar_lens=lens, n_cigar=n_ops)
+    return ReadBatch(**batch)

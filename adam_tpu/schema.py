@@ -1,0 +1,239 @@
+"""Columnar record schemas for the TPU-native ADAM rebuild.
+
+The reference defines ten Avro records in
+``adam-format/src/main/resources/avro/adam.avdl`` (ADAMRecord :4-68, Base :70-88,
+ADAMNucleotideContig :90-97, ADAMPileup :99-128, ADAMVariant :157-217,
+ADAMGenotype :219-298, ADAMVariantDomain :310-325, ...).  We mirror those records
+as Arrow schemas so Parquet files keep the reference's columnar/projection
+discipline, with one TPU-first change: the eleven read-flag booleans of
+ADAMRecord (adam.avdl:31-43) are packed into a single uint32 ``flags`` column
+using the standard SAM flag bit layout.  On device that single word is what the
+kernels consume; the Avro-style boolean views are exposed as helper expressions
+(see :data:`FLAG_FIELDS`).
+
+Coordinates are 0-based throughout, like the reference (adam.avdl:16-17).
+"""
+
+from __future__ import annotations
+
+import pyarrow as pa
+
+# --------------------------------------------------------------------------
+# SAM flag bits (standard layout; replaces adam.avdl:31-43 booleans)
+# --------------------------------------------------------------------------
+
+FLAG_PAIRED = 0x1            # readPaired
+FLAG_PROPER_PAIR = 0x2       # properPair
+FLAG_UNMAPPED = 0x4          # !readMapped
+FLAG_MATE_UNMAPPED = 0x8     # !mateMapped
+FLAG_REVERSE = 0x10          # readNegativeStrand
+FLAG_MATE_REVERSE = 0x20     # mateNegativeStrand
+FLAG_FIRST_OF_PAIR = 0x40    # firstOfPair
+FLAG_SECOND_OF_PAIR = 0x80   # secondOfPair
+FLAG_SECONDARY = 0x100       # !primaryAlignment
+FLAG_QC_FAIL = 0x200         # failedVendorQualityChecks
+FLAG_DUPLICATE = 0x400       # duplicateRead
+FLAG_SUPPLEMENTARY = 0x800   # (not modeled by the reference; kept for SAM parity)
+
+#: Mapping from the reference's ADAMRecord boolean field names (adam.avdl:31-43)
+#: to ``(bit, inverted)`` pairs over the packed ``flags`` column.
+FLAG_FIELDS = {
+    "readPaired": (FLAG_PAIRED, False),
+    "properPair": (FLAG_PROPER_PAIR, False),
+    "readMapped": (FLAG_UNMAPPED, True),
+    "mateMapped": (FLAG_MATE_UNMAPPED, True),
+    "readNegativeStrand": (FLAG_REVERSE, False),
+    "mateNegativeStrand": (FLAG_MATE_REVERSE, False),
+    "firstOfPair": (FLAG_FIRST_OF_PAIR, False),
+    "secondOfPair": (FLAG_SECOND_OF_PAIR, False),
+    "primaryAlignment": (FLAG_SECONDARY, True),
+    "failedVendorQualityChecks": (FLAG_QC_FAIL, False),
+    "duplicateRead": (FLAG_DUPLICATE, False),
+}
+
+# --------------------------------------------------------------------------
+# Base / CIGAR alphabets
+# --------------------------------------------------------------------------
+
+#: IUPAC nucleotide alphabet, same 17 symbols as the Base enum (adam.avdl:70-88).
+#: The first four codes are the base-4 encoding used by the BQSR context
+#: covariate (cf. StandardCovariate.scala:50-104); N is code 4.
+BASES = "ACGTNUXKMRYSWBVHD"
+BASE_CODE = {b: i for i, b in enumerate(BASES)}
+BASE_CODE.update({b.lower(): i for i, b in enumerate(BASES)})
+BASE_PAD = -1
+
+#: CIGAR operators in SAM spec order: code = index in "MIDNSHP=X".
+CIGAR_OPS = "MIDNSHP=X"
+CIGAR_CODE = {op: i for i, op in enumerate(CIGAR_OPS)}
+(CIGAR_M, CIGAR_I, CIGAR_D, CIGAR_N, CIGAR_S,
+ CIGAR_H, CIGAR_P, CIGAR_EQ, CIGAR_X) = range(9)
+#: ops that consume read bases / reference bases (SAM spec)
+CIGAR_CONSUMES_READ = (True, True, False, False, True, False, False, True, True)
+CIGAR_CONSUMES_REF = (True, False, True, True, False, False, False, True, True)
+
+# --------------------------------------------------------------------------
+# Arrow schemas (Parquet on-disk layout)
+# --------------------------------------------------------------------------
+
+#: ADAMRecord (adam.avdl:4-68) with the flag booleans packed into ``flags``.
+READ_SCHEMA = pa.schema([
+    pa.field("referenceName", pa.string()),
+    pa.field("referenceId", pa.int32()),
+    pa.field("start", pa.int64()),
+    pa.field("mapq", pa.int32()),
+    pa.field("readName", pa.string()),
+    pa.field("sequence", pa.string()),
+    pa.field("mateReference", pa.string()),
+    pa.field("mateAlignmentStart", pa.int64()),
+    pa.field("cigar", pa.string()),
+    pa.field("qual", pa.string()),
+    pa.field("recordGroupName", pa.string()),
+    pa.field("recordGroupId", pa.int32()),
+    pa.field("flags", pa.uint32()),
+    pa.field("mismatchingPositions", pa.string()),   # the SAM MD tag
+    pa.field("attributes", pa.string()),
+    # denormalized record-group metadata (adam.avdl:49-59)
+    pa.field("recordGroupSequencingCenter", pa.string()),
+    pa.field("recordGroupDescription", pa.string()),
+    pa.field("recordGroupRunDateEpoch", pa.int64()),
+    pa.field("recordGroupFlowOrder", pa.string()),
+    pa.field("recordGroupKeySequence", pa.string()),
+    pa.field("recordGroupLibrary", pa.string()),
+    pa.field("recordGroupPredictedMedianInsertSize", pa.int32()),
+    pa.field("recordGroupPlatform", pa.string()),
+    pa.field("recordGroupPlatformUnit", pa.string()),
+    pa.field("recordGroupSample", pa.string()),
+    pa.field("mateReferenceId", pa.int32()),
+    # denormalized sequence-dictionary fields (adam.avdl:6-12,62-67)
+    pa.field("referenceLength", pa.int64()),
+    pa.field("referenceUrl", pa.string()),
+    pa.field("mateReferenceLength", pa.int64()),
+    pa.field("mateReferenceUrl", pa.string()),
+])
+
+#: ADAMNucleotideContig (adam.avdl:90-97); sequence stored as a string, not an
+#: enum array — strings are the natural Arrow/Parquet layout.
+CONTIG_SCHEMA = pa.schema([
+    pa.field("contigName", pa.string()),
+    pa.field("contigId", pa.int32()),
+    pa.field("description", pa.string()),
+    pa.field("sequence", pa.large_string()),
+    pa.field("sequenceLength", pa.int64()),
+    pa.field("url", pa.string()),
+])
+
+#: ADAMPileup (adam.avdl:99-128).
+PILEUP_SCHEMA = pa.schema([
+    pa.field("referenceName", pa.string()),
+    pa.field("referenceId", pa.int32()),
+    pa.field("position", pa.int64()),
+    pa.field("rangeOffset", pa.int32()),
+    pa.field("rangeLength", pa.int32()),
+    pa.field("referenceBase", pa.string()),
+    pa.field("readBase", pa.string()),
+    pa.field("sangerQuality", pa.int32()),
+    pa.field("mapQuality", pa.int32()),
+    pa.field("numSoftClipped", pa.int32()),
+    pa.field("numReverseStrand", pa.int32()),
+    pa.field("countAtPosition", pa.int32()),
+    pa.field("readName", pa.string()),
+    pa.field("readStart", pa.int64()),
+    pa.field("readEnd", pa.int64()),
+    pa.field("recordGroupSequencingCenter", pa.string()),
+    pa.field("recordGroupDescription", pa.string()),
+    pa.field("recordGroupRunDateEpoch", pa.int64()),
+    pa.field("recordGroupFlowOrder", pa.string()),
+    pa.field("recordGroupKeySequence", pa.string()),
+    pa.field("recordGroupLibrary", pa.string()),
+    pa.field("recordGroupPredictedMedianInsertSize", pa.int32()),
+    pa.field("recordGroupPlatform", pa.string()),
+    pa.field("recordGroupPlatformUnit", pa.string()),
+    pa.field("recordGroupSample", pa.string()),
+])
+
+#: ADAMVariant (adam.avdl:157-217).
+VARIANT_SCHEMA = pa.schema([
+    pa.field("referenceId", pa.int32()),
+    pa.field("referenceName", pa.string()),
+    pa.field("position", pa.int64()),
+    pa.field("referenceAllele", pa.string()),
+    pa.field("variant", pa.string()),
+    pa.field("variantType", pa.string()),
+    pa.field("id", pa.string()),
+    pa.field("quality", pa.int32()),
+    pa.field("filters", pa.string()),
+    pa.field("filtersRun", pa.bool_()),
+    pa.field("alleleFrequency", pa.float64()),
+    pa.field("rmsBaseQuality", pa.int32()),
+    pa.field("siteRmsMapQuality", pa.int32()),
+    pa.field("siteMapQZeroCounts", pa.int32()),
+    pa.field("totalSiteMapCounts", pa.int32()),
+    pa.field("numberOfSamplesWithData", pa.int32()),
+    pa.field("structuralVariantType", pa.string()),
+    pa.field("svLength", pa.int64()),
+    pa.field("svIsPrecise", pa.bool_()),
+    pa.field("svEnd", pa.int64()),
+    pa.field("svConfidenceIntervalStartLow", pa.int64()),
+    pa.field("svConfidenceIntervalStartHigh", pa.int64()),
+    pa.field("svConfidenceIntervalEndLow", pa.int64()),
+    pa.field("svConfidenceIntervalEndHigh", pa.int64()),
+])
+
+#: ADAMGenotype (adam.avdl:219-298).
+GENOTYPE_SCHEMA = pa.schema([
+    pa.field("referenceId", pa.int32()),
+    pa.field("referenceName", pa.string()),
+    pa.field("position", pa.int64()),
+    pa.field("sampleId", pa.string()),
+    pa.field("ploidy", pa.int32()),
+    pa.field("haplotypeNumber", pa.int32()),
+    pa.field("alleleVariantType", pa.string()),
+    pa.field("allele", pa.string()),
+    pa.field("isReference", pa.bool_()),
+    pa.field("referenceAllele", pa.string()),
+    pa.field("expectedAlleleDosage", pa.float64()),
+    pa.field("genotypeQuality", pa.int32()),
+    pa.field("depth", pa.int32()),
+    pa.field("phredLikelihoods", pa.string()),
+    pa.field("phredPosteriorLikelihoods", pa.string()),
+    pa.field("ploidyStateGenotypeLikelihoods", pa.string()),
+    pa.field("haplotypeQuality", pa.int32()),
+    pa.field("rmsBaseQuality", pa.int32()),
+    pa.field("rmsMapQuality", pa.int32()),
+    pa.field("readsMappedForwardStrand", pa.int32()),
+    pa.field("readsMappedMapQ0", pa.int32()),
+    pa.field("svType", pa.string()),
+    pa.field("svLength", pa.int64()),
+    pa.field("svIsPrecise", pa.bool_()),
+    pa.field("svEnd", pa.int64()),
+    pa.field("svConfidenceIntervalStartLow", pa.int64()),
+    pa.field("svConfidenceIntervalStartHigh", pa.int64()),
+    pa.field("svConfidenceIntervalEndLow", pa.int64()),
+    pa.field("svConfidenceIntervalEndHigh", pa.int64()),
+    pa.field("isPhased", pa.bool_()),
+    pa.field("isPhaseSwitch", pa.bool_()),
+    pa.field("phaseSetId", pa.string()),
+    pa.field("phaseQuality", pa.int32()),
+])
+
+#: ADAMVariantDomain (adam.avdl:310-325).
+VARIANT_DOMAIN_SCHEMA = pa.schema([
+    pa.field("referenceId", pa.int32()),
+    pa.field("position", pa.int64()),
+    pa.field("referenceAllele", pa.string()),
+    pa.field("variant", pa.string()),
+    pa.field("inDbSNP", pa.bool_()),
+    pa.field("inHM2", pa.bool_()),
+    pa.field("inHM3", pa.bool_()),
+    pa.field("in1000G", pa.bool_()),
+])
+
+SCHEMAS = {
+    "read": READ_SCHEMA,
+    "contig": CONTIG_SCHEMA,
+    "pileup": PILEUP_SCHEMA,
+    "variant": VARIANT_SCHEMA,
+    "genotype": GENOTYPE_SCHEMA,
+    "variantdomain": VARIANT_DOMAIN_SCHEMA,
+}

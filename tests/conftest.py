@@ -1,0 +1,28 @@
+"""Test harness: every test runs on a virtual 8-device CPU mesh.
+
+The reference's SparkFunSuite spins up an in-process local[4] SparkContext per
+test so distributed code paths (shuffles included) run in one JVM
+(test/.../util/SparkFunSuite.scala:26-100).  The JAX equivalent: force the CPU
+backend with 8 virtual devices, so every shard_map/pjit test exercises real
+multi-device sharding and collectives without TPU hardware.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import pathlib
+
+import pytest
+
+
+RESOURCES = pathlib.Path(__file__).parent / "resources"
+
+
+@pytest.fixture(scope="session")
+def resources() -> pathlib.Path:
+    return RESOURCES

@@ -1,0 +1,90 @@
+"""Flagstat kernel tests.
+
+Scenario coverage mirrors the reference's FlagStat usage: per-flag counters,
+QC-pass/fail split, duplicate sub-metrics, cross-chromosome mates
+(rdd/FlagStat.scala:85-114).
+"""
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from adam_tpu import schema as S
+from adam_tpu.io.sam import read_sam
+from adam_tpu.ops.flagstat import flagstat, format_report
+from adam_tpu.packing import pack_reads
+
+
+def make_table(rows):
+    cols = {name: [] for name in S.READ_SCHEMA.names}
+    for row in rows:
+        for name in S.READ_SCHEMA.names:
+            cols[name].append(row.get(name))
+    return pa.Table.from_pydict(cols, schema=S.READ_SCHEMA)
+
+
+def read(flags=0, mapq=50, refid=0, mate_refid=None, **kw):
+    return dict(flags=flags, mapq=mapq, referenceId=refid,
+                mateReferenceId=mate_refid, **kw)
+
+
+def test_small_sam_counts(resources):
+    table, seq_dict, _ = read_sam(resources / "small.sam")
+    assert table.num_rows == 20
+    assert len(seq_dict) == 2
+    batch = pack_reads(table, with_bases=False, with_cigar=False)
+    failed, passed = flagstat(batch)
+    # all 20 reads in small.sam are mapped, unpaired, QC-passed
+    assert passed.total == 20
+    assert passed.mapped == 20
+    assert passed.paired_in_sequencing == 0
+    assert failed.total == 0
+
+
+def test_flag_split_and_duplicates():
+    paired = S.FLAG_PAIRED
+    rows = [
+        read(flags=0),                                       # mapped single
+        read(flags=S.FLAG_UNMAPPED, refid=None, mapq=None),  # unmapped
+        read(flags=S.FLAG_QC_FAIL),                          # failed QC
+        read(flags=S.FLAG_DUPLICATE),                        # primary dup
+        read(flags=S.FLAG_DUPLICATE | S.FLAG_SECONDARY),     # secondary dup
+        read(flags=paired | S.FLAG_PROPER_PAIR | S.FLAG_FIRST_OF_PAIR,
+             mate_refid=0),                                  # proper pair r1
+        read(flags=paired | S.FLAG_SECOND_OF_PAIR | S.FLAG_MATE_UNMAPPED),
+        read(flags=paired, mate_refid=1, mapq=3),            # cross-chrom, low mapq
+        read(flags=paired, mate_refid=1, mapq=30),           # cross-chrom
+    ]
+    failed, passed = flagstat(pack_reads(make_table(rows), with_bases=False,
+                                         with_cigar=False))
+    assert passed.total == 8 and failed.total == 1
+    assert failed.mapped == 1
+    assert passed.mapped == 7  # one unmapped among passed
+    assert passed.duplicates_primary.total == 1
+    assert passed.duplicates_secondary.total == 1
+    assert passed.paired_in_sequencing == 4
+    assert passed.read1 == 1 and passed.read2 == 1
+    assert passed.properly_paired == 1
+    assert passed.with_self_and_mate_mapped == 3
+    assert passed.singleton == 1
+    assert passed.with_mate_mapped_to_diff_chromosome == 2
+    assert passed.with_mate_mapped_to_diff_chromosome_mapq5 == 1
+
+
+def test_padding_rows_ignored():
+    rows = [read(flags=0)] * 3
+    batch = pack_reads(make_table(rows), with_bases=False, with_cigar=False,
+                       pad_rows_to=8)
+    assert batch.n_reads == 8
+    failed, passed = flagstat(batch)
+    assert passed.total == 3 and failed.total == 0
+
+
+def test_report_shape():
+    rows = [read(flags=0)]
+    failed, passed = flagstat(pack_reads(make_table(rows), with_bases=False,
+                                         with_cigar=False))
+    report = format_report(failed, passed)
+    assert "1 + 0 in total (QC-passed reads + QC-failed reads)" in report
+    assert "1 + 0 mapped (100.00%:0.00%)" in report
+    assert len(report.strip().splitlines()) == 18
