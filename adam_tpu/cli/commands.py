@@ -62,6 +62,45 @@ class Bam2AdamCommand(Command):
 
 
 @register
+class TransformCommand(Command):
+    name = "transform"
+    help = "Read pre-processing pipeline (markdup/BQSR/realign/sort)"
+
+    def add_args(self, p: argparse.ArgumentParser) -> None:
+        # flag names mirror cli/Transform.scala:40-60
+        p.add_argument("input", help="SAM/BAM file or ADAM Parquet dataset")
+        p.add_argument("output", help="output Parquet dataset directory "
+                                      "(or .sam path)")
+        p.add_argument("-mark_duplicate_reads", action="store_true")
+        p.add_argument("-sort_reads", action="store_true")
+        p.add_argument("-parts", type=int, default=1)
+
+    def run(self, args) -> int:
+        from ..io.dispatch import load_reads, sequence_dictionary_from_reads
+        from ..io.parquet import save_table
+
+        table, seq_dict, rg_dict = load_reads(args.input)
+        if args.mark_duplicate_reads:
+            from ..ops.markdup import mark_duplicates
+            table = mark_duplicates(table)
+        if args.sort_reads:
+            from ..ops.sort import sort_reads
+            table = sort_reads(table)
+        if args.output.endswith(".sam"):
+            from ..io.dispatch import record_group_dictionary_from_reads
+            from ..io.sam import write_sam
+            if seq_dict is None:
+                seq_dict = sequence_dictionary_from_reads(table)
+            if rg_dict is None:
+                rg_dict = record_group_dictionary_from_reads(table)
+            write_sam(table, seq_dict, args.output, rg_dict)
+        else:
+            save_table(table, args.output, n_parts=args.parts)
+        print(f"wrote {table.num_rows} reads to {args.output}")
+        return 0
+
+
+@register
 class PrintCommand(Command):
     name = "print"
     help = "Print an ADAM Parquet dataset (or SAM) as records"

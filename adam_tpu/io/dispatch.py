@@ -47,6 +47,40 @@ def load_reads(path: str, *, columns: Optional[Sequence[str]] = None,
     return table, None, None
 
 
+def record_group_dictionary_from_reads(table: pa.Table) -> RecordGroupDictionary:
+    """Rebuild record groups from the denormalized recordGroup* columns
+    (the reference reconstructs them by scan+dedup the same way it does the
+    sequence dictionary, AdamContext.scala:175-236)."""
+    from ..models.dictionary import RecordGroup
+    cols = ("recordGroupName", "recordGroupId", "recordGroupSequencingCenter",
+            "recordGroupDescription", "recordGroupRunDateEpoch",
+            "recordGroupFlowOrder", "recordGroupKeySequence",
+            "recordGroupLibrary", "recordGroupPredictedMedianInsertSize",
+            "recordGroupPlatform", "recordGroupPlatformUnit",
+            "recordGroupSample")
+    if not all(c in table.column_names for c in cols):
+        return RecordGroupDictionary()
+    sub = table.select(cols).to_pydict()
+    seen = {}
+    for i in range(table.num_rows):
+        name = sub["recordGroupName"][i]
+        if name is None or name in seen:
+            continue
+        seen[name] = RecordGroup(
+            id=name, index=sub["recordGroupId"][i] or 0,
+            sequencing_center=sub["recordGroupSequencingCenter"][i],
+            description=sub["recordGroupDescription"][i],
+            run_date_epoch=sub["recordGroupRunDateEpoch"][i],
+            flow_order=sub["recordGroupFlowOrder"][i],
+            key_sequence=sub["recordGroupKeySequence"][i],
+            library=sub["recordGroupLibrary"][i],
+            predicted_median_insert_size=sub["recordGroupPredictedMedianInsertSize"][i],
+            platform=sub["recordGroupPlatform"][i],
+            platform_unit=sub["recordGroupPlatformUnit"][i],
+            sample=sub["recordGroupSample"][i])
+    return RecordGroupDictionary(seen.values())
+
+
 def sequence_dictionary_from_reads(table: pa.Table) -> SequenceDictionary:
     """Rebuild the sequence dictionary from denormalized read fields
     (AdamContext.scala:175-236: scan + dedup of

@@ -1,0 +1,136 @@
+"""Vectorized CIGAR geometry: ends, clips, 5' positions, per-base reference
+positions.
+
+Re-designs the lazy per-record walks of ``rich/RichADAMRecord.scala`` as
+batched tensor ops over the packed ``cigar_ops``/``cigar_lens`` columns:
+
+  * ``end``             — RichADAMRecord.end (:77-87): start + ref-consuming lens
+  * ``unclipped_start`` — :99-109: start minus leading S/H clips
+  * ``unclipped_end``   — :89-97: end plus trailing S/H clips
+  * ``five_prime``      — fivePrimePosition (:112-118)
+  * ``reference_positions`` — :156-187: the per-base read-offset ->
+    reference-position map (M/X/=/S advance from unclippedStart, D/P/N skip
+    reference, I yields no position, H ignored)
+
+The per-base map is computed with a cumulative-sum-over-op-runs trick instead
+of the reference's list fold: each base finds its op slot by comparing its
+read offset against the running read-consumption cumsum, then offsets from
+that op's walk position.  Everything is jit/vmap/shard_map compatible; -1 is
+the "no position" sentinel (the reference's None).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from .. import schema as S
+
+# per-op advance tables, indexed by cigar op code (M I D N S H P = X)
+_CONSUMES_READ = np.array(S.CIGAR_CONSUMES_READ, np.int32)
+_CONSUMES_REF = np.array(S.CIGAR_CONSUMES_REF, np.int32)
+# the referencePositions walk: advances for every op except I and H
+# (S counts because the walk starts at unclippedStart; RichADAMRecord:163-178)
+_WALK_ADVANCES = np.array([1, 0, 1, 1, 1, 0, 1, 1, 1], np.int32)
+_IS_CLIP = np.array([0, 0, 0, 0, 1, 1, 0, 0, 0], np.int32)
+
+NO_POSITION = -1
+
+
+def _table(tab: np.ndarray, ops: jnp.ndarray) -> jnp.ndarray:
+    """Gather a per-op-code table over an op tensor; padding (-1) -> 0."""
+    safe = jnp.where(ops < 0, 0, ops)
+    return jnp.where(ops < 0, 0, jnp.asarray(tab)[safe])
+
+
+def reference_lengths(cigar_ops, cigar_lens) -> jnp.ndarray:
+    """[N] bases of reference consumed by each read's alignment."""
+    return jnp.sum(_table(_CONSUMES_REF, cigar_ops) * cigar_lens, axis=-1)
+
+
+def read_end(start, cigar_ops, cigar_lens) -> jnp.ndarray:
+    """[N] exclusive reference end position (RichADAMRecord.end :77-87)."""
+    return start + reference_lengths(cigar_ops, cigar_lens)
+
+
+def _leading_clip(cigar_ops, cigar_lens, soft_only: bool = False) -> jnp.ndarray:
+    """[N] total clipped bases before the first aligned op."""
+    is_clip = _table(_IS_CLIP, cigar_ops)
+    # a clip op counts while every op before it (inclusive) is a clip
+    still_leading = jnp.cumprod(is_clip, axis=-1)
+    if soft_only:
+        still_leading = still_leading * (cigar_ops == S.CIGAR_S)
+    return jnp.sum(still_leading * cigar_lens, axis=-1)
+
+
+def _trailing_clip(cigar_ops, cigar_lens, n_cigar) -> jnp.ndarray:
+    """[N] total clipped bases after the last aligned op."""
+    C = cigar_ops.shape[-1]
+    idx = jnp.arange(C)
+    in_range = idx[None, :] < n_cigar[:, None]
+    is_clip = jnp.where(in_range, _table(_IS_CLIP, cigar_ops), 1)
+    # scan from the right: op counts while everything after it is clip/padding
+    still_trailing = jnp.flip(jnp.cumprod(jnp.flip(is_clip, -1), -1), -1) * in_range
+    return jnp.sum(still_trailing * cigar_lens, axis=-1)
+
+
+def unclipped_start(start, cigar_ops, cigar_lens) -> jnp.ndarray:
+    """[N] start minus leading clips (RichADAMRecord.unclippedStart :99-109)."""
+    return start - _leading_clip(cigar_ops, cigar_lens)
+
+
+def unclipped_end(start, cigar_ops, cigar_lens, n_cigar) -> jnp.ndarray:
+    """[N] end plus trailing clips (RichADAMRecord.unclippedEnd :89-97)."""
+    return read_end(start, cigar_ops, cigar_lens) + \
+        _trailing_clip(cigar_ops, cigar_lens, n_cigar)
+
+
+def five_prime_position(start, flags, cigar_ops, cigar_lens, n_cigar) -> jnp.ndarray:
+    """[N] orientation-aware unclipped 5' position
+    (RichADAMRecord.fivePrimePosition :112-118; the markdup key ingredient,
+    ReferencePositionPair.scala:8-87)."""
+    reverse = (flags & S.FLAG_REVERSE) != 0
+    return jnp.where(reverse,
+                     unclipped_end(start, cigar_ops, cigar_lens, n_cigar),
+                     unclipped_start(start, cigar_ops, cigar_lens))
+
+
+def reference_positions(start, cigar_ops, cigar_lens, max_len: int) -> jnp.ndarray:
+    """[N, L] reference position of every read base, NO_POSITION at
+    insertions/padding (RichADAMRecord.referencePositions :156-187).
+
+    ``max_len`` is the static padded read length (bases.shape[1]).
+    Soft-clipped bases get (out-of-alignment) positions extrapolated before
+    ``start``, like the reference.  One deliberate divergence: the reference
+    starts this walk at unclippedStart, which also subtracts leading *hard*
+    clips but never re-advances past them (RichADAMRecord.scala:158,171-173),
+    so every position in a hard-clipped read shifts left by the H length and
+    disagrees with the read's own MD-tag coordinates.  We subtract leading
+    soft clips only, so the first M base always lands on ``start``.
+    """
+    N, C = cigar_ops.shape
+    L = max_len
+    ops_safe = jnp.where(cigar_ops < 0, 0, cigar_ops)
+    consumes_read = _table(_CONSUMES_READ, cigar_ops) * cigar_lens   # [N, C]
+    walk_adv = _table(_WALK_ADVANCES, cigar_ops) * cigar_lens        # [N, C]
+
+    read_cum = jnp.cumsum(consumes_read, axis=-1)                    # inclusive
+    read_begin = read_cum - consumes_read                            # exclusive
+    walk_cum = jnp.cumsum(walk_adv, axis=-1)
+    walk_start = start - _leading_clip(cigar_ops, cigar_lens, soft_only=True)
+    walk_begin = walk_start[:, None] + (walk_cum - walk_adv)         # [N, C]
+
+    offs = jnp.arange(L, dtype=read_cum.dtype)                       # [L]
+    # op slot owning each read offset: first j with read_cum[j] > off
+    owned = offs[None, :, None] >= read_cum[:, None, :]              # [N, L, C]
+    slot = jnp.sum(owned.astype(jnp.int32), axis=-1)                 # [N, L]
+    slot = jnp.clip(slot, 0, C - 1)
+
+    op_at = jnp.take_along_axis(ops_safe, slot, axis=1)              # [N, L]
+    begin_at = jnp.take_along_axis(read_begin, slot, axis=1)
+    walk_at = jnp.take_along_axis(walk_begin, slot, axis=1)
+    pos = walk_at + (offs[None, :] - begin_at)
+
+    in_read = offs[None, :] < read_cum[:, -1:]
+    is_ins = op_at == S.CIGAR_I
+    return jnp.where(in_read & ~is_ins, pos, NO_POSITION)

@@ -126,6 +126,19 @@ def flagstat_kernel(flags: jnp.ndarray, mapq: jnp.ndarray,
 _flagstat_jit = jax.jit(partial(flagstat_kernel, axis_name=None))
 
 
+def flagstat_sharded(mesh):
+    """jit-compiled flagstat over a device mesh: per-shard masked matmul +
+    psum over ICI (replaces the reference's executor map + driver tree
+    aggregate, FlagStat.scala:102-114)."""
+    from jax.sharding import PartitionSpec as P
+    from ..parallel.mesh import READS_AXIS
+    spec = P(READS_AXIS)
+    fn = jax.shard_map(
+        partial(flagstat_kernel, axis_name=READS_AXIS), mesh=mesh,
+        in_specs=(spec, spec, spec, spec, spec), out_specs=P())
+    return jax.jit(fn)
+
+
 def flagstat(batch: ReadBatch) -> tuple[FlagStatMetrics, FlagStatMetrics]:
     """(QC-failed, QC-passed) metrics — same pair order as the reference's
     ``adamFlagStat`` (FlagStat.scala:85-114)."""

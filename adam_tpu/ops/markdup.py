@@ -1,0 +1,186 @@
+"""Duplicate marking — Picard-compatible 5'-position-pair algorithm.
+
+Re-designs ``rdd/MarkDuplicates.scala:24-110`` + ``models/SingleReadBucket``
++ ``models/ReferencePositionPair`` for the TPU substrate.  The reference runs
+two Spark shuffles (group by (recordGroup, readName), then by (leftPos,
+library)) and per-group Scala loops.  Here:
+
+  * the per-base hot work — orientation-aware unclipped 5' positions
+    (ReferencePositionPair via RichADAMRecord.fivePrimePosition) and the
+    phred>=15 quality sums (MarkDuplicates.score :37-39) — runs on device as
+    batched tensor ops;
+  * the grouping/winner logic runs host-side as vectorized numpy sorts over
+    *encoded integer keys* (no Python loops, no string shuffles): a
+    position-with-orientation packs into one int64 preserving the reference's
+    (refId, pos, strand) comparison order (ReferencePosition.scala:45-55).
+
+Decision semantics (MarkDuplicates.apply :59-109):
+  * bucket reads by (recordGroupId, readName); take the first two
+    primary-mapped reads as the pair (ReferencePositionPair.scala:11-48 —
+    both branches pair iff a second primary exists);
+  * key = sorted (left, right) 5' positions; group by (left, library);
+  * left == None  => all reads in those buckets are non-duplicates;
+  * if the group has pairs: fragment buckets (right == None) are all
+    duplicates; within each right-position subgroup the highest-scoring
+    bucket's primaries survive, every other primary and all secondaries are
+    duplicates;
+  * no pairs in group => fragments are scored the same way;
+  * unmapped reads are never duplicates.
+
+Ties on score break toward the earliest bucket in input order (the reference
+inherits whatever order the shuffle produced — Scala's stable sortBy on a
+nondeterministic grouping; we make it deterministic).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pyarrow as pa
+
+from .. import schema as S
+from ..packing import ReadBatch, dictionary_codes, pack_reads
+from . import cigar as C
+
+_POS_BIAS = np.int64(1) << 31   # unclipped positions can go negative
+
+
+def encode_position_with_orientation(refid, pos, negative_strand):
+    """(refId, pos, strand) -> one int64 key preserving the reference's
+    comparison order (ReferencePositionWithOrientation.compare :47-55);
+    0 is the None sentinel and sorts below every real position."""
+    refid = np.asarray(refid, np.int64)
+    pos = np.asarray(pos, np.int64)
+    strand = np.asarray(negative_strand, np.int64)
+    return ((refid + 1) << 33) | ((pos + _POS_BIAS) << 1) | strand
+
+
+@partial(jax.jit, static_argnames=())
+def _device_fiveprime_and_score(flags, start, cigar_ops, cigar_lens, n_cigar,
+                                quals):
+    fp = C.five_prime_position(start, flags, cigar_ops, cigar_lens, n_cigar)
+    score = jnp.sum(jnp.where(quals >= 15, quals, 0).astype(jnp.int32), axis=-1)
+    return fp, score
+
+
+def _first_two_per_bucket(bucket_id: np.ndarray, rows: np.ndarray,
+                          n_buckets: int):
+    """For rows sorted into buckets, return (first_row, second_row) per
+    bucket (-1 when absent), keeping input order within a bucket."""
+    order = np.argsort(bucket_id[rows], kind="stable")
+    srows = rows[order]
+    sb = bucket_id[rows][order]
+    first = np.full(n_buckets, -1, np.int64)
+    second = np.full(n_buckets, -1, np.int64)
+    is_first = np.ones(len(srows), bool)
+    is_first[1:] = sb[1:] != sb[:-1]
+    first[sb[is_first]] = srows[is_first]
+    is_second = np.zeros(len(srows), bool)
+    is_second[1:] = ~is_first[1:] & is_first[:-1]
+    second[sb[is_second]] = srows[is_second]
+    return first, second
+
+
+def mark_duplicates_flags(table: pa.Table, batch: ReadBatch | None = None
+                          ) -> np.ndarray:
+    """Compute the new packed ``flags`` column with FLAG_DUPLICATE set/cleared
+    per the reference algorithm.  Returns int64 [num_rows]."""
+    n = table.num_rows
+    if batch is None:
+        batch = pack_reads(table)
+
+    fp_dev, score_dev = _device_fiveprime_and_score(
+        jnp.asarray(batch.flags), jnp.asarray(batch.start),
+        jnp.asarray(batch.cigar_ops), jnp.asarray(batch.cigar_lens),
+        jnp.asarray(batch.n_cigar), jnp.asarray(batch.quals))
+    fp = np.asarray(fp_dev)[:n]
+    score = np.asarray(score_dev)[:n]
+
+    flags = np.asarray(batch.flags[:n], np.int64)
+    refid = np.asarray(batch.refid[:n], np.int64)
+    rgid = np.asarray(batch.read_group[:n], np.int64)
+    mapped = (flags & S.FLAG_UNMAPPED) == 0
+    primary = (flags & S.FLAG_SECONDARY) == 0
+    strand = (flags & S.FLAG_REVERSE) != 0
+
+    # ---- bucket by (recordGroupId, readName) (SingleReadBucket.scala:30-37)
+    name_idx = dictionary_codes(table.column("readName"))
+    combined = (rgid + 1) * (name_idx.max(initial=0) + 2) + (name_idx + 1)
+    _, bucket_id = np.unique(combined, return_inverse=True)
+    n_buckets = int(bucket_id.max(initial=-1)) + 1
+
+    # ---- first two primary-mapped reads per bucket = the position pair
+    pm_rows = np.flatnonzero(mapped & primary)
+    r1, r2 = _first_two_per_bucket(bucket_id, pm_rows, n_buckets)
+
+    poskey = encode_position_with_orientation(refid, fp, strand)
+    k1 = np.where(r1 >= 0, poskey[np.maximum(r1, 0)], 0)
+    k2 = np.where(r2 >= 0, poskey[np.maximum(r2, 0)], 0)
+    left = np.where((k2 > 0) & (k2 < k1), k2, k1)
+    right = np.where(k2 > 0, np.where(k2 < k1, k1, k2), 0)
+
+    # ---- library of allReads(0) (MarkDuplicates.scala:62-64): first read by
+    # (primary-mapped, secondary-mapped, unmapped) priority then input order
+    lib_idx = dictionary_codes(table.column("recordGroupLibrary"))
+    priority = np.where(mapped & primary, 0, np.where(mapped, 1, 2))
+    order = np.lexsort((np.arange(n), priority, bucket_id))
+    ob = bucket_id[order]
+    is_first = np.ones(n, bool)
+    is_first[1:] = ob[1:] != ob[:-1]
+    bucket_lib = np.zeros(n_buckets, np.int64)
+    bucket_lib[ob[is_first]] = lib_idx[order[is_first]]
+    bucket_first_row = np.zeros(n_buckets, np.int64)
+    bucket_first_row[ob[is_first]] = order[is_first]
+
+    # ---- bucket score = sum of primary-mapped phred>=15 sums (:41-43)
+    bucket_score = np.bincount(bucket_id[pm_rows],
+                               weights=score[pm_rows].astype(np.float64),
+                               minlength=n_buckets).astype(np.int64)
+
+    # ---- group by (library, left); subgroup by right; pick winners
+    bo = np.lexsort((bucket_first_row, -bucket_score, right, left, bucket_lib))
+    slib, sleft, sright = bucket_lib[bo], left[bo], right[bo]
+    new_group = np.ones(n_buckets, bool)
+    new_group[1:] = (slib[1:] != slib[:-1]) | (sleft[1:] != sleft[:-1])
+    group_id_sorted = np.cumsum(new_group) - 1
+    n_groups = int(group_id_sorted[-1]) + 1 if n_buckets else 0
+    # does the (lib,left) group contain any pair bucket?
+    group_has_pairs = np.zeros(n_groups, bool)
+    np.maximum.at(group_has_pairs, group_id_sorted, sright != 0)
+    new_subgroup = np.ones(n_buckets, bool)
+    new_subgroup[1:] = new_group[1:] | (sright[1:] != sright[:-1])
+    # the first bucket of each subgroup has the best (score, order) — winner
+    winner_sorted = new_subgroup
+    is_winner = np.zeros(n_buckets, bool)
+    is_winner[bo] = winner_sorted
+    bucket_group = np.zeros(n_buckets, np.int64)
+    bucket_group[bo] = group_id_sorted
+
+    # ---- per-read verdicts
+    if n_buckets:
+        bleft = left[bucket_id]
+        bright = right[bucket_id]
+        bpairs = group_has_pairs[bucket_group[bucket_id]]
+        bwin = is_winner[bucket_id]
+    else:
+        bleft = bright = np.zeros(n, np.int64)
+        bpairs = bwin = np.zeros(n, bool)
+    frag_in_pair_group = (bleft != 0) & (bright == 0) & bpairs
+    scored = (bleft != 0) & ((bright != 0) | ~bpairs)
+    dup = mapped & (frag_in_pair_group | (scored & (~primary | ~bwin)))
+
+    return np.where(dup, flags | S.FLAG_DUPLICATE,
+                    flags & ~np.int64(S.FLAG_DUPLICATE))
+
+
+def mark_duplicates(table: pa.Table, batch: ReadBatch | None = None) -> pa.Table:
+    """Return the table with its ``flags`` column rewritten (adamMarkDuplicates
+    analog, AdamRDDFunctions.scala:100-102)."""
+    new_flags = mark_duplicates_flags(table, batch)
+    idx = table.column_names.index("flags")
+    return table.set_column(idx, "flags",
+                            pa.array(new_flags.astype(np.uint32),
+                                     pa.uint32()))

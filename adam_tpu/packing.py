@@ -131,20 +131,36 @@ def _string_column_to_padded(col: pa.ChunkedArray, n_rows: int, pad_to: int,
     return out, lens_full
 
 
+def _nan_to_null(np_col: np.ndarray, null_value: int) -> np.ndarray:
+    """Arrow's to_numpy renders nulls as NaN (float); coerce to a sentinel."""
+    if np_col.dtype.kind == "f":
+        np_col = np.where(np.isnan(np_col), null_value, np_col)
+    return np_col.astype(np.int64)
+
+
+def column_int64(table: pa.Table, name: str, null_value: int = -1) -> np.ndarray:
+    """Integer column -> int64 numpy with nulls as ``null_value``."""
+    return _nan_to_null(
+        table.column(name).to_numpy(zero_copy_only=False), null_value)
+
+
+def dictionary_codes(col: pa.ChunkedArray) -> np.ndarray:
+    """Dictionary-encode a string column -> dense int64 codes, null -> -1."""
+    import pyarrow.compute as pc
+    codes = pc.dictionary_encode(col.combine_chunks())
+    return _nan_to_null(codes.indices.to_numpy(zero_copy_only=False), -1)
+
+
 def _int_column(table: pa.Table, name: str, n_rows: int, null_value=-1) -> np.ndarray:
     if name not in table.column_names:  # projected-out column
         return np.full(n_rows, null_value, np.int32)
-    col = table.column(name)
-    np_col = col.to_numpy(zero_copy_only=False)
-    out = np.full(n_rows, null_value, np.int32)
-    vals = np.where(np.isnan(np_col.astype(np.float64)), null_value, np_col) \
-        if np_col.dtype.kind == "f" else np_col
-    vals = vals.astype(np.int64)
+    vals = column_int64(table, name, null_value)
     if vals.size and (vals.max(initial=0) > np.iinfo(np.int32).max or
                       vals.min(initial=0) < np.iinfo(np.int32).min):
         # device columns are int32; contigs longer than 2^31 bp would need a
         # (refid, offset) split which no current genome requires
         raise OverflowError(f"column {name!r} exceeds int32 range")
+    out = np.full(n_rows, null_value, np.int32)
     out[:len(vals)] = vals.astype(np.int32)
     return out
 

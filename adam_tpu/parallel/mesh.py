@@ -1,0 +1,55 @@
+"""Device mesh + batch sharding utilities.
+
+The reference's parallelism substrate is the Spark RDD: partitions over
+executors, shuffles between them (SURVEY.md §2.4).  Ours is a
+``jax.sharding.Mesh``: a batch of packed reads is sharded along its leading
+(read) axis across devices, kernels run under ``shard_map``, and the
+reference's driver-side aggregates become ``psum`` over ICI.
+
+One mesh axis ("shard") suffices for the read-processing pipelines — they are
+data-parallel with all-reduce aggregation; the genome-coordinate axis is
+handled by the partitioner (parallel/partitioner.py), which assigns genome
+bins to shards host-side, replacing Spark's shuffle.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+READS_AXIS = "shard"
+
+
+def make_mesh(n_devices: Optional[int] = None, devices=None) -> Mesh:
+    """1-D mesh over (up to) all local devices."""
+    if devices is None:
+        devices = jax.devices()
+        if n_devices is not None:
+            devices = devices[:n_devices]
+    return Mesh(np.asarray(devices), (READS_AXIS,))
+
+
+def reads_sharding(mesh: Mesh) -> NamedSharding:
+    """Shard the leading (read) axis; replicate everything else."""
+    return NamedSharding(mesh, P(READS_AXIS))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def shard_batch(batch, mesh: Mesh):
+    """Place a ReadBatch on the mesh, read axis sharded.
+
+    The batch row count must divide evenly by mesh size — pack with
+    ``pad_rows_to=mesh.size`` (padding rows are valid=False).
+    """
+    n = batch.n_reads
+    if n % mesh.size != 0:
+        raise ValueError(
+            f"batch rows {n} not divisible by mesh size {mesh.size}; "
+            f"pack with pad_rows_to={mesh.size}")
+    return batch.device_put(reads_sharding(mesh))

@@ -1,0 +1,224 @@
+"""MD ("mismatching positions") tag machinery — host side.
+
+Faithful re-implementation of ``util/MdTag.scala`` (the load-bearing string
+logic for BQSR masking, pileup emission, reference reconstruction and
+realignment rewrites): the parse FSM (:38-98), ``moveAlignment`` re-derivation
+after a cigar change (:137-233), ``getReference`` reconstruction (:306-372)
+and the ``toString`` FSM (:380-442).
+
+MD strings follow ``[0-9]+(([A-Z]+|\\^[A-Z]+)[0-9]+)*`` where runs of digits
+count matching bases, letters are reference bases at mismatches, and ``^``
+precedes deleted reference bases.  Positions here are absolute 0-based
+reference coordinates, like the reference implementation.
+
+The device-facing view (per-base mismatch masks / reference base codes) lives
+in :mod:`adam_tpu.ops.mdtag_masks`.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Dict, List, Optional, Tuple
+
+_DIGITS = re.compile(r"\d+")
+_BASES = re.compile(r"[AaGgCcTtNnUuKkMmRrSsWwBbVvHhDdXxYy]+")
+
+# cigar text helpers (replaces samtools TextCigarCodec)
+_CIGAR_ELEM = re.compile(r"(\d+)([MIDNSHP=X])")
+
+
+def parse_cigar(cigar: str) -> List[Tuple[int, str]]:
+    """CIGAR text -> [(length, op)] list."""
+    if not cigar or cigar == "*":
+        return []
+    elems = _CIGAR_ELEM.findall(cigar)
+    if "".join(f"{l}{o}" for l, o in elems) != cigar:
+        raise ValueError(f"malformed cigar {cigar!r}")
+    return [(int(l), o) for l, o in elems]
+
+
+def cigar_to_string(elems: List[Tuple[int, str]]) -> str:
+    return "".join(f"{l}{o}" for l, o in elems)
+
+
+_CONSUMES_READ = set("MIS=X")
+_CONSUMES_REF = set("MDN=X")
+
+
+class MdTag:
+    """Parsed MD tag: match ranges + mismatch/delete base maps
+    (MdTag.scala:439-444 class body)."""
+
+    def __init__(self, matches: List[range], mismatches: Dict[int, str],
+                 deletes: Dict[int, str]):
+        self.matches = matches
+        self.mismatches = mismatches
+        self.deletes = deletes
+
+    # -- parse (MdTag.scala:38-98) --------------------------------------
+    @classmethod
+    def parse(cls, md: str, reference_start: int) -> "MdTag":
+        matches: List[range] = []
+        mismatches: Dict[int, str] = {}
+        deletes: Dict[int, str] = {}
+        if md:
+            tag = md.upper()
+            offset = 0
+            ref_pos = reference_start
+
+            def read_matches(err: str) -> None:
+                nonlocal offset, ref_pos
+                m = _DIGITS.match(tag, offset)
+                if not m:
+                    raise ValueError(err + f": {md!r}")
+                length = int(m.group())
+                if length > 0:
+                    matches.append(range(ref_pos, ref_pos + length))
+                offset = m.end()
+                ref_pos += length
+
+            read_matches("MD tag must start with a digit")
+            while offset < len(tag):
+                is_delete = tag[offset] == "^"
+                if is_delete:
+                    offset += 1
+                m = _BASES.match(tag, offset)
+                if not m:
+                    raise ValueError(
+                        "Failed to find deleted or mismatched bases after a "
+                        f"match: {md!r}")
+                for base in m.group():
+                    (deletes if is_delete else mismatches)[ref_pos] = base
+                    ref_pos += 1
+                offset = m.end()
+                read_matches("MD tag should have matching bases after "
+                             "mismatched or missing bases")
+        return cls(matches, mismatches, deletes)
+
+    # -- queries (MdTag.scala:240-296) ----------------------------------
+    def is_match(self, pos: int) -> bool:
+        return any(pos in r for r in self.matches)
+
+    def mismatched_base(self, pos: int) -> Optional[str]:
+        return self.mismatches.get(pos)
+
+    def deleted_base(self, pos: int) -> Optional[str]:
+        return self.deletes.get(pos)
+
+    def has_mismatches(self) -> bool:
+        return bool(self.mismatches)
+
+    def start(self) -> int:
+        starts = [r.start for r in self.matches] + \
+            list(self.mismatches) + list(self.deletes)
+        return min(starts)
+
+    def end(self) -> int:
+        ends = [r.stop - 1 for r in self.matches] + \
+            list(self.mismatches) + list(self.deletes)
+        return max(ends)
+
+    # -- reference reconstruction (MdTag.scala:306-372) ------------------
+    def get_reference(self, read_sequence: str, cigar: str | List[Tuple[int, str]],
+                      reference_from: int) -> str:
+        """Rebuild the reference sequence overlapping this read from the read
+        bases + mismatch/delete records."""
+        elems = parse_cigar(cigar) if isinstance(cigar, str) else cigar
+        ref_pos = self.start()
+        read_pos = 0
+        out: List[str] = []
+        for length, op in elems:
+            if op == "M":
+                for _ in range(length):
+                    out.append(self.mismatches.get(ref_pos) or
+                               read_sequence[read_pos])
+                    read_pos += 1
+                    ref_pos += 1
+            elif op == "D":
+                for _ in range(length):
+                    base = self.deletes.get(ref_pos)
+                    if base is None:
+                        raise ValueError(
+                            f"Could not find deleted base at ref pos {ref_pos}")
+                    out.append(base)
+                    ref_pos += 1
+            else:
+                if op in _CONSUMES_READ:
+                    read_pos += length
+                if op in _CONSUMES_REF:
+                    raise ValueError(f"Cannot handle operator: {op}")
+        return "".join(out)
+
+    # -- re-derivation after realignment (MdTag.scala:137-233) -----------
+    @classmethod
+    def move_alignment(cls, reference: str, sequence: str,
+                       new_cigar: str | List[Tuple[int, str]],
+                       read_start: int) -> "MdTag":
+        """Recompute the MD events of ``sequence`` aligned at ``read_start``
+        against ``reference`` (0-indexed at the alignment) under ``new_cigar``."""
+        elems = parse_cigar(new_cigar) if isinstance(new_cigar, str) else new_cigar
+        ref_pos = 0
+        read_pos = 0
+        matches: List[range] = []
+        mismatches: Dict[int, str] = {}
+        deletes: Dict[int, str] = {}
+        for length, op in elems:
+            if op == "M":
+                range_start = 0
+                in_match = False
+                for _ in range(length):
+                    if reference[ref_pos] == sequence[read_pos]:
+                        if not in_match:
+                            range_start = ref_pos
+                            in_match = True
+                    else:
+                        if in_match:
+                            matches.append(range(range_start + read_start,
+                                                 ref_pos + read_start))
+                            in_match = False
+                        mismatches[ref_pos + read_start] = reference[ref_pos]
+                    read_pos += 1
+                    ref_pos += 1
+                if in_match:
+                    matches.append(range(range_start + read_start,
+                                         ref_pos + read_start))
+            elif op == "D":
+                for _ in range(length):
+                    deletes[ref_pos + read_start] = reference[ref_pos]
+                    ref_pos += 1
+            else:
+                if op in _CONSUMES_READ:
+                    read_pos += length
+                if op in _CONSUMES_REF:
+                    raise ValueError(f"Cannot handle operator: {op}")
+        return cls(matches, mismatches, deletes)
+
+    # -- serialization (MdTag.scala:380-442) -----------------------------
+    def __str__(self) -> str:
+        out: List[str] = []
+        last_was_match = False
+        last_was_deletion = False
+        match_run = 0
+        for i in range(self.start(), self.end() + 1):
+            if self.is_match(i):
+                match_run = match_run + 1 if last_was_match else 1
+                last_was_match = True
+                last_was_deletion = False
+            elif i in self.deletes:
+                if not last_was_deletion:
+                    out.append(str(match_run) if last_was_match else "0")
+                    out.append("^")
+                    last_was_match = False
+                    last_was_deletion = True
+                out.append(self.deletes[i])
+            else:
+                out.append(str(match_run) if last_was_match else "0")
+                out.append(self.mismatches[i])
+                last_was_match = False
+                last_was_deletion = False
+        out.append(str(match_run) if last_was_match else "0")
+        return "".join(out)
+
+    def __eq__(self, other) -> bool:
+        return isinstance(other, MdTag) and str(self) == str(other) and \
+            self.start() == other.start()

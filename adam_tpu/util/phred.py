@@ -1,0 +1,33 @@
+"""Phred <-> probability tables (util/PhredUtils.scala:20-44).
+
+256-entry lookup tables, exposed both as numpy arrays for host code and as
+device constants for kernels (a gather from a [256] table vectorizes the
+reference's per-base calls)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+PHRED_TO_ERROR = 10.0 ** (-np.arange(256) / 10.0)
+PHRED_TO_SUCCESS = 1.0 - PHRED_TO_ERROR
+
+
+def phred_to_error_probability(phred):
+    return PHRED_TO_ERROR[phred]
+
+
+def phred_to_success_probability(phred):
+    return PHRED_TO_SUCCESS[phred]
+
+
+def _probability_to_phred(p) -> int:
+    # truncation (not rounding) matches PhredUtils.scala:33
+    return int(-10.0 * np.log10(p))
+
+
+def success_probability_to_phred(p) -> int:
+    return _probability_to_phred(1.0 - p)
+
+
+def error_probability_to_phred(p) -> int:
+    return _probability_to_phred(p)

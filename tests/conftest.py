@@ -9,7 +9,7 @@ multi-device sharding and collectives without TPU hardware.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"  # force: the session env may point at TPU
 flags = os.environ.get("XLA_FLAGS", "")
 if "host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
@@ -17,7 +17,12 @@ if "host_platform_device_count" not in flags:
 
 import pathlib
 
+import jax
 import pytest
+
+# the env var alone is not enough under the axon TPU plugin, which registers
+# itself regardless; the config update wins
+jax.config.update("jax_platforms", "cpu")
 
 
 RESOURCES = pathlib.Path(__file__).parent / "resources"

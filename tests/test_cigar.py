@@ -1,0 +1,58 @@
+"""CIGAR geometry kernel tests (vs RichADAMRecord semantics :77-187)."""
+
+import numpy as np
+import jax.numpy as jnp
+
+from adam_tpu import schema as S
+from adam_tpu.ops import cigar as C
+from adam_tpu.packing import pack_cigars
+
+
+def geom(cigars, starts, flags=None):
+    n = len(cigars)
+    ops, lens, n_ops = pack_cigars(cigars, n)
+    start = np.asarray(starts, np.int32)
+    flags = np.zeros(n, np.int32) if flags is None else np.asarray(flags)
+    return ops, lens, n_ops, start, flags
+
+
+def test_end_and_clips():
+    ops, lens, n_ops, start, flags = geom(
+        ["10M", "2S8M", "8M2S", "2H3S5M", "5M2D5M", "4M2I4M", "10M3S2H"],
+        [100] * 7)
+    end = np.asarray(C.read_end(start, ops, lens))
+    assert end.tolist() == [110, 108, 108, 105, 112, 108, 110]
+    ustart = np.asarray(C.unclipped_start(start, ops, lens))
+    assert ustart.tolist() == [100, 98, 100, 95, 100, 100, 100]
+    uend = np.asarray(C.unclipped_end(start, ops, lens, n_ops))
+    assert uend.tolist() == [110, 108, 110, 105, 112, 108, 115]
+
+
+def test_five_prime():
+    ops, lens, n_ops, start, flags = geom(
+        ["2S8M", "2S8M"], [100, 100],
+        flags=[0, S.FLAG_REVERSE])
+    fp = np.asarray(C.five_prime_position(start, flags, ops, lens, n_ops))
+    assert fp.tolist() == [98, 108]  # forward: unclipped start; reverse: unclipped end
+
+
+def test_reference_positions_matches_reference_walk():
+    # 2S3M2I3M2D2M: soft clips extrapolate, insertions yield no position,
+    # deletions skip reference (RichADAMRecord.referencePositions :156-187)
+    ops, lens, n_ops, start, _ = geom(["2S3M2I3M2D2M"], [100])
+    pos = np.asarray(C.reference_positions(start, ops, lens, max_len=16))[0]
+    expected = [98, 99,             # soft clip from unclippedStart
+                100, 101, 102,      # 3M
+                -1, -1,             # 2I
+                103, 104, 105,      # 3M
+                # 2D consumes ref only
+                108, 109]           # 2M after deletion
+    assert pos[:12].tolist() == expected
+    assert (pos[12:] == C.NO_POSITION).all()
+
+
+def test_reference_positions_hard_clip_ignored():
+    ops, lens, n_ops, start, _ = geom(["2H3M"], [50])
+    pos = np.asarray(C.reference_positions(start, ops, lens, max_len=8))[0]
+    assert pos[:3].tolist() == [50, 51, 52]
+    assert (pos[3:] == C.NO_POSITION).all()

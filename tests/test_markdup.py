@@ -1,0 +1,129 @@
+"""MarkDuplicates scenario matrix — mirrors MarkDuplicatesSuite.scala:78-159
+(single read / different positions / same position / clipping / reverse
+strand / unmapped / pairs / pairs+fragments)."""
+
+import numpy as np
+import pyarrow as pa
+
+from adam_tpu import schema as S
+from adam_tpu.ops.markdup import mark_duplicates_flags
+
+
+def _table(rows):
+    cols = {name: [] for name in S.READ_SCHEMA.names}
+    for row in rows:
+        for name in S.READ_SCHEMA.names:
+            cols[name].append(row.get(name))
+    return pa.Table.from_pydict(cols, schema=S.READ_SCHEMA)
+
+
+_COUNTER = [0]
+
+
+def mapped_read(refid=0, position=100, name=None, avg_phred=20,
+                clipped=0, primary=True, negative=False):
+    # mirrors createMappedRead (MarkDuplicatesSuite.scala:30-51)
+    _COUNTER[0] += 1
+    name = name or f"auto{_COUNTER[0]}"
+    qual = chr(avg_phred + 33) * 100
+    cigar = f"{clipped}S{100 - clipped}M" if clipped else "100M"
+    flags = (0 if primary else S.FLAG_SECONDARY) | \
+        (S.FLAG_REVERSE if negative else 0)
+    return dict(referenceId=refid, referenceName=f"reference{refid}",
+                start=position, qual=qual, cigar=cigar, readName=name,
+                recordGroupName="machine foo", recordGroupId=0,
+                recordGroupLibrary="library bar", flags=flags,
+                sequence="A" * 100, mapq=50)
+
+
+def unmapped_read():
+    _COUNTER[0] += 1
+    return dict(flags=S.FLAG_UNMAPPED, readName=f"un{_COUNTER[0]}")
+
+
+def pair(refid1, pos1, refid2, pos2, name=None, avg_phred=20):
+    # mirrors createPair (:53-73): R2 on the negative strand
+    _COUNTER[0] += 1
+    name = name or f"pair{_COUNTER[0]}"
+    r1 = mapped_read(refid1, pos1, name=name, avg_phred=avg_phred)
+    r2 = mapped_read(refid2, pos2, name=name, avg_phred=avg_phred,
+                     negative=True)
+    for r, other_ref, other_pos, bit in (
+            (r1, refid2, pos2, S.FLAG_FIRST_OF_PAIR),
+            (r2, refid1, pos1, S.FLAG_SECOND_OF_PAIR)):
+        r["flags"] |= S.FLAG_PAIRED | bit
+        r["mateReferenceId"] = other_ref
+        r["mateAlignmentStart"] = other_pos
+    return [r1, r2]
+
+
+def dups(rows):
+    flags = mark_duplicates_flags(_table(rows))
+    return (flags & S.FLAG_DUPLICATE) != 0
+
+
+def test_single_read():
+    assert dups([mapped_read()]).tolist() == [False]
+
+
+def test_different_positions():
+    assert dups([mapped_read(0, 42), mapped_read(0, 43)]).tolist() == \
+        [False, False]
+
+
+def test_same_position():
+    rows = [mapped_read(1, 42, name="best", avg_phred=30)] + \
+        [mapped_read(1, 42, name=f"poor{i}") for i in range(10)]
+    d = dups(rows)
+    assert d.tolist() == [False] + [True] * 10
+
+
+def test_same_position_with_clipping():
+    # clipped reads at 44 with 2S have unclipped start 42 == the others
+    rows = [mapped_read(1, 42, name="best", avg_phred=30)] + \
+        [mapped_read(1, 44, clipped=2, name=f"poorC{i}") for i in range(5)] + \
+        [mapped_read(1, 42, name=f"poorU{i}") for i in range(5)]
+    d = dups(rows)
+    assert d.tolist() == [False] + [True] * 10
+
+
+def test_reverse_strand():
+    rows = [mapped_read(10, 42, negative=True, name="best", avg_phred=30)] + \
+        [mapped_read(10, 42, negative=True, name=f"poor{i}") for i in range(7)]
+    assert dups(rows).tolist() == [False] + [True] * 7
+
+
+def test_reverse_not_grouped_with_forward():
+    # same position, opposite strands: 5' keys differ => no duplicates
+    rows = [mapped_read(0, 42), mapped_read(0, 42, negative=True)]
+    # note: forward 5' = 42, reverse 5' = 142 (end), so distinct
+    assert dups(rows).tolist() == [False, False]
+
+
+def test_unmapped_never_duplicates():
+    rows = [unmapped_read() for _ in range(10)]
+    assert dups(rows).tolist() == [False] * 10
+
+
+def test_read_pairs():
+    rows = pair(0, 10, 0, 210, name="best", avg_phred=30)
+    for i in range(10):
+        rows += pair(0, 10, 0, 210, name=f"poor{i}")
+    d = dups(rows)
+    assert d.tolist() == [False, False] + [True] * 20
+
+
+def test_read_pairs_with_fragments():
+    # pairs beat fragments regardless of score (MarkDuplicatesSuite:143-153)
+    rows = [mapped_read(2, 33, avg_phred=40, name=f"fragment{i}")
+            for i in range(10)]
+    rows += pair(2, 33, 2, 200, avg_phred=20, name="pair")
+    d = dups(rows)
+    assert d.tolist() == [True] * 10 + [False, False]
+
+
+def test_secondary_alignments_always_duplicates_in_scored_groups():
+    rows = [mapped_read(0, 42, name="best", avg_phred=30),
+            mapped_read(0, 42, name="best", primary=False)]
+    d = dups(rows)
+    assert d.tolist() == [False, True]

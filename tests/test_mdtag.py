@@ -1,0 +1,84 @@
+"""MdTag tests — scenario coverage mirrors MdTagSuite.scala (parse cases,
+reference reconstruction, moveAlignment rewrites, toString round-trip)."""
+
+import pytest
+
+from adam_tpu.util.mdtag import MdTag, cigar_to_string, parse_cigar
+
+
+def test_parse_all_match():
+    tag = MdTag.parse("60", 0)
+    for i in range(60):
+        assert tag.is_match(i)
+    assert not tag.is_match(60)
+    assert not tag.has_mismatches()
+
+
+def test_parse_mismatch():
+    tag = MdTag.parse("10A20", 0)
+    assert tag.is_match(5)
+    assert not tag.is_match(10)
+    assert tag.mismatched_base(10) == "A"
+    assert tag.is_match(15)
+    assert tag.has_mismatches()
+
+
+def test_parse_deletion():
+    tag = MdTag.parse("10^AC20", 100)
+    assert tag.is_match(105)
+    assert tag.deleted_base(110) == "A"
+    assert tag.deleted_base(111) == "C"
+    assert tag.is_match(112)
+    assert tag.start() == 100
+    assert tag.end() == 131
+
+
+def test_parse_start_offset():
+    tag = MdTag.parse("5C5", 10)
+    assert tag.mismatched_base(15) == "C"
+    assert tag.is_match(10) and tag.is_match(19)
+
+
+def test_parse_invalid():
+    with pytest.raises(ValueError):
+        MdTag.parse("A10", 0)
+
+
+def test_tostring_roundtrip():
+    for md in ["60", "10A20", "10^AC20", "0A10", "5C0", "10A5^GG4T1"]:
+        assert str(MdTag.parse(md, 0)) == md
+
+
+def test_get_reference():
+    # read ACGTACGT aligned 8M with mismatch at offset 2 (ref base G->T read)
+    tag = MdTag.parse("2G5", 0)
+    ref = tag.get_reference("ACTTACGT", "8M", 0)
+    assert ref == "ACGTACGT"[:2] + "G" + "TACGT"
+
+
+def test_get_reference_with_deletion():
+    tag = MdTag.parse("2^CC2", 0)
+    ref = tag.get_reference("ACGT", "2M2D2M", 0)
+    assert ref == "ACCCGT"
+
+
+def test_move_alignment():
+    # same alignment recomputed => same tag
+    ref = "ACGTACGT"
+    seq = "ACGTACGT"
+    tag = MdTag.move_alignment(ref, seq, "8M", 100)
+    assert str(tag) == "8"
+    # introduce mismatch
+    tag2 = MdTag.move_alignment(ref, "ACCTACGT", "8M", 100)
+    assert str(tag2) == "2G5"
+    assert tag2.mismatched_base(102) == "G"
+    # deletion cigar
+    tag3 = MdTag.move_alignment("ACGTACGT", "ACACGT", "2M2D4M", 0)
+    assert str(tag3) == "2^GT4"
+
+
+def test_parse_cigar_roundtrip():
+    for c in ["75M", "2S8M", "4M2I4M2D10M", "10M3S2H"]:
+        assert cigar_to_string(parse_cigar(c)) == c
+    with pytest.raises(ValueError):
+        parse_cigar("10Q")
