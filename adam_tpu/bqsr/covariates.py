@@ -1,0 +1,104 @@
+"""BQSR covariates as batched device tensors.
+
+Re-designs ``rdd/recalibration/StandardCovariate.scala`` +
+``ReadCovariates.scala``: instead of per-read iterators allocating Int arrays,
+every covariate is an [N, L] tensor computed in one jitted kernel.
+
+Covariates (all exactly as the reference computes them):
+  * qualByRG (StandardCovariate.scala:25-32): qual + 60 * recordGroupId;
+  * DiscreteCycle (:39-48): forward 1..len, reverse len..1, negated for
+    second-of-pair;
+  * BaseContext size 2 (:50-104): code 0 for the first in-window base or any
+    window containing a non-ACGT base, else 1 + 4*prev + cur.  For reverse
+    strand reads the reference takes a slice of the reverse-complemented
+    sequence whose element order is *mirrored* relative to the per-base
+    iteration (:75-79 with ReadCovariates.scala:50-60) — we reproduce that
+    pairing bit-for-bit, since apply-time lookups use the same pairing.
+
+The low-quality end clip (ReadCovariates.scala:37-39: leading/trailing run of
+quals <= 2 excluded) becomes the ``in_window`` mask.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from .. import schema as S
+
+MAX_REASONABLE_QSCORE = 60     # RecalUtil.Constants (RecalUtil.scala:26)
+MIN_REASONABLE_ERROR = 10.0 ** (-MAX_REASONABLE_QSCORE / 10.0)
+MIN_QUALITY = 2                # ReadCovariates.scala:31
+CONTEXT_SIZE = 2
+N_CONTEXT = 4 ** CONTEXT_SIZE + 1   # 0 reserved for "no context"
+
+
+def clip_window(quals, read_len):
+    """(start, end) [N] of the window after trimming leading/trailing runs of
+    quals <= MIN_QUALITY (ReadCovariates.scala:37-39)."""
+    L = quals.shape[1]
+    offs = jnp.arange(L)
+    in_read = offs[None, :] < read_len[:, None]
+    lowq = (quals <= MIN_QUALITY) & in_read
+    # leading run: count while cumprod of lowq stays 1
+    start = jnp.sum(jnp.cumprod(lowq.astype(jnp.int32), axis=1), axis=1)
+    # trailing run within the read: reverse scan over in-read positions
+    lowq_or_pad = lowq | ~in_read
+    trail = jnp.cumprod(jnp.flip(lowq_or_pad.astype(jnp.int32), 1), axis=1)
+    trailing = jnp.sum(trail, axis=1) - (L - read_len)
+    end = read_len - trailing
+    return start, jnp.maximum(end, start)
+
+
+@partial(jax.jit, static_argnames=())
+def covariate_tensors(bases, quals, read_len, flags, read_group):
+    """All per-base covariate tensors.
+
+    Returns dict of [N, L] tensors: in_window (bool), qual_rg, cycle_idx
+    (cycle + L, so always >= 0), context (0..16).
+    """
+    N, L = bases.shape
+    offs = jnp.arange(L)
+    start, end = clip_window(quals, read_len)
+    in_window = (offs[None, :] >= start[:, None]) & \
+        (offs[None, :] < end[:, None])
+
+    qual_rg = quals.astype(jnp.int32) + \
+        MAX_REASONABLE_QSCORE * jnp.maximum(read_group, 0)[:, None]
+
+    reverse = (flags & S.FLAG_REVERSE) != 0
+    second = ((flags & S.FLAG_PAIRED) != 0) & \
+        ((flags & S.FLAG_SECOND_OF_PAIR) != 0)
+    cycle = jnp.where(reverse[:, None], read_len[:, None] - offs[None, :],
+                      offs[None, :] + 1)
+    cycle = jnp.where(second[:, None], -cycle, cycle)
+    cycle_idx = cycle + L
+
+    b = bases.astype(jnp.int32)
+    valid = (b >= 0) & (b < 4)
+    compl = jnp.where(valid, 3 - b, b)
+
+    def enc(prev_b, cur_b, prev_ok, cur_ok):
+        ok = prev_ok & cur_ok
+        return jnp.where(ok, 1 + 4 * prev_b + cur_b, 0)
+
+    # forward: context of base i = window (i-1, i)
+    prev_idx = jnp.maximum(offs - 1, 0)
+    fwd = enc(b[:, prev_idx], b, valid[:, prev_idx] & (offs > 0)[None, :],
+              valid)
+    # reverse (mirrored pairing, see module docstring): element i pairs with
+    # p = end-1-(i-start); context = enc(compl(b[p+1]), compl(b[p]))
+    p = end[:, None] - 1 - (offs[None, :] - start[:, None])
+    p_safe = jnp.clip(p, 0, L - 1)
+    p1_safe = jnp.clip(p + 1, 0, L - 1)
+    take = jnp.take_along_axis
+    rev = enc(take(compl, p1_safe, 1), take(compl, p_safe, 1),
+              take(valid, p1_safe, 1) & (p + 1 < end[:, None]),
+              take(valid, p_safe, 1) & (p >= 0))
+    context = jnp.where(reverse[:, None], rev, fwd)
+    # the first in-window base never has a context
+    context = jnp.where(offs[None, :] == start[:, None], 0, context)
+    return dict(in_window=in_window, qual_rg=qual_rg, cycle_idx=cycle_idx,
+                context=context, window_start=start, window_end=end)

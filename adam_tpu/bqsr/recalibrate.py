@@ -1,0 +1,241 @@
+"""BQSR driver: two passes over the reads, both device-resident.
+
+Re-designs ``rdd/RecalibrateBaseQualities.scala``:
+
+  pass 1 (computeTable :52-64): per-base covariates + mismatch/mask state ->
+    scatter-add into the dense count tensors; across shards the tables merge
+    with psum (the reference tree-reduces JVM hash maps to the driver);
+  pass 2 (applyTable :66-76): per-base gathers from the finalized delta
+    tables rewrite the quality scores.
+
+Usable-read filter (:29-32): mapped, primary, not duplicate, has MD.
+Recalibrated reads (:69-74): mapped, primary, not duplicate (MD not
+required at apply time — unknown bases are masked, not skipped).
+
+One deliberate divergence: RecalUtil.recalibrate (:31-42) rebuilds the qual
+string from only the clip-window bases, silently *truncating* the quals of
+reads with low-quality ends; we keep the original qual for bases outside the
+window (what GATK does).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pyarrow as pa
+
+from .. import schema as S
+from ..models.snptable import SnpTable
+from ..ops import cigar as C
+from ..packing import ReadBatch, pack_reads
+from ..util.mdtag import MdTag
+from ..util.phred import PHRED_TO_ERROR
+from .covariates import MAX_REASONABLE_QSCORE, covariate_tensors
+from .table import FinalizedTable, RecalTable
+
+# mismatch state codes (host -> device)
+STATE_MATCH = 0
+STATE_MISMATCH = 1
+STATE_MASKED = 2
+
+
+def usable_read_mask(flags: np.ndarray, has_md: np.ndarray) -> np.ndarray:
+    """RecalibrateBaseQualities.usableRead (:29-32)."""
+    return ((flags & S.FLAG_UNMAPPED) == 0) & \
+        ((flags & S.FLAG_SECONDARY) == 0) & \
+        ((flags & S.FLAG_DUPLICATE) == 0) & has_md
+
+
+def mismatch_state(table: pa.Table, batch: ReadBatch,
+                   snp_table: Optional[SnpTable] = None) -> np.ndarray:
+    """[N, L] int8 per-base state for pass 1.
+
+    Mirrors ReadCovariates.next (:49-60): a base is MASKED when its reference
+    position is undefined (insertion/soft-clip/outside the alignment), the
+    read has no MD tag, or dbSNP masks the position; else MATCH/MISMATCH by
+    the MD tag (RichADAMRecord.isMismatchAtReadOffset :138-154).
+    """
+    n = table.num_rows
+    L = batch.max_len
+    pos = np.asarray(C.reference_positions(
+        jnp.asarray(batch.start), jnp.asarray(batch.cigar_ops),
+        jnp.asarray(batch.cigar_lens), L))[:n]
+    end = np.asarray(C.read_end(
+        jnp.asarray(batch.start), jnp.asarray(batch.cigar_ops),
+        jnp.asarray(batch.cigar_lens)))[:n]
+    start = np.asarray(batch.start[:n], np.int64)
+
+    mds = table.column("mismatchingPositions").to_pylist()
+    state = np.full((n, L), STATE_MASKED, np.int8)
+    in_align = (pos >= 0) & (pos >= start[:, None]) & (pos < end[:, None])
+
+    # MD mismatch lookup (shared encoding with the pileup engine)
+    from ..ops.pileup import _lookup, _md_lookup_arrays
+    usable_rows = np.flatnonzero([m is not None for m in mds])
+    mm_keys, mm_bases, _, _ = _md_lookup_arrays(mds, start, usable_rows)
+    has_md = np.array([m is not None for m in mds])
+
+    rows = np.arange(n)[:, None].repeat(L, 1)
+    keys = (rows.astype(np.int64) << 34) | np.maximum(pos, 0)
+    _, is_mm = _lookup(keys.ravel(), mm_keys, mm_bases)
+    is_mm = is_mm.reshape(n, L)
+
+    defined = in_align & has_md[:, None]
+    state[defined & ~is_mm] = STATE_MATCH
+    state[defined & is_mm] = STATE_MISMATCH
+
+    if snp_table is not None and len(snp_table):
+        names = table.column("referenceName").to_pylist()
+        for contig in snp_table.contigs():
+            crows = np.flatnonzero([nm == contig for nm in names])
+            if len(crows) == 0:
+                continue
+            hit = snp_table.mask(contig, np.maximum(pos[crows], 0)) & \
+                (pos[crows] >= 0)
+            sub = state[crows]
+            sub[hit] = STATE_MASKED
+            state[crows] = sub
+    return state
+
+
+@partial(jax.jit, static_argnames=("n_qual_rg", "n_cycle", "axis_name"))
+def _count_kernel(bases, quals, read_len, flags, read_group, state, usable,
+                  n_qual_rg: int, n_cycle: int, axis_name=None):
+    """Pass-1 scatter-add into the dense count tensors."""
+    cov = covariate_tensors(bases, quals, read_len, flags, read_group)
+    counted = cov["in_window"] & usable[:, None] & (state != STATE_MASKED)
+    mm = (state == STATE_MISMATCH) & counted
+    k = jnp.clip(cov["qual_rg"], 0, n_qual_rg - 1)
+    cyc = jnp.clip(cov["cycle_idx"], 0, n_cycle - 1)
+    ctx = cov["context"]
+
+    w = counted.astype(jnp.int32)
+    wm = mm.astype(jnp.int32)
+    qual_obs = jnp.zeros((n_qual_rg,), jnp.int32).at[k].add(w)
+    qual_mm = jnp.zeros((n_qual_rg,), jnp.int32).at[k].add(wm)
+    cyc_flat = k * n_cycle + cyc
+    cycle_obs = jnp.zeros((n_qual_rg * n_cycle,), jnp.int32).at[cyc_flat].add(w)
+    cycle_mm = jnp.zeros((n_qual_rg * n_cycle,), jnp.int32).at[cyc_flat].add(wm)
+    from .covariates import N_CONTEXT
+    ctx_flat = k * N_CONTEXT + ctx
+    ctx_obs = jnp.zeros((n_qual_rg * N_CONTEXT,), jnp.int32).at[ctx_flat].add(w)
+    ctx_mm = jnp.zeros((n_qual_rg * N_CONTEXT,), jnp.int32).at[ctx_flat].add(wm)
+
+    # expectedMismatch sums reported error over every window base of a usable
+    # read, masked or not (RecalTable.+= :62)
+    err_lut = jnp.asarray(PHRED_TO_ERROR)
+    windowed = cov["in_window"] & usable[:, None]
+    expected = jnp.sum(jnp.where(
+        windowed, err_lut[jnp.clip(quals.astype(jnp.int32), 0, 255)], 0.0))
+
+    out = (qual_obs, qual_mm, cycle_obs, cycle_mm, ctx_obs, ctx_mm, expected)
+    if axis_name is not None:
+        out = tuple(jax.lax.psum(o, axis_name) for o in out)
+    return out
+
+
+def compute_table(table: pa.Table, batch: Optional[ReadBatch] = None,
+                  snp_table: Optional[SnpTable] = None,
+                  n_read_groups: Optional[int] = None) -> RecalTable:
+    """Pass 1: build the RecalTable from usable reads."""
+    n = table.num_rows
+    if batch is None:
+        batch = pack_reads(table)
+    has_md = np.zeros(batch.n_reads, bool)
+    has_md[:n] = [m is not None
+                  for m in table.column("mismatchingPositions").to_pylist()]
+    flags_np = np.asarray(batch.flags)
+    usable = usable_read_mask(flags_np, has_md) & np.asarray(batch.valid)
+
+    state = np.full((batch.n_reads, batch.max_len), STATE_MASKED, np.int8)
+    state[:n] = mismatch_state(table, batch, snp_table)
+
+    if n_read_groups is None:
+        n_read_groups = int(np.asarray(batch.read_group).max(initial=0)) + 1
+    rt = RecalTable(n_read_groups=max(n_read_groups, 1),
+                    max_read_len=batch.max_len)
+    out = _count_kernel(
+        jnp.asarray(batch.bases), jnp.asarray(batch.quals),
+        jnp.asarray(batch.read_len), jnp.asarray(batch.flags),
+        jnp.asarray(batch.read_group), jnp.asarray(state),
+        jnp.asarray(usable), n_qual_rg=rt.n_qual_rg, n_cycle=rt.n_cycle)
+    (qual_obs, qual_mm, cycle_obs, cycle_mm, ctx_obs, ctx_mm, expected) = \
+        [np.asarray(o) for o in out]
+    rt.qual_obs += qual_obs.astype(np.int64)
+    rt.qual_mm += qual_mm.astype(np.int64)
+    rt.cycle_obs += cycle_obs.reshape(rt.n_qual_rg, rt.n_cycle).astype(np.int64)
+    rt.cycle_mm += cycle_mm.reshape(rt.n_qual_rg, rt.n_cycle).astype(np.int64)
+    rt.ctx_obs += ctx_obs.reshape(rt.n_qual_rg, -1).astype(np.int64)
+    rt.ctx_mm += ctx_mm.reshape(rt.n_qual_rg, -1).astype(np.int64)
+    rt.expected_mismatch += float(expected)
+    return rt
+
+
+@partial(jax.jit, static_argnames=())
+def _apply_kernel(bases, quals, read_len, flags, read_group, recal_mask,
+                  rg_delta, qual_delta, cycle_delta, ctx_delta, rg_of_qualrg):
+    """Pass-2: per-base gathers from the delta tables -> new quals."""
+    cov = covariate_tensors(bases, quals, read_len, flags, read_group)
+    Q = qual_delta.shape[0]
+    k = jnp.clip(cov["qual_rg"], 0, Q - 1)
+    cyc = jnp.clip(cov["cycle_idx"], 0, cycle_delta.shape[1] - 1)
+    ctx = cov["context"]
+    err_lut = jnp.asarray(PHRED_TO_ERROR)
+    reported = err_lut[jnp.clip(quals.astype(jnp.int32), 0, 255)]
+    # flat gathers keep the lookup O(N*L) instead of materializing [N,L,NC]
+    n_cycle = cycle_delta.shape[1]
+    n_ctx = ctx_delta.shape[1]
+    p = reported + rg_delta[rg_of_qualrg[k]] + qual_delta[k] + \
+        cycle_delta.reshape(-1)[k * n_cycle + cyc] + \
+        ctx_delta.reshape(-1)[k * n_ctx + ctx]
+    from .covariates import MIN_REASONABLE_ERROR
+    p = jnp.clip(p, MIN_REASONABLE_ERROR, 1.0)
+    new_q = jnp.trunc(-10.0 * jnp.log10(p)).astype(jnp.int8)
+    recal = cov["in_window"] & recal_mask[:, None]
+    return jnp.where(recal, new_q, quals)
+
+
+def apply_table(rt: RecalTable, table: pa.Table,
+                batch: Optional[ReadBatch] = None) -> pa.Table:
+    """Pass 2: rewrite the qual strings of recalibratable reads."""
+    n = table.num_rows
+    if batch is None:
+        batch = pack_reads(table)
+    fin = rt.finalize()
+    flags_np = np.asarray(batch.flags)
+    recal_mask = ((flags_np & S.FLAG_UNMAPPED) == 0) & \
+        ((flags_np & S.FLAG_SECONDARY) == 0) & \
+        ((flags_np & S.FLAG_DUPLICATE) == 0) & np.asarray(batch.valid)
+
+    new_quals = np.asarray(_apply_kernel(
+        jnp.asarray(batch.bases), jnp.asarray(batch.quals),
+        jnp.asarray(batch.read_len), jnp.asarray(batch.flags),
+        jnp.asarray(batch.read_group), jnp.asarray(recal_mask),
+        jnp.asarray(fin.rg_delta), jnp.asarray(fin.qual_delta),
+        jnp.asarray(fin.cycle_delta), jnp.asarray(fin.ctx_delta),
+        jnp.asarray(fin.rg_of_qualrg)))[:n]
+
+    read_len = np.asarray(batch.read_len[:n])
+    quals_out = []
+    old = table.column("qual").to_pylist()
+    for i in range(n):
+        if not recal_mask[i] or old[i] is None:
+            quals_out.append(old[i])
+        else:
+            q = new_quals[i, :read_len[i]] + 33
+            quals_out.append(bytes(q.astype(np.uint8)).decode("ascii"))
+    idx = table.column_names.index("qual")
+    return table.set_column(idx, "qual", pa.array(quals_out, pa.string()))
+
+
+def recalibrate_base_qualities(table: pa.Table,
+                               snp_table: Optional[SnpTable] = None
+                               ) -> pa.Table:
+    """adamBQSR (AdamRDDFunctions.scala:104-107): compute + apply."""
+    batch = pack_reads(table)
+    rt = compute_table(table, batch, snp_table)
+    return apply_table(rt, table, batch)

@@ -1,0 +1,135 @@
+"""The recalibration count table as dense tensors.
+
+Re-designs ``rdd/recalibration/RecalTable.scala`` (nested mutable hash maps of
+ErrorCount, merged pairwise on the driver :23-215) as dense int64 count
+tensors indexed by the qualByRG stratification and covariate values:
+
+    qual_obs/qual_mm   [Q]            Q = MAX_REASONABLE_QSCORE * nRG + 94
+    cycle_obs/cycle_mm [Q, 2L+1]      cycle c -> index c + L
+    ctx_obs/ctx_mm     [Q, 17]
+
+Counts accumulate on device via scatter-add and merge across shards with a
+single ``psum`` — the reference's ``aggregate(RecalTable)(+, ++)`` tree
+reduce to the driver (RecalibrateBaseQualities.scala:52-64) becomes one
+collective over ICI.
+
+Finalization and the delta hierarchy (readgroup -> qual -> covariates) follow
+RecalTable.finalizeTable/getErrorRateShifts (:118-152) exactly, including the
+``(qualByRG - 1) / MAX_REASONABLE_QSCORE`` truncating-division read-group
+regrouping (:121,129 — a quirk for qual-0 bases of non-zero read groups that
+we reproduce for parity).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+import numpy as np
+
+from ..util.phred import PHRED_TO_ERROR
+from .covariates import (MAX_REASONABLE_QSCORE, MIN_REASONABLE_ERROR,
+                         N_CONTEXT)
+
+
+def _error_prob(mm: np.ndarray, obs: np.ndarray, fallback: np.ndarray):
+    """ErrorCount.getErrorProb (RecalTable.scala:199-203): max(1e-6, mm/obs)
+    when observed, else the caller's fallback."""
+    safe = np.maximum(obs, 1)
+    p = np.maximum(MIN_REASONABLE_ERROR, mm / safe)
+    return np.where(obs > 0, p, fallback)
+
+
+def _rg_of_qualrg(k: np.ndarray) -> np.ndarray:
+    """(k - 1) / 60 with Scala's truncate-toward-zero division."""
+    return np.where(k >= 1, (k - 1) // MAX_REASONABLE_QSCORE, 0)
+
+
+@dataclass
+class RecalTable:
+    """Dense recalibration counts + finalized delta tables."""
+    n_read_groups: int
+    max_read_len: int
+    qual_obs: np.ndarray = field(default=None)
+    qual_mm: np.ndarray = field(default=None)
+    cycle_obs: np.ndarray = field(default=None)
+    cycle_mm: np.ndarray = field(default=None)
+    ctx_obs: np.ndarray = field(default=None)
+    ctx_mm: np.ndarray = field(default=None)
+    expected_mismatch: float = 0.0
+
+    def __post_init__(self):
+        Q = self.n_qual_rg
+        NC = self.n_cycle
+        for name, shape in (("qual_obs", (Q,)), ("qual_mm", (Q,)),
+                            ("cycle_obs", (Q, NC)), ("cycle_mm", (Q, NC)),
+                            ("ctx_obs", (Q, N_CONTEXT)),
+                            ("ctx_mm", (Q, N_CONTEXT))):
+            if getattr(self, name) is None:
+                setattr(self, name, np.zeros(shape, np.int64))
+
+    @property
+    def n_qual_rg(self) -> int:
+        # + 94 headroom for quals beyond MAX_REASONABLE_QSCORE
+        return MAX_REASONABLE_QSCORE * max(self.n_read_groups, 1) + 94
+
+    @property
+    def n_cycle(self) -> int:
+        return 2 * self.max_read_len + 1
+
+    # -- merge (RecalTable.++ :96-113) -----------------------------------
+    def __add__(self, other: "RecalTable") -> "RecalTable":
+        assert self.n_qual_rg == other.n_qual_rg and \
+            self.n_cycle == other.n_cycle
+        return RecalTable(
+            self.n_read_groups, self.max_read_len,
+            self.qual_obs + other.qual_obs, self.qual_mm + other.qual_mm,
+            self.cycle_obs + other.cycle_obs, self.cycle_mm + other.cycle_mm,
+            self.ctx_obs + other.ctx_obs, self.ctx_mm + other.ctx_mm,
+            self.expected_mismatch + other.expected_mismatch)
+
+    # -- finalize (RecalTable.finalizeTable :118-126) --------------------
+    def finalize(self) -> "FinalizedTable":
+        Q = self.n_qual_rg
+        ks = np.arange(Q)
+        rg_of_k = _rg_of_qualrg(ks)
+        n_rg_groups = int(rg_of_k.max()) + 1
+        rg_obs = np.bincount(rg_of_k, weights=self.qual_obs,
+                             minlength=n_rg_groups)
+        rg_mm = np.bincount(rg_of_k, weights=self.qual_mm,
+                            minlength=n_rg_groups)
+        total_obs = max(float(self.qual_obs.sum()), 1.0)
+        avg_reported = self.expected_mismatch / total_obs
+
+        # readgroup deltas (:128-131)
+        rg_err = _error_prob(rg_mm, rg_obs, np.full(n_rg_groups, avg_reported))
+        rg_delta = rg_err - avg_reported
+
+        # qual deltas (:133-139): fallback/baseline = reported + rgDelta
+        reported = PHRED_TO_ERROR[np.minimum(ks % MAX_REASONABLE_QSCORE, 255)]
+        adj1 = reported + rg_delta[rg_of_k]
+        qual_err = _error_prob(self.qual_mm, self.qual_obs, adj1)
+        qual_delta = qual_err - adj1
+
+        # covariate deltas (:141-146): baseline = reported + rgD + qualD
+        adj2 = (reported + rg_delta[rg_of_k] + qual_delta)[:, None]
+        cyc_err = _error_prob(self.cycle_mm, self.cycle_obs,
+                              np.broadcast_to(adj2, self.cycle_obs.shape))
+        ctx_err = _error_prob(self.ctx_mm, self.ctx_obs,
+                              np.broadcast_to(adj2, self.ctx_obs.shape))
+        return FinalizedTable(
+            rg_delta=rg_delta.astype(np.float64),
+            qual_delta=qual_delta.astype(np.float64),
+            cycle_delta=(cyc_err - adj2).astype(np.float64),
+            ctx_delta=(ctx_err - adj2).astype(np.float64),
+            rg_of_qualrg=rg_of_k, avg_reported_error=avg_reported)
+
+
+@dataclass
+class FinalizedTable:
+    rg_delta: np.ndarray        # [nRGgroups]
+    qual_delta: np.ndarray      # [Q]
+    cycle_delta: np.ndarray     # [Q, 2L+1]
+    ctx_delta: np.ndarray       # [Q, 17]
+    rg_of_qualrg: np.ndarray    # [Q]
+    avg_reported_error: float

@@ -72,6 +72,9 @@ class TransformCommand(Command):
         p.add_argument("output", help="output Parquet dataset directory "
                                       "(or .sam path)")
         p.add_argument("-mark_duplicate_reads", action="store_true")
+        p.add_argument("-recalibrate_base_qualities", action="store_true")
+        p.add_argument("-dbsnp_sites", default=None,
+                       help="sites-only VCF masking known SNPs during BQSR")
         p.add_argument("-sort_reads", action="store_true")
         p.add_argument("-parts", type=int, default=1)
 
@@ -83,6 +86,12 @@ class TransformCommand(Command):
         if args.mark_duplicate_reads:
             from ..ops.markdup import mark_duplicates
             table = mark_duplicates(table)
+        if args.recalibrate_base_qualities:
+            from ..bqsr.recalibrate import recalibrate_base_qualities
+            from ..models.snptable import SnpTable
+            snp = SnpTable.from_vcf(args.dbsnp_sites) if args.dbsnp_sites \
+                else None
+            table = recalibrate_base_qualities(table, snp)
         if args.sort_reads:
             from ..ops.sort import sort_reads
             table = sort_reads(table)
@@ -97,6 +106,59 @@ class TransformCommand(Command):
         else:
             save_table(table, args.output, n_parts=args.parts)
         print(f"wrote {table.num_rows} reads to {args.output}")
+        return 0
+
+
+@register
+class Reads2RefCommand(Command):
+    name = "reads2ref"
+    help = "Convert reads to pileups (cli/Reads2Ref.scala:39-75)"
+
+    def add_args(self, p: argparse.ArgumentParser) -> None:
+        p.add_argument("input", help="SAM/BAM file or ADAM Parquet dataset")
+        p.add_argument("output", help="output pileup Parquet dataset")
+        p.add_argument("-aggregate", action="store_true")
+        p.add_argument("-allow_non_primary", action="store_true",
+                       help="skip the locus predicate filter")
+        p.add_argument("-parts", type=int, default=1)
+
+    def run(self, args) -> int:
+        from ..io.dispatch import load_reads
+        from ..io.parquet import locus_predicate, save_table
+        from ..ops.pileup import aggregate_pileups, reads_to_pileups
+
+        filters = None if args.allow_non_primary else locus_predicate()
+        table, _, _ = load_reads(args.input, filters=filters)
+        pileups = reads_to_pileups(table)
+        if args.aggregate:
+            pileups = aggregate_pileups(pileups)
+        save_table(pileups, args.output, n_parts=args.parts)
+        n_reads = max(table.num_rows, 1)
+        print(f"wrote {pileups.num_rows} pileups from {table.num_rows} reads "
+              f"(coverage ~{pileups.num_rows / n_reads:.1f}x read length)")
+        return 0
+
+
+@register
+class AggregatePileupsCommand(Command):
+    name = "aggregate_pileups"
+    help = "Aggregate a pileup dataset by position/base/sample"
+
+    def add_args(self, p: argparse.ArgumentParser) -> None:
+        p.add_argument("input", help="pileup Parquet dataset")
+        p.add_argument("output", help="output pileup Parquet dataset")
+        p.add_argument("-parts", type=int, default=1)
+
+    def run(self, args) -> int:
+        from ..io.parquet import load_table, save_table
+        from ..ops.pileup import aggregate_pileups
+
+        pileups = load_table(args.input)
+        # external data: fail loudly on null required fields (the reference
+        # NPEs in combineEvidence; we raise up front)
+        agg = aggregate_pileups(pileups, validate=True)
+        save_table(agg, args.output, n_parts=args.parts)
+        print(f"aggregated {pileups.num_rows} -> {agg.num_rows} pileups")
         return 0
 
 

@@ -1,0 +1,56 @@
+"""dbSNP site mask table (models/SnpTable.scala:12-63).
+
+The reference keeps contig -> Set[position] hash sets, broadcast to executors,
+probed per base.  Here each contig's positions are a sorted int64 array and
+masking a whole [N, L] tile of base positions is one vectorized searchsorted —
+the form a TPU/host split wants (the table stays host-side; the resulting
+mask ships to the device with the batch).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable
+
+import numpy as np
+
+
+class SnpTable:
+    def __init__(self, table: Dict[str, np.ndarray] | None = None):
+        self._by_contig: Dict[str, np.ndarray] = {
+            k: np.unique(np.asarray(v, np.int64))
+            for k, v in (table or {}).items()}
+
+    @classmethod
+    def from_vcf_lines(cls, lines: Iterable[str]) -> "SnpTable":
+        """Parse a sites-only VCF: (contig, 1-based pos) per line
+        (SnpTable.scala:31-46). Positions are stored 0-based like every other
+        coordinate in this framework; the reference keeps the VCF's 1-based
+        values and compares them against 0-based read walk positions — an
+        off-by-one we do not reproduce."""
+        table: Dict[str, list] = {}
+        for line in lines:
+            if line.startswith("#") or not line.strip():
+                continue
+            split = line.split("\t")
+            table.setdefault(split[0], []).append(int(split[1]) - 1)
+        return cls({k: np.asarray(v, np.int64) for k, v in table.items()})
+
+    @classmethod
+    def from_vcf(cls, path: str) -> "SnpTable":
+        with open(path, "rt") as f:
+            return cls.from_vcf_lines(f)
+
+    def __len__(self) -> int:
+        return sum(len(v) for v in self._by_contig.values())
+
+    def contigs(self):
+        return list(self._by_contig)
+
+    def mask(self, contig: str, positions: np.ndarray) -> np.ndarray:
+        """bool mask of positions present in the table for ``contig``."""
+        sites = self._by_contig.get(contig)
+        if sites is None or len(sites) == 0:
+            return np.zeros(positions.shape, bool)
+        idx = np.searchsorted(sites, positions)
+        idx = np.minimum(idx, len(sites) - 1)
+        return sites[idx] == positions

@@ -79,6 +79,6 @@ class GenomicRegionPartitioner:
         n_bins = (last - first + 1).astype(np.int64)
         rows = np.repeat(np.arange(len(refid)), n_bins)
         offsets = np.arange(int(n_bins.sum())) - \
-            np.repeat(np.concatenate([[0], np.cumsum(n_bins)[:-1]]), n_bins)
+            np.repeat(np.cumsum(n_bins) - n_bins, n_bins)
         bins = first[rows] + offsets
         return rows.astype(np.int32), bins.astype(np.int32)

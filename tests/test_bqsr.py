@@ -1,0 +1,168 @@
+"""BQSR tests — covariate semantics vs the reference's StandardCovariate /
+ReadCovariates, count-table algebra (RecalibrateBaseQualitiesSuite scenarios),
+and end-to-end recalibration behavior."""
+
+import numpy as np
+import jax.numpy as jnp
+import pyarrow as pa
+
+from adam_tpu import schema as S
+from adam_tpu.bqsr.covariates import covariate_tensors, clip_window
+from adam_tpu.bqsr.recalibrate import (apply_table, compute_table,
+                                       mismatch_state, recalibrate_base_qualities,
+                                       STATE_MASKED, STATE_MATCH, STATE_MISMATCH)
+from adam_tpu.bqsr.table import RecalTable, _rg_of_qualrg
+from adam_tpu.models.snptable import SnpTable
+from adam_tpu.packing import pack_reads
+
+
+def _reads_table(rows):
+    cols = {name: [] for name in S.READ_SCHEMA.names}
+    for row in rows:
+        for name in S.READ_SCHEMA.names:
+            cols[name].append(row.get(name))
+    return pa.Table.from_pydict(cols, schema=S.READ_SCHEMA)
+
+
+def read(sequence="ACTAG", cigar="5M", md="5", start=10, quals=(30,) * 5,
+         name="r", flags=0, rg=0, **kw):
+    return dict(sequence=sequence, cigar=cigar, mismatchingPositions=md,
+                start=start, mapq=30, qual="".join(chr(q + 33) for q in quals),
+                readName=name, referenceId=0, referenceName="1", flags=flags,
+                recordGroupId=rg, recordGroupName=f"rg{rg}", **kw)
+
+
+def cov_for(rows):
+    batch = pack_reads(_reads_table(rows))
+    return {k: np.asarray(v) for k, v in covariate_tensors(
+        jnp.asarray(batch.bases), jnp.asarray(batch.quals),
+        jnp.asarray(batch.read_len), jnp.asarray(batch.flags),
+        jnp.asarray(batch.read_group)).items()}, batch
+
+
+def enc2(a, b):
+    code = {"A": 0, "C": 1, "G": 2, "T": 3}
+    return 1 + 4 * code[a] + code[b]
+
+
+def test_forward_context():
+    # seq1 from "Covariate :: Context :: Example": AACCTTGGAA
+    cov, batch = cov_for([read(sequence="AACCTTGGAA", cigar="10M", md="10",
+                               quals=(30,) * 10)])
+    expected = [0] + [enc2(a, b) for a, b in
+                      zip("AACCTTGGA", "ACCTTGGAA")]
+    assert cov["context"][0, :10].tolist() == expected
+
+
+def test_reverse_context_mirrored_pairing():
+    # reference pairing for reverse reads is mirrored (see covariates.py doc);
+    # seq GGCTACGT reversed-complement is ACGTAGCC, whose windows are
+    # None,AC,CG,GT,TA,AG,GC,CC — mirrored back onto base offsets
+    cov, _ = cov_for([read(sequence="GGCTACGT", cigar="8M", md="8",
+                           quals=(30,) * 8, flags=S.FLAG_REVERSE)])
+    rc_windows = [0, enc2("A", "C"), enc2("C", "G"), enc2("G", "T"),
+                  enc2("T", "A"), enc2("A", "G"), enc2("G", "C"),
+                  enc2("C", "C")]
+    assert cov["context"][0, :8].tolist() == rc_windows
+
+
+def test_context_n_base():
+    cov, _ = cov_for([read(sequence="ANTAG", md="5")])
+    ctx = cov["context"][0, :5]
+    assert ctx[0] == 0  # first base
+    assert ctx[1] == 0 and ctx[2] == 0  # windows containing N
+    assert ctx[3] == enc2("T", "A") and ctx[4] == enc2("A", "G")
+
+
+def test_cycle_covariate():
+    fwd, _ = cov_for([read()])
+    assert (fwd["cycle_idx"][0, :5] - 128).tolist() == [1, 2, 3, 4, 5]
+    rev, _ = cov_for([read(flags=S.FLAG_REVERSE)])
+    assert (rev["cycle_idx"][0, :5] - 128).tolist() == [5, 4, 3, 2, 1]
+    r2, _ = cov_for([read(flags=S.FLAG_PAIRED | S.FLAG_SECOND_OF_PAIR)])
+    assert (r2["cycle_idx"][0, :5] - 128).tolist() == [-1, -2, -3, -4, -5]
+
+
+def test_qual_rg_stratification():
+    cov, _ = cov_for([read(rg=2, quals=(30, 31, 32, 33, 34))])
+    assert cov["qual_rg"][0, :5].tolist() == [150, 151, 152, 153, 154]
+
+
+def test_low_quality_clip_window():
+    cov, _ = cov_for([read(quals=(2, 2, 30, 30, 1))])
+    assert cov["window_start"][0] == 2
+    assert cov["window_end"][0] == 4
+    assert cov["in_window"][0, :5].tolist() == [False, False, True, True, False]
+
+
+def test_mismatch_state():
+    t = _reads_table([read(md="2A2"),                      # mismatch at pos 12
+                      read(name="r2", cigar="2S3M", md="3")])  # clipped head
+    batch = pack_reads(t)
+    st = mismatch_state(t, batch)
+    assert st[0, :5].tolist() == [STATE_MATCH, STATE_MATCH, STATE_MISMATCH,
+                                  STATE_MATCH, STATE_MATCH]
+    # soft-clipped bases have positions outside the alignment => masked
+    assert st[1, :2].tolist() == [STATE_MASKED, STATE_MASKED]
+    assert st[1, 2:5].tolist() == [STATE_MATCH] * 3
+
+
+def test_dbsnp_masking():
+    t = _reads_table([read(md="2A2")])
+    batch = pack_reads(t)
+    snp = SnpTable({"1": np.array([12])})  # the mismatch position
+    st = mismatch_state(t, batch, snp)
+    assert st[0, 2] == STATE_MASKED
+    assert st[0, 0] == STATE_MATCH
+
+
+def test_count_table():
+    # 10 reads, one mismatching base each at offset 2, quals all 30
+    rows = [read(name=f"r{i}", md="2A2") for i in range(10)]
+    rt = compute_table(_reads_table(rows))
+    assert rt.qual_obs[30] == 50
+    assert rt.qual_mm[30] == 10
+    # cycle 3 (offset 2) holds all the mismatches
+    assert rt.cycle_mm[30, 128 + 3] == 10
+    assert rt.cycle_obs[30, 128 + 3] == 10
+    assert abs(rt.expected_mismatch - 50 * 10 ** -3.0) < 1e-6
+
+
+def test_rg_regrouping_quirk():
+    # (k-1)/60 truncating division (RecalTable.scala:121,129)
+    ks = np.array([0, 1, 59, 60, 61, 120, 121])
+    assert _rg_of_qualrg(ks).tolist() == [0, 0, 0, 0, 1, 1, 2]
+
+
+def test_recalibrate_shifts_quals_toward_empirical():
+    # reads report q30 (error 1e-3) but 1% of bases mismatch, spread across
+    # cycles so no single covariate dominates: quals must drop toward ~q20
+    def md_for(i):
+        if i >= 100:
+            return "50"
+        off = i % 50  # every cycle gets exactly 2 of the 100 mismatches
+        return f"{off}A{49 - off}" if off < 49 else "49A0"
+    rows = [read(name=f"r{i}", sequence="A" * 50, cigar="50M", md=md_for(i),
+                 quals=(30,) * 50, start=10 + 60 * i) for i in range(200)]
+    out = recalibrate_base_qualities(_reads_table(rows))
+    new_quals = np.array([[ord(c) - 33 for c in q]
+                          for q in out.column("qual").to_pylist()])
+    mean_q = new_quals.mean()
+    assert 15 <= mean_q <= 25, mean_q
+    # unmapped read stays untouched
+    rows.append(dict(readName="u", flags=S.FLAG_UNMAPPED, sequence="AAAAA",
+                     qual="IIIII"))
+    out2 = recalibrate_base_qualities(_reads_table(rows))
+    assert out2.column("qual").to_pylist()[-1] == "IIIII"
+
+
+def test_table_merge():
+    rows_a = [read(name="a", md="2A2")]
+    rows_b = [read(name="b", md="5")]
+    ta = compute_table(_reads_table(rows_a))
+    tb = compute_table(_reads_table(rows_b))
+    merged = ta + tb
+    both = compute_table(_reads_table(rows_a + rows_b))
+    assert (merged.qual_obs == both.qual_obs).all()
+    assert (merged.qual_mm == both.qual_mm).all()
+    assert abs(merged.expected_mismatch - both.expected_mismatch) < 1e-12

@@ -1,0 +1,127 @@
+"""Pileup conversion + aggregation tests (mirror PileupConversionSuite and
+PileupAggregationSuite scenarios)."""
+
+import numpy as np
+import pyarrow as pa
+
+from adam_tpu import schema as S
+from adam_tpu.ops.pileup import aggregate_pileups, reads_to_pileups
+
+
+def _reads_table(rows):
+    cols = {name: [] for name in S.READ_SCHEMA.names}
+    for row in rows:
+        for name in S.READ_SCHEMA.names:
+            cols[name].append(row.get(name))
+    return pa.Table.from_pydict(cols, schema=S.READ_SCHEMA)
+
+
+def read(sequence="ACTAG", cigar="5M", md="5", start=1, mapq=30,
+         quals=(30, 20, 40, 20, 10), name="r", **kw):
+    qual = "".join(chr(q + 33) for q in quals)
+    return dict(sequence=sequence, cigar=cigar, mismatchingPositions=md,
+                start=start, mapq=mapq, qual=qual, readName=name,
+                referenceId=0, referenceName="1", flags=0, **kw)
+
+
+def by_pos(t):
+    return t.sort_by([("position", "ascending")]).to_pylist()
+
+
+def test_all_match_read():
+    # PileupConversionSuite "single read with only matches"
+    p = reads_to_pileups(_reads_table([read()]))
+    rows = by_pos(p)
+    assert len(rows) == 5
+    assert "".join(r["readBase"] for r in rows) == "ACTAG"
+    assert [r["sangerQuality"] for r in rows] == [30, 20, 40, 20, 10]
+    assert all(r["readBase"] == r["referenceBase"] for r in rows)
+    assert all(r["mapQuality"] == 30 for r in rows)
+    assert all(r["readStart"] == 1 and r["readEnd"] == 6 for r in rows)
+    assert all(r["countAtPosition"] == 1 for r in rows)
+    assert all(r["rangeLength"] is None for r in rows)
+    assert [r["position"] for r in rows] == [1, 2, 3, 4, 5]
+
+
+def test_mismatch_read():
+    # "matches and mismatches": MD 4A0 => ref base A at final position
+    p = reads_to_pileups(_reads_table([read(md="4A0")]))
+    rows = by_pos(p)
+    assert [r["referenceBase"] for r in rows] == ["A", "C", "T", "A", "A"]
+    assert [r["readBase"] for r in rows] == list("ACTAG")
+
+
+def test_insertion_read():
+    # 2M2I1M: insertion bases pinned at the post-match position
+    p = reads_to_pileups(_reads_table([read(cigar="2M2I1M", md="3")]))
+    rows = p.to_pylist()
+    ins = [r for r in rows if r["referenceBase"] is None]
+    assert len(ins) == 2
+    assert all(r["position"] == 3 for r in ins)  # start 1 + 2M
+    assert sorted(r["rangeOffset"] for r in ins) == [0, 1]
+    assert all(r["rangeLength"] == 2 for r in ins)
+    m = [r for r in rows if r["referenceBase"] is not None]
+    assert [r["position"] for r in sorted(m, key=lambda r: r["position"])] == \
+        [1, 2, 3]
+
+
+def test_deletion_read():
+    # 2M2D3M with MD 2^CA3: deletion records carry MD bases, no read base
+    p = reads_to_pileups(_reads_table([read(cigar="2M2D3M", md="2^CA3")]))
+    rows = by_pos(p)
+    assert len(rows) == 7
+    dels = [r for r in rows if r["readBase"] is None]
+    assert [(r["position"], r["referenceBase"], r["rangeOffset"],
+             r["rangeLength"]) for r in dels] == \
+        [(3, "C", 0, 2), (4, "A", 1, 2)]
+
+
+def test_softclip_read():
+    p = reads_to_pileups(_reads_table([read(cigar="2S3M", md="3")]))
+    rows = p.to_pylist()
+    clipped = [r for r in rows if r["numSoftClipped"] == 1]
+    assert len(clipped) == 2
+    assert all(r["position"] == 1 for r in clipped)  # pinned at start
+    assert all(r["referenceBase"] is None for r in clipped)
+
+
+def test_read_without_md_emits_nothing():
+    p = reads_to_pileups(_reads_table([read(md=None)]))
+    assert p.num_rows == 0
+
+
+def test_aggregation():
+    # two matching reads at the same position: counts sum, quals average
+    t = _reads_table([
+        read(name="a", quals=(30, 20, 40, 20, 10)),
+        read(name="b", quals=(10, 20, 20, 20, 30), mapq=20,
+             recordGroupSample="s1"),
+    ])
+    p = reads_to_pileups(t)
+    agg = aggregate_pileups(p)
+    # sample differs (None vs s1) => groups stay separate
+    assert agg.num_rows == 10
+    t2 = _reads_table([
+        read(name="a", quals=(30, 20, 40, 20, 10)),
+        read(name="b", quals=(10, 20, 20, 20, 30), mapq=20),
+    ])
+    agg2 = aggregate_pileups(reads_to_pileups(t2)).sort_by(
+        [("position", "ascending")])
+    rows = agg2.to_pylist()
+    assert len(rows) == 5
+    assert [r["countAtPosition"] for r in rows] == [2] * 5
+    assert [r["sangerQuality"] for r in rows] == [20, 20, 30, 20, 20]
+    assert [r["mapQuality"] for r in rows] == [25] * 5
+    assert all(sorted(r["readName"].split(",")) == ["a", "b"] for r in rows)
+
+
+def test_aggregation_separates_bases():
+    # mismatching read base at same position stays a separate group
+    t = _reads_table([
+        read(name="a", md="5"),
+        read(name="b", sequence="GCTAG", md="0A4"),
+    ])
+    agg = aggregate_pileups(reads_to_pileups(t))
+    first = [r for r in agg.to_pylist() if r["position"] == 1]
+    assert len(first) == 2
+    assert sorted(r["readBase"] for r in first) == ["A", "G"]
