@@ -75,6 +75,7 @@ class TransformCommand(Command):
         p.add_argument("-recalibrate_base_qualities", action="store_true")
         p.add_argument("-dbsnp_sites", default=None,
                        help="sites-only VCF masking known SNPs during BQSR")
+        p.add_argument("-realignIndels", action="store_true")
         p.add_argument("-sort_reads", action="store_true")
         p.add_argument("-parts", type=int, default=1)
 
@@ -92,6 +93,9 @@ class TransformCommand(Command):
             snp = SnpTable.from_vcf(args.dbsnp_sites) if args.dbsnp_sites \
                 else None
             table = recalibrate_base_qualities(table, snp)
+        if args.realignIndels:
+            from ..realign.realigner import realign_indels
+            table = realign_indels(table)
         if args.sort_reads:
             from ..ops.sort import sort_reads
             table = sort_reads(table)

@@ -1,0 +1,160 @@
+"""Consensus generation + indel left-normalization (host-side string logic).
+
+Re-implements ``models/Consensus.scala:23-63``, ``util/NormalizationUtils.scala``
+(leftAlignIndel :36-115, barrel-rotate shift count :125-142, shiftIndel
+:152-162) and ``rich/RichCigar.moveLeft`` (:53-110).
+
+moveLeft is written to its intended semantics — trim one base from the
+element before the indel, pad one onto the element after (appending 1M when
+nothing follows) — rather than copying the reference's list surgery, which
+silently drops elements for some cigar shapes (RichCigar.scala:76-80).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Optional, Tuple
+
+from ..util.mdtag import MdTag, cigar_to_string, parse_cigar
+
+_CONSUMES_READ = set("MIS=X")
+_CONSUMES_REF = set("MDN=X")
+
+
+@dataclass(frozen=True)
+class Consensus:
+    """An alternate allele hypothesis (Consensus.scala:54-63).
+
+    ``start == end``: insertion of ``bases`` at position ``start``;
+    ``end > start``: deletion of reference [start, end).
+    """
+    bases: str
+    start: int
+    end: int
+
+    def insert_into_reference(self, reference: str, ref_start: int,
+                              ref_end: int) -> str:
+        if not (ref_start <= self.start <= ref_end and
+                ref_start <= self.end <= ref_end):
+            raise ValueError(
+                f"Consensus [{self.start},{self.end}] and reference "
+                f"[{ref_start},{ref_end}] do not overlap")
+        return reference[:self.start - ref_start] + self.bases + \
+            reference[self.end - ref_start:]
+
+    @property
+    def is_insertion(self) -> bool:
+        return self.start == self.end
+
+
+def generate_alternate_consensus(sequence: str, start: int,
+                                 cigar: List[Tuple[int, str]]
+                                 ) -> Optional[Consensus]:
+    """Consensus.generateAlternateConsensus (Consensus.scala:25-50): a
+    consensus exists for reads with exactly one I or D, all other ops M."""
+    n_indel = sum(1 for _, op in cigar if op in "ID")
+    if n_indel != 1:
+        return None
+    read_pos = 0
+    ref_pos = start
+    for length, op in cigar:
+        if op == "I":
+            return Consensus(sequence[read_pos:read_pos + length],
+                             ref_pos, ref_pos)
+        if op == "D":
+            return Consensus("", ref_pos, ref_pos + length)
+        if op in _CONSUMES_READ and op in _CONSUMES_REF:
+            read_pos += length
+            ref_pos += length
+        else:
+            return None
+    return None
+
+
+def num_alignment_blocks(cigar: List[Tuple[int, str]]) -> int:
+    """RichCigar.numAlignmentBlocks (:38-45): count of M elements."""
+    return sum(1 for _, op in cigar if op == "M")
+
+
+def move_left(cigar: List[Tuple[int, str]], index: int
+              ) -> List[Tuple[int, str]]:
+    """Move element ``index`` one position left (RichCigar.moveLeft intent):
+    the element before it shrinks by one, the element after grows by one
+    (append 1M when the indel is last)."""
+    if index <= 0 or index >= len(cigar):
+        return list(cigar)
+    out = [list(e) for e in cigar]
+    out[index - 1][0] -= 1
+    if index + 1 < len(out):
+        out[index + 1][0] += 1
+    else:
+        out.append([1, "M"])
+    result = [(l, op) for l, op in out if l > 0]
+    return result
+
+
+def cigar_total_length(cigar: List[Tuple[int, str]]) -> int:
+    return sum(l for l, _ in cigar)
+
+
+def shift_indel(cigar: List[Tuple[int, str]], index: int,
+                shifts: int) -> List[Tuple[int, str]]:
+    """NormalizationUtils.shiftIndel (:152-162): apply up to ``shifts``
+    single-base left moves, stopping when the cigar would degenerate."""
+    total = cigar_total_length(cigar)
+    current = list(cigar)
+    cur_index = index
+    for _ in range(shifts):
+        new = move_left(current, cur_index)
+        if cigar_total_length(new) != total or len(new) < len(current):
+            # the element before the indel vanished; the reference stops here
+            break
+        current = new
+    return current
+
+
+def num_positions_to_shift(variant: str, preceding: str) -> int:
+    """Barrel-rotate shift count (NormalizationUtils:125-142)."""
+    count = 0
+    v = variant
+    p = preceding
+    while p and v and p[-1] == v[-1]:
+        v = v[-1] + v[:-1]
+        p = p[:-1]
+        count += 1
+    return count
+
+
+def left_align_indel(sequence: str, cigar: List[Tuple[int, str]],
+                     md: Optional[MdTag]) -> List[Tuple[int, str]]:
+    """NormalizationUtils.leftAlignIndel (:36-115): shift a single indel as
+    far left as the preceding read bases allow."""
+    indel_pos = -1
+    indel_len = 0
+    is_insert = False
+    read_pos = 0
+    ref_pos = 0
+    for i, (length, op) in enumerate(cigar):
+        if op in "ID":
+            if indel_pos != -1:
+                return list(cigar)  # second indel: bail
+            indel_pos = i
+            indel_len = length
+            is_insert = op == "I"
+        elif indel_pos == -1:
+            if op in _CONSUMES_READ:
+                read_pos += length
+            if op in _CONSUMES_REF:
+                ref_pos += length
+    if indel_pos == -1:
+        return list(cigar)
+    if is_insert:
+        variant = sequence[read_pos:read_pos + indel_len]
+    else:
+        if md is None:
+            return list(cigar)
+        ref_seq = md.get_reference(sequence, cigar, 0)
+        variant = ref_seq[ref_pos:ref_pos + indel_len]
+    preceding = sequence[:read_pos]
+    shifts = num_positions_to_shift(variant, preceding)
+    return shift_indel(cigar, indel_pos, shifts)

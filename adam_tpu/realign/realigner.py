@@ -1,0 +1,342 @@
+"""Indel realignment driver + the consensus sweep kernel.
+
+Re-designs ``rdd/RealignIndels.scala``: target discovery reuses the pileup
+engine (targets.py), reads map to targets by interval search, and each target
+group is realigned against candidate indel consensuses.  The hot loop — every
+read swept across every consensus at every admissible offset, scored by
+summed mismatch quality (sweepReadOverReferenceForQuality :376-394, the
+O(reads x consensuses x offsets x readLen) core) — runs as one batched device
+kernel: a [R, O, L] mismatch tensor contracted against the quality vector.
+Cigar/MD/start rewrites stay host-side string logic, checked against the
+device-chosen offsets.
+
+Acceptance: the best consensus must improve total mismatch quality by more
+than lodThreshold (5.0) phred-decades over the original alignments
+(RealignIndels.scala:176-182,308).  Realigned reads get mapq + 10 (:320).
+
+One deliberate divergence: the reference's post-sweep cigar rewrite
+(:327-345) emits an all-M cigar whenever the new start precedes the consensus
+indel — which is exactly the common case, so its output contradicts the GATK
+golden file its own test suite ships (the test passes vacuously: it filters
+on ``getReadName == "read4"`` where getReadName is an Avro Utf8, so the
+comparison is always false and the asserts run on empty lists).  We emit the
+correct GATK-style cigar: M(bases before indel) I/D M(bases after), which
+reproduces GATK's output for the artificial golden fixture.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pyarrow as pa
+
+from .. import schema as S
+from ..packing import ReadBatch, _round_up, column_int64, pack_reads
+from ..util.mdtag import MdTag, cigar_to_string, parse_cigar
+from .consensus import (Consensus, generate_alternate_consensus,
+                        left_align_indel, num_alignment_blocks)
+from .targets import find_targets, map_reads_to_targets
+
+LOD_THRESHOLD = 5.0   # RealignIndels.scala:181
+MAX_INDEL_SIZE = 3000
+BIG = 1 << 30
+
+
+@partial(jax.jit, static_argnames=())
+def _sweep_kernel(reads_u8, quals, read_lens, cons_u8, cons_len):
+    """Batched sweep: best (mismatch-quality, offset) per read.
+
+    reads_u8 [R, L], quals [R, L], read_lens [R], cons_u8 [CL] (padded),
+    cons_len scalar.  Admissible offsets are 0 <= o < cons_len - read_len
+    (sweepReadOverReferenceForQuality :381); ties resolve to the lowest
+    offset, like the reference's reduction.
+    """
+    R, L = reads_u8.shape
+    CL = cons_u8.shape[0]
+    offs = jnp.arange(CL)
+    idx = jnp.clip(offs[:, None] + jnp.arange(L)[None, :], 0, CL - 1)
+    cons_win = cons_u8[idx]                                    # [CL, L]
+    in_read = jnp.arange(L)[None, :] < read_lens[:, None]      # [R, L]
+    w = jnp.where(in_read, quals, 0).astype(jnp.int32)
+    mm = reads_u8[:, None, :] != cons_win[None, :, :]          # [R, CL, L]
+    score = jnp.sum(mm * w[:, None, :], axis=-1)               # [R, CL]
+    valid = offs[None, :] < (cons_len - read_lens)[:, None]
+    score = jnp.where(valid, score, BIG)
+    best_o = jnp.argmin(score, axis=1)
+    best_q = jnp.take_along_axis(score, best_o[:, None], 1)[:, 0]
+    return best_q, best_o
+
+
+@dataclass
+class _Read:
+    """Host-side view of one read inside a target group."""
+    row: int
+    seq: str
+    quals: List[int]
+    start: int
+    mapq: int
+    cigar: List[Tuple[int, str]]
+    md: Optional[MdTag]
+    md_str: Optional[str]
+
+    def end(self) -> int:
+        return self.start + sum(l for l, op in self.cigar if op in "MDN=X")
+
+
+def _sum_mismatch_quality(read: _Read) -> int:
+    """Summed quality of the read's mismatching bases under its current
+    alignment.
+
+    Deliberate divergence: the reference's sumMismatchQuality (:425-430) zips
+    the read against its MD-derived reference *positionally, ignoring the
+    cigar*, so for a deletion-spanning read every base after the deletion is
+    compared against the wrong reference column and counted as a mismatch.
+    That inflates the "original" score, makes every deletion-spanning read
+    look improvable, and hands out spurious mapq+10 bumps the GATK golden
+    file does not have.  We walk the cigar and count only MD-recorded
+    mismatches — which makes read1/3/5 of the golden fixture stay untouched,
+    matching GATK.
+    """
+    q = 0
+    read_pos = 0
+    ref_pos = read.start
+    for length, op in read.cigar:
+        if op in "M=X":
+            for i in range(length):
+                if read.md.mismatched_base(ref_pos + i) is not None:
+                    q += read.quals[read_pos + i]
+            read_pos += length
+            ref_pos += length
+        elif op in "IS":
+            read_pos += length
+        elif op in "DN":
+            ref_pos += length
+    return q
+
+
+def _reference_from_reads(reads: List[_Read]) -> Tuple[str, int, int]:
+    """getReferenceFromReads (:147-167): stitch the target's reference from
+    the reads' MD tags."""
+    spans = sorted(((r.md.get_reference(r.seq, r.cigar, r.start),
+                     r.start, r.end()) for r in reads if r.md is not None),
+                   key=lambda t: t[1])
+    ref, ref_start, ref_end = spans[0][0], spans[0][1], spans[0][2]
+    for seq, s, e in spans[1:]:
+        if e < ref_end:
+            continue
+        if ref_end >= s:
+            ref = ref + seq[ref_end - s:]
+            ref_end = e
+        else:
+            raise ValueError(f"reference gap at {ref_end} before {s}")
+    return ref, ref_start, ref_end
+
+
+def _rewrite_read(read: _Read, cons: Consensus, ref: str, ref_start: int,
+                  remap: int) -> Optional[_Read]:
+    """GATK-style start/cigar/MD rewrite for an accepted remapping.
+
+    Returns None for degenerate placements (read only partially overlaps an
+    insertion, or would run past the stitched reference) — the caller keeps
+    the original alignment.
+    """
+    rl = len(read.seq)
+    indel_off = cons.start - ref_start       # indel point in consensus coords
+    if cons.is_insertion:
+        ilen = len(cons.bases)
+        m1 = indel_off - remap
+        if 0 < m1 and m1 + ilen < rl:
+            new_start = ref_start + remap
+            cigar = [(m1, "M"), (ilen, "I"), (rl - m1 - ilen, "M")]
+        elif remap >= indel_off + ilen:       # entirely after the insertion
+            new_start = ref_start + remap - ilen
+            cigar = [(rl, "M")]
+        elif m1 >= rl:                        # entirely before the insertion
+            new_start = ref_start + remap
+            cigar = [(rl, "M")]
+        else:                                 # partial overlap: unplaceable
+            return None
+    else:
+        dlen = cons.end - cons.start
+        m1 = indel_off - remap
+        if 0 < m1 < rl:
+            new_start = ref_start + remap
+            cigar = [(m1, "M"), (dlen, "D"), (rl - m1, "M")]
+        elif remap >= indel_off:              # entirely after the deletion
+            new_start = ref_start + remap + dlen
+            cigar = [(rl, "M")]
+        else:
+            new_start = ref_start + remap
+            cigar = [(rl, "M")]
+    # the rewrite must stay within the stitched reference
+    ref_consumed = sum(l for l, op in cigar if op in "MDN=X")
+    if new_start - ref_start + ref_consumed > len(ref):
+        return None
+    new_md = MdTag.move_alignment(ref[new_start - ref_start:], read.seq,
+                                  cigar, new_start)
+    return _Read(read.row, read.seq, read.quals, new_start, read.mapq + 10,
+                 cigar, new_md, str(new_md))
+
+
+def _realign_group(reads: List[_Read]) -> Dict[int, _Read]:
+    """realignTargetGroup (:238-364) for one non-empty target."""
+    # --- findConsensus (:184-228)
+    reads_to_clean: List[_Read] = []
+    consensuses: List[Consensus] = []
+    for r in reads:
+        cigar = r.cigar
+        md = r.md
+        if md is None:
+            continue
+        if num_alignment_blocks(cigar) == 2:
+            new_cigar = left_align_indel(r.seq, cigar, md)
+            if new_cigar != cigar:
+                ref = md.get_reference(r.seq, cigar, r.start)
+                md = MdTag.move_alignment(ref, r.seq, new_cigar, r.start)
+                cigar = new_cigar
+        if md.has_mismatches():
+            cleaned = _Read(r.row, r.seq, r.quals, r.start, r.mapq, cigar,
+                            md, str(md))
+            reads_to_clean.append(cleaned)
+            c = generate_alternate_consensus(r.seq, r.start, cigar)
+            if c is not None and c not in consensuses:
+                consensuses.append(c)
+    if not reads_to_clean or not consensuses:
+        return {}
+
+    try:
+        ref, ref_start, ref_end = _reference_from_reads(reads)
+    except ValueError:
+        return {}  # reference gap: leave the group unrealigned
+
+    original_quals = [_sum_mismatch_quality(r) for r in reads_to_clean]
+    total_pre = sum(original_quals)
+
+    # --- sweep every consensus (device kernel); R and L pad to buckets so
+    # XLA compilations amortize across the many differently-sized groups
+    R = _round_up(len(reads_to_clean), 32)
+    L = _round_up(max(len(r.seq) for r in reads_to_clean), 32)
+    reads_u8 = np.zeros((R, L), np.uint8)
+    quals_arr = np.zeros((R, L), np.int32)
+    lens = np.zeros(R, np.int32)
+    for i, r in enumerate(reads_to_clean):
+        b = r.seq.encode()
+        reads_u8[i, :len(b)] = np.frombuffer(b, np.uint8)
+        quals_arr[i, :len(r.quals)] = r.quals
+        lens[i] = len(b)
+
+    best = None  # (total, consensus, per-read (qual, offset))
+    for cons in consensuses:
+        try:
+            cons_seq = cons.insert_into_reference(ref, ref_start, ref_end)
+        except ValueError:
+            continue
+        CL = _round_up(max(len(cons_seq), L + 1), 64)
+        cons_u8 = np.zeros(CL, np.uint8)
+        cb = cons_seq.encode()
+        cons_u8[:len(cb)] = np.frombuffer(cb, np.uint8)
+        q, o = _sweep_kernel(jnp.asarray(reads_u8), jnp.asarray(quals_arr),
+                             jnp.asarray(lens), jnp.asarray(cons_u8),
+                             jnp.int32(len(cons_seq)))
+        q = np.asarray(q)[:len(reads_to_clean)]
+        o = np.asarray(o)[:len(reads_to_clean)]
+        # fall back to the original alignment when the sweep cannot improve
+        use = q < np.asarray(original_quals)
+        quals_final = np.where(use, q, original_quals)
+        offsets_final = np.where(use, o, -1)
+        total = int(quals_final.sum())
+        if best is None or total < best[0]:
+            best = (total, cons, quals_final, offsets_final)
+
+    if best is None:
+        return {}
+    total_best, cons, _, offsets = best
+    if (total_pre - total_best) / 10.0 <= LOD_THRESHOLD:
+        return {}
+
+    out: Dict[int, _Read] = {}
+    for r, off in zip(reads_to_clean, offsets):
+        rewritten = _rewrite_read(r, cons, ref, ref_start, int(off)) \
+            if off >= 0 else None
+        # unplaceable rewrites keep the (left-normalized) original alignment
+        out[r.row] = rewritten if rewritten is not None else r
+    return out
+
+
+def realign_indels(table: pa.Table, batch: Optional[ReadBatch] = None
+                   ) -> pa.Table:
+    """adamRealignIndels (AdamRDDFunctions.scala:109-112)."""
+    from ..ops.pileup import reads_to_pileups
+    n = table.num_rows
+    if batch is None:
+        batch = pack_reads(table)
+
+    pileups = reads_to_pileups(table, batch)
+    targets = find_targets(pileups)
+    if len(targets) == 0:
+        return table
+
+    from ..ops import cigar as C
+    flags = np.asarray(batch.flags[:n], np.int64)
+    refid = np.asarray(batch.refid[:n], np.int64)
+    start = np.asarray(batch.start[:n], np.int64)
+    end = np.asarray(C.read_end(jnp.asarray(batch.start),
+                                jnp.asarray(batch.cigar_ops),
+                                jnp.asarray(batch.cigar_lens)))[:n]
+    mapped = (flags & S.FLAG_UNMAPPED) == 0
+    tgt = map_reads_to_targets(refid, start, end.astype(np.int64), mapped,
+                               targets)
+
+    # only rows inside targets are touched — gather just those
+    in_target = np.flatnonzero(tgt >= 0)
+    sub = table.select(["sequence", "cigar", "mismatchingPositions", "qual",
+                        "mapq"]).take(pa.array(in_target)).to_pydict()
+
+    updates: Dict[int, _Read] = {}
+    for t in np.unique(tgt[in_target]):
+        sub_rows = np.flatnonzero(tgt[in_target] == t)
+        group = []
+        for i in sub_rows:
+            row = int(in_target[i])
+            if sub["sequence"][i] is None or sub["cigar"][i] is None:
+                continue
+            md_str = sub["mismatchingPositions"][i]
+            md = MdTag.parse(md_str, int(start[row])) \
+                if md_str is not None else None
+            group.append(_Read(
+                row, sub["sequence"][i],
+                [ord(c) - 33 for c in (sub["qual"][i] or "")],
+                int(start[row]), int(sub["mapq"][i] or 0),
+                parse_cigar(sub["cigar"][i]), md, md_str))
+        if group:
+            updates.update(_realign_group(group))
+
+    if not updates:
+        return table
+
+    new_start = column_int64(table, "start").tolist()
+    new_mapq = column_int64(table, "mapq").tolist()
+    new_cigar = table.column("cigar").to_pylist()
+    new_md = table.column("mismatchingPositions").to_pylist()
+    for row, r in updates.items():
+        new_start[row] = r.start
+        new_mapq[row] = r.mapq
+        new_cigar[row] = cigar_to_string(r.cigar)
+        new_md[row] = r.md_str
+
+    def set_col(t, name, values, typ):
+        idx = t.column_names.index(name)
+        vals = [None if v == -1 and typ != pa.string() else v
+                for v in values] if typ != pa.string() else values
+        return t.set_column(idx, name, pa.array(vals, typ))
+
+    table = set_col(table, "start", new_start, pa.int64())
+    table = set_col(table, "mapq", new_mapq, pa.int32())
+    table = set_col(table, "cigar", new_cigar, pa.string())
+    table = set_col(table, "mismatchingPositions", new_md, pa.string())
+    return table

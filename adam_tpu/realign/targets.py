@@ -1,0 +1,130 @@
+"""Indel realignment target discovery.
+
+Re-designs ``algorithms/realignmenttarget/`` (RealignmentTargetFinder:27-101,
+IndelRealignmentTarget:251-437): the reference converts reads to pileups,
+groups by position into rods, builds per-position targets, sorts, collects to
+the driver and tail-recursively merges overlapping targets.  Here the whole
+thing is vectorized over the pileup table: per-position evidence sums via
+sorted segment reductions, then a linear interval merge.
+
+Evidence rules (IndelRealignmentTarget.apply :262-333):
+  * indel evidence = any pileup with rangeOffset set (insertions, deletions
+    and — faithfully to the reference — soft clips);
+  * SNP evidence = aligned mismatch pileups whose summed quality is >= 0.15
+    of the summed match quality (mismatchThreshold :254), or any mismatch
+    when there are no matches;
+  * a position's target spans [min readStart, max readEnd) of the
+    contributing reads; overlapping targets merge.
+
+The per-target indel/SNP sets only ever feed the merged read range, so the
+final representation is just an [T, 2] interval array — which is also what
+the read->target assignment (binary search) wants.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import numpy as np
+import pyarrow as pa
+
+from ..packing import ReadBatch, column_int64
+
+MISMATCH_THRESHOLD = 0.15  # IndelRealignmentTarget.scala:254
+MAX_TARGET_SPREAD = 3000   # empty-target skew spread (RealignIndels.scala:77)
+
+
+def find_targets(pileups: pa.Table) -> np.ndarray:
+    """[T, 3] (referenceId, start, end) inclusive read-range intervals,
+    sorted by (refid, start) and merged per contig."""
+    n = pileups.num_rows
+    if n == 0:
+        return np.zeros((0, 3), np.int64)
+    pos = column_int64(pileups, "position")
+    refid = column_int64(pileups, "referenceId", 0)
+    range_off = column_int64(pileups, "rangeOffset", -1)
+    softclip = column_int64(pileups, "numSoftClipped", 0)
+    qual = column_int64(pileups, "sangerQuality", 0)
+    rstart = column_int64(pileups, "readStart", 0)
+    rend = column_int64(pileups, "readEnd", 0)
+    read_base = np.array(
+        [b is not None for b in pileups.column("readBase").to_pylist()])
+    ref_base_eq = np.array(
+        [a == b and a is not None
+         for a, b in zip(pileups.column("readBase").to_pylist(),
+                         pileups.column("referenceBase").to_pylist())])
+
+    is_indel = range_off >= 0
+    aligned = ~is_indel & (softclip == 0)
+    is_match = aligned & ref_base_eq
+    is_mismatch = aligned & read_base & ~ref_base_eq
+
+    # per-(refid, position) evidence sums
+    key = (refid << 34) | pos
+    uniq, inv = np.unique(key, return_inverse=True)
+    m = len(uniq)
+    match_q = np.bincount(inv, weights=qual * is_match, minlength=m)
+    mismatch_q = np.bincount(inv, weights=qual * is_mismatch, minlength=m)
+    snp_ev = (mismatch_q > 0) & ((match_q == 0) |
+                                 (mismatch_q / np.maximum(match_q, 1e-9) >=
+                                  MISMATCH_THRESHOLD))
+
+    # contributing pileups: indels always; mismatches when SNP evidence holds
+    contrib = is_indel | (is_mismatch & snp_ev[inv])
+    if not contrib.any():
+        return np.zeros((0, 3), np.int64)
+    c_inv = inv[contrib]
+    big = np.int64(1) << 60
+    t_start = np.full(m, big, np.int64)
+    np.minimum.at(t_start, c_inv, rstart[contrib])
+    t_end = np.full(m, -big, np.int64)
+    np.maximum.at(t_end, c_inv, rend[contrib] - 1)
+    t_ref = uniq >> 34  # recover refid from the position key
+    keep = t_start < big
+    t_ref, t_start, t_end = t_ref[keep], t_start[keep], t_end[keep]
+
+    # sort by (refid, start) + merge per-contig overlapping inclusive
+    # intervals (joinTargets :54-71; targets never span contigs)
+    order = np.lexsort((t_start, t_ref))
+    t_ref, t_start, t_end = t_ref[order], t_start[order], t_end[order]
+    merged = []
+    cr, cs, ce = int(t_ref[0]), int(t_start[0]), int(t_end[0])
+    for r, s, e in zip(t_ref[1:], t_start[1:], t_end[1:]):
+        if r == cr and s <= ce:  # same contig, inclusive ranges overlap
+            ce = max(ce, int(e))
+        else:
+            merged.append((cr, cs, ce))
+            cr, cs, ce = int(r), int(s), int(e)
+    merged.append((cr, cs, ce))
+    return np.array(merged, np.int64).reshape(-1, 3)
+
+
+def map_reads_to_targets(refid: np.ndarray, start: np.ndarray,
+                         end: np.ndarray, mapped: np.ndarray,
+                         targets: np.ndarray) -> np.ndarray:
+    """[N] target index per read, -1-ish for "no target".
+
+    A read maps to the first target on its contig whose inclusive read range
+    overlaps [start, end-1] (TargetOrdering.contains :79-88).  Unassigned
+    reads get the reference's skew-spread empty key -1 - start/3000
+    (RealignIndels.mapToTarget :77-80) so downstream grouping stays balanced.
+    """
+    out = -1 - (np.maximum(start, 0) // MAX_TARGET_SPREAD)
+    if len(targets) == 0:
+        return out.astype(np.int64)
+    tr, ts, te = targets[:, 0], targets[:, 1], targets[:, 2]
+    # encode (refid, pos) into one sortable key; targets are lexsorted so the
+    # composite keys are sorted too
+    shift = np.int64(1) << 34
+    read_start_key = refid * shift + start
+    read_end_key = refid * shift + (end - 1)
+    t_start_key = tr * shift + ts
+    t_end_key = tr * shift + te
+    # first target with end key >= read start key; overlap iff also starts
+    # before the read's end key (same-contig by key construction)
+    idx = np.searchsorted(t_end_key, read_start_key)
+    idx_c = np.minimum(idx, len(ts) - 1)
+    overlaps = mapped & (idx < len(ts)) & \
+        (t_start_key[idx_c] <= read_end_key) & \
+        (t_end_key[idx_c] >= read_start_key) & (tr[idx_c] == refid)
+    return np.where(overlaps, idx_c, out).astype(np.int64)
