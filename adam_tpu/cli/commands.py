@@ -167,6 +167,306 @@ class AggregatePileupsCommand(Command):
 
 
 @register
+class Vcf2AdamCommand(Command):
+    name = "vcf2adam"
+    help = "Convert a VCF file to ADAM variant-context Parquet datasets"
+
+    def add_args(self, p: argparse.ArgumentParser) -> None:
+        p.add_argument("input", help="VCF file")
+        p.add_argument("output", help="output basename (.v/.g/.vd datasets)")
+
+    def run(self, args) -> int:
+        from ..io.parquet import save_table
+        from ..io.vcf import read_vcf
+
+        variants, genotypes, domains, _ = read_vcf(args.input)
+        # three datasets, the reference's .v/.g/.vd convention
+        # (AdamRDDFunctions.scala:330-363)
+        save_table(variants, args.output + ".v")
+        save_table(genotypes, args.output + ".g")
+        save_table(domains, args.output + ".vd")
+        print(f"wrote {variants.num_rows} variants, {genotypes.num_rows} "
+              f"genotypes, {domains.num_rows} domains to {args.output}.{{v,g,vd}}")
+        return 0
+
+
+@register
+class Adam2VcfCommand(Command):
+    name = "adam2vcf"
+    help = "Convert ADAM variant-context Parquet datasets to VCF"
+
+    def add_args(self, p: argparse.ArgumentParser) -> None:
+        p.add_argument("input", help="basename of .v/.g datasets")
+        p.add_argument("output", help="output VCF file")
+
+    def run(self, args) -> int:
+        import os
+        import pyarrow as pa
+        from .. import schema as S
+        from ..io.parquet import load_table
+        from ..io.vcf import write_vcf
+
+        variants = load_table(args.input + ".v")
+        if os.path.exists(args.input + ".g"):
+            genotypes = load_table(args.input + ".g")
+        else:
+            genotypes = pa.Table.from_pydict(
+                {n: [] for n in S.GENOTYPE_SCHEMA.names},
+                schema=S.GENOTYPE_SCHEMA)
+        write_vcf(variants, genotypes, args.output)
+        print(f"wrote {variants.num_rows} variants to {args.output}")
+        return 0
+
+
+@register
+class ComputeVariantsCommand(Command):
+    name = "compute_variants"
+    help = "Compute variant data from genotypes (cli/ComputeVariants.scala)"
+
+    def add_args(self, p: argparse.ArgumentParser) -> None:
+        p.add_argument("input", help="genotype Parquet dataset (.g)")
+        p.add_argument("output", help="output basename (.v/.g datasets)")
+        p.add_argument("-runValidation", action="store_true")
+        p.add_argument("-runStrictValidation", action="store_true")
+
+    def run(self, args) -> int:
+        from ..converters.genotypes_to_variants import convert_genotypes
+        from ..io.parquet import load_table, save_table
+
+        genotypes = load_table(args.input)
+        variants = convert_genotypes(
+            genotypes, validate=args.runValidation or args.runStrictValidation,
+            strict=args.runStrictValidation)
+        save_table(variants, args.output + ".v")
+        save_table(genotypes, args.output + ".g")
+        print(f"computed {variants.num_rows} variants from "
+              f"{genotypes.num_rows} genotypes")
+        return 0
+
+
+@register
+class CompareCommand(Command):
+    name = "compare"
+    help = "Compare two read datasets pipeline-concordance style"
+
+    def add_args(self, p: argparse.ArgumentParser) -> None:
+        p.add_argument("input1", nargs="?")
+        p.add_argument("input2", nargs="?")
+        p.add_argument("-comparisons", default=None,
+                       help="comma-separated comparison names (default: all)")
+        p.add_argument("-list_comparisons", action="store_true")
+        p.add_argument("-directory", default=None,
+                       help="directory to write per-metric histogram files")
+
+    def run(self, args) -> int:
+        from ..compare.engine import (ComparisonTraversalEngine,
+                                      DEFAULT_COMPARISONS, find_comparison)
+        if args.list_comparisons:
+            print("\nAvailable comparisons:")
+            for c in DEFAULT_COMPARISONS.values():
+                print(f"\t{c.name:>10} : {c.description}")
+            return 0
+        if not args.input1 or not args.input2:
+            print("compare: INPUT1 and INPUT2 required", file=__import__("sys").stderr)
+            return 2
+        from ..io.dispatch import load_reads_union
+        # comma-separated paths per input union with id reconciliation
+        # (the reference's -recurse multi-file load, CompareAdam.scala:70-86)
+        t1, sd1, _ = load_reads_union(args.input1.split(","))
+        t2, sd2, _ = load_reads_union(args.input2.split(","))
+        engine = ComparisonTraversalEngine(t1, t2, sd1, sd2)
+        names = (args.comparisons.split(",") if args.comparisons
+                 else list(DEFAULT_COMPARISONS))
+        # summary format mirrors cli/CompareAdam.scala:148-174
+        print(f"{'INPUT1':>15}: {args.input1}")
+        print(f"\t{'total-reads':>15}: {len(engine.named1)}")
+        print(f"\t{'unique-reads':>15}: {engine.unique_to_1()}")
+        print(f"{'INPUT2':>15}: {args.input2}")
+        print(f"\t{'total-reads':>15}: {len(engine.named2)}")
+        print(f"\t{'unique-reads':>15}: {engine.unique_to_2()}")
+        for name in names:
+            comp = find_comparison(name)
+            hist = engine.aggregate(comp)
+            count = hist.count()
+            ident = hist.count_identical()
+            diff_frac = (count - ident) / count if count else 0.0
+            print()
+            print(comp.name)
+            print(f"\t{'count':>15}: {count}")
+            print(f"\t{'identity':>15}: {ident}")
+            print(f"\t{'diff%':>15}: {100.0 * diff_frac:.5f}")
+            if args.directory:
+                import os
+                os.makedirs(args.directory, exist_ok=True)
+                with open(os.path.join(args.directory, name + ".txt"),
+                          "w") as f:
+                    hist.write(f)
+        return 0
+
+
+@register
+class FindReadsCommand(Command):
+    name = "findreads"
+    help = "Find reads that match comparative criteria (e.g. positions!=0)"
+
+    def add_args(self, p: argparse.ArgumentParser) -> None:
+        p.add_argument("input1")
+        p.add_argument("input2")
+        p.add_argument("filter",
+                       help='e.g. "positions!=0" or "dupemismatch=(1,0)"; '
+                            "semicolon-separated filters AND together")
+        p.add_argument("-file", default=None,
+                       help="write matching read names to this file")
+
+    def run(self, args) -> int:
+        from ..compare.engine import ComparisonTraversalEngine, parse_filters
+        from ..io.dispatch import load_reads_union
+        t1, sd1, _ = load_reads_union(args.input1.split(","))
+        t2, sd2, _ = load_reads_union(args.input2.split(","))
+        engine = ComparisonTraversalEngine(t1, t2, sd1, sd2)
+        names = engine.find(parse_filters(args.filter))
+        if args.file:
+            with open(args.file, "w") as f:
+                f.write("\n".join(names) + ("\n" if names else ""))
+        else:
+            for n in names:
+                print(n)
+        return 0
+
+
+@register
+class Fasta2AdamCommand(Command):
+    name = "fasta2adam"
+    help = "Convert a FASTA reference to an ADAM contig Parquet dataset"
+
+    def add_args(self, p: argparse.ArgumentParser) -> None:
+        p.add_argument("input", help="FASTA file")
+        p.add_argument("output", help="output Parquet dataset")
+        p.add_argument("-reads", default=None,
+                       help="reads file whose dictionary supplies contig ids "
+                            "(cli/Fasta2Adam.scala:57-82)")
+
+    def run(self, args) -> int:
+        import pyarrow as pa
+        from ..io.fasta import read_fasta
+        from ..io.parquet import save_table
+
+        contigs = read_fasta(args.input)
+        if args.reads:
+            from ..io.dispatch import (load_reads,
+                                       sequence_dictionary_from_reads)
+            rtable, sd, _ = load_reads(args.reads)
+            if sd is None:
+                sd = sequence_dictionary_from_reads(rtable)
+            names = contigs.column("contigName").to_pylist()
+            new_ids = [sd[n].id if n in sd else None for n in names]
+            contigs = contigs.set_column(
+                contigs.column_names.index("contigId"), "contigId",
+                pa.array(new_ids, pa.int32()))
+        save_table(contigs, args.output)
+        print(f"wrote {contigs.num_rows} contigs to {args.output}")
+        return 0
+
+
+@register
+class MpileupCommand(Command):
+    name = "mpileup"
+    help = "Output samtools mpileup-style text (cli/MpileupCommand.scala)"
+
+    def add_args(self, p: argparse.ArgumentParser) -> None:
+        p.add_argument("input", help="SAM/BAM file or ADAM Parquet dataset")
+
+    def run(self, args) -> int:
+        from ..io.dispatch import load_reads
+        from ..ops.pileup import reads_to_pileups
+
+        table, _, _ = load_reads(args.input)
+        pileups = reads_to_pileups(table)
+        rows = pileups.sort_by([("referenceId", "ascending"),
+                                ("position", "ascending")]).to_pylist()
+        # group by position; event layout mirrors MpileupCommand.scala:47-78
+        from itertools import groupby
+        for (name, pos), group in groupby(
+                rows, key=lambda r: (r["referenceName"], r["position"])):
+            group = list(group)
+            aligned = [r for r in group if r["rangeOffset"] is None]
+            inserts = [r for r in group if r["rangeOffset"] is not None and
+                       r["readBase"] is not None and not r["numSoftClipped"]]
+            deletes = [r for r in group if r["readBase"] is None]
+            ref_base = next((r["referenceBase"] for r in aligned + deletes
+                             if r["referenceBase"]), "?")
+            # numReads = aligned events + whole insertions + deletions —
+            # soft clips excluded, insertions counted once
+            # (PileupTraversable event model)
+            n_ins = len({r["readName"] for r in inserts})
+            depth = len(aligned) + n_ins + len(deletes)
+            out = [f"{name} {pos} {ref_base} {depth} "]
+            for r in aligned:
+                if r["readBase"] == r["referenceBase"]:
+                    out.append("," if r["numReverseStrand"] else ".")
+                else:
+                    b = r["readBase"] or "?"
+                    out.append(b.lower() if r["numReverseStrand"] else b)
+            for r in deletes:
+                out.append(f"-1{ref_base}")
+            for r in inserts:
+                if r["rangeOffset"] == 0:
+                    # whole insertion reported once, at its first base
+                    ins = [x for x in inserts
+                           if x["readName"] == r["readName"]]
+                    seq = "".join(x["readBase"] for x in sorted(
+                        ins, key=lambda x: x["rangeOffset"]))
+                    out.append(f"+{len(seq)}{seq}")
+            print("".join(out))
+        return 0
+
+
+@register
+class PrintTagsCommand(Command):
+    name = "print_tags"
+    help = "Print the distinct attribute tags and their counts"
+
+    def add_args(self, p: argparse.ArgumentParser) -> None:
+        p.add_argument("input")
+        p.add_argument("-list", dest="list_n", type=int, default=None,
+                       help="also list the first N attribute fields")
+        p.add_argument("-count", default=None,
+                       help="comma-separated tags: print value census")
+
+    def run(self, args) -> int:
+        from collections import Counter
+        from .. import schema as S
+        from ..io.dispatch import load_reads
+        from ..packing import column_int64
+
+        table, _, _ = load_reads(
+            args.input, columns=("attributes", "flags"))
+        flags = column_int64(table, "flags", 0)
+        attrs = table.column("attributes").to_pylist()
+        # the reference filters failed-vendor-quality reads (PrintTags.scala:70)
+        usable = [(a or "") for a, f in zip(attrs, flags)
+                  if not (f & S.FLAG_QC_FAIL)]
+        if args.list_n:
+            for a in usable[:args.list_n]:
+                print(a)
+        to_count = set(args.count.split(",")) if args.count else set()
+        tag_counts: Counter = Counter()
+        value_counts: dict = {t: Counter() for t in to_count}
+        for a in usable:
+            for field in a.split("\t") if a else []:
+                tag = field.split(":", 1)[0]
+                tag_counts[tag] += 1
+                if tag in to_count:
+                    value_counts[tag][field.split(":", 2)[2]] += 1
+        for tag, count in tag_counts.most_common():
+            print(f"{tag:>3}\t{count}")
+            for value, vc in value_counts.get(tag, {}).items():
+                print(f"\t{vc:>10}\t{value}")
+        print(f"Total: {len(usable)}")
+        return 0
+
+
+@register
 class PrintCommand(Command):
     name = "print"
     help = "Print an ADAM Parquet dataset (or SAM) as records"

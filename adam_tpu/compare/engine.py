@@ -1,0 +1,295 @@
+"""Pipeline-concordance comparison engine.
+
+Re-designs ``rdd/comparisons/ComparisonTraversalEngine.scala:40-90``, the
+``metrics/`` package (BucketComparisons + the five default comparisons,
+AvailableComparisons.scala:25-177; Histogram aggregator,
+util/Histogram.scala:22-98) and the findreads filter grammar
+(cli/FindReads.scala:59-96).
+
+Two read datasets bucket by readName into 7-way ReadBuckets
+(models/ReadBucket.scala:31-111), join on name, and each comparison emits
+values per joined pair which aggregate into histograms.  The reference runs
+two shuffles and an RDD join; here bucketing is a vectorized arrow/numpy
+group-by and the join is a dict merge.
+"""
+
+from __future__ import annotations
+
+import re
+from collections import Counter
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+import pyarrow as pa
+
+from .. import schema as S
+from ..packing import column_int64
+
+
+@dataclass
+class ReadBucket:
+    """7-way split of one read name's records (ReadBucket.scala:31-47)."""
+    unpaired_primary: List[dict] = field(default_factory=list)
+    paired_first_primary: List[dict] = field(default_factory=list)
+    paired_second_primary: List[dict] = field(default_factory=list)
+    unpaired_secondary: List[dict] = field(default_factory=list)
+    paired_first_secondary: List[dict] = field(default_factory=list)
+    paired_second_secondary: List[dict] = field(default_factory=list)
+    unmapped: List[dict] = field(default_factory=list)
+
+    #: the five slots every comparison walks (AvailableComparisons :52-56)
+    COMPARED_SLOTS = ("unpaired_primary", "paired_first_primary",
+                      "paired_second_primary", "paired_first_secondary",
+                      "paired_second_secondary")
+
+
+def bucket_reads(table: pa.Table) -> Dict[str, ReadBucket]:
+    """Group reads by name into ReadBuckets (ReadBucket.scala:83-104)."""
+    out: Dict[str, ReadBucket] = {}
+    flags = column_int64(table, "flags", 0)
+    rows = table.to_pylist()
+    for row, f in zip(rows, flags):
+        name = row["readName"]
+        b = out.setdefault(name, ReadBucket())
+        mapped = (f & S.FLAG_UNMAPPED) == 0
+        primary = (f & S.FLAG_SECONDARY) == 0
+        paired = (f & S.FLAG_PAIRED) != 0
+        first = (f & S.FLAG_FIRST_OF_PAIR) != 0
+        if not mapped:
+            b.unmapped.append(row)
+        elif primary:
+            if not paired:
+                b.unpaired_primary.append(row)
+            elif first:
+                b.paired_first_primary.append(row)
+            else:
+                b.paired_second_primary.append(row)
+        else:
+            if not paired:
+                b.unpaired_secondary.append(row)
+            elif first:
+                b.paired_first_secondary.append(row)
+            else:
+                b.paired_second_secondary.append(row)
+    return out
+
+
+# ----------------------------------------------------------------------
+# comparisons (AvailableComparisons.scala:25-177)
+# ----------------------------------------------------------------------
+
+class Comparison:
+    name = ""
+    description = ""
+
+    def matched_by_name(self, b1: ReadBucket, b2: ReadBucket) -> list:
+        raise NotImplementedError
+
+    def _slot_pairs(self, b1, b2):
+        for slot in ReadBucket.COMPARED_SLOTS:
+            yield getattr(b1, slot), getattr(b2, slot)
+
+
+class OverMatched(Comparison):
+    name = "overmatched"
+    description = "Checks that all buckets have exactly 0 or 1 records"
+
+    def matched_by_name(self, b1, b2):
+        ok = all(len(r1) == len(r2) and len(r1) <= 1
+                 for r1, r2 in self._slot_pairs(b1, b2))
+        return [ok]
+
+
+class DupeMismatch(Comparison):
+    name = "dupemismatch"
+    description = "Counts the number of common reads marked as duplicates"
+
+    def matched_by_name(self, b1, b2):
+        out = []
+        for r1, r2 in self._slot_pairs(b1, b2):
+            if len(r1) == len(r2) == 1:
+                out.append((
+                    1 if (r1[0]["flags"] & S.FLAG_DUPLICATE) else 0,
+                    1 if (r2[0]["flags"] & S.FLAG_DUPLICATE) else 0))
+        return out
+
+
+class MappedPosition(Comparison):
+    name = "positions"
+    description = "Counts how many reads align to the same genomic location"
+
+    def _distance(self, r1, r2):
+        if len(r1) != len(r2) or len(r1) > 1:
+            return -1
+        if len(r1) == 0:
+            return 0
+        a, b = r1[0], r2[0]
+        if a["referenceId"] != b["referenceId"]:
+            return -1
+        return abs((a["start"] or 0) - (b["start"] or 0))
+
+    def matched_by_name(self, b1, b2):
+        return [sum(self._distance(r1, r2)
+                    for r1, r2 in self._slot_pairs(b1, b2))]
+
+
+class MapQualityScores(Comparison):
+    name = "mapqs"
+    description = "Creates scatter plot of mapping quality scores across identical reads"
+
+    def matched_by_name(self, b1, b2):
+        out = []
+        for r1, r2 in self._slot_pairs(b1, b2):
+            if len(r1) == len(r2) == 1:
+                out.append((r1[0]["mapq"], r2[0]["mapq"]))
+        return out
+
+
+class BaseQualityScores(Comparison):
+    name = "baseqs"
+    description = "Creates scatter plots of base quality scores across identical positions in the same reads"
+
+    def matched_by_name(self, b1, b2):
+        out = []
+        for r1, r2 in self._slot_pairs(b1, b2):
+            if len(r1) == len(r2) == 1 and r1[0]["qual"] and r2[0]["qual"]:
+                out.extend((ord(a) - 33, ord(b) - 33)
+                           for a, b in zip(r1[0]["qual"], r2[0]["qual"]))
+        return out
+
+
+DEFAULT_COMPARISONS: Dict[str, Comparison] = {
+    c.name: c for c in (OverMatched(), DupeMismatch(), MappedPosition(),
+                        MapQualityScores(), BaseQualityScores())}
+
+
+def find_comparison(name: str) -> Comparison:
+    if name not in DEFAULT_COMPARISONS:
+        raise KeyError(f"Could not find comparison {name}")
+    return DEFAULT_COMPARISONS[name]
+
+
+# ----------------------------------------------------------------------
+# histogram aggregation (util/Histogram.scala:22-98)
+# ----------------------------------------------------------------------
+
+class Histogram:
+    def __init__(self, values=()):
+        self.value_to_count = Counter(values)
+
+    def count(self) -> int:
+        return sum(self.value_to_count.values())
+
+    def count_identical(self) -> int:
+        def identical(k):
+            if isinstance(k, tuple):
+                return k[0] == k[1]
+            if isinstance(k, bool):
+                return k
+            if isinstance(k, int):
+                return k == 0
+            return False
+        return sum(v for k, v in self.value_to_count.items() if identical(k))
+
+    def __add__(self, other: "Histogram") -> "Histogram":
+        h = Histogram()
+        h.value_to_count = self.value_to_count + other.value_to_count
+        return h
+
+    def write(self, stream) -> None:
+        stream.write("value\tcount\n")
+        for value, count in self.value_to_count.items():
+            stream.write(f"{value}\t{count}\n")
+
+
+# ----------------------------------------------------------------------
+# engine (ComparisonTraversalEngine.scala:40-90)
+# ----------------------------------------------------------------------
+
+class ComparisonTraversalEngine:
+    def __init__(self, table1: pa.Table, table2: pa.Table,
+                 seq_dict1=None, seq_dict2=None):
+        # reconcile contig ids across inputs before joining, like the
+        # reference's loadAdamFromPaths (AdamContext.scala:364-383)
+        if seq_dict1 is not None and seq_dict2 is not None:
+            from ..io.dispatch import remap_reference_ids
+            table2 = remap_reference_ids(table2, seq_dict2.map_to(seq_dict1))
+        self.named1 = bucket_reads(table1)
+        self.named2 = bucket_reads(table2)
+        names = set(self.named1) & set(self.named2)
+        self.joined = {n: (self.named1[n], self.named2[n]) for n in names}
+
+    def unique_to_1(self) -> int:
+        return len(set(self.named1) - set(self.named2))
+
+    def unique_to_2(self) -> int:
+        return len(set(self.named2) - set(self.named1))
+
+    def generate(self, comparison: Comparison) -> Dict[str, list]:
+        return {name: comparison.matched_by_name(b1, b2)
+                for name, (b1, b2) in self.joined.items()}
+
+    def aggregate(self, comparison: Comparison) -> Histogram:
+        h = Histogram()
+        for values in self.generate(comparison).values():
+            for v in values:
+                h.value_to_count[v] += 1
+        return h
+
+    def find(self, filters: Sequence["GeneratorFilter"]) -> List[str]:
+        out = []
+        for name, (b1, b2) in self.joined.items():
+            if all(any(f.passes(v)
+                       for v in f.comparison.matched_by_name(b1, b2))
+                   for f in filters):
+                out.append(name)
+        return sorted(out)
+
+
+# ----------------------------------------------------------------------
+# findreads filter grammar (cli/FindReads.scala:59-96)
+# ----------------------------------------------------------------------
+
+_FILTER_RE = re.compile(r"([^!=<>]+)(!=|=|<|>)(.*)")
+
+
+@dataclass
+class GeneratorFilter:
+    comparison: Comparison
+    op: str
+    value: object
+
+    def passes(self, v) -> bool:
+        target = self.value
+        if self.op == "=":
+            return v == target
+        if self.op == "!=":
+            return v != target
+        if self.op == "<":
+            return v < target
+        if self.op == ">":
+            return v > target
+        raise ValueError(self.op)
+
+
+def parse_filter(filter_string: str) -> GeneratorFilter:
+    m = _FILTER_RE.fullmatch(filter_string)
+    if not m:
+        raise ValueError(filter_string)
+    comparison = find_comparison(m.group(1))
+    raw = m.group(3)
+    if raw.startswith("("):
+        parts = raw.strip("()").split(",")
+        value: object = tuple(int(p) for p in parts)
+    elif raw in ("true", "false"):
+        value = raw == "true"
+    elif "." in raw:
+        value = float(raw)
+    else:
+        value = int(raw)
+    return GeneratorFilter(comparison, m.group(2), value)
+
+
+def parse_filters(filters: str) -> List[GeneratorFilter]:
+    return [parse_filter(f) for f in filters.split(";")]

@@ -1,0 +1,156 @@
+"""Genotype -> variant computation (``compute_variants``).
+
+Re-designs ``converters/GenotypesToVariantsConverter.scala`` (:37-494): group
+genotypes by (referenceId, position, allele) and synthesize per-site variant
+statistics.
+
+Math (:108-160):
+  * rms over phred values runs in success-probability space:
+    phred(rms(successProb(q)));
+  * variant quality = phred(1 - prod(successProb(GQ))) (:146,:346-352);
+  * allele frequency = genotypes carrying the allele / all genotypes at the
+    site.  (The reference passes the *group's* own length as the denominator
+    (:452-489 calls convertGenotypes with ``genotypes.length`` of the group),
+    so its AF is always 1.0 — we use the site total, which is what the code
+    comments say it wants.)
+
+Validation (:37-106): consistent reference name/allele/isReference within a
+group is always required; per-sample ploidy/haplotype checks run under
+``validate=True`` and raise under ``strict=True`` (the reference's
+-runValidation / -runStrictValidation knobs, ComputeVariants.scala:45-49).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+import pyarrow as pa
+
+from .. import schema as S
+from ..util.phred import (phred_to_success_probability,
+                          success_probability_to_phred)
+
+
+def _rms_phred(quals: List[int]) -> Optional[int]:
+    if not quals:
+        return None
+    probs = [phred_to_success_probability(q) for q in quals]
+    rms = math.sqrt(sum(p * p for p in probs) / len(probs))
+    return success_probability_to_phred(rms)
+
+
+def _variant_quality(gqs: List[int]) -> Optional[int]:
+    if not gqs:
+        return None
+    prod = 1.0
+    for q in gqs:
+        prod *= phred_to_success_probability(q)
+    return success_probability_to_phred(1.0 - prod)
+
+
+def _validate_sample(genotypes: List[dict], strict: bool,
+                     warnings: List[str]) -> None:
+    """Per-sample checks (validateGenotypes :37-106)."""
+    ploidies = {g["ploidy"] for g in genotypes if g["ploidy"] is not None}
+    msgs = []
+    if len(ploidies) > 1:
+        msgs.append(f"inconsistent ploidy {ploidies}")
+    haplos = [g["haplotypeNumber"] for g in genotypes
+              if g["haplotypeNumber"] is not None]
+    if len(haplos) != len(set(haplos)):
+        msgs.append("duplicate haplotype numbers")
+    for m in msgs:
+        full = f"sample {genotypes[0]['sampleId']}: {m}"
+        if strict:
+            raise ValueError(full)
+        warnings.append(full)
+
+
+def convert_genotypes(genotypes: pa.Table,
+                      existing_variants: Optional[pa.Table] = None,
+                      validate: bool = False,
+                      strict: bool = False) -> pa.Table:
+    """Genotype table -> variant table, one row per (site, allele)."""
+    g_rows = genotypes.to_pylist()
+    by_site: Dict[Tuple, List[dict]] = {}
+    for g in g_rows:
+        by_site.setdefault((g["referenceId"], g["position"]), []).append(g)
+
+    existing: Dict[Tuple, dict] = {}
+    if existing_variants is not None:
+        for v in existing_variants.to_pylist():
+            existing[(v["referenceId"], v["position"], v["variant"])] = v
+
+    warnings: List[str] = []
+    out_rows = []
+    for (refid, pos), site_gs in by_site.items():
+        total = len(site_gs)
+        by_allele: Dict[str, List[dict]] = {}
+        for g in site_gs:
+            by_allele.setdefault(g["allele"], []).append(g)
+        for allele, gs in by_allele.items():
+            # critical validation (:171-177): consistent within group
+            for field in ("referenceName", "referenceAllele", "isReference"):
+                if len({g[field] for g in gs}) > 1:
+                    raise ValueError(
+                        f"{field} inconsistent at {refid}:{pos} {allele}")
+            if validate:
+                by_sample: Dict[str, List[dict]] = {}
+                for g in gs:
+                    by_sample.setdefault(g["sampleId"], []).append(g)
+                for sample_gs in by_sample.values():
+                    _validate_sample(sample_gs, strict, warnings)
+
+            head = gs[0]
+            ex = existing.get((refid, pos, allele))
+            gqs = [g["genotypeQuality"] for g in gs
+                   if g["genotypeQuality"] is not None]
+            row = {
+                "referenceId": refid,
+                "referenceName": head["referenceName"],
+                "position": pos,
+                "referenceAllele": head["referenceAllele"],
+                "isReference": head["isReference"],
+                "variant": allele,
+                "variantType": head["alleleVariantType"],
+                "alleleFrequency": len(gs) / max(total, 1),
+                "quality": (ex["quality"] if ex is not None and
+                            ex.get("quality") is not None
+                            else _variant_quality(gqs)),
+                "id": (ex or {}).get("id"),
+                "filters": (ex or {}).get("filters"),
+                "filtersRun": (ex or {}).get("filtersRun", False),
+                "rmsBaseQuality": _rms_phred(
+                    [g["rmsBaseQuality"] for g in gs
+                     if g["rmsBaseQuality"] is not None and
+                     g["depth"] is not None]),
+                "siteRmsMappingQuality": _rms_phred(
+                    [g["rmsMapQuality"] for g in gs
+                     if g["rmsMapQuality"] is not None and
+                     g["depth"] is not None]),
+                "totalSiteMapCounts": (sum(g["depth"] for g in gs
+                                           if g["depth"] is not None)
+                                       if any(g["depth"] is not None
+                                              for g in gs) else None),
+                "siteMapQZeroCounts": (sum(g["readsMappedMapQ0"] for g in gs
+                                           if g["readsMappedMapQ0"] is not None)
+                                       if any(g["readsMappedMapQ0"] is not None
+                                              for g in gs) else None),
+                "numberOfSamplesWithData": len({g["sampleId"] for g in gs}),
+            }
+            if head.get("svType") is not None:
+                for f in ("svType", "svLength", "svIsPrecise", "svEnd",
+                          "svConfidenceIntervalStartLow",
+                          "svConfidenceIntervalStartHigh",
+                          "svConfidenceIntervalEndLow",
+                          "svConfidenceIntervalEndHigh"):
+                    row[f] = head[f]
+            out_rows.append(row)
+
+    for w in warnings:
+        print(f"validation warning: {w}")
+    cols = {name: [r.get(name) for r in out_rows]
+            for name in S.VARIANT_SCHEMA.names}
+    return pa.Table.from_pydict(cols, schema=S.VARIANT_SCHEMA)

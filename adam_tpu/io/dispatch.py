@@ -47,6 +47,46 @@ def load_reads(path: str, *, columns: Optional[Sequence[str]] = None,
     return table, None, None
 
 
+def remap_reference_ids(table: pa.Table, id_map) -> pa.Table:
+    """Rewrite referenceId/mateReferenceId through ``id_map`` — the
+    reference's broadcast remap (rich/RichRDDReferenceRecords.scala:26-48);
+    identity maps are skipped, like the reference."""
+    if all(k == v for k, v in id_map.items()):
+        return table
+    import numpy as np
+    for col in ("referenceId", "mateReferenceId"):
+        if col not in table.column_names:
+            continue
+        vals = table.column(col).to_pylist()
+        new = [id_map.get(v, v) if v is not None else None for v in vals]
+        table = table.set_column(table.column_names.index(col), col,
+                                 pa.array(new, pa.int32()))
+    return table
+
+
+def load_reads_union(paths):
+    """Load several read files into one table with reconciled contig ids
+    (AdamContext.loadAdamFromPaths :364-383): each file's dictionary maps
+    onto the accumulated one via SequenceDictionary.map_to, its ids are
+    rewritten, and the tables concatenate."""
+    acc_dict = None
+    tables = []
+    rg = None
+    for p in paths:
+        table, sd, rgd = load_reads(p)
+        if sd is None:
+            sd = sequence_dictionary_from_reads(table)
+        if acc_dict is None:
+            acc_dict = sd
+        else:
+            id_map = sd.map_to(acc_dict)
+            table = remap_reference_ids(table, id_map)
+            acc_dict = acc_dict + sd.remap(id_map)
+        rg = rg or rgd
+        tables.append(table)
+    return pa.concat_tables(tables), acc_dict, rg
+
+
 def record_group_dictionary_from_reads(table: pa.Table) -> RecordGroupDictionary:
     """Rebuild record groups from the denormalized recordGroup* columns
     (the reference reconstructs them by scan+dedup the same way it does the

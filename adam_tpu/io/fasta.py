@@ -1,0 +1,51 @@
+"""FASTA import -> ADAMNucleotideContig records.
+
+Re-designs ``converters/FastaConverter.scala:27-166`` (line-number-keyed
+Spark FASTA assembly) as a simple host parse: ``>name description`` headers,
+sequence lines concatenated, sequential contig ids.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import pyarrow as pa
+
+from .. import schema as S
+
+
+def read_fasta(path_or_file, url: Optional[str] = None) -> pa.Table:
+    if hasattr(path_or_file, "read"):
+        text = path_or_file.read()
+    else:
+        url = url or str(path_or_file)
+        with open(path_or_file, "rt") as f:
+            text = f.read()
+    names, descs, seqs = [], [], []
+    cur: list = []
+    for line in text.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        if line.startswith(">"):
+            header = line[1:].split(None, 1)
+            names.append(header[0] if header else "")
+            descs.append(header[1] if len(header) > 1 else None)
+            cur = []
+            seqs.append(cur)
+        else:
+            if not names:  # headerless FASTA: single anonymous contig
+                names.append("")
+                descs.append(None)
+                cur = []
+                seqs.append(cur)
+            cur.append(line.upper())
+    joined = ["".join(s) for s in seqs]
+    return pa.Table.from_pydict({
+        "contigName": names,
+        "contigId": list(range(len(names))),
+        "description": descs,
+        "sequence": joined,
+        "sequenceLength": [len(s) for s in joined],
+        "url": [url] * len(names),
+    }, schema=S.CONTIG_SCHEMA)

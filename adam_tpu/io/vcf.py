@@ -1,0 +1,287 @@
+"""VCF import/export.
+
+Re-designs ``converters/VariantContextConverter.scala`` (bidirectional
+ADAM <-> VCF, :44-575) without the Broad VariantContext/tribble stack: VCF
+text parses directly into the three Arrow tables (variants, genotypes,
+variant domains) and serializes back with the standard header lines the
+reference builds in ``util/VcfHeaderUtils.scala:34-131``.
+
+Field mapping (VariantContextConverter.convertVariants :126-300):
+  * one variant row per ALT allele; 0-based positions;
+  * variantType by ref/alt length (SNP/MNP/Insertion/Deletion, :207-226);
+  * INFO: AF (per-allele), NS -> numberOfSamplesWithData, DP ->
+    totalSiteMapCounts, MQ -> siteRmsMapQuality, MQ0 -> siteMapQZeroCounts,
+    BQ -> rmsBaseQuality;
+  * FILTER "." -> filtersRun=false, PASS -> empty filters.
+Genotypes (convertGenotypes :351-449): one row per sample per haplotype
+(GT entry), with phasing flags, GQ/DP/HQ/PL fields.
+Domains (convertDomains :474-504): DB/H2/H3/1000G INFO flags.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import pyarrow as pa
+
+from ..models.dictionary import SequenceDictionary, SequenceRecord
+from .. import schema as S
+
+
+def _variant_type(ref: str, alt: str) -> str:
+    if len(ref) == len(alt):
+        return "SNP" if len(ref) == 1 else "MNP"
+    return "Insertion" if len(alt) > len(ref) else "Deletion"
+
+
+def _info_dict(info: str) -> Dict[str, str]:
+    out = {}
+    if info == ".":
+        return out
+    for item in info.split(";"):
+        if "=" in item:
+            k, v = item.split("=", 1)
+            out[k] = v
+        else:
+            out[item] = ""
+    return out
+
+
+def read_vcf(path_or_file) -> Tuple[pa.Table, pa.Table, pa.Table,
+                                    SequenceDictionary]:
+    """Parse VCF -> (variants, genotypes, domains, sequence dictionary)."""
+    if hasattr(path_or_file, "read"):
+        lines = path_or_file.read().splitlines()
+    else:
+        with open(path_or_file, "rt") as f:
+            lines = f.read().splitlines()
+
+    contigs: List[SequenceRecord] = []
+    contig_by_name: Dict[str, SequenceRecord] = {}
+    samples: List[str] = []
+    v_rows, g_rows, d_rows = [], [], []
+
+    def intern_contig(name: str) -> SequenceRecord:
+        rec = contig_by_name.get(name)
+        if rec is None:
+            rec = SequenceRecord(len(contigs), name, 0)
+            contigs.append(rec)
+            contig_by_name[name] = rec
+        return rec
+    for line in lines:
+        if line.startswith("##"):
+            if line.startswith("##contig=<"):
+                fields = dict(kv.split("=", 1)
+                              for kv in line[10:].rstrip(">").split(",")
+                              if "=" in kv)
+                rec = SequenceRecord(
+                    len(contigs), fields.get("ID", f"c{len(contigs)}"),
+                    int(fields.get("length", 0)))
+                contigs.append(rec)
+                contig_by_name[rec.name] = rec
+            continue
+        if line.startswith("#CHROM"):
+            samples = line.split("\t")[9:]
+            continue
+        if not line.strip():
+            continue
+        f = line.split("\t")
+        chrom, pos1, vid, ref, alts, qual, filt, info = f[:8]
+        fmt = f[8].split(":") if len(f) > 8 else []
+        pos = int(pos1) - 1
+        info_d = _info_dict(info)
+        contig = intern_contig(chrom)
+        refid = contig.id
+        alt_list = [a for a in alts.split(",") if a != "."]
+        afs = info_d.get("AF", "").split(",") if "AF" in info_d else []
+
+        for ai, alt in enumerate(alt_list):
+            v_rows.append({
+                "referenceId": refid, "referenceName": chrom,
+                "referenceLength": contig.length or None,
+                "referenceUrl": contig.url,
+                "position": pos, "referenceAllele": ref, "variant": alt,
+                "variantType": _variant_type(ref, alt),
+                "id": vid if vid != "." else None,
+                "quality": int(float(qual)) if qual != "." else None,
+                "filters": None if filt in (".", "PASS") else filt,
+                "filtersRun": filt != ".",
+                "alleleFrequency": float(afs[ai]) if ai < len(afs) else None,
+                "rmsBaseQuality": int(info_d["BQ"]) if "BQ" in info_d else None,
+                "siteRmsMappingQuality": int(info_d["MQ"]) if "MQ" in info_d else None,
+                "siteMapQZeroCounts": int(info_d["MQ0"]) if "MQ0" in info_d else None,
+                "totalSiteMapCounts": int(info_d["DP"]) if "DP" in info_d else None,
+                "numberOfSamplesWithData": int(info_d["NS"]) if "NS" in info_d else None,
+            })
+        d_rows.append({
+            "referenceId": refid, "position": pos, "referenceAllele": ref,
+            "variant": alt_list[0] if alt_list else None,
+            "inDbSNP": "DB" in info_d, "inHM2": "H2" in info_d,
+            "inHM3": "H3" in info_d, "in1000G": "1000G" in info_d,
+        })
+
+        alleles = [ref] + alts.split(",")
+        for si, sample in enumerate(samples):
+            if 9 + si >= len(f):
+                continue
+            sd = dict(zip(fmt, f[9 + si].split(":")))
+            gt = sd.get("GT", ".")
+            phased = "|" in gt
+            idxs = gt.replace("|", "/").split("/")
+            hq = sd.get("HQ", "").split(",") if "HQ" in sd else []
+            for hi, ix in enumerate(idxs):
+                if ix == ".":
+                    continue
+                allele = alleles[int(ix)]
+                g_rows.append({
+                    "referenceId": refid, "referenceName": chrom,
+                    "position": pos, "sampleId": sample,
+                    "ploidy": len(idxs), "haplotypeNumber": hi,
+                    "allele": allele, "isReference": allele == ref,
+                    "referenceAllele": ref,
+                    "alleleVariantType": ("SNP" if allele == ref else
+                                          _variant_type(ref, allele)),
+                    "genotypeQuality": int(sd["GQ"]) if sd.get("GQ", "").isdigit() else None,
+                    "depth": int(sd["DP"]) if sd.get("DP", "").isdigit() else None,
+                    "phredLikelihoods": sd.get("PL"),
+                    "phredPosteriorLikelihoods": sd.get("GP"),
+                    "haplotypeQuality": (int(hq[hi])
+                                         if hi < len(hq) and hq[hi].isdigit()
+                                         else None),
+                    "isPhased": phased,
+                    "phaseSetId": sd.get("PS"),
+                    "phaseQuality": int(sd["PQ"]) if sd.get("PQ", "").isdigit() else None,
+                })
+
+    def table(rows, schema):
+        cols = {name: [r.get(name) for r in rows] for name in schema.names}
+        return pa.Table.from_pydict(cols, schema=schema)
+
+    return (table(v_rows, S.VARIANT_SCHEMA),
+            table(g_rows, S.GENOTYPE_SCHEMA),
+            table(d_rows, S.VARIANT_DOMAIN_SCHEMA),
+            SequenceDictionary(contigs))
+
+
+def write_vcf(variants: pa.Table, genotypes: pa.Table, path_or_file,
+              seq_dict: Optional[SequenceDictionary] = None) -> None:
+    """Serialize variant/genotype tables to VCF text (adam2vcf path;
+    header lines follow VcfHeaderUtils.scala:34-131)."""
+    close = False
+    if hasattr(path_or_file, "write"):
+        out = path_or_file
+    else:
+        out = open(path_or_file, "wt")
+        close = True
+    try:
+        out.write("##fileformat=VCFv4.1\n")
+        out.write('##INFO=<ID=NS,Number=1,Type=Integer,Description="Number of Samples With Data">\n')
+        out.write('##INFO=<ID=DP,Number=1,Type=Integer,Description="Total Depth">\n')
+        out.write('##INFO=<ID=AF,Number=A,Type=Float,Description="Allele Frequency">\n')
+        out.write('##INFO=<ID=MQ,Number=1,Type=Integer,Description="RMS Mapping Quality">\n')
+        out.write('##INFO=<ID=MQ0,Number=1,Type=Integer,Description="Number of MapQ=0 Reads">\n')
+        out.write('##FORMAT=<ID=GT,Number=1,Type=String,Description="Genotype">\n')
+        out.write('##FORMAT=<ID=GQ,Number=1,Type=Integer,Description="Genotype Quality">\n')
+        out.write('##FORMAT=<ID=DP,Number=1,Type=Integer,Description="Read Depth">\n')
+        out.write('##FORMAT=<ID=HQ,Number=2,Type=Integer,Description="Haplotype Quality">\n')
+        if seq_dict is None:
+            # rebuild contig lines from the denormalized variant columns
+            seen: Dict[str, int] = {}
+            for v in variants.select(["referenceName",
+                                      "referenceLength"]).to_pylist():
+                if v["referenceName"] is not None and \
+                        v["referenceName"] not in seen:
+                    seen[v["referenceName"]] = v["referenceLength"] or 0
+            seq_dict = SequenceDictionary(
+                SequenceRecord(i, n, l) for i, (n, l) in
+                enumerate(seen.items()))
+        for rec in seq_dict:
+            out.write(f"##contig=<ID={rec.name},length={rec.length}>\n")
+
+        g_by_site: Dict[Tuple, List[dict]] = {}
+        sample_order: List[str] = []
+        for g in genotypes.to_pylist():
+            g_by_site.setdefault((g["referenceName"], g["position"]),
+                                 []).append(g)
+            if g["sampleId"] not in sample_order:
+                sample_order.append(g["sampleId"])
+
+        header = ["#CHROM", "POS", "ID", "REF", "ALT", "QUAL", "FILTER",
+                  "INFO"]
+        if sample_order:
+            header += ["FORMAT"] + sample_order
+        out.write("\t".join(header) + "\n")
+
+        v_by_site: Dict[Tuple, List[dict]] = {}
+        for v in variants.to_pylist():
+            v_by_site.setdefault((v["referenceName"], v["position"]),
+                                 []).append(v)
+        # reference-only sites (ALT=".") exist only in the genotype table
+        for (chrom, pos), gs in g_by_site.items():
+            v_by_site.setdefault((chrom, pos), [])
+
+        for (chrom, pos), vs in sorted(v_by_site.items(),
+                                       key=lambda kv: (kv[0][0] or "",
+                                                       kv[0][1])):
+            site_genotypes = g_by_site.get((chrom, pos), [])
+            ref = vs[0]["referenceAllele"] if vs else \
+                site_genotypes[0]["referenceAllele"]
+            # reference-allele variant rows (computed site stats) never
+            # appear in ALT — only true alternate alleles do
+            alt_vs = [v for v in vs if not v.get("isReference")]
+            alts = [v["variant"] for v in alt_vs]
+            vs = alt_vs or vs
+            if not vs:
+                vs = [{key: None for key in
+                       ("id", "quality", "filters", "numberOfSamplesWithData",
+                        "totalSiteMapCounts", "alleleFrequency",
+                        "siteRmsMappingQuality", "siteMapQZeroCounts")} |
+                      {"filtersRun": False}]
+            info_parts = []
+            if vs[0]["numberOfSamplesWithData"] is not None:
+                info_parts.append(f"NS={vs[0]['numberOfSamplesWithData']}")
+            if vs[0]["totalSiteMapCounts"] is not None:
+                info_parts.append(f"DP={vs[0]['totalSiteMapCounts']}")
+            afs = [v["alleleFrequency"] for v in vs]
+            if any(a is not None for a in afs):
+                info_parts.append(
+                    "AF=" + ",".join("." if a is None else f"{a:g}"
+                                     for a in afs))
+            if vs[0]["siteRmsMappingQuality"] is not None:
+                info_parts.append(f"MQ={vs[0]['siteRmsMappingQuality']}")
+            if vs[0]["siteMapQZeroCounts"] is not None:
+                info_parts.append(f"MQ0={vs[0]['siteMapQZeroCounts']}")
+            filt = "." if not vs[0]["filtersRun"] else \
+                (vs[0]["filters"] or "PASS")
+            row = [chrom, str(pos + 1), vs[0]["id"] or ".", ref,
+                   ",".join(alts) or ".",
+                   str(vs[0]["quality"]) if vs[0]["quality"] is not None else ".",
+                   filt, ";".join(info_parts) or "."]
+
+            site_gs = g_by_site.get((chrom, pos), [])
+            if sample_order:
+                row.append("GT:GQ:DP")
+                alleles = [ref] + alts
+                for sample in sample_order:
+                    gs = sorted((g for g in site_gs
+                                 if g["sampleId"] == sample),
+                                key=lambda g: g["haplotypeNumber"] or 0)
+                    if not gs:
+                        row.append("./.")
+                        continue
+                    sep = "|" if gs[0]["isPhased"] else "/"
+                    calls = [str(alleles.index(g["allele"]))
+                             if g["allele"] in alleles else "." for g in gs]
+                    # pad half-calls back to declared ploidy ("0/." etc.)
+                    ploidy = gs[0]["ploidy"] or len(calls)
+                    calls += ["."] * (ploidy - len(calls))
+                    gt = sep.join(calls)
+                    gq = gs[0]["genotypeQuality"]
+                    dp = gs[0]["depth"]
+                    row.append(":".join([
+                        gt, str(gq) if gq is not None else ".",
+                        str(dp) if dp is not None else "."]))
+            out.write("\t".join(row) + "\n")
+    finally:
+        if close:
+            out.close()

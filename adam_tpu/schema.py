@@ -156,8 +156,11 @@ PILEUP_SCHEMA = pa.schema([
 VARIANT_SCHEMA = pa.schema([
     pa.field("referenceId", pa.int32()),
     pa.field("referenceName", pa.string()),
+    pa.field("referenceLength", pa.int64()),
+    pa.field("referenceUrl", pa.string()),
     pa.field("position", pa.int64()),
     pa.field("referenceAllele", pa.string()),
+    pa.field("isReference", pa.bool_()),
     pa.field("variant", pa.string()),
     pa.field("variantType", pa.string()),
     pa.field("id", pa.string()),
@@ -166,11 +169,13 @@ VARIANT_SCHEMA = pa.schema([
     pa.field("filtersRun", pa.bool_()),
     pa.field("alleleFrequency", pa.float64()),
     pa.field("rmsBaseQuality", pa.int32()),
-    pa.field("siteRmsMapQuality", pa.int32()),
+    pa.field("siteRmsMappingQuality", pa.int32()),
     pa.field("siteMapQZeroCounts", pa.int32()),
     pa.field("totalSiteMapCounts", pa.int32()),
     pa.field("numberOfSamplesWithData", pa.int32()),
-    pa.field("structuralVariantType", pa.string()),
+    pa.field("totalNumberOfSamplesCount", pa.int32()),
+    pa.field("strandBias", pa.float64()),
+    pa.field("svType", pa.string()),
     pa.field("svLength", pa.int64()),
     pa.field("svIsPrecise", pa.bool_()),
     pa.field("svEnd", pa.int64()),

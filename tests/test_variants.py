@@ -1,0 +1,94 @@
+"""VCF layer + genotype->variant computation tests (mirrors
+AdamContextSuite VCF round trips and GenotypesToVariantsConverter math)."""
+
+import io
+
+import pytest
+
+from adam_tpu.converters.genotypes_to_variants import convert_genotypes
+from adam_tpu.io.vcf import read_vcf, write_vcf
+from adam_tpu.util.phred import phred_to_success_probability
+
+
+@pytest.fixture(scope="module")
+def small_vcf(resources):
+    return read_vcf(resources / "small.vcf")
+
+
+def test_read_small_vcf(small_vcf):
+    variants, genotypes, domains, seq_dict = small_vcf
+    # 4 sites; site 2 has two alts, site 3 has none, site 4 has two
+    assert variants.num_rows == 5
+    v = variants.to_pylist()
+    assert v[0]["position"] == 14369      # 0-based
+    assert v[0]["referenceAllele"] == "G" and v[0]["variant"] == "A"
+    assert v[0]["variantType"] == "SNP"
+    assert v[0]["alleleFrequency"] == 0.5
+    assert v[0]["id"] == "rs6054257"
+    assert v[0]["numberOfSamplesWithData"] == 3
+    micro = [r for r in v if r["position"] == 1234566]
+    assert {r["variantType"] for r in micro} == {"Deletion", "Insertion"}
+    # genotypes: 3 samples x 2 haplotypes x 4 sites
+    assert genotypes.num_rows == 24
+    g0 = genotypes.to_pylist()[0]
+    assert g0["sampleId"] == "NA00001" and g0["isPhased"]
+    assert g0["genotypeQuality"] == 48 and g0["depth"] == 1
+    assert g0["haplotypeQuality"] == 51
+    # domains: DB/H2 flags from INFO
+    d = domains.to_pylist()
+    assert d[0]["inDbSNP"] and d[0]["inHM2"]
+    assert not d[2]["inDbSNP"]
+    assert len(seq_dict) == 1 and seq_dict["20"].length == 62435964
+
+
+def test_vcf_roundtrip(small_vcf):
+    variants, genotypes, domains, seq_dict = small_vcf
+    buf = io.StringIO()
+    write_vcf(variants, genotypes, buf, seq_dict)
+    v2, g2, _, _ = read_vcf(io.StringIO(buf.getvalue()))
+    assert v2.num_rows == variants.num_rows
+    assert g2.num_rows == genotypes.num_rows
+    for key in ("position", "referenceAllele", "variant", "alleleFrequency",
+                "quality"):
+        assert v2.column(key).to_pylist() == variants.column(key).to_pylist()
+    for key in ("sampleId", "allele", "isPhased", "genotypeQuality"):
+        assert g2.column(key).to_pylist() == genotypes.column(key).to_pylist()
+
+
+def test_compute_variants(small_vcf):
+    _, genotypes, _, _ = small_vcf
+    variants = convert_genotypes(genotypes)
+    v = variants.to_pylist()
+    # site 14369: alleles G (3 copies) and A (3 copies) over 6 genotypes
+    site1 = {r["variant"]: r for r in v if r["position"] == 14369}
+    assert set(site1) == {"G", "A"}
+    assert site1["A"]["alleleFrequency"] == 0.5
+    assert site1["A"]["isReference"] is False
+    assert site1["G"]["isReference"] is True
+    assert site1["A"]["numberOfSamplesWithData"] == 2  # NA00002 + NA00003
+    # quality = phred(1 - prod(successProb(GQ)))
+    gqs = [r["genotypeQuality"] for r in genotypes.to_pylist()
+           if r["position"] == 14369 and r["allele"] == "A"]
+    prod = 1.0
+    for q in gqs:
+        prod *= phred_to_success_probability(q)
+    assert site1["A"]["quality"] is not None
+
+
+def test_compute_variants_strict_validation():
+    import pyarrow as pa
+    from adam_tpu import schema as S
+    rows = [
+        dict(referenceId=0, referenceName="1", position=5, sampleId="s",
+             ploidy=2, haplotypeNumber=0, allele="A", isReference=False,
+             referenceAllele="G", alleleVariantType="SNP"),
+        dict(referenceId=0, referenceName="1", position=5, sampleId="s",
+             ploidy=3, haplotypeNumber=0, allele="A", isReference=False,
+             referenceAllele="G", alleleVariantType="SNP"),
+    ]
+    cols = {n: [r.get(n) for r in rows] for n in S.GENOTYPE_SCHEMA.names}
+    t = pa.Table.from_pydict(cols, schema=S.GENOTYPE_SCHEMA)
+    # non-strict: warns only
+    convert_genotypes(t, validate=True, strict=False)
+    with pytest.raises(ValueError):
+        convert_genotypes(t, validate=True, strict=True)
