@@ -53,9 +53,10 @@ def main(argv=None) -> int:
     if not getattr(args, "_cmd", None):
         parser.print_help()
         return 1
+    from ..errors import FormatError
     try:
         return args._cmd.run(args) or 0
-    except (FileNotFoundError, IsADirectoryError) as e:
+    except (FileNotFoundError, IsADirectoryError, FormatError) as e:
         print(f"adam-tpu {args.command}: {e}", file=sys.stderr)
         return 2
 

@@ -1,0 +1,11 @@
+"""User-facing error types.
+
+``FormatError`` marks malformed *input data* (bad BAM magic, unparseable
+SAM/VCF, cigar overflow...).  The CLI catches it and prints a one-line
+message; genuine programming errors (arbitrary ValueError etc.) keep their
+tracebacks.
+"""
+
+
+class FormatError(ValueError):
+    pass

@@ -1,0 +1,302 @@
+"""BAM binary format: BGZF + BAM record codec.
+
+The reference leans on samtools-jar + hadoop-bam for BAM decoding
+(pom.xml:299-345, AdamContext.adamBamLoad :122-137).  This module implements
+the format natively: BGZF block decompression, the BAM header (SAM spec
+section 4.2), and the alignment record codec — producing the same Arrow
+reads table as the SAM parser, via the same converter semantics
+(SAMRecordConverter.scala:25-146).
+
+A writer is included (round-trip tests + bam export).  The hot-path C++
+version of this decoder lives in ``native/``; this pure-Python codec is the
+reference implementation and fallback.
+"""
+
+from __future__ import annotations
+
+import gzip
+import struct
+import zlib
+from typing import List, Optional, Tuple
+
+import numpy as np
+import pyarrow as pa
+
+from ..models.dictionary import (RecordGroup, RecordGroupDictionary,
+                                 SequenceDictionary, SequenceRecord)
+from .. import schema as S
+
+_BAM_MAGIC = b"BAM\x01"
+#: 4-bit seq codes (SAM spec 4.2.3)
+SEQ_CODE = "=ACMGRSVTWYHKDBN"
+_CIGAR_OPS = "MIDNSHP=X"
+_MAPQ_UNKNOWN = 255
+
+
+def _decompress_bgzf(data: bytes) -> bytes:
+    """BGZF is a series of gzip members; decompress them all."""
+    out = []
+    pos = 0
+    while pos < len(data):
+        d = zlib.decompressobj(wbits=31)
+        out.append(d.decompress(data[pos:]))
+        consumed = len(data) - pos - len(d.unused_data)
+        if consumed <= 0:
+            break
+        pos += consumed
+    return b"".join(out)
+
+
+def _parse_tag_value(data: bytes, off: int) -> Tuple[str, str, object, int]:
+    """One optional field -> (tag, sam_type, value, new_offset)."""
+    tag = data[off:off + 2].decode()
+    typ = chr(data[off + 2])
+    off += 3
+    if typ == "A":
+        return tag, "A", chr(data[off]), off + 1
+    int_types = {"c": ("b", 1), "C": ("B", 1), "s": ("<h", 2), "S": ("<H", 2),
+                 "i": ("<i", 4), "I": ("<I", 4)}
+    if typ in int_types:
+        fmt, size = int_types[typ]
+        return tag, "i", struct.unpack_from(fmt, data, off)[0], off + size
+    if typ == "f":
+        return tag, "f", struct.unpack_from("<f", data, off)[0], off + 4
+    if typ in "ZH":
+        end = data.index(b"\x00", off)
+        return tag, typ, data[off:end].decode(), end + 1
+    if typ == "B":
+        sub = chr(data[off])
+        n = struct.unpack_from("<i", data, off + 1)[0]
+        fmt, size = {"c": ("b", 1), "C": ("B", 1), "s": ("<h", 2),
+                     "S": ("<H", 2), "i": ("<i", 4), "I": ("<I", 4),
+                     "f": ("<f", 4)}[sub]
+        vals = [struct.unpack_from(fmt, data, off + 5 + i * size)[0]
+                for i in range(n)]
+        value = sub + "," + ",".join(str(v) for v in vals)
+        return tag, "B", value, off + 5 + n * size
+    raise ValueError(f"unknown BAM tag type {typ!r}")
+
+
+def load_decompressed(path) -> bytes:
+    with open(path, "rb") as f:
+        raw = f.read()
+    return _decompress_bgzf(raw) if raw[:2] == b"\x1f\x8b" else raw
+
+
+def parse_header(data: bytes, path="<bytes>"
+                 ) -> Tuple[SequenceDictionary, RecordGroupDictionary, int]:
+    """BAM header -> (seq dict, record groups, first-record offset)."""
+    from ..errors import FormatError
+    if data[:4] != _BAM_MAGIC:
+        raise FormatError(f"{path}: not a BAM file")
+    l_text = struct.unpack_from("<i", data, 4)[0]
+    text = data[8:8 + l_text].decode("utf-8", "replace").rstrip("\x00")
+    off = 8 + l_text
+    n_ref = struct.unpack_from("<i", data, off)[0]
+    off += 4
+    refs: List[SequenceRecord] = []
+    for i in range(n_ref):
+        l_name = struct.unpack_from("<i", data, off)[0]
+        name = data[off + 4:off + 4 + l_name - 1].decode()
+        l_ref = struct.unpack_from("<i", data, off + 4 + l_name)[0]
+        refs.append(SequenceRecord(i, name, l_ref))
+        off += 8 + l_name
+    rg_dict = RecordGroupDictionary.from_sam_header_lines(
+        l for l in text.splitlines() if l.startswith("@RG"))
+    return SequenceDictionary(refs), rg_dict, off
+
+
+def read_bam(path) -> Tuple[pa.Table, SequenceDictionary,
+                            RecordGroupDictionary]:
+    """Parse a BAM file into (reads table, seq dict, record groups)."""
+    data = load_decompressed(path)
+    seq_dict, rg_dict, off = parse_header(data, path)
+
+    cols = {name: [] for name in S.READ_SCHEMA.names}
+
+    def put(**kwargs):
+        for name in S.READ_SCHEMA.names:
+            cols[name].append(kwargs.get(name))
+
+    n = len(data)
+    while off < n:
+        block_size = struct.unpack_from("<i", data, off)[0]
+        rec_end = off + 4 + block_size
+        (ref_id, pos, l_read_name, mapq, _bin, n_cigar, flag, l_seq,
+         next_ref, next_pos, _tlen) = struct.unpack_from("<iiBBHHHiiii",
+                                                         data, off + 4)
+        p = off + 36
+        read_name = data[p:p + l_read_name - 1].decode()
+        p += l_read_name
+        cigar_parts = []
+        for ci in range(n_cigar):
+            v = struct.unpack_from("<I", data, p + ci * 4)[0]
+            cigar_parts.append(f"{v >> 4}{_CIGAR_OPS[v & 0xF]}")
+        p += n_cigar * 4
+        seq_bytes = data[p:p + (l_seq + 1) // 2]
+        seq_chars = []
+        for i in range(l_seq):
+            b = seq_bytes[i // 2]
+            code = (b >> 4) if i % 2 == 0 else (b & 0xF)
+            seq_chars.append(SEQ_CODE[code])
+        p += (l_seq + 1) // 2
+        quals = data[p:p + l_seq]
+        p += l_seq
+        qual = None if (l_seq == 0 or quals[:1] == b"\xff") else \
+            "".join(chr(q + 33) for q in quals)
+
+        attrs = []
+        md = None
+        rg_name = None
+        while p < rec_end:
+            tag, typ, value, p = _parse_tag_value(data, p)
+            if tag == "MD":
+                md = str(value)
+            elif tag == "RG":
+                rg_name = str(value)
+            else:
+                attrs.append(f"{tag}:{typ}:{value}")
+
+        row = dict(
+            readName=read_name if read_name != "*" else None,
+            flags=flag,
+            sequence="".join(seq_chars) if l_seq else None,
+            qual=qual,
+            cigar="".join(cigar_parts) or None,
+            mismatchingPositions=md,
+            attributes="\t".join(attrs) if attrs else None,
+        )
+        if ref_id >= 0:
+            rec = seq_dict[ref_id]
+            row.update(referenceId=ref_id, referenceName=rec.name,
+                       referenceLength=rec.length, referenceUrl=rec.url)
+            if pos >= 0:
+                row["start"] = pos
+            if mapq != _MAPQ_UNKNOWN:
+                row["mapq"] = mapq
+        if next_ref >= 0:
+            rec = seq_dict[next_ref]
+            row.update(mateReferenceId=next_ref, mateReference=rec.name,
+                       mateReferenceLength=rec.length,
+                       mateReferenceUrl=rec.url)
+            if next_pos >= 0:
+                row["mateAlignmentStart"] = next_pos
+        if rg_name is not None and rg_name in rg_dict:
+            g = rg_dict[rg_name]
+            row.update(
+                recordGroupName=g.id, recordGroupId=g.index,
+                recordGroupSequencingCenter=g.sequencing_center,
+                recordGroupDescription=g.description,
+                recordGroupRunDateEpoch=g.run_date_epoch,
+                recordGroupFlowOrder=g.flow_order,
+                recordGroupKeySequence=g.key_sequence,
+                recordGroupLibrary=g.library,
+                recordGroupPredictedMedianInsertSize=g.predicted_median_insert_size,
+                recordGroupPlatform=g.platform,
+                recordGroupPlatformUnit=g.platform_unit,
+                recordGroupSample=g.sample)
+        put(**row)
+        off = rec_end
+
+    return pa.Table.from_pydict(cols, schema=S.READ_SCHEMA), seq_dict, rg_dict
+
+
+# ----------------------------------------------------------------------
+# writer (round-trip testing + export)
+# ----------------------------------------------------------------------
+
+_SEQ_TO_CODE = {c: i for i, c in enumerate(SEQ_CODE)}
+_CIGAR_TO_CODE = {c: i for i, c in enumerate(_CIGAR_OPS)}
+
+
+def _bgzf_block(payload: bytes) -> bytes:
+    comp = zlib.compressobj(6, zlib.DEFLATED, -15)
+    deflated = comp.compress(payload) + comp.flush()
+    bsize = len(deflated) + 25 + 1
+    header = (b"\x1f\x8b\x08\x04\x00\x00\x00\x00\x00\xff" +
+              struct.pack("<HBBHH", 6, 66, 67, 2, bsize - 1))
+    return header + deflated + struct.pack("<II", zlib.crc32(payload),
+                                           len(payload))
+
+
+_BGZF_EOF = bytes.fromhex(
+    "1f8b08040000000000ff0600424302001b0003000000000000000000")
+
+
+def write_bam(table: pa.Table, seq_dict: SequenceDictionary, path,
+              rg_dict: Optional[RecordGroupDictionary] = None) -> None:
+    import io as _io
+    from .sam import write_sam
+    # header text: reuse the SAM writer's header
+    buf = _io.StringIO()
+    write_sam(table.slice(0, 0), seq_dict, buf, rg_dict)
+    text = buf.getvalue().encode()
+
+    body = bytearray()
+    body += _BAM_MAGIC
+    body += struct.pack("<i", len(text))
+    body += text
+    recs = list(seq_dict)
+    body += struct.pack("<i", len(recs))
+    for rec in recs:
+        name = rec.name.encode() + b"\x00"
+        body += struct.pack("<i", len(name)) + name + \
+            struct.pack("<i", rec.length)
+
+    for row in table.to_pylist():
+        name = (row["readName"] or "*").encode() + b"\x00"
+        seq = row["sequence"] or ""
+        qual = row["qual"]
+        from ..util.mdtag import parse_cigar
+        cigar = parse_cigar(row["cigar"]) if row["cigar"] else []
+        rec = bytearray()
+        ref_id = row["referenceId"] if row["referenceId"] is not None else -1
+        pos = row["start"] if row["start"] is not None else -1
+        mate_ref = row["mateReferenceId"] \
+            if row["mateReferenceId"] is not None else -1
+        mate_pos = row["mateAlignmentStart"] \
+            if row["mateAlignmentStart"] is not None else -1
+        mapq = row["mapq"] if row["mapq"] is not None else _MAPQ_UNKNOWN
+        rec += struct.pack("<iiBBHHHiiii", ref_id, pos, len(name), mapq,
+                           0, len(cigar), row["flags"] or 0, len(seq),
+                           mate_ref, mate_pos, 0)
+        rec += name
+        for length, op in cigar:
+            rec += struct.pack("<I", (length << 4) | _CIGAR_TO_CODE[op])
+        packed = bytearray()
+        for i in range(0, len(seq), 2):
+            hi = _SEQ_TO_CODE.get(seq[i].upper(), 15) << 4
+            lo = _SEQ_TO_CODE.get(seq[i + 1].upper(), 15) \
+                if i + 1 < len(seq) else 0
+            packed.append(hi | lo)
+        rec += bytes(packed)
+        rec += bytes((ord(c) - 33 for c in qual)) if qual \
+            else b"\xff" * len(seq)
+        if row["mismatchingPositions"] is not None:
+            rec += b"MDZ" + row["mismatchingPositions"].encode() + b"\x00"
+        if row["recordGroupName"] is not None:
+            rec += b"RGZ" + row["recordGroupName"].encode() + b"\x00"
+        for field in (row["attributes"] or "").split("\t"):
+            if not field:
+                continue
+            tag, typ, value = field.split(":", 2)
+            if typ == "i":
+                iv = int(value)
+                # values beyond int32 came from unsigned BAM tags
+                rec += tag.encode() + (b"i" + struct.pack("<i", iv)
+                                       if iv < (1 << 31)
+                                       else b"I" + struct.pack("<I", iv))
+            elif typ == "f":
+                rec += tag.encode() + b"f" + struct.pack("<f", float(value))
+            elif typ == "A":
+                rec += tag.encode() + b"A" + value[:1].encode()
+            else:  # Z/H/B all serialize as text
+                rec += tag.encode() + b"Z" + value.encode() + b"\x00"
+        body += struct.pack("<i", len(rec)) + bytes(rec)
+
+    with open(path, "wb") as f:
+        data = bytes(body)
+        # 64 KB payload blocks (BGZF limit is 65536 per block)
+        for lo in range(0, len(data), 0xFF00):
+            f.write(_bgzf_block(data[lo:lo + 0xFF00]))
+        f.write(_BGZF_EOF)

@@ -1,0 +1,86 @@
+"""Fast path: BAM file -> ReadBatch without Arrow materialization.
+
+Uses the native packer (native/packer.c) when built, falling back to the
+pure-Python codec.  This is the input pipeline for device-only workloads
+(flagstat, markdup scoring, BQSR pass 1): scalar columns, decoded bases,
+quals and cigars land directly in the padded SoA tensors the kernels
+consume.  Header parsing (dictionaries) stays in Python — it is tiny.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import numpy as np
+
+from ..models.dictionary import RecordGroupDictionary, SequenceDictionary
+from ..packing import ReadBatch, _round_up
+from .bam import load_decompressed, parse_header
+
+try:
+    import adam_tpu_native as _native
+except ImportError:  # pragma: no cover - toolchain-less environments
+    _native = None
+
+
+def native_available() -> bool:
+    return _native is not None
+
+
+def bam_to_read_batch(path, *, pad_rows_to: int = 1,
+                      bucket_len: int = 0, max_cigar_ops: int = 0
+                      ) -> Tuple[ReadBatch, SequenceDictionary,
+                                 RecordGroupDictionary]:
+    """Decode + pack a whole BAM in one native pass."""
+    if _native is None:
+        # fallback path never touches the file twice: read_bam does the one
+        # decompression + parse
+        from ..packing import pack_cigars, pack_reads
+        from ..util.mdtag import parse_cigar
+        from .bam import read_bam
+        table, sd, rg = read_bam(path)
+        cig_ops = max_cigar_ops or max(
+            (len(parse_cigar(c)) for c in table.column("cigar").to_pylist()
+             if c), default=1)
+        return pack_reads(table, pad_rows_to=pad_rows_to,
+                          bucket_len=bucket_len,
+                          max_cigar_ops=max(cig_ops, 1)), sd, rg
+
+    data = load_decompressed(path)
+    seq_dict, rg_dict, first = parse_header(data, path)
+
+    n, max_len, max_cig = _native.scan(data, first)
+    L = bucket_len or _round_up(max(int(max_len), 1), 128)
+    C = max_cigar_ops or max(int(max_cig), 1)
+    n_pad = _round_up(max(n, 1), pad_rows_to)
+
+    cols = dict(
+        flags=np.zeros(n_pad, np.int32),
+        refid=np.full(n_pad, -1, np.int32),
+        start=np.full(n_pad, -1, np.int32),
+        mapq=np.full(n_pad, -1, np.int32),
+        mate_refid=np.full(n_pad, -1, np.int32),
+        mate_start=np.full(n_pad, -1, np.int32),
+        read_len=np.zeros(n_pad, np.int32),
+        bases=np.full((n_pad, L), -1, np.int8),
+        quals=np.full((n_pad, L), -1, np.int8),
+        cigar_ops=np.full((n_pad, C), -1, np.int8),
+        cigar_lens=np.zeros((n_pad, C), np.int32),
+        n_cigar=np.zeros(n_pad, np.int32),
+    )
+    packed = _native.pack(
+        data, first, cols["flags"][:n], cols["refid"][:n], cols["start"][:n],
+        cols["mapq"][:n], cols["mate_refid"][:n], cols["mate_start"][:n],
+        cols["read_len"][:n], cols["bases"][:n].reshape(-1),
+        cols["quals"][:n].reshape(-1), cols["cigar_ops"][:n].reshape(-1),
+        cols["cigar_lens"][:n].reshape(-1), cols["n_cigar"][:n], L, C)
+    if packed != n:
+        raise ValueError(f"packed {packed} of {n} records")
+
+    batch = ReadBatch(
+        valid=np.arange(n_pad) < n,
+        row_index=np.where(np.arange(n_pad) < n, np.arange(n_pad),
+                           -1).astype(np.int32),
+        read_group=np.full(n_pad, -1, np.int32),  # RG tags stay in the
+        **cols)                                   # Arrow path
+    return batch, seq_dict, rg_dict

@@ -1,0 +1,144 @@
+"""Distributed genome-binned pileup counting — the sequence-parallel path.
+
+The reference aggregates pileups with a position-keyed Spark shuffle
+(PileupAggregator.scala:200-218) and scales along the genome axis by binning
++ boundary-read duplication (AdamRDDFunctions.scala:144-191, SURVEY.md §5).
+Here the genome axis maps onto the device mesh: the partitioner assigns each
+read (duplicated across bin boundaries) to a genome bin, each device owns one
+contiguous stripe of bins, and per-position evidence is a scatter-add into a
+dense [bin_span, channels] count tensor — ``segment_sum`` instead of a
+shuffle.  Under ``shard_map`` every device counts its own stripe; no
+collective is needed for the counts themselves (positions are disjoint by
+construction), which is exactly why the binning layout is the right one for
+ICI-poor topologies.
+
+Channels: A, C, G, T, other-base, insertion, deletion, soft-clip,
+reverse-strand, coverage, base-quality sum, mapq sum.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import schema as S
+from ..ops.pileup import pileup_walk
+from ..ops import cigar as C
+
+CHANNELS = ("A", "C", "G", "T", "N_OTHER", "INS", "DEL", "CLIP",
+            "REVERSE", "COVERAGE", "QUAL_SUM", "MAPQ_SUM")
+N_CHANNELS = len(CHANNELS)
+(CH_A, CH_C, CH_G, CH_T, CH_OTHER, CH_INS, CH_DEL, CH_CLIP,
+ CH_REVERSE, CH_COVERAGE, CH_QUAL, CH_MAPQ) = range(N_CHANNELS)
+
+
+@partial(jax.jit, static_argnames=("bin_span", "max_len"))
+def pileup_count_kernel(bases, quals, start, flags, mapq, valid,
+                        cigar_ops, cigar_lens, bin_start,
+                        bin_span: int, max_len: int) -> jnp.ndarray:
+    """[bin_span, N_CHANNELS] int32 counts for positions
+    [bin_start, bin_start + bin_span).
+
+    Per-base events follow the pileup walk (Reads2PileupProcessor semantics):
+    M bases count their base channel + coverage + qual/mapq sums; I bases
+    count INS at the pinned position; S bases count CLIP; D positions
+    (reference-consuming, no read base) count DEL via the cigar geometry.
+    """
+    N, L = bases.shape
+    pos, op, off_in_op, op_len, in_read = pileup_walk(
+        start, cigar_ops, cigar_lens, max_len)
+    rel = pos - bin_start
+    ok = in_read & valid[:, None] & (rel >= 0) & (rel < bin_span)
+    rel = jnp.clip(rel, 0, bin_span - 1)
+
+    is_m = (op == S.CIGAR_M) | (op == S.CIGAR_EQ) | (op == S.CIGAR_X)
+    is_i = op == S.CIGAR_I
+    is_s = op == S.CIGAR_S
+    reverse = ((flags & S.FLAG_REVERSE) != 0)[:, None]
+
+    out = jnp.zeros((bin_span, N_CHANNELS), jnp.int32)
+
+    def add(out, mask, channel, val=1):
+        w = jnp.where(ok & mask, val, 0).astype(jnp.int32)
+        return out.at[rel.reshape(-1), channel].add(w.reshape(-1))
+
+    base_ch = jnp.where(bases < 4, bases, CH_OTHER)
+    w_base = jnp.where(ok & is_m, 1, 0).astype(jnp.int32)
+    out = out.at[rel.reshape(-1), base_ch.reshape(-1)].add(w_base.reshape(-1))
+    out = add(out, is_m, CH_COVERAGE)
+    out = add(out, is_m, CH_QUAL, jnp.maximum(quals, 0).astype(jnp.int32))
+    out = add(out, is_m, CH_MAPQ,
+              jnp.broadcast_to(jnp.maximum(mapq, 0)[:, None], (N, L)))
+    out = add(out, is_m & reverse, CH_REVERSE)
+    out = add(out, is_i, CH_INS)
+    out = add(out, is_s, CH_CLIP)
+
+    # deletion events: reference positions consumed by D ops.  Each D op
+    # covers [d_start, d_start + len); instead of expanding per position
+    # (which would bound the deletion length) we scatter a +1/-1 difference
+    # pair clipped to the bin and prefix-sum — any deletion length in O(span).
+    ref_adv = C._table(np.array(S.CIGAR_CONSUMES_REF, np.int32),
+                       cigar_ops) * cigar_lens
+    ref_before = jnp.cumsum(ref_adv, axis=1) - ref_adv
+    d_start = start[:, None] + ref_before - bin_start          # [N, Cc]
+    d_end = d_start + cigar_lens
+    is_d = (cigar_ops == S.CIGAR_D) & valid[:, None]
+    lo = jnp.clip(d_start, 0, bin_span)
+    hi = jnp.clip(d_end, 0, bin_span)
+    w_d = jnp.where(is_d & (hi > lo), 1, 0).astype(jnp.int32)
+    diff = jnp.zeros((bin_span + 1,), jnp.int32)
+    diff = diff.at[lo.reshape(-1)].add(w_d.reshape(-1))
+    diff = diff.at[hi.reshape(-1)].add(-w_d.reshape(-1))
+    out = out.at[:, CH_DEL].add(jnp.cumsum(diff)[:bin_span])
+    return out
+
+
+def sharded_pileup_counts(mesh, bin_span: int, max_len: int):
+    """shard_map-compiled binned pileup: each device counts its own genome
+    stripe.  Inputs are sharded on the read axis (reads pre-routed to their
+    bin's device by the partitioner) plus a per-device bin_start scalar."""
+    from jax.sharding import PartitionSpec as P
+    from .mesh import READS_AXIS
+    spec = P(READS_AXIS)
+
+    def step(bases, quals, start, flags, mapq, valid, cigar_ops, cigar_lens,
+             bin_start):
+        return pileup_count_kernel(bases, quals, start, flags, mapq, valid,
+                                   cigar_ops, cigar_lens, bin_start[0],
+                                   bin_span=bin_span, max_len=max_len)
+
+    fn = jax.shard_map(step, mesh=mesh,
+                       in_specs=(spec,) * 8 + (spec,),
+                       out_specs=spec)
+    return jax.jit(fn)
+
+
+def route_reads_to_stripes(refid, start, end, mapped, valid,
+                           stripe_starts: np.ndarray,
+                           stripe_span: int):
+    """Host-side reshard for one contig: assign reads (duplicated across
+    stripe boundaries) to per-device genome stripes.
+
+    ``stripe_starts`` are the genome positions where each device's stripe
+    begins (stripe d covers [stripe_starts[d], stripe_starts[d]+stripe_span)).
+    Returns (gather_rows, device_of_row): a read appears once per stripe its
+    [start, end) span touches — the boundary-duplication trick
+    (AdamRDDFunctions.scala:175-183).
+    """
+    rows_ok = np.flatnonzero(np.asarray(mapped) & np.asarray(valid))
+    s = np.asarray(start)[rows_ok]
+    e = np.maximum(np.asarray(end)[rows_ok], s + 1)
+    lo = np.searchsorted(stripe_starts, s, side="right") - 1
+    hi = np.searchsorted(stripe_starts, e - 1, side="right") - 1
+    lo = np.clip(lo, 0, len(stripe_starts) - 1)
+    hi = np.clip(hi, lo, len(stripe_starts) - 1)
+    n_stripes = (hi - lo + 1).astype(np.int64)
+    gather = rows_ok[np.repeat(np.arange(len(rows_ok)), n_stripes)]
+    offsets = np.arange(int(n_stripes.sum())) - \
+        np.repeat(np.cumsum(n_stripes) - n_stripes, n_stripes)
+    device = (lo[np.repeat(np.arange(len(rows_ok)), n_stripes)] + offsets)
+    return gather.astype(np.int64), device.astype(np.int32)

@@ -1,0 +1,202 @@
+/*
+ * Native BAM record packer: decompressed BAM bytes -> fixed-shape
+ * structure-of-arrays batches (the ReadBatch device layout).
+ *
+ * This is the TPU-first replacement for the reference's JVM BAM stack
+ * (samtools-jar + hadoop-bam, pom.xml:299-345): where the reference
+ * deserializes every record into a SAMRecord object and converts it to an
+ * Avro ADAMRecord (SAMRecordConverter.scala:25-146), this packer writes each
+ * alignment's scalar fields, 4-bit-decoded bases, quals and cigar ops
+ * straight into preallocated int8/int32 column buffers that ship to the
+ * device unchanged.  No per-record Python objects, no string materialization.
+ *
+ * Exposed via the CPython C API (module adam_tpu_native):
+ *   scan(data, offset)  -> (n_records, max_read_len, max_cigar_ops)
+ *   pack(data, offset, flags, refid, start, mapq, mate_refid, mate_start,
+ *        read_len, bases, quals, cigar_ops, cigar_lens, n_cigar,
+ *        max_len, max_cigar) -> n_packed
+ *
+ * Buffers are writable 1-D contiguous views (numpy arrays); 2-D arrays pass
+ * as their flattened views with known row strides (max_len / max_cigar).
+ */
+
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+#include <stdint.h>
+#include <string.h>
+
+/* BAM 4-bit seq code ("=ACMGRSVTWYHKDBN") -> adam_tpu base code
+ * (schema.BASES "ACGTNUXKMRYSWBVHD"); '=' maps to N. */
+static const int8_t SEQ4_TO_CODE[16] = {
+    4, 0, 1, 8, 2, 9, 11, 14, 3, 12, 10, 15, 7, 16, 13, 4};
+
+static int32_t rd_i32(const uint8_t *p) {
+    int32_t v;
+    memcpy(&v, p, 4);
+    return v; /* BAM is little-endian; so are our targets */
+}
+
+static uint32_t rd_u32(const uint8_t *p) {
+    uint32_t v;
+    memcpy(&v, p, 4);
+    return v;
+}
+
+static uint16_t rd_u16(const uint8_t *p) {
+    uint16_t v;
+    memcpy(&v, p, 2);
+    return v;
+}
+
+/* ---------------------------------------------------------------- scan */
+static PyObject *scan(PyObject *self, PyObject *args) {
+    Py_buffer data;
+    Py_ssize_t offset;
+    if (!PyArg_ParseTuple(args, "y*n", &data, &offset))
+        return NULL;
+    const uint8_t *buf = (const uint8_t *)data.buf;
+    Py_ssize_t n = data.len;
+    Py_ssize_t pos = offset;
+    long long count = 0, max_len = 0, max_cigar = 0;
+    while (pos + 4 <= n) {
+        int32_t block = rd_i32(buf + pos);
+        if (block < 32 || pos + 4 + block > n) break;
+        uint8_t l_name = buf[pos + 4 + 8];
+        uint16_t n_cig = rd_u16(buf + pos + 4 + 12);
+        int32_t l_seq = rd_i32(buf + pos + 4 + 16);
+        /* the variable-length sections must fit inside the record block */
+        if (l_seq < 0 ||
+            32LL + l_name + 4LL * n_cig + (l_seq + 1LL) / 2 + l_seq > block)
+            break;
+        if (l_seq > max_len) max_len = l_seq;
+        if (n_cig > max_cigar) max_cigar = n_cig;
+        count++;
+        pos += 4 + block;
+    }
+    PyBuffer_Release(&data);
+    return Py_BuildValue("(LLL)", count, max_len, max_cigar);
+}
+
+/* ---------------------------------------------------------------- pack */
+static PyObject *pack(PyObject *self, PyObject *args) {
+    Py_buffer data, flags, refid, start, mapq, mate_refid, mate_start,
+        read_len, bases, quals, cigar_ops, cigar_lens, n_cigar;
+    Py_ssize_t offset, max_len, max_cigar;
+    if (!PyArg_ParseTuple(args, "y*nw*w*w*w*w*w*w*w*w*w*w*w*nn",
+                          &data, &offset, &flags, &refid, &start, &mapq,
+                          &mate_refid, &mate_start, &read_len, &bases,
+                          &quals, &cigar_ops, &cigar_lens, &n_cigar,
+                          &max_len, &max_cigar))
+        return NULL;
+
+    const uint8_t *buf = (const uint8_t *)data.buf;
+    Py_ssize_t n = data.len;
+    int32_t *f_flags = (int32_t *)flags.buf;
+    int32_t *f_refid = (int32_t *)refid.buf;
+    int32_t *f_start = (int32_t *)start.buf;
+    int32_t *f_mapq = (int32_t *)mapq.buf;
+    int32_t *f_mref = (int32_t *)mate_refid.buf;
+    int32_t *f_mstart = (int32_t *)mate_start.buf;
+    int32_t *f_rlen = (int32_t *)read_len.buf;
+    int8_t *f_bases = (int8_t *)bases.buf;
+    int8_t *f_quals = (int8_t *)quals.buf;
+    int8_t *f_cops = (int8_t *)cigar_ops.buf;
+    int32_t *f_clens = (int32_t *)cigar_lens.buf;
+    int32_t *f_ncig = (int32_t *)n_cigar.buf;
+    Py_ssize_t capacity = flags.len / (Py_ssize_t)sizeof(int32_t);
+
+    Py_ssize_t pos = offset;
+    Py_ssize_t i = 0;
+    int error = 0;
+    Py_BEGIN_ALLOW_THREADS
+    while (pos + 4 <= n && i < capacity) {
+        int32_t block = rd_i32(buf + pos);
+        if (block < 32 || pos + 4 + block > n) break;
+        const uint8_t *r = buf + pos + 4;
+        int32_t ref = rd_i32(r);
+        int32_t p0 = rd_i32(r + 4);
+        uint8_t l_name = r[8];
+        uint8_t mq = r[9];
+        uint16_t n_cig = rd_u16(r + 12);
+        uint16_t flag = rd_u16(r + 14);
+        int32_t l_seq = rd_i32(r + 16);
+        int32_t nref = rd_i32(r + 20);
+        int32_t npos = rd_i32(r + 24);
+
+        if (l_seq > max_len || n_cig > max_cigar) { error = 1; break; }
+        /* bounds: never read past the record block on corrupt input */
+        if (l_seq < 0 ||
+            32LL + l_name + 4LL * n_cig + (l_seq + 1LL) / 2 + l_seq > block) {
+            error = 1;
+            break;
+        }
+
+        f_flags[i] = flag;
+        f_refid[i] = ref;
+        f_start[i] = (ref >= 0 && p0 >= 0) ? p0 : -1;
+        f_mapq[i] = (ref >= 0 && mq != 255) ? mq : -1;
+        f_mref[i] = nref;
+        f_mstart[i] = (nref >= 0 && npos >= 0) ? npos : -1;
+        f_rlen[i] = l_seq;
+
+        const uint8_t *c = r + 32 + l_name;
+        int8_t *co = f_cops + i * max_cigar;
+        int32_t *cl = f_clens + i * max_cigar;
+        for (int k = 0; k < n_cig; k++) {
+            uint32_t v = rd_u32(c + 4 * (Py_ssize_t)k);
+            co[k] = (int8_t)(v & 0xF);
+            cl[k] = (int32_t)(v >> 4);
+        }
+        for (int k = n_cig; k < max_cigar; k++) { co[k] = -1; cl[k] = 0; }
+        f_ncig[i] = n_cig;
+
+        const uint8_t *sq = c + 4 * (Py_ssize_t)n_cig;
+        int8_t *b = f_bases + i * max_len;
+        for (int k = 0; k < l_seq; k++) {
+            uint8_t byte = sq[k >> 1];
+            uint8_t code = (k & 1) ? (byte & 0xF) : (byte >> 4);
+            b[k] = SEQ4_TO_CODE[code];
+        }
+        for (int k = l_seq; k < max_len; k++) b[k] = -1;
+
+        const uint8_t *ql = sq + (l_seq + 1) / 2;
+        int8_t *q = f_quals + i * max_len;
+        int missing = (l_seq > 0 && ql[0] == 0xFF);
+        for (int k = 0; k < l_seq; k++)
+            q[k] = missing ? -1 : (int8_t)ql[k];
+        for (int k = l_seq; k < max_len; k++) q[k] = -1;
+
+        i++;
+        pos += 4 + block;
+    }
+    Py_END_ALLOW_THREADS
+
+    PyBuffer_Release(&data); PyBuffer_Release(&flags);
+    PyBuffer_Release(&refid); PyBuffer_Release(&start);
+    PyBuffer_Release(&mapq); PyBuffer_Release(&mate_refid);
+    PyBuffer_Release(&mate_start); PyBuffer_Release(&read_len);
+    PyBuffer_Release(&bases); PyBuffer_Release(&quals);
+    PyBuffer_Release(&cigar_ops); PyBuffer_Release(&cigar_lens);
+    PyBuffer_Release(&n_cigar);
+    if (error) {
+        PyErr_SetString(PyExc_ValueError,
+                        "record exceeds max_len/max_cigar bounds");
+        return NULL;
+    }
+    return PyLong_FromSsize_t(i);
+}
+
+static PyMethodDef methods[] = {
+    {"scan", scan, METH_VARARGS,
+     "scan(data, offset) -> (n_records, max_read_len, max_cigar_ops)"},
+    {"pack", pack, METH_VARARGS,
+     "pack(data, offset, *column_buffers, max_len, max_cigar) -> n_packed"},
+    {NULL, NULL, 0, NULL}};
+
+static struct PyModuleDef module = {
+    PyModuleDef_HEAD_INIT, "adam_tpu_native",
+    "Native BAM -> packed-tensor batch codec", -1, methods};
+
+PyMODINIT_FUNC PyInit_adam_tpu_native(void) {
+    return PyModule_Create(&module);
+}

@@ -1,0 +1,18 @@
+"""Build script: the native BAM packer extension.
+
+The extension is optional — if the toolchain is unavailable the framework
+falls back to the pure-Python BAM codec (adam_tpu/io/bam.py).
+"""
+
+from setuptools import Extension, setup
+
+setup(
+    ext_modules=[
+        Extension(
+            "adam_tpu_native",
+            sources=["native/packer.c"],
+            extra_compile_args=["-O3", "-std=c99"],
+            optional=True,
+        )
+    ]
+)
