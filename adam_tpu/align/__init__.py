@@ -1,0 +1,4 @@
+from .smithwaterman import (SWAlignment, SWParams, smith_waterman,
+                            sw_score_batch)
+
+__all__ = ["SWAlignment", "SWParams", "smith_waterman", "sw_score_batch"]

@@ -1,0 +1,156 @@
+"""Smith-Waterman local alignment, vectorized for TPU.
+
+The reference ships only an abstract scaffold (algorithms/smithwaterman/
+SmithWaterman.scala:21-34): the scoring-matrix fill exists but its inner loop
+runs ``for (j <- i until y)`` — upper-triangular only — and indexes one past
+the end of both strings (SmithWatermanGapScoringFromFn.scala:44-51); no
+``trackback`` implementation or call site exists anywhere.  This module is
+the completed algorithm, designed tensor-first:
+
+* The DP fill is O(|x|) ``lax.scan`` steps, each a fully vectorized row
+  update.  The within-row insertion chain ``H[i,j] = max(cand[j],
+  H[i,j-1] + w_ins)`` — the recurrence that usually forces a scalar inner
+  loop — is a max-plus prefix maximum, computed in one shot as
+  ``cummax(cand - j*w_ins) + j*w_ins``.  That keeps each step a wide VPU op
+  instead of a length-|y| dependency chain.
+* Scores/end positions are available batch-wise on device (``sw_score_batch``
+  via ``vmap``) without materializing matrices; full traceback materializes
+  the [|x|+1, |y|+1] score matrix and walks it on host (traceback is an
+  O(|x|+|y|) pointer chase — sequential by nature and never the hot loop;
+  realignment's consensus sweep handles the batched case).
+
+Cell preference on score ties is diagonal > up (gap in y) > left (gap in x),
+so alignments favor M runs; the reference never defined one (its fill keeps
+the value only).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+NEG = -1e30
+
+
+@dataclass(frozen=True)
+class SWParams:
+    """Constant gap scoring (SmithWatermanConstantGapScoring.scala:21-40)."""
+    w_match: float = 1.0
+    w_mismatch: float = -1.0 / 3.0
+    w_insert: float = -1.0 / 3.0   # gap in x (consumes y)
+    w_delete: float = -1.0 / 3.0   # gap in y (consumes x)
+
+
+@dataclass
+class SWAlignment:
+    score: float
+    x_start: int          # 0-based start of the aligned window in x
+    y_start: int
+    cigar_x: str          # x against y: M = diag, I = consumes x, D = consumes y
+    cigar_y: str          # mirror (I and D swapped)
+    aligned_x: str        # x window with '_' at gaps
+    aligned_y: str
+
+
+def _fill(x_u8, y_u8, x_len, y_len, p: SWParams):
+    """Return the full [Lx+1, Ly+1] local-alignment score matrix.
+
+    x_u8 [Lx], y_u8 [Ly] padded int8 codes; positions >= the lengths are
+    masked out of play (their candidates pinned to 0, the local-alignment
+    floor), so padding never changes the matrix inside the live region.
+    """
+    Lx, Ly = x_u8.shape[0], y_u8.shape[0]
+    j = jnp.arange(Ly + 1, dtype=jnp.float32)
+    j_alive = j[1:] <= y_len  # column j consumes y[j-1]
+
+    def row(h_prev, xi):
+        xc, i = xi
+        alive = (i <= x_len)
+        sub = jnp.where(xc == y_u8, p.w_match, p.w_mismatch)
+        diag = h_prev[:-1] + sub
+        up = h_prev[1:] + p.w_delete
+        cand = jnp.maximum(jnp.maximum(diag, up), 0.0)
+        cand = jnp.where(j_alive & alive, cand, 0.0)
+        # insertion chain via max-plus prefix max
+        chain = jax.lax.cummax(cand - j[1:] * p.w_insert) + j[1:] * p.w_insert
+        h = jnp.concatenate([jnp.zeros((1,), jnp.float32),
+                             jnp.maximum(cand, jnp.where(j_alive, chain, 0.0))])
+        return h, h
+
+    h0 = jnp.zeros((Ly + 1,), jnp.float32)
+    xs = (x_u8, jnp.arange(1, Lx + 1))
+    _, rows = jax.lax.scan(row, h0, xs)
+    return jnp.concatenate([h0[None, :], rows], axis=0)
+
+
+def _score_end(x_u8, y_u8, x_len, y_len, p: SWParams):
+    m = _fill(x_u8, y_u8, x_len, y_len, p)
+    flat = jnp.argmax(m)
+    return m.max(), flat // m.shape[1], flat % m.shape[1]
+
+
+@partial(jax.jit, static_argnames=("p",))
+def sw_score_batch(xs_u8, x_lens, ys_u8, y_lens, p: SWParams = SWParams()):
+    """Batched best-local-alignment (score, end_x, end_y) — no matrices kept.
+
+    xs_u8 [N, Lx], ys_u8 [N, Ly] padded; lengths [N].  This is the device
+    path for filtering/scoring many pairs at once.
+    """
+    return jax.vmap(lambda x, xl, yv, yl: _score_end(x, yv, xl, yl, p))(
+        xs_u8, x_lens, ys_u8, y_lens)
+
+
+def _encode(s: str) -> np.ndarray:
+    """Raw bytes as codes: equality on codes is exactly equality on
+    characters, for any alphabet (IUPAC codes, lowercase soft-masking)."""
+    return np.frombuffer(s.encode(), np.uint8).copy()
+
+
+def _rle(ops: str) -> str:
+    out = []
+    i = 0
+    while i < len(ops):
+        j = i
+        while j < len(ops) and ops[j] == ops[i]:
+            j += 1
+        out.append(f"{j - i}{ops[i]}")
+        i = j
+    return "".join(out)
+
+
+def smith_waterman(x: str, y: str, p: SWParams = SWParams()) -> SWAlignment:
+    """Align two strings locally; full cigars + gapped alignment strings."""
+    if not x or not y:
+        return SWAlignment(0.0, 0, 0, "", "", "", "")
+    xv, yv = _encode(x), _encode(y)
+    m = np.asarray(_fill(jnp.asarray(xv), jnp.asarray(yv),
+                         jnp.int32(len(x)), jnp.int32(len(y)), p))
+    i, j = np.unravel_index(np.argmax(m), m.shape)
+    score = float(m[i, j])
+    # the max-plus cummax in _fill leaves float-epsilon residue, so cell
+    # provenance is re-derived with a tolerance, not exact equality
+    eps = 1e-4
+    ops_x, ax, ay = [], [], []
+    while i > 0 and j > 0 and m[i, j] > eps:
+        sub = p.w_match if xv[i - 1] == yv[j - 1] else p.w_mismatch
+        if abs(m[i, j] - (m[i - 1, j - 1] + sub)) <= eps:
+            ops_x.append("M"); ax.append(x[i - 1]); ay.append(y[j - 1])
+            i, j = i - 1, j - 1
+        elif abs(m[i, j] - (m[i - 1, j] + p.w_delete)) <= eps:
+            ops_x.append("I"); ax.append(x[i - 1]); ay.append("_")
+            i -= 1
+        elif abs(m[i, j] - (m[i, j - 1] + p.w_insert)) <= eps:
+            ops_x.append("D"); ax.append("_"); ay.append(y[j - 1])
+            j -= 1
+        else:  # numerical dead end: stop rather than emit a wrong op
+            break
+    ops_x.reverse(); ax.reverse(); ay.reverse()
+    sx = "".join(ops_x)
+    sy = sx.replace("I", "d").replace("D", "I").replace("d", "D")
+    return SWAlignment(score, i, j, _rle(sx), _rle(sy),
+                       "".join(ax), "".join(ay))

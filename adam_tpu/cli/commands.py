@@ -78,37 +78,52 @@ class TransformCommand(Command):
         p.add_argument("-realignIndels", action="store_true")
         p.add_argument("-sort_reads", action="store_true")
         p.add_argument("-parts", type=int, default=1)
+        p.add_argument("-timing", action="store_true",
+                       help="print a per-stage wall-clock report")
+        p.add_argument("-trace_dir", default=None,
+                       help="write a JAX device profiler trace here")
 
     def run(self, args) -> int:
+        from ..instrument import device_trace, report, stage
         from ..io.dispatch import load_reads, sequence_dictionary_from_reads
         from ..io.parquet import save_table
 
-        table, seq_dict, rg_dict = load_reads(args.input)
-        if args.mark_duplicate_reads:
-            from ..ops.markdup import mark_duplicates
-            table = mark_duplicates(table)
-        if args.recalibrate_base_qualities:
-            from ..bqsr.recalibrate import recalibrate_base_qualities
-            from ..models.snptable import SnpTable
-            snp = SnpTable.from_vcf(args.dbsnp_sites) if args.dbsnp_sites \
-                else None
-            table = recalibrate_base_qualities(table, snp)
-        if args.realignIndels:
-            from ..realign.realigner import realign_indels
-            table = realign_indels(table)
-        if args.sort_reads:
-            from ..ops.sort import sort_reads
-            table = sort_reads(table)
-        if args.output.endswith(".sam"):
-            from ..io.dispatch import record_group_dictionary_from_reads
-            from ..io.sam import write_sam
-            if seq_dict is None:
-                seq_dict = sequence_dictionary_from_reads(table)
-            if rg_dict is None:
-                rg_dict = record_group_dictionary_from_reads(table)
-            write_sam(table, seq_dict, args.output, rg_dict)
-        else:
-            save_table(table, args.output, n_parts=args.parts)
+        with device_trace(args.trace_dir):
+            with stage("load"):
+                table, seq_dict, rg_dict = load_reads(args.input)
+            if args.mark_duplicate_reads:
+                from ..ops.markdup import mark_duplicates
+                with stage("markdup", sync=True):
+                    table = mark_duplicates(table)
+            if args.recalibrate_base_qualities:
+                from ..bqsr.recalibrate import recalibrate_base_qualities
+                from ..models.snptable import SnpTable
+                snp = SnpTable.from_vcf(args.dbsnp_sites) \
+                    if args.dbsnp_sites else None
+                with stage("bqsr", sync=True):
+                    table = recalibrate_base_qualities(table, snp)
+            if args.realignIndels:
+                from ..realign.realigner import realign_indels
+                with stage("realign", sync=True):
+                    table = realign_indels(table)
+            if args.sort_reads:
+                from ..ops.sort import sort_reads
+                with stage("sort", sync=True):
+                    table = sort_reads(table)
+            with stage("save"):
+                if args.output.endswith(".sam"):
+                    from ..io.dispatch import \
+                        record_group_dictionary_from_reads
+                    from ..io.sam import write_sam
+                    if seq_dict is None:
+                        seq_dict = sequence_dictionary_from_reads(table)
+                    if rg_dict is None:
+                        rg_dict = record_group_dictionary_from_reads(table)
+                    write_sam(table, seq_dict, args.output, rg_dict)
+                else:
+                    save_table(table, args.output, n_parts=args.parts)
+        if args.timing:
+            print(report().format())
         print(f"wrote {table.num_rows} reads to {args.output}")
         return 0
 

@@ -54,6 +54,9 @@ def main(argv=None) -> int:
         parser.print_help()
         return 1
     from ..errors import FormatError
+    from ..instrument import log_invocation
+    log_invocation(["adam-tpu"] + list(argv if argv is not None
+                                       else sys.argv[1:]))
     try:
         return args._cmd.run(args) or 0
     except (FileNotFoundError, IsADirectoryError, FormatError) as e:

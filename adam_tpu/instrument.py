@@ -1,0 +1,122 @@
+"""Tracing and stage timing.
+
+The reference has no profiling subsystem — observability is log4j messages
+plus stage-progress printlns (RealignIndels.scala:442-450,
+RecalibrateBaseQualities.scala:37-44) and whatever the Spark web UI shows;
+AdamMain logs its argv for reproduction (AdamMain.scala:55,66-71).  This
+module is the TPU framework's own: nested wall-clock stage timers that
+accumulate into a report, and an opt-in bridge to the JAX device profiler
+(jax.profiler) for XLA-level traces viewable in Perfetto/TensorBoard.
+
+Usage::
+
+    with stage("markdup"):
+        table = mark_duplicates(table)
+    print(report().format())
+
+Timers are process-global (one pipeline per process, matching the CLI) and
+cheap enough to leave on; the JAX profiler is only started when a trace
+directory is given (it interacts with compilation caching).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import sys
+import time
+from dataclasses import dataclass, field
+from typing import Dict, Iterator, List, Optional
+
+
+@dataclass
+class StageStats:
+    name: str
+    calls: int = 0
+    seconds: float = 0.0
+    children: "Dict[str, StageStats]" = field(default_factory=dict)
+
+
+@dataclass
+class PipelineReport:
+    root: StageStats = field(default_factory=lambda: StageStats("pipeline"))
+    _stack: List[StageStats] = field(default_factory=list)
+
+    def format(self) -> str:
+        lines = ["stage timing:"]
+        total = sum(c.seconds for c in self.root.children.values())
+
+        def walk(node: StageStats, depth: int) -> None:
+            pct = 100.0 * node.seconds / total if total else 0.0
+            lines.append(f"  {'  ' * depth}{node.name:<24s}"
+                         f"{node.seconds:9.3f} s  x{node.calls:<4d}{pct:5.1f}%")
+            for c in node.children.values():
+                walk(c, depth + 1)
+
+        for c in self.root.children.values():
+            walk(c, 0)
+        return "\n".join(lines)
+
+    def reset(self) -> None:
+        self.root = StageStats("pipeline")
+        self._stack = []
+
+
+_REPORT = PipelineReport()
+
+
+def report() -> PipelineReport:
+    return _REPORT
+
+
+@contextlib.contextmanager
+def stage(name: str, *, sync: bool = False) -> Iterator[None]:
+    """Time a pipeline stage; nests.  ``sync=True`` drains pending device
+    work first so the stage is charged its own device time, not its
+    predecessor's (async dispatch otherwise misattributes)."""
+    parent = _REPORT._stack[-1] if _REPORT._stack else _REPORT.root
+    node = parent.children.setdefault(name, StageStats(name))
+    if sync:
+        _block_on_device()
+    t0 = time.perf_counter()
+    _REPORT._stack.append(node)
+    try:
+        yield
+    finally:
+        if sync:
+            _block_on_device()
+        _REPORT._stack.pop()
+        node.calls += 1
+        node.seconds += time.perf_counter() - t0
+
+
+def _block_on_device() -> None:
+    try:
+        import jax
+        (jax.device_put(0) + 0).block_until_ready()
+    except Exception:  # pragma: no cover - no backend
+        pass
+
+
+@contextlib.contextmanager
+def device_trace(trace_dir: Optional[str]) -> Iterator[None]:
+    """XLA-level profiler trace (Perfetto/TensorBoard) when a dir is given."""
+    if not trace_dir:
+        yield
+        return
+    import jax
+    jax.profiler.start_trace(trace_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+        print(f"device trace written to {trace_dir}", file=sys.stderr)
+
+
+def log_invocation(argv: Optional[List[str]] = None) -> None:
+    """AdamMain parity: record the exact argv for reproduction
+    (AdamMain.scala:55,66-71)."""
+    argv = sys.argv if argv is None else argv
+    if os.environ.get("ADAM_TPU_QUIET"):
+        return
+    print(f"adam-tpu invocation: {' '.join(argv)}", file=sys.stderr)

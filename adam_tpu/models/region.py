@@ -1,0 +1,147 @@
+"""Reference-coordinate primitives: positions, oriented positions, regions.
+
+Mirrors the semantics of models/ReferencePosition.scala:25-207 and
+models/ReferenceRegion.scala:25-177 — 0-based coordinates, [start, end)
+half-open regions, UNMAPPED sentinel, and the interval algebra (overlap,
+containment, distance, adjacency, hull, merge).  Alongside the scalar API is
+a vectorized form (`merge_intervals`) used wherever the reference fell back
+to driver-side tail recursion over sorted targets
+(RealignmentTargetFinder.scala:54-71).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional, Tuple
+
+import numpy as np
+
+UNMAPPED_REFID = -1
+
+
+@dataclass(frozen=True, order=True)
+class ReferencePosition:
+    """A point on the reference.  Ordering is (refId, pos)."""
+    ref_id: int
+    pos: int
+
+    @classmethod
+    def unmapped(cls) -> "ReferencePosition":
+        return cls(UNMAPPED_REFID, -1)
+
+    @property
+    def is_mapped(self) -> bool:
+        return self.ref_id != UNMAPPED_REFID
+
+
+@dataclass(frozen=True, order=True)
+class OrientedPosition:
+    """Position + strand; orders by position then strand
+    (ReferencePositionWithOrientation ReferencePosition.scala:25-56)."""
+    position: ReferencePosition
+    negative_strand: bool
+
+
+@dataclass(frozen=True, order=True)
+class ReferenceRegion:
+    """[start, end) half-open region; ordering is (refId, start, end)."""
+    ref_id: int
+    start: int
+    end: int
+
+    def __post_init__(self):
+        if self.start < 0 or self.end < self.start:
+            raise ValueError(f"bad region [{self.start}, {self.end})")
+
+    @property
+    def width(self) -> int:
+        return self.end - self.start
+
+    def overlaps(self, other: "ReferenceRegion") -> bool:
+        return (self.ref_id == other.ref_id and self.end > other.start
+                and self.start < other.end)
+
+    def contains_point(self, p: ReferencePosition) -> bool:
+        return (self.ref_id == p.ref_id and self.start <= p.pos
+                and self.end > p.pos)
+
+    def contains(self, other: "ReferenceRegion") -> bool:
+        return (self.ref_id == other.ref_id and self.start <= other.start
+                and self.end >= other.end)
+
+    def distance_to_point(self, p: ReferencePosition) -> Optional[int]:
+        """0 if inside; >=1 outside; None across references."""
+        if self.ref_id != p.ref_id:
+            return None
+        if p.pos < self.start:
+            return self.start - p.pos
+        if p.pos >= self.end:
+            return p.pos - self.end + 1
+        return 0
+
+    def distance(self, other: "ReferenceRegion") -> Optional[int]:
+        """0 when overlapping, 1 when abutting, else gap+1; None across refs."""
+        if self.ref_id != other.ref_id:
+            return None
+        if self.overlaps(other):
+            return 0
+        if other.start >= self.end:
+            return other.start - self.end + 1
+        return self.start - other.end + 1
+
+    def is_adjacent(self, other: "ReferenceRegion") -> bool:
+        return self.distance(other) == 1
+
+    def hull(self, other: "ReferenceRegion") -> "ReferenceRegion":
+        if self.ref_id != other.ref_id:
+            raise ValueError("hull across references")
+        return ReferenceRegion(self.ref_id, min(self.start, other.start),
+                               max(self.end, other.end))
+
+    def merge(self, other: "ReferenceRegion") -> "ReferenceRegion":
+        if not (self.overlaps(other) or self.is_adjacent(other)):
+            raise ValueError("merge requires overlap or adjacency")
+        return self.hull(other)
+
+
+def region_of_read(ref_id: int, start: int, end: int,
+                   mapped: bool) -> Optional[ReferenceRegion]:
+    """Read alignment span as a region; the reference builds the *inclusive*
+    end then +1 into half-open (ReferenceRegion.scala:34-40), so `end` here
+    is the usual exclusive alignment end."""
+    if not mapped:
+        return None
+    return ReferenceRegion(ref_id, start, end)
+
+
+def merge_intervals(ref_ids: np.ndarray, starts: np.ndarray,
+                    ends: np.ndarray, *, adjacency: bool = False
+                    ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Merge overlapping (optionally also abutting) intervals, vectorized.
+
+    Replaces the reference's collect-to-driver + tail-recursive joinTargets
+    fold: sort by (ref, start), then a cummax-based run segmentation — a new
+    run starts wherever an interval's start exceeds the running max end of
+    everything before it.  O(n log n) in numpy, no Python loop.
+    Returns merged (ref_ids, starts, ends) in sorted order.
+    """
+    n = len(starts)
+    if n == 0:
+        return (np.empty(0, ref_ids.dtype), np.empty(0, starts.dtype),
+                np.empty(0, ends.dtype))
+    order = np.lexsort((starts, ref_ids))
+    r, s, e = ref_ids[order], starts[order], ends[order]
+    # lift each contig into its own disjoint coordinate band so one running
+    # cummax works across the whole sorted array
+    band = int(ends.max()) + 2
+    off = r.astype(np.int64) * band
+    s64, e64 = s.astype(np.int64) + off, e.astype(np.int64) + off
+    run_max = np.maximum.accumulate(e64)
+    thresh = s64 if adjacency else s64 + 1  # adjacency: end==start still merges
+    new_run = np.ones(n, bool)
+    new_run[1:] = thresh[1:] > run_max[:-1]
+    seg = np.cumsum(new_run) - 1
+    starts_out = s[new_run]
+    refs_out = r[new_run]
+    ends_out = np.maximum.reduceat(e, np.flatnonzero(new_run))
+    return refs_out, starts_out, ends_out

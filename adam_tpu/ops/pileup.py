@@ -126,6 +126,9 @@ def reads_to_pileups(table: pa.Table, batch: Optional[ReadBatch] = None
     """adamRecords2Pileup (AdamRDDFunctions.scala:130-142) — reads table ->
     ADAMPileup table (PILEUP_SCHEMA)."""
     n = table.num_rows
+    if n == 0:
+        return pa.Table.from_pydict(
+            {f: [] for f in S.PILEUP_SCHEMA.names}, schema=S.PILEUP_SCHEMA)
     if batch is None:
         batch = pack_reads(table)
     L = batch.max_len

@@ -1,0 +1,134 @@
+"""Rods: per-locus pileup groups, kept columnar.
+
+Re-designs ``models/ADAMRod.scala`` + the rod functions of
+``rdd/AdamRDDFunctions.scala`` (adamRecords2Rods :144-191,
+adamPileupsToRods :252-258, adamSplitRodsBySamples :267-274,
+adamDivideRodsBySamples :276-283, adamAggregateRods :285-296,
+adamRodCoverage :298-314).
+
+A rod is "all pileup bases at one locus".  The reference materializes a
+Scala object per locus holding a List[ADAMPileup]; here a ``RodView`` is the
+pileup table sorted by locus plus segment offsets — the same information
+with zero per-locus allocation, and the layout segment-reductions want.
+
+The reference's two-phase bucketed grouping (reads duplicated into 1-2
+fixed-width 1000 bp buckets, then per-bucket locus grouping) exists to bound
+shuffle skew; as written it also emits duplicate rods for reads that span a
+bucket boundary (bucketedReadsToRods does not trim pileups to the bucket
+range, :175-187).  The TPU design does not need the trick single-host — the
+distribution analog (genome-bin sharding with boundary-read duplication and
+halo trimming) lives in parallel/pileup.py — so ``reads_to_rods`` grouping is
+a plain global sort+segment, which matches what the reference computes minus
+the boundary duplicates.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Iterator, List, Optional, Tuple
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.compute as pc
+
+from .pileup import aggregate_pileups, reads_to_pileups
+
+
+@dataclass
+class RodView:
+    """Pileups sorted by locus (and optionally sample) with rod boundaries.
+
+    rods[i] = pileups.slice(offsets[i], offsets[i+1]-offsets[i]) — all at
+    position (ref_ids[i], positions[i]).
+    """
+    pileups: pa.Table
+    ref_ids: np.ndarray      # [n_rods]
+    positions: np.ndarray    # [n_rods]
+    offsets: np.ndarray      # [n_rods + 1]
+    by_sample: bool = False  # rods further split per sample
+
+    def __len__(self) -> int:
+        return len(self.ref_ids)
+
+    def rod(self, i: int) -> pa.Table:
+        return self.pileups.slice(self.offsets[i],
+                                  self.offsets[i + 1] - self.offsets[i])
+
+    def __iter__(self) -> Iterator[Tuple[int, int, pa.Table]]:
+        for i in range(len(self)):
+            yield int(self.ref_ids[i]), int(self.positions[i]), self.rod(i)
+
+
+def _segment(pileups: pa.Table, keys: List[np.ndarray]) -> Tuple[pa.Table,
+                                                                 np.ndarray,
+                                                                 np.ndarray]:
+    """Sort the table by key columns and return (sorted, starts, order)."""
+    order = np.lexsort(tuple(reversed(keys)))
+    sorted_t = pileups.take(pa.array(order))
+    ks = [k[order] for k in keys]
+    n = len(order)
+    new = np.zeros(n, bool)
+    if n:
+        new[0] = True
+    for k in ks:
+        new[1:] |= k[1:] != k[:-1]
+    return sorted_t, np.flatnonzero(new), order
+
+
+def pileups_to_rods(pileups: pa.Table) -> RodView:
+    """Group pileups by reference position (adamPileupsToRods :252-258)."""
+    refid = pileups.column("referenceId").to_numpy(zero_copy_only=False)
+    pos = pileups.column("position").to_numpy(zero_copy_only=False)
+    sorted_t, starts, order = _segment(pileups, [refid, pos])
+    offsets = np.append(starts, len(pileups))
+    return RodView(sorted_t, refid[order][starts], pos[order][starts], offsets)
+
+
+def reads_to_rods(table: pa.Table, bucket_size: int = 1000) -> RodView:
+    """Reads → pileups → rods (adamRecords2Rods :144-191).
+
+    ``bucket_size`` is accepted for signature parity; see module docstring
+    for why the bucketed shuffle is not needed here.
+    """
+    del bucket_size
+    mapped = table.filter(pc.is_valid(table.column("start")))
+    return pileups_to_rods(reads_to_pileups(mapped))
+
+
+def split_rods_by_samples(rods: RodView) -> RodView:
+    """Split each rod per sample, flat (adamSplitRodsBySamples :267-274)."""
+    refid = rods.pileups.column("referenceId").to_numpy(zero_copy_only=False)
+    pos = rods.pileups.column("position").to_numpy(zero_copy_only=False)
+    sample = np.asarray(rods.pileups.column("recordGroupSample")
+                        .to_pylist(), object)
+    sample = np.where(sample == None, "", sample)  # noqa: E711
+    sorted_t, starts, order = _segment(rods.pileups, [refid, pos, sample])
+    offsets = np.append(starts, len(sorted_t))
+    return RodView(sorted_t, refid[order][starts], pos[order][starts],
+                   offsets, by_sample=True)
+
+
+def divide_rods_by_samples(rods: RodView
+                           ) -> List[Tuple[int, int, List[pa.Table]]]:
+    """Per-position list of single-sample rods
+    (adamDivideRodsBySamples :276-283)."""
+    split = split_rods_by_samples(rods)
+    out: List[Tuple[int, int, List[pa.Table]]] = []
+    for r, p, t in split:
+        if out and out[-1][0] == r and out[-1][1] == p:
+            out[-1][2].append(t)
+        else:
+            out.append((r, p, [t]))
+    return out
+
+
+def aggregate_rods(rods: RodView) -> RodView:
+    """In-rod evidence aggregation (adamAggregateRods :285-296)."""
+    return pileups_to_rods(aggregate_pileups(rods.pileups))
+
+
+def rod_coverage(rods: RodView) -> float:
+    """Average pileup depth across covered loci (adamRodCoverage :298-314)."""
+    if len(rods) == 0:
+        return float("nan")
+    return len(rods.pileups) / len(rods)

@@ -72,6 +72,73 @@ def _sweep_kernel(reads_u8, quals, read_lens, cons_u8, cons_len):
     return best_q, best_o
 
 
+# every IUPAC nucleotide code — either case, since SAM sequence is
+# [A-Za-z=.] and soft-masked references are lowercase — gets its own one-hot
+# class so that class equality == byte equality for any real sequence; only
+# bytes outside this alphabet alias into the trailing 'other' class
+_BASE_ALPHABET = b"ACGTNRYSWKMBDHVU=acgtnryswkmbdhvu."
+_N_BASE_CLASSES = len(_BASE_ALPHABET) + 1
+
+
+@jax.jit
+def _sweep_conv(reads_u8, quals, read_lens, cons_u8, cons_len):
+    """The sweep as one MXU convolution.
+
+    score[r, o] = sum_l w[r,l] * [read[r,l] != cons[o+l]]
+                = wsum[r] - sum_{l,b} (w[r,l] * readOH[r,l,b]) * consOH[o+l,b]
+
+    i.e. total quality minus a correlation of the quality-weighted one-hot
+    read against the one-hot consensus — a single conv_general_dilated with
+    the consensus as the (N=1, C=B, W=CL+L) input and the reads as (O=R,
+    I=B, W=L) filters, B the per-character class count, output [R, CL+1].  XLA lowers it straight onto the systolic array; no
+    [R, O, L] intermediate ever exists.  f32 accumulation is exact here
+    (scores are integers < 2^24).
+    """
+    classes = jnp.arange(_N_BASE_CLASSES, dtype=jnp.int32)
+
+    def encode(u8):
+        lut = jnp.full((256,), _N_BASE_CLASSES - 1, jnp.int32)
+        for i, c in enumerate(_BASE_ALPHABET):
+            lut = lut.at[c].set(i)
+        return lut[u8.astype(jnp.int32)]
+
+    R, L = reads_u8.shape
+    CL = cons_u8.shape[0]
+    in_read = jnp.arange(L)[None, :] < read_lens[:, None]
+    w = jnp.where(in_read, quals, 0).astype(jnp.float32)          # [R, L]
+    read_oh = (encode(reads_u8)[:, :, None] == classes).astype(jnp.float32)
+    wq = w[:, :, None] * read_oh                                  # [R, L, B]
+    cons_oh = (encode(cons_u8)[:, None] == classes).astype(jnp.float32)
+    # pad by L all-zero columns so every admissible offset of a short read
+    # (up to cons_len - read_len > CL - L) gets a conv output; the padding
+    # itself is never scored — admissible windows keep weighted lanes inside
+    # the true consensus
+    cons_oh = jnp.concatenate(
+        [cons_oh, jnp.zeros((L, _N_BASE_CLASSES), jnp.float32)], axis=0)
+    match = jax.lax.conv_general_dilated(
+        cons_oh.T[None, :, :],                # [1, B, CL]
+        jnp.transpose(wq, (0, 2, 1)),         # [R, B, L]
+        window_strides=(1,), padding="VALID",
+        dimension_numbers=("NCH", "OIH", "NCH"),
+        preferred_element_type=jnp.float32)[0]                    # [R, CL-L+1]
+    score = (jnp.sum(w, axis=1, keepdims=True) - match).astype(jnp.int32)
+    offs = jnp.arange(score.shape[1])
+    valid = offs[None, :] < (cons_len - read_lens)[:, None]
+    score = jnp.where(valid, score, BIG)
+    best_o = jnp.argmin(score, axis=1)
+    best_q = jnp.take_along_axis(score, best_o[:, None], 1)[:, 0]
+    return best_q, best_o
+
+
+def _sweep(reads_u8, quals, read_lens, cons_u8, cons_len):
+    """Production sweep: the conv formulation (MXU on TPU, vectorized
+    everywhere else).  ``_sweep_kernel`` is the O(R*O*L)-materializing naive
+    oracle kept for tests; ``sweep_pallas.sweep_pallas`` is the
+    VMEM-streaming alternative for consensus lengths where even the [R, O]
+    score matrix should not round-trip HBM per candidate."""
+    return _sweep_conv(reads_u8, quals, read_lens, cons_u8, cons_len)
+
+
 @dataclass
 class _Read:
     """Host-side view of one read inside a target group."""
@@ -240,9 +307,9 @@ def _realign_group(reads: List[_Read]) -> Dict[int, _Read]:
         cons_u8 = np.zeros(CL, np.uint8)
         cb = cons_seq.encode()
         cons_u8[:len(cb)] = np.frombuffer(cb, np.uint8)
-        q, o = _sweep_kernel(jnp.asarray(reads_u8), jnp.asarray(quals_arr),
-                             jnp.asarray(lens), jnp.asarray(cons_u8),
-                             jnp.int32(len(cons_seq)))
+        q, o = _sweep(jnp.asarray(reads_u8), jnp.asarray(quals_arr),
+                      jnp.asarray(lens), jnp.asarray(cons_u8),
+                      jnp.int32(len(cons_seq)))
         q = np.asarray(q)[:len(reads_to_clean)]
         o = np.asarray(o)[:len(reads_to_clean)]
         # fall back to the original alignment when the sweep cannot improve

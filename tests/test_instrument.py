@@ -1,0 +1,55 @@
+"""Stage timing / tracing subsystem."""
+
+import time
+
+from adam_tpu.instrument import report, stage
+
+
+def setup_function(_):
+    report().reset()
+
+
+def test_stage_accumulates():
+    with stage("a"):
+        time.sleep(0.01)
+    with stage("a"):
+        pass
+    r = report()
+    a = r.root.children["a"]
+    assert a.calls == 2
+    assert a.seconds >= 0.01
+
+
+def test_nesting():
+    with stage("outer"):
+        with stage("inner"):
+            pass
+    r = report()
+    outer = r.root.children["outer"]
+    assert "inner" in outer.children
+    assert "inner" not in r.root.children
+
+
+def test_format_report():
+    with stage("markdup"):
+        pass
+    with stage("bqsr"):
+        with stage("table"):
+            pass
+    text = report().format()
+    assert "markdup" in text and "bqsr" in text and "table" in text
+    assert "stage timing:" in text
+
+
+def test_sync_stage_runs_with_device():
+    with stage("dev", sync=True):
+        pass
+    assert report().root.children["dev"].calls == 1
+
+
+def test_transform_timing_flag(tmp_path, resources):
+    from adam_tpu.cli.main import main
+    out = tmp_path / "out"
+    rc = main(["transform", str(resources / "small.sam"), str(out),
+               "-mark_duplicate_reads", "-sort_reads", "-timing"])
+    assert rc == 0

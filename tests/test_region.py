@@ -1,0 +1,120 @@
+"""Interval algebra (mirrors ReferenceRegionSuite semantics)."""
+
+import numpy as np
+import pytest
+
+from adam_tpu.models.region import (OrientedPosition, ReferencePosition,
+                                    ReferenceRegion, merge_intervals,
+                                    region_of_read)
+
+
+def test_contains_point_and_region():
+    r = ReferenceRegion(0, 10, 20)
+    assert r.contains_point(ReferencePosition(0, 10))
+    assert r.contains_point(ReferencePosition(0, 19))
+    assert not r.contains_point(ReferencePosition(0, 20))  # half-open
+    assert not r.contains_point(ReferencePosition(1, 15))
+    assert r.contains(ReferenceRegion(0, 10, 20))
+    assert r.contains(ReferenceRegion(0, 12, 18))
+    assert not r.contains(ReferenceRegion(0, 5, 15))
+
+
+def test_overlaps():
+    r = ReferenceRegion(0, 10, 20)
+    assert r.overlaps(ReferenceRegion(0, 19, 25))
+    assert not r.overlaps(ReferenceRegion(0, 20, 25))  # abutting, no overlap
+    assert not r.overlaps(ReferenceRegion(1, 10, 20))
+
+
+def test_distance_semantics():
+    r = ReferenceRegion(0, 10, 20)
+    # inside -> 0; just past end -> 1; across refs -> None
+    assert r.distance_to_point(ReferencePosition(0, 15)) == 0
+    assert r.distance_to_point(ReferencePosition(0, 20)) == 1
+    assert r.distance_to_point(ReferencePosition(0, 5)) == 5
+    assert r.distance_to_point(ReferencePosition(1, 15)) is None
+    assert r.distance(ReferenceRegion(0, 15, 25)) == 0
+    assert r.distance(ReferenceRegion(0, 20, 25)) == 1  # abutting
+    assert r.distance(ReferenceRegion(0, 25, 30)) == 6
+    assert r.distance(ReferenceRegion(0, 0, 5)) == 6
+    assert r.distance(ReferenceRegion(1, 10, 20)) is None
+
+
+def test_adjacent_merge_hull():
+    a = ReferenceRegion(0, 10, 20)
+    b = ReferenceRegion(0, 20, 30)
+    assert a.is_adjacent(b)
+    assert a.merge(b) == ReferenceRegion(0, 10, 30)
+    c = ReferenceRegion(0, 40, 50)
+    assert not a.is_adjacent(c)
+    with pytest.raises(ValueError):
+        a.merge(c)
+    assert a.hull(c) == ReferenceRegion(0, 10, 50)
+    with pytest.raises(ValueError):
+        a.hull(ReferenceRegion(1, 0, 5))
+
+
+def test_ordering():
+    rs = [ReferenceRegion(1, 0, 5), ReferenceRegion(0, 10, 20),
+          ReferenceRegion(0, 10, 15), ReferenceRegion(0, 2, 3)]
+    assert sorted(rs) == [ReferenceRegion(0, 2, 3), ReferenceRegion(0, 10, 15),
+                          ReferenceRegion(0, 10, 20), ReferenceRegion(1, 0, 5)]
+    p = [OrientedPosition(ReferencePosition(0, 5), True),
+         OrientedPosition(ReferencePosition(0, 5), False)]
+    assert sorted(p)[0].negative_strand is False
+
+
+def test_region_of_read():
+    assert region_of_read(0, 5, 15, mapped=True) == ReferenceRegion(0, 5, 15)
+    assert region_of_read(0, 5, 15, mapped=False) is None
+
+
+def test_bad_region_rejected():
+    with pytest.raises(ValueError):
+        ReferenceRegion(0, 10, 5)
+    with pytest.raises(ValueError):
+        ReferenceRegion(0, -1, 5)
+
+
+def test_merge_intervals_overlap_only():
+    refs = np.array([0, 0, 0, 1], np.int32)
+    starts = np.array([0, 5, 20, 0], np.int64)
+    ends = np.array([10, 15, 30, 5], np.int64)
+    r, s, e = merge_intervals(refs, starts, ends)
+    assert s.tolist() == [0, 20, 0]
+    assert e.tolist() == [15, 30, 5]
+    assert r.tolist() == [0, 0, 1]
+
+
+def test_merge_intervals_adjacency_flag():
+    refs = np.zeros(2, np.int32)
+    starts = np.array([0, 10], np.int64)
+    ends = np.array([10, 20], np.int64)
+    _, s, e = merge_intervals(refs, starts, ends)
+    assert len(s) == 2  # abutting intervals stay split without the flag
+    _, s, e = merge_intervals(refs, starts, ends, adjacency=True)
+    assert s.tolist() == [0] and e.tolist() == [20]
+
+
+def test_merge_intervals_no_cross_contig_bleed():
+    # a huge interval on ref 0 must not swallow later refs' intervals
+    refs = np.array([0, 1, 1], np.int32)
+    starts = np.array([0, 5, 500], np.int64)
+    ends = np.array([10_000, 10, 510], np.int64)
+    r, s, e = merge_intervals(refs, starts, ends)
+    assert len(s) == 3
+    assert r.tolist() == [0, 1, 1]
+
+
+def test_merge_intervals_unsorted_input():
+    refs = np.zeros(3, np.int32)
+    starts = np.array([20, 0, 5], np.int64)
+    ends = np.array([30, 10, 25], np.int64)
+    _, s, e = merge_intervals(refs, starts, ends)
+    assert s.tolist() == [0] and e.tolist() == [30]
+
+
+def test_merge_intervals_empty():
+    z = np.empty(0, np.int64)
+    r, s, e = merge_intervals(z.astype(np.int32), z, z)
+    assert len(r) == 0

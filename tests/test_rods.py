@@ -1,0 +1,101 @@
+"""Rod conversion (mirrors the rod scenarios of AdamRDDFunctionsSuite)."""
+
+import numpy as np
+import pyarrow as pa
+
+from adam_tpu import schema as S
+from adam_tpu.ops.rods import (RodView, aggregate_rods,
+                               divide_rods_by_samples, pileups_to_rods,
+                               reads_to_rods, rod_coverage,
+                               split_rods_by_samples)
+
+
+def _reads_table(rows):
+    cols = {name: [] for name in S.READ_SCHEMA.names}
+    for row in rows:
+        for name in S.READ_SCHEMA.names:
+            cols[name].append(row.get(name))
+    return pa.Table.from_pydict(cols, schema=S.READ_SCHEMA)
+
+
+def read(sequence="ACTAG", cigar="5M", md="5", start=1, mapq=30, name="r",
+         sample=None, **kw):
+    qual = "".join(chr(q + 33) for q in (30, 20, 40, 20, 10))[:len(sequence)]
+    return dict(sequence=sequence, cigar=cigar, mismatchingPositions=md,
+                start=start, mapq=mapq, qual=qual, readName=name,
+                referenceId=0, referenceName="1", flags=0,
+                recordGroupSample=sample, **kw)
+
+
+def test_reads_to_rods_single_read():
+    rods = reads_to_rods(_reads_table([read()]))
+    assert len(rods) == 5
+    assert rods.positions.tolist() == [1, 2, 3, 4, 5]
+    assert all(len(rods.rod(i)) == 1 for i in range(5))
+    assert rod_coverage(rods) == 1.0
+
+
+def test_reads_to_rods_overlapping_reads():
+    # two reads overlapping at positions 3..5 -> depth 2 there
+    rods = reads_to_rods(_reads_table([
+        read(name="r1"), read(name="r2", start=3)]))
+    assert rods.positions.tolist() == [1, 2, 3, 4, 5, 6, 7]
+    depths = [len(rods.rod(i)) for i in range(len(rods))]
+    assert depths == [1, 1, 2, 2, 2, 1, 1]
+    assert rod_coverage(rods) == 10 / 7
+
+
+def test_unmapped_reads_dropped():
+    t = _reads_table([read(), dict(readName="u", sequence="AAAAA",
+                                   qual="IIIII", flags=4)])
+    rods = reads_to_rods(t)
+    assert len(rods.pileups) == 5
+
+
+def test_pileups_to_rods_round_trip():
+    from adam_tpu.ops.pileup import reads_to_pileups
+    p = reads_to_pileups(_reads_table([read(name="a"), read(name="b")]))
+    rods = pileups_to_rods(p)
+    assert len(rods) == 5
+    assert all(len(rods.rod(i)) == 2 for i in range(5))
+
+
+def test_split_rods_by_samples():
+    rods = reads_to_rods(_reads_table([
+        read(name="r1", sample="s1"), read(name="r2", sample="s2")]))
+    assert all(len(rods.rod(i)) == 2 for i in range(5))
+    split = split_rods_by_samples(rods)
+    assert len(split) == 10  # each locus splits into two single-sample rods
+    assert all(len(split.rod(i)) == 1 for i in range(10))
+    assert split.by_sample
+
+
+def test_divide_rods_by_samples():
+    rods = reads_to_rods(_reads_table([
+        read(name="r1", sample="s1"), read(name="r2", sample="s2")]))
+    divided = divide_rods_by_samples(rods)
+    assert len(divided) == 5  # grouped back by position
+    for _, _, per_sample in divided:
+        assert len(per_sample) == 2
+
+
+def test_aggregate_rods():
+    rods = reads_to_rods(_reads_table([read(name="a"), read(name="b")]))
+    agg = aggregate_rods(rods)
+    assert len(agg) == 5
+    # identical evidence collapses to one pileup per locus with count 2
+    assert all(len(agg.rod(i)) == 1 for i in range(5))
+    assert all(agg.rod(i).column("countAtPosition")[0].as_py() == 2
+               for i in range(5))
+
+
+def test_rod_iteration():
+    rods = reads_to_rods(_reads_table([read()]))
+    seen = [(r, p, len(t)) for r, p, t in rods]
+    assert seen == [(0, 1, 1), (0, 2, 1), (0, 3, 1), (0, 4, 1), (0, 5, 1)]
+
+
+def test_empty():
+    rods = reads_to_rods(_reads_table([]))
+    assert len(rods) == 0
+    assert np.isnan(rod_coverage(rods))

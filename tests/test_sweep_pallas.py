@@ -1,0 +1,119 @@
+"""Pallas consensus-sweep kernel vs the jnp reference formulation.
+
+Runs the kernel through the Pallas interpreter (works on the CPU test mesh);
+on TPU the same kernel compiles to Mosaic.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from adam_tpu.realign.realigner import _sweep_conv, _sweep_kernel
+from adam_tpu.realign.sweep_pallas import sweep_pallas
+
+_BASES = np.frombuffer(b"ACGTN", np.uint8)
+
+
+def _random_case(rng, R, L, CL):
+    reads = _BASES[rng.randint(0, 5, size=(R, L))]
+    quals = rng.randint(0, 41, size=(R, L)).astype(np.int32)
+    lens = rng.randint(L // 2, L + 1, size=R).astype(np.int32)
+    cons = _BASES[rng.randint(0, 5, size=CL)]
+    return reads, quals, lens, cons
+
+
+@pytest.mark.parametrize("R,L,CL", [(4, 10, 40), (17, 33, 150), (1, 8, 9)])
+def test_matches_jnp_kernel(R, L, CL):
+    rng = np.random.RandomState(R * 1000 + L)
+    reads, quals, lens, cons = _random_case(rng, R, L, CL)
+    q0, o0 = _sweep_kernel(jnp.asarray(reads), jnp.asarray(quals),
+                           jnp.asarray(lens), jnp.asarray(cons),
+                           jnp.int32(CL))
+    q1, o1 = sweep_pallas(jnp.asarray(reads), jnp.asarray(quals),
+                          jnp.asarray(lens), jnp.asarray(cons), CL,
+                          interpret=True)
+    np.testing.assert_array_equal(np.asarray(q0), np.asarray(q1))
+    np.testing.assert_array_equal(np.asarray(o0), np.asarray(o1))
+
+
+@pytest.mark.parametrize("R,L,CL", [(4, 10, 40), (17, 33, 150), (1, 8, 9)])
+def test_conv_matches_naive(R, L, CL):
+    # the production path: the sweep as an MXU convolution
+    rng = np.random.RandomState(R + L + CL)
+    reads, quals, lens, cons = _random_case(rng, R, L, CL)
+    q0, o0 = _sweep_kernel(jnp.asarray(reads), jnp.asarray(quals),
+                           jnp.asarray(lens), jnp.asarray(cons),
+                           jnp.int32(CL))
+    q1, o1 = _sweep_conv(jnp.asarray(reads), jnp.asarray(quals),
+                         jnp.asarray(lens), jnp.asarray(cons), jnp.int32(CL))
+    np.testing.assert_array_equal(np.asarray(q0), np.asarray(q1))
+    np.testing.assert_array_equal(np.asarray(o0), np.asarray(o1))
+
+
+def test_conv_short_read_far_offsets():
+    # a short read whose only perfect placement lies beyond CL - L: the
+    # conv output must still cover it (regression: VALID-window clipping)
+    cons = np.frombuffer(b"C" * 28 + b"ACGTG", np.uint8).copy()
+    CL = len(cons)  # perfect hit at offset 28, admissible (28 < 33 - 4)
+    reads = np.zeros((1, 16), np.uint8)
+    reads[0, :4] = np.frombuffer(b"ACGT", np.uint8)
+    quals = np.full((1, 16), 30, np.int32)
+    lens = np.array([4], np.int32)
+    q, o = _sweep_conv(jnp.asarray(reads), jnp.asarray(quals),
+                       jnp.asarray(lens), jnp.asarray(cons), jnp.int32(CL))
+    assert int(q[0]) == 0 and int(o[0]) == 28
+
+
+def test_conv_lowercase_and_exotic_bytes_match_naive():
+    # soft-masked (lowercase) and non-IUPAC bytes must not alias into a
+    # shared class and fake perfect matches (regression)
+    reads = np.frombuffer(b"ajgt", np.uint8).copy()[None, :]
+    quals = np.full((1, 4), 15, np.int32)
+    lens = np.array([4], np.int32)
+    cons = np.frombuffer(b"tacgjjjj", np.uint8).copy()
+    q0, o0 = _sweep_kernel(jnp.asarray(reads), jnp.asarray(quals),
+                           jnp.asarray(lens), jnp.asarray(cons),
+                           jnp.int32(8))
+    q1, o1 = _sweep_conv(jnp.asarray(reads), jnp.asarray(quals),
+                         jnp.asarray(lens), jnp.asarray(cons), jnp.int32(8))
+    assert int(q1[0]) == int(q0[0]) and int(q1[0]) > 0
+    assert int(o1[0]) == int(o0[0])
+
+
+def test_exact_placement():
+    # a read that matches the consensus perfectly at offset 7
+    cons = np.frombuffer(b"ACGTACGTACGTACGTACGTACGT", np.uint8).copy()
+    read = cons[7:15]
+    reads = read[None, :]
+    quals = np.full((1, 8), 30, np.int32)
+    lens = np.array([8], np.int32)
+    q, o = sweep_pallas(jnp.asarray(reads), jnp.asarray(quals),
+                        jnp.asarray(lens), jnp.asarray(cons), len(cons),
+                        interpret=True)
+    assert int(q[0]) == 0
+    # perfect score also occurs at offsets 7+4k; lowest-offset tie-break
+    assert int(o[0]) % 4 == 3 and int(o[0]) <= 7
+
+
+def test_inadmissible_everywhere():
+    # read longer than consensus -> BIG score
+    reads = np.full((1, 16), 65, np.uint8)
+    quals = np.full((1, 16), 30, np.int32)
+    lens = np.array([16], np.int32)
+    cons = np.full(10, 65, np.uint8)
+    q, _ = sweep_pallas(jnp.asarray(reads), jnp.asarray(quals),
+                        jnp.asarray(lens), jnp.asarray(cons), 10,
+                        interpret=True)
+    assert int(q[0]) >= 1 << 30
+
+
+def test_mismatch_quality_weighting():
+    cons = np.frombuffer(b"AAAAAAAAAA", np.uint8).copy()
+    reads = np.frombuffer(b"AAAT", np.uint8).copy()[None, :]
+    quals = np.array([[30, 30, 30, 17]], np.int32)
+    lens = np.array([4], np.int32)
+    q, o = sweep_pallas(jnp.asarray(reads), jnp.asarray(quals),
+                        jnp.asarray(lens), jnp.asarray(cons), 10,
+                        interpret=True)
+    assert int(q[0]) == 17  # one mismatch, weighted by its quality
+    assert int(o[0]) == 0
