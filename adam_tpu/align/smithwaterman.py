@@ -34,8 +34,6 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-NEG = -1e30
-
 
 @dataclass(frozen=True)
 class SWParams:
@@ -132,9 +130,11 @@ def smith_waterman(x: str, y: str, p: SWParams = SWParams()) -> SWAlignment:
                          jnp.int32(len(x)), jnp.int32(len(y)), p))
     i, j = np.unravel_index(np.argmax(m), m.shape)
     score = float(m[i, j])
-    # the max-plus cummax in _fill leaves float-epsilon residue, so cell
-    # provenance is re-derived with a tolerance, not exact equality
-    eps = 1e-4
+    # the max-plus cummax in _fill leaves float-epsilon residue whose
+    # magnitude scales with j*|w_insert| (the shifted operand), so cell
+    # provenance is re-derived with a tolerance that scales with the
+    # matrix — a fixed eps breaks down once f32 ulp at j/3 exceeds it
+    eps = 1e-4 + 1e-6 * float(np.abs(m).max())
     ops_x, ax, ay = [], [], []
     while i > 0 and j > 0 and m[i, j] > eps:
         sub = p.w_match if xv[i - 1] == yv[j - 1] else p.w_mismatch

@@ -82,34 +82,56 @@ class TransformCommand(Command):
                        help="print a per-stage wall-clock report")
         p.add_argument("-trace_dir", default=None,
                        help="write a JAX device profiler trace here")
+        p.add_argument("-checkpoint_dir", default=None,
+                       help="materialize each stage here and resume a "
+                            "previously interrupted run")
 
     def run(self, args) -> int:
+        from ..checkpoint import CheckpointDir, run_stages
         from ..instrument import device_trace, report, stage
         from ..io.dispatch import load_reads, sequence_dictionary_from_reads
         from ..io.parquet import save_table
 
+        def timed(name, fn):
+            def wrapped(table):
+                with stage(name, sync=True):
+                    return fn(table)
+            return name, wrapped
+
+        stages = []
+        if args.mark_duplicate_reads:
+            from ..ops.markdup import mark_duplicates
+            stages.append(timed("markdup", mark_duplicates))
+        if args.recalibrate_base_qualities:
+            from ..bqsr.recalibrate import recalibrate_base_qualities
+            from ..models.snptable import SnpTable
+            snp = SnpTable.from_vcf(args.dbsnp_sites) \
+                if args.dbsnp_sites else None
+            stages.append(timed(
+                "bqsr", lambda t: recalibrate_base_qualities(t, snp)))
+        if args.realignIndels:
+            from ..realign.realigner import realign_indels
+            stages.append(timed("realign", realign_indels))
+        if args.sort_reads:
+            from ..ops.sort import sort_reads
+            stages.append(timed("sort", sort_reads))
+
+        ckpt = None
+        if args.checkpoint_dir:
+            # every stage-affecting parameter belongs in the fingerprint —
+            # resuming a BQSR checkpoint built from different known-sites
+            # would silently use the wrong mask
+            config = [args.input, f"dbsnp={args.dbsnp_sites}"] \
+                + [name for name, _ in stages]
+            ckpt = CheckpointDir(args.checkpoint_dir, config)
+
         with device_trace(args.trace_dir):
             with stage("load"):
                 table, seq_dict, rg_dict = load_reads(args.input)
-            if args.mark_duplicate_reads:
-                from ..ops.markdup import mark_duplicates
-                with stage("markdup", sync=True):
-                    table = mark_duplicates(table)
-            if args.recalibrate_base_qualities:
-                from ..bqsr.recalibrate import recalibrate_base_qualities
-                from ..models.snptable import SnpTable
-                snp = SnpTable.from_vcf(args.dbsnp_sites) \
-                    if args.dbsnp_sites else None
-                with stage("bqsr", sync=True):
-                    table = recalibrate_base_qualities(table, snp)
-            if args.realignIndels:
-                from ..realign.realigner import realign_indels
-                with stage("realign", sync=True):
-                    table = realign_indels(table)
-            if args.sort_reads:
-                from ..ops.sort import sort_reads
-                with stage("sort", sync=True):
-                    table = sort_reads(table)
+            table = run_stages(
+                ckpt, table, stages,
+                on_skip=lambda done: print(
+                    f"resuming after checkpointed stages: {', '.join(done)}"))
             with stage("save"):
                 if args.output.endswith(".sam"):
                     from ..io.dispatch import \

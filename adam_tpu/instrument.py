@@ -91,9 +91,13 @@ def stage(name: str, *, sync: bool = False) -> Iterator[None]:
 
 
 def _block_on_device() -> None:
+    """Drain every local device's queue, not just the default one — a
+    shard_map stage leaves work in flight on all mesh devices, and TPU
+    queues complete in order, so one trailing op per device is a barrier."""
     try:
         import jax
-        (jax.device_put(0) + 0).block_until_ready()
+        jax.block_until_ready([jax.device_put(0, device=d) + 0
+                               for d in jax.local_devices()])
     except Exception:  # pragma: no cover - no backend
         pass
 

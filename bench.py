@@ -1,11 +1,18 @@
-"""Benchmark: flagstat throughput on device.
+"""Benchmark: flagstat throughput on device, host->device transfer included.
 
 Prints ONE json line: {"metric", "value", "unit", "vs_baseline"}.
 
 Baseline (BASELINE.md #1): the reference runs flagstat over 51,554,029 reads
 in 17 s on a laptop => 3.03 M reads/s.  We time the same counters over the
-same number of (synthetic, on-device) packed reads.  vs_baseline is our
-reads/s over the reference's.
+same number of packed reads, measured from host-resident packed columns
+through device transfer to the materialized [K, 2] counter block — i.e. the
+device side of the real pipeline, excluding only the format decode that the
+IO layer benches separately.
+
+The packed wire layout is the compact one projection discipline dictates:
+flags u16, mapq u8, refid/mate_refid i16, valid bool = 8 bytes/read; the
+kernel widens on device.  (The reference's trick was projecting 13 Parquet
+fields; column-width discipline matters even more over a PCIe/tunnel link.)
 """
 
 from __future__ import annotations
@@ -24,26 +31,30 @@ def main() -> None:
     import jax.numpy as jnp
     from adam_tpu.ops.flagstat import flagstat_kernel
 
-    # generate the packed columns directly on device (the host->device copy of
-    # a real load is covered by the IO path, benched separately as it grows)
-    key = jax.random.PRNGKey(0)
-    ks = jax.random.split(key, 4)
+    rng = np.random.RandomState(0)
     n = N_READS
-    flags = jax.random.randint(ks[0], (n,), 0, 1 << 11, dtype=jnp.int32)
-    mapq = jax.random.randint(ks[1], (n,), 0, 61, dtype=jnp.int32)
-    refid = jax.random.randint(ks[2], (n,), 0, 24, dtype=jnp.int32)
-    mate_refid = jax.random.randint(ks[3], (n,), 0, 24, dtype=jnp.int32)
-    valid = jnp.ones((n,), bool)
+    flags = rng.randint(0, 1 << 11, size=n).astype(np.uint16)
+    mapq = rng.randint(0, 61, size=n).astype(np.uint8)
+    refid = rng.randint(0, 24, size=n).astype(np.int16)
+    mate_refid = rng.randint(0, 24, size=n).astype(np.int16)
+    valid = np.ones(n, bool)
+    host_cols = (flags, mapq, refid, mate_refid, valid)
 
-    fn = jax.jit(lambda *a: flagstat_kernel(*a))
-    out = fn(flags, mapq, refid, mate_refid, valid)
-    jax.block_until_ready(out)  # compile + warm
+    @jax.jit
+    def fn(f, m, r, mr, v):
+        return flagstat_kernel(f.astype(jnp.int32), m.astype(jnp.int32),
+                               r.astype(jnp.int32), mr.astype(jnp.int32), v)
 
-    iters = 5
+    def run():
+        out = fn(*[jax.device_put(c) for c in host_cols])
+        jax.block_until_ready(out)
+        return out
+
+    run()  # compile + warm
+    iters = 3
     t0 = time.perf_counter()
     for _ in range(iters):
-        out = fn(flags, mapq, refid, mate_refid, valid)
-    jax.block_until_ready(out)
+        run()
     dt = (time.perf_counter() - t0) / iters
 
     reads_per_s = n / dt

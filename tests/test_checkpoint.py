@@ -1,0 +1,107 @@
+"""Checkpoint/resume for the transform pipeline."""
+
+import json
+import os
+
+import pyarrow as pa
+import pytest
+
+from adam_tpu.checkpoint import MANIFEST, CheckpointDir, run_stages
+
+
+def _table(n):
+    return pa.table({"x": list(range(n))})
+
+
+def test_stages_run_and_checkpoint(tmp_path):
+    ckpt = CheckpointDir(str(tmp_path / "ck"), ["cfg"])
+    calls = []
+
+    def mk(name):
+        def fn(t):
+            calls.append(name)
+            return t.append_column(name, pa.array([0] * t.num_rows))
+        return name, fn
+
+    out = run_stages(ckpt, _table(3), [mk("a"), mk("b")])
+    assert calls == ["a", "b"]
+    assert out.column_names == ["x", "a", "b"]
+    assert ckpt.completed == ["00-a", "01-b"]
+
+
+def test_resume_skips_completed(tmp_path):
+    path = str(tmp_path / "ck")
+    calls = []
+
+    def mk(name, fail=False):
+        def fn(t):
+            calls.append(name)
+            if fail:
+                raise RuntimeError("boom")
+            return t.append_column(name, pa.array([0] * t.num_rows))
+        return name, fn
+
+    with pytest.raises(RuntimeError):
+        run_stages(CheckpointDir(path, ["cfg"]), _table(3),
+                   [mk("a"), mk("b", fail=True)])
+    assert calls == ["a", "b"]
+
+    calls.clear()
+    skipped = []
+    out = run_stages(CheckpointDir(path, ["cfg"]), _table(3),
+                     [mk("a"), mk("b")], on_skip=skipped.extend)
+    assert calls == ["b"]  # resumed from stage a's table
+    assert skipped == ["00-a"]
+    assert out.column_names == ["x", "a", "b"]
+
+
+def test_config_mismatch_rejected(tmp_path):
+    path = str(tmp_path / "ck")
+    run_stages(CheckpointDir(path, ["cfg1"]), _table(1),
+               [("a", lambda t: t)])
+    with pytest.raises(ValueError, match="different pipeline"):
+        CheckpointDir(path, ["cfg2"])
+
+
+def test_manifest_atomic_and_valid(tmp_path):
+    path = str(tmp_path / "ck")
+    run_stages(CheckpointDir(path, ["c"]), _table(1), [("s", lambda t: t)])
+    with open(os.path.join(path, MANIFEST)) as f:
+        m = json.load(f)
+    assert m["completed"] == ["00-s"]
+    assert "fingerprint" in m
+
+
+def test_stage_dir_missing_means_not_completed(tmp_path):
+    path = str(tmp_path / "ck")
+    run_stages(CheckpointDir(path, ["c"]), _table(1), [("s", lambda t: t)])
+    import shutil
+    shutil.rmtree(os.path.join(path, "00-s"))
+    ck = CheckpointDir(path, ["c"])
+    assert ck.completed == []
+
+
+def test_no_checkpoint_dir_is_passthrough():
+    out = run_stages(None, _table(2), [("a", lambda t: t)])
+    assert out.num_rows == 2
+
+
+def test_cli_transform_resume(tmp_path, resources):
+    from adam_tpu.cli.main import main
+    ck = str(tmp_path / "ck")
+    out1 = str(tmp_path / "o1")
+    rc = main(["transform", str(resources / "small.sam"), out1,
+               "-mark_duplicate_reads", "-sort_reads",
+               "-checkpoint_dir", ck])
+    assert rc == 0
+    assert sorted(os.listdir(ck)) == ["00-markdup", "01-sort", MANIFEST]
+    # rerun: all stages skipped, output still produced
+    out2 = str(tmp_path / "o2")
+    rc = main(["transform", str(resources / "small.sam"), out2,
+               "-mark_duplicate_reads", "-sort_reads",
+               "-checkpoint_dir", ck])
+    assert rc == 0
+    import pyarrow.parquet as pq
+    t1 = pq.read_table(out1)
+    t2 = pq.read_table(out2)
+    assert t1.equals(t2)
