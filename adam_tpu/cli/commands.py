@@ -10,6 +10,7 @@ Implemented so far:
 from __future__ import annotations
 
 import argparse
+import os
 
 from .main import Command, register
 
@@ -118,10 +119,19 @@ class TransformCommand(Command):
 
         ckpt = None
         if args.checkpoint_dir:
-            # every stage-affecting parameter belongs in the fingerprint —
+            # every stage-affecting input belongs in the fingerprint —
             # resuming a BQSR checkpoint built from different known-sites
-            # would silently use the wrong mask
-            config = [args.input, f"dbsnp={args.dbsnp_sites}"] \
+            # would silently use the wrong mask.  Path + size + mtime, so an
+            # edited file under the same name invalidates the checkpoint.
+            def _stamp(path):
+                if not path:
+                    return f"{path}"
+                try:
+                    st = os.stat(path)
+                    return f"{path}:{st.st_size}:{st.st_mtime_ns}"
+                except OSError:
+                    return f"{path}:missing"
+            config = [_stamp(args.input), f"dbsnp={_stamp(args.dbsnp_sites)}"] \
                 + [name for name, _ in stages]
             ckpt = CheckpointDir(args.checkpoint_dir, config)
 

@@ -1,0 +1,230 @@
+"""Device-side shuffle and halo exchange — the XLA-collective backend.
+
+The reference's distribution substrate is the Spark 0.8.1 shuffle (TCP block
+transfers keyed by partitioner) plus driver aggregates (SURVEY.md §2.4).
+This module provides the TPU-native equivalents as collectives that ride ICI
+inside a slice (and DCN between hosts when the mesh spans processes):
+
+* :func:`all_to_all_reshard` — the shuffle itself.  Rows arrive sharded in
+  arrival order (file order); each device routes its rows to the device that
+  owns their key (e.g. the genome-bin stripe owner from
+  ``GenomicRegionPartitioner``) with one fixed-capacity
+  ``jax.lax.all_to_all``.  This is the MoE-dispatch formulation of a
+  shuffle: dense [n_shards, capacity, ...] send/recv buffers with validity
+  masks instead of dynamic blocks, because XLA collectives need static
+  shapes.
+* :func:`ring_halo_merge` — neighbor exchange via ``ppermute``.  The
+  host-side partitioner handles boundary-spanning reads by duplicating them
+  into both bins (partitioner.py); when reads are already on-device, the
+  cheaper alternative is to let each stripe count a halo of positions past
+  its right edge and ``ppermute`` the halo to the right neighbor — a ring
+  step, the same communication shape as ring attention's kv rotation.
+* :func:`pileup_counts_halo_exchange` — the sequence-parallel pileup built
+  from the two: each device counts its stripe + halo, one ppermute merges
+  boundaries.  No host round-trip, no read duplication.
+
+Multi-host: :func:`initialize` wraps ``jax.distributed.initialize`` and
+:func:`make_host_mesh` builds the 2-D ("host", "chip") mesh whose outer axis
+maps onto DCN and inner axis onto ICI — shard the genome axis over "host"
+(rare, bulky resharding over DCN) and the read axis over "chip" (frequent
+psum/all_to_all over ICI), the layout SURVEY.md §2.4 calls for.
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache, partial
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+from .mesh import READS_AXIS
+
+HOST_AXIS = "host"
+CHIP_AXIS = "chip"
+
+
+# --------------------------------------------------------------------------
+# multi-host runtime
+# --------------------------------------------------------------------------
+
+def initialize(coordinator_address: Optional[str] = None,
+               num_processes: Optional[int] = None,
+               process_id: Optional[int] = None) -> None:
+    """Join the multi-host runtime (no-op for single-process runs).
+
+    Replaces the reference's Akka/Spark control plane (pom.xml:33-35): after
+    this, ``jax.devices()`` spans every host and collectives cross DCN.
+    Arguments default to the cluster-autodetect path (TPU metadata / env).
+    """
+    if num_processes is not None and num_processes <= 1:
+        return
+    jax.distributed.initialize(coordinator_address=coordinator_address,
+                               num_processes=num_processes,
+                               process_id=process_id)
+
+
+def make_host_mesh(devices=None) -> Mesh:
+    """2-D mesh [hosts, chips-per-host] with axes ("host", "chip").
+
+    Collectives over "chip" stay on ICI; collectives over "host" cross DCN.
+    Single-process runs get a 1×n mesh, so code written against the two-axis
+    layout runs unchanged on one host.
+    """
+    if devices is None:
+        devices = jax.devices()
+    by_proc: dict = {}
+    for d in devices:
+        by_proc.setdefault(d.process_index, []).append(d)
+    counts = {p: len(v) for p, v in by_proc.items()}
+    if len(set(counts.values())) != 1:
+        raise ValueError(
+            f"hosts hold unequal device counts {counts}; a rectangular "
+            "(host, chip) mesh needs the same chips per host")
+    grid = np.array([by_proc[p] for p in sorted(by_proc)], dtype=object)
+    return Mesh(grid, (HOST_AXIS, CHIP_AXIS))
+
+
+# --------------------------------------------------------------------------
+# all_to_all reshard: the shuffle
+# --------------------------------------------------------------------------
+
+def _dispatch_local(dest, cols, n_shards: int, capacity: int):
+    """Pack this device's rows into [n_shards, capacity, ...] send buffers.
+
+    Rows beyond a destination's capacity are dropped (counted in the returned
+    overflow); callers size capacity from the partitioner's bin histogram the
+    same way the reference sizes reducer counts from coverage
+    (PileupAggregator.scala:204-209).
+    """
+    n = dest.shape[0]
+    # stable sort by destination; rank within destination group = position -
+    # start of group.  O(n log n), fully vectorized.
+    order = jnp.argsort(dest, stable=True)
+    sorted_dest = dest[order]
+    group_start = jnp.searchsorted(sorted_dest, jnp.arange(n_shards),
+                                   side="left")
+    rank_sorted = jnp.arange(n) - group_start[sorted_dest]
+    rank = jnp.zeros_like(rank_sorted).at[order].set(rank_sorted)
+
+    keep = rank < capacity
+    slot = jnp.where(keep, dest * capacity + rank, n_shards * capacity)
+    overflow = jnp.sum(~keep)
+
+    def scatter(col):
+        buf = jnp.zeros((n_shards * capacity + 1,) + col.shape[1:], col.dtype)
+        return buf.at[slot].set(col)[:-1].reshape(
+            (n_shards, capacity) + col.shape[1:])
+
+    sent_valid = scatter(keep.astype(jnp.int8)).astype(bool)
+    return jax.tree.map(scatter, cols), sent_valid, overflow
+
+
+def _reshard_step(dest, cols, n_shards: int, capacity: int, axis_name: str):
+    send, sent_valid, overflow = _dispatch_local(dest, cols, n_shards,
+                                                 capacity)
+    a2a = partial(jax.lax.all_to_all, axis_name=axis_name, split_axis=0,
+                  concat_axis=0, tiled=True)
+    recv = jax.tree.map(a2a, send)
+    recv_valid = a2a(sent_valid)
+    flat = jax.tree.map(
+        lambda x: x.reshape((n_shards * capacity,) + x.shape[2:]), recv)
+    total_overflow = jax.lax.psum(overflow, axis_name)
+    return flat, recv_valid.reshape(-1), total_overflow
+
+
+def all_to_all_reshard(mesh: Mesh, dest: jnp.ndarray, cols, capacity: int,
+                       axis_name: str = READS_AXIS):
+    """Route rows to the shard owning their key — the device-side shuffle.
+
+    Args:
+      mesh: 1-D mesh over ``axis_name``.
+      dest: [N] int32 global array (sharded on the read axis) of destination
+        shard ids in [0, mesh.size).
+      cols: pytree of [N, ...] arrays to move with each row.
+      capacity: max rows any one source sends to any one destination.  Each
+        device receives exactly ``mesh.size * capacity`` slots back.
+
+    Returns (cols_out, valid, overflow): resharded pytree of
+    [mesh.size * capacity, ...] per device (global shape
+    [mesh.size² * capacity, ...]), a validity mask, and the global count of
+    rows dropped to the capacity limit (0 when capacity was sized right).
+    """
+    _, treedef = jax.tree.flatten(cols)
+    fn = _build_resharder(mesh, treedef, capacity, axis_name)
+    return fn(dest, cols)
+
+
+@lru_cache(maxsize=None)
+def _build_resharder(mesh: Mesh, treedef, capacity: int, axis_name: str):
+    """One shard_map+jit per (mesh, tree shape, capacity) — cached so
+    per-batch calls reuse the compiled collective."""
+    n_shards = mesh.shape[axis_name]
+    step = partial(_reshard_step, n_shards=n_shards, capacity=capacity,
+                   axis_name=axis_name)
+    spec = P(axis_name)
+    spec_tree = jax.tree.unflatten(
+        treedef, [spec] * treedef.num_leaves)
+    fn = jax.shard_map(
+        step, mesh=mesh,
+        in_specs=(spec, spec_tree),
+        out_specs=(spec_tree, spec, P()))
+    return jax.jit(fn)
+
+
+# --------------------------------------------------------------------------
+# ppermute halo exchange
+# --------------------------------------------------------------------------
+
+def ring_halo_merge(stripe: jnp.ndarray, halo: jnp.ndarray,
+                    axis_name: str = READS_AXIS) -> jnp.ndarray:
+    """Merge per-stripe halo counts into the right neighbor's leading rows.
+
+    ``stripe`` is this device's [span, ...] count block; ``halo`` holds counts
+    this device accumulated for the first H positions *past* its right edge
+    (they belong to the next stripe).  One ``ppermute`` ring step moves every
+    halo one device to the right; the halo arriving at stripe 0 wraps from
+    the genome's end and is dropped, mirroring the partitioner's refusal to
+    spill ranges into the unmapped bin (partitioner.py bins_for_ranges).
+    """
+    n = jax.lax.axis_size(axis_name)
+    incoming = jax.lax.ppermute(halo, axis_name,
+                                perm=[(i, (i + 1) % n) for i in range(n)])
+    first = jax.lax.axis_index(axis_name) == 0
+    incoming = jnp.where(first, jnp.zeros_like(incoming), incoming)
+    h = halo.shape[0]
+    return stripe.at[:h].add(incoming.astype(stripe.dtype))
+
+
+def pileup_counts_halo_exchange(mesh: Mesh, bin_span: int, halo: int,
+                                max_len: int):
+    """Sequence-parallel pileup without boundary-read duplication.
+
+    Each device counts positions [i*bin_span, i*bin_span + bin_span + halo)
+    for its stripe i — its own span plus a halo wide enough for the longest
+    read/deletion overhang — then one ring ppermute folds halos into
+    neighbors.  Compare ``sharded_pileup_counts`` (parallel/pileup.py), which
+    instead expects the host to have duplicated boundary reads.
+
+    Returns a jitted fn(bases, quals, start, flags, mapq, valid, cigar_ops,
+    cigar_lens) -> [n_devices * bin_span, N_CHANNELS] with reads sharded on
+    the leading axis by stripe (route with ``route_reads_to_stripes``).
+    """
+    from .pileup import pileup_count_kernel
+
+    spec = P(READS_AXIS)
+
+    def step(bases, quals, start, flags, mapq, valid, cigar_ops, cigar_lens):
+        i = jax.lax.axis_index(READS_AXIS)
+        bin_start = (i * bin_span).astype(jnp.int32)
+        counts = pileup_count_kernel(bases, quals, start, flags, mapq, valid,
+                                     cigar_ops, cigar_lens, bin_start,
+                                     bin_span=bin_span + halo,
+                                     max_len=max_len)
+        return ring_halo_merge(counts[:bin_span], counts[bin_span:],
+                               READS_AXIS)
+
+    fn = jax.shard_map(step, mesh=mesh, in_specs=(spec,) * 8, out_specs=spec)
+    return jax.jit(fn)

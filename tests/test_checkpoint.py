@@ -105,3 +105,20 @@ def test_cli_transform_resume(tmp_path, resources):
     t1 = pq.read_table(out1)
     t2 = pq.read_table(out2)
     assert t1.equals(t2)
+
+
+def test_cli_transform_edited_input_invalidates(tmp_path, resources):
+    """An input edited under the same path must not resume stale stages —
+    the fingerprint includes size+mtime, not just the path string."""
+    import shutil
+    from adam_tpu.cli.main import main
+    sam = tmp_path / "in.sam"
+    shutil.copy(resources / "small.sam", sam)
+    ck = str(tmp_path / "ck")
+    rc = main(["transform", str(sam), str(tmp_path / "o1"),
+               "-mark_duplicate_reads", "-checkpoint_dir", ck])
+    assert rc == 0
+    os.utime(sam, ns=(0, 0))  # same bytes, different mtime
+    with pytest.raises(ValueError, match="different pipeline configuration"):
+        main(["transform", str(sam), str(tmp_path / "o2"),
+              "-mark_duplicate_reads", "-checkpoint_dir", ck])
