@@ -128,9 +128,19 @@ class TransformCommand(Command):
                     return f"{path}"
                 try:
                     st = os.stat(path)
-                    return f"{path}:{st.st_size}:{st.st_mtime_ns}"
                 except OSError:
                     return f"{path}:missing"
+                if not os.path.isdir(path):
+                    return f"{path}:{st.st_size}:{st.st_mtime_ns}"
+                # a Parquet dataset directory: a rewritten part file keeps
+                # the dir's own size/mtime, so stamp the entries themselves
+                parts = []
+                for root, _, names in sorted(os.walk(path)):
+                    for name in sorted(names):
+                        fst = os.stat(os.path.join(root, name))
+                        parts.append(
+                            f"{name}:{fst.st_size}:{fst.st_mtime_ns}")
+                return f"{path}:" + ",".join(parts)
             config = [_stamp(args.input), f"dbsnp={_stamp(args.dbsnp_sites)}"] \
                 + [name for name, _ in stages]
             ckpt = CheckpointDir(args.checkpoint_dir, config)
@@ -499,12 +509,20 @@ class PrintTagsCommand(Command):
         to_count = set(args.count.split(",")) if args.count else set()
         tag_counts: Counter = Counter()
         value_counts: dict = {t: Counter() for t in to_count}
+        from ..util.attributes import parse_attribute
         for a in usable:
             for field in a.split("\t") if a else []:
-                tag = field.split(":", 1)[0]
+                try:
+                    tag = parse_attribute(field).tag
+                except ValueError:
+                    # census is best-effort: count nonconforming fields
+                    # under their raw tag rather than aborting the command
+                    tag = field.split(":", 1)[0]
                 tag_counts[tag] += 1
                 if tag in to_count:
-                    value_counts[tag][field.split(":", 2)[2]] += 1
+                    # census keys keep the on-disk SAM encoding (the typed
+                    # value's repr would split '3' vs '3.0' buckets)
+                    value_counts[tag][field.split(":", 2)[-1]] += 1
         for tag, count in tag_counts.most_common():
             print(f"{tag:>3}\t{count}")
             for value, vc in value_counts.get(tag, {}).items():

@@ -13,8 +13,18 @@ from . import parquet as pqio
 from .sam import read_sam
 
 #: columns the flagstat command projects — the 13-field projection of
-#: cli/FlagStat.scala:50-57 collapses to 4 columns with packed flags.
-FLAGSTAT_COLUMNS = ("flags", "mapq", "referenceId", "mateReferenceId")
+#: cli/FlagStat.scala:50-57 collapses to 4 columns once the 11 flag booleans
+#: fold into the packed ``flags`` word (projections.ADAMRecordField).
+def _flagstat_columns():
+    from ..projections import projection
+    return tuple(projection(
+        "readPaired", "properPair", "readMapped", "mateMapped",
+        "readNegativeStrand", "firstOfPair", "secondOfPair",
+        "primaryAlignment", "failedVendorQualityChecks", "duplicateRead",
+        "mapq", "referenceId", "mateReferenceId"))
+
+
+FLAGSTAT_COLUMNS = _flagstat_columns()
 
 
 def load_reads(path: str, *, columns: Optional[Sequence[str]] = None,

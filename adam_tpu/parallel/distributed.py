@@ -32,6 +32,7 @@ psum/all_to_all over ICI), the layout SURVEY.md §2.4 calls for.
 
 from __future__ import annotations
 
+import os
 from functools import lru_cache, partial
 from typing import Optional, Tuple
 
@@ -57,9 +58,17 @@ def initialize(coordinator_address: Optional[str] = None,
 
     Replaces the reference's Akka/Spark control plane (pom.xml:33-35): after
     this, ``jax.devices()`` spans every host and collectives cross DCN.
-    Arguments default to the cluster-autodetect path (TPU metadata / env).
+    With no arguments and no cluster environment (coordinator env vars), this
+    is a true no-op so single-host runs need no special-casing; pass explicit
+    arguments (or run under a cluster launcher that sets them) to join.
     """
     if num_processes is not None and num_processes <= 1:
+        return
+    if (coordinator_address is None and num_processes is None
+            and process_id is None
+            and not any(os.environ.get(k) for k in (
+                "COORDINATOR_ADDRESS", "JAX_COORDINATOR_ADDRESS",
+                "MEGASCALE_COORDINATOR_ADDRESS"))):
         return
     jax.distributed.initialize(coordinator_address=coordinator_address,
                                num_processes=num_processes,
@@ -198,6 +207,21 @@ def ring_halo_merge(stripe: jnp.ndarray, halo: jnp.ndarray,
     return stripe.at[:h].add(incoming.astype(stripe.dtype))
 
 
+def route_by_start(start, mapped, valid, bin_span: int, n_stripes: int):
+    """Host-side start-only routing for the halo-exchange pileup: each read
+    goes to exactly ONE stripe, the one holding its start position.
+
+    This is the required counterpart of :func:`pileup_counts_halo_exchange` —
+    do NOT use ``route_reads_to_stripes`` (parallel/pileup.py) with it: that
+    router *duplicates* boundary-spanning reads into both stripes, which the
+    halo merge would then count twice.  Returns (rows, stripe) for the
+    mapped+valid reads.
+    """
+    rows = np.flatnonzero(np.asarray(mapped) & np.asarray(valid))
+    stripe = np.minimum(np.asarray(start)[rows] // bin_span, n_stripes - 1)
+    return rows.astype(np.int64), stripe.astype(np.int32)
+
+
 def pileup_counts_halo_exchange(mesh: Mesh, bin_span: int, halo: int,
                                 max_len: int):
     """Sequence-parallel pileup without boundary-read duplication.
@@ -210,7 +234,9 @@ def pileup_counts_halo_exchange(mesh: Mesh, bin_span: int, halo: int,
 
     Returns a jitted fn(bases, quals, start, flags, mapq, valid, cigar_ops,
     cigar_lens) -> [n_devices * bin_span, N_CHANNELS] with reads sharded on
-    the leading axis by stripe (route with ``route_reads_to_stripes``).
+    the leading axis by the stripe of their START (route with
+    :func:`route_by_start`; start-only routing is what makes the halo merge
+    count each base exactly once).
     """
     from .pileup import pileup_count_kernel
 

@@ -1,0 +1,97 @@
+"""Field projections — the columnar-discipline API.
+
+Re-designs the reference's ``projections/`` package: ``Projection``/``Filter``
+build a projected schema from a field subset (Projection.scala:10-41) and
+per-record field enumerations name every projectable field
+(ADAMRecordField.scala:28-71 and siblings).  Here each record's fields are a
+namespace over its Arrow schema, and a projection resolves to the column list
+handed to the Parquet reader (io/parquet.load_table) — plus one packing-aware
+twist: the eleven ADAMRecord flag booleans (adam.avdl:31-43) are virtual
+fields that resolve to the packed ``flags`` column (schema.FLAG_FIELDS).
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, List
+
+import pyarrow as pa
+
+from . import schema as S
+
+
+class _FieldNamespace:
+    """Attribute-per-field view over one record schema; iterating yields all
+    concrete column names (the reference's FieldEnumeration)."""
+
+    def __init__(self, record: str, arrow_schema: pa.Schema, virtual=()):
+        self._record = record
+        self._schema = arrow_schema
+        self._virtual = dict(virtual)
+        for name in arrow_schema.names:
+            setattr(self, name, name)
+        for name, target in self._virtual.items():
+            setattr(self, name, name)
+
+    @property
+    def record(self) -> str:
+        return self._record
+
+    @property
+    def arrow_schema(self) -> pa.Schema:
+        return self._schema
+
+    def __iter__(self):
+        return iter(self._schema.names)
+
+    def resolve(self, fields: Iterable[str]) -> List[str]:
+        """Field names -> concrete column names, virtual flag fields folded
+        into their backing column, order preserved, duplicates dropped."""
+        out: List[str] = []
+        for f in fields:
+            col = self._virtual.get(f, f)
+            if col not in self._schema.names:
+                raise ValueError(
+                    f"unknown field {f!r} for record {self._record!r}")
+            if col not in out:
+                out.append(col)
+        return out
+
+
+_FLAG_VIRTUALS = {name: "flags" for name in S.FLAG_FIELDS}
+
+#: ADAMRecordField (projections/ADAMRecordField.scala:28-71) — 39 reference
+#: fields; the 11 booleans resolve to the packed ``flags`` column.
+ADAMRecordField = _FieldNamespace("read", S.READ_SCHEMA, _FLAG_VIRTUALS)
+ADAMPileupField = _FieldNamespace("pileup", S.PILEUP_SCHEMA)
+ADAMVariantField = _FieldNamespace("variant", S.VARIANT_SCHEMA)
+ADAMGenotypeField = _FieldNamespace("genotype", S.GENOTYPE_SCHEMA)
+ADAMVariantDomainField = _FieldNamespace("variantdomain",
+                                         S.VARIANT_DOMAIN_SCHEMA)
+ADAMNucleotideContigField = _FieldNamespace("contig", S.CONTIG_SCHEMA)
+
+_NAMESPACES = {ns.record: ns for ns in (
+    ADAMRecordField, ADAMPileupField, ADAMVariantField, ADAMGenotypeField,
+    ADAMVariantDomainField, ADAMNucleotideContigField)}
+
+
+def namespace_for(record: str) -> _FieldNamespace:
+    return _NAMESPACES[record]
+
+
+def projection(*fields: str, record: str = "read") -> List[str]:
+    """Columns to read for the requested fields (Projection.scala:25-33)."""
+    return _NAMESPACES[record].resolve(fields)
+
+
+def filtered(*excluded: str, record: str = "read") -> List[str]:
+    """Complement projection: every column except the excluded fields
+    (Projection's filter form, Projection.scala:35-41)."""
+    ns = _NAMESPACES[record]
+    drop = set(ns.resolve(excluded))
+    return [c for c in ns if c not in drop]
+
+
+def project_schema(columns: Iterable[str], record: str = "read") -> pa.Schema:
+    """Projected Arrow schema for the column subset."""
+    full = _NAMESPACES[record].arrow_schema
+    return pa.schema([full.field(c) for c in columns])

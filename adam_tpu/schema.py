@@ -234,11 +234,39 @@ VARIANT_DOMAIN_SCHEMA = pa.schema([
     pa.field("in1000G", pa.bool_()),
 ])
 
+#: ADAMNestedPileup (adam.avdl:130-135): a pileup plus its overlapping read
+#: evidence.  Declared but unused by any reference code; carried for schema
+#: parity as nested structs (which is why the reference notes it "cannot be
+#: used with databases" — same caveat applies to flat-columnar projection).
+NESTED_PILEUP_SCHEMA = pa.schema([
+    pa.field("pileup", pa.struct(list(PILEUP_SCHEMA))),
+    pa.field("readEvidence", pa.list_(pa.struct(list(READ_SCHEMA)))),
+])
+
+#: ADAMGenotypeIdentification (adam.avdl:327-345): sample cohort/ethnicity +
+#: record-group fields.  Declared but unused by any reference code.
+GENOTYPE_IDENTIFICATION_SCHEMA = pa.schema([
+    pa.field("sampleEthnicity", pa.string()),
+    pa.field("sampleCohort", pa.string()),
+    pa.field("recordGroupSequencingCenter", pa.string()),
+    pa.field("recordGroupDescription", pa.string()),
+    pa.field("recordGroupRunDateEpoch", pa.int64()),
+    pa.field("recordGroupFlowOrder", pa.string()),
+    pa.field("recordGroupKeySequence", pa.string()),
+    pa.field("recordGroupLibrary", pa.string()),
+    pa.field("recordGroupPredictedMedianInsertSize", pa.int32()),
+    pa.field("recordGroupPlatform", pa.string()),
+    pa.field("recordGroupPlatformUnit", pa.string()),
+    pa.field("recordGroupSample", pa.string()),
+])
+
 SCHEMAS = {
     "read": READ_SCHEMA,
     "contig": CONTIG_SCHEMA,
     "pileup": PILEUP_SCHEMA,
+    "nestedpileup": NESTED_PILEUP_SCHEMA,
     "variant": VARIANT_SCHEMA,
     "genotype": GENOTYPE_SCHEMA,
     "variantdomain": VARIANT_DOMAIN_SCHEMA,
+    "genotypeidentification": GENOTYPE_IDENTIFICATION_SCHEMA,
 }

@@ -1,0 +1,98 @@
+"""Typed SAM optional-field attributes.
+
+Mirrors ``models/Attribute.scala:29-48`` (the ``tag:type:value`` triple with
+its SAM-spec type letters) and ``util/AttributeUtils.scala:26-103`` (parsing
+the tab-separated ``attributes`` column back into typed values).  The read
+schema stores attributes exactly as the reference does — one string column of
+``TAG:T:value`` entries joined by tabs (adam.avdl:48-53) — and this module is
+the typed view over it.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass
+from enum import Enum
+from typing import Any, List, Sequence, Union
+
+
+class TagType(Enum):
+    """SAM optional-field type letters (SAMv1 spec §1.5)."""
+
+    CHARACTER = "A"
+    INTEGER = "i"
+    FLOAT = "f"
+    STRING = "Z"
+    BYTE_SEQUENCE = "H"
+    NUMERIC_SEQUENCE = "B"
+
+    def __str__(self) -> str:
+        return self.value
+
+
+@dataclass(frozen=True)
+class Attribute:
+    """One typed optional field (Attribute.scala:29-31)."""
+
+    tag: str
+    tag_type: TagType
+    value: Any
+
+    def __str__(self) -> str:
+        if self.tag_type is TagType.NUMERIC_SEQUENCE:
+            head = "f" if any(isinstance(v, float) for v in self.value) \
+                else "i"
+            body = head + "," + ",".join(str(v) for v in self.value)
+        elif self.tag_type is TagType.BYTE_SEQUENCE:
+            body = "".join(f"{b:02X}" for b in self.value)
+        else:
+            body = str(self.value)
+        return f"{self.tag}:{self.tag_type}:{body}"
+
+
+_ATTR_RE = re.compile(r"^([^:]{2}):([AifZHB])(?::(.*))?$")
+
+
+def _typed_value(type_letter: str, text: str) -> Any:
+    if type_letter == "A":
+        return text[0]
+    if type_letter == "i":
+        return int(text)
+    if type_letter == "f":
+        return float(text)
+    if type_letter == "Z":
+        return text
+    if type_letter == "H":
+        return bytes.fromhex(text)
+    # B: first subfield is the element type letter, then comma-separated
+    parts = text.split(",")
+    if parts and parts[0] in "cCsSiIf":
+        elem, parts = parts[0], parts[1:]
+    else:  # tolerate the bare form the reference accepts
+        elem = None
+    if elem == "f" or any("." in p or "e" in p.lower() for p in parts):
+        return [float(p) for p in parts]
+    return [int(p) for p in parts]
+
+
+def parse_attribute(encoded: str) -> Attribute:
+    """``TAG:T:value`` -> :class:`Attribute` (AttributeUtils.scala:62-71)."""
+    m = _ATTR_RE.match(encoded)
+    if not m:
+        raise ValueError(
+            f"attribute string {encoded!r} doesn't match tag:type:value")
+    tag, letter, text = m.group(1), m.group(2), m.group(3) or ""
+    return Attribute(tag, TagType(letter), _typed_value(letter, text))
+
+
+def parse_attributes(tag_string: Union[str, None]) -> List[Attribute]:
+    """Parse the tab-joined ``attributes`` column value
+    (AttributeUtils.scala:53-58); empty/None -> []."""
+    if not tag_string:
+        return []
+    return [parse_attribute(s) for s in tag_string.split("\t") if s]
+
+
+def format_attributes(attrs: Sequence[Attribute]) -> str:
+    """Inverse of :func:`parse_attributes`: the on-disk column encoding."""
+    return "\t".join(str(a) for a in attrs)

@@ -1,0 +1,72 @@
+"""GATK-style interval list files.
+
+Re-designs ``util/IntervalListReader.scala:31-80``: a tab-separated file of
+``contig  start  end  strand  name`` lines preceded by a SAM-style header
+whose ``@SQ`` lines carry the sequence dictionary.  One deviation from the
+reference, which parses column 0 with ``toInt`` (so named contigs crash):
+contig names resolve through the header dictionary first, falling back to
+the integer form for dictionary-less files.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, List, Tuple
+
+from ..models.dictionary import SequenceDictionary, SequenceRecord
+from ..models.region import ReferenceRegion
+
+
+def _parse_sq_line(line: str, next_id: int) -> SequenceRecord:
+    name, length, url = None, None, None
+    for field in line.rstrip("\n").split("\t")[1:]:
+        key, _, value = field.partition(":")
+        if key == "SN":
+            name = value
+        elif key == "LN":
+            length = int(value)
+        elif key == "UR":
+            url = value
+    if name is None or length is None:
+        raise ValueError(f"@SQ line missing SN/LN: {line!r}")
+    return SequenceRecord(next_id, name, length, url)
+
+
+class IntervalListReader:
+    """Iterate (ReferenceRegion, name) pairs from an interval list file.
+
+    The embedded dictionary is available as :attr:`sequence_dictionary`
+    (IntervalListReader.scala:37-49); ids are assigned in header order.
+    """
+
+    def __init__(self, path):
+        self.path = path
+        self._dict: SequenceDictionary | None = None
+
+    @property
+    def sequence_dictionary(self) -> SequenceDictionary:
+        if self._dict is None:
+            records: List[SequenceRecord] = []
+            with open(self.path, encoding="utf-8") as f:
+                for line in f:
+                    if line.startswith("@SQ"):
+                        records.append(_parse_sq_line(line, len(records)))
+            self._dict = SequenceDictionary(records)
+        return self._dict
+
+    def __iter__(self) -> Iterator[Tuple[ReferenceRegion, str]]:
+        seq_dict = self.sequence_dictionary
+        with open(self.path, encoding="utf-8") as f:
+            for line in f:
+                if line.startswith("@") or not line.strip():
+                    continue
+                contig, start, end, strand, name = \
+                    line.rstrip("\n").split("\t")[:5]
+                if strand != "+":
+                    raise ValueError(
+                        f"only +-strand intervals supported: {line!r}")
+                rec = seq_dict.get(contig)
+                ref_id = rec.id if rec is not None else int(contig)
+                yield ReferenceRegion(ref_id, int(start), int(end)), name
+
+    def regions(self) -> List[Tuple[ReferenceRegion, str]]:
+        return list(self)

@@ -106,7 +106,10 @@ def test_pileup_halo_exchange_matches_single_device():
     (bases, quals, start, flags, mapq, valid, cigar_ops, cigar_lens) = cols
 
     # route each read to the stripe of its *start* (halo covers the overhang)
-    stripe_of = np.minimum(start // span, n_dev - 1)
+    from adam_tpu.parallel.distributed import route_by_start
+    rows, stripe_of = route_by_start(start, np.ones_like(valid), valid,
+                                     span, n_dev)
+    assert (rows == np.arange(len(start))).all()  # one slot per read, no dup
     order = np.argsort(stripe_of, kind="stable")
     # pad so every stripe holds exactly max count
     counts = np.bincount(stripe_of, minlength=n_dev)

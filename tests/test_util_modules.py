@@ -1,0 +1,87 @@
+"""Typed attributes (AttributeUtils.scala:26-103), GATK interval lists
+(IntervalListReader.scala:31-80), and field projections (projections/*)."""
+
+import pytest
+
+from adam_tpu.projections import (ADAMRecordField, filtered, project_schema,
+                                  projection)
+from adam_tpu.util.attributes import (Attribute, TagType, format_attributes,
+                                      parse_attribute, parse_attributes)
+from adam_tpu.util.intervals import IntervalListReader
+
+
+# -- attributes ------------------------------------------------------------
+
+def test_parse_typed_attributes():
+    attrs = parse_attributes("NM:i:0\tAS:i:75\tXA:Z:chr1,+100,75M,0")
+    assert [a.tag for a in attrs] == ["NM", "AS", "XA"]
+    assert attrs[0] == Attribute("NM", TagType.INTEGER, 0)
+    assert attrs[1].value == 75
+    assert attrs[2].value == "chr1,+100,75M,0"
+
+
+@pytest.mark.parametrize("encoded,value", [
+    ("XC:A:c", "c"),
+    ("XF:f:1.5", 1.5),
+    ("XH:H:1A2B", b"\x1a\x2b"),
+    ("XB:B:i,1,2,-3", [1, 2, -3]),
+    ("XB:B:f,0.5,2.0", [0.5, 2.0]),
+])
+def test_parse_attribute_types(encoded, value):
+    assert parse_attribute(encoded).value == value
+
+
+def test_attribute_roundtrip():
+    s = "NM:i:0\tXC:A:c\tXF:f:1.5\tXB:B:i,1,2"
+    assert format_attributes(parse_attributes(s)) == s
+
+
+def test_parse_attribute_rejects_malformed():
+    with pytest.raises(ValueError):
+        parse_attribute("bad")
+    assert parse_attributes("") == []
+    assert parse_attributes(None) == []
+
+
+# -- interval lists --------------------------------------------------------
+
+def test_interval_list_reader(resources):
+    reader = IntervalListReader(resources / "example_intervals.list")
+    d = reader.sequence_dictionary
+    assert d["1"].length == 249250621
+    regions = reader.regions()
+    assert len(regions) == 6
+    region, name = regions[0]
+    assert name == "target_1"
+    assert (region.ref_id, region.start, region.end) == (d["1"].id, 30366,
+                                                         30503)
+    # every interval names a contig from the embedded dictionary
+    assert {r.ref_id for r, _ in regions} <= {rec.id for rec in d}
+
+
+# -- projections -----------------------------------------------------------
+
+def test_flag_fields_fold_into_flags_column():
+    cols = projection("readMapped", "duplicateRead", "mapq")
+    assert cols == ["flags", "mapq"]
+
+
+def test_projection_unknown_field_raises():
+    with pytest.raises(ValueError, match="unknown field"):
+        projection("noSuchField")
+
+
+def test_filtered_excludes():
+    cols = filtered("sequence", "qual")
+    assert "sequence" not in cols and "qual" not in cols
+    assert "start" in cols and "flags" in cols
+
+
+def test_project_schema_subset():
+    sch = project_schema(["start", "mapq"])
+    assert sch.names == ["start", "mapq"]
+
+
+def test_namespace_attribute_access():
+    assert ADAMRecordField.start == "start"
+    assert ADAMRecordField.readMapped == "readMapped"
