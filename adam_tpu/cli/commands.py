@@ -137,9 +137,15 @@ class TransformCommand(Command):
                 parts = []
                 for root, _, names in sorted(os.walk(path)):
                     for name in sorted(names):
-                        fst = os.stat(os.path.join(root, name))
+                        full = os.path.join(root, name)
+                        rel = os.path.relpath(full, path)
+                        try:
+                            fst = os.stat(full)
+                        except OSError:
+                            parts.append(f"{rel}:missing")
+                            continue
                         parts.append(
-                            f"{name}:{fst.st_size}:{fst.st_mtime_ns}")
+                            f"{rel}:{fst.st_size}:{fst.st_mtime_ns}")
                 return f"{path}:" + ",".join(parts)
             config = [_stamp(args.input), f"dbsnp={_stamp(args.dbsnp_sites)}"] \
                 + [name for name, _ in stages]
@@ -509,15 +515,11 @@ class PrintTagsCommand(Command):
         to_count = set(args.count.split(",")) if args.count else set()
         tag_counts: Counter = Counter()
         value_counts: dict = {t: Counter() for t in to_count}
-        from ..util.attributes import parse_attribute
         for a in usable:
             for field in a.split("\t") if a else []:
-                try:
-                    tag = parse_attribute(field).tag
-                except ValueError:
-                    # census is best-effort: count nonconforming fields
-                    # under their raw tag rather than aborting the command
-                    tag = field.split(":", 1)[0]
+                # tag census stays a cheap split (this is the CLI hot loop);
+                # util.attributes provides the typed view when values matter
+                tag = field.split(":", 1)[0]
                 tag_counts[tag] += 1
                 if tag in to_count:
                     # census keys keep the on-disk SAM encoding (the typed

@@ -58,21 +58,29 @@ def initialize(coordinator_address: Optional[str] = None,
 
     Replaces the reference's Akka/Spark control plane (pom.xml:33-35): after
     this, ``jax.devices()`` spans every host and collectives cross DCN.
-    With no arguments and no cluster environment (coordinator env vars), this
-    is a true no-op so single-host runs need no special-casing; pass explicit
-    arguments (or run under a cluster launcher that sets them) to join.
+    With no arguments this tries JAX's cluster autodetection (TPU metadata,
+    SLURM/OMPI env, coordinator env vars); when no cluster can be detected —
+    a plain single-host run — the detection failure is swallowed and the
+    call is a no-op, so callers need no special-casing.  Explicit arguments
+    are always honored (and their failures always raised).
     """
     if num_processes is not None and num_processes <= 1:
         return
-    if (coordinator_address is None and num_processes is None
-            and process_id is None
-            and not any(os.environ.get(k) for k in (
-                "COORDINATOR_ADDRESS", "JAX_COORDINATOR_ADDRESS",
-                "MEGASCALE_COORDINATOR_ADDRESS"))):
-        return
-    jax.distributed.initialize(coordinator_address=coordinator_address,
-                               num_processes=num_processes,
-                               process_id=process_id)
+    explicit = (coordinator_address is not None or num_processes is not None
+                or process_id is not None)
+    cluster_markers = ("SLURM_JOB_ID", "OMPI_COMM_WORLD_SIZE",
+                       "COORDINATOR_ADDRESS", "JAX_COORDINATOR_ADDRESS",
+                       "MEGASCALE_COORDINATOR_ADDRESS", "CLOUD_TPU_TASK_ID")
+    try:
+        jax.distributed.initialize(coordinator_address=coordinator_address,
+                                   num_processes=num_processes,
+                                   process_id=process_id)
+    except (RuntimeError, ValueError):
+        if explicit or any(os.environ.get(k) for k in cluster_markers):
+            # a cluster was asked for or is visibly present: a failed join
+            # must be loud, or psums silently report per-host partials
+            raise
+        # no cluster detected: single-host run, nothing to join
 
 
 def make_host_mesh(devices=None) -> Mesh:

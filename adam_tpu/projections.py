@@ -85,8 +85,18 @@ def projection(*fields: str, record: str = "read") -> List[str]:
 
 def filtered(*excluded: str, record: str = "read") -> List[str]:
     """Complement projection: every column except the excluded fields
-    (Projection's filter form, Projection.scala:35-41)."""
+    (Projection's filter form, Projection.scala:35-41).
+
+    Virtual flag fields cannot be excluded — dropping one would drop the
+    shared packed ``flags`` column and take the other ten booleans with it;
+    exclude ``"flags"`` itself to drop them all.
+    """
     ns = _NAMESPACES[record]
+    virtual = [f for f in excluded if f in ns._virtual]
+    if virtual:
+        raise ValueError(
+            f"cannot exclude virtual flag field(s) {virtual}: they share "
+            "the packed 'flags' column; exclude 'flags' to drop all of them")
     drop = set(ns.resolve(excluded))
     return [c for c in ns if c not in drop]
 

@@ -32,16 +32,21 @@ class TagType(Enum):
 
 @dataclass(frozen=True)
 class Attribute:
-    """One typed optional field (Attribute.scala:29-31)."""
+    """One typed optional field (Attribute.scala:29-31).
+
+    ``array_subtype`` preserves the element-type letter of a B-typed array
+    (``c/C/s/S/i/I/f``) so round-tripping keeps the on-disk encoding.
+    """
 
     tag: str
     tag_type: TagType
     value: Any
+    array_subtype: Union[str, None] = None
 
     def __str__(self) -> str:
         if self.tag_type is TagType.NUMERIC_SEQUENCE:
-            head = "f" if any(isinstance(v, float) for v in self.value) \
-                else "i"
+            head = self.array_subtype or (
+                "f" if any(isinstance(v, float) for v in self.value) else "i")
             body = head + "," + ",".join(str(v) for v in self.value)
         elif self.tag_type is TagType.BYTE_SEQUENCE:
             body = "".join(f"{b:02X}" for b in self.value)
@@ -53,26 +58,28 @@ class Attribute:
 _ATTR_RE = re.compile(r"^([^:]{2}):([AifZHB])(?::(.*))?$")
 
 
-def _typed_value(type_letter: str, text: str) -> Any:
+def _typed_value(type_letter: str, text: str):
     if type_letter == "A":
-        return text[0]
+        if not text:
+            raise ValueError("empty value for A-typed attribute")
+        return text[0], None
     if type_letter == "i":
-        return int(text)
+        return int(text), None
     if type_letter == "f":
-        return float(text)
+        return float(text), None
     if type_letter == "Z":
-        return text
+        return text, None
     if type_letter == "H":
-        return bytes.fromhex(text)
+        return bytes.fromhex(text), None
     # B: first subfield is the element type letter, then comma-separated
     parts = text.split(",")
-    if parts and parts[0] in "cCsSiIf":
+    if parts and parts[0] in ("c", "C", "s", "S", "i", "I", "f"):
         elem, parts = parts[0], parts[1:]
     else:  # tolerate the bare form the reference accepts
         elem = None
     if elem == "f" or any("." in p or "e" in p.lower() for p in parts):
-        return [float(p) for p in parts]
-    return [int(p) for p in parts]
+        return [float(p) for p in parts], elem
+    return [int(p) for p in parts], elem
 
 
 def parse_attribute(encoded: str) -> Attribute:
@@ -82,7 +89,8 @@ def parse_attribute(encoded: str) -> Attribute:
         raise ValueError(
             f"attribute string {encoded!r} doesn't match tag:type:value")
     tag, letter, text = m.group(1), m.group(2), m.group(3) or ""
-    return Attribute(tag, TagType(letter), _typed_value(letter, text))
+    value, subtype = _typed_value(letter, text)
+    return Attribute(tag, TagType(letter), value, subtype)
 
 
 def parse_attributes(tag_string: Union[str, None]) -> List[Attribute]:

@@ -9,10 +9,14 @@ through device transfer to the materialized [K, 2] counter block — i.e. the
 device side of the real pipeline, excluding only the format decode that the
 IO layer benches separately.
 
-The packed wire layout is the compact one projection discipline dictates:
-flags u16, mapq u8, refid/mate_refid i16, valid bool = 8 bytes/read; the
-kernel widens on device.  (The reference's trick was projecting 13 Parquet
-fields; column-width discipline matters even more over a PCIe/tunnel link.)
+The wire layout is the reference's projection discipline pushed to the
+limit: flagstat consumes 26 bits per read (flag word, mapq, the
+cross-chromosome comparison, validity), so the packer ships exactly one u32
+word per read (ops/flagstat.pack_flagstat_wire32) in one contiguous buffer.
+The transfer link is the bottleneck (~260 MB/s steady over the tunnel;
+five separate column copies or u8 buffers run at half that or worse), so
+wire bytes/read directly set the throughput ceiling.  (The reference's
+trick was projecting 13 Parquet fields out of 39; same idea, harder edge.)
 """
 
 from __future__ import annotations
@@ -28,8 +32,9 @@ BASELINE_READS_PER_S = N_READS / 17.0
 
 def main() -> None:
     import jax
-    import jax.numpy as jnp
-    from adam_tpu.ops.flagstat import flagstat_kernel
+
+    from adam_tpu.ops.flagstat import (flagstat_kernel_wire32,
+                                       pack_flagstat_wire32)
 
     rng = np.random.RandomState(0)
     n = N_READS
@@ -38,15 +43,13 @@ def main() -> None:
     refid = rng.randint(0, 24, size=n).astype(np.int16)
     mate_refid = rng.randint(0, 24, size=n).astype(np.int16)
     valid = np.ones(n, bool)
-    host_cols = (flags, mapq, refid, mate_refid, valid)
 
-    @jax.jit
-    def fn(f, m, r, mr, v):
-        return flagstat_kernel(f.astype(jnp.int32), m.astype(jnp.int32),
-                               r.astype(jnp.int32), mr.astype(jnp.int32), v)
+    fn = jax.jit(flagstat_kernel_wire32)
 
     def run():
-        out = fn(*[jax.device_put(c) for c in host_cols])
+        # per-batch host packing is real pipeline work: time it too
+        wire = pack_flagstat_wire32(flags, mapq, refid, mate_refid, valid)
+        out = fn(jax.device_put(wire))
         jax.block_until_ready(out)
         return out
 

@@ -186,11 +186,53 @@ static PyObject *pack(PyObject *self, PyObject *args) {
     return PyLong_FromSsize_t(i);
 }
 
+/* ---------------------------------------------------- pack_wire32 */
+/* Fused flagstat wire packing: one pass over the five projected columns
+ * into the 4-byte-per-read word (ops/flagstat.pack_flagstat_wire32):
+ * flags(16) | mapq(8)<<16 | valid<<24 | (refid != mate_refid)<<25.
+ * The transfer link is the flagstat bottleneck, so the host-side pack
+ * must not become one: a single C pass instead of numpy temporaries. */
+static PyObject *pack_wire32(PyObject *self, PyObject *args) {
+    Py_buffer flags, mapq, refid, mate, valid, out;
+    if (!PyArg_ParseTuple(args, "y*y*y*y*y*w*", &flags, &mapq, &refid,
+                          &mate, &valid, &out))
+        return NULL;
+    Py_ssize_t n = out.len / 4;
+    if (flags.len != 2 * n || mapq.len != n || refid.len != 2 * n ||
+        mate.len != 2 * n || valid.len != n) {
+        PyBuffer_Release(&flags); PyBuffer_Release(&mapq);
+        PyBuffer_Release(&refid); PyBuffer_Release(&mate);
+        PyBuffer_Release(&valid); PyBuffer_Release(&out);
+        PyErr_SetString(PyExc_ValueError, "column length mismatch");
+        return NULL;
+    }
+    const uint16_t *f = (const uint16_t *)flags.buf;
+    const uint8_t *q = (const uint8_t *)mapq.buf;
+    const int16_t *r = (const int16_t *)refid.buf;
+    const int16_t *m = (const int16_t *)mate.buf;
+    const uint8_t *v = (const uint8_t *)valid.buf;
+    uint32_t *w = (uint32_t *)out.buf;
+    Py_BEGIN_ALLOW_THREADS
+    for (Py_ssize_t i = 0; i < n; i++) {
+        w[i] = (uint32_t)f[i] | ((uint32_t)q[i] << 16) |
+               ((uint32_t)(v[i] != 0) << 24) |
+               ((uint32_t)(r[i] != m[i]) << 25);
+    }
+    Py_END_ALLOW_THREADS
+    PyBuffer_Release(&flags); PyBuffer_Release(&mapq);
+    PyBuffer_Release(&refid); PyBuffer_Release(&mate);
+    PyBuffer_Release(&valid); PyBuffer_Release(&out);
+    Py_RETURN_NONE;
+}
+
 static PyMethodDef methods[] = {
     {"scan", scan, METH_VARARGS,
      "scan(data, offset) -> (n_records, max_read_len, max_cigar_ops)"},
     {"pack", pack, METH_VARARGS,
      "pack(data, offset, *column_buffers, max_len, max_cigar) -> n_packed"},
+    {"pack_wire32", pack_wire32, METH_VARARGS,
+     "pack_wire32(flags_u16, mapq_u8, refid_i16, mate_i16, valid_u8, "
+     "out_u32) -> None"},
     {NULL, NULL, 0, NULL}};
 
 static struct PyModuleDef module = {

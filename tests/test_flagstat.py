@@ -88,3 +88,50 @@ def test_report_shape():
     assert "1 + 0 in total (QC-passed reads + QC-failed reads)" in report
     assert "1 + 0 mapped (100.00%:0.00%)" in report
     assert len(report.strip().splitlines()) == 18
+
+
+def test_wire_pack_roundtrip_matches_columns():
+    """The contiguous wire block must reproduce the five-column kernel
+    exactly (pack on host, bitcast-unpack on device)."""
+    import numpy as np
+    import jax.numpy as jnp
+    from adam_tpu.ops.flagstat import (flagstat_kernel, flagstat_kernel_wire,
+                                       pack_flagstat_wire)
+    rng = np.random.RandomState(7)
+    n = 4096
+    flags = rng.randint(0, 1 << 12, size=n).astype(np.uint16)
+    mapq = rng.randint(0, 255, size=n).astype(np.uint8)
+    refid = rng.randint(-1, 30, size=n).astype(np.int16)
+    mate = rng.randint(-1, 30, size=n).astype(np.int16)
+    valid = rng.rand(n) < 0.9
+    ref = flagstat_kernel(jnp.asarray(flags.astype(np.int32)),
+                          jnp.asarray(mapq.astype(np.int32)),
+                          jnp.asarray(refid.astype(np.int32)),
+                          jnp.asarray(mate.astype(np.int32)),
+                          jnp.asarray(valid))
+    wire = pack_flagstat_wire(flags, mapq, refid, mate, valid)
+    got = flagstat_kernel_wire(jnp.asarray(wire))
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(ref))
+
+
+def test_wire32_matches_columns():
+    import numpy as np
+    import jax.numpy as jnp
+    from adam_tpu.ops.flagstat import (flagstat_kernel,
+                                       flagstat_kernel_wire32,
+                                       pack_flagstat_wire32)
+    rng = np.random.RandomState(11)
+    n = 4096
+    flags = rng.randint(0, 1 << 12, size=n).astype(np.uint16)
+    mapq = rng.randint(0, 255, size=n).astype(np.uint8)
+    refid = rng.randint(-1, 30, size=n).astype(np.int16)
+    mate = rng.randint(-1, 30, size=n).astype(np.int16)
+    valid = rng.rand(n) < 0.9
+    ref = flagstat_kernel(jnp.asarray(flags.astype(np.int32)),
+                          jnp.asarray(mapq.astype(np.int32)),
+                          jnp.asarray(refid.astype(np.int32)),
+                          jnp.asarray(mate.astype(np.int32)),
+                          jnp.asarray(valid))
+    wire = pack_flagstat_wire32(flags, mapq, refid, mate, valid)
+    got = flagstat_kernel_wire32(jnp.asarray(wire))
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(ref))

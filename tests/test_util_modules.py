@@ -85,3 +85,20 @@ def test_project_schema_subset():
 def test_namespace_attribute_access():
     assert ADAMRecordField.start == "start"
     assert ADAMRecordField.readMapped == "readMapped"
+
+
+def test_b_array_subtype_preserved():
+    a = parse_attribute("XB:B:c,1,2")
+    assert a.value == [1, 2] and a.array_subtype == "c"
+    assert str(a) == "XB:B:c,1,2"
+
+
+def test_empty_char_attribute_raises_valueerror():
+    with pytest.raises(ValueError):
+        parse_attribute("XC:A:")
+
+
+def test_filtered_rejects_virtual_flag_fields():
+    with pytest.raises(ValueError, match="virtual flag field"):
+        filtered("mateNegativeStrand")
+    assert "flags" not in filtered("flags")
