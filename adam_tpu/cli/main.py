@@ -37,6 +37,20 @@ def _load_commands() -> None:
     from . import commands  # noqa: F401
 
 
+def _honor_platform_env() -> None:
+    """Make ``JAX_PLATFORMS=cpu adam-tpu ...`` actually run on CPU.
+
+    Some PJRT plugins register themselves regardless of the env var; the
+    config update wins (same workaround as tests/conftest.py).  Harmless if
+    jax is already imported or the var is unset.
+    """
+    import os
+    plat = os.environ.get("JAX_PLATFORMS")
+    if plat:
+        import jax
+        jax.config.update("jax_platforms", plat)
+
+
 def main(argv=None) -> int:
     _load_commands()
     parser = argparse.ArgumentParser(
@@ -53,6 +67,9 @@ def main(argv=None) -> int:
     if not getattr(args, "_cmd", None):
         parser.print_help()
         return 1
+    # after parsing (so --help stays jax-import-free), before any command
+    # can initialize a backend
+    _honor_platform_env()
     from ..errors import FormatError
     from ..instrument import log_invocation
     log_invocation(["adam-tpu"] + list(argv if argv is not None

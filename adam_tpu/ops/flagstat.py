@@ -133,6 +133,20 @@ WIRE_BYTES = 8
 _REFID_BIAS = 1 << 15
 
 
+def _check_refid_range(refid, mate_refid):
+    """Both wire formats carry refids in 16 bits; values outside int16
+    would silently corrupt neighboring fields (or wrap and fake a
+    same-chromosome mate), so refuse loudly."""
+    for name, col in (("refid", refid), ("mate_refid", mate_refid)):
+        col = np.asarray(col)
+        if col.dtype.itemsize > 2 and col.size and (
+                col.min() < -_REFID_BIAS or col.max() >= _REFID_BIAS):
+            raise ValueError(
+                f"{name} outside int16 range: the flagstat wire formats "
+                "carry 16-bit reference ids (supports up to 32k contigs); "
+                "renumber or use the unpacked kernel for wider ids")
+
+
 def pack_flagstat_wire(flags, mapq, refid, mate_refid, valid) -> np.ndarray:
     """Pack the five flagstat columns into ONE contiguous [2N] u32 buffer.
 
@@ -145,6 +159,7 @@ def pack_flagstat_wire(flags, mapq, refid, mate_refid, valid) -> np.ndarray:
     ~130 MB/s.  The device unbundles with shifts, which XLA fuses into the
     counting pass.
     """
+    _check_refid_range(refid, mate_refid)
     word_a = (flags.astype(np.uint32)
               | (mapq.astype(np.uint32) << 16)
               | ((valid != 0).astype(np.uint32) << 24))
@@ -185,6 +200,7 @@ def pack_flagstat_wire32(flags, mapq, refid, mate_refid, valid) -> np.ndarray:
     wire halves the wall time.  Use the 8-byte block when downstream kernels
     need real refids.
     """
+    _check_refid_range(refid, mate_refid)
     n = len(flags)
     cols = (np.ascontiguousarray(flags, np.uint16),
             np.ascontiguousarray(mapq, np.uint8),

@@ -58,29 +58,28 @@ def initialize(coordinator_address: Optional[str] = None,
 
     Replaces the reference's Akka/Spark control plane (pom.xml:33-35): after
     this, ``jax.devices()`` spans every host and collectives cross DCN.
-    With no arguments this tries JAX's cluster autodetection (TPU metadata,
-    SLURM/OMPI env, coordinator env vars); when no cluster can be detected —
-    a plain single-host run — the detection failure is swallowed and the
-    call is a no-op, so callers need no special-casing.  Explicit arguments
-    are always honored (and their failures always raised).
+    The contract is explicit opt-in: the runtime is joined only when
+    arguments are passed or a coordinator address is in the environment
+    (JAX_COORDINATOR_ADDRESS / COORDINATOR_ADDRESS /
+    MEGASCALE_COORDINATOR_ADDRESS — what multi-host launchers export).
+    Otherwise this is a true no-op, so a lone process inside a SLURM/MPI
+    allocation never blocks on an 8-way barrier it was not meant to join,
+    and a plain single-host run needs no special-casing.  Join failures are
+    always raised — a swallowed failure would mean psums silently reporting
+    per-host partial results.
     """
     if num_processes is not None and num_processes <= 1:
         return
     explicit = (coordinator_address is not None or num_processes is not None
                 or process_id is not None)
-    cluster_markers = ("SLURM_JOB_ID", "OMPI_COMM_WORLD_SIZE",
-                       "COORDINATOR_ADDRESS", "JAX_COORDINATOR_ADDRESS",
-                       "MEGASCALE_COORDINATOR_ADDRESS", "CLOUD_TPU_TASK_ID")
-    try:
-        jax.distributed.initialize(coordinator_address=coordinator_address,
-                                   num_processes=num_processes,
-                                   process_id=process_id)
-    except (RuntimeError, ValueError):
-        if explicit or any(os.environ.get(k) for k in cluster_markers):
-            # a cluster was asked for or is visibly present: a failed join
-            # must be loud, or psums silently report per-host partials
-            raise
-        # no cluster detected: single-host run, nothing to join
+    coordinator_env = any(os.environ.get(k) for k in (
+        "JAX_COORDINATOR_ADDRESS", "COORDINATOR_ADDRESS",
+        "MEGASCALE_COORDINATOR_ADDRESS"))
+    if not explicit and not coordinator_env:
+        return
+    jax.distributed.initialize(coordinator_address=coordinator_address,
+                               num_processes=num_processes,
+                               process_id=process_id)
 
 
 def make_host_mesh(devices=None) -> Mesh:
@@ -248,6 +247,11 @@ def pileup_counts_halo_exchange(mesh: Mesh, bin_span: int, halo: int,
     """
     from .pileup import pileup_count_kernel
 
+    if halo > bin_span:
+        raise ValueError(
+            f"halo {halo} exceeds bin_span {bin_span}: one ring step only "
+            "reaches the immediate neighbor, so overhang beyond a full "
+            "stripe would be lost — widen the stripes or shrink the halo")
     spec = P(READS_AXIS)
 
     def step(bases, quals, start, flags, mapq, valid, cigar_ops, cigar_lens):

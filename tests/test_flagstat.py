@@ -135,3 +135,20 @@ def test_wire32_matches_columns():
     wire = pack_flagstat_wire32(flags, mapq, refid, mate, valid)
     got = flagstat_kernel_wire32(jnp.asarray(wire))
     np.testing.assert_array_equal(np.asarray(got), np.asarray(ref))
+
+
+def test_wire_pack_rejects_wide_refids():
+    import numpy as np
+    import pytest
+    from adam_tpu.ops.flagstat import (pack_flagstat_wire,
+                                       pack_flagstat_wire32)
+    n = 8
+    flags = np.zeros(n, np.uint16)
+    mapq = np.zeros(n, np.uint8)
+    wide = np.full(n, 40000, np.int32)
+    ok = np.zeros(n, np.int32)
+    valid = np.ones(n, bool)
+    for packer in (pack_flagstat_wire, pack_flagstat_wire32):
+        with pytest.raises(ValueError, match="int16 range"):
+            packer(flags, mapq, wide, ok, valid)
+        packer(flags, mapq, ok, ok, valid)  # in-range int32 is fine
