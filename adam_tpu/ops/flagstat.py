@@ -139,8 +139,10 @@ def _check_refid_range(refid, mate_refid):
     same-chromosome mate), so refuse loudly."""
     for name, col in (("refid", refid), ("mate_refid", mate_refid)):
         col = np.asarray(col)
-        if col.dtype.itemsize > 2 and col.size and (
-                col.min() < -_REFID_BIAS or col.max() >= _REFID_BIAS):
+        info = np.iinfo(col.dtype)
+        may_exceed = info.min < -_REFID_BIAS or info.max >= _REFID_BIAS
+        if may_exceed and col.size and (
+                int(col.min()) < -_REFID_BIAS or int(col.max()) >= _REFID_BIAS):
             raise ValueError(
                 f"{name} outside int16 range: the flagstat wire formats "
                 "carry 16-bit reference ids (supports up to 32k contigs); "

@@ -152,3 +152,14 @@ def test_wire_pack_rejects_wide_refids():
         with pytest.raises(ValueError, match="int16 range"):
             packer(flags, mapq, wide, ok, valid)
         packer(flags, mapq, ok, ok, valid)  # in-range int32 is fine
+
+
+def test_wire_pack_rejects_wide_uint16_refids():
+    import numpy as np
+    import pytest
+    from adam_tpu.ops.flagstat import pack_flagstat_wire32
+    n = 4
+    with pytest.raises(ValueError, match="int16 range"):
+        pack_flagstat_wire32(np.zeros(n, np.uint16), np.zeros(n, np.uint8),
+                             np.full(n, 40000, np.uint16),
+                             np.zeros(n, np.uint16), np.ones(n, bool))
