@@ -58,26 +58,26 @@ def initialize(coordinator_address: Optional[str] = None,
 
     Replaces the reference's Akka/Spark control plane (pom.xml:33-35): after
     this, ``jax.devices()`` spans every host and collectives cross DCN.
-    The join happens when (a) arguments are passed, (b) a coordinator
-    address is in the environment (what multi-host launchers export), or
-    (c) TPU-pod worker markers are present (CLOUD_TPU_TASK_ID /
-    TPU_WORKER_ID / TPU_WORKER_HOSTNAMES — the metadata-autodetect case).
-    Whenever a join is attempted, failures RAISE — a swallowed failure
-    would mean psums silently reporting per-host partial results.  A bare
-    SLURM/MPI allocation with none of the above is deliberately NOT joined:
-    a lone `adam-tpu` process inside `salloc -n 8` must not block on an
-    8-way barrier it was never meant to be part of — launchers that want
-    the join must export a coordinator address.
+    The contract is explicit opt-in: the join happens only when arguments
+    are passed or a coordinator address is in the environment
+    (JAX_COORDINATOR_ADDRESS / COORDINATOR_ADDRESS /
+    MEGASCALE_COORDINATOR_ADDRESS — what multi-host launchers export).
+    Anything implicit (SLURM job vars, TPU-pod worker metadata) deliberately
+    does NOT trigger a join: those markers are present for lone processes
+    too — a single process SSH'd onto one worker of a slice, or inside
+    `salloc -n 8` — and an inferred barrier would block them forever.
+    Multi-host launches must export a coordinator address (or pass
+    arguments); whenever a join is attempted, failures RAISE — a swallowed
+    failure would mean psums silently reporting per-host partial results.
     """
     if num_processes is not None and num_processes <= 1:
         return
     explicit = (coordinator_address is not None or num_processes is not None
                 or process_id is not None)
-    cluster_env = any(os.environ.get(k) for k in (
+    coordinator_env = any(os.environ.get(k) for k in (
         "JAX_COORDINATOR_ADDRESS", "COORDINATOR_ADDRESS",
-        "MEGASCALE_COORDINATOR_ADDRESS",
-        "CLOUD_TPU_TASK_ID", "TPU_WORKER_ID", "TPU_WORKER_HOSTNAMES"))
-    if not explicit and not cluster_env:
+        "MEGASCALE_COORDINATOR_ADDRESS"))
+    if not explicit and not coordinator_env:
         return
     jax.distributed.initialize(coordinator_address=coordinator_address,
                                num_processes=num_processes,

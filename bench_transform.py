@@ -1,0 +1,106 @@
+"""Device throughput of the transform pipeline's inner kernels — evidence
+toward the north-star target (BASELINE.md: markdup+BQSR >= 10 M reads/s).
+
+Measures the per-batch DEVICE work of `transform` on synthetic 100 bp reads:
+markdup 5'-geometry + phred>=15 scoring, BQSR pass-1 covariate counting
+(the psum-merged RecalTable scatter), and the BQSR apply rewrite — the three
+per-read hot loops the reference runs as Scala inner loops inside Spark
+executors (MarkDuplicates.scala:37-43, StandardCovariate.scala:27-103,
+RecalUtil.scala:31-42).
+
+Host->device transfer of the packed columns is included (batch streaming),
+like bench.py.  Prints one JSON line per stage plus the fused pipeline.
+Not run by the driver (bench.py stays the single-line flagstat bench); run
+manually: `python bench_transform.py [n_reads]`.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+L = 100
+C = 8
+N_RG = 4
+
+
+def make_batch(n, rng):
+    return dict(
+        flags=np.where(rng.rand(n) < 0.5, 16, 0).astype(np.int32),
+        mapq=rng.randint(0, 61, size=n).astype(np.int32),
+        start=rng.randint(0, 1 << 28, size=n).astype(np.int32),
+        valid=np.ones(n, bool),
+        read_group=rng.randint(0, N_RG, size=n).astype(np.int32),
+        read_len=np.full(n, L, np.int32),
+        bases=rng.randint(0, 4, size=(n, L)).astype(np.int8),
+        quals=rng.randint(2, 41, size=(n, L)).astype(np.int8),
+        state=rng.randint(0, 3, size=(n, L)).astype(np.int8),
+        cigar_ops=np.concatenate(
+            [np.zeros((n, 1), np.int8), np.full((n, C - 1), -1, np.int8)],
+            axis=1),
+        cigar_lens=np.concatenate(
+            [np.full((n, 1), L, np.int32), np.zeros((n, C - 1), np.int32)],
+            axis=1),
+    )
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+    from adam_tpu.bqsr.recalibrate import _apply_kernel, _count_kernel
+    from adam_tpu.bqsr.table import RecalTable
+    from adam_tpu.ops.markdup import _device_fiveprime_and_score
+
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 8_000_000
+    rng = np.random.RandomState(0)
+    b = make_batch(n, rng)
+    rt = RecalTable(n_read_groups=N_RG, max_read_len=L)
+    n_cigar = np.ones(n, np.int32)
+
+    def markdup(d):
+        return _device_fiveprime_and_score(
+            d["flags"], d["start"], d["cigar_ops"], d["cigar_lens"],
+            jnp.asarray(n_cigar), d["quals"])
+
+    def bqsr_count(d):
+        return _count_kernel(
+            d["bases"], d["quals"], d["read_len"], d["flags"],
+            d["read_group"], d["state"], d["valid"],
+            n_qual_rg=rt.n_qual_rg, n_cycle=rt.n_cycle)
+
+    fin = rt.finalize()
+    fin_dev = tuple(jnp.asarray(a) for a in (
+        fin.rg_delta, fin.qual_delta, fin.cycle_delta, fin.ctx_delta,
+        fin.rg_of_qualrg))
+
+    def bqsr_apply(d):
+        mask = jnp.ones(d["bases"].shape[:1], bool)
+        return _apply_kernel(d["bases"], d["quals"], d["read_len"],
+                             d["flags"], d["read_group"], mask, *fin_dev)
+
+    def fused(d):
+        # the transform pipeline's device work for one batch, one dispatch
+        return markdup(d), bqsr_count(d), bqsr_apply(d)
+
+    stages = [("markdup_score", markdup), ("bqsr_count", bqsr_count),
+              ("bqsr_apply", bqsr_apply), ("transform_fused", fused)]
+
+    for name, fn in stages:
+        jfn = jax.jit(fn)
+        put = {k: jax.device_put(v) for k, v in b.items()}
+        jax.block_until_ready(jfn(put))  # compile
+        iters = 3
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            put = {k: jax.device_put(v) for k, v in b.items()}
+            jax.block_until_ready(jfn(put))
+        dt = (time.perf_counter() - t0) / iters
+        print(json.dumps({"metric": f"{name}_reads_per_sec",
+                          "value": round(n / dt), "unit": "reads/s"}))
+
+
+if __name__ == "__main__":
+    main()

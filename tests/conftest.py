@@ -31,3 +31,29 @@ RESOURCES = pathlib.Path(__file__).parent / "resources"
 @pytest.fixture(scope="session")
 def resources() -> pathlib.Path:
     return RESOURCES
+
+
+def iter_mpileup_tokens(bases: str):
+    """Tokenize an mpileup bases column (samtools' or ours): yields
+    ('char', c) for per-position symbols (./,/ACGT/*/$-stripped) and
+    ('run', sign, seq) for length-prefixed +n/-n insertion/deletion runs.
+    Shared by the pileup-diff tests so both parse one grammar."""
+    i = 0
+    while i < len(bases):
+        c = bases[i]
+        if c == "^":
+            i += 2
+            continue
+        if c == "$":
+            i += 1
+            continue
+        if c in "+-":
+            j = i + 1
+            while j < len(bases) and bases[j].isdigit():
+                j += 1
+            n = int(bases[i + 1:j])
+            yield ("run", c, bases[j:j + n])
+            i = j + n
+            continue
+        yield ("char", c)
+        i += 1

@@ -88,23 +88,13 @@ def test_mpileup_matches_pileup_depths(resources, capsys):
         by_pos[int(parts[1]) + 1] = parts
     with open(resources / "small_realignment_targets.pileup") as f:
         golden = [l.rstrip("\n").split("\t") for l in f]
+    from tests.conftest import iter_mpileup_tokens
+
     def spanning_depth(bases):
-        # count aligned bases + deletions; insertions ("+nSEQ") sit between
+        # aligned bases + deletion runs; insertions ("+nSEQ") sit between
         # positions and don't add samtools depth
-        d, i = 0, 0
-        while i < len(bases):
-            c = bases[i]
-            if c in "+-":
-                j = i + 1
-                while j < len(bases) and bases[j].isdigit():
-                    j += 1
-                if c == "-":
-                    d += 1
-                i = j + int(bases[i + 1:j])
-                continue
-            d += 1
-            i += 1
-        return d
+        return sum(1 for t in iter_mpileup_tokens(bases)
+                   if t[0] == "char" or t[1] == "-")
 
     checked = 0
     for g in golden:

@@ -95,24 +95,10 @@ def test_mismatch_calls_match_samtools(mouse, golden_pileup, disputed):
                 and r["readBase"] and not r["numSoftClipped"]
                 and r["readBase"] != r["referenceBase"]):
             ours.add(r["position"])
+    from tests.conftest import iter_mpileup_tokens
     golden = set()
     for pos, _ref, _depth, bases in golden_pileup:
-        core = []
-        i = 0
-        while i < len(bases):  # strip ^X start markers, $, +n/-n runs
-            c = bases[i]
-            if c == "^":
-                i += 2
-                continue
-            if c in "+-":
-                j = i + 1
-                while j < len(bases) and bases[j].isdigit():
-                    j += 1
-                i = j + int(bases[i + 1:j])
-                continue
-            if c != "$":
-                core.append(c)
-            i += 1
+        core = [t[1] for t in iter_mpileup_tokens(bases) if t[0] == "char"]
         if any(c in "ACGTacgt" for c in core):
             golden.add(pos)
     assert ours - disputed == golden - disputed
