@@ -1,0 +1,83 @@
+"""Variant contexts: site-keyed merge of variants, genotypes and domains.
+
+Re-designs ``models/ADAMVariantContext.scala:24-138``: the reference builds
+per-site contexts with three shuffles (keyBy position -> groupByKey x2 ->
+join).  Here the columnar path keeps the three tables AS tables (joins and
+filters stay in Arrow); this module is the host-side per-site object view —
+one dict-keyed pass, same row-at-a-time granularity as the reference's
+context objects — plus the ``.v/.g/.vd`` dataset triple loader pairing with
+the save convention (AdamRDDFunctions.scala:330-363, cli commands
+vcf2adam/compute_variants).  Use it for site-wise consumers (VCF emission,
+inspection), not for bulk columnar transforms.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+import pyarrow as pa
+
+
+@dataclass
+class VariantContext:
+    """All evidence at one site (ADAMVariantContext.scala:24-35): the
+    position key, the variants called there (one per alt allele), the
+    per-sample genotypes, and optional domain memberships."""
+
+    ref_id: int
+    position: int
+    variants: List[dict] = field(default_factory=list)
+    genotypes: List[dict] = field(default_factory=list)
+    domains: List[dict] = field(default_factory=list)
+
+
+def _key(row: dict) -> Tuple[int, int]:
+    rid = row.get("referenceId")
+    return (-1 if rid is None else rid, row["position"])
+
+
+def merge_variants_and_genotypes(
+        variants: pa.Table, genotypes: pa.Table,
+        domains: Optional[pa.Table] = None) -> List[VariantContext]:
+    """Site-keyed merge (mergeVariantsAndGenotypes,
+    ADAMVariantContext.scala:36-84).  Genotypes at positions with no variant
+    row are kept as genotype-only contexts (the reference's
+    ``buildFromGenotypes`` path :86-110); domains attach where present.
+    Contexts come back position-sorted.
+    """
+    by_site: Dict[Tuple[int, int], VariantContext] = {}
+
+    def ctx(row: dict) -> VariantContext:
+        k = _key(row)
+        if k not in by_site:
+            by_site[k] = VariantContext(k[0], k[1])
+        return by_site[k]
+
+    for row in variants.to_pylist():
+        ctx(row).variants.append(row)
+    for row in genotypes.to_pylist():
+        ctx(row).genotypes.append(row)
+    if domains is not None:
+        for row in domains.to_pylist():
+            k = _key(row)
+            if k in by_site:           # domains only annotate known sites
+                by_site[k].domains.append(row)
+    return [by_site[k] for k in sorted(by_site)]
+
+
+def load_variant_contexts(basename: str) -> List[VariantContext]:
+    """Load the ``.v/.g/.vd`` dataset triple written by vcf2adam /
+    compute_variants and merge into contexts; a missing ``.vd`` (older
+    outputs) degrades to no domain annotations."""
+    import os
+
+    from ..io.parquet import load_table
+
+    variants = load_table(basename + ".v")
+    genotypes = load_table(basename + ".g")
+    domains = None
+    vd = basename + ".vd"
+    if os.path.exists(vd):
+        domains = load_table(vd)
+    return merge_variants_and_genotypes(variants, genotypes, domains)

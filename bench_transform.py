@@ -29,6 +29,7 @@ N_RG = 4
 
 def make_batch(n, rng):
     return dict(
+        n_cigar=np.ones(n, np.int32),
         flags=np.where(rng.rand(n) < 0.5, 16, 0).astype(np.int32),
         mapq=rng.randint(0, 61, size=n).astype(np.int32),
         start=rng.randint(0, 1 << 28, size=n).astype(np.int32),
@@ -58,12 +59,11 @@ def main() -> None:
     rng = np.random.RandomState(0)
     b = make_batch(n, rng)
     rt = RecalTable(n_read_groups=N_RG, max_read_len=L)
-    n_cigar = np.ones(n, np.int32)
 
     def markdup(d):
         return _device_fiveprime_and_score(
             d["flags"], d["start"], d["cigar_ops"], d["cigar_lens"],
-            jnp.asarray(n_cigar), d["quals"])
+            d["n_cigar"], d["quals"])
 
     def bqsr_count(d):
         return _count_kernel(

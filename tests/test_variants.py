@@ -92,3 +92,29 @@ def test_compute_variants_strict_validation():
     convert_genotypes(t, validate=True, strict=False)
     with pytest.raises(ValueError):
         convert_genotypes(t, validate=True, strict=True)
+
+
+def test_variant_context_merge(small_vcf, tmp_path):
+    """ADAMVariantContext.scala:36-110 semantics: site-keyed merge of the
+    .v/.g/.vd triple, genotype-only sites kept, domains attached."""
+    from adam_tpu.io.parquet import save_table
+    from adam_tpu.models.variantcontext import (load_variant_contexts,
+                                                merge_variants_and_genotypes)
+    variants, genotypes, domains, _ = small_vcf
+    ctxs = merge_variants_and_genotypes(variants, genotypes, domains)
+    # small.vcf: 4 sites (one multi-allelic -> 2 variant rows at one site)
+    assert len(ctxs) == 4
+    assert [len(c.variants) for c in ctxs].count(2) == 2
+    # 3 samples x ploidy 2 -> one genotype row per haplotype (adam.avdl:219)
+    assert all(len(c.genotypes) == 6 for c in ctxs)
+    assert sum(len(c.domains) for c in ctxs) == domains.num_rows
+    assert [c.position for c in ctxs] == sorted(c.position for c in ctxs)
+
+    base = str(tmp_path / "vc")
+    save_table(variants, base + ".v")
+    save_table(genotypes, base + ".g")
+    save_table(domains, base + ".vd")
+    loaded = load_variant_contexts(base)
+    assert len(loaded) == len(ctxs)
+    assert [len(c.variants) for c in loaded] == [len(c.variants)
+                                                for c in ctxs]
