@@ -244,21 +244,21 @@ def write_bam(table: pa.Table, seq_dict: SequenceDictionary, path,
             struct.pack("<i", rec.length)
 
     for row in table.to_pylist():
-        name = (row["readName"] or "*").encode() + b"\x00"
-        seq = row["sequence"] or ""
-        qual = row["qual"]
+        name = (row.get("readName") or "*").encode() + b"\x00"
+        seq = row.get("sequence") or ""
+        qual = row.get("qual")
         from ..util.mdtag import parse_cigar
-        cigar = parse_cigar(row["cigar"]) if row["cigar"] else []
+        cigar = parse_cigar(row.get("cigar")) if row.get("cigar") else []
         rec = bytearray()
-        ref_id = row["referenceId"] if row["referenceId"] is not None else -1
-        pos = row["start"] if row["start"] is not None else -1
-        mate_ref = row["mateReferenceId"] \
-            if row["mateReferenceId"] is not None else -1
-        mate_pos = row["mateAlignmentStart"] \
-            if row["mateAlignmentStart"] is not None else -1
-        mapq = row["mapq"] if row["mapq"] is not None else _MAPQ_UNKNOWN
+        ref_id = row.get("referenceId") if row.get("referenceId") is not None else -1
+        pos = row.get("start") if row.get("start") is not None else -1
+        mate_ref = row.get("mateReferenceId") \
+            if row.get("mateReferenceId") is not None else -1
+        mate_pos = row.get("mateAlignmentStart") \
+            if row.get("mateAlignmentStart") is not None else -1
+        mapq = row.get("mapq") if row.get("mapq") is not None else _MAPQ_UNKNOWN
         rec += struct.pack("<iiBBHHHiiii", ref_id, pos, len(name), mapq,
-                           0, len(cigar), row["flags"] or 0, len(seq),
+                           0, len(cigar), row.get("flags") or 0, len(seq),
                            mate_ref, mate_pos, 0)
         rec += name
         for length, op in cigar:
@@ -272,11 +272,11 @@ def write_bam(table: pa.Table, seq_dict: SequenceDictionary, path,
         rec += bytes(packed)
         rec += bytes((ord(c) - 33 for c in qual)) if qual \
             else b"\xff" * len(seq)
-        if row["mismatchingPositions"] is not None:
-            rec += b"MDZ" + row["mismatchingPositions"].encode() + b"\x00"
-        if row["recordGroupName"] is not None:
-            rec += b"RGZ" + row["recordGroupName"].encode() + b"\x00"
-        for field in (row["attributes"] or "").split("\t"):
+        if row.get("mismatchingPositions") is not None:
+            rec += b"MDZ" + row.get("mismatchingPositions").encode() + b"\x00"
+        if row.get("recordGroupName") is not None:
+            rec += b"RGZ" + row.get("recordGroupName").encode() + b"\x00"
+        for field in (row.get("attributes") or "").split("\t"):
             if not field:
                 continue
             tag, typ, value = field.split(":", 2)
