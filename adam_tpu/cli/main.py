@@ -38,17 +38,9 @@ def _load_commands() -> None:
 
 
 def _honor_platform_env() -> None:
-    """Make ``JAX_PLATFORMS=cpu adam-tpu ...`` actually run on CPU.
+    from adam_tpu.platform import honor_platform_env
 
-    Some PJRT plugins register themselves regardless of the env var; the
-    config update wins (same workaround as tests/conftest.py).  Harmless if
-    jax is already imported or the var is unset.
-    """
-    import os
-    plat = os.environ.get("JAX_PLATFORMS")
-    if plat:
-        import jax
-        jax.config.update("jax_platforms", plat)
+    honor_platform_env()
 
 
 def main(argv=None) -> int:

@@ -1,0 +1,64 @@
+"""Backend-platform selection under the axon TPU plugin.
+
+The one environment quirk every entry point must handle: the axon PJRT
+plugin registers itself regardless of ``JAX_PLATFORMS``, so forcing the CPU
+backend takes BOTH the env var and ``jax.config.update("jax_platforms",
+"cpu")`` before the backend initializes.  Round 1 lost a driver evidence
+artifact because one entry point (``__graft_entry__.dryrun_multichip``) had
+its own drifted copy of this workaround — this module is now the single
+implementation, shared by tests/conftest.py, the CLI, bench.py, and the
+driver entry points.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+
+_COUNT_RE = re.compile(r"--xla_force_host_platform_device_count=(\d+)")
+
+
+def ensure_host_device_count(n_devices: int) -> None:
+    """Guarantee >= ``n_devices`` virtual CPU devices via ``XLA_FLAGS``.
+
+    Replaces an existing smaller ``--xla_force_host_platform_device_count``
+    rather than skipping on a substring hit (a pre-set smaller count would
+    otherwise make a multi-device caller fail).  Must run before the jax
+    backend initializes.
+    """
+    flags = os.environ.get("XLA_FLAGS", "")
+    m = _COUNT_RE.search(flags)
+    if m is None:
+        flags = (flags +
+                 f" --xla_force_host_platform_device_count={n_devices}")
+    elif int(m.group(1)) < n_devices:
+        flags = flags.replace(
+            m.group(0), f"--xla_force_host_platform_device_count={n_devices}")
+    os.environ["XLA_FLAGS"] = flags.strip()
+
+
+def force_cpu(n_devices: int | None = None) -> None:
+    """Force the CPU backend; optionally ensure n virtual devices.
+
+    Safe to call repeatedly; must be called before the first backend touch
+    (a backend that already initialized to TPU cannot be switched).
+    """
+    if n_devices is not None:
+        ensure_host_device_count(n_devices)
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    # the env var alone is not enough under the axon plugin; config wins
+    jax.config.update("jax_platforms", "cpu")
+
+
+def honor_platform_env() -> None:
+    """Make ``JAX_PLATFORMS=cpu adam-tpu ...`` actually run on CPU.
+
+    Harmless if jax is already imported or the var is unset.
+    """
+    plat = os.environ.get("JAX_PLATFORMS")
+    if plat:
+        import jax
+
+        jax.config.update("jax_platforms", plat)

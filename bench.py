@@ -1,6 +1,10 @@
 """Benchmark: flagstat throughput on device, host->device transfer included.
 
-Prints ONE json line: {"metric", "value", "unit", "vs_baseline"}.
+Prints exactly ONE json line: {"metric", "value", "unit", "vs_baseline", ...}.
+This contract holds on EVERY exit path: backend-init failure, tunnel hang,
+or any other exception still produces one parseable line (with an "error"
+field and, where possible, a CPU-fallback measurement) — round 1 lost its
+perf evidence to a traceback-instead-of-JSON exit.
 
 Baseline (BASELINE.md #1): the reference runs flagstat over 51,554,029 reads
 in 17 s on a laptop => 3.03 M reads/s.  We time the same counters over the
@@ -22,6 +26,9 @@ trick was projecting 13 Parquet fields out of 39; same idea, harder edge.)
 from __future__ import annotations
 
 import json
+import os
+import subprocess
+import sys
 import time
 
 import numpy as np
@@ -29,8 +36,44 @@ import numpy as np
 N_READS = 51_554_029
 BASELINE_READS_PER_S = N_READS / 17.0
 
+# Budget for waiting out a flaky TPU tunnel before falling back to CPU.
+# Kept well under the driver's own timeout so we always get to print.
+PROBE_TOTAL_S = float(os.environ.get("ADAM_TPU_BENCH_PROBE_BUDGET", "150"))
+PROBE_ONE_S = 45.0
+PROBE_SLEEP_S = 15.0
 
-def main() -> None:
+
+def _probe_tpu() -> tuple[bool, str]:
+    """Check the default (TPU) backend comes up, in a SUBPROCESS.
+
+    A failed backend init is cached by jax for the life of the process, and
+    a hung tunnel blocks ``jax.devices()`` indefinitely — so the probe must
+    be isolated and timeout-bounded.  Retries with backoff inside a budget.
+    """
+    code = "import jax; d=jax.devices(); assert d; print(d[0].platform)"
+    # leave room inside the shared budget for at least one measurement
+    deadline = time.monotonic() + min(PROBE_TOTAL_S,
+                                      max(0.0, _remaining() - 180.0))
+    last = "never ran"
+    attempt = 0
+    while True:
+        attempt += 1
+        t = max(5.0, min(PROBE_ONE_S, deadline - time.monotonic()))
+        try:
+            r = subprocess.run([sys.executable, "-c", code],
+                               capture_output=True, text=True, timeout=t)
+            if r.returncode == 0:
+                return True, r.stdout.strip()
+            last = (r.stderr.strip().splitlines() or ["rc=%d" % r.returncode])[-1]
+        except subprocess.TimeoutExpired:
+            last = f"probe timed out after {t:.0f}s (tunnel hang)"
+        if time.monotonic() + PROBE_SLEEP_S + PROBE_ONE_S > deadline:
+            return False, f"{last} (after {attempt} attempts)"
+        time.sleep(PROBE_SLEEP_S)
+
+
+def _measure() -> float:
+    """Reads/s for the packed-wire flagstat, transfer-inclusive."""
     import jax
 
     from adam_tpu.ops.flagstat import (flagstat_kernel_wire32,
@@ -59,15 +102,91 @@ def main() -> None:
     for _ in range(iters):
         run()
     dt = (time.perf_counter() - t0) / iters
+    return n / dt
 
-    reads_per_s = n / dt
-    print(json.dumps({
+
+MEASURE_TIMEOUT_S = float(os.environ.get("ADAM_TPU_BENCH_MEASURE_TIMEOUT",
+                                         "240"))
+# One shared deadline across probe + both measurements so a worst-case run
+# (probe budget + TPU hang + CPU fallback) cannot outlive the driver's own
+# timeout and lose the JSON line to an external SIGKILL.
+TOTAL_BUDGET_S = float(os.environ.get("ADAM_TPU_BENCH_TOTAL_BUDGET", "540"))
+_START = time.monotonic()
+
+
+def _remaining() -> float:
+    return TOTAL_BUDGET_S - (time.monotonic() - _START)
+
+
+def _measure_subprocess(platform: str) -> tuple[float | None, str | None]:
+    """Run ``_measure`` in a timeout-bounded subprocess.
+
+    The tunnel's recorded failure mode is a HANG (not an error): a hang in
+    the main process would blow the one-JSON-line contract at the driver's
+    timeout, so the measurement is isolated exactly like the probe is.
+    Returns (reads_per_s, error).
+    """
+    env = dict(os.environ)
+    if platform == "cpu":
+        env["JAX_PLATFORMS"] = "cpu"
+    t = min(MEASURE_TIMEOUT_S, _remaining())
+    if t <= 10:
+        return None, "total bench budget exhausted before measurement"
+    try:
+        r = subprocess.run([sys.executable, os.path.abspath(__file__),
+                            "--measure"], capture_output=True, text=True,
+                           timeout=t, env=env)
+    except subprocess.TimeoutExpired:
+        return None, f"measurement hung past {t:.0f}s"
+    if r.returncode != 0:
+        tail = (r.stderr.strip().splitlines() or ["?"])[-1]
+        return None, f"measurement failed (rc={r.returncode}): {tail}"[:300]
+    try:
+        return float(r.stdout.strip().splitlines()[-1]), None
+    except (ValueError, IndexError):
+        return None, f"unparseable measurement output: {r.stdout[-200:]!r}"
+
+
+def main() -> None:
+    result = {
         "metric": "flagstat_reads_per_sec",
-        "value": round(reads_per_s),
+        "value": 0,
         "unit": "reads/s",
-        "vs_baseline": round(reads_per_s / BASELINE_READS_PER_S, 2),
-    }))
+        "vs_baseline": 0.0,
+    }
+    try:
+        errors = []
+        ok, info = _probe_tpu()
+        if not ok:
+            errors.append(f"tpu backend unavailable: {info}")
+        platform = (info or "tpu") if ok else "cpu"
+        reads_per_s, err = _measure_subprocess(platform)
+        if reads_per_s is None and platform != "cpu":
+            # TPU came up for the probe but died/hung for the measurement:
+            # still record a real number, on CPU, and say so honestly.
+            errors.append(f"on {platform}: {err}")
+            platform = "cpu"
+            reads_per_s, err = _measure_subprocess(platform)
+        if reads_per_s is None:
+            errors.append(f"on cpu: {err}")
+        else:
+            result["value"] = round(reads_per_s)
+            result["vs_baseline"] = round(reads_per_s / BASELINE_READS_PER_S,
+                                          2)
+        result["platform"] = platform
+        if errors:
+            result["error"] = "; ".join(errors)[:500]
+    except BaseException as e:  # noqa: BLE001 — the one-line contract wins
+        result["error"] = f"{type(e).__name__}: {e}"[:500]
+    print(json.dumps(result))
 
 
 if __name__ == "__main__":
-    main()
+    if "--measure" in sys.argv:
+        if os.environ.get("JAX_PLATFORMS") == "cpu":
+            from adam_tpu.platform import force_cpu
+
+            force_cpu()
+        print(_measure())
+    else:
+        main()

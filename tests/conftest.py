@@ -7,22 +7,13 @@ backend with 8 virtual devices, so every shard_map/pjit test exercises real
 multi-device sharding and collectives without TPU hardware.
 """
 
-import os
+from adam_tpu.platform import force_cpu
 
-os.environ["JAX_PLATFORMS"] = "cpu"  # force: the session env may point at TPU
-flags = os.environ.get("XLA_FLAGS", "")
-if "host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8").strip()
+force_cpu(n_devices=8)  # the session env may point at the TPU tunnel
 
 import pathlib
 
-import jax
 import pytest
-
-# the env var alone is not enough under the axon TPU plugin, which registers
-# itself regardless; the config update wins
-jax.config.update("jax_platforms", "cpu")
 
 
 RESOURCES = pathlib.Path(__file__).parent / "resources"
