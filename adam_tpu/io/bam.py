@@ -106,99 +106,234 @@ def parse_header(data: bytes, path="<bytes>"
     return SequenceDictionary(refs), rg_dict, off
 
 
+def iter_decompressed(path, chunk_bytes: int = 1 << 24):
+    """Stream a (possibly BGZF-compressed) file as decompressed byte chunks.
+
+    The whole-file :func:`load_decompressed` holds the full decompressed BAM
+    in memory; this generator bounds host RSS for multi-GB inputs — BGZF
+    members decompress incrementally as the raw bytes arrive.
+    """
+    with open(path, "rb") as f:
+        magic = f.read(2)
+        f.seek(0)
+        if magic != b"\x1f\x8b":
+            while True:
+                raw = f.read(chunk_bytes)
+                if not raw:
+                    return
+                yield raw
+            return
+        d = zlib.decompressobj(wbits=31)
+        while True:
+            raw = f.read(chunk_bytes)
+            if not raw:
+                break
+            out = [d.decompress(raw)]
+            # a raw chunk can close several gzip members; chain through them
+            while d.eof:
+                leftover = d.unused_data
+                d = zlib.decompressobj(wbits=31)
+                if not leftover:
+                    break
+                out.append(d.decompress(leftover))
+            chunk = b"".join(out)
+            if chunk:
+                yield chunk
+
+
+def _parse_record(data, off: int, seq_dict, rg_dict):
+    """Parse ONE complete alignment record at ``off``.
+
+    Returns (row_dict, record_end) or None when the buffer ends before the
+    record does (streaming callers append more bytes and retry).
+    """
+    n = len(data)
+    if off + 4 > n:
+        return None
+    block_size = struct.unpack_from("<i", data, off)[0]
+    if block_size < 32:  # below the fixed-field floor: corrupt, not partial
+        from ..errors import FormatError
+        raise FormatError(
+            f"corrupt BAM record: block_size {block_size} at byte {off}")
+    rec_end = off + 4 + block_size
+    if rec_end > n:
+        return None
+    (ref_id, pos, l_read_name, mapq, _bin, n_cigar, flag, l_seq,
+     next_ref, next_pos, _tlen) = struct.unpack_from("<iiBBHHHiiii",
+                                                     data, off + 4)
+    p = off + 36
+    read_name = data[p:p + l_read_name - 1].decode()
+    p += l_read_name
+    cigar_parts = []
+    for ci in range(n_cigar):
+        v = struct.unpack_from("<I", data, p + ci * 4)[0]
+        cigar_parts.append(f"{v >> 4}{_CIGAR_OPS[v & 0xF]}")
+    p += n_cigar * 4
+    seq_bytes = data[p:p + (l_seq + 1) // 2]
+    seq_chars = []
+    for i in range(l_seq):
+        b = seq_bytes[i // 2]
+        code = (b >> 4) if i % 2 == 0 else (b & 0xF)
+        seq_chars.append(SEQ_CODE[code])
+    p += (l_seq + 1) // 2
+    quals = data[p:p + l_seq]
+    p += l_seq
+    qual = None if (l_seq == 0 or quals[:1] == b"\xff") else \
+        "".join(chr(q + 33) for q in quals)
+
+    attrs = []
+    md = None
+    rg_name = None
+    while p < rec_end:
+        tag, typ, value, p = _parse_tag_value(data, p)
+        if tag == "MD":
+            md = str(value)
+        elif tag == "RG":
+            rg_name = str(value)
+        else:
+            attrs.append(f"{tag}:{typ}:{value}")
+
+    row = dict(
+        readName=read_name if read_name != "*" else None,
+        flags=flag,
+        sequence="".join(seq_chars) if l_seq else None,
+        qual=qual,
+        cigar="".join(cigar_parts) or None,
+        mismatchingPositions=md,
+        attributes="\t".join(attrs) if attrs else None,
+    )
+    if ref_id >= 0:
+        rec = seq_dict[ref_id]
+        row.update(referenceId=ref_id, referenceName=rec.name,
+                   referenceLength=rec.length, referenceUrl=rec.url)
+        if pos >= 0:
+            row["start"] = pos
+        if mapq != _MAPQ_UNKNOWN:
+            row["mapq"] = mapq
+    if next_ref >= 0:
+        rec = seq_dict[next_ref]
+        row.update(mateReferenceId=next_ref, mateReference=rec.name,
+                   mateReferenceLength=rec.length,
+                   mateReferenceUrl=rec.url)
+        if next_pos >= 0:
+            row["mateAlignmentStart"] = next_pos
+    if rg_name is not None and rg_name in rg_dict:
+        g = rg_dict[rg_name]
+        row.update(
+            recordGroupName=g.id, recordGroupId=g.index,
+            recordGroupSequencingCenter=g.sequencing_center,
+            recordGroupDescription=g.description,
+            recordGroupRunDateEpoch=g.run_date_epoch,
+            recordGroupFlowOrder=g.flow_order,
+            recordGroupKeySequence=g.key_sequence,
+            recordGroupLibrary=g.library,
+            recordGroupPredictedMedianInsertSize=g.predicted_median_insert_size,
+            recordGroupPlatform=g.platform,
+            recordGroupPlatformUnit=g.platform_unit,
+            recordGroupSample=g.sample)
+    return row, rec_end
+
+
+def _rows_to_table(cols) -> pa.Table:
+    return pa.Table.from_pydict(cols, schema=S.READ_SCHEMA)
+
+
+def _empty_cols():
+    return {name: [] for name in S.READ_SCHEMA.names}
+
+
+def _put_row(cols, row) -> None:
+    for name in S.READ_SCHEMA.names:
+        cols[name].append(row.get(name))
+
+
+def stream_header(byte_iter, path):
+    """Accumulate streamed bytes until the BAM header parses.
+
+    Returns (seq_dict, rg_dict, first_record_offset, buffer) where ``buffer``
+    is a bytearray already holding the consumed bytes.
+    """
+    from ..errors import FormatError
+
+    buf = bytearray()
+    for piece in byte_iter:
+        buf += piece
+        try:
+            sd, rg, off = parse_header(bytes(buf), path)
+            return sd, rg, off, buf
+        except (struct.error, IndexError):
+            continue  # header larger than the bytes so far
+    try:
+        sd, rg, off = parse_header(bytes(buf), path)
+        return sd, rg, off, buf
+    except (struct.error, IndexError) as e:
+        raise FormatError(f"{path}: truncated BAM header") from e
+
+
+def open_bam_stream(path, chunk_rows: int = 1 << 20,
+                    chunk_bytes: int = 1 << 24):
+    """(seq_dict, rg_dict, generator of Arrow tables) over a streamed BAM.
+
+    Host memory stays bounded by chunk size: bytes decompress incrementally
+    (``iter_decompressed``) and records parse as they complete, never
+    materializing the whole file.
+    """
+    from ..errors import FormatError
+
+    byte_iter = iter_decompressed(path, chunk_bytes)
+    seq_dict, rg_dict, off, buf = stream_header(byte_iter, path)
+
+    def gen():
+        nonlocal buf, off
+        cols = _empty_cols()
+        n_rows = 0
+        exhausted = False
+        while True:
+            parsed = _parse_record(buf, off, seq_dict, rg_dict)
+            if parsed is None:
+                if exhausted:
+                    break
+                # compact consumed bytes, then pull more input
+                if off:
+                    del buf[:off]
+                    off = 0
+                piece = next(byte_iter, None)
+                if piece is None:
+                    exhausted = True
+                else:
+                    buf += piece
+                continue
+            row, off = parsed
+            _put_row(cols, row)
+            n_rows += 1
+            if n_rows >= chunk_rows:
+                yield _rows_to_table(cols)
+                cols = _empty_cols()
+                n_rows = 0
+        if off < len(buf):
+            raise FormatError(
+                f"{path}: {len(buf) - off} trailing bytes form no complete "
+                "record (truncated file?)")
+        if n_rows:
+            yield _rows_to_table(cols)
+
+    return seq_dict, rg_dict, gen()
+
+
 def read_bam(path) -> Tuple[pa.Table, SequenceDictionary,
                             RecordGroupDictionary]:
     """Parse a BAM file into (reads table, seq dict, record groups)."""
     data = load_decompressed(path)
     seq_dict, rg_dict, off = parse_header(data, path)
-
-    cols = {name: [] for name in S.READ_SCHEMA.names}
-
-    def put(**kwargs):
-        for name in S.READ_SCHEMA.names:
-            cols[name].append(kwargs.get(name))
-
-    n = len(data)
-    while off < n:
-        block_size = struct.unpack_from("<i", data, off)[0]
-        rec_end = off + 4 + block_size
-        (ref_id, pos, l_read_name, mapq, _bin, n_cigar, flag, l_seq,
-         next_ref, next_pos, _tlen) = struct.unpack_from("<iiBBHHHiiii",
-                                                         data, off + 4)
-        p = off + 36
-        read_name = data[p:p + l_read_name - 1].decode()
-        p += l_read_name
-        cigar_parts = []
-        for ci in range(n_cigar):
-            v = struct.unpack_from("<I", data, p + ci * 4)[0]
-            cigar_parts.append(f"{v >> 4}{_CIGAR_OPS[v & 0xF]}")
-        p += n_cigar * 4
-        seq_bytes = data[p:p + (l_seq + 1) // 2]
-        seq_chars = []
-        for i in range(l_seq):
-            b = seq_bytes[i // 2]
-            code = (b >> 4) if i % 2 == 0 else (b & 0xF)
-            seq_chars.append(SEQ_CODE[code])
-        p += (l_seq + 1) // 2
-        quals = data[p:p + l_seq]
-        p += l_seq
-        qual = None if (l_seq == 0 or quals[:1] == b"\xff") else \
-            "".join(chr(q + 33) for q in quals)
-
-        attrs = []
-        md = None
-        rg_name = None
-        while p < rec_end:
-            tag, typ, value, p = _parse_tag_value(data, p)
-            if tag == "MD":
-                md = str(value)
-            elif tag == "RG":
-                rg_name = str(value)
-            else:
-                attrs.append(f"{tag}:{typ}:{value}")
-
-        row = dict(
-            readName=read_name if read_name != "*" else None,
-            flags=flag,
-            sequence="".join(seq_chars) if l_seq else None,
-            qual=qual,
-            cigar="".join(cigar_parts) or None,
-            mismatchingPositions=md,
-            attributes="\t".join(attrs) if attrs else None,
-        )
-        if ref_id >= 0:
-            rec = seq_dict[ref_id]
-            row.update(referenceId=ref_id, referenceName=rec.name,
-                       referenceLength=rec.length, referenceUrl=rec.url)
-            if pos >= 0:
-                row["start"] = pos
-            if mapq != _MAPQ_UNKNOWN:
-                row["mapq"] = mapq
-        if next_ref >= 0:
-            rec = seq_dict[next_ref]
-            row.update(mateReferenceId=next_ref, mateReference=rec.name,
-                       mateReferenceLength=rec.length,
-                       mateReferenceUrl=rec.url)
-            if next_pos >= 0:
-                row["mateAlignmentStart"] = next_pos
-        if rg_name is not None and rg_name in rg_dict:
-            g = rg_dict[rg_name]
-            row.update(
-                recordGroupName=g.id, recordGroupId=g.index,
-                recordGroupSequencingCenter=g.sequencing_center,
-                recordGroupDescription=g.description,
-                recordGroupRunDateEpoch=g.run_date_epoch,
-                recordGroupFlowOrder=g.flow_order,
-                recordGroupKeySequence=g.key_sequence,
-                recordGroupLibrary=g.library,
-                recordGroupPredictedMedianInsertSize=g.predicted_median_insert_size,
-                recordGroupPlatform=g.platform,
-                recordGroupPlatformUnit=g.platform_unit,
-                recordGroupSample=g.sample)
-        put(**row)
-        off = rec_end
-
-    return pa.Table.from_pydict(cols, schema=S.READ_SCHEMA), seq_dict, rg_dict
+    cols = _empty_cols()
+    while off < len(data):
+        parsed = _parse_record(data, off, seq_dict, rg_dict)
+        if parsed is None:
+            from ..errors import FormatError
+            raise FormatError(f"{path}: truncated record at byte {off}")
+        row, off = parsed
+        _put_row(cols, row)
+    return _rows_to_table(cols), seq_dict, rg_dict
 
 
 # ----------------------------------------------------------------------

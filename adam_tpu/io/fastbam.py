@@ -15,7 +15,8 @@ import numpy as np
 
 from ..models.dictionary import RecordGroupDictionary, SequenceDictionary
 from ..packing import ReadBatch, _round_up
-from .bam import load_decompressed, parse_header
+from .bam import (iter_decompressed, load_decompressed, parse_header,
+                  stream_header)
 
 try:
     import adam_tpu_native as _native
@@ -54,20 +55,7 @@ def bam_to_read_batch(path, *, pad_rows_to: int = 1,
     C = max_cigar_ops or max(int(max_cig), 1)
     n_pad = _round_up(max(n, 1), pad_rows_to)
 
-    cols = dict(
-        flags=np.zeros(n_pad, np.int32),
-        refid=np.full(n_pad, -1, np.int32),
-        start=np.full(n_pad, -1, np.int32),
-        mapq=np.full(n_pad, -1, np.int32),
-        mate_refid=np.full(n_pad, -1, np.int32),
-        mate_start=np.full(n_pad, -1, np.int32),
-        read_len=np.zeros(n_pad, np.int32),
-        bases=np.full((n_pad, L), -1, np.int8),
-        quals=np.full((n_pad, L), -1, np.int8),
-        cigar_ops=np.full((n_pad, C), -1, np.int8),
-        cigar_lens=np.zeros((n_pad, C), np.int32),
-        n_cigar=np.zeros(n_pad, np.int32),
-    )
+    cols = _alloc_cols(n_pad, L, C)
     packed = _native.pack(
         data, first, cols["flags"][:n], cols["refid"][:n], cols["start"][:n],
         cols["mapq"][:n], cols["mate_refid"][:n], cols["mate_start"][:n],
@@ -84,3 +72,127 @@ def bam_to_read_batch(path, *, pad_rows_to: int = 1,
         read_group=np.full(n_pad, -1, np.int32),  # RG tags stay in the
         **cols)                                   # Arrow path
     return batch, seq_dict, rg_dict
+
+
+def _alloc_cols(n_pad: int, L: int, C: int) -> dict:
+    return dict(
+        flags=np.zeros(n_pad, np.int32),
+        refid=np.full(n_pad, -1, np.int32),
+        start=np.full(n_pad, -1, np.int32),
+        mapq=np.full(n_pad, -1, np.int32),
+        mate_refid=np.full(n_pad, -1, np.int32),
+        mate_start=np.full(n_pad, -1, np.int32),
+        read_len=np.zeros(n_pad, np.int32),
+        bases=np.full((n_pad, L), -1, np.int8),
+        quals=np.full((n_pad, L), -1, np.int8),
+        cigar_ops=np.full((n_pad, C), -1, np.int8),
+        cigar_lens=np.zeros((n_pad, C), np.int32),
+        n_cigar=np.zeros(n_pad, np.int32),
+    )
+
+
+def open_bam_batch_stream(path, *, chunk_rows: int = 1 << 20,
+                          pad_rows_to: int = 1, bucket_len: int = 0,
+                          max_cigar_ops: int = 0, chunk_bytes: int = 1 << 24):
+    """(seq_dict, rg_dict, generator of ReadBatch) over a streamed BAM.
+
+    The streaming input pipeline for device workloads: BGZF blocks
+    decompress incrementally, ``scan_chunk``/``pack_chunk`` (native) walk at
+    most ``chunk_rows`` records per step, and each chunk packs straight into
+    the fixed-shape SoA tensors.  Host RSS stays bounded by
+    chunk_rows × row width — never the file size.
+
+    Row-length buckets and cigar-slot budgets grow monotonically across
+    chunks (rounded to 128 lanes), so a long run of same-shape chunks reuses
+    one compiled kernel.
+    """
+    from ..errors import FormatError
+
+    if _native is None:
+        # pure-Python fallback: Arrow chunks -> pack_reads
+        from ..packing import pack_reads
+        from .bam import open_bam_stream
+        sd, rg, tables = open_bam_stream(path, chunk_rows=chunk_rows,
+                                         chunk_bytes=chunk_bytes)
+
+        def gen_py():
+            L = bucket_len
+            C = max_cigar_ops or 1
+            for table in tables:
+                from ..util.mdtag import parse_cigar
+                C = max(C, max((len(parse_cigar(c))
+                                for c in table.column("cigar").to_pylist()
+                                if c), default=1))
+                # grow the bucket before packing — a later chunk may hold a
+                # longer read than anything seen so far
+                chunk_max = max((len(s) for s
+                                 in table.column("sequence").to_pylist()
+                                 if s), default=1)
+                L = max(L, _round_up(chunk_max, 128))
+                batch = pack_reads(table, pad_rows_to=pad_rows_to,
+                                   bucket_len=L, max_cigar_ops=C)
+                yield batch
+
+        return sd, rg, gen_py()
+
+    byte_iter = iter_decompressed(path, chunk_bytes)
+    seq_dict, rg_dict, off, buf = stream_header(byte_iter, path)
+
+    def gen():
+        nonlocal buf, off
+        L_sticky = bucket_len
+        C_sticky = max_cigar_ops
+        exhausted = False
+        # incremental scan state: resume from scan_off instead of re-walking
+        # the whole accumulated buffer after every appended byte piece
+        n, max_len, max_cig, scan_off = 0, 0, 0, off
+        while True:
+            dn, dml, dmc, scan_off = _native.scan_chunk(
+                buf, scan_off, chunk_rows - n)
+            n += dn
+            max_len = max(max_len, dml)
+            max_cig = max(max_cig, dmc)
+            if n < chunk_rows and not exhausted:
+                if off:
+                    del buf[:off]
+                    scan_off -= off
+                    off = 0
+                piece = next(byte_iter, None)
+                if piece is None:
+                    exhausted = True
+                else:
+                    buf += piece
+                continue
+            if n == 0:
+                if off < len(buf):
+                    raise FormatError(
+                        f"{path}: {len(buf) - off} trailing bytes form no "
+                        "complete record (truncated file?)")
+                return
+            next_off = scan_off
+            n_pad = _round_up(n, pad_rows_to)
+            L_sticky = max(L_sticky, _round_up(max(int(max_len), 1), 128))
+            C_sticky = max(C_sticky, int(max_cig), 1)
+            cols = _alloc_cols(n_pad, L_sticky, C_sticky)
+            packed, new_off = _native.pack_chunk(
+                buf, off, cols["flags"][:n], cols["refid"][:n],
+                cols["start"][:n], cols["mapq"][:n], cols["mate_refid"][:n],
+                cols["mate_start"][:n], cols["read_len"][:n],
+                cols["bases"][:n].reshape(-1), cols["quals"][:n].reshape(-1),
+                cols["cigar_ops"][:n].reshape(-1),
+                cols["cigar_lens"][:n].reshape(-1), cols["n_cigar"][:n],
+                L_sticky, C_sticky)
+            if packed != n or new_off != next_off:
+                raise ValueError(
+                    f"pack_chunk consumed {packed}/{n} records")
+            off = scan_off = new_off
+            n_chunk, n = n, 0
+            max_len, max_cig = 0, 0
+            yield ReadBatch(
+                valid=np.arange(n_pad) < n_chunk,
+                row_index=np.where(np.arange(n_pad) < n_chunk,
+                                   np.arange(n_pad), -1).astype(np.int32),
+                read_group=np.full(n_pad, -1, np.int32),
+                **cols)
+
+    return seq_dict, rg_dict, gen()

@@ -49,6 +49,79 @@ def save_table(table: pa.Table, path: str, *, compression: str = "zstd",
         part += 1
 
 
+def iter_tables(path: str, *, columns: Optional[Sequence[str]] = None,
+                filters=None, chunk_rows: int = 1 << 20):
+    """Stream a Parquet file/dataset as Arrow tables of ~chunk_rows each.
+
+    Projection and predicate push down into the scan; host memory stays
+    bounded by the chunk size instead of the dataset size.
+    """
+    import pyarrow.dataset as ds
+    if os.path.isdir(path):
+        paths = sorted(os.path.join(path, f) for f in os.listdir(path)
+                       if f.endswith(".parquet"))
+        dataset = ds.dataset(paths, format="parquet")
+    else:
+        dataset = ds.dataset(path, format="parquet")
+    for batch in dataset.to_batches(
+            columns=list(columns) if columns else None, filter=filters,
+            batch_size=chunk_rows):
+        if batch.num_rows:
+            yield pa.Table.from_batches([batch])
+
+
+class DatasetWriter:
+    """Incremental Parquet dataset writer: one part file per ``write`` call
+    group, bounded memory (the streaming counterpart of :func:`save_table`).
+
+    The reference's executors each write their own part file
+    (AdamRDDFunctions.scala:37-56 via ParquetOutputFormat); here each flushed
+    chunk becomes a part, named in write order so readers see file order ==
+    stream order.
+    """
+
+    def __init__(self, path: str, *, compression: str = "zstd",
+                 row_group_size: int = 1 << 20,
+                 part_rows: int = 1 << 20):
+        os.makedirs(path, exist_ok=True)
+        self.path = path
+        self.compression = compression
+        self.row_group_size = row_group_size
+        self.part_rows = part_rows
+        self._part = 0
+        self._pending: list[pa.Table] = []
+        self._pending_rows = 0
+        self.rows_written = 0
+
+    def write(self, table: pa.Table) -> None:
+        self._pending.append(table)
+        self._pending_rows += table.num_rows
+        if self._pending_rows >= self.part_rows:
+            self.flush()
+
+    def flush(self) -> None:
+        if not self._pending:
+            return
+        chunk = pa.concat_tables(self._pending)
+        pq.write_table(
+            chunk, os.path.join(self.path, f"part-r-{self._part:05d}.parquet"),
+            compression=self.compression, row_group_size=self.row_group_size)
+        self.rows_written += chunk.num_rows
+        self._part += 1
+        self._pending = []
+        self._pending_rows = 0
+
+    def close(self) -> None:
+        self.flush()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        if not any(exc):
+            self.close()
+
+
 def load_table(path: str, *, columns: Optional[Sequence[str]] = None,
                filters=None) -> pa.Table:
     """Read a Parquet file or dataset directory with optional projection

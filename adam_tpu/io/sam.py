@@ -24,7 +24,7 @@ primary, as the SAM spec defines.
 
 from __future__ import annotations
 
-import io
+import itertools
 from typing import List, Optional, Tuple
 
 import pyarrow as pa
@@ -36,99 +36,134 @@ from .. import schema as S
 _MAPQ_UNKNOWN = 255
 
 
-def read_sam(path_or_file) -> Tuple[pa.Table, SequenceDictionary, RecordGroupDictionary]:
-    """Parse a SAM text file into (reads table, seq dict, record groups)."""
+def _parse_sam_line(line: str, seq_dict, rg_dict) -> Optional[dict]:
+    """One SAM body line -> row dict (None for blank lines)."""
+    line = line.rstrip("\n")
+    if not line:
+        return None
+    f = line.split("\t")
+    qname, flag, rname, pos, mapq, cigar, rnext, pnext, _tlen, seq, qual = f[:11]
+    flag = int(flag)
+    row = {
+        "readName": qname if qname != "*" else None,
+        "flags": flag,
+        "sequence": seq if seq != "*" else None,
+        "qual": qual if qual != "*" else None,
+        "cigar": cigar if cigar != "*" else None,
+    }
+    if rname != "*":
+        rec = seq_dict.get(rname)
+        row["referenceName"] = rname
+        row["referenceId"] = rec.id if rec else None
+        if rec:
+            row["referenceLength"] = rec.length
+            row["referenceUrl"] = rec.url
+        if int(pos) != 0:
+            row["start"] = int(pos) - 1
+        if int(mapq) != _MAPQ_UNKNOWN:
+            row["mapq"] = int(mapq)
+    mate_rname = rname if rnext == "=" else rnext
+    if mate_rname != "*":
+        rec = seq_dict.get(mate_rname)
+        row["mateReference"] = mate_rname
+        row["mateReferenceId"] = rec.id if rec else None
+        if rec:
+            row["mateReferenceLength"] = rec.length
+            row["mateReferenceUrl"] = rec.url
+        if int(pnext) > 0:
+            row["mateAlignmentStart"] = int(pnext) - 1
+    attrs = []
+    rg: Optional[RecordGroup] = None
+    for tag_field in f[11:]:
+        tag, typ, value = tag_field.split(":", 2)
+        if tag == "MD":
+            row["mismatchingPositions"] = value
+        elif tag == "RG":
+            rg = rg_dict.get(value)
+            if rg is None:
+                # tolerate RG tags without a header line: register so each
+                # distinct group still gets a distinct dense index
+                rg = RecordGroup(id=value, index=len(rg_dict))
+                rg_dict.add(rg)
+        else:
+            attrs.append(f"{tag}:{typ}:{value}")
+    if attrs:
+        row["attributes"] = "\t".join(attrs)
+    if rg is not None:
+        row.update(
+            recordGroupName=rg.id, recordGroupId=rg.index,
+            recordGroupSequencingCenter=rg.sequencing_center,
+            recordGroupDescription=rg.description,
+            recordGroupRunDateEpoch=rg.run_date_epoch,
+            recordGroupFlowOrder=rg.flow_order,
+            recordGroupKeySequence=rg.key_sequence,
+            recordGroupLibrary=rg.library,
+            recordGroupPredictedMedianInsertSize=rg.predicted_median_insert_size,
+            recordGroupPlatform=rg.platform,
+            recordGroupPlatformUnit=rg.platform_unit,
+            recordGroupSample=rg.sample,
+        )
+    return row
+
+
+def _rows_to_table(rows) -> pa.Table:
+    cols = {name: [] for name in S.READ_SCHEMA.names}
+    for row in rows:
+        for name in S.READ_SCHEMA.names:
+            cols[name].append(row.get(name))
+    return pa.Table.from_pydict(cols, schema=S.READ_SCHEMA)
+
+
+def open_sam_stream(path_or_file, chunk_rows: int = 1 << 20):
+    """(seq_dict, rg_dict, generator of Arrow tables) over a streamed SAM.
+
+    Lines parse as they are read; host memory is bounded by ``chunk_rows``
+    (the whole-file :func:`read_sam` is this stream concatenated).
+    """
+    close = False
     if hasattr(path_or_file, "read"):
-        text = path_or_file.read()
+        f = path_or_file
     else:
-        with open(path_or_file, "rt") as f:
-            text = f.read()
+        f = open(path_or_file, "rt")
+        close = True
     header_lines = []
-    body_start = 0
-    for line in io.StringIO(text):
+    first_body: Optional[str] = None
+    for line in f:
         if line.startswith("@"):
             header_lines.append(line)
-            body_start += len(line)
         else:
+            first_body = line
             break
     seq_dict = SequenceDictionary.from_sam_header_lines(header_lines)
     rg_dict = RecordGroupDictionary.from_sam_header_lines(header_lines)
 
-    cols = {name: [] for name in S.READ_SCHEMA.names}
+    def gen():
+        try:
+            rows: List[dict] = []
+            lines = ([first_body] if first_body is not None else [])
+            for line in itertools.chain(lines, f):
+                row = _parse_sam_line(line, seq_dict, rg_dict)
+                if row is None:
+                    continue
+                rows.append(row)
+                if len(rows) >= chunk_rows:
+                    yield _rows_to_table(rows)
+                    rows = []
+            if rows:
+                yield _rows_to_table(rows)
+        finally:
+            if close:
+                f.close()
 
-    def put(**kwargs):
-        for name in S.READ_SCHEMA.names:
-            cols[name].append(kwargs.get(name))
+    return seq_dict, rg_dict, gen()
 
-    for line in io.StringIO(text[body_start:]):
-        line = line.rstrip("\n")
-        if not line:
-            continue
-        f = line.split("\t")
-        qname, flag, rname, pos, mapq, cigar, rnext, pnext, _tlen, seq, qual = f[:11]
-        flag = int(flag)
-        row = {
-            "readName": qname if qname != "*" else None,
-            "flags": flag,
-            "sequence": seq if seq != "*" else None,
-            "qual": qual if qual != "*" else None,
-            "cigar": cigar if cigar != "*" else None,
-        }
-        if rname != "*":
-            rec = seq_dict.get(rname)
-            row["referenceName"] = rname
-            row["referenceId"] = rec.id if rec else None
-            if rec:
-                row["referenceLength"] = rec.length
-                row["referenceUrl"] = rec.url
-            if int(pos) != 0:
-                row["start"] = int(pos) - 1
-            if int(mapq) != _MAPQ_UNKNOWN:
-                row["mapq"] = int(mapq)
-        mate_rname = rname if rnext == "=" else rnext
-        if mate_rname != "*":
-            rec = seq_dict.get(mate_rname)
-            row["mateReference"] = mate_rname
-            row["mateReferenceId"] = rec.id if rec else None
-            if rec:
-                row["mateReferenceLength"] = rec.length
-                row["mateReferenceUrl"] = rec.url
-            if int(pnext) > 0:
-                row["mateAlignmentStart"] = int(pnext) - 1
-        attrs = []
-        rg: Optional[RecordGroup] = None
-        for tag_field in f[11:]:
-            tag, typ, value = tag_field.split(":", 2)
-            if tag == "MD":
-                row["mismatchingPositions"] = value
-            elif tag == "RG":
-                rg = rg_dict.get(value)
-                if rg is None:
-                    # tolerate RG tags without a header line: register so each
-                    # distinct group still gets a distinct dense index
-                    rg = RecordGroup(id=value, index=len(rg_dict))
-                    rg_dict.add(rg)
-            else:
-                attrs.append(f"{tag}:{typ}:{value}")
-        if attrs:
-            row["attributes"] = "\t".join(attrs)
-        if rg is not None:
-            row.update(
-                recordGroupName=rg.id, recordGroupId=rg.index,
-                recordGroupSequencingCenter=rg.sequencing_center,
-                recordGroupDescription=rg.description,
-                recordGroupRunDateEpoch=rg.run_date_epoch,
-                recordGroupFlowOrder=rg.flow_order,
-                recordGroupKeySequence=rg.key_sequence,
-                recordGroupLibrary=rg.library,
-                recordGroupPredictedMedianInsertSize=rg.predicted_median_insert_size,
-                recordGroupPlatform=rg.platform,
-                recordGroupPlatformUnit=rg.platform_unit,
-                recordGroupSample=rg.sample,
-            )
-        put(**row)
 
-    table = pa.Table.from_pydict(cols, schema=S.READ_SCHEMA)
+def read_sam(path_or_file) -> Tuple[pa.Table, SequenceDictionary, RecordGroupDictionary]:
+    """Parse a SAM text file into (reads table, seq dict, record groups)."""
+    seq_dict, rg_dict, gen = open_sam_stream(path_or_file)
+    tables = list(gen)
+    table = pa.concat_tables(tables) if tables \
+        else _rows_to_table([])
     return table, seq_dict, rg_dict
 
 

@@ -77,8 +77,41 @@ static PyObject *scan(PyObject *self, PyObject *args) {
     return Py_BuildValue("(LLL)", count, max_len, max_cigar);
 }
 
+/* ----------------------------------------------------------- scan_chunk */
+/* Bounded scan for streaming: counts at most max_records complete records
+ * from `offset`, and also returns where the scan stopped, so the caller can
+ * chunk a multi-GB BAM without re-walking it from the start.  A partial
+ * record at the end of the buffer simply stops the scan (next_offset points
+ * at it); the caller appends more bytes and resumes. */
+static PyObject *scan_chunk(PyObject *self, PyObject *args) {
+    Py_buffer data;
+    Py_ssize_t offset, max_records;
+    if (!PyArg_ParseTuple(args, "y*nn", &data, &offset, &max_records))
+        return NULL;
+    const uint8_t *buf = (const uint8_t *)data.buf;
+    Py_ssize_t n = data.len;
+    Py_ssize_t pos = offset;
+    long long count = 0, max_len = 0, max_cigar = 0;
+    while (pos + 4 <= n && count < max_records) {
+        int32_t block = rd_i32(buf + pos);
+        if (block < 32 || pos + 4 + block > n) break;
+        uint8_t l_name = buf[pos + 4 + 8];
+        uint16_t n_cig = rd_u16(buf + pos + 4 + 12);
+        int32_t l_seq = rd_i32(buf + pos + 4 + 16);
+        if (l_seq < 0 ||
+            32LL + l_name + 4LL * n_cig + (l_seq + 1LL) / 2 + l_seq > block)
+            break;
+        if (l_seq > max_len) max_len = l_seq;
+        if (n_cig > max_cigar) max_cigar = n_cig;
+        count++;
+        pos += 4 + block;
+    }
+    PyBuffer_Release(&data);
+    return Py_BuildValue("(LLLn)", count, max_len, max_cigar, pos);
+}
+
 /* ---------------------------------------------------------------- pack */
-static PyObject *pack(PyObject *self, PyObject *args) {
+static PyObject *pack_impl(PyObject *args, int want_offset) {
     Py_buffer data, flags, refid, start, mapq, mate_refid, mate_start,
         read_len, bases, quals, cigar_ops, cigar_lens, n_cigar;
     Py_ssize_t offset, max_len, max_cigar;
@@ -183,7 +216,19 @@ static PyObject *pack(PyObject *self, PyObject *args) {
                         "record exceeds max_len/max_cigar bounds");
         return NULL;
     }
+    if (want_offset)
+        return Py_BuildValue("(nn)", i, pos);
     return PyLong_FromSsize_t(i);
+}
+
+static PyObject *pack(PyObject *self, PyObject *args) {
+    return pack_impl(args, 0);
+}
+
+/* Streaming variant: same arguments, returns (n_packed, next_offset) so the
+ * caller can resume after the last complete record. */
+static PyObject *pack_chunk(PyObject *self, PyObject *args) {
+    return pack_impl(args, 1);
 }
 
 /* ---------------------------------------------------- pack_wire32 */
@@ -230,6 +275,12 @@ static PyMethodDef methods[] = {
      "scan(data, offset) -> (n_records, max_read_len, max_cigar_ops)"},
     {"pack", pack, METH_VARARGS,
      "pack(data, offset, *column_buffers, max_len, max_cigar) -> n_packed"},
+    {"scan_chunk", scan_chunk, METH_VARARGS,
+     "scan_chunk(data, offset, max_records) -> "
+     "(n_records, max_read_len, max_cigar_ops, next_offset)"},
+    {"pack_chunk", pack_chunk, METH_VARARGS,
+     "pack_chunk(data, offset, *column_buffers, max_len, max_cigar) -> "
+     "(n_packed, next_offset)"},
     {"pack_wire32", pack_wire32, METH_VARARGS,
      "pack_wire32(flags_u16, mapq_u8, refid_i16, mate_i16, valid_u8, "
      "out_u32) -> None"},
