@@ -51,10 +51,28 @@ class CheckpointDir:
             with open(mpath) as f:
                 m = json.load(f)
             if m.get("fingerprint") != _fingerprint(self.config):
+                # say WHICH kind of mismatch: a changed input file needs a
+                # recompute (stale checkpoints), different flags usually
+                # means the wrong -checkpoint_dir
+                old = m.get("config")
+                detail = "pipeline configuration differs"
+                if isinstance(old, list) and len(old) == len(self.config):
+                    changed = [i for i, (a, b)
+                               in enumerate(zip(old, self.config)) if a != b]
+                    if changed and all(
+                            ":" in self.config[i] for i in changed):
+                        detail = ("input file(s) changed since the "
+                                  "checkpoint was written — the cached "
+                                  "stages are stale")
+                    elif changed:
+                        detail = ("pipeline stages/flags differ: "
+                                  f"{[old[i] for i in changed]} vs "
+                                  f"{[self.config[i] for i in changed]}")
+                elif isinstance(old, list):
+                    detail = "pipeline stage list differs"
                 raise ValueError(
-                    f"checkpoint dir {self.path} was created by a different "
-                    f"pipeline configuration; refusing to resume (delete it "
-                    f"or use another -checkpoint_dir)")
+                    f"checkpoint dir {self.path}: {detail}; refusing to "
+                    f"resume (delete it or use another -checkpoint_dir)")
             self.completed = [s for s in m.get("completed", [])
                               if os.path.isdir(self._stage_dir(s))]
 
@@ -63,6 +81,7 @@ class CheckpointDir:
 
     def _write_manifest(self) -> None:
         payload = json.dumps({"fingerprint": _fingerprint(self.config),
+                              "config": self.config,
                               "completed": self.completed})
         fd, tmp = tempfile.mkstemp(dir=self.path, suffix=".manifest")
         with os.fdopen(fd, "w") as f:

@@ -22,17 +22,17 @@ class FlagStatCommand(Command):
 
     def add_args(self, p: argparse.ArgumentParser) -> None:
         p.add_argument("input", help="SAM/BAM file or ADAM Parquet dataset")
+        p.add_argument("-chunk_rows", type=int, default=1 << 22,
+                       help="reads per streamed chunk (bounds host memory)")
 
     def run(self, args) -> int:
-        from ..io.dispatch import FLAGSTAT_COLUMNS, load_reads
-        from ..ops.flagstat import flagstat, format_report
-        from ..packing import pack_reads
+        from ..ops.flagstat import format_report
+        from ..parallel.pipeline import streaming_flagstat
 
-        # project just the 4 flagstat columns
-        # (the reference's 13-field projection, cli/FlagStat.scala:50-57)
-        table, _, _ = load_reads(args.input, columns=FLAGSTAT_COLUMNS)
-        batch = pack_reads(table, with_bases=False, with_cigar=False)
-        failed, passed = flagstat(batch)
+        # streams bounded chunks of the 4-column projection (the reference's
+        # 13-field projection, cli/FlagStat.scala:50-57) through the mesh
+        failed, passed = streaming_flagstat(args.input,
+                                            chunk_rows=args.chunk_rows)
         print(format_report(failed, passed))
         return 0
 
@@ -86,8 +86,49 @@ class TransformCommand(Command):
         p.add_argument("-checkpoint_dir", default=None,
                        help="materialize each stage here and resume a "
                             "previously interrupted run")
+        p.add_argument("-stream", action="store_true",
+                       help="force the chunked mesh-sharded pipeline "
+                            "(bounded host memory; auto-enabled for inputs "
+                            "over 1 GB unless the output is .sam)")
+        p.add_argument("-stream_chunk_rows", type=int, default=1 << 20,
+                       help="reads per streamed chunk")
+        p.add_argument("-workdir", default=None,
+                       help="scratch directory for streamed spills "
+                            "(default: a temp dir)")
 
     def run(self, args) -> int:
+        sam_out = args.output.endswith(".sam")
+        # -checkpoint_dir keeps the in-memory staged path (the streaming
+        # pipeline has its own spill discipline but no resume yet); never
+        # silently drop a requested checkpoint
+        auto_stream = (not sam_out and not args.checkpoint_dir and
+                       os.path.exists(args.input) and
+                       not os.path.isdir(args.input) and
+                       os.path.getsize(args.input) > (1 << 30))
+        if args.stream or auto_stream:
+            if sam_out:
+                raise SystemExit(
+                    "transform -stream writes Parquet datasets; "
+                    "convert with adam-tpu transform OUT.sam afterwards")
+            if args.checkpoint_dir:
+                raise SystemExit(
+                    "transform -stream does not support -checkpoint_dir "
+                    "yet; drop one of the two flags")
+            from ..models.snptable import SnpTable
+            from ..parallel.pipeline import streaming_transform
+            snp = SnpTable.from_vcf(args.dbsnp_sites) \
+                if args.dbsnp_sites else None
+            n = streaming_transform(
+                args.input, args.output,
+                markdup=args.mark_duplicate_reads,
+                bqsr=args.recalibrate_base_qualities, snp_table=snp,
+                realign=args.realignIndels, sort=args.sort_reads,
+                workdir=args.workdir, chunk_rows=args.stream_chunk_rows)
+            print(f"wrote {n} reads to {args.output}")
+            return 0
+        return self._run_inmemory(args)
+
+    def _run_inmemory(self, args) -> int:
         from ..checkpoint import CheckpointDir, run_stages
         from ..instrument import device_trace, report, stage
         from ..io.dispatch import load_reads, sequence_dictionary_from_reads

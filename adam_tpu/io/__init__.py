@@ -1,0 +1,15 @@
+"""IO layer: format codecs + streaming.  Shared row-conversion helper lives
+here so the SAM and BAM streamed parsers build identical chunk tables."""
+
+import pyarrow as pa
+
+from .. import schema as S
+
+
+def read_rows_to_table(rows) -> pa.Table:
+    """Row dicts -> an Arrow table over READ_SCHEMA."""
+    cols = {name: [] for name in S.READ_SCHEMA.names}
+    for row in rows:
+        for name in S.READ_SCHEMA.names:
+            cols[name].append(row.get(name))
+    return pa.Table.from_pydict(cols, schema=S.READ_SCHEMA)

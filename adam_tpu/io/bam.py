@@ -122,7 +122,6 @@ def iter_decompressed(path, chunk_bytes: int = 1 << 24):
                 if not raw:
                     return
                 yield raw
-            return
         d = zlib.decompressobj(wbits=31)
         while True:
             raw = f.read(chunk_bytes)
@@ -234,17 +233,9 @@ def _parse_record(data, off: int, seq_dict, rg_dict):
     return row, rec_end
 
 
-def _rows_to_table(cols) -> pa.Table:
-    return pa.Table.from_pydict(cols, schema=S.READ_SCHEMA)
-
-
-def _empty_cols():
-    return {name: [] for name in S.READ_SCHEMA.names}
-
-
-def _put_row(cols, row) -> None:
-    for name in S.READ_SCHEMA.names:
-        cols[name].append(row.get(name))
+def _rows_to_table(rows) -> pa.Table:
+    from . import read_rows_to_table
+    return read_rows_to_table(rows)
 
 
 def stream_header(byte_iter, path):
@@ -285,8 +276,7 @@ def open_bam_stream(path, chunk_rows: int = 1 << 20,
 
     def gen():
         nonlocal buf, off
-        cols = _empty_cols()
-        n_rows = 0
+        rows = []
         exhausted = False
         while True:
             parsed = _parse_record(buf, off, seq_dict, rg_dict)
@@ -304,18 +294,16 @@ def open_bam_stream(path, chunk_rows: int = 1 << 20,
                     buf += piece
                 continue
             row, off = parsed
-            _put_row(cols, row)
-            n_rows += 1
-            if n_rows >= chunk_rows:
-                yield _rows_to_table(cols)
-                cols = _empty_cols()
-                n_rows = 0
+            rows.append(row)
+            if len(rows) >= chunk_rows:
+                yield _rows_to_table(rows)
+                rows = []
         if off < len(buf):
             raise FormatError(
                 f"{path}: {len(buf) - off} trailing bytes form no complete "
                 "record (truncated file?)")
-        if n_rows:
-            yield _rows_to_table(cols)
+        if rows:
+            yield _rows_to_table(rows)
 
     return seq_dict, rg_dict, gen()
 
@@ -325,15 +313,15 @@ def read_bam(path) -> Tuple[pa.Table, SequenceDictionary,
     """Parse a BAM file into (reads table, seq dict, record groups)."""
     data = load_decompressed(path)
     seq_dict, rg_dict, off = parse_header(data, path)
-    cols = _empty_cols()
+    rows = []
     while off < len(data):
         parsed = _parse_record(data, off, seq_dict, rg_dict)
         if parsed is None:
             from ..errors import FormatError
             raise FormatError(f"{path}: truncated record at byte {off}")
         row, off = parsed
-        _put_row(cols, row)
-    return _rows_to_table(cols), seq_dict, rg_dict
+        rows.append(row)
+    return _rows_to_table(rows), seq_dict, rg_dict
 
 
 # ----------------------------------------------------------------------

@@ -107,11 +107,8 @@ def _parse_sam_line(line: str, seq_dict, rg_dict) -> Optional[dict]:
 
 
 def _rows_to_table(rows) -> pa.Table:
-    cols = {name: [] for name in S.READ_SCHEMA.names}
-    for row in rows:
-        for name in S.READ_SCHEMA.names:
-            cols[name].append(row.get(name))
-    return pa.Table.from_pydict(cols, schema=S.READ_SCHEMA)
+    from . import read_rows_to_table
+    return read_rows_to_table(rows)
 
 
 def open_sam_stream(path_or_file, chunk_rows: int = 1 << 20):

@@ -45,6 +45,12 @@ def merge_variants_and_genotypes(
     row are kept as genotype-only contexts (the reference's
     ``buildFromGenotypes`` path :86-110); domains attach where present.
     Contexts come back position-sorted.
+
+    Deliberate superset of the reference: variant-only sites (no genotypes)
+    are ALSO kept, which neither reference path produces — the reference's
+    inner join drops sites a sites-only VCF legitimately carries, and
+    downstream consumers (adam2vcf) need them.  Filter on
+    ``ctx.genotypes`` to recover the reference's exact join.
     """
     by_site: Dict[Tuple[int, int], VariantContext] = {}
 

@@ -149,6 +149,19 @@ def _check_refid_range(refid, mate_refid):
                 "renumber or use the unpacked kernel for wider ids")
 
 
+def _check_flags_mapq_range(flags, mapq) -> None:
+    """Out-of-range flags/mapq would silently corrupt neighboring wire
+    bit-fields (valid/cross bits) — raise instead, like the refid check."""
+    for name, col, hi in (("flags", flags, 1 << 16), ("mapq", mapq, 256)):
+        col = np.asarray(col)
+        info = np.iinfo(col.dtype)
+        if (info.min < 0 or info.max >= hi) and col.size and (
+                int(col.min()) < 0 or int(col.max()) >= hi):
+            raise ValueError(
+                f"{name} outside [0, {hi}) for the flagstat wire word; "
+                "sanitize the column (e.g. clip null sentinels) first")
+
+
 def pack_flagstat_wire(flags, mapq, refid, mate_refid, valid) -> np.ndarray:
     """Pack the five flagstat columns into ONE contiguous [2N] u32 buffer.
 
@@ -162,6 +175,7 @@ def pack_flagstat_wire(flags, mapq, refid, mate_refid, valid) -> np.ndarray:
     counting pass.
     """
     _check_refid_range(refid, mate_refid)
+    _check_flags_mapq_range(flags, mapq)
     word_a = (flags.astype(np.uint32)
               | (mapq.astype(np.uint32) << 16)
               | ((valid != 0).astype(np.uint32) << 24))
@@ -203,6 +217,7 @@ def pack_flagstat_wire32(flags, mapq, refid, mate_refid, valid) -> np.ndarray:
     need real refids.
     """
     _check_refid_range(refid, mate_refid)
+    _check_flags_mapq_range(flags, mapq)
     n = len(flags)
     cols = (np.ascontiguousarray(flags, np.uint16),
             np.ascontiguousarray(mapq, np.uint8),
@@ -249,6 +264,18 @@ def flagstat_sharded(mesh):
     fn = jax.shard_map(
         partial(flagstat_kernel, axis_name=READS_AXIS), mesh=mesh,
         in_specs=(spec, spec, spec, spec, spec), out_specs=P())
+    return jax.jit(fn)
+
+
+def flagstat_wire32_sharded(mesh):
+    """jit-compiled wire32 flagstat over a device mesh: per-shard count +
+    psum over ICI, fed by the 4-byte projection word (the streaming CLI
+    path — reference: executor map + driver aggregate, FlagStat.scala:102)."""
+    from jax.sharding import PartitionSpec as P
+    from ..parallel.mesh import READS_AXIS
+    fn = jax.shard_map(
+        partial(flagstat_kernel_wire32, axis_name=READS_AXIS), mesh=mesh,
+        in_specs=(P(READS_AXIS),), out_specs=P())
     return jax.jit(fn)
 
 

@@ -84,32 +84,24 @@ def _first_two_per_bucket(bucket_id: np.ndarray, rows: np.ndarray,
     return first, second
 
 
-def mark_duplicates_flags(table: pa.Table, batch: ReadBatch | None = None
-                          ) -> np.ndarray:
-    """Compute the new packed ``flags`` column with FLAG_DUPLICATE set/cleared
-    per the reference algorithm.  Returns int64 [num_rows]."""
-    n = table.num_rows
-    if batch is None:
-        batch = pack_reads(table)
+def decide_duplicates(flags: np.ndarray, refid: np.ndarray, fp: np.ndarray,
+                      score: np.ndarray, bucket_id: np.ndarray,
+                      lib_idx: np.ndarray) -> np.ndarray:
+    """The grouping/winner core over per-read columns -> dup bool [N].
 
-    fp_dev, score_dev = _device_fiveprime_and_score(
-        jnp.asarray(batch.flags), jnp.asarray(batch.start),
-        jnp.asarray(batch.cigar_ops), jnp.asarray(batch.cigar_lens),
-        jnp.asarray(batch.n_cigar), jnp.asarray(batch.quals))
-    fp = np.asarray(fp_dev)[:n]
-    score = np.asarray(score_dev)[:n]
-
-    flags = np.asarray(batch.flags[:n], np.int64)
-    refid = np.asarray(batch.refid[:n], np.int64)
-    rgid = np.asarray(batch.read_group[:n], np.int64)
+    Inputs are global arrays in dataset order: SAM ``flags``, ``refid``,
+    orientation-aware unclipped 5' positions ``fp``, phred>=15 quality sums
+    ``score``, dense (recordGroup, readName) bucket ids, and dense library
+    codes.  Split out from :func:`mark_duplicates_flags` so the streaming
+    pipeline can run it over compact key columns accumulated across chunks
+    without holding the records themselves.
+    """
+    n = len(flags)
+    flags = np.asarray(flags, np.int64)
+    refid = np.asarray(refid, np.int64)
     mapped = (flags & S.FLAG_UNMAPPED) == 0
     primary = (flags & S.FLAG_SECONDARY) == 0
     strand = (flags & S.FLAG_REVERSE) != 0
-
-    # ---- bucket by (recordGroupId, readName) (SingleReadBucket.scala:30-37)
-    name_idx = dictionary_codes(table.column("readName"))
-    combined = (rgid + 1) * (name_idx.max(initial=0) + 2) + (name_idx + 1)
-    _, bucket_id = np.unique(combined, return_inverse=True)
     n_buckets = int(bucket_id.max(initial=-1)) + 1
 
     # ---- first two primary-mapped reads per bucket = the position pair
@@ -124,7 +116,6 @@ def mark_duplicates_flags(table: pa.Table, batch: ReadBatch | None = None
 
     # ---- library of allReads(0) (MarkDuplicates.scala:62-64): first read by
     # (primary-mapped, secondary-mapped, unmapped) priority then input order
-    lib_idx = dictionary_codes(table.column("recordGroupLibrary"))
     priority = np.where(mapped & primary, 0, np.where(mapped, 1, 2))
     order = np.lexsort((np.arange(n), priority, bucket_id))
     ob = bucket_id[order]
@@ -170,8 +161,58 @@ def mark_duplicates_flags(table: pa.Table, batch: ReadBatch | None = None
         bpairs = bwin = np.zeros(n, bool)
     frag_in_pair_group = (bleft != 0) & (bright == 0) & bpairs
     scored = (bleft != 0) & ((bright != 0) | ~bpairs)
-    dup = mapped & (frag_in_pair_group | (scored & (~primary | ~bwin)))
+    return mapped & (frag_in_pair_group | (scored & (~primary | ~bwin)))
 
+
+def bucket_ids_from_keys(rgid: np.ndarray, *name_keys: np.ndarray
+                         ) -> np.ndarray:
+    """Dense (recordGroup, readName) bucket ids from integer key columns.
+
+    ``name_keys`` identify the read name (a dictionary code, or the two
+    words of a 128-bit hash in the streaming pipeline).  Buckets number by
+    first appearance order of nothing in particular — only equality matters.
+    """
+    n = len(rgid)
+    cols = (np.asarray(rgid, np.int64),) + tuple(
+        np.asarray(k).view(np.int64) if np.asarray(k).dtype == np.uint64
+        else np.asarray(k, np.int64) for k in name_keys)
+    order = np.lexsort(cols[::-1])
+    new = np.zeros(n, bool)
+    new[0:1] = True
+    for c in cols:
+        s = c[order]
+        new[1:] |= s[1:] != s[:-1]
+    ids_sorted = np.cumsum(new) - 1
+    bucket_id = np.empty(n, np.int64)
+    bucket_id[order] = ids_sorted
+    return bucket_id
+
+
+def mark_duplicates_flags(table: pa.Table, batch: ReadBatch | None = None
+                          ) -> np.ndarray:
+    """Compute the new packed ``flags`` column with FLAG_DUPLICATE set/cleared
+    per the reference algorithm.  Returns int64 [num_rows]."""
+    n = table.num_rows
+    if batch is None:
+        batch = pack_reads(table)
+
+    fp_dev, score_dev = _device_fiveprime_and_score(
+        jnp.asarray(batch.flags), jnp.asarray(batch.start),
+        jnp.asarray(batch.cigar_ops), jnp.asarray(batch.cigar_lens),
+        jnp.asarray(batch.n_cigar), jnp.asarray(batch.quals))
+    fp = np.asarray(fp_dev)[:n]
+    score = np.asarray(score_dev)[:n]
+
+    flags = np.asarray(batch.flags[:n], np.int64)
+    refid = np.asarray(batch.refid[:n], np.int64)
+    rgid = np.asarray(batch.read_group[:n], np.int64)
+
+    # ---- bucket by (recordGroupId, readName) (SingleReadBucket.scala:30-37)
+    name_idx = dictionary_codes(table.column("readName"))
+    bucket_id = bucket_ids_from_keys(rgid, name_idx)
+    lib_idx = dictionary_codes(table.column("recordGroupLibrary"))
+
+    dup = decide_duplicates(flags, refid, fp, score, bucket_id, lib_idx)
     return np.where(dup, flags | S.FLAG_DUPLICATE,
                     flags & ~np.int64(S.FLAG_DUPLICATE))
 

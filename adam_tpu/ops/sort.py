@@ -4,9 +4,11 @@ Re-designs ``adamSortReadsByReferencePosition``
 (rdd/AdamRDDFunctions.scala:63-93): mapped reads order by (referenceId,
 start); unmapped reads sort after every mapped read.  The reference scatters
 unmapped reads across 10k synthetic refIds purely to avoid Spark range-
-partitioner skew (:66-82) — irrelevant here, since the sort is a single
-vectorized lexsort on the host shard (and a `jax.lax.sort` on device when part
-of a fused pipeline); unmapped reads simply keep their input order at the end.
+partitioner skew (:66-82) — irrelevant here: this module is a single
+vectorized host lexsort, and the distributed form is the streaming
+pipeline's range partition (genome bins) + per-bin sort
+(parallel/pipeline.streaming_transform pass 4).  Unmapped reads keep their
+input order at the end.
 """
 
 from __future__ import annotations

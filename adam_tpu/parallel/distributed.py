@@ -254,6 +254,16 @@ def pileup_counts_halo_exchange(mesh: Mesh, bin_span: int, halo: int,
             f"halo {halo} exceeds bin_span {bin_span}: one ring step only "
             "reaches the immediate neighbor, so overhang beyond a full "
             "stripe would be lost — widen the stripes or shrink the halo")
+    if halo < max_len - 1:
+        # the silent-undercount direction: a read starting on a stripe's
+        # last position reaches max_len - 1 positions past the edge; a
+        # smaller halo would drop those boundary counts without any error
+        # (deletions consume extra reference — callers still owe headroom
+        # for them on top of this read-length floor)
+        raise ValueError(
+            f"halo {halo} below the read-length floor max_len - 1 = "
+            f"{max_len - 1}: boundary positions past bin_span + halo would "
+            "be silently lost")
     spec = P(READS_AXIS)
 
     def step(bases, quals, start, flags, mapq, valid, cigar_ops, cigar_lens):

@@ -59,7 +59,7 @@ def test_config_mismatch_rejected(tmp_path):
     path = str(tmp_path / "ck")
     run_stages(CheckpointDir(path, ["cfg1"]), _table(1),
                [("a", lambda t: t)])
-    with pytest.raises(ValueError, match="different pipeline"):
+    with pytest.raises(ValueError, match="refusing to resume"):
         CheckpointDir(path, ["cfg2"])
 
 
@@ -119,6 +119,21 @@ def test_cli_transform_edited_input_invalidates(tmp_path, resources):
                "-mark_duplicate_reads", "-checkpoint_dir", ck])
     assert rc == 0
     os.utime(sam, ns=(0, 0))  # same bytes, different mtime
-    with pytest.raises(ValueError, match="different pipeline configuration"):
+    with pytest.raises(ValueError, match="input file"):
         main(["transform", str(sam), str(tmp_path / "o2"),
               "-mark_duplicate_reads", "-checkpoint_dir", ck])
+
+
+def test_checkpoint_mismatch_messages_distinguish_cause(tmp_path):
+    import pytest
+    from adam_tpu.checkpoint import CheckpointDir
+    # input stamp change -> "input file(s) changed"
+    CheckpointDir(str(tmp_path / "a"),
+                  ["in.sam:100:1", "dbsnp=None", "markdup"])._write_manifest()
+    with pytest.raises(ValueError, match="input file"):
+        CheckpointDir(str(tmp_path / "a"), ["in.sam:200:2", "dbsnp=None", "markdup"])
+    # different stage list -> "stage"
+    CheckpointDir(str(tmp_path / "b"),
+                  ["in.sam:100:1", "dbsnp=None", "markdup"])._write_manifest()
+    with pytest.raises(ValueError, match="stage"):
+        CheckpointDir(str(tmp_path / "b"), ["in.sam:100:1", "dbsnp=None", "sort"])

@@ -133,3 +133,12 @@ def test_pileup_halo_exchange_matches_single_device():
         bin_span=genome_len, max_len=L))
     np.testing.assert_array_equal(out, ref)
     assert out[:, CH_COVERAGE].sum() > 0 and out[:, CH_DEL].sum() > 0
+
+
+def test_halo_exchange_rejects_undersized_halo():
+    import pytest
+    from adam_tpu.parallel.distributed import pileup_counts_halo_exchange
+    from adam_tpu.parallel.mesh import make_mesh
+    mesh = make_mesh(4)
+    with pytest.raises(ValueError, match="read-length floor"):
+        pileup_counts_halo_exchange(mesh, bin_span=256, halo=16, max_len=32)

@@ -163,3 +163,24 @@ def test_wire_pack_rejects_wide_uint16_refids():
         pack_flagstat_wire32(np.zeros(n, np.uint16), np.zeros(n, np.uint8),
                              np.full(n, 40000, np.uint16),
                              np.zeros(n, np.uint16), np.ones(n, bool))
+
+
+def test_wire_pack_rejects_out_of_range_flags_and_mapq():
+    import numpy as np
+    import pytest
+    from adam_tpu.ops.flagstat import (pack_flagstat_wire,
+                                       pack_flagstat_wire32)
+    n = 4
+    ok16 = np.zeros(n, np.uint16)
+    ok8 = np.zeros(n, np.uint8)
+    refid = np.zeros(n, np.int16)
+    valid = np.ones(n, bool)
+    wide_flags = np.full(n, 1 << 16, np.int32)
+    neg_mapq = np.full(n, -1, np.int32)  # the null sentinel, unsanitized
+    for packer in (pack_flagstat_wire, pack_flagstat_wire32):
+        with pytest.raises(ValueError, match="flags"):
+            packer(wide_flags, ok8, refid, refid, valid)
+        with pytest.raises(ValueError, match="mapq"):
+            packer(ok16, neg_mapq, refid, refid, valid)
+        packer(ok16.astype(np.int32), ok8.astype(np.int32), refid, refid,
+               valid)  # in-range wide dtypes are fine
