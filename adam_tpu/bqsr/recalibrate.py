@@ -69,15 +69,15 @@ def mismatch_state(table: pa.Table, batch: ReadBatch,
         jnp.asarray(batch.cigar_lens)))[:n]
     start = np.asarray(batch.start[:n], np.int64)
 
-    mds = table.column("mismatchingPositions").to_pylist()
+    md_col = table.column("mismatchingPositions")
     state = np.full((n, L), STATE_MASKED, np.int8)
     in_align = (pos >= 0) & (pos >= start[:, None]) & (pos < end[:, None])
 
     # MD mismatch lookup (shared encoding with the pileup engine)
-    from ..ops.pileup import _lookup, _md_lookup_arrays
-    usable_rows = np.flatnonzero([m is not None for m in mds])
-    mm_keys, mm_bases, _, _ = _md_lookup_arrays(mds, start, usable_rows)
-    has_md = np.array([m is not None for m in mds])
+    from ..ops.pileup import _col_valid, _lookup, _md_lookup_arrays
+    has_md = _col_valid(md_col)
+    usable_rows = np.flatnonzero(has_md)
+    mm_keys, mm_bases, _, _ = _md_lookup_arrays(md_col, start, usable_rows)
 
     rows = np.arange(n)[:, None].repeat(L, 1)
     keys = (rows.astype(np.int64) << 34) | np.maximum(pos, 0)
@@ -145,9 +145,9 @@ def compute_table(table: pa.Table, batch: Optional[ReadBatch] = None,
     n = table.num_rows
     if batch is None:
         batch = pack_reads(table)
+    from ..ops.pileup import _col_valid
     has_md = np.zeros(batch.n_reads, bool)
-    has_md[:n] = [m is not None
-                  for m in table.column("mismatchingPositions").to_pylist()]
+    has_md[:n] = _col_valid(table.column("mismatchingPositions"))
     flags_np = np.asarray(batch.flags)
     usable = usable_read_mask(flags_np, has_md) & np.asarray(batch.valid)
 
@@ -219,17 +219,30 @@ def apply_table(rt: RecalTable, table: pa.Table,
         jnp.asarray(fin.cycle_delta), jnp.asarray(fin.ctx_delta),
         jnp.asarray(fin.rg_of_qualrg)))[:n]
 
-    read_len = np.asarray(batch.read_len[:n])
-    quals_out = []
-    old = table.column("qual").to_pylist()
-    for i in range(n):
-        if not recal_mask[i] or old[i] is None:
-            quals_out.append(old[i])
-        else:
-            q = new_quals[i, :read_len[i]] + 33
-            quals_out.append(bytes(q.astype(np.uint8)).decode("ascii"))
+    read_len = np.asarray(batch.read_len[:n], np.int64)
+    old_col = table.column("qual").combine_chunks()
+    nulls = np.asarray(old_col.is_null()) if old_col.null_count \
+        else np.zeros(n, bool)
+    # vectorized string rebuild: the apply kernel already returns the
+    # original qual for non-recalibrated bases/rows, so every non-null row's
+    # new string is just its (new_quals + 33) prefix — build the Arrow
+    # column straight from an offsets+data buffer pair, no per-read loop
+    lens = np.where(nulls, 0, read_len)
+    offsets = np.zeros(n + 1, np.int32)
+    np.cumsum(lens, out=offsets[1:])
+    mat = (new_quals.astype(np.int16) + 33).astype(np.uint8)
+    L = mat.shape[1] if mat.ndim == 2 else 0
+    keep = (np.arange(L)[None, :] < lens[:, None])
+    data = mat[keep].tobytes()
+    buffers = [None, pa.py_buffer(offsets), pa.py_buffer(data)]
+    null_count = int(nulls.sum())
+    if null_count:
+        buffers[0] = pa.py_buffer(
+            np.packbits(~nulls, bitorder="little").tobytes())
+    new_col = pa.Array.from_buffers(pa.string(), n, buffers,
+                                    null_count=null_count)
     idx = table.column_names.index("qual")
-    return table.set_column(idx, "qual", pa.array(quals_out, pa.string()))
+    return table.set_column(idx, "qual", new_col)
 
 
 def recalibrate_base_qualities(table: pa.Table,

@@ -106,22 +106,99 @@ def parse_header(data: bytes, path="<bytes>"
     return SequenceDictionary(refs), rg_dict, off
 
 
+def _bgzf_member_size(buf, off: int):
+    """Parse one BGZF member header at ``off`` -> total member size, or
+    None when the BSIZE ('BC') extra subfield is absent / header truncated.
+    """
+    if off + 18 > len(buf):
+        return None
+    if buf[off] != 0x1F or buf[off + 1] != 0x8B or not (buf[off + 3] & 4):
+        return None
+    xlen = buf[off + 10] | (buf[off + 11] << 8)
+    p, end = off + 12, off + 12 + xlen
+    if end > len(buf):
+        return None
+    while p + 4 <= end:
+        si1, si2 = buf[p], buf[p + 1]
+        slen = buf[p + 2] | (buf[p + 3] << 8)
+        if si1 == 66 and si2 == 67 and slen == 2:  # 'B','C'
+            return (buf[p + 4] | (buf[p + 5] << 8)) + 1
+        p += 4 + slen
+    return None
+
+
+def _iter_decompressed_bgzf(f, chunk_bytes: int):
+    """Threaded BGZF decompression: members are independent deflate blocks,
+    and ``zlib.decompress`` releases the GIL, so a thread pool inflates a
+    batch of members in parallel (~8x one thread)."""
+    import os as _os
+    from concurrent.futures import ThreadPoolExecutor
+
+    from ..errors import FormatError
+
+    def inflate(view):
+        # strip 12-byte header + extra field; trailing 8 bytes are crc+isize
+        xlen = view[10] | (view[11] << 8)
+        isize = int.from_bytes(view[-4:], "little")
+        return zlib.decompress(bytes(view[12 + xlen:-8]), wbits=-15,
+                               bufsize=isize or 1)
+
+    with ThreadPoolExecutor(min(8, _os.cpu_count() or 1)) as pool:
+        buf = bytearray()
+        eof = False
+        target = chunk_bytes
+        while not eof or buf:
+            while not eof and len(buf) < target:
+                raw = f.read(chunk_bytes)
+                if not raw:
+                    eof = True
+                else:
+                    buf += raw
+            members = []
+            off = 0
+            while True:
+                size = _bgzf_member_size(buf, off)
+                if size is None or off + size > len(buf):
+                    break
+                members.append(memoryview(buf)[off:off + size])
+                off += size
+            if not members:
+                if buf and eof:
+                    raise FormatError(
+                        f"{len(buf)} trailing bytes form no BGZF member")
+                if not eof:
+                    # one member larger than the current window: widen it
+                    target = max(target * 2, len(buf) + chunk_bytes)
+                    continue
+                break
+            target = chunk_bytes
+            chunk = b"".join(pool.map(inflate, members))
+            del members  # release memoryviews before compacting
+            del buf[:off]
+            if chunk:
+                yield chunk
+
+
 def iter_decompressed(path, chunk_bytes: int = 1 << 24):
     """Stream a (possibly BGZF-compressed) file as decompressed byte chunks.
 
     The whole-file :func:`load_decompressed` holds the full decompressed BAM
-    in memory; this generator bounds host RSS for multi-GB inputs — BGZF
-    members decompress incrementally as the raw bytes arrive.
+    in memory; this generator bounds host RSS for multi-GB inputs.  BGZF
+    inputs (the normal case) decompress member-parallel across a thread
+    pool; plain whole-file gzip falls back to sequential streaming.
     """
     with open(path, "rb") as f:
-        magic = f.read(2)
+        head = f.read(18)
         f.seek(0)
-        if magic != b"\x1f\x8b":
+        if head[:2] != b"\x1f\x8b":
             while True:
                 raw = f.read(chunk_bytes)
                 if not raw:
                     return
                 yield raw
+        if _bgzf_member_size(head, 0) is not None:
+            yield from _iter_decompressed_bgzf(f, chunk_bytes)
+            return
         d = zlib.decompressobj(wbits=31)
         while True:
             raw = f.read(chunk_bytes)
@@ -138,6 +215,26 @@ def iter_decompressed(path, chunk_bytes: int = 1 << 24):
             chunk = b"".join(out)
             if chunk:
                 yield chunk
+
+
+def parse_tag_region(data, p: int, end: int):
+    """Walk a record's optional-field region -> (attr strings, MD, RG).
+
+    Shared by the pure-Python record parser and the native decoder's
+    float-tag fallback (C cannot reproduce Python's float repr).
+    """
+    attrs = []
+    md = None
+    rg_name = None
+    while p < end:
+        tag, typ, value, p = _parse_tag_value(data, p)
+        if tag == "MD":
+            md = str(value)
+        elif tag == "RG":
+            rg_name = str(value)
+        else:
+            attrs.append(f"{tag}:{typ}:{value}")
+    return attrs, md, rg_name
 
 
 def _parse_record(data, off: int, seq_dict, rg_dict):
@@ -180,17 +277,7 @@ def _parse_record(data, off: int, seq_dict, rg_dict):
     qual = None if (l_seq == 0 or quals[:1] == b"\xff") else \
         "".join(chr(q + 33) for q in quals)
 
-    attrs = []
-    md = None
-    rg_name = None
-    while p < rec_end:
-        tag, typ, value, p = _parse_tag_value(data, p)
-        if tag == "MD":
-            md = str(value)
-        elif tag == "RG":
-            rg_name = str(value)
-        else:
-            attrs.append(f"{tag}:{typ}:{value}")
+    attrs, md, rg_name = parse_tag_region(data, p, rec_end)
 
     row = dict(
         readName=read_name if read_name != "*" else None,

@@ -40,12 +40,14 @@ def load_reads(path: str, *, columns: Optional[Sequence[str]] = None,
     p = str(path)
     if p.endswith(".sam") or p.endswith(".bam"):
         if p.endswith(".bam"):
-            try:
-                from .bam import read_bam
-            except ImportError as e:
-                raise FileNotFoundError(
-                    f"BAM support not available yet ({e}); convert to SAM") from e
-            table, sd, rg = read_bam(p)
+            # native Arrow decoder when built; pure-Python codec otherwise
+            from .. import schema as S
+            from .fastbam import open_bam_arrow_stream
+            sd, rg, gen = open_bam_arrow_stream(p)
+            tables = list(gen)
+            table = pa.concat_tables(tables) if tables else \
+                pa.Table.from_pydict({n: [] for n in S.READ_SCHEMA.names},
+                                     schema=S.READ_SCHEMA)
         else:
             table, sd, rg = read_sam(p)
         if columns is not None:

@@ -57,8 +57,8 @@ def open_read_stream(path: str, *, columns: Optional[Sequence[str]] = None,
     """Open SAM/BAM/Parquet reads as a bounded-memory chunk stream."""
     p = str(path)
     if p.endswith(".bam"):
-        from .bam import open_bam_stream
-        sd, rg, gen = open_bam_stream(p, chunk_rows=chunk_rows)
+        from .fastbam import open_bam_arrow_stream
+        sd, rg, gen = open_bam_arrow_stream(p, chunk_rows=chunk_rows)
         return ReadStream(_projected(gen, columns, filters), sd, rg)
     if p.endswith(".sam"):
         from .sam import open_sam_stream

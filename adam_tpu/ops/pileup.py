@@ -85,12 +85,50 @@ def pileup_walk(start, cigar_ops, cigar_lens, max_len: int):
     return pos, op_at, off_in_op, len_at, in_read
 
 
+def _col_valid(col) -> np.ndarray:
+    """Arrow (chunked) column -> bool validity numpy array."""
+    arr = col.combine_chunks() if isinstance(col, pa.ChunkedArray) else col
+    if len(arr) == 0:
+        return np.zeros(0, bool)
+    return np.asarray(arr.is_valid())
+
+
 def _md_lookup_arrays(mds, starts, usable_rows):
     """Parse MD tags (host) into flat lookup arrays.
 
-    Returns (mm_keys, mm_bases, del_keys, del_bases) where keys combine
-    (read_row << 34 | ref_pos) for vectorized searchsorted lookups.
+    ``mds`` is an Arrow string column (fast path: one native C pass over
+    its offsets+data buffers) or a Python list (fallback FSM).  Returns
+    (mm_keys, mm_bases, del_keys, del_bases) where keys combine
+    (read_row << 34 | ref_pos), sorted, for vectorized searchsorted
+    lookups.
     """
+    native = None
+    if isinstance(mds, (pa.ChunkedArray, pa.Array)):
+        try:
+            import adam_tpu_native as N
+            native = getattr(N, "md_parse", None)
+        except ImportError:  # pragma: no cover - toolchain-less envs
+            native = None
+        if native is not None:
+            arr = mds.combine_chunks() if isinstance(mds, pa.ChunkedArray) \
+                else mds
+            if len(arr) == 0:
+                z = np.zeros(0, np.int64), np.zeros(0, np.uint8)
+                return z[0], z[1], z[0].copy(), z[1].copy()
+            bufs = arr.buffers()
+            offsets = np.frombuffer(bufs[1], np.int32, count=len(arr) + 1,
+                                    offset=arr.offset * 4)
+            data = np.frombuffer(bufs[2], np.uint8) \
+                if bufs[2] is not None else np.zeros(0, np.uint8)
+            mm_k, mm_b, del_k, del_b = native(
+                offsets, data,
+                np.ascontiguousarray(usable_rows, np.int64),
+                np.ascontiguousarray(starts, np.int64))
+            return (np.frombuffer(mm_k, np.int64).copy(),
+                    np.frombuffer(mm_b, np.uint8).copy(),
+                    np.frombuffer(del_k, np.int64).copy(),
+                    np.frombuffer(del_b, np.uint8).copy())
+        mds = mds.to_pylist()
     mm_k, mm_b, del_k, del_b = [], [], [], []
     for row in usable_rows:
         md = MdTag.parse(mds[row], int(starts[row]))
@@ -145,14 +183,12 @@ def reads_to_pileups(table: pa.Table, batch: Optional[ReadBatch] = None
     in_read = np.asarray(inread_d)[:n]
     read_end = np.asarray(end_d)[:n]
 
-    mds = table.column("mismatchingPositions").to_pylist()
-    cigars_null = np.array([c is None for c in
-                            table.column("cigar").to_pylist()])
-    usable = np.array([m is not None for m in mds]) & ~cigars_null
+    md_col = table.column("mismatchingPositions")
+    usable = _col_valid(md_col) & _col_valid(table.column("cigar"))
     usable_rows = np.flatnonzero(usable)
     starts = np.asarray(batch.start[:n], np.int64)
     mm_keys, mm_bases, del_keys, del_bases = _md_lookup_arrays(
-        mds, starts, usable_rows)
+        md_col, starts, usable_rows)
 
     # ---- read-base emissions: ops M, I, S
     emit = in_read & usable[:, None] & ((op == S.CIGAR_M) | (op == S.CIGAR_I) |

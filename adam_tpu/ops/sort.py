@@ -5,10 +5,12 @@ Re-designs ``adamSortReadsByReferencePosition``
 start); unmapped reads sort after every mapped read.  The reference scatters
 unmapped reads across 10k synthetic refIds purely to avoid Spark range-
 partitioner skew (:66-82) — irrelevant here: this module is a single
-vectorized host lexsort, and the distributed form is the streaming
+vectorized host lexsort.  The distributed forms are (a) the streaming
 pipeline's range partition (genome bins) + per-bin sort
-(parallel/pipeline.streaming_transform pass 4).  Unmapped reads keep their
-input order at the end.
+(parallel/pipeline.streaming_transform pass 4) and (b) the on-device
+sample sort over XLA collectives (parallel/sort.py), both differentially
+tested against this host sort.  Unmapped reads keep their input order at
+the end.
 """
 
 from __future__ import annotations

@@ -41,6 +41,10 @@ def _wire32_from_table(table: pa.Table) -> np.ndarray:
     # matching the unpacked kernel's mapq=-1 (both fail the >=5 test)
     refid = column_int64(table, "referenceId", -1)
     mate_refid = column_int64(table, "mateReferenceId", -1)
+    # range-check BEFORE narrowing: a wrapped int16 would pass the packer's
+    # own guard and silently corrupt the cross-chromosome counters
+    from ..ops.flagstat import _check_refid_range
+    _check_refid_range(refid, mate_refid)
     return pack_flagstat_wire32(
         flags.astype(np.uint16), mapq.astype(np.uint8),
         refid.astype(np.int16), mate_refid.astype(np.int16),
@@ -263,9 +267,15 @@ def streaming_transform(input_path: str, output_path: str, *,
             if raw_writer is not None:
                 raw_writer.write(table)
             if keys is not None or bqsr:
+                # grow the length bucket BEFORE packing — a later chunk may
+                # hold a longer read than anything seen so far
+                import pyarrow.compute as pc
+                chunk_max = pc.max(pc.binary_length(
+                    table.column("sequence"))).as_py() or 1
+                bucket_len = max(bucket_len,
+                                 ((chunk_max + 127) // 128) * 128)
                 batch = pack_reads(table, pad_rows_to=mesh.size,
                                    bucket_len=bucket_len)
-                bucket_len = max(bucket_len, batch.max_len)
                 if keys is not None:
                     keys.add_chunk(table, batch)
         if raw_writer is not None:

@@ -1,0 +1,163 @@
+"""Distributed device sample-sort — the cluster sort, on the mesh.
+
+Re-designs ``adamSortReadsByReferencePosition``'s range-partition +
+``sortByKey`` (rdd/AdamRDDFunctions.scala:63-93) as an on-device sample
+sort over XLA collectives:
+
+  1. each shard sorts locally and takes evenly spaced key samples;
+  2. one ``all_gather`` pools the samples; the pooled sort's quantiles
+     become the n-1 range splitters (the reference's RangePartitioner
+     does exactly this with a driver-side sample collect);
+  3. rows route to the shard owning their key range with the MoE-style
+     fixed-capacity ``all_to_all`` (parallel/distributed.py);
+  4. each shard sorts what it received; shard order == key-range order,
+     so reading shards in order yields the global sort.
+
+Keys are TWO int32 words — (dense contig rank, biased position) — not one
+int64: TPUs have no native int64 (and this runtime keeps x64 off, where
+int64 device arrays silently truncate), while ``lax.sort`` with
+``num_keys`` gives exact lexicographic multiword ordering for free.  Ties
+break by original row index (a third sort word), which makes the whole
+sort STABLE — the same guarantee ``ops/sort.sort_order``'s lexsort gives,
+so the two agree bit-for-bit and the multi-device path is testable
+against the host path.
+
+The reference scatters unmapped reads over 10k synthetic keys to dodge
+range-partitioner skew (:66-82); here unmapped rows share one maximal key
+and skew is bounded by the capacity factor instead — overflow raises
+loudly rather than silently dropping rows.
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache, partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+from .. import schema as S
+from .mesh import READS_AXIS, make_mesh
+
+_POS_BIAS = np.int64(1) << 31
+_PAD_HI = np.int32(2**31 - 1)   # sorts after every real rank incl. unmapped
+
+
+def pack_sort_keys(flags: np.ndarray, refid: np.ndarray,
+                   start: np.ndarray):
+    """(flags, refid, start) -> (hi int32, lo uint32) key words matching
+    ``ops/sort``'s (key_ref, key_pos) lexsort order.  Contig ids densify
+    to ranks first (ids can be sparse, e.g. crc32-assigned)."""
+    flags = np.asarray(flags, np.int64)
+    refid = np.asarray(refid, np.int64)
+    start = np.asarray(start, np.int64)
+    mapped = (flags & S.FLAG_UNMAPPED) == 0
+    ids = np.unique(refid)
+    rank = np.searchsorted(ids, refid).astype(np.int64)
+    n_rank = len(ids) + 1
+    hi = np.where(mapped, rank, n_rank).astype(np.int32)
+    # unmapped reads keep input order, so their ORDER IS their key: using
+    # the row index as the position word spreads what would otherwise be
+    # one giant equal-key run across every splitter range — the same skew
+    # dodge as the reference's 10k-synthetic-key scatter
+    # (AdamRDDFunctions.scala:66-82), but exact instead of approximate
+    lo = np.where(mapped, start + _POS_BIAS,
+                  np.arange(len(flags))).astype(np.uint32)
+    return hi, lo
+
+
+def _lex_dest(hi, lo, sp_hi, sp_lo):
+    """searchsorted(splitters, key, side='right') over two-word keys:
+    dest = count of splitters <= key, lexicographically."""
+    le = (sp_hi[None, :] < hi[:, None]) | \
+        ((sp_hi[None, :] == hi[:, None]) & (sp_lo[None, :] <= lo[:, None]))
+    return jnp.sum(le.astype(jnp.int32), axis=1)
+
+
+def _sort_step(hi, lo, idx, n_shards: int, capacity: int, n_samples: int):
+    m = hi.shape[0]
+    lh, ll, li = jax.lax.sort((hi, lo, idx), num_keys=3)
+    stride = max(m // n_samples, 1)
+    sh = jax.lax.all_gather(lh[::stride][:n_samples], READS_AXIS).reshape(-1)
+    sl = jax.lax.all_gather(ll[::stride][:n_samples], READS_AXIS).reshape(-1)
+    sh, sl = jax.lax.sort((sh, sl), num_keys=2)
+    q = sh.shape[0] // n_shards
+    sp_hi = sh[q::q][:n_shards - 1]
+    sp_lo = sl[q::q][:n_shards - 1]
+    dest = _lex_dest(lh, ll, sp_hi, sp_lo)
+
+    from .distributed import _reshard_step
+    (rh, rl, ri), recv_valid, overflow = _reshard_step(
+        dest, (lh, ll, li), n_shards, capacity, READS_AXIS)
+    rh = jnp.where(recv_valid, rh, _PAD_HI)
+    ri = jnp.where(recv_valid, ri, jnp.iinfo(jnp.int32).max)
+    oh, ol, oi = jax.lax.sort((rh, rl, ri), num_keys=3)
+    return oh, oi, jnp.sum(recv_valid.astype(jnp.int32))[None], overflow
+
+
+@lru_cache(maxsize=None)
+def _build_sorter(mesh: Mesh, capacity: int, n_samples: int):
+    n_shards = mesh.shape[READS_AXIS]
+    spec = P(READS_AXIS)
+    fn = jax.shard_map(
+        partial(_sort_step, n_shards=n_shards, capacity=capacity,
+                n_samples=n_samples),
+        mesh=mesh, in_specs=(spec, spec, spec),
+        out_specs=(spec, spec, spec, P()))
+    return jax.jit(fn)
+
+
+def sample_sort_permutation(hi: np.ndarray, lo: np.ndarray,
+                            mesh: Mesh = None, *,
+                            capacity_factor: float = 3.0,
+                            n_samples: int = 64) -> np.ndarray:
+    """Global stable-sort permutation of two-word keys, computed on the
+    mesh.  ``perm`` satisfies: (hi, lo)[perm] is sorted with ties in
+    original order — identical to ``np.lexsort((lo, hi))``."""
+    if mesh is None:
+        mesh = make_mesh()
+    n = len(hi)
+    if n == 0:
+        return np.zeros(0, np.int64)
+    if n >= 2**31:
+        raise ValueError("row index exceeds int32 (shard the input first)")
+    n_shards = mesh.shape[READS_AXIS]
+    m = -(-n // n_shards)  # rows per shard
+    n_pad = m * n_shards
+    hp = np.full(n_pad, _PAD_HI, np.int32)
+    lp = np.arange(n_pad, dtype=np.uint32)  # pads spread like unmapped rows
+    hp[:n] = hi
+    lp[:n] = lo
+    idx = np.arange(n_pad, dtype=np.int32)
+    capacity = max(int(capacity_factor * m / n_shards) + n_samples, 16)
+    fn = _build_sorter(mesh, capacity, n_samples)
+    from .mesh import reads_sharding
+    sharding = reads_sharding(mesh)
+    oh, oi, counts, overflow = fn(jax.device_put(hp, sharding),
+                                  jax.device_put(lp, sharding),
+                                  jax.device_put(idx, sharding))
+    if int(overflow) != 0:
+        raise ValueError(
+            f"sample sort overflowed capacity {capacity} on "
+            f"{int(overflow)} rows — key skew beyond capacity_factor "
+            f"{capacity_factor}; raise it (the reference's analog is its "
+            "10k-synthetic-key unmapped scatter, AdamRDDFunctions.scala:66)")
+    oi = np.asarray(oi).reshape(n_shards, -1).astype(np.int64)
+    counts = np.asarray(counts).reshape(n_shards)
+    perm = np.concatenate([oi[s, :counts[s]] for s in range(n_shards)])
+    return perm[perm < n]  # drop padding rows (maximal keys, sort last)
+
+
+def sort_reads_distributed(table, mesh: Mesh = None):
+    """``adamSortReadsByReferencePosition`` over the mesh: device sample
+    sort of the packed keys, then one host gather by the permutation."""
+    import pyarrow as pa
+
+    from ..packing import column_int64
+
+    hi, lo = pack_sort_keys(column_int64(table, "flags", 0),
+                            column_int64(table, "referenceId"),
+                            column_int64(table, "start"))
+    perm = sample_sort_permutation(hi, lo, mesh)
+    return table.take(pa.array(perm))

@@ -231,6 +231,411 @@ static PyObject *pack_chunk(PyObject *self, PyObject *args) {
     return pack_impl(args, 1);
 }
 
+/* ------------------------------------------------------ decode_arrow */
+/* BAM records -> Arrow column buffers, single C pass.
+ *
+ * The streaming transform's ingest was dominated by the per-record Python
+ * record parser (~60 us/record); this decoder emits the READ_SCHEMA string
+ * columns (name/sequence/qual/cigar/MD/RG/attributes) as offsets+data
+ * buffers that pyarrow wraps zero-copy.  Attribute tags are formatted in C
+ * exactly as the Python codec formats them ("TAG:i:123", tab-joined,
+ * MD/RG lifted out); records containing float tags (whose Python repr C
+ * cannot reproduce bit-for-bit) get their raw tag region copied to a side
+ * buffer and a needs_py flag so Python re-formats just those. */
+
+#include <stdlib.h>
+#include <stdio.h>
+
+typedef struct { uint8_t *p; Py_ssize_t len, cap; } dynbuf;
+
+static int db_reserve(dynbuf *b, Py_ssize_t extra) {
+    if (b->len + extra <= b->cap) return 0;
+    Py_ssize_t nc = b->cap ? b->cap * 2 : 4096;
+    while (nc < b->len + extra) nc *= 2;
+    uint8_t *q = (uint8_t *)realloc(b->p, (size_t)nc);
+    if (!q) return -1;
+    b->p = q; b->cap = nc;
+    return 0;
+}
+
+static void db_put(dynbuf *b, const uint8_t *src, Py_ssize_t n) {
+    memcpy(b->p + b->len, src, (size_t)n);
+    b->len += n;
+}
+
+static const char SEQ_CHARS[17] = "=ACMGRSVTWYHKDBN";
+static const char CIG_CHARS[10] = "MIDNSHP=X";
+
+/* one optional field; returns new offset or -1 on unknown type */
+static Py_ssize_t tag_size(const uint8_t *d, Py_ssize_t off,
+                           Py_ssize_t end) {
+    uint8_t typ = d[off + 2];
+    off += 3;
+    switch (typ) {
+    case 'A': case 'c': case 'C': return off + 1;
+    case 's': case 'S': return off + 2;
+    case 'i': case 'I': case 'f': return off + 4;
+    case 'Z': case 'H':
+        while (off < end && d[off]) off++;
+        return off + 1;
+    case 'B': {
+        if (off + 5 > end) return -1;  /* count bytes must be in-bounds */
+        uint8_t sub = d[off];
+        int32_t n = rd_i32(d + off + 1);
+        if (n < 0) return -1;
+        int size = (sub == 'c' || sub == 'C') ? 1 :
+                   (sub == 's' || sub == 'S') ? 2 : 4;
+        return off + 5 + (Py_ssize_t)n * size;
+    }
+    default: return -1;
+    }
+}
+
+static long long tag_int(const uint8_t *d, Py_ssize_t off, uint8_t typ) {
+    switch (typ) {
+    case 'c': return (int8_t)d[off];
+    case 'C': return d[off];
+    case 's': { int16_t v; memcpy(&v, d + off, 2); return v; }
+    case 'S': { uint16_t v; memcpy(&v, d + off, 2); return v; }
+    case 'i': return rd_i32(d + off);
+    case 'I': return rd_u32(d + off);
+    }
+    return 0;
+}
+
+static PyObject *decode_arrow(PyObject *self, PyObject *args) {
+    Py_buffer data;
+    Py_ssize_t offset, max_records;
+    Py_buffer flags, refid, start, mapq, mref, mstart;
+    Py_buffer offs[8];   /* name seq qual cig md rg attr raw */
+    Py_buffer vals[7];   /* name seq qual cig md rg attr */
+    Py_buffer needs_py;
+    if (!PyArg_ParseTuple(args, "y*nnw*w*w*w*w*w*"
+                          "w*w*w*w*w*w*w*w*"
+                          "w*w*w*w*w*w*w*" "w*",
+                          &data, &offset, &max_records,
+                          &flags, &refid, &start, &mapq, &mref, &mstart,
+                          &offs[0], &offs[1], &offs[2], &offs[3], &offs[4],
+                          &offs[5], &offs[6], &offs[7],
+                          &vals[0], &vals[1], &vals[2], &vals[3], &vals[4],
+                          &vals[5], &vals[6], &needs_py))
+        return NULL;
+
+    const uint8_t *buf = (const uint8_t *)data.buf;
+    Py_ssize_t n_bytes = data.len;
+    int32_t *f_flags = (int32_t *)flags.buf;
+    int32_t *f_refid = (int32_t *)refid.buf;
+    int32_t *f_start = (int32_t *)start.buf;
+    int32_t *f_mapq = (int32_t *)mapq.buf;
+    int32_t *f_mref = (int32_t *)mref.buf;
+    int32_t *f_mstart = (int32_t *)mstart.buf;
+    int32_t *f_offs[8];
+    uint8_t *f_vals[7];
+    for (int k = 0; k < 8; k++) f_offs[k] = (int32_t *)offs[k].buf;
+    for (int k = 0; k < 7; k++) f_vals[k] = (uint8_t *)vals[k].buf;
+    uint8_t *f_npy = (uint8_t *)needs_py.buf;
+
+    dynbuf bufs[8];
+    memset(bufs, 0, sizeof(bufs));
+    for (int k = 0; k < 8; k++) f_offs[k][0] = 0;
+
+    Py_ssize_t pos = offset, i = 0;
+    int error = 0;
+    enum { B_NAME, B_SEQ, B_QUAL, B_CIG, B_MD, B_RG, B_ATTR, B_RAW };
+
+    Py_BEGIN_ALLOW_THREADS
+    while (pos + 4 <= n_bytes && i < max_records) {
+        int32_t block = rd_i32(buf + pos);
+        if (block < 32 || pos + 4 + block > n_bytes) break;
+        const uint8_t *r = buf + pos + 4;
+        Py_ssize_t rec_end_off = pos + 4 + block;
+        int32_t ref = rd_i32(r);
+        int32_t p0 = rd_i32(r + 4);
+        uint8_t l_name = r[8];
+        uint8_t mq = r[9];
+        uint16_t n_cig = rd_u16(r + 12);
+        uint16_t flag = rd_u16(r + 14);
+        int32_t l_seq = rd_i32(r + 16);
+        int32_t nref = rd_i32(r + 20);
+        int32_t npos = rd_i32(r + 24);
+        if (l_seq < 0 || l_name < 1 ||
+            32LL + l_name + 4LL * n_cig + (l_seq + 1LL) / 2 + l_seq > block) {
+            error = 1;
+            break;
+        }
+
+        f_flags[i] = flag;
+        f_refid[i] = ref;
+        f_start[i] = p0;
+        f_mapq[i] = mq;
+        f_mref[i] = nref;
+        f_mstart[i] = npos;
+
+        /* name ("*" encodes null) */
+        const uint8_t *nm = r + 32;
+        int name_null = (l_name == 2 && nm[0] == '*');
+        if (!name_null) {
+            if (db_reserve(&bufs[B_NAME], l_name)) { error = 2; break; }
+            db_put(&bufs[B_NAME], nm, l_name - 1);
+        }
+        f_vals[B_NAME][i] = !name_null;
+
+        /* cigar */
+        const uint8_t *c = r + 32 + l_name;
+        if (n_cig) {
+            if (db_reserve(&bufs[B_CIG], (Py_ssize_t)n_cig * 12)) {
+                error = 2; break;
+            }
+            char *w = (char *)bufs[B_CIG].p + bufs[B_CIG].len;
+            for (int k = 0; k < n_cig; k++) {
+                uint32_t v = rd_u32(c + 4 * (Py_ssize_t)k);
+                w += sprintf(w, "%u%c", v >> 4, CIG_CHARS[v & 0xF]);
+            }
+            bufs[B_CIG].len = (uint8_t *)w - bufs[B_CIG].p;
+        }
+        f_vals[B_CIG][i] = n_cig > 0;
+
+        /* sequence (4-bit) + qual (+33) */
+        const uint8_t *sq = c + 4 * (Py_ssize_t)n_cig;
+        const uint8_t *ql = sq + (l_seq + 1) / 2;
+        if (l_seq) {
+            if (db_reserve(&bufs[B_SEQ], l_seq) ||
+                db_reserve(&bufs[B_QUAL], l_seq)) { error = 2; break; }
+            uint8_t *ws = bufs[B_SEQ].p + bufs[B_SEQ].len;
+            for (int k = 0; k < l_seq; k++) {
+                uint8_t byte = sq[k >> 1];
+                ws[k] = SEQ_CHARS[(k & 1) ? (byte & 0xF) : (byte >> 4)];
+            }
+            bufs[B_SEQ].len += l_seq;
+            if (ql[0] != 0xFF) {
+                uint8_t *wq = bufs[B_QUAL].p + bufs[B_QUAL].len;
+                for (int k = 0; k < l_seq; k++) wq[k] = ql[k] + 33;
+                bufs[B_QUAL].len += l_seq;
+                f_vals[B_QUAL][i] = 1;
+            } else {
+                f_vals[B_QUAL][i] = 0;
+            }
+            f_vals[B_SEQ][i] = 1;
+        } else {
+            f_vals[B_SEQ][i] = 0;
+            f_vals[B_QUAL][i] = 0;
+        }
+
+        /* tags: MD + RG lifted out, the rest formatted (or raw on floats) */
+        Py_ssize_t t = (ql + l_seq) - buf;
+        Py_ssize_t tag_begin = t;
+        Py_ssize_t attr_mark = bufs[B_ATTR].len;
+        int have_md = 0, have_rg = 0, have_attr = 0, needpy = 0;
+        while (t + 3 <= rec_end_off) {
+            uint8_t t0 = buf[t], t1 = buf[t + 1], typ = buf[t + 2];
+            Py_ssize_t vt = t + 3;
+            Py_ssize_t nt = tag_size(buf, t, rec_end_off);
+            if (nt < 0 || nt > rec_end_off) { error = 3; break; }
+            if (t0 == 'M' && t1 == 'D' && typ == 'Z') {
+                Py_ssize_t zl = nt - 1 - vt;
+                if (db_reserve(&bufs[B_MD], zl)) { error = 2; break; }
+                db_put(&bufs[B_MD], buf + vt, zl);
+                have_md = 1;
+            } else if (t0 == 'R' && t1 == 'G' && typ == 'Z') {
+                Py_ssize_t zl = nt - 1 - vt;
+                if (db_reserve(&bufs[B_RG], zl)) { error = 2; break; }
+                db_put(&bufs[B_RG], buf + vt, zl);
+                have_rg = 1;
+            } else if (!needpy) {
+                if (typ == 'f' || (typ == 'B' && buf[vt] == 'f')) {
+                    needpy = 1;          /* Python re-formats this record */
+                    bufs[B_ATTR].len = attr_mark;
+                } else {
+                    /* size the whole formatted tag up front — a realloc
+                     * after taking `w` would leave it dangling */
+                    Py_ssize_t cap = 48 + (nt - vt) * 5;
+                    if (typ == 'B') {
+                        int32_t bn = rd_i32(buf + vt + 1);
+                        cap = 24 + (Py_ssize_t)bn * 22;
+                    }
+                    if (db_reserve(&bufs[B_ATTR], cap)) { error = 2; break; }
+                    char *w = (char *)bufs[B_ATTR].p + bufs[B_ATTR].len;
+                    if (have_attr) *w++ = '\t';
+                    *w++ = t0; *w++ = t1; *w++ = ':';
+                    switch (typ) {
+                    case 'A':
+                        w += sprintf(w, "A:%c", buf[vt]);
+                        break;
+                    case 'c': case 'C': case 's': case 'S':
+                    case 'i': case 'I':
+                        w += sprintf(w, "i:%lld", tag_int(buf, vt, typ));
+                        break;
+                    case 'Z': case 'H':
+                        *w++ = (char)typ; *w++ = ':';
+                        memcpy(w, buf + vt, nt - 1 - vt);
+                        w += nt - 1 - vt;
+                        break;
+                    case 'B': {
+                        uint8_t sub = buf[vt];
+                        int32_t bn = rd_i32(buf + vt + 1);
+                        int sz = (sub == 'c' || sub == 'C') ? 1 :
+                                 (sub == 's' || sub == 'S') ? 2 : 4;
+                        w += sprintf(w, "B:%c", sub);
+                        for (int32_t k = 0; k < bn; k++)
+                            w += sprintf(w, ",%lld",
+                                         tag_int(buf, vt + 5 +
+                                                 (Py_ssize_t)k * sz, sub));
+                        break;
+                    }
+                    }
+                    if (error) break;
+                    bufs[B_ATTR].len = (uint8_t *)w - bufs[B_ATTR].p;
+                    have_attr = 1;
+                }
+            }
+            t = nt;
+        }
+        if (error) break;
+        if (needpy) {
+            Py_ssize_t rl = rec_end_off - tag_begin;
+            if (db_reserve(&bufs[B_RAW], rl)) { error = 2; break; }
+            db_put(&bufs[B_RAW], buf + tag_begin, rl);
+            have_attr = 1;  /* Python fills the real value */
+        }
+        f_npy[i] = (uint8_t)needpy;
+        f_vals[B_MD][i] = (uint8_t)have_md;
+        f_vals[B_RG][i] = (uint8_t)have_rg;
+        f_vals[B_ATTR][i] = (uint8_t)have_attr;
+
+        i++;
+        for (int k = 0; k < 8; k++)
+            f_offs[k][i] = (int32_t)bufs[k].len;
+        pos = rec_end_off;
+    }
+    Py_END_ALLOW_THREADS
+
+    PyObject *result = NULL;
+    if (!error) {
+        PyObject *blobs[8] = {0};
+        int ok = 1;
+        for (int k = 0; k < 8; k++) {
+            blobs[k] = PyBytes_FromStringAndSize((char *)bufs[k].p,
+                                                 bufs[k].len);
+            if (!blobs[k]) { ok = 0; break; }
+        }
+        if (ok)
+            result = Py_BuildValue("(nnNNNNNNNN)", i, pos,
+                                   blobs[0], blobs[1], blobs[2], blobs[3],
+                                   blobs[4], blobs[5], blobs[6], blobs[7]);
+        else
+            for (int k = 0; k < 8; k++) Py_XDECREF(blobs[k]);
+    } else if (error == 1 || error == 3) {
+        PyErr_SetString(PyExc_ValueError, "corrupt BAM record");
+    } else {
+        PyErr_NoMemory();
+    }
+    for (int k = 0; k < 8; k++) free(bufs[k].p);
+
+    PyBuffer_Release(&data); PyBuffer_Release(&flags);
+    PyBuffer_Release(&refid); PyBuffer_Release(&start);
+    PyBuffer_Release(&mapq); PyBuffer_Release(&mref);
+    PyBuffer_Release(&mstart);
+    for (int k = 0; k < 8; k++) PyBuffer_Release(&offs[k]);
+    for (int k = 0; k < 7; k++) PyBuffer_Release(&vals[k]);
+    PyBuffer_Release(&needs_py);
+    return result;
+}
+
+/* -------------------------------------------------------- md_parse */
+/* Batch MD-tag parse over an Arrow string column: the per-read Python FSM
+ * (util/mdtag.MdTag.parse) fed both the pileup engine and BQSR pass 1 and
+ * dominated their host time.  Emits (key = row<<34 | ref_pos, base) pairs
+ * for mismatches and deletions, already key-sorted (rows ascend, positions
+ * ascend within a row).  Grammar: [0-9]+(([A-Z]+|\^[A-Z]+)[0-9]+)*. */
+
+static const char *MD_IUPAC = "ACGTNUKMRSWBVHDXY";
+
+static int md_is_base(uint8_t ch) {
+    uint8_t u = (ch >= 'a' && ch <= 'z') ? ch - 32 : ch;
+    for (const char *p = MD_IUPAC; *p; p++)
+        if (*p == (char)u) return 1;
+    return 0;
+}
+
+static PyObject *md_parse(PyObject *self, PyObject *args) {
+    Py_buffer offsets, data, rows, starts;
+    if (!PyArg_ParseTuple(args, "y*y*y*y*", &offsets, &data, &rows, &starts))
+        return NULL;
+    const int32_t *offs = (const int32_t *)offsets.buf;
+    const uint8_t *d = (const uint8_t *)data.buf;
+    const int64_t *row_idx = (const int64_t *)rows.buf;
+    const int64_t *start = (const int64_t *)starts.buf;
+    Py_ssize_t n_rows = rows.len / 8;
+
+    dynbuf mk = {0}, mb = {0}, dk = {0}, db = {0};
+    Py_ssize_t bad_row = -1;
+    int oom = 0;
+
+    Py_BEGIN_ALLOW_THREADS
+    for (Py_ssize_t r = 0; r < n_rows && !oom; r++) {
+        int64_t row = row_idx[r];
+        Py_ssize_t p = offs[row], end = offs[row + 1];
+        if (p >= end) continue;              /* empty tag: no entries */
+        int64_t ref_pos = start[row];
+        int64_t keybase = row << 34;
+        /* leading digits required */
+        if (!(d[p] >= '0' && d[p] <= '9')) { bad_row = row; break; }
+        int need_digit = 1;  /* leading digits, and digits after letters */
+        for (;;) {
+            long long run = 0;
+            int saw = 0;
+            while (p < end && d[p] >= '0' && d[p] <= '9') {
+                run = run * 10 + (d[p++] - '0');
+                saw = 1;
+            }
+            if (need_digit && !saw) { bad_row = row; break; }
+            ref_pos += run;
+            if (p >= end) break;
+            need_digit = 1;
+            int is_del = d[p] == '^';
+            if (is_del) p++;
+            if (p >= end || !md_is_base(d[p])) { bad_row = row; break; }
+            while (p < end && md_is_base(d[p])) {
+                uint8_t u = d[p];
+                if (u >= 'a' && u <= 'z') u -= 32;
+                dynbuf *kb = is_del ? &dk : &mk;
+                dynbuf *bb = is_del ? &db : &mb;
+                int64_t key = keybase | ref_pos;
+                if (db_reserve(kb, 8) || db_reserve(bb, 1)) { oom = 1; break; }
+                db_put(kb, (const uint8_t *)&key, 8);
+                bb->p[bb->len++] = u;
+                ref_pos++;
+                p++;
+            }
+            if (oom) break;
+            if (p < end && !(d[p] >= '0' && d[p] <= '9')) {
+                bad_row = row;
+                break;
+            }
+        }
+        if (bad_row >= 0) break;
+    }
+    Py_END_ALLOW_THREADS
+
+    PyObject *result = NULL;
+    if (oom) {
+        PyErr_NoMemory();
+    } else if (bad_row >= 0) {
+        PyErr_Format(PyExc_ValueError, "malformed MD tag at row %zd",
+                     (Py_ssize_t)bad_row);
+    } else {
+        result = Py_BuildValue(
+            "(y#y#y#y#)", (char *)(mk.p ? mk.p : (uint8_t *)""),
+            mk.len, (char *)(mb.p ? mb.p : (uint8_t *)""), mb.len,
+            (char *)(dk.p ? dk.p : (uint8_t *)""), dk.len,
+            (char *)(db.p ? db.p : (uint8_t *)""), db.len);
+    }
+    free(mk.p); free(mb.p); free(dk.p); free(db.p);
+    PyBuffer_Release(&offsets); PyBuffer_Release(&data);
+    PyBuffer_Release(&rows); PyBuffer_Release(&starts);
+    return result;
+}
+
 /* ---------------------------------------------------- pack_wire32 */
 /* Fused flagstat wire packing: one pass over the five projected columns
  * into the 4-byte-per-read word (ops/flagstat.pack_flagstat_wire32):
@@ -281,6 +686,13 @@ static PyMethodDef methods[] = {
     {"pack_chunk", pack_chunk, METH_VARARGS,
      "pack_chunk(data, offset, *column_buffers, max_len, max_cigar) -> "
      "(n_packed, next_offset)"},
+    {"md_parse", md_parse, METH_VARARGS,
+     "md_parse(offsets_i32, data_u8, rows_i64, starts_i64) -> "
+     "(mm_keys, mm_bases, del_keys, del_bases) byte blobs"},
+    {"decode_arrow", decode_arrow, METH_VARARGS,
+     "decode_arrow(data, offset, max_records, 6 fixed cols, 8 offset "
+     "arrays, 7 validity arrays, needs_py) -> (n, next_offset, 8 data "
+     "blobs)"},
     {"pack_wire32", pack_wire32, METH_VARARGS,
      "pack_wire32(flags_u16, mapq_u8, refid_i16, mate_i16, valid_u8, "
      "out_u32) -> None"},

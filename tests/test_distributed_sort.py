@@ -1,0 +1,77 @@
+"""Device sample-sort vs the host lexsort — bit-for-bit agreement.
+
+VERDICT r1 #5: make the distributed sort real.  Every test runs on the
+8-virtual-device CPU mesh, exercising the all_gather splitter exchange and
+the fixed-capacity all_to_all shuffle exactly as on a slice.
+"""
+
+import numpy as np
+import pytest
+
+from adam_tpu.io.dispatch import load_reads
+from adam_tpu.ops.sort import sort_reads
+from adam_tpu.parallel.mesh import make_mesh
+from adam_tpu.parallel.sort import (pack_sort_keys, sample_sort_permutation,
+                                    sort_reads_distributed)
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return make_mesh(8)
+
+
+@pytest.mark.parametrize("n", [1, 7, 1000, 4096])
+def test_permutation_matches_lexsort_random(mesh, n):
+    rng = np.random.RandomState(n)
+    hi = rng.randint(0, 5, n).astype(np.int32)
+    lo = rng.randint(0, 50, n).astype(np.uint32)  # heavy ties
+    # spread ties the way pack_sort_keys does for unmapped rows: ties in
+    # (hi, lo) still exist across these values, testing stability
+    perm = sample_sort_permutation(hi, lo, mesh)
+    want = np.lexsort((np.arange(n), lo, hi))
+    np.testing.assert_array_equal(perm, want)
+
+
+def test_permutation_large_positions(mesh):
+    rng = np.random.RandomState(0)
+    n = 2000
+    hi = rng.randint(0, 25, n).astype(np.int32)
+    lo = rng.randint(0, 2**32 - 1, n, dtype=np.uint64).astype(np.uint32)
+    perm = sample_sort_permutation(hi, lo, mesh)
+    want = np.lexsort((np.arange(n), lo, hi))
+    np.testing.assert_array_equal(perm, want)
+
+
+def test_overflow_raises_loudly(mesh):
+    # one identical (hi, lo) key everywhere: every row routes to one shard
+    n = 4096
+    hi = np.zeros(n, np.int32)
+    lo = np.zeros(n, np.uint32)
+    with pytest.raises(ValueError, match="capacity"):
+        sample_sort_permutation(hi, lo, mesh, capacity_factor=1.0)
+
+
+@pytest.mark.parametrize("src", ["unmapped.sam",
+                                 "small_realignment_targets.sam"])
+def test_sort_reads_distributed_matches_host(resources, mesh, src):
+    """unmapped.sam is half flag-unmapped reads — the skew case the
+    reference dodges with its 10k-synthetic-key scatter."""
+    table, _, _ = load_reads(str(resources / src))
+    want = sort_reads(table)
+    got = sort_reads_distributed(table, mesh)
+    for name in ("readName", "flags", "referenceId", "start"):
+        assert got.column(name).to_pylist() == \
+            want.column(name).to_pylist(), name
+
+
+def test_pack_sort_keys_order_matches_sort_order(resources):
+    from adam_tpu.ops.sort import sort_order
+    from adam_tpu.packing import column_int64
+    table, _, _ = load_reads(str(resources / "unmapped.sam"))
+    flags = column_int64(table, "flags", 0)
+    refid = column_int64(table, "referenceId")
+    start = column_int64(table, "start")
+    hi, lo = pack_sort_keys(flags, refid, start)
+    np.testing.assert_array_equal(
+        np.lexsort((np.arange(len(hi)), lo, hi)),
+        sort_order(flags, refid, start))
