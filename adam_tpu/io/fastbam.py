@@ -245,6 +245,7 @@ def open_bam_arrow_stream(path, *, chunk_rows: int = 1 << 20,
                     return
                 target *= 2  # one record larger than the buffer window
                 continue
+            target = chunk_bytes  # a widened window resets after success
             off = next_off
             if off:
                 del buf[:off]

@@ -49,12 +49,27 @@ def _info_dict(info: str) -> Dict[str, str]:
 
 def read_vcf(path_or_file) -> Tuple[pa.Table, pa.Table, pa.Table,
                                     SequenceDictionary]:
-    """Parse VCF -> (variants, genotypes, domains, sequence dictionary)."""
+    """Parse VCF -> (variants, genotypes, domains, sequence dictionary).
+
+    Dispatches on extension like the reference's adamLoad
+    (AdamContext.scala:129-137): ``.bcf`` decodes through the binary codec
+    (io/bcf.py), ``.vcf.gz``/``.vcf.bgz`` decompress first (BGZF is plain
+    concatenated gzip members), bare paths parse as text.
+    """
     if hasattr(path_or_file, "read"):
         lines = path_or_file.read().splitlines()
     else:
-        with open(path_or_file, "rt") as f:
-            lines = f.read().splitlines()
+        p = str(path_or_file)
+        if p.endswith(".bcf"):
+            from .bcf import read_bcf
+            return read_bcf(p)
+        if p.endswith((".gz", ".bgz")):
+            import gzip
+            with gzip.open(p, "rt") as f:
+                lines = f.read().splitlines()
+        else:
+            with open(p, "rt") as f:
+                lines = f.read().splitlines()
 
     contigs: List[SequenceRecord] = []
     contig_by_name: Dict[str, SequenceRecord] = {}
@@ -166,10 +181,28 @@ def read_vcf(path_or_file) -> Tuple[pa.Table, pa.Table, pa.Table,
 def write_vcf(variants: pa.Table, genotypes: pa.Table, path_or_file,
               seq_dict: Optional[SequenceDictionary] = None) -> None:
     """Serialize variant/genotype tables to VCF text (adam2vcf path;
-    header lines follow VcfHeaderUtils.scala:34-131)."""
+    header lines follow VcfHeaderUtils.scala:34-131).  ``.vcf.gz``/``.bgz``
+    paths BGZF-compress; ``.bcf`` paths binary-encode (io/bcf.py) — export
+    forms the reference never had."""
     close = False
     if hasattr(path_or_file, "write"):
         out = path_or_file
+    elif str(path_or_file).endswith((".gz", ".bgz", ".bcf")):
+        import io as _io
+        buf = _io.StringIO()
+        write_vcf(variants, genotypes, buf, seq_dict)
+        p = str(path_or_file)
+        if p.endswith(".bcf"):
+            from .bcf import write_bcf
+            write_bcf(buf.getvalue(), p)
+        else:
+            from .bam import _BGZF_EOF, _bgzf_block
+            data = buf.getvalue().encode()
+            with open(p, "wb") as fh:
+                for i in range(0, len(data), 60000):
+                    fh.write(_bgzf_block(data[i:i + 60000]))
+                fh.write(_BGZF_EOF)
+        return
     else:
         out = open(path_or_file, "wt")
         close = True

@@ -1,0 +1,63 @@
+"""Worker process for the two-process DCN smoke test.
+
+Run as:  python _dcn_worker.py <coordinator> <num_processes> <process_id>
+
+Joins the multi-host runtime over loopback (CPU backend, 2 virtual devices
+per process), builds the (host, chip) mesh, and runs a cross-process
+flagstat-style psum.  Each process contributes DIFFERENT local counts, so a
+collective that silently stays process-local produces the wrong total —
+the exact failure mode parallel/distributed.initialize exists to prevent
+(a swallowed join means per-host partial results).
+
+Prints "DCN_OK <hosts> <total>" on success; any failure exits non-zero.
+"""
+
+from __future__ import annotations
+
+import sys
+
+
+def main() -> None:
+    coordinator, num_processes, process_id = (
+        sys.argv[1], int(sys.argv[2]), int(sys.argv[3]))
+
+    from adam_tpu.platform import force_cpu
+    force_cpu(n_devices=2)
+
+    from adam_tpu.parallel import distributed as D
+    D.initialize(coordinator_address=coordinator,
+                 num_processes=num_processes, process_id=process_id)
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from jax.experimental.shard_map import shard_map
+
+    assert jax.process_count() == num_processes, jax.process_count()
+    mesh = D.make_host_mesh()
+    assert mesh.devices.shape == (num_processes, 2), mesh.devices.shape
+
+    # per-process distinct payload: process p, local device d contributes
+    # rows of (p * 100 + d); the global psum must see all four shards.
+    local = np.stack([
+        np.full((8,), process_id * 100 + d, np.int32) for d in range(2)])
+    sharding = NamedSharding(mesh, P((D.HOST_AXIS, D.CHIP_AXIS)))
+    arr = jax.make_array_from_process_local_data(
+        sharding, local.reshape(-1, 8),
+        global_shape=(2 * num_processes, 8))
+
+    reduced = jax.jit(shard_map(
+        lambda x: jax.lax.psum(jnp.sum(x, axis=0, keepdims=True),
+                               (D.HOST_AXIS, D.CHIP_AXIS)),
+        mesh=mesh,
+        in_specs=P((D.HOST_AXIS, D.CHIP_AXIS)),
+        out_specs=P()))(arr)
+    total = int(np.asarray(reduced)[0, 0])
+    expect = sum(p * 100 + d for p in range(num_processes) for d in range(2))
+    assert total == expect, (total, expect)
+    print(f"DCN_OK {num_processes} {total}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

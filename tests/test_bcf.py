@@ -1,0 +1,120 @@
+"""BCF2.2 + bgzipped-VCF ingestion (VERDICT r1 #8).
+
+The reference reaches .bcf through hadoop-bam's VCFInputFormat
+(AdamContext.scala:129-137); these tests prove the native codec round-trips
+the same content with zero external tools: small.vcf encoded to BCF by our
+own encoder and decoded back must produce Arrow tables identical to the
+text parse, and a bgzipped copy must parse identically too.
+"""
+
+from __future__ import annotations
+
+import gzip
+import os
+
+from adam_tpu.io.bcf import bcf_to_vcf_text, read_bcf, vcf_text_to_bcf_bytes
+from adam_tpu.io.vcf import read_vcf, write_vcf
+
+RES = os.path.join(os.path.dirname(__file__), "resources")
+SMALL = os.path.join(RES, "small.vcf")
+
+
+def _tables_equal(a, b):
+    for ta, tb in zip(a[:3], b[:3]):
+        assert ta.schema == tb.schema
+        assert ta.to_pydict() == tb.to_pydict()
+    assert [r.name for r in a[3]] == [r.name for r in b[3]]
+
+
+def test_vcf_gz_parses_identically(tmp_path):
+    gz = tmp_path / "small.vcf.gz"
+    with open(SMALL, "rb") as f:
+        gz.write_bytes(gzip.compress(f.read()))
+    _tables_equal(read_vcf(SMALL), read_vcf(str(gz)))
+
+
+def test_bcf_round_trip_matches_text_parse(tmp_path):
+    with open(SMALL) as f:
+        text = f.read()
+    bcf = tmp_path / "small.bcf"
+    bcf.write_bytes(vcf_text_to_bcf_bytes(text))
+    _tables_equal(read_vcf(SMALL), read_bcf(str(bcf)))
+    # and via the extension dispatch
+    _tables_equal(read_vcf(SMALL), read_vcf(str(bcf)))
+
+
+def test_bcf_records_decode_to_equivalent_text():
+    with open(SMALL) as f:
+        text = f.read()
+    decoded = bcf_to_vcf_text(vcf_text_to_bcf_bytes(text))
+    # record lines must match field-for-field (header gains nothing for
+    # small.vcf — everything it uses is declared)
+    orig = [ln for ln in text.splitlines() if not ln.startswith("#")]
+    back = [ln for ln in decoded.splitlines() if not ln.startswith("#")]
+    assert len(orig) == len(back)
+    for o, b in zip(orig, back):
+        fo, fb = o.split("\t"), b.split("\t")
+        assert fo[:5] == fb[:5]
+        assert float(fo[5]) == float(fb[5])  # QUAL may gain/lose ".0"
+
+        def norm(cols):
+            # VCF allows dropping trailing missing FORMAT fields; BCF
+            # carries them explicitly — both spell the same record
+            out = list(cols)
+            for i in range(3, len(out)):  # slice: FILTER,INFO,FORMAT,samples
+                while out[i].endswith(":."):
+                    out[i] = out[i][:-2]
+            return out
+
+        assert norm(fo[6:]) == norm(fb[6:])
+
+
+def test_write_vcf_bcf_and_gz_round_trip(tmp_path):
+    variants, genotypes, domains, sd = read_vcf(SMALL)
+    for name in ("out.vcf.gz", "out.bcf"):
+        path = tmp_path / name
+        write_vcf(variants, genotypes, str(path), seq_dict=sd)
+        v2, g2, _, _ = read_vcf(str(path))
+        # the writer narrows INFO/FORMAT to the fields it declares, so
+        # compare the columns it preserves
+        assert v2.column("position").to_pylist() == \
+            variants.column("position").to_pylist()
+        assert v2.column("variant").to_pylist() == \
+            variants.column("variant").to_pylist()
+        assert g2.column("allele").to_pylist() == \
+            genotypes.column("allele").to_pylist()
+        assert g2.column("isPhased").to_pylist() == \
+            genotypes.column("isPhased").to_pylist()
+
+
+def test_gt_phased_missing_round_trip():
+    from adam_tpu.io.bcf import _decode_gt, _enc_gt_block, _read_desc
+
+    def round_trip(gt):
+        blob = _enc_gt_block([gt])
+        length, btype, p = _read_desc(blob, 0)
+        import struct
+        vals = [struct.unpack_from("<b", blob, p + i)[0]
+                for i in range(length)]
+        vals = [Ellipsis if v == -0x7F else None if v == -0x80 else v
+                for v in vals]
+        return _decode_gt(vals)
+
+    for gt in ("0|.", ".|1", "./1", "0/.", ".", "0|1", "1/2"):
+        assert round_trip(gt) == gt, gt
+    # htslib spells phased-missing as integer 1: must decode to "."
+    assert _decode_gt([2, 1]) == "0|."
+
+
+def test_cli_vcf2adam_accepts_bcf_and_gz(tmp_path):
+    from adam_tpu.cli.main import main
+    with open(SMALL) as f:
+        text = f.read()
+    bcf = tmp_path / "small.bcf"
+    bcf.write_bytes(vcf_text_to_bcf_bytes(text))
+    gz = tmp_path / "small.vcf.gz"
+    with open(SMALL, "rb") as f:
+        gz.write_bytes(gzip.compress(f.read()))
+    for src, out in ((bcf, tmp_path / "vb"), (gz, tmp_path / "vg")):
+        assert main(["vcf2adam", str(src), str(out)]) == 0
+        assert os.path.exists(str(out) + ".v")

@@ -1,0 +1,63 @@
+"""Two-process DCN smoke test (VERDICT r1 #6).
+
+The reference gets multi-executor coverage for free from local-mode Spark
+(SparkFunSuite, local[4] — one JVM).  Crossing a PROCESS boundary is the
+part that harness cannot fake: this test spawns two real processes that
+join via ``jax.distributed.initialize`` over loopback (CPU backend), build
+``make_host_mesh`` (2 hosts x 2 chips), and psum distinct per-process
+payloads — proving the coordination service, the DCN (gRPC) collective
+path, and the (host, chip) mesh layout actually compose.
+
+Heavier than the rest of the suite (two jax startups + a coordination
+barrier); set ADAM_TPU_SKIP_MULTIPROC=1 to skip.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+WORKER = os.path.join(os.path.dirname(__file__), "_dcn_worker.py")
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+@pytest.mark.skipif(os.environ.get("ADAM_TPU_SKIP_MULTIPROC") == "1",
+                    reason="multi-process smoke disabled by env")
+def test_two_process_psum_over_loopback():
+    coordinator = f"127.0.0.1:{_free_port()}"
+    env = dict(os.environ)
+    # workers force their own platform/device count; scrub inherited flags
+    # so the parent test session's settings don't leak in
+    env.pop("XLA_FLAGS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    # `python tests/_dcn_worker.py` puts tests/ on sys.path, not the repo
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = root + os.pathsep + env.get("PYTHONPATH", "")
+    procs = [
+        subprocess.Popen(
+            [sys.executable, WORKER, coordinator, "2", str(pid)],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            env=env)
+        for pid in range(2)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, err = p.communicate(timeout=180)
+            outs.append((p.returncode, out, err))
+    except subprocess.TimeoutExpired:
+        for p in procs:
+            p.kill()
+        pytest.fail("two-process join timed out (coordination hang)")
+    for rc, out, err in outs:
+        assert rc == 0, f"worker failed rc={rc}\nstdout:{out}\nstderr:{err}"
+        assert "DCN_OK 2 202" in out, out
