@@ -47,12 +47,12 @@ def find_targets(pileups: pa.Table) -> np.ndarray:
     qual = column_int64(pileups, "sangerQuality", 0)
     rstart = column_int64(pileups, "readStart", 0)
     rend = column_int64(pileups, "readEnd", 0)
-    read_base = np.array(
-        [b is not None for b in pileups.column("readBase").to_pylist()])
-    ref_base_eq = np.array(
-        [a == b and a is not None
-         for a, b in zip(pileups.column("readBase").to_pylist(),
-                         pileups.column("referenceBase").to_pylist())])
+    import pyarrow.compute as pc
+    rb_col = pileups.column("readBase")
+    read_base = pc.is_valid(rb_col).to_numpy(zero_copy_only=False)
+    ref_base_eq = pc.fill_null(
+        pc.equal(rb_col, pileups.column("referenceBase")),
+        False).to_numpy(zero_copy_only=False)
 
     is_indel = range_off >= 0
     aligned = ~is_indel & (softclip == 0)

@@ -195,28 +195,31 @@ class MdTag:
 
     # -- serialization (MdTag.scala:380-442) -----------------------------
     def __str__(self) -> str:
+        """Event-walk form of the reference's position-by-position toString
+        FSM: equivalent output for well-formed tags (every position in
+        [start, end] is a match, a mismatch, or a deletion), O(events)
+        instead of O(span x match-runs) — the FSM dominated realignment
+        profiles via its per-position ``is_match`` scans."""
+        evs = sorted(
+            [(p, False, b) for p, b in self.mismatches.items()] +
+            [(p, True, b) for p, b in self.deletes.items()])
         out: List[str] = []
-        last_was_match = False
-        last_was_deletion = False
-        match_run = 0
-        for i in range(self.start(), self.end() + 1):
-            if self.is_match(i):
-                match_run = match_run + 1 if last_was_match else 1
-                last_was_match = True
-                last_was_deletion = False
-            elif i in self.deletes:
-                if not last_was_deletion:
-                    out.append(str(match_run) if last_was_match else "0")
-                    out.append("^")
-                    last_was_match = False
-                    last_was_deletion = True
-                out.append(self.deletes[i])
+        cursor = self.start()
+        prev_del_pos = -2
+        for p, is_del, base in evs:
+            gap = p - cursor
+            if is_del and prev_del_pos == p - 1 and gap == 0:
+                out.append(base)          # continue the ^-run
+            elif is_del:
+                out.append(str(gap))
+                out.append("^")
+                out.append(base)
             else:
-                out.append(str(match_run) if last_was_match else "0")
-                out.append(self.mismatches[i])
-                last_was_match = False
-                last_was_deletion = False
-        out.append(str(match_run) if last_was_match else "0")
+                out.append(str(gap))
+                out.append(base)
+            cursor = p + 1
+            prev_del_pos = p if is_del else -2
+        out.append(str(self.end() + 1 - cursor))
         return "".join(out)
 
     def __eq__(self, other) -> bool:

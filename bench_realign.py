@@ -1,0 +1,60 @@
+"""Realignment throughput on a synthetic many-target chromosome.
+
+Evidence for VERDICT r1 #7's done-gate: realign wall time on a synthetic
+1000-target chromosome within 2x of the markdup stage over the same reads.
+The batched sweep (realigner._sweep_groups) buckets every
+(target, consensus) job by padded shape and sweeps many targets per
+vmapped MXU dispatch, so the compile count stays O(#shapes), not O(#targets).
+
+Prints one JSON line per stage.  Not run by the driver (bench.py stays the
+single-line contract); run manually: ``python bench_realign.py [n_targets]``.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import sys
+import time
+
+
+def main() -> None:
+    from adam_tpu.platform import honor_platform_env
+    honor_platform_env()  # JAX_PLATFORMS=cpu must beat the axon plugin
+
+    n_targets = int(sys.argv[1]) if len(sys.argv) > 1 else 1000
+    sys.path.insert(0, "tests")
+    from _synth_realign import synth_sam
+
+    from adam_tpu.io.sam import read_sam
+    from adam_tpu.ops.markdup import mark_duplicates
+    from adam_tpu.packing import pack_reads
+    from adam_tpu.realign.realigner import realign_indels
+
+    text = synth_sam(n_targets, reads_per_target=20, seed=0)
+    table, _, _ = read_sam(io.StringIO(text))
+    n = table.num_rows
+    batch = pack_reads(table)
+
+    t0 = time.perf_counter()
+    mark_duplicates(table, batch)
+    t_markdup = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    out = realign_indels(table, batch)
+    t_realign = time.perf_counter() - t0
+
+    changed = sum(1 for a, b in zip(table.column("cigar").to_pylist(),
+                                    out.column("cigar").to_pylist())
+                  if a != b)
+    for name, dt in (("markdup", t_markdup), ("realign", t_realign)):
+        print(json.dumps({"metric": f"{name}_wall_s", "value": round(dt, 2),
+                          "unit": "s", "n_reads": n,
+                          "n_targets": n_targets}))
+    print(json.dumps({"metric": "realign_vs_markdup", "unit": "ratio",
+                      "value": round(t_realign / t_markdup, 2),
+                      "reads_realigned": changed}))
+
+
+if __name__ == "__main__":
+    main()

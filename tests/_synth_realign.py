@@ -1,0 +1,73 @@
+"""Synthetic many-target chromosome for realignment scale tests/benches.
+
+Each target is an isolated 3-bp deletion: one anchor read carries the true
+indel cigar (plus one SNP so it enters the consensus set, mirroring how
+findConsensus only consumes mismatching reads), and the remaining reads are
+aligned naively all-M against the reference, so every base past the deletion
+point mismatches — the exact evidence pattern RealignmentTargetFinder keys
+on (mismatch quality ratio > 0.15) and the realigner must clean up.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+_BASES = "ACGT"
+DEL_LEN = 3
+DEL_AT = 200        # deletion offset inside each target's ref segment
+SEG_LEN = 400
+SPACING = 1000
+READ_LEN = 100
+
+
+def _md_for_match_run(read: str, ref: str) -> str:
+    """MD tag for an all-M alignment of read against ref[:len(read)]."""
+    out, run = [], 0
+    for rb, fb in zip(read, ref):
+        if rb == fb:
+            run += 1
+        else:
+            out.append(str(run))
+            out.append(fb)
+            run = 0
+    out.append(str(run))
+    return "".join(out)
+
+
+def synth_sam(n_targets: int, reads_per_target: int = 20, seed: int = 0
+              ) -> str:
+    rng = np.random.RandomState(seed)
+    chrom_len = n_targets * SPACING + SEG_LEN + 1
+    lines = ["@HD\tVN:1.0\tSO:unsorted",
+             f"@SQ\tSN:1\tLN:{chrom_len}",
+             "@RG\tID:rg1\tSM:s1\tLB:lib1"]
+    qual = "I" * READ_LEN
+    for t in range(n_targets):
+        seg_start = t * SPACING  # 0-based
+        ref = "".join(_BASES[i] for i in rng.randint(0, 4, SEG_LEN))
+        alt = ref[:DEL_AT] + ref[DEL_AT + DEL_LEN:]
+
+        # anchor: correct deletion cigar + one SNP for consensus membership
+        ao = DEL_AT - READ_LEN // 2
+        a_seq = list(alt[ao:ao + READ_LEN])
+        snp_at = 5
+        ref_base = a_seq[snp_at]
+        a_seq[snp_at] = _BASES[(_BASES.index(ref_base) + 1) % 4]
+        m1 = DEL_AT - ao
+        a_md = (f"{snp_at}{ref_base}{m1 - snp_at - 1}"
+                f"^{ref[DEL_AT:DEL_AT + DEL_LEN]}{READ_LEN - m1}")
+        lines.append("\t".join([
+            f"t{t}_anchor", "0", "1", str(seg_start + ao + 1), "60",
+            f"{m1}M{DEL_LEN}D{READ_LEN - m1}M", "*", "0", "0",
+            "".join(a_seq), qual, f"MD:Z:{a_md}", "RG:Z:rg1"]))
+
+        # naive all-M reads sampled from the alt haplotype spanning the site
+        for i in range(reads_per_target - 1):
+            o = int(rng.randint(DEL_AT - READ_LEN + 20, DEL_AT - 20))
+            seq = alt[o:o + READ_LEN]
+            md = _md_for_match_run(seq, ref[o:o + READ_LEN])
+            lines.append("\t".join([
+                f"t{t}_r{i}", "0", "1", str(seg_start + o + 1), "60",
+                f"{READ_LEN}M", "*", "0", "0", seq, qual,
+                f"MD:Z:{md}", "RG:Z:rg1"]))
+    return "\n".join(lines) + "\n"

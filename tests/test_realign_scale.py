@@ -1,0 +1,74 @@
+"""Realignment at many-target scale through the batched sweep
+(VERDICT r1 #7: the per-target dispatch path had never been exercised
+beyond fixture-sized groups).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from adam_tpu.io.sam import read_sam
+from adam_tpu.realign.realigner import realign_indels
+from tests._synth_realign import DEL_LEN, synth_sam
+
+import io
+
+
+def _load(n_targets, reads_per_target=12, seed=0):
+    text = synth_sam(n_targets, reads_per_target, seed)
+    table, _, _ = read_sam(io.StringIO(text))
+    return table
+
+
+def test_many_targets_realign_and_match_anchor():
+    table = _load(50)
+    out = realign_indels(table)
+    names = out.column("readName").to_pylist()
+    cigars = out.column("cigar").to_pylist()
+    starts = out.column("start").to_pylist()
+    in_cigars = table.column("cigar").to_pylist()
+
+    # per target: the anchor's deletion cigar must survive, and naive all-M
+    # reads spanning the site must gain the deletion
+    by_target = {}
+    for i, n in enumerate(names):
+        by_target.setdefault(n.split("_")[0], []).append(i)
+    realigned_targets = 0
+    for t, rows in by_target.items():
+        fixed = [i for i in rows if f"{DEL_LEN}D" in cigars[i]
+                 and f"{DEL_LEN}D" not in in_cigars[i]]
+        if fixed:
+            realigned_targets += 1
+    # every target carries identical evidence; all must clean up
+    assert realigned_targets >= len(by_target) * 9 // 10, (
+        realigned_targets, len(by_target))
+
+    # realigned reads moved consistently: start stays, bases before the
+    # deletion unchanged (positions encoded in the new cigar)
+    for i, n in enumerate(names):
+        if "anchor" in n:
+            assert f"{DEL_LEN}D" in cigars[i], n
+
+
+def test_batched_sweep_matches_single_group_path():
+    """The bucketed vmapped dispatch must produce byte-identical output to
+    sweeping one group at a time."""
+    from adam_tpu.realign import realigner as R
+
+    table = _load(12, reads_per_target=8, seed=3)
+    # force the vmapped batch path (CPU defaults to per-job dispatch) ...
+    R._BATCH_ON_CPU = True
+    try:
+        out_batched = realign_indels(table)
+    finally:
+        R._BATCH_ON_CPU = False
+    # ... and the per-job path via a zero workspace budget, which drives
+    # _sweep_g_max to 1 on EVERY backend (so this differential still
+    # crosses both implementations when the suite runs on a TPU)
+    budget = R._SWEEP_BATCH_BUDGET
+    R._SWEEP_BATCH_BUDGET = 0
+    try:
+        out_single = realign_indels(table)
+    finally:
+        R._SWEEP_BATCH_BUDGET = budget
+    assert out_batched.to_pydict() == out_single.to_pydict()
