@@ -98,30 +98,42 @@ class SequenceDictionary:
                 merged.add(rec)
         return merged
 
+    def nonoverlapping_hash(self, name: str) -> int:
+        """Deterministic fresh id for ``name`` probing past ids in use here
+        (SequenceDictionary.nonoverlappingHash :246-247 — crc32 instead of
+        Java hashCode: deterministic across processes, unlike Python's
+        salted hash; the probe-increment semantics match)."""
+        import zlib
+        h = zlib.crc32(name.encode()) % (1 << 30)
+        while h in self._by_id:
+            h += 1
+        return h
+
     def map_to(self, target: "SequenceDictionary") -> Dict[int, int]:
         """id-remap table taking this dictionary's ids onto ``target``'s.
 
-        Mirrors SequenceDictionary.mapTo (SequenceDictionary.scala:150-220):
-        contigs present in ``target`` (by name) take target's id; contigs
-        absent take a fresh id not used by either side
-        (``nonoverlappingHash``).
+        Mirrors SequenceDictionary.mapTo (SequenceDictionary.scala:122-160),
+        all five cases of its test suite ("all five cases for toMap"):
+        contigs present in ``target`` by name take target's id; contigs
+        absent keep their own id when it is free in the accumulated
+        assignment, else take ``target.nonoverlapping_hash`` (probed further
+        past ids this map has already handed out).
         """
-        used = set(target._by_id) | set(self._by_id)
-
-        def fresh(start: int) -> int:
-            h = start
-            while h in used:
-                h += 1
-            used.add(h)
-            return h
-
-        import zlib
+        assigned = set(target._by_id)
         remap: Dict[int, int] = {}
         for rec in self:
             t = target._by_name.get(rec.name)
-            # crc32: deterministic across processes, unlike Python's salted hash
-            remap[rec.id] = t.id if t is not None else \
-                fresh(zlib.crc32(rec.name.encode()) % (1 << 30))
+            if t is not None:
+                remap[rec.id] = t.id
+            elif rec.id not in assigned:
+                remap[rec.id] = rec.id
+                assigned.add(rec.id)
+            else:
+                h = target.nonoverlapping_hash(rec.name)
+                while h in assigned:
+                    h += 1
+                remap[rec.id] = h
+                assigned.add(h)
         return remap
 
     def remap(self, id_map: Dict[int, int]) -> "SequenceDictionary":

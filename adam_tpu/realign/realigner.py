@@ -500,8 +500,15 @@ def realign_indels(table: pa.Table, batch: Optional[ReadBatch] = None
             updates.update(upd)
         states.clear()
 
-    for t in np.unique(tgt[in_target]):
-        sub_rows = np.flatnonzero(tgt[in_target] == t)
+    # group rows by target via one stable argsort + slice bounds — a
+    # per-target masked scan would be O(targets x reads) at genome scale
+    sub_tgt = tgt[in_target]
+    order = np.argsort(sub_tgt, kind="stable")
+    sorted_t = sub_tgt[order]
+    bounds = np.flatnonzero(
+        np.r_[True, sorted_t[1:] != sorted_t[:-1], True])
+    for bi in range(len(bounds) - 1):
+        sub_rows = order[bounds[bi]:bounds[bi + 1]]
         group = []
         for i in sub_rows:
             row = int(in_target[i])

@@ -111,12 +111,12 @@ class MdTag:
     def start(self) -> int:
         starts = [r.start for r in self.matches] + \
             list(self.mismatches) + list(self.deletes)
-        return min(starts)
+        return min(starts) if starts else 0  # empty (zero-length) tag
 
     def end(self) -> int:
         ends = [r.stop - 1 for r in self.matches] + \
             list(self.mismatches) + list(self.deletes)
-        return max(ends)
+        return max(ends) if ends else -1  # empty tag: end < start
 
     # -- reference reconstruction (MdTag.scala:306-372) ------------------
     def get_reference(self, read_sequence: str, cigar: str | List[Tuple[int, str]],
@@ -200,6 +200,8 @@ class MdTag:
         [start, end] is a match, a mismatch, or a deletion), O(events)
         instead of O(span x match-runs) — the FSM dominated realignment
         profiles via its per-position ``is_match`` scans."""
+        if not (self.matches or self.mismatches or self.deletes):
+            return "0"  # zero-length tag (the reference FSM cannot emit one)
         evs = sorted(
             [(p, False, b) for p, b in self.mismatches.items()] +
             [(p, True, b) for p, b in self.deletes.items()])

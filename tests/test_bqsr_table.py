@@ -1,0 +1,215 @@
+"""RecalTable algebra + finalization deltas (VERDICT r1 #9).
+
+Mirrors the table-algebra half of RecalibrateBaseQualitiesSuite.scala
+(:41-378): construction, merge under ``+`` for disjoint / qual-overlapping /
+covariate-overlapping / fully-overlapping counts, and the finalization
+delta hierarchy (readgroup -> qual -> covariate baselines, :323-378) —
+computed against closed-form expectations, not by re-running the
+implementation's own formula.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from adam_tpu.bqsr.covariates import MAX_REASONABLE_QSCORE, N_CONTEXT
+from adam_tpu.bqsr.table import RecalTable, _rg_of_qualrg
+from adam_tpu.util.phred import PHRED_TO_ERROR
+
+
+def make_table(n_rg=2, L=10):
+    return RecalTable(n_read_groups=n_rg, max_read_len=L)
+
+
+def test_construction_shapes_and_zeroing():
+    t = make_table(n_rg=3, L=7)
+    Q = MAX_REASONABLE_QSCORE * 3 + 94
+    assert t.qual_obs.shape == (Q,) and t.qual_mm.shape == (Q,)
+    assert t.cycle_obs.shape == (Q, 15)
+    assert t.ctx_obs.shape == (Q, N_CONTEXT)
+    assert int(t.qual_obs.sum()) == 0 and t.expected_mismatch == 0.0
+
+
+def test_merge_disjoint_counts():
+    a, b = make_table(), make_table()
+    a.qual_obs[10] = 100
+    a.qual_mm[10] = 5
+    b.qual_obs[20] = 50
+    b.qual_mm[20] = 2
+    m = a + b
+    assert m.qual_obs[10] == 100 and m.qual_obs[20] == 50
+    assert m.qual_mm[10] == 5 and m.qual_mm[20] == 2
+    assert int(m.qual_obs.sum()) == 150  # no crosstalk anywhere else
+
+
+def test_merge_quals_overlap():
+    a, b = make_table(), make_table()
+    a.qual_obs[30] = 100
+    a.qual_mm[30] = 7
+    b.qual_obs[30] = 40
+    b.qual_mm[30] = 3
+    m = a + b
+    assert m.qual_obs[30] == 140 and m.qual_mm[30] == 10
+
+
+def test_merge_covars_overlap():
+    a, b = make_table(), make_table()
+    a.cycle_obs[30, 4] = 10
+    b.cycle_obs[30, 4] = 5
+    a.ctx_obs[30, 2] = 8
+    b.ctx_obs[30, 2] = 1
+    m = a + b
+    assert m.cycle_obs[30, 4] == 15 and m.ctx_obs[30, 2] == 9
+
+
+def test_merge_everything_overlaps_and_expected_mismatch_adds():
+    a, b = make_table(), make_table()
+    for t, k in ((a, 3), (b, 5)):
+        t.qual_obs[30] = 100 * k
+        t.qual_mm[30] = k
+        t.cycle_obs[30, 1] = 10 * k
+        t.cycle_mm[30, 1] = k
+        t.ctx_obs[30, 0] = 10 * k
+        t.ctx_mm[30, 0] = k
+        t.expected_mismatch = 0.25 * k
+    m = a + b
+    assert m.qual_obs[30] == 800 and m.qual_mm[30] == 8
+    assert m.cycle_obs[30, 1] == 80 and m.cycle_mm[30, 1] == 8
+    assert m.ctx_obs[30, 0] == 80 and m.ctx_mm[30, 0] == 8
+    assert m.expected_mismatch == pytest.approx(2.0)
+
+
+def test_merge_shape_mismatch_raises():
+    with pytest.raises(AssertionError):
+        _ = make_table(n_rg=1) + make_table(n_rg=2)
+    with pytest.raises(AssertionError):
+        _ = make_table(L=5) + make_table(L=6)
+
+
+def test_qualrg_regrouping_boundaries():
+    # (k - 1) / 60 truncating division (RecalTable.scala:121,129): the
+    # reference's quirk sends qual-0 of any read group to group 0
+    ks = np.array([0, 1, 59, 60, 61, 120, 121])
+    assert _rg_of_qualrg(ks).tolist() == [0, 0, 0, 0, 1, 1, 2]
+
+
+def test_finalize_deltas_closed_form_single_group():
+    """One read group, one qual stratum: every delta has a closed form.
+
+    obs=1000 bases at reported Q31 with 10 mismatches:
+      avg_reported = p31; rg empirical = 0.01 -> rg_delta = 0.01 - p31;
+      qual baseline = p31 + rg_delta = 0.01 = qual empirical -> qual_delta 0;
+      a cycle cell with rate 0.02 -> cycle_delta = 0.02 - 0.01 = 0.01.
+    """
+    t = make_table(n_rg=1, L=5)
+    k = 31
+    p31 = PHRED_TO_ERROR[31]
+    t.qual_obs[k] = 1000
+    t.qual_mm[k] = 10
+    t.expected_mismatch = 1000 * p31
+    t.cycle_obs[k, 3] = 1000
+    t.cycle_mm[k, 3] = 20
+    fin = t.finalize()
+    assert fin.avg_reported_error == pytest.approx(p31)
+    assert fin.rg_delta[0] == pytest.approx(0.01 - p31)
+    assert fin.qual_delta[k] == pytest.approx(0.0, abs=1e-12)
+    assert fin.cycle_delta[k, 3] == pytest.approx(0.01)
+    # unobserved cells fall back to the running baseline -> zero delta
+    assert fin.cycle_delta[k, 0] == pytest.approx(0.0, abs=1e-12)
+    assert fin.ctx_delta[k, 1] == pytest.approx(0.0, abs=1e-12)
+
+
+def test_finalize_unobserved_qual_uses_baseline():
+    t = make_table(n_rg=1, L=5)
+    t.qual_obs[20] = 500
+    t.qual_mm[20] = 5
+    t.expected_mismatch = 500 * PHRED_TO_ERROR[20]
+    fin = t.finalize()
+    # a qual stratum with zero observations: empirical == baseline
+    assert fin.qual_delta[33] == pytest.approx(0.0, abs=1e-12)
+
+
+def test_finalize_minimum_error_clamp():
+    # zero mismatches over many bases clamps to MIN_REASONABLE_ERROR (1e-6)
+    t = make_table(n_rg=1, L=5)
+    k = 40
+    p40 = PHRED_TO_ERROR[40]
+    t.qual_obs[k] = 10_000
+    t.qual_mm[k] = 0
+    t.expected_mismatch = 10_000 * p40
+    fin = t.finalize()
+    # rg_delta = max(1e-6, 0/10000) - p40
+    assert fin.rg_delta[0] == pytest.approx(1e-6 - p40)
+
+
+def test_finalize_two_read_groups_independent_deltas():
+    """Counts land in per-rg qual strata (k = rg*60 + qual); each read
+    group's delta must reflect only its own empirical rate."""
+    t = make_table(n_rg=2, L=5)
+    q = 30
+    p30 = PHRED_TO_ERROR[30]
+    k0, k1 = q, MAX_REASONABLE_QSCORE + q
+    t.qual_obs[k0] = 1000
+    t.qual_mm[k0] = 10    # rg0 rate 0.01
+    t.qual_obs[k1] = 1000
+    t.qual_mm[k1] = 40    # rg1 rate 0.04
+    t.expected_mismatch = 2000 * p30
+    fin = t.finalize()
+    assert fin.rg_delta[0] == pytest.approx(0.01 - p30)
+    assert fin.rg_delta[1] == pytest.approx(0.04 - p30)
+    assert fin.rg_of_qualrg[k0] == 0 and fin.rg_of_qualrg[k1] == 1
+    # qual deltas vanish: stratum empirical == rg baseline in both groups
+    assert fin.qual_delta[k0] == pytest.approx(0.0, abs=1e-12)
+    assert fin.qual_delta[k1] == pytest.approx(0.0, abs=1e-12)
+
+
+def test_finalize_qual_delta_nonzero_when_stratum_deviates():
+    """Two strata in one read group with different empirical rates: the rg
+    baseline is their blend, and each stratum's qual_delta corrects it."""
+    t = make_table(n_rg=1, L=5)
+    p20, p35 = PHRED_TO_ERROR[20], PHRED_TO_ERROR[35]
+    t.qual_obs[20] = 1000
+    t.qual_mm[20] = 30    # 0.03
+    t.qual_obs[35] = 1000
+    t.qual_mm[35] = 1     # 0.001
+    t.expected_mismatch = 1000 * p20 + 1000 * p35
+    fin = t.finalize()
+    avg = (1000 * p20 + 1000 * p35) / 2000
+    rg_delta = 31 / 2000 - avg
+    assert fin.rg_delta[0] == pytest.approx(rg_delta)
+    assert fin.qual_delta[20] == pytest.approx(0.03 - (p20 + rg_delta))
+    assert fin.qual_delta[35] == pytest.approx(0.001 - (p35 + rg_delta))
+
+
+def test_merge_then_finalize_equals_finalize_of_sum():
+    """Merging shards then finalizing == finalizing a table built from the
+    summed counts (the psum-merge invariant the streaming pipeline relies
+    on, RecalibrateBaseQualities.scala:52-64's aggregate)."""
+    rng = np.random.RandomState(0)
+    parts = []
+    for _ in range(4):
+        t = make_table(n_rg=2, L=8)
+        t.qual_obs[:] = rng.randint(0, 100, t.qual_obs.shape)
+        t.qual_mm[:] = rng.randint(0, 5, t.qual_mm.shape)
+        t.cycle_obs[:] = rng.randint(0, 50, t.cycle_obs.shape)
+        t.cycle_mm[:] = rng.randint(0, 3, t.cycle_mm.shape)
+        t.ctx_obs[:] = rng.randint(0, 50, t.ctx_obs.shape)
+        t.ctx_mm[:] = rng.randint(0, 3, t.ctx_mm.shape)
+        t.expected_mismatch = float(rng.rand())
+        parts.append(t)
+    merged = parts[0] + parts[1] + parts[2] + parts[3]
+    whole = make_table(n_rg=2, L=8)
+    for t in parts:
+        whole.qual_obs += t.qual_obs
+        whole.qual_mm += t.qual_mm
+        whole.cycle_obs += t.cycle_obs
+        whole.cycle_mm += t.cycle_mm
+        whole.ctx_obs += t.ctx_obs
+        whole.ctx_mm += t.ctx_mm
+        whole.expected_mismatch += t.expected_mismatch
+    fa, fb = merged.finalize(), whole.finalize()
+    np.testing.assert_allclose(fa.rg_delta, fb.rg_delta)
+    np.testing.assert_allclose(fa.qual_delta, fb.qual_delta)
+    np.testing.assert_allclose(fa.cycle_delta, fb.cycle_delta)
+    np.testing.assert_allclose(fa.ctx_delta, fb.ctx_delta)
