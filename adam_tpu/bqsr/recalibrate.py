@@ -89,9 +89,17 @@ def mismatch_state(table: pa.Table, batch: ReadBatch,
     state[defined & is_mm] = STATE_MISMATCH
 
     if snp_table is not None and len(snp_table):
-        names = table.column("referenceName").to_pylist()
+        # dictionary-encode the contig column once: per-contig row selection
+        # is then an int-code compare, not a per-read string scan
+        enc = table.column("referenceName").combine_chunks() \
+            .dictionary_encode()
+        codes = enc.indices.to_numpy(zero_copy_only=False)
+        code_of = {c: i for i, c in enumerate(enc.dictionary.to_pylist())}
         for contig in snp_table.contigs():
-            crows = np.flatnonzero([nm == contig for nm in names])
+            ci = code_of.get(contig)
+            if ci is None:
+                continue
+            crows = np.flatnonzero(codes == ci)
             if len(crows) == 0:
                 continue
             hit = snp_table.mask(contig, np.maximum(pos[crows], 0)) & \

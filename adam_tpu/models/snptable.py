@@ -37,8 +37,51 @@ class SnpTable:
 
     @classmethod
     def from_vcf(cls, path: str) -> "SnpTable":
-        with open(path, "rt") as f:
-            return cls.from_vcf_lines(f)
+        """Sites file -> table.  dbSNP-scale inputs (tens of millions of
+        lines) go through pyarrow's native CSV reader — only the ## header
+        block is scanned in Python; gzip/BGZF transparently decompress.
+        Falls back to the line parser on any malformed/unusual layout."""
+        with open(path, "rb") as f:
+            data = f.read()
+        if data[:2] == b"\x1f\x8b":
+            import gzip
+            data = gzip.decompress(data)
+        try:
+            return cls._from_vcf_bytes(data)
+        except Exception:
+            return cls.from_vcf_lines(data.decode().splitlines())
+
+    @classmethod
+    def _from_vcf_bytes(cls, data: bytes) -> "SnpTable":
+        import pyarrow as pa
+        import pyarrow.csv as pacsv
+
+        off = 0
+        while off < len(data) and data[off:off + 1] == b"#":
+            nl = data.find(b"\n", off)
+            if nl < 0:
+                return cls({})
+            off = nl + 1
+        if off >= len(data):
+            return cls({})
+        tbl = pacsv.read_csv(
+            # py_buffer slice: zero-copy view past the header (a bytes
+            # slice would duplicate a dbSNP-scale body)
+            pa.BufferReader(pa.py_buffer(data).slice(off)),
+            read_options=pacsv.ReadOptions(autogenerate_column_names=True),
+            # VCF is not quoted CSV: a field starting with '"' must not
+            # swallow following lines (silent site loss, not an error)
+            parse_options=pacsv.ParseOptions(delimiter="\t",
+                                             quote_char=False),
+            convert_options=pacsv.ConvertOptions(
+                include_columns=["f0", "f1"],
+                column_types={"f0": pa.string(), "f1": pa.int64()}))
+        chrom = tbl.column("f0").combine_chunks().dictionary_encode()
+        codes = chrom.indices.to_numpy(zero_copy_only=False)
+        pos = tbl.column("f1").to_numpy(zero_copy_only=False) - 1
+        contigs = chrom.dictionary.to_pylist()
+        return cls({contig: pos[codes == ci]
+                    for ci, contig in enumerate(contigs)})
 
     def __len__(self) -> int:
         return sum(len(v) for v in self._by_contig.values())

