@@ -38,49 +38,90 @@ class SnpTable:
     @classmethod
     def from_vcf(cls, path: str) -> "SnpTable":
         """Sites file -> table.  dbSNP-scale inputs (tens of millions of
-        lines) go through pyarrow's native CSV reader — only the ## header
-        block is scanned in Python; gzip/BGZF transparently decompress.
-        Falls back to the line parser on any malformed/unusual layout."""
-        with open(path, "rb") as f:
-            data = f.read()
-        if data[:2] == b"\x1f\x8b":
-            import gzip
-            data = gzip.decompress(data)
+        lines) go through pyarrow's native CSV reader — decompression and
+        parsing stream, only the ## header block is scanned in Python, and
+        only the CHROM/POS columns materialize.  Falls back to the line
+        parser on malformed layouts (ragged rows etc.), loudly."""
+        import pyarrow as pa
         try:
-            return cls._from_vcf_bytes(data)
-        except Exception:
-            return cls.from_vcf_lines(data.decode().splitlines())
+            return cls._from_vcf_arrow(path)
+        except (pa.ArrowInvalid, ValueError) as e:
+            import warnings
+            warnings.warn(
+                f"SnpTable fast path failed for {path!r} ({e}); falling "
+                "back to the per-line parser", stacklevel=2)
+            with cls._open_text_stream(path) as f:
+                return cls.from_vcf_lines(f)
+
+    _HEADER_PROBE_BYTES = 1 << 24
+
+    @staticmethod
+    def _open_text_stream(path: str):
+        with open(path, "rb") as probe:
+            magic = probe.read(2)
+        if magic == b"\x1f\x8b":
+            import gzip
+            return gzip.open(path, "rt")
+        return open(path, "rt")
+
+    @staticmethod
+    def _open_byte_stream(path: str):
+        with open(path, "rb") as probe:
+            magic = probe.read(2)
+        if magic == b"\x1f\x8b":
+            import gzip  # handles multi-member streams, i.e. BGZF too
+            return gzip.open(path, "rb")
+        return open(path, "rb")
 
     @classmethod
-    def _from_vcf_bytes(cls, data: bytes) -> "SnpTable":
+    def _from_vcf_arrow(cls, path: str) -> "SnpTable":
+        import numpy as np
         import pyarrow as pa
         import pyarrow.csv as pacsv
 
-        off = 0
-        while off < len(data) and data[off:off + 1] == b"#":
-            nl = data.find(b"\n", off)
+        # count leading '#' header lines from a bounded probe of the head —
+        # the body itself is never materialized as Python bytes
+        with cls._open_byte_stream(path) as f:
+            head = f.read(cls._HEADER_PROBE_BYTES)
+        n_header, off = 0, 0
+        while off < len(head) and head[off:off + 1] == b"#":
+            nl = head.find(b"\n", off)
             if nl < 0:
+                if len(head) == cls._HEADER_PROBE_BYTES:
+                    raise ValueError("header larger than the probe window")
                 return cls({})
+            n_header += 1
             off = nl + 1
-        if off >= len(data):
+        if off >= len(head) and len(head) < cls._HEADER_PROBE_BYTES:
             return cls({})
-        tbl = pacsv.read_csv(
-            # py_buffer slice: zero-copy view past the header (a bytes
-            # slice would duplicate a dbSNP-scale body)
-            pa.BufferReader(pa.py_buffer(data).slice(off)),
-            read_options=pacsv.ReadOptions(autogenerate_column_names=True),
-            # VCF is not quoted CSV: a field starting with '"' must not
-            # swallow following lines (silent site loss, not an error)
-            parse_options=pacsv.ParseOptions(delimiter="\t",
-                                             quote_char=False),
-            convert_options=pacsv.ConvertOptions(
-                include_columns=["f0", "f1"],
-                column_types={"f0": pa.string(), "f1": pa.int64()}))
+
+        with cls._open_byte_stream(path) as f:
+            tbl = pacsv.read_csv(
+                f,
+                read_options=pacsv.ReadOptions(
+                    skip_rows=n_header, autogenerate_column_names=True),
+                # VCF is not quoted CSV: a field starting with '"' must not
+                # swallow following lines (silent site loss, not an error)
+                parse_options=pacsv.ParseOptions(delimiter="\t",
+                                                 quote_char=False),
+                convert_options=pacsv.ConvertOptions(
+                    include_columns=["f0", "f1"],
+                    column_types={"f0": pa.string(), "f1": pa.int64()}))
         chrom = tbl.column("f0").combine_chunks().dictionary_encode()
-        codes = chrom.indices.to_numpy(zero_copy_only=False)
+        idx = chrom.indices
+        codes = idx.to_numpy(zero_copy_only=False)
         pos = tbl.column("f1").to_numpy(zero_copy_only=False) - 1
+        if idx.null_count:
+            keep = ~np.isnan(codes)
+            codes, pos = codes[keep], pos[keep]
+        codes = codes.astype(np.int64)
         contigs = chrom.dictionary.to_pylist()
-        return cls({contig: pos[codes == ci]
+        # one stable argsort + boundary split: a per-contig boolean scan is
+        # O(contigs x sites) and dbSNP carries thousands of accessions
+        order = np.argsort(codes, kind="stable")
+        sp = pos[order]
+        bounds = np.searchsorted(codes[order], np.arange(len(contigs) + 1))
+        return cls({contig: sp[bounds[ci]:bounds[ci + 1]]
                     for ci, contig in enumerate(contigs)})
 
     def __len__(self) -> int:

@@ -78,3 +78,17 @@ def test_snptable_fast_path_matches_line_parser(tmp_path):
     pos = np.array([int(x) for x in fast._by_contig["chr1"][:5]] + [10**7])
     m = fast.mask("chr1", pos)
     assert m[:5].all() and not m[5]
+
+
+def test_snptable_ragged_rows_fall_back_loudly(tmp_path):
+    import warnings
+
+    p = tmp_path / "ragged.vcf"
+    p.write_text("##x\n#CHROM\tPOS\nchr1\t100\tA\tB\nchr1\t200\n"
+                 "chr2\t300\tA\tB\tC\tD\tE\tF\tG\tH\n")
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        t = SnpTable.from_vcf(str(p))
+    assert any("fast path failed" in str(x.message) for x in w)
+    assert t.mask("chr1", np.array([99, 199])).all()
+    assert t.mask("chr2", np.array([299])).all()
