@@ -50,6 +50,38 @@ def usable_read_mask(flags: np.ndarray, has_md: np.ndarray) -> np.ndarray:
         ((flags & S.FLAG_DUPLICATE) == 0) & has_md
 
 
+# per-event gather budget for _scatter_at_positions: bounds the [E_chunk, L]
+# row gathers so event scatters never materialize more than ~32 MB at once
+_EVENT_CHUNK_BYTES = 32 << 20
+
+
+def _scatter_at_positions(state: np.ndarray, pos: np.ndarray,
+                          ev_row: np.ndarray, ev_pos: np.ndarray,
+                          ok_mask: np.ndarray, value: int) -> None:
+    """Set ``state[r, j] = value`` where ``pos[r, j] == p`` for each event
+    ``(r, p)`` and ``ok_mask[r, j]`` holds.
+
+    Within a read, aligned base positions are strictly increasing and
+    clip-extrapolated positions fall outside [start, end), so at most one
+    ``ok`` column matches a given reference position — argmax-first-hit is
+    exact.  Work and memory are O(E x L) over the (rare) events instead of
+    O(N x L) over every base.
+    """
+    if len(ev_row) == 0:
+        return
+    L = pos.shape[1]
+    chunk = max(1, _EVENT_CHUNK_BYTES // max(L * pos.itemsize, 1))
+    for s in range(0, len(ev_row), chunk):
+        r = ev_row[s:s + chunk]
+        p = ev_pos[s:s + chunk]
+        hit = pos[r] == p[:, None]                      # [e, L]
+        j = np.argmax(hit, axis=1)
+        found = hit[np.arange(len(r)), j]
+        rr, jj = r[found], j[found]
+        sel = ok_mask[rr, jj]
+        state[rr[sel], jj[sel]] = value
+
+
 def mismatch_state(table: pa.Table, batch: ReadBatch,
                    snp_table: Optional[SnpTable] = None) -> np.ndarray:
     """[N, L] int8 per-base state for pass 1.
@@ -58,6 +90,13 @@ def mismatch_state(table: pa.Table, batch: ReadBatch,
     position is undefined (insertion/soft-clip/outside the alignment), the
     read has no MD tag, or dbSNP masks the position; else MATCH/MISMATCH by
     the MD tag (RichADAMRecord.isMismatchAtReadOffset :138-154).
+
+    Event-side formulation: every aligned base of an MD-bearing read defaults
+    to MATCH, then the MD mismatch events (~1 per read) and the dbSNP sites
+    overlapping each alignment span are scattered in as MISMATCH/MASKED.
+    Peak memory is O(N x L) int8/bool plus an O(events x L) chunked gather —
+    the round-2 version materialized an [N, L] int64 key matrix (~1 GB per
+    1M-read x 128 bp chunk) and looped Python over every dbSNP accession.
     """
     n = table.num_rows
     L = batch.max_len
@@ -73,40 +112,46 @@ def mismatch_state(table: pa.Table, batch: ReadBatch,
     state = np.full((n, L), STATE_MASKED, np.int8)
     in_align = (pos >= 0) & (pos >= start[:, None]) & (pos < end[:, None])
 
-    # MD mismatch lookup (shared encoding with the pileup engine)
-    from ..ops.pileup import _col_valid, _lookup, _md_lookup_arrays
+    from ..ops.pileup import _col_valid, _md_lookup_arrays
     has_md = _col_valid(md_col)
+    in_align &= has_md[:, None]          # now: "defined" per the reference
+    state[in_align] = STATE_MATCH
+
+    # MD mismatch events (shared key encoding with the pileup engine:
+    # row << 34 | ref_pos)
     usable_rows = np.flatnonzero(has_md)
-    mm_keys, mm_bases, _, _ = _md_lookup_arrays(md_col, start, usable_rows)
-
-    rows = np.arange(n)[:, None].repeat(L, 1)
-    keys = (rows.astype(np.int64) << 34) | np.maximum(pos, 0)
-    _, is_mm = _lookup(keys.ravel(), mm_keys, mm_bases)
-    is_mm = is_mm.reshape(n, L)
-
-    defined = in_align & has_md[:, None]
-    state[defined & ~is_mm] = STATE_MATCH
-    state[defined & is_mm] = STATE_MISMATCH
+    mm_keys, _, _, _ = _md_lookup_arrays(md_col, start, usable_rows)
+    _scatter_at_positions(state, pos, (mm_keys >> 34),
+                          mm_keys & ((np.int64(1) << 34) - 1),
+                          in_align, STATE_MISMATCH)
 
     if snp_table is not None and len(snp_table):
-        # dictionary-encode the contig column once: per-contig row selection
-        # is then an int-code compare, not a per-read string scan
+        # dictionary-encode the contig column once, then iterate only the
+        # contigs PRESENT IN THIS BATCH (<= #chromosomes) — dbSNP itself
+        # carries thousands of accessions.  Per contig, each read's site
+        # hits are the sorted-site range [start, end): two searchsorteds
+        # and a flat range-expand, no per-base keys.
         enc = table.column("referenceName").combine_chunks() \
             .dictionary_encode()
         codes = enc.indices.to_numpy(zero_copy_only=False)
-        code_of = {c: i for i, c in enumerate(enc.dictionary.to_pylist())}
-        for contig in snp_table.contigs():
-            ci = code_of.get(contig)
-            if ci is None:
+        for ci, contig in enumerate(enc.dictionary.to_pylist()):
+            sites = snp_table.sites(contig)
+            if sites is None or len(sites) == 0:
                 continue
             crows = np.flatnonzero(codes == ci)
             if len(crows) == 0:
                 continue
-            hit = snp_table.mask(contig, np.maximum(pos[crows], 0)) & \
-                (pos[crows] >= 0)
-            sub = state[crows]
-            sub[hit] = STATE_MASKED
-            state[crows] = sub
+            lo = np.searchsorted(sites, start[crows])
+            hi = np.searchsorted(sites, end[crows])
+            cnt = hi - lo
+            tot = int(cnt.sum())
+            if tot == 0:
+                continue
+            ev_row = np.repeat(crows, cnt)
+            first = np.cumsum(cnt) - cnt
+            idx = np.repeat(lo - first, cnt) + np.arange(tot)
+            _scatter_at_positions(state, pos, ev_row, sites[idx],
+                                  in_align, STATE_MASKED)
     return state
 
 

@@ -79,6 +79,9 @@ class TransformCommand(Command):
         p.add_argument("-realignIndels", action="store_true")
         p.add_argument("-sort_reads", action="store_true")
         p.add_argument("-parts", type=int, default=1)
+        p.add_argument("-coalesce", type=int, default=None,
+                       help="cap the number of output part files "
+                            "(Transform.scala:51-70's repartition knob)")
         p.add_argument("-timing", action="store_true",
                        help="print a per-stage wall-clock report")
         p.add_argument("-trace_dir", default=None,
@@ -123,7 +126,8 @@ class TransformCommand(Command):
                 markdup=args.mark_duplicate_reads,
                 bqsr=args.recalibrate_base_qualities, snp_table=snp,
                 realign=args.realignIndels, sort=args.sort_reads,
-                workdir=args.workdir, chunk_rows=args.stream_chunk_rows)
+                workdir=args.workdir, chunk_rows=args.stream_chunk_rows,
+                coalesce=args.coalesce)
             print(f"wrote {n} reads to {args.output}")
             return 0
         return self._run_inmemory(args)
@@ -210,7 +214,8 @@ class TransformCommand(Command):
                         rg_dict = record_group_dictionary_from_reads(table)
                     write_sam(table, seq_dict, args.output, rg_dict)
                 else:
-                    save_table(table, args.output, n_parts=args.parts)
+                    save_table(table, args.output,
+                               n_parts=args.coalesce or args.parts)
         if args.timing:
             print(report().format())
         print(f"wrote {table.num_rows} reads to {args.output}")
@@ -383,14 +388,18 @@ class CompareCommand(Command):
                  else list(DEFAULT_COMPARISONS))
         # summary format mirrors cli/CompareAdam.scala:148-174
         print(f"{'INPUT1':>15}: {args.input1}")
-        print(f"\t{'total-reads':>15}: {len(engine.named1)}")
+        print(f"\t{'total-reads':>15}: {engine.n_names_1}")
         print(f"\t{'unique-reads':>15}: {engine.unique_to_1()}")
         print(f"{'INPUT2':>15}: {args.input2}")
-        print(f"\t{'total-reads':>15}: {len(engine.named2)}")
+        print(f"\t{'total-reads':>15}: {engine.n_names_2}")
         print(f"\t{'unique-reads':>15}: {engine.unique_to_2()}")
-        for name in names:
-            comp = find_comparison(name)
-            hist = engine.aggregate(comp)
+        # one combined traversal for every requested metric
+        # (CombinedComparisons, Comparisons.scala:112-152)
+        comps = [find_comparison(n) for n in names]
+        hists = engine.aggregate_all(comps)
+        for comp in comps:
+            name = comp.name
+            hist = hists[name]
             count = hist.count()
             ident = hist.count_identical()
             diff_frac = (count - ident) / count if count else 0.0

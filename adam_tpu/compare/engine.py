@@ -2,15 +2,20 @@
 
 Re-designs ``rdd/comparisons/ComparisonTraversalEngine.scala:40-90``, the
 ``metrics/`` package (BucketComparisons + the five default comparisons,
-AvailableComparisons.scala:25-177; Histogram aggregator,
-util/Histogram.scala:22-98) and the findreads filter grammar
+AvailableComparisons.scala:25-177; CombinedComparisons/Collection forms,
+Comparisons.scala:112-152; Histogram + Combined aggregators,
+aggregators/Aggregator.scala:22-145) and the findreads filter grammar
 (cli/FindReads.scala:59-96).
 
 Two read datasets bucket by readName into 7-way ReadBuckets
 (models/ReadBucket.scala:31-111), join on name, and each comparison emits
 values per joined pair which aggregate into histograms.  The reference runs
-two shuffles and an RDD join; here bucketing is a vectorized arrow/numpy
-group-by and the join is a dict merge.
+two shuffles and an RDD join; here the whole traversal is columnar: one
+dictionary-encode over both name columns (the hash join), per-(name, slot)
+count/row-index matrices built with scatter-adds, and every metric a
+batched numpy kernel over the joined ids — no per-read-pair Python.  The
+original per-bucket ``matched_by_name`` path is kept as the differential
+oracle (tests) and for ad-hoc single-name queries.
 """
 
 from __future__ import annotations
@@ -164,6 +169,188 @@ DEFAULT_COMPARISONS: Dict[str, Comparison] = {
                         MapQualityScores(), BaseQualityScores())}
 
 
+# ----------------------------------------------------------------------
+# columnar traversal (the CombinedComparisons/CombinedAggregator form,
+# Comparisons.scala:112-152 + aggregators/Aggregator.scala:122-145)
+# ----------------------------------------------------------------------
+
+#: compared slot codes 0..4 == ReadBucket.COMPARED_SLOTS order;
+#: 5 = unpaired_secondary (never compared), 6 = unmapped
+_N_SLOTS = 7
+
+
+@dataclass
+class _MetricValues:
+    """Columnar result of one comparison over the join: ``values[i]``
+    belongs to joined name ``name_idx[i]``.  ``values`` is [V] for scalar
+    metrics (kind 'int'/'bool') or [V, 2] for pair metrics (kind 'pair').
+    ``null_as_none``: -1 entries decode as None (null mapq parity with the
+    per-bucket oracle, which emits the raw dict value)."""
+    name_idx: np.ndarray
+    values: np.ndarray
+    kind: str  # 'bool' | 'int' | 'pair'
+    null_as_none: bool = False
+
+    def _decode(self, v: int):
+        return None if self.null_as_none and v == -1 else v
+
+    def histogram(self) -> Histogram:
+        h = Histogram()
+        if len(self.values) == 0:
+            return h
+        if self.kind == "pair":
+            uniq, cnt = np.unique(self.values, axis=0, return_counts=True)
+            for (a, b), c in zip(uniq.tolist(), cnt.tolist()):
+                h.value_to_count[(self._decode(a), self._decode(b))] = c
+        else:
+            uniq, cnt = np.unique(self.values, return_counts=True)
+            cast = bool if self.kind == "bool" else int
+            for u, c in zip(uniq.tolist(), cnt.tolist()):
+                h.value_to_count[cast(u)] = c
+        return h
+
+    def to_python(self):
+        if self.kind == "pair":
+            return [(self._decode(a), self._decode(b))
+                    for a, b in self.values.tolist()]
+        if self.kind == "bool":
+            return [bool(v) for v in self.values.tolist()]
+        return [int(v) for v in self.values.tolist()]
+
+
+class _Side:
+    """Per-input columnar bucket structure: counts and single-row indices
+    per (readName, slot) — the vectorized ReadBucket."""
+
+    def __init__(self, table: pa.Table, codes: np.ndarray, n_names: int):
+        n = table.num_rows
+        flags = column_int64(table, "flags", 0)
+        mapped = (flags & S.FLAG_UNMAPPED) == 0
+        primary = (flags & S.FLAG_SECONDARY) == 0
+        paired = (flags & S.FLAG_PAIRED) != 0
+        first = (flags & S.FLAG_FIRST_OF_PAIR) != 0
+        slot = np.full(n, 6, np.int8)                       # unmapped
+        slot[mapped & primary & ~paired] = 0                # unpaired_primary
+        slot[mapped & primary & paired & first] = 1
+        slot[mapped & primary & paired & ~first] = 2
+        slot[mapped & ~primary & ~paired] = 5               # not compared
+        slot[mapped & ~primary & paired & first] = 3
+        slot[mapped & ~primary & paired & ~first] = 4
+
+        self.counts = np.zeros((n_names, _N_SLOTS), np.int32)
+        np.add.at(self.counts, (codes, slot), 1)
+        self.rowof = np.zeros((n_names, 5), np.int64)
+        cmp_sel = slot < 5
+        self.rowof[codes[cmp_sel], slot[cmp_sel]] = \
+            np.flatnonzero(cmp_sel)
+        self.present = self.counts.sum(axis=1) > 0
+
+        self.flags = flags
+        self.start = column_int64(table, "start", 0)
+        self.refid = column_int64(table, "referenceId", -1)
+        self.mapq = column_int64(table, "mapq", -1)   # -1 == null
+        qual = table.column("qual").combine_chunks()
+        self.qual_valid = np.asarray(qual.is_valid()) if len(qual) \
+            else np.zeros(0, bool)
+        bufs = qual.buffers()
+        self.qual_offsets = np.frombuffer(
+            bufs[1], np.int32, count=n + 1, offset=qual.offset * 4) \
+            if n else np.zeros(1, np.int32)
+        self.qual_data = np.frombuffer(bufs[2], np.uint8) \
+            if len(bufs) > 2 and bufs[2] is not None else np.zeros(0, np.uint8)
+
+
+@dataclass
+class _JoinContext:
+    """Shared state of one columnar traversal: both sides + joined ids."""
+    s1: _Side
+    s2: _Side
+    joined: np.ndarray          # [m] name ids present on both sides
+    names: pa.Array             # dictionary: name id -> readName
+    n_names: int
+
+    def singles(self):
+        """[m, 5] mask of slots where both sides hold exactly one record,
+        plus the row indices into each table."""
+        c1 = self.s1.counts[self.joined][:, :5]
+        c2 = self.s2.counts[self.joined][:, :5]
+        single = (c1 == 1) & (c2 == 1)
+        return c1, c2, single
+
+
+def _columnar_overmatched(ctx: _JoinContext) -> _MetricValues:
+    c1, c2, _ = ctx.singles()
+    ok = ((c1 == c2) & (c1 <= 1)).all(axis=1)
+    return _MetricValues(ctx.joined, ok, "bool")
+
+
+def _columnar_dupemismatch(ctx: _JoinContext) -> _MetricValues:
+    _, _, single = ctx.singles()
+    mi, si = np.nonzero(single)
+    r1 = ctx.s1.rowof[ctx.joined[mi], si]
+    r2 = ctx.s2.rowof[ctx.joined[mi], si]
+    pairs = np.stack([
+        (ctx.s1.flags[r1] & S.FLAG_DUPLICATE) != 0,
+        (ctx.s2.flags[r2] & S.FLAG_DUPLICATE) != 0], axis=1).astype(np.int64)
+    return _MetricValues(ctx.joined[mi], pairs, "pair")
+
+
+def _columnar_positions(ctx: _JoinContext) -> _MetricValues:
+    c1, c2, single = ctx.singles()
+    dist = np.full(single.shape, -1, np.int64)
+    dist[(c1 == 0) & (c2 == 0)] = 0
+    mi, si = np.nonzero(single)
+    r1 = ctx.s1.rowof[ctx.joined[mi], si]
+    r2 = ctx.s2.rowof[ctx.joined[mi], si]
+    d = np.where(ctx.s1.refid[r1] != ctx.s2.refid[r2], -1,
+                 np.abs(ctx.s1.start[r1] - ctx.s2.start[r2]))
+    dist[mi, si] = d
+    return _MetricValues(ctx.joined, dist.sum(axis=1), "int")
+
+
+def _columnar_mapqs(ctx: _JoinContext) -> _MetricValues:
+    _, _, single = ctx.singles()
+    mi, si = np.nonzero(single)
+    r1 = ctx.s1.rowof[ctx.joined[mi], si]
+    r2 = ctx.s2.rowof[ctx.joined[mi], si]
+    pairs = np.stack([ctx.s1.mapq[r1], ctx.s2.mapq[r2]], axis=1)
+    return _MetricValues(ctx.joined[mi], pairs, "pair", null_as_none=True)
+
+
+def _columnar_baseqs(ctx: _JoinContext) -> _MetricValues:
+    _, _, single = ctx.singles()
+    mi, si = np.nonzero(single)
+    r1 = ctx.s1.rowof[ctx.joined[mi], si]
+    r2 = ctx.s2.rowof[ctx.joined[mi], si]
+    o1, o2 = ctx.s1.qual_offsets, ctx.s2.qual_offsets
+    l1 = o1[r1 + 1] - o1[r1]
+    l2 = o2[r2 + 1] - o2[r2]
+    keep = ctx.s1.qual_valid[r1] & ctx.s2.qual_valid[r2] & \
+        (l1 > 0) & (l2 > 0)
+    mi, r1, r2 = mi[keep], r1[keep], r2[keep]
+    lens = np.minimum(l1, l2)[keep].astype(np.int64)
+    tot = int(lens.sum())
+    if tot == 0:
+        return _MetricValues(np.zeros(0, np.int64),
+                             np.zeros((0, 2), np.int64), "pair")
+    first = np.cumsum(lens) - lens
+    within = np.arange(tot) - np.repeat(first, lens)
+    i1 = np.repeat(o1[r1].astype(np.int64), lens) + within
+    i2 = np.repeat(o2[r2].astype(np.int64), lens) + within
+    pairs = np.stack([ctx.s1.qual_data[i1].astype(np.int64) - 33,
+                      ctx.s2.qual_data[i2].astype(np.int64) - 33], axis=1)
+    return _MetricValues(np.repeat(ctx.joined[mi], lens), pairs, "pair")
+
+
+_COLUMNAR_KERNELS: Dict[str, Callable[[_JoinContext], _MetricValues]] = {
+    "overmatched": _columnar_overmatched,
+    "dupemismatch": _columnar_dupemismatch,
+    "positions": _columnar_positions,
+    "mapqs": _columnar_mapqs,
+    "baseqs": _columnar_baseqs,
+}
+
+
 def find_comparison(name: str) -> Comparison:
     if name not in DEFAULT_COMPARISONS:
         raise KeyError(f"Could not find comparison {name}")
@@ -181,6 +368,11 @@ class Histogram:
     def count(self) -> int:
         return sum(self.value_to_count.values())
 
+    def count_subset(self, predicate: Callable[[object], bool]) -> int:
+        """Total count of entries whose *value* satisfies ``predicate``
+        (util/Histogram.scala:37 countSubset)."""
+        return sum(v for k, v in self.value_to_count.items() if predicate(k))
+
     def count_identical(self) -> int:
         def identical(k):
             if isinstance(k, tuple):
@@ -190,7 +382,7 @@ class Histogram:
             if isinstance(k, int):
                 return k == 0
             return False
-        return sum(v for k, v in self.value_to_count.items() if identical(k))
+        return self.count_subset(identical)
 
     def __add__(self, other: "Histogram") -> "Histogram":
         h = Histogram()
@@ -215,36 +407,126 @@ class ComparisonTraversalEngine:
         if seq_dict1 is not None and seq_dict2 is not None:
             from ..io.dispatch import remap_reference_ids
             table2 = remap_reference_ids(table2, seq_dict2.map_to(seq_dict1))
-        self.named1 = bucket_reads(table1)
-        self.named2 = bucket_reads(table2)
-        names = set(self.named1) & set(self.named2)
-        self.joined = {n: (self.named1[n], self.named2[n]) for n in names}
+        self._tables = (table1, table2)
+        self._named: Optional[tuple] = None      # lazy oracle buckets
+        n1 = table1.num_rows
+        names = pa.concat_arrays([
+            table1.column("readName").combine_chunks(),
+            table2.column("readName").combine_chunks()]).dictionary_encode()
+        codes = names.indices.to_numpy(zero_copy_only=False)
+        n_names = len(names.dictionary)
+        self._null_id = -1
+        if names.indices.null_count:
+            # null readNames bucket together (bucket_reads keyed them None)
+            self._null_id = n_names
+            codes = np.where(np.isnan(codes), n_names, codes)
+            n_names += 1
+        codes = codes.astype(np.int64)
+        s1 = _Side(table1, codes[:n1], n_names)
+        s2 = _Side(table2, codes[n1:], n_names)
+        self._ctx = _JoinContext(
+            s1, s2, np.flatnonzero(s1.present & s2.present),
+            names.dictionary, n_names)
+
+    def _name_of(self, ids: np.ndarray) -> list:
+        """Name ids -> readName strings (None for the null bucket)."""
+        out = []
+        d = self._ctx.names
+        for i in np.asarray(ids).tolist():
+            out.append(None if i == self._null_id else d[i].as_py())
+        return out
+
+    @property
+    def n_joined(self) -> int:
+        return len(self._ctx.joined)
+
+    @property
+    def n_names_1(self) -> int:
+        return int(self._ctx.s1.present.sum())
+
+    @property
+    def n_names_2(self) -> int:
+        return int(self._ctx.s2.present.sum())
 
     def unique_to_1(self) -> int:
-        return len(set(self.named1) - set(self.named2))
+        return int((self._ctx.s1.present & ~self._ctx.s2.present).sum())
 
     def unique_to_2(self) -> int:
-        return len(set(self.named2) - set(self.named1))
+        return int((self._ctx.s2.present & ~self._ctx.s1.present).sum())
+
+    def _values(self, comparison: Comparison) -> _MetricValues:
+        return _COLUMNAR_KERNELS[comparison.name](self._ctx)
+
+    def _oracle_buckets(self):
+        """Lazy per-bucket structures for comparisons without a columnar
+        kernel (user-defined BucketComparisons subclasses)."""
+        if self._named is None:
+            self._named = (bucket_reads(self._tables[0]),
+                           bucket_reads(self._tables[1]))
+        return self._named
 
     def generate(self, comparison: Comparison) -> Dict[str, list]:
-        return {name: comparison.matched_by_name(b1, b2)
-                for name, (b1, b2) in self.joined.items()}
+        """Per-name value lists (ComparisonTraversalEngine.this.generate
+        :61-65) — a view over the columnar values for API parity."""
+        if comparison.name not in _COLUMNAR_KERNELS:
+            named1, named2 = self._oracle_buckets()
+            return {n: comparison.matched_by_name(named1[n], named2[n])
+                    for n in set(named1) & set(named2)}
+        mv = self._values(comparison)
+        order = np.argsort(mv.name_idx, kind="stable")
+        vals = _MetricValues(mv.name_idx[order], mv.values[order], mv.kind)
+        ids, starts = np.unique(vals.name_idx, return_index=True)
+        py = vals.to_python()
+        bounds = list(starts[1:]) + [len(py)]
+        name_strs = self._name_of(ids)
+        out = {name: [] for name in self._name_of(self._ctx.joined)}
+        for name, lo, hi in zip(name_strs, starts, bounds):
+            out[name] = py[lo:hi]
+        return out
 
     def aggregate(self, comparison: Comparison) -> Histogram:
-        h = Histogram()
-        for values in self.generate(comparison).values():
-            for v in values:
-                h.value_to_count[v] += 1
-        return h
+        if comparison.name not in _COLUMNAR_KERNELS:
+            h = Histogram()
+            for values in self.generate(comparison).values():
+                for v in values:
+                    h.value_to_count[v] += 1
+            return h
+        return self._values(comparison).histogram()
+
+    def aggregate_all(self, comparisons: Sequence[Comparison]
+                      ) -> Dict[str, Histogram]:
+        """One traversal computing every comparison's histogram — the
+        CombinedComparisons + CombinedAggregator collection forms
+        (Comparisons.scala:112-152, aggregators/Aggregator.scala:122-145).
+        The join context is built once and shared; each metric is one
+        batched kernel over it."""
+        return {c.name: self.aggregate(c) for c in comparisons}
 
     def find(self, filters: Sequence["GeneratorFilter"]) -> List[str]:
-        out = []
-        for name, (b1, b2) in self.joined.items():
-            if all(any(f.passes(v)
-                       for v in f.comparison.matched_by_name(b1, b2))
-                   for f in filters):
-                out.append(name)
-        return sorted(out)
+        """Names for which every filter passes on at least one value
+        (cli/FindReads.scala:59-96) — vectorized per-name any/all."""
+        ctx = self._ctx
+        ok_all = np.ones(ctx.n_names, bool)
+        joined_mask = np.zeros(ctx.n_names, bool)
+        joined_mask[ctx.joined] = True
+        for f in filters:
+            if f.comparison.name not in _COLUMNAR_KERNELS:
+                gen = self.generate(f.comparison)
+                passing = {n for n, vs in gen.items()
+                           if any(f.passes(v) for v in vs)}
+                for i in np.flatnonzero(ok_all & joined_mask):
+                    if self._name_of([i])[0] not in passing:
+                        ok_all[i] = False
+                continue
+            mv = self._values(f.comparison)
+            passes = f.passes_array(mv.values, mv.kind)
+            any_pass = np.zeros(ctx.n_names, bool)
+            np.logical_or.at(any_pass, mv.name_idx, passes)
+            ok_all &= any_pass                 # empty value list => fails
+        ids = np.flatnonzero(ok_all & joined_mask)
+        names = self._name_of(ids)
+        # a null-name bucket sorts first (Python can't order None vs str)
+        return sorted(names, key=lambda x: (x is not None, x))
 
 
 # ----------------------------------------------------------------------
@@ -270,6 +552,35 @@ class GeneratorFilter:
             return v < target
         if self.op == ">":
             return v > target
+        raise ValueError(self.op)
+
+    def passes_array(self, values: np.ndarray, kind: str) -> np.ndarray:
+        """Vectorized ``passes`` over a metric's columnar values."""
+        if kind == "pair":
+            t = np.asarray(self.value, np.int64)
+            if t.shape != (2,):
+                raise ValueError(
+                    f"filter value {self.value!r} vs pair-valued comparison")
+            if self.op == "=":
+                return (values == t).all(axis=1)
+            if self.op == "!=":
+                return (values != t).any(axis=1)
+            lex_lt = (values[:, 0] < t[0]) | \
+                ((values[:, 0] == t[0]) & (values[:, 1] < t[1]))
+            if self.op == "<":
+                return lex_lt
+            if self.op == ">":
+                return ~lex_lt & ~(values == t).all(axis=1)
+            raise ValueError(self.op)
+        target = self.value
+        if self.op == "=":
+            return values == target
+        if self.op == "!=":
+            return values != target
+        if self.op == "<":
+            return values < target
+        if self.op == ">":
+            return values > target
         raise ValueError(self.op)
 
 

@@ -34,6 +34,8 @@ import re
 import struct
 from typing import Dict, List, Optional, Tuple
 
+import numpy as np
+
 from .bam import _BGZF_EOF, _bgzf_block, _decompress_bgzf
 
 _MAGIC = b"BCF\x02\x02"
@@ -69,7 +71,11 @@ class _HeaderDicts:
     def __init__(self, header_text: str):
         self.strings: List[str] = ["PASS"]
         self.contigs: List[str] = []
-        self.types: Dict[str, str] = {"GT": "String"}
+        # INFO and FORMAT are distinct namespaces in the spec: the same ID
+        # may be declared with different Types in each (e.g. INFO DP Integer
+        # vs FORMAT DP String), so each context keeps its own type map
+        self.info_types: Dict[str, str] = {}
+        self.fmt_types: Dict[str, str] = {"GT": "String"}
         str_idx = {"PASS": 0}
         for line in header_text.splitlines():
             m = _HDR_RE.match(line)
@@ -83,8 +89,12 @@ class _HeaderDicts:
                     self.contigs.append("")
                 self.contigs[idx] = name
             else:
-                if kind in ("INFO", "FORMAT"):
-                    self.types.setdefault(name, meta.get("Type", "String"))
+                if kind == "INFO":
+                    self.info_types.setdefault(name,
+                                               meta.get("Type", "String"))
+                elif kind == "FORMAT":
+                    self.fmt_types.setdefault(name,
+                                              meta.get("Type", "String"))
                 if name not in str_idx:
                     idx = int(meta["IDX"]) if "IDX" in meta else \
                         len(self.strings)
@@ -106,6 +116,13 @@ def _read_desc(buf: bytes, off: int) -> Tuple[int, int, int]:
     btype, length = b & 0xF, b >> 4
     if length == 15:
         vals, off = _read_value(buf, off)
+        # the extended length must be a concrete non-negative int: a
+        # MISSING/EOV sentinel here is file corruption, and letting the
+        # None/Ellipsis flow on turns into a baffling TypeError downstream
+        if not isinstance(vals, list) or not vals or \
+                not isinstance(vals[0], int) or vals[0] < 0:
+            raise ValueError("corrupt BCF typed descriptor: extended length "
+                             f"is {vals!r}, not a non-negative int")
         length = vals[0]
     return length, btype, off
 
@@ -195,7 +212,9 @@ def _enc_str(s: str, width: Optional[int] = None) -> bytes:
 # --------------------------------------------------------------------------
 
 def _fmt_float(v: float) -> str:
-    return f"{v:g}"
+    # shortest decimal string that round-trips the stored float32 — %g's six
+    # significant digits silently lose precision the storage still carries
+    return str(np.float32(v))
 
 
 def _vals_to_text(vals, btype_hint=None) -> str:
@@ -265,7 +284,7 @@ def _decode_record(shared: bytes, indiv: bytes, dicts: _HeaderDicts) -> str:
         key = dicts.strings[key_v[0]]
         vals, p = _read_value(shared, p)
         if (not isinstance(vals, str) and len(vals) == 0) or \
-                dicts.types.get(key) == "Flag":
+                dicts.info_types.get(key) == "Flag":
             info_parts.append(key)
         else:
             info_parts.append(f"{key}={_vals_to_text(vals)}")
@@ -321,11 +340,16 @@ def _decode_gt(vals) -> str:
     alleles = [v for v in vals if v is not Ellipsis]
     if not alleles:
         return "."
-    # phase bit lives on each non-first allele (htslib convention);
+    # the phase bit lives on EACH non-first allele (htslib convention), so
+    # each separator reflects its own allele's bit — 0/1|2 stays mixed-phase;
     # missing alleles encode as 0 (unphased) or 1 (phased)
-    sep = "|" if any(v & 1 for v in alleles[1:] if v) else "/"
-    return sep.join("." if (v is None or v >> 1 == 0) else str((v >> 1) - 1)
-                    for v in alleles)
+    def show(v):
+        return "." if (v is None or v >> 1 == 0) else str((v >> 1) - 1)
+    out = [show(alleles[0])]
+    for v in alleles[1:]:
+        out.append("|" if (v is not None and v & 1) else "/")
+        out.append(show(v))
+    return "".join(out)
 
 
 def read_bcf(path_or_bytes):
@@ -419,13 +443,15 @@ def _enc_info_value(raw: str, typ: str) -> bytes:
 def _enc_gt_block(gts: List[str]) -> bytes:
     parsed = []
     for gt in gts:
-        phased = "|" in gt
-        parts = gt.replace("|", "/").split("/") if gt != "." else ["."]
+        # keep each allele's own separator: 0/1|2 sets the phase bit on the
+        # third allele only (phased-missing ".|1" != "./1" likewise)
+        toks = re.split(r"([/|])", gt) if gt != "." else ["."]
         vals = []
-        for i, a in enumerate(parts):
+        for i in range(0, len(toks), 2):
+            a = toks[i]
             core = 0 if a == "." else (int(a) + 1) << 1
-            # phased-missing carries the phase bit too (spec: ".|1" != "./1")
-            vals.append(core | (1 if phased and i > 0 else 0))
+            phased = i > 0 and toks[i - 1] == "|"
+            vals.append(core | (1 if phased else 0))
         parsed.append(vals)
     width = max(len(v) for v in parsed)
     out = [_enc_desc(width, _BT_INT8)]
@@ -503,7 +529,7 @@ def _enc_record(line: str, dicts: _HeaderDicts, n_sample: int) -> bytes:
         else:
             k, v = part, ""
         shared.append(_enc_ints([dicts.string_idx[k]]))
-        shared.append(_enc_info_value(v, dicts.types.get(k, "String")))
+        shared.append(_enc_info_value(v, dicts.info_types.get(k, "String")))
     shared_b = b"".join(shared)
 
     indiv = []
@@ -517,7 +543,7 @@ def _enc_record(line: str, dicts: _HeaderDicts, n_sample: int) -> bytes:
             indiv.append(_enc_gt_block(cols))
         else:
             indiv.append(_enc_fmt_block(cols,
-                                        dicts.types.get(key, "String")))
+                                        dicts.fmt_types.get(key, "String")))
     indiv_b = b"".join(indiv)
     return struct.pack("<II", len(shared_b), len(indiv_b)) + \
         shared_b + indiv_b

@@ -78,6 +78,11 @@ class DatasetWriter:
     (AdamRDDFunctions.scala:37-56 via ParquetOutputFormat); here each flushed
     chunk becomes a part, named in write order so readers see file order ==
     stream order.
+
+    ``part_rows`` rotates to a new part file after that many rows, but rows
+    stream into the OPEN part as row groups every ``row_group_size`` rows —
+    memory stays bounded by the row-group size even when one part holds the
+    whole dataset (transform -coalesce 1).
     """
 
     def __init__(self, path: str, *, compression: str = "zstd",
@@ -89,6 +94,8 @@ class DatasetWriter:
         self.row_group_size = row_group_size
         self.part_rows = part_rows
         self._part = 0
+        self._part_row_count = 0
+        self._writer: Optional[pq.ParquetWriter] = None
         self._pending: list[pa.Table] = []
         self._pending_rows = 0
         self.rows_written = 0
@@ -96,23 +103,40 @@ class DatasetWriter:
     def write(self, table: pa.Table) -> None:
         self._pending.append(table)
         self._pending_rows += table.num_rows
-        if self._pending_rows >= self.part_rows:
+        if self._pending_rows >= min(self.row_group_size, self.part_rows):
             self.flush()
 
     def flush(self) -> None:
         if not self._pending:
             return
         chunk = pa.concat_tables(self._pending)
-        pq.write_table(
-            chunk, os.path.join(self.path, f"part-r-{self._part:05d}.parquet"),
-            compression=self.compression, row_group_size=self.row_group_size)
-        self.rows_written += chunk.num_rows
-        self._part += 1
         self._pending = []
         self._pending_rows = 0
+        # split across part-file boundaries
+        while chunk.num_rows:
+            if self._writer is None:
+                self._writer = pq.ParquetWriter(
+                    os.path.join(self.path,
+                                 f"part-r-{self._part:05d}.parquet"),
+                    chunk.schema, compression=self.compression)
+            room = self.part_rows - self._part_row_count
+            head = chunk.slice(0, room)
+            self._writer.write_table(head,
+                                     row_group_size=self.row_group_size)
+            self.rows_written += head.num_rows
+            self._part_row_count += head.num_rows
+            chunk = chunk.slice(head.num_rows)
+            if self._part_row_count >= self.part_rows:
+                self._writer.close()
+                self._writer = None
+                self._part += 1
+                self._part_row_count = 0
 
     def close(self) -> None:
         self.flush()
+        if self._writer is not None:
+            self._writer.close()
+            self._writer = None
 
     def __enter__(self):
         return self

@@ -110,10 +110,19 @@ class SnpTable:
         chrom = tbl.column("f0").combine_chunks().dictionary_encode()
         idx = chrom.indices
         codes = idx.to_numpy(zero_copy_only=False)
-        pos = tbl.column("f1").to_numpy(zero_copy_only=False) - 1
+        pos_col = tbl.column("f1")
+        pos = pos_col.to_numpy(zero_copy_only=False)
+        # drop rows with null CHROM *or* null POS — a null POS surfaces as
+        # NaN here and would otherwise cast to a garbage int64 sentinel site
+        keep = None
         if idx.null_count:
             keep = ~np.isnan(codes)
+        if pos_col.null_count:
+            pos_ok = ~np.isnan(pos)
+            keep = pos_ok if keep is None else keep & pos_ok
+        if keep is not None:
             codes, pos = codes[keep], pos[keep]
+        pos = pos.astype(np.int64) - 1
         codes = codes.astype(np.int64)
         contigs = chrom.dictionary.to_pylist()
         # one stable argsort + boundary split: a per-contig boolean scan is
@@ -129,6 +138,10 @@ class SnpTable:
 
     def contigs(self):
         return list(self._by_contig)
+
+    def sites(self, contig: str) -> np.ndarray | None:
+        """Sorted 0-based site positions for ``contig`` (None if absent)."""
+        return self._by_contig.get(contig)
 
     def mask(self, contig: str, positions: np.ndarray) -> np.ndarray:
         """bool mask of positions present in the table for ``contig``."""

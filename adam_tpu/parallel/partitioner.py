@@ -60,9 +60,29 @@ class GenomicRegionPartitioner:
             raise ValueError(f"unknown referenceId(s) {np.unique(bad)[:5]} "
                              "not in the sequence dictionary")
         total_offset = self._cumul[np.minimum(slot, len(self.ids) - 1)] + pos
-        frac = total_offset.astype(np.float64) / self.total_length
-        bins = np.floor(frac * self.parts).astype(np.int64)
+        bins = self.bin_of_flat(total_offset)
         return np.where(mapped, bins, self.parts).astype(np.int32)
+
+    def bin_of_flat(self, flat: np.ndarray) -> np.ndarray:
+        """Mapped-bin index of a flat coordinate — exact integer floor
+        division, the ONE formula shared by partition(), bin_lower_flat()
+        and the halo router (pipeline._route_halo), so boundary rounding
+        can never disagree between them."""
+        return np.clip(np.asarray(flat, np.int64) * self.parts
+                       // self.total_length, 0, self.parts - 1)
+
+    def flat(self, refid: np.ndarray, pos: np.ndarray) -> np.ndarray:
+        """[N] cumulative-genome ("flat") coordinate of each position;
+        refid < 0 -> 0 (sorts before every contig, like sort_order)."""
+        refid = np.asarray(refid, np.int64)
+        pos = np.asarray(pos, np.int64)
+        slot = np.clip(np.searchsorted(self.ids, refid), 0,
+                       len(self.ids) - 1)
+        return np.where(refid < 0, 0, self._cumul[slot] + pos)
+
+    def bin_lower_flat(self, b: int) -> int:
+        """Smallest flat coordinate belonging to mapped bin ``b``."""
+        return (b * self.total_length + self.parts - 1) // self.parts
 
     def bins_for_ranges(self, refid: np.ndarray, start: np.ndarray,
                         end: np.ndarray):

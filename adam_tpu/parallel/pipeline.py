@@ -196,12 +196,21 @@ class _MarkdupKeys:
                                  cat["score"], bucket_id, cat["lib"])
 
 
+#: realignment halo width: maxIndelSize == max target span
+#: (RealignIndels.scala:176-182) plus an allowance for read length, so any
+#: read that can share a merged target group with a neighbor bin's read is
+#: duplicated into that bin's halo
+_REALIGN_HALO = 3000 + 1024
+
+
 def streaming_transform(input_path: str, output_path: str, *,
                         markdup: bool = False, bqsr: bool = False,
                         snp_table=None, realign: bool = False,
                         sort: bool = False, workdir: Optional[str] = None,
                         mesh=None, chunk_rows: int = 1 << 20,
                         n_bins: Optional[int] = None,
+                        coalesce: Optional[int] = None,
+                        max_bin_rows: Optional[int] = None,
                         compression: str = "zstd") -> int:
     """The ``transform`` pipeline over a chunked stream and a device mesh.
 
@@ -218,15 +227,28 @@ def streaming_transform(input_path: str, output_path: str, *,
       pass 3  emit: re-stream, apply dup bits + recalibrated quals, route
               rows to genome bins (GenomicRegionPartitioner) when
               sort/realign is on, else write output parts directly;
-      pass 4  per-bin: realign + in-bin sort; bins concatenate in genome
-              order, so the output is globally position-sorted
+      pass 4  per-bin: realign + in-bin sort; bins emit through a sorted
+              merge window, so the output is globally position-sorted
               (AdamRDDFunctions.scala:63-93's range partition + sort).
 
     Host RSS is bounded by chunk size + ~42 bytes/read of markdup keys —
-    never the dataset.  Realignment note: targets are found per genome bin;
-    a target group spanning a bin edge sees only its own bin's reads
-    (boundary effect << bin span; the reference's global target collect has
-    no such edge, the in-memory path matches it exactly).
+    never the dataset.  Two skew/edge mechanisms:
+
+      * realign halo: reads within ``_REALIGN_HALO`` of a bin edge are
+        duplicated into the neighbor bin's halo set (the rod-bucket trick,
+        AdamRDDFunctions.scala:175-183); each bin realigns own+halo reads so
+        a target group straddling the edge sees the SAME evidence from both
+        sides, and emits only its own rows — matching the reference's
+        global target collect (RealignmentTargetFinder.scala:54-71, which
+        has no edges) without holding the genome in memory;
+      * hot-bin split: a bin whose row count exceeds ``max_bin_rows``
+        (default 4x chunk_rows) splits into position sub-ranges at row
+        quantiles before processing (the reference scales reducer counts by
+        coverage the same way, PileupAggregator.scala:204-209), so one
+        high-coverage contig (chrM, rDNA) cannot blow host RSS.
+
+    ``coalesce`` caps the number of output part files (Transform.scala's
+    -coalesce repartition, :51-70).
     """
     from ..bqsr.recalibrate import apply_table, compute_table
     from ..bqsr.table import RecalTable
@@ -312,12 +334,16 @@ def streaming_transform(input_path: str, output_path: str, *,
                 n_bins = max(int(np.ceil(total_rows / max(chunk_rows, 1))),
                              mesh.size)
             part = GenomicRegionPartitioner.from_dictionary(n_bins, seq_dict)
+            bin_part_rows = max(chunk_rows // n_bins, 1 << 14)
             bin_writers = [
                 DatasetWriter(os.path.join(workdir, f"bin-{b:05d}"),
-                              part_rows=max(chunk_rows // n_bins, 1 << 14),
+                              part_rows=bin_part_rows,
                               compression=compression)
                 for b in range(part.num_partitions)]
-        out = DatasetWriter(output_path, part_rows=chunk_rows,
+            halo_writers: dict = {}
+        out_part_rows = chunk_rows if coalesce is None else \
+            max(1, -(-total_rows // max(coalesce, 1)))
+        out = DatasetWriter(output_path, part_rows=out_part_rows,
                             compression=compression)
         for table in reread():
             if bqsr:
@@ -339,20 +365,23 @@ def streaming_transform(input_path: str, output_path: str, *,
             for b in np.unique(bins):
                 rows = np.flatnonzero(bins == b)
                 bin_writers[int(b)].write(table.take(pa.array(rows)))
+            if realign:
+                _route_halo(table, bins, part, f_mapped & (refid >= 0),
+                            refid, start, halo_writers, workdir,
+                            bin_part_rows, compression)
 
-        # ---- pass 4: per-bin realign/sort, concatenate in genome order ----
+        # ---- pass 4: per-bin realign/sort through the merge window --------
         if binned:
-            from ..ops.sort import sort_reads
-            from ..realign.realigner import realign_indels
-            for b, w in enumerate(bin_writers):
+            for w in bin_writers:
                 w.close()
-                if w.rows_written == 0:
-                    continue
-                unmapped_bin = (b == part.num_partitions - 1)
-                for btab in _bin_tables(w.path, chunk_rows, unmapped_bin,
-                                        realign, sort, sort_reads,
-                                        realign_indels):
-                    out.write(btab)
+            for w in halo_writers.values() if realign else ():
+                w.close()
+            budget = max_bin_rows if max_bin_rows is not None \
+                else 4 * chunk_rows
+            _emit_bins(out, bin_writers,
+                       halo_writers if realign else {}, part,
+                       chunk_rows, budget, realign, sort,
+                       compression=compression)
         out.close()
         return total_rows
     finally:
@@ -362,22 +391,193 @@ def streaming_transform(input_path: str, output_path: str, *,
             shutil.rmtree(raw_path, ignore_errors=True)
 
 
-def _bin_tables(path: str, chunk_rows: int, unmapped_bin: bool,
-                realign: bool, sort: bool, sort_reads, realign_indels):
-    """Load one genome bin and yield its processed table(s).
+def _route_halo(table, bins, part, mapped_ok, refid, start, halo_writers,
+                workdir, part_rows, compression):
+    """Duplicate reads near a bin edge into the neighbor bins' halo sets
+    (the rod-bucket trick, AdamRDDFunctions.scala:175-183): any bin whose
+    range a read's ±halo window touches gets a copy, so edge-straddling
+    realignment targets see full evidence on both sides."""
+    import pyarrow.compute as pc
 
-    Mapped bins hold ~dataset/n_bins reads and process in memory (realign
-    needs the whole bin's evidence); the unmapped bin streams through
-    untouched in input order, matching the in-memory sort's stable tail.
-    """
-    from ..io.parquet import iter_tables, load_table
+    from ..io.parquet import DatasetWriter
 
-    if unmapped_bin:
-        yield from iter_tables(path, chunk_rows=chunk_rows)
+    if part.parts <= 1:
         return
-    table = load_table(path)
-    if realign:
-        table = realign_indels(table)
-    if sort:
-        table = sort_reads(table)
-    yield table
+    W = _REALIGN_HALO
+    rows_m = np.flatnonzero(mapped_ok)
+    if len(rows_m) == 0:
+        return
+    flat = part.flat(refid[rows_m], np.maximum(start[rows_m], 0))
+    slen = pc.binary_length(table.column("sequence")).combine_chunks() \
+        .fill_null(0).to_numpy(zero_copy_only=False)[rows_m]
+    fend = flat + np.maximum(slen.astype(np.int64), 1)
+    bfirst = part.bin_of_flat(np.maximum(flat - W, 0))
+    blast = part.bin_of_flat(fend + W)
+    own = bins[rows_m].astype(np.int64)
+    cnt = blast - bfirst + 1
+    rr = np.repeat(np.arange(len(rows_m)), cnt)
+    offs = np.arange(int(cnt.sum())) - np.repeat(np.cumsum(cnt) - cnt, cnt)
+    tgt = bfirst[rr] + offs
+    keep = tgt != own[rr]
+    rr, tgt = rr[keep], tgt[keep]
+    for b2 in np.unique(tgt):
+        sel = rows_m[rr[tgt == b2]]
+        w = halo_writers.get(int(b2))
+        if w is None:
+            w = halo_writers[int(b2)] = DatasetWriter(
+                os.path.join(workdir, f"halo-{int(b2):05d}"),
+                part_rows=part_rows, compression=compression)
+        w.write(table.take(pa.array(sel)))
+
+
+def _realign_with_halo(own: pa.Table, halo: Optional[pa.Table],
+                       realign_indels) -> pa.Table:
+    """Realign own+halo evidence together, emit only the own rows (realign
+    preserves row order/count, so the own rows are the leading slice)."""
+    if halo is None or halo.num_rows == 0:
+        return realign_indels(own)
+    u = pa.concat_tables([own, halo])
+    return realign_indels(u).slice(0, own.num_rows)
+
+
+def _flat_of_table(table: pa.Table, part) -> np.ndarray:
+    refid = column_int64(table, "referenceId")
+    start = column_int64(table, "start")
+    return part.flat(refid, np.maximum(start, 0))
+
+
+def _process_mapped_bin(path, halo_path, part, rows, chunk_rows, budget,
+                        realign, sort, next_lo, workdir_b,
+                        compression="zstd"):
+    """Yield (processed_table, next_lower_flat) for one mapped bin,
+    splitting bins over ``budget`` rows into position sub-ranges first."""
+    from ..io.parquet import DatasetWriter, iter_tables, load_table
+    from ..ops.sort import sort_reads
+    from ..realign.realigner import realign_indels
+
+    def finish(own, halo, nxt):
+        t = _realign_with_halo(own, halo, realign_indels) if realign else own
+        if sort:
+            t = sort_reads(t)
+        return t, nxt
+
+    if rows <= budget:
+        halo = load_table(halo_path) if halo_path else None
+        yield finish(load_table(path), halo, next_lo)
+        return
+
+    # hot bin: pick cut positions at row quantiles of the flat coordinate
+    # (projection-only scan), then stream rows into sub-range writers with
+    # their own ±halo duplication.  Ties collapse — a single position's
+    # pileup can exceed the budget but a position cannot be split.
+    key_tbl = load_table(path, columns=["referenceId", "start"])
+    flat_sorted = np.sort(_flat_of_table(key_tbl, part))
+    del key_tbl
+    k = int(np.ceil(rows / budget))
+    cuts = np.unique(flat_sorted[np.minimum(
+        np.arange(1, k) * budget, rows - 1)])
+    lows = np.concatenate([[0], cuts])              # sub-range lower edges
+    highs = np.concatenate([cuts, [np.iinfo(np.int64).max]])
+    W = _REALIGN_HALO
+    sub_own = [DatasetWriter(os.path.join(workdir_b, f"sub-{i:03d}"),
+                             part_rows=budget, compression=compression)
+               for i in range(len(lows))]
+    sub_halo = [DatasetWriter(os.path.join(workdir_b, f"subhalo-{i:03d}"),
+                              part_rows=budget, compression=compression)
+                for i in range(len(lows))] if realign else []
+
+    def route(tbl, is_halo_source):
+        flat = _flat_of_table(tbl, part)
+        if realign:         # fend only feeds the halo windows
+            import pyarrow.compute as pc
+            slen = pc.binary_length(tbl.column("sequence")) \
+                .combine_chunks().fill_null(0) \
+                .to_numpy(zero_copy_only=False).astype(np.int64)
+            fend = flat + np.maximum(slen, 1)
+        for i, (lo, hi) in enumerate(zip(lows, highs)):
+            if not is_halo_source:
+                sel = np.flatnonzero((flat >= lo) & (flat < hi))
+                if len(sel):
+                    sub_own[i].write(tbl.take(pa.array(sel)))
+            if realign:
+                osel = np.flatnonzero(
+                    (fend + W > lo) & (flat - W < hi) &
+                    (is_halo_source | (flat < lo) | (flat >= hi)))
+                if len(osel):
+                    sub_halo[i].write(tbl.take(pa.array(osel)))
+
+    for tbl in iter_tables(path, chunk_rows=chunk_rows):
+        route(tbl, is_halo_source=False)
+    if halo_path:
+        for tbl in iter_tables(halo_path, chunk_rows=chunk_rows):
+            route(tbl, is_halo_source=True)
+    for i in range(len(lows)):
+        sub_own[i].close()
+        if realign:
+            sub_halo[i].close()
+        if sub_own[i].rows_written == 0:
+            continue
+        halo = load_table(sub_halo[i].path) \
+            if realign and sub_halo[i].rows_written else None
+        nxt = int(highs[i]) if i + 1 < len(lows) else next_lo
+        yield finish(load_table(sub_own[i].path), halo, nxt)
+
+
+def _emit_bins(out, bin_writers, halo_writers, part, chunk_rows, budget,
+               realign, sort, compression="zstd"):
+    """Pass 4 driver: process mapped bins in genome order, emitting sorted
+    output through a merge window — realignment can move a read up to the
+    halo width across a bin edge, so rows only emit once no later bin can
+    produce a smaller sort key."""
+    import shutil as _shutil
+    import tempfile as _tempfile
+
+    from .. import schema as S
+    from ..io.parquet import iter_tables
+    from ..ops.sort import sort_reads
+
+    pending: Optional[pa.Table] = None
+
+    def emit_sorted(tbl, next_lower_flat):
+        nonlocal pending
+        pending = tbl if pending is None else \
+            sort_reads(pa.concat_tables([pending, tbl]))
+        cutoff = next_lower_flat - _REALIGN_HALO
+        flags = column_int64(pending, "flags", 0)
+        flat = _flat_of_table(pending, part)
+        safe = ((flags & S.FLAG_UNMAPPED) == 0) & (flat < cutoff)
+        k = int(safe.sum())  # sorted => safe rows are a prefix
+        if k:
+            out.write(pending.slice(0, k))
+        pending = pending.slice(k) if k < pending.num_rows else None
+
+    for b, w in enumerate(bin_writers):
+        if b == part.num_partitions - 1:        # unmapped bin: stable tail
+            if pending is not None:
+                out.write(pending)
+                pending = None
+            if w.rows_written:
+                for t in iter_tables(w.path, chunk_rows=chunk_rows):
+                    out.write(t)
+            continue
+        if w.rows_written == 0:
+            continue
+        halo_w = halo_writers.get(b)
+        halo_path = halo_w.path if halo_w is not None and \
+            halo_w.rows_written else None
+        next_lo = part.bin_lower_flat(b + 1) if b + 1 < part.parts \
+            else part.total_length + _REALIGN_HALO
+        workdir_b = _tempfile.mkdtemp(prefix="hotbin_", dir=w.path)
+        try:
+            for tbl, nxt in _process_mapped_bin(
+                    w.path, halo_path, part, w.rows_written, chunk_rows,
+                    budget, realign, sort, next_lo, workdir_b,
+                    compression=compression):
+                if sort:
+                    emit_sorted(tbl, nxt)
+                else:
+                    out.write(tbl)
+        finally:
+            _shutil.rmtree(workdir_b, ignore_errors=True)
+    if pending is not None:                      # no unmapped rows written
+        out.write(pending)

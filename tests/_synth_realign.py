@@ -34,8 +34,14 @@ def _md_for_match_run(read: str, ref: str) -> str:
     return "".join(out)
 
 
-def synth_sam(n_targets: int, reads_per_target: int = 20, seed: int = 0
-              ) -> str:
+def synth_sam(n_targets: int, reads_per_target: int = 20, seed: int = 0,
+              tail_reads: int = 0) -> str:
+    """``tail_reads`` adds per-target naive all-M reads STARTING AFTER the
+    deletion site: their alignments are shifted by DEL_LEN (every base
+    mismatches), so they contribute mismatch evidence extending the target
+    past the deletion and get realigned to start+DEL_LEN with a clean MD —
+    placing them on the far side of a genome-bin edge from the anchor read
+    exercises the cross-bin halo path."""
     rng = np.random.RandomState(seed)
     chrom_len = n_targets * SPACING + SEG_LEN + 1
     lines = ["@HD\tVN:1.0\tSO:unsorted",
@@ -68,6 +74,15 @@ def synth_sam(n_targets: int, reads_per_target: int = 20, seed: int = 0
             md = _md_for_match_run(seq, ref[o:o + READ_LEN])
             lines.append("\t".join([
                 f"t{t}_r{i}", "0", "1", str(seg_start + o + 1), "60",
+                f"{READ_LEN}M", "*", "0", "0", seq, qual,
+                f"MD:Z:{md}", "RG:Z:rg1"]))
+
+        for i in range(tail_reads):
+            o = int(rng.randint(DEL_AT + 5, DEL_AT + 40))
+            seq = alt[o:o + READ_LEN]          # == ref[o+DEL_LEN:...]
+            md = _md_for_match_run(seq, ref[o:o + READ_LEN])
+            lines.append("\t".join([
+                f"t{t}_tail{i}", "0", "1", str(seg_start + o + 1), "60",
                 f"{READ_LEN}M", "*", "0", "0", seq, qual,
                 f"MD:Z:{md}", "RG:Z:rg1"]))
     return "\n".join(lines) + "\n"

@@ -118,3 +118,75 @@ def test_cli_vcf2adam_accepts_bcf_and_gz(tmp_path):
     for src, out in ((bcf, tmp_path / "vb"), (gz, tmp_path / "vg")):
         assert main(["vcf2adam", str(src), str(out)]) == 0
         assert os.path.exists(str(out) + ".v")
+
+
+# ---- round-2 advisor findings ------------------------------------------
+
+
+def _one_sample_vcf(fmt, sample, info="DP=10",
+                    extra_header=()) -> str:
+    header = ["##fileformat=VCFv4.2", "##contig=<ID=1>",
+              '##INFO=<ID=DP,Number=1,Type=Integer,Description="">',
+              '##FORMAT=<ID=GT,Number=1,Type=String,Description="">',
+              *extra_header,
+              "#CHROM\tPOS\tID\tREF\tALT\tQUAL\tFILTER\tINFO\tFORMAT\tS1"]
+    return "\n".join(header) + \
+        f"\n1\t100\t.\tA\tC,G\t30\tPASS\t{info}\t{fmt}\t{sample}\n"
+
+
+def test_mixed_phase_gt_round_trips():
+    # per-allele phasing (BCF spec): 0/1|2 must NOT collapse to 0|1|2
+    for gt in ("0/1|2", "0|1/2", ".|1", "./1", "0/1", "0|1"):
+        text = _one_sample_vcf("GT", gt)
+        decoded = bcf_to_vcf_text(vcf_text_to_bcf_bytes(text))
+        rec = [ln for ln in decoded.splitlines()
+               if not ln.startswith("#")][0]
+        assert rec.split("\t")[9] == gt, gt
+
+
+def test_float_precision_survives_decode():
+    # %g kept 6 significant digits; the stored float32 carries ~7-9
+    text = _one_sample_vcf("GT", "0/1", info="AF=0.1234567")
+    decoded = bcf_to_vcf_text(vcf_text_to_bcf_bytes(text))
+    rec = [ln for ln in decoded.splitlines() if not ln.startswith("#")][0]
+    info = dict(p.split("=") for p in rec.split("\t")[7].split(";"))
+    import numpy as np
+    assert np.float32(info["AF"]) == np.float32(0.1234567)
+
+
+def test_info_and_format_type_namespaces_are_separate():
+    # same ID declared Integer in INFO but String in FORMAT: the FORMAT
+    # values must encode as strings (here "7a" would crash an int encode)
+    text = _one_sample_vcf(
+        "GT:XX", "0/1:7a", info="XX=3",
+        extra_header=(
+            '##INFO=<ID=XX,Number=1,Type=Integer,Description="">',
+            '##FORMAT=<ID=XX,Number=1,Type=String,Description="">'))
+    decoded = bcf_to_vcf_text(vcf_text_to_bcf_bytes(text))
+    rec = [ln for ln in decoded.splitlines() if not ln.startswith("#")][0]
+    f = rec.split("\t")
+    assert "XX=3" in f[7]
+    assert f[9].split(":")[1] == "7a"
+
+
+def test_corrupt_extended_descriptor_raises_value_error():
+    import pytest
+    from adam_tpu.io.bcf import _read_desc
+    # descriptor byte 0xF1 = extended length, int8; follow with a typed
+    # MISSING int8 sentinel (0x11 desc, 0x80 payload) as the "length"
+    buf = bytes([0xF1, 0x11, 0x80])
+    with pytest.raises(ValueError, match="corrupt BCF typed descriptor"):
+        _read_desc(buf, 0)
+
+
+def test_snptable_drops_null_pos_rows(tmp_path):
+    p = tmp_path / "sites.vcf"
+    p.write_text("##fileformat=VCFv4.2\n#CHROM\tPOS\tID\tREF\tALT\n"
+                 "1\t101\t.\tA\tC\n"
+                 "1\t\t.\tA\tC\n"          # null POS
+                 "2\t201\t.\tG\tT\n")
+    from adam_tpu.models.snptable import SnpTable
+    t = SnpTable.from_vcf(str(p))
+    assert len(t) == 2
+    assert t.sites("1").tolist() == [100]
+    assert t.sites("2").tolist() == [200]

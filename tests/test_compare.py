@@ -27,7 +27,7 @@ def test_reads12_vs_reads21_concordance(engines):
     same, _ = engines
     assert same.unique_to_1() == 0 and same.unique_to_2() == 0
     hist = same.aggregate(find_comparison("positions"))
-    assert hist.count() == len(same.joined) == 200
+    assert hist.count() == same.n_joined == 200
     assert hist.count_identical() == 196
     assert hist.value_to_count.get(-1) == 4
 
@@ -43,7 +43,7 @@ def test_shifted_read_detected(engines):
 def test_mapq_comparison(engines):
     same, _ = engines
     hist = same.aggregate(find_comparison("mapqs"))
-    assert hist.count() == len(same.joined)
+    assert hist.count() == same.n_joined
     assert hist.count_identical() == hist.count()
 
 

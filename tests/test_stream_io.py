@@ -111,3 +111,44 @@ def test_open_read_stream_dispatch_and_projection(resources_module, tmp_path,
         got = pa.concat_tables(list(rs))
         assert got.column_names == ["flags", "start"]
         assert got.num_rows == table.num_rows
+
+
+def test_dataset_writer_streams_row_groups_within_one_part(tmp_path):
+    """-coalesce 1 must not buffer the dataset: rows stream into the open
+    part as row groups every row_group_size rows."""
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+    from adam_tpu.io.parquet import DatasetWriter, load_table
+
+    w = DatasetWriter(str(tmp_path / "ds"), part_rows=10_000,
+                      row_group_size=100)
+    for i in range(10):
+        w.write(pa.table({"x": list(range(i * 100, (i + 1) * 100))}))
+        # after each write the pending buffer must have been flushed to disk
+        assert w._pending_rows == 0
+    w.close()
+    import os
+    parts = [f for f in os.listdir(tmp_path / "ds")
+             if f.endswith(".parquet")]
+    assert len(parts) == 1
+    f = pq.ParquetFile(str(tmp_path / "ds" / parts[0]))
+    assert f.metadata.num_row_groups >= 10
+    assert load_table(str(tmp_path / "ds")).column("x").to_pylist() == \
+        list(range(1000))
+
+
+def test_dataset_writer_part_rotation_split_mid_chunk(tmp_path):
+    import pyarrow as pa
+    from adam_tpu.io.parquet import DatasetWriter, load_table
+
+    w = DatasetWriter(str(tmp_path / "ds"), part_rows=250,
+                      row_group_size=100)
+    w.write(pa.table({"x": list(range(600))}))
+    w.close()
+    import os
+    parts = sorted(f for f in os.listdir(tmp_path / "ds")
+                   if f.endswith(".parquet"))
+    assert len(parts) == 3               # 250 + 250 + 100
+    assert load_table(str(tmp_path / "ds")).column("x").to_pylist() == \
+        list(range(600))
+    assert w.rows_written == 600
