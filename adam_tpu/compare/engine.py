@@ -474,7 +474,8 @@ class ComparisonTraversalEngine:
                     for n in set(named1) & set(named2)}
         mv = self._values(comparison)
         order = np.argsort(mv.name_idx, kind="stable")
-        vals = _MetricValues(mv.name_idx[order], mv.values[order], mv.kind)
+        vals = _MetricValues(mv.name_idx[order], mv.values[order], mv.kind,
+                             mv.null_as_none)
         ids, starts = np.unique(vals.name_idx, return_index=True)
         py = vals.to_python()
         bounds = list(starts[1:]) + [len(py)]

@@ -34,6 +34,60 @@ def _variant_type(ref: str, alt: str) -> str:
     return "Insertion" if len(alt) > len(ref) else "Deletion"
 
 
+#: VCF SVTYPE code <-> StructuralVariantType enum (adam.avdl:137-146)
+_SV_TYPE_OF_CODE = {
+    "DEL": "Deletion", "INS": "Insertion", "DUP": "Duplication",
+    "INV": "Inversion", "CNV": "CopyNumberVariation",
+    "DUP:TANDEM": "TandemDuplication", "DEL:ME": "MobileElementDeletion",
+    "INS:ME": "MobileElementInsertion",
+}
+_SV_CODE_OF_TYPE = {v: k for k, v in _SV_TYPE_OF_CODE.items()}
+
+
+def _int_or_none(s: Optional[str]) -> Optional[int]:
+    """VCF integer value; '.' (the missing value) and malformed -> None."""
+    if not s or s == ".":
+        return None
+    try:
+        return int(s)
+    except ValueError:
+        return None
+
+
+def _sv_fields(info_d: Dict[str, str]) -> Dict[str, object]:
+    """INFO SVTYPE/SVLEN/END/IMPRECISE/CIPOS/CIEND -> ADAMVariant sv*
+    columns (adam.avdl:190-216; VariantContextConverter carries them via
+    the symbolic-allele path, :207-226).
+
+    SVTYPE codes outside the StructuralVariantType enum (e.g. BND) are kept
+    as their raw code so the write path can round-trip them — the reference
+    would drop them at its enum boundary; a superset costs nothing here.
+    """
+    if "SVTYPE" not in info_d:
+        return {}
+    out: Dict[str, object] = {
+        "svType": _SV_TYPE_OF_CODE.get(info_d["SVTYPE"],
+                                       info_d["SVTYPE"] or None),
+        "svIsPrecise": "IMPRECISE" not in info_d,
+    }
+    svlen = _int_or_none(info_d.get("SVLEN", "").split(",")[0])
+    if svlen is not None:
+        out["svLength"] = svlen
+    end = _int_or_none(info_d.get("END"))
+    if end is not None:
+        out["svEnd"] = end - 1
+    for key, lo, hi in (("CIPOS", "svConfidenceIntervalStartLow",
+                         "svConfidenceIntervalStartHigh"),
+                        ("CIEND", "svConfidenceIntervalEndLow",
+                         "svConfidenceIntervalEndHigh")):
+        parts = info_d.get(key, "").split(",")
+        if len(parts) == 2:
+            plo, phi = _int_or_none(parts[0]), _int_or_none(parts[1])
+            if plo is not None and phi is not None:
+                out[lo], out[hi] = plo, phi
+    return out
+
+
 def _info_dict(info: str) -> Dict[str, str]:
     out = {}
     if info == ".":
@@ -109,14 +163,23 @@ def read_vcf(path_or_file) -> Tuple[pa.Table, pa.Table, pa.Table,
         refid = contig.id
         alt_list = [a for a in alts.split(",") if a != "."]
         afs = info_d.get("AF", "").split(",") if "AF" in info_d else []
+        sv = _sv_fields(info_d)
 
         for ai, alt in enumerate(alt_list):
-            v_rows.append({
+            # symbolic ALT (<DEL>, <DUP:TANDEM>) -> Complex with no base
+            # string; breakend notation -> SV (convertType :207-218)
+            if alt.startswith("<"):
+                vtype, vseq = "Complex", None
+            elif "[" in alt or "]" in alt:
+                vtype, vseq = "SV", alt
+            else:
+                vtype, vseq = _variant_type(ref, alt), alt
+            v_rows.append(sv | {
                 "referenceId": refid, "referenceName": chrom,
                 "referenceLength": contig.length or None,
                 "referenceUrl": contig.url,
-                "position": pos, "referenceAllele": ref, "variant": alt,
-                "variantType": _variant_type(ref, alt),
+                "position": pos, "referenceAllele": ref, "variant": vseq,
+                "variantType": vtype,
                 "id": vid if vid != "." else None,
                 "quality": int(float(qual)) if qual != "." else None,
                 "filters": None if filt in (".", "PASS") else filt,
@@ -154,18 +217,29 @@ def read_vcf(path_or_file) -> Tuple[pa.Table, pa.Table, pa.Table,
                     "ploidy": len(idxs), "haplotypeNumber": hi,
                     "allele": allele, "isReference": allele == ref,
                     "referenceAllele": ref,
-                    "alleleVariantType": ("SNP" if allele == ref else
-                                          _variant_type(ref, allele)),
+                    "alleleVariantType": (
+                        "SNP" if allele == ref else
+                        "Complex" if allele.startswith("<") else
+                        "SV" if ("[" in allele or "]" in allele) else
+                        _variant_type(ref, allele)),
                     "genotypeQuality": int(sd["GQ"]) if sd.get("GQ", "").isdigit() else None,
                     "depth": int(sd["DP"]) if sd.get("DP", "").isdigit() else None,
                     "phredLikelihoods": sd.get("PL"),
                     "phredPosteriorLikelihoods": sd.get("GP"),
+                    "ploidyStateGenotypeLikelihoods": sd.get("GQL"),
+                    "rmsMapQuality": (int(sd["MQ"])
+                                      if sd.get("MQ", "").isdigit()
+                                      else None),
                     "haplotypeQuality": (int(hq[hi])
                                          if hi < len(hq) and hq[hi].isdigit()
                                          else None),
                     "isPhased": phased,
-                    "phaseSetId": sd.get("PS"),
-                    "phaseQuality": int(sd["PQ"]) if sd.get("PQ", "").isdigit() else None,
+                    # phasing extras only carry when the call IS phased
+                    # (VariantContextConverter :404-411)
+                    "phaseSetId": sd.get("PS") if phased else None,
+                    "phaseQuality": (int(sd["PQ"])
+                                     if phased and sd.get("PQ", "").isdigit()
+                                     else None),
                 })
 
     def table(rows, schema):
@@ -211,12 +285,25 @@ def write_vcf(variants: pa.Table, genotypes: pa.Table, path_or_file,
         out.write('##INFO=<ID=NS,Number=1,Type=Integer,Description="Number of Samples With Data">\n')
         out.write('##INFO=<ID=DP,Number=1,Type=Integer,Description="Total Depth">\n')
         out.write('##INFO=<ID=AF,Number=A,Type=Float,Description="Allele Frequency">\n')
+        out.write('##INFO=<ID=BQ,Number=1,Type=Integer,Description="RMS Base Quality">\n')
         out.write('##INFO=<ID=MQ,Number=1,Type=Integer,Description="RMS Mapping Quality">\n')
         out.write('##INFO=<ID=MQ0,Number=1,Type=Integer,Description="Number of MapQ=0 Reads">\n')
+        out.write('##INFO=<ID=SVTYPE,Number=1,Type=String,Description="Type of structural variant">\n')
+        out.write('##INFO=<ID=SVLEN,Number=.,Type=Integer,Description="Difference in length between REF and ALT alleles">\n')
+        out.write('##INFO=<ID=END,Number=1,Type=Integer,Description="End position of the variant">\n')
+        out.write('##INFO=<ID=IMPRECISE,Number=0,Type=Flag,Description="Imprecise structural variation">\n')
+        out.write('##INFO=<ID=CIPOS,Number=2,Type=Integer,Description="Confidence interval around POS">\n')
+        out.write('##INFO=<ID=CIEND,Number=2,Type=Integer,Description="Confidence interval around END">\n')
         out.write('##FORMAT=<ID=GT,Number=1,Type=String,Description="Genotype">\n')
         out.write('##FORMAT=<ID=GQ,Number=1,Type=Integer,Description="Genotype Quality">\n')
         out.write('##FORMAT=<ID=DP,Number=1,Type=Integer,Description="Read Depth">\n')
         out.write('##FORMAT=<ID=HQ,Number=2,Type=Integer,Description="Haplotype Quality">\n')
+        out.write('##FORMAT=<ID=PL,Number=G,Type=Integer,Description="Phred-scaled Genotype Likelihoods">\n')
+        out.write('##FORMAT=<ID=GP,Number=G,Type=Float,Description="Phred-scaled Genotype Posteriors">\n')
+        out.write('##FORMAT=<ID=GQL,Number=.,Type=String,Description="Ploidy-state Genotype Likelihoods">\n')
+        out.write('##FORMAT=<ID=MQ,Number=1,Type=Integer,Description="RMS Mapping Quality">\n')
+        out.write('##FORMAT=<ID=PS,Number=1,Type=String,Description="Phase Set">\n')
+        out.write('##FORMAT=<ID=PQ,Number=1,Type=Integer,Description="Phasing Quality">\n')
         if seq_dict is None:
             # rebuild contig lines from the denormalized variant columns
             seen: Dict[str, int] = {}
@@ -262,7 +349,13 @@ def write_vcf(variants: pa.Table, genotypes: pa.Table, path_or_file,
             # reference-allele variant rows (computed site stats) never
             # appear in ALT — only true alternate alleles do
             alt_vs = [v for v in vs if not v.get("isReference")]
-            alts = [v["variant"] for v in alt_vs]
+            # Complex (symbolic) alleles carry no base string; rebuild the
+            # symbolic ALT from the SV type (the base string is likewise
+            # unrecoverable in the reference, convertType :244-252)
+            alts = [v["variant"] if v["variant"] is not None else
+                    "<%s>" % _SV_CODE_OF_TYPE.get(v.get("svType") or "UNK",
+                                                  v.get("svType") or "UNK")
+                    for v in alt_vs]
             vs = alt_vs or vs
             if not vs:
                 vs = [{key: None for key in
@@ -280,10 +373,31 @@ def write_vcf(variants: pa.Table, genotypes: pa.Table, path_or_file,
                 info_parts.append(
                     "AF=" + ",".join("." if a is None else f"{a:g}"
                                      for a in afs))
+            if vs[0].get("rmsBaseQuality") is not None:
+                info_parts.append(f"BQ={vs[0]['rmsBaseQuality']}")
             if vs[0]["siteRmsMappingQuality"] is not None:
                 info_parts.append(f"MQ={vs[0]['siteRmsMappingQuality']}")
             if vs[0]["siteMapQZeroCounts"] is not None:
                 info_parts.append(f"MQ0={vs[0]['siteMapQZeroCounts']}")
+            if vs[0].get("svType") is not None:
+                # unmapped codes (BND etc.) were kept raw — emit verbatim
+                info_parts.append(
+                    "SVTYPE="
+                    f"{_SV_CODE_OF_TYPE.get(vs[0]['svType'], vs[0]['svType'])}")
+                if vs[0].get("svIsPrecise") is False:
+                    info_parts.append("IMPRECISE")
+                if vs[0].get("svLength") is not None:
+                    info_parts.append(f"SVLEN={vs[0]['svLength']}")
+                if vs[0].get("svEnd") is not None:
+                    info_parts.append(f"END={vs[0]['svEnd'] + 1}")
+                if vs[0].get("svConfidenceIntervalStartLow") is not None:
+                    info_parts.append(
+                        f"CIPOS={vs[0]['svConfidenceIntervalStartLow']},"
+                        f"{vs[0]['svConfidenceIntervalStartHigh']}")
+                if vs[0].get("svConfidenceIntervalEndLow") is not None:
+                    info_parts.append(
+                        f"CIEND={vs[0]['svConfidenceIntervalEndLow']},"
+                        f"{vs[0]['svConfidenceIntervalEndHigh']}")
             filt = "." if not vs[0]["filtersRun"] else \
                 (vs[0]["filters"] or "PASS")
             row = [chrom, str(pos + 1), vs[0]["id"] or ".", ref,
@@ -293,7 +407,19 @@ def write_vcf(variants: pa.Table, genotypes: pa.Table, path_or_file,
 
             site_gs = g_by_site.get((chrom, pos), [])
             if sample_order:
-                row.append("GT:GQ:DP")
+                # per-site FORMAT: GT plus whichever fields any sample
+                # carries (the reference round-trips GQ/DP/HQ/PL/GP/GQL/
+                # MQ/PS/PQ, VariantContextConverter.scala:362-449)
+                field_of = {"GQ": "genotypeQuality", "DP": "depth",
+                            "HQ": "haplotypeQuality",
+                            "PL": "phredLikelihoods",
+                            "GP": "phredPosteriorLikelihoods",
+                            "GQL": "ploidyStateGenotypeLikelihoods",
+                            "MQ": "rmsMapQuality", "PS": "phaseSetId",
+                            "PQ": "phaseQuality"}
+                keys = [k for k, fld in field_of.items()
+                        if any(g.get(fld) is not None for g in site_gs)]
+                row.append(":".join(["GT"] + keys))
                 alleles = [ref] + alts
                 for sample in sample_order:
                     gs = sorted((g for g in site_gs
@@ -308,12 +434,18 @@ def write_vcf(variants: pa.Table, genotypes: pa.Table, path_or_file,
                     # pad half-calls back to declared ploidy ("0/." etc.)
                     ploidy = gs[0]["ploidy"] or len(calls)
                     calls += ["."] * (ploidy - len(calls))
-                    gt = sep.join(calls)
-                    gq = gs[0]["genotypeQuality"]
-                    dp = gs[0]["depth"]
-                    row.append(":".join([
-                        gt, str(gq) if gq is not None else ".",
-                        str(dp) if dp is not None else "."]))
+                    cols = [sep.join(calls)]
+                    for k in keys:
+                        if k == "HQ":  # one value per haplotype
+                            hqs = [g.get("haplotypeQuality") for g in gs]
+                            cols.append(
+                                ",".join("." if h is None else str(h)
+                                         for h in hqs)
+                                if any(h is not None for h in hqs) else ".")
+                            continue
+                        v = gs[0].get(field_of[k])
+                        cols.append("." if v is None else str(v))
+                    row.append(":".join(cols))
             out.write("\t".join(row) + "\n")
     finally:
         if close:

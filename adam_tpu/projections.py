@@ -73,6 +73,24 @@ _NAMESPACES = {ns.record: ns for ns in (
     ADAMRecordField, ADAMPileupField, ADAMVariantField, ADAMGenotypeField,
     ADAMVariantDomainField, ADAMNucleotideContigField)}
 
+#: ADAMVariantAnnotations (projections/ADAMVariantAnnotationFields.scala:21-28)
+#: — the extension registry pairing each variant-annotation record with the
+#: dataset suffix it is stored under; compute_variants/vcf2adam write the
+#: ``.vd`` dataset and variantcontext.load_variant_contexts reads it back.
+ADAMVariantAnnotations = {"variantdomain": ".vd"}
+
+
+def annotation_extension(record: str) -> str:
+    """File extension for a registered variant-annotation record."""
+    return ADAMVariantAnnotations[record]
+
+
+def annotation_namespace(record: str) -> _FieldNamespace:
+    """Field namespace for a registered variant-annotation record."""
+    if record not in ADAMVariantAnnotations:
+        raise KeyError(f"{record!r} is not a registered variant annotation")
+    return _NAMESPACES[record]
+
 
 def namespace_for(record: str) -> _FieldNamespace:
     return _NAMESPACES[record]

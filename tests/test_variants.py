@@ -118,3 +118,178 @@ def test_variant_context_merge(small_vcf, tmp_path):
     assert len(loaded) == len(ctxs)
     assert [len(c.variants) for c in loaded] == [len(c.variants)
                                                 for c in ctxs]
+
+
+# ---- round-3 field-parity additions ------------------------------------
+
+SV_VCF = """##fileformat=VCFv4.1
+##contig=<ID=1,length=249250621>
+##INFO=<ID=SVTYPE,Number=1,Type=String,Description="">
+##INFO=<ID=SVLEN,Number=.,Type=Integer,Description="">
+##INFO=<ID=END,Number=1,Type=Integer,Description="">
+##INFO=<ID=IMPRECISE,Number=0,Type=Flag,Description="">
+##INFO=<ID=CIPOS,Number=2,Type=Integer,Description="">
+##INFO=<ID=CIEND,Number=2,Type=Integer,Description="">
+##FORMAT=<ID=GT,Number=1,Type=String,Description="">
+##FORMAT=<ID=GQ,Number=1,Type=Integer,Description="">
+#CHROM\tPOS\tID\tREF\tALT\tQUAL\tFILTER\tINFO\tFORMAT\tNA1
+1\t2827693\tsv1\tT\t<DEL>\t30\tPASS\tSVTYPE=DEL;SVLEN=-1200;END=2828894;IMPRECISE;CIPOS=-56,20;CIEND=-10,62\tGT:GQ\t0/1:14
+1\t9000000\tsv2\tG\t<DUP:TANDEM>\t40\tPASS\tSVTYPE=DUP:TANDEM;SVLEN=3000;END=9003001\tGT:GQ\t1/1:31
+"""
+
+LIKELIHOOD_VCF = """##fileformat=VCFv4.1
+##contig=<ID=1,length=249250621>
+##FORMAT=<ID=GT,Number=1,Type=String,Description="">
+##FORMAT=<ID=GQ,Number=1,Type=Integer,Description="">
+##FORMAT=<ID=PL,Number=G,Type=Integer,Description="">
+##FORMAT=<ID=GP,Number=G,Type=Float,Description="">
+##FORMAT=<ID=GQL,Number=.,Type=String,Description="">
+##FORMAT=<ID=MQ,Number=1,Type=Integer,Description="">
+##FORMAT=<ID=PS,Number=1,Type=String,Description="">
+##FORMAT=<ID=PQ,Number=1,Type=Integer,Description="">
+#CHROM\tPOS\tID\tREF\tALT\tQUAL\tFILTER\tINFO\tFORMAT\tNA1
+1\t100\t.\tA\tC\t50\tPASS\t.\tGT:GQ:PL:GP:GQL:MQ:PS:PQ\t0|1:48:51,0,30\t
+"""
+LIKELIHOOD_VCF = LIKELIHOOD_VCF.replace(
+    "51,0,30\t", "51,0,30:0.1,0.8,0.1:l1,l2:58:ps1:40")
+
+
+def _read_text(text):
+    import io
+    from adam_tpu.io.vcf import read_vcf
+    return read_vcf(io.StringIO(text))
+
+
+def test_sv_fields_mapped_from_info():
+    v, g, d, sd = _read_text(SV_VCF)
+    rows = v.to_pylist()
+    assert rows[0]["variantType"] == "Complex"
+    assert rows[0]["variant"] is None
+    assert rows[0]["svType"] == "Deletion"
+    assert rows[0]["svLength"] == -1200
+    assert rows[0]["svEnd"] == 2828893          # 0-based
+    assert rows[0]["svIsPrecise"] is False
+    assert rows[0]["svConfidenceIntervalStartLow"] == -56
+    assert rows[0]["svConfidenceIntervalStartHigh"] == 20
+    assert rows[0]["svConfidenceIntervalEndLow"] == -10
+    assert rows[0]["svConfidenceIntervalEndHigh"] == 62
+    assert rows[1]["svType"] == "TandemDuplication"
+    assert rows[1]["svIsPrecise"] is True
+    # symbolic allele flows into the genotype table too
+    g0 = g.to_pylist()
+    assert any(r["allele"] == "<DEL>" and r["alleleVariantType"] == "Complex"
+               for r in g0)
+
+
+def test_genotype_likelihood_fields_mapped():
+    _, g, _, _ = _read_text(LIKELIHOOD_VCF)
+    r = g.to_pylist()[0]
+    assert r["phredLikelihoods"] == "51,0,30"
+    assert r["phredPosteriorLikelihoods"] == "0.1,0.8,0.1"
+    assert r["ploidyStateGenotypeLikelihoods"] == "l1,l2"
+    assert r["rmsMapQuality"] == 58
+    assert r["isPhased"] is True
+    assert r["phaseSetId"] == "ps1"
+    assert r["phaseQuality"] == 40
+
+
+def test_phase_fields_dropped_when_unphased():
+    text = LIKELIHOOD_VCF.replace("0|1", "0/1")
+    _, g, _, _ = _read_text(text)
+    r = g.to_pylist()[0]
+    assert r["isPhased"] is False
+    assert r["phaseSetId"] is None and r["phaseQuality"] is None
+
+
+def _round_trip(text, via_bcf=False, tmp_path=None):
+    import io
+    from adam_tpu.io.vcf import read_vcf, write_vcf
+    first = _read_text(text)
+    if via_bcf:
+        p = str(tmp_path / "rt.bcf")
+        write_vcf(first[0], first[1], p, first[3])
+        second = read_vcf(p)
+    else:
+        buf = io.StringIO()
+        write_vcf(first[0], first[1], buf, first[3])
+        second = _read_text(buf.getvalue())
+    return first, second
+
+
+def _assert_tables_match(first, second, tables=(0, 1)):
+    for ti in tables:
+        a, b = first[ti].to_pylist(), second[ti].to_pylist()
+        assert len(a) == len(b)
+        for ra, rb in zip(a, b):
+            for k, va in ra.items():
+                assert rb.get(k) == va, (ti, k, va, rb.get(k))
+
+
+def test_sv_vcf_round_trip():
+    _assert_tables_match(*_round_trip(SV_VCF))
+
+
+def test_sv_bcf_round_trip(tmp_path):
+    _assert_tables_match(*_round_trip(SV_VCF, via_bcf=True,
+                                      tmp_path=tmp_path))
+
+
+def test_likelihood_vcf_round_trip():
+    _assert_tables_match(*_round_trip(LIKELIHOOD_VCF))
+
+
+def test_likelihood_bcf_round_trip(tmp_path):
+    _assert_tables_match(*_round_trip(LIKELIHOOD_VCF, via_bcf=True,
+                                      tmp_path=tmp_path))
+
+
+def test_variant_annotation_registry():
+    from adam_tpu.projections import (ADAMVariantAnnotations,
+                                      annotation_extension,
+                                      annotation_namespace)
+    assert annotation_extension("variantdomain") == ".vd"
+    assert "inDbSNP" in list(annotation_namespace("variantdomain"))
+    assert list(ADAMVariantAnnotations) == ["variantdomain"]
+
+
+def test_sv_missing_values_and_bnd_round_trip():
+    text = """##fileformat=VCFv4.1
+##contig=<ID=1,length=249250621>
+#CHROM\tPOS\tID\tREF\tALT\tQUAL\tFILTER\tINFO
+1\t100\t.\tA\tA]17:198982]\t30\tPASS\tSVTYPE=BND;SVLEN=.;END=.;CIPOS=-10,10
+1\t200\t.\tG\t<DEL>\t40\tPASS\tSVTYPE=DEL;SVLEN=.;END=.;CIPOS=.,.
+"""
+    first = _read_text(text)
+    rows = first[0].to_pylist()
+    assert rows[0]["svType"] == "BND"           # raw code kept
+    assert rows[0]["variantType"] == "SV"
+    assert rows[0]["svLength"] is None and rows[0]["svEnd"] is None
+    assert rows[0]["svConfidenceIntervalStartLow"] == -10
+    assert rows[1]["svType"] == "Deletion"
+    assert rows[1]["svConfidenceIntervalStartLow"] is None
+    import io
+    from adam_tpu.io.vcf import write_vcf
+    buf = io.StringIO()
+    write_vcf(first[0], first[1], buf, first[3])
+    second = _read_text(buf.getvalue())
+    _assert_tables_match(first, second, tables=(0,))
+    # the breakend ALT and BND SVTYPE both survive
+    rec = [ln for ln in buf.getvalue().splitlines()
+           if not ln.startswith("#")][0]
+    assert "SVTYPE=BND" in rec and "A]17:198982]" in rec
+
+
+def test_generate_mapqs_null_parity_with_aggregate():
+    import pyarrow as pa
+    from adam_tpu.compare.engine import (ComparisonTraversalEngine,
+                                         find_comparison)
+    t1 = pa.table({"readName": ["a"], "flags": [0], "start": [5],
+                   "referenceId": [0],
+                   "mapq": pa.array([None], pa.int64()), "qual": ["II"]})
+    t2 = pa.table({"readName": ["a"], "flags": [0], "start": [5],
+                   "referenceId": [0], "mapq": pa.array([30], pa.int64()),
+                   "qual": ["II"]})
+    e = ComparisonTraversalEngine(t1, t2)
+    comp = find_comparison("mapqs")
+    assert e.generate(comp)["a"] == [(None, 30)]
+    assert dict(e.aggregate(comp).value_to_count) == {(None, 30): 1}
