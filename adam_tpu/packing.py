@@ -34,6 +34,10 @@ _BASE_LUT = np.full(256, S.BASE_PAD, np.int8)
 for _ch, _code in S.BASE_CODE.items():
     _BASE_LUT[ord(_ch)] = _code
 
+#: byte-value minus offset as one int8 gather (qual decode); bytes under
+#: the offset only occur in masked-out padding and may wrap freely
+_OFFSET_LUTS = {33: (np.arange(256, dtype=np.int16) - 33).astype(np.int8)}
+
 _CIGAR_LUT = np.full(256, -1, np.int8)
 for _ch, _code in S.CIGAR_CODE.items():
     _CIGAR_LUT[ord(_ch)] = _code
@@ -120,14 +124,16 @@ def _string_column_to_padded(col: pa.ChunkedArray, n_rows: int, pad_to: int,
     lens_full[:len(arr)] = lens
     if data.size == 0:
         return out, lens_full
-    pos = np.arange(L)[None, :]
+    pos = np.arange(L, dtype=np.int64)[None, :]
     mask = pos < lens[:len(arr), None]
-    # gather source byte for every (row, pos) inside the mask
-    src = offsets[:-1, None] + pos
-    vals = data[np.where(mask, src, 0)]
-    decoded = (lut[vals].astype(np.int16) - offset).astype(np.int8) if offset == 0 \
-        else (vals.astype(np.int16) - offset).astype(np.int8)
-    out[:len(arr)][mask] = decoded[mask]
+    # gather source byte for every (row, pos), clipped into range; one
+    # int8 LUT pass decodes AND offsets, and the padded region overwrites
+    # via a single where — no boolean fancy-indexing round trips
+    src = np.minimum(offsets[:-1, None].astype(np.int64) + pos,
+                     max(data.size - 1, 0))
+    vals = data[src]
+    lut8 = lut if offset == 0 else _OFFSET_LUTS[offset]
+    out[:len(arr)] = np.where(mask, lut8[vals], pad_value)
     return out, lens_full
 
 
@@ -224,8 +230,13 @@ def pack_cigars(cigars, n_rows: int, max_ops: int = MAX_CIGAR_OPS):
     """CIGAR strings -> (ops int8 [N,C], lens int32 [N,C], n_ops int32 [N]).
 
     Replaces the samtools TextCigarCodec the reference leans on
-    (rich/RichADAMRecord.scala:58-60).
+    (rich/RichADAMRecord.scala:58-60).  An Arrow string column takes the
+    flat-byte vectorized path (one pass over the offsets+data buffers, no
+    per-row Python — the text codec was one of the three packing hot spots
+    in the first end-to-end profile); lists fall back to the char loop.
     """
+    if isinstance(cigars, (pa.ChunkedArray, pa.Array)):
+        return _pack_cigars_arrow(cigars, n_rows, max_ops)
     ops = np.full((n_rows, max_ops), -1, np.int8)
     lens = np.zeros((n_rows, max_ops), np.int32)
     n_ops = np.zeros(n_rows, np.int32)
@@ -246,6 +257,87 @@ def pack_cigars(cigars, n_rows: int, max_ops: int = MAX_CIGAR_OPS):
                 j += 1
         n_ops[i] = j
     return ops, lens, n_ops
+
+
+_POW10 = 10 ** np.arange(10, dtype=np.int64)
+
+
+def _pack_cigars_arrow(col, n_rows: int, max_ops: int = MAX_CIGAR_OPS):
+    """Vectorized CIGAR text parse over the Arrow buffers.
+
+    Each op character closes a digit run: the run's value is the sum of
+    digit * 10^(digits-remaining-after-it-in-run), computed with one
+    cumulative-count pass — no per-row loop.
+    """
+    arr = col.combine_chunks() if isinstance(col, pa.ChunkedArray) else col
+    n = len(arr)
+    ops = np.full((n_rows, max_ops), -1, np.int8)
+    lens = np.zeros((n_rows, max_ops), np.int32)
+    n_ops = np.zeros(n_rows, np.int32)
+    if n == 0:
+        return ops, lens, n_ops
+    bufs = arr.buffers()
+    offsets = np.frombuffer(bufs[1], np.int32, count=n + 1,
+                            offset=arr.offset * 4).astype(np.int64)
+    data = np.frombuffer(bufs[2], np.uint8) if bufs[2] is not None \
+        else np.zeros(0, np.uint8)
+    # normalize away slicing: views outside [offsets[0], offsets[-1]) belong
+    # to rows not in this array and must not be scanned
+    data = data[offsets[0]:offsets[-1]]
+    offsets = offsets - offsets[0]
+    if data.size == 0:
+        return ops, lens, n_ops
+    codes = _CIGAR_LUT[data]                       # -1 for digits/junk
+    is_digit = (data >= 48) & (data <= 57)
+    junk = ~is_digit & (codes < 0)
+    if junk.any():
+        # '*' rows (no cigar) are the one legal non-token; anything else is
+        # corrupt input and must fail LOUDLY like the loop path's KeyError —
+        # folding a stray byte into a digit run would silently corrupt the
+        # geometry feeding realignment/BQSR
+        jrows = np.searchsorted(offsets[1:], np.flatnonzero(junk),
+                                side="right")
+        row_len = offsets[jrows + 1] - offsets[jrows]
+        star = (row_len == 1) & (data[offsets[jrows]] == ord("*"))
+        if not star.all():
+            bad = int(jrows[~star][0])
+            raise ValueError(f"unparseable cigar {arr[bad].as_py()!r}")
+    op_idx = np.flatnonzero(~is_digit & (codes >= 0))
+    if len(op_idx) == 0:
+        return ops, lens, n_ops
+    # row of each op char, and its slot within the row
+    row = np.searchsorted(offsets[1:], op_idx, side="right")
+    first_op_of_row = np.searchsorted(row, np.arange(n))
+    slot = np.arange(len(op_idx)) - first_op_of_row[row]
+    if slot.max(initial=0) >= max_ops:
+        bad = row[slot >= max_ops][0]
+        raise ValueError(
+            f"cigar {arr[int(bad)].as_py()!r} exceeds {max_ops} ops")
+    # digit-run value per op: digits between the previous op (or row
+    # start) and this op.  weight = 10^(run_end - i - 1) for digit at i.
+    run_start = np.maximum(
+        np.concatenate([[np.int64(-1)], op_idx[:-1]]) + 1,
+        offsets[row])
+    run_len = op_idx - run_start
+    digit_rows = np.repeat(np.arange(len(op_idx)), run_len)
+    flat = np.repeat(run_start, run_len) + _ranges_within(run_len)
+    weights = _POW10[np.repeat(op_idx, run_len) - flat - 1]
+    values = np.zeros(len(op_idx), np.int64)
+    np.add.at(values, digit_rows,
+              (data[flat].astype(np.int64) - 48) * weights)
+    ops[row, slot] = codes[op_idx]
+    lens[row, slot] = values.astype(np.int32)
+    np.maximum.at(n_ops, row, (slot + 1).astype(np.int32))
+    return ops, lens, n_ops
+
+
+def _ranges_within(counts: np.ndarray) -> np.ndarray:
+    """[sum(counts)] 0..count_i-1 for each i, concatenated."""
+    total = int(counts.sum())
+    if total == 0:
+        return np.zeros(0, np.int64)
+    first = np.cumsum(counts) - counts
+    return np.arange(total, dtype=np.int64) - np.repeat(first, counts)
 
 
 def pack_reads(table: pa.Table, *, with_bases: bool = True,
@@ -277,6 +369,6 @@ def pack_reads(table: pa.Table, *, with_bases: bool = True,
         batch.update(bases=bases, quals=quals, read_len=read_len)
     if with_cigar:
         ops, lens, n_ops = pack_cigars(
-            table.column("cigar").to_pylist(), n_pad, max_cigar_ops)
+            table.column("cigar"), n_pad, max_cigar_ops)
         batch.update(cigar_ops=ops, cigar_lens=lens, n_cigar=n_ops)
     return ReadBatch(**batch)

@@ -252,12 +252,25 @@ def streaming_transform(input_path: str, output_path: str, *,
     """
     from ..bqsr.recalibrate import apply_table, compute_table
     from ..bqsr.table import RecalTable
+    from ..instrument import stage
     from ..io.parquet import DatasetWriter, iter_tables
     from ..io.stream import open_read_stream
     from ..models.dictionary import SequenceDictionary
     from ..packing import pack_reads
     from .partitioner import GenomicRegionPartitioner
     from .. import schema as S
+
+    def timed_chunks(it, name):
+        """Attribute the iterator's own work (format decode / parquet scan)
+        to a named stage, chunk by chunk."""
+        it = iter(it)
+        while True:
+            with stage(name):
+                try:
+                    table = next(it)
+                except StopIteration:
+                    return
+            yield table
 
     if mesh is None:
         mesh = make_mesh()
@@ -280,14 +293,15 @@ def streaming_transform(input_path: str, output_path: str, *,
         total_rows = 0
         max_rgid = -1
         bucket_len = 0
-        for table in stream:
+        for table in timed_chunks(stream, "p1-decode"):
             total_rows += table.num_rows
             max_rgid = max(max_rgid,
                            int(column_int64(table, "recordGroupId")
                                .max(initial=-1)))
             _accumulate_seq_records(table, seq_seen)
             if raw_writer is not None:
-                raw_writer.write(table)
+                with stage("p1-spill"):
+                    raw_writer.write(table)
             if keys is not None or bqsr:
                 # grow the length bucket BEFORE packing — a later chunk may
                 # hold a longer read than anything seen so far
@@ -296,15 +310,18 @@ def streaming_transform(input_path: str, output_path: str, *,
                     table.column("sequence"))).as_py() or 1
                 bucket_len = max(bucket_len,
                                  ((chunk_max + 127) // 128) * 128)
-                batch = pack_reads(table, pad_rows_to=mesh.size,
-                                   bucket_len=bucket_len)
+                with stage("p1-pack"):
+                    batch = pack_reads(table, pad_rows_to=mesh.size,
+                                       bucket_len=bucket_len)
                 if keys is not None:
-                    keys.add_chunk(table, batch)
+                    with stage("p1-markdup-keys", sync=True):
+                        keys.add_chunk(table, batch)
         if raw_writer is not None:
             raw_writer.close()
         seq_dict = stream.seq_dict or SequenceDictionary(seq_seen.values())
 
-        dup = keys.decide() if keys is not None else None
+        with stage("markdup-decide"):
+            dup = keys.decide() if keys is not None else None
 
         def reread():
             offset = 0
@@ -318,11 +335,13 @@ def streaming_transform(input_path: str, output_path: str, *,
         # ---- pass 2: BQSR table -------------------------------------------
         rt = None
         if bqsr:
-            for table in reread():
-                batch = pack_reads(table, pad_rows_to=mesh.size,
-                                   bucket_len=bucket_len)
-                part = compute_table(table, batch, snp_table,
-                                     n_read_groups=max(max_rgid + 1, 1))
+            for table in timed_chunks(reread(), "p2-decode"):
+                with stage("p2-pack"):
+                    batch = pack_reads(table, pad_rows_to=mesh.size,
+                                       bucket_len=bucket_len)
+                with stage("p2-bqsr-count", sync=True):
+                    part = compute_table(table, batch, snp_table,
+                                         n_read_groups=max(max_rgid + 1, 1))
                 rt = part if rt is None else rt + part
             if rt is None:
                 rt = RecalTable(n_read_groups=1, max_read_len=bucket_len or 1)
@@ -345,30 +364,34 @@ def streaming_transform(input_path: str, output_path: str, *,
             max(1, -(-total_rows // max(coalesce, 1)))
         out = DatasetWriter(output_path, part_rows=out_part_rows,
                             compression=compression)
-        for table in reread():
+        for table in timed_chunks(reread(), "p3-decode"):
             if bqsr:
-                batch = pack_reads(table, pad_rows_to=mesh.size,
-                                   bucket_len=bucket_len)
-                table = apply_table(rt, table, batch)
+                with stage("p3-pack"):
+                    batch = pack_reads(table, pad_rows_to=mesh.size,
+                                       bucket_len=bucket_len)
+                with stage("p3-bqsr-apply", sync=True):
+                    table = apply_table(rt, table, batch)
             if not binned:
-                out.write(table)
+                with stage("p3-write"):
+                    out.write(table)
                 continue
-            flags = column_int64(table, "flags", 0)
-            refid = column_int64(table, "referenceId")
-            start = column_int64(table, "start")
-            f_mapped = (flags & S.FLAG_UNMAPPED) == 0
-            bins = part.partition(np.where(f_mapped, refid, -1),
-                                  np.maximum(start, 0))
-            # flag-mapped reads with a null refid sort before every contig
-            # (sort_order keys by flags, not refid) -> front bin
-            bins = np.where(f_mapped & (refid < 0), 0, bins)
-            for b in np.unique(bins):
-                rows = np.flatnonzero(bins == b)
-                bin_writers[int(b)].write(table.take(pa.array(rows)))
-            if realign:
-                _route_halo(table, bins, part, f_mapped & (refid >= 0),
-                            refid, start, halo_writers, workdir,
-                            bin_part_rows, compression)
+            with stage("p3-route"):
+                flags = column_int64(table, "flags", 0)
+                refid = column_int64(table, "referenceId")
+                start = column_int64(table, "start")
+                f_mapped = (flags & S.FLAG_UNMAPPED) == 0
+                bins = part.partition(np.where(f_mapped, refid, -1),
+                                      np.maximum(start, 0))
+                # flag-mapped reads with a null refid sort before every
+                # contig (sort_order keys by flags, not refid) -> front bin
+                bins = np.where(f_mapped & (refid < 0), 0, bins)
+                for b in np.unique(bins):
+                    rows = np.flatnonzero(bins == b)
+                    bin_writers[int(b)].write(table.take(pa.array(rows)))
+                if realign:
+                    _route_halo(table, bins, part, f_mapped & (refid >= 0),
+                                refid, start, halo_writers, workdir,
+                                bin_part_rows, compression)
 
         # ---- pass 4: per-bin realign/sort through the merge window --------
         if binned:
@@ -378,10 +401,11 @@ def streaming_transform(input_path: str, output_path: str, *,
                 w.close()
             budget = max_bin_rows if max_bin_rows is not None \
                 else 4 * chunk_rows
-            _emit_bins(out, bin_writers,
-                       halo_writers if realign else {}, part,
-                       chunk_rows, budget, realign, sort,
-                       compression=compression)
+            with stage("p4-bins", sync=True):
+                _emit_bins(out, bin_writers,
+                           halo_writers if realign else {}, part,
+                           chunk_rows, budget, realign, sort,
+                           compression=compression)
         out.close()
         return total_rows
     finally:

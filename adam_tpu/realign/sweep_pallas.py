@@ -116,3 +116,39 @@ def sweep_pallas(reads_u8, quals, read_lens, cons_u8, cons_len, *,
                            jnp.asarray([cons_len], jnp.int32),
                            interpret=interpret)
     return bq[:R], bo[:R]
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _sweep_padded_batch(reads, w, lens, cons, cons_len, interpret=False):
+    return jax.vmap(
+        lambda r, wq, ln, c, cl: _sweep_padded(r, wq, ln, c, cl,
+                                               interpret=interpret)
+    )(reads, w, lens, cons, cons_len)
+
+
+def sweep_pallas_batch(reads_u8, quals, read_lens, cons_u8, cons_len, *,
+                       interpret: bool = False):
+    """Batched form of :func:`sweep_pallas` over a leading G axis — the
+    pallas counterpart of realigner._sweep_conv_many (one vmapped dispatch
+    per padded-shape bucket).  reads_u8 [G, R, L], quals [G, R, L],
+    read_lens [G, R], cons_u8 [G, CL], cons_len [G]."""
+    G, R, L = reads_u8.shape
+    CL = int(cons_u8.shape[1])
+    Rp, Lp = _round_up(max(R, 8), 8), _round_up(max(L, 128), 128)
+    CLp = _round_up(max(CL, Lp) + Lp, 128)
+
+    reads_p = jnp.zeros((G, Rp, Lp), jnp.int32).at[:, :R, :L].set(
+        reads_u8.astype(jnp.int32))
+    w = jnp.zeros((G, Rp, Lp), jnp.int32).at[:, :R, :L].set(
+        quals.astype(jnp.int32))
+    lens_full = jnp.zeros((G, Rp), jnp.int32).at[:, :R].set(read_lens)
+    mask = jnp.arange(Lp)[None, None, :] < lens_full[:, :, None]
+    w = jnp.where(mask, w, 0)
+    lens_p = jnp.full((G, Rp, 1), CL, jnp.int32).at[:, :R, 0].set(read_lens)
+    cons_p = jnp.zeros((G, 1, CLp), jnp.int32).at[:, 0, :CL].set(
+        cons_u8.astype(jnp.int32))
+    bq, bo = _sweep_padded_batch(
+        reads_p, w, lens_p, cons_p,
+        jnp.asarray(cons_len, jnp.int32).reshape(G, 1),
+        interpret=interpret)
+    return bq[:, :R], bo[:, :R]

@@ -56,3 +56,20 @@ def test_reference_positions_hard_clip_ignored():
     pos = np.asarray(C.reference_positions(start, ops, lens, max_len=8))[0]
     assert pos[:3].tolist() == [50, 51, 52]
     assert (pos[3:] == C.NO_POSITION).all()
+
+
+def test_pack_cigars_arrow_matches_loop():
+    import pyarrow as pa
+    cigs = ["100M", "3S7M2I5M3D10M", None, "*", "5H10M5H", "1M",
+            "123456789M", "2M3I", "10M10M10M", "9N1P2=3X", ""]
+    want = pack_cigars(list(cigs), len(cigs) + 2)
+    got = pack_cigars(pa.array(cigs), len(cigs) + 2)
+    for a, b in zip(want, got):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_pack_cigars_arrow_max_ops_overflow():
+    import pyarrow as pa
+    import pytest
+    with pytest.raises(ValueError, match="exceeds"):
+        pack_cigars(pa.array(["1M" * 20]), 1)

@@ -117,3 +117,41 @@ def test_mismatch_quality_weighting():
                         interpret=True)
     assert int(q[0]) == 17  # one mismatch, weighted by its quality
     assert int(o[0]) == 0
+
+
+def test_sweep_pallas_batch_matches_conv_many():
+    import numpy as np
+    import jax.numpy as jnp
+    from adam_tpu.realign.realigner import _sweep_conv_many
+    from adam_tpu.realign.sweep_pallas import sweep_pallas_batch
+
+    rng = np.random.RandomState(4)
+    G, R, L, CL = 3, 12, 20, 64
+    bases = np.frombuffer(b"ACGT", np.uint8)
+    reads = bases[rng.randint(0, 4, (G, R, L))]
+    quals = rng.randint(2, 41, (G, R, L)).astype(np.int32)
+    lens = rng.randint(5, L + 1, (G, R)).astype(np.int32)
+    cons = bases[rng.randint(0, 4, (G, CL))]
+    clen = np.array([CL, CL - 7, 40], np.int32)
+    want_q, want_o = _sweep_conv_many(
+        jnp.asarray(reads), jnp.asarray(quals), jnp.asarray(lens),
+        jnp.asarray(cons), jnp.asarray(clen))
+    got_q, got_o = sweep_pallas_batch(reads, quals, lens, cons, clen,
+                                      interpret=True)
+    np.testing.assert_array_equal(np.asarray(got_q), np.asarray(want_q))
+    np.testing.assert_array_equal(np.asarray(got_o), np.asarray(want_o))
+
+
+def test_sweep_backend_selection(monkeypatch):
+    import adam_tpu.realign.realigner as RL
+    RL._sweep_backend.cache_clear()
+    monkeypatch.setenv(RL._SWEEP_IMPL_ENV, "conv")
+    assert RL._sweep_backend() == "conv"
+    RL._sweep_backend.cache_clear()
+    monkeypatch.setenv(RL._SWEEP_IMPL_ENV, "pallas")
+    assert RL._sweep_backend() == "pallas"
+    RL._sweep_backend.cache_clear()
+    monkeypatch.setenv(RL._SWEEP_IMPL_ENV, "auto")
+    # CPU backend in tests -> conv (pallas is TPU-only outside interpret)
+    assert RL._sweep_backend() == "conv"
+    RL._sweep_backend.cache_clear()
