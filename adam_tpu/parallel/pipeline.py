@@ -272,6 +272,18 @@ def streaming_transform(input_path: str, output_path: str, *,
                     return
             yield table
 
+    def pad_bucket(rows: int) -> int:
+        """Row-count bucket for packing: next power of two (x mesh), so a
+        partial tail chunk reuses a previously compiled kernel shape
+        instead of forcing a full recompilation of every device kernel —
+        shape churn cost more than pass 2's actual compute in the first
+        end-to-end profile.  Capped at chunk_rows (mesh-rounded): full
+        chunks all share one shape already, so only the tail buckets —
+        a non-power-of-two chunk_rows must not inflate every chunk."""
+        b = 1 << max(rows - 1, 1).bit_length()
+        cap = max(-(-chunk_rows // mesh.size) * mesh.size, mesh.size)
+        return min(-(-b // mesh.size) * mesh.size, cap)
+
     if mesh is None:
         mesh = make_mesh()
     own_workdir = workdir is None
@@ -311,8 +323,9 @@ def streaming_transform(input_path: str, output_path: str, *,
                 bucket_len = max(bucket_len,
                                  ((chunk_max + 127) // 128) * 128)
                 with stage("p1-pack"):
-                    batch = pack_reads(table, pad_rows_to=mesh.size,
-                                       bucket_len=bucket_len)
+                    batch = pack_reads(
+                        table, pad_rows_to=pad_bucket(table.num_rows),
+                        bucket_len=bucket_len)
                 if keys is not None:
                     with stage("p1-markdup-keys", sync=True):
                         keys.add_chunk(table, batch)
@@ -337,11 +350,13 @@ def streaming_transform(input_path: str, output_path: str, *,
         if bqsr:
             for table in timed_chunks(reread(), "p2-decode"):
                 with stage("p2-pack"):
-                    batch = pack_reads(table, pad_rows_to=mesh.size,
-                                       bucket_len=bucket_len)
+                    batch = pack_reads(
+                        table, pad_rows_to=pad_bucket(table.num_rows),
+                        bucket_len=bucket_len)
                 with stage("p2-bqsr-count", sync=True):
                     part = compute_table(table, batch, snp_table,
-                                         n_read_groups=max(max_rgid + 1, 1))
+                                         n_read_groups=max(max_rgid + 1, 1),
+                                         mesh=mesh)
                 rt = part if rt is None else rt + part
             if rt is None:
                 rt = RecalTable(n_read_groups=1, max_read_len=bucket_len or 1)
@@ -367,10 +382,11 @@ def streaming_transform(input_path: str, output_path: str, *,
         for table in timed_chunks(reread(), "p3-decode"):
             if bqsr:
                 with stage("p3-pack"):
-                    batch = pack_reads(table, pad_rows_to=mesh.size,
-                                       bucket_len=bucket_len)
+                    batch = pack_reads(
+                        table, pad_rows_to=pad_bucket(table.num_rows),
+                        bucket_len=bucket_len)
                 with stage("p3-bqsr-apply", sync=True):
-                    table = apply_table(rt, table, batch)
+                    table = apply_table(rt, table, batch, mesh=mesh)
             if not binned:
                 with stage("p3-write"):
                     out.write(table)

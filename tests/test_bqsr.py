@@ -166,3 +166,48 @@ def test_table_merge():
     assert (merged.qual_obs == both.qual_obs).all()
     assert (merged.qual_mm == both.qual_mm).all()
     assert abs(merged.expected_mismatch - both.expected_mismatch) < 1e-12
+
+
+def test_count_backends_agree():
+    """scatter (the shard_map/dryrun kernel), matmul (the MXU formulation)
+    and host (CPU bincounts) must produce identical RecalTables."""
+    import os
+    import numpy as np
+    from adam_tpu.bqsr import recalibrate as R
+
+    rows = []
+    rng = np.random.RandomState(9)
+    for i in range(60):
+        L = int(rng.randint(6, 12))
+        seq = "".join("ACGT"[c] for c in rng.randint(0, 4, L))
+        md = f"{L}" if rng.rand() < 0.6 else f"{L//2}A{L - L//2 - 1}"
+        quals = rng.randint(2, 41, L)
+        rows.append(read(sequence=seq, cigar=f"{L}M", md=md,
+                         start=int(rng.randint(0, 500)),
+                         quals=tuple(quals), name=f"r{i}",
+                         flags=int(rng.choice([0, 16, 83, 163])),
+                         rg=int(rng.randint(0, 3))))
+    table = _reads_table(rows)
+    outs = {}
+    saved = os.environ.get(R._COUNT_IMPL_ENV)
+    try:
+        for impl in ("scatter", "matmul", "host"):
+            os.environ[R._COUNT_IMPL_ENV] = impl
+            outs[impl] = R.compute_table(table)
+    finally:
+        if saved is None:
+            os.environ.pop(R._COUNT_IMPL_ENV, None)
+        else:
+            os.environ[R._COUNT_IMPL_ENV] = saved
+    for impl in ("matmul", "host"):
+        a, b = outs["scatter"], outs[impl]
+        np.testing.assert_array_equal(a.qual_obs, b.qual_obs, err_msg=impl)
+        np.testing.assert_array_equal(a.qual_mm, b.qual_mm, err_msg=impl)
+        np.testing.assert_array_equal(a.cycle_obs, b.cycle_obs,
+                                      err_msg=impl)
+        np.testing.assert_array_equal(a.cycle_mm, b.cycle_mm, err_msg=impl)
+        np.testing.assert_array_equal(a.ctx_obs, b.ctx_obs, err_msg=impl)
+        np.testing.assert_array_equal(a.ctx_mm, b.ctx_mm, err_msg=impl)
+        # all backends build the same integer qual histogram and take the
+        # f64 dot on host, so even the float expectation is bit-identical
+        assert a.expected_mismatch == b.expected_mismatch, impl
