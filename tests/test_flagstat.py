@@ -184,3 +184,24 @@ def test_wire_pack_rejects_out_of_range_flags_and_mapq():
             packer(ok16, neg_mapq, refid, refid, valid)
         packer(ok16.astype(np.int32), ok8.astype(np.int32), refid, refid,
                valid)  # in-range wide dtypes are fine
+
+
+def test_pallas_flagstat_matches_einsum_core():
+    """The Pallas wire sweep must be bit-identical to the XLA einsum core,
+    including the ragged tail handed back to XLA (interpret mode on CPU)."""
+    import numpy as np
+    from adam_tpu.ops.flagstat import (flagstat_kernel_wire32,
+                                       pack_flagstat_wire32)
+    from adam_tpu.ops.flagstat_pallas import (BLOCK, flagstat_pallas_wire32)
+
+    rng = np.random.RandomState(7)
+    for n in (BLOCK * 2 + 1234, BLOCK, 1000):  # blocked+tail, exact, tiny
+        wire = pack_flagstat_wire32(
+            rng.randint(0, 1 << 12, size=n).astype(np.uint16),
+            rng.randint(0, 61, size=n).astype(np.uint8),
+            rng.randint(0, 24, size=n).astype(np.int16),
+            rng.randint(0, 24, size=n).astype(np.int16),
+            rng.rand(n) < 0.95)
+        got = np.asarray(flagstat_pallas_wire32(wire, interpret=True))
+        ref = np.asarray(flagstat_kernel_wire32(wire))
+        assert np.array_equal(got, ref), n
