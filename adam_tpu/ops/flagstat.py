@@ -84,9 +84,12 @@ def flagstat_kernel(flags: jnp.ndarray, mapq: jnp.ndarray,
     return _flagstat_core(flags, mapq, refid != mate_refid, valid, axis_name)
 
 
-def _flagstat_core(flags, mapq, cross, valid, axis_name=None):
-    """Counting core over the 26 bits flagstat actually consumes: the flag
-    word, mapq, the cross-chromosome comparison result, and validity."""
+def indicator_masks(flags, mapq, cross, valid):
+    """The 18 flagstat indicators (COUNTER_NAMES order) + the (passed,
+    failed) vendor-quality split, all bool, over the 26 bits flagstat
+    actually consumes.  Single definition shared by the XLA einsum core
+    below and the Pallas wire sweep (:mod:`.flagstat_pallas`) so counter
+    semantics cannot diverge between the two."""
     def has(bit):
         return (flags & bit) != 0
 
@@ -99,9 +102,9 @@ def _flagstat_core(flags, mapq, cross, valid, axis_name=None):
 
     dup_p = dup & primary
     dup_s = dup & ~primary
-    ones = jnp.ones_like(paired)
+    ones = jnp.ones_like(paired, bool)
 
-    indicators = jnp.stack([
+    inds = (
         ones,
         dup_p, dup_p & mapped & mate_mapped, dup_p & mapped & ~mate_mapped,
         dup_p & cross,
@@ -116,10 +119,17 @@ def _flagstat_core(flags, mapq, cross, valid, axis_name=None):
         paired & mapped & ~mate_mapped,
         mate_diff_chr,
         mate_diff_chr & (mapq >= 5),
-    ])  # [K, N] bool
-
+    )
     failed = has(S.FLAG_QC_FAIL) & valid
-    split = jnp.stack([valid & ~failed, failed], axis=1)  # [N, 2]
+    passed = valid & ~failed
+    return inds, passed, failed
+
+
+def _flagstat_core(flags, mapq, cross, valid, axis_name=None):
+    """Counting core: [K, N] indicator stack x [N, 2] split einsum."""
+    inds, passed, failed = indicator_masks(flags, mapq, cross, valid)
+    indicators = jnp.stack(inds)              # [K, N] bool
+    split = jnp.stack([passed, failed], axis=1)  # [N, 2]
     counts = jnp.einsum("kn,nc->kc", indicators.astype(jnp.int32),
                         split.astype(jnp.int32),
                         preferred_element_type=jnp.int32)

@@ -37,47 +37,16 @@ BLOCK_ROWS = 128
 BLOCK = BLOCK_ROWS * LANES
 
 
-def _indicator_masks(wire):
-    """The 18 flagstat indicators + (passed, failed) masks, all bool, in
-    the COUNTER_NAMES order of :mod:`.flagstat`."""
-    from .. import schema as S
+def _wire_masks(wire):
+    """Unpack the wire word and delegate to the shared indicator-mask
+    definition in :mod:`.flagstat` (one source of counter semantics)."""
+    from .flagstat import indicator_masks
 
     flags = (wire & 0xFFFF).astype(jnp.int32)
     mapq = ((wire >> 16) & 0xFF).astype(jnp.int32)
     valid = ((wire >> 24) & 1) != 0
     cross = ((wire >> 25) & 1) != 0
-
-    def has(bit):
-        return (flags & bit) != 0
-
-    paired = has(S.FLAG_PAIRED)
-    mapped = ~has(S.FLAG_UNMAPPED)
-    mate_mapped = ~has(S.FLAG_MATE_UNMAPPED)
-    primary = ~has(S.FLAG_SECONDARY)
-    dup = has(S.FLAG_DUPLICATE)
-    mate_diff_chr = paired & mapped & mate_mapped & cross
-    dup_p = dup & primary
-    dup_s = dup & ~primary
-    ones = jnp.ones_like(paired, bool)
-    inds = (
-        ones,
-        dup_p, dup_p & mapped & mate_mapped, dup_p & mapped & ~mate_mapped,
-        dup_p & cross,
-        dup_s, dup_s & mapped & mate_mapped, dup_s & mapped & ~mate_mapped,
-        dup_s & cross,
-        mapped,
-        paired,
-        paired & has(S.FLAG_FIRST_OF_PAIR),
-        paired & has(S.FLAG_SECOND_OF_PAIR),
-        paired & has(S.FLAG_PROPER_PAIR),
-        paired & mapped & mate_mapped,
-        paired & mapped & ~mate_mapped,
-        mate_diff_chr,
-        mate_diff_chr & (mapq >= 5),
-    )
-    failed = has(S.FLAG_QC_FAIL) & valid
-    passed = valid & ~failed
-    return inds, passed, failed
+    return indicator_masks(flags, mapq, cross, valid)
 
 
 def _kernel(wire_ref, out_ref):
@@ -89,7 +58,7 @@ def _kernel(wire_ref, out_ref):
             out_ref[k, 0] = 0
             out_ref[k, 1] = 0
 
-    inds, passed, failed = _indicator_masks(wire_ref[...])
+    inds, passed, failed = _wire_masks(wire_ref[...])
     for k, ind in enumerate(inds):
         out_ref[k, 0] += jnp.sum((ind & passed).astype(jnp.int32))
         out_ref[k, 1] += jnp.sum((ind & failed).astype(jnp.int32))
@@ -118,6 +87,39 @@ def _flagstat_blocked(wire3d, tail, interpret=False):
     return counts + flagstat_kernel_wire32(tail)
 
 
+def _local_flagstat(wire, *, interpret: bool):
+    """Traceable flat-wire flagstat: blocked Pallas sweep + XLA tail.
+    Shapes are static under jit, so the block split happens at trace
+    time; usable inside shard_map shards."""
+    n = wire.shape[0]
+    n_blk = n // BLOCK
+    if n_blk == 0:
+        return flagstat_kernel_wire32(wire)
+    w3 = wire[:n_blk * BLOCK].reshape(n_blk, BLOCK_ROWS, LANES)
+    counts = _blocked_call(w3, interpret=interpret)
+    return counts + flagstat_kernel_wire32(wire[n_blk * BLOCK:])
+
+
+def flagstat_wire32_sharded_pallas(mesh, interpret: bool = False):
+    """Mesh-sharded fast path: each shard runs the Pallas wire sweep on its
+    local slice, counters psum over ICI — drop-in for
+    :func:`..ops.flagstat.flagstat_wire32_sharded` (the streaming CLI
+    kernel; reference: executor map + driver aggregate,
+    FlagStat.scala:102-114).  ``interpret=True`` lets the virtual-CPU test
+    mesh execute the same code path."""
+    from jax.sharding import PartitionSpec as P
+
+    from ..parallel.mesh import READS_AXIS
+
+    def fn(wire):
+        counts = _local_flagstat(wire, interpret=interpret)
+        return jax.lax.psum(counts, READS_AXIS)
+
+    f = jax.shard_map(fn, mesh=mesh, in_specs=(P(READS_AXIS),),
+                      out_specs=P())
+    return jax.jit(f)
+
+
 def flagstat_pallas_wire32(wire, interpret: bool = False) -> jnp.ndarray:
     """[18, 2] int32 counters off the 4-byte wire word, Pallas fast path.
 
@@ -138,5 +140,7 @@ def flagstat_pallas_wire32(wire, interpret: bool = False) -> jnp.ndarray:
 
 
 def available() -> bool:
-    """True when the default backend can run the compiled kernel."""
-    return jax.default_backend() == "tpu"
+    """True when the active backend can run the compiled kernel."""
+    from ..platform import is_tpu_backend
+
+    return is_tpu_backend()

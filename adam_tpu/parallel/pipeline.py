@@ -68,7 +68,18 @@ def streaming_flagstat(path: str, *, mesh=None, chunk_rows: int = 1 << 22
 
     if mesh is None:
         mesh = make_mesh()
-    kernel = flagstat_wire32_sharded(mesh)
+    # kernel selection: the Pallas wire sweep is ~4.5x the XLA einsum on
+    # TPU; ADAM_TPU_FLAGSTAT_IMPL=pallas forces it (interpret mode off-TPU
+    # so the virtual-CPU test mesh runs the identical path), =xla opts out
+    from ..platform import is_tpu_backend
+    impl = os.environ.get("ADAM_TPU_FLAGSTAT_IMPL", "auto")
+    on_tpu = is_tpu_backend()
+    if impl == "pallas" or (impl == "auto" and on_tpu):
+        from ..ops.flagstat_pallas import flagstat_wire32_sharded_pallas
+        kernel = flagstat_wire32_sharded_pallas(mesh,
+                                                interpret=not on_tpu)
+    else:
+        kernel = flagstat_wire32_sharded(mesh)
     sharding = reads_sharding(mesh)
 
     totals: Optional[np.ndarray] = None

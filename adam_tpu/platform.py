@@ -62,3 +62,24 @@ def honor_platform_env() -> None:
         import jax
 
         jax.config.update("jax_platforms", plat)
+
+
+def is_tpu_backend() -> bool:
+    """True when the active backend executes on TPU hardware.
+
+    The axon PJRT plugin can surface the backend name as "axon" while the
+    devices themselves report a TPU device_kind, so a bare
+    ``default_backend() == "tpu"`` check misfires there (it would route the
+    streaming flagstat off its Pallas fast path, or worse, run the Mosaic
+    interpreter on real chunks).  Single shared predicate for every
+    fast-path gate.
+    """
+    import jax
+
+    if jax.default_backend() in ("tpu", "axon"):
+        return True
+    try:
+        return any("tpu" in getattr(d, "device_kind", "").lower()
+                   for d in jax.devices())
+    except RuntimeError:
+        return False

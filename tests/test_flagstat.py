@@ -205,3 +205,17 @@ def test_pallas_flagstat_matches_einsum_core():
         got = np.asarray(flagstat_pallas_wire32(wire, interpret=True))
         ref = np.asarray(flagstat_kernel_wire32(wire))
         assert np.array_equal(got, ref), n
+
+
+def test_streaming_flagstat_pallas_path_matches_xla(resources, monkeypatch):
+    """ADAM_TPU_FLAGSTAT_IMPL=pallas routes the streaming CLI pipeline
+    through the sharded Pallas sweep (interpret mode on the virtual-CPU
+    mesh); counters must match the XLA einsum path exactly."""
+    from adam_tpu.parallel.pipeline import streaming_flagstat
+
+    sam = str(resources / "unmapped.sam")  # 200 reads, mixed mapped state
+    monkeypatch.setenv("ADAM_TPU_FLAGSTAT_IMPL", "xla")
+    ref = streaming_flagstat(sam)
+    monkeypatch.setenv("ADAM_TPU_FLAGSTAT_IMPL", "pallas")
+    got = streaming_flagstat(sam)
+    assert got == ref
