@@ -371,15 +371,18 @@ def _sharded_count_fn(kernel, mesh, n_qual_rg: int, n_cycle: int):
     return jax.jit(fn)
 
 
-def compute_table(table: pa.Table, batch: Optional[ReadBatch] = None,
-                  snp_table: Optional[SnpTable] = None,
-                  n_read_groups: Optional[int] = None,
-                  mesh=None) -> RecalTable:
-    """Pass 1: build the RecalTable from usable reads.
-
-    With ``mesh``, the counting kernel runs under shard_map across the
-    devices (rows must divide the mesh; streaming_transform's bucketed
-    pads guarantee it) and the count tensors psum over ICI.
+def count_tables_device(table: pa.Table,
+                        batch: Optional[ReadBatch] = None,
+                        snp_table: Optional[SnpTable] = None,
+                        n_read_groups: Optional[int] = None,
+                        mesh=None):
+    """Pass-1 counting for one chunk, WITHOUT the host sync: returns the 7
+    count tensors (qual_obs, qual_mm, cycle_obs, cycle_mm, ctx_obs,
+    ctx_mm, qhist) still on device (numpy under the "host" impl — both add
+    elementwise), so a streaming caller can accumulate chunk tables
+    device-side and let host pack/mismatch-state of chunk i+1 overlap the
+    device count of chunk i.  ``tables_to_recal`` folds the accumulated
+    tensors into a RecalTable at pass end.
     """
     n = table.num_rows
     if batch is None:
@@ -415,6 +418,14 @@ def compute_table(table: pa.Table, batch: Optional[ReadBatch] = None,
         else:
             out = kernel(*args, n_qual_rg=rt.n_qual_rg,
                          n_cycle=rt.n_cycle)
+    return out
+
+
+def tables_to_recal(out, n_read_groups: int, max_read_len: int
+                    ) -> RecalTable:
+    """Fold (possibly chunk-accumulated) count tensors into a RecalTable."""
+    rt = RecalTable(n_read_groups=max(n_read_groups, 1),
+                    max_read_len=max_read_len)
     (qual_obs, qual_mm, cycle_obs, cycle_mm, ctx_obs, ctx_mm, qhist) = \
         [np.asarray(o) for o in out]
     rt.qual_obs += qual_obs.astype(np.int64)
@@ -428,6 +439,25 @@ def compute_table(table: pa.Table, batch: Optional[ReadBatch] = None,
     rt.expected_mismatch += float(
         qhist.astype(np.float64) @ np.asarray(PHRED_TO_ERROR))
     return rt
+
+
+def compute_table(table: pa.Table, batch: Optional[ReadBatch] = None,
+                  snp_table: Optional[SnpTable] = None,
+                  n_read_groups: Optional[int] = None,
+                  mesh=None) -> RecalTable:
+    """Pass 1: build the RecalTable from usable reads (one-chunk form).
+
+    With ``mesh``, the counting kernel runs under shard_map across the
+    devices (rows must divide the mesh; streaming_transform's bucketed
+    pads guarantee it) and the count tensors psum over ICI.
+    """
+    if batch is None:
+        batch = pack_reads(table)
+    if n_read_groups is None:
+        n_read_groups = int(np.asarray(batch.read_group).max(initial=0)) + 1
+    out = count_tables_device(table, batch, snp_table,
+                              n_read_groups=n_read_groups, mesh=mesh)
+    return tables_to_recal(out, n_read_groups, batch.max_len)
 
 
 @partial(jax.jit, static_argnames=())

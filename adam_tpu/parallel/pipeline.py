@@ -82,7 +82,18 @@ def streaming_flagstat(path: str, *, mesh=None, chunk_rows: int = 1 << 22
         kernel = flagstat_wire32_sharded(mesh)
     sharding = reads_sharding(mesh)
 
-    totals: Optional[np.ndarray] = None
+    # Counters accumulate ON DEVICE between drains: a per-chunk np.asarray
+    # would serialize host decode/pack against device compute (and pay a
+    # full link round trip per chunk); async dispatch lets the host stream
+    # chunk i+1 while the device counts chunk i.  Every SYNC_EVERY chunks
+    # the int32 device block folds into a host int64 total — np.asarray is
+    # a REAL round trip (the tunnel backend's block_until_ready is a
+    # no-op), which both bounds the in-flight queue and keeps the device
+    # accumulation window far inside int32 range regardless of file size.
+    SYNC_EVERY = 8 if on_tpu else 1
+    totals = np.zeros((18, 2), np.int64)
+    totals_dev = None
+    n_chunks = 0
     stream = open_read_stream(path, columns=FLAGSTAT_COLUMNS,
                               chunk_rows=chunk_rows)
     for table in stream:
@@ -91,10 +102,14 @@ def streaming_flagstat(path: str, *, mesh=None, chunk_rows: int = 1 << 22
         if n_pad != len(wire):  # padding words carry valid=0
             wire = np.concatenate(
                 [wire, np.zeros(n_pad - len(wire), np.uint32)])
-        counts = np.asarray(kernel(jax.device_put(wire, sharding)))
-        totals = counts if totals is None else totals + counts
-    if totals is None:
-        totals = np.zeros((18, 2), np.int64)
+        counts = kernel(jax.device_put(wire, sharding))
+        totals_dev = counts if totals_dev is None else totals_dev + counts
+        n_chunks += 1
+        if n_chunks % SYNC_EVERY == 0:
+            totals += np.asarray(totals_dev).astype(np.int64)
+            totals_dev = None
+    if totals_dev is not None:
+        totals += np.asarray(totals_dev).astype(np.int64)
     passed = FlagStatMetrics.from_counters(totals[:, 0])
     failed = FlagStatMetrics.from_counters(totals[:, 1])
     return failed, passed
@@ -357,20 +372,59 @@ def streaming_transform(input_path: str, output_path: str, *,
                 yield table
 
         # ---- pass 2: BQSR table -------------------------------------------
+        # count tensors accumulate on device (async dispatch): the host's
+        # decode/pack/mismatch-state of chunk i+1 overlaps the device count
+        # of chunk i; one bounded sync every few chunks caps the in-flight
+        # queue.  The RecalTable materializes once at pass end.
         rt = None
         if bqsr:
+
+            from ..bqsr.recalibrate import (count_tables_device,
+                                            tables_to_recal)
+            from ..platform import is_tpu_backend
+            n_rg_run = max(max_rgid + 1, 1)
+            # Bounded async on accelerators: the host's decode/pack/
+            # mismatch-state of chunk i+1 overlaps the device count of
+            # chunk i.  The drain folds the int32 device tables into host
+            # int64 via np.asarray — a REAL round trip (the tunnel
+            # backend's block_until_ready is a no-op), which both caps the
+            # in-flight queue and keeps the int32 accumulation window to a
+            # few chunks (a whole-pass int32 sum would wrap on WGS-scale
+            # inputs).  On the CPU backend overlap buys nothing — sync
+            # every chunk keeps the stage report attribution exact.
+            sync_every = 4 if is_tpu_backend() else 1
+            host_acc = None
+            acc = None
+            n_counted = 0
             for table in timed_chunks(reread(), "p2-decode"):
                 with stage("p2-pack"):
                     batch = pack_reads(
                         table, pad_rows_to=pad_bucket(table.num_rows),
                         bucket_len=bucket_len)
-                with stage("p2-bqsr-count", sync=True):
-                    part = compute_table(table, batch, snp_table,
-                                         n_read_groups=max(max_rgid + 1, 1),
-                                         mesh=mesh)
-                rt = part if rt is None else rt + part
-            if rt is None:
+                will_sync = (n_counted + 1) % sync_every == 0
+                with stage("p2-bqsr-count", sync=will_sync):
+                    out = count_tables_device(table, batch, snp_table,
+                                              n_read_groups=n_rg_run,
+                                              mesh=mesh)
+                    acc = out if acc is None else tuple(
+                        a + b for a, b in zip(acc, out))
+                    n_counted += 1
+                    if will_sync:
+                        folded = tuple(np.asarray(a).astype(np.int64)
+                                       for a in acc)
+                        host_acc = folded if host_acc is None else tuple(
+                            h + f for h, f in zip(host_acc, folded))
+                        acc = None
+            if acc is not None:
+                folded = tuple(np.asarray(a).astype(np.int64) for a in acc)
+                host_acc = folded if host_acc is None else tuple(
+                    h + f for h, f in zip(host_acc, folded))
+            if host_acc is None:
                 rt = RecalTable(n_read_groups=1, max_read_len=bucket_len or 1)
+            else:
+                with stage("p2-bqsr-count", sync=True):
+                    rt = tables_to_recal(host_acc, n_rg_run,
+                                         bucket_len or 1)
 
         # ---- pass 3: emit / route to bins ---------------------------------
         binned = sort or realign

@@ -547,13 +547,16 @@ def _worker(stages: list[str]) -> None:
     is_tpu, kind = _stage_probe()
     if "flagstat" in stages:
         _stage_flagstat(kind)
-    if "transform" in stages:
-        _stage_transform(kind, is_tpu)
+    # pallas before transform: the transform stage carries the one residual
+    # compile-time risk (the count-matmul scan body at product n), and a
+    # hang there must not cost the pallas kernel evidence
     if "pallas" in stages:
         if is_tpu:
             _stage_pallas()
         else:
             _emit("pallas", {"skipped": "pallas stages need a TPU backend"})
+    if "transform" in stages:
+        _stage_transform(kind, is_tpu)
 
 
 # ---------------------------------------------------------------------------
@@ -632,7 +635,7 @@ def main() -> None:
     errors: list[str] = []
     stages: dict = {}
     try:
-        want = ["probe", "flagstat", "transform", "pallas"]
+        want = ["probe", "flagstat", "pallas", "transform"]
         attempt = 0
         cpu_incidental: dict = {}
         fails: dict = {}

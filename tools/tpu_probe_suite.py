@@ -1,0 +1,218 @@
+"""One-shot TPU probe suite: every open hardware question, answered in one
+tunnel window.
+
+Run when the tunnel is up (tools/tpu_watch.py tells you).  Prints one JSON
+line per probe so a mid-run tunnel death keeps earlier answers:
+
+  1. scan-compile knee: lax.scan compile seconds vs trip count for the BQSR
+     count-matmul body (the flagstat einsum showed ~2 s/iteration compile,
+     i.e. the remote AOT compiler unrolls; is the count scan usable at
+     product chunk sizes?)
+  2. BQSR count backends on chip: scatter vs matmul wall rate at a product
+     chunk shape
+  3. fused transform pass rate (the bench.py transform stage, standalone)
+  4. realign sweep + Smith-Waterman Pallas kernels: compile?, match?, ms
+  5. apply-pass rate
+
+Each probe runs in this process; order is least-risky first so a hang
+costs the fewest answers.  Use `--only 1,3` to cherry-pick.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+import numpy as np
+
+
+def emit(name, **kw):
+    print(json.dumps({"probe": name} | kw), flush=True)
+
+
+def t():
+    return time.perf_counter()
+
+
+def probe_scan_knee():
+    import jax
+    import jax.numpy as jnp
+
+    from adam_tpu.bqsr.recalibrate import _count_kernel_matmul
+    from adam_tpu.bqsr.table import RecalTable
+
+    L, n_rg = 100, 4
+    rt = RecalTable(n_read_groups=n_rg, max_read_len=L)
+    rng = np.random.RandomState(0)
+    for n_blocks in (16, 64, 256):
+        n = 512 * n_blocks
+        args = (jnp.asarray(rng.randint(0, 4, (n, L)).astype(np.int8)),
+                jnp.asarray(rng.randint(2, 41, (n, L)).astype(np.int8)),
+                jnp.full((n,), L, jnp.int32),
+                jnp.zeros((n,), jnp.int32),
+                jnp.asarray(rng.randint(0, n_rg, n).astype(np.int32)),
+                jnp.asarray(rng.randint(0, 3, (n, L)).astype(np.int8)),
+                jnp.ones((n,), bool))
+        t0 = t()
+        out = _count_kernel_matmul(*args, n_qual_rg=rt.n_qual_rg,
+                                   n_cycle=rt.n_cycle)
+        jax.device_get(out[0])
+        compile_s = t() - t0
+        t0 = t()
+        for _ in range(4):
+            out = _count_kernel_matmul(*args, n_qual_rg=rt.n_qual_rg,
+                                       n_cycle=rt.n_cycle)
+        jax.device_get(out[0])
+        run_s = (t() - t0) / 4
+        emit("scan_knee", n_blocks=n_blocks, n_reads=n,
+             compile_s=round(compile_s, 1), run_s=round(run_s, 3),
+             reads_per_sec=round(n / run_s))
+
+
+def _count_args(n, L, n_rg):
+    import jax.numpy as jnp
+
+    rng = np.random.RandomState(0)
+    return (jnp.asarray(rng.randint(0, 4, (n, L)).astype(np.int8)),
+            jnp.asarray(rng.randint(2, 41, (n, L)).astype(np.int8)),
+            jnp.full((n,), L, jnp.int32),
+            jnp.zeros((n,), jnp.int32),
+            jnp.asarray(rng.randint(0, n_rg, n).astype(np.int32)),
+            jnp.asarray(rng.randint(0, 3, (n, L)).astype(np.int8)),
+            jnp.ones((n,), bool))
+
+
+def probe_backends():
+    import jax
+
+    from adam_tpu.bqsr.recalibrate import (_count_kernel,
+                                           _count_kernel_matmul)
+    from adam_tpu.bqsr.table import RecalTable
+
+    L, n_rg, n = 100, 4, 131072
+    rt = RecalTable(n_read_groups=n_rg, max_read_len=L)
+    args = _count_args(n, L, n_rg)
+    for name, kern in (("scatter", _count_kernel),
+                       ("matmul", _count_kernel_matmul)):
+        try:
+            t0 = t()
+            out = kern(*args, n_qual_rg=rt.n_qual_rg, n_cycle=rt.n_cycle)
+            jax.device_get(out[0])
+            compile_s = t() - t0
+            t0 = t()
+            for _ in range(8):
+                out = kern(*args, n_qual_rg=rt.n_qual_rg,
+                           n_cycle=rt.n_cycle)
+            jax.device_get(out[0])
+            run_s = (t() - t0) / 8
+            emit("count_backend", impl=name, n_reads=n,
+                 compile_s=round(compile_s, 1),
+                 reads_per_sec=round(n / run_s))
+        except Exception as e:  # noqa: BLE001
+            emit("count_backend", impl=name, error=str(e)[:200])
+
+
+def probe_apply():
+    import jax
+    import jax.numpy as jnp
+
+    from adam_tpu.bqsr.recalibrate import _apply_kernel
+    from adam_tpu.bqsr.table import RecalTable
+
+    L, n_rg, n = 100, 4, 262144
+    rt = RecalTable(n_read_groups=n_rg, max_read_len=L)
+    fin = rt.finalize()
+    fin_dev = tuple(jnp.asarray(a) for a in (
+        fin.rg_delta, fin.qual_delta, fin.cycle_delta, fin.ctx_delta,
+        fin.rg_of_qualrg))
+    a = _count_args(n, L, n_rg)
+    mask = jnp.ones((n,), bool)
+    t0 = t()
+    out = _apply_kernel(a[0], a[1], a[2], a[3], a[4], mask, *fin_dev)
+    jax.device_get(out[:1, :1])
+    compile_s = t() - t0
+    t0 = t()
+    for _ in range(8):
+        out = _apply_kernel(a[0], a[1], a[2], a[3], a[4], mask, *fin_dev)
+    jax.device_get(out[:1, :1])
+    run_s = (t() - t0) / 8
+    emit("apply", n_reads=n, compile_s=round(compile_s, 1),
+         reads_per_sec=round(n / run_s))
+
+
+def probe_pallas_kernels():
+    import jax
+    import jax.numpy as jnp
+
+    rng = np.random.RandomState(0)
+    R, L, CL = 64, 100, 512
+    bases = np.frombuffer(b"ACGT", np.uint8)
+    reads = jnp.asarray(bases[rng.randint(0, 4, (R, L))])
+    quals = jnp.asarray(rng.randint(2, 41, (R, L)).astype(np.int32))
+    lens = jnp.full((R,), L, jnp.int32)
+    cons = jnp.asarray(bases[rng.randint(0, 4, (CL,))])
+    from adam_tpu.realign.realigner import _sweep_conv
+    try:
+        from adam_tpu.realign.sweep_pallas import sweep_pallas
+        t0 = t()
+        q, o = sweep_pallas(reads, quals, lens, cons, CL, interpret=False)
+        jax.device_get(q)
+        compile_s = t() - t0
+        qc, oc = _sweep_conv(reads, quals, lens, cons, CL)
+        ok = bool(np.array_equal(np.asarray(q), np.asarray(qc)) and
+                  np.array_equal(np.asarray(o), np.asarray(oc)))
+        emit("sweep_pallas", compiles=True, matches=ok,
+             compile_s=round(compile_s, 1))
+    except Exception as e:  # noqa: BLE001
+        emit("sweep_pallas", compiles=False, error=str(e)[:300])
+    try:
+        from adam_tpu.align.smithwaterman import sw_score_batch
+        from adam_tpu.align.sw_pallas import sw_score_batch_pallas
+        B, SL = 32, 128
+        a = jnp.asarray(rng.randint(0, 4, (B, SL)).astype(np.uint8))
+        b = jnp.asarray(rng.randint(0, 4, (B, SL)).astype(np.uint8))
+        al = jnp.full((B,), SL, jnp.int32)
+        bl = jnp.full((B,), SL, jnp.int32)
+        t0 = t()
+        got = sw_score_batch_pallas(a, al, b, bl, interpret=False)
+        jax.device_get(got)
+        compile_s = t() - t0
+        ref = sw_score_batch(a, al, b, bl)[0]
+        emit("sw_pallas", compiles=True,
+             matches=bool(np.array_equal(np.asarray(got),
+                                         np.asarray(ref))),
+             compile_s=round(compile_s, 1))
+    except Exception as e:  # noqa: BLE001
+        emit("sw_pallas", compiles=False, error=str(e)[:300])
+
+
+PROBES = {
+    "1": ("scan_knee", probe_scan_knee),
+    "2": ("count_backends", probe_backends),
+    "3": ("apply", probe_apply),
+    "4": ("pallas", probe_pallas_kernels),
+}
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--only", default="4,2,3,1",
+                    help="comma-separated probe ids, run order")
+    args = ap.parse_args()
+    import jax
+    d = jax.devices()[0]
+    emit("env", device_kind=getattr(d, "device_kind", "?"),
+         platform=d.platform)
+    for pid in args.only.split(","):
+        name, fn = PROBES[pid.strip()]
+        t0 = t()
+        try:
+            fn()
+        except Exception as e:  # noqa: BLE001
+            emit(name, fatal=str(e)[:300])
+        emit(name + "_done", wall_s=round(t() - t0, 1))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,80 @@
+"""Tunnel watcher: poll the TPU tunnel; the moment it answers, run the full
+benchmark and save the one-line JSON to BENCH_TPU_EVIDENCE.json.
+
+The tunnel's control and data planes flap on minute-to-hour scales (observed
+rounds 2-3), so evidence capture cannot wait for a human to notice the
+tunnel is back — run this under tmux and let it grab the artifact:
+
+    python tools/tpu_watch.py [--once] [--out BENCH_TPU_EVIDENCE.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+PROBE = ("import jax; d = jax.devices()[0]; "
+         "print(getattr(d, 'device_kind', '?'), d.platform)")
+
+
+def probe_ok(timeout_s: float = 45.0) -> bool:
+    try:
+        rc = subprocess.run([sys.executable, "-c", PROBE],
+                            timeout=timeout_s, capture_output=True)
+    except subprocess.TimeoutExpired:
+        return False
+    out = rc.stdout.decode(errors="replace").lower()
+    return rc.returncode == 0 and ("tpu" in out or "axon" in out)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default="BENCH_TPU_EVIDENCE.json")
+    ap.add_argument("--once", action="store_true",
+                    help="stop after the first captured TPU artifact")
+    ap.add_argument("--interval", type=float, default=180.0,
+                    help="seconds between probes; each probe costs a jax "
+                         "import subprocess, so keep this sparse — CPU "
+                         "benchmarks share the box")
+    args = ap.parse_args()
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+    while True:
+        t0 = time.strftime("%H:%M:%S")
+        if not probe_ok():
+            print(f"[{t0}] tunnel down", flush=True)
+            time.sleep(args.interval)
+            continue
+        print(f"[{t0}] tunnel UP — running bench.py", flush=True)
+        try:
+            rc = subprocess.run(
+                [sys.executable, os.path.join(repo, "bench.py")],
+                timeout=640, capture_output=True, text=True, cwd=repo)
+        except subprocess.TimeoutExpired:
+            print("bench timed out; re-probing", flush=True)
+            continue
+        line = rc.stdout.strip().splitlines()[-1] if rc.stdout.strip() else ""
+        try:
+            doc = json.loads(line)
+        except ValueError:
+            print(f"bench emitted no JSON (rc={rc.returncode})", flush=True)
+            time.sleep(args.interval)
+            continue
+        doc["captured_at"] = time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                           time.gmtime())
+        with open(os.path.join(repo, args.out), "w") as f:
+            json.dump(doc, f, indent=1)
+        got_tpu = doc.get("platform") == "tpu"
+        print(f"captured platform={doc.get('platform')} "
+              f"flagstat={doc.get('value')}", flush=True)
+        if got_tpu and args.once:
+            return 0
+        time.sleep(args.interval)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
