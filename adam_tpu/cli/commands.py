@@ -15,6 +15,48 @@ import os
 from .main import Command, register
 
 
+def add_parquet_args(p: argparse.ArgumentParser) -> None:
+    """The reference's shared ParquetArgs (ParquetArgs.scala:22-31), same
+    flag names: block size (bytes -> row-group rotation), page size,
+    codec, dictionary encoding.  Composed into every command that writes
+    Parquet, like the args4j trait mix-in."""
+    p.add_argument("-parquet_block_size", type=int, default=None,
+                   metavar="BYTES",
+                   help="approximate row-group size in bytes")
+    p.add_argument("-parquet_page_size", type=int, default=None,
+                   metavar="BYTES", help="Parquet data page size")
+    p.add_argument("-parquet_compression_codec", default=None,
+                   choices=["gzip", "snappy", "zstd", "uncompressed"],
+                   help="overrides -compression when given")
+    p.add_argument("-parquet_disable_dictionary", action="store_true",
+                   help="turn off dictionary encoding")
+
+
+def parquet_writer_kwargs(args, fallback_compression: str = "zstd"):
+    """argparse namespace -> save_table/DatasetWriter keyword arguments."""
+    codec = getattr(args, "parquet_compression_codec", None)
+    if codec is None:
+        codec = getattr(args, "compression", None) or fallback_compression
+    return dict(
+        compression=None if codec in ("none", "uncompressed") else codec,
+        page_size=getattr(args, "parquet_page_size", None),
+        use_dictionary=not getattr(args, "parquet_disable_dictionary",
+                                   False),
+    )
+
+
+def save_with_args(table, path, args, **kw) -> None:
+    """save_table with the shared ParquetArgs applied (incl. the bytes ->
+    row-group-rows conversion for -parquet_block_size)."""
+    from ..io.parquet import rows_for_block_size, save_table
+
+    kwargs = parquet_writer_kwargs(args)
+    bs = getattr(args, "parquet_block_size", None)
+    if bs:
+        kwargs["row_group_size"] = rows_for_block_size(table, bs)
+    save_table(table, path, **kwargs, **kw)
+
+
 @register
 class FlagStatCommand(Command):
     name = "flagstat"
@@ -49,15 +91,18 @@ class Bam2AdamCommand(Command):
                        help="number of part files to write")
         p.add_argument("-compression", default="zstd",
                        choices=["zstd", "snappy", "gzip", "none"])
+        p.add_argument("-samtools_validation", default="lenient",
+                       choices=["strict", "lenient", "silent"],
+                       help="malformed-record handling (same default as "
+                            "the reference, Bam2Adam.scala:46-47)")
+        add_parquet_args(p)
 
     def run(self, args) -> int:
         from ..io.dispatch import load_reads
-        from ..io.parquet import save_table
 
-        table, _, _ = load_reads(args.input)
-        save_table(table, args.output,
-                   compression=None if args.compression == "none" else args.compression,
-                   n_parts=args.parts)
+        table, _, _ = load_reads(args.input,
+                                 stringency=args.samtools_validation)
+        save_with_args(table, args.output, args, n_parts=args.parts)
         print(f"wrote {table.num_rows} reads to {args.output}")
         return 0
 
@@ -98,6 +143,7 @@ class TransformCommand(Command):
         p.add_argument("-workdir", default=None,
                        help="scratch directory for streamed spills "
                             "(default: a temp dir)")
+        add_parquet_args(p)
 
     def run(self, args) -> int:
         sam_out = args.output.endswith(".sam")
@@ -124,13 +170,18 @@ class TransformCommand(Command):
                 set_sync_timing(True)
             snp = SnpTable.from_vcf(args.dbsnp_sites) \
                 if args.dbsnp_sites else None
+            pw = parquet_writer_kwargs(args)
             n = streaming_transform(
                 args.input, args.output,
                 markdup=args.mark_duplicate_reads,
                 bqsr=args.recalibrate_base_qualities, snp_table=snp,
                 realign=args.realignIndels, sort=args.sort_reads,
                 workdir=args.workdir, chunk_rows=args.stream_chunk_rows,
-                coalesce=args.coalesce)
+                coalesce=args.coalesce,
+                compression=pw["compression"] or "none",
+                page_size=pw["page_size"],
+                use_dictionary=pw["use_dictionary"],
+                row_group_bytes=args.parquet_block_size)
             if args.timing:
                 from ..instrument import report
                 print(report().format())
@@ -223,8 +274,8 @@ class TransformCommand(Command):
                         rg_dict = record_group_dictionary_from_reads(table)
                     write_sam(table, seq_dict, args.output, rg_dict)
                 else:
-                    save_table(table, args.output,
-                               n_parts=args.coalesce or args.parts)
+                    save_with_args(table, args.output, args,
+                                   n_parts=args.coalesce or args.parts)
         if args.timing:
             print(report().format())
         print(f"wrote {table.num_rows} reads to {args.output}")
@@ -243,10 +294,11 @@ class Reads2RefCommand(Command):
         p.add_argument("-allow_non_primary", action="store_true",
                        help="skip the locus predicate filter")
         p.add_argument("-parts", type=int, default=1)
+        add_parquet_args(p)
 
     def run(self, args) -> int:
         from ..io.dispatch import load_reads
-        from ..io.parquet import locus_predicate, save_table
+        from ..io.parquet import locus_predicate
         from ..ops.pileup import aggregate_pileups, reads_to_pileups
 
         filters = None if args.allow_non_primary else locus_predicate()
@@ -254,7 +306,7 @@ class Reads2RefCommand(Command):
         pileups = reads_to_pileups(table)
         if args.aggregate:
             pileups = aggregate_pileups(pileups)
-        save_table(pileups, args.output, n_parts=args.parts)
+        save_with_args(pileups, args.output, args, n_parts=args.parts)
         n_reads = max(table.num_rows, 1)
         print(f"wrote {pileups.num_rows} pileups from {table.num_rows} reads "
               f"(coverage ~{pileups.num_rows / n_reads:.1f}x read length)")
@@ -270,16 +322,17 @@ class AggregatePileupsCommand(Command):
         p.add_argument("input", help="pileup Parquet dataset")
         p.add_argument("output", help="output pileup Parquet dataset")
         p.add_argument("-parts", type=int, default=1)
+        add_parquet_args(p)
 
     def run(self, args) -> int:
-        from ..io.parquet import load_table, save_table
+        from ..io.parquet import load_table
         from ..ops.pileup import aggregate_pileups
 
         pileups = load_table(args.input)
         # external data: fail loudly on null required fields (the reference
         # NPEs in combineEvidence; we raise up front)
         agg = aggregate_pileups(pileups, validate=True)
-        save_table(agg, args.output, n_parts=args.parts)
+        save_with_args(agg, args.output, args, n_parts=args.parts)
         print(f"aggregated {pileups.num_rows} -> {agg.num_rows} pileups")
         return 0
 
@@ -292,17 +345,17 @@ class Vcf2AdamCommand(Command):
     def add_args(self, p: argparse.ArgumentParser) -> None:
         p.add_argument("input", help="VCF file")
         p.add_argument("output", help="output basename (.v/.g/.vd datasets)")
+        add_parquet_args(p)
 
     def run(self, args) -> int:
-        from ..io.parquet import save_table
         from ..io.vcf import read_vcf
 
         variants, genotypes, domains, _ = read_vcf(args.input)
         # three datasets, the reference's .v/.g/.vd convention
         # (AdamRDDFunctions.scala:330-363)
-        save_table(variants, args.output + ".v")
-        save_table(genotypes, args.output + ".g")
-        save_table(domains, args.output + ".vd")
+        save_with_args(variants, args.output + ".v", args)
+        save_with_args(genotypes, args.output + ".g", args)
+        save_with_args(domains, args.output + ".vd", args)
         print(f"wrote {variants.num_rows} variants, {genotypes.num_rows} "
               f"genotypes, {domains.num_rows} domains to {args.output}.{{v,g,vd}}")
         return 0
@@ -467,6 +520,7 @@ class Fasta2AdamCommand(Command):
         p.add_argument("-reads", default=None,
                        help="reads file whose dictionary supplies contig ids "
                             "(cli/Fasta2Adam.scala:57-82)")
+        add_parquet_args(p)
 
     def run(self, args) -> int:
         import pyarrow as pa
@@ -485,7 +539,7 @@ class Fasta2AdamCommand(Command):
             contigs = contigs.set_column(
                 contigs.column_names.index("contigId"), "contigId",
                 pa.array(new_ids, pa.int32()))
-        save_table(contigs, args.output)
+        save_with_args(contigs, args.output, args)
         print(f"wrote {contigs.num_rows} contigs to {args.output}")
         return 0
 

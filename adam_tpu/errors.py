@@ -9,3 +9,29 @@ tracebacks.
 
 class FormatError(ValueError):
     pass
+
+
+class ValidationStringency:
+    """SAM-tools style record validation levels
+    (Bam2Adam.scala:46-47 exposes samtools' STRICT/LENIENT/SILENT;
+    the reference CLI defaults to LENIENT)."""
+    STRICT = "strict"
+    LENIENT = "lenient"
+    SILENT = "silent"
+
+
+def handle_malformed(stringency: str, message: str, cause=None) -> None:
+    """Apply a stringency decision to one malformed input record: STRICT
+    raises :class:`FormatError`, LENIENT warns on stderr and drops the
+    record, SILENT drops it quietly.  An unrecognized level is a caller
+    bug and raises — falling through to silent would invert the strictness
+    the caller asked for."""
+    if stringency == ValidationStringency.STRICT:
+        raise FormatError(message) from cause
+    if stringency == ValidationStringency.LENIENT:
+        import sys
+        print(f"warning: {message} (dropped)", file=sys.stderr)
+    elif stringency != ValidationStringency.SILENT:
+        raise ValueError(
+            f"unknown validation stringency {stringency!r} "
+            f"(want strict/lenient/silent)")

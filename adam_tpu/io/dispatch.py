@@ -28,7 +28,7 @@ FLAGSTAT_COLUMNS = _flagstat_columns()
 
 
 def load_reads(path: str, *, columns: Optional[Sequence[str]] = None,
-               filters=None
+               filters=None, stringency: str = "strict"
                ) -> Tuple[pa.Table, Optional[SequenceDictionary],
                           Optional[RecordGroupDictionary]]:
     """Load reads from SAM or Parquet; returns (table, seq_dict, rg_dict).
@@ -49,7 +49,7 @@ def load_reads(path: str, *, columns: Optional[Sequence[str]] = None,
                 pa.Table.from_pydict({n: [] for n in S.READ_SCHEMA.names},
                                      schema=S.READ_SCHEMA)
         else:
-            table, sd, rg = read_sam(p)
+            table, sd, rg = read_sam(p, stringency=stringency)
         if columns is not None:
             table = table.select([c for c in columns])
         if filters is not None:

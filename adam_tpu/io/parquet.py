@@ -35,8 +35,19 @@ def locus_predicate():
             == pa.scalar(0, pa.uint32()))
 
 
+def rows_for_block_size(table: pa.Table, block_bytes: int) -> int:
+    """Approximate row-group row count for a byte-denominated block size
+    (the reference's ``-parquet_block_size``, ParquetArgs.scala:22-31, is
+    bytes; our writers rotate row groups by rows)."""
+    rows = max(table.num_rows, 1)
+    bytes_per_row = max(table.nbytes / rows, 1.0)
+    return max(int(block_bytes / bytes_per_row), 1)
+
+
 def save_table(table: pa.Table, path: str, *, compression: str = "zstd",
-               row_group_size: int = 1 << 20, n_parts: int = 1) -> None:
+               row_group_size: int = 1 << 20, n_parts: int = 1,
+               page_size: int | None = None,
+               use_dictionary: bool = True) -> None:
     """Write a dataset directory of Parquet part files (adamSave analog)."""
     os.makedirs(path, exist_ok=True)
     rows = table.num_rows
@@ -45,7 +56,9 @@ def save_table(table: pa.Table, path: str, *, compression: str = "zstd",
     for lo in range(0, max(rows, 1), per):
         chunk = table.slice(lo, per)
         pq.write_table(chunk, os.path.join(path, f"part-r-{part:05d}.parquet"),
-                       compression=compression, row_group_size=row_group_size)
+                       compression=compression, row_group_size=row_group_size,
+                       data_page_size=page_size,
+                       use_dictionary=use_dictionary)
         part += 1
 
 
@@ -87,12 +100,21 @@ class DatasetWriter:
 
     def __init__(self, path: str, *, compression: str = "zstd",
                  row_group_size: int = 1 << 20,
-                 part_rows: int = 1 << 20):
+                 part_rows: int = 1 << 20,
+                 page_size: int | None = None,
+                 use_dictionary: bool = True,
+                 row_group_bytes: int | None = None):
         os.makedirs(path, exist_ok=True)
         self.path = path
         self.compression = compression
         self.row_group_size = row_group_size
         self.part_rows = part_rows
+        self.page_size = page_size
+        self.use_dictionary = use_dictionary
+        #: byte-denominated row-group target (the reference's
+        #: -parquet_block_size); resolved to rows from the first flushed
+        #: chunk's observed bytes/row
+        self.row_group_bytes = row_group_bytes
         self._part = 0
         self._part_row_count = 0
         self._writer: Optional[pq.ParquetWriter] = None
@@ -112,13 +134,19 @@ class DatasetWriter:
         chunk = pa.concat_tables(self._pending)
         self._pending = []
         self._pending_rows = 0
+        if self.row_group_bytes is not None:
+            self.row_group_size = rows_for_block_size(
+                chunk, self.row_group_bytes)
+            self.row_group_bytes = None
         # split across part-file boundaries
         while chunk.num_rows:
             if self._writer is None:
                 self._writer = pq.ParquetWriter(
                     os.path.join(self.path,
                                  f"part-r-{self._part:05d}.parquet"),
-                    chunk.schema, compression=self.compression)
+                    chunk.schema, compression=self.compression,
+                    data_page_size=self.page_size,
+                    use_dictionary=self.use_dictionary)
             room = self.part_rows - self._part_row_count
             head = chunk.slice(0, room)
             self._writer.write_table(head,

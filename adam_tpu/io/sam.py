@@ -111,11 +111,15 @@ def _rows_to_table(rows) -> pa.Table:
     return read_rows_to_table(rows)
 
 
-def open_sam_stream(path_or_file, chunk_rows: int = 1 << 20):
+def open_sam_stream(path_or_file, chunk_rows: int = 1 << 20,
+                    stringency: str = "strict"):
     """(seq_dict, rg_dict, generator of Arrow tables) over a streamed SAM.
 
     Lines parse as they are read; host memory is bounded by ``chunk_rows``
     (the whole-file :func:`read_sam` is this stream concatenated).
+    ``stringency`` follows samtools semantics (Bam2Adam.scala:46-47):
+    strict raises on a malformed record, lenient warns and drops it,
+    silent drops it quietly.
     """
     close = False
     if hasattr(path_or_file, "read"):
@@ -138,8 +142,16 @@ def open_sam_stream(path_or_file, chunk_rows: int = 1 << 20):
         try:
             rows: List[dict] = []
             lines = ([first_body] if first_body is not None else [])
+            from ..errors import handle_malformed
             for line in itertools.chain(lines, f):
-                row = _parse_sam_line(line, seq_dict, rg_dict)
+                try:
+                    row = _parse_sam_line(line, seq_dict, rg_dict)
+                except (ValueError, IndexError) as e:
+                    handle_malformed(
+                        stringency,
+                        f"malformed SAM record {line.rstrip()[:80]!r}: {e}",
+                        e)
+                    continue
                 if row is None:
                     continue
                 rows.append(row)
@@ -155,9 +167,11 @@ def open_sam_stream(path_or_file, chunk_rows: int = 1 << 20):
     return seq_dict, rg_dict, gen()
 
 
-def read_sam(path_or_file) -> Tuple[pa.Table, SequenceDictionary, RecordGroupDictionary]:
+def read_sam(path_or_file, stringency: str = "strict"
+             ) -> Tuple[pa.Table, SequenceDictionary, RecordGroupDictionary]:
     """Parse a SAM text file into (reads table, seq dict, record groups)."""
-    seq_dict, rg_dict, gen = open_sam_stream(path_or_file)
+    seq_dict, rg_dict, gen = open_sam_stream(path_or_file,
+                                             stringency=stringency)
     tables = list(gen)
     table = pa.concat_tables(tables) if tables \
         else _rows_to_table([])

@@ -237,7 +237,10 @@ def streaming_transform(input_path: str, output_path: str, *,
                         n_bins: Optional[int] = None,
                         coalesce: Optional[int] = None,
                         max_bin_rows: Optional[int] = None,
-                        compression: str = "zstd") -> int:
+                        compression: str = "zstd",
+                        page_size: Optional[int] = None,
+                        use_dictionary: bool = True,
+                        row_group_bytes: Optional[int] = None) -> int:
     """The ``transform`` pipeline over a chunked stream and a device mesh.
 
     Multi-pass, like the reference's shuffle stages (Transform.scala:62-97):
@@ -327,7 +330,8 @@ def streaming_transform(input_path: str, output_path: str, *,
         keys = _MarkdupKeys(mesh) if markdup else None
         seq_seen: dict = {}
         raw_writer = None if is_parquet else DatasetWriter(
-            raw_path, part_rows=chunk_rows, compression=compression)
+            raw_path, part_rows=chunk_rows, compression=compression, page_size=page_size,
+                            use_dictionary=use_dictionary)
         total_rows = 0
         max_rgid = -1
         bucket_len = 0
@@ -437,13 +441,16 @@ def streaming_transform(input_path: str, output_path: str, *,
             bin_writers = [
                 DatasetWriter(os.path.join(workdir, f"bin-{b:05d}"),
                               part_rows=bin_part_rows,
-                              compression=compression)
+                              compression=compression, page_size=page_size,
+                            use_dictionary=use_dictionary)
                 for b in range(part.num_partitions)]
             halo_writers: dict = {}
         out_part_rows = chunk_rows if coalesce is None else \
             max(1, -(-total_rows // max(coalesce, 1)))
         out = DatasetWriter(output_path, part_rows=out_part_rows,
-                            compression=compression)
+                            compression=compression, page_size=page_size,
+                            use_dictionary=use_dictionary,
+                            row_group_bytes=row_group_bytes)
         for table in timed_chunks(reread(), "p3-decode"):
             if bqsr:
                 with stage("p3-pack"):
@@ -472,7 +479,8 @@ def streaming_transform(input_path: str, output_path: str, *,
                 if realign:
                     _route_halo(table, bins, part, f_mapped & (refid >= 0),
                                 refid, start, halo_writers, workdir,
-                                bin_part_rows, compression)
+                                bin_part_rows, compression, page_size,
+                                use_dictionary)
 
         # ---- pass 4: per-bin realign/sort through the merge window --------
         if binned:
@@ -486,7 +494,8 @@ def streaming_transform(input_path: str, output_path: str, *,
                 _emit_bins(out, bin_writers,
                            halo_writers if realign else {}, part,
                            chunk_rows, budget, realign, sort,
-                           compression=compression)
+                           compression=compression, page_size=page_size,
+                            use_dictionary=use_dictionary)
         out.close()
         return total_rows
     finally:
@@ -497,7 +506,8 @@ def streaming_transform(input_path: str, output_path: str, *,
 
 
 def _route_halo(table, bins, part, mapped_ok, refid, start, halo_writers,
-                workdir, part_rows, compression):
+                workdir, part_rows, compression, page_size=None,
+                use_dictionary=True):
     """Duplicate reads near a bin edge into the neighbor bins' halo sets
     (the rod-bucket trick, AdamRDDFunctions.scala:175-183): any bin whose
     range a read's ±halo window touches gets a copy, so edge-straddling
@@ -531,7 +541,8 @@ def _route_halo(table, bins, part, mapped_ok, refid, start, halo_writers,
         if w is None:
             w = halo_writers[int(b2)] = DatasetWriter(
                 os.path.join(workdir, f"halo-{int(b2):05d}"),
-                part_rows=part_rows, compression=compression)
+                part_rows=part_rows, compression=compression, page_size=page_size,
+                            use_dictionary=use_dictionary)
         w.write(table.take(pa.array(sel)))
 
 
@@ -553,7 +564,8 @@ def _flat_of_table(table: pa.Table, part) -> np.ndarray:
 
 def _process_mapped_bin(path, halo_path, part, rows, chunk_rows, budget,
                         realign, sort, next_lo, workdir_b,
-                        compression="zstd"):
+                        compression="zstd", page_size=None,
+                        use_dictionary=True):
     """Yield (processed_table, next_lower_flat) for one mapped bin,
     splitting bins over ``budget`` rows into position sub-ranges first."""
     from ..io.parquet import DatasetWriter, iter_tables, load_table
@@ -585,10 +597,12 @@ def _process_mapped_bin(path, halo_path, part, rows, chunk_rows, budget,
     highs = np.concatenate([cuts, [np.iinfo(np.int64).max]])
     W = _REALIGN_HALO
     sub_own = [DatasetWriter(os.path.join(workdir_b, f"sub-{i:03d}"),
-                             part_rows=budget, compression=compression)
+                             part_rows=budget, compression=compression, page_size=page_size,
+                            use_dictionary=use_dictionary)
                for i in range(len(lows))]
     sub_halo = [DatasetWriter(os.path.join(workdir_b, f"subhalo-{i:03d}"),
-                              part_rows=budget, compression=compression)
+                              part_rows=budget, compression=compression, page_size=page_size,
+                            use_dictionary=use_dictionary)
                 for i in range(len(lows))] if realign else []
 
     def route(tbl, is_halo_source):
@@ -629,7 +643,8 @@ def _process_mapped_bin(path, halo_path, part, rows, chunk_rows, budget,
 
 
 def _emit_bins(out, bin_writers, halo_writers, part, chunk_rows, budget,
-               realign, sort, compression="zstd"):
+               realign, sort, compression="zstd", page_size=None,
+               use_dictionary=True):
     """Pass 4 driver: process mapped bins in genome order, emitting sorted
     output through a merge window — realignment can move a read up to the
     halo width across a bin edge, so rows only emit once no later bin can
@@ -677,7 +692,8 @@ def _emit_bins(out, bin_writers, halo_writers, part, chunk_rows, budget,
             for tbl, nxt in _process_mapped_bin(
                     w.path, halo_path, part, w.rows_written, chunk_rows,
                     budget, realign, sort, next_lo, workdir_b,
-                    compression=compression):
+                    compression=compression, page_size=page_size,
+                            use_dictionary=use_dictionary):
                 if sort:
                     emit_sorted(tbl, nxt)
                 else:

@@ -122,3 +122,30 @@ def test_listdict(resources, capsys):
 def test_unknown_input_gives_error_not_traceback(tmp_path, capsys):
     rc = main(["flagstat", str(tmp_path / "nope.sam")])
     assert rc == 2
+
+
+def test_bam2adam_samtools_validation(tmp_path, resources, capsys):
+    """-samtools_validation: lenient drops malformed records with a stderr
+    warning (reference default, Bam2Adam.scala:46-47); strict raises a
+    FormatError-backed exit."""
+    import pytest
+    from adam_tpu.cli.main import main
+
+    good = (resources / "small.sam").read_text()
+    bad = tmp_path / "bad.sam"
+    lines = good.splitlines(keepends=True)
+    body_at = next(i for i, ln in enumerate(lines)
+                   if not ln.startswith("@"))
+    lines.insert(body_at + 1, "broken\trecord\n")  # 2 fields, flag not int
+    bad.write_text("".join(lines))
+
+    out = tmp_path / "out.adam"
+    rc = main(["bam2adam", str(bad), str(out)])  # default: lenient
+    assert rc == 0
+    assert "wrote 20 reads" in capsys.readouterr().out  # bad row dropped
+
+    rc = main(["bam2adam", str(bad), str(tmp_path / "out2.adam"),
+               "-samtools_validation", "strict"])
+    assert rc != 0  # FormatError -> one-line CLI error, nonzero exit
+    err = capsys.readouterr().err
+    assert "malformed SAM record" in err

@@ -44,16 +44,9 @@ def probe_scan_knee():
 
     L, n_rg = 100, 4
     rt = RecalTable(n_read_groups=n_rg, max_read_len=L)
-    rng = np.random.RandomState(0)
     for n_blocks in (16, 64, 256):
         n = 512 * n_blocks
-        args = (jnp.asarray(rng.randint(0, 4, (n, L)).astype(np.int8)),
-                jnp.asarray(rng.randint(2, 41, (n, L)).astype(np.int8)),
-                jnp.full((n,), L, jnp.int32),
-                jnp.zeros((n,), jnp.int32),
-                jnp.asarray(rng.randint(0, n_rg, n).astype(np.int32)),
-                jnp.asarray(rng.randint(0, 3, (n, L)).astype(np.int8)),
-                jnp.ones((n,), bool))
+        args = _count_args(n, L, n_rg)
         t0 = t()
         out = _count_kernel_matmul(*args, n_qual_rg=rt.n_qual_rg,
                                    n_cycle=rt.n_cycle)

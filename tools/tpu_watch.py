@@ -66,9 +66,24 @@ def main() -> int:
             continue
         doc["captured_at"] = time.strftime("%Y-%m-%dT%H:%M:%SZ",
                                            time.gmtime())
-        with open(os.path.join(repo, args.out), "w") as f:
-            json.dump(doc, f, indent=1)
         got_tpu = doc.get("platform") == "tpu"
+        out_path = os.path.join(repo, args.out)
+        # never clobber a captured TPU artifact with a CPU-fallback one (a
+        # tunnel flap mid-bench would otherwise destroy the very evidence
+        # this tool exists to preserve)
+        keep = False
+        if not got_tpu and os.path.exists(out_path):
+            try:
+                with open(out_path) as f:
+                    keep = json.load(f).get("platform") == "tpu"
+            except ValueError:
+                pass
+        if keep:
+            print("bench fell back to CPU; keeping existing TPU artifact",
+                  flush=True)
+        else:
+            with open(out_path, "w") as f:
+                json.dump(doc, f, indent=1)
         print(f"captured platform={doc.get('platform')} "
               f"flagstat={doc.get('value')}", flush=True)
         if got_tpu and args.once:
