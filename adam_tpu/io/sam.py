@@ -119,8 +119,15 @@ def open_sam_stream(path_or_file, chunk_rows: int = 1 << 20,
     (the whole-file :func:`read_sam` is this stream concatenated).
     ``stringency`` follows samtools semantics (Bam2Adam.scala:46-47):
     strict raises on a malformed record, lenient warns and drops it,
-    silent drops it quietly.
+    silent drops it quietly; the level is validated here, up front, not
+    at the first malformed record.
     """
+    from ..errors import ValidationStringency
+    if stringency not in (ValidationStringency.STRICT,
+                          ValidationStringency.LENIENT,
+                          ValidationStringency.SILENT):
+        raise ValueError(f"unknown validation stringency {stringency!r} "
+                         "(want strict/lenient/silent)")
     close = False
     if hasattr(path_or_file, "read"):
         f = path_or_file

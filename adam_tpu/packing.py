@@ -124,16 +124,31 @@ def _string_column_to_padded(col: pa.ChunkedArray, n_rows: int, pad_to: int,
     lens_full[:len(arr)] = lens
     if data.size == 0:
         return out, lens_full
-    pos = np.arange(L, dtype=np.int64)[None, :]
-    mask = pos < lens[:len(arr), None]
-    # gather source byte for every (row, pos), clipped into range; one
-    # int8 LUT pass decodes AND offsets, and the padded region overwrites
-    # via a single where — no boolean fancy-indexing round trips
-    src = np.minimum(offsets[:-1, None].astype(np.int64) + pos,
-                     max(data.size - 1, 0))
-    vals = data[src]
     lut8 = lut if offset == 0 else _OFFSET_LUTS[offset]
-    out[:len(arr)] = np.where(mask, lut8[vals], pad_value)
+    # dense fast path: every row the same length Lc with contiguous
+    # offsets (the fixed-read-length norm for sequencer output) — the
+    # Arrow data buffer IS the [n, Lc] byte matrix, so one reshape + LUT
+    # replaces the (row, pos) gather and its [n, L] index intermediate
+    n_arr = len(arr)
+    Lc = int(lens[0]) if n_arr else 0
+    if (Lc > 0 and not arr.null_count and data.size == n_arr * Lc and
+            int(offsets[0]) == 0 and int(offsets[-1]) == data.size and
+            bool((lens == Lc).all())):
+        out[:n_arr, :Lc] = lut8[data.reshape(n_arr, Lc)]
+        return out, lens_full
+    pos = np.arange(L, dtype=np.int32)[None, :]
+    mask = pos < lens[:n_arr, None]
+    # gather source byte for every (row, pos); one int8 LUT pass decodes
+    # AND offsets, and the padded region overwrites via a single where.
+    # int32 indices suffice (Arrow string offsets are int32) and halve the
+    # index-matrix traffic — but the position must clamp to the row's own
+    # last byte BEFORE the add: offset + raw pos could pass 2^31 on a
+    # near-2GB chunk and wrap negative.
+    pos_in_row = np.minimum(pos, np.maximum(lens[:n_arr, None] - 1, 0))
+    src = np.minimum(offsets[:-1, None] + pos_in_row,
+                     np.int32(max(data.size - 1, 0)))
+    vals = data[src]
+    out[:n_arr] = np.where(mask, lut8[vals], pad_value)
     return out, lens_full
 
 

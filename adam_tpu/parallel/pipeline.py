@@ -289,6 +289,12 @@ def streaming_transform(input_path: str, output_path: str, *,
     from .partitioner import GenomicRegionPartitioner
     from .. import schema as S
 
+    # one bundle for every DatasetWriter this run constructs (spills, bins,
+    # halos, subs, output) — the next knob gets added HERE, not at eight
+    # call sites; row_group_bytes applies to the output writer alone
+    wopts = dict(compression=compression, page_size=page_size,
+                 use_dictionary=use_dictionary)
+
     def timed_chunks(it, name):
         """Attribute the iterator's own work (format decode / parquet scan)
         to a named stage, chunk by chunk."""
@@ -330,8 +336,7 @@ def streaming_transform(input_path: str, output_path: str, *,
         keys = _MarkdupKeys(mesh) if markdup else None
         seq_seen: dict = {}
         raw_writer = None if is_parquet else DatasetWriter(
-            raw_path, part_rows=chunk_rows, compression=compression, page_size=page_size,
-                            use_dictionary=use_dictionary)
+            raw_path, part_rows=chunk_rows, **wopts)
         total_rows = 0
         max_rgid = -1
         bucket_len = 0
@@ -440,17 +445,13 @@ def streaming_transform(input_path: str, output_path: str, *,
             bin_part_rows = max(chunk_rows // n_bins, 1 << 14)
             bin_writers = [
                 DatasetWriter(os.path.join(workdir, f"bin-{b:05d}"),
-                              part_rows=bin_part_rows,
-                              compression=compression, page_size=page_size,
-                            use_dictionary=use_dictionary)
+                              part_rows=bin_part_rows, **wopts)
                 for b in range(part.num_partitions)]
             halo_writers: dict = {}
         out_part_rows = chunk_rows if coalesce is None else \
             max(1, -(-total_rows // max(coalesce, 1)))
         out = DatasetWriter(output_path, part_rows=out_part_rows,
-                            compression=compression, page_size=page_size,
-                            use_dictionary=use_dictionary,
-                            row_group_bytes=row_group_bytes)
+                            row_group_bytes=row_group_bytes, **wopts)
         for table in timed_chunks(reread(), "p3-decode"):
             if bqsr:
                 with stage("p3-pack"):
@@ -479,8 +480,7 @@ def streaming_transform(input_path: str, output_path: str, *,
                 if realign:
                     _route_halo(table, bins, part, f_mapped & (refid >= 0),
                                 refid, start, halo_writers, workdir,
-                                bin_part_rows, compression, page_size,
-                                use_dictionary)
+                                bin_part_rows, wopts)
 
         # ---- pass 4: per-bin realign/sort through the merge window --------
         if binned:
@@ -493,9 +493,7 @@ def streaming_transform(input_path: str, output_path: str, *,
             with stage("p4-bins", sync=True):
                 _emit_bins(out, bin_writers,
                            halo_writers if realign else {}, part,
-                           chunk_rows, budget, realign, sort,
-                           compression=compression, page_size=page_size,
-                            use_dictionary=use_dictionary)
+                           chunk_rows, budget, realign, sort, wopts)
         out.close()
         return total_rows
     finally:
@@ -506,8 +504,7 @@ def streaming_transform(input_path: str, output_path: str, *,
 
 
 def _route_halo(table, bins, part, mapped_ok, refid, start, halo_writers,
-                workdir, part_rows, compression, page_size=None,
-                use_dictionary=True):
+                workdir, part_rows, wopts):
     """Duplicate reads near a bin edge into the neighbor bins' halo sets
     (the rod-bucket trick, AdamRDDFunctions.scala:175-183): any bin whose
     range a read's ±halo window touches gets a copy, so edge-straddling
@@ -541,8 +538,7 @@ def _route_halo(table, bins, part, mapped_ok, refid, start, halo_writers,
         if w is None:
             w = halo_writers[int(b2)] = DatasetWriter(
                 os.path.join(workdir, f"halo-{int(b2):05d}"),
-                part_rows=part_rows, compression=compression, page_size=page_size,
-                            use_dictionary=use_dictionary)
+                part_rows=part_rows, **wopts)
         w.write(table.take(pa.array(sel)))
 
 
@@ -563,9 +559,7 @@ def _flat_of_table(table: pa.Table, part) -> np.ndarray:
 
 
 def _process_mapped_bin(path, halo_path, part, rows, chunk_rows, budget,
-                        realign, sort, next_lo, workdir_b,
-                        compression="zstd", page_size=None,
-                        use_dictionary=True):
+                        realign, sort, next_lo, workdir_b, wopts):
     """Yield (processed_table, next_lower_flat) for one mapped bin,
     splitting bins over ``budget`` rows into position sub-ranges first."""
     from ..io.parquet import DatasetWriter, iter_tables, load_table
@@ -597,12 +591,10 @@ def _process_mapped_bin(path, halo_path, part, rows, chunk_rows, budget,
     highs = np.concatenate([cuts, [np.iinfo(np.int64).max]])
     W = _REALIGN_HALO
     sub_own = [DatasetWriter(os.path.join(workdir_b, f"sub-{i:03d}"),
-                             part_rows=budget, compression=compression, page_size=page_size,
-                            use_dictionary=use_dictionary)
+                             part_rows=budget, **wopts)
                for i in range(len(lows))]
     sub_halo = [DatasetWriter(os.path.join(workdir_b, f"subhalo-{i:03d}"),
-                              part_rows=budget, compression=compression, page_size=page_size,
-                            use_dictionary=use_dictionary)
+                              part_rows=budget, **wopts)
                 for i in range(len(lows))] if realign else []
 
     def route(tbl, is_halo_source):
@@ -643,8 +635,7 @@ def _process_mapped_bin(path, halo_path, part, rows, chunk_rows, budget,
 
 
 def _emit_bins(out, bin_writers, halo_writers, part, chunk_rows, budget,
-               realign, sort, compression="zstd", page_size=None,
-               use_dictionary=True):
+               realign, sort, wopts):
     """Pass 4 driver: process mapped bins in genome order, emitting sorted
     output through a merge window — realignment can move a read up to the
     halo width across a bin edge, so rows only emit once no later bin can
@@ -691,9 +682,7 @@ def _emit_bins(out, bin_writers, halo_writers, part, chunk_rows, budget,
         try:
             for tbl, nxt in _process_mapped_bin(
                     w.path, halo_path, part, w.rows_written, chunk_rows,
-                    budget, realign, sort, next_lo, workdir_b,
-                    compression=compression, page_size=page_size,
-                            use_dictionary=use_dictionary):
+                    budget, realign, sort, next_lo, workdir_b, wopts):
                 if sort:
                     emit_sorted(tbl, nxt)
                 else:

@@ -92,3 +92,43 @@ def test_snptable_ragged_rows_fall_back_loudly(tmp_path):
     assert any("fast path failed" in str(x.message) for x in w)
     assert t.mask("chr1", np.array([99, 199])).all()
     assert t.mask("chr2", np.array([299])).all()
+
+
+def test_string_pack_dense_fast_path_matches_general():
+    """Uniform-length columns take the reshape+LUT fast path; the result
+    must be byte-identical to the ragged gather path (forced by mixing
+    one shorter row in)."""
+    import numpy as np
+    import pyarrow as pa
+    from adam_tpu.packing import pack_reads
+
+    def tbl(seqs):
+        n = len(seqs)
+        return pa.table({
+            "flags": pa.array(np.zeros(n, np.int32), pa.int32()),
+            "referenceId": pa.array(np.zeros(n, np.int32), pa.int32()),
+            "start": pa.array(np.arange(n, dtype=np.int64), pa.int64()),
+            "mapq": pa.array(np.full(n, 60, np.int32), pa.int32()),
+            "mateReferenceId": pa.array(np.zeros(n, np.int32), pa.int32()),
+            "mateAlignmentStart": pa.array(np.zeros(n, np.int64),
+                                           pa.int64()),
+            "recordGroupId": pa.array(np.zeros(n, np.int32), pa.int32()),
+            "sequence": pa.array(seqs),
+            "qual": pa.array(["I" * len(s) for s in seqs]),
+            "cigar": pa.array([f"{len(s)}M" for s in seqs]),
+        })
+
+    dense = ["ACGTACGT"] * 5
+    b_dense = pack_reads(tbl(dense), bucket_len=16)
+    ragged = dense + ["ACG"]          # one short row forces the gather path
+    b_ragged = pack_reads(tbl(ragged), bucket_len=16)
+    assert np.array_equal(np.asarray(b_dense.bases)[:5],
+                          np.asarray(b_ragged.bases)[:5])
+    assert np.array_equal(np.asarray(b_dense.quals)[:5],
+                          np.asarray(b_ragged.quals)[:5])
+    assert np.asarray(b_ragged.read_len)[5] == 3
+    # a sliced (offset != 0) column must not take the dense path blindly
+    sl = tbl(ragged).slice(1)
+    b_sl = pack_reads(sl, bucket_len=16)
+    assert np.array_equal(np.asarray(b_sl.bases)[:4],
+                          np.asarray(b_ragged.bases)[1:5])
