@@ -20,6 +20,7 @@ import sys
 def main() -> None:
     coordinator, num_processes, process_id = (
         sys.argv[1], int(sys.argv[2]), int(sys.argv[3]))
+    shard_paths = sys.argv[4:]          # flagstat mode: one SAM per process
 
     from adam_tpu.platform import force_cpu
     force_cpu(n_devices=2)
@@ -57,6 +58,33 @@ def main() -> None:
     expect = sum(p * 100 + d for p in range(num_processes) for d in range(2))
     assert total == expect, (total, expect)
     print(f"DCN_OK {num_processes} {total}", flush=True)
+
+    if shard_paths:
+        # real multi-host flagstat: each process ingests ITS OWN file shard
+        # through the product path (SAM decode -> wire pack -> device
+        # kernel), then the 18x2 counter blocks reduce across processes —
+        # the reference's executor map + driver aggregate
+        # (FlagStat.scala:85-114) across genuine process boundaries.
+        from jax.experimental import multihost_utils
+        from adam_tpu.io.sam import read_sam
+        from adam_tpu.ops.flagstat import flagstat_kernel_wire32
+        from adam_tpu.parallel.pipeline import _wire32_from_table
+
+        table, _, _ = read_sam(shard_paths[process_id])
+        wire = _wire32_from_table(table)
+        local_counts = np.asarray(
+            jax.jit(flagstat_kernel_wire32)(jnp.asarray(wire)))
+        summed = multihost_utils.process_allgather(local_counts)
+        global_counts = summed.reshape(num_processes, 18, 2).sum(axis=0)
+
+        # oracle: the whole file sequentially in this same process
+        whole = [np.asarray(jax.jit(flagstat_kernel_wire32)(
+            jnp.asarray(_wire32_from_table(read_sam(p_)[0]))))
+            for p_ in shard_paths]
+        expect_counts = np.sum(whole, axis=0)
+        assert np.array_equal(global_counts, expect_counts), (
+            global_counts.tolist(), expect_counts.tolist())
+        print(f"DCNFS_OK {int(global_counts[0, 0])}", flush=True)
 
 
 if __name__ == "__main__":

@@ -30,13 +30,13 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-@pytest.mark.skipif(os.environ.get("ADAM_TPU_SKIP_MULTIPROC") == "1",
-                    reason="multi-process smoke disabled by env")
-def test_two_process_psum_over_loopback():
+def _run_two_workers(extra_args, timeout, fail_msg):
+    """Spawn the DCN worker twice over loopback and return both outputs.
+
+    Workers force their own platform/device count; inherited XLA flags are
+    scrubbed so the parent test session's settings don't leak in."""
     coordinator = f"127.0.0.1:{_free_port()}"
     env = dict(os.environ)
-    # workers force their own platform/device count; scrub inherited flags
-    # so the parent test session's settings don't leak in
     env.pop("XLA_FLAGS", None)
     env["JAX_PLATFORMS"] = "cpu"
     # `python tests/_dcn_worker.py` puts tests/ on sys.path, not the repo
@@ -44,7 +44,8 @@ def test_two_process_psum_over_loopback():
     env["PYTHONPATH"] = root + os.pathsep + env.get("PYTHONPATH", "")
     procs = [
         subprocess.Popen(
-            [sys.executable, WORKER, coordinator, "2", str(pid)],
+            [sys.executable, WORKER, coordinator, "2", str(pid)]
+            + list(extra_args),
             stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
             env=env)
         for pid in range(2)
@@ -52,12 +53,45 @@ def test_two_process_psum_over_loopback():
     outs = []
     try:
         for p in procs:
-            out, err = p.communicate(timeout=180)
+            out, err = p.communicate(timeout=timeout)
             outs.append((p.returncode, out, err))
     except subprocess.TimeoutExpired:
         for p in procs:
             p.kill()
-        pytest.fail("two-process join timed out (coordination hang)")
+        pytest.fail(fail_msg)
     for rc, out, err in outs:
         assert rc == 0, f"worker failed rc={rc}\nstdout:{out}\nstderr:{err}"
+    return outs
+
+
+@pytest.mark.skipif(os.environ.get("ADAM_TPU_SKIP_MULTIPROC") == "1",
+                    reason="multi-process smoke disabled by env")
+def test_two_process_psum_over_loopback():
+    outs = _run_two_workers(
+        [], 180, "two-process join timed out (coordination hang)")
+    for _rc, out, _err in outs:
         assert "DCN_OK 2 202" in out, out
+
+
+@pytest.mark.skipif(os.environ.get("ADAM_TPU_SKIP_MULTIPROC") == "1",
+                    reason="multi-process smoke disabled by env")
+def test_two_process_file_sharded_flagstat(tmp_path):
+    """Each process ingests its own SAM shard through the product path and
+    the counters reduce across processes — equal to the whole-file oracle
+    (the reference's executor map + driver aggregate, FlagStat.scala:85-114,
+    across real process boundaries)."""
+    src = os.path.join(os.path.dirname(__file__), "resources",
+                       "unmapped.sam")
+    lines = open(src).read().splitlines(keepends=True)
+    header = [ln for ln in lines if ln.startswith("@")]
+    body = [ln for ln in lines if not ln.startswith("@")]
+    shards = []
+    for i in range(2):
+        p = tmp_path / f"shard{i}.sam"
+        p.write_text("".join(header + body[i::2]))
+        shards.append(str(p))
+
+    outs = _run_two_workers(
+        shards, 240, "two-process file-sharded flagstat timed out")
+    for _rc, out, _err in outs:
+        assert "DCNFS_OK 200" in out, out  # 200 reads total across shards
