@@ -147,9 +147,9 @@ class TransformCommand(Command):
 
     def run(self, args) -> int:
         sam_out = args.output.endswith(".sam")
-        # -checkpoint_dir keeps the in-memory staged path (the streaming
-        # pipeline has its own spill discipline but no resume yet); never
-        # silently drop a requested checkpoint
+        # -checkpoint_dir alone keeps the in-memory staged path (stage
+        # tables in Parquet); with -stream it selects the streaming
+        # pass-level resume (workdir = checkpoint dir)
         auto_stream = (not sam_out and not args.checkpoint_dir and
                        os.path.exists(args.input) and
                        not os.path.isdir(args.input) and
@@ -159,10 +159,11 @@ class TransformCommand(Command):
                 raise SystemExit(
                     "transform -stream writes Parquet datasets; "
                     "convert with adam-tpu transform OUT.sam afterwards")
-            if args.checkpoint_dir:
+            if args.checkpoint_dir and args.workdir and \
+                    args.checkpoint_dir != args.workdir:
                 raise SystemExit(
-                    "transform -stream does not support -checkpoint_dir "
-                    "yet; drop one of the two flags")
+                    "-checkpoint_dir IS the streaming workdir; drop "
+                    "-workdir or make them equal")
             from ..models.snptable import SnpTable
             from ..parallel.pipeline import streaming_transform
             if args.timing:
@@ -176,12 +177,14 @@ class TransformCommand(Command):
                 markdup=args.mark_duplicate_reads,
                 bqsr=args.recalibrate_base_qualities, snp_table=snp,
                 realign=args.realignIndels, sort=args.sort_reads,
-                workdir=args.workdir, chunk_rows=args.stream_chunk_rows,
+                workdir=args.checkpoint_dir or args.workdir,
+                chunk_rows=args.stream_chunk_rows,
                 coalesce=args.coalesce,
                 compression=pw["compression"] or "none",
                 page_size=pw["page_size"],
                 use_dictionary=pw["use_dictionary"],
-                row_group_bytes=args.parquet_block_size)
+                row_group_bytes=args.parquet_block_size,
+                resume=bool(args.checkpoint_dir))
             if args.timing:
                 from ..instrument import report
                 print(report().format())

@@ -174,6 +174,125 @@ def _apply_dup_bits(table: pa.Table, dup: np.ndarray) -> pa.Table:
                             pa.array(new.astype(np.uint32), pa.uint32()))
 
 
+class _BinStub:
+    """Stand-in for a closed DatasetWriter when pass 4 resumes from a
+    checkpoint: _emit_bins/_process_mapped_bin only consume ``path`` and
+    ``rows_written``."""
+
+    def __init__(self, path: str, rows_written: int):
+        self.path = path
+        self.rows_written = rows_written
+
+
+def _snp_digest(snp_table) -> str:
+    """Content digest of the BQSR known-sites mask for the resume
+    fingerprint: a checkpointed RecalTable counted against a different
+    dbSNP mask must not be reused (the mask changes which bases count)."""
+    if snp_table is None:
+        return "none"
+    import hashlib
+
+    h = hashlib.sha256()
+    for contig in sorted(snp_table._by_contig):
+        h.update(contig.encode())
+        h.update(snp_table._by_contig[contig].tobytes())
+    return h.hexdigest()[:16]
+
+
+class _StreamCheckpoint:
+    """Pass-level resume manifest for :func:`streaming_transform`.
+
+    The in-memory pipeline checkpoints whole stage TABLES
+    (checkpoint.CheckpointDir); the streaming pipeline's state between
+    passes is already durable Parquet in the workdir (raw spill, genome
+    bins, halos) plus three compact artifacts — the markdup dup bits, the
+    RecalTable, and the run metadata.  So resume here is a manifest that
+    records which passes completed for WHICH (input, config) fingerprint,
+    the compact artifacts beside it, and pre-pass cleanup of any
+    half-written artifacts from a crashed attempt.  Markers write via
+    tmp+rename, so a crash mid-mark is invisible (same discipline as
+    checkpoint.py).
+    """
+
+    MANIFEST = "stream_checkpoint.json"
+
+    def __init__(self, workdir: str, fingerprint: str):
+        import json
+
+        self.dir = workdir
+        self.path = os.path.join(workdir, self.MANIFEST)
+        self.state = {"fingerprint": fingerprint, "passes": {}}
+        if os.path.exists(self.path):
+            try:
+                with open(self.path) as f:
+                    prev = json.load(f)
+            except ValueError:
+                prev = None
+            if prev and prev.get("fingerprint") == fingerprint:
+                self.state = prev
+            else:
+                # a different input/config owns these artifacts: refusing
+                # beats silently destroying another run's (possibly
+                # multi-hour) resume state — same contract as the
+                # in-memory CheckpointDir (checkpoint.py:51-77)
+                raise ValueError(
+                    f"checkpoint dir {workdir!r} belongs to a different "
+                    "transform (input/flags changed or manifest corrupt); "
+                    "delete it or use another -checkpoint_dir")
+
+    @staticmethod
+    def fingerprint(input_path: str, output_path: str, config: dict) -> str:
+        import hashlib
+        import json
+
+        parts = [os.path.abspath(input_path), os.path.abspath(output_path),
+                 json.dumps(config, sort_keys=True)]
+        try:
+            st = os.stat(input_path)
+            parts.append(f"{st.st_size}:{st.st_mtime_ns}")
+        except OSError:
+            pass
+        return hashlib.sha256("\x00".join(parts).encode()).hexdigest()[:16]
+
+    def has(self, name: str) -> bool:
+        return name in self.state["passes"]
+
+    def meta(self, name: str) -> dict:
+        return self.state["passes"][name]
+
+    def mark(self, name: str, **meta) -> None:
+        import json
+
+        self.state["passes"][name] = meta
+        tmp = self.path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(self.state, f)
+        os.replace(tmp, self.path)
+
+    def save_array(self, name: str, arr) -> None:
+        np.save(os.path.join(self.dir, name + ".npy"), arr)
+
+    def load_array(self, name: str):
+        return np.load(os.path.join(self.dir, name + ".npy"))
+
+    def save_arrays(self, name: str, **arrays) -> None:
+        np.savez(os.path.join(self.dir, name + ".npz"), **arrays)
+
+    def load_arrays(self, name: str):
+        return np.load(os.path.join(self.dir, name + ".npz"))
+
+    def clean_unless(self, marker: str, *glob_patterns: str) -> None:
+        """Remove artifacts of an uncompleted pass (crashed half-writes)."""
+        import glob as _glob
+
+        if self.has(marker):
+            return
+        for pat in glob_patterns:
+            for full in _glob.glob(os.path.join(self.dir, pat)):
+                shutil.rmtree(full, ignore_errors=True) \
+                    if os.path.isdir(full) else os.unlink(full)
+
+
 class _MarkdupKeys:
     """Per-chunk compact markdup key accumulator (~42 bytes/read).
 
@@ -240,7 +359,8 @@ def streaming_transform(input_path: str, output_path: str, *,
                         compression: str = "zstd",
                         page_size: Optional[int] = None,
                         use_dictionary: bool = True,
-                        row_group_bytes: Optional[int] = None) -> int:
+                        row_group_bytes: Optional[int] = None,
+                        resume: bool = False) -> int:
     """The ``transform`` pipeline over a chunked stream and a device mesh.
 
     Multi-pass, like the reference's shuffle stages (Transform.scala:62-97):
@@ -330,16 +450,48 @@ def streaming_transform(input_path: str, output_path: str, *,
                       input_path.endswith(".bam"))
     raw_path = input_path if is_parquet else os.path.join(workdir, "raw")
 
+    ck = None
+    if resume:
+        if own_workdir:
+            raise ValueError(
+                "streaming resume needs a persistent workdir "
+                "(pass workdir=/checkpoint dir)")
+        fp = _StreamCheckpoint.fingerprint(input_path, output_path, dict(
+            markdup=markdup, bqsr=bqsr, realign=realign, sort=sort,
+            chunk_rows=chunk_rows, n_bins=n_bins, coalesce=coalesce,
+            max_bin_rows=max_bin_rows, snp=_snp_digest(snp_table)))
+        ck = _StreamCheckpoint(workdir, fp)
+        if ck.has("done") and os.path.isdir(output_path) and any(
+                f.endswith(".parquet") for f in os.listdir(output_path)):
+            return ck.meta("done")["total_rows"]
+
     try:
         # ---- pass 1: ingest ------------------------------------------------
-        stream = open_read_stream(input_path, chunk_rows=chunk_rows)
-        keys = _MarkdupKeys(mesh) if markdup else None
+        from ..models.dictionary import SequenceRecord
+        if ck is not None and ck.has("p1"):
+            m1 = ck.meta("p1")
+            total_rows = m1["total_rows"]
+            max_rgid = m1["max_rgid"]
+            bucket_len = m1["bucket_len"]
+            seq_dict = SequenceDictionary(
+                SequenceRecord(i, nm, ln or 0, u)
+                for i, nm, ln, u in m1["seq_records"])
+            dup = ck.load_array("dup") if m1["has_dup"] else None
+            p1_skipped = True
+        else:
+            p1_skipped = False
+        if ck is not None and not p1_skipped:
+            ck.clean_unless("p1", "raw", "dup.npy")
+        stream = [] if p1_skipped else \
+            open_read_stream(input_path, chunk_rows=chunk_rows)
+        keys = _MarkdupKeys(mesh) if (markdup and not p1_skipped) else None
         seq_seen: dict = {}
-        raw_writer = None if is_parquet else DatasetWriter(
+        raw_writer = None if (is_parquet or p1_skipped) else DatasetWriter(
             raw_path, part_rows=chunk_rows, **wopts)
-        total_rows = 0
-        max_rgid = -1
-        bucket_len = 0
+        if not p1_skipped:
+            total_rows = 0
+            max_rgid = -1
+            bucket_len = 0
         for table in timed_chunks(stream, "p1-decode"):
             total_rows += table.num_rows
             max_rgid = max(max_rgid,
@@ -366,10 +518,18 @@ def streaming_transform(input_path: str, output_path: str, *,
                         keys.add_chunk(table, batch)
         if raw_writer is not None:
             raw_writer.close()
-        seq_dict = stream.seq_dict or SequenceDictionary(seq_seen.values())
-
-        with stage("markdup-decide"):
-            dup = keys.decide() if keys is not None else None
+        if not p1_skipped:
+            seq_dict = stream.seq_dict or \
+                SequenceDictionary(seq_seen.values())
+            with stage("markdup-decide"):
+                dup = keys.decide() if keys is not None else None
+            if ck is not None:
+                if dup is not None:
+                    ck.save_array("dup", dup)
+                ck.mark("p1", total_rows=total_rows, max_rgid=max_rgid,
+                        bucket_len=bucket_len, has_dup=dup is not None,
+                        seq_records=[[r.id, r.name, r.length, r.url]
+                                     for r in seq_dict])
 
         def reread():
             offset = 0
@@ -386,7 +546,16 @@ def streaming_transform(input_path: str, output_path: str, *,
         # of chunk i; one bounded sync every few chunks caps the in-flight
         # queue.  The RecalTable materializes once at pass end.
         rt = None
-        if bqsr:
+        if bqsr and ck is not None and ck.has("p2"):
+            z = ck.load_arrays("recal")
+            rt = RecalTable(
+                n_read_groups=int(z["n_read_groups"]),
+                max_read_len=int(z["max_read_len"]),
+                qual_obs=z["qual_obs"], qual_mm=z["qual_mm"],
+                cycle_obs=z["cycle_obs"], cycle_mm=z["cycle_mm"],
+                ctx_obs=z["ctx_obs"], ctx_mm=z["ctx_mm"],
+                expected_mismatch=float(z["expected_mismatch"]))
+        elif bqsr:
 
             from ..bqsr.recalibrate import (count_tables_device,
                                             tables_to_recal)
@@ -434,25 +603,59 @@ def streaming_transform(input_path: str, output_path: str, *,
                 with stage("p2-bqsr-count", sync=True):
                     rt = tables_to_recal(host_acc, n_rg_run,
                                          bucket_len or 1)
+            if ck is not None:
+                ck.save_arrays(
+                    "recal", n_read_groups=rt.n_read_groups,
+                    max_read_len=rt.max_read_len, qual_obs=rt.qual_obs,
+                    qual_mm=rt.qual_mm, cycle_obs=rt.cycle_obs,
+                    cycle_mm=rt.cycle_mm, ctx_obs=rt.ctx_obs,
+                    ctx_mm=rt.ctx_mm,
+                    expected_mismatch=rt.expected_mismatch)
+                ck.mark("p2")
 
         # ---- pass 3: emit / route to bins ---------------------------------
         binned = sort or realign
+        p3_skipped = binned and ck is not None and ck.has("p3")
+        if p3_skipped:
+            # the resolved bin count depends on mesh.size when defaulted;
+            # a resume on different hardware must honor the count the
+            # checkpointed bins were actually routed with
+            n_bins = ck.meta("p3")["n_bins"]
         if binned:
             if n_bins is None:
                 n_bins = max(int(np.ceil(total_rows / max(chunk_rows, 1))),
                              mesh.size)
             part = GenomicRegionPartitioner.from_dictionary(n_bins, seq_dict)
             bin_part_rows = max(chunk_rows // n_bins, 1 << 14)
-            bin_writers = [
-                DatasetWriter(os.path.join(workdir, f"bin-{b:05d}"),
-                              part_rows=bin_part_rows, **wopts)
-                for b in range(part.num_partitions)]
-            halo_writers: dict = {}
+            if p3_skipped:
+                m3 = ck.meta("p3")
+                bin_writers = [
+                    _BinStub(os.path.join(workdir, f"bin-{b:05d}"), r)
+                    for b, r in enumerate(m3["bin_rows"])]
+                halo_writers = {
+                    int(b): _BinStub(
+                        os.path.join(workdir, f"halo-{int(b):05d}"), r)
+                    for b, r in m3["halo_rows"].items()}
+            else:
+                if ck is not None:
+                    ck.clean_unless("p3", "bin-*", "halo-*")
+                bin_writers = [
+                    DatasetWriter(os.path.join(workdir, f"bin-{b:05d}"),
+                                  part_rows=bin_part_rows, **wopts)
+                    for b in range(part.num_partitions)]
+                halo_writers: dict = {}
         out_part_rows = chunk_rows if coalesce is None else \
             max(1, -(-total_rows // max(coalesce, 1)))
+        if ck is not None and os.path.isdir(output_path):
+            # idempotent rerun: stale parts from an interrupted emit would
+            # otherwise survive next to the fresh ones
+            for f in os.listdir(output_path):
+                if f.endswith(".parquet"):
+                    os.unlink(os.path.join(output_path, f))
         out = DatasetWriter(output_path, part_rows=out_part_rows,
                             row_group_bytes=row_group_bytes, **wopts)
-        for table in timed_chunks(reread(), "p3-decode"):
+        for table in timed_chunks([] if p3_skipped else reread(),
+                                  "p3-decode"):
             if bqsr:
                 with stage("p3-pack"):
                     batch = pack_reads(
@@ -484,10 +687,16 @@ def streaming_transform(input_path: str, output_path: str, *,
 
         # ---- pass 4: per-bin realign/sort through the merge window --------
         if binned:
-            for w in bin_writers:
-                w.close()
-            for w in halo_writers.values() if realign else ():
-                w.close()
+            if not p3_skipped:
+                for w in bin_writers:
+                    w.close()
+                for w in halo_writers.values() if realign else ():
+                    w.close()
+                if ck is not None:
+                    ck.mark("p3", n_bins=n_bins,
+                            bin_rows=[w.rows_written for w in bin_writers],
+                            halo_rows={str(b): w.rows_written
+                                       for b, w in halo_writers.items()})
             budget = max_bin_rows if max_bin_rows is not None \
                 else 4 * chunk_rows
             with stage("p4-bins", sync=True):
@@ -495,11 +704,14 @@ def streaming_transform(input_path: str, output_path: str, *,
                            halo_writers if realign else {}, part,
                            chunk_rows, budget, realign, sort, wopts)
         out.close()
+        if ck is not None:
+            ck.mark("done", total_rows=total_rows)
         return total_rows
     finally:
         if own_workdir:
             shutil.rmtree(workdir, ignore_errors=True)
-        elif raw_path != input_path:
+        elif raw_path != input_path and ck is None:
+            # checkpointed runs keep the spill: it IS the resume state
             shutil.rmtree(raw_path, ignore_errors=True)
 
 

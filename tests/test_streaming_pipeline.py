@@ -281,3 +281,106 @@ class TestBinEdgeAndSkew:
         assert same
         parts = [f for f in os.listdir(out) if f.endswith(".parquet")]
         assert len(parts) <= 2
+
+
+class TestStreamingResume:
+    """Pass-level checkpoint/resume for the streaming transform
+    (-stream -checkpoint_dir): the reference restarts `transform` from
+    zero on failure (SURVEY §5); here completed passes are skipped."""
+
+    def _run(self, resources, tmp_path, out_name, **kw):
+        from adam_tpu.parallel.pipeline import streaming_transform
+        return streaming_transform(
+            str(resources / "unmapped.sam"), str(tmp_path / out_name),
+            markdup=True, bqsr=True, sort=True, chunk_rows=64, **kw)
+
+    def test_done_short_circuit_and_identical_output(self, resources,
+                                                     tmp_path):
+        from adam_tpu.io.parquet import load_table
+        from adam_tpu.ops.sort import sort_reads  # noqa: F401 (import ok)
+
+        ckdir = tmp_path / "ck"
+        ckdir.mkdir()
+        n1 = self._run(resources, tmp_path, "out1", workdir=str(ckdir),
+                       resume=True)
+        # baseline without checkpointing
+        n2 = self._run(resources, tmp_path, "out2")
+        assert n1 == n2 == 200
+        t1 = load_table(str(tmp_path / "out1"))
+        t2 = load_table(str(tmp_path / "out2"))
+        assert t1.equals(t2)
+        # rerun: 'done' marker short-circuits before any pass runs
+        import adam_tpu.io.stream as IOS
+        monkey_called = []
+        orig = IOS.open_read_stream
+
+        def spy(*a, **k):
+            monkey_called.append(a)
+            return orig(*a, **k)
+        IOS.open_read_stream = spy
+        try:
+            n3 = self._run(resources, tmp_path, "out1",
+                           workdir=str(ckdir), resume=True)
+        finally:
+            IOS.open_read_stream = orig
+        assert n3 == 200
+        assert not monkey_called  # no pass re-ran
+
+    def test_crash_in_pass4_resumes_to_identical_output(self, resources,
+                                                        tmp_path,
+                                                        monkeypatch):
+        import adam_tpu.parallel.pipeline as PL
+        from adam_tpu.io.parquet import load_table
+
+        ckdir = tmp_path / "ck2"
+        ckdir.mkdir()
+
+        def boom(*a, **k):
+            raise RuntimeError("injected p4 crash")
+        monkeypatch.setattr(PL, "_emit_bins", boom)
+        import pytest
+        with pytest.raises(RuntimeError, match="injected p4 crash"):
+            self._run(resources, tmp_path, "outc", workdir=str(ckdir),
+                      resume=True)
+        monkeypatch.undo()
+
+        # resume must skip p1-p3 (their artifacts are checkpointed) ...
+        import adam_tpu.io.stream as IOS
+        called = {"p1": 0}
+        orig_stream = IOS.open_read_stream
+
+        def spy(*a, **k):
+            called["p1"] += 1
+            return orig_stream(*a, **k)
+        monkeypatch.setattr(IOS, "open_read_stream", spy)
+        n = self._run(resources, tmp_path, "outc", workdir=str(ckdir),
+                      resume=True)
+        assert n == 200
+        assert called["p1"] == 0
+        # ... and the finished output must equal a fresh full run
+        ref = self._run(resources, tmp_path, "outref")
+        assert load_table(str(tmp_path / "outc")).equals(
+            load_table(str(tmp_path / "outref")))
+
+    def test_fingerprint_change_refuses(self, resources, tmp_path):
+        import json
+
+        import pytest
+        ckdir = tmp_path / "ck3"
+        ckdir.mkdir()
+        self._run(resources, tmp_path, "outa", workdir=str(ckdir),
+                  resume=True)
+        manifest = json.load(open(ckdir / "stream_checkpoint.json"))
+        assert "done" in manifest["passes"]
+        # different config -> the dir belongs to another run: refuse, do
+        # NOT destroy its resume state (same contract as CheckpointDir)
+        from adam_tpu.parallel.pipeline import streaming_transform
+        with pytest.raises(ValueError, match="different transform"):
+            streaming_transform(
+                str(resources / "unmapped.sam"), str(tmp_path / "outb"),
+                markdup=False, bqsr=True, sort=True, chunk_rows=64,
+                workdir=str(ckdir), resume=True)
+        # original run's state untouched: rerun still short-circuits
+        n = self._run(resources, tmp_path, "outa", workdir=str(ckdir),
+                      resume=True)
+        assert n == 200
