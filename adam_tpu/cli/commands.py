@@ -297,9 +297,45 @@ class Reads2RefCommand(Command):
         p.add_argument("-allow_non_primary", action="store_true",
                        help="skip the locus predicate filter")
         p.add_argument("-parts", type=int, default=1)
+        p.add_argument("-stream", action="store_true",
+                       help="chunked bounded-memory pipeline (auto-enabled "
+                            "for inputs over 1 GB)")
+        p.add_argument("-no_stream", action="store_true",
+                       help="force the in-memory path even for large "
+                            "inputs")
+        p.add_argument("-stream_chunk_rows", type=int, default=1 << 20)
+        p.add_argument("-window_bp", type=int, default=1 << 20,
+                       help="aggregation window width in bp (streaming; "
+                            "memory ~ window x coverage)")
+        p.add_argument("-workdir", default=None)
         add_parquet_args(p)
 
     def run(self, args) -> int:
+        auto_stream = (os.path.exists(args.input) and
+                       not os.path.isdir(args.input) and
+                       os.path.getsize(args.input) > (1 << 30))
+        if (args.stream or auto_stream) and not args.no_stream:
+            if args.parts != 1:
+                import sys
+                print("warning: -parts is ignored by the streaming path "
+                      "(part size follows -stream_chunk_rows); use "
+                      "-no_stream for the in-memory writer",
+                      file=sys.stderr)
+            from ..parallel.pipeline import streaming_reads2ref
+            pw = parquet_writer_kwargs(args)
+            n_reads, n_pileups = streaming_reads2ref(
+                args.input, args.output, aggregate=args.aggregate,
+                allow_non_primary=args.allow_non_primary,
+                chunk_rows=args.stream_chunk_rows,
+                window_bp=args.window_bp, workdir=args.workdir,
+                compression=pw["compression"] or "none",
+                page_size=pw["page_size"],
+                use_dictionary=pw["use_dictionary"],
+                row_group_bytes=args.parquet_block_size)
+            n = max(n_reads, 1)
+            print(f"wrote {n_pileups} pileups from {n_reads} reads "
+                  f"(coverage ~{n_pileups / n:.1f}x read length)")
+            return 0
         from ..io.dispatch import load_reads
         from ..io.parquet import locus_predicate
         from ..ops.pileup import aggregate_pileups, reads_to_pileups

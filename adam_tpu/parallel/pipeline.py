@@ -903,3 +903,137 @@ def _emit_bins(out, bin_writers, halo_writers, part, chunk_rows, budget,
             _shutil.rmtree(workdir_b, ignore_errors=True)
     if pending is not None:                      # no unmapped rows written
         out.write(pending)
+
+
+# ---------------------------------------------------------------------------
+# streaming reads2ref
+# ---------------------------------------------------------------------------
+
+def streaming_reads2ref(input_path: str, output_path: str, *,
+                        aggregate: bool = False,
+                        allow_non_primary: bool = False,
+                        chunk_rows: int = 1 << 20,
+                        window_bp: int = 1 << 20,
+                        workdir: Optional[str] = None,
+                        compression: str = "zstd",
+                        page_size: Optional[int] = None,
+                        use_dictionary: bool = True,
+                        row_group_bytes: Optional[int] = None
+                        ) -> Tuple[int, int]:
+    """``reads2ref`` over a bounded-memory chunk stream.
+
+    The reference streams this through Spark executors by construction
+    (Reads2Ref.scala:56-74: flatMap to pileups, optional groupBy-position
+    aggregate); the in-memory path here loads the whole reads table.  This
+    is the streaming form:
+
+      * non-aggregated: pure map — each chunk's pileups append to the
+        output dataset (the ~readLen× data amplification never lives in
+        memory at once);
+      * aggregated: pileup rows route to fixed-width genome windows
+        (``window_bp`` positions each) in a Parquet workdir, then each
+        window aggregates independently — grouping keys include the exact
+        position, so window-partitioning by position is exact (no halo
+        needed, unlike realignment's target groups), and window-wise
+        aggregation equals the global groupBy restricted to that window.
+        Host memory is bounded by window span x coverage, the same
+        coverage-scaled budget the reference sizes reducers with
+        (PileupAggregator.scala:204-209).
+
+    Returns (n_reads, n_output_pileups).
+    """
+    from ..io.parquet import (DatasetWriter, load_table, locus_predicate)
+    from ..io.stream import open_read_stream
+    from ..ops.pileup import aggregate_pileups, reads_to_pileups
+
+    wopts = dict(compression=compression, page_size=page_size,
+                 use_dictionary=use_dictionary)
+    filters = None if allow_non_primary else locus_predicate()
+    stream = open_read_stream(input_path, filters=filters,
+                              chunk_rows=chunk_rows)
+    out = DatasetWriter(output_path, part_rows=chunk_rows,
+                        row_group_bytes=row_group_bytes, **wopts)
+    n_reads = 0
+    n_out = 0
+
+    if not aggregate:
+        if os.path.isdir(output_path):
+            for f in os.listdir(output_path):      # stale tail parts from
+                if f.endswith(".parquet"):         # a larger previous run
+                    os.unlink(os.path.join(output_path, f))
+        for table in stream:
+            n_reads += table.num_rows
+            p = reads_to_pileups(table)
+            n_out += p.num_rows
+            out.write(p)
+        out.close()
+        return n_reads, n_out
+
+    # round UP to a power of two: the flag documents a width, and a
+    # silent round-down would halve the promised window
+    window_bits = max((window_bp - 1).bit_length(), 1)
+    own_workdir = workdir is None
+    if own_workdir:
+        workdir = tempfile.mkdtemp(prefix="adam_tpu_reads2ref_")
+    os.makedirs(workdir, exist_ok=True)
+    import glob as _glob
+    for stale in _glob.glob(os.path.join(workdir, "win-*")):
+        shutil.rmtree(stale, ignore_errors=True)   # a previous run's rows
+    #                                                must not aggregate in
+    if os.path.isdir(output_path):
+        for f in os.listdir(output_path):          # stale tail parts from
+            if f.endswith(".parquet"):             # a larger previous run
+                os.unlink(os.path.join(output_path, f))
+    win_dirs: dict = {}
+    try:
+        # Each (chunk, window) slice writes ONE closed file immediately:
+        # no per-window writer stays open (a whole-genome run touches
+        # thousands of windows — persistent handles would blow the fd
+        # limit, and their pending buffers would grow host RSS linearly
+        # across the genome), and memory stays bounded by the chunk.
+        import pyarrow.parquet as _pq
+        chunk_i = 0
+        for table in stream:
+            n_reads += table.num_rows
+            p = reads_to_pileups(table)
+            if not p.num_rows:
+                continue
+            refid = column_int64(p, "referenceId", -1)
+            posi = column_int64(p, "position", -1)
+            win = np.maximum(posi, 0) >> window_bits
+            key = np.where(refid >= 0, refid * (1 << 40) + win, -1)
+            # one argsort + boundary split routes every window in
+            # O(n log n) (a per-unique-key scan is quadratic when an
+            # unsorted chunk touches thousands of windows)
+            order = np.argsort(key, kind="stable")
+            sk = key[order]
+            bounds = np.flatnonzero(np.r_[True, sk[1:] != sk[:-1]])
+            for bi, lo in enumerate(bounds):
+                hi = bounds[bi + 1] if bi + 1 < len(bounds) else len(sk)
+                k = int(sk[lo])
+                d = win_dirs.get(k)
+                if d is None:
+                    d = win_dirs[k] = os.path.join(
+                        workdir, f"win-{k & ((1 << 64) - 1):016x}")
+                    os.makedirs(d, exist_ok=True)
+                _pq.write_table(
+                    p.take(pa.array(order[lo:hi])),
+                    os.path.join(d, f"chunk-{chunk_i:06d}.parquet"),
+                    compression=wopts["compression"],
+                    data_page_size=wopts["page_size"],
+                    use_dictionary=wopts["use_dictionary"])
+            chunk_i += 1
+        # windows emit in genome order ((refid, window) == sorted key) so
+        # the output dataset reads back position-grouped
+        for k in sorted(win_dirs):
+            agg = aggregate_pileups(load_table(win_dirs[k]))
+            n_out += agg.num_rows
+            out.write(agg)
+        out.close()
+        return n_reads, n_out
+    finally:
+        if own_workdir:
+            shutil.rmtree(workdir, ignore_errors=True)
+        else:
+            for d in win_dirs.values():
+                shutil.rmtree(d, ignore_errors=True)
