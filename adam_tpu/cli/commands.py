@@ -467,6 +467,15 @@ class CompareCommand(Command):
         p.add_argument("-list_comparisons", action="store_true")
         p.add_argument("-directory", default=None,
                        help="directory to write per-metric histogram files")
+        p.add_argument("-stream", action="store_true",
+                       help="name-hash bucketed bounded-memory compare "
+                            "(auto-enabled when the inputs total over "
+                            "1 GB)")
+        p.add_argument("-no_stream", action="store_true",
+                       help="force the in-memory engine for large inputs")
+        p.add_argument("-buckets", type=int, default=32,
+                       help="streaming: number of name-hash buckets "
+                            "(memory ~ input / buckets)")
 
     def run(self, args) -> int:
         from ..compare.engine import (ComparisonTraversalEngine,
@@ -479,42 +488,67 @@ class CompareCommand(Command):
         if not args.input1 or not args.input2:
             print("compare: INPUT1 and INPUT2 required", file=__import__("sys").stderr)
             return 2
+        names = (args.comparisons.split(",") if args.comparisons
+                 else list(DEFAULT_COMPARISONS))
+        comps = [find_comparison(n) for n in names]
+        p1, p2 = args.input1.split(","), args.input2.split(",")
+
+        def total_size(paths):
+            total = 0
+            for q in paths:
+                if os.path.isdir(q):       # a Parquet dataset directory
+                    total += sum(
+                        os.path.getsize(os.path.join(q, f))
+                        for f in os.listdir(q) if f.endswith(".parquet"))
+                elif os.path.exists(q):
+                    total += os.path.getsize(q)
+            return total
+
+        def print_summary(n1, u1, n2, u2, hists):
+            # format mirrors cli/CompareAdam.scala:148-174; one printer
+            # for both engines so the outputs cannot drift
+            print(f"{'INPUT1':>15}: {args.input1}")
+            print(f"\t{'total-reads':>15}: {n1}")
+            print(f"\t{'unique-reads':>15}: {u1}")
+            print(f"{'INPUT2':>15}: {args.input2}")
+            print(f"\t{'total-reads':>15}: {n2}")
+            print(f"\t{'unique-reads':>15}: {u2}")
+            for comp in comps:
+                hist = hists[comp.name]
+                count = hist.count()
+                ident = hist.count_identical()
+                diff_frac = (count - ident) / count if count else 0.0
+                print()
+                print(comp.name)
+                print(f"\t{'count':>15}: {count}")
+                print(f"\t{'identity':>15}: {ident}")
+                print(f"\t{'diff%':>15}: {100.0 * diff_frac:.5f}")
+                if args.directory:
+                    os.makedirs(args.directory, exist_ok=True)
+                    with open(os.path.join(args.directory,
+                                           comp.name + ".txt"), "w") as f:
+                        hist.write(f)
+
+        auto = total_size(p1) + total_size(p2) > (1 << 30)
+        if (args.stream or auto) and not args.no_stream:
+            from ..compare.engine import streaming_compare
+            r = streaming_compare(p1, p2, comps, n_buckets=args.buckets)
+            t = r["totals"]
+            print_summary(t["n_names_1"], t["unique_to_1"],
+                          t["n_names_2"], t["unique_to_2"],
+                          r["histograms"])
+            return 0
         from ..io.dispatch import load_reads_union
         # comma-separated paths per input union with id reconciliation
         # (the reference's -recurse multi-file load, CompareAdam.scala:70-86)
-        t1, sd1, _ = load_reads_union(args.input1.split(","))
-        t2, sd2, _ = load_reads_union(args.input2.split(","))
+        t1, sd1, _ = load_reads_union(p1)
+        t2, sd2, _ = load_reads_union(p2)
         engine = ComparisonTraversalEngine(t1, t2, sd1, sd2)
-        names = (args.comparisons.split(",") if args.comparisons
-                 else list(DEFAULT_COMPARISONS))
-        # summary format mirrors cli/CompareAdam.scala:148-174
-        print(f"{'INPUT1':>15}: {args.input1}")
-        print(f"\t{'total-reads':>15}: {engine.n_names_1}")
-        print(f"\t{'unique-reads':>15}: {engine.unique_to_1()}")
-        print(f"{'INPUT2':>15}: {args.input2}")
-        print(f"\t{'total-reads':>15}: {engine.n_names_2}")
-        print(f"\t{'unique-reads':>15}: {engine.unique_to_2()}")
         # one combined traversal for every requested metric
         # (CombinedComparisons, Comparisons.scala:112-152)
-        comps = [find_comparison(n) for n in names]
-        hists = engine.aggregate_all(comps)
-        for comp in comps:
-            name = comp.name
-            hist = hists[name]
-            count = hist.count()
-            ident = hist.count_identical()
-            diff_frac = (count - ident) / count if count else 0.0
-            print()
-            print(comp.name)
-            print(f"\t{'count':>15}: {count}")
-            print(f"\t{'identity':>15}: {ident}")
-            print(f"\t{'diff%':>15}: {100.0 * diff_frac:.5f}")
-            if args.directory:
-                import os
-                os.makedirs(args.directory, exist_ok=True)
-                with open(os.path.join(args.directory, name + ".txt"),
-                          "w") as f:
-                    hist.write(f)
+        print_summary(engine.n_names_1, engine.unique_to_1(),
+                      engine.n_names_2, engine.unique_to_2(),
+                      engine.aggregate_all(comps))
         return 0
 
 

@@ -20,6 +20,7 @@ oracle (tests) and for ad-hoc single-name queries.
 
 from __future__ import annotations
 
+import os
 import re
 from collections import Counter
 from dataclasses import dataclass, field
@@ -605,3 +606,141 @@ def parse_filter(filter_string: str) -> GeneratorFilter:
 
 def parse_filters(filters: str) -> List[GeneratorFilter]:
     return [parse_filter(f) for f in filters.split(";")]
+
+
+# ----------------------------------------------------------------------
+# streaming compare (bounded memory over name-hash buckets)
+# ----------------------------------------------------------------------
+
+#: the projection one compare traversal actually consumes — the reference
+#: projects 6 id fields + generator schemas (CompareAdam.scala:70-86); the
+#: reference* columns ride along to rebuild the dictionaries for id
+#: reconciliation on Parquet inputs
+COMPARE_COLUMNS = ("readName", "flags", "start", "referenceId", "mapq",
+                   "qual", "referenceName", "referenceLength",
+                   "referenceUrl")
+
+
+def streaming_compare(paths1, paths2, comparisons, *, n_buckets: int = 32,
+                      chunk_rows: int = 1 << 20,
+                      workdir: Optional[str] = None) -> dict:
+    """Bounded-memory compare: both inputs spill into name-hash buckets,
+    then each bucket runs the columnar traversal independently and the
+    histograms/counters merge (they are monoids, like everything the
+    reference aggregates).
+
+    A read name lands in exactly one bucket on both sides, so per-bucket
+    joins/uniques/histograms sum to exactly the whole-input result — the
+    same invariant behind the reference's hash-partitioned join
+    (ComparisonTraversalEngine.scala:40-45).  Host memory is bounded by
+    the largest bucket (~input/n_buckets), not the inputs.
+
+    Contig ids reconcile exactly like load_reads_union
+    (AdamContext.loadAdamFromPaths :364-383): each file's dictionary maps
+    onto its side's accumulated one and chunks are remapped as they
+    spill; side 2 then maps onto side 1 at bucket-compare time.
+    """
+    import glob as _glob
+    import shutil
+    import tempfile
+
+    from ..io.dispatch import remap_reference_ids
+    from ..io.parquet import iter_tables, load_table
+    from ..io.stream import open_read_stream
+    from ..models.dictionary import SequenceDictionary
+    from ..packing import hash_strings_128
+    from ..parallel.pipeline import (_accumulate_seq_records,
+                                     route_slices_to_dirs)
+
+    own = workdir is None
+    if own:
+        workdir = tempfile.mkdtemp(prefix="adam_tpu_compare_")
+    os.makedirs(workdir, exist_ok=True)
+    for stale in _glob.glob(os.path.join(workdir, "s[01]-b*")):
+        shutil.rmtree(stale, ignore_errors=True)  # a hard-killed prior
+    #                                               run must not double in
+
+    def file_dict(path):
+        """The file's sequence dictionary without loading its rows: the
+        header for SAM/BAM; a reference-column scan for Parquet."""
+        stream = open_read_stream(path, columns=None, chunk_rows=chunk_rows)
+        if stream.seq_dict is not None:
+            return stream.seq_dict
+        seen: dict = {}
+        for t in iter_tables(path, chunk_rows=chunk_rows,
+                             columns=[c for c in (
+                                 "referenceId", "referenceName",
+                                 "referenceLength", "referenceUrl")]):
+            _accumulate_seq_records(t, seen)
+        return SequenceDictionary(seen.values())
+
+    schemas = [None, None]
+    dicts = [None, None]
+    try:
+        for side, paths in ((0, paths1), (1, paths2)):
+            acc = None
+            chunk_i = 0
+            bucket_dirs: dict = {}
+            for path in paths:
+                sd = file_dict(path)
+                id_map = {}
+                if acc is None:
+                    acc = sd
+                else:
+                    id_map = sd.map_to(acc)
+                    acc = acc + sd.remap(id_map)
+                stream = open_read_stream(path, columns=COMPARE_COLUMNS,
+                                          chunk_rows=chunk_rows)
+                for table in stream:
+                    if id_map:
+                        table = remap_reference_ids(table, id_map)
+                    if schemas[side] is None:
+                        schemas[side] = table.schema
+                    lo, _hi = hash_strings_128(table.column("readName"))
+                    bucket = (lo % n_buckets).astype(np.int64)
+                    route_slices_to_dirs(
+                        table, bucket, workdir, chunk_i, bucket_dirs, {},
+                        lambda b, _s=side: f"s{_s}-b{b:04d}")
+                    chunk_i += 1
+            dicts[side] = acc if acc is not None else SequenceDictionary()
+
+        id_map = dicts[1].map_to(dicts[0]) if len(dicts[0]) and \
+            len(dicts[1]) else {}
+        # a side that yielded zero chunks still joins: an empty table of
+        # the other side's schema keeps the populated side's totals exact
+        # (both are the same COMPARE_COLUMNS projection)
+        for side in (0, 1):
+            if schemas[side] is None:
+                schemas[side] = schemas[1 - side]
+
+        totals = dict(n_names_1=0, n_names_2=0, unique_to_1=0,
+                      unique_to_2=0, n_joined=0)
+        hists = {c.name: Histogram() for c in comparisons}
+        if schemas[0] is None:                    # both inputs empty
+            return {"totals": totals, "histograms": hists}
+        for b in range(n_buckets):
+            sides = []
+            for side in (0, 1):
+                d = os.path.join(workdir, f"s{side}-b{b:04d}")
+                sides.append(load_table(d) if os.path.isdir(d)
+                             else schemas[side].empty_table())
+            t1, t2 = sides
+            if t1.num_rows == 0 and t2.num_rows == 0:
+                continue
+            if id_map:
+                t2 = remap_reference_ids(t2, id_map)
+            engine = ComparisonTraversalEngine(t1, t2)
+            totals["n_names_1"] += engine.n_names_1
+            totals["n_names_2"] += engine.n_names_2
+            totals["unique_to_1"] += engine.unique_to_1()
+            totals["unique_to_2"] += engine.unique_to_2()
+            totals["n_joined"] += engine.n_joined
+            for name, h in engine.aggregate_all(comparisons).items():
+                hists[name] = hists[name] + h
+        return {"totals": totals, "histograms": hists}
+    finally:
+        if own:
+            shutil.rmtree(workdir, ignore_errors=True)
+        else:
+            for d in _glob.glob(os.path.join(workdir, "s[01]-b*")):
+                shutil.rmtree(d, ignore_errors=True)

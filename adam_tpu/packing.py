@@ -207,11 +207,20 @@ def hash_strings_128(col: pa.ChunkedArray) -> tuple[np.ndarray, np.ndarray]:
     h2 = np.full(n, 0x61C8864680B583EB, np.uint64)
     with np.errstate(over="ignore"):
         for j in range(W):
+            # a round only mixes rows whose name actually reaches word j:
+            # W is the CHUNK's max width, and an unconditional transform
+            # would make the hash depend on the longest name sharing the
+            # chunk — the same read name must hash identically in every
+            # chunk layout (streaming markdup pairs mates across chunks
+            # by this hash; the compare engine buckets by it per side)
+            live = (np.int64(j) * 8) < lens
             w = words[:, j]
-            h1 = (h1 + w) * M1
-            h1 ^= h1 >> np.uint64(29)
-            h2 = (h2 ^ w) * M2
-            h2 ^= h2 >> np.uint64(31)
+            n1 = (h1 + w) * M1
+            n1 ^= n1 >> np.uint64(29)
+            h1 = np.where(live, n1, h1)
+            n2 = (h2 ^ w) * M2
+            n2 ^= n2 >> np.uint64(31)
+            h2 = np.where(live, n2, h2)
         h1 = (h1 + lens.astype(np.uint64)) * M1
         h2 = (h2 ^ lens.astype(np.uint64)) * M2
     if nulls is not None:

@@ -909,6 +909,46 @@ def _emit_bins(out, bin_writers, halo_writers, part, chunk_rows, budget,
 # streaming reads2ref
 # ---------------------------------------------------------------------------
 
+
+def _purge_stale_parts(output_path: str) -> None:
+    """Remove pre-existing part files so a rerun that writes fewer parts
+    does not leave the old run's tail mixed into the dataset."""
+    if os.path.isdir(output_path):
+        for f in os.listdir(output_path):
+            if f.endswith(".parquet"):
+                os.unlink(os.path.join(output_path, f))
+
+
+def route_slices_to_dirs(table: pa.Table, key: np.ndarray, workdir: str,
+                         chunk_i: int, dirs: dict, wopts: dict,
+                         name_of) -> None:
+    """Route a chunk's rows into per-key Parquet dirs: one argsort +
+    boundary split (a per-unique-key scan is quadratic when a chunk
+    touches thousands of keys), one immediately-closed file per
+    (chunk, key) slice — no persistent writer handles or pending buffers
+    (thousands of keys would exhaust fds and grow host RSS).  Shared by
+    the streaming reads2ref window router and the streaming compare
+    name-hash bucketer."""
+    import pyarrow.parquet as _pq
+
+    order = np.argsort(key, kind="stable")
+    sk = key[order]
+    bounds = np.flatnonzero(np.r_[True, sk[1:] != sk[:-1]])
+    for bi, lo in enumerate(bounds):
+        hi = bounds[bi + 1] if bi + 1 < len(bounds) else len(sk)
+        k = int(sk[lo])
+        d = dirs.get(k)
+        if d is None:
+            d = dirs[k] = os.path.join(workdir, name_of(k))
+            os.makedirs(d, exist_ok=True)
+        _pq.write_table(table.take(pa.array(order[lo:hi])),
+                        os.path.join(d, f"chunk-{chunk_i:06d}.parquet"),
+                        compression=wopts.get("compression", "zstd"),
+                        data_page_size=wopts.get("page_size"),
+                        use_dictionary=wopts.get("use_dictionary", True))
+
+
+
 def streaming_reads2ref(input_path: str, output_path: str, *,
                         aggregate: bool = False,
                         allow_non_primary: bool = False,
@@ -951,16 +991,13 @@ def streaming_reads2ref(input_path: str, output_path: str, *,
     filters = None if allow_non_primary else locus_predicate()
     stream = open_read_stream(input_path, filters=filters,
                               chunk_rows=chunk_rows)
+    _purge_stale_parts(output_path)
     out = DatasetWriter(output_path, part_rows=chunk_rows,
                         row_group_bytes=row_group_bytes, **wopts)
     n_reads = 0
     n_out = 0
 
     if not aggregate:
-        if os.path.isdir(output_path):
-            for f in os.listdir(output_path):      # stale tail parts from
-                if f.endswith(".parquet"):         # a larger previous run
-                    os.unlink(os.path.join(output_path, f))
         for table in stream:
             n_reads += table.num_rows
             p = reads_to_pileups(table)
@@ -980,18 +1017,8 @@ def streaming_reads2ref(input_path: str, output_path: str, *,
     for stale in _glob.glob(os.path.join(workdir, "win-*")):
         shutil.rmtree(stale, ignore_errors=True)   # a previous run's rows
     #                                                must not aggregate in
-    if os.path.isdir(output_path):
-        for f in os.listdir(output_path):          # stale tail parts from
-            if f.endswith(".parquet"):             # a larger previous run
-                os.unlink(os.path.join(output_path, f))
     win_dirs: dict = {}
     try:
-        # Each (chunk, window) slice writes ONE closed file immediately:
-        # no per-window writer stays open (a whole-genome run touches
-        # thousands of windows — persistent handles would blow the fd
-        # limit, and their pending buffers would grow host RSS linearly
-        # across the genome), and memory stays bounded by the chunk.
-        import pyarrow.parquet as _pq
         chunk_i = 0
         for table in stream:
             n_reads += table.num_rows
@@ -1002,26 +1029,9 @@ def streaming_reads2ref(input_path: str, output_path: str, *,
             posi = column_int64(p, "position", -1)
             win = np.maximum(posi, 0) >> window_bits
             key = np.where(refid >= 0, refid * (1 << 40) + win, -1)
-            # one argsort + boundary split routes every window in
-            # O(n log n) (a per-unique-key scan is quadratic when an
-            # unsorted chunk touches thousands of windows)
-            order = np.argsort(key, kind="stable")
-            sk = key[order]
-            bounds = np.flatnonzero(np.r_[True, sk[1:] != sk[:-1]])
-            for bi, lo in enumerate(bounds):
-                hi = bounds[bi + 1] if bi + 1 < len(bounds) else len(sk)
-                k = int(sk[lo])
-                d = win_dirs.get(k)
-                if d is None:
-                    d = win_dirs[k] = os.path.join(
-                        workdir, f"win-{k & ((1 << 64) - 1):016x}")
-                    os.makedirs(d, exist_ok=True)
-                _pq.write_table(
-                    p.take(pa.array(order[lo:hi])),
-                    os.path.join(d, f"chunk-{chunk_i:06d}.parquet"),
-                    compression=wopts["compression"],
-                    data_page_size=wopts["page_size"],
-                    use_dictionary=wopts["use_dictionary"])
+            route_slices_to_dirs(
+                p, key, workdir, chunk_i, win_dirs, wopts,
+                lambda k: f"win-{k & ((1 << 64) - 1):016x}")
             chunk_i += 1
         # windows emit in genome order ((refid, window) == sorted key) so
         # the output dataset reads back position-grouped

@@ -214,3 +214,70 @@ def test_custom_comparison_falls_back_to_bucket_path():
     h = engine.aggregate(MapqSum())
     want = _oracle_histogram(t1, t2, MapqSum())
     assert dict(h.value_to_count) == dict(want.value_to_count)
+
+
+def test_streaming_compare_matches_inmemory(resources, tmp_path):
+    """Name-hash bucketed streaming compare == the in-memory engine:
+    histograms value-for-value, totals, uniques — with bucket/chunk sizes
+    small enough that every bucket and chunk boundary is exercised."""
+    from adam_tpu.compare.engine import (ComparisonTraversalEngine,
+                                         DEFAULT_COMPARISONS,
+                                         streaming_compare)
+    from adam_tpu.io.dispatch import load_reads_union
+
+    comps = list(DEFAULT_COMPARISONS.values())
+    for right in ("reads21.sam", "reads12_diff1.sam"):
+        p1 = [str(resources / "reads12.sam")]
+        p2 = [str(resources / right)]
+        t1, sd1, _ = load_reads_union(p1)
+        t2, sd2, _ = load_reads_union(p2)
+        eng = ComparisonTraversalEngine(t1, t2, sd1, sd2)
+        ref_h = eng.aggregate_all(comps)
+
+        got = streaming_compare(p1, p2, comps, n_buckets=7, chunk_rows=3)
+        assert got["totals"] == dict(
+            n_names_1=eng.n_names_1, n_names_2=eng.n_names_2,
+            unique_to_1=eng.unique_to_1(), unique_to_2=eng.unique_to_2(),
+            n_joined=eng.n_joined), right
+        for name in ref_h:
+            assert got["histograms"][name].value_to_count == \
+                ref_h[name].value_to_count, (right, name)
+
+
+def test_streaming_compare_empty_side_and_multifile(resources, tmp_path):
+    """A header-only side still reports the populated side's totals; a
+    comma-separated side reconciles contig ids per file like
+    load_reads_union."""
+    from adam_tpu.compare.engine import (DEFAULT_COMPARISONS,
+                                         streaming_compare)
+
+    comps = list(DEFAULT_COMPARISONS.values())
+    src = resources / "reads12.sam"
+    lines = src.read_text().splitlines(keepends=True)
+    header = [ln for ln in lines if ln.startswith("@")]
+    empty = tmp_path / "empty.sam"
+    empty.write_text("".join(header))
+
+    r = streaming_compare([str(src)], [str(empty)], comps, n_buckets=3)
+    assert r["totals"]["n_names_1"] == 200
+    assert r["totals"]["unique_to_1"] == 200
+    assert r["totals"]["n_names_2"] == 0
+    assert r["totals"]["n_joined"] == 0
+
+    # split side 1 into two files (first/second half of the body) — the
+    # union must behave like the single file
+    body = [ln for ln in lines if not ln.startswith("@")]
+    h1 = tmp_path / "h1.sam"
+    h2 = tmp_path / "h2.sam"
+    h1.write_text("".join(header + body[:100]))
+    h2.write_text("".join(header + body[100:]))
+    r2 = streaming_compare([str(h1), str(h2)],
+                           [str(resources / "reads21.sam")], comps,
+                           n_buckets=3, chunk_rows=7)
+    r_ref = streaming_compare([str(src)],
+                              [str(resources / "reads21.sam")], comps,
+                              n_buckets=3, chunk_rows=7)
+    assert r2["totals"] == r_ref["totals"]
+    for name in r_ref["histograms"]:
+        assert r2["histograms"][name].value_to_count == \
+            r_ref["histograms"][name].value_to_count, name

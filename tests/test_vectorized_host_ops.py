@@ -132,3 +132,21 @@ def test_string_pack_dense_fast_path_matches_general():
     b_sl = pack_reads(sl, bucket_len=16)
     assert np.array_equal(np.asarray(b_sl.bases)[:4],
                           np.asarray(b_ragged.bases)[1:5])
+
+
+def test_name_hash_is_chunk_layout_independent():
+    """The same name must hash identically regardless of what else shares
+    its chunk: the Horner width follows the chunk's LONGEST name, and an
+    unconditional round would fold padding into short names' hashes —
+    streaming markdup pairs mates across chunks by this hash."""
+    import numpy as np
+    import pyarrow as pa
+    from adam_tpu.packing import hash_strings_128
+
+    short = ["read:1", "pair:2:xyz", "q"]
+    alone = hash_strings_128(pa.chunked_array([pa.array(short)]))
+    with_long = hash_strings_128(pa.chunked_array(
+        [pa.array(short + ["a" * 200])]))
+    for i in range(len(short)):
+        assert alone[0][i] == with_long[0][i], short[i]
+        assert alone[1][i] == with_long[1][i], short[i]
