@@ -45,6 +45,16 @@ def parquet_writer_kwargs(args, fallback_compression: str = "zstd"):
     )
 
 
+def input_size_bytes(path: str) -> int:
+    """Size of a file input or a Parquet dataset directory (sum of its
+    part files) — the auto-stream threshold for every streaming-capable
+    command."""
+    if os.path.isdir(path):
+        return sum(os.path.getsize(os.path.join(path, f))
+                   for f in os.listdir(path) if f.endswith(".parquet"))
+    return os.path.getsize(path) if os.path.exists(path) else 0
+
+
 def save_with_args(table, path, args, **kw) -> None:
     """save_table with the shared ParquetArgs applied (incl. the bytes ->
     row-group-rows conversion for -parquet_block_size)."""
@@ -438,11 +448,25 @@ class ComputeVariantsCommand(Command):
         p.add_argument("output", help="output basename (.v/.g datasets)")
         p.add_argument("-runValidation", action="store_true")
         p.add_argument("-runStrictValidation", action="store_true")
+        p.add_argument("-stream", action="store_true",
+                       help="windowed bounded-memory conversion "
+                            "(auto-enabled for inputs over 1 GB)")
+        p.add_argument("-no_stream", action="store_true",
+                       help="force the in-memory path for large inputs")
 
     def run(self, args) -> int:
         from ..converters.genotypes_to_variants import convert_genotypes
         from ..io.parquet import load_table, save_table
 
+        if (args.stream or input_size_bytes(args.input) > (1 << 30)) \
+                and not args.no_stream:
+            from ..parallel.pipeline import streaming_compute_variants
+            n_geno, n_var = streaming_compute_variants(
+                args.input, args.output,
+                validate=args.runValidation or args.runStrictValidation,
+                strict=args.runStrictValidation)
+            print(f"computed {n_var} variants from {n_geno} genotypes")
+            return 0
         genotypes = load_table(args.input)
         variants = convert_genotypes(
             genotypes, validate=args.runValidation or args.runStrictValidation,
@@ -494,15 +518,7 @@ class CompareCommand(Command):
         p1, p2 = args.input1.split(","), args.input2.split(",")
 
         def total_size(paths):
-            total = 0
-            for q in paths:
-                if os.path.isdir(q):       # a Parquet dataset directory
-                    total += sum(
-                        os.path.getsize(os.path.join(q, f))
-                        for f in os.listdir(q) if f.endswith(".parquet"))
-                elif os.path.exists(q):
-                    total += os.path.getsize(q)
-            return total
+            return sum(input_size_bytes(q) for q in paths)
 
         def print_summary(n1, u1, n2, u2, hists):
             # format mirrors cli/CompareAdam.scala:148-174; one printer

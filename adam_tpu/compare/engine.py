@@ -652,6 +652,8 @@ def streaming_compare(paths1, paths2, comparisons, *, n_buckets: int = 32,
     from ..parallel.pipeline import (_accumulate_seq_records,
                                      route_slices_to_dirs)
 
+    if n_buckets < 1:
+        raise ValueError(f"n_buckets must be >= 1, got {n_buckets}")
     own = workdir is None
     if own:
         workdir = tempfile.mkdtemp(prefix="adam_tpu_compare_")
@@ -681,12 +683,14 @@ def streaming_compare(paths1, paths2, comparisons, *, n_buckets: int = 32,
             acc = None
             chunk_i = 0
             bucket_dirs: dict = {}
-            for path in paths:
-                sd = file_dict(path)
+            for file_i, path in enumerate(paths):
+                # the FIRST file's dictionary accumulates during the spill
+                # itself (no remap can apply to it); only later files pay
+                # the dictionary pre-scan their remap requires
                 id_map = {}
-                if acc is None:
-                    acc = sd
-                else:
+                first_seen: dict = {}
+                if file_i > 0:
+                    sd = file_dict(path)
                     id_map = sd.map_to(acc)
                     acc = acc + sd.remap(id_map)
                 stream = open_read_stream(path, columns=COMPARE_COLUMNS,
@@ -696,12 +700,17 @@ def streaming_compare(paths1, paths2, comparisons, *, n_buckets: int = 32,
                         table = remap_reference_ids(table, id_map)
                     if schemas[side] is None:
                         schemas[side] = table.schema
+                    if file_i == 0 and stream.seq_dict is None:
+                        _accumulate_seq_records(table, first_seen)
                     lo, _hi = hash_strings_128(table.column("readName"))
                     bucket = (lo % n_buckets).astype(np.int64)
                     route_slices_to_dirs(
                         table, bucket, workdir, chunk_i, bucket_dirs, {},
                         lambda b, _s=side: f"s{_s}-b{b:04d}")
                     chunk_i += 1
+                if file_i == 0:
+                    acc = stream.seq_dict if stream.seq_dict is not None \
+                        else SequenceDictionary(first_seen.values())
             dicts[side] = acc if acc is not None else SequenceDictionary()
 
         id_map = dicts[1].map_to(dicts[0]) if len(dicts[0]) and \

@@ -931,6 +931,8 @@ def route_slices_to_dirs(table: pa.Table, key: np.ndarray, workdir: str,
     name-hash bucketer."""
     import pyarrow.parquet as _pq
 
+    if len(key) == 0:
+        return
     order = np.argsort(key, kind="stable")
     sk = key[order]
     bounds = np.flatnonzero(np.r_[True, sk[1:] != sk[:-1]])
@@ -1043,6 +1045,75 @@ def streaming_reads2ref(input_path: str, output_path: str, *,
         return n_reads, n_out
     finally:
         if own_workdir:
+            shutil.rmtree(workdir, ignore_errors=True)
+        else:
+            for d in win_dirs.values():
+                shutil.rmtree(d, ignore_errors=True)
+
+
+# ---------------------------------------------------------------------------
+# streaming compute_variants
+# ---------------------------------------------------------------------------
+
+def streaming_compute_variants(input_path: str, output_base: str, *,
+                               validate: bool = False, strict: bool = False,
+                               chunk_rows: int = 1 << 20,
+                               window_bp: int = 1 << 20,
+                               workdir: Optional[str] = None,
+                               compression: str = "zstd") -> Tuple[int, int]:
+    """``compute_variants`` over a bounded-memory genotype stream.
+
+    The reference's groupBy-position shuffle (AdamRDDFunctions.scala:
+    422-434) becomes the same windowed routing as streaming reads2ref:
+    variant synthesis is per (site, allele), and windows partition sites
+    exactly, so window-wise conversion equals the global groupBy.  The
+    genotypes copy through to ``<base>.g`` as they stream (the reference
+    writes both datasets, ComputeVariants.scala:55-72).
+
+    Returns (n_genotypes, n_variants).
+    """
+    from ..converters.genotypes_to_variants import convert_genotypes
+    from ..io.parquet import DatasetWriter, iter_tables, load_table
+
+    wopts = dict(compression=compression)
+    window_bits = max((window_bp - 1).bit_length(), 1)
+    own = workdir is None
+    if own:
+        workdir = tempfile.mkdtemp(prefix="adam_tpu_cv_")
+    os.makedirs(workdir, exist_ok=True)
+    import glob as _glob
+    for stale in _glob.glob(os.path.join(workdir, "gwin-*")):
+        shutil.rmtree(stale, ignore_errors=True)
+    _purge_stale_parts(output_base + ".v")
+    _purge_stale_parts(output_base + ".g")
+    v_out = DatasetWriter(output_base + ".v", part_rows=chunk_rows, **wopts)
+    g_out = DatasetWriter(output_base + ".g", part_rows=chunk_rows, **wopts)
+    win_dirs: dict = {}
+    n_geno = 0
+    n_var = 0
+    try:
+        chunk_i = 0
+        for table in iter_tables(input_path, chunk_rows=chunk_rows):
+            n_geno += table.num_rows
+            g_out.write(table)
+            refid = column_int64(table, "referenceId", -1)
+            posi = column_int64(table, "position", -1)
+            win = np.maximum(posi, 0) >> window_bits
+            key = np.where(refid >= 0, refid * (1 << 40) + win, -1)
+            route_slices_to_dirs(
+                table, key, workdir, chunk_i, win_dirs, wopts,
+                lambda k: f"gwin-{k & ((1 << 64) - 1):016x}")
+            chunk_i += 1
+        g_out.close()
+        for k in sorted(win_dirs):
+            variants = convert_genotypes(load_table(win_dirs[k]),
+                                         validate=validate, strict=strict)
+            n_var += variants.num_rows
+            v_out.write(variants)
+        v_out.close()
+        return n_geno, n_var
+    finally:
+        if own:
             shutil.rmtree(workdir, ignore_errors=True)
         else:
             for d in win_dirs.values():
