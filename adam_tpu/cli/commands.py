@@ -47,12 +47,22 @@ def parquet_writer_kwargs(args, fallback_compression: str = "zstd"):
 
 def input_size_bytes(path: str) -> int:
     """Size of a file input or a Parquet dataset directory (sum of its
-    part files) — the auto-stream threshold for every streaming-capable
-    command."""
+    part files)."""
     if os.path.isdir(path):
         return sum(os.path.getsize(os.path.join(path, f))
                    for f in os.listdir(path) if f.endswith(".parquet"))
     return os.path.getsize(path) if os.path.exists(path) else 0
+
+
+def should_stream(args, *paths) -> bool:
+    """One auto-stream gate for every streaming-capable command: explicit
+    -stream wins, -no_stream vetoes, otherwise inputs (files or dataset
+    directories) totaling over 1 GB stream."""
+    if getattr(args, "no_stream", False):
+        return False
+    if getattr(args, "stream", False):
+        return True
+    return sum(input_size_bytes(p) for p in paths) > (1 << 30)
 
 
 def save_with_args(table, path, args, **kw) -> None:
@@ -161,9 +171,7 @@ class TransformCommand(Command):
         # tables in Parquet); with -stream it selects the streaming
         # pass-level resume (workdir = checkpoint dir)
         auto_stream = (not sam_out and not args.checkpoint_dir and
-                       os.path.exists(args.input) and
-                       not os.path.isdir(args.input) and
-                       os.path.getsize(args.input) > (1 << 30))
+                       should_stream(args, args.input))
         if args.stream or auto_stream:
             if sam_out:
                 raise SystemExit(
@@ -321,10 +329,7 @@ class Reads2RefCommand(Command):
         add_parquet_args(p)
 
     def run(self, args) -> int:
-        auto_stream = (os.path.exists(args.input) and
-                       not os.path.isdir(args.input) and
-                       os.path.getsize(args.input) > (1 << 30))
-        if (args.stream or auto_stream) and not args.no_stream:
+        if should_stream(args, args.input):
             if args.parts != 1:
                 import sys
                 print("warning: -parts is ignored by the streaming path "
@@ -458,8 +463,7 @@ class ComputeVariantsCommand(Command):
         from ..converters.genotypes_to_variants import convert_genotypes
         from ..io.parquet import load_table, save_table
 
-        if (args.stream or input_size_bytes(args.input) > (1 << 30)) \
-                and not args.no_stream:
+        if should_stream(args, args.input):
             from ..parallel.pipeline import streaming_compute_variants
             n_geno, n_var = streaming_compute_variants(
                 args.input, args.output,
@@ -517,9 +521,6 @@ class CompareCommand(Command):
         comps = [find_comparison(n) for n in names]
         p1, p2 = args.input1.split(","), args.input2.split(",")
 
-        def total_size(paths):
-            return sum(input_size_bytes(q) for q in paths)
-
         def print_summary(n1, u1, n2, u2, hists):
             # format mirrors cli/CompareAdam.scala:148-174; one printer
             # for both engines so the outputs cannot drift
@@ -545,8 +546,7 @@ class CompareCommand(Command):
                                            comp.name + ".txt"), "w") as f:
                         hist.write(f)
 
-        auto = total_size(p1) + total_size(p2) > (1 << 30)
-        if (args.stream or auto) and not args.no_stream:
+        if should_stream(args, *(p1 + p2)):
             from ..compare.engine import streaming_compare
             r = streaming_compare(p1, p2, comps, n_buckets=args.buckets)
             t = r["totals"]
@@ -640,13 +640,29 @@ class MpileupCommand(Command):
 
     def add_args(self, p: argparse.ArgumentParser) -> None:
         p.add_argument("input", help="SAM/BAM file or ADAM Parquet dataset")
+        p.add_argument("-stream", action="store_true",
+                       help="windowed bounded-memory pileup text "
+                            "(auto-enabled for inputs over 1 GB)")
+        p.add_argument("-no_stream", action="store_true")
 
     def run(self, args) -> int:
         from ..io.dispatch import load_reads
         from ..ops.pileup import reads_to_pileups
 
+        if should_stream(args, args.input):
+            from ..parallel.pipeline import windowed_pileups
+            # windows partition positions exactly and emit in genome
+            # order, so per-window text == the globally sorted traversal
+            with windowed_pileups(args.input,
+                                  allow_non_primary=True) as (_n, wins):
+                for wtbl in wins:
+                    self._emit(wtbl)
+            return 0
         table, _, _ = load_reads(args.input)
-        pileups = reads_to_pileups(table)
+        self._emit(reads_to_pileups(table))
+        return 0
+
+    def _emit(self, pileups) -> None:
         rows = pileups.sort_by([("referenceId", "ascending"),
                                 ("position", "ascending")]).to_pylist()
         # group by position; event layout mirrors MpileupCommand.scala:47-78
@@ -683,7 +699,6 @@ class MpileupCommand(Command):
                         ins, key=lambda x: x["rangeOffset"]))
                     out.append(f"+{len(seq)}{seq}")
             print("".join(out))
-        return 0
 
 
 @register
@@ -701,37 +716,46 @@ class PrintTagsCommand(Command):
     def run(self, args) -> int:
         from collections import Counter
         from .. import schema as S
-        from ..io.dispatch import load_reads
+        from ..io.stream import open_read_stream
         from ..packing import column_int64
 
-        table, _, _ = load_reads(
-            args.input, columns=("attributes", "flags"))
-        flags = column_int64(table, "flags", 0)
-        attrs = table.column("attributes").to_pylist()
-        # the reference filters failed-vendor-quality reads (PrintTags.scala:70)
-        usable = [(a or "") for a, f in zip(attrs, flags)
-                  if not (f & S.FLAG_QC_FAIL)]
-        if args.list_n:
-            for a in usable[:args.list_n]:
-                print(a)
+        # counters accumulate per streamed chunk — the census is a
+        # monoid, so the whole-file table never materializes
         to_count = set(args.count.split(",")) if args.count else set()
         tag_counts: Counter = Counter()
         value_counts: dict = {t: Counter() for t in to_count}
-        for a in usable:
-            for field in a.split("\t") if a else []:
-                # tag census stays a cheap split (this is the CLI hot loop);
-                # util.attributes provides the typed view when values matter
-                tag = field.split(":", 1)[0]
-                tag_counts[tag] += 1
-                if tag in to_count:
-                    # census keys keep the on-disk SAM encoding (the typed
-                    # value's repr would split '3' vs '3.0' buckets)
-                    value_counts[tag][field.split(":", 2)[-1]] += 1
+        n_usable = 0
+        listed = args.list_n
+        stream = open_read_stream(args.input,
+                                  columns=("attributes", "flags"))
+        for table in stream:
+            flags = column_int64(table, "flags", 0)
+            attrs = table.column("attributes").to_pylist()
+            # the reference filters failed-vendor-quality reads
+            # (PrintTags.scala:70)
+            usable = [(a or "") for a, f in zip(attrs, flags)
+                      if not (f & S.FLAG_QC_FAIL)]
+            n_usable += len(usable)
+            if listed:
+                for a in usable[:listed]:
+                    print(a)
+                listed -= min(len(usable), listed)
+            for a in usable:
+                for field in a.split("\t") if a else []:
+                    # tag census stays a cheap split (the CLI hot loop);
+                    # util.attributes provides the typed view when values
+                    # matter
+                    tag = field.split(":", 1)[0]
+                    tag_counts[tag] += 1
+                    if tag in to_count:
+                        # census keys keep the on-disk SAM encoding (the
+                        # typed value's repr splits '3' vs '3.0' buckets)
+                        value_counts[tag][field.split(":", 2)[-1]] += 1
         for tag, count in tag_counts.most_common():
             print(f"{tag:>3}\t{count}")
             for value, vc in value_counts.get(tag, {}).items():
                 print(f"\t{vc:>10}\t{value}")
-        print(f"Total: {len(usable)}")
+        print(f"Total: {n_usable}")
         return 0
 
 
@@ -745,10 +769,20 @@ class PrintCommand(Command):
         p.add_argument("-limit", type=int, default=25)
 
     def run(self, args) -> int:
-        from ..io.dispatch import load_reads
-        table, _, _ = load_reads(args.input)
-        for row in table.slice(0, args.limit).to_pylist():
-            print({k: v for k, v in row.items() if v is not None})
+        from ..io.stream import open_read_stream
+
+        # stream and stop: printing 25 rows of a 100 GB dataset must not
+        # load the dataset (the reference's driver-side
+        # ParquetFileTraversable iterates the same way)
+        remaining = args.limit
+        stream = open_read_stream(
+            args.input, chunk_rows=max(min(remaining, 1 << 16), 1))
+        for table in stream:
+            for row in table.slice(0, remaining).to_pylist():
+                print({k: v for k, v in row.items() if v is not None})
+            remaining -= min(table.num_rows, remaining)
+            if remaining <= 0:
+                break
         return 0
 
 
@@ -761,10 +795,32 @@ class ListDictCommand(Command):
         p.add_argument("input")
 
     def run(self, args) -> int:
-        from ..io.dispatch import load_reads, sequence_dictionary_from_reads
-        table, seq_dict, _ = load_reads(args.input)
+        from ..io.stream import open_read_stream
+        from ..models.dictionary import SequenceDictionary
+        from ..parallel.pipeline import _accumulate_seq_records
+
+        # SAM/BAM answer from the header without reading the body; Parquet
+        # accumulates the denormalized columns (primary AND mate) chunk by
+        # chunk (the reference's scan+dedup, AdamContext.scala:175-236) —
+        # either way the file never materializes whole.  The projection
+        # intersects with the dataset schema so a column-subset dataset
+        # cannot fail the select (sequence/qual bytes are the bulk of a
+        # reads file; reading them to list contigs would be absurd).
+        wanted = ("referenceId", "referenceName", "referenceLength",
+                  "referenceUrl", "mateReferenceId", "mateReference",
+                  "mateReferenceLength", "mateReferenceUrl")
+        columns = None
+        if os.path.isdir(args.input) or args.input.endswith(".parquet"):
+            import pyarrow.dataset as ds
+            avail = set(ds.dataset(args.input, format="parquet").schema.names)
+            columns = [c for c in wanted if c in avail] or None
+        stream = open_read_stream(args.input, columns=columns)
+        seq_dict = stream.seq_dict
         if seq_dict is None:
-            seq_dict = sequence_dictionary_from_reads(table)
+            seen: dict = {}
+            for table in stream:
+                _accumulate_seq_records(table, seen)
+            seq_dict = SequenceDictionary(seen.values())
         for rec in seq_dict:
             print(f"{rec.id}\t{rec.name}\t{rec.length}\t{rec.url or ''}")
         return 0

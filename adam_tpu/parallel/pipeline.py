@@ -951,6 +951,69 @@ def route_slices_to_dirs(table: pa.Table, key: np.ndarray, workdir: str,
 
 
 
+from contextlib import contextmanager
+
+
+@contextmanager
+def windowed_pileups(input_path: str, *, allow_non_primary: bool = False,
+                     chunk_rows: int = 1 << 20, window_bp: int = 1 << 20,
+                     workdir: Optional[str] = None, wopts: dict = None):
+    """Spill a read stream's pileups into genome windows, then yield
+    ``(n_reads, windows)`` where ``windows`` iterates per-window pileup
+    tables in genome order.  Positions never cross a window, so per-window
+    processing (aggregation, mpileup text) equals the global
+    position-grouped traversal.  Shared by streaming reads2ref -aggregate
+    and streaming mpileup."""
+    from ..io.parquet import load_table, locus_predicate
+    from ..io.stream import open_read_stream
+    from ..ops.pileup import reads_to_pileups
+
+    wopts = wopts or {}
+    window_bits = max((window_bp - 1).bit_length(), 1)
+    filters = None if allow_non_primary else locus_predicate()
+    # open the stream BEFORE creating a temp workdir: a bad path must not
+    # leak an adam_tpu_pileupwin_* dir per failed invocation
+    stream = open_read_stream(input_path, filters=filters,
+                              chunk_rows=chunk_rows)
+    own = workdir is None
+    if own:
+        workdir = tempfile.mkdtemp(prefix="adam_tpu_pileupwin_")
+    os.makedirs(workdir, exist_ok=True)
+    import glob as _glob
+    for stale in _glob.glob(os.path.join(workdir, "win-*")):
+        shutil.rmtree(stale, ignore_errors=True)   # a previous run's rows
+    #                                                must not aggregate in
+    win_dirs: dict = {}
+    try:
+        n_reads = 0
+        chunk_i = 0
+        for table in stream:
+            n_reads += table.num_rows
+            p = reads_to_pileups(table)
+            if not p.num_rows:
+                continue
+            refid = column_int64(p, "referenceId", -1)
+            posi = column_int64(p, "position", -1)
+            win = np.maximum(posi, 0) >> window_bits
+            key = np.where(refid >= 0, refid * (1 << 40) + win, -1)
+            route_slices_to_dirs(
+                p, key, workdir, chunk_i, win_dirs, wopts,
+                lambda k: f"win-{k & ((1 << 64) - 1):016x}")
+            chunk_i += 1
+
+        def windows():
+            for k in sorted(win_dirs):
+                yield load_table(win_dirs[k])
+
+        yield n_reads, windows()
+    finally:
+        if own:
+            shutil.rmtree(workdir, ignore_errors=True)
+        else:
+            for d in win_dirs.values():
+                shutil.rmtree(d, ignore_errors=True)
+
+
 def streaming_reads2ref(input_path: str, output_path: str, *,
                         aggregate: bool = False,
                         allow_non_primary: bool = False,
@@ -990,16 +1053,16 @@ def streaming_reads2ref(input_path: str, output_path: str, *,
 
     wopts = dict(compression=compression, page_size=page_size,
                  use_dictionary=use_dictionary)
-    filters = None if allow_non_primary else locus_predicate()
-    stream = open_read_stream(input_path, filters=filters,
-                              chunk_rows=chunk_rows)
     _purge_stale_parts(output_path)
     out = DatasetWriter(output_path, part_rows=chunk_rows,
                         row_group_bytes=row_group_bytes, **wopts)
-    n_reads = 0
     n_out = 0
 
     if not aggregate:
+        filters = None if allow_non_primary else locus_predicate()
+        stream = open_read_stream(input_path, filters=filters,
+                                  chunk_rows=chunk_rows)
+        n_reads = 0
         for table in stream:
             n_reads += table.num_rows
             p = reads_to_pileups(table)
@@ -1008,47 +1071,17 @@ def streaming_reads2ref(input_path: str, output_path: str, *,
         out.close()
         return n_reads, n_out
 
-    # round UP to a power of two: the flag documents a width, and a
-    # silent round-down would halve the promised window
-    window_bits = max((window_bp - 1).bit_length(), 1)
-    own_workdir = workdir is None
-    if own_workdir:
-        workdir = tempfile.mkdtemp(prefix="adam_tpu_reads2ref_")
-    os.makedirs(workdir, exist_ok=True)
-    import glob as _glob
-    for stale in _glob.glob(os.path.join(workdir, "win-*")):
-        shutil.rmtree(stale, ignore_errors=True)   # a previous run's rows
-    #                                                must not aggregate in
-    win_dirs: dict = {}
-    try:
-        chunk_i = 0
-        for table in stream:
-            n_reads += table.num_rows
-            p = reads_to_pileups(table)
-            if not p.num_rows:
-                continue
-            refid = column_int64(p, "referenceId", -1)
-            posi = column_int64(p, "position", -1)
-            win = np.maximum(posi, 0) >> window_bits
-            key = np.where(refid >= 0, refid * (1 << 40) + win, -1)
-            route_slices_to_dirs(
-                p, key, workdir, chunk_i, win_dirs, wopts,
-                lambda k: f"win-{k & ((1 << 64) - 1):016x}")
-            chunk_i += 1
-        # windows emit in genome order ((refid, window) == sorted key) so
-        # the output dataset reads back position-grouped
-        for k in sorted(win_dirs):
-            agg = aggregate_pileups(load_table(win_dirs[k]))
+    # windows emit in genome order ((refid, window) == sorted key) so the
+    # output dataset reads back position-grouped
+    with windowed_pileups(input_path, allow_non_primary=allow_non_primary,
+                          chunk_rows=chunk_rows, window_bp=window_bp,
+                          workdir=workdir, wopts=wopts) as (n_reads, wins):
+        for wtbl in wins:
+            agg = aggregate_pileups(wtbl)
             n_out += agg.num_rows
             out.write(agg)
-        out.close()
-        return n_reads, n_out
-    finally:
-        if own_workdir:
-            shutil.rmtree(workdir, ignore_errors=True)
-        else:
-            for d in win_dirs.values():
-                shutil.rmtree(d, ignore_errors=True)
+    out.close()
+    return n_reads, n_out
 
 
 # ---------------------------------------------------------------------------
