@@ -376,12 +376,36 @@ class AggregatePileupsCommand(Command):
         p.add_argument("input", help="pileup Parquet dataset")
         p.add_argument("output", help="output pileup Parquet dataset")
         p.add_argument("-parts", type=int, default=1)
+        p.add_argument("-stream", action="store_true",
+                       help="windowed bounded-memory aggregation "
+                            "(auto-enabled for inputs over 1 GB)")
+        p.add_argument("-no_stream", action="store_true")
+        p.add_argument("-window_bp", type=int, default=1 << 20)
+        p.add_argument("-stream_chunk_rows", type=int, default=1 << 20)
         add_parquet_args(p)
 
     def run(self, args) -> int:
         from ..io.parquet import load_table
         from ..ops.pileup import aggregate_pileups
 
+        if should_stream(args, args.input):
+            if args.parts != 1:
+                import sys
+                print("warning: -parts is ignored by the streaming path "
+                      "(part size follows -stream_chunk_rows); use "
+                      "-no_stream for the in-memory writer",
+                      file=sys.stderr)
+            from ..parallel.pipeline import streaming_aggregate_pileups
+            pw = parquet_writer_kwargs(args)
+            n_in, n_out = streaming_aggregate_pileups(
+                args.input, args.output, window_bp=args.window_bp,
+                chunk_rows=args.stream_chunk_rows,
+                compression=pw["compression"] or "none",
+                page_size=pw["page_size"],
+                use_dictionary=pw["use_dictionary"],
+                row_group_bytes=args.parquet_block_size)
+            print(f"aggregated {n_in} -> {n_out} pileups")
+            return 0
         pileups = load_table(args.input)
         # external data: fail loudly on null required fields (the reference
         # NPEs in combineEvidence; we raise up front)

@@ -955,6 +955,54 @@ from contextlib import contextmanager
 
 
 @contextmanager
+def windowed_tables(tables_iter, *, window_bp: int = 1 << 20,
+                    workdir: Optional[str] = None, wopts: dict = None,
+                    prefix: str = "win"):
+    """Route (referenceId, position)-keyed tables into power-of-two genome
+    windows on disk, then yield an iterator of per-window tables in genome
+    order.  The single windowing engine behind streaming reads2ref
+    -aggregate, mpileup, aggregate_pileups, and compute_variants —
+    exact-position partitioning makes window-wise group-bys equal the
+    global ones."""
+    from ..io.parquet import load_table
+
+    wopts = wopts or {}
+    window_bits = max((window_bp - 1).bit_length(), 1)
+    own = workdir is None
+    if own:
+        workdir = tempfile.mkdtemp(prefix="adam_tpu_window_")
+    os.makedirs(workdir, exist_ok=True)
+    import glob as _glob
+    for stale in _glob.glob(os.path.join(workdir, prefix + "-*")):
+        shutil.rmtree(stale, ignore_errors=True)   # a previous run's rows
+    #                                                must not aggregate in
+    win_dirs: dict = {}
+    try:
+        for chunk_i, table in enumerate(tables_iter):
+            if not table.num_rows:
+                continue
+            refid = column_int64(table, "referenceId", -1)
+            posi = column_int64(table, "position", -1)
+            win = np.maximum(posi, 0) >> window_bits
+            key = np.where(refid >= 0, refid * (1 << 40) + win, -1)
+            route_slices_to_dirs(
+                table, key, workdir, chunk_i, win_dirs, wopts,
+                lambda k: f"{prefix}-{k & ((1 << 64) - 1):016x}")
+
+        def windows():
+            for k in sorted(win_dirs):
+                yield load_table(win_dirs[k])
+
+        yield windows()
+    finally:
+        if own:
+            shutil.rmtree(workdir, ignore_errors=True)
+        else:
+            for d in win_dirs.values():
+                shutil.rmtree(d, ignore_errors=True)
+
+
+@contextmanager
 def windowed_pileups(input_path: str, *, allow_non_primary: bool = False,
                      chunk_rows: int = 1 << 20, window_bp: int = 1 << 20,
                      workdir: Optional[str] = None, wopts: dict = None):
@@ -962,56 +1010,28 @@ def windowed_pileups(input_path: str, *, allow_non_primary: bool = False,
     ``(n_reads, windows)`` where ``windows`` iterates per-window pileup
     tables in genome order.  Positions never cross a window, so per-window
     processing (aggregation, mpileup text) equals the global
-    position-grouped traversal.  Shared by streaming reads2ref -aggregate
-    and streaming mpileup."""
-    from ..io.parquet import load_table, locus_predicate
+    position-grouped traversal."""
+    from ..io.parquet import locus_predicate
     from ..io.stream import open_read_stream
     from ..ops.pileup import reads_to_pileups
 
-    wopts = wopts or {}
-    window_bits = max((window_bp - 1).bit_length(), 1)
     filters = None if allow_non_primary else locus_predicate()
     # open the stream BEFORE creating a temp workdir: a bad path must not
-    # leak an adam_tpu_pileupwin_* dir per failed invocation
+    # leak a temp dir per failed invocation
     stream = open_read_stream(input_path, filters=filters,
                               chunk_rows=chunk_rows)
-    own = workdir is None
-    if own:
-        workdir = tempfile.mkdtemp(prefix="adam_tpu_pileupwin_")
-    os.makedirs(workdir, exist_ok=True)
-    import glob as _glob
-    for stale in _glob.glob(os.path.join(workdir, "win-*")):
-        shutil.rmtree(stale, ignore_errors=True)   # a previous run's rows
-    #                                                must not aggregate in
-    win_dirs: dict = {}
-    try:
-        n_reads = 0
-        chunk_i = 0
+    counted = {"n": 0}
+
+    def pileup_chunks():
         for table in stream:
-            n_reads += table.num_rows
-            p = reads_to_pileups(table)
-            if not p.num_rows:
-                continue
-            refid = column_int64(p, "referenceId", -1)
-            posi = column_int64(p, "position", -1)
-            win = np.maximum(posi, 0) >> window_bits
-            key = np.where(refid >= 0, refid * (1 << 40) + win, -1)
-            route_slices_to_dirs(
-                p, key, workdir, chunk_i, win_dirs, wopts,
-                lambda k: f"win-{k & ((1 << 64) - 1):016x}")
-            chunk_i += 1
+            counted["n"] += table.num_rows
+            yield reads_to_pileups(table)
 
-        def windows():
-            for k in sorted(win_dirs):
-                yield load_table(win_dirs[k])
-
-        yield n_reads, windows()
-    finally:
-        if own:
-            shutil.rmtree(workdir, ignore_errors=True)
-        else:
-            for d in win_dirs.values():
-                shutil.rmtree(d, ignore_errors=True)
+    with windowed_tables(pileup_chunks(), window_bp=window_bp,
+                         workdir=workdir, wopts=wopts) as wins:
+        # the spill ran eagerly inside windowed_tables, so the count is
+        # final by the time it yields
+        yield counted["n"], wins
 
 
 def streaming_reads2ref(input_path: str, output_path: str, *,
@@ -1097,57 +1117,77 @@ def streaming_compute_variants(input_path: str, output_base: str, *,
     """``compute_variants`` over a bounded-memory genotype stream.
 
     The reference's groupBy-position shuffle (AdamRDDFunctions.scala:
-    422-434) becomes the same windowed routing as streaming reads2ref:
-    variant synthesis is per (site, allele), and windows partition sites
-    exactly, so window-wise conversion equals the global groupBy.  The
-    genotypes copy through to ``<base>.g`` as they stream (the reference
-    writes both datasets, ComputeVariants.scala:55-72).
+    422-434) becomes the shared windowed routing: variant synthesis is
+    per (site, allele), and windows partition sites exactly, so
+    window-wise conversion equals the global groupBy.  The genotypes copy
+    through to ``<base>.g`` as they stream (the reference writes both
+    datasets, ComputeVariants.scala:55-72).
 
     Returns (n_genotypes, n_variants).
     """
     from ..converters.genotypes_to_variants import convert_genotypes
-    from ..io.parquet import DatasetWriter, iter_tables, load_table
+    from ..io.parquet import DatasetWriter, iter_tables
 
     wopts = dict(compression=compression)
-    window_bits = max((window_bp - 1).bit_length(), 1)
-    own = workdir is None
-    if own:
-        workdir = tempfile.mkdtemp(prefix="adam_tpu_cv_")
-    os.makedirs(workdir, exist_ok=True)
-    import glob as _glob
-    for stale in _glob.glob(os.path.join(workdir, "gwin-*")):
-        shutil.rmtree(stale, ignore_errors=True)
     _purge_stale_parts(output_base + ".v")
     _purge_stale_parts(output_base + ".g")
     v_out = DatasetWriter(output_base + ".v", part_rows=chunk_rows, **wopts)
     g_out = DatasetWriter(output_base + ".g", part_rows=chunk_rows, **wopts)
-    win_dirs: dict = {}
-    n_geno = 0
-    n_var = 0
-    try:
-        chunk_i = 0
+    counted = {"n": 0}
+
+    def chunks():
         for table in iter_tables(input_path, chunk_rows=chunk_rows):
-            n_geno += table.num_rows
+            counted["n"] += table.num_rows
             g_out.write(table)
-            refid = column_int64(table, "referenceId", -1)
-            posi = column_int64(table, "position", -1)
-            win = np.maximum(posi, 0) >> window_bits
-            key = np.where(refid >= 0, refid * (1 << 40) + win, -1)
-            route_slices_to_dirs(
-                table, key, workdir, chunk_i, win_dirs, wopts,
-                lambda k: f"gwin-{k & ((1 << 64) - 1):016x}")
-            chunk_i += 1
+            yield table
+
+    n_var = 0
+    with windowed_tables(chunks(), window_bp=window_bp, workdir=workdir,
+                         wopts=wopts, prefix="gwin") as wins:
         g_out.close()
-        for k in sorted(win_dirs):
-            variants = convert_genotypes(load_table(win_dirs[k]),
-                                         validate=validate, strict=strict)
+        for wtbl in wins:
+            variants = convert_genotypes(wtbl, validate=validate,
+                                         strict=strict)
             n_var += variants.num_rows
             v_out.write(variants)
-        v_out.close()
-        return n_geno, n_var
-    finally:
-        if own:
-            shutil.rmtree(workdir, ignore_errors=True)
-        else:
-            for d in win_dirs.values():
-                shutil.rmtree(d, ignore_errors=True)
+    v_out.close()
+    return counted["n"], n_var
+
+
+def streaming_aggregate_pileups(input_path: str, output_path: str, *,
+                                chunk_rows: int = 1 << 20,
+                                window_bp: int = 1 << 20,
+                                workdir: Optional[str] = None,
+                                compression: str = "zstd",
+                                page_size: Optional[int] = None,
+                                use_dictionary: bool = True,
+                                row_group_bytes: Optional[int] = None
+                                ) -> Tuple[int, int]:
+    """``aggregate_pileups`` over a bounded-memory pileup stream: the same
+    exact-position window routing as streaming reads2ref -aggregate, fed
+    by an existing pileup dataset instead of a read stream
+    (PileupAggregator.scala:200-218's coverage-scaled groupBy)."""
+    from ..io.parquet import DatasetWriter, iter_tables
+    from ..ops.pileup import aggregate_pileups
+
+    wopts = dict(compression=compression, page_size=page_size,
+                 use_dictionary=use_dictionary)
+    _purge_stale_parts(output_path)
+    out = DatasetWriter(output_path, part_rows=chunk_rows,
+                        row_group_bytes=row_group_bytes, **wopts)
+    counted = {"n": 0}
+
+    def chunks():
+        for table in iter_tables(input_path, chunk_rows=chunk_rows):
+            counted["n"] += table.num_rows
+            yield table
+
+    n_out = 0
+    with windowed_tables(chunks(), window_bp=window_bp, workdir=workdir,
+                         wopts=wopts) as wins:
+        for wtbl in wins:
+            agg = aggregate_pileups(wtbl, validate=True)
+            n_out += agg.num_rows
+            out.write(agg)
+    out.close()
+    return counted["n"], n_out

@@ -477,3 +477,27 @@ def test_streaming_compute_variants_matches_inmemory(resources, tmp_path):
                           ("variant", "ascending")])
     assert key(got.select(ref.column_names)).equals(key(ref))
     assert load_table(str(tmp_path / "out.g")).equals(genotypes)
+
+
+def test_streaming_aggregate_pileups_matches_inmemory(resources, tmp_path):
+    from adam_tpu.io.dispatch import load_reads
+    from adam_tpu.io.parquet import load_table, save_table
+    from adam_tpu.ops.pileup import aggregate_pileups, reads_to_pileups
+    from adam_tpu.parallel.pipeline import streaming_aggregate_pileups
+
+    table, _, _ = load_reads(str(resources / "small_realignment_targets.sam"))
+    pileups = reads_to_pileups(table)
+    ppath = tmp_path / "p"
+    save_table(pileups, str(ppath))
+    ref = aggregate_pileups(pileups, validate=True)
+
+    n_in, n_out = streaming_aggregate_pileups(
+        str(ppath), str(tmp_path / "agg"), chunk_rows=17, window_bp=64)
+    assert n_in == pileups.num_rows and n_out == ref.num_rows
+    got = load_table(str(tmp_path / "agg"))
+
+    def key(t):
+        return t.sort_by([(c, "ascending") for c in
+                          ("referenceId", "position", "rangeOffset",
+                           "readBase")])
+    assert key(got.select(ref.column_names)).equals(key(ref))
