@@ -447,6 +447,10 @@ class Adam2VcfCommand(Command):
     def add_args(self, p: argparse.ArgumentParser) -> None:
         p.add_argument("input", help="basename of .v/.g datasets")
         p.add_argument("output", help="output VCF file")
+        p.add_argument("-stream", action="store_true",
+                       help="windowed bounded-memory VCF text (plain .vcf "
+                            "only; auto-enabled over 1 GB)")
+        p.add_argument("-no_stream", action="store_true")
 
     def run(self, args) -> int:
         import os
@@ -455,6 +459,13 @@ class Adam2VcfCommand(Command):
         from ..io.parquet import load_table
         from ..io.vcf import write_vcf
 
+        if should_stream(args, args.input + ".v", args.input + ".g") and \
+                not str(args.output).endswith((".gz", ".bgz", ".bcf")):
+            from ..parallel.pipeline import streaming_adam2vcf
+            n_v, n_g = streaming_adam2vcf(args.input, args.output)
+            print(f"wrote {n_v} variants / {n_g} genotypes to "
+                  f"{args.output}")
+            return 0
         variants = load_table(args.input + ".v")
         if os.path.exists(args.input + ".g"):
             genotypes = load_table(args.input + ".g")
@@ -605,14 +616,27 @@ class FindReadsCommand(Command):
                             "semicolon-separated filters AND together")
         p.add_argument("-file", default=None,
                        help="write matching read names to this file")
+        p.add_argument("-stream", action="store_true",
+                       help="name-hash bucketed bounded-memory traversal "
+                            "(auto-enabled over 1 GB)")
+        p.add_argument("-no_stream", action="store_true")
 
     def run(self, args) -> int:
         from ..compare.engine import ComparisonTraversalEngine, parse_filters
         from ..io.dispatch import load_reads_union
-        t1, sd1, _ = load_reads_union(args.input1.split(","))
-        t2, sd2, _ = load_reads_union(args.input2.split(","))
-        engine = ComparisonTraversalEngine(t1, t2, sd1, sd2)
-        names = engine.find(parse_filters(args.filter))
+        p1, p2 = args.input1.split(","), args.input2.split(",")
+        filters = parse_filters(args.filter)
+        if should_stream(args, *(p1 + p2)):
+            from ..compare.engine import streaming_compare
+            # comparisons=[]: the filters drive the traversal; histogram
+            # aggregation would be pure waste here
+            r = streaming_compare(p1, p2, [], find_filters=filters)
+            names = sorted(r["matching_names"])
+        else:
+            t1, sd1, _ = load_reads_union(p1)
+            t2, sd2, _ = load_reads_union(p2)
+            engine = ComparisonTraversalEngine(t1, t2, sd1, sd2)
+            names = engine.find(filters)
         if args.file:
             with open(args.file, "w") as f:
                 f.write("\n".join(names) + ("\n" if names else ""))

@@ -623,7 +623,8 @@ COMPARE_COLUMNS = ("readName", "flags", "start", "referenceId", "mapq",
 
 def streaming_compare(paths1, paths2, comparisons, *, n_buckets: int = 32,
                       chunk_rows: int = 1 << 20,
-                      workdir: Optional[str] = None) -> dict:
+                      workdir: Optional[str] = None,
+                      find_filters: Optional[Sequence] = None) -> dict:
     """Bounded-memory compare: both inputs spill into name-hash buckets,
     then each bucket runs the columnar traversal independently and the
     histograms/counters merge (they are monoids, like everything the
@@ -725,8 +726,10 @@ def streaming_compare(paths1, paths2, comparisons, *, n_buckets: int = 32,
         totals = dict(n_names_1=0, n_names_2=0, unique_to_1=0,
                       unique_to_2=0, n_joined=0)
         hists = {c.name: Histogram() for c in comparisons}
+        matching: list = []
         if schemas[0] is None:                    # both inputs empty
-            return {"totals": totals, "histograms": hists}
+            return {"totals": totals, "histograms": hists,
+                    "matching_names": matching}
         for b in range(n_buckets):
             sides = []
             for side in (0, 1):
@@ -746,7 +749,12 @@ def streaming_compare(paths1, paths2, comparisons, *, n_buckets: int = 32,
             totals["n_joined"] += engine.n_joined
             for name, h in engine.aggregate_all(comparisons).items():
                 hists[name] = hists[name] + h
-        return {"totals": totals, "histograms": hists}
+            if find_filters is not None:
+                # a name lives in exactly one bucket, so per-bucket finds
+                # concatenate without dedup (the findreads path)
+                matching.extend(engine.find(find_filters))
+        return {"totals": totals, "histograms": hists,
+                "matching_names": matching}
     finally:
         if own:
             shutil.rmtree(workdir, ignore_errors=True)

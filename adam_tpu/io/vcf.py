@@ -281,172 +281,188 @@ def write_vcf(variants: pa.Table, genotypes: pa.Table, path_or_file,
         out = open(path_or_file, "wt")
         close = True
     try:
-        out.write("##fileformat=VCFv4.1\n")
-        out.write('##INFO=<ID=NS,Number=1,Type=Integer,Description="Number of Samples With Data">\n')
-        out.write('##INFO=<ID=DP,Number=1,Type=Integer,Description="Total Depth">\n')
-        out.write('##INFO=<ID=AF,Number=A,Type=Float,Description="Allele Frequency">\n')
-        out.write('##INFO=<ID=BQ,Number=1,Type=Integer,Description="RMS Base Quality">\n')
-        out.write('##INFO=<ID=MQ,Number=1,Type=Integer,Description="RMS Mapping Quality">\n')
-        out.write('##INFO=<ID=MQ0,Number=1,Type=Integer,Description="Number of MapQ=0 Reads">\n')
-        out.write('##INFO=<ID=SVTYPE,Number=1,Type=String,Description="Type of structural variant">\n')
-        out.write('##INFO=<ID=SVLEN,Number=.,Type=Integer,Description="Difference in length between REF and ALT alleles">\n')
-        out.write('##INFO=<ID=END,Number=1,Type=Integer,Description="End position of the variant">\n')
-        out.write('##INFO=<ID=IMPRECISE,Number=0,Type=Flag,Description="Imprecise structural variation">\n')
-        out.write('##INFO=<ID=CIPOS,Number=2,Type=Integer,Description="Confidence interval around POS">\n')
-        out.write('##INFO=<ID=CIEND,Number=2,Type=Integer,Description="Confidence interval around END">\n')
-        out.write('##FORMAT=<ID=GT,Number=1,Type=String,Description="Genotype">\n')
-        out.write('##FORMAT=<ID=GQ,Number=1,Type=Integer,Description="Genotype Quality">\n')
-        out.write('##FORMAT=<ID=DP,Number=1,Type=Integer,Description="Read Depth">\n')
-        out.write('##FORMAT=<ID=HQ,Number=2,Type=Integer,Description="Haplotype Quality">\n')
-        out.write('##FORMAT=<ID=PL,Number=G,Type=Integer,Description="Phred-scaled Genotype Likelihoods">\n')
-        out.write('##FORMAT=<ID=GP,Number=G,Type=Float,Description="Phred-scaled Genotype Posteriors">\n')
-        out.write('##FORMAT=<ID=GQL,Number=.,Type=String,Description="Ploidy-state Genotype Likelihoods">\n')
-        out.write('##FORMAT=<ID=MQ,Number=1,Type=Integer,Description="RMS Mapping Quality">\n')
-        out.write('##FORMAT=<ID=PS,Number=1,Type=String,Description="Phase Set">\n')
-        out.write('##FORMAT=<ID=PQ,Number=1,Type=Integer,Description="Phasing Quality">\n')
-        if seq_dict is None:
-            # rebuild contig lines from the denormalized variant columns
-            seen: Dict[str, int] = {}
-            for v in variants.select(["referenceName",
-                                      "referenceLength"]).to_pylist():
-                if v["referenceName"] is not None and \
-                        v["referenceName"] not in seen:
-                    seen[v["referenceName"]] = v["referenceLength"] or 0
-            seq_dict = SequenceDictionary(
-                SequenceRecord(i, n, l) for i, (n, l) in
-                enumerate(seen.items()))
-        for rec in seq_dict:
-            out.write(f"##contig=<ID={rec.name},length={rec.length}>\n")
-
-        g_by_site: Dict[Tuple, List[dict]] = {}
         sample_order: List[str] = []
-        for g in genotypes.to_pylist():
-            g_by_site.setdefault((g["referenceName"], g["position"]),
-                                 []).append(g)
-            if g["sampleId"] not in sample_order:
-                sample_order.append(g["sampleId"])
-
-        header = ["#CHROM", "POS", "ID", "REF", "ALT", "QUAL", "FILTER",
-                  "INFO"]
-        if sample_order:
-            header += ["FORMAT"] + sample_order
-        out.write("\t".join(header) + "\n")
-
-        v_by_site: Dict[Tuple, List[dict]] = {}
-        for v in variants.to_pylist():
-            v_by_site.setdefault((v["referenceName"], v["position"]),
-                                 []).append(v)
-        # reference-only sites (ALT=".") exist only in the genotype table
-        for (chrom, pos), gs in g_by_site.items():
-            v_by_site.setdefault((chrom, pos), [])
-
-        for (chrom, pos), vs in sorted(v_by_site.items(),
-                                       key=lambda kv: (kv[0][0] or "",
-                                                       kv[0][1])):
-            site_genotypes = g_by_site.get((chrom, pos), [])
-            ref = vs[0]["referenceAllele"] if vs else \
-                site_genotypes[0]["referenceAllele"]
-            # reference-allele variant rows (computed site stats) never
-            # appear in ALT — only true alternate alleles do
-            alt_vs = [v for v in vs if not v.get("isReference")]
-            # Complex (symbolic) alleles carry no base string; rebuild the
-            # symbolic ALT from the SV type (the base string is likewise
-            # unrecoverable in the reference, convertType :244-252)
-            alts = [v["variant"] if v["variant"] is not None else
-                    "<%s>" % _SV_CODE_OF_TYPE.get(v.get("svType") or "UNK",
-                                                  v.get("svType") or "UNK")
-                    for v in alt_vs]
-            vs = alt_vs or vs
-            if not vs:
-                vs = [{key: None for key in
-                       ("id", "quality", "filters", "numberOfSamplesWithData",
-                        "totalSiteMapCounts", "alleleFrequency",
-                        "siteRmsMappingQuality", "siteMapQZeroCounts")} |
-                      {"filtersRun": False}]
-            info_parts = []
-            if vs[0]["numberOfSamplesWithData"] is not None:
-                info_parts.append(f"NS={vs[0]['numberOfSamplesWithData']}")
-            if vs[0]["totalSiteMapCounts"] is not None:
-                info_parts.append(f"DP={vs[0]['totalSiteMapCounts']}")
-            afs = [v["alleleFrequency"] for v in vs]
-            if any(a is not None for a in afs):
-                info_parts.append(
-                    "AF=" + ",".join("." if a is None else f"{a:g}"
-                                     for a in afs))
-            if vs[0].get("rmsBaseQuality") is not None:
-                info_parts.append(f"BQ={vs[0]['rmsBaseQuality']}")
-            if vs[0]["siteRmsMappingQuality"] is not None:
-                info_parts.append(f"MQ={vs[0]['siteRmsMappingQuality']}")
-            if vs[0]["siteMapQZeroCounts"] is not None:
-                info_parts.append(f"MQ0={vs[0]['siteMapQZeroCounts']}")
-            if vs[0].get("svType") is not None:
-                # unmapped codes (BND etc.) were kept raw — emit verbatim
-                info_parts.append(
-                    "SVTYPE="
-                    f"{_SV_CODE_OF_TYPE.get(vs[0]['svType'], vs[0]['svType'])}")
-                if vs[0].get("svIsPrecise") is False:
-                    info_parts.append("IMPRECISE")
-                if vs[0].get("svLength") is not None:
-                    info_parts.append(f"SVLEN={vs[0]['svLength']}")
-                if vs[0].get("svEnd") is not None:
-                    info_parts.append(f"END={vs[0]['svEnd'] + 1}")
-                if vs[0].get("svConfidenceIntervalStartLow") is not None:
-                    info_parts.append(
-                        f"CIPOS={vs[0]['svConfidenceIntervalStartLow']},"
-                        f"{vs[0]['svConfidenceIntervalStartHigh']}")
-                if vs[0].get("svConfidenceIntervalEndLow") is not None:
-                    info_parts.append(
-                        f"CIEND={vs[0]['svConfidenceIntervalEndLow']},"
-                        f"{vs[0]['svConfidenceIntervalEndHigh']}")
-            filt = "." if not vs[0]["filtersRun"] else \
-                (vs[0]["filters"] or "PASS")
-            row = [chrom, str(pos + 1), vs[0]["id"] or ".", ref,
-                   ",".join(alts) or ".",
-                   str(vs[0]["quality"]) if vs[0]["quality"] is not None else ".",
-                   filt, ";".join(info_parts) or "."]
-
-            site_gs = g_by_site.get((chrom, pos), [])
-            if sample_order:
-                # per-site FORMAT: GT plus whichever fields any sample
-                # carries (the reference round-trips GQ/DP/HQ/PL/GP/GQL/
-                # MQ/PS/PQ, VariantContextConverter.scala:362-449)
-                field_of = {"GQ": "genotypeQuality", "DP": "depth",
-                            "HQ": "haplotypeQuality",
-                            "PL": "phredLikelihoods",
-                            "GP": "phredPosteriorLikelihoods",
-                            "GQL": "ploidyStateGenotypeLikelihoods",
-                            "MQ": "rmsMapQuality", "PS": "phaseSetId",
-                            "PQ": "phaseQuality"}
-                keys = [k for k, fld in field_of.items()
-                        if any(g.get(fld) is not None for g in site_gs)]
-                row.append(":".join(["GT"] + keys))
-                alleles = [ref] + alts
-                for sample in sample_order:
-                    gs = sorted((g for g in site_gs
-                                 if g["sampleId"] == sample),
-                                key=lambda g: g["haplotypeNumber"] or 0)
-                    if not gs:
-                        row.append("./.")
-                        continue
-                    sep = "|" if gs[0]["isPhased"] else "/"
-                    calls = [str(alleles.index(g["allele"]))
-                             if g["allele"] in alleles else "." for g in gs]
-                    # pad half-calls back to declared ploidy ("0/." etc.)
-                    ploidy = gs[0]["ploidy"] or len(calls)
-                    calls += ["."] * (ploidy - len(calls))
-                    cols = [sep.join(calls)]
-                    for k in keys:
-                        if k == "HQ":  # one value per haplotype
-                            hqs = [g.get("haplotypeQuality") for g in gs]
-                            cols.append(
-                                ",".join("." if h is None else str(h)
-                                         for h in hqs)
-                                if any(h is not None for h in hqs) else ".")
-                            continue
-                        v = gs[0].get(field_of[k])
-                        cols.append("." if v is None else str(v))
-                    row.append(":".join(cols))
-            out.write("\t".join(row) + "\n")
+        for sid in genotypes.column("sampleId").to_pylist():
+            if sid not in sample_order:
+                sample_order.append(sid)
+        _write_vcf_header(out, variants, sample_order, seq_dict)
+        _write_vcf_records(out, variants, genotypes, sample_order)
     finally:
         if close:
             out.close()
+
+
+def _write_vcf_header(out, variants: pa.Table, sample_order: List[str],
+                      seq_dict: Optional[SequenceDictionary]) -> None:
+    """The ## metadata block + contig lines + #CHROM line with a FIXED
+    sample column order (VcfHeaderUtils.scala:34-131); split out so the
+    streaming adam2vcf can emit it once before windowed data lines."""
+    out.write("##fileformat=VCFv4.1\n")
+    out.write('##INFO=<ID=NS,Number=1,Type=Integer,Description="Number of Samples With Data">\n')
+    out.write('##INFO=<ID=DP,Number=1,Type=Integer,Description="Total Depth">\n')
+    out.write('##INFO=<ID=AF,Number=A,Type=Float,Description="Allele Frequency">\n')
+    out.write('##INFO=<ID=BQ,Number=1,Type=Integer,Description="RMS Base Quality">\n')
+    out.write('##INFO=<ID=MQ,Number=1,Type=Integer,Description="RMS Mapping Quality">\n')
+    out.write('##INFO=<ID=MQ0,Number=1,Type=Integer,Description="Number of MapQ=0 Reads">\n')
+    out.write('##INFO=<ID=SVTYPE,Number=1,Type=String,Description="Type of structural variant">\n')
+    out.write('##INFO=<ID=SVLEN,Number=.,Type=Integer,Description="Difference in length between REF and ALT alleles">\n')
+    out.write('##INFO=<ID=END,Number=1,Type=Integer,Description="End position of the variant">\n')
+    out.write('##INFO=<ID=IMPRECISE,Number=0,Type=Flag,Description="Imprecise structural variation">\n')
+    out.write('##INFO=<ID=CIPOS,Number=2,Type=Integer,Description="Confidence interval around POS">\n')
+    out.write('##INFO=<ID=CIEND,Number=2,Type=Integer,Description="Confidence interval around END">\n')
+    out.write('##FORMAT=<ID=GT,Number=1,Type=String,Description="Genotype">\n')
+    out.write('##FORMAT=<ID=GQ,Number=1,Type=Integer,Description="Genotype Quality">\n')
+    out.write('##FORMAT=<ID=DP,Number=1,Type=Integer,Description="Read Depth">\n')
+    out.write('##FORMAT=<ID=HQ,Number=2,Type=Integer,Description="Haplotype Quality">\n')
+    out.write('##FORMAT=<ID=PL,Number=G,Type=Integer,Description="Phred-scaled Genotype Likelihoods">\n')
+    out.write('##FORMAT=<ID=GP,Number=G,Type=Float,Description="Phred-scaled Genotype Posteriors">\n')
+    out.write('##FORMAT=<ID=GQL,Number=.,Type=String,Description="Ploidy-state Genotype Likelihoods">\n')
+    out.write('##FORMAT=<ID=MQ,Number=1,Type=Integer,Description="RMS Mapping Quality">\n')
+    out.write('##FORMAT=<ID=PS,Number=1,Type=String,Description="Phase Set">\n')
+    out.write('##FORMAT=<ID=PQ,Number=1,Type=Integer,Description="Phasing Quality">\n')
+    if seq_dict is None:
+        # rebuild contig lines from the denormalized variant columns
+        seen: Dict[str, int] = {}
+        for v in variants.select(["referenceName",
+                                  "referenceLength"]).to_pylist():
+            if v["referenceName"] is not None and \
+                    v["referenceName"] not in seen:
+                seen[v["referenceName"]] = v["referenceLength"] or 0
+        seq_dict = SequenceDictionary(
+            SequenceRecord(i, n, l) for i, (n, l) in
+            enumerate(seen.items()))
+    for rec in seq_dict:
+        out.write(f"##contig=<ID={rec.name},length={rec.length}>\n")
+
+    header = ["#CHROM", "POS", "ID", "REF", "ALT", "QUAL", "FILTER",
+              "INFO"]
+    if sample_order:
+        header += ["FORMAT"] + sample_order
+    out.write("\t".join(header) + "\n")
+
+
+def _write_vcf_records(out, variants: pa.Table, genotypes: pa.Table,
+                       sample_order: List[str]) -> None:
+    """Emit the data lines for one (variants, genotypes) slice with a FIXED
+    global sample column order — the slice-local body of :func:`write_vcf`,
+    callable per genome window by the streaming adam2vcf."""
+    g_by_site: Dict[Tuple, List[dict]] = {}
+    for g in genotypes.to_pylist():
+        g_by_site.setdefault((g["referenceName"], g["position"]),
+                             []).append(g)
+
+    v_by_site: Dict[Tuple, List[dict]] = {}
+    for v in variants.to_pylist():
+        v_by_site.setdefault((v["referenceName"], v["position"]),
+                             []).append(v)
+    # reference-only sites (ALT=".") exist only in the genotype table
+    for (chrom, pos), gs in g_by_site.items():
+        v_by_site.setdefault((chrom, pos), [])
+
+    for (chrom, pos), vs in sorted(v_by_site.items(),
+                                   key=lambda kv: (kv[0][0] or "",
+                                                   kv[0][1])):
+        site_genotypes = g_by_site.get((chrom, pos), [])
+        ref = vs[0]["referenceAllele"] if vs else \
+            site_genotypes[0]["referenceAllele"]
+        # reference-allele variant rows (computed site stats) never
+        # appear in ALT — only true alternate alleles do
+        alt_vs = [v for v in vs if not v.get("isReference")]
+        # Complex (symbolic) alleles carry no base string; rebuild the
+        # symbolic ALT from the SV type (the base string is likewise
+        # unrecoverable in the reference, convertType :244-252)
+        alts = [v["variant"] if v["variant"] is not None else
+                "<%s>" % _SV_CODE_OF_TYPE.get(v.get("svType") or "UNK",
+                                              v.get("svType") or "UNK")
+                for v in alt_vs]
+        vs = alt_vs or vs
+        if not vs:
+            vs = [{key: None for key in
+                   ("id", "quality", "filters", "numberOfSamplesWithData",
+                    "totalSiteMapCounts", "alleleFrequency",
+                    "siteRmsMappingQuality", "siteMapQZeroCounts")} |
+                  {"filtersRun": False}]
+        info_parts = []
+        if vs[0]["numberOfSamplesWithData"] is not None:
+            info_parts.append(f"NS={vs[0]['numberOfSamplesWithData']}")
+        if vs[0]["totalSiteMapCounts"] is not None:
+            info_parts.append(f"DP={vs[0]['totalSiteMapCounts']}")
+        afs = [v["alleleFrequency"] for v in vs]
+        if any(a is not None for a in afs):
+            info_parts.append(
+                "AF=" + ",".join("." if a is None else f"{a:g}"
+                                 for a in afs))
+        if vs[0].get("rmsBaseQuality") is not None:
+            info_parts.append(f"BQ={vs[0]['rmsBaseQuality']}")
+        if vs[0]["siteRmsMappingQuality"] is not None:
+            info_parts.append(f"MQ={vs[0]['siteRmsMappingQuality']}")
+        if vs[0]["siteMapQZeroCounts"] is not None:
+            info_parts.append(f"MQ0={vs[0]['siteMapQZeroCounts']}")
+        if vs[0].get("svType") is not None:
+            # unmapped codes (BND etc.) were kept raw — emit verbatim
+            info_parts.append(
+                "SVTYPE="
+                f"{_SV_CODE_OF_TYPE.get(vs[0]['svType'], vs[0]['svType'])}")
+            if vs[0].get("svIsPrecise") is False:
+                info_parts.append("IMPRECISE")
+            if vs[0].get("svLength") is not None:
+                info_parts.append(f"SVLEN={vs[0]['svLength']}")
+            if vs[0].get("svEnd") is not None:
+                info_parts.append(f"END={vs[0]['svEnd'] + 1}")
+            if vs[0].get("svConfidenceIntervalStartLow") is not None:
+                info_parts.append(
+                    f"CIPOS={vs[0]['svConfidenceIntervalStartLow']},"
+                    f"{vs[0]['svConfidenceIntervalStartHigh']}")
+            if vs[0].get("svConfidenceIntervalEndLow") is not None:
+                info_parts.append(
+                    f"CIEND={vs[0]['svConfidenceIntervalEndLow']},"
+                    f"{vs[0]['svConfidenceIntervalEndHigh']}")
+        filt = "." if not vs[0]["filtersRun"] else \
+            (vs[0]["filters"] or "PASS")
+        row = [chrom, str(pos + 1), vs[0]["id"] or ".", ref,
+               ",".join(alts) or ".",
+               str(vs[0]["quality"]) if vs[0]["quality"] is not None else ".",
+               filt, ";".join(info_parts) or "."]
+
+        site_gs = g_by_site.get((chrom, pos), [])
+        if sample_order:
+            # per-site FORMAT: GT plus whichever fields any sample
+            # carries (the reference round-trips GQ/DP/HQ/PL/GP/GQL/
+            # MQ/PS/PQ, VariantContextConverter.scala:362-449)
+            field_of = {"GQ": "genotypeQuality", "DP": "depth",
+                        "HQ": "haplotypeQuality",
+                        "PL": "phredLikelihoods",
+                        "GP": "phredPosteriorLikelihoods",
+                        "GQL": "ploidyStateGenotypeLikelihoods",
+                        "MQ": "rmsMapQuality", "PS": "phaseSetId",
+                        "PQ": "phaseQuality"}
+            keys = [k for k, fld in field_of.items()
+                    if any(g.get(fld) is not None for g in site_gs)]
+            row.append(":".join(["GT"] + keys))
+            alleles = [ref] + alts
+            for sample in sample_order:
+                gs = sorted((g for g in site_gs
+                             if g["sampleId"] == sample),
+                            key=lambda g: g["haplotypeNumber"] or 0)
+                if not gs:
+                    row.append("./.")
+                    continue
+                sep = "|" if gs[0]["isPhased"] else "/"
+                calls = [str(alleles.index(g["allele"]))
+                         if g["allele"] in alleles else "." for g in gs]
+                # pad half-calls back to declared ploidy ("0/." etc.)
+                ploidy = gs[0]["ploidy"] or len(calls)
+                calls += ["."] * (ploidy - len(calls))
+                cols = [sep.join(calls)]
+                for k in keys:
+                    if k == "HQ":  # one value per haplotype
+                        hqs = [g.get("haplotypeQuality") for g in gs]
+                        cols.append(
+                            ",".join("." if h is None else str(h)
+                                     for h in hqs)
+                            if any(h is not None for h in hqs) else ".")
+                        continue
+                    v = gs[0].get(field_of[k])
+                    cols.append("." if v is None else str(v))
+                row.append(":".join(cols))
+        out.write("\t".join(row) + "\n")

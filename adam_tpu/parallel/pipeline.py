@@ -957,7 +957,7 @@ from contextlib import contextmanager
 @contextmanager
 def windowed_tables(tables_iter, *, window_bp: int = 1 << 20,
                     workdir: Optional[str] = None, wopts: dict = None,
-                    prefix: str = "win"):
+                    prefix: str = "win", with_keys: bool = False):
     """Route (referenceId, position)-keyed tables into power-of-two genome
     windows on disk, then yield an iterator of per-window tables in genome
     order.  The single windowing engine behind streaming reads2ref
@@ -991,7 +991,8 @@ def windowed_tables(tables_iter, *, window_bp: int = 1 << 20,
 
         def windows():
             for k in sorted(win_dirs):
-                yield load_table(win_dirs[k])
+                t = load_table(win_dirs[k])
+                yield (k, t) if with_keys else t
 
         yield windows()
     finally:
@@ -1191,3 +1192,108 @@ def streaming_aggregate_pileups(input_path: str, output_path: str, *,
             out.write(agg)
     out.close()
     return counted["n"], n_out
+
+
+def streaming_adam2vcf(input_base: str, output_path: str, *,
+                       chunk_rows: int = 1 << 20,
+                       window_bp: int = 1 << 20,
+                       workdir: Optional[str] = None) -> Tuple[int, int]:
+    """``adam2vcf`` over bounded-memory variant/genotype streams.
+
+    Header facts that must be global — the sample column order and the
+    contig lines — come from cheap single-column pre-scans; the data
+    lines then emit window by window through the shared position router
+    (both datasets route with the SAME keys, merged so reference-only
+    sites that exist in one table still emit).  Output order follows the
+    sequence-dictionary ids, the VCF convention (the in-memory writer
+    orders by contig name).  Plain ``.vcf`` text only — the bgzf/bcf
+    forms buffer whole files and stay on the in-memory path.
+
+    Returns (n_variants, n_genotypes).
+    """
+    from contextlib import ExitStack
+
+    from .. import schema as S
+    from ..io.parquet import iter_tables
+    from ..io.vcf import _write_vcf_header, _write_vcf_records
+    from ..models.dictionary import SequenceDictionary, SequenceRecord
+
+    if str(output_path).endswith((".gz", ".bgz", ".bcf")):
+        raise ValueError("streaming adam2vcf writes plain .vcf text; "
+                         "use -no_stream for compressed/BCF output")
+
+    # pre-scan 1: global sample order (first appearance, like the
+    # in-memory writer); pre-scan 2: contig lines.  Both stay columnar —
+    # per-chunk pyarrow unique, then dedupe the small unique lists (a
+    # per-row Python loop over the >1 GB inputs this path exists for
+    # would be quadratic in the unique count).  A variants-only dataset
+    # (no .g — the in-memory path supports it) streams too.
+    import pyarrow.compute as pc
+    has_g = os.path.isdir(input_base + ".g") and any(
+        f.endswith(".parquet") for f in os.listdir(input_base + ".g"))
+    sample_order: list = []
+    seen_samples: set = set()
+    if has_g:
+        for t in iter_tables(input_base + ".g", columns=["sampleId"],
+                             chunk_rows=chunk_rows):
+            for sid in pc.unique(t.column("sampleId")).to_pylist():
+                if sid not in seen_samples:
+                    seen_samples.add(sid)
+                    sample_order.append(sid)
+    contigs: dict = {}
+    for t in iter_tables(input_base + ".v",
+                         columns=["referenceName", "referenceLength"],
+                         chunk_rows=chunk_rows):
+        grouped = t.group_by("referenceName").aggregate(
+            [("referenceLength", "max")])
+        for v in grouped.to_pylist():
+            if v["referenceName"] is not None and \
+                    v["referenceName"] not in contigs:
+                contigs[v["referenceName"]] = \
+                    v["referenceLength_max"] or 0
+    seq_dict = SequenceDictionary(
+        SequenceRecord(i, n, ln) for i, (n, ln) in
+        enumerate(contigs.items()))
+
+    counted = {"v": 0, "g": 0}
+
+    def chunks(path, key):
+        for t in iter_tables(path, chunk_rows=chunk_rows):
+            counted[key] += t.num_rows
+            yield t
+
+    with open(output_path, "wt") as out, ExitStack() as stack:
+        _write_vcf_header(out, S.VARIANT_SCHEMA.empty_table(),
+                          sample_order, seq_dict)
+
+        vw = stack.enter_context(windowed_tables(
+            chunks(input_base + ".v", "v"), window_bp=window_bp,
+            workdir=workdir, prefix="vwin", with_keys=True))
+        gw = stack.enter_context(windowed_tables(
+            chunks(input_base + ".g", "g") if has_g else iter(()),
+            window_bp=window_bp, workdir=workdir, prefix="gwin",
+            with_keys=True))
+        # two-pointer merge over the sorted window keys: a site may exist
+        # in either table alone (reference-only sites live in .g)
+        vi = iter(vw)
+        gi = iter(gw)
+        v_item = next(vi, None)
+        g_item = next(gi, None)
+        while v_item is not None or g_item is not None:
+            vk = v_item[0] if v_item is not None else None
+            gk = g_item[0] if g_item is not None else None
+            if gk is None or (vk is not None and vk < gk):
+                _write_vcf_records(out, v_item[1],
+                                   S.GENOTYPE_SCHEMA.empty_table(),
+                                   sample_order)
+                v_item = next(vi, None)
+            elif vk is None or gk < vk:
+                _write_vcf_records(out, S.VARIANT_SCHEMA.empty_table(),
+                                   g_item[1], sample_order)
+                g_item = next(gi, None)
+            else:
+                _write_vcf_records(out, v_item[1], g_item[1],
+                                   sample_order)
+                v_item = next(vi, None)
+                g_item = next(gi, None)
+    return counted["v"], counted["g"]

@@ -281,3 +281,21 @@ def test_streaming_compare_empty_side_and_multifile(resources, tmp_path):
     for name in r_ref["histograms"]:
         assert r2["histograms"][name].value_to_count == \
             r_ref["histograms"][name].value_to_count, name
+
+
+def test_streaming_findreads_matches_inmemory(resources):
+    from adam_tpu.compare.engine import (ComparisonTraversalEngine,
+                                         parse_filters, streaming_compare)
+    from adam_tpu.io.dispatch import load_reads_union
+
+    p1 = [str(resources / "reads12.sam")]
+    p2 = [str(resources / "reads12_diff1.sam")]
+    filters = parse_filters("positions!=0")
+    t1, sd1, _ = load_reads_union(p1)
+    t2, sd2, _ = load_reads_union(p2)
+    ref = ComparisonTraversalEngine(t1, t2, sd1, sd2).find(filters)
+    got = streaming_compare(p1, p2, [f.comparison for f in filters],
+                            n_buckets=5, chunk_rows=7,
+                            find_filters=filters)["matching_names"]
+    assert sorted(got) == sorted(ref)
+    assert ref  # the fixture pair must actually produce matches

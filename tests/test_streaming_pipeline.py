@@ -501,3 +501,27 @@ def test_streaming_aggregate_pileups_matches_inmemory(resources, tmp_path):
                           ("referenceId", "position", "rangeOffset",
                            "readBase")])
     assert key(got.select(ref.column_names)).equals(key(ref))
+
+
+def test_streaming_adam2vcf_matches_inmemory(resources, tmp_path):
+    """Windowed adam2vcf text == the in-memory writer, line for line
+    (single-contig fixture, so ordering conventions agree)."""
+    import io
+
+    from adam_tpu.io.parquet import save_table
+    from adam_tpu.io.vcf import read_vcf, write_vcf
+    from adam_tpu.parallel.pipeline import streaming_adam2vcf
+
+    variants, genotypes, _domains, seq = read_vcf(str(resources /
+                                                      "small.vcf"))
+    save_table(variants, str(tmp_path / "x.v"))
+    save_table(genotypes, str(tmp_path / "x.g"))
+
+    buf = io.StringIO()
+    write_vcf(variants, genotypes, buf)
+    n_v, n_g = streaming_adam2vcf(str(tmp_path / "x"),
+                                  str(tmp_path / "out.vcf"),
+                                  chunk_rows=3, window_bp=64)
+    assert (n_v, n_g) == (variants.num_rows, genotypes.num_rows)
+    got = (tmp_path / "out.vcf").read_text()
+    assert got == buf.getvalue()
