@@ -205,21 +205,11 @@ def _count_kernel(bases, quals, read_len, flags, read_group, state, usable,
     return out
 
 
-@partial(jax.jit, static_argnames=("n_qual_rg", "n_cycle", "block_rows",
-                                   "axis_name"))
-def _count_kernel_matmul(bases, quals, read_len, flags, read_group, state,
-                         usable, n_qual_rg: int, n_cycle: int,
-                         block_rows: int = 512, axis_name=None):
-    """Pass-1 counting as blocked one-hot matmuls — the MXU formulation.
-
-    Scatter-adds serialize on duplicate indices (ruinous on TPU); here each
-    table is ``(one_hot(k) * w).T @ one_hot(attr)`` over row blocks:
-    table[q, c] = sum_x [k_x = q] * w_x * [attr_x = c].  The observed and
-    mismatch tables stack along the Q axis so one [2Q, X] @ [X, C] matmul
-    per block produces both.  f32 block products are exact (block sums
-    < 2^24) and accumulate into int32 carries.
-    """
-    from .covariates import N_CONTEXT
+def _count_block_prep(bases, quals, read_len, flags, read_group, state,
+                      usable, n_qual_rg: int, n_cycle: int,
+                      block_rows: int):
+    """Covariates + masks flattened into per-block arrays — the shared
+    prologue of the matmul-scan and dispatch-chain count kernels."""
     cov = covariate_tensors(bases, quals, read_len, flags, read_group)
     counted = cov["in_window"] & usable[:, None] & (state != STATE_MASKED)
     mm = (state == STATE_MISMATCH) & counted
@@ -236,51 +226,57 @@ def _count_kernel_matmul(bases, quals, read_len, flags, read_group, state,
 
     windowed = cov["in_window"] & usable[:, None]
     qidx = jnp.clip(quals.astype(jnp.int32), 0, 255)
+    return (padded(k).reshape(n_blocks, block_rows * L),
+            padded(cyc).reshape(n_blocks, block_rows * L),
+            padded(ctx).reshape(n_blocks, block_rows * L),
+            padded(qidx).reshape(n_blocks, block_rows * L),
+            padded(counted.astype(jnp.bfloat16)).reshape(n_blocks, -1),
+            padded(mm.astype(jnp.bfloat16)).reshape(n_blocks, -1),
+            padded(windowed.astype(jnp.bfloat16)).reshape(n_blocks, -1))
 
-    kp = padded(k).reshape(n_blocks, block_rows * L)
-    cycp = padded(cyc).reshape(n_blocks, block_rows * L)
-    ctxp = padded(ctx).reshape(n_blocks, block_rows * L)
-    qp = padded(qidx).reshape(n_blocks, block_rows * L)
-    wp = padded(counted.astype(jnp.bfloat16)).reshape(n_blocks, -1)
-    wmp = padded(mm.astype(jnp.bfloat16)).reshape(n_blocks, -1)
-    wwp = padded(windowed.astype(jnp.bfloat16)).reshape(n_blocks, -1)
 
-    q_ids = jnp.arange(n_qual_rg, dtype=jnp.int32)
-    cyc_ids = jnp.arange(n_cycle, dtype=jnp.int32)
-    ctx_ids = jnp.arange(N_CONTEXT, dtype=jnp.int32)
-    q256_ids = jnp.arange(256, dtype=jnp.int32)
-
-    def body(carry, blk):
-        qual_o, qual_m, cyc_t, ctx_t, qh_t = carry
-        kb, cycb, ctxb, qb, wb, wmb, wwb = blk
-        ohk = (kb[:, None] == q_ids[None, :]).astype(jnp.bfloat16)
-        wk = jnp.concatenate([ohk * wb[:, None], ohk * wmb[:, None]],
-                             axis=1)                       # [X, 2Q]
-        qual_sums = jnp.sum(wk, axis=0,
-                            dtype=jnp.float32).astype(jnp.int32)  # [2Q]
-        ohcyc = (cycb[:, None] == cyc_ids[None, :]).astype(jnp.bfloat16)
-        ohctx = (ctxb[:, None] == ctx_ids[None, :]).astype(jnp.bfloat16)
-        cyc_pair = jax.lax.dot(wk.T, ohcyc,
-                               preferred_element_type=jnp.float32)
-        ctx_pair = jax.lax.dot(wk.T, ohctx,
-                               preferred_element_type=jnp.float32)
-        ohq = (qb[:, None] == q256_ids[None, :]).astype(jnp.bfloat16)
-        qh = jax.lax.dot(wwb.reshape(1, -1), ohq,
-                         preferred_element_type=jnp.float32)[0]
-        return (qual_o + qual_sums[:n_qual_rg],
-                qual_m + qual_sums[n_qual_rg:],
-                cyc_t + cyc_pair.astype(jnp.int32),
-                ctx_t + ctx_pair.astype(jnp.int32),
-                qh_t + qh.astype(jnp.int32)), None
-
-    init = (jnp.zeros((n_qual_rg,), jnp.int32),
+def _count_init(n_qual_rg: int, n_cycle: int):
+    from .covariates import N_CONTEXT
+    return (jnp.zeros((n_qual_rg,), jnp.int32),
             jnp.zeros((n_qual_rg,), jnp.int32),
             jnp.zeros((2 * n_qual_rg, n_cycle), jnp.int32),
             jnp.zeros((2 * n_qual_rg, N_CONTEXT), jnp.int32),
             jnp.zeros((256,), jnp.int32))
-    (qual_obs, qual_mm, cyc_t, ctx_t, qhist), _ = jax.lax.scan(
-        body, init, (kp, cycp, ctxp, qp, wp, wmp, wwp))
 
+
+def _count_block_body(carry, blk, n_qual_rg: int, n_cycle: int):
+    """One block's one-hot matmuls accumulated into the carry tables
+    (shared by the lax.scan and dispatch-chain drivers)."""
+    from .covariates import N_CONTEXT
+    q_ids = jnp.arange(n_qual_rg, dtype=jnp.int32)
+    cyc_ids = jnp.arange(n_cycle, dtype=jnp.int32)
+    ctx_ids = jnp.arange(N_CONTEXT, dtype=jnp.int32)
+    q256_ids = jnp.arange(256, dtype=jnp.int32)
+    qual_o, qual_m, cyc_t, ctx_t, qh_t = carry
+    kb, cycb, ctxb, qb, wb, wmb, wwb = blk
+    ohk = (kb[:, None] == q_ids[None, :]).astype(jnp.bfloat16)
+    wk = jnp.concatenate([ohk * wb[:, None], ohk * wmb[:, None]],
+                         axis=1)                       # [X, 2Q]
+    qual_sums = jnp.sum(wk, axis=0,
+                        dtype=jnp.float32).astype(jnp.int32)  # [2Q]
+    ohcyc = (cycb[:, None] == cyc_ids[None, :]).astype(jnp.bfloat16)
+    ohctx = (ctxb[:, None] == ctx_ids[None, :]).astype(jnp.bfloat16)
+    cyc_pair = jax.lax.dot(wk.T, ohcyc,
+                           preferred_element_type=jnp.float32)
+    ctx_pair = jax.lax.dot(wk.T, ohctx,
+                           preferred_element_type=jnp.float32)
+    ohq = (qb[:, None] == q256_ids[None, :]).astype(jnp.bfloat16)
+    qh = jax.lax.dot(wwb.reshape(1, -1), ohq,
+                     preferred_element_type=jnp.float32)[0]
+    return (qual_o + qual_sums[:n_qual_rg],
+            qual_m + qual_sums[n_qual_rg:],
+            cyc_t + cyc_pair.astype(jnp.int32),
+            ctx_t + ctx_pair.astype(jnp.int32),
+            qh_t + qh.astype(jnp.int32))
+
+
+def _pack_count_out(carry, n_qual_rg: int, axis_name=None):
+    qual_obs, qual_mm, cyc_t, ctx_t, qhist = carry
     out = (qual_obs, qual_mm,
            cyc_t[:n_qual_rg].reshape(-1), cyc_t[n_qual_rg:].reshape(-1),
            ctx_t[:n_qual_rg].reshape(-1), ctx_t[n_qual_rg:].reshape(-1),
@@ -288,6 +284,71 @@ def _count_kernel_matmul(bases, quals, read_len, flags, read_group, state,
     if axis_name is not None:
         out = tuple(jax.lax.psum(o, axis_name) for o in out)
     return out
+
+
+@partial(jax.jit, static_argnames=("n_qual_rg", "n_cycle", "block_rows",
+                                   "axis_name"))
+def _count_kernel_matmul(bases, quals, read_len, flags, read_group, state,
+                         usable, n_qual_rg: int, n_cycle: int,
+                         block_rows: int = 512, axis_name=None):
+    """Pass-1 counting as blocked one-hot matmuls — the MXU formulation.
+
+    Scatter-adds serialize on duplicate indices (ruinous on TPU); here each
+    table is ``(one_hot(k) * w).T @ one_hot(attr)`` over row blocks:
+    table[q, c] = sum_x [k_x = q] * w_x * [attr_x = c].  The observed and
+    mismatch tables stack along the Q axis so one [2Q, X] @ [X, C] matmul
+    per block produces both.  f32 block products are exact (block sums
+    < 2^24) and accumulate into int32 carries.
+    """
+    blocks = _count_block_prep(bases, quals, read_len, flags, read_group,
+                               state, usable, n_qual_rg, n_cycle,
+                               block_rows)
+
+    def body(carry, blk):
+        return _count_block_body(carry, blk, n_qual_rg, n_cycle), None
+
+    carry, _ = jax.lax.scan(body, _count_init(n_qual_rg, n_cycle), blocks)
+    return _pack_count_out(carry, n_qual_rg, axis_name)
+
+
+@partial(jax.jit, static_argnames=("n_qual_rg", "n_cycle", "block_rows"))
+def _count_chain_prep_jit(bases, quals, read_len, flags, read_group, state,
+                          usable, n_qual_rg, n_cycle, block_rows):
+    return _count_block_prep(bases, quals, read_len, flags, read_group,
+                             state, usable, n_qual_rg, n_cycle, block_rows)
+
+
+@partial(jax.jit, static_argnames=("n_qual_rg", "n_cycle"),
+         donate_argnums=(0,))
+def _count_chain_step_jit(carry, kb, cycb, ctxb, qb, wb, wmb, wwb,
+                          n_qual_rg, n_cycle):
+    return _count_block_body(carry, (kb, cycb, ctxb, qb, wb, wmb, wwb),
+                             n_qual_rg, n_cycle)
+
+
+def _count_kernel_chain(bases, quals, read_len, flags, read_group, state,
+                        usable, n_qual_rg: int, n_cycle: int,
+                        block_rows: int = 512, axis_name=None):
+    """The matmul formulation driven by a HOST dispatch chain instead of a
+    lax.scan: one compiled block step re-dispatched per block with a
+    donated device-resident carry.  Compile time is one block regardless
+    of chunk size — the escape hatch for toolchains whose loop compiler
+    unrolls (the remote TPU AOT compiler took ~2 s/iteration on an
+    equivalent scan body; at product chunk sizes that is hours).
+    ``ADAM_TPU_BQSR_COUNT=chain`` selects it.
+    """
+    assert axis_name is None, "chain impl runs outside shard_map"
+    blocks = _count_chain_prep_jit(bases, quals, read_len, flags,
+                                   read_group, state, usable,
+                                   n_qual_rg=n_qual_rg, n_cycle=n_cycle,
+                                   block_rows=block_rows)
+    carry = _count_init(n_qual_rg, n_cycle)
+    n_blocks = blocks[0].shape[0]
+    for i in range(n_blocks):
+        carry = _count_chain_step_jit(
+            carry, *(b[i] for b in blocks),
+            n_qual_rg=n_qual_rg, n_cycle=n_cycle)
+    return _pack_count_out(carry, n_qual_rg)
 
 
 def _count_tables_host(batch: ReadBatch, state, usable, n_qual_rg: int,
@@ -347,7 +408,7 @@ _COUNT_IMPL_ENV = "ADAM_TPU_BQSR_COUNT"
 
 def _count_impl() -> str:
     choice = os.environ.get(_COUNT_IMPL_ENV, "auto")
-    if choice in ("scatter", "matmul", "host"):
+    if choice in ("scatter", "matmul", "host", "chain"):
         return choice
     return "scatter" if jax.default_backend() == "cpu" else "matmul"
 
@@ -406,12 +467,17 @@ def count_tables_device(table: pa.Table,
                                  n_qual_rg=rt.n_qual_rg,
                                  n_cycle=rt.n_cycle)
     else:
-        kernel = _count_kernel_matmul if impl == "matmul" else _count_kernel
+        kernel = {"matmul": _count_kernel_matmul,
+                  "chain": _count_kernel_chain}.get(impl, _count_kernel)
         args = (jnp.asarray(batch.bases), jnp.asarray(batch.quals),
                 jnp.asarray(batch.read_len), jnp.asarray(batch.flags),
                 jnp.asarray(batch.read_group), jnp.asarray(state),
                 jnp.asarray(usable))
-        if mesh is not None and mesh.size > 1 and \
+        if impl == "chain":
+            # host-driven dispatch loop; runs outside shard_map by design
+            out = kernel(*args, n_qual_rg=rt.n_qual_rg,
+                         n_cycle=rt.n_cycle)
+        elif mesh is not None and mesh.size > 1 and \
                 batch.n_reads % mesh.size == 0:
             out = _sharded_count_fn(kernel, mesh, rt.n_qual_rg,
                                     rt.n_cycle)(*args)

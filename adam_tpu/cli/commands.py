@@ -459,8 +459,15 @@ class Adam2VcfCommand(Command):
         from ..io.parquet import load_table
         from ..io.vcf import write_vcf
 
-        if should_stream(args, args.input + ".v", args.input + ".g") and \
-                not str(args.output).endswith((".gz", ".bgz", ".bcf")):
+        wants_stream = should_stream(args, args.input + ".v",
+                                     args.input + ".g")
+        compressed_out = str(args.output).endswith((".gz", ".bgz", ".bcf"))
+        if wants_stream and compressed_out:
+            import sys
+            print("warning: streaming adam2vcf writes plain .vcf only; "
+                  "buffering the whole dataset for compressed/BCF output "
+                  "(-no_stream silences this)", file=sys.stderr)
+        if wants_stream and not compressed_out:
             from ..parallel.pipeline import streaming_adam2vcf
             n_v, n_g = streaming_adam2vcf(args.input, args.output)
             print(f"wrote {n_v} variants / {n_g} genotypes to "

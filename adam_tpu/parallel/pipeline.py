@@ -1229,8 +1229,12 @@ def streaming_adam2vcf(input_base: str, output_path: str, *,
     # would be quadratic in the unique count).  A variants-only dataset
     # (no .g — the in-memory path supports it) streams too.
     import pyarrow.compute as pc
-    has_g = os.path.isdir(input_base + ".g") and any(
-        f.endswith(".parquet") for f in os.listdir(input_base + ".g"))
+    g_path = input_base + ".g"
+    # a .g dataset may be a part-file directory OR one plain parquet file
+    # (both load_table-readable; the in-memory path supports both)
+    has_g = (os.path.isdir(g_path) and any(
+        f.endswith(".parquet") for f in os.listdir(g_path))) or \
+        os.path.isfile(g_path)
     sample_order: list = []
     seen_samples: set = set()
     if has_g:

@@ -211,3 +211,29 @@ def test_count_backends_agree():
         # all backends build the same integer qual histogram and take the
         # f64 dot on host, so even the float expectation is bit-identical
         assert a.expected_mismatch == b.expected_mismatch, impl
+
+
+def test_count_impl_chain_matches_scatter():
+    """The dispatch-chain count backend (the scan-compile escape hatch)
+    must produce bit-identical tables to the scatter oracle."""
+    import numpy as np
+
+    from adam_tpu.bqsr.recalibrate import (_count_kernel,
+                                           _count_kernel_chain)
+    from adam_tpu.bqsr.table import RecalTable
+
+    rng = np.random.RandomState(3)
+    n, L, n_rg = 700, 50, 3   # 700 rows -> 3 blocks of 256 + padding
+    rt = RecalTable(n_read_groups=n_rg, max_read_len=L)
+    args = (rng.randint(0, 4, (n, L)).astype(np.int8),
+            rng.randint(2, 41, (n, L)).astype(np.int8),
+            rng.randint(30, L + 1, n).astype(np.int32),
+            rng.choice([0, 16, 1 | 128], n).astype(np.int32),
+            rng.randint(0, n_rg, n).astype(np.int32),
+            rng.randint(0, 3, (n, L)).astype(np.int8),
+            rng.rand(n) < 0.9)
+    ref = _count_kernel(*args, n_qual_rg=rt.n_qual_rg, n_cycle=rt.n_cycle)
+    got = _count_kernel_chain(*args, n_qual_rg=rt.n_qual_rg,
+                              n_cycle=rt.n_cycle, block_rows=256)
+    for a, b in zip(got, ref):
+        assert np.array_equal(np.asarray(a), np.asarray(b))
