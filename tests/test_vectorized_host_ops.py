@@ -150,3 +150,49 @@ def test_name_hash_is_chunk_layout_independent():
     for i in range(len(short)):
         assert alone[0][i] == with_long[0][i], short[i]
         assert alone[1][i] == with_long[1][i], short[i]
+
+
+def test_snptable_ingest_rss_stays_bounded(tmp_path):
+    """The dbSNP-scale ingest claim, recorded as a test: streaming a
+    10M-line sites VCF must hold process peak RSS far under what a
+    per-line Python parse materializes (~2 GB of str/dict churn).  The
+    child process reports its own ru_maxrss so this test's suite
+    neighbors cannot pollute the measurement."""
+    import subprocess
+    import sys
+
+    import numpy as np
+
+    p = tmp_path / "sites.vcf"
+    n = 10_000_000
+    rng = np.random.RandomState(0)
+    pos = np.sort(rng.randint(1, 3_000_000_000, size=n))
+    chrom = rng.randint(1, 23, size=n)
+    with open(p, "w") as f:
+        f.write("##fileformat=VCFv4.1\n#CHROM\tPOS\tID\tREF\tALT\n")
+        # vectorized text assembly; ~180 MB file
+        for s in range(0, n, 1_000_000):
+            block = "\n".join(
+                f"chr{c}\t{q}\t.\tA\tG" for c, q in
+                zip(chrom[s:s + 1_000_000], pos[s:s + 1_000_000]))
+            f.write(block + "\n")
+
+    child = (
+        "import resource, sys\n"
+        "from adam_tpu.models.snptable import SnpTable\n"
+        f"t = SnpTable.from_vcf({str(p)!r})\n"
+        "peak_kb = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss\n"
+        "print(len(t), peak_kb)\n")
+    env = {**__import__('os').environ, "JAX_PLATFORMS": "cpu"}
+    # the suite's 8-virtual-device XLA flags inflate the child's baseline
+    env.pop("XLA_FLAGS", None)
+    out = subprocess.run([sys.executable, "-c", child], timeout=300,
+                         capture_output=True, text=True, env=env)
+    assert out.returncode == 0, out.stderr[-500:]
+    n_sites, peak_kb = out.stdout.split()[-2:]
+    assert int(n_sites) > 9_000_000     # len() counts deduped sites
+    # columns are ~160 MB (2 x 10M int64) + argsort copies + the
+    # interpreter/pyarrow baseline; measured ~830 MB with the incremental
+    # reader (read_csv's whole-table materialization measured ~960 MB,
+    # the per-line parser several GB)
+    assert int(peak_kb) < 1_100_000, f"peak RSS {int(peak_kb)//1024} MB"
