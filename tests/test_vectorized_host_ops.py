@@ -192,7 +192,9 @@ def test_snptable_ingest_rss_stays_bounded(tmp_path):
     n_sites, peak_kb = out.stdout.split()[-2:]
     assert int(n_sites) > 9_000_000     # len() counts deduped sites
     # columns are ~160 MB (2 x 10M int64) + argsort copies + the
-    # interpreter/pyarrow baseline; measured ~830 MB with the incremental
-    # reader (read_csv's whole-table materialization measured ~960 MB,
-    # the per-line parser several GB)
-    assert int(peak_kb) < 1_100_000, f"peak RSS {int(peak_kb)//1024} MB"
+    # interpreter/pyarrow baseline; measured ~830 MB isolated with the
+    # incremental reader (read_csv's whole-table materialization ~960 MB,
+    # the per-line parser several GB).  The bound carries headroom for
+    # allocator behavior under full-suite memory pressure — it exists to
+    # catch an O(file) regression, not to pin the exact number.
+    assert int(peak_kb) < 1_600_000, f"peak RSS {int(peak_kb)//1024} MB"
