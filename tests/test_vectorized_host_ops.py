@@ -194,7 +194,8 @@ def test_snptable_ingest_rss_stays_bounded(tmp_path):
     # columns are ~160 MB (2 x 10M int64) + argsort copies + the
     # interpreter/pyarrow baseline; measured ~830 MB isolated with the
     # incremental reader (read_csv's whole-table materialization ~960 MB,
-    # the per-line parser several GB).  The bound carries headroom for
-    # allocator behavior under full-suite memory pressure — it exists to
-    # catch an O(file) regression, not to pin the exact number.
-    assert int(peak_kb) < 1_600_000, f"peak RSS {int(peak_kb)//1024} MB"
+    # the per-line parser >4 GB).  Under full-suite memory pressure the
+    # child's allocator measured up to ~2 GB for the identical work, so
+    # the bound is a gross-regression tripwire (O(file) string churn),
+    # not a pin on the isolated number.
+    assert int(peak_kb) < 2_500_000, f"peak RSS {int(peak_kb)//1024} MB"
