@@ -49,6 +49,8 @@ def make_batch(n, rng):
 
 
 def main() -> None:
+    from adam_tpu.platform import honor_platform_env
+    honor_platform_env()      # the axon plugin ignores bare JAX_PLATFORMS
     import jax
     import jax.numpy as jnp
     from adam_tpu.bqsr.recalibrate import _apply_kernel, _count_kernel
@@ -88,15 +90,23 @@ def main() -> None:
     stages = [("markdup_score", markdup), ("bqsr_count", bqsr_count),
               ("bqsr_apply", bqsr_apply), ("transform_fused", fused)]
 
+    def sync(out):
+        # pull one scalar of one output: a jit dispatch is one executable,
+        # so any output materializing implies the whole program ran —
+        # and device_get is a REAL round trip where the tunnel backend's
+        # block_until_ready is a no-op (see bench.py's timing discipline)
+        leaf = jax.tree_util.tree_leaves(out)[0]
+        jax.device_get(leaf.ravel()[:1])
+
     for name, fn in stages:
         jfn = jax.jit(fn)
         put = {k: jax.device_put(v) for k, v in b.items()}
-        jax.block_until_ready(jfn(put))  # compile
+        sync(jfn(put))                   # compile + warm
         iters = 3
         t0 = time.perf_counter()
         for _ in range(iters):
             put = {k: jax.device_put(v) for k, v in b.items()}
-            jax.block_until_ready(jfn(put))
+            sync(jfn(put))
         dt = (time.perf_counter() - t0) / iters
         print(json.dumps({"metric": f"{name}_reads_per_sec",
                           "value": round(n / dt), "unit": "reads/s"}))
