@@ -361,3 +361,62 @@ def open_bam_batch_stream(path, *, chunk_rows: int = 1 << 20,
                 **cols)
 
     return seq_dict, rg_dict, gen()
+
+
+def _stream_records(path, byte_iter, buf0, off0, chunk_bytes, decode):
+    """Shared bounded-buffer driver for the native chunk decoders: fill the
+    window, call ``decode(buf, off)`` -> (n, next_offset, result), widen the
+    window when one record exceeds it, raise on trailing garbage, trim the
+    consumed prefix.  Yields each non-empty ``result``."""
+    from ..errors import FormatError
+
+    buf, off = buf0, off0
+    exhausted = False
+    target = chunk_bytes
+    while True:
+        while not exhausted and len(buf) - off < target:
+            piece = next(byte_iter, None)
+            if piece is None:
+                exhausted = True
+            else:
+                buf += piece
+        n, next_off, result = decode(buf, off)
+        if n == 0:
+            if exhausted:
+                if off < len(buf):
+                    raise FormatError(
+                        f"{path}: {len(buf) - off} trailing bytes form "
+                        "no complete record (truncated file?)")
+                return
+            target *= 2  # one record larger than the buffer window
+            continue
+        target = chunk_bytes  # a widened window resets after success
+        off = next_off
+        if off:
+            del buf[:off]
+            off = 0
+        yield result
+
+
+def open_bam_wire32_stream(path, *, chunk_rows: int = 1 << 22,
+                           chunk_bytes: int = 1 << 24):
+    """Generator of uint32 flagstat wire-word chunks straight from BAM
+    bytes — the 4 fields flagstat consumes live at fixed offsets in each
+    record, so the native walk emits the wire with NO name/seq/qual/cigar
+    decode (the Arrow path decodes everything, ~30 bytes of string work
+    per 4-byte word).  Field semantics are pinned to the Arrow path by a
+    differential test.  Returns None without the native extension; the
+    caller falls back to the Arrow path.
+    """
+    if _native is None or not hasattr(_native, "flagstat_wire_chunk"):
+        return None
+    byte_iter = iter_decompressed(path, chunk_bytes)
+    _sd, _rg, off0, buf0 = stream_header(byte_iter, path)
+
+    def decode(buf, off):
+        out = np.empty(chunk_rows, np.uint32)
+        n, next_off = _native.flagstat_wire_chunk(buf, off, chunk_rows, out)
+        return n, next_off, out[:n]
+
+    return _stream_records(path, byte_iter, buf0, off0, chunk_bytes,
+                           decode)

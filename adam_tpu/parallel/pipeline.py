@@ -41,13 +41,14 @@ def _wire32_from_table(table: pa.Table) -> np.ndarray:
     # matching the unpacked kernel's mapq=-1 (both fail the >=5 test)
     refid = column_int64(table, "referenceId", -1)
     mate_refid = column_int64(table, "mateReferenceId", -1)
-    # range-check BEFORE narrowing: a wrapped int16 would pass the packer's
-    # own guard and silently corrupt the cross-chromosome counters
-    from ..ops.flagstat import _check_refid_range
-    _check_refid_range(refid, mate_refid)
+    # the wire consumes only the COMPARISON of the refids, so compute the
+    # cross bit at full width and feed the packer a 0/1 surrogate pair —
+    # a >32k-contig BAM (beyond int16) flagstats identically to the
+    # native fast path instead of tripping the packer's narrowing guard
+    cross = (refid != mate_refid).astype(np.int16)
     return pack_flagstat_wire32(
         flags.astype(np.uint16), mapq.astype(np.uint8),
-        refid.astype(np.int16), mate_refid.astype(np.int16),
+        cross, np.zeros(n, np.int16),
         np.ones(n, np.uint8))
 
 
@@ -94,10 +95,19 @@ def streaming_flagstat(path: str, *, mesh=None, chunk_rows: int = 1 << 22
     totals = np.zeros((18, 2), np.int64)
     totals_dev = None
     n_chunks = 0
-    stream = open_read_stream(path, columns=FLAGSTAT_COLUMNS,
-                              chunk_rows=chunk_rows)
-    for table in stream:
-        wire = _wire32_from_table(table)
+    # BAM fast path: the native walk emits the wire word straight from the
+    # record bytes — no string decode at all (ADAM_TPU_FLAGSTAT_DECODE=
+    # arrow opts back into the Arrow path, e.g. for differential checks)
+    wire_chunks = None
+    if path.endswith(".bam") and \
+            os.environ.get("ADAM_TPU_FLAGSTAT_DECODE", "auto") != "arrow":
+        from ..io.fastbam import open_bam_wire32_stream
+        wire_chunks = open_bam_wire32_stream(path, chunk_rows=chunk_rows)
+    if wire_chunks is None:
+        stream = open_read_stream(path, columns=FLAGSTAT_COLUMNS,
+                                  chunk_rows=chunk_rows)
+        wire_chunks = (_wire32_from_table(t) for t in stream)
+    for wire in wire_chunks:
         n_pad = _pad_to(len(wire), mesh.size)
         if n_pad != len(wire):  # padding words carry valid=0
             wire = np.concatenate(

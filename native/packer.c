@@ -675,6 +675,51 @@ static PyObject *pack_wire32(PyObject *self, PyObject *args) {
     Py_RETURN_NONE;
 }
 
+/* ------------------------------------------------- flagstat_wire_chunk */
+/* Emit the 4-byte flagstat projection word straight from BAM records —
+ * no name/seq/qual/cigar decode at all.  Matches the Arrow path's field
+ * semantics exactly: mapq byte is 0 when the ref is unset or mapq==255
+ * (the Arrow column is null there and the wire packer zero-fills), the
+ * cross-chromosome bit compares raw refIDs (-1 == -1 for both unmapped),
+ * and the valid bit is always set.  Returns (n, next_offset) like
+ * scan_chunk so multi-GB BAMs stream. */
+static PyObject *flagstat_wire_chunk(PyObject *self, PyObject *args) {
+    Py_buffer data, out;
+    Py_ssize_t offset, max_records;
+    if (!PyArg_ParseTuple(args, "y*nnw*", &data, &offset, &max_records,
+                          &out))
+        return NULL;
+    if (out.len < 4 * max_records) {
+        PyBuffer_Release(&data);
+        PyBuffer_Release(&out);
+        PyErr_SetString(PyExc_ValueError, "wire buffer too small");
+        return NULL;
+    }
+    const uint8_t *buf = (const uint8_t *)data.buf;
+    Py_ssize_t n = data.len;
+    Py_ssize_t pos = offset;
+    uint32_t *w = (uint32_t *)out.buf;
+    Py_ssize_t count = 0;
+    Py_BEGIN_ALLOW_THREADS
+    while (pos + 4 <= n && count < max_records) {
+        int32_t block = rd_i32(buf + pos);
+        if (block < 32 || pos + 4 + block > n) break;
+        const uint8_t *r = buf + pos + 4;
+        int32_t ref = rd_i32(r + 0);
+        uint8_t mq = r[9];
+        uint16_t flag = rd_u16(r + 14);
+        int32_t mref = rd_i32(r + 20);
+        uint32_t mq_wire = (ref >= 0 && mq != 255) ? mq : 0;
+        w[count++] = (uint32_t)flag | (mq_wire << 16) | (1u << 24) |
+                     ((uint32_t)(ref != mref) << 25);
+        pos += 4 + block;
+    }
+    Py_END_ALLOW_THREADS
+    PyBuffer_Release(&data);
+    PyBuffer_Release(&out);
+    return Py_BuildValue("(nn)", count, pos);
+}
+
 static PyMethodDef methods[] = {
     {"scan", scan, METH_VARARGS,
      "scan(data, offset) -> (n_records, max_read_len, max_cigar_ops)"},
@@ -693,6 +738,9 @@ static PyMethodDef methods[] = {
      "decode_arrow(data, offset, max_records, 6 fixed cols, 8 offset "
      "arrays, 7 validity arrays, needs_py) -> (n, next_offset, 8 data "
      "blobs)"},
+    {"flagstat_wire_chunk", flagstat_wire_chunk, METH_VARARGS,
+     "flagstat_wire_chunk(data, offset, max_records, out_u32) -> "
+     "(n, next_offset)"},
     {"pack_wire32", pack_wire32, METH_VARARGS,
      "pack_wire32(flags_u16, mapq_u8, refid_i16, mate_i16, valid_u8, "
      "out_u32) -> None"},
