@@ -406,11 +406,18 @@ def _count_tables_host(batch: ReadBatch, state, usable, n_qual_rg: int,
 _COUNT_IMPL_ENV = "ADAM_TPU_BQSR_COUNT"
 
 
-def _count_impl() -> str:
+def _count_impl(sharded: bool = False) -> str:
     choice = os.environ.get(_COUNT_IMPL_ENV, "auto")
     if choice in ("scatter", "matmul", "host", "chain"):
         return choice
-    return "scatter" if jax.default_backend() == "cpu" else "matmul"
+    if jax.default_backend() == "cpu":
+        return "scatter"
+    # TPU auto: the chain form (host-dispatched matmul blocks) compiles in
+    # one block regardless of chunk size — the remote AOT compiler showed
+    # ~2 s/iteration compile on an equivalent scan body, which at product
+    # chunk sizes (thousands of blocks) is effectively a hang.  The scan
+    # form stays the pick under shard_map, which a host loop cannot enter.
+    return "matmul" if sharded else "chain"
 
 
 @lru_cache(maxsize=16)
@@ -461,7 +468,9 @@ def count_tables_device(table: pa.Table,
         n_read_groups = int(np.asarray(batch.read_group).max(initial=0)) + 1
     rt = RecalTable(n_read_groups=max(n_read_groups, 1),
                     max_read_len=batch.max_len)
-    impl = _count_impl()
+    sharded = mesh is not None and mesh.size > 1 and \
+        batch.n_reads % mesh.size == 0
+    impl = _count_impl(sharded=sharded)
     if impl == "host":
         out = _count_tables_host(batch, state, usable,
                                  n_qual_rg=rt.n_qual_rg,
@@ -477,8 +486,7 @@ def count_tables_device(table: pa.Table,
             # host-driven dispatch loop; runs outside shard_map by design
             out = kernel(*args, n_qual_rg=rt.n_qual_rg,
                          n_cycle=rt.n_cycle)
-        elif mesh is not None and mesh.size > 1 and \
-                batch.n_reads % mesh.size == 0:
+        elif sharded:
             out = _sharded_count_fn(kernel, mesh, rt.n_qual_rg,
                                     rt.n_cycle)(*args)
         else:

@@ -213,47 +213,20 @@ def open_bam_arrow_stream(path, *, chunk_rows: int = 1 << 20,
     byte_iter = iter_decompressed(path, chunk_bytes)
     seq_dict, rg_dict, off, buf = stream_header(byte_iter, path)
 
-    def gen():
-        nonlocal buf, off
-        from ..errors import FormatError
+    def decode(buf, off):
+        cr = chunk_rows
+        fixed = [np.empty(cr, np.int32) for _ in range(6)]
+        offs = [np.empty(cr + 1, np.int32) for _ in range(8)]
+        vals = [np.empty(cr, np.uint8) for _ in range(7)]
+        needs_py = np.zeros(cr, np.uint8)
+        n, next_off, *blobs = _native.decode_arrow(
+            buf, off, cr, *fixed, *offs, *vals, needs_py)
+        table = None if n == 0 else _arrow_chunk_table(
+            n, fixed, offs, vals, blobs, needs_py, seq_dict, rg_dict)
+        return n, next_off, table
 
-        exhausted = False
-        target = chunk_bytes
-        while True:
-            # fill the buffer first, decode once: chunks are bounded by
-            # min(chunk_rows, ~chunk_bytes of records), not exact-sized,
-            # so no byte is ever decoded twice
-            while not exhausted and len(buf) - off < target:
-                piece = next(byte_iter, None)
-                if piece is None:
-                    exhausted = True
-                else:
-                    buf += piece
-            cr = chunk_rows
-            fixed = [np.empty(cr, np.int32) for _ in range(6)]
-            offs = [np.empty(cr + 1, np.int32) for _ in range(8)]
-            vals = [np.empty(cr, np.uint8) for _ in range(7)]
-            needs_py = np.zeros(cr, np.uint8)
-            n, next_off, *blobs = _native.decode_arrow(
-                buf, off, cr, *fixed, *offs, *vals, needs_py)
-            if n == 0:
-                if exhausted:
-                    if off < len(buf):
-                        raise FormatError(
-                            f"{path}: {len(buf) - off} trailing bytes form "
-                            "no complete record (truncated file?)")
-                    return
-                target *= 2  # one record larger than the buffer window
-                continue
-            target = chunk_bytes  # a widened window resets after success
-            off = next_off
-            if off:
-                del buf[:off]
-                off = 0
-            yield _arrow_chunk_table(n, fixed, offs, vals, blobs, needs_py,
-                                     seq_dict, rg_dict)
-
-    return seq_dict, rg_dict, gen()
+    return seq_dict, rg_dict, _stream_records(path, byte_iter, buf, off,
+                                              chunk_bytes, decode)
 
 
 def open_bam_batch_stream(path, *, chunk_rows: int = 1 << 20,

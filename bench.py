@@ -357,13 +357,12 @@ def _stage_transform(kind: str, is_tpu: bool):
     L, C, n_rg = 100, 8, 4
     default_n = 1_500_000 if is_tpu else 200_000
     n = int(os.environ.get("ADAM_TPU_BENCH_TRANSFORM_READS", default_n))
-    choice = os.environ.get(
-        "ADAM_TPU_BQSR_COUNT", "matmul" if is_tpu else "scatter")
-    # report what actually runs: anything other than "matmul" (host, auto,
-    # scatter) exercises the scatter kernel here
-    count_impl = "matmul" if choice == "matmul" else "scatter"
-    count_kernel = (_count_kernel_matmul if count_impl == "matmul"
-                    else _count_kernel)
+    # resolve EXACTLY like the product's unsharded path so the reported
+    # numbers describe the kernel the product runs for the same setting
+    from adam_tpu.bqsr.recalibrate import _count_impl
+    count_impl = _count_impl(sharded=False)
+    if count_impl == "host":      # no host-bincount form in this bench
+        count_impl = "scatter"
 
     # the batch is generated ON DEVICE: the 45 MB/s tunnel would spend
     # minutes shipping ~700 MB of synthetic columns (the round-2 transform
@@ -403,28 +402,59 @@ def _stage_transform(kind: str, is_tpu: bool):
 
     # dispatch-chained fused-transform passes (see _chain_rate); pass i+1
     # consumes the quals pass i recalibrated, so the [n, L] qual tensor is
-    # truly rewritten in HBM every pass and nothing is CSE-able.
-    @jax.jit
-    def pass_fn(q, c):
-        fp, score = _device_fiveprime_and_score(
-            b["flags"], b["start"] + c, b["cigar_ops"],
-            b["cigar_lens"], b["n_cigar"], q)
-        counts = count_kernel(
-            b["bases"], q, b["read_len"], b["flags"],
-            b["read_group"], b["state"], b["valid"],
-            n_qual_rg=rt.n_qual_rg, n_cycle=rt.n_cycle)
-        newq = _apply_kernel(b["bases"], q, b["read_len"],
-                             b["flags"], b["read_group"], mask, *fin_dev)
-        s = (fp.sum().astype(jnp.int32) +
-             score.sum().astype(jnp.int32) +
-             sum(x.sum() for x in counts))
-        return newq, s & 3, s
+    # truly rewritten in HBM every pass and nothing is CSE-able.  Under
+    # the "chain" count impl the count runs as its own host-dispatched
+    # block sequence per pass (everything still async in one stream, so
+    # _chain_rate's final sync bounds the sum of all of it).
+    if count_impl == "chain":
+        from adam_tpu.bqsr.recalibrate import _count_kernel_chain
 
-    state = {"q": b["quals"], "c": jnp.int32(0)}
+        @jax.jit
+        def pass_fn(q, c):
+            fp, score = _device_fiveprime_and_score(
+                b["flags"], b["start"] + c, b["cigar_ops"],
+                b["cigar_lens"], b["n_cigar"], q)
+            newq = _apply_kernel(b["bases"], q, b["read_len"],
+                                 b["flags"], b["read_group"], mask,
+                                 *fin_dev)
+            s = fp.sum().astype(jnp.int32) + score.sum().astype(jnp.int32)
+            return newq, s & 3, s
 
-    def step():
-        q, c, s = pass_fn(state["q"], state["c"])
-        state.update(q=q, c=c, s=s)
+        state = {"q": b["quals"], "c": jnp.int32(0)}
+
+        def step():
+            counts = _count_kernel_chain(
+                b["bases"], state["q"], b["read_len"], b["flags"],
+                b["read_group"], b["state"], b["valid"],
+                n_qual_rg=rt.n_qual_rg, n_cycle=rt.n_cycle)
+            q, c, s = pass_fn(state["q"], state["c"])
+            state.update(q=q, c=c, s=s + counts[0].sum())
+    else:
+        count_kernel = (_count_kernel_matmul if count_impl == "matmul"
+                        else _count_kernel)
+
+        @jax.jit
+        def pass_fn(q, c):
+            fp, score = _device_fiveprime_and_score(
+                b["flags"], b["start"] + c, b["cigar_ops"],
+                b["cigar_lens"], b["n_cigar"], q)
+            counts = count_kernel(
+                b["bases"], q, b["read_len"], b["flags"],
+                b["read_group"], b["state"], b["valid"],
+                n_qual_rg=rt.n_qual_rg, n_cycle=rt.n_cycle)
+            newq = _apply_kernel(b["bases"], q, b["read_len"],
+                                 b["flags"], b["read_group"], mask,
+                                 *fin_dev)
+            s = (fp.sum().astype(jnp.int32) +
+                 score.sum().astype(jnp.int32) +
+                 sum(x.sum() for x in counts))
+            return newq, s & 3, s
+
+        state = {"q": b["quals"], "c": jnp.int32(0)}
+
+        def step():
+            q, c, s = pass_fn(state["q"], state["c"])
+            state.update(q=q, c=c, s=s)
 
     per, k_used = _chain_rate(step, lambda: state["s"], rtt,
                               k_probe=4, k_max=512)

@@ -705,6 +705,16 @@ static PyObject *flagstat_wire_chunk(PyObject *self, PyObject *args) {
         int32_t block = rd_i32(buf + pos);
         if (block < 32 || pos + 4 + block > n) break;
         const uint8_t *r = buf + pos + 4;
+        /* the same framing consistency check the full decoder enforces:
+         * a corrupted block_size that still lands in-bounds would
+         * misframe every following record and silently corrupt counts */
+        uint8_t l_name = r[8];
+        uint16_t n_cig = rd_u16(r + 12);
+        int32_t l_seq = rd_i32(r + 16);
+        if (l_seq < 0 ||
+            32LL + l_name + 4LL * n_cig + (l_seq + 1LL) / 2 + l_seq >
+                block)
+            break;
         int32_t ref = rd_i32(r + 0);
         uint8_t mq = r[9];
         uint16_t flag = rd_u16(r + 14);
