@@ -180,17 +180,58 @@ def probe_pallas_kernels():
         emit("sw_pallas", compiles=False, error=str(e)[:300])
 
 
+def probe_flagstat_blocks():
+    """Pallas flagstat wire sweep at candidate VMEM block sizes (2^19
+    exceeded scoped VMEM; is 2^18 inside it, and is it faster than the
+    shipping 2^17?)."""
+    import jax
+    import jax.numpy as jnp
+
+    from adam_tpu.ops.flagstat import pack_flagstat_wire32
+    from adam_tpu.ops.flagstat_pallas import _blocked_call
+
+    rng = np.random.RandomState(0)
+    n = 1 << 24                       # 16M reads resident
+    wire = pack_flagstat_wire32(
+        rng.randint(0, 1 << 12, size=n).astype(np.uint16),
+        rng.randint(0, 61, size=n).astype(np.uint8),
+        rng.randint(0, 24, size=n).astype(np.int16),
+        rng.randint(0, 24, size=n).astype(np.int16),
+        np.ones(n, bool))
+    for rows in (128, 256, 512):      # x1024 lanes = 2^17..2^19 words
+        B = rows * 1024
+        w3 = jax.device_put(wire[:(n // B) * B].reshape(-1, rows, 1024))
+        try:
+            f = jax.jit(lambda a, _r=rows: _blocked_call(a,
+                                                         interpret=False))
+            t0 = t()
+            jax.device_get(f(w3))
+            compile_s = t() - t0
+            k = 32
+            t0 = t()
+            for _ in range(k):
+                out = f(w3)
+            jax.device_get(out)
+            per = (t() - t0) / k
+            emit("flagstat_block", rows=rows,
+                 compile_s=round(compile_s, 1),
+                 greads_per_sec=round((n // B) * B / per / 1e9, 2))
+        except Exception as e:  # noqa: BLE001
+            emit("flagstat_block", rows=rows, error=str(e)[:200])
+
+
 PROBES = {
     "1": ("scan_knee", probe_scan_knee),
     "2": ("count_backends", probe_backends),
     "3": ("apply", probe_apply),
     "4": ("pallas", probe_pallas_kernels),
+    "5": ("flagstat_blocks", probe_flagstat_blocks),
 }
 
 
 def main():
     ap = argparse.ArgumentParser()
-    ap.add_argument("--only", default="4,2,3,1",
+    ap.add_argument("--only", default="4,5,2,3,1",
                     help="comma-separated probe ids, run order")
     args = ap.parse_args()
     import jax
