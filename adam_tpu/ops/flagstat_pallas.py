@@ -67,11 +67,11 @@ def _kernel(wire_ref, out_ref):
 def _blocked_call(wire3d, *, interpret: bool):
     from jax.experimental.pallas import tpu as pltpu
 
-    n_blk = wire3d.shape[0]
+    n_blk, rows, lanes = wire3d.shape
     return pl.pallas_call(
         _kernel,
         grid=(n_blk,),
-        in_specs=[pl.BlockSpec((None, BLOCK_ROWS, LANES),
+        in_specs=[pl.BlockSpec((None, rows, lanes),
                                lambda i: (i, 0, 0))],
         out_specs=pl.BlockSpec(memory_space=pltpu.SMEM),
         out_shape=jax.ShapeDtypeStruct((18, 2), jnp.int32),

@@ -10,9 +10,10 @@ line per probe so a mid-run tunnel death keeps earlier answers:
      product chunk sizes?)
   2. BQSR count backends on chip: scatter vs matmul wall rate at a product
      chunk shape
-  3. fused transform pass rate (the bench.py transform stage, standalone)
+  3. apply-pass rate
   4. realign sweep + Smith-Waterman Pallas kernels: compile?, match?, ms
-  5. apply-pass rate
+  5. pallas flagstat block-size sweep (is 2^18 inside scoped VMEM, and
+     faster than the shipping 2^17?)
 
 Each probe runs in this process; order is least-risky first so a hang
 costs the fewest answers.  Use `--only 1,3` to cherry-pick.
@@ -185,7 +186,6 @@ def probe_flagstat_blocks():
     exceeded scoped VMEM; is 2^18 inside it, and is it faster than the
     shipping 2^17?)."""
     import jax
-    import jax.numpy as jnp
 
     from adam_tpu.ops.flagstat import pack_flagstat_wire32
     from adam_tpu.ops.flagstat_pallas import _blocked_call
