@@ -62,17 +62,37 @@ def load_reads(path: str, *, columns: Optional[Sequence[str]] = None,
 def remap_reference_ids(table: pa.Table, id_map) -> pa.Table:
     """Rewrite referenceId/mateReferenceId through ``id_map`` — the
     reference's broadcast remap (rich/RichRDDReferenceRecords.scala:26-48);
-    identity maps are skipped, like the reference."""
+    identity maps are skipped, like the reference.  Vectorized: one
+    sorted-key binary search (searchsorted) replaces the per-row dict
+    walk (this sits on streaming compare's per-bucket path)."""
     if all(k == v for k, v in id_map.items()):
         return table
     import numpy as np
+    keys = np.fromiter(id_map.keys(), np.int64, len(id_map))
+    vals_map = np.fromiter(id_map.values(), np.int64, len(id_map))
+    order = np.argsort(keys)
+    skeys, svals = keys[order], vals_map[order]
+    # searchsorted, NOT a dense LUT over the key span: nonoverlapping_hash
+    # contig ids reach ~2^30, so a span-sized arange would allocate
+    # gigabytes for a map of a few dozen entries
     for col in ("referenceId", "mateReferenceId"):
         if col not in table.column_names:
             continue
-        vals = table.column(col).to_pylist()
-        new = [id_map.get(v, v) if v is not None else None for v in vals]
-        table = table.set_column(table.column_names.index(col), col,
-                                 pa.array(new, pa.int32()))
+        arr = table.column(col)
+        vals = arr.to_numpy(zero_copy_only=False)
+        nulls = np.isnan(vals) if vals.dtype.kind == "f" else \
+            np.zeros(len(vals), bool)
+        v = np.where(nulls, skeys[0], vals).astype(np.int64)
+        idx = np.searchsorted(skeys, v)
+        idx_c = np.minimum(idx, len(skeys) - 1)
+        hit = skeys[idx_c] == v
+        new = np.where(hit, svals[idx_c], v)   # unmapped ids pass through
+        # hand pyarrow the int64 array: its checked cast raises loudly on
+        # an id past int32 instead of silently wrapping
+        table = table.set_column(
+            table.column_names.index(col), col,
+            pa.array(new, pa.int32(),
+                     mask=nulls if nulls.any() else None))
     return table
 
 

@@ -708,7 +708,11 @@ def main() -> None:
         # incidental CPU results from failed device attempts count first
         for k, v in cpu_incidental.items():
             stages.setdefault(k, v)
-        missing = [s for s in want[:3] if s not in stages]
+        # CPU fallback covers every measurement stage except the one
+        # genuinely TPU-only stage — deriving from `want` keeps a future
+        # stage from being silently dropped (the want[:3] slice bug)
+        missing = [s for s in want
+                   if s != "pallas" and s not in stages]
         if missing:
             got, err, _failed = _run_worker(
                 ["probe"] + [m for m in missing if m != "probe"],

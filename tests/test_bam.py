@@ -44,3 +44,37 @@ def test_bam_cli_paths(resources, tmp_path):
     write_bam(table, seq_dict, bam_path, rg_dict)
     assert main(["bam2adam", str(bam_path), str(tmp_path / "out.adam")]) == 0
     assert main(["flagstat", str(bam_path)]) == 0
+
+
+def test_remap_reference_ids_vectorized_semantics():
+    """Nulls stay null, unmapped ids pass through, sparse maps with large
+    id gaps remap exactly (the searchsorted rewrite of the per-row
+    walk)."""
+    import numpy as np
+    import pyarrow as pa
+
+    from adam_tpu.io.dispatch import remap_reference_ids
+
+    t = pa.table({
+        "referenceId": pa.array([0, 5, None, 99, 7], pa.int32()),
+        "mateReferenceId": pa.array([5, None, 0, 7, 1234], pa.int32()),
+        "x": pa.array([1, 2, 3, 4, 5]),
+    })
+    out = remap_reference_ids(t, {0: 10, 5: 0, 7: 7, 1234: 2})
+    assert out.column("referenceId").to_pylist() == [10, 0, None, 99, 7]
+    assert out.column("mateReferenceId").to_pylist() == [0, None, 10, 7, 2]
+    # identity map: table returned untouched
+    assert remap_reference_ids(t, {3: 3, 9: 9}) is t
+
+
+def test_remap_reference_ids_huge_sparse_keys():
+    """nonoverlapping_hash contig ids reach ~2^30; a sparse map spanning
+    that range must remap without span-sized allocations."""
+    import pyarrow as pa
+
+    from adam_tpu.io.dispatch import remap_reference_ids
+
+    big = (1 << 30) - 7
+    t = pa.table({"referenceId": pa.array([0, big, 3], pa.int32())})
+    out = remap_reference_ids(t, {0: 1, big: 2})
+    assert out.column("referenceId").to_pylist() == [1, 2, 3]
