@@ -423,11 +423,46 @@ class Vcf2AdamCommand(Command):
     def add_args(self, p: argparse.ArgumentParser) -> None:
         p.add_argument("input", help="VCF file")
         p.add_argument("output", help="output basename (.v/.g/.vd datasets)")
+        p.add_argument("-stream", action="store_true",
+                       help="chunked bounded-memory parse (auto-enabled "
+                            "for inputs over 1 GB; .bcf stays in-memory)")
+        p.add_argument("-no_stream", action="store_true")
+        p.add_argument("-stream_chunk_rows", type=int, default=1 << 18)
         add_parquet_args(p)
 
     def run(self, args) -> int:
         from ..io.vcf import read_vcf
 
+        if should_stream(args, args.input) and \
+                not str(args.input).endswith(".bcf"):
+            from .. import schema as S
+            from ..io.parquet import DatasetWriter
+            from ..io.vcf import VcfStream
+            pw = parquet_writer_kwargs(args)
+            writers = {ext: DatasetWriter(args.output + ext, **pw)
+                       for ext in (".v", ".g", ".vd")}
+            schemas = {".v": S.VARIANT_SCHEMA, ".g": S.GENOTYPE_SCHEMA,
+                       ".vd": S.VARIANT_DOMAIN_SCHEMA}
+            n = {".v": 0, ".g": 0, ".vd": 0}
+            for v, g, d in VcfStream(args.input,
+                                     chunk_rows=args.stream_chunk_rows):
+                for ext, tbl in ((".v", v), (".g", g), (".vd", d)):
+                    n[ext] += tbl.num_rows
+                    writers[ext].write(tbl)
+            import pyarrow.parquet as pq
+            for ext, w in writers.items():
+                w.close()
+                if w.rows_written == 0:
+                    # a sites-only VCF has no genotype rows; the dataset
+                    # must still carry its schema (the in-memory path
+                    # writes a schema-bearing empty part, and
+                    # DatasetWriter never emits a part for zero rows)
+                    pq.write_table(
+                        schemas[ext].empty_table(),
+                        os.path.join(w.path, "part-r-00000.parquet"))
+            print(f"wrote {n['.v']} variants, {n['.g']} genotypes, "
+                  f"{n['.vd']} domains to {args.output}.{{v,g,vd}}")
+            return 0
         variants, genotypes, domains, _ = read_vcf(args.input)
         # three datasets, the reference's .v/.g/.vd convention
         # (AdamRDDFunctions.scala:330-363)

@@ -101,6 +101,186 @@ def _info_dict(info: str) -> Dict[str, str]:
     return out
 
 
+class VcfStream:
+    """Streaming VCF parser: iterate ``(variants, genotypes, domains)``
+    Arrow-table chunks of ~``chunk_rows`` variant rows each, holding only
+    one chunk of rows in memory (``read_vcf`` loads whole files; 1000G-
+    scale VCFs need this form).  ``seq_dict`` and ``samples`` are complete
+    once iteration finishes (contigs can appear mid-body via interning,
+    exactly like the whole-file parser).
+    """
+
+    def __init__(self, path_or_file, chunk_rows: int = 1 << 18):
+        self._source = path_or_file
+        self._chunk_rows = chunk_rows
+        self.samples: List[str] = []
+        self._contigs: List[SequenceRecord] = []
+        self._contig_by_name: Dict[str, SequenceRecord] = {}
+
+    @property
+    def seq_dict(self) -> SequenceDictionary:
+        return SequenceDictionary(self._contigs)
+
+    def _open_lines(self):
+        if hasattr(self._source, "read"):
+            return iter(self._source.read().splitlines()), None
+        p = str(self._source)
+        if p.endswith((".gz", ".bgz")):
+            import gzip
+            f = gzip.open(p, "rt")
+            return (ln.rstrip("\n") for ln in f), f
+        f = open(p, "rt")
+        return (ln.rstrip("\n") for ln in f), f
+
+    def __iter__(self):
+        lines, close_me = self._open_lines()
+        # a fresh pass re-reads the header: reset the interned state or a
+        # second iteration would duplicate contigs and shift referenceIds
+        self._contigs = []
+        self._contig_by_name = {}
+        self.samples = []
+        contigs = self._contigs
+        contig_by_name = self._contig_by_name
+        v_rows, g_rows, d_rows = [], [], []
+        samples = self.samples
+
+        def intern_contig(name: str) -> SequenceRecord:
+            rec = contig_by_name.get(name)
+            if rec is None:
+                rec = SequenceRecord(len(contigs), name, 0)
+                contigs.append(rec)
+                contig_by_name[name] = rec
+            return rec
+
+        def tables():
+            return (_rows_to_table(v_rows, S.VARIANT_SCHEMA),
+                    _rows_to_table(g_rows, S.GENOTYPE_SCHEMA),
+                    _rows_to_table(d_rows, S.VARIANT_DOMAIN_SCHEMA))
+
+        try:
+            for line in lines:
+                if line.startswith("##"):
+                    if line.startswith("##contig=<"):
+                        fields = dict(kv.split("=", 1)
+                                      for kv in line[10:].rstrip(">").split(",")
+                                      if "=" in kv)
+                        rec = SequenceRecord(
+                            len(contigs), fields.get("ID", f"c{len(contigs)}"),
+                            int(fields.get("length", 0)))
+                        contigs.append(rec)
+                        contig_by_name[rec.name] = rec
+                    continue
+                if line.startswith("#CHROM"):
+                    samples[:] = line.split("\t")[9:]  # mutate in place:
+                    #          self.samples must see the header
+                    continue
+                if not line.strip():
+                    continue
+                f = line.split("\t")
+                chrom, pos1, vid, ref, alts, qual, filt, info = f[:8]
+                fmt = f[8].split(":") if len(f) > 8 else []
+                pos = int(pos1) - 1
+                info_d = _info_dict(info)
+                contig = intern_contig(chrom)
+                refid = contig.id
+                alt_list = [a for a in alts.split(",") if a != "."]
+                afs = info_d.get("AF", "").split(",") if "AF" in info_d else []
+                sv = _sv_fields(info_d)
+
+                for ai, alt in enumerate(alt_list):
+                    # symbolic ALT (<DEL>, <DUP:TANDEM>) -> Complex with no base
+                    # string; breakend notation -> SV (convertType :207-218)
+                    if alt.startswith("<"):
+                        vtype, vseq = "Complex", None
+                    elif "[" in alt or "]" in alt:
+                        vtype, vseq = "SV", alt
+                    else:
+                        vtype, vseq = _variant_type(ref, alt), alt
+                    v_rows.append(sv | {
+                        "referenceId": refid, "referenceName": chrom,
+                        "referenceLength": contig.length or None,
+                        "referenceUrl": contig.url,
+                        "position": pos, "referenceAllele": ref, "variant": vseq,
+                        "variantType": vtype,
+                        "id": vid if vid != "." else None,
+                        "quality": int(float(qual)) if qual != "." else None,
+                        "filters": None if filt in (".", "PASS") else filt,
+                        "filtersRun": filt != ".",
+                        "alleleFrequency": float(afs[ai]) if ai < len(afs) else None,
+                        "rmsBaseQuality": int(info_d["BQ"]) if "BQ" in info_d else None,
+                        "siteRmsMappingQuality": int(info_d["MQ"]) if "MQ" in info_d else None,
+                        "siteMapQZeroCounts": int(info_d["MQ0"]) if "MQ0" in info_d else None,
+                        "totalSiteMapCounts": int(info_d["DP"]) if "DP" in info_d else None,
+                        "numberOfSamplesWithData": int(info_d["NS"]) if "NS" in info_d else None,
+                    })
+                d_rows.append({
+                    "referenceId": refid, "position": pos, "referenceAllele": ref,
+                    "variant": alt_list[0] if alt_list else None,
+                    "inDbSNP": "DB" in info_d, "inHM2": "H2" in info_d,
+                    "inHM3": "H3" in info_d, "in1000G": "1000G" in info_d,
+                })
+
+                alleles = [ref] + alts.split(",")
+                for si, sample in enumerate(samples):
+                    if 9 + si >= len(f):
+                        continue
+                    sd = dict(zip(fmt, f[9 + si].split(":")))
+                    gt = sd.get("GT", ".")
+                    phased = "|" in gt
+                    idxs = gt.replace("|", "/").split("/")
+                    hq = sd.get("HQ", "").split(",") if "HQ" in sd else []
+                    for hi, ix in enumerate(idxs):
+                        if ix == ".":
+                            continue
+                        allele = alleles[int(ix)]
+                        g_rows.append({
+                            "referenceId": refid, "referenceName": chrom,
+                            "position": pos, "sampleId": sample,
+                            "ploidy": len(idxs), "haplotypeNumber": hi,
+                            "allele": allele, "isReference": allele == ref,
+                            "referenceAllele": ref,
+                            "alleleVariantType": (
+                                "SNP" if allele == ref else
+                                "Complex" if allele.startswith("<") else
+                                "SV" if ("[" in allele or "]" in allele) else
+                                _variant_type(ref, allele)),
+                            "genotypeQuality": int(sd["GQ"]) if sd.get("GQ", "").isdigit() else None,
+                            "depth": int(sd["DP"]) if sd.get("DP", "").isdigit() else None,
+                            "phredLikelihoods": sd.get("PL"),
+                            "phredPosteriorLikelihoods": sd.get("GP"),
+                            "ploidyStateGenotypeLikelihoods": sd.get("GQL"),
+                            "rmsMapQuality": (int(sd["MQ"])
+                                              if sd.get("MQ", "").isdigit()
+                                              else None),
+                            "haplotypeQuality": (int(hq[hi])
+                                                 if hi < len(hq) and hq[hi].isdigit()
+                                                 else None),
+                            "isPhased": phased,
+                            # phasing extras only carry when the call IS phased
+                            # (VariantContextConverter :404-411)
+                            "phaseSetId": sd.get("PS") if phased else None,
+                            "phaseQuality": (int(sd["PQ"])
+                                             if phased and sd.get("PQ", "").isdigit()
+                                             else None),
+                        })
+                # flush on EITHER table: multi-sample VCFs grow g_rows
+                # ~samples x ploidy faster than v_rows, and the bound must
+                # hold for 2504-sample cohorts
+                if max(len(v_rows), len(g_rows)) >= self._chunk_rows:
+                    yield tables()
+                    v_rows, g_rows, d_rows = [], [], []
+            if v_rows or g_rows or d_rows:
+                yield tables()
+        finally:
+            if close_me is not None:
+                close_me.close()
+
+
+def _rows_to_table(rows, schema):
+    cols = {name: [r.get(name) for r in rows] for name in schema.names}
+    return pa.Table.from_pydict(cols, schema=schema)
+
+
 def read_vcf(path_or_file) -> Tuple[pa.Table, pa.Table, pa.Table,
                                     SequenceDictionary]:
     """Parse VCF -> (variants, genotypes, domains, sequence dictionary).
@@ -108,148 +288,23 @@ def read_vcf(path_or_file) -> Tuple[pa.Table, pa.Table, pa.Table,
     Dispatches on extension like the reference's adamLoad
     (AdamContext.scala:129-137): ``.bcf`` decodes through the binary codec
     (io/bcf.py), ``.vcf.gz``/``.vcf.bgz`` decompress first (BGZF is plain
-    concatenated gzip members), bare paths parse as text.
+    concatenated gzip members), bare paths parse as text.  The whole-file
+    form of :class:`VcfStream`.
     """
-    if hasattr(path_or_file, "read"):
-        lines = path_or_file.read().splitlines()
-    else:
-        p = str(path_or_file)
-        if p.endswith(".bcf"):
-            from .bcf import read_bcf
-            return read_bcf(p)
-        if p.endswith((".gz", ".bgz")):
-            import gzip
-            with gzip.open(p, "rt") as f:
-                lines = f.read().splitlines()
-        else:
-            with open(p, "rt") as f:
-                lines = f.read().splitlines()
-
-    contigs: List[SequenceRecord] = []
-    contig_by_name: Dict[str, SequenceRecord] = {}
-    samples: List[str] = []
-    v_rows, g_rows, d_rows = [], [], []
-
-    def intern_contig(name: str) -> SequenceRecord:
-        rec = contig_by_name.get(name)
-        if rec is None:
-            rec = SequenceRecord(len(contigs), name, 0)
-            contigs.append(rec)
-            contig_by_name[name] = rec
-        return rec
-    for line in lines:
-        if line.startswith("##"):
-            if line.startswith("##contig=<"):
-                fields = dict(kv.split("=", 1)
-                              for kv in line[10:].rstrip(">").split(",")
-                              if "=" in kv)
-                rec = SequenceRecord(
-                    len(contigs), fields.get("ID", f"c{len(contigs)}"),
-                    int(fields.get("length", 0)))
-                contigs.append(rec)
-                contig_by_name[rec.name] = rec
-            continue
-        if line.startswith("#CHROM"):
-            samples = line.split("\t")[9:]
-            continue
-        if not line.strip():
-            continue
-        f = line.split("\t")
-        chrom, pos1, vid, ref, alts, qual, filt, info = f[:8]
-        fmt = f[8].split(":") if len(f) > 8 else []
-        pos = int(pos1) - 1
-        info_d = _info_dict(info)
-        contig = intern_contig(chrom)
-        refid = contig.id
-        alt_list = [a for a in alts.split(",") if a != "."]
-        afs = info_d.get("AF", "").split(",") if "AF" in info_d else []
-        sv = _sv_fields(info_d)
-
-        for ai, alt in enumerate(alt_list):
-            # symbolic ALT (<DEL>, <DUP:TANDEM>) -> Complex with no base
-            # string; breakend notation -> SV (convertType :207-218)
-            if alt.startswith("<"):
-                vtype, vseq = "Complex", None
-            elif "[" in alt or "]" in alt:
-                vtype, vseq = "SV", alt
-            else:
-                vtype, vseq = _variant_type(ref, alt), alt
-            v_rows.append(sv | {
-                "referenceId": refid, "referenceName": chrom,
-                "referenceLength": contig.length or None,
-                "referenceUrl": contig.url,
-                "position": pos, "referenceAllele": ref, "variant": vseq,
-                "variantType": vtype,
-                "id": vid if vid != "." else None,
-                "quality": int(float(qual)) if qual != "." else None,
-                "filters": None if filt in (".", "PASS") else filt,
-                "filtersRun": filt != ".",
-                "alleleFrequency": float(afs[ai]) if ai < len(afs) else None,
-                "rmsBaseQuality": int(info_d["BQ"]) if "BQ" in info_d else None,
-                "siteRmsMappingQuality": int(info_d["MQ"]) if "MQ" in info_d else None,
-                "siteMapQZeroCounts": int(info_d["MQ0"]) if "MQ0" in info_d else None,
-                "totalSiteMapCounts": int(info_d["DP"]) if "DP" in info_d else None,
-                "numberOfSamplesWithData": int(info_d["NS"]) if "NS" in info_d else None,
-            })
-        d_rows.append({
-            "referenceId": refid, "position": pos, "referenceAllele": ref,
-            "variant": alt_list[0] if alt_list else None,
-            "inDbSNP": "DB" in info_d, "inHM2": "H2" in info_d,
-            "inHM3": "H3" in info_d, "in1000G": "1000G" in info_d,
-        })
-
-        alleles = [ref] + alts.split(",")
-        for si, sample in enumerate(samples):
-            if 9 + si >= len(f):
-                continue
-            sd = dict(zip(fmt, f[9 + si].split(":")))
-            gt = sd.get("GT", ".")
-            phased = "|" in gt
-            idxs = gt.replace("|", "/").split("/")
-            hq = sd.get("HQ", "").split(",") if "HQ" in sd else []
-            for hi, ix in enumerate(idxs):
-                if ix == ".":
-                    continue
-                allele = alleles[int(ix)]
-                g_rows.append({
-                    "referenceId": refid, "referenceName": chrom,
-                    "position": pos, "sampleId": sample,
-                    "ploidy": len(idxs), "haplotypeNumber": hi,
-                    "allele": allele, "isReference": allele == ref,
-                    "referenceAllele": ref,
-                    "alleleVariantType": (
-                        "SNP" if allele == ref else
-                        "Complex" if allele.startswith("<") else
-                        "SV" if ("[" in allele or "]" in allele) else
-                        _variant_type(ref, allele)),
-                    "genotypeQuality": int(sd["GQ"]) if sd.get("GQ", "").isdigit() else None,
-                    "depth": int(sd["DP"]) if sd.get("DP", "").isdigit() else None,
-                    "phredLikelihoods": sd.get("PL"),
-                    "phredPosteriorLikelihoods": sd.get("GP"),
-                    "ploidyStateGenotypeLikelihoods": sd.get("GQL"),
-                    "rmsMapQuality": (int(sd["MQ"])
-                                      if sd.get("MQ", "").isdigit()
-                                      else None),
-                    "haplotypeQuality": (int(hq[hi])
-                                         if hi < len(hq) and hq[hi].isdigit()
-                                         else None),
-                    "isPhased": phased,
-                    # phasing extras only carry when the call IS phased
-                    # (VariantContextConverter :404-411)
-                    "phaseSetId": sd.get("PS") if phased else None,
-                    "phaseQuality": (int(sd["PQ"])
-                                     if phased and sd.get("PQ", "").isdigit()
-                                     else None),
-                })
-
-    def table(rows, schema):
-        cols = {name: [r.get(name) for r in rows] for name in schema.names}
-        return pa.Table.from_pydict(cols, schema=schema)
-
-    return (table(v_rows, S.VARIANT_SCHEMA),
-            table(g_rows, S.GENOTYPE_SCHEMA),
-            table(d_rows, S.VARIANT_DOMAIN_SCHEMA),
-            SequenceDictionary(contigs))
+    if not hasattr(path_or_file, "read") and \
+            str(path_or_file).endswith(".bcf"):
+        from .bcf import read_bcf
+        return read_bcf(str(path_or_file))
+    stream = VcfStream(path_or_file)
+    chunks = list(stream)
+    if not chunks:
+        return (_rows_to_table([], S.VARIANT_SCHEMA),
+                _rows_to_table([], S.GENOTYPE_SCHEMA),
+                _rows_to_table([], S.VARIANT_DOMAIN_SCHEMA),
+                stream.seq_dict)
+    vs, gs, ds = zip(*chunks)
+    return (pa.concat_tables(vs), pa.concat_tables(gs),
+            pa.concat_tables(ds), stream.seq_dict)
 
 
 def write_vcf(variants: pa.Table, genotypes: pa.Table, path_or_file,
