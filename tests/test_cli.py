@@ -149,3 +149,32 @@ def test_bam2adam_samtools_validation(tmp_path, resources, capsys):
     assert rc != 0  # FormatError -> one-line CLI error, nonzero exit
     err = capsys.readouterr().err
     assert "malformed SAM record" in err
+
+
+def test_jenkins_smoke_pipeline(resources, tmp_path, capsys):
+    """The reference's only system test, end to end through the real CLI
+    (scripts/jenkins-test:21-38): bam2adam -> transform -sort_reads ->
+    reads2ref -> print -> flagstat, here starting from a BAM we write
+    ourselves (the native codec round-trips the SAM fixture)."""
+    from adam_tpu.cli.main import main
+    from adam_tpu.io.bam import write_bam
+    from adam_tpu.io.dispatch import load_reads
+
+    table, sd, rg = load_reads(
+        str(resources / "small_realignment_targets.sam"))
+    bam = tmp_path / "in.bam"
+    write_bam(table, sd, str(bam), rg)
+
+    adam = tmp_path / "reads.adam"
+    assert main(["bam2adam", str(bam), str(adam)]) == 0
+    sorted_out = tmp_path / "sorted.adam"
+    assert main(["transform", str(adam), str(sorted_out),
+                 "-sort_reads"]) == 0
+    pileups = tmp_path / "pileups.adam"
+    assert main(["reads2ref", str(sorted_out), str(pileups)]) == 0
+    assert main(["print", str(pileups), "-limit", "3"]) == 0
+    assert main(["flagstat", str(sorted_out)]) == 0
+    out = capsys.readouterr().out
+    assert "wrote 7 reads" in out          # bam2adam + transform
+    assert "707 pileups" in out            # reads2ref coverage line
+    assert "7 + 0 in total" in out         # flagstat header counter
