@@ -219,3 +219,31 @@ def test_streaming_flagstat_pallas_path_matches_xla(resources, monkeypatch):
     monkeypatch.setenv("ADAM_TPU_FLAGSTAT_IMPL", "pallas")
     got = streaming_flagstat(sam)
     assert got == ref
+
+
+def test_sharded_pallas_with_real_blocks_matches_core():
+    """A shard large enough to reach the Pallas grid kernel (>= one VMEM
+    block per shard) must still match the einsum core under shard_map —
+    shards below one block silently exercise only the XLA tail, which is
+    how a shard_map/vma incompatibility hid until the full-block dryrun."""
+    import numpy as np
+
+    from adam_tpu.ops.flagstat import (flagstat_kernel_wire32,
+                                       pack_flagstat_wire32)
+    from adam_tpu.ops.flagstat_pallas import (BLOCK,
+                                              flagstat_wire32_sharded_pallas)
+    from adam_tpu.parallel.mesh import make_mesh
+
+    mesh = make_mesh(4)
+    n = (BLOCK + 777) * 4          # one full block + ragged tail per shard
+    rng = np.random.RandomState(11)
+    wire = pack_flagstat_wire32(
+        rng.randint(0, 1 << 12, size=n).astype(np.uint16),
+        rng.randint(0, 61, size=n).astype(np.uint8),
+        rng.randint(0, 8, size=n).astype(np.int16),
+        rng.randint(0, 8, size=n).astype(np.int16),
+        rng.rand(n) < 0.97)
+    got = np.asarray(flagstat_wire32_sharded_pallas(mesh, interpret=True)(
+        wire))
+    want = np.asarray(flagstat_kernel_wire32(wire))
+    assert np.array_equal(got, want)
