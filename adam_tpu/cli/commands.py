@@ -424,8 +424,8 @@ class Vcf2AdamCommand(Command):
         p.add_argument("input", help="VCF file")
         p.add_argument("output", help="output basename (.v/.g/.vd datasets)")
         p.add_argument("-stream", action="store_true",
-                       help="chunked bounded-memory parse (auto-enabled "
-                            "for inputs over 1 GB; .bcf stays in-memory)")
+                       help="chunked bounded-memory parse, text or BCF "
+                            "(auto-enabled for inputs over 1 GB)")
         p.add_argument("-no_stream", action="store_true")
         p.add_argument("-stream_chunk_rows", type=int, default=1 << 18)
         add_parquet_args(p)
@@ -433,18 +433,22 @@ class Vcf2AdamCommand(Command):
     def run(self, args) -> int:
         from ..io.vcf import read_vcf
 
-        if should_stream(args, args.input) and \
-                not str(args.input).endswith(".bcf"):
+        if should_stream(args, args.input):
             from .. import schema as S
             from ..io.parquet import DatasetWriter
             from ..io.vcf import VcfStream
             pw = parquet_writer_kwargs(args)
+            source = args.input
+            if str(args.input).endswith(".bcf"):
+                # binary records stream as decoded VCF lines
+                from ..io.bcf import iter_bcf_vcf_lines
+                source = iter_bcf_vcf_lines(args.input)
             writers = {ext: DatasetWriter(args.output + ext, **pw)
                        for ext in (".v", ".g", ".vd")}
             schemas = {".v": S.VARIANT_SCHEMA, ".g": S.GENOTYPE_SCHEMA,
                        ".vd": S.VARIANT_DOMAIN_SCHEMA}
             n = {".v": 0, ".g": 0, ".vd": 0}
-            for v, g, d in VcfStream(args.input,
+            for v, g, d in VcfStream(source,
                                      chunk_rows=args.stream_chunk_rows):
                 for ext, tbl in ((".v", v), (".g", g), (".vd", d)):
                     n[ext] += tbl.num_rows

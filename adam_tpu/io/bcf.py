@@ -578,3 +578,67 @@ def vcf_text_to_bcf_bytes(vcf_text: str) -> bytes:
 def write_bcf(vcf_text: str, path) -> None:
     with open(path, "wb") as fh:
         fh.write(vcf_text_to_bcf_bytes(vcf_text))
+
+
+def iter_bcf_vcf_lines(path: str, chunk_bytes: int = 1 << 24):
+    """Streaming BCF -> VCF text lines: BGZF members decompress
+    incrementally (io/bam.iter_decompressed) and records decode from a
+    bounded buffer — ``read_bcf``/``bcf_to_vcf_text`` buffer whole files;
+    cohort-scale BCFs need this form.  Yields the header lines first, then
+    one record line per site; plug into ``vcf.VcfStream`` for chunked
+    Arrow tables.
+    """
+    from .bam import iter_decompressed
+
+    it = iter_decompressed(path, chunk_bytes)
+    buf = bytearray()
+    off = 0
+    exhausted = False
+
+    def fill(target: int) -> bool:
+        """Ensure ``target`` unconsumed bytes; compacts ONCE per refill —
+        a per-record front delete would memmove the whole window per
+        record (quadratic: ~160k records per 16 MB window)."""
+        nonlocal exhausted, off
+        if len(buf) - off >= target:
+            return True
+        if off:
+            del buf[:off]
+            off = 0
+        while not exhausted and len(buf) < target:
+            piece = next(it, None)
+            if piece is None:
+                exhausted = True
+            else:
+                buf.extend(piece)
+        return len(buf) >= target
+
+    if not fill(9):
+        raise ValueError("truncated BCF header")
+    if bytes(buf[off:off + 5]) != _MAGIC:
+        raise ValueError(
+            f"not a BCFv2 file (magic {bytes(buf[off:off + 5])!r}); plain "
+            "VCF text should go through io.vcf.read_vcf")
+    (l_text,) = struct.unpack_from("<I", buf, off + 5)
+    if not fill(9 + l_text):
+        raise ValueError("truncated BCF header text")
+    text = bytes(buf[off + 9:off + 9 + l_text]).split(b"\x00", 1)[0] \
+        .decode()
+    dicts = _HeaderDicts(text)
+    yield from text.rstrip("\n").split("\n")
+    off += 9 + l_text
+
+    while True:
+        if not fill(8):
+            if len(buf) - off:
+                raise ValueError(f"{len(buf) - off} trailing bytes form "
+                                 "no complete BCF record (truncated "
+                                 "file?)")
+            return
+        l_shared, l_indiv = struct.unpack_from("<II", buf, off)
+        if not fill(8 + l_shared + l_indiv):
+            raise ValueError("truncated BCF record")
+        shared = bytes(buf[off + 8:off + 8 + l_shared])
+        indiv = bytes(buf[off + 8 + l_shared:off + 8 + l_shared + l_indiv])
+        off += 8 + l_shared + l_indiv
+        yield _decode_record(shared, indiv, dicts)

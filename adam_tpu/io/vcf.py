@@ -124,6 +124,11 @@ class VcfStream:
     def _open_lines(self):
         if hasattr(self._source, "read"):
             return iter(self._source.read().splitlines()), None
+        if not isinstance(self._source, (str, bytes)) and \
+                hasattr(self._source, "__iter__"):
+            # a line iterator (e.g. bcf.iter_bcf_vcf_lines) — one-shot:
+            # a second __iter__ pass will see it exhausted
+            return iter(self._source), None
         p = str(self._source)
         if p.endswith((".gz", ".bgz")):
             import gzip
