@@ -110,11 +110,13 @@ def _emit(stage: str, payload: dict) -> None:
 # `jax.block_until_ready` does NOT synchronize on the axon tunnel backend
 # (measured: an 8-iter 4096^3 bf16 matmul loop "finishes" at 8x the chip's
 # peak FLOPs), and a `device_get` of even one scalar pays a ~190 ms tunnel
-# round trip.  Every device-resident rate here therefore (a) chains k
-# iterations INSIDE one jit with a lax.scan whose carry makes iteration i+1
-# data-dependent on iteration i (so XLA cannot CSE the repeats away), (b)
-# synchronizes once by pulling the tiny scan output to host, and (c)
-# subtracts the separately measured round-trip floor.
+# round trip.  Every device-resident rate therefore amortizes k chained
+# iterations against ONE tiny device_get and subtracts the separately
+# measured round-trip floor.  Two chaining forms: a lax.scan with a
+# data-dependent carry (_scan_rate — small bodies only: the remote AOT
+# compiler's scan compile time scales with body size/trip count), and a
+# host dispatch chain over the in-order stream (_chain_rate — compile
+# cost of one pass, used for every big-array stage).
 
 _RTT_CACHE: list = []
 
