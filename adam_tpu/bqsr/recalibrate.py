@@ -408,7 +408,12 @@ _COUNT_IMPL_ENV = "ADAM_TPU_BQSR_COUNT"
 
 def _count_impl(sharded: bool = False) -> str:
     choice = os.environ.get(_COUNT_IMPL_ENV, "auto")
-    if choice in ("scatter", "matmul", "host", "chain"):
+    if sharded and choice in ("chain", "pallas"):
+        # both run host-driven outside shard_map; honoring them under a
+        # mesh would silently drop the sharding — coerce to the scan form
+        # (same matmul math) rather than compute on one device
+        return "matmul"
+    if choice in ("scatter", "matmul", "host", "chain", "pallas"):
         return choice
     if jax.default_backend() == "cpu":
         return "scatter"
@@ -439,6 +444,21 @@ def _sharded_count_fn(kernel, mesh, n_qual_rg: int, n_cycle: int):
     return jax.jit(fn)
 
 
+#: row-slab bound for the pass-1 chunk walk.  The count kernels materialize
+#: several [rows, L] int32 covariate tensors; at the streaming pipeline's
+#: 1M-row chunks that working set (~2.4 GB) falls out of cache and the
+#: measured cost turns superlinear: 1M rows took 38 s where 5x the 200k-row
+#: time predicts 8 s (CPU backend, this box).  Walking the chunk in
+#: 256k-row slabs and summing the (tiny) count tensors restores the linear
+#: rate — count tensors are exact integer monoids, so the slab sum is
+#: bit-identical to the monolithic call for every impl.
+_COUNT_SLAB_ENV = "ADAM_TPU_COUNT_SLAB"
+
+
+def _count_slab_rows() -> int:
+    return int(os.environ.get(_COUNT_SLAB_ENV, str(256 * 1024)))
+
+
 def count_tables_device(table: pa.Table,
                         batch: Optional[ReadBatch] = None,
                         snp_table: Optional[SnpTable] = None,
@@ -451,10 +471,39 @@ def count_tables_device(table: pa.Table,
     device-side and let host pack/mismatch-state of chunk i+1 overlap the
     device count of chunk i.  ``tables_to_recal`` folds the accumulated
     tensors into a RecalTable at pass end.
+
+    Large chunks walk in `_count_slab_rows()` row slabs (see note at
+    ``_COUNT_SLAB_ENV``); the sharded mesh path stays monolithic — its rows
+    already split across devices under shard_map.
     """
     n = table.num_rows
     if batch is None:
         batch = pack_reads(table)
+    if n_read_groups is None:
+        n_read_groups = int(np.asarray(batch.read_group).max(initial=0)) + 1
+    sharded = mesh is not None and mesh.size > 1 and \
+        batch.n_reads % mesh.size == 0
+    slab = _count_slab_rows()
+    if not sharded and batch.n_reads > slab:
+        acc = None
+        for s in range(0, batch.n_reads, slab):
+            e = min(s + slab, batch.n_reads)
+            out = _count_tables_one(table.slice(s, max(min(e, n) - s, 0)),
+                                    batch.row_slice(s, e),
+                                    snp_table, n_read_groups, None)
+            acc = out if acc is None else tuple(
+                a + b for a, b in zip(acc, out))
+        return acc
+    return _count_tables_one(table, batch, snp_table, n_read_groups,
+                             mesh if sharded else None)
+
+
+def _count_tables_one(table: pa.Table, batch: ReadBatch,
+                      snp_table: Optional[SnpTable],
+                      n_read_groups: int, mesh):
+    """One slab's pass-1 count (the pre-slab body of
+    :func:`count_tables_device`)."""
+    n = table.num_rows
     from ..ops.pileup import _col_valid
     has_md = np.zeros(batch.n_reads, bool)
     has_md[:n] = _col_valid(table.column("mismatchingPositions"))
@@ -464,17 +513,25 @@ def count_tables_device(table: pa.Table,
     state = np.full((batch.n_reads, batch.max_len), STATE_MASKED, np.int8)
     state[:n] = mismatch_state(table, batch, snp_table)
 
-    if n_read_groups is None:
-        n_read_groups = int(np.asarray(batch.read_group).max(initial=0)) + 1
     rt = RecalTable(n_read_groups=max(n_read_groups, 1),
                     max_read_len=batch.max_len)
-    sharded = mesh is not None and mesh.size > 1 and \
-        batch.n_reads % mesh.size == 0
+    sharded = mesh is not None
     impl = _count_impl(sharded=sharded)
     if impl == "host":
         out = _count_tables_host(batch, state, usable,
                                  n_qual_rg=rt.n_qual_rg,
                                  n_cycle=rt.n_cycle)
+    elif impl == "pallas":
+        from .count_pallas import count_kernel_pallas, fits
+        from ..platform import is_tpu_backend
+        assert fits(rt.n_qual_rg, rt.n_cycle), \
+            "covariate ranges exceed the packed-word budget"
+        out = count_kernel_pallas(
+            jnp.asarray(batch.bases), jnp.asarray(batch.quals),
+            jnp.asarray(batch.read_len), jnp.asarray(batch.flags),
+            jnp.asarray(batch.read_group), jnp.asarray(state),
+            jnp.asarray(usable), n_qual_rg=rt.n_qual_rg,
+            n_cycle=rt.n_cycle, interpret=not is_tpu_backend())
     else:
         kernel = {"matmul": _count_kernel_matmul,
                   "chain": _count_kernel_chain}.get(impl, _count_kernel)

@@ -87,6 +87,14 @@ class ReadBatch:
             kw[f.name] = None if v is None else jax.device_put(v, sharding)
         return ReadBatch(**kw)
 
+    def row_slice(self, s: int, e: int) -> "ReadBatch":
+        """Row-slice every populated column (zero-copy views)."""
+        kw = {}
+        for f in dc_fields(self):
+            v = getattr(self, f.name)
+            kw[f.name] = None if v is None else v[s:e]
+        return ReadBatch(**kw)
+
 
 if _HAVE_JAX:
     jax.tree_util.register_pytree_node(

@@ -49,7 +49,7 @@ CPU_RESERVE_S = float(os.environ.get("ADAM_TPU_BENCH_CPU_RESERVE", "150"))
 #: per-stage stdout deadlines for the worker (probe covers backend init +
 #: first compile over the tunnel)
 STAGE_TIMEOUT_S = {"probe": 150.0, "flagstat": 180.0, "transform": 280.0,
-                   "pallas": 240.0}
+                   "bqsr_race": 300.0, "pallas": 240.0}
 _START = time.monotonic()
 
 
@@ -491,6 +491,111 @@ def _stage_transform(kind: str, is_tpu: bool):
     })
 
 
+def _stage_bqsr_race(kind: str, is_tpu: bool):
+    """Race every BQSR pass-1 count backend on one device-resident batch
+    (VERDICT r3 #2): scatter (XLA scatter-add), matmul (blocked one-hot
+    MXU scan), chain (host-dispatched matmul blocks — the scan-compile
+    escape), and pallas (packed-word VMEM one-hot sweep; TPU only).
+    Reports
+    reads/s per impl and the winner; the product's auto pick
+    (`bqsr.recalibrate._count_impl`) should match the winner on each
+    platform."""
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    from adam_tpu.bqsr.recalibrate import (_count_kernel,
+                                           _count_kernel_chain,
+                                           _count_kernel_matmul)
+    from adam_tpu.bqsr.table import RecalTable
+
+    L, n_rg = 100, 4
+    default_n = 1_000_000 if is_tpu else 50_000
+    n = int(os.environ.get("ADAM_TPU_BENCH_RACE_READS", default_n))
+    rt = RecalTable(n_read_groups=n_rg, max_read_len=L)
+
+    @jax.jit
+    def gen(key):
+        ks = jax.random.split(key, 5)
+        return (
+            jax.random.randint(ks[0], (n, L), 0, 4, jnp.int32
+                               ).astype(jnp.int8),          # bases
+            jax.random.randint(ks[1], (n, L), 2, 41, jnp.int32
+                               ).astype(jnp.int8),          # quals
+            jnp.full((n,), L, jnp.int32),                   # read_len
+            jnp.where(jax.random.uniform(ks[2], (n,)) < 0.5, 16, 0
+                      ).astype(jnp.int32),                  # flags
+            jax.random.randint(ks[3], (n,), 0, n_rg, jnp.int32),
+            jax.random.randint(ks[4], (n, L), 0, 3, jnp.int32
+                               ).astype(jnp.int8),          # state
+            jnp.ones((n,), bool),                           # usable
+        )
+
+    args = gen(jax.random.PRNGKey(7))
+    rtt = _tunnel_rtt()
+    payload: dict = {"race_n_reads": n,
+                     "race_backend": jax.default_backend()}
+    rates: dict = {}
+
+    def race(name, make_step, k_probe=2, k_max=64):
+        try:
+            st: dict = {}
+
+            def step():
+                st["out"] = make_step()
+
+            per, k_used = _chain_rate(step, lambda: st["out"][0], rtt,
+                                      k_probe=k_probe, k_max=k_max)
+            rates[name] = n / per
+            payload[f"race_{name}_reads_per_sec"] = round(n / per)
+            payload[f"race_{name}_chain_len"] = k_used
+        except Exception as e:  # noqa: BLE001 — record, race the rest
+            payload[f"race_{name}_error"] = f"{type(e).__name__}: {e}"[:160]
+
+    kw = dict(n_qual_rg=rt.n_qual_rg, n_cycle=rt.n_cycle)
+    race("scatter", lambda: _count_kernel(*args, **kw))
+    if is_tpu:
+        # the matmul leg is a lax.scan over n/block_rows (~2k) one-hot
+        # blocks; the remote AOT compiler unrolls scan bodies at ~2 s
+        # each (see recalibrate._count_impl), so compiling it here would
+        # eat the whole stage deadline.  chain IS the same math driven by
+        # host dispatch — it races in matmul's stead.
+        payload["race_matmul_skipped"] = \
+            "scan AOT-unroll compile ~2s/block; chain is the same math"
+    else:
+        race("matmul", lambda: _count_kernel_matmul(*args, **kw))
+    race("chain", lambda: _count_kernel_chain(*args, **kw))
+    if is_tpu:
+        from adam_tpu.bqsr.count_pallas import count_kernel_pallas
+        race("pallas", lambda: count_kernel_pallas(*args, **kw))
+
+    if rates:
+        winner = max(rates, key=rates.get)
+        best = rates[winner]
+        peak_fl, peak_bw, peak_ref = _peaks_for(kind)
+        payload["race_winner"] = winner
+        payload["race_winner_reads_per_sec"] = round(best)
+        # roofline bases: the pallas wire model moves 8 B/base (packed
+        # index+weight words) + ~3 B/base prologue reads; its MXU cost is
+        # the two one-hot NT dots over the kernel's actual padded dims
+        from adam_tpu.bqsr.count_pallas import CTX_COLS, _round_up
+        q_pad = _round_up(rt.n_qual_rg, 8)
+        cat_cols = _round_up(rt.n_cycle, 128) + CTX_COLS
+        flops_per_read = 2 * 2 * q_pad * cat_cols * L
+        payload["race_bytes_per_read_wire"] = 11.0 * L
+        payload["race_peak_ref"] = peak_ref
+        if "pallas" in rates:
+            payload["race_pallas_gbytes_per_sec"] = round(
+                rates["pallas"] * 11.0 * L / 1e9, 2)
+            payload["race_pallas_pct_peak_hbm"] = round(
+                100 * rates["pallas"] * 11.0 * L / peak_bw, 2)
+            payload["race_pallas_mxu_flops_per_read"] = flops_per_read
+            payload["race_pallas_mfu_pct"] = round(
+                100 * rates["pallas"] * flops_per_read / peak_fl, 2)
+    _emit("bqsr_race", payload)
+
+
 def _stage_pallas():
     """Compile-and-time the Pallas kernels on the real device (VERDICT r2
     weak #2: interpreter-only so far).  Falls out with ok=False rather than
@@ -589,6 +694,8 @@ def _worker(stages: list[str]) -> None:
             _emit("pallas", {"skipped": "pallas stages need a TPU backend"})
     if "transform" in stages:
         _stage_transform(kind, is_tpu)
+    if "bqsr_race" in stages:
+        _stage_bqsr_race(kind, is_tpu)
 
 
 # ---------------------------------------------------------------------------
@@ -667,7 +774,7 @@ def main() -> None:
     errors: list[str] = []
     stages: dict = {}
     try:
-        want = ["probe", "flagstat", "pallas", "transform"]
+        want = ["probe", "flagstat", "pallas", "transform", "bqsr_race"]
         attempt = 0
         cpu_incidental: dict = {}
         fails: dict = {}
@@ -754,6 +861,9 @@ def main() -> None:
             result.update(tr)
             result["transform_vs_target"] = round(
                 tr["transform_fused_reads_per_sec"] / 10e6, 3)
+        br = stages.get("bqsr_race")
+        if br:
+            result.update(br)
         pl = stages.get("pallas")
         if pl:
             result.update({f"pallas_{k}" if not k.startswith(

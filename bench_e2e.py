@@ -115,7 +115,10 @@ def run(n_reads: int, chunk_rows: int) -> dict:
     tmp = tempfile.mkdtemp(prefix="adam_e2e_")
     bam = os.path.join(tmp, "synth.bam")
     stats = synth_bam(bam, n_reads)
-    stats["platform"] = jax.default_backend()
+    backend = jax.default_backend()
+    # the tunnel plugin reports "axon"; the artifact field means "ran on
+    # the chip", so normalize it the way bench.py's probe does
+    stats["platform"] = "tpu" if backend in ("tpu", "axon") else backend
     stats["device_kind"] = getattr(jax.devices()[0], "device_kind", "?")
     stats["chunk_rows"] = chunk_rows
 

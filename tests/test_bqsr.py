@@ -237,3 +237,62 @@ def test_count_impl_chain_matches_scatter():
                               n_cycle=rt.n_cycle, block_rows=256)
     for a, b in zip(got, ref):
         assert np.array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_count_slab_walk_matches_monolithic(monkeypatch):
+    """The bounded-slab chunk walk (ADAM_TPU_COUNT_SLAB) must sum to the
+    bit-identical tables of one monolithic pass — including when the pad
+    rows and the MD-less reads land mid-slab."""
+    import numpy as np
+
+    from adam_tpu.bqsr import recalibrate as R
+
+    rows = []
+    rng = np.random.RandomState(11)
+    for i in range(90):
+        L = int(rng.randint(6, 12))
+        seq = "".join("ACGT"[c] for c in rng.randint(0, 4, L))
+        md = None if rng.rand() < 0.15 else (
+            f"{L}" if rng.rand() < 0.6 else f"{L//2}A{L - L//2 - 1}")
+        quals = rng.randint(2, 41, L)
+        rows.append(read(sequence=seq, cigar=f"{L}M", md=md,
+                         start=int(rng.randint(0, 500)),
+                         quals=tuple(quals), name=f"r{i}",
+                         flags=int(rng.choice([0, 16, 83, 163])),
+                         rg=int(rng.randint(0, 3))))
+    table = _reads_table(rows)
+    batch = pack_reads(table, pad_rows_to=64)   # pad rows inside last slab
+
+    monkeypatch.setenv(R._COUNT_SLAB_ENV, str(1 << 30))
+    mono = R.count_tables_device(table, batch, n_read_groups=3)
+    monkeypatch.setenv(R._COUNT_SLAB_ENV, "32")  # 90 rows -> 4 slabs
+    slabbed = R.count_tables_device(table, batch, n_read_groups=3)
+    for a, b in zip(slabbed, mono):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_count_impl_pallas_matches_scatter():
+    """The Pallas packed-word MXU count backend must produce bit-identical
+    tables to the scatter oracle (interpret mode on the CPU test mesh)."""
+    import numpy as np
+
+    from adam_tpu.bqsr.count_pallas import count_kernel_pallas, fits
+    from adam_tpu.bqsr.recalibrate import _count_kernel
+    from adam_tpu.bqsr.table import RecalTable
+
+    rng = np.random.RandomState(5)
+    n, L, n_rg = 300, 50, 3
+    rt = RecalTable(n_read_groups=n_rg, max_read_len=L)
+    assert fits(rt.n_qual_rg, rt.n_cycle)
+    args = (rng.randint(0, 4, (n, L)).astype(np.int8),
+            rng.randint(2, 41, (n, L)).astype(np.int8),
+            rng.randint(30, L + 1, n).astype(np.int32),
+            rng.choice([0, 16, 1 | 128], n).astype(np.int32),
+            rng.randint(0, n_rg, n).astype(np.int32),
+            rng.randint(0, 3, (n, L)).astype(np.int8),
+            rng.rand(n) < 0.9)
+    ref = _count_kernel(*args, n_qual_rg=rt.n_qual_rg, n_cycle=rt.n_cycle)
+    got = count_kernel_pallas(*args, n_qual_rg=rt.n_qual_rg,
+                              n_cycle=rt.n_cycle, interpret=True)
+    for a, b in zip(got, ref):
+        assert np.array_equal(np.asarray(a), np.asarray(b))
