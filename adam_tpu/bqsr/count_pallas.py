@@ -11,9 +11,9 @@ operands in HBM — ~4 KB of traffic per base (``[X, Q]`` + ``[X, C]`` bf16
 round trips) against ~8 B of actual information.  This kernel:
 
   * packs the four covariate indices of a base into ONE int32 word in an
-    XLA prologue (k:10 | cycle:9 | context:5 | qual:8 bits — ranges are
-    asserted by :func:`fits`), plus a 3-bit weight word: 8 B/base of HBM
-    traffic total;
+    XLA prologue (k:10 | cycle:10 | context:5 | qual:7 bits — ranges are
+    asserted by :func:`fits`; quals arrive as int8 so 7 bits are exact),
+    plus a 3-bit weight word: 8 B/base of HBM traffic total;
   * unpacks in VMEM, builds the one-hot indicator tiles in vector
     registers, and contracts them on the MXU with NT-form ``dot_general``
     (contraction over the lane axis — the attention-QK^T shape);
@@ -40,6 +40,7 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
+from ..packing import _round_up
 from .covariates import N_CONTEXT, covariate_tensors
 from .recalibrate import STATE_MASKED, STATE_MISMATCH
 
@@ -48,13 +49,14 @@ BLOCK_ELEMS = 2048
 #: context bins occupy one lane-tile after the cycle bins
 CTX_COLS = 128
 
-_K_BITS, _CYC_BITS, _CTX_BITS = 10, 9, 5
+_K_BITS, _CYC_BITS, _CTX_BITS, _Q_BITS = 10, 10, 5, 7
 
 
 def fits(n_qual_rg: int, n_cycle: int) -> bool:
     """Do the covariate ranges fit the packed-word bit budget?  (True for
-    every real configuration: k < 1024 covers 15 read groups, cycle < 512
-    covers 255 bp reads, context < 32 always.)"""
+    every real configuration: k < 1024 covers 15 read groups; cycle <
+    1024 covers the 511-bp length bucket, i.e. every short-read input;
+    context < 32 always; quals are int8 so 7 bits are exact.)"""
     return (n_qual_rg <= 1 << _K_BITS and n_cycle <= 1 << _CYC_BITS
             and N_CONTEXT <= 1 << _CTX_BITS)
 
@@ -70,7 +72,9 @@ def _pack_words(bases, quals, read_len, flags, read_group, state, usable,
     windowed = cov["in_window"] & usable[:, None]
     k = jnp.clip(cov["qual_rg"], 0, n_qual_rg - 1)
     cyc = jnp.clip(cov["cycle_idx"], 0, n_cycle - 1)
-    q = jnp.clip(quals.astype(jnp.int32), 0, 255)
+    # int8 quals are <= 127, so the 7-bit field loses nothing (negative
+    # pad values clip to 0, matching the scatter oracle's qhist clip)
+    q = jnp.clip(quals.astype(jnp.int32), 0, (1 << _Q_BITS) - 1)
 
     word = (k | (cyc << _K_BITS) | (cov["context"] << (_K_BITS + _CYC_BITS))
             | (q << (_K_BITS + _CYC_BITS + _CTX_BITS)))
@@ -103,7 +107,7 @@ def _kernel(word_ref, wbits_ref, obs_ref, mm_ref, qh_ref, *,
     k = word & ((1 << _K_BITS) - 1)
     cyc = (word >> _K_BITS) & ((1 << _CYC_BITS) - 1)
     ctx = (word >> (_K_BITS + _CYC_BITS)) & ((1 << _CTX_BITS) - 1)
-    q = (word >> (_K_BITS + _CYC_BITS + _CTX_BITS)) & 0xFF
+    q = (word >> (_K_BITS + _CYC_BITS + _CTX_BITS)) & ((1 << _Q_BITS) - 1)
     w = (wbits & 1).astype(jnp.bfloat16)
     wm = ((wbits >> 1) & 1).astype(jnp.bfloat16)
     ww = ((wbits >> 2) & 1).astype(jnp.bfloat16)
@@ -133,10 +137,6 @@ def _kernel(word_ref, wbits_ref, obs_ref, mm_ref, qh_ref, *,
     qh_ref[...] += jax.lax.dot_general(
         ww8, ohq, nt, preferred_element_type=jnp.float32
     ).astype(jnp.int32)
-
-
-def _round_up(x: int, m: int) -> int:
-    return -(-x // m) * m
 
 
 @functools.partial(jax.jit,

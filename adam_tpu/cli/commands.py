@@ -86,6 +86,9 @@ class FlagStatCommand(Command):
         p.add_argument("input", help="SAM/BAM file or ADAM Parquet dataset")
         p.add_argument("-chunk_rows", type=int, default=1 << 22,
                        help="reads per streamed chunk (bounds host memory)")
+        p.add_argument("-io_threads", type=int, default=1,
+                       help="overlap host decode with device dispatch "
+                            "(reader thread + pack pool; >1 enables)")
 
     def run(self, args) -> int:
         from ..ops.flagstat import format_report
@@ -94,7 +97,8 @@ class FlagStatCommand(Command):
         # streams bounded chunks of the 4-column projection (the reference's
         # 13-field projection, cli/FlagStat.scala:50-57) through the mesh
         failed, passed = streaming_flagstat(args.input,
-                                            chunk_rows=args.chunk_rows)
+                                            chunk_rows=args.chunk_rows,
+                                            io_threads=args.io_threads)
         print(format_report(failed, passed))
         return 0
 
@@ -160,6 +164,10 @@ class TransformCommand(Command):
                             "over 1 GB unless the output is .sam)")
         p.add_argument("-stream_chunk_rows", type=int, default=1 << 20,
                        help="reads per streamed chunk")
+        p.add_argument("-io_threads", type=int, default=1,
+                       help="overlap host decode+pack with device "
+                            "dispatch in every streaming pass (reader "
+                            "thread + pack pool; output is bit-identical)")
         p.add_argument("-workdir", default=None,
                        help="scratch directory for streamed spills "
                             "(default: a temp dir)")
@@ -202,7 +210,8 @@ class TransformCommand(Command):
                 page_size=pw["page_size"],
                 use_dictionary=pw["use_dictionary"],
                 row_group_bytes=args.parquet_block_size,
-                resume=bool(args.checkpoint_dir))
+                resume=bool(args.checkpoint_dir),
+                io_threads=args.io_threads)
             if args.timing:
                 from ..instrument import report
                 print(report().format())
@@ -703,25 +712,51 @@ class Fasta2AdamCommand(Command):
         p.add_argument("-reads", default=None,
                        help="reads file whose dictionary supplies contig ids "
                             "(cli/Fasta2Adam.scala:57-82)")
+        p.add_argument("-stream", action="store_true",
+                       help="bounded-memory per-contig conversion "
+                            "(auto-enabled for inputs over 1 GB)")
+        p.add_argument("-no_stream", action="store_true")
         add_parquet_args(p)
 
-    def run(self, args) -> int:
+    def _remap_ids(self, contigs, sd):
         import pyarrow as pa
-        from ..io.fasta import read_fasta
-        from ..io.parquet import save_table
+        names = contigs.column("contigName").to_pylist()
+        new_ids = [sd[n].id if n in sd else None for n in names]
+        return contigs.set_column(
+            contigs.column_names.index("contigId"), "contigId",
+            pa.array(new_ids, pa.int32()))
 
-        contigs = read_fasta(args.input)
+    def run(self, args) -> int:
+        from ..io.fasta import contig_batches, read_fasta
+
+        sd = None
         if args.reads:
             from ..io.dispatch import (load_reads,
                                        sequence_dictionary_from_reads)
             rtable, sd, _ = load_reads(args.reads)
             if sd is None:
                 sd = sequence_dictionary_from_reads(rtable)
-            names = contigs.column("contigName").to_pylist()
-            new_ids = [sd[n].id if n in sd else None for n in names]
-            contigs = contigs.set_column(
-                contigs.column_names.index("contigId"), "contigId",
-                pa.array(new_ids, pa.int32()))
+        if should_stream(args, args.input):
+            # bounded-memory path (FastaConverter.scala:27-166 converts
+            # distributed; here contigs flush to parts as they complete)
+            from ..io.parquet import DatasetWriter
+            kw = parquet_writer_kwargs(args)
+            if kw.get("compression") is None:       # "uncompressed"
+                kw["compression"] = "none"
+            kw["row_group_bytes"] = getattr(args, "parquet_block_size",
+                                            None)
+            n = 0
+            with DatasetWriter(args.output, **kw) as w:
+                for contigs in contig_batches(args.input, url=args.input):
+                    if sd is not None:
+                        contigs = self._remap_ids(contigs, sd)
+                    w.write(contigs)
+                    n += contigs.num_rows
+            print(f"wrote {n} contigs to {args.output}")
+            return 0
+        contigs = read_fasta(args.input)
+        if sd is not None:
+            contigs = self._remap_ids(contigs, sd)
         save_with_args(contigs, args.output, args)
         print(f"wrote {contigs.num_rows} contigs to {args.output}")
         return 0

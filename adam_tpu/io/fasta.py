@@ -1,51 +1,127 @@
 """FASTA import -> ADAMNucleotideContig records.
 
 Re-designs ``converters/FastaConverter.scala:27-166`` (line-number-keyed
-Spark FASTA assembly) as a simple host parse: ``>name description`` headers,
-sequence lines concatenated, sequential contig ids.
+Spark FASTA assembly) as a bounded-buffer chunk parse: the file reads in
+fixed-size byte chunks and contigs emit as soon as their last line is seen,
+so host RSS is bounded by (largest single contig + one IO chunk) rather
+than the whole file — the reference gets the same bound from Spark
+partitioning.  ``>name description`` headers, sequence lines concatenated,
+sequential contig ids.
 """
 
 from __future__ import annotations
 
-from typing import Optional, Tuple
+from typing import Iterator, Optional, Tuple
 
 import pyarrow as pa
 
 from .. import schema as S
 
+#: bytes per read() chunk of the streaming parser
+_CHUNK_BYTES = 8 << 20
+
+
+def iter_fasta(path_or_file, chunk_bytes: int = _CHUNK_BYTES
+               ) -> Iterator[Tuple[str, Optional[str], str]]:
+    """Yield ``(name, description, sequence)`` per contig, reading the
+    file in ``chunk_bytes`` pieces.  Peak memory: one contig's sequence
+    pieces + one IO chunk."""
+    f = path_or_file if hasattr(path_or_file, "read") \
+        else open(path_or_file, "rt")
+    owns = f is not path_or_file
+    try:
+        name: Optional[str] = None
+        desc: Optional[str] = None
+        pieces: list = []
+        started = False
+        carry = ""
+        while True:
+            chunk = f.read(chunk_bytes)
+            if not chunk:
+                break
+            chunk = carry + chunk
+            lines = chunk.split("\n")
+            carry = lines.pop()          # last piece may be mid-line
+            for line in lines:
+                line = line.strip()
+                if not line:
+                    continue
+                if line.startswith(">"):
+                    if started:
+                        yield name or "", desc, "".join(pieces)
+                    header = line[1:].split(None, 1)
+                    name = header[0] if header else ""
+                    desc = header[1] if len(header) > 1 else None
+                    pieces = []
+                    started = True
+                else:
+                    if not started:      # headerless: anonymous contig
+                        name, desc, started = "", None, True
+                    pieces.append(line.upper())
+                    if len(pieces) >= 4096:
+                        # compact: per-line str objects cost ~2x their
+                        # payload; long contigs would otherwise hold
+                        # millions of them
+                        pieces = ["".join(pieces)]
+        last = carry.strip()
+        if last:
+            if last.startswith(">"):
+                if started:
+                    yield name or "", desc, "".join(pieces)
+                header = last[1:].split(None, 1)
+                yield (header[0] if header else ""), \
+                    (header[1] if len(header) > 1 else None), ""
+                return
+            if not started:
+                name, desc, started = "", None, True
+            pieces.append(last.upper())
+        if started:
+            yield name or "", desc, "".join(pieces)
+    finally:
+        if owns:
+            f.close()
+
+
+def contig_batches(path_or_file, url: Optional[str] = None,
+                   batch_bytes: int = 256 << 20,
+                   start_id: int = 0) -> Iterator[pa.Table]:
+    """CONTIG_SCHEMA tables of whole contigs, flushed every
+    ``batch_bytes`` of sequence — the bounded-memory unit the streaming
+    ``fasta2adam`` writes per part."""
+    names, descs, seqs = [], [], []
+    held = 0
+    next_id = start_id
+
+    def flush():
+        nonlocal names, descs, seqs, held
+        t = pa.Table.from_pydict({
+            "contigName": names,
+            "contigId": list(range(next_id - len(names), next_id)),
+            "description": descs,
+            "sequence": seqs,
+            "sequenceLength": [len(s) for s in seqs],
+            "url": [url] * len(names),
+        }, schema=S.CONTIG_SCHEMA)
+        names, descs, seqs = [], [], []
+        held = 0
+        return t
+
+    for name, desc, seq in iter_fasta(path_or_file):
+        names.append(name)
+        descs.append(desc)
+        seqs.append(seq)
+        held += len(seq)
+        next_id += 1
+        if held >= batch_bytes:
+            yield flush()
+    if names or next_id == start_id:
+        yield flush()
+
 
 def read_fasta(path_or_file, url: Optional[str] = None) -> pa.Table:
-    if hasattr(path_or_file, "read"):
-        text = path_or_file.read()
-    else:
-        url = url or str(path_or_file)
-        with open(path_or_file, "rt") as f:
-            text = f.read()
-    names, descs, seqs = [], [], []
-    cur: list = []
-    for line in text.splitlines():
-        line = line.strip()
-        if not line:
-            continue
-        if line.startswith(">"):
-            header = line[1:].split(None, 1)
-            names.append(header[0] if header else "")
-            descs.append(header[1] if len(header) > 1 else None)
-            cur = []
-            seqs.append(cur)
-        else:
-            if not names:  # headerless FASTA: single anonymous contig
-                names.append("")
-                descs.append(None)
-                cur = []
-                seqs.append(cur)
-            cur.append(line.upper())
-    joined = ["".join(s) for s in seqs]
-    return pa.Table.from_pydict({
-        "contigName": names,
-        "contigId": list(range(len(names))),
-        "description": descs,
-        "sequence": joined,
-        "sequenceLength": [len(s) for s in joined],
-        "url": [url] * len(names),
-    }, schema=S.CONTIG_SCHEMA)
+    """Whole-file form (small references / tests); the chunked parser
+    underneath keeps intermediate copies bounded."""
+    if url is None and not hasattr(path_or_file, "read"):
+        url = str(path_or_file)
+    tables = list(contig_batches(path_or_file, url=url))
+    return tables[0] if len(tables) == 1 else pa.concat_tables(tables)

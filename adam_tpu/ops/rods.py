@@ -84,13 +84,14 @@ def pileups_to_rods(pileups: pa.Table) -> RodView:
     return RodView(sorted_t, refid[order][starts], pos[order][starts], offsets)
 
 
-def reads_to_rods(table: pa.Table, bucket_size: int = 1000) -> RodView:
+def reads_to_rods(table: pa.Table) -> RodView:
     """Reads → pileups → rods (adamRecords2Rods :144-191).
 
-    ``bucket_size`` is accepted for signature parity; see module docstring
-    for why the bucketed shuffle is not needed here.
+    The reference's ``bucketSize`` knob is deliberately absent: its
+    bucketed shuffle is a distribution trick (see module docstring); the
+    windowed streaming analog takes its window size from
+    ``parallel.pipeline``'s genome bins, not from a rod-level parameter.
     """
-    del bucket_size
     mapped = table.filter(pc.is_valid(table.column("start")))
     return pileups_to_rods(reads_to_pileups(mapped))
 

@@ -52,7 +52,8 @@ def _wire32_from_table(table: pa.Table) -> np.ndarray:
         np.ones(n, np.uint8))
 
 
-def streaming_flagstat(path: str, *, mesh=None, chunk_rows: int = 1 << 22
+def streaming_flagstat(path: str, *, mesh=None, chunk_rows: int = 1 << 22,
+                       io_threads: int = 1
                        ) -> Tuple["FlagStatMetrics", "FlagStatMetrics"]:
     """Chunked, mesh-sharded flagstat over any reads input.
 
@@ -107,6 +108,12 @@ def streaming_flagstat(path: str, *, mesh=None, chunk_rows: int = 1 << 22
         stream = open_read_stream(path, columns=FLAGSTAT_COLUMNS,
                                   chunk_rows=chunk_rows)
         wire_chunks = (_wire32_from_table(t) for t in stream)
+    if io_threads > 1:
+        # decode (native wire walk / Arrow projection) moves to a reader
+        # thread so it overlaps device dispatch; counter accumulation is
+        # an exact integer monoid, so the result cannot depend on timing
+        from .ingest import pipelined
+        wire_chunks = pipelined(wire_chunks, workers=io_threads)
     for wire in wire_chunks:
         n_pad = _pad_to(len(wire), mesh.size)
         if n_pad != len(wire):  # padding words carry valid=0
@@ -358,6 +365,34 @@ class _MarkdupKeys:
 _REALIGN_HALO = 3000 + 1024
 
 
+def _packed_chunks(chunk_iter, pass_name: str, io_threads: int,
+                   pack_reads, pad_bucket, bucket_len: int, timed_chunks,
+                   want_pack: bool = True):
+    """(table, batch) pairs for passes with a FIXED length bucket —
+    sequential (decode/pack stages timed apart) or overlapped via
+    parallel.ingest.pipelined (stall time lands in ``<pass>-ingest-wait``)."""
+    from ..instrument import stage
+
+    def work(table, _ctx):
+        if not want_pack:
+            return table, None
+        return table, pack_reads(
+            table, pad_rows_to=pad_bucket(table.num_rows),
+            bucket_len=bucket_len)
+
+    if io_threads > 1:
+        from .ingest import pipelined
+        yield from timed_chunks(pipelined(chunk_iter, work, io_threads),
+                                f"{pass_name}-ingest-wait")
+        return
+    for table in timed_chunks(chunk_iter, f"{pass_name}-decode"):
+        if not want_pack:
+            yield table, None
+            continue
+        with stage(f"{pass_name}-pack"):
+            yield work(table, None)
+
+
 def streaming_transform(input_path: str, output_path: str, *,
                         markdup: bool = False, bqsr: bool = False,
                         snp_table=None, realign: bool = False,
@@ -370,7 +405,8 @@ def streaming_transform(input_path: str, output_path: str, *,
                         page_size: Optional[int] = None,
                         use_dictionary: bool = True,
                         row_group_bytes: Optional[int] = None,
-                        resume: bool = False) -> int:
+                        resume: bool = False,
+                        io_threads: int = 1) -> int:
     """The ``transform`` pipeline over a chunked stream and a device mesh.
 
     Multi-pass, like the reference's shuffle stages (Transform.scala:62-97):
@@ -408,6 +444,14 @@ def streaming_transform(input_path: str, output_path: str, *,
 
     ``coalesce`` caps the number of output part files (Transform.scala's
     -coalesce repartition, :51-70).
+
+    ``io_threads > 1`` overlaps host ingest with device dispatch in every
+    pass (one reader thread decoding in order + a pool packing chunks,
+    results consumed in input order — parallel.ingest.pipelined; the
+    reference's Bam2Adam.scala:56-97 reader/writer pool).  Output is
+    bit-identical to the sequential walk (differential-tested); only the
+    stage report changes shape (decode+pack collapse into
+    ``pN-ingest-wait``, the consumer's stall time).
     """
     from ..bqsr.recalibrate import apply_table, compute_table
     from ..bqsr.table import RecalTable
@@ -502,7 +546,50 @@ def streaming_transform(input_path: str, output_path: str, *,
             total_rows = 0
             max_rgid = -1
             bucket_len = 0
-        for table in timed_chunks(stream, "p1-decode"):
+        import pyarrow.compute as pc
+
+        def grow_bucket(table):
+            # grow the length bucket BEFORE packing — a later chunk may
+            # hold a longer read than anything seen so far.  Runs in
+            # strict chunk order (main thread, or the pipelined reader's
+            # prepare hook), so chunk i's pack sees max(len) over <= i
+            # exactly like the sequential walk.
+            nonlocal bucket_len
+            chunk_max = pc.max(pc.binary_length(
+                table.column("sequence"))).as_py() or 1
+            bucket_len = max(bucket_len,
+                             ((chunk_max + 127) // 128) * 128)
+            return bucket_len
+
+        def p1_pack(table, blen):
+            if keys is None:
+                return table, None
+            return table, pack_reads(
+                table, pad_rows_to=pad_bucket(table.num_rows),
+                bucket_len=blen)
+
+        track_len = keys is not None or bqsr
+        if io_threads > 1 and not p1_skipped:
+            # no pack / no length tracking still overlaps: the reader
+            # thread performs the format decode (fn degrades to pack-less
+            # passthrough, prepare to a no-op)
+            from .ingest import pipelined
+            p1_iter = timed_chunks(
+                pipelined(stream, p1_pack, io_threads,
+                          prepare=grow_bucket if track_len else None),
+                "p1-ingest-wait")
+        else:
+            def p1_sync():
+                for table in timed_chunks(stream, "p1-decode"):
+                    batch = None
+                    if track_len:
+                        grow_bucket(table)
+                    if keys is not None:
+                        with stage("p1-pack"):
+                            _, batch = p1_pack(table, bucket_len)
+                    yield table, batch
+            p1_iter = p1_sync()
+        for table, batch in p1_iter:
             total_rows += table.num_rows
             max_rgid = max(max_rgid,
                            int(column_int64(table, "recordGroupId")
@@ -511,21 +598,9 @@ def streaming_transform(input_path: str, output_path: str, *,
             if raw_writer is not None:
                 with stage("p1-spill"):
                     raw_writer.write(table)
-            if keys is not None or bqsr:
-                # grow the length bucket BEFORE packing — a later chunk may
-                # hold a longer read than anything seen so far
-                import pyarrow.compute as pc
-                chunk_max = pc.max(pc.binary_length(
-                    table.column("sequence"))).as_py() or 1
-                bucket_len = max(bucket_len,
-                                 ((chunk_max + 127) // 128) * 128)
-                with stage("p1-pack"):
-                    batch = pack_reads(
-                        table, pad_rows_to=pad_bucket(table.num_rows),
-                        bucket_len=bucket_len)
-                if keys is not None:
-                    with stage("p1-markdup-keys", sync=True):
-                        keys.add_chunk(table, batch)
+            if keys is not None:
+                with stage("p1-markdup-keys", sync=True):
+                    keys.add_chunk(table, batch)
         if raw_writer is not None:
             raw_writer.close()
         if not p1_skipped:
@@ -584,11 +659,9 @@ def streaming_transform(input_path: str, output_path: str, *,
             host_acc = None
             acc = None
             n_counted = 0
-            for table in timed_chunks(reread(), "p2-decode"):
-                with stage("p2-pack"):
-                    batch = pack_reads(
-                        table, pad_rows_to=pad_bucket(table.num_rows),
-                        bucket_len=bucket_len)
+            for table, batch in _packed_chunks(
+                    reread(), "p2", io_threads, pack_reads, pad_bucket,
+                    bucket_len, timed_chunks):
                 will_sync = (n_counted + 1) % sync_every == 0
                 with stage("p2-bqsr-count", sync=will_sync):
                     out = count_tables_device(table, batch, snp_table,
@@ -664,13 +737,11 @@ def streaming_transform(input_path: str, output_path: str, *,
                     os.unlink(os.path.join(output_path, f))
         out = DatasetWriter(output_path, part_rows=out_part_rows,
                             row_group_bytes=row_group_bytes, **wopts)
-        for table in timed_chunks([] if p3_skipped else reread(),
-                                  "p3-decode"):
+        for table, batch in _packed_chunks(
+                [] if p3_skipped else reread(), "p3", io_threads,
+                pack_reads, pad_bucket, bucket_len, timed_chunks,
+                want_pack=bqsr):
             if bqsr:
-                with stage("p3-pack"):
-                    batch = pack_reads(
-                        table, pad_rows_to=pad_bucket(table.num_rows),
-                        bucket_len=bucket_len)
                 with stage("p3-bqsr-apply", sync=True):
                     table = apply_table(rt, table, batch, mesh=mesh)
             if not binned:

@@ -314,9 +314,25 @@ def _stage_flagstat(kind: str):
             pallas_resident = (n_blk3 * BLOCK) / pper
         except Exception as e:  # noqa: BLE001 — report, don't die
             state["pallas_error"] = f"{type(e).__name__}: {e}"[:200]
+        try:
+            from adam_tpu.ops.flagstat_pallas import (V2_BLOCK, V2_ROWS,
+                                                      _flagstat_blocked_v2)
+            n_blk4 = len(wire) // V2_BLOCK
+            w4 = jax.device_put(
+                wire[:n_blk4 * V2_BLOCK].reshape(n_blk4, V2_ROWS, LANES))
+            tail4 = jax.device_put(wire[:0])
+            vstate: dict = {}
+
+            def vstep():
+                vstate["out"] = _flagstat_blocked_v2(w4, tail4)
+
+            vper, _vk = _chain_rate(vstep, lambda: vstate["out"], rtt)
+            state["pallas_v2"] = (n_blk4 * V2_BLOCK) / vper
+        except Exception as e:  # noqa: BLE001
+            state["pallas_v2_error"] = f"{type(e).__name__}: {e}"[:200]
 
     peak_fl, peak_bw, peak_ref = _peaks_for(kind)
-    best = max(resident, pallas_resident or 0)
+    best = max(resident, pallas_resident or 0, state.get("pallas_v2", 0))
     import jax as _jax
     payload = {
         "backend": _jax.default_backend(),
@@ -343,6 +359,14 @@ def _stage_flagstat(kind: str):
         payload["pallas_device_reads_per_sec"] = round(pallas_resident)
     if "pallas_error" in state:
         payload["pallas_error"] = state["pallas_error"]
+    if "pallas_v2" in state:
+        payload["pallas_v2_device_reads_per_sec"] = round(state["pallas_v2"])
+        payload["pallas_v2_gbytes_per_sec"] = round(
+            state["pallas_v2"] * FLAGSTAT_BYTES_PER_READ / 1e9, 2)
+        payload["pallas_v2_pct_peak_hbm"] = round(
+            100 * state["pallas_v2"] * FLAGSTAT_BYTES_PER_READ / peak_bw, 2)
+    if "pallas_v2_error" in state:
+        payload["pallas_v2_error"] = state["pallas_v2_error"]
     _emit("flagstat", payload)
 
 

@@ -178,3 +178,59 @@ def test_jenkins_smoke_pipeline(resources, tmp_path, capsys):
     assert "wrote 7 reads" in out          # bam2adam + transform
     assert "707 pileups" in out            # reads2ref coverage line
     assert "7 + 0 in total" in out         # flagstat header counter
+
+
+def test_fasta2adam_stream_matches_inmemory(resources, tmp_path, capsys):
+    """-stream (per-contig DatasetWriter path) must produce the same rows
+    as the in-memory path, including -reads contig-id remapping."""
+    import pyarrow.parquet as pq
+
+    run(["fasta2adam", resources / "artificial.fa", tmp_path / "mem.adam"])
+    run(["fasta2adam", resources / "artificial.fa", tmp_path / "st.adam",
+         "-stream"])
+    capsys.readouterr()
+    a = pq.read_table(tmp_path / "mem.adam")
+    b = pq.read_table(tmp_path / "st.adam")
+    assert a.sort_by("contigName").equals(b.sort_by("contigName"))
+
+
+def test_fasta_stream_bounded_rss(tmp_path):
+    """A multi-contig FASTA an order larger than the batch bound converts
+    with peak host RSS far below file size (VERDICT r3 #6).  The bound is
+    a gross tripwire, not an exact pin: contig batches flush at
+    batch_bytes, so holding the whole 64 MB file would trip it."""
+    import resource
+
+    import numpy as np
+
+    from adam_tpu.io.fasta import contig_batches, iter_fasta
+
+    fa = tmp_path / "big.fa"
+    rng = np.random.RandomState(0)
+    n_contigs, clen = 16, 4 << 20            # 64 MB of sequence
+    with open(fa, "w") as f:
+        for i in range(n_contigs):
+            f.write(f">ctg{i} synthetic\n")
+            seq = np.frombuffer(b"ACGT", np.uint8)[
+                rng.randint(0, 4, clen)].tobytes().decode()
+            for s in range(0, clen, 70):
+                f.write(seq[s:s + 70] + "\n")
+    total = 0
+    n_seen = 0
+    growth_at_batch3 = None
+    rss0 = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+    for i, t in enumerate(contig_batches(str(fa), batch_bytes=8 << 20)):
+        total += sum(t.column("sequenceLength").to_pylist())
+        n_seen += t.num_rows
+        if i == 2:      # steady state: parse transients + 2 live batches
+            growth_at_batch3 = \
+                resource.getrusage(resource.RUSAGE_SELF).ru_maxrss - rss0
+    assert n_seen == n_contigs and total == n_contigs * clen
+    names = [n for n, _, _ in iter_fasta(str(fa))]
+    assert names == [f"ctg{i}" for i in range(n_contigs)]
+    growth_end = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss - rss0
+    # boundedness = the PLATEAU: after steady state (batch 3 of 8), five
+    # more 8 MB batches plus a full re-parse must add almost nothing; an
+    # accumulate-everything implementation adds ~8 MB per batch
+    assert growth_end - growth_at_batch3 < 16_000, \
+        (growth_at_batch3, growth_end)

@@ -247,3 +247,29 @@ def test_sharded_pallas_with_real_blocks_matches_core():
         wire))
     want = np.asarray(flagstat_kernel_wire32(wire))
     assert np.array_equal(got, want)
+
+
+def test_pallas_v2_matches_einsum_core(monkeypatch):
+    """The v2 deferred-reduction wire sweep (and its env-selected product
+    path) must match the XLA einsum core bit for bit, block + ragged
+    tail."""
+    import numpy as np
+
+    from adam_tpu.ops import flagstat_pallas as FP
+    from adam_tpu.ops.flagstat import (flagstat_kernel_wire32,
+                                       pack_flagstat_wire32)
+
+    rng = np.random.RandomState(7)
+    n = FP.V2_BLOCK + 333
+    wire = pack_flagstat_wire32(
+        rng.randint(0, 1 << 11, n).astype(np.uint16),
+        rng.randint(0, 61, n).astype(np.uint8),
+        rng.randint(0, 24, n).astype(np.int16),
+        rng.randint(0, 24, n).astype(np.int16),
+        rng.rand(n) < 0.97)
+    ref = np.asarray(flagstat_kernel_wire32(np.asarray(wire)))
+    got = np.asarray(FP.flagstat_pallas_wire32_v2(wire, interpret=True))
+    assert np.array_equal(ref, got)
+    monkeypatch.setenv(FP._VARIANT_ENV, "v2")
+    via_env = np.asarray(FP.flagstat_pallas_wire32(wire, interpret=True))
+    assert np.array_equal(ref, via_env)

@@ -108,7 +108,7 @@ def _capture_e2e(repo: str) -> None:
             pass
     print("running bench_e2e against the chip", flush=True)
     try:
-        subprocess.run(
+        rc = subprocess.run(
             [sys.executable, os.path.join(repo, "bench_e2e.py"),
              "--reads", os.environ.get("ADAM_TPU_E2E_TPU_READS", "500000"),
              "--out", out_path],
@@ -116,6 +116,11 @@ def _capture_e2e(repo: str) -> None:
     except subprocess.TimeoutExpired:
         print("e2e bench timed out", flush=True)
         return
+    if rc.returncode != 0:
+        tail = (rc.stderr or rc.stdout or "").strip().splitlines()[-8:]
+        print(f"e2e bench rc={rc.returncode}:", flush=True)
+        for line in tail:
+            print(f"  {line}", flush=True)
     if os.path.exists(out_path):
         try:
             with open(out_path) as f:
