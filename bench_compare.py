@@ -1,0 +1,148 @@
+"""Concordance at scale (VERDICT r3 #5): streaming compare + findreads
+over a >= 10 M-read synthetic pair, recording reads/s and peak host RSS.
+
+The workload the reference built its ComparisonTraversalEngine for
+(ComparisonTraversalEngine.scala:40-88: hash-partitioned name join over
+two pipeline runs) — here the name-hash bucket spill + columnar bucket
+joins of ``compare.engine.streaming_compare``.
+
+Both sides synthesize directly as chunked Parquet datasets (bounded
+memory; no BAM detour).  Side 2 perturbs ~1% of positions, ~2% of mapqs
+and drops ~0.5% of reads, so every comparison has real work and
+findreads returns a non-trivial set.
+
+Usage::
+
+    python bench_compare.py [--reads 10000000] [--out COMPARE_BENCH.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import resource
+import shutil
+import tempfile
+import time
+
+
+def synth_pair(base: str, n_reads: int, chunk: int = 1 << 20,
+               seed: int = 0) -> dict:
+    import numpy as np
+    import pyarrow as pa
+
+    from adam_tpu import schema as S
+    from adam_tpu.io.parquet import DatasetWriter
+
+    rng = np.random.RandomState(seed)
+    L = 36
+    n_contigs = 24
+    t0 = time.perf_counter()
+    paths = [os.path.join(base, "side1"), os.path.join(base, "side2")]
+    writers = [DatasetWriter(p, part_rows=chunk, compression="zstd")
+               for p in paths]
+    bases = np.frombuffer(b"ACGT", np.uint8)
+    done = 0
+    while done < n_reads:
+        n = min(chunk, n_reads - done)
+        names = np.char.add("r", np.arange(done, done + n).astype(str))
+        refid = rng.randint(0, n_contigs, n).astype(np.int32)
+        start = rng.randint(0, 10_000_000, n).astype(np.int64)
+        mapq = rng.randint(0, 61, n).astype(np.int32)
+        flags = np.where(rng.rand(n) < 0.5, 16, 0).astype(np.int64)
+        qual_mat = (rng.randint(25, 41, (n, L)) + 33).astype(np.uint8)
+        quals = qual_mat.view(f"S{L}").ravel().astype(str)
+
+        def col_table(refid, start, mapq, keep):
+            m = {
+                "readName": pa.array(names[keep]),
+                "referenceId": pa.array(refid[keep], pa.int32()),
+                "referenceName": pa.array(
+                    [f"chr{r + 1}" for r in refid[keep]]),
+                "start": pa.array(start[keep], pa.int64()),
+                "mapq": pa.array(mapq[keep], pa.int32()),
+                "flags": pa.array(flags[keep], pa.int64()),
+                "qual": pa.array(quals[keep]),
+            }
+            nn = int(keep.sum())
+            return pa.Table.from_pydict(
+                {f: m.get(f, pa.nulls(nn, S.READ_SCHEMA.field(f).type))
+                 for f in S.READ_SCHEMA.names}, schema=S.READ_SCHEMA)
+
+        all_rows = np.ones(n, bool)
+        writers[0].write(col_table(refid, start, mapq, all_rows))
+        start2 = np.where(rng.rand(n) < 0.01,
+                          rng.randint(0, 10_000_000, n), start)
+        mapq2 = np.where(rng.rand(n) < 0.02,
+                         rng.randint(0, 61, n), mapq).astype(np.int32)
+        keep2 = rng.rand(n) >= 0.005
+        writers[1].write(col_table(refid, start2.astype(np.int64), mapq2,
+                                   keep2))
+        done += n
+    for w in writers:
+        w.close()
+    return {"paths": paths, "synth_s": round(time.perf_counter() - t0, 1),
+            "bytes": sum(
+                os.path.getsize(os.path.join(p, f))
+                for p in paths for f in os.listdir(p))}
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--reads", type=int, default=10_000_000)
+    ap.add_argument("--buckets", type=int, default=64)
+    ap.add_argument("--chunk_rows", type=int, default=1 << 20)
+    ap.add_argument("--out", default="COMPARE_BENCH.json")
+    args = ap.parse_args()
+
+    from adam_tpu.platform import honor_platform_env
+    honor_platform_env()
+
+    from adam_tpu.compare.engine import (find_comparison, parse_filters,
+                                         streaming_compare)
+
+    base = tempfile.mkdtemp(prefix="adam_compare_bench_")
+    doc = {"n_reads_per_side": args.reads, "n_buckets": args.buckets,
+           "chunk_rows": args.chunk_rows}
+    try:
+        st = synth_pair(base, args.reads, chunk=args.chunk_rows)
+        doc["synth_s"] = st["synth_s"]
+        doc["input_bytes"] = st["bytes"]
+        rss0 = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+
+        comps = [find_comparison(n)
+                 for n in ("positions", "mapqs", "dupemismatch")]
+        t0 = time.perf_counter()
+        r = streaming_compare(
+            [st["paths"][0]], [st["paths"][1]], comps,
+            n_buckets=args.buckets, chunk_rows=args.chunk_rows)
+        doc["compare_wall_s"] = round(time.perf_counter() - t0, 1)
+        doc.update({k: int(v) for k, v in r["totals"].items()})
+        doc["positions_nonzero"] = int(
+            r["histograms"]["positions"].count_subset(lambda v: v != 0))
+        doc["compare_reads_per_sec"] = round(
+            2 * args.reads / max(doc["compare_wall_s"], 1e-9))
+
+        t0 = time.perf_counter()
+        f = streaming_compare(
+            [st["paths"][0]], [st["paths"][1]], [],
+            n_buckets=args.buckets, chunk_rows=args.chunk_rows,
+            find_filters=parse_filters("positions!=0"))
+        doc["findreads_wall_s"] = round(time.perf_counter() - t0, 1)
+        doc["findreads_hits"] = len(f["matching_names"])
+        doc["findreads_reads_per_sec"] = round(
+            2 * args.reads / max(doc["findreads_wall_s"], 1e-9))
+
+        doc["peak_rss_gb"] = round(
+            resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1e6, 2)
+        doc["rss_before_gb"] = round(rss0 / 1e6, 2)
+    finally:
+        shutil.rmtree(base, ignore_errors=True)
+    with open(args.out, "w") as fh:
+        json.dump(doc, fh, indent=1)
+    print(json.dumps(doc))
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
