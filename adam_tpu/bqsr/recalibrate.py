@@ -644,17 +644,33 @@ def apply_table(rt: RecalTable, table: pa.Table,
         ((flags_np & S.FLAG_SECONDARY) == 0) & \
         ((flags_np & S.FLAG_DUPLICATE) == 0) & np.asarray(batch.valid)
 
-    args = (jnp.asarray(batch.bases), jnp.asarray(batch.quals),
-            jnp.asarray(batch.read_len), jnp.asarray(batch.flags),
-            jnp.asarray(batch.read_group), jnp.asarray(recal_mask),
-            jnp.asarray(fin.rg_delta), jnp.asarray(fin.qual_delta),
-            jnp.asarray(fin.cycle_delta), jnp.asarray(fin.ctx_delta),
-            jnp.asarray(fin.rg_of_qualrg))
-    if mesh is not None and mesh.size > 1 and \
-            batch.n_reads % mesh.size == 0:
-        new_quals = np.asarray(_sharded_apply_fn(mesh)(*args))[:n]
+    fin_dev = (jnp.asarray(fin.rg_delta), jnp.asarray(fin.qual_delta),
+               jnp.asarray(fin.cycle_delta), jnp.asarray(fin.ctx_delta),
+               jnp.asarray(fin.rg_of_qualrg))
+
+    def slab_args(b, mask):
+        return (jnp.asarray(b.bases), jnp.asarray(b.quals),
+                jnp.asarray(b.read_len), jnp.asarray(b.flags),
+                jnp.asarray(b.read_group), jnp.asarray(mask)) + fin_dev
+
+    sharded = mesh is not None and mesh.size > 1 and \
+        batch.n_reads % mesh.size == 0
+    slab = _count_slab_rows()
+    if sharded:
+        new_quals = np.asarray(
+            _sharded_apply_fn(mesh)(*slab_args(batch, recal_mask)))[:n]
+    elif batch.n_reads > slab:
+        # same bounded-working-set walk as pass 1 (the apply gathers
+        # materialize the identical [rows, L] covariate tensors); per-row
+        # output, so slab concatenation is trivially the monolithic result
+        parts = [np.asarray(_apply_kernel(
+            *slab_args(batch.row_slice(s, min(s + slab, batch.n_reads)),
+                       recal_mask[s:s + slab])))
+            for s in range(0, batch.n_reads, slab)]
+        new_quals = np.concatenate(parts, axis=0)[:n]
     else:
-        new_quals = np.asarray(_apply_kernel(*args))[:n]
+        new_quals = np.asarray(
+            _apply_kernel(*slab_args(batch, recal_mask)))[:n]
 
     read_len = np.asarray(batch.read_len[:n], np.int64)
     old_col = table.column("qual").combine_chunks()

@@ -390,7 +390,11 @@ def _packed_chunks(chunk_iter, pass_name: str, io_threads: int,
             yield table, None
             continue
         with stage(f"{pass_name}-pack"):
-            yield work(table, None)
+            out = work(table, None)
+        # yield OUTSIDE the stage context: a yield inside would leave the
+        # pack timer running across the consumer's whole chunk body and
+        # nest its stages under pack (observed in the first e2e rerun)
+        yield out
 
 
 def streaming_transform(input_path: str, output_path: str, *,

@@ -593,6 +593,10 @@ def _stage_bqsr_race(kind: str, is_tpu: bool):
     if is_tpu:
         from adam_tpu.bqsr.count_pallas import count_kernel_pallas
         race("pallas", lambda: count_kernel_pallas(*args, **kw))
+        # int8 one-hots: 2x MXU peak on v5e IF Mosaic's int8 matmul path
+        # lowers; a rejection lands as race_pallas8_error, not a crash
+        race("pallas8", lambda: count_kernel_pallas(*args, int8_mxu=True,
+                                                    **kw))
 
     if rates:
         winner = max(rates, key=rates.get)

@@ -271,9 +271,14 @@ def test_count_slab_walk_matches_monolithic(monkeypatch):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
 
-def test_count_impl_pallas_matches_scatter():
-    """The Pallas packed-word MXU count backend must produce bit-identical
-    tables to the scatter oracle (interpret mode on the CPU test mesh)."""
+import pytest
+
+
+@pytest.mark.parametrize("int8_mxu", [False, True])
+def test_count_impl_pallas_matches_scatter(int8_mxu):
+    """The Pallas packed-word MXU count backend (bf16 and int8 one-hot
+    variants) must produce bit-identical tables to the scatter oracle
+    (interpret mode on the CPU test mesh)."""
     import numpy as np
 
     from adam_tpu.bqsr.count_pallas import count_kernel_pallas, fits
@@ -293,6 +298,36 @@ def test_count_impl_pallas_matches_scatter():
             rng.rand(n) < 0.9)
     ref = _count_kernel(*args, n_qual_rg=rt.n_qual_rg, n_cycle=rt.n_cycle)
     got = count_kernel_pallas(*args, n_qual_rg=rt.n_qual_rg,
-                              n_cycle=rt.n_cycle, interpret=True)
+                              n_cycle=rt.n_cycle, interpret=True,
+                              int8_mxu=int8_mxu)
     for a, b in zip(got, ref):
         assert np.array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_apply_slab_walk_matches_monolithic(monkeypatch):
+    """apply_table's slab walk must rebuild the same qual strings as the
+    monolithic kernel call."""
+    import numpy as np
+
+    from adam_tpu.bqsr import recalibrate as R
+
+    rows = []
+    rng = np.random.RandomState(13)
+    for i in range(70):
+        L = int(rng.randint(6, 12))
+        seq = "".join("ACGT"[c] for c in rng.randint(0, 4, L))
+        rows.append(read(sequence=seq, cigar=f"{L}M",
+                         md=f"{L//2}A{L - L//2 - 1}",
+                         start=int(rng.randint(0, 500)),
+                         quals=tuple(rng.randint(2, 41, L)), name=f"r{i}",
+                         flags=int(rng.choice([0, 16, 1024])),
+                         rg=int(rng.randint(0, 2))))
+    table = _reads_table(rows)
+    batch = pack_reads(table, pad_rows_to=64)
+    rt = R.compute_table(table, batch)
+
+    monkeypatch.setenv(R._COUNT_SLAB_ENV, str(1 << 30))
+    mono = R.apply_table(rt, table, batch)
+    monkeypatch.setenv(R._COUNT_SLAB_ENV, "16")
+    slabbed = R.apply_table(rt, table, batch)
+    assert mono.equals(slabbed)
