@@ -710,20 +710,22 @@ def _worker(stages: list[str]) -> None:
     # supplies device_kind/is_tpu to the other stages (the orchestrator
     # keeps the first probe result it saw)
     is_tpu, kind = _stage_probe()
+    # VERDICT-priority order: the round-4 evidence set is (flagstat,
+    # fused transform, count-backend race); the realign/SW pallas stage
+    # comes last — a hang in any stage costs only lower-priority ones
+    # (the orchestrator's per-stage deadlines + skip-after-2 keep
+    # already-streamed results either way)
     if "flagstat" in stages:
         _stage_flagstat(kind)
-    # pallas before transform: the transform stage carries the one residual
-    # compile-time risk (the count-matmul scan body at product n), and a
-    # hang there must not cost the pallas kernel evidence
+    if "transform" in stages:
+        _stage_transform(kind, is_tpu)
+    if "bqsr_race" in stages:
+        _stage_bqsr_race(kind, is_tpu)
     if "pallas" in stages:
         if is_tpu:
             _stage_pallas()
         else:
             _emit("pallas", {"skipped": "pallas stages need a TPU backend"})
-    if "transform" in stages:
-        _stage_transform(kind, is_tpu)
-    if "bqsr_race" in stages:
-        _stage_bqsr_race(kind, is_tpu)
 
 
 # ---------------------------------------------------------------------------
@@ -802,7 +804,7 @@ def main() -> None:
     errors: list[str] = []
     stages: dict = {}
     try:
-        want = ["probe", "flagstat", "pallas", "transform", "bqsr_race"]
+        want = ["probe", "flagstat", "transform", "bqsr_race", "pallas"]
         attempt = 0
         cpu_incidental: dict = {}
         fails: dict = {}

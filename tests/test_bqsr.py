@@ -5,6 +5,7 @@ and end-to-end recalibration behavior."""
 import numpy as np
 import jax.numpy as jnp
 import pyarrow as pa
+import pytest
 
 from adam_tpu import schema as S
 from adam_tpu.bqsr.covariates import covariate_tensors, clip_window
@@ -269,9 +270,6 @@ def test_count_slab_walk_matches_monolithic(monkeypatch):
     slabbed = R.count_tables_device(table, batch, n_read_groups=3)
     for a, b in zip(slabbed, mono):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
-
-
-import pytest
 
 
 @pytest.mark.parametrize("int8_mxu", [False, True])

@@ -220,20 +220,171 @@ def probe_flagstat_blocks():
             emit("flagstat_block", rows=rows, error=str(e)[:200])
 
 
+
+
+def probe_count_pallas():
+    """Round-4: the packed-word Pallas count kernel on chip — bf16 vs
+    int8 one-hots, and the BLOCK_ELEMS sweep (DMA/grid amortization vs
+    VMEM pressure).  This is the kernel the bqsr_race stage times at one
+    shape; here we learn which shape to ship."""
+    import jax
+
+    from adam_tpu.bqsr import count_pallas as CP
+    from adam_tpu.bqsr.table import RecalTable
+
+    L, n_rg = 100, 4
+    n = 500_000
+    rt = RecalTable(n_read_groups=n_rg, max_read_len=L)
+    args = _count_args(n, L, n_rg)
+    word3, wbits3 = CP._pack_words(*args, n_qual_rg=rt.n_qual_rg,
+                                   n_cycle=rt.n_cycle)
+    q_rows = CP._round_up(rt.n_qual_rg, 8)
+    cyc_bins = CP._round_up(rt.n_cycle, 128)
+    n_elems = word3.size
+    flat_w = word3.reshape(-1)
+    flat_b = wbits3.reshape(-1)
+    for block in (1024, 2048, 4096, 8192):
+        nb = n_elems // block
+        w3 = jax.device_put(flat_w[:nb * block].reshape(nb, 1, block))
+        b3 = jax.device_put(flat_b[:nb * block].reshape(nb, 1, block))
+        for int8 in (False, True):
+            try:
+                saved = CP.BLOCK_ELEMS
+                CP.BLOCK_ELEMS = block
+                t0 = t()
+                out = CP._count_call(w3, b3, q_rows=q_rows,
+                                     cyc_bins=cyc_bins, interpret=False,
+                                     int8_mxu=int8)
+                jax.device_get(out[0])
+                compile_s = t() - t0
+                k = 16
+                t0 = t()
+                for _ in range(k):
+                    out = CP._count_call(w3, b3, q_rows=q_rows,
+                                         cyc_bins=cyc_bins,
+                                         interpret=False, int8_mxu=int8)
+                jax.device_get(out[0][0, 0])
+                per = (t() - t0) / k
+                emit("count_pallas", block=block, int8=int8,
+                     compile_s=round(compile_s, 1),
+                     reads_per_sec=round(nb * block / L / per),
+                     gelems_per_sec=round(nb * block / per / 1e9, 3))
+            except Exception as e:  # noqa: BLE001
+                emit("count_pallas", block=block, int8=int8,
+                     error=str(e)[:200])
+            finally:
+                CP.BLOCK_ELEMS = saved
+
+
+def probe_flagstat_v2():
+    """Round-4: v1 vs v2 flagstat kernel, plus an attribution pair — a
+    mask-only v2 (sums skipped) and a sum-only v2 (masks constant) — so
+    the measurement says WHAT binds the sweep (VERDICT r3 #3: ">=25% of
+    peak HBM or prove what binds")."""
+    import jax
+
+    from adam_tpu.ops.flagstat import pack_flagstat_wire32
+    from adam_tpu.ops import flagstat_pallas as FP
+
+    rng = np.random.RandomState(0)
+    n = 1 << 24
+    wire = pack_flagstat_wire32(
+        rng.randint(0, 1 << 12, size=n).astype(np.uint16),
+        rng.randint(0, 61, size=n).astype(np.uint8),
+        rng.randint(0, 24, size=n).astype(np.int16),
+        rng.randint(0, 24, size=n).astype(np.int16),
+        np.ones(n, bool))
+
+    def run(label, call, rows):
+        B = rows * FP.LANES
+        w3 = jax.device_put(wire[:(n // B) * B].reshape(-1, rows,
+                                                        FP.LANES))
+        try:
+            f = jax.jit(lambda a: call(a, interpret=False))
+            t0 = t()
+            jax.device_get(f(w3))
+            compile_s = t() - t0
+            k = 32
+            t0 = t()
+            for _ in range(k):
+                out = f(w3)
+            jax.device_get(out)
+            per = (t() - t0) / k
+            emit("flagstat_v2", variant=label,
+                 compile_s=round(compile_s, 1),
+                 greads_per_sec=round((n // B) * B / per / 1e9, 2),
+                 gbytes_per_sec=round((n // B) * B * 4 / per / 1e9, 1))
+        except Exception as e:  # noqa: BLE001
+            emit("flagstat_v2", variant=label, error=str(e)[:200])
+
+    run("v1", FP._blocked_call, FP.BLOCK_ROWS)
+    run("v2", FP._blocked_call_v2, FP.V2_ROWS)
+
+    # attribution variants: same grid/DMA, reduced in-kernel work
+    from jax.experimental import pallas as pl
+    import jax.numpy as jnp
+
+    def make_stub(body):
+        def kern(wire_ref, acc_ref):
+            i = pl.program_id(0)
+
+            @pl.when(i == 0)
+            def _init():
+                acc_ref[...] = jnp.zeros_like(acc_ref)
+            body(wire_ref, acc_ref)
+
+        def call(wire3d, *, interpret):
+            from jax.experimental.pallas import tpu as pltpu
+            n_blk, rows, lanes = wire3d.shape
+            return pl.pallas_call(
+                kern, grid=(n_blk,),
+                in_specs=[pl.BlockSpec((None, rows, lanes),
+                                       lambda i: (i, 0, 0))],
+                out_specs=pl.BlockSpec((36, FP.LANES), lambda i: (0, 0)),
+                out_shape=jax.ShapeDtypeStruct((36, FP.LANES), jnp.int32),
+                compiler_params=pltpu.CompilerParams(
+                    dimension_semantics=("arbitrary",)),
+                interpret=interpret)(wire3d)
+        return call
+
+    def dma_only(wire_ref, acc_ref):
+        # touch the block once: one select+sum, no indicator masks
+        acc_ref[0, :] += jnp.sum(wire_ref[...].astype(jnp.int32) & 1,
+                                 axis=0)
+
+    def masks_only(wire_ref, acc_ref):
+        # all 18 indicators + pf pack, but a single lane-sum at the end
+        inds, passed, failed = FP._wire_masks(wire_ref[...])
+        pf = passed.astype(jnp.int32) + (failed.astype(jnp.int32) << 16)
+        total = pf
+        for ind in inds:
+            total = total ^ jnp.where(ind, pf, 0)   # mask cost, no sums
+        acc_ref[0, :] += jnp.sum(total, axis=0)
+
+    run("dma_only", make_stub(dma_only), FP.V2_ROWS)
+    run("masks_only", make_stub(masks_only), FP.V2_ROWS)
+
+
 PROBES = {
     "1": ("scan_knee", probe_scan_knee),
     "2": ("count_backends", probe_backends),
     "3": ("apply", probe_apply),
     "4": ("pallas", probe_pallas_kernels),
     "5": ("flagstat_blocks", probe_flagstat_blocks),
+    "6": ("count_pallas", probe_count_pallas),
+    "7": ("flagstat_v2", probe_flagstat_v2),
 }
 
 
 def main():
     ap = argparse.ArgumentParser()
-    ap.add_argument("--only", default="4,5,2,3,1",
+    ap.add_argument("--only", default="7,6,4,5,2,3,1",
                     help="comma-separated probe ids, run order")
     args = ap.parse_args()
+    from adam_tpu.platform import honor_platform_env
+    honor_platform_env()      # the axon plugin ignores bare JAX_PLATFORMS;
+    #                           without this a CPU debug run hangs on the
+    #                           (possibly dead) tunnel instead
     import jax
     d = jax.devices()[0]
     emit("env", device_kind=getattr(d, "device_kind", "?"),

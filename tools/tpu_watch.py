@@ -88,9 +88,67 @@ def main() -> int:
               f"flagstat={doc.get('value')}", flush=True)
         if got_tpu:
             _capture_e2e(repo)
+            _capture_probes(repo)
             if args.once:
                 return 0
         time.sleep(args.interval)
+
+
+_PROBE_IDS = ("7", "6", "4", "5", "2", "3", "1")
+
+
+def _probe_output_complete(text: str) -> bool:
+    """TPU-platform env line + a *_done line for every probe."""
+    lines = []
+    for ln in text.splitlines():
+        try:
+            if ln.strip():
+                lines.append(json.loads(ln))
+        except ValueError:
+            continue
+    envs = [d for d in lines if d.get("probe") == "env"]
+    if not envs or "tpu" not in (envs[0].get("device_kind", "") +
+                                 envs[0].get("platform", "")).lower():
+        return False
+    done = {d["probe"] for d in lines
+            if d.get("probe", "").endswith("_done")}
+    return len(done) >= len(_PROBE_IDS)
+
+
+def _capture_probes(repo: str) -> None:
+    """One-shot probe suite (block sweeps, kernel attribution) after the
+    bench + e2e artifacts are safe — the lowest-priority use of a tunnel
+    window, but the one that decides which kernel variants ship.  Retries
+    in later windows until a COMPLETE on-TPU run exists: a CPU-fallback
+    or partial (timed-out) capture is kept for inspection but does not
+    satisfy the guard."""
+    out_path = os.path.join(repo, "PROBES_TPU.jsonl")
+    if os.path.exists(out_path):
+        try:
+            with open(out_path) as f:
+                if _probe_output_complete(f.read()):
+                    return
+        except ValueError:
+            pass
+    print("running probe suite", flush=True)
+    out = ""
+    try:
+        rc = subprocess.run(
+            [sys.executable, os.path.join(repo, "tools",
+                                          "tpu_probe_suite.py")],
+            timeout=1200, capture_output=True, text=True, cwd=repo)
+        out = rc.stdout
+    except subprocess.TimeoutExpired as e:
+        # keep whatever probes streamed before the deadline (a later
+        # window re-runs the whole suite; probes are idempotent)
+        out = (e.stdout or b"").decode(errors="replace") \
+            if isinstance(e.stdout, bytes) else (e.stdout or "")
+        print("probe suite timed out; partial output kept", flush=True)
+    if out.strip():
+        with open(out_path, "w") as f:
+            f.write(out)
+    print(f"probe capture: complete={_probe_output_complete(out)} "
+          f"({len(out.splitlines())} lines)", flush=True)
 
 
 def _capture_e2e(repo: str) -> None:
