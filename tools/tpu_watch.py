@@ -87,11 +87,35 @@ def main() -> int:
         print(f"captured platform={doc.get('platform')} "
               f"flagstat={doc.get('value')}", flush=True)
         if got_tpu:
+            _commit_evidence(repo, [args.out])
             _capture_e2e(repo)
             _capture_probes(repo)
+            _commit_evidence(repo, [args.out, "E2E_BENCH_TPU.json",
+                                    "PROBES_TPU.jsonl"])
             if args.once:
                 return 0
         time.sleep(args.interval)
+
+
+def _commit_evidence(repo: str, names) -> None:
+    """Commit captured TPU artifacts the moment they exist — a tunnel
+    window can open and close while nobody is watching, and an
+    uncommitted artifact is one `rm`/crash away from being round-3's
+    story again.  Stages ONLY the named files."""
+    present = [n for n in names if os.path.exists(os.path.join(repo, n))]
+    if not present:
+        return
+    try:
+        subprocess.run(["git", "add", "--"] + present, cwd=repo,
+                       check=True, capture_output=True, timeout=30)
+        rc = subprocess.run(
+            ["git", "commit", "-m",
+             "Record TPU evidence artifacts captured by tpu_watch"],
+            cwd=repo, capture_output=True, text=True, timeout=30)
+        if rc.returncode == 0:
+            print(f"committed evidence: {', '.join(present)}", flush=True)
+    except Exception as e:  # noqa: BLE001 — capture keeps priority
+        print(f"evidence commit failed: {e}", flush=True)
 
 
 _PROBE_IDS = ("7", "6", "4", "5", "2", "3", "1")
