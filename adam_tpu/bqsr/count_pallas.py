@@ -13,7 +13,7 @@ round trips) against ~8 B of actual information.  This kernel:
   * packs the four covariate indices of a base into ONE int32 word in an
     XLA prologue (k:10 | cycle:10 | context:5 | qual:7 bits — ranges are
     asserted by :func:`fits`; quals arrive as int8 so 7 bits are exact),
-    plus a 3-bit weight word: 8 B/base of HBM traffic total;
+    plus a 3-bit int8 weight byte: 5 B/base of HBM traffic total;
   * unpacks in VMEM, builds the one-hot indicator tiles in vector
     registers, and contracts them on the MXU with NT-form ``dot_general``
     (contraction over the lane axis — the attention-QK^T shape);
@@ -78,8 +78,8 @@ def _pack_words(bases, quals, read_len, flags, read_group, state, usable,
 
     word = (k | (cyc << _K_BITS) | (cov["context"] << (_K_BITS + _CYC_BITS))
             | (q << (_K_BITS + _CYC_BITS + _CTX_BITS)))
-    wbits = (counted.astype(jnp.int32) | (mm.astype(jnp.int32) << 1)
-             | (windowed.astype(jnp.int32) << 2))
+    wbits = (counted.astype(jnp.int8) | (mm.astype(jnp.int8) << 1)
+             | (windowed.astype(jnp.int8) << 2))
 
     n_elems = word.size
     n_blocks = max(-(-n_elems // BLOCK_ELEMS), 1)
@@ -103,7 +103,7 @@ def _kernel(word_ref, wbits_ref, obs_ref, mm_ref, qh_ref, *,
         qh_ref[...] = jnp.zeros_like(qh_ref)
 
     word = word_ref[...]                    # [1, X] int32 rows
-    wbits = wbits_ref[...]
+    wbits = wbits_ref[...].astype(jnp.int32)     # int8 on the wire
     k = word & ((1 << _K_BITS) - 1)
     cyc = (word >> _K_BITS) & ((1 << _CYC_BITS) - 1)
     ctx = (word >> (_K_BITS + _CYC_BITS)) & ((1 << _CTX_BITS) - 1)

@@ -562,6 +562,8 @@ def _stage_bqsr_race(kind: str, is_tpu: bool):
                      "race_backend": jax.default_backend()}
     rates: dict = {}
 
+    outputs: dict = {}
+
     def race(name, make_step, k_probe=2, k_max=64):
         try:
             st: dict = {}
@@ -572,6 +574,8 @@ def _stage_bqsr_race(kind: str, is_tpu: bool):
             per, k_used = _chain_rate(step, lambda: st["out"][0], rtt,
                                       k_probe=k_probe, k_max=k_max)
             rates[name] = n / per
+            outputs[name] = st["out"]   # same args every pass => the
+            #                             last pass's tables ARE the value
             payload[f"race_{name}_reads_per_sec"] = round(n / per)
             payload[f"race_{name}_chain_len"] = k_used
         except Exception as e:  # noqa: BLE001 — record, race the rest
@@ -597,6 +601,24 @@ def _stage_bqsr_race(kind: str, is_tpu: bool):
         # lowers; a rejection lands as race_pallas8_error, not a crash
         race("pallas8", lambda: count_kernel_pallas(*args, int8_mxu=True,
                                                     **kw))
+        # on-chip VALUE cross-check vs the scatter oracle: interpret-mode
+        # equality is already test-pinned, but the compiled Mosaic kernel
+        # must match on real hardware before the product default can flip.
+        # Compares the race's OWN stashed outputs (device_get of tiny
+        # tables) — no kernel re-runs in the scarce tunnel window.
+        try:
+            if "scatter" in outputs:
+                ref = [np.asarray(o) for o in outputs["scatter"]]
+                for name in ("pallas", "pallas8"):
+                    if name not in outputs:
+                        continue
+                    got = [np.asarray(o) for o in outputs[name]]
+                    payload[f"race_{name}_matches_scatter"] = bool(
+                        all(np.array_equal(a, b)
+                            for a, b in zip(got, ref)))
+        except Exception as e:  # noqa: BLE001
+            payload["race_crosscheck_error"] = \
+                f"{type(e).__name__}: {e}"[:160]
 
     if rates:
         winner = max(rates, key=rates.get)
@@ -604,20 +626,20 @@ def _stage_bqsr_race(kind: str, is_tpu: bool):
         peak_fl, peak_bw, peak_ref = _peaks_for(kind)
         payload["race_winner"] = winner
         payload["race_winner_reads_per_sec"] = round(best)
-        # roofline bases: the pallas wire model moves 8 B/base (packed
-        # index+weight words) + ~3 B/base prologue reads; its MXU cost is
-        # the two one-hot NT dots over the kernel's actual padded dims
+        # roofline bases: the pallas wire model moves 5 B/base (int32
+        # index word + int8 weight byte) + ~3 B/base prologue reads; its
+        # MXU cost is the two one-hot NT dots over the padded dims
         from adam_tpu.bqsr.count_pallas import CTX_COLS, _round_up
         q_pad = _round_up(rt.n_qual_rg, 8)
         cat_cols = _round_up(rt.n_cycle, 128) + CTX_COLS
         flops_per_read = 2 * 2 * q_pad * cat_cols * L
-        payload["race_bytes_per_read_wire"] = 11.0 * L
+        payload["race_bytes_per_read_wire"] = 8.0 * L
         payload["race_peak_ref"] = peak_ref
         if "pallas" in rates:
             payload["race_pallas_gbytes_per_sec"] = round(
-                rates["pallas"] * 11.0 * L / 1e9, 2)
+                rates["pallas"] * 8.0 * L / 1e9, 2)
             payload["race_pallas_pct_peak_hbm"] = round(
-                100 * rates["pallas"] * 11.0 * L / peak_bw, 2)
+                100 * rates["pallas"] * 8.0 * L / peak_bw, 2)
             payload["race_pallas_mxu_flops_per_read"] = flops_per_read
             payload["race_pallas_mfu_pct"] = round(
                 100 * rates["pallas"] * flops_per_read / peak_fl, 2)

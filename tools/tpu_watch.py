@@ -106,14 +106,21 @@ def _commit_evidence(repo: str, names) -> None:
     if not present:
         return
     try:
-        subprocess.run(["git", "add", "--"] + present, cwd=repo,
-                       check=True, capture_output=True, timeout=30)
+        # pathspec'd commit: ONLY the named files land in it, regardless
+        # of whatever else a concurrent session may have staged
         rc = subprocess.run(
             ["git", "commit", "-m",
-             "Record TPU evidence artifacts captured by tpu_watch"],
+             "Record TPU evidence artifacts captured by tpu_watch",
+             "--"] + present,
             cwd=repo, capture_output=True, text=True, timeout=30)
         if rc.returncode == 0:
             print(f"committed evidence: {', '.join(present)}", flush=True)
+        elif "nothing to commit" in (rc.stdout + rc.stderr) or \
+                "no changes added" in (rc.stdout + rc.stderr):
+            pass                      # already committed last window
+        else:
+            print(f"evidence commit rc={rc.returncode}: "
+                  f"{(rc.stderr or rc.stdout).strip()[:300]}", flush=True)
     except Exception as e:  # noqa: BLE001 — capture keeps priority
         print(f"evidence commit failed: {e}", flush=True)
 
