@@ -41,7 +41,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
 from ..packing import _round_up
-from .covariates import N_CONTEXT, covariate_tensors
+from .covariates import (MAX_REASONABLE_QSCORE, N_CONTEXT,
+                         covariate_tensors)
 from .recalibrate import STATE_MASKED, STATE_MISMATCH
 
 #: elements (bases) swept per grid step; lane-aligned
@@ -202,3 +203,177 @@ def _unpack_tables(obs, mm, qh, n_qual_rg: int, n_cycle: int,
     return (jnp.sum(cycle_obs, axis=1), jnp.sum(cycle_mm, axis=1),
             cycle_obs.reshape(-1), cycle_mm.reshape(-1),
             ctx_obs.reshape(-1), ctx_mm.reshape(-1), qh[0])
+
+
+# ---------------------------------------------------------------------------
+# v3: per-read-row kernel, covariates computed IN KERNEL (~2 B/base wire)
+# ---------------------------------------------------------------------------
+
+#: reads per grid step for the rows kernel; each read occupies
+#: ``lane_tiles`` 128-lane slices (bucket_len is always a multiple of 128)
+ROWS_BLOCK = 32
+
+_SW_RG_BITS, _SW_LEN_BITS = 8, 9
+
+
+@functools.partial(jax.jit, static_argnames=())
+def _pack_rows_jit(bases, quals, read_len, flags, read_group, state,
+                   usable):
+    """Covariates (context needs the real bases) -> (cb [N, L] int8,
+    sw [N, 1] int32), padded rows handled by the caller."""
+    cov = covariate_tensors(bases, quals, read_len, flags, read_group)
+    counted = cov["in_window"] & usable[:, None] & (state != STATE_MASKED)
+    mm = (state == STATE_MISMATCH) & counted
+    windowed = cov["in_window"] & usable[:, None]
+    cb = (cov["context"].astype(jnp.int32)
+          | (counted.astype(jnp.int32) << 5)
+          | (mm.astype(jnp.int32) << 6)
+          | (windowed.astype(jnp.int32) << 7)).astype(jnp.int8)
+    from .. import schema as S  # noqa: local import avoids module cycle
+    rev = ((flags & S.FLAG_REVERSE) != 0).astype(jnp.int32)
+    sec = (((flags & S.FLAG_PAIRED) != 0) &
+           ((flags & S.FLAG_SECOND_OF_PAIR) != 0)).astype(jnp.int32)
+    rg = jnp.clip(jnp.maximum(read_group, 0), 0,
+                  (1 << _SW_RG_BITS) - 1)
+    ln = jnp.clip(read_len, 0, (1 << _SW_LEN_BITS) - 1)
+    sw = (rg | (rev << _SW_RG_BITS) | (sec << (_SW_RG_BITS + 1))
+          | (ln << (_SW_RG_BITS + 2)))[:, None]
+    return cb, sw
+
+
+def _rows_kernel(q_ref, cb_ref, sw_ref, obs_ref, mm_ref, qh_ref, *,
+                 q_rows: int, cyc_bins: int, n_qual_rg: int,
+                 n_cycle: int, max_read_len: int, lane_tiles: int,
+                 int8_mxu: bool):
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _init():
+        obs_ref[...] = jnp.zeros_like(obs_ref)
+        mm_ref[...] = jnp.zeros_like(mm_ref)
+        qh_ref[...] = jnp.zeros_like(qh_ref)
+
+    oh_t = jnp.int8 if int8_mxu else jnp.bfloat16
+    acc_t = jnp.int32 if int8_mxu else jnp.float32
+    nt = (((1,), (1,)), ((), ()))
+    iota_q = jax.lax.broadcasted_iota(jnp.int32, (q_rows, 128), 0)
+    cat = jax.lax.broadcasted_iota(jnp.int32,
+                                   (cyc_bins + CTX_COLS, 128), 0)
+    iota_256 = jax.lax.broadcasted_iota(jnp.int32, (256, 128), 0)
+    lane = jax.lax.broadcasted_iota(jnp.int32, (1, 128), 1)
+
+    obs_acc = jnp.zeros((q_rows, cyc_bins + CTX_COLS), acc_t)
+    mm_acc = jnp.zeros((q_rows, cyc_bins + CTX_COLS), acc_t)
+    qh_acc = jnp.zeros((1, 256), acc_t)
+    for r in range(q_ref.shape[0]):
+        s = sw_ref[r, 0]
+        rg = s & ((1 << _SW_RG_BITS) - 1)
+        rev = (s >> _SW_RG_BITS) & 1
+        sec = (s >> (_SW_RG_BITS + 1)) & 1
+        rlen = (s >> (_SW_RG_BITS + 2)) & ((1 << _SW_LEN_BITS) - 1)
+        for t in range(lane_tiles):
+            sl = slice(t * 128, (t + 1) * 128)
+            q = jnp.maximum(q_ref[r:r + 1, sl].astype(jnp.int32), 0)
+            cbv = cb_ref[r:r + 1, sl].astype(jnp.int32)
+            ctx = cbv & 31
+            w = ((cbv >> 5) & 1).astype(oh_t)
+            wm = ((cbv >> 6) & 1).astype(oh_t)
+            ww = ((cbv >> 7) & 1).astype(oh_t)
+            pos = lane + t * 128
+            # DiscreteCycle (StandardCovariate.scala:39-48) + L offset,
+            # exactly covariate_tensors' formula
+            cyc = jnp.where(rev == 1, rlen - pos, pos + 1)
+            cyc = jnp.where(sec == 1, -cyc, cyc) + max_read_len
+            cyc = jnp.clip(cyc, 0, n_cycle - 1)
+            k = jnp.clip(q + MAX_REASONABLE_QSCORE * rg, 0,
+                         n_qual_rg - 1)
+            eq = (iota_q == k).astype(oh_t)
+            ohc = (((cat < cyc_bins) & (cat == cyc))
+                   | ((cat >= cyc_bins) & (cat - cyc_bins == ctx))
+                   ).astype(oh_t)
+            obs_acc += jax.lax.dot_general(
+                eq * w, ohc, nt, preferred_element_type=acc_t)
+            mm_acc += jax.lax.dot_general(
+                eq * wm, ohc, nt, preferred_element_type=acc_t)
+            ohq = (iota_256 == jnp.minimum(q, 255)).astype(oh_t)
+            qh_acc += jax.lax.dot_general(
+                ww.astype(oh_t), ohq, nt,
+                preferred_element_type=acc_t)
+    obs_ref[...] += obs_acc.astype(jnp.int32)
+    mm_ref[...] += mm_acc.astype(jnp.int32)
+    qh_ref[0:1, :] += qh_acc.astype(jnp.int32)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("q_rows", "cyc_bins", "n_qual_rg",
+                                    "n_cycle", "max_read_len",
+                                    "interpret", "int8_mxu"))
+def _rows_call(quals2, cb2, sw2, q_rows: int, cyc_bins: int,
+               n_qual_rg: int, n_cycle: int, max_read_len: int,
+               interpret: bool, int8_mxu: bool):
+    from jax.experimental.pallas import tpu as pltpu
+
+    n_rows, L = quals2.shape
+    n_blocks = n_rows // ROWS_BLOCK
+    cat_cols = cyc_bins + CTX_COLS
+    row_spec = pl.BlockSpec((ROWS_BLOCK, L), lambda i: (i, 0))
+    sw_spec = pl.BlockSpec((ROWS_BLOCK, 1), lambda i: (i, 0))
+    acc = pl.BlockSpec((q_rows, cat_cols), lambda i: (0, 0))
+    qh = pl.BlockSpec((8, 256), lambda i: (0, 0))
+    kern = functools.partial(
+        _rows_kernel, q_rows=q_rows, cyc_bins=cyc_bins,
+        n_qual_rg=n_qual_rg, n_cycle=n_cycle, max_read_len=max_read_len,
+        lane_tiles=L // 128, int8_mxu=int8_mxu)
+    return pl.pallas_call(
+        kern, grid=(n_blocks,),
+        in_specs=[row_spec, row_spec, sw_spec],
+        out_specs=(acc, acc, qh),
+        out_shape=(jax.ShapeDtypeStruct((q_rows, cat_cols), jnp.int32),
+                   jax.ShapeDtypeStruct((q_rows, cat_cols), jnp.int32),
+                   jax.ShapeDtypeStruct((8, 256), jnp.int32)),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary",)),
+        interpret=interpret,
+    )(quals2, cb2, sw2)
+
+
+def count_kernel_pallas_rows(bases, quals, read_len, flags, read_group,
+                             state, usable, n_qual_rg: int, n_cycle: int,
+                             interpret: bool = False,
+                             int8_mxu: bool = False):
+    """v3 of the Pallas count backend — same 7-tensor contract, ~2 B/base
+    of wire.  Reads lay out as rows ([reads, bucket_len], bucket_len a
+    multiple of 128 like the product packer emits); the kernel computes
+    the qual-rg and cycle covariates from the quals byte + a 4 B/read
+    scalar word, so only the context/weights byte rides per base."""
+    assert fits(n_qual_rg, n_cycle), (n_qual_rg, n_cycle)
+    N, L = quals.shape
+    max_read_len = (n_cycle - 1) // 2        # table geometry: 2L+1
+    # the oracle's cycle offset is the ARRAY width; this kernel derives
+    # it from the table geometry — they must be the same number or the
+    # cycle bins silently shift (the product packer guarantees it:
+    # bucket_len == RecalTable.max_read_len)
+    assert L == max_read_len, (L, max_read_len)
+    if N == 0:
+        z = jnp.zeros
+        return (z((n_qual_rg,), jnp.int32), z((n_qual_rg,), jnp.int32),
+                z((n_qual_rg * n_cycle,), jnp.int32),
+                z((n_qual_rg * n_cycle,), jnp.int32),
+                z((n_qual_rg * N_CONTEXT,), jnp.int32),
+                z((n_qual_rg * N_CONTEXT,), jnp.int32),
+                z((256,), jnp.int32))
+    cb, sw = _pack_rows_jit(bases, quals, read_len, flags, read_group,
+                            state, usable)
+    L_pad = _round_up(L, 128)
+    N_pad = _round_up(N, ROWS_BLOCK)
+    q2 = jnp.pad(jnp.asarray(quals), ((0, N_pad - N), (0, L_pad - L)))
+    cb2 = jnp.pad(cb, ((0, N_pad - N), (0, L_pad - L)))
+    sw2 = jnp.pad(sw, ((0, N_pad - N), (0, 0)))
+    q_rows = _round_up(n_qual_rg, 8)
+    cyc_bins = _round_up(n_cycle, 128)
+    obs, mm, qh = _rows_call(q2, cb2, sw2, q_rows=q_rows,
+                             cyc_bins=cyc_bins, n_qual_rg=n_qual_rg,
+                             n_cycle=n_cycle, max_read_len=max_read_len,
+                             interpret=interpret, int8_mxu=int8_mxu)
+    return _unpack_tables(obs, mm, qh, n_qual_rg=n_qual_rg,
+                          n_cycle=n_cycle, cyc_bins=cyc_bins)

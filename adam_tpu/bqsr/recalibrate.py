@@ -408,12 +408,13 @@ _COUNT_IMPL_ENV = "ADAM_TPU_BQSR_COUNT"
 
 def _count_impl(sharded: bool = False) -> str:
     choice = os.environ.get(_COUNT_IMPL_ENV, "auto")
-    if sharded and choice in ("chain", "pallas"):
+    if sharded and choice in ("chain", "pallas", "pallas_rows"):
         # both run host-driven outside shard_map; honoring them under a
         # mesh would silently drop the sharding — coerce to the scan form
         # (same matmul math) rather than compute on one device
         return "matmul"
-    if choice in ("scatter", "matmul", "host", "chain", "pallas"):
+    if choice in ("scatter", "matmul", "host", "chain", "pallas",
+                  "pallas_rows"):
         return choice
     if jax.default_backend() == "cpu":
         return "scatter"
@@ -521,12 +522,15 @@ def _count_tables_one(table: pa.Table, batch: ReadBatch,
         out = _count_tables_host(batch, state, usable,
                                  n_qual_rg=rt.n_qual_rg,
                                  n_cycle=rt.n_cycle)
-    elif impl == "pallas":
-        from .count_pallas import count_kernel_pallas, fits
+    elif impl in ("pallas", "pallas_rows"):
+        from .count_pallas import (count_kernel_pallas,
+                                   count_kernel_pallas_rows, fits)
         from ..platform import is_tpu_backend
         assert fits(rt.n_qual_rg, rt.n_cycle), \
             "covariate ranges exceed the packed-word budget"
-        out = count_kernel_pallas(
+        kern = count_kernel_pallas if impl == "pallas" \
+            else count_kernel_pallas_rows
+        out = kern(
             jnp.asarray(batch.bases), jnp.asarray(batch.quals),
             jnp.asarray(batch.read_len), jnp.asarray(batch.flags),
             jnp.asarray(batch.read_group), jnp.asarray(state),

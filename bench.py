@@ -601,6 +601,13 @@ def _stage_bqsr_race(kind: str, is_tpu: bool):
         # lowers; a rejection lands as race_pallas8_error, not a crash
         race("pallas8", lambda: count_kernel_pallas(*args, int8_mxu=True,
                                                     **kw))
+        # v3 rows kernel: covariates in-kernel, ~2 B/base wire
+        from adam_tpu.bqsr.count_pallas import count_kernel_pallas_rows
+        race("pallas_rows",
+             lambda: count_kernel_pallas_rows(*args, **kw))
+        race("pallas_rows8",
+             lambda: count_kernel_pallas_rows(*args, int8_mxu=True,
+                                              **kw))
         # on-chip VALUE cross-check vs the scatter oracle: interpret-mode
         # equality is already test-pinned, but the compiled Mosaic kernel
         # must match on real hardware before the product default can flip.
@@ -609,7 +616,8 @@ def _stage_bqsr_race(kind: str, is_tpu: bool):
         try:
             if "scatter" in outputs:
                 ref = [np.asarray(o) for o in outputs["scatter"]]
-                for name in ("pallas", "pallas8"):
+                for name in ("pallas", "pallas8", "pallas_rows",
+                             "pallas_rows8"):
                     if name not in outputs:
                         continue
                     got = [np.asarray(o) for o in outputs[name]]

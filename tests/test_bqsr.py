@@ -273,13 +273,17 @@ def test_count_slab_walk_matches_monolithic(monkeypatch):
 
 
 @pytest.mark.parametrize("int8_mxu", [False, True])
-def test_count_impl_pallas_matches_scatter(int8_mxu):
-    """The Pallas packed-word MXU count backend (bf16 and int8 one-hot
-    variants) must produce bit-identical tables to the scatter oracle
-    (interpret mode on the CPU test mesh)."""
+@pytest.mark.parametrize("variant", ["flat", "rows"])
+def test_count_impl_pallas_matches_scatter(variant, int8_mxu):
+    """Every Pallas count backend variant (flat packed-word v1 and
+    in-kernel-covariate rows v3, each in bf16 and int8 one-hot forms)
+    must produce bit-identical tables to the scatter oracle (interpret
+    mode on the CPU test mesh)."""
     import numpy as np
 
-    from adam_tpu.bqsr.count_pallas import count_kernel_pallas, fits
+    from adam_tpu.bqsr.count_pallas import (count_kernel_pallas,
+                                            count_kernel_pallas_rows,
+                                            fits)
     from adam_tpu.bqsr.recalibrate import _count_kernel
     from adam_tpu.bqsr.table import RecalTable
 
@@ -294,10 +298,11 @@ def test_count_impl_pallas_matches_scatter(int8_mxu):
             rng.randint(0, n_rg, n).astype(np.int32),
             rng.randint(0, 3, (n, L)).astype(np.int8),
             rng.rand(n) < 0.9)
+    kern = count_kernel_pallas if variant == "flat" \
+        else count_kernel_pallas_rows
     ref = _count_kernel(*args, n_qual_rg=rt.n_qual_rg, n_cycle=rt.n_cycle)
-    got = count_kernel_pallas(*args, n_qual_rg=rt.n_qual_rg,
-                              n_cycle=rt.n_cycle, interpret=True,
-                              int8_mxu=int8_mxu)
+    got = kern(*args, n_qual_rg=rt.n_qual_rg,
+               n_cycle=rt.n_cycle, interpret=True, int8_mxu=int8_mxu)
     for a, b in zip(got, ref):
         assert np.array_equal(np.asarray(a), np.asarray(b))
 
