@@ -456,8 +456,14 @@ def _stage_transform(kind: str, is_tpu: bool):
             q, c, s = pass_fn(state["q"], state["c"])
             state.update(q=q, c=c, s=s + counts[0].sum())
     else:
-        count_kernel = (_count_kernel_matmul if count_impl == "matmul"
-                        else _count_kernel)
+        if count_impl in ("pallas", "pallas_rows"):
+            from adam_tpu.bqsr.count_pallas import (
+                count_kernel_pallas, count_kernel_pallas_rows)
+            count_kernel = count_kernel_pallas if count_impl == "pallas" \
+                else count_kernel_pallas_rows
+        else:
+            count_kernel = (_count_kernel_matmul if count_impl == "matmul"
+                            else _count_kernel)
 
         @jax.jit
         def pass_fn(q, c):
