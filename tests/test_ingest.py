@@ -128,3 +128,12 @@ def test_transform_output_independent_of_io_threads(tmp_path):
                             chunk_rows=512, io_threads=thr)
         outs[thr] = pq.read_table(out)
     assert outs[1].equals(outs[4])
+
+    # the pack-less passes (no markdup/bqsr) take the decode-only
+    # prefetch path — that too must be byte-invisible
+    for thr in (1, 3):
+        out = tmp_path / f"plain{thr}"
+        streaming_transform(str(src), str(out), chunk_rows=512,
+                            io_threads=thr)
+        outs[f"p{thr}"] = pq.read_table(out)
+    assert outs["p1"].equals(outs["p3"])
