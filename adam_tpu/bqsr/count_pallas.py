@@ -377,3 +377,37 @@ def count_kernel_pallas_rows(bases, quals, read_len, flags, read_group,
                              interpret=interpret, int8_mxu=int8_mxu)
     return _unpack_tables(obs, mm, qh, n_qual_rg=n_qual_rg,
                           n_cycle=n_cycle, cyc_bins=cyc_bins)
+
+
+def sharded_count_pallas(mesh, n_qual_rg: int, n_cycle: int,
+                         variant: str = "flat", interpret: bool = False,
+                         int8_mxu: bool = False):
+    """Mesh-sharded count: each shard runs the Pallas kernel on its local
+    rows, the 7 count tensors psum over ICI — the same shape as
+    ``flagstat_wire32_sharded_pallas`` and the distributed form the
+    reference reaches with its driver aggregate
+    (RecalibrateBaseQualities.scala:52-64).  Unlike the chain impl (a
+    host loop that cannot enter shard_map), the pallas_call is traceable,
+    so the sharded product path gets the fast kernel instead of the
+    scan-form matmul and its remote-AOT unroll hazard.
+
+    ``check_vma=False`` for the same reason as the flagstat kernel: the
+    pallas_call out_shape carries no varying-mesh-axes annotation.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    from ..parallel.mesh import READS_AXIS
+
+    kern = count_kernel_pallas if variant == "flat" \
+        else count_kernel_pallas_rows
+
+    def fn(bases, quals, read_len, flags, read_group, state, usable):
+        out = kern(bases, quals, read_len, flags, read_group, state,
+                   usable, n_qual_rg=n_qual_rg, n_cycle=n_cycle,
+                   interpret=interpret, int8_mxu=int8_mxu)
+        return tuple(jax.lax.psum(o, READS_AXIS) for o in out)
+
+    spec = P(READS_AXIS)
+    return jax.jit(jax.shard_map(
+        fn, mesh=mesh, in_specs=(spec,) * 7, out_specs=(P(),) * 7,
+        check_vma=False))

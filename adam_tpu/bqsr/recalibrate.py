@@ -408,10 +408,11 @@ _COUNT_IMPL_ENV = "ADAM_TPU_BQSR_COUNT"
 
 def _count_impl(sharded: bool = False) -> str:
     choice = os.environ.get(_COUNT_IMPL_ENV, "auto")
-    if sharded and choice in ("chain", "pallas", "pallas_rows"):
-        # both run host-driven outside shard_map; honoring them under a
-        # mesh would silently drop the sharding — coerce to the scan form
-        # (same matmul math) rather than compute on one device
+    if sharded and choice == "chain":
+        # chain is a host loop that cannot enter shard_map; honoring it
+        # under a mesh would silently drop the sharding — coerce to the
+        # scan form (same matmul math).  The pallas impls ARE traceable
+        # and run sharded (count_pallas.sharded_count_pallas).
         return "matmul"
     if choice in ("scatter", "matmul", "host", "chain", "pallas",
                   "pallas_rows"):
@@ -458,6 +459,14 @@ _COUNT_SLAB_ENV = "ADAM_TPU_COUNT_SLAB"
 
 def _count_slab_rows() -> int:
     return int(os.environ.get(_COUNT_SLAB_ENV, str(256 * 1024)))
+
+
+@lru_cache(maxsize=16)
+def _sharded_pallas_fn(mesh, n_qual_rg: int, n_cycle: int, variant: str,
+                       interpret: bool):
+    from .count_pallas import sharded_count_pallas
+    return sharded_count_pallas(mesh, n_qual_rg, n_cycle, variant=variant,
+                                interpret=interpret)
 
 
 def count_tables_device(table: pa.Table,
@@ -528,14 +537,21 @@ def _count_tables_one(table: pa.Table, batch: ReadBatch,
         from ..platform import is_tpu_backend
         assert fits(rt.n_qual_rg, rt.n_cycle), \
             "covariate ranges exceed the packed-word budget"
-        kern = count_kernel_pallas if impl == "pallas" \
-            else count_kernel_pallas_rows
-        out = kern(
-            jnp.asarray(batch.bases), jnp.asarray(batch.quals),
-            jnp.asarray(batch.read_len), jnp.asarray(batch.flags),
-            jnp.asarray(batch.read_group), jnp.asarray(state),
-            jnp.asarray(usable), n_qual_rg=rt.n_qual_rg,
-            n_cycle=rt.n_cycle, interpret=not is_tpu_backend())
+        variant = "flat" if impl == "pallas" else "rows"
+        args = (jnp.asarray(batch.bases), jnp.asarray(batch.quals),
+                jnp.asarray(batch.read_len), jnp.asarray(batch.flags),
+                jnp.asarray(batch.read_group), jnp.asarray(state),
+                jnp.asarray(usable))
+        if sharded:
+            out = _sharded_pallas_fn(mesh, rt.n_qual_rg, rt.n_cycle,
+                                     variant,
+                                     not is_tpu_backend())(*args)
+        else:
+            kern = count_kernel_pallas if impl == "pallas" \
+                else count_kernel_pallas_rows
+            out = kern(*args, n_qual_rg=rt.n_qual_rg,
+                       n_cycle=rt.n_cycle,
+                       interpret=not is_tpu_backend())
     else:
         kernel = {"matmul": _count_kernel_matmul,
                   "chain": _count_kernel_chain}.get(impl, _count_kernel)
