@@ -424,7 +424,90 @@ def _count_impl(sharded: bool = False) -> str:
     # ~2 s/iteration compile on an equivalent scan body, which at product
     # chunk sizes (thousands of blocks) is effectively a hang.  The scan
     # form stays the pick under shard_map, which a host loop cannot enter.
+    # Both answers may be upgraded to the Pallas rows kernel by the
+    # per-geometry self-check (_tpu_auto_upgrade) at the call site.
     return "matmul" if sharded else "chain"
+
+
+#: (n_qual_rg, n_cycle, sharded, mesh) -> bool: did the Pallas rows
+#: kernel prove itself exact in the SAME configuration production uses?
+_AUTO_UPGRADE_CACHE: dict = {}
+
+
+def _tpu_auto_upgrade(fallback: str, n_qual_rg: int, n_cycle: int,
+                      n_read_groups: int, mesh=None) -> str:
+    """On TPU backends, upgrade the auto count impl to the Pallas rows
+    kernel after a one-time exactness check against the scatter oracle
+    at this table geometry — run through the SAME callable production
+    will use (sharded wrapper + interpret flag included).  The check
+    batch is adversarial: invalid/pad bases, pad and boundary quals,
+    null read groups, zero-length and unusable reads.  Any failure —
+    Mosaic rejection, value divergence — caches False and the caller's
+    own fallback is returned, so a failed check on the sharded path can
+    never leak a host-loop impl to it (or vice versa)."""
+    sharded = mesh is not None
+    key = (n_qual_rg, n_cycle, sharded, mesh)
+    ok = _AUTO_UPGRADE_CACHE.get(key)
+    if ok is None:
+        ok = False
+        try:
+            from .count_pallas import ROWS_BLOCK, fits
+            from ..platform import is_tpu_backend
+            L = (n_cycle - 1) // 2
+            if fits(n_qual_rg, n_cycle) and L >= 1:
+                rng = np.random.RandomState(0)
+                n = ROWS_BLOCK * 2 * (mesh.size if sharded else 1)
+                quals = rng.randint(-1, 94, (n, L)).astype(np.int8)
+                quals[0] = 0
+                quals[1] = 93
+                read_len = rng.randint(0, L + 1, n).astype(np.int32)
+                usable = rng.rand(n) < 0.8
+                usable[2] = False
+                args = (
+                    # -1 pad and 4 (N) both out of the valid 0-3 range
+                    jnp.asarray(rng.randint(-1, 5, (n, L))
+                                .astype(np.int8)),
+                    jnp.asarray(quals),
+                    jnp.asarray(read_len),
+                    jnp.asarray(rng.choice([0, 16, 83, 163, 512 | 1], n)
+                                .astype(np.int32)),
+                    jnp.asarray(rng.randint(-1, n_read_groups, n)
+                                .astype(np.int32)),
+                    jnp.asarray(rng.randint(0, 3, (n, L))
+                                .astype(np.int8)),
+                    jnp.asarray(usable))
+                ref = _count_kernel(*args, n_qual_rg=n_qual_rg,
+                                    n_cycle=n_cycle)
+                if sharded:
+                    cand = _sharded_pallas_fn(
+                        mesh, n_qual_rg, n_cycle, "rows",
+                        not is_tpu_backend())(*args)
+                else:
+                    from .count_pallas import count_kernel_pallas_rows
+                    cand = count_kernel_pallas_rows(
+                        *args, n_qual_rg=n_qual_rg, n_cycle=n_cycle,
+                        interpret=not is_tpu_backend())
+                ok = all(np.array_equal(np.asarray(a), np.asarray(b))
+                         for a, b in zip(cand, ref))
+        except Exception:  # noqa: BLE001 — fallback is the answer
+            ok = False
+        _AUTO_UPGRADE_CACHE[key] = ok
+    return "pallas_rows" if ok else fallback
+
+
+#: row-slab bound for the pass-1 chunk walk.  The count kernels materialize
+#: several [rows, L] int32 covariate tensors; at the streaming pipeline's
+#: 1M-row chunks that working set (~2.4 GB) falls out of cache and the
+#: measured cost turns superlinear: 1M rows took 38 s where 5x the 200k-row
+#: time predicts 8 s (CPU backend, this box).  Walking the chunk in
+#: 256k-row slabs and summing the (tiny) count tensors restores the linear
+#: rate — count tensors are exact integer monoids, so the slab sum is
+#: bit-identical to the monolithic call for every impl.
+_COUNT_SLAB_ENV = "ADAM_TPU_COUNT_SLAB"
+
+
+def _count_slab_rows() -> int:
+    return int(os.environ.get(_COUNT_SLAB_ENV, str(256 * 1024)))
 
 
 @lru_cache(maxsize=16)
@@ -444,21 +527,6 @@ def _sharded_count_fn(kernel, mesh, n_qual_rg: int, n_cycle: int):
                 axis_name=READS_AXIS),
         mesh=mesh, in_specs=(spec,) * 7, out_specs=(P(),) * 7)
     return jax.jit(fn)
-
-
-#: row-slab bound for the pass-1 chunk walk.  The count kernels materialize
-#: several [rows, L] int32 covariate tensors; at the streaming pipeline's
-#: 1M-row chunks that working set (~2.4 GB) falls out of cache and the
-#: measured cost turns superlinear: 1M rows took 38 s where 5x the 200k-row
-#: time predicts 8 s (CPU backend, this box).  Walking the chunk in
-#: 256k-row slabs and summing the (tiny) count tensors restores the linear
-#: rate — count tensors are exact integer monoids, so the slab sum is
-#: bit-identical to the monolithic call for every impl.
-_COUNT_SLAB_ENV = "ADAM_TPU_COUNT_SLAB"
-
-
-def _count_slab_rows() -> int:
-    return int(os.environ.get(_COUNT_SLAB_ENV, str(256 * 1024)))
 
 
 @lru_cache(maxsize=16)
@@ -527,6 +595,14 @@ def _count_tables_one(table: pa.Table, batch: ReadBatch,
                     max_read_len=batch.max_len)
     sharded = mesh is not None
     impl = _count_impl(sharded=sharded)
+    if impl in ("chain", "matmul") and \
+            os.environ.get(_COUNT_IMPL_ENV, "auto") == "auto":
+        # auto on a TPU backend: prefer the Pallas rows kernel once it
+        # proves itself exact at this geometry IN this configuration
+        # (the sharded check runs the shard_map wrapper itself)
+        impl = _tpu_auto_upgrade(impl, rt.n_qual_rg, rt.n_cycle,
+                                 rt.n_read_groups,
+                                 mesh if sharded else None)
     if impl == "host":
         out = _count_tables_host(batch, state, usable,
                                  n_qual_rg=rt.n_qual_rg,

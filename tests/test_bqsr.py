@@ -367,3 +367,42 @@ def test_sharded_pallas_count_matches_scatter(variant):
     got = fn(*args)
     for a, b in zip(got, ref):
         assert np.array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_tpu_auto_upgrade_falls_back_on_kernel_failure(monkeypatch):
+    """A kernel that cannot run (Mosaic rejection, backend quirk) must
+    cache a False verdict and return each caller's OWN fallback — a
+    failed check on one path can never leak another path's impl."""
+    from adam_tpu.bqsr import count_pallas as CP
+    from adam_tpu.bqsr import recalibrate as R
+
+    def boom(*a, **kw):
+        raise RuntimeError("mosaic said no")
+
+    monkeypatch.setattr(CP, "count_kernel_pallas_rows", boom)
+    R._AUTO_UPGRADE_CACHE.clear()
+    got = R._tpu_auto_upgrade("chain", 154, 101, 1)
+    assert got == "chain"
+    assert R._AUTO_UPGRADE_CACHE[(154, 101, False, None)] is False
+    # a different fallback gets ITS OWN answer from the cached verdict
+    assert R._tpu_auto_upgrade("matmul", 154, 101, 1) == "matmul"
+    R._AUTO_UPGRADE_CACHE.clear()
+
+
+def test_tpu_auto_upgrade_picks_rows_when_exact(monkeypatch):
+    """When the rows kernel runs and matches the oracle (forced via
+    interpret mode here), auto upgrades to it and caches per geometry."""
+    from adam_tpu.bqsr import count_pallas as CP
+    from adam_tpu.bqsr import recalibrate as R
+
+    real = CP.count_kernel_pallas_rows
+
+    def interp(*args, **kw):
+        kw["interpret"] = True
+        return real(*args, **kw)
+
+    monkeypatch.setattr(CP, "count_kernel_pallas_rows", interp)
+    R._AUTO_UPGRADE_CACHE.clear()
+    got = R._tpu_auto_upgrade("chain", 154, 101, 1)
+    assert got == "pallas_rows"
+    R._AUTO_UPGRADE_CACHE.clear()
