@@ -446,7 +446,7 @@ def _tpu_auto_upgrade(fallback: str, n_qual_rg: int, n_cycle: int,
     own fallback is returned, so a failed check on the sharded path can
     never leak a host-loop impl to it (or vice versa)."""
     sharded = mesh is not None
-    key = (n_qual_rg, n_cycle, sharded, mesh)
+    key = (n_qual_rg, n_cycle, mesh)
     ok = _AUTO_UPGRADE_CACHE.get(key)
     if ok is None:
         ok = False
@@ -454,7 +454,10 @@ def _tpu_auto_upgrade(fallback: str, n_qual_rg: int, n_cycle: int,
             from .count_pallas import ROWS_BLOCK, fits
             from ..platform import is_tpu_backend
             L = (n_cycle - 1) // 2
-            if fits(n_qual_rg, n_cycle) and L >= 1:
+            # TPU only: on any other accelerator the probe would pass in
+            # interpret mode and then run the Mosaic INTERPRETER on real
+            # chunks (platform.is_tpu_backend's documented hazard)
+            if is_tpu_backend() and fits(n_qual_rg, n_cycle) and L >= 1:
                 rng = np.random.RandomState(0)
                 n = ROWS_BLOCK * 2 * (mesh.size if sharded else 1)
                 quals = rng.randint(-1, 94, (n, L)).astype(np.int8)

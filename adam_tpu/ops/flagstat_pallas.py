@@ -38,13 +38,77 @@ BLOCK_ROWS = 128
 BLOCK = BLOCK_ROWS * LANES
 
 #: kernel variant for the product paths: "v1" (per-block SMEM scalar
-#: reductions) or "v2" (deferred per-lane reduction, 4x block).  Default
-#: stays v1 until a chip measurement crowns v2 (bench.py races both).
+#: reductions), "v2" (deferred per-lane reduction, 4x block), or "auto"
+#: (default): on TPU backends, race both once per process with a
+#: correctness gate against the XLA core and keep the winner — the same
+#: self-tuning pattern as realign's conv-vs-pallas sweep race.
 _VARIANT_ENV = "ADAM_TPU_FLAGSTAT_PALLAS"
 
 
+def _t_of(thunk) -> float:
+    import time
+    t0 = time.perf_counter()
+    thunk()
+    return time.perf_counter() - t0
+
+
 def _variant() -> str:
-    return os.environ.get(_VARIANT_ENV, "v1")
+    choice = os.environ.get(_VARIANT_ENV, "auto")
+    if choice in ("v1", "v2"):
+        return choice
+    return _auto_variant()
+
+
+@functools.lru_cache(maxsize=1)
+def _auto_variant() -> str:
+    from ..platform import is_tpu_backend
+    if not is_tpu_backend():
+        return "v1"          # variants only differ compiled; tests pin both
+    try:
+        from .flagstat import pack_flagstat_wire32
+
+        rng = np.random.RandomState(0)
+        n = 16 * V2_BLOCK                  # 32 MiB of wire, 64 v1 blocks
+        wire = pack_flagstat_wire32(
+            rng.randint(0, 1 << 12, n).astype(np.uint16),
+            rng.randint(0, 61, n).astype(np.uint8),
+            rng.randint(0, 4, n).astype(np.int16),
+            rng.randint(0, 4, n).astype(np.int16),
+            np.ones(n, bool))
+        ref = np.asarray(flagstat_kernel_wire32(jnp.asarray(wire)))
+        w1 = jax.device_put(wire.reshape(-1, BLOCK_ROWS, LANES))
+        w4 = jax.device_put(wire.reshape(-1, V2_ROWS, LANES))
+        tail = jax.device_put(wire[:0])
+
+        # the one device_get sync pays a tunnel round trip with ms-scale
+        # jitter (block_until_ready is a no-op over axon), so: measure
+        # the sync floor, chain enough dispatches that kernel time
+        # dominates it, take min-of-3, and demand a real margin
+        g = jax.jit(lambda a: a[0, :1, :1].astype(jnp.int32))
+        jax.device_get(g(w1))
+        rtt = min(_t_of(lambda: jax.device_get(g(w1)))
+                  for _ in range(3))
+
+        def timed(fn, arg):
+            out = fn(arg, tail)
+            if not np.array_equal(np.asarray(out), ref):
+                return None              # correctness gate
+
+            def once():
+                o = None
+                for _ in range(32):      # chained dispatch; one sync
+                    o = fn(arg, tail)
+                jax.device_get(o)
+            once()                       # warm
+            return max(min(_t_of(once) for _ in range(3)) - rtt, 1e-6)
+
+        t1 = timed(_flagstat_blocked, w1)
+        t2 = timed(_flagstat_blocked_v2, w4)
+        if t2 is not None and (t1 is None or t2 < 0.9 * t1):
+            return "v2"
+    except Exception:  # noqa: BLE001 — v1 is the safe answer
+        pass
+    return "v1"
 
 
 def _wire_masks(wire):

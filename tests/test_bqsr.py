@@ -376,14 +376,17 @@ def test_tpu_auto_upgrade_falls_back_on_kernel_failure(monkeypatch):
     from adam_tpu.bqsr import count_pallas as CP
     from adam_tpu.bqsr import recalibrate as R
 
+    from adam_tpu import platform as P
+
     def boom(*a, **kw):
         raise RuntimeError("mosaic said no")
 
     monkeypatch.setattr(CP, "count_kernel_pallas_rows", boom)
+    monkeypatch.setattr(P, "is_tpu_backend", lambda: True)
     R._AUTO_UPGRADE_CACHE.clear()
     got = R._tpu_auto_upgrade("chain", 154, 101, 1)
     assert got == "chain"
-    assert R._AUTO_UPGRADE_CACHE[(154, 101, False, None)] is False
+    assert R._AUTO_UPGRADE_CACHE[(154, 101, None)] is False
     # a different fallback gets ITS OWN answer from the cached verdict
     assert R._tpu_auto_upgrade("matmul", 154, 101, 1) == "matmul"
     R._AUTO_UPGRADE_CACHE.clear()
@@ -401,7 +404,10 @@ def test_tpu_auto_upgrade_picks_rows_when_exact(monkeypatch):
         kw["interpret"] = True
         return real(*args, **kw)
 
+    from adam_tpu import platform as P
+
     monkeypatch.setattr(CP, "count_kernel_pallas_rows", interp)
+    monkeypatch.setattr(P, "is_tpu_backend", lambda: True)
     R._AUTO_UPGRADE_CACHE.clear()
     got = R._tpu_auto_upgrade("chain", 154, 101, 1)
     assert got == "pallas_rows"
