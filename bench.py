@@ -541,7 +541,7 @@ def _stage_bqsr_race(kind: str, is_tpu: bool):
     from adam_tpu.bqsr.table import RecalTable
 
     L, n_rg = 100, 4
-    default_n = 1_000_000 if is_tpu else 50_000
+    default_n = 1_000_000 if is_tpu else 25_000
     n = int(os.environ.get("ADAM_TPU_BENCH_RACE_READS", default_n))
     rt = RecalTable(n_read_groups=n_rg, max_read_len=L)
 
