@@ -384,9 +384,18 @@ def _stage_transform(kind: str, is_tpu: bool):
     default_n = 1_500_000 if is_tpu else 200_000
     n = int(os.environ.get("ADAM_TPU_BENCH_TRANSFORM_READS", default_n))
     # resolve EXACTLY like the product's unsharded path so the reported
-    # numbers describe the kernel the product runs for the same setting
-    from adam_tpu.bqsr.recalibrate import _count_impl
+    # numbers describe the kernel the product runs for the same setting —
+    # including the TPU auto upgrade to the Pallas rows kernel (its
+    # exactness probe runs here just as in count_tables_device)
+    from adam_tpu.bqsr.recalibrate import (_COUNT_IMPL_ENV, _count_impl,
+                                           _tpu_auto_upgrade)
+    from adam_tpu.bqsr.table import RecalTable as _RT
+    _rt0 = _RT(n_read_groups=n_rg, max_read_len=L)
     count_impl = _count_impl(sharded=False)
+    if count_impl in ("chain", "matmul") and \
+            os.environ.get(_COUNT_IMPL_ENV, "auto") == "auto":
+        count_impl = _tpu_auto_upgrade(count_impl, _rt0.n_qual_rg,
+                                       _rt0.n_cycle, n_rg)
     if count_impl == "host":      # no host-bincount form in this bench
         count_impl = "scatter"
 
