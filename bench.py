@@ -550,7 +550,7 @@ def _stage_bqsr_race(kind: str, is_tpu: bool):
     from adam_tpu.bqsr.table import RecalTable
 
     L, n_rg = 100, 4
-    default_n = 1_000_000 if is_tpu else 25_000
+    default_n = 1_000_000 if is_tpu else 10_000
     n = int(os.environ.get("ADAM_TPU_BENCH_RACE_READS", default_n))
     rt = RecalTable(n_read_groups=n_rg, max_read_len=L)
 
@@ -878,12 +878,22 @@ def main() -> None:
                                          _remaining() - CPU_RESERVE_S)))
                 continue
             stages |= {k: v for k, v in got.items() if k not in stages}
+            if "probe" in got:
+                # the tunnel answered: probe hangs so far were flaps,
+                # not death — only CONSECUTIVE probe hangs may concede
+                fails.pop("probe", None)
             if err:
                 errors.append(f"attempt {attempt}: {err}")
                 if failed:
                     fails[failed] = fails.get(failed, 0) + 1
                     if fails[failed] >= 2:
                         skip.add(failed)
+                if fails.get("probe", 0) >= 2:
+                    # the tunnel is dead, not flaky: every further
+                    # attempt would burn another probe deadline the CPU
+                    # fallback needs (observed: the fallback's race
+                    # stage starved after two 150 s probe hangs)
+                    break
                 time.sleep(min(10.0, max(0.0,
                                          _remaining() - CPU_RESERVE_S)))
             else:
