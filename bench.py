@@ -49,7 +49,8 @@ CPU_RESERVE_S = float(os.environ.get("ADAM_TPU_BENCH_CPU_RESERVE", "150"))
 #: per-stage stdout deadlines for the worker (probe covers backend init +
 #: first compile over the tunnel)
 STAGE_TIMEOUT_S = {"probe": 150.0, "flagstat": 180.0, "transform": 280.0,
-                   "bqsr_race": 300.0, "pallas": 240.0}
+                   "bqsr_race": 300.0, "bqsr_race8": 150.0,
+                   "pallas": 240.0}
 _START = time.monotonic()
 
 
@@ -530,6 +531,39 @@ def _stage_transform(kind: str, is_tpu: bool):
     })
 
 
+def _race_args(n: int, L: int, n_rg: int):
+    """Device-resident synthetic count-race batch — ONE jitted generator
+    shared by the core race and the int8 stage, so both see identical
+    data (seed 7) and the second stage hits the in-process compile
+    cache instead of re-tracing an identical generator over the
+    tunnel."""
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def gen(key):
+        ks = jax.random.split(key, 5)
+        return (
+            jax.random.randint(ks[0], (n, L), 0, 4, jnp.int32
+                               ).astype(jnp.int8),          # bases
+            jax.random.randint(ks[1], (n, L), 2, 41, jnp.int32
+                               ).astype(jnp.int8),          # quals
+            jnp.full((n,), L, jnp.int32),                   # read_len
+            jnp.where(jax.random.uniform(ks[2], (n,)) < 0.5, 16, 0
+                      ).astype(jnp.int32),                  # flags
+            jax.random.randint(ks[3], (n,), 0, n_rg, jnp.int32),
+            jax.random.randint(ks[4], (n, L), 0, 3, jnp.int32
+                               ).astype(jnp.int8),          # state
+            jnp.ones((n,), bool),                           # usable
+        )
+
+    gen = _RACE_GEN_CACHE.setdefault((n, L, n_rg), gen)
+    return gen(jax.random.PRNGKey(7))
+
+
+_RACE_GEN_CACHE: dict = {}
+
+
 def _stage_bqsr_race(kind: str, is_tpu: bool):
     """Race every BQSR pass-1 count backend on one device-resident batch
     (VERDICT r3 #2): scatter (XLA scatter-add), matmul (blocked one-hot
@@ -553,25 +587,7 @@ def _stage_bqsr_race(kind: str, is_tpu: bool):
     default_n = 1_000_000 if is_tpu else 10_000
     n = int(os.environ.get("ADAM_TPU_BENCH_RACE_READS", default_n))
     rt = RecalTable(n_read_groups=n_rg, max_read_len=L)
-
-    @jax.jit
-    def gen(key):
-        ks = jax.random.split(key, 5)
-        return (
-            jax.random.randint(ks[0], (n, L), 0, 4, jnp.int32
-                               ).astype(jnp.int8),          # bases
-            jax.random.randint(ks[1], (n, L), 2, 41, jnp.int32
-                               ).astype(jnp.int8),          # quals
-            jnp.full((n,), L, jnp.int32),                   # read_len
-            jnp.where(jax.random.uniform(ks[2], (n,)) < 0.5, 16, 0
-                      ).astype(jnp.int32),                  # flags
-            jax.random.randint(ks[3], (n,), 0, n_rg, jnp.int32),
-            jax.random.randint(ks[4], (n, L), 0, 3, jnp.int32
-                               ).astype(jnp.int8),          # state
-            jnp.ones((n,), bool),                           # usable
-        )
-
-    args = gen(jax.random.PRNGKey(7))
+    args = _race_args(n, L, n_rg)
     rtt = _tunnel_rtt()
     payload: dict = {"race_n_reads": n,
                      "race_backend": jax.default_backend()}
@@ -612,17 +628,10 @@ def _stage_bqsr_race(kind: str, is_tpu: bool):
     if is_tpu:
         from adam_tpu.bqsr.count_pallas import count_kernel_pallas
         race("pallas", lambda: count_kernel_pallas(*args, **kw))
-        # int8 one-hots: 2x MXU peak on v5e IF Mosaic's int8 matmul path
-        # lowers; a rejection lands as race_pallas8_error, not a crash
-        race("pallas8", lambda: count_kernel_pallas(*args, int8_mxu=True,
-                                                    **kw))
         # v3 rows kernel: covariates in-kernel, ~2 B/base wire
         from adam_tpu.bqsr.count_pallas import count_kernel_pallas_rows
         race("pallas_rows",
              lambda: count_kernel_pallas_rows(*args, **kw))
-        race("pallas_rows8",
-             lambda: count_kernel_pallas_rows(*args, int8_mxu=True,
-                                              **kw))
         # on-chip VALUE cross-check vs the scatter oracle: interpret-mode
         # equality is already test-pinned, but the compiled Mosaic kernel
         # must match on real hardware before the product default can flip.
@@ -631,8 +640,7 @@ def _stage_bqsr_race(kind: str, is_tpu: bool):
         try:
             if "scatter" in outputs:
                 ref = [np.asarray(o) for o in outputs["scatter"]]
-                for name in ("pallas", "pallas8", "pallas_rows",
-                             "pallas_rows8"):
+                for name in ("pallas", "pallas_rows"):
                     if name not in outputs:
                         continue
                     got = [np.asarray(o) for o in outputs[name]]
@@ -667,6 +675,51 @@ def _stage_bqsr_race(kind: str, is_tpu: bool):
             payload["race_pallas_mfu_pct"] = round(
                 100 * rates["pallas"] * flops_per_read / peak_fl, 2)
     _emit("bqsr_race", payload)
+
+
+def _stage_bqsr_race8(kind: str, is_tpu: bool):
+    """The exploratory int8-MXU legs of the count race, as their OWN
+    stage: a Mosaic int8 rejection or slow compile can only cost this
+    line, never the core race results (which already streamed)."""
+    if not is_tpu:
+        _emit("bqsr_race8", {"race8_skipped":
+                             "int8 MXU legs are TPU-only"})
+        return
+    import numpy as np
+
+    from adam_tpu.bqsr.count_pallas import (count_kernel_pallas,
+                                            count_kernel_pallas_rows)
+    from adam_tpu.bqsr.recalibrate import _count_kernel
+    from adam_tpu.bqsr.table import RecalTable
+
+    L, n_rg = 100, 4
+    n = int(os.environ.get("ADAM_TPU_BENCH_RACE_READS", 1_000_000))
+    rt = RecalTable(n_read_groups=n_rg, max_read_len=L)
+    args = _race_args(n, L, n_rg)         # identical data, cached gen
+    rtt = _tunnel_rtt()
+    payload: dict = {"race8_n_reads": n}
+    kw = dict(n_qual_rg=rt.n_qual_rg, n_cycle=rt.n_cycle)
+    ref = None
+    for name, kern in (("pallas8", count_kernel_pallas),
+                       ("pallas_rows8", count_kernel_pallas_rows)):
+        try:
+            st: dict = {}
+
+            def step():
+                st["out"] = kern(*args, int8_mxu=True, **kw)
+
+            per, k_used = _chain_rate(step, lambda: st["out"][0], rtt,
+                                      k_probe=2, k_max=64)
+            payload[f"race_{name}_reads_per_sec"] = round(n / per)
+            payload[f"race_{name}_chain_len"] = k_used
+            if ref is None:
+                ref = [np.asarray(o) for o in _count_kernel(*args, **kw)]
+            got = [np.asarray(o) for o in st["out"]]
+            payload[f"race_{name}_matches_scatter"] = bool(
+                all(np.array_equal(a, b) for a, b in zip(got, ref)))
+        except Exception as e:  # noqa: BLE001
+            payload[f"race_{name}_error"] = f"{type(e).__name__}: {e}"[:160]
+    _emit("bqsr_race8", payload)
 
 
 def _stage_pallas():
@@ -771,6 +824,10 @@ def _worker(stages: list[str]) -> None:
             _stage_pallas()
         else:
             _emit("pallas", {"skipped": "pallas stages need a TPU backend"})
+    # exploratory int8 legs LAST: a hang here can only cost this line,
+    # never prior-round evidence (pallas) or the core race
+    if "bqsr_race8" in stages:
+        _stage_bqsr_race8(kind, is_tpu)
 
 
 # ---------------------------------------------------------------------------
@@ -849,7 +906,8 @@ def main() -> None:
     errors: list[str] = []
     stages: dict = {}
     try:
-        want = ["probe", "flagstat", "transform", "bqsr_race", "pallas"]
+        want = ["probe", "flagstat", "transform", "bqsr_race",
+                "pallas", "bqsr_race8"]
         attempt = 0
         cpu_incidental: dict = {}
         fails: dict = {}
@@ -906,7 +964,8 @@ def main() -> None:
         # genuinely TPU-only stage — deriving from `want` keeps a future
         # stage from being silently dropped (the want[:3] slice bug)
         missing = [s for s in want
-                   if s != "pallas" and s not in stages]
+                   if s not in ("pallas", "bqsr_race8")
+                   and s not in stages]
         if missing:
             got, err, _failed = _run_worker(
                 ["probe"] + [m for m in missing if m != "probe"],
@@ -949,6 +1008,9 @@ def main() -> None:
         br = stages.get("bqsr_race")
         if br:
             result.update(br)
+        br8 = stages.get("bqsr_race8")
+        if br8:
+            result.update(br8)
         pl = stages.get("pallas")
         if pl:
             result.update({f"pallas_{k}" if not k.startswith(

@@ -51,9 +51,17 @@ def main() -> int:
             continue
         print(f"[{t0}] tunnel UP — running bench.py", flush=True)
         try:
+            # the watcher's run is the round's main TPU-evidence channel:
+            # give it a bigger budget than the driver's default so every
+            # stage (incl. the 6-leg count race) fits one window with
+            # cold per-worker compiles
+            env = dict(os.environ)
+            env.setdefault("ADAM_TPU_BENCH_TOTAL_BUDGET", "900")
+            budget = float(env["ADAM_TPU_BENCH_TOTAL_BUDGET"])
             rc = subprocess.run(
                 [sys.executable, os.path.join(repo, "bench.py")],
-                timeout=640, capture_output=True, text=True, cwd=repo)
+                timeout=budget + 100, capture_output=True, text=True,
+                cwd=repo, env=env)
         except subprocess.TimeoutExpired:
             print("bench timed out; re-probing", flush=True)
             continue
