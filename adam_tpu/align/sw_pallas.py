@@ -37,7 +37,11 @@ def _sw_body(xs_ref, ys_ref, xlen_ref, ylen_ref, best_ref, *,
     xlen = xlen_ref[:]                                 # [B, 1]
     ylen = ylen_ref[:]                                 # [B, 1]
     B, Ly = ys.shape
-    jvec = jax.lax.broadcasted_iota(jnp.float32, (B, Ly), 1)
+    # Mosaic's tpu.iota is integer-only (the f32 form verifies in the
+    # interpreter but is rejected at real TPU lowering — caught by
+    # tools/aot_check.py); build the float lane index by converting
+    jvec = jax.lax.broadcasted_iota(jnp.int32, (B, Ly), 1).astype(
+        jnp.float32)
     j_alive = jax.lax.broadcasted_iota(jnp.int32, (B, Ly), 1) < ylen
     lane0 = jax.lax.broadcasted_iota(jnp.int32, (B, Ly), 1) == 0
 

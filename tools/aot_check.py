@@ -1,0 +1,155 @@
+"""Tunnel-independent TPU evidence: AOT-lower every product Pallas/XLA
+kernel for the TPU target and record per-kernel status.
+
+``jax.export.export(jit_fn, platforms=["tpu"])`` runs trace + StableHLO
+lowering — and, for Pallas kernels, the Mosaic dialect conversion and
+serialization — without a live device.  A kernel that Mosaic would reject
+(unsupported op, bad layout, rank/tiling constraint) fails HERE, so this
+check retires the "Mosaic might reject the int8 legs" class of risk even
+when the tunnel is down (VERDICT r4, next-round #2).
+
+    JAX_PLATFORMS=cpu python tools/aot_check.py [--out AOT_CHECK.json]
+
+Each kernel gets: ok, lowering wall seconds, serialized-module size (a
+proxy for "the Mosaic payload is really in there"), or the exception.
+The watcher's no-tunnel branch runs this once per round.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+import traceback
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# tunnel-independence is the point: force the CPU client so the check
+# never blocks on (or is invalidated by) tunnel state.  The bare env var
+# is NOT enough — the axon plugin initializes (and touches the tunnel)
+# regardless; platform.force_cpu flips the jax config too.
+from adam_tpu.platform import force_cpu  # noqa: E402
+
+force_cpu()
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+from jax import export  # noqa: E402
+
+
+def S(shape, dtype):
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
+def _read_args(n=64, L=128):
+    """Abstract ReadBatch tensors in the product packer's dtypes
+    (packing.py ReadBatch: int8 bases/quals, int32 scalars, bool valid)."""
+    return dict(
+        bases=S((n, L), jnp.int8), quals=S((n, L), jnp.int8),
+        read_len=S((n,), jnp.int32), flags=S((n,), jnp.int32),
+        read_group=S((n,), jnp.int32), state=S((n, L), jnp.int8),
+        usable=S((n,), jnp.bool_))
+
+
+def kernel_cases():
+    """(name, jit_fn, abstract_args) for every product TPU kernel."""
+    from adam_tpu.align.sw_pallas import sw_score_batch_pallas
+    from adam_tpu.bqsr.count_pallas import (count_kernel_pallas,
+                                            count_kernel_pallas_rows)
+    from adam_tpu.ops import flagstat_pallas as fp
+    from adam_tpu.realign.sweep_pallas import sweep_pallas
+
+    cases = []
+
+    # flagstat v1/v2: the public wrappers split wire into blocked + tail
+    # with host-side (concrete) shape logic, so the jittable surface — and
+    # the thing worth lowering — is the inner blocked kernel + tail path
+    tail = S((100,), jnp.uint32)
+    cases.append(("flagstat_v1",
+                  jax.jit(lambda w3, t: fp._flagstat_blocked(w3, t)),
+                  (S((2, fp.BLOCK_ROWS, fp.LANES), jnp.uint32), tail)))
+    cases.append(("flagstat_v2",
+                  jax.jit(lambda w3, t: fp._flagstat_blocked_v2(w3, t)),
+                  (S((2, fp.V2_ROWS, fp.LANES), jnp.uint32), tail)))
+
+    # BQSR count kernels: product geometry for one read group of 128 bp
+    # reads (n_qual_rg = 60*RG+94, n_cycle = 2L+1 — table.py)
+    ra = _read_args(n=64, L=128)
+    n_qual_rg, n_cycle = 60 + 94, 2 * 128 + 1
+    order = ("bases", "quals", "read_len", "flags", "read_group", "state",
+             "usable")
+    args = tuple(ra[k] for k in order)
+    for name, fn in (("count_flat", count_kernel_pallas),
+                     ("count_rows", count_kernel_pallas_rows)):
+        for tag, int8_mxu in (("bf16", False), ("int8", True)):
+            cases.append((
+                f"{name}_{tag}",
+                jax.jit(lambda *a, _fn=fn, _i8=int8_mxu: _fn(
+                    *a, n_qual_rg=n_qual_rg, n_cycle=n_cycle,
+                    int8_mxu=_i8)),
+                args))
+
+    # realign consensus sweep
+    R, L, CL = 16, 128, 256
+    cases.append(("sweep",
+                  jax.jit(lambda r, q, rl, c, cl: sweep_pallas(
+                      r, q, rl, c, cl)),
+                  (S((R, L), jnp.uint8), S((R, L), jnp.int8),
+                   S((R,), jnp.int32), S((CL,), jnp.uint8),
+                   S((), jnp.int32))))
+
+    # Smith-Waterman scoring
+    N, Lx, Ly = 16, 128, 128
+    cases.append(("sw_score",
+                  jax.jit(lambda xs, xl, ys, yl: sw_score_batch_pallas(
+                      xs, xl, ys, yl)),
+                  (S((N, Lx), jnp.uint8), S((N,), jnp.int32),
+                   S((N, Ly), jnp.uint8), S((N,), jnp.int32))))
+    return cases
+
+
+def check_one(name, fn, args):
+    t0 = time.perf_counter()
+    try:
+        exp = export.export(fn, platforms=["tpu"])(*args)
+        blob = exp.serialize()
+        return {"kernel": name, "ok": True,
+                "lower_s": round(time.perf_counter() - t0, 2),
+                "serialized_bytes": len(blob),
+                "has_tpu_custom_call":
+                    b"tpu_custom_call" in exp.mlir_module_serialized}
+    except Exception as e:  # noqa: BLE001 — per-kernel isolation is the job
+        return {"kernel": name, "ok": False,
+                "lower_s": round(time.perf_counter() - t0, 2),
+                "error": f"{type(e).__name__}: {e}"[:500],
+                "trace_tail": traceback.format_exc().splitlines()[-3:]}
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default="AOT_CHECK.json")
+    args = ap.parse_args()
+    results = [check_one(*c) for c in kernel_cases()]
+    doc = {
+        "what": "AOT TPU lowering status of every product Pallas kernel "
+                "(trace + StableHLO + Mosaic serialization, no device)",
+        "jax_version": jax.__version__,
+        "lowering_platform": "tpu",
+        "client_platform": jax.default_backend(),
+        "captured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "kernels": results,
+        "all_ok": all(r["ok"] for r in results),
+    }
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    with open(os.path.join(repo, args.out), "w") as f:
+        json.dump(doc, f, indent=1)
+    for r in results:
+        print(json.dumps(r))
+    print(f"all_ok={doc['all_ok']}")
+    return 0 if doc["all_ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
