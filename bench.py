@@ -908,73 +908,15 @@ def main() -> None:
     try:
         want = ["probe", "flagstat", "transform", "bqsr_race",
                 "pallas", "bqsr_race8"]
-        attempt = 0
-        cpu_incidental: dict = {}
-        fails: dict = {}
-        skip: set = set()
-        # device attempts: keep retrying the flaky tunnel while budget
-        # lasts; a stage that hangs twice is skipped (not retried forever)
-        # so later stages still get their shot at the device
-        while _remaining() > CPU_RESERVE_S + 60:
-            attempt += 1
-            missing = [s for s in want
-                       if s not in stages and s not in skip]
-            if not missing:
-                break
-            got, err, failed = _run_worker(
-                missing, {}, deadline_s=_remaining() - CPU_RESERVE_S)
-            if got.get("probe", {}).get("platform") not in (None, "tpu"):
-                # a fast tunnel failure silently falls back to the CPU
-                # backend INSIDE the worker; those numbers are fallback
-                # material, not device results — keep retrying the tunnel
-                cpu_incidental |= {k: v for k, v in got.items()
-                                   if k not in cpu_incidental}
-                errors.append(
-                    f"attempt {attempt}: backend fell back to "
-                    f"{got['probe'].get('platform')}")
-                time.sleep(min(10.0, max(0.0,
-                                         _remaining() - CPU_RESERVE_S)))
-                continue
-            stages |= {k: v for k, v in got.items() if k not in stages}
-            if "probe" in got:
-                # the tunnel answered: probe hangs so far were flaps,
-                # not death — only CONSECUTIVE probe hangs may concede
-                fails.pop("probe", None)
-            if err:
-                errors.append(f"attempt {attempt}: {err}")
-                if failed:
-                    fails[failed] = fails.get(failed, 0) + 1
-                    if fails[failed] >= 2:
-                        skip.add(failed)
-                if fails.get("probe", 0) >= 2:
-                    # the tunnel is dead, not flaky: every further
-                    # attempt would burn another probe deadline the CPU
-                    # fallback needs (observed: the fallback's race
-                    # stage starved after two 150 s probe hangs)
-                    break
-                time.sleep(min(10.0, max(0.0,
-                                         _remaining() - CPU_RESERVE_S)))
-            else:
-                break
-        # CPU fallback for whatever never landed (pallas is TPU-only);
-        # incidental CPU results from failed device attempts count first
-        for k, v in cpu_incidental.items():
-            stages.setdefault(k, v)
-        # CPU fallback covers every measurement stage except the one
-        # genuinely TPU-only stage — deriving from `want` keeps a future
-        # stage from being silently dropped (the want[:3] slice bug)
-        missing = [s for s in want
-                   if s not in ("pallas", "bqsr_race8")
-                   and s not in stages]
-        if missing:
-            got, err, _failed = _run_worker(
-                ["probe"] + [m for m in missing if m != "probe"],
-                {"JAX_PLATFORMS": "cpu"},
-                deadline_s=max(_remaining() - 10, 30))
-            for k, v in got.items():
-                stages.setdefault(k, v)
-            if err:
-                errors.append(f"cpu fallback: {err}")
+        # the scheduler (device-retry / skip-after-2 / concede-on-dead-
+        # tunnel / CPU-fallback decisions) lives in benchlib.orchestrate,
+        # pinned hardware-free by tests/test_bench_orchestration.py
+        from benchlib import orchestrate
+        stages, errors = orchestrate(
+            want,
+            lambda missing, env_extra, deadline_s: _run_worker(
+                missing, env_extra, deadline_s=deadline_s),
+            _remaining, CPU_RESERVE_S)
 
         probe = stages.get("probe", {})
         # headline platform = the backend the flagstat number ran on; a TPU

@@ -1,0 +1,178 @@
+"""The bench.py stage scheduler, pinned without hardware (VERDICT r4 #6).
+
+Three scenarios the one tunnel window that matters depends on:
+dead tunnel -> complete CPU-fallback artifact; flapping tunnel -> device
+stages retried, hang-twice stages skipped without starving later ones;
+healthy tunnel -> one worker pass, no fallback.  Plus the in-worker
+CPU-silent-fallback salvage path.
+"""
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from benchlib import TPU_ONLY_STAGES, orchestrate  # noqa: E402
+
+WANT = ["probe", "flagstat", "transform", "bqsr_race", "pallas",
+        "bqsr_race8"]
+
+
+class FakeClock:
+    """remaining() driven by an explicit tick budget: every run_worker
+    call and every sleep burns the seconds the test says it does."""
+
+    def __init__(self, total=520.0, reserve=150.0):
+        self.total = total
+        self.spent = 0.0
+        self.reserve = reserve
+
+    def remaining(self):
+        return self.total - self.spent
+
+    def sleep(self, s):
+        self.spent += s
+
+
+class FakeWorker:
+    """Scripted run_worker: pops one scripted (got, err, failed, cost)
+    outcome per call and records what it was asked to run."""
+
+    def __init__(self, clock, script):
+        self.clock = clock
+        self.script = list(script)
+        self.calls = []
+
+    def __call__(self, stages, env_extra, deadline_s):
+        self.calls.append((list(stages), dict(env_extra), deadline_s))
+        assert deadline_s > 0, "scheduler must never pass a dead deadline"
+        if not self.script:
+            raise AssertionError("worker called more times than scripted")
+        got, err, failed, cost = self.script.pop(0)
+        self.clock.spent += cost
+        return dict(got), err, failed
+
+
+def tpu_probe():
+    return {"probe": {"platform": "tpu", "device_kind": "TPU v5 lite"}}
+
+
+def cpu_probe():
+    return {"probe": {"platform": "cpu"}}
+
+
+def payloads(*names, backend="tpu"):
+    return {n: {"reads_per_sec": 1.0, "backend": backend} for n in names}
+
+
+def test_healthy_tunnel_single_pass_no_fallback():
+    clock = FakeClock()
+    all_stages = tpu_probe() | payloads("flagstat", "transform",
+                                        "bqsr_race", "pallas", "bqsr_race8")
+    worker = FakeWorker(clock, [(all_stages, None, None, 60.0)])
+    stages, errors = orchestrate(WANT, worker, clock.remaining,
+                                 clock.reserve, clock.sleep)
+    assert errors == []
+    assert set(stages) == set(WANT)
+    # one device attempt, no CPU fallback pass
+    assert len(worker.calls) == 1
+    assert worker.calls[0][0] == WANT
+    assert worker.calls[0][1] == {}
+
+
+def test_dead_tunnel_concedes_after_two_probe_hangs_full_cpu_artifact():
+    clock = FakeClock()
+    hang = ({}, "stage probe hung past its deadline", "probe", 150.0)
+    cpu_all = cpu_probe() | payloads("flagstat", "transform", "bqsr_race",
+                                     backend="cpu")
+    worker = FakeWorker(clock, [hang, hang, (cpu_all, None, None, 90.0)])
+    stages, errors = orchestrate(WANT, worker, clock.remaining,
+                                 clock.reserve, clock.sleep)
+    # two device attempts, then concession straight to the CPU pass —
+    # not a third probe deadline that would starve the fallback
+    assert len(worker.calls) == 3
+    assert worker.calls[2][1] == {"JAX_PLATFORMS": "cpu"}
+    # the fallback covers every measurement stage except the TPU-only ones
+    assert set(worker.calls[2][0]) == set(WANT) - set(TPU_ONLY_STAGES)
+    for s in set(WANT) - set(TPU_ONLY_STAGES):
+        assert s in stages
+    assert all(s not in stages for s in TPU_ONLY_STAGES)
+    assert len([e for e in errors if "hung" in e]) == 2
+
+
+def test_flapping_tunnel_retries_missing_only_and_skips_after_two_hangs():
+    clock = FakeClock(total=2000.0)
+    # attempt 1: probe+flagstat land, transform hangs
+    a1 = (tpu_probe() | payloads("flagstat"),
+          "stage transform hung past its deadline", "transform", 120.0)
+    # attempt 2: transform hangs AGAIN -> skipped from then on
+    a2 = (tpu_probe(), "stage transform hung past its deadline",
+          "transform", 120.0)
+    # attempt 3: later stages still get their shot at the device
+    a3 = (tpu_probe() | payloads("bqsr_race", "pallas", "bqsr_race8"),
+          None, None, 120.0)
+    # CPU fallback picks up the skipped transform
+    fb = (cpu_probe() | payloads("transform", backend="cpu"), None, None,
+          60.0)
+    worker = FakeWorker(clock, [a1, a2, a3, fb])
+    stages, errors = orchestrate(WANT, worker, clock.remaining,
+                                 clock.reserve, clock.sleep)
+    # each retry asks only for what is still missing and not skipped
+    # (probe is already in `stages`; the worker re-probes regardless)
+    assert worker.calls[1][0] == ["transform", "bqsr_race", "pallas",
+                                  "bqsr_race8"]
+    assert worker.calls[2][0] == ["bqsr_race", "pallas", "bqsr_race8"]
+    # device results kept; transform came from the CPU fallback
+    assert stages["bqsr_race"]["backend"] == "tpu"
+    assert stages["transform"]["backend"] == "cpu"
+    assert len(errors) == 2
+
+
+def test_probe_fail_counter_resets_on_probe_success():
+    clock = FakeClock(total=3000.0)
+    hang = ({}, "stage probe hung past its deadline", "probe", 150.0)
+    ok_but_flagstat_hangs = (
+        tpu_probe(), "stage flagstat hung past its deadline", "flagstat",
+        150.0)
+    # probe hang, probe OK (resets), probe hang, probe hang -> concede:
+    # four device attempts total, only then the fallback
+    final = (cpu_probe() | payloads("flagstat", "transform", "bqsr_race",
+                                    backend="cpu"), None, None, 60.0)
+    worker = FakeWorker(clock, [hang, ok_but_flagstat_hangs, hang, hang,
+                                final])
+    stages, errors = orchestrate(WANT, worker, clock.remaining,
+                                 clock.reserve, clock.sleep)
+    assert len(worker.calls) == 5
+    assert worker.calls[4][1] == {"JAX_PLATFORMS": "cpu"}
+
+
+def test_in_worker_cpu_fallback_salvaged_not_trusted_as_device():
+    clock = FakeClock()
+    # worker's backend silently fell back to CPU: numbers arrive but must
+    # not count as device results; retry instead
+    silent = (cpu_probe() | payloads("flagstat", backend="cpu"), None,
+              None, 100.0)
+    worker = FakeWorker(clock, [silent, silent, silent,
+                                (cpu_probe(), None, None, 30.0)])
+    stages, errors = orchestrate(WANT, worker, clock.remaining,
+                                 clock.reserve, clock.sleep)
+    # budget exhausted retrying; incidental CPU flagstat still salvaged
+    assert stages["flagstat"]["backend"] == "cpu"
+    assert any("fell back" in e for e in errors)
+    # the salvage must not have suppressed the explicit CPU pass for the
+    # stages the incidental results never covered
+    assert worker.calls[-1][1] == {"JAX_PLATFORMS": "cpu"}
+    assert "transform" in worker.calls[-1][0]
+
+
+def test_no_device_attempt_when_budget_already_inside_reserve():
+    clock = FakeClock(total=200.0, reserve=150.0)  # 200 < 150+60
+    fb = (cpu_probe() | payloads("flagstat", "transform", "bqsr_race",
+                                 backend="cpu"), None, None, 60.0)
+    worker = FakeWorker(clock, [fb])
+    stages, errors = orchestrate(WANT, worker, clock.remaining,
+                                 clock.reserve, clock.sleep)
+    # straight to the CPU fallback — no device attempt could fit
+    assert len(worker.calls) == 1
+    assert worker.calls[0][1] == {"JAX_PLATFORMS": "cpu"}
+    assert stages["flagstat"]["backend"] == "cpu"
