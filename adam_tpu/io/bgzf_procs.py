@@ -1,0 +1,176 @@
+"""Multi-process BGZF inflate: N worker processes, one compressed segment
+range each (VERDICT r4, next-round #7).
+
+``io/bam.iter_decompressed`` already thread-parallelizes member inflate
+(zlib releases the GIL), but one process tops out around one core of
+Python-side glue; the 10 M reads/s ingest model needs ~8 cores of decode
+(round-3 finding: ~450 k reads/s/core).  This module is the process-level
+axis, re-designing ``cli/Bam2Adam.scala:56-97`` (reader thread + N writer
+threads over a blocking queue) as: a cheap no-inflate SEGMENTER pass that
+hops BGZF member headers (BSIZE extra subfield, SAM spec 4.1) to cut the
+compressed byte range into member-aligned segments, then a process pool
+that inflates whole segments independently, with results consumed in
+input order.
+
+Order preservation is structural, not scheduled: segments are contiguous
+compressed ranges, workers never see partial members, and the parent
+yields segment payloads in segment order — so the concatenated output is
+byte-identical to the sequential walk for ANY process count (pinned by
+``tests/test_io_procs.py``).  Record straddling across segment
+boundaries needs no special handling because records are parsed
+downstream from the *joined* byte stream, exactly as with the
+single-process iterator.
+
+Workers are ``spawn``ed, not forked: the parent typically holds a live
+JAX/XLA runtime whose internal threads do not survive fork.
+"""
+
+from __future__ import annotations
+
+import multiprocessing as mp
+import os
+import zlib
+from collections import deque
+from typing import Iterator, List, Tuple
+
+#: default compressed bytes per segment — ~64 MiB decompressed, so
+#: in-flight host RSS is bounded by ``depth x ~4x this``
+SEGMENT_BYTES = 16 << 20
+
+
+def _member_size(buf, off: int):
+    """BGZF member header at ``off`` -> total member size, or None.
+
+    Same parse as ``io/bam._bgzf_member_size``; duplicated here so worker
+    processes import nothing beyond the stdlib (spawn cost, and no
+    pyarrow/numpy in the inflate workers).
+    """
+    if off + 18 > len(buf):
+        return None
+    if buf[off] != 0x1F or buf[off + 1] != 0x8B or not (buf[off + 3] & 4):
+        return None
+    xlen = buf[off + 10] | (buf[off + 11] << 8)
+    p, end = off + 12, off + 12 + xlen
+    if end > len(buf):
+        return None
+    while p + 4 <= end:
+        si1, si2 = buf[p], buf[p + 1]
+        slen = buf[p + 2] | (buf[p + 3] << 8)
+        if si1 == 66 and si2 == 67 and slen == 2:  # 'B','C'
+            return (buf[p + 4] | (buf[p + 5] << 8)) + 1
+        p += 4 + slen
+    return None
+
+
+def iter_segments(path: str, segment_bytes: int = SEGMENT_BYTES
+                  ) -> Iterator[Tuple[int, int]]:
+    """Member-aligned compressed (offset, size) segments of a BGZF file,
+    yielded as the scan discovers them.
+
+    One sequential buffered pass over the COMPRESSED bytes, no inflate:
+    each member header names its own size (BSIZE), so the scan hops
+    header to header.  Lazy on purpose — on a multi-GB input the pool
+    starts inflating the first segments while the tail is still being
+    scanned.  Raises ValueError on non-BGZF input (first yield) or a
+    truncated trailing member (mid-iteration, like the sequential
+    iterator's FormatError).
+    """
+    window = 4 << 20
+    with open(path, "rb") as f:
+        size = os.fstat(f.fileno()).st_size
+        buf = b""
+        base = 0            # file offset of buf[0]
+        off = 0             # current member's file offset
+        seg_start = 0
+        while off < size:
+            # keep a full worst-case header (12 + xlen <= 64 KiB + slack)
+            if off - base + (1 << 17) > len(buf) and base + len(buf) < size:
+                f.seek(off)
+                buf = f.read(window)
+                base = off
+            m = _member_size(buf, off - base)
+            if m is None:
+                raise ValueError(
+                    f"{path}: no BGZF member at offset {off}")
+            off += m
+            if off - seg_start >= segment_bytes:
+                yield (seg_start, off - seg_start)
+                seg_start = off
+        if off != size:
+            raise ValueError(f"{path}: trailing garbage after {off}")
+        if seg_start < size:
+            yield (seg_start, size - seg_start)
+
+
+def scan_segments(path: str, segment_bytes: int = SEGMENT_BYTES
+                  ) -> List[Tuple[int, int]]:
+    """Eager form of :func:`iter_segments` (tests, tooling)."""
+    return list(iter_segments(path, segment_bytes))
+
+
+def _inflate_segment(path: str, off: int, size: int) -> bytes:
+    """Worker: inflate every member in [off, off+size) of ``path``."""
+    with open(path, "rb") as f:
+        f.seek(off)
+        buf = f.read(size)
+    out = []
+    p = 0
+    while p < len(buf):
+        m = _member_size(buf, p)
+        if m is None or p + m > len(buf):
+            raise ValueError(f"{path}: segment [{off},{off + size}) is not "
+                             f"member-aligned at +{p}")
+        xlen = buf[p + 10] | (buf[p + 11] << 8)
+        isize = int.from_bytes(buf[p + m - 4:p + m], "little")
+        out.append(zlib.decompress(buf[p + 12 + xlen:p + m - 8], wbits=-15,
+                                   bufsize=isize or 1))
+        p += m
+    return b"".join(out)
+
+
+def iter_decompressed_procs(path: str, procs: int,
+                            segment_bytes: int = SEGMENT_BYTES,
+                            depth: int = 0) -> Iterator[bytes]:
+    """Decompressed byte chunks of a BGZF file, inflated by ``procs``
+    worker processes; concatenation is byte-identical to
+    ``io/bam.iter_decompressed``.  Non-BGZF inputs (plain gzip, raw)
+    fall back to the sequential iterator.
+
+    At most ``depth`` (default ``procs + 2``) segments are in flight, so
+    host RSS stays bounded regardless of how far inflate outruns the
+    consumer.
+    """
+    from .bam import iter_decompressed
+
+    if procs <= 1:
+        yield from iter_decompressed(path)
+        return
+    it = iter_segments(path, segment_bytes)
+    try:
+        first = next(it, None)
+    except ValueError:
+        # not BGZF (plain gzip / raw): the sequential iterator handles it
+        yield from iter_decompressed(path)
+        return
+    if first is None:
+        return
+
+    depth = depth or procs + 2
+    ctx = mp.get_context("spawn")
+    with ctx.Pool(processes=procs) as pool:
+        pending: deque = deque()
+        pending.append(pool.apply_async(_inflate_segment, (path, *first)))
+        try:
+            # prime the window lazily: the scan overlaps the inflate pool
+            while pending:
+                while len(pending) < depth:
+                    nxt = next(it, None)
+                    if nxt is None:
+                        break
+                    pending.append(pool.apply_async(_inflate_segment,
+                                                    (path, *nxt)))
+                data = pending.popleft().get()
+                if data:
+                    yield data
+        finally:
+            pool.terminate()

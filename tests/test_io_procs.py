@@ -1,0 +1,105 @@
+"""Multi-process BGZF ingest differentials (VERDICT r4 #7): the
+concatenated byte stream — and therefore every downstream decision —
+is bit-identical at any process count."""
+
+import gzip
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from adam_tpu import schema as S
+from adam_tpu.io.bam import iter_decompressed, read_bam, write_bam
+from adam_tpu.io.bgzf_procs import (iter_decompressed_procs, scan_segments)
+from adam_tpu.models.dictionary import (RecordGroupDictionary,
+                                        SequenceDictionary, SequenceRecord)
+
+
+def _synth_bam(path, n_reads=3000, L=80, seed=7):
+    rng = np.random.RandomState(seed)
+    letters = np.frombuffer(b"ACGT", np.uint8)
+    seq_dict = SequenceDictionary([SequenceRecord(0, "chr1", 10_000_000)])
+    seqs = letters[rng.randint(0, 4, (n_reads, L))].view(f"S{L}").ravel()
+    quals = (rng.randint(30, 41, (n_reads, L)) + 33).astype(
+        np.uint8).view(f"S{L}").ravel()
+    cols = {}
+    data = {
+        "readName": pa.array([f"r{i}" for i in range(n_reads)]),
+        "sequence": pa.array(seqs.astype(str)),
+        "qual": pa.array(quals.astype(str)),
+        "cigar": pa.array([f"{L}M"] * n_reads),
+        "referenceId": pa.array(
+            np.zeros(n_reads, np.int32), pa.int32()),
+        "referenceName": pa.array(["chr1"] * n_reads),
+        "start": pa.array(
+            np.sort(rng.randint(0, 9_000_000, n_reads)), pa.int64()),
+        "mapq": pa.array(np.full(n_reads, 60, np.int32), pa.int32()),
+        "flags": pa.array(np.zeros(n_reads, np.int64), pa.int64()),
+    }
+    for name in S.READ_SCHEMA.names:
+        if name in data:
+            cols[name] = data[name].cast(S.READ_SCHEMA.field(name).type)
+        else:
+            cols[name] = pa.nulls(n_reads, S.READ_SCHEMA.field(name).type)
+    table = pa.Table.from_pydict(cols, schema=S.READ_SCHEMA)
+    write_bam(table, seq_dict, str(path), RecordGroupDictionary([]))
+    return table
+
+
+@pytest.fixture(scope="module")
+def bam_path(tmp_path_factory):
+    p = tmp_path_factory.mktemp("iop") / "synth.bam"
+    _synth_bam(p)
+    return p
+
+
+def test_scan_segments_tile_the_file_exactly(bam_path):
+    segs = scan_segments(str(bam_path), segment_bytes=1 << 15)
+    assert len(segs) > 3, "segment_bytes small enough to force >1 segment"
+    pos = 0
+    for off, size in segs:
+        assert off == pos and size > 0
+        pos = off + size
+    assert pos == bam_path.stat().st_size
+
+
+@pytest.mark.parametrize("procs", [2, 3])
+def test_procs_stream_bit_identical(bam_path, procs):
+    seq = b"".join(iter_decompressed(str(bam_path)))
+    par = b"".join(iter_decompressed_procs(str(bam_path), procs,
+                                           segment_bytes=1 << 15))
+    assert par == seq
+
+
+def test_procs_decode_to_identical_tables(bam_path):
+    """End-to-end: records parsed from the multi-process stream equal the
+    sequential read (record straddling across segment cuts included)."""
+    from adam_tpu.io.bam import stream_header, _parse_record, _rows_to_table
+
+    byte_iter = iter_decompressed_procs(str(bam_path), 2,
+                                        segment_bytes=1 << 15)
+    seq_dict, rg_dict, off, buf = stream_header(byte_iter, str(bam_path))
+    rows = []
+    while True:
+        parsed = _parse_record(buf, off, seq_dict, rg_dict)
+        if parsed is None:
+            piece = next(byte_iter, None)
+            if piece is None:
+                break
+            if off:
+                del buf[:off]
+                off = 0
+            buf += piece
+            continue
+        row, off = parsed
+        rows.append(row)
+    got = _rows_to_table(rows)
+    want = read_bam(str(bam_path))[0]
+    assert got.equals(want)
+
+
+def test_non_bgzf_falls_back_to_sequential(tmp_path):
+    p = tmp_path / "plain.gz"
+    payload = b"plain gzip, not bgzf" * 1000
+    p.write_bytes(gzip.compress(payload))
+    assert b"".join(iter_decompressed_procs(str(p), 4)) == payload
