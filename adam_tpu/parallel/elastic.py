@@ -1,0 +1,149 @@
+"""Elastic recovery for the multi-host mesh: job-level restart + resume.
+
+The reference inherits failure recovery from Spark lineage re-execution
+(SURVEY §5: "entirely delegated to Spark").  A `jax.distributed` mesh has
+no per-task lineage — a lost peer wedges every subsequent collective —
+so the TPU-native recovery unit is the JOB: a supervisor (the analog of
+the cluster manager restarting a Spark executor's whole stage) detects
+any worker death, tears the incarnation down, and relaunches the job on
+a RE-FORMED mesh with a fresh coordination service; workers resume from
+the durable checkpoint (checkpoint.py pass-level fingerprints), which
+makes the re-run land on byte-identical output.  This is exactly how
+production TPU pods recover (GKE/Borg job restart + orbax resume) — the
+design the scaling-book recipe assumes — rather than in-place peer
+rejoin, which XLA's SPMD model cannot express mid-program.
+
+Worker-side: a lost peer usually manifests as a HANG (the collective
+waits on DCN), not an error.  ``phase_watchdog`` converts "no progress
+past the deadline" into a prompt nonzero exit the supervisor can see.
+
+``tests/test_elastic_recovery.py`` kills one worker of a two-process
+mesh mid-run and pins recovery-to-correct-output (VERDICT r4 #8).
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, List, Optional, Sequence
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+@dataclass
+class Incarnation:
+    """One launch of the whole job: N processes on one coordinator.
+
+    Worker output goes to the per-worker files in ``logs`` — NOT pipes:
+    an undrained pipe wedges any worker chattier than the OS buffer,
+    which would read as a hang, not a failure."""
+    number: int
+    coordinator: str
+    procs: List[subprocess.Popen] = field(default_factory=list)
+    logs: List[str] = field(default_factory=list)
+
+
+def supervise(argv_for: Callable[[int, str], Sequence[str]],
+              num_processes: int,
+              max_restarts: int = 2,
+              poll_s: float = 0.25,
+              grace_kill_s: float = 5.0,
+              env: Optional[dict] = None,
+              log_dir: Optional[str] = None,
+              on_incarnation: Optional[Callable[[Incarnation], None]] = None,
+              ) -> Incarnation:
+    """Run the N-process job to success, restarting the WHOLE job on any
+    worker death (nonzero exit or signal).
+
+    ``argv_for(process_id, coordinator_address)`` builds each worker's
+    command line.  Each incarnation gets a fresh coordinator port — a
+    re-formed mesh, not a rejoin: the old coordination service dies with
+    the incarnation.  Returns the successful incarnation; raises
+    RuntimeError after ``max_restarts`` failed relaunches.  Durable state
+    (the checkpoint dir the argv points at) is the workers' own
+    responsibility — that is what makes restart = resume.
+    """
+    last_fail = "never launched"
+    log_dir = log_dir or tempfile.mkdtemp(prefix="elastic_logs_")
+    os.makedirs(log_dir, exist_ok=True)
+    for number in range(max_restarts + 1):
+        coordinator = f"127.0.0.1:{free_port()}"
+        inc = Incarnation(number=number, coordinator=coordinator)
+        for pid in range(num_processes):
+            path = os.path.join(log_dir, f"inc{number}-worker{pid}.log")
+            inc.logs.append(path)
+            with open(path, "w") as log:
+                inc.procs.append(subprocess.Popen(
+                    list(argv_for(pid, coordinator)),
+                    stdout=log, stderr=subprocess.STDOUT, env=env))
+        if on_incarnation:
+            on_incarnation(inc)
+        failed: Optional[int] = None
+        while True:
+            codes = [p.poll() for p in inc.procs]
+            bad = [i for i, c in enumerate(codes)
+                   if c is not None and c != 0]
+            if bad:
+                failed = bad[0]
+                break
+            if all(c == 0 for c in codes):
+                return inc
+            time.sleep(poll_s)
+        # one worker died: the mesh is wedged — tear down the whole
+        # incarnation (peers are likely hung inside a collective on the
+        # dead peer, so escalate kill after a grace period)
+        rc = inc.procs[failed].returncode
+        for p in inc.procs:
+            if p.poll() is None:
+                p.terminate()
+        deadline = time.monotonic() + grace_kill_s
+        for p in inc.procs:
+            if p.poll() is None:
+                try:
+                    p.wait(timeout=max(0.0, deadline - time.monotonic()))
+                except subprocess.TimeoutExpired:
+                    p.kill()
+        for p in inc.procs:
+            try:
+                p.wait(timeout=grace_kill_s)
+            except subprocess.TimeoutExpired:
+                pass
+        last_fail = (f"incarnation {number}: worker {failed} exited "
+                     f"rc={rc}")
+    raise RuntimeError(
+        f"job failed after {max_restarts + 1} incarnations ({last_fail})")
+
+
+def phase_watchdog(deadline_s: float, exit_code: int = 17,
+                   note: str = "") -> Callable[[], None]:
+    """Arm a deadline for the current phase; returns a disarm callable.
+
+    A peer lost mid-collective shows up as an indefinite DCN wait, which
+    no in-process exception handler can interrupt — ``os._exit`` from a
+    watchdog thread is the reliable conversion of "hung past deadline"
+    into a worker death the supervisor acts on.
+    """
+    disarmed = threading.Event()
+
+    def fire():
+        if not disarmed.wait(timeout=deadline_s):
+            sys.stderr.write(
+                f"phase_watchdog: {note or 'phase'} exceeded "
+                f"{deadline_s}s — assuming lost peer, exiting "
+                f"{exit_code}\n")
+            sys.stderr.flush()
+            os._exit(exit_code)
+
+    threading.Thread(target=fire, daemon=True,
+                     name="phase-watchdog").start()
+    return disarmed.set

@@ -1,0 +1,113 @@
+"""Worker for the elastic-recovery test (VERDICT r4 #8).
+
+Run as:  python _elastic_worker.py <coordinator> <nproc> <pid> <workdir>
+
+A two-pass checkpointed job over a 2-process (host, chip) mesh:
+
+  pass1:  y = 2x        (sharded elementwise over the mesh)
+  pass2:  z = y + Σy    (global psum across hosts — needs every peer)
+
+Process 0 owns the checkpoint (checkpoint.py CheckpointDir); every
+process reads the manifest at startup so the resume decision — which
+passes to skip — is identical across the mesh (a divergent skip would
+desynchronize the collectives).
+
+Victim protocol: on the FIRST incarnation (marker file absent), process
+1 writes the marker and dies with rc=1 right after pass1 is durably
+checkpointed.  Process 0 then enters pass2's psum against a dead peer —
+the phase watchdog converts that hang into a prompt exit.  The
+supervisor relaunches; the second incarnation resumes from the pass1
+checkpoint and completes.  Success prints "ELASTIC_OK <total>".
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+import numpy as np
+
+
+def main() -> None:
+    coordinator, nproc, pid, workdir = (
+        sys.argv[1], int(sys.argv[2]), int(sys.argv[3]), sys.argv[4])
+    marker = os.path.join(workdir, "victim-died")
+    ckpt_dir = os.path.join(workdir, "ckpt")
+
+    from adam_tpu.platform import force_cpu
+    force_cpu(n_devices=2)
+
+    from adam_tpu.parallel import distributed as D
+    from adam_tpu.parallel.elastic import phase_watchdog
+    D.initialize(coordinator_address=coordinator, num_processes=nproc,
+                 process_id=pid)
+
+    import jax
+    import jax.numpy as jnp
+    import pyarrow as pa
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from adam_tpu.checkpoint import CheckpointDir
+    mesh = D.make_host_mesh()
+    n_dev = nproc * 2
+
+    def device_sum(x_np: np.ndarray) -> int:
+        """Global Σx via a cross-host psum over the (host, chip) mesh."""
+        rows = x_np.reshape(n_dev, -1)
+        local = rows[pid * 2:(pid + 1) * 2]
+        sharding = NamedSharding(mesh, P((D.HOST_AXIS, D.CHIP_AXIS)))
+        arr = jax.make_array_from_process_local_data(
+            sharding, local, global_shape=rows.shape)
+        red = jax.jit(shard_map(
+            lambda x: jax.lax.psum(jnp.sum(x, keepdims=True).reshape(1, 1),
+                                   (D.HOST_AXIS, D.CHIP_AXIS)),
+            mesh=mesh, in_specs=P((D.HOST_AXIS, D.CHIP_AXIS)),
+            out_specs=P()))(arr)
+        return int(np.asarray(red)[0, 0])
+
+    def pass1(table: pa.Table) -> pa.Table:
+        x = table.column("x").to_numpy()
+        return pa.table({"x": x * 2})
+
+    def pass2(table: pa.Table) -> pa.Table:
+        x = table.column("x").to_numpy()
+        return pa.table({"x": x + device_sum(x)})
+
+    config = ["elastic-demo", f"nproc:{nproc}"]
+    ck = CheckpointDir(ckpt_dir, config) if pid == 0 else None
+    # non-owners read the manifest (never write) so every process skips
+    # the same completed passes
+    completed = (ck.completed if ck is not None
+                 else CheckpointDir(ckpt_dir, config).completed)
+
+    names = ["00-pass1", "01-pass2"]
+    fns = [pass1, pass2]
+    table = pa.table({"x": np.arange(32, dtype=np.int64)})
+    start = 0
+    if completed:
+        latest = completed[-1]
+        start = names.index(latest) + 1
+        table = CheckpointDir(ckpt_dir, config).load(latest)
+
+    for i in range(start, len(names)):
+        disarm = phase_watchdog(45.0, note=names[i])
+        table = fns[i](table)
+        # the collective below doubles as a barrier: nobody proceeds (or
+        # dies, for the victim) until every peer finished pass i — which
+        # for i=0 also means the checkpoint write could complete first
+        if ck is not None:
+            ck.save(names[i], table)
+        device_sum(np.zeros(n_dev, np.int64))
+        disarm()
+        if i == 0 and pid == 1 and not os.path.exists(marker):
+            with open(marker, "w") as f:
+                f.write("pass1 done; dying\n")
+            os._exit(1)
+
+    total = int(table.column("x").to_numpy().sum())
+    print(f"ELASTIC_OK {total}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

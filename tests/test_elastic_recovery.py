@@ -1,0 +1,64 @@
+"""Mid-run peer loss on the distributed mesh -> recovery to correct
+output (VERDICT r4 #8).
+
+The scenario Spark's lineage re-execution covered for the reference:
+a two-process (host, chip) mesh runs a checkpointed two-pass job;
+process 1 dies AFTER pass1 is durably checkpointed but BEFORE pass2's
+cross-host psum; the supervisor tears down the wedged incarnation and
+relaunches on a re-formed mesh (fresh coordinator), which resumes from
+the checkpoint and lands on the oracle result.
+
+Heavier than the rest of the suite (two incarnations x two jax
+startups + a watchdog deadline); set ADAM_TPU_SKIP_MULTIPROC=1 to skip.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+import pytest
+
+from adam_tpu.parallel.elastic import supervise
+
+WORKER = os.path.join(os.path.dirname(__file__), "_elastic_worker.py")
+
+
+@pytest.mark.skipif(os.environ.get("ADAM_TPU_SKIP_MULTIPROC") == "1",
+                    reason="multi-process smoke disabled by env")
+def test_peer_loss_recovers_to_correct_output(tmp_path):
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = root + os.pathsep + env.get("PYTHONPATH", "")
+
+    incarnations = []
+
+    def argv_for(pid, coordinator):
+        return [sys.executable, WORKER, coordinator, "2", str(pid),
+                str(tmp_path)]
+
+    inc = supervise(argv_for, num_processes=2, max_restarts=2, env=env,
+                    log_dir=str(tmp_path / "logs"),
+                    on_incarnation=incarnations.append)
+
+    # the victim really did die mid-run and a restart really happened
+    assert os.path.exists(tmp_path / "victim-died")
+    assert inc.number == 1, "expected exactly one relaunch"
+    assert len(incarnations) == 2
+
+    # oracle: x=arange(32); pass1 doubles (sum 992); pass2 adds the
+    # global psum to every row -> total = 992 + 32*992
+    expect = 992 * 33
+    for path in inc.logs[-2:]:           # the successful incarnation's logs
+        with open(path) as f:
+            out = f.read()
+        assert f"ELASTIC_OK {expect}" in out, out
+
+    # resume came from the checkpoint, not a silent recompute of pass1:
+    # the manifest must already have had 00-pass1 when incarnation 1 began
+    import json
+    with open(tmp_path / "ckpt" / "checkpoint.json") as f:
+        manifest = json.load(f)
+    assert manifest["completed"] == ["00-pass1", "01-pass2"]
