@@ -58,6 +58,13 @@ def main() -> int:
             # cold per-worker compiles
             env = dict(os.environ)
             env.setdefault("ADAM_TPU_BENCH_TOTAL_BUDGET", "900")
+            # flap resilience (r5): the 51.5M-read default packs+ships a
+            # 206 MB wire ×3 through a tunnel that stalls on minute
+            # scales — the exact shape of r5-window-1's flagstat hang.
+            # 12M reads (48 MB) measures the same per-read rates with
+            # 4x less stall exposure; rates are size-independent past
+            # ~4M reads (one resident chain block).
+            env.setdefault("ADAM_TPU_BENCH_FLAGSTAT_READS", "12000000")
             budget = float(env["ADAM_TPU_BENCH_TOTAL_BUDGET"])
             rc = subprocess.run(
                 [sys.executable, os.path.join(repo, "bench.py")],
