@@ -690,6 +690,23 @@ def compute_table(table: pa.Table, batch: Optional[ReadBatch] = None,
     return tables_to_recal(out, n_read_groups, batch.max_len)
 
 
+def _recalibrated_qual(reported, k, cyc, ctx, rg_delta, qual_delta,
+                       cycle_delta, ctx_delta, rg_of_qualrg):
+    """RecalUtil.recalibrate (:31-42): reported error + the delta chain
+    -> truncated new phred.  THE one copy of the formula — both the
+    per-base kernel and the LUT grid builder evaluate it, which is what
+    makes their bit-identity structural rather than hand-synchronized.
+    Flat gathers keep the lookup O(elements), never [.., NC]."""
+    n_cycle = cycle_delta.shape[1]
+    n_ctx = ctx_delta.shape[1]
+    p = reported + rg_delta[rg_of_qualrg[k]] + qual_delta[k] + \
+        cycle_delta.reshape(-1)[k * n_cycle + cyc] + \
+        ctx_delta.reshape(-1)[k * n_ctx + ctx]
+    from .covariates import MIN_REASONABLE_ERROR
+    p = jnp.clip(p, MIN_REASONABLE_ERROR, 1.0)
+    return jnp.trunc(-10.0 * jnp.log10(p)).astype(jnp.int8)
+
+
 @partial(jax.jit, static_argnames=())
 def _apply_kernel(bases, quals, read_len, flags, read_group, recal_mask,
                   rg_delta, qual_delta, cycle_delta, ctx_delta, rg_of_qualrg):
@@ -698,34 +715,74 @@ def _apply_kernel(bases, quals, read_len, flags, read_group, recal_mask,
     Q = qual_delta.shape[0]
     k = jnp.clip(cov["qual_rg"], 0, Q - 1)
     cyc = jnp.clip(cov["cycle_idx"], 0, cycle_delta.shape[1] - 1)
-    ctx = cov["context"]
     err_lut = jnp.asarray(PHRED_TO_ERROR)
     reported = err_lut[jnp.clip(quals.astype(jnp.int32), 0, 255)]
-    # flat gathers keep the lookup O(N*L) instead of materializing [N,L,NC]
+    new_q = _recalibrated_qual(reported, k, cyc, cov["context"], rg_delta,
+                               qual_delta, cycle_delta, ctx_delta,
+                               rg_of_qualrg)
+    recal = cov["in_window"] & recal_mask[:, None]
+    return jnp.where(recal, new_q, quals)
+
+
+@partial(jax.jit, static_argnames=("n_rg",))
+def _build_apply_lut(n_rg: int, rg_delta, qual_delta, cycle_delta,
+                     ctx_delta, rg_of_qualrg):
+    """[128*n_rg*n_cycle*17] int8 new-qual table: the recalibrated qual
+    is a pure function of (raw qual, read group, cycle bin, context), so
+    evaluate ``_apply_kernel``'s EXACT expression once over the
+    enumerated grid — same jnp ops, same backend, same precision — and
+    pass 2 becomes one int8 gather per base.  Bit-identity with the
+    per-base kernel is by construction (and differential-pinned).
+
+    Grid axes carry raw qual and read group separately (not the fused
+    qual_rg index): ``reported`` reads the RAW qual while the delta
+    lookups read the clipped fused index, so a k-only table would alias
+    quals >= MAX_REASONABLE_QSCORE across neighboring read groups.
+    """
+    Q = qual_delta.shape[0]
     n_cycle = cycle_delta.shape[1]
     n_ctx = ctx_delta.shape[1]
-    p = reported + rg_delta[rg_of_qualrg[k]] + qual_delta[k] + \
-        cycle_delta.reshape(-1)[k * n_cycle + cyc] + \
-        ctx_delta.reshape(-1)[k * n_ctx + ctx]
-    from .covariates import MIN_REASONABLE_ERROR
-    p = jnp.clip(p, MIN_REASONABLE_ERROR, 1.0)
-    new_q = jnp.trunc(-10.0 * jnp.log10(p)).astype(jnp.int8)
+    q = jnp.arange(128, dtype=jnp.int32)[:, None, None, None]
+    rg = jnp.arange(n_rg, dtype=jnp.int32)[None, :, None, None]
+    cyc = jnp.arange(n_cycle, dtype=jnp.int32)[None, None, :, None]
+    ctx = jnp.arange(n_ctx, dtype=jnp.int32)[None, None, None, :]
+    k = jnp.clip(q + MAX_REASONABLE_QSCORE * rg, 0, Q - 1)
+    err_lut = jnp.asarray(PHRED_TO_ERROR)
+    reported = err_lut[q]
+    return _recalibrated_qual(reported, k, cyc, ctx, rg_delta, qual_delta,
+                              cycle_delta, ctx_delta,
+                              rg_of_qualrg).reshape(-1)
+
+
+@partial(jax.jit, static_argnames=("n_rg",))
+def _apply_kernel_lut(bases, quals, read_len, flags, read_group,
+                      recal_mask, lut, n_rg: int):
+    """Pass-2 via the precomputed new-qual LUT: covariates + ONE gather
+    (vs three flat delta gathers + log10 per base in ``_apply_kernel``)."""
+    from .covariates import N_CONTEXT
+    cov = covariate_tensors(bases, quals, read_len, flags, read_group)
+    n_ctx = N_CONTEXT
+    n_cycle = lut.shape[0] // (128 * n_rg * n_ctx)
+    iq = jnp.clip(quals.astype(jnp.int32), 0, 127)
+    irg = jnp.clip(jnp.maximum(read_group, 0), 0, n_rg - 1)[:, None]
+    cyc = jnp.clip(cov["cycle_idx"], 0, n_cycle - 1)
+    idx = ((iq * n_rg + irg) * n_cycle + cyc) * n_ctx + cov["context"]
+    new_q = lut[idx]
     recal = cov["in_window"] & recal_mask[:, None]
     return jnp.where(recal, new_q, quals)
 
 
 @lru_cache(maxsize=8)
-def _sharded_apply_fn(mesh):
-    """Cached shard_map+jit of the apply gather kernel: reads shard over
-    the mesh, the delta tables replicate (the reference's broadcast
-    variable)."""
+def _sharded_apply_fn(mesh, n_rg: int):
+    """Cached shard_map+jit of the LUT apply kernel: reads shard over
+    the mesh, the LUT replicates (the reference's broadcast variable)."""
     from jax.sharding import PartitionSpec as P
 
     from ..parallel.mesh import READS_AXIS
     spec = P(READS_AXIS)
     return jax.jit(jax.shard_map(
-        _apply_kernel, mesh=mesh,
-        in_specs=(spec,) * 6 + (P(),) * 5, out_specs=spec))
+        partial(_apply_kernel_lut, n_rg=n_rg), mesh=mesh,
+        in_specs=(spec,) * 6 + (P(),), out_specs=spec))
 
 
 def apply_table(rt: RecalTable, table: pa.Table,
@@ -743,33 +800,40 @@ def apply_table(rt: RecalTable, table: pa.Table,
         ((flags_np & S.FLAG_SECONDARY) == 0) & \
         ((flags_np & S.FLAG_DUPLICATE) == 0) & np.asarray(batch.valid)
 
-    fin_dev = (jnp.asarray(fin.rg_delta), jnp.asarray(fin.qual_delta),
-               jnp.asarray(fin.cycle_delta), jnp.asarray(fin.ctx_delta),
-               jnp.asarray(fin.rg_of_qualrg))
+    # one small grid eval per chunk turns pass 2 into covariates + a
+    # single int8 gather (the delta math and log10 happen 128*n_rg*NC*17
+    # times instead of once per base); bit-identical to _apply_kernel by
+    # construction — the grid runs the same expression on the same
+    # backend (differential-pinned in tests/test_bqsr_apply_lut.py)
+    n_rg = max(rt.n_read_groups, 1)
+    lut = _build_apply_lut(
+        n_rg, jnp.asarray(fin.rg_delta), jnp.asarray(fin.qual_delta),
+        jnp.asarray(fin.cycle_delta), jnp.asarray(fin.ctx_delta),
+        jnp.asarray(fin.rg_of_qualrg))
 
     def slab_args(b, mask):
         return (jnp.asarray(b.bases), jnp.asarray(b.quals),
                 jnp.asarray(b.read_len), jnp.asarray(b.flags),
-                jnp.asarray(b.read_group), jnp.asarray(mask)) + fin_dev
+                jnp.asarray(b.read_group), jnp.asarray(mask), lut)
 
     sharded = mesh is not None and mesh.size > 1 and \
         batch.n_reads % mesh.size == 0
     slab = _count_slab_rows()
     if sharded:
-        new_quals = np.asarray(
-            _sharded_apply_fn(mesh)(*slab_args(batch, recal_mask)))[:n]
+        new_quals = np.asarray(_sharded_apply_fn(mesh, n_rg)(
+            *slab_args(batch, recal_mask)))[:n]
     elif batch.n_reads > slab:
         # same bounded-working-set walk as pass 1 (the apply gathers
         # materialize the identical [rows, L] covariate tensors); per-row
         # output, so slab concatenation is trivially the monolithic result
-        parts = [np.asarray(_apply_kernel(
+        parts = [np.asarray(_apply_kernel_lut(
             *slab_args(batch.row_slice(s, min(s + slab, batch.n_reads)),
-                       recal_mask[s:s + slab])))
+                       recal_mask[s:s + slab]), n_rg=n_rg))
             for s in range(0, batch.n_reads, slab)]
         new_quals = np.concatenate(parts, axis=0)[:n]
     else:
-        new_quals = np.asarray(
-            _apply_kernel(*slab_args(batch, recal_mask)))[:n]
+        new_quals = np.asarray(_apply_kernel_lut(
+            *slab_args(batch, recal_mask), n_rg=n_rg))[:n]
 
     read_len = np.asarray(batch.read_len[:n], np.int64)
     old_col = table.column("qual").combine_chunks()
