@@ -89,6 +89,9 @@ class FlagStatCommand(Command):
         p.add_argument("-io_threads", type=int, default=1,
                        help="overlap host decode with device dispatch "
                             "(reader thread + pack pool; >1 enables)")
+        p.add_argument("-io_procs", type=int, default=1,
+                       help="BGZF inflate worker processes (>1 enables; "
+                            "byte-identical stream)")
 
     def run(self, args) -> int:
         from ..ops.flagstat import format_report
@@ -98,7 +101,8 @@ class FlagStatCommand(Command):
         # 13-field projection, cli/FlagStat.scala:50-57) through the mesh
         failed, passed = streaming_flagstat(args.input,
                                             chunk_rows=args.chunk_rows,
-                                            io_threads=args.io_threads)
+                                            io_threads=args.io_threads,
+                                            io_procs=args.io_procs)
         print(format_report(failed, passed))
         return 0
 
@@ -168,6 +172,10 @@ class TransformCommand(Command):
                        help="overlap host decode+pack with device "
                             "dispatch in every streaming pass (reader "
                             "thread + pack pool; output is bit-identical)")
+        p.add_argument("-io_procs", type=int, default=1,
+                       help="BGZF inflate worker processes for the "
+                            "ingest pass (>1 enables; bit-identical "
+                            "output — the byte stream is unchanged)")
         p.add_argument("-workdir", default=None,
                        help="scratch directory for streamed spills "
                             "(default: a temp dir)")
@@ -211,7 +219,8 @@ class TransformCommand(Command):
                 use_dictionary=pw["use_dictionary"],
                 row_group_bytes=args.parquet_block_size,
                 resume=bool(args.checkpoint_dir),
-                io_threads=args.io_threads)
+                io_threads=args.io_threads,
+                io_procs=args.io_procs)
             if args.timing:
                 from ..instrument import report
                 print(report().format())

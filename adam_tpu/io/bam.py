@@ -179,14 +179,23 @@ def _iter_decompressed_bgzf(f, chunk_bytes: int):
                 yield chunk
 
 
-def iter_decompressed(path, chunk_bytes: int = 1 << 24):
+def iter_decompressed(path, chunk_bytes: int = 1 << 24, procs: int = 1):
     """Stream a (possibly BGZF-compressed) file as decompressed byte chunks.
 
     The whole-file :func:`load_decompressed` holds the full decompressed BAM
     in memory; this generator bounds host RSS for multi-GB inputs.  BGZF
     inputs (the normal case) decompress member-parallel across a thread
     pool; plain whole-file gzip falls back to sequential streaming.
+
+    ``procs > 1`` inflates member-aligned compressed segments across a
+    process pool instead (``io/bgzf_procs``) — byte-identical stream,
+    process-level decode parallelism.
     """
+    if procs > 1:
+        from .bgzf_procs import iter_decompressed_procs
+        yield from iter_decompressed_procs(path, procs,
+                                           chunk_bytes=chunk_bytes)
+        return
     with open(path, "rb") as f:
         head = f.read(18)
         f.seek(0)
@@ -349,7 +358,7 @@ def stream_header(byte_iter, path):
 
 
 def open_bam_stream(path, chunk_rows: int = 1 << 20,
-                    chunk_bytes: int = 1 << 24):
+                    chunk_bytes: int = 1 << 24, io_procs: int = 1):
     """(seq_dict, rg_dict, generator of Arrow tables) over a streamed BAM.
 
     Host memory stays bounded by chunk size: bytes decompress incrementally
@@ -358,7 +367,7 @@ def open_bam_stream(path, chunk_rows: int = 1 << 20,
     """
     from ..errors import FormatError
 
-    byte_iter = iter_decompressed(path, chunk_bytes)
+    byte_iter = iter_decompressed(path, chunk_bytes, procs=io_procs)
     seq_dict, rg_dict, off, buf = stream_header(byte_iter, path)
 
     def gen():

@@ -129,28 +129,35 @@ def _inflate_segment(path: str, off: int, size: int) -> bytes:
 
 
 def iter_decompressed_procs(path: str, procs: int,
-                            segment_bytes: int = SEGMENT_BYTES,
-                            depth: int = 0) -> Iterator[bytes]:
+                            segment_bytes: int = 0,
+                            depth: int = 0,
+                            chunk_bytes: int = 1 << 24) -> Iterator[bytes]:
     """Decompressed byte chunks of a BGZF file, inflated by ``procs``
     worker processes; concatenation is byte-identical to
     ``io/bam.iter_decompressed``.  Non-BGZF inputs (plain gzip, raw)
-    fall back to the sequential iterator.
+    fall back to the sequential iterator (which honors ``chunk_bytes``).
 
-    At most ``depth`` (default ``procs + 2``) segments are in flight, so
-    host RSS stays bounded regardless of how far inflate outruns the
-    consumer.
+    Yielded chunks are one decompressed segment each; segments default
+    to ~``chunk_bytes/4`` of compressed bytes (BGZF compresses BAM ~4x),
+    so the caller's per-chunk memory expectation carries over.  At most
+    ``depth`` (default ``procs + 2``) segments are in flight, so host
+    RSS stays bounded by ~``depth x chunk_bytes`` regardless of how far
+    inflate outruns the consumer.
     """
     from .bam import iter_decompressed
 
     if procs <= 1:
-        yield from iter_decompressed(path)
+        yield from iter_decompressed(path, chunk_bytes)
         return
+    if not segment_bytes:
+        # module attr read at call time, so tests can shrink segments
+        segment_bytes = min(SEGMENT_BYTES, max(1 << 16, chunk_bytes // 4))
     it = iter_segments(path, segment_bytes)
     try:
         first = next(it, None)
     except ValueError:
         # not BGZF (plain gzip / raw): the sequential iterator handles it
-        yield from iter_decompressed(path)
+        yield from iter_decompressed(path, chunk_bytes)
         return
     if first is None:
         return

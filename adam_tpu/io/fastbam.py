@@ -197,20 +197,21 @@ def _arrow_chunk_table(n, fixed, offs, vals, blobs, needs_py, seq_dict,
 
 
 def open_bam_arrow_stream(path, *, chunk_rows: int = 1 << 20,
-                          chunk_bytes: int = 1 << 24):
+                          chunk_bytes: int = 1 << 24, io_procs: int = 1):
     """(seq_dict, rg_dict, generator of Arrow tables) — native fast path.
 
     The C decoder (native/packer.c decode_arrow) emits string columns as
     offsets+data blobs that pyarrow wraps zero-copy; measured ~50x the pure
     Python record parser.  Falls back to ``open_bam_stream`` without the
-    extension.
+    extension.  ``io_procs > 1`` inflates BGZF across a process pool
+    (byte-identical stream — io/bgzf_procs).
     """
     from .bam import open_bam_stream
 
     if _native is None:
         return open_bam_stream(path, chunk_rows=chunk_rows,
-                               chunk_bytes=chunk_bytes)
-    byte_iter = iter_decompressed(path, chunk_bytes)
+                               chunk_bytes=chunk_bytes, io_procs=io_procs)
+    byte_iter = iter_decompressed(path, chunk_bytes, procs=io_procs)
     seq_dict, rg_dict, off, buf = stream_header(byte_iter, path)
 
     def decode(buf, off):
@@ -372,7 +373,7 @@ def _stream_records(path, byte_iter, buf0, off0, chunk_bytes, decode):
 
 
 def open_bam_wire32_stream(path, *, chunk_rows: int = 1 << 22,
-                           chunk_bytes: int = 1 << 24):
+                           chunk_bytes: int = 1 << 24, io_procs: int = 1):
     """Generator of uint32 flagstat wire-word chunks straight from BAM
     bytes — the 4 fields flagstat consumes live at fixed offsets in each
     record, so the native walk emits the wire with NO name/seq/qual/cigar
@@ -383,7 +384,7 @@ def open_bam_wire32_stream(path, *, chunk_rows: int = 1 << 22,
     """
     if _native is None or not hasattr(_native, "flagstat_wire_chunk"):
         return None
-    byte_iter = iter_decompressed(path, chunk_bytes)
+    byte_iter = iter_decompressed(path, chunk_bytes, procs=io_procs)
     _sd, _rg, off0, buf0 = stream_header(byte_iter, path)
 
     def decode(buf, off):

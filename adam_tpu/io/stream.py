@@ -53,12 +53,17 @@ def _projected(chunks, columns, filters):
 
 def open_read_stream(path: str, *, columns: Optional[Sequence[str]] = None,
                      filters=None,
-                     chunk_rows: int = DEFAULT_CHUNK_ROWS) -> ReadStream:
-    """Open SAM/BAM/Parquet reads as a bounded-memory chunk stream."""
+                     chunk_rows: int = DEFAULT_CHUNK_ROWS,
+                     io_procs: int = 1) -> ReadStream:
+    """Open SAM/BAM/Parquet reads as a bounded-memory chunk stream.
+
+    ``io_procs > 1`` inflates BGZF (.bam) across a process pool — the
+    byte stream is identical, decode just stops being one-core-bound."""
     p = str(path)
     if p.endswith(".bam"):
         from .fastbam import open_bam_arrow_stream
-        sd, rg, gen = open_bam_arrow_stream(p, chunk_rows=chunk_rows)
+        sd, rg, gen = open_bam_arrow_stream(p, chunk_rows=chunk_rows,
+                                            io_procs=io_procs)
         return ReadStream(_projected(gen, columns, filters), sd, rg)
     if p.endswith(".sam"):
         from .sam import open_sam_stream

@@ -53,7 +53,7 @@ def _wire32_from_table(table: pa.Table) -> np.ndarray:
 
 
 def streaming_flagstat(path: str, *, mesh=None, chunk_rows: int = 1 << 22,
-                       io_threads: int = 1
+                       io_threads: int = 1, io_procs: int = 1
                        ) -> Tuple["FlagStatMetrics", "FlagStatMetrics"]:
     """Chunked, mesh-sharded flagstat over any reads input.
 
@@ -103,10 +103,12 @@ def streaming_flagstat(path: str, *, mesh=None, chunk_rows: int = 1 << 22,
     if path.endswith(".bam") and \
             os.environ.get("ADAM_TPU_FLAGSTAT_DECODE", "auto") != "arrow":
         from ..io.fastbam import open_bam_wire32_stream
-        wire_chunks = open_bam_wire32_stream(path, chunk_rows=chunk_rows)
+        wire_chunks = open_bam_wire32_stream(path, chunk_rows=chunk_rows,
+                                             io_procs=io_procs)
     if wire_chunks is None:
         stream = open_read_stream(path, columns=FLAGSTAT_COLUMNS,
-                                  chunk_rows=chunk_rows)
+                                  chunk_rows=chunk_rows,
+                                  io_procs=io_procs)
         wire_chunks = (_wire32_from_table(t) for t in stream)
     if io_threads > 1:
         # decode (native wire walk / Arrow projection) moves to a reader
@@ -410,7 +412,8 @@ def streaming_transform(input_path: str, output_path: str, *,
                         use_dictionary: bool = True,
                         row_group_bytes: Optional[int] = None,
                         resume: bool = False,
-                        io_threads: int = 1) -> int:
+                        io_threads: int = 1,
+                        io_procs: int = 1) -> int:
     """The ``transform`` pipeline over a chunked stream and a device mesh.
 
     Multi-pass, like the reference's shuffle stages (Transform.scala:62-97):
@@ -541,7 +544,8 @@ def streaming_transform(input_path: str, output_path: str, *,
         if ck is not None and not p1_skipped:
             ck.clean_unless("p1", "raw", "dup.npy")
         stream = [] if p1_skipped else \
-            open_read_stream(input_path, chunk_rows=chunk_rows)
+            open_read_stream(input_path, chunk_rows=chunk_rows,
+                             io_procs=io_procs)
         keys = _MarkdupKeys(mesh) if (markdup and not p1_skipped) else None
         seq_seen: dict = {}
         raw_writer = None if (is_parquet or p1_skipped) else DatasetWriter(

@@ -103,3 +103,26 @@ def test_non_bgzf_falls_back_to_sequential(tmp_path):
     payload = b"plain gzip, not bgzf" * 1000
     p.write_bytes(gzip.compress(payload))
     assert b"".join(iter_decompressed_procs(str(p), 4)) == payload
+
+
+def test_streaming_transform_bit_identical_with_io_procs(bam_path,
+                                                         tmp_path,
+                                                         monkeypatch):
+    """The product path end-to-end: -io_procs must not change one byte
+    of transform output (VERDICT r4 #7 differential pin)."""
+    from adam_tpu.io.parquet import load_table
+    from adam_tpu.parallel.pipeline import streaming_transform
+
+    # small segments so the 2-process pool really splits the input
+    from adam_tpu.io import bgzf_procs
+    monkeypatch.setattr(bgzf_procs, "SEGMENT_BYTES", 1 << 15)
+
+    outs = []
+    for procs in (1, 2):
+        out = tmp_path / f"out{procs}"
+        streaming_transform(
+            str(bam_path), str(out), markdup=True, bqsr=True, sort=True,
+            workdir=str(tmp_path / f"wk{procs}"), chunk_rows=1 << 10,
+            io_procs=procs)
+        outs.append(load_table(str(out)))
+    assert outs[0].equals(outs[1])
