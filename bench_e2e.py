@@ -103,7 +103,13 @@ def synth_bam(path: str, n_reads: int, seed: int = 0) -> dict:
     }
 
 
-def run(n_reads: int, chunk_rows: int) -> dict:
+def run(n_reads: int, chunk_rows: int, repeat: int = 1) -> dict:
+    """Synthesize once, run the transform ``repeat`` times.
+
+    The number of record is the MEDIAN wall (VERDICT r4 #5: a best-of-
+    window headline exceeded both committed evidence runs on this
+    ±40%-variance 1-core box); all runs ship in the artifact.
+    """
     from adam_tpu.platform import honor_platform_env
     honor_platform_env()      # axon plugin ignores bare JAX_PLATFORMS=cpu
     import jax
@@ -122,27 +128,47 @@ def run(n_reads: int, chunk_rows: int) -> dict:
     stats["device_kind"] = getattr(jax.devices()[0], "device_kind", "?")
     stats["chunk_rows"] = chunk_rows
 
-    out_ds = os.path.join(tmp, "out")
-    t0 = time.perf_counter()
-    n = streaming_transform(
-        bam, out_ds, markdup=True, bqsr=True, sort=True,
-        workdir=os.path.join(tmp, "wk"), chunk_rows=chunk_rows)
-    wall = time.perf_counter() - t0
-    assert n == n_reads
-    stats["transform_wall_s"] = round(wall, 1)
-    stats["reads_per_sec"] = round(n_reads / wall)
-
-    stages = {}
-
-    def walk(node, prefix=""):
-        for name, child in node.children.items():
-            stages[prefix + name] = round(child.seconds, 2)
-            walk(child, prefix + name + "/")
-    walk(report().root)
-    stats["stages_s"] = stages
-    accounted = sum(v for k, v in stages.items() if "/" not in k)
-    stats["unaccounted_s"] = round(wall - accounted, 1)
+    walls = []
+    stages_per_run = []
     import shutil
+    for r in range(max(repeat, 1)):
+        out_ds = os.path.join(tmp, f"out{r}")
+        wk = os.path.join(tmp, f"wk{r}")
+        report().reset()
+        t0 = time.perf_counter()
+        n = streaming_transform(
+            bam, out_ds, markdup=True, bqsr=True, sort=True,
+            workdir=wk, chunk_rows=chunk_rows)
+        walls.append(time.perf_counter() - t0)
+        assert n == n_reads
+
+        stages = {}
+
+        def walk(node, prefix=""):
+            for name, child in node.children.items():
+                stages[prefix + name] = round(child.seconds, 2)
+                walk(child, prefix + name + "/")
+        walk(report().root)
+        stages_per_run.append(stages)
+        shutil.rmtree(out_ds, ignore_errors=True)
+        shutil.rmtree(wk, ignore_errors=True)
+
+    # headline = the median RUN's wall (lower-middle for even N): an
+    # actual run, so headline, stage attribution, and runs_wall_s stay
+    # consistent — an interpolated statistics.median would re-create the
+    # "headline matches no committed run" problem this flag fixes
+    med_idx = walls.index(sorted(walls)[(len(walls) - 1) // 2])
+    med = walls[med_idx]
+    stats["transform_wall_s"] = round(med, 1)
+    stats["reads_per_sec"] = round(n_reads / med)
+    stats["n_runs"] = len(walls)
+    stats["runs_wall_s"] = [round(w, 1) for w in walls]
+    stats["wall_min_s"] = round(min(walls), 1)
+    stats["wall_max_s"] = round(max(walls), 1)
+    stats["stages_s"] = stages_per_run[med_idx]
+    accounted = sum(v for k, v in stats["stages_s"].items()
+                    if "/" not in k)
+    stats["unaccounted_s"] = round(walls[med_idx] - accounted, 1)
     shutil.rmtree(tmp, ignore_errors=True)
     return stats
 
@@ -151,9 +177,12 @@ def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--reads", type=int, default=2_000_000)
     ap.add_argument("--chunk-rows", type=int, default=1 << 20)
+    ap.add_argument("--repeat", type=int, default=1,
+                    help="run the transform N times over one synthesis; "
+                         "the headline is the median wall")
     ap.add_argument("--out", default=None)
     args = ap.parse_args()
-    stats = run(args.reads, args.chunk_rows)
+    stats = run(args.reads, args.chunk_rows, repeat=args.repeat)
     doc = json.dumps(stats, indent=1)
     print(doc)
     if args.out:
