@@ -59,6 +59,9 @@ def main(argv=None) -> int:
     if not getattr(args, "_cmd", None):
         parser.print_help()
         return 1
+    # every command compiles the same kernels; persist them across runs
+    from ..platform import enable_compilation_cache
+    enable_compilation_cache()
     # after parsing (so --help stays jax-import-free), before any command
     # can initialize a backend
     _honor_platform_env()

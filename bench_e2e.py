@@ -110,8 +110,10 @@ def run(n_reads: int, chunk_rows: int, repeat: int = 1) -> dict:
     window headline exceeded both committed evidence runs on this
     ±40%-variance 1-core box); all runs ship in the artifact.
     """
-    from adam_tpu.platform import honor_platform_env
+    from adam_tpu.platform import enable_compilation_cache, \
+        honor_platform_env
     honor_platform_env()      # axon plugin ignores bare JAX_PLATFORMS=cpu
+    enable_compilation_cache()   # measure the product as shipped
     import jax
 
     from adam_tpu.instrument import report, set_sync_timing
