@@ -108,10 +108,15 @@ def probe_backends():
 
 
 def probe_apply():
+    """Old per-base delta-gather apply vs the r5 LUT apply, on chip —
+    the LUT won 1.65x on CPU; this says whether the chip agrees (fewer
+    big gathers should matter MORE on TPU)."""
     import jax
     import jax.numpy as jnp
 
-    from adam_tpu.bqsr.recalibrate import _apply_kernel
+    from adam_tpu.bqsr.recalibrate import (_apply_kernel,
+                                           _apply_kernel_lut,
+                                           _build_apply_lut)
     from adam_tpu.bqsr.table import RecalTable
 
     L, n_rg, n = 100, 4, 262144
@@ -122,17 +127,26 @@ def probe_apply():
         fin.rg_of_qualrg))
     a = _count_args(n, L, n_rg)
     mask = jnp.ones((n,), bool)
-    t0 = t()
-    out = _apply_kernel(a[0], a[1], a[2], a[3], a[4], mask, *fin_dev)
-    jax.device_get(out[:1, :1])
-    compile_s = t() - t0
-    t0 = t()
-    for _ in range(8):
-        out = _apply_kernel(a[0], a[1], a[2], a[3], a[4], mask, *fin_dev)
-    jax.device_get(out[:1, :1])
-    run_s = (t() - t0) / 8
-    emit("apply", n_reads=n, compile_s=round(compile_s, 1),
-         reads_per_sec=round(n / run_s))
+
+    def run(label, fn):
+        t0 = t()
+        out = fn()
+        jax.device_get(out[:1, :1])
+        compile_s = t() - t0
+        t0 = t()
+        for _ in range(8):
+            out = fn()
+        jax.device_get(out[:1, :1])
+        run_s = (t() - t0) / 8
+        emit("apply", variant=label, n_reads=n,
+             compile_s=round(compile_s, 1),
+             reads_per_sec=round(n / run_s))
+
+    run("gather", lambda: _apply_kernel(a[0], a[1], a[2], a[3], a[4],
+                                        mask, *fin_dev))
+    lut = _build_apply_lut(n_rg, *fin_dev)
+    run("lut", lambda: _apply_kernel_lut(a[0], a[1], a[2], a[3], a[4],
+                                         mask, lut, n_rg=n_rg))
 
 
 def probe_pallas_kernels():
