@@ -78,25 +78,27 @@ def covariate_tensors(bases, quals, read_len, flags, read_group):
 
     b = bases.astype(jnp.int32)
     valid = (b >= 0) & (b < 4)
-    compl = jnp.where(valid, 3 - b, b)
 
-    def enc(prev_b, cur_b, prev_ok, cur_ok):
-        ok = prev_ok & cur_ok
-        return jnp.where(ok, 1 + 4 * prev_b + cur_b, 0)
-
-    # forward: context of base i = window (i-1, i)
+    # forward: context of base i = enc(b[i-1], b[i]) when both valid
     prev_idx = jnp.maximum(offs - 1, 0)
-    fwd = enc(b[:, prev_idx], b, valid[:, prev_idx] & (offs > 0)[None, :],
-              valid)
-    # reverse (mirrored pairing, see module docstring): element i pairs with
-    # p = end-1-(i-start); context = enc(compl(b[p+1]), compl(b[p]))
+    fwd_ok = valid[:, prev_idx] & valid & (offs > 0)[None, :]
+    fwd = jnp.where(fwd_ok, 1 + 4 * b[:, prev_idx] + b, 0)
+    # reverse (mirrored pairing, see module docstring): element i pairs
+    # with p = end-1-(i-start); context = enc(compl(b[p+1]), compl(b[p])).
+    # That value is a pure complement-swap of the FORWARD context at
+    # p+1 — enc(y, x) -> enc(3-x, 3-y) is the 17-entry involution below —
+    # so one gather of fwd replaces four take_along_axis gathers (the
+    # dominant cost of this kernel at [N, L] scale).  fwd[p+1] is
+    # nonzero exactly when valid[p] & valid[p+1] & (p+1 > 0); the p >= 0
+    # boundary is subsumed (p = -1 means p+1 = 0, where fwd is 0), and
+    # p+1 < end is the one condition applied on top.
+    g = jnp.arange(N_CONTEXT)
+    y, x = (g - 1) // 4, (g - 1) % 4
+    compl_swap = jnp.where(g == 0, 0, 1 + 4 * (3 - x) + (3 - y))
     p = end[:, None] - 1 - (offs[None, :] - start[:, None])
-    p_safe = jnp.clip(p, 0, L - 1)
     p1_safe = jnp.clip(p + 1, 0, L - 1)
-    take = jnp.take_along_axis
-    rev = enc(take(compl, p1_safe, 1), take(compl, p_safe, 1),
-              take(valid, p1_safe, 1) & (p + 1 < end[:, None]),
-              take(valid, p_safe, 1) & (p >= 0))
+    fwd_at_p1 = jnp.take_along_axis(fwd, p1_safe, 1)
+    rev = jnp.where(p + 1 < end[:, None], compl_swap[fwd_at_p1], 0)
     context = jnp.where(reverse[:, None], rev, fwd)
     # the first in-window base never has a context
     context = jnp.where(offs[None, :] == start[:, None], 0, context)

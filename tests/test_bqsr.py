@@ -412,3 +412,47 @@ def test_tpu_auto_upgrade_picks_rows_when_exact(monkeypatch):
     got = R._tpu_auto_upgrade("chain", 154, 101, 1)
     assert got == "pallas_rows"
     R._AUTO_UPGRADE_CACHE.clear()
+
+
+def test_reverse_context_matches_four_gather_formulation():
+    """The r5 one-gather complement-swap context must equal the original
+    four-gather formulation (enc(compl(b[p+1]), compl(b[p])) with
+    explicit validity gates) on an edge-heavy random batch: invalid/N/pad
+    bases, zero-length reads, windows clipped by low quals."""
+    from adam_tpu.bqsr.covariates import clip_window
+
+    rng = np.random.RandomState(11)
+    n, L = 256, 24
+    bases = rng.randint(-1, 5, (n, L)).astype(np.int8)   # -1 pad, 4 = N
+    quals = rng.randint(-1, 45, (n, L)).astype(np.int8)  # low ends clip
+    read_len = rng.randint(0, L + 1, n).astype(np.int32)
+    flags = np.where(rng.rand(n) < 0.7, S.FLAG_REVERSE, 0).astype(np.int32)
+    read_group = np.zeros(n, np.int32)
+
+    cov = covariate_tensors(jnp.asarray(bases), jnp.asarray(quals),
+                            jnp.asarray(read_len), jnp.asarray(flags),
+                            jnp.asarray(read_group))
+    got = np.asarray(cov["context"])
+
+    # oracle: the original formulation, in numpy
+    start, end = map(np.asarray, clip_window(jnp.asarray(quals),
+                                             jnp.asarray(read_len)))
+    b = bases.astype(np.int64)
+    valid = (b >= 0) & (b < 4)
+    compl = np.where(valid, 3 - b, b)
+    offs = np.arange(L)
+    prev_idx = np.maximum(offs - 1, 0)
+    fwd = np.where(valid[:, prev_idx] & valid & (offs > 0)[None, :],
+                   1 + 4 * b[:, prev_idx] + b, 0)
+    p = end[:, None] - 1 - (offs[None, :] - start[:, None])
+    p_safe = np.clip(p, 0, L - 1)
+    p1_safe = np.clip(p + 1, 0, L - 1)
+    take = np.take_along_axis
+    ok = (take(valid, p1_safe, 1) & (p + 1 < end[:, None]) &
+          take(valid, p_safe, 1) & (p >= 0))
+    rev = np.where(ok, 1 + 4 * take(compl, p1_safe, 1)
+                   + take(compl, p_safe, 1), 0)
+    reverse = (flags & S.FLAG_REVERSE) != 0
+    want = np.where(reverse[:, None], rev, fwd)
+    want = np.where(offs[None, :] == start[:, None], 0, want)
+    assert np.array_equal(got, want)
