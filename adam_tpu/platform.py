@@ -47,9 +47,17 @@ def enable_compilation_cache(path: str | None = None) -> None:
     One config flag removes it for every run after the first.
 
     Resolution order: explicit arg > ADAM_TPU_COMPILE_CACHE (``0``/empty
-    disables) > JAX_COMPILATION_CACHE_DIR (jax reads it natively; we
-    leave it alone) > ``~/.cache/adam_tpu/xla``.  Failures are
-    non-fatal — the cache is an optimization, never a dependency.
+    disables; a path force-enables on any backend) >
+    JAX_COMPILATION_CACHE_DIR (jax reads it natively; we leave it
+    alone) > ``~/.cache/adam_tpu/xla``.  Failures are non-fatal — the
+    cache is an optimization, never a dependency.
+
+    Default-on only for non-CPU backends: XLA:CPU AOT reload emits an
+    ERROR-level machine-feature-drift warning per cached executable
+    (compile-time tuning flags like +prefer-no-scatter never match the
+    host detector's list) and genuinely risks SIGILL when one cache dir
+    crosses heterogeneous machines (shared home dirs).  The compile
+    the cache saves most is the tunnel's remote AOT anyway.
     """
     if path is None:
         env = os.environ.get("ADAM_TPU_COMPILE_CACHE")
@@ -60,6 +68,13 @@ def enable_compilation_cache(path: str | None = None) -> None:
         elif os.environ.get("JAX_COMPILATION_CACHE_DIR"):
             return
         else:
+            try:
+                import jax
+
+                if jax.default_backend() == "cpu":
+                    return
+            except Exception:  # noqa: BLE001
+                return
             path = os.path.join(os.path.expanduser("~"), ".cache",
                                 "adam_tpu", "xla")
     try:
