@@ -52,42 +52,72 @@ def usable_read_mask(flags: np.ndarray, has_md: np.ndarray) -> np.ndarray:
 
 
 @partial(jax.jit, static_argnames=("max_len",))
-def _geometry_kernel(start, cigar_ops, cigar_lens, max_len: int):
-    """Fused per-base reference positions + read ends for pass 1."""
-    return (C.reference_positions(start, cigar_ops, cigar_lens, max_len),
-            C.read_end(start, cigar_ops, cigar_lens))
+def _state_base_kernel(start, cigar_ops, cigar_lens, has_md,
+                       max_len: int):
+    """Base state computed ON DEVICE: MATCH where the reference position
+    is defined (aligned, within [start, end)) and the read has an MD
+    tag, else MASKED.  Returns (state int8, end, pos) with pos left on
+    device — the host copies 1 byte/base instead of the 4-byte position
+    matrix (which only complex-cigar event rows ever need)."""
+    pos = C.reference_positions(start, cigar_ops, cigar_lens, max_len)
+    end = C.read_end(start, cigar_ops, cigar_lens)
+    in_align = (pos >= 0) & (pos >= start[:, None]) & \
+        (pos < end[:, None]) & has_md[:, None]
+    state = jnp.where(in_align, STATE_MATCH, STATE_MASKED).astype(jnp.int8)
+    return state, end, pos
 
 
-# per-event gather budget for _scatter_at_positions: bounds the [E_chunk, L]
-# row gathers so event scatters never materialize more than ~32 MB at once
+# per-event gather budget for _apply_events' complex-cigar path: bounds
+# the [E_chunk, L] row gathers so event scatters never materialize more
+# than ~32 MB at once
 _EVENT_CHUNK_BYTES = 32 << 20
 
 
-def _scatter_at_positions(state: np.ndarray, pos: np.ndarray,
-                          ev_row: np.ndarray, ev_pos: np.ndarray,
-                          ok_mask: np.ndarray, value: int) -> None:
-    """Set ``state[r, j] = value`` where ``pos[r, j] == p`` for each event
-    ``(r, p)`` and ``ok_mask[r, j]`` holds.
+def _apply_events(state: np.ndarray, start: np.ndarray,
+                  simple: np.ndarray, pos_dev,
+                  ev_row: np.ndarray, ev_pos: np.ndarray,
+                  value: int) -> None:
+    """Set ``state[r, j] = value`` at the base of read ``r`` aligned to
+    reference position ``p``, gated on that base being unmasked (the
+    defined/in-alignment gate: MASKED marks undefined positions, and
+    events never target them).
 
-    Within a read, aligned base positions are strictly increasing and
-    clip-extrapolated positions fall outside [start, end), so at most one
-    ``ok`` column matches a given reference position — argmax-first-hit is
-    exact.  Work and memory are O(E x L) over the (rare) events instead of
+    Single-M-cigar rows (the overwhelming majority) resolve the offset
+    arithmetically (``j = p - start``) with NO position matrix at all;
+    complex-cigar rows gather their device-resident position rows in
+    bounded chunks and use argmax-first-hit, which is exact because
+    aligned positions within a read are strictly increasing and
+    clip-extrapolated positions fall outside [start, end).  Work and
+    memory are O(E) + O(E_complex x L) over the (rare) events instead of
     O(N x L) over every base.
     """
     if len(ev_row) == 0:
         return
-    L = pos.shape[1]
-    chunk = max(1, _EVENT_CHUNK_BYTES // max(L * pos.itemsize, 1))
-    for s in range(0, len(ev_row), chunk):
-        r = ev_row[s:s + chunk]
-        p = ev_pos[s:s + chunk]
-        hit = pos[r] == p[:, None]                      # [e, L]
+    L = state.shape[1]
+    is_simple = simple[ev_row]
+    r = ev_row[is_simple]
+    off = ev_pos[is_simple] - start[r]
+    ok = (off >= 0) & (off < L)
+    r, off = r[ok], off[ok].astype(np.intp)
+    sel = state[r, off] != STATE_MASKED
+    state[r[sel], off[sel]] = value
+
+    r2 = ev_row[~is_simple]
+    p2 = ev_pos[~is_simple]
+    if len(r2) == 0:
+        return
+    chunk = max(1, _EVENT_CHUNK_BYTES // max(L * 4, 1))
+    for s in range(0, len(r2), chunk):
+        rr = r2[s:s + chunk]
+        pp = p2[s:s + chunk]
+        uniq, inv = np.unique(rr, return_inverse=True)
+        posu = np.asarray(pos_dev[jnp.asarray(uniq)])    # [u, L]
+        hit = posu[inv] == pp[:, None]                   # [e, L]
         j = np.argmax(hit, axis=1)
-        found = hit[np.arange(len(r)), j]
-        rr, jj = r[found], j[found]
-        sel = ok_mask[rr, jj]
-        state[rr[sel], jj[sel]] = value
+        found = hit[np.arange(len(rr)), j]
+        rs, js = rr[found], j[found]
+        sel = state[rs, js] != STATE_MASKED
+        state[rs[sel], js[sel]] = value
 
 
 def mismatch_state(table: pa.Table, batch: ReadBatch,
@@ -108,32 +138,37 @@ def mismatch_state(table: pa.Table, batch: ReadBatch,
     """
     n = table.num_rows
     L = batch.max_len
-    # one fused jit for the geometry: eager per-op dispatch of the
-    # reference-position walk measured 6.3 s per 500k-read chunk on CPU —
-    # the single largest cost of the whole streaming-transform pass 2
-    pos_d, end_d = _geometry_kernel(
-        jnp.asarray(batch.start), jnp.asarray(batch.cigar_ops),
-        jnp.asarray(batch.cigar_lens), max_len=L)
-    pos = np.asarray(pos_d)[:n]
-    end = np.asarray(end_d)[:n]
-    start = np.asarray(batch.start[:n], np.int64)
-
     md_col = table.column("mismatchingPositions")
-    state = np.full((n, L), STATE_MASKED, np.int8)
-    in_align = (pos >= 0) & (pos >= start[:, None]) & (pos < end[:, None])
-
     from ..ops.pileup import _col_valid, _md_lookup_arrays
     has_md = _col_valid(md_col)
-    in_align &= has_md[:, None]          # now: "defined" per the reference
-    state[in_align] = STATE_MATCH
+    has_md_pad = np.zeros(batch.n_reads, bool)
+    has_md_pad[:n] = has_md
+
+    # one fused jit for geometry AND the base state: eager per-op
+    # dispatch of the reference-position walk measured 6.3 s per
+    # 500k-read chunk on CPU, and copying the int32 position matrix to
+    # host another ~2.5 s/M — so the state is built on device (1 B/base
+    # crosses) and positions stay device-resident for the few
+    # complex-cigar event rows that need them
+    state_d, end_d, pos_d = _state_base_kernel(
+        jnp.asarray(batch.start), jnp.asarray(batch.cigar_ops),
+        jnp.asarray(batch.cigar_lens), jnp.asarray(has_md_pad), max_len=L)
+    # .copy(): the CPU backend zero-copies device buffers read-only, and
+    # the event scatters below write in place
+    state = np.asarray(state_d)[:n].copy()
+    end = np.asarray(end_d)[:n]
+    start = np.asarray(batch.start[:n], np.int64)
+    ops = np.asarray(batch.cigar_ops)[:n]
+    simple = ops[:, 0] == S.CIGAR_M
+    if ops.shape[1] > 1:          # single-op batches have no slot 1
+        simple &= ops[:, 1] < 0
 
     # MD mismatch events (shared key encoding with the pileup engine:
     # row << 34 | ref_pos)
     usable_rows = np.flatnonzero(has_md)
     mm_keys, _, _, _ = _md_lookup_arrays(md_col, start, usable_rows)
-    _scatter_at_positions(state, pos, (mm_keys >> 34),
-                          mm_keys & ((np.int64(1) << 34) - 1),
-                          in_align, STATE_MISMATCH)
+    _apply_events(state, start, simple, pos_d, (mm_keys >> 34),
+                  mm_keys & ((np.int64(1) << 34) - 1), STATE_MISMATCH)
 
     if snp_table is not None and len(snp_table):
         # dictionary-encode the contig column once, then iterate only the
@@ -160,8 +195,8 @@ def mismatch_state(table: pa.Table, batch: ReadBatch,
             ev_row = np.repeat(crows, cnt)
             first = np.cumsum(cnt) - cnt
             idx = np.repeat(lo - first, cnt) + np.arange(tot)
-            _scatter_at_positions(state, pos, ev_row, sites[idx],
-                                  in_align, STATE_MASKED)
+            _apply_events(state, start, simple, pos_d, ev_row,
+                          sites[idx], STATE_MASKED)
     return state
 
 

@@ -59,12 +59,14 @@ def main(argv=None) -> int:
     if not getattr(args, "_cmd", None):
         parser.print_help()
         return 1
-    # every command compiles the same kernels; persist them across runs
-    from ..platform import enable_compilation_cache
-    enable_compilation_cache()
     # after parsing (so --help stays jax-import-free), before any command
     # can initialize a backend
     _honor_platform_env()
+    # every command compiles the same kernels; persist them across runs
+    # (after the platform forcing, so the cache's platform gate sees the
+    # forced config — and never before: the gate must not init a backend)
+    from ..platform import enable_compilation_cache
+    enable_compilation_cache()
     from ..errors import FormatError
     from ..instrument import log_invocation
     log_invocation(["adam-tpu"] + list(argv if argv is not None

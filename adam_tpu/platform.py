@@ -68,12 +68,20 @@ def enable_compilation_cache(path: str | None = None) -> None:
         elif os.environ.get("JAX_COMPILATION_CACHE_DIR"):
             return
         else:
+            # intended-platform check WITHOUT touching the backend:
+            # jax.default_backend() here would initialize it before the
+            # caller's platform forcing could apply (and would dial a
+            # dead tunnel on axon boxes just to decide about a cache).
+            # jax.config.jax_platforms reflects force_cpu /
+            # honor_platform_env; the env var covers the pre-config case.
             try:
                 import jax
 
-                if jax.default_backend() == "cpu":
-                    return
+                plat = jax.config.jax_platforms or \
+                    os.environ.get("JAX_PLATFORMS", "")
             except Exception:  # noqa: BLE001
+                plat = os.environ.get("JAX_PLATFORMS", "")
+            if (plat or "").split(",")[0].strip() == "cpu":
                 return
             path = os.path.join(os.path.expanduser("~"), ".cache",
                                 "adam_tpu", "xla")

@@ -128,6 +128,7 @@ def test_memory_bounded_on_1m_read_chunk():
 
     class FakeBatch:
         max_len = L
+        n_reads = n
         start = table.column("start").to_numpy().astype(np.int64)
         cigar_ops = np.zeros((n, 1), np.int8)
         cigar_lens = np.full((n, 1), L, np.int32)
