@@ -1,0 +1,76 @@
+"""Fast supervisor-logic tests: scripted worker processes, no jax.
+
+The mesh-level integration lives in test_elastic_recovery.py; these pin
+the supervisor's restart/teardown decisions cheaply: success first try,
+give-up after max_restarts, full-incarnation teardown on one death, and
+fresh coordinators per incarnation.
+"""
+
+import os
+import sys
+
+import pytest
+
+from adam_tpu.parallel.elastic import supervise
+
+
+def _worker_argv(body: str):
+    return [sys.executable, "-c", body]
+
+
+def test_all_zero_exit_first_incarnation(tmp_path):
+    inc = supervise(lambda pid, coord: _worker_argv("print('ok')"),
+                    num_processes=2, max_restarts=0,
+                    log_dir=str(tmp_path))
+    assert inc.number == 0
+    assert [p.returncode for p in inc.procs] == [0, 0]
+
+
+def test_gives_up_after_max_restarts(tmp_path):
+    with pytest.raises(RuntimeError, match="after 3 incarnations"):
+        supervise(lambda pid, coord: _worker_argv("raise SystemExit(3)"),
+                  num_processes=2, max_restarts=2, log_dir=str(tmp_path))
+
+
+def test_one_death_tears_down_the_whole_incarnation(tmp_path):
+    """Worker 1 exits nonzero immediately; worker 0 would run for 60 s —
+    the supervisor must kill it rather than wait, and the next
+    incarnation (all-healthy via the marker) succeeds."""
+    marker = tmp_path / "second_try"
+    body = (
+        "import os, sys, time\n"
+        f"marker = {str(marker)!r}\n"
+        "pid = int(sys.argv[1])\n"
+        "if os.path.exists(marker):\n"
+        "    sys.exit(0)\n"
+        "if pid == 1:\n"
+        "    open(marker, 'w').write('x')\n"
+        "    sys.exit(9)\n"
+        "time.sleep(60)\n"
+    )
+
+    def argv(pid, coord):
+        return [sys.executable, "-c", body, str(pid)]
+
+    import time
+    t0 = time.monotonic()
+    inc = supervise(argv, num_processes=2, max_restarts=1,
+                    log_dir=str(tmp_path / "logs"))
+    assert inc.number == 1
+    # worker 0's 60 s sleep must have been terminated, not waited out
+    assert time.monotonic() - t0 < 30
+
+
+def test_fresh_coordinator_per_incarnation(tmp_path):
+    coords = []
+
+    def argv(pid, coord):
+        if pid == 0:
+            coords.append(coord)
+        fail = len(coords) < 2  # first incarnation dies
+        return _worker_argv(f"raise SystemExit({1 if fail else 0})")
+
+    inc = supervise(argv, num_processes=1, max_restarts=2,
+                    log_dir=str(tmp_path))
+    assert inc.number == 1
+    assert len(set(coords)) == len(coords), "coordinator ports must differ"
