@@ -29,10 +29,13 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 # tunnel-independence is the point: force the CPU client so the check
 # never blocks on (or is invalidated by) tunnel state.  The bare env var
 # is NOT enough — the axon plugin initializes (and touches the tunnel)
-# regardless; platform.force_cpu flips the jax config too.
+# regardless; platform.force_cpu flips the jax config too.  8 virtual
+# devices back the sharded-section mesh (the lowering still targets
+# TPU — jax.export records nr_devices=8 and the module carries the
+# partitioned collectives).
 from adam_tpu.platform import force_cpu  # noqa: E402
 
-force_cpu()
+force_cpu(n_devices=8)
 
 import jax  # noqa: E402
 import jax.numpy as jnp  # noqa: E402
@@ -110,6 +113,59 @@ def kernel_cases():
     return cases
 
 
+def sharded_cases():
+    """(name, jit_fn, abstract_args) for the MULTI-CHIP product paths:
+    shard_map'd Pallas kernels + psum over the reads axis, lowered for
+    TPU with nr_devices=8.  This is the dryrun's coverage at the Mosaic
+    layer: the dryrun executes these graphs on the CPU mesh in interpret
+    mode; here the same graphs lower through real Mosaic + partitioned
+    collectives without a device."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from adam_tpu.bqsr.count_pallas import sharded_count_pallas
+    from adam_tpu.bqsr.recalibrate import _sharded_apply_fn
+    from adam_tpu.bqsr.table import RecalTable
+    from adam_tpu.ops import flagstat_pallas as fp
+    from adam_tpu.parallel.mesh import READS_AXIS, make_mesh
+
+    mesh = make_mesh(n_devices=8)
+    rows = NamedSharding(mesh, P(READS_AXIS))
+    repl = NamedSharding(mesh, P())
+    cases = []
+
+    n_wire = 8 * fp.V2_ROWS * fp.LANES
+    cases.append((
+        "sharded_flagstat_pallas",
+        jax.jit(fp.flagstat_wire32_sharded_pallas(mesh, interpret=False),
+                in_shardings=rows),
+        (S((n_wire,), jnp.uint32),)))
+
+    n, L, n_rg = 64, 128, 1
+    rt = RecalTable(n_read_groups=n_rg, max_read_len=L)
+    ra = _read_args(n=n, L=L)
+    order = ("bases", "quals", "read_len", "flags", "read_group", "state",
+             "usable")
+    args = tuple(ra[k] for k in order)
+    for variant in ("flat", "rows"):
+        cases.append((
+            f"sharded_count_pallas_{variant}",
+            jax.jit(sharded_count_pallas(mesh, rt.n_qual_rg, rt.n_cycle,
+                                         variant, interpret=False),
+                    in_shardings=(rows,) * 7),
+            args))
+
+    from adam_tpu.bqsr.covariates import N_CONTEXT
+    lut_len = 128 * n_rg * rt.n_cycle * N_CONTEXT
+    cases.append((
+        "sharded_apply_lut",
+        jax.jit(_sharded_apply_fn(mesh, n_rg),
+                in_shardings=(rows,) * 6 + (repl,)),
+        tuple(ra[k] for k in ("bases", "quals", "read_len", "flags",
+                              "read_group")) + (S((n,), jnp.bool_),
+                                                S((lut_len,), jnp.int8))))
+    return cases
+
+
 def check_one(name, fn, args):
     t0 = time.perf_counter()
     try:
@@ -118,6 +174,7 @@ def check_one(name, fn, args):
         return {"kernel": name, "ok": True,
                 "lower_s": round(time.perf_counter() - t0, 2),
                 "serialized_bytes": len(blob),
+                "nr_devices": exp.nr_devices,
                 "has_tpu_custom_call":
                     b"tpu_custom_call" in exp.mlir_module_serialized}
     except Exception as e:  # noqa: BLE001 — per-kernel isolation is the job
@@ -132,6 +189,11 @@ def main() -> int:
     ap.add_argument("--out", default="AOT_CHECK.json")
     args = ap.parse_args()
     results = [check_one(*c) for c in kernel_cases()]
+    try:
+        results += [check_one(*c) for c in sharded_cases()]
+    except Exception as e:  # noqa: BLE001 — sharded section is additive
+        results.append({"kernel": "sharded_section", "ok": False,
+                        "error": f"{type(e).__name__}: {e}"[:500]})
     doc = {
         "what": "AOT TPU lowering status of every product Pallas kernel "
                 "(trace + StableHLO + Mosaic serialization, no device)",
