@@ -23,9 +23,16 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
+import sys
 import time
 
 import numpy as np
+
+# spawned as `python tools/tpu_probe_suite.py`, sys.path[0] is tools/ —
+# the repo root must be added or `import adam_tpu` dies before the first
+# probe line (exactly how round-4's probe captures came back empty)
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def emit(name, **kw):

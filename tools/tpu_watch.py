@@ -216,6 +216,12 @@ def _capture_probes(repo: str) -> None:
                                           "tpu_probe_suite.py")],
             timeout=1200, capture_output=True, text=True, cwd=repo)
         out = rc.stdout
+        if not out.strip():
+            # an import-time death produces zero probe lines; say WHY
+            # instead of silently recording an empty capture
+            tail = (rc.stderr or "").strip().splitlines()[-5:]
+            print(f"probe suite emitted nothing (rc={rc.returncode}): "
+                  + " | ".join(tail), flush=True)
     except subprocess.TimeoutExpired as e:
         # keep whatever probes streamed before the deadline (a later
         # window re-runs the whole suite; probes are idempotent)
