@@ -122,6 +122,12 @@ def _commit_evidence(repo: str, names) -> None:
     if not present:
         return
     try:
+        # add first: a pathspec'd `git commit -- FILE` errors on files
+        # git has never seen, and fresh-window evidence files are
+        # exactly that (caught in a round-5 rehearsal — a real window's
+        # artifacts would have sat uncommitted, round-3's story again)
+        subprocess.run(["git", "add", "--"] + present, cwd=repo,
+                       capture_output=True, text=True, timeout=30)
         # pathspec'd commit: ONLY the named files land in it, regardless
         # of whatever else a concurrent session may have staged
         rc = subprocess.run(
