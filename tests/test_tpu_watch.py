@@ -1,0 +1,67 @@
+"""Pins for tpu_watch's pure helpers — the bits of the one-shot capture
+chain that can be tested without a tunnel (the subprocess pieces were
+rehearsed live in round 5; two latent bugs — probe suite import death
+and pathspec'd commit of untracked evidence — came from exactly this
+chain never executing)."""
+
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+
+def _load():
+    path = Path(__file__).resolve().parent.parent / "tools" / "tpu_watch.py"
+    spec = importlib.util.spec_from_file_location("tw", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+TW = _load()
+
+
+def _probe_lines(*dicts):
+    return "\n".join(json.dumps(d) for d in dicts)
+
+
+def test_probe_output_complete_requires_tpu_env_and_all_done():
+    done = [{"probe": f"{pid}_done"} for pid in TW._PROBE_IDS]
+    tpu_env = {"probe": "env", "device_kind": "TPU v5 lite",
+               "platform": "tpu"}
+    assert TW._probe_output_complete(_probe_lines(tpu_env, *done))
+    # CPU env: kept for inspection but never satisfies the guard
+    cpu_env = {"probe": "env", "device_kind": "cpu", "platform": "cpu"}
+    assert not TW._probe_output_complete(_probe_lines(cpu_env, *done))
+    # missing one done line: a timed-out partial capture must retry
+    assert not TW._probe_output_complete(
+        _probe_lines(tpu_env, *done[:-1]))
+    # garbage lines are skipped, not fatal
+    assert TW._probe_output_complete(
+        "not json\n" + _probe_lines(tpu_env, *done))
+
+
+def test_commit_evidence_commits_untracked_files(tmp_path):
+    repo = tmp_path / "r"
+    repo.mkdir()
+    env = dict(os.environ)
+    run = lambda *a: subprocess.run(  # noqa: E731
+        ["git", *a], cwd=repo, capture_output=True, text=True, env=env)
+    run("init", "-q")
+    run("config", "user.email", "t@t")
+    run("config", "user.name", "t")
+    (repo / "seed").write_text("s")
+    run("add", "seed")
+    run("commit", "-q", "-m", "seed")
+
+    (repo / "NEW_EVIDENCE.json").write_text("{}")
+    (repo / "unrelated.txt").write_text("must not be committed")
+    TW._commit_evidence(str(repo), ["NEW_EVIDENCE.json", "absent.json"])
+
+    show = run("show", "--stat", "--oneline", "HEAD").stdout
+    assert "NEW_EVIDENCE.json" in show
+    assert "unrelated.txt" not in show
+    status = run("status", "--porcelain").stdout
+    assert "unrelated.txt" in status
