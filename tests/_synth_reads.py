@@ -1,0 +1,55 @@
+"""Shared vectorized synthetic reads-table builder for scale-ish tests.
+
+Several round-5 tests (multi-process ingest differentials, sharded BQSR
+apply) each grew their own ~30-line random READ_SCHEMA table builder;
+this is the one copy.  Row-dict-shaped helpers (`_reads_table(rows)`)
+in the older suites serve a different purpose (hand-crafted per-read
+scenarios) and stay local.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pyarrow as pa
+
+from adam_tpu import schema as S
+
+
+def random_reads_table(n: int, L: int, seed: int = 0, *,
+                       n_rg: int = 0, contig: str = "chr1",
+                       contig_len: int = 10_000_000,
+                       qual_range: tuple = (30, 41),
+                       sorted_starts: bool = False,
+                       flags=None) -> pa.Table:
+    """Full READ_SCHEMA table of ``n`` random mapped ``L``-bp reads
+    (all-match MD, single-M cigar)."""
+    rng = np.random.RandomState(seed)
+    letters = np.frombuffer(b"ACGT", np.uint8)
+    seqs = letters[rng.randint(0, 4, (n, L))].view(f"S{L}").ravel()
+    quals = (rng.randint(*qual_range, (n, L)) + 33).astype(
+        np.uint8).view(f"S{L}").ravel()
+    starts = rng.randint(0, contig_len - L, n)
+    if sorted_starts:
+        starts = np.sort(starts)
+    if flags is None:
+        flags = np.zeros(n, np.int64)
+    data = {
+        "readName": pa.array([f"r{i}" for i in range(n)]),
+        "sequence": pa.array(seqs.astype(str)),
+        "qual": pa.array(quals.astype(str)),
+        "cigar": pa.array([f"{L}M"] * n),
+        "mismatchingPositions": pa.array([str(L)] * n),
+        "referenceId": pa.array(np.zeros(n, np.int32), pa.int32()),
+        "referenceName": pa.array([contig] * n),
+        "start": pa.array(starts.astype(np.int64), pa.int64()),
+        "mapq": pa.array(np.full(n, 60, np.int32), pa.int32()),
+        "flags": pa.array(np.asarray(flags, np.int64), pa.int64()),
+    }
+    if n_rg:
+        data["recordGroupId"] = pa.array(
+            rng.randint(0, n_rg, n).astype(np.int32), pa.int32())
+    cols = {}
+    for name in S.READ_SCHEMA.names:
+        cols[name] = data[name].cast(S.READ_SCHEMA.field(name).type) \
+            if name in data else pa.nulls(n, S.READ_SCHEMA.field(name).type)
+    return pa.Table.from_pydict(cols, schema=S.READ_SCHEMA)

@@ -68,39 +68,14 @@ def test_sharded_apply_engages_and_matches_host_path():
     """apply_table(mesh=) must really take the shard_map LUT path (not
     silently fall back to the slab walk) and produce byte-identical qual
     strings to the unsharded call."""
-    import pyarrow as pa
-
-    from adam_tpu import schema as S
+    from _synth_reads import random_reads_table
     from adam_tpu.bqsr.recalibrate import (_sharded_apply_fn, apply_table)
-    from adam_tpu.bqsr.table import RecalTable
     from adam_tpu.packing import pack_reads
     from adam_tpu.parallel.mesh import make_mesh
 
-    rng = np.random.RandomState(3)
     n, L, n_rg = 64, 32, 2          # 64 % 8 devices == 0
-    letters = np.frombuffer(b"ACGT", np.uint8)
-    seqs = letters[rng.randint(0, 4, (n, L))].view(f"S{L}").ravel()
-    quals = (rng.randint(5, 41, (n, L)) + 33).astype(np.uint8) \
-        .view(f"S{L}").ravel()
-    data = {
-        "readName": pa.array([f"r{i}" for i in range(n)]),
-        "sequence": pa.array(seqs.astype(str)),
-        "qual": pa.array(quals.astype(str)),
-        "cigar": pa.array([f"{L}M"] * n),
-        "mismatchingPositions": pa.array([str(L)] * n),
-        "referenceId": pa.array(np.zeros(n, np.int32), pa.int32()),
-        "referenceName": pa.array(["chr1"] * n),
-        "start": pa.array(np.arange(n, dtype=np.int64), pa.int64()),
-        "mapq": pa.array(np.full(n, 60, np.int32), pa.int32()),
-        "flags": pa.array(np.zeros(n, np.int64), pa.int64()),
-        "recordGroupId": pa.array(
-            rng.randint(0, n_rg, n).astype(np.int32), pa.int32()),
-    }
-    cols = {}
-    for name in S.READ_SCHEMA.names:
-        cols[name] = data[name].cast(S.READ_SCHEMA.field(name).type) \
-            if name in data else pa.nulls(n, S.READ_SCHEMA.field(name).type)
-    table = pa.Table.from_pydict(cols, schema=S.READ_SCHEMA)
+    table = random_reads_table(n, L, seed=3, n_rg=n_rg,
+                               qual_range=(5, 41))
     batch = pack_reads(table)
     rt = _random_table(n_rg, batch.max_len, seed=9)
 

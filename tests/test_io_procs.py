@@ -4,11 +4,9 @@ is bit-identical at any process count."""
 
 import gzip
 
-import numpy as np
-import pyarrow as pa
 import pytest
 
-from adam_tpu import schema as S
+from _synth_reads import random_reads_table
 from adam_tpu.io.bam import iter_decompressed, read_bam, write_bam
 from adam_tpu.io.bgzf_procs import (iter_decompressed_procs, scan_segments)
 from adam_tpu.models.dictionary import (RecordGroupDictionary,
@@ -16,32 +14,8 @@ from adam_tpu.models.dictionary import (RecordGroupDictionary,
 
 
 def _synth_bam(path, n_reads=3000, L=80, seed=7):
-    rng = np.random.RandomState(seed)
-    letters = np.frombuffer(b"ACGT", np.uint8)
     seq_dict = SequenceDictionary([SequenceRecord(0, "chr1", 10_000_000)])
-    seqs = letters[rng.randint(0, 4, (n_reads, L))].view(f"S{L}").ravel()
-    quals = (rng.randint(30, 41, (n_reads, L)) + 33).astype(
-        np.uint8).view(f"S{L}").ravel()
-    cols = {}
-    data = {
-        "readName": pa.array([f"r{i}" for i in range(n_reads)]),
-        "sequence": pa.array(seqs.astype(str)),
-        "qual": pa.array(quals.astype(str)),
-        "cigar": pa.array([f"{L}M"] * n_reads),
-        "referenceId": pa.array(
-            np.zeros(n_reads, np.int32), pa.int32()),
-        "referenceName": pa.array(["chr1"] * n_reads),
-        "start": pa.array(
-            np.sort(rng.randint(0, 9_000_000, n_reads)), pa.int64()),
-        "mapq": pa.array(np.full(n_reads, 60, np.int32), pa.int32()),
-        "flags": pa.array(np.zeros(n_reads, np.int64), pa.int64()),
-    }
-    for name in S.READ_SCHEMA.names:
-        if name in data:
-            cols[name] = data[name].cast(S.READ_SCHEMA.field(name).type)
-        else:
-            cols[name] = pa.nulls(n_reads, S.READ_SCHEMA.field(name).type)
-    table = pa.Table.from_pydict(cols, schema=S.READ_SCHEMA)
+    table = random_reads_table(n_reads, L, seed, sorted_starts=True)
     write_bam(table, seq_dict, str(path), RecordGroupDictionary([]))
     return table
 

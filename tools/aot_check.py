@@ -47,13 +47,12 @@ def S(shape, dtype):
 
 
 def _read_args(n=64, L=128):
-    """Abstract ReadBatch tensors in the product packer's dtypes
-    (packing.py ReadBatch: int8 bases/quals, int32 scalars, bool valid)."""
-    return dict(
-        bases=S((n, L), jnp.int8), quals=S((n, L), jnp.int8),
-        read_len=S((n,), jnp.int32), flags=S((n,), jnp.int32),
-        read_group=S((n,), jnp.int32), state=S((n, L), jnp.int8),
-        usable=S((n,), jnp.bool_))
+    """Abstract ReadBatch tensors in the product packer's dtypes and the
+    count kernels' positional order: (bases, quals, read_len, flags,
+    read_group, state, usable)."""
+    return (S((n, L), jnp.int8), S((n, L), jnp.int8),
+            S((n,), jnp.int32), S((n,), jnp.int32), S((n,), jnp.int32),
+            S((n, L), jnp.int8), S((n,), jnp.bool_))
 
 
 def kernel_cases():
@@ -79,11 +78,8 @@ def kernel_cases():
 
     # BQSR count kernels: product geometry for one read group of 128 bp
     # reads (n_qual_rg = 60*RG+94, n_cycle = 2L+1 — table.py)
-    ra = _read_args(n=64, L=128)
+    args = _read_args(n=64, L=128)
     n_qual_rg, n_cycle = 60 + 94, 2 * 128 + 1
-    order = ("bases", "quals", "read_len", "flags", "read_group", "state",
-             "usable")
-    args = tuple(ra[k] for k in order)
     for name, fn in (("count_flat", count_kernel_pallas),
                      ("count_rows", count_kernel_pallas_rows)):
         for tag, int8_mxu in (("bf16", False), ("int8", True)):
@@ -142,10 +138,7 @@ def sharded_cases():
 
     n, L, n_rg = 64, 128, 1
     rt = RecalTable(n_read_groups=n_rg, max_read_len=L)
-    ra = _read_args(n=n, L=L)
-    order = ("bases", "quals", "read_len", "flags", "read_group", "state",
-             "usable")
-    args = tuple(ra[k] for k in order)
+    args = _read_args(n=n, L=L)
     for variant in ("flat", "rows"):
         cases.append((
             f"sharded_count_pallas_{variant}",
@@ -160,9 +153,7 @@ def sharded_cases():
         "sharded_apply_lut",
         jax.jit(_sharded_apply_fn(mesh, n_rg),
                 in_shardings=(rows,) * 6 + (repl,)),
-        tuple(ra[k] for k in ("bases", "quals", "read_len", "flags",
-                              "read_group")) + (S((n,), jnp.bool_),
-                                                S((lut_len,), jnp.int8))))
+        args[:5] + (S((n,), jnp.bool_), S((lut_len,), jnp.int8))))
     return cases
 
 
