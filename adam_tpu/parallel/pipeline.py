@@ -945,6 +945,7 @@ def _emit_bins(out, bin_writers, halo_writers, part, chunk_rows, budget,
     import tempfile as _tempfile
 
     from .. import schema as S
+    from ..instrument import stage
     from ..io.parquet import iter_tables
     from ..ops.sort import sort_reads
 
@@ -952,15 +953,17 @@ def _emit_bins(out, bin_writers, halo_writers, part, chunk_rows, budget,
 
     def emit_sorted(tbl, next_lower_flat):
         nonlocal pending
-        pending = tbl if pending is None else \
-            sort_reads(pa.concat_tables([pending, tbl]))
+        with stage("merge-sort"):
+            pending = tbl if pending is None else \
+                sort_reads(pa.concat_tables([pending, tbl]))
         cutoff = next_lower_flat - _REALIGN_HALO
         flags = column_int64(pending, "flags", 0)
         flat = _flat_of_table(pending, part)
         safe = ((flags & S.FLAG_UNMAPPED) == 0) & (flat < cutoff)
         k = int(safe.sum())  # sorted => safe rows are a prefix
         if k:
-            out.write(pending.slice(0, k))
+            with stage("write"):
+                out.write(pending.slice(0, k))
         pending = pending.slice(k) if k < pending.num_rows else None
 
     for b, w in enumerate(bin_writers):

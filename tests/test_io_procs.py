@@ -72,6 +72,19 @@ def test_procs_decode_to_identical_tables(bam_path):
     assert got.equals(want)
 
 
+def test_streaming_flagstat_identical_with_io_procs(bam_path, monkeypatch):
+    """The flagstat native wire path through the process-pool inflater
+    must count exactly what the sequential walk counts."""
+    from adam_tpu.io import bgzf_procs
+    from adam_tpu.parallel.pipeline import streaming_flagstat
+
+    monkeypatch.setattr(bgzf_procs, "SEGMENT_BYTES", 1 << 15)
+    seq = streaming_flagstat(str(bam_path))
+    par = streaming_flagstat(str(bam_path), io_procs=2)
+    for a, b in zip(seq, par):
+        assert a == b
+
+
 def test_non_bgzf_falls_back_to_sequential(tmp_path):
     p = tmp_path / "plain.gz"
     payload = b"plain gzip, not bgzf" * 1000
