@@ -116,16 +116,69 @@ class Bam2AdamCommand(Command):
         p.add_argument("input", help="SAM/BAM file")
         p.add_argument("output", help="output Parquet dataset directory")
         p.add_argument("-parts", type=int, default=1,
-                       help="number of part files to write")
+                       help="number of part files to write (in-memory "
+                            "path; the streamed path rotates one part "
+                            "per chunk, like the reference's "
+                            "one-part-per-writer-thread)")
         p.add_argument("-compression", default="zstd",
                        choices=["zstd", "snappy", "gzip", "none"])
         p.add_argument("-samtools_validation", default="lenient",
                        choices=["strict", "lenient", "silent"],
                        help="malformed-record handling (same default as "
                             "the reference, Bam2Adam.scala:46-47)")
+        p.add_argument("-stream", action="store_true",
+                       help="force the chunked bounded-memory path "
+                            "(auto for inputs over 1 GB)")
+        p.add_argument("-no_stream", action="store_true")
+        p.add_argument("-stream_chunk_rows", type=int, default=1 << 20,
+                       help="reads per streamed chunk")
+        p.add_argument("-io_threads", type=int, default=1,
+                       help=">1 moves decode to a read-ahead thread so "
+                            "it overlaps the Parquet write on the "
+                            "streamed path (bit-identical; bam2adam has "
+                            "no pack stage, so this is an on/off "
+                            "overlap, not a pool size)")
+        p.add_argument("-io_procs", type=int, default=1,
+                       help="BGZF inflate worker processes on the "
+                            "streamed path (bit-identical)")
         add_parquet_args(p)
 
     def run(self, args) -> int:
+        if should_stream(args, args.input):
+            # the reference's Bam2Adam IS a streaming converter (reader
+            # thread + N writers over a bounded queue, one part file per
+            # writer); this is that shape with bounded chunks
+            from .. import schema as S
+            from ..io.parquet import DatasetWriter
+            from ..io.stream import open_read_stream
+
+            if args.parts != 1:
+                print("bam2adam: streaming path rotates one part per "
+                      f"chunk; -parts {args.parts} does not apply "
+                      "(use -stream_chunk_rows to size parts)")
+            stream = open_read_stream(
+                args.input, chunk_rows=args.stream_chunk_rows,
+                io_procs=args.io_procs,
+                stringency=args.samtools_validation)
+            chunks = stream
+            if args.io_threads > 1:
+                from ..parallel.ingest import pipelined
+                chunks = pipelined(chunks, workers=args.io_threads)
+            n = 0
+            with DatasetWriter(args.output,
+                               part_rows=args.stream_chunk_rows,
+                               row_group_bytes=args.parquet_block_size,
+                               **parquet_writer_kwargs(args)) as out:
+                for t in chunks:
+                    out.write(t)
+                    n += t.num_rows
+                if n == 0:
+                    # a header-only (or all-dropped) input must still
+                    # yield a schema-bearing dataset, like the
+                    # in-memory path's one empty part
+                    out.write(S.READ_SCHEMA.empty_table())
+            print(f"wrote {n} reads to {args.output}")
+            return 0
         from ..io.dispatch import load_reads
 
         table, _, _ = load_reads(args.input,

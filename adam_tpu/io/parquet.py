@@ -120,9 +120,11 @@ class DatasetWriter:
         self._writer: Optional[pq.ParquetWriter] = None
         self._pending: list[pa.Table] = []
         self._pending_rows = 0
+        self._schema: Optional[pa.Schema] = None
         self.rows_written = 0
 
     def write(self, table: pa.Table) -> None:
+        self._schema = table.schema
         self._pending.append(table)
         self._pending_rows += table.num_rows
         if self._pending_rows >= min(self.row_group_size, self.part_rows):
@@ -162,6 +164,18 @@ class DatasetWriter:
 
     def close(self) -> None:
         self.flush()
+        if self._writer is None and self.rows_written == 0 and \
+                self._schema is not None:
+            # an all-empty stream still yields a schema-bearing dataset
+            # (save_table writes one empty part the same way) — a
+            # part-less directory reads back as a 0-column table and
+            # breaks every downstream consumer
+            self._writer = pq.ParquetWriter(
+                os.path.join(self.path, "part-r-00000.parquet"),
+                self._schema, compression=self.compression,
+                data_page_size=self.page_size,
+                use_dictionary=self.use_dictionary)
+            self._writer.write_table(self._schema.empty_table())
         if self._writer is not None:
             self._writer.close()
             self._writer = None

@@ -54,11 +54,15 @@ def _projected(chunks, columns, filters):
 def open_read_stream(path: str, *, columns: Optional[Sequence[str]] = None,
                      filters=None,
                      chunk_rows: int = DEFAULT_CHUNK_ROWS,
-                     io_procs: int = 1) -> ReadStream:
+                     io_procs: int = 1,
+                     stringency: str = "strict") -> ReadStream:
     """Open SAM/BAM/Parquet reads as a bounded-memory chunk stream.
 
     ``io_procs > 1`` inflates BGZF (.bam) across a process pool — the
-    byte stream is identical, decode just stops being one-core-bound."""
+    byte stream is identical, decode just stops being one-core-bound.
+    ``stringency`` applies to SAM text parsing (strict/lenient/silent,
+    Bam2Adam.scala:46-47); BAM and Parquet are binary formats whose
+    decode is structurally strict."""
     p = str(path)
     if p.endswith(".bam"):
         from .fastbam import open_bam_arrow_stream
@@ -67,7 +71,8 @@ def open_read_stream(path: str, *, columns: Optional[Sequence[str]] = None,
         return ReadStream(_projected(gen, columns, filters), sd, rg)
     if p.endswith(".sam"):
         from .sam import open_sam_stream
-        sd, rg, gen = open_sam_stream(p, chunk_rows=chunk_rows)
+        sd, rg, gen = open_sam_stream(p, chunk_rows=chunk_rows,
+                                      stringency=stringency)
         return ReadStream(_projected(gen, columns, filters), sd, rg)
     from . import parquet as pqio
     gen = pqio.iter_tables(p, columns=columns, filters=filters,

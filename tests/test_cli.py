@@ -234,3 +234,37 @@ def test_fasta_stream_bounded_rss(tmp_path):
     # accumulate-everything implementation adds ~8 MB per batch
     assert growth_end - growth_at_batch3 < 16_000, \
         (growth_at_batch3, growth_end)
+
+
+def test_bam2adam_stream_differential(resources, tmp_path, capsys):
+    """bam2adam -stream (the bounded-memory path the reference's
+    threaded converter embodies) must write the same rows as the
+    in-memory path, with -io_threads/-io_procs changing nothing."""
+    from adam_tpu.io.parquet import load_table
+
+    run(["bam2adam", resources / "unmapped.sam", tmp_path / "mem.adam"])
+    run(["bam2adam", resources / "unmapped.sam", tmp_path / "st.adam",
+         "-stream", "-stream_chunk_rows", 64])
+    run(["bam2adam", resources / "unmapped.sam", tmp_path / "st2.adam",
+         "-stream", "-stream_chunk_rows", 64, "-io_threads", 2,
+         "-io_procs", 2])
+    capsys.readouterr()
+    mem = load_table(str(tmp_path / "mem.adam"))
+    st = load_table(str(tmp_path / "st.adam"))
+    st2 = load_table(str(tmp_path / "st2.adam"))
+    assert st.equals(mem)
+    assert st2.equals(mem)
+
+
+def test_bam2adam_stream_empty_input_keeps_schema(tmp_path, capsys):
+    """A header-only input must still produce a schema-bearing dataset
+    on the streamed path (review finding: zero parts -> 0-column load)."""
+    from adam_tpu.io.parquet import load_table
+
+    src = tmp_path / "empty.sam"
+    src.write_text("@HD\tVN:1.5\tSO:unsorted\n"
+                   "@SQ\tSN:chr1\tLN:1000\n")
+    run(["bam2adam", src, tmp_path / "e.adam", "-stream"])
+    capsys.readouterr()
+    t = load_table(str(tmp_path / "e.adam"))
+    assert t.num_rows == 0 and t.num_columns == 30
