@@ -375,9 +375,10 @@ def _stage_transform(kind: str, is_tpu: bool):
     import jax
     import jax.numpy as jnp
 
-    from adam_tpu.bqsr.recalibrate import (_count_kernel,
-                                           _count_kernel_matmul,
-                                           _apply_kernel)
+    from adam_tpu.bqsr.recalibrate import (_apply_kernel_lut,
+                                           _build_apply_lut,
+                                           _count_kernel,
+                                           _count_kernel_matmul)
     from adam_tpu.bqsr.table import RecalTable
     from adam_tpu.ops.markdup import _device_fiveprime_and_score
 
@@ -433,6 +434,8 @@ def _stage_transform(kind: str, is_tpu: bool):
     fin_dev = tuple(jnp.asarray(a) for a in (
         fin.rg_delta, fin.qual_delta, fin.cycle_delta, fin.ctx_delta,
         fin.rg_of_qualrg))
+    # the product's pass-2 is the LUT apply (r5); measure what ships
+    lut = _build_apply_lut(n_rg, *fin_dev)
     mask = jnp.ones((n,), bool)
     rtt = _tunnel_rtt()
 
@@ -450,9 +453,9 @@ def _stage_transform(kind: str, is_tpu: bool):
             fp, score = _device_fiveprime_and_score(
                 b["flags"], b["start"] + c, b["cigar_ops"],
                 b["cigar_lens"], b["n_cigar"], q)
-            newq = _apply_kernel(b["bases"], q, b["read_len"],
-                                 b["flags"], b["read_group"], mask,
-                                 *fin_dev)
+            newq = _apply_kernel_lut(b["bases"], q, b["read_len"],
+                                     b["flags"], b["read_group"], mask,
+                                     lut, n_rg=n_rg)
             s = fp.sum().astype(jnp.int32) + score.sum().astype(jnp.int32)
             return newq, s & 3, s
 
@@ -484,9 +487,9 @@ def _stage_transform(kind: str, is_tpu: bool):
                 b["bases"], q, b["read_len"], b["flags"],
                 b["read_group"], b["state"], b["valid"],
                 n_qual_rg=rt.n_qual_rg, n_cycle=rt.n_cycle)
-            newq = _apply_kernel(b["bases"], q, b["read_len"],
-                                 b["flags"], b["read_group"], mask,
-                                 *fin_dev)
+            newq = _apply_kernel_lut(b["bases"], q, b["read_len"],
+                                     b["flags"], b["read_group"], mask,
+                                     lut, n_rg=n_rg)
             s = (fp.sum().astype(jnp.int32) +
                  score.sum().astype(jnp.int32) +
                  sum(x.sum() for x in counts))
