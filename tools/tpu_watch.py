@@ -103,9 +103,14 @@ def main() -> int:
         print(f"captured platform={doc.get('platform')} "
               f"flagstat={doc.get('value')}", flush=True)
         if got_tpu:
+            # VERDICT r4 window priority: (a) bench incl. races — just
+            # landed, commit immediately; (b) the flagstat-v2 roofline +
+            # LUT-apply race (probe suite); (c) the TPU e2e breakdown.
+            # Commit after EACH step: a flap mid-(c) must not cost (b).
             _commit_evidence(repo, [args.out])
-            _capture_e2e(repo)
             _capture_probes(repo)
+            _commit_evidence(repo, ["PROBES_TPU.jsonl"])
+            _capture_e2e(repo)
             _commit_evidence(repo, [args.out, "E2E_BENCH_TPU.json",
                                     "PROBES_TPU.jsonl"])
             if args.once:
