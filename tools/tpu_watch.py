@@ -263,7 +263,7 @@ def _capture_e2e(repo: str) -> None:
     try:
         rc = subprocess.run(
             [sys.executable, os.path.join(repo, "bench_e2e.py"),
-             "--reads", os.environ.get("ADAM_TPU_E2E_TPU_READS", "500000"),
+             "--reads", os.environ.get("ADAM_TPU_E2E_TPU_READS", "250000"),
              "--out", out_path],
             timeout=1500, capture_output=True, text=True, cwd=repo)
     except subprocess.TimeoutExpired:
