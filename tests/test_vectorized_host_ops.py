@@ -186,7 +186,7 @@ def test_snptable_ingest_rss_stays_bounded(tmp_path):
     env = {**__import__('os').environ, "JAX_PLATFORMS": "cpu"}
     # the suite's 8-virtual-device XLA flags inflate the child's baseline
     env.pop("XLA_FLAGS", None)
-    out = subprocess.run([sys.executable, "-c", child], timeout=300,
+    out = subprocess.run([sys.executable, "-c", child], timeout=600,
                          capture_output=True, text=True, env=env)
     assert out.returncode == 0, out.stderr[-500:]
     n_sites, peak_kb = out.stdout.split()[-2:]
