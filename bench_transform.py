@@ -53,7 +53,8 @@ def main() -> None:
     honor_platform_env()      # the axon plugin ignores bare JAX_PLATFORMS
     import jax
     import jax.numpy as jnp
-    from adam_tpu.bqsr.recalibrate import _apply_kernel, _count_kernel
+    from adam_tpu.bqsr.recalibrate import (_apply_kernel_lut,
+                                           _build_apply_lut, _count_kernel)
     from adam_tpu.bqsr.table import RecalTable
     from adam_tpu.ops.markdup import _device_fiveprime_and_score
 
@@ -78,10 +79,13 @@ def main() -> None:
         fin.rg_delta, fin.qual_delta, fin.cycle_delta, fin.ctx_delta,
         fin.rg_of_qualrg))
 
+    lut = _build_apply_lut(N_RG, *fin_dev)   # the product's r5 pass-2
+
     def bqsr_apply(d):
         mask = jnp.ones(d["bases"].shape[:1], bool)
-        return _apply_kernel(d["bases"], d["quals"], d["read_len"],
-                             d["flags"], d["read_group"], mask, *fin_dev)
+        return _apply_kernel_lut(d["bases"], d["quals"], d["read_len"],
+                                 d["flags"], d["read_group"], mask, lut,
+                                 n_rg=N_RG)
 
     def fused(d):
         # the transform pipeline's device work for one batch, one dispatch
