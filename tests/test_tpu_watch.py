@@ -65,3 +65,25 @@ def test_commit_evidence_commits_untracked_files(tmp_path):
     assert "unrelated.txt" not in show
     status = run("status", "--porcelain").stdout
     assert "unrelated.txt" in status
+
+
+def test_save_artifact_never_clobbers_tpu_with_cpu(tmp_path):
+    repo = str(tmp_path)
+    tpu = {"platform": "tpu", "value": 1}
+    cpu = {"platform": "cpu", "value": 2}
+    # nothing yet: CPU fallback saves
+    assert TW._save_artifact(repo, "B.json", cpu) == "saved"
+    # CPU over CPU: newest wins
+    assert TW._save_artifact(repo, "B.json", cpu) == "saved"
+    # TPU over CPU: saves
+    assert TW._save_artifact(repo, "B.json", tpu) == "saved"
+    # CPU over TPU: KEPT — the whole point
+    assert TW._save_artifact(repo, "B.json", cpu) == "kept"
+    assert json.load(open(tmp_path / "B.json"))["platform"] == "tpu"
+    # TPU over TPU: newest wins
+    assert TW._save_artifact(repo, "B.json", {"platform": "tpu",
+                                              "value": 3}) == "saved"
+    assert json.load(open(tmp_path / "B.json"))["value"] == 3
+    # corrupt existing file: overwritten, not fatal
+    (tmp_path / "B.json").write_text("not json{")
+    assert TW._save_artifact(repo, "B.json", cpu) == "saved"

@@ -83,23 +83,9 @@ def main() -> int:
         doc["captured_at"] = time.strftime("%Y-%m-%dT%H:%M:%SZ",
                                            time.gmtime())
         got_tpu = doc.get("platform") == "tpu"
-        out_path = os.path.join(repo, args.out)
-        # never clobber a captured TPU artifact with a CPU-fallback one (a
-        # tunnel flap mid-bench would otherwise destroy the very evidence
-        # this tool exists to preserve)
-        keep = False
-        if not got_tpu and os.path.exists(out_path):
-            try:
-                with open(out_path) as f:
-                    keep = json.load(f).get("platform") == "tpu"
-            except ValueError:
-                pass
-        if keep:
+        if _save_artifact(repo, args.out, doc) == "kept":
             print("bench fell back to CPU; keeping existing TPU artifact",
                   flush=True)
-        else:
-            with open(out_path, "w") as f:
-                json.dump(doc, f, indent=1)
         print(f"captured platform={doc.get('platform')} "
               f"flagstat={doc.get('value')}", flush=True)
         if got_tpu:
@@ -116,6 +102,24 @@ def main() -> int:
             if args.once:
                 return 0
         time.sleep(args.interval)
+
+
+def _save_artifact(repo: str, out_name: str, doc: dict) -> str:
+    """Write the bench artifact UNLESS that would clobber a captured TPU
+    artifact with a CPU-fallback one — a tunnel flap mid-bench would
+    otherwise destroy the very evidence this tool exists to preserve.
+    Returns "saved" or "kept"."""
+    out_path = os.path.join(repo, out_name)
+    if doc.get("platform") != "tpu" and os.path.exists(out_path):
+        try:
+            with open(out_path) as f:
+                if json.load(f).get("platform") == "tpu":
+                    return "kept"
+        except ValueError:
+            pass            # corrupt existing file: overwrite it
+    with open(out_path, "w") as f:
+        json.dump(doc, f, indent=1)
+    return "saved"
 
 
 def _commit_evidence(repo: str, names) -> None:
