@@ -1,0 +1,66 @@
+"""enable_compilation_cache resolution rules, pinned without touching
+the real jax config (a test-session cache dir would leak into every
+later test's compiles)."""
+
+import adam_tpu.platform as P
+
+
+class _Recorder:
+    def __init__(self):
+        self.calls = []
+
+    def __call__(self, key, value):
+        self.calls.append((key, value))
+
+
+def _run(monkeypatch, tmp_path, env=None, platforms_cfg=""):
+    import sys
+    from types import SimpleNamespace
+
+    rec = _Recorder()
+    for k in ("ADAM_TPU_COMPILE_CACHE", "JAX_COMPILATION_CACHE_DIR",
+              "JAX_PLATFORMS"):
+        monkeypatch.delenv(k, raising=False)
+    for k, v in (env or {}).items():
+        monkeypatch.setenv(k, v)
+    # the function does `import jax` internally; a stub keeps the real
+    # session config untouched (jax_platforms is a read-only property,
+    # and a real cache dir would leak into every later test's compiles)
+    fake = SimpleNamespace(config=SimpleNamespace(
+        jax_platforms=platforms_cfg, update=rec))
+    monkeypatch.setitem(sys.modules, "jax", fake)
+    monkeypatch.setattr(P.os.path, "expanduser",
+                        lambda p: p.replace("~", str(tmp_path)))
+    P.enable_compilation_cache()
+    return rec.calls
+
+
+def test_disabled_by_zero(monkeypatch, tmp_path):
+    assert _run(monkeypatch, tmp_path,
+                env={"ADAM_TPU_COMPILE_CACHE": "0"}) == []
+
+
+def test_explicit_path_force_enables_even_on_cpu(monkeypatch, tmp_path):
+    calls = _run(monkeypatch, tmp_path,
+                 env={"ADAM_TPU_COMPILE_CACHE": str(tmp_path / "c"),
+                      "JAX_PLATFORMS": "cpu"},
+                 platforms_cfg="cpu")
+    assert ("jax_compilation_cache_dir", str(tmp_path / "c")) in calls
+
+
+def test_jax_native_env_left_alone(monkeypatch, tmp_path):
+    assert _run(monkeypatch, tmp_path,
+                env={"JAX_COMPILATION_CACHE_DIR": "/elsewhere"}) == []
+
+
+def test_cpu_platform_gate_skips_default(monkeypatch, tmp_path):
+    assert _run(monkeypatch, tmp_path, platforms_cfg="cpu") == []
+    assert _run(monkeypatch, tmp_path,
+                env={"JAX_PLATFORMS": "cpu"}) == []
+
+
+def test_default_enables_for_unforced_platform(monkeypatch, tmp_path):
+    calls = _run(monkeypatch, tmp_path, platforms_cfg="")
+    dirs = [v for k, v in calls if k == "jax_compilation_cache_dir"]
+    assert len(dirs) == 1 and dirs[0].startswith(str(tmp_path))
+    assert ("jax_persistent_cache_min_compile_time_secs", 0.1) in calls
