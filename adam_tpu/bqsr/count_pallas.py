@@ -41,6 +41,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
 from ..packing import _round_up
+from ..platform import pallas_tpu_compiler_params, shard_map
 from .covariates import (MAX_REASONABLE_QSCORE, N_CONTEXT,
                          covariate_tensors)
 from .recalibrate import STATE_MASKED, STATE_MISMATCH
@@ -166,7 +167,7 @@ def _count_call(word3, wbits3, q_rows: int, cyc_bins: int,
         out_shape=(jax.ShapeDtypeStruct((q_rows, cat_cols), jnp.int32),
                    jax.ShapeDtypeStruct((q_rows, cat_cols), jnp.int32),
                    jax.ShapeDtypeStruct((8, 256), jnp.int32)),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=pallas_tpu_compiler_params(
             dimension_semantics=("arbitrary",)),
         interpret=interpret,
     )(word3, wbits3)
@@ -331,7 +332,7 @@ def _rows_call(quals2, cb2, sw2, q_rows: int, cyc_bins: int,
         out_shape=(jax.ShapeDtypeStruct((q_rows, cat_cols), jnp.int32),
                    jax.ShapeDtypeStruct((q_rows, cat_cols), jnp.int32),
                    jax.ShapeDtypeStruct((8, 256), jnp.int32)),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=pallas_tpu_compiler_params(
             dimension_semantics=("arbitrary",)),
         interpret=interpret,
     )(quals2, cb2, sw2)
@@ -408,6 +409,6 @@ def sharded_count_pallas(mesh, n_qual_rg: int, n_cycle: int,
         return tuple(jax.lax.psum(o, READS_AXIS) for o in out)
 
     spec = P(READS_AXIS)
-    return jax.jit(jax.shard_map(
+    return jax.jit(shard_map(
         fn, mesh=mesh, in_specs=(spec,) * 7, out_specs=(P(),) * 7,
         check_vma=False))

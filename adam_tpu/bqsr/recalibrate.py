@@ -31,6 +31,7 @@ import pyarrow as pa
 
 from .. import schema as S
 from ..models.snptable import SnpTable
+from ..platform import shard_map
 from ..ops import cigar as C
 from ..packing import ReadBatch, pack_reads
 from ..util.mdtag import MdTag
@@ -560,7 +561,7 @@ def _sharded_count_fn(kernel, mesh, n_qual_rg: int, n_cycle: int):
     from ..parallel.mesh import READS_AXIS
 
     spec = P(READS_AXIS)
-    fn = jax.shard_map(
+    fn = shard_map(
         partial(kernel, n_qual_rg=n_qual_rg, n_cycle=n_cycle,
                 axis_name=READS_AXIS),
         mesh=mesh, in_specs=(spec,) * 7, out_specs=(P(),) * 7)
@@ -815,7 +816,7 @@ def _sharded_apply_fn(mesh, n_rg: int):
 
     from ..parallel.mesh import READS_AXIS
     spec = P(READS_AXIS)
-    return jax.jit(jax.shard_map(
+    return jax.jit(shard_map(
         partial(_apply_kernel_lut, n_rg=n_rg), mesh=mesh,
         in_specs=(spec,) * 6 + (P(),), out_specs=spec))
 

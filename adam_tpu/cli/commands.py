@@ -165,6 +165,11 @@ class Bam2AdamCommand(Command):
                 from ..parallel.ingest import pipelined
                 chunks = pipelined(chunks, workers=args.io_threads)
             n = 0
+            import time as _time
+
+            from .. import obs
+
+            t0 = _time.perf_counter()
             with DatasetWriter(args.output,
                                part_rows=args.stream_chunk_rows,
                                row_group_bytes=args.parquet_block_size,
@@ -172,11 +177,15 @@ class Bam2AdamCommand(Command):
                 for t in chunks:
                     out.write(t)
                     n += t.num_rows
+                    obs.chunk_processed("bam2adam", t.num_rows,
+                                        bytes_in=t.nbytes)
                 if n == 0:
                     # a header-only (or all-dropped) input must still
                     # yield a schema-bearing dataset, like the
                     # in-memory path's one empty part
                     out.write(S.READ_SCHEMA.empty_table())
+            obs.run_totals("bam2adam", n, _time.perf_counter() - t0,
+                           input_path=args.input, output_path=args.output)
             print(f"wrote {n} reads to {args.output}")
             return 0
         from ..io.dispatch import load_reads
@@ -275,16 +284,16 @@ class TransformCommand(Command):
                 io_threads=args.io_threads,
                 io_procs=args.io_procs)
             if args.timing:
-                from ..instrument import report
-                print(report().format())
+                from ..instrument import print_report
+                print_report()   # one quiet gate for ALL instrument output
             print(f"wrote {n} reads to {args.output}")
             return 0
         return self._run_inmemory(args)
 
     def _run_inmemory(self, args) -> int:
         from ..checkpoint import CheckpointDir, run_stages
-        from ..instrument import (device_trace, report, set_sync_timing,
-                                  stage)
+        from ..instrument import (device_trace, print_report,
+                                  set_sync_timing, stage)
         if args.timing:
             set_sync_timing(True)
         from ..io.dispatch import load_reads, sequence_dictionary_from_reads
@@ -369,7 +378,7 @@ class TransformCommand(Command):
                     save_with_args(table, args.output, args,
                                    n_parts=args.coalesce or args.parts)
         if args.timing:
-            print(report().format())
+            print_report()       # quiet-gated, like every instrument print
         print(f"wrote {table.num_rows} reads to {args.output}")
         return 0
 

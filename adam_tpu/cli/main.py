@@ -54,6 +54,12 @@ def main(argv=None) -> int:
         cmd = _COMMANDS[name]()
         p = sub.add_parser(name, help=cmd.help)
         cmd.add_args(p)
+        # every command gets the telemetry flag (one place, not N):
+        # a run manifest + per-stage/per-chunk events + final metrics
+        # snapshot, as schema-versioned JSONL (docs/OBSERVABILITY.md)
+        p.add_argument("-metrics", default=None, metavar="PATH",
+                       help="write run telemetry (JSONL manifest/events/"
+                            "metrics snapshot) to PATH")
         p.set_defaults(_cmd=cmd)
     args = parser.parse_args(argv)
     if not getattr(args, "_cmd", None):
@@ -69,10 +75,18 @@ def main(argv=None) -> int:
     enable_compilation_cache()
     from ..errors import FormatError
     from ..instrument import log_invocation
-    log_invocation(["adam-tpu"] + list(argv if argv is not None
-                                       else sys.argv[1:]))
+    from ..obs import metrics_path_from, metrics_run
+    full_argv = ["adam-tpu"] + list(argv if argv is not None
+                                    else sys.argv[1:])
+    log_invocation(full_argv)
+    # the config fingerprint covers every parsed flag, so two runs with
+    # the same manifest fingerprint really ran the same configuration
+    config = {k: v for k, v in vars(args).items()
+              if not k.startswith("_") and k != "metrics"}
     try:
-        return args._cmd.run(args) or 0
+        with metrics_run(metrics_path_from(args.metrics), argv=full_argv,
+                         config=config, command=args.command):
+            return args._cmd.run(args) or 0
     except (FileNotFoundError, IsADirectoryError, FormatError) as e:
         print(f"adam-tpu {args.command}: {e}", file=sys.stderr)
         return 2

@@ -28,6 +28,8 @@ import time
 from dataclasses import dataclass, field
 from typing import Dict, Iterator, List, Optional
 
+from .obs import stage_finished as _obs_stage_finished
+
 
 @dataclass
 class StageStats:
@@ -63,6 +65,26 @@ class PipelineReport:
 
 
 _REPORT = PipelineReport()
+
+
+def quiet() -> bool:
+    """THE stderr gate: every instrument print routes through here, so
+    ``ADAM_TPU_QUIET`` silences all of it — log_invocation honored it
+    while device_trace and the CLI's report print did not (one env var,
+    three behaviors was a bug)."""
+    return bool(os.environ.get("ADAM_TPU_QUIET"))
+
+
+def say(msg: str) -> None:
+    """Quiet-gated stderr print; the single exit for instrument chatter."""
+    if not quiet():
+        print(msg, file=sys.stderr)
+
+
+def print_report() -> None:
+    """The CLI's ``-timing`` output, through the same quiet gate."""
+    if not quiet():
+        print(_REPORT.format())
 
 #: whether ``stage(sync=True)`` actually drains device queues.  Accurate
 #: per-stage attribution costs a host/device barrier per stage entry+exit,
@@ -101,7 +123,13 @@ def stage(name: str, *, sync: bool = False) -> Iterator[None]:
             _block_on_device()
         _REPORT._stack.pop()
         node.calls += 1
-        node.seconds += time.perf_counter() - t0
+        dt = time.perf_counter() - t0
+        node.seconds += dt
+        # the metrics plane sees every stage too: counters/histograms in
+        # the process registry (merge-able across workers) plus a JSONL
+        # event when a -metrics log is open (a few dict ops; the report
+        # tree stays the -timing formatter's source)
+        _obs_stage_finished(name, dt)
 
 
 def _block_on_device() -> None:
@@ -128,13 +156,11 @@ def device_trace(trace_dir: Optional[str]) -> Iterator[None]:
         yield
     finally:
         jax.profiler.stop_trace()
-        print(f"device trace written to {trace_dir}", file=sys.stderr)
+        say(f"device trace written to {trace_dir}")
 
 
 def log_invocation(argv: Optional[List[str]] = None) -> None:
     """AdamMain parity: record the exact argv for reproduction
     (AdamMain.scala:55,66-71)."""
     argv = sys.argv if argv is None else argv
-    if os.environ.get("ADAM_TPU_QUIET"):
-        return
-    print(f"adam-tpu invocation: {' '.join(argv)}", file=sys.stderr)
+    say(f"adam-tpu invocation: {' '.join(argv)}")

@@ -1,0 +1,255 @@
+"""``adam_tpu.obs`` — pipeline-wide metrics and structured run telemetry.
+
+Two halves, both process-global and always importable without jax:
+
+* :mod:`.registry` — counters / gauges / histograms with labels, the
+  merge-able metrics plane (worker snapshots fold into the coordinator,
+  parallel/distributed.py);
+* :mod:`.events` — the opt-in JSONL event log behind the CLI's
+  ``-metrics PATH`` flag (manifest, per-stage / per-chunk events, final
+  summary with the registry snapshot).
+
+Wiring (who reports what):
+
+* ``instrument.stage`` → ``stage_calls`` / ``stage_seconds{stage=}`` +
+  a ``stage`` event per call;
+* streaming passes (parallel/pipeline.py) → ``chunk_rows`` /
+  ``bytes_in`` / ``bytes_out`` / ``pad_waste_frac`` / ``reads_per_sec``
+  + a ``chunk`` event per chunk;
+* platform.py → ``compile_cache_hits`` / ``compile_cache_misses`` /
+  ``compile_count`` / ``compile_seconds`` via jax.monitoring;
+* the summary → ``device_mem_peak`` (best effort).
+
+Everything here is telemetry: failures degrade to no-ops, nothing takes
+a device barrier, and with no ``-metrics`` flag the event half is dead
+weightless code (tests/test_obs.py pins both properties).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import time
+from typing import Iterator, Optional
+
+from . import events
+from .registry import (counter, gauge, histogram, registry,  # noqa: F401
+                       reset_registry)
+
+#: env fallback for the CLI flag — lets bench workers and elastic worker
+#: subprocesses write a sidecar without threading a flag through argv
+METRICS_ENV = "ADAM_TPU_METRICS"
+
+emit = events.emit
+
+
+def reset_all() -> None:
+    """Zero every piece of process-global telemetry (test isolation)."""
+    reset_registry()
+    events.discard_log()
+
+
+# ---------------------------------------------------------------------------
+# hooks for the instrument / pipeline layers
+# ---------------------------------------------------------------------------
+
+def stage_finished(name: str, seconds: float) -> None:
+    """Called by ``instrument.stage`` on every stage exit."""
+    registry().counter("stage_calls", stage=name).inc()
+    registry().histogram("stage_seconds", stage=name).observe(seconds)
+    events.emit("stage", name=name, seconds=round(seconds, 6))
+
+
+def chunk_processed(pass_name: str, rows: int, *,
+                    pad_rows: Optional[int] = None,
+                    bytes_in: int = 0, seconds: Optional[float] = None
+                    ) -> None:
+    """Per-chunk accounting from the streaming passes.
+
+    ``pad_rows=None`` means the caller did not measure padding — no
+    ``pad_waste_frac`` sample is recorded (an unconditional 0.0 would
+    drown the real samples and halve the reported mean waste)."""
+    r = registry()
+    r.counter("chunks", **{"pass": pass_name}).inc()
+    r.counter("rows_in", **{"pass": pass_name}).inc(rows)
+    r.histogram("chunk_rows", **{"pass": pass_name}).observe(rows)
+    if bytes_in:
+        r.counter("bytes_in", **{"pass": pass_name}).inc(bytes_in)
+    if pad_rows is not None and rows + pad_rows:
+        r.histogram("pad_waste_frac",
+                    **{"pass": pass_name}).observe(pad_rows / (rows + pad_rows))
+    fields = {"pass": pass_name, "rows": rows}
+    if pad_rows:
+        fields["pad_rows"] = pad_rows
+    if bytes_in:
+        fields["bytes_in"] = bytes_in
+    if seconds is not None:
+        fields["seconds"] = round(seconds, 6)
+    events.emit("chunk", **fields)
+
+
+def pad_waste(pass_name: str, rows: int, padded_rows: int) -> None:
+    """Bucket-padding accounting: the fraction of a packed chunk that is
+    padding (wasted device work), from pipeline.pad_bucket consumers."""
+    if padded_rows > 0:
+        r = registry()
+        r.histogram("pad_waste_frac", **{"pass": pass_name}).observe(
+            (padded_rows - rows) / padded_rows)
+        r.counter("pad_rows", **{"pass": pass_name}).inc(padded_rows - rows)
+
+
+def _path_bytes(path: Optional[str]) -> int:
+    if not path:
+        return 0
+    try:
+        if os.path.isdir(path):
+            return sum(os.path.getsize(os.path.join(path, f))
+                       for f in os.listdir(path) if f.endswith(".parquet"))
+        return os.path.getsize(path)
+    except OSError:
+        return 0
+
+
+def run_totals(op: str, rows: int, wall_seconds: float,
+               input_path: Optional[str] = None,
+               output_path: Optional[str] = None) -> None:
+    """End-of-run rollup for a streaming command: total rows, headline
+    throughput gauge, file-level bytes in/out."""
+    r = registry()
+    r.counter("rows_total", op=op).inc(rows)
+    if wall_seconds > 0:
+        r.gauge("reads_per_sec", op=op).set(rows / wall_seconds)
+    b_in = _path_bytes(input_path)
+    if b_in:
+        r.counter("bytes_in", op=op).inc(b_in)
+    b_out = _path_bytes(output_path)
+    if b_out:
+        r.counter("bytes_out", op=op).inc(b_out)
+    events.emit("run_totals", op=op, rows=rows,
+                wall_seconds=round(wall_seconds, 6),
+                bytes_in=b_in, bytes_out=b_out)
+
+
+def record_device_mem_peak() -> None:
+    """Fold each local device's peak-bytes-in-use into a gauge (max-merge
+    across workers gives the fleet peak).  CPU backends typically return
+    no stats — that is fine, the gauge just stays unset."""
+    try:
+        import jax
+
+        peak = 0
+        for d in jax.local_devices():
+            stats = d.memory_stats()
+            if stats:
+                peak = max(peak, stats.get("peak_bytes_in_use", 0))
+        if peak:
+            registry().gauge("device_mem_peak").set(peak)
+    except Exception:  # noqa: BLE001 — telemetry never fails a run
+        pass
+
+
+# ---------------------------------------------------------------------------
+# the run wrapper (CLI -metrics, bench sidecars, worker env)
+# ---------------------------------------------------------------------------
+
+@contextlib.contextmanager
+def metrics_run(path: Optional[str], *, argv=None,
+                config: Optional[dict] = None, **manifest_extra
+                ) -> Iterator[Optional[events.EventLog]]:
+    """Open the event log, write the manifest, run, close with a summary.
+
+    ``path=None`` is a no-op context (the common, un-flagged case).  The
+    summary event carries the wall time, an ``ok`` flag, and the full
+    registry snapshot; the file publishes atomically on exit even when
+    the body raises, so a failed run still leaves valid telemetry.
+    """
+    if not path:
+        yield None
+        return
+    try:
+        from ..platform import install_compile_metrics
+
+        install_compile_metrics()
+    except Exception:  # noqa: BLE001
+        pass
+    log = events.open_log(path)
+    events.write_manifest(log, argv=argv, config=config, **manifest_extra)
+    t0 = time.perf_counter()
+    ok = True
+    err = None
+    try:
+        yield log
+    except BaseException as e:
+        ok = False
+        err = f"{type(e).__name__}: {e}"
+        raise
+    finally:
+        record_device_mem_peak()
+        fields = dict(wall_seconds=round(time.perf_counter() - t0, 6),
+                      ok=ok, metrics=registry().snapshot())
+        if err:
+            fields["error"] = err[:500]
+        log.emit("summary", **fields)
+        log.close()
+        if events.active() is log:
+            events.close_log()
+
+
+def metrics_path_from(flag_value: Optional[str]) -> Optional[str]:
+    """The CLI flag wins; the ``ADAM_TPU_METRICS`` env var is the fallback
+    (how bench workers and elastic workers get a per-process sidecar)."""
+    return flag_value or os.environ.get(METRICS_ENV) or None
+
+
+def metrics_run_from_env(**kw):
+    """:func:`metrics_run` keyed purely off ``ADAM_TPU_METRICS`` — what a
+    spawned worker (bench subprocess, elastic incarnation) uses when no
+    CLI flag reaches it.  No-op context when the var is unset."""
+    return metrics_run(metrics_path_from(None), **kw)
+
+
+# ---------------------------------------------------------------------------
+# snapshot-file merge (elastic supervisor side)
+# ---------------------------------------------------------------------------
+
+def read_snapshot_file(path: str) -> Optional[dict]:
+    """The registry snapshot recorded in a finished run's JSONL (its
+    summary event's ``metrics`` field) or in a bare snapshot JSON file;
+    ``None`` when the file is missing, torn, or carries no snapshot."""
+    import json
+
+    try:
+        with open(path) as f:
+            lines = [ln for ln in f if ln.strip()]
+    except OSError:
+        return None
+    for ln in reversed(lines):
+        try:
+            doc = json.loads(ln)
+        except ValueError:
+            continue
+        if doc.get("event") == "summary" and "metrics" in doc:
+            return doc["metrics"]
+        if {"counters", "gauges", "histograms"} & set(doc):
+            return doc  # a bare registry snapshot file
+    return None
+
+
+def snapshot_is_fleet_merged(snap: dict) -> bool:
+    """Whether this snapshot already holds fleet totals (its process ran
+    ``distributed.merge_worker_metrics``, which stamps the marker gauge).
+    Folding two fleet views double-counts — aggregators must merge at
+    most one (parallel/elastic.py's supervisor does)."""
+    return (snap.get("gauges") or {}).get("fleet_merged", 0) >= 1
+
+
+def merge_metrics_file(path: str) -> bool:
+    """Fold a finished run's JSONL (or bare snapshot JSON) into THIS
+    process's registry.  Returns True when something merged.  This is how
+    the elastic supervisor aggregates worker sidecars after an
+    incarnation completes (parallel/elastic.py)."""
+    snap = read_snapshot_file(path)
+    if snap is None:
+        return False
+    registry().merge(snap)
+    return True

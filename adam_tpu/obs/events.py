@@ -1,0 +1,162 @@
+"""Structured JSONL run-telemetry log (the ``-metrics PATH`` sink).
+
+One run = one JSONL file:
+
+  line 1   ``manifest``  — schema version, argv, config fingerprint,
+                           backend/mesh shape (best effort), git rev
+  lines    ``stage`` / ``chunk`` / domain events as the run progresses
+  last     ``summary``   — wall time plus the full registry snapshot
+
+Atomicity: events append to ``PATH.tmp`` (each line flushed whole, so a
+tail is readable mid-run) and the file publishes to ``PATH`` by
+fsync+rename on close — a crashed run leaves the partial ``.tmp``, never
+a truncated final artifact.  ``tools/check_metrics.py`` validates the
+published file against this schema (documented in
+docs/OBSERVABILITY.md); bump ``SCHEMA_VERSION`` on any breaking change.
+
+The sink is process-global and opt-in: ``emit`` is a no-op until a log
+is open, so hot paths call it unconditionally.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import socket
+import subprocess
+import sys
+import threading
+import time
+from typing import Optional
+
+SCHEMA_VERSION = 1
+
+_LOCK = threading.Lock()
+_LOG: "Optional[EventLog]" = None
+
+
+class EventLog:
+    def __init__(self, path: str):
+        self.path = path
+        self.tmp = path + ".tmp"
+        parent = os.path.dirname(os.path.abspath(path))
+        os.makedirs(parent, exist_ok=True)
+        self._f = open(self.tmp, "w")
+        self._t0 = time.time()
+        self._closed = False
+
+    def emit(self, event: str, **fields) -> None:
+        if self._closed:
+            return
+        line = json.dumps({"event": event,
+                           "t": round(time.time() - self._t0, 6),
+                           **fields}, default=str)
+        with _LOCK:
+            self._f.write(line + "\n")
+            self._f.flush()
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        with _LOCK:
+            self._f.flush()
+            os.fsync(self._f.fileno())
+            self._f.close()
+        os.replace(self.tmp, self.path)
+
+
+def open_log(path: str) -> EventLog:
+    """Open the process-global event log (closing any previous one)."""
+    global _LOG
+    if _LOG is not None:
+        _LOG.close()
+    _LOG = EventLog(path)
+    return _LOG
+
+
+def active() -> Optional[EventLog]:
+    return _LOG
+
+
+def emit(event: str, **fields) -> None:
+    """Append one event; no-op when no log is open (the common case)."""
+    if _LOG is not None:
+        _LOG.emit(event, **fields)
+
+
+def close_log() -> None:
+    global _LOG
+    if _LOG is not None:
+        _LOG.close()
+        _LOG = None
+
+
+def discard_log() -> None:
+    """Drop an open log without publishing (test isolation)."""
+    global _LOG
+    if _LOG is not None:
+        _LOG._closed = True
+        try:
+            _LOG._f.close()
+            os.unlink(_LOG.tmp)
+        except OSError:
+            pass
+        _LOG = None
+
+
+# ---------------------------------------------------------------------------
+# manifest helpers
+# ---------------------------------------------------------------------------
+
+def config_fingerprint(config: Optional[dict]) -> str:
+    blob = json.dumps(config or {}, sort_keys=True, default=str)
+    return hashlib.sha256(blob.encode()).hexdigest()[:16]
+
+
+def _git_rev() -> Optional[str]:
+    try:
+        out = subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"],
+            cwd=os.path.dirname(os.path.dirname(
+                os.path.dirname(os.path.abspath(__file__)))),
+            capture_output=True, text=True, timeout=5)
+        return out.stdout.strip() or None
+    except Exception:  # noqa: BLE001 — telemetry never fails a run
+        return None
+
+
+def _backend_info() -> dict:
+    """Backend + mesh shape, best effort.  Only queried when a metrics log
+    was requested (a run follows, so initializing the backend here is not
+    an extra cost); any failure degrades to nulls."""
+    info: dict = {"backend": None, "n_devices": None, "device_kind": None,
+                  "process_index": 0, "process_count": 1}
+    try:
+        import jax
+
+        info["backend"] = jax.default_backend()
+        devs = jax.devices()
+        info["n_devices"] = len(devs)
+        info["device_kind"] = getattr(devs[0], "device_kind", None)
+        info["process_index"] = jax.process_index()
+        info["process_count"] = jax.process_count()
+    except Exception:  # noqa: BLE001
+        pass
+    return info
+
+
+def write_manifest(log: EventLog, argv=None, config: Optional[dict] = None,
+                   **extra) -> None:
+    log.emit("manifest",
+             schema=SCHEMA_VERSION,
+             time=time.strftime("%Y-%m-%dT%H:%M:%S%z"),
+             argv=list(argv if argv is not None else sys.argv),
+             config=config or {},
+             config_fingerprint=config_fingerprint(config),
+             git_rev=_git_rev(),
+             host=socket.gethostname(),
+             pid=os.getpid(),
+             **_backend_info(),
+             **extra)

@@ -1,0 +1,233 @@
+"""Process-global metrics registry: counters, gauges, histograms with labels.
+
+The reference had no metrics plane at all — observability was log4j
+printlns plus the Spark web UI (instrument.py's note); our rebuild's
+telemetry so far was one private stage-timer tree.  This registry is the
+substrate the whole pipeline reports through: `instrument.stage` feeds
+per-stage counters/histograms, the streaming passes feed per-chunk
+throughput and padding waste, platform.py feeds compile-cache hits and
+compile wall-time, and the distributed layer merges per-worker snapshots
+into the coordinator's registry (parallel/distributed.py).
+
+Three metric kinds, three merge semantics (the monoid each one is):
+
+* Counter   — monotonic float/int; merge = sum (an executor-map count)
+* Gauge     — last-set value; merge = max (peaks: device_mem_peak)
+* Histogram — sparse power-of-two buckets + count/sum/min/max;
+              merge = bucket-wise add (exact, like the 18x2 flagstat
+              counter block)
+
+Updates are a dict lookup plus a float add — cheap enough to leave on
+unconditionally, like the stage timers; nothing here ever touches a
+device (the no-barrier guarantee is pinned by tests/test_obs.py).
+
+Keys are Prometheus-style ``name{label=value,...}`` strings, which makes
+snapshots JSON-plain and lets `merge` work on keys without parsing
+labels back out.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+from typing import Dict, Optional
+
+
+def _key(name: str, labels: dict) -> str:
+    if not labels:
+        return name
+    inner = ",".join(f"{k}={labels[k]}" for k in sorted(labels))
+    return f"{name}{{{inner}}}"
+
+
+class Counter:
+    __slots__ = ("key", "value", "_lock")
+
+    def __init__(self, key: str):
+        self.key = key
+        self.value = 0.0
+        self._lock = threading.Lock()
+
+    def inc(self, v: float = 1.0) -> None:
+        # locked like Histogram.observe: the pipelined ingest pool calls
+        # inc from worker threads, and += is a read-add-store
+        with self._lock:
+            self.value += v
+
+
+class Gauge:
+    __slots__ = ("key", "value", "_lock")
+
+    def __init__(self, key: str):
+        self.key = key
+        self.value = 0.0
+        self._lock = threading.Lock()
+
+    def set(self, v: float) -> None:
+        with self._lock:
+            self.value = float(v)
+
+
+class Histogram:
+    """Sparse base-2 exponential histogram.
+
+    A value lands in the bucket of its binary exponent (``frexp``), so one
+    dict covers microsecond stage times and million-row chunk counts alike;
+    bucket-wise addition makes the merge exact.  Non-positive values get
+    their own sentinel bucket — zero-waste chunks must not share a bucket
+    with the (0.5, 1] range, which is exactly what ``pad_waste_frac``
+    exists to expose.
+    """
+
+    __slots__ = ("key", "count", "sum", "min", "max", "buckets", "_lock")
+
+    def __init__(self, key: str):
+        self.key = key
+        self.count = 0
+        self.sum = 0.0
+        self.min = math.inf
+        self.max = -math.inf
+        self.buckets: Dict[int, int] = {}
+        self._lock = threading.Lock()
+
+    #: bucket for v <= 0 — below every frexp exponent a positive double
+    #: can produce (the smallest subnormal's is -1073), so it never
+    #: collides with a real value bucket
+    NONPOS_BUCKET = -1075
+
+    def observe(self, v: float) -> None:
+        v = float(v)
+        # v in (2^(b-1), 2^b]; non-positive → the sentinel bucket
+        b = self.NONPOS_BUCKET if v <= 0.0 else math.frexp(v)[1]
+        with self._lock:
+            self.count += 1
+            self.sum += v
+            self.min = min(self.min, v)
+            self.max = max(self.max, v)
+            self.buckets[b] = self.buckets.get(b, 0) + 1
+
+    def mean(self) -> float:
+        return self.sum / self.count if self.count else 0.0
+
+    def to_dict(self) -> dict:
+        return {"count": self.count, "sum": self.sum,
+                "min": self.min if self.count else None,
+                "max": self.max if self.count else None,
+                "buckets": {str(b): n for b, n in sorted(self.buckets.items())}}
+
+
+class MetricsRegistry:
+    """One per process (module-global below), like instrument._REPORT."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counters: Dict[str, Counter] = {}
+        self._gauges: Dict[str, Gauge] = {}
+        self._histograms: Dict[str, Histogram] = {}
+        #: bumped on every reset() — lets once-per-run consumers (the
+        #: distributed metrics merge) tell "same run" from "fresh run"
+        self.generation = 0
+
+    # -- get-or-create accessors -------------------------------------------
+
+    def counter(self, name: str, **labels) -> Counter:
+        key = _key(name, labels)
+        c = self._counters.get(key)
+        if c is None:
+            with self._lock:
+                c = self._counters.setdefault(key, Counter(key))
+        return c
+
+    def gauge(self, name: str, **labels) -> Gauge:
+        key = _key(name, labels)
+        g = self._gauges.get(key)
+        if g is None:
+            with self._lock:
+                g = self._gauges.setdefault(key, Gauge(key))
+        return g
+
+    def histogram(self, name: str, **labels) -> Histogram:
+        key = _key(name, labels)
+        h = self._histograms.get(key)
+        if h is None:
+            with self._lock:
+                h = self._histograms.setdefault(key, Histogram(key))
+        return h
+
+    # -- snapshot / merge ---------------------------------------------------
+
+    def snapshot(self) -> dict:
+        """JSON-plain view: the wire format between workers and the
+        coordinator, and the ``metrics`` field of the JSONL summary event
+        (docs/OBSERVABILITY.md)."""
+        return {
+            "counters": {k: c.value for k, c in self._counters.items()},
+            "gauges": {k: g.value for k, g in self._gauges.items()},
+            "histograms": {k: h.to_dict()
+                           for k, h in self._histograms.items()},
+        }
+
+    def merge(self, snap: dict) -> None:
+        """Fold another process's snapshot in: counters sum, gauges max,
+        histograms bucket-add.  Keys need no parsing — they are identity."""
+        for k, v in (snap.get("counters") or {}).items():
+            c = self._counters.get(k)
+            if c is None:
+                with self._lock:
+                    c = self._counters.setdefault(k, Counter(k))
+            c.inc(v)
+        for k, v in (snap.get("gauges") or {}).items():
+            g = self._gauges.get(k)
+            if g is None:
+                with self._lock:
+                    g = self._gauges.setdefault(k, Gauge(k))
+            with g._lock:
+                g.value = max(g.value, v)
+        for k, d in (snap.get("histograms") or {}).items():
+            h = self._histograms.get(k)
+            if h is None:
+                with self._lock:
+                    h = self._histograms.setdefault(k, Histogram(k))
+            with h._lock:
+                h.count += d.get("count", 0)
+                h.sum += d.get("sum", 0.0)
+                if d.get("min") is not None:
+                    h.min = min(h.min, d["min"])
+                if d.get("max") is not None:
+                    h.max = max(h.max, d["max"])
+                for b, n in (d.get("buckets") or {}).items():
+                    b = int(b)
+                    h.buckets[b] = h.buckets.get(b, 0) + n
+
+    def reset(self) -> None:
+        with self._lock:
+            self._counters.clear()
+            self._gauges.clear()
+            self._histograms.clear()
+            self.generation += 1
+
+    def is_empty(self) -> bool:
+        return not (self._counters or self._gauges or self._histograms)
+
+
+_REGISTRY = MetricsRegistry()
+
+
+def registry() -> MetricsRegistry:
+    return _REGISTRY
+
+
+def counter(name: str, **labels) -> Counter:
+    return _REGISTRY.counter(name, **labels)
+
+
+def gauge(name: str, **labels) -> Gauge:
+    return _REGISTRY.gauge(name, **labels)
+
+
+def histogram(name: str, **labels) -> Histogram:
+    return _REGISTRY.histogram(name, **labels)
+
+
+def reset_registry() -> None:
+    _REGISTRY.reset()

@@ -23,6 +23,7 @@ import numpy as np
 
 from .. import schema as S
 from ..packing import ReadBatch
+from ..platform import shard_map
 
 #: counter order in the [K] axis of the kernel output
 COUNTER_NAMES = (
@@ -271,7 +272,7 @@ def flagstat_sharded(mesh):
     from jax.sharding import PartitionSpec as P
     from ..parallel.mesh import READS_AXIS
     spec = P(READS_AXIS)
-    fn = jax.shard_map(
+    fn = shard_map(
         partial(flagstat_kernel, axis_name=READS_AXIS), mesh=mesh,
         in_specs=(spec, spec, spec, spec, spec), out_specs=P())
     return jax.jit(fn)
@@ -283,7 +284,7 @@ def flagstat_wire32_sharded(mesh):
     path — reference: executor map + driver aggregate, FlagStat.scala:102)."""
     from jax.sharding import PartitionSpec as P
     from ..parallel.mesh import READS_AXIS
-    fn = jax.shard_map(
+    fn = shard_map(
         partial(flagstat_kernel_wire32, axis_name=READS_AXIS), mesh=mesh,
         in_specs=(P(READS_AXIS),), out_specs=P())
     return jax.jit(fn)

@@ -31,6 +31,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.experimental import pallas as pl
 
+from ..platform import pallas_tpu_compiler_params, shard_map
 from .flagstat import flagstat_kernel_wire32
 
 LANES = 1024
@@ -185,7 +186,7 @@ def _blocked_call_v2(wire3d, *, interpret: bool):
                                lambda i: (i, 0, 0))],
         out_specs=pl.BlockSpec((36, LANES), lambda i: (0, 0)),
         out_shape=jax.ShapeDtypeStruct((36, LANES), jnp.int32),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=pallas_tpu_compiler_params(
             dimension_semantics=("arbitrary",)),
         interpret=interpret,
     )(wire3d)
@@ -224,7 +225,7 @@ def _blocked_call(wire3d, *, interpret: bool):
                                lambda i: (i, 0, 0))],
         out_specs=pl.BlockSpec(memory_space=pltpu.SMEM),
         out_shape=jax.ShapeDtypeStruct((18, 2), jnp.int32),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=pallas_tpu_compiler_params(
             dimension_semantics=("arbitrary",)),
         interpret=interpret,
     )(wire3d)
@@ -276,7 +277,7 @@ def flagstat_wire32_sharded_pallas(mesh, interpret: bool = False):
     # actually reaches the kernel (>= one VMEM block).  Shards below one
     # block take the XLA tail and never trip it — which is why only a
     # full-block dryrun caught this.
-    f = jax.shard_map(fn, mesh=mesh, in_specs=(P(READS_AXIS),),
+    f = shard_map(fn, mesh=mesh, in_specs=(P(READS_AXIS),),
                       out_specs=P(), check_vma=False)
     return jax.jit(f)
 

@@ -41,6 +41,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
+from ..platform import axis_size, shard_map
 from .mesh import READS_AXIS
 
 HOST_AXIS = "host"
@@ -103,6 +104,97 @@ def make_host_mesh(devices=None) -> Mesh:
             "(host, chip) mesh needs the same chips per host")
     grid = np.array([by_proc[p] for p in sorted(by_proc)], dtype=object)
     return Mesh(grid, (HOST_AXIS, CHIP_AXIS))
+
+
+# --------------------------------------------------------------------------
+# per-worker metrics gather (coordination-service control plane)
+# --------------------------------------------------------------------------
+
+#: monotonic sequence so repeated gathers use fresh KV keys (every process
+#: calls in the same program order, so sequence numbers agree)
+_METRICS_GATHER_SEQ = [0]
+
+
+def gather_metrics_snapshots(timeout_ms: int = 60_000) -> list:
+    """Every process's obs-registry snapshot, gathered over the
+    coordination service's key-value store.
+
+    This is deliberately the CONTROL plane (the same gRPC service
+    ``jax.distributed.initialize`` brought up), not a device collective:
+    snapshots are small JSON, the gather happens once per run at report
+    time, and the KV path works on every backend — including CPU jaxlibs
+    whose XLA build has no multiprocess computations.  The reference's
+    analog is executors shipping accumulator updates to the driver.
+    Single-process runs return ``[own snapshot]`` without any service.
+    """
+    import json
+
+    from ..obs.registry import registry
+
+    snap = registry().snapshot()
+    if jax.process_count() == 1:
+        return [snap]
+    from jax._src import distributed as _dist
+
+    client = _dist.global_state.client
+    if client is None:
+        raise RuntimeError(
+            "metrics gather needs the coordination service; call "
+            "initialize() (or pass a coordinator address) first")
+    seq = _METRICS_GATHER_SEQ[0]
+    _METRICS_GATHER_SEQ[0] += 1
+    prefix = f"adam_tpu/obs/{seq}"
+    client.key_value_set(f"{prefix}/{jax.process_index()}",
+                         json.dumps(snap))
+    snaps = []
+    for pid in range(jax.process_count()):
+        if pid == jax.process_index():
+            snaps.append(snap)
+        else:
+            snaps.append(json.loads(client.blocking_key_value_get(
+                f"{prefix}/{pid}", timeout_ms)))
+    return snaps
+
+
+#: registry generation at the last fold — the once-per-run guard below
+_LAST_MERGE_GEN = [None]
+
+
+def merge_worker_metrics(timeout_ms: int = 60_000) -> dict:
+    """Fold every peer worker's registry snapshot into THIS process's
+    registry (counters sum, gauges max, histograms bucket-add) and return
+    the merged snapshot.
+
+    Symmetric — every process ends up with the fleet view — so the
+    coordinator's report (and its ``-metrics`` summary event) carries
+    merged per-worker counters, the acceptance shape for distributed
+    runs.  The reference got this from Spark's driver-side aggregate
+    of executor metrics; here it is one KV gather + three monoid merges.
+
+    At most once per run: after the fold every registry already holds
+    fleet totals, so a second gather would sum peers' fleet views and
+    double-count.  Guarded — raises unless the registry was reset since
+    the previous merge (a new run).
+    """
+    from ..obs.registry import registry
+
+    gen = registry().generation
+    if _LAST_MERGE_GEN[0] == gen:
+        raise RuntimeError(
+            "merge_worker_metrics already ran for this registry "
+            "generation; a second fold would double-count peers "
+            "(reset the registry to start a new run)")
+    snaps = gather_metrics_snapshots(timeout_ms)
+    me = jax.process_index() if jax.process_count() > 1 else 0
+    for i, s in enumerate(snaps):
+        if i != me:
+            registry().merge(s)
+    # stamp the fleet-view marker (obs.snapshot_is_fleet_merged): any
+    # aggregator folding this process's sidecar with its peers' must
+    # merge at most one of them, or every counter counts N times
+    registry().gauge("fleet_merged").set(1)
+    _LAST_MERGE_GEN[0] = gen
+    return registry().snapshot()
 
 
 # --------------------------------------------------------------------------
@@ -185,7 +277,7 @@ def _build_resharder(mesh: Mesh, treedef, capacity: int, axis_name: str):
     spec = P(axis_name)
     spec_tree = jax.tree.unflatten(
         treedef, [spec] * treedef.num_leaves)
-    fn = jax.shard_map(
+    fn = shard_map(
         step, mesh=mesh,
         in_specs=(spec, spec_tree),
         out_specs=(spec_tree, spec, P()))
@@ -207,7 +299,7 @@ def ring_halo_merge(stripe: jnp.ndarray, halo: jnp.ndarray,
     the genome's end and is dropped, mirroring the partitioner's refusal to
     spill ranges into the unmapped bin (partitioner.py bins_for_ranges).
     """
-    n = jax.lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     incoming = jax.lax.ppermute(halo, axis_name,
                                 perm=[(i, (i + 1) % n) for i in range(n)])
     first = jax.lax.axis_index(axis_name) == 0
@@ -276,5 +368,5 @@ def pileup_counts_halo_exchange(mesh: Mesh, bin_span: int, halo: int,
         return ring_halo_merge(counts[:bin_span], counts[bin_span:],
                                READS_AXIS)
 
-    fn = jax.shard_map(step, mesh=mesh, in_specs=(spec,) * 8, out_specs=spec)
+    fn = shard_map(step, mesh=mesh, in_specs=(spec,) * 8, out_specs=spec)
     return jax.jit(fn)

@@ -46,11 +46,15 @@ class Incarnation:
 
     Worker output goes to the per-worker files in ``logs`` — NOT pipes:
     an undrained pipe wedges any worker chattier than the OS buffer,
-    which would read as a hang, not a failure."""
+    which would read as a hang, not a failure.  ``metrics`` holds each
+    worker's telemetry sidecar path (obs JSONL, written when the worker
+    honors ``ADAM_TPU_METRICS``); the supervisor folds the finished
+    incarnation's sidecars into its own registry."""
     number: int
     coordinator: str
     procs: List[subprocess.Popen] = field(default_factory=list)
     logs: List[str] = field(default_factory=list)
+    metrics: List[str] = field(default_factory=list)
 
 
 def supervise(argv_for: Callable[[int, str], Sequence[str]],
@@ -73,19 +77,37 @@ def supervise(argv_for: Callable[[int, str], Sequence[str]],
     (the checkpoint dir the argv points at) is the workers' own
     responsibility — that is what makes restart = resume.
     """
+    from ..obs import (METRICS_ENV, emit, read_snapshot_file, registry,
+                       snapshot_is_fleet_merged)
+
     last_fail = "never launched"
     log_dir = log_dir or tempfile.mkdtemp(prefix="elastic_logs_")
     os.makedirs(log_dir, exist_ok=True)
     for number in range(max_restarts + 1):
         coordinator = f"127.0.0.1:{free_port()}"
         inc = Incarnation(number=number, coordinator=coordinator)
+        registry().counter("elastic_incarnations").inc()
+        emit("incarnation", number=number, coordinator=coordinator,
+             workers=num_processes)
         for pid in range(num_processes):
             path = os.path.join(log_dir, f"inc{number}-worker{pid}.log")
             inc.logs.append(path)
+            # each worker gets its OWN metrics sidecar — always, even
+            # when the caller's env carries ADAM_TPU_METRICS (a single
+            # shared path would be clobbered by N concurrent writers and
+            # then merged N times).  A worker that opts into telemetry
+            # via obs.metrics_run_from_env writes here, and the
+            # supervisor — the coordinator of this recovery scheme —
+            # merges the successful incarnation's sidecars below.
+            wenv = dict(env if env is not None else os.environ)
+            mpath = os.path.join(
+                log_dir, f"inc{number}-worker{pid}.metrics.jsonl")
+            wenv[METRICS_ENV] = mpath
+            inc.metrics.append(mpath)
             with open(path, "w") as log:
                 inc.procs.append(subprocess.Popen(
                     list(argv_for(pid, coordinator)),
-                    stdout=log, stderr=subprocess.STDOUT, env=env))
+                    stdout=log, stderr=subprocess.STDOUT, env=wenv))
         if on_incarnation:
             on_incarnation(inc)
         failed: Optional[int] = None
@@ -97,12 +119,31 @@ def supervise(argv_for: Callable[[int, str], Sequence[str]],
                 failed = bad[0]
                 break
             if all(c == 0 for c in codes):
+                # gather each worker's registry snapshot into the
+                # coordinator's report: counter sum / gauge max /
+                # histogram merge (obs.registry.MetricsRegistry.merge).
+                # A worker that ran distributed.merge_worker_metrics
+                # already holds fleet totals (symmetric merge), so fold
+                # at most ONE fleet-view sidecar — summing N fleet
+                # views would count every worker N times.
+                merged_fleet = False
+                for mp in inc.metrics:
+                    snap = read_snapshot_file(mp)
+                    if snap is None:
+                        continue
+                    fleet = snapshot_is_fleet_merged(snap)
+                    if fleet and merged_fleet:
+                        continue
+                    registry().merge(snap)
+                    merged_fleet = merged_fleet or fleet
                 return inc
             time.sleep(poll_s)
         # one worker died: the mesh is wedged — tear down the whole
         # incarnation (peers are likely hung inside a collective on the
         # dead peer, so escalate kill after a grace period)
         rc = inc.procs[failed].returncode
+        registry().counter("elastic_worker_deaths").inc()
+        emit("worker_death", incarnation=number, worker=failed, rc=rc)
         for p in inc.procs:
             if p.poll() is None:
                 p.terminate()

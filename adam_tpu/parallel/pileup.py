@@ -28,6 +28,7 @@ import numpy as np
 from .. import schema as S
 from ..ops.pileup import pileup_walk
 from ..ops import cigar as C
+from ..platform import shard_map
 
 CHANNELS = ("A", "C", "G", "T", "N_OTHER", "INS", "DEL", "CLIP",
             "REVERSE", "COVERAGE", "QUAL_SUM", "MAPQ_SUM")
@@ -111,7 +112,7 @@ def sharded_pileup_counts(mesh, bin_span: int, max_len: int):
                                    cigar_ops, cigar_lens, bin_start[0],
                                    bin_span=bin_span, max_len=max_len)
 
-    fn = jax.shard_map(step, mesh=mesh,
+    fn = shard_map(step, mesh=mesh,
                        in_specs=(spec,) * 8 + (spec,),
                        out_specs=spec)
     return jax.jit(fn)

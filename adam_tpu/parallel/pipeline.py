@@ -23,6 +23,7 @@ from typing import Optional, Tuple
 import numpy as np
 import pyarrow as pa
 
+from .. import obs
 from ..packing import column_int64
 from .mesh import make_mesh, reads_sharding
 
@@ -116,19 +117,33 @@ def streaming_flagstat(path: str, *, mesh=None, chunk_rows: int = 1 << 22,
         # an exact integer monoid, so the result cannot depend on timing
         from .ingest import pipelined
         wire_chunks = pipelined(wire_chunks, workers=io_threads)
+    import time as _time
+    t_start = _time.perf_counter()
+    n_reads = 0
     for wire in wire_chunks:
-        n_pad = _pad_to(len(wire), mesh.size)
-        if n_pad != len(wire):  # padding words carry valid=0
+        t_chunk = _time.perf_counter()
+        rows = len(wire)
+        n_pad = _pad_to(rows, mesh.size)
+        if n_pad != rows:  # padding words carry valid=0
             wire = np.concatenate(
-                [wire, np.zeros(n_pad - len(wire), np.uint32)])
+                [wire, np.zeros(n_pad - rows, np.uint32)])
         counts = kernel(jax.device_put(wire, sharding))
         totals_dev = counts if totals_dev is None else totals_dev + counts
         n_chunks += 1
+        n_reads += rows
         if n_chunks % SYNC_EVERY == 0:
             totals += np.asarray(totals_dev).astype(np.int64)
             totals_dev = None
+        obs.chunk_processed("flagstat", rows, bytes_in=4 * rows,
+                            seconds=_time.perf_counter() - t_chunk)
+        obs.pad_waste("flagstat", rows, n_pad)
     if totals_dev is not None:
         totals += np.asarray(totals_dev).astype(np.int64)
+    # same end-of-run rollup as transform (rows_total / reads_per_sec /
+    # bytes_in + the run_totals event), so -metrics consumers see one
+    # schema across commands
+    obs.run_totals("flagstat", n_reads, _time.perf_counter() - t_start,
+                   input_path=path)
     passed = FlagStatMetrics.from_counters(totals[:, 0])
     failed = FlagStatMetrics.from_counters(totals[:, 1])
     return failed, passed
@@ -378,9 +393,10 @@ def _packed_chunks(chunk_iter, pass_name: str, io_threads: int,
     def work(table, _ctx):
         if not want_pack:
             return table, None
+        padded = pad_bucket(table.num_rows)
+        obs.pad_waste(pass_name, table.num_rows, padded)
         return table, pack_reads(
-            table, pad_rows_to=pad_bucket(table.num_rows),
-            bucket_len=bucket_len)
+            table, pad_rows_to=padded, bucket_len=bucket_len)
 
     if io_threads > 1:
         from .ingest import pipelined
@@ -478,15 +494,21 @@ def streaming_transform(input_path: str, output_path: str, *,
 
     def timed_chunks(it, name):
         """Attribute the iterator's own work (format decode / parquet scan)
-        to a named stage, chunk by chunk."""
+        to a named stage, chunk by chunk; each chunk also lands in the
+        metrics plane (chunk_rows/bytes_in + a JSONL chunk event).  The
+        pipelined paths yield (table, packed) pairs, the sync paths bare
+        tables — account the table either way."""
         it = iter(it)
         while True:
             with stage(name):
                 try:
-                    table = next(it)
+                    item = next(it)
                 except StopIteration:
                     return
-            yield table
+            table = item[0] if isinstance(item, tuple) else item
+            obs.chunk_processed(name, table.num_rows,
+                                bytes_in=table.nbytes)
+            yield item
 
     def pad_bucket(rows: int) -> int:
         """Row-count bucket for packing: next power of two (x mesh), so a
@@ -500,6 +522,8 @@ def streaming_transform(input_path: str, output_path: str, *,
         cap = max(-(-chunk_rows // mesh.size) * mesh.size, mesh.size)
         return min(-(-b // mesh.size) * mesh.size, cap)
 
+    import time as _time
+    t_start = _time.perf_counter()
     if mesh is None:
         mesh = make_mesh()
     own_workdir = workdir is None
@@ -572,9 +596,10 @@ def streaming_transform(input_path: str, output_path: str, *,
         def p1_pack(table, blen):
             if keys is None:
                 return table, None
+            padded = pad_bucket(table.num_rows)
+            obs.pad_waste("p1", table.num_rows, padded)
             return table, pack_reads(
-                table, pad_rows_to=pad_bucket(table.num_rows),
-                bucket_len=blen)
+                table, pad_rows_to=padded, bucket_len=blen)
 
         track_len = keys is not None or bqsr
         if io_threads > 1 and not p1_skipped:
@@ -795,6 +820,9 @@ def streaming_transform(input_path: str, output_path: str, *,
         out.close()
         if ck is not None:
             ck.mark("done", total_rows=total_rows)
+        obs.run_totals("transform", total_rows,
+                       _time.perf_counter() - t_start,
+                       input_path=input_path, output_path=output_path)
         return total_rows
     finally:
         if own_workdir:

@@ -38,6 +38,7 @@ import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
 from .. import schema as S
+from ..platform import shard_map
 from .mesh import READS_AXIS, make_mesh
 
 _POS_BIAS = np.int64(1) << 31
@@ -100,7 +101,7 @@ def _sort_step(hi, lo, idx, n_shards: int, capacity: int, n_samples: int):
 def _build_sorter(mesh: Mesh, capacity: int, n_samples: int):
     n_shards = mesh.shape[READS_AXIS]
     spec = P(READS_AXIS)
-    fn = jax.shard_map(
+    fn = shard_map(
         partial(_sort_step, n_shards=n_shards, capacity=capacity,
                 n_samples=n_samples),
         mesh=mesh, in_specs=(spec, spec, spec),

@@ -18,6 +18,27 @@ import re
 _COUNT_RE = re.compile(r"--xla_force_host_platform_device_count=(\d+)")
 
 
+def shard_map(f, *, mesh, in_specs, out_specs, **kw):
+    """``jax.shard_map`` on any installed jax.
+
+    The API graduated out of ``jax.experimental.shard_map`` (top-level
+    since ~0.6); older jaxlibs only ship the experimental name.  One
+    compat indirection here keeps every kernel call site on the modern
+    spelling — this is the same single-implementation discipline that
+    created this module (a drifted per-file workaround cost round 1 an
+    evidence artifact).
+    """
+    import jax
+
+    sm = getattr(jax, "shard_map", None)
+    if sm is None:
+        from jax.experimental.shard_map import shard_map as sm
+
+        if "check_vma" in kw:   # the experimental API's older spelling
+            kw["check_rep"] = kw.pop("check_vma")
+    return sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kw)
+
+
 def ensure_host_device_count(n_devices: int) -> None:
     """Guarantee >= ``n_devices`` virtual CPU devices via ``XLA_FLAGS``.
 
@@ -59,6 +80,8 @@ def enable_compilation_cache(path: str | None = None) -> None:
     crosses heterogeneous machines (shared home dirs).  The compile
     the cache saves most is the tunnel's remote AOT anyway.
     """
+    install_compile_metrics()   # count hits/misses/compile-seconds even
+    #                             when the cache itself ends up disabled
     if path is None:
         env = os.environ.get("ADAM_TPU_COMPILE_CACHE")
         if env is not None:
@@ -95,6 +118,69 @@ def enable_compilation_cache(path: str | None = None) -> None:
         jax.config.update("jax_persistent_cache_min_compile_time_secs",
                           0.1)
     except Exception:  # noqa: BLE001 — never fail a run over a cache
+        pass
+
+
+def axis_size(axis_name):
+    """``jax.lax.axis_size`` on any installed jax (older releases spell
+    it ``core.axis_frame``); concrete int under shard_map tracing."""
+    import jax
+
+    fn = getattr(jax.lax, "axis_size", None)
+    if fn is not None:
+        return fn(axis_name)
+    from jax._src import core
+
+    return core.axis_frame(axis_name)
+
+
+def pallas_tpu_compiler_params(**kw):
+    """``pltpu.CompilerParams`` / legacy ``pltpu.TPUCompilerParams``."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    cls = getattr(pltpu, "CompilerParams", None) or pltpu.TPUCompilerParams
+    return cls(**kw)
+
+
+_COMPILE_METRICS_INSTALLED = False
+
+
+def install_compile_metrics() -> None:
+    """Route jax.monitoring compile events into the obs registry.
+
+    Compilation is this framework's JVM-warmup analog, so it is telemetry
+    of the first order: persistent-cache hits/misses
+    (``/jax/compilation_cache/*``) become ``compile_cache_hits`` /
+    ``compile_cache_misses`` counters, and every backend-compile duration
+    (``/jax/core/compile/backend_compile_duration``) accumulates into
+    ``compile_count`` / ``compile_seconds``.  Idempotent and non-fatal:
+    listeners cannot be unregistered, so the callbacks consult the
+    live registry accessor (test resets keep working) and any
+    registration failure degrades to no telemetry, never a broken run.
+    """
+    global _COMPILE_METRICS_INSTALLED
+    if _COMPILE_METRICS_INSTALLED:
+        return
+    try:
+        from jax import monitoring
+
+        from .obs.registry import registry
+
+        def on_event(event: str, **kw) -> None:
+            if "/compilation_cache/cache_hits" in event:
+                registry().counter("compile_cache_hits").inc()
+            elif "/compilation_cache/cache_misses" in event:
+                registry().counter("compile_cache_misses").inc()
+
+        def on_duration(event: str, duration: float, **kw) -> None:
+            if event.endswith("backend_compile_duration"):
+                registry().counter("compile_count").inc()
+                registry().counter("compile_seconds").inc(duration)
+
+        monitoring.register_event_listener(on_event)
+        monitoring.register_event_duration_secs_listener(on_duration)
+        _COMPILE_METRICS_INSTALLED = True
+    except Exception:  # noqa: BLE001 — telemetry never fails a run
         pass
 
 

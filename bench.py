@@ -807,6 +807,16 @@ def _worker(stages: list[str]) -> None:
     if os.environ.get("JAX_PLATFORMS") == "cpu":
         from adam_tpu.platform import force_cpu
         force_cpu()
+    # per-run telemetry sidecar: the orchestrator points ADAM_TPU_METRICS
+    # at a path next to the BENCH artifact (benchlib.orchestrate), so
+    # every attempt leaves a manifest + per-stage events + the registry
+    # snapshot — the per-stage numbers future BENCH entries cite
+    from adam_tpu.obs import metrics_run_from_env
+    with metrics_run_from_env(config={"stages": stages}):
+        _worker_stages(stages)
+
+
+def _worker_stages(stages: list[str]) -> None:
     # the probe always runs: it validates the tunnel for THIS process and
     # supplies device_kind/is_tpu to the other stages (the orchestrator
     # keeps the first probe result it saw)
@@ -915,11 +925,16 @@ def main() -> None:
         # tunnel / CPU-fallback decisions) lives in benchlib.orchestrate,
         # pinned hardware-free by tests/test_bench_orchestration.py
         from benchlib import orchestrate
+        # telemetry sidecars land next to the BENCH_*.json artifact (cwd
+        # unless redirected), one per worker run
+        mdir = os.environ.get("ADAM_TPU_BENCH_METRICS_DIR", ".")
         stages, errors = orchestrate(
             want,
             lambda missing, env_extra, deadline_s: _run_worker(
                 missing, env_extra, deadline_s=deadline_s),
-            _remaining, CPU_RESERVE_S)
+            _remaining, CPU_RESERVE_S,
+            metrics_path_for=lambda tag: os.path.join(
+                mdir, f"BENCH_metrics_{tag}.jsonl"))
 
         probe = stages.get("probe", {})
         # headline platform = the backend the flagstat number ran on; a TPU
@@ -960,6 +975,11 @@ def main() -> None:
         if pl:
             result.update({f"pallas_{k}" if not k.startswith(
                 ("sweep", "sw_")) else k: v for k, v in pl.items()})
+        paths = sorted({v["metrics_path"] for v in stages.values()
+                        if isinstance(v, dict) and "metrics_path" in v
+                        and os.path.exists(v["metrics_path"])})
+        if paths:
+            result["metrics_paths"] = paths
         if errors:
             result["error"] = "; ".join(errors)[:600]
     except BaseException as e:  # noqa: BLE001 — the one-line contract wins

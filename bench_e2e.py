@@ -220,9 +220,21 @@ def main() -> None:
                          "clips) as a separate artifact; the default "
                          "stays comparable across rounds")
     ap.add_argument("--out", default=None)
+    ap.add_argument("--metrics", default=None,
+                    help="telemetry sidecar path (default: "
+                         "<out>.metrics.jsonl when --out is given)")
     args = ap.parse_args()
-    stats = run(args.reads, args.chunk_rows, repeat=args.repeat,
-                adversarial=args.adversarial)
+    # the sidecar lands next to the BENCH artifact: manifest + per-stage
+    # events + the registry snapshot, so the E2E number carries its own
+    # per-stage breakdown in schema form (docs/OBSERVABILITY.md)
+    mpath = args.metrics or (args.out + ".metrics.jsonl"
+                             if args.out else None)
+    from adam_tpu.obs import metrics_run
+    with metrics_run(mpath, argv=sys.argv, config=vars(args)):
+        stats = run(args.reads, args.chunk_rows, repeat=args.repeat,
+                    adversarial=args.adversarial)
+    if mpath:
+        stats["metrics_path"] = mpath
     doc = json.dumps(stats, indent=1)
     print(doc)
     if args.out:

@@ -27,6 +27,7 @@ def orchestrate(want: list[str],
                 cpu_reserve_s: float,
                 sleep: Callable[[float], None] = time.sleep,
                 tpu_only: Iterable[str] = TPU_ONLY_STAGES,
+                metrics_path_for: "Callable[[str], str] | None" = None,
                 ) -> tuple[dict, list[str]]:
     """Collect stage payloads for `want`, retrying the flaky device path
     while budget lasts, then CPU-fallback for whatever never landed.
@@ -34,6 +35,13 @@ def orchestrate(want: list[str],
     run_worker(stages, env_extra, deadline_s) -> (stage->payload, err,
     failed_stage) — bench._run_worker's contract.  Returns (stages,
     errors).
+
+    ``metrics_path_for(tag)`` (tags: ``attempt<N>``, ``cpu``) names a
+    per-run telemetry sidecar: the path rides to the worker via
+    ``ADAM_TPU_METRICS`` (the worker writes an obs JSONL there) and is
+    recorded as ``metrics_path`` in every stage payload collected from
+    that run — so a BENCH_*.json entry can cite the sidecar's per-stage
+    numbers instead of only end-to-end wall time.
     """
     errors: list[str] = []
     stages: dict = {}
@@ -41,6 +49,20 @@ def orchestrate(want: list[str],
     cpu_incidental: dict = {}
     fails: dict = {}
     skip: set = set()
+
+    def tagged(got: dict, tag: str) -> dict:
+        if metrics_path_for is None:
+            return got
+        path = metrics_path_for(tag)
+        return {k: ({**v, "metrics_path": path}
+                    if isinstance(v, dict) else v)
+                for k, v in got.items()}
+
+    def worker_env(tag: str) -> dict:
+        if metrics_path_for is None:
+            return {}
+        return {"ADAM_TPU_METRICS": metrics_path_for(tag)}
+
     # device attempts: keep retrying the flaky tunnel while budget
     # lasts; a stage that hangs twice is skipped (not retried forever)
     # so later stages still get their shot at the device
@@ -50,7 +72,9 @@ def orchestrate(want: list[str],
         if not missing:
             break
         got, err, failed = run_worker(
-            missing, {}, remaining() - cpu_reserve_s)
+            missing, worker_env(f"attempt{attempt}"),
+            remaining() - cpu_reserve_s)
+        got = tagged(got, f"attempt{attempt}")
         if got.get("probe", {}).get("platform") not in (None, "tpu"):
             # a fast tunnel failure silently falls back to the CPU
             # backend INSIDE the worker; those numbers are fallback
@@ -91,9 +115,9 @@ def orchestrate(want: list[str],
     if missing:
         got, err, _failed = run_worker(
             ["probe"] + [m for m in missing if m != "probe"],
-            {"JAX_PLATFORMS": "cpu"},
+            {"JAX_PLATFORMS": "cpu"} | worker_env("cpu"),
             max(remaining() - 10, 30))
-        for k, v in got.items():
+        for k, v in tagged(got, "cpu").items():
             stages.setdefault(k, v)
         if err:
             errors.append(f"cpu fallback: {err}")

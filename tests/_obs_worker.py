@@ -1,0 +1,47 @@
+"""Worker for the two-process metrics-merge test (adam_tpu.obs).
+
+Run as:  python _obs_worker.py <coordinator> <num_processes> <process_id>
+
+Joins the coordination service over loopback and contributes DISTINCT
+per-worker telemetry: worker p incs ``worker_reads`` by 100*(p+1), sets
+``device_mem_peak`` to 1000+p, and observes one ``chunk_rows`` sample.
+``merge_worker_metrics`` then gathers every worker's registry snapshot
+through the service's KV store — the control plane, no device
+collectives, so this runs on jaxlibs whose CPU XLA has no multiprocess
+computations (the reason the DCN psum smoke test cannot cover it here).
+
+The merged report must show counter SUM, gauge MAX, histogram count SUM;
+prints "OBS_MERGE_OK <reads> <peak> <hist_count>" on success.
+"""
+
+from __future__ import annotations
+
+import sys
+
+
+def main() -> None:
+    coordinator, nproc, pid = (sys.argv[1], int(sys.argv[2]),
+                               int(sys.argv[3]))
+
+    from adam_tpu.platform import force_cpu
+    force_cpu(n_devices=1)
+
+    from adam_tpu.parallel import distributed as D
+    D.initialize(coordinator_address=coordinator, num_processes=nproc,
+                 process_id=pid)
+
+    from adam_tpu.obs import registry
+    r = registry()
+    r.counter("worker_reads").inc(100 * (pid + 1))
+    r.gauge("device_mem_peak").set(1000 + pid)
+    r.histogram("chunk_rows").observe(10 * (pid + 1))
+
+    merged = D.merge_worker_metrics(timeout_ms=60_000)
+    reads = merged["counters"]["worker_reads"]
+    peak = merged["gauges"]["device_mem_peak"]
+    hist = merged["histograms"]["chunk_rows"]["count"]
+    print(f"OBS_MERGE_OK {int(reads)} {int(peak)} {hist}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

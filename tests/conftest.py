@@ -24,6 +24,21 @@ def resources() -> pathlib.Path:
     return RESOURCES
 
 
+@pytest.fixture(autouse=True)
+def _zeroed_telemetry():
+    """Process-global telemetry (instrument._REPORT, the obs registry, a
+    dangling event log, the sync-timing switch) must not leak between
+    tests — every test starts from zeroed state, and a test that enables
+    sync timing cannot slow every later test with device barriers."""
+    from adam_tpu import obs
+    from adam_tpu.instrument import report, set_sync_timing
+
+    report().reset()
+    obs.reset_all()
+    set_sync_timing(False)
+    yield
+
+
 def iter_mpileup_tokens(bases: str):
     """Tokenize an mpileup bases column (samtools' or ours): yields
     ('char', c) for per-position symbols (./,/ACGT/*/$-stripped) and

@@ -165,6 +165,41 @@ def test_in_worker_cpu_fallback_salvaged_not_trusted_as_device():
     assert "transform" in worker.calls[-1][0]
 
 
+def test_metrics_sidecar_path_rides_env_and_lands_in_payloads():
+    """With metrics_path_for, every worker run gets ADAM_TPU_METRICS in
+    its env and every collected stage payload records which sidecar its
+    numbers came from — so BENCH entries can cite per-stage telemetry."""
+    clock = FakeClock(total=2000.0)
+    a1 = (tpu_probe() | payloads("flagstat"),
+          "stage transform hung past its deadline", "transform", 120.0)
+    a2 = (tpu_probe() | payloads("transform", "bqsr_race", "pallas",
+                                 "bqsr_race8"), None, None, 120.0)
+    worker = FakeWorker(clock, [a1, a2])
+    stages, errors = orchestrate(
+        WANT, worker, clock.remaining, clock.reserve, clock.sleep,
+        metrics_path_for=lambda tag: f"/bench/m-{tag}.jsonl")
+    assert worker.calls[0][1] == {
+        "ADAM_TPU_METRICS": "/bench/m-attempt1.jsonl"}
+    assert worker.calls[1][1] == {
+        "ADAM_TPU_METRICS": "/bench/m-attempt2.jsonl"}
+    assert stages["flagstat"]["metrics_path"] == "/bench/m-attempt1.jsonl"
+    assert stages["transform"]["metrics_path"] == "/bench/m-attempt2.jsonl"
+
+
+def test_metrics_sidecar_tags_cpu_fallback():
+    clock = FakeClock()
+    hang = ({}, "stage probe hung past its deadline", "probe", 150.0)
+    cpu_all = cpu_probe() | payloads("flagstat", "transform", "bqsr_race",
+                                     backend="cpu")
+    worker = FakeWorker(clock, [hang, hang, (cpu_all, None, None, 90.0)])
+    stages, _ = orchestrate(
+        WANT, worker, clock.remaining, clock.reserve, clock.sleep,
+        metrics_path_for=lambda tag: f"m-{tag}.jsonl")
+    assert worker.calls[2][1] == {"JAX_PLATFORMS": "cpu",
+                                  "ADAM_TPU_METRICS": "m-cpu.jsonl"}
+    assert stages["flagstat"]["metrics_path"] == "m-cpu.jsonl"
+
+
 def test_no_device_attempt_when_budget_already_inside_reserve():
     clock = FakeClock(total=200.0, reserve=150.0)  # 200 < 150+60
     fb = (cpu_probe() | payloads("flagstat", "transform", "bqsr_race",

@@ -11,6 +11,7 @@ from adam_tpu.parallel.distributed import (
     all_to_all_reshard, make_host_mesh, pileup_counts_halo_exchange,
     ring_halo_merge)
 from adam_tpu.parallel.mesh import READS_AXIS, make_mesh
+from adam_tpu.platform import shard_map
 from adam_tpu.parallel.pileup import CH_COVERAGE, CH_DEL, pileup_count_kernel
 
 
@@ -65,7 +66,7 @@ def test_ring_halo_merge_adds_into_right_neighbor():
     halo = np.tile(np.arange(1, h + 1, dtype=np.int32)[:, None],
                    (n_dev, 1)).reshape(n_dev * h, 1)
 
-    fn = jax.jit(jax.shard_map(
+    fn = jax.jit(shard_map(
         lambda s, ha: ring_halo_merge(s, ha),
         mesh=mesh, in_specs=(jax.sharding.PartitionSpec(READS_AXIS),) * 2,
         out_specs=jax.sharding.PartitionSpec(READS_AXIS)))

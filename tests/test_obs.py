@@ -1,0 +1,414 @@
+"""The adam_tpu.obs telemetry subsystem (ISSUE 1).
+
+Covers: stage nesting feeding the registry, sync=True gating through
+set_sync_timing (counted _block_on_device calls — the no-barrier
+guarantee for un-instrumented runs), merge semantics (counter sum /
+gauge max / histogram bucket-add), the JSONL event log's atomic
+publish + schema, the CLI ``-metrics`` flow validated by
+tools/check_metrics.py, test isolation (back-to-back runs start
+zeroed), the quiet gate, and the two-process worker-snapshot merge.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import json
+import os
+import pathlib
+import socket
+import subprocess
+import sys
+
+import pytest
+
+from adam_tpu import instrument, obs
+from adam_tpu.instrument import report, set_sync_timing, stage
+from adam_tpu.obs.registry import Histogram, MetricsRegistry
+
+ROOT = pathlib.Path(__file__).parent.parent
+
+_spec = importlib.util.spec_from_file_location(
+    "check_metrics", ROOT / "tools" / "check_metrics.py")
+check_metrics = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(check_metrics)
+
+
+# ---------------------------------------------------------------------------
+# registry semantics
+# ---------------------------------------------------------------------------
+
+def test_counter_gauge_histogram_basics():
+    r = MetricsRegistry()
+    r.counter("reads", op="flagstat").inc(5)
+    r.counter("reads", op="flagstat").inc(3)
+    r.gauge("peak").set(7)
+    h = r.histogram("lat")
+    for v in (0.5, 1.5, 1000.0):
+        h.observe(v)
+    snap = r.snapshot()
+    assert snap["counters"]["reads{op=flagstat}"] == 8
+    assert snap["gauges"]["peak"] == 7
+    hd = snap["histograms"]["lat"]
+    assert hd["count"] == 3 and hd["min"] == 0.5 and hd["max"] == 1000.0
+    assert sum(hd["buckets"].values()) == 3
+
+
+def test_merge_semantics_counter_sum_gauge_max_histogram_add():
+    a, b = MetricsRegistry(), MetricsRegistry()
+    a.counter("n").inc(10)
+    b.counter("n").inc(32)
+    a.gauge("device_mem_peak").set(100)
+    b.gauge("device_mem_peak").set(250)
+    a.histogram("rows").observe(4)
+    b.histogram("rows").observe(4)
+    b.histogram("rows").observe(1000)
+    a.merge(b.snapshot())
+    snap = a.snapshot()
+    assert snap["counters"]["n"] == 42            # sum
+    assert snap["gauges"]["device_mem_peak"] == 250   # max
+    h = snap["histograms"]["rows"]
+    assert h["count"] == 3 and h["sum"] == 1008
+    assert h["min"] == 4 and h["max"] == 1000
+    # the two rows=4 samples share one bucket after the merge
+    assert max(h["buckets"].values()) == 2
+
+
+def test_histogram_nonpositive_sentinel_bucket():
+    """Zero/negative samples must not share a bucket with (0.5, 1] —
+    exactly the range pad_waste_frac exists to expose."""
+    h = MetricsRegistry().histogram("pad_waste_frac")
+    h.observe(0.0)
+    h.observe(0.7)
+    assert h.buckets == {Histogram.NONPOS_BUCKET: 1, 0: 1}
+    d = h.to_dict()["buckets"]
+    assert d[str(Histogram.NONPOS_BUCKET)] == 1 and len(d) == 2
+
+
+def test_chunk_processed_without_pad_rows_records_no_waste_sample():
+    """Callers that did not measure padding must not pollute the waste
+    histogram with spurious 0.0 samples (they would halve the mean)."""
+    obs.chunk_processed("p1", 100, bytes_in=400)
+    assert "pad_waste_frac{pass=p1}" not in (
+        obs.registry().snapshot()["histograms"])
+    obs.chunk_processed("p1", 75, pad_rows=25)
+    h = obs.registry().snapshot()["histograms"]["pad_waste_frac{pass=p1}"]
+    assert h["count"] == 1 and h["sum"] == 0.25
+
+
+def test_merge_into_empty_registry_creates_metrics():
+    a, b = MetricsRegistry(), MetricsRegistry()
+    b.counter("only_in_b").inc(2)
+    b.histogram("h").observe(1)
+    a.merge(b.snapshot())
+    assert a.snapshot() == b.snapshot()
+
+
+def test_merge_roundtrips_through_json():
+    a, b = MetricsRegistry(), MetricsRegistry()
+    b.counter("n", shard=3).inc(9)
+    b.histogram("rows", **{"pass": "p1"}).observe(7)
+    a.merge(json.loads(json.dumps(b.snapshot())))
+    assert a.snapshot()["counters"]["n{shard=3}"] == 9
+    assert a.snapshot()["histograms"]["rows{pass=p1}"]["count"] == 1
+
+
+# ---------------------------------------------------------------------------
+# instrument.stage -> registry
+# ---------------------------------------------------------------------------
+
+def test_stage_feeds_registry_with_nesting():
+    with stage("outer"):
+        with stage("inner"):
+            pass
+        with stage("inner"):
+            pass
+    snap = obs.registry().snapshot()
+    assert snap["counters"]["stage_calls{stage=outer}"] == 1
+    assert snap["counters"]["stage_calls{stage=inner}"] == 2
+    assert snap["histograms"]["stage_seconds{stage=inner}"]["count"] == 2
+    # the report tree still nests (the registry is flat by design)
+    assert "inner" in report().root.children["outer"].children
+
+
+def test_sync_stage_gated_off_takes_no_device_barrier(monkeypatch):
+    calls = []
+    monkeypatch.setattr(instrument, "_block_on_device",
+                        lambda: calls.append(1))
+    set_sync_timing(False)
+    with stage("hot", sync=True):
+        pass
+    assert calls == []          # the acceptance guarantee: no -timing,
+    #                             no barriers, full async dispatch
+
+
+def test_sync_stage_gated_on_blocks_at_entry_and_exit(monkeypatch):
+    calls = []
+    monkeypatch.setattr(instrument, "_block_on_device",
+                        lambda: calls.append(1))
+    set_sync_timing(True)
+    with stage("timed", sync=True):
+        pass
+    assert len(calls) == 2      # drain predecessor + drain own work
+    with stage("untimed", sync=False):
+        pass
+    assert len(calls) == 2      # sync=False never blocks either way
+
+
+# ---------------------------------------------------------------------------
+# event log
+# ---------------------------------------------------------------------------
+
+def test_metrics_run_publishes_atomically(tmp_path):
+    path = tmp_path / "run.jsonl"
+    with obs.metrics_run(str(path), argv=["adam-tpu", "test"],
+                         config={"x": 1}):
+        obs.counter("n").inc(3)
+        obs.emit("chunk", **{"pass": "p1", "rows": 7})
+        assert not path.exists()          # events buffer in PATH.tmp...
+        assert path.with_suffix(".jsonl.tmp").exists()
+    assert path.exists()                  # ...and publish on close
+    assert not path.with_suffix(".jsonl.tmp").exists()
+    lines = [json.loads(ln) for ln in path.read_text().splitlines()]
+    assert lines[0]["event"] == "manifest"
+    assert lines[0]["schema"] == 1
+    assert lines[-1]["event"] == "summary"
+    assert lines[-1]["ok"] is True
+    assert lines[-1]["metrics"]["counters"]["n"] == 3
+    assert check_metrics.validate(str(path)) == []
+
+
+def test_metrics_run_failure_still_publishes_valid_file(tmp_path):
+    path = tmp_path / "boom.jsonl"
+    with pytest.raises(RuntimeError):
+        with obs.metrics_run(str(path)):
+            obs.counter("n").inc()
+            raise RuntimeError("boom")
+    assert check_metrics.validate(str(path)) == []
+    last = json.loads(path.read_text().splitlines()[-1])
+    assert last["ok"] is False and "boom" in last["error"]
+
+
+def test_metrics_run_none_is_noop(tmp_path):
+    with obs.metrics_run(None):
+        obs.emit("chunk", **{"pass": "p1", "rows": 1})
+    assert list(tmp_path.iterdir()) == []
+
+
+def test_check_metrics_rejects_torn_and_wrong_schema(tmp_path):
+    bad = tmp_path / "bad.jsonl"
+    bad.write_text('{"event": "manifest", "t": 0, "schema": 99}\n'
+                   '{"event": "stage", "t": 0.1}\n'
+                   '{not json\n')
+    errors = check_metrics.validate(str(bad))
+    assert any("schema" in e for e in errors)
+    assert any("invalid JSON" in e for e in errors)
+    assert any("seconds" in e for e in errors)
+    assert check_metrics.main([str(bad)]) == 1
+
+
+# ---------------------------------------------------------------------------
+# pipeline integration + isolation
+# ---------------------------------------------------------------------------
+
+def _flagstat_counters(resources):
+    from adam_tpu.parallel.mesh import make_mesh
+    from adam_tpu.parallel.pipeline import streaming_flagstat
+
+    streaming_flagstat(str(resources / "small.sam"), mesh=make_mesh(8),
+                       chunk_rows=8)
+    return obs.registry().snapshot()["counters"]
+
+
+def test_streaming_flagstat_reports_chunks(resources):
+    counters = _flagstat_counters(resources)
+    assert counters["rows_in{pass=flagstat}"] == 20
+    assert counters["chunks{pass=flagstat}"] == 3      # 8+8+4 rows
+    assert counters["bytes_in{pass=flagstat}"] == 80   # 4 B wire/read
+    gauges = obs.registry().snapshot()["gauges"]
+    assert gauges["reads_per_sec{op=flagstat}"] > 0
+
+
+def test_back_to_back_runs_start_from_zeroed_telemetry(resources):
+    """Two pipeline runs with a reset between must report identically —
+    the regression the process-global registry/report made easy to lose."""
+    first = _flagstat_counters(resources)
+    report().reset()
+    obs.reset_all()
+    assert obs.registry().is_empty()
+    assert report().root.children == {}
+    second = _flagstat_counters(resources)
+
+    def deterministic(c):
+        # compile_count/compile_seconds vary run to run (jit caching);
+        # the chunk/row accounting must be exactly reproducible
+        return {k: v for k, v in c.items() if not k.startswith("compile")}
+    assert deterministic(first) == deterministic(second)
+
+
+def test_streaming_transform_pad_waste_and_totals(resources, tmp_path):
+    from adam_tpu.parallel.pipeline import streaming_transform
+
+    n = streaming_transform(str(resources / "small.sam"),
+                            str(tmp_path / "out"), markdup=True,
+                            chunk_rows=1 << 12)
+    snap = obs.registry().snapshot()
+    assert snap["counters"]["rows_total{op=transform}"] == n
+    assert snap["gauges"]["reads_per_sec{op=transform}"] > 0
+    assert snap["counters"]["bytes_out{op=transform}"] > 0
+    # 20 reads pack into a 24-row bucket (8-device mesh): waste recorded
+    h = snap["histograms"]["pad_waste_frac{pass=p1}"]
+    assert h["count"] >= 1 and 0 <= h["max"] < 1
+
+
+# ---------------------------------------------------------------------------
+# CLI -metrics flow (the tier-1 acceptance path)
+# ---------------------------------------------------------------------------
+
+def test_transform_cli_metrics_validates(resources, tmp_path):
+    from adam_tpu.cli.main import main
+
+    mpath = tmp_path / "run.metrics.jsonl"
+    rc = main(["transform", str(resources / "small.sam"),
+               str(tmp_path / "out"), "-mark_duplicate_reads",
+               "-sort_reads", "-stream", "-metrics", str(mpath)])
+    assert rc == 0
+    assert check_metrics.validate(str(mpath)) == [], \
+        check_metrics.validate(str(mpath))
+    lines = [json.loads(ln) for ln in mpath.read_text().splitlines()]
+    events = [d["event"] for d in lines]
+    assert events[0] == "manifest" and events[-1] == "summary"
+    assert "stage" in events and "chunk" in events
+    m = lines[0]
+    assert m["config"]["command"] == "transform"
+    assert m["backend"] == "cpu"
+    summary = lines[-1]
+    assert summary["metrics"]["counters"][
+        "rows_total{op=transform}"] == 20
+
+
+def test_flagstat_cli_metrics_validates(resources, tmp_path, capsys):
+    from adam_tpu.cli.main import main
+
+    mpath = tmp_path / "fs.metrics.jsonl"
+    rc = main(["flagstat", str(resources / "small.sam"),
+               "-metrics", str(mpath)])
+    assert rc == 0
+    assert check_metrics.validate(str(mpath)) == []
+    summary = json.loads(mpath.read_text().splitlines()[-1])
+    assert summary["metrics"]["counters"][
+        "rows_in{pass=flagstat}"] == 20
+
+
+# ---------------------------------------------------------------------------
+# quiet gate
+# ---------------------------------------------------------------------------
+
+def test_quiet_gates_all_instrument_output(monkeypatch, capsys):
+    monkeypatch.setenv("ADAM_TPU_QUIET", "1")
+    instrument.say("noise")
+    instrument.log_invocation(["adam-tpu", "x"])
+    with stage("s"):
+        pass
+    instrument.print_report()
+    out = capsys.readouterr()
+    assert out.out == "" and out.err == ""
+    monkeypatch.delenv("ADAM_TPU_QUIET")
+    instrument.print_report()
+    assert "stage timing:" in capsys.readouterr().out
+
+
+# ---------------------------------------------------------------------------
+# elastic sidecar merge
+# ---------------------------------------------------------------------------
+
+def test_merge_metrics_file_folds_summary_snapshot(tmp_path):
+    r = MetricsRegistry()
+    r.counter("worker_rows").inc(11)
+    side = tmp_path / "w0.metrics.jsonl"
+    side.write_text(
+        json.dumps({"event": "manifest", "t": 0, "schema": 1}) + "\n" +
+        json.dumps({"event": "summary", "t": 1, "ok": True,
+                    "metrics": r.snapshot()}) + "\n")
+    obs.counter("worker_rows").inc(31)
+    assert obs.merge_metrics_file(str(side))
+    assert obs.registry().snapshot()["counters"]["worker_rows"] == 42
+    assert not obs.merge_metrics_file(str(tmp_path / "missing.jsonl"))
+
+
+def test_merge_worker_metrics_once_per_run_guard():
+    """A second fold in the same run would sum peers' already-merged
+    fleet views (double-count); the guard trips until a registry reset
+    marks a new run."""
+    from adam_tpu.parallel import distributed as D
+
+    obs.counter("n").inc(5)
+    assert D.merge_worker_metrics()["counters"]["n"] == 5
+    with pytest.raises(RuntimeError, match="double-count"):
+        D.merge_worker_metrics()
+    obs.reset_all()                      # new run: guard re-arms
+    assert D.merge_worker_metrics() == obs.registry().snapshot()
+
+
+def test_merge_worker_metrics_stamps_fleet_marker():
+    from adam_tpu.parallel import distributed as D
+
+    obs.counter("n").inc(1)
+    assert obs.snapshot_is_fleet_merged(D.merge_worker_metrics())
+
+
+def test_supervisor_folds_at_most_one_fleet_view(tmp_path):
+    """N workers that each ran the symmetric distributed merge all write
+    fleet-total sidecars; the supervisor must fold exactly one, not sum
+    N fleet views (which would count every worker N times)."""
+    from adam_tpu.parallel.elastic import supervise
+
+    body = (
+        "import json, os\n"
+        "snap = {'counters': {'rows_total': 300.0},\n"
+        "        'gauges': {'fleet_merged': 1.0}, 'histograms': {}}\n"
+        "with open(os.environ['ADAM_TPU_METRICS'], 'w') as f:\n"
+        "    f.write(json.dumps({'event': 'summary', 't': 0.1,\n"
+        "                        'ok': True, 'metrics': snap}) + '\\n')\n"
+    )
+    supervise(lambda pid, coord: [sys.executable, "-c", body],
+              num_processes=2, max_restarts=0, log_dir=str(tmp_path))
+    snap = obs.registry().snapshot()
+    assert snap["counters"]["rows_total"] == 300          # not 600
+    assert obs.snapshot_is_fleet_merged(snap)
+
+
+# ---------------------------------------------------------------------------
+# two-process worker merge over the coordination service
+# ---------------------------------------------------------------------------
+
+@pytest.mark.skipif(os.environ.get("ADAM_TPU_SKIP_MULTIPROC") == "1",
+                    reason="multi-process smoke disabled by env")
+def test_two_process_registry_merge_over_loopback():
+    """Each worker contributes distinct counters; the coordinator's
+    merged report must show the fleet totals (counter sum, gauge max,
+    histogram count) — gathered over the coordination-service KV store,
+    which works on any backend."""
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        coordinator = f"127.0.0.1:{s.getsockname()[1]}"
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = str(ROOT) + os.pathsep + env.get("PYTHONPATH", "")
+    worker = os.path.join(os.path.dirname(__file__), "_obs_worker.py")
+    procs = [subprocess.Popen(
+        [sys.executable, worker, coordinator, "2", str(pid)],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        env=env) for pid in range(2)]
+    outs = []
+    try:
+        for p in procs:
+            outs.append(p.communicate(timeout=120))
+    except subprocess.TimeoutExpired:
+        for p in procs:
+            p.kill()
+        pytest.fail("metrics-merge workers timed out")
+    for p, (out, err) in zip(procs, outs):
+        assert p.returncode == 0, f"rc={p.returncode}\n{out}\n{err}"
+        # sum(100, 200), max(1000, 1001), two histogram samples
+        assert "OBS_MERGE_OK 300 1001 2" in out, out

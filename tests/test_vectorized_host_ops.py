@@ -195,7 +195,8 @@ def test_snptable_ingest_rss_stays_bounded(tmp_path):
     # interpreter/pyarrow baseline; measured ~830 MB isolated with the
     # incremental reader (read_csv's whole-table materialization ~960 MB,
     # the per-line parser >4 GB).  Under full-suite memory pressure the
-    # child's allocator measured up to ~2 GB for the identical work, so
-    # the bound is a gross-regression tripwire (O(file) string churn),
-    # not a pin on the isolated number.
-    assert int(peak_kb) < 2_500_000, f"peak RSS {int(peak_kb)//1024} MB"
+    # child's allocator measured up to ~2 GB for the identical work —
+    # ~2.65 GB once the shard_map compat let the whole suite actually
+    # execute ahead of this test — so the bound is a gross-regression
+    # tripwire (O(file) string churn), not a pin on the isolated number.
+    assert int(peak_kb) < 3_200_000, f"peak RSS {int(peak_kb)//1024} MB"

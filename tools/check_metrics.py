@@ -1,0 +1,183 @@
+#!/usr/bin/env python3
+"""Validate an adam-tpu metrics/telemetry JSONL file (schema 1).
+
+The schema is documented in docs/OBSERVABILITY.md and produced by
+``adam_tpu.obs`` (the CLI's ``-metrics PATH`` flag, the bench sidecars,
+elastic worker sidecars).  Contract checked here:
+
+* every line is a JSON object with an ``event`` string and numeric ``t``;
+* line 1 is the ``manifest``: ``schema == 1``, ``argv`` a list of
+  strings, a hex ``config_fingerprint``, host/pid present;
+* ``stage`` events carry ``name`` (str) and ``seconds`` (number >= 0);
+* ``chunk`` events carry ``pass`` (str) and ``rows`` (int >= 0);
+* the last line is the ``summary``: ``wall_seconds``, ``ok``, and a
+  ``metrics`` snapshot whose counters/gauges are numeric and whose
+  histograms are internally consistent (count == sum of bucket counts);
+* exactly one manifest, exactly one summary.
+
+Usage::
+
+    python tools/check_metrics.py RUN.metrics.jsonl [...]
+
+Exit 0 when every file validates; 1 otherwise, with one error line per
+violation.  Used by the tier-1 CLI telemetry test (tests/test_obs.py)
+so the documented schema and the produced schema cannot drift.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from typing import List
+
+SCHEMA_VERSION = 1
+
+_NUM = (int, float)
+
+
+def _is_num(v) -> bool:
+    return isinstance(v, _NUM) and not isinstance(v, bool)
+
+
+def validate(path: str) -> List[str]:
+    """Return a list of human-readable schema violations (empty = valid)."""
+    errs: List[str] = []
+
+    def err(line_no, msg):
+        errs.append(f"{path}:{line_no}: {msg}")
+
+    try:
+        with open(path) as f:
+            raw = [ln for ln in f.read().splitlines() if ln.strip()]
+    except OSError as e:
+        return [f"{path}: unreadable: {e}"]
+    if not raw:
+        return [f"{path}: empty file"]
+
+    docs = []
+    for i, ln in enumerate(raw, 1):
+        try:
+            doc = json.loads(ln)
+        except ValueError as e:
+            err(i, f"invalid JSON: {e}")
+            continue
+        if not isinstance(doc, dict):
+            err(i, "line is not a JSON object")
+            continue
+        if not isinstance(doc.get("event"), str):
+            err(i, "missing/non-string 'event'")
+        if not _is_num(doc.get("t")):
+            err(i, "missing/non-numeric 't'")
+        docs.append((i, doc))
+
+    if not docs:
+        return errs
+
+    manifests = [(i, d) for i, d in docs if d.get("event") == "manifest"]
+    summaries = [(i, d) for i, d in docs if d.get("event") == "summary"]
+    if len(manifests) != 1:
+        errs.append(f"{path}: expected exactly 1 manifest, "
+                    f"found {len(manifests)}")
+    if len(summaries) != 1:
+        errs.append(f"{path}: expected exactly 1 summary, "
+                    f"found {len(summaries)}")
+
+    if manifests:
+        i, m = manifests[0]
+        if (i, m) != docs[0] and docs[0][1].get("event") != "manifest":
+            err(i, "manifest is not the first line")
+        if m.get("schema") != SCHEMA_VERSION:
+            err(i, f"manifest schema {m.get('schema')!r} != "
+                   f"{SCHEMA_VERSION}")
+        argv = m.get("argv")
+        if not (isinstance(argv, list) and
+                all(isinstance(a, str) for a in argv)):
+            err(i, "manifest argv is not a list of strings")
+        fp = m.get("config_fingerprint")
+        if not (isinstance(fp, str) and len(fp) >= 8 and
+                all(c in "0123456789abcdef" for c in fp)):
+            err(i, "manifest config_fingerprint is not a hex digest")
+        for field in ("host", "pid"):
+            if field not in m:
+                err(i, f"manifest missing {field!r}")
+
+    for i, d in docs:
+        ev = d.get("event")
+        if ev == "stage":
+            if not isinstance(d.get("name"), str):
+                err(i, "stage event missing string 'name'")
+            if not (_is_num(d.get("seconds")) and d["seconds"] >= 0):
+                err(i, "stage event missing non-negative 'seconds'")
+        elif ev == "chunk":
+            if not isinstance(d.get("pass"), str):
+                err(i, "chunk event missing string 'pass'")
+            rows = d.get("rows")
+            if not (isinstance(rows, int) and not isinstance(rows, bool)
+                    and rows >= 0):
+                err(i, "chunk event missing non-negative int 'rows'")
+
+    if summaries:
+        i, s = summaries[0]
+        if (i, s) != docs[-1]:
+            err(i, "summary is not the last line")
+        if not _is_num(s.get("wall_seconds")):
+            err(i, "summary missing numeric 'wall_seconds'")
+        if not isinstance(s.get("ok"), bool):
+            err(i, "summary missing boolean 'ok'")
+        snap = s.get("metrics")
+        if not isinstance(snap, dict):
+            err(i, "summary missing 'metrics' snapshot object")
+        else:
+            for kind in ("counters", "gauges", "histograms"):
+                if not isinstance(snap.get(kind), dict):
+                    err(i, f"metrics snapshot missing {kind!r} object")
+            for k, v in (snap.get("counters") or {}).items():
+                if not _is_num(v):
+                    err(i, f"counter {k!r} value is not numeric")
+            for k, v in (snap.get("gauges") or {}).items():
+                if not _is_num(v):
+                    err(i, f"gauge {k!r} value is not numeric")
+            for k, h in (snap.get("histograms") or {}).items():
+                if not isinstance(h, dict):
+                    err(i, f"histogram {k!r} is not an object")
+                    continue
+                buckets = h.get("buckets")
+                if not isinstance(buckets, dict):
+                    err(i, f"histogram {k!r} missing buckets")
+                    continue
+                bad_keys = [b for b in buckets
+                            if not b.lstrip("-").isdigit()]
+                if bad_keys:
+                    err(i, f"histogram {k!r} non-integer bucket keys "
+                           f"{bad_keys[:3]}")
+                if not _is_num(h.get("sum")):
+                    err(i, f"histogram {k!r} missing numeric sum")
+                total = sum(n for b, n in buckets.items()
+                            if b not in bad_keys)
+                if h.get("count") != total:
+                    err(i, f"histogram {k!r} count {h.get('count')} != "
+                           f"bucket total {total}")
+    return errs
+
+
+def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    if not argv:
+        print("usage: check_metrics.py FILE.jsonl [...]", file=sys.stderr)
+        return 2
+    bad = 0
+    for path in argv:
+        errors = validate(path)
+        if errors:
+            bad += 1
+            for e in errors:
+                print(e, file=sys.stderr)
+        else:
+            with open(path) as f:
+                n = sum(1 for ln in f if ln.strip())
+            print(f"{path}: ok ({n} events, schema {SCHEMA_VERSION})")
+    return 1 if bad else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -355,7 +355,7 @@ def probe_flagstat_v2():
             body(wire_ref, acc_ref)
 
         def call(wire3d, *, interpret):
-            from jax.experimental.pallas import tpu as pltpu
+            from adam_tpu.platform import pallas_tpu_compiler_params
             n_blk, rows, lanes = wire3d.shape
             return pl.pallas_call(
                 kern, grid=(n_blk,),
@@ -363,7 +363,7 @@ def probe_flagstat_v2():
                                        lambda i: (i, 0, 0))],
                 out_specs=pl.BlockSpec((36, FP.LANES), lambda i: (0, 0)),
                 out_shape=jax.ShapeDtypeStruct((36, FP.LANES), jnp.int32),
-                compiler_params=pltpu.CompilerParams(
+                compiler_params=pallas_tpu_compiler_params(
                     dimension_semantics=("arbitrary",)),
                 interpret=interpret)(wire3d)
         return call
