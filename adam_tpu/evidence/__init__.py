@@ -1,0 +1,38 @@
+"""``adam_tpu.evidence`` — cross-window TPU evidence ledger and
+information-first capture scheduler.
+
+Hardware windows are rare (~1 per 18 h observed) and flap on minute
+scales, so every second of a window must buy evidence that does not yet
+exist.  Three modules, all importable without jax:
+
+* :mod:`.ledger` — the persisted per-stage evidence record
+  (``EVIDENCE_LEDGER.json`` next to the ``BENCH_*.json`` artifacts),
+  merged keep-best across windows: a stage with an on-chip number is
+  never clobbered by a CPU fallback, and never re-paid before a stage
+  without one;
+* :mod:`.scheduler` — orders runnable stages by information-per-byte
+  (never-captured-on-TPU first, then smallest wire), scales per-stage
+  problem sizes to the link rate the probe just measured, and owns the
+  per-stage deadline table ``bench._run_worker`` enforces;
+* :mod:`.probe` — pure analysis for the self-diagnosing probe record
+  (RTT, repeat-matmul samples, chain-linearity residual, calibration
+  deviation vs the round-3 190 TFLOPs number) so a partial artifact
+  like the 124-TFLOPs anomaly explains itself.
+
+``bench.py`` drives all three; ``tools/tpu_watch.py`` reads the ledger
+to re-enter a window with only the missing stages; ledger writes emit
+through :mod:`adam_tpu.obs` so evidence and telemetry share one
+artifact chain.  Format documented in docs/EVIDENCE.md, validated by
+``tools/check_evidence.py``.
+"""
+
+from __future__ import annotations
+
+from .ledger import Ledger, new_window_id  # noqa: F401
+from .probe import (CALIBRATION_TFLOPS,  # noqa: F401
+                    DEVIATION_THRESHOLD, analyze_probe,
+                    chain_linearity_residual)
+from .scheduler import (CPU_FALLBACK_ORDER,  # noqa: F401
+                        DEFAULT_STAGE_ORDER, STAGE_DEADLINES_S,
+                        order_cpu_fallback, order_stages, parse_only,
+                        scaled_reads_env, wire_bytes_for)

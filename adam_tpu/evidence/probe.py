@@ -1,0 +1,135 @@
+"""Self-diagnosing probe analysis: turn raw probe measurements into a
+record that explains its own anomalies.
+
+Round 3's on-chip calibration put the repeat-matmul at ~190 TFLOPs on
+the v5e; round 5 captured 124 TFLOPs and nobody could say whether the
+chip, the tunnel, or the timing discipline was at fault.  This module
+is the pure half of the fix (bench._stage_probe supplies the raw
+measurements; nothing here imports jax):
+
+* **RTT** — the tunnel round-trip floor every chained timing subtracts;
+* **repeat matmul** — N tflops samples from chained matmul runs at
+  increasing chain lengths; their spread bounds the timing noise;
+* **chain-linearity residual** — least-squares fit of ``time = a +
+  b * k`` over the (chain length, wall time) points; a large residual
+  means the "per-iteration" rate is not actually linear in k (tunnel
+  stall, async-dispatch misaccounting) and the tflops number cannot be
+  trusted;
+* **calibration deviation** — the best sample vs the round-3 on-chip
+  calibration (190 TFLOPs); >10 % deviation sets a flag that rides the
+  probe record into the ledger, so a partial artifact carries its own
+  health verdict.
+
+Records land in the evidence ledger's ``probes`` history and the probe
+stage payload; ``tools/check_evidence.py`` validates the field set.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+#: round-3 on-chip repeat-matmul calibration for the 2048^3 bf16 chain
+#: (BENCH_r03; v5 lite).  The deviation flag is computed against this,
+#: not the spec-sheet peak — the question a window must answer is "does
+#: the chip behave like it did when the numbers were good".
+CALIBRATION_TFLOPS = 190.0
+
+#: relative deviation beyond which the probe flags itself
+DEVIATION_THRESHOLD = 0.10
+
+
+def chain_linearity_residual(points: Sequence[Tuple[float, float]]
+                             ) -> Optional[float]:
+    """Max relative residual of the least-squares line ``t = a + b*k``
+    over ``points = [(k, seconds), ...]``.  Needs >= 3 distinct chain
+    lengths; returns None otherwise.  ~0 means per-iteration cost is
+    genuinely constant (the chained-timing discipline holds); large
+    values mean the timing is lying (e.g. dispatch "finished" at 8x
+    peak because block_until_ready did not sync the tunnel)."""
+    pts = [(float(k), float(t)) for k, t in points]
+    if len({k for k, _ in pts}) < 3:
+        return None
+    n = len(pts)
+    mean_k = sum(k for k, _ in pts) / n
+    mean_t = sum(t for _, t in pts) / n
+    var_k = sum((k - mean_k) ** 2 for k, _ in pts)
+    if var_k <= 0:
+        return None
+    b = sum((k - mean_k) * (t - mean_t) for k, t in pts) / var_k
+    a = mean_t - b * mean_k
+    resid = 0.0
+    for k, t in pts:
+        pred = a + b * k
+        denom = max(abs(t), 1e-9)
+        resid = max(resid, abs(pred - t) / denom)
+    return resid
+
+
+def diagnose(record: dict) -> str:
+    """One human-readable line explaining the record's health — what a
+    partial artifact says for itself when nobody was watching."""
+    parts = []
+    dev = record.get("calibration_deviation")
+    if record.get("calibration_deviation_flag"):
+        parts.append(
+            f"matmul {record.get('matmul_tflops')} TFLOPs deviates "
+            f"{dev:+.0%} from the round-3 calibration "
+            f"{record.get('calibration_tflops')} — link or device "
+            f"contention; treat this window's rates as lower bounds")
+    resid = record.get("chain_linearity_residual")
+    if resid is not None and resid > 0.15:
+        parts.append(
+            f"chain timing nonlinear (residual {resid:.2f}) — "
+            f"per-iteration rates from this window are unreliable")
+    if not parts:
+        if record.get("calibration_applies"):
+            parts.append("probe healthy: matmul within calibration, "
+                         "chain timing linear")
+        else:
+            parts.append("non-TPU backend: calibration not applicable")
+    return "; ".join(parts)
+
+
+def analyze_probe(*, rtt_s: float,
+                  tflops_samples: Sequence[float],
+                  chain_points: Sequence[Tuple[float, float]],
+                  is_tpu: bool,
+                  link_bytes_per_sec: Optional[float] = None,
+                  calibration_tflops: float = CALIBRATION_TFLOPS,
+                  threshold: float = DEVIATION_THRESHOLD) -> dict:
+    """Build the self-diagnosing probe record from raw measurements.
+
+    ``tflops_samples``: repeat-matmul rate per chain run (>=1);
+    ``chain_points``: the (chain length, wall seconds) pairs behind
+    those samples.  Calibration deviation only applies on a TPU backend
+    — flagging a CPU fallback against 190 TFLOPs would make every CPU
+    artifact "anomalous" and bury the real signal.
+    """
+    samples = [round(float(s), 2) for s in tflops_samples]
+    best = max(samples) if samples else None
+    resid = chain_linearity_residual(chain_points)
+    rec: dict = {
+        "rtt_ms": round(rtt_s * 1e3, 1),
+        "repeat_matmul_tflops": samples,
+        "repeat_matmul_n": len(samples),
+        "matmul_tflops": best,
+        "matmul_tflops_spread": round(max(samples) - min(samples), 2)
+        if len(samples) >= 2 else None,
+        "chain_points": [[int(k), round(float(t), 4)]
+                         for k, t in chain_points],
+        "chain_linearity_residual": round(resid, 4)
+        if resid is not None else None,
+        "link_bytes_per_sec": round(float(link_bytes_per_sec), 1)
+        if link_bytes_per_sec else None,
+        "calibration_tflops": calibration_tflops,
+        "calibration_applies": bool(is_tpu),
+    }
+    if is_tpu and best:
+        dev = (best - calibration_tflops) / calibration_tflops
+        rec["calibration_deviation"] = round(dev, 4)
+        rec["calibration_deviation_flag"] = bool(abs(dev) > threshold)
+    else:
+        rec["calibration_deviation"] = None
+        rec["calibration_deviation_flag"] = False
+    rec["diagnosis"] = diagnose(rec)
+    return rec

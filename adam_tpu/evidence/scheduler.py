@@ -1,0 +1,196 @@
+"""Information-first capture scheduling for bench stages.
+
+A tunnel window is the scarce resource; the scheduler's one job is to
+make any window — even 60 seconds — yield the never-captured evidence
+first.  Ordering rule (information-per-byte):
+
+1. stages with NO on-chip ledger record come before stages that already
+   have one (an on-chip number is never re-paid before a stage without
+   one);
+2. within each group, higher information tier first — the tier encodes
+   what each stage adjudicates (the six-way count race decides the
+   product's default backend; the pallas checks decide which kernels
+   ship; the fused transform is the product headline; flagstat already
+   has CPU-adjudicated numbers; the int8 legs are exploratory);
+3. ties break toward the smallest wire, so a flapping link loses the
+   least when a stage dies mid-transfer.
+
+This fixes the round-4/5 inversion (bench.py ran the 34 MB flagstat
+wire before the 8 MB race — VERDICT r4, ``bench.py:912``): the default
+order with an empty ledger is ``probe → bqsr_race → pallas → transform
+→ flagstat → bqsr_race8``, pinned by tests/test_bench_orchestration.py.
+
+The scheduler also owns the per-stage deadline table (bench._run_worker
+enforces it over the worker's stdout; ``ADAM_TPU_BENCH_STAGE_TIMEOUTS``
+overrides single entries) and the link-rate problem-size scaling: once
+the probe measures the tunnel's actual byte rate, each wire-shipping
+stage is shrunk so its transfer fits a bounded slice of the window
+instead of stalling it (the round-5 flagstat hang was a 206 MB wire on
+a ~1 MB/s flap).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Iterable, Optional
+
+#: canonical stage order with an empty ledger — probe always first (it
+#: supplies platform/link context to everything after it)
+DEFAULT_STAGE_ORDER = ("probe", "bqsr_race", "pallas", "transform",
+                       "flagstat", "bqsr_race8")
+
+#: information tier per stage (lower = captured earlier); see module
+#: docstring for what each stage adjudicates
+INFO_TIER = {"probe": 0, "bqsr_race": 1, "pallas": 2, "transform": 3,
+             "flagstat": 4, "bqsr_race8": 5}
+
+#: per-stage stdout deadlines enforced by bench._run_worker (probe
+#: covers backend init + first compile over the tunnel); one hung stage
+#: can cost at most its own entry, never the window
+STAGE_DEADLINES_S = {"probe": 150.0, "flagstat": 180.0, "transform": 280.0,
+                     "bqsr_race": 300.0, "bqsr_race8": 150.0,
+                     "pallas": 240.0}
+
+TIMEOUTS_ENV = "ADAM_TPU_BENCH_STAGE_TIMEOUTS"
+
+# -- analytic wire models ----------------------------------------------------
+# bytes the stage moves over the host->device link at its default
+# problem size (flagstat ships a real packed wire; the race/transform
+# batches are generated on device, so their wire is the per-read
+# accounting footprint bench reports, not a host transfer — the model
+# only needs to rank stages and scale problem sizes consistently).
+
+FLAGSTAT_WIRE_BYTES_PER_READ = 4.0
+RACE_WIRE_BYTES_PER_READ = 8.0          # index word + weight byte per base
+TRANSFORM_WIRE_BYTES_PER_READ = 33.0    # scalars + LUT slices per read
+
+_DEFAULT_READS = {"flagstat": 12_000_000, "bqsr_race": 1_000_000,
+                  "bqsr_race8": 1_000_000, "transform": 1_500_000}
+
+
+def wire_bytes_for(stage: str, payload: Optional[dict] = None,
+                   n_reads: Optional[int] = None) -> Optional[int]:
+    """Analytic wire bytes for a stage, from its payload's read count
+    when available (ledger accounting), else the default sizes."""
+    p = payload or {}
+    if n_reads is None:
+        n_reads = (p.get("n_reads") or p.get("race_n_reads") or
+                   p.get("race8_n_reads") or p.get("transform_n_reads") or
+                   _DEFAULT_READS.get(stage))
+    if stage == "probe":
+        return 2 * 2048 * 2048            # the bf16 matmul operand
+    if stage == "pallas":
+        return 64 * 100 * 8               # tiny check arrays
+    if stage == "flagstat":
+        return int(FLAGSTAT_WIRE_BYTES_PER_READ * n_reads)
+    if stage in ("bqsr_race", "bqsr_race8"):
+        return int(RACE_WIRE_BYTES_PER_READ * n_reads)
+    if stage == "transform":
+        return int(TRANSFORM_WIRE_BYTES_PER_READ * n_reads)
+    return None
+
+
+def order_stages(want: Iterable[str], ledger=None) -> list:
+    """Order ``want`` information-first against the ledger state (see
+    module docstring).  ``ledger`` may be None (empty-ledger order) or
+    anything with ``captured_on_tpu(stage)``."""
+    want = list(dict.fromkeys(want))      # de-dup, keep caller's extras
+
+    def key(stage):
+        captured = 1 if (ledger is not None and
+                         ledger.captured_on_tpu(stage)) else 0
+        tier = INFO_TIER.get(stage, len(INFO_TIER))
+        return (0 if stage == "probe" else 1, captured, tier,
+                wire_bytes_for(stage) or 0)
+
+    return sorted(want, key=key)
+
+
+#: the CPU fallback pass exists to complete the ARTIFACT, not to buy
+#: on-chip evidence: headline metric (flagstat) first, then the product
+#: transform, then the race adjudication — the reverse of the window's
+#: information-first order, which is meaningless off-chip (the seed's
+#: CPU artifacts landed flagstat+transform+race in exactly this order;
+#: racing first would let the slow CPU race legs eat the fallback
+#: deadline and zero the headline value)
+CPU_FALLBACK_ORDER = ("probe", "flagstat", "transform", "bqsr_race")
+
+
+def order_cpu_fallback(missing: Iterable[str]) -> list:
+    """Order the CPU fallback pass's stages artifact-first (see
+    CPU_FALLBACK_ORDER); unknown stages keep their relative order at
+    the end."""
+    known = {s: i for i, s in enumerate(CPU_FALLBACK_ORDER)}
+    return sorted(missing, key=lambda s: known.get(s, len(known)))
+
+
+def parse_only(spec: Optional[str]) -> Optional[list]:
+    """``--only``/``ADAM_TPU_BENCH_ONLY`` parsing: comma-separated stage
+    names; probe is always prepended (every worker needs its platform
+    probe).  None/empty -> None (run everything)."""
+    if not spec:
+        return None
+    stages = [s.strip() for s in spec.split(",") if s.strip()]
+    if not stages:
+        return None
+    return ["probe"] + [s for s in stages if s != "probe"]
+
+
+def parse_stage_timeouts(spec: Optional[str],
+                         base: Optional[dict] = None) -> dict:
+    """Merge ``name=seconds`` comma-pairs over the deadline table.
+    Malformed entries are skipped, not fatal — a typo in a watcher env
+    must not cost the window."""
+    out = dict(base if base is not None else STAGE_DEADLINES_S)
+    for item in (spec or "").split(","):
+        if "=" not in item:
+            continue
+        name, _, val = item.partition("=")
+        try:
+            sec = float(val)
+        except ValueError:
+            continue
+        if name.strip() and sec > 0:
+            out[name.strip()] = sec
+    return out
+
+
+#: floor on the scaled flagstat wire: rates are size-independent past
+#: ~4M reads (one resident chain block), so never shrink below that
+MIN_FLAGSTAT_READS = 4_000_000
+MIN_RACE_READS = 250_000
+MIN_TRANSFORM_READS = 250_000
+
+
+def scaled_reads_env(link_bytes_per_sec: Optional[float],
+                     transfer_budget_s: float = 45.0) -> dict:
+    """Problem sizes scaled to the link rate the probe just measured:
+    env overrides capping each wire-shipping stage's transfer at
+    ``transfer_budget_s`` seconds of the measured link.  No link rate
+    (or a fast link that fits the defaults) -> no overrides."""
+    if not link_bytes_per_sec or link_bytes_per_sec <= 0:
+        return {}
+    cap = link_bytes_per_sec * transfer_budget_s
+    out = {}
+    n_flag = int(cap / FLAGSTAT_WIRE_BYTES_PER_READ)
+    if n_flag < _DEFAULT_READS["flagstat"]:
+        out["ADAM_TPU_BENCH_FLAGSTAT_READS"] = \
+            str(max(MIN_FLAGSTAT_READS, n_flag))
+    n_race = int(cap / RACE_WIRE_BYTES_PER_READ)
+    if n_race < _DEFAULT_READS["bqsr_race"]:
+        out["ADAM_TPU_BENCH_RACE_READS"] = \
+            str(max(MIN_RACE_READS, n_race))
+    n_tr = int(cap / TRANSFORM_WIRE_BYTES_PER_READ)
+    if n_tr < _DEFAULT_READS["transform"]:
+        out["ADAM_TPU_BENCH_TRANSFORM_READS"] = \
+            str(max(MIN_TRANSFORM_READS, n_tr))
+    return out
+
+
+def scale_env_from_probe(probe_payload: Optional[dict]) -> dict:
+    """benchlib.orchestrate hook: once an attempt's probe payload lands,
+    derive the size overrides for every subsequent attempt in the same
+    window (re-entry after a flap runs shrunken stages)."""
+    if not probe_payload:
+        return {}
+    return scaled_reads_env(probe_payload.get("link_bytes_per_sec"))

@@ -28,6 +28,10 @@ def orchestrate(want: list[str],
                 sleep: Callable[[float], None] = time.sleep,
                 tpu_only: Iterable[str] = TPU_ONLY_STAGES,
                 metrics_path_for: "Callable[[str], str] | None" = None,
+                ledger=None,
+                window_id: str = "",
+                scale_env: "Callable[[dict], dict] | None" = None,
+                cpu_order: "Callable[[list[str]], list[str]] | None" = None,
                 ) -> tuple[dict, list[str]]:
     """Collect stage payloads for `want`, retrying the flaky device path
     while budget lasts, then CPU-fallback for whatever never landed.
@@ -42,6 +46,20 @@ def orchestrate(want: list[str],
     recorded as ``metrics_path`` in every stage payload collected from
     that run — so a BENCH_*.json entry can cite the sidecar's per-stage
     numbers instead of only end-to-end wall time.
+
+    ``ledger`` (an evidence.ledger.Ledger, or None) is checkpointed
+    after EVERY worker run: each captured stage folds in keep-best and
+    the file saves immediately, so a window that slams shut mid-attempt
+    has already persisted whatever streamed.  Ledger failures never
+    break the bench contract.  ``scale_env(probe_payload) -> env dict``
+    (evidence.scheduler.scale_env_from_probe) re-sizes later attempts'
+    problem sizes to the link rate the first successful probe measured
+    — flap re-entry runs shrunken stages instead of re-stalling on
+    full-size wires.  ``cpu_order(missing) -> missing`` reorders the
+    final CPU pass (evidence.scheduler.order_cpu_fallback): the
+    fallback completes the ARTIFACT headline-first — the window's
+    information-first order is meaningless off-chip and would let the
+    slow CPU race legs starve the flagstat value.
     """
     errors: list[str] = []
     stages: dict = {}
@@ -49,6 +67,7 @@ def orchestrate(want: list[str],
     cpu_incidental: dict = {}
     fails: dict = {}
     skip: set = set()
+    link_env: dict = {}
 
     def tagged(got: dict, tag: str) -> dict:
         if metrics_path_for is None:
@@ -63,6 +82,15 @@ def orchestrate(want: list[str],
             return {}
         return {"ADAM_TPU_METRICS": metrics_path_for(tag)}
 
+    def note_ledger(got: dict) -> None:
+        if ledger is None or not got:
+            return
+        try:
+            ledger.record_stages(got, window_id=window_id)
+            ledger.save()
+        except Exception:  # noqa: BLE001 — evidence write must never
+            pass           # kill the one-line bench contract
+
     # device attempts: keep retrying the flaky tunnel while budget
     # lasts; a stage that hangs twice is skipped (not retried forever)
     # so later stages still get their shot at the device
@@ -72,20 +100,31 @@ def orchestrate(want: list[str],
         if not missing:
             break
         got, err, failed = run_worker(
-            missing, worker_env(f"attempt{attempt}"),
+            missing, link_env | worker_env(f"attempt{attempt}"),
             remaining() - cpu_reserve_s)
         got = tagged(got, f"attempt{attempt}")
+        if scale_env is not None and \
+                got.get("probe", {}).get("platform") == "tpu":
+            # only a genuine tunnel probe's link rate may (re)size the
+            # wires: a silent in-worker CPU fallback measures its local
+            # loopback and would wipe the slow-tunnel shrink overrides
+            try:
+                link_env = dict(scale_env(got["probe"]) or {})
+            except Exception:  # noqa: BLE001 — sizing is best-effort
+                link_env = {}
         if got.get("probe", {}).get("platform") not in (None, "tpu"):
             # a fast tunnel failure silently falls back to the CPU
             # backend INSIDE the worker; those numbers are fallback
             # material, not device results — keep retrying the tunnel
             cpu_incidental |= {k: v for k, v in got.items()
                                if k not in cpu_incidental}
+            note_ledger(got)
             errors.append(
                 f"attempt {attempt}: backend fell back to "
                 f"{got['probe'].get('platform')}")
             sleep(min(10.0, max(0.0, remaining() - cpu_reserve_s)))
             continue
+        note_ledger(got)
         stages |= {k: v for k, v in got.items() if k not in stages}
         if "probe" in got:
             # the tunnel answered: probe hangs so far were flaps,
@@ -113,11 +152,17 @@ def orchestrate(want: list[str],
     missing = [s for s in want
                if s not in tpu_only and s not in stages]
     if missing:
+        if cpu_order is not None:
+            missing = list(cpu_order(missing))
+        # note: link_env deliberately NOT applied — sizes scaled to the
+        # tunnel link rate are meaningless for an in-process CPU pass
         got, err, _failed = run_worker(
             ["probe"] + [m for m in missing if m != "probe"],
             {"JAX_PLATFORMS": "cpu"} | worker_env("cpu"),
             max(remaining() - 10, 30))
-        for k, v in tagged(got, "cpu").items():
+        got = tagged(got, "cpu")
+        note_ledger(got)
+        for k, v in got.items():
             stages.setdefault(k, v)
         if err:
             errors.append(f"cpu fallback: {err}")

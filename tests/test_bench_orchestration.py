@@ -4,15 +4,33 @@ Three scenarios the one tunnel window that matters depends on:
 dead tunnel -> complete CPU-fallback artifact; flapping tunnel -> device
 stages retried, hang-twice stages skipped without starving later ones;
 healthy tunnel -> one worker pass, no fallback.  Plus the in-worker
-CPU-silent-fallback salvage path.
-"""
+CPU-silent-fallback salvage path, the per-stage deadline enforcement in
+bench._run_worker (stub subprocess worker), and the 60-second
+flap-window rehearsal: race captured before flagstat starts, second
+window re-enters with only the missing stages against the merged
+evidence ledger (adam_tpu.evidence)."""
 
+import importlib.util
+import json
+import os
 import sys
+import time
 from pathlib import Path
 
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
+import bench  # noqa: E402
+from adam_tpu.evidence.ledger import Ledger  # noqa: E402
+from adam_tpu.evidence.scheduler import (DEFAULT_STAGE_ORDER,  # noqa: E402
+                                         order_stages, parse_only,
+                                         scale_env_from_probe)
 from benchlib import TPU_ONLY_STAGES, orchestrate  # noqa: E402
+
+ROOT = Path(__file__).resolve().parent.parent
+_spec = importlib.util.spec_from_file_location(
+    "tpu_watch", ROOT / "tools" / "tpu_watch.py")
+tpu_watch = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(tpu_watch)
 
 WANT = ["probe", "flagstat", "transform", "bqsr_race", "pallas",
         "bqsr_race8"]
@@ -211,3 +229,296 @@ def test_no_device_attempt_when_budget_already_inside_reserve():
     assert len(worker.calls) == 1
     assert worker.calls[0][1] == {"JAX_PLATFORMS": "cpu"}
     assert stages["flagstat"]["backend"] == "cpu"
+
+
+# ---------------------------------------------------------------------------
+# bench._run_worker: per-stage deadlines over a stub subprocess worker
+# ---------------------------------------------------------------------------
+
+_STUB_PROBE_THEN_HANG = (
+    "import json,sys,time;"
+    "print(json.dumps({'stage':'probe','platform':'cpu'}),flush=True);"
+    "time.sleep(60)")
+
+_STUB_PROBE_THEN_EXIT = (
+    "import json;"
+    "print(json.dumps({'stage':'probe','platform':'cpu'}),flush=True)")
+
+
+def test_run_worker_deadline_table_comes_from_scheduler():
+    """bench's per-stage deadline table IS the scheduler's (one source
+    of truth), env-overridable via ADAM_TPU_BENCH_STAGE_TIMEOUTS —
+    parse_stage_timeouts merge semantics pinned in test_evidence.py."""
+    from adam_tpu.evidence.scheduler import (STAGE_DEADLINES_S,
+                                             parse_stage_timeouts)
+    assert set(STAGE_DEADLINES_S) == set(DEFAULT_STAGE_ORDER)
+    if "ADAM_TPU_BENCH_STAGE_TIMEOUTS" not in os.environ:
+        assert bench.STAGE_TIMEOUT_S == \
+            parse_stage_timeouts(None, STAGE_DEADLINES_S)
+
+
+def test_run_worker_enforces_per_stage_deadline(monkeypatch):
+    """A stage that never prints its line is charged ONLY its own
+    deadline entry — the worker is killed and the hang attributed to
+    the right stage, so one hung stage cannot eat a window."""
+    monkeypatch.setitem(bench.STAGE_TIMEOUT_S, "flagstat", 0.2)
+    t0 = time.monotonic()
+    got, err, failed = bench._run_worker(
+        ["probe", "flagstat"], {}, deadline_s=30.0,
+        argv=[sys.executable, "-c", _STUB_PROBE_THEN_HANG])
+    took = time.monotonic() - t0
+    assert took < 10.0, "hung stage must cost its deadline, not the window"
+    assert failed == "flagstat" and "hung" in err
+    # the probe line that DID stream is kept, stamped with its wall cost
+    assert got["probe"]["platform"] == "cpu"
+    assert got["probe"]["stage_wall_s"] >= 0
+
+
+def test_run_worker_attributes_early_exit_to_pending_stage():
+    got, err, failed = bench._run_worker(
+        ["probe", "flagstat"], {}, deadline_s=30.0,
+        argv=[sys.executable, "-c", _STUB_PROBE_THEN_EXIT])
+    assert "probe" in got
+    assert failed == "flagstat"
+    assert "before flagstat" in err
+
+
+def test_worker_stages_run_in_the_order_given(monkeypatch):
+    """_worker_stages executes stage bodies in the order the
+    orchestrator sorted them (information-first) — the round-4/5
+    hard-coded flagstat-before-race order is gone (bench.py:912)."""
+    calls = []
+    monkeypatch.setattr(
+        bench, "_stage_probe",
+        lambda: calls.append("probe") or (True, "TPU v5 lite"))
+    for name in list(bench._STAGE_BODIES):
+        monkeypatch.setitem(
+            bench._STAGE_BODIES, name,
+            lambda kind, is_tpu, _n=name: calls.append(_n))
+    bench._worker_stages(["bqsr_race", "pallas", "flagstat"])
+    assert calls == ["probe", "bqsr_race", "pallas", "flagstat"]
+
+
+def test_first_window_order_race_before_flagstat():
+    """The bench.py:912 inversion fix, pinned at the bench level: an
+    empty ledger's first window runs probe -> bqsr_race -> pallas ->
+    transform -> flagstat -> bqsr_race8."""
+    assert list(DEFAULT_STAGE_ORDER) == \
+        ["probe", "bqsr_race", "pallas", "transform", "flagstat",
+         "bqsr_race8"]
+    assert order_stages(DEFAULT_STAGE_ORDER) == list(DEFAULT_STAGE_ORDER)
+
+
+# ---------------------------------------------------------------------------
+# the 60-second flap window, rehearsed end-to-end (hardware-free)
+# ---------------------------------------------------------------------------
+
+def _stage_tpu(name, **extra):
+    return {name: {"backend": "tpu", "stage_wall_s": 10.0, **extra}}
+
+
+def test_sixty_second_flap_window_then_ledger_reentry(tmp_path):
+    """The acceptance rehearsal: a 60-second window yields the on-chip
+    race number BEFORE flagstat ever starts; a second window re-enters
+    (tpu_watch._reentry_env) with only the missing stages; the merged
+    ledger shows keep-best semantics and no stage is re-paid."""
+    path = str(tmp_path / "EVIDENCE_LEDGER.json")
+
+    # ---- window 1: ~a minute of budget, tunnel slams shut right after
+    # the race (orchestrate needs remaining > reserve+60 to attempt)
+    led = Ledger(path)
+    want = order_stages(DEFAULT_STAGE_ORDER, led)
+    clock = FakeClock(total=65.0, reserve=0.0)
+    a1 = (tpu_probe() |
+          _stage_tpu("bqsr_race", race_backend="tpu",
+                     race_winner="scatter", race_n_reads=250_000),
+          "stage pallas hung past its deadline", "pallas", 55.0)
+    # the window is gone; bench's CPU fallback still completes the
+    # artifact — those numbers must land as fallback, not evidence
+    fb = (cpu_probe() | payloads("flagstat", backend="cpu"), None, None,
+          5.0)
+    worker = FakeWorker(clock, [a1, fb])
+    stages, _errors = orchestrate(want, worker, clock.remaining,
+                                  clock.reserve, clock.sleep,
+                                  ledger=led, window_id="w1")
+    # information-first: the race was requested BEFORE flagstat
+    first = worker.calls[0][0]
+    assert first.index("bqsr_race") < first.index("flagstat")
+    assert stages["bqsr_race"]["backend"] == "tpu"
+
+    # the ledger on disk (checkpointed after every attempt — a window
+    # that slams shut has already persisted what streamed)
+    led1 = Ledger(path)
+    assert led1.captured_on_tpu("bqsr_race")
+    assert not led1.captured_on_tpu("flagstat")       # deferred: CPU only
+    assert led1.record("flagstat")["platform"] == "cpu"
+    assert led1.record("bqsr_race")["window_id"] == "w1"
+
+    # ---- window 2: tpu_watch re-enters with only the missing stages
+    reenter = tpu_watch._reentry_env(led1)
+    only = reenter["ADAM_TPU_BENCH_ONLY"]
+    assert "bqsr_race" not in only.split(",")
+    want2 = order_stages(parse_only(only), led1)
+    assert want2[0] == "probe" and "bqsr_race" not in want2
+
+    clock2 = FakeClock(total=520.0)
+    a2 = (tpu_probe() |
+          _stage_tpu("pallas", sweep_pallas_ok=True, sw_pallas_ok=True) |
+          _stage_tpu("transform", transform_fused_reads_per_sec=9e6,
+                     transform_n_reads=250_000) |
+          _stage_tpu("flagstat", reads_per_sec=1e8,
+                     n_reads=4_000_000) |
+          _stage_tpu("bqsr_race8", race_backend="tpu",
+                     race_pallas8_reads_per_sec=5e6),
+          None, None, 100.0)
+    worker2 = FakeWorker(clock2, [a2])
+    _stages2, errors2 = orchestrate(want2, worker2, clock2.remaining,
+                                    clock2.reserve, clock2.sleep,
+                                    ledger=led1, window_id="w2")
+    assert errors2 == []
+    # no stage re-paid: window 2 never asked for the captured race
+    assert all("bqsr_race" not in c[0] for c in worker2.calls)
+
+    # merged ledger: keep-best across both windows
+    merged = Ledger(path)
+    assert merged.record("bqsr_race")["window_id"] == "w1"   # kept
+    assert merged.record("flagstat")["platform"] == "tpu"    # upgraded
+    assert merged.record("flagstat")["window_id"] == "w2"
+    assert merged.missing_stages(tpu_watch.BENCH_STAGES) == []
+    # and a fully-captured ledger produces no re-entry restriction
+    assert "ADAM_TPU_BENCH_ONLY" not in tpu_watch._reentry_env(merged)
+
+
+def test_probe_link_rate_scales_later_attempts():
+    """Once a probe measures the tunnel's byte rate, every later attempt
+    in the window runs shrunken wires (evidence.scheduler
+    .scale_env_from_probe) instead of re-stalling on full-size ones."""
+    clock = FakeClock(total=2000.0)
+    slow_probe = {"probe": {"platform": "tpu",
+                            "link_bytes_per_sec": 1e6}}   # ~1 MB/s flap
+    a1 = (slow_probe, "stage flagstat hung past its deadline",
+          "flagstat", 120.0)
+    a2 = (tpu_probe() | payloads("flagstat", "transform", "bqsr_race",
+                                 "pallas", "bqsr_race8"),
+          None, None, 100.0)
+    worker = FakeWorker(clock, [a1, a2])
+    _stages, _errors = orchestrate(WANT, worker, clock.remaining,
+                                   clock.reserve, clock.sleep,
+                                   scale_env=scale_env_from_probe)
+    assert "ADAM_TPU_BENCH_FLAGSTAT_READS" not in worker.calls[0][1]
+    # 45 s of a 1 MB/s link at 4 B/read -> 11.25M reads
+    assert worker.calls[1][1]["ADAM_TPU_BENCH_FLAGSTAT_READS"] == \
+        "11250000"
+
+
+def test_cpu_fallback_runs_headline_first_not_information_first():
+    """With cpu_order wired (bench.main passes evidence.scheduler
+    .order_cpu_fallback), the dead-tunnel fallback asks for flagstat
+    BEFORE the race: off-chip there is no evidence to buy, and the slow
+    CPU race legs must not starve the headline value."""
+    from adam_tpu.evidence.scheduler import order_cpu_fallback
+    clock = FakeClock()
+    hang = ({}, "stage probe hung past its deadline", "probe", 150.0)
+    cpu_all = cpu_probe() | payloads("flagstat", "transform", "bqsr_race",
+                                     backend="cpu")
+    worker = FakeWorker(clock, [hang, hang, (cpu_all, None, None, 90.0)])
+    # want arrives information-first (race before flagstat)
+    want = order_stages(DEFAULT_STAGE_ORDER)
+    _stages, _errors = orchestrate(want, worker, clock.remaining,
+                                   clock.reserve, clock.sleep,
+                                   cpu_order=order_cpu_fallback)
+    fallback = worker.calls[2][0]
+    assert fallback == ["probe", "flagstat", "transform", "bqsr_race"]
+
+
+def test_cpu_silent_fallback_probe_never_resizes_wires():
+    """Only a genuine tunnel probe's link rate may scale the wires: a
+    silent in-worker CPU fallback measures its local loopback (or
+    nothing) and must not wipe the slow-tunnel shrink overrides."""
+    clock = FakeClock(total=3000.0)
+    slow_tpu = ({"probe": {"platform": "tpu",
+                           "link_bytes_per_sec": 1e6}},
+                "stage flagstat hung past its deadline", "flagstat",
+                120.0)
+    silent_cpu = (cpu_probe() | payloads("flagstat", backend="cpu"),
+                  None, None, 50.0)
+    final = (tpu_probe() | payloads("flagstat", "transform", "bqsr_race",
+                                    "pallas", "bqsr_race8"),
+             None, None, 100.0)
+    worker = FakeWorker(clock, [slow_tpu, silent_cpu, final])
+    _stages, _errors = orchestrate(WANT, worker, clock.remaining,
+                                   clock.reserve, clock.sleep,
+                                   scale_env=scale_env_from_probe)
+    shrink = "ADAM_TPU_BENCH_FLAGSTAT_READS"
+    assert shrink not in worker.calls[0][1]
+    assert worker.calls[1][1][shrink] == "11250000"
+    # the CPU probe in attempt 2 did NOT clear the override
+    assert worker.calls[2][1][shrink] == "11250000"
+
+
+def test_save_artifact_keeps_tpu_headline_over_worse_docs(tmp_path):
+    """tpu_watch's keep-dont-clobber, extended: a re-entry run that
+    never measured flagstat (platform=tpu, value=0) must not overwrite
+    the committed TPU artifact holding the real headline."""
+    repo = str(tmp_path)
+    good = {"platform": "tpu", "value": 123456}
+    assert tpu_watch._save_artifact(repo, "B.json", good) == "saved"
+    assert tpu_watch._save_artifact(
+        repo, "B.json", {"platform": "tpu", "value": 0}) == "kept"
+    assert tpu_watch._save_artifact(
+        repo, "B.json", {"platform": "cpu", "value": 999}) == "kept"
+    assert tpu_watch._save_artifact(
+        repo, "B.json", {"platform": "tpu", "value": 999}) == "saved"
+    with open(tmp_path / "B.json") as f:
+        assert json.load(f)["value"] == 999
+
+
+def test_main_reports_ledger_headline_when_reentry_skips_flagstat(
+        tmp_path, monkeypatch, capsys):
+    """A --only re-entry run that skips flagstat reports the ledger's
+    captured headline (value_source cites the window), never value=0
+    labeled tpu — the combination _save_artifact would then refuse."""
+    import benchlib
+
+    monkeypatch.setenv("ADAM_TPU_BENCH_METRICS_DIR", str(tmp_path))
+    monkeypatch.setenv("ADAM_TPU_WINDOW_ID", "w2")
+    led = Ledger(str(tmp_path / "EVIDENCE_LEDGER.json"))
+    led.record_stage("flagstat", {"reads_per_sec": 123456,
+                                  "backend": "tpu"},
+                     platform="tpu", window_id="w1")
+    led.save()
+
+    def fake_orchestrate(want, run_worker, *a, **kw):
+        return ({"probe": {"platform": "tpu",
+                           "device_kind": "TPU v5 lite"},
+                 "bqsr_race": {"race_winner": "scatter",
+                               "race_backend": "tpu"}}, [])
+
+    monkeypatch.setattr(benchlib, "orchestrate", fake_orchestrate)
+    bench.main(["probe", "bqsr_race"])
+    doc = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert doc["value"] == 123456
+    assert doc["platform"] == "tpu"
+    assert doc["value_source"] == "ledger:w1"
+
+
+def test_ledger_failures_never_break_the_bench_contract():
+    """A broken ledger (unwritable path, bad state) must not take down
+    the one-line bench artifact — evidence is best-effort."""
+    class ExplodingLedger:
+        def record_stages(self, *_a, **_k):
+            raise RuntimeError("disk full")
+
+        def save(self):
+            raise RuntimeError("disk full")
+
+    clock = FakeClock()
+    all_stages = tpu_probe() | payloads("flagstat", "transform",
+                                        "bqsr_race", "pallas",
+                                        "bqsr_race8")
+    worker = FakeWorker(clock, [(all_stages, None, None, 60.0)])
+    stages, errors = orchestrate(WANT, worker, clock.remaining,
+                                 clock.reserve, clock.sleep,
+                                 ledger=ExplodingLedger(), window_id="w1")
+    assert errors == []
+    assert set(stages) == set(WANT)

@@ -1,0 +1,157 @@
+"""tools/check_evidence.py — the evidence-ledger drift guard, pinned
+the way tests/test_obs.py pins tools/check_metrics.py: a synthesized
+ledger validates, torn/wrong documents are rejected with precise
+errors, and a REAL CPU bench.py invocation produces a ledger + probe
+record that validate in CI — bench, ledger, probe analysis, and
+validator cannot drift apart."""
+
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+ROOT = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(ROOT))
+
+from adam_tpu.evidence.ledger import Ledger  # noqa: E402
+from adam_tpu.evidence.probe import analyze_probe  # noqa: E402
+
+_spec = importlib.util.spec_from_file_location(
+    "check_evidence", ROOT / "tools" / "check_evidence.py")
+check_evidence = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(check_evidence)
+
+
+def _synth_ledger(path: str) -> Ledger:
+    led = Ledger(path)
+    probe_rec = analyze_probe(
+        rtt_s=0.19, tflops_samples=[186.0, 184.0, 189.5],
+        chain_points=[(128, 0.2), (256, 0.21), (512, 0.24)],
+        is_tpu=True, link_bytes_per_sec=45e6)
+    led.record_stages(
+        {"probe": {"platform": "tpu", "device_kind": "TPU v5 lite",
+                   **probe_rec},
+         "bqsr_race": {"race_backend": "tpu", "race_n_reads": 1_000_000,
+                       "race_winner": "pallas", "stage_wall_s": 33.0},
+         "flagstat": {"backend": "tpu", "n_reads": 12_000_000,
+                      "reads_per_sec": 1e8, "stage_wall_s": 41.0}},
+        window_id="w1")
+    led.save()
+    return led
+
+
+def test_synthesized_ledger_validates(tmp_path, capsys):
+    path = str(tmp_path / "EVIDENCE_LEDGER.json")
+    _synth_ledger(path)
+    assert check_evidence.validate(path) == []
+    assert check_evidence.main([path]) == 0
+    out = capsys.readouterr().out
+    assert "ok (3 stages, 3 on-chip, 1 probes" in out
+
+
+def test_rejects_torn_json_and_wrong_schema(tmp_path):
+    torn = tmp_path / "torn.json"
+    torn.write_text('{"schema": 1, "stages": {')
+    assert any("invalid JSON" in e
+               for e in check_evidence.validate(str(torn)))
+    wrong = tmp_path / "wrong.json"
+    wrong.write_text(json.dumps({"schema": 99, "updated_at": "x",
+                                 "stages": {}, "probes": []}))
+    assert any("schema" in e for e in check_evidence.validate(str(wrong)))
+    assert check_evidence.main([str(torn), str(wrong)]) == 1
+
+
+def test_rejects_skip_marker_and_malformed_stage_records(tmp_path):
+    doc = {"schema": 1, "updated_at": "2026-08-02T00:00:00Z",
+           "probes": [],
+           "stages": {
+               # skip markers are not evidence — recording one marks
+               # the stage as paid for and the scheduler would defer it
+               "pallas": {"stage": "pallas", "platform": "cpu",
+                          "result_digest": "a" * 16, "window_id": "w1",
+                          "captured_at": "2026-08-02T00:00:00Z",
+                          "payload": {"skipped": "needs TPU"}},
+               # wrong key/field mismatches
+               "flagstat": {"stage": "transform", "platform": "",
+                            "result_digest": "nothex!", "window_id": "",
+                            "captured_at": "2026-08-02T00:00:00Z",
+                            "payload": {"x": 1}, "wire_bytes": -4,
+                            "wall_s": "fast",
+                            "link_bytes_per_sec": 0}}}
+    p = tmp_path / "L.json"
+    p.write_text(json.dumps(doc))
+    errs = check_evidence.validate(str(p))
+    assert any("skip-marker" in e for e in errs)
+    assert any("!= key" in e for e in errs)
+    assert any("platform" in e for e in errs)
+    assert any("result_digest" in e for e in errs)
+    assert any("wire_bytes" in e for e in errs)
+    assert any("wall_s" in e for e in errs)
+    assert any("link_bytes_per_sec" in e for e in errs)
+    # captured stages with NO probe history: unadjudicatable evidence
+    assert any("no probe records" in e for e in errs)
+
+
+def test_rejects_malformed_probe_records(tmp_path):
+    doc = {"schema": 1, "updated_at": "2026-08-02T00:00:00Z",
+           "stages": {},
+           "probes": [{"window_id": "", "rtt_ms": -1,
+                       "repeat_matmul_tflops": [],
+                       "chain_linearity_residual": -0.5,
+                       "calibration_deviation_flag": "yes"}]}
+    p = tmp_path / "L.json"
+    p.write_text(json.dumps(doc))
+    errs = check_evidence.validate(str(p))
+    assert any("window_id" in e for e in errs)
+    assert any("rtt_ms" in e for e in errs)
+    assert any("repeat_matmul_tflops" in e for e in errs)
+    assert any("chain_linearity_residual" in e for e in errs)
+    assert any("calibration_tflops" in e for e in errs)
+    assert any("calibration_deviation_flag" in e for e in errs)
+
+
+def test_real_cpu_bench_invocation_ledger_validates(tmp_path):
+    """The whole artifact chain, for real: bench.py (CPU backend, one
+    shrunken stage) writes EVIDENCE_LEDGER.json next to its artifact;
+    the validator passes it and the record cites the run's window id.
+    Budget 180 with reserve 150 skips the device-retry loop (no tunnel
+    in CI), going straight to the CPU fallback pass."""
+    env = {k: v for k, v in os.environ.items()
+           if not k.startswith("ADAM_TPU_")}
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "ADAM_TPU_BENCH_TOTAL_BUDGET": "180",
+        "ADAM_TPU_BENCH_CPU_RESERVE": "150",
+        "ADAM_TPU_BENCH_CPU_RUNS": "1",
+        "ADAM_TPU_BENCH_FLAGSTAT_READS": "200000",
+        "ADAM_TPU_QUIET": "1",
+    })
+    proc = subprocess.run(
+        [sys.executable, str(ROOT / "bench.py"), "--only", "flagstat"],
+        cwd=tmp_path, env=env, capture_output=True, text=True,
+        timeout=240)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    result = json.loads(proc.stdout.strip().splitlines()[-1])
+
+    ledger_path = tmp_path / "EVIDENCE_LEDGER.json"
+    assert ledger_path.exists()
+    assert check_evidence.validate(str(ledger_path)) == []
+    assert check_evidence.main([str(ledger_path)]) == 0
+
+    doc = json.loads(ledger_path.read_text())
+    assert set(doc["stages"]) == {"probe", "flagstat"}
+    assert len(doc["probes"]) >= 1
+    flag = doc["stages"]["flagstat"]
+    assert flag["platform"] == "cpu"
+    assert flag["window_id"] == result["window_id"]
+    assert flag["payload"]["n_runs"] == 1          # median-of-N fields
+    assert flag["wall_s"] > 0                       # stage window cost
+    # the probe record is self-diagnosing even on the CPU backend:
+    # calibration N/A (no 190-TFLOPs flag on a CPU), RTT + samples there
+    probe = doc["probes"][-1]
+    assert probe["calibration_applies"] is False
+    assert probe["calibration_deviation_flag"] is False
+    assert probe["repeat_matmul_n"] >= 3
+    assert probe["rtt_ms"] >= 0

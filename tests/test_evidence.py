@@ -1,0 +1,378 @@
+"""Unit pins for ``adam_tpu.evidence`` — ledger keep-best merge,
+information-first scheduling, and the self-diagnosing probe analysis.
+All hardware-free; the 60-second window rehearsal that drives these
+pieces end-to-end lives in tests/test_bench_orchestration.py."""
+
+import json
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from adam_tpu.evidence import ledger as ev_ledger  # noqa: E402
+from adam_tpu.evidence import probe as ev_probe  # noqa: E402
+from adam_tpu.evidence import scheduler as ev_sched  # noqa: E402
+from adam_tpu.evidence.ledger import Ledger  # noqa: E402
+
+
+# ---------------------------------------------------------------------------
+# ledger
+# ---------------------------------------------------------------------------
+
+def _tpu_rec(stage, captured_at="2026-08-01T00:00:00Z", digest="a" * 16):
+    return {"stage": stage, "platform": "tpu", "captured_at": captured_at,
+            "result_digest": digest, "window_id": "w1",
+            "payload": {"x": 1}}
+
+
+def _cpu_rec(stage, captured_at="2026-08-02T00:00:00Z"):
+    return {"stage": stage, "platform": "cpu", "captured_at": captured_at,
+            "result_digest": "b" * 16, "window_id": "w2",
+            "payload": {"x": 2}}
+
+
+def test_merge_records_tpu_never_clobbered_by_cpu():
+    tpu, cpu = _tpu_rec("flagstat"), _cpu_rec("flagstat")
+    # regardless of which is newer or which side it arrives on
+    assert ev_ledger.merge_records(tpu, cpu) is tpu
+    assert ev_ledger.merge_records(cpu, tpu) is tpu
+    # same-quality: newer captured_at wins
+    newer = _tpu_rec("flagstat", captured_at="2026-08-03T00:00:00Z")
+    assert ev_ledger.merge_records(tpu, newer) is newer
+    assert ev_ledger.merge_records(newer, tpu) is newer
+    # None handling
+    assert ev_ledger.merge_records(None, cpu) is cpu
+    assert ev_ledger.merge_records(tpu, None) is tpu
+
+
+def test_ledger_record_save_reload_roundtrip(tmp_path):
+    path = str(tmp_path / "LEDGER.json")
+    led = Ledger(path)
+    led.record_stage("bqsr_race", {"race_winner": "pallas"},
+                     platform="tpu", window_id="w1",
+                     wire_bytes=8_000_000, wall_s=42.5,
+                     link_bytes_per_sec=45e6)
+    led.record_probe({"window_id": "w1",
+                      "captured_at": ev_ledger.now_iso(),
+                      "rtt_ms": 190.0})
+    led.save()
+
+    led2 = Ledger(path)
+    rec = led2.record("bqsr_race")
+    assert rec["platform"] == "tpu"
+    assert rec["wire_bytes"] == 8_000_000
+    assert rec["wall_s"] == 42.5
+    assert rec["window_id"] == "w1"
+    assert len(rec["result_digest"]) == 16
+    assert led2.captured_on_tpu("bqsr_race")
+    assert not led2.captured_on_tpu("flagstat")
+    assert led2.last_probe()["rtt_ms"] == 190.0
+    # atomic write: no tmp file left behind
+    assert not (tmp_path / "LEDGER.json.tmp").exists()
+
+
+def test_ledger_save_merges_with_concurrent_writer(tmp_path):
+    """Two processes each captured different stages; the second save
+    must not clobber the first's evidence (merge-on-save)."""
+    path = str(tmp_path / "L.json")
+    a = Ledger(path)
+    b = Ledger(path)            # loaded before a saved anything
+    a.record_stage("bqsr_race", {"race_winner": "scatter"},
+                   platform="tpu", window_id="w1")
+    a.save()
+    b.record_stage("flagstat", {"reads_per_sec": 2},
+                   platform="tpu", window_id="w2")
+    b.save()
+    led = Ledger(path)
+    assert led.captured_on_tpu("bqsr_race")
+    assert led.captured_on_tpu("flagstat")
+
+
+def test_ledger_cpu_capture_never_downgrades_tpu(tmp_path):
+    path = str(tmp_path / "L.json")
+    led = Ledger(path)
+    led.record_stage("flagstat", {"reads_per_sec": 100}, platform="tpu",
+                     window_id="w1")
+    led.save()
+    # later CPU fallback run records the same stage
+    led2 = Ledger(path)
+    led2.record_stage("flagstat", {"reads_per_sec": 5}, platform="cpu",
+                      window_id="w2")
+    assert led2.record("flagstat")["platform"] == "tpu"
+    led2.save()
+    assert Ledger(path).record("flagstat")["window_id"] == "w1"
+
+
+def test_ledger_skip_payloads_are_not_evidence(tmp_path):
+    led = Ledger(str(tmp_path / "L.json"))
+    led.record_stage("pallas", {"skipped": "needs TPU"}, platform="cpu",
+                     window_id="w1")
+    led.record_stage("bqsr_race8", {"race8_skipped": "TPU-only"},
+                     platform="cpu", window_id="w1")
+    assert led.record("pallas") is None
+    assert led.record("bqsr_race8") is None
+
+
+def test_ledger_failure_payloads_are_not_evidence(tmp_path):
+    """A stage that RAN on the TPU but produced nothing (every race leg
+    errored, both pallas kernels rejected) must not be marked captured
+    — re-entry would otherwise never retry it and the evidence would
+    never exist."""
+    led = Ledger(str(tmp_path / "L.json"))
+    led.record_stage("bqsr_race",
+                     {"race_n_reads": 1000,
+                      "race_scatter_error": "XlaRuntimeError: boom"},
+                     platform="tpu", window_id="w1")
+    assert led.record("bqsr_race") is None
+    led.record_stage("pallas",
+                     {"sweep_pallas_ok": False, "sw_pallas_ok": False,
+                      "sweep_pallas_error": "Mosaic rejection"},
+                     platform="tpu", window_id="w1")
+    assert led.record("pallas") is None
+    led.record_stage("flagstat", {"error": "died mid-measure"},
+                     platform="tpu", window_id="w1")
+    assert led.record("flagstat") is None
+    # partial success IS evidence: one pallas kernel ok, a race with a
+    # winner despite a failed leg
+    led.record_stage("pallas", {"sweep_pallas_ok": True,
+                                "sw_pallas_ok": False},
+                     platform="tpu", window_id="w2")
+    led.record_stage("bqsr_race", {"race_winner": "scatter",
+                                   "race_matmul_error": "slow"},
+                     platform="tpu", window_id="w2")
+    assert led.captured_on_tpu("pallas")
+    assert led.captured_on_tpu("bqsr_race")
+
+
+def test_ledger_corrupt_file_degrades_to_empty(tmp_path):
+    path = tmp_path / "L.json"
+    path.write_text("not json{")
+    led = Ledger(str(path))
+    assert led.doc["stages"] == {}
+    # and a wrong-schema doc likewise
+    path.write_text(json.dumps({"schema": 99, "stages": {"x": {}}}))
+    assert Ledger(str(path)).doc["stages"] == {}
+
+
+def test_ledger_record_stages_resolves_platform_and_probe(tmp_path):
+    """The bench-attempt entry point: platform comes from the payload's
+    backend (race_backend for the race), falling back to the probe;
+    'axon' normalizes to tpu; the probe payload also lands in the
+    probes history with the window id."""
+    led = Ledger(str(tmp_path / "L.json"))
+    got = {
+        "probe": {"platform": "tpu", "device_kind": "TPU v5 lite",
+                  "link_bytes_per_sec": 45e6, "rtt_ms": 190.0,
+                  "stage_wall_s": 12.0},
+        "bqsr_race": {"race_backend": "axon", "race_n_reads": 1_000_000,
+                      "race_winner": "pallas", "stage_wall_s": 33.0},
+        "flagstat": {"backend": "cpu", "n_reads": 1000,
+                     "reads_per_sec": 7.0, "stage_wall_s": 5.0},
+    }
+    led.record_stages(got, window_id="w7")
+    assert led.record("bqsr_race")["platform"] == "tpu"
+    assert led.record("flagstat")["platform"] == "cpu"
+    assert led.record("probe")["platform"] == "tpu"
+    # wall and link context recorded
+    assert led.record("bqsr_race")["wall_s"] == 33.0
+    assert led.record("bqsr_race")["link_bytes_per_sec"] == 45e6
+    # wire bytes from the payload's read count (8 B/read race wire)
+    assert led.record("bqsr_race")["wire_bytes"] == 8_000_000
+    probes = led.doc["probes"]
+    assert len(probes) == 1 and probes[0]["window_id"] == "w7"
+
+
+def test_summary_line_shows_convergence(tmp_path):
+    led = Ledger(str(tmp_path / "L.json"))
+    want = ["bqsr_race", "flagstat"]
+    assert led.summary_line(want) == \
+        "ledger: 0/2 on-chip; missing: bqsr_race,flagstat"
+    led.record_stage("bqsr_race", {"race_winner": "scatter"},
+                     platform="tpu", window_id="w1")
+    assert led.summary_line(want) == \
+        "ledger: 1/2 on-chip (bqsr_race); missing: flagstat"
+    led.record_stage("flagstat", {"reads_per_sec": 1},
+                     platform="tpu", window_id="w2")
+    assert led.summary_line(want).endswith("; complete")
+    assert led.missing_stages(want) == []
+
+
+def test_ledger_emits_obs_events_and_counters(tmp_path):
+    from adam_tpu import obs
+
+    log_path = str(tmp_path / "m.jsonl")
+    with obs.metrics_run(log_path):
+        led = Ledger(str(tmp_path / "L.json"))
+        led.record_stage("bqsr_race", {"race_winner": "scatter"},
+                         window_id="w1", platform="tpu")
+        snap = obs.registry().snapshot()
+        assert snap["counters"]["ledger_stage_captured{platform=tpu}"] == 1
+        assert snap["gauges"]["ledger_on_chip_stages"] == 1
+    events = [json.loads(ln) for ln in open(log_path)]
+    ev = [e for e in events if e["event"] == "ledger_stage"]
+    assert len(ev) == 1 and ev[0]["stage"] == "bqsr_race" and \
+        ev[0]["window_id"] == "w1"
+
+
+# ---------------------------------------------------------------------------
+# scheduler
+# ---------------------------------------------------------------------------
+
+def test_default_order_is_information_first():
+    """The round-4/5 inversion fix (bench.py:912): with an empty ledger
+    the 8 MB race runs before the pallas checks, the shrunken
+    transform, and the 34 MB flagstat wire; the exploratory int8 legs
+    run last."""
+    order = ev_sched.order_stages(ev_sched.DEFAULT_STAGE_ORDER)
+    assert order == list(ev_sched.DEFAULT_STAGE_ORDER)
+    assert order[0] == "probe"
+    assert order.index("bqsr_race") < order.index("pallas") < \
+        order.index("transform") < order.index("flagstat") < \
+        order.index("bqsr_race8")
+    # shuffled input, same order out
+    assert ev_sched.order_stages(
+        ["flagstat", "bqsr_race8", "probe", "transform", "pallas",
+         "bqsr_race"]) == order
+
+
+def test_order_defers_captured_stages(tmp_path):
+    """A stage with an on-chip number is never re-paid before a stage
+    without one."""
+    led = Ledger(str(tmp_path / "L.json"))
+    led.record_stage("bqsr_race", {"race_winner": "scatter"},
+                     platform="tpu", window_id="w1")
+    order = ev_sched.order_stages(ev_sched.DEFAULT_STAGE_ORDER, led)
+    assert order[0] == "probe"
+    assert order.index("bqsr_race") > order.index("flagstat")
+    # a CPU-only record does NOT count as captured
+    led.record_stage("transform", {"transform_fused_reads_per_sec": 1},
+                     platform="cpu", window_id="w1")
+    order = ev_sched.order_stages(ev_sched.DEFAULT_STAGE_ORDER, led)
+    assert order.index("transform") < order.index("flagstat")
+
+
+def test_order_cpu_fallback_is_headline_first():
+    """The CPU fallback completes the ARTIFACT, not the evidence set:
+    flagstat (the headline metric) before transform before the race —
+    the window's information-first order reversed, so the slow CPU race
+    legs cannot starve the flagstat value out of the fallback window."""
+    assert ev_sched.order_cpu_fallback(
+        ["bqsr_race", "transform", "flagstat"]) == \
+        ["flagstat", "transform", "bqsr_race"]
+    # unknown stages keep their relative order at the end
+    assert ev_sched.order_cpu_fallback(["mystery", "flagstat"]) == \
+        ["flagstat", "mystery"]
+
+
+def test_parse_only_prepends_probe():
+    assert ev_sched.parse_only(None) is None
+    assert ev_sched.parse_only("") is None
+    assert ev_sched.parse_only("flagstat,transform") == \
+        ["probe", "flagstat", "transform"]
+    assert ev_sched.parse_only("probe,flagstat") == ["probe", "flagstat"]
+
+
+def test_parse_stage_timeouts_overrides_and_skips_garbage():
+    base = {"probe": 150.0, "flagstat": 180.0}
+    out = ev_sched.parse_stage_timeouts(
+        "flagstat=60,junk,bad=notanum,neg=-5,pallas=12.5", base)
+    assert out["flagstat"] == 60.0
+    assert out["probe"] == 150.0          # untouched
+    assert out["pallas"] == 12.5          # new entry allowed
+    assert "neg" not in out
+    assert ev_sched.parse_stage_timeouts(None, base) == base
+
+
+def test_scaled_reads_env_caps_wire_to_link_rate():
+    # a 1 MB/s flap: 45 s of link = 45 MB -> flagstat capped at ~11.25M
+    env = ev_sched.scaled_reads_env(1e6)
+    assert int(env["ADAM_TPU_BENCH_FLAGSTAT_READS"]) == 11_250_000
+    # a 10 kB/s crawl: floors hold (rates are size-independent past
+    # one resident chain block; a too-small wire measures nothing)
+    env = ev_sched.scaled_reads_env(1e4)
+    assert int(env["ADAM_TPU_BENCH_FLAGSTAT_READS"]) == \
+        ev_sched.MIN_FLAGSTAT_READS
+    assert int(env["ADAM_TPU_BENCH_RACE_READS"]) == \
+        ev_sched.MIN_RACE_READS
+    # a fast link: defaults already fit, no overrides
+    assert ev_sched.scaled_reads_env(1e9) == {}
+    assert ev_sched.scaled_reads_env(None) == {}
+
+
+def test_wire_bytes_prefers_payload_read_counts():
+    assert ev_sched.wire_bytes_for("flagstat", {"n_reads": 1000}) == 4000
+    assert ev_sched.wire_bytes_for(
+        "bqsr_race", {"race_n_reads": 1000}) == 8000
+    # defaults when no payload
+    assert ev_sched.wire_bytes_for("flagstat") == 48_000_000
+    assert ev_sched.wire_bytes_for("bqsr_race") == 8_000_000
+
+
+# ---------------------------------------------------------------------------
+# probe analysis
+# ---------------------------------------------------------------------------
+
+def test_chain_linearity_residual_flat_vs_bent():
+    # perfectly linear: residual 0
+    pts = [(8, 0.1 + 8 * 0.01), (16, 0.1 + 16 * 0.01),
+           (32, 0.1 + 32 * 0.01)]
+    assert ev_probe.chain_linearity_residual(pts) < 1e-9
+    # bent (the "finished at 8x peak" async-dispatch lie): large residual
+    bent = [(8, 0.2), (16, 0.2), (32, 2.0)]
+    assert ev_probe.chain_linearity_residual(bent) > 0.3
+    # under 3 distinct points: undefined
+    assert ev_probe.chain_linearity_residual([(8, 0.1), (16, 0.2)]) is None
+
+
+def test_analyze_probe_flags_the_124_tflops_anomaly():
+    """The round-5 artifact: 124 TFLOPs vs the 190 calibration must
+    carry its own deviation flag and a diagnosis line."""
+    rec = ev_probe.analyze_probe(
+        rtt_s=0.19, tflops_samples=[124.0, 121.5, 118.0],
+        chain_points=[(128, 0.2), (256, 0.21), (512, 0.24)],
+        is_tpu=True, link_bytes_per_sec=45e6)
+    assert rec["calibration_tflops"] == 190.0
+    assert rec["calibration_deviation_flag"] is True
+    assert rec["calibration_deviation"] < -0.3
+    assert "124.0" in rec["diagnosis"]
+    assert rec["rtt_ms"] == 190.0
+    assert rec["repeat_matmul_n"] == 3
+    assert rec["link_bytes_per_sec"] == 45e6
+
+
+def test_analyze_probe_healthy_and_cpu_cases():
+    ok = ev_probe.analyze_probe(
+        rtt_s=0.19, tflops_samples=[188.0, 185.0, 191.0],
+        chain_points=[(128, 0.2), (256, 0.21), (512, 0.24)], is_tpu=True)
+    assert ok["calibration_deviation_flag"] is False
+    assert "healthy" in ok["diagnosis"]
+    # CPU fallback: 0.1 TFLOPs is not an "anomaly", calibration N/A
+    cpu = ev_probe.analyze_probe(
+        rtt_s=0.0, tflops_samples=[0.1], chain_points=[(8, 1.0)],
+        is_tpu=False)
+    assert cpu["calibration_deviation"] is None
+    assert cpu["calibration_deviation_flag"] is False
+    assert cpu["chain_linearity_residual"] is None
+
+
+def test_probe_record_validates_against_check_evidence(tmp_path):
+    """The probe analysis output and the ledger that holds it satisfy
+    tools/check_evidence.py — analysis, persistence, and validator
+    cannot drift apart."""
+    sys.path.insert(0, str(Path(__file__).resolve().parent.parent
+                           / "tools"))
+    import check_evidence
+
+    led = Ledger(str(tmp_path / "L.json"))
+    rec = ev_probe.analyze_probe(
+        rtt_s=0.19, tflops_samples=[124.0, 121.5],
+        chain_points=[(128, 0.2), (256, 0.21), (512, 0.24)],
+        is_tpu=True, link_bytes_per_sec=45e6)
+    payload = {"platform": "tpu", "device_kind": "TPU v5 lite", **rec}
+    led.record_stages({"probe": payload,
+                       "bqsr_race": {"race_backend": "tpu",
+                                     "race_n_reads": 1_000_000,
+                                     "stage_wall_s": 30.0}},
+                      window_id="w1")
+    led.save()
+    assert check_evidence.validate(led.path) == []

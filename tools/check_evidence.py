@@ -1,0 +1,188 @@
+#!/usr/bin/env python3
+"""Validate an adam-tpu evidence ledger file (schema 1).
+
+The ledger (default ``EVIDENCE_LEDGER.json``) is produced by
+``adam_tpu.evidence.ledger`` — bench.py records every captured stage
+into it, merged keep-best across tunnel windows; tools/tpu_watch.py
+reads it to re-enter windows with only the missing stages.  Format
+documented in docs/EVIDENCE.md; this validator is the drift guard
+(mirroring tools/check_metrics.py for the telemetry sidecars).
+
+Contract checked here:
+
+* the document is a JSON object with ``schema == 1``, an ``updated_at``
+  string, a ``stages`` object, and a ``probes`` list;
+* every stage record carries: ``stage`` (str, matching its key),
+  ``platform`` (str), ``result_digest`` (hex str, >= 8 chars),
+  ``window_id`` (non-empty str), ``captured_at`` (str), ``payload``
+  (object), plus ``wire_bytes`` (int >= 0 or null), ``wall_s`` (number
+  >= 0 or null) and ``link_bytes_per_sec`` (number > 0 or null);
+* a stage whose payload is a skip marker must not have been recorded;
+* every probe record carries: ``window_id``/``captured_at`` strings,
+  ``rtt_ms`` (number >= 0), ``repeat_matmul_tflops`` (list of >= 1
+  numbers), ``matmul_tflops`` (number or null),
+  ``chain_linearity_residual`` (number >= 0 or null),
+  ``calibration_tflops`` (number), ``calibration_deviation`` (number
+  or null) and ``calibration_deviation_flag`` (bool) — the
+  self-diagnosing fields a partial window artifact explains itself
+  with;
+* a ledger with captured stages must hold at least one probe record
+  (evidence without window health context is unadjudicatable).
+
+Usage::
+
+    python tools/check_evidence.py EVIDENCE_LEDGER.json [...]
+
+Exit 0 when every file validates; 1 otherwise, one error line per
+violation.  Run in CI by tests/test_check_evidence.py against both a
+synthesized ledger and a real CPU bench.py invocation.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from typing import List
+
+SCHEMA_VERSION = 1
+
+_NUM = (int, float)
+
+
+def _is_num(v) -> bool:
+    return isinstance(v, _NUM) and not isinstance(v, bool)
+
+
+def _is_hex(v) -> bool:
+    return (isinstance(v, str) and len(v) >= 8 and
+            all(c in "0123456789abcdef" for c in v))
+
+
+def _check_stage(errs, path, name, rec) -> None:
+    def err(msg):
+        errs.append(f"{path}: stages[{name!r}]: {msg}")
+
+    if not isinstance(rec, dict):
+        err("record is not an object")
+        return
+    if rec.get("stage") != name:
+        err(f"stage field {rec.get('stage')!r} != key")
+    if not isinstance(rec.get("platform"), str) or not rec.get("platform"):
+        err("missing non-empty string 'platform'")
+    if not _is_hex(rec.get("result_digest")):
+        err("result_digest is not a hex digest")
+    if not isinstance(rec.get("window_id"), str) or not rec.get("window_id"):
+        err("missing non-empty string 'window_id'")
+    if not isinstance(rec.get("captured_at"), str):
+        err("missing string 'captured_at'")
+    payload = rec.get("payload")
+    if not isinstance(payload, dict):
+        err("missing object 'payload'")
+    elif any(k == "skipped" or k.endswith("_skipped") for k in payload):
+        err("skip-marker payload recorded as evidence")
+    wb = rec.get("wire_bytes")
+    if wb is not None and not (isinstance(wb, int) and
+                               not isinstance(wb, bool) and wb >= 0):
+        err("wire_bytes is not a non-negative int or null")
+    ws = rec.get("wall_s")
+    if ws is not None and not (_is_num(ws) and ws >= 0):
+        err("wall_s is not a non-negative number or null")
+    lr = rec.get("link_bytes_per_sec")
+    if lr is not None and not (_is_num(lr) and lr > 0):
+        err("link_bytes_per_sec is not a positive number or null")
+
+
+def _check_probe(errs, path, i, rec) -> None:
+    def err(msg):
+        errs.append(f"{path}: probes[{i}]: {msg}")
+
+    if not isinstance(rec, dict):
+        err("record is not an object")
+        return
+    for field in ("window_id", "captured_at"):
+        if not isinstance(rec.get(field), str) or not rec.get(field):
+            err(f"missing non-empty string {field!r}")
+    if not (_is_num(rec.get("rtt_ms")) and rec["rtt_ms"] >= 0):
+        err("missing non-negative 'rtt_ms'")
+    samples = rec.get("repeat_matmul_tflops")
+    if not (isinstance(samples, list) and len(samples) >= 1 and
+            all(_is_num(s) for s in samples)):
+        err("repeat_matmul_tflops is not a non-empty number list")
+    mt = rec.get("matmul_tflops")
+    if mt is not None and not _is_num(mt):
+        err("matmul_tflops is not a number or null")
+    resid = rec.get("chain_linearity_residual")
+    if resid is not None and not (_is_num(resid) and resid >= 0):
+        err("chain_linearity_residual is not a non-negative number "
+            "or null")
+    if not _is_num(rec.get("calibration_tflops")):
+        err("missing numeric 'calibration_tflops'")
+    dev = rec.get("calibration_deviation")
+    if dev is not None and not _is_num(dev):
+        err("calibration_deviation is not a number or null")
+    if not isinstance(rec.get("calibration_deviation_flag"), bool):
+        err("missing boolean 'calibration_deviation_flag'")
+
+
+def validate(path: str) -> List[str]:
+    """Return a list of human-readable schema violations (empty = valid)."""
+    errs: List[str] = []
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except OSError as e:
+        return [f"{path}: unreadable: {e}"]
+    except ValueError as e:
+        return [f"{path}: invalid JSON: {e}"]
+    if not isinstance(doc, dict):
+        return [f"{path}: document is not a JSON object"]
+    if doc.get("schema") != SCHEMA_VERSION:
+        errs.append(f"{path}: schema {doc.get('schema')!r} != "
+                    f"{SCHEMA_VERSION}")
+    if not isinstance(doc.get("updated_at"), str):
+        errs.append(f"{path}: missing string 'updated_at'")
+    stages = doc.get("stages")
+    if not isinstance(stages, dict):
+        errs.append(f"{path}: missing 'stages' object")
+        stages = {}
+    probes = doc.get("probes")
+    if not isinstance(probes, list):
+        errs.append(f"{path}: missing 'probes' list")
+        probes = []
+    for name, rec in stages.items():
+        _check_stage(errs, path, name, rec)
+    for i, rec in enumerate(probes):
+        _check_probe(errs, path, i, rec)
+    if stages and not probes:
+        errs.append(f"{path}: captured stages but no probe records — "
+                    f"evidence lacks window health context")
+    return errs
+
+
+def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    if not argv:
+        print("usage: check_evidence.py EVIDENCE_LEDGER.json [...]",
+              file=sys.stderr)
+        return 2
+    bad = 0
+    for path in argv:
+        errors = validate(path)
+        if errors:
+            bad += 1
+            for e in errors:
+                print(e, file=sys.stderr)
+        else:
+            with open(path) as f:
+                doc = json.load(f)
+            n_tpu = sum(1 for r in doc.get("stages", {}).values()
+                        if isinstance(r, dict) and
+                        r.get("platform") == "tpu")
+            print(f"{path}: ok ({len(doc.get('stages', {}))} stages, "
+                  f"{n_tpu} on-chip, {len(doc.get('probes', []))} "
+                  f"probes, schema {SCHEMA_VERSION})")
+    return 1 if bad else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
