@@ -122,7 +122,8 @@ def _apply_events(state: np.ndarray, start: np.ndarray,
 
 
 def mismatch_state(table: pa.Table, batch: ReadBatch,
-                   snp_table: Optional[SnpTable] = None) -> np.ndarray:
+                   snp_table: Optional[SnpTable] = None,
+                   device_batch: Optional[ReadBatch] = None) -> np.ndarray:
     """[N, L] int8 per-base state for pass 1.
 
     Mirrors ReadCovariates.next (:49-60): a base is MASKED when its reference
@@ -150,10 +151,13 @@ def mismatch_state(table: pa.Table, batch: ReadBatch,
     # 500k-read chunk on CPU, and copying the int32 position matrix to
     # host another ~2.5 s/M — so the state is built on device (1 B/base
     # crosses) and positions stay device-resident for the few
-    # complex-cigar event rows that need them
+    # complex-cigar event rows that need them.  ``device_batch`` (the
+    # executor's prefetched feed) supplies already-transferred columns so
+    # the geometry inputs don't cross the link twice.
+    db = device_batch if device_batch is not None else batch
     state_d, end_d, pos_d = _state_base_kernel(
-        jnp.asarray(batch.start), jnp.asarray(batch.cigar_ops),
-        jnp.asarray(batch.cigar_lens), jnp.asarray(has_md_pad), max_len=L)
+        jnp.asarray(db.start), jnp.asarray(db.cigar_ops),
+        jnp.asarray(db.cigar_lens), jnp.asarray(has_md_pad), max_len=L)
     # .copy(): the CPU backend zero-copies device buffers read-only, and
     # the event scatters below write in place
     state = np.asarray(state_d)[:n].copy()
@@ -550,12 +554,19 @@ def _count_slab_rows() -> int:
 
 
 @lru_cache(maxsize=16)
-def _sharded_count_fn(kernel, mesh, n_qual_rg: int, n_cycle: int):
+def _sharded_count_fn(kernel, mesh, n_qual_rg: int, n_cycle: int,
+                      donate: bool = False):
     """Build (and cache — a fresh shard_map+jit per chunk would retrace
     every call, like distributed.py's _build_resharder) the count kernel
     under shard_map over the read axis, tables psum-merged across the
     mesh — the distributed form the reference reaches with its
-    driver-side aggregate (RecalibrateBaseQualities:52-64 tree-reduce)."""
+    driver-side aggregate (RecalibrateBaseQualities:52-64 tree-reduce).
+
+    ``donate=True`` (the streaming executor's per-chunk path) donates
+    all 7 per-chunk inputs: each chunk's tensors are consumed exactly
+    once, so the device reuses their HBM for the next chunk's arrivals
+    instead of re-allocating.  Callers that re-dispatch the same buffers
+    (the bench race chains) must keep the default."""
     from jax.sharding import PartitionSpec as P
 
     from ..parallel.mesh import READS_AXIS
@@ -565,7 +576,20 @@ def _sharded_count_fn(kernel, mesh, n_qual_rg: int, n_cycle: int):
         partial(kernel, n_qual_rg=n_qual_rg, n_cycle=n_cycle,
                 axis_name=READS_AXIS),
         mesh=mesh, in_specs=(spec,) * 7, out_specs=(P(),) * 7)
-    return jax.jit(fn)
+    return jax.jit(fn, donate_argnums=tuple(range(7)) if donate else ())
+
+
+@lru_cache(maxsize=8)
+def _donating_count_fn(kernel):
+    """The unsharded count kernel re-jitted with its 7 per-chunk array
+    args donated (same trace — ``__wrapped__`` is the undecorated body;
+    the jit cache keys the two variants separately)."""
+    statics = ("n_qual_rg", "n_cycle", "block_rows", "axis_name") \
+        if kernel is _count_kernel_matmul \
+        else ("n_qual_rg", "n_cycle", "axis_name")
+    return jax.jit(getattr(kernel, "__wrapped__", kernel),
+                   static_argnames=statics,
+                   donate_argnums=tuple(range(7)))
 
 
 @lru_cache(maxsize=16)
@@ -580,7 +604,9 @@ def count_tables_device(table: pa.Table,
                         batch: Optional[ReadBatch] = None,
                         snp_table: Optional[SnpTable] = None,
                         n_read_groups: Optional[int] = None,
-                        mesh=None):
+                        mesh=None,
+                        device_batch: Optional[ReadBatch] = None,
+                        donate: bool = False):
     """Pass-1 counting for one chunk, WITHOUT the host sync: returns the 7
     count tensors (qual_obs, qual_mm, cycle_obs, cycle_mm, ctx_obs,
     ctx_mm, qhist) still on device (numpy under the "host" impl — both add
@@ -592,6 +618,15 @@ def count_tables_device(table: pa.Table,
     Large chunks walk in `_count_slab_rows()` row slabs (see note at
     ``_COUNT_SLAB_ENV``); the sharded mesh path stays monolithic — its rows
     already split across devices under shard_map.
+
+    ``device_batch`` (the executor's prefetched feed) carries the same
+    batch already transferred — consumed by the monolithic paths
+    (sharded, or unsharded within one slab), where the kernel takes
+    whole columns; the slab walk slices rows, and slicing device arrays
+    would dispatch a compiled slice per offset (fresh shapes, the exact
+    churn the executor exists to kill), so it keeps the host batch.
+    ``donate=True`` donates the kernel's per-chunk inputs (streaming
+    path only; see `_sharded_count_fn`).
     """
     n = table.num_rows
     if batch is None:
@@ -607,17 +642,21 @@ def count_tables_device(table: pa.Table,
             e = min(s + slab, batch.n_reads)
             out = _count_tables_one(table.slice(s, max(min(e, n) - s, 0)),
                                     batch.row_slice(s, e),
-                                    snp_table, n_read_groups, None)
+                                    snp_table, n_read_groups, None,
+                                    donate=donate)
             acc = out if acc is None else tuple(
                 a + b for a, b in zip(acc, out))
         return acc
     return _count_tables_one(table, batch, snp_table, n_read_groups,
-                             mesh if sharded else None)
+                             mesh if sharded else None,
+                             device_batch=device_batch, donate=donate)
 
 
 def _count_tables_one(table: pa.Table, batch: ReadBatch,
                       snp_table: Optional[SnpTable],
-                      n_read_groups: int, mesh):
+                      n_read_groups: int, mesh,
+                      device_batch: Optional[ReadBatch] = None,
+                      donate: bool = False):
     """One slab's pass-1 count (the pre-slab body of
     :func:`count_tables_device`)."""
     n = table.num_rows
@@ -628,7 +667,9 @@ def _count_tables_one(table: pa.Table, batch: ReadBatch,
     usable = usable_read_mask(flags_np, has_md) & np.asarray(batch.valid)
 
     state = np.full((batch.n_reads, batch.max_len), STATE_MASKED, np.int8)
-    state[:n] = mismatch_state(table, batch, snp_table)
+    state[:n] = mismatch_state(table, batch, snp_table,
+                               device_batch=device_batch)
+    dev = device_batch if device_batch is not None else batch
 
     rt = RecalTable(n_read_groups=max(n_read_groups, 1),
                     max_read_len=batch.max_len)
@@ -653,9 +694,11 @@ def _count_tables_one(table: pa.Table, batch: ReadBatch,
         assert fits(rt.n_qual_rg, rt.n_cycle), \
             "covariate ranges exceed the packed-word budget"
         variant = "flat" if impl == "pallas" else "rows"
-        args = (jnp.asarray(batch.bases), jnp.asarray(batch.quals),
-                jnp.asarray(batch.read_len), jnp.asarray(batch.flags),
-                jnp.asarray(batch.read_group), jnp.asarray(state),
+        # pallas_call manages its own VMEM streaming; input donation is
+        # not threaded through the Mosaic wrappers
+        args = (jnp.asarray(dev.bases), jnp.asarray(dev.quals),
+                jnp.asarray(dev.read_len), jnp.asarray(dev.flags),
+                jnp.asarray(dev.read_group), jnp.asarray(state),
                 jnp.asarray(usable))
         if sharded:
             out = _sharded_pallas_fn(mesh, rt.n_qual_rg, rt.n_cycle,
@@ -670,20 +713,22 @@ def _count_tables_one(table: pa.Table, batch: ReadBatch,
     else:
         kernel = {"matmul": _count_kernel_matmul,
                   "chain": _count_kernel_chain}.get(impl, _count_kernel)
-        args = (jnp.asarray(batch.bases), jnp.asarray(batch.quals),
-                jnp.asarray(batch.read_len), jnp.asarray(batch.flags),
-                jnp.asarray(batch.read_group), jnp.asarray(state),
+        args = (jnp.asarray(dev.bases), jnp.asarray(dev.quals),
+                jnp.asarray(dev.read_len), jnp.asarray(dev.flags),
+                jnp.asarray(dev.read_group), jnp.asarray(state),
                 jnp.asarray(usable))
         if impl == "chain":
             # host-driven dispatch loop; runs outside shard_map by design
+            # (and keeps its own donated carry — see the step jit)
             out = kernel(*args, n_qual_rg=rt.n_qual_rg,
                          n_cycle=rt.n_cycle)
         elif sharded:
             out = _sharded_count_fn(kernel, mesh, rt.n_qual_rg,
-                                    rt.n_cycle)(*args)
+                                    rt.n_cycle, donate)(*args)
         else:
-            out = kernel(*args, n_qual_rg=rt.n_qual_rg,
-                         n_cycle=rt.n_cycle)
+            fn = _donating_count_fn(kernel) if donate else kernel
+            out = fn(*args, n_qual_rg=rt.n_qual_rg,
+                     n_cycle=rt.n_cycle)
     return out
 
 
@@ -743,10 +788,32 @@ def _recalibrated_qual(reported, k, cyc, ctx, rg_delta, qual_delta,
     return jnp.trunc(-10.0 * jnp.log10(p)).astype(jnp.int8)
 
 
+#: the LUT's raw-qual axis is sized from the SAME table the per-base
+#: kernel gathers ``reported`` from, so the two paths share one qual
+#: domain by construction (a 128-entry axis silently clipped quals the
+#: kernel path would have looked up past 127 — round-5 advisor)
+_LUT_QUALS = int(PHRED_TO_ERROR.shape[0])
+
+
+def _require_int8_quals(quals) -> None:
+    """Both apply entry points take int8 quals (the packer's dtype).
+
+    int8 tops out at 127, which is what makes the LUT's raw-qual clip
+    and ``_apply_kernel``'s 0..255 reported-error clip agree on every
+    reachable value — enforce it at trace time so the bit-identity is a
+    checked contract, not an accident of current callers."""
+    if quals.dtype != jnp.int8:
+        raise TypeError(
+            f"BQSR apply kernels take int8 quals, got {quals.dtype}: "
+            "wider quals would index past the LUT's qual axis and break "
+            "LUT/per-base bit-identity")
+
+
 @partial(jax.jit, static_argnames=())
 def _apply_kernel(bases, quals, read_len, flags, read_group, recal_mask,
                   rg_delta, qual_delta, cycle_delta, ctx_delta, rg_of_qualrg):
     """Pass-2: per-base gathers from the delta tables -> new quals."""
+    _require_int8_quals(quals)
     cov = covariate_tensors(bases, quals, read_len, flags, read_group)
     Q = qual_delta.shape[0]
     k = jnp.clip(cov["qual_rg"], 0, Q - 1)
@@ -763,22 +830,24 @@ def _apply_kernel(bases, quals, read_len, flags, read_group, recal_mask,
 @partial(jax.jit, static_argnames=("n_rg",))
 def _build_apply_lut(n_rg: int, rg_delta, qual_delta, cycle_delta,
                      ctx_delta, rg_of_qualrg):
-    """[128*n_rg*n_cycle*17] int8 new-qual table: the recalibrated qual
-    is a pure function of (raw qual, read group, cycle bin, context), so
-    evaluate ``_apply_kernel``'s EXACT expression once over the
-    enumerated grid — same jnp ops, same backend, same precision — and
-    pass 2 becomes one int8 gather per base.  Bit-identity with the
+    """[_LUT_QUALS*n_rg*n_cycle*17] int8 new-qual table: the recalibrated
+    qual is a pure function of (raw qual, read group, cycle bin,
+    context), so evaluate ``_apply_kernel``'s EXACT expression once over
+    the enumerated grid — same jnp ops, same backend, same precision —
+    and pass 2 becomes one int8 gather per base.  Bit-identity with the
     per-base kernel is by construction (and differential-pinned).
 
     Grid axes carry raw qual and read group separately (not the fused
     qual_rg index): ``reported`` reads the RAW qual while the delta
     lookups read the clipped fused index, so a k-only table would alias
-    quals >= MAX_REASONABLE_QSCORE across neighboring read groups.
+    quals >= MAX_REASONABLE_QSCORE across neighboring read groups.  The
+    qual axis spans the whole PHRED_TO_ERROR domain (``_LUT_QUALS``), the
+    same table the per-base kernel gathers from.
     """
     Q = qual_delta.shape[0]
     n_cycle = cycle_delta.shape[1]
     n_ctx = ctx_delta.shape[1]
-    q = jnp.arange(128, dtype=jnp.int32)[:, None, None, None]
+    q = jnp.arange(_LUT_QUALS, dtype=jnp.int32)[:, None, None, None]
     rg = jnp.arange(n_rg, dtype=jnp.int32)[None, :, None, None]
     cyc = jnp.arange(n_cycle, dtype=jnp.int32)[None, None, :, None]
     ctx = jnp.arange(n_ctx, dtype=jnp.int32)[None, None, None, :]
@@ -796,10 +865,11 @@ def _apply_kernel_lut(bases, quals, read_len, flags, read_group,
     """Pass-2 via the precomputed new-qual LUT: covariates + ONE gather
     (vs three flat delta gathers + log10 per base in ``_apply_kernel``)."""
     from .covariates import N_CONTEXT
+    _require_int8_quals(quals)
     cov = covariate_tensors(bases, quals, read_len, flags, read_group)
     n_ctx = N_CONTEXT
-    n_cycle = lut.shape[0] // (128 * n_rg * n_ctx)
-    iq = jnp.clip(quals.astype(jnp.int32), 0, 127)
+    n_cycle = lut.shape[0] // (_LUT_QUALS * n_rg * n_ctx)
+    iq = jnp.clip(quals.astype(jnp.int32), 0, _LUT_QUALS - 1)
     irg = jnp.clip(jnp.maximum(read_group, 0), 0, n_rg - 1)[:, None]
     cyc = jnp.clip(cov["cycle_idx"], 0, n_cycle - 1)
     idx = ((iq * n_rg + irg) * n_cycle + cyc) * n_ctx + cov["context"]
@@ -809,24 +879,46 @@ def _apply_kernel_lut(bases, quals, read_len, flags, read_group,
 
 
 @lru_cache(maxsize=8)
-def _sharded_apply_fn(mesh, n_rg: int):
+def _sharded_apply_fn(mesh, n_rg: int, donate: bool = False):
     """Cached shard_map+jit of the LUT apply kernel: reads shard over
-    the mesh, the LUT replicates (the reference's broadcast variable)."""
+    the mesh, the LUT replicates (the reference's broadcast variable).
+
+    ``donate=True`` donates the 6 per-chunk read columns — the quals
+    input has the output's exact shape and dtype, so the rewritten quals
+    alias the arriving buffer instead of allocating a second [N, L] per
+    chunk.  The replicated LUT (arg 6) is reused across chunks and never
+    donated."""
     from jax.sharding import PartitionSpec as P
 
     from ..parallel.mesh import READS_AXIS
     spec = P(READS_AXIS)
     return jax.jit(shard_map(
         partial(_apply_kernel_lut, n_rg=n_rg), mesh=mesh,
-        in_specs=(spec,) * 6 + (P(),), out_specs=spec))
+        in_specs=(spec,) * 6 + (P(),), out_specs=spec),
+        donate_argnums=tuple(range(6)) if donate else ())
+
+
+@lru_cache(maxsize=4)
+def _donating_apply_lut():
+    """Unsharded LUT apply with the 6 per-chunk args donated (the LUT
+    stays undonated — it is reused across slabs and chunks)."""
+    return jax.jit(getattr(_apply_kernel_lut, "__wrapped__",
+                           _apply_kernel_lut),
+                   static_argnames=("n_rg",),
+                   donate_argnums=tuple(range(6)))
 
 
 def apply_table(rt: RecalTable, table: pa.Table,
-                batch: Optional[ReadBatch] = None, mesh=None) -> pa.Table:
+                batch: Optional[ReadBatch] = None, mesh=None,
+                device_batch: Optional[ReadBatch] = None,
+                donate: bool = False) -> pa.Table:
     """Pass 2: rewrite the qual strings of recalibratable reads.
 
     With ``mesh``, the gather kernel shard_maps over the read axis (the
-    delta tables replicate — the reference's broadcast variable)."""
+    delta tables replicate — the reference's broadcast variable).
+    ``device_batch``/``donate`` are the streaming executor's prefetched
+    feed and HBM-reuse knobs (see count_tables_device — device_batch is
+    consumed by the monolithic sharded path only)."""
     n = table.num_rows
     if batch is None:
         batch = pack_reads(table)
@@ -856,20 +948,24 @@ def apply_table(rt: RecalTable, table: pa.Table,
         batch.n_reads % mesh.size == 0
     slab = _count_slab_rows()
     if sharded:
-        new_quals = np.asarray(_sharded_apply_fn(mesh, n_rg)(
-            *slab_args(batch, recal_mask)))[:n]
+        dev = device_batch if device_batch is not None else batch
+        new_quals = np.asarray(_sharded_apply_fn(mesh, n_rg, donate)(
+            *slab_args(dev, recal_mask)))[:n]
     elif batch.n_reads > slab:
         # same bounded-working-set walk as pass 1 (the apply gathers
         # materialize the identical [rows, L] covariate tensors); per-row
         # output, so slab concatenation is trivially the monolithic result
-        parts = [np.asarray(_apply_kernel_lut(
+        fn = _donating_apply_lut() if donate else _apply_kernel_lut
+        parts = [np.asarray(fn(
             *slab_args(batch.row_slice(s, min(s + slab, batch.n_reads)),
                        recal_mask[s:s + slab]), n_rg=n_rg))
             for s in range(0, batch.n_reads, slab)]
         new_quals = np.concatenate(parts, axis=0)[:n]
     else:
-        new_quals = np.asarray(_apply_kernel_lut(
-            *slab_args(batch, recal_mask), n_rg=n_rg))[:n]
+        dev = device_batch if device_batch is not None else batch
+        fn = _donating_apply_lut() if donate else _apply_kernel_lut
+        new_quals = np.asarray(fn(
+            *slab_args(dev, recal_mask), n_rg=n_rg))[:n]
 
     read_len = np.asarray(batch.read_len[:n], np.int64)
     old_col = table.column("qual").combine_chunks()

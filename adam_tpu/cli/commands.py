@@ -45,6 +45,41 @@ def parquet_writer_kwargs(args, fallback_compression: str = "zstd"):
     )
 
 
+def add_executor_args(p: argparse.ArgumentParser) -> None:
+    """Knobs for the streaming executor (parallel/executor.py) — shared
+    by every command on the shape-bucketed chunk hot path.  Flags mirror
+    the ADAM_TPU_EXECUTOR_* env overrides (docs/EXECUTOR.md)."""
+    p.add_argument("-prefetch_depth", type=int, default=None,
+                   metavar="N",
+                   help="device-feed look-ahead: chunk i+1's device_put "
+                        "overlaps chunk i's compute, at most N chunks "
+                        "in flight (default: 2 on accelerators, 0 on "
+                        "CPU)")
+    p.add_argument("-ladder_base", type=float, default=None,
+                   metavar="BASE",
+                   help="geometric ratio of the canonical row-bucket "
+                        "ladder (default 2.0, floor 1.1; the autotuner "
+                        "densifies to sqrt(2) when pad waste exceeds "
+                        "35%%)")
+    p.add_argument("-no_autotune", action="store_true",
+                   help="freeze the executor plan at its defaults (no "
+                        "pad-waste/link-rate re-decisions at pass "
+                        "boundaries)")
+
+
+def executor_opts_from(args) -> dict:
+    """argparse namespace -> StreamExecutor keyword overrides (only the
+    explicitly set ones, so env vars and autotuning fill the rest)."""
+    opts: dict = {}
+    if getattr(args, "prefetch_depth", None) is not None:
+        opts["prefetch_depth"] = args.prefetch_depth
+    if getattr(args, "ladder_base", None) is not None:
+        opts["ladder_base"] = args.ladder_base
+    if getattr(args, "no_autotune", False):
+        opts["autotune"] = False
+    return opts
+
+
 def input_size_bytes(path: str) -> int:
     """Size of a file input or a Parquet dataset directory (sum of its
     part files)."""
@@ -92,6 +127,7 @@ class FlagStatCommand(Command):
         p.add_argument("-io_procs", type=int, default=1,
                        help="BGZF inflate worker processes (>1 enables; "
                             "byte-identical stream)")
+        add_executor_args(p)
 
     def run(self, args) -> int:
         from ..ops.flagstat import format_report
@@ -99,10 +135,10 @@ class FlagStatCommand(Command):
 
         # streams bounded chunks of the 4-column projection (the reference's
         # 13-field projection, cli/FlagStat.scala:50-57) through the mesh
-        failed, passed = streaming_flagstat(args.input,
-                                            chunk_rows=args.chunk_rows,
-                                            io_threads=args.io_threads,
-                                            io_procs=args.io_procs)
+        failed, passed = streaming_flagstat(
+            args.input, chunk_rows=args.chunk_rows,
+            io_threads=args.io_threads, io_procs=args.io_procs,
+            executor_opts=executor_opts_from(args))
         print(format_report(failed, passed))
         return 0
 
@@ -241,6 +277,7 @@ class TransformCommand(Command):
         p.add_argument("-workdir", default=None,
                        help="scratch directory for streamed spills "
                             "(default: a temp dir)")
+        add_executor_args(p)
         add_parquet_args(p)
 
     def run(self, args) -> int:
@@ -282,7 +319,8 @@ class TransformCommand(Command):
                 row_group_bytes=args.parquet_block_size,
                 resume=bool(args.checkpoint_dir),
                 io_threads=args.io_threads,
-                io_procs=args.io_procs)
+                io_procs=args.io_procs,
+                executor_opts=executor_opts_from(args))
             if args.timing:
                 from ..instrument import print_report
                 print_report()   # one quiet gate for ALL instrument output

@@ -278,16 +278,22 @@ def flagstat_sharded(mesh):
     return jax.jit(fn)
 
 
-def flagstat_wire32_sharded(mesh):
+def flagstat_wire32_sharded(mesh, donate: bool = False):
     """jit-compiled wire32 flagstat over a device mesh: per-shard count +
     psum over ICI, fed by the 4-byte projection word (the streaming CLI
-    path — reference: executor map + driver aggregate, FlagStat.scala:102)."""
+    path — reference: executor map + driver aggregate, FlagStat.scala:102).
+
+    ``donate=True`` donates the wire buffer to the call (the streaming
+    executor's per-chunk feed: each chunk's wire is used exactly once,
+    so the device reuses its HBM instead of re-allocating every chunk).
+    Callers that re-dispatch the same buffer — the bench chain loops —
+    must keep the default."""
     from jax.sharding import PartitionSpec as P
     from ..parallel.mesh import READS_AXIS
     fn = shard_map(
         partial(flagstat_kernel_wire32, axis_name=READS_AXIS), mesh=mesh,
         in_specs=(P(READS_AXIS),), out_specs=P())
-    return jax.jit(fn)
+    return jax.jit(fn, donate_argnums=(0,) if donate else ())
 
 
 def flagstat(batch: ReadBatch) -> tuple[FlagStatMetrics, FlagStatMetrics]:

@@ -257,7 +257,8 @@ def _local_flagstat(wire, *, interpret: bool):
     return counts + flagstat_kernel_wire32(wire[n_blk * BLOCK:])
 
 
-def flagstat_wire32_sharded_pallas(mesh, interpret: bool = False):
+def flagstat_wire32_sharded_pallas(mesh, interpret: bool = False,
+                                   donate: bool = False):
     """Mesh-sharded fast path: each shard runs the Pallas wire sweep on its
     local slice, counters psum over ICI — drop-in for
     :func:`..ops.flagstat.flagstat_wire32_sharded` (the streaming CLI
@@ -277,9 +278,11 @@ def flagstat_wire32_sharded_pallas(mesh, interpret: bool = False):
     # actually reaches the kernel (>= one VMEM block).  Shards below one
     # block take the XLA tail and never trip it — which is why only a
     # full-block dryrun caught this.
+    # donate=True (the streaming executor's per-chunk feed) lets the
+    # device reuse each chunk's wire HBM; see flagstat_wire32_sharded
     f = shard_map(fn, mesh=mesh, in_specs=(P(READS_AXIS),),
                       out_specs=P(), check_vma=False)
-    return jax.jit(f)
+    return jax.jit(f, donate_argnums=(0,) if donate else ())
 
 
 def flagstat_pallas_wire32(wire, interpret: bool = False) -> jnp.ndarray:

@@ -108,6 +108,64 @@ def _round_up(x: int, mult: int) -> int:
     return ((x + mult - 1) // mult) * mult if mult > 1 else x
 
 
+# ---------------------------------------------------------------------------
+# canonical shape buckets (the executor's ladder — parallel/executor.py)
+# ---------------------------------------------------------------------------
+
+#: default geometric ratio between consecutive row-bucket rungs; the
+#: executor's autotuner densifies to sqrt(2) when observed pad waste
+#: exceeds its target (docs/EXECUTOR.md)
+LADDER_BASE_DEFAULT = 2.0
+
+
+def row_bucket_ladder(cap_rows: int, mult: int = 1,
+                      base: float = LADDER_BASE_DEFAULT) -> tuple:
+    """Geometric ladder of canonical row buckets: ``mult``-multiples from
+    ``mult`` up to the ``mult``-rounded ``cap_rows`` (always the top rung).
+
+    Every streamed chunk pads its row count to a rung, so a whole pass —
+    and, because the ladder is shared, a whole multi-pass run — compiles
+    each kernel against at most ``len(ladder)`` row shapes.  Previously
+    each pass re-derived power-of-two buckets independently and a skewed
+    tail chunk could mint a fresh shape (= a fresh XLA compile) mid-run.
+    """
+    if base <= 1.0:
+        raise ValueError(f"ladder base must exceed 1.0, got {base}")
+    mult = max(int(mult), 1)
+    cap = max(_round_up(int(cap_rows), mult), mult)
+    rungs = []
+    r = mult
+    while r < cap:
+        rungs.append(r)
+        r = _round_up(max(int(r * base + 0.5), r + 1), mult)
+    rungs.append(cap)
+    return tuple(rungs)
+
+
+def pad_rows_for(rows: int, ladder) -> int:
+    """Smallest ladder rung holding ``rows`` (top rung for anything
+    larger — streams bound chunk rows by the cap the ladder was built
+    for, so overflow indicates a caller bug and the top rung keeps the
+    shape canonical rather than minting a new one)."""
+    for r in ladder:
+        if rows <= r:
+            return r
+    return ladder[-1]
+
+
+def len_bucket(max_len: int, base: float = LADDER_BASE_DEFAULT) -> int:
+    """Canonical length bucket: the next 128-multiple (TPU lane width),
+    rounded up its own geometric ladder (128, 256, 512, ... for the
+    default base) so a late chunk carrying a slightly longer read reuses
+    an already-compiled [N, L] shape instead of forcing a recompile of
+    every base-level kernel."""
+    units = max(-(-int(max_len) // 128), 1)
+    r = 1
+    while r < units:
+        r = max(int(r * base + 0.5), r + 1)
+    return 128 * r
+
+
 def _string_column_to_padded(col: pa.ChunkedArray, n_rows: int, pad_to: int,
                              lut: np.ndarray, pad_value: int,
                              offset: int = 0) -> tuple[np.ndarray, np.ndarray]:

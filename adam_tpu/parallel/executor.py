@@ -1,0 +1,371 @@
+"""Adaptive shape-bucketed chunk-stream executor — the streaming hot path.
+
+The per-chunk cycle (decode → pack → pad → dispatch) is the binding cost
+of every streaming command (BENCH_r05: the fused kernels finish far
+ahead of the feed).  This module is the one owner of that cycle's three
+silent killers, replacing the ad-hoc dispatch loops in
+parallel/pipeline.py:
+
+1. **Canonical shape buckets.**  Row counts pad to one geometric ladder
+   (packing.row_bucket_ladder) shared across every pass of a run, and
+   read lengths to the 128-multiple ladder (packing.len_bucket), so each
+   kernel compiles against at most ``len(ladder)`` shapes — a skewed
+   tail chunk can no longer mint a fresh shape (= a fresh XLA compile,
+   20-40 s through the tunnel's remote AOT compiler) mid-run.
+2. **Prefetching device feed** (ingest.prefetched): chunk i+1's
+   ``device_put`` runs on a feeder thread while chunk i's kernels
+   execute — double-buffered, in-flight bounded at ``prefetch_depth``
+   results, the same backpressure discipline as the pipelined ingest
+   pool and the drain-every-``sync_every`` device accumulators.
+3. **Pad-waste/recompile autotuner** (:func:`decide_plan`): at pass
+   boundaries — never mid-pass — the next pass's plan (chunk rows,
+   ladder density) is re-decided from the pad waste observed so far and
+   the evidence ledger's measured link rate (adam_tpu/evidence).  The
+   decision is a PURE function of its recorded inputs, so
+   tools/check_executor.py can replay a run's sidecar and assert the
+   decisions were deterministic.
+
+Donated input buffers ride along: on TPU backends the executor asks the
+jit'd kernels (ops/flagstat, bqsr/recalibrate) to donate their per-chunk
+inputs, so the device reuses the arriving chunk's HBM for outputs and
+scratch instead of re-allocating every chunk.  Donation stays off on the
+CPU backend, where it buys nothing and XLA warns per call.
+
+Every decision emits through :mod:`adam_tpu.obs`:
+
+* ``executor_bucket_selected`` event + ``executor_passes`` counter — one
+  per pass boundary, carrying the plan AND its inputs (replayable);
+* ``executor_recompile`` event + ``executor_shapes{pass=}`` counter —
+  first sighting of a (rows, len) shape in a pass (each sighting
+  predicts one XLA compile per kernel the pass runs);
+* ``executor_prefetch_stall_s`` event + histogram and the
+  ``executor_prefetch_inflight_peak{pass=}`` gauge — where the feed
+  waited on the host, and proof the in-flight bound held.
+
+No code path here takes a device barrier; with no ``-metrics`` sink the
+event half stays dead weight (the obs no-op contract).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import time
+from typing import Callable, Iterable, Iterator, Optional
+
+from .. import obs
+from ..packing import (LADDER_BASE_DEFAULT, len_bucket,  # noqa: F401
+                       pad_rows_for, row_bucket_ladder)
+
+#: env overrides (flags on the CLI commands mirror these)
+LADDER_BASE_ENV = "ADAM_TPU_EXECUTOR_LADDER_BASE"
+PREFETCH_ENV = "ADAM_TPU_EXECUTOR_PREFETCH"
+AUTOTUNE_ENV = "ADAM_TPU_EXECUTOR_AUTOTUNE"
+DONATE_ENV = "ADAM_TPU_EXECUTOR_DONATE"
+
+#: the autotuner densifies the ladder once observed mean pad waste
+#: crosses this fraction (sqrt(2) rungs halve the worst-case waste of
+#: the default power-of-two ladder)
+PAD_WASTE_TARGET = 0.35
+DENSE_LADDER_BASE = 2.0 ** 0.5
+
+#: floor for a caller/env-supplied ladder base: a base barely above 1.0
+#: (a plausible flag typo like 1.001) would build a ladder with millions
+#: of rungs and serialize it into every executor_bucket_selected event
+MIN_LADDER_BASE = 1.1
+
+#: a re-streamed pass's chunk transfer should fit this many seconds of
+#: the measured link (the evidence scheduler's transfer-budget
+#: discipline, applied to the product path)
+TRANSFER_BUDGET_S = 45.0
+MIN_CHUNK_ROWS = 1 << 14
+
+#: default look-ahead of the device feed (double-buffered)
+DEFAULT_PREFETCH_DEPTH = 2
+
+
+def decide_plan(*, pass_name: str, chunk_rows: int, mesh_size: int,
+                on_tpu: bool, waste_mean: Optional[float] = None,
+                link_bytes_per_sec: Optional[float] = None,
+                bytes_per_row: Optional[float] = None,
+                ladder_base: Optional[float] = None,
+                prefetch_depth: Optional[int] = None,
+                donate: Optional[bool] = None,
+                autotune: bool = True) -> dict:
+    """The autotuner: one pass's frozen execution plan.
+
+    PURE — the returned plan is a deterministic function of the keyword
+    inputs, which the ``executor_bucket_selected`` event records in full
+    (``inputs`` + ``input_digest``), so a recorded sidecar can be
+    replayed offline and the decision re-derived bit-for-bit
+    (tools/check_executor.py).  Explicit ``ladder_base`` /
+    ``prefetch_depth`` / ``donate`` pin those knobs; ``autotune=False``
+    freezes everything at the defaults.
+    """
+    inputs = dict(pass_name=pass_name, chunk_rows=int(chunk_rows),
+                  mesh_size=int(mesh_size), on_tpu=bool(on_tpu),
+                  waste_mean=None if waste_mean is None
+                  else round(float(waste_mean), 6),
+                  link_bytes_per_sec=None if not link_bytes_per_sec
+                  else round(float(link_bytes_per_sec), 1),
+                  bytes_per_row=None if bytes_per_row is None
+                  else float(bytes_per_row),
+                  ladder_base=ladder_base, prefetch_depth=prefetch_depth,
+                  donate=donate, autotune=bool(autotune))
+    # decide from the CANONICALIZED inputs (what the event records) —
+    # deciding from the raw floats would let a rounding boundary make
+    # the offline replay disagree with the recorded plan
+    waste_mean = inputs["waste_mean"]
+    link_bytes_per_sec = inputs["link_bytes_per_sec"]
+    reasons = []
+    base = max(ladder_base, MIN_LADDER_BASE) if ladder_base \
+        else LADDER_BASE_DEFAULT
+    if autotune and not ladder_base and waste_mean is not None \
+            and waste_mean > PAD_WASTE_TARGET:
+        base = DENSE_LADDER_BASE
+        reasons.append(f"pad_waste {waste_mean:.2f}>{PAD_WASTE_TARGET}"
+                       ":dense-ladder")
+    rows = int(chunk_rows)
+    if autotune and on_tpu and link_bytes_per_sec and bytes_per_row:
+        # cap the re-streamed chunk so its wire fits a bounded slice of
+        # the measured link — the round-5 lesson (a 206 MB wire on a
+        # ~1 MB/s flap stalls the whole window) applied to the product
+        cap = int(link_bytes_per_sec * TRANSFER_BUDGET_S /
+                  max(bytes_per_row, 1e-9))
+        if cap < rows:
+            rows = max(MIN_CHUNK_ROWS, cap)
+            reasons.append("link-rate-chunk-cap")
+    mult = max(int(mesh_size), 1)
+    rows = max(-(-rows // mult) * mult, mult)
+    depth = prefetch_depth if prefetch_depth is not None else \
+        (DEFAULT_PREFETCH_DEPTH if on_tpu else 0)
+    do_donate = bool(on_tpu) if donate is None else bool(donate)
+    ladder = row_bucket_ladder(rows, mult, base)
+    digest = hashlib.sha256(
+        json.dumps(inputs, sort_keys=True).encode()).hexdigest()[:16]
+    return dict(pass_name=pass_name, chunk_rows=rows,
+                ladder_base=round(float(base), 6), ladder=list(ladder),
+                prefetch_depth=int(depth), donate=do_donate,
+                reason=";".join(reasons) or "default",
+                inputs=inputs, input_digest=digest)
+
+
+def _ledger_link_rate() -> Optional[float]:
+    """The evidence ledger's latest measured host→device link rate
+    (bytes/s) — the probe writes it once per capture window; the
+    autotuner reads it instead of re-measuring on the product path.
+    Best-effort: no ledger, no rate."""
+    try:
+        from ..evidence.ledger import Ledger, default_path
+
+        probe = Ledger(default_path()).last_probe()
+        if probe:
+            v = probe.get("link_bytes_per_sec")
+            return float(v) if v else None
+    except Exception:  # noqa: BLE001 — telemetry-grade, never fatal
+        pass
+    return None
+
+
+class PassExecutor:
+    """One pass's frozen plan plus its shape/waste/stall accounting.
+
+    Handed out by :meth:`StreamExecutor.begin_pass`; the pass uses
+    :meth:`pad_rows` for every chunk, :meth:`feed` around its device
+    transfers, and the plan's ``donate`` / ``sync_every`` knobs on its
+    kernels.  ``finish()`` (or the next ``begin_pass``) emits the pass's
+    prefetch-stall rollup.
+    """
+
+    def __init__(self, parent: "StreamExecutor", plan: dict,
+                 sync_every: int):
+        import threading
+
+        self._parent = parent
+        self.plan = plan
+        self.pass_name = plan["pass_name"]
+        self.ladder = tuple(plan["ladder"])
+        self.chunk_rows = plan["chunk_rows"]
+        self.prefetch_depth = plan["prefetch_depth"]
+        self.donate = plan["donate"]
+        self.sync_every = max(int(sync_every), 1)
+        self._shapes: set = set()
+        self._lock = threading.Lock()   # pad_rows runs on pipelined
+        #                                 ingest pool workers too
+        self._stall_s = 0.0
+        self._inflight_peak = 0
+        self._chunks = 0
+        self._finished = False
+
+    # -- shape bucketing ---------------------------------------------------
+
+    def pad_rows(self, rows: int, len_b: Optional[int] = None) -> int:
+        """Canonical row bucket for a chunk (ladder rung); records pad
+        waste and first-sighting-of-a-shape telemetry."""
+        bucket = pad_rows_for(rows, self.ladder)
+        obs.pad_waste(self.pass_name, rows, bucket)
+        if bucket > 0:
+            self._parent._note_waste(self.pass_name,
+                                     (bucket - rows) / bucket)
+        self.note_shape(bucket, len_b)
+        return bucket
+
+    def note_shape(self, rows_bucket: int,
+                   len_b: Optional[int] = None) -> None:
+        """First sighting of a (rows, len) shape in this pass — the
+        event each kernel's XLA compile at that shape hangs off."""
+        key = (rows_bucket, len_b)
+        with self._lock:
+            if key in self._shapes:
+                return
+            self._shapes.add(key)
+            n = len(self._shapes)
+        obs.registry().counter("executor_shapes",
+                               **{"pass": self.pass_name}).inc()
+        obs.emit("executor_recompile", **{"pass": self.pass_name},
+                 rows=int(rows_bucket),
+                 len=None if len_b is None else int(len_b),
+                 n_shapes=n)
+
+    @property
+    def n_shapes(self) -> int:
+        return len(self._shapes)
+
+    # -- device feed -------------------------------------------------------
+
+    def feed(self, items: Iterable, put: Callable) -> Iterator:
+        """``put(item)`` (the host→device transfer) for each item in
+        input order, prefetched ``prefetch_depth`` ahead (see
+        ingest.prefetched); depth 0 — the CPU default — is the plain
+        synchronous loop.  Stall/in-flight telemetry lands on this
+        executor either way."""
+        from .ingest import prefetched
+
+        def on_chunk(stall_s: float, inflight: int) -> None:
+            self._stall_s += stall_s
+            self._chunks += 1
+            self._inflight_peak = max(self._inflight_peak, inflight)
+            r = obs.registry()
+            r.histogram("executor_prefetch_stall_s",
+                        **{"pass": self.pass_name}).observe(stall_s)
+            if inflight > self._parent._gauged.get(self.pass_name, -1):
+                self._parent._gauged[self.pass_name] = inflight
+                r.gauge("executor_prefetch_inflight_peak",
+                        **{"pass": self.pass_name}).set(inflight)
+
+        return prefetched(items, put, depth=self.prefetch_depth,
+                          on_chunk=on_chunk)
+
+    def finish(self) -> None:
+        """Emit the pass's prefetch rollup (idempotent; also run by the
+        next ``begin_pass`` so pass boundaries stay the one place
+        executor events happen)."""
+        if self._finished:
+            return
+        self._finished = True
+        if self._chunks:
+            obs.emit("executor_prefetch_stall_s",
+                     **{"pass": self.pass_name},
+                     seconds=round(self._stall_s, 6),
+                     chunks=self._chunks,
+                     inflight_peak=self._inflight_peak,
+                     depth=self.prefetch_depth)
+
+
+class StreamExecutor:
+    """One per streaming run; hands each pass a frozen plan at its
+    boundary and carries the cross-pass autotuner state (observed pad
+    waste, the ledger link rate, resolved env overrides)."""
+
+    def __init__(self, mesh, chunk_rows: int, *,
+                 on_tpu: Optional[bool] = None,
+                 autotune: Optional[bool] = None,
+                 ladder_base: Optional[float] = None,
+                 prefetch_depth: Optional[int] = None,
+                 donate: Optional[bool] = None,
+                 link_bytes_per_sec: Optional[float] = None):
+        self.mesh_size = getattr(mesh, "size", None) or int(mesh or 1)
+        self.chunk_rows = int(chunk_rows)
+        if on_tpu is None:
+            from ..platform import is_tpu_backend
+            on_tpu = is_tpu_backend()
+        self.on_tpu = bool(on_tpu)
+        env = os.environ
+        if autotune is None:
+            autotune = env.get(AUTOTUNE_ENV, "1") not in ("0", "off")
+        self.autotune = bool(autotune)
+        if ladder_base is None and env.get(LADDER_BASE_ENV):
+            try:
+                ladder_base = float(env[LADDER_BASE_ENV])
+            except ValueError:
+                ladder_base = None
+        self.ladder_base = ladder_base
+        if prefetch_depth is None and env.get(PREFETCH_ENV):
+            try:
+                prefetch_depth = int(env[PREFETCH_ENV])
+            except ValueError:
+                prefetch_depth = None
+        self.prefetch_depth = prefetch_depth
+        if donate is None and env.get(DONATE_ENV) in ("0", "off"):
+            donate = False
+        self.donate = donate
+        if link_bytes_per_sec is None and self.autotune and self.on_tpu:
+            link_bytes_per_sec = _ledger_link_rate()
+        self.link_bytes_per_sec = link_bytes_per_sec
+        import threading
+
+        self._waste: dict = {}      # pass -> [frac_sum, n]
+        self._waste_lock = threading.Lock()
+        self._gauged: dict = {}     # pass -> last inflight gauge value
+        self._current: Optional[PassExecutor] = None
+
+    # -- autotuner state ---------------------------------------------------
+
+    def _note_waste(self, pass_name: str, frac: float) -> None:
+        with self._waste_lock:
+            s = self._waste.setdefault(pass_name, [0.0, 0])
+            s[0] += frac
+            s[1] += 1
+
+    def observed_waste_mean(self) -> Optional[float]:
+        """Mean pad-waste fraction over every chunk padded so far (all
+        completed passes of THIS run) — the autotuner's densify signal."""
+        tot = sum(s[0] for s in self._waste.values())
+        n = sum(s[1] for s in self._waste.values())
+        return (tot / n) if n else None
+
+    # -- pass boundaries ---------------------------------------------------
+
+    def begin_pass(self, pass_name: str, *,
+                   bytes_per_row: Optional[float] = None,
+                   sync_every: int = 1) -> PassExecutor:
+        """Freeze the plan for one pass (the ONLY place decisions are
+        made — never mid-pass) and emit it through obs."""
+        if self._current is not None:
+            self._current.finish()
+        plan = decide_plan(
+            pass_name=pass_name, chunk_rows=self.chunk_rows,
+            mesh_size=self.mesh_size, on_tpu=self.on_tpu,
+            waste_mean=self.observed_waste_mean(),
+            link_bytes_per_sec=self.link_bytes_per_sec,
+            bytes_per_row=bytes_per_row, ladder_base=self.ladder_base,
+            prefetch_depth=self.prefetch_depth, donate=self.donate,
+            autotune=self.autotune)
+        obs.registry().counter("executor_passes",
+                               **{"pass": pass_name}).inc()
+        obs.emit("executor_bucket_selected", **{"pass": pass_name},
+                 chunk_rows=plan["chunk_rows"],
+                 ladder=plan["ladder"], ladder_base=plan["ladder_base"],
+                 prefetch_depth=plan["prefetch_depth"],
+                 donate=plan["donate"], reason=plan["reason"],
+                 inputs=plan["inputs"],
+                 input_digest=plan["input_digest"])
+        pex = PassExecutor(self, plan, sync_every)
+        self._current = pex
+        return pex
+
+    def finish(self) -> None:
+        if self._current is not None:
+            self._current.finish()
+            self._current = None

@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import queue
 import threading
+import time
 from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Callable, Iterable, Iterator, Optional
 
@@ -110,3 +111,88 @@ def _passthrough(item, _ctx):
 
 def _no_prepare(_item):
     return None
+
+
+def prefetched(items: Iterable, put: Callable, depth: int = 2,
+               on_chunk: Optional[Callable] = None) -> Iterator[Any]:
+    """Bounded look-ahead device feed: yield ``put(item)`` in input order
+    while a feeder thread runs ``put`` up to ``depth`` items AHEAD of the
+    consumer.
+
+    ``put`` is the host→device transfer (``jax.device_put`` of a padded
+    wire / packed batch): running it ahead means chunk i+1's transfer
+    overlaps chunk i's device compute — the double-buffer the streaming
+    executor (parallel/executor.py) feeds the jit'd kernels with.  The
+    in-flight queue is structurally bounded at ``depth`` results (plus
+    the one the feeder is computing), the same backpressure discipline as
+    :func:`pipelined`, so device HBM held by prefetched inputs is capped
+    regardless of how far the host outruns the device.
+
+    ``on_chunk(stall_seconds, inflight)`` (optional) is called on the
+    CONSUMER thread once per yielded item with the time the consumer
+    spent blocked waiting for it and the queue depth observed at that
+    moment — the telemetry hook behind ``executor_prefetch_stall_s``.
+    Host-side timing only: nothing here takes a device barrier.
+
+    ``depth <= 0`` degrades to the plain synchronous loop (no threads),
+    the default off-accelerator path.
+    """
+    if depth <= 0:
+        for item in items:
+            t0 = time.perf_counter()
+            got = put(item)
+            if on_chunk is not None:
+                on_chunk(time.perf_counter() - t0, 0)
+            yield got
+        return
+
+    out: "queue.Queue" = queue.Queue(maxsize=depth)
+    stop = threading.Event()
+
+    def send(x) -> bool:
+        while not stop.is_set():
+            try:
+                out.put(x, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def feeder():
+        try:
+            for item in items:
+                if stop.is_set():
+                    return
+                if not send((None, put(item))):
+                    return
+            send(_DONE)
+        except BaseException as e:  # noqa: BLE001 — surface on consumer
+            send((e, None))
+
+    t = threading.Thread(target=feeder, daemon=True, name="device-feed")
+    t.start()
+    try:
+        while True:
+            t0 = time.perf_counter()
+            got = out.get()
+            stall = time.perf_counter() - t0
+            if got is _DONE:
+                break
+            err, value = got
+            if err is not None:
+                raise err
+            if on_chunk is not None:
+                # qsize() AFTER the get: results queued ahead of the
+                # consumer at pickup — structurally bounded at ``depth``
+                # (the queue's maxsize), which is the bound the
+                # executor's inflight-peak gauge publishes
+                on_chunk(stall, out.qsize())
+            yield value
+    finally:
+        stop.set()
+        while t.is_alive():
+            try:
+                out.get_nowait()
+            except queue.Empty:
+                pass
+            t.join(timeout=0.05)

@@ -28,10 +28,6 @@ from ..packing import column_int64
 from .mesh import make_mesh, reads_sharding
 
 
-def _pad_to(n: int, mult: int) -> int:
-    return ((n + mult - 1) // mult) * mult if mult > 1 else n
-
-
 def _wire32_from_table(table: pa.Table) -> np.ndarray:
     """Chunk table -> the 4-byte flagstat projection word."""
     from ..ops.flagstat import pack_flagstat_wire32
@@ -54,7 +50,8 @@ def _wire32_from_table(table: pa.Table) -> np.ndarray:
 
 
 def streaming_flagstat(path: str, *, mesh=None, chunk_rows: int = 1 << 22,
-                       io_threads: int = 1, io_procs: int = 1
+                       io_threads: int = 1, io_procs: int = 1,
+                       executor_opts: Optional[dict] = None
                        ) -> Tuple["FlagStatMetrics", "FlagStatMetrics"]:
     """Chunked, mesh-sharded flagstat over any reads input.
 
@@ -62,12 +59,21 @@ def streaming_flagstat(path: str, *, mesh=None, chunk_rows: int = 1 << 22,
     shards over the mesh, and the 18x2 counter block psums over ICI; blocks
     accumulate across chunks on host (the counters form a monoid, like the
     reference's FlagStatMetrics aggregate).
+
+    The chunk cycle runs under the shape-bucketed executor
+    (parallel/executor.py): wires pad to the canonical row ladder (one
+    compiled shape set for the whole run), the device feed prefetches
+    chunk i+1's ``device_put`` behind chunk i's count on accelerators,
+    and the kernel donates each chunk's wire buffer there.
+    ``executor_opts`` forwards StreamExecutor knobs (prefetch_depth,
+    ladder_base, autotune, donate).
     """
     import jax
 
     from ..io.dispatch import FLAGSTAT_COLUMNS
     from ..io.stream import open_read_stream
     from ..ops.flagstat import (FlagStatMetrics, flagstat_wire32_sharded)
+    from .executor import StreamExecutor
 
     if mesh is None:
         mesh = make_mesh()
@@ -77,23 +83,24 @@ def streaming_flagstat(path: str, *, mesh=None, chunk_rows: int = 1 << 22,
     from ..platform import is_tpu_backend
     impl = os.environ.get("ADAM_TPU_FLAGSTAT_IMPL", "auto")
     on_tpu = is_tpu_backend()
+    ex = StreamExecutor(mesh, chunk_rows, on_tpu=on_tpu,
+                        **(executor_opts or {}))
+    # sync_every: counters accumulate ON DEVICE between drains — a
+    # per-chunk np.asarray would serialize host decode/pack against
+    # device compute (and pay a full link round trip per chunk); the
+    # periodic int64 fold both bounds the in-flight queue and keeps the
+    # int32 accumulation window small regardless of file size.
+    pex = ex.begin_pass("flagstat", bytes_per_row=4.0,
+                        sync_every=8 if on_tpu else 1)
     if impl == "pallas" or (impl == "auto" and on_tpu):
         from ..ops.flagstat_pallas import flagstat_wire32_sharded_pallas
         kernel = flagstat_wire32_sharded_pallas(mesh,
-                                                interpret=not on_tpu)
+                                                interpret=not on_tpu,
+                                                donate=pex.donate)
     else:
-        kernel = flagstat_wire32_sharded(mesh)
+        kernel = flagstat_wire32_sharded(mesh, donate=pex.donate)
     sharding = reads_sharding(mesh)
 
-    # Counters accumulate ON DEVICE between drains: a per-chunk np.asarray
-    # would serialize host decode/pack against device compute (and pay a
-    # full link round trip per chunk); async dispatch lets the host stream
-    # chunk i+1 while the device counts chunk i.  Every SYNC_EVERY chunks
-    # the int32 device block folds into a host int64 total — np.asarray is
-    # a REAL round trip (the tunnel backend's block_until_ready is a
-    # no-op), which both bounds the in-flight queue and keeps the device
-    # accumulation window far inside int32 range regardless of file size.
-    SYNC_EVERY = 8 if on_tpu else 1
     totals = np.zeros((18, 2), np.int64)
     totals_dev = None
     n_chunks = 0
@@ -104,11 +111,12 @@ def streaming_flagstat(path: str, *, mesh=None, chunk_rows: int = 1 << 22,
     if path.endswith(".bam") and \
             os.environ.get("ADAM_TPU_FLAGSTAT_DECODE", "auto") != "arrow":
         from ..io.fastbam import open_bam_wire32_stream
-        wire_chunks = open_bam_wire32_stream(path, chunk_rows=chunk_rows,
+        wire_chunks = open_bam_wire32_stream(path,
+                                             chunk_rows=pex.chunk_rows,
                                              io_procs=io_procs)
     if wire_chunks is None:
         stream = open_read_stream(path, columns=FLAGSTAT_COLUMNS,
-                                  chunk_rows=chunk_rows,
+                                  chunk_rows=pex.chunk_rows,
                                   io_procs=io_procs)
         wire_chunks = (_wire32_from_table(t) for t in stream)
     if io_threads > 1:
@@ -120,25 +128,33 @@ def streaming_flagstat(path: str, *, mesh=None, chunk_rows: int = 1 << 22,
     import time as _time
     t_start = _time.perf_counter()
     n_reads = 0
-    for wire in wire_chunks:
-        t_chunk = _time.perf_counter()
+
+    def _pad_put(wire):
+        # pad to the canonical rung (padding words carry valid=0), then
+        # start the host→device transfer — under the prefetching feed
+        # this runs up to prefetch_depth chunks ahead of the dispatch
         rows = len(wire)
-        n_pad = _pad_to(rows, mesh.size)
-        if n_pad != rows:  # padding words carry valid=0
+        n_pad = pex.pad_rows(rows)
+        if n_pad != rows:
             wire = np.concatenate(
                 [wire, np.zeros(n_pad - rows, np.uint32)])
-        counts = kernel(jax.device_put(wire, sharding))
+        return rows, jax.device_put(wire, sharding)
+
+    for rows, wire_dev in pex.feed(wire_chunks, _pad_put):
+        t_chunk = _time.perf_counter()
+        counts = kernel(wire_dev)
+        del wire_dev            # donated on TPU: consumed by the kernel
         totals_dev = counts if totals_dev is None else totals_dev + counts
         n_chunks += 1
         n_reads += rows
-        if n_chunks % SYNC_EVERY == 0:
+        if n_chunks % pex.sync_every == 0:
             totals += np.asarray(totals_dev).astype(np.int64)
             totals_dev = None
         obs.chunk_processed("flagstat", rows, bytes_in=4 * rows,
                             seconds=_time.perf_counter() - t_chunk)
-        obs.pad_waste("flagstat", rows, n_pad)
     if totals_dev is not None:
         totals += np.asarray(totals_dev).astype(np.int64)
+    ex.finish()
     # same end-of-run rollup as transform (rows_total / reads_per_sec /
     # bytes_in + the run_totals event), so -metrics consumers see one
     # schema across commands
@@ -350,7 +366,11 @@ class _MarkdupKeys:
         from ..packing import hash_strings_128
 
         n = table.num_rows
-        sharded = batch.device_put(reads_sharding(self.mesh))
+        # the executor's device feed may hand the batch in already
+        # sharded (its transfer then overlapped the previous chunk's
+        # key kernel); host batches take the put here as before
+        sharded = batch if not isinstance(batch.flags, np.ndarray) \
+            else batch.device_put(reads_sharding(self.mesh))
         fp, score = _device_fiveprime_and_score(
             sharded.flags, sharded.start, sharded.cigar_ops,
             sharded.cigar_lens, sharded.n_cigar, sharded.quals)
@@ -382,26 +402,44 @@ class _MarkdupKeys:
 _REALIGN_HALO = 3000 + 1024
 
 
-def _packed_chunks(chunk_iter, pass_name: str, io_threads: int,
-                   pack_reads, pad_bucket, bucket_len: int, timed_chunks,
+def _packed_chunks(chunk_iter, pex, io_threads: int,
+                   pack_reads, bucket_len: int, timed_chunks,
                    want_pack: bool = True):
     """(table, batch) pairs for passes with a FIXED length bucket —
     sequential (decode/pack stages timed apart) or overlapped via
-    parallel.ingest.pipelined (stall time lands in ``<pass>-ingest-wait``)."""
+    parallel.ingest.pipelined (stall time lands in ``<pass>-ingest-wait``).
+    Row padding comes from the pass executor's canonical ladder
+    (``pex.pad_rows``), which also owns the pad-waste/recompile
+    telemetry.
+
+    ``timed_chunks=None`` yields UNSTAGED pairs: when the executor's
+    device feed is active its feeder thread drives this generator, and
+    ``instrument.stage``'s report stack is shared (not thread-local) —
+    interleaved stages from two threads would mis-nest the timing tree.
+    The caller then attributes its stall consumer-side as
+    ``<pass>-feed-wait`` (the ``-ingest-wait`` discipline)."""
     from ..instrument import stage
+
+    pass_name = pex.pass_name
 
     def work(table, _ctx):
         if not want_pack:
             return table, None
-        padded = pad_bucket(table.num_rows)
-        obs.pad_waste(pass_name, table.num_rows, padded)
+        padded = pex.pad_rows(table.num_rows, bucket_len)
         return table, pack_reads(
             table, pad_rows_to=padded, bucket_len=bucket_len)
 
     if io_threads > 1:
         from .ingest import pipelined
-        yield from timed_chunks(pipelined(chunk_iter, work, io_threads),
-                                f"{pass_name}-ingest-wait")
+        piped = pipelined(chunk_iter, work, io_threads)
+        if timed_chunks is None:
+            yield from piped
+        else:
+            yield from timed_chunks(piped, f"{pass_name}-ingest-wait")
+        return
+    if timed_chunks is None:
+        for table in chunk_iter:
+            yield work(table, None)
         return
     for table in timed_chunks(chunk_iter, f"{pass_name}-decode"):
         if not want_pack:
@@ -413,6 +451,66 @@ def _packed_chunks(chunk_iter, pass_name: str, io_threads: int,
         # pack timer running across the consumer's whole chunk body and
         # nest its stages under pack (observed in the first e2e rerun)
         yield out
+
+
+def _project_batch(batch, keep: tuple):
+    """None out columns a pass's kernels never touch before the device
+    feed ships the batch — the projection-to-the-bit discipline applied
+    to the prefetch wire (p1's markdup keys never read bases; shipping
+    them would double the transfer)."""
+    from dataclasses import fields as _dc_fields, replace as _dc_replace
+
+    drop = {f.name: None for f in _dc_fields(batch)
+            if f.name not in keep and getattr(batch, f.name) is not None}
+    return _dc_replace(batch, **drop) if drop else batch
+
+
+#: device-feed projections: the columns each pass's device kernels read
+_P1_DEV_COLS = ("flags", "start", "cigar_ops", "cigar_lens", "n_cigar",
+                "quals")
+_P2_DEV_COLS = ("flags", "start", "read_group", "read_len", "bases",
+                "quals", "cigar_ops", "cigar_lens")
+_P3_DEV_COLS = ("flags", "read_group", "read_len", "bases", "quals")
+
+
+def _feed_packed(chunk_iter, pex, io_threads: int, pack_reads,
+                 bucket_len: int, timed_chunks, mesh, dev_cols: tuple,
+                 want_pack: bool = True):
+    """``_packed_chunks`` composed with the executor's device feed:
+    yields (table, host_batch, device_batch_or_None) triples.
+
+    The feed pre-transfers the batch (projected to ``dev_cols``) only
+    when the downstream kernel can consume whole columns: the sharded
+    mesh path, or an unsharded chunk small enough for the monolithic
+    (non-slab) walk — the slab walk slices rows, and slicing device
+    arrays would dispatch a compiled slice per offset (fresh shapes, the
+    churn the executor exists to kill).
+
+    When the feed is active (prefetch_depth > 0) the producer runs
+    UNSTAGED on the feeder thread — instrument's stage stack is shared,
+    not thread-local — and the consumer's stall is attributed as
+    ``<pass>-feed-wait`` (the ``-ingest-wait`` discipline)."""
+    from ..bqsr.recalibrate import _count_slab_rows
+
+    active = pex.prefetch_depth > 0
+    base = _packed_chunks(chunk_iter, pex, io_threads, pack_reads,
+                          bucket_len, None if active else timed_chunks,
+                          want_pack=want_pack)
+    sharding = reads_sharding(mesh)
+    slab = _count_slab_rows()
+
+    def put(item):
+        table, batch = item
+        dev = None
+        if batch is not None and batch.n_reads % mesh.size == 0 and \
+                (mesh.size > 1 or batch.n_reads <= slab):
+            dev = _project_batch(batch, dev_cols).device_put(sharding)
+        return table, batch, dev
+
+    fed = pex.feed(base, put)
+    if active:
+        fed = timed_chunks(fed, f"{pex.pass_name}-feed-wait")
+    return fed
 
 
 def streaming_transform(input_path: str, output_path: str, *,
@@ -429,7 +527,8 @@ def streaming_transform(input_path: str, output_path: str, *,
                         row_group_bytes: Optional[int] = None,
                         resume: bool = False,
                         io_threads: int = 1,
-                        io_procs: int = 1) -> int:
+                        io_procs: int = 1,
+                        executor_opts: Optional[dict] = None) -> int:
     """The ``transform`` pipeline over a chunked stream and a device mesh.
 
     Multi-pass, like the reference's shuffle stages (Transform.scala:62-97):
@@ -475,6 +574,18 @@ def streaming_transform(input_path: str, output_path: str, *,
     bit-identical to the sequential walk (differential-tested); only the
     stage report changes shape (decode+pack collapse into
     ``pN-ingest-wait``, the consumer's stall time).
+
+    Chunk shapes, device transfers, and buffer donation are owned by the
+    shape-bucketed executor (parallel/executor.py): row counts pad to
+    one canonical ladder across all passes (each kernel compiles at most
+    ``len(ladder)`` shapes for the run), the device feed prefetches the
+    next chunk's transfer behind the current chunk's kernels on
+    accelerators, and the autotuner re-decides the chunk size / ladder
+    density at pass boundaries from observed pad waste and the evidence
+    ledger's link rate.  Padding rows carry ``valid=False`` and every
+    kernel ignores them, so bucket geometry never changes results.
+    ``executor_opts`` forwards StreamExecutor knobs (prefetch_depth,
+    ladder_base, autotune, donate).
     """
     from ..bqsr.recalibrate import apply_table, compute_table
     from ..bqsr.table import RecalTable
@@ -510,22 +621,15 @@ def streaming_transform(input_path: str, output_path: str, *,
                                 bytes_in=table.nbytes)
             yield item
 
-    def pad_bucket(rows: int) -> int:
-        """Row-count bucket for packing: next power of two (x mesh), so a
-        partial tail chunk reuses a previously compiled kernel shape
-        instead of forcing a full recompilation of every device kernel —
-        shape churn cost more than pass 2's actual compute in the first
-        end-to-end profile.  Capped at chunk_rows (mesh-rounded): full
-        chunks all share one shape already, so only the tail buckets —
-        a non-power-of-two chunk_rows must not inflate every chunk."""
-        b = 1 << max(rows - 1, 1).bit_length()
-        cap = max(-(-chunk_rows // mesh.size) * mesh.size, mesh.size)
-        return min(-(-b // mesh.size) * mesh.size, cap)
-
     import time as _time
     t_start = _time.perf_counter()
     if mesh is None:
         mesh = make_mesh()
+    # shape buckets / device feed / autotuner for every pass's chunk
+    # cycle — replaces the per-pass pad_bucket closures (whose power-of-
+    # two buckets each pass re-derived independently)
+    from .executor import StreamExecutor
+    ex = StreamExecutor(mesh, chunk_rows, **(executor_opts or {}))
     own_workdir = workdir is None
     if own_workdir:
         workdir = tempfile.mkdtemp(prefix="adam_tpu_transform_")
@@ -567,8 +671,9 @@ def streaming_transform(input_path: str, output_path: str, *,
             p1_skipped = False
         if ck is not None and not p1_skipped:
             ck.clean_unless("p1", "raw", "dup.npy")
+        pex1 = ex.begin_pass("p1")
         stream = [] if p1_skipped else \
-            open_read_stream(input_path, chunk_rows=chunk_rows,
+            open_read_stream(input_path, chunk_rows=pex1.chunk_rows,
                              io_procs=io_procs)
         keys = _MarkdupKeys(mesh) if (markdup and not p1_skipped) else None
         seq_seen: dict = {}
@@ -580,37 +685,51 @@ def streaming_transform(input_path: str, output_path: str, *,
             bucket_len = 0
         import pyarrow.compute as pc
 
+        from ..packing import len_bucket
+
         def grow_bucket(table):
             # grow the length bucket BEFORE packing — a later chunk may
             # hold a longer read than anything seen so far.  Runs in
             # strict chunk order (main thread, or the pipelined reader's
             # prepare hook), so chunk i's pack sees max(len) over <= i
-            # exactly like the sequential walk.
+            # exactly like the sequential walk.  Buckets come from the
+            # canonical 128-multiple ladder (packing.len_bucket), so a
+            # marginally longer late read reuses a compiled [N, L] shape.
             nonlocal bucket_len
             chunk_max = pc.max(pc.binary_length(
                 table.column("sequence"))).as_py() or 1
-            bucket_len = max(bucket_len,
-                             ((chunk_max + 127) // 128) * 128)
+            bucket_len = max(bucket_len, len_bucket(chunk_max))
             return bucket_len
 
         def p1_pack(table, blen):
             if keys is None:
                 return table, None
-            padded = pad_bucket(table.num_rows)
-            obs.pad_waste("p1", table.num_rows, padded)
+            padded = pex1.pad_rows(table.num_rows, blen)
             return table, pack_reads(
                 table, pad_rows_to=padded, bucket_len=blen)
 
         track_len = keys is not None or bqsr
+        use_p1_feed = keys is not None and pex1.prefetch_depth > 0
         if io_threads > 1 and not p1_skipped:
             # no pack / no length tracking still overlaps: the reader
             # thread performs the format decode (fn degrades to pack-less
             # passthrough, prepare to a no-op)
             from .ingest import pipelined
-            p1_iter = timed_chunks(
-                pipelined(stream, p1_pack, io_threads,
-                          prepare=grow_bucket if track_len else None),
-                "p1-ingest-wait")
+            p1_base = pipelined(stream, p1_pack, io_threads,
+                                prepare=grow_bucket if track_len else None)
+            p1_iter = p1_base if use_p1_feed else \
+                timed_chunks(p1_base, "p1-ingest-wait")
+        elif use_p1_feed:
+            # the device feed's feeder thread will drive this generator;
+            # instrument's stage stack is shared across threads, so the
+            # producer runs UNSTAGED and the consumer attributes its
+            # stall as p1-feed-wait below (the -ingest-wait discipline)
+            def p1_plain():
+                for table in stream:
+                    if track_len:
+                        grow_bucket(table)
+                    yield p1_pack(table, bucket_len)
+            p1_iter = p1_plain()
         else:
             def p1_sync():
                 for table in timed_chunks(stream, "p1-decode"):
@@ -622,6 +741,22 @@ def streaming_transform(input_path: str, output_path: str, *,
                             _, batch = p1_pack(table, bucket_len)
                     yield table, batch
             p1_iter = p1_sync()
+        if use_p1_feed:
+            # device feed: the markdup-key batch ships (projected to the
+            # columns the key kernel reads) up to prefetch_depth chunks
+            # ahead of the kernel dispatch; add_chunk detects the
+            # pre-sharded batch and skips its own put
+            p1_sharding = reads_sharding(mesh)
+
+            def _p1_put(item):
+                table, batch = item
+                if batch is not None and \
+                        batch.n_reads % mesh.size == 0:
+                    batch = _project_batch(batch, _P1_DEV_COLS) \
+                        .device_put(p1_sharding)
+                return table, batch
+            p1_iter = timed_chunks(pex1.feed(p1_iter, _p1_put),
+                                   "p1-feed-wait")
         for table, batch in p1_iter:
             total_rows += table.num_rows
             max_rgid = max(max_rgid,
@@ -649,9 +784,13 @@ def streaming_transform(input_path: str, output_path: str, *,
                         seq_records=[[r.id, r.name, r.length, r.url]
                                      for r in seq_dict])
 
-        def reread():
+        def reread(rows=chunk_rows):
+            # a re-streamed pass may use its own (autotuned) chunk size:
+            # dup-bit offsets track rows, and every per-chunk consumer is
+            # an exact monoid or per-row map, so re-chunking never
+            # changes results (differential-pinned)
             offset = 0
-            for table in iter_tables(raw_path, chunk_rows=chunk_rows):
+            for table in iter_tables(raw_path, chunk_rows=rows):
                 if dup is not None:
                     table = _apply_dup_bits(
                         table, dup[offset:offset + table.num_rows])
@@ -688,18 +827,23 @@ def streaming_transform(input_path: str, output_path: str, *,
             # few chunks (a whole-pass int32 sum would wrap on WGS-scale
             # inputs).  On the CPU backend overlap buys nothing — sync
             # every chunk keeps the stage report attribution exact.
-            sync_every = 4 if is_tpu_backend() else 1
+            pex2 = ex.begin_pass(
+                "p2", bytes_per_row=2.0 * max(bucket_len, 1) + 64.0,
+                sync_every=4 if is_tpu_backend() else 1)
             host_acc = None
             acc = None
             n_counted = 0
-            for table, batch in _packed_chunks(
-                    reread(), "p2", io_threads, pack_reads, pad_bucket,
-                    bucket_len, timed_chunks):
-                will_sync = (n_counted + 1) % sync_every == 0
+            p2_iter = _feed_packed(reread(pex2.chunk_rows), pex2,
+                                   io_threads, pack_reads, bucket_len,
+                                   timed_chunks, mesh, _P2_DEV_COLS)
+            for table, batch, dev_batch in p2_iter:
+                will_sync = (n_counted + 1) % pex2.sync_every == 0
                 with stage("p2-bqsr-count", sync=will_sync):
                     out = count_tables_device(table, batch, snp_table,
                                               n_read_groups=n_rg_run,
-                                              mesh=mesh)
+                                              mesh=mesh,
+                                              device_batch=dev_batch,
+                                              donate=pex2.donate)
                     acc = out if acc is None else tuple(
                         a + b for a, b in zip(acc, out))
                     n_counted += 1
@@ -770,13 +914,18 @@ def streaming_transform(input_path: str, output_path: str, *,
                     os.unlink(os.path.join(output_path, f))
         out = DatasetWriter(output_path, part_rows=out_part_rows,
                             row_group_bytes=row_group_bytes, **wopts)
-        for table, batch in _packed_chunks(
-                [] if p3_skipped else reread(), "p3", io_threads,
-                pack_reads, pad_bucket, bucket_len, timed_chunks,
-                want_pack=bqsr):
+        pex3 = ex.begin_pass(
+            "p3", bytes_per_row=2.0 * max(bucket_len, 1) + 64.0)
+        p3_iter = _feed_packed([] if p3_skipped else
+                               reread(pex3.chunk_rows), pex3, io_threads,
+                               pack_reads, bucket_len, timed_chunks,
+                               mesh, _P3_DEV_COLS, want_pack=bqsr)
+        for table, batch, dev_batch in p3_iter:
             if bqsr:
                 with stage("p3-bqsr-apply", sync=True):
-                    table = apply_table(rt, table, batch, mesh=mesh)
+                    table = apply_table(rt, table, batch, mesh=mesh,
+                                        device_batch=dev_batch,
+                                        donate=pex3.donate)
             if not binned:
                 with stage("p3-write"):
                     out.write(table)
@@ -820,6 +969,7 @@ def streaming_transform(input_path: str, output_path: str, *,
         out.close()
         if ck is not None:
             ck.mark("done", total_rows=total_rows)
+        ex.finish()
         obs.run_totals("transform", total_rows,
                        _time.perf_counter() - t_start,
                        input_path=input_path, output_path=output_path)

@@ -79,6 +79,17 @@ def enable_compilation_cache(path: str | None = None) -> None:
     host detector's list) and genuinely risks SIGILL when one cache dir
     crosses heterogeneous machines (shared home dirs).  The compile
     the cache saves most is the tunnel's remote AOT anyway.
+
+    The default-on decision gates on the ACTUAL initialized backend, not
+    on platform-config string absence: a CPU-only jax install with no
+    ``JAX_PLATFORMS`` set used to pass the old "not forced to cpu" check
+    and enable the persistent cache anyway (round-5 advisor).  An
+    explicitly forced CPU platform still short-circuits here; otherwise
+    the config update is DEFERRED to the first backend-compile event —
+    the backend is initialized by then, so ``jax.default_backend()`` is
+    a free read, never an init trigger.  Cost of the deferral: the very
+    first compile of a run misses the cache config (it would have been
+    the cache's own first miss on a cold cache anyway).
     """
     install_compile_metrics()   # count hits/misses/compile-seconds even
     #                             when the cache itself ends up disabled
@@ -91,12 +102,9 @@ def enable_compilation_cache(path: str | None = None) -> None:
         elif os.environ.get("JAX_COMPILATION_CACHE_DIR"):
             return
         else:
-            # intended-platform check WITHOUT touching the backend:
-            # jax.default_backend() here would initialize it before the
-            # caller's platform forcing could apply (and would dial a
-            # dead tunnel on axon boxes just to decide about a cache).
-            # jax.config.jax_platforms reflects force_cpu /
-            # honor_platform_env; the env var covers the pre-config case.
+            # fast veto WITHOUT touching the backend (deciding about a
+            # cache must never dial a dead tunnel): an explicitly forced
+            # CPU platform needs no deferral machinery at all
             try:
                 import jax
 
@@ -106,8 +114,13 @@ def enable_compilation_cache(path: str | None = None) -> None:
                 plat = os.environ.get("JAX_PLATFORMS", "")
             if (plat or "").split(",")[0].strip() == "cpu":
                 return
-            path = os.path.join(os.path.expanduser("~"), ".cache",
-                                "adam_tpu", "xla")
+            _defer_default_cache(os.path.join(
+                os.path.expanduser("~"), ".cache", "adam_tpu", "xla"))
+            return
+    _apply_cache_config(path)
+
+
+def _apply_cache_config(path: str) -> None:
     try:
         os.makedirs(path, exist_ok=True)
         import jax
@@ -119,6 +132,55 @@ def enable_compilation_cache(path: str | None = None) -> None:
                           0.1)
     except Exception:  # noqa: BLE001 — never fail a run over a cache
         pass
+
+
+#: the deferred default-cache path (at most one pending decision) — a
+#: list so tests can reset it without reaching into closures
+_PENDING_DEFAULT_CACHE: list = []
+_DEFER_LISTENER_INSTALLED = False
+
+
+def _defer_default_cache(path: str) -> None:
+    """Arm the deferred default-on decision: on the first backend
+    compile, check the now-initialized backend and enable the cache for
+    non-CPU backends only.  jax.monitoring listeners cannot be
+    unregistered, so the callback consults the pending list and becomes
+    a no-op once the decision is made."""
+    global _DEFER_LISTENER_INSTALLED
+    _PENDING_DEFAULT_CACHE[:] = [path]
+    if _DEFER_LISTENER_INSTALLED:
+        return
+    try:
+        from jax import monitoring
+
+        def on_duration(event: str, duration: float, **kw) -> None:
+            if event.endswith("backend_compile_duration"):
+                apply_pending_default_cache()
+
+        monitoring.register_event_duration_secs_listener(on_duration)
+        _DEFER_LISTENER_INSTALLED = True
+    except Exception:  # noqa: BLE001 — cache is an optimization only
+        _PENDING_DEFAULT_CACHE.clear()
+
+
+def apply_pending_default_cache() -> None:
+    """Resolve a deferred default-cache decision against the initialized
+    backend (called from the compile listener; safe to call directly —
+    e.g. after an explicit backend init — and idempotent)."""
+    try:
+        # two threads can finish their first compiles concurrently; the
+        # loser of the pop must no-op, not raise out of jax's listener
+        path = _PENDING_DEFAULT_CACHE.pop()
+    except IndexError:
+        return
+    try:
+        import jax
+
+        if jax.default_backend() == "cpu":
+            return          # CPU-only install: never default-enable
+    except Exception:  # noqa: BLE001
+        return
+    _apply_cache_config(path)
 
 
 def axis_size(axis_name):

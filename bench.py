@@ -119,6 +119,33 @@ def _emit(stage: str, payload: dict) -> None:
     print(json.dumps({"stage": stage} | payload), flush=True)
 
 
+def _executor_plan_fields(pass_name: str, is_tpu: bool,
+                          bytes_per_row: float,
+                          chunk_rows: int = 1 << 20) -> dict:
+    """The streaming-executor plan the PRODUCT would freeze on this
+    backend (parallel/executor.decide_plan over the evidence ledger's
+    link rate) — stamped into the stage payload so every BENCH artifact
+    records the shape-ladder / prefetch / donation configuration the
+    pipeline actually runs with, not just the kernel rate."""
+    try:
+        from adam_tpu.parallel.executor import (_ledger_link_rate,
+                                                decide_plan)
+
+        plan = decide_plan(
+            pass_name=pass_name, chunk_rows=chunk_rows, mesh_size=1,
+            on_tpu=is_tpu,
+            link_bytes_per_sec=_ledger_link_rate() if is_tpu else None,
+            bytes_per_row=bytes_per_row)
+        return {"executor_chunk_rows": plan["chunk_rows"],
+                "executor_ladder_len": len(plan["ladder"]),
+                "executor_ladder_base": plan["ladder_base"],
+                "executor_prefetch_depth": plan["prefetch_depth"],
+                "executor_donate": plan["donate"],
+                "executor_reason": plan["reason"]}
+    except Exception:  # noqa: BLE001 — reporting only, never the stage
+        return {}
+
+
 # -- timing discipline over the tunnel --------------------------------------
 # `jax.block_until_ready` does NOT synchronize on the axon tunnel backend
 # (measured: an 8-iter 4096^3 bf16 matmul loop "finishes" at 8x the chip's
@@ -423,6 +450,9 @@ def _stage_flagstat(kind: str, is_tpu: bool):
             round(100 * best * FLAGSTAT_FLOPS_PER_READ / peak_fl, 4),
         "link_gbytes_per_sec":
             round(incl * FLAGSTAT_BYTES_PER_READ / 1e9, 3),
+        **_executor_plan_fields("flagstat", is_tpu,
+                                FLAGSTAT_BYTES_PER_READ,
+                                chunk_rows=1 << 22),
     }
     if incl_stats:
         payload["n_runs"] = incl_stats["n_runs"]
@@ -615,6 +645,8 @@ def _stage_transform(kind: str, is_tpu: bool):
         "mfu": round(device_rate * fpr / peak_fl, 6),
         "mfu_note": "analytic flops vs peak bf16; kernels are int/"
                     "elementwise so pct_peak_hbm is the binding roofline",
+        **_executor_plan_fields("p2", is_tpu,
+                                _transform_bytes_per_read(L, C)),
         **({"transform_n_runs": tr_stats["n_runs"],
             "transform_fused_device_reads_per_sec_min":
                 tr_stats["runs_min"],

@@ -112,6 +112,12 @@ def orchestrate(want: list[str],
                 link_env = dict(scale_env(got["probe"]) or {})
             except Exception:  # noqa: BLE001 — sizing is best-effort
                 link_env = {}
+            if link_env:
+                # stage reporting: the artifact (and the ledger) should
+                # show HOW this window's wires were shrunk, not leave
+                # readers to re-derive it from the link rate
+                got["probe"] = {**got["probe"],
+                                "scaled_env": dict(link_env)}
         if got.get("probe", {}).get("platform") not in (None, "tpu"):
             # a fast tunnel failure silently falls back to the CPU
             # backend INSIDE the worker; those numbers are fallback

@@ -1,0 +1,401 @@
+"""The adaptive shape-bucketed executor (parallel/executor.py): ladder
+canonicality, the recompile bound over skewed streams, pad-waste limits,
+the prefetch feed's ordering/bound/bit-identity, autotuner determinism
+(including the offline replay via tools/check_executor.py), and the
+no-device-barrier property with ``-metrics`` off."""
+
+from __future__ import annotations
+
+import importlib.util
+import json
+import os
+import pathlib
+import time
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from adam_tpu import obs
+from adam_tpu.packing import (len_bucket, pad_rows_for,
+                              row_bucket_ladder)
+from adam_tpu.parallel.executor import (PAD_WASTE_TARGET,
+                                        DENSE_LADDER_BASE,
+                                        StreamExecutor, decide_plan)
+from adam_tpu.parallel.ingest import prefetched
+from adam_tpu.parallel.mesh import make_mesh
+
+TOOLS = pathlib.Path(__file__).parent.parent / "tools"
+
+
+def _load_tool(name: str):
+    spec = importlib.util.spec_from_file_location(name,
+                                                  TOOLS / f"{name}.py")
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+# ---------------------------------------------------------------------------
+# ladder
+# ---------------------------------------------------------------------------
+
+class TestLadder:
+    def test_rungs_are_mesh_multiples_and_capped(self):
+        ladder = row_bucket_ladder(96, 8)
+        assert ladder == (8, 16, 32, 64, 96)
+        assert all(r % 8 == 0 for r in ladder)
+
+    def test_every_row_count_maps_into_the_ladder(self):
+        ladder = row_bucket_ladder(1 << 20, 8)
+        rng = np.random.RandomState(0)
+        for rows in rng.randint(1, (1 << 20) + 1, 200):
+            b = pad_rows_for(int(rows), ladder)
+            assert b in ladder and b >= rows
+        # the canonical-shape property: ANY skew yields <= len(ladder)
+        # distinct shapes, because every bucket IS a rung
+        assert len({pad_rows_for(int(r), ladder)
+                    for r in rng.randint(1, 1 << 20, 5000)}) <= len(ladder)
+
+    def test_dense_base_halves_worst_case_waste(self):
+        dense = row_bucket_ladder(1 << 16, 8, DENSE_LADDER_BASE)
+        wide = row_bucket_ladder(1 << 16, 8)
+        assert len(dense) > len(wide)
+        rows = (1 << 15) + 8          # just past a power-of-two rung
+        waste = 1 - rows / pad_rows_for(rows, wide)
+        waste_dense = 1 - rows / pad_rows_for(rows, dense)
+        assert waste_dense < waste
+
+    def test_len_bucket_lane_multiples(self):
+        assert len_bucket(1) == 128
+        assert len_bucket(100) == 128
+        assert len_bucket(150) == 256
+        assert len_bucket(300) == 512
+        assert len_bucket(128) == 128
+
+    def test_bad_base_rejected(self):
+        with pytest.raises(ValueError):
+            row_bucket_ladder(64, 8, base=1.0)
+
+
+# ---------------------------------------------------------------------------
+# autotuner (pure decisions)
+# ---------------------------------------------------------------------------
+
+class TestDecidePlan:
+    def test_deterministic_and_digest_stable(self):
+        kw = dict(pass_name="p2", chunk_rows=1 << 20, mesh_size=8,
+                  on_tpu=True, waste_mean=0.123456789,
+                  link_bytes_per_sec=45e6, bytes_per_row=264.0)
+        a, b = decide_plan(**kw), decide_plan(**kw)
+        assert a == b
+        # replaying from the RECORDED (canonicalized) inputs reproduces
+        # the plan bit-for-bit — the check_executor contract
+        c = decide_plan(**a["inputs"])
+        for f in ("chunk_rows", "ladder", "ladder_base",
+                  "prefetch_depth", "donate", "input_digest"):
+            assert c[f] == a[f], f
+
+    def test_waste_over_target_densifies_ladder(self):
+        lo = decide_plan(pass_name="p2", chunk_rows=1 << 16, mesh_size=8,
+                         on_tpu=False, waste_mean=0.1)
+        hi = decide_plan(pass_name="p2", chunk_rows=1 << 16, mesh_size=8,
+                         on_tpu=False,
+                         waste_mean=PAD_WASTE_TARGET + 0.05)
+        assert lo["ladder_base"] == 2.0
+        assert hi["ladder_base"] == pytest.approx(DENSE_LADDER_BASE)
+        assert "dense-ladder" in hi["reason"]
+        assert len(hi["ladder"]) > len(lo["ladder"])
+
+    def test_slow_link_caps_chunk_rows_on_tpu_only(self):
+        kw = dict(pass_name="p2", chunk_rows=1 << 20, mesh_size=8,
+                  link_bytes_per_sec=1e6, bytes_per_row=264.0)
+        tpu = decide_plan(on_tpu=True, **kw)
+        cpu = decide_plan(on_tpu=False, **kw)
+        assert tpu["chunk_rows"] < (1 << 20)
+        assert tpu["chunk_rows"] % 8 == 0
+        assert "link-rate-chunk-cap" in tpu["reason"]
+        assert cpu["chunk_rows"] == 1 << 20      # no link cap off-chip
+        # the ladder always tops out at the decided chunk size
+        assert tpu["ladder"][-1] == tpu["chunk_rows"]
+
+    def test_tiny_ladder_base_clamped(self):
+        """A plausible flag typo (1.001) must not build a million-rung
+        ladder that every pass-boundary event then serializes."""
+        p = decide_plan(pass_name="p2", chunk_rows=1 << 22, mesh_size=8,
+                        on_tpu=False, ladder_base=1.001)
+        assert p["ladder_base"] >= 1.1
+        assert len(p["ladder"]) < 200
+
+    def test_autotune_off_freezes_defaults(self):
+        p = decide_plan(pass_name="p2", chunk_rows=1 << 20, mesh_size=8,
+                        on_tpu=True, waste_mean=0.9,
+                        link_bytes_per_sec=1e5, bytes_per_row=264.0,
+                        autotune=False)
+        assert p["chunk_rows"] == 1 << 20
+        assert p["ladder_base"] == 2.0
+        assert p["reason"] == "default"
+
+
+# ---------------------------------------------------------------------------
+# prefetching device feed
+# ---------------------------------------------------------------------------
+
+class TestPrefetched:
+    def test_order_preserved_and_bound_held(self):
+        peaks = []
+
+        def on_chunk(stall, inflight):
+            peaks.append(inflight)
+
+        def slow_consume(it):
+            for x in it:
+                time.sleep(0.002)     # let the feeder run ahead
+                yield x
+
+        got = list(slow_consume(prefetched(range(50), lambda x: x * 3,
+                                           depth=2, on_chunk=on_chunk)))
+        assert got == [x * 3 for x in range(50)]
+        assert len(peaks) == 50
+        assert max(peaks) <= 2        # the in-flight queue bound
+
+    def test_depth_zero_is_synchronous(self):
+        seen = []
+        out = list(prefetched([1, 2, 3],
+                              lambda x: seen.append(x) or x, depth=0))
+        assert out == [1, 2, 3] and seen == [1, 2, 3]
+
+    def test_put_error_surfaces(self):
+        def bad(x):
+            if x == 3:
+                raise RuntimeError("boom")
+            return x
+        with pytest.raises(RuntimeError, match="boom"):
+            list(prefetched(range(10), bad, depth=2))
+
+    def test_consumer_bail_stops_feeder(self):
+        produced = []
+
+        def put(x):
+            produced.append(x)
+            return x
+        it = prefetched(range(10_000), put, depth=2)
+        next(it)
+        it.close()
+        time.sleep(0.05)
+        n = len(produced)
+        time.sleep(0.05)
+        assert len(produced) == n     # feeder stopped, not draining all
+
+
+# ---------------------------------------------------------------------------
+# pipeline integration: recompile bound, waste, determinism, no-barrier
+# ---------------------------------------------------------------------------
+
+def _skewed_dataset(tmp_path, seed=0):
+    """Skewed-length synthetic reads: 5 full 96-row chunks + a 57-row
+    tail at chunk_rows=96, mixing 60 bp and 80 bp reads (one 128-lane
+    length bucket, two row rungs)."""
+    from adam_tpu.io.parquet import save_table
+    from tests._synth_reads import random_reads_table
+
+    t1 = random_reads_table(500, 60, seed=seed, n_rg=2)
+    t2 = random_reads_table(37, 80, seed=seed + 1, n_rg=2)
+    table = pa.concat_tables([t1, t2]).combine_chunks()
+    path = tmp_path / "ds"
+    save_table(table, str(path), n_parts=1)
+    return str(path)
+
+
+def _run_transform(src, out_dir, chunk_rows=96):
+    from adam_tpu.parallel.pipeline import streaming_transform
+    return streaming_transform(src, str(out_dir), bqsr=True,
+                               mesh=make_mesh(8), chunk_rows=chunk_rows)
+
+
+def test_skewed_stream_compiles_at_most_ladder_shapes(tmp_path):
+    """The tentpole pin: a skewed run's shape count stays within the
+    ladder (each shape = at most one XLA compile per kernel), observed
+    pad waste stays under 35%, and an identical second run re-uses every
+    compiled executable (obs compile-miss counter delta == 0)."""
+    from adam_tpu.platform import install_compile_metrics
+
+    install_compile_metrics()
+    src = _skewed_dataset(tmp_path)
+    n = _run_transform(src, tmp_path / "out1")
+    assert n == 537
+
+    snap = obs.registry().snapshot()
+    ladder = row_bucket_ladder(96, 8)
+    for p in ("p2", "p3"):
+        shapes = snap["counters"].get(f"executor_shapes{{pass={p}}}", 0)
+        assert 1 <= shapes <= len(ladder), (p, shapes, ladder)
+        h = snap["histograms"][f"pad_waste_frac{{pass={p}}}"]
+        assert h["count"] >= 6
+        assert h["sum"] / h["count"] < 0.35      # the waste ceiling
+    compiles_after_run1 = snap["counters"].get("compile_count", 0)
+
+    # identical input, fresh output: every (kernel, shape) pair was
+    # already compiled — the canonical ladder means ZERO new compiles
+    n2 = _run_transform(src, tmp_path / "out2")
+    assert n2 == n
+    snap2 = obs.registry().snapshot()
+    assert snap2["counters"].get("compile_count", 0) == \
+        compiles_after_run1
+    # and the outputs are byte-identical
+    from adam_tpu.io.parquet import load_table
+    assert load_table(str(tmp_path / "out1")).equals(
+        load_table(str(tmp_path / "out2")))
+
+
+def test_prefetch_enabled_is_bit_identical_and_bounded(tmp_path,
+                                                       monkeypatch):
+    """The device feed (forced on via env, depth 2) must not change a
+    single output byte, and its in-flight gauge must respect the
+    bound."""
+    from adam_tpu.io.parquet import load_table
+
+    src = _skewed_dataset(tmp_path, seed=3)
+    _run_transform(src, tmp_path / "ref")
+    ref = load_table(str(tmp_path / "ref"))
+
+    obs.reset_all()
+    from adam_tpu.instrument import report
+    report().reset()
+    monkeypatch.setenv("ADAM_TPU_EXECUTOR_PREFETCH", "2")
+    _run_transform(src, tmp_path / "fed")
+    assert load_table(str(tmp_path / "fed")).equals(ref)
+    gauges = obs.registry().snapshot()["gauges"]
+    peaks = {k: v for k, v in gauges.items()
+             if k.startswith("executor_prefetch_inflight_peak")}
+    assert peaks                      # the feed really engaged
+    assert all(v <= 2 for v in peaks.values())
+    # with the feed active, stage attribution moves consumer-side
+    # (<pass>-feed-wait): the feeder thread must never drive stage()
+    # contexts — instrument's report stack is shared, not thread-local
+    stages = set(report().root.children)
+    assert "p2-feed-wait" in stages and "p3-feed-wait" in stages
+    assert "p2-decode" not in stages and "p2-pack" not in stages
+
+
+def test_streaming_flagstat_prefetch_matches_default(resources,
+                                                     monkeypatch):
+    from adam_tpu.parallel.pipeline import streaming_flagstat
+
+    src = str(resources / "unmapped.sam")
+    want = streaming_flagstat(src, mesh=make_mesh(8), chunk_rows=64)
+    monkeypatch.setenv("ADAM_TPU_EXECUTOR_PREFETCH", "2")
+    got = streaming_flagstat(src, mesh=make_mesh(8), chunk_rows=64)
+    assert got == want
+
+
+def test_no_device_barrier_with_metrics_off(tmp_path, monkeypatch):
+    """PR 1's acceptance guarantee survives the executor: without
+    -timing/-metrics, a full streaming run (prefetch forced on) never
+    calls the device barrier."""
+    import adam_tpu.instrument as instrument
+
+    calls = []
+    monkeypatch.setattr(instrument, "_block_on_device",
+                        lambda: calls.append(1))
+    monkeypatch.setenv("ADAM_TPU_EXECUTOR_PREFETCH", "2")
+    src = _skewed_dataset(tmp_path, seed=5)
+    _run_transform(src, tmp_path / "out")
+    assert calls == []
+
+
+def test_autotuner_densifies_after_wasteful_pass(tmp_path):
+    """Pass-boundary re-decision: seed the executor with >35% observed
+    waste and the NEXT pass's ladder densifies; decisions never change
+    mid-pass (the pass's frozen plan object is what chunks consult)."""
+    ex = StreamExecutor(make_mesh(8), 1 << 16, on_tpu=False)
+    p1 = ex.begin_pass("p1")
+    assert p1.plan["ladder_base"] == 2.0
+    # a badly skewed pass: every chunk ~52% padding
+    for _ in range(8):
+        p1.pad_rows((1 << 15) + 16)
+    assert ex.observed_waste_mean() > PAD_WASTE_TARGET
+    p2 = ex.begin_pass("p2")
+    assert p2.plan["ladder_base"] == pytest.approx(DENSE_LADDER_BASE)
+    assert p1.plan["ladder_base"] == 2.0       # p1's plan never moved
+
+
+# ---------------------------------------------------------------------------
+# sidecar: schema + deterministic replay (tools/check_executor.py)
+# ---------------------------------------------------------------------------
+
+def test_cli_sidecar_validates_and_replays(resources, tmp_path):
+    from adam_tpu.cli.main import main
+
+    mpath = str(tmp_path / "run.jsonl")
+    rc = main(["transform", str(resources / "small.sam"),
+               str(tmp_path / "out"), "-recalibrate_base_qualities",
+               "-stream", "-stream_chunk_rows", "64",
+               "-metrics", mpath])
+    assert rc == 0
+
+    check_metrics = _load_tool("check_metrics")
+    assert check_metrics.validate(mpath) == []
+    lines = [json.loads(ln) for ln in open(mpath) if ln.strip()]
+    selected = [d for d in lines
+                if d.get("event") == "executor_bucket_selected"]
+    assert {d["pass"] for d in selected} >= {"p1", "p2", "p3"}
+    assert any(d.get("event") == "executor_recompile" for d in lines)
+
+    check_executor = _load_tool("check_executor")
+    assert check_executor.check([mpath]) == []
+
+
+def test_check_executor_flags_nondeterminism(tmp_path):
+    """A tampered sidecar — same input digest, drifted decision — must
+    fail the replay."""
+    plan = decide_plan(pass_name="p2", chunk_rows=96, mesh_size=8,
+                       on_tpu=False)
+    ev = {"event": "executor_bucket_selected", "t": 0.1, **{
+        k: plan[k] for k in ("chunk_rows", "ladder", "ladder_base",
+                             "prefetch_depth", "donate", "inputs",
+                             "input_digest")}, "pass": "p2"}
+    good = tmp_path / "good.jsonl"
+    good.write_text(json.dumps(ev) + "\n")
+    bad_ev = dict(ev, chunk_rows=128,
+                  ladder=list(ev["ladder"][:-1]) + [128])
+    bad = tmp_path / "bad.jsonl"
+    bad.write_text(json.dumps(bad_ev) + "\n")
+
+    check_executor = _load_tool("check_executor")
+    assert check_executor.check([str(good)]) == []
+    errs = check_executor.check([str(bad)])
+    assert errs and any("non-deterministic" in e for e in errs)
+    # cross-file: one digest, two decisions
+    errs2 = check_executor.check([str(good), str(bad)])
+    assert any("decided differently" in e or "non-deterministic" in e
+               for e in errs2)
+
+
+def test_donated_flagstat_kernel_still_counts(resources):
+    """Donation is a memory optimization, never a semantics change: the
+    donating kernel build produces the same counters (donation engages
+    for real on TPU; on CPU jax falls back with the buffers copied)."""
+    import warnings
+
+    import jax
+
+    from adam_tpu.ops.flagstat import (flagstat_wire32_sharded,
+                                       pack_flagstat_wire32)
+
+    rng = np.random.RandomState(0)
+    n = 64
+    wire = pack_flagstat_wire32(
+        rng.randint(0, 1 << 11, n).astype(np.uint16),
+        rng.randint(0, 61, n).astype(np.uint8),
+        rng.randint(0, 4, n).astype(np.int16),
+        rng.randint(0, 4, n).astype(np.int16),
+        np.ones(n, bool))
+    mesh = make_mesh(8)
+    want = np.asarray(flagstat_wire32_sharded(mesh)(
+        jax.device_put(wire)))
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")   # CPU: "donation not used"
+        got = np.asarray(flagstat_wire32_sharded(mesh, donate=True)(
+            jax.device_put(wire)))
+    np.testing.assert_array_equal(got, want)

@@ -1,6 +1,13 @@
 """enable_compilation_cache resolution rules, pinned without touching
 the real jax config (a test-session cache dir would leak into every
-later test's compiles)."""
+later test's compiles).
+
+The default-on decision is DEFERRED: it gates on the actual initialized
+backend (read at the first backend-compile event), not on the absence
+of a forced-CPU platform string — a CPU-only jax install with no
+JAX_PLATFORMS used to slip past the old string check and enable the
+persistent cache (AOT-reload warning spam + cross-machine SIGILL risk).
+"""
 
 import adam_tpu.platform as P
 
@@ -13,11 +20,13 @@ class _Recorder:
         self.calls.append((key, value))
 
 
-def _run(monkeypatch, tmp_path, env=None, platforms_cfg=""):
+def _run(monkeypatch, tmp_path, env=None, platforms_cfg="",
+         backend="tpu"):
     import sys
     from types import SimpleNamespace
 
     rec = _Recorder()
+    listeners = []
     for k in ("ADAM_TPU_COMPILE_CACHE", "JAX_COMPILATION_CACHE_DIR",
               "JAX_PLATFORMS"):
         monkeypatch.delenv(k, raising=False)
@@ -25,42 +34,103 @@ def _run(monkeypatch, tmp_path, env=None, platforms_cfg=""):
         monkeypatch.setenv(k, v)
     # the function does `import jax` internally; a stub keeps the real
     # session config untouched (jax_platforms is a read-only property,
-    # and a real cache dir would leak into every later test's compiles)
-    fake = SimpleNamespace(config=SimpleNamespace(
-        jax_platforms=platforms_cfg, update=rec))
+    # and a real cache dir would leak into every later test's compiles).
+    # ``monitoring`` captures the deferred listener; ``default_backend``
+    # plays the post-init backend the deferral consults.
+    fake = SimpleNamespace(
+        config=SimpleNamespace(jax_platforms=platforms_cfg, update=rec),
+        default_backend=lambda: backend,
+        monitoring=SimpleNamespace(
+            register_event_duration_secs_listener=listeners.append,
+            register_event_listener=lambda f: None))
     monkeypatch.setitem(sys.modules, "jax", fake)
     monkeypatch.setattr(P.os.path, "expanduser",
                         lambda p: p.replace("~", str(tmp_path)))
+    # isolate the module-global deferral state (and keep the fake's
+    # monitoring registrations out of the real compile-metrics install)
+    monkeypatch.setattr(P, "_PENDING_DEFAULT_CACHE", [])
+    monkeypatch.setattr(P, "_DEFER_LISTENER_INSTALLED", False)
+    monkeypatch.setattr(P, "_COMPILE_METRICS_INSTALLED", True)
     P.enable_compilation_cache()
-    return rec.calls
+    return rec.calls, listeners
+
+
+def _fire_compile(listeners):
+    for f in listeners:
+        f("/jax/core/compile/backend_compile_duration", 0.5)
 
 
 def test_disabled_by_zero(monkeypatch, tmp_path):
-    assert _run(monkeypatch, tmp_path,
-                env={"ADAM_TPU_COMPILE_CACHE": "0"}) == []
+    calls, listeners = _run(monkeypatch, tmp_path,
+                            env={"ADAM_TPU_COMPILE_CACHE": "0"})
+    assert calls == [] and listeners == []
 
 
 def test_explicit_path_force_enables_even_on_cpu(monkeypatch, tmp_path):
-    calls = _run(monkeypatch, tmp_path,
-                 env={"ADAM_TPU_COMPILE_CACHE": str(tmp_path / "c"),
-                      "JAX_PLATFORMS": "cpu"},
-                 platforms_cfg="cpu")
+    calls, _ = _run(monkeypatch, tmp_path,
+                    env={"ADAM_TPU_COMPILE_CACHE": str(tmp_path / "c"),
+                         "JAX_PLATFORMS": "cpu"},
+                    platforms_cfg="cpu", backend="cpu")
     assert ("jax_compilation_cache_dir", str(tmp_path / "c")) in calls
 
 
 def test_jax_native_env_left_alone(monkeypatch, tmp_path):
-    assert _run(monkeypatch, tmp_path,
-                env={"JAX_COMPILATION_CACHE_DIR": "/elsewhere"}) == []
+    calls, listeners = _run(
+        monkeypatch, tmp_path,
+        env={"JAX_COMPILATION_CACHE_DIR": "/elsewhere"})
+    assert calls == [] and listeners == []
 
 
-def test_cpu_platform_gate_skips_default(monkeypatch, tmp_path):
-    assert _run(monkeypatch, tmp_path, platforms_cfg="cpu") == []
-    assert _run(monkeypatch, tmp_path,
-                env={"JAX_PLATFORMS": "cpu"}) == []
+def test_forced_cpu_platform_skips_without_deferral(monkeypatch,
+                                                    tmp_path):
+    calls, listeners = _run(monkeypatch, tmp_path, platforms_cfg="cpu")
+    assert calls == [] and listeners == []
+    calls, listeners = _run(monkeypatch, tmp_path,
+                            env={"JAX_PLATFORMS": "cpu"})
+    assert calls == [] and listeners == []
 
 
-def test_default_enables_for_unforced_platform(monkeypatch, tmp_path):
-    calls = _run(monkeypatch, tmp_path, platforms_cfg="")
+def test_default_defers_then_enables_on_accelerator(monkeypatch,
+                                                    tmp_path):
+    calls, listeners = _run(monkeypatch, tmp_path, platforms_cfg="",
+                            backend="tpu")
+    assert calls == []          # nothing before the backend exists
+    assert len(listeners) == 1
+    _fire_compile(listeners)
     dirs = [v for k, v in calls if k == "jax_compilation_cache_dir"]
     assert len(dirs) == 1 and dirs[0].startswith(str(tmp_path))
     assert ("jax_persistent_cache_min_compile_time_secs", 0.1) in calls
+    # one-shot: later compile events must not re-apply the config
+    _fire_compile(listeners)
+    assert len([v for k, v in calls
+                if k == "jax_compilation_cache_dir"]) == 1
+
+
+def test_default_never_enables_on_cpu_only_install(monkeypatch,
+                                                   tmp_path):
+    """THE round-5 advisor case: no forced platform string, but the
+    backend that actually initializes is CPU (cpu-only jaxlib).  The
+    old absence-of-forced-cpu gate enabled the persistent cache here."""
+    calls, listeners = _run(monkeypatch, tmp_path, platforms_cfg="",
+                            backend="cpu")
+    assert calls == []
+    _fire_compile(listeners)
+    assert calls == []
+
+
+def test_apply_pending_on_empty_list_is_a_noop(monkeypatch):
+    """Two concurrently-compiling threads can both reach the listener;
+    the pop loser must no-op, never raise out of jax's compile path."""
+    monkeypatch.setattr(P, "_PENDING_DEFAULT_CACHE", [])
+    P.apply_pending_default_cache()     # must not raise
+
+
+def test_unrelated_duration_events_do_not_resolve(monkeypatch,
+                                                  tmp_path):
+    calls, listeners = _run(monkeypatch, tmp_path, platforms_cfg="",
+                            backend="tpu")
+    for f in listeners:
+        f("/jax/some/other_duration", 0.1)
+    assert calls == []          # still pending until a backend compile
+    _fire_compile(listeners)
+    assert any(k == "jax_compilation_cache_dir" for k, _ in calls)

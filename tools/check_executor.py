@@ -1,0 +1,137 @@
+#!/usr/bin/env python3
+"""Replay a telemetry sidecar's executor decisions and assert they are
+deterministic.
+
+The streaming executor's autotuner (adam_tpu/parallel/executor.py,
+``decide_plan``) is a PURE function of its inputs, and every
+``executor_bucket_selected`` event records those inputs verbatim plus a
+digest of them.  This checker re-derives each recorded decision offline
+and fails when:
+
+* replaying ``decide_plan(**inputs)`` yields a different chunk_rows /
+  ladder / ladder_base / prefetch_depth / donate than the event
+  recorded (the autotuner drifted from purity — e.g. someone added a
+  clock or env read inside the decision);
+* the recorded ``input_digest`` does not match the digest of the
+  recorded inputs (the event lied about what it decided from);
+* two events — within one file or across files — share an
+  ``input_digest`` but disagree on the decision (same inputs must mean
+  the same plan, the fixed-input-digest determinism contract the smoke
+  test pins).
+
+Usage::
+
+    python tools/check_executor.py RUN.metrics.jsonl [...]
+
+Exit 0 when every recorded decision replays identically; 1 otherwise
+with one line per violation.  Companion to tools/check_metrics.py
+(which validates the event SCHEMA; this validates the event's
+semantics).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+from typing import Dict, List, Tuple
+
+# runnable as a script from anywhere (same repo-root shim as aot_check)
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+#: the plan fields a replay must reproduce exactly
+PLAN_FIELDS = ("chunk_rows", "ladder", "ladder_base", "prefetch_depth",
+               "donate")
+
+
+def _events(path: str) -> List[Tuple[int, dict]]:
+    out = []
+    with open(path) as f:
+        for i, ln in enumerate(f, 1):
+            if not ln.strip():
+                continue
+            try:
+                doc = json.loads(ln)
+            except ValueError:
+                continue        # schema problems are check_metrics' job
+            if isinstance(doc, dict) and \
+                    doc.get("event") == "executor_bucket_selected":
+                out.append((i, doc))
+    return out
+
+
+def check(paths: List[str]) -> List[str]:
+    """Replay every recorded decision; return human-readable violations
+    (empty = deterministic)."""
+    from adam_tpu.parallel.executor import decide_plan
+
+    errs: List[str] = []
+    by_digest: Dict[str, Tuple[str, int, dict]] = {}
+    n_checked = 0
+    for path in paths:
+        events = _events(path)
+        if not events:
+            errs.append(f"{path}: no executor_bucket_selected events "
+                        "(not an executor run, or events were lost)")
+            continue
+        for i, ev in events:
+            inputs = ev.get("inputs")
+            if not isinstance(inputs, dict):
+                errs.append(f"{path}:{i}: event carries no inputs — "
+                            "decision cannot be replayed")
+                continue
+            try:
+                plan = decide_plan(**inputs)
+            except TypeError as e:
+                errs.append(f"{path}:{i}: inputs do not replay through "
+                            f"decide_plan: {e}")
+                continue
+            n_checked += 1
+            for field in PLAN_FIELDS:
+                if ev.get(field) != plan[field]:
+                    errs.append(
+                        f"{path}:{i}: non-deterministic decision — "
+                        f"recorded {field}={ev.get(field)!r}, replay "
+                        f"yields {plan[field]!r}")
+            if ev.get("input_digest") != plan["input_digest"]:
+                errs.append(
+                    f"{path}:{i}: input_digest mismatch (recorded "
+                    f"{ev.get('input_digest')!r}, inputs digest to "
+                    f"{plan['input_digest']!r})")
+            # cross-event/cross-file: one digest, one decision
+            decision = {f: ev.get(f) for f in PLAN_FIELDS}
+            dig = ev.get("input_digest")
+            if isinstance(dig, str):
+                seen = by_digest.get(dig)
+                if seen is None:
+                    by_digest[dig] = (path, i, decision)
+                elif seen[2] != decision:
+                    errs.append(
+                        f"{path}:{i}: digest {dig} decided differently "
+                        f"than {seen[0]}:{seen[1]} — same inputs must "
+                        "yield the same plan")
+    if not errs and not n_checked:
+        errs.append("no replayable executor decisions found")
+    return errs
+
+
+def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    if not argv:
+        print("usage: check_executor.py RUN.metrics.jsonl [...]",
+              file=sys.stderr)
+        return 2
+    errors = check(argv)
+    if errors:
+        for e in errors:
+            print(e, file=sys.stderr)
+        return 1
+    n = sum(len(_events(p)) for p in argv)
+    print(f"ok: {n} executor decision(s) replayed deterministically "
+          f"across {len(argv)} file(s)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -10,6 +10,16 @@ elastic worker sidecars).  Contract checked here:
   strings, a hex ``config_fingerprint``, host/pid present;
 * ``stage`` events carry ``name`` (str) and ``seconds`` (number >= 0);
 * ``chunk`` events carry ``pass`` (str) and ``rows`` (int >= 0);
+* ``executor_bucket_selected`` events carry ``pass``, ``chunk_rows``
+  (int > 0), a strictly ascending int ``ladder`` whose top rung equals
+  ``chunk_rows``, ``ladder_base`` (> 1), ``inputs`` (object) and a hex
+  ``input_digest`` (tools/check_executor.py replays the decision);
+* ``executor_recompile`` events carry ``pass``, ``rows`` (a member of
+  that pass's announced ladder) and ``n_shapes`` (int >= 1 — counts
+  (rows, len) pairs, so it may exceed the ROW ladder length when the
+  length bucket grows mid-pass);
+* ``executor_prefetch_stall_s`` events carry ``pass``, ``seconds``
+  (>= 0) and ``inflight_peak <= depth`` (the feed's bound held);
 * the last line is the ``summary``: ``wall_seconds``, ``ok``, and a
   ``metrics`` snapshot whose counters/gauges are numeric and whose
   histograms are internally consistent (count == sum of bucket counts);
@@ -101,6 +111,7 @@ def validate(path: str) -> List[str]:
             if field not in m:
                 err(i, f"manifest missing {field!r}")
 
+    ladders: dict = {}   # pass -> announced ladder (latest wins)
     for i, d in docs:
         ev = d.get("event")
         if ev == "stage":
@@ -115,6 +126,69 @@ def validate(path: str) -> List[str]:
             if not (isinstance(rows, int) and not isinstance(rows, bool)
                     and rows >= 0):
                 err(i, "chunk event missing non-negative int 'rows'")
+        elif ev == "executor_bucket_selected":
+            if not isinstance(d.get("pass"), str):
+                err(i, "executor_bucket_selected missing string 'pass'")
+            cr = d.get("chunk_rows")
+            if not (isinstance(cr, int) and not isinstance(cr, bool)
+                    and cr > 0):
+                err(i, "executor_bucket_selected missing positive int "
+                       "'chunk_rows'")
+            ladder = d.get("ladder")
+            if not (isinstance(ladder, list) and ladder and
+                    all(isinstance(r, int) and not isinstance(r, bool)
+                        and r > 0 for r in ladder) and
+                    all(a < b for a, b in zip(ladder, ladder[1:]))):
+                err(i, "executor_bucket_selected 'ladder' is not a "
+                       "strictly ascending list of positive ints")
+            elif isinstance(cr, int) and ladder[-1] != cr:
+                err(i, f"executor ladder top rung {ladder[-1]} != "
+                       f"chunk_rows {cr}")
+            else:
+                ladders[d.get("pass")] = ladder
+            if not (_is_num(d.get("ladder_base")) and
+                    d["ladder_base"] > 1):
+                err(i, "executor_bucket_selected 'ladder_base' must "
+                       "exceed 1")
+            if not isinstance(d.get("inputs"), dict):
+                err(i, "executor_bucket_selected missing 'inputs' "
+                       "object (decision must be replayable)")
+            dig = d.get("input_digest")
+            if not (isinstance(dig, str) and len(dig) >= 8 and
+                    all(c in "0123456789abcdef" for c in dig)):
+                err(i, "executor_bucket_selected missing hex "
+                       "'input_digest'")
+        elif ev == "executor_recompile":
+            if not isinstance(d.get("pass"), str):
+                err(i, "executor_recompile missing string 'pass'")
+            rows = d.get("rows")
+            if not (isinstance(rows, int) and not isinstance(rows, bool)
+                    and rows > 0):
+                err(i, "executor_recompile missing positive int 'rows'")
+            elif d.get("pass") in ladders and \
+                    rows not in ladders[d["pass"]]:
+                err(i, f"executor_recompile rows {rows} not a rung of "
+                       f"pass {d['pass']!r}'s announced ladder")
+            ns = d.get("n_shapes")
+            if not (isinstance(ns, int) and not isinstance(ns, bool)
+                    and ns >= 1):
+                err(i, "executor_recompile missing int 'n_shapes' >= 1")
+            # NOTE: n_shapes counts distinct (rows, len) PAIRS, so its
+            # bound is len(ladder) x length-buckets, not len(ladder) —
+            # a growing length bucket mid-pass legitimately exceeds the
+            # row-ladder length.  Only rows-membership is checkable.
+        elif ev == "executor_prefetch_stall_s":
+            if not isinstance(d.get("pass"), str):
+                err(i, "executor_prefetch_stall_s missing string 'pass'")
+            if not (_is_num(d.get("seconds")) and d["seconds"] >= 0):
+                err(i, "executor_prefetch_stall_s missing non-negative "
+                       "'seconds'")
+            peak = d.get("inflight_peak")
+            depth = d.get("depth")
+            if _is_num(peak) and _is_num(depth) and depth > 0 and \
+                    peak > depth:
+                err(i, f"executor prefetch inflight_peak {peak} exceeds "
+                       f"its depth bound {depth}")
 
     if summaries:
         i, s = summaries[0]
