@@ -277,6 +277,21 @@ class TransformCommand(Command):
         p.add_argument("-workdir", default=None,
                        help="scratch directory for streamed spills "
                             "(default: a temp dir)")
+        p.add_argument("-realign_pipeline_depth", type=int, default=None,
+                       metavar="N",
+                       help="pass-4 realign pipeline look-ahead: bin "
+                            "i+1's Parquet load + host group prep "
+                            "overlaps bin i's sweeps and bin i-1's emit, "
+                            "with at most N bins in flight (default 2; "
+                            "1 = serial walk through the same engine; "
+                            "0 = pipeline off entirely; mirrors "
+                            "ADAM_TPU_REALIGN_PIPELINE_DEPTH). "
+                            "Output is byte-identical at any depth")
+        p.add_argument("-no_realign_pipeline", action="store_true",
+                       help="run pass-4 realignment strictly serially "
+                            "(the pre-pipeline path; mirrors "
+                            "ADAM_TPU_REALIGN_PIPELINE=0). Scheduling "
+                            "only — output bytes never change")
         add_executor_args(p)
         add_parquet_args(p)
 
@@ -305,6 +320,11 @@ class TransformCommand(Command):
             snp = SnpTable.from_vcf(args.dbsnp_sites) \
                 if args.dbsnp_sites else None
             pw = parquet_writer_kwargs(args)
+            realign_opts: dict = {}
+            if args.realign_pipeline_depth is not None:
+                realign_opts["depth"] = args.realign_pipeline_depth
+            if args.no_realign_pipeline:
+                realign_opts["pipeline"] = False
             n = streaming_transform(
                 args.input, args.output,
                 markdup=args.mark_duplicate_reads,
@@ -320,7 +340,8 @@ class TransformCommand(Command):
                 resume=bool(args.checkpoint_dir),
                 io_threads=args.io_threads,
                 io_procs=args.io_procs,
-                executor_opts=executor_opts_from(args))
+                executor_opts=executor_opts_from(args),
+                realign_opts=realign_opts)
             if args.timing:
                 from ..instrument import print_report
                 print_report()   # one quiet gate for ALL instrument output

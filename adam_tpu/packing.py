@@ -153,6 +153,30 @@ def pad_rows_for(rows: int, ladder) -> int:
     return ladder[-1]
 
 
+def shape_rung(n: int, mult: int, base: float = LADDER_BASE_DEFAULT) -> int:
+    """Smallest canonical rung (the :func:`row_bucket_ladder` recurrence
+    from ``mult``) that holds ``n`` — the unbounded form of
+    :func:`pad_rows_for` for group-shaped work whose cap is data-dependent.
+
+    The realignment sweep pads its (R, L, CL) job geometry with this
+    (realign/realigner.py, scheduled by parallel/realign_exec.py): the
+    rungs follow ``row_bucket_ladder``'s growth recurrence exactly (a
+    ladder's non-top rungs are this sequence; its TOP rung is the
+    mult-rounded cap, which only coincides when the cap sits on the
+    sequence), so sweep shapes are canonical across bins and runs —
+    independent of any per-run cap — and each kernel compiles a bounded
+    shape set.
+    """
+    if base <= 1.0:
+        raise ValueError(f"ladder base must exceed 1.0, got {base}")
+    mult = max(int(mult), 1)
+    r = mult
+    n = int(n)
+    while r < n:
+        r = _round_up(max(int(r * base + 0.5), r + 1), mult)
+    return r
+
+
 def len_bucket(max_len: int, base: float = LADDER_BASE_DEFAULT) -> int:
     """Canonical length bucket: the next 128-multiple (TPU lane width),
     rounded up its own geometric ladder (128, 256, 512, ... for the

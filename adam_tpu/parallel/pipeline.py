@@ -226,7 +226,7 @@ def _apply_dup_bits(table: pa.Table, dup: np.ndarray) -> pa.Table:
 
 class _BinStub:
     """Stand-in for a closed DatasetWriter when pass 4 resumes from a
-    checkpoint: _emit_bins/_process_mapped_bin only consume ``path`` and
+    checkpoint: _emit_bins/_bin_unit_descs only consume ``path`` and
     ``rows_written``."""
 
     def __init__(self, path: str, rows_written: int):
@@ -528,7 +528,8 @@ def streaming_transform(input_path: str, output_path: str, *,
                         resume: bool = False,
                         io_threads: int = 1,
                         io_procs: int = 1,
-                        executor_opts: Optional[dict] = None) -> int:
+                        executor_opts: Optional[dict] = None,
+                        realign_opts: Optional[dict] = None) -> int:
     """The ``transform`` pipeline over a chunked stream and a device mesh.
 
     Multi-pass, like the reference's shuffle stages (Transform.scala:62-97):
@@ -547,6 +548,15 @@ def streaming_transform(input_path: str, output_path: str, *,
       pass 4  per-bin: realign + in-bin sort; bins emit through a sorted
               merge window, so the output is globally position-sorted
               (AdamRDDFunctions.scala:63-93's range partition + sort).
+              With realignment on, bins run through the pipelined realign
+              engine (parallel/realign_exec.py): load+prep of the next
+              bin overlaps the current bin's device sweeps and the
+              previous bin's emit, and sweep jobs from all in-flight bins
+              batch by padded shape.  ``realign_opts`` forwards its knobs
+              ({pipeline: bool, depth: int, donate: bool} — the
+              -realign_pipeline_depth / -no_realign_pipeline flags and
+              ADAM_TPU_REALIGN_* envs); output is byte-identical at any
+              depth, pipeline on or off.
 
     Host RSS is bounded by chunk size + ~42 bytes/read of markdup keys —
     never the dataset.  Two skew/edge mechanisms:
@@ -965,7 +975,8 @@ def streaming_transform(input_path: str, output_path: str, *,
             with stage("p4-bins", sync=True):
                 _emit_bins(out, bin_writers,
                            halo_writers if realign else {}, part,
-                           chunk_rows, budget, realign, sort, wopts)
+                           chunk_rows, budget, realign, sort, wopts,
+                           realign_opts=realign_opts)
         out.close()
         if ck is not None:
             ck.mark("done", total_rows=total_rows)
@@ -1037,29 +1048,39 @@ def _flat_of_table(table: pa.Table, part) -> np.ndarray:
     return part.flat(refid, np.maximum(start, 0))
 
 
-def _process_mapped_bin(path, halo_path, part, rows, chunk_rows, budget,
-                        realign, sort, next_lo, workdir_b, wopts):
-    """Yield (processed_table, next_lower_flat) for one mapped bin,
-    splitting bins over ``budget`` rows into position sub-ranges first."""
-    from ..io.parquet import DatasetWriter, iter_tables, load_table
-    from ..ops.sort import sort_reads
-    from ..realign.realigner import realign_indels
+def _bin_unit_descs(path, halo_path, part, rows, chunk_rows, budget,
+                    realign, next_lo, wopts):
+    """Describe one mapped bin's schedulable pass-4 units lazily: one
+    ``(load, next_lower_flat)`` pair for an in-budget bin, or one per
+    position sub-range after the hot-bin quantile split.
 
-    def finish(own, halo, nxt):
-        t = _realign_with_halo(own, halo, realign_indels) if realign else own
-        if sort:
-            t = sort_reads(t)
-        return t, nxt
+    The split I/O runs during ITERATION (on the realign pipeline's reader
+    thread when pass 4 is pipelined — overlapped with downstream sweeps
+    and emits; see parallel/realign_exec.py), and each ``load()`` reads
+    its unit's tables once and removes its sub-range spill, so in-flight
+    host rows stay bounded at ~(pipeline depth + 2) x budget (depth + 1
+    queued prepared units, one under prep, one being finished).
+    """
+    import glob as _glob
+    import shutil as _shutil
+    import tempfile as _tempfile
+    import threading as _threading
+
+    from ..io.parquet import DatasetWriter, iter_tables, load_table
 
     if rows <= budget:
-        halo = load_table(halo_path) if halo_path else None
-        yield finish(load_table(path), halo, next_lo)
+        def load_small():
+            halo = load_table(halo_path) if halo_path else None
+            return load_table(path), halo
+        yield load_small, next_lo
         return
 
     # hot bin: pick cut positions at row quantiles of the flat coordinate
     # (projection-only scan), then stream rows into sub-range writers with
     # their own ±halo duplication.  Ties collapse — a single position's
     # pileup can exceed the budget but a position cannot be split.
+    for stale in _glob.glob(os.path.join(path, "hotbin_*")):
+        _shutil.rmtree(stale, ignore_errors=True)   # a crashed prior split
     key_tbl = load_table(path, columns=["referenceId", "start"])
     flat_sorted = np.sort(_flat_of_table(key_tbl, part))
     del key_tbl
@@ -1069,6 +1090,7 @@ def _process_mapped_bin(path, halo_path, part, rows, chunk_rows, budget,
     lows = np.concatenate([[0], cuts])              # sub-range lower edges
     highs = np.concatenate([cuts, [np.iinfo(np.int64).max]])
     W = _REALIGN_HALO
+    workdir_b = _tempfile.mkdtemp(prefix="hotbin_", dir=path)
     sub_own = [DatasetWriter(os.path.join(workdir_b, f"sub-{i:03d}"),
                              part_rows=budget, **wopts)
                for i in range(len(lows))]
@@ -1105,23 +1127,50 @@ def _process_mapped_bin(path, halo_path, part, rows, chunk_rows, budget,
         sub_own[i].close()
         if realign:
             sub_halo[i].close()
-        if sub_own[i].rows_written == 0:
-            continue
-        halo = load_table(sub_halo[i].path) \
-            if realign and sub_halo[i].rows_written else None
+
+    live = [i for i in range(len(lows)) if sub_own[i].rows_written]
+    if not live:
+        _shutil.rmtree(workdir_b, ignore_errors=True)
+        return
+    # loaders may execute concurrently (and complete out of order) on the
+    # realign pipeline's prep pool — the split spill goes away when the
+    # LAST of them has loaded, not when the last is issued
+    remaining = [len(live)]
+    rlock = _threading.Lock()
+    for i in live:
         nxt = int(highs[i]) if i + 1 < len(lows) else next_lo
-        yield finish(load_table(sub_own[i].path), halo, nxt)
+
+        def load_sub(i=i):
+            own = load_table(sub_own[i].path)
+            halo = load_table(sub_halo[i].path) \
+                if realign and sub_halo[i].rows_written else None
+            _shutil.rmtree(sub_own[i].path, ignore_errors=True)
+            if realign:
+                _shutil.rmtree(sub_halo[i].path, ignore_errors=True)
+            with rlock:
+                remaining[0] -= 1
+                done = remaining[0] == 0
+            if done:
+                _shutil.rmtree(workdir_b, ignore_errors=True)
+            return own, halo
+        yield load_sub, nxt
 
 
 def _emit_bins(out, bin_writers, halo_writers, part, chunk_rows, budget,
-               realign, sort, wopts):
+               realign, sort, wopts, realign_opts=None):
     """Pass 4 driver: process mapped bins in genome order, emitting sorted
     output through a merge window — realignment can move a read up to the
     halo width across a bin edge, so rows only emit once no later bin can
-    produce a smaller sort key."""
-    import shutil as _shutil
-    import tempfile as _tempfile
+    produce a smaller sort key.
 
+    With realignment on, the bins run through the pipelined engine
+    (parallel/realign_exec.py): bin i+1's load+prep overlaps bin i's
+    sweeps and bin i-1's finish/emit, with sweep jobs from every in-flight
+    bin batched by padded shape.  The engine changes scheduling only —
+    emit order and bytes are identical to the serial walk (and
+    ``-no_realign_pipeline`` / ``ADAM_TPU_REALIGN_PIPELINE=0`` forces the
+    serial walk outright).
+    """
     from .. import schema as S
     from ..instrument import stage
     from ..io.parquet import iter_tables
@@ -1144,35 +1193,73 @@ def _emit_bins(out, bin_writers, halo_writers, part, chunk_rows, budget,
                 out.write(pending.slice(0, k))
         pending = pending.slice(k) if k < pending.num_rows else None
 
+    emit = emit_sorted if sort else (lambda tbl, nxt: out.write(tbl))
+
+    # mapped bins in genome order; the last partition is the unmapped tail
+    mapped = []
     for b, w in enumerate(bin_writers):
-        if b == part.num_partitions - 1:        # unmapped bin: stable tail
-            if pending is not None:
-                out.write(pending)
-                pending = None
-            if w.rows_written:
-                for t in iter_tables(w.path, chunk_rows=chunk_rows):
-                    out.write(t)
-            continue
-        if w.rows_written == 0:
+        if b == part.num_partitions - 1 or w.rows_written == 0:
             continue
         halo_w = halo_writers.get(b)
         halo_path = halo_w.path if halo_w is not None and \
             halo_w.rows_written else None
         next_lo = part.bin_lower_flat(b + 1) if b + 1 < part.parts \
             else part.total_length + _REALIGN_HALO
-        workdir_b = _tempfile.mkdtemp(prefix="hotbin_", dir=w.path)
-        try:
-            for tbl, nxt in _process_mapped_bin(
-                    w.path, halo_path, part, w.rows_written, chunk_rows,
-                    budget, realign, sort, next_lo, workdir_b, wopts):
-                if sort:
-                    emit_sorted(tbl, nxt)
-                else:
-                    out.write(tbl)
-        finally:
-            _shutil.rmtree(workdir_b, ignore_errors=True)
-    if pending is not None:                      # no unmapped rows written
+        mapped.append((b, w, halo_path, next_lo))
+
+    plan = None
+    if realign:
+        from ..platform import is_tpu_backend
+        from .realign_exec import (decide_realign_plan, emit_realign_plan,
+                                   resolve_realign_opts)
+        plan = decide_realign_plan(
+            n_bins=part.num_partitions, on_tpu=is_tpu_backend(),
+            **resolve_realign_opts(realign_opts))
+        emit_realign_plan(plan)
+
+    try:
+        if plan is not None and plan["pipeline_depth"] > 0:
+            from .realign_exec import BinUnitDesc, RealignEngine
+
+            def units():
+                for seq, (b, w, halo_path, next_lo) in enumerate(mapped):
+                    for k, (load, nxt) in enumerate(_bin_unit_descs(
+                            w.path, halo_path, part, w.rows_written,
+                            chunk_rows, budget, True, next_lo, wopts)):
+                        yield BinUnitDesc(b, (seq, k), load, nxt)
+
+            RealignEngine(plan).run(units(), emit, sort)
+        else:
+            from ..realign.realigner import realign_indels
+            for b, w, halo_path, next_lo in mapped:
+                for load, nxt in _bin_unit_descs(
+                        w.path, halo_path, part, w.rows_written,
+                        chunk_rows, budget, realign, next_lo, wopts):
+                    own, halo = load()
+                    tbl = _realign_with_halo(own, halo, realign_indels) \
+                        if realign else own
+                    if sort:
+                        tbl = sort_reads(tbl)
+                    emit(tbl, nxt)
+    finally:
+        # sub-range loaders normally consume and remove their own spill;
+        # an abort between the hot-bin split and the last load must not
+        # leak up to a bin budget of duplicated rows into the workdir
+        # (the pre-pipeline code's per-bin try/finally, hoisted here)
+        import glob as _glob
+        import shutil as _shutil
+        for _b, w, _h, _n in mapped:
+            for stale in _glob.glob(os.path.join(w.path, "hotbin_*")):
+                _shutil.rmtree(stale, ignore_errors=True)
+
+    # unmapped tail: flush the merge window, then the stable unmapped rows
+    if pending is not None:
         out.write(pending)
+        pending = None
+    uw = bin_writers[part.num_partitions - 1]
+    if uw.rows_written:
+        for t in iter_tables(uw.path, chunk_rows=chunk_rows):
+            out.write(t)
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,328 @@
+"""Pipelined per-bin realignment engine — transform pass 4's scheduler.
+
+The reference's indel realignment is its most expensive shuffle stage
+(AdamRDDFunctions.scala:109-183), and after PR 3 it was the one streaming
+pass still outside the executor discipline: bins ran strictly one at a
+time, host prep blocked the device, and small bins dispatched
+under-filled sweep batches.  This module is the pass-4 counterpart of
+``parallel/executor.py`` — a bounded three-stage software pipeline over
+the genome-ordered bin sequence:
+
+  stage A  **load + prep**: a worker pool loads bin i+1's Parquet (own +
+           halo) and runs the host group prep (pileups → targets →
+           columnar group packing, ``realigner.plan_realign``) while …
+  stage B  **sweep**: … bin i's sweep jobs sit in the cross-bin batcher.
+           Jobs from every in-flight bin bucket by their padded
+           ``(R, L, CL)`` shape on the canonical rung ladder
+           (``packing.shape_rung`` — the executor's ``row_bucket_ladder``
+           recurrence), so tiny bins no longer dispatch G=1 batches and
+           each kernel compiles a bounded shape set per run; dispatch is
+           asynchronous, so the device runs ahead while …
+  stage C  **finish + emit**: … bin i-1 takes the LOD gate, rewrites,
+           vectorized write-back, in-bin sort, and the sorted
+           merge-window emit — in strict genome order.
+
+The pipeline changes scheduling, never results: units emit in exactly the
+serial order (``ingest.pipelined`` preserves input order), sweep lanes are
+vmapped independently, and pad lanes replicate lane 0
+(``realigner.sweep_dispatch``), so output is byte-identical to the serial
+path at any depth — pinned by tests/test_realign_exec.py.
+
+Every decision and stage emits through :mod:`adam_tpu.obs` (the PR 3
+``executor_bucket_selected`` convention):
+
+* ``realign_plan_selected`` — the frozen plan with its canonicalized
+  ``inputs`` + ``input_digest`` (:func:`decide_realign_plan` is pure, so
+  the decision replays offline);
+* ``realign_bin`` — per-unit stage wall times
+  (load/prep/sweep/finish/emit), group/job counts;
+* ``realign_sweep_dispatch`` — per-dispatch bucket occupancy: padded
+  shape, jobs carried, padded lane count G, distinct units on board.
+
+On TPU backends the plan turns on sweep-input donation
+(``realigner._sweep_conv_many_donating``), reusing each batch's HBM for
+outputs instead of re-allocating per dispatch.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import threading
+import time
+from dataclasses import dataclass
+from typing import Callable, Dict, Iterable, Optional, Tuple
+
+import numpy as np
+import pyarrow as pa
+
+from .. import obs
+from ..realign import realigner as R
+
+#: env overrides (the transform CLI flags mirror these, docs/REALIGN_EXECUTOR.md)
+REALIGN_PIPELINE_ENV = "ADAM_TPU_REALIGN_PIPELINE"          # 0/off disables
+REALIGN_DEPTH_ENV = "ADAM_TPU_REALIGN_PIPELINE_DEPTH"
+REALIGN_DONATE_ENV = "ADAM_TPU_REALIGN_DONATE"              # 0/off disables
+
+#: default look-ahead: bin i+1 preps while bin i sweeps and bin i-1 emits
+DEFAULT_REALIGN_DEPTH = 2
+#: host RSS is bounded by depth x bin budget — cap runaway flag values
+MAX_REALIGN_DEPTH = 16
+
+
+def decide_realign_plan(*, n_bins: int, on_tpu: bool,
+                        pipeline: Optional[bool] = None,
+                        depth: Optional[int] = None,
+                        donate: Optional[bool] = None) -> dict:
+    """The pass-4 plan: one frozen decision per transform run.
+
+    PURE — the returned plan is a deterministic function of the keyword
+    inputs, which the ``realign_plan_selected`` event records in full
+    (``inputs`` + ``input_digest``), the same replayable-decision
+    contract as ``executor.decide_plan``.  Explicit ``pipeline`` /
+    ``depth`` / ``donate`` pin those knobs.
+    """
+    inputs = dict(n_bins=int(n_bins), on_tpu=bool(on_tpu),
+                  pipeline=None if pipeline is None else bool(pipeline),
+                  depth=None if depth is None else int(depth),
+                  donate=None if donate is None else bool(donate))
+    reasons = []
+    use = True if inputs["pipeline"] is None else inputs["pipeline"]
+    d = DEFAULT_REALIGN_DEPTH if inputs["depth"] is None else inputs["depth"]
+    if d > MAX_REALIGN_DEPTH:
+        d = MAX_REALIGN_DEPTH
+        reasons.append("depth-capped")
+    if d <= 0:
+        # an explicit depth <= 0 means OFF (the prefetch_depth=0
+        # convention), and the recorded reason says so — a silent floor
+        # to 1 would be invisible in the replayable plan
+        use = False
+        reasons.append("depth-off")
+    if not use:
+        d = 0
+        if "depth-off" not in reasons:
+            reasons.append("pipeline-off")
+    do_donate = bool(on_tpu) if inputs["donate"] is None \
+        else inputs["donate"]
+    digest = hashlib.sha256(
+        json.dumps(inputs, sort_keys=True).encode()).hexdigest()[:16]
+    return dict(pipeline_depth=int(d), donate=do_donate,
+                reason=";".join(reasons) or "default",
+                inputs=inputs, input_digest=digest)
+
+
+def resolve_realign_opts(opts: Optional[dict] = None) -> dict:
+    """CLI flags win; ``ADAM_TPU_REALIGN_*`` envs fill whatever the caller
+    left unset (the executor's flag/env convention)."""
+    out = dict(opts or {})
+    env = os.environ
+    if "pipeline" not in out and env.get(REALIGN_PIPELINE_ENV):
+        out["pipeline"] = env[REALIGN_PIPELINE_ENV] not in ("0", "off")
+    if "depth" not in out and env.get(REALIGN_DEPTH_ENV):
+        try:
+            out["depth"] = int(env[REALIGN_DEPTH_ENV])
+        except ValueError:
+            pass
+    if "donate" not in out and env.get(REALIGN_DONATE_ENV) in ("0", "off"):
+        out["donate"] = False
+    return out
+
+
+def emit_realign_plan(plan: dict) -> None:
+    """One ``realign_plan_selected`` event + counter per pass-4 start —
+    the pass-boundary discipline of ``StreamExecutor.begin_pass``."""
+    obs.registry().counter("realign_plans").inc()
+    obs.emit("realign_plan_selected",
+             pipeline_depth=plan["pipeline_depth"], donate=plan["donate"],
+             reason=plan["reason"], inputs=plan["inputs"],
+             input_digest=plan["input_digest"])
+
+
+class _ChunkResult:
+    """One dispatch's device results, converted to numpy exactly once
+    (the np conversion is the device sync point; members from several
+    units share it)."""
+
+    __slots__ = ("_dev", "_np")
+
+    def __init__(self, q_dev, o_dev):
+        self._dev = (q_dev, o_dev)
+        self._np = None
+
+    def arrays(self) -> Tuple[np.ndarray, np.ndarray]:
+        if self._np is None:
+            q, o = self._dev
+            self._np = (np.asarray(q), np.asarray(o))
+            self._dev = None          # release device buffers promptly
+        return self._np
+
+
+class CrossBinSweepBatcher:
+    """Shape-bucketed sweep-job queue across the pipeline's in-flight bins.
+
+    Jobs register from the prep workers (thread-safe); device dispatch
+    happens on the scheduler thread only.  Buckets key on the padded
+    ``(R, L, CL)`` job shape — realigner's canonical rungs — so jobs from
+    different bins share one vmapped dispatch; dispatch G pads to a power
+    of two with pad lanes replicating lane 0, so batch composition can
+    change scheduling and telemetry but never a byte of output.
+    """
+
+    def __init__(self, donate: bool = False):
+        self._donate = donate
+        self._lock = threading.Lock()
+        self._buckets: Dict[tuple, list] = {}     # shape -> [(uid, si, ji)]
+        self._states: Dict[tuple, list] = {}      # uid -> states
+        self._results: Dict[tuple, tuple] = {}    # (uid,si,ji) -> (chunk,g)
+        self._unit_shapes: Dict[tuple, set] = {}  # uid -> undispatched shapes
+        self._shapes_seen: set = set()            # (G, R, L, CL) sightings
+
+    # -- producer side (prep workers) --------------------------------------
+
+    def add_unit(self, uid: tuple, states: list) -> None:
+        """Register every (group, consensus) job of a prepared unit.
+        Called from the load+prep workers; never dispatches."""
+        with self._lock:
+            self._states[uid] = states
+            shapes = self._unit_shapes.setdefault(uid, set())
+            for si, st in enumerate(states):
+                for ji, job in enumerate(st.jobs):
+                    self._buckets.setdefault(job.shape, []).append(
+                        (uid, si, ji))
+                    shapes.add(job.shape)
+
+    # -- scheduler side (strict unit order) --------------------------------
+
+    def sweep_unit(self, uid: tuple) -> list:
+        """Dispatch every bucket still holding one of ``uid``'s jobs —
+        the WHOLE bucket, so jobs from bins prepped ahead ride along in
+        the same batches (that is the cross-bin amortization) — then
+        return ``uid``'s per-state result lists (numpy, job order)."""
+        while True:
+            with self._lock:
+                shape = next((s for s in self._unit_shapes.get(uid, ())
+                              if self._buckets.get(s)), None)
+                if shape is None:
+                    break
+                members = self._buckets.pop(shape)
+                for u, _, _ in members:
+                    self._unit_shapes.get(u, set()).discard(shape)
+            self._dispatch(shape, members)
+        states = self._states.pop(uid)
+        self._unit_shapes.pop(uid, None)
+        out = []
+        for si, st in enumerate(states):
+            out.append([self._take(uid, si, ji)
+                        for ji in range(len(st.jobs))])
+        return out
+
+    def _dispatch(self, shape: tuple, members: list) -> None:
+        Rr, L, CL = shape
+        g_max = R._sweep_g_max(Rr, L, CL)
+        for lo in range(0, len(members), g_max):
+            chunk = members[lo:lo + g_max]
+            pairs = [(self._states[u][si], self._states[u][si].jobs[ji])
+                     for u, si, ji in chunk]
+            q_dev, o_dev = R.sweep_dispatch(pairs, donate=self._donate)
+            cr = _ChunkResult(q_dev, o_dev)
+            for g, key in enumerate(chunk):
+                self._results[key] = (cr, g)
+            # the ACTUAL padded lane count, read off the dispatched
+            # result — not a re-derivation of sweep_dispatch's policy
+            G = int(q_dev.shape[0])
+            r = obs.registry()
+            r.counter("realign_sweep_dispatches").inc()
+            r.counter("realign_sweep_jobs").inc(len(chunk))
+            if (G, Rr, L, CL) not in self._shapes_seen:
+                self._shapes_seen.add((G, Rr, L, CL))
+                r.counter("realign_shapes").inc()
+            obs.emit("realign_sweep_dispatch", shape=[Rr, L, CL],
+                     jobs=len(chunk), g=G,
+                     units=len({u for u, _, _ in chunk}))
+
+    def _take(self, uid: tuple, si: int, ji: int):
+        cr, g = self._results.pop((uid, si, ji))
+        qs, os_ = cr.arrays()
+        return qs[g], os_[g]
+
+    @property
+    def n_shapes(self) -> int:
+        return len(self._shapes_seen)
+
+
+@dataclass
+class BinUnitDesc:
+    """One schedulable unit of pass 4: a whole mapped bin, or one
+    position sub-range of a hot (over-budget) bin."""
+    bin_id: int
+    uid: tuple                      # (sequence, sub-index): emit order
+    load: Callable[[], tuple]       # () -> (own_table, halo_table|None)
+    next_lo: int                    # merge-window cutoff of the NEXT unit
+
+
+class RealignEngine:
+    """Drives :class:`BinUnitDesc` units through the 3-stage pipeline.
+
+    ``run`` consumes units in order, with ``plan['pipeline_depth']`` prep
+    workers feeding a bounded in-order queue (``ingest.pipelined``), so
+    host RSS stays ~(depth + 2) x bin budget: depth + 1 queued prepared
+    units, one under prep, one being finished.  Depth 1 degrades to the
+    fully synchronous walk — same engine, same bytes.
+    """
+
+    def __init__(self, plan: dict):
+        self.plan = plan
+        self.depth = int(plan["pipeline_depth"])
+        self.batcher = CrossBinSweepBatcher(donate=bool(plan["donate"]))
+
+    def run(self, units: Iterable[BinUnitDesc],
+            emit: Callable[[pa.Table, int], None], sort: bool) -> int:
+        from ..ops.sort import sort_reads
+        from .ingest import pipelined
+
+        def prep(u: BinUnitDesc, _ctx):
+            # runs on pool workers: plain timers only — instrument's
+            # stage stack is shared across threads (the executor's
+            # feed-wait lesson), so stage() never runs here
+            t0 = time.perf_counter()
+            own, halo = u.load()
+            t1 = time.perf_counter()
+            combined = own if halo is None or halo.num_rows == 0 \
+                else pa.concat_tables([own, halo])
+            work = R.plan_realign(combined)
+            if work is not None:
+                self.batcher.add_unit(u.uid, work.states)
+            t2 = time.perf_counter()
+            return (u, own.num_rows, combined, work, t1 - t0, t2 - t1)
+
+        reg = obs.registry()
+        n_units = 0
+        for u, own_rows, combined, work, load_s, prep_s in pipelined(
+                units, prep, workers=self.depth, depth=self.depth + 1):
+            t2 = time.perf_counter()
+            if work is not None:
+                results = self.batcher.sweep_unit(u.uid)
+                t3 = time.perf_counter()
+                tbl = R.finish_realign(work, results)
+            else:
+                t3 = time.perf_counter()
+                tbl = combined
+            if tbl.num_rows != own_rows:      # drop the halo copies
+                tbl = tbl.slice(0, own_rows)
+            if sort:
+                tbl = sort_reads(tbl)
+            t4 = time.perf_counter()
+            emit(tbl, u.next_lo)
+            t5 = time.perf_counter()
+            n_units += 1
+            stage_s = dict(load=load_s, prep=prep_s, sweep=t3 - t2,
+                           finish=t4 - t3, emit=t5 - t4)
+            for name, s in stage_s.items():
+                reg.histogram("realign_stage_seconds",
+                              stage=name).observe(s)
+            obs.emit("realign_bin", bin=int(u.bin_id), rows=int(own_rows),
+                     groups=0 if work is None else len(work.states),
+                     jobs=0 if work is None else work.n_jobs,
+                     **{f"{k}_s": round(v, 6) for k, v in stage_s.items()})
+        return n_units

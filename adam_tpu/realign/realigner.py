@@ -37,8 +37,8 @@ import numpy as np
 import pyarrow as pa
 
 from .. import schema as S
-from ..packing import ReadBatch, _round_up, column_int64, pack_reads
-from ..util.mdtag import MdTag, cigar_to_string, parse_cigar
+from ..packing import ReadBatch, column_int64, pack_reads, shape_rung
+from ..util.mdtag import MdTag, cigar_to_string
 from .consensus import (Consensus, generate_alternate_consensus,
                         left_align_indel, num_alignment_blocks)
 from .targets import find_targets, map_reads_to_targets
@@ -196,12 +196,36 @@ def _sweep(reads_u8, quals, read_lens, cons_u8, cons_len):
     return _sweep_conv(reads_u8, quals, read_lens, cons_u8, cons_len)
 
 
-def _sweep_many(reads_b, quals_b, lens_b, cons_b, clen_b):
+@lru_cache(maxsize=1)
+def _sweep_conv_donating():
+    """Single-job counterpart of :func:`_sweep_conv_many_donating` —
+    buckets that dispatch exactly one job (rare shapes, tail chunks)
+    follow the same donation discipline as the batched path."""
+    return jax.jit(_sweep_conv_impl, donate_argnums=(0, 1, 2, 3))
+
+
+@lru_cache(maxsize=1)
+def _sweep_conv_many_donating():
+    """TPU variant of the batched conv sweep with its per-dispatch
+    operands donated, so the device reuses the arriving batch's HBM for
+    outputs/scratch instead of re-allocating every dispatch (PR 3's
+    donation discipline applied to the realign hot loop).  Off-TPU
+    donation buys nothing and XLA warns per call, so callers gate it
+    (realign_exec's plan sets donate only on TPU backends)."""
+    return jax.jit(jax.vmap(_sweep_conv_impl),
+                   donate_argnums=(0, 1, 2, 3, 4))
+
+
+def _sweep_many(reads_b, quals_b, lens_b, cons_b, clen_b,
+                donate: bool = False):
     """Batched sweep over one padded-shape bucket (G leading axis)."""
     if _sweep_backend() == "pallas":
         from .sweep_pallas import sweep_pallas_batch
         return sweep_pallas_batch(reads_b, quals_b, lens_b, cons_b, clen_b)
-    return _sweep_conv_many(reads_b, quals_b, lens_b, cons_b, clen_b)
+    fn = _sweep_conv_many_donating() if donate else _sweep_conv_many
+    return fn(jnp.asarray(reads_b), jnp.asarray(quals_b),
+              jnp.asarray(lens_b), jnp.asarray(cons_b),
+              jnp.asarray(clen_b))
 
 
 @dataclass
@@ -371,10 +395,15 @@ def _prepare_group(reads: List[_Read]) -> Optional[_GroupState]:
 
     original_quals = [_sum_mismatch_quality(r) for r in reads_to_clean]
 
-    # R and L pad to buckets so XLA compilations amortize across the many
-    # differently-sized groups (and so many groups share one batched sweep)
-    R = _round_up(len(reads_to_clean), 32)
-    L = _round_up(max(len(r.seq) for r in reads_to_clean), 32)
+    # R and L pad to the canonical geometric rung ladder (packing.
+    # shape_rung — the executor's row_bucket_ladder recurrence) so XLA
+    # compilations amortize across the many differently-sized groups, many
+    # groups share one batched sweep, and the whole run's sweep shape set
+    # stays bounded by the ladder (the cross-bin batcher in
+    # parallel/realign_exec.py buckets jobs from every in-flight bin by
+    # exactly these rungs)
+    R = shape_rung(len(reads_to_clean), 32)
+    L = shape_rung(max(len(r.seq) for r in reads_to_clean), 32)
     reads_u8 = np.zeros((R, L), np.uint8)
     quals_arr = np.zeros((R, L), np.int32)
     lens = np.zeros(R, np.int32)
@@ -390,7 +419,7 @@ def _prepare_group(reads: List[_Read]) -> Optional[_GroupState]:
             cons_seq = cons.insert_into_reference(ref, ref_start, ref_end)
         except ValueError:
             continue
-        CL = _round_up(max(len(cons_seq), L + 1), 64)
+        CL = shape_rung(max(len(cons_seq), L + 1), 64)
         cons_u8 = np.zeros(CL, np.uint8)
         cb = cons_seq.encode()
         cons_u8[:len(cb)] = np.frombuffer(cb, np.uint8)
@@ -464,7 +493,52 @@ def _sweep_g_max(R: int, L: int, CL: int) -> int:
     return 1 << (g.bit_length() - 1)
 
 
-def _sweep_groups(states: List[_GroupState]) -> List[Dict[int, _Read]]:
+def sweep_dispatch(pairs: List[Tuple[_GroupState, _SweepJob]],
+                   donate: bool = False):
+    """One device dispatch over same-shape (group, consensus) jobs.
+
+    ``pairs`` share ``job.shape == (R, L, CL)``.  Returns ``(qs, os_)``
+    DEVICE arrays with leading axis ``G >= len(pairs)`` — G pads to a
+    power of two so chunk shapes repeat across dispatches, and padded
+    lanes REPLICATE LANE 0 (they used to sweep a garbage consensus of
+    dummy length L+1: wasted MXU work that could poison a result if lane
+    indexing ever drifted; a replica computes something already being
+    computed and is discarded the same way).  Lanes are vmapped
+    independently, so each job's result is identical whatever else shares
+    the batch — the property the cross-bin batcher
+    (parallel/realign_exec.py) leans on for byte-identical scheduling.
+    """
+    R, L, CL = pairs[0][1].shape
+    if len(pairs) == 1:
+        st, job = pairs[0]
+        args = (jnp.asarray(st.reads_u8), jnp.asarray(st.quals_arr),
+                jnp.asarray(st.lens), jnp.asarray(job.cons_u8),
+                jnp.int32(job.cons_len))
+        if donate and _sweep_backend() == "conv":
+            q, o = _sweep_conv_donating()(*args)
+        else:
+            q, o = _sweep(*args)
+        return q[None], o[None]
+    G = 1 << (len(pairs) - 1).bit_length()
+    reads_b = np.zeros((G, R, L), np.uint8)
+    quals_b = np.zeros((G, R, L), np.int32)
+    lens_b = np.zeros((G, R), np.int32)
+    cons_b = np.zeros((G, CL), np.uint8)
+    clen_b = np.zeros(G, np.int32)
+    for g, (st, job) in enumerate(pairs):
+        reads_b[g] = st.reads_u8
+        quals_b[g] = st.quals_arr
+        lens_b[g] = st.lens
+        cons_b[g] = job.cons_u8
+        clen_b[g] = job.cons_len
+    for arr in (reads_b, quals_b, lens_b, cons_b, clen_b):
+        arr[len(pairs):] = arr[0]
+    return _sweep_many(reads_b, quals_b, lens_b, cons_b, clen_b,
+                       donate=donate)
+
+
+def _sweep_groups(states: List[_GroupState],
+                  donate: bool = False) -> List[Dict[int, _Read]]:
     """Sweep every (group, consensus) job, bucketed by padded shape so one
     vmapped dispatch covers many targets (VERDICT r1 #7: the per-target
     Python loop + per-consensus dispatch never scaled past fixture groups).
@@ -481,32 +555,10 @@ def _sweep_groups(states: List[_GroupState]) -> List[Dict[int, _Read]]:
         g_max = _sweep_g_max(R, L, CL)
         for lo in range(0, len(members), g_max):
             chunk = members[lo:lo + g_max]
-            G = 1 << (len(chunk) - 1).bit_length()
-            reads_b = np.zeros((G, R, L), np.uint8)
-            quals_b = np.zeros((G, R, L), np.int32)
-            lens_b = np.zeros((G, R), np.int32)
-            cons_b = np.zeros((G, CL), np.uint8)
-            clen_b = np.full(G, L + 1, np.int32)  # harmless dummy shape
-            for g, (si, ji) in enumerate(chunk):
-                st, job = states[si], states[si].jobs[ji]
-                reads_b[g] = st.reads_u8
-                quals_b[g] = st.quals_arr
-                lens_b[g] = st.lens
-                cons_b[g] = job.cons_u8
-                clen_b[g] = job.cons_len
-            if len(chunk) == 1:
-                q, o = _sweep(jnp.asarray(reads_b[0]),
-                              jnp.asarray(quals_b[0]),
-                              jnp.asarray(lens_b[0]),
-                              jnp.asarray(cons_b[0]),
-                              jnp.int32(int(clen_b[0])))
-                qs, os_ = np.asarray(q)[None], np.asarray(o)[None]
-            else:
-                q, o = _sweep_many(
-                    jnp.asarray(reads_b), jnp.asarray(quals_b),
-                    jnp.asarray(lens_b), jnp.asarray(cons_b),
-                    jnp.asarray(clen_b))
-                qs, os_ = np.asarray(q), np.asarray(o)
+            q, o = sweep_dispatch(
+                [(states[si], states[si].jobs[ji]) for si, ji in chunk],
+                donate=donate)
+            qs, os_ = np.asarray(q), np.asarray(o)
             for g, (si, ji) in enumerate(chunk):
                 results[(si, ji)] = (qs[g], os_[g])
 
@@ -517,18 +569,101 @@ def _sweep_groups(states: List[_GroupState]) -> List[Dict[int, _Read]]:
     return out
 
 
-def realign_indels(table: pa.Table, batch: Optional[ReadBatch] = None
-                   ) -> pa.Table:
-    """adamRealignIndels (AdamRDDFunctions.scala:109-112)."""
+@dataclass
+class _PrepContext:
+    """Host-side realignment context for one table: the target mapping
+    plus the packed columns group construction reads from."""
+    table: pa.Table
+    batch: ReadBatch
+    start: np.ndarray       # int64 [n] per-row alignment start
+    in_target: np.ndarray   # global row indices inside any target
+    sub_tgt: np.ndarray     # target id per in_target row
+
+    def groups(self):
+        """Yield per-target ``_Read`` lists, built columnar.
+
+        The per-read Python of the old path ([ord(c) - 33 ...] over every
+        qual string, a regex parse per cigar) is gone: quals slice out of
+        the packed ``ReadBatch.quals`` plane, cigars come from the packed
+        ``cigar_ops``/``cigar_lens`` columns, and mapq/start are the
+        batch's int columns — prep cost scales with the columns, not
+        reads x Python.  MD tags still parse per read (a genuine FSM),
+        but one vectorized regex pass gates whole groups first: a group
+        with no mismatching read can never produce ``reads_to_clean``
+        (consensuses only come from mismatching reads), so skipping it
+        before any ``MdTag.parse`` is output-identical.
+        """
+        import pyarrow.compute as pc
+
+        rows = self.in_target
+        sub = self.table.select(
+            ["sequence", "cigar", "mismatchingPositions", "qual"]
+        ).take(pa.array(rows))
+        seqs = sub.column("sequence").to_pylist()
+        mds = sub.column("mismatchingPositions").to_pylist()
+        cig_null = pc.is_null(sub.column("cigar")).combine_chunks() \
+            .to_numpy(zero_copy_only=False)
+        qlens = pc.fill_null(pc.binary_length(sub.column("qual")), 0) \
+            .combine_chunks().to_numpy(zero_copy_only=False) \
+            .astype(np.int64)
+        # a mismatch is a letter directly after a digit run (deleted
+        # bases follow '^'), so one regex pass marks mismatching reads
+        has_mm = pc.fill_null(pc.match_substring_regex(
+            sub.column("mismatchingPositions"), "[0-9][A-Za-z]"), False) \
+            .combine_chunks().to_numpy(zero_copy_only=False)
+        quals8 = self.batch.quals
+        ops8 = self.batch.cigar_ops
+        lens32 = self.batch.cigar_lens
+        nops = self.batch.n_cigar
+        mapq = np.maximum(np.asarray(self.batch.mapq), 0)
+        start = self.start
+
+        # group rows by target via one stable argsort + slice bounds — a
+        # per-target masked scan would be O(targets x reads) at genome
+        # scale
+        order = np.argsort(self.sub_tgt, kind="stable")
+        sorted_t = self.sub_tgt[order]
+        bounds = np.flatnonzero(
+            np.r_[True, sorted_t[1:] != sorted_t[:-1], True])
+        for bi in range(len(bounds) - 1):
+            sub_rows = order[bounds[bi]:bounds[bi + 1]]
+            if not has_mm[sub_rows].any():
+                continue
+            group: List[_Read] = []
+            for i in sub_rows:
+                i = int(i)
+                row = int(rows[i])
+                seq = seqs[i]
+                if seq is None or cig_null[i]:
+                    continue
+                md_str = mds[i]
+                md = MdTag.parse(md_str, int(start[row])) \
+                    if md_str is not None else None
+                k = int(nops[row])
+                cigar = [(int(lens32[row, j]), S.CIGAR_OPS[ops8[row, j]])
+                         for j in range(k)]
+                group.append(_Read(
+                    row, seq, quals8[row, :qlens[i]].astype(np.int32),
+                    int(start[row]), int(mapq[row]), cigar, md, md_str))
+            if group:
+                yield group
+
+
+def _prep_context(table: pa.Table,
+                  batch: Optional[ReadBatch]) -> Optional[_PrepContext]:
+    """Targets + read→target mapping; ``None`` when nothing can realign
+    (realign_indels then returns the table unchanged)."""
     from ..ops.pileup import reads_to_pileups
     n = table.num_rows
-    if batch is None:
+    if batch is None or batch.quals is None or batch.cigar_ops is None:
+        # group prep reads the packed qual/cigar planes — re-pack when the
+        # caller's batch was projected without them
         batch = pack_reads(table)
 
     pileups = reads_to_pileups(table, batch)
     targets = find_targets(pileups)
     if len(targets) == 0:
-        return table
+        return None
 
     from ..ops import cigar as C
     flags = np.asarray(batch.flags[:n], np.int64)
@@ -540,11 +675,102 @@ def realign_indels(table: pa.Table, batch: Optional[ReadBatch] = None
     mapped = (flags & S.FLAG_UNMAPPED) == 0
     tgt = map_reads_to_targets(refid, start, end.astype(np.int64), mapped,
                                targets)
-
     # only rows inside targets are touched — gather just those
     in_target = np.flatnonzero(tgt >= 0)
-    sub = table.select(["sequence", "cigar", "mismatchingPositions", "qual",
-                        "mapq"]).take(pa.array(in_target)).to_pydict()
+    if len(in_target) == 0:
+        return None
+    return _PrepContext(table, batch, start, in_target, tgt[in_target])
+
+
+@dataclass
+class RealignWork:
+    """One table's host-prepared realignment: everything up to — but not
+    including — the device sweeps.  ``parallel/realign_exec.py`` schedules
+    the sweep jobs of many in-flight bins together through this seam;
+    :func:`realign_indels` drives the same states serially."""
+    table: pa.Table
+    states: List[_GroupState]
+
+    @property
+    def n_jobs(self) -> int:
+        return sum(len(st.jobs) for st in self.states)
+
+
+def plan_realign(table: pa.Table, batch: Optional[ReadBatch] = None
+                 ) -> Optional[RealignWork]:
+    """Host-side phases of :func:`realign_indels` (pileups, targets,
+    columnar group prep, packed states); ``None`` when the table has
+    nothing to realign."""
+    ctx = _prep_context(table, batch)
+    if ctx is None:
+        return None
+    states = []
+    for group in ctx.groups():
+        st = _prepare_group(group)
+        if st is not None:
+            states.append(st)
+    return RealignWork(table, states) if states else None
+
+
+def finish_realign(work: RealignWork,
+                   results: List[List[Tuple[np.ndarray, np.ndarray]]]
+                   ) -> pa.Table:
+    """Apply sweep results (one ``[(q, o)]`` list per state, job order)
+    to the planned table: LOD gate, rewrites, vectorized write-back."""
+    updates: Dict[int, _Read] = {}
+    for st, res in zip(work.states, results):
+        updates.update(_finish_group(st, res))
+    return apply_updates(work.table, updates)
+
+
+def apply_updates(table: pa.Table, updates: Dict[int, _Read]) -> pa.Table:
+    """Scatter accepted rewrites into the table.
+
+    O(changed) host work plus one Arrow ``take`` per column — replacing
+    the old four ``.tolist()`` + whole-table Python loops, which scaled
+    O(total rows) even when a handful of reads moved.
+    """
+    if not updates:
+        return table
+    rows = np.sort(np.fromiter(updates, np.int64, len(updates)))
+    reads = [updates[int(r)] for r in rows]
+    n = table.num_rows
+
+    def set_int(t, name, vals, typ):
+        col = column_int64(t, name)          # nulls -> the old -1 sentinel
+        col[rows] = vals
+        arr = pa.array(col, typ, mask=(col == -1))
+        return t.set_column(t.column_names.index(name), name, arr)
+
+    def set_str(t, name, new_vals):
+        ca = t.column(name).combine_chunks()
+        chunks = ca.chunks if isinstance(ca, pa.ChunkedArray) else [ca]
+        merged = pa.chunked_array(
+            [*chunks, pa.array(new_vals, type=ca.type)], type=ca.type)
+        idx = np.arange(n, dtype=np.int64)
+        idx[rows] = n + np.arange(len(rows), dtype=np.int64)
+        return t.set_column(t.column_names.index(name), name,
+                            merged.take(pa.array(idx)))
+
+    table = set_int(table, "start",
+                    np.fromiter((r.start for r in reads), np.int64,
+                                len(reads)), pa.int64())
+    table = set_int(table, "mapq",
+                    np.fromiter((r.mapq for r in reads), np.int64,
+                                len(reads)), pa.int32())
+    table = set_str(table, "cigar",
+                    [cigar_to_string(r.cigar) for r in reads])
+    table = set_str(table, "mismatchingPositions",
+                    [r.md_str for r in reads])
+    return table
+
+
+def realign_indels(table: pa.Table, batch: Optional[ReadBatch] = None
+                   ) -> pa.Table:
+    """adamRealignIndels (AdamRDDFunctions.scala:109-112)."""
+    ctx = _prep_context(table, batch)
+    if ctx is None:
+        return table
 
     # prepare -> sweep -> finish in slabs of groups, so host memory stays
     # O(slab) — a whole-genome run has ~1M targets and holding every
@@ -557,57 +783,14 @@ def realign_indels(table: pa.Table, batch: Optional[ReadBatch] = None
             updates.update(upd)
         states.clear()
 
-    # group rows by target via one stable argsort + slice bounds — a
-    # per-target masked scan would be O(targets x reads) at genome scale
-    sub_tgt = tgt[in_target]
-    order = np.argsort(sub_tgt, kind="stable")
-    sorted_t = sub_tgt[order]
-    bounds = np.flatnonzero(
-        np.r_[True, sorted_t[1:] != sorted_t[:-1], True])
-    for bi in range(len(bounds) - 1):
-        sub_rows = order[bounds[bi]:bounds[bi + 1]]
-        group = []
-        for i in sub_rows:
-            row = int(in_target[i])
-            if sub["sequence"][i] is None or sub["cigar"][i] is None:
-                continue
-            md_str = sub["mismatchingPositions"][i]
-            md = MdTag.parse(md_str, int(start[row])) \
-                if md_str is not None else None
-            group.append(_Read(
-                row, sub["sequence"][i],
-                [ord(c) - 33 for c in (sub["qual"][i] or "")],
-                int(start[row]), int(sub["mapq"][i] or 0),
-                parse_cigar(sub["cigar"][i]), md, md_str))
-        if group:
-            state = _prepare_group(group)
-            if state is not None:
-                states.append(state)
+    for group in ctx.groups():
+        state = _prepare_group(group)
+        if state is not None:
+            states.append(state)
         if len(states) >= _GROUP_SLAB:
             flush()
     flush()
 
     if not updates:
         return table
-
-    new_start = column_int64(table, "start").tolist()
-    new_mapq = column_int64(table, "mapq").tolist()
-    new_cigar = table.column("cigar").to_pylist()
-    new_md = table.column("mismatchingPositions").to_pylist()
-    for row, r in updates.items():
-        new_start[row] = r.start
-        new_mapq[row] = r.mapq
-        new_cigar[row] = cigar_to_string(r.cigar)
-        new_md[row] = r.md_str
-
-    def set_col(t, name, values, typ):
-        idx = t.column_names.index(name)
-        vals = [None if v == -1 and typ != pa.string() else v
-                for v in values] if typ != pa.string() else values
-        return t.set_column(idx, name, pa.array(vals, typ))
-
-    table = set_col(table, "start", new_start, pa.int64())
-    table = set_col(table, "mapq", new_mapq, pa.int32())
-    table = set_col(table, "cigar", new_cigar, pa.string())
-    table = set_col(table, "mismatchingPositions", new_md, pa.string())
-    return table
+    return apply_updates(table, updates)

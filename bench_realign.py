@@ -1,10 +1,20 @@
 """Realignment throughput on a synthetic many-target chromosome.
 
-Evidence for VERDICT r1 #7's done-gate: realign wall time on a synthetic
-1000-target chromosome within 2x of the markdup stage over the same reads.
-The batched sweep (realigner._sweep_groups) buckets every
-(target, consensus) job by padded shape and sweeps many targets per
-vmapped MXU dispatch, so the compile count stays O(#shapes), not O(#targets).
+Two measurements:
+
+1. The single-shot batched sweep (realigner._sweep_groups) against the
+   markdup stage over the same reads — VERDICT r1 #7's done-gate (realign
+   within 2x of markdup on 1000 synthetic targets).
+2. The pass-4 pipeline (parallel/realign_exec.py): the full multi-bin
+   streamed transform with realignment run twice — serial
+   (``realign_opts={'pipeline': False}``) and pipelined — with the
+   pipelined run's per-unit stage breakdown (load / prep / sweep /
+   finish / emit wall) pulled from the ``realign_stage_seconds``
+   histograms (the serial walk is monolithic per bin — it reports its
+   p4 wall only) and the frozen realign plan stamped into the artifact
+   the way bench.py stamps executor plans.  The pipelined p4 wall must
+   beat serial by >= 1.3x on the CPU backend from I/O+prep overlap
+   alone (the PR 4 acceptance gate).
 
 Prints one JSON line per stage.  Not run by the driver (bench.py stays the
 single-line contract); run manually: ``python bench_realign.py [n_targets]``.
@@ -14,22 +24,39 @@ from __future__ import annotations
 
 import io
 import json
+import shutil
 import sys
+import tempfile
 import time
 
 
-def main() -> None:
-    from adam_tpu.platform import honor_platform_env
-    honor_platform_env()  # JAX_PLATFORMS=cpu must beat the axon plugin
+def _stage_breakdown() -> dict:
+    """Sum of each realign pipeline stage's wall from the obs registry."""
+    from adam_tpu import obs
 
-    n_targets = int(sys.argv[1]) if len(sys.argv) > 1 else 1000
-    sys.path.insert(0, "tests")
-    from _synth_realign import synth_sam
+    snap = obs.registry().snapshot()
+    out = {}
+    for key, h in snap.get("histograms", {}).items():
+        if key.startswith("realign_stage_seconds{stage="):
+            stage = key[len("realign_stage_seconds{stage="):-1]
+            out[stage] = round(h["sum"], 3)
+    return out
 
+
+def _p4_wall() -> float:
+    from adam_tpu import obs
+
+    snap = obs.registry().snapshot()
+    h = snap.get("histograms", {}).get("stage_seconds{stage=p4-bins}")
+    return round(h["sum"], 3) if h else 0.0
+
+
+def bench_single_shot(n_targets: int) -> None:
     from adam_tpu.io.sam import read_sam
     from adam_tpu.ops.markdup import mark_duplicates
     from adam_tpu.packing import pack_reads
     from adam_tpu.realign.realigner import realign_indels
+    from tests._synth_realign import synth_sam
 
     text = synth_sam(n_targets, reads_per_target=20, seed=0)
     table, _, _ = read_sam(io.StringIO(text))
@@ -54,6 +81,84 @@ def main() -> None:
     print(json.dumps({"metric": "realign_vs_markdup", "unit": "ratio",
                       "value": round(t_realign / t_markdup, 2),
                       "reads_realigned": changed}))
+
+
+def bench_pipeline(n_targets: int, n_bins: int = 8) -> None:
+    from adam_tpu import obs
+    from adam_tpu.instrument import report
+    from adam_tpu.parallel.mesh import make_mesh
+    from adam_tpu.parallel.pipeline import streaming_transform
+    from adam_tpu.parallel.realign_exec import (decide_realign_plan,
+                                                resolve_realign_opts)
+    from adam_tpu.platform import is_tpu_backend
+    from tests._synth_realign import synth_sam
+
+    workroot = tempfile.mkdtemp(prefix="bench_realign_")
+    try:
+        src = f"{workroot}/synth.sam"
+        with open(src, "w") as f:
+            f.write(synth_sam(n_targets, reads_per_target=12, seed=0,
+                              tail_reads=4))
+
+        # warm the XLA compile caches (the sweep shapes are canonical
+        # rungs, so a small run compiles what the timed runs will use) —
+        # otherwise whichever mode runs first eats the compiles and the
+        # comparison measures compilation, not scheduling
+        warm_src = f"{workroot}/warm.sam"
+        with open(warm_src, "w") as f:
+            f.write(synth_sam(max(n_targets // 8, 8), reads_per_target=12,
+                              seed=0, tail_reads=4))
+        streaming_transform(
+            warm_src, f"{workroot}/out_warm", realign=True, sort=True,
+            workdir=f"{workroot}/wk_warm", mesh=make_mesh(),
+            chunk_rows=1 << 16, n_bins=n_bins)
+
+        walls: dict = {}
+        for mode, opts in (("serial", {"pipeline": False}),
+                           ("pipelined", {})):
+            obs.reset_all()
+            report().reset()
+            t0 = time.perf_counter()
+            streaming_transform(
+                src, f"{workroot}/out_{mode}", realign=True, sort=True,
+                workdir=f"{workroot}/wk_{mode}", mesh=make_mesh(),
+                chunk_rows=1 << 16, n_bins=n_bins, realign_opts=opts)
+            wall = time.perf_counter() - t0
+            p4 = _p4_wall() or wall
+            walls[mode] = p4
+            line = {"metric": "realign_p4_wall_s", "mode": mode,
+                    "value": round(p4, 3), "total_wall_s": round(wall, 3),
+                    "n_targets": n_targets, "n_bins": n_bins}
+            stages = _stage_breakdown()
+            if stages:      # engine-only histograms; serial is monolithic
+                line["stages"] = stages
+            print(json.dumps(line))
+
+        # the frozen plan the product runs with, stamped like bench.py's
+        # executor plans (decide_realign_plan is pure + replayable)
+        plan = decide_realign_plan(
+            n_bins=n_bins + 1, on_tpu=is_tpu_backend(),
+            **resolve_realign_opts(None))
+        print(json.dumps({
+            "metric": "realign_pipeline_speedup", "unit": "ratio",
+            "value": round(walls["serial"] / max(walls["pipelined"], 1e-9),
+                           3),
+            "target": 1.3,
+            "realign_plan": {
+                "pipeline_depth": plan["pipeline_depth"],
+                "donate": plan["donate"], "reason": plan["reason"],
+                "input_digest": plan["input_digest"]}}))
+    finally:
+        shutil.rmtree(workroot, ignore_errors=True)
+
+
+def main() -> None:
+    from adam_tpu.platform import honor_platform_env
+    honor_platform_env()  # JAX_PLATFORMS=cpu must beat the axon plugin
+
+    n_targets = int(sys.argv[1]) if len(sys.argv) > 1 else 1000
+    bench_single_shot(n_targets)
+    bench_pipeline(max(n_targets // 2, 64))
 
 
 if __name__ == "__main__":

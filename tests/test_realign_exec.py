@@ -1,0 +1,334 @@
+"""The pipelined per-bin realignment engine (parallel/realign_exec.py):
+plan purity, byte-identity of the pipelined pass 4 at any depth vs the
+serial walk AND the in-memory stages, preserved merge-window emit order,
+cross-bin sweep batching with its bounded compiled-shape set, and the
+vectorized write-back."""
+
+from __future__ import annotations
+
+import json
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from adam_tpu import obs
+from adam_tpu.io.dispatch import load_reads
+from adam_tpu.io.parquet import load_table
+from adam_tpu.parallel.mesh import make_mesh
+from adam_tpu.parallel.realign_exec import (DEFAULT_REALIGN_DEPTH,
+                                            MAX_REALIGN_DEPTH,
+                                            CrossBinSweepBatcher,
+                                            decide_realign_plan,
+                                            resolve_realign_opts)
+from tests._synth_realign import synth_sam
+
+
+# ---------------------------------------------------------------------------
+# the plan (pure decisions, env resolution)
+# ---------------------------------------------------------------------------
+
+class TestDecideRealignPlan:
+    def test_deterministic_and_replayable(self):
+        kw = dict(n_bins=9, on_tpu=True, depth=3)
+        a, b = decide_realign_plan(**kw), decide_realign_plan(**kw)
+        assert a == b
+        # replaying from the RECORDED inputs reproduces the plan —
+        # the executor_bucket_selected contract
+        c = decide_realign_plan(**a["inputs"])
+        assert c["pipeline_depth"] == a["pipeline_depth"]
+        assert c["donate"] == a["donate"]
+        assert c["input_digest"] == a["input_digest"]
+
+    def test_defaults(self):
+        p = decide_realign_plan(n_bins=4, on_tpu=False)
+        assert p["pipeline_depth"] == DEFAULT_REALIGN_DEPTH
+        assert p["donate"] is False          # donation is TPU-only
+        assert p["reason"] == "default"
+        assert decide_realign_plan(n_bins=4, on_tpu=True)["donate"] is True
+
+    def test_pipeline_off_and_depth_cap(self):
+        off = decide_realign_plan(n_bins=4, on_tpu=False, pipeline=False)
+        assert off["pipeline_depth"] == 0
+        assert "pipeline-off" in off["reason"]
+        hi = decide_realign_plan(n_bins=4, on_tpu=False, depth=999)
+        assert hi["pipeline_depth"] == MAX_REALIGN_DEPTH
+        assert "depth-capped" in hi["reason"]
+        # explicit depth 0 means OFF, and the replayable reason says so
+        zero = decide_realign_plan(n_bins=4, on_tpu=False, depth=0)
+        assert zero["pipeline_depth"] == 0
+        assert "depth-off" in zero["reason"]
+
+    def test_env_resolution(self, monkeypatch):
+        monkeypatch.setenv("ADAM_TPU_REALIGN_PIPELINE", "0")
+        monkeypatch.setenv("ADAM_TPU_REALIGN_PIPELINE_DEPTH", "5")
+        monkeypatch.setenv("ADAM_TPU_REALIGN_DONATE", "0")
+        opts = resolve_realign_opts()
+        assert opts == {"pipeline": False, "depth": 5, "donate": False}
+        # explicit caller opts beat the env (the flag/env convention)
+        assert resolve_realign_opts({"pipeline": True})["pipeline"] is True
+
+
+# ---------------------------------------------------------------------------
+# byte-identity: pipelined == serial == in-memory, at any depth
+# ---------------------------------------------------------------------------
+
+def _synth_src(tmp_path, n_targets=6, seed=11, tail_reads=6):
+    text = synth_sam(n_targets, 10, seed=seed, tail_reads=tail_reads)
+    src = tmp_path / "synth.sam"
+    src.write_text(text)
+    return str(src)
+
+
+def _run(src, out, n_bins=3, realign_opts=None, chunk_rows=97, **kw):
+    from adam_tpu.parallel.pipeline import streaming_transform
+    return streaming_transform(
+        src, str(out), realign=True, sort=True,
+        workdir=str(out) + ".wk", mesh=make_mesh(8),
+        chunk_rows=chunk_rows, n_bins=n_bins,
+        realign_opts=realign_opts, **kw)
+
+
+COLS = ("readName", "flags", "start", "cigar", "mismatchingPositions",
+        "qual", "mapq")
+
+
+def test_pipelined_depths_byte_identical_and_match_inmemory(tmp_path):
+    """The tentpole pin: pass 4 pipelined at depth 1 and depth 4, and the
+    serial (pipeline-off) walk, all produce byte-identical output — and
+    that output equals the in-memory realign+sort stages (so the merge
+    window's emit order survives the pipeline)."""
+    from adam_tpu.ops.sort import sort_reads
+    from adam_tpu.realign.realigner import realign_indels
+
+    src = _synth_src(tmp_path)
+    table, _, _ = load_reads(src)
+    want = sort_reads(realign_indels(table))
+
+    outs = {}
+    for name, opts in (("serial", {"pipeline": False}),
+                       ("depth1", {"depth": 1}),
+                       ("depth4", {"depth": 4})):
+        n = _run(src, tmp_path / name, realign_opts=opts)
+        outs[name] = load_table(str(tmp_path / name))
+        assert n == table.num_rows
+    assert outs["serial"].equals(outs["depth1"])
+    assert outs["serial"].equals(outs["depth4"])
+    for c in COLS:
+        assert outs["depth4"].column(c).to_pylist() == \
+            want.column(c).to_pylist(), c
+
+    # emit order: mapped rows leave the merge window globally
+    # position-sorted
+    got = outs["depth4"]
+    from adam_tpu import schema as S
+    from adam_tpu.packing import column_int64
+    flags = column_int64(got, "flags", 0)
+    mapped = (flags & S.FLAG_UNMAPPED) == 0
+    refid = column_int64(got, "referenceId")[mapped]
+    start = column_int64(got, "start")[mapped]
+    key = refid * (1 << 40) + start
+    assert bool(np.all(key[:-1] <= key[1:]))
+
+
+def test_hot_bin_spill_cleaned_on_abort(tmp_path, monkeypatch):
+    """An exception downstream of a hot-bin split must not leak the
+    hotbin_* sub-range spill into the workdir (the pre-pipeline code's
+    per-bin try/finally guarantee, now hoisted to _emit_bins)."""
+    import glob
+
+    boom = RuntimeError("injected emit crash")
+    monkeypatch.setattr("adam_tpu.ops.sort.sort_reads",
+                        lambda tbl: (_ for _ in ()).throw(boom))
+    src = _synth_src(tmp_path, n_targets=6)
+    # depth 1 = synchronous: unit 2's loader provably never runs, so
+    # without the _emit_bins cleanup its sub-range spill WOULD leak
+    with pytest.raises(RuntimeError, match="injected emit crash"):
+        _run(src, tmp_path / "out", n_bins=1, max_bin_rows=60,
+             realign_opts={"depth": 1})
+    assert not glob.glob(str(tmp_path / "out.wk" / "bin-*" / "hotbin_*"))
+
+
+def test_pipelined_hot_bin_split_matches_serial(tmp_path):
+    """A tiny max_bin_rows forces the quantile sub-range split: the
+    pipelined engine must process the same units (split I/O on the reader
+    thread, loaders on the pool) byte-identically."""
+    src = _synth_src(tmp_path, n_targets=6)
+    _run(src, tmp_path / "ser", n_bins=1, max_bin_rows=60,
+         realign_opts={"pipeline": False})
+    _run(src, tmp_path / "pipe", n_bins=1, max_bin_rows=60,
+         realign_opts={"depth": 3})
+    assert load_table(str(tmp_path / "ser")).equals(
+        load_table(str(tmp_path / "pipe")))
+
+
+# ---------------------------------------------------------------------------
+# cross-bin sweep batching
+# ---------------------------------------------------------------------------
+
+def _states_for(src_text):
+    import io as _io
+
+    from adam_tpu.io.sam import read_sam
+    from adam_tpu.realign.realigner import plan_realign
+
+    table, _, _ = read_sam(_io.StringIO(src_text))
+    work = plan_realign(table)
+    assert work is not None
+    return table, work
+
+
+def test_cross_bin_batcher_merges_units_and_matches_serial(tmp_path):
+    """Jobs from several registered units share dispatches (the whole
+    bucket goes when the head unit sweeps), and every unit's results are
+    byte-identical to the serial per-unit sweep."""
+    from adam_tpu.realign import realigner as R
+
+    works = []
+    for seed in (0, 1, 2):
+        _, work = _states_for(synth_sam(2, 8, seed=seed))
+        works.append(work)
+
+    # batched G>1 dispatches on the CPU backend need the test override
+    R._BATCH_ON_CPU = True
+    try:
+        mpath = tmp_path / "ev.jsonl"
+        with obs.metrics_run(str(mpath), argv=["test"]):
+            batcher = CrossBinSweepBatcher()
+            for uid, work in enumerate(works):
+                batcher.add_unit((uid,), work.states)
+            got = {uid: batcher.sweep_unit((uid,))
+                   for uid in range(len(works))}
+        want = {}
+        for uid, work in enumerate(works):
+            res = _serial_results(work)
+            want[uid] = [[res[(si, ji)] for ji in range(len(st.jobs))]
+                         for si, st in enumerate(work.states)]
+    finally:
+        R._BATCH_ON_CPU = False
+
+    for uid in got:
+        for sres, wres in zip(got[uid], want[uid]):
+            for (q, o), (wq, wo) in zip(sres, wres):
+                np.testing.assert_array_equal(np.asarray(q), np.asarray(wq))
+                np.testing.assert_array_equal(np.asarray(o), np.asarray(wo))
+
+    # the first unit's sweep dispatched buckets carrying ALL units' jobs
+    events = [json.loads(ln) for ln in open(mpath) if ln.strip()]
+    dispatches = [e for e in events
+                  if e.get("event") == "realign_sweep_dispatch"]
+    assert dispatches
+    assert max(e["units"] for e in dispatches) >= 2
+    assert all(e["g"] >= e["jobs"] >= 1 for e in dispatches)
+
+
+def _serial_results(work):
+    """Per-job sweep results through the serial single-dispatch path."""
+    from adam_tpu.realign.realigner import sweep_dispatch
+
+    out = {}
+    for si, st in enumerate(work.states):
+        for ji, job in enumerate(st.jobs):
+            q, o = sweep_dispatch([(st, job)])
+            out[(si, ji)] = (np.asarray(q)[0], np.asarray(o)[0])
+    return out
+
+
+def test_compile_count_bounded_and_rerun_compiles_nothing(tmp_path):
+    """The canonical-rung pin (the test_executor.py pattern): a pipelined
+    multi-bin realign run keeps its dispatched sweep shape set small, and
+    an identical second run re-uses every compiled executable
+    (compile-miss counter delta == 0)."""
+    from adam_tpu.platform import install_compile_metrics
+
+    install_compile_metrics()
+    src = _synth_src(tmp_path)
+    _run(src, tmp_path / "out1")
+    snap = obs.registry().snapshot()
+    shapes = snap["counters"].get("realign_shapes", 0)
+    assert 1 <= shapes <= 8, shapes
+    assert snap["counters"].get("realign_sweep_jobs", 0) >= \
+        snap["counters"].get("realign_sweep_dispatches", 1)
+    compiles_after_run1 = snap["counters"].get("compile_count", 0)
+
+    _run(src, tmp_path / "out2")
+    snap2 = obs.registry().snapshot()
+    assert snap2["counters"].get("compile_count", 0) == \
+        compiles_after_run1
+    assert load_table(str(tmp_path / "out1")).equals(
+        load_table(str(tmp_path / "out2")))
+
+
+# ---------------------------------------------------------------------------
+# vectorized write-back
+# ---------------------------------------------------------------------------
+
+def test_apply_updates_scatters_and_preserves_nulls():
+    from adam_tpu.realign.realigner import _Read, apply_updates
+
+    table = pa.table({
+        "start": pa.array([5, None, 9, 12], pa.int64()),
+        "mapq": pa.array([60, 0, None, 37], pa.int32()),
+        "cigar": pa.array(["4M", None, "2M1D2M", "4M"], pa.string()),
+        "mismatchingPositions": pa.array(["4", "0", None, "4"],
+                                         pa.string()),
+        "readName": pa.array(["a", "b", "c", "d"], pa.string()),
+    })
+    upd = {2: _Read(2, "ACGT", np.array([30] * 4, np.int32), 20, 47,
+                    [(4, "M")], None, "2A1")}
+    got = apply_updates(table, upd)
+    assert got.column("start").to_pylist() == [5, None, 20, 12]
+    assert got.column("mapq").to_pylist() == [60, 0, 47, 37]
+    assert got.column("cigar").to_pylist() == ["4M", None, "4M", "4M"]
+    assert got.column("mismatchingPositions").to_pylist() == \
+        ["4", "0", "2A1", "4"]
+    assert got.column("readName").to_pylist() == ["a", "b", "c", "d"]
+    # untouched tables come back untouched
+    assert apply_updates(table, {}) is table
+
+
+# ---------------------------------------------------------------------------
+# CLI flags + metrics sidecar schema
+# ---------------------------------------------------------------------------
+
+def test_cli_flags_and_metrics_schema(resources, tmp_path):
+    """-realign_pipeline_depth / -no_realign_pipeline parse and run; the
+    -metrics sidecar carries the new realign events and validates against
+    tools/check_metrics.py (the documented-schema-cannot-drift pin)."""
+    import importlib.util
+    import pathlib
+
+    from adam_tpu.cli.main import main
+
+    src = str(resources / "small_realignment_targets.sam")
+    mpath = str(tmp_path / "run.jsonl")
+    rc = main(["transform", src, str(tmp_path / "out"),
+               "-realignIndels", "-sort_reads", "-stream",
+               "-stream_chunk_rows", "64", "-realign_pipeline_depth", "2",
+               "-metrics", mpath])
+    assert rc == 0
+
+    tools = pathlib.Path(__file__).parent.parent / "tools"
+    spec = importlib.util.spec_from_file_location(
+        "check_metrics", tools / "check_metrics.py")
+    check_metrics = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(check_metrics)
+    assert check_metrics.validate(mpath) == []
+
+    events = [json.loads(ln) for ln in open(mpath) if ln.strip()]
+    plans = [e for e in events
+             if e.get("event") == "realign_plan_selected"]
+    assert len(plans) == 1
+    assert plans[0]["pipeline_depth"] == 2
+    assert "input_digest" in plans[0]
+    bins = [e for e in events if e.get("event") == "realign_bin"]
+    assert bins and all(e["rows"] >= 0 and e["load_s"] >= 0
+                        for e in bins)
+
+    # the serial escape hatch parses too and matches
+    rc = main(["transform", src, str(tmp_path / "out_ser"),
+               "-realignIndels", "-sort_reads", "-stream",
+               "-stream_chunk_rows", "64", "-no_realign_pipeline"])
+    assert rc == 0
+    assert load_table(str(tmp_path / "out")).equals(
+        load_table(str(tmp_path / "out_ser")))

@@ -20,6 +20,15 @@ elastic worker sidecars).  Contract checked here:
   length bucket grows mid-pass);
 * ``executor_prefetch_stall_s`` events carry ``pass``, ``seconds``
   (>= 0) and ``inflight_peak <= depth`` (the feed's bound held);
+* ``realign_plan_selected`` events carry ``pipeline_depth`` (int >= 0),
+  boolean ``donate``, ``inputs`` (object) and a hex ``input_digest``
+  (the decision is pure and replayable, like the executor's);
+* ``realign_bin`` events carry ``bin``/``rows``/``groups``/``jobs``
+  (non-negative ints) and non-negative per-stage walls
+  (``load_s``/``prep_s``/``sweep_s``/``finish_s``/``emit_s``);
+* ``realign_sweep_dispatch`` events carry ``shape`` (three positive
+  ints), ``jobs >= 1``, padded lane count ``g >= jobs`` and
+  ``units >= 1`` (distinct bins sharing the dispatch);
 * the last line is the ``summary``: ``wall_seconds``, ``ok``, and a
   ``metrics`` snapshot whose counters/gauges are numeric and whose
   histograms are internally consistent (count == sum of bucket counts);
@@ -189,6 +198,55 @@ def validate(path: str) -> List[str]:
                     peak > depth:
                 err(i, f"executor prefetch inflight_peak {peak} exceeds "
                        f"its depth bound {depth}")
+        elif ev == "realign_plan_selected":
+            pd = d.get("pipeline_depth")
+            if not (isinstance(pd, int) and not isinstance(pd, bool)
+                    and pd >= 0):
+                err(i, "realign_plan_selected missing non-negative int "
+                       "'pipeline_depth'")
+            if not isinstance(d.get("donate"), bool):
+                err(i, "realign_plan_selected missing boolean 'donate'")
+            if not isinstance(d.get("inputs"), dict):
+                err(i, "realign_plan_selected missing 'inputs' object "
+                       "(decision must be replayable)")
+            dig = d.get("input_digest")
+            if not (isinstance(dig, str) and len(dig) >= 8 and
+                    all(c in "0123456789abcdef" for c in dig)):
+                err(i, "realign_plan_selected missing hex 'input_digest'")
+        elif ev == "realign_bin":
+            for field in ("bin", "rows", "groups", "jobs"):
+                v = d.get(field)
+                if not (isinstance(v, int) and not isinstance(v, bool)
+                        and v >= 0):
+                    err(i, f"realign_bin missing non-negative int "
+                           f"{field!r}")
+            for field in ("load_s", "prep_s", "sweep_s", "finish_s",
+                          "emit_s"):
+                v = d.get(field)
+                if not (_is_num(v) and v >= 0):
+                    err(i, f"realign_bin missing non-negative {field!r}")
+        elif ev == "realign_sweep_dispatch":
+            shape = d.get("shape")
+            if not (isinstance(shape, list) and len(shape) == 3 and
+                    all(isinstance(s, int) and not isinstance(s, bool)
+                        and s > 0 for s in shape)):
+                err(i, "realign_sweep_dispatch 'shape' is not three "
+                       "positive ints")
+            jobs = d.get("jobs")
+            g = d.get("g")
+            if not (isinstance(jobs, int) and not isinstance(jobs, bool)
+                    and jobs >= 1):
+                err(i, "realign_sweep_dispatch missing int 'jobs' >= 1")
+            if not (isinstance(g, int) and not isinstance(g, bool)
+                    and g >= 1):
+                err(i, "realign_sweep_dispatch missing int 'g' >= 1")
+            elif isinstance(jobs, int) and g < jobs:
+                err(i, f"realign_sweep_dispatch g {g} below its jobs "
+                       f"count {jobs} (lanes cannot undercount jobs)")
+            units = d.get("units")
+            if not (isinstance(units, int) and not isinstance(units, bool)
+                    and units >= 1):
+                err(i, "realign_sweep_dispatch missing int 'units' >= 1")
 
     if summaries:
         i, s = summaries[0]
