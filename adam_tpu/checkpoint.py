@@ -25,11 +25,53 @@ from typing import Callable, List, Optional
 
 import pyarrow as pa
 
+from .resilience import faults as _faults
+
 MANIFEST = "checkpoint.json"
 
 
 def _fingerprint(parts: List[str]) -> str:
     return hashlib.sha256("\x00".join(parts).encode()).hexdigest()[:16]
+
+
+def fsync_dir(path: str) -> None:
+    """fsync a directory so a just-renamed entry survives power loss
+    (rename durability needs the PARENT flushed, not just the file).
+    Best effort: some filesystems refuse directory fds."""
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+def atomic_write(path: str, payload: str, *,
+                 fault_site: Optional[str] = None) -> None:
+    """THE durable atomic text write: tmp in the target's directory,
+    flush + fsync the content, optional fault-injection hook on the
+    in-flight tmp (`checkpoint_write` truncation = a torn power-loss
+    write, as the next process observes it), atomic rename, parent-dir
+    fsync.  One implementation for every manifest writer (this module,
+    the streaming checkpoint in parallel/pipeline.py, the evidence
+    ledger) so the durability discipline cannot drift between copies.
+    A fired fault leaves the torn tmp behind — that IS the post-crash
+    disk state the resume paths must tolerate."""
+    parent = os.path.dirname(os.path.abspath(path)) or "."
+    os.makedirs(parent, exist_ok=True)
+    fd, tmp = tempfile.mkstemp(dir=parent, suffix=".tmp")
+    with os.fdopen(fd, "w") as f:
+        f.write(payload)
+        f.flush()
+        os.fsync(f.fileno())
+    if fault_site is not None:
+        _faults.fire(fault_site, path=tmp)
+    os.replace(tmp, path)
+    fsync_dir(parent)
 
 
 @dataclass
@@ -83,10 +125,8 @@ class CheckpointDir:
         payload = json.dumps({"fingerprint": _fingerprint(self.config),
                               "config": self.config,
                               "completed": self.completed})
-        fd, tmp = tempfile.mkstemp(dir=self.path, suffix=".manifest")
-        with os.fdopen(fd, "w") as f:
-            f.write(payload)
-        os.replace(tmp, os.path.join(self.path, MANIFEST))
+        atomic_write(os.path.join(self.path, MANIFEST), payload,
+                     fault_site="checkpoint_write")
 
     def latest(self) -> Optional[str]:
         return self.completed[-1] if self.completed else None

@@ -65,6 +65,13 @@ def add_executor_args(p: argparse.ArgumentParser) -> None:
                    help="freeze the executor plan at its defaults (no "
                         "pad-waste/link-rate re-decisions at pass "
                         "boundaries)")
+    p.add_argument("-retry_budget", type=int, default=None, metavar="N",
+                   help="attempts per chunk dispatch before degrading "
+                        "(transient device errors retry with backoff; "
+                        "RESOURCE_EXHAUSTED splits along the ladder; "
+                        "a persistent failure falls back to the CPU "
+                        "backend — default 3, ADAM_TPU_RETRY_* envs "
+                        "tune the rest; docs/RESILIENCE.md)")
 
 
 def executor_opts_from(args) -> dict:
@@ -77,6 +84,8 @@ def executor_opts_from(args) -> dict:
         opts["ladder_base"] = args.ladder_base
     if getattr(args, "no_autotune", False):
         opts["autotune"] = False
+    if getattr(args, "retry_budget", None) is not None:
+        opts["retry_budget"] = args.retry_budget
     return opts
 
 

@@ -60,6 +60,14 @@ def main(argv=None) -> int:
         p.add_argument("-metrics", default=None, metavar="PATH",
                        help="write run telemetry (JSONL manifest/events/"
                             "metrics snapshot) to PATH")
+        # ... and the fault-injection plane (docs/RESILIENCE.md): a
+        # seeded, replayable plan of which site fires on which
+        # occurrence with which fault.  Unset (the normal case) the
+        # plane is zero-overhead.
+        p.add_argument("-fault_plan", default=None, metavar="PATH",
+                       help="install a deterministic fault-injection "
+                            "plan (JSON; ADAM_TPU_FAULT_PLAN is the "
+                            "env fallback)")
         p.set_defaults(_cmd=cmd)
     args = parser.parse_args(argv)
     if not getattr(args, "_cmd", None):
@@ -73,12 +81,26 @@ def main(argv=None) -> int:
     # forced config — and never before: the gate must not init a backend)
     from ..platform import enable_compilation_cache
     enable_compilation_cache()
-    from ..errors import FormatError
-    from ..instrument import log_invocation
+    from ..errors import FormatError, malformed_summary, reset_malformed
+    from ..instrument import log_invocation, say
     from ..obs import metrics_path_from, metrics_run
+    from ..resilience import InjectedFault, faults
     full_argv = ["adam-tpu"] + list(argv if argv is not None
                                     else sys.argv[1:])
     log_invocation(full_argv)
+    # fault plane: flag wins, ADAM_TPU_FAULT_PLAN is the env fallback
+    # (how elastic workers and bench subprocesses inherit the plan);
+    # then the worker_proc site fires — a 'kill' rule takes this process
+    # down exactly like a preempted worker, before any pipeline state
+    try:
+        faults.install_from_env(getattr(args, "fault_plan", None))
+    except (OSError, ValueError) as e:
+        # a missing/malformed plan file is bad input, not a crash —
+        # same one-line clean exit every other bad input gets
+        print(f"adam-tpu {args.command}: bad fault plan: {e}",
+              file=sys.stderr)
+        return 2
+    reset_malformed()
     # the config fingerprint covers every parsed flag, so two runs with
     # the same manifest fingerprint really ran the same configuration
     config = {k: v for k, v in vars(args).items()
@@ -86,10 +108,20 @@ def main(argv=None) -> int:
     try:
         with metrics_run(metrics_path_from(args.metrics), argv=full_argv,
                          config=config, command=args.command):
-            return args._cmd.run(args) or 0
+            faults.fire("worker_proc")
+            rc = args._cmd.run(args) or 0
     except (FileNotFoundError, IsADirectoryError, FormatError) as e:
         print(f"adam-tpu {args.command}: {e}", file=sys.stderr)
         return 2
+    except InjectedFault as e:
+        # injected faults that exhaust every recovery path exit cleanly
+        # and typed — the chaos matrix's 'fails cleanly' arm
+        print(f"adam-tpu {args.command}: {e}", file=sys.stderr)
+        return 3
+    summary = malformed_summary()
+    if summary:
+        say(summary)
+    return rc
 
 
 if __name__ == "__main__":  # pragma: no cover

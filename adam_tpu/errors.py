@@ -6,6 +6,9 @@ message; genuine programming errors (arbitrary ValueError etc.) keep their
 tracebacks.
 """
 
+import os
+import threading
+
 
 class FormatError(ValueError):
     pass
@@ -20,18 +23,84 @@ class ValidationStringency:
     SILENT = "silent"
 
 
+#: LENIENT stderr warning cap: first K records warn individually, then
+#: one suppression notice, then silence — a badly corrupt BAM must not
+#: emit one stderr line per record (millions of lines on WGS inputs).
+#: Every drop still counts in the ``malformed_records`` obs counter and
+#: the end-of-run summary (:func:`malformed_summary`).
+MAX_MALFORMED_WARNINGS_ENV = "ADAM_TPU_MAX_MALFORMED_WARNINGS"
+DEFAULT_MAX_MALFORMED_WARNINGS = 10
+
+_MALFORMED_LOCK = threading.Lock()
+_MALFORMED = {"dropped": 0, "warned": 0}
+
+
+def _warning_cap() -> int:
+    try:
+        v = os.environ.get(MAX_MALFORMED_WARNINGS_ENV)
+        return int(v) if v else DEFAULT_MAX_MALFORMED_WARNINGS
+    except ValueError:
+        return DEFAULT_MAX_MALFORMED_WARNINGS
+
+
 def handle_malformed(stringency: str, message: str, cause=None) -> None:
     """Apply a stringency decision to one malformed input record: STRICT
-    raises :class:`FormatError`, LENIENT warns on stderr and drops the
-    record, SILENT drops it quietly.  An unrecognized level is a caller
-    bug and raises — falling through to silent would invert the strictness
-    the caller asked for."""
+    raises :class:`FormatError`, LENIENT warns on stderr (capped — see
+    :data:`MAX_MALFORMED_WARNINGS_ENV`) and drops the record, SILENT
+    drops it quietly.  Every dropped record counts in the
+    ``malformed_records`` obs counter either way.  An unrecognized level
+    is a caller bug and raises — falling through to silent would invert
+    the strictness the caller asked for."""
     if stringency == ValidationStringency.STRICT:
         raise FormatError(message) from cause
     if stringency == ValidationStringency.LENIENT:
+        from . import obs
+
+        obs.registry().counter("malformed_records").inc()
+        cap = _warning_cap()
+        with _MALFORMED_LOCK:
+            _MALFORMED["dropped"] += 1
+            warned = _MALFORMED["warned"]
+            if warned <= cap:
+                _MALFORMED["warned"] = warned + 1
         import sys
-        print(f"warning: {message} (dropped)", file=sys.stderr)
-    elif stringency != ValidationStringency.SILENT:
+        if warned < cap:
+            print(f"warning: {message} (dropped)", file=sys.stderr)
+        elif warned == cap:
+            print(f"warning: {cap} malformed-record warnings shown; "
+                  "suppressing the rest (drops still counted — see the "
+                  "end-of-run summary / malformed_records metric)",
+                  file=sys.stderr)
+    elif stringency == ValidationStringency.SILENT:
+        from . import obs
+
+        obs.registry().counter("malformed_records").inc()
+        with _MALFORMED_LOCK:
+            _MALFORMED["dropped"] += 1
+    else:
         raise ValueError(
             f"unknown validation stringency {stringency!r} "
             f"(want strict/lenient/silent)")
+
+
+def malformed_summary():
+    """One end-of-run line summarizing dropped records, or ``None`` when
+    nothing was dropped (the CLI prints it after every command)."""
+    with _MALFORMED_LOCK:
+        dropped = _MALFORMED["dropped"]
+        warned = min(_MALFORMED["warned"], _warning_cap())
+    if not dropped:
+        return None
+    suppressed = dropped - warned
+    line = f"dropped {dropped} malformed record(s) this run"
+    if suppressed > 0:
+        line += f" ({suppressed} warning(s) suppressed)"
+    return line
+
+
+def reset_malformed() -> None:
+    """Zero the per-run malformed-record accounting (test isolation and
+    the CLI's per-invocation scope)."""
+    with _MALFORMED_LOCK:
+        _MALFORMED["dropped"] = 0
+        _MALFORMED["warned"] = 0

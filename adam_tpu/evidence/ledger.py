@@ -163,17 +163,13 @@ def merge_docs(ours: dict, theirs: dict) -> dict:
 
 
 def save_doc(path: str, doc: dict) -> dict:
-    """Merge ``doc`` with whatever is on disk, then atomically replace.
+    """Merge ``doc`` with whatever is on disk, then atomically replace
+    (checkpoint.atomic_write: tmp + fsync + rename + parent-dir fsync).
     Returns the merged document actually written."""
+    from ..checkpoint import atomic_write
+
     merged = merge_docs(doc, load_doc(path))
-    parent = os.path.dirname(os.path.abspath(path))
-    os.makedirs(parent, exist_ok=True)
-    tmp = path + ".tmp"
-    with open(tmp, "w") as f:
-        json.dump(merged, f, indent=1, sort_keys=True)
-        f.flush()
-        os.fsync(f.fileno())
-    os.replace(tmp, path)
+    atomic_write(path, json.dumps(merged, indent=1, sort_keys=True))
     return merged
 
 

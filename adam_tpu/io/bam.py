@@ -372,6 +372,7 @@ def open_bam_stream(path, chunk_rows: int = 1 << 20,
 
     def gen():
         nonlocal buf, off
+        from ..resilience import faults as _faults
         rows = []
         exhausted = False
         while True:
@@ -389,6 +390,14 @@ def open_bam_stream(path, chunk_rows: int = 1 << 20,
                 else:
                     buf += piece
                 continue
+            # input_record injection site — fired once per PARSED record
+            # (never on buffer-refill iterations), so occurrence N means
+            # the Nth record regardless of chunking, matching read_bam.
+            # An 'error' fault raises InjectedFormatError: like a
+            # genuinely undecodable BAM record, it is fatal-typed (the
+            # binary decoder has no stringency drop path — that exists
+            # only for SAM text), so the CLI exits with one clean line.
+            _faults.fire("input_record")
             row, off = parsed
             rows.append(row)
             if len(rows) >= chunk_rows:
@@ -409,12 +418,16 @@ def read_bam(path) -> Tuple[pa.Table, SequenceDictionary,
     """Parse a BAM file into (reads table, seq dict, record groups)."""
     data = load_decompressed(path)
     seq_dict, rg_dict, off = parse_header(data, path)
+    from ..resilience import faults as _faults
     rows = []
     while off < len(data):
         parsed = _parse_record(data, off, seq_dict, rg_dict)
         if parsed is None:
             from ..errors import FormatError
             raise FormatError(f"{path}: truncated record at byte {off}")
+        # fired once per parsed record (occurrence N = Nth record), the
+        # same counting as the streaming decoder
+        _faults.fire("input_record")
         row, off = parsed
         rows.append(row)
     return _rows_to_table(rows), seq_dict, rg_dict

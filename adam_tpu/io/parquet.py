@@ -20,6 +20,7 @@ import pyarrow as pa
 import pyarrow.parquet as pq
 
 from .. import schema as S
+from ..resilience import faults as _faults
 
 #: the reference's LocusPredicate (predicates/LocusPredicate.scala:28-36):
 #: mapped ∧ primary ∧ !failedVendorQualityChecks ∧ !duplicateRead, expressed
@@ -141,11 +142,13 @@ class DatasetWriter:
                 chunk, self.row_group_bytes)
             self.row_group_bytes = None
         # split across part-file boundaries
+        part_path = None
         while chunk.num_rows:
+            part_path = os.path.join(
+                self.path, f"part-r-{self._part:05d}.parquet")
             if self._writer is None:
                 self._writer = pq.ParquetWriter(
-                    os.path.join(self.path,
-                                 f"part-r-{self._part:05d}.parquet"),
+                    part_path,
                     chunk.schema, compression=self.compression,
                     data_page_size=self.page_size,
                     use_dictionary=self.use_dictionary)
@@ -161,6 +164,12 @@ class DatasetWriter:
                 self._writer = None
                 self._part += 1
                 self._part_row_count = 0
+        if part_path is not None:
+            # spill_write injection site: a truncate/corrupt fault tears
+            # the just-flushed part and 'dies' — resume must treat the
+            # partial spill as absent or rebuild it (pinned by the
+            # crash-consistency tests)
+            _faults.fire("spill_write", path=part_path)
 
     def close(self) -> None:
         self.flush()

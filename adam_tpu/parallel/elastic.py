@@ -65,6 +65,8 @@ def supervise(argv_for: Callable[[int, str], Sequence[str]],
               env: Optional[dict] = None,
               log_dir: Optional[str] = None,
               on_incarnation: Optional[Callable[[Incarnation], None]] = None,
+              restart_backoff_s: float = 0.25,
+              restart_backoff_cap_s: float = 30.0,
               ) -> Incarnation:
     """Run the N-process job to success, restarting the WHOLE job on any
     worker death (nonzero exit or signal).
@@ -76,19 +78,38 @@ def supervise(argv_for: Callable[[int, str], Sequence[str]],
     RuntimeError after ``max_restarts`` failed relaunches.  Durable state
     (the checkpoint dir the argv points at) is the workers' own
     responsibility — that is what makes restart = resume.
+
+    Relaunches back off exponentially (``restart_backoff_s`` doubling to
+    ``restart_backoff_cap_s``, deterministic jitter): an immediate
+    relaunch of a deterministically-crashing job burns every restart in
+    seconds, and synchronized supervisor fleets would hammer a shared
+    coordinator.  The delay is recorded in each ``incarnation`` event.
     """
     from ..obs import (METRICS_ENV, emit, read_snapshot_file, registry,
                        snapshot_is_fleet_merged)
+    from ..resilience.faults import INCARNATION_ENV
+    from ..resilience.retry import backoff_delay
 
     last_fail = "never launched"
     log_dir = log_dir or tempfile.mkdtemp(prefix="elastic_logs_")
     os.makedirs(log_dir, exist_ok=True)
     for number in range(max_restarts + 1):
+        delay = 0.0
+        if number and restart_backoff_s > 0:
+            # key the jitter by THIS supervisor's pid: a fleet of
+            # supervisors restarting off one shared-coordinator flap
+            # must spread out, not compute one identical "jitter" and
+            # re-hammer it in lockstep (the delay each process actually
+            # used is recorded in its incarnation event)
+            delay = backoff_delay(f"elastic_restart:{os.getpid()}",
+                                  number, restart_backoff_s,
+                                  restart_backoff_cap_s)
+            time.sleep(delay)
         coordinator = f"127.0.0.1:{free_port()}"
         inc = Incarnation(number=number, coordinator=coordinator)
         registry().counter("elastic_incarnations").inc()
         emit("incarnation", number=number, coordinator=coordinator,
-             workers=num_processes)
+             workers=num_processes, restart_delay_s=round(delay, 6))
         for pid in range(num_processes):
             path = os.path.join(log_dir, f"inc{number}-worker{pid}.log")
             inc.logs.append(path)
@@ -103,6 +124,10 @@ def supervise(argv_for: Callable[[int, str], Sequence[str]],
             mpath = os.path.join(
                 log_dir, f"inc{number}-worker{pid}.metrics.jsonl")
             wenv[METRICS_ENV] = mpath
+            # fault-plan rules can scope to one incarnation (e.g. kill
+            # the first launch's workers, let the relaunch live) — the
+            # supervisor stamps which launch this worker belongs to
+            wenv[INCARNATION_ENV] = str(number)
             inc.metrics.append(mpath)
             with open(path, "w") as log:
                 inc.procs.append(subprocess.Popen(

@@ -57,6 +57,7 @@ from typing import Callable, Iterable, Iterator, Optional
 from .. import obs
 from ..packing import (LADDER_BASE_DEFAULT, len_bucket,  # noqa: F401
                        pad_rows_for, row_bucket_ladder)
+from ..resilience.retry import dispatch_with_retry, resolve_retry_policy
 
 #: env overrides (flags on the CLI commands mirror these)
 LADDER_BASE_ENV = "ADAM_TPU_EXECUTOR_LADDER_BASE"
@@ -232,6 +233,33 @@ class PassExecutor:
     def n_shapes(self) -> int:
         return len(self._shapes)
 
+    # -- resilient dispatch ------------------------------------------------
+
+    def dispatch(self, label: str, fn: Callable, *,
+                 split: Optional[Callable] = None,
+                 fallback: Optional[Callable] = None):
+        """Run one chunk's device dispatch under the scoped retry/
+        degradation ladder (resilience.retry): transient device errors
+        re-dispatch with backoff, ``RESOURCE_EXHAUSTED`` splits along
+        the ladder rungs via ``split``, a persistent failure degrades to
+        the caller's per-chunk CPU ``fallback``.  ``fn(attempt)`` — the
+        attempt number lets the caller re-transfer from host state and
+        confine buffer donation to attempt 1.  The ``device_dispatch``
+        fault-injection site fires inside each attempt."""
+        return dispatch_with_retry(
+            fn, site="device_dispatch",
+            label=f"{self.pass_name}:{label}",
+            policy=self._parent.retry_policy, split=split,
+            fallback=fallback)
+
+    def dispatch_put(self, label: str, fn: Callable):
+        """A host→device transfer under the same retry ladder (site
+        ``device_put``; no split/fallback — a put either lands or the
+        run fails cleanly after the budget)."""
+        return dispatch_with_retry(
+            fn, site="device_put", label=f"{self.pass_name}:{label}",
+            policy=self._parent.retry_policy)
+
     # -- device feed -------------------------------------------------------
 
     def feed(self, items: Iterable, put: Callable) -> Iterator:
@@ -284,7 +312,8 @@ class StreamExecutor:
                  ladder_base: Optional[float] = None,
                  prefetch_depth: Optional[int] = None,
                  donate: Optional[bool] = None,
-                 link_bytes_per_sec: Optional[float] = None):
+                 link_bytes_per_sec: Optional[float] = None,
+                 retry_budget: Optional[int] = None):
         self.mesh_size = getattr(mesh, "size", None) or int(mesh or 1)
         self.chunk_rows = int(chunk_rows)
         if on_tpu is None:
@@ -313,6 +342,9 @@ class StreamExecutor:
         if link_bytes_per_sec is None and self.autotune and self.on_tpu:
             link_bytes_per_sec = _ledger_link_rate()
         self.link_bytes_per_sec = link_bytes_per_sec
+        # one resolved retry/degradation policy per run scope
+        # (-retry_budget flag / ADAM_TPU_RETRY_* envs)
+        self.retry_policy = resolve_retry_policy(budget=retry_budget)
         import threading
 
         self._waste: dict = {}      # pass -> [frac_sum, n]

@@ -26,6 +26,8 @@ import time
 from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Callable, Iterable, Iterator, Optional
 
+from ..resilience import faults as _faults
+
 _DONE = object()
 
 
@@ -50,6 +52,10 @@ def pipelined(items: Iterable, fn: Optional[Callable] = None,
         prepare = _no_prepare
     if workers <= 1:
         for item in items:
+            # feeder_load fires on the synchronous path too, so the
+            # default (thread-less) configuration exercises the same
+            # fault matrix with the same occurrence ordering
+            _faults.fire("feeder_load")
             yield fn(item, prepare(item))
         return
 
@@ -74,6 +80,9 @@ def pipelined(items: Iterable, fn: Optional[Callable] = None,
             for item in items:
                 if stop.is_set():
                     return
+                # injected reader-side faults surface on the consumer
+                # through the same error queue a real decode error uses
+                _faults.fire("feeder_load")
                 ctx = prepare(item)
                 if not put(pool.submit(fn, item, ctx)):
                     return
@@ -139,6 +148,7 @@ def prefetched(items: Iterable, put: Callable, depth: int = 2,
     """
     if depth <= 0:
         for item in items:
+            _faults.fire("feeder_load")
             t0 = time.perf_counter()
             got = put(item)
             if on_chunk is not None:
@@ -163,6 +173,7 @@ def prefetched(items: Iterable, put: Callable, depth: int = 2,
             for item in items:
                 if stop.is_set():
                     return
+                _faults.fire("feeder_load")
                 if not send((None, put(item))):
                     return
             send(_DONE)

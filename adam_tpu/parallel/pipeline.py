@@ -129,25 +129,80 @@ def streaming_flagstat(path: str, *, mesh=None, chunk_rows: int = 1 << 22,
     t_start = _time.perf_counter()
     n_reads = 0
 
+    def _pad_wire(wire_u):
+        n_pad = pex.pad_rows(len(wire_u))
+        if n_pad != len(wire_u):
+            return np.concatenate(
+                [wire_u, np.zeros(n_pad - len(wire_u), np.uint32)])
+        return wire_u
+
     def _pad_put(wire):
         # pad to the canonical rung (padding words carry valid=0), then
         # start the host→device transfer — under the prefetching feed
-        # this runs up to prefetch_depth chunks ahead of the dispatch
+        # this runs up to prefetch_depth chunks ahead of the dispatch.
+        # The padded host wire rides along as the retry/split/fallback
+        # source (a failed donated dispatch needs a fresh transfer).
         rows = len(wire)
-        n_pad = pex.pad_rows(rows)
-        if n_pad != rows:
-            wire = np.concatenate(
-                [wire, np.zeros(n_pad - rows, np.uint32)])
-        return rows, jax.device_put(wire, sharding)
+        wire = _pad_wire(wire)
+        dev = pex.dispatch_put(
+            "wire", lambda attempt: jax.device_put(wire, sharding))
+        return rows, wire, dev
 
-    for rows, wire_dev in pex.feed(wire_chunks, _pad_put):
+    mesh_mult = max(getattr(mesh, "size", 1) or 1, 1)
+
+    def _host_cpu_counts(wire_padded):
+        # degraded per-chunk CPU fallback: the same integer count kernel
+        # on the CPU backend — counters are exact sums over valid words,
+        # so the degraded chunk is byte-identical by construction
+        import jax.numpy as jnp
+        from ..ops.flagstat import flagstat_kernel_wire32
+        with jax.default_device(jax.devices("cpu")[0]):
+            return np.asarray(
+                flagstat_kernel_wire32(jnp.asarray(wire_padded))
+            ).astype(np.int64)
+
+    def _split_halves(wire_valid, err):
+        # RESOURCE_EXHAUSTED: halve along the ladder rungs and
+        # re-dispatch each half under its own policy ladder — the
+        # counter monoid makes half-sums equal the whole
+        rows = len(wire_valid)
+        mid = max((rows // 2) // mesh_mult, 1) * mesh_mult
+        if rows <= mesh_mult or mid >= rows:
+            raise err
+        return (_dispatch_sub(wire_valid[:mid]) +
+                _dispatch_sub(wire_valid[mid:]))
+
+    def _dispatch_sub(wire_valid):
+        padded = _pad_wire(wire_valid)
+        counts = pex.dispatch(
+            "count-split",
+            lambda attempt: kernel(jax.device_put(padded, sharding)),
+            split=lambda e: _split_halves(wire_valid, e),
+            fallback=lambda e: _host_cpu_counts(padded))
+        return np.asarray(counts).astype(np.int64)
+
+    for rows, wire_host, wire_dev in pex.feed(wire_chunks, _pad_put):
         t_chunk = _time.perf_counter()
-        counts = kernel(wire_dev)
+        counts = pex.dispatch(
+            "count",
+            lambda attempt, dev=wire_dev, host=wire_host:
+                kernel(dev) if attempt == 1
+                else kernel(jax.device_put(host, sharding)),
+            split=lambda e, host=wire_host, r=rows:
+                _split_halves(host[:r], e),
+            fallback=lambda e, host=wire_host: _host_cpu_counts(host))
         del wire_dev            # donated on TPU: consumed by the kernel
-        totals_dev = counts if totals_dev is None else totals_dev + counts
+        if isinstance(counts, np.ndarray):
+            # a split/degraded chunk returns host counters — fold them
+            # straight into the host totals, never back onto a device
+            # that just failed
+            totals += counts.astype(np.int64)
+        else:
+            totals_dev = counts if totals_dev is None \
+                else totals_dev + counts
         n_chunks += 1
         n_reads += rows
-        if n_chunks % pex.sync_every == 0:
+        if n_chunks % pex.sync_every == 0 and totals_dev is not None:
             totals += np.asarray(totals_dev).astype(np.int64)
             totals_dev = None
         obs.chunk_processed("flagstat", rows, bytes_in=4 * rows,
@@ -313,11 +368,11 @@ class _StreamCheckpoint:
     def mark(self, name: str, **meta) -> None:
         import json
 
+        from ..checkpoint import atomic_write
+
         self.state["passes"][name] = meta
-        tmp = self.path + ".tmp"
-        with open(tmp, "w") as f:
-            json.dump(self.state, f)
-        os.replace(tmp, self.path)
+        atomic_write(self.path, json.dumps(self.state),
+                     fault_site="checkpoint_write")
 
     def save_array(self, name: str, arr) -> None:
         np.save(os.path.join(self.dir, name + ".npy"), arr)
@@ -359,23 +414,62 @@ class _MarkdupKeys:
         self.fp, self.score, self.h1, self.h2, self.lib = [], [], [], [], []
         self.lib_map: dict = {}
 
-    def add_chunk(self, table: pa.Table, batch) -> None:
+    def add_chunk(self, table: pa.Table, batch, pex=None,
+                  repack=None) -> None:
         import jax
         import jax.numpy as jnp
         from ..ops.markdup import _device_fiveprime_and_score
         from ..packing import hash_strings_128
 
         n = table.num_rows
-        # the executor's device feed may hand the batch in already
-        # sharded (its transfer then overlapped the previous chunk's
-        # key kernel); host batches take the put here as before
-        sharded = batch if not isinstance(batch.flags, np.ndarray) \
-            else batch.device_put(reads_sharding(self.mesh))
-        fp, score = _device_fiveprime_and_score(
-            sharded.flags, sharded.start, sharded.cigar_ops,
-            sharded.cigar_lens, sharded.n_cigar, sharded.quals)
-        self.fp.append(np.asarray(fp)[:n].astype(np.int64))
-        self.score.append(np.asarray(score)[:n])
+        is_host = isinstance(batch.flags, np.ndarray)
+
+        def compute(b):
+            # the executor's device feed may hand the batch in already
+            # sharded (its transfer then overlapped the previous
+            # chunk's key kernel); host batches take the put here
+            sharded = b if not isinstance(b.flags, np.ndarray) \
+                else b.device_put(reads_sharding(self.mesh))
+            fp, score = _device_fiveprime_and_score(
+                sharded.flags, sharded.start, sharded.cigar_ops,
+                sharded.cigar_lens, sharded.n_cigar, sharded.quals)
+            # materialize BEFORE any accumulator mutates: a device
+            # error must surface here, inside the retry ladder — never
+            # between appends (a partial append would corrupt the keys)
+            return (np.asarray(fp)[:n].astype(np.int64),
+                    np.asarray(score)[:n])
+
+        def run(attempt):
+            if attempt == 1 or is_host:
+                return compute(batch)
+            # a failed attempt may have consumed the prefetched device
+            # batch — rebuild the chunk's host batch and re-transfer
+            return compute(repack() if repack is not None else batch)
+
+        def fallback(e):
+            # degraded per-chunk CPU fallback: the same integer key
+            # kernel (5' positions + phred>=15 sums) pinned to the CPU
+            # backend — byte-identical by construction
+            b = batch if is_host else \
+                (repack() if repack is not None else None)
+            if b is None or not isinstance(b.flags, np.ndarray):
+                raise e
+            with jax.default_device(jax.devices("cpu")[0]):
+                fp, score = _device_fiveprime_and_score(
+                    jnp.asarray(b.flags), jnp.asarray(b.start),
+                    jnp.asarray(b.cigar_ops),
+                    jnp.asarray(b.cigar_lens),
+                    jnp.asarray(b.n_cigar), jnp.asarray(b.quals))
+                return (np.asarray(fp)[:n].astype(np.int64),
+                        np.asarray(score)[:n])
+
+        if pex is not None:
+            fp_np, score_np = pex.dispatch("markdup-keys", run,
+                                           fallback=fallback)
+        else:
+            fp_np, score_np = run(1)
+        self.fp.append(fp_np)
+        self.score.append(score_np)
         self.flags.append(column_int64(table, "flags", 0))
         self.refid.append(column_int64(table, "referenceId"))
         self.rgid.append(column_int64(table, "recordGroupId"))
@@ -504,7 +598,9 @@ def _feed_packed(chunk_iter, pex, io_threads: int, pack_reads,
         dev = None
         if batch is not None and batch.n_reads % mesh.size == 0 and \
                 (mesh.size > 1 or batch.n_reads <= slab):
-            dev = _project_batch(batch, dev_cols).device_put(sharding)
+            proj = _project_batch(batch, dev_cols)
+            dev = pex.dispatch_put(
+                "batch", lambda attempt: proj.device_put(sharding))
         return table, batch, dev
 
     fed = pex.feed(base, put)
@@ -762,8 +858,10 @@ def streaming_transform(input_path: str, output_path: str, *,
                 table, batch = item
                 if batch is not None and \
                         batch.n_reads % mesh.size == 0:
-                    batch = _project_batch(batch, _P1_DEV_COLS) \
-                        .device_put(p1_sharding)
+                    proj = _project_batch(batch, _P1_DEV_COLS)
+                    batch = pex1.dispatch_put(
+                        "batch",
+                        lambda attempt: proj.device_put(p1_sharding))
                 return table, batch
             p1_iter = timed_chunks(pex1.feed(p1_iter, _p1_put),
                                    "p1-feed-wait")
@@ -778,7 +876,14 @@ def streaming_transform(input_path: str, output_path: str, *,
                     raw_writer.write(table)
             if keys is not None:
                 with stage("p1-markdup-keys", sync=True):
-                    keys.add_chunk(table, batch)
+                    keys.add_chunk(
+                        table, batch, pex=pex1,
+                        # retry/fallback source when the fed device
+                        # batch was consumed by a failed attempt
+                        repack=lambda t=table: pack_reads(
+                            t, pad_rows_to=pex1.pad_rows(
+                                t.num_rows, bucket_len),
+                            bucket_len=bucket_len))
         if raw_writer is not None:
             raw_writer.close()
         if not p1_skipped:
@@ -846,18 +951,54 @@ def streaming_transform(input_path: str, output_path: str, *,
             p2_iter = _feed_packed(reread(pex2.chunk_rows), pex2,
                                    io_threads, pack_reads, bucket_len,
                                    timed_chunks, mesh, _P2_DEV_COLS)
+
+            def _p2_cpu_fallback(table, batch):
+                # degraded per-chunk CPU fallback: the host bincount
+                # oracle (bqsr.recalibrate's "host" impl — exact integer
+                # counts, kept selectable as a differential oracle) with
+                # every jax op pinned to the CPU backend
+                import jax
+                from ..bqsr.recalibrate import _COUNT_IMPL_ENV
+                old = os.environ.get(_COUNT_IMPL_ENV)
+                os.environ[_COUNT_IMPL_ENV] = "host"
+                try:
+                    with jax.default_device(jax.devices("cpu")[0]):
+                        out = count_tables_device(
+                            table, batch, snp_table,
+                            n_read_groups=n_rg_run, mesh=None)
+                finally:
+                    if old is None:
+                        os.environ.pop(_COUNT_IMPL_ENV, None)
+                    else:
+                        os.environ[_COUNT_IMPL_ENV] = old
+                return tuple(np.asarray(a) for a in out)
+
             for table, batch, dev_batch in p2_iter:
                 will_sync = (n_counted + 1) % pex2.sync_every == 0
                 with stage("p2-bqsr-count", sync=will_sync):
-                    out = count_tables_device(table, batch, snp_table,
-                                              n_read_groups=n_rg_run,
-                                              mesh=mesh,
-                                              device_batch=dev_batch,
-                                              donate=pex2.donate)
-                    acc = out if acc is None else tuple(
-                        a + b for a, b in zip(acc, out))
+                    out = pex2.dispatch(
+                        "count",
+                        lambda attempt, t=table, b=batch, d=dev_batch:
+                            count_tables_device(
+                                t, b, snp_table,
+                                n_read_groups=n_rg_run, mesh=mesh,
+                                device_batch=d if attempt == 1 else None,
+                                donate=pex2.donate and attempt == 1),
+                        fallback=lambda e, t=table, b=batch:
+                            _p2_cpu_fallback(t, b))
+                    if isinstance(out[0], np.ndarray):
+                        # a degraded chunk's host counts fold straight
+                        # into the host accumulator — never back onto a
+                        # device that just failed
+                        folded = tuple(np.asarray(a).astype(np.int64)
+                                       for a in out)
+                        host_acc = folded if host_acc is None else tuple(
+                            h + f for h, f in zip(host_acc, folded))
+                    else:
+                        acc = out if acc is None else tuple(
+                            a + b for a, b in zip(acc, out))
                     n_counted += 1
-                    if will_sync:
+                    if will_sync and acc is not None:
                         folded = tuple(np.asarray(a).astype(np.int64)
                                        for a in acc)
                         host_acc = folded if host_acc is None else tuple(
@@ -930,12 +1071,26 @@ def streaming_transform(input_path: str, output_path: str, *,
                                reread(pex3.chunk_rows), pex3, io_threads,
                                pack_reads, bucket_len, timed_chunks,
                                mesh, _P3_DEV_COLS, want_pack=bqsr)
+        def _p3_cpu_fallback(table, batch):
+            # degraded per-chunk CPU fallback: the unsharded LUT apply
+            # pinned to the CPU backend (a per-row integer map — the
+            # slab/sharded forms are bit-identical by construction)
+            import jax
+            with jax.default_device(jax.devices("cpu")[0]):
+                return apply_table(rt, table, batch, mesh=None)
+
         for table, batch, dev_batch in p3_iter:
             if bqsr:
                 with stage("p3-bqsr-apply", sync=True):
-                    table = apply_table(rt, table, batch, mesh=mesh,
-                                        device_batch=dev_batch,
-                                        donate=pex3.donate)
+                    table = pex3.dispatch(
+                        "apply",
+                        lambda attempt, t=table, b=batch, d=dev_batch:
+                            apply_table(
+                                rt, t, b, mesh=mesh,
+                                device_batch=d if attempt == 1 else None,
+                                donate=pex3.donate and attempt == 1),
+                        fallback=lambda e, t=table, b=batch:
+                            _p3_cpu_fallback(t, b))
             if not binned:
                 with stage("p3-write"):
                     out.write(table)
@@ -976,7 +1131,8 @@ def streaming_transform(input_path: str, output_path: str, *,
                 _emit_bins(out, bin_writers,
                            halo_writers if realign else {}, part,
                            chunk_rows, budget, realign, sort, wopts,
-                           realign_opts=realign_opts)
+                           realign_opts=realign_opts,
+                           retry_policy=ex.retry_policy)
         out.close()
         if ck is not None:
             ck.mark("done", total_rows=total_rows)
@@ -1157,7 +1313,8 @@ def _bin_unit_descs(path, halo_path, part, rows, chunk_rows, budget,
 
 
 def _emit_bins(out, bin_writers, halo_writers, part, chunk_rows, budget,
-               realign, sort, wopts, realign_opts=None):
+               realign, sort, wopts, realign_opts=None,
+               retry_policy=None):
     """Pass 4 driver: process mapped bins in genome order, emitting sorted
     output through a merge window — realignment can move a read up to the
     halo width across a bin edge, so rows only emit once no later bin can
@@ -1228,7 +1385,8 @@ def _emit_bins(out, bin_writers, halo_writers, part, chunk_rows, budget,
                             chunk_rows, budget, True, next_lo, wopts)):
                         yield BinUnitDesc(b, (seq, k), load, nxt)
 
-            RealignEngine(plan).run(units(), emit, sort)
+            RealignEngine(plan, retry_policy=retry_policy).run(
+                units(), emit, sort)
         else:
             from ..realign.realigner import realign_indels
             for b, w, halo_path, next_lo in mapped:

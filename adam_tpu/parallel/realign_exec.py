@@ -59,6 +59,7 @@ import pyarrow as pa
 
 from .. import obs
 from ..realign import realigner as R
+from ..resilience.retry import dispatch_with_retry, resolve_retry_policy
 
 #: env overrides (the transform CLI flags mirror these, docs/REALIGN_EXECUTOR.md)
 REALIGN_PIPELINE_ENV = "ADAM_TPU_REALIGN_PIPELINE"          # 0/off disables
@@ -169,8 +170,11 @@ class CrossBinSweepBatcher:
     change scheduling and telemetry but never a byte of output.
     """
 
-    def __init__(self, donate: bool = False):
+    def __init__(self, donate: bool = False, retry_policy=None):
         self._donate = donate
+        # the caller's resolved policy (the -retry_budget flag plumbed
+        # through StreamExecutor) wins; standalone use falls back to env
+        self._retry = retry_policy or resolve_retry_policy()
         self._lock = threading.Lock()
         self._buckets: Dict[tuple, list] = {}     # shape -> [(uid, si, ji)]
         self._states: Dict[tuple, list] = {}      # uid -> states
@@ -221,25 +225,54 @@ class CrossBinSweepBatcher:
         Rr, L, CL = shape
         g_max = R._sweep_g_max(Rr, L, CL)
         for lo in range(0, len(members), g_max):
-            chunk = members[lo:lo + g_max]
-            pairs = [(self._states[u][si], self._states[u][si].jobs[ji])
-                     for u, si, ji in chunk]
-            q_dev, o_dev = R.sweep_dispatch(pairs, donate=self._donate)
-            cr = _ChunkResult(q_dev, o_dev)
-            for g, key in enumerate(chunk):
-                self._results[key] = (cr, g)
-            # the ACTUAL padded lane count, read off the dispatched
-            # result — not a re-derivation of sweep_dispatch's policy
-            G = int(q_dev.shape[0])
-            r = obs.registry()
-            r.counter("realign_sweep_dispatches").inc()
-            r.counter("realign_sweep_jobs").inc(len(chunk))
-            if (G, Rr, L, CL) not in self._shapes_seen:
-                self._shapes_seen.add((G, Rr, L, CL))
-                r.counter("realign_shapes").inc()
-            obs.emit("realign_sweep_dispatch", shape=[Rr, L, CL],
-                     jobs=len(chunk), g=G,
-                     units=len({u for u, _, _ in chunk}))
+            self._dispatch_chunk(shape, members[lo:lo + g_max])
+
+    def _dispatch_chunk(self, shape: tuple, chunk: list) -> None:
+        """One device sweep batch under the scoped retry ladder:
+        transient errors re-dispatch (states are host-resident, so every
+        attempt rebuilds its device inputs), ``RESOURCE_EXHAUSTED``
+        halves the bucket and re-dispatches the halves — lanes are
+        independent vmap programs, so batch composition changes
+        scheduling and telemetry, never a byte of output."""
+        Rr, L, CL = shape
+        pairs = [(self._states[u][si], self._states[u][si].jobs[ji])
+                 for u, si, ji in chunk]
+
+        def fn(attempt):
+            # donation only on the first attempt: a failed donated
+            # dispatch may have consumed its buffers
+            return R.sweep_dispatch(pairs,
+                                    donate=self._donate and attempt == 1)
+
+        def split(err):
+            if len(chunk) <= 1:
+                raise err
+            mid = (len(chunk) + 1) // 2
+            self._dispatch_chunk(shape, chunk[:mid])
+            self._dispatch_chunk(shape, chunk[mid:])
+            return None
+
+        out = dispatch_with_retry(fn, site="device_dispatch",
+                                  label="realign:sweep",
+                                  policy=self._retry, split=split)
+        if out is None:
+            return              # split path recorded the halves' results
+        q_dev, o_dev = out
+        cr = _ChunkResult(q_dev, o_dev)
+        for g, key in enumerate(chunk):
+            self._results[key] = (cr, g)
+        # the ACTUAL padded lane count, read off the dispatched
+        # result — not a re-derivation of sweep_dispatch's policy
+        G = int(q_dev.shape[0])
+        r = obs.registry()
+        r.counter("realign_sweep_dispatches").inc()
+        r.counter("realign_sweep_jobs").inc(len(chunk))
+        if (G, Rr, L, CL) not in self._shapes_seen:
+            self._shapes_seen.add((G, Rr, L, CL))
+            r.counter("realign_shapes").inc()
+        obs.emit("realign_sweep_dispatch", shape=[Rr, L, CL],
+                 jobs=len(chunk), g=G,
+                 units=len({u for u, _, _ in chunk}))
 
     def _take(self, uid: tuple, si: int, ji: int):
         cr, g = self._results.pop((uid, si, ji))
@@ -271,10 +304,11 @@ class RealignEngine:
     fully synchronous walk — same engine, same bytes.
     """
 
-    def __init__(self, plan: dict):
+    def __init__(self, plan: dict, retry_policy=None):
         self.plan = plan
         self.depth = int(plan["pipeline_depth"])
-        self.batcher = CrossBinSweepBatcher(donate=bool(plan["donate"]))
+        self.batcher = CrossBinSweepBatcher(donate=bool(plan["donate"]),
+                                            retry_policy=retry_policy)
 
     def run(self, units: Iterable[BinUnitDesc],
             emit: Callable[[pa.Table, int], None], sort: bool) -> int:

@@ -1,0 +1,41 @@
+"""``adam_tpu.resilience`` — deterministic fault injection + scoped
+retry/degradation for every dispatch site.
+
+The reference inherits all failure recovery from Spark lineage
+re-execution (SURVEY §5); the TPU rebuild replaced lineage with
+job-level elastic restart (parallel/elastic.py) and pass-level
+checkpoints (checkpoint.py).  Between those coarse mechanisms this
+package adds the per-chunk layer:
+
+* :mod:`.faults` — a deterministic fault-injection plane: named sites
+  (``device_dispatch``, ``device_put``, ``spill_write``,
+  ``checkpoint_write``, ``feeder_load``, ``worker_proc``,
+  ``input_record``) registered at the existing choke points, driven by a
+  seeded, replayable fault plan (``-fault_plan PATH`` /
+  ``ADAM_TPU_FAULT_PLAN``).  With no plan installed the plane is
+  zero-overhead: no counting, no events, no behavior change.
+* :mod:`.retry` — the scoped retry/degradation policy engine wrapping
+  per-chunk and per-bin device dispatch: bounded retries with
+  exponential backoff + deterministic jitter for transient device
+  errors, ``RESOURCE_EXHAUSTED`` → split along the existing ladder
+  rungs, persistent device loss → per-chunk graceful CPU fallback
+  (flagged ``degraded``), all decided by a PURE function whose inputs
+  every event records (the ``decide_plan`` convention —
+  tools/check_resilience.py replays them offline).
+
+docs/RESILIENCE.md documents the plan format, the policy, and the
+pinned chaos matrix (tests/test_resilience.py).
+"""
+
+from __future__ import annotations
+
+from .faults import (FAULT_PLAN_ENV, INCARNATION_ENV, SITES,  # noqa: F401
+                     InjectedDeviceError, InjectedFault,
+                     InjectedFormatError, InjectedTornWrite, active,
+                     clear_plan, decide_fault, fire, install_from_env,
+                     install_plan, reset_counters)
+from .retry import (RETRY_BACKOFF_ENV, RETRY_BUDGET_ENV,  # noqa: F401
+                    RETRY_FALLBACK_ENV, RETRY_SEED_ENV, RETRY_SPLIT_ENV,
+                    RetryPolicy, backoff_delay, classify_error,
+                    decide_retry, dispatch_with_retry,
+                    resolve_retry_policy)

@@ -1,0 +1,355 @@
+"""Deterministic fault-injection plane.
+
+Every recovery path in the pipeline — retry, split, CPU fallback,
+checkpoint resume, elastic restart — exists to survive failures that
+real hardware produces rarely and unreproducibly.  This module makes
+those failures a *first-class, replayable input*: named injection sites
+sit at the existing choke points (the executor's dispatch/put, the
+ingest feeders, the spill/checkpoint writers, the elastic workers, the
+BAM record decoder), and a seeded fault plan says which site fires on
+which occurrence with which fault.
+
+Determinism contract (the executor's ``decide_plan`` convention):
+:func:`decide_fault` is a PURE function of ``(site, occurrence,
+incarnation, rules)``; every firing emits a ``fault_injected`` event
+carrying those inputs verbatim plus their digest, so
+tools/check_resilience.py can replay a recorded run's firings offline
+and fail on any non-determinism.
+
+Zero-overhead contract: with no plan installed, :func:`fire` is one
+module-global ``None`` check — no occurrence counting, no events, no
+behavior change (pinned by tests/test_resilience.py).
+
+Faults:
+
+* ``error``    — raise a typed error (:class:`InjectedDeviceError` with
+  an XLA-style status code, or :class:`InjectedFormatError` for input
+  sites) that the retry engine classifies exactly like the real thing;
+* ``latency``  — sleep ``latency_s`` (slow-link / straggler rehearsal);
+* ``truncate`` — for write sites: truncate the in-flight file to
+  ``frac`` of its bytes, then raise :class:`InjectedTornWrite` — a
+  power loss mid-write, as observable by the next process;
+* ``corrupt``  — for write sites: overwrite a window of the file's
+  middle bytes, then raise :class:`InjectedTornWrite`;
+* ``kill``     — SIGKILL the current process (``worker_proc``: the
+  elastic supervisor's worker-death path, no Python unwinding).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import signal
+import threading
+import time
+from typing import Optional
+
+from .. import obs
+from ..errors import FormatError
+
+#: the named injection sites (docs/RESILIENCE.md documents each one's
+#: choke point); fire() rejects anything else so a typo'd plan fails
+#: loudly instead of never firing
+SITES = ("device_dispatch", "device_put", "spill_write",
+         "checkpoint_write", "feeder_load", "worker_proc", "input_record")
+
+FAULTS = ("error", "latency", "truncate", "corrupt", "kill")
+
+#: plan path fallback for the CLI flag (how elastic workers and bench
+#: subprocesses inherit the plan — env crosses the process boundary)
+FAULT_PLAN_ENV = "ADAM_TPU_FAULT_PLAN"
+#: stamped by the elastic supervisor on each worker's env; plan rules
+#: with an ``incarnation`` field only fire when it matches
+INCARNATION_ENV = "ADAM_TPU_INCARNATION"
+
+#: error codes an ``error`` fault may raise (the transient set mirrors
+#: retry.classify_error's XLA status matching; FORMAT raises the typed
+#: input error the CLI already turns into a clean one-line exit)
+ERROR_CODES = ("RESOURCE_EXHAUSTED", "DATA_LOSS", "UNAVAILABLE",
+               "PREEMPTED", "DEADLINE_EXCEEDED", "ABORTED", "INTERNAL",
+               "FORMAT")
+
+
+class InjectedFault(RuntimeError):
+    """Base of every injected failure — typed, so the chaos matrix can
+    pin 'fails cleanly' as 'raises an InjectedFault subclass, never a
+    bare crash'."""
+
+    code = "INJECTED"
+
+
+class InjectedDeviceError(InjectedFault):
+    """An injected device/runtime error carrying an XLA-style status
+    code; retry.classify_error maps it exactly like a real
+    XlaRuntimeError with the same code in its message."""
+
+    def __init__(self, code: str, site: str, occurrence: int):
+        self.code = code
+        super().__init__(
+            f"{code}: injected fault at site {site!r} occurrence "
+            f"{occurrence}")
+
+
+class InjectedTornWrite(InjectedFault):
+    """The write was torn (truncated/corrupted) and the writer 'died' —
+    what a crash mid-write looks like to the next process."""
+
+    code = "DATA_LOSS"
+
+
+class InjectedFormatError(FormatError, InjectedFault):
+    """Injected malformed-input error; subclasses FormatError so the CLI
+    prints its one-line message and exits 2 like any bad input."""
+
+    code = "FORMAT"
+
+
+_LOCK = threading.Lock()
+_PLAN: Optional[dict] = None
+_COUNTS: dict = {}
+#: site -> canonical rules targeting it (install-time index): fire()'s
+#: hot path scans only these cheap matchers and defers the full
+#: decide_fault (rules copy + JSON + sha256) to actual hits, so a plan
+#: targeting one site costs per-record sites nothing but a dict lookup
+_BY_SITE: dict = {}
+
+
+# ---------------------------------------------------------------------------
+# plan install / canonicalization
+# ---------------------------------------------------------------------------
+
+def _canon_rule(i: int, rule: dict) -> dict:
+    """Validate + canonicalize one plan rule (the exact dict the
+    ``fault_injected`` event records, so replay sees what fired)."""
+    site = rule.get("site")
+    if site not in SITES:
+        raise ValueError(f"fault plan rule {i}: unknown site {site!r} "
+                         f"(want one of {', '.join(SITES)})")
+    fault = rule.get("fault")
+    if fault not in FAULTS:
+        raise ValueError(f"fault plan rule {i}: unknown fault {fault!r} "
+                         f"(want one of {', '.join(FAULTS)})")
+    occ = rule.get("occurrence", "1+")
+    if isinstance(occ, bool) or not (
+            isinstance(occ, int)
+            or (isinstance(occ, list) and occ
+                and all(isinstance(o, int) and not isinstance(o, bool)
+                        for o in occ))
+            or (isinstance(occ, str) and occ.endswith("+")
+                and occ[:-1].isdigit())):
+        raise ValueError(
+            f"fault plan rule {i}: occurrence must be an int, a list of "
+            f"ints, or 'N+' (every occurrence >= N), got {occ!r}")
+    out = dict(site=site, fault=fault, occurrence=occ)
+    if fault == "error":
+        code = rule.get("error", "UNAVAILABLE")
+        if code not in ERROR_CODES:
+            raise ValueError(f"fault plan rule {i}: unknown error code "
+                             f"{code!r} (want one of {', '.join(ERROR_CODES)})")
+        out["error"] = code
+    if fault == "latency":
+        out["latency_s"] = round(float(rule.get("latency_s", 0.01)), 6)
+    if fault in ("truncate", "corrupt"):
+        frac = float(rule.get("frac", 0.5))
+        if not 0.0 <= frac <= 1.0:
+            raise ValueError(f"fault plan rule {i}: frac must be in "
+                             f"[0, 1], got {frac}")
+        out["frac"] = round(frac, 6)
+    if "incarnation" in rule:
+        out["incarnation"] = int(rule["incarnation"])
+    return out
+
+
+def canonicalize_plan(plan: dict) -> dict:
+    """Validate a raw plan document into its canonical form (what the
+    plane decides from and what events record)."""
+    if not isinstance(plan, dict) or not isinstance(
+            plan.get("rules"), list):
+        raise ValueError("fault plan must be an object with a 'rules' list")
+    return {"seed": int(plan.get("seed", 0)),
+            "rules": [_canon_rule(i, r)
+                      for i, r in enumerate(plan["rules"])]}
+
+
+def install_plan(plan) -> dict:
+    """Install a fault plan process-wide: a dict, or a path to a JSON
+    file.  Occurrence counters reset — a plan install starts a fresh,
+    replayable firing sequence."""
+    global _PLAN
+    if isinstance(plan, str):
+        with open(plan) as f:
+            plan = json.load(f)
+    canon = canonicalize_plan(plan)
+    by_site: dict = {}
+    for rule in canon["rules"]:
+        by_site.setdefault(rule["site"], []).append(rule)
+    with _LOCK:
+        _PLAN = canon
+        _COUNTS.clear()
+        _BY_SITE.clear()
+        _BY_SITE.update(by_site)
+    return canon
+
+
+def install_from_env(flag_value: Optional[str] = None) -> Optional[dict]:
+    """The CLI entry: the ``-fault_plan`` flag wins, ``ADAM_TPU_FAULT_PLAN``
+    is the fallback (how spawned workers inherit the plan); neither set
+    leaves the plane inert."""
+    path = flag_value or os.environ.get(FAULT_PLAN_ENV) or None
+    return install_plan(path) if path else None
+
+
+def clear_plan() -> None:
+    """Remove the installed plan and zero the counters (test isolation)."""
+    global _PLAN
+    with _LOCK:
+        _PLAN = None
+        _COUNTS.clear()
+        _BY_SITE.clear()
+
+
+def reset_counters() -> None:
+    """Zero the occurrence counters, keeping the plan (a fresh run)."""
+    with _LOCK:
+        _COUNTS.clear()
+
+
+def active() -> bool:
+    return _PLAN is not None
+
+
+# ---------------------------------------------------------------------------
+# the pure decision + the firing hook
+# ---------------------------------------------------------------------------
+
+def _occ_matches(spec, occurrence: int) -> bool:
+    if isinstance(spec, int):
+        return occurrence == spec
+    if isinstance(spec, list):
+        return occurrence in spec
+    return occurrence >= int(spec[:-1])     # "N+" — persistent fault
+
+
+def decide_fault(*, site: str, occurrence: int,
+                 incarnation: Optional[int] = None,
+                 rules: list) -> dict:
+    """Whether (and how) this site occurrence fires — PURE.
+
+    First matching rule wins (a plan is read top to bottom, like the
+    executor ladder's first-fit).  The returned decision carries the
+    canonicalized ``inputs`` and their ``input_digest``, the replayable
+    contract tools/check_resilience.py verifies.
+    """
+    inputs = dict(site=site, occurrence=int(occurrence),
+                  incarnation=None if incarnation is None
+                  else int(incarnation),
+                  rules=[dict(r) for r in rules])
+    hit = None
+    idx = None
+    for i, rule in enumerate(inputs["rules"]):
+        if rule["site"] != site:
+            continue
+        if not _occ_matches(rule["occurrence"], inputs["occurrence"]):
+            continue
+        if "incarnation" in rule and \
+                rule["incarnation"] != inputs["incarnation"]:
+            continue
+        hit, idx = rule, i
+        break
+    digest = hashlib.sha256(
+        json.dumps(inputs, sort_keys=True).encode()).hexdigest()[:16]
+    out = dict(fire=hit is not None, rule=idx,
+               fault=None if hit is None else hit["fault"],
+               inputs=inputs, input_digest=digest)
+    if hit is not None:
+        for k in ("error", "latency_s", "frac"):
+            if k in hit:
+                out[k] = hit[k]
+    return out
+
+
+def _incarnation() -> Optional[int]:
+    v = os.environ.get(INCARNATION_ENV)
+    try:
+        return int(v) if v else None
+    except ValueError:
+        return None
+
+
+def fire(site: str, path: Optional[str] = None) -> None:
+    """The injection hook every choke point calls.
+
+    No plan → return immediately (the zero-overhead contract: no
+    counting, no events).  With a plan: count the occurrence, take the
+    pure decision, record it, apply the fault (which may raise, sleep,
+    tear ``path``, or SIGKILL the process).
+    """
+    plan = _PLAN
+    if plan is None:
+        return
+    if site not in SITES:
+        raise ValueError(f"unknown fault site {site!r}")
+    # untargeted site: no counting, no lock — its occurrence numbers
+    # are unobservable (no rule can ever fire there), and per-record
+    # sites must not contend on the global lock just because a plan
+    # targets some OTHER site
+    candidates = _BY_SITE.get(site)
+    if not candidates:
+        return
+    with _LOCK:
+        _COUNTS[site] = occ = _COUNTS.get(site, 0) + 1
+    # cheap pre-match before the full pure decision: the hot path
+    # (per-record input_record, per-chunk feeder/put sites) must not pay
+    # the rules copy + JSON + sha256 of decide_fault on every miss —
+    # decide_fault re-derives the SAME first-match on a hit, so the
+    # recorded decision stays bit-for-bit replayable
+    inc = _incarnation()
+    if not any(_occ_matches(r["occurrence"], occ)
+               and ("incarnation" not in r or r["incarnation"] == inc)
+               for r in candidates):
+        return
+    d = decide_fault(site=site, occurrence=occ,
+                     incarnation=inc, rules=plan["rules"])
+    if not d["fire"]:
+        return
+    obs.registry().counter("faults_injected", site=site).inc()
+    obs.emit("fault_injected", site=site, occurrence=occ,
+             fault=d["fault"], rule=d["rule"],
+             path=path, inputs=d["inputs"],
+             input_digest=d["input_digest"])
+    _apply(d, site, occ, path)
+
+
+def _apply(d: dict, site: str, occ: int, path: Optional[str]) -> None:
+    fault = d["fault"]
+    if fault == "latency":
+        time.sleep(d.get("latency_s", 0.01))
+        return
+    if fault == "error":
+        code = d.get("error", "UNAVAILABLE")
+        if code == "FORMAT":
+            raise InjectedFormatError(
+                f"injected malformed input at site {site!r} "
+                f"occurrence {occ}")
+        raise InjectedDeviceError(code, site, occ)
+    if fault == "kill":
+        os.kill(os.getpid(), signal.SIGKILL)
+        return                                      # pragma: no cover
+    # truncate / corrupt: tear the in-flight file, then 'die'
+    if path is not None:
+        try:
+            size = os.path.getsize(path)
+            with open(path, "r+b") as f:
+                if fault == "truncate":
+                    f.truncate(int(size * d.get("frac", 0.5)))
+                else:
+                    lo = int(size * d.get("frac", 0.5) / 2)
+                    n = max(1, min(64, size - lo))
+                    f.seek(lo)
+                    f.write(b"\xff" * n)
+        except OSError:
+            pass        # a missing/unwritable target still 'crashes'
+    raise InjectedTornWrite(
+        f"DATA_LOSS: injected {fault} at site {site!r} occurrence {occ}"
+        + (f" ({path})" if path else ""))

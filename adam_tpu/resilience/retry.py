@@ -1,0 +1,266 @@
+"""Scoped retry/degradation policy engine for per-chunk device dispatch.
+
+The recovery ladder between "chunk raised" and "job restarts" (SURVEY
+§5's lineage re-execution, rebuilt at chunk granularity):
+
+1. **retry** — transient device errors (preemption, interconnect
+   ``DATA_LOSS``, ``UNAVAILABLE``) re-dispatch the same chunk with
+   exponential backoff + deterministic jitter, at most ``budget``
+   attempts;
+2. **split** — ``RESOURCE_EXHAUSTED`` halves the chunk/bucket along the
+   existing ladder rungs and re-dispatches the halves (every consumer is
+   an exact monoid or per-row map, so re-chunking never changes bytes —
+   the ``reread`` contract);
+3. **CPU fallback** — a budget-exhausted (persistent) device failure
+   re-runs that chunk's kernels on the CPU backend, byte-identical by
+   construction (exact integer kernels), and flags the dispatch
+   ``degraded`` — a streaming run finishes instead of dying;
+4. **raise** — fatal errors (anything not recognizably transient)
+   propagate immediately; bounded retries never mask a real bug.
+
+Every decision is :func:`decide_retry` — PURE, recorded in full in the
+``retry_attempt`` event (``inputs`` + ``input_digest``, the executor's
+``decide_plan`` convention) so tools/check_resilience.py replays a
+recorded run's policy offline.  Degraded dispatches additionally emit
+``degraded_dispatch`` and set the ``degraded`` gauge.
+
+Policy knobs: ``-retry_budget`` on the streaming CLI commands, and the
+``ADAM_TPU_RETRY_*`` envs (docs/RESILIENCE.md).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import time
+from dataclasses import dataclass
+from typing import Callable, Optional
+
+from .. import obs
+from . import faults
+
+RETRY_BUDGET_ENV = "ADAM_TPU_RETRY_BUDGET"
+RETRY_BACKOFF_ENV = "ADAM_TPU_RETRY_BACKOFF_S"
+RETRY_SPLIT_ENV = "ADAM_TPU_RETRY_SPLIT"            # 0/off disables
+RETRY_FALLBACK_ENV = "ADAM_TPU_RETRY_CPU_FALLBACK"  # 0/off disables
+RETRY_SEED_ENV = "ADAM_TPU_RETRY_SEED"
+
+#: attempts per chunk, retries included (1 = no retries)
+DEFAULT_BUDGET = 3
+DEFAULT_BACKOFF_S = 0.05
+DEFAULT_BACKOFF_CAP_S = 2.0
+
+#: XLA status codes (and message substrings) worth re-dispatching: the
+#: transient set production TPU jobs see across preemption, interconnect
+#: flaps, and coordinator churn
+_TRANSIENT_MARKS = ("DATA_LOSS", "UNAVAILABLE", "PREEMPT",
+                    "DEADLINE_EXCEEDED", "ABORTED", "CANCELLED",
+                    "INTERNAL", "CONNECTION RESET", "SOCKET CLOSED")
+_OOM_MARKS = ("RESOURCE_EXHAUSTED", "OUT OF MEMORY", "OOM")
+
+
+@dataclass(frozen=True)
+class RetryPolicy:
+    """One resolved policy per run scope (executor / realign engine)."""
+    budget: int = DEFAULT_BUDGET
+    backoff_s: float = DEFAULT_BACKOFF_S
+    backoff_cap_s: float = DEFAULT_BACKOFF_CAP_S
+    split: bool = True
+    cpu_fallback: bool = True
+    seed: int = 0
+
+
+def resolve_retry_policy(budget: Optional[int] = None,
+                         backoff_s: Optional[float] = None,
+                         split: Optional[bool] = None,
+                         cpu_fallback: Optional[bool] = None,
+                         seed: Optional[int] = None) -> RetryPolicy:
+    """Explicit arguments (CLI flags) win; ``ADAM_TPU_RETRY_*`` envs fill
+    whatever the caller left unset (the executor's flag/env convention)."""
+    env = os.environ
+
+    def _int(v, name, default):
+        if v is not None:
+            return int(v)
+        try:
+            return int(env[name]) if env.get(name) else default
+        except ValueError:
+            return default
+
+    def _float(v, name, default):
+        if v is not None:
+            return float(v)
+        try:
+            return float(env[name]) if env.get(name) else default
+        except ValueError:
+            return default
+
+    def _bool(v, name):
+        if v is not None:
+            return bool(v)
+        return env.get(name, "1") not in ("0", "off")
+
+    return RetryPolicy(
+        budget=max(_int(budget, RETRY_BUDGET_ENV, DEFAULT_BUDGET), 1),
+        backoff_s=max(_float(backoff_s, RETRY_BACKOFF_ENV,
+                             DEFAULT_BACKOFF_S), 0.0),
+        backoff_cap_s=DEFAULT_BACKOFF_CAP_S,
+        split=_bool(split, RETRY_SPLIT_ENV),
+        cpu_fallback=_bool(cpu_fallback, RETRY_FALLBACK_ENV),
+        seed=_int(seed, RETRY_SEED_ENV, 0))
+
+
+# ---------------------------------------------------------------------------
+# error classification
+# ---------------------------------------------------------------------------
+
+def classify_error(exc: BaseException) -> str:
+    """``"oom"`` / ``"transient"`` / ``"fatal"`` for one dispatch error.
+
+    Injected faults classify by their carried code — the same mapping a
+    real ``XlaRuntimeError`` gets from its message, so the chaos matrix
+    exercises the identical policy path production errors take.
+    """
+    if isinstance(exc, faults.InjectedFormatError):
+        return "fatal"          # bad input is not a device problem
+    if isinstance(exc, faults.InjectedFault):
+        code = getattr(exc, "code", "")
+        if code in ("RESOURCE_EXHAUSTED",):
+            return "oom"
+        if code in ("DATA_LOSS", "UNAVAILABLE", "PREEMPTED",
+                    "DEADLINE_EXCEEDED", "ABORTED", "INTERNAL"):
+            return "transient"
+        return "fatal"
+    name = type(exc).__name__
+    module = type(exc).__module__ or ""
+    if name == "XlaRuntimeError" or module.startswith(("jaxlib", "jax")):
+        msg = str(exc).upper()
+        if any(m in msg for m in _OOM_MARKS):
+            return "oom"
+        if any(m in msg for m in _TRANSIENT_MARKS):
+            return "transient"
+        return "fatal"
+    if isinstance(exc, (ConnectionError, TimeoutError)):
+        return "transient"
+    return "fatal"
+
+
+# ---------------------------------------------------------------------------
+# the pure decision
+# ---------------------------------------------------------------------------
+
+def backoff_delay(key: str, attempt: int, base_s: float, cap_s: float,
+                  seed: int = 0) -> float:
+    """Exponential backoff with DETERMINISTIC jitter: the jitter fraction
+    derives from a digest of (key, attempt, seed), so a replay computes
+    the identical delay — seeded chaos stays replayable — while distinct
+    sites/attempts still de-synchronize (the thundering-herd fix jitter
+    exists for).  Shared with the elastic supervisor's restart backoff."""
+    raw = min(cap_s, base_s * (2.0 ** max(attempt - 1, 0)))
+    h = hashlib.sha256(f"{key}|{attempt}|{seed}".encode()).digest()
+    frac = int.from_bytes(h[:4], "big") / 0xFFFFFFFF
+    return round(raw * (1.0 + 0.5 * frac), 6)
+
+
+def decide_retry(*, site: str, attempt: int, budget: int,
+                 error_kind: str, can_split: bool, can_fallback: bool,
+                 backoff_s: float = DEFAULT_BACKOFF_S,
+                 backoff_cap_s: float = DEFAULT_BACKOFF_CAP_S,
+                 seed: int = 0) -> dict:
+    """One failed attempt's next action — PURE.
+
+    ``action`` ∈ ``retry`` (sleep ``delay_s``, re-dispatch) / ``split``
+    (halve along the ladder rungs, re-dispatch the halves) /
+    ``fallback_cpu`` (degraded per-chunk CPU re-run) / ``raise``.  The
+    ``retry_attempt`` event records the canonicalized inputs + digest,
+    replayed by tools/check_resilience.py.
+    """
+    inputs = dict(site=site, attempt=int(attempt), budget=int(budget),
+                  error_kind=error_kind, can_split=bool(can_split),
+                  can_fallback=bool(can_fallback),
+                  backoff_s=round(float(backoff_s), 6),
+                  backoff_cap_s=round(float(backoff_cap_s), 6),
+                  seed=int(seed))
+    action, delay, reason = "raise", 0.0, ""
+    kind = inputs["error_kind"]
+    if kind == "fatal":
+        reason = "fatal-error"
+    elif kind == "oom" and inputs["can_split"]:
+        action, reason = "split", "oom:split-ladder"
+    elif inputs["attempt"] < inputs["budget"]:
+        action = "retry"
+        delay = backoff_delay(site, inputs["attempt"],
+                              inputs["backoff_s"],
+                              inputs["backoff_cap_s"], inputs["seed"])
+        reason = f"{kind}:attempt {inputs['attempt']}/{inputs['budget']}"
+    elif inputs["can_fallback"]:
+        action, reason = "fallback_cpu", f"{kind}:budget-exhausted"
+    else:
+        reason = f"{kind}:budget-exhausted:no-fallback"
+    digest = hashlib.sha256(
+        json.dumps(inputs, sort_keys=True).encode()).hexdigest()[:16]
+    return dict(action=action, delay_s=delay, reason=reason,
+                inputs=inputs, input_digest=digest)
+
+
+# ---------------------------------------------------------------------------
+# the dispatch wrapper
+# ---------------------------------------------------------------------------
+
+def dispatch_with_retry(fn: Callable[[int], object], *,
+                        site: str = "device_dispatch", label: str = "",
+                        policy: Optional[RetryPolicy] = None,
+                        split: Optional[Callable] = None,
+                        fallback: Optional[Callable] = None):
+    """Run one dispatch under the policy ladder.
+
+    ``fn(attempt)`` performs the dispatch — the attempt number lets the
+    caller re-transfer from host state on retries (a failed donated
+    dispatch may have consumed its device buffer) and keep donation to
+    the first attempt only.  ``split(exc)`` / ``fallback(exc)`` are the
+    caller's halve-and-redispatch and CPU re-run; either may be ``None``
+    when the site cannot support it, and the pure decision sees that.
+
+    The fault-injection site fires inside the attempt, so injected
+    faults traverse the identical recovery path real errors take.
+    """
+    if policy is None:
+        policy = resolve_retry_policy()
+    attempt = 0
+    while True:
+        attempt += 1
+        try:
+            faults.fire(site)
+            return fn(attempt)
+        except Exception as e:  # noqa: BLE001 — classified below
+            kind = classify_error(e)
+            d = decide_retry(
+                site=site, attempt=attempt, budget=policy.budget,
+                error_kind=kind,
+                can_split=split is not None and policy.split,
+                can_fallback=fallback is not None and policy.cpu_fallback,
+                backoff_s=policy.backoff_s,
+                backoff_cap_s=policy.backoff_cap_s, seed=policy.seed)
+            obs.registry().counter("retry_attempts", site=site).inc()
+            obs.emit("retry_attempt", site=site, label=label,
+                     attempt=attempt, error_kind=kind,
+                     error=f"{type(e).__name__}: {e}"[:200],
+                     action=d["action"], delay_s=d["delay_s"],
+                     reason=d["reason"], inputs=d["inputs"],
+                     input_digest=d["input_digest"])
+            if d["action"] == "retry":
+                if d["delay_s"]:
+                    time.sleep(d["delay_s"])
+                continue
+            if d["action"] == "split":
+                return split(e)
+            if d["action"] == "fallback_cpu":
+                obs.registry().counter("degraded_dispatches",
+                                       site=site).inc()
+                obs.registry().gauge("degraded").set(1)
+                obs.emit("degraded_dispatch", site=site, label=label,
+                         attempt=attempt, error_kind=kind,
+                         error=f"{type(e).__name__}: {e}"[:200])
+                return fallback(e)
+            raise

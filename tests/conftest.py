@@ -31,12 +31,17 @@ def _zeroed_telemetry():
     tests — every test starts from zeroed state, and a test that enables
     sync timing cannot slow every later test with device barriers."""
     from adam_tpu import obs
+    from adam_tpu.errors import reset_malformed
     from adam_tpu.instrument import report, set_sync_timing
+    from adam_tpu.resilience import faults
 
     report().reset()
     obs.reset_all()
     set_sync_timing(False)
+    faults.clear_plan()
+    reset_malformed()
     yield
+    faults.clear_plan()
 
 
 def iter_mpileup_tokens(bases: str):

@@ -1,0 +1,728 @@
+"""The resilience plane (adam_tpu/resilience): pure decision replay,
+the dispatch policy ladder, the chaos matrix over the streaming
+flagstat/transform paths (every (site, fault) pair either completes
+byte-identical to the fault-free run or fails cleanly with a typed
+error and no torn artifacts), torn-write crash consistency, the
+malformed-warning cap, elastic restart backoff + worker-kill recovery,
+and the offline validators (tools/check_resilience.py +
+tools/check_metrics.py round trip)."""
+
+from __future__ import annotations
+
+import importlib.util
+import json
+import os
+import pathlib
+import sys
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from adam_tpu import obs
+from adam_tpu.resilience import (InjectedDeviceError, InjectedFault,
+                                 InjectedFormatError, InjectedTornWrite,
+                                 RetryPolicy, classify_error,
+                                 decide_fault, decide_retry,
+                                 dispatch_with_retry, faults)
+from adam_tpu.resilience.retry import backoff_delay
+
+RESOURCES = pathlib.Path(__file__).parent / "resources"
+TOOLS = pathlib.Path(__file__).parent.parent / "tools"
+
+#: a fast policy for tests — same ladder, millisecond backoff
+FAST = dict(ADAM_TPU_RETRY_BACKOFF_S="0.001")
+
+
+def _load_tool(name: str):
+    spec = importlib.util.spec_from_file_location(name,
+                                                 TOOLS / f"{name}.py")
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _rule(site, fault, occurrence=1, **kw):
+    return dict(site=site, fault=fault, occurrence=occurrence, **kw)
+
+
+def _counter(name, **labels):
+    return obs.registry().counter(name, **labels).value
+
+
+# ---------------------------------------------------------------------------
+# pure decisions
+# ---------------------------------------------------------------------------
+
+class TestDecideFault:
+    RULES = [_rule("device_dispatch", "error", occurrence=2,
+                   error="DATA_LOSS"),
+             _rule("spill_write", "truncate", occurrence="3+", frac=0.25),
+             _rule("worker_proc", "kill", occurrence=1, incarnation=0)]
+
+    def _canon(self):
+        return faults.canonicalize_plan({"rules": self.RULES})["rules"]
+
+    def test_deterministic_and_digest_stable(self):
+        kw = dict(site="device_dispatch", occurrence=2,
+                  rules=self._canon())
+        a, b = decide_fault(**kw), decide_fault(**kw)
+        assert a == b and a["fire"] and a["fault"] == "error"
+        # replaying from the RECORDED inputs reproduces the decision
+        # bit-for-bit — the check_resilience contract
+        c = decide_fault(**a["inputs"])
+        assert (c["fire"], c["fault"], c["rule"], c["input_digest"]) == \
+            (a["fire"], a["fault"], a["rule"], a["input_digest"])
+
+    def test_occurrence_specs(self):
+        rules = self._canon()
+        assert not decide_fault(site="device_dispatch", occurrence=1,
+                                rules=rules)["fire"]
+        assert decide_fault(site="device_dispatch", occurrence=2,
+                            rules=rules)["fire"]
+        assert not decide_fault(site="device_dispatch", occurrence=3,
+                                rules=rules)["fire"]
+        # "N+" persists from N on
+        assert not decide_fault(site="spill_write", occurrence=2,
+                                rules=rules)["fire"]
+        for occ in (3, 4, 100):
+            d = decide_fault(site="spill_write", occurrence=occ,
+                             rules=rules)
+            assert d["fire"] and d["fault"] == "truncate" \
+                and d["frac"] == 0.25
+
+    def test_incarnation_gating(self):
+        rules = self._canon()
+        hit = decide_fault(site="worker_proc", occurrence=1,
+                           incarnation=0, rules=rules)
+        miss = decide_fault(site="worker_proc", occurrence=1,
+                            incarnation=1, rules=rules)
+        none = decide_fault(site="worker_proc", occurrence=1,
+                            incarnation=None, rules=rules)
+        assert hit["fire"] and not miss["fire"] and not none["fire"]
+
+    def test_plan_validation_rejects_typos(self):
+        with pytest.raises(ValueError, match="unknown site"):
+            faults.canonicalize_plan(
+                {"rules": [_rule("devise_dispatch", "error")]})
+        with pytest.raises(ValueError, match="unknown fault"):
+            faults.canonicalize_plan(
+                {"rules": [_rule("device_dispatch", "explode")]})
+        with pytest.raises(ValueError, match="occurrence"):
+            faults.canonicalize_plan(
+                {"rules": [_rule("device_dispatch", "error",
+                                 occurrence="sometimes")]})
+
+
+class TestDecideRetry:
+    KW = dict(site="device_dispatch", budget=3, backoff_s=0.05,
+              backoff_cap_s=2.0, seed=0)
+
+    def test_fatal_raises_immediately(self):
+        d = decide_retry(attempt=1, error_kind="fatal", can_split=True,
+                         can_fallback=True, **self.KW)
+        assert d["action"] == "raise"
+
+    def test_oom_splits_when_supported(self):
+        d = decide_retry(attempt=1, error_kind="oom", can_split=True,
+                         can_fallback=True, **self.KW)
+        assert d["action"] == "split" and d["delay_s"] == 0
+        d2 = decide_retry(attempt=1, error_kind="oom", can_split=False,
+                          can_fallback=True, **self.KW)
+        assert d2["action"] == "retry"    # no split site: treat as transient
+
+    def test_transient_ladder_retry_then_fallback_then_raise(self):
+        mk = lambda attempt, fb: decide_retry(
+            attempt=attempt, error_kind="transient", can_split=False,
+            can_fallback=fb, **self.KW)
+        assert mk(1, True)["action"] == "retry"
+        assert mk(2, True)["action"] == "retry"
+        assert mk(3, True)["action"] == "fallback_cpu"
+        assert mk(3, False)["action"] == "raise"
+        # backoff grows and carries deterministic jitter
+        d1, d2 = mk(1, True)["delay_s"], mk(2, True)["delay_s"]
+        assert 0 < d1 < d2
+        assert mk(1, True)["delay_s"] == d1      # replayable
+
+    def test_digest_replay(self):
+        d = decide_retry(attempt=2, error_kind="transient",
+                         can_split=True, can_fallback=True, **self.KW)
+        c = decide_retry(**d["inputs"])
+        assert (c["action"], c["delay_s"], c["input_digest"]) == \
+            (d["action"], d["delay_s"], d["input_digest"])
+
+    def test_backoff_delay_deterministic_and_capped(self):
+        a = backoff_delay("x", 5, 0.05, 2.0)
+        assert a == backoff_delay("x", 5, 0.05, 2.0)
+        assert a <= 2.0 * 1.5
+        assert backoff_delay("x", 1, 0.05, 2.0) != \
+            backoff_delay("y", 1, 0.05, 2.0)     # de-synchronized
+
+
+class TestClassify:
+    def test_injected_codes(self):
+        assert classify_error(
+            InjectedDeviceError("RESOURCE_EXHAUSTED", "s", 1)) == "oom"
+        assert classify_error(
+            InjectedDeviceError("DATA_LOSS", "s", 1)) == "transient"
+        assert classify_error(InjectedTornWrite("x")) == "transient"
+        assert classify_error(InjectedFormatError("bad")) == "fatal"
+        assert classify_error(ValueError("nope")) == "fatal"
+
+    def test_xla_style_messages(self):
+        class XlaRuntimeError(Exception):
+            pass
+        assert classify_error(
+            XlaRuntimeError("RESOURCE_EXHAUSTED: out of memory")) == "oom"
+        assert classify_error(
+            XlaRuntimeError("UNAVAILABLE: socket closed")) == "transient"
+
+
+# ---------------------------------------------------------------------------
+# the dispatch engine (no jax)
+# ---------------------------------------------------------------------------
+
+class TestDispatchEngine:
+    POLICY = RetryPolicy(budget=3, backoff_s=0.001)
+
+    def test_transient_retries_to_success(self):
+        calls = []
+
+        def fn(attempt):
+            calls.append(attempt)
+            if attempt < 3:
+                raise InjectedDeviceError("UNAVAILABLE", "t", attempt)
+            return "ok"
+
+        assert dispatch_with_retry(fn, policy=self.POLICY) == "ok"
+        assert calls == [1, 2, 3]
+        assert _counter("retry_attempts", site="device_dispatch") == 2
+
+    def test_persistent_degrades_to_fallback(self):
+        def fn(attempt):
+            raise InjectedDeviceError("DATA_LOSS", "t", attempt)
+
+        out = dispatch_with_retry(fn, policy=self.POLICY,
+                                  fallback=lambda e: "degraded")
+        assert out == "degraded"
+        assert _counter("degraded_dispatches",
+                        site="device_dispatch") == 1
+        assert obs.registry().gauge("degraded").value == 1
+
+    def test_persistent_without_fallback_raises_typed(self):
+        def fn(attempt):
+            raise InjectedDeviceError("DATA_LOSS", "t", attempt)
+
+        with pytest.raises(InjectedDeviceError):
+            dispatch_with_retry(fn, policy=self.POLICY)
+
+    def test_oom_routes_to_split(self):
+        def fn(attempt):
+            raise InjectedDeviceError("RESOURCE_EXHAUSTED", "t", attempt)
+
+        out = dispatch_with_retry(fn, policy=self.POLICY,
+                                  split=lambda e: "halved",
+                                  fallback=lambda e: "degraded")
+        assert out == "halved"
+
+    def test_realign_engine_inherits_caller_policy(self):
+        # the -retry_budget flag reaches pass 4: StreamExecutor's
+        # resolved policy plumbs through _emit_bins → RealignEngine →
+        # the sweep batcher (env-only resolution is the standalone
+        # fallback)
+        from adam_tpu.parallel.realign_exec import (RealignEngine,
+                                                    decide_realign_plan)
+        plan = decide_realign_plan(n_bins=4, on_tpu=False)
+        pol = RetryPolicy(budget=7)
+        eng = RealignEngine(plan, retry_policy=pol)
+        assert eng.batcher._retry.budget == 7
+
+    def test_fatal_propagates_untouched(self):
+        def fn(attempt):
+            raise ValueError("real bug")
+
+        with pytest.raises(ValueError, match="real bug"):
+            dispatch_with_retry(fn, policy=self.POLICY,
+                                fallback=lambda e: "degraded")
+        assert _counter("degraded_dispatches",
+                        site="device_dispatch") == 0
+
+
+# ---------------------------------------------------------------------------
+# the injection plane
+# ---------------------------------------------------------------------------
+
+class TestFaultPlane:
+    def test_no_plan_is_zero_overhead(self):
+        # no counting, no events, no behavior change
+        faults.clear_plan()
+        for _ in range(3):
+            faults.fire("device_dispatch")
+        assert not faults.active()
+        snap = obs.registry().snapshot()
+        assert not any(k.startswith("faults_injected")
+                       for k in snap["counters"])
+
+    def test_error_fires_on_exact_occurrence(self):
+        faults.install_plan({"rules": [_rule(
+            "device_dispatch", "error", occurrence=3,
+            error="UNAVAILABLE")]})
+        faults.fire("device_dispatch")
+        faults.fire("device_dispatch")
+        with pytest.raises(InjectedDeviceError) as ei:
+            faults.fire("device_dispatch")
+        assert ei.value.code == "UNAVAILABLE"
+        faults.fire("device_dispatch")            # occurrence 4: clean
+        assert _counter("faults_injected", site="device_dispatch") == 1
+
+    def test_truncate_tears_the_file(self, tmp_path):
+        p = tmp_path / "artifact.bin"
+        p.write_bytes(b"x" * 1000)
+        faults.install_plan({"rules": [_rule(
+            "checkpoint_write", "truncate", frac=0.5)]})
+        with pytest.raises(InjectedTornWrite):
+            faults.fire("checkpoint_write", path=str(p))
+        assert p.stat().st_size == 500
+
+    def test_corrupt_overwrites_without_resizing(self, tmp_path):
+        p = tmp_path / "artifact.bin"
+        p.write_bytes(b"a" * 1000)
+        faults.install_plan({"rules": [_rule(
+            "spill_write", "corrupt")]})
+        with pytest.raises(InjectedTornWrite):
+            faults.fire("spill_write", path=str(p))
+        data = p.read_bytes()
+        assert len(data) == 1000 and b"\xff" in data
+
+    def test_env_install(self, tmp_path, monkeypatch):
+        plan = tmp_path / "plan.json"
+        plan.write_text(json.dumps(
+            {"rules": [_rule("feeder_load", "latency",
+                             latency_s=0.0)]}))
+        monkeypatch.setenv(faults.FAULT_PLAN_ENV, str(plan))
+        assert faults.install_from_env() is not None
+        assert faults.active()
+
+
+# ---------------------------------------------------------------------------
+# chaos matrix: streaming flagstat
+# ---------------------------------------------------------------------------
+
+def _flagstat(src, **kw):
+    from adam_tpu.parallel.pipeline import streaming_flagstat
+    return streaming_flagstat(src, chunk_rows=64, **kw)
+
+
+class TestFlagstatChaos:
+    @pytest.fixture(scope="class")
+    def baseline(self):
+        faults.clear_plan()
+        return _flagstat(str(RESOURCES / "reads12.sam"))
+
+    def _run(self, rules, monkeypatch):
+        for k, v in FAST.items():
+            monkeypatch.setenv(k, v)
+        faults.install_plan({"rules": rules})
+        try:
+            return _flagstat(str(RESOURCES / "reads12.sam"))
+        finally:
+            faults.clear_plan()
+
+    def test_transient_dispatch_error_retries_to_identity(
+            self, baseline, monkeypatch):
+        got = self._run([_rule("device_dispatch", "error",
+                               error="DATA_LOSS")], monkeypatch)
+        assert got == baseline
+        assert _counter("retry_attempts", site="device_dispatch") >= 1
+        assert _counter("faults_injected", site="device_dispatch") == 1
+
+    def test_oom_splits_along_the_ladder_to_identity(
+            self, baseline, monkeypatch):
+        got = self._run([_rule("device_dispatch", "error",
+                               error="RESOURCE_EXHAUSTED")], monkeypatch)
+        assert got == baseline
+        assert _counter("retry_attempts", site="device_dispatch") >= 1
+
+    def test_persistent_device_loss_degrades_to_cpu_identity(
+            self, baseline, monkeypatch):
+        got = self._run([_rule("device_dispatch", "error",
+                               occurrence="1+", error="DATA_LOSS")],
+                        monkeypatch)
+        assert got == baseline
+        assert _counter("degraded_dispatches",
+                        site="device_dispatch") >= 1
+        assert obs.registry().gauge("degraded").value == 1
+
+    def test_persistent_oom_fails_cleanly_at_the_split_floor(
+            self, baseline, monkeypatch):
+        with pytest.raises(InjectedDeviceError):
+            self._run([_rule("device_dispatch", "error",
+                             occurrence="1+",
+                             error="RESOURCE_EXHAUSTED")], monkeypatch)
+
+    def test_dispatch_latency_changes_nothing(self, baseline,
+                                              monkeypatch):
+        got = self._run([_rule("device_dispatch", "latency",
+                               occurrence="1+", latency_s=0.001)],
+                        monkeypatch)
+        assert got == baseline
+
+    def test_device_put_error_retries_to_identity(self, baseline,
+                                                  monkeypatch):
+        got = self._run([_rule("device_put", "error",
+                               error="UNAVAILABLE")], monkeypatch)
+        assert got == baseline
+        assert _counter("retry_attempts", site="device_put") >= 1
+
+    def test_feeder_load_error_fails_cleanly(self, baseline,
+                                             monkeypatch):
+        with pytest.raises(InjectedDeviceError):
+            self._run([_rule("feeder_load", "error", occurrence=2,
+                             error="INTERNAL")], monkeypatch)
+
+    def test_feeder_load_error_fails_cleanly_threaded(self, baseline,
+                                                      monkeypatch):
+        for k, v in FAST.items():
+            monkeypatch.setenv(k, v)
+        faults.install_plan({"rules": [_rule(
+            "feeder_load", "error", occurrence=2, error="INTERNAL")]})
+        with pytest.raises(InjectedDeviceError):
+            _flagstat(str(RESOURCES / "reads12.sam"), io_threads=2)
+
+    def test_feeder_latency_changes_nothing(self, baseline,
+                                            monkeypatch):
+        got = self._run([_rule("feeder_load", "latency",
+                               occurrence="1+", latency_s=0.001)],
+                        monkeypatch)
+        assert got == baseline
+
+    def test_no_plan_emits_no_resilience_events(self, baseline,
+                                                tmp_path):
+        faults.clear_plan()
+        side = tmp_path / "clean.jsonl"
+        with obs.metrics_run(str(side)):
+            got = _flagstat(str(RESOURCES / "reads12.sam"))
+        assert got == baseline
+        events = [json.loads(ln)["event"]
+                  for ln in side.read_text().splitlines()]
+        assert not {"fault_injected", "retry_attempt",
+                    "degraded_dispatch"} & set(events)
+        snap = obs.registry().snapshot()
+        assert not any(k.startswith(("faults_injected", "retry_attempts",
+                                     "degraded_dispatches"))
+                       for k in snap["counters"])
+
+
+class TestInputRecordChaos:
+    def test_injected_record_error_is_typed_format_error(self, tmp_path):
+        from adam_tpu.io.bam import read_bam, write_bam
+        from adam_tpu.io.sam import read_sam
+
+        table, seq_dict, _ = read_sam(str(RESOURCES / "small.sam"))
+        bam = tmp_path / "small.bam"
+        write_bam(table, seq_dict, str(bam))
+        ref = read_bam(str(bam))[0]
+        faults.install_plan({"rules": [_rule(
+            "input_record", "error", occurrence=2, error="FORMAT")]})
+        from adam_tpu.errors import FormatError
+        with pytest.raises(FormatError):
+            read_bam(str(bam))
+        # clean rerun decodes identically (the plane left no state)
+        faults.clear_plan()
+        assert read_bam(str(bam))[0].equals(ref)
+
+    def test_occurrence_counts_records_not_loop_iterations(
+            self, tmp_path):
+        # occurrence N must mean the Nth RECORD, independent of how the
+        # streaming decoder's buffer refills chunk the walk — a tiny
+        # chunk_bytes forces many refill iterations between records
+        from adam_tpu.io.bam import open_bam_stream, read_bam, write_bam
+        from adam_tpu.io.sam import read_sam
+
+        table, seq_dict, _ = read_sam(str(RESOURCES / "small.sam"))
+        bam = tmp_path / "small.bam"
+        write_bam(table, seq_dict, str(bam))
+        n = table.num_rows
+
+        def stream_rows(occurrence):
+            faults.install_plan({"rules": [_rule(
+                "input_record", "error", occurrence=occurrence,
+                error="FORMAT")]})
+            try:
+                _, _, gen = open_bam_stream(str(bam), chunk_bytes=64)
+                return sum(t.num_rows for t in gen)
+            finally:
+                faults.clear_plan()
+
+        # past the last record: the stream completes in full
+        assert stream_rows(n + 1) == n
+        # exactly the last record: fails (so the count is record-exact)
+        with pytest.raises(InjectedFormatError):
+            stream_rows(n)
+        # and the whole-file decoder agrees on the same occurrence
+        faults.install_plan({"rules": [_rule(
+            "input_record", "error", occurrence=n, error="FORMAT")]})
+        with pytest.raises(InjectedFormatError):
+            read_bam(str(bam))
+
+
+# ---------------------------------------------------------------------------
+# chaos matrix: streaming transform (+ torn-write crash consistency)
+# ---------------------------------------------------------------------------
+
+def _transform(out, workdir=None, resume=False, **kw):
+    from adam_tpu.parallel.pipeline import streaming_transform
+    return streaming_transform(
+        str(RESOURCES / "reads12.sam"), str(out), markdup=True,
+        bqsr=True, sort=True, chunk_rows=64,
+        workdir=None if workdir is None else str(workdir),
+        resume=resume, **kw)
+
+
+def _load_sorted(path):
+    from adam_tpu.io.parquet import load_table
+    return load_table(str(path))
+
+
+class TestTransformChaos:
+    @pytest.fixture(scope="class")
+    def baseline(self, tmp_path_factory):
+        faults.clear_plan()
+        out = tmp_path_factory.mktemp("base") / "out"
+        n = _transform(out)
+        return n, _load_sorted(out)
+
+    def test_transient_dispatch_retries_to_identity(
+            self, baseline, tmp_path, monkeypatch):
+        for k, v in FAST.items():
+            monkeypatch.setenv(k, v)
+        n0, ref = baseline
+        faults.install_plan({"rules": [_rule(
+            "device_dispatch", "error", occurrence=2,
+            error="UNAVAILABLE")]})
+        n = _transform(tmp_path / "out")
+        faults.clear_plan()
+        assert n == n0
+        assert _load_sorted(tmp_path / "out").equals(ref)
+        assert _counter("retry_attempts", site="device_dispatch") >= 1
+
+    def test_persistent_device_loss_degrades_to_identity(
+            self, baseline, tmp_path, monkeypatch):
+        for k, v in FAST.items():
+            monkeypatch.setenv(k, v)
+        n0, ref = baseline
+        faults.install_plan({"rules": [_rule(
+            "device_dispatch", "error", occurrence="1+",
+            error="DATA_LOSS")]})
+        n = _transform(tmp_path / "out")
+        faults.clear_plan()
+        assert n == n0
+        assert _load_sorted(tmp_path / "out").equals(ref)
+        assert _counter("degraded_dispatches",
+                        site="device_dispatch") >= 1
+
+    def test_torn_spill_crashes_then_resumes_to_identity(
+            self, baseline, tmp_path, monkeypatch):
+        for k, v in FAST.items():
+            monkeypatch.setenv(k, v)
+        n0, ref = baseline
+        wd = tmp_path / "wd"
+        out = tmp_path / "out"
+        faults.install_plan({"rules": [_rule(
+            "spill_write", "truncate", occurrence=2)]})
+        with pytest.raises(InjectedTornWrite):
+            _transform(out, workdir=wd, resume=True)
+        # the crash left no completed-pass marker pointing at the torn
+        # spill: either no manifest yet, or one whose passes are all
+        # genuinely re-loadable (p1 incomplete here)
+        manifest = wd / "stream_checkpoint.json"
+        if manifest.exists():
+            state = json.loads(manifest.read_text())
+            assert "p1" not in state["passes"]
+        faults.clear_plan()
+        n = _transform(out, workdir=wd, resume=True)
+        assert n == n0
+        assert _load_sorted(out).equals(ref)
+
+    def test_torn_checkpoint_manifest_crashes_then_resumes(
+            self, baseline, tmp_path, monkeypatch):
+        for k, v in FAST.items():
+            monkeypatch.setenv(k, v)
+        n0, ref = baseline
+        wd = tmp_path / "wd"
+        out = tmp_path / "out"
+        faults.install_plan({"rules": [_rule(
+            "checkpoint_write", "truncate", occurrence=1)]})
+        with pytest.raises(InjectedTornWrite):
+            _transform(out, workdir=wd, resume=True)
+        # the torn write hit the TMP file — the published manifest is
+        # either absent or valid JSON (tmp+fsync+rename discipline)
+        manifest = wd / "stream_checkpoint.json"
+        if manifest.exists():
+            json.loads(manifest.read_text())
+        faults.clear_plan()
+        n = _transform(out, workdir=wd, resume=True)
+        assert n == n0
+        assert _load_sorted(out).equals(ref)
+
+
+class TestCheckpointDirTornWrite:
+    def test_manifest_fsyncs_and_survives_torn_tmp(self, tmp_path,
+                                                   monkeypatch):
+        from adam_tpu.checkpoint import CheckpointDir
+
+        synced = []
+        real_fsync = os.fsync
+        monkeypatch.setattr(os, "fsync",
+                            lambda fd: (synced.append(fd),
+                                        real_fsync(fd))[1])
+        ck = CheckpointDir(str(tmp_path / "ck"), ["cfg"])
+        ck.save("00-stage", pa.table({"x": pa.array([1, 2, 3])}))
+        assert synced, "manifest write must fsync before rename"
+        # now tear the NEXT manifest write mid-tmp: the published
+        # manifest must still name only the completed first stage
+        faults.install_plan({"rules": [_rule(
+            "checkpoint_write", "truncate", occurrence=1)]})
+        with pytest.raises(InjectedTornWrite):
+            ck.save("01-next", pa.table({"x": pa.array([4])}))
+        faults.clear_plan()
+        ck2 = CheckpointDir(str(tmp_path / "ck"), ["cfg"])
+        assert ck2.completed == ["00-stage"]
+
+
+# ---------------------------------------------------------------------------
+# satellites: malformed-warning cap, elastic backoff + worker kill
+# ---------------------------------------------------------------------------
+
+class TestMalformedCap:
+    def _spam(self, n, stringency="lenient"):
+        from adam_tpu.errors import handle_malformed
+        for i in range(n):
+            handle_malformed(stringency, f"bad record {i}")
+
+    def test_lenient_caps_stderr_and_counts_all(self, capsys,
+                                                monkeypatch):
+        monkeypatch.setenv("ADAM_TPU_MAX_MALFORMED_WARNINGS", "5")
+        self._spam(12)
+        err = capsys.readouterr().err
+        lines = [ln for ln in err.splitlines() if ln]
+        assert len(lines) == 6                      # 5 warnings + notice
+        assert sum("bad record" in ln for ln in lines) == 5
+        assert "suppressing" in lines[-1]
+        assert _counter("malformed_records") == 12
+        from adam_tpu.errors import malformed_summary
+        s = malformed_summary()
+        assert "12" in s and "7" in s               # 7 suppressed
+
+    def test_silent_counts_without_stderr(self, capsys):
+        self._spam(4, stringency="silent")
+        assert capsys.readouterr().err == ""
+        assert _counter("malformed_records") == 4
+        from adam_tpu.errors import malformed_summary
+        assert "4" in malformed_summary()
+
+    def test_strict_still_raises(self):
+        from adam_tpu.errors import FormatError, handle_malformed
+        with pytest.raises(FormatError):
+            handle_malformed("strict", "bad")
+
+
+class TestElasticResilience:
+    def test_restart_backoff_recorded_and_applied(self, tmp_path):
+        from adam_tpu.parallel.elastic import supervise
+
+        marker = tmp_path / "second_try"
+        body = ("import os, sys\n"
+                f"m = {str(marker)!r}\n"
+                "if os.path.exists(m): sys.exit(0)\n"
+                "open(m, 'w').write('x'); sys.exit(7)\n")
+        side = tmp_path / "sup.jsonl"
+        with obs.metrics_run(str(side)):
+            inc = supervise(
+                lambda pid, coord: [sys.executable, "-c", body],
+                num_processes=1, max_restarts=2,
+                log_dir=str(tmp_path / "logs"),
+                restart_backoff_s=0.01)
+        assert inc.number == 1
+        events = [json.loads(ln)
+                  for ln in side.read_text().splitlines()]
+        incs = [e for e in events if e["event"] == "incarnation"]
+        assert incs[0]["restart_delay_s"] == 0
+        assert incs[1]["restart_delay_s"] > 0
+
+    def test_worker_kill_fault_recovers_on_next_incarnation(
+            self, tmp_path):
+        from adam_tpu.parallel.elastic import supervise
+
+        plan = tmp_path / "plan.json"
+        plan.write_text(json.dumps({"rules": [_rule(
+            "worker_proc", "kill", incarnation=0)]}))
+        repo = str(pathlib.Path(__file__).parent.parent)
+        body = ("import sys\n"
+                f"sys.path.insert(0, {repo!r})\n"
+                "from adam_tpu.resilience import faults\n"
+                "faults.install_from_env()\n"
+                "faults.fire('worker_proc')\n"
+                "print('WORKER_OK')\n")
+        env = dict(os.environ)
+        env[faults.FAULT_PLAN_ENV] = str(plan)
+        inc = supervise(
+            lambda pid, coord: [sys.executable, "-c", body],
+            num_processes=1, max_restarts=2, env=env,
+            log_dir=str(tmp_path / "logs"), restart_backoff_s=0.01)
+        # incarnation 0 was SIGKILLed by the plan; the supervisor's
+        # stamped ADAM_TPU_INCARNATION kept the rule off incarnation 1
+        assert inc.number == 1
+        assert "WORKER_OK" in open(inc.logs[0]).read()
+
+
+# ---------------------------------------------------------------------------
+# offline validators round trip
+# ---------------------------------------------------------------------------
+
+class TestValidators:
+    def _faulted_sidecar(self, tmp_path, monkeypatch):
+        for k, v in FAST.items():
+            monkeypatch.setenv(k, v)
+        side = tmp_path / "run.jsonl"
+        faults.install_plan({"rules": [
+            _rule("device_dispatch", "error", error="DATA_LOSS"),
+            _rule("device_dispatch", "latency", occurrence=3,
+                  latency_s=0.0)]})
+        with obs.metrics_run(str(side), argv=["test"]):
+            _flagstat(str(RESOURCES / "reads12.sam"))
+        faults.clear_plan()
+        return side
+
+    def test_round_trip_validates(self, tmp_path, monkeypatch):
+        side = self._faulted_sidecar(tmp_path, monkeypatch)
+        cm = _load_tool("check_metrics")
+        assert cm.validate(str(side)) == []
+        cr = _load_tool("check_resilience")
+        assert cr.check([str(side)]) == []
+        events = [json.loads(ln)["event"]
+                  for ln in side.read_text().splitlines()]
+        assert "fault_injected" in events and "retry_attempt" in events
+
+    def test_tampered_decision_fails_replay(self, tmp_path,
+                                            monkeypatch):
+        side = self._faulted_sidecar(tmp_path, monkeypatch)
+        lines = side.read_text().splitlines()
+        out = []
+        for ln in lines:
+            doc = json.loads(ln)
+            if doc.get("event") == "retry_attempt":
+                doc["action"] = "fallback_cpu" \
+                    if doc["action"] != "fallback_cpu" else "retry"
+            out.append(json.dumps(doc))
+        tampered = tmp_path / "tampered.jsonl"
+        tampered.write_text("\n".join(out) + "\n")
+        cr = _load_tool("check_resilience")
+        errs = cr.check([str(tampered)])
+        assert errs and any("non-deterministic" in e for e in errs)
+
+    def test_no_events_is_an_error(self, tmp_path):
+        empty = tmp_path / "empty.jsonl"
+        empty.write_text('{"event": "manifest", "t": 0}\n')
+        cr = _load_tool("check_resilience")
+        assert cr.check([str(empty)])

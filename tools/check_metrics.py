@@ -29,6 +29,16 @@ elastic worker sidecars).  Contract checked here:
 * ``realign_sweep_dispatch`` events carry ``shape`` (three positive
   ints), ``jobs >= 1``, padded lane count ``g >= jobs`` and
   ``units >= 1`` (distinct bins sharing the dispatch);
+* ``fault_injected`` events carry ``site`` (a known injection site),
+  ``occurrence`` (int >= 1), ``fault`` (a known fault kind),
+  ``inputs`` (object) and a hex ``input_digest``
+  (tools/check_resilience.py replays the firing decision);
+* ``retry_attempt`` events carry ``site``, ``attempt`` (int >= 1),
+  ``error_kind``, ``action`` (retry/split/fallback_cpu/raise),
+  ``delay_s`` (number >= 0), ``inputs`` (object) and a hex
+  ``input_digest`` (the policy decision is pure and replayable);
+* ``degraded_dispatch`` events carry ``site``, ``attempt`` (int >= 1)
+  and ``error_kind`` — the chunk completed on the CPU fallback;
 * the last line is the ``summary``: ``wall_seconds``, ``ok``, and a
   ``metrics`` snapshot whose counters/gauges are numeric and whose
   histograms are internally consistent (count == sum of bucket counts);
@@ -52,6 +62,15 @@ from typing import List
 SCHEMA_VERSION = 1
 
 _NUM = (int, float)
+
+#: mirror of adam_tpu.resilience.faults.SITES / FAULTS (kept literal so
+#: the validator runs without importing the package, like the rest of
+#: this file's schema knowledge)
+_FAULT_SITES = ("device_dispatch", "device_put", "spill_write",
+                "checkpoint_write", "feeder_load", "worker_proc",
+                "input_record")
+_FAULT_KINDS = ("error", "latency", "truncate", "corrupt", "kill")
+_RETRY_ACTIONS = ("retry", "split", "fallback_cpu", "raise")
 
 
 def _is_num(v) -> bool:
@@ -247,6 +266,53 @@ def validate(path: str) -> List[str]:
             if not (isinstance(units, int) and not isinstance(units, bool)
                     and units >= 1):
                 err(i, "realign_sweep_dispatch missing int 'units' >= 1")
+        elif ev == "fault_injected":
+            if d.get("site") not in _FAULT_SITES:
+                err(i, f"fault_injected unknown site {d.get('site')!r}")
+            occ = d.get("occurrence")
+            if not (isinstance(occ, int) and not isinstance(occ, bool)
+                    and occ >= 1):
+                err(i, "fault_injected missing int 'occurrence' >= 1")
+            if d.get("fault") not in _FAULT_KINDS:
+                err(i, f"fault_injected unknown fault {d.get('fault')!r}")
+            if not isinstance(d.get("inputs"), dict):
+                err(i, "fault_injected missing 'inputs' object "
+                       "(firing must be replayable)")
+            dig = d.get("input_digest")
+            if not (isinstance(dig, str) and len(dig) >= 8 and
+                    all(c in "0123456789abcdef" for c in dig)):
+                err(i, "fault_injected missing hex 'input_digest'")
+        elif ev == "retry_attempt":
+            if d.get("site") not in _FAULT_SITES:
+                err(i, f"retry_attempt unknown site {d.get('site')!r}")
+            att = d.get("attempt")
+            if not (isinstance(att, int) and not isinstance(att, bool)
+                    and att >= 1):
+                err(i, "retry_attempt missing int 'attempt' >= 1")
+            if not isinstance(d.get("error_kind"), str):
+                err(i, "retry_attempt missing string 'error_kind'")
+            if d.get("action") not in _RETRY_ACTIONS:
+                err(i, f"retry_attempt unknown action "
+                       f"{d.get('action')!r}")
+            if not (_is_num(d.get("delay_s")) and d["delay_s"] >= 0):
+                err(i, "retry_attempt missing non-negative 'delay_s'")
+            if not isinstance(d.get("inputs"), dict):
+                err(i, "retry_attempt missing 'inputs' object "
+                       "(decision must be replayable)")
+            dig = d.get("input_digest")
+            if not (isinstance(dig, str) and len(dig) >= 8 and
+                    all(c in "0123456789abcdef" for c in dig)):
+                err(i, "retry_attempt missing hex 'input_digest'")
+        elif ev == "degraded_dispatch":
+            if d.get("site") not in _FAULT_SITES:
+                err(i, f"degraded_dispatch unknown site "
+                       f"{d.get('site')!r}")
+            att = d.get("attempt")
+            if not (isinstance(att, int) and not isinstance(att, bool)
+                    and att >= 1):
+                err(i, "degraded_dispatch missing int 'attempt' >= 1")
+            if not isinstance(d.get("error_kind"), str):
+                err(i, "degraded_dispatch missing string 'error_kind'")
 
     if summaries:
         i, s = summaries[0]

@@ -1,0 +1,157 @@
+#!/usr/bin/env python3
+"""Replay a telemetry sidecar's fault firings and retry decisions and
+assert they are deterministic.
+
+The resilience plane's two decision functions
+(adam_tpu/resilience/faults.py ``decide_fault``,
+adam_tpu/resilience/retry.py ``decide_retry``) are PURE functions of
+their inputs, and every ``fault_injected`` / ``retry_attempt`` event
+records those inputs verbatim plus a digest of them.  This checker
+re-derives each recorded decision offline and fails when:
+
+* replaying ``decide_fault(**inputs)`` does not fire, fires a different
+  fault, or picks a different rule than the event recorded (the plane
+  drifted from purity — e.g. someone added a clock or random read);
+* replaying ``decide_retry(**inputs)`` yields a different action or
+  delay than the event recorded (the policy drifted);
+* a recorded ``input_digest`` does not match the digest of the recorded
+  inputs (the event lied about what it decided from);
+* two events — within one file or across files — share an
+  ``input_digest`` but disagree on the decision (same inputs must mean
+  the same firing/action, the determinism contract the chaos matrix
+  pins).
+
+Usage::
+
+    python tools/check_resilience.py RUN.metrics.jsonl [...]
+
+Exit 0 when every recorded decision replays identically; 1 otherwise
+with one line per violation.  Companion to tools/check_metrics.py
+(which validates the event SCHEMA; this validates the event's
+semantics) and tools/check_executor.py (the same convention for the
+executor's plans).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+from typing import Dict, List, Tuple
+
+# runnable as a script from anywhere (same repo-root shim as aot_check)
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+#: the decision fields a replay must reproduce exactly, per event kind
+FAULT_FIELDS = ("fault", "rule")
+RETRY_FIELDS = ("action", "delay_s")
+
+
+def _events(path: str, kinds: tuple) -> List[Tuple[int, dict]]:
+    out = []
+    with open(path) as f:
+        for i, ln in enumerate(f, 1):
+            if not ln.strip():
+                continue
+            try:
+                doc = json.loads(ln)
+            except ValueError:
+                continue        # schema problems are check_metrics' job
+            if isinstance(doc, dict) and doc.get("event") in kinds:
+                out.append((i, doc))
+    return out
+
+
+def _check_one(path, i, ev, replay_fn, fields, errs, by_digest, kind):
+    inputs = ev.get("inputs")
+    if not isinstance(inputs, dict):
+        errs.append(f"{path}:{i}: {kind} event carries no inputs — "
+                    "decision cannot be replayed")
+        return False
+    try:
+        d = replay_fn(**inputs)
+    except TypeError as e:
+        errs.append(f"{path}:{i}: inputs do not replay through "
+                    f"{kind}: {e}")
+        return False
+    for field in fields:
+        if ev.get(field) != d.get(field):
+            errs.append(
+                f"{path}:{i}: non-deterministic {kind} decision — "
+                f"recorded {field}={ev.get(field)!r}, replay yields "
+                f"{d.get(field)!r}")
+    if kind == "fault" and not d.get("fire"):
+        errs.append(f"{path}:{i}: recorded firing does not fire on "
+                    "replay — the plane decided from something beyond "
+                    "its recorded inputs")
+    if ev.get("input_digest") != d.get("input_digest"):
+        errs.append(
+            f"{path}:{i}: input_digest mismatch (recorded "
+            f"{ev.get('input_digest')!r}, inputs digest to "
+            f"{d.get('input_digest')!r})")
+    # cross-event/cross-file: one digest, one decision
+    decision = {f: ev.get(f) for f in fields}
+    dig = ev.get("input_digest")
+    if isinstance(dig, str):
+        seen = by_digest.get((kind, dig))
+        if seen is None:
+            by_digest[(kind, dig)] = (path, i, decision)
+        elif seen[2] != decision:
+            errs.append(
+                f"{path}:{i}: digest {dig} decided differently than "
+                f"{seen[0]}:{seen[1]} — same inputs must yield the "
+                "same decision")
+    return True
+
+
+def check(paths: List[str]) -> List[str]:
+    """Replay every recorded firing/policy decision; return
+    human-readable violations (empty = deterministic)."""
+    from adam_tpu.resilience.faults import decide_fault
+    from adam_tpu.resilience.retry import decide_retry
+
+    errs: List[str] = []
+    by_digest: Dict[tuple, Tuple[str, int, dict]] = {}
+    n_checked = 0
+    for path in paths:
+        faults = _events(path, ("fault_injected",))
+        retries = _events(path, ("retry_attempt",))
+        if not faults and not retries:
+            errs.append(f"{path}: no fault_injected/retry_attempt "
+                        "events (not a faulted run, or events were "
+                        "lost)")
+            continue
+        for i, ev in faults:
+            if _check_one(path, i, ev, decide_fault, FAULT_FIELDS,
+                          errs, by_digest, "fault"):
+                n_checked += 1
+        for i, ev in retries:
+            if _check_one(path, i, ev, decide_retry, RETRY_FIELDS,
+                          errs, by_digest, "retry"):
+                n_checked += 1
+    if not errs and not n_checked:
+        errs.append("no replayable resilience decisions found")
+    return errs
+
+
+def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    if not argv:
+        print("usage: check_resilience.py RUN.metrics.jsonl [...]",
+              file=sys.stderr)
+        return 2
+    errors = check(argv)
+    if errors:
+        for e in errors:
+            print(e, file=sys.stderr)
+        return 1
+    n = sum(len(_events(p, ("fault_injected", "retry_attempt")))
+            for p in argv)
+    print(f"ok: {n} resilience decision(s) replayed deterministically "
+          f"across {len(argv)} file(s)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
