@@ -60,6 +60,15 @@ def main(argv=None) -> int:
         p.add_argument("-metrics", default=None, metavar="PATH",
                        help="write run telemetry (JSONL manifest/events/"
                             "metrics snapshot) to PATH")
+        # ... the run timeline (docs/OBSERVABILITY.md): thread-aware
+        # spans exported as Chrome-trace/Perfetto JSON — main thread,
+        # feeder threads, prep pools each get their own lane.  Zero
+        # overhead unless the flag (or ADAM_TPU_TRACE, how workers
+        # inherit it) names a path.
+        p.add_argument("-trace", default=None, metavar="PATH",
+                       help="write a Chrome-trace/Perfetto timeline of "
+                            "this run's spans (thread lanes) to PATH "
+                            "(ADAM_TPU_TRACE is the env fallback)")
         # ... and the fault-injection plane (docs/RESILIENCE.md): a
         # seeded, replayable plan of which site fires on which
         # occurrence with which fault.  Unset (the normal case) the
@@ -83,7 +92,8 @@ def main(argv=None) -> int:
     enable_compilation_cache()
     from ..errors import FormatError, malformed_summary, reset_malformed
     from ..instrument import log_invocation, say
-    from ..obs import metrics_path_from, metrics_run
+    from ..obs import (metrics_path_from, metrics_run, trace_path_from,
+                       trace_run)
     from ..resilience import InjectedFault, faults
     full_argv = ["adam-tpu"] + list(argv if argv is not None
                                     else sys.argv[1:])
@@ -103,13 +113,17 @@ def main(argv=None) -> int:
     reset_malformed()
     # the config fingerprint covers every parsed flag, so two runs with
     # the same manifest fingerprint really ran the same configuration
+    # (sidecar paths excluded: where telemetry goes is not what ran)
     config = {k: v for k, v in vars(args).items()
-              if not k.startswith("_") and k != "metrics"}
+              if not k.startswith("_") and k not in ("metrics", "trace")}
     try:
         with metrics_run(metrics_path_from(args.metrics), argv=full_argv,
                          config=config, command=args.command):
-            faults.fire("worker_proc")
-            rc = args._cmd.run(args) or 0
+            # trace nests INSIDE metrics so the trace_written receipt
+            # lands in the metrics sidecar before its summary closes
+            with trace_run(trace_path_from(getattr(args, "trace", None))):
+                faults.fire("worker_proc")
+                rc = args._cmd.run(args) or 0
     except (FileNotFoundError, IsADirectoryError, FormatError) as e:
         print(f"adam-tpu {args.command}: {e}", file=sys.stderr)
         return 2

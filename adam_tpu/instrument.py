@@ -15,20 +15,28 @@ Usage::
     print(report().format())
 
 Timers are process-global (one pipeline per process, matching the CLI) and
-cheap enough to leave on; the JAX profiler is only started when a trace
-directory is given (it interacts with compilation caching).
+cheap enough to leave on; the stage STACK is per-thread (contextvar), so
+feeder threads and prep pools time their own stages without corrupting
+the main thread's nesting.  Every stage exit also lands on the opt-in
+run timeline (``obs.trace`` — the CLI's ``-trace`` flag) as a span on
+the calling thread's lane.  The JAX profiler is only started when a
+trace directory is given (it interacts with compilation caching).
 """
 
 from __future__ import annotations
 
 import contextlib
+import contextvars
 import os
 import sys
+import threading
 import time
 from dataclasses import dataclass, field
 from typing import Dict, Iterator, List, Optional
 
 from .obs import stage_finished as _obs_stage_finished
+from .obs import ioledger as _ioledger
+from .obs import trace as _trace
 
 
 @dataclass
@@ -39,10 +47,32 @@ class StageStats:
     children: "Dict[str, StageStats]" = field(default_factory=dict)
 
 
+#: the stage stack is PER-THREAD (contextvar: each thread — and each
+#: asyncio task — sees its own), replacing the process-shared list that
+#: forced PR 3 to run feed producers unstaged: interleaved stages from a
+#: feeder thread and the consumer would pop each other's frames and
+#: mis-nest the whole timing tree.  Each thread's stages root at the
+#: report root, so feeder/prep-pool work shows up as its own top-level
+#: lane instead of corrupting the main thread's nesting.
+_STACKS: "contextvars.ContextVar[Optional[List[StageStats]]]" = \
+    contextvars.ContextVar("adam_tpu_stage_stack", default=None)
+
+#: tree mutations (setdefault + the exit accounting) are cross-thread
+#: now; one cheap lock keeps calls/seconds exact
+_TREE_LOCK = threading.Lock()
+
+
+def _stage_stack() -> List[StageStats]:
+    s = _STACKS.get()
+    if s is None:
+        s = []
+        _STACKS.set(s)
+    return s
+
+
 @dataclass
 class PipelineReport:
     root: StageStats = field(default_factory=lambda: StageStats("pipeline"))
-    _stack: List[StageStats] = field(default_factory=list)
 
     def format(self) -> str:
         lines = ["stage timing:"]
@@ -61,7 +91,10 @@ class PipelineReport:
 
     def reset(self) -> None:
         self.root = StageStats("pipeline")
-        self._stack = []
+        # clear the CALLING thread's stack: stages opened after a reset
+        # must not nest under a node of the discarded tree (other
+        # threads' stacks drain naturally as their open stages exit)
+        _STACKS.set([])
 
 
 _REPORT = PipelineReport()
@@ -82,9 +115,15 @@ def say(msg: str) -> None:
 
 
 def print_report() -> None:
-    """The CLI's ``-timing`` output, through the same quiet gate."""
+    """The CLI's ``-timing`` output, through the same quiet gate.  The
+    per-pass I/O ledger rides along when a run recorded any — the
+    decoded/spilled/re-read breakdown belongs in the same end-of-run
+    report as the stage walls it explains."""
     if not quiet():
         print(_REPORT.format())
+        io_lines = _ioledger.format_report()
+        if io_lines:
+            print(io_lines)
 
 #: whether ``stage(sync=True)`` actually drains device queues.  Accurate
 #: per-stage attribution costs a host/device barrier per stage entry+exit,
@@ -108,23 +147,42 @@ def stage(name: str, *, sync: bool = False) -> Iterator[None]:
     """Time a pipeline stage; nests.  ``sync=True`` drains pending device
     work first so the stage is charged its own device time, not its
     predecessor's (async dispatch otherwise misattributes) — gated on
-    :func:`set_sync_timing` so untimed runs keep full pipelining."""
-    parent = _REPORT._stack[-1] if _REPORT._stack else _REPORT.root
-    node = parent.children.setdefault(name, StageStats(name))
+    :func:`set_sync_timing` so untimed runs keep full pipelining.
+
+    THREAD-AWARE: the stack is per-thread (contextvar), so feeder
+    threads, the realign prep pool, and pipelined ingest workers may all
+    run staged concurrently — each thread's stages nest among themselves
+    and root at the report root.  When the tracing plane is on
+    (``obs.trace``), every stage exit also records a span on this
+    thread's timeline lane."""
+    stack = _stage_stack()
+    with _TREE_LOCK:
+        parent = stack[-1] if stack else _REPORT.root
+        node = parent.children.setdefault(name, StageStats(name))
     sync = sync and _SYNC_TIMING
     if sync:
         _block_on_device()
+    tr = _trace.active()
+    ts0 = tr.now_us() if tr is not None else 0.0
     t0 = time.perf_counter()
-    _REPORT._stack.append(node)
+    stack.append(node)
     try:
         yield
     finally:
         if sync:
             _block_on_device()
-        _REPORT._stack.pop()
-        node.calls += 1
+        stack.pop()
         dt = time.perf_counter() - t0
-        node.seconds += dt
+        with _TREE_LOCK:
+            node.calls += 1
+            node.seconds += dt
+        if tr is not None:
+            # end = the collector's OWN clock at exit (not ts0 + dt):
+            # both clocks tick off perf_counter, so exit order implies
+            # end order and nested spans can never outlive their parent
+            # in the written trace by a scheduling gap between the two
+            # entry-time captures
+            tr.complete(name, ts0, tr.now_us() - ts0)
         # the metrics plane sees every stage too: counters/histograms in
         # the process registry (merge-able across workers) plus a JSONL
         # event when a -metrics log is open (a few dict ops; the report

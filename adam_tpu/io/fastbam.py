@@ -384,6 +384,10 @@ def open_bam_wire32_stream(path, *, chunk_rows: int = 1 << 22,
     """
     if _native is None or not hasattr(_native, "flagstat_wire_chunk"):
         return None
+    # I/O ledger: the native walk decodes the whole BAM once — count its
+    # on-disk bytes against the active pass scope (no-op outside one)
+    from ..obs import ioledger
+    ioledger.record_input(path)
     byte_iter = iter_decompressed(path, chunk_bytes, procs=io_procs)
     _sd, _rg, off0, buf0 = stream_header(byte_iter, path)
 

@@ -104,7 +104,9 @@ class DatasetWriter:
                  part_rows: int = 1 << 20,
                  page_size: int | None = None,
                  use_dictionary: bool = True,
-                 row_group_bytes: int | None = None):
+                 row_group_bytes: int | None = None,
+                 io_pass: str | None = None,
+                 io_kind: str = "spilled"):
         os.makedirs(path, exist_ok=True)
         self.path = path
         self.compression = compression
@@ -116,6 +118,15 @@ class DatasetWriter:
         #: -parquet_block_size); resolved to rows from the first flushed
         #: chunk's observed bytes/row
         self.row_group_bytes = row_group_bytes
+        #: I/O-ledger attribution (obs.ioledger): a spill writer names
+        #: the pass that pays for it (``io_pass="p1"``) and its on-disk
+        #: bytes are counted at close — from ``os.stat`` of the parts
+        #: this writer produced, so ledger totals reconcile with ``du``.
+        #: ``io_pass=None`` (the default — outputs, converters) records
+        #: nothing.
+        self.io_pass = io_pass
+        self.io_kind = io_kind
+        self._part_paths: list[str] = []
         self._part = 0
         self._part_row_count = 0
         self._writer: Optional[pq.ParquetWriter] = None
@@ -152,6 +163,7 @@ class DatasetWriter:
                     chunk.schema, compression=self.compression,
                     data_page_size=self.page_size,
                     use_dictionary=self.use_dictionary)
+                self._part_paths.append(part_path)
             room = self.part_rows - self._part_row_count
             head = chunk.slice(0, room)
             self._writer.write_table(head,
@@ -179,15 +191,27 @@ class DatasetWriter:
             # (save_table writes one empty part the same way) — a
             # part-less directory reads back as a 0-column table and
             # breaks every downstream consumer
+            empty_path = os.path.join(self.path, "part-r-00000.parquet")
             self._writer = pq.ParquetWriter(
-                os.path.join(self.path, "part-r-00000.parquet"),
+                empty_path,
                 self._schema, compression=self.compression,
                 data_page_size=self.page_size,
                 use_dictionary=self.use_dictionary)
+            self._part_paths.append(empty_path)
             self._writer.write_table(self._schema.empty_table())
         if self._writer is not None:
             self._writer.close()
             self._writer = None
+        if self.io_pass is not None and self._part_paths:
+            from ..obs import ioledger
+            nbytes = 0
+            for p in self._part_paths:
+                try:
+                    nbytes += os.path.getsize(p)
+                except OSError:
+                    pass
+            ioledger.record(self.io_kind, nbytes, self.io_pass)
+            self._part_paths = []   # idempotent close: count parts once
 
     def __enter__(self):
         return self

@@ -62,8 +62,16 @@ def open_read_stream(path: str, *, columns: Optional[Sequence[str]] = None,
     byte stream is identical, decode just stops being one-core-bound.
     ``stringency`` applies to SAM text parsing (strict/lenient/silent,
     Bam2Adam.scala:46-47); BAM and Parquet are binary formats whose
-    decode is structurally strict."""
+    decode is structurally strict.
+
+    When an I/O-ledger pass scope is active (``obs.ioledger.pass_scope``
+    — the streaming passes set one around their stream opens), the
+    source's on-disk bytes count as that pass's decoded input; outside a
+    scope this records nothing."""
+    from ..obs import ioledger
+
     p = str(path)
+    ioledger.record_input(p)
     if p.endswith(".bam"):
         from .fastbam import open_bam_arrow_stream
         sd, rg, gen = open_bam_arrow_stream(p, chunk_rows=chunk_rows,

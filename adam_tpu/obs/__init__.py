@@ -32,9 +32,10 @@ import os
 import time
 from typing import Iterator, Optional
 
-from . import events
+from . import events, ioledger, trace  # noqa: F401  (re-exported planes)
 from .registry import (counter, gauge, histogram, registry,  # noqa: F401
                        reset_registry)
+from .trace import (TRACE_ENV, trace_path_from, trace_run)  # noqa: F401
 
 #: env fallback for the CLI flag — lets bench workers and elastic worker
 #: subprocesses write a sidecar without threading a flag through argv
@@ -47,6 +48,8 @@ def reset_all() -> None:
     """Zero every piece of process-global telemetry (test isolation)."""
     reset_registry()
     events.discard_log()
+    ioledger.reset()
+    trace.discard_trace()
 
 
 # ---------------------------------------------------------------------------
@@ -54,10 +57,19 @@ def reset_all() -> None:
 # ---------------------------------------------------------------------------
 
 def stage_finished(name: str, seconds: float) -> None:
-    """Called by ``instrument.stage`` on every stage exit."""
+    """Called by ``instrument.stage`` on every stage exit.  Off the main
+    thread the event carries the lane name (``thread``) — the stage
+    stack is thread-aware now, so feeder/prep-pool stages are real and
+    a metrics reader needs to know which lane a sample came from."""
     registry().counter("stage_calls", stage=name).inc()
     registry().histogram("stage_seconds", stage=name).observe(seconds)
-    events.emit("stage", name=name, seconds=round(seconds, 6))
+    import threading
+    t = threading.current_thread()
+    if t is threading.main_thread():
+        events.emit("stage", name=name, seconds=round(seconds, 6))
+    else:
+        events.emit("stage", name=name, seconds=round(seconds, 6),
+                    thread=t.name)
 
 
 def chunk_processed(pass_name: str, rows: int, *,

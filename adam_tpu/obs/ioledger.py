@@ -1,0 +1,204 @@
+"""Per-pass I/O ledger: bytes decoded vs spilled vs re-read.
+
+ROADMAP item 1's claim — "inter-pass spill I/O is now the dominant
+un-attacked cost in ``transform``" — was, until this module, a number
+nobody measured.  The streaming transform decodes the input once (pass
+1), spills it raw, then re-streams that spill twice (passes 2 and 3),
+spills again into genome bins (+realign halos), and re-reads every bin
+in pass 4.  This ledger counts each of those byte flows **at the I/O
+layer itself** (``DatasetWriter`` close, ``reread()``, the bin/sub-spill
+loads, the BAM/Parquet stream opens), attributed to the pass that paid
+them:
+
+* ``decoded`` — bytes of ORIGINAL input read off disk (file/dataset
+  size at stream open; the one unavoidable read);
+* ``spilled`` — bytes written to intermediate spill datasets (raw
+  chunks, genome bins, halos, hot-bin sub-ranges) — the p1 raw spill,
+  p3's bin routing, p4's hot-bin splits;
+* ``reread`` — spill bytes read back (p2/p3 re-streams, p4 bin loads).
+
+The derived **spill amplification** — (spilled + reread) / decoded — is
+the number the item-1 single-stream fusion PR targets: a fused pipeline
+that decodes once and materializes only the shuffle-shaped stages drives
+it toward the p3/p4 floor.  Per-pass rows report their own contribution
+against the run's decoded bytes, so the event stream shows WHERE the
+amplification comes from.
+
+Mechanics: byte counts land in registry counters
+(``io_bytes_{decoded,spilled,reread}{pass=}`` — merge-able across
+workers like every other counter) plus a process-local totals dict for
+the end-of-run report; :func:`emit_events` emits one ``io_ledger``
+event per pass plus a ``total`` rollup and sets the
+``io_spill_amplification`` gauge.  Attribution uses an explicit
+``pass_name`` where the call site knows it (writers, reread) and a
+contextvar :func:`pass_scope` where the I/O layer is generic (the
+stream openers) — readers record only when a scope is active, so
+telemetry never misattributes unrelated I/O.
+
+Everything here is telemetry: failures degrade to no-ops, byte counts
+come from ``os.stat`` (never from reading data twice), and with no
+consumer the counters are a dict lookup + add (the obs discipline).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import os
+import threading
+from typing import Dict, Iterator, Optional
+
+from . import events as _events
+from .registry import registry
+
+KINDS = ("decoded", "spilled", "reread")
+
+_PASS: "contextvars.ContextVar[Optional[str]]" = contextvars.ContextVar(
+    "adam_tpu_io_pass", default=None)
+
+_LOCK = threading.Lock()
+_TOTALS: Dict[str, Dict[str, int]] = {}    # pass -> kind -> bytes
+
+
+@contextlib.contextmanager
+def pass_scope(name: str) -> Iterator[None]:
+    """Attribute reader-side I/O opened inside this block to ``name``.
+    Contextvar-scoped, so concurrent passes in other threads (or other
+    runs in async contexts) never cross-attribute."""
+    tok = _PASS.set(name)
+    try:
+        yield
+    finally:
+        _PASS.reset(tok)
+
+
+def current_pass() -> Optional[str]:
+    return _PASS.get()
+
+
+def path_bytes(path: Optional[str]) -> int:
+    """On-disk bytes of a file or a Parquet dataset directory (sum of
+    its part files) — the reconciliation currency of the whole ledger:
+    every count here can be checked against ``du``."""
+    if not path:
+        return 0
+    try:
+        if os.path.isdir(path):
+            return sum(os.path.getsize(os.path.join(path, f))
+                       for f in os.listdir(path) if f.endswith(".parquet"))
+        return os.path.getsize(path)
+    except OSError:
+        return 0
+
+
+def record(kind: str, nbytes: int, pass_name: Optional[str] = None) -> None:
+    """Count ``nbytes`` of ``kind`` I/O against ``pass_name`` (or the
+    active :func:`pass_scope`).  No pass in scope and none given →
+    dropped (generic I/O outside any instrumented pass is not ledger
+    material)."""
+    if nbytes <= 0:
+        return
+    name = pass_name or _PASS.get()
+    if name is None:
+        return
+    registry().counter(f"io_bytes_{kind}", **{"pass": name}).inc(nbytes)
+    with _LOCK:
+        row = _TOTALS.setdefault(name, dict.fromkeys(KINDS, 0))
+        row[kind] += int(nbytes)
+
+
+def record_input(path: str, pass_name: Optional[str] = None) -> None:
+    """Reader-side hook: a full scan of ``path`` begins — count its
+    on-disk size as decoded input.  No-op outside a pass scope (the
+    stream openers call this unconditionally)."""
+    if pass_name or _PASS.get():
+        record("decoded", path_bytes(path), pass_name)
+
+
+def snapshot() -> Dict[str, Dict[str, int]]:
+    with _LOCK:
+        return {p: dict(row) for p, row in _TOTALS.items()}
+
+
+def _totals(snap: Dict[str, Dict[str, int]]) -> Dict[str, int]:
+    return {k: sum(row.get(k, 0) for row in snap.values()) for k in KINDS}
+
+
+def spill_amplification(snap: Optional[dict] = None) -> Optional[float]:
+    """(spilled + reread) / decoded over the whole run; None when the
+    run decoded nothing (nothing to amortize against)."""
+    tot = _totals(snapshot() if snap is None else snap)
+    if tot["decoded"] <= 0:
+        return None
+    return (tot["spilled"] + tot["reread"]) / tot["decoded"]
+
+
+def emit_events() -> Dict[str, Dict[str, int]]:
+    """End-of-run rollup: one ``io_ledger`` event per pass (its bytes +
+    its amplification contribution against the run's decoded bytes),
+    one ``total`` event, and the ``io_spill_amplification`` gauge.
+    Returns the snapshot it emitted (empty dict → emitted nothing)."""
+    snap = snapshot()
+    if not snap:
+        return snap
+    tot = _totals(snap)
+    # decoded == 0 (a checkpoint resume that skipped pass 1, a
+    # spill-only tool) leaves the ratio UNDEFINED: emit null, never a
+    # clamped denominator — a raw byte count masquerading as a ratio
+    # would feed straight into compare_bench's gate
+    denom = tot["decoded"]
+
+    def amp_of(row) -> Optional[float]:
+        if denom <= 0:
+            return None
+        return round((row["spilled"] + row["reread"]) / denom, 4)
+
+    for name in sorted(snap):
+        row = snap[name]
+        _events.emit("io_ledger", **{"pass": name},
+                     decoded=row["decoded"], spilled=row["spilled"],
+                     reread=row["reread"], amplification=amp_of(row))
+    amp = amp_of(tot)
+    _events.emit("io_ledger", **{"pass": "total"},
+                 decoded=tot["decoded"], spilled=tot["spilled"],
+                 reread=tot["reread"], amplification=amp)
+    if amp is not None:
+        registry().gauge("io_spill_amplification").set(amp)
+    return snap
+
+
+def format_report() -> str:
+    """Human lines for the end-of-run report (``-timing``); empty string
+    when no instrumented pass recorded I/O."""
+    snap = snapshot()
+    if not snap:
+        return ""
+    tot = _totals(snap)
+    denom = tot["decoded"]
+
+    def mb(n: int) -> str:
+        return f"{n / 1e6:10.2f} MB"
+
+    def amp_str(row) -> str:
+        if denom <= 0:
+            return "  n/a"      # undefined ratio (e.g. resumed run)
+        return f"{(row['spilled'] + row['reread']) / denom:5.2f}x"
+
+    lines = ["i/o ledger (decoded / spilled / re-read, "
+             "amp = (spill+reread)/decoded):"]
+    for name in sorted(snap):
+        row = snap[name]
+        lines.append(f"  {name:<10s}{mb(row['decoded'])}"
+                     f"{mb(row['spilled'])}{mb(row['reread'])}"
+                     f"   amp {amp_str(row)}")
+    lines.append(f"  {'total':<10s}{mb(tot['decoded'])}"
+                 f"{mb(tot['spilled'])}{mb(tot['reread'])}"
+                 f"   amp {amp_str(tot)}")
+    return "\n".join(lines)
+
+
+def reset() -> None:
+    """Zero the process-local totals (test isolation; the registry
+    counters reset through the registry's own reset)."""
+    with _LOCK:
+        _TOTALS.clear()

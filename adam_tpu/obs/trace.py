@@ -1,0 +1,319 @@
+"""Run-wide tracing plane: thread-aware spans, Chrome-trace export.
+
+The reference's only timeline view was the Spark web UI's stage bars;
+``instrument.py`` rebuilt the per-stage wall-clock *totals* but its
+report could never show host/feeder/device overlap — the stage stack was
+process-shared, so PR 3 had to run feed producers unstaged and attribute
+their cost consumer-side.  This module is the missing axis: a process-
+global, **opt-in** span collector whose events carry (pid, tid) lanes,
+exported as Chrome-trace / Perfetto-loadable JSON (``chrome://tracing``,
+https://ui.perfetto.dev).  The span *stack* itself lives in
+``instrument.py`` (one contextvar per thread); this module owns the
+event sink and the file format.
+
+Contract (the obs no-op discipline):
+
+* **zero overhead when off** — ``active()`` is one module-global read;
+  every hot-path hook checks it before doing any work.  No collector,
+  no allocation, no lock, no event.
+* **atomic publish** — the timeline writes via the shared
+  ``checkpoint.atomic_write`` (tmp + fsync + rename), so a crashed run
+  never leaves a torn JSON.
+* **multiprocess merge** — workers write their own file (the
+  ``ADAM_TPU_TRACE`` env names it, exactly like ``ADAM_TPU_METRICS``);
+  the supervisor/coordinator folds worker events in by
+  :func:`merge_trace_file` (elastic sidecars) or the KV gather
+  (``parallel.distributed.merge_worker_traces``).  Timestamps are
+  wall-clock-anchored microseconds, so lanes from different processes
+  align on one timeline.
+
+Event kinds (Chrome Trace Event Format):
+
+* ``X`` complete — one per finished span (``instrument.stage``, executor
+  dispatches, realign sweeps), with ``ts``/``dur`` in µs;
+* ``C`` counter — small numeric series (prefetch in-flight depth);
+* ``i`` instant — point markers (pass boundaries);
+* ``M`` metadata — process/thread names, appended at finalize so every
+  lane is labeled (feeder threads, the realign prep pool, workers).
+
+``tools/check_trace.py`` validates the written file (schema, per-lane
+monotonic timestamps, span nesting); ``docs/OBSERVABILITY.md`` has the
+how-to-read walkthrough.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import List, Optional
+
+from . import events as _events
+
+#: env fallback for the CLI ``-trace`` flag — how bench workers and
+#: elastic worker subprocesses get a per-process timeline sidecar
+TRACE_ENV = "ADAM_TPU_TRACE"
+
+_TRACE: "Optional[TraceCollector]" = None
+
+
+class TraceCollector:
+    """One run's span/counter event buffer plus its output path.
+
+    Thread-safe appends; events buffer in memory (a streaming transform
+    run produces thousands of spans, not millions — stage granularity,
+    not instruction granularity) and publish once, atomically, at
+    :meth:`write`.
+    """
+
+    def __init__(self, path: str):
+        self.path = path
+        self._lock = threading.Lock()
+        self._events: List[dict] = []
+        self._threads: dict = {}        # tid -> thread name (this process)
+        self._pid = os.getpid()
+        # wall-anchored clock: ts = wall0 + (perf_now - perf0), so spans
+        # from different processes land on one aligned timeline while
+        # durations keep perf_counter's resolution
+        self._wall0 = time.time()
+        self._perf0 = time.perf_counter()
+
+    # -- clock -------------------------------------------------------------
+
+    def now_us(self) -> float:
+        """Wall-anchored timestamp in microseconds (Chrome-trace units)."""
+        return (self._wall0 + (time.perf_counter() - self._perf0)) * 1e6
+
+    # -- recording ---------------------------------------------------------
+
+    def _note_thread(self) -> int:
+        t = threading.current_thread()
+        tid = t.ident or 0
+        if tid not in self._threads:
+            self._threads[tid] = t.name
+        return tid
+
+    def complete(self, name: str, ts_us: float, dur_us: float,
+                 cat: str = "stage", args: Optional[dict] = None) -> None:
+        """One finished span (``X`` phase), recorded at span EXIT."""
+        ev = {"name": name, "ph": "X", "cat": cat,
+              "ts": round(ts_us, 3), "dur": round(max(dur_us, 0.0), 3),
+              "pid": self._pid, "tid": self._note_thread()}
+        if args:
+            ev["args"] = args
+        with self._lock:
+            self._events.append(ev)
+
+    def instant(self, name: str, cat: str = "mark",
+                args: Optional[dict] = None) -> None:
+        ev = {"name": name, "ph": "i", "cat": cat, "s": "t",
+              "ts": round(self.now_us(), 3),
+              "pid": self._pid, "tid": self._note_thread()}
+        if args:
+            ev["args"] = args
+        with self._lock:
+            self._events.append(ev)
+
+    def counter(self, name: str, value: float) -> None:
+        ev = {"name": name, "ph": "C", "cat": "counter",
+              "ts": round(self.now_us(), 3), "pid": self._pid, "tid": 0,
+              "args": {name: value}}
+        with self._lock:
+            self._events.append(ev)
+
+    # -- merge (workers -> coordinator) ------------------------------------
+
+    def add_events(self, evs: List[dict]) -> int:
+        """Fold another process's events in (they carry their own
+        pid/tid lanes and wall-anchored timestamps)."""
+        evs = [e for e in evs if isinstance(e, dict)]
+        with self._lock:
+            self._events.extend(evs)
+        return len(evs)
+
+    def events(self) -> List[dict]:
+        """Snapshot of the raw event list (the KV-gather wire format)."""
+        with self._lock:
+            return list(self._events)
+
+    # -- publish -----------------------------------------------------------
+
+    def finalize_doc(self) -> dict:
+        """The Chrome-trace document: events sorted by timestamp plus
+        process/thread name metadata for every lane this process saw
+        (merged workers ship their own ``M`` events)."""
+        with self._lock:
+            evs = sorted(self._events,
+                         key=lambda e: (e.get("pid", 0), e.get("tid", 0),
+                                        e.get("ts", 0.0)))
+            threads = dict(self._threads)
+        meta = [{"name": "process_name", "ph": "M", "pid": self._pid,
+                 "tid": 0, "args": {"name": f"adam-tpu pid={self._pid}"}}]
+        for tid, tname in sorted(threads.items()):
+            meta.append({"name": "thread_name", "ph": "M",
+                         "pid": self._pid, "tid": tid,
+                         "args": {"name": tname}})
+        return {"traceEvents": meta + evs, "displayTimeUnit": "ms"}
+
+    def write(self) -> dict:
+        """Atomic publish (tmp + fsync + rename via the one shared
+        ``atomic_write``); returns ``{path, events, lanes}``."""
+        from ..checkpoint import atomic_write  # lazy: avoids an import
+        #       cycle (checkpoint -> resilience.faults -> obs -> trace)
+
+        doc = self.finalize_doc()
+        # default=str: a span arg holding a non-JSON type (a numpy int,
+        # a Path) must degrade to its repr, not crash the publish
+        atomic_write(self.path, json.dumps(doc, default=str))
+        lanes = {(e.get("pid"), e.get("tid")) for e in doc["traceEvents"]
+                 if e.get("ph") == "X"}
+        return {"path": self.path,
+                "events": sum(1 for e in doc["traceEvents"]
+                              if e.get("ph") != "M"),
+                "lanes": len(lanes)}
+
+
+# ---------------------------------------------------------------------------
+# the process-global collector
+# ---------------------------------------------------------------------------
+
+def active() -> Optional[TraceCollector]:
+    """THE hot-path gate: one module-global read.  ``None`` (the default)
+    means every trace hook is a no-op."""
+    return _TRACE
+
+
+def start_trace(path: str) -> TraceCollector:
+    """Install the process-global collector (replacing any previous one
+    WITHOUT writing it — ``trace_run`` owns the publish)."""
+    global _TRACE
+    _TRACE = TraceCollector(path)
+    return _TRACE
+
+
+def stop_trace() -> Optional[dict]:
+    """Write and uninstall; returns the write receipt (or None)."""
+    global _TRACE
+    t, _TRACE = _TRACE, None
+    return t.write() if t is not None else None
+
+
+def discard_trace() -> None:
+    """Drop an active collector without publishing (test isolation)."""
+    global _TRACE
+    _TRACE = None
+
+
+def trace_path_from(flag_value: Optional[str]) -> Optional[str]:
+    """The CLI flag wins; ``ADAM_TPU_TRACE`` is the fallback (how bench
+    workers and elastic workers get a per-process timeline)."""
+    return flag_value or os.environ.get(TRACE_ENV) or None
+
+
+class span:
+    """``with trace.span("name"):`` — a hand-rolled context manager (not
+    ``@contextmanager``: no generator allocation on the off path, which
+    hot loops take every chunk)."""
+
+    __slots__ = ("name", "cat", "args", "_t", "_ts")
+
+    def __init__(self, name: str, cat: str = "stage",
+                 args: Optional[dict] = None):
+        self.name = name
+        self.cat = cat
+        self.args = args
+        self._t = None
+
+    def __enter__(self):
+        t = _TRACE
+        if t is not None:
+            self._t = t
+            self._ts = t.now_us()
+        return self
+
+    def __exit__(self, *exc):
+        t = self._t
+        if t is not None:
+            t.complete(self.name, self._ts, t.now_us() - self._ts,
+                       cat=self.cat, args=self.args)
+        return False
+
+
+def instant(name: str, **args) -> None:
+    t = _TRACE
+    if t is not None:
+        t.instant(name, args=args or None)
+
+
+def counter(name: str, value: float) -> None:
+    t = _TRACE
+    if t is not None:
+        t.counter(name, value)
+
+
+# ---------------------------------------------------------------------------
+# run wrapper + multiprocess merge
+# ---------------------------------------------------------------------------
+
+def trace_run(path: Optional[str]):
+    """Context manager: open the collector, run, atomically publish the
+    timeline (even when the body raises — a failed run's partial
+    timeline is exactly what you debug with).  ``path=None`` is a no-op
+    context, the common un-flagged case.  Emits a ``trace_written``
+    event through the metrics plane so a ``-metrics`` sidecar records
+    where its run's timeline went."""
+    import contextlib
+
+    @contextlib.contextmanager
+    def _run():
+        if not path:
+            yield None
+            return
+        t = start_trace(path)
+        try:
+            yield t
+        finally:
+            # only publish if nobody swapped the collector underneath
+            # (a nested start_trace owns the newer one)
+            if _TRACE is t:
+                try:
+                    receipt = stop_trace()
+                except Exception as e:  # noqa: BLE001 — telemetry must
+                    # never fail an otherwise-successful run (the obs
+                    # discipline): an unwritable trace path surfaces as
+                    # one stderr line, not a nonzero exit after hours
+                    # of completed work
+                    import sys
+                    print(f"adam-tpu: trace not written to {path}: {e}",
+                          file=sys.stderr)
+                else:
+                    if receipt:
+                        _events.emit("trace_written", **receipt)
+    return _run()
+
+
+def read_trace_events(path: str) -> Optional[List[dict]]:
+    """A written timeline's events, or None when missing/torn."""
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, ValueError):
+        return None
+    evs = doc.get("traceEvents") if isinstance(doc, dict) else None
+    return evs if isinstance(evs, list) else None
+
+
+def merge_trace_file(path: str) -> bool:
+    """Fold a finished worker's timeline file into THIS process's active
+    collector (the elastic supervisor's sidecar path).  Returns True
+    when events merged; False when tracing is off here or the file is
+    missing/torn."""
+    t = _TRACE
+    if t is None:
+        return False
+    evs = read_trace_events(path)
+    if not evs:
+        return False
+    t.add_events(evs)
+    return True

@@ -156,6 +156,71 @@ def gather_metrics_snapshots(timeout_ms: int = 60_000) -> list:
     return snaps
 
 
+#: monotonic sequence for trace gathers (separate namespace from the
+#: metrics gather so the two cannot race each other's keys)
+_TRACE_GATHER_SEQ = [0]
+
+
+def gather_trace_events(timeout_ms: int = 60_000) -> list:
+    """Every process's trace-event buffer, gathered over the same
+    coordination-service KV store as the metrics snapshots.
+
+    SYMMETRIC — every process must call in the same program order (like
+    ``gather_metrics_snapshots``); a process with tracing off
+    contributes an empty list, so mixed configurations gather without
+    deadlock.  Events are small JSON dicts (stage granularity); a run's
+    buffer is a few hundred KB at worst, well inside KV payload bounds.
+    """
+    import json
+
+    from ..obs import trace
+
+    t = trace.active()
+    own = t.events() if t is not None else []
+    if jax.process_count() == 1:
+        return [own]
+    from jax._src import distributed as _dist
+
+    client = _dist.global_state.client
+    if client is None:
+        raise RuntimeError(
+            "trace gather needs the coordination service; call "
+            "initialize() (or pass a coordinator address) first")
+    seq = _TRACE_GATHER_SEQ[0]
+    _TRACE_GATHER_SEQ[0] += 1
+    prefix = f"adam_tpu/trace/{seq}"
+    client.key_value_set(f"{prefix}/{jax.process_index()}",
+                         json.dumps(own))
+    out = []
+    for pid in range(jax.process_count()):
+        if pid == jax.process_index():
+            out.append(own)
+        else:
+            out.append(json.loads(client.blocking_key_value_get(
+                f"{prefix}/{pid}", timeout_ms)))
+    return out
+
+
+def merge_worker_traces(timeout_ms: int = 60_000) -> int:
+    """Fold every peer's trace events into THIS process's collector (the
+    coordinator then writes ONE timeline with a lane per process —
+    exactly how metrics snapshots merge).  Returns the number of foreign
+    events folded; 0 with tracing off locally (the gather still runs, so
+    the call stays symmetric across the fleet)."""
+    from ..obs import trace
+
+    bufs = gather_trace_events(timeout_ms)
+    t = trace.active()
+    if t is None:
+        return 0
+    me = jax.process_index() if jax.process_count() > 1 else 0
+    n = 0
+    for i, evs in enumerate(bufs):
+        if i != me and evs:
+            n += t.add_events(evs)
+    return n
+
+
 #: registry generation at the last fold — the once-per-run guard below
 _LAST_MERGE_GEN = [None]
 

@@ -55,6 +55,7 @@ class Incarnation:
     procs: List[subprocess.Popen] = field(default_factory=list)
     logs: List[str] = field(default_factory=list)
     metrics: List[str] = field(default_factory=list)
+    traces: List[str] = field(default_factory=list)
 
 
 def supervise(argv_for: Callable[[int, str], Sequence[str]],
@@ -86,13 +87,24 @@ def supervise(argv_for: Callable[[int, str], Sequence[str]],
     coordinator.  The delay is recorded in each ``incarnation`` event.
     """
     from ..obs import (METRICS_ENV, emit, read_snapshot_file, registry,
-                       snapshot_is_fleet_merged)
+                       snapshot_is_fleet_merged, trace)
     from ..resilience.faults import INCARNATION_ENV
     from ..resilience.retry import backoff_delay
 
     last_fail = "never launched"
     log_dir = log_dir or tempfile.mkdtemp(prefix="elastic_logs_")
     os.makedirs(log_dir, exist_ok=True)
+    # timeline sidecars ride the metrics discipline: ONLY when this
+    # supervisor is tracing (active collector, or the env the workers
+    # will actually inherit asks for a trace) do workers get per-worker
+    # ADAM_TPU_TRACE paths — a shared path would be clobbered by N
+    # writers, and an untraced run must not grow N timeline files per
+    # incarnation.  The gate reads the CALLER's env when one is given:
+    # a trace path in `env` alone would otherwise reach every worker
+    # verbatim, the exact clobber this stamping exists to prevent.
+    worker_base_env = env if env is not None else os.environ
+    tracing = trace.active() is not None or \
+        bool(worker_base_env.get(trace.TRACE_ENV))
     for number in range(max_restarts + 1):
         delay = 0.0
         if number and restart_backoff_s > 0:
@@ -129,6 +141,11 @@ def supervise(argv_for: Callable[[int, str], Sequence[str]],
             # supervisor stamps which launch this worker belongs to
             wenv[INCARNATION_ENV] = str(number)
             inc.metrics.append(mpath)
+            if tracing:
+                tpath = os.path.join(
+                    log_dir, f"inc{number}-worker{pid}.trace.json")
+                wenv[trace.TRACE_ENV] = tpath
+                inc.traces.append(tpath)
             with open(path, "w") as log:
                 inc.procs.append(subprocess.Popen(
                     list(argv_for(pid, coordinator)),
@@ -161,6 +178,11 @@ def supervise(argv_for: Callable[[int, str], Sequence[str]],
                         continue
                     registry().merge(snap)
                     merged_fleet = merged_fleet or fleet
+                # worker timelines fold into the supervisor's (events
+                # carry their own pid lanes and wall-anchored clocks, so
+                # one merged file shows every process on one axis)
+                for tp in inc.traces:
+                    trace.merge_trace_file(tp)
                 return inc
             time.sleep(poll_s)
         # one worker died: the mesh is wedged — tear down the whole

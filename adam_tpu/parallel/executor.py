@@ -246,11 +246,14 @@ class PassExecutor:
         attempt number lets the caller re-transfer from host state and
         confine buffer donation to attempt 1.  The ``device_dispatch``
         fault-injection site fires inside each attempt."""
-        return dispatch_with_retry(
-            fn, site="device_dispatch",
-            label=f"{self.pass_name}:{label}",
-            policy=self._parent.retry_policy, split=split,
-            fallback=fallback)
+        # trace.span is near-free when tracing is off (one global read
+        # in __enter__) — and keeps ONE dispatch call site either way
+        with obs.trace.span(f"{self.pass_name}:{label}", cat="dispatch"):
+            return dispatch_with_retry(
+                fn, site="device_dispatch",
+                label=f"{self.pass_name}:{label}",
+                policy=self._parent.retry_policy, split=split,
+                fallback=fallback)
 
     def dispatch_put(self, label: str, fn: Callable):
         """A host→device transfer under the same retry ladder (site
@@ -274,6 +277,12 @@ class PassExecutor:
             self._stall_s += stall_s
             self._chunks += 1
             self._inflight_peak = max(self._inflight_peak, inflight)
+            tr = obs.trace.active()
+            if tr is not None:
+                # the timeline's proof the feed ran ahead: a counter
+                # series of results queued at each consumer pickup
+                tr.counter(f"prefetch_inflight:{self.pass_name}",
+                           inflight)
             r = obs.registry()
             r.histogram("executor_prefetch_stall_s",
                         **{"pass": self.pass_name}).observe(stall_s)
@@ -386,6 +395,9 @@ class StreamExecutor:
             autotune=self.autotune)
         obs.registry().counter("executor_passes",
                                **{"pass": pass_name}).inc()
+        obs.trace.instant(f"pass:{pass_name}",
+                          chunk_rows=plan["chunk_rows"],
+                          prefetch_depth=plan["prefetch_depth"])
         obs.emit("executor_bucket_selected", **{"pass": pass_name},
                  chunk_rows=plan["chunk_rows"],
                  ladder=plan["ladder"], ladder_base=plan["ladder_base"],

@@ -34,7 +34,8 @@ _DONE = object()
 def pipelined(items: Iterable, fn: Optional[Callable] = None,
               workers: int = 1,
               prepare: Optional[Callable] = None,
-              depth: Optional[int] = None) -> Iterator[Any]:
+              depth: Optional[int] = None,
+              pool_name: str = "ingest-pool") -> Iterator[Any]:
     """Yield ``fn(item, prepare(item))`` for each item, in input order.
 
     * ``prepare`` (optional) runs on the READER thread in strict input
@@ -45,6 +46,9 @@ def pipelined(items: Iterable, fn: Optional[Callable] = None,
 
     The reader also performs the iterator's own work (format decode), so
     decode itself overlaps the consumer even when ``fn`` is None.
+    ``pool_name`` names the worker threads (``<pool_name>_N``) — the
+    tracing plane (obs.trace) labels timeline lanes by thread name, so
+    the realign prep pool and the ingest pack pool stay tellable apart.
     """
     if fn is None:
         fn = _passthrough
@@ -90,7 +94,8 @@ def pipelined(items: Iterable, fn: Optional[Callable] = None,
         except BaseException as e:  # noqa: BLE001 — surface on consumer
             put(e)
 
-    with ThreadPoolExecutor(max_workers=workers) as pool:
+    with ThreadPoolExecutor(max_workers=workers,
+                            thread_name_prefix=pool_name) as pool:
         t = threading.Thread(target=reader, args=(pool,), daemon=True,
                              name="ingest-reader")
         t.start()

@@ -106,19 +106,22 @@ def streaming_flagstat(path: str, *, mesh=None, chunk_rows: int = 1 << 22,
     n_chunks = 0
     # BAM fast path: the native walk emits the wire word straight from the
     # record bytes — no string decode at all (ADAM_TPU_FLAGSTAT_DECODE=
-    # arrow opts back into the Arrow path, e.g. for differential checks)
+    # arrow opts back into the Arrow path, e.g. for differential checks).
+    # The I/O-ledger scope attributes the input's on-disk bytes (counted
+    # by the stream openers) to this pass as decoded input.
     wire_chunks = None
-    if path.endswith(".bam") and \
-            os.environ.get("ADAM_TPU_FLAGSTAT_DECODE", "auto") != "arrow":
-        from ..io.fastbam import open_bam_wire32_stream
-        wire_chunks = open_bam_wire32_stream(path,
-                                             chunk_rows=pex.chunk_rows,
-                                             io_procs=io_procs)
-    if wire_chunks is None:
-        stream = open_read_stream(path, columns=FLAGSTAT_COLUMNS,
-                                  chunk_rows=pex.chunk_rows,
-                                  io_procs=io_procs)
-        wire_chunks = (_wire32_from_table(t) for t in stream)
+    with obs.ioledger.pass_scope("flagstat"):
+        if path.endswith(".bam") and \
+                os.environ.get("ADAM_TPU_FLAGSTAT_DECODE", "auto") != "arrow":
+            from ..io.fastbam import open_bam_wire32_stream
+            wire_chunks = open_bam_wire32_stream(path,
+                                                 chunk_rows=pex.chunk_rows,
+                                                 io_procs=io_procs)
+        if wire_chunks is None:
+            stream = open_read_stream(path, columns=FLAGSTAT_COLUMNS,
+                                      chunk_rows=pex.chunk_rows,
+                                      io_procs=io_procs)
+            wire_chunks = (_wire32_from_table(t) for t in stream)
     if io_threads > 1:
         # decode (native wire walk / Arrow projection) moves to a reader
         # thread so it overlaps device dispatch; counter accumulation is
@@ -212,9 +215,10 @@ def streaming_flagstat(path: str, *, mesh=None, chunk_rows: int = 1 << 22,
     ex.finish()
     # same end-of-run rollup as transform (rows_total / reads_per_sec /
     # bytes_in + the run_totals event), so -metrics consumers see one
-    # schema across commands
+    # schema across commands; the io_ledger events ride the same exit
     obs.run_totals("flagstat", n_reads, _time.perf_counter() - t_start,
                    input_path=path)
+    obs.ioledger.emit_events()
     passed = FlagStatMetrics.from_counters(totals[:, 0])
     failed = FlagStatMetrics.from_counters(totals[:, 1])
     return failed, passed
@@ -506,12 +510,11 @@ def _packed_chunks(chunk_iter, pex, io_threads: int,
     (``pex.pad_rows``), which also owns the pad-waste/recompile
     telemetry.
 
-    ``timed_chunks=None`` yields UNSTAGED pairs: when the executor's
-    device feed is active its feeder thread drives this generator, and
-    ``instrument.stage``'s report stack is shared (not thread-local) —
-    interleaved stages from two threads would mis-nest the timing tree.
-    The caller then attributes its stall consumer-side as
-    ``<pass>-feed-wait`` (the ``-ingest-wait`` discipline)."""
+    ALWAYS staged: the stage stack is per-thread now (instrument), so
+    when the executor's device feed drives this generator from its
+    feeder thread, the decode/pack stages land correctly nested on that
+    thread's own report lane (and its timeline lane under ``-trace``) —
+    the PR 3 unstaged-producer workaround is gone."""
     from ..instrument import stage
 
     pass_name = pex.pass_name
@@ -526,14 +529,7 @@ def _packed_chunks(chunk_iter, pex, io_threads: int,
     if io_threads > 1:
         from .ingest import pipelined
         piped = pipelined(chunk_iter, work, io_threads)
-        if timed_chunks is None:
-            yield from piped
-        else:
-            yield from timed_chunks(piped, f"{pass_name}-ingest-wait")
-        return
-    if timed_chunks is None:
-        for table in chunk_iter:
-            yield work(table, None)
+        yield from timed_chunks(piped, f"{pass_name}-ingest-wait")
         return
     for table in timed_chunks(chunk_iter, f"{pass_name}-decode"):
         if not want_pack:
@@ -569,7 +565,7 @@ _P3_DEV_COLS = ("flags", "read_group", "read_len", "bases", "quals")
 
 def _feed_packed(chunk_iter, pex, io_threads: int, pack_reads,
                  bucket_len: int, timed_chunks, mesh, dev_cols: tuple,
-                 want_pack: bool = True):
+                 want_pack: bool = True, feed_wait=None):
     """``_packed_chunks`` composed with the executor's device feed:
     yields (table, host_batch, device_batch_or_None) triples.
 
@@ -581,14 +577,16 @@ def _feed_packed(chunk_iter, pex, io_threads: int, pack_reads,
     churn the executor exists to kill).
 
     When the feed is active (prefetch_depth > 0) the producer runs
-    UNSTAGED on the feeder thread — instrument's stage stack is shared,
-    not thread-local — and the consumer's stall is attributed as
-    ``<pass>-feed-wait`` (the ``-ingest-wait`` discipline)."""
+    STAGED on the feeder thread (the stage stack is per-thread now —
+    decode/pack walls land on the feeder's own lane), and the consumer's
+    stall is still attributed as ``<pass>-feed-wait`` via ``feed_wait``
+    — a stage-only wrapper (no chunk accounting: the producer already
+    counted each chunk once)."""
     from ..bqsr.recalibrate import _count_slab_rows
 
     active = pex.prefetch_depth > 0
     base = _packed_chunks(chunk_iter, pex, io_threads, pack_reads,
-                          bucket_len, None if active else timed_chunks,
+                          bucket_len, timed_chunks,
                           want_pack=want_pack)
     sharding = reads_sharding(mesh)
     slab = _count_slab_rows()
@@ -604,8 +602,8 @@ def _feed_packed(chunk_iter, pex, io_threads: int, pack_reads,
         return table, batch, dev
 
     fed = pex.feed(base, put)
-    if active:
-        fed = timed_chunks(fed, f"{pex.pass_name}-feed-wait")
+    if active and feed_wait is not None:
+        fed = feed_wait(fed, f"{pex.pass_name}-feed-wait")
     return fed
 
 
@@ -709,12 +707,13 @@ def streaming_transform(input_path: str, output_path: str, *,
     wopts = dict(compression=compression, page_size=page_size,
                  use_dictionary=use_dictionary)
 
-    def timed_chunks(it, name):
+    def timed_chunks(it, name, count=True):
         """Attribute the iterator's own work (format decode / parquet scan)
         to a named stage, chunk by chunk; each chunk also lands in the
-        metrics plane (chunk_rows/bytes_in + a JSONL chunk event).  The
-        pipelined paths yield (table, packed) pairs, the sync paths bare
-        tables — account the table either way."""
+        metrics plane (chunk_rows/bytes_in + a JSONL chunk event) unless
+        ``count=False``.  The pipelined paths yield (table, packed)
+        pairs, the sync paths bare tables — account the table either
+        way."""
         it = iter(it)
         while True:
             with stage(name):
@@ -722,10 +721,18 @@ def streaming_transform(input_path: str, output_path: str, *,
                     item = next(it)
                 except StopIteration:
                     return
-            table = item[0] if isinstance(item, tuple) else item
-            obs.chunk_processed(name, table.num_rows,
-                                bytes_in=table.nbytes)
+            if count:
+                table = item[0] if isinstance(item, tuple) else item
+                obs.chunk_processed(name, table.num_rows,
+                                    bytes_in=table.nbytes)
             yield item
+
+    def waited(it, name):
+        """Stage-only stall attribution for the consumer side of the
+        device feed (``<pass>-feed-wait``): times the wait, records NO
+        chunk event — the staged producer already counted each chunk
+        once on its own thread."""
+        return timed_chunks(it, name, count=False)
 
     import time as _time
     t_start = _time.perf_counter()
@@ -778,13 +785,19 @@ def streaming_transform(input_path: str, output_path: str, *,
         if ck is not None and not p1_skipped:
             ck.clean_unless("p1", "raw", "dup.npy")
         pex1 = ex.begin_pass("p1")
-        stream = [] if p1_skipped else \
-            open_read_stream(input_path, chunk_rows=pex1.chunk_rows,
-                             io_procs=io_procs)
+        if p1_skipped:
+            stream = []
+        else:
+            # the I/O ledger counts the input's on-disk bytes (recorded
+            # by the stream opener) as pass 1's decoded input
+            with obs.ioledger.pass_scope("p1"):
+                stream = open_read_stream(input_path,
+                                          chunk_rows=pex1.chunk_rows,
+                                          io_procs=io_procs)
         keys = _MarkdupKeys(mesh) if (markdup and not p1_skipped) else None
         seq_seen: dict = {}
         raw_writer = None if (is_parquet or p1_skipped) else DatasetWriter(
-            raw_path, part_rows=chunk_rows, **wopts)
+            raw_path, part_rows=chunk_rows, io_pass="p1", **wopts)
         if not p1_skipped:
             total_rows = 0
             max_rgid = -1
@@ -823,20 +836,12 @@ def streaming_transform(input_path: str, output_path: str, *,
             from .ingest import pipelined
             p1_base = pipelined(stream, p1_pack, io_threads,
                                 prepare=grow_bucket if track_len else None)
-            p1_iter = p1_base if use_p1_feed else \
-                timed_chunks(p1_base, "p1-ingest-wait")
-        elif use_p1_feed:
-            # the device feed's feeder thread will drive this generator;
-            # instrument's stage stack is shared across threads, so the
-            # producer runs UNSTAGED and the consumer attributes its
-            # stall as p1-feed-wait below (the -ingest-wait discipline)
-            def p1_plain():
-                for table in stream:
-                    if track_len:
-                        grow_bucket(table)
-                    yield p1_pack(table, bucket_len)
-            p1_iter = p1_plain()
+            p1_iter = timed_chunks(p1_base, "p1-ingest-wait")
         else:
+            # staged even when the device feed's feeder thread drives
+            # this generator: the stage stack is per-thread, so
+            # p1-decode/p1-pack land on the feeder's own lane (the PR 3
+            # unstaged workaround is gone)
             def p1_sync():
                 for table in timed_chunks(stream, "p1-decode"):
                     batch = None
@@ -851,7 +856,9 @@ def streaming_transform(input_path: str, output_path: str, *,
             # device feed: the markdup-key batch ships (projected to the
             # columns the key kernel reads) up to prefetch_depth chunks
             # ahead of the kernel dispatch; add_chunk detects the
-            # pre-sharded batch and skips its own put
+            # pre-sharded batch and skips its own put.  The consumer's
+            # stall is timed as p1-feed-wait (stage only — the staged
+            # producer already counted every chunk once)
             p1_sharding = reads_sharding(mesh)
 
             def _p1_put(item):
@@ -863,8 +870,7 @@ def streaming_transform(input_path: str, output_path: str, *,
                         "batch",
                         lambda attempt: proj.device_put(p1_sharding))
                 return table, batch
-            p1_iter = timed_chunks(pex1.feed(p1_iter, _p1_put),
-                                   "p1-feed-wait")
+            p1_iter = waited(pex1.feed(p1_iter, _p1_put), "p1-feed-wait")
         for table, batch in p1_iter:
             total_rows += table.num_rows
             max_rgid = max(max_rgid,
@@ -899,11 +905,17 @@ def streaming_transform(input_path: str, output_path: str, *,
                         seq_records=[[r.id, r.name, r.length, r.url]
                                      for r in seq_dict])
 
-        def reread(rows=chunk_rows):
+        def reread(rows=chunk_rows, io_pass=None):
             # a re-streamed pass may use its own (autotuned) chunk size:
             # dup-bit offsets track rows, and every per-chunk consumer is
             # an exact monoid or per-row map, so re-chunking never
-            # changes results (differential-pinned)
+            # changes results (differential-pinned).  Each re-stream
+            # counts the spill's on-disk bytes as the pass's re-read I/O
+            # (the ledger's "decode the bytes once" denominator): one
+            # record per invocation, from os.stat — never from the data.
+            if io_pass is not None:
+                obs.ioledger.record(
+                    "reread", obs.ioledger.path_bytes(raw_path), io_pass)
             offset = 0
             for table in iter_tables(raw_path, chunk_rows=rows):
                 if dup is not None:
@@ -948,9 +960,10 @@ def streaming_transform(input_path: str, output_path: str, *,
             host_acc = None
             acc = None
             n_counted = 0
-            p2_iter = _feed_packed(reread(pex2.chunk_rows), pex2,
-                                   io_threads, pack_reads, bucket_len,
-                                   timed_chunks, mesh, _P2_DEV_COLS)
+            p2_iter = _feed_packed(reread(pex2.chunk_rows, io_pass="p2"),
+                                   pex2, io_threads, pack_reads,
+                                   bucket_len, timed_chunks, mesh,
+                                   _P2_DEV_COLS, feed_wait=waited)
 
             def _p2_cpu_fallback(table, batch):
                 # degraded per-chunk CPU fallback: the host bincount
@@ -1052,7 +1065,8 @@ def streaming_transform(input_path: str, output_path: str, *,
                     ck.clean_unless("p3", "bin-*", "halo-*")
                 bin_writers = [
                     DatasetWriter(os.path.join(workdir, f"bin-{b:05d}"),
-                                  part_rows=bin_part_rows, **wopts)
+                                  part_rows=bin_part_rows, io_pass="p3",
+                                  **wopts)
                     for b in range(part.num_partitions)]
                 halo_writers: dict = {}
         out_part_rows = chunk_rows if coalesce is None else \
@@ -1068,9 +1082,10 @@ def streaming_transform(input_path: str, output_path: str, *,
         pex3 = ex.begin_pass(
             "p3", bytes_per_row=2.0 * max(bucket_len, 1) + 64.0)
         p3_iter = _feed_packed([] if p3_skipped else
-                               reread(pex3.chunk_rows), pex3, io_threads,
-                               pack_reads, bucket_len, timed_chunks,
-                               mesh, _P3_DEV_COLS, want_pack=bqsr)
+                               reread(pex3.chunk_rows, io_pass="p3"),
+                               pex3, io_threads, pack_reads, bucket_len,
+                               timed_chunks, mesh, _P3_DEV_COLS,
+                               want_pack=bqsr, feed_wait=waited)
         def _p3_cpu_fallback(table, batch):
             # degraded per-chunk CPU fallback: the unsharded LUT apply
             # pinned to the CPU backend (a per-row integer map — the
@@ -1140,6 +1155,9 @@ def streaming_transform(input_path: str, output_path: str, *,
         obs.run_totals("transform", total_rows,
                        _time.perf_counter() - t_start,
                        input_path=input_path, output_path=output_path)
+        # per-pass io_ledger events + the spill-amplification gauge —
+        # the number ROADMAP item 1's fusion refactor exists to move
+        obs.ioledger.emit_events()
         return total_rows
     finally:
         if own_workdir:
@@ -1184,7 +1202,7 @@ def _route_halo(table, bins, part, mapped_ok, refid, start, halo_writers,
         if w is None:
             w = halo_writers[int(b2)] = DatasetWriter(
                 os.path.join(workdir, f"halo-{int(b2):05d}"),
-                part_rows=part_rows, **wopts)
+                part_rows=part_rows, io_pass="p3", **wopts)
         w.write(table.take(pa.array(sel)))
 
 
@@ -1226,6 +1244,13 @@ def _bin_unit_descs(path, halo_path, part, rows, chunk_rows, budget,
 
     if rows <= budget:
         def load_small():
+            # pass 4 re-reads the whole bin (+halo) spill — count its
+            # on-disk bytes BEFORE the load (the engine may delete the
+            # spill after materializing); runs on the realign pipeline's
+            # reader thread, so attribution is explicit, not scoped
+            obs.ioledger.record(
+                "reread", obs.ioledger.path_bytes(path) +
+                obs.ioledger.path_bytes(halo_path), "p4")
             halo = load_table(halo_path) if halo_path else None
             return load_table(path), halo
         yield load_small, next_lo
@@ -1248,10 +1273,10 @@ def _bin_unit_descs(path, halo_path, part, rows, chunk_rows, budget,
     W = _REALIGN_HALO
     workdir_b = _tempfile.mkdtemp(prefix="hotbin_", dir=path)
     sub_own = [DatasetWriter(os.path.join(workdir_b, f"sub-{i:03d}"),
-                             part_rows=budget, **wopts)
+                             part_rows=budget, io_pass="p4", **wopts)
                for i in range(len(lows))]
     sub_halo = [DatasetWriter(os.path.join(workdir_b, f"subhalo-{i:03d}"),
-                              part_rows=budget, **wopts)
+                              part_rows=budget, io_pass="p4", **wopts)
                 for i in range(len(lows))] if realign else []
 
     def route(tbl, is_halo_source):
@@ -1274,6 +1299,11 @@ def _bin_unit_descs(path, halo_path, part, rows, chunk_rows, budget,
                 if len(osel):
                     sub_halo[i].write(tbl.take(pa.array(osel)))
 
+    # the split streams the whole over-budget bin (+halo) once to route
+    # it into sub-ranges: p4 re-read I/O (the quantile key pre-scan above
+    # is a 2-column projection — a few % of the bin — and is not counted)
+    obs.ioledger.record("reread", obs.ioledger.path_bytes(path) +
+                        obs.ioledger.path_bytes(halo_path), "p4")
     for tbl in iter_tables(path, chunk_rows=chunk_rows):
         route(tbl, is_halo_source=False)
     if halo_path:
@@ -1297,6 +1327,10 @@ def _bin_unit_descs(path, halo_path, part, rows, chunk_rows, budget,
         nxt = int(highs[i]) if i + 1 < len(lows) else next_lo
 
         def load_sub(i=i):
+            obs.ioledger.record(
+                "reread", obs.ioledger.path_bytes(sub_own[i].path) +
+                (obs.ioledger.path_bytes(sub_halo[i].path)
+                 if realign and sub_halo[i].rows_written else 0), "p4")
             own = load_table(sub_own[i].path)
             halo = load_table(sub_halo[i].path) \
                 if realign and sub_halo[i].rows_written else None
@@ -1416,6 +1450,8 @@ def _emit_bins(out, bin_writers, halo_writers, part, chunk_rows, budget,
         pending = None
     uw = bin_writers[part.num_partitions - 1]
     if uw.rows_written:
+        obs.ioledger.record("reread", obs.ioledger.path_bytes(uw.path),
+                            "p4")
         for t in iter_tables(uw.path, chunk_rows=chunk_rows):
             out.write(t)
 

@@ -252,9 +252,15 @@ class CrossBinSweepBatcher:
             self._dispatch_chunk(shape, chunk[mid:])
             return None
 
-        out = dispatch_with_retry(fn, site="device_dispatch",
-                                  label="realign:sweep",
-                                  policy=self._retry, split=split)
+        # one timeline span per device sweep batch (near-free when
+        # tracing is off): the cross-bin batches are exactly what the
+        # Perfetto view needs to show overlapping the prep pool's lanes
+        with obs.trace.span("realign:sweep", cat="dispatch",
+                            args={"shape": [Rr, L, CL],
+                                  "jobs": len(chunk)}):
+            out = dispatch_with_retry(fn, site="device_dispatch",
+                                      label="realign:sweep",
+                                      policy=self._retry, split=split)
         if out is None:
             return              # split path recorded the halves' results
         q_dev, o_dev = out
@@ -315,25 +321,31 @@ class RealignEngine:
         from ..ops.sort import sort_reads
         from .ingest import pipelined
 
+        from ..instrument import stage
+
         def prep(u: BinUnitDesc, _ctx):
-            # runs on pool workers: plain timers only — instrument's
-            # stage stack is shared across threads (the executor's
-            # feed-wait lesson), so stage() never runs here
+            # runs on pool workers: the stage stack is per-thread now
+            # (the tracing plane), so load/prep are REAL stages on the
+            # prep pool's own report/timeline lane; the perf timers stay
+            # the realign_bin event's source (stage granularity differs)
             t0 = time.perf_counter()
-            own, halo = u.load()
+            with stage("p4-load"):
+                own, halo = u.load()
             t1 = time.perf_counter()
-            combined = own if halo is None or halo.num_rows == 0 \
-                else pa.concat_tables([own, halo])
-            work = R.plan_realign(combined)
-            if work is not None:
-                self.batcher.add_unit(u.uid, work.states)
+            with stage("p4-prep"):
+                combined = own if halo is None or halo.num_rows == 0 \
+                    else pa.concat_tables([own, halo])
+                work = R.plan_realign(combined)
+                if work is not None:
+                    self.batcher.add_unit(u.uid, work.states)
             t2 = time.perf_counter()
             return (u, own.num_rows, combined, work, t1 - t0, t2 - t1)
 
         reg = obs.registry()
         n_units = 0
         for u, own_rows, combined, work, load_s, prep_s in pipelined(
-                units, prep, workers=self.depth, depth=self.depth + 1):
+                units, prep, workers=self.depth, depth=self.depth + 1,
+                pool_name="realign-prep"):
             t2 = time.perf_counter()
             if work is not None:
                 results = self.batcher.sweep_unit(u.uid)

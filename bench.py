@@ -1092,6 +1092,13 @@ def main(only: "list[str] | None" = None) -> None:
             _remaining, CPU_RESERVE_S,
             metrics_path_for=lambda tag: os.path.join(
                 mdir, f"BENCH_metrics_{tag}.jsonl"),
+            # timeline sidecars are opt-in (tpu_watch sets the env):
+            # the path rides to workers as ADAM_TPU_TRACE and stamps
+            # each payload — so the evidence ledger's on-chip records
+            # point at a Perfetto-loadable timeline of their window
+            trace_path_for=(lambda tag: os.path.join(
+                mdir, f"BENCH_trace_{tag}.json"))
+            if os.environ.get("ADAM_TPU_TRACE_BENCH") else None,
             ledger=led, window_id=window_id,
             scale_env=scale_env_from_probe,
             cpu_order=order_cpu_fallback)

@@ -28,6 +28,7 @@ def orchestrate(want: list[str],
                 sleep: Callable[[float], None] = time.sleep,
                 tpu_only: Iterable[str] = TPU_ONLY_STAGES,
                 metrics_path_for: "Callable[[str], str] | None" = None,
+                trace_path_for: "Callable[[str], str] | None" = None,
                 ledger=None,
                 window_id: str = "",
                 scale_env: "Callable[[dict], dict] | None" = None,
@@ -45,7 +46,12 @@ def orchestrate(want: list[str],
     ``ADAM_TPU_METRICS`` (the worker writes an obs JSONL there) and is
     recorded as ``metrics_path`` in every stage payload collected from
     that run — so a BENCH_*.json entry can cite the sidecar's per-stage
-    numbers instead of only end-to-end wall time.
+    numbers instead of only end-to-end wall time.  ``trace_path_for``
+    does the same for the run TIMELINE (``ADAM_TPU_TRACE`` →
+    Chrome-trace JSON, obs.trace): the path is stamped as
+    ``trace_path`` in each payload, and since the evidence ledger keeps
+    whole payloads, an on-chip capture window leaves a loadable
+    timeline behind, not just a headline number.
 
     ``ledger`` (an evidence.ledger.Ledger, or None) is checkpointed
     after EVERY worker run: each captured stage folds in keep-best and
@@ -70,17 +76,23 @@ def orchestrate(want: list[str],
     link_env: dict = {}
 
     def tagged(got: dict, tag: str) -> dict:
-        if metrics_path_for is None:
+        stamps = {}
+        if metrics_path_for is not None:
+            stamps["metrics_path"] = metrics_path_for(tag)
+        if trace_path_for is not None:
+            stamps["trace_path"] = trace_path_for(tag)
+        if not stamps:
             return got
-        path = metrics_path_for(tag)
-        return {k: ({**v, "metrics_path": path}
-                    if isinstance(v, dict) else v)
+        return {k: ({**v, **stamps} if isinstance(v, dict) else v)
                 for k, v in got.items()}
 
     def worker_env(tag: str) -> dict:
-        if metrics_path_for is None:
-            return {}
-        return {"ADAM_TPU_METRICS": metrics_path_for(tag)}
+        env = {}
+        if metrics_path_for is not None:
+            env["ADAM_TPU_METRICS"] = metrics_path_for(tag)
+        if trace_path_for is not None:
+            env["ADAM_TPU_TRACE"] = trace_path_for(tag)
+        return env
 
     def note_ledger(got: dict) -> None:
         if ledger is None or not got:

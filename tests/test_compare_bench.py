@@ -1,0 +1,129 @@
+"""tools/compare_bench.py — the bench-trajectory gate (ISSUE 6).
+
+The acceptance pin: an injected synthetic regression exits nonzero;
+within-threshold drift exits zero; cross-platform artifacts refuse to
+gate; both artifact shapes (bare bench doc / driver wrapper with
+``parsed``) load.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import json
+import pathlib
+
+ROOT = pathlib.Path(__file__).parent.parent
+
+_spec = importlib.util.spec_from_file_location(
+    "compare_bench", ROOT / "tools" / "compare_bench.py")
+compare_bench = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(compare_bench)
+
+
+_BASE = {
+    "metric": "flagstat_reads_per_sec",
+    "value": 6_000_000,
+    "vs_baseline": 2.0,
+    "platform": "cpu",
+    "transform_fused_reads_per_sec": 160_000,
+    "transform_vs_target": 0.016,
+    "flagstat_stage_wall_s": 30.0,
+    "transform_spill_amplification": 4.2,
+    "pad_waste_frac_mean": 0.21,
+}
+
+
+def _write(tmp_path, name, doc, wrap=False):
+    p = tmp_path / name
+    p.write_text(json.dumps({"parsed": doc} if wrap else doc))
+    return str(p)
+
+
+def test_identical_artifacts_pass(tmp_path):
+    old = _write(tmp_path, "old.json", _BASE)
+    new = _write(tmp_path, "new.json", _BASE)
+    assert compare_bench.main([old, new]) == 0
+
+
+def test_injected_regression_exits_nonzero(tmp_path, capsys):
+    """The acceptance criterion: a synthetic 30% headline drop (and a
+    spill-amplification rise) trips the gate."""
+    worse = dict(_BASE, value=4_200_000,
+                 transform_spill_amplification=6.5)
+    old = _write(tmp_path, "old.json", _BASE)
+    new = _write(tmp_path, "new.json", worse)
+    assert compare_bench.main([old, new, "--threshold", "10"]) == 1
+    err = capsys.readouterr().err
+    assert "value" in err and "fell" in err
+    assert "spill_amplification" in err and "rose" in err
+
+
+def test_lower_is_better_direction(tmp_path):
+    """A WALL-TIME drop and a spill-amplification drop are improvements,
+    not regressions — direction is per-metric."""
+    better = dict(_BASE, flagstat_stage_wall_s=10.0,
+                  transform_spill_amplification=1.5)
+    old = _write(tmp_path, "old.json", _BASE)
+    new = _write(tmp_path, "new.json", better)
+    assert compare_bench.main([old, new, "--threshold", "10"]) == 0
+
+
+def test_within_threshold_drift_passes(tmp_path):
+    drift = dict(_BASE, value=int(_BASE["value"] * 0.95))
+    old = _write(tmp_path, "old.json", _BASE)
+    new = _write(tmp_path, "new.json", drift)
+    assert compare_bench.main([old, new, "--threshold", "10"]) == 0
+    # ... and the same drift trips a tighter gate
+    assert compare_bench.main([old, new, "--threshold", "2"]) == 1
+
+
+def test_driver_wrapper_shape_loads(tmp_path):
+    """BENCH_r0N.json wraps the doc under 'parsed'; the bare doc and
+    the wrapper must compare identically."""
+    worse = dict(_BASE, value=3_000_000)
+    old = _write(tmp_path, "old.json", _BASE, wrap=True)
+    new = _write(tmp_path, "new.json", worse)
+    assert compare_bench.main([old, new]) == 1
+
+
+def test_cross_platform_refuses_to_gate(tmp_path, capsys):
+    tpu = dict(_BASE, platform="tpu", value=50_000_000)
+    old = _write(tmp_path, "old.json", tpu)
+    new = _write(tmp_path, "new.json", _BASE)
+    assert compare_bench.main([old, new]) == 2
+    assert "platform mismatch" in capsys.readouterr().err
+    # the override compares anyway (and this "regression" trips)
+    assert compare_bench.main([old, new, "--allow-cross-platform"]) == 1
+
+
+def test_explicit_keys_subset(tmp_path):
+    worse = dict(_BASE, value=1_000_000)          # would regress...
+    old = _write(tmp_path, "old.json", _BASE)
+    new = _write(tmp_path, "new.json", worse)
+    # ...but the explicit key list only tracks transform throughput
+    assert compare_bench.main(
+        [old, new, "--keys", "transform_fused_reads_per_sec"]) == 0
+
+
+def test_missing_key_in_new_is_noted_not_fatal(tmp_path, capsys):
+    new_doc = {k: v for k, v in _BASE.items() if k != "value"}
+    old = _write(tmp_path, "old.json", _BASE)
+    new = _write(tmp_path, "new.json", new_doc)
+    assert compare_bench.main([old, new]) == 0
+    assert "missing in NEW" in capsys.readouterr().out
+
+
+def test_zero_baseline_is_noted_not_gated(tmp_path, capsys):
+    """0 -> tiny is an undefined relative change, not an infinite
+    regression — a no-spill baseline must not trip the gate."""
+    old_doc = dict(_BASE, transform_spill_amplification=0.0)
+    new_doc = dict(_BASE, transform_spill_amplification=0.0001)
+    old = _write(tmp_path, "old.json", old_doc)
+    new = _write(tmp_path, "new.json", new_doc)
+    assert compare_bench.main([old, new]) == 0
+    assert "zero baseline" in capsys.readouterr().out
+
+
+def test_unreadable_artifact_exits_2(tmp_path):
+    old = _write(tmp_path, "old.json", _BASE)
+    assert compare_bench.main([old, str(tmp_path / "nope.json")]) == 2

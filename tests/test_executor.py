@@ -270,12 +270,18 @@ def test_prefetch_enabled_is_bit_identical_and_bounded(tmp_path,
              if k.startswith("executor_prefetch_inflight_peak")}
     assert peaks                      # the feed really engaged
     assert all(v <= 2 for v in peaks.values())
-    # with the feed active, stage attribution moves consumer-side
-    # (<pass>-feed-wait): the feeder thread must never drive stage()
-    # contexts — instrument's report stack is shared, not thread-local
+    # with the feed active, the PRODUCER runs staged on the feeder
+    # thread (the stage stack is per-thread since the tracing plane
+    # landed): decode/pack walls are real stages on the feeder's lane,
+    # and the consumer's stall still shows up as <pass>-feed-wait
     stages = set(report().root.children)
     assert "p2-feed-wait" in stages and "p3-feed-wait" in stages
-    assert "p2-decode" not in stages and "p2-pack" not in stages
+    assert "p2-decode" in stages and "p2-pack" in stages
+    # feed-wait is a stage-only wrapper: chunk accounting happened
+    # exactly once, producer-side, under the pass's real name
+    counters = obs.registry().snapshot()["counters"]
+    assert "chunks{pass=p2-decode}" in counters
+    assert "chunks{pass=p2-feed-wait}" not in counters
 
 
 def test_streaming_flagstat_prefetch_matches_default(resources,

@@ -1,0 +1,410 @@
+"""The run-wide tracing plane + per-pass I/O ledger (ISSUE 6).
+
+Covers: zero events / no collector when tracing is off; thread-aware
+span lanes (feeder thread + realign prep pool nest under their own
+lanes, the regression the shared stage stack caused); the two-thread
+interleaved-stage nesting pin; the Chrome-trace file validating under
+tools/check_trace.py; 2-process merge through the elastic sidecar path
+producing one loadable timeline; and the I/O ledger's totals
+reconciling with actual on-disk file sizes for a small transform run.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import json
+import os
+import pathlib
+import sys
+import threading
+import time
+
+import pytest
+
+from adam_tpu import obs
+from adam_tpu.instrument import report, stage
+from adam_tpu.obs import ioledger, trace
+from adam_tpu.parallel.mesh import make_mesh
+
+ROOT = pathlib.Path(__file__).parent.parent
+
+
+def _load_tool(name):
+    spec = importlib.util.spec_from_file_location(
+        name, ROOT / "tools" / f"{name}.py")
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+check_trace = _load_tool("check_trace")
+check_metrics = _load_tool("check_metrics")
+
+
+# ---------------------------------------------------------------------------
+# off = off
+# ---------------------------------------------------------------------------
+
+def test_trace_off_is_inert(tmp_path):
+    """No collector: span() is a no-op, stages record no trace events,
+    nothing is written anywhere."""
+    assert trace.active() is None
+    with trace.span("ghost"):
+        pass
+    trace.instant("ghost")
+    trace.counter("ghost", 1)
+    with stage("plain"):
+        pass
+    assert trace.active() is None
+    assert list(tmp_path.iterdir()) == []
+    # and the stage still landed in the report/metrics planes
+    assert report().root.children["plain"].calls == 1
+
+
+def test_trace_run_none_is_noop(tmp_path):
+    with trace.trace_run(None):
+        with stage("s"):
+            pass
+    assert list(tmp_path.iterdir()) == []
+    assert trace.active() is None
+
+
+# ---------------------------------------------------------------------------
+# thread-aware nesting (the shared-stage-stack regression pin)
+# ---------------------------------------------------------------------------
+
+def test_two_threads_interleaving_stages_nest_correctly(tmp_path):
+    """Two threads drive overlapping stage() contexts concurrently; the
+    old process-shared stack would pop the other thread's frame and
+    mis-nest the tree.  Each thread must get its own correctly nested
+    subtree AND its own timeline lane."""
+    path = tmp_path / "t.trace.json"
+    trace.start_trace(str(path))
+    barrier = threading.Barrier(2)
+
+    def worker(outer, inner):
+        barrier.wait()
+        with stage(outer):
+            time.sleep(0.02)
+            with stage(inner):
+                time.sleep(0.02)
+
+    t = threading.Thread(target=worker, args=("t-outer", "t-inner"),
+                         name="interleaver")
+    t.start()
+    worker("m-outer", "m-inner")        # main thread, interleaved
+    t.join()
+    receipt = trace.stop_trace()
+
+    root = report().root.children
+    # each thread's pair nests under ITSELF, at the root of its lane
+    assert "m-inner" in root["m-outer"].children
+    assert "t-inner" in root["t-outer"].children
+    assert "t-outer" not in root["m-outer"].children
+    assert "m-outer" not in root["t-outer"].children
+    # the timeline has two span lanes and validates (nesting included)
+    assert receipt["lanes"] == 2
+    assert check_trace.validate(str(path)) == []
+    doc = json.loads(path.read_text())
+    names = {e["args"]["name"] for e in doc["traceEvents"]
+             if e.get("ph") == "M" and e["name"] == "thread_name"}
+    assert "interleaver" in names
+
+
+def test_stage_event_carries_thread_lane(tmp_path):
+    """Off-main-thread stages stamp their lane into the metrics event —
+    the span schema check_metrics validates."""
+    mpath = tmp_path / "m.jsonl"
+    with obs.metrics_run(str(mpath)):
+        with stage("main-work"):
+            pass
+        th = threading.Thread(
+            target=lambda: _staged_noop("thread-work"), name="lane-7")
+        th.start()
+        th.join()
+    lines = [json.loads(ln) for ln in mpath.read_text().splitlines()]
+    stages = {d["name"]: d for d in lines if d["event"] == "stage"}
+    assert "thread" not in stages["main-work"]
+    assert stages["thread-work"]["thread"] == "lane-7"
+    assert check_metrics.validate(str(mpath)) == []
+
+
+def _staged_noop(name):
+    with stage(name):
+        pass
+
+
+# ---------------------------------------------------------------------------
+# product-path lanes: feeder thread + realign prep pool
+# ---------------------------------------------------------------------------
+
+def _realign_transform(tmp_path, trace_path=None, **kw):
+    from adam_tpu.parallel.pipeline import streaming_transform
+    from tests._synth_realign import synth_sam
+
+    src = tmp_path / "synth.sam"
+    src.write_text(synth_sam(6, 10, seed=11, tail_reads=6))
+    out = tmp_path / "out"
+    if trace_path is not None:
+        trace.start_trace(str(trace_path))
+    try:
+        n = streaming_transform(
+            str(src), str(out), markdup=True, bqsr=True, realign=True,
+            sort=True, mesh=make_mesh(8), chunk_rows=64,
+            executor_opts={"prefetch_depth": 2},
+            realign_opts={"depth": 2}, **kw)
+    finally:
+        receipt = trace.stop_trace() if trace_path is not None else None
+    return n, receipt
+
+
+def test_transform_trace_has_feeder_and_realign_lanes(tmp_path):
+    """The acceptance shape: a traced transform run emits a timeline
+    with distinct, correctly nested lanes for the main thread, the
+    executor's device-feed thread(s), and the realign prep pool."""
+    tpath = tmp_path / "run.trace.json"
+    n, receipt = _realign_transform(tmp_path, trace_path=tpath)
+    assert n > 0
+    assert receipt["lanes"] >= 3
+    assert check_trace.validate(str(tpath)) == [], \
+        check_trace.validate(str(tpath))
+    doc = json.loads(tpath.read_text())
+    lane_names = {e["args"]["name"] for e in doc["traceEvents"]
+                  if e.get("ph") == "M" and e["name"] == "thread_name"}
+    assert "MainThread" in lane_names
+    assert "device-feed" in lane_names
+    assert any(n.startswith("realign-prep") for n in lane_names)
+    # producer stages are REAL again (the PR 3 unstaged workaround is
+    # gone): decode/pack spans exist, on a non-main lane
+    spans = [e for e in doc["traceEvents"] if e.get("ph") == "X"]
+    main_tid = threading.main_thread().ident
+    by_name = {}
+    for e in spans:
+        by_name.setdefault(e["name"], set()).add(e["tid"])
+    assert "p2-decode" in by_name and "p2-pack" in by_name
+    assert by_name["p2-pack"] - {main_tid}, \
+        "pack spans should ride the feeder thread's lane"
+    assert {"p4-load", "p4-prep"} <= set(by_name)
+    assert by_name["p4-prep"] - {main_tid}, \
+        "prep spans should ride the realign pool's lanes"
+
+
+def test_traced_run_is_byte_identical_to_untraced(tmp_path):
+    from adam_tpu.io.parquet import load_table
+
+    n1, _ = _realign_transform(tmp_path, trace_path=None)
+    ref = load_table(str(tmp_path / "out"))
+    obs.reset_all()
+    report().reset()
+    tmp2 = tmp_path / "again"
+    tmp2.mkdir()
+    n2, _ = _realign_transform(tmp2, trace_path=tmp2 / "t.json")
+    assert n2 == n1
+    assert load_table(str(tmp2 / "out")).equals(ref)
+
+
+# ---------------------------------------------------------------------------
+# 2-process merge (the elastic sidecar path)
+# ---------------------------------------------------------------------------
+
+_WORKER_BODY = """
+import os
+from adam_tpu.obs import trace
+with trace.trace_run(os.environ["ADAM_TPU_TRACE"]):
+    with trace.span("worker-span"):
+        with trace.span("worker-child"):
+            pass
+"""
+
+
+def test_two_process_merge_produces_one_loadable_timeline(tmp_path):
+    """Two worker processes write timeline sidecars (ADAM_TPU_TRACE,
+    stamped by the elastic supervisor because the supervisor itself is
+    tracing); the supervisor folds them and writes ONE file with a lane
+    per process, loadable and valid."""
+    from adam_tpu.parallel.elastic import supervise
+
+    merged = tmp_path / "merged.trace.json"
+    trace.start_trace(str(merged))
+    env = dict(os.environ)
+    env["PYTHONPATH"] = str(ROOT) + os.pathsep + env.get("PYTHONPATH", "")
+    with stage("supervise"):
+        inc = supervise(
+            lambda pid, coord: [sys.executable, "-c", _WORKER_BODY],
+            num_processes=2, max_restarts=0, log_dir=str(tmp_path),
+            env=env)
+    assert len(inc.traces) == 2
+    receipt = trace.stop_trace()
+    assert check_trace.validate(str(merged)) == [], \
+        check_trace.validate(str(merged))
+    doc = json.loads(merged.read_text())
+    spans = [e for e in doc["traceEvents"] if e.get("ph") == "X"]
+    pids = {e["pid"] for e in spans}
+    assert len(pids) == 3               # supervisor + two workers
+    assert sum(1 for e in spans if e["name"] == "worker-span") == 2
+    assert receipt["lanes"] >= 3
+
+
+def test_env_carried_trace_path_is_overridden_per_worker(tmp_path):
+    """A caller env carrying ADAM_TPU_TRACE must not reach N workers
+    verbatim (they would all rename onto one file, last writer wins) —
+    the supervisor stamps per-worker paths off the env it actually
+    hands the workers."""
+    from adam_tpu.parallel.elastic import supervise
+
+    shared = tmp_path / "shared.trace.json"
+    env = dict(os.environ)
+    env["PYTHONPATH"] = str(ROOT) + os.pathsep + env.get("PYTHONPATH", "")
+    env[trace.TRACE_ENV] = str(shared)
+    inc = supervise(
+        lambda pid, coord: [sys.executable, "-c", _WORKER_BODY],
+        num_processes=2, max_restarts=0, log_dir=str(tmp_path), env=env)
+    assert len(set(inc.traces)) == 2
+    assert not shared.exists()
+    for tp in inc.traces:
+        assert check_trace.validate(tp) == []
+
+
+def test_io_ledger_amplification_null_when_nothing_decoded(tmp_path):
+    """A run that only spills/rereads (e.g. a checkpoint resume that
+    skipped pass 1) has an UNDEFINED amplification: the event carries
+    null, never a clamped-denominator byte count, and the gauge stays
+    unset."""
+    mpath = tmp_path / "m.jsonl"
+    with obs.metrics_run(str(mpath)):
+        ioledger.record("reread", 12345, "p2")
+        ioledger.emit_events()
+    lines = [json.loads(ln) for ln in mpath.read_text().splitlines()]
+    led = {d["pass"]: d for d in lines if d["event"] == "io_ledger"}
+    assert led["total"]["amplification"] is None
+    assert "io_spill_amplification" not in \
+        obs.registry().snapshot()["gauges"]
+    assert check_metrics.validate(str(mpath)) == []
+    assert "n/a" in ioledger.format_report()
+
+
+def test_untraced_supervisor_stamps_no_trace_sidecars(tmp_path):
+    from adam_tpu.parallel.elastic import supervise
+
+    assert trace.active() is None
+    inc = supervise(
+        lambda pid, coord: [sys.executable, "-c", "pass"],
+        num_processes=1, max_restarts=0, log_dir=str(tmp_path))
+    assert inc.traces == []
+    assert not list(tmp_path.glob("*.trace.json"))
+
+
+# ---------------------------------------------------------------------------
+# the I/O ledger
+# ---------------------------------------------------------------------------
+
+def _dir_bytes(path):
+    return ioledger.path_bytes(str(path))
+
+
+def test_io_ledger_reconciles_with_disk(resources, tmp_path):
+    """The acceptance pin: a small transform run's ledger totals equal
+    the actual on-disk sizes — decoded == the input file, p1 spilled ==
+    the raw spill dir, p2/p3 re-read == that same dir (each re-stream
+    pays it once), p3 spilled == the genome bins, p4 re-read == the
+    non-empty bins it loaded back."""
+    from adam_tpu.parallel.pipeline import streaming_transform
+
+    src = str(resources / "small.sam")
+    wd = tmp_path / "wd"
+    n = streaming_transform(src, str(tmp_path / "out"), markdup=True,
+                            bqsr=True, sort=True, mesh=make_mesh(8),
+                            chunk_rows=1 << 12, workdir=str(wd),
+                            resume=True)      # resume keeps the spill
+    assert n == 20
+    snap = ioledger.snapshot()
+    assert set(snap) == {"p1", "p2", "p3", "p4"}
+
+    raw = _dir_bytes(wd / "raw")
+    assert raw > 0
+    assert snap["p1"]["decoded"] == os.path.getsize(src)
+    assert snap["p1"]["spilled"] == raw
+    assert snap["p1"]["reread"] == 0
+    assert snap["p2"] == {"decoded": 0, "spilled": 0, "reread": raw}
+    assert snap["p3"]["decoded"] == 0 and snap["p3"]["reread"] == raw
+    bins = sum(_dir_bytes(d) for d in wd.glob("bin-*"))
+    assert snap["p3"]["spilled"] == bins > 0
+    assert snap["p4"] == {"decoded": 0, "spilled": 0, "reread": bins}
+
+    # the emitted gauge matches the hand-derived ratio
+    amp = obs.registry().snapshot()["gauges"]["io_spill_amplification"]
+    expect = (raw + bins + 2 * raw + bins) / os.path.getsize(src)
+    assert amp == pytest.approx(expect, abs=1e-3)
+
+    # counters carry the same numbers (the merge-able plane)
+    counters = obs.registry().snapshot()["counters"]
+    assert counters["io_bytes_spilled{pass=p1}"] == raw
+    assert counters["io_bytes_reread{pass=p4}"] == bins
+
+
+def test_io_ledger_events_validate_and_flagstat_decodes_once(
+        resources, tmp_path):
+    from adam_tpu.cli.main import main
+
+    mpath = tmp_path / "fs.jsonl"
+    rc = main(["flagstat", str(resources / "small.sam"),
+               "-metrics", str(mpath)])
+    assert rc == 0
+    assert check_metrics.validate(str(mpath)) == []
+    lines = [json.loads(ln) for ln in mpath.read_text().splitlines()]
+    led = {d["pass"]: d for d in lines if d["event"] == "io_ledger"}
+    src_bytes = os.path.getsize(resources / "small.sam")
+    assert led["flagstat"]["decoded"] == src_bytes
+    assert led["flagstat"]["spilled"] == 0
+    assert led["total"]["amplification"] == 0     # nothing spilled
+
+
+def test_transform_cli_trace_flag_end_to_end(resources, tmp_path):
+    """-trace on the CLI: timeline written atomically, validates under
+    the tool's main(), and the metrics sidecar records the receipt."""
+    from adam_tpu.cli.main import main
+
+    tpath = tmp_path / "run.trace.json"
+    mpath = tmp_path / "run.metrics.jsonl"
+    rc = main(["transform", str(resources / "small.sam"),
+               str(tmp_path / "out"), "-mark_duplicate_reads",
+               "-sort_reads", "-stream", "-trace", str(tpath),
+               "-metrics", str(mpath)])
+    assert rc == 0
+    assert trace.active() is None         # collector closed with the run
+    assert check_trace.main([str(tpath)]) == 0
+    assert check_metrics.validate(str(mpath)) == []
+    lines = [json.loads(ln) for ln in mpath.read_text().splitlines()]
+    tw = [d for d in lines if d["event"] == "trace_written"]
+    assert len(tw) == 1 and tw[0]["path"] == str(tpath)
+    assert tw[0]["events"] >= 1 and tw[0]["lanes"] >= 1
+
+
+def test_check_trace_rejects_torn_and_mis_nested(tmp_path):
+    torn = tmp_path / "torn.json"
+    torn.write_text('{"traceEvents": [')
+    assert check_trace.validate(str(torn)) != []
+
+    bad = tmp_path / "overlap.json"
+    bad.write_text(json.dumps({"traceEvents": [
+        {"name": "a", "ph": "X", "ts": 0.0, "dur": 10.0,
+         "pid": 1, "tid": 1},
+        {"name": "b", "ph": "X", "ts": 5.0, "dur": 10.0,
+         "pid": 1, "tid": 1},
+    ]}))
+    errs = check_trace.validate(str(bad))
+    assert any("partially overlaps" in e for e in errs)
+
+    ok = tmp_path / "nested.json"
+    ok.write_text(json.dumps({"traceEvents": [
+        {"name": "a", "ph": "X", "ts": 0.0, "dur": 10.0,
+         "pid": 1, "tid": 1},
+        {"name": "b", "ph": "X", "ts": 2.0, "dur": 3.0,
+         "pid": 1, "tid": 1},
+        {"name": "c", "ph": "X", "ts": 12.0, "dur": 1.0,
+         "pid": 1, "tid": 1},
+    ]}))
+    assert check_trace.validate(str(ok)) == []
+    empty = tmp_path / "empty.json"
+    empty.write_text(json.dumps({"traceEvents": []}))
+    assert any("no spans" in e for e in check_trace.validate(str(empty)))

@@ -8,7 +8,9 @@ elastic worker sidecars).  Contract checked here:
 * every line is a JSON object with an ``event`` string and numeric ``t``;
 * line 1 is the ``manifest``: ``schema == 1``, ``argv`` a list of
   strings, a hex ``config_fingerprint``, host/pid present;
-* ``stage`` events carry ``name`` (str) and ``seconds`` (number >= 0);
+* ``stage`` events carry ``name`` (str) and ``seconds`` (number >= 0),
+  plus an optional ``thread`` (str — the lane name, present when the
+  span ran off the main thread: feeder threads, prep pools);
 * ``chunk`` events carry ``pass`` (str) and ``rows`` (int >= 0);
 * ``executor_bucket_selected`` events carry ``pass``, ``chunk_rows``
   (int > 0), a strictly ascending int ``ladder`` whose top rung equals
@@ -39,6 +41,14 @@ elastic worker sidecars).  Contract checked here:
   ``input_digest`` (the policy decision is pure and replayable);
 * ``degraded_dispatch`` events carry ``site``, ``attempt`` (int >= 1)
   and ``error_kind`` — the chunk completed on the CPU fallback;
+* ``io_ledger`` events (one per pass + a ``total`` rollup at run end)
+  carry ``pass`` (str), non-negative int ``decoded``/``spilled``/
+  ``reread`` byte counts and an ``amplification`` ratio — non-negative
+  number, or null when the run decoded nothing ((spilled + reread) /
+  run decoded — the spill-I/O number ROADMAP item 1 targets);
+* ``trace_written`` events carry ``path`` (str), ``events`` (int >= 0)
+  and ``lanes`` (int >= 0) — the receipt for the run's Chrome-trace
+  timeline (validated separately by tools/check_trace.py);
 * the last line is the ``summary``: ``wall_seconds``, ``ok``, and a
   ``metrics`` snapshot whose counters/gauges are numeric and whose
   histograms are internally consistent (count == sum of bucket counts);
@@ -147,6 +157,8 @@ def validate(path: str) -> List[str]:
                 err(i, "stage event missing string 'name'")
             if not (_is_num(d.get("seconds")) and d["seconds"] >= 0):
                 err(i, "stage event missing non-negative 'seconds'")
+            if "thread" in d and not isinstance(d["thread"], str):
+                err(i, "stage event 'thread' lane is not a string")
         elif ev == "chunk":
             if not isinstance(d.get("pass"), str):
                 err(i, "chunk event missing string 'pass'")
@@ -313,6 +325,29 @@ def validate(path: str) -> List[str]:
                 err(i, "degraded_dispatch missing int 'attempt' >= 1")
             if not isinstance(d.get("error_kind"), str):
                 err(i, "degraded_dispatch missing string 'error_kind'")
+        elif ev == "io_ledger":
+            if not isinstance(d.get("pass"), str):
+                err(i, "io_ledger missing string 'pass'")
+            for field in ("decoded", "spilled", "reread"):
+                v = d.get(field)
+                if not (isinstance(v, int) and not isinstance(v, bool)
+                        and v >= 0):
+                    err(i, f"io_ledger missing non-negative int "
+                           f"{field!r}")
+            amp = d.get("amplification")
+            if not (amp is None or (_is_num(amp) and amp >= 0)):
+                err(i, "io_ledger 'amplification' must be a "
+                       "non-negative number or null (undefined when "
+                       "the run decoded nothing)")
+        elif ev == "trace_written":
+            if not isinstance(d.get("path"), str):
+                err(i, "trace_written missing string 'path'")
+            for field in ("events", "lanes"):
+                v = d.get(field)
+                if not (isinstance(v, int) and not isinstance(v, bool)
+                        and v >= 0):
+                    err(i, f"trace_written missing non-negative int "
+                           f"{field!r}")
 
     if summaries:
         i, s = summaries[0]

@@ -1,0 +1,157 @@
+#!/usr/bin/env python3
+"""Validate an adam-tpu Chrome-trace timeline (the ``-trace`` output).
+
+The replay-validator convention of tools/check_executor.py and
+tools/check_resilience.py, applied to the tracing plane
+(adam_tpu/obs/trace.py, docs/OBSERVABILITY.md): the file a run wrote
+must be loadable by Perfetto AND internally consistent — timeline bugs
+(negative durations, cross-thread stack corruption, unsorted lanes)
+show up here before anyone burns time staring at a garbled UI.
+
+Contract checked:
+
+* the file is a JSON object with a ``traceEvents`` list (the Chrome
+  Trace Event Format container adam-tpu writes; ``displayTimeUnit``
+  optional);
+* every event is an object with a string ``name`` and a ``ph`` in
+  {X, i, C, M}; non-metadata events carry numeric ``ts`` and int
+  ``pid``/``tid``;
+* ``X`` (complete-span) events carry ``dur >= 0``;
+* per (pid, tid) lane, ``X`` events appear in non-decreasing ``ts``
+  order (the writer sorts; an unsorted lane means a merge bug);
+* per lane, spans NEST or are DISJOINT — a span that partially overlaps
+  another on the same lane is exactly the corruption the old shared
+  stage stack produced, and the thing the thread-aware stack exists to
+  prevent ("balanced begin/end" in complete-event form);
+* at least one ``X`` event exists (an empty timeline is a wiring bug,
+  not a valid artifact).
+
+Usage::
+
+    python tools/check_trace.py RUN.trace.json [...]
+
+Exit 0 when every file validates; 1 otherwise, one error line per
+violation.  Used by tests/test_trace.py so the documented format and
+the produced format cannot drift.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from typing import Dict, List, Tuple
+
+_PHASES = ("X", "i", "C", "M")
+_NUM = (int, float)
+
+
+def _is_num(v) -> bool:
+    return isinstance(v, _NUM) and not isinstance(v, bool)
+
+
+def _is_int(v) -> bool:
+    return isinstance(v, int) and not isinstance(v, bool)
+
+
+def validate(path: str) -> List[str]:
+    """Return human-readable violations (empty = valid timeline)."""
+    errs: List[str] = []
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except OSError as e:
+        return [f"{path}: unreadable: {e}"]
+    except ValueError as e:
+        return [f"{path}: invalid JSON (torn write?): {e}"]
+    if not isinstance(doc, dict) or \
+            not isinstance(doc.get("traceEvents"), list):
+        return [f"{path}: not a Chrome-trace document "
+                "(object with a 'traceEvents' list)"]
+
+    lanes: Dict[Tuple, List[Tuple[float, float, str]]] = {}
+    n_spans = 0
+    for i, ev in enumerate(doc["traceEvents"]):
+        where = f"{path}: traceEvents[{i}]"
+        if not isinstance(ev, dict):
+            errs.append(f"{where}: event is not an object")
+            continue
+        ph = ev.get("ph")
+        if ph not in _PHASES:
+            errs.append(f"{where}: unknown phase {ph!r}")
+            continue
+        if not isinstance(ev.get("name"), str):
+            errs.append(f"{where}: missing string 'name'")
+        if ph == "M":
+            continue            # metadata carries no clock
+        if not _is_num(ev.get("ts")):
+            errs.append(f"{where}: missing numeric 'ts'")
+            continue
+        if not (_is_int(ev.get("pid")) and _is_int(ev.get("tid"))):
+            errs.append(f"{where}: missing int 'pid'/'tid'")
+            continue
+        if ph != "X":
+            continue
+        n_spans += 1
+        dur = ev.get("dur")
+        if not (_is_num(dur) and dur >= 0):
+            errs.append(f"{where}: X event missing non-negative 'dur'")
+            continue
+        lane = (ev["pid"], ev["tid"])
+        seq = lanes.setdefault(lane, [])
+        if seq and ev["ts"] < seq[-1][0]:
+            errs.append(f"{where}: lane {lane} timestamps regress "
+                        f"({ev['ts']} after {seq[-1][0]} — unsorted "
+                        "lane, merge bug)")
+        seq.append((float(ev["ts"]), float(ev["ts"]) + float(dur),
+                    ev.get("name", "?")))
+
+    # span nesting per lane: walking starts in ts order, an open-span
+    # stack catches partial overlap — the complete-event form of
+    # "balanced begin/end"
+    for lane, seq in lanes.items():
+        stack: List[Tuple[float, float, str]] = []
+        # equal-start ties order the LONGER span first (the parent): a
+        # child sharing its parent's start must stack under it
+        for ts, te, name in sorted(seq, key=lambda x: (x[0], -x[1])):
+            while stack and ts >= stack[-1][1] - 1e-9:
+                stack.pop()
+            if stack and te > stack[-1][1] + 1e-6:
+                errs.append(
+                    f"{path}: lane {lane}: span {name!r} "
+                    f"[{ts:.1f}, {te:.1f}] partially overlaps enclosing "
+                    f"{stack[-1][2]!r} [.., {stack[-1][1]:.1f}] — "
+                    "mis-nested spans (the shared-stage-stack bug)")
+            stack.append((ts, te, name))
+
+    if not errs and n_spans == 0:
+        errs.append(f"{path}: no spans (X events) — an empty timeline "
+                    "is a wiring bug, not evidence")
+    return errs
+
+
+def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    if not argv:
+        print("usage: check_trace.py RUN.trace.json [...]",
+              file=sys.stderr)
+        return 2
+    bad = 0
+    for path in argv:
+        errors = validate(path)
+        if errors:
+            bad += 1
+            for e in errors:
+                print(e, file=sys.stderr)
+        else:
+            with open(path) as f:
+                doc = json.load(f)
+            evs = doc["traceEvents"]
+            lanes = {(e.get("pid"), e.get("tid")) for e in evs
+                     if e.get("ph") == "X"}
+            print(f"{path}: ok ({sum(1 for e in evs if e.get('ph') == 'X')}"
+                  f" spans across {len(lanes)} lanes)")
+    return 1 if bad else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

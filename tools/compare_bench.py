@@ -1,0 +1,191 @@
+#!/usr/bin/env python3
+"""Diff two BENCH artifacts and GATE on regression.
+
+Five rounds of BENCH_r0N.json accumulated a trajectory nobody machine-
+checked: a PR that halved the headline would only be caught by a human
+reading two JSON blobs.  This tool makes the bench trajectory gate —
+compare an OLD artifact against a NEW one and exit nonzero when any
+tracked metric regressed past the threshold:
+
+* throughput metrics (``value``, ``*_reads_per_sec``,
+  ``transform_vs_target``, ``vs_baseline``) — HIGHER is better;
+* cost metrics (``*_stage_wall_s``, ``*_wall_s``, ``first_matmul_s``,
+  ``*pad_waste*``, ``*spill_amplification*``) — LOWER is better (the
+  last two are the executor's pad-tax and the I/O ledger's spill ratio,
+  docs/OBSERVABILITY.md).
+
+Accepts both artifact shapes: the bench one-line doc itself
+(BENCH_TPU_EVIDENCE.json) and the driver wrapper holding it under
+``parsed`` (BENCH_r0N.json).  Artifacts from different platforms
+(cpu vs tpu) are incomparable — flagged and exited 2 unless
+``--allow-cross-platform`` (numbers still print).
+
+Usage::
+
+    python tools/compare_bench.py OLD.json NEW.json [--threshold 10]
+           [--keys value,transform_fused_reads_per_sec] [--allow-cross-platform]
+
+Exit codes: 0 no regression, 1 regression past threshold, 2 usage /
+unreadable / cross-platform.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Dict, List, Optional, Tuple
+
+#: substrings/suffixes that mark a LOWER-is-better metric
+_LOWER_BETTER = ("pad_waste", "spill_amplification", "_wall_s",
+                 "first_matmul_s", "rtt_ms")
+#: markers of HIGHER-is-better metrics
+_HIGHER_BETTER_SUFFIX = ("_reads_per_sec", "_tflops",
+                         "_gbytes_per_sec")
+_HIGHER_BETTER_EXACT = ("value", "vs_baseline", "transform_vs_target",
+                        "mfu", "mfu_pct")
+
+
+def load_doc(path: str) -> dict:
+    with open(path) as f:
+        doc = json.load(f)
+    if isinstance(doc, dict) and isinstance(doc.get("parsed"), dict):
+        doc = doc["parsed"]         # the BENCH_r0N.json driver wrapper
+    if not isinstance(doc, dict):
+        raise ValueError(f"{path}: not a bench artifact object")
+    return doc
+
+
+def direction(key: str) -> Optional[str]:
+    """'up' (higher better), 'down' (lower better), None (untracked)."""
+    if key in _HIGHER_BETTER_EXACT or \
+            key.endswith(_HIGHER_BETTER_SUFFIX):
+        return "up"
+    if any(m in key for m in _LOWER_BETTER):
+        return "down"
+    return None
+
+
+def _is_num(v) -> bool:
+    return isinstance(v, (int, float)) and not isinstance(v, bool)
+
+
+def compare(old: dict, new: dict, threshold_pct: float,
+            keys: Optional[List[str]] = None
+            ) -> Tuple[List[str], List[str], Dict[str, tuple]]:
+    """Returns (regressions, notes, rows) where rows maps key ->
+    (old, new, delta_pct, direction)."""
+    regressions: List[str] = []
+    notes: List[str] = []
+    rows: Dict[str, tuple] = {}
+    if keys:
+        tracked = []
+        for k in keys:
+            d = direction(k)
+            if d is None:
+                # an explicit key with no recognized direction marker:
+                # say the assumption out loud — silently guessing "up"
+                # would invert the gate for a cost metric
+                notes.append(f"{k}: direction unrecognized — assuming "
+                             "higher-is-better (name it *_wall_s / "
+                             "*pad_waste* / *spill_amplification* "
+                             "for lower-is-better)")
+                d = "up"
+            tracked.append((k, d))
+    else:
+        tracked = [(k, d) for k in sorted(set(old) | set(new))
+                   if (d := direction(k)) is not None]
+    for key, d in tracked:
+        ov, nv = old.get(key), new.get(key)
+        if not _is_num(ov) or not _is_num(nv):
+            if _is_num(ov) and nv is None:
+                notes.append(f"{key}: present in OLD, missing in NEW")
+            continue
+        if ov == 0:
+            if nv != 0:
+                # relative change against a zero baseline is undefined
+                # (0 pad waste -> 0.0001 is not an infinite regression);
+                # surface it, never gate on it
+                notes.append(f"{key}: zero baseline ({ov!r} -> {nv!r})"
+                             " — relative change undefined, not gated")
+                continue
+            delta = 0.0
+        else:
+            delta = 100.0 * (nv - ov) / abs(ov)
+        rows[key] = (ov, nv, delta, d)
+        regressed = (d == "up" and delta < -threshold_pct) or \
+                    (d == "down" and delta > threshold_pct)
+        if regressed:
+            arrow = "fell" if d == "up" else "rose"
+            regressions.append(
+                f"{key}: {arrow} {abs(delta):.1f}% "
+                f"({ov!r} -> {nv!r}; threshold {threshold_pct}%)")
+    return regressions, notes, rows
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="diff two BENCH_*.json artifacts; exit 1 on "
+                    "regression past --threshold")
+    ap.add_argument("old", help="baseline artifact")
+    ap.add_argument("new", help="candidate artifact")
+    ap.add_argument("--threshold", type=float, default=10.0,
+                    metavar="PCT",
+                    help="allowed change in the bad direction (%%; "
+                         "default 10)")
+    ap.add_argument("--keys", default=None,
+                    help="comma-separated metric keys (default: every "
+                         "tracked throughput/cost key present)")
+    ap.add_argument("--allow-cross-platform", action="store_true",
+                    help="compare artifacts from different backends "
+                         "anyway (numbers are NOT comparable across "
+                         "cpu/tpu; off by default)")
+    args = ap.parse_args(argv)
+
+    try:
+        old, new = load_doc(args.old), load_doc(args.new)
+    except (OSError, ValueError) as e:
+        print(f"compare_bench: {e}", file=sys.stderr)
+        return 2
+
+    po, pn = old.get("platform"), new.get("platform")
+    if po != pn and not args.allow_cross_platform:
+        print(f"compare_bench: platform mismatch ({po!r} vs {pn!r}) — "
+              "cross-backend numbers do not gate "
+              "(--allow-cross-platform overrides)", file=sys.stderr)
+        return 2
+
+    keys = [k.strip() for k in args.keys.split(",")] if args.keys else None
+    regressions, notes, rows = compare(old, new, args.threshold, keys)
+    if not rows and not notes:
+        print("compare_bench: no tracked numeric keys in common",
+              file=sys.stderr)
+        return 2
+
+    width = max((len(k) for k in rows), default=10)
+    print(f"{'metric':<{width}}  {'old':>14}  {'new':>14}  {'Δ%':>8}")
+    for key, (ov, nv, delta, d) in rows.items():
+        mark = ""
+        if (d == "up" and delta < -args.threshold) or \
+                (d == "down" and delta > args.threshold):
+            mark = "  REGRESSION"
+        elif (d == "up" and delta > args.threshold) or \
+                (d == "down" and delta < -args.threshold):
+            mark = "  improved"
+        print(f"{key:<{width}}  {ov:>14.4g}  {nv:>14.4g}  "
+              f"{delta:>+7.1f}%{mark}")
+    for n in notes:
+        print(f"note: {n}")
+    if regressions:
+        print(f"\n{len(regressions)} regression(s) past "
+              f"{args.threshold}%:", file=sys.stderr)
+        for r in regressions:
+            print(f"  {r}", file=sys.stderr)
+        return 1
+    print(f"\nok: no regression past {args.threshold}% "
+          f"({len(rows)} metrics compared)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

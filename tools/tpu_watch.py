@@ -79,6 +79,12 @@ def main() -> int:
             # cold per-worker compiles
             env = dict(os.environ)
             env.setdefault("ADAM_TPU_BENCH_TOTAL_BUDGET", "900")
+            # every watcher-driven window leaves a timeline behind:
+            # bench stamps per-attempt ADAM_TPU_TRACE sidecars
+            # (BENCH_trace_<tag>.json) into each payload, and payloads
+            # persist through the evidence ledger — an on-chip capture
+            # is then inspectable in Perfetto, not just a number
+            env.setdefault("ADAM_TPU_TRACE_BENCH", "1")
             # flap resilience (r5): the 51.5M-read default packs+ships a
             # 206 MB wire ×3 through a tunnel that stalls on minute
             # scales — the exact shape of r5-window-1's flagstat hang.
