@@ -121,9 +121,39 @@ def _apply_events(state: np.ndarray, start: np.ndarray,
         state[rs[sel], js[sel]] = value
 
 
+def md_events_for(table: pa.Table, starts: np.ndarray
+                  ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Parse a chunk's MD tags ONCE into the compact event form the
+    fused transform hoists into stream 1: ``(has_md, ev_rows, ev_pos)``
+    — per-read MD presence plus the ~1-per-read mismatch events
+    (chunk-local row, absolute reference position).  Feeding this back
+    through ``count_tables_device(md_info=...)`` skips the MD re-parse
+    (and lets the count walk's spill projection drop the
+    ``mismatchingPositions`` column entirely — it is the largest column
+    of the raw spill on typical inputs)."""
+    from ..ops.pileup import _col_valid, _md_lookup_arrays
+
+    md_col = table.column("mismatchingPositions")
+    has_md = _col_valid(md_col)
+    mm_keys, _, _, _ = _md_lookup_arrays(md_col, starts,
+                                         np.flatnonzero(has_md))
+    return (has_md, (mm_keys >> 34).astype(np.int64),
+            mm_keys & ((np.int64(1) << 34) - 1))
+
+
+def slice_md_info(md_info, s: int, e: int):
+    """Row-slice an ``(has_md, ev_rows, ev_pos)`` triple to [s, e) with
+    rows re-based to the slice (the slab walk's counterpart of
+    ``ReadBatch.row_slice``)."""
+    has_md, ev_rows, ev_pos = md_info
+    sel = (ev_rows >= s) & (ev_rows < e)
+    return has_md[s:e], ev_rows[sel] - s, ev_pos[sel]
+
+
 def mismatch_state(table: pa.Table, batch: ReadBatch,
                    snp_table: Optional[SnpTable] = None,
-                   device_batch: Optional[ReadBatch] = None) -> np.ndarray:
+                   device_batch: Optional[ReadBatch] = None,
+                   md_info=None) -> np.ndarray:
     """[N, L] int8 per-base state for pass 1.
 
     Mirrors ReadCovariates.next (:49-60): a base is MASKED when its reference
@@ -140,9 +170,11 @@ def mismatch_state(table: pa.Table, batch: ReadBatch,
     """
     n = table.num_rows
     L = batch.max_len
-    md_col = table.column("mismatchingPositions")
-    from ..ops.pileup import _col_valid, _md_lookup_arrays
-    has_md = _col_valid(md_col)
+    if md_info is None:
+        from ..ops.pileup import _col_valid
+        has_md = _col_valid(table.column("mismatchingPositions"))
+    else:
+        has_md = md_info[0][:n]     # may carry the padded tail
     has_md_pad = np.zeros(batch.n_reads, bool)
     has_md_pad[:n] = has_md
 
@@ -169,11 +201,20 @@ def mismatch_state(table: pa.Table, batch: ReadBatch,
         simple &= ops[:, 1] < 0
 
     # MD mismatch events (shared key encoding with the pileup engine:
-    # row << 34 | ref_pos)
-    usable_rows = np.flatnonzero(has_md)
-    mm_keys, _, _, _ = _md_lookup_arrays(md_col, start, usable_rows)
-    _apply_events(state, start, simple, pos_d, (mm_keys >> 34),
-                  mm_keys & ((np.int64(1) << 34) - 1), STATE_MISMATCH)
+    # row << 34 | ref_pos); ``md_info`` supplies them pre-parsed (the
+    # fused transform parses MD once in stream 1 — events are
+    # same-valued scatters, so supply order cannot change the state)
+    if md_info is None:
+        from ..ops.pileup import _md_lookup_arrays
+        mm_keys, _, _, _ = _md_lookup_arrays(
+            table.column("mismatchingPositions"), start,
+            np.flatnonzero(has_md))
+        ev_rows = mm_keys >> 34
+        ev_pos = mm_keys & ((np.int64(1) << 34) - 1)
+    else:
+        _, ev_rows, ev_pos = md_info
+    _apply_events(state, start, simple, pos_d, ev_rows, ev_pos,
+                  STATE_MISMATCH)
 
     if snp_table is not None and len(snp_table):
         # dictionary-encode the contig column once, then iterate only the
@@ -606,7 +647,8 @@ def count_tables_device(table: pa.Table,
                         n_read_groups: Optional[int] = None,
                         mesh=None,
                         device_batch: Optional[ReadBatch] = None,
-                        donate: bool = False):
+                        donate: bool = False,
+                        md_info=None):
     """Pass-1 counting for one chunk, WITHOUT the host sync: returns the 7
     count tensors (qual_obs, qual_mm, cycle_obs, cycle_mm, ctx_obs,
     ctx_mm, qhist) still on device (numpy under the "host" impl — both add
@@ -643,32 +685,40 @@ def count_tables_device(table: pa.Table,
             out = _count_tables_one(table.slice(s, max(min(e, n) - s, 0)),
                                     batch.row_slice(s, e),
                                     snp_table, n_read_groups, None,
-                                    donate=donate)
+                                    donate=donate,
+                                    md_info=None if md_info is None
+                                    else slice_md_info(md_info, s, e))
             acc = out if acc is None else tuple(
                 a + b for a, b in zip(acc, out))
         return acc
     return _count_tables_one(table, batch, snp_table, n_read_groups,
                              mesh if sharded else None,
-                             device_batch=device_batch, donate=donate)
+                             device_batch=device_batch, donate=donate,
+                             md_info=md_info)
 
 
 def _count_tables_one(table: pa.Table, batch: ReadBatch,
                       snp_table: Optional[SnpTable],
                       n_read_groups: int, mesh,
                       device_batch: Optional[ReadBatch] = None,
-                      donate: bool = False):
+                      donate: bool = False,
+                      md_info=None):
     """One slab's pass-1 count (the pre-slab body of
     :func:`count_tables_device`)."""
     n = table.num_rows
-    from ..ops.pileup import _col_valid
     has_md = np.zeros(batch.n_reads, bool)
-    has_md[:n] = _col_valid(table.column("mismatchingPositions"))
+    if md_info is None:
+        from ..ops.pileup import _col_valid
+        has_md[:n] = _col_valid(table.column("mismatchingPositions"))
+    else:
+        has_md[:n] = md_info[0][:n]
     flags_np = np.asarray(batch.flags)
     usable = usable_read_mask(flags_np, has_md) & np.asarray(batch.valid)
 
     state = np.full((batch.n_reads, batch.max_len), STATE_MASKED, np.int8)
     state[:n] = mismatch_state(table, batch, snp_table,
-                               device_batch=device_batch)
+                               device_batch=device_batch,
+                               md_info=md_info)
     dev = device_batch if device_batch is not None else batch
 
     rt = RecalTable(n_read_groups=max(n_read_groups, 1),

@@ -301,6 +301,11 @@ class TransformCommand(Command):
                             "(the pre-pipeline path; mirrors "
                             "ADAM_TPU_REALIGN_PIPELINE=0). Scheduling "
                             "only — output bytes never change")
+        p.add_argument("-no_fuse", action="store_true",
+                       help="run the legacy 4-pass streaming transform "
+                            "instead of the fused single-decode streams "
+                            "(mirrors ADAM_TPU_FUSE=0). Dataflow only — "
+                            "output is byte-identical either way")
         add_executor_args(p)
         add_parquet_args(p)
 
@@ -350,7 +355,8 @@ class TransformCommand(Command):
                 io_threads=args.io_threads,
                 io_procs=args.io_procs,
                 executor_opts=executor_opts_from(args),
-                realign_opts=realign_opts)
+                realign_opts=realign_opts,
+                fuse=False if args.no_fuse else None)
             if args.timing:
                 from ..instrument import print_report
                 print_report()   # one quiet gate for ALL instrument output

@@ -1,0 +1,258 @@
+"""ReadBatch wire-format spill: sequence/qual as padded byte planes.
+
+The legacy streaming transform spills raw Parquet ROWS and re-packs the
+two base-level string columns (``sequence``, ``qual``) on every
+re-stream: a ragged offsets+data gather per column per chunk
+(packing._string_column_to_padded).  The fused transform's stream 1
+spills those columns already in the ReadBatch WIRE LAYOUT instead — one
+fixed-width byte row per read, padded to the canonical length bucket —
+so a re-streaming pass rebuilds the device planes with a reshape + LUT
+(no ragged gather) and the output pass reconstructs the original
+strings with an exact prefix slice.
+
+Losslessness is structural, not alphabet-dependent: the wire columns
+hold the ORIGINAL BYTES verbatim (never the int8 codes), lengths ride
+in sidecar int32 columns (-1 encodes null, 0 the empty string), so any
+IUPAC/lowercase/odd byte round-trips exactly — pinned by the
+tests/test_fusion.py roundtrip property tests.
+
+Schema mapping (column order preserved):
+
+* ``sequence`` -> ``__wire_seq`` (binary, every row exactly the wire
+  width) at the same column index; ``__wire_seq_len`` appended;
+* ``qual`` -> ``__wire_qual`` / ``__wire_qual_len`` likewise.
+
+Every chunk of one spill uses the same wire width (the caller passes
+the run's growing length bucket), so the Parquet dataset carries one
+unified schema and a re-read chunk's plane rebuild is a single
+``data.reshape(n, W)``.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import numpy as np
+import pyarrow as pa
+
+WIRE_SEQ = "__wire_seq"
+WIRE_QUAL = "__wire_qual"
+WIRE_SEQ_LEN = "__wire_seq_len"
+WIRE_QUAL_LEN = "__wire_qual_len"
+
+#: Arrow binary columns carry int32 offsets: one wire plane must stay
+#: under 2^31 bytes or the offset arithmetic would wrap SILENTLY (a
+#: 2^20-row chunk of 2048-padded long reads crosses it).  to_wire
+#: builds chunked columns above this; _wire_pair refuses outright.
+MAX_WIRE_PLANE_BYTES = (1 << 31) - (1 << 16)
+
+#: the wire plane columns a count-only projection needs (plus scalars)
+WIRE_COLUMNS = (WIRE_SEQ, WIRE_QUAL, WIRE_SEQ_LEN, WIRE_QUAL_LEN)
+
+
+def is_wire_table(table: pa.Table) -> bool:
+    return WIRE_SEQ in table.column_names
+
+
+def _string_bytes(col) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Arrow string/binary column -> (data uint8, offsets int32,
+    lens int32 with -1 for null)."""
+    arr = col.combine_chunks() if isinstance(col, pa.ChunkedArray) else col
+    if isinstance(arr, pa.ChunkedArray):  # zero-chunk edge case
+        arr = pa.concat_arrays(arr.chunks) if arr.num_chunks \
+            else pa.array([], pa.binary())
+    n = len(arr)
+    bufs = arr.buffers()
+    offsets = np.frombuffer(bufs[1], np.int32, count=n + 1,
+                            offset=arr.offset * 4) if n else \
+        np.zeros(1, np.int32)
+    data = np.frombuffer(bufs[2], np.uint8) if len(bufs) > 2 and \
+        bufs[2] is not None else np.zeros(0, np.uint8)
+    lens = (offsets[1:] - offsets[:-1]).astype(np.int32)
+    if n and arr.null_count:
+        lens = np.where(np.asarray(arr.is_null()), -1, lens)
+    return data, offsets, lens
+
+
+def _padded_matrix(data: np.ndarray, offsets: np.ndarray,
+                   lens: np.ndarray, width: int) -> np.ndarray:
+    """[n, width] uint8 byte matrix: each row's original bytes then
+    zero padding (null rows all-zero)."""
+    n = len(lens)
+    out = np.zeros((n, width), np.uint8)
+    if n == 0 or data.size == 0:
+        return out
+    real = np.maximum(lens, 0)
+    if int(real.max(initial=0)) > width:
+        raise ValueError(
+            f"string length {int(real.max())} exceeds wire width {width}")
+    # dense fast path: uniform non-null rows ARE the matrix
+    L0 = int(real[0])
+    if L0 and not (lens < 0).any() and data.size == n * L0 and \
+            int(offsets[0]) == 0 and int(offsets[-1]) == data.size and \
+            bool((real == L0).all()):
+        out[:, :L0] = data.reshape(n, L0)
+        return out
+    pos = np.arange(width, dtype=np.int32)[None, :]
+    mask = pos < real[:, None]
+    pos_in_row = np.minimum(pos, np.maximum(real[:, None] - 1, 0))
+    src = np.minimum(offsets[:-1, None] + pos_in_row,
+                     np.int32(max(data.size - 1, 0)))
+    np.copyto(out, np.where(mask, data[src], 0))
+    return out
+
+
+def _wire_pair(col, width: int) -> Tuple[pa.Array, pa.Array]:
+    """One string column -> (wire binary array of uniform ``width``
+    rows, int32 length array with -1 for null)."""
+    data, offsets, lens = _string_bytes(col)
+    n = len(lens)
+    if n * width > MAX_WIRE_PLANE_BYTES:
+        # int32 offsets would wrap silently past 2 GiB — the caller
+        # (to_wire) slices rows to stay under the cap, so reaching this
+        # is a bug, and corrupting the spill is the one wrong answer
+        raise ValueError(
+            f"wire plane {n} rows x {width} B exceeds the 2 GiB "
+            "int32-offset cap")
+    mat = _padded_matrix(data, offsets, lens, width)
+    wire_offsets = (np.arange(n + 1, dtype=np.int32) * width)
+    wire = pa.Array.from_buffers(
+        pa.binary(), n,
+        [None, pa.py_buffer(wire_offsets), pa.py_buffer(mat.tobytes())])
+    return wire, pa.array(lens, pa.int32())
+
+
+def to_wire(table: pa.Table, width: int) -> pa.Table:
+    """Replace ``sequence``/``qual`` with wire plane columns (same
+    indices; length sidecars appended).  ``width`` must hold every
+    read of the run (the transform passes its canonical length
+    bucket).  A chunk whose padded plane would cross the 2 GiB
+    int32-offset cap is built in row slices and carried as chunked
+    columns — same values, no silent offset wrap."""
+    rows_cap = max(MAX_WIRE_PLANE_BYTES // max(width, 1), 1)
+
+    def wire_col(name):
+        col = table.column(name)
+        if table.num_rows <= rows_cap:
+            w, ln = _wire_pair(col, width)
+            return w, ln
+        parts = [_wire_pair(col.slice(lo, rows_cap), width)
+                 for lo in range(0, table.num_rows, rows_cap)]
+        return (pa.chunked_array([p[0] for p in parts]),
+                pa.chunked_array([p[1] for p in parts]))
+
+    seq_wire, seq_len = wire_col("sequence")
+    qual_wire, qual_len = wire_col("qual")
+    out = table.set_column(table.column_names.index("sequence"),
+                           WIRE_SEQ, seq_wire)
+    out = out.set_column(out.column_names.index("qual"),
+                         WIRE_QUAL, qual_wire)
+    out = out.append_column(WIRE_SEQ_LEN, seq_len)
+    return out.append_column(WIRE_QUAL_LEN, qual_len)
+
+
+def _wire_matrix(table: pa.Table, name: str) -> np.ndarray:
+    """[n, W] uint8 matrix straight off the wire column's data buffer."""
+    data, offsets, lens = _string_bytes(table.column(name))
+    n = table.num_rows
+    if n == 0:
+        return np.zeros((0, 0), np.uint8)
+    W = int(lens[0]) if len(lens) else 0
+    if W and data.size == n * W and int(offsets[0]) == 0 and \
+            bool((lens == W).all()):
+        return data.reshape(n, W).copy()
+    # defensive ragged fallback (a hand-edited spill); rebuild densely
+    width = int(np.maximum(lens, 0).max(initial=0))
+    return _padded_matrix(data, offsets, lens, max(width, 1))
+
+
+def _rebuild_string(mat: np.ndarray, lens: np.ndarray) -> pa.Array:
+    """Wire matrix + true lengths -> the exact original string column
+    (prefix bytes verbatim, nulls where ``lens < 0``)."""
+    n = len(lens)
+    nulls = lens < 0
+    real = np.maximum(lens, 0)
+    offsets = np.zeros(n + 1, np.int32)
+    np.cumsum(real, out=offsets[1:])
+    W = mat.shape[1] if mat.ndim == 2 else 0
+    keep = np.arange(W, dtype=np.int32)[None, :] < real[:, None]
+    data = mat[keep].tobytes() if W else b""
+    buffers = [None, pa.py_buffer(offsets), pa.py_buffer(data)]
+    null_count = int(nulls.sum())
+    if null_count:
+        buffers[0] = pa.py_buffer(
+            np.packbits(~nulls, bitorder="little").tobytes())
+    return pa.Array.from_buffers(pa.string(), n, buffers,
+                                 null_count=null_count)
+
+
+def from_wire(table: pa.Table) -> pa.Table:
+    """Exact inverse of :func:`to_wire` (original column names, order,
+    values, and nulls)."""
+    seq_lens = np.asarray(table.column(WIRE_SEQ_LEN).combine_chunks()
+                          .to_numpy(zero_copy_only=False)).astype(np.int64)
+    qual_lens = np.asarray(table.column(WIRE_QUAL_LEN).combine_chunks()
+                           .to_numpy(zero_copy_only=False)).astype(np.int64)
+    seq = _rebuild_string(_wire_matrix(table, WIRE_SEQ), seq_lens)
+    qual = _rebuild_string(_wire_matrix(table, WIRE_QUAL), qual_lens)
+    out = table.set_column(table.column_names.index(WIRE_SEQ),
+                           "sequence", seq)
+    out = out.set_column(out.column_names.index(WIRE_QUAL), "qual", qual)
+    return out.drop_columns([WIRE_SEQ_LEN, WIRE_QUAL_LEN])
+
+
+def pack_reads_wire(table: pa.Table, *, bucket_len: int,
+                    pad_rows_to: int = 1,
+                    max_cigar_ops: Optional[int] = None):
+    """:func:`packing.pack_reads` over a WIRE-format chunk: the base/qual
+    planes come from a reshape + one LUT pass over the wire matrices (no
+    ragged gather), producing bit-identical planes to packing a
+    reconstructed string table (padding beyond each read's length is
+    BASE_PAD / QUAL_PAD exactly as pack_reads emits)."""
+    from .. import schema as S
+    from ..packing import (MAX_CIGAR_OPS, QUAL_PAD, ReadBatch, _BASE_LUT,
+                           _OFFSET_LUTS, _int_column, _round_up,
+                           pack_cigars)
+
+    n = table.num_rows
+    n_pad = _round_up(max(n, 1), pad_rows_to)
+    seq_lens = np.asarray(table.column(WIRE_SEQ_LEN).combine_chunks()
+                          .to_numpy(zero_copy_only=False)).astype(np.int64)
+    qual_lens = np.asarray(table.column(WIRE_QUAL_LEN).combine_chunks()
+                           .to_numpy(zero_copy_only=False)).astype(np.int64)
+    if int(np.maximum(seq_lens, 0).max(initial=0)) > bucket_len or \
+            int(np.maximum(qual_lens, 0).max(initial=0)) > bucket_len:
+        raise ValueError("wire read length exceeds bucket "
+                         f"{bucket_len}")
+
+    def plane(name, lens, lut, pad_value):
+        mat = _wire_matrix(table, name)
+        out = np.full((n_pad, bucket_len), pad_value, np.int8)
+        W = min(mat.shape[1], bucket_len) if mat.size else 0
+        if W:
+            real = np.maximum(lens, 0)
+            dec = lut[mat[:, :W]]
+            keep = np.arange(W, dtype=np.int32)[None, :] < real[:, None]
+            out[:n, :W] = np.where(keep, dec, pad_value)
+        return out
+
+    bases = plane(WIRE_SEQ, seq_lens, _BASE_LUT, S.BASE_PAD)
+    quals = plane(WIRE_QUAL, qual_lens, _OFFSET_LUTS[33], QUAL_PAD)
+    read_len = np.zeros(n_pad, np.int32)
+    read_len[:n] = np.maximum(seq_lens, 0).astype(np.int32)
+    ops, lens_c, n_ops = pack_cigars(
+        table.column("cigar"), n_pad,
+        max_cigar_ops if max_cigar_ops is not None else MAX_CIGAR_OPS)
+    return ReadBatch(
+        flags=_int_column(table, "flags", n_pad, null_value=0),
+        refid=_int_column(table, "referenceId", n_pad),
+        start=_int_column(table, "start", n_pad),
+        mapq=_int_column(table, "mapq", n_pad),
+        mate_refid=_int_column(table, "mateReferenceId", n_pad),
+        mate_start=_int_column(table, "mateAlignmentStart", n_pad),
+        read_group=_int_column(table, "recordGroupId", n_pad),
+        valid=np.arange(n_pad) < n,
+        row_index=np.where(np.arange(n_pad) < n,
+                           np.arange(n_pad), -1).astype(np.int32),
+        read_len=read_len, bases=bases, quals=quals,
+        cigar_ops=ops, cigar_lens=lens_c, n_cigar=n_ops)

@@ -91,6 +91,48 @@ def path_bytes(path: Optional[str]) -> int:
         return 0
 
 
+def dataset_bytes(path: Optional[str], columns=None) -> int:
+    """On-disk bytes of a Parquet file/dataset, restricted to a
+    projected column subset when ``columns`` is given.
+
+    The honest-accounting fix behind the fused transform's gauge: a
+    re-streaming pass that PROJECTS a column subset reads only those
+    columns' pages off disk (Parquet pushdown), so charging it the full
+    dataset size (the pre-fusion ``reread()`` behavior) overstated the
+    re-read side of ``io_spill_amplification``.  Per-column compressed
+    sizes come from the part footers (column-chunk
+    ``total_compressed_size``; nested paths attribute to their root
+    column) — still ``os.stat``-reconcilable: summing every column of
+    every part is the file size minus footer overhead.  ``columns is
+    None`` keeps the whole-file stat path.  Telemetry-grade: any footer
+    trouble degrades to the full-size count, never an exception."""
+    if not path:
+        return 0
+    if columns is None:
+        return path_bytes(path)
+    want = {c.split(".", 1)[0] for c in columns}
+    try:
+        import pyarrow.parquet as pq
+
+        if os.path.isdir(path):
+            parts = [os.path.join(path, f) for f in os.listdir(path)
+                     if f.endswith(".parquet")]
+        else:
+            parts = [path]
+        total = 0
+        for part in parts:
+            md = pq.ParquetFile(part).metadata
+            for rg in range(md.num_row_groups):
+                g = md.row_group(rg)
+                for ci in range(g.num_columns):
+                    col = g.column(ci)
+                    if col.path_in_schema.split(".", 1)[0] in want:
+                        total += col.total_compressed_size
+        return int(total)
+    except Exception:  # noqa: BLE001 — telemetry-grade, never fatal
+        return path_bytes(path)
+
+
 def record(kind: str, nbytes: int, pass_name: Optional[str] = None) -> None:
     """Count ``nbytes`` of ``kind`` I/O against ``pass_name`` (or the
     active :func:`pass_scope`).  No pass in scope and none given →

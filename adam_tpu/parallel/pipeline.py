@@ -500,6 +500,210 @@ class _MarkdupKeys:
 _REALIGN_HALO = 3000 + 1024
 
 
+# ---------------------------------------------------------------------------
+# fused single-stream transform: decode once, collapse the re-streams
+# ---------------------------------------------------------------------------
+
+#: escape hatch: ADAM_TPU_FUSE=0/off forces the legacy 4-pass transform,
+#: =1 forces fusion (the -no_fuse transform flag mirrors the former)
+FUSE_ENV = "ADAM_TPU_FUSE"
+
+#: global-row join column the fused binned streams carry through the bin
+#: spill (dup bits + MD events re-join by it in s2/p4); stripped before
+#: any row reaches realign/sort/output
+RIDX_COL = "__ridx"
+
+
+def resolve_fuse_opt(fuse=None):
+    """Caller's explicit choice wins; ``ADAM_TPU_FUSE`` fills None (the
+    executor's flag/env convention)."""
+    if fuse is None and os.environ.get(FUSE_ENV):
+        fuse = os.environ[FUSE_ENV] not in ("0", "off")
+    return fuse
+
+
+def decide_fusion_plan(*, markdup: bool, bqsr: bool, realign: bool,
+                       sort: bool, is_parquet: bool,
+                       coalesced: bool = False,
+                       fuse: Optional[bool] = None) -> dict:
+    """The transform's frozen dataflow plan: fused streams vs the legacy
+    4-pass chain, per flag combination.
+
+    PURE — a deterministic function of the keyword inputs, recorded in
+    full (``inputs`` + ``input_digest``) by the ``fusion_plan_selected``
+    event so tools/check_executor.py can replay the decision offline
+    (the ``decide_plan`` convention).  The stream structure it encodes:
+
+    * binned (sort/realign on): stream 1 decodes the input ONCE and
+      routes rows straight to the genome bins (+realign halos) — no raw
+      spill at all; with BQSR, stream 2 walks the own-bins with a
+      column projection to accumulate the RecalTable; pass 4 applies
+      dup bits + the deferred LUT qual rewrite at bin load, then
+      realigns/sorts/emits.  Only the two genuine barriers (markdup
+      decision, RecalTable finalize) materialize state.
+    * unbinned: stream 1 spills in the ReadBatch wire format
+      (io/wirespill — base/qual planes, not raw rows), stream 2 (BQSR
+      only) re-reads a projected plane subset for the count, and the
+      emit stream applies dup bits + the LUT at output emit.  With no
+      stage enabled at all, stream 1 writes the output directly (zero
+      spill).
+    """
+    inputs = dict(markdup=bool(markdup), bqsr=bool(bqsr),
+                  realign=bool(realign), sort=bool(sort),
+                  is_parquet=bool(is_parquet), coalesced=bool(coalesced),
+                  fuse=None if fuse is None else bool(fuse))
+    import hashlib
+    import json
+
+    reasons = []
+    fused = True if inputs["fuse"] is None else inputs["fuse"]
+    if not fused:
+        reasons.append("fuse-off")
+    binned = bool(sort or realign)
+    # direct emit needs total_rows to be un-needed up front: an explicit
+    # -coalesce sizes output parts from the total, so it keeps the
+    # spill + emit-stream shape even with no stages enabled
+    direct_emit = fused and not binned and not markdup and not bqsr \
+        and not coalesced
+    # the wire spill only exists when a later stream re-reads it; a
+    # Parquet input needs no spill (streams re-read the input itself)
+    wire_spill = fused and not binned and not is_parquet and \
+        not direct_emit
+    if direct_emit:
+        reasons.append("passthrough")
+    if fused:
+        streams = ["s1"] + (["s2"] if bqsr else []) + \
+            (["p4"] if binned else ([] if direct_emit else ["s3"]))
+    else:
+        streams = ["p1"] + (["p2"] if bqsr else []) + ["p3"] + \
+            (["p4"] if binned else [])
+    plan = dict(
+        mode="fused" if fused else "legacy",
+        binned=binned,
+        route_in_s1=fused and binned,
+        # __ridx joins dup bits (markdup) and the hoisted MD events
+        # (bqsr) back to bin rows after the s1 scatter
+        carry_ridx=fused and binned and (markdup or bqsr),
+        count_pass=("s2" if fused else "p2") if bqsr else None,
+        apply_at=(("p4" if binned else "s3") if fused else "p3")
+        if bqsr else None,
+        wire_spill=wire_spill,
+        direct_emit=direct_emit,
+        streams=streams,
+        reason=";".join(reasons) or "default",
+        inputs=inputs)
+    plan["input_digest"] = hashlib.sha256(
+        json.dumps(inputs, sort_keys=True).encode()).hexdigest()[:16]
+    return plan
+
+
+def emit_fusion_plan(plan: dict) -> None:
+    """One ``fusion_plan_selected`` event + counter per transform run —
+    the pass-boundary discipline of ``StreamExecutor.begin_pass``."""
+    obs.registry().counter("fusion_plans").inc()
+    obs.emit("fusion_plan_selected", mode=plan["mode"],
+             streams=list(plan["streams"]),
+             route_in_s1=plan["route_in_s1"],
+             carry_ridx=plan["carry_ridx"],
+             count_pass=plan["count_pass"], apply_at=plan["apply_at"],
+             wire_spill=plan["wire_spill"],
+             direct_emit=plan["direct_emit"], reason=plan["reason"],
+             inputs=plan["inputs"], input_digest=plan["input_digest"])
+
+
+class _MdEventStore:
+    """Stream-1 accumulator for the BQSR mismatch evidence: per-read MD
+    presence plus the ~1-per-read MD mismatch events, keyed by GLOBAL
+    row index.
+
+    The legacy count pass re-reads and re-parses every read's
+    ``mismatchingPositions`` string (the largest column of the raw
+    spill on typical inputs); the fused transform parses it exactly
+    once while the bytes are already decoded in stream 1, holds the
+    compact event form (~a few bytes/read — the markdup-keys RSS
+    envelope), and stream 2's projection drops the MD column from its
+    re-read entirely.  ``md_info_for`` re-joins the events to any row
+    subset (a bin chunk's ``__ridx`` gather, or a sequential re-stream's
+    offset range) in the exact shape ``count_tables_device(md_info=)``
+    consumes.
+    """
+
+    def __init__(self):
+        self._has, self._rows, self._pos = [], [], []
+        self._base = 0
+        self.has_md = None
+        self.ev_rows = None
+        self.ev_pos = None
+
+    def add_chunk(self, table: pa.Table) -> None:
+        """Strict chunk order (stream 1's reader), so local rows offset
+        by the running base are globally sorted."""
+        from ..bqsr.recalibrate import md_events_for
+
+        starts = column_int64(table, "start", -1)
+        has_md, rows, pos = md_events_for(table, starts)
+        self._has.append(has_md)
+        self._rows.append(rows + self._base)
+        self._pos.append(pos)
+        self._base += table.num_rows
+
+    def freeze(self) -> None:
+        self.has_md = np.concatenate(self._has) if self._has \
+            else np.zeros(0, bool)
+        self.ev_rows = np.concatenate(self._rows) if self._rows \
+            else np.zeros(0, np.int64)
+        self.ev_pos = np.concatenate(self._pos) if self._pos \
+            else np.zeros(0, np.int64)
+        self._has = self._rows = self._pos = None
+
+    def save(self, ck: "_StreamCheckpoint") -> None:
+        ck.save_arrays("mdinfo", has_md=self.has_md,
+                       ev_rows=self.ev_rows, ev_pos=self.ev_pos)
+
+    @classmethod
+    def load(cls, ck: "_StreamCheckpoint") -> "_MdEventStore":
+        z = ck.load_arrays("mdinfo")
+        st = cls()
+        st.has_md = z["has_md"]
+        st.ev_rows = z["ev_rows"]
+        st.ev_pos = z["ev_pos"]
+        return st
+
+    def md_info_for(self, ridx: np.ndarray):
+        """(has_md, local_rows, positions) for the chunk whose rows map
+        to global rows ``ridx`` — a two-searchsorted range expand, no
+        per-row Python."""
+        has = self.has_md[ridx] if len(self.has_md) else \
+            np.zeros(len(ridx), bool)
+        lo = np.searchsorted(self.ev_rows, ridx, side="left")
+        hi = np.searchsorted(self.ev_rows, ridx, side="right")
+        cnt = hi - lo
+        tot = int(cnt.sum())
+        first = np.cumsum(cnt) - cnt
+        idx = np.repeat(lo - first, cnt) + np.arange(tot)
+        local = np.repeat(np.arange(len(ridx), dtype=np.int64), cnt)
+        return has, local, self.ev_pos[idx]
+
+
+def _estimate_input_rows(path: str, chunk_rows: int) -> int:
+    """Row-count estimate for the fused default bin count: exact from
+    Parquet footers, else input bytes over a nominal compressed
+    bytes/read.  Output VALUES are bin-count-invariant (the halo makes
+    realignment edge-independent, pinned by TestBinEdgeAndSkew), so an
+    estimate only shifts scheduling granularity."""
+    try:
+        if not (path.endswith(".sam") or path.endswith(".bam")):
+            import pyarrow.parquet as pq
+            if os.path.isdir(path):
+                return sum(
+                    pq.ParquetFile(os.path.join(path, f)).metadata.num_rows
+                    for f in os.listdir(path) if f.endswith(".parquet"))
+            return pq.ParquetFile(path).metadata.num_rows
+        return max(os.stat(path).st_size // 256, 1)
+    except (OSError, ValueError):
+        return max(int(chunk_rows), 1)
+
+
 def _packed_chunks(chunk_iter, pex, io_threads: int,
                    pack_reads, bucket_len: int, timed_chunks,
                    want_pack: bool = True):
@@ -623,7 +827,8 @@ def streaming_transform(input_path: str, output_path: str, *,
                         io_threads: int = 1,
                         io_procs: int = 1,
                         executor_opts: Optional[dict] = None,
-                        realign_opts: Optional[dict] = None) -> int:
+                        realign_opts: Optional[dict] = None,
+                        fuse: Optional[bool] = None) -> int:
     """The ``transform`` pipeline over a chunked stream and a device mesh.
 
     Multi-pass, like the reference's shuffle stages (Transform.scala:62-97):
@@ -691,8 +896,7 @@ def streaming_transform(input_path: str, output_path: str, *,
     ``executor_opts`` forwards StreamExecutor knobs (prefetch_depth,
     ladder_base, autotune, donate).
     """
-    from ..bqsr.recalibrate import apply_table, compute_table
-    from ..bqsr.table import RecalTable
+    from ..bqsr.recalibrate import apply_table
     from ..instrument import stage
     from ..io.parquet import DatasetWriter, iter_tables
     from ..io.stream import open_read_stream
@@ -707,51 +911,33 @@ def streaming_transform(input_path: str, output_path: str, *,
     wopts = dict(compression=compression, page_size=page_size,
                  use_dictionary=use_dictionary)
 
-    def timed_chunks(it, name, count=True):
-        """Attribute the iterator's own work (format decode / parquet scan)
-        to a named stage, chunk by chunk; each chunk also lands in the
-        metrics plane (chunk_rows/bytes_in + a JSONL chunk event) unless
-        ``count=False``.  The pipelined paths yield (table, packed)
-        pairs, the sync paths bare tables — account the table either
-        way."""
-        it = iter(it)
-        while True:
-            with stage(name):
-                try:
-                    item = next(it)
-                except StopIteration:
-                    return
-            if count:
-                table = item[0] if isinstance(item, tuple) else item
-                obs.chunk_processed(name, table.num_rows,
-                                    bytes_in=table.nbytes)
-            yield item
-
-    def waited(it, name):
-        """Stage-only stall attribution for the consumer side of the
-        device feed (``<pass>-feed-wait``): times the wait, records NO
-        chunk event — the staged producer already counted each chunk
-        once on its own thread."""
-        return timed_chunks(it, name, count=False)
+    timed_chunks = _timed_chunks
+    waited = _feed_wait
 
     import time as _time
     t_start = _time.perf_counter()
     if mesh is None:
         mesh = make_mesh()
-    # shape buckets / device feed / autotuner for every pass's chunk
-    # cycle — replaces the per-pass pad_bucket closures (whose power-of-
-    # two buckets each pass re-derived independently)
-    from .executor import StreamExecutor
-    ex = StreamExecutor(mesh, chunk_rows, **(executor_opts or {}))
+    is_parquet = not (input_path.endswith(".sam") or
+                      input_path.endswith(".bam"))
+    # one frozen dataflow decision per run (pure + replayable +
+    # event-recorded, the executor convention): fused streams decode the
+    # bytes once and collapse the p2/p3 re-streams; the -no_fuse flag /
+    # ADAM_TPU_FUSE env pins the legacy 4-pass chain
+    fplan = decide_fusion_plan(markdup=markdup, bqsr=bqsr,
+                               realign=realign, sort=sort,
+                               is_parquet=is_parquet,
+                               coalesced=coalesce is not None,
+                               fuse=resolve_fuse_opt(fuse))
+    emit_fusion_plan(fplan)
+    # workdir + pass-level checkpoint: built ONCE for both dataflows —
+    # the fingerprint carries the fusion mode, so a fused workdir
+    # refuses a legacy resume (and vice versa: the two layouts spill
+    # different artifacts under the same paths)
     own_workdir = workdir is None
     if own_workdir:
         workdir = tempfile.mkdtemp(prefix="adam_tpu_transform_")
     os.makedirs(workdir, exist_ok=True)
-
-    is_parquet = not (input_path.endswith(".sam") or
-                      input_path.endswith(".bam"))
-    raw_path = input_path if is_parquet else os.path.join(workdir, "raw")
-
     ck = None
     if resume:
         if own_workdir:
@@ -761,11 +947,30 @@ def streaming_transform(input_path: str, output_path: str, *,
         fp = _StreamCheckpoint.fingerprint(input_path, output_path, dict(
             markdup=markdup, bqsr=bqsr, realign=realign, sort=sort,
             chunk_rows=chunk_rows, n_bins=n_bins, coalesce=coalesce,
-            max_bin_rows=max_bin_rows, snp=_snp_digest(snp_table)))
+            max_bin_rows=max_bin_rows, snp=_snp_digest(snp_table),
+            fuse=fplan["mode"]))
         ck = _StreamCheckpoint(workdir, fp)
         if ck.has("done") and os.path.isdir(output_path) and any(
                 f.endswith(".parquet") for f in os.listdir(output_path)):
             return ck.meta("done")["total_rows"]
+    if fplan["mode"] == "fused":
+        return _fused_transform(
+            input_path, output_path, plan=fplan, markdup=markdup,
+            bqsr=bqsr, snp_table=snp_table, realign=realign, sort=sort,
+            workdir=workdir, own_workdir=own_workdir, ck=ck, mesh=mesh,
+            chunk_rows=chunk_rows,
+            n_bins=n_bins, coalesce=coalesce, max_bin_rows=max_bin_rows,
+            wopts=wopts, row_group_bytes=row_group_bytes,
+            io_threads=io_threads, io_procs=io_procs,
+            executor_opts=executor_opts, realign_opts=realign_opts,
+            t_start=t_start)
+    # shape buckets / device feed / autotuner for every pass's chunk
+    # cycle — replaces the per-pass pad_bucket closures (whose power-of-
+    # two buckets each pass re-derived independently)
+    from .executor import StreamExecutor
+    ex = StreamExecutor(mesh, chunk_rows, **(executor_opts or {}))
+
+    raw_path = input_path if is_parquet else os.path.join(workdir, "raw")
 
     try:
         # ---- pass 1: ingest ------------------------------------------------
@@ -905,19 +1110,25 @@ def streaming_transform(input_path: str, output_path: str, *,
                         seq_records=[[r.id, r.name, r.length, r.url]
                                      for r in seq_dict])
 
-        def reread(rows=chunk_rows, io_pass=None):
+        def reread(rows=chunk_rows, io_pass=None, columns=None):
             # a re-streamed pass may use its own (autotuned) chunk size:
             # dup-bit offsets track rows, and every per-chunk consumer is
             # an exact monoid or per-row map, so re-chunking never
             # changes results (differential-pinned).  Each re-stream
             # counts the spill's on-disk bytes as the pass's re-read I/O
             # (the ledger's "decode the bytes once" denominator): one
-            # record per invocation, from os.stat — never from the data.
+            # record per invocation, from the Parquet footers — never
+            # from the data.  A projected re-read charges only the
+            # projected columns' compressed bytes (the honest-accounting
+            # currency of the fusion gauge; ioledger.dataset_bytes).
             if io_pass is not None:
                 obs.ioledger.record(
-                    "reread", obs.ioledger.path_bytes(raw_path), io_pass)
+                    "reread",
+                    obs.ioledger.dataset_bytes(raw_path, columns),
+                    io_pass)
             offset = 0
-            for table in iter_tables(raw_path, chunk_rows=rows):
+            for table in iter_tables(raw_path, chunk_rows=rows,
+                                     columns=columns):
                 if dup is not None:
                     table = _apply_dup_bits(
                         table, dup[offset:offset + table.num_rows])
@@ -931,20 +1142,9 @@ def streaming_transform(input_path: str, output_path: str, *,
         # queue.  The RecalTable materializes once at pass end.
         rt = None
         if bqsr and ck is not None and ck.has("p2"):
-            z = ck.load_arrays("recal")
-            rt = RecalTable(
-                n_read_groups=int(z["n_read_groups"]),
-                max_read_len=int(z["max_read_len"]),
-                qual_obs=z["qual_obs"], qual_mm=z["qual_mm"],
-                cycle_obs=z["cycle_obs"], cycle_mm=z["cycle_mm"],
-                ctx_obs=z["ctx_obs"], ctx_mm=z["ctx_mm"],
-                expected_mismatch=float(z["expected_mismatch"]))
+            rt = _recal_from_ck(ck)
         elif bqsr:
-
-            from ..bqsr.recalibrate import (count_tables_device,
-                                            tables_to_recal)
             from ..platform import is_tpu_backend
-            n_rg_run = max(max_rgid + 1, 1)
             # Bounded async on accelerators: the host's decode/pack/
             # mismatch-state of chunk i+1 overlaps the device count of
             # chunk i.  The drain folds the int32 device tables into host
@@ -957,85 +1157,16 @@ def streaming_transform(input_path: str, output_path: str, *,
             pex2 = ex.begin_pass(
                 "p2", bytes_per_row=2.0 * max(bucket_len, 1) + 64.0,
                 sync_every=4 if is_tpu_backend() else 1)
-            host_acc = None
-            acc = None
-            n_counted = 0
-            p2_iter = _feed_packed(reread(pex2.chunk_rows, io_pass="p2"),
-                                   pex2, io_threads, pack_reads,
-                                   bucket_len, timed_chunks, mesh,
-                                   _P2_DEV_COLS, feed_wait=waited)
-
-            def _p2_cpu_fallback(table, batch):
-                # degraded per-chunk CPU fallback: the host bincount
-                # oracle (bqsr.recalibrate's "host" impl — exact integer
-                # counts, kept selectable as a differential oracle) with
-                # every jax op pinned to the CPU backend
-                import jax
-                from ..bqsr.recalibrate import _COUNT_IMPL_ENV
-                old = os.environ.get(_COUNT_IMPL_ENV)
-                os.environ[_COUNT_IMPL_ENV] = "host"
-                try:
-                    with jax.default_device(jax.devices("cpu")[0]):
-                        out = count_tables_device(
-                            table, batch, snp_table,
-                            n_read_groups=n_rg_run, mesh=None)
-                finally:
-                    if old is None:
-                        os.environ.pop(_COUNT_IMPL_ENV, None)
-                    else:
-                        os.environ[_COUNT_IMPL_ENV] = old
-                return tuple(np.asarray(a) for a in out)
-
-            for table, batch, dev_batch in p2_iter:
-                will_sync = (n_counted + 1) % pex2.sync_every == 0
-                with stage("p2-bqsr-count", sync=will_sync):
-                    out = pex2.dispatch(
-                        "count",
-                        lambda attempt, t=table, b=batch, d=dev_batch:
-                            count_tables_device(
-                                t, b, snp_table,
-                                n_read_groups=n_rg_run, mesh=mesh,
-                                device_batch=d if attempt == 1 else None,
-                                donate=pex2.donate and attempt == 1),
-                        fallback=lambda e, t=table, b=batch:
-                            _p2_cpu_fallback(t, b))
-                    if isinstance(out[0], np.ndarray):
-                        # a degraded chunk's host counts fold straight
-                        # into the host accumulator — never back onto a
-                        # device that just failed
-                        folded = tuple(np.asarray(a).astype(np.int64)
-                                       for a in out)
-                        host_acc = folded if host_acc is None else tuple(
-                            h + f for h, f in zip(host_acc, folded))
-                    else:
-                        acc = out if acc is None else tuple(
-                            a + b for a, b in zip(acc, out))
-                    n_counted += 1
-                    if will_sync and acc is not None:
-                        folded = tuple(np.asarray(a).astype(np.int64)
-                                       for a in acc)
-                        host_acc = folded if host_acc is None else tuple(
-                            h + f for h, f in zip(host_acc, folded))
-                        acc = None
-            if acc is not None:
-                folded = tuple(np.asarray(a).astype(np.int64) for a in acc)
-                host_acc = folded if host_acc is None else tuple(
-                    h + f for h, f in zip(host_acc, folded))
-            if host_acc is None:
-                rt = RecalTable(n_read_groups=1, max_read_len=bucket_len or 1)
-            else:
-                with stage("p2-bqsr-count", sync=True):
-                    rt = tables_to_recal(host_acc, n_rg_run,
-                                         bucket_len or 1)
+            rt = _count_stream(
+                pex2,
+                _feed_packed(reread(pex2.chunk_rows, io_pass="p2"),
+                             pex2, io_threads, pack_reads, bucket_len,
+                             timed_chunks, mesh, _P2_DEV_COLS,
+                             feed_wait=waited),
+                snp_table=snp_table, n_rg_run=max(max_rgid + 1, 1),
+                bucket_len=bucket_len, mesh=mesh)
             if ck is not None:
-                ck.save_arrays(
-                    "recal", n_read_groups=rt.n_read_groups,
-                    max_read_len=rt.max_read_len, qual_obs=rt.qual_obs,
-                    qual_mm=rt.qual_mm, cycle_obs=rt.cycle_obs,
-                    cycle_mm=rt.cycle_mm, ctx_obs=rt.ctx_obs,
-                    ctx_mm=rt.ctx_mm,
-                    expected_mismatch=rt.expected_mismatch)
-                ck.mark("p2")
+                _save_recal(ck, rt, "p2")
 
         # ---- pass 3: emit / route to bins ---------------------------------
         binned = sort or realign
@@ -1111,22 +1242,8 @@ def streaming_transform(input_path: str, output_path: str, *,
                     out.write(table)
                 continue
             with stage("p3-route"):
-                flags = column_int64(table, "flags", 0)
-                refid = column_int64(table, "referenceId")
-                start = column_int64(table, "start")
-                f_mapped = (flags & S.FLAG_UNMAPPED) == 0
-                bins = part.partition(np.where(f_mapped, refid, -1),
-                                      np.maximum(start, 0))
-                # flag-mapped reads with a null refid sort before every
-                # contig (sort_order keys by flags, not refid) -> front bin
-                bins = np.where(f_mapped & (refid < 0), 0, bins)
-                for b in np.unique(bins):
-                    rows = np.flatnonzero(bins == b)
-                    bin_writers[int(b)].write(table.take(pa.array(rows)))
-                if realign:
-                    _route_halo(table, bins, part, f_mapped & (refid >= 0),
-                                refid, start, halo_writers, workdir,
-                                bin_part_rows, wopts)
+                _route_chunk(table, part, bin_writers, halo_writers,
+                             realign, workdir, bin_part_rows, wopts)
 
         # ---- pass 4: per-bin realign/sort through the merge window --------
         if binned:
@@ -1167,8 +1284,712 @@ def streaming_transform(input_path: str, output_path: str, *,
             shutil.rmtree(raw_path, ignore_errors=True)
 
 
+def _timed_chunks(it, name, count=True):
+    """Attribute an iterator's own work (format decode / parquet scan)
+    to a named stage, chunk by chunk; each chunk also lands in the
+    metrics plane (chunk_rows/bytes_in + a JSONL chunk event) unless
+    ``count=False``.  The pipelined paths yield (table, ...) tuples,
+    the sync paths bare tables — account the table either way.  ONE
+    implementation serves the legacy and fused transforms, so a chunk-
+    accounting fix can never diverge between them."""
+    from ..instrument import stage
+
+    it = iter(it)
+    while True:
+        with stage(name):
+            try:
+                item = next(it)
+            except StopIteration:
+                return
+        if count:
+            table = item[0] if isinstance(item, tuple) else item
+            obs.chunk_processed(name, table.num_rows,
+                                bytes_in=table.nbytes)
+        yield item
+
+
+def _feed_wait(it, name):
+    """Stage-only stall attribution for the consumer side of the device
+    feed (``<pass>-feed-wait``): times the wait, records NO chunk event
+    — the staged producer already counted each chunk once on its own
+    thread."""
+    return _timed_chunks(it, name, count=False)
+
+
+def _count_stream(pex, fed_iter, *, snp_table, n_rg_run, bucket_len,
+                  mesh, md_info_fn=None):
+    """The RecalTable count loop shared by legacy pass 2 and fused
+    stream 2 (ONE implementation, like ``_timed_chunks``): bounded-async
+    device accumulation — ``sync_every`` folds the int32 device tables
+    into host int64 (exact integer monoid, so the fold cadence and the
+    chunk source can differ without changing a bit) — with the per-chunk
+    retry ladder and a host-bincount CPU fallback, materializing the
+    RecalTable once at pass end.  ``md_info_fn(table)`` supplies the
+    fused layout's hoisted MD events; None means parse MD from the
+    table (the legacy path)."""
+    import jax
+
+    from ..bqsr.recalibrate import (_COUNT_IMPL_ENV, count_tables_device,
+                                    tables_to_recal)
+    from ..bqsr.table import RecalTable
+    from ..instrument import stage
+
+    count_stage = f"{pex.pass_name}-bqsr-count"
+
+    def cpu_fallback(table, batch, md_info):
+        # degraded per-chunk CPU fallback: the host bincount oracle
+        # (bqsr.recalibrate's "host" impl — exact integer counts, kept
+        # selectable as a differential oracle) with every jax op pinned
+        # to the CPU backend
+        old = os.environ.get(_COUNT_IMPL_ENV)
+        os.environ[_COUNT_IMPL_ENV] = "host"
+        try:
+            with jax.default_device(jax.devices("cpu")[0]):
+                out = count_tables_device(
+                    table, batch, snp_table, n_read_groups=n_rg_run,
+                    mesh=None, md_info=md_info)
+        finally:
+            if old is None:
+                os.environ.pop(_COUNT_IMPL_ENV, None)
+            else:
+                os.environ[_COUNT_IMPL_ENV] = old
+        return tuple(np.asarray(a) for a in out)
+
+    def fold(into, out):
+        folded = tuple(np.asarray(a).astype(np.int64) for a in out)
+        return folded if into is None else tuple(
+            h + f for h, f in zip(into, folded))
+
+    host_acc = None
+    acc = None
+    n_counted = 0
+    for table, batch, dev_batch in fed_iter:
+        md_info = None if md_info_fn is None else md_info_fn(table)
+        will_sync = (n_counted + 1) % pex.sync_every == 0
+        with stage(count_stage, sync=will_sync):
+            out = pex.dispatch(
+                "count",
+                lambda attempt, t=table, b=batch, d=dev_batch,
+                mi=md_info:
+                    count_tables_device(
+                        t, b, snp_table, n_read_groups=n_rg_run,
+                        mesh=mesh,
+                        device_batch=d if attempt == 1 else None,
+                        donate=pex.donate and attempt == 1,
+                        md_info=mi),
+                fallback=lambda e, t=table, b=batch, mi=md_info:
+                    cpu_fallback(t, b, mi))
+            if isinstance(out[0], np.ndarray):
+                # a degraded chunk's host counts fold straight into the
+                # host accumulator — never back onto a device that just
+                # failed
+                host_acc = fold(host_acc, out)
+            else:
+                acc = out if acc is None else tuple(
+                    a + b for a, b in zip(acc, out))
+            n_counted += 1
+            if will_sync and acc is not None:
+                host_acc = fold(host_acc, acc)
+                acc = None
+    if acc is not None:
+        host_acc = fold(host_acc, acc)
+    if host_acc is None:
+        return RecalTable(n_read_groups=1, max_read_len=bucket_len or 1)
+    with stage(count_stage, sync=True):
+        return tables_to_recal(host_acc, n_rg_run, bucket_len or 1)
+
+
+def _recal_from_ck(ck) -> "RecalTable":
+    """Restore a checkpointed RecalTable (the p2/s2 marker's arrays)."""
+    from ..bqsr.table import RecalTable
+
+    z = ck.load_arrays("recal")
+    return RecalTable(
+        n_read_groups=int(z["n_read_groups"]),
+        max_read_len=int(z["max_read_len"]),
+        qual_obs=z["qual_obs"], qual_mm=z["qual_mm"],
+        cycle_obs=z["cycle_obs"], cycle_mm=z["cycle_mm"],
+        ctx_obs=z["ctx_obs"], ctx_mm=z["ctx_mm"],
+        expected_mismatch=float(z["expected_mismatch"]))
+
+
+def _save_recal(ck, rt, marker: str) -> None:
+    ck.save_arrays(
+        "recal", n_read_groups=rt.n_read_groups,
+        max_read_len=rt.max_read_len, qual_obs=rt.qual_obs,
+        qual_mm=rt.qual_mm, cycle_obs=rt.cycle_obs,
+        cycle_mm=rt.cycle_mm, ctx_obs=rt.ctx_obs, ctx_mm=rt.ctx_mm,
+        expected_mismatch=rt.expected_mismatch)
+    ck.mark(marker)
+
+
+def _prescan_seq_dict(input_path: str, chunk_rows: int):
+    """Parquet inputs carry no header: recover the sequence dictionary
+    from a PROJECTED pre-scan of the denormalized dictionary columns
+    (first-appearance order, exactly `_accumulate_seq_records` over the
+    stream — so the fused router's bins equal the legacy pass-3 bins).
+    Counted as decoded input at its projected size."""
+    from ..io.parquet import iter_tables
+    from ..models.dictionary import SequenceDictionary
+
+    cols = ["referenceId", "referenceName", "referenceLength",
+            "referenceUrl", "mateReferenceId", "mateReference",
+            "mateReferenceLength", "mateReferenceUrl"]
+    obs.ioledger.record("decoded",
+                        obs.ioledger.dataset_bytes(input_path, cols), "s1")
+    seen: dict = {}
+    for t in iter_tables(input_path, chunk_rows=chunk_rows, columns=cols):
+        _accumulate_seq_records(t, seen)
+    return SequenceDictionary(seen.values())
+
+
+def _fused_transform(input_path: str, output_path: str, *, plan: dict,
+                     markdup: bool, bqsr: bool, snp_table, realign: bool,
+                     sort: bool, workdir: str, own_workdir: bool, ck,
+                     mesh, chunk_rows: int, n_bins: Optional[int],
+                     coalesce: Optional[int], max_bin_rows: Optional[int],
+                     wopts: dict, row_group_bytes: Optional[int],
+                     io_threads: int, io_procs: int,
+                     executor_opts: Optional[dict],
+                     realign_opts: Optional[dict], t_start: float) -> int:
+    """The fused dataflow of :func:`streaming_transform` (plan mode
+    ``fused``): one decode of the input drives ALL chunk-local work, and
+    only the two genuine barriers — the markdup decision and the
+    RecalTable finalize — materialize state.
+
+      stream 1  decode each chunk ONCE: markdup key columns on device,
+                MD mismatch events parsed into the compact host store,
+                rows routed straight to genome bins (+halos, +__ridx)
+                when binned — no raw spill at all — or spilled in the
+                ReadBatch wire format (io/wirespill) when a later
+                stream must re-read them;
+      barrier   markdup decision over the compact keys;
+      stream 2  (BQSR only) accumulate the RecalTable over a PROJECTED
+                re-read — the own-bins walk (binned; readName/MD/mate
+                columns never leave disk) or the wire-plane subset of
+                the spill — joining dup bits and MD events back by
+                ``__ridx``;
+      barrier   RecalTable finalize;
+      pass 4 /  bins: dup bits + the DEFERRED LUT qual apply happen at
+      stream 3  bin load (on the realign engine's prep pool, overlapped
+                with sweeps), then realign/sort/emit exactly as legacy;
+                unbinned: one emit walk rebuilds rows from the wire
+                planes, applies dup bits + LUT, and writes the output.
+
+    Byte-identical to the legacy 4-pass chain across the whole flag
+    matrix (tests/test_fusion.py): routing reads only flags/refid/start
+    (untouched by either barrier), the count is an exact integer monoid
+    (bin order == chunk order under addition), and the LUT apply is a
+    pure per-row map (applying it per-bin instead of per-chunk cannot
+    change a byte).
+    """
+    import time as _time
+
+    from ..instrument import stage
+    from ..io.parquet import DatasetWriter
+    from ..io.stream import open_read_stream
+    from ..models.dictionary import SequenceDictionary, SequenceRecord
+    from ..packing import len_bucket, pack_reads
+    from .executor import StreamExecutor
+    from .partitioner import GenomicRegionPartitioner
+
+    import pyarrow.compute as pc
+
+    binned = plan["binned"]
+    carry_ridx = plan["carry_ridx"]
+    wire_spill = plan["wire_spill"]
+    direct_emit = plan["direct_emit"]
+    is_parquet = plan["inputs"]["is_parquet"]
+
+    ex = StreamExecutor(mesh, chunk_rows, **(executor_opts or {}))
+    raw_path = input_path if is_parquet else os.path.join(workdir, "raw")
+
+    try:
+        # ---- stream 1: decode once -----------------------------------
+        s1_skipped = ck is not None and ck.has("s1")
+        if s1_skipped:
+            m1 = ck.meta("s1")
+            total_rows = m1["total_rows"]
+            max_rgid = m1["max_rgid"]
+            bucket_len = m1["bucket_len"]
+            seq_dict = SequenceDictionary(
+                SequenceRecord(i, nm, ln or 0, u)
+                for i, nm, ln, u in m1["seq_records"])
+            dup = ck.load_array("dup") if m1["has_dup"] else None
+            mdstore = _MdEventStore.load(ck) if m1.get("has_md") else None
+            if binned:
+                n_bins = m1["n_bins"]
+                part = GenomicRegionPartitioner.from_dictionary(
+                    n_bins, seq_dict)
+                bin_part_rows = max(chunk_rows // n_bins, 1 << 14)
+                bin_writers = [
+                    _BinStub(os.path.join(workdir, f"bin-{b:05d}"), r)
+                    for b, r in enumerate(m1["bin_rows"])]
+                halo_writers = {
+                    int(b): _BinStub(
+                        os.path.join(workdir, f"halo-{int(b):05d}"), r)
+                    for b, r in m1["halo_rows"].items()}
+        else:
+            if ck is not None:
+                ck.clean_unless("s1", "bin-*", "halo-*", "raw",
+                                "dup.npy", "mdinfo.npz")
+            pex1 = ex.begin_pass("s1")
+            with obs.ioledger.pass_scope("s1"):
+                stream = open_read_stream(input_path,
+                                          chunk_rows=pex1.chunk_rows,
+                                          io_procs=io_procs)
+            keys = _MarkdupKeys(mesh) if markdup else None
+            mdstore = _MdEventStore() if bqsr else None
+            seq_seen: dict = {}
+            total_rows = 0
+            max_rgid = -1
+            bucket_len = 0
+            track_len = keys is not None or bqsr or wire_spill
+
+            from ..io.wirespill import to_wire
+
+            def grow_bucket(table):
+                nonlocal bucket_len
+                chunk_max = pc.max(pc.binary_length(
+                    table.column("sequence"))).as_py() or 1
+                bucket_len = max(bucket_len, len_bucket(chunk_max))
+                return bucket_len
+
+            def s1_work(table, blen):
+                batch = None
+                if keys is not None:
+                    padded = pex1.pad_rows(table.num_rows, blen)
+                    batch = pack_reads(table, pad_rows_to=padded,
+                                       bucket_len=blen)
+                wire = to_wire(table, blen) if wire_spill else None
+                return table, batch, wire
+
+            if binned:
+                if n_bins is None:
+                    est = _estimate_input_rows(input_path, chunk_rows)
+                    n_bins = max(int(np.ceil(est / max(chunk_rows, 1))),
+                                 mesh.size)
+                # the router needs the dictionary BEFORE the scan: the
+                # SAM/BAM header carries it; Parquet inputs pre-scan
+                # their (tiny) projected dictionary columns
+                seq_route = stream.seq_dict or (
+                    _prescan_seq_dict(input_path, chunk_rows)
+                    if is_parquet else SequenceDictionary(()))
+                part = GenomicRegionPartitioner.from_dictionary(
+                    n_bins, seq_route)
+                bin_part_rows = max(chunk_rows // n_bins, 1 << 14)
+                bin_writers = [
+                    DatasetWriter(os.path.join(workdir, f"bin-{b:05d}"),
+                                  part_rows=bin_part_rows, io_pass="s1",
+                                  **wopts)
+                    for b in range(part.num_partitions)]
+                halo_writers: dict = {}
+            raw_writer = None
+            direct_out = None
+            if wire_spill:
+                raw_writer = DatasetWriter(raw_path, part_rows=chunk_rows,
+                                           io_pass="s1", **wopts)
+            elif direct_emit and not binned:
+                if ck is not None:
+                    _purge_stale_parts(output_path)
+                direct_out = DatasetWriter(
+                    output_path, part_rows=chunk_rows,
+                    row_group_bytes=row_group_bytes, **wopts)
+
+            if io_threads > 1:
+                from .ingest import pipelined
+                s1_base = pipelined(stream, s1_work, io_threads,
+                                    prepare=grow_bucket if track_len
+                                    else None)
+                s1_iter = _timed_chunks(s1_base, "s1-ingest-wait")
+            else:
+                def s1_sync():
+                    for table in _timed_chunks(stream, "s1-decode"):
+                        if track_len:
+                            grow_bucket(table)
+                        if keys is not None or wire_spill:
+                            with stage("s1-pack"):
+                                item = s1_work(table, bucket_len)
+                        else:
+                            item = (table, None, None)
+                        yield item
+                s1_iter = s1_sync()
+            if keys is not None and pex1.prefetch_depth > 0:
+                s1_sharding = reads_sharding(mesh)
+
+                def _s1_put(item):
+                    table, batch, wire = item
+                    if batch is not None and \
+                            batch.n_reads % mesh.size == 0:
+                        proj = _project_batch(batch, _P1_DEV_COLS)
+                        batch = pex1.dispatch_put(
+                            "batch",
+                            lambda attempt: proj.device_put(s1_sharding))
+                    return table, batch, wire
+                s1_iter = _feed_wait(pex1.feed(s1_iter, _s1_put),
+                                     "s1-feed-wait")
+
+            ridx_base = 0
+            for table, batch, wire in s1_iter:
+                n = table.num_rows
+                max_rgid = max(max_rgid,
+                               int(column_int64(table, "recordGroupId")
+                                   .max(initial=-1)))
+                _accumulate_seq_records(table, seq_seen)
+                if mdstore is not None:
+                    # the one MD parse of the run (stream 2 joins the
+                    # events back by global row; its projection drops
+                    # the MD column from the re-read entirely)
+                    with stage("s1-md-events"):
+                        mdstore.add_chunk(table)
+                if keys is not None:
+                    with stage("s1-markdup-keys", sync=True):
+                        keys.add_chunk(
+                            table, batch, pex=pex1,
+                            repack=lambda t=table: pack_reads(
+                                t, pad_rows_to=pex1.pad_rows(
+                                    t.num_rows, bucket_len),
+                                bucket_len=bucket_len))
+                if binned:
+                    routed = table
+                    if carry_ridx:
+                        routed = table.append_column(
+                            RIDX_COL, pa.array(np.arange(
+                                ridx_base, ridx_base + n), pa.int64()))
+                    with stage("s1-route"):
+                        _route_chunk(routed, part, bin_writers,
+                                     halo_writers, realign, workdir,
+                                     bin_part_rows, wopts, io_pass="s1")
+                elif raw_writer is not None:
+                    with stage("s1-spill"):
+                        raw_writer.write(wire)
+                elif direct_out is not None:
+                    with stage("s1-write"):
+                        direct_out.write(table)
+                total_rows += n
+                ridx_base += n
+            if raw_writer is not None:
+                raw_writer.close()
+            if direct_out is not None:
+                direct_out.close()
+            if binned:
+                for w in bin_writers:
+                    w.close()
+                for w in halo_writers.values():
+                    w.close()
+            seq_dict = stream.seq_dict or \
+                SequenceDictionary(seq_seen.values())
+            with stage("markdup-decide"):
+                dup = keys.decide() if keys is not None else None
+            if mdstore is not None:
+                mdstore.freeze()
+            # direct-emit runs never mark s1: their output IS the final
+            # output, so the only honest resume points are "nothing"
+            # (re-run the idempotent passthrough) and "done" — an s1
+            # marker would let a crash between mark and done resume
+            # into an emit-less run
+            if ck is not None and not direct_emit:
+                if dup is not None:
+                    ck.save_array("dup", dup)
+                if mdstore is not None:
+                    mdstore.save(ck)
+                meta = dict(total_rows=total_rows, max_rgid=max_rgid,
+                            bucket_len=bucket_len,
+                            has_dup=dup is not None,
+                            has_md=mdstore is not None,
+                            seq_records=[[r.id, r.name, r.length, r.url]
+                                         for r in seq_dict])
+                if binned:
+                    meta.update(
+                        n_bins=n_bins,
+                        bin_rows=[w.rows_written for w in bin_writers],
+                        halo_rows={str(b): w.rows_written
+                                   for b, w in halo_writers.items()})
+                ck.mark("s1", **meta)
+
+        # ---- stream 2: RecalTable over a projected re-read -----------
+        rt = None
+        if bqsr and ck is not None and ck.has("s2"):
+            rt = _recal_from_ck(ck)
+        elif bqsr:
+            rt = _fused_count_pass(
+                ex=ex, workdir=workdir, raw_path=raw_path, plan=plan,
+                mesh=mesh, snp_table=snp_table, dup=dup, mdstore=mdstore,
+                bin_writers=bin_writers if binned else None,
+                max_rgid=max_rgid, bucket_len=bucket_len,
+                io_threads=io_threads)
+            if ck is not None:
+                _save_recal(ck, rt, "s2")
+
+        # ---- emit: pass 4 (binned) / stream 3 (unbinned) -------------
+        out_part_rows = chunk_rows if coalesce is None else \
+            max(1, -(-total_rows // max(coalesce, 1)))
+        if direct_emit and not binned:
+            pass                      # stream 1 already wrote the output
+        elif binned:
+            if ck is not None and os.path.isdir(output_path):
+                _purge_stale_parts(output_path)
+            out = DatasetWriter(output_path, part_rows=out_part_rows,
+                                row_group_bytes=row_group_bytes, **wopts)
+            budget = max_bin_rows if max_bin_rows is not None \
+                else 4 * chunk_rows
+            prepare = _fused_bin_prepare(
+                dup, rt, mesh, bucket_len, ex.retry_policy) \
+                if (carry_ridx or rt is not None) else None
+            with stage("p4-bins", sync=True):
+                _emit_bins(out, bin_writers,
+                           halo_writers if realign else {}, part,
+                           chunk_rows, budget, realign, sort, wopts,
+                           realign_opts=realign_opts,
+                           retry_policy=ex.retry_policy,
+                           prepare=prepare)
+            out.close()
+        else:
+            if ck is not None and os.path.isdir(output_path):
+                _purge_stale_parts(output_path)
+            _fused_emit_stream(
+                ex=ex, raw_path=raw_path, output_path=output_path,
+                plan=plan, mesh=mesh, dup=dup, rt=rt,
+                bucket_len=bucket_len, out_part_rows=out_part_rows,
+                row_group_bytes=row_group_bytes, wopts=wopts,
+                io_threads=io_threads)
+        if ck is not None:
+            ck.mark("done", total_rows=total_rows)
+        ex.finish()
+        obs.run_totals("transform", total_rows,
+                       _time.perf_counter() - t_start,
+                       input_path=input_path, output_path=output_path)
+        obs.ioledger.emit_events()
+        return total_rows
+    finally:
+        if own_workdir:
+            shutil.rmtree(workdir, ignore_errors=True)
+        elif plan["wire_spill"] and ck is None:
+            shutil.rmtree(raw_path, ignore_errors=True)
+
+
+def _fused_count_pass(*, ex, workdir, raw_path, plan, mesh, snp_table,
+                      dup, mdstore, bin_writers, max_rgid, bucket_len,
+                      io_threads):
+    """Stream 2: the BQSR RecalTable over the fused layout's ONE
+    projected re-read — own-bins in genome order (binned; the count is
+    an exact integer monoid, so bin order equals chunk order) or the
+    wire spill / Parquet input (unbinned).  Dup bits and the stream-1
+    MD events re-join by global row index; the projection never reads
+    readName / MD / mate columns off disk.  The count loop itself is
+    ``_count_stream`` — the same machinery legacy pass 2 runs."""
+    from ..io.parquet import iter_tables
+    from ..io.wirespill import WIRE_COLUMNS, pack_reads_wire
+    from ..packing import pack_reads
+    from ..platform import is_tpu_backend
+
+    binned = plan["binned"]
+    wire = plan["wire_spill"]
+    pex2 = ex.begin_pass(
+        "s2", bytes_per_row=2.0 * max(bucket_len, 1) + 64.0,
+        sync_every=4 if is_tpu_backend() else 1)
+    scalar_cols = ["flags", "start", "recordGroupId", "cigar"]
+    if snp_table is not None:
+        scalar_cols.append("referenceName")
+    if wire:
+        s2_cols = scalar_cols + list(WIRE_COLUMNS)
+    else:
+        s2_cols = scalar_cols + ["sequence", "qual"]
+    if binned:
+        s2_cols = s2_cols + [RIDX_COL]
+
+    def s2_chunks():
+        if binned:
+            for b, w in enumerate(bin_writers):
+                if w.rows_written == 0:
+                    continue
+                obs.ioledger.record(
+                    "reread",
+                    obs.ioledger.dataset_bytes(w.path, s2_cols), "s2")
+                for tbl in iter_tables(w.path, columns=s2_cols,
+                                       chunk_rows=pex2.chunk_rows):
+                    if dup is not None:
+                        tbl = _apply_dup_bits(
+                            tbl, dup[column_int64(tbl, RIDX_COL)])
+                    yield tbl
+            return
+        obs.ioledger.record(
+            "reread", obs.ioledger.dataset_bytes(raw_path, s2_cols),
+            "s2")
+        offset = 0
+        for tbl in iter_tables(raw_path, columns=s2_cols,
+                               chunk_rows=pex2.chunk_rows):
+            n = tbl.num_rows
+            tbl = tbl.append_column(
+                RIDX_COL, pa.array(np.arange(offset, offset + n),
+                                   pa.int64()))
+            if dup is not None:
+                tbl = _apply_dup_bits(tbl, dup[offset:offset + n])
+            offset += n
+            yield tbl
+
+    if wire:
+        def pack_fn(table, *, pad_rows_to=1, bucket_len=0):
+            return pack_reads_wire(table, bucket_len=bucket_len,
+                                   pad_rows_to=pad_rows_to)
+    else:
+        pack_fn = pack_reads
+    return _count_stream(
+        pex2,
+        _feed_packed(s2_chunks(), pex2, io_threads, pack_fn, bucket_len,
+                     _timed_chunks, mesh, _P2_DEV_COLS,
+                     feed_wait=_feed_wait),
+        snp_table=snp_table, n_rg_run=max(max_rgid + 1, 1),
+        bucket_len=bucket_len, mesh=mesh,
+        md_info_fn=None if mdstore is None else
+        (lambda table: mdstore.md_info_for(
+            column_int64(table, RIDX_COL))))
+
+
+def _fused_emit_stream(*, ex, raw_path, output_path, plan, mesh, dup, rt,
+                       bucket_len, out_part_rows, row_group_bytes, wopts,
+                       io_threads):
+    """Stream 3 (fused, unbinned): rebuild rows from the wire spill (or
+    re-read the Parquet input), apply dup bits + the deferred LUT qual
+    rewrite, and write the output — the ONE full re-read of the fused
+    unbinned layout.  The chunk cycle runs through ``_feed_packed``
+    exactly like legacy pass 3 (pipelined ingest, prefetching device
+    feed, ladder padding), so the executor pins — feed-wait
+    attribution, inflight bound, shape ladder — hold unchanged under
+    the new pass name."""
+    import jax
+
+    from ..bqsr.recalibrate import apply_table
+    from ..instrument import stage
+    from ..io.parquet import DatasetWriter, iter_tables
+    from ..io.wirespill import from_wire
+    from ..packing import pack_reads
+
+    wire = plan["wire_spill"]
+    pex3 = ex.begin_pass(
+        "s3", bytes_per_row=2.0 * max(bucket_len, 1) + 64.0)
+    out = DatasetWriter(output_path, part_rows=out_part_rows,
+                        row_group_bytes=row_group_bytes, **wopts)
+
+    def s3_chunks():
+        # one full re-read: rows rebuild exactly from the wire planes
+        # (prefix bytes verbatim), dup bits join by stream offset
+        obs.ioledger.record(
+            "reread", obs.ioledger.dataset_bytes(raw_path), "s3")
+        offset = 0
+        for spill_tbl in iter_tables(raw_path,
+                                     chunk_rows=pex3.chunk_rows):
+            n = spill_tbl.num_rows
+            if dup is not None:
+                spill_tbl = _apply_dup_bits(spill_tbl,
+                                            dup[offset:offset + n])
+            offset += n
+            yield from_wire(spill_tbl) if wire else spill_tbl
+
+    s3_iter = _feed_packed(s3_chunks(), pex3, io_threads, pack_reads,
+                           bucket_len, _timed_chunks, mesh, _P3_DEV_COLS,
+                           want_pack=rt is not None,
+                           feed_wait=_feed_wait)
+
+    def _cpu_apply(table, batch):
+        with jax.default_device(jax.devices("cpu")[0]):
+            return apply_table(rt, table, batch, mesh=None)
+
+    for table, batch, dev_batch in s3_iter:
+        if rt is not None:
+            with stage("s3-bqsr-apply", sync=True):
+                table = pex3.dispatch(
+                    "apply",
+                    lambda attempt, t=table, b=batch, d=dev_batch:
+                        apply_table(
+                            rt, t, b, mesh=mesh,
+                            device_batch=d if attempt == 1 else None,
+                            donate=pex3.donate and attempt == 1),
+                    fallback=lambda e, t=table, b=batch:
+                        _cpu_apply(t, b))
+        with stage("s3-write"):
+            out.write(table)
+    out.close()
+
+
+def _fused_bin_prepare(dup, rt, mesh, bucket_len, retry_policy):
+    """The fused pass-4 load hook: join dup bits back by ``__ridx``,
+    strip the join column, and run the deferred BQSR LUT apply — a pure
+    per-row map, so applying it per-bin (here) instead of per-chunk
+    (legacy pass 3) is byte-identical.  Runs wherever the bin load runs
+    (the realign engine's prep pool when pass 4 is pipelined), under
+    the same retry/degrade ladder as every other device dispatch."""
+    from ..packing import pack_reads, shape_rung
+    from ..resilience.retry import dispatch_with_retry
+
+    mult = max(getattr(mesh, "size", 1) or 1, 1)
+
+    def prepare(tbl):
+        if tbl is None:
+            return None
+        if RIDX_COL in tbl.column_names:
+            if dup is not None and tbl.num_rows:
+                tbl = _apply_dup_bits(tbl,
+                                      dup[column_int64(tbl, RIDX_COL)])
+            tbl = tbl.drop_columns([RIDX_COL])
+        if rt is None or tbl.num_rows == 0:
+            return tbl
+        import jax
+
+        from ..bqsr.recalibrate import apply_table
+
+        # canonical rung padding (the realign sweep's shape discipline):
+        # arbitrary bin sizes must not mint a fresh apply shape each
+        batch = pack_reads(tbl,
+                           pad_rows_to=shape_rung(max(tbl.num_rows, 1),
+                                                  mult),
+                           bucket_len=bucket_len)
+
+        def run(attempt):
+            return apply_table(rt, tbl, batch,
+                               mesh=mesh if attempt == 1 else None)
+
+        def fallback(err):
+            with jax.default_device(jax.devices("cpu")[0]):
+                return apply_table(rt, tbl, batch, mesh=None)
+
+        with obs.trace.span("p4:apply", cat="dispatch"):
+            return dispatch_with_retry(run, site="device_dispatch",
+                                       label="p4:apply",
+                                       policy=retry_policy,
+                                       fallback=fallback)
+    return prepare
+
+
+def _route_chunk(table, part, bin_writers, halo_writers, realign, workdir,
+                 bin_part_rows, wopts, io_pass="p3"):
+    """Route one chunk's rows to their genome bins (+realign halos): the
+    GenomicRegionPartitioner scatter shared by legacy pass 3 and the
+    fused stream 1 (which routes at decode time, before dup bits — bin
+    assignment reads only flags/refid/start, none of which any earlier
+    barrier rewrites)."""
+    from .. import schema as S
+
+    flags = column_int64(table, "flags", 0)
+    refid = column_int64(table, "referenceId")
+    start = column_int64(table, "start")
+    f_mapped = (flags & S.FLAG_UNMAPPED) == 0
+    bins = part.partition(np.where(f_mapped, refid, -1),
+                          np.maximum(start, 0))
+    # flag-mapped reads with a null refid sort before every contig
+    # (sort_order keys by flags, not refid) -> front bin
+    bins = np.where(f_mapped & (refid < 0), 0, bins)
+    for b in np.unique(bins):
+        rows = np.flatnonzero(bins == b)
+        bin_writers[int(b)].write(table.take(pa.array(rows)))
+    if realign:
+        _route_halo(table, bins, part, f_mapped & (refid >= 0),
+                    refid, start, halo_writers, workdir,
+                    bin_part_rows, wopts, io_pass=io_pass)
+
+
 def _route_halo(table, bins, part, mapped_ok, refid, start, halo_writers,
-                workdir, part_rows, wopts):
+                workdir, part_rows, wopts, io_pass="p3"):
     """Duplicate reads near a bin edge into the neighbor bins' halo sets
     (the rod-bucket trick, AdamRDDFunctions.scala:175-183): any bin whose
     range a read's ±halo window touches gets a copy, so edge-straddling
@@ -1202,7 +2023,7 @@ def _route_halo(table, bins, part, mapped_ok, refid, start, halo_writers,
         if w is None:
             w = halo_writers[int(b2)] = DatasetWriter(
                 os.path.join(workdir, f"halo-{int(b2):05d}"),
-                part_rows=part_rows, io_pass="p3", **wopts)
+                part_rows=part_rows, io_pass=io_pass, **wopts)
         w.write(table.take(pa.array(sel)))
 
 
@@ -1346,13 +2167,34 @@ def _bin_unit_descs(path, halo_path, part, rows, chunk_rows, budget,
         yield load_sub, nxt
 
 
+def _wrap_load(load, prepare):
+    """Compose a unit's lazy loader with the fused prepare hook (dup
+    bits via ``__ridx`` + the deferred BQSR LUT apply): it runs where
+    the load runs — the realign engine's prep pool when pass 4 is
+    pipelined — so the rewrite overlaps sweeps exactly like the load
+    itself."""
+    if prepare is None:
+        return load
+
+    def wrapped():
+        own, halo = load()
+        return prepare(own), (None if halo is None else prepare(halo))
+    return wrapped
+
+
 def _emit_bins(out, bin_writers, halo_writers, part, chunk_rows, budget,
                realign, sort, wopts, realign_opts=None,
-               retry_policy=None):
+               retry_policy=None, prepare=None):
     """Pass 4 driver: process mapped bins in genome order, emitting sorted
     output through a merge window — realignment can move a read up to the
     halo width across a bin edge, so rows only emit once no later bin can
     produce a smaller sort key.
+
+    ``prepare`` (fused transform): a per-table rewrite applied to every
+    loaded bin/halo table (and the unmapped tail) BEFORE realign/sort —
+    the deferred dup-bit + LUT qual apply, joined by the ``__ridx``
+    column the fused stream 1 routed into the bins (stripped here, so
+    downstream stages see the exact legacy schema).
 
     With realignment on, the bins run through the pipelined engine
     (parallel/realign_exec.py): bin i+1's load+prep overlaps bin i's
@@ -1417,7 +2259,8 @@ def _emit_bins(out, bin_writers, halo_writers, part, chunk_rows, budget,
                     for k, (load, nxt) in enumerate(_bin_unit_descs(
                             w.path, halo_path, part, w.rows_written,
                             chunk_rows, budget, True, next_lo, wopts)):
-                        yield BinUnitDesc(b, (seq, k), load, nxt)
+                        yield BinUnitDesc(b, (seq, k),
+                                          _wrap_load(load, prepare), nxt)
 
             RealignEngine(plan, retry_policy=retry_policy).run(
                 units(), emit, sort)
@@ -1427,7 +2270,7 @@ def _emit_bins(out, bin_writers, halo_writers, part, chunk_rows, budget,
                 for load, nxt in _bin_unit_descs(
                         w.path, halo_path, part, w.rows_written,
                         chunk_rows, budget, realign, next_lo, wopts):
-                    own, halo = load()
+                    own, halo = _wrap_load(load, prepare)()
                     tbl = _realign_with_halo(own, halo, realign_indels) \
                         if realign else own
                     if sort:
@@ -1453,7 +2296,10 @@ def _emit_bins(out, bin_writers, halo_writers, part, chunk_rows, budget,
         obs.ioledger.record("reread", obs.ioledger.path_bytes(uw.path),
                             "p4")
         for t in iter_tables(uw.path, chunk_rows=chunk_rows):
-            out.write(t)
+            # the fused prepare applies here too: unmapped rows need
+            # their dup bits cleared/set and the (identity) LUT column
+            # rebuild exactly like the legacy pass-3 chunk walk did
+            out.write(t if prepare is None else prepare(t))
 
 
 # ---------------------------------------------------------------------------

@@ -146,6 +146,26 @@ def _executor_plan_fields(pass_name: str, is_tpu: bool,
         return {}
 
 
+def _fusion_plan_fields() -> dict:
+    """The PRODUCT transform's frozen dataflow plan (the full-pipeline
+    flag set) — stamped into the BENCH transform payload the way the
+    executor plan is, so every artifact records which stream structure
+    (fused vs legacy) the numbers belong to."""
+    try:
+        from adam_tpu.parallel.pipeline import (decide_fusion_plan,
+                                                resolve_fuse_opt)
+
+        plan = decide_fusion_plan(markdup=True, bqsr=True, realign=True,
+                                  sort=True, is_parquet=False,
+                                  fuse=resolve_fuse_opt(None))
+        return {"fusion_plan": {
+            "mode": plan["mode"], "streams": plan["streams"],
+            "reason": plan["reason"],
+            "input_digest": plan["input_digest"]}}
+    except Exception:  # noqa: BLE001 — reporting only, never the stage
+        return {}
+
+
 # -- timing discipline over the tunnel --------------------------------------
 # `jax.block_until_ready` does NOT synchronize on the axon tunnel backend
 # (measured: an 8-iter 4096^3 bf16 matmul loop "finishes" at 8x the chip's
@@ -645,8 +665,9 @@ def _stage_transform(kind: str, is_tpu: bool):
         "mfu": round(device_rate * fpr / peak_fl, 6),
         "mfu_note": "analytic flops vs peak bf16; kernels are int/"
                     "elementwise so pct_peak_hbm is the binding roofline",
-        **_executor_plan_fields("p2", is_tpu,
+        **_executor_plan_fields("s2", is_tpu,
                                 _transform_bytes_per_read(L, C)),
+        **_fusion_plan_fields(),
         **({"transform_n_runs": tr_stats["n_runs"],
             "transform_fused_device_reads_per_sec_min":
                 tr_stats["runs_min"],

@@ -11,8 +11,9 @@ Usage::
     python bench_e2e.py [--reads 2000000] [--out E2E_BENCH.json]
 
 Writes one JSON document with: synthesis stats, total wall time, reads/s,
-and the per-stage seconds from instrument.report() (p1-decode / p1-pack /
-p1-markdup-keys / markdup-decide / p2-* / p3-* / p4-bins).
+and the per-stage seconds from instrument.report() (s1-decode / s1-pack /
+s1-markdup-keys / markdup-decide / s2-* / p4-bins under the fused default;
+p1-*/p2-*/p3-* with ADAM_TPU_FUSE=0).
 
 The synthetic BAM mirrors NA12878-like shape: 100 bp reads, ~30 chunks of
 coordinate-local reads over 24 contigs, MD tags, qualities, 4 read groups,

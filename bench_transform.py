@@ -12,12 +12,25 @@ Host->device transfer of the packed columns is included (batch streaming),
 like bench.py.  Prints one JSON line per stage plus the fused pipeline.
 Not run by the driver (bench.py stays the single-line flagstat bench); run
 manually: `python bench_transform.py [n_reads]`.
+
+``--stream [n_targets]`` runs the WHOLE-PIPELINE comparison instead (the
+bench_realign.py convention): a warmed fused-vs-legacy streamed transform
+on a synthetic many-target chromosome, reporting per-pass wall clocks,
+the per-pass ``io_bytes_{decoded,spilled,reread}`` ledger breakdown, the
+``io_spill_amplification`` gauge both ways, and the frozen fusion plan —
+the ISSUE 7 acceptance gate's source numbers.  ``--artifacts DIR``
+additionally writes ``BENCH_TRANSFORM_BASELINE.json`` (legacy) and
+``BENCH_TRANSFORM.json`` (fused) for ``tools/bench_gate.py`` /
+``tools/compare_bench.py`` to diff and gate.
 """
 
 from __future__ import annotations
 
 import json
+import os
+import shutil
 import sys
+import tempfile
 import time
 
 import numpy as np
@@ -48,9 +61,157 @@ def make_batch(n, rng):
     )
 
 
+def _pass_walls() -> dict:
+    """Per-pass wall clocks from the instrument report's top-level
+    stage tree (s1-*/s2-*/s3-*/p1-*.../p4-bins groups by prefix)."""
+    from adam_tpu.instrument import report
+
+    walls: dict = {}
+    for name, node in report().root.children.items():
+        key = name.split("-", 1)[0] if "-" in name else name
+        walls[key] = round(walls.get(key, 0.0) + node.seconds, 3)
+    return walls
+
+
+def bench_stream(n_targets: int, n_bins: int = 4,
+                 artifacts_dir=None) -> None:
+    """Warmed fused-vs-legacy streamed transform (markdup + BQSR +
+    realign + sort — the full pipeline) with the per-pass I/O ledger
+    breakdown and the frozen fusion-plan stamp."""
+    from adam_tpu import obs
+    from adam_tpu.instrument import report
+    from adam_tpu.obs import ioledger
+    from adam_tpu.parallel.mesh import make_mesh
+    from adam_tpu.parallel.pipeline import (decide_fusion_plan,
+                                            resolve_fuse_opt,
+                                            streaming_transform)
+    from adam_tpu.platform import is_tpu_backend
+    from tests._synth_realign import synth_sam
+
+    workroot = tempfile.mkdtemp(prefix="bench_transform_")
+    artifacts = {}
+    try:
+        src = f"{workroot}/synth.sam"
+        with open(src, "w") as f:
+            f.write(synth_sam(n_targets, reads_per_target=12, seed=0,
+                              tail_reads=4))
+
+        # warm the XLA compile caches on a smaller cut of the same
+        # shapes (the bench_realign discipline: whichever mode ran
+        # first would otherwise eat the compiles)
+        warm_src = f"{workroot}/warm.sam"
+        with open(warm_src, "w") as f:
+            f.write(synth_sam(max(n_targets // 8, 8), reads_per_target=12,
+                              seed=0, tail_reads=4))
+        for fuse in (False, True):
+            streaming_transform(
+                warm_src, f"{workroot}/out_warm{int(fuse)}",
+                markdup=True, bqsr=True, realign=True, sort=True,
+                workdir=f"{workroot}/wk_warm{int(fuse)}",
+                mesh=make_mesh(), chunk_rows=1 << 14, n_bins=n_bins,
+                fuse=fuse)
+
+        backend = "tpu" if is_tpu_backend() else "cpu"
+        for mode, fuse in (("legacy", False), ("fused", True)):
+            obs.reset_all()
+            report().reset()
+            t0 = time.perf_counter()
+            n = streaming_transform(
+                src, f"{workroot}/out_{mode}", markdup=True, bqsr=True,
+                realign=True, sort=True, workdir=f"{workroot}/wk_{mode}",
+                mesh=make_mesh(), chunk_rows=1 << 14, n_bins=n_bins,
+                fuse=fuse)
+            wall = time.perf_counter() - t0
+            snap = ioledger.snapshot()
+            amp = ioledger.spill_amplification(snap)
+            totals = {k: sum(r.get(k, 0) for r in snap.values())
+                      for k in ("decoded", "spilled", "reread")}
+            line = {"metric": "transform_stream_wall_s", "mode": mode,
+                    "value": round(wall, 3), "n_reads": n,
+                    "n_targets": n_targets, "n_bins": n_bins,
+                    "pass_walls": _pass_walls(),
+                    "io_bytes": {p: dict(r) for p, r in
+                                 sorted(snap.items())},
+                    "io_spill_amplification":
+                        None if amp is None else round(amp, 4)}
+            print(json.dumps(line))
+            artifacts[mode] = {
+                "platform": backend,
+                "schema": "bench_transform_stream",
+                "mode": mode,
+                "n_reads": n,
+                "transform_stream_wall_s": round(wall, 3),
+                "io_spill_amplification":
+                    None if amp is None else round(amp, 4),
+                "io_bytes_decoded": totals["decoded"],
+                "io_bytes_spilled": totals["spilled"],
+                "io_bytes_reread": totals["reread"],
+            }
+
+        # each artifact records the plan ITS leg actually executed
+        # (pure + replayable); the summary line stamps the product
+        # default
+        def stamp_of(fuse):
+            plan = decide_fusion_plan(markdup=True, bqsr=True,
+                                      realign=True, sort=True,
+                                      is_parquet=False, fuse=fuse)
+            return {"mode": plan["mode"], "streams": plan["streams"],
+                    "reason": plan["reason"],
+                    "input_digest": plan["input_digest"]}
+
+        stamp = stamp_of(resolve_fuse_opt(None))
+        artifacts["fused"]["fusion_plan"] = stamp_of(True)
+        artifacts["legacy"]["fusion_plan"] = stamp_of(False)
+        al, af = artifacts["legacy"], artifacts["fused"]
+        cut = None
+        if al["io_spill_amplification"] and af["io_spill_amplification"]:
+            cut = round(100 * (1 - af["io_spill_amplification"] /
+                               al["io_spill_amplification"]), 1)
+        print(json.dumps({
+            "metric": "transform_fusion_io_cut_pct", "value": cut,
+            "target": 40.0,
+            "spill_reread_bytes_legacy":
+                al["io_bytes_spilled"] + al["io_bytes_reread"],
+            "spill_reread_bytes_fused":
+                af["io_bytes_spilled"] + af["io_bytes_reread"],
+            "fusion_plan": stamp}))
+
+        if artifacts_dir is not None:
+            for mode, name in (("legacy", "BENCH_TRANSFORM_BASELINE"),
+                               ("fused", "BENCH_TRANSFORM")):
+                path = os.path.join(artifacts_dir, f"{name}.json")
+                with open(path, "w") as f:
+                    json.dump(artifacts[mode], f, indent=1,
+                              sort_keys=True)
+                    f.write("\n")
+                print(json.dumps({"metric": "artifact", "path": path}))
+    finally:
+        shutil.rmtree(workroot, ignore_errors=True)
+
+
 def main() -> None:
     from adam_tpu.platform import honor_platform_env
     honor_platform_env()      # the axon plugin ignores bare JAX_PLATFORMS
+    if "--stream" in sys.argv:
+        # validate flags BEFORE the multi-minute runs: a missing
+        # --artifacts value (or one swallowed as n_targets) must fail
+        # here, not after both benchmark legs completed
+        rest = sys.argv[1:]
+        artifacts_dir = None
+        if "--artifacts" in rest:
+            i = rest.index("--artifacts")
+            if i + 1 >= len(rest) or rest[i + 1].startswith("--"):
+                sys.exit("bench_transform: --artifacts needs a "
+                         "directory argument")
+            artifacts_dir = rest[i + 1]
+            if not os.path.isdir(artifacts_dir):
+                sys.exit(f"bench_transform: --artifacts dir "
+                         f"{artifacts_dir!r} does not exist")
+            del rest[i:i + 2]
+        pos = [a for a in rest if not a.startswith("--")]
+        bench_stream(int(pos[0]) if pos else 400,
+                     artifacts_dir=artifacts_dir)
+        return
     import jax
     import jax.numpy as jnp
     from adam_tpu.bqsr.recalibrate import (_apply_kernel_lut,

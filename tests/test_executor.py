@@ -227,7 +227,9 @@ def test_skewed_stream_compiles_at_most_ladder_shapes(tmp_path):
 
     snap = obs.registry().snapshot()
     ladder = row_bucket_ladder(96, 8)
-    for p in ("p2", "p3"):
+    # the fused transform's count/emit streams (a Parquet-input
+    # bqsr-only run re-reads the input in s2 projected, s3 full)
+    for p in ("s2", "s3"):
         shapes = snap["counters"].get(f"executor_shapes{{pass={p}}}", 0)
         assert 1 <= shapes <= len(ladder), (p, shapes, ladder)
         h = snap["histograms"][f"pad_waste_frac{{pass={p}}}"]
@@ -275,13 +277,13 @@ def test_prefetch_enabled_is_bit_identical_and_bounded(tmp_path,
     # landed): decode/pack walls are real stages on the feeder's lane,
     # and the consumer's stall still shows up as <pass>-feed-wait
     stages = set(report().root.children)
-    assert "p2-feed-wait" in stages and "p3-feed-wait" in stages
-    assert "p2-decode" in stages and "p2-pack" in stages
+    assert "s2-feed-wait" in stages and "s3-feed-wait" in stages
+    assert "s2-decode" in stages and "s2-pack" in stages
     # feed-wait is a stage-only wrapper: chunk accounting happened
     # exactly once, producer-side, under the pass's real name
     counters = obs.registry().snapshot()["counters"]
-    assert "chunks{pass=p2-decode}" in counters
-    assert "chunks{pass=p2-feed-wait}" not in counters
+    assert "chunks{pass=s2-decode}" in counters
+    assert "chunks{pass=s2-feed-wait}" not in counters
 
 
 def test_streaming_flagstat_prefetch_matches_default(resources,
@@ -345,7 +347,7 @@ def test_cli_sidecar_validates_and_replays(resources, tmp_path):
     lines = [json.loads(ln) for ln in open(mpath) if ln.strip()]
     selected = [d for d in lines
                 if d.get("event") == "executor_bucket_selected"]
-    assert {d["pass"] for d in selected} >= {"p1", "p2", "p3"}
+    assert {d["pass"] for d in selected} >= {"s1", "s2", "s3"}
     assert any(d.get("event") == "executor_recompile" for d in lines)
 
     check_executor = _load_tool("check_executor")

@@ -1,0 +1,586 @@
+"""The fused single-stream transform must be indistinguishable — byte
+for byte — from the legacy 4-pass chain it collapses.
+
+Pins, per ISSUE 7's acceptance: the full flag-matrix identity (fused vs
+legacy, io_threads 1 and >1, hot-bin split), checkpoint/resume across
+the new stream boundaries (fingerprint carries the fusion mode),
+fault-plan chaos on the fused spill site, the pure/replayable
+``decide_fusion_plan`` + its event schema, the wire-spill codec's exact
+roundtrip, the hoisted-MD-event differential, and the honest
+projected-bytes ledger accounting the tentpole's gauge rides on.
+"""
+
+import itertools
+import json
+import os
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from adam_tpu import obs
+from adam_tpu.io.parquet import load_table, save_table
+from adam_tpu.parallel.mesh import make_mesh
+from adam_tpu.parallel.pipeline import (FUSE_ENV, RIDX_COL,
+                                        decide_fusion_plan,
+                                        resolve_fuse_opt,
+                                        streaming_transform)
+
+
+def _synth_src(tmp_path, n_targets=6, seed=5, tail_reads=5):
+    from tests._synth_realign import synth_sam
+
+    src = tmp_path / "synth.sam"
+    src.write_text(synth_sam(n_targets, reads_per_target=10, seed=seed,
+                             tail_reads=tail_reads))
+    return str(src)
+
+
+def _assert_identical(a: pa.Table, b: pa.Table, ctx=""):
+    assert a.num_rows == b.num_rows, (ctx, a.num_rows, b.num_rows)
+    assert a.column_names == b.column_names, ctx
+    for c in a.column_names:
+        assert a.column(c).to_pylist() == b.column(c).to_pylist(), \
+            (ctx, c)
+
+
+def _pair(tmp_path, src, tag, **kw):
+    """Run fused and legacy on the same input; return both tables."""
+    outs = {}
+    for mode, fuse in (("legacy", False), ("fused", True)):
+        obs.reset_all()
+        streaming_transform(src, str(tmp_path / f"o_{tag}_{mode}"),
+                            workdir=str(tmp_path / f"w_{tag}_{mode}"),
+                            mesh=make_mesh(8), fuse=fuse, **kw)
+        outs[mode] = load_table(str(tmp_path / f"o_{tag}_{mode}"))
+    return outs["fused"], outs["legacy"]
+
+
+# ---------------------------------------------------------------------------
+# the plan: pure, replayable, env-resolved
+# ---------------------------------------------------------------------------
+
+class TestDecideFusionPlan:
+    def test_deterministic_and_digest_stable(self):
+        kw = dict(markdup=True, bqsr=True, realign=True, sort=True,
+                  is_parquet=False)
+        a, b = decide_fusion_plan(**kw), decide_fusion_plan(**kw)
+        assert a == b
+        assert a["mode"] == "fused"
+        assert a["streams"] == ["s1", "s2", "p4"]
+        assert a["route_in_s1"] and a["carry_ridx"]
+        assert not a["wire_spill"]          # binned: no raw spill at all
+
+    def test_flag_combinations_collapse_correctly(self):
+        # unbinned + both stages: wire spill + projected count + emit
+        p = decide_fusion_plan(markdup=True, bqsr=True, realign=False,
+                               sort=False, is_parquet=False)
+        assert p["streams"] == ["s1", "s2", "s3"]
+        assert p["wire_spill"] and not p["route_in_s1"]
+        # parquet input never spills (streams re-read the input)
+        p = decide_fusion_plan(markdup=True, bqsr=True, realign=False,
+                               sort=False, is_parquet=True)
+        assert not p["wire_spill"]
+        # no stages at all: stream 1 writes the output directly
+        p = decide_fusion_plan(markdup=False, bqsr=False, realign=False,
+                               sort=False, is_parquet=False)
+        assert p["direct_emit"] and p["streams"] == ["s1"]
+        # ... unless -coalesce needs total_rows before the output opens:
+        # the plan keeps the spill + emit-stream shape (and says so, so
+        # the io_ledger stream-membership check stays consistent)
+        p = decide_fusion_plan(markdup=False, bqsr=False, realign=False,
+                               sort=False, is_parquet=False,
+                               coalesced=True)
+        assert not p["direct_emit"] and p["wire_spill"]
+        assert p["streams"] == ["s1", "s3"]
+        # escape hatch
+        p = decide_fusion_plan(markdup=True, bqsr=True, realign=True,
+                               sort=True, is_parquet=False, fuse=False)
+        assert p["mode"] == "legacy"
+        assert p["streams"] == ["p1", "p2", "p3", "p4"]
+        assert p["reason"] == "fuse-off"
+
+    def test_env_resolution(self, monkeypatch):
+        monkeypatch.setenv(FUSE_ENV, "0")
+        assert resolve_fuse_opt(None) is False
+        monkeypatch.setenv(FUSE_ENV, "off")
+        assert resolve_fuse_opt(None) is False
+        monkeypatch.setenv(FUSE_ENV, "1")
+        assert resolve_fuse_opt(None) is True
+        # the explicit caller choice beats the env
+        assert resolve_fuse_opt(False) is False
+        monkeypatch.delenv(FUSE_ENV)
+        assert resolve_fuse_opt(None) is None
+
+    def test_event_schema_and_replay(self, tmp_path, resources):
+        """A real fused run's sidecar validates under check_metrics and
+        replays under check_executor (a tampered decision fails)."""
+        import importlib.util
+
+        def load_tool(name):
+            spec = importlib.util.spec_from_file_location(
+                name, os.path.join(os.path.dirname(__file__), os.pardir,
+                                   "tools", f"{name}.py"))
+            mod = importlib.util.module_from_spec(spec)
+            spec.loader.exec_module(mod)
+            return mod
+
+        check_metrics = load_tool("check_metrics")
+        check_executor = load_tool("check_executor")
+        mpath = tmp_path / "m.jsonl"
+        with obs.metrics_run(str(mpath)):
+            streaming_transform(str(resources / "small.sam"),
+                                str(tmp_path / "out"), markdup=True,
+                                bqsr=True, sort=True, mesh=make_mesh(8),
+                                chunk_rows=1 << 12,
+                                workdir=str(tmp_path / "wk"))
+        assert check_metrics.validate(str(mpath)) == []
+        assert check_executor.check([str(mpath)]) == []
+        lines = [json.loads(ln) for ln in mpath.read_text().splitlines()]
+        fusion = [d for d in lines
+                  if d.get("event") == "fusion_plan_selected"]
+        assert len(fusion) == 1 and fusion[0]["mode"] == "fused"
+        # ledger passes follow the collapsed stream set
+        led = {d["pass"] for d in lines if d.get("event") == "io_ledger"}
+        assert led <= set(fusion[0]["streams"]) | {"total"}
+        # tamper: flip the recorded decision -> replay must fail
+        bad = tmp_path / "bad.jsonl"
+        out_lines = []
+        for d in lines:
+            if d.get("event") == "fusion_plan_selected":
+                d = dict(d, mode="legacy")
+            out_lines.append(json.dumps(d))
+        bad.write_text("\n".join(out_lines) + "\n")
+        assert any("non-deterministic" in e
+                   for e in check_executor.check([str(bad)]))
+
+
+# ---------------------------------------------------------------------------
+# flag-matrix byte identity
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("markdup,bqsr,realign,sort", list(
+    itertools.product([False, True], repeat=4)))
+def test_flag_matrix_identity(tmp_path, markdup, bqsr, realign, sort):
+    """Every flag combination: the fused dataflow's output equals the
+    legacy 4-pass chain value-for-value."""
+    src = _synth_src(tmp_path)
+    fused, legacy = _pair(
+        tmp_path, src, "m", markdup=markdup, bqsr=bqsr, realign=realign,
+        sort=sort, chunk_rows=64,
+        n_bins=3 if (realign or sort) else None)
+    _assert_identical(fused, legacy, (markdup, bqsr, realign, sort))
+
+
+@pytest.mark.parametrize("markdup,bqsr,realign,sort", [
+    (True, True, True, True),       # everything on (binned, s2 over bins)
+    (True, True, False, False),     # unbinned wire spill + both barriers
+    (True, False, False, True),     # markdup + sort, no count stream
+    (False, False, False, False),   # direct-emit passthrough
+])
+def test_flag_matrix_identity_io_threads(tmp_path, markdup, bqsr,
+                                         realign, sort):
+    """The pipelined-ingest variant of the matrix corners: overlap must
+    stay bit-identical through the fused streams too."""
+    src = _synth_src(tmp_path)
+    fused, legacy = _pair(
+        tmp_path, src, "t", markdup=markdup, bqsr=bqsr, realign=realign,
+        sort=sort, chunk_rows=64, io_threads=2,
+        n_bins=3 if (realign or sort) else None)
+    _assert_identical(fused, legacy, (markdup, bqsr, realign, sort, 2))
+
+
+def test_hot_bin_split_identity(tmp_path):
+    """An over-budget bin forces the quantile sub-range split under the
+    fused prepare hook (dup bits + LUT apply at sub-load)."""
+    src = _synth_src(tmp_path, n_targets=6)
+    fused, legacy = _pair(tmp_path, src, "h", markdup=True, bqsr=True,
+                          realign=True, sort=True, chunk_rows=64,
+                          n_bins=1, max_bin_rows=60)
+    _assert_identical(fused, legacy, "hot-split")
+
+
+def test_parquet_input_identity_and_no_spill(tmp_path, resources):
+    """Parquet input: the fused streams re-read the INPUT (projected in
+    s2) — no spill dataset is ever written."""
+    from adam_tpu.io.dispatch import load_reads
+
+    table, _, _ = load_reads(str(resources / "small.sam"))
+    pin = tmp_path / "pin"
+    save_table(table, str(pin), n_parts=2)
+    fused, legacy = _pair(tmp_path, str(pin), "pq", markdup=True,
+                          bqsr=True, sort=True, chunk_rows=8, n_bins=2)
+    _assert_identical(fused, legacy, "parquet")
+    assert not (tmp_path / "w_pq_fused" / "raw").exists()
+
+
+def test_fused_output_carries_no_join_column(tmp_path):
+    """__ridx is a spill-internal join key: it must never reach the
+    output (or the realign machinery's input schema)."""
+    src = _synth_src(tmp_path)
+    obs.reset_all()
+    streaming_transform(src, str(tmp_path / "out"), markdup=True,
+                        bqsr=True, realign=True, sort=True,
+                        workdir=str(tmp_path / "wk"), mesh=make_mesh(8),
+                        chunk_rows=64, n_bins=2)
+    got = load_table(str(tmp_path / "out"))
+    assert RIDX_COL not in got.column_names
+    # ... while the bin spill itself DOES carry it (the join is real)
+    import glob
+    bins = [p for p in glob.glob(str(tmp_path / "wk" / "bin-*"))
+            if load_table(p).num_rows]
+    assert bins and all(RIDX_COL in load_table(p).column_names
+                        for p in bins)
+
+
+def test_fused_ledger_beats_legacy(tmp_path):
+    """The tentpole's number: on the same full-pipeline input the fused
+    spill+reread total must undercut legacy by >= 40% (the BENCH gate's
+    in-repo twin, relative so it holds on any host)."""
+    from adam_tpu.obs import ioledger
+
+    src = _synth_src(tmp_path, n_targets=40, seed=11, tail_reads=6)
+    totals = {}
+    for mode, fuse in (("legacy", False), ("fused", True)):
+        obs.reset_all()
+        streaming_transform(src, str(tmp_path / f"out_{mode}"),
+                            markdup=True, bqsr=True, realign=True,
+                            sort=True,
+                            workdir=str(tmp_path / f"wk_{mode}"),
+                            mesh=make_mesh(8), chunk_rows=128, n_bins=4,
+                            fuse=fuse)
+        snap = ioledger.snapshot()
+        totals[mode] = sum(r["spilled"] + r["reread"]
+                           for r in snap.values())
+    assert totals["fused"] <= 0.6 * totals["legacy"], totals
+
+
+# ---------------------------------------------------------------------------
+# checkpoint/resume across the new stream boundaries
+# ---------------------------------------------------------------------------
+
+class TestFusedResume:
+    def _run(self, tmp_path, src, out, ckdir=None, fuse=True, **kw):
+        obs.reset_all()
+        return streaming_transform(
+            src, str(tmp_path / out), workdir=ckdir,
+            resume=ckdir is not None, mesh=make_mesh(8), chunk_rows=64,
+            markdup=True, bqsr=True, sort=True, realign=True, n_bins=3,
+            fuse=fuse, **kw)
+
+    def test_crash_after_s1_resumes_identical(self, tmp_path,
+                                              monkeypatch):
+        """Crash at the emit barrier: resume must skip s1 (no re-decode)
+        and finish byte-identical to an uncheckpointed run."""
+        import adam_tpu.parallel.pipeline as PL
+
+        src = _synth_src(tmp_path)
+        ck = tmp_path / "ck"
+        ck.mkdir()
+
+        def boom(*a, **k):
+            raise RuntimeError("injected p4 crash")
+        monkeypatch.setattr(PL, "_emit_bins", boom)
+        with pytest.raises(RuntimeError, match="injected p4 crash"):
+            self._run(tmp_path, src, "outc", ckdir=str(ck))
+        monkeypatch.undo()
+
+        import adam_tpu.io.stream as IOS
+        calls = []
+        orig = IOS.open_read_stream
+
+        def spy(*a, **k):
+            calls.append(a)
+            return orig(*a, **k)
+        monkeypatch.setattr(IOS, "open_read_stream", spy)
+        n = self._run(tmp_path, src, "outc", ckdir=str(ck))
+        assert not calls, "stream 1 re-ran on resume"
+        monkeypatch.undo()
+        ref = self._run(tmp_path, src, "outref")
+        assert n == ref
+        assert load_table(str(tmp_path / "outc")).equals(
+            load_table(str(tmp_path / "outref")))
+        # and the finished manifest short-circuits a rerun entirely
+        n2 = self._run(tmp_path, src, "outc", ckdir=str(ck))
+        assert n2 == n
+
+    def test_crash_in_s2_resumes_identical(self, tmp_path, monkeypatch):
+        """Crash mid-count: resume restores the s1 bin stubs + MD event
+        store from the manifest and re-counts to the same table."""
+        import adam_tpu.parallel.pipeline as PL
+
+        src = _synth_src(tmp_path)
+        ck = tmp_path / "ck2"
+        ck.mkdir()
+        orig_count = PL._fused_count_pass
+
+        def boom(**kw):
+            raise RuntimeError("injected s2 crash")
+        monkeypatch.setattr(PL, "_fused_count_pass", boom)
+        with pytest.raises(RuntimeError, match="injected s2 crash"):
+            self._run(tmp_path, src, "outs2", ckdir=str(ck))
+        monkeypatch.setattr(PL, "_fused_count_pass", orig_count)
+        n = self._run(tmp_path, src, "outs2", ckdir=str(ck))
+        ref = self._run(tmp_path, src, "outs2_ref")
+        assert n == ref
+        assert load_table(str(tmp_path / "outs2")).equals(
+            load_table(str(tmp_path / "outs2_ref")))
+
+    def test_direct_emit_resume_never_marks_s1(self, tmp_path):
+        """Direct-emit runs (no stages) write the OUTPUT during stream
+        1, so the only honest resume points are nothing and done — an
+        s1 marker would let a crash in between resume into an emit-less
+        run."""
+        src = _synth_src(tmp_path)
+        ck = tmp_path / "ckd"
+        ck.mkdir()
+        obs.reset_all()
+        n = streaming_transform(src, str(tmp_path / "outd"),
+                                workdir=str(ck), resume=True,
+                                mesh=make_mesh(8), chunk_rows=64,
+                                fuse=True)
+        manifest = json.load(open(ck / "stream_checkpoint.json"))
+        assert "s1" not in manifest["passes"]
+        assert "done" in manifest["passes"]
+        n2 = streaming_transform(src, str(tmp_path / "outd"),
+                                 workdir=str(ck), resume=True,
+                                 mesh=make_mesh(8), chunk_rows=64,
+                                 fuse=True)
+        assert n2 == n
+        ref = streaming_transform(src, str(tmp_path / "outd_ref"),
+                                  mesh=make_mesh(8), chunk_rows=64,
+                                  fuse=True)
+        assert n == ref
+        assert load_table(str(tmp_path / "outd")).equals(
+            load_table(str(tmp_path / "outd_ref")))
+
+    def test_fingerprint_includes_fusion_mode(self, tmp_path):
+        """A fused checkpoint dir must refuse a legacy resume (and vice
+        versa): the two layouts spill different artifacts."""
+        src = _synth_src(tmp_path)
+        ck = tmp_path / "ck3"
+        ck.mkdir()
+        self._run(tmp_path, src, "outa", ckdir=str(ck), fuse=True)
+        with pytest.raises(ValueError, match="different transform"):
+            self._run(tmp_path, src, "outb", ckdir=str(ck), fuse=False)
+        # the refusal left the fused state intact
+        n = self._run(tmp_path, src, "outa", ckdir=str(ck), fuse=True)
+        assert n > 0
+
+
+# ---------------------------------------------------------------------------
+# chaos on the fused spill site
+# ---------------------------------------------------------------------------
+
+class TestFusedChaos:
+    def test_torn_bin_spill_crash_then_resume_identical(self, tmp_path):
+        """A truncate fault tears an s1 bin part mid-run (the fused
+        layout's ONE spill site): the run dies typed, and a resume in
+        the same workdir rebuilds to byte-identical output (clean-or-
+        identical, the PR 5 chaos contract)."""
+        from adam_tpu.resilience import faults
+
+        src = _synth_src(tmp_path)
+        ck = tmp_path / "ckx"
+        ck.mkdir()
+        faults.install_plan({"rules": [dict(
+            site="spill_write", fault="truncate", occurrence=2,
+            frac=0.5)]})
+        try:
+            with pytest.raises(faults.InjectedTornWrite):
+                obs.reset_all()
+                streaming_transform(
+                    src, str(tmp_path / "outx"), workdir=str(ck),
+                    resume=True, mesh=make_mesh(8), chunk_rows=64,
+                    markdup=True, bqsr=True, sort=True, n_bins=2,
+                    fuse=True)
+        finally:
+            faults.clear_plan()
+        obs.reset_all()
+        n = streaming_transform(
+            src, str(tmp_path / "outx"), workdir=str(ck), resume=True,
+            mesh=make_mesh(8), chunk_rows=64, markdup=True, bqsr=True,
+            sort=True, n_bins=2, fuse=True)
+        obs.reset_all()
+        ref = streaming_transform(
+            src, str(tmp_path / "outref"), mesh=make_mesh(8),
+            chunk_rows=64, markdup=True, bqsr=True, sort=True, n_bins=2,
+            fuse=True)
+        assert n == ref
+        assert load_table(str(tmp_path / "outx")).equals(
+            load_table(str(tmp_path / "outref")))
+
+
+# ---------------------------------------------------------------------------
+# the wire-format spill codec
+# ---------------------------------------------------------------------------
+
+class TestWireSpill:
+    def _adversarial_table(self):
+        seqs = ["ACGT", None, "", "acgtn", "NRYKM", "A" * 100, "T"]
+        quals = ["IIII", None, "", "!!#%&", "~~~~~", chr(33) * 100, None]
+        n = len(seqs)
+        return pa.table({
+            "referenceName": pa.array(["c1"] * n),
+            "referenceId": pa.array([0] * n, pa.int32()),
+            "start": pa.array(list(range(n)), pa.int64()),
+            "mapq": pa.array([60] * n, pa.int32()),
+            "readName": pa.array([f"r{i}" for i in range(n)]),
+            "sequence": pa.array(seqs),
+            "mateReference": pa.array([None] * n, pa.string()),
+            "mateAlignmentStart": pa.array([None] * n, pa.int64()),
+            "cigar": pa.array(["4M", None, "*", "5M", "2M3I", "100M",
+                               "1M"]),
+            "qual": pa.array(quals),
+            "recordGroupId": pa.array([0] * n, pa.int32()),
+            "flags": pa.array([0, 4, 0, 16, 0, 0, 0], pa.uint32()),
+            "mismatchingPositions": pa.array(
+                ["4", None, None, "5", "0A4", "100", "1"]),
+            "mateReferenceId": pa.array([None] * n, pa.int32()),
+        })
+
+    def test_roundtrip_exact_through_parquet(self, tmp_path):
+        """Nulls, empty strings, IUPAC/lowercase bases, variable
+        lengths: to_wire -> Parquet -> from_wire is the identity."""
+        import pyarrow.parquet as pq
+
+        from adam_tpu.io.wirespill import from_wire, to_wire
+
+        tbl = self._adversarial_table()
+        w = to_wire(tbl, 128)
+        p = tmp_path / "w.parquet"
+        pq.write_table(w, str(p), compression="zstd")
+        back = from_wire(pq.read_table(str(p)))
+        assert back.schema.equals(tbl.schema)
+        _assert_identical(back, tbl, "wire-roundtrip")
+
+    def test_pack_reads_wire_matches_pack_reads(self):
+        """The wire fast-pack's planes are bit-identical to packing the
+        original string table."""
+        from dataclasses import fields
+
+        from adam_tpu.io.wirespill import pack_reads_wire, to_wire
+        from adam_tpu.packing import pack_reads
+
+        tbl = self._adversarial_table()
+        a = pack_reads(tbl, pad_rows_to=8, bucket_len=128)
+        b = pack_reads_wire(to_wire(tbl, 128), bucket_len=128,
+                            pad_rows_to=8)
+        for f in fields(a):
+            va, vb = getattr(a, f.name), getattr(b, f.name)
+            if va is None:
+                assert vb is None, f.name
+            else:
+                assert np.array_equal(va, vb), f.name
+
+    def test_width_guard(self):
+        from adam_tpu.io.wirespill import to_wire
+
+        with pytest.raises(ValueError, match="exceeds wire width"):
+            to_wire(self._adversarial_table(), 64)
+
+    def test_plane_cap_splits_instead_of_wrapping(self, monkeypatch):
+        """A chunk whose padded plane would cross the int32-offset cap
+        builds CHUNKED wire columns (values exact) instead of silently
+        wrapping the offsets — pinned by shrinking the cap to force the
+        split on a small table."""
+        import pyarrow.parquet as pq
+
+        import adam_tpu.io.wirespill as W
+
+        tbl = self._adversarial_table()
+        monkeypatch.setattr(W, "MAX_WIRE_PLANE_BYTES", 3 * 128)
+        w = W.to_wire(tbl, 128)
+        assert w.column(W.WIRE_SEQ).num_chunks > 1    # the split happened
+        back = W.from_wire(w.combine_chunks())
+        _assert_identical(back, tbl, "capped-wire")
+        # and the un-combined form still parquet-roundtrips exactly
+        import tempfile, os
+        d = tempfile.mkdtemp()
+        try:
+            pq.write_table(w, os.path.join(d, "w.parquet"))
+            back2 = W.from_wire(pq.read_table(os.path.join(d,
+                                                           "w.parquet")))
+            _assert_identical(back2, tbl, "capped-wire-parquet")
+        finally:
+            import shutil
+            shutil.rmtree(d, ignore_errors=True)
+        # the pair builder itself refuses an over-cap request outright
+        with pytest.raises(ValueError, match="int32-offset cap"):
+            W._wire_pair(tbl.column("sequence"), 1024)
+
+
+# ---------------------------------------------------------------------------
+# hoisted MD events + honest accounting
+# ---------------------------------------------------------------------------
+
+def test_md_info_differential(resources, monkeypatch):
+    """count_tables_device(md_info=...) == the parsed-MD path, bit for
+    bit, monolithic and through the slab walk."""
+    from adam_tpu.bqsr.recalibrate import (count_tables_device,
+                                           md_events_for)
+    from adam_tpu.io.dispatch import load_reads
+    from adam_tpu.packing import pack_reads
+
+    table, _, _ = load_reads(
+        str(resources / "small_realignment_targets.sam"))
+    batch = pack_reads(table, pad_rows_to=8)
+    ref = count_tables_device(table, batch, None, n_read_groups=2)
+    starts = np.asarray(batch.start[:table.num_rows], np.int64)
+    md = md_events_for(table, starts)
+    got = count_tables_device(table, batch, None, n_read_groups=2,
+                              md_info=md)
+    for a, b in zip(ref, got):
+        assert np.array_equal(np.asarray(a), np.asarray(b))
+    monkeypatch.setenv("ADAM_TPU_COUNT_SLAB", "8")
+    got2 = count_tables_device(table, batch, None, n_read_groups=2,
+                               md_info=md)
+    for a, b in zip(ref, got2):
+        assert np.array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_bench_gate_holds_on_committed_artifacts(tmp_path, monkeypatch):
+    """tools/bench_gate.py over the committed BENCH artifacts: the
+    >= 40% amplification cut gates green, and a regressed artifact
+    (the future-PR scenario) exits nonzero."""
+    import importlib.util
+
+    root = os.path.join(os.path.dirname(__file__), os.pardir)
+    spec = importlib.util.spec_from_file_location(
+        "bench_gate", os.path.join(root, "tools", "bench_gate.py"))
+    gate = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(gate)
+    assert gate.main([]) == 0
+    # a future PR that loses the fusion win: amp creeps back up
+    bad = json.load(open(gate.CURRENT))
+    bad["io_spill_amplification"] = \
+        json.load(open(gate.BASELINE))["io_spill_amplification"] * 0.8
+    bad_path = tmp_path / "BAD.json"
+    bad_path.write_text(json.dumps(bad))
+    monkeypatch.setattr(gate, "CURRENT", str(bad_path))
+    assert gate.main([]) == 1
+
+
+def test_dataset_bytes_projection_is_honest(tmp_path, resources):
+    """ioledger.dataset_bytes: the projected count equals the sum of
+    exactly the projected columns' column-chunk compressed sizes, and
+    the full count equals path_bytes minus footer overhead (never
+    more)."""
+    from adam_tpu.io.dispatch import load_reads
+    from adam_tpu.obs import ioledger
+
+    table, _, _ = load_reads(str(resources / "small.sam"))
+    ds = tmp_path / "ds"
+    save_table(table, str(ds), n_parts=2)
+    full = ioledger.path_bytes(str(ds))
+    all_cols = ioledger.dataset_bytes(str(ds), table.column_names)
+    assert 0 < all_cols <= full
+    proj = ioledger.dataset_bytes(str(ds), ["sequence", "qual"])
+    assert 0 < proj < all_cols
+    rest = ioledger.dataset_bytes(
+        str(ds), [c for c in table.column_names
+                  if c not in ("sequence", "qual")])
+    assert proj + rest == all_cols        # columns partition the bytes
+    # None keeps the whole-file stat path; unknown columns count zero
+    assert ioledger.dataset_bytes(str(ds)) == full
+    assert ioledger.dataset_bytes(str(ds), ["no_such_column"]) == 0

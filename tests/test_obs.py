@@ -256,7 +256,8 @@ def test_streaming_transform_pad_waste_and_totals(resources, tmp_path):
     assert snap["gauges"]["reads_per_sec{op=transform}"] > 0
     assert snap["counters"]["bytes_out{op=transform}"] > 0
     # 20 reads pack into a 24-row bucket (8-device mesh): waste recorded
-    h = snap["histograms"]["pad_waste_frac{pass=p1}"]
+    # (s1 = the fused transform's ingest stream)
+    h = snap["histograms"]["pad_waste_frac{pass=s1}"]
     assert h["count"] >= 1 and 0 <= h["max"] < 1
 
 

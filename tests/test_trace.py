@@ -181,8 +181,9 @@ def test_transform_trace_has_feeder_and_realign_lanes(tmp_path):
     by_name = {}
     for e in spans:
         by_name.setdefault(e["name"], set()).add(e["tid"])
-    assert "p2-decode" in by_name and "p2-pack" in by_name
-    assert by_name["p2-pack"] - {main_tid}, \
+    # the fused transform's count stream (s2) owns the decode/pack now
+    assert "s2-decode" in by_name and "s2-pack" in by_name
+    assert by_name["s2-pack"] - {main_tid}, \
         "pack spans should ride the feeder thread's lane"
     assert {"p4-load", "p4-prep"} <= set(by_name)
     assert by_name["p4-prep"] - {main_tid}, \
@@ -303,11 +304,12 @@ def _dir_bytes(path):
 
 
 def test_io_ledger_reconciles_with_disk(resources, tmp_path):
-    """The acceptance pin: a small transform run's ledger totals equal
-    the actual on-disk sizes — decoded == the input file, p1 spilled ==
-    the raw spill dir, p2/p3 re-read == that same dir (each re-stream
-    pays it once), p3 spilled == the genome bins, p4 re-read == the
-    non-empty bins it loaded back."""
+    """The acceptance pin (LEGACY dataflow, pinned via fuse=False): a
+    small transform run's ledger totals equal the actual on-disk sizes
+    — decoded == the input file, p1 spilled == the raw spill dir, p2/p3
+    re-read == that same dir (each re-stream pays it once), p3 spilled
+    == the genome bins, p4 re-read == the non-empty bins it loaded
+    back."""
     from adam_tpu.parallel.pipeline import streaming_transform
 
     src = str(resources / "small.sam")
@@ -315,7 +317,8 @@ def test_io_ledger_reconciles_with_disk(resources, tmp_path):
     n = streaming_transform(src, str(tmp_path / "out"), markdup=True,
                             bqsr=True, sort=True, mesh=make_mesh(8),
                             chunk_rows=1 << 12, workdir=str(wd),
-                            resume=True)      # resume keeps the spill
+                            resume=True,      # resume keeps the spill
+                            fuse=False)
     assert n == 20
     snap = ioledger.snapshot()
     assert set(snap) == {"p1", "p2", "p3", "p4"}
@@ -340,6 +343,42 @@ def test_io_ledger_reconciles_with_disk(resources, tmp_path):
     counters = obs.registry().snapshot()["counters"]
     assert counters["io_bytes_spilled{pass=p1}"] == raw
     assert counters["io_bytes_reread{pass=p4}"] == bins
+
+
+def test_io_ledger_reconciles_with_disk_fused(resources, tmp_path):
+    """The FUSED dataflow's ledger reconciliation: stream 1 decodes the
+    input once and spills ONLY the genome bins (no raw spill exists on
+    disk at all), stream 2's re-read is exactly the projected column
+    subset of those bins (the honest accounting of
+    ioledger.dataset_bytes), and pass 4 re-reads the bins in full."""
+    from adam_tpu.parallel.pipeline import streaming_transform
+
+    src = str(resources / "small.sam")
+    wd = tmp_path / "wd"
+    n = streaming_transform(src, str(tmp_path / "out"), markdup=True,
+                            bqsr=True, sort=True, mesh=make_mesh(8),
+                            chunk_rows=1 << 12, workdir=str(wd),
+                            resume=True)      # resume keeps the bins
+    assert n == 20
+    snap = ioledger.snapshot()
+    assert set(snap) == {"s1", "s2", "p4"}
+    assert not (wd / "raw").exists()          # decode-once: no raw spill
+
+    bins = sum(_dir_bytes(d) for d in wd.glob("bin-*"))
+    assert snap["s1"]["decoded"] == os.path.getsize(src)
+    assert snap["s1"]["spilled"] == bins > 0
+    assert snap["s1"]["reread"] == 0
+    s2_cols = ["flags", "start", "recordGroupId", "cigar", "sequence",
+               "qual", "__ridx"]
+    proj = sum(ioledger.dataset_bytes(str(d), s2_cols)
+               for d in wd.glob("bin-*") if _dir_bytes(d))
+    assert snap["s2"] == {"decoded": 0, "spilled": 0, "reread": proj}
+    assert 0 < proj < bins                    # the projection saves I/O
+    assert snap["p4"] == {"decoded": 0, "spilled": 0, "reread": bins}
+
+    amp = obs.registry().snapshot()["gauges"]["io_spill_amplification"]
+    expect = (bins + proj + bins) / os.path.getsize(src)
+    assert amp == pytest.approx(expect, abs=1e-3)
 
 
 def test_io_ledger_events_validate_and_flagstat_decodes_once(

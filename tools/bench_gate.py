@@ -1,0 +1,85 @@
+#!/usr/bin/env python3
+"""Gate the committed transform BENCH artifacts through compare_bench.
+
+Two checks, both running :mod:`tools.compare_bench` (the PR 6 artifact
+differ) with ``--threshold``:
+
+1. **The fusion win is pinned.**  ``BENCH_TRANSFORM_BASELINE.json``
+   (the legacy 4-pass ledger) vs ``BENCH_TRANSFORM.json`` (the fused
+   streams) on ``io_spill_amplification`` with ``--threshold=-40``: a
+   negative threshold inverts the gate into a REQUIREMENT — the fused
+   artifact must be at least 40% below the legacy baseline (ISSUE 7's
+   acceptance number), or this exits nonzero.
+
+2. **Future PRs cannot regress the fused numbers.**  When a freshly
+   generated artifact is passed (``bench_gate.py NEW.json``, produced
+   by ``python bench_transform.py --stream --artifacts DIR``), it is
+   diffed against the committed ``BENCH_TRANSFORM.json`` at the
+   standard 10% threshold over the amplification AND the wall — a
+   transform io/wall regression exits nonzero locally before it ships.
+
+Usage::
+
+    python tools/bench_gate.py            # check 1 only (committed pair)
+    python tools/bench_gate.py NEW.json   # checks 1 + 2
+
+Exit 0 when every gate holds; the first failing compare_bench exit code
+otherwise.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+import compare_bench  # noqa: E402
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BASELINE = os.path.join(ROOT, "BENCH_TRANSFORM_BASELINE.json")
+CURRENT = os.path.join(ROOT, "BENCH_TRANSFORM.json")
+
+#: the ISSUE 7 acceptance number: fused must cut the spill-I/O
+#: amplification by at least this much vs the legacy baseline
+REQUIRED_CUT_PCT = 40.0
+
+
+def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    for path in (BASELINE, CURRENT):
+        if not os.path.exists(path):
+            print(f"bench_gate: missing committed artifact {path} "
+                  "(regenerate with: python bench_transform.py --stream "
+                  "--artifacts .)", file=sys.stderr)
+            return 2
+
+    print(f"== gate 1: fused cuts io_spill_amplification >= "
+          f"{REQUIRED_CUT_PCT}% vs the legacy baseline ==")
+    rc = compare_bench.main([BASELINE, CURRENT,
+                             "--keys", "io_spill_amplification",
+                             f"--threshold=-{REQUIRED_CUT_PCT}"])
+    if rc != 0:
+        print("bench_gate: the committed fused artifact no longer cuts "
+              f"spill amplification by {REQUIRED_CUT_PCT}% — the fusion "
+              "win regressed", file=sys.stderr)
+        return rc
+
+    if argv:
+        fresh = argv[0]
+        print(f"\n== gate 2: {fresh} vs committed {CURRENT} "
+              "(10% regression threshold) ==")
+        rc = compare_bench.main([
+            CURRENT, fresh,
+            "--keys", "io_spill_amplification,transform_stream_wall_s",
+            "--threshold", "10"])
+        if rc != 0:
+            print("bench_gate: transform io/wall regressed past 10% vs "
+                  "the committed artifact", file=sys.stderr)
+            return rc
+
+    print("\nbench_gate: all gates hold")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -44,8 +44,15 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
 PLAN_FIELDS = ("chunk_rows", "ladder", "ladder_base", "prefetch_depth",
                "donate")
 
+#: the fused-transform plan fields a replay must reproduce exactly
+#: (pipeline.decide_fusion_plan; same purity contract)
+FUSION_FIELDS = ("mode", "streams", "route_in_s1", "carry_ridx",
+                 "count_pass", "apply_at", "wire_spill", "direct_emit")
 
-def _events(path: str) -> List[Tuple[int, dict]]:
+_REPLAYED = ("executor_bucket_selected", "fusion_plan_selected")
+
+
+def _events(path: str, kinds=_REPLAYED) -> List[Tuple[int, dict]]:
     out = []
     with open(path) as f:
         for i, ln in enumerate(f, 1):
@@ -55,8 +62,7 @@ def _events(path: str) -> List[Tuple[int, dict]]:
                 doc = json.loads(ln)
             except ValueError:
                 continue        # schema problems are check_metrics' job
-            if isinstance(doc, dict) and \
-                    doc.get("event") == "executor_bucket_selected":
+            if isinstance(doc, dict) and doc.get("event") in kinds:
                 out.append((i, doc))
     return out
 
@@ -65,33 +71,41 @@ def check(paths: List[str]) -> List[str]:
     """Replay every recorded decision; return human-readable violations
     (empty = deterministic)."""
     from adam_tpu.parallel.executor import decide_plan
+    from adam_tpu.parallel.pipeline import decide_fusion_plan
 
+    deciders = {"executor_bucket_selected": (decide_plan, PLAN_FIELDS),
+                "fusion_plan_selected": (decide_fusion_plan,
+                                         FUSION_FIELDS)}
     errs: List[str] = []
-    by_digest: Dict[str, Tuple[str, int, dict]] = {}
+    # digests are namespaced per event kind: the two deciders hash
+    # different input tuples and must never cross-validate
+    by_digest: Dict[Tuple[str, str], Tuple[str, int, dict]] = {}
     n_checked = 0
     for path in paths:
         events = _events(path)
         if not events:
-            errs.append(f"{path}: no executor_bucket_selected events "
+            errs.append(f"{path}: no replayable plan events "
                         "(not an executor run, or events were lost)")
             continue
         for i, ev in events:
+            kind = ev.get("event")
+            decider, fields = deciders[kind]
             inputs = ev.get("inputs")
             if not isinstance(inputs, dict):
-                errs.append(f"{path}:{i}: event carries no inputs — "
+                errs.append(f"{path}:{i}: {kind} carries no inputs — "
                             "decision cannot be replayed")
                 continue
             try:
-                plan = decide_plan(**inputs)
+                plan = decider(**inputs)
             except TypeError as e:
                 errs.append(f"{path}:{i}: inputs do not replay through "
-                            f"decide_plan: {e}")
+                            f"{decider.__name__}: {e}")
                 continue
             n_checked += 1
-            for field in PLAN_FIELDS:
+            for field in fields:
                 if ev.get(field) != plan[field]:
                     errs.append(
-                        f"{path}:{i}: non-deterministic decision — "
+                        f"{path}:{i}: non-deterministic {kind} — "
                         f"recorded {field}={ev.get(field)!r}, replay "
                         f"yields {plan[field]!r}")
             if ev.get("input_digest") != plan["input_digest"]:
@@ -100,12 +114,12 @@ def check(paths: List[str]) -> List[str]:
                     f"{ev.get('input_digest')!r}, inputs digest to "
                     f"{plan['input_digest']!r})")
             # cross-event/cross-file: one digest, one decision
-            decision = {f: ev.get(f) for f in PLAN_FIELDS}
+            decision = {f: ev.get(f) for f in fields}
             dig = ev.get("input_digest")
             if isinstance(dig, str):
-                seen = by_digest.get(dig)
+                seen = by_digest.get((kind, dig))
                 if seen is None:
-                    by_digest[dig] = (path, i, decision)
+                    by_digest[(kind, dig)] = (path, i, decision)
                 elif seen[2] != decision:
                     errs.append(
                         f"{path}:{i}: digest {dig} decided differently "

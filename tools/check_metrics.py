@@ -22,6 +22,12 @@ elastic worker sidecars).  Contract checked here:
   length bucket grows mid-pass);
 * ``executor_prefetch_stall_s`` events carry ``pass``, ``seconds``
   (>= 0) and ``inflight_peak <= depth`` (the feed's bound held);
+* ``fusion_plan_selected`` events carry ``mode`` (fused/legacy), the
+  ``streams`` list the run will execute (fused runs start at ``s1``),
+  boolean ``route_in_s1``/``carry_ridx``/``wire_spill``/
+  ``direct_emit``, ``inputs`` (object) and a hex ``input_digest``
+  (tools/check_executor.py replays the decision); ``io_ledger``
+  transform-pass rows must belong to an announced stream set;
 * ``realign_plan_selected`` events carry ``pipeline_depth`` (int >= 0),
   boolean ``donate``, ``inputs`` (object) and a hex ``input_digest``
   (the decision is pure and replayable, like the executor's);
@@ -150,6 +156,11 @@ def validate(path: str) -> List[str]:
                 err(i, f"manifest missing {field!r}")
 
     ladders: dict = {}   # pass -> announced ladder (latest wins)
+    # union of every fusion plan's announced streams: io_ledger rows for
+    # transform-shaped pass names must belong to an announced stream set
+    # (the collapsed-pass consistency the fused dataflow promises)
+    fusion_streams: set = set()
+    _TRANSFORM_PASSES = {"p1", "p2", "p3", "p4", "s1", "s2", "s3"}
     for i, d in docs:
         ev = d.get("event")
         if ev == "stage":
@@ -229,6 +240,32 @@ def validate(path: str) -> List[str]:
                     peak > depth:
                 err(i, f"executor prefetch inflight_peak {peak} exceeds "
                        f"its depth bound {depth}")
+        elif ev == "fusion_plan_selected":
+            if d.get("mode") not in ("fused", "legacy"):
+                err(i, f"fusion_plan_selected unknown mode "
+                       f"{d.get('mode')!r}")
+            streams = d.get("streams")
+            if not (isinstance(streams, list) and streams and
+                    all(isinstance(s, str) and s for s in streams)):
+                err(i, "fusion_plan_selected 'streams' is not a "
+                       "non-empty string list")
+            else:
+                if d.get("mode") == "fused" and streams[0] != "s1":
+                    err(i, "fusion_plan_selected fused mode must start "
+                           "at stream 's1'")
+                fusion_streams.update(streams)
+            for field in ("route_in_s1", "carry_ridx", "wire_spill",
+                          "direct_emit"):
+                if not isinstance(d.get(field), bool):
+                    err(i, f"fusion_plan_selected missing boolean "
+                           f"{field!r}")
+            if not isinstance(d.get("inputs"), dict):
+                err(i, "fusion_plan_selected missing 'inputs' object "
+                       "(decision must be replayable)")
+            dig = d.get("input_digest")
+            if not (isinstance(dig, str) and len(dig) >= 8 and
+                    all(c in "0123456789abcdef" for c in dig)):
+                err(i, "fusion_plan_selected missing hex 'input_digest'")
         elif ev == "realign_plan_selected":
             pd = d.get("pipeline_depth")
             if not (isinstance(pd, int) and not isinstance(pd, bool)
@@ -328,6 +365,12 @@ def validate(path: str) -> List[str]:
         elif ev == "io_ledger":
             if not isinstance(d.get("pass"), str):
                 err(i, "io_ledger missing string 'pass'")
+            elif fusion_streams and d["pass"] in _TRANSFORM_PASSES and \
+                    d["pass"] not in fusion_streams:
+                err(i, f"io_ledger pass {d['pass']!r} is not in the "
+                       "announced fusion stream set "
+                       f"{sorted(fusion_streams)} — ledger attribution "
+                       "must follow the collapsed pass structure")
             for field in ("decoded", "spilled", "reread"):
                 v = d.get(field)
                 if not (isinstance(v, int) and not isinstance(v, bool)
