@@ -380,6 +380,144 @@ def count_kernel_pallas_rows(bases, quals, read_len, flags, read_group,
                           n_cycle=n_cycle, cyc_bins=cyc_bins)
 
 
+# ---------------------------------------------------------------------------
+# ragged count: flat covariate walk, no padded-lane masking
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.jit, static_argnames=("n_rows", "n_qual_rg",
+                                             "n_cycle", "max_read_len"))
+def _pack_words_flat(bases_flat, quals_flat, row_of, pos_of, row_starts,
+                     read_len, flags, read_group, state_flat, usable,
+                     n_bases, n_rows: int, n_qual_rg: int, n_cycle: int,
+                     max_read_len: int):
+    """Ragged prologue: flat covariates -> the same packed index/weight
+    words as :func:`_pack_words`, but over ``T`` real bases instead of
+    ``N x L`` padded lanes — the per-read cycle walk is driven by true
+    lengths through the prefix-sum row index, so no padded element is
+    ever packed (slack past ``n_bases`` gets zero weights)."""
+    from .covariates import covariate_flat
+
+    cov = covariate_flat(bases_flat, quals_flat, row_of, pos_of,
+                         row_starts, read_len, flags, read_group,
+                         n_bases, n_rows=n_rows,
+                         max_read_len=max_read_len)
+    usable_b = usable[row_of]
+    counted = cov["in_window"] & usable_b & (state_flat != STATE_MASKED)
+    mm = (state_flat == STATE_MISMATCH) & counted
+    windowed = cov["in_window"] & usable_b
+    k = jnp.clip(cov["qual_rg"], 0, n_qual_rg - 1)
+    cyc = jnp.clip(cov["cycle_idx"], 0, n_cycle - 1)
+    q = jnp.clip(quals_flat.astype(jnp.int32), 0, (1 << _Q_BITS) - 1)
+
+    word = (k | (cyc << _K_BITS) | (cov["context"] << (_K_BITS + _CYC_BITS))
+            | (q << (_K_BITS + _CYC_BITS + _CTX_BITS)))
+    wbits = (counted.astype(jnp.int8) | (mm.astype(jnp.int8) << 1)
+             | (windowed.astype(jnp.int8) << 2))
+
+    n_elems = word.shape[0]
+    n_blocks = max(-(-n_elems // BLOCK_ELEMS), 1)
+    pad = n_blocks * BLOCK_ELEMS - n_elems
+
+    def blocked(a):
+        return jnp.pad(a, (0, pad)).reshape(n_blocks, 1, BLOCK_ELEMS)
+
+    return blocked(word), blocked(wbits)
+
+
+@functools.partial(jax.jit, static_argnames=("n_qual_rg", "n_cycle"))
+def _count_flat_xla(word3, wbits3, n_qual_rg: int, n_cycle: int):
+    """The ragged kernel's off-TPU form: unpack the packed words and
+    segment-sum the weights into the dense tables (``.at[].add`` — XLA's
+    segment_sum — over the fused covariate index).  Zero-weight slack
+    words contribute nothing, so the tables equal the scatter oracle's
+    exactly (integer adds, order-free)."""
+    from .covariates import N_CONTEXT
+
+    word = word3.reshape(-1)
+    wbits = wbits3.reshape(-1).astype(jnp.int32)
+    k = word & ((1 << _K_BITS) - 1)
+    cyc = (word >> _K_BITS) & ((1 << _CYC_BITS) - 1)
+    ctx = (word >> (_K_BITS + _CYC_BITS)) & ((1 << _CTX_BITS) - 1)
+    q = (word >> (_K_BITS + _CYC_BITS + _CTX_BITS)) & ((1 << _Q_BITS) - 1)
+    w = wbits & 1
+    wm = (wbits >> 1) & 1
+    ww = (wbits >> 2) & 1
+    qual_obs = jnp.zeros((n_qual_rg,), jnp.int32).at[k].add(w)
+    qual_mm = jnp.zeros((n_qual_rg,), jnp.int32).at[k].add(wm)
+    cyc_flat = k * n_cycle + cyc
+    cycle_obs = jnp.zeros((n_qual_rg * n_cycle,), jnp.int32
+                          ).at[cyc_flat].add(w)
+    cycle_mm = jnp.zeros((n_qual_rg * n_cycle,), jnp.int32
+                         ).at[cyc_flat].add(wm)
+    ctx_flat = k * N_CONTEXT + ctx
+    ctx_obs = jnp.zeros((n_qual_rg * N_CONTEXT,), jnp.int32
+                        ).at[ctx_flat].add(w)
+    ctx_mm = jnp.zeros((n_qual_rg * N_CONTEXT,), jnp.int32
+                       ).at[ctx_flat].add(wm)
+    qhist = jnp.zeros((256,), jnp.int32).at[q].add(ww)
+    return (qual_obs, qual_mm, cycle_obs, cycle_mm, ctx_obs, ctx_mm,
+            qhist)
+
+
+def count_kernel_ragged(rb, state_flat, usable, n_qual_rg: int,
+                        n_cycle: int, max_read_len: int,
+                        interpret: bool = False, int8_mxu: bool = False,
+                        impl: str = "auto"):
+    """Ragged twin of :func:`count_kernel_pallas` — same 7-tensor
+    contract, fed by a :class:`packing.RaggedBatch` (``rb``) plus the
+    flat mismatch-state plane.
+
+    Device work scales with the TRUE base count ``T``: the prologue
+    packs one word per real base (per-read cycle walk via the
+    prefix-sum row index — no padded-lane masking anywhere), and the
+    word sweep runs ``T / BLOCK_ELEMS`` grid steps instead of
+    ``N x L / BLOCK_ELEMS``.  On TPU the words feed the SAME Mosaic
+    one-hot-matmul kernel as the padded path (``impl="pallas"``);
+    off-TPU they fall back to the XLA segment-sum formulation
+    (``impl="xla"``).  Bit-identical to the padded scatter oracle either
+    way — integer monoid over the same (covariate, weight) multiset —
+    pinned by tests/test_ragged.py.
+    """
+    assert fits(n_qual_rg, n_cycle), (n_qual_rg, n_cycle)
+    word3, wbits3 = _pack_words_flat(
+        jnp.asarray(rb.bases_flat), jnp.asarray(rb.quals_flat),
+        jnp.asarray(rb.row_of), jnp.asarray(rb.pos_of),
+        jnp.asarray(rb.row_offsets[:-1]), jnp.asarray(rb.read_len),
+        jnp.asarray(rb.flags), jnp.asarray(rb.read_group),
+        jnp.asarray(state_flat), jnp.asarray(usable),
+        jnp.int32(rb.n_bases), n_rows=rb.n_reads,
+        n_qual_rg=n_qual_rg, n_cycle=n_cycle,
+        max_read_len=max_read_len)
+    if impl == "auto":
+        from ..platform import is_tpu_backend
+        impl = "pallas" if is_tpu_backend() else "xla"
+    if impl == "xla":
+        return _count_flat_xla(word3, wbits3, n_qual_rg=n_qual_rg,
+                               n_cycle=n_cycle)
+    q_rows = _round_up(n_qual_rg, 8)
+    cyc_bins = _round_up(n_cycle, 128)
+    obs, mm, qh = _count_call(word3, wbits3, q_rows=q_rows,
+                              cyc_bins=cyc_bins, interpret=interpret,
+                              int8_mxu=int8_mxu)
+    return _unpack_tables(obs, mm, qh, n_qual_rg=n_qual_rg,
+                          n_cycle=n_cycle, cyc_bins=cyc_bins)
+
+
+def flatten_state(state, read_len, t_pad: int):
+    """[N, L] mismatch-state plane -> flat [t_pad] by true lengths
+    (row-major — concatenation order), STATE_MASKED in the slack."""
+    import numpy as np
+
+    state = np.asarray(state)
+    L = state.shape[1]
+    rl = np.minimum(np.asarray(read_len, np.int64), L)
+    mask = np.arange(L, dtype=np.int64)[None, :] < rl[:, None]
+    out = np.full(t_pad, STATE_MASKED, np.int8)
+    flat = state[mask]
+    out[:len(flat)] = flat
+    return out
+
+
 def sharded_count_pallas(mesh, n_qual_rg: int, n_cycle: int,
                          variant: str = "flat", interpret: bool = False,
                          int8_mxu: bool = False):

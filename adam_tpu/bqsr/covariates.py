@@ -104,3 +104,78 @@ def covariate_tensors(bases, quals, read_len, flags, read_group):
     context = jnp.where(offs[None, :] == start[:, None], 0, context)
     return dict(in_window=in_window, qual_rg=qual_rg, cycle_idx=cycle_idx,
                 context=context, window_start=start, window_end=end)
+
+
+@partial(jax.jit, static_argnames=("n_rows", "max_read_len"))
+def covariate_flat(bases_flat, quals_flat, row_of, pos_of, row_starts,
+                   read_len, flags, read_group, n_bases, *,
+                   n_rows: int, max_read_len: int):
+    """:func:`covariate_tensors` over the RAGGED layout: concatenated
+    ``[T]`` planes + the prefix-sum row index (packing.RaggedBatch).
+
+    Same covariate definitions BIT FOR BIT — the per-read cycle walk is
+    driven by true lengths via ``row_of``/``pos_of``, so no padded-lane
+    element is ever computed or masked.  The window clip becomes two
+    segment reductions (first/last non-low-qual position per read); the
+    reverse-strand context gathers through ``row_starts`` instead of
+    ``take_along_axis``.  Slack elements past ``n_bases`` (their
+    ``row_of`` is 0) contribute reduction-neutral values and return
+    ``in_window=False``.
+
+    ``max_read_len`` is the cycle-axis offset — the padded form uses its
+    plane width ``L``, which the product packer pins to the RecalTable's
+    ``max_read_len``; here the table geometry is passed explicitly.
+    Returns flat [T] tensors: ``in_window``, ``qual_rg``, ``cycle_idx``,
+    ``context``, plus per-read ``window_start``/``window_end``.
+    """
+    T = bases_flat.shape[0]
+    live = jnp.arange(T) < n_bases
+    rlen = read_len[row_of]
+    quals = quals_flat.astype(jnp.int32)
+
+    # clip window (ReadCovariates.scala:37-39) as segment reductions:
+    # ws = first position with qual > MIN_QUALITY (read_len when none),
+    # we = last such position + 1 — identical to the padded cumprod form
+    lowq = quals <= MIN_QUALITY
+    big = jnp.int32(1 << 30)
+    ws = jnp.minimum(jax.ops.segment_min(
+        jnp.where(live & ~lowq, pos_of, big), row_of,
+        num_segments=n_rows), read_len)
+    last = jax.ops.segment_max(
+        jnp.where(live & ~lowq, pos_of, -1), row_of,
+        num_segments=n_rows)
+    we = jnp.maximum(last + 1, ws)
+    in_window = (pos_of >= ws[row_of]) & (pos_of < we[row_of]) & live
+
+    qual_rg = quals + MAX_REASONABLE_QSCORE * \
+        jnp.maximum(read_group, 0)[row_of]
+
+    reverse = (flags & S.FLAG_REVERSE) != 0
+    second = ((flags & S.FLAG_PAIRED) != 0) & \
+        ((flags & S.FLAG_SECOND_OF_PAIR) != 0)
+    rev_b = reverse[row_of]
+    cycle = jnp.where(rev_b, rlen - pos_of, pos_of + 1)
+    cycle = jnp.where(second[row_of], -cycle, cycle)
+    cycle_idx = cycle + max_read_len
+
+    b = bases_flat.astype(jnp.int32)
+    valid = (b >= 0) & (b < 4)
+    # forward context: the previous flat element IS the previous base of
+    # the same read whenever pos > 0 (reads concatenate contiguously)
+    prev = jnp.maximum(jnp.arange(T) - 1, 0)
+    fwd_ok = valid[prev] & valid & (pos_of > 0)
+    fwd = jnp.where(fwd_ok, 1 + 4 * b[prev] + b, 0)
+    # reverse (mirrored pairing — covariate_tensors' complement-swap of
+    # the forward context at p+1, gathered within the read's own span)
+    g = jnp.arange(N_CONTEXT)
+    y, x = (g - 1) // 4, (g - 1) % 4
+    compl_swap = jnp.where(g == 0, 0, 1 + 4 * (3 - x) + (3 - y))
+    ws_b, we_b = ws[row_of], we[row_of]
+    p = we_b - 1 - (pos_of - ws_b)
+    p1_in_row = jnp.clip(p + 1, 0, jnp.maximum(rlen - 1, 0))
+    fwd_at_p1 = fwd[jnp.clip(row_starts[row_of] + p1_in_row, 0, T - 1)]
+    rev = jnp.where(p + 1 < we_b, compl_swap[fwd_at_p1], 0)
+    context = jnp.where(rev_b, rev, fwd)
+    context = jnp.where(pos_of == ws_b, 0, context)
+    return dict(in_window=in_window, qual_rg=qual_rg, cycle_idx=cycle_idx,
+                context=context, window_start=ws, window_end=we)

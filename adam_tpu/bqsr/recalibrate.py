@@ -648,7 +648,8 @@ def count_tables_device(table: pa.Table,
                         mesh=None,
                         device_batch: Optional[ReadBatch] = None,
                         donate: bool = False,
-                        md_info=None):
+                        md_info=None,
+                        layout: str = "padded"):
     """Pass-1 counting for one chunk, WITHOUT the host sync: returns the 7
     count tensors (qual_obs, qual_mm, cycle_obs, cycle_mm, ctx_obs,
     ctx_mm, qhist) still on device (numpy under the "host" impl — both add
@@ -677,6 +678,9 @@ def count_tables_device(table: pa.Table,
         n_read_groups = int(np.asarray(batch.read_group).max(initial=0)) + 1
     sharded = mesh is not None and mesh.size > 1 and \
         batch.n_reads % mesh.size == 0
+    # the ragged layout is an unsharded dispatch (the plan demotes it on
+    # multi-shard meshes — executor.decide_plan's ragged_capable gate)
+    lay = layout if layout == "ragged" and not sharded else "padded"
     slab = _count_slab_rows()
     if not sharded and batch.n_reads > slab:
         acc = None
@@ -687,14 +691,15 @@ def count_tables_device(table: pa.Table,
                                     snp_table, n_read_groups, None,
                                     donate=donate,
                                     md_info=None if md_info is None
-                                    else slice_md_info(md_info, s, e))
+                                    else slice_md_info(md_info, s, e),
+                                    layout=lay)
             acc = out if acc is None else tuple(
                 a + b for a, b in zip(acc, out))
         return acc
     return _count_tables_one(table, batch, snp_table, n_read_groups,
                              mesh if sharded else None,
                              device_batch=device_batch, donate=donate,
-                             md_info=md_info)
+                             md_info=md_info, layout=lay)
 
 
 def _count_tables_one(table: pa.Table, batch: ReadBatch,
@@ -702,7 +707,7 @@ def _count_tables_one(table: pa.Table, batch: ReadBatch,
                       n_read_groups: int, mesh,
                       device_batch: Optional[ReadBatch] = None,
                       donate: bool = False,
-                      md_info=None):
+                      md_info=None, layout: str = "padded"):
     """One slab's pass-1 count (the pre-slab body of
     :func:`count_tables_device`)."""
     n = table.num_rows
@@ -724,6 +729,35 @@ def _count_tables_one(table: pa.Table, batch: ReadBatch,
     rt = RecalTable(n_read_groups=max(n_read_groups, 1),
                     max_read_len=batch.max_len)
     sharded = mesh is not None
+    if layout == "ragged" and not sharded:
+        # the ragged layout (docs/ARCHITECTURE.md §6g): flatten the
+        # padded planes by true lengths and count over T real bases —
+        # the per-read cycle walk rides the prefix-sum row index, so no
+        # padded lane (row slack OR past-length lane) reaches the kernel
+        from ..packing import ragged_from_batch, shape_rung
+        from ..platform import is_tpu_backend
+        from .count_pallas import (BLOCK_ELEMS, count_kernel_ragged, fits,
+                                   flatten_state)
+        if fits(rt.n_qual_rg, rt.n_cycle):
+            # pad the flat planes to a canonical geometric rung (the
+            # row-ladder recurrence over BLOCK_ELEMS multiples) — exact
+            # per-chunk T would mint a fresh compiled shape per chunk,
+            # the recompile tax the rung machinery exists to kill
+            rl = np.minimum(np.asarray(batch.read_len, np.int64),
+                            batch.max_len)
+            t_rung = shape_rung(max(int(rl.sum()), 1), BLOCK_ELEMS)
+            rb = ragged_from_batch(batch, pad_bases_to=t_rung)
+            state_flat = flatten_state(state, rb.read_len,
+                                       len(rb.bases_flat))
+            return count_kernel_ragged(
+                rb, state_flat, usable, n_qual_rg=rt.n_qual_rg,
+                n_cycle=rt.n_cycle, max_read_len=batch.max_len,
+                interpret=not is_tpu_backend())
+        # covariate ranges past the packed-word budget: padded fallback.
+        # The ragged feed projects bases/quals OFF the device batch
+        # (pipeline._P2_DEV_COLS_RAGGED) — the padded kernels below
+        # need them, so fall back to the host batch's columns
+        dev = batch
     impl = _count_impl(sharded=sharded)
     if impl in ("chain", "matmul") and \
             os.environ.get(_COUNT_IMPL_ENV, "auto") == "auto":

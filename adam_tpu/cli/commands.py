@@ -72,6 +72,16 @@ def add_executor_args(p: argparse.ArgumentParser) -> None:
                         "a persistent failure falls back to the CPU "
                         "backend — default 3, ADAM_TPU_RETRY_* envs "
                         "tune the rest; docs/RESILIENCE.md)")
+    g = p.add_mutually_exclusive_group()
+    g.add_argument("-ragged", action="store_true",
+                   help="force the RAGGED kernel layout on every "
+                        "ragged-capable pass (concatenated planes + "
+                        "prefix-sum row index, no per-chunk pad tax; "
+                        "docs/EXECUTOR.md) — default: let raced bench "
+                        "evidence decide, padded without evidence")
+    g.add_argument("-no_ragged", action="store_true",
+                   help="force the padded layout (the escape hatch; "
+                        "ADAM_TPU_RAGGED=0 is the env equivalent)")
 
 
 def executor_opts_from(args) -> dict:
@@ -86,6 +96,10 @@ def executor_opts_from(args) -> dict:
         opts["autotune"] = False
     if getattr(args, "retry_budget", None) is not None:
         opts["retry_budget"] = args.retry_budget
+    if getattr(args, "ragged", False):
+        opts["ragged"] = True
+    elif getattr(args, "no_ragged", False):
+        opts["ragged"] = False
     return opts
 
 
@@ -339,6 +353,10 @@ class TransformCommand(Command):
                 realign_opts["depth"] = args.realign_pipeline_depth
             if args.no_realign_pipeline:
                 realign_opts["pipeline"] = False
+            if getattr(args, "ragged", False):
+                realign_opts["layout"] = "ragged"
+            elif getattr(args, "no_ragged", False):
+                realign_opts["layout"] = "padded"
             n = streaming_transform(
                 args.input, args.output,
                 markdup=args.mark_duplicate_reads,

@@ -61,6 +61,9 @@ STAGE_SUCCESS_KEYS = {
     "bqsr_race8": ("race_pallas8_reads_per_sec",
                    "race_pallas_rows8_reads_per_sec"),
     "pallas": ("sweep_pallas_ok", "sw_pallas_ok"),
+    "ragged_race": ("ragged_realign_ragged_per_sec",
+                    "ragged_bqsr_ragged_per_sec",
+                    "ragged_flagstat_ragged_per_sec"),
 }
 
 #: pallas is special: the ok flags are present on failure too (False)

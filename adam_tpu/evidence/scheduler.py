@@ -17,8 +17,9 @@ first.  Ordering rule (information-per-byte):
 
 This fixes the round-4/5 inversion (bench.py ran the 34 MB flagstat
 wire before the 8 MB race — VERDICT r4, ``bench.py:912``): the default
-order with an empty ledger is ``probe → bqsr_race → pallas → transform
-→ flagstat → bqsr_race8``, pinned by tests/test_bench_orchestration.py.
+order with an empty ledger is ``probe → bqsr_race → pallas →
+ragged_race → transform → flagstat → bqsr_race8``, pinned by
+tests/test_bench_orchestration.py.
 
 The scheduler also owns the per-stage deadline table (bench._run_worker
 enforces it over the worker's stdout; ``ADAM_TPU_BENCH_STAGE_TIMEOUTS``
@@ -35,21 +36,23 @@ import os
 from typing import Iterable, Optional
 
 #: canonical stage order with an empty ledger — probe always first (it
-#: supplies platform/link context to everything after it)
-DEFAULT_STAGE_ORDER = ("probe", "bqsr_race", "pallas", "transform",
-                       "flagstat", "bqsr_race8")
+#: supplies platform/link context to everything after it).  ragged_race
+#: adjudicates the executor's padded-vs-ragged layout dimension
+#: (ISSUE 8) right after the kernel adjudication stages.
+DEFAULT_STAGE_ORDER = ("probe", "bqsr_race", "pallas", "ragged_race",
+                       "transform", "flagstat", "bqsr_race8")
 
 #: information tier per stage (lower = captured earlier); see module
 #: docstring for what each stage adjudicates
-INFO_TIER = {"probe": 0, "bqsr_race": 1, "pallas": 2, "transform": 3,
-             "flagstat": 4, "bqsr_race8": 5}
+INFO_TIER = {"probe": 0, "bqsr_race": 1, "pallas": 2, "ragged_race": 3,
+             "transform": 4, "flagstat": 5, "bqsr_race8": 6}
 
 #: per-stage stdout deadlines enforced by bench._run_worker (probe
 #: covers backend init + first compile over the tunnel); one hung stage
 #: can cost at most its own entry, never the window
 STAGE_DEADLINES_S = {"probe": 150.0, "flagstat": 180.0, "transform": 280.0,
                      "bqsr_race": 300.0, "bqsr_race8": 150.0,
-                     "pallas": 240.0}
+                     "pallas": 240.0, "ragged_race": 300.0}
 
 TIMEOUTS_ENV = "ADAM_TPU_BENCH_STAGE_TIMEOUTS"
 
@@ -65,7 +68,8 @@ RACE_WIRE_BYTES_PER_READ = 8.0          # index word + weight byte per base
 TRANSFORM_WIRE_BYTES_PER_READ = 33.0    # scalars + LUT slices per read
 
 _DEFAULT_READS = {"flagstat": 12_000_000, "bqsr_race": 1_000_000,
-                  "bqsr_race8": 1_000_000, "transform": 1_500_000}
+                  "bqsr_race8": 1_000_000, "transform": 1_500_000,
+                  "ragged_race": 3_000_000}
 
 
 def wire_bytes_for(stage: str, payload: Optional[dict] = None,
@@ -83,6 +87,9 @@ def wire_bytes_for(stage: str, payload: Optional[dict] = None,
         return 64 * 100 * 8               # tiny check arrays
     if stage == "flagstat":
         return int(FLAGSTAT_WIRE_BYTES_PER_READ * n_reads)
+    if stage == "ragged_race":
+        # dominated by its flagstat leg's wire (both layouts)
+        return int(2 * FLAGSTAT_WIRE_BYTES_PER_READ * n_reads)
     if stage in ("bqsr_race", "bqsr_race8"):
         return int(RACE_WIRE_BYTES_PER_READ * n_reads)
     if stage == "transform":
@@ -113,7 +120,8 @@ def order_stages(want: Iterable[str], ledger=None) -> list:
 #: CPU artifacts landed flagstat+transform+race in exactly this order;
 #: racing first would let the slow CPU race legs eat the fallback
 #: deadline and zero the headline value)
-CPU_FALLBACK_ORDER = ("probe", "flagstat", "transform", "bqsr_race")
+CPU_FALLBACK_ORDER = ("probe", "flagstat", "transform", "bqsr_race",
+                      "ragged_race")
 
 
 def order_cpu_fallback(missing: Iterable[str]) -> list:

@@ -256,3 +256,73 @@ def pack_reads_wire(table: pa.Table, *, bucket_len: int,
                            np.arange(n_pad), -1).astype(np.int32),
         read_len=read_len, bases=bases, quals=quals,
         cigar_ops=ops, cigar_lens=lens_c, n_cigar=n_ops)
+
+
+def pack_reads_ragged_wire(table: pa.Table, *, pad_rows_to: int = 1,
+                           pad_bases_to: int = 1, with_cigar: bool = True,
+                           max_cigar_ops: Optional[int] = None):
+    """:func:`packing.pack_reads_ragged` over a WIRE-format chunk.
+
+    The wire matrices are row-padded byte planes; gathering each row's
+    true-length prefix (one boolean take per plane) yields exactly the
+    concatenated layout — the length sidecars already ARE the per-read
+    lengths whose prefix sum becomes ``row_offsets``.  Bit-identical to
+    flattening ``pack_reads_wire``'s padded planes (the ragged
+    differential pinned in tests/test_ragged.py)."""
+    from .. import schema as S
+    from ..packing import (MAX_CIGAR_OPS, QUAL_PAD, RaggedBatch, _BASE_LUT,
+                           _OFFSET_LUTS, _int_column, _ragged_walk,
+                           _ranges_within, _round_up, pack_cigars)
+
+    n = table.num_rows
+    n_pad = _round_up(max(n, 1), pad_rows_to)
+    seq_lens = np.asarray(table.column(WIRE_SEQ_LEN).combine_chunks()
+                          .to_numpy(zero_copy_only=False)).astype(np.int64)
+    qual_lens = np.asarray(table.column(WIRE_QUAL_LEN).combine_chunks()
+                           .to_numpy(zero_copy_only=False)).astype(np.int64)
+    read_len = np.zeros(n_pad, np.int32)
+    read_len[:n] = np.maximum(seq_lens, 0).astype(np.int32)
+    T = int(read_len.sum())
+    t_pad = _round_up(max(T, 1), max(int(pad_bases_to), 1))
+    row_offsets, row_of, pos_of = _ragged_walk(read_len, t_pad)
+
+    def flat(name, lens, lut, pad_value):
+        mat = _wire_matrix(table, name)
+        out = np.full(t_pad, pad_value, np.int8)
+        if not mat.size:
+            return out
+        W = mat.shape[1]
+        # decode only each read's true-length prefix; the qual plane
+        # clips to the sequence length (flat planes share the sequence
+        # offsets — bytes past read_len are never consumed by a kernel),
+        # and a row whose own column is shorter leaves its tail at
+        # pad_value — exactly the padded packer's QUAL_PAD tail
+        eff = np.minimum(np.maximum(lens, 0),
+                         np.minimum(read_len[:n], W)).astype(np.int64)
+        src_rows = np.repeat(np.arange(n, dtype=np.int64), eff)
+        pos = _ranges_within(eff)
+        out[row_offsets[:-1][:n][src_rows] + pos] = lut[mat[src_rows, pos]]
+        return out
+
+    bases_flat = flat(WIRE_SEQ, seq_lens, _BASE_LUT, S.BASE_PAD)
+    quals_flat = flat(WIRE_QUAL, qual_lens, _OFFSET_LUTS[33], QUAL_PAD)
+    kw: dict = {}
+    if with_cigar:
+        ops, lens_c, n_ops = pack_cigars(
+            table.column("cigar"), n_pad,
+            max_cigar_ops if max_cigar_ops is not None else MAX_CIGAR_OPS)
+        kw.update(cigar_ops=ops, cigar_lens=lens_c, n_cigar=n_ops)
+    return RaggedBatch(
+        flags=_int_column(table, "flags", n_pad, null_value=0),
+        refid=_int_column(table, "referenceId", n_pad),
+        start=_int_column(table, "start", n_pad),
+        mapq=_int_column(table, "mapq", n_pad),
+        mate_refid=_int_column(table, "mateReferenceId", n_pad),
+        mate_start=_int_column(table, "mateAlignmentStart", n_pad),
+        read_group=_int_column(table, "recordGroupId", n_pad),
+        valid=np.arange(n_pad) < n,
+        row_index=np.where(np.arange(n_pad) < n,
+                           np.arange(n_pad), -1).astype(np.int32),
+        read_len=read_len, row_offsets=row_offsets,
+        bases_flat=bases_flat, quals_flat=quals_flat,
+        row_of=row_of, pos_of=pos_of, **kw)

@@ -100,14 +100,27 @@ def chunk_processed(pass_name: str, rows: int, *,
     events.emit("chunk", **fields)
 
 
-def pad_waste(pass_name: str, rows: int, padded_rows: int) -> None:
+def pad_waste(pass_name: str, rows: int, padded_rows: int,
+              max_len: Optional[int] = None,
+              padded_len: Optional[int] = None) -> None:
     """Bucket-padding accounting: the fraction of a packed chunk that is
-    padding (wasted device work), from pipeline.pad_bucket consumers."""
+    padding (wasted device work), from pipeline.pad_bucket consumers.
+
+    The ROW axis (``pad_waste_frac``) was the only measured axis through
+    PR 7, but base-level kernels pad a LENGTH axis too (the 128-multiple
+    bucket) — on a length-skewed input the lane slack dwarfs the row
+    slack.  ``max_len``/``padded_len`` (the chunk's true max read length
+    vs its bucket) add a ``pad_waste_lane_frac`` sample so the executor's
+    padded-vs-ragged layout decision is justified by measured waste on
+    every padded axis (docs/OBSERVABILITY.md)."""
+    r = registry()
     if padded_rows > 0:
-        r = registry()
         r.histogram("pad_waste_frac", **{"pass": pass_name}).observe(
             (padded_rows - rows) / padded_rows)
         r.counter("pad_rows", **{"pass": pass_name}).inc(padded_rows - rows)
+    if max_len is not None and padded_len is not None and padded_len > 0:
+        r.histogram("pad_waste_lane_frac", **{"pass": pass_name}).observe(
+            (padded_len - min(max_len, padded_len)) / padded_len)
 
 
 def _path_bytes(path: Optional[str]) -> int:

@@ -306,6 +306,138 @@ def flagstat_pallas_wire32(wire, interpret: bool = False) -> jnp.ndarray:
                              interpret=interpret)
 
 
+# ---------------------------------------------------------------------------
+# ragged wire sweep: prefix-sum row bound instead of per-chunk padding
+# ---------------------------------------------------------------------------
+#
+# The padded streaming path pads EVERY chunk's wire to a ladder rung and
+# burns valid=0 words on the pad rows (<35% mean, but real device cycles).
+# The ragged form dispatches one fixed-capacity CONCATENATION of many
+# variable-length chunks: validity is positional — a row counts iff its
+# flat index sits below the row-offset prefix sum's total — so the slack
+# past the total may be arbitrary garbage (never zeroed, never shipped
+# per-chunk) and the pad tax collapses to the final partial buffer.
+# Same sequential grid and SMEM accumulator structure as the v1 sweep.
+
+def _kernel_ragged(total_ref, wire_ref, out_ref):
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _init():
+        for k in range(18):
+            out_ref[k, 0] = 0
+            out_ref[k, 1] = 0
+
+    wire = wire_ref[...]
+    rows, lanes = wire.shape
+    # global flat row index of every word in this block — the prefix-sum
+    # walk: a word is live iff it sits below the offsets' total
+    idx = (i * rows * lanes
+           + jax.lax.broadcasted_iota(jnp.int32, (rows, lanes), 0) * lanes
+           + jax.lax.broadcasted_iota(jnp.int32, (rows, lanes), 1))
+    live = idx < total_ref[0]
+    inds, passed, failed = _wire_masks(wire)
+    passed &= live          # slack words may be garbage: the positional
+    failed &= live          # bound gates them, not a valid bit
+    for k, ind in enumerate(inds):
+        out_ref[k, 0] += jnp.sum((ind & passed).astype(jnp.int32))
+        out_ref[k, 1] += jnp.sum((ind & failed).astype(jnp.int32))
+
+
+def _blocked_call_ragged(wire3d, total, *, interpret: bool):
+    from jax.experimental.pallas import tpu as pltpu
+
+    n_blk, rows, lanes = wire3d.shape
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(n_blk,),
+        in_specs=[pl.BlockSpec((None, rows, lanes),
+                               lambda i, total_ref: (i, 0, 0))],
+        out_specs=pl.BlockSpec(memory_space=pltpu.SMEM),
+    )
+    return pl.pallas_call(
+        _kernel_ragged,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((18, 2), jnp.int32),
+        compiler_params=pallas_tpu_compiler_params(
+            dimension_semantics=("arbitrary",)),
+        interpret=interpret,
+    )(total, wire3d)
+
+
+@jax.jit
+def _flagstat_ragged_tail(tail, base, total):
+    """XLA ragged tail: words at flat indices [base, base+len) count iff
+    below ``total`` (a zeroed word carries valid=0, so one where does
+    the positional masking)."""
+    idx = base + jnp.arange(tail.shape[0], dtype=jnp.int32)
+    return flagstat_kernel_wire32(jnp.where(idx < total, tail, 0))
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _flagstat_blocked_ragged(wire3d, tail, total, interpret=False):
+    counts = _blocked_call_ragged(wire3d, total, interpret=interpret)
+    n_blk, rows, lanes = wire3d.shape
+    return counts + _flagstat_ragged_tail(
+        tail, jnp.int32(n_blk * rows * lanes), total[0])
+
+
+def flagstat_pallas_wire32_ragged(wire, row_offsets,
+                                  interpret: bool = False) -> jnp.ndarray:
+    """[18, 2] counters over a fixed-capacity concatenation of
+    variable-length chunk wires — the ragged twin of
+    :func:`flagstat_pallas_wire32`.
+
+    ``row_offsets`` is the int32 prefix sum of the source chunks' row
+    counts (``io/wirespill`` length-sidecar format, cumulated); only
+    rows below ``row_offsets[-1]`` count, everything past it is slack
+    the kernel never consumes.  The compiled shape depends only on the
+    wire CAPACITY, so a whole run dispatches one shape regardless of how
+    the input raggedly chunks — bit-identical to summing the padded
+    kernel over the source chunks (exact int32 monoid), pinned by
+    tests/test_ragged.py.
+    """
+    wire = np.asarray(wire, np.uint32)
+    offs = np.asarray(row_offsets, np.int32)
+    total = jnp.asarray(offs[-1:], jnp.int32)
+    n_blk = wire.shape[0] // BLOCK
+    tail = wire[n_blk * BLOCK:]
+    if n_blk == 0:
+        return _flagstat_ragged_tail(jnp.asarray(tail), jnp.int32(0),
+                                     total[0])
+    wire3d = wire[:n_blk * BLOCK].reshape(n_blk, BLOCK_ROWS, LANES)
+    return _flagstat_blocked_ragged(jnp.asarray(wire3d), jnp.asarray(tail),
+                                    total, interpret=interpret)
+
+
+def flagstat_ragged_dispatch(wire, total, *, interpret: bool = False,
+                             use_pallas: bool = False) -> jnp.ndarray:
+    """[18, 2] counters off one fixed-capacity wire buffer (device or
+    host array) with ``total`` live rows — the streaming ragged path's
+    dispatcher (parallel/pipeline.py).  ``use_pallas`` routes full
+    blocks through the ragged Mosaic sweep (interpret mode off-TPU);
+    otherwise the one-where XLA form runs.  The buffer capacity is the
+    only compiled shape either way."""
+    wire = jnp.asarray(wire)
+    tot = jnp.asarray([int(total)], jnp.int32)
+    n_blk = wire.shape[0] // BLOCK
+    if use_pallas and n_blk:
+        w3 = wire[:n_blk * BLOCK].reshape(n_blk, BLOCK_ROWS, LANES)
+        return _flagstat_blocked_ragged(w3, wire[n_blk * BLOCK:], tot,
+                                        interpret=interpret)
+    return _flagstat_ragged_tail(wire, jnp.int32(0), tot[0])
+
+
+def flagstat_wire32_ragged_xla(wire, row_offsets) -> jnp.ndarray:
+    """XLA fallback of the ragged sweep (the off-TPU product path): one
+    fused where + the einsum core — the positional bound zeroes slack
+    words (valid bit 0) instead of requiring pre-zeroed padding."""
+    offs = np.asarray(row_offsets, np.int32)
+    return _flagstat_ragged_tail(jnp.asarray(wire),
+                                 jnp.int32(0),
+                                 jnp.int32(int(offs[-1])))
+
+
 def available() -> bool:
     """True when the active backend can run the compiled kernel."""
     from ..platform import is_tpu_backend

@@ -16,6 +16,7 @@ have ``valid == False`` and are ignored by every kernel.
 
 from __future__ import annotations
 
+import functools
 from dataclasses import dataclass, fields as dc_fields
 from typing import Optional
 
@@ -118,6 +119,7 @@ def _round_up(x: int, mult: int) -> int:
 LADDER_BASE_DEFAULT = 2.0
 
 
+@functools.lru_cache(maxsize=512)
 def row_bucket_ladder(cap_rows: int, mult: int = 1,
                       base: float = LADDER_BASE_DEFAULT) -> tuple:
     """Geometric ladder of canonical row buckets: ``mult``-multiples from
@@ -128,6 +130,14 @@ def row_bucket_ladder(cap_rows: int, mult: int = 1,
     each kernel against at most ``len(ladder)`` row shapes.  Previously
     each pass re-derived power-of-two buckets independently and a skewed
     tail chunk could mint a fresh shape (= a fresh XLA compile) mid-run.
+
+    Memoized per (cap, mult, base): the fused transform's per-chunk plan
+    consumers and the realign batcher re-derive ladders in hot loops, and
+    a dense (sqrt-2) ladder over a multi-million-row cap is hundreds of
+    Python loop iterations per call.  The ladder is a pure function of
+    its arguments and the returned tuple is immutable, so caching cannot
+    change a single rung — pinned by tests/test_ragged.py alongside the
+    zero-recompile rerun property.
     """
     if base <= 1.0:
         raise ValueError(f"ladder base must exceed 1.0, got {base}")
@@ -153,6 +163,7 @@ def pad_rows_for(rows: int, ladder) -> int:
     return ladder[-1]
 
 
+@functools.lru_cache(maxsize=8192)
 def shape_rung(n: int, mult: int, base: float = LADDER_BASE_DEFAULT) -> int:
     """Smallest canonical rung (the :func:`row_bucket_ladder` recurrence
     from ``mult``) that holds ``n`` — the unbounded form of
@@ -177,6 +188,7 @@ def shape_rung(n: int, mult: int, base: float = LADDER_BASE_DEFAULT) -> int:
     return r
 
 
+@functools.lru_cache(maxsize=1024)
 def len_bucket(max_len: int, base: float = LADDER_BASE_DEFAULT) -> int:
     """Canonical length bucket: the next 128-multiple (TPU lane width),
     rounded up its own geometric ladder (128, 256, 512, ... for the
@@ -486,3 +498,240 @@ def pack_reads(table: pa.Table, *, with_bases: bool = True,
             table.column("cigar"), n_pad, max_cigar_ops)
         batch.update(cigar_ops=ops, cigar_lens=lens, n_cigar=n_ops)
     return ReadBatch(**batch)
+
+
+# ---------------------------------------------------------------------------
+# ragged layout: concatenated planes + row-offset prefix sums
+# ---------------------------------------------------------------------------
+
+@dataclass
+class RaggedBatch:
+    """Variable-length reads as CONCATENATED planes (no per-read padding).
+
+    The padded :class:`ReadBatch` pays a "pad tax" on two axes: rows pad
+    to a ladder rung and every base-level plane pads to the 128-multiple
+    length bucket, so a skewed input can spend a third of device cycles
+    on ``valid=False`` rows and past-length lanes.  This layout is the
+    ragged-paged-attention answer (docs/ARCHITECTURE.md §6g): base/qual
+    bytes of all reads concatenate into flat ``[T]`` planes and an int32
+    ``row_offsets`` prefix sum (the :mod:`..io.wirespill` length-sidecar
+    format, cumulated) says where each read starts — kernels walk a
+    prefix-sum row index instead of masking padded lanes.
+
+    ``row_of``/``pos_of`` materialize the prefix-sum walk (source row and
+    position-in-read of every flat element) so jitted kernels need no
+    host searchsorted.  The flat planes MAY carry slack past
+    ``n_bases == row_offsets[-1]`` (padding ``T`` to a canonical rung
+    keeps compiled shapes bounded); slack elements carry pad sentinels
+    and ``row_of == 0`` and every kernel excludes them by flat index,
+    never by a valid bit.
+
+    Scalar per-read columns keep :class:`ReadBatch` semantics (rows pad
+    to ``pad_rows_to`` with ``valid == False``); cigars stay the packed
+    fixed-op columns — op counts are tiny and bounded, so raggedness
+    buys nothing there.
+    """
+    flags: np.ndarray          # int32 [N]
+    refid: np.ndarray          # int32 [N]
+    start: np.ndarray          # int32 [N]
+    mapq: np.ndarray           # int32 [N]
+    mate_refid: np.ndarray     # int32 [N]
+    mate_start: np.ndarray     # int32 [N]
+    read_group: np.ndarray     # int32 [N]
+    valid: np.ndarray          # bool  [N]
+    row_index: np.ndarray      # int32 [N]
+    read_len: np.ndarray       # int32 [N] true lengths (0 for pad/null)
+    row_offsets: np.ndarray    # int32 [N+1] prefix sums into the planes
+    bases_flat: Optional[np.ndarray] = None  # int8 [Tpad], BASE_PAD slack
+    quals_flat: Optional[np.ndarray] = None  # int8 [Tpad], QUAL_PAD slack
+    row_of: Optional[np.ndarray] = None      # int32 [Tpad], 0 in slack
+    pos_of: Optional[np.ndarray] = None      # int32 [Tpad], 0 in slack
+    cigar_ops: Optional[np.ndarray] = None   # int8 [N, C]
+    cigar_lens: Optional[np.ndarray] = None  # int32 [N, C]
+    n_cigar: Optional[np.ndarray] = None     # int32 [N]
+
+    @property
+    def n_reads(self) -> int:
+        return int(self.flags.shape[0])
+
+    @property
+    def n_bases(self) -> int:
+        """True flat-plane length (elements past it are slack)."""
+        return int(self.row_offsets[-1])
+
+    def device_put(self, sharding=None) -> "RaggedBatch":
+        kw = {}
+        for f in dc_fields(self):
+            v = getattr(self, f.name)
+            kw[f.name] = None if v is None else jax.device_put(v, sharding)
+        return RaggedBatch(**kw)
+
+
+if _HAVE_JAX:
+    jax.tree_util.register_pytree_node(
+        RaggedBatch,
+        lambda rb: (tuple(getattr(rb, f.name) for f in dc_fields(rb)), None),
+        lambda _, children: RaggedBatch(*children),
+    )
+
+
+def _flat_string_column(col, n_rows: int, lut: np.ndarray,
+                        clip_lens: Optional[np.ndarray] = None
+                        ) -> tuple[np.ndarray, np.ndarray]:
+    """Arrow string/binary column -> (decoded flat int8 [T], lens int32
+    [n_rows], source-gather tuple) with no inter-read padding.  The Arrow
+    var-length layout already IS concatenated-bytes + prefix-sum offsets,
+    so the dense (null-free, unsliced) case decodes with ONE LUT pass
+    over the data buffer — no per-row work at all.
+
+    ``clip_lens`` caps each row's decoded length (the qual plane clips to
+    the sequence length: flat planes share the sequence's offsets, and a
+    kernel never reads past ``read_len`` anyway — exactly the bytes the
+    padded packer exposes)."""
+    arr = col.combine_chunks() if isinstance(col, (pa.ChunkedArray,)) \
+        else col
+    if isinstance(arr, pa.ChunkedArray):  # zero-chunk edge case
+        arr = pa.concat_arrays(arr.chunks) if arr.num_chunks \
+            else pa.array([], pa.string())
+    n = len(arr)
+    bufs = arr.buffers()
+    offsets = np.frombuffer(bufs[1], np.int32, count=n + 1,
+                            offset=arr.offset * 4) if n else \
+        np.zeros(1, np.int32)
+    data = np.frombuffer(bufs[2], np.uint8) if len(bufs) > 2 and \
+        bufs[2] is not None else np.zeros(0, np.uint8)
+    lens = (offsets[1:] - offsets[:-1]).astype(np.int32)
+    if n and arr.null_count:
+        lens = np.where(np.asarray(arr.is_null()), 0, lens)
+    lens_full = np.zeros(n_rows, np.int32)
+    lens_full[:n] = lens
+    if clip_lens is not None:
+        lens_full = np.minimum(lens_full, clip_lens)
+        lens = lens_full[:n]
+    T = int(lens.sum())
+    if T == 0:
+        return np.zeros(0, np.int8), lens_full
+    contiguous = (not (n and arr.null_count) and clip_lens is None and
+                  data.size == int(offsets[-1]) - int(offsets[0]) and
+                  bool((offsets[1:] >= offsets[:-1]).all()))
+    if contiguous:
+        flat = lut[data[int(offsets[0]):int(offsets[0]) + T]].astype(
+            np.int8, copy=False)
+        return flat, lens_full
+    src = np.repeat(offsets[:-1].astype(np.int64), lens) + \
+        _ranges_within(lens)
+    return lut[data[src]].astype(np.int8, copy=False), lens_full
+
+
+def _ragged_walk(lens: np.ndarray, t_pad: int
+                 ) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """(row_offsets [N+1], row_of [t_pad], pos_of [t_pad]) for per-read
+    lengths — the materialized prefix-sum row index; slack walks row 0 at
+    position 0 (excluded by flat index, never consumed)."""
+    n = len(lens)
+    row_offsets = np.zeros(n + 1, np.int32)
+    np.cumsum(lens, out=row_offsets[1:])
+    T = int(row_offsets[-1])
+    row_of = np.zeros(t_pad, np.int32)
+    pos_of = np.zeros(t_pad, np.int32)
+    row_of[:T] = np.repeat(np.arange(n, dtype=np.int32), lens)
+    pos_of[:T] = _ranges_within(lens).astype(np.int32)
+    return row_offsets, row_of, pos_of
+
+
+def pack_reads_ragged(table: pa.Table, *, with_bases: bool = True,
+                      with_cigar: bool = True, pad_rows_to: int = 1,
+                      pad_bases_to: int = 1,
+                      max_cigar_ops: int = MAX_CIGAR_OPS) -> RaggedBatch:
+    """:func:`pack_reads`' ragged twin: same scalar columns, flat planes.
+
+    Lossless against the padded packers by construction — the flat
+    planes hold exactly the per-read prefixes :func:`pack_reads` (and
+    :func:`..io.wirespill.pack_reads_wire`) expose below ``read_len``,
+    in the same row order; tests/test_ragged.py pins the differential
+    over adversarial alphabets, nulls, empty reads and one-read chunks.
+    Wire-format chunks (``io.wirespill.to_wire`` spills) route through
+    :func:`..io.wirespill.pack_reads_ragged_wire`, which rebuilds the
+    same planes off the wire matrices.
+    """
+    from .io.wirespill import is_wire_table, pack_reads_ragged_wire
+
+    if with_bases and is_wire_table(table):
+        return pack_reads_ragged_wire(
+            table, pad_rows_to=pad_rows_to, pad_bases_to=pad_bases_to,
+            with_cigar=with_cigar, max_cigar_ops=max_cigar_ops)
+    n = table.num_rows
+    n_pad = _round_up(max(n, 1), pad_rows_to)
+    batch = dict(
+        flags=_int_column(table, "flags", n_pad, null_value=0),
+        refid=_int_column(table, "referenceId", n_pad),
+        start=_int_column(table, "start", n_pad),
+        mapq=_int_column(table, "mapq", n_pad),
+        mate_refid=_int_column(table, "mateReferenceId", n_pad),
+        mate_start=_int_column(table, "mateAlignmentStart", n_pad),
+        read_group=_int_column(table, "recordGroupId", n_pad),
+        valid=np.arange(n_pad) < n,
+        row_index=np.where(np.arange(n_pad) < n,
+                           np.arange(n_pad), -1).astype(np.int32),
+    )
+    if with_bases:
+        bases, read_len = _flat_string_column(
+            table.column("sequence"), n_pad, _BASE_LUT)
+        quals, qual_eff = _flat_string_column(
+            table.column("qual"), n_pad, _OFFSET_LUTS[33],
+            clip_lens=read_len)
+        t_pad = _round_up(max(len(bases), 1), max(int(pad_bases_to), 1))
+        bases_p = np.full(t_pad, S.BASE_PAD, np.int8)
+        bases_p[:len(bases)] = bases
+        row_offsets, row_of, pos_of = _ragged_walk(read_len, t_pad)
+        # the qual plane shares the SEQUENCE offsets: a shorter qual
+        # string fills its prefix and leaves QUAL_PAD up to read_len,
+        # exactly the padded packer's tail — so scatter, don't concat
+        quals_p = np.full(t_pad, QUAL_PAD, np.int8)
+        if len(quals):
+            dst = np.repeat(row_offsets[:-1].astype(np.int64),
+                            qual_eff) + _ranges_within(qual_eff)
+            quals_p[dst] = quals
+        batch.update(read_len=read_len, row_offsets=row_offsets,
+                     bases_flat=bases_p, quals_flat=quals_p,
+                     row_of=row_of, pos_of=pos_of)
+    else:
+        batch.update(read_len=np.zeros(n_pad, np.int32),
+                     row_offsets=np.zeros(n_pad + 1, np.int32))
+    if with_cigar:
+        ops, lens, n_ops = pack_cigars(
+            table.column("cigar"), n_pad, max_cigar_ops)
+        batch.update(cigar_ops=ops, cigar_lens=lens, n_cigar=n_ops)
+    return RaggedBatch(**batch)
+
+
+def ragged_from_batch(batch: ReadBatch, pad_bases_to: int = 1
+                      ) -> RaggedBatch:
+    """Flatten an already-packed padded :class:`ReadBatch` into the
+    ragged layout (one boolean take per plane — row-major order is
+    concatenation order).  The bridge the streaming passes use to feed
+    ragged kernels without re-decoding, and the differential oracle for
+    :func:`pack_reads_ragged`."""
+    if batch.bases is None or batch.read_len is None:
+        raise ValueError("ragged_from_batch needs packed base planes")
+    n, L = batch.bases.shape
+    read_len = np.minimum(np.asarray(batch.read_len, np.int32), L)
+    mask = np.arange(L, dtype=np.int32)[None, :] < read_len[:, None]
+    T = int(read_len.sum())
+    t_pad = _round_up(max(T, 1), max(int(pad_bases_to), 1))
+    bases_p = np.full(t_pad, S.BASE_PAD, np.int8)
+    bases_p[:T] = np.asarray(batch.bases)[mask]
+    quals_p = np.full(t_pad, QUAL_PAD, np.int8)
+    if batch.quals is not None:
+        quals_p[:T] = np.asarray(batch.quals)[mask]
+    row_offsets, row_of, pos_of = _ragged_walk(read_len, t_pad)
+    return RaggedBatch(
+        flags=batch.flags, refid=batch.refid, start=batch.start,
+        mapq=batch.mapq, mate_refid=batch.mate_refid,
+        mate_start=batch.mate_start, read_group=batch.read_group,
+        valid=batch.valid, row_index=batch.row_index,
+        read_len=read_len, row_offsets=row_offsets,
+        bases_flat=bases_p, quals_flat=quals_p,
+        row_of=row_of, pos_of=pos_of,
+        cigar_ops=batch.cigar_ops, cigar_lens=batch.cigar_lens,
+        n_cigar=batch.n_cigar)

@@ -64,6 +64,10 @@ LADDER_BASE_ENV = "ADAM_TPU_EXECUTOR_LADDER_BASE"
 PREFETCH_ENV = "ADAM_TPU_EXECUTOR_PREFETCH"
 AUTOTUNE_ENV = "ADAM_TPU_EXECUTOR_AUTOTUNE"
 DONATE_ENV = "ADAM_TPU_EXECUTOR_DONATE"
+#: layout escape hatch shared by every ragged-capable pass (flagstat,
+#: BQSR count, realign sweep): 1/ragged forces the ragged layout,
+#: 0/off/padded forces padded; unset lets raced bench evidence decide
+RAGGED_ENV = "ADAM_TPU_RAGGED"
 
 #: the autotuner densifies the ladder once observed mean pad waste
 #: crosses this fraction (sqrt(2) rungs halve the worst-case waste of
@@ -93,6 +97,9 @@ def decide_plan(*, pass_name: str, chunk_rows: int, mesh_size: int,
                 ladder_base: Optional[float] = None,
                 prefetch_depth: Optional[int] = None,
                 donate: Optional[bool] = None,
+                layout: Optional[str] = None,
+                ragged_capable: bool = False,
+                ragged_rates: Optional[dict] = None,
                 autotune: bool = True) -> dict:
     """The autotuner: one pass's frozen execution plan.
 
@@ -101,8 +108,18 @@ def decide_plan(*, pass_name: str, chunk_rows: int, mesh_size: int,
     (``inputs`` + ``input_digest``), so a recorded sidecar can be
     replayed offline and the decision re-derived bit-for-bit
     (tools/check_executor.py).  Explicit ``ladder_base`` /
-    ``prefetch_depth`` / ``donate`` pin those knobs; ``autotune=False``
-    freezes everything at the defaults.
+    ``prefetch_depth`` / ``donate`` / ``layout`` pin those knobs;
+    ``autotune=False`` freezes everything at the defaults.
+
+    ``layout`` is the ragged-vs-padded dimension (docs/EXECUTOR.md):
+    ``ragged_capable`` says whether THIS pass has a ragged twin in this
+    run configuration (single-shard mesh, a kernel with a ragged form);
+    ``ragged_rates`` is the raced bench evidence — the PR 2 ledger's
+    ``ragged_race`` record for this pass's kernel, ``{"padded": r/s,
+    "ragged": r/s}`` measured on the CURRENT platform — and the plan
+    picks ragged only when an explicit pin or measured evidence backs
+    it.  Padded is the no-evidence default: the ragged layout is a
+    measured optimization, never a guess.
     """
     inputs = dict(pass_name=pass_name, chunk_rows=int(chunk_rows),
                   mesh_size=int(mesh_size), on_tpu=bool(on_tpu),
@@ -113,13 +130,33 @@ def decide_plan(*, pass_name: str, chunk_rows: int, mesh_size: int,
                   bytes_per_row=None if bytes_per_row is None
                   else float(bytes_per_row),
                   ladder_base=ladder_base, prefetch_depth=prefetch_depth,
-                  donate=donate, autotune=bool(autotune))
+                  donate=donate, layout=layout,
+                  ragged_capable=bool(ragged_capable),
+                  ragged_rates=None if not ragged_rates else {
+                      k: round(float(v), 1)
+                      for k, v in sorted(ragged_rates.items())},
+                  autotune=bool(autotune))
     # decide from the CANONICALIZED inputs (what the event records) —
     # deciding from the raw floats would let a rounding boundary make
     # the offline replay disagree with the recorded plan
     waste_mean = inputs["waste_mean"]
     link_bytes_per_sec = inputs["link_bytes_per_sec"]
     reasons = []
+    lay = "padded"
+    if inputs["layout"] == "ragged":
+        if inputs["ragged_capable"]:
+            lay = "ragged"
+            reasons.append("layout-pinned-ragged")
+        else:
+            reasons.append("ragged-pin-unsupported:padded")
+    elif inputs["layout"] == "padded":
+        reasons.append("layout-pinned-padded")
+    elif autotune and inputs["ragged_capable"] and inputs["ragged_rates"]:
+        rr = inputs["ragged_rates"]
+        if rr.get("ragged", 0) > rr.get("padded", 0) > 0:
+            lay = "ragged"
+            reasons.append(
+                f"ragged-evidence {rr['ragged']:.0f}>{rr['padded']:.0f}")
     base = max(ladder_base, MIN_LADDER_BASE) if ladder_base \
         else LADDER_BASE_DEFAULT
     if autotune and not ladder_base and waste_mean is not None \
@@ -148,8 +185,58 @@ def decide_plan(*, pass_name: str, chunk_rows: int, mesh_size: int,
     return dict(pass_name=pass_name, chunk_rows=rows,
                 ladder_base=round(float(base), 6), ladder=list(ladder),
                 prefetch_depth=int(depth), donate=do_donate,
+                layout=lay,
                 reason=";".join(reasons) or "default",
                 inputs=inputs, input_digest=digest)
+
+
+#: which ragged-race evidence keys back which streaming pass: the bench
+#: ``ragged_race`` stage (bench.py) races each kernel's ragged twin
+#: against its padded form and the ledger keeps the best record
+_RAGGED_KERNEL_OF_PASS = {"flagstat": "flagstat", "p2": "bqsr",
+                          "s2": "bqsr"}
+
+
+def resolve_ragged_env(env_val: Optional[str]) -> Optional[str]:
+    """ADAM_TPU_RAGGED / flag string -> explicit layout pin or None."""
+    if env_val is None or env_val == "":
+        return None
+    if env_val in ("0", "off", "padded", "no"):
+        return "padded"
+    return "ragged"
+
+
+def ledger_ragged_rates(kernel: str,
+                        platform: Optional[str] = None) -> Optional[dict]:
+    """The evidence ledger's raced ragged-vs-padded rates for ``kernel``
+    (``flagstat`` | ``bqsr`` | ``realign``) — ``{"padded": r/s,
+    "ragged": r/s}`` from the bench ``ragged_race`` stage, or None when
+    the ledger has no record FOR THE CURRENT PLATFORM (cross-platform
+    evidence must never steer a layout: a CPU win says nothing about the
+    MXU).  Best-effort, like :func:`_ledger_link_rate`."""
+    try:
+        import jax
+
+        from ..evidence.ledger import Ledger, default_path
+        from ..platform import is_tpu_backend
+
+        # normalize like Ledger.record_stages does: the axon TPU plugin
+        # reports backend "axon" but records land as platform "tpu" —
+        # a raw default_backend() compare would orphan the evidence on
+        # the exact hardware the ragged layout targets
+        plat = platform or \
+            ("tpu" if is_tpu_backend() else jax.default_backend())
+        rec = Ledger(default_path()).record("ragged_race")
+        if not rec or rec.get("platform") != plat:
+            return None
+        payload = rec.get("payload") or rec
+        p = payload.get(f"ragged_{kernel}_padded_per_sec")
+        r = payload.get(f"ragged_{kernel}_ragged_per_sec")
+        if p and r:
+            return {"padded": float(p), "ragged": float(r)}
+    except Exception:  # noqa: BLE001 — telemetry-grade, never fatal
+        pass
+    return None
 
 
 def _ledger_link_rate() -> Optional[float]:
@@ -190,6 +277,7 @@ class PassExecutor:
         self.chunk_rows = plan["chunk_rows"]
         self.prefetch_depth = plan["prefetch_depth"]
         self.donate = plan["donate"]
+        self.layout = plan.get("layout", "padded")
         self.sync_every = max(int(sync_every), 1)
         self._shapes: set = set()
         self._lock = threading.Lock()   # pad_rows runs on pipelined
@@ -201,16 +289,33 @@ class PassExecutor:
 
     # -- shape bucketing ---------------------------------------------------
 
-    def pad_rows(self, rows: int, len_b: Optional[int] = None) -> int:
+    def pad_rows(self, rows: int, len_b: Optional[int] = None,
+                 max_len: Optional[int] = None) -> int:
         """Canonical row bucket for a chunk (ladder rung); records pad
-        waste and first-sighting-of-a-shape telemetry."""
+        waste and first-sighting-of-a-shape telemetry.  ``max_len`` (the
+        chunk's true longest read) adds the length-axis waste sample
+        against the ``len_b`` bucket — the lane half of the pad tax."""
         bucket = pad_rows_for(rows, self.ladder)
-        obs.pad_waste(self.pass_name, rows, bucket)
+        obs.pad_waste(self.pass_name, rows, bucket,
+                      max_len=max_len, padded_len=len_b)
         if bucket > 0:
             self._parent._note_waste(self.pass_name,
                                      (bucket - rows) / bucket)
         self.note_shape(bucket, len_b)
         return bucket
+
+    def note_ragged(self, rows: int, capacity: int) -> None:
+        """Ragged-layout accounting for one fixed-capacity dispatch:
+        ``rows`` live rows below the prefix-sum bound, ``capacity`` the
+        buffer's compiled row count.  Waste collapses to the final
+        partial buffer instead of every chunk's rung slack — recorded
+        through the same ``pad_waste_frac`` series so padded and ragged
+        runs compare on one metric."""
+        obs.pad_waste(self.pass_name, rows, capacity)
+        if capacity > 0:
+            self._parent._note_waste(self.pass_name,
+                                     (capacity - rows) / capacity)
+        self.note_shape(capacity, None)
 
     def note_shape(self, rows_bucket: int,
                    len_b: Optional[int] = None) -> None:
@@ -321,6 +426,7 @@ class StreamExecutor:
                  ladder_base: Optional[float] = None,
                  prefetch_depth: Optional[int] = None,
                  donate: Optional[bool] = None,
+                 ragged: Optional[bool] = None,
                  link_bytes_per_sec: Optional[float] = None,
                  retry_budget: Optional[int] = None):
         self.mesh_size = getattr(mesh, "size", None) or int(mesh or 1)
@@ -348,6 +454,12 @@ class StreamExecutor:
         if donate is None and env.get(DONATE_ENV) in ("0", "off"):
             donate = False
         self.donate = donate
+        # layout pin: the -ragged/-no_ragged flags win; ADAM_TPU_RAGGED
+        # fills an unset flag; None leaves the decision to evidence
+        if ragged is None:
+            self.layout_pin = resolve_ragged_env(env.get(RAGGED_ENV))
+        else:
+            self.layout_pin = "ragged" if ragged else "padded"
         if link_bytes_per_sec is None and self.autotune and self.on_tpu:
             link_bytes_per_sec = _ledger_link_rate()
         self.link_bytes_per_sec = link_bytes_per_sec
@@ -380,11 +492,22 @@ class StreamExecutor:
 
     def begin_pass(self, pass_name: str, *,
                    bytes_per_row: Optional[float] = None,
+                   ragged_capable: bool = False,
                    sync_every: int = 1) -> PassExecutor:
         """Freeze the plan for one pass (the ONLY place decisions are
-        made — never mid-pass) and emit it through obs."""
+        made — never mid-pass) and emit it through obs.
+
+        ``ragged_capable=True`` opens the layout dimension: the pass has
+        a ragged kernel twin wired in for this run (the caller also
+        requires ``mesh_size == 1`` — ragged dispatches are unsharded,
+        so a multi-shard mesh always stays padded)."""
         if self._current is not None:
             self._current.finish()
+        capable = bool(ragged_capable) and self.mesh_size == 1
+        rates = None
+        if capable and self.layout_pin is None and self.autotune:
+            rates = ledger_ragged_rates(
+                _RAGGED_KERNEL_OF_PASS.get(pass_name, pass_name))
         plan = decide_plan(
             pass_name=pass_name, chunk_rows=self.chunk_rows,
             mesh_size=self.mesh_size, on_tpu=self.on_tpu,
@@ -392,7 +515,8 @@ class StreamExecutor:
             link_bytes_per_sec=self.link_bytes_per_sec,
             bytes_per_row=bytes_per_row, ladder_base=self.ladder_base,
             prefetch_depth=self.prefetch_depth, donate=self.donate,
-            autotune=self.autotune)
+            layout=self.layout_pin, ragged_capable=capable,
+            ragged_rates=rates, autotune=self.autotune)
         obs.registry().counter("executor_passes",
                                **{"pass": pass_name}).inc()
         obs.trace.instant(f"pass:{pass_name}",
@@ -402,7 +526,8 @@ class StreamExecutor:
                  chunk_rows=plan["chunk_rows"],
                  ladder=plan["ladder"], ladder_base=plan["ladder_base"],
                  prefetch_depth=plan["prefetch_depth"],
-                 donate=plan["donate"], reason=plan["reason"],
+                 donate=plan["donate"], layout=plan["layout"],
+                 reason=plan["reason"],
                  inputs=plan["inputs"],
                  input_digest=plan["input_digest"])
         pex = PassExecutor(self, plan, sync_every)

@@ -91,8 +91,13 @@ def streaming_flagstat(path: str, *, mesh=None, chunk_rows: int = 1 << 22,
     # periodic int64 fold both bounds the in-flight queue and keeps the
     # int32 accumulation window small regardless of file size.
     pex = ex.begin_pass("flagstat", bytes_per_row=4.0,
+                        ragged_capable=True,
                         sync_every=8 if on_tpu else 1)
-    if impl == "pallas" or (impl == "auto" and on_tpu):
+    use_pallas = impl == "pallas" or (impl == "auto" and on_tpu)
+    ragged_mode = pex.layout == "ragged"
+    if ragged_mode:
+        kernel = None           # ragged dispatches are unsharded
+    elif use_pallas:
         from ..ops.flagstat_pallas import flagstat_wire32_sharded_pallas
         kernel = flagstat_wire32_sharded_pallas(mesh,
                                                 interpret=not on_tpu,
@@ -184,16 +189,104 @@ def streaming_flagstat(path: str, *, mesh=None, chunk_rows: int = 1 << 22,
             fallback=lambda e: _host_cpu_counts(padded))
         return np.asarray(counts).astype(np.int64)
 
-    for rows, wire_host, wire_dev in pex.feed(wire_chunks, _pad_put):
-        t_chunk = _time.perf_counter()
+    # -- ragged layout: fixed-capacity concat buffers, prefix-sum bound --
+    # Chunks concatenate into ONE compiled buffer shape (the plan's top
+    # rung); validity is positional (docs/ARCHITECTURE.md §6g), so the
+    # slack past each buffer's total is garbage the kernel never reads
+    # and the per-chunk rung padding — the pad tax — is gone.  Counters
+    # are an exact integer monoid over reads, so any re-chunking of the
+    # stream is byte-identical to the padded walk.
+    def _rag_host_counts(buf, total):
+        from ..ops.flagstat_pallas import flagstat_wire32_ragged_xla
+        with jax.default_device(jax.devices("cpu")[0]):
+            return np.asarray(flagstat_wire32_ragged_xla(
+                buf, np.array([0, total], np.int32))).astype(np.int64)
+
+    def _rag_dispatch(dev_or_host, total, attempt):
+        from ..ops.flagstat_pallas import flagstat_ragged_dispatch
+        arr = dev_or_host if attempt == 1 else \
+            jax.device_put(dev_or_host, sharding)
+        return flagstat_ragged_dispatch(
+            arr, total, interpret=use_pallas and not on_tpu,
+            use_pallas=use_pallas)
+
+    def _rag_sub(vw):
+        # pad the half up to a ladder rung (zero slack sits past the
+        # positional bound anyway) — exact-length sub-buffers would
+        # mint a fresh compiled shape per split, compounding the OOM
+        # the split is recovering from
+        padded = _pad_wire(vw)
         counts = pex.dispatch(
-            "count",
-            lambda attempt, dev=wire_dev, host=wire_host:
-                kernel(dev) if attempt == 1
-                else kernel(jax.device_put(host, sharding)),
-            split=lambda e, host=wire_host, r=rows:
-                _split_halves(host[:r], e),
-            fallback=lambda e, host=wire_host: _host_cpu_counts(host))
+            "count-split",
+            lambda attempt: _rag_dispatch(
+                jax.device_put(padded, sharding), len(vw), 1),
+            split=lambda e: _rag_split(vw, e),
+            fallback=lambda e: _rag_host_counts(padded, len(vw)))
+        return np.asarray(counts).astype(np.int64)
+
+    def _rag_split(vw, err):
+        if len(vw) <= 1:
+            raise err
+        mid = len(vw) // 2
+        return _rag_sub(vw[:mid]) + _rag_sub(vw[mid:])
+
+    def _rag_buffers(chunks):
+        cap = pex.chunk_rows
+        parts: list = []
+        have = 0
+        for w in chunks:
+            w = np.asarray(w, np.uint32)
+            while w.size:
+                take = min(cap - have, int(w.size))
+                parts.append(w[:take])
+                have += take
+                w = w[take:]
+                if have == cap:
+                    yield parts, have
+                    parts, have = [], 0
+        if have:
+            yield parts, have
+
+    def _rag_put(item):
+        parts, total = item
+        cap = pex.chunk_rows
+        # slack past ``total`` stays unwritten: the kernels' positional
+        # bound (the row-offset prefix sum) is what excludes it
+        buf = np.empty(cap, np.uint32)
+        off = 0
+        for p in parts:
+            buf[off:off + len(p)] = p
+            off += len(p)
+        dev = pex.dispatch_put(
+            "wire", lambda attempt: jax.device_put(buf, sharding))
+        return total, buf, dev
+
+    if ragged_mode:
+        fed = pex.feed(_rag_buffers(wire_chunks), _rag_put)
+    else:
+        fed = pex.feed(wire_chunks, _pad_put)
+    for rows, wire_host, wire_dev in fed:
+        t_chunk = _time.perf_counter()
+        if ragged_mode:
+            pex.note_ragged(rows, pex.chunk_rows)
+            counts = pex.dispatch(
+                "count",
+                lambda attempt, dev=wire_dev, host=wire_host, t=rows:
+                    _rag_dispatch(dev if attempt == 1 else host, t,
+                                  attempt),
+                split=lambda e, host=wire_host, t=rows:
+                    _rag_split(host[:t], e),
+                fallback=lambda e, host=wire_host, t=rows:
+                    _rag_host_counts(host, t))
+        else:
+            counts = pex.dispatch(
+                "count",
+                lambda attempt, dev=wire_dev, host=wire_host:
+                    kernel(dev) if attempt == 1
+                    else kernel(jax.device_put(host, sharding)),
+                split=lambda e, host=wire_host, r=rows:
+                    _split_halves(host[:r], e),
+                fallback=lambda e, host=wire_host: _host_cpu_counts(host))
         del wire_dev            # donated on TPU: consumed by the kernel
         if isinstance(counts, np.ndarray):
             # a split/degraded chunk returns host counters — fold them
@@ -726,7 +819,9 @@ def _packed_chunks(chunk_iter, pex, io_threads: int,
     def work(table, _ctx):
         if not want_pack:
             return table, None
-        padded = pex.pad_rows(table.num_rows, bucket_len)
+        padded = pex.pad_rows(table.num_rows, bucket_len,
+                              max_len=_chunk_max_len(table)
+                              if bucket_len else None)
         return table, pack_reads(
             table, pad_rows_to=padded, bucket_len=bucket_len)
 
@@ -747,6 +842,26 @@ def _packed_chunks(chunk_iter, pex, io_threads: int,
         yield out
 
 
+def _chunk_max_len(table: pa.Table):
+    """The chunk's true longest read (for the length-axis pad-waste
+    sample against the bucket) — one vectorized Arrow pass; None when
+    the projection carries no base-level column.  Best-effort telemetry,
+    never fatal."""
+    try:
+        import pyarrow.compute as pc
+
+        from ..io.wirespill import WIRE_SEQ_LEN, is_wire_table
+        if is_wire_table(table):
+            v = pc.max(table.column(WIRE_SEQ_LEN)).as_py()
+        elif "sequence" in table.column_names:
+            v = pc.max(pc.binary_length(table.column("sequence"))).as_py()
+        else:
+            return None
+        return int(v) if v is not None else None
+    except Exception:  # noqa: BLE001 — telemetry-grade
+        return None
+
+
 def _project_batch(batch, keep: tuple):
     """None out columns a pass's kernels never touch before the device
     feed ships the batch — the projection-to-the-bit discipline applied
@@ -764,7 +879,17 @@ _P1_DEV_COLS = ("flags", "start", "cigar_ops", "cigar_lens", "n_cigar",
                 "quals")
 _P2_DEV_COLS = ("flags", "start", "read_group", "read_len", "bases",
                 "quals", "cigar_ops", "cigar_lens")
+#: the ragged count rebuilds FLAT planes from the host batch
+#: (recalibrate._count_tables_one), so pre-shipping the padded [N, L]
+#: base/qual planes would transfer exactly the pad-tax bytes the layout
+#: removes; mismatch_state's geometry columns still ride the feed
+_P2_DEV_COLS_RAGGED = ("flags", "start", "read_group", "read_len",
+                       "cigar_ops", "cigar_lens")
 _P3_DEV_COLS = ("flags", "read_group", "read_len", "bases", "quals")
+
+
+def _p2_dev_cols(pex) -> tuple:
+    return _P2_DEV_COLS if pex.layout == "padded" else _P2_DEV_COLS_RAGGED
 
 
 def _feed_packed(chunk_iter, pex, io_threads: int, pack_reads,
@@ -1156,12 +1281,13 @@ def streaming_transform(input_path: str, output_path: str, *,
             # every chunk keeps the stage report attribution exact.
             pex2 = ex.begin_pass(
                 "p2", bytes_per_row=2.0 * max(bucket_len, 1) + 64.0,
+                ragged_capable=True,
                 sync_every=4 if is_tpu_backend() else 1)
             rt = _count_stream(
                 pex2,
                 _feed_packed(reread(pex2.chunk_rows, io_pass="p2"),
                              pex2, io_threads, pack_reads, bucket_len,
-                             timed_chunks, mesh, _P2_DEV_COLS,
+                             timed_chunks, mesh, _p2_dev_cols(pex2),
                              feed_wait=waited),
                 snp_table=snp_table, n_rg_run=max(max_rgid + 1, 1),
                 bucket_len=bucket_len, mesh=mesh)
@@ -1376,7 +1502,7 @@ def _count_stream(pex, fed_iter, *, snp_table, n_rg_run, bucket_len,
                         mesh=mesh,
                         device_batch=d if attempt == 1 else None,
                         donate=pex.donate and attempt == 1,
-                        md_info=mi),
+                        md_info=mi, layout=pex.layout),
                 fallback=lambda e, t=table, b=batch, mi=md_info:
                     cpu_fallback(t, b, mi))
             if isinstance(out[0], np.ndarray):
@@ -1787,6 +1913,7 @@ def _fused_count_pass(*, ex, workdir, raw_path, plan, mesh, snp_table,
     wire = plan["wire_spill"]
     pex2 = ex.begin_pass(
         "s2", bytes_per_row=2.0 * max(bucket_len, 1) + 64.0,
+        ragged_capable=True,
         sync_every=4 if is_tpu_backend() else 1)
     scalar_cols = ["flags", "start", "recordGroupId", "cigar"]
     if snp_table is not None:
@@ -1837,7 +1964,7 @@ def _fused_count_pass(*, ex, workdir, raw_path, plan, mesh, snp_table,
     return _count_stream(
         pex2,
         _feed_packed(s2_chunks(), pex2, io_threads, pack_fn, bucket_len,
-                     _timed_chunks, mesh, _P2_DEV_COLS,
+                     _timed_chunks, mesh, _p2_dev_cols(pex2),
                      feed_wait=_feed_wait),
         snp_table=snp_table, n_rg_run=max(max_rgid + 1, 1),
         bucket_len=bucket_len, mesh=mesh,

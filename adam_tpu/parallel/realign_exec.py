@@ -75,20 +75,45 @@ MAX_REALIGN_DEPTH = 16
 def decide_realign_plan(*, n_bins: int, on_tpu: bool,
                         pipeline: Optional[bool] = None,
                         depth: Optional[int] = None,
-                        donate: Optional[bool] = None) -> dict:
+                        donate: Optional[bool] = None,
+                        layout: Optional[str] = None,
+                        ragged_rates: Optional[dict] = None) -> dict:
     """The pass-4 plan: one frozen decision per transform run.
 
     PURE — the returned plan is a deterministic function of the keyword
     inputs, which the ``realign_plan_selected`` event records in full
     (``inputs`` + ``input_digest``), the same replayable-decision
     contract as ``executor.decide_plan``.  Explicit ``pipeline`` /
-    ``depth`` / ``donate`` pin those knobs.
+    ``depth`` / ``donate`` / ``layout`` pin those knobs.
+
+    ``layout`` picks the sweep dispatch form: ``padded`` buckets jobs on
+    all four (R, L, CL, G) axes; ``ragged`` concatenates reads across
+    jobs and buckets only on the (CL, G) rungs (docs/ARCHITECTURE.md
+    §6g).  Unpinned, the decision follows the bench ``ragged_race``
+    evidence the same way ``executor.decide_plan`` does — padded stays
+    the no-evidence default.
     """
     inputs = dict(n_bins=int(n_bins), on_tpu=bool(on_tpu),
                   pipeline=None if pipeline is None else bool(pipeline),
                   depth=None if depth is None else int(depth),
-                  donate=None if donate is None else bool(donate))
+                  donate=None if donate is None else bool(donate),
+                  layout=layout,
+                  ragged_rates=None if not ragged_rates else {
+                      k: round(float(v), 1)
+                      for k, v in sorted(ragged_rates.items())})
     reasons = []
+    lay = "padded"
+    if inputs["layout"] == "ragged":
+        lay = "ragged"
+        reasons.append("layout-pinned-ragged")
+    elif inputs["layout"] == "padded":
+        reasons.append("layout-pinned-padded")
+    elif inputs["ragged_rates"]:
+        rr = inputs["ragged_rates"]
+        if rr.get("ragged", 0) > rr.get("padded", 0) > 0:
+            lay = "ragged"
+            reasons.append(
+                f"ragged-evidence {rr['ragged']:.0f}>{rr['padded']:.0f}")
     use = True if inputs["pipeline"] is None else inputs["pipeline"]
     d = DEFAULT_REALIGN_DEPTH if inputs["depth"] is None else inputs["depth"]
     if d > MAX_REALIGN_DEPTH:
@@ -108,14 +133,18 @@ def decide_realign_plan(*, n_bins: int, on_tpu: bool,
         else inputs["donate"]
     digest = hashlib.sha256(
         json.dumps(inputs, sort_keys=True).encode()).hexdigest()[:16]
-    return dict(pipeline_depth=int(d), donate=do_donate,
+    return dict(pipeline_depth=int(d), donate=do_donate, layout=lay,
                 reason=";".join(reasons) or "default",
                 inputs=inputs, input_digest=digest)
 
 
 def resolve_realign_opts(opts: Optional[dict] = None) -> dict:
-    """CLI flags win; ``ADAM_TPU_REALIGN_*`` envs fill whatever the caller
-    left unset (the executor's flag/env convention)."""
+    """CLI flags win; ``ADAM_TPU_REALIGN_*`` (and the shared
+    ``ADAM_TPU_RAGGED``) envs fill whatever the caller left unset (the
+    executor's flag/env convention).  An unpinned layout pulls the
+    raced bench evidence for the realign sweep from the PR 2 ledger."""
+    from .executor import RAGGED_ENV, ledger_ragged_rates, resolve_ragged_env
+
     out = dict(opts or {})
     env = os.environ
     if "pipeline" not in out and env.get(REALIGN_PIPELINE_ENV):
@@ -127,6 +156,13 @@ def resolve_realign_opts(opts: Optional[dict] = None) -> dict:
             pass
     if "donate" not in out and env.get(REALIGN_DONATE_ENV) in ("0", "off"):
         out["donate"] = False
+    if out.get("layout") is None:
+        out["layout"] = resolve_ragged_env(env.get(RAGGED_ENV))
+    if out["layout"] is None:
+        out.pop("layout")
+        rates = ledger_ragged_rates("realign")
+        if rates:
+            out["ragged_rates"] = rates
     return out
 
 
@@ -136,6 +172,7 @@ def emit_realign_plan(plan: dict) -> None:
     obs.registry().counter("realign_plans").inc()
     obs.emit("realign_plan_selected",
              pipeline_depth=plan["pipeline_depth"], donate=plan["donate"],
+             layout=plan.get("layout", "padded"),
              reason=plan["reason"], inputs=plan["inputs"],
              input_digest=plan["input_digest"])
 
@@ -170,17 +207,27 @@ class CrossBinSweepBatcher:
     change scheduling and telemetry but never a byte of output.
     """
 
-    def __init__(self, donate: bool = False, retry_policy=None):
+    def __init__(self, donate: bool = False, retry_policy=None,
+                 layout: str = "padded"):
         self._donate = donate
+        self._layout = layout
         # the caller's resolved policy (the -retry_budget flag plumbed
         # through StreamExecutor) wins; standalone use falls back to env
         self._retry = retry_policy or resolve_retry_policy()
         self._lock = threading.Lock()
-        self._buckets: Dict[tuple, list] = {}     # shape -> [(uid, si, ji)]
+        self._buckets: Dict[tuple, list] = {}     # key -> [(uid, si, ji)]
         self._states: Dict[tuple, list] = {}      # uid -> states
         self._results: Dict[tuple, tuple] = {}    # (uid,si,ji) -> (chunk,g)
-        self._unit_shapes: Dict[tuple, set] = {}  # uid -> undispatched shapes
+        self._unit_shapes: Dict[tuple, set] = {}  # uid -> undispatched keys
         self._shapes_seen: set = set()            # (G, R, L, CL) sightings
+
+    def _key(self, job) -> tuple:
+        """Bucket key: the full padded (R, L, CL) shape, or — ragged —
+        the CL rung alone: concatenated reads make R and L per-dispatch
+        totals instead of per-job shape axes, so only the consensus
+        rung (and the padded lane count G) remain compiled axes."""
+        return job.shape if self._layout == "padded" \
+            else (job.shape[2],)
 
     # -- producer side (prep workers) --------------------------------------
 
@@ -192,9 +239,10 @@ class CrossBinSweepBatcher:
             shapes = self._unit_shapes.setdefault(uid, set())
             for si, st in enumerate(states):
                 for ji, job in enumerate(st.jobs):
-                    self._buckets.setdefault(job.shape, []).append(
+                    key = self._key(job)
+                    self._buckets.setdefault(key, []).append(
                         (uid, si, ji))
-                    shapes.add(job.shape)
+                    shapes.add(key)
 
     # -- scheduler side (strict unit order) --------------------------------
 
@@ -222,6 +270,19 @@ class CrossBinSweepBatcher:
         return out
 
     def _dispatch(self, shape: tuple, members: list) -> None:
+        if self._layout == "ragged":
+            # chunk by cumulative flat bases so the [T, CLp] working set
+            # stays under budget (realigner.ragged_chunk_jobs)
+            t_of = [int(self._states[u][si].lens.sum())
+                    for u, si, _ in members]
+            splits = R.ragged_chunk_jobs(t_of, shape[0]) + [len(members)]
+            lo = 0
+            for hi in splits:
+                if hi > lo:
+                    self._dispatch_chunk_ragged(shape[0],
+                                                members[lo:hi])
+                lo = hi
+            return
         Rr, L, CL = shape
         g_max = R._sweep_g_max(Rr, L, CL)
         for lo in range(0, len(members), g_max):
@@ -276,13 +337,85 @@ class CrossBinSweepBatcher:
         if (G, Rr, L, CL) not in self._shapes_seen:
             self._shapes_seen.add((G, Rr, L, CL))
             r.counter("realign_shapes").inc()
+        # per-axis pad-waste breakdown: the measured justification for
+        # the layout decision (docs/OBSERVABILITY.md) — fraction of each
+        # padded axis spent on slack, on THIS dispatch's true geometry
+        true_r = [len(self._states[u][si].reads_to_clean)
+                  for u, si, _ in chunk]
+        true_b = [int(self._states[u][si].lens.sum())
+                  for u, si, _ in chunk]
+        true_cl = [self._states[u][si].jobs[ji].cons_len
+                   for u, si, ji in chunk]
         obs.emit("realign_sweep_dispatch", shape=[Rr, L, CL],
                  jobs=len(chunk), g=G,
-                 units=len({u for u, _, _ in chunk}))
+                 units=len({u for u, _, _ in chunk}),
+                 layout="padded",
+                 waste_r=round(1 - sum(true_r) / (len(chunk) * Rr), 4),
+                 waste_l=round(1 - sum(true_b) /
+                               max(sum(true_r) * L, 1), 4),
+                 waste_cl=round(1 - sum(true_cl) /
+                                (len(chunk) * CL), 4),
+                 waste_g=round(1 - len(chunk) / G, 4))
+
+    def _dispatch_chunk_ragged(self, cl: int, chunk: list) -> None:
+        """One RAGGED device sweep batch: jobs share only the CL rung;
+        reads concatenate at true (R, L) through the prefix-sum row
+        index (realigner.sweep_dispatch_ragged).  Same retry discipline
+        as the padded dispatch — lanes/rows are independent, so a
+        half-split changes scheduling, never a byte."""
+        pairs = [(self._states[u][si], self._states[u][si].jobs[ji])
+                 for u, si, ji in chunk]
+
+        def fn(attempt):
+            return R.sweep_dispatch_ragged(pairs, donate=self._donate
+                                           and attempt == 1)
+
+        def split(err):
+            if len(chunk) <= 1:
+                raise err
+            mid = (len(chunk) + 1) // 2
+            self._dispatch_chunk_ragged(cl, chunk[:mid])
+            self._dispatch_chunk_ragged(cl, chunk[mid:])
+            return None
+
+        with obs.trace.span("realign:sweep", cat="dispatch",
+                            args={"shape": [cl], "jobs": len(chunk),
+                                  "layout": "ragged"}):
+            out = dispatch_with_retry(fn, site="device_dispatch",
+                                      label="realign:sweep",
+                                      policy=self._retry, split=split)
+        if out is None:
+            return
+        q, o, spans, stats = out
+        cr = _ChunkResult(q, o)
+        for key, span in zip(chunk, spans):
+            self._results[key] = (cr, span)
+        r = obs.registry()
+        r.counter("realign_sweep_dispatches").inc()
+        r.counter("realign_sweep_jobs").inc(len(chunk))
+        sig = (stats["g"], stats["rows_pad"], stats["bases_pad"], cl)
+        if sig not in self._shapes_seen:
+            self._shapes_seen.add(sig)
+            r.counter("realign_shapes").inc()
+        obs.emit("realign_sweep_dispatch",
+                 shape=[stats["rows_pad"], stats["bases_pad"], cl],
+                 jobs=len(chunk), g=stats["g"],
+                 units=len({u for u, _, _ in chunk}),
+                 layout="ragged",
+                 waste_r=round(1 - stats["rows"] /
+                               max(stats["rows_pad"], 1), 4),
+                 waste_l=round(1 - stats["bases"] /
+                               max(stats["bases_pad"], 1), 4),
+                 waste_cl=round(1 - stats["cons_true"] /
+                                max(len(chunk) * cl, 1), 4),
+                 waste_g=round(1 - len(chunk) / stats["g"], 4))
 
     def _take(self, uid: tuple, si: int, ji: int):
         cr, g = self._results.pop((uid, si, ji))
         qs, os_ = cr.arrays()
+        if isinstance(g, tuple):        # ragged: a (lo, hi) row span
+            lo, hi = g
+            return qs[lo:hi], os_[lo:hi]
         return qs[g], os_[g]
 
     @property
@@ -313,8 +446,9 @@ class RealignEngine:
     def __init__(self, plan: dict, retry_policy=None):
         self.plan = plan
         self.depth = int(plan["pipeline_depth"])
-        self.batcher = CrossBinSweepBatcher(donate=bool(plan["donate"]),
-                                            retry_policy=retry_policy)
+        self.batcher = CrossBinSweepBatcher(
+            donate=bool(plan["donate"]), retry_policy=retry_policy,
+            layout=plan.get("layout", "padded"))
 
     def run(self, units: Iterable[BinUnitDesc],
             emit: Callable[[pa.Table, int], None], sort: bool) -> int:

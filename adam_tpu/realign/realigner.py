@@ -228,6 +228,196 @@ def _sweep_many(reads_b, quals_b, lens_b, cons_b, clen_b,
               jnp.asarray(clen_b))
 
 
+# ---------------------------------------------------------------------------
+# ragged sweep: concatenated reads across jobs, (CL, G)-only bucketing
+# ---------------------------------------------------------------------------
+
+@partial(jax.jit, static_argnames=("cl_pad",))
+def _sweep_ragged_impl(base_flat, w_flat, row_of, pos_of, job_of_row,
+                       read_len_r, cons_flat, cons_len_g, cl_pad):
+    """The consensus sweep over the RAGGED layout — the XLA segment-sum
+    formulation (the off-TPU product path; sweep_pallas.sweep_pallas_ragged
+    is the Mosaic twin).
+
+    Reads from MANY (group, consensus) jobs concatenate into flat [T]
+    base/weight planes with a prefix-sum row index (``row_of``/
+    ``pos_of``); each read row maps to its job's consensus through
+    ``job_of_row``.  score[r, o] = sum over the read's bases of
+    w * [base != cons[job(r), o + pos]] — one [T, CLp] gather+compare,
+    then ONE segment_sum over the row index.  No (R, L) padding exists:
+    compiled shapes depend only on the flat-plane rung, the row rung,
+    and the (CL, G) rungs — the four-axis pad tax of the padded batch
+    collapses to rung slack on the two concatenated totals.
+
+    Integer scores, BIG at inadmissible offsets, argmin tie-break to the
+    lowest offset: exactly the padded kernels' semantics, so per-job
+    results are bit-identical to :func:`_sweep_conv` / sweep_pallas on
+    any real sequence (raw-byte comparison, the pallas kernel's rule).
+    """
+    offs = jnp.arange(cl_pad, dtype=jnp.int32)
+    cidx = job_of_row[row_of] * cl_pad + pos_of               # [T]
+    idx = jnp.clip(cidx[:, None] + offs[None, :], 0,
+                   cons_flat.shape[0] - 1)                    # [T, CLp]
+    mm = (base_flat[:, None] != cons_flat[idx]).astype(jnp.int32)
+    contrib = mm * w_flat[:, None]
+    scores = jax.ops.segment_sum(contrib, row_of,
+                                 num_segments=read_len_r.shape[0])
+    valid = offs[None, :] < (cons_len_g[job_of_row][:, None]
+                             - read_len_r[:, None])
+    scores = jnp.where(valid, scores, BIG)
+    best_o = jnp.argmin(scores, axis=1)
+    best_q = jnp.take_along_axis(scores, best_o[:, None], 1)[:, 0]
+    return best_q, best_o
+
+
+def _sweep_ragged_xla(base_flat, w_flat, row_of, pos_of, job_of_row,
+                      read_len_r, cons_b, cons_len_g):
+    """Wrapper flattening the [G, CLp] consensus block for the jitted
+    impl (cl_pad must be a concrete int for the index arithmetic)."""
+    G, CLp = cons_b.shape
+    return _sweep_ragged_impl(
+        jnp.asarray(base_flat), jnp.asarray(w_flat), jnp.asarray(row_of),
+        jnp.asarray(pos_of), jnp.asarray(job_of_row),
+        jnp.asarray(read_len_r), jnp.asarray(cons_b).reshape(-1),
+        jnp.asarray(cons_len_g), cl_pad=CLp)
+
+
+#: flat-plane rung multiple for the ragged sweep (lane-aligned); row
+#: rung multiple matches the padded R rung's 32
+_RAGGED_T_MULT = 2048
+_RAGGED_R_MULT = 32
+
+
+def sweep_dispatch_ragged(pairs: List[Tuple["_GroupState", "_SweepJob"]],
+                          donate: bool = False):
+    """One RAGGED device dispatch over (group, consensus) jobs sharing a
+    CL rung — the counterpart of :func:`sweep_dispatch` that needs no
+    shared (R, L): each job contributes its group's TRUE rows at TRUE
+    lengths to the concatenated planes.
+
+    Returns ``[(q, o)]`` numpy pairs per job (true row count each) —
+    exactly what ``_finish_group`` consumes, bit-identical to the padded
+    dispatch's per-job lanes.  ``donate`` is accepted for signature
+    parity; the flat planes are rebuilt per dispatch, so donation buys
+    nothing here (the plan's donate knob stays a padded-path lever).
+    """
+    CL = pairs[0][1].shape[2]
+    assert all(job.shape[2] == CL for _, job in pairs), "one CL rung"
+    n_rows = [len(st.reads_to_clean) for st, _ in pairs]
+    t_rows = [int(st.lens[:r].sum()) for (st, _), r in zip(pairs, n_rows)]
+    Rt = sum(n_rows)
+    T = sum(t_rows)
+    G = 1 << max(len(pairs) - 1, 0).bit_length()
+    CLp = CL
+
+    # shared (cheap) geometry: per-row job map, true lengths, consensus
+    # block — slack rows sweep nothing (read_len = CL leaves no
+    # admissible offset, the padded kernels' own pad-row rule).  The
+    # XLA branch pads rows/bases to its own rungs; the row-structured
+    # Mosaic branch pads (8, 128)-tile geometry inside
+    # sweep_pallas_ragged — stats report whichever geometry THIS
+    # dispatch actually allocated (the realign_sweep_dispatch event's
+    # honesty contract).
+    Rp = shape_rung(max(Rt, 1), _RAGGED_R_MULT)
+    job_of_row = np.zeros(Rp, np.int32)
+    read_len_r = np.full(Rp, CL, np.int32)
+    cons_b = np.zeros((G, CLp), np.int32)
+    cons_len_g = np.zeros(G, np.int32)
+    r0 = 0
+    spans = []
+    for g, ((st, job), nr) in enumerate(zip(pairs, n_rows)):
+        job_of_row[r0:r0 + nr] = g
+        read_len_r[r0:r0 + nr] = st.lens[:nr]
+        cons_b[g, :len(job.cons_u8)] = job.cons_u8.astype(np.int32)
+        cons_len_g[g] = job.cons_len
+        spans.append((r0, r0 + nr))
+        r0 += nr
+    # padded job lanes replicate lane 0 (no garbage consensus swept)
+    cons_b[len(pairs):] = cons_b[0]
+    cons_len_g[len(pairs):] = cons_len_g[0]
+
+    if _sweep_backend() == "pallas":
+        from .sweep_pallas import sweep_pallas_ragged
+        # row-structured form for Mosaic: [Rt, Lmax] planes + a per-row
+        # consensus gather (same values, kernel-friendly layout); the
+        # flat planes below are the XLA branch's and are never built
+        # here — each branch pays only its own layout's host prep
+        Lmax = max((int(st.lens[:nr].max(initial=1))
+                    for (st, _), nr in zip(pairs, n_rows)), default=1)
+        reads_rows = np.zeros((Rt, Lmax), np.int32)
+        w_rows = np.zeros((Rt, Lmax), np.int32)
+        r0 = 0
+        for (st, _), nr in zip(pairs, n_rows):
+            W = min(st.reads_u8.shape[1], Lmax)
+            reads_rows[r0:r0 + nr, :W] = st.reads_u8[:nr, :W]
+            w_rows[r0:r0 + nr, :W] = st.quals_arr[:nr, :W]
+            r0 += nr
+        lane = np.arange(Lmax, dtype=np.int32)[None, :]
+        w_rows = np.where(lane < read_len_r[:Rt, None], w_rows, 0)
+        q, o = sweep_pallas_ragged(
+            reads_rows, w_rows, read_len_r[:Rt],
+            cons_b[job_of_row[:Rt]], cons_len_g[job_of_row[:Rt]])
+        # the Mosaic kernel's own tile geometry (sweep_pallas_ragged
+        # pads to 8 sublanes x 128 lanes), not the XLA branch's rungs
+        rows_pad = -(-max(Rt, 8) // 8) * 8
+        bases_pad = rows_pad * (-(-max(Lmax, 128) // 128) * 128)
+    else:
+        rows_pad = Rp
+        bases_pad = Tp = shape_rung(max(T, 1), _RAGGED_T_MULT)
+        base_flat = np.zeros(Tp, np.int32)
+        w_flat = np.zeros(Tp, np.int32)
+        row_of = np.zeros(Tp, np.int32)
+        pos_of = np.zeros(Tp, np.int32)
+        r0 = t0 = 0
+        for (st, _), nr, tr in zip(pairs, n_rows, t_rows):
+            lens = st.lens[:nr].astype(np.int64)
+            mask = np.arange(st.reads_u8.shape[1])[None, :] < \
+                lens[:, None]
+            base_flat[t0:t0 + tr] = st.reads_u8[:nr][mask]
+            w_flat[t0:t0 + tr] = st.quals_arr[:nr][mask]
+            row_of[t0:t0 + tr] = r0 + np.repeat(np.arange(nr), lens)
+            pos_of[t0:t0 + tr] = _pos_within(lens)
+            r0 += nr
+            t0 += tr
+        q, o = _sweep_ragged_xla(base_flat, w_flat, row_of, pos_of,
+                                 job_of_row, read_len_r, cons_b,
+                                 cons_len_g)
+        q, o = q[:Rt], o[:Rt]
+    stats = dict(rows=Rt, rows_pad=rows_pad, bases=T, bases_pad=bases_pad,
+                 g=G, cl=CLp,
+                 cons_true=int(cons_len_g[:len(pairs)].sum()))
+    return np.asarray(q), np.asarray(o), spans, stats
+
+
+def _pos_within(lens: np.ndarray) -> np.ndarray:
+    """0..len_i-1 per read, concatenated (int32) — the shared
+    prefix-sum walk primitive, narrowed for the device planes."""
+    from ..packing import _ranges_within
+
+    return _ranges_within(lens).astype(np.int32)
+
+
+#: per-dispatch budget for the ragged sweep's [T, CLp] working set (the
+#: gather/compare intermediate, int32) — the analogue of
+#: _SWEEP_BATCH_BUDGET for the flat formulation
+_RAGGED_SWEEP_BUDGET = 128 << 20
+
+
+def ragged_chunk_jobs(members_t: List[int], cl_pad: int) -> List[int]:
+    """Split points for a ragged bucket's member list: cumulative flat
+    bases are bounded so the [T, CLp] int32 working set stays under
+    budget (always at least one member per chunk)."""
+    cap = max(_RAGGED_SWEEP_BUDGET // (4 * max(cl_pad, 1)), 1)
+    splits = []
+    acc = 0
+    for i, t in enumerate(members_t):
+        if acc and acc + t > cap:
+            splits.append(i)
+            acc = 0
+        acc += t
+    return splits
+
+
 @dataclass
 class _Read:
     """Host-side view of one read inside a target group."""

@@ -118,6 +118,90 @@ def sweep_pallas(reads_u8, quals, read_lens, cons_u8, cons_len, *,
     return bq[:R], bo[:R]
 
 
+# ---------------------------------------------------------------------------
+# ragged sweep: rows from MANY jobs in one block, per-row consensus
+# ---------------------------------------------------------------------------
+
+def _sweep_body_ragged(reads_ref, w_ref, lens_ref, cons_ref, conslen_ref,
+                       bestq_ref, besto_ref, *, n_offsets: int):
+    """The roll-sweep of :func:`_sweep_body` with a PER-ROW consensus:
+    rows belonging to different (group, consensus) jobs share one block
+    (concatenated along R at true counts — no per-job R rung), each row
+    scoring against its own job's consensus lane.  L pads once to the
+    dispatch-wide lane rung instead of per-job, so the batcher buckets
+    only on the (CL, G) rungs (docs/ARCHITECTURE.md §6g)."""
+    reads = reads_ref[:].astype(jnp.int32)          # [R, L]
+    w = w_ref[:]                                    # [R, L], pre-masked
+    lens = lens_ref[:]                              # [R, 1]
+    conslen = conslen_ref[:]                        # [R, 1]
+    cons = cons_ref[:].astype(jnp.int32)            # [R, CLp]
+    R, L = reads.shape
+    CLp = cons.shape[1]
+
+    def body(o, carry):
+        bq, bo, cons_c = carry
+        win = cons_c[:, :L]
+        mm = (reads != win).astype(jnp.int32)
+        s = jnp.sum(mm * w, axis=1, keepdims=True)
+        valid = o < (conslen - lens)
+        s = jnp.where(valid, s, BIG)
+        better = s < bq
+        return (jnp.where(better, s, bq), jnp.where(better, o, bo),
+                pltpu.roll(cons_c, shift=CLp - 1, axis=1))
+
+    init = (jnp.full((R, 1), BIG, jnp.int32), jnp.zeros((R, 1), jnp.int32),
+            cons)
+    bq, bo, _ = jax.lax.fori_loop(0, n_offsets, body, init)
+    bestq_ref[:] = bq
+    besto_ref[:] = bo
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _sweep_ragged_call(reads, w, lens, cons_rows, conslen, interpret=False):
+    R, L = reads.shape
+    CLp = cons_rows.shape[1]
+    kernel = functools.partial(_sweep_body_ragged, n_offsets=CLp - L)
+    bq, bo = pl.pallas_call(
+        kernel,
+        out_shape=(jax.ShapeDtypeStruct((R, 1), jnp.int32),
+                   jax.ShapeDtypeStruct((R, 1), jnp.int32)),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)] * 5,
+        out_specs=(pl.BlockSpec(memory_space=pltpu.VMEM),
+                   pl.BlockSpec(memory_space=pltpu.VMEM)),
+        interpret=interpret,
+    )(reads, w, lens, cons_rows, conslen)
+    return bq[:, 0], bo[:, 0]
+
+
+def sweep_pallas_ragged(reads_rows, w_rows, lens_rows, cons_rows,
+                        conslen_rows, *, interpret: bool = False):
+    """Ragged consensus sweep, Pallas-backed: ``reads_rows``/``w_rows``
+    [R, L] concatenate every job's TRUE rows (weights pre-masked past
+    each read's length), ``cons_rows`` [R, CLp] carries each row's own
+    consensus, ``lens_rows``/``conslen_rows`` [R] the true lengths.
+    Returns (best_quality [R], best_offset [R]) — bit-identical to the
+    XLA segment-sum form (realigner._sweep_ragged_xla)."""
+    R, L = reads_rows.shape
+    CLin = int(cons_rows.shape[1])
+    Rp = _round_up(max(R, 8), 8)
+    Lp = _round_up(max(L, 128), 128)
+    CLp = _round_up(max(CLin, Lp) + Lp, 128)
+    reads_p = jnp.zeros((Rp, Lp), jnp.int32).at[:R, :L].set(
+        jnp.asarray(reads_rows, jnp.int32))
+    w_p = jnp.zeros((Rp, Lp), jnp.int32).at[:R, :L].set(
+        jnp.asarray(w_rows, jnp.int32))
+    cons_p = jnp.zeros((Rp, CLp), jnp.int32).at[:R, :CLin].set(
+        jnp.asarray(cons_rows, jnp.int32))
+    # pad rows: no admissible offset (cons_len 0, read_len CLp)
+    lens_p = jnp.full((Rp, 1), CLp, jnp.int32).at[:R, 0].set(
+        jnp.asarray(lens_rows, jnp.int32))
+    conslen_p = jnp.zeros((Rp, 1), jnp.int32).at[:R, 0].set(
+        jnp.asarray(conslen_rows, jnp.int32))
+    bq, bo = _sweep_ragged_call(reads_p, w_p, lens_p, cons_p, conslen_p,
+                                interpret=interpret)
+    return bq[:R], bo[:R]
+
+
 @functools.partial(jax.jit, static_argnames=("interpret",))
 def _sweep_padded_batch(reads, w, lens, cons, cons_len, interpret=False):
     return jax.vmap(

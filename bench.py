@@ -886,6 +886,312 @@ def _stage_bqsr_race8(kind: str, is_tpu: bool):
     _emit("bqsr_race8", payload)
 
 
+def _ragged_realign_pairs(n_groups: int, skewed: bool, seed: int):
+    """Synthetic (group, consensus) sweep jobs.  ``skewed`` draws the
+    long-tailed geometry real targets show (many 1-3 read groups, wild
+    read-length and consensus-length spread) — the distribution where
+    4-axis padding burns the most cycles; uniform is the fixed-length
+    sequencer norm."""
+    import numpy as np
+
+    from adam_tpu.packing import shape_rung
+    from adam_tpu.realign import realigner as R
+
+    rng = np.random.RandomState(seed)
+    bases = np.frombuffer(b"ACGT", np.uint8)
+    pairs = []
+    for _ in range(n_groups):
+        if skewed:
+            nr = int(rng.choice([1, 1, 2, 2, 3, 4, 6, 10, 24],
+                                p=[.25, .2, .15, .1, .1, .08, .06,
+                                   .04, .02]))
+            lens = rng.randint(25, 150, nr)
+            cl = int(rng.randint(160, 500))
+        else:
+            nr = int(rng.choice([8, 12, 16]))
+            lens = np.full(nr, 100)
+            cl = 300
+        Rr = shape_rung(nr, 32)
+        L = shape_rung(int(lens.max()), 32)
+        reads_u8 = np.zeros((Rr, L), np.uint8)
+        quals = np.zeros((Rr, L), np.int32)
+        lens_p = np.zeros(Rr, np.int32)
+        for i, l in enumerate(lens):
+            reads_u8[i, :l] = bases[rng.randint(0, 4, l)]
+            quals[i, :l] = rng.randint(2, 41, l)
+            lens_p[i] = l
+        CL = shape_rung(max(cl, L + 1), 64)
+        cons = np.zeros(CL, np.uint8)
+        cons[:cl] = bases[rng.randint(0, 4, cl)]
+        job = R._SweepJob(None, cons, cl, (Rr, L, CL))
+        st = R._GroupState([None] * nr, "", 0, [0] * nr, 0,
+                           reads_u8, quals, lens_p, [job])
+        pairs.append((st, job))
+    return pairs
+
+
+def _stage_ragged_race(kind: str, is_tpu: bool):
+    """Race each ragged kernel against its padded twin (ISSUE 8) on a
+    uniform AND a length-skewed synthetic input, with a bit-identity
+    cross-check on every leg.  Three kernels: the flagstat wire sweep
+    (padded = per-chunk ladder-rung padding, ragged = fixed-capacity
+    concat + prefix-sum bound), the BQSR covariate count (padded planes
+    vs the flat per-read cycle walk) and the realign consensus sweep
+    (4-axis-padded shape buckets vs (CL, G)-only ragged concat).
+
+    The evidence keys the executor plans read
+    (``ragged_<kernel>_{padded,ragged}_per_sec`` —
+    executor.ledger_ragged_rates) carry the distribution where ragged
+    fares WORST, so evidence only flips the product default when the
+    ragged form wins on both shapes; per-distribution rates and sweep
+    walls ride alongside (``tools/bench_gate.py`` gates the committed
+    skewed realign walls at >= 20%)."""
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    # "backend" is the key Ledger.record_stages consults for the stage's
+    # actual platform (a flap window's probe may have run on TPU while
+    # this stage fell back to CPU — the record must say CPU, or
+    # ledger_ragged_rates' platform guard would let cross-platform
+    # evidence steer a layout)
+    payload: dict = {"backend": jax.default_backend()}
+    n_scale = float(os.environ.get("ADAM_TPU_BENCH_RAGGED_SCALE", "1"))
+
+    def timed_best(fn, runs=3):
+        best = None
+        for _ in range(runs):
+            t0 = time.perf_counter()
+            fn()
+            dt = time.perf_counter() - t0
+            best = dt if best is None else min(best, dt)
+        return best
+
+    pairs_of: dict = {}     # kernel -> {dist: (padded/s, ragged/s)}
+    matched: dict = {}      # kernel -> every leg bit-identical so far
+
+    def record(kernel, dist, per_unit, t_pad, t_rag, match):
+        payload[f"ragged_{kernel}_{dist}_padded_wall_s"] = round(t_pad, 4)
+        payload[f"ragged_{kernel}_{dist}_ragged_wall_s"] = round(t_rag, 4)
+        payload[f"ragged_{kernel}_{dist}_speedup"] = round(t_pad / t_rag, 3)
+        payload[f"ragged_{kernel}_{dist}_matches_padded"] = bool(match)
+        pairs_of.setdefault(kernel, {})[dist] = (per_unit / t_pad,
+                                                 per_unit / t_rag)
+        matched[kernel] = matched.get(kernel, True) and bool(match)
+
+    # ---- realign consensus sweep -------------------------------------
+    try:
+        from adam_tpu.realign import realigner as R
+
+        n_groups = max(int(120 * n_scale), 8)
+        for dist in ("uniform", "skewed"):
+            pairs = _ragged_realign_pairs(n_groups, dist == "skewed",
+                                          seed=13)
+            jobs = len(pairs)
+
+            def run_padded():
+                buckets: dict = {}
+                for p in pairs:
+                    buckets.setdefault(p[1].shape, []).append(p)
+                out = {}
+                for shape, members in buckets.items():
+                    g = R._sweep_g_max(*shape)
+                    for lo in range(0, len(members), g):
+                        chunk = members[lo:lo + g]
+                        q, o = R.sweep_dispatch(chunk)
+                        q, o = np.asarray(q), np.asarray(o)
+                        for gi, p in enumerate(chunk):
+                            out[id(p[0])] = (q[gi], o[gi])
+                return out
+
+            def run_ragged():
+                buckets: dict = {}
+                for p in pairs:
+                    buckets.setdefault(p[1].shape[2], []).append(p)
+                out = {}
+                for cl, members in buckets.items():
+                    t_of = [int(st.lens.sum()) for st, _ in members]
+                    splits = R.ragged_chunk_jobs(t_of, cl) + [len(members)]
+                    lo = 0
+                    for hi in splits:
+                        if hi > lo:
+                            q, o, spans, _ = R.sweep_dispatch_ragged(
+                                members[lo:hi])
+                            for p, (slo, shi) in zip(members[lo:hi],
+                                                     spans):
+                                out[id(p[0])] = (q[slo:shi], o[slo:shi])
+                        lo = hi
+                return out
+
+            ref = run_padded()          # warm + reference values
+            got = run_ragged()
+            match = all(
+                np.array_equal(ref[k][0][:len(got[k][0])], got[k][0]) and
+                np.array_equal(ref[k][1][:len(got[k][1])], got[k][1])
+                for k in ref)
+            t_pad = timed_best(run_padded)
+            t_rag = timed_best(run_ragged)
+            record("realign", dist, jobs, t_pad, t_rag, match)
+    except Exception as e:  # noqa: BLE001 — record, race the rest
+        payload["ragged_realign_error"] = f"{type(e).__name__}: {e}"[:160]
+
+    # ---- BQSR covariate count ----------------------------------------
+    try:
+        from adam_tpu.bqsr.count_pallas import (count_kernel_pallas,
+                                                count_kernel_ragged,
+                                                flatten_state)
+        from adam_tpu.bqsr.recalibrate import _count_kernel
+        from adam_tpu.bqsr.table import RecalTable
+        from adam_tpu.packing import ReadBatch, ragged_from_batch
+
+        rng = np.random.RandomState(29)
+        N = max(int((100_000 if is_tpu else 16_000) * n_scale), 512)
+        # L bounded by the packed-word cycle budget (fits(): n_cycle =
+        # 2L+1 must stay under 1024)
+        L, n_rg = 384, 4
+        rt = RecalTable(n_read_groups=n_rg, max_read_len=L)
+        for dist in ("uniform", "skewed"):
+            lens = np.full(N, 148, np.int32) if dist == "uniform" else \
+                rng.choice([30, 50, 75, 100, 150, 250, 384], N,
+                           p=[.3, .25, .2, .12, .08, .04, .01]
+                           ).astype(np.int32)
+            lane = np.arange(L)[None, :]
+            bases_p = np.where(lane < lens[:, None],
+                               rng.randint(0, 4, (N, L)), -1).astype(np.int8)
+            quals_p = np.where(lane < lens[:, None],
+                               rng.randint(2, 41, (N, L)), -1).astype(np.int8)
+            flags = rng.choice([0, 16, 1 + 128, 1 + 128 + 16],
+                               N).astype(np.int32)
+            rgs = rng.randint(0, n_rg, N).astype(np.int32)
+            state = np.where(lane < lens[:, None],
+                             rng.randint(0, 2, (N, L)), 2).astype(np.int8)
+            usable = np.ones(N, bool)
+            batch = ReadBatch(
+                flags=flags, refid=np.zeros(N, np.int32),
+                start=np.zeros(N, np.int32), mapq=np.zeros(N, np.int32),
+                mate_refid=np.zeros(N, np.int32),
+                mate_start=np.zeros(N, np.int32), read_group=rgs,
+                valid=np.ones(N, bool),
+                row_index=np.arange(N, dtype=np.int32),
+                read_len=lens, bases=bases_p, quals=quals_p)
+            rb = ragged_from_batch(batch, pad_bases_to=1 << 16)
+            sf = flatten_state(state, rb.read_len, len(rb.bases_flat))
+            kw = dict(n_qual_rg=rt.n_qual_rg, n_cycle=rt.n_cycle)
+            args = (jnp.asarray(bases_p), jnp.asarray(quals_p),
+                    jnp.asarray(lens), jnp.asarray(flags),
+                    jnp.asarray(rgs), jnp.asarray(state),
+                    jnp.asarray(usable))
+
+            def padded_out():
+                kern = count_kernel_pallas if is_tpu else _count_kernel
+                return [np.asarray(o) for o in kern(*args, **kw)]
+
+            def ragged_out():
+                return [np.asarray(o) for o in count_kernel_ragged(
+                    rb, sf, usable, max_read_len=L, **kw)]
+
+            ref, got = padded_out(), ragged_out()
+            match = all(np.array_equal(a, b) for a, b in zip(ref, got))
+            t_pad = timed_best(lambda: padded_out())
+            t_rag = timed_best(lambda: ragged_out())
+            record("bqsr", dist, N, t_pad, t_rag, match)
+    except Exception as e:  # noqa: BLE001
+        payload["ragged_bqsr_error"] = f"{type(e).__name__}: {e}"[:160]
+
+    # ---- flagstat wire sweep -----------------------------------------
+    try:
+        from adam_tpu.ops.flagstat import (flagstat_kernel_wire32,
+                                           pack_flagstat_wire32)
+        from adam_tpu.ops.flagstat_pallas import (
+            flagstat_pallas_wire32, flagstat_ragged_dispatch)
+        from adam_tpu.packing import pad_rows_for, row_bucket_ladder
+
+        rng = np.random.RandomState(41)
+        total = max(int((30_000_000 if is_tpu else 3_000_000) * n_scale),
+                    1 << 16)
+        cap = 1 << 20
+        ladder = row_bucket_ladder(cap, 1)
+        for dist in ("uniform", "skewed"):
+            sizes = []
+            left = total
+            while left > 0:
+                if dist == "uniform":
+                    n = min(cap, left)
+                else:
+                    n = min(int(rng.choice(
+                        [1 << 12, 1 << 14, 3 << 14, 1 << 16, 3 << 16,
+                         700_000])), left)
+                sizes.append(n)
+                left -= n
+            chunks = [pack_flagstat_wire32(
+                rng.randint(0, 1 << 12, n).astype(np.uint16),
+                rng.randint(0, 61, n).astype(np.uint8),
+                rng.randint(0, 4, n).astype(np.int16),
+                rng.randint(0, 4, n).astype(np.int16),
+                np.ones(n, bool)) for n in sizes]
+
+            def padded_counts():
+                acc = None
+                for w in chunks:
+                    rung = pad_rows_for(len(w), ladder)
+                    if rung != len(w):
+                        w = np.concatenate(
+                            [w, np.zeros(rung - len(w), np.uint32)])
+                    c = flagstat_pallas_wire32(w) if is_tpu else \
+                        flagstat_kernel_wire32(jnp.asarray(w))
+                    acc = np.asarray(c).astype(np.int64) if acc is None \
+                        else acc + np.asarray(c)
+                return acc
+
+            def ragged_counts():
+                acc = None
+                buf = np.empty(cap, np.uint32)
+                have = 0
+
+                def flush(n_live):
+                    nonlocal acc
+                    c = flagstat_ragged_dispatch(buf, n_live,
+                                                 use_pallas=is_tpu)
+                    acc = np.asarray(c).astype(np.int64) if acc is None \
+                        else acc + np.asarray(c)
+                for w in chunks:
+                    while len(w):
+                        take = min(cap - have, len(w))
+                        buf[have:have + take] = w[:take]
+                        have += take
+                        w = w[take:]
+                        if have == cap:
+                            flush(cap)
+                            have = 0
+                if have:
+                    flush(have)
+                return acc
+
+            ref, got = padded_counts(), ragged_counts()
+            match = np.array_equal(ref, got)
+            t_pad = timed_best(padded_counts)
+            t_rag = timed_best(ragged_counts)
+            record("flagstat", dist, total, t_pad, t_rag, match)
+    except Exception as e:  # noqa: BLE001
+        payload["ragged_flagstat_error"] = f"{type(e).__name__}: {e}"[:160]
+
+    # the conservative evidence pair the product plans consume — emitted
+    # ONLY when a kernel raced BOTH distributions with every leg
+    # bit-identical: a partial race (one distribution crashed) must not
+    # become ledger evidence, or the scheduler would mark the stage
+    # captured and the layout default could flip on the distribution
+    # set where the other shape just failed
+    for kernel, by_dist in pairs_of.items():
+        if len(by_dist) < 2 or not matched.get(kernel):
+            continue
+        pad_ps, rag_ps = min(by_dist.values(),
+                             key=lambda p: p[1] / p[0])
+        payload[f"ragged_{kernel}_padded_per_sec"] = round(pad_ps, 1)
+        payload[f"ragged_{kernel}_ragged_per_sec"] = round(rag_ps, 1)
+    _emit("ragged_race", payload)
+
+
 def _stage_pallas(kind: str, is_tpu: bool):
     """Compile-and-time the Pallas kernels on the real device (VERDICT r2
     weak #2: interpreter-only so far).  Falls out with ok=False rather than
@@ -982,7 +1288,8 @@ def _worker(stages: list[str]) -> None:
 
 _STAGE_BODIES = {"flagstat": _stage_flagstat, "transform": _stage_transform,
                  "bqsr_race": _stage_bqsr_race, "pallas": _stage_pallas,
-                 "bqsr_race8": _stage_bqsr_race8}
+                 "bqsr_race8": _stage_bqsr_race8,
+                 "ragged_race": _stage_ragged_race}
 
 
 def _worker_stages(stages: list[str]) -> None:

@@ -302,10 +302,10 @@ def test_worker_stages_run_in_the_order_given(monkeypatch):
 def test_first_window_order_race_before_flagstat():
     """The bench.py:912 inversion fix, pinned at the bench level: an
     empty ledger's first window runs probe -> bqsr_race -> pallas ->
-    transform -> flagstat -> bqsr_race8."""
+    ragged_race -> transform -> flagstat -> bqsr_race8."""
     assert list(DEFAULT_STAGE_ORDER) == \
-        ["probe", "bqsr_race", "pallas", "transform", "flagstat",
-         "bqsr_race8"]
+        ["probe", "bqsr_race", "pallas", "ragged_race", "transform",
+         "flagstat", "bqsr_race8"]
     assert order_stages(DEFAULT_STAGE_ORDER) == list(DEFAULT_STAGE_ORDER)
 
 
@@ -364,6 +364,9 @@ def test_sixty_second_flap_window_then_ledger_reentry(tmp_path):
     clock2 = FakeClock(total=520.0)
     a2 = (tpu_probe() |
           _stage_tpu("pallas", sweep_pallas_ok=True, sw_pallas_ok=True) |
+          _stage_tpu("ragged_race", ragged_backend="tpu",
+                     ragged_realign_ragged_per_sec=500.0,
+                     ragged_realign_padded_per_sec=250.0) |
           _stage_tpu("transform", transform_fused_reads_per_sec=9e6,
                      transform_n_reads=250_000) |
           _stage_tpu("flagstat", reads_per_sec=1e8,
@@ -428,7 +431,8 @@ def test_cpu_fallback_runs_headline_first_not_information_first():
                                    clock.reserve, clock.sleep,
                                    cpu_order=order_cpu_fallback)
     fallback = worker.calls[2][0]
-    assert fallback == ["probe", "flagstat", "transform", "bqsr_race"]
+    assert fallback == ["probe", "flagstat", "transform", "bqsr_race",
+                        "ragged_race"]
 
 
 def test_cpu_silent_fallback_probe_never_resizes_wires():

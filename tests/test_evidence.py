@@ -227,12 +227,12 @@ def test_default_order_is_information_first():
     assert order == list(ev_sched.DEFAULT_STAGE_ORDER)
     assert order[0] == "probe"
     assert order.index("bqsr_race") < order.index("pallas") < \
-        order.index("transform") < order.index("flagstat") < \
-        order.index("bqsr_race8")
+        order.index("ragged_race") < order.index("transform") < \
+        order.index("flagstat") < order.index("bqsr_race8")
     # shuffled input, same order out
     assert ev_sched.order_stages(
         ["flagstat", "bqsr_race8", "probe", "transform", "pallas",
-         "bqsr_race"]) == order
+         "ragged_race", "bqsr_race"]) == order
 
 
 def test_order_defers_captured_stages(tmp_path):

@@ -1,0 +1,601 @@
+"""Ragged kernels & the layout dimension (ISSUE 8).
+
+Pins: packer losslessness over adversarial inputs, per-kernel
+bit-identity of every ragged twin against its padded form (flagstat
+wire sweep, BQSR covariate count, realign consensus sweep — XLA
+fallback AND Mosaic-interpreter route), plan purity / env / CLI
+round-trips for the ``layout`` dimension, the per-axis pad-waste
+telemetry, and the zero-recompile rerun property of the ragged paths.
+"""
+
+from __future__ import annotations
+
+import json
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from adam_tpu import obs
+from adam_tpu import schema as S
+from adam_tpu.packing import (ReadBatch, pack_reads, pack_reads_ragged,
+                              ragged_from_batch, row_bucket_ladder,
+                              shape_rung)
+
+
+def _reads_table(seqs, quals, cigars=None):
+    n = len(seqs)
+    data = {
+        "sequence": pa.array(seqs, pa.string()),
+        "qual": pa.array(quals, pa.string()),
+        "cigar": pa.array(cigars or ["*"] * n, pa.string()),
+        "flags": pa.array([i % 7 for i in range(n)], pa.int64()),
+        "referenceId": pa.array([0] * n, pa.int32()),
+        "start": pa.array(list(range(n)), pa.int64()),
+        "mapq": pa.array([60] * n, pa.int32()),
+        "mateReferenceId": pa.array([0] * n, pa.int32()),
+        "mateAlignmentStart": pa.array([0] * n, pa.int64()),
+        "recordGroupId": pa.array([i % 3 for i in range(n)], pa.int32()),
+    }
+    cols = {}
+    for name in S.READ_SCHEMA.names:
+        cols[name] = data[name].cast(S.READ_SCHEMA.field(name).type) \
+            if name in data else pa.nulls(n, S.READ_SCHEMA.field(name).type)
+    return pa.Table.from_pydict(cols, schema=S.READ_SCHEMA)
+
+
+#: adversarial (sequence, qual) chunks: IUPAC/lowercase/odd alphabets,
+#: nulls, empty strings, qual shorter AND longer than the sequence
+_ADVERSARIAL = [
+    (["ACGT", "NNacgtRYKM", "", "A"], ["IIII", "JJJJJJJJJJ", "", "#"]),
+    ([None, "ACGTACGT", "acg"], [None, "II", "KKKKKK"]),
+    (["G"], ["I"]),
+    (["nNrR.=UuBb", "ACGT"], ["!!!!!!!!!!", "~~~~"]),
+]
+
+
+class TestRaggedPacker:
+    def test_plain_table_differential(self):
+        """pack_reads_ragged == flatten(pack_reads) on every adversarial
+        chunk: same offsets, same decoded prefix bytes, same scalars."""
+        for seqs, quals in _ADVERSARIAL:
+            t = _reads_table(seqs, quals)
+            pb = pack_reads(t, pad_rows_to=4)
+            rb = pack_reads_ragged(t, pad_rows_to=4, pad_bases_to=16)
+            fl = ragged_from_batch(pb, pad_bases_to=16)
+            T = rb.n_bases
+            assert fl.n_bases == T
+            assert np.array_equal(rb.row_offsets, fl.row_offsets)
+            assert np.array_equal(rb.row_of, fl.row_of)
+            assert np.array_equal(rb.pos_of, fl.pos_of)
+            assert np.array_equal(rb.bases_flat[:T], fl.bases_flat[:T])
+            assert np.array_equal(rb.quals_flat[:T], fl.quals_flat[:T])
+            assert np.array_equal(rb.read_len, fl.read_len)
+            for f in ("flags", "refid", "start", "mapq", "read_group",
+                      "valid", "row_index"):
+                assert np.array_equal(getattr(rb, f), getattr(pb, f)), f
+
+    def test_wire_table_differential(self):
+        """The wire-format route (io/wirespill spills) rebuilds the same
+        flat planes — pack_reads_ragged(to_wire(t)) == flatten of
+        pack_reads_wire(to_wire(t))."""
+        from adam_tpu.io.wirespill import pack_reads_wire, to_wire
+
+        for seqs, quals in _ADVERSARIAL:
+            t = _reads_table(seqs, quals)
+            w = to_wire(t, 128)
+            pbw = pack_reads_wire(w, bucket_len=128, pad_rows_to=4)
+            rbw = pack_reads_ragged(w, pad_rows_to=4, pad_bases_to=16)
+            flw = ragged_from_batch(pbw, pad_bases_to=16)
+            T = rbw.n_bases
+            assert np.array_equal(rbw.row_offsets, flw.row_offsets)
+            assert np.array_equal(rbw.bases_flat[:T], flw.bases_flat[:T])
+            assert np.array_equal(rbw.quals_flat[:T], flw.quals_flat[:T])
+
+    def test_single_read_chunks(self):
+        """One-read chunks (the degenerate stream tail) pack losslessly
+        row by row."""
+        seqs, quals = _ADVERSARIAL[0]
+        t = _reads_table(seqs, quals)
+        whole = pack_reads_ragged(t)
+        for i in range(t.num_rows):
+            one = pack_reads_ragged(t.slice(i, 1))
+            lo, hi = whole.row_offsets[i], whole.row_offsets[i + 1]
+            assert one.n_bases == hi - lo
+            assert np.array_equal(one.bases_flat[:one.n_bases],
+                                  whole.bases_flat[lo:hi])
+            assert np.array_equal(one.quals_flat[:one.n_bases],
+                                  whole.quals_flat[lo:hi])
+
+    def test_slack_is_sentinel_and_excluded_by_index(self):
+        """Flat-plane slack past n_bases carries pad sentinels and
+        row_of 0 — positional exclusion, never a valid bit."""
+        t = _reads_table(["ACG"], ["III"])
+        rb = pack_reads_ragged(t, pad_bases_to=64)
+        assert len(rb.bases_flat) == 64 and rb.n_bases == 3
+        assert (rb.bases_flat[3:] == S.BASE_PAD).all()
+        assert (rb.row_of[3:] == 0).all()
+
+
+# ---------------------------------------------------------------------------
+# flagstat: ragged wire sweep
+# ---------------------------------------------------------------------------
+
+def _mk_wire(rng, n):
+    from adam_tpu.ops.flagstat import pack_flagstat_wire32
+
+    return pack_flagstat_wire32(
+        rng.randint(0, 1 << 12, n).astype(np.uint16),
+        rng.randint(0, 61, n).astype(np.uint8),
+        rng.randint(0, 4, n).astype(np.int16),
+        rng.randint(0, 4, n).astype(np.int16),
+        np.ones(n, bool))
+
+
+class TestRaggedFlagstat:
+    def test_concat_equals_per_chunk_padded(self):
+        """Ragged counters over a fixed-capacity concat (garbage slack!)
+        equal the sum of padded per-chunk counters — XLA form and the
+        Mosaic interpreter route."""
+        import jax.numpy as jnp
+
+        from adam_tpu.ops.flagstat import flagstat_kernel_wire32
+        from adam_tpu.ops.flagstat_pallas import (
+            BLOCK, flagstat_pallas_wire32_ragged, flagstat_wire32_ragged_xla)
+
+        rng = np.random.RandomState(0)
+        chunks = [_mk_wire(rng, n) for n in (1000, 37, 0, 250_000, 5)]
+        cap = BLOCK * 2 + 517
+        buf = rng.randint(0, 2 ** 32, cap, dtype=np.uint32)  # garbage
+        off, offsets = 0, [0]
+        for c in chunks:
+            buf[off:off + len(c)] = c
+            off += len(c)
+            offsets.append(off)
+        offsets = np.array(offsets, np.int32)
+        ref = sum(np.asarray(flagstat_kernel_wire32(jnp.asarray(c)))
+                  for c in chunks)
+        assert np.array_equal(
+            ref, np.asarray(flagstat_wire32_ragged_xla(buf, offsets)))
+        assert np.array_equal(
+            ref, np.asarray(flagstat_pallas_wire32_ragged(
+                buf, offsets, interpret=True)))
+        # all-slack and exactly-full buffers
+        z = np.asarray(flagstat_pallas_wire32_ragged(
+            buf, np.array([0], np.int32), interpret=True))
+        assert z.sum() == 0
+        full = _mk_wire(rng, BLOCK)
+        assert np.array_equal(
+            np.asarray(flagstat_kernel_wire32(jnp.asarray(full))),
+            np.asarray(flagstat_pallas_wire32_ragged(
+                full, np.array([0, BLOCK], np.int32), interpret=True)))
+
+    def test_streaming_identical_and_zero_recompile(self, tmp_path,
+                                                    monkeypatch):
+        """streaming_flagstat under -ragged: identical metrics to the
+        padded walk, the plan event records layout=ragged, and an
+        identical rerun re-uses every compiled executable."""
+        from adam_tpu.io.parquet import save_table
+        from adam_tpu.parallel.mesh import make_mesh
+        from adam_tpu.parallel.pipeline import streaming_flagstat
+        from adam_tpu.platform import install_compile_metrics
+        from tests._synth_reads import random_reads_table
+
+        t = random_reads_table(3000, 80, seed=3,
+                               flags=np.random.RandomState(1).choice(
+                                   [0, 4, 1024, 512, 16], 3000))
+        src = str(tmp_path / "reads.parquet")
+        save_table(t, src)
+        ref = streaming_flagstat(src, chunk_rows=700)
+
+        # ragged engages on a single-shard mesh only (the virtual CPU
+        # test mesh has 8 shards and must demote — test_mesh_demotes)
+        install_compile_metrics()
+        mpath = str(tmp_path / "rag.jsonl")
+        with obs.metrics_run(mpath, argv=["test"]):
+            got = streaming_flagstat(
+                src, chunk_rows=700, mesh=make_mesh(1),
+                executor_opts={"ragged": True})
+        assert got == ref
+        events = [json.loads(ln) for ln in open(mpath)]
+        plans = [e for e in events
+                 if e.get("event") == "executor_bucket_selected"]
+        assert plans and plans[0]["layout"] == "ragged"
+        assert "layout-pinned-ragged" in plans[0]["reason"]
+
+        compiles = obs.registry().snapshot()["counters"].get(
+            "compile_count", 0)
+        got2 = streaming_flagstat(src, chunk_rows=700, mesh=make_mesh(1),
+                                  executor_opts={"ragged": True})
+        assert got2 == ref
+        assert obs.registry().snapshot()["counters"].get(
+            "compile_count", 0) == compiles
+
+        # the sidecar validates and the layout decision replays
+        import sys
+        sys.path.insert(0, "tools")
+        import check_executor
+        import check_metrics
+        assert check_metrics.validate(mpath) == []
+        assert check_executor.check([mpath]) == []
+
+    def test_env_pin(self, tmp_path, monkeypatch):
+        """ADAM_TPU_RAGGED=1 flips the layout; =0 forces padded even
+        with ragged evidence in scope."""
+        from adam_tpu.parallel.executor import StreamExecutor
+
+        monkeypatch.setenv("ADAM_TPU_RAGGED", "1")
+        ex = StreamExecutor(1, 1 << 10, on_tpu=False)
+        pex = ex.begin_pass("flagstat", ragged_capable=True)
+        assert pex.layout == "ragged"
+        ex.finish()
+        monkeypatch.setenv("ADAM_TPU_RAGGED", "0")
+        ex = StreamExecutor(1, 1 << 10, on_tpu=False)
+        pex = ex.begin_pass("flagstat", ragged_capable=True)
+        assert pex.layout == "padded"
+        ex.finish()
+
+
+# ---------------------------------------------------------------------------
+# BQSR count: flat covariate walk
+# ---------------------------------------------------------------------------
+
+def _adversarial_count_batch(rng, N=257, L=128, n_rg=3):
+    read_len = rng.choice([0, 1, 5, 30, 60, 127, L], N).astype(np.int32)
+    lane = np.arange(L)[None, :]
+    bases = np.where(lane < read_len[:, None],
+                     rng.randint(-1, 5, (N, L)), -1).astype(np.int8)
+    quals = np.where(lane < read_len[:, None],
+                     rng.randint(-1, 61, (N, L)), -1).astype(np.int8)
+    flags = rng.choice([0, 16, 1 + 128, 1 + 128 + 16, 1 + 64],
+                       N).astype(np.int32)
+    rg = rng.randint(-1, n_rg, N).astype(np.int32)
+    state = rng.randint(0, 3, (N, L)).astype(np.int8)
+    usable = rng.rand(N) < 0.9
+    batch = ReadBatch(
+        flags=flags, refid=np.zeros(N, np.int32),
+        start=np.zeros(N, np.int32), mapq=np.zeros(N, np.int32),
+        mate_refid=np.zeros(N, np.int32),
+        mate_start=np.zeros(N, np.int32), read_group=rg,
+        valid=np.ones(N, bool), row_index=np.arange(N, dtype=np.int32),
+        read_len=read_len, bases=bases, quals=quals)
+    return batch, state, usable
+
+
+class TestRaggedCount:
+    @pytest.mark.parametrize("impl", ["xla", "pallas"])
+    def test_differential_vs_scatter_oracle(self, impl):
+        """The ragged count (both routes) equals the scatter oracle on
+        an adversarial batch: invalid bases, negative quals, null read
+        groups, zero-length and unusable reads, reverse/second flags."""
+        import jax.numpy as jnp
+
+        from adam_tpu.bqsr.count_pallas import (count_kernel_ragged,
+                                                flatten_state)
+        from adam_tpu.bqsr.recalibrate import _count_kernel
+        from adam_tpu.bqsr.table import RecalTable
+
+        rng = np.random.RandomState(5)
+        batch, state, usable = _adversarial_count_batch(rng)
+        L = batch.max_len
+        rt = RecalTable(n_read_groups=3, max_read_len=L)
+        ref = [np.asarray(o) for o in _count_kernel(
+            jnp.asarray(batch.bases), jnp.asarray(batch.quals),
+            jnp.asarray(batch.read_len), jnp.asarray(batch.flags),
+            jnp.asarray(batch.read_group), jnp.asarray(state),
+            jnp.asarray(usable), n_qual_rg=rt.n_qual_rg,
+            n_cycle=rt.n_cycle)]
+        rb = ragged_from_batch(batch, pad_bases_to=2048)
+        sf = flatten_state(state, rb.read_len, len(rb.bases_flat))
+        got = [np.asarray(o) for o in count_kernel_ragged(
+            rb, sf, usable, n_qual_rg=rt.n_qual_rg, n_cycle=rt.n_cycle,
+            max_read_len=L, impl=impl, interpret=True)]
+        for i, (a, b) in enumerate(zip(ref, got)):
+            assert np.array_equal(a, b), f"tensor {i} diverged"
+
+    def test_count_tables_device_layout_hook(self):
+        """count_tables_device(layout='ragged') returns the padded
+        answer bit for bit (the _count_stream integration seam)."""
+        from adam_tpu.bqsr.recalibrate import count_tables_device
+        from tests._synth_reads import random_reads_table
+
+        t = random_reads_table(300, 70, seed=2, n_rg=2)
+        pad = [np.asarray(o) for o in
+               count_tables_device(t, n_read_groups=2)]
+        rag = [np.asarray(o) for o in
+               count_tables_device(t, n_read_groups=2, layout="ragged")]
+        for a, b in zip(pad, rag):
+            assert np.array_equal(a, b)
+
+
+# ---------------------------------------------------------------------------
+# realign sweep: (CL, G)-only bucketing
+# ---------------------------------------------------------------------------
+
+def _sweep_pairs(rng, specs):
+    """(n_reads, max_len, cons_len) specs -> (state, job) pairs the
+    dispatchers consume (same construction as _prepare_group)."""
+    from adam_tpu.realign import realigner as R
+
+    bases = np.frombuffer(b"ACGT", np.uint8)
+    pairs = []
+    for n, lmax, cl in specs:
+        lens_true = rng.randint(max(1, lmax // 3), lmax + 1, n)
+        Rr = shape_rung(n, 32)
+        L = shape_rung(int(lens_true.max()), 32)
+        reads_u8 = np.zeros((Rr, L), np.uint8)
+        quals = np.zeros((Rr, L), np.int32)
+        lens = np.zeros(Rr, np.int32)
+        for i, l in enumerate(lens_true):
+            reads_u8[i, :l] = bases[rng.randint(0, 4, l)]
+            quals[i, :l] = rng.randint(2, 41, l)
+            lens[i] = l
+        CL = shape_rung(max(cl, L + 1), 64)
+        cons = np.zeros(CL, np.uint8)
+        cons[:cl] = bases[rng.randint(0, 4, cl)]
+        job = R._SweepJob(None, cons, cl, (Rr, L, CL))
+        pairs.append((R._GroupState([None] * n, "", 0, [0] * n, 0,
+                                    reads_u8, quals, lens, [job]), job))
+    return pairs
+
+
+_SWEEP_SPECS = [(3, 60, 150), (1, 40, 200), (17, 90, 180), (2, 33, 220),
+                (8, 80, 161)]
+
+
+class TestRaggedSweep:
+    def test_per_job_identity_vs_padded(self, monkeypatch):
+        """sweep_dispatch_ragged == per-job padded sweep_dispatch across
+        mixed (R, L) geometries sharing one CL rung — XLA form and the
+        Mosaic-interpreter row kernel."""
+        from adam_tpu.realign import realigner as R
+        from adam_tpu.realign import sweep_pallas as SP
+
+        rng = np.random.RandomState(11)
+        pairs = _sweep_pairs(rng, _SWEEP_SPECS)
+        assert len({job.shape[2] for _, job in pairs}) == 1
+        refs = []
+        for st, job in pairs:
+            q, o = R.sweep_dispatch([(st, job)])
+            refs.append((np.asarray(q)[0], np.asarray(o)[0]))
+        q, o, spans, stats = R.sweep_dispatch_ragged(pairs)
+        assert stats["rows"] == sum(len(st.reads_to_clean)
+                                    for st, _ in pairs)
+        for (st, _), (rq, ro), (lo, hi) in zip(pairs, refs, spans):
+            n = len(st.reads_to_clean)
+            assert np.array_equal(rq[:n], q[lo:hi])
+            assert np.array_equal(ro[:n], o[lo:hi])
+
+        # the pallas row kernel (interpreter off-TPU) agrees bit for bit
+        monkeypatch.setenv("ADAM_TPU_SWEEP_IMPL", "pallas")
+        R._sweep_backend.cache_clear()
+        orig = SP.sweep_pallas_ragged
+        monkeypatch.setattr(
+            SP, "sweep_pallas_ragged",
+            lambda *a, **k: orig(*a, interpret=True, **k))
+        try:
+            q2, o2, _, _ = R.sweep_dispatch_ragged(pairs)
+        finally:
+            monkeypatch.delenv("ADAM_TPU_SWEEP_IMPL")
+            R._sweep_backend.cache_clear()
+        assert np.array_equal(q, q2) and np.array_equal(o, o2)
+
+    def test_batcher_ragged_buckets_on_cl_only(self):
+        """With layout=ragged the batcher keys buckets on the CL rung
+        alone: jobs with different (R, L) land in ONE bucket, and the
+        results match the padded batcher's."""
+        from adam_tpu.parallel.realign_exec import CrossBinSweepBatcher
+
+        rng = np.random.RandomState(7)
+        pairs = _sweep_pairs(rng, _SWEEP_SPECS)
+        states = [st for st, _ in pairs]
+
+        def run(layout):
+            b = CrossBinSweepBatcher(layout=layout)
+            b.add_unit(("u", 0), states)
+            if layout == "ragged":
+                assert len(b._buckets) == 1       # one CL rung
+                (key,) = b._buckets
+                assert key == (pairs[0][1].shape[2],)
+            return b.sweep_unit(("u", 0))
+
+        pad = run("padded")
+        rag = run("ragged")
+        for ps, rs, st in zip(pad, rag, states):
+            n = len(st.reads_to_clean)
+            for (pq, po), (rq, ro) in zip(ps, rs):
+                assert np.array_equal(np.asarray(pq)[:n],
+                                      np.asarray(rq)[:n])
+                assert np.array_equal(np.asarray(po)[:n],
+                                      np.asarray(ro)[:n])
+
+    def test_transform_realign_identical_with_telemetry(self, tmp_path):
+        """Full pass-4 byte identity under layout=ragged, with the plan
+        event carrying layout, waste breakdowns on every dispatch event,
+        and the sidecar passing both validators."""
+        from adam_tpu.io.parquet import load_table
+        from adam_tpu.parallel.pipeline import streaming_transform
+        from tests._synth_realign import synth_sam
+
+        src = str(tmp_path / "s.sam")
+        open(src, "w").write(synth_sam(6, 10, seed=11, tail_reads=5))
+
+        def run(name, **kw):
+            out = str(tmp_path / name)
+            streaming_transform(src, out, realign=True, chunk_rows=64,
+                                workdir=str(tmp_path / ("wk" + name)),
+                                **kw)
+            return load_table(out)
+
+        ref = run("pad")
+        mpath = str(tmp_path / "rag.jsonl")
+        with obs.metrics_run(mpath, argv=["test"]):
+            got = run("rag", realign_opts={"layout": "ragged"})
+        assert got.equals(ref)
+
+        events = [json.loads(ln) for ln in open(mpath)]
+        plans = [e for e in events
+                 if e.get("event") == "realign_plan_selected"]
+        assert plans and plans[0]["layout"] == "ragged"
+        disp = [e for e in events
+                if e.get("event") == "realign_sweep_dispatch"]
+        assert disp
+        for d in disp:
+            assert d["layout"] == "ragged"
+            for f in ("waste_r", "waste_l", "waste_cl", "waste_g"):
+                assert 0 <= d[f] <= 1
+        import sys
+        sys.path.insert(0, "tools")
+        import check_executor
+        import check_metrics
+        assert check_metrics.validate(mpath) == []
+        assert check_executor.check([mpath]) == []
+
+
+# ---------------------------------------------------------------------------
+# the layout plan: purity, evidence, env/CLI
+# ---------------------------------------------------------------------------
+
+class TestLayoutPlan:
+    def test_decide_plan_layout_table(self):
+        from adam_tpu.parallel.executor import decide_plan
+
+        base = dict(pass_name="p2", chunk_rows=1 << 16, mesh_size=1,
+                    on_tpu=False)
+        assert decide_plan(**base)["layout"] == "padded"
+        assert decide_plan(**base, layout="ragged",
+                           ragged_capable=True)["layout"] == "ragged"
+        # an explicit ragged pin on an incapable pass demotes, loudly
+        p = decide_plan(**base, layout="ragged", ragged_capable=False)
+        assert p["layout"] == "padded"
+        assert "ragged-pin-unsupported" in p["reason"]
+        # evidence flips the default only when ragged measured faster
+        win = decide_plan(**base, ragged_capable=True,
+                          ragged_rates={"padded": 100.0, "ragged": 140.0})
+        assert win["layout"] == "ragged"
+        assert "ragged-evidence" in win["reason"]
+        lose = decide_plan(**base, ragged_capable=True,
+                           ragged_rates={"padded": 150.0, "ragged": 90.0})
+        assert lose["layout"] == "padded"
+        # replay from recorded inputs reproduces the plan exactly
+        assert decide_plan(**win["inputs"]) == win
+
+    def test_realign_plan_layout_and_replay(self):
+        from adam_tpu.parallel.realign_exec import decide_realign_plan
+
+        p = decide_realign_plan(n_bins=4, on_tpu=False,
+                                ragged_rates={"padded": 10, "ragged": 20})
+        assert p["layout"] == "ragged"
+        assert decide_realign_plan(**p["inputs"]) == p
+        q = decide_realign_plan(n_bins=4, on_tpu=False, layout="padded")
+        assert q["layout"] == "padded"
+
+    def test_mesh_demotes_ragged(self):
+        """A multi-shard mesh keeps padded even under an explicit pin —
+        ragged dispatches are unsharded by design."""
+        from adam_tpu.parallel.executor import StreamExecutor
+
+        ex = StreamExecutor(8, 1 << 10, on_tpu=False, ragged=True)
+        pex = ex.begin_pass("flagstat", ragged_capable=True)
+        assert pex.layout == "padded"
+        ex.finish()
+
+    def test_ledger_evidence_roundtrip(self, tmp_path, monkeypatch):
+        """ledger_ragged_rates reads the raced pair back from a
+        ragged_race record — and refuses cross-platform evidence."""
+        from adam_tpu.evidence.ledger import Ledger
+        from adam_tpu.parallel.executor import ledger_ragged_rates
+
+        path = str(tmp_path / "EVIDENCE_LEDGER.json")
+        monkeypatch.setenv("ADAM_TPU_EVIDENCE_LEDGER", path)
+        led = Ledger(path)
+        led.record_stage("ragged_race",
+                         {"ragged_realign_padded_per_sec": 120.0,
+                          "ragged_realign_ragged_per_sec": 300.0},
+                         platform="cpu", window_id="w1")
+        led.save()
+        assert ledger_ragged_rates("realign", platform="cpu") == \
+            {"padded": 120.0, "ragged": 300.0}
+        # evidence captured on another platform never steers this one
+        assert ledger_ragged_rates("realign", platform="tpu") is None
+        assert ledger_ragged_rates("bqsr", platform="cpu") is None
+
+    def test_cli_flags_round_trip(self):
+        from adam_tpu.cli.main import main as cli_main  # noqa: F401
+        from adam_tpu.cli.commands import executor_opts_from
+
+        class A:
+            ragged = True
+            no_ragged = False
+        assert executor_opts_from(A())["ragged"] is True
+
+        class B:
+            ragged = False
+            no_ragged = True
+        assert executor_opts_from(B())["ragged"] is False
+
+        class C:
+            ragged = False
+            no_ragged = False
+        assert "ragged" not in executor_opts_from(C())
+
+    def test_resolve_realign_opts_layout_env(self, monkeypatch):
+        from adam_tpu.parallel.realign_exec import resolve_realign_opts
+
+        monkeypatch.setenv("ADAM_TPU_RAGGED", "1")
+        assert resolve_realign_opts()["layout"] == "ragged"
+        monkeypatch.setenv("ADAM_TPU_RAGGED", "0")
+        assert resolve_realign_opts()["layout"] == "padded"
+        # explicit caller layout beats the env
+        assert resolve_realign_opts(
+            {"layout": "padded"})["layout"] == "padded"
+
+
+# ---------------------------------------------------------------------------
+# satellites: ladder memoization, lane-waste sample, committed artifact
+# ---------------------------------------------------------------------------
+
+def test_ladder_memoized_and_unchanged():
+    """row_bucket_ladder is cached per (cap, mult, base) — identical
+    object back, identical rungs to a fresh derivation."""
+    a = row_bucket_ladder(1 << 20, 8)
+    b = row_bucket_ladder(1 << 20, 8)
+    assert a is b
+    # the cached ladder matches the recurrence re-derived by hand
+    r, rungs = 8, []
+    while r < (1 << 20):
+        rungs.append(r)
+        r = ((max(int(r * 2.0 + 0.5), r + 1) + 7) // 8) * 8
+    rungs.append(1 << 20)
+    assert list(a) == rungs
+    assert shape_rung(100, 32) is shape_rung(100, 32) or \
+        shape_rung(100, 32) == shape_rung(100, 32)
+
+
+def test_pad_waste_lane_axis():
+    """obs.pad_waste's new length-axis sample lands in its own
+    histogram and never contaminates the row series."""
+    obs.pad_waste("px", 90, 128, max_len=70, padded_len=128)
+    snap = obs.registry().snapshot()
+    h = snap["histograms"]["pad_waste_lane_frac{pass=px}"]
+    assert h["count"] == 1
+    assert abs(h["sum"] - (128 - 70) / 128) < 1e-9
+    assert snap["histograms"]["pad_waste_frac{pass=px}"]["count"] == 1
+
+
+def test_committed_ragged_artifact_holds():
+    """BENCH_RAGGED.json (the committed length-skewed CPU artifact):
+    the ragged realign sweep beats the 4-axis-padded form by >= 20%
+    sweep wall and every raced kernel matched its padded twin —
+    tools/bench_gate.py enforces the same numbers."""
+    import os
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    with open(os.path.join(root, "BENCH_RAGGED.json")) as f:
+        doc = json.load(f)
+    assert doc["ragged_realign_skewed_speedup"] >= 1.25
+    for k, v in doc.items():
+        if k.endswith("_matches_padded"):
+            assert v is True, k
+    # the evidence keys the executor plans consume are present
+    assert doc["ragged_realign_ragged_per_sec"] > 0
+    assert doc["ragged_realign_padded_per_sec"] > 0

@@ -197,6 +197,9 @@ def test_snptable_ingest_rss_stays_bounded(tmp_path):
     # the per-line parser >4 GB).  Under full-suite memory pressure the
     # child's allocator measured up to ~2 GB for the identical work —
     # ~2.65 GB once the shard_map compat let the whole suite actually
-    # execute ahead of this test — so the bound is a gross-regression
-    # tripwire (O(file) string churn), not a pin on the isolated number.
-    assert int(peak_kb) < 3_200_000, f"peak RSS {int(peak_kb)//1024} MB"
+    # execute ahead of this test, ~3.21 GB with the PR 8 suite running
+    # ahead of it — so the bound is a gross-regression tripwire
+    # (O(file) string churn, which lands >4 GB), not a pin on the
+    # isolated number (~830 MB, unchanged — pinned by running this test
+    # alone).
+    assert int(peak_kb) < 3_600_000, f"peak RSS {int(peak_kb)//1024} MB"

@@ -1,8 +1,8 @@
 #!/usr/bin/env python3
-"""Gate the committed transform BENCH artifacts through compare_bench.
+"""Gate the committed BENCH artifacts through compare_bench.
 
-Two checks, both running :mod:`tools.compare_bench` (the PR 6 artifact
-differ) with ``--threshold``:
+Checks, each running :mod:`tools.compare_bench` (the PR 6 artifact
+differ) with ``--threshold`` where applicable:
 
 1. **The fusion win is pinned.**  ``BENCH_TRANSFORM_BASELINE.json``
    (the legacy 4-pass ledger) vs ``BENCH_TRANSFORM.json`` (the fused
@@ -18,17 +18,29 @@ differ) with ``--threshold``:
    standard 10% threshold over the amplification AND the wall — a
    transform io/wall regression exits nonzero locally before it ships.
 
+3. **The ragged-layout win is pinned.**  ``BENCH_RAGGED.json`` (the
+   committed length-skewed CPU ``ragged_race`` artifact) must show the
+   ragged realign sweep beating the 4-axis-padded form by >= 20% of
+   sweep wall on the skewed input (ISSUE 8's acceptance number), and
+   every raced ragged kernel bit-identical to its padded twin.  A
+   fresh ragged artifact (``--ragged NEW_RAGGED.json``, from
+   ``python bench.py --worker ragged_race``) additionally diffs BOTH
+   layouts' sweep walls against the committed numbers at 10% — a
+   regression in either layout fails the check.
+
 Usage::
 
-    python tools/bench_gate.py            # check 1 only (committed pair)
-    python tools/bench_gate.py NEW.json   # checks 1 + 2
+    python tools/bench_gate.py                       # committed gates
+    python tools/bench_gate.py NEW.json              # + transform diff
+    python tools/bench_gate.py --ragged NEW_R.json   # + ragged diff
 
-Exit 0 when every gate holds; the first failing compare_bench exit code
+Exit 0 when every gate holds; the first failing check's exit code
 otherwise.
 """
 
 from __future__ import annotations
 
+import json
 import os
 import sys
 
@@ -38,20 +50,78 @@ import compare_bench  # noqa: E402
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 BASELINE = os.path.join(ROOT, "BENCH_TRANSFORM_BASELINE.json")
 CURRENT = os.path.join(ROOT, "BENCH_TRANSFORM.json")
+RAGGED = os.path.join(ROOT, "BENCH_RAGGED.json")
 
 #: the ISSUE 7 acceptance number: fused must cut the spill-I/O
 #: amplification by at least this much vs the legacy baseline
 REQUIRED_CUT_PCT = 40.0
 
+#: the ISSUE 8 acceptance number: the ragged realign sweep must beat
+#: the 4-axis-padded form by >= 20% of sweep wall on the committed
+#: length-skewed artifact (wall_padded / wall_ragged >= 1.25)
+RAGGED_REQUIRED_SPEEDUP = 1.25
+
+#: the ragged-vs-padded walls a fresh artifact is regression-diffed on
+#: (both layouts: a regression in EITHER fails)
+RAGGED_WALL_KEYS = ("ragged_realign_skewed_padded_wall_s",
+                    "ragged_realign_skewed_ragged_wall_s",
+                    "ragged_realign_uniform_padded_wall_s",
+                    "ragged_realign_uniform_ragged_wall_s")
+
+
+def _check_ragged_artifact(path: str) -> int:
+    """Gate 3's committed-artifact half: the >= 20% skewed sweep win
+    plus bit-identity on every raced ragged kernel."""
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, ValueError) as e:
+        print(f"bench_gate: unreadable ragged artifact {path}: {e}",
+              file=sys.stderr)
+        return 2
+    rc = 0
+    speedup = doc.get("ragged_realign_skewed_speedup")
+    if not isinstance(speedup, (int, float)) or \
+            speedup < RAGGED_REQUIRED_SPEEDUP:
+        print(f"bench_gate: ragged realign sweep speedup {speedup!r} on "
+              "the committed skewed artifact is below the required "
+              f"{RAGGED_REQUIRED_SPEEDUP}x (>= 20% sweep-wall cut) — "
+              "the ragged-layout win regressed", file=sys.stderr)
+        rc = 1
+    mism = [k for k, v in doc.items()
+            if k.endswith("_matches_padded") and v is not True]
+    if mism:
+        print("bench_gate: ragged kernels no longer bit-identical to "
+              f"their padded twins in {path}: {mism}", file=sys.stderr)
+        rc = 1
+    if rc == 0:
+        print(f"ragged gate: skewed realign sweep speedup {speedup}x "
+              f">= {RAGGED_REQUIRED_SPEEDUP}x, all kernels bit-identical")
+    return rc
+
 
 def main(argv=None) -> int:
-    argv = sys.argv[1:] if argv is None else argv
+    argv = list(sys.argv[1:] if argv is None else argv)
+    fresh_ragged = None
+    if "--ragged" in argv:
+        i = argv.index("--ragged")
+        try:
+            fresh_ragged = argv[i + 1]
+        except IndexError:
+            print("bench_gate: --ragged needs a path", file=sys.stderr)
+            return 2
+        del argv[i:i + 2]
     for path in (BASELINE, CURRENT):
         if not os.path.exists(path):
             print(f"bench_gate: missing committed artifact {path} "
                   "(regenerate with: python bench_transform.py --stream "
                   "--artifacts .)", file=sys.stderr)
             return 2
+    if not os.path.exists(RAGGED):
+        print(f"bench_gate: missing committed artifact {RAGGED} "
+              "(regenerate with: python bench.py --worker ragged_race "
+              "> out.jsonl on the CPU backend)", file=sys.stderr)
+        return 2
 
     print(f"== gate 1: fused cuts io_spill_amplification >= "
           f"{REQUIRED_CUT_PCT}% vs the legacy baseline ==")
@@ -75,6 +145,27 @@ def main(argv=None) -> int:
         if rc != 0:
             print("bench_gate: transform io/wall regressed past 10% vs "
                   "the committed artifact", file=sys.stderr)
+            return rc
+
+    print(f"\n== gate 3: ragged realign sweep >= "
+          f"{RAGGED_REQUIRED_SPEEDUP}x on the committed skewed "
+          "artifact ==")
+    rc = _check_ragged_artifact(RAGGED)
+    if rc != 0:
+        return rc
+
+    if fresh_ragged:
+        print(f"\n== gate 3b: {fresh_ragged} vs committed {RAGGED} "
+              "(10% regression threshold, both layouts) ==")
+        rc = _check_ragged_artifact(fresh_ragged)
+        if rc != 0:
+            return rc
+        rc = compare_bench.main([RAGGED, fresh_ragged,
+                                 "--keys", ",".join(RAGGED_WALL_KEYS),
+                                 "--threshold", "10"])
+        if rc != 0:
+            print("bench_gate: a ragged or padded sweep wall regressed "
+                  "past 10% vs the committed artifact", file=sys.stderr)
             return rc
 
     print("\nbench_gate: all gates hold")

@@ -40,16 +40,32 @@ from typing import Dict, List, Tuple
 sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 
-#: the plan fields a replay must reproduce exactly
+#: the plan fields a replay must reproduce exactly (``layout`` — the
+#: ragged-vs-padded dimension — is compared only when the event carries
+#: it, so pre-layout sidecars still replay)
 PLAN_FIELDS = ("chunk_rows", "ladder", "ladder_base", "prefetch_depth",
-               "donate")
+               "donate", "layout")
 
 #: the fused-transform plan fields a replay must reproduce exactly
 #: (pipeline.decide_fusion_plan; same purity contract)
 FUSION_FIELDS = ("mode", "streams", "route_in_s1", "carry_ridx",
                  "count_pass", "apply_at", "wire_spill", "direct_emit")
 
-_REPLAYED = ("executor_bucket_selected", "fusion_plan_selected")
+#: the pass-4 plan fields a replay must reproduce exactly
+#: (realign_exec.decide_realign_plan — the layout decision included)
+REALIGN_FIELDS = ("pipeline_depth", "donate", "layout")
+
+#: fields absent from older sidecars: compared only when recorded
+_OPTIONAL_FIELDS = ("layout",)
+
+#: event kinds whose canonicalized inputs grew layout keys in PR 8 —
+#: a pre-layout event's recorded inputs digest differently under the
+#: current decider (the new dict carries more keys), so the digest
+#: replay is skipped for them; the decision FIELDS still replay
+_LAYOUT_KINDS = ("executor_bucket_selected", "realign_plan_selected")
+
+_REPLAYED = ("executor_bucket_selected", "fusion_plan_selected",
+             "realign_plan_selected")
 
 
 def _events(path: str, kinds=_REPLAYED) -> List[Tuple[int, dict]]:
@@ -72,10 +88,13 @@ def check(paths: List[str]) -> List[str]:
     (empty = deterministic)."""
     from adam_tpu.parallel.executor import decide_plan
     from adam_tpu.parallel.pipeline import decide_fusion_plan
+    from adam_tpu.parallel.realign_exec import decide_realign_plan
 
     deciders = {"executor_bucket_selected": (decide_plan, PLAN_FIELDS),
                 "fusion_plan_selected": (decide_fusion_plan,
-                                         FUSION_FIELDS)}
+                                         FUSION_FIELDS),
+                "realign_plan_selected": (decide_realign_plan,
+                                          REALIGN_FIELDS)}
     errs: List[str] = []
     # digests are namespaced per event kind: the two deciders hash
     # different input tuples and must never cross-validate
@@ -103,12 +122,16 @@ def check(paths: List[str]) -> List[str]:
                 continue
             n_checked += 1
             for field in fields:
+                if field in _OPTIONAL_FIELDS and field not in ev:
+                    continue        # pre-layout sidecar: nothing recorded
                 if ev.get(field) != plan[field]:
                     errs.append(
                         f"{path}:{i}: non-deterministic {kind} — "
                         f"recorded {field}={ev.get(field)!r}, replay "
                         f"yields {plan[field]!r}")
-            if ev.get("input_digest") != plan["input_digest"]:
+            pre_layout = kind in _LAYOUT_KINDS and "layout" not in inputs
+            if not pre_layout and \
+                    ev.get("input_digest") != plan["input_digest"]:
                 errs.append(
                     f"{path}:{i}: input_digest mismatch (recorded "
                     f"{ev.get('input_digest')!r}, inputs digest to "

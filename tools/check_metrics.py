@@ -14,8 +14,9 @@ elastic worker sidecars).  Contract checked here:
 * ``chunk`` events carry ``pass`` (str) and ``rows`` (int >= 0);
 * ``executor_bucket_selected`` events carry ``pass``, ``chunk_rows``
   (int > 0), a strictly ascending int ``ladder`` whose top rung equals
-  ``chunk_rows``, ``ladder_base`` (> 1), ``inputs`` (object) and a hex
-  ``input_digest`` (tools/check_executor.py replays the decision);
+  ``chunk_rows``, ``ladder_base`` (> 1), ``inputs`` (object), a hex
+  ``input_digest`` (tools/check_executor.py replays the decision) and —
+  since the ragged-layout dimension — a ``layout`` of padded|ragged;
 * ``executor_recompile`` events carry ``pass``, ``rows`` (a member of
   that pass's announced ladder) and ``n_shapes`` (int >= 1 — counts
   (rows, len) pairs, so it may exceed the ROW ladder length when the
@@ -35,8 +36,11 @@ elastic worker sidecars).  Contract checked here:
   (non-negative ints) and non-negative per-stage walls
   (``load_s``/``prep_s``/``sweep_s``/``finish_s``/``emit_s``);
 * ``realign_sweep_dispatch`` events carry ``shape`` (three positive
-  ints), ``jobs >= 1``, padded lane count ``g >= jobs`` and
-  ``units >= 1`` (distinct bins sharing the dispatch);
+  ints — padded (R, L, CL), or the ragged (rows_pad, bases_pad, CL)),
+  ``jobs >= 1``, padded lane count ``g >= jobs``, ``units >= 1``
+  (distinct bins sharing the dispatch), and — since the ragged layout —
+  a ``layout`` of padded|ragged plus the per-axis pad-waste fractions
+  ``waste_r``/``waste_l``/``waste_cl``/``waste_g`` in [0, 1];
 * ``fault_injected`` events carry ``site`` (a known injection site),
   ``occurrence`` (int >= 1), ``fault`` (a known fault kind),
   ``inputs`` (object) and a hex ``input_digest``
@@ -209,6 +213,9 @@ def validate(path: str) -> List[str]:
                     all(c in "0123456789abcdef" for c in dig)):
                 err(i, "executor_bucket_selected missing hex "
                        "'input_digest'")
+            if "layout" in d and d["layout"] not in ("padded", "ragged"):
+                err(i, f"executor_bucket_selected unknown layout "
+                       f"{d['layout']!r}")
         elif ev == "executor_recompile":
             if not isinstance(d.get("pass"), str):
                 err(i, "executor_recompile missing string 'pass'")
@@ -274,6 +281,9 @@ def validate(path: str) -> List[str]:
                        "'pipeline_depth'")
             if not isinstance(d.get("donate"), bool):
                 err(i, "realign_plan_selected missing boolean 'donate'")
+            if "layout" in d and d["layout"] not in ("padded", "ragged"):
+                err(i, f"realign_plan_selected unknown layout "
+                       f"{d['layout']!r}")
             if not isinstance(d.get("inputs"), dict):
                 err(i, "realign_plan_selected missing 'inputs' object "
                        "(decision must be replayable)")
@@ -315,6 +325,14 @@ def validate(path: str) -> List[str]:
             if not (isinstance(units, int) and not isinstance(units, bool)
                     and units >= 1):
                 err(i, "realign_sweep_dispatch missing int 'units' >= 1")
+            if "layout" in d and d["layout"] not in ("padded", "ragged"):
+                err(i, f"realign_sweep_dispatch unknown layout "
+                       f"{d['layout']!r}")
+            for field in ("waste_r", "waste_l", "waste_cl", "waste_g"):
+                if field in d and not (_is_num(d[field]) and
+                                       0 <= d[field] <= 1):
+                    err(i, f"realign_sweep_dispatch {field!r} must be a "
+                           "fraction in [0, 1] (per-axis pad waste)")
         elif ev == "fault_injected":
             if d.get("site") not in _FAULT_SITES:
                 err(i, f"fault_injected unknown site {d.get('site')!r}")

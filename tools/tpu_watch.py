@@ -29,8 +29,8 @@ PROBE = ("import jax; d = jax.devices()[0]; "
 
 #: the measurement stages the ledger tracks (probe always re-runs — it
 #: is the window's health check, not evidence to converge on)
-BENCH_STAGES = ("bqsr_race", "pallas", "transform", "flagstat",
-                "bqsr_race8")
+BENCH_STAGES = ("bqsr_race", "pallas", "ragged_race", "transform",
+                "flagstat", "bqsr_race8")
 LEDGER_NAME = "EVIDENCE_LEDGER.json"
 
 
