@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import argparse
 import os
+import sys
 
 from .main import Command, register
 
@@ -84,6 +85,85 @@ def add_executor_args(p: argparse.ArgumentParser) -> None:
                         "ADAM_TPU_RAGGED=0 is the env equivalent)")
 
 
+def add_fleet_args(p: argparse.ArgumentParser) -> None:
+    """The shard-fleet knobs (parallel/shardstream.py): ``-hosts N``
+    turns the command into a supervisor that spawns N worker processes,
+    each streaming its contiguous unit range through the product
+    executor; results merge through the exact monoid, so fleet output
+    is byte-identical to the single-host run."""
+    p.add_argument("-hosts", type=int, default=1,
+                   help="shard the stream across N worker processes "
+                        "(supervisor-spawned elastic fleet; 1 = "
+                        "single-host, the default)")
+    p.add_argument("-unit_rows", type=int, default=None,
+                   help="rows per fleet work unit (the commit/recovery "
+                        "granularity; default ~8 units per host)")
+    p.add_argument("-lease_ttl", type=float, default=None,
+                   help="seconds a worker's heartbeat lease may go "
+                        "stale before the supervisor declares it lost "
+                        "(ADAM_TPU_FLEET_LEASE_TTL_S)")
+    p.add_argument("-max_restarts", type=int, default=None,
+                   help="respawned incarnations per shard before its "
+                        "range redistributes across survivors "
+                        "(ADAM_TPU_FLEET_MAX_RESTARTS)")
+    p.add_argument("-no_shrink", action="store_true",
+                   help="disable shrink-to-fit redistribution after "
+                        "the restart budget (the fleet then fails "
+                        "cleanly typed instead)")
+    p.add_argument("-speculate", action="store_true",
+                   help="deadline-based speculative re-execution of "
+                        "the slowest shard's tail range on an idle "
+                        "survivor (off by default; the per-unit merge "
+                        "dedups, so results never double-count)")
+    p.add_argument("-commit_every", type=int, default=1,
+                   help="work units per durable commit (each commit "
+                        "costs ~3 fsyncs; batch on slow filesystems — "
+                        "a coarser cadence only widens what a lost "
+                        "worker recomputes, never the result)")
+    p.add_argument("-fleet_dir", default=None,
+                   help="fleet control directory (plan/leases/commits; "
+                        "kept for audit when given, temp otherwise)")
+    p.add_argument("-fleet_timeout", type=float, default=900.0,
+                   help="seconds before the supervisor declares the "
+                        "whole fleet stuck (workers that heartbeat and "
+                        "commit are healthy — size this to the run)")
+
+
+def fleet_policy_from(args):
+    from ..resilience.retry import resolve_fleet_policy
+    return resolve_fleet_policy(
+        max_restarts=args.max_restarts,
+        lease_ttl_s=args.lease_ttl,
+        redistribute=False if args.no_shrink else None,
+        speculate=True if args.speculate else None)
+
+
+def fleet_worker_env(args) -> dict:
+    """Environment for fleet workers carrying the CLI's explicitly set
+    executor knobs — workers build their own StreamExecutor and resolve
+    these from the env (the executor's flag/env convention), so a flag
+    that tunes the single-host path must not silently drop the moment
+    ``-hosts`` is added."""
+    from ..parallel.executor import (AUTOTUNE_ENV, LADDER_BASE_ENV,
+                                     PREFETCH_ENV, RAGGED_ENV)
+    from ..resilience.retry import RETRY_BUDGET_ENV
+
+    env = dict(os.environ)
+    if getattr(args, "prefetch_depth", None) is not None:
+        env[PREFETCH_ENV] = str(args.prefetch_depth)
+    if getattr(args, "ladder_base", None) is not None:
+        env[LADDER_BASE_ENV] = str(args.ladder_base)
+    if getattr(args, "no_autotune", False):
+        env[AUTOTUNE_ENV] = "0"
+    if getattr(args, "retry_budget", None) is not None:
+        env[RETRY_BUDGET_ENV] = str(args.retry_budget)
+    if getattr(args, "ragged", False):
+        env[RAGGED_ENV] = "1"
+    elif getattr(args, "no_ragged", False):
+        env[RAGGED_ENV] = "0"
+    return env
+
+
 def executor_opts_from(args) -> dict:
     """argparse namespace -> StreamExecutor keyword overrides (only the
     explicitly set ones, so env vars and autotuning fill the rest)."""
@@ -150,10 +230,42 @@ class FlagStatCommand(Command):
         p.add_argument("-io_procs", type=int, default=1,
                        help="BGZF inflate worker processes (>1 enables; "
                             "byte-identical stream)")
+        p.add_argument("-shard_id", type=int, default=None,
+                       help="run as ONE fleet worker against an "
+                            "existing -fleet_dir (normally the "
+                            "supervisor spawns these; exposed for "
+                            "manual relaunch/debug)")
+        add_fleet_args(p)
         add_executor_args(p)
 
     def run(self, args) -> int:
         from ..ops.flagstat import format_report
+
+        if args.shard_id is not None:
+            if not args.fleet_dir:
+                print("flagstat: -shard_id needs -fleet_dir",
+                      file=sys.stderr)
+                return 2
+            from ..parallel.shardstream import run_shard_worker
+            return run_shard_worker(args.fleet_dir, args.shard_id)
+        if args.hosts > 1:
+            from ..parallel.shardstream import fleet_flagstat
+            if args.chunk_rows != 1 << 22:
+                # don't silently drop an explicitly tuned flag: the
+                # fleet's granularity knob is -unit_rows
+                print("flagstat -hosts: -chunk_rows does not apply to "
+                      "the fleet path (use -unit_rows for the "
+                      "commit/recovery granularity)", file=sys.stderr)
+            failed, passed = fleet_flagstat(
+                args.input, hosts=args.hosts, unit_rows=args.unit_rows,
+                fleet_dir=args.fleet_dir,
+                commit_every=args.commit_every,
+                io_procs=args.io_procs,
+                env=fleet_worker_env(args),
+                timeout_s=args.fleet_timeout,
+                policy=fleet_policy_from(args))
+            print(format_report(failed, passed))
+            return 0
         from ..parallel.pipeline import streaming_flagstat
 
         # streams bounded chunks of the 4-column projection (the reference's
@@ -320,6 +432,7 @@ class TransformCommand(Command):
                             "instead of the fused single-decode streams "
                             "(mirrors ADAM_TPU_FUSE=0). Dataflow only — "
                             "output is byte-identical either way")
+        add_fleet_args(p)
         add_executor_args(p)
         add_parquet_args(p)
 
@@ -330,7 +443,24 @@ class TransformCommand(Command):
         # pass-level resume (workdir = checkpoint dir)
         auto_stream = (not sam_out and not args.checkpoint_dir and
                        should_stream(args, args.input))
-        if args.stream or auto_stream:
+        if args.hosts > 1:
+            from ..parallel.pipeline import resolve_fuse_opt
+            is_parquet = not args.input.endswith((".sam", ".bam"))
+            # resolve the fusion choice the way the pipeline will
+            # (flag wins, ADAM_TPU_FUSE fills) — an env-forced legacy
+            # run must get this same typed refusal, not a traceback
+            fused = resolve_fuse_opt(False if args.no_fuse else None) \
+                is not False
+            if (not args.recalibrate_base_qualities or args.sort_reads
+                    or args.realignIndels or not fused
+                    or not is_parquet or sam_out):
+                print("transform: -hosts shards the fused stream-2 "
+                      "BQSR count — it needs "
+                      "-recalibrate_base_qualities, a Parquet input/"
+                      "output, no -sort_reads/-realignIndels, and the "
+                      "fused dataflow (no -no_fuse)", file=sys.stderr)
+                return 2
+        if args.stream or auto_stream or args.hosts > 1:
             if sam_out:
                 raise SystemExit(
                     "transform -stream writes Parquet datasets; "
@@ -357,6 +487,20 @@ class TransformCommand(Command):
                 realign_opts["layout"] = "ragged"
             elif getattr(args, "no_ragged", False):
                 realign_opts["layout"] = "padded"
+            fleet = None
+            if args.hosts > 1:
+                pol = fleet_policy_from(args)
+                fleet = dict(hosts=args.hosts,
+                             unit_rows=args.unit_rows,
+                             fleet_dir=args.fleet_dir,
+                             snp_path=args.dbsnp_sites,
+                             commit_every=args.commit_every,
+                             env=fleet_worker_env(args),
+                             timeout_s=args.fleet_timeout,
+                             max_restarts=pol.max_restarts,
+                             lease_ttl_s=pol.lease_ttl_s,
+                             redistribute=pol.redistribute,
+                             speculate=pol.speculate)
             n = streaming_transform(
                 args.input, args.output,
                 markdup=args.mark_duplicate_reads,
@@ -374,7 +518,8 @@ class TransformCommand(Command):
                 io_procs=args.io_procs,
                 executor_opts=executor_opts_from(args),
                 realign_opts=realign_opts,
-                fuse=False if args.no_fuse else None)
+                fuse=False if args.no_fuse else None,
+                fleet=fleet)
             if args.timing:
                 from ..instrument import print_report
                 print_report()   # one quiet gate for ALL instrument output

@@ -52,7 +52,11 @@ INFO_TIER = {"probe": 0, "bqsr_race": 1, "pallas": 2, "ragged_race": 3,
 #: can cost at most its own entry, never the window
 STAGE_DEADLINES_S = {"probe": 150.0, "flagstat": 180.0, "transform": 280.0,
                      "bqsr_race": 300.0, "bqsr_race8": 150.0,
-                     "pallas": 240.0, "ragged_race": 300.0}
+                     "pallas": 240.0, "ragged_race": 300.0,
+                     # CPU-mesh fleet scaling (4 full flagstat runs +
+                     # worker spawns); never in the TPU capture order —
+                     # reached only via --worker/--only shard_scale
+                     "shard_scale": 600.0}
 
 TIMEOUTS_ENV = "ADAM_TPU_BENCH_STAGE_TIMEOUTS"
 

@@ -953,7 +953,8 @@ def streaming_transform(input_path: str, output_path: str, *,
                         io_procs: int = 1,
                         executor_opts: Optional[dict] = None,
                         realign_opts: Optional[dict] = None,
-                        fuse: Optional[bool] = None) -> int:
+                        fuse: Optional[bool] = None,
+                        fleet: Optional[dict] = None) -> int:
     """The ``transform`` pipeline over a chunked stream and a device mesh.
 
     Multi-pass, like the reference's shuffle stages (Transform.scala:62-97):
@@ -1020,6 +1021,14 @@ def streaming_transform(input_path: str, output_path: str, *,
     kernel ignores them, so bucket geometry never changes results.
     ``executor_opts`` forwards StreamExecutor knobs (prefetch_depth,
     ladder_base, autotune, donate).
+
+    ``fleet`` (``{"hosts": N, ...}`` — the transform CLI's ``-hosts``)
+    distributes the fused stream-2 RecalTable count across N worker
+    processes via parallel/shardstream.py: supported for the fused,
+    unbinned, Parquet-input dataflow (the count is an exact integer
+    monoid, so the sharded table — and therefore the output — is
+    byte-identical to the single-host run; markdup dup bits and the
+    hoisted MD events ship to the fleet and re-join by global row).
     """
     from ..bqsr.recalibrate import apply_table
     from ..instrument import stage
@@ -1078,6 +1087,17 @@ def streaming_transform(input_path: str, output_path: str, *,
         if ck.has("done") and os.path.isdir(output_path) and any(
                 f.endswith(".parquet") for f in os.listdir(output_path)):
             return ck.meta("done")["total_rows"]
+    if fleet and int(fleet.get("hosts", 1)) > 1 and (
+            fplan["mode"] != "fused" or fplan["binned"] or
+            not is_parquet or not bqsr):
+        # refuse rather than silently run single-host: a dropped hosts
+        # request is the kind of quiet degradation the fleet layer
+        # exists to make impossible
+        raise ValueError(
+            "transform -hosts shards the fused stream-2 count: it "
+            "needs -recalibrate_base_qualities, the fused dataflow "
+            "(no -no_fuse), a Parquet input, and no "
+            "-sort_reads/-realignIndels")
     if fplan["mode"] == "fused":
         return _fused_transform(
             input_path, output_path, plan=fplan, markdup=markdup,
@@ -1088,7 +1108,7 @@ def streaming_transform(input_path: str, output_path: str, *,
             wopts=wopts, row_group_bytes=row_group_bytes,
             io_threads=io_threads, io_procs=io_procs,
             executor_opts=executor_opts, realign_opts=realign_opts,
-            t_start=t_start)
+            t_start=t_start, fleet=fleet)
     # shape buckets / device feed / autotuner for every pass's chunk
     # cycle — replaces the per-pass pad_bucket closures (whose power-of-
     # two buckets each pass re-derived independently)
@@ -1577,7 +1597,8 @@ def _fused_transform(input_path: str, output_path: str, *, plan: dict,
                      wopts: dict, row_group_bytes: Optional[int],
                      io_threads: int, io_procs: int,
                      executor_opts: Optional[dict],
-                     realign_opts: Optional[dict], t_start: float) -> int:
+                     realign_opts: Optional[dict], t_start: float,
+                     fleet: Optional[dict] = None) -> int:
     """The fused dataflow of :func:`streaming_transform` (plan mode
     ``fused``): one decode of the input drives ALL chunk-local work, and
     only the two genuine barriers — the markdup decision and the
@@ -1837,6 +1858,18 @@ def _fused_transform(input_path: str, output_path: str, *, plan: dict,
         rt = None
         if bqsr and ck is not None and ck.has("s2"):
             rt = _recal_from_ck(ck)
+        elif bqsr and fleet and int(fleet.get("hosts", 1)) > 1:
+            # fleet count: stream 2 is the transform's one exact-monoid
+            # re-stream, so it shards across worker processes and the
+            # merged RecalTable — and therefore the output — is
+            # byte-identical to the single-host count (shardstream's
+            # per-unit commit/merge contract)
+            rt = _fleet_count_pass(
+                input_path, fleet=fleet, snp_table=snp_table, dup=dup,
+                mdstore=mdstore, max_rgid=max_rgid,
+                bucket_len=bucket_len)
+            if ck is not None:
+                _save_recal(ck, rt, "s2")
         elif bqsr:
             rt = _fused_count_pass(
                 ex=ex, workdir=workdir, raw_path=raw_path, plan=plan,
@@ -1971,6 +2004,44 @@ def _fused_count_pass(*, ex, workdir, raw_path, plan, mesh, snp_table,
         md_info_fn=None if mdstore is None else
         (lambda table: mdstore.md_info_for(
             column_int64(table, RIDX_COL))))
+
+
+def _fleet_count_pass(input_path, *, fleet, snp_table, dup, mdstore,
+                      max_rgid, bucket_len):
+    """Stream 2, fleet-sharded (parallel/shardstream.py): the same
+    projected Parquet re-read the single-host unbinned count walks,
+    split into contiguous unit ranges across worker processes; per-unit
+    count tensors merge through the RecalTable monoid.  Dup bits and
+    the stream-1 MD event store ship once via the fleet dir and re-join
+    per shard by global row index — exactly the ``__ridx`` joins of the
+    single-host walk, keyed by unit offset instead of a carried column.
+    """
+    from ..resilience.retry import resolve_fleet_policy
+    from .shardstream import fleet_bqsr_count
+
+    snp_path = fleet.get("snp_path")
+    if snp_table is not None and not snp_path:
+        raise ValueError(
+            "fleet transform needs the dbsnp PATH (workers rebuild the "
+            "mask themselves); pass fleet={'snp_path': ...}")
+    cols = ["flags", "start", "recordGroupId", "cigar"]
+    if snp_table is not None:
+        cols.append("referenceName")
+    cols += ["sequence", "qual"]
+    policy = resolve_fleet_policy(
+        max_restarts=fleet.get("max_restarts"),
+        lease_ttl_s=fleet.get("lease_ttl_s"),
+        redistribute=fleet.get("redistribute"),
+        speculate=fleet.get("speculate"))
+    return fleet_bqsr_count(
+        input_path, hosts=int(fleet["hosts"]),
+        n_rg_run=max(max_rgid + 1, 1), bucket_len=bucket_len,
+        columns=cols, dup=dup, mdstore=mdstore, snp_path=snp_path,
+        unit_rows=fleet.get("unit_rows"),
+        fleet_dir=fleet.get("fleet_dir"), policy=policy,
+        env=fleet.get("env"),
+        commit_every=int(fleet.get("commit_every", 1)),
+        timeout_s=float(fleet.get("timeout_s", 900.0)))
 
 
 def _fused_emit_stream(*, ex, raw_path, output_path, plan, mesh, dup, rt,

@@ -1,0 +1,1457 @@
+"""Pod-scale elastic sharded streaming: lose a worker mid-stream, keep
+the run.
+
+The reference's Spark layer got fault tolerance for free (lineage +
+task retry, SURVEY §5); our streaming hot path was single-host — one
+preempted worker killed the whole run.  This module expresses the
+streaming workloads as sharded MapReduce over a multi-host fleet, the
+DrJAX broadcast/map/reduce decomposition (arXiv:2403.07128) at process
+granularity:
+
+* **broadcast** — a pure, replayable shard plan
+  (:func:`decide_shard_plan`, event ``shard_plan_selected``) assigns
+  contiguous *unit* ranges (fixed ``unit_rows``-row slices of the
+  input) to hosts.  Contiguous ranges are the locality axis: for a
+  position-sorted input they are contiguous genome ranges, and the
+  genome partitioner (``GenomicRegionPartitioner``) optionally snaps
+  shard boundaries onto genome-bin edges (``unit_bins``).
+* **map** — each host runs the EXISTING single-host machinery on its
+  shard: the shape-bucketed executor, the PR 5 retry→split→CPU-degrade
+  ladder per chunk, the obs/metrics plane — all compose per-host
+  unchanged.  Workers never share a jax mesh, so a lost peer cannot
+  wedge a collective (the design parallel/elastic.py already argues:
+  XLA SPMD cannot drop a peer mid-program; CPU jaxlibs do not even
+  implement multiprocess computations).  The control plane is the
+  fleet directory: atomic JSON (checkpoint.atomic_write discipline)
+  for plan / assignment / lease / progress, immutable ``.npz`` commit
+  files for results.
+* **reduce** — per-shard results merge through the existing monoid
+  paths: flagstat 18×2 counter blocks sum, RecalTable count tensors
+  sum (``tables_to_recal``), per-worker obs sidecars fold into the
+  supervisor's registry exactly like the elastic supervisor's merge.
+
+The robustness core: every unit's result is committed durably and
+*per unit* (result file first, progress marker second), so a worker
+preempted mid-stream loses only its uncommitted units.  The elastic
+supervisor detects loss via process exit **or heartbeat lease expiry**
+(a hung worker shows no exit code; the stale lease converts "silent"
+into "dead", and the supervisor fences it with SIGKILL before
+reassigning).  Recovery is the pure
+:func:`decide_shard_reassignment` (event ``shard_reassigned``):
+respawn a new incarnation of the same shard (resuming from committed
+units), or — past the restart budget — redistribute the remaining
+range across survivors (shrink-to-fit).  Deadline-based speculative
+execution (:func:`decide_shard_speculation`, off by default) re-runs
+the slowest shard's tail range on an idle survivor; the merge
+deduplicates units (first committer wins, by (incarnation, shard,
+seq) order), so duplicated work can never double-count — unit results
+are exact integer monoids, so WHO computed a unit is value-irrelevant.
+
+Re-decode is honest: a respawned worker re-reads whatever input bytes
+it must traverse to reach its remaining range, and those bytes land in
+the I/O ledger (per-worker sidecars; the supervisor's fold sums them)
+— never silently absorbed.
+
+tools/check_metrics.py validates the event schemas;
+tools/check_executor.py replays every plan/reassignment decision;
+tests/test_shardstream.py pins the chaos matrix (SIGKILL / latency /
+torn-checkpoint × shard → byte-identical or cleanly typed).
+"""
+
+from __future__ import annotations
+
+import glob as _glob
+import hashlib
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .. import obs
+from ..checkpoint import atomic_write
+from ..resilience import faults
+from ..resilience.retry import (RETRY_SEED_ENV, FleetPolicy,
+                                resolve_fleet_policy)
+
+#: fleet-dir layout (every path is relative to the fleet dir)
+PLAN_FILE = "plan.json"
+DONE_FILE = "done"
+ASSIGN_DIR = "assign"
+EXTRA_DIR = "extra"
+LEASE_DIR = "leases"
+PROGRESS_DIR = "progress"
+COMMIT_DIR = "commits"
+LOG_DIR = "logs"
+
+#: per-worker CPU budget (Arrow decode/IO pools), stamped by the
+#: supervisor when ``worker_cpus`` is set — hosts emulated on one box
+#: must not oversubscribe each other
+FLEET_WORKER_CPUS_ENV = "ADAM_TPU_FLEET_WORKER_CPUS"
+
+
+# ---------------------------------------------------------------------------
+# small helpers: runs encoding + atomic fleet-dir JSON
+# ---------------------------------------------------------------------------
+
+def _to_runs(units: Sequence[int]) -> List[List[int]]:
+    """Sorted unit ids -> compact [lo, hi) runs (events record runs, so
+    a reassignment of a million units is a few ints, not a list)."""
+    runs: List[List[int]] = []
+    for u in sorted(set(int(u) for u in units)):
+        if runs and runs[-1][1] == u:
+            runs[-1][1] = u + 1
+        else:
+            runs.append([u, u + 1])
+    return runs
+
+
+def _from_runs(runs: Sequence[Sequence[int]]) -> List[int]:
+    out: List[int] = []
+    for lo, hi in runs:
+        out.extend(range(int(lo), int(hi)))
+    return out
+
+
+def _write_json(path: str, doc: dict, fault_site: Optional[str] = None
+                ) -> None:
+    atomic_write(path, json.dumps(doc, sort_keys=True),
+                 fault_site=fault_site)
+
+
+def _read_json(path: str) -> Optional[dict]:
+    """Tolerant read: missing or torn file -> None (the atomic-write
+    discipline means a torn TARGET never exists; a torn TMP left by a
+    crashed writer is simply not the target)."""
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, ValueError):
+        return None
+    return doc if isinstance(doc, dict) else None
+
+
+def _digest(inputs: dict) -> str:
+    return hashlib.sha256(
+        json.dumps(inputs, sort_keys=True).encode()).hexdigest()[:16]
+
+
+# ---------------------------------------------------------------------------
+# the pure decisions
+# ---------------------------------------------------------------------------
+
+def decide_shard_plan(*, n_units: int, n_hosts: int, unit_rows: int,
+                      total_rows: int,
+                      unit_bins: Optional[Sequence[int]] = None) -> dict:
+    """The fleet's broadcast step — PURE.
+
+    Contiguous balanced unit ranges per host (locality: contiguous file
+    order is contiguous genome order for a sorted input).  When
+    ``unit_bins`` (the genome-partitioner bin of each unit's first row)
+    is given, interior shard boundaries snap to the nearest bin
+    transition within a small window, so a shard boundary prefers a
+    genome-bin edge over splitting a bin across hosts.  Recorded in
+    full (``inputs`` + ``input_digest``) by ``shard_plan_selected`` so
+    tools/check_executor.py replays the decision offline (the
+    ``decide_plan`` convention).
+    """
+    inputs = dict(n_units=int(n_units), n_hosts=int(n_hosts),
+                  unit_rows=int(unit_rows), total_rows=int(total_rows),
+                  unit_bins=None if unit_bins is None
+                  else [int(b) for b in unit_bins])
+    reasons = ["contiguous"]
+    hosts = max(min(inputs["n_hosts"], inputs["n_units"]), 1)
+    if hosts < inputs["n_hosts"]:
+        reasons.append("clamped-to-units")
+    bounds = [i * inputs["n_units"] // hosts for i in range(hosts + 1)]
+    bins = inputs["unit_bins"]
+    if bins is not None and len(bins) == inputs["n_units"] and hosts > 1:
+        window = max(inputs["n_units"] // (4 * hosts), 1)
+        snapped = False
+        for i in range(1, hosts):
+            b = bounds[i]
+            lo = max(bounds[i - 1] + 1, b - window)
+            hi = min(bounds[i + 1] - 1, b + window)
+            best = None
+            for j in range(lo, hi + 1):
+                if 0 < j < len(bins) and bins[j] != bins[j - 1]:
+                    if best is None or abs(j - b) < abs(best - b):
+                        best = j
+            if best is not None and best != b:
+                bounds[i] = best
+                snapped = True
+        if snapped:
+            reasons.append("bin-snap")
+    assignments = [[bounds[i], bounds[i + 1]] for i in range(hosts)]
+    return dict(n_hosts=hosts, n_units=inputs["n_units"],
+                unit_rows=inputs["unit_rows"],
+                assignments=assignments, reason="+".join(reasons),
+                inputs=inputs, input_digest=_digest(inputs))
+
+
+def decide_shard_reassignment(*, shard: int, incarnation: int,
+                              restarts_used: int, max_restarts: int,
+                              remaining_runs: Sequence[Sequence[int]],
+                              survivors: Sequence[int],
+                              redistribute: bool,
+                              error_code: str) -> dict:
+    """One dead/lost shard's next action — PURE.
+
+    ``action`` ∈ ``none`` (nothing uncommitted remains) / ``respawn``
+    (a new incarnation of the same shard resumes the remaining range) /
+    ``redistribute`` (shrink-to-fit: the remaining range splits into
+    contiguous slices across the sorted survivors) / ``fail`` (restart
+    budget exhausted and nowhere to shrink to).  Recorded in full by
+    ``shard_reassigned`` (cause ``death``); tools/check_executor.py
+    replays it.
+    """
+    inputs = dict(shard=int(shard), incarnation=int(incarnation),
+                  restarts_used=int(restarts_used),
+                  max_restarts=int(max_restarts),
+                  remaining_runs=[[int(a), int(b)]
+                                  for a, b in remaining_runs],
+                  survivors=sorted(int(s) for s in survivors),
+                  redistribute=bool(redistribute),
+                  error_code=str(error_code))
+    remaining = _from_runs(inputs["remaining_runs"])
+    action, new_inc, splits, reason = "fail", None, [], ""
+    if not remaining:
+        action, reason = "none", "nothing-uncommitted"
+    elif inputs["restarts_used"] < inputs["max_restarts"]:
+        action = "respawn"
+        new_inc = inputs["incarnation"] + 1
+        reason = (f"{inputs['error_code']}:restart "
+                  f"{inputs['restarts_used'] + 1}/{inputs['max_restarts']}")
+    elif inputs["redistribute"] and inputs["survivors"]:
+        action = "redistribute"
+        surv = inputs["survivors"]
+        n = len(remaining)
+        for i, s in enumerate(surv):
+            lo = i * n // len(surv)
+            hi = (i + 1) * n // len(surv)
+            if hi > lo:
+                splits.append([s, _to_runs(remaining[lo:hi])])
+        reason = f"{inputs['error_code']}:shrink-to-fit:{len(surv)}"
+    else:
+        reason = (f"{inputs['error_code']}:restarts-exhausted:"
+                  "no-survivors" if not inputs["survivors"]
+                  else f"{inputs['error_code']}:restarts-exhausted:"
+                  "redistribute-off")
+    return dict(action=action, new_incarnation=new_inc, splits=splits,
+                reason=reason, inputs=inputs,
+                input_digest=_digest(inputs))
+
+
+def decide_shard_speculation(*, candidates: Sequence[Sequence],
+                             idle: Sequence[int],
+                             factor: float) -> dict:
+    """Whether to speculatively re-run the slowest shard's tail — PURE.
+
+    ``candidates`` is ``[[shard, remaining_runs, rate], ...]`` for
+    every shard with uncommitted units (``rate`` = committed units per
+    second, rounded); ``idle`` the draining shards with spare capacity.
+    The slowest shard (largest ETA; ties -> lowest id) is speculated
+    when the best candidate rate is at least ``factor`` times its rate
+    (or it has made no progress at all), handing the LATTER half of its
+    remaining range to the first idle survivor.  The merge dedups per
+    unit, so the original keeps running — first commit wins and no unit
+    ever counts twice.  Recorded by ``shard_reassigned`` (cause
+    ``speculation``).
+    """
+    inputs = dict(
+        candidates=[[int(s), [[int(a), int(b)] for a, b in runs],
+                     round(float(r), 6)] for s, runs, r in candidates],
+        idle=sorted(int(i) for i in idle),
+        factor=round(float(factor), 6))
+    out = dict(action="none", victim=None, target=None, tail_runs=[],
+               reason="", inputs=inputs, input_digest=_digest(inputs))
+    if not inputs["candidates"] or not inputs["idle"]:
+        out["reason"] = "no-candidates" if not inputs["candidates"] \
+            else "no-idle-survivor"
+        return out
+    best_rate = max(r for _, _, r in inputs["candidates"])
+
+    def eta(entry):
+        s, runs, r = entry
+        n = sum(hi - lo for lo, hi in runs)
+        return (n / r) if r > 0 else float("inf")
+
+    victim = sorted(inputs["candidates"],
+                    key=lambda e: (-eta(e), e[0]))[0]
+    v_shard, v_runs, v_rate = victim
+    if v_rate > 0 and best_rate < inputs["factor"] * v_rate:
+        out["reason"] = "within-deadline"
+        return out
+    remaining = _from_runs(v_runs)
+    if not remaining:
+        out["reason"] = "victim-empty"
+        return out
+    tail = remaining[len(remaining) // 2:] or remaining[-1:]
+    out.update(action="speculate", victim=v_shard,
+               target=inputs["idle"][0], tail_runs=_to_runs(tail),
+               reason=f"eta-straggler:rate={v_rate}:best={best_rate}")
+    return out
+
+
+def _emit_reassigned(cause: str, d: dict, **extra) -> None:
+    obs.registry().counter("shard_reassignments", cause=cause).inc()
+    fields = dict(cause=cause, action=d["action"], reason=d["reason"],
+                  inputs=d["inputs"], input_digest=d["input_digest"])
+    if cause == "death":
+        fields.update(shard=d["inputs"]["shard"],
+                      new_incarnation=d["new_incarnation"],
+                      splits=d["splits"])
+    else:
+        fields.update(shard=d["victim"], victim=d["victim"],
+                      target=d["target"], tail_runs=d["tail_runs"])
+    fields.update(extra)
+    obs.emit("shard_reassigned", **fields)
+
+
+# ---------------------------------------------------------------------------
+# input sizing + range readers (the locality-aware map side)
+# ---------------------------------------------------------------------------
+
+def count_input_rows(path: str) -> int:
+    """Total reads in the input — exact.  Parquet: footer sums (free).
+    SAM: a byte scan counting record lines (no field parse).  BAM: a
+    full decode walk (documented cost; the fleet plan needs the row
+    count once, and the supervisor pays it, not every worker)."""
+    p = str(path)
+    if p.endswith(".sam"):
+        n = 0
+        with open(p, "rb") as f:
+            for line in f:
+                if line and not line.startswith(b"@") and line.strip():
+                    n += 1
+        return n
+    if p.endswith(".bam"):
+        from ..io.stream import open_read_stream
+        return sum(t.num_rows for t in
+                   open_read_stream(p, columns=["flags"],
+                                    chunk_rows=1 << 20))
+    import pyarrow.parquet as pq
+    if os.path.isdir(p):
+        return sum(pq.ParquetFile(os.path.join(p, f)).metadata.num_rows
+                   for f in sorted(os.listdir(p))
+                   if f.endswith(".parquet"))
+    return pq.ParquetFile(p).metadata.num_rows
+
+
+def unit_bins_for(path: str, unit_rows: int, n_units: int,
+                  n_hosts: int) -> Optional[List[int]]:
+    """Genome-partitioner bin of each unit's FIRST row (the plan's
+    locality hint), from one projected 2-int-column scan of a Parquet
+    input.  Best-effort: None on any trouble (SAM/BAM input, missing
+    columns, unknown contigs) — the plan then stays plain contiguous."""
+    p = str(path)
+    if p.endswith(".sam") or p.endswith(".bam"):
+        return None
+    try:
+        from ..io.parquet import iter_tables
+        from ..packing import column_int64
+        from .partitioner import GenomicRegionPartitioner
+        from .pipeline import _prescan_seq_dict
+
+        seq_dict = _prescan_seq_dict(p, unit_rows)
+        if not len(list(seq_dict)):
+            return None
+        part = GenomicRegionPartitioner.from_dictionary(
+            max(n_hosts, 1), seq_dict)
+        refids = np.zeros(n_units, np.int64)
+        starts = np.zeros(n_units, np.int64)
+        off = 0
+        for t in iter_tables(p, columns=["referenceId", "start"],
+                             chunk_rows=max(unit_rows, 1 << 16)):
+            n = t.num_rows
+            first = -(-off // unit_rows)        # ceil: next boundary
+            while first * unit_rows < off + n and first < n_units:
+                row = first * unit_rows - off
+                refids[first] = column_int64(t, "referenceId", -1)[row]
+                starts[first] = column_int64(t, "start", 0)[row]
+                first += 1
+            off += n
+        return [int(b) for b in part.partition(refids,
+                                               np.maximum(starts, 0))]
+    except Exception:  # noqa: BLE001 — locality is a hint, never fatal
+        return None
+
+
+def _rebatch_units(tables, first_unit: int, unit_rows: int):
+    """Yield (unit_id, table) with exact unit boundaries from a stream
+    of arbitrarily-chunked tables starting at global row
+    first_unit*unit_rows."""
+    import pyarrow as pa
+
+    unit = first_unit
+    parts: list = []
+    have = 0
+    for t in tables:
+        parts.append(t)
+        have += t.num_rows
+        while have >= unit_rows:
+            whole = pa.concat_tables(parts)
+            yield unit, whole.slice(0, unit_rows)
+            rest = whole.slice(unit_rows)
+            parts = [rest] if rest.num_rows else []
+            have -= unit_rows
+            unit += 1
+    if have:
+        yield unit, pa.concat_tables(parts)
+
+
+def _rg_compressed_bytes(rg_meta, roots: Optional[set]) -> int:
+    total = 0
+    for c in range(rg_meta.num_columns):
+        col = rg_meta.column(c)
+        root = col.path_in_schema.split(".", 1)[0]
+        if roots is None or root in roots:
+            total += col.total_compressed_size
+    return total
+
+
+def _parquet_range_tables(path: str, row_lo: int, row_hi: int,
+                          columns: Optional[Sequence[str]],
+                          io_kind: str, io_pass: str):
+    """Tables covering global rows [row_lo, row_hi) of a Parquet
+    file/dataset, reading ONLY the overlapping row groups (the locality
+    payoff: a shard's I/O is its range, not the file).  Bytes actually
+    read land in the I/O ledger under ``io_pass`` (projected,
+    compressed — the honest-accounting currency)."""
+    import pyarrow.parquet as pq
+
+    files = sorted(os.path.join(path, f) for f in os.listdir(path)
+                   if f.endswith(".parquet")) \
+        if os.path.isdir(path) else [path]
+    roots = None if columns is None \
+        else {c.split(".", 1)[0] for c in columns}
+    base = 0
+    for fpath in files:
+        pf = pq.ParquetFile(fpath)
+        md = pf.metadata
+        nr = md.num_rows
+        if base + nr <= row_lo:
+            base += nr
+            continue
+        if base >= row_hi:
+            break
+        gb = base
+        for g in range(md.num_row_groups):
+            rg = md.row_group(g)
+            gn = rg.num_rows
+            if gb + gn > row_lo and gb < row_hi and gn:
+                obs.ioledger.record(io_kind,
+                                    _rg_compressed_bytes(rg, roots),
+                                    io_pass)
+                tbl = pf.read_row_group(
+                    g, columns=list(columns) if columns else None)
+                s = max(row_lo - gb, 0)
+                e = min(row_hi - gb, gn)
+                yield tbl.slice(s, e - s)
+            gb += gn
+        base += nr
+
+
+def _unit_tables(path: str, units: Sequence[int], unit_rows: int,
+                 columns: Optional[Sequence[str]], io_kind: str,
+                 io_pass: str, io_procs: int = 1):
+    """(unit_id, table) pairs for the requested units, contiguous run
+    by contiguous run.
+
+    Parquet: row-group skip — only overlapping groups decode.  SAM/BAM:
+    one forward stream per worker; rows before the shard's first unit
+    are decoded-and-skipped (there is no record index to seek by), and
+    that traversal is counted by the stream opener's ledger hook — the
+    honest re-decode cost of recovery on text/BGZF inputs."""
+    units = sorted(set(int(u) for u in units))
+    if not units:
+        return
+    runs = _to_runs(units)
+    p = str(path)
+    if not (p.endswith(".sam") or p.endswith(".bam")):
+        for lo, hi in runs:
+            yield from _rebatch_units(
+                _parquet_range_tables(p, lo * unit_rows, hi * unit_rows,
+                                      columns, io_kind, io_pass),
+                lo, unit_rows)
+        return
+    from ..io.stream import open_read_stream
+
+    with obs.ioledger.pass_scope(io_pass):
+        stream = open_read_stream(p, columns=columns,
+                                  chunk_rows=unit_rows,
+                                  io_procs=io_procs)
+    want = set(units)
+    last = units[-1]
+    for unit, table in _rebatch_units(iter(stream), 0, unit_rows):
+        if unit in want:
+            yield unit, table
+        if unit >= last:
+            break
+
+
+# ---------------------------------------------------------------------------
+# worker-side task runtimes (the map functions)
+# ---------------------------------------------------------------------------
+
+def _flagstat_runtime(spec: dict):
+    """Per-unit 18x2 flagstat counter blocks through the product
+    dispatch ladder (pad to the canonical rung, retry/split/CPU-degrade
+    — parallel/pipeline.streaming_flagstat's padded path, per unit)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..ops.flagstat import (flagstat_kernel_wire32,
+                                flagstat_wire32_sharded)
+    from ..platform import is_tpu_backend
+    from .executor import StreamExecutor
+    from .mesh import make_mesh, reads_sharding
+    from .pipeline import _wire32_from_table
+
+    mesh = make_mesh()
+    on_tpu = is_tpu_backend()
+    ex = StreamExecutor(mesh, int(spec["unit_rows"]), on_tpu=on_tpu)
+    pex = ex.begin_pass("flagstat", bytes_per_row=4.0)
+    impl = os.environ.get("ADAM_TPU_FLAGSTAT_IMPL", "auto")
+    if impl == "pallas" or (impl == "auto" and on_tpu):
+        from ..ops.flagstat_pallas import flagstat_wire32_sharded_pallas
+        kernel = flagstat_wire32_sharded_pallas(mesh,
+                                                interpret=not on_tpu,
+                                                donate=pex.donate)
+    else:
+        kernel = flagstat_wire32_sharded(mesh, donate=pex.donate)
+    sharding = reads_sharding(mesh)
+    mesh_mult = max(getattr(mesh, "size", 1) or 1, 1)
+
+    def pad(w):
+        n_pad = pex.pad_rows(len(w))
+        if n_pad != len(w):
+            return np.concatenate(
+                [w, np.zeros(n_pad - len(w), np.uint32)])
+        return w
+
+    def host_cpu(wire_padded):
+        with jax.default_device(jax.devices("cpu")[0]):
+            return np.asarray(flagstat_kernel_wire32(
+                jnp.asarray(wire_padded))).astype(np.int64)
+
+    def halves(w, err):
+        rows = len(w)
+        mid = max((rows // 2) // mesh_mult, 1) * mesh_mult
+        if rows <= mesh_mult or mid >= rows:
+            raise err
+        return sub(w[:mid]) + sub(w[mid:])
+
+    def sub(w):
+        padded = pad(w)
+        c = pex.dispatch(
+            "count-split",
+            lambda attempt: kernel(jax.device_put(padded, sharding)),
+            split=lambda e: halves(w, e),
+            fallback=lambda e: host_cpu(padded))
+        return np.asarray(c).astype(np.int64)
+
+    def unit_result(unit_id: int, table) -> Dict[str, np.ndarray]:
+        wire = _wire32_from_table(table)
+        padded = pad(wire)
+        counts = pex.dispatch(
+            "count",
+            lambda attempt: kernel(jax.device_put(padded, sharding)),
+            split=lambda e: halves(wire, e),
+            fallback=lambda e: host_cpu(padded))
+        obs.chunk_processed("flagstat", table.num_rows,
+                            bytes_in=4 * table.num_rows)
+        return {"counts": np.asarray(counts).astype(np.int64)}
+
+    return unit_result, ex
+
+
+#: the 7 RecalTable count-tensor keys a bqsr commit stores
+_BQSR_KEYS = tuple(f"t{i}" for i in range(7))
+
+
+def _bqsr_runtime(spec: dict):
+    """Per-unit RecalTable count tensors through the product count path
+    (``count_tables_device``), joining the coordinator's dup bits and
+    hoisted MD events back by global row — the fused stream 2, one
+    shard's slice at a time."""
+    import jax
+
+    from ..bqsr.recalibrate import (_COUNT_IMPL_ENV, count_tables_device)
+    from ..packing import pack_reads
+    from ..platform import is_tpu_backend
+    from .executor import StreamExecutor
+    from .mesh import make_mesh
+    from .pipeline import _MdEventStore, _apply_dup_bits
+
+    params = spec["params"]
+    n_rg_run = int(params["n_rg_run"])
+    bucket_len = int(params["bucket_len"])
+    unit_rows = int(spec["unit_rows"])
+    fleet_dir = spec["fleet_dir"]
+
+    dup = None
+    if params.get("has_dup"):
+        dup = np.load(os.path.join(fleet_dir, "dup.npy"),
+                      mmap_mode="r")
+    mdstore = None
+    if params.get("has_md"):
+        z = np.load(os.path.join(fleet_dir, "md.npz"))
+        mdstore = _MdEventStore()
+        mdstore.has_md = z["has_md"]
+        mdstore.ev_rows = z["ev_rows"]
+        mdstore.ev_pos = z["ev_pos"]
+    snp_table = None
+    if params.get("snp_path"):
+        from ..models.snptable import SnpTable
+        snp_table = SnpTable.from_vcf(params["snp_path"])
+
+    mesh = make_mesh()
+    ex = StreamExecutor(mesh, unit_rows, on_tpu=is_tpu_backend())
+    pex = ex.begin_pass(
+        "s2", bytes_per_row=2.0 * max(bucket_len, 1) + 64.0)
+
+    def cpu_fallback(table, batch, md_info):
+        old = os.environ.get(_COUNT_IMPL_ENV)
+        os.environ[_COUNT_IMPL_ENV] = "host"
+        try:
+            with jax.default_device(jax.devices("cpu")[0]):
+                out = count_tables_device(
+                    table, batch, snp_table, n_read_groups=n_rg_run,
+                    mesh=None, md_info=md_info)
+        finally:
+            if old is None:
+                os.environ.pop(_COUNT_IMPL_ENV, None)
+            else:
+                os.environ[_COUNT_IMPL_ENV] = old
+        return tuple(np.asarray(a) for a in out)
+
+    def unit_result(unit_id: int, table) -> Dict[str, np.ndarray]:
+        n = table.num_rows
+        lo = unit_id * unit_rows
+        if dup is not None:
+            table = _apply_dup_bits(table, np.asarray(dup[lo:lo + n]))
+        md_info = None if mdstore is None else \
+            mdstore.md_info_for(np.arange(lo, lo + n, dtype=np.int64))
+        batch = pack_reads(table,
+                           pad_rows_to=pex.pad_rows(n, bucket_len),
+                           bucket_len=bucket_len)
+        out = pex.dispatch(
+            "count",
+            lambda attempt, t=table, b=batch, mi=md_info:
+                count_tables_device(
+                    t, b, snp_table, n_read_groups=n_rg_run,
+                    mesh=mesh, donate=pex.donate and attempt == 1,
+                    md_info=mi, layout="padded"),
+            fallback=lambda e, t=table, b=batch, mi=md_info:
+                cpu_fallback(t, b, mi))
+        obs.chunk_processed("s2", n, bytes_in=table.nbytes)
+        return {k: np.asarray(a).astype(np.int64)
+                for k, a in zip(_BQSR_KEYS, out)}
+
+    return unit_result, ex
+
+
+_RUNTIMES: Dict[str, Callable] = {"flagstat": _flagstat_runtime,
+                                  "bqsr_count": _bqsr_runtime}
+
+def _task_io(spec: dict) -> Tuple[Optional[List[str]], str, str]:
+    """Per-task range-reader configuration: (projected columns, ledger
+    kind, ledger pass) — the same projections the single-host passes
+    read, so fleet and single-host runs charge identical I/O."""
+    if spec["task"] == "flagstat":
+        from ..io.dispatch import FLAGSTAT_COLUMNS
+        return list(FLAGSTAT_COLUMNS), "decoded", "flagstat"
+    return list(spec["params"]["columns"]), "reread", "s2"
+
+
+# ---------------------------------------------------------------------------
+# worker
+# ---------------------------------------------------------------------------
+
+class _Heartbeat:
+    """The worker's lease renewal loop: every ``heartbeat_s`` fire the
+    ``shard_lease`` fault site, then atomically rewrite the lease file.
+    The supervisor reads the file's mtime; a stale lease past the TTL
+    is a lost worker.  An injected lease error is treated as fatal FOR
+    THIS WORKER (typed stderr line, hard exit) — the fleet layer, not
+    the worker, owns recovery."""
+
+    def __init__(self, path: str, heartbeat_s: float, incarnation: int):
+        self.path = path
+        self.heartbeat_s = heartbeat_s
+        self.incarnation = incarnation
+        self._stop = threading.Event()
+        self._seq = 0
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="shard-lease")
+
+    def start(self) -> "_Heartbeat":
+        self._beat()                    # lease exists before any work
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def _beat(self) -> None:
+        faults.fire("shard_lease", path=self.path)
+        self._seq += 1
+        _write_json(self.path, dict(seq=self._seq, pid=os.getpid(),
+                                    incarnation=self.incarnation))
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.heartbeat_s):
+            try:
+                self._beat()
+            except faults.InjectedFault as e:
+                sys.stderr.write(
+                    f"shard-worker: lease renewal failed (typed): "
+                    f"{type(e).__name__}: {e}\n")
+                sys.stderr.flush()
+                os._exit(13)
+            except OSError as e:        # fleet dir gone: supervisor died
+                sys.stderr.write(
+                    f"shard-worker: lease write failed: {e}\n")
+                os._exit(14)
+
+
+def _commit_unit_results(fleet_dir: str, shard: int, incarnation: int,
+                         seq: int, results: List[Tuple[int, dict]]
+                         ) -> str:
+    """One immutable commit file: unit ids + their result arrays,
+    written tmp+rename (never torn).  Returns the committed path."""
+    arrays: Dict[str, np.ndarray] = {
+        "units": np.array([u for u, _ in results], np.int64)}
+    for key in results[0][1]:
+        arrays[key] = np.stack([r[key] for _, r in results])
+    path = os.path.join(fleet_dir, COMMIT_DIR,
+                        f"shard{shard}-inc{incarnation}-{seq:06d}.npz")
+    fd, tmp = tempfile.mkstemp(dir=os.path.dirname(path),
+                               suffix=".tmp")
+    try:
+        with os.fdopen(fd, "wb") as f:
+            np.savez(f, **arrays)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+    return path
+
+
+def run_shard_worker(fleet_dir: str, shard: int) -> int:
+    """One fleet worker: read the plan + this shard's assignment,
+    stream the assigned unit ranges through the product executor,
+    commit each unit's result durably (commit file, then progress
+    marker), then drain — pick up redistributed / speculative extra
+    units until the supervisor writes the ``done`` file.
+
+    Recovery contract: everything before the last progress marker is
+    lost-proof; a respawned incarnation recomputes only uncommitted
+    units (units any OTHER worker already committed are skipped too —
+    the supervisor prunes them from the respawn assignment, and the
+    merge dedups regardless)."""
+    faults.fire("worker_proc")
+    spec = _read_json(os.path.join(fleet_dir, PLAN_FILE))
+    if spec is None:
+        print(f"shard-worker: no readable plan in {fleet_dir}",
+              file=sys.stderr)
+        return 2
+    spec = dict(spec, fleet_dir=fleet_dir)
+    assign_path = os.path.join(fleet_dir, ASSIGN_DIR,
+                               f"shard{shard}.json")
+    assign = _read_json(assign_path) or {}
+    my_inc = int(assign.get("incarnation", 0))
+    units = _from_runs(assign.get("runs", []))
+    progress_path = os.path.join(fleet_dir, PROGRESS_DIR,
+                                 f"shard{shard}.json")
+    prog = _read_json(progress_path) or {}
+    done_units = set(_from_runs(prog.get("done_runs", [])))
+
+    obs.registry().gauge("shard_id").set(shard)
+    obs.registry().gauge("shard_incarnation").set(my_inc)
+
+    hb = _Heartbeat(
+        os.path.join(fleet_dir, LEASE_DIR, f"shard{shard}.json"),
+        float(spec["policy"]["heartbeat_s"]), my_inc).start()
+    unit_result, ex = _RUNTIMES[spec["task"]](spec)
+    columns, io_kind, io_pass = _task_io(spec)
+    unit_rows = int(spec["unit_rows"])
+    commit_every = max(int(spec.get("commit_every", 1)), 1)
+    seq = 0
+    pending: List[Tuple[int, dict]] = []
+
+    def flush() -> None:
+        nonlocal seq
+        if not pending:
+            return
+        seq += 1
+        _commit_unit_results(fleet_dir, shard, my_inc, seq, pending)
+        done_units.update(u for u, _ in pending)
+        pending.clear()
+        # marker AFTER the commit file: a crash between them only
+        # recomputes (merge dedups); the reverse order could mark work
+        # that never landed.  The checkpoint_write fault site tears the
+        # in-flight tmp here — the chaos matrix's torn-marker cell.
+        _write_json(progress_path,
+                    dict(done_runs=_to_runs(sorted(done_units)),
+                         incarnation=my_inc),
+                    fault_site="checkpoint_write")
+
+    def process(unit_ids: Sequence[int]) -> None:
+        todo = [u for u in unit_ids if u not in done_units]
+        for unit, table in _unit_tables(
+                spec["input"], todo, unit_rows, columns, io_kind,
+                io_pass, io_procs=int(spec.get("io_procs", 1))):
+            pending.append((unit, unit_result(unit, table)))
+            if len(pending) >= commit_every:
+                flush()
+        flush()
+
+    try:
+        process(units)
+        # drain: redistributed/speculative extras arrive via the extra
+        # file; exit when the supervisor declares the fleet done — or
+        # when the supervisor itself is GONE (hard-killed: its cleanup
+        # never ran, the done file will never appear, and an orphaned
+        # worker spinning forever would leak a whole jax process)
+        extra_path = os.path.join(fleet_dir, EXTRA_DIR,
+                                  f"shard{shard}.json")
+        done_path = os.path.join(fleet_dir, DONE_FILE)
+        sup_pid = int(spec.get("supervisor_pid") or 0)
+        seen_version = 0
+        ticks = 0
+        while not os.path.exists(done_path):
+            cur = _read_json(assign_path) or {}
+            if int(cur.get("incarnation", my_inc)) != my_inc:
+                break               # fenced: a newer incarnation owns us
+            ticks += 1
+            if sup_pid and ticks % 40 == 0:     # ~every 2 s
+                try:
+                    os.kill(sup_pid, 0)
+                except OSError:
+                    sys.stderr.write(
+                        "shard-worker: supervisor gone — exiting "
+                        "orphaned drain\n")
+                    break
+            extra = _read_json(extra_path) or {}
+            if int(extra.get("version", 0)) > seen_version:
+                seen_version = int(extra["version"])
+                process(_from_runs(extra.get("runs", [])))
+            time.sleep(0.05)
+    finally:
+        hb.stop()
+        ex.finish()
+        obs.ioledger.emit_events()
+    return 0
+
+
+def worker_main(argv: Optional[List[str]] = None) -> int:
+    """``python -m adam_tpu.parallel.shardstream FLEET_DIR SHARD_ID`` —
+    the supervisor-spawned worker entry (env carries the metrics
+    sidecar path, incarnation, shard id, and fault plan, exactly like
+    elastic workers)."""
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if len(argv) != 2:
+        print("usage: python -m adam_tpu.parallel.shardstream "
+              "FLEET_DIR SHARD_ID", file=sys.stderr)
+        return 2
+    fleet_dir, shard = argv[0], int(argv[1])
+    # per-host CPU budget: hosts emulated on one box must not
+    # oversubscribe each other's cores (a real pod gives each host its
+    # own) — bound Arrow's decode pool before anything imports jax
+    cpus = os.environ.get(FLEET_WORKER_CPUS_ENV)
+    if cpus:
+        try:
+            import pyarrow as _pa
+            _pa.set_cpu_count(max(int(cpus), 1))
+            _pa.set_io_thread_count(max(int(cpus), 1))
+        except (ValueError, ImportError):
+            pass
+    from ..platform import honor_platform_env
+    honor_platform_env()
+    try:
+        faults.install_from_env()
+    except (OSError, ValueError) as e:
+        print(f"shard-worker: bad fault plan: {e}", file=sys.stderr)
+        return 2
+    try:
+        with obs.metrics_run_from_env(
+                argv=["shard-worker", fleet_dir, str(shard)],
+                config=dict(fleet_dir=fleet_dir, shard=shard),
+                command="shard-worker"):
+            return run_shard_worker(fleet_dir, shard)
+    except faults.InjectedFault as e:
+        print(f"shard-worker: {type(e).__name__}: {e}", file=sys.stderr)
+        return 3
+
+
+# ---------------------------------------------------------------------------
+# supervisor
+# ---------------------------------------------------------------------------
+
+class _ShardState:
+    def __init__(self, shard: int, runs: List[List[int]]):
+        self.shard = shard
+        self.runs = runs
+        self.incarnation = 0
+        self.restarts = 0
+        self.proc: Optional[subprocess.Popen] = None
+        self.spawned_at = 0.0
+        self.closed = False             # no proc should run for it
+        self.extra_version = 0
+        self.extra_units: List[int] = []
+        self.speculated = False
+
+
+def _repo_root() -> str:
+    import adam_tpu
+    return os.path.dirname(os.path.dirname(
+        os.path.abspath(adam_tpu.__file__)))
+
+
+class ShardSupervisor:
+    """The fleet control plane: spawn, watch (exit codes + leases),
+    reassign, and merge.  One instance per fleet run."""
+
+    def __init__(self, spec: dict, plan: dict, fleet_dir: str,
+                 policy: FleetPolicy, env: Optional[dict] = None,
+                 boot_grace_s: float = 90.0, timeout_s: float = 900.0,
+                 worker_cpus: Optional[int] = None):
+        self.spec = spec
+        self.plan = plan
+        self.fleet_dir = fleet_dir
+        self.policy = policy
+        self.env = dict(env if env is not None else os.environ)
+        if worker_cpus:
+            self.env[FLEET_WORKER_CPUS_ENV] = str(int(worker_cpus))
+            # OpenMP-backed kernels (numpy BLAS) respect this at import
+            self.env.setdefault("OMP_NUM_THREADS", str(int(worker_cpus)))
+        self.boot_grace_s = max(boot_grace_s, policy.lease_ttl_s)
+        self.timeout_s = timeout_s
+        self.states: Dict[int, _ShardState] = {}
+        self.all_units = list(range(plan["n_units"]))
+        self._commit_units: Dict[str, List[int]] = {}
+        self._dups = 0
+
+    # -- spawn -------------------------------------------------------------
+
+    def _worker_env(self, shard: int, incarnation: int) -> dict:
+        wenv = dict(self.env)
+        wenv[obs.METRICS_ENV] = os.path.join(
+            self.fleet_dir, LOG_DIR,
+            f"shard{shard}-inc{incarnation}.metrics.jsonl")
+        wenv[faults.INCARNATION_ENV] = str(incarnation)
+        wenv[faults.SHARD_ENV] = str(shard)
+        # fleet-scoped retry policy: each host draws a DISTINCT
+        # deterministic jitter stream, so a shared transient (one flaky
+        # interconnect) cannot re-synchronize every host's retries
+        base = 0
+        try:
+            base = int(self.env.get(RETRY_SEED_ENV) or 0)
+        except ValueError:
+            pass
+        wenv[RETRY_SEED_ENV] = str(base + 1000 * (shard + 1))
+        root = _repo_root()
+        wenv["PYTHONPATH"] = root + os.pathsep + \
+            wenv.get("PYTHONPATH", "")
+        return wenv
+
+    def _spawn(self, st: _ShardState) -> None:
+        # drop the previous incarnation's lease BEFORE the new worker
+        # starts: judging a respawn against its predecessor's stale
+        # mtime would declare it lost mid-import and burn the whole
+        # restart budget in one poll cycle — a fresh incarnation must
+        # get the boot grace, then live on its OWN heartbeats
+        try:
+            os.unlink(os.path.join(self.fleet_dir, LEASE_DIR,
+                                   f"shard{st.shard}.json"))
+        except OSError:
+            pass
+        log_path = os.path.join(
+            self.fleet_dir, LOG_DIR,
+            f"shard{st.shard}-inc{st.incarnation}.log")
+        argv = [sys.executable, "-m", "adam_tpu.parallel.shardstream",
+                self.fleet_dir, str(st.shard)]
+        with open(log_path, "w") as log:
+            st.proc = subprocess.Popen(
+                argv, stdout=log, stderr=subprocess.STDOUT,
+                env=self._worker_env(st.shard, st.incarnation))
+        st.spawned_at = time.monotonic()
+        obs.registry().counter("shard_spawns").inc()
+
+    # -- commit scanning ---------------------------------------------------
+
+    def _scan_commits(self) -> Dict[int, Tuple]:
+        """unit -> (sort_key, path, row) for the winning commit of each
+        unit (first by (incarnation, shard, seq) — deterministic, and
+        value-irrelevant: unit results are exact monoids).  Commit
+        files are immutable once renamed, so parses cache."""
+        best: Dict[int, Tuple] = {}
+        self._dups = 0
+        for path in sorted(_glob.glob(os.path.join(
+                self.fleet_dir, COMMIT_DIR, "*.npz"))):
+            if path not in self._commit_units:
+                try:
+                    with np.load(path) as z:
+                        self._commit_units[path] = \
+                            [int(u) for u in z["units"]]
+                except (OSError, ValueError, KeyError, EOFError):
+                    continue        # in-flight or torn: ignore
+            name = os.path.basename(path)[:-4]
+            s, i, q = name.split("-")
+            key = (int(i[3:]), int(s[5:]), int(q))
+            for row, unit in enumerate(self._commit_units[path]):
+                if unit in best:
+                    self._dups += 1
+                    if key >= best[unit][0]:
+                        continue
+                best[unit] = (key, path, row)
+        return best
+
+    def _committed_by_shard(self, best: Dict[int, Tuple]
+                            ) -> Dict[int, int]:
+        out: Dict[int, int] = {}
+        for key, _, _ in best.values():
+            out[key[1]] = out.get(key[1], 0) + 1
+        return out
+
+    # -- death / lease handling --------------------------------------------
+
+    def _handle_loss(self, st: _ShardState, error_code: str,
+                     committed: Dict[int, Tuple]) -> None:
+        # fence first: a half-dead worker must not keep committing
+        # after its range is handed elsewhere (the merge would dedup,
+        # but fencing keeps the failure windows crisp)
+        if st.proc is not None and st.proc.poll() is None:
+            st.proc.kill()
+            try:
+                st.proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                pass
+        obs.registry().counter("shard_deaths",
+                               code=error_code).inc()
+        remaining = sorted(
+            (set(_from_runs(st.runs)) | set(st.extra_units))
+            - set(committed))
+        survivors = sorted(
+            s for s, o in self.states.items()
+            if s != st.shard and not o.closed
+            and o.proc is not None and o.proc.poll() is None)
+        d = decide_shard_reassignment(
+            shard=st.shard, incarnation=st.incarnation,
+            restarts_used=st.restarts,
+            max_restarts=self.policy.max_restarts,
+            remaining_runs=_to_runs(remaining), survivors=survivors,
+            redistribute=self.policy.redistribute,
+            error_code=error_code)
+        _emit_reassigned("death", d)
+        if d["action"] == "none":
+            st.closed = True
+            return
+        if d["action"] == "respawn":
+            st.incarnation = d["new_incarnation"]
+            st.restarts += 1
+            st.runs = _to_runs(remaining)
+            st.extra_units = []
+            # a fresh incarnation is a fresh straggler candidate: the
+            # old one's speculation mark must not exclude it forever
+            st.speculated = False
+            _write_json(
+                os.path.join(self.fleet_dir, ASSIGN_DIR,
+                             f"shard{st.shard}.json"),
+                dict(runs=st.runs, incarnation=st.incarnation))
+            self._spawn(st)
+            return
+        if d["action"] == "redistribute":
+            st.closed = True
+            for target, runs in d["splits"]:
+                self._give_extra(self.states[target], _from_runs(runs))
+            return
+        raise RuntimeError(
+            f"shard fleet failed: shard {st.shard} lost "
+            f"({error_code}) with {len(remaining)} units uncommitted, "
+            f"restart budget exhausted and no survivors to shrink onto")
+
+    def _give_extra(self, st: _ShardState, units: List[int]) -> None:
+        st.extra_units = sorted(set(st.extra_units) | set(units))
+        st.extra_version += 1
+        _write_json(
+            os.path.join(self.fleet_dir, EXTRA_DIR,
+                         f"shard{st.shard}.json"),
+            dict(runs=_to_runs(st.extra_units),
+                 version=st.extra_version))
+
+    def _check_lease(self, st: _ShardState, now: float) -> bool:
+        """True when the shard's lease has expired (stale heartbeat)."""
+        lease = os.path.join(self.fleet_dir, LEASE_DIR,
+                             f"shard{st.shard}.json")
+        try:
+            age = time.time() - os.path.getmtime(lease)
+        except OSError:
+            # no lease yet: only the boot grace applies (jax import on
+            # a cold worker takes seconds; a TTL-sized wait would
+            # declare every healthy worker dead at startup)
+            return (now - st.spawned_at) > self.boot_grace_s
+        if age <= self.policy.lease_ttl_s:
+            return False
+        obs.registry().counter("shard_lease_expiries").inc()
+        obs.emit("shard_lease_expired", shard=st.shard,
+                 age_s=round(age, 3),
+                 ttl_s=round(self.policy.lease_ttl_s, 3))
+        return True
+
+    # -- speculation -------------------------------------------------------
+
+    def _maybe_speculate(self, committed: Dict[int, Tuple],
+                         now: float) -> None:
+        by_shard = self._committed_by_shard(committed)
+        candidates = []
+        idle = []
+        for s, st in sorted(self.states.items()):
+            if st.closed or st.proc is None or \
+                    st.proc.poll() is not None:
+                continue
+            mine = set(_from_runs(st.runs)) | set(st.extra_units)
+            remaining = sorted(mine - set(committed))
+            elapsed = max(now - st.spawned_at, 1e-3)
+            rate = round(by_shard.get(s, 0) / elapsed, 6)
+            obs.registry().gauge("shard_progress_rate",
+                                 shard=str(s)).set(rate)
+            if remaining:
+                # a shard still inside its boot grace with no commits
+                # is importing jax, not straggling — _check_lease
+                # grants the same window before declaring death
+                booting = rate == 0 and \
+                    (now - st.spawned_at) < self.boot_grace_s
+                if not st.speculated and not booting:
+                    candidates.append([s, _to_runs(remaining), rate])
+            else:
+                idle.append(s)
+        if not candidates or not idle:
+            return
+        d = decide_shard_speculation(candidates=candidates, idle=idle,
+                                     factor=self.policy.speculate_factor)
+        if d["action"] != "speculate":
+            return
+        _emit_reassigned("speculation", d)
+        self.states[d["victim"]].speculated = True
+        self._give_extra(self.states[d["target"]],
+                         _from_runs(d["tail_runs"]))
+
+    # -- the run loop ------------------------------------------------------
+
+    def run(self) -> Dict[int, Tuple]:
+        # a reused fleet dir must belong to THIS run: stale commit
+        # files from a different input/plan would count as committed
+        # units and merge wrong-input results without any error.  Same
+        # digest = same input + unit boundaries, so its commits are
+        # valid resume state (the CheckpointDir reject-on-mismatch
+        # discipline, fleet edition).
+        prev = _read_json(os.path.join(self.fleet_dir, PLAN_FILE))
+        if prev is not None and prev.get("plan_digest") != \
+                self.plan["input_digest"]:
+            raise ValueError(
+                f"fleet dir {self.fleet_dir!r} belongs to a different "
+                "run (input/unit plan changed); delete it or use "
+                "another -fleet_dir")
+        for d in (ASSIGN_DIR, EXTRA_DIR, LEASE_DIR, PROGRESS_DIR,
+                  COMMIT_DIR, LOG_DIR):
+            os.makedirs(os.path.join(self.fleet_dir, d), exist_ok=True)
+        _write_json(os.path.join(self.fleet_dir, PLAN_FILE),
+                    dict(self.spec,
+                         plan_digest=self.plan["input_digest"],
+                         supervisor_pid=os.getpid()))
+        for shard, (lo, hi) in enumerate(self.plan["assignments"]):
+            st = _ShardState(shard, [[lo, hi]] if hi > lo else [])
+            self.states[shard] = st
+            _write_json(
+                os.path.join(self.fleet_dir, ASSIGN_DIR,
+                             f"shard{shard}.json"),
+                dict(runs=st.runs, incarnation=0))
+            self._spawn(st)
+        deadline = time.monotonic() + self.timeout_s
+        try:
+            while True:
+                committed = self._scan_commits()
+                obs.registry().gauge("shard_units_committed").set(
+                    len(committed))
+                if len(committed) >= len(self.all_units):
+                    break
+                now = time.monotonic()
+                if now > deadline:
+                    raise RuntimeError(
+                        f"shard fleet timed out after {self.timeout_s}s "
+                        f"({len(committed)}/{len(self.all_units)} units "
+                        "committed)")
+                for st in list(self.states.values()):
+                    if st.closed or st.proc is None:
+                        continue
+                    rc = st.proc.poll()
+                    if rc is not None:
+                        # signals (SIGKILL preemption) vs error exits;
+                        # a clean exit with work remaining is INTERNAL
+                        # too (the worker broke its drain contract)
+                        code = "PREEMPTED" if rc < 0 else "INTERNAL"
+                        self._handle_loss(st, code, committed)
+                        continue
+                    if self._check_lease(st, now):
+                        self._handle_loss(st, "DEADLINE_EXCEEDED",
+                                          committed)
+                if self.policy.speculate:
+                    self._maybe_speculate(committed, time.monotonic())
+                time.sleep(0.1)
+            # release the drain loops, then collect workers
+            with open(os.path.join(self.fleet_dir, DONE_FILE), "w") as f:
+                f.write("done\n")
+            for st in self.states.values():
+                if st.proc is not None and st.proc.poll() is None:
+                    try:
+                        st.proc.wait(timeout=30)
+                    except subprocess.TimeoutExpired:
+                        st.proc.terminate()
+                        try:
+                            st.proc.wait(timeout=10)
+                        except subprocess.TimeoutExpired:
+                            st.proc.kill()
+            return committed
+        finally:
+            for st in self.states.values():
+                if st.proc is not None and st.proc.poll() is None:
+                    st.proc.kill()
+
+    # -- sidecar fold ------------------------------------------------------
+
+    def fold_worker_metrics(self) -> int:
+        """Fold every worker sidecar's registry snapshot into THIS
+        process's registry (counter sum / gauge max / histogram merge —
+        the elastic supervisor's discipline).  Returns sidecars folded.
+        Workers never hold fleet views, so every sidecar folds."""
+        from ..obs import read_snapshot_file, registry
+        n = 0
+        for path in sorted(_glob.glob(os.path.join(
+                self.fleet_dir, LOG_DIR, "*.metrics.jsonl"))):
+            snap = read_snapshot_file(path)
+            if snap is None:
+                continue
+            registry().merge(snap)
+            n += 1
+        if n:
+            registry().gauge("fleet_merged").set(1)
+        return n
+
+
+# ---------------------------------------------------------------------------
+# fleet entry points (broadcast + map + reduce, one call)
+# ---------------------------------------------------------------------------
+
+def _build_plan(input_path: str, hosts: int, unit_rows: Optional[int],
+                locality: bool = True) -> Tuple[dict, int, int]:
+    total_rows = count_input_rows(input_path)
+    if unit_rows is None:
+        # granular enough to balance and to lose little on a death
+        # (~8 units per host), bounded below so tiny inputs still shard
+        unit_rows = max(-(-total_rows // max(8 * hosts, 1)), 256)
+    n_units = max(-(-total_rows // unit_rows), 1)
+    bins = unit_bins_for(input_path, unit_rows, n_units, hosts) \
+        if locality else None
+    plan = decide_shard_plan(n_units=n_units, n_hosts=hosts,
+                             unit_rows=unit_rows, total_rows=total_rows,
+                             unit_bins=bins)
+    obs.registry().counter("shard_plans").inc()
+    obs.emit("shard_plan_selected", n_hosts=plan["n_hosts"],
+             n_units=plan["n_units"], unit_rows=plan["unit_rows"],
+             assignments=plan["assignments"], reason=plan["reason"],
+             inputs=plan["inputs"], input_digest=plan["input_digest"])
+    return plan, total_rows, unit_rows
+
+
+def run_fleet(task: str, input_path: str, *, hosts: int,
+              unit_rows: Optional[int] = None,
+              params: Optional[dict] = None,
+              fleet_dir: Optional[str] = None,
+              policy: Optional[FleetPolicy] = None,
+              env: Optional[dict] = None,
+              commit_every: int = 1,
+              io_procs: int = 1,
+              timeout_s: float = 900.0,
+              locality: bool = True,
+              worker_cpus: Optional[int] = None,
+              seed: Optional[Callable[[str], None]] = None
+              ) -> Dict[str, np.ndarray]:
+    """Run one sharded MapReduce workload to completion and return the
+    merged (monoid-reduced) result arrays.
+
+    The supervisor lives in THIS process (its events/metrics land in
+    the caller's telemetry run); workers are separate processes.  The
+    fleet dir defaults to a temp dir removed on success; pass one to
+    keep the plan/commit/lease audit trail.  ``commit_every`` batches
+    units per durable commit (each commit is 3 fsyncs — on a slow
+    filesystem per-unit commits can dominate small units); a coarser
+    cadence only widens what a preempted worker recomputes, never what
+    the run returns."""
+    import shutil
+
+    policy = policy or resolve_fleet_policy()
+    own_dir = fleet_dir is None
+    if own_dir:
+        fleet_dir = tempfile.mkdtemp(prefix="adam_tpu_fleet_")
+    os.makedirs(fleet_dir, exist_ok=True)
+    if seed is not None:
+        # task sidecar files (dup bits, MD events) land in the fleet
+        # dir before any worker spawns — ONE dir lifecycle (creation,
+        # keep-on-failure, success cleanup) for every task
+        seed(fleet_dir)
+    plan, total_rows, unit_rows = _build_plan(
+        input_path, hosts, unit_rows, locality=locality)
+    if total_rows == 0:
+        # nothing to shard: the phantom single unit would never commit
+        # (no rows to read) and the supervisor would spin to timeout —
+        # return the empty monoid, like the single-host stream does
+        if own_dir:
+            shutil.rmtree(fleet_dir, ignore_errors=True)
+        return {}
+    spec = dict(task=task, input=os.path.abspath(input_path),
+                unit_rows=unit_rows, n_units=plan["n_units"],
+                total_rows=total_rows, params=params or {},
+                commit_every=int(commit_every),
+                io_procs=int(io_procs),
+                policy=dict(heartbeat_s=policy.heartbeat_s,
+                            lease_ttl_s=policy.lease_ttl_s))
+    sup = ShardSupervisor(spec, plan, fleet_dir, policy, env=env,
+                          timeout_s=timeout_s, worker_cpus=worker_cpus)
+    t0 = time.perf_counter()
+    try:
+        winners = sup.run()
+        merged = _merge_commits(winners, sup)
+        obs.emit("shard_merge", units=len(winners),
+                 duplicates=int(sup._dups),
+                 shards=plan["n_hosts"],
+                 wall_s=round(time.perf_counter() - t0, 6))
+        obs.registry().counter("shard_units_deduped").inc(sup._dups)
+        sup.fold_worker_metrics()
+    except BaseException:
+        # a FAILED fleet keeps its dir: the worker logs and metrics
+        # sidecars under logs/ are the only record of WHY workers died
+        # — deleting them would be exactly the silent absorption this
+        # module exists to prevent
+        if own_dir:
+            sys.stderr.write(
+                f"shard fleet failed; audit trail kept at "
+                f"{fleet_dir} (worker logs + sidecars under "
+                f"{LOG_DIR}/)\n")
+        raise
+    if own_dir:
+        shutil.rmtree(fleet_dir, ignore_errors=True)
+    return merged
+
+
+def _merge_commits(winners: Dict[int, Tuple], sup: ShardSupervisor
+                   ) -> Dict[str, np.ndarray]:
+    """Reduce: sum each unit's winning result arrays (exact integer
+    monoid — the same fold order-independence the single-host chunk
+    accumulators rely on)."""
+    acc: Dict[str, np.ndarray] = {}
+    loaded: Dict[str, "np.lib.npyio.NpzFile"] = {}
+    for unit in sorted(winners):
+        _, path, row = winners[unit]
+        if path not in loaded:
+            loaded[path] = np.load(path)
+        z = loaded[path]
+        for key in z.files:
+            if key == "units":
+                continue
+            arr = z[key][row].astype(np.int64)
+            acc[key] = arr if key not in acc else acc[key] + arr
+    for z in loaded.values():
+        z.close()
+    return acc
+
+
+def fleet_flagstat(path: str, *, hosts: int,
+                   unit_rows: Optional[int] = None,
+                   fleet_dir: Optional[str] = None,
+                   policy: Optional[FleetPolicy] = None,
+                   env: Optional[dict] = None,
+                   commit_every: int = 1,
+                   io_procs: int = 1,
+                   timeout_s: float = 900.0,
+                   worker_cpus: Optional[int] = None):
+    """Sharded streaming flagstat: per-unit 18x2 counter blocks from N
+    worker processes, summed — byte-identical to the single-host
+    :func:`parallel.pipeline.streaming_flagstat` (counters are an exact
+    monoid over reads; unit boundaries cannot change a bit).  Returns
+    ``(failed, passed)`` like the single-host call."""
+    from ..ops.flagstat import FlagStatMetrics
+
+    merged = run_fleet("flagstat", path, hosts=hosts,
+                       unit_rows=unit_rows, fleet_dir=fleet_dir,
+                       policy=policy, env=env,
+                       commit_every=commit_every, io_procs=io_procs,
+                       timeout_s=timeout_s, worker_cpus=worker_cpus)
+    totals = merged.get("counts")
+    if totals is None:
+        totals = np.zeros((18, 2), np.int64)
+    passed = FlagStatMetrics.from_counters(totals[:, 0])
+    failed = FlagStatMetrics.from_counters(totals[:, 1])
+    return failed, passed
+
+
+def fleet_bqsr_count(path: str, *, hosts: int, n_rg_run: int,
+                     bucket_len: int,
+                     columns: Sequence[str],
+                     dup: Optional[np.ndarray] = None,
+                     mdstore=None,
+                     snp_path: Optional[str] = None,
+                     unit_rows: Optional[int] = None,
+                     fleet_dir: Optional[str] = None,
+                     policy: Optional[FleetPolicy] = None,
+                     env: Optional[dict] = None,
+                     commit_every: int = 1,
+                     timeout_s: float = 900.0):
+    """Sharded fused stream 2: the RecalTable count over a Parquet
+    reads dataset, distributed across hosts and merged through the
+    RecalTable monoid — byte-identical to the single-host count (exact
+    integer tensors; unit order is irrelevant under addition).  The
+    coordinator's markdup dup bits and hoisted MD events ship once via
+    the fleet dir (``run_fleet``'s ``seed`` hook, so the dir lifecycle
+    — keep-on-failure, success cleanup — has one owner) and re-join
+    per shard by global row index."""
+    from ..bqsr.recalibrate import tables_to_recal
+
+    def seed(d: str) -> None:
+        if dup is not None:
+            np.save(os.path.join(d, "dup.npy"), np.asarray(dup))
+        if mdstore is not None:
+            np.savez(os.path.join(d, "md.npz"),
+                     has_md=mdstore.has_md, ev_rows=mdstore.ev_rows,
+                     ev_pos=mdstore.ev_pos)
+
+    params = dict(n_rg_run=int(n_rg_run),
+                  bucket_len=int(bucket_len),
+                  columns=list(columns),
+                  has_dup=dup is not None,
+                  has_md=mdstore is not None,
+                  snp_path=snp_path)
+    merged = run_fleet("bqsr_count", path, hosts=hosts,
+                       unit_rows=unit_rows, params=params,
+                       fleet_dir=fleet_dir, policy=policy, env=env,
+                       commit_every=commit_every,
+                       timeout_s=timeout_s, seed=seed)
+    if not merged:
+        from ..bqsr.table import RecalTable
+        return RecalTable(n_read_groups=max(n_rg_run, 1),
+                          max_read_len=max(bucket_len, 1))
+    tensors = tuple(merged[k] for k in _BQSR_KEYS)
+    return tables_to_recal(tensors, n_rg_run, max(bucket_len, 1))
+
+
+if __name__ == "__main__":
+    sys.exit(worker_main())

@@ -29,13 +29,13 @@ pinned chaos matrix (tests/test_resilience.py).
 
 from __future__ import annotations
 
-from .faults import (FAULT_PLAN_ENV, INCARNATION_ENV, SITES,  # noqa: F401
-                     InjectedDeviceError, InjectedFault,
+from .faults import (FAULT_PLAN_ENV, INCARNATION_ENV, SHARD_ENV,  # noqa: F401
+                     SITES, InjectedDeviceError, InjectedFault,
                      InjectedFormatError, InjectedTornWrite, active,
                      clear_plan, decide_fault, fire, install_from_env,
                      install_plan, reset_counters)
 from .retry import (RETRY_BACKOFF_ENV, RETRY_BUDGET_ENV,  # noqa: F401
                     RETRY_FALLBACK_ENV, RETRY_SEED_ENV, RETRY_SPLIT_ENV,
-                    RetryPolicy, backoff_delay, classify_error,
-                    decide_retry, dispatch_with_retry,
-                    resolve_retry_policy)
+                    FleetPolicy, RetryPolicy, backoff_delay,
+                    classify_error, decide_retry, dispatch_with_retry,
+                    resolve_fleet_policy, resolve_retry_policy)

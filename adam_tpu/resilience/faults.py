@@ -52,7 +52,8 @@ from ..errors import FormatError
 #: choke point); fire() rejects anything else so a typo'd plan fails
 #: loudly instead of never firing
 SITES = ("device_dispatch", "device_put", "spill_write",
-         "checkpoint_write", "feeder_load", "worker_proc", "input_record")
+         "checkpoint_write", "feeder_load", "worker_proc", "input_record",
+         "shard_lease")
 
 FAULTS = ("error", "latency", "truncate", "corrupt", "kill")
 
@@ -62,6 +63,10 @@ FAULT_PLAN_ENV = "ADAM_TPU_FAULT_PLAN"
 #: stamped by the elastic supervisor on each worker's env; plan rules
 #: with an ``incarnation`` field only fire when it matches
 INCARNATION_ENV = "ADAM_TPU_INCARNATION"
+#: stamped by the shard-fleet supervisor (parallel/shardstream.py) on
+#: each worker's env; plan rules with a ``shard`` field only fire when
+#: it matches — how the chaos matrix targets one host of a fleet
+SHARD_ENV = "ADAM_TPU_SHARD_ID"
 
 #: error codes an ``error`` fault may raise (the transient set mirrors
 #: retry.classify_error's XLA status matching; FORMAT raises the typed
@@ -158,6 +163,8 @@ def _canon_rule(i: int, rule: dict) -> dict:
         out["frac"] = round(frac, 6)
     if "incarnation" in rule:
         out["incarnation"] = int(rule["incarnation"])
+    if "shard" in rule:
+        out["shard"] = int(rule["shard"])
     return out
 
 
@@ -233,18 +240,23 @@ def _occ_matches(spec, occurrence: int) -> bool:
 
 def decide_fault(*, site: str, occurrence: int,
                  incarnation: Optional[int] = None,
+                 shard: Optional[int] = None,
                  rules: list) -> dict:
     """Whether (and how) this site occurrence fires — PURE.
 
     First matching rule wins (a plan is read top to bottom, like the
     executor ladder's first-fit).  The returned decision carries the
     canonicalized ``inputs`` and their ``input_digest``, the replayable
-    contract tools/check_resilience.py verifies.
+    contract tools/check_resilience.py verifies.  ``shard`` (the fleet
+    worker's id, from ``ADAM_TPU_SHARD_ID``) joins the inputs ONLY when
+    set, so pre-fleet sidecars replay digest-identical.
     """
     inputs = dict(site=site, occurrence=int(occurrence),
                   incarnation=None if incarnation is None
                   else int(incarnation),
                   rules=[dict(r) for r in rules])
+    if shard is not None:
+        inputs["shard"] = int(shard)
     hit = None
     idx = None
     for i, rule in enumerate(inputs["rules"]):
@@ -254,6 +266,8 @@ def decide_fault(*, site: str, occurrence: int,
             continue
         if "incarnation" in rule and \
                 rule["incarnation"] != inputs["incarnation"]:
+            continue
+        if "shard" in rule and rule["shard"] != inputs.get("shard"):
             continue
         hit, idx = rule, i
         break
@@ -271,6 +285,14 @@ def decide_fault(*, site: str, occurrence: int,
 
 def _incarnation() -> Optional[int]:
     v = os.environ.get(INCARNATION_ENV)
+    try:
+        return int(v) if v else None
+    except ValueError:
+        return None
+
+
+def _shard() -> Optional[int]:
+    v = os.environ.get(SHARD_ENV)
     try:
         return int(v) if v else None
     except ValueError:
@@ -305,12 +327,14 @@ def fire(site: str, path: Optional[str] = None) -> None:
     # decide_fault re-derives the SAME first-match on a hit, so the
     # recorded decision stays bit-for-bit replayable
     inc = _incarnation()
+    shard = _shard()
     if not any(_occ_matches(r["occurrence"], occ)
                and ("incarnation" not in r or r["incarnation"] == inc)
+               and ("shard" not in r or r["shard"] == shard)
                for r in candidates):
         return
     d = decide_fault(site=site, occurrence=occ,
-                     incarnation=inc, rules=plan["rules"])
+                     incarnation=inc, shard=shard, rules=plan["rules"])
     if not d["fire"]:
         return
     obs.registry().counter("faults_injected", site=site).inc()

@@ -112,6 +112,93 @@ def resolve_retry_policy(budget: Optional[int] = None,
 
 
 # ---------------------------------------------------------------------------
+# fleet-scoped policy (the shard-stream supervisor's knobs)
+# ---------------------------------------------------------------------------
+
+FLEET_RESTARTS_ENV = "ADAM_TPU_FLEET_MAX_RESTARTS"
+FLEET_LEASE_TTL_ENV = "ADAM_TPU_FLEET_LEASE_TTL_S"
+FLEET_HEARTBEAT_ENV = "ADAM_TPU_FLEET_HEARTBEAT_S"
+FLEET_REDISTRIBUTE_ENV = "ADAM_TPU_FLEET_REDISTRIBUTE"   # 0/off disables
+FLEET_SPECULATE_ENV = "ADAM_TPU_FLEET_SPECULATE"         # 1/on enables
+FLEET_SPECULATE_FACTOR_ENV = "ADAM_TPU_FLEET_SPECULATE_FACTOR"
+
+
+@dataclass(frozen=True)
+class FleetPolicy:
+    """One resolved recovery policy per fleet run (the shard-stream
+    supervisor, parallel/shardstream.py) — the fleet-scoped rung of the
+    same ladder :class:`RetryPolicy` runs per chunk INSIDE each worker.
+
+    ``max_restarts`` bounds respawned incarnations per shard (the
+    elastic supervisor's convention); past it, ``redistribute`` lets the
+    dead shard's remaining range shrink-to-fit across survivors.
+    ``lease_ttl_s`` is how stale a worker's heartbeat lease may go
+    before the supervisor declares the worker lost (a hung worker shows
+    no exit code — the lease is what converts "silent" into "dead").
+    ``speculate`` (off by default) enables deadline-based speculative
+    reassignment of the slowest shard's tail range to an idle survivor;
+    the per-unit commit merge deduplicates, so speculation can never
+    double-count.
+    """
+    max_restarts: int = 2
+    lease_ttl_s: float = 10.0
+    heartbeat_s: float = 1.0
+    redistribute: bool = True
+    speculate: bool = False
+    speculate_factor: float = 3.0
+
+
+def resolve_fleet_policy(max_restarts: Optional[int] = None,
+                         lease_ttl_s: Optional[float] = None,
+                         heartbeat_s: Optional[float] = None,
+                         redistribute: Optional[bool] = None,
+                         speculate: Optional[bool] = None,
+                         speculate_factor: Optional[float] = None
+                         ) -> FleetPolicy:
+    """Explicit arguments (CLI flags) win; ``ADAM_TPU_FLEET_*`` envs fill
+    whatever the caller left unset (the executor's flag/env convention).
+    The heartbeat defaults to a third of the lease TTL so one missed
+    renewal never expires a healthy worker."""
+    env = os.environ
+
+    def _int(v, name, default):
+        if v is not None:
+            return int(v)
+        try:
+            return int(env[name]) if env.get(name) else default
+        except ValueError:
+            return default
+
+    def _float(v, name, default):
+        if v is not None:
+            return float(v)
+        try:
+            return float(env[name]) if env.get(name) else default
+        except ValueError:
+            return default
+
+    def _bool(v, name, default):
+        if v is not None:
+            return bool(v)
+        raw = env.get(name)
+        if raw is None:
+            return default
+        return raw not in ("0", "off", "")
+
+    ttl = max(_float(lease_ttl_s, FLEET_LEASE_TTL_ENV, 10.0), 0.1)
+    hb = _float(heartbeat_s, FLEET_HEARTBEAT_ENV, ttl / 3.0)
+    return FleetPolicy(
+        max_restarts=max(_int(max_restarts, FLEET_RESTARTS_ENV, 2), 0),
+        lease_ttl_s=ttl,
+        heartbeat_s=min(max(hb, 0.05), ttl),
+        redistribute=_bool(redistribute, FLEET_REDISTRIBUTE_ENV, True),
+        speculate=_bool(speculate, FLEET_SPECULATE_ENV, False),
+        speculate_factor=max(
+            _float(speculate_factor, FLEET_SPECULATE_FACTOR_ENV, 3.0),
+            1.0))
+
+
+# ---------------------------------------------------------------------------
 # error classification
 # ---------------------------------------------------------------------------
 

@@ -1273,6 +1273,112 @@ def _stage_pallas(kind: str, is_tpu: bool):
     _emit("pallas", out)
 
 
+def _burn_cpu(q):
+    """Pure-CPU burner for the shard_scale parallel-capacity probe
+    (module level: the spawn context must pickle it)."""
+    t0 = time.perf_counter()
+    x = 0
+    for i in range(20_000_000):
+        x += i
+    q.put(time.perf_counter() - t0)
+
+
+def _stage_shard_scale(kind: str, is_tpu: bool):
+    """Multi-process CPU-mesh scaling of streaming flagstat through the
+    shard fleet (parallel/shardstream.py): one synthetic Parquet
+    dataset, fleet runs at 1/2/4 hosts, walls + speedups + an identical-
+    counters cross-check against the single-host product path.
+
+    CPU-mesh by design (the fleet's workers are processes, not chips):
+    ``is_tpu`` only stamps the platform.  Speedup_2 (2 hosts vs the
+    1-host fleet — spawn overhead on both sides) is the gated number.
+    The artifact also records the box's MEASURED parallel capacity
+    (``host_parallel_capacity``: aggregate throughput of two
+    concurrent pure-CPU burners over one — this container advertises 2
+    CPUs but delivers ~1.3), because that capacity, not the host
+    count, is the ceiling any process-level scaling can reach here;
+    hosts beyond it are reported (oversubscription data), never
+    gated."""
+    import multiprocessing
+    import shutil
+    import tempfile
+
+    import numpy as np
+    import pyarrow as pa
+
+    from adam_tpu.io.parquet import DatasetWriter
+    from adam_tpu.ops.flagstat import format_report
+    from adam_tpu.parallel.pipeline import streaming_flagstat
+    from adam_tpu.parallel.shardstream import fleet_flagstat
+    from adam_tpu.resilience.retry import FleetPolicy
+
+    def parallel_capacity() -> float:
+        """Aggregate 2-process throughput over 1-process throughput —
+        the real core budget behind os.cpu_count()'s claim."""
+        ctx = multiprocessing.get_context("spawn")
+        q = ctx.Queue()
+        p = ctx.Process(target=_burn_cpu, args=(q,))
+        p.start()
+        p.join()
+        solo = q.get()
+        ps = [ctx.Process(target=_burn_cpu, args=(q,)) for _ in range(2)]
+        t0 = time.perf_counter()
+        for p in ps:
+            p.start()
+        for p in ps:
+            p.join()
+        pair_wall = time.perf_counter() - t0
+        for _ in range(2):
+            q.get()
+        return round(2.0 * solo / max(pair_wall, 1e-6), 3)
+
+    n = int(os.environ.get("ADAM_TPU_BENCH_SHARD_READS", 48_000_000))
+    rng = np.random.RandomState(11)
+    tmp = tempfile.mkdtemp(prefix="bench_shard_")
+    out: dict = {"shard_scale_n_reads": n, "platform": kind,
+                 "cpu_count": os.cpu_count(),
+                 "host_parallel_capacity": parallel_capacity()}
+    try:
+        pq_dir = os.path.join(tmp, "reads")
+        part = 1 << 18
+        with DatasetWriter(pq_dir, part_rows=part) as w:
+            for lo in range(0, n, part):
+                m = min(part, n - lo)
+                w.write(pa.table({
+                    "flags": pa.array(rng.randint(
+                        0, 1 << 11, size=m).astype(np.uint32),
+                        pa.uint32()),
+                    "mapq": pa.array(rng.randint(0, 61, size=m),
+                                     pa.int32()),
+                    "referenceId": pa.array(rng.randint(0, 24, size=m),
+                                            pa.int32()),
+                    "mateReferenceId": pa.array(
+                        rng.randint(0, 24, size=m), pa.int32()),
+                }))
+        t0 = time.perf_counter()
+        single = format_report(*streaming_flagstat(
+            pq_dir, chunk_rows=1 << 19))
+        out["shard_single_wall_s"] = round(time.perf_counter() - t0, 3)
+        pol = FleetPolicy(lease_ttl_s=60.0)
+        reports = {}
+        for hosts in (1, 2, 4):
+            t0 = time.perf_counter()
+            reports[hosts] = format_report(*fleet_flagstat(
+                pq_dir, hosts=hosts, unit_rows=max(n // 16, 1),
+                policy=pol, commit_every=4, timeout_s=600.0))
+            out[f"shard_hosts{hosts}_wall_s"] = round(
+                time.perf_counter() - t0, 3)
+        out["shard_scale_identical"] = all(
+            r == single for r in reports.values())
+        out["shard_speedup_2"] = round(
+            out["shard_hosts1_wall_s"] / out["shard_hosts2_wall_s"], 3)
+        out["shard_speedup_4"] = round(
+            out["shard_hosts1_wall_s"] / out["shard_hosts4_wall_s"], 3)
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+    _emit("shard_scale", out)
+
+
 def _worker(stages: list[str]) -> None:
     if os.environ.get("JAX_PLATFORMS") == "cpu":
         from adam_tpu.platform import force_cpu
@@ -1289,7 +1395,10 @@ def _worker(stages: list[str]) -> None:
 _STAGE_BODIES = {"flagstat": _stage_flagstat, "transform": _stage_transform,
                  "bqsr_race": _stage_bqsr_race, "pallas": _stage_pallas,
                  "bqsr_race8": _stage_bqsr_race8,
-                 "ragged_race": _stage_ragged_race}
+                 "ragged_race": _stage_ragged_race,
+                 # CPU-mesh fleet scaling (ISSUE 9): not in the TPU
+                 # capture order — run via --worker/--only shard_scale
+                 "shard_scale": _stage_shard_scale}
 
 
 def _worker_stages(stages: list[str]) -> None:

@@ -59,11 +59,23 @@ def main() -> None:
         sharding = NamedSharding(mesh, P((D.HOST_AXIS, D.CHIP_AXIS)))
         arr = jax.make_array_from_process_local_data(
             sharding, local, global_shape=rows.shape)
-        red = jax.jit(shard_map(
-            lambda x: jax.lax.psum(jnp.sum(x, keepdims=True).reshape(1, 1),
-                                   (D.HOST_AXIS, D.CHIP_AXIS)),
-            mesh=mesh, in_specs=P((D.HOST_AXIS, D.CHIP_AXIS)),
-            out_specs=P()))(arr)
+        try:
+            red = jax.jit(shard_map(
+                lambda x: jax.lax.psum(
+                    jnp.sum(x, keepdims=True).reshape(1, 1),
+                    (D.HOST_AXIS, D.CHIP_AXIS)),
+                mesh=mesh, in_specs=P((D.HOST_AXIS, D.CHIP_AXIS)),
+                out_specs=P()))(arr)
+        except Exception as e:  # noqa: BLE001 — precise re-raise below
+            # the one environmental limitation the test may skip on
+            # (see tests/_mp_support.py); anything else propagates
+            from _mp_support import MARKER, UNSUPPORTED_RC, \
+                mp_unsupported_reason
+            reason = mp_unsupported_reason(e)
+            if not reason:
+                raise
+            print(f"{MARKER}: {reason}", file=sys.stderr, flush=True)
+            sys.exit(UNSUPPORTED_RC)
         return int(np.asarray(red)[0, 0])
 
     def pass1(table: pa.Table) -> pa.Table:

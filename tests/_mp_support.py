@@ -1,0 +1,108 @@
+"""Shared support for the multi-process (DCN) tests: detect — precisely
+— whether this jaxlib's CPU backend can run multiprocess computations.
+
+Some CPU jaxlib builds reject any cross-process computation with
+``INVALID_ARGUMENT: Multiprocess computations aren't implemented on
+the CPU backend`` — an XLA build limitation, not a bug in this repo's
+collectives.  The workers (`_dcn_worker.py`, `_elastic_worker.py`)
+detect exactly that error, print :data:`MARKER` to stderr and exit
+:data:`UNSUPPORTED_RC`; the tests convert that — and ONLY that — into
+a skip.  Any other failure (join hang, wrong psum total, worker crash)
+still fails loudly: the skip is a precise condition, not a blanket.
+
+The fleet tests (tests/test_shardstream.py) deliberately do not depend
+on jax multiprocess computations at all — shardstream's workers never
+share a mesh — so multi-process coverage holds even where these
+collective smokes must skip.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+import socket
+import subprocess
+import sys
+from typing import Tuple
+
+#: stderr marker + exit code a worker uses for the known jaxlib
+#: limitation (nothing else may produce them)
+MARKER = "MULTIPROC_CPU_UNSUPPORTED"
+UNSUPPORTED_RC = 21
+
+_DCN_WORKER = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "_dcn_worker.py")
+
+
+def mp_unsupported_reason(exc: BaseException) -> str:
+    """The precise jaxlib-limitation test the workers share: non-empty
+    (the reason) only for the known unsupported-backend error."""
+    msg = str(exc)
+    if "Multiprocess computations aren't implemented" in msg:
+        return msg.splitlines()[0][:200]
+    return ""
+
+
+def unsupported_reason_from(rc: int, err: str) -> str:
+    """The one parse of the worker marker protocol (shared by the probe
+    and the tests, so they can never skip on different conditions):
+    non-empty (the reason) iff ``(rc, stderr)`` match it exactly."""
+    if rc != UNSUPPORTED_RC or MARKER not in err:
+        return ""
+    for ln in err.splitlines():
+        if ln.startswith(MARKER):
+            return ln[len(MARKER):].strip(": ") or \
+                "multiprocess CPU computations unavailable"
+    return "multiprocess CPU computations unavailable"
+
+
+def worker_env() -> dict:
+    """Env for a spawned DCN worker: forced CPU platform, inherited XLA
+    flags scrubbed, repo root importable."""
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = root + os.pathsep + env.get("PYTHONPATH", "")
+    return env
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+@functools.lru_cache(maxsize=1)
+def multiprocess_cpu_status() -> Tuple[str, str]:
+    """("ok", "") / ("unsupported", reason) / ("error", detail) — one
+    cached two-process psum probe over loopback.
+
+    ``unsupported`` is returned ONLY on the marker/exit-code protocol
+    above; a probe that fails any other way reports ``error`` and the
+    caller's real test still runs (and fails with the real cause)."""
+    coordinator = f"127.0.0.1:{_free_port()}"
+    procs = [
+        subprocess.Popen(
+            [sys.executable, _DCN_WORKER, coordinator, "2", str(pid)],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            env=worker_env())
+        for pid in range(2)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, err = p.communicate(timeout=180)
+            outs.append((p.returncode, out, err))
+    except subprocess.TimeoutExpired:
+        for p in procs:
+            p.kill()
+        return ("error", "probe timed out (coordination hang)")
+    for rc, _out, err in outs:
+        reason = unsupported_reason_from(rc, err)
+        if reason:
+            return ("unsupported", reason)
+    if all(rc == 0 for rc, _o, _e in outs):
+        return ("ok", "")
+    rc, out, err = next((o for o in outs if o[0] != 0), outs[0])
+    return ("error", f"probe worker rc={rc}: {err[-300:]}")

@@ -251,7 +251,9 @@ def test_run_worker_deadline_table_comes_from_scheduler():
     parse_stage_timeouts merge semantics pinned in test_evidence.py."""
     from adam_tpu.evidence.scheduler import (STAGE_DEADLINES_S,
                                              parse_stage_timeouts)
-    assert set(STAGE_DEADLINES_S) == set(DEFAULT_STAGE_ORDER)
+    # every TPU-capture-order stage has a deadline; CPU-only stages
+    # outside the capture order (shard_scale) may add entries on top
+    assert set(DEFAULT_STAGE_ORDER) <= set(STAGE_DEADLINES_S)
     if "ADAM_TPU_BENCH_STAGE_TIMEOUTS" not in os.environ:
         assert bench.STAGE_TIMEOUT_S == \
             parse_stage_timeouts(None, STAGE_DEADLINES_S)

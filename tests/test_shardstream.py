@@ -1,0 +1,656 @@
+"""Pod-scale elastic sharded streaming (parallel/shardstream.py).
+
+Pins, extending the tests/test_resilience.py chaos conventions to the
+fleet layer:
+
+* plan/reassignment/speculation decisions are PURE, digest-stable, and
+  replay through tools/check_executor.py;
+* the per-unit commit merge counts every unit EXACTLY once — the
+  no-double-count contract speculation and recovery both lean on;
+* the chaos matrix: SIGKILL mid-stream / lease-latency / torn progress
+  marker × a targeted shard — every cell completes with output
+  byte-identical to the unfaulted single-host run, or fails cleanly
+  typed; a killed worker's re-decode lands in the I/O ledger;
+* shrink-to-fit redistribution past the restart budget, and
+  deadline-based speculative reassignment (``-speculate``);
+* the fleet transform: the fused stream-2 RecalTable count sharded
+  across worker processes lands on a byte-identical output dataset,
+  with and without a mid-count worker kill;
+* the CLI ``-hosts`` path end-to-end, with validator round-trips
+  (check_metrics schema + check_executor replay) on the supervisor's
+  telemetry sidecar.
+
+Multi-process by construction (real subprocess workers, real SIGKILL),
+but with NO jax multiprocess collectives — these tests run where
+tests/test_multiprocess.py must skip.
+"""
+
+from __future__ import annotations
+
+import glob
+import hashlib
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from adam_tpu.parallel import shardstream as ss
+from adam_tpu.resilience.retry import FleetPolicy
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# pure decisions
+# ---------------------------------------------------------------------------
+
+def test_plan_contiguous_balanced_and_digest_stable():
+    p = ss.decide_shard_plan(n_units=10, n_hosts=3, unit_rows=100,
+                             total_rows=950)
+    assert p["assignments"] == [[0, 3], [3, 6], [6, 10]]
+    assert p["assignments"][0][0] == 0
+    assert p["assignments"][-1][1] == p["n_units"]
+    # deterministic: same inputs, same digest and decision
+    q = ss.decide_shard_plan(n_units=10, n_hosts=3, unit_rows=100,
+                             total_rows=950)
+    assert q == p
+    # hosts clamp to units (no empty shards)
+    c = ss.decide_shard_plan(n_units=2, n_hosts=8, unit_rows=10,
+                             total_rows=20)
+    assert c["n_hosts"] == 2 and "clamped" in c["reason"]
+
+
+def test_plan_snaps_to_genome_bin_edges():
+    # 12 units, bin changes at unit 5; the naive midpoint is 6 — the
+    # plan must prefer the genome-bin edge one unit left
+    p = ss.decide_shard_plan(n_units=12, n_hosts=2, unit_rows=10,
+                             total_rows=120,
+                             unit_bins=[0] * 5 + [1] * 7)
+    assert p["assignments"] == [[0, 5], [5, 12]]
+    assert "bin-snap" in p["reason"]
+    # no bins -> plain contiguous split, reason says so
+    q = ss.decide_shard_plan(n_units=12, n_hosts=2, unit_rows=10,
+                             total_rows=120)
+    assert q["assignments"] == [[0, 6], [6, 12]]
+    assert q["reason"] == "contiguous"
+
+
+def test_reassignment_ladder_respawn_then_shrink_then_fail():
+    kw = dict(shard=1, incarnation=0, restarts_used=0, max_restarts=2,
+              remaining_runs=[[3, 7]], survivors=[0, 2],
+              redistribute=True, error_code="PREEMPTED")
+    d = ss.decide_shard_reassignment(**kw)
+    assert d["action"] == "respawn" and d["new_incarnation"] == 1
+    d2 = ss.decide_shard_reassignment(
+        **{**kw, "incarnation": 2, "restarts_used": 2})
+    assert d2["action"] == "redistribute"
+    # contiguous slices over sorted survivors, covering all of [3, 7)
+    got = sorted(u for _, runs in d2["splits"]
+                 for u in ss._from_runs(runs))
+    assert got == [3, 4, 5, 6]
+    d3 = ss.decide_shard_reassignment(
+        **{**kw, "restarts_used": 2, "survivors": []})
+    assert d3["action"] == "fail"
+    d4 = ss.decide_shard_reassignment(**{**kw, "remaining_runs": []})
+    assert d4["action"] == "none"
+    # the recorded digest replays (check_executor's contract)
+    r = ss.decide_shard_reassignment(**d["inputs"])
+    assert r["input_digest"] == d["input_digest"]
+    assert r["action"] == d["action"]
+
+
+def test_speculation_decision():
+    # shard 1 stalled (rate 0) with an idle survivor: speculate its tail
+    d = ss.decide_shard_speculation(
+        candidates=[[1, [[4, 8]], 0.0]], idle=[0], factor=3.0)
+    assert d["action"] == "speculate"
+    assert (d["victim"], d["target"]) == (1, 0)
+    assert ss._from_runs(d["tail_runs"]) == [6, 7]
+    # a healthy shard within the deadline is left alone
+    h = ss.decide_shard_speculation(
+        candidates=[[1, [[4, 8]], 2.0], [0, [[0, 2]], 2.5]],
+        idle=[2], factor=3.0)
+    assert h["action"] == "none"
+    # no idle capacity -> never speculate
+    n = ss.decide_shard_speculation(
+        candidates=[[1, [[4, 8]], 0.0]], idle=[], factor=1.0)
+    assert n["action"] == "none"
+
+
+def test_runs_roundtrip():
+    units = [1, 2, 3, 7, 9, 10]
+    assert ss._to_runs(units) == [[1, 4], [7, 8], [9, 11]]
+    assert ss._from_runs(ss._to_runs(units)) == units
+    assert ss._to_runs([]) == [] and ss._from_runs([]) == []
+
+
+# ---------------------------------------------------------------------------
+# merge: the pinned no-double-count contract
+# ---------------------------------------------------------------------------
+
+def test_merge_counts_every_unit_exactly_once(tmp_path):
+    """Overlapping commits (speculation / a fenced-but-landed zombie
+    commit) are deduplicated per unit with deterministic arbitration —
+    the invariant that makes speculative re-execution safe."""
+    fleet = tmp_path / "fleet"
+    (fleet / ss.COMMIT_DIR).mkdir(parents=True)
+
+    def commit(shard, inc, seq, units, value):
+        ss._commit_unit_results(
+            str(fleet), shard, inc, seq,
+            [(u, {"counts": np.full((2,), value, np.int64)})
+             for u in units])
+
+    commit(0, 0, 1, [0, 1], 10)
+    commit(1, 0, 1, [2, 3], 20)
+    commit(0, 0, 2, [2, 3], 999)   # speculative duplicate of shard 1's
+    commit(1, 1, 1, [3], 999)      # respawn recommitted a landed unit
+    plan = ss.decide_shard_plan(n_units=4, n_hosts=2, unit_rows=10,
+                                total_rows=40)
+    spec = dict(task="flagstat", input="x", unit_rows=10, n_units=4,
+                total_rows=40, params={}, commit_every=1,
+                policy=dict(heartbeat_s=1, lease_ttl_s=10))
+    sup = ss.ShardSupervisor(spec, plan, str(fleet), FleetPolicy())
+    winners = sup._scan_commits()
+    assert sorted(winners) == [0, 1, 2, 3]
+    assert sup._dups == 3
+    merged = ss._merge_commits(winners, sup)
+    # every unit counted EXACTLY once: units 0/1 from shard 0's first
+    # commit (10 each); units 2/3 both have duplicates and resolve by
+    # the deterministic (incarnation, shard, seq) order to shard 0's
+    # speculative commit (999 each) — the sum is 4 values, never 7.
+    # (In production duplicate values are identical — exact monoids —
+    # so arbitration is value-irrelevant; distinct values here EXPOSE
+    # which commit won and that only one did.)
+    assert merged["counts"].tolist() == [10 + 10 + 999 + 999] * 2
+    assert winners[2][0] == (0, 0, 2)
+    assert winners[3][0] == (0, 0, 2)
+
+
+# ---------------------------------------------------------------------------
+# live fleets (subprocess workers; shared input + oracle)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def fleet_input(tmp_path_factory):
+    """A 2400-read Parquet dataset + the single-host oracle report."""
+    from adam_tpu.io.parquet import DatasetWriter
+    from adam_tpu.io.sam import read_sam
+    from adam_tpu.ops.flagstat import format_report
+    from adam_tpu.parallel.pipeline import streaming_flagstat
+
+    tmp = tmp_path_factory.mktemp("shardstream")
+    pq_dir = str(tmp / "reads")
+    table, _, _ = read_sam(os.path.join(
+        os.path.dirname(__file__), "resources", "unmapped.sam"))
+    with DatasetWriter(pq_dir, part_rows=256) as w:
+        w.write(pa.concat_tables([table] * 12))
+    failed, passed = streaming_flagstat(pq_dir, chunk_rows=256)
+    return dict(path=pq_dir, oracle=format_report(failed, passed))
+
+
+def _decoded_bytes(snapshot) -> int:
+    return sum(v for k, v in snapshot["counters"].items()
+               if k.startswith("io_bytes_decoded"))
+
+
+def _row_group_spans(path: str, columns) -> list:
+    """[(row_lo, row_hi, projected_compressed_bytes)] per row group of
+    a Parquet dataset — the exact per-group accounting
+    shardstream._parquet_range_tables records into the I/O ledger."""
+    import pyarrow.parquet as pq
+
+    roots = {c.split(".", 1)[0] for c in columns}
+    spans = []
+    base = 0
+    files = sorted(os.path.join(path, f) for f in os.listdir(path)
+                   if f.endswith(".parquet"))
+    for fpath in files:
+        md = pq.ParquetFile(fpath).metadata
+        for g in range(md.num_row_groups):
+            rg = md.row_group(g)
+            spans.append((base, base + rg.num_rows,
+                          ss._rg_compressed_bytes(rg, roots)))
+            base += rg.num_rows
+    return spans
+
+
+def _report(pair) -> str:
+    from adam_tpu.ops.flagstat import format_report
+    failed, passed = pair
+    return format_report(failed, passed)
+
+
+def _fleet(fleet_input, tmp_path, *, rules=None, policy=None,
+           metrics=None, hosts=2):
+    env = dict(os.environ)
+    if rules is not None:
+        plan_path = str(tmp_path / "faults.json")
+        with open(plan_path, "w") as f:
+            json.dump({"rules": rules}, f)
+        env["ADAM_TPU_FAULT_PLAN"] = plan_path
+    from adam_tpu import obs
+    fleet_dir = str(tmp_path / "fleet")
+    if metrics is not None:
+        with obs.metrics_run(metrics, argv=["test"], config={}):
+            out = ss.fleet_flagstat(fleet_input["path"], hosts=hosts,
+                                    unit_rows=100, fleet_dir=fleet_dir,
+                                    policy=policy, env=env,
+                                    timeout_s=240)
+    else:
+        out = ss.fleet_flagstat(fleet_input["path"], hosts=hosts,
+                                unit_rows=100, fleet_dir=fleet_dir,
+                                policy=policy, env=env, timeout_s=240)
+    return out, fleet_dir
+
+
+def _events(path):
+    with open(path) as f:
+        return [json.loads(ln) for ln in f if ln.strip()]
+
+
+def _run_validators(*paths):
+    for tool in ("check_metrics", "check_executor"):
+        r = subprocess.run(
+            [sys.executable, os.path.join(ROOT, "tools", f"{tool}.py")]
+            + list(paths), capture_output=True, text=True)
+        assert r.returncode == 0, f"{tool}: {r.stdout}\n{r.stderr}"
+
+
+def test_fleet_flagstat_byte_identical_and_replayable(
+        fleet_input, tmp_path):
+    metrics = str(tmp_path / "sup.metrics.jsonl")
+    # a harmless shard_lease latency rule rides along so every worker
+    # sidecar records shard-scoped fault firings — the new site +
+    # shard-input replay contract check_resilience verifies below
+    rules = [{"site": "shard_lease", "fault": "latency",
+              "latency_s": 0.01, "occurrence": "1+"}]
+    out, fleet_dir = _fleet(fleet_input, tmp_path, rules=rules,
+                            metrics=metrics)
+    assert _report(out) == fleet_input["oracle"]
+    evs = _events(metrics)
+    plans = [e for e in evs if e["event"] == "shard_plan_selected"]
+    merges = [e for e in evs if e["event"] == "shard_merge"]
+    assert len(plans) == 1 and len(merges) == 1
+    assert plans[0]["n_hosts"] == 2
+    assert merges[0]["units"] == plans[0]["n_units"]
+    assert merges[0]["duplicates"] == 0
+    _run_validators(metrics)
+    # the audit trail survives when a fleet dir is given
+    assert os.path.exists(os.path.join(fleet_dir, ss.PLAN_FILE))
+    assert glob.glob(os.path.join(fleet_dir, ss.COMMIT_DIR, "*.npz"))
+    # worker sidecars carry the shard_lease firings with shard-scoped
+    # inputs; check_metrics takes the schema, check_resilience replays
+    # decide_fault over them
+    sidecars = sorted(glob.glob(os.path.join(
+        fleet_dir, ss.LOG_DIR, "*.metrics.jsonl")))
+    assert sidecars
+    fired = []
+    for sc in sidecars:
+        fired += [e for e in _events(sc)
+                  if e["event"] == "fault_injected"]
+    assert fired and all(e["site"] == "shard_lease" for e in fired)
+    assert {e["inputs"].get("shard") for e in fired} == {0, 1}
+    r = subprocess.run(
+        [sys.executable,
+         os.path.join(ROOT, "tools", "check_resilience.py")] + sidecars,
+        capture_output=True, text=True)
+    assert r.returncode == 0, f"{r.stdout}\n{r.stderr}"
+    r = subprocess.run(
+        [sys.executable,
+         os.path.join(ROOT, "tools", "check_metrics.py")] + sidecars,
+        capture_output=True, text=True)
+    assert r.returncode == 0, f"{r.stdout}\n{r.stderr}"
+
+
+def test_fleet_sigkill_mid_stream_loses_only_uncommitted(
+        fleet_input, tmp_path):
+    """THE acceptance pin: SIGKILL one worker mid-stream; the run
+    completes byte-identical to the unfaulted single-host run, the
+    respawn recomputes only uncommitted units, and the recovery
+    re-decode is VISIBLE in the merged I/O ledger."""
+    from adam_tpu import obs
+
+    metrics = str(tmp_path / "sup.metrics.jsonl")
+    rules = [{"site": "device_dispatch", "fault": "kill",
+              "occurrence": 3, "incarnation": 0, "shard": 1}]
+    out, fleet_dir = _fleet(fleet_input, tmp_path, rules=rules,
+                            metrics=metrics)
+    assert _report(out) == fleet_input["oracle"]
+    evs = _events(metrics)
+    deaths = [e for e in evs if e["event"] == "shard_reassigned"
+              and e["inputs"].get("shard") == 1]
+    assert [(e["cause"], e["action"]) for e in deaths] == \
+        [("death", "respawn")]
+    assert deaths[0]["inputs"]["error_code"] == "PREEMPTED"
+    # two incarnations of shard 1 really ran
+    assert len(glob.glob(os.path.join(
+        fleet_dir, ss.LOG_DIR, "shard1-inc*.log"))) == 2
+    # the killed incarnation committed SOMETHING (it died on dispatch
+    # 3); the respawn recomputed ONLY the complement — "loses only its
+    # uncommitted chunks", read straight off the commit files
+    def units_of(pattern):
+        out = set()
+        for p in glob.glob(os.path.join(fleet_dir, ss.COMMIT_DIR,
+                                        pattern)):
+            with np.load(p) as z:
+                out.update(int(u) for u in z["units"])
+        return out
+
+    inc0 = units_of("shard1-inc0-*.npz")
+    inc1 = units_of("shard1-inc1-*.npz")
+    assert inc0, "the victim should have committed units before dying"
+    assert inc1, "the respawn should have finished the range"
+    assert not (inc0 & inc1), "committed units must never recompute"
+    plan = _events(metrics)
+    [pl] = [e for e in plan if e["event"] == "shard_plan_selected"]
+    lo, hi = pl["assignments"][1]
+    assert inc0 | inc1 == set(range(lo, hi))
+    # re-decode counted in the I/O ledger, not silently absorbed: the
+    # respawn's sidecar charges EXACTLY the projected bytes of every
+    # row group overlapping its remaining range — including the
+    # boundary group the victim had already decoded (unit boundaries
+    # sit mid-row-group here, so the overlap provably exists)
+    from adam_tpu.io.dispatch import FLAGSTAT_COLUMNS
+    from adam_tpu.obs import read_snapshot_file
+
+    spans = _row_group_spans(fleet_input["path"], FLAGSTAT_COLUMNS)
+    R = pl["unit_rows"]
+    remaining_groups = [
+        (glo, ghi, b) for glo, ghi, b in spans
+        if any(glo < (u + 1) * R and ghi > u * R for u in inc1)]
+    redecoded = [
+        (glo, ghi) for glo, ghi, _ in remaining_groups
+        if any(glo < (u + 1) * R and ghi > u * R for u in inc0)]
+    assert redecoded, "a boundary row group must straddle the kill"
+    sidecars = glob.glob(os.path.join(fleet_dir, ss.LOG_DIR,
+                                      "shard1-inc1.metrics.jsonl"))
+    snap = read_snapshot_file(sidecars[0])
+    assert _decoded_bytes(snap) == sum(b for _, _, b in remaining_groups)
+    _run_validators(metrics)
+
+
+def test_fleet_lease_expiry_fences_and_recovers(fleet_input, tmp_path):
+    """A hung worker (lease-latency fault: the heartbeat thread stalls
+    past the TTL) is detected WITHOUT an exit code, fenced, and its
+    range respawned — byte-identical output."""
+    metrics = str(tmp_path / "sup.metrics.jsonl")
+    rules = [{"site": "shard_lease", "fault": "latency",
+              "latency_s": 60.0, "occurrence": "2+", "incarnation": 0,
+              "shard": 1},
+             # keep the victim mid-stream past the TTL (its stalled
+             # heartbeat must expire BEFORE its range completes)
+             {"site": "device_dispatch", "fault": "latency",
+              "latency_s": 1.0, "occurrence": "1+", "incarnation": 0,
+              "shard": 1}]
+    # the TTL must separate a stalled heartbeat (60 s) from a merely
+    # slow one: a starved box can stretch a healthy worker's renewal
+    # gap to seconds, so keep the TTL generous — a spurious expiry of
+    # the healthy shard would only trigger a harmless extra respawn,
+    # but the pin below wants the VICTIM's expiry specifically
+    pol = FleetPolicy(max_restarts=2, lease_ttl_s=5.0, heartbeat_s=0.5)
+    out, fleet_dir = _fleet(fleet_input, tmp_path, rules=rules,
+                            policy=pol, metrics=metrics)
+    assert _report(out) == fleet_input["oracle"]
+    evs = _events(metrics)
+    expiries = [e for e in evs if e["event"] == "shard_lease_expired"
+                and e["shard"] == 1]
+    assert expiries, "the stalled worker's lease must expire"
+    assert expiries[0]["age_s"] > pol.lease_ttl_s
+    deaths = [e for e in evs if e["event"] == "shard_reassigned"
+              and e.get("cause") == "death"
+              and e["inputs"]["shard"] == 1]
+    assert deaths and \
+        deaths[0]["inputs"]["error_code"] == "DEADLINE_EXCEEDED"
+    # the respawn must LIVE (the supervisor drops the dead
+    # incarnation's lease before spawning — judging the fresh worker
+    # against its predecessor's stale mtime would re-kill it
+    # mid-import and burn the whole restart budget): exactly one
+    # shard-1 death, and the respawn itself committed work
+    assert len(deaths) == 1
+    assert glob.glob(os.path.join(fleet_dir, ss.COMMIT_DIR,
+                                  "shard1-inc1-*.npz"))
+    _run_validators(metrics)
+
+
+def test_fleet_torn_progress_marker_recovers(fleet_input, tmp_path):
+    """A torn progress-marker write (power loss mid-checkpoint) kills
+    the worker typed; the marker target stays untorn (atomic_write
+    tears the TMP), so the respawn recomputes only what the marker
+    never recorded — byte-identical."""
+    metrics = str(tmp_path / "sup.metrics.jsonl")
+    rules = [{"site": "checkpoint_write", "fault": "truncate",
+              "occurrence": 2, "incarnation": 0, "shard": 1}]
+    out, fleet_dir = _fleet(fleet_input, tmp_path, rules=rules,
+                            metrics=metrics)
+    assert _report(out) == fleet_input["oracle"]
+    # the torn tmp never became the marker: whatever marker exists
+    # parses (or none exists at all)
+    marker = os.path.join(fleet_dir, ss.PROGRESS_DIR, "shard1.json")
+    if os.path.exists(marker):
+        json.load(open(marker))
+    evs = _events(metrics)
+    assert [(e["cause"], e["action"]) for e in evs
+            if e["event"] == "shard_reassigned"
+            and e["inputs"].get("shard") == 1] == \
+        [("death", "respawn")]
+
+
+def test_fleet_shrink_to_fit_redistributes(fleet_input, tmp_path):
+    """Past the restart budget the dead shard's remaining range splits
+    across survivors and the run still lands byte-identical."""
+    metrics = str(tmp_path / "sup.metrics.jsonl")
+    rules = [{"site": "device_dispatch", "fault": "kill",
+              "occurrence": 2, "incarnation": 0, "shard": 1}]
+    pol = FleetPolicy(max_restarts=0, lease_ttl_s=10)
+    out, _ = _fleet(fleet_input, tmp_path, rules=rules, policy=pol,
+                    metrics=metrics)
+    assert _report(out) == fleet_input["oracle"]
+    evs = _events(metrics)
+    acts = [e for e in evs if e["event"] == "shard_reassigned"
+            and e["inputs"].get("shard") == 1]
+    assert [(e["cause"], e["action"]) for e in acts] == \
+        [("death", "redistribute")]
+    assert acts[0]["splits"], "shrink-to-fit must name the new owners"
+    _run_validators(metrics)
+
+
+def test_fleet_exhausted_fails_cleanly_typed(fleet_input, tmp_path):
+    """Restart budget exhausted + redistribution disabled: the fleet
+    fails CLEANLY (a typed RuntimeError naming the shard and code),
+    never a hang or a silent partial result."""
+    rules = [{"site": "device_dispatch", "fault": "kill",
+              "occurrence": 1, "shard": 1}]       # every incarnation
+    pol = FleetPolicy(max_restarts=1, lease_ttl_s=10,
+                      redistribute=False)
+    with pytest.raises(RuntimeError, match="shard 1.*INTERNAL|PREEMPTED"):
+        _fleet(fleet_input, tmp_path, rules=rules, policy=pol)
+
+
+def test_fleet_speculation_no_double_count_live(fleet_input, tmp_path):
+    """A latency straggler triggers speculative tail reassignment
+    (factor 1.0 forces it); totals stay byte-identical — the per-unit
+    dedup absorbs any overlap between victim and speculator."""
+    metrics = str(tmp_path / "sup.metrics.jsonl")
+    rules = [{"site": "device_dispatch", "fault": "latency",
+              "latency_s": 1.2, "occurrence": "2+", "shard": 1}]
+    pol = FleetPolicy(max_restarts=2, lease_ttl_s=30, heartbeat_s=0.3,
+                      speculate=True, speculate_factor=1.0)
+    out, _ = _fleet(fleet_input, tmp_path, rules=rules, policy=pol,
+                    metrics=metrics)
+    assert _report(out) == fleet_input["oracle"]
+    evs = _events(metrics)
+    specs = [e for e in evs if e["event"] == "shard_reassigned"
+             and e["cause"] == "speculation"]
+    assert specs and specs[0]["action"] == "speculate"
+    merge = [e for e in evs if e["event"] == "shard_merge"][0]
+    # overlap may or may not materialize before completion; what is
+    # pinned is that duplicates were DEDUPLICATED, never summed
+    assert merge["units"] == 24
+    _run_validators(metrics)
+
+
+# ---------------------------------------------------------------------------
+# fleet transform: sharded fused stream-2 count
+# ---------------------------------------------------------------------------
+
+def _dataset_digest(d: str) -> str:
+    h = hashlib.sha256()
+    for f in sorted(os.listdir(d)):
+        h.update(f.encode())
+        with open(os.path.join(d, f), "rb") as fh:
+            h.update(fh.read())
+    return h.hexdigest()
+
+
+@pytest.mark.slow
+def test_fleet_transform_s2_byte_identical_with_kill(tmp_path):
+    """The fused transform's RecalTable count sharded across two
+    worker processes (markdup dup bits + MD events re-joined per
+    shard) lands on a byte-identical output dataset — including with a
+    worker SIGKILL mid-count."""
+    from adam_tpu.io.parquet import DatasetWriter
+    from adam_tpu.io.sam import read_sam
+    from adam_tpu.parallel.pipeline import streaming_transform
+
+    pq_dir = str(tmp_path / "reads")
+    table, _, _ = read_sam(os.path.join(
+        os.path.dirname(__file__), "resources", "reads12.sam"))
+    with DatasetWriter(pq_dir, part_rows=128) as w:
+        w.write(pa.concat_tables([table] * 40))
+    out_a = str(tmp_path / "a")
+    out_b = str(tmp_path / "b")
+    out_c = str(tmp_path / "c")
+    n_a = streaming_transform(pq_dir, out_a, bqsr=True, markdup=True,
+                              chunk_rows=128)
+    n_b = streaming_transform(pq_dir, out_b, bqsr=True, markdup=True,
+                              chunk_rows=128,
+                              fleet={"hosts": 2, "unit_rows": 60})
+    assert n_a == n_b
+    assert _dataset_digest(out_a) == _dataset_digest(out_b)
+    plan_path = str(tmp_path / "faults.json")
+    with open(plan_path, "w") as f:
+        json.dump({"rules": [{"site": "device_dispatch",
+                              "fault": "kill", "occurrence": 2,
+                              "incarnation": 0, "shard": 0}]}, f)
+    os.environ["ADAM_TPU_FAULT_PLAN"] = plan_path
+    try:
+        n_c = streaming_transform(pq_dir, out_c, bqsr=True,
+                                  markdup=True, chunk_rows=128,
+                                  fleet={"hosts": 2, "unit_rows": 60})
+    finally:
+        del os.environ["ADAM_TPU_FAULT_PLAN"]
+    assert n_a == n_c
+    assert _dataset_digest(out_a) == _dataset_digest(out_c)
+
+
+def test_fleet_transform_rejects_unsupported_combos(tmp_path):
+    from adam_tpu.parallel.pipeline import streaming_transform
+
+    with pytest.raises(ValueError, match="-hosts"):
+        streaming_transform(str(tmp_path / "in.sam"),
+                            str(tmp_path / "out"), bqsr=True,
+                            fleet={"hosts": 2})
+    # no bqsr -> there is no stream-2 to shard; refusing beats the
+    # silent single-host run a dropped hosts request would be
+    with pytest.raises(ValueError, match="recalibrate"):
+        streaming_transform(str(tmp_path / "in_dir"),
+                            str(tmp_path / "out2"), markdup=True,
+                            bqsr=False, fleet={"hosts": 2})
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+
+def test_cli_fleet_flagstat(fleet_input, tmp_path, capsys):
+    from adam_tpu.cli.main import main
+
+    metrics = str(tmp_path / "cli.metrics.jsonl")
+    rc = main(["flagstat", fleet_input["path"], "-hosts", "2",
+               "-unit_rows", "100", "-metrics", metrics])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert out.strip() == fleet_input["oracle"].strip()
+    _run_validators(metrics)
+
+
+def test_reused_fleet_dir_rejects_different_plan(tmp_path):
+    """A kept fleet dir belongs to ONE (input, plan): a rerun with a
+    different plan digest must refuse rather than merge stale commits
+    from the previous run (the CheckpointDir discipline)."""
+    fleet = tmp_path / "fleet"
+    fleet.mkdir()
+    ss._write_json(str(fleet / ss.PLAN_FILE),
+                   dict(task="flagstat", plan_digest="deadbeefdeadbeef"))
+    plan = ss.decide_shard_plan(n_units=4, n_hosts=2, unit_rows=10,
+                                total_rows=40)
+    spec = dict(task="flagstat", input="x", unit_rows=10, n_units=4,
+                total_rows=40, params={}, commit_every=1,
+                policy=dict(heartbeat_s=1, lease_ttl_s=10))
+    sup = ss.ShardSupervisor(spec, plan, str(fleet), FleetPolicy())
+    with pytest.raises(ValueError, match="different run"):
+        sup.run()
+
+
+def test_fleet_empty_input_returns_empty_monoid(tmp_path):
+    """A 0-row input short-circuits to the empty result like the
+    single-host stream — no phantom unit, no supervisor spin."""
+    import pyarrow as pa
+
+    from adam_tpu.io.parquet import DatasetWriter
+
+    pq_dir = str(tmp_path / "empty")
+    with DatasetWriter(pq_dir, part_rows=64) as w:
+        w.write(pa.table({
+            "flags": pa.array([], pa.uint32()),
+            "mapq": pa.array([], pa.int32()),
+            "referenceId": pa.array([], pa.int32()),
+            "mateReferenceId": pa.array([], pa.int32())}))
+    import time
+    t0 = time.perf_counter()
+    failed, passed = ss.fleet_flagstat(pq_dir, hosts=2, timeout_s=60)
+    assert time.perf_counter() - t0 < 30
+    assert passed.total == 0 and failed.total == 0
+
+
+def test_fault_site_tables_stay_in_sync():
+    """faults.SITES and check_metrics' literal mirror must agree, or a
+    new site's events would fail schema validation (the drift this PR's
+    shard_lease site would have hit silently)."""
+    sys.path.insert(0, os.path.join(ROOT, "tools"))
+    try:
+        import check_metrics
+    finally:
+        sys.path.pop(0)
+    from adam_tpu.resilience.faults import SITES
+
+    assert set(check_metrics._FAULT_SITES) == set(SITES)
+    assert "shard_lease" in SITES
+
+
+def test_bench_gate_committed_shard_artifact():
+    """Gate 4 holds on the committed BENCH_SHARD.json (counter identity
+    always; the scaling floor arms only when the artifact's capacity
+    probe measured real parallelism — this box is capacity-limited)."""
+    r = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "bench_gate.py")],
+        capture_output=True, text=True)
+    assert r.returncode == 0, f"{r.stdout}\n{r.stderr}"
+    assert "shard gate:" in r.stdout
+
+
+def test_cli_transform_hosts_validation(tmp_path, capsys):
+    from adam_tpu.cli.main import main
+
+    src = os.path.join(os.path.dirname(__file__), "resources",
+                       "reads12.sam")
+    rc = main(["transform", src, str(tmp_path / "out"), "-hosts", "2",
+               "-recalibrate_base_qualities"])
+    assert rc == 2            # SAM input cannot shard the s2 count
+    err = capsys.readouterr().err
+    assert "-hosts" in err and "Parquet" in err

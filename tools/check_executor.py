@@ -11,7 +11,10 @@ and fails when:
 * replaying ``decide_plan(**inputs)`` yields a different chunk_rows /
   ladder / ladder_base / prefetch_depth / donate than the event
   recorded (the autotuner drifted from purity — e.g. someone added a
-  clock or env read inside the decision);
+  clock or env read inside the decision); the same replay runs for the
+  fleet's ``shard_plan_selected`` (decide_shard_plan) and
+  ``shard_reassigned`` (decide_shard_reassignment /
+  decide_shard_speculation, selected by the recorded ``cause``);
 * the recorded ``input_digest`` does not match the digest of the
   recorded inputs (the event lied about what it decided from);
 * two events — within one file or across files — share an
@@ -55,6 +58,15 @@ FUSION_FIELDS = ("mode", "streams", "route_in_s1", "carry_ridx",
 #: (realign_exec.decide_realign_plan — the layout decision included)
 REALIGN_FIELDS = ("pipeline_depth", "donate", "layout")
 
+#: the fleet plan/reassignment fields a replay must reproduce exactly
+#: (shardstream.decide_shard_plan / decide_shard_reassignment /
+#: decide_shard_speculation — shard_reassigned picks its decider by
+#: the recorded ``cause``)
+SHARD_PLAN_FIELDS = ("assignments", "reason")
+SHARD_DEATH_FIELDS = ("action", "new_incarnation", "splits", "reason")
+SHARD_SPEC_FIELDS = ("action", "victim", "target", "tail_runs",
+                     "reason")
+
 #: fields absent from older sidecars: compared only when recorded
 _OPTIONAL_FIELDS = ("layout",)
 
@@ -65,7 +77,8 @@ _OPTIONAL_FIELDS = ("layout",)
 _LAYOUT_KINDS = ("executor_bucket_selected", "realign_plan_selected")
 
 _REPLAYED = ("executor_bucket_selected", "fusion_plan_selected",
-             "realign_plan_selected")
+             "realign_plan_selected", "shard_plan_selected",
+             "shard_reassigned")
 
 
 def _events(path: str, kinds=_REPLAYED) -> List[Tuple[int, dict]]:
@@ -89,12 +102,17 @@ def check(paths: List[str]) -> List[str]:
     from adam_tpu.parallel.executor import decide_plan
     from adam_tpu.parallel.pipeline import decide_fusion_plan
     from adam_tpu.parallel.realign_exec import decide_realign_plan
+    from adam_tpu.parallel.shardstream import (decide_shard_plan,
+                                               decide_shard_reassignment,
+                                               decide_shard_speculation)
 
     deciders = {"executor_bucket_selected": (decide_plan, PLAN_FIELDS),
                 "fusion_plan_selected": (decide_fusion_plan,
                                          FUSION_FIELDS),
                 "realign_plan_selected": (decide_realign_plan,
-                                          REALIGN_FIELDS)}
+                                          REALIGN_FIELDS),
+                "shard_plan_selected": (decide_shard_plan,
+                                        SHARD_PLAN_FIELDS)}
     errs: List[str] = []
     # digests are namespaced per event kind: the two deciders hash
     # different input tuples and must never cross-validate
@@ -108,7 +126,17 @@ def check(paths: List[str]) -> List[str]:
             continue
         for i, ev in events:
             kind = ev.get("event")
-            decider, fields = deciders[kind]
+            if kind == "shard_reassigned":
+                # one event name, two pure deciders — the recorded
+                # cause says which one produced it
+                if ev.get("cause") == "speculation":
+                    decider, fields = (decide_shard_speculation,
+                                       SHARD_SPEC_FIELDS)
+                else:
+                    decider, fields = (decide_shard_reassignment,
+                                       SHARD_DEATH_FIELDS)
+            else:
+                decider, fields = deciders[kind]
             inputs = ev.get("inputs")
             if not isinstance(inputs, dict):
                 errs.append(f"{path}:{i}: {kind} carries no inputs — "

@@ -59,6 +59,21 @@ elastic worker sidecars).  Contract checked here:
 * ``trace_written`` events carry ``path`` (str), ``events`` (int >= 0)
   and ``lanes`` (int >= 0) — the receipt for the run's Chrome-trace
   timeline (validated separately by tools/check_trace.py);
+* ``shard_plan_selected`` events carry ``n_hosts``/``n_units``/
+  ``unit_rows`` (ints >= 1), ``assignments`` ([lo, hi) pairs tiling
+  [0, n_units) contiguously), ``reason``, ``inputs`` and a hex
+  ``input_digest`` (tools/check_executor.py replays the decision);
+* ``shard_reassigned`` events carry ``cause`` (death/speculation),
+  ``action`` (none/respawn/redistribute/fail/speculate), ``shard``
+  (int >= 0), the cause's payload (``splits`` for death, ``tail_runs``
+  for speculation), ``inputs`` and a hex ``input_digest`` (replayed by
+  tools/check_executor.py);
+* ``shard_lease_expired`` events carry ``shard`` (int >= 0), ``age_s``
+  (>= 0) and ``ttl_s`` (> 0) — a fleet worker's heartbeat went stale
+  past its lease;
+* ``shard_merge`` events carry ``units``/``duplicates`` (ints >= 0)
+  and ``shards`` (int >= 1) — the fleet reduce receipt (duplicates are
+  speculation/recovery overlap the per-unit merge deduplicated);
 * the last line is the ``summary``: ``wall_seconds``, ``ok``, and a
   ``metrics`` snapshot whose counters/gauges are numeric and whose
   histograms are internally consistent (count == sum of bucket counts);
@@ -88,9 +103,17 @@ _NUM = (int, float)
 #: this file's schema knowledge)
 _FAULT_SITES = ("device_dispatch", "device_put", "spill_write",
                 "checkpoint_write", "feeder_load", "worker_proc",
-                "input_record")
+                "input_record", "shard_lease")
 _FAULT_KINDS = ("error", "latency", "truncate", "corrupt", "kill")
 _RETRY_ACTIONS = ("retry", "split", "fallback_cpu", "raise")
+_SHARD_CAUSES = ("death", "speculation")
+_SHARD_ACTIONS = ("none", "respawn", "redistribute", "fail",
+                  "speculate")
+
+
+def _is_hex(v) -> bool:
+    return (isinstance(v, str) and len(v) >= 8 and
+            all(c in "0123456789abcdef" for c in v))
 
 
 def _is_num(v) -> bool:
@@ -409,6 +432,80 @@ def validate(path: str) -> List[str]:
                         and v >= 0):
                     err(i, f"trace_written missing non-negative int "
                            f"{field!r}")
+        elif ev == "shard_plan_selected":
+            for field in ("n_hosts", "n_units", "unit_rows"):
+                v = d.get(field)
+                if not (isinstance(v, int) and not isinstance(v, bool)
+                        and v >= 1):
+                    err(i, f"shard_plan_selected missing int "
+                           f"{field!r} >= 1")
+            a = d.get("assignments")
+            ok_shape = (isinstance(a, list) and a and all(
+                isinstance(r, list) and len(r) == 2 and
+                all(isinstance(x, int) and not isinstance(x, bool)
+                    for x in r) and r[0] < r[1] for r in a))
+            if not ok_shape:
+                err(i, "shard_plan_selected 'assignments' is not a "
+                       "non-empty list of [lo, hi) int pairs")
+            else:
+                if a[0][0] != 0 or any(
+                        a[k][1] != a[k + 1][0]
+                        for k in range(len(a) - 1)) or \
+                        (isinstance(d.get("n_units"), int) and
+                         a[-1][1] != d["n_units"]):
+                    err(i, "shard_plan_selected assignments must tile "
+                           "[0, n_units) contiguously without overlap")
+            if not isinstance(d.get("reason"), str):
+                err(i, "shard_plan_selected missing string 'reason'")
+            if not isinstance(d.get("inputs"), dict):
+                err(i, "shard_plan_selected missing 'inputs' object "
+                       "(decision must be replayable)")
+            if not _is_hex(d.get("input_digest")):
+                err(i, "shard_plan_selected missing hex 'input_digest'")
+        elif ev == "shard_reassigned":
+            if d.get("cause") not in _SHARD_CAUSES:
+                err(i, f"shard_reassigned unknown cause "
+                       f"{d.get('cause')!r}")
+            if d.get("action") not in _SHARD_ACTIONS:
+                err(i, f"shard_reassigned unknown action "
+                       f"{d.get('action')!r}")
+            sh = d.get("shard")
+            if not (isinstance(sh, int) and not isinstance(sh, bool)
+                    and sh >= 0):
+                err(i, "shard_reassigned missing int 'shard' >= 0")
+            if d.get("cause") == "death":
+                if not isinstance(d.get("splits"), list):
+                    err(i, "shard_reassigned (death) missing 'splits' "
+                           "list")
+            elif not isinstance(d.get("tail_runs"), list):
+                err(i, "shard_reassigned (speculation) missing "
+                       "'tail_runs' list")
+            if not isinstance(d.get("inputs"), dict):
+                err(i, "shard_reassigned missing 'inputs' object "
+                       "(decision must be replayable)")
+            if not _is_hex(d.get("input_digest")):
+                err(i, "shard_reassigned missing hex 'input_digest'")
+        elif ev == "shard_lease_expired":
+            sh = d.get("shard")
+            if not (isinstance(sh, int) and not isinstance(sh, bool)
+                    and sh >= 0):
+                err(i, "shard_lease_expired missing int 'shard' >= 0")
+            if not (_is_num(d.get("age_s")) and d["age_s"] >= 0):
+                err(i, "shard_lease_expired missing non-negative "
+                       "'age_s'")
+            if not (_is_num(d.get("ttl_s")) and d["ttl_s"] > 0):
+                err(i, "shard_lease_expired missing positive 'ttl_s'")
+        elif ev == "shard_merge":
+            for field in ("units", "duplicates"):
+                v = d.get(field)
+                if not (isinstance(v, int) and not isinstance(v, bool)
+                        and v >= 0):
+                    err(i, f"shard_merge missing non-negative int "
+                           f"{field!r}")
+            sh = d.get("shards")
+            if not (isinstance(sh, int) and not isinstance(sh, bool)
+                    and sh >= 1):
+                err(i, "shard_merge missing int 'shards' >= 1")
 
     if summaries:
         i, s = summaries[0]
