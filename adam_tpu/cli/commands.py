@@ -621,6 +621,137 @@ class TransformCommand(Command):
 
 
 @register
+class ServeCommand(Command):
+    name = "serve"
+    help = ("Long-lived multi-tenant front-end: warm the device once, "
+            "serve many jobs from a spool directory")
+
+    def add_args(self, p: argparse.ArgumentParser) -> None:
+        p.add_argument("spool",
+                       help="spool directory (queue/running/done/failed "
+                            "job-spec exchange; clients use "
+                            "'adam-tpu submit')")
+        p.add_argument("-chunk_rows", type=int, default=1 << 22,
+                       help="reads per streamed chunk — the SERVER owns "
+                            "this so every tenant's jobs land on one "
+                            "canonical shape ladder (structural "
+                            "cross-job compile-cache hits)")
+        p.add_argument("-max_concurrent", type=int, default=4,
+                       help="jobs admitted per round (FIFO; "
+                            "docs/ARCHITECTURE.md §6i)")
+        p.add_argument("-no_pack", action="store_true",
+                       help="disable cross-tenant shared dispatches "
+                            "(each admitted flagstat job then streams "
+                            "solo)")
+        p.add_argument("-pack_segments", type=int, default=8,
+                       help="tenants per shared dispatch buffer (the "
+                            "segmented kernel's compiled width)")
+        p.add_argument("-max_jobs", type=int, default=None,
+                       help="exit after serving N jobs (default: serve "
+                            "until SPOOL/stop appears)")
+        p.add_argument("-idle_timeout", type=float, default=None,
+                       help="exit after this many seconds with an "
+                            "empty queue (default: wait forever)")
+        p.add_argument("-poll_s", type=float, default=0.05,
+                       help="queue poll interval when idle")
+        p.add_argument("-io_procs", type=int, default=1,
+                       help="default BGZF inflate worker processes per "
+                            "job (a job spec's args.io_procs overrides)")
+        add_executor_args(p)
+
+    def run(self, args) -> int:
+        from ..serve.server import ServeServer
+
+        server = ServeServer(
+            args.spool, chunk_rows=args.chunk_rows,
+            max_concurrent=args.max_concurrent,
+            pack=not args.no_pack, pack_segments=args.pack_segments,
+            poll_s=args.poll_s, io_procs=args.io_procs,
+            executor_opts=executor_opts_from(args))
+        info = server.boot()
+        from ..instrument import say
+        say(f"serve: warm on {info.get('backend')} "
+            f"({info.get('n_devices')} device(s)); "
+            f"spool {args.spool}")
+        n = server.run(max_jobs=args.max_jobs,
+                       idle_timeout_s=args.idle_timeout)
+        print(f"served {n} job(s) from {args.spool}")
+        return 0
+
+
+@register
+class SubmitCommand(Command):
+    name = "submit"
+    help = "Submit a job to a running 'adam-tpu serve' spool"
+
+    def add_args(self, p: argparse.ArgumentParser) -> None:
+        p.add_argument("spool", help="the server's spool directory")
+        p.add_argument("job_command", choices=["flagstat", "transform"],
+                       metavar="COMMAND",
+                       help="flagstat or transform")
+        p.add_argument("input", help="SAM/BAM file or Parquet dataset")
+        p.add_argument("output", nargs="?", default=None,
+                       help="output dataset (transform only)")
+        p.add_argument("-tenant", default="default",
+                       help="tenant id — scopes obs labels, trace "
+                            "lanes, and fault-plan rules to this job's "
+                            "owner")
+        p.add_argument("-job_id", default=None,
+                       help="explicit job id (default: assigned)")
+        p.add_argument("-args", dest="job_args", default=None,
+                       metavar="JSON",
+                       help="extra command args as a JSON object (e.g. "
+                            '\'{"markdup": true}\' for transform)')
+        p.add_argument("-wait", action="store_true",
+                       help="poll for the result and print it (flagstat "
+                            "output is byte-identical to the solo CLI)")
+        p.add_argument("-timeout", type=float, default=120.0,
+                       help="-wait timeout in seconds")
+
+    def run(self, args) -> int:
+        import json as _json
+
+        from ..serve import jobspec
+
+        try:
+            job_args = _json.loads(args.job_args) if args.job_args \
+                else {}
+        except ValueError as e:
+            print(f"submit: bad -args JSON: {e}", file=sys.stderr)
+            return 2
+        try:
+            job_id = jobspec.submit_job(args.spool, {
+                "job_id": args.job_id, "tenant": args.tenant,
+                "command": args.job_command, "input": args.input,
+                "output": args.output, "args": job_args})
+        except ValueError as e:
+            print(f"submit: {e}", file=sys.stderr)
+            return 2
+        if not args.wait:
+            print(f"queued {job_id}")
+            return 0
+        try:
+            doc = jobspec.wait_result(args.spool, job_id,
+                                      timeout_s=args.timeout)
+        except TimeoutError as e:
+            print(f"submit: {e}", file=sys.stderr)
+            return 4
+        if not doc.get("ok"):
+            print(f"submit: job {job_id} failed "
+                  f"[{doc.get('error_type')}]: {doc.get('error')}",
+                  file=sys.stderr)
+            return 3
+        result = doc.get("result") or {}
+        if args.job_command == "flagstat":
+            # the exact line the solo CLI prints (byte-identity is the
+            # serve contract, not a best effort)
+            print(result.get("report", ""))
+        else:
+            print(f"wrote {result.get('rows')} reads to {args.output}")
+        return 0
+
+
+@register
 class Reads2RefCommand(Command):
     name = "reads2ref"
     help = "Convert reads to pileups (cli/Reads2Ref.scala:39-75)"

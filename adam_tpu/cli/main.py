@@ -44,6 +44,12 @@ def _honor_platform_env() -> None:
 
 
 def main(argv=None) -> int:
+    # anchor the cold-start clock before anything can touch jax — the
+    # startup_seconds breakdown in the metrics sidecar measures from
+    # here (obs imports no jax; the lazy command imports keep this cheap)
+    from ..obs import startup as _startup
+
+    _startup.begin()
     _load_commands()
     parser = argparse.ArgumentParser(
         prog="adam-tpu",

@@ -98,9 +98,17 @@ def malformed_summary():
     return line
 
 
+def malformed_count() -> int:
+    """Records dropped since the last reset — the serve front-end scopes
+    this per job, so one tenant's dirty input is accounted to that
+    tenant's result document, never a neighbor's."""
+    with _MALFORMED_LOCK:
+        return _MALFORMED["dropped"]
+
+
 def reset_malformed() -> None:
     """Zero the per-run malformed-record accounting (test isolation and
-    the CLI's per-invocation scope)."""
+    the CLI's / serve loop's per-invocation scope)."""
     with _MALFORMED_LOCK:
         _MALFORMED["dropped"] = 0
         _MALFORMED["warned"] = 0

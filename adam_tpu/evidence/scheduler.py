@@ -56,7 +56,12 @@ STAGE_DEADLINES_S = {"probe": 150.0, "flagstat": 180.0, "transform": 280.0,
                      # CPU-mesh fleet scaling (4 full flagstat runs +
                      # worker spawns); never in the TPU capture order —
                      # reached only via --worker/--only shard_scale
-                     "shard_scale": 600.0}
+                     "shard_scale": 600.0,
+                     # warm-serve amortization (K cold CLI spawns + one
+                     # serve process + a packed pair); never in the TPU
+                     # capture order — reached only via --worker/--only
+                     # serve_warm
+                     "serve_warm": 600.0}
 
 TIMEOUTS_ENV = "ADAM_TPU_BENCH_STAGE_TIMEOUTS"
 

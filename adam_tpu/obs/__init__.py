@@ -32,7 +32,7 @@ import os
 import time
 from typing import Iterator, Optional
 
-from . import events, ioledger, trace  # noqa: F401  (re-exported planes)
+from . import events, ioledger, startup, trace  # noqa: F401  (planes)
 from .registry import (counter, gauge, histogram, registry,  # noqa: F401
                        reset_registry)
 from .trace import (TRACE_ENV, trace_path_from, trace_run)  # noqa: F401
@@ -50,6 +50,7 @@ def reset_all() -> None:
     events.discard_log()
     ioledger.reset()
     trace.discard_trace()
+    startup.begin()
 
 
 # ---------------------------------------------------------------------------
@@ -210,6 +211,10 @@ def metrics_run(path: Optional[str], *, argv=None,
         raise
     finally:
         record_device_mem_peak()
+        # the cold-start breakdown (backend init / first compile / first
+        # dispatch) lands in EVERY command's sidecar, so the serve-mode
+        # warmup win is measured against a recorded per-run baseline
+        startup.emit_event(log)
         fields = dict(wall_seconds=round(time.perf_counter() - t0, 6),
                       ok=ok, metrics=registry().snapshot())
         if err:

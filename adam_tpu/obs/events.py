@@ -134,9 +134,15 @@ def _backend_info() -> dict:
     info: dict = {"backend": None, "n_devices": None, "device_kind": None,
                   "process_index": 0, "process_count": 1}
     try:
-        import jax
+        from . import startup
 
-        info["backend"] = jax.default_backend()
+        # when a -metrics run's manifest is the first backend touch,
+        # this probe IS the backend init — time it into the cold-start
+        # breakdown (first write wins across the instrumented sites)
+        with startup.phase("backend_init"):
+            import jax
+
+            info["backend"] = jax.default_backend()
         devs = jax.devices()
         info["n_devices"] = len(devs)
         info["device_kind"] = getattr(devs[0], "device_kind", None)

@@ -1,0 +1,108 @@
+"""Cold-start accounting: where a command's warmup time actually goes.
+
+Every batch CLI invocation pays the same three tolls before its first
+useful byte of work: the jax backend initialization, the first XLA
+compile, and the first device dispatch.  The serve front-end
+(adam_tpu/serve) exists to amortize exactly those tolls across a request
+stream — so they must be *numbers in the sidecar*, not a claim.  This
+module is the passive recorder: cheap first-occurrence marks that the
+existing hooks stamp as a run warms up, emitted as one
+``startup_seconds`` event into the metrics sidecar (obs.metrics_run)
+on every command.
+
+Marks (all seconds, all best-effort — absent when the run never reached
+that phase):
+
+* ``backend_init_s``     — duration of the first backend-initializing
+  jax call this process made through an instrumented site
+  (platform.is_tpu_backend, the metrics manifest's backend probe, or
+  platform.warm);
+* ``first_compile_at_s`` — elapsed from :func:`begin` to the end of the
+  first backend compile (platform.install_compile_metrics' listener);
+* ``first_compile_s``    — that compile's own duration;
+* ``first_dispatch_at_s``— elapsed to the first device dispatch
+  (resilience.retry.dispatch_with_retry, site ``device_dispatch``).
+
+The anchor defaults to module import time and :func:`begin` re-anchors
+it (the CLI calls it at entry, before any jax import).  Everything here
+is telemetry: lock-free reads, first-write-wins marks, never raises.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+from typing import Dict, Iterator, Optional
+
+_LOCK = threading.Lock()
+#: anchor for the ``*_at_s`` marks — import time approximates process
+#: start; begin() re-anchors at CLI entry
+_T0: float = time.perf_counter()
+_MARKS: Dict[str, float] = {}
+
+
+def begin() -> None:
+    """Re-anchor the clock and clear the marks (one per command run;
+    the CLI and the bench/serve workers call this at entry)."""
+    global _T0
+    with _LOCK:
+        _T0 = time.perf_counter()
+        _MARKS.clear()
+
+
+def mark_at(phase: str) -> None:
+    """Record ``<phase>_at_s`` = elapsed since the anchor, first write
+    wins (later occurrences of the same phase are not startup)."""
+    t = time.perf_counter() - _T0
+    with _LOCK:
+        _MARKS.setdefault(f"{phase}_at_s", round(t, 6))
+
+
+def mark_duration(phase: str, seconds: float) -> None:
+    """Record ``<phase>_s`` = a measured duration, first write wins."""
+    with _LOCK:
+        _MARKS.setdefault(f"{phase}_s", round(float(seconds), 6))
+
+
+@contextlib.contextmanager
+def phase(name: str) -> Iterator[None]:
+    """Time a block as ``<name>_s`` (first occurrence only).  The check
+    whether the mark already landed is deliberately NOT taken up front:
+    two racing first callers both measure, first write wins — cheaper
+    than holding the lock across the body."""
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        mark_duration(name, time.perf_counter() - t0)
+
+
+def note_first_compile(duration_s: float) -> None:
+    """The compile listener's hook (platform.install_compile_metrics):
+    the first backend compile stamps both its duration and when it
+    finished relative to the anchor."""
+    mark_duration("first_compile", duration_s)
+    mark_at("first_compile")
+
+
+def snapshot() -> Dict[str, float]:
+    with _LOCK:
+        return dict(_MARKS)
+
+
+def emit_event(log=None) -> Optional[dict]:
+    """Emit the ``startup_seconds`` event (into ``log`` when given, else
+    the process-global event sink); returns the emitted fields or None
+    when nothing was marked — a run that never touched jax has no
+    startup story to tell."""
+    snap = snapshot()
+    if not snap:
+        return None
+    if log is not None:
+        log.emit("startup_seconds", **snap)
+    else:
+        from . import events
+
+        events.emit("startup_seconds", **snap)
+    return snap

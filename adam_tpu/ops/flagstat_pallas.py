@@ -257,6 +257,7 @@ def _local_flagstat(wire, *, interpret: bool):
     return counts + flagstat_kernel_wire32(wire[n_blk * BLOCK:])
 
 
+@functools.lru_cache(maxsize=None)
 def flagstat_wire32_sharded_pallas(mesh, interpret: bool = False,
                                    donate: bool = False):
     """Mesh-sharded fast path: each shard runs the Pallas wire sweep on its
@@ -264,7 +265,9 @@ def flagstat_wire32_sharded_pallas(mesh, interpret: bool = False,
     :func:`..ops.flagstat.flagstat_wire32_sharded` (the streaming CLI
     kernel; reference: executor map + driver aggregate,
     FlagStat.scala:102-114).  ``interpret=True`` lets the virtual-CPU test
-    mesh execute the same code path."""
+    mesh execute the same code path.  Memoized per (mesh, interpret,
+    donate) so serve-mode job 2+ reuses the warm jit wrapper instead of
+    recompiling (see flagstat.flagstat_wire32_sharded)."""
     from jax.sharding import PartitionSpec as P
 
     from ..parallel.mesh import READS_AXIS

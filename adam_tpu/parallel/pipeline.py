@@ -49,6 +49,34 @@ def _wire32_from_table(table: pa.Table) -> np.ndarray:
         np.ones(n, np.uint8))
 
 
+def flagstat_wire_chunks(path: str, *, chunk_rows: int,
+                         io_procs: int = 1):
+    """Wire-word chunks for any reads input — the streaming flagstat
+    front half, shared with the serve front-end's cross-tenant packer
+    (adam_tpu/serve/packed.py).  BAM inputs take the native wire walk
+    (no string decode; ``ADAM_TPU_FLAGSTAT_DECODE=arrow`` opts out),
+    everything else packs the 4-column Arrow projection per chunk.  The
+    I/O-ledger scope attributes the input's on-disk bytes to pass
+    ``flagstat`` at open, exactly like the solo path."""
+    from ..io.dispatch import FLAGSTAT_COLUMNS
+    from ..io.stream import open_read_stream
+
+    with obs.ioledger.pass_scope("flagstat"):
+        if path.endswith(".bam") and \
+                os.environ.get("ADAM_TPU_FLAGSTAT_DECODE",
+                               "auto") != "arrow":
+            from ..io.fastbam import open_bam_wire32_stream
+            wire_chunks = open_bam_wire32_stream(path,
+                                                 chunk_rows=chunk_rows,
+                                                 io_procs=io_procs)
+            if wire_chunks is not None:     # None: no native module —
+                return wire_chunks          # fall back to the Arrow path
+        stream = open_read_stream(path, columns=FLAGSTAT_COLUMNS,
+                                  chunk_rows=chunk_rows,
+                                  io_procs=io_procs)
+        return (_wire32_from_table(t) for t in stream)
+
+
 def streaming_flagstat(path: str, *, mesh=None, chunk_rows: int = 1 << 22,
                        io_threads: int = 1, io_procs: int = 1,
                        executor_opts: Optional[dict] = None
@@ -70,8 +98,6 @@ def streaming_flagstat(path: str, *, mesh=None, chunk_rows: int = 1 << 22,
     """
     import jax
 
-    from ..io.dispatch import FLAGSTAT_COLUMNS
-    from ..io.stream import open_read_stream
     from ..ops.flagstat import (FlagStatMetrics, flagstat_wire32_sharded)
     from .executor import StreamExecutor
 
@@ -114,19 +140,8 @@ def streaming_flagstat(path: str, *, mesh=None, chunk_rows: int = 1 << 22,
     # arrow opts back into the Arrow path, e.g. for differential checks).
     # The I/O-ledger scope attributes the input's on-disk bytes (counted
     # by the stream openers) to this pass as decoded input.
-    wire_chunks = None
-    with obs.ioledger.pass_scope("flagstat"):
-        if path.endswith(".bam") and \
-                os.environ.get("ADAM_TPU_FLAGSTAT_DECODE", "auto") != "arrow":
-            from ..io.fastbam import open_bam_wire32_stream
-            wire_chunks = open_bam_wire32_stream(path,
-                                                 chunk_rows=pex.chunk_rows,
-                                                 io_procs=io_procs)
-        if wire_chunks is None:
-            stream = open_read_stream(path, columns=FLAGSTAT_COLUMNS,
-                                      chunk_rows=pex.chunk_rows,
-                                      io_procs=io_procs)
-            wire_chunks = (_wire32_from_table(t) for t in stream)
+    wire_chunks = flagstat_wire_chunks(path, chunk_rows=pex.chunk_rows,
+                                       io_procs=io_procs)
     if io_threads > 1:
         # decode (native wire walk / Arrow projection) moves to a reader
         # thread so it overlaps device dispatch; counter accumulation is

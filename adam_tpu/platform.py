@@ -183,6 +183,59 @@ def apply_pending_default_cache() -> None:
     _apply_cache_config(path)
 
 
+def warm() -> dict:
+    """Pre-pay the cold-start tolls NOW, not on the first tenant's job.
+
+    The serve front-end (adam_tpu/serve) calls this once at boot: it
+    initializes the jax backend, resolves the deferred default
+    compilation-cache decision (:func:`enable_compilation_cache`'s
+    listener path would otherwise wait for the first real compile —
+    i.e. the first tenant's job would pay the un-cached compile), and
+    runs one tiny jit dispatch so the dispatch machinery is hot.
+    Returns the measured breakdown (also recorded in obs.startup)::
+
+        {"backend": str, "n_devices": int, "backend_init_s": float,
+         "warm_dispatch_s": float, "cache_resolved": bool}
+
+    Safe to call repeatedly — a warm backend just re-measures cheap
+    reads (and the startup marks keep their first values).  Never
+    raises: a broken backend returns the error string instead, and the
+    caller (which is about to run real jobs that will surface the same
+    problem loudly) decides what to do.
+    """
+    import time as _time
+
+    from .obs import startup
+
+    out: dict = {"backend": None, "n_devices": 0,
+                 "backend_init_s": 0.0, "warm_dispatch_s": 0.0,
+                 "cache_resolved": False}
+    try:
+        t0 = _time.perf_counter()
+        with startup.phase("backend_init"):
+            import jax
+
+            out["backend"] = jax.default_backend()
+        out["n_devices"] = len(jax.devices())
+        out["backend_init_s"] = round(_time.perf_counter() - t0, 6)
+        # the deferred default-cache decision normally resolves on the
+        # first compile event; the backend is initialized now, so
+        # resolve it eagerly — the warm dispatch below then compiles
+        # WITH the cache config in place
+        apply_pending_default_cache()
+        out["cache_resolved"] = not _PENDING_DEFAULT_CACHE
+        t0 = _time.perf_counter()
+        import jax.numpy as jnp
+
+        jax.block_until_ready(
+            jax.jit(lambda x: x + 1)(jnp.zeros((8,), jnp.int32)))
+        out["warm_dispatch_s"] = round(_time.perf_counter() - t0, 6)
+        startup.mark_at("first_dispatch")
+    except Exception as e:  # noqa: BLE001 — warming is best-effort
+        out["error"] = f"{type(e).__name__}: {e}"[:200]
+    return out
+
+
 def axis_size(axis_name):
     """``jax.lax.axis_size`` on any installed jax (older releases spell
     it ``core.axis_frame``); concrete int under shard_map tracing."""
@@ -234,10 +287,15 @@ def install_compile_metrics() -> None:
             elif "/compilation_cache/cache_misses" in event:
                 registry().counter("compile_cache_misses").inc()
 
+        from .obs import startup
+
         def on_duration(event: str, duration: float, **kw) -> None:
             if event.endswith("backend_compile_duration"):
                 registry().counter("compile_count").inc()
                 registry().counter("compile_seconds").inc(duration)
+                # first-write-wins: only the run's FIRST compile lands
+                # in the startup_seconds breakdown
+                startup.note_first_compile(duration)
 
         monitoring.register_event_listener(on_event)
         monitoring.register_event_duration_secs_listener(on_duration)
@@ -283,9 +341,17 @@ def is_tpu_backend() -> bool:
     interpreter on real chunks).  Single shared predicate for every
     fast-path gate.
     """
-    import jax
+    from .obs import startup
 
-    if jax.default_backend() in ("tpu", "axon"):
+    # the first call through here usually IS the backend init (every
+    # streaming pass gates on it before compiling anything) — time it
+    # into the cold-start breakdown; later calls re-measure a cached
+    # backend read in microseconds and lose the first-write race
+    with startup.phase("backend_init"):
+        import jax
+
+        backend = jax.default_backend()
+    if backend in ("tpu", "axon"):
         return True
     try:
         return any("tpu" in getattr(d, "device_kind", "").lower()

@@ -68,6 +68,13 @@ INCARNATION_ENV = "ADAM_TPU_INCARNATION"
 #: it matches — how the chaos matrix targets one host of a fleet
 SHARD_ENV = "ADAM_TPU_SHARD_ID"
 
+#: the serve front-end's per-job scope (adam_tpu/serve): the server sets
+#: the current tenant around each job's execution, and plan rules with a
+#: ``tenant`` field only fire while that tenant's job runs — how the
+#: chaos matrix faults tenant A without touching tenant B.  Module
+#: state, not env: tenants multiplex inside ONE process.
+_TENANT: Optional[str] = None
+
 #: error codes an ``error`` fault may raise (the transient set mirrors
 #: retry.classify_error's XLA status matching; FORMAT raises the typed
 #: input error the CLI already turns into a clean one-line exit)
@@ -165,6 +172,8 @@ def _canon_rule(i: int, rule: dict) -> dict:
         out["incarnation"] = int(rule["incarnation"])
     if "shard" in rule:
         out["shard"] = int(rule["shard"])
+    if "tenant" in rule:
+        out["tenant"] = str(rule["tenant"])
     return out
 
 
@@ -208,10 +217,13 @@ def install_from_env(flag_value: Optional[str] = None) -> Optional[dict]:
 
 
 def clear_plan() -> None:
-    """Remove the installed plan and zero the counters (test isolation)."""
-    global _PLAN
+    """Remove the installed plan and zero the counters (test isolation).
+    The serve tenant scope clears too — a leaked tenant would silently
+    re-scope the next test's plan."""
+    global _PLAN, _TENANT
     with _LOCK:
         _PLAN = None
+        _TENANT = None
         _COUNTS.clear()
         _BY_SITE.clear()
 
@@ -241,6 +253,7 @@ def _occ_matches(spec, occurrence: int) -> bool:
 def decide_fault(*, site: str, occurrence: int,
                  incarnation: Optional[int] = None,
                  shard: Optional[int] = None,
+                 tenant: Optional[str] = None,
                  rules: list) -> dict:
     """Whether (and how) this site occurrence fires — PURE.
 
@@ -248,8 +261,9 @@ def decide_fault(*, site: str, occurrence: int,
     executor ladder's first-fit).  The returned decision carries the
     canonicalized ``inputs`` and their ``input_digest``, the replayable
     contract tools/check_resilience.py verifies.  ``shard`` (the fleet
-    worker's id, from ``ADAM_TPU_SHARD_ID``) joins the inputs ONLY when
-    set, so pre-fleet sidecars replay digest-identical.
+    worker's id, from ``ADAM_TPU_SHARD_ID``) and ``tenant`` (the serve
+    front-end's current job scope) join the inputs ONLY when set, so
+    pre-fleet/pre-serve sidecars replay digest-identical.
     """
     inputs = dict(site=site, occurrence=int(occurrence),
                   incarnation=None if incarnation is None
@@ -257,6 +271,8 @@ def decide_fault(*, site: str, occurrence: int,
                   rules=[dict(r) for r in rules])
     if shard is not None:
         inputs["shard"] = int(shard)
+    if tenant is not None:
+        inputs["tenant"] = str(tenant)
     hit = None
     idx = None
     for i, rule in enumerate(inputs["rules"]):
@@ -268,6 +284,8 @@ def decide_fault(*, site: str, occurrence: int,
                 rule["incarnation"] != inputs["incarnation"]:
             continue
         if "shard" in rule and rule["shard"] != inputs.get("shard"):
+            continue
+        if "tenant" in rule and rule["tenant"] != inputs.get("tenant"):
             continue
         hit, idx = rule, i
         break
@@ -299,6 +317,18 @@ def _shard() -> Optional[int]:
         return None
 
 
+def set_tenant(tenant: Optional[str]) -> None:
+    """Scope subsequent firings to one serve tenant (None clears).  The
+    serve front-end brackets each job's execution with this, so a plan
+    rule carrying ``tenant`` targets exactly one job's dispatches."""
+    global _TENANT
+    _TENANT = None if tenant is None else str(tenant)
+
+
+def current_tenant() -> Optional[str]:
+    return _TENANT
+
+
 def fire(site: str, path: Optional[str] = None) -> None:
     """The injection hook every choke point calls.
 
@@ -328,13 +358,16 @@ def fire(site: str, path: Optional[str] = None) -> None:
     # recorded decision stays bit-for-bit replayable
     inc = _incarnation()
     shard = _shard()
+    tenant = _TENANT
     if not any(_occ_matches(r["occurrence"], occ)
                and ("incarnation" not in r or r["incarnation"] == inc)
                and ("shard" not in r or r["shard"] == shard)
+               and ("tenant" not in r or r["tenant"] == tenant)
                for r in candidates):
         return
     d = decide_fault(site=site, occurrence=occ,
-                     incarnation=inc, shard=shard, rules=plan["rules"])
+                     incarnation=inc, shard=shard, tenant=tenant,
+                     rules=plan["rules"])
     if not d["fire"]:
         return
     obs.registry().counter("faults_injected", site=site).inc()

@@ -314,6 +314,10 @@ def dispatch_with_retry(fn: Callable[[int], object], *,
     """
     if policy is None:
         policy = resolve_retry_policy()
+    if site == "device_dispatch":
+        # every device dispatch funnels through here — the first one of
+        # the process closes the cold-start window (obs.startup)
+        obs.startup.mark_at("first_dispatch")
     attempt = 0
     while True:
         attempt += 1

@@ -1,0 +1,27 @@
+"""``adam_tpu.serve`` — the always-warm, multi-tenant front-end.
+
+Every batch CLI invocation pays cold jax init + XLA compile per run;
+the canonical shape ladder (parallel/executor.py) already guarantees the
+compiled kernels are reusable across runs, so the only thing missing is
+a process that *lives* across runs.  This package is that process:
+
+* :mod:`.jobspec`   — the filesystem job-spec queue (atomic submit,
+  durable per-job results, crash-safe re-queue);
+* :mod:`.admission` — the pure, replayable admission/batching
+  controller (``decide_admission``, the ``decide_plan`` convention:
+  recorded inputs + digest, replayed by tools/check_executor.py);
+* :mod:`.packed`    — cross-tenant shared dispatches: one fixed-capacity
+  flagstat wire buffer packs many tenants' rows, segment prefix-sum
+  bounds keep per-tenant counters exact (ops/flagstat.py's segmented
+  kernel, the ragged-concat discipline of docs/ARCHITECTURE.md §6g);
+* :mod:`.server`    — the long-lived loop: warm the backend once
+  (platform.warm), admit queued jobs, multiplex them onto one device
+  with per-tenant isolation (obs labels, fault/retry scoping, malformed
+  budgets — one tenant's failure never touches another's bytes).
+
+docs/ARCHITECTURE.md §6i walks the dataflow.
+"""
+
+from .admission import decide_admission  # noqa: F401
+from .jobspec import submit_job, wait_result  # noqa: F401
+from .server import ServeServer  # noqa: F401

@@ -1,0 +1,89 @@
+"""The pure admission/batching controller — the autotuner grown into a
+scheduler.
+
+Each serve round, the server snapshots its queue and asks ONE pure
+function which jobs run now and which of them share dispatches:
+
+* **FIFO admission** bounded by ``max_concurrent`` — submit order is the
+  only fairness story that is both starvation-free and replayable (no
+  clocks, no sizes-as-priorities that would let a huge tenant starve a
+  small one at decision time);
+* **cross-tenant pack groups** — admitted flagstat jobs co-dispatch
+  through the shared fixed-capacity wire buffer (serve/packed.py), at
+  most ``pack_segments`` tenants per group (the segmented kernel's
+  compiled segment width); a lone flagstat job runs solo, since a
+  one-tenant "shared" buffer is just the ragged path with extra steps.
+
+:func:`decide_admission` follows the ``decide_plan`` convention
+(parallel/executor.py): PURE, canonicalized inputs recorded verbatim in
+the ``admission_selected`` event plus their digest, replayed offline by
+tools/check_executor.py.  The queue snapshot it decides from carries
+only (job_id, tenant, command, seq) — admission never reads a byte of
+input data, so the decision is cheap and the replay needs no files.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+from typing import Iterable
+
+#: compiled segment width of the shared flagstat dispatch buffer — the
+#: segmented kernel (ops/flagstat.flagstat_kernel_wire32_segmented)
+#: compiles per (capacity, S), so the server pads every group to this
+DEFAULT_PACK_SEGMENTS = 8
+
+#: commands the shared-dispatch packer can co-schedule (transform runs
+#: a multi-pass dataflow with its own spills — it multiplexes between
+#: jobs, not inside a dispatch)
+PACKABLE_COMMANDS = ("flagstat",)
+
+
+def decide_admission(*, queued: Iterable[dict], running: int,
+                     max_concurrent: int, pack: bool = True,
+                     pack_segments: int = DEFAULT_PACK_SEGMENTS) -> dict:
+    """One serve round's admission plan — PURE.
+
+    ``queued``: compact descriptors ``{"job_id", "tenant", "command",
+    "seq"}`` (any order; canonicalization sorts by ``seq``).
+    ``running``: jobs already executing (occupied slots).  Returns::
+
+        {"admit": [job_id, ...],          # start these, in order
+         "pack_groups": [[job_id, ...]],  # co-dispatched subsets
+         "reason": str,
+         "inputs": {...}, "input_digest": hex}
+
+    Every ``pack_groups`` member also appears in ``admit``; groups hold
+    >= 2 jobs (singletons run solo).  The recorded inputs replay the
+    decision bit-for-bit (tools/check_executor.py).
+    """
+    canon = sorted((dict(job_id=str(q["job_id"]), tenant=str(q["tenant"]),
+                         command=str(q["command"]), seq=int(q["seq"]))
+                    for q in queued), key=lambda q: q["seq"])
+    inputs = dict(queued=canon, running=int(running),
+                  max_concurrent=int(max_concurrent), pack=bool(pack),
+                  pack_segments=int(pack_segments))
+    slots = max(inputs["max_concurrent"] - inputs["running"], 0)
+    admitted = inputs["queued"][:slots]
+    admit = [q["job_id"] for q in admitted]
+    reasons = [f"fifo {len(admit)}/{len(canon)} queued into "
+               f"{slots} slot(s)"]
+    pack_groups: list = []
+    if inputs["pack"]:
+        packable = [q["job_id"] for q in admitted
+                    if q["command"] in PACKABLE_COMMANDS]
+        width = max(inputs["pack_segments"], 2)
+        for lo in range(0, len(packable), width):
+            group = packable[lo:lo + width]
+            if len(group) >= 2:
+                pack_groups.append(group)
+        if pack_groups:
+            reasons.append(
+                f"packed {sum(len(g) for g in pack_groups)} flagstat "
+                f"job(s) into {len(pack_groups)} shared dispatch "
+                f"group(s)")
+    digest = hashlib.sha256(
+        json.dumps(inputs, sort_keys=True).encode()).hexdigest()[:16]
+    return dict(admit=admit, pack_groups=pack_groups,
+                reason="; ".join(reasons), inputs=inputs,
+                input_digest=digest)

@@ -1,0 +1,372 @@
+"""Filesystem job-spec queue for the serve front-end.
+
+The transport is deliberately the dumbest durable thing that works
+everywhere the CLI works: a spool directory of JSON files.  Submission
+is atomic (write tmp, hard-link into the queue — a name collision loses
+the race and retries the next sequence number), results are atomic
+(tmp+rename, the sidecar discipline), and a server crash loses nothing:
+jobs found under ``running/`` at boot re-queue, because every job is a
+pure function of its spec (the streaming commands it wraps are
+idempotent over their inputs and rewrite their outputs whole).
+
+Spool layout::
+
+    SPOOL/queue/<seq>-<job_id>.json    submitted, waiting
+    SPOOL/running/<seq>-<job_id>.json  claimed by the server
+    SPOOL/done/<job_id>.json           result document (ok)
+    SPOOL/failed/<job_id>.json         result document (typed failure)
+    SPOOL/serving.json                 server boot receipt (pid + warmup)
+    SPOOL/stop                         sentinel: drain and exit
+
+Job spec (canonicalized by :func:`canon_spec`)::
+
+    {"job_id": str, "tenant": str, "command": "flagstat" | "transform",
+     "input": str, "output": str | null, "args": {...}}
+
+``args`` forwards a whitelisted subset of the underlying streaming
+call's keywords (:data:`FLAGSTAT_ARGS` / :data:`TRANSFORM_ARGS`) — the
+server, not the client, owns executor shape knobs, so every tenant's
+jobs land on the one canonical shape ladder and cross-job compile-cache
+hits are structural.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+from typing import Iterator, Optional, Tuple
+
+from ..checkpoint import atomic_write
+
+QUEUE, RUNNING, DONE, FAILED = "queue", "running", "done", "failed"
+STOP_SENTINEL = "stop"
+SERVING_MARKER = "serving.json"
+
+COMMANDS = ("flagstat", "transform")
+
+#: per-command arg whitelists — the spec's ``args`` may set only these
+#: (anything else is a validation error, not a silent drop)
+FLAGSTAT_ARGS = ("io_procs",)
+TRANSFORM_ARGS = ("markdup", "bqsr", "dbsnp_sites", "realign", "sort",
+                  "io_procs", "io_threads")
+
+_ID_RE = re.compile(r"^[A-Za-z0-9._-]{1,80}$")
+_NAME_RE = re.compile(r"^(\d{8,})-(.+)\.json$")
+
+#: high-water sequence hint, max-merged on every successful submit so
+#: enqueueing stays O(in-flight), not O(every job ever served) — the
+#: hard-link race below is what actually guarantees uniqueness
+_SEQ_FILE = ".seq"
+
+
+def spool_dirs(spool: str) -> Tuple[str, str, str, str]:
+    return tuple(os.path.join(spool, d)
+                 for d in (QUEUE, RUNNING, DONE, FAILED))
+
+
+def ensure_spool(spool: str) -> str:
+    for d in spool_dirs(spool):
+        os.makedirs(d, exist_ok=True)
+    return spool
+
+
+def canon_spec(spec: dict) -> dict:
+    """Validate + canonicalize one job spec (what queue files hold and
+    what results echo back).  Raises ``ValueError`` on anything a server
+    round could not execute — bad submissions fail at submit time, on
+    the client, never inside the serve loop."""
+    if not isinstance(spec, dict):
+        raise ValueError("job spec must be a JSON object")
+    cmd = spec.get("command")
+    if cmd not in COMMANDS:
+        raise ValueError(f"job spec: unknown command {cmd!r} "
+                         f"(want one of {', '.join(COMMANDS)})")
+    tenant = spec.get("tenant", "default")
+    if not (isinstance(tenant, str) and _ID_RE.match(tenant)):
+        raise ValueError(f"job spec: bad tenant {tenant!r} "
+                         "(want [A-Za-z0-9._-]{1,80})")
+    job_id = spec.get("job_id")
+    if job_id is not None and not (isinstance(job_id, str)
+                                   and _ID_RE.match(job_id)):
+        raise ValueError(f"job spec: bad job_id {job_id!r}")
+    inp = spec.get("input")
+    if not (isinstance(inp, str) and inp):
+        raise ValueError("job spec: missing input path")
+    output = spec.get("output")
+    if cmd == "transform":
+        if not (isinstance(output, str) and output):
+            raise ValueError("job spec: transform needs an output path")
+    elif output is not None:
+        raise ValueError(f"job spec: {cmd} takes no output path")
+    args = spec.get("args") or {}
+    if not isinstance(args, dict):
+        raise ValueError("job spec: args must be an object")
+    allowed = FLAGSTAT_ARGS if cmd == "flagstat" else TRANSFORM_ARGS
+    unknown = sorted(set(args) - set(allowed))
+    if unknown:
+        raise ValueError(f"job spec: unknown {cmd} args {unknown} "
+                         f"(allowed: {', '.join(allowed)})")
+    return {"job_id": job_id, "tenant": tenant, "command": cmd,
+            "input": inp, "output": output, "args": dict(args)}
+
+
+_AUTO_ID_RE = re.compile(r"^job(\d{8,})\.json$")
+
+
+def _live_max_seq(spool: str) -> int:
+    """Highest sequence among IN-FLIGHT jobs (queue + running names
+    carry it as their prefix) — bounded by concurrency, cheap."""
+    seq = 0
+    for d in (QUEUE, RUNNING):
+        try:
+            names = os.listdir(os.path.join(spool, d))
+        except OSError:
+            continue
+        for name in names:
+            m = _NAME_RE.match(name)
+            if m:
+                seq = max(seq, int(m.group(1)))
+    return seq
+
+
+def _max_seq(spool: str) -> int:
+    """Highest sequence number the spool has EVER assigned: in-flight
+    names plus retired auto-id results (``done/jobNNNNNNNN.json``) —
+    scanning only the live queue would recycle seq 1 the moment the
+    queue drains, and a recycled auto job_id would let a waiting client
+    read the PREVIOUS job's result document.  Full-scan fallback for
+    spools without a ``.seq`` hint; normal submits read the hint and
+    scan only the in-flight dirs."""
+    seq = _live_max_seq(spool)
+    for d in (DONE, FAILED):
+        try:
+            names = os.listdir(os.path.join(spool, d))
+        except OSError:
+            continue
+        for name in names:
+            m = _AUTO_ID_RE.match(name)
+            if m:
+                seq = max(seq, int(m.group(1)))
+    return seq
+
+
+def _read_seq_hint(spool: str) -> Optional[int]:
+    try:
+        with open(os.path.join(spool, _SEQ_FILE)) as f:
+            return int(f.read().strip())
+    except (OSError, ValueError):
+        return None
+
+
+def _write_seq_hint(spool: str, seq: int) -> None:
+    """Max-merge the high-water hint (atomic tmp+rename; a racing
+    writer can only lose a few numbers, and the hard-link submit race
+    re-resolves those — the hint is a scan-avoidance optimization,
+    never the uniqueness authority)."""
+    try:
+        cur = _read_seq_hint(spool) or 0
+        path = os.path.join(spool, _SEQ_FILE)
+        tmp = path + f".tmp{os.getpid()}"
+        with open(tmp, "w") as f:
+            f.write(str(max(cur, seq)))
+        os.replace(tmp, path)
+    except OSError:
+        pass
+
+
+def _result_exists(spool: str, job_id: str) -> bool:
+    return any(os.path.exists(os.path.join(spool, d, f"{job_id}.json"))
+               for d in (DONE, FAILED))
+
+
+def _id_in_flight(spool: str, job_id: str) -> bool:
+    suffix = f"-{job_id}.json"
+    for d in (QUEUE, RUNNING):
+        try:
+            names = os.listdir(os.path.join(spool, d))
+        except OSError:
+            continue
+        if any(n.endswith(suffix) and _NAME_RE.match(n) for n in names):
+            return True
+    return False
+
+
+def submit_job(spool: str, spec: dict) -> str:
+    """Atomically enqueue one job; returns its ``job_id``.
+
+    The sequence number (submit order — what FIFO admission orders by)
+    is high-water+1 — the ``.seq`` hint max-merged with the in-flight
+    names (a hintless spool pays one full scan); a concurrent submitter
+    that claims the same number loses the hard-link race and retries
+    the next one, so two clients can never clobber each other's specs.
+
+    Input/output paths resolve to absolute HERE, on the submitting
+    side: the server's cwd is not the client's, and a relative
+    ``sample.bam`` must mean the client's file, not whatever same-named
+    file sits next to the server."""
+    ensure_spool(spool)
+    spec = canon_spec(spec)
+    spec["input"] = os.path.abspath(spec["input"])
+    if spec["output"] is not None:
+        spec["output"] = os.path.abspath(spec["output"])
+    if spec["job_id"] and (_result_exists(spool, spec["job_id"]) or
+                           _id_in_flight(spool, spec["job_id"])):
+        raise ValueError(
+            f"job_id {spec['job_id']!r} already has a result or a "
+            "queued/running job in this spool (pick a fresh id — "
+            "results key by job_id)")
+    qdir = os.path.join(spool, QUEUE)
+    hint = _read_seq_hint(spool)
+    seq = max(hint, _live_max_seq(spool)) if hint is not None \
+        else _max_seq(spool)
+    while True:
+        seq += 1
+        job_id = spec["job_id"] or f"job{seq:08d}"
+        final = os.path.join(qdir, f"{seq:08d}-{job_id}.json")
+        tmp = final + f".tmp{os.getpid()}"
+        doc = dict(spec, job_id=job_id, seq=seq)
+        with open(tmp, "w") as f:
+            f.write(json.dumps(doc, sort_keys=True))
+            f.flush()
+            os.fsync(f.fileno())
+        try:
+            os.link(tmp, final)     # fails if the name exists: no clobber
+        except FileExistsError:
+            os.unlink(tmp)
+            if spec["job_id"]:
+                raise ValueError(
+                    f"job_id {spec['job_id']!r} already queued at "
+                    f"seq {seq}")
+            continue
+        os.unlink(tmp)
+        _write_seq_hint(spool, seq)
+        return job_id
+
+
+def iter_queue(spool: str) -> Iterator[Tuple[int, str, dict]]:
+    """Queued jobs in submit order: yields ``(seq, path, spec)``.
+    Unreadable/torn files (a submitter mid-write crashed before the
+    atomic link — impossible — or manual tampering) are skipped, not
+    fatal: one bad file must not wedge the queue."""
+    qdir = os.path.join(spool, QUEUE)
+    try:
+        names = os.listdir(qdir)
+    except OSError:
+        return
+    # numeric order, not lexicographic: past seq 99,999,999 the name
+    # grows a digit and a string sort would serve it out of order
+    matched = sorted(((int(m.group(1)), n)
+                      for n in names
+                      for m in (_NAME_RE.match(n),) if m))
+    for _, name in matched:
+        path = os.path.join(qdir, name)
+        m = _NAME_RE.match(name)
+        try:
+            with open(path) as f:
+                spec = json.load(f)
+        except (OSError, ValueError):
+            continue
+        if isinstance(spec, dict):
+            yield int(m.group(1)), path, spec
+
+
+def claim_job(spool: str, queue_path: str) -> Optional[str]:
+    """Move a queued job to ``running/`` (atomic rename).  Returns the
+    running path, or None when another server instance claimed it
+    first."""
+    dest = os.path.join(spool, RUNNING, os.path.basename(queue_path))
+    try:
+        os.rename(queue_path, dest)
+    except OSError:
+        return None
+    return dest
+
+
+def requeue_running(spool: str) -> int:
+    """Boot-time crash recovery: any job still under ``running/`` was
+    claimed by a server that died mid-job — move it back to the queue
+    (jobs are idempotent; see module docstring).  Returns the count."""
+    rdir = os.path.join(spool, RUNNING)
+    n = 0
+    try:
+        names = os.listdir(rdir)
+    except OSError:
+        return 0
+    for name in sorted(names):
+        if _NAME_RE.match(name):
+            try:
+                os.rename(os.path.join(rdir, name),
+                          os.path.join(spool, QUEUE, name))
+                n += 1
+            except OSError:
+                pass
+    return n
+
+
+def write_result(spool: str, spec: dict, *, ok: bool,
+                 result: Optional[dict] = None,
+                 error: Optional[str] = None,
+                 error_type: Optional[str] = None,
+                 seconds: Optional[float] = None,
+                 running_path: Optional[str] = None) -> str:
+    """Publish one job's durable result document (atomic tmp+rename)
+    and retire its running-claim file.  ``done/`` and ``failed/`` key by
+    job_id — the client polls one well-known name."""
+    doc = {"job_id": spec["job_id"], "tenant": spec["tenant"],
+           "command": spec["command"], "ok": bool(ok),
+           "seconds": None if seconds is None else round(seconds, 6),
+           "result": result or {}}
+    if error is not None:
+        doc["error"] = str(error)[:500]
+    if error_type is not None:
+        doc["error_type"] = error_type
+    dest = os.path.join(spool, DONE if ok else FAILED,
+                        f"{spec['job_id']}.json")
+    atomic_write(dest, json.dumps(doc, sort_keys=True))
+    if running_path:
+        try:
+            os.unlink(running_path)
+        except OSError:
+            pass
+    return dest
+
+
+def read_result(spool: str, job_id: str) -> Optional[dict]:
+    for d in (DONE, FAILED):
+        path = os.path.join(spool, d, f"{job_id}.json")
+        try:
+            with open(path) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            continue
+    return None
+
+
+def wait_result(spool: str, job_id: str, timeout_s: float = 60.0,
+                poll_s: float = 0.05) -> dict:
+    """Poll for a job's result document; raises ``TimeoutError`` when
+    the server never publishes one in time."""
+    import time
+
+    deadline = time.monotonic() + timeout_s
+    while True:
+        doc = read_result(spool, job_id)
+        if doc is not None:
+            return doc
+        if time.monotonic() >= deadline:
+            raise TimeoutError(
+                f"no result for job {job_id!r} within {timeout_s}s "
+                f"(is a server running on {spool!r}?)")
+        time.sleep(poll_s)
+
+
+def request_stop(spool: str) -> None:
+    """Drop the stop sentinel: a running server drains its current round
+    and exits cleanly."""
+    with open(os.path.join(spool, STOP_SENTINEL), "w") as f:
+        f.write("stop\n")
+
+
+def stop_requested(spool: str) -> bool:
+    return os.path.exists(os.path.join(spool, STOP_SENTINEL))

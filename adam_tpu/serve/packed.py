@@ -1,0 +1,187 @@
+"""Cross-tenant shared dispatches: many tenants, one wire buffer.
+
+The PR 8 ragged flagstat concat (docs/ARCHITECTURE.md §6g) packs one
+run's variable-length chunks into a fixed-capacity buffer with a
+positional row bound; this module is that buffer opened to the request
+stream: the capacity slack a lone job would waste is filled with the
+NEXT tenant's rows, and a segment prefix sum (the row-offset convention,
+one live range per tenant run) keeps the per-tenant counters separable —
+``ops/flagstat.flagstat_kernel_wire32_segmented`` folds every tenant's
+[18, 2] block from ONE dispatch, the way ragged paged attention packs
+variable-length requests into shared TPU dispatches (PAPERS.md,
+arXiv:2604.15464).
+
+Byte-identity is structural: the segmented kernel shares
+``indicator_masks`` with the solo kernels and sums exact int32
+contributions per segment, so a tenant's counters folded across shared
+buffers equal its solo run bit-for-bit regardless of how jobs interleave
+(tests/test_serve.py pins the matrix).
+
+Isolation: while a tenant's chunks are being decoded and packed, the
+fault plane is scoped to that tenant (``faults.set_tenant``); the shared
+dispatch itself runs unscoped — and if it fails past the retry ladder,
+:class:`SharedDispatchError` tells the server to degrade the group to
+solo runs (exact monoid: a re-stream cannot change bytes), so one bad
+shared dispatch never takes down the tenants riding in it.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from .. import obs
+from ..resilience import faults
+
+
+class SharedDispatchError(RuntimeError):
+    """A shared (multi-tenant) dispatch failed past the retry ladder;
+    carries the original error.  The server's response is degradation,
+    not failure: re-run each member solo."""
+
+    def __init__(self, cause: BaseException):
+        self.cause = cause
+        super().__init__(f"shared dispatch failed: "
+                         f"{type(cause).__name__}: {cause}")
+
+
+def packed_flagstat(specs: List[dict], *, chunk_rows: int = 1 << 22,
+                    pack_segments: int = 8,
+                    executor_opts: Optional[dict] = None
+                    ) -> Tuple[Dict[str, Tuple[object, object]],
+                               Dict[str, dict]]:
+    """Run N flagstat jobs through shared fixed-capacity dispatches.
+
+    ``specs``: canonical job specs (jobspec.canon_spec) in admission
+    order.  Returns ``(results, stats)``: ``results[job_id]`` is the
+    exact ``(failed, passed)`` pair ``streaming_flagstat`` returns per
+    job, ``stats[job_id]`` carries that job's ``rows`` and its OWN
+    ``dropped`` malformed-record count (ingest is sequential per job,
+    so the delta brackets attribute drops to the tenant that owns them
+    — the per-tenant accounting contract).  One buffer capacity (the
+    executor plan's chunk_rows) and one segment width = ONE compiled
+    shape for the whole serve lifetime.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from ..errors import malformed_count
+    from ..ops.flagstat import (FlagStatMetrics,
+                                flagstat_kernel_wire32_segmented)
+    from ..parallel.executor import StreamExecutor
+    from ..parallel.pipeline import flagstat_wire_chunks
+
+    ex = StreamExecutor(1, chunk_rows, **(executor_opts or {}))
+    # the shared buffer is its own pass: one frozen plan, one
+    # executor_bucket_selected event, one compiled (capacity, S) shape
+    pex = ex.begin_pass("serve_pack", bytes_per_row=4.0)
+    cap = pex.chunk_rows
+    n_seg = max(int(pack_segments), 2)
+
+    totals = {s["job_id"]: np.zeros((18, 2), np.int64) for s in specs}
+    stats = {s["job_id"]: {"rows": 0, "dropped": 0} for s in specs}
+
+    def _host_counts(buf, bounds):
+        # degraded CPU fallback for ONE buffer: same exact integer
+        # kernel on the CPU backend (the solo path's discipline)
+        with jax.default_device(jax.devices("cpu")[0]):
+            return np.asarray(flagstat_kernel_wire32_segmented(
+                jnp.asarray(buf), jnp.asarray(bounds)))
+
+    def _flush(buf, segments):
+        """Dispatch one filled buffer; fold each segment's [18, 2] block
+        into its job's totals.  ``segments``: [(job_id, rows), ...] in
+        fill order."""
+        if not segments:
+            return
+        counts = np.cumsum([0] + [r for _, r in segments])
+        live = int(counts[-1])
+        bounds = np.full(n_seg + 1, live, np.int32)
+        bounds[:len(counts)] = counts.astype(np.int32)
+        # tenants share the dispatch; a tenant-scoped fault must not
+        # fire here (it would hit its neighbors) — the server scopes
+        # ingest, the dispatch runs unscoped
+        prev = faults.current_tenant()
+        faults.set_tenant(None)
+        try:
+            pex.note_ragged(live, cap)
+            bounds_dev = jnp.asarray(bounds)
+            dev = pex.dispatch_put(
+                "pack-wire", lambda attempt: jax.device_put(buf))
+            counts_dev = pex.dispatch(
+                "pack-count",
+                lambda attempt, dev=dev, host=buf, b=bounds_dev:
+                    flagstat_kernel_wire32_segmented(
+                        dev if attempt == 1 else jnp.asarray(host), b),
+                fallback=lambda e, host=buf, b=bounds:
+                    _host_counts(host, b))
+            out = np.asarray(counts_dev).astype(np.int64)
+        except Exception as e:  # noqa: BLE001 — the server degrades
+            raise SharedDispatchError(e) from e
+        finally:
+            faults.set_tenant(prev)
+        for s, (job_id, rows) in enumerate(segments):
+            totals[job_id] += out[s]
+        obs.chunk_processed("serve_pack", live, bytes_in=4 * live)
+        obs.emit("serve_pack_dispatch", capacity=int(cap),
+                 live_rows=live, segments=len(segments),
+                 jobs=sorted({j for j, _ in segments}))
+
+    # sequential fill in admission order: job j's tail shares its last
+    # buffer with job j+1's head — the capacity slack IS the next
+    # tenant's admission ticket
+    buf = np.empty(cap, np.uint32)      # slack past the bound is
+    #                                     positionally dead (never read)
+    have = 0
+    segments: List[Tuple[str, int]] = []
+
+    def _seg_add(job_id: str, rows: int) -> None:
+        if segments and segments[-1][0] == job_id:
+            segments[-1] = (job_id, segments[-1][1] + rows)
+        else:
+            segments.append((job_id, rows))
+
+    for spec in specs:
+        job_id = spec["job_id"]
+        with obs.trace.span(f"tenant:{spec['tenant']}:{job_id}",
+                            cat="serve"):
+            faults.set_tenant(spec["tenant"])
+            dropped0 = malformed_count()
+            try:
+                chunks = flagstat_wire_chunks(
+                    spec["input"], chunk_rows=cap,
+                    io_procs=int(spec["args"].get("io_procs", 1)))
+                for w in chunks:
+                    w = np.asarray(w, np.uint32)
+                    stats[job_id]["rows"] += int(w.size)
+                    while w.size:
+                        # a full segment table flushes early even with
+                        # row capacity left: S is a compiled constant
+                        if have == cap or (len(segments) == n_seg and
+                                           segments[-1][0] != job_id):
+                            _flush(buf, segments)
+                            buf = np.empty(cap, np.uint32)
+                            have, segments = 0, []
+                        take = min(cap - have, int(w.size))
+                        buf[have:have + take] = w[:take]
+                        _seg_add(job_id, take)
+                        have += take
+                        w = w[take:]
+                        if have == cap:
+                            _flush(buf, segments)
+                            buf = np.empty(cap, np.uint32)
+                            have, segments = 0, []
+            finally:
+                faults.set_tenant(None)
+                stats[job_id]["dropped"] = malformed_count() - dropped0
+    if segments:
+        _flush(buf, segments)
+    ex.finish()
+
+    out: Dict[str, Tuple[object, object]] = {}
+    for spec in specs:
+        t = totals[spec["job_id"]]
+        out[spec["job_id"]] = (FlagStatMetrics.from_counters(t[:, 1]),
+                               FlagStatMetrics.from_counters(t[:, 0]))
+    return out, stats

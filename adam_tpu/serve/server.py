@@ -1,0 +1,326 @@
+"""The long-lived serve loop: warm once, serve many.
+
+One :class:`ServeServer` owns one device-warm process.  Boot pays the
+cold-start tolls exactly once (platform.warm — backend init, the
+deferred compile-cache decision, a priming dispatch) and every job after
+that rides the warm jit caches; because the server, not the client,
+owns the chunk-size/ladder knobs, every tenant's jobs land on the one
+canonical shape ladder and job 2+ of a command shape recompiles nothing
+(the zero-recompile pin, tests/test_serve.py).
+
+Per-tenant isolation, all riding existing machinery:
+
+* the fault plane scopes to the running job's tenant
+  (``faults.set_tenant``) — a plan rule carrying ``tenant`` fires only
+  inside that tenant's execution;
+* the malformed-record budget resets per job and the job's drop count
+  lands in its result document, not on a neighbor;
+* a job's typed failure (bad input, injected fault past the recovery
+  ladder, anything else) writes ``failed/<job>.json`` and the loop
+  serves on — one tenant's failure never touches another's bytes;
+* obs: every job completion emits a ``tenant_job`` event and runs under
+  a ``tenant:<tenant>:<job>`` trace span, so one sidecar/timeline
+  splits cleanly by tenant.
+
+Shared dispatches (serve/packed.py) degrade, never fail collectively: a
+shared dispatch error re-runs each member solo (exact monoid — bytes
+cannot change), recorded as ``serve_pack_degraded``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Dict, List, Optional
+
+from .. import obs
+from ..checkpoint import atomic_write
+from ..errors import FormatError, malformed_count, reset_malformed
+from ..resilience import faults
+from ..resilience.faults import InjectedFault
+from . import jobspec
+from .admission import DEFAULT_PACK_SEGMENTS, decide_admission
+from .packed import SharedDispatchError, packed_flagstat
+
+
+class ServeServer:
+    """One warm device, many tenants (docs/ARCHITECTURE.md §6i)."""
+
+    def __init__(self, spool: str, *, chunk_rows: int = 1 << 22,
+                 max_concurrent: int = 4, pack: bool = True,
+                 pack_segments: int = DEFAULT_PACK_SEGMENTS,
+                 poll_s: float = 0.05, io_procs: int = 1,
+                 executor_opts: Optional[dict] = None):
+        self.spool = jobspec.ensure_spool(spool)
+        self.chunk_rows = int(chunk_rows)
+        self.max_concurrent = max(int(max_concurrent), 1)
+        self.pack = bool(pack)
+        self.pack_segments = max(int(pack_segments), 2)
+        self.poll_s = float(poll_s)
+        self.io_procs = int(io_procs)
+        self.executor_opts = dict(executor_opts or {})
+        self.jobs_served = 0
+        self._booted = False
+
+    # -- boot ---------------------------------------------------------------
+
+    def boot(self) -> dict:
+        """Warm the backend + compile cache once, re-queue any jobs a
+        crashed predecessor left under ``running/``, and publish the
+        ``serving.json`` receipt (pid + warmup breakdown) clients can
+        wait on."""
+        from ..platform import warm
+
+        if self._booted:
+            return {}
+        requeued = jobspec.requeue_running(self.spool)
+        t0 = time.perf_counter()
+        info = warm()
+        info["warm_total_s"] = round(time.perf_counter() - t0, 6)
+        info["requeued"] = requeued
+        info["startup"] = obs.startup.snapshot()
+        obs.emit("serve_boot", **{k: v for k, v in info.items()})
+        atomic_write(os.path.join(self.spool, jobspec.SERVING_MARKER),
+                     json.dumps({"pid": os.getpid(), **info},
+                                sort_keys=True, default=str))
+        self._booted = True
+        return info
+
+    # -- the loop -----------------------------------------------------------
+
+    def run(self, *, max_jobs: Optional[int] = None,
+            idle_timeout_s: Optional[float] = None) -> int:
+        """Serve until ``max_jobs`` jobs completed, the stop sentinel
+        appears, or the queue stays empty for ``idle_timeout_s``.
+        Returns the number of jobs served this call."""
+        self.boot()
+        served_at_entry = self.jobs_served
+        idle_since = time.monotonic()
+        while True:
+            if jobspec.stop_requested(self.spool):
+                break
+            n = self._round(
+                None if max_jobs is None
+                else max(max_jobs - (self.jobs_served - served_at_entry),
+                         0))
+            if n:
+                idle_since = time.monotonic()
+            if max_jobs is not None and \
+                    self.jobs_served - served_at_entry >= max_jobs:
+                break
+            if n == 0:
+                if idle_timeout_s is not None and \
+                        time.monotonic() - idle_since >= idle_timeout_s:
+                    break
+                time.sleep(self.poll_s)
+        return self.jobs_served - served_at_entry
+
+    def _round(self, budget: Optional[int] = None) -> int:
+        """One admission round: snapshot the queue, take the pure
+        decision, claim and execute.  Returns jobs completed."""
+        queued = []
+        by_id: Dict[str, tuple] = {}
+        for seq, path, spec in jobspec.iter_queue(self.spool):
+            try:
+                canon = jobspec.canon_spec(spec)
+            except ValueError as e:
+                # a hand-written bad spec fails ITSELF, not the loop.
+                # The result doc keys by the FILENAME-derived id, never
+                # the file's own job_id field: a filename cannot carry
+                # a path separator, but a hand-written job_id like
+                # "../../x" could walk the result write out of the
+                # spool (and leave the failure doc unreadable besides)
+                canon = {"job_id": os.path.basename(path)[9:-5],
+                         "tenant": "default",
+                         "command": str(spec.get("command")),
+                         "input": "", "output": None, "args": {}}
+                claimed = jobspec.claim_job(self.spool, path)
+                jobspec.write_result(
+                    self.spool, canon, ok=False, error=str(e),
+                    error_type="ValueError", running_path=claimed)
+                continue
+            canon["seq"] = seq
+            queued.append({"job_id": canon["job_id"],
+                           "tenant": canon["tenant"],
+                           "command": canon["command"], "seq": seq})
+            by_id[canon["job_id"]] = (path, canon)
+        if not queued:
+            return 0
+        max_c = self.max_concurrent if budget is None \
+            else min(self.max_concurrent, max(budget, 0))
+        plan = decide_admission(
+            queued=queued, running=0, max_concurrent=max_c,
+            pack=self.pack, pack_segments=self.pack_segments)
+        if not plan["admit"]:
+            return 0
+        obs.registry().counter("serve_rounds").inc()
+        obs.emit("admission_selected", admit=plan["admit"],
+                 pack_groups=plan["pack_groups"], reason=plan["reason"],
+                 inputs=plan["inputs"],
+                 input_digest=plan["input_digest"])
+        # claim everything admitted up front (a submitter watching the
+        # queue sees admission as one atomic batch)
+        claimed: Dict[str, tuple] = {}
+        for job_id in plan["admit"]:
+            path, canon = by_id[job_id]
+            running = jobspec.claim_job(self.spool, path)
+            if running is not None:
+                claimed[job_id] = (running, canon)
+        done = 0
+        packed_ids = {j for g in plan["pack_groups"] for j in g}
+        for group in plan["pack_groups"]:
+            members = [(claimed[j][0], claimed[j][1])
+                       for j in group if j in claimed]
+            done += self._run_packed(members)
+        for job_id in plan["admit"]:
+            if job_id in packed_ids or job_id not in claimed:
+                continue
+            running, canon = claimed[job_id]
+            self._run_solo(running, canon)
+            done += 1
+        return done
+
+    # -- execution ----------------------------------------------------------
+
+    def _execute(self, spec: dict):
+        """Run one job's command body; returns its result payload."""
+        if spec["command"] == "flagstat":
+            from ..ops.flagstat import format_report
+            from ..parallel.pipeline import streaming_flagstat
+
+            failed, passed = streaming_flagstat(
+                spec["input"], chunk_rows=self.chunk_rows,
+                io_procs=int(spec["args"].get("io_procs",
+                                              self.io_procs)),
+                executor_opts=self.executor_opts)
+            return {"report": format_report(failed, passed)}
+        return {"rows": self._execute_transform(spec)}
+
+    def _execute_transform(self, spec: dict) -> int:
+        from ..models.snptable import SnpTable
+        from ..parallel.pipeline import streaming_transform
+
+        args = spec["args"]
+        snp_path = args.get("dbsnp_sites")
+        snp = SnpTable.from_vcf(snp_path) if snp_path else None
+        return streaming_transform(
+            spec["input"], spec["output"],
+            markdup=bool(args.get("markdup")),
+            bqsr=bool(args.get("bqsr")), snp_table=snp,
+            realign=bool(args.get("realign")),
+            sort=bool(args.get("sort")),
+            chunk_rows=self.chunk_rows,
+            io_threads=int(args.get("io_threads", 1)),
+            io_procs=int(args.get("io_procs", self.io_procs)),
+            executor_opts=self.executor_opts)
+
+    def _finish(self, running: str, spec: dict, *, ok: bool,
+                result=None, error: Optional[BaseException] = None,
+                seconds: float = 0.0, compiles: float = 0.0,
+                rows=None, dropped: int = 0) -> None:
+        """Publish one job's outcome: durable result doc + the
+        ``tenant_job`` event (the per-tenant obs label every sidecar
+        consumer splits on)."""
+        fields = dict(job_id=spec["job_id"], tenant=spec["tenant"],
+                      command=spec["command"],
+                      status="ok" if ok else "failed",
+                      seconds=round(seconds, 6), compiles=int(compiles))
+        if rows is not None:
+            fields["rows"] = int(rows)
+        if dropped:
+            fields["malformed_dropped"] = int(dropped)
+        if error is not None:
+            fields["error_type"] = type(error).__name__
+        obs.emit("tenant_job", **fields)
+        obs.registry().counter(
+            "serve_jobs", tenant=spec["tenant"],
+            status=fields["status"]).inc()
+        res = dict(result or {})
+        if dropped:
+            res["malformed_dropped"] = int(dropped)
+        jobspec.write_result(
+            self.spool, spec, ok=ok, result=res,
+            error=None if error is None else str(error),
+            error_type=None if error is None else type(error).__name__,
+            seconds=seconds, running_path=running)
+        self.jobs_served += 1
+
+    def _run_solo(self, running: str, spec: dict) -> None:
+        t0 = time.perf_counter()
+        compiles0 = obs.registry().counter("compile_count").value
+        reset_malformed()
+        faults.set_tenant(spec["tenant"])
+        try:
+            with obs.trace.span(
+                    f"tenant:{spec['tenant']}:{spec['job_id']}",
+                    cat="serve"):
+                result = self._execute(spec)
+            dropped = malformed_count()   # before the finally resets it
+        except (FileNotFoundError, IsADirectoryError, FormatError,
+                InjectedFault, ValueError, RuntimeError, OSError) as e:
+            # typed, isolated failure: THIS job fails, the loop lives
+            self._finish(running, spec, ok=False, error=e,
+                         seconds=time.perf_counter() - t0,
+                         compiles=obs.registry().counter(
+                             "compile_count").value - compiles0,
+                         dropped=malformed_count())
+            return
+        finally:
+            faults.set_tenant(None)
+            reset_malformed()
+        self._finish(
+            running, spec, ok=True, result=result,
+            seconds=time.perf_counter() - t0,
+            compiles=obs.registry().counter(
+                "compile_count").value - compiles0,
+            rows=result.get("rows"), dropped=dropped)
+
+    def _run_packed(self, members: List[tuple]) -> int:
+        """One shared-dispatch group.  On a shared failure, degrade to
+        solo re-runs (exact monoid: identical bytes) instead of failing
+        every rider."""
+        if not members:
+            return 0
+        specs = [spec for _, spec in members]
+        t0 = time.perf_counter()
+        compiles0 = obs.registry().counter("compile_count").value
+        reset_malformed()
+        try:
+            results, stats = packed_flagstat(
+                specs, chunk_rows=self.chunk_rows,
+                pack_segments=self.pack_segments,
+                executor_opts=self.executor_opts)
+        except (SharedDispatchError, FileNotFoundError,
+                IsADirectoryError, FormatError, InjectedFault,
+                ValueError, RuntimeError, OSError) as e:
+            obs.emit("serve_pack_degraded",
+                     jobs=[s["job_id"] for s in specs],
+                     error=f"{type(e).__name__}: {e}"[:200])
+            obs.registry().counter("serve_pack_degraded").inc()
+            for running, spec in members:
+                self._run_solo(running, spec)
+            return len(members)
+        finally:
+            reset_malformed()
+        seconds = time.perf_counter() - t0
+        compiles = obs.registry().counter(
+            "compile_count").value - compiles0
+        from ..ops.flagstat import format_report
+
+        for i, (running, spec) in enumerate(members):
+            failed, passed = results[spec["job_id"]]
+            st = stats.get(spec["job_id"], {})
+            # the dispatches were genuinely shared, so per-job wall is
+            # the group wall and the compile count lands once (the
+            # group head); rows and malformed drops are each tenant's
+            # OWN (ingest is sequential per job inside the packer)
+            self._finish(running, spec, ok=True,
+                         result={"report": format_report(failed,
+                                                         passed),
+                                 "packed": len(members)},
+                         seconds=seconds,
+                         compiles=compiles if i == 0 else 0,
+                         rows=st.get("rows"),
+                         dropped=int(st.get("dropped", 0)))
+        return len(members)

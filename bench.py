@@ -1379,6 +1379,152 @@ def _stage_shard_scale(kind: str, is_tpu: bool):
     _emit("shard_scale", out)
 
 
+def _stage_serve_warm(kind: str, is_tpu: bool):
+    """Warm-serve vs cold-CLI amortization (ISSUE 10): K sequential
+    flagstat jobs paid as K cold ``adam-tpu flagstat`` subprocesses
+    (jax import + backend init + compile per job) vs K jobs submitted to
+    ONE warm ``adam-tpu serve`` process, plus a mixed-tenant
+    packed-dispatch leg (two tenants co-submitted, shared fixed-capacity
+    dispatches).  The gated numbers: ``serve_warm_speedup`` (median cold
+    job wall over median warm job wall, jobs 2+ on both sides — job 1
+    pays first-compile on both and is reported separately) with
+    byte-identity of every warm/packed report against the cold CLI
+    output, and ``serve_warm_recompiles`` == 0 (jobs 2+ reuse the warm
+    jit caches; the serve sidecar's tenant_job events are the proof).
+    Process-level by design — ``is_tpu`` only stamps the platform."""
+    import shutil
+    import statistics
+    import tempfile
+
+    import numpy as np
+    import pyarrow as pa
+
+    from adam_tpu.io.parquet import DatasetWriter
+    from adam_tpu.serve import jobspec
+
+    root = os.path.dirname(os.path.abspath(__file__))
+    n = int(os.environ.get("ADAM_TPU_BENCH_SERVE_READS", 2_000_000))
+    k = max(int(os.environ.get("ADAM_TPU_BENCH_SERVE_JOBS", 3)), 2)
+    rng = np.random.RandomState(17)
+    tmp = tempfile.mkdtemp(prefix="bench_serve_")
+    out: dict = {"platform": kind, "serve_n_reads": n,
+                 "serve_n_jobs": k, "cpu_count": os.cpu_count()}
+    env = dict(os.environ)
+    env["PYTHONPATH"] = root + os.pathsep + env.get("PYTHONPATH", "")
+    try:
+        pq_dir = os.path.join(tmp, "reads")
+        part = 1 << 18
+        with DatasetWriter(pq_dir, part_rows=part) as w:
+            for lo in range(0, n, part):
+                m = min(part, n - lo)
+                w.write(pa.table({
+                    "flags": pa.array(rng.randint(
+                        0, 1 << 11, size=m).astype(np.uint32),
+                        pa.uint32()),
+                    "mapq": pa.array(rng.randint(0, 61, size=m),
+                                     pa.int32()),
+                    "referenceId": pa.array(rng.randint(0, 24, size=m),
+                                            pa.int32()),
+                    "mateReferenceId": pa.array(
+                        rng.randint(0, 24, size=m), pa.int32()),
+                }))
+
+        # -- cold leg: K full CLI invocations, each paying init+compile
+        cold_walls, cold_reports = [], []
+        for _ in range(k):
+            t0 = time.perf_counter()
+            proc = subprocess.run(
+                [sys.executable, "-m", "adam_tpu", "flagstat", pq_dir],
+                cwd=root, env=env, capture_output=True, text=True,
+                timeout=300)
+            cold_walls.append(round(time.perf_counter() - t0, 3))
+            cold_reports.append(proc.stdout)
+        out["serve_cold_job_walls"] = cold_walls
+        out["serve_cold_job1_wall_s"] = cold_walls[0]
+        out["serve_cold_job_wall_s"] = round(
+            statistics.median(cold_walls[1:]), 3)
+
+        # -- warm leg: one serve process, K sequential submissions
+        spool = os.path.join(tmp, "spool")
+        sidecar = os.path.join(tmp, "serve.metrics.jsonl")
+        server = subprocess.Popen(
+            [sys.executable, "-m", "adam_tpu", "serve", spool,
+             "-max_jobs", str(k), "-idle_timeout", "240",
+             "-poll_s", "0.01", "-metrics", sidecar],
+            cwd=root, env=env, stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL)
+        marker = os.path.join(spool, jobspec.SERVING_MARKER)
+        deadline = time.monotonic() + 120
+        while not os.path.exists(marker):
+            if time.monotonic() > deadline or server.poll() is not None:
+                raise RuntimeError("serve process never became ready")
+            time.sleep(0.05)
+        warm_walls, warm_reports = [], []
+        for i in range(k):
+            t0 = time.perf_counter()
+            job = jobspec.submit_job(spool, {
+                "tenant": f"t{i}", "command": "flagstat",
+                "input": pq_dir, "args": {}})
+            doc = jobspec.wait_result(spool, job, timeout_s=240.0,
+                                      poll_s=0.005)
+            warm_walls.append(round(time.perf_counter() - t0, 3))
+            warm_reports.append((doc.get("result") or {}).get("report"))
+        server.wait(timeout=60)
+        out["serve_warm_job_walls"] = warm_walls
+        out["serve_warm_job1_wall_s"] = warm_walls[0]
+        out["serve_warm_job_wall_s"] = round(
+            statistics.median(warm_walls[1:]), 3)
+        out["serve_warm_speedup"] = round(
+            out["serve_cold_job_wall_s"] /
+            max(out["serve_warm_job_wall_s"], 1e-9), 3)
+        # the CLI prints the report + newline; results carry the report
+        solo = cold_reports[0]
+        out["serve_identical"] = all(
+            r == solo for r in cold_reports) and all(
+            (r or "") + "\n" == solo for r in warm_reports)
+        # jobs 2+ must recompile nothing (the compile-count delta the
+        # serve sidecar's tenant_job events record per job)
+        compiles = []
+        with open(sidecar) as f:
+            for ln in f:
+                try:
+                    d = json.loads(ln)
+                except ValueError:
+                    continue
+                if d.get("event") == "tenant_job":
+                    compiles.append(int(d.get("compiles", 0)))
+        out["serve_warm_recompiles"] = sum(compiles[1:]) \
+            if len(compiles) == k else None
+
+        # -- packed leg: two tenants co-submitted, admitted in one
+        # round, counters folded from shared dispatches
+        spool2 = os.path.join(tmp, "spool2")
+        for t in ("alice", "bob"):
+            jobspec.submit_job(spool2, {
+                "job_id": f"packed-{t}", "tenant": t,
+                "command": "flagstat", "input": pq_dir, "args": {}})
+        t0 = time.perf_counter()
+        proc = subprocess.run(
+            [sys.executable, "-m", "adam_tpu", "serve", spool2,
+             "-max_jobs", "2", "-idle_timeout", "240",
+             "-poll_s", "0.01"],
+            cwd=root, env=env, capture_output=True, text=True,
+            timeout=300)
+        out["serve_packed_pair_wall_s"] = round(
+            time.perf_counter() - t0, 3)
+        packed_ok = []
+        for t in ("alice", "bob"):
+            doc = jobspec.read_result(spool2, f"packed-{t}") or {}
+            res = doc.get("result") or {}
+            packed_ok.append(doc.get("ok") is True and
+                             res.get("packed") == 2 and
+                             (res.get("report") or "") + "\n" == solo)
+        out["serve_packed_identical"] = all(packed_ok)
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+    _emit("serve_warm", out)
+
+
 def _worker(stages: list[str]) -> None:
     if os.environ.get("JAX_PLATFORMS") == "cpu":
         from adam_tpu.platform import force_cpu
@@ -1398,7 +1544,11 @@ _STAGE_BODIES = {"flagstat": _stage_flagstat, "transform": _stage_transform,
                  "ragged_race": _stage_ragged_race,
                  # CPU-mesh fleet scaling (ISSUE 9): not in the TPU
                  # capture order — run via --worker/--only shard_scale
-                 "shard_scale": _stage_shard_scale}
+                 "shard_scale": _stage_shard_scale,
+                 # warm-serve amortization (ISSUE 10): process-level,
+                 # not in the TPU capture order — run via --worker/
+                 # --only serve_warm
+                 "serve_warm": _stage_serve_warm}
 
 
 def _worker_stages(stages: list[str]) -> None:

@@ -39,12 +39,22 @@ differ) with ``--threshold`` where applicable:
    hosts beyond the box's cores are reported (oversubscription data),
    never gated.
 
+5. **The warm-serve win is pinned.**  ``BENCH_SERVE.json`` (the
+   committed ``serve_warm`` artifact, ISSUE 10) must show a warm-serve
+   job (job 2+, median) at least 2x faster than the same job as a cold
+   CLI invocation, every warm AND packed-dispatch report byte-identical
+   to the cold CLI output, and zero recompiles on warm jobs 2+.  A
+   fresh artifact (``--serve NEW_SV.json``, from ``python bench.py
+   --worker serve_warm``) additionally diffs the cold/warm job walls at
+   the standard 10% threshold.
+
 Usage::
 
     python tools/bench_gate.py                       # committed gates
     python tools/bench_gate.py NEW.json              # + transform diff
     python tools/bench_gate.py --ragged NEW_R.json   # + ragged diff
     python tools/bench_gate.py --shard NEW_S.json    # + fleet diff
+    python tools/bench_gate.py --serve NEW_SV.json   # + serve diff
 
 Exit 0 when every gate holds; the first failing check's exit code
 otherwise.
@@ -103,6 +113,60 @@ SHARD_MIN_SPEEDUP_ANY = 0.5
 
 #: the fleet walls a fresh artifact is regression-diffed on
 SHARD_WALL_KEYS = ("shard_hosts1_wall_s", "shard_hosts2_wall_s")
+
+SERVE = os.path.join(ROOT, "BENCH_SERVE.json")
+
+#: the ISSUE 10 acceptance number: a warm-serve job (job 2+, median)
+#: must run >= 2x faster than the same job as a cold CLI invocation
+#: (job 2+, median — job 1 pays first-compile on both sides and is
+#: reported, not gated).  Identity and the zero-recompile pin are
+#: enforced unconditionally: amortization may vary with box load, but
+#: wrong bytes or a warm-path recompile is a machinery regression.
+SERVE_REQUIRED_SPEEDUP = 2.0
+
+#: the serve walls a fresh artifact is regression-diffed on
+SERVE_WALL_KEYS = ("serve_cold_job_wall_s", "serve_warm_job_wall_s")
+
+
+def _check_serve_artifact(path: str) -> int:
+    """Gate 5's committed-artifact half: the >= 2x warm-vs-cold win on
+    job 2+, byte-identity of every warm/packed report against the cold
+    CLI, and zero recompiles on warm jobs 2+."""
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, ValueError) as e:
+        print(f"bench_gate: unreadable serve artifact {path}: {e}",
+              file=sys.stderr)
+        return 2
+    rc = 0
+    speedup = doc.get("serve_warm_speedup")
+    if not isinstance(speedup, (int, float)) or \
+            speedup < SERVE_REQUIRED_SPEEDUP:
+        print(f"bench_gate: warm-serve speedup {speedup!r} in {path} "
+              f"is below the required {SERVE_REQUIRED_SPEEDUP}x on "
+              "job 2+ — the always-warm amortization regressed",
+              file=sys.stderr)
+        rc = 1
+    for key in ("serve_identical", "serve_packed_identical"):
+        if doc.get(key) is not True:
+            print(f"bench_gate: {key} is not true in {path} — serve "
+                  "output no longer byte-identical to the solo CLI",
+                  file=sys.stderr)
+            rc = 1
+    if doc.get("serve_warm_recompiles") != 0:
+        print(f"bench_gate: serve_warm_recompiles "
+              f"{doc.get('serve_warm_recompiles')!r} in {path} — warm "
+              "jobs 2+ must reuse the compiled shapes (compile-count "
+              "delta 0)", file=sys.stderr)
+        rc = 1
+    if rc == 0:
+        print(f"serve gate: warm job {speedup}x >= "
+              f"{SERVE_REQUIRED_SPEEDUP}x cold (job 2+ medians, "
+              f"{doc.get('serve_n_jobs')} jobs x "
+              f"{doc.get('serve_n_reads')} reads), all reports "
+              "byte-identical, 0 warm recompiles")
+    return rc
 
 
 def _check_shard_artifact(path: str) -> int:
@@ -205,6 +269,15 @@ def main(argv=None) -> int:
             print("bench_gate: --shard needs a path", file=sys.stderr)
             return 2
         del argv[i:i + 2]
+    fresh_serve = None
+    if "--serve" in argv:
+        i = argv.index("--serve")
+        try:
+            fresh_serve = argv[i + 1]
+        except IndexError:
+            print("bench_gate: --serve needs a path", file=sys.stderr)
+            return 2
+        del argv[i:i + 2]
     for path in (BASELINE, CURRENT):
         if not os.path.exists(path):
             print(f"bench_gate: missing committed artifact {path} "
@@ -219,6 +292,11 @@ def main(argv=None) -> int:
     if not os.path.exists(SHARD):
         print(f"bench_gate: missing committed artifact {SHARD} "
               "(regenerate with: python bench.py --worker shard_scale "
+              "> out.jsonl on the CPU backend)", file=sys.stderr)
+        return 2
+    if not os.path.exists(SERVE):
+        print(f"bench_gate: missing committed artifact {SERVE} "
+              "(regenerate with: python bench.py --worker serve_warm "
               "> out.jsonl on the CPU backend)", file=sys.stderr)
         return 2
 
@@ -285,6 +363,27 @@ def main(argv=None) -> int:
                                  "--threshold", "10"])
         if rc != 0:
             print("bench_gate: a fleet wall regressed past 10% vs the "
+                  "committed artifact", file=sys.stderr)
+            return rc
+
+    print(f"\n== gate 5: warm-serve job 2+ >= "
+          f"{SERVE_REQUIRED_SPEEDUP}x the cold CLI on the committed "
+          "serve_warm artifact ==")
+    rc = _check_serve_artifact(SERVE)
+    if rc != 0:
+        return rc
+
+    if fresh_serve:
+        print(f"\n== gate 5b: {fresh_serve} vs committed {SERVE} "
+              "(10% regression threshold on the serve walls) ==")
+        rc = _check_serve_artifact(fresh_serve)
+        if rc != 0:
+            return rc
+        rc = compare_bench.main([SERVE, fresh_serve,
+                                 "--keys", ",".join(SERVE_WALL_KEYS),
+                                 "--threshold", "10"])
+        if rc != 0:
+            print("bench_gate: a serve wall regressed past 10% vs the "
                   "committed artifact", file=sys.stderr)
             return rc
 

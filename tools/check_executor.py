@@ -67,6 +67,11 @@ SHARD_DEATH_FIELDS = ("action", "new_incarnation", "splits", "reason")
 SHARD_SPEC_FIELDS = ("action", "victim", "target", "tail_runs",
                      "reason")
 
+#: the serve admission fields a replay must reproduce exactly
+#: (serve/admission.decide_admission — which jobs run and which share
+#: dispatches; same purity contract)
+ADMISSION_FIELDS = ("admit", "pack_groups", "reason")
+
 #: fields absent from older sidecars: compared only when recorded
 _OPTIONAL_FIELDS = ("layout",)
 
@@ -78,7 +83,7 @@ _LAYOUT_KINDS = ("executor_bucket_selected", "realign_plan_selected")
 
 _REPLAYED = ("executor_bucket_selected", "fusion_plan_selected",
              "realign_plan_selected", "shard_plan_selected",
-             "shard_reassigned")
+             "shard_reassigned", "admission_selected")
 
 
 def _events(path: str, kinds=_REPLAYED) -> List[Tuple[int, dict]]:
@@ -105,6 +110,7 @@ def check(paths: List[str]) -> List[str]:
     from adam_tpu.parallel.shardstream import (decide_shard_plan,
                                                decide_shard_reassignment,
                                                decide_shard_speculation)
+    from adam_tpu.serve.admission import decide_admission
 
     deciders = {"executor_bucket_selected": (decide_plan, PLAN_FIELDS),
                 "fusion_plan_selected": (decide_fusion_plan,
@@ -112,7 +118,9 @@ def check(paths: List[str]) -> List[str]:
                 "realign_plan_selected": (decide_realign_plan,
                                           REALIGN_FIELDS),
                 "shard_plan_selected": (decide_shard_plan,
-                                        SHARD_PLAN_FIELDS)}
+                                        SHARD_PLAN_FIELDS),
+                "admission_selected": (decide_admission,
+                                       ADMISSION_FIELDS)}
     errs: List[str] = []
     # digests are namespaced per event kind: the two deciders hash
     # different input tuples and must never cross-validate

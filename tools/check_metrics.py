@@ -74,6 +74,19 @@ elastic worker sidecars).  Contract checked here:
 * ``shard_merge`` events carry ``units``/``duplicates`` (ints >= 0)
   and ``shards`` (int >= 1) — the fleet reduce receipt (duplicates are
   speculation/recovery overlap the per-unit merge deduplicated);
+* ``admission_selected`` events (the serve front-end's scheduler,
+  adam_tpu/serve/admission.py) carry ``admit`` (a list of job-id
+  strings), ``pack_groups`` (a list of >= 2-element job-id lists, each
+  member also admitted), ``reason`` (str), ``inputs`` (object) and a
+  hex ``input_digest`` (tools/check_executor.py replays the decision);
+* ``tenant_job`` events carry ``job_id``/``tenant``/``command``
+  (strings), ``status`` (ok/failed), ``seconds`` (number >= 0) and
+  ``compiles`` (int >= 0) — one per served job, the per-tenant label
+  sidecar consumers split on;
+* ``startup_seconds`` events carry only non-negative numeric fields —
+  the cold-start breakdown (backend init / first compile / first
+  dispatch) every command stamps so the serve warmup win is measured
+  against a recorded baseline;
 * the last line is the ``summary``: ``wall_seconds``, ``ok``, and a
   ``metrics`` snapshot whose counters/gauges are numeric and whose
   histograms are internally consistent (count == sum of bucket counts);
@@ -506,6 +519,52 @@ def validate(path: str) -> List[str]:
             if not (isinstance(sh, int) and not isinstance(sh, bool)
                     and sh >= 1):
                 err(i, "shard_merge missing int 'shards' >= 1")
+        elif ev == "admission_selected":
+            admit = d.get("admit")
+            if not (isinstance(admit, list) and
+                    all(isinstance(j, str) and j for j in admit)):
+                err(i, "admission_selected 'admit' is not a list of "
+                       "job-id strings")
+            groups = d.get("pack_groups")
+            if not (isinstance(groups, list) and all(
+                    isinstance(g, list) and len(g) >= 2 and
+                    all(isinstance(j, str) and j for j in g)
+                    for g in groups)):
+                err(i, "admission_selected 'pack_groups' is not a list "
+                       "of >= 2-element job-id lists")
+            elif isinstance(admit, list):
+                stray = [j for g in groups for j in g if j not in admit]
+                if stray:
+                    err(i, f"admission_selected pack_groups members "
+                           f"{stray} are not in 'admit' — a job cannot "
+                           "co-dispatch without being admitted")
+            if not isinstance(d.get("reason"), str):
+                err(i, "admission_selected missing string 'reason'")
+            if not isinstance(d.get("inputs"), dict):
+                err(i, "admission_selected missing 'inputs' object "
+                       "(decision must be replayable)")
+            if not _is_hex(d.get("input_digest")):
+                err(i, "admission_selected missing hex 'input_digest'")
+        elif ev == "tenant_job":
+            for field in ("job_id", "tenant", "command"):
+                if not isinstance(d.get(field), str):
+                    err(i, f"tenant_job missing string {field!r}")
+            if d.get("status") not in ("ok", "failed"):
+                err(i, f"tenant_job unknown status {d.get('status')!r}")
+            if not (_is_num(d.get("seconds")) and d["seconds"] >= 0):
+                err(i, "tenant_job missing non-negative 'seconds'")
+            c = d.get("compiles")
+            if not (isinstance(c, int) and not isinstance(c, bool)
+                    and c >= 0):
+                err(i, "tenant_job missing non-negative int 'compiles'")
+        elif ev == "startup_seconds":
+            for k, v in d.items():
+                if k in ("event", "t"):
+                    continue
+                if not (_is_num(v) and v >= 0):
+                    err(i, f"startup_seconds field {k!r} must be a "
+                           "non-negative number (a cold-start phase "
+                           "mark)")
 
     if summaries:
         i, s = summaries[0]
