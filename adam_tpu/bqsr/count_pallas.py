@@ -518,6 +518,7 @@ def flatten_state(state, read_len, t_pad: int):
     return out
 
 
+@functools.lru_cache(maxsize=16)
 def sharded_count_pallas(mesh, n_qual_rg: int, n_cycle: int,
                          variant: str = "flat", interpret: bool = False,
                          int8_mxu: bool = False):

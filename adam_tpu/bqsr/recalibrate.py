@@ -633,9 +633,10 @@ def _donating_count_fn(kernel):
                    donate_argnums=tuple(range(7)))
 
 
-@lru_cache(maxsize=16)
 def _sharded_pallas_fn(mesh, n_qual_rg: int, n_cycle: int, variant: str,
                        interpret: bool):
+    # deferred-import shim only: sharded_count_pallas memoizes itself,
+    # and a second LRU here would pin entries the outer one evicted
     from .count_pallas import sharded_count_pallas
     return sharded_count_pallas(mesh, n_qual_rg, n_cycle, variant=variant,
                                 interpret=interpret)

@@ -74,6 +74,34 @@ def atomic_write(path: str, payload: str, *,
     fsync_dir(parent)
 
 
+def atomic_np_write(path: str, writer: Callable) -> str:
+    """THE durable atomic binary-blob write — ``atomic_write``'s twin
+    for np.save/np.savez payloads: tmp in the target dir + flush +
+    fsync + rename + parent-dir fsync, ``writer(f)`` doing the save
+    onto the open handle (a handle, not a path — np.save appends
+    ``.npy`` to bare paths).  One implementation for fleet commit
+    files, broadcast seed blobs, and any future binary artifact so the
+    discipline cannot drift between copies.  The parent-dir fsync
+    matters most where a marker ordering rides on it: the fleet
+    recovery contract is commit file FIRST, progress marker second — a
+    marker whose dir entry survives a power loss while the commit's
+    does not would silently drop the unit from the merge."""
+    parent = os.path.dirname(os.path.abspath(path)) or "."
+    os.makedirs(parent, exist_ok=True)
+    fd, tmp = tempfile.mkstemp(dir=parent, suffix=".tmp")
+    try:
+        with os.fdopen(fd, "wb") as f:
+            writer(f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+        fsync_dir(parent)
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+    return path
+
+
 @dataclass
 class CheckpointDir:
     """A resumable run rooted at ``path`` for a given pipeline config.

@@ -316,10 +316,15 @@ def flagstat_kernel_wire32_segmented(wire: jnp.ndarray,
 _flagstat_jit = jax.jit(partial(flagstat_kernel, axis_name=None))
 
 
+@functools.lru_cache(maxsize=None)
 def flagstat_sharded(mesh):
     """jit-compiled flagstat over a device mesh: per-shard masked matmul +
     psum over ICI (replaces the reference's executor map + driver tree
-    aggregate, FlagStat.scala:102-114)."""
+    aggregate, FlagStat.scala:102-114).
+
+    Memoized per mesh like :func:`flagstat_wire32_sharded` — a fresh
+    ``jax.jit`` wrapper per call would recompile on every warm-path
+    invocation (jit caches hang off the wrapper object)."""
     from jax.sharding import PartitionSpec as P
     from ..parallel.mesh import READS_AXIS
     spec = P(READS_AXIS)

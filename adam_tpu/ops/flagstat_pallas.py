@@ -175,7 +175,11 @@ def _kernel_v2(wire_ref, acc_ref):
         acc_ref[18 + k, :] += part >> 16
 
 
+@functools.partial(jax.jit, static_argnames=("interpret",))
 def _blocked_call_v2(wire3d, *, interpret: bool):
+    # module-scope jit owns the trace cache: callers inside jit inline
+    # it for free, and the direct (bench/oracle) route stops re-tracing
+    # a fresh pallas_call wrapper per invocation
     from jax.experimental.pallas import tpu as pltpu
 
     n_blk, rows, lanes = wire3d.shape
@@ -214,6 +218,7 @@ def flagstat_pallas_wire32_v2(wire, interpret: bool = False) -> jnp.ndarray:
                                 interpret=interpret)
 
 
+@functools.partial(jax.jit, static_argnames=("interpret",))
 def _blocked_call(wire3d, *, interpret: bool):
     from jax.experimental.pallas import tpu as pltpu
 

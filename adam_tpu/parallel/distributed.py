@@ -388,9 +388,13 @@ def route_by_start(start, mapped, valid, bin_span: int, n_stripes: int):
     return rows.astype(np.int64), stripe.astype(np.int32)
 
 
+@lru_cache(maxsize=None)
 def pileup_counts_halo_exchange(mesh: Mesh, bin_span: int, halo: int,
                                 max_len: int):
     """Sequence-parallel pileup without boundary-read duplication.
+    Memoized per (mesh, bin_span, halo, max_len) like
+    ``_build_resharder`` — the validation errors below re-raise on
+    every call (lru_cache never caches exceptions).
 
     Each device counts positions [i*bin_span, i*bin_span + bin_span + halo)
     for its stripe i — its own span plus a halo wide enough for the longest

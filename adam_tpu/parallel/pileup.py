@@ -18,7 +18,7 @@ reverse-strand, coverage, base-quality sum, mapq sum.
 
 from __future__ import annotations
 
-from functools import partial
+from functools import lru_cache, partial
 from typing import Tuple
 
 import jax
@@ -98,10 +98,14 @@ def pileup_count_kernel(bases, quals, start, flags, mapq, valid,
     return out
 
 
+@lru_cache(maxsize=None)
 def sharded_pileup_counts(mesh, bin_span: int, max_len: int):
     """shard_map-compiled binned pileup: each device counts its own genome
     stripe.  Inputs are sharded on the read axis (reads pre-routed to their
-    bin's device by the partitioner) plus a per-device bin_start scalar."""
+    bin's device by the partitioner) plus a per-device bin_start scalar.
+    Memoized per (mesh, bin_span, max_len): a fresh shard_map+jit per
+    call would retrace every invocation (the warm-path recompile leak
+    flagstat_wire32_sharded documents)."""
     from jax.sharding import PartitionSpec as P
     from .mesh import READS_AXIS
     spec = P(READS_AXIS)

@@ -74,7 +74,7 @@ from typing import Callable, Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from .. import obs
-from ..checkpoint import atomic_write
+from ..checkpoint import atomic_np_write, atomic_write
 from ..resilience import faults
 from ..resilience.retry import (RETRY_SEED_ENV, FleetPolicy,
                                 resolve_fleet_policy)
@@ -732,18 +732,7 @@ def _commit_unit_results(fleet_dir: str, shard: int, incarnation: int,
         arrays[key] = np.stack([r[key] for _, r in results])
     path = os.path.join(fleet_dir, COMMIT_DIR,
                         f"shard{shard}-inc{incarnation}-{seq:06d}.npz")
-    fd, tmp = tempfile.mkstemp(dir=os.path.dirname(path),
-                               suffix=".tmp")
-    try:
-        with os.fdopen(fd, "wb") as f:
-            np.savez(f, **arrays)
-            f.flush()
-            os.fsync(f.fileno())
-        os.replace(tmp, path)
-    finally:
-        if os.path.exists(tmp):
-            os.unlink(tmp)
-    return path
+    return atomic_np_write(path, lambda f: np.savez(f, **arrays))
 
 
 def run_shard_worker(fleet_dir: str, shard: int) -> int:
@@ -1427,12 +1416,18 @@ def fleet_bqsr_count(path: str, *, hosts: int, n_rg_run: int,
     from ..bqsr.recalibrate import tables_to_recal
 
     def seed(d: str) -> None:
+        # atomic_np_write like the unit commits: a supervisor crash
+        # mid-seed must not leave a torn dup/md blob that a rerun's
+        # workers would load as broadcast state
         if dup is not None:
-            np.save(os.path.join(d, "dup.npy"), np.asarray(dup))
+            atomic_np_write(os.path.join(d, "dup.npy"),
+                             lambda f: np.save(f, np.asarray(dup)))
         if mdstore is not None:
-            np.savez(os.path.join(d, "md.npz"),
-                     has_md=mdstore.has_md, ev_rows=mdstore.ev_rows,
-                     ev_pos=mdstore.ev_pos)
+            atomic_np_write(
+                os.path.join(d, "md.npz"),
+                lambda f: np.savez(f, has_md=mdstore.has_md,
+                                   ev_rows=mdstore.ev_rows,
+                                   ev_pos=mdstore.ev_pos))
 
     params = dict(n_rg_run=int(n_rg_run),
                   bucket_len=int(bucket_len),

@@ -1,0 +1,19 @@
+"""GL001 seeded violations: an impure planner + an unrecorded call site."""
+
+import os
+import time
+
+
+def decide_split(*, rows, budget):
+    # VIOLATION: clock + env reads inside a decide_* planner
+    deadline = time.time() + budget
+    if os.environ.get("FIXTURE_FORCE"):
+        return {"rows": rows, "deadline": deadline}
+    return {"rows": rows // 2, "deadline": deadline}
+
+
+def run_chunk(rows):
+    # VIOLATION: planner invoked from a wrapper that never emits the
+    # decision — no replayable record
+    plan = decide_split(rows=rows, budget=5)
+    return plan["rows"]

@@ -1,0 +1,18 @@
+"""GL001 clean twin: pure planner, event-emitting call site."""
+
+from adam_tpu import obs
+
+_DEFAULT_BUDGET = 5  # immutable module constant: fine to read
+
+
+def decide_split(*, rows, budget, force):
+    # pure function of its keyword inputs — replayable offline
+    if force:
+        return {"rows": rows}
+    return {"rows": min(rows, budget * _DEFAULT_BUDGET)}
+
+
+def run_chunk(rows, force):
+    plan = decide_split(rows=rows, budget=_DEFAULT_BUDGET, force=force)
+    obs.emit("alpha", inputs={"rows": rows}, plan=plan)
+    return plan["rows"]

@@ -1,0 +1,10 @@
+"""GL002 seeded violation: a fresh jit wrapper built per call."""
+
+import jax
+
+
+def run_chunk(x):
+    # VIOLATION: per-call jax.jit — the compile cache dies with the
+    # wrapper object and every invocation recompiles
+    step = jax.jit(lambda a: a + 1)
+    return step(x)

@@ -1,0 +1,17 @@
+"""GL002 clean twin: module-scope jit + memoized constructor."""
+
+import functools
+
+import jax
+
+_step = jax.jit(lambda a: a + 1)  # module scope: one cache per process
+
+
+@functools.lru_cache(maxsize=None)
+def build_step(n: int):
+    # memoized constructor: one wrapper per distinct n
+    return jax.jit(lambda a: a + n)
+
+
+def run_chunk(x, n):
+    return build_step(n)(_step(x))

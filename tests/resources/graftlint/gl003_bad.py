@@ -1,0 +1,9 @@
+"""GL003 seeded violation: bare durable write under the real name."""
+
+import json
+
+
+def save_marker(path, doc):
+    # VIOLATION: a crash between open and close publishes a torn file
+    with open(path, "w") as f:
+        json.dump(doc, f)

@@ -1,0 +1,13 @@
+"""GL003 clean twin: tmp + fsync + rename in one place."""
+
+import json
+import os
+
+
+def save_marker(path, doc):
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(doc, f)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)

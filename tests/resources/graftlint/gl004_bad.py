@@ -1,0 +1,13 @@
+"""GL004 seeded violations (placed at adam_tpu/obs/events.py in the
+fixture repo): an unregistered emit + a dead schema.
+
+The support check_metrics registers ("alpha", "beta"); this module
+emits "alpha" and "gamma" — so "gamma" has no schema (one finding) and
+"beta" has no live emit site (the other)."""
+
+from adam_tpu import obs
+
+
+def record(n):
+    obs.emit("alpha", n=n)
+    obs.emit("gamma", n=n)  # VIOLATION: no schema for 'gamma'

@@ -1,0 +1,10 @@
+"""GL004 clean twin: every registered kind emitted, nothing else —
+including one method-emit on an EventLog receiver (the
+write_manifest/run_with_events shape GL004 must count as live)."""
+
+from adam_tpu import obs
+
+
+def record(log, n):
+    obs.emit("alpha", n=n)
+    log.emit("beta", n=n)

@@ -1,0 +1,8 @@
+"""GL005 seeded violation: a fault-site literal outside the table."""
+
+from adam_tpu.resilience import faults
+
+
+def choke_point(x):
+    faults.fire("site_zz")  # VIOLATION: not in faults.SITES
+    return x

@@ -1,0 +1,8 @@
+"""GL005 clean twin: registered site literals only."""
+
+from adam_tpu.resilience import faults
+
+
+def choke_point(x):
+    faults.fire("site_a")
+    return x

@@ -1,0 +1,17 @@
+"""GL006 seeded violation: pool thread writes module-global state bare."""
+
+import threading
+
+_STATS = {}
+
+
+def _worker(k):
+    # VIOLATION: unlocked read-modify-write on module state from a
+    # thread entry point
+    _STATS[k] = _STATS.get(k, 0) + 1
+
+
+def start(k):
+    t = threading.Thread(target=_worker, args=(k,), daemon=True)
+    t.start()
+    return t

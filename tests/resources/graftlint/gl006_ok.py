@@ -1,0 +1,17 @@
+"""GL006 clean twin: the same write under a module-level lock."""
+
+import threading
+
+_STATS = {}
+_LOCK = threading.Lock()
+
+
+def _worker(k):
+    with _LOCK:
+        _STATS[k] = _STATS.get(k, 0) + 1
+
+
+def start(k):
+    t = threading.Thread(target=_worker, args=(k,), daemon=True)
+    t.start()
+    return t

@@ -1,0 +1,6 @@
+"""Mini check_metrics stand-in for graftlint fixture repos: just the
+two registry literals the drift rules (GL004, GL005) read."""
+
+KNOWN_EVENTS = ("alpha", "beta")
+
+_FAULT_SITES = ("site_a", "site_b")

@@ -1,0 +1,8 @@
+"""Mini resilience.faults stand-in for graftlint fixture repos: the
+registered site table GL005 compares fire() literals against."""
+
+SITES = ("site_a", "site_b")
+
+
+def fire(site: str) -> None:
+    raise NotImplementedError("fixture stub")

@@ -14,6 +14,9 @@ Each kernel gets: ok, lowering wall seconds, serialized-module size (a
 proxy for "the Mosaic payload is really in there"), or the exception.
 The watcher's no-tunnel branch runs this once per round.
 """
+# graftlint-file: disable=GL002 — one-shot AOT-lowering harness: each
+# kernel is deliberately wrapped in a fresh jit once per process run to
+# measure its lowering; there is no warm path to leak recompiles into.
 
 from __future__ import annotations
 
@@ -195,9 +198,10 @@ def main() -> int:
         "kernels": results,
         "all_ok": all(r["ok"] for r in results),
     }
+    from adam_tpu.checkpoint import atomic_write
+
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    with open(os.path.join(repo, args.out), "w") as f:
-        json.dump(doc, f, indent=1)
+    atomic_write(os.path.join(repo, args.out), json.dumps(doc, indent=1))
     for r in results:
         print(json.dumps(r))
     print(f"all_ok={doc['all_ok']}")

@@ -111,6 +111,29 @@ SCHEMA_VERSION = 1
 
 _NUM = (int, float)
 
+#: THE event-kind registry: every kind the adam_tpu product tree emits.
+#: tools/graftlint rule GL004 (event-schema drift) checks this tuple
+#: against the live ``obs.emit("<kind>", ...)`` sites — an emitted kind
+#: missing here, or a kind here with no emit site, fails the lint.  A
+#: kind outside this tuple fails validation below: an unregistered
+#: event is unvalidatable telemetry.
+KNOWN_EVENTS = (
+    "manifest", "summary",
+    "stage", "chunk", "run_totals",
+    "executor_bucket_selected", "executor_recompile",
+    "executor_prefetch_stall_s",
+    "fusion_plan_selected",
+    "realign_plan_selected", "realign_bin", "realign_sweep_dispatch",
+    "fault_injected", "retry_attempt", "degraded_dispatch",
+    "io_ledger", "trace_written",
+    "incarnation", "worker_death",
+    "shard_plan_selected", "shard_reassigned", "shard_lease_expired",
+    "shard_merge",
+    "admission_selected", "tenant_job", "startup_seconds",
+    "serve_boot", "serve_pack_dispatch", "serve_pack_degraded",
+    "ledger_stage",
+)
+
 #: mirror of adam_tpu.resilience.faults.SITES / FAULTS (kept literal so
 #: the validator runs without importing the package, like the rest of
 #: this file's schema knowledge)
@@ -203,6 +226,10 @@ def validate(path: str) -> List[str]:
     _TRANSFORM_PASSES = {"p1", "p2", "p3", "p4", "s1", "s2", "s3"}
     for i, d in docs:
         ev = d.get("event")
+        if isinstance(ev, str) and ev not in KNOWN_EVENTS:
+            err(i, f"unknown event kind {ev!r} — every emitted kind "
+                   "needs a schema here (KNOWN_EVENTS; see graftlint "
+                   "rule GL004)")
         if ev == "stage":
             if not isinstance(d.get("name"), str):
                 err(i, "stage event missing string 'name'")

@@ -1,0 +1,42 @@
+"""graftlint — the repo's own conventions, machine-checked.
+
+Ten PRs of this codebase accreted load-bearing invariants, and every one
+of them exists because a real bug shipped first:
+
+* pure replayable ``decide_*`` planners (the executor/fusion/fault/serve
+  convention — ``tools/check_executor.py`` replays them offline);
+* memoized jit constructors (the PR 10 per-call ``jax.jit`` recompile
+  leak: a fresh wrapper per serve job recompiled what the previous job
+  already compiled);
+* atomic tmp+rename(+fsync) durable writes (``checkpoint.atomic_write``
+  — a torn manifest must be invisible to resume);
+* the event-schema registry (``tools/check_metrics.py`` — an emitted
+  kind without a schema is unvalidatable telemetry);
+* the registered fault-site table (``resilience.faults.SITES`` — the
+  PR 9 site-table drift pin, generalized);
+* lock discipline on module-global state written from pool threads (the
+  PR 6 shared-stage-stack race).
+
+graftlint is a stdlib-``ast`` static pass that enforces all six as lint
+rules over ``adam_tpu/`` + ``tools/``: the same "replay the decision
+offline" discipline the ``check_*`` validators apply to runtime
+sidecars, applied to the source itself.  Findings carry file:line, a
+rule id and a one-line fix hint; grandfathered findings live in a
+checked-in baseline (``tools/graftlint/baseline.json``) with a
+documented reason each, and a stale baseline entry is itself a finding
+— the baseline can only shrink.
+
+CLI::
+
+    python -m tools.graftlint [--baseline FILE] [--rule ID] [PATHS...]
+
+Exit 0 when the scan is clean modulo baseline; 1 on any non-baselined
+finding (or stale baseline entry); 2 on usage error.  The whole pass
+runs in tier-1 via tests/test_graftlint.py.  Rule catalog:
+docs/STATIC_ANALYSIS.md.
+"""
+
+from .engine import Finding, Repo, load_baseline, scan  # noqa: F401
+from .rules import RULES  # noqa: F401
+
+__all__ = ["Finding", "Repo", "RULES", "load_baseline", "scan"]

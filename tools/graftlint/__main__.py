@@ -1,0 +1,96 @@
+"""CLI: ``python -m tools.graftlint [--baseline FILE] [--rule ID]
+[PATHS...]``.
+
+Defaults: scan ``adam_tpu/`` + ``tools/`` from the repo root with the
+checked-in baseline.  Exit 0 clean-modulo-baseline, 1 on any
+non-baselined finding (stale baseline entries included), 2 on usage
+error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+
+def _repo_root() -> str:
+    # tools/graftlint/__main__.py -> repo root is two levels up from
+    # the package directory
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+
+def main(argv=None) -> int:
+    root = _repo_root()
+    p = argparse.ArgumentParser(
+        prog="python -m tools.graftlint",
+        description="AST invariant linter + static race detector for "
+                    "the repo's own conventions (rule catalog: "
+                    "docs/STATIC_ANALYSIS.md)")
+    p.add_argument("paths", nargs="*",
+                   help="files/directories to scan (default: adam_tpu "
+                        "tools, relative to the repo root)")
+    p.add_argument("--baseline",
+                   default=os.path.join(root, "tools", "graftlint",
+                                        "baseline.json"),
+                   help="grandfathered-findings file (default: the "
+                        "checked-in tools/graftlint/baseline.json; "
+                        "pass an empty string for none)")
+    p.add_argument("--rule", action="append", default=None,
+                   help="run only this rule id or name (repeatable)")
+    p.add_argument("--list-rules", action="store_true",
+                   help="print the rule catalog and exit")
+    p.add_argument("--root", default=root,
+                   help=argparse.SUPPRESS)  # test hook
+    try:
+        args = p.parse_args(argv)
+    except SystemExit as e:
+        return 2 if e.code not in (0, None) else 0
+
+    from .engine import load_baseline, scan
+    from .rules import RULES, RULES_BY_NAME
+
+    if args.list_rules:
+        for rid, mod in sorted(RULES.items()):
+            doc = (mod.__doc__ or "").strip().splitlines()[0]
+            print(f"{rid}  {mod.NAME:<22s} {doc}")
+        return 0
+
+    only = None
+    if args.rule:
+        only = set()
+        for r in args.rule:
+            if r in RULES:
+                only.add(r)
+            elif r in RULES_BY_NAME:
+                only.add(RULES_BY_NAME[r].ID)
+            else:
+                print(f"unknown rule {r!r} (known: "
+                      f"{', '.join(sorted(RULES))} / "
+                      f"{', '.join(sorted(RULES_BY_NAME))})",
+                      file=sys.stderr)
+                return 2
+
+    paths = args.paths or ["adam_tpu", "tools"]
+    baseline = args.baseline or None
+    try:
+        active, suppressed, errors = scan(
+            args.root, paths, RULES, baseline_path=baseline, only=only)
+    except ValueError as e:          # malformed baseline
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    for e in errors:
+        print(f"error: {e}", file=sys.stderr)
+    for f in active:
+        print(f.format())
+    n_mod = sum(1 for _ in active)
+    tail = (f"{n_mod} finding(s)" if active else "clean")
+    if suppressed:
+        tail += f" ({len(suppressed)} baselined)"
+    print(f"graftlint: {tail}")
+    return 1 if (active or errors) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

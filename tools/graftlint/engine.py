@@ -1,0 +1,370 @@
+"""Rule engine: module loading, scope/import resolution, baseline.
+
+Everything here is stdlib ``ast`` — graftlint must run in any
+environment the repo's validators run in (no jax import, no third-party
+parser), and it must never execute the code it checks (the same
+"replay offline" discipline as tools/check_executor.py).
+
+Suppression mechanisms, narrowest first:
+
+* line pragma   ``# graftlint: disable=GL00X — reason`` silences the
+  named rule(s) on that source line;
+* file pragma   ``# graftlint-file: disable=GL00X — reason`` silences
+  the named rule(s) for the whole file (one-shot harness scripts);
+* baseline      ``tools/graftlint/baseline.json`` — grandfathered
+  findings keyed (rule, path, symbol) with a documented reason each.
+  A baseline entry that no longer matches any finding is STALE and is
+  reported as a finding itself (rule GL000), so the baseline can only
+  shrink.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+#: rule id for stale-baseline findings (not a real rule — the round-trip
+#: guard on the baseline file itself)
+STALE_RULE = "GL000"
+
+_PRAGMA_LINE = re.compile(r"#\s*graftlint:\s*disable=([A-Z0-9,\s-]+)")
+_PRAGMA_FILE = re.compile(r"#\s*graftlint-file:\s*disable=([A-Z0-9,\s-]+)")
+
+
+@dataclass(frozen=True)
+class Finding:
+    rule: str       # "GL001"
+    name: str       # "decider-purity"
+    path: str       # repo-relative, forward slashes
+    line: int
+    symbol: str     # stable baseline key: enclosing qualname or detail
+    message: str
+    hint: str
+
+    @property
+    def key(self) -> Tuple[str, str, str]:
+        return (self.rule, self.path, self.symbol)
+
+    def format(self) -> str:
+        return (f"{self.path}:{self.line}: {self.rule}[{self.name}] "
+                f"{self.message}\n    hint: {self.hint}")
+
+
+@dataclass
+class FuncInfo:
+    """One function (or method / nested function) in a module."""
+    node: ast.AST                    # FunctionDef | AsyncFunctionDef
+    qualname: str                    # dotted through classes/functions
+    class_name: Optional[str]        # nearest enclosing class, if any
+    parent: Optional["FuncInfo"]     # nearest enclosing function, if any
+    decorators: List[str] = field(default_factory=list)  # resolved dotted
+
+
+class Module:
+    """Parsed source file + the resolution maps every rule needs."""
+
+    def __init__(self, root: str, abspath: str):
+        self.abspath = abspath
+        self.rel = os.path.relpath(abspath, root).replace(os.sep, "/")
+        with open(abspath, encoding="utf-8") as f:
+            self.text = f.read()
+        self.lines = self.text.splitlines()
+        self.tree = ast.parse(self.text, filename=self.rel)
+        self.package = self._package()
+        self.imports = self._imports()
+        self.parents: Dict[ast.AST, ast.AST] = {}
+        for p in ast.walk(self.tree):
+            for c in ast.iter_child_nodes(p):
+                self.parents[c] = p
+        self.functions: List[FuncInfo] = []
+        self.scope_of: Dict[ast.AST, Optional[FuncInfo]] = {}
+        self._assign_scopes(self.tree, scope=None, prefix="", cls=None)
+        self.file_disables, self.line_disables = self._pragmas()
+
+    # -- structure ---------------------------------------------------------
+
+    def _package(self) -> str:
+        """Dotted package of this module ('adam_tpu.parallel' for
+        adam_tpu/parallel/ingest.py) — anchors relative imports."""
+        parts = self.rel.split("/")
+        return ".".join(parts[:-1])
+
+    def _imports(self) -> Dict[str, str]:
+        """alias -> absolute dotted target for every import statement."""
+        out: Dict[str, str] = {}
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    out[a.asname or a.name.split(".")[0]] = \
+                        a.name if a.asname else a.name.split(".")[0]
+            elif isinstance(node, ast.ImportFrom):
+                if node.level:
+                    base = self.package.split(".")
+                    base = base[:len(base) - (node.level - 1)]
+                    mod = ".".join(base + ([node.module]
+                                           if node.module else []))
+                else:
+                    mod = node.module or ""
+                for a in node.names:
+                    if a.name == "*":
+                        continue
+                    out[a.asname or a.name] = f"{mod}.{a.name}"
+        return out
+
+    def _assign_scopes(self, node: ast.AST, scope: Optional[FuncInfo],
+                       prefix: str, cls: Optional[str]) -> None:
+        """Map every node to the function whose BODY executes it.
+
+        Decorator expressions and default-value expressions of a
+        function run in the ENCLOSING scope (a module-level
+        ``@partial(jax.jit, ...)`` is a module-scope jit construction,
+        not a call inside the function it decorates)."""
+        self.scope_of[node] = scope
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            qn = f"{prefix}{node.name}"
+            info = FuncInfo(node=node, qualname=qn, class_name=cls,
+                            parent=scope,
+                            decorators=[d for d in
+                                        (self.resolve(self.call_target(dec)
+                                                      or self.dotted(dec))
+                                         for dec in node.decorator_list)
+                                        if d])
+            self.functions.append(info)
+            for dec in node.decorator_list:
+                self._walk_in(dec, scope, prefix, cls)
+            for default in (node.args.defaults +
+                            [d for d in node.args.kw_defaults
+                             if d is not None]):
+                self._walk_in(default, scope, prefix, cls)
+            for stmt in node.body:
+                self._walk_in(stmt, info, f"{qn}.", cls)
+        elif isinstance(node, ast.ClassDef):
+            for dec in node.decorator_list:
+                self._walk_in(dec, scope, prefix, cls)
+            for stmt in node.body:
+                self._walk_in(stmt, scope, f"{prefix}{node.name}.",
+                              node.name)
+        else:
+            for child in ast.iter_child_nodes(node):
+                self._walk_in(child, scope, prefix, cls)
+
+    def _walk_in(self, node, scope, prefix, cls):
+        self._assign_scopes(node, scope, prefix, cls)
+
+    def _pragmas(self):
+        file_dis: Set[str] = set()
+        line_dis: Dict[int, Set[str]] = {}
+        for i, ln in enumerate(self.lines, 1):
+            m = _PRAGMA_FILE.search(ln)
+            if m:
+                file_dis |= {r.strip() for r in m.group(1).split(",")
+                             if r.strip()}
+                continue
+            m = _PRAGMA_LINE.search(ln)
+            if m:
+                line_dis[i] = {r.strip() for r in m.group(1).split(",")
+                               if r.strip()}
+        return file_dis, line_dis
+
+    # -- resolution helpers ------------------------------------------------
+
+    @staticmethod
+    def dotted(node: ast.AST) -> Optional[str]:
+        """'a.b.c' for a Name/Attribute chain, else None."""
+        parts: List[str] = []
+        while isinstance(node, ast.Attribute):
+            parts.append(node.attr)
+            node = node.value
+        if isinstance(node, ast.Name):
+            parts.append(node.id)
+            return ".".join(reversed(parts))
+        return None
+
+    def resolve(self, dotted: Optional[str]) -> Optional[str]:
+        """Rewrite the first segment through the module's import map
+        ('np.random.rand' -> 'numpy.random.rand')."""
+        if not dotted:
+            return None
+        head, _, rest = dotted.partition(".")
+        target = self.imports.get(head)
+        if target is None:
+            return dotted
+        return f"{target}.{rest}" if rest else target
+
+    def call_target(self, node: ast.AST) -> Optional[str]:
+        """Resolved dotted target of a Call node (else None)."""
+        if isinstance(node, ast.Call):
+            return self.resolve(self.dotted(node.func))
+        return None
+
+    def enclosing(self, node: ast.AST) -> Optional[FuncInfo]:
+        """The function whose body executes this node (None = module)."""
+        return self.scope_of.get(node)
+
+    def suppressed(self, rule: str, line: int) -> bool:
+        if rule in self.file_disables:
+            return True
+        return rule in self.line_disables.get(line, set())
+
+
+class Repo:
+    """The scan unit: parsed modules + lazily shared cross-module facts.
+
+    ``modules`` is the scan set (what findings are reported against);
+    ``reference(rel)`` loads well-known files (faults.py,
+    check_metrics.py) even when PATHS excluded them, so the drift rules
+    always compare against the real registries."""
+
+    def __init__(self, root: str, paths: Sequence[str]):
+        self.root = os.path.abspath(root)
+        self.modules: List[Module] = []
+        self.errors: List[str] = []
+        self.scanned_dirs: List[str] = []
+        self._refs: Dict[str, Optional[Module]] = {}
+        for path in paths:
+            ap = path if os.path.isabs(path) else \
+                os.path.join(self.root, path)
+            if os.path.isfile(ap) and ap.endswith(".py"):
+                self._load(ap)
+            elif os.path.isdir(ap):
+                self.scanned_dirs.append(os.path.abspath(ap))
+                for dirpath, dirnames, filenames in os.walk(ap):
+                    dirnames[:] = sorted(
+                        d for d in dirnames
+                        if d not in ("__pycache__", ".git"))
+                    for fn in sorted(filenames):
+                        if fn.endswith(".py"):
+                            self._load(os.path.join(dirpath, fn))
+            else:
+                self.errors.append(f"{path}: not a .py file or directory")
+
+    def _load(self, abspath: str) -> None:
+        try:
+            self.modules.append(Module(self.root, abspath))
+        except (OSError, SyntaxError, UnicodeDecodeError,
+                ValueError) as e:
+            self.errors.append(f"{abspath}: unparseable: {e}")
+
+    def module(self, rel: str) -> Optional[Module]:
+        for m in self.modules:
+            if m.rel == rel:
+                return m
+        return None
+
+    def covers_dir(self, rel: str) -> bool:
+        """True when the scan set includes the WHOLE tree at
+        root/*rel* — i.e. some scanned directory is that directory or
+        an ancestor of it.  Absence-of-X rules (a dead schema = no
+        emit site anywhere) may only fire on a scan that could have
+        seen X; a partial scan proves nothing absent."""
+        target = os.path.abspath(os.path.join(self.root, rel))
+        for d in self.scanned_dirs:
+            if target == d or target.startswith(d + os.sep):
+                return True
+        return False
+
+    def reference(self, rel: str) -> Optional[Module]:
+        """A well-known file by repo-relative path, loaded on demand and
+        cached; falls back to the scan set when already loaded."""
+        if rel in self._refs:
+            return self._refs[rel]
+        m = self.module(rel)
+        if m is None:
+            ap = os.path.join(self.root, rel)
+            if os.path.isfile(ap):
+                try:
+                    m = Module(self.root, ap)
+                except (OSError, SyntaxError, UnicodeDecodeError,
+                        ValueError):
+                    m = None
+        self._refs[rel] = m
+        return m
+
+
+# ---------------------------------------------------------------------------
+# baseline
+# ---------------------------------------------------------------------------
+
+def load_baseline(path: Optional[str]) -> List[dict]:
+    """Baseline entries [{rule, path, symbol, reason}, ...]; every entry
+    must carry a non-empty reason (an undocumented grandfathering is a
+    usage error — the whole point is the documented WHY)."""
+    if not path or not os.path.isfile(path):
+        return []
+    with open(path, encoding="utf-8") as f:
+        doc = json.load(f)
+    entries = doc.get("entries", [])
+    for e in entries:
+        for fld in ("rule", "path", "symbol", "reason"):
+            if not isinstance(e.get(fld), str) or not e[fld].strip():
+                raise ValueError(
+                    f"baseline entry {e!r} missing non-empty {fld!r} "
+                    "(every grandfathered finding needs a documented "
+                    "reason)")
+    return entries
+
+
+def apply_baseline(findings: List[Finding],
+                   entries: List[dict],
+                   baseline_path: str) -> Tuple[List[Finding],
+                                                List[Finding]]:
+    """Split into (active, suppressed); stale baseline entries are
+    appended to *active* as GL000 findings — a baseline row that no
+    longer matches anything must be deleted, not carried."""
+    keys = {(e["rule"], e["path"], e["symbol"]): e for e in entries}
+    hit: Set[Tuple[str, str, str]] = set()
+    active: List[Finding] = []
+    suppressed: List[Finding] = []
+    for f in findings:
+        if f.key in keys:
+            hit.add(f.key)
+            suppressed.append(f)
+        else:
+            active.append(f)
+    for e in entries:
+        k = (e["rule"], e["path"], e["symbol"])
+        if k not in hit:
+            active.append(Finding(
+                rule=STALE_RULE, name="stale-baseline",
+                path=baseline_path.replace(os.sep, "/"), line=1,
+                symbol=f"{e['rule']}:{e['path']}:{e['symbol']}",
+                message=(f"stale baseline entry {e['rule']} "
+                         f"{e['path']}::{e['symbol']} matches no "
+                         "current finding"),
+                hint="delete the entry — the violation it grandfathered "
+                     "is gone (the baseline only shrinks)"))
+    return active, suppressed
+
+
+# ---------------------------------------------------------------------------
+# driver
+# ---------------------------------------------------------------------------
+
+def scan(root: str, paths: Sequence[str], rules: Dict[str, "object"],
+         baseline_path: Optional[str] = None,
+         only: Optional[Iterable[str]] = None):
+    """Run the rule set over PATHS.  Returns (active, suppressed,
+    errors): non-baselined findings (incl. stale-baseline rows),
+    baseline-suppressed findings, and unparseable-file errors."""
+    repo = Repo(root, paths)
+    findings: List[Finding] = []
+    wanted = set(only) if only else None
+    for rule_id, rule in sorted(rules.items()):
+        if wanted and rule_id not in wanted and \
+                getattr(rule, "NAME", "") not in wanted:
+            continue
+        for f in rule.check(repo):
+            m = repo.module(f.path)
+            if m is not None and m.suppressed(f.rule, f.line):
+                continue
+            findings.append(f)
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    entries = load_baseline(baseline_path)
+    rel_base = (os.path.relpath(baseline_path, root)
+                if baseline_path else "baseline.json")
+    active, suppressed = apply_baseline(findings, entries, rel_base)
+    return active, suppressed, repo.errors

@@ -1,0 +1,19 @@
+"""Rule registry.  Each rule module exposes ``ID`` ("GL00X"), ``NAME``
+(kebab-case slug), and ``check(repo) -> Iterable[Finding]``.  The
+catalog — with the shipped bug that motivated each rule — lives in
+docs/STATIC_ANALYSIS.md; adding a rule = adding a module here plus
+fixture twins under tests/resources/graftlint/."""
+
+from . import (rules_decider, rules_durable, rules_events, rules_faults,
+               rules_jit, rules_race)
+
+RULES = {mod.ID: mod for mod in (
+    rules_decider,   # GL001 decider-purity
+    rules_jit,       # GL002 jit-memoization
+    rules_durable,   # GL003 durable-write discipline
+    rules_events,    # GL004 event-schema drift
+    rules_faults,    # GL005 fault-site drift
+    rules_race,      # GL006 static stage/race detector
+)}
+
+RULES_BY_NAME = {mod.NAME: mod for mod in RULES.values()}

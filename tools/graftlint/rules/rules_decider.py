@@ -1,0 +1,258 @@
+"""GL001 decider-purity: ``decide_*`` planners must be pure and every
+product call site must flow through an event-emitting wrapper.
+
+Originating bug class: the whole replay plane (tools/check_executor.py,
+tools/check_resilience.py) rests on planners being deterministic
+functions of their recorded ``inputs`` — a planner that peeks at
+``os.environ``, the clock, randomness, the filesystem, a jax backend
+probe, or a mutable module global replays DIFFERENTLY offline and the
+sidecar digests stop meaning anything.  Env resolution belongs in the
+``resolve_*`` wrappers (executor.resolve_ragged_env,
+retry.resolve_retry_policy...), which run once at the impure boundary
+and hand the planner plain values.
+
+A planner here is a module-level function named ``decide_*`` whose
+arguments are all keyword-only — the signature convention every shipped
+planner uses (``decide_plan``, ``decide_fault``, ``decide_admission``,
+...).  ``ops/markdup.decide_duplicates`` takes positional arrays and is
+a kernel, not a planner; the signature rule keeps it out.
+
+The call-site half: in product code (``adam_tpu/``) a planner may only
+be invoked from a function that also emits the decision through
+``obs.emit`` — the event IS the replay record.  Validators and tests
+call planners bare on purpose (that is the replay); they are out of
+scope.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Set
+
+from ..engine import Finding, FuncInfo, Module, Repo
+
+ID = "GL001"
+NAME = "decider-purity"
+
+#: resolved-call prefixes a pure planner may never touch
+_FORBIDDEN_PREFIXES = (
+    "time.", "random.", "uuid.", "secrets.", "socket.", "subprocess.",
+    "tempfile.", "shutil.", "datetime.", "numpy.random.", "jax.",
+)
+#: bare calls that reach the filesystem / stdin
+_FORBIDDEN_BARE = {"open", "input"}
+#: the pure string-algebra corner of ``os`` (everything else in os.* is
+#: environment or filesystem)
+_OS_PURE = {
+    "os.path.join", "os.path.basename", "os.path.dirname",
+    "os.path.splitext", "os.path.split", "os.path.normpath", "os.sep",
+    "os.fspath",
+}
+
+_MUTATORS = {"append", "add", "update", "pop", "clear", "setdefault",
+             "extend", "insert", "remove", "discard", "popitem"}
+
+
+def is_planner(fn: FuncInfo) -> bool:
+    node = fn.node
+    if not fn.qualname.startswith("decide_") or "." in fn.qualname:
+        return False
+    a = node.args
+    return (not a.args and not a.posonlyargs and bool(a.kwonlyargs))
+
+
+def _mutable_globals(m: Module) -> Set[str]:
+    """Module-level names that are demonstrably mutable state: targets
+    of a ``global`` rebind anywhere in the module, or module-level
+    containers the module itself mutates."""
+    out: Set[str] = set()
+    for node in ast.walk(m.tree):
+        if isinstance(node, ast.Global):
+            out.update(node.names)
+    containers: Set[str] = set()
+    for stmt in m.tree.body:
+        if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 and \
+                isinstance(stmt.targets[0], ast.Name):
+            name, val = stmt.targets[0].id, stmt.value
+        elif isinstance(stmt, ast.AnnAssign) and \
+                isinstance(stmt.target, ast.Name) and \
+                stmt.value is not None:
+            name, val = stmt.target.id, stmt.value
+        else:
+            continue
+        if isinstance(val, (ast.List, ast.Dict, ast.Set, ast.ListComp,
+                            ast.DictComp, ast.SetComp)):
+            containers.add(name)
+        elif isinstance(val, ast.Call):
+            t = m.call_target(val) or ""
+            if t in ("dict", "list", "set", "collections.defaultdict",
+                     "collections.OrderedDict", "collections.deque"):
+                containers.add(name)
+    for node in ast.walk(m.tree):
+        root = None
+        if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            targets = node.targets if isinstance(node, ast.Assign) \
+                else [node.target]
+            for t in targets:
+                if isinstance(t, (ast.Subscript, ast.Attribute)):
+                    root = _root_name(t)
+        elif isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Attribute) and \
+                node.func.attr in _MUTATORS:
+            root = _root_name(node.func)
+        if root in containers:
+            out.add(root)
+    return out
+
+
+def _root_name(node: ast.AST):
+    while isinstance(node, (ast.Attribute, ast.Subscript)):
+        node = node.value
+    return node.id if isinstance(node, ast.Name) else None
+
+
+def _local_bindings(fn_node: ast.AST) -> Set[str]:
+    a = fn_node.args
+    names = {arg.arg for arg in (a.args + a.posonlyargs + a.kwonlyargs)}
+    if a.vararg:
+        names.add(a.vararg.arg)
+    if a.kwarg:
+        names.add(a.kwarg.arg)
+    for node in ast.walk(fn_node):
+        if isinstance(node, ast.Name) and \
+                isinstance(node.ctx, (ast.Store, ast.Del)):
+            names.add(node.id)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                               ast.ClassDef)):
+            names.add(node.name)
+        elif isinstance(node, ast.ImportFrom) or \
+                isinstance(node, ast.Import):
+            for alias in node.names:
+                names.add(alias.asname or alias.name.split(".")[0])
+    return names
+
+
+def _emitting_functions(m: Module) -> Set[str]:
+    """Qualnames of functions that emit — directly, or transitively
+    through a same-module helper called by bare name (the
+    ``emit_fusion_plan`` / ``_emit_reassigned`` wrapper shape)."""
+    direct: Set[str] = set()
+    calls: dict = {}
+    for fn in m.functions:
+        names: Set[str] = set()
+        for node in ast.walk(fn.node):
+            if isinstance(node, ast.Call):
+                d = m.dotted(node.func)
+                if not d:
+                    continue
+                if d.split(".")[-1] == "emit":
+                    direct.add(fn.qualname)
+                elif "." not in d:
+                    names.add(d)
+        calls[fn.qualname] = names
+    by_leaf: dict = {}
+    for fn in m.functions:
+        by_leaf.setdefault(fn.qualname.split(".")[-1],
+                           set()).add(fn.qualname)
+    emitting = set(direct)
+    changed = True
+    while changed:
+        changed = False
+        for qn, names in calls.items():
+            if qn in emitting:
+                continue
+            for n in names:
+                if by_leaf.get(n, set()) & emitting:
+                    emitting.add(qn)
+                    changed = True
+                    break
+    return emitting
+
+
+def check(repo: Repo) -> Iterable[Finding]:
+    findings: List[Finding] = []
+    planner_names: Set[str] = set()
+    for m in repo.modules:
+        for fn in m.functions:
+            if is_planner(fn):
+                planner_names.add(fn.qualname)
+
+    for m in repo.modules:
+        for fn in m.functions:
+            if not is_planner(fn):
+                continue
+            locals_ = _local_bindings(fn.node)
+            mutable = _mutable_globals(m) - locals_
+            for node in ast.walk(fn.node):
+                bad = None
+                if isinstance(node, ast.Call):
+                    t = m.resolve(m.dotted(node.func))
+                    if t in _FORBIDDEN_BARE:
+                        bad = t
+                    elif t and t.startswith("os.") and t not in _OS_PURE:
+                        bad = t
+                    elif t and any(t == p[:-1] or t.startswith(p)
+                                   for p in _FORBIDDEN_PREFIXES):
+                        bad = t
+                elif isinstance(node, ast.Attribute):
+                    if m.resolve(m.dotted(node)) == "os.environ":
+                        bad = "os.environ"
+                if bad is not None:
+                    findings.append(Finding(
+                        rule=ID, name=NAME, path=m.rel, line=node.lineno,
+                        symbol=f"{fn.qualname}:{bad}",
+                        message=(f"planner {fn.qualname} calls impure "
+                                 f"API {bad} — decide_* must be a pure "
+                                 "function of its recorded inputs"),
+                        hint="resolve env/clock/backend state in a "
+                             "resolve_* wrapper and pass the value in "
+                             "as a keyword input (check_executor "
+                             "replays the decision offline)"))
+                elif isinstance(node, ast.Name) and \
+                        isinstance(node.ctx, ast.Load) and \
+                        node.id in mutable:
+                    findings.append(Finding(
+                        rule=ID, name=NAME, path=m.rel, line=node.lineno,
+                        symbol=f"{fn.qualname}:{node.id}",
+                        message=(f"planner {fn.qualname} reads mutable "
+                                 f"module global {node.id} — hidden "
+                                 "state breaks offline replay"),
+                        hint="pass the value in as a keyword input; "
+                             "module constants are fine, mutated "
+                             "globals are not"))
+
+        # call-site half: product code only
+        if not m.rel.startswith("adam_tpu/"):
+            continue
+        emitting = _emitting_functions(m)
+        for node in ast.walk(m.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            d = m.dotted(node.func)
+            leaf = d.split(".")[-1] if d else None
+            if leaf not in planner_names:
+                continue
+            fn = m.enclosing(node)
+            if fn is not None and is_planner(fn):
+                continue        # a planner may compose planners
+            if fn is None:
+                findings.append(Finding(
+                    rule=ID, name=NAME, path=m.rel, line=node.lineno,
+                    symbol=f"<module>:{leaf}",
+                    message=(f"planner {leaf} called at module scope — "
+                             "decisions must flow through an "
+                             "event-emitting wrapper"),
+                    hint="call it from the wrapper that emits the "
+                         "*_selected event with inputs + digest"))
+            elif fn.qualname not in emitting:
+                findings.append(Finding(
+                    rule=ID, name=NAME, path=m.rel, line=node.lineno,
+                    symbol=f"{fn.qualname}:{leaf}",
+                    message=(f"planner {leaf} called from "
+                             f"{fn.qualname}, which never emits — the "
+                             "decision would leave no replayable "
+                             "record"),
+                    hint="emit the decision event (inputs + "
+                         "input_digest) in this wrapper, or route the "
+                         "call through the one that does"))
+    return findings

@@ -1,0 +1,150 @@
+"""GL003 durable-write discipline: artifact writes go through the
+atomic helpers.
+
+Originating bug class: torn artifacts.  The PR 5 hardening wrapped
+every manifest/marker/ledger write in ``checkpoint.atomic_write`` (tmp
+in the target dir + flush + fsync + rename + parent-dir fsync) after
+the chaos matrix showed a mid-write crash leaving a half-written
+manifest that a resume then trusted.  A bare ``json.dump(obj,
+open(path, "w"))`` or ``np.save(path, ...)`` re-opens exactly that
+hole: the next crash between open and close publishes a torn file
+under the real name.
+
+Flagged patterns (the shipped bug shapes):
+
+* ``json.dump(obj, f)``
+* ``f.write(json.dumps(...))`` — directly or through a local name
+  assigned from ``json.dumps``
+* ``np.save(...)`` / ``np.savez(...)`` / ``np.savez_compressed(...)``
+
+A site is exempt when the atomic discipline is visible around it:
+
+* the enclosing function also calls ``os.replace`` / ``os.rename`` /
+  ``atomic_write`` / ``save_doc`` (write-tmp-then-rename in one place);
+* the write is in a method and a sibling method of the same class does
+  the rename (the EventLog shape: append to ``.tmp`` in ``emit``,
+  publish in ``close``);
+* the file object is a caller-supplied parameter (the caller owns
+  durability — report writers handed ``sys.stdout``);
+* the target is ``sys.stdout`` / ``sys.stderr``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Optional, Set
+
+from ..engine import Finding, FuncInfo, Module, Repo
+
+ID = "GL003"
+NAME = "durable-write"
+
+_NP_SAVERS = {"numpy.save", "numpy.savez", "numpy.savez_compressed"}
+#: ``os.link`` publishes atomically too (the spool's no-clobber submit)
+_ATOMIC_CALLS = {"replace", "rename", "renames", "link", "atomic_write",
+                 "atomic_np_write", "save_doc"}
+
+
+def _has_atomic_call(m: Module, node: ast.AST) -> bool:
+    for n in ast.walk(node):
+        if isinstance(n, ast.Call):
+            d = m.dotted(n.func)
+            if d and d.split(".")[-1] in _ATOMIC_CALLS:
+                return True
+    return False
+
+
+def _class_has_atomic(m: Module, fn: FuncInfo) -> bool:
+    if fn.class_name is None:
+        return False
+    return any(f.class_name == fn.class_name and
+               _has_atomic_call(m, f.node)
+               for f in m.functions)
+
+
+def _params_of_chain(fn: Optional[FuncInfo]) -> Set[str]:
+    names: Set[str] = set()
+    while fn is not None:
+        a = fn.node.args
+        names |= {arg.arg for arg in
+                  (a.args + a.posonlyargs + a.kwonlyargs)}
+        fn = fn.parent
+    return names
+
+
+def _dumps_locals(m: Module, scope_node: ast.AST) -> Set[str]:
+    """Local names assigned from ``json.dumps(...)`` in this scope."""
+    out: Set[str] = set()
+    for n in ast.walk(scope_node):
+        if isinstance(n, ast.Assign) and isinstance(n.value, ast.Call) \
+                and m.resolve(m.dotted(n.value.func)) == "json.dumps":
+            for t in n.targets:
+                if isinstance(t, ast.Name):
+                    out.add(t.id)
+    return out
+
+
+def _mentions(node: ast.AST, names: Set[str]) -> bool:
+    return any(isinstance(n, ast.Name) and n.id in names
+               for n in ast.walk(node))
+
+
+def check(repo: Repo) -> Iterable[Finding]:
+    findings: List[Finding] = []
+    for m in repo.modules:
+        for node in ast.walk(m.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            t = m.resolve(m.dotted(node.func))
+            file_expr = None
+            what = None
+            fn = m.enclosing(node)
+            scope_node = fn.node if fn is not None else m.tree
+            if t == "json.dump":
+                what = "json.dump"
+                file_expr = node.args[1] if len(node.args) > 1 else None
+            elif t in _NP_SAVERS:
+                what = t.split(".")[-1]
+                file_expr = node.args[0] if node.args else None
+            elif isinstance(node.func, ast.Attribute) and \
+                    node.func.attr == "write" and node.args:
+                a0 = node.args[0]
+                is_dumps = (isinstance(a0, ast.Call) and
+                            m.resolve(m.dotted(a0.func)) == "json.dumps")
+                if not is_dumps:
+                    dl = _dumps_locals(m, scope_node)
+                    is_dumps = bool(dl) and _mentions(a0, dl)
+                if not is_dumps:
+                    continue
+                what = "write(json.dumps(...))"
+                file_expr = node.func.value
+            else:
+                continue
+
+            # exemptions, cheapest first
+            if file_expr is not None:
+                fd = m.resolve(m.dotted(file_expr))
+                if fd in ("sys.stdout", "sys.stderr"):
+                    continue
+                root = file_expr
+                while isinstance(root, (ast.Attribute, ast.Subscript)):
+                    root = root.value
+                if isinstance(root, ast.Name) and \
+                        root.id in _params_of_chain(fn):
+                    continue
+            if _has_atomic_call(m, scope_node):
+                continue
+            if fn is not None and _class_has_atomic(m, fn):
+                continue
+            qual = fn.qualname if fn is not None else "<module>"
+            findings.append(Finding(
+                rule=ID, name=NAME, path=m.rel, line=node.lineno,
+                symbol=qual,
+                message=(f"bare durable write ({what}) in {qual} — a "
+                         "crash mid-write publishes a torn artifact "
+                         "under the real name"),
+                hint="route through checkpoint.atomic_write / "
+                     "ledger.save_doc, or write to '<path>.tmp' and "
+                     "os.replace() it into place (fsync for "
+                     "crash-durability)"))
+    return findings

@@ -1,0 +1,133 @@
+"""GL004 event-schema drift: every emitted event kind has a schema,
+every schema has a live emit site.
+
+Originating bug class: unvalidatable telemetry.  The obs plane's
+contract is that ``tools/check_metrics.py`` can validate any sidecar
+the pipeline produces — that is what the tier-1 CLI telemetry test and
+every validator round-trip pin rely on.  An event kind emitted without
+a schema sails through validation unchecked (the validator skips
+unknown kinds), so a field rename or type change in that event is
+silent drift; a schema without an emit site is dead weight that
+documents an event nobody produces.
+
+Ground truth on the schema side is the ``KNOWN_EVENTS`` tuple in
+tools/check_metrics.py (the validator's own registry).  Ground truth on
+the live side is every ``obs.emit("<kind>", ...)`` /
+``events.emit(...)`` call with a literal kind in ``adam_tpu/``.
+
+The dead-schema direction only runs when the scan actually covers the
+``adam_tpu/`` tree — a partial scan (fixtures, one file) cannot prove
+an emit site absent.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Tuple
+
+from ..engine import Finding, Module, Repo
+
+ID = "GL004"
+NAME = "event-schema"
+
+CHECK_METRICS = "tools/check_metrics.py"
+
+
+def known_events(repo: Repo) -> Tuple[Optional[List[str]], int]:
+    """(KNOWN_EVENTS contents, line) from check_metrics.py, or
+    (None, 1) when the registry tuple is missing."""
+    m = repo.reference(CHECK_METRICS)
+    if m is None:
+        return None, 1
+    for stmt in m.tree.body:
+        if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 and \
+                isinstance(stmt.targets[0], ast.Name) and \
+                stmt.targets[0].id == "KNOWN_EVENTS" and \
+                isinstance(stmt.value, (ast.Tuple, ast.List)):
+            vals = [e.value for e in stmt.value.elts
+                    if isinstance(e, ast.Constant) and
+                    isinstance(e.value, str)]
+            return vals, stmt.lineno
+    return None, 1
+
+
+def _is_obs_emit(m: Module, node: ast.Call) -> bool:
+    d = m.dotted(node.func)
+    if not d or d.split(".")[-1] != "emit":
+        return False
+    r = m.resolve(d) or d
+    parts = r.split(".")
+    # the obs plane's emit: adam_tpu.obs.emit, adam_tpu.obs.events.emit,
+    # or a local alias of either
+    if "obs" in parts or "events" in parts or r == "emit":
+        return True
+    # method emit on an EventLog instance — the obs convention names the
+    # receiver `log`/`*_log` (events.write_manifest(log, ...),
+    # run_with_events' `log.emit("summary", ...)`); stdlib loggers never
+    # take a literal kind as first arg, so this stays precise
+    recv = parts[-2] if len(parts) >= 2 else ""
+    return "log" in recv.lower()
+
+
+def emit_sites(repo: Repo) -> Dict[str, Tuple[str, int]]:
+    """kind -> (first path, line) over every literal obs emit in the
+    scanned ``adam_tpu/`` modules."""
+    out: Dict[str, Tuple[str, int]] = {}
+    for m in repo.modules:
+        if not m.rel.startswith("adam_tpu/"):
+            continue
+        for node in ast.walk(m.tree):
+            if not (isinstance(node, ast.Call) and
+                    _is_obs_emit(m, node) and node.args):
+                continue
+            a0 = node.args[0]
+            if isinstance(a0, ast.Constant) and isinstance(a0.value, str):
+                out.setdefault(a0.value, (m.rel, node.lineno))
+    return out
+
+
+def check(repo: Repo) -> Iterable[Finding]:
+    findings: List[Finding] = []
+    sites = emit_sites(repo)
+    if not sites:
+        return findings
+    known, known_line = known_events(repo)
+    if known is None:
+        findings.append(Finding(
+            rule=ID, name=NAME, path=CHECK_METRICS, line=1,
+            symbol="KNOWN_EVENTS",
+            message="tools/check_metrics.py has no KNOWN_EVENTS "
+                    "registry tuple — the schema side of the drift "
+                    "check has no ground truth",
+            hint="declare KNOWN_EVENTS = (\"manifest\", \"summary\", "
+                 "...) listing every validated event kind"))
+        return findings
+
+    for kind, (path, line) in sorted(sites.items()):
+        if kind not in known:
+            findings.append(Finding(
+                rule=ID, name=NAME, path=path, line=line,
+                symbol=f"emit:{kind}",
+                message=(f"event kind {kind!r} is emitted but has no "
+                         "schema in tools/check_metrics.py — its "
+                         "sidecar lines validate as nothing"),
+                hint="add the kind to KNOWN_EVENTS and a field-schema "
+                     "branch in check_metrics.validate (document it in "
+                     "docs/OBSERVABILITY.md)"))
+
+    # the dead-schema direction needs the WHOLE product tree in the
+    # scan set: `graftlint adam_tpu/obs` must not call every schema
+    # whose emit site lives elsewhere dead
+    if repo.covers_dir("adam_tpu"):
+        for kind in known:
+            if kind not in sites:
+                findings.append(Finding(
+                    rule=ID, name=NAME, path=CHECK_METRICS,
+                    line=known_line, symbol=f"schema:{kind}",
+                    message=(f"schema for event kind {kind!r} has no "
+                             "live emit site in adam_tpu/ — dead "
+                             "schema"),
+                    hint="delete the schema (and its KNOWN_EVENTS "
+                         "entry), or revive the emit site it "
+                         "documented"))
+    return findings

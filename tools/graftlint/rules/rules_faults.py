@@ -1,0 +1,105 @@
+"""GL005 fault-site drift: every literal fault-plane site string is in
+the registered table, and the validator mirror matches it.
+
+Originating bug class: the PR 9 site-table drift — ``shard_lease`` was
+added to ``resilience.faults.SITES`` and the hardcoded mirror in
+tools/check_metrics.py silently lagged until a test pinned that one
+list.  This rule generalizes the pin from one list to the whole tree:
+
+* every ``faults.fire("<site>")`` literal anywhere in the scan set must
+  name a registered site (``faults.fire`` raises on unknown sites at
+  runtime, but only when a plan is installed AND the site fires — a
+  typo at a rarely-exercised choke point ships silently);
+* the ``_FAULT_SITES`` mirror in tools/check_metrics.py must equal
+  ``SITES`` exactly (the validator must reject what the plane would
+  reject).
+
+Fires through a variable (``faults.fire(site)`` in the retry engine)
+are out of static reach and stay runtime-checked.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Optional, Tuple
+
+from ..engine import Finding, Module, Repo
+
+ID = "GL005"
+NAME = "fault-site"
+
+FAULTS_MOD = "adam_tpu/resilience/faults.py"
+CHECK_METRICS = "tools/check_metrics.py"
+
+
+def _tuple_of_strs(m: Module, name: str) -> Tuple[Optional[list], int]:
+    for stmt in m.tree.body:
+        if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 and \
+                isinstance(stmt.targets[0], ast.Name) and \
+                stmt.targets[0].id == name and \
+                isinstance(stmt.value, (ast.Tuple, ast.List)):
+            vals = [e.value for e in stmt.value.elts
+                    if isinstance(e, ast.Constant) and
+                    isinstance(e.value, str)]
+            return vals, stmt.lineno
+    return None, 1
+
+
+def registered_sites(repo: Repo) -> Tuple[Optional[list], int]:
+    m = repo.reference(FAULTS_MOD)
+    if m is None:
+        return None, 1
+    return _tuple_of_strs(m, "SITES")
+
+
+def check(repo: Repo) -> Iterable[Finding]:
+    findings: List[Finding] = []
+    sites, _ = registered_sites(repo)
+    if sites is None:
+        return findings
+
+    for m in repo.modules:
+        if m.rel == FAULTS_MOD:
+            continue
+        for node in ast.walk(m.tree):
+            if not (isinstance(node, ast.Call) and node.args):
+                continue
+            d = m.dotted(node.func)
+            if not d or d.split(".")[-1] != "fire":
+                continue
+            r = m.resolve(d) or d
+            if not r.endswith("faults.fire"):
+                continue
+            a0 = node.args[0]
+            if not (isinstance(a0, ast.Constant) and
+                    isinstance(a0.value, str)):
+                continue
+            if a0.value not in sites:
+                findings.append(Finding(
+                    rule=ID, name=NAME, path=m.rel, line=node.lineno,
+                    symbol=f"site:{a0.value}",
+                    message=(f"fault site {a0.value!r} is not in the "
+                             "registered resilience.faults.SITES table "
+                             "— a plan targeting it can never fire and "
+                             "fire() raises once one does"),
+                    hint="register the site in faults.SITES (and the "
+                         "check_metrics mirror), or fix the typo "
+                         f"(registered: {', '.join(sites)})"))
+
+    cm = repo.reference(CHECK_METRICS)
+    if cm is not None:
+        mirror, mline = _tuple_of_strs(cm, "_FAULT_SITES")
+        if mirror is not None and list(mirror) != list(sites):
+            missing = [s for s in sites if s not in mirror]
+            extra = [s for s in mirror if s not in sites]
+            findings.append(Finding(
+                rule=ID, name=NAME, path=CHECK_METRICS, line=mline,
+                symbol="_FAULT_SITES",
+                message=("check_metrics._FAULT_SITES drifted from "
+                         f"faults.SITES (missing: {missing or 'none'}, "
+                         f"extra: {extra or 'none'}) — the validator "
+                         "no longer rejects what the plane rejects"),
+                hint="copy faults.SITES into the _FAULT_SITES literal "
+                     "(kept literal so the validator runs without "
+                     "importing the package)"))
+    return findings

@@ -1,0 +1,163 @@
+"""GL002 jit-memoization: compile constructors only at module scope or
+behind a memoizer.
+
+Originating bug class: the PR 10 warm-path recompile leak —
+``flagstat_wire32_sharded`` rebuilt a fresh ``jax.jit`` wrapper per
+call, so every serve-mode job recompiled kernels the previous job had
+already compiled (jit caches hang off the wrapper OBJECT, not the
+traced function).  The fix was ``functools.lru_cache`` per (mesh,
+donate); this rule keeps the next per-chunk/per-job constructor from
+shipping.
+
+A compile constructor (``jax.jit(...)``, ``pl.pallas_call(...)``) may
+appear:
+
+* at module scope — including decorator position
+  (``@partial(jax.jit, ...)`` executes at import time);
+* inside a function decorated with ``functools.lru_cache`` /
+  ``functools.cache`` (the memoization-helper convention:
+  ``flagstat_wire32_sharded``, ``_build_resharder``,
+  ``_donating_count_fn``...);
+* inside a function that is itself jit-compiled at module scope (a
+  ``pallas_call`` in a kernel body traces once per shape through the
+  module-scope wrapper).
+
+Anywhere else is a per-call wrapper: the jit cache dies with the
+wrapper and the warm path recompiles.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Optional
+
+from ..engine import Finding, FuncInfo, Module, Repo
+
+ID = "GL002"
+NAME = "jit-memoization"
+
+_CONSTRUCTORS = {
+    "jax.jit",
+    "jax.experimental.pallas.pallas_call",
+    "jax.experimental.pallas.tpu.pallas_call",
+}
+_MEMOIZERS = {"functools.lru_cache", "functools.cache"}
+
+
+def _decorated_with(m: Module, fn: FuncInfo, targets: set) -> bool:
+    """True when any decorator is one of *targets*, directly or via
+    ``partial(<target>, ...)``."""
+    for dec in fn.node.decorator_list:
+        d = m.resolve(m.dotted(dec))
+        if d in targets:
+            return True
+        if isinstance(dec, ast.Call):
+            d = m.resolve(m.dotted(dec.func))
+            if d in targets:
+                return True
+            if d == "functools.partial" and dec.args:
+                a0 = m.resolve(m.dotted(dec.args[0]))
+                if a0 in targets:
+                    return True
+    return False
+
+
+def _deco_allowed(m: Module, fn: Optional[FuncInfo]) -> bool:
+    while fn is not None:
+        if _decorated_with(m, fn, _MEMOIZERS):
+            return True
+        if _decorated_with(m, fn, {"jax.jit"}):
+            # the kernel body itself; the module-scope jit wrapper owns
+            # the cache
+            return True
+        fn = fn.parent
+    return False
+
+
+class _CallSites:
+    """Where is each function called from, across the scan set?
+    (modules that file-disable this rule are excluded — their call
+    sites are exempt by declaration)."""
+
+    def __init__(self, repo: Repo):
+        self.sites: dict = {}   # (mod_dotted, leaf) and ("", leaf) keys
+        for m in repo.modules:
+            if ID in m.file_disables:
+                continue
+            for node in ast.walk(m.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                d = m.dotted(node.func)
+                if not d:
+                    continue
+                leaf = d.split(".")[-1]
+                enc = m.enclosing(node)
+                if "." not in d:
+                    self.sites.setdefault((m.rel, leaf),
+                                          []).append((m, enc))
+                    # a bare name may be a cross-module import
+                    # (`from .helper import _h; _h(c)`): also key it
+                    # under the resolved target so of() finds the
+                    # caller from the DEFINING module's side
+                    r = m.resolve(d)
+                    if r and r != d:
+                        self.sites.setdefault(("*", r),
+                                              []).append((m, enc))
+                else:
+                    r = m.resolve(d) or d
+                    self.sites.setdefault(("*", r), []).append((m, enc))
+
+    def of(self, m: Module, fn: FuncInfo) -> list:
+        leaf = fn.qualname.split(".")[-1]
+        mod_dotted = m.rel[:-3].replace("/", ".")
+        if mod_dotted.endswith(".__init__"):
+            # importers say `from pkg import fn`, not pkg.__init__.fn
+            mod_dotted = mod_dotted[: -len(".__init__")]
+        out = list(self.sites.get((m.rel, leaf), []))
+        out += self.sites.get(("*", f"{mod_dotted}.{leaf}"), [])
+        return out
+
+
+def _site_allowed(m: Module, fn: FuncInfo, sites: _CallSites) -> bool:
+    """Allowed by decorator on the enclosing chain, or — for a plain
+    helper — because EVERY call site in the scan set is inside a
+    decorator-allowed function (the ``_blocked_call`` shape: a
+    pallas_call helper only ever invoked from module-scope-jitted
+    wrappers).  Deliberately ONE hop: a chain of plain callers rooted
+    at a module-scope ``main()`` must not bless a per-chunk
+    constructor — that is exactly the warm-path leak."""
+    if _deco_allowed(m, fn):
+        return True
+    callers = sites.of(m, fn)
+    return bool(callers) and all(
+        cfn is not None and _deco_allowed(cm, cfn)
+        for cm, cfn in callers)
+
+
+def check(repo: Repo) -> Iterable[Finding]:
+    findings: List[Finding] = []
+    sites = _CallSites(repo)
+    for m in repo.modules:
+        for node in ast.walk(m.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            t = m.resolve(m.dotted(node.func))
+            if t not in _CONSTRUCTORS:
+                continue
+            fn = m.enclosing(node)
+            if fn is None or _site_allowed(m, fn, sites):
+                continue
+            findings.append(Finding(
+                rule=ID, name=NAME, path=m.rel, line=node.lineno,
+                symbol=fn.qualname,
+                message=(f"{t.split('.')[-1]} constructed inside "
+                         f"{fn.qualname}, which is neither module-scope "
+                         "nor memoized — a fresh wrapper per call "
+                         "recompiles on every warm-path invocation "
+                         "(the PR 10 serve recompile leak)"),
+                hint="decorate the constructor with "
+                     "functools.lru_cache keyed on hashable args "
+                     "(mesh hashes by devices+axes; see "
+                     "ops/flagstat.flagstat_wire32_sharded), or hoist "
+                     "the jit to module scope"))
+    return findings

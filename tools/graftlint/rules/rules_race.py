@@ -1,0 +1,309 @@
+"""GL006 static stage/race detector: module-global state written from
+thread-reachable code without a lock.
+
+Originating bug class: the PR 6 shared-stage-stack race —
+``instrument.stage`` kept one process-global stack, and the moment
+PR 3's feeder threads staged their own work, producer and consumer
+popped each other's frames and mis-nested the whole timing tree.  The
+fix (per-thread contextvar + one tree lock) is the discipline this rule
+enforces everywhere: code reachable from a thread entry point may only
+write module-global mutable state under a lock, through a contextvar,
+or through the internally-locked registry helpers.
+
+Entry points (where concurrency starts), discovered per module:
+
+* ``threading.Thread(target=f, ...)``
+* ``pool.submit(f, ...)`` (ThreadPoolExecutor)
+* callables handed to ``ingest.pipelined`` / ``ingest.prefetched``
+  (the named pools: ``ingest-pool``, ``realign-prep``, device-feed
+  feeder loops, shardstream heartbeats, the serve loop's workers)
+
+From those roots a lightweight call-graph walk (same-module bare names,
+``self.method``, and cross-module ``pkg.mod.fn`` through the import
+map; depth-capped) visits every statically reachable function.  Inside,
+a write to a module-global container or a ``global`` rebind is flagged
+unless an enclosing ``with`` holds a module-level ``threading.Lock`` /
+``RLock`` (or any ``*lock*``-named context).  Contextvars, queues,
+events and semaphores are internally synchronized and exempt.
+
+This is deliberately lightweight (no aliasing, no cross-thread
+happens-before): it catches the shipped bug shape — an unlocked
+read-modify-write on shared module state from a pool thread — and
+leaves provably-safe single-writer cases to a documented baseline
+entry.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from ..engine import Finding, FuncInfo, Module, Repo
+
+ID = "GL006"
+NAME = "stage-race"
+
+_MUTATORS = {"append", "add", "update", "pop", "clear", "setdefault",
+             "extend", "insert", "remove", "discard", "popitem",
+             "appendleft", "sort", "reverse"}
+
+_LOCK_TYPES = {"threading.Lock", "threading.RLock",
+               "threading.Condition", "threading.Semaphore",
+               "threading.BoundedSemaphore"}
+_SAFE_TYPES = {"queue.Queue", "queue.SimpleQueue", "queue.LifoQueue",
+               "queue.PriorityQueue", "collections.deque",
+               "contextvars.ContextVar", "threading.Event",
+               "threading.local"}
+
+_DEPTH_CAP = 12
+
+
+def _root_name(node: ast.AST) -> Optional[str]:
+    while isinstance(node, (ast.Attribute, ast.Subscript)):
+        node = node.value
+    return node.id if isinstance(node, ast.Name) else None
+
+
+class _ModFacts:
+    """Per-module: mutable globals, lock globals, safe globals."""
+
+    def __init__(self, m: Module):
+        self.mutable: Set[str] = set()
+        self.locks: Set[str] = set()
+        self.safe: Set[str] = set()
+        for stmt in m.tree.body:
+            if isinstance(stmt, ast.Assign) and \
+                    len(stmt.targets) == 1 and \
+                    isinstance(stmt.targets[0], ast.Name):
+                name, val = stmt.targets[0].id, stmt.value
+            elif isinstance(stmt, ast.AnnAssign) and \
+                    isinstance(stmt.target, ast.Name) and \
+                    stmt.value is not None:
+                name, val = stmt.target.id, stmt.value
+            else:
+                continue
+            if isinstance(val, (ast.List, ast.Dict, ast.Set,
+                                ast.ListComp, ast.DictComp, ast.SetComp)):
+                self.mutable.add(name)
+            elif isinstance(val, ast.Call):
+                t = m.resolve(m.dotted(val.func)) or ""
+                if t in _LOCK_TYPES:
+                    self.locks.add(name)
+                elif t in _SAFE_TYPES:
+                    self.safe.add(name)
+                elif t in ("dict", "list", "set",
+                           "collections.defaultdict",
+                           "collections.OrderedDict"):
+                    self.mutable.add(name)
+                else:
+                    # any other instance held at module scope is shared
+                    # state too (the PipelineReport tree)
+                    self.mutable.add(name)
+        # names rebound via `global` anywhere count as mutable state
+        for node in ast.walk(m.tree):
+            if isinstance(node, ast.Global):
+                self.mutable.update(n for n in node.names
+                                    if n not in self.locks and
+                                    n not in self.safe)
+
+
+def _under_lock(m: Module, facts: _ModFacts, node: ast.AST) -> bool:
+    """Any enclosing ``with`` whose context mentions a module lock or a
+    ``*lock*``-named attribute (instance locks: ``self._lock``)."""
+    cur = m.parents.get(node)
+    while cur is not None:
+        if isinstance(cur, ast.With):
+            for item in cur.items:
+                for n in ast.walk(item.context_expr):
+                    if isinstance(n, ast.Name) and n.id in facts.locks:
+                        return True
+                    if isinstance(n, ast.Name) and \
+                            "lock" in n.id.lower():
+                        return True
+                    if isinstance(n, ast.Attribute) and \
+                            "lock" in n.attr.lower():
+                        return True
+        cur = m.parents.get(cur)
+    return False
+
+
+def _callable_name(node: ast.AST) -> Optional[str]:
+    """Dotted name of a callable reference (Name / Attribute), else
+    None (lambdas and calls are not chased)."""
+    if isinstance(node, (ast.Name, ast.Attribute)):
+        return Module.dotted(node)
+    return None
+
+
+def _entry_refs(m: Module) -> List[Tuple[str, int]]:
+    """Dotted callable refs handed to a thread/pool in this module."""
+    out: List[Tuple[str, int]] = []
+    for node in ast.walk(m.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        t = m.resolve(m.dotted(node.func)) or ""
+        leaf = t.split(".")[-1]
+        if t == "threading.Thread":
+            for kw in node.keywords:
+                if kw.arg == "target":
+                    n = _callable_name(kw.value)
+                    if n:
+                        out.append((n, node.lineno))
+        elif isinstance(node.func, ast.Attribute) and \
+                node.func.attr == "submit" and node.args:
+            n = _callable_name(node.args[0])
+            if n:
+                out.append((n, node.lineno))
+        elif leaf in ("pipelined", "prefetched") and \
+                ("ingest" in t.split(".") or leaf == t):
+            # fn/prepare/put/on_chunk args run on the reader/feeder/pool
+            for arg in list(node.args[1:]) + \
+                    [kw.value for kw in node.keywords
+                     if kw.arg in ("fn", "prepare", "put", "on_chunk")]:
+                n = _callable_name(arg)
+                if n:
+                    out.append((n, node.lineno))
+    return out
+
+
+class _Graph:
+    """Resolution of function references + call edges across the scan
+    set — bare same-module names, ``self.method``, and dotted
+    ``pkg.mod.fn`` through each module's import map."""
+
+    def __init__(self, repo: Repo):
+        self.repo = repo
+        self.by_dotted_mod: Dict[str, Module] = {}
+        for m in repo.modules:
+            if m.rel.endswith(".py"):
+                dotted = m.rel[:-3].replace("/", ".")
+                if dotted.endswith(".__init__"):
+                    dotted = dotted[:-9]
+                self.by_dotted_mod[dotted] = m
+
+    def resolve_ref(self, m: Module, ref: str
+                    ) -> List[Tuple[Module, FuncInfo]]:
+        """All functions a dotted reference may denote."""
+        out: List[Tuple[Module, FuncInfo]] = []
+        parts = ref.split(".")
+        if parts[0] == "self" and len(parts) == 2:
+            # any same-module method with that name (class-insensitive:
+            # cheap and safe — extra edges only widen the walk)
+            for f in m.functions:
+                if f.qualname.split(".")[-1] == parts[1] and \
+                        f.class_name is not None:
+                    out.append((m, f))
+            return out
+        if len(parts) == 1:
+            for f in m.functions:
+                qn = f.qualname.split(".")
+                if qn[-1] == parts[0]:
+                    out.append((m, f))
+            if out:
+                return out
+            # no same-module match: a bare name may be imported from
+            # another module (`from .state import record;
+            # Thread(target=record)`) — fall through to cross-module
+            # resolution via the import map
+        resolved = m.resolve(ref) or ref
+        rparts = resolved.split(".")
+        for split in range(len(rparts) - 1, 0, -1):
+            mod_dotted = ".".join(rparts[:split])
+            target = self.by_dotted_mod.get(mod_dotted)
+            if target is None:
+                continue
+            tail = rparts[split:]
+            for f in target.functions:
+                qn = f.qualname.split(".")
+                if qn[-len(tail):] == tail:
+                    out.append((target, f))
+            break
+        return out
+
+    def callees(self, m: Module, fn: FuncInfo
+                ) -> List[Tuple[Module, FuncInfo]]:
+        out: List[Tuple[Module, FuncInfo]] = []
+        for node in ast.walk(fn.node):
+            if isinstance(node, ast.Call):
+                ref = _callable_name(node.func)
+                if ref:
+                    out.extend(self.resolve_ref(m, ref))
+        return out
+
+
+def check(repo: Repo) -> Iterable[Finding]:
+    findings: List[Finding] = []
+    facts: Dict[str, _ModFacts] = {m.rel: _ModFacts(m)
+                                   for m in repo.modules}
+    graph = _Graph(repo)
+
+    # -- collect thread-reachable functions --------------------------------
+    roots: List[Tuple[Module, FuncInfo]] = []
+    for m in repo.modules:
+        for ref, _line in _entry_refs(m):
+            roots.extend(graph.resolve_ref(m, ref))
+    seen: Set[Tuple[str, str]] = set()
+    frontier = [(m, f, 0) for m, f in roots]
+    reachable: List[Tuple[Module, FuncInfo]] = []
+    while frontier:
+        m, f, depth = frontier.pop()
+        key = (m.rel, f.qualname)
+        if key in seen or depth > _DEPTH_CAP:
+            continue
+        seen.add(key)
+        reachable.append((m, f))
+        for cm, cf in graph.callees(m, f):
+            frontier.append((cm, cf, depth + 1))
+
+    # -- flag unlocked writes to module-global state -----------------------
+    reported: Set[Tuple[str, str]] = set()
+    for m, fn in reachable:
+        fx = facts.get(m.rel)
+        if fx is None:
+            continue
+        declared_global: Set[str] = set()
+        for node in ast.walk(fn.node):
+            if isinstance(node, ast.Global):
+                declared_global.update(node.names)
+        for node in ast.walk(fn.node):
+            target_name = None
+            verb = None
+            if isinstance(node, (ast.Assign, ast.AugAssign,
+                                 ast.AnnAssign)):
+                targets = node.targets if isinstance(node, ast.Assign) \
+                    else [node.target]
+                for t in targets:
+                    if isinstance(t, (ast.Subscript, ast.Attribute)):
+                        r = _root_name(t)
+                        if r in fx.mutable and r not in fx.safe:
+                            target_name, verb = r, "writes"
+                    elif isinstance(t, ast.Name) and \
+                            t.id in declared_global:
+                        target_name, verb = t.id, "rebinds"
+            elif isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Attribute) and \
+                    node.func.attr in _MUTATORS:
+                r = _root_name(node.func)
+                if r in fx.mutable and r not in fx.safe:
+                    target_name, verb = r, f"mutates ({node.func.attr})"
+            if target_name is None:
+                continue
+            if _under_lock(m, facts[m.rel], node):
+                continue
+            key = (m.rel, f"{fn.qualname}:{target_name}")
+            if key in reported:
+                continue
+            reported.add(key)
+            findings.append(Finding(
+                rule=ID, name=NAME, path=m.rel, line=node.lineno,
+                symbol=f"{fn.qualname}:{target_name}",
+                message=(f"{fn.qualname} {verb} module-global "
+                         f"{target_name} and is reachable from a "
+                         "thread entry point without a lock — an "
+                         "interleaved read-modify-write corrupts it "
+                         "(the PR 6 shared-stage-stack race class)"),
+                hint="guard the write with a module-level "
+                     "threading.Lock (`with _LOCK:`), make the state "
+                     "per-thread (contextvars.ContextVar), or go "
+                     "through the locked registry helpers"))
+    return findings

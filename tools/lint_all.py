@@ -1,0 +1,125 @@
+"""One-command static + artifact validation for the repo.
+
+Runs, in order:
+
+1. **graftlint** — the AST invariant linter over ``adam_tpu/`` +
+   ``tools/`` with the checked-in baseline (docs/STATIC_ANALYSIS.md);
+2. **bench_gate** — the committed BENCH artifacts through their
+   regression gates;
+3. **check_evidence** — the committed evidence ledger
+   (``EVIDENCE_LEDGER.json``), when one exists;
+4. any **sidecar paths passed as arguments**, routed by shape:
+   ``*.trace.json`` -> check_trace, other ``*.json`` -> check_evidence,
+   ``*.jsonl`` -> check_metrics + check_executor + check_resilience.
+
+This is the verify-flow entry: where ``python -m pytest tests/`` checks
+behavior, ``python -m tools.lint_all`` checks the conventions and the
+committed artifacts in one shot — run both before shipping.  Each step
+runs in a subprocess so one validator's crash cannot mask another's
+verdict; exit status is nonzero iff any step failed.
+
+    python -m tools.lint_all [--fast] [SIDECAR ...]
+
+``--fast`` skips bench_gate (it re-derives every gate from the
+committed artifacts, ~10 s of numpy churn) — graftlint + evidence +
+sidecars only.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+from typing import List, Sequence, Tuple
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+LEDGER = os.path.join(ROOT, "EVIDENCE_LEDGER.json")
+
+
+def _has_fault_events(path: str) -> bool:
+    """True when the sidecar records any fault/retry decision —
+    check_resilience treats their absence as a failure, so it only
+    runs on sidecars that have something to replay."""
+    try:
+        with open(path, encoding="utf-8", errors="replace") as f:
+            for ln in f:
+                if "fault_injected" not in ln and "retry_attempt" not in ln:
+                    continue
+                try:
+                    doc = json.loads(ln)
+                except ValueError:
+                    continue
+                if isinstance(doc, dict) and doc.get("event") in (
+                        "fault_injected", "retry_attempt"):
+                    return True
+        return False
+    except OSError:
+        return False
+
+
+def _steps(argv: Sequence[str]) -> List[Tuple[str, List[str]]]:
+    """(label, argv) per step; sidecars are routed by filename shape."""
+    fast = "--fast" in argv
+    # absolute early: the validator subprocesses run with cwd=ROOT, so
+    # a path relative to the INVOKING cwd would resolve differently
+    # here and there
+    paths = [os.path.abspath(a) for a in argv if a != "--fast"]
+    py = sys.executable
+    steps: List[Tuple[str, List[str]]] = [
+        ("graftlint", [py, "-m", "tools.graftlint"]),
+    ]
+    if not fast:
+        steps.append(
+            ("bench_gate", [py, os.path.join(ROOT, "tools",
+                                             "bench_gate.py")]))
+    if os.path.exists(LEDGER):
+        steps.append(
+            ("check_evidence", [py, os.path.join(ROOT, "tools",
+                                                 "check_evidence.py"),
+                                LEDGER]))
+    for p in paths:
+        tool_dir = os.path.join(ROOT, "tools")
+        if p.endswith(".trace.json"):
+            steps.append((f"check_trace {p}",
+                          [py, os.path.join(tool_dir, "check_trace.py"),
+                           p]))
+        elif p.endswith(".json"):
+            steps.append((f"check_evidence {p}",
+                          [py, os.path.join(tool_dir,
+                                            "check_evidence.py"), p]))
+        else:
+            steps.append((f"check_metrics {p}",
+                          [py, os.path.join(tool_dir,
+                                            "check_metrics.py"), p]))
+            steps.append((f"check_executor {p}",
+                          [py, os.path.join(tool_dir,
+                                            "check_executor.py"), p]))
+            # check_resilience requires fault events; only a faulted
+            # run's sidecar can satisfy it
+            if _has_fault_events(p):
+                steps.append((f"check_resilience {p}",
+                              [py, os.path.join(tool_dir,
+                                                "check_resilience.py"),
+                               p]))
+    return steps
+
+
+def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else list(argv)
+    failures: List[str] = []
+    for label, cmd in _steps(argv):
+        print(f"== lint_all: {label}", flush=True)
+        rc = subprocess.call(cmd, cwd=ROOT)
+        if rc != 0:
+            failures.append(f"{label} (exit {rc})")
+    if failures:
+        print(f"lint_all: FAILED — {', '.join(failures)}",
+              file=sys.stderr)
+        return 1
+    print("lint_all: all checks hold")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

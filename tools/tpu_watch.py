@@ -198,8 +198,11 @@ def _save_artifact(repo: str, out_name: str, doc: dict) -> str:
             return "kept"
         if not doc.get("value") and existing.get("value"):
             return "kept"
-    with open(out_path, "w") as f:
-        json.dump(doc, f, indent=1)
+    # atomic_write: this artifact may be git-committed the moment it
+    # lands (_commit_evidence) — a torn write must never publish
+    from adam_tpu.checkpoint import atomic_write
+
+    atomic_write(out_path, json.dumps(doc, indent=1))
     return "saved"
 
 
