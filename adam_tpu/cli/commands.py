@@ -657,9 +657,56 @@ class ServeCommand(Command):
         p.add_argument("-io_procs", type=int, default=1,
                        help="default BGZF inflate worker processes per "
                             "job (a job spec's args.io_procs overrides)")
+        p.add_argument("-hosts", type=int, default=1,
+                       help="fleet-serve worker processes (>1: a "
+                            "cluster scheduler places queued jobs onto "
+                            "N always-warm workers, each a full serve "
+                            "loop; docs/FLEET_SERVE.md)")
+        p.add_argument("-worker_depth", type=int, default=4,
+                       help="fleet mode: max jobs in flight per worker "
+                            "before placement holds them in the front "
+                            "queue (where stealing can still rebalance)")
+        p.add_argument("-max_job_kills", type=int, default=2,
+                       help="fleet mode: worker deaths one job may "
+                            "cause before it is quarantined with a "
+                            "typed failure (the poison-job ladder)")
+        p.add_argument("-shard_rows", type=int, default=0,
+                       help="fleet mode: flagstat inputs at or above "
+                            "this many rows split into per-range "
+                            "sub-jobs across the fleet (0: never shard)")
+        p.add_argument("-no_steal", action="store_true",
+                       help="fleet mode: disable work stealing for "
+                            "idle workers")
         add_executor_args(p)
 
     def run(self, args) -> int:
+        from ..instrument import say
+
+        if args.hosts < 1:
+            print(f"serve: -hosts must be >= 1 (got {args.hosts})",
+                  file=sys.stderr)
+            return 2
+        if args.hosts > 1:
+            from ..serve.scheduler import FleetServeScheduler
+
+            sched = FleetServeScheduler(
+                args.spool, hosts=args.hosts,
+                chunk_rows=args.chunk_rows,
+                max_concurrent=args.max_concurrent,
+                pack=not args.no_pack,
+                pack_segments=args.pack_segments,
+                poll_s=args.poll_s, io_procs=args.io_procs,
+                worker_depth=args.worker_depth,
+                max_job_kills=args.max_job_kills,
+                shard_rows=args.shard_rows, steal=not args.no_steal,
+                executor_opts=executor_opts_from(args))
+            info = sched.boot()
+            say(f"serve: fleet of {info.get('hosts')} always-warm "
+                f"worker(s); spool {args.spool}")
+            n = sched.run(max_jobs=args.max_jobs,
+                          idle_timeout_s=args.idle_timeout)
+            print(f"served {n} job(s) from {args.spool}")
+            return 0
         from ..serve.server import ServeServer
 
         server = ServeServer(
@@ -669,7 +716,6 @@ class ServeCommand(Command):
             poll_s=args.poll_s, io_procs=args.io_procs,
             executor_opts=executor_opts_from(args))
         info = server.boot()
-        from ..instrument import say
         say(f"serve: warm on {info.get('backend')} "
             f"({info.get('n_devices')} device(s)); "
             f"spool {args.spool}")

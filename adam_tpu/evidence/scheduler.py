@@ -61,7 +61,11 @@ STAGE_DEADLINES_S = {"probe": 150.0, "flagstat": 180.0, "transform": 280.0,
                      # serve process + a packed pair); never in the TPU
                      # capture order — reached only via --worker/--only
                      # serve_warm
-                     "serve_warm": 600.0}
+                     "serve_warm": 600.0,
+                     # fleet-serve scaling (two fleets, 1+2 warm worker
+                     # boots, 2K jobs); never in the TPU capture order —
+                     # reached only via --worker/--only fleet_serve
+                     "fleet_serve": 600.0}
 
 TIMEOUTS_ENV = "ADAM_TPU_BENCH_STAGE_TIMEOUTS"
 

@@ -9,6 +9,7 @@ consume.  Header parsing (dictionaries) stays in Python — it is tiny.
 
 from __future__ import annotations
 
+import os
 from typing import Optional, Tuple
 
 import numpy as np
@@ -26,6 +27,41 @@ except ImportError:  # pragma: no cover - toolchain-less environments
 
 def native_available() -> bool:
     return _native is not None
+
+
+def native_unavailable_reason() -> str:
+    """The precise environment-limitation test (the
+    tests/_mp_support.py discipline): non-empty — the reason — ONLY
+    when the native packer failed to load because the built extension
+    artifact targets a different CPython ABI than the running
+    interpreter (e.g. a ``cpython-312`` .so under a 3.10 runtime).
+    Everything else — no artifact built at all, a matching-ABI
+    artifact that still failed to import — returns "" and the caller's
+    test fails with the real cause; the skip is a precise condition,
+    not a blanket."""
+    if _native is not None:
+        return ""
+    import importlib.machinery
+    import sys as _sys
+
+    suffixes = tuple(importlib.machinery.EXTENSION_SUFFIXES)
+    root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    try:
+        names = sorted(os.listdir(root))
+    except OSError:
+        return ""
+    built = [n for n in names
+             if n.startswith("adam_tpu_native.")
+             and n.endswith((".so", ".pyd", ".dylib"))]
+    if not built:
+        return ""               # never built: a real toolchain failure
+    if any(n[len("adam_tpu_native"):] in suffixes for n in built):
+        return ""               # right ABI present yet unloadable: real
+    tag = "cp%d%d" % _sys.version_info[:2]
+    return (f"native packer artifact {built[0]} targets a different "
+            f"CPython ABI than this interpreter ({tag}, expects "
+            f"adam_tpu_native{suffixes[0]})")
 
 
 def bam_to_read_batch(path, *, pad_rows_to: int = 1,

@@ -495,6 +495,13 @@ def _unit_tables(path: str, units: Sequence[int], unit_rows: int,
             break
 
 
+#: public name for the range reader — the fleet-serve scheduler's
+#: ``flagstat_range`` sub-jobs (serve/scheduler.py) walk shard unit
+#: ranges through the exact same row-group-skipping path the shard
+#: fleet's workers use
+unit_tables = _unit_tables
+
+
 # ---------------------------------------------------------------------------
 # worker-side task runtimes (the map functions)
 # ---------------------------------------------------------------------------
@@ -674,13 +681,15 @@ def _task_io(spec: dict) -> Tuple[Optional[List[str]], str, str]:
 # worker
 # ---------------------------------------------------------------------------
 
-class _Heartbeat:
+class Heartbeat:
     """The worker's lease renewal loop: every ``heartbeat_s`` fire the
     ``shard_lease`` fault site, then atomically rewrite the lease file.
     The supervisor reads the file's mtime; a stale lease past the TTL
     is a lost worker.  An injected lease error is treated as fatal FOR
     THIS WORKER (typed stderr line, hard exit) — the fleet layer, not
-    the worker, owns recovery."""
+    the worker, owns recovery.  Shared by the shard fleet's workers and
+    the fleet-serve workers (serve/scheduler.py) — one lease protocol,
+    one fault site, one chaos matrix."""
 
     def __init__(self, path: str, heartbeat_s: float, incarnation: int):
         self.path = path
@@ -691,7 +700,7 @@ class _Heartbeat:
         self._thread = threading.Thread(target=self._run, daemon=True,
                                         name="shard-lease")
 
-    def start(self) -> "_Heartbeat":
+    def start(self) -> "Heartbeat":
         self._beat()                    # lease exists before any work
         self._thread.start()
         return self
@@ -767,7 +776,7 @@ def run_shard_worker(fleet_dir: str, shard: int) -> int:
     obs.registry().gauge("shard_id").set(shard)
     obs.registry().gauge("shard_incarnation").set(my_inc)
 
-    hb = _Heartbeat(
+    hb = Heartbeat(
         os.path.join(fleet_dir, LEASE_DIR, f"shard{shard}.json"),
         float(spec["policy"]["heartbeat_s"]), my_inc).start()
     unit_result, ex = _RUNTIMES[spec["task"]](spec)

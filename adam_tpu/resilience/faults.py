@@ -68,6 +68,12 @@ INCARNATION_ENV = "ADAM_TPU_INCARNATION"
 #: it matches — how the chaos matrix targets one host of a fleet
 SHARD_ENV = "ADAM_TPU_SHARD_ID"
 
+#: stamped by the fleet-serve scheduler (serve/scheduler.py) on each
+#: always-warm worker's env; plan rules with a ``worker`` field only
+#: fire in that worker's process — how the chaos matrix SIGKILLs one
+#: host of a serve fleet while its neighbors keep serving
+WORKER_ENV = "ADAM_TPU_WORKER_ID"
+
 #: the serve front-end's per-job scope (adam_tpu/serve): the server sets
 #: the current tenant around each job's execution, and plan rules with a
 #: ``tenant`` field only fire while that tenant's job runs — how the
@@ -172,6 +178,8 @@ def _canon_rule(i: int, rule: dict) -> dict:
         out["incarnation"] = int(rule["incarnation"])
     if "shard" in rule:
         out["shard"] = int(rule["shard"])
+    if "worker" in rule:
+        out["worker"] = int(rule["worker"])
     if "tenant" in rule:
         out["tenant"] = str(rule["tenant"])
     return out
@@ -253,6 +261,7 @@ def _occ_matches(spec, occurrence: int) -> bool:
 def decide_fault(*, site: str, occurrence: int,
                  incarnation: Optional[int] = None,
                  shard: Optional[int] = None,
+                 worker: Optional[int] = None,
                  tenant: Optional[str] = None,
                  rules: list) -> dict:
     """Whether (and how) this site occurrence fires — PURE.
@@ -261,9 +270,10 @@ def decide_fault(*, site: str, occurrence: int,
     executor ladder's first-fit).  The returned decision carries the
     canonicalized ``inputs`` and their ``input_digest``, the replayable
     contract tools/check_resilience.py verifies.  ``shard`` (the fleet
-    worker's id, from ``ADAM_TPU_SHARD_ID``) and ``tenant`` (the serve
-    front-end's current job scope) join the inputs ONLY when set, so
-    pre-fleet/pre-serve sidecars replay digest-identical.
+    worker's id, from ``ADAM_TPU_SHARD_ID``), ``worker`` (the
+    fleet-serve host's id, from ``ADAM_TPU_WORKER_ID``) and ``tenant``
+    (the serve front-end's current job scope) join the inputs ONLY when
+    set, so pre-fleet/pre-serve sidecars replay digest-identical.
     """
     inputs = dict(site=site, occurrence=int(occurrence),
                   incarnation=None if incarnation is None
@@ -271,6 +281,8 @@ def decide_fault(*, site: str, occurrence: int,
                   rules=[dict(r) for r in rules])
     if shard is not None:
         inputs["shard"] = int(shard)
+    if worker is not None:
+        inputs["worker"] = int(worker)
     if tenant is not None:
         inputs["tenant"] = str(tenant)
     hit = None
@@ -284,6 +296,8 @@ def decide_fault(*, site: str, occurrence: int,
                 rule["incarnation"] != inputs["incarnation"]:
             continue
         if "shard" in rule and rule["shard"] != inputs.get("shard"):
+            continue
+        if "worker" in rule and rule["worker"] != inputs.get("worker"):
             continue
         if "tenant" in rule and rule["tenant"] != inputs.get("tenant"):
             continue
@@ -311,6 +325,14 @@ def _incarnation() -> Optional[int]:
 
 def _shard() -> Optional[int]:
     v = os.environ.get(SHARD_ENV)
+    try:
+        return int(v) if v else None
+    except ValueError:
+        return None
+
+
+def _worker() -> Optional[int]:
+    v = os.environ.get(WORKER_ENV)
     try:
         return int(v) if v else None
     except ValueError:
@@ -358,16 +380,18 @@ def fire(site: str, path: Optional[str] = None) -> None:
     # recorded decision stays bit-for-bit replayable
     inc = _incarnation()
     shard = _shard()
+    worker = _worker()
     tenant = _TENANT
     if not any(_occ_matches(r["occurrence"], occ)
                and ("incarnation" not in r or r["incarnation"] == inc)
                and ("shard" not in r or r["shard"] == shard)
+               and ("worker" not in r or r["worker"] == worker)
                and ("tenant" not in r or r["tenant"] == tenant)
                for r in candidates):
         return
     d = decide_fault(site=site, occurrence=occ,
-                     incarnation=inc, shard=shard, tenant=tenant,
-                     rules=plan["rules"])
+                     incarnation=inc, shard=shard, worker=worker,
+                     tenant=tenant, rules=plan["rules"])
     if not d["fire"]:
         return
     obs.registry().counter("faults_injected", site=site).inc()

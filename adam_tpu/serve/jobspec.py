@@ -43,13 +43,25 @@ QUEUE, RUNNING, DONE, FAILED = "queue", "running", "done", "failed"
 STOP_SENTINEL = "stop"
 SERVING_MARKER = "serving.json"
 
-COMMANDS = ("flagstat", "transform")
+#: which claimed job(s) the server is EXECUTING right now (a claimed
+#: batch sits in ``running/`` while the loop works through it one
+#: entry at a time) — the fleet scheduler's kill-attribution boundary:
+#: a worker death charges only the jobs named here; claimed-but-waiting
+#: jobs requeue innocently (serve/scheduler.py, the poison ladder)
+ACTIVE_MARKER = "active.json"
+
+COMMANDS = ("flagstat", "transform", "flagstat_range")
 
 #: per-command arg whitelists — the spec's ``args`` may set only these
 #: (anything else is a validation error, not a silent drop)
 FLAGSTAT_ARGS = ("io_procs",)
 TRANSFORM_ARGS = ("markdup", "bqsr", "dbsnp_sites", "realign", "sort",
                   "io_procs", "io_threads")
+#: ``flagstat_range`` is the fleet scheduler's shard sub-job (one unit
+#: range of a big input; serve/scheduler.py sums the exact counter
+#: monoid back into the parent's report) — first-class in the spool so
+#: sub-jobs requeue/steal/quarantine through the same machinery
+FLAGSTAT_RANGE_ARGS = ("io_procs", "unit_lo", "unit_hi", "unit_rows")
 
 _ID_RE = re.compile(r"^[A-Za-z0-9._-]{1,80}$")
 _NAME_RE = re.compile(r"^(\d{8,})-(.+)\.json$")
@@ -102,13 +114,31 @@ def canon_spec(spec: dict) -> dict:
     args = spec.get("args") or {}
     if not isinstance(args, dict):
         raise ValueError("job spec: args must be an object")
-    allowed = FLAGSTAT_ARGS if cmd == "flagstat" else TRANSFORM_ARGS
+    allowed = {"flagstat": FLAGSTAT_ARGS, "transform": TRANSFORM_ARGS,
+               "flagstat_range": FLAGSTAT_RANGE_ARGS}[cmd]
     unknown = sorted(set(args) - set(allowed))
     if unknown:
         raise ValueError(f"job spec: unknown {cmd} args {unknown} "
                          f"(allowed: {', '.join(allowed)})")
+    if cmd == "flagstat_range":
+        # the range args are REQUIRED, not merely allowed — a spec
+        # missing them would otherwise detonate inside the serve loop
+        # instead of failing itself at validation time
+        for field in ("unit_lo", "unit_hi", "unit_rows"):
+            v = args.get(field)
+            if not (isinstance(v, int) and not isinstance(v, bool)
+                    and v >= (1 if field == "unit_rows" else 0)):
+                raise ValueError(
+                    f"job spec: flagstat_range needs int arg "
+                    f"{field!r} (got {v!r})")
+    # submit time rides the spec so the server can report queue-wait
+    # per tenant; absent/garbage degrades to "unknown", never an error
+    sub_at = spec.get("submitted_at")
+    sub_at = float(sub_at) if isinstance(sub_at, (int, float)) \
+        and not isinstance(sub_at, bool) else None
     return {"job_id": job_id, "tenant": tenant, "command": cmd,
-            "input": inp, "output": output, "args": dict(args)}
+            "input": inp, "output": output, "args": dict(args),
+            "submitted_at": sub_at}
 
 
 _AUTO_ID_RE = re.compile(r"^job(\d{8,})\.json$")
@@ -220,6 +250,8 @@ def submit_job(spool: str, spec: dict) -> str:
     hint = _read_seq_hint(spool)
     seq = max(hint, _live_max_seq(spool)) if hint is not None \
         else _max_seq(spool)
+    import time as _time
+    spec["submitted_at"] = round(_time.time(), 6)
     while True:
         seq += 1
         job_id = spec["job_id"] or f"job{seq:08d}"
@@ -309,14 +341,22 @@ def write_result(spool: str, spec: dict, *, ok: bool,
                  error: Optional[str] = None,
                  error_type: Optional[str] = None,
                  seconds: Optional[float] = None,
+                 queue_s: Optional[float] = None,
+                 service_s: Optional[float] = None,
                  running_path: Optional[str] = None) -> str:
     """Publish one job's durable result document (atomic tmp+rename)
     and retire its running-claim file.  ``done/`` and ``failed/`` key by
-    job_id — the client polls one well-known name."""
+    job_id — the client polls one well-known name.  ``queue_s`` /
+    ``service_s`` stamp the per-tenant SLO split (submit→start wait and
+    execution wall) into the doc the client reads."""
     doc = {"job_id": spec["job_id"], "tenant": spec["tenant"],
            "command": spec["command"], "ok": bool(ok),
            "seconds": None if seconds is None else round(seconds, 6),
            "result": result or {}}
+    if queue_s is not None:
+        doc["queue_s"] = round(float(queue_s), 6)
+    if service_s is not None:
+        doc["service_s"] = round(float(service_s), 6)
     if error is not None:
         doc["error"] = str(error)[:500]
     if error_type is not None:
@@ -359,6 +399,34 @@ def wait_result(spool: str, job_id: str, timeout_s: float = 60.0,
                 f"no result for job {job_id!r} within {timeout_s}s "
                 f"(is a server running on {spool!r}?)")
         time.sleep(poll_s)
+
+
+def set_active(spool: str, job_ids) -> None:
+    """Publish the executing-job set (atomic; survives a SIGKILL so the
+    fleet scheduler can read it off a corpse).  An empty set clears the
+    marker — between jobs nothing is chargeable."""
+    path = os.path.join(spool, ACTIVE_MARKER)
+    ids = sorted(str(j) for j in job_ids)
+    if not ids:
+        try:
+            os.unlink(path)
+        except OSError:
+            pass
+        return
+    atomic_write(path, json.dumps(ids))
+
+
+def read_active(spool: str) -> list:
+    """The job ids the (possibly dead) server was executing — ``[]``
+    when the marker is absent or unreadable (attribution then errs
+    innocent: a requeue costs a re-run, a wrong quarantine costs a
+    tenant its job)."""
+    try:
+        with open(os.path.join(spool, ACTIVE_MARKER)) as f:
+            doc = json.load(f)
+    except (OSError, ValueError):
+        return []
+    return [str(j) for j in doc] if isinstance(doc, list) else []
 
 
 def request_stop(spool: str) -> None:

@@ -1,0 +1,1206 @@
+"""Fleet serve: the fault-tolerant cluster scheduler over always-warm
+workers.
+
+PR 10's serve plane multiplexes tenants onto ONE warm device; PR 9's
+shard fleet spreads one batch job across worker processes with nobody
+queueing behind it.  This module fuses them: one front-door spool, a
+fleet of always-warm worker processes (each a full
+:class:`~adam_tpu.serve.server.ServeServer` on its own sub-spool, booted
+through ``platform.warm()`` and holding the shared compiled shape
+ladder), and a pure, replayable cluster scheduler that places queued
+jobs — and shards of big jobs via the existing
+``shardstream.decide_shard_plan`` — onto whichever hosts are alive.
+The DrJAX process-granularity MapReduce shape (arXiv:2403.07128)
+applied to a serving loop: placements are the broadcast, each worker's
+warm serve loop is the map, result relay (and the exact-monoid counter
+merge for sharded jobs) is the reduce — with workers as pipeline
+stages, not barrier-synced rounds (arXiv:1908.09291).
+
+Robustness is the core of the design, built on existing machinery:
+
+* **heartbeat leases** — every worker renews a lease file through
+  ``shardstream.Heartbeat`` (the ``shard_lease`` fault site fires at
+  each renewal); the scheduler reads lease mtimes exactly like the
+  shard supervisor: process exit → ``worker_death``, stale lease →
+  ``lease_expiry`` + a SIGKILL fence before any reassignment;
+* **durable requeue** — a lost worker's claimed jobs (its sub-spool
+  ``running/``) and unstarted jobs (``queue/``) move back to the front
+  queue by atomic rename, results the worker committed before dying
+  relay normally, and the spool's monotonic never-recycled ids mean a
+  retried job can never collide with a retired result;
+* **poison-job quarantine** — :func:`decide_requeue` (pure) counts the
+  worker deaths attributed to each *started* job; past ``max_job_kills``
+  the job fails with a typed ``failed/<job>.json`` (``JobQuarantined``)
+  instead of grinding the fleet down worker by worker;
+* **work stealing** — :func:`decide_steal` (pure, the
+  ``decide_shard_speculation`` shape) moves unclaimed queue entries
+  from a backlogged worker to an idle one; moves are atomic renames, a
+  lost race simply skips, and exactly-once results are structural
+  (relay-before-requeue, fence-before-requeue);
+* **graceful drain** — stop lets in-flight jobs finish their round,
+  relays their results, requeues anything unstarted back to the front
+  queue durably, and writes the per-tenant SLO shutdown report.
+
+Every decision follows the ``decide_plan`` convention: PURE, kwonly,
+recorded with canonicalized ``inputs`` + ``input_digest``
+(``placement_selected`` / ``job_requeued`` events, validated by
+tools/check_metrics.py and replayed offline by
+tools/check_executor.py).  Cross-tenant packed dispatch happens *per
+host* — each worker's own ``decide_admission`` round groups the jobs
+placed on it through ``flagstat_kernel_wire32_segmented``, with the
+PR 10 degrade-to-solo path intact per worker.
+
+docs/FLEET_SERVE.md walks the placement/requeue/quarantine protocol and
+the failure-mode table; tests/test_fleet_serve.py pins the chaos
+matrix (SIGKILL any worker mid-job → byte-identical to a one-worker
+oracle; a poison job quarantines while neighbors complete).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import re
+import subprocess
+import sys
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .. import obs
+from ..checkpoint import atomic_write
+from ..resilience import faults
+from ..resilience.retry import (RETRY_SEED_ENV, FleetPolicy,
+                                resolve_fleet_policy)
+from . import jobspec
+
+#: fleet-dir layout (everything lives under ``SPOOL/fleet/``)
+FLEET_DIR = "fleet"
+CONFIG_FILE = "config.json"
+WORKERS_DIR = "workers"
+LEASE_DIR = "leases"
+LOG_DIR = "logs"
+PARTS_DIR = "parts"
+SHARDED_DIR = "sharded"
+
+
+#: sub-job id suffix: ``<parent>.s<k>`` (the spool's id alphabet allows
+#: dots, so sub-jobs are first-class spool citizens — they requeue,
+#: steal and quarantine through the same machinery as whole jobs)
+_SUBJOB_RE = re.compile(r"^(.+)\.s(\d+)$")
+
+
+class JobQuarantined(RuntimeError):
+    """A job was quarantined after killing its worker budget — the
+    typed failure the poison ladder writes instead of grinding the
+    fleet down (its name lands in ``failed/<job>.json``'s
+    ``error_type``)."""
+
+
+# ---------------------------------------------------------------------------
+# the pure decisions
+# ---------------------------------------------------------------------------
+
+def _digest(inputs: dict) -> str:
+    return hashlib.sha256(
+        json.dumps(inputs, sort_keys=True).encode()).hexdigest()[:16]
+
+
+def decide_placement(*, queued: Sequence[dict], workers: Sequence[dict],
+                     depth: int) -> dict:
+    """One scheduler round's placements — PURE.
+
+    ``queued``: front-queue descriptors ``{"job_id", "tenant",
+    "command", "seq"}`` (any order; canonicalization sorts by ``seq``).
+    ``workers``: ``{"worker", "inflight", "alive"}`` snapshots
+    (``inflight`` = queued + running at that host).  FIFO by submit
+    order onto the least-loaded alive worker (ties → lowest id), at
+    most ``depth`` jobs in flight per worker — jobs past every host's
+    depth stay in the front queue (where stealing and later rounds can
+    still reorder them onto whoever drains first).  Returns::
+
+        {"place": [[job_id, worker], ...], "reason": str,
+         "inputs": {...}, "input_digest": hex}
+
+    Recorded in full by ``placement_selected``;
+    tools/check_executor.py replays the decision offline.
+    """
+    canon_q = sorted((dict(job_id=str(q["job_id"]),
+                           tenant=str(q["tenant"]),
+                           command=str(q["command"]), seq=int(q["seq"]))
+                      for q in queued), key=lambda q: q["seq"])
+    canon_w = sorted((dict(worker=int(w["worker"]),
+                           inflight=int(w["inflight"]),
+                           alive=bool(w["alive"]))
+                      for w in workers), key=lambda w: w["worker"])
+    inputs = dict(queued=canon_q, workers=canon_w, depth=int(depth))
+    load = {w["worker"]: w["inflight"] for w in canon_w if w["alive"]}
+    place: List[List] = []
+    for q in canon_q:
+        if not load:
+            break
+        w = min(load, key=lambda k: (load[k], k))
+        if load[w] >= inputs["depth"]:
+            break               # every alive worker is at depth
+        place.append([q["job_id"], w])
+        load[w] += 1
+    reason = (f"fifo {len(place)}/{len(canon_q)} queued onto "
+              f"{len(load)} worker(s) at depth {inputs['depth']}")
+    return dict(place=place, reason=reason, inputs=inputs,
+                input_digest=_digest(inputs))
+
+
+def decide_requeue(*, job_id: str, tenant: str, cause: str, kills: int,
+                   max_kills: int, started: bool) -> dict:
+    """One orphaned job's next action after its worker was lost — PURE.
+
+    ``kills`` counts the worker deaths attributed to this job so far
+    (a death is attributed only when the job was *started* — sitting
+    claimed in the dead worker's ``running/``; unstarted queue entries
+    ride along innocently).  ``action`` is ``requeue`` (back to the
+    front queue, durably) or ``quarantine`` (the poison ladder: a job
+    that has killed ``max_kills`` workers fails with a typed
+    ``failed/<job>.json`` instead of being handed a fresh victim).
+    Recorded in full by ``job_requeued``; tools/check_executor.py
+    replays it.
+    """
+    inputs = dict(job_id=str(job_id), tenant=str(tenant),
+                  cause=str(cause), kills=int(kills),
+                  max_kills=int(max_kills), started=bool(started))
+    if inputs["started"] and inputs["kills"] >= inputs["max_kills"]:
+        action = "quarantine"
+        reason = (f"{inputs['cause']}: killed {inputs['kills']} "
+                  f"worker(s) >= budget {inputs['max_kills']} — poison")
+    else:
+        action = "requeue"
+        reason = (f"{inputs['cause']}: requeue "
+                  f"({inputs['kills']}/{inputs['max_kills']} "
+                  "kill(s) attributed)")
+    return dict(action=action, reason=reason, inputs=inputs,
+                input_digest=_digest(inputs))
+
+
+def decide_steal(*, stealable: Sequence[dict],
+                 idle: Sequence[int]) -> dict:
+    """Whether idle hosts steal queued work from backlogged ones — PURE
+    (the ``decide_shard_speculation`` shape: a drained host volunteers,
+    the decision hands it the other end of someone's backlog).
+
+    ``stealable``: unclaimed queue entries at busy workers with at
+    least TWO jobs in flight — a 1-deep host never donates, since
+    moving its only job to an empty neighbor swaps the imbalance
+    instead of reducing it (``{"job_id", "worker", "seq"}`` —
+    unit-granular, since sharded jobs' range sub-jobs are ordinary
+    queue entries).  Each idle worker
+    gets at most one steal per decision (gradual rebalance): the
+    earliest-seq entry from the most-backlogged donor (ties → lowest
+    donor id).  Moves are atomic renames at the call site — a donor
+    that claims the job first wins the race and the move is skipped,
+    never duplicated.  Recorded by ``job_requeued`` (cause ``steal``).
+    """
+    canon_s = sorted((dict(job_id=str(s["job_id"]),
+                           worker=int(s["worker"]), seq=int(s["seq"]))
+                      for s in stealable), key=lambda s: s["seq"])
+    inputs = dict(stealable=canon_s,
+                  idle=sorted(int(i) for i in idle))
+    moves: List[List] = []
+    taken: set = set()
+    for w in inputs["idle"]:
+        cands = [s for s in canon_s
+                 if s["job_id"] not in taken and s["worker"] != w]
+        if not cands:
+            break
+        donors: Dict[int, int] = {}
+        for s in cands:
+            donors[s["worker"]] = donors.get(s["worker"], 0) + 1
+        donor = max(donors, key=lambda k: (donors[k], -k))
+        s = next(s for s in cands if s["worker"] == donor)
+        moves.append([s["job_id"], donor, w])
+        taken.add(s["job_id"])
+    out = dict(action="steal" if moves else "none", moves=moves,
+               reason=(f"{len(moves)} unit(s) to "
+                       f"{len(inputs['idle'])} idle worker(s)"
+                       if moves else "nothing-stealable"),
+               inputs=inputs, input_digest=_digest(inputs))
+    return out
+
+
+def _emit_placement(d: dict, **extra) -> None:
+    obs.registry().counter("fleet_placements").inc(len(d["place"]))
+    obs.emit("placement_selected", place=d["place"], reason=d["reason"],
+             inputs=d["inputs"], input_digest=d["input_digest"], **extra)
+
+
+def _emit_requeued(cause: str, d: dict, **extra) -> None:
+    obs.registry().counter("fleet_requeues", action=d["action"]).inc()
+    fields = dict(cause=cause, action=d["action"], reason=d["reason"],
+                  inputs=d["inputs"], input_digest=d["input_digest"])
+    if cause == "steal":
+        fields["moves"] = d["moves"]
+    else:
+        fields["job_id"] = d["inputs"]["job_id"]
+    fields.update(extra)
+    obs.emit("job_requeued", **fields)
+
+
+# ---------------------------------------------------------------------------
+# range execution (the sharded-big-job map function, run by workers)
+# ---------------------------------------------------------------------------
+
+def range_flagstat_counts(path: str, *, unit_lo: int, unit_hi: int,
+                          unit_rows: int, io_procs: int = 1
+                          ) -> Tuple[np.ndarray, int]:
+    """The 18x2 flagstat counter block for global units
+    ``[unit_lo, unit_hi)`` of ``path`` — the shard fleet's flagstat map
+    function (``shardstream._flagstat_runtime``: pad to the canonical
+    rung, retry/split/CPU-degrade per unit) re-used inside a warm serve
+    worker.  Parquet inputs read only the overlapping row groups;
+    counters are an exact integer monoid, so the scheduler's sum over
+    sub-jobs is byte-identical to one solo pass."""
+    from ..io.dispatch import FLAGSTAT_COLUMNS
+    from ..parallel import shardstream
+
+    unit_result, ex = shardstream._flagstat_runtime(
+        {"unit_rows": int(unit_rows)})
+    total = np.zeros((18, 2), np.int64)
+    rows = 0
+    try:
+        for unit, table in shardstream.unit_tables(
+                path, list(range(int(unit_lo), int(unit_hi))),
+                int(unit_rows), list(FLAGSTAT_COLUMNS), "decoded",
+                "flagstat", io_procs=int(io_procs)):
+            total += unit_result(unit, table)["counts"]
+            rows += table.num_rows
+    finally:
+        ex.finish()
+    return total, rows
+
+
+# ---------------------------------------------------------------------------
+# worker entry (``python -m adam_tpu.serve.scheduler --worker FLEET W``)
+# ---------------------------------------------------------------------------
+
+def _read_json(path: str) -> Optional[dict]:
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, ValueError):
+        return None
+    return doc if isinstance(doc, dict) else None
+
+
+def worker_spool(fleet_dir: str, worker: int) -> str:
+    return os.path.join(fleet_dir, WORKERS_DIR, f"w{worker}", "spool")
+
+
+def _lease_path(fleet_dir: str, worker: int) -> str:
+    return os.path.join(fleet_dir, LEASE_DIR, f"w{worker}.json")
+
+
+def worker_main(argv: Optional[List[str]] = None) -> int:
+    """One fleet-serve worker: heartbeat a lease, warm the backend once,
+    and run a full :class:`ServeServer` loop over this worker's private
+    sub-spool until the scheduler writes the stop sentinel (or the
+    scheduler itself disappears — an orphaned warm jax process must not
+    leak)."""
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if argv and argv[0] == "--worker":
+        argv = argv[1:]
+    if len(argv) != 2:
+        print("usage: python -m adam_tpu.serve.scheduler --worker "
+              "FLEET_DIR WORKER_ID", file=sys.stderr)
+        return 2
+    fleet_dir, worker = argv[0], int(argv[1])
+    from ..platform import honor_platform_env
+    honor_platform_env()
+    try:
+        faults.install_from_env()
+    except (OSError, ValueError) as e:
+        print(f"serve-worker: bad fault plan: {e}", file=sys.stderr)
+        return 2
+    cfg = _read_json(os.path.join(fleet_dir, CONFIG_FILE)) or {}
+    wspool = worker_spool(fleet_dir, worker)
+    inc = 0
+    try:
+        inc = int(os.environ.get(faults.INCARNATION_ENV) or 0)
+    except ValueError:
+        pass
+    from ..parallel.shardstream import Heartbeat
+    from .server import ServeServer
+
+    # the lease exists before the expensive warm boot: the scheduler
+    # judges a booting worker by its heartbeats, not a boot-grace guess
+    hb = Heartbeat(_lease_path(fleet_dir, worker),
+                   float(cfg.get("heartbeat_s", 1.0)), inc).start()
+    try:
+        with obs.metrics_run_from_env(
+                argv=["serve-worker", fleet_dir, str(worker)],
+                config=dict(fleet_dir=fleet_dir, worker=worker,
+                            incarnation=inc),
+                command="serve-worker"):
+            srv = ServeServer(
+                wspool, chunk_rows=int(cfg.get("chunk_rows", 1 << 22)),
+                max_concurrent=int(cfg.get("max_concurrent", 4)),
+                pack=bool(cfg.get("pack", True)),
+                pack_segments=int(cfg.get("pack_segments", 8)),
+                poll_s=float(cfg.get("poll_s", 0.05)),
+                io_procs=int(cfg.get("io_procs", 1)),
+                executor_opts=cfg.get("executor_opts") or {},
+                slo_report=False)
+            sched_pid = int(cfg.get("scheduler_pid") or 0)
+            while not jobspec.stop_requested(wspool):
+                # short idle re-entries so the orphan check runs even
+                # when no jobs arrive (boot() is idempotent)
+                srv.run(idle_timeout_s=2.0)
+                if jobspec.stop_requested(wspool):
+                    break
+                if sched_pid:
+                    try:
+                        os.kill(sched_pid, 0)
+                    except OSError:
+                        sys.stderr.write(
+                            "serve-worker: scheduler gone — exiting "
+                            "orphaned loop\n")
+                        break
+            return 0
+    except faults.InjectedFault as e:
+        print(f"serve-worker: {type(e).__name__}: {e}", file=sys.stderr)
+        return 3
+    finally:
+        hb.stop()
+
+
+# ---------------------------------------------------------------------------
+# the scheduler
+# ---------------------------------------------------------------------------
+
+class _WorkerState:
+    def __init__(self, worker: int):
+        self.worker = worker
+        self.incarnation = 0
+        self.restarts = 0
+        self.proc: Optional[subprocess.Popen] = None
+        self.spawned_at = 0.0
+        self.closed = False
+
+
+def _repo_root() -> str:
+    import adam_tpu
+    return os.path.dirname(os.path.dirname(
+        os.path.abspath(adam_tpu.__file__)))
+
+
+class FleetServeScheduler:
+    """The fleet-serve control plane: spawn always-warm workers, place
+    queued jobs, watch leases, fence + requeue + quarantine, steal for
+    idle hosts, merge sharded jobs, relay results, drain cleanly."""
+
+    def __init__(self, spool: str, *, hosts: int,
+                 chunk_rows: int = 1 << 22, max_concurrent: int = 4,
+                 pack: bool = True, pack_segments: int = 8,
+                 poll_s: float = 0.05, io_procs: int = 1,
+                 worker_depth: int = 4, max_job_kills: int = 2,
+                 shard_rows: int = 0, steal: bool = True,
+                 policy: Optional[FleetPolicy] = None,
+                 env: Optional[dict] = None,
+                 executor_opts: Optional[dict] = None,
+                 boot_grace_s: float = 60.0,
+                 drain_timeout_s: float = 60.0):
+        self.spool = jobspec.ensure_spool(spool)
+        self.fleet_dir = os.path.join(spool, FLEET_DIR)
+        self.hosts = max(int(hosts), 1)
+        self.chunk_rows = int(chunk_rows)
+        self.max_concurrent = max(int(max_concurrent), 1)
+        self.pack = bool(pack)
+        self.pack_segments = max(int(pack_segments), 2)
+        self.poll_s = float(poll_s)
+        self.io_procs = int(io_procs)
+        self.worker_depth = max(int(worker_depth), 1)
+        self.max_job_kills = max(int(max_job_kills), 1)
+        self.shard_rows = int(shard_rows)
+        self.steal = bool(steal)
+        self.policy = policy or resolve_fleet_policy()
+        self.env = dict(env if env is not None else os.environ)
+        self.executor_opts = dict(executor_opts or {})
+        self.boot_grace_s = max(boot_grace_s, self.policy.lease_ttl_s)
+        self.drain_timeout_s = float(drain_timeout_s)
+        self.states: Dict[int, _WorkerState] = {}
+        self.jobs_served = 0
+        self.kills: Dict[str, int] = {}
+        #: parent job_id -> {"spec", "claim", "parts": {sub_id: doc|None}}
+        self._shards: Dict[str, dict] = {}
+        #: parents already finished (a FAILED parent can leave straggler
+        #: sub-jobs running on healthy workers — their late results must
+        #: drop, never relay as client-visible docs or count as served)
+        self._retired_parents: set = set()
+        self._row_counts: Dict[str, int] = {}
+        self._slo: Dict[str, Dict[str, List[float]]] = {}
+        self._last_placement_digest: Optional[str] = None
+        self._booted = False
+
+    # -- boot ---------------------------------------------------------------
+
+    def boot(self) -> dict:
+        if self._booted:
+            return {}
+        for d in (WORKERS_DIR, LEASE_DIR, LOG_DIR, PARTS_DIR,
+                  SHARDED_DIR):
+            os.makedirs(os.path.join(self.fleet_dir, d), exist_ok=True)
+        requeued = jobspec.requeue_running(self.spool)
+        requeued += self._recover_previous_fleet()
+        atomic_write(os.path.join(self.fleet_dir, CONFIG_FILE),
+                     json.dumps(dict(
+                         chunk_rows=self.chunk_rows,
+                         max_concurrent=self.max_concurrent,
+                         pack=self.pack,
+                         pack_segments=self.pack_segments,
+                         poll_s=self.poll_s, io_procs=self.io_procs,
+                         executor_opts=self.executor_opts,
+                         heartbeat_s=self.policy.heartbeat_s,
+                         scheduler_pid=os.getpid()), sort_keys=True))
+        for w in range(self.hosts):
+            st = _WorkerState(w)
+            self.states[w] = st
+            self._spawn(st)
+        obs.emit("serve_boot", hosts=self.hosts, requeued=requeued,
+                 worker_depth=self.worker_depth,
+                 shard_rows=self.shard_rows)
+        atomic_write(os.path.join(self.spool, jobspec.SERVING_MARKER),
+                     json.dumps(dict(pid=os.getpid(), hosts=self.hosts,
+                                     requeued=requeued),
+                                sort_keys=True))
+        self._booted = True
+        return dict(hosts=self.hosts, requeued=requeued)
+
+    def _recover_previous_fleet(self) -> int:
+        """A crashed scheduler leaves jobs scattered across worker
+        sub-spools and half-merged shard parents — move every one of
+        them back to the front queue (results a dead fleet committed
+        relay as-is; sharded parents re-run whole, their orphaned
+        sub-jobs and part results are dropped)."""
+        n = 0
+        wroot = os.path.join(self.fleet_dir, WORKERS_DIR)
+        parents: List[str] = []
+        sdir = os.path.join(self.fleet_dir, SHARDED_DIR)
+        for name in sorted(os.listdir(sdir) if os.path.isdir(sdir)
+                           else []):
+            if not jobspec._NAME_RE.match(name):
+                continue
+            try:
+                os.rename(os.path.join(sdir, name),
+                          os.path.join(self.spool, jobspec.QUEUE, name))
+                parents.append(jobspec._NAME_RE.match(name).group(2))
+                n += 1
+            except OSError:
+                pass
+
+        def _orphan_sub(job_id: str) -> bool:
+            m = _SUBJOB_RE.match(job_id)
+            return bool(m and m.group(1) in parents)
+
+        for wname in sorted(os.listdir(wroot) if os.path.isdir(wroot)
+                            else []):
+            ws = os.path.join(wroot, wname, "spool")
+            for sub in (jobspec.QUEUE, jobspec.RUNNING):
+                d = os.path.join(ws, sub)
+                for name in sorted(os.listdir(d)
+                                   if os.path.isdir(d) else []):
+                    m = jobspec._NAME_RE.match(name)
+                    if not m:
+                        continue
+                    src = os.path.join(d, name)
+                    if _orphan_sub(m.group(2)):
+                        try:
+                            os.unlink(src)
+                        except OSError:
+                            pass
+                        continue
+                    try:
+                        os.rename(src, os.path.join(
+                            self.spool, jobspec.QUEUE, name))
+                        n += 1
+                    except OSError:
+                        pass
+            for sub in (jobspec.DONE, jobspec.FAILED):
+                d = os.path.join(ws, sub)
+                for name in sorted(os.listdir(d)
+                                   if os.path.isdir(d) else []):
+                    job_id = name[:-5] if name.endswith(".json") else name
+                    src = os.path.join(d, name)
+                    if _orphan_sub(job_id) or jobspec.read_result(
+                            self.spool, job_id) is not None:
+                        try:
+                            os.unlink(src)
+                        except OSError:
+                            pass
+                        continue
+                    try:
+                        os.rename(src, os.path.join(self.spool, sub,
+                                                    name))
+                    except OSError:
+                        pass
+            # a dead fleet's stop sentinel must not stop the new one
+            try:
+                os.unlink(os.path.join(ws, jobspec.STOP_SENTINEL))
+            except OSError:
+                pass
+        # drop stale part results (their parents re-run whole)
+        pdir = os.path.join(self.fleet_dir, PARTS_DIR)
+        for root, _, names in os.walk(pdir):
+            for name in names:
+                try:
+                    os.unlink(os.path.join(root, name))
+                except OSError:
+                    pass
+        return n
+
+    # -- spawn / env --------------------------------------------------------
+
+    def _worker_env(self, worker: int, incarnation: int) -> dict:
+        wenv = dict(self.env)
+        wenv[obs.METRICS_ENV] = os.path.join(
+            self.fleet_dir, LOG_DIR,
+            f"w{worker}-inc{incarnation}.metrics.jsonl")
+        wenv[faults.INCARNATION_ENV] = str(incarnation)
+        wenv[faults.WORKER_ENV] = str(worker)
+        base = 0
+        try:
+            base = int(self.env.get(RETRY_SEED_ENV) or 0)
+        except ValueError:
+            pass
+        wenv[RETRY_SEED_ENV] = str(base + 1000 * (worker + 1))
+        root = _repo_root()
+        wenv["PYTHONPATH"] = root + os.pathsep + \
+            wenv.get("PYTHONPATH", "")
+        return wenv
+
+    def _spawn(self, st: _WorkerState) -> None:
+        # drop the previous incarnation's lease: a respawn must get the
+        # boot grace, then live on its OWN heartbeats (the shardstream
+        # supervisor's discipline)
+        try:
+            os.unlink(_lease_path(self.fleet_dir, st.worker))
+        except OSError:
+            pass
+        jobspec.ensure_spool(worker_spool(self.fleet_dir, st.worker))
+        for stale in (jobspec.STOP_SENTINEL, jobspec.ACTIVE_MARKER):
+            try:
+                os.unlink(os.path.join(
+                    worker_spool(self.fleet_dir, st.worker), stale))
+            except OSError:
+                pass
+        log_path = os.path.join(
+            self.fleet_dir, LOG_DIR,
+            f"w{st.worker}-inc{st.incarnation}.log")
+        argv = [sys.executable, "-m", "adam_tpu.serve.scheduler",
+                "--worker", self.fleet_dir, str(st.worker)]
+        with open(log_path, "w") as log:
+            st.proc = subprocess.Popen(
+                argv, stdout=log, stderr=subprocess.STDOUT,
+                env=self._worker_env(st.worker, st.incarnation))
+        st.spawned_at = time.monotonic()
+        obs.registry().counter("fleet_worker_spawns").inc()
+
+    # -- snapshots ----------------------------------------------------------
+
+    def _listdir(self, path: str) -> List[str]:
+        try:
+            return sorted(os.listdir(path))
+        except OSError:
+            return []
+
+    def _worker_inflight(self, worker: int) -> Tuple[List[str],
+                                                     List[str]]:
+        ws = worker_spool(self.fleet_dir, worker)
+        q = [n for n in self._listdir(os.path.join(ws, jobspec.QUEUE))
+             if jobspec._NAME_RE.match(n)]
+        r = [n for n in self._listdir(os.path.join(ws, jobspec.RUNNING))
+             if jobspec._NAME_RE.match(n)]
+        return q, r
+
+    def _alive(self, st: _WorkerState) -> bool:
+        return (not st.closed and st.proc is not None
+                and st.proc.poll() is None)
+
+    # -- placement ----------------------------------------------------------
+
+    def _front_queue(self) -> List[Tuple[int, str, dict]]:
+        """Canonicalized front-queue snapshot; hand-tampered bad specs
+        fail themselves (the server loop's discipline), never the
+        scheduler."""
+        out = []
+        for seq, path, spec in jobspec.iter_queue(self.spool):
+            try:
+                canon = jobspec.canon_spec(spec)
+            except ValueError as e:
+                canon = {"job_id": os.path.basename(path)[9:-5],
+                         "tenant": "default",
+                         "command": str(spec.get("command")),
+                         "input": "", "output": None, "args": {},
+                         "submitted_at": None}
+                claimed = jobspec.claim_job(self.spool, path)
+                jobspec.write_result(
+                    self.spool, canon, ok=False, error=str(e),
+                    error_type="ValueError", running_path=claimed)
+                continue
+            canon["seq"] = seq
+            out.append((seq, path, canon))
+        return out
+
+    def _input_rows(self, path: str) -> Optional[int]:
+        """Row count for shard-eligibility (cached per input; the
+        scheduler pays it once, workers never)."""
+        if path in self._row_counts:
+            return self._row_counts[path]
+        try:
+            from ..parallel.shardstream import count_input_rows
+            n = int(count_input_rows(path))
+        except Exception:  # noqa: BLE001 — sizing is a hint, not fatal
+            n = -1
+        self._row_counts[path] = n
+        return n
+
+    def _maybe_shard(self, seq: int, path: str, canon: dict,
+                     alive: int) -> bool:
+        """Expand one big flagstat job into per-range sub-jobs via the
+        existing pure ``decide_shard_plan`` (event
+        ``shard_plan_selected``).  The parent's queue file moves to
+        ``fleet/sharded/`` (the durable in-flight claim a crashed
+        scheduler requeues from); sub-jobs submit as first-class spool
+        jobs and place like any other."""
+        if (self.shard_rows <= 0 or alive < 2
+                or canon["command"] != "flagstat"
+                or _SUBJOB_RE.match(canon["job_id"])):
+            return False
+        rows = self._input_rows(canon["input"])
+        if rows is None or rows < max(self.shard_rows, 2):
+            return False
+        from ..parallel.shardstream import decide_shard_plan
+
+        unit_rows = max(-(-rows // (2 * alive)), 256)
+        n_units = max(-(-rows // unit_rows), 1)
+        plan = decide_shard_plan(n_units=n_units, n_hosts=alive,
+                                 unit_rows=unit_rows, total_rows=rows,
+                                 unit_bins=None)
+        # the reason goes out VERBATIM — check_executor replays the
+        # decision from its inputs and compares it; the fleet-serve
+        # context rides a separate field instead of tainting the replay
+        obs.emit("shard_plan_selected", n_hosts=plan["n_hosts"],
+                 n_units=plan["n_units"], unit_rows=plan["unit_rows"],
+                 assignments=plan["assignments"],
+                 reason=plan["reason"], source="fleet-serve",
+                 inputs=plan["inputs"],
+                 input_digest=plan["input_digest"])
+        claim = os.path.join(self.fleet_dir, SHARDED_DIR,
+                             os.path.basename(path))
+        try:
+            os.rename(path, claim)
+        except OSError:
+            return False        # raced away (shouldn't happen: one
+        #                         scheduler owns the front queue)
+        parts: Dict[str, Optional[dict]] = {}
+        for k, (lo, hi) in enumerate(plan["assignments"]):
+            if hi <= lo:
+                continue
+            sub_id = f"{canon['job_id']}.s{k}"
+            jobspec.submit_job(self.spool, {
+                "job_id": sub_id, "tenant": canon["tenant"],
+                "command": "flagstat_range", "input": canon["input"],
+                "output": None,
+                "args": {"unit_lo": int(lo), "unit_hi": int(hi),
+                         "unit_rows": int(plan["unit_rows"]),
+                         **({"io_procs": canon["args"]["io_procs"]}
+                            if "io_procs" in canon["args"] else {})}})
+            parts[sub_id] = None
+        self._shards[canon["job_id"]] = dict(spec=canon, claim=claim,
+                                             parts=parts)
+        obs.registry().counter("fleet_jobs_sharded").inc()
+        return True
+
+    def _place_round(self) -> int:
+        queued = self._front_queue()
+        if not queued:
+            return 0
+        alive = sum(1 for st in self.states.values()
+                    if self._alive(st))
+        if alive and self.shard_rows > 0:
+            remaining = []
+            for seq, path, canon in queued:
+                if not self._maybe_shard(seq, path, canon, alive):
+                    remaining.append((seq, path, canon))
+            if len(remaining) != len(queued):
+                # sub-jobs just joined the queue: re-snapshot so they
+                # place this round
+                queued = self._front_queue()
+            else:
+                queued = remaining
+        if not queued:
+            return 0
+        workers = []
+        for w, st in sorted(self.states.items()):
+            q, r = self._worker_inflight(w)
+            workers.append(dict(worker=w, inflight=len(q) + len(r),
+                                alive=self._alive(st)))
+        d = decide_placement(
+            queued=[dict(job_id=c["job_id"], tenant=c["tenant"],
+                         command=c["command"], seq=c["seq"])
+                    for _, _, c in queued],
+            workers=workers, depth=self.worker_depth)
+        if not d["place"]:
+            return 0
+        # an unchanged queue/worker snapshot re-derives the identical
+        # decision — emitting it again would only bloat the sidecar
+        if d["input_digest"] != self._last_placement_digest:
+            _emit_placement(d)
+            self._last_placement_digest = d["input_digest"]
+        by_id = {c["job_id"]: (path, c) for _, path, c in queued}
+        placed = 0
+        for job_id, w in d["place"]:
+            path, _canon = by_id[job_id]
+            dest = os.path.join(worker_spool(self.fleet_dir, w),
+                                jobspec.QUEUE, os.path.basename(path))
+            try:
+                os.rename(path, dest)
+                placed += 1
+            except OSError:
+                continue
+        return placed
+
+    # -- result relay + shard merge -----------------------------------------
+
+    def _observe_slo(self, doc: dict) -> None:
+        from .server import slo_observe
+        slo_observe(self._slo, doc.get("tenant") or "default",
+                    doc.get("queue_s"), doc.get("service_s"))
+
+    def _relay_results(self) -> int:
+        done = 0
+        for w in sorted(self.states):
+            done += self._relay_worker(w)
+        done += self._merge_ready_shards()
+        return done
+
+    def _relay_worker(self, worker: int) -> int:
+        ws = worker_spool(self.fleet_dir, worker)
+        done = 0
+        for sub in (jobspec.DONE, jobspec.FAILED):
+            d = os.path.join(ws, sub)
+            for name in self._listdir(d):
+                if not name.endswith(".json"):
+                    continue
+                job_id = name[:-5]
+                src = os.path.join(d, name)
+                m = _SUBJOB_RE.match(job_id)
+                if m and m.group(1) in self._shards:
+                    self._collect_part(m.group(1), job_id, src)
+                    continue
+                if m and m.group(1) in self._retired_parents:
+                    # a straggler of an already-failed parent: its
+                    # result has nowhere to merge and must not surface
+                    # as a client-visible doc (or consume a max_jobs
+                    # slot)
+                    try:
+                        os.unlink(src)
+                    except OSError:
+                        pass
+                    continue
+                if jobspec.read_result(self.spool, job_id) is not None:
+                    # already served (a requeue/steal race duplicate):
+                    # the first durable result wins, extras drop
+                    try:
+                        os.unlink(src)
+                    except OSError:
+                        pass
+                    continue
+                try:
+                    os.rename(src, os.path.join(self.spool, sub, name))
+                except OSError:
+                    continue
+                doc = jobspec.read_result(self.spool, job_id) or {}
+                self._observe_slo(doc)
+                self.kills.pop(job_id, None)
+                self.jobs_served += 1
+                done += 1
+        return done
+
+    def _collect_part(self, parent: str, sub_id: str, src: str) -> None:
+        pdir = os.path.join(self.fleet_dir, PARTS_DIR, parent)
+        os.makedirs(pdir, exist_ok=True)
+        dest = os.path.join(pdir, f"{sub_id}.json")
+        try:
+            os.rename(src, dest)
+        except OSError:
+            return
+        doc = _read_json(dest)
+        state = self._shards.get(parent)
+        if state is None or doc is None:
+            return
+        if sub_id in state["parts"]:
+            state["parts"][sub_id] = doc
+
+    def _merge_ready_shards(self) -> int:
+        done = 0
+        for parent in list(self._shards):
+            state = self._shards[parent]
+            parts = state["parts"]
+            docs = [doc for doc in parts.values() if doc is not None]
+            failed = [doc for doc in docs if not doc.get("ok")]
+            if failed:
+                doc = failed[0]
+                self._finish_shard(
+                    parent, ok=False,
+                    error=(f"shard {doc.get('job_id')} failed: "
+                           f"{doc.get('error')}"),
+                    error_type=doc.get("error_type") or "RuntimeError")
+                done += 1
+                continue
+            if len(docs) < len(parts):
+                continue
+            from ..ops.flagstat import (FlagStatMetrics, format_report)
+
+            totals = np.zeros((18, 2), np.int64)
+            rows = 0
+            queue_ss, service_ss = [], []
+            for doc in docs:
+                res = doc.get("result") or {}
+                totals += np.asarray(res["counts"], np.int64)
+                rows += int(res.get("rows") or 0)
+                if isinstance(doc.get("queue_s"), (int, float)):
+                    queue_ss.append(float(doc["queue_s"]))
+                if isinstance(doc.get("service_s"), (int, float)):
+                    service_ss.append(float(doc["service_s"]))
+            report = format_report(
+                FlagStatMetrics.from_counters(totals[:, 1]),
+                FlagStatMetrics.from_counters(totals[:, 0]))
+            self._finish_shard(
+                parent, ok=True,
+                result={"report": report, "rows": rows,
+                        "sharded": len(parts)},
+                queue_s=min(queue_ss) if queue_ss else None,
+                service_s=max(service_ss) if service_ss else None)
+            done += 1
+        return done
+
+    def _finish_shard(self, parent: str, *, ok: bool,
+                      result: Optional[dict] = None,
+                      error: Optional[str] = None,
+                      error_type: Optional[str] = None,
+                      queue_s: Optional[float] = None,
+                      service_s: Optional[float] = None) -> None:
+        state = self._shards.pop(parent)
+        self._retired_parents.add(parent)
+        jobspec.write_result(self.spool, state["spec"], ok=ok,
+                             result=result, error=error,
+                             error_type=error_type,
+                             queue_s=queue_s, service_s=service_s,
+                             running_path=state["claim"])
+        doc = jobspec.read_result(self.spool, parent) or {}
+        self._observe_slo(doc)
+        # a failed parent's stragglers: drop their queue entries so a
+        # poison sub-job's siblings do not spin on a retired parent
+        if not ok:
+            self._drop_subjobs(parent)
+        self.jobs_served += 1
+
+    def _drop_subjobs(self, parent: str) -> None:
+        dirs = [os.path.join(self.spool, jobspec.QUEUE)]
+        for w in self.states:
+            dirs.append(os.path.join(worker_spool(self.fleet_dir, w),
+                                     jobspec.QUEUE))
+        for d in dirs:
+            for name in self._listdir(d):
+                m = jobspec._NAME_RE.match(name)
+                if not m:
+                    continue
+                sm = _SUBJOB_RE.match(m.group(2))
+                if sm and sm.group(1) == parent:
+                    try:
+                        os.unlink(os.path.join(d, name))
+                    except OSError:
+                        pass
+
+    # -- loss handling -------------------------------------------------------
+
+    def _check_lease(self, st: _WorkerState, now: float) -> bool:
+        lease = _lease_path(self.fleet_dir, st.worker)
+        try:
+            age = time.time() - os.path.getmtime(lease)
+        except OSError:
+            return (now - st.spawned_at) > self.boot_grace_s
+        if age <= self.policy.lease_ttl_s:
+            return False
+        obs.registry().counter("fleet_lease_expiries").inc()
+        obs.emit("worker_lease_expired", worker=st.worker,
+                 age_s=round(age, 3),
+                 ttl_s=round(self.policy.lease_ttl_s, 3))
+        return True
+
+    def _watch_workers(self) -> None:
+        now = time.monotonic()
+        for st in list(self.states.values()):
+            if st.closed or st.proc is None:
+                continue
+            rc = st.proc.poll()
+            if rc is not None:
+                self._handle_worker_loss(st, "worker_death")
+            elif self._check_lease(st, now):
+                self._handle_worker_loss(st, "lease_expiry")
+        if all(st.closed for st in self.states.values()):
+            leftover = len(self._front_queue()) + len(self._shards) + \
+                sum(len(self._worker_inflight(w)[0]) +
+                    len(self._worker_inflight(w)[1])
+                    for w in self.states)
+            if leftover:
+                raise RuntimeError(
+                    f"fleet serve failed: all {self.hosts} worker(s) "
+                    f"exhausted their restart budgets with {leftover} "
+                    "job(s) unserved")
+
+    def _handle_worker_loss(self, st: _WorkerState, cause: str) -> None:
+        # fence first: a half-dead worker must not keep writing results
+        # after its jobs are handed elsewhere
+        if st.proc is not None and st.proc.poll() is None:
+            st.proc.kill()
+            try:
+                st.proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                pass
+        obs.registry().counter("fleet_worker_deaths",
+                               cause=cause).inc()
+        # whatever the worker committed before dying still counts —
+        # relay BEFORE requeue, so a finished job never re-runs
+        self._relay_worker(st.worker)
+        ws = worker_spool(self.fleet_dir, st.worker)
+        # kill attribution is the EXECUTING set (the worker's active
+        # marker, written around each run), not the whole claimed
+        # batch: a serve round claims several jobs up front, and
+        # charging a death to claimed-but-waiting jobs would let one
+        # poison job quarantine every innocent sharing its worker
+        active = set(jobspec.read_active(ws))
+        for sub, claimed in ((jobspec.RUNNING, True),
+                             (jobspec.QUEUE, False)):
+            d = os.path.join(ws, sub)
+            for name in self._listdir(d):
+                m = jobspec._NAME_RE.match(name)
+                if not m:
+                    continue
+                src = os.path.join(d, name)
+                job_id = m.group(2)
+                if jobspec.read_result(self.spool, job_id) is not None:
+                    try:        # result landed before the death
+                        os.unlink(src)
+                    except OSError:
+                        pass
+                    continue
+                sm = _SUBJOB_RE.match(job_id)
+                if sm and sm.group(1) in self._retired_parents:
+                    try:        # straggler of a failed parent: no
+                        os.unlink(src)  # point re-running it
+                    except OSError:
+                        pass
+                    continue
+                spec = _read_json(src) or {}
+                tenant = str(spec.get("tenant") or "default")
+                started = claimed and job_id in active
+                kills = self.kills.get(job_id, 0) + (1 if started
+                                                     else 0)
+                if started:
+                    self.kills[job_id] = kills
+                dec = decide_requeue(job_id=job_id, tenant=tenant,
+                                     cause=cause, kills=kills,
+                                     max_kills=self.max_job_kills,
+                                     started=started)
+                _emit_requeued(cause, dec, worker=st.worker)
+                if dec["action"] == "quarantine":
+                    self._quarantine(src, job_id, spec, cause, kills)
+                    continue
+                try:
+                    os.rename(src, os.path.join(
+                        self.spool, jobspec.QUEUE, name))
+                except OSError:
+                    pass
+        st.restarts += 1
+        if st.restarts > self.policy.max_restarts:
+            st.closed = True
+            obs.registry().counter("fleet_workers_closed").inc()
+            return
+        st.incarnation += 1
+        self._spawn(st)
+
+    def _quarantine(self, src: str, job_id: str, spec: dict,
+                    cause: str, kills: int) -> None:
+        try:
+            canon = jobspec.canon_spec(spec)
+        except ValueError:
+            canon = {"job_id": job_id,
+                     "tenant": str(spec.get("tenant") or "default"),
+                     "command": str(spec.get("command")),
+                     "input": "", "output": None, "args": {},
+                     "submitted_at": None}
+        canon["job_id"] = job_id
+        err = JobQuarantined(
+            f"job {job_id} quarantined: killed {kills} worker(s) "
+            f"({cause}) — poison-job budget is "
+            f"{self.max_job_kills}")
+        jobspec.write_result(self.spool, canon, ok=False,
+                             error=str(err),
+                             error_type=type(err).__name__,
+                             running_path=src)
+        obs.registry().counter("fleet_jobs_quarantined").inc()
+        self.kills.pop(job_id, None)
+        m = _SUBJOB_RE.match(job_id)
+        if m and m.group(1) in self._shards:
+            # the parent fails through the normal merge path: record
+            # the quarantine doc as this part's (failed) result
+            doc = jobspec.read_result(self.spool, job_id)
+            if doc is not None:
+                self._shards[m.group(1)]["parts"][job_id] = doc
+        else:
+            self.jobs_served += 1
+
+    # -- stealing ------------------------------------------------------------
+
+    def _steal_round(self) -> None:
+        if not self.steal:
+            return
+        stealable, idle = [], []
+        for w, st in sorted(self.states.items()):
+            if not self._alive(st):
+                continue
+            q, r = self._worker_inflight(w)
+            if not q and not r:
+                idle.append(w)
+                continue
+            if len(q) + len(r) < 2:
+                # a 1-deep host is not a donor: moving its only job to
+                # an empty neighbor swaps the imbalance instead of
+                # reducing it — two booting workers would ping-pong one
+                # unclaimed job every poll round, churning renames and
+                # spamming steal events that rebalance nothing
+                continue
+            for name in q:
+                m = jobspec._NAME_RE.match(name)
+                stealable.append(dict(job_id=m.group(2), worker=w,
+                                      seq=int(m.group(1))))
+        if not stealable or not idle:
+            return
+        d = decide_steal(stealable=stealable, idle=idle)
+        if d["action"] != "steal":
+            return
+        _emit_requeued("steal", d)
+        by_id = {s["job_id"]: s["seq"] for s in d["inputs"]["stealable"]}
+        for job_id, src_w, dst_w in d["moves"]:
+            name = f"{by_id[job_id]:08d}-{job_id}.json"
+            try:
+                os.rename(
+                    os.path.join(worker_spool(self.fleet_dir, src_w),
+                                 jobspec.QUEUE, name),
+                    os.path.join(worker_spool(self.fleet_dir, dst_w),
+                                 jobspec.QUEUE, name))
+                obs.registry().counter("fleet_jobs_stolen").inc()
+            except OSError:
+                continue        # the donor claimed it first: skip
+
+    # -- drain / run ---------------------------------------------------------
+
+    def _drain(self) -> None:
+        """Stop every worker cleanly: write its stop sentinel, let the
+        in-flight round finish, relay what completed, requeue the rest
+        durably, kill stragglers past the timeout."""
+        for w in self.states:
+            try:
+                jobspec.request_stop(worker_spool(self.fleet_dir, w))
+            except OSError:
+                pass
+        deadline = time.monotonic() + self.drain_timeout_s
+        while time.monotonic() < deadline:
+            self._relay_results()
+            if all(st.proc is None or st.proc.poll() is not None
+                   for st in self.states.values()):
+                break
+            time.sleep(0.05)
+        for st in self.states.values():
+            if st.proc is not None and st.proc.poll() is None:
+                st.proc.kill()
+                try:
+                    st.proc.wait(timeout=10)
+                except subprocess.TimeoutExpired:
+                    pass
+        self._relay_results()
+        # anything not served goes back to the front queue — durable,
+        # never torn: the next boot picks it up exactly where it sat
+        for w, st in sorted(self.states.items()):
+            ws = worker_spool(self.fleet_dir, w)
+            for sub in (jobspec.RUNNING, jobspec.QUEUE):
+                d = os.path.join(ws, sub)
+                for name in self._listdir(d):
+                    if not jobspec._NAME_RE.match(name):
+                        continue
+                    m = jobspec._NAME_RE.match(name)
+                    if jobspec.read_result(self.spool,
+                                           m.group(2)) is not None:
+                        try:
+                            os.unlink(os.path.join(d, name))
+                        except OSError:
+                            pass
+                        continue
+                    spec = _read_json(os.path.join(d, name)) or {}
+                    dec = decide_requeue(
+                        job_id=m.group(2),
+                        tenant=str(spec.get("tenant") or "default"),
+                        cause="drain",
+                        kills=self.kills.get(m.group(2), 0),
+                        max_kills=self.max_job_kills, started=False)
+                    _emit_requeued("drain", dec, worker=w)
+                    try:
+                        os.rename(os.path.join(d, name),
+                                  os.path.join(self.spool,
+                                               jobspec.QUEUE, name))
+                    except OSError:
+                        pass
+
+    def write_report(self) -> Optional[str]:
+        # same file name as the single-host server's shutdown report —
+        # clients poll one well-known path whatever the fleet size
+        from .server import SLO_REPORT_FILE, write_slo_report
+        return write_slo_report(
+            os.path.join(self.spool, SLO_REPORT_FILE), self._slo,
+            hosts=self.hosts, jobs=self.jobs_served)
+
+    def run(self, *, max_jobs: Optional[int] = None,
+            idle_timeout_s: Optional[float] = None) -> int:
+        """Serve until ``max_jobs`` results relayed, the front-door stop
+        sentinel appears, or the whole fleet idles for
+        ``idle_timeout_s``.  Always drains the workers and writes the
+        SLO shutdown report on the way out."""
+        self.boot()
+        served0 = self.jobs_served
+        idle_since = time.monotonic()
+        try:
+            while True:
+                n = self._relay_results()
+                if n:
+                    idle_since = time.monotonic()
+                if max_jobs is not None and \
+                        self.jobs_served - served0 >= max_jobs:
+                    break
+                if jobspec.stop_requested(self.spool):
+                    break
+                self._watch_workers()
+                if self._place_round():
+                    idle_since = time.monotonic()
+                self._steal_round()
+                if idle_timeout_s is not None and \
+                        time.monotonic() - idle_since >= idle_timeout_s:
+                    break
+                time.sleep(self.poll_s)
+        finally:
+            self._drain()
+            self.write_report()
+        return self.jobs_served - served0
+
+
+if __name__ == "__main__":
+    sys.exit(worker_main())

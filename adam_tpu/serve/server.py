@@ -43,6 +43,73 @@ from . import jobspec
 from .admission import DEFAULT_PACK_SEGMENTS, decide_admission
 from .packed import SharedDispatchError, packed_flagstat
 
+#: the per-tenant SLO shutdown report file name (single-host serve
+#: writes it next to the spool dirs; the fleet scheduler reuses the
+#: same helpers for its own)
+SLO_REPORT_FILE = "serve_report.json"
+
+
+def _pctl(values, q: float) -> float:
+    """Nearest-rank percentile over a non-empty list (pure python — the
+    report must not need a device library)."""
+    vs = sorted(values)
+    idx = max(int(-(-q * len(vs) // 100)) - 1, 0)
+    return vs[min(idx, len(vs) - 1)]
+
+
+def slo_observe(slo: dict, tenant: str, queue_s, service_s) -> None:
+    """Fold one served job's latency split into the per-tenant SLO
+    accumulator (plus the obs histograms, so worker sidecars carry the
+    distribution even when the report is written elsewhere)."""
+    rec = slo.setdefault(tenant, {"queue_s": [], "service_s": []})
+    for key, v in (("queue_s", queue_s), ("service_s", service_s)):
+        if isinstance(v, (int, float)) and not isinstance(v, bool) \
+                and v >= 0:
+            rec[key].append(float(v))
+            obs.registry().histogram(
+                f"serve_{key.replace('_s', '')}_seconds",
+                tenant=tenant).observe(float(v))
+
+
+def slo_summary(slo: dict) -> dict:
+    """Per-tenant p50/p99 of queue-wait and service time — the gated
+    tail numbers, not a claim."""
+    out = {}
+    for tenant in sorted(slo):
+        rec = slo[tenant]
+        ten = {"jobs": max(len(rec["queue_s"]), len(rec["service_s"]))}
+        for key in ("queue_s", "service_s"):
+            vs = rec[key]
+            if vs:
+                ten[key] = {"p50": round(_pctl(vs, 50), 6),
+                            "p99": round(_pctl(vs, 99), 6)}
+        out[tenant] = ten
+    return out
+
+
+def write_slo_report(path: str, slo: dict, *, hosts: int,
+                     jobs: int) -> Optional[str]:
+    """The serve shutdown report: per-tenant tail-latency percentiles,
+    written atomically next to the spool.  Telemetry discipline: a
+    failed write degrades to one stderr line, never fails a finished
+    serve run."""
+    doc = {"hosts": int(hosts), "jobs": int(jobs),
+           "tenants": slo_summary(slo)}
+    try:
+        atomic_write(path, json.dumps(doc, sort_keys=True))
+    except OSError as e:
+        import sys
+        sys.stderr.write(f"serve: SLO report write failed: {e}\n")
+        return None
+    from ..instrument import say
+    for tenant, ten in doc["tenants"].items():
+        q, s = ten.get("queue_s"), ten.get("service_s")
+        if q and s:
+            say(f"serve SLO [{tenant}]: queue p50 {q['p50']}s "
+                f"p99 {q['p99']}s; service p50 {s['p50']}s "
+                f"p99 {s['p99']}s over {ten['jobs']} job(s)")
+    return path
+
 
 class ServeServer:
     """One warm device, many tenants (docs/ARCHITECTURE.md §6i)."""
@@ -51,7 +118,8 @@ class ServeServer:
                  max_concurrent: int = 4, pack: bool = True,
                  pack_segments: int = DEFAULT_PACK_SEGMENTS,
                  poll_s: float = 0.05, io_procs: int = 1,
-                 executor_opts: Optional[dict] = None):
+                 executor_opts: Optional[dict] = None,
+                 slo_report: bool = True):
         self.spool = jobspec.ensure_spool(spool)
         self.chunk_rows = int(chunk_rows)
         self.max_concurrent = max(int(max_concurrent), 1)
@@ -61,6 +129,11 @@ class ServeServer:
         self.io_procs = int(io_procs)
         self.executor_opts = dict(executor_opts or {})
         self.jobs_served = 0
+        #: per-tenant latency accumulators (queue-wait + service time);
+        #: fleet workers set ``slo_report=False`` — the scheduler owns
+        #: the fleet-wide report, built from the relayed result docs
+        self.slo: Dict[str, dict] = {}
+        self.slo_report = bool(slo_report)
         self._booted = False
 
     # -- boot ---------------------------------------------------------------
@@ -114,6 +187,10 @@ class ServeServer:
                         time.monotonic() - idle_since >= idle_timeout_s:
                     break
                 time.sleep(self.poll_s)
+        if self.slo_report and self.jobs_served:
+            write_slo_report(
+                os.path.join(self.spool, SLO_REPORT_FILE), self.slo,
+                hosts=1, jobs=self.jobs_served)
         return self.jobs_served - served_at_entry
 
     def _round(self, budget: Optional[int] = None) -> int:
@@ -134,7 +211,8 @@ class ServeServer:
                 canon = {"job_id": os.path.basename(path)[9:-5],
                          "tenant": "default",
                          "command": str(spec.get("command")),
-                         "input": "", "output": None, "args": {}}
+                         "input": "", "output": None, "args": {},
+                         "submitted_at": None}
                 claimed = jobspec.claim_job(self.spool, path)
                 jobspec.write_result(
                     self.spool, canon, ok=False, error=str(e),
@@ -195,6 +273,19 @@ class ServeServer:
                                               self.io_procs)),
                 executor_opts=self.executor_opts)
             return {"report": format_report(failed, passed)}
+        if spec["command"] == "flagstat_range":
+            # the fleet scheduler's shard sub-job: one unit range of a
+            # big input; the exact counter block (not a formatted
+            # report) rides the result doc back for the parent merge
+            from .scheduler import range_flagstat_counts
+
+            a = spec["args"]
+            counts, rows = range_flagstat_counts(
+                spec["input"], unit_lo=int(a["unit_lo"]),
+                unit_hi=int(a["unit_hi"]),
+                unit_rows=int(a["unit_rows"]),
+                io_procs=int(a.get("io_procs", self.io_procs)))
+            return {"counts": counts.tolist(), "rows": rows}
         return {"rows": self._execute_transform(spec)}
 
     def _execute_transform(self, spec: dict) -> int:
@@ -215,17 +306,32 @@ class ServeServer:
             io_procs=int(args.get("io_procs", self.io_procs)),
             executor_opts=self.executor_opts)
 
+    def _queue_wait(self, spec: dict) -> Optional[float]:
+        """Submit→start wait, when the spec carries its submit stamp
+        (jobspec.submit_job writes it; hand-built specs may not)."""
+        sub_at = spec.get("submitted_at")
+        if isinstance(sub_at, (int, float)) and \
+                not isinstance(sub_at, bool):
+            return max(time.time() - float(sub_at), 0.0)
+        return None
+
     def _finish(self, running: str, spec: dict, *, ok: bool,
                 result=None, error: Optional[BaseException] = None,
                 seconds: float = 0.0, compiles: float = 0.0,
-                rows=None, dropped: int = 0) -> None:
+                rows=None, dropped: int = 0,
+                queue_s: Optional[float] = None) -> None:
         """Publish one job's outcome: durable result doc + the
         ``tenant_job`` event (the per-tenant obs label every sidecar
-        consumer splits on)."""
+        consumer splits on).  ``queue_s`` (submit→start wait) and
+        ``service_s`` (== ``seconds``, the execution wall) make the
+        scheduler's tails a recorded number per tenant."""
         fields = dict(job_id=spec["job_id"], tenant=spec["tenant"],
                       command=spec["command"],
                       status="ok" if ok else "failed",
-                      seconds=round(seconds, 6), compiles=int(compiles))
+                      seconds=round(seconds, 6), compiles=int(compiles),
+                      service_s=round(seconds, 6))
+        if queue_s is not None:
+            fields["queue_s"] = round(queue_s, 6)
         if rows is not None:
             fields["rows"] = int(rows)
         if dropped:
@@ -236,6 +342,7 @@ class ServeServer:
         obs.registry().counter(
             "serve_jobs", tenant=spec["tenant"],
             status=fields["status"]).inc()
+        slo_observe(self.slo, spec["tenant"], queue_s, seconds)
         res = dict(result or {})
         if dropped:
             res["malformed_dropped"] = int(dropped)
@@ -243,14 +350,19 @@ class ServeServer:
             self.spool, spec, ok=ok, result=res,
             error=None if error is None else str(error),
             error_type=None if error is None else type(error).__name__,
-            seconds=seconds, running_path=running)
+            seconds=seconds, queue_s=queue_s, service_s=seconds,
+            running_path=running)
         self.jobs_served += 1
 
     def _run_solo(self, running: str, spec: dict) -> None:
         t0 = time.perf_counter()
+        queue_s = self._queue_wait(spec)
         compiles0 = obs.registry().counter("compile_count").value
         reset_malformed()
         faults.set_tenant(spec["tenant"])
+        # the kill-attribution boundary: if this process dies now, the
+        # fleet scheduler charges THIS job, not the whole claimed batch
+        jobspec.set_active(self.spool, [spec["job_id"]])
         try:
             with obs.trace.span(
                     f"tenant:{spec['tenant']}:{spec['job_id']}",
@@ -264,17 +376,18 @@ class ServeServer:
                          seconds=time.perf_counter() - t0,
                          compiles=obs.registry().counter(
                              "compile_count").value - compiles0,
-                         dropped=malformed_count())
+                         dropped=malformed_count(), queue_s=queue_s)
             return
         finally:
             faults.set_tenant(None)
             reset_malformed()
+            jobspec.set_active(self.spool, [])
         self._finish(
             running, spec, ok=True, result=result,
             seconds=time.perf_counter() - t0,
             compiles=obs.registry().counter(
                 "compile_count").value - compiles0,
-            rows=result.get("rows"), dropped=dropped)
+            rows=result.get("rows"), dropped=dropped, queue_s=queue_s)
 
     def _run_packed(self, members: List[tuple]) -> int:
         """One shared-dispatch group.  On a shared failure, degrade to
@@ -283,9 +396,14 @@ class ServeServer:
         if not members:
             return 0
         specs = [spec for _, spec in members]
+        queue_waits = {spec["job_id"]: self._queue_wait(spec)
+                       for _, spec in members}
         t0 = time.perf_counter()
         compiles0 = obs.registry().counter("compile_count").value
         reset_malformed()
+        # every rider genuinely fate-shares the packed dispatches, so a
+        # death here is chargeable to the whole group
+        jobspec.set_active(self.spool, [s["job_id"] for s in specs])
         try:
             results, stats = packed_flagstat(
                 specs, chunk_rows=self.chunk_rows,
@@ -303,6 +421,7 @@ class ServeServer:
             return len(members)
         finally:
             reset_malformed()
+            jobspec.set_active(self.spool, [])
         seconds = time.perf_counter() - t0
         compiles = obs.registry().counter(
             "compile_count").value - compiles0
@@ -322,5 +441,6 @@ class ServeServer:
                          seconds=seconds,
                          compiles=compiles if i == 0 else 0,
                          rows=st.get("rows"),
-                         dropped=int(st.get("dropped", 0)))
+                         dropped=int(st.get("dropped", 0)),
+                         queue_s=queue_waits.get(spec["job_id"]))
         return len(members)

@@ -1,0 +1,585 @@
+"""Fleet serve: the fault-tolerant cluster scheduler over always-warm
+workers (ISSUE 12).
+
+Pins, per docs/FLEET_SERVE.md:
+
+* ``decide_placement`` / ``decide_requeue`` / ``decide_steal`` are
+  pure/replayable (canonicalized inputs + digest, event-recorded,
+  replayed offline by tools/check_executor.py);
+* THE chaos pin: SIGKILL any fleet-serve worker mid-job (the existing
+  ``device_dispatch``/``shard_lease`` fault sites, worker-scoped) →
+  the job requeues durably and the full tenant result set is
+  byte-identical to a one-worker oracle run;
+* a hung worker (stalled heartbeat past the lease TTL) is fenced with
+  SIGKILL before its jobs are handed elsewhere;
+* the poison-job quarantine ladder: a job that kills
+  ``max_job_kills`` workers fails with a typed ``JobQuarantined``
+  result while its neighbors' jobs complete byte-identical;
+* drain/stop: in-flight jobs finish or requeue durably, never torn —
+  a later scheduler serves the remainder byte-identical;
+* work stealing is exactly-once: a stolen-then-raced job produces ONE
+  durable result (first relay wins, duplicates drop);
+* per-tenant SLO split: every result doc and ``tenant_job`` event
+  carries ``queue_s``/``service_s`` and the shutdown report summarizes
+  p50/p99 per tenant;
+* the committed ``BENCH_FLEET_SERVE.json`` keeps the gate-6 numbers.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from adam_tpu import obs
+from adam_tpu.ops.flagstat import format_report
+from adam_tpu.parallel.pipeline import streaming_flagstat
+from adam_tpu.resilience.retry import FleetPolicy
+from adam_tpu.serve import jobspec
+from adam_tpu.serve.scheduler import (FleetServeScheduler,
+                                      decide_placement, decide_requeue,
+                                      decide_steal, worker_spool)
+
+ROOT = os.path.join(os.path.dirname(__file__), "..")
+CHUNK = 1 << 14
+
+
+def _synth_reads(path, n, seed):
+    from adam_tpu.io.parquet import DatasetWriter
+
+    rng = np.random.RandomState(seed)
+    with DatasetWriter(str(path), part_rows=1 << 14) as w:
+        for lo in range(0, n, 1 << 14):
+            m = min(1 << 14, n - lo)
+            w.write(pa.table({
+                "flags": pa.array(rng.randint(
+                    0, 1 << 11, size=m).astype(np.uint32), pa.uint32()),
+                "mapq": pa.array(rng.randint(0, 61, size=m), pa.int32()),
+                "referenceId": pa.array(rng.randint(0, 24, size=m),
+                                        pa.int32()),
+                "mateReferenceId": pa.array(rng.randint(0, 24, size=m),
+                                            pa.int32()),
+            }))
+    return str(path)
+
+
+def _solo_report(path):
+    return format_report(*streaming_flagstat(path, chunk_rows=CHUNK))
+
+
+def _chaos_env(tmp_path, rules):
+    plan_path = str(tmp_path / "faults.json")
+    with open(plan_path, "w") as f:
+        json.dump({"rules": rules}, f)
+    env = dict(os.environ)
+    env["ADAM_TPU_FAULT_PLAN"] = plan_path
+    return env
+
+
+def _submit(spool, jobs):
+    for job_id, tenant, inp in jobs:
+        jobspec.submit_job(spool, {"job_id": job_id, "tenant": tenant,
+                                   "command": "flagstat", "input": inp})
+
+
+def _events(path):
+    with open(path) as f:
+        return [json.loads(ln) for ln in f if ln.strip()]
+
+
+def _run_validators(*paths):
+    for tool in ("check_metrics", "check_executor"):
+        r = subprocess.run(
+            [sys.executable, os.path.join(ROOT, "tools", f"{tool}.py")]
+            + list(paths), capture_output=True, text=True)
+        assert r.returncode == 0, f"{tool}: {r.stdout}\n{r.stderr}"
+
+
+def _oracle_results(tmp_path, jobs, name="oracle"):
+    """The one-worker oracle: the SAME job set served by a 1-host
+    fleet, the byte-identity reference for every chaos leg."""
+    spool = str(tmp_path / name)
+    _submit(spool, jobs)
+    sched = FleetServeScheduler(spool, hosts=1, chunk_rows=CHUNK,
+                                poll_s=0.02)
+    assert sched.run(max_jobs=len(jobs), idle_timeout_s=120.0) == \
+        len(jobs)
+    return {j: jobspec.read_result(spool, j) for j, _, _ in jobs}
+
+
+# ---------------------------------------------------------------------------
+# the pure decisions
+# ---------------------------------------------------------------------------
+
+def test_decide_placement_fifo_least_loaded_replayable():
+    queued = [dict(job_id="b", tenant="t", command="flagstat", seq=2),
+              dict(job_id="a", tenant="t", command="flagstat", seq=1),
+              dict(job_id="c", tenant="t", command="flagstat", seq=3)]
+    workers = [dict(worker=1, inflight=1, alive=True),
+               dict(worker=0, inflight=0, alive=True),
+               dict(worker=2, inflight=0, alive=False)]
+    d = decide_placement(queued=queued, workers=workers, depth=2)
+    # FIFO by seq; least-loaded alive worker, ties to the lowest id;
+    # the dead worker never receives work
+    assert d["place"] == [["a", 0], ["b", 0], ["c", 1]]
+    # input order never matters (canonicalization)
+    d2 = decide_placement(queued=list(reversed(queued)),
+                          workers=list(reversed(workers)), depth=2)
+    assert d2["input_digest"] == d["input_digest"]
+    assert d2["place"] == d["place"]
+    # replaying the recorded inputs reproduces the decision exactly
+    r = decide_placement(**d["inputs"])
+    assert (r["place"], r["input_digest"]) == \
+        (d["place"], d["input_digest"])
+    # every alive worker at depth: jobs stay in the front queue
+    full = decide_placement(
+        queued=queued, workers=[dict(worker=0, inflight=2, alive=True)],
+        depth=2)
+    assert full["place"] == []
+
+
+def test_decide_requeue_quarantine_ladder():
+    # an unstarted job rides along innocently, whatever its history
+    d = decide_requeue(job_id="j", tenant="t", cause="worker_death",
+                       kills=5, max_kills=2, started=False)
+    assert d["action"] == "requeue"
+    # a started job below budget requeues, at budget quarantines
+    d1 = decide_requeue(job_id="j", tenant="t", cause="worker_death",
+                        kills=1, max_kills=2, started=True)
+    assert d1["action"] == "requeue"
+    d2 = decide_requeue(job_id="j", tenant="t", cause="lease_expiry",
+                        kills=2, max_kills=2, started=True)
+    assert d2["action"] == "quarantine"
+    r = decide_requeue(**d2["inputs"])
+    assert (r["action"], r["input_digest"]) == \
+        ("quarantine", d2["input_digest"])
+    assert d1["input_digest"] != d2["input_digest"]
+
+
+def test_decide_steal_one_per_idle_never_duplicates():
+    stealable = [dict(job_id="a", worker=0, seq=1),
+                 dict(job_id="b", worker=0, seq=2),
+                 dict(job_id="c", worker=1, seq=3)]
+    d = decide_steal(stealable=stealable, idle=[2, 3])
+    assert d["action"] == "steal"
+    # each idle worker gets at most one move; no job moves twice; the
+    # most-backlogged donor (worker 0) gives first, earliest seq first
+    moved = [m[0] for m in d["moves"]]
+    assert len(moved) == len(set(moved)) == 2
+    assert d["moves"][0] == ["a", 0, 2]
+    assert all(src != dst for _, src, dst in d["moves"])
+    r = decide_steal(**d["inputs"])
+    assert (r["moves"], r["input_digest"]) == \
+        (d["moves"], d["input_digest"])
+    # nothing stealable → none
+    assert decide_steal(stealable=[], idle=[1])["action"] == "none"
+
+
+# ---------------------------------------------------------------------------
+# the chaos matrix
+# ---------------------------------------------------------------------------
+
+def test_fleet_serve_byte_identity_slo_and_replay(tmp_path):
+    """The no-chaos floor: K tenants on a 2-worker fleet, every result
+    byte-identical to the one-worker oracle, queue/service SLO split in
+    every result doc + tenant_job event, the shutdown report carries
+    per-tenant p50/p99, and the scheduler sidecar replays through both
+    validators."""
+    inp = _synth_reads(tmp_path / "reads", 24_000, 1)
+    jobs = [(f"j{i}", f"t{i % 2}", inp) for i in range(4)]
+    oracle = _oracle_results(tmp_path, jobs)
+
+    spool = str(tmp_path / "spool")
+    _submit(spool, jobs)
+    sidecar = str(tmp_path / "sched.metrics.jsonl")
+    with obs.metrics_run(sidecar, argv=["fleet"], config={}):
+        sched = FleetServeScheduler(spool, hosts=2, chunk_rows=CHUNK,
+                                    poll_s=0.02)
+        assert sched.run(max_jobs=4, idle_timeout_s=120.0) == 4
+    for job_id, _, _ in jobs:
+        doc = jobspec.read_result(spool, job_id)
+        assert doc["ok"], doc
+        assert doc["result"]["report"] == \
+            oracle[job_id]["result"]["report"]
+        assert doc["queue_s"] >= 0 and doc["service_s"] >= 0
+    # per-tenant tails are a recorded number, not a claim
+    with open(os.path.join(spool, "serve_report.json")) as f:
+        report = json.load(f)
+    assert report["hosts"] == 2 and report["jobs"] == 4
+    for tenant in ("t0", "t1"):
+        ten = report["tenants"][tenant]
+        assert ten["jobs"] == 2
+        assert ten["queue_s"]["p99"] >= ten["queue_s"]["p50"] >= 0
+        assert ten["service_s"]["p99"] >= ten["service_s"]["p50"] >= 0
+    # worker sidecars: tenant_job events carry the SLO split
+    tj = []
+    for sc in glob.glob(os.path.join(
+            spool, "fleet", "logs", "*.metrics.jsonl")):
+        tj += [e for e in _events(sc) if e["event"] == "tenant_job"]
+    assert len(tj) == 4
+    assert all(e["service_s"] >= 0 and e["queue_s"] >= 0 for e in tj)
+    # schema + replay on the scheduler's own sidecar
+    evs = _events(sidecar)
+    assert [e["event"] for e in evs if e["event"] ==
+            "placement_selected"]
+    _run_validators(sidecar)
+
+
+def test_fleet_worker_sigkill_mid_job_requeues_byte_identical(tmp_path):
+    """THE acceptance pin: SIGKILL worker 1 mid-job (worker-scoped
+    device_dispatch kill, incarnation 0 only); its jobs requeue through
+    the pure decide_requeue and the full tenant result set stays
+    byte-identical to the one-worker oracle."""
+    inp = _synth_reads(tmp_path / "reads", 24_000, 2)
+    jobs = [(f"j{i}", f"t{i % 2}", inp) for i in range(4)]
+    oracle = _oracle_results(tmp_path, jobs)
+
+    spool = str(tmp_path / "spool")
+    _submit(spool, jobs)
+    env = _chaos_env(tmp_path, [
+        {"site": "device_dispatch", "fault": "kill", "occurrence": 2,
+         "worker": 1, "incarnation": 0}])
+    sidecar = str(tmp_path / "sched.metrics.jsonl")
+    with obs.metrics_run(sidecar, argv=["fleet-kill"], config={}):
+        sched = FleetServeScheduler(spool, hosts=2, chunk_rows=CHUNK,
+                                    poll_s=0.02, env=env)
+        assert sched.run(max_jobs=4, idle_timeout_s=120.0) == 4
+    for job_id, _, _ in jobs:
+        doc = jobspec.read_result(spool, job_id)
+        assert doc["ok"], doc
+        assert doc["result"]["report"] == \
+            oracle[job_id]["result"]["report"]
+    evs = _events(sidecar)
+    rq = [e for e in evs if e["event"] == "job_requeued"
+          and e["cause"] == "worker_death"]
+    assert rq and all(e["action"] == "requeue" for e in rq)
+    # worker 1 really died and respawned (incarnation 1 booted)
+    assert glob.glob(os.path.join(spool, "fleet", "logs",
+                                  "w1-inc1.log"))
+    _run_validators(sidecar)
+
+
+def test_fleet_lease_hang_fences_and_requeues(tmp_path):
+    """A hung worker — its heartbeat thread stalled past the lease TTL
+    by a worker-scoped shard_lease latency fault while a dispatch
+    latency keeps its job mid-run — is detected WITHOUT an exit code,
+    fenced with SIGKILL, and its jobs requeue; results stay
+    byte-identical to the oracle."""
+    inp = _synth_reads(tmp_path / "reads", 24_000, 3)
+    jobs = [(f"j{i}", "t0", inp) for i in range(2)]
+    oracle = _oracle_results(tmp_path, jobs)
+
+    spool = str(tmp_path / "spool")
+    _submit(spool, jobs)
+    env = _chaos_env(tmp_path, [
+        {"site": "shard_lease", "fault": "latency", "latency_s": 60.0,
+         "occurrence": "2+", "worker": 1, "incarnation": 0},
+        # keep the victim mid-job past the TTL (the stalled heartbeat
+        # stalls ~0.5s in and must expire at ~TTL+0.5s, well BEFORE the
+        # job's ~3-dispatch service time at 4s/dispatch completes)
+        {"site": "device_dispatch", "fault": "latency",
+         "latency_s": 4.0, "occurrence": "1+", "worker": 1,
+         "incarnation": 0}])
+    pol = FleetPolicy(max_restarts=2, lease_ttl_s=5.0, heartbeat_s=0.5)
+    sidecar = str(tmp_path / "sched.metrics.jsonl")
+    with obs.metrics_run(sidecar, argv=["fleet-hang"], config={}):
+        sched = FleetServeScheduler(spool, hosts=2, chunk_rows=CHUNK,
+                                    poll_s=0.02, env=env, policy=pol)
+        assert sched.run(max_jobs=2, idle_timeout_s=180.0) == 2
+    for job_id, _, _ in jobs:
+        doc = jobspec.read_result(spool, job_id)
+        assert doc["ok"], doc
+        assert doc["result"]["report"] == \
+            oracle[job_id]["result"]["report"]
+    evs = _events(sidecar)
+    exp = [e for e in evs if e["event"] == "worker_lease_expired"]
+    assert exp and exp[0]["worker"] == 1
+    assert exp[0]["age_s"] > pol.lease_ttl_s
+    assert [e for e in evs if e["event"] == "job_requeued"
+            and e["cause"] == "lease_expiry"]
+    _run_validators(sidecar)
+
+
+def test_poison_job_quarantined_neighbors_unaffected(tmp_path):
+    """The poison ladder: a tenant-scoped kill fault murders every
+    worker its job lands on; after max_job_kills deaths the job fails
+    with a typed JobQuarantined result instead of grinding the fleet
+    down, and the other tenants' jobs complete byte-identical."""
+    inp = _synth_reads(tmp_path / "reads", 24_000, 4)
+    good = [("g0", "alice", inp), ("g1", "bob", inp)]
+    oracle = _oracle_results(tmp_path, good)
+
+    spool = str(tmp_path / "spool")
+    _submit(spool, [("poison", "mallory", inp)] + good)
+    # tenant-scoped faults fire only inside that tenant's scoped
+    # execution; shared dispatches deliberately run UNscoped (a tenant
+    # rule must not hit the neighbors riding its buffer), so the fleet
+    # runs pack=False here to put every dispatch on the scoped solo
+    # path.  Attribution still matters: the worker claims several jobs
+    # per round, and only the ACTIVE one (the worker's active.json
+    # marker) may be charged for the death — the bystander claimed
+    # alongside the poison must requeue innocently every time.
+    env = _chaos_env(tmp_path, [
+        {"site": "device_dispatch", "fault": "kill",
+         "occurrence": "1+", "tenant": "mallory"}])
+    sidecar = str(tmp_path / "sched.metrics.jsonl")
+    with obs.metrics_run(sidecar, argv=["fleet-poison"], config={}):
+        sched = FleetServeScheduler(spool, hosts=2, chunk_rows=CHUNK,
+                                    poll_s=0.02, env=env, pack=False,
+                                    max_job_kills=2)
+        assert sched.run(max_jobs=3, idle_timeout_s=180.0) == 3
+    doc = jobspec.read_result(spool, "poison")
+    assert doc and not doc["ok"]
+    assert doc["error_type"] == "JobQuarantined"
+    assert "killed 2 worker(s)" in doc["error"]
+    for job_id, _, _ in good:
+        gd = jobspec.read_result(spool, job_id)
+        assert gd["ok"], gd
+        assert gd["result"]["report"] == \
+            oracle[job_id]["result"]["report"]
+    evs = _events(sidecar)
+    ladder = [e["action"] for e in evs if e["event"] == "job_requeued"
+              and e.get("job_id") == "poison"]
+    assert ladder and ladder[-1] == "quarantine"
+    assert ladder.count("quarantine") == 1
+    _run_validators(sidecar)
+
+
+def test_drain_requeues_unserved_durably_then_completes(tmp_path):
+    """Stop with work in flight: served jobs keep their results,
+    everything else lands back in the front queue durably (never torn,
+    never both queued and resulted), and a later fleet serves the
+    remainder byte-identical."""
+    inp = _synth_reads(tmp_path / "reads", 24_000, 5)
+    jobs = [(f"j{i}", f"t{i % 3}", inp) for i in range(6)]
+    oracle = _oracle_results(tmp_path, jobs)
+
+    spool = str(tmp_path / "spool")
+    _submit(spool, jobs)
+    sched = FleetServeScheduler(spool, hosts=2, chunk_rows=CHUNK,
+                                poll_s=0.02, worker_depth=1)
+    served = sched.run(max_jobs=2, idle_timeout_s=120.0)
+    assert served >= 2
+    qdir = os.path.join(spool, jobspec.QUEUE)
+    queued_now = {jobspec._NAME_RE.match(n).group(2)
+                  for n in os.listdir(qdir)
+                  if jobspec._NAME_RE.match(n)}
+    for job_id, _, _ in jobs:
+        has_result = jobspec.read_result(spool, job_id) is not None
+        # exactly one of: durable result, or back in the front queue
+        assert has_result != (job_id in queued_now), job_id
+    # nothing may be left stranded in worker sub-spools
+    for w in (0, 1):
+        ws = worker_spool(os.path.join(spool, "fleet"), w)
+        for sub in (jobspec.QUEUE, jobspec.RUNNING):
+            d = os.path.join(ws, sub)
+            leftover = [n for n in (os.listdir(d)
+                                    if os.path.isdir(d) else [])
+                        if jobspec._NAME_RE.match(n)]
+            assert leftover == [], (w, sub, leftover)
+    # a fresh fleet picks the remainder up exactly where it sat
+    sched2 = FleetServeScheduler(spool, hosts=2, chunk_rows=CHUNK,
+                                 poll_s=0.02)
+    assert sched2.run(max_jobs=len(queued_now),
+                      idle_timeout_s=120.0) == len(queued_now)
+    for job_id, _, _ in jobs:
+        doc = jobspec.read_result(spool, job_id)
+        assert doc["ok"], doc
+        assert doc["result"]["report"] == \
+            oracle[job_id]["result"]["report"]
+
+
+def test_work_steal_exactly_once(tmp_path):
+    """An idle worker steals a backlogged neighbor's unclaimed queue
+    entry (the decide_shard_speculation shape, unit-granular) and the
+    job produces exactly ONE durable result — the no-double-count
+    pin."""
+    inp = _synth_reads(tmp_path / "reads", 24_000, 6)
+    jobs = [(f"j{i}", f"t{i}", inp) for i in range(3)]
+    oracle = _oracle_results(tmp_path, jobs)
+
+    spool = str(tmp_path / "spool")
+    _submit(spool, jobs)
+    # worker 0 crawls (every dispatch +1.5 s) so its queued job is
+    # still unclaimed when worker 1 drains; max_concurrent=1 keeps the
+    # backlog in queue/ (claimed jobs are never stealable)
+    env = _chaos_env(tmp_path, [
+        {"site": "device_dispatch", "fault": "latency",
+         "latency_s": 1.5, "occurrence": "1+", "worker": 0}])
+    sidecar = str(tmp_path / "sched.metrics.jsonl")
+    with obs.metrics_run(sidecar, argv=["fleet-steal"], config={}):
+        sched = FleetServeScheduler(spool, hosts=2, chunk_rows=CHUNK,
+                                    poll_s=0.02, max_concurrent=1,
+                                    worker_depth=2, env=env)
+        assert sched.run(max_jobs=3, idle_timeout_s=180.0) == 3
+    for job_id, _, _ in jobs:
+        doc = jobspec.read_result(spool, job_id)
+        assert doc["ok"], doc
+        assert doc["result"]["report"] == \
+            oracle[job_id]["result"]["report"]
+        # exactly one durable result doc per job across done/ + failed/
+        hits = [p for p in
+                glob.glob(os.path.join(spool, "*", f"{job_id}.json"))
+                if os.path.basename(os.path.dirname(p)) in
+                (jobspec.DONE, jobspec.FAILED)]
+        assert len(hits) == 1, hits
+    evs = _events(sidecar)
+    steals = [e for e in evs if e["event"] == "job_requeued"
+              and e["cause"] == "steal"]
+    assert steals, "the idle worker should have stolen the backlog"
+    assert all(e["action"] == "steal" and e["moves"] for e in steals)
+    _run_validators(sidecar)
+
+
+def test_steal_never_ping_pongs_single_job(tmp_path):
+    """A 1-deep worker is not a donor: with one unclaimed job at worker
+    0 and worker 1 empty (two booting workers, nobody claiming yet),
+    the steal round must NOT move the job — a steal that merely swaps
+    the imbalance would ping-pong the entry (and spam steal events)
+    every poll round until a worker finally claims it.  With a second
+    job queued at worker 0, stealing resumes and strictly improves
+    balance."""
+
+    class _FakeProc:
+        def poll(self):
+            return None
+
+    spool = str(tmp_path / "spool")
+    jobspec.ensure_spool(spool)
+    sched = FleetServeScheduler(spool, hosts=2, chunk_rows=CHUNK)
+    fleet = os.path.join(spool, "fleet")
+    from adam_tpu.serve.scheduler import _WorkerState
+    for w in (0, 1):
+        jobspec.ensure_spool(worker_spool(fleet, w))
+        st = _WorkerState(w)
+        st.proc = _FakeProc()
+        sched.states[w] = st
+
+    def _queue_file(w, seq, job_id):
+        path = os.path.join(worker_spool(fleet, w), jobspec.QUEUE,
+                            f"{seq:08d}-{job_id}.json")
+        with open(path, "w") as f:
+            json.dump({"job_id": job_id, "tenant": "t",
+                       "command": "flagstat", "input": "/x"}, f)
+        return path
+
+    lone = _queue_file(0, 1, "lone")
+    for _ in range(3):
+        sched._steal_round()
+        assert os.path.exists(lone), \
+            "a 1-deep donor's only job must not move"
+    # a real backlog (2 in flight at worker 0) donates exactly one
+    _queue_file(0, 2, "extra")
+    sched._steal_round()
+    moved = [n for n in os.listdir(os.path.join(
+        worker_spool(fleet, 1), jobspec.QUEUE))
+        if jobspec._NAME_RE.match(n)]
+    assert len(moved) == 1
+    sched._steal_round()    # balanced 1/1 now: nothing more moves
+    moved2 = [n for n in os.listdir(os.path.join(
+        worker_spool(fleet, 1), jobspec.QUEUE))
+        if jobspec._NAME_RE.match(n)]
+    assert moved2 == moved
+
+
+def test_relay_dedups_duplicate_results(tmp_path):
+    """The structural exactly-once half of stealing/requeueing: when a
+    race leaves TWO workers committing the same job id, the first
+    durable relay wins and the duplicate drops — the front spool never
+    ends up with a torn or double-counted result."""
+    spool = str(tmp_path / "spool")
+    jobspec.ensure_spool(spool)
+    sched = FleetServeScheduler(spool, hosts=2, chunk_rows=CHUNK)
+    fleet = os.path.join(spool, "fleet")
+    from adam_tpu.serve.scheduler import _WorkerState
+    for w in (0, 1):
+        jobspec.ensure_spool(worker_spool(fleet, w))
+        sched.states[w] = _WorkerState(w)
+        with open(os.path.join(worker_spool(fleet, w), jobspec.DONE,
+                               "dup.json"), "w") as f:
+            json.dump({"job_id": "dup", "tenant": "t", "ok": True,
+                       "command": "flagstat",
+                       "result": {"from_worker": w}}, f)
+    assert sched._relay_results() == 1
+    assert sched.jobs_served == 1
+    doc = jobspec.read_result(spool, "dup")
+    assert doc["result"]["from_worker"] == 0    # first relay won
+    # the duplicate is gone, not waiting to clobber the winner later
+    assert not os.path.exists(os.path.join(
+        worker_spool(fleet, 1), jobspec.DONE, "dup.json"))
+
+
+def test_sharded_big_job_merges_exact(tmp_path):
+    """A big flagstat job splits into per-range sub-jobs via the
+    existing decide_shard_plan, lands across the fleet, and the merged
+    counter monoid is byte-identical to the solo report (with the
+    sub-job count stamped in the result)."""
+    inp = _synth_reads(tmp_path / "reads", 40_000, 7)
+    solo = _solo_report(inp)
+    small_inp = _synth_reads(tmp_path / "reads_small", 8_000, 8)
+    solo_small = _solo_report(small_inp)
+    spool = str(tmp_path / "spool")
+    _submit(spool, [("big", "alice", inp), ("small", "bob", small_inp)])
+    sidecar = str(tmp_path / "sched.metrics.jsonl")
+    with obs.metrics_run(sidecar, argv=["fleet-shard"], config={}):
+        sched = FleetServeScheduler(spool, hosts=2, chunk_rows=CHUNK,
+                                    poll_s=0.02, shard_rows=30_000)
+        assert sched.run(max_jobs=2, idle_timeout_s=180.0) == 2
+    doc = jobspec.read_result(spool, "big")
+    assert doc["ok"], doc
+    assert doc["result"]["report"] == solo
+    assert doc["result"]["sharded"] == 2
+    # the small job stayed whole (below the shard floor)
+    small = jobspec.read_result(spool, "small")
+    assert small["ok"] and small["result"]["report"] == solo_small
+    assert "sharded" not in small["result"]
+    evs = _events(sidecar)
+    plans = [e for e in evs if e["event"] == "shard_plan_selected"]
+    assert len(plans) == 1 and plans[0]["n_hosts"] == 2
+    _run_validators(sidecar)
+
+
+# ---------------------------------------------------------------------------
+# worker-scoped fault plumbing + the committed artifact
+# ---------------------------------------------------------------------------
+
+def test_worker_scoping_digest_compat():
+    """decide_fault without a worker key digests exactly as before the
+    fleet-serve scope existed — pre-fleet sidecars keep replaying —
+    and the worker joins the inputs only when set (the shard/tenant
+    discipline)."""
+    from adam_tpu.resilience import faults
+
+    rules = [{"site": "device_dispatch", "fault": "error",
+              "error": "ABORTED", "occurrence": 1, "worker": 1}]
+    d_none = faults.decide_fault(site="device_dispatch", occurrence=1,
+                                 rules=rules)
+    assert not d_none["fire"] and "worker" not in d_none["inputs"]
+    d_0 = faults.decide_fault(site="device_dispatch", occurrence=1,
+                              worker=0, rules=rules)
+    assert not d_0["fire"] and d_0["inputs"]["worker"] == 0
+    d_1 = faults.decide_fault(site="device_dispatch", occurrence=1,
+                              worker=1, rules=rules)
+    assert d_1["fire"] and d_1["fault"] == "error"
+    assert len({d["input_digest"] for d in (d_none, d_0, d_1)}) == 3
+
+
+def test_committed_fleet_serve_artifact_gates():
+    """The committed BENCH_FLEET_SERVE.json must keep the gate-6
+    numbers: identity + zero recompiles per worker unconditionally,
+    the 2-worker scaling floor when the box's measured capacity armed
+    it (tools/bench_gate.py gate 6 enforces this forever; this pin
+    fails earlier and closer to the numbers)."""
+    with open(os.path.join(ROOT, "BENCH_FLEET_SERVE.json")) as f:
+        doc = json.load(f)
+    assert doc["fleet_serve_identical"] is True
+    assert doc["fleet_serve_recompiles"] == 0
+    assert isinstance(doc["fleet_serve_speedup_2"], (int, float))
+    if doc.get("host_parallel_capacity", 0) >= 1.2:
+        assert doc["fleet_serve_speedup_2"] >= 1.05

@@ -14,7 +14,11 @@ and fails when:
   clock or env read inside the decision); the same replay runs for the
   fleet's ``shard_plan_selected`` (decide_shard_plan) and
   ``shard_reassigned`` (decide_shard_reassignment /
-  decide_shard_speculation, selected by the recorded ``cause``);
+  decide_shard_speculation, selected by the recorded ``cause``), the
+  serve front-end's ``admission_selected`` (decide_admission), and
+  the fleet-serve scheduler's ``placement_selected``
+  (decide_placement) and ``job_requeued`` (decide_requeue /
+  decide_steal, selected by the recorded ``cause``);
 * the recorded ``input_digest`` does not match the digest of the
   recorded inputs (the event lied about what it decided from);
 * two events — within one file or across files — share an
@@ -72,6 +76,14 @@ SHARD_SPEC_FIELDS = ("action", "victim", "target", "tail_runs",
 #: dispatches; same purity contract)
 ADMISSION_FIELDS = ("admit", "pack_groups", "reason")
 
+#: the fleet-serve scheduler fields a replay must reproduce exactly
+#: (serve/scheduler.decide_placement / decide_requeue / decide_steal —
+#: ``job_requeued`` picks its decider by the recorded ``cause``, the
+#: shard_reassigned discipline)
+PLACEMENT_FIELDS = ("place", "reason")
+REQUEUE_FIELDS = ("action", "reason")
+STEAL_FIELDS = ("action", "moves", "reason")
+
 #: fields absent from older sidecars: compared only when recorded
 _OPTIONAL_FIELDS = ("layout",)
 
@@ -83,7 +95,8 @@ _LAYOUT_KINDS = ("executor_bucket_selected", "realign_plan_selected")
 
 _REPLAYED = ("executor_bucket_selected", "fusion_plan_selected",
              "realign_plan_selected", "shard_plan_selected",
-             "shard_reassigned", "admission_selected")
+             "shard_reassigned", "admission_selected",
+             "placement_selected", "job_requeued")
 
 
 def _events(path: str, kinds=_REPLAYED) -> List[Tuple[int, dict]]:
@@ -111,6 +124,8 @@ def check(paths: List[str]) -> List[str]:
                                                decide_shard_reassignment,
                                                decide_shard_speculation)
     from adam_tpu.serve.admission import decide_admission
+    from adam_tpu.serve.scheduler import (decide_placement,
+                                          decide_requeue, decide_steal)
 
     deciders = {"executor_bucket_selected": (decide_plan, PLAN_FIELDS),
                 "fusion_plan_selected": (decide_fusion_plan,
@@ -120,7 +135,9 @@ def check(paths: List[str]) -> List[str]:
                 "shard_plan_selected": (decide_shard_plan,
                                         SHARD_PLAN_FIELDS),
                 "admission_selected": (decide_admission,
-                                       ADMISSION_FIELDS)}
+                                       ADMISSION_FIELDS),
+                "placement_selected": (decide_placement,
+                                       PLACEMENT_FIELDS)}
     errs: List[str] = []
     # digests are namespaced per event kind: the two deciders hash
     # different input tuples and must never cross-validate
@@ -143,6 +160,13 @@ def check(paths: List[str]) -> List[str]:
                 else:
                     decider, fields = (decide_shard_reassignment,
                                        SHARD_DEATH_FIELDS)
+            elif kind == "job_requeued":
+                # same discipline: steal events came from decide_steal,
+                # every other cause from decide_requeue
+                if ev.get("cause") == "steal":
+                    decider, fields = (decide_steal, STEAL_FIELDS)
+                else:
+                    decider, fields = (decide_requeue, REQUEUE_FIELDS)
             else:
                 decider, fields = deciders[kind]
             inputs = ev.get("inputs")

@@ -82,7 +82,25 @@ elastic worker sidecars).  Contract checked here:
 * ``tenant_job`` events carry ``job_id``/``tenant``/``command``
   (strings), ``status`` (ok/failed), ``seconds`` (number >= 0) and
   ``compiles`` (int >= 0) — one per served job, the per-tenant label
-  sidecar consumers split on;
+  sidecar consumers split on; optional ``queue_s``/``service_s``
+  (numbers >= 0) split the job's latency into submit→start wait and
+  execution wall — the per-tenant SLO numbers the serve shutdown
+  report summarizes as p50/p99;
+* ``placement_selected`` events (the fleet-serve cluster scheduler,
+  adam_tpu/serve/scheduler.py) carry ``place`` (a list of
+  ``[job_id, worker]`` pairs), ``reason`` (str), ``inputs`` (object)
+  and a hex ``input_digest`` (tools/check_executor.py replays the
+  decision);
+* ``job_requeued`` events carry ``cause``
+  (worker_death/lease_expiry/drain/steal), ``action``
+  (requeue/quarantine/steal), ``reason`` (str), ``inputs`` (object)
+  and a hex ``input_digest`` (replayed by tools/check_executor.py);
+  steal events carry ``moves`` (``[job_id, from, to]`` triples), the
+  rest carry the ``job_id`` being requeued or quarantined;
+* ``worker_lease_expired`` events carry ``worker`` (int >= 0),
+  ``age_s`` (>= 0) and ``ttl_s`` (> 0) — a fleet-serve worker's
+  heartbeat went stale past its lease (the scheduler fences it with
+  SIGKILL before requeuing its jobs);
 * ``startup_seconds`` events carry only non-negative numeric fields —
   the cold-start breakdown (backend init / first compile / first
   dispatch) every command stamps so the serve warmup win is measured
@@ -131,6 +149,7 @@ KNOWN_EVENTS = (
     "shard_merge",
     "admission_selected", "tenant_job", "startup_seconds",
     "serve_boot", "serve_pack_dispatch", "serve_pack_degraded",
+    "placement_selected", "job_requeued", "worker_lease_expired",
     "ledger_stage",
 )
 
@@ -145,6 +164,8 @@ _RETRY_ACTIONS = ("retry", "split", "fallback_cpu", "raise")
 _SHARD_CAUSES = ("death", "speculation")
 _SHARD_ACTIONS = ("none", "respawn", "redistribute", "fail",
                   "speculate")
+_REQUEUE_CAUSES = ("worker_death", "lease_expiry", "drain", "steal")
+_REQUEUE_ACTIONS = ("requeue", "quarantine", "steal")
 
 
 def _is_hex(v) -> bool:
@@ -584,6 +605,63 @@ def validate(path: str) -> List[str]:
             if not (isinstance(c, int) and not isinstance(c, bool)
                     and c >= 0):
                 err(i, "tenant_job missing non-negative int 'compiles'")
+            for field in ("queue_s", "service_s"):
+                if field in d and not (_is_num(d[field]) and
+                                       d[field] >= 0):
+                    err(i, f"tenant_job {field!r} must be a "
+                           "non-negative number (the per-tenant SLO "
+                           "latency split)")
+        elif ev == "placement_selected":
+            place = d.get("place")
+            if not (isinstance(place, list) and all(
+                    isinstance(p, list) and len(p) == 2 and
+                    isinstance(p[0], str) and p[0] and
+                    isinstance(p[1], int) and not isinstance(p[1], bool)
+                    and p[1] >= 0 for p in place)):
+                err(i, "placement_selected 'place' is not a list of "
+                       "[job_id, worker] pairs")
+            if not isinstance(d.get("reason"), str):
+                err(i, "placement_selected missing string 'reason'")
+            if not isinstance(d.get("inputs"), dict):
+                err(i, "placement_selected missing 'inputs' object "
+                       "(decision must be replayable)")
+            if not _is_hex(d.get("input_digest")):
+                err(i, "placement_selected missing hex 'input_digest'")
+        elif ev == "job_requeued":
+            if d.get("cause") not in _REQUEUE_CAUSES:
+                err(i, f"job_requeued unknown cause {d.get('cause')!r}")
+            if d.get("action") not in _REQUEUE_ACTIONS:
+                err(i, f"job_requeued unknown action "
+                       f"{d.get('action')!r}")
+            if d.get("cause") == "steal":
+                moves = d.get("moves")
+                if not (isinstance(moves, list) and all(
+                        isinstance(m, list) and len(m) == 3 and
+                        isinstance(m[0], str) and m[0] and
+                        all(isinstance(x, int) and
+                            not isinstance(x, bool) and x >= 0
+                            for x in m[1:]) for m in moves)):
+                    err(i, "job_requeued (steal) 'moves' is not a list "
+                           "of [job_id, from, to] triples")
+            elif not isinstance(d.get("job_id"), str):
+                err(i, "job_requeued missing string 'job_id'")
+            if not isinstance(d.get("reason"), str):
+                err(i, "job_requeued missing string 'reason'")
+            if not isinstance(d.get("inputs"), dict):
+                err(i, "job_requeued missing 'inputs' object "
+                       "(decision must be replayable)")
+            if not _is_hex(d.get("input_digest")):
+                err(i, "job_requeued missing hex 'input_digest'")
+        elif ev == "worker_lease_expired":
+            w = d.get("worker")
+            if not (isinstance(w, int) and not isinstance(w, bool)
+                    and w >= 0):
+                err(i, "worker_lease_expired missing int 'worker' >= 0")
+            if not (_is_num(d.get("age_s")) and d["age_s"] >= 0):
+                err(i, "worker_lease_expired missing non-negative "
+                       "'age_s'")
+            if not (_is_num(d.get("ttl_s")) and d["ttl_s"] > 0):
+                err(i, "worker_lease_expired missing positive 'ttl_s'")
         elif ev == "startup_seconds":
             for k, v in d.items():
                 if k in ("event", "t"):
