@@ -503,6 +503,58 @@ def count_kernel_ragged(rb, state_flat, usable, n_qual_rg: int,
                           n_cycle=n_cycle, cyc_bins=cyc_bins)
 
 
+#: the five flat planes the paged count pool pages (name, dtype) — the
+#: ragged layout's [T]-sized shipping cost, now delta-only resident
+PAGED_COUNT_PLANES = (("bases", "int8"), ("quals", "int8"),
+                      ("state", "int8"), ("row_of", "int32"),
+                      ("pos_of", "int32"))
+
+
+def count_kernel_paged(pools: dict, page_table, *, row_starts, read_len,
+                       flags, read_group, usable, n_bases: int,
+                       n_rows: int, n_qual_rg: int, n_cycle: int,
+                       max_read_len: int, interpret: bool = False,
+                       int8_mxu: bool = False, impl: str = "auto"):
+    """Paged twin of :func:`count_kernel_ragged` — same 7-tensor
+    contract, fed by the RESIDENT page pools instead of freshly shipped
+    flat planes (docs/ARCHITECTURE.md §6l).
+
+    ``pools`` maps each :data:`PAGED_COUNT_PLANES` name to its
+    ``[pool_pages, page_rows]`` device array; ``page_table`` lists the
+    physical pages of this chunk's flat planes in logical order.  One
+    gather per plane reconstructs exactly the arrays the ragged kernel
+    would receive — the page-table walk IS the prefix-sum row walk,
+    relocated into residency — then the identical prologue + sweep
+    runs, so the tables are bit-identical to :func:`count_kernel_ragged`
+    (and through it to the padded scatter oracle) by construction,
+    pinned by tests/test_paged.py.  Scalar per-read columns ([N]-sized,
+    a rounding error next to the [T] planes) still ship per chunk.
+    """
+    from types import SimpleNamespace
+
+    from ..parallel.pagedbuf import gather_pages
+
+    pt = jnp.asarray(page_table, jnp.int32)
+    # the gathered view IS the RaggedBatch the ragged kernel consumes
+    # (count_kernel_ragged reads row_offsets[:-1] — the row starts),
+    # so the identity is literal delegation, never a copied epilogue
+    starts = jnp.asarray(row_starts, jnp.int32)
+    view = SimpleNamespace(
+        bases_flat=gather_pages(pools["bases"], pt),
+        quals_flat=gather_pages(pools["quals"], pt),
+        row_of=gather_pages(pools["row_of"], pt),
+        pos_of=gather_pages(pools["pos_of"], pt),
+        row_offsets=jnp.concatenate([starts, jnp.zeros(1, jnp.int32)]),
+        read_len=read_len, flags=flags, read_group=read_group,
+        n_bases=int(n_bases), n_reads=int(n_rows))
+    return count_kernel_ragged(view, gather_pages(pools["state"], pt),
+                               usable, n_qual_rg=n_qual_rg,
+                               n_cycle=n_cycle,
+                               max_read_len=max_read_len,
+                               interpret=interpret, int8_mxu=int8_mxu,
+                               impl=impl)
+
+
 def flatten_state(state, read_len, t_pad: int):
     """[N, L] mismatch-state plane -> flat [t_pad] by true lengths
     (row-major — concatenation order), STATE_MASKED in the slack."""

@@ -642,6 +642,64 @@ def _sharded_pallas_fn(mesh, n_qual_rg: int, n_cycle: int, variant: str,
                                 interpret=interpret)
 
 
+def _paged_count(box: dict, rb, state_flat, usable, rt, max_read_len):
+    """One chunk's count through the RESIDENT plane pool
+    (parallel/pagedbuf; docs/ARCHITECTURE.md §6l).
+
+    ``box`` is the pass-scoped pool holder ``_count_stream`` threads
+    through every chunk ({"pass": name, "put": pex.dispatch_put});
+    the pool is created lazily, sized to twice the first chunk's page
+    need, and persists across chunks — each chunk ships only its live
+    pages (the [T]-sized planes; the rung slack past the last page
+    never crosses the link) and the kernel walks the page table.
+    Returns None when the pool would thrash (a later chunk outgrowing
+    it): the caller's ragged concat path is the fallback, identical
+    bytes by the count monoid."""
+    import numpy as np
+
+    from ..parallel.pagedbuf import PagePool
+    from ..platform import is_tpu_backend
+    from .count_pallas import (BLOCK_ELEMS, PAGED_COUNT_PLANES,
+                               count_kernel_paged)
+
+    t_pad = len(rb.bases_flat)
+    page_rows = BLOCK_ELEMS         # every t-rung is a BLOCK_ELEMS
+    #                                 multiple (shape_rung over it)
+    table_len = max(t_pad // page_rows, 1)
+    # ship only the LIVE pages (true base count, rounded up to a whole
+    # page) — the rung slack past them never crosses the link; the page
+    # table pads to the rung with the last live page, whose stale
+    # content is weight-gated off by the kernel's ``live`` bound
+    need = min(max(-(-int(rb.n_bases) // page_rows), 1), table_len)
+    pool = box.get("pool")
+    if pool is None:
+        pool = box["pool"] = PagePool(
+            box.get("pass", "p2"), table_len * 2, page_rows,
+            planes=PAGED_COUNT_PLANES, put=box.get("put"))
+    ids = pool.alloc(need)
+    if ids is None:
+        return None
+    live = need * page_rows
+    pool.write(ids, bases=rb.bases_flat[:live],
+               quals=rb.quals_flat[:live],
+               state=np.asarray(state_flat)[:live],
+               row_of=rb.row_of[:live], pos_of=rb.pos_of[:live])
+    try:
+        return count_kernel_paged(
+            {n: pool.device(n) for n, _ in PAGED_COUNT_PLANES},
+            pool.table(ids, table_len),
+            row_starts=rb.row_offsets[:-1], read_len=rb.read_len,
+            flags=rb.flags, read_group=rb.read_group, usable=usable,
+            n_bases=rb.n_bases, n_rows=rb.n_reads,
+            n_qual_rg=rt.n_qual_rg, n_cycle=rt.n_cycle,
+            max_read_len=max_read_len,
+            interpret=not is_tpu_backend())
+    finally:
+        # the dispatch is enqueued on the device stream before any
+        # later scatter can recycle these pages (FIFO ordering)
+        pool.free(ids)
+
+
 def count_tables_device(table: pa.Table,
                         batch: Optional[ReadBatch] = None,
                         snp_table: Optional[SnpTable] = None,
@@ -650,7 +708,8 @@ def count_tables_device(table: pa.Table,
                         device_batch: Optional[ReadBatch] = None,
                         donate: bool = False,
                         md_info=None,
-                        layout: str = "padded"):
+                        layout: str = "padded",
+                        paged_box: Optional[dict] = None):
     """Pass-1 counting for one chunk, WITHOUT the host sync: returns the 7
     count tensors (qual_obs, qual_mm, cycle_obs, cycle_mm, ctx_obs,
     ctx_mm, qhist) still on device (numpy under the "host" impl — both add
@@ -679,9 +738,10 @@ def count_tables_device(table: pa.Table,
         n_read_groups = int(np.asarray(batch.read_group).max(initial=0)) + 1
     sharded = mesh is not None and mesh.size > 1 and \
         batch.n_reads % mesh.size == 0
-    # the ragged layout is an unsharded dispatch (the plan demotes it on
-    # multi-shard meshes — executor.decide_plan's ragged_capable gate)
-    lay = layout if layout == "ragged" and not sharded else "padded"
+    # the ragged/paged layouts are unsharded dispatches (the plan
+    # demotes them on multi-shard meshes — decide_plan's capable gates)
+    lay = layout if layout in ("ragged", "paged") and not sharded \
+        else "padded"
     slab = _count_slab_rows()
     if not sharded and batch.n_reads > slab:
         acc = None
@@ -693,14 +753,15 @@ def count_tables_device(table: pa.Table,
                                     donate=donate,
                                     md_info=None if md_info is None
                                     else slice_md_info(md_info, s, e),
-                                    layout=lay)
+                                    layout=lay, paged_box=paged_box)
             acc = out if acc is None else tuple(
                 a + b for a, b in zip(acc, out))
         return acc
     return _count_tables_one(table, batch, snp_table, n_read_groups,
                              mesh if sharded else None,
                              device_batch=device_batch, donate=donate,
-                             md_info=md_info, layout=lay)
+                             md_info=md_info, layout=lay,
+                             paged_box=paged_box)
 
 
 def _count_tables_one(table: pa.Table, batch: ReadBatch,
@@ -708,7 +769,8 @@ def _count_tables_one(table: pa.Table, batch: ReadBatch,
                       n_read_groups: int, mesh,
                       device_batch: Optional[ReadBatch] = None,
                       donate: bool = False,
-                      md_info=None, layout: str = "padded"):
+                      md_info=None, layout: str = "padded",
+                      paged_box: Optional[dict] = None):
     """One slab's pass-1 count (the pre-slab body of
     :func:`count_tables_device`)."""
     n = table.num_rows
@@ -730,7 +792,7 @@ def _count_tables_one(table: pa.Table, batch: ReadBatch,
     rt = RecalTable(n_read_groups=max(n_read_groups, 1),
                     max_read_len=batch.max_len)
     sharded = mesh is not None
-    if layout == "ragged" and not sharded:
+    if layout in ("ragged", "paged") and not sharded:
         # the ragged layout (docs/ARCHITECTURE.md §6g): flatten the
         # padded planes by true lengths and count over T real bases —
         # the per-read cycle walk rides the prefix-sum row index, so no
@@ -750,6 +812,14 @@ def _count_tables_one(table: pa.Table, batch: ReadBatch,
             rb = ragged_from_batch(batch, pad_bases_to=t_rung)
             state_flat = flatten_state(state, rb.read_len,
                                        len(rb.bases_flat))
+            if layout == "paged" and paged_box is not None:
+                # resident paged planes (docs/ARCHITECTURE.md §6l):
+                # ship only this chunk's live pages; a thrashing pool
+                # answers None and the ragged concat runs instead
+                out = _paged_count(paged_box, rb, state_flat, usable,
+                                   rt, batch.max_len)
+                if out is not None:
+                    return out
             return count_kernel_ragged(
                 rb, state_flat, usable, n_qual_rg=rt.n_qual_rg,
                 n_cycle=rt.n_cycle, max_read_len=batch.max_len,

@@ -51,7 +51,8 @@ def fsync_dir(path: str) -> None:
 
 
 def atomic_write(path: str, payload: str, *,
-                 fault_site: Optional[str] = None) -> None:
+                 fault_site: Optional[str] = None,
+                 fsync: bool = True) -> None:
     """THE durable atomic text write: tmp in the target's directory,
     flush + fsync the content, optional fault-injection hook on the
     in-flight tmp (`checkpoint_write` truncation = a torn power-loss
@@ -60,18 +61,25 @@ def atomic_write(path: str, payload: str, *,
     the streaming checkpoint in parallel/pipeline.py, the evidence
     ledger) so the durability discipline cannot drift between copies.
     A fired fault leaves the torn tmp behind — that IS the post-crash
-    disk state the resume paths must tolerate."""
+    disk state the resume paths must tolerate.
+
+    ``fsync=False`` keeps the tmp+rename atomicity but skips BOTH
+    syncs — for writers whose content durability is not load-bearing
+    and who batch their own directory fsync per round (the heartbeat
+    lease renewal, parallel/shardstream.py)."""
     parent = os.path.dirname(os.path.abspath(path)) or "."
     os.makedirs(parent, exist_ok=True)
     fd, tmp = tempfile.mkstemp(dir=parent, suffix=".tmp")
     with os.fdopen(fd, "w") as f:
         f.write(payload)
-        f.flush()
-        os.fsync(f.fileno())
+        if fsync:
+            f.flush()
+            os.fsync(f.fileno())
     if fault_site is not None:
         _faults.fire(fault_site, path=tmp)
     os.replace(tmp, path)
-    fsync_dir(parent)
+    if fsync:
+        fsync_dir(parent)
 
 
 def atomic_np_write(path: str, writer: Callable) -> str:

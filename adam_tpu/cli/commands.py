@@ -83,6 +83,23 @@ def add_executor_args(p: argparse.ArgumentParser) -> None:
     g.add_argument("-no_ragged", action="store_true",
                    help="force the padded layout (the escape hatch; "
                         "ADAM_TPU_RAGGED=0 is the env equivalent)")
+    gp = p.add_mutually_exclusive_group()
+    gp.add_argument("-paged", action="store_true",
+                    help="route every paged-capable pass through the "
+                         "RESIDENT page pool (ragged addressing + "
+                         "page-granular residency: only delta pages "
+                         "cross the host→device link; "
+                         "docs/EXECUTOR.md §6, ADAM_TPU_PAGED=1)")
+    gp.add_argument("-no_paged", action="store_true",
+                    help="force the page pool off even when "
+                         "ADAM_TPU_PAGED is set in the environment")
+    p.add_argument("-page_rows", type=int, default=None, metavar="N",
+                   help="flat elements per page (default 32768 for the "
+                        "wire plane; ADAM_TPU_PAGE_ROWS)")
+    p.add_argument("-pool_pages", type=int, default=None, metavar="N",
+                   help="pages in the resident pool (default sized to "
+                        "the prefetch depth + one dispatch; "
+                        "ADAM_TPU_POOL_PAGES)")
 
 
 def add_fleet_args(p: argparse.ArgumentParser) -> None:
@@ -145,7 +162,9 @@ def fleet_worker_env(args) -> dict:
     that tunes the single-host path must not silently drop the moment
     ``-hosts`` is added."""
     from ..parallel.executor import (AUTOTUNE_ENV, LADDER_BASE_ENV,
-                                     PREFETCH_ENV, RAGGED_ENV)
+                                     PAGE_ROWS_ENV, PAGED_ENV,
+                                     POOL_PAGES_ENV, PREFETCH_ENV,
+                                     RAGGED_ENV)
     from ..resilience.retry import RETRY_BUDGET_ENV
 
     env = dict(os.environ)
@@ -161,6 +180,14 @@ def fleet_worker_env(args) -> dict:
         env[RAGGED_ENV] = "1"
     elif getattr(args, "no_ragged", False):
         env[RAGGED_ENV] = "0"
+    if getattr(args, "paged", False):
+        env[PAGED_ENV] = "1"
+    elif getattr(args, "no_paged", False):
+        env[PAGED_ENV] = "0"
+    if getattr(args, "page_rows", None) is not None:
+        env[PAGE_ROWS_ENV] = str(args.page_rows)
+    if getattr(args, "pool_pages", None) is not None:
+        env[POOL_PAGES_ENV] = str(args.pool_pages)
     return env
 
 
@@ -180,6 +207,14 @@ def executor_opts_from(args) -> dict:
         opts["ragged"] = True
     elif getattr(args, "no_ragged", False):
         opts["ragged"] = False
+    if getattr(args, "paged", False):
+        opts["paged"] = True
+    elif getattr(args, "no_paged", False):
+        opts["paged"] = False
+    if getattr(args, "page_rows", None) is not None:
+        opts["page_rows"] = args.page_rows
+    if getattr(args, "pool_pages", None) is not None:
+        opts["pool_pages"] = args.pool_pages
     return opts
 
 

@@ -64,6 +64,7 @@ STAGE_SUCCESS_KEYS = {
     "ragged_race": ("ragged_realign_ragged_per_sec",
                     "ragged_bqsr_ragged_per_sec",
                     "ragged_flagstat_ragged_per_sec"),
+    "paged_race": ("paged_h2d_reduction",),
 }
 
 #: pallas is special: the ok flags are present on failure too (False)

@@ -65,7 +65,12 @@ STAGE_DEADLINES_S = {"probe": 150.0, "flagstat": 180.0, "transform": 280.0,
                      # fleet-serve scaling (two fleets, 1+2 warm worker
                      # boots, 2K jobs); never in the TPU capture order —
                      # reached only via --worker/--only fleet_serve
-                     "fleet_serve": 600.0}
+                     "fleet_serve": 600.0,
+                     # resident paged buffers: kernel-twin identity +
+                     # the in-process serve steady-state h2d leg; never
+                     # in the TPU capture order — reached only via
+                     # --worker/--only paged_race
+                     "paged_race": 400.0}
 
 TIMEOUTS_ENV = "ADAM_TPU_BENCH_STAGE_TIMEOUTS"
 

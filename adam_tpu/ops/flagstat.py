@@ -313,6 +313,31 @@ def flagstat_kernel_wire32_segmented(wire: jnp.ndarray,
     return jnp.stack(out, axis=-1)                           # [S, K, 2]
 
 
+@jax.jit
+def flagstat_kernel_wire32_segmented_paged(pool: jnp.ndarray,
+                                           page_table: jnp.ndarray,
+                                           bounds: jnp.ndarray
+                                           ) -> jnp.ndarray:
+    """[S, 18, 2] per-tenant counters off the RESIDENT page pool — the
+    paged twin of :func:`flagstat_kernel_wire32_segmented` and the
+    serve front-end's continuous-batching dispatch (serve/packed.py,
+    docs/ARCHITECTURE.md §6l).
+
+    One gather assembles the logical shared wire from
+    ``pool[page_table]`` (pages filled in admission order; only DELTA
+    pages ever crossed the link), then the same segment fold runs over
+    the same positional bounds — so a tenant's counters under paging
+    equal its solo run bit-for-bit however its rows landed in pages
+    (the PR 10 identity matrix re-run under paging,
+    tests/test_paged.py).  The compiled shape depends only on
+    (pool geometry, table length, S): one executable per serve
+    lifetime."""
+    from ..parallel.pagedbuf import gather_pages
+
+    wire = gather_pages(pool, page_table)
+    return flagstat_kernel_wire32_segmented(wire, bounds)
+
+
 _flagstat_jit = jax.jit(partial(flagstat_kernel, axis_name=None))
 
 

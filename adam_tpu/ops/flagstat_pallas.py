@@ -446,6 +446,134 @@ def flagstat_wire32_ragged_xla(wire, row_offsets) -> jnp.ndarray:
                                  jnp.int32(int(offs[-1])))
 
 
+# ---------------------------------------------------------------------------
+# paged wire sweep: the page table replaces the fresh concat buffer
+# ---------------------------------------------------------------------------
+#
+# The ragged sweep still consumes a freshly concatenated host buffer —
+# one full-capacity device_put per dispatch, slack included.  The paged
+# twin (docs/ARCHITECTURE.md §6l) reads the RESIDENT page pool
+# (parallel/pagedbuf.PagePool): grid step i scalar-prefetches the page
+# table and pulls physical page ``page_table[i]`` straight from the
+# pool, so only delta pages ever crossed the link.  Validity stays
+# positional — logical flat index below the prefix-sum total — exactly
+# the ragged kernel's bound, so the two are bit-identical by
+# construction over any page placement.
+
+def _kernel_paged(pt_ref, total_ref, pool_ref, out_ref):
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _init():
+        for k in range(18):
+            out_ref[k, 0] = 0
+            out_ref[k, 1] = 0
+
+    wire = pool_ref[...]            # physical page pt[i], via index_map
+    rows, lanes = wire.shape
+    # LOGICAL flat index: position in page-table order, not in the pool
+    idx = (i * rows * lanes
+           + jax.lax.broadcasted_iota(jnp.int32, (rows, lanes), 0) * lanes
+           + jax.lax.broadcasted_iota(jnp.int32, (rows, lanes), 1))
+    live = idx < total_ref[0]
+    inds, passed, failed = _wire_masks(wire)
+    passed &= live
+    failed &= live
+    for k, ind in enumerate(inds):
+        out_ref[k, 0] += jnp.sum((ind & passed).astype(jnp.int32))
+        out_ref[k, 1] += jnp.sum((ind & failed).astype(jnp.int32))
+
+
+def _blocked_call_paged(pool3, page_table, total, *, interpret: bool):
+    from jax.experimental.pallas import tpu as pltpu
+
+    n_logical = page_table.shape[0]
+    _, rows, lanes = pool3.shape
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(n_logical,),
+        in_specs=[pl.BlockSpec((None, rows, lanes),
+                               lambda i, pt_ref, total_ref:
+                               (pt_ref[i], 0, 0))],
+        out_specs=pl.BlockSpec(memory_space=pltpu.SMEM),
+    )
+    return pl.pallas_call(
+        _kernel_paged,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((18, 2), jnp.int32),
+        compiler_params=pallas_tpu_compiler_params(
+            dimension_semantics=("arbitrary",)),
+        interpret=interpret,
+    )(page_table, total, pool3)
+
+
+#: sublane tile of the paged Pallas block: pages must hold whole
+#: [8, LANES] tiles to map onto kernel blocks
+_PAGE_TILE = 8 * LANES
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _flagstat_paged_pallas(pool, page_table, total, interpret=False):
+    P, page_rows = pool.shape
+    pool3 = pool.reshape(P, page_rows // LANES, LANES)
+    return _blocked_call_paged(pool3, page_table, total,
+                               interpret=interpret)
+
+
+@jax.jit
+def flagstat_wire32_paged_xla(pool, page_table, total):
+    """XLA fallback of the paged sweep (the off-TPU product path): one
+    gather assembles the logical wire from the resident pool in
+    page-table order, then the positional-bound einsum core — the
+    ragged fallback fed by residency instead of a fresh concat."""
+    from ..parallel.pagedbuf import gather_pages
+
+    wire = gather_pages(pool, page_table)
+    idx = jnp.arange(wire.shape[0], dtype=jnp.int32)
+    return flagstat_kernel_wire32(jnp.where(idx < total, wire, 0))
+
+
+def flagstat_pallas_wire32_paged(pool, page_table, total,
+                                 interpret: bool = False) -> jnp.ndarray:
+    """[18, 2] counters off the RESIDENT page pool — the paged twin of
+    :func:`flagstat_pallas_wire32_ragged`.
+
+    ``pool`` is the ``[pool_pages, page_rows]`` resident device array,
+    ``page_table`` the int32 physical-page sequence in logical order,
+    ``total`` the live-row prefix-sum bound (rows past it — including
+    the repeated pad entries at the table's tail — are slack the kernel
+    never counts).  The compiled shape depends only on the POOL
+    geometry and the table length, so a serve lifetime dispatches one
+    shape however tenants land in pages — bit-identical to the ragged
+    concat sweep over the same logical rows (tests/test_paged.py).
+    Pages whose size is not a multiple of the 8x1024 block tile route
+    through the XLA gather form.
+    """
+    pool = jnp.asarray(pool)
+    pt = jnp.asarray(page_table, jnp.int32)
+    tot = jnp.asarray(np.asarray([int(total)], np.int32))
+    if pool.shape[1] % _PAGE_TILE:
+        return flagstat_wire32_paged_xla(pool, pt, tot[0])
+    return _flagstat_paged_pallas(pool, pt, tot, interpret=interpret)
+
+
+def flagstat_paged_dispatch(pool, page_table, total, *,
+                            interpret: bool = False,
+                            use_pallas: bool = False) -> jnp.ndarray:
+    """[18, 2] counters off the resident pool — the streaming paged
+    path's dispatcher (parallel/pipeline.py), mirroring
+    :func:`flagstat_ragged_dispatch`: ``use_pallas`` routes through the
+    scalar-prefetch Mosaic sweep (interpret mode off-TPU), otherwise
+    the one-gather XLA form runs."""
+    pool = jnp.asarray(pool)
+    pt = jnp.asarray(page_table, jnp.int32)
+    if use_pallas and pool.shape[1] % _PAGE_TILE == 0:
+        tot = jnp.asarray(np.asarray([int(total)], np.int32))
+        return _flagstat_paged_pallas(pool, pt, tot,
+                                      interpret=interpret)
+    return flagstat_wire32_paged_xla(pool, pt, jnp.int32(int(total)))
+
+
 def available() -> bool:
     """True when the active backend can run the compiled kernel."""
     from ..platform import is_tpu_backend

@@ -68,6 +68,13 @@ DONATE_ENV = "ADAM_TPU_EXECUTOR_DONATE"
 #: BQSR count, realign sweep): 1/ragged forces the ragged layout,
 #: 0/off/padded forces padded; unset lets raced bench evidence decide
 RAGGED_ENV = "ADAM_TPU_RAGGED"
+#: paged-layout pin + page geometry (parallel/pagedbuf.py,
+#: docs/EXECUTOR.md §6): ADAM_TPU_PAGED=1 routes every paged-capable
+#: pass through the resident page pool, 0 forces it off; unset leaves
+#: the plan default (off — paging is an explicit opt-in)
+PAGED_ENV = "ADAM_TPU_PAGED"
+PAGE_ROWS_ENV = "ADAM_TPU_PAGE_ROWS"
+POOL_PAGES_ENV = "ADAM_TPU_POOL_PAGES"
 
 #: the autotuner densifies the ladder once observed mean pad waste
 #: crosses this fraction (sqrt(2) rungs halve the worst-case waste of
@@ -100,6 +107,9 @@ def decide_plan(*, pass_name: str, chunk_rows: int, mesh_size: int,
                 layout: Optional[str] = None,
                 ragged_capable: bool = False,
                 ragged_rates: Optional[dict] = None,
+                paged_capable: bool = False,
+                page_rows: Optional[int] = None,
+                pool_pages: Optional[int] = None,
                 autotune: bool = True) -> dict:
     """The autotuner: one pass's frozen execution plan.
 
@@ -120,6 +130,16 @@ def decide_plan(*, pass_name: str, chunk_rows: int, mesh_size: int,
     picks ragged only when an explicit pin or measured evidence backs
     it.  Padded is the no-evidence default: the ragged layout is a
     measured optimization, never a guess.
+
+    ``layout="paged"`` (the ``-paged``/``ADAM_TPU_PAGED`` pin) routes a
+    ``paged_capable`` pass through the resident page pool
+    (parallel/pagedbuf.py, docs/ARCHITECTURE.md §6l): chunk capacity
+    rounds up to a whole number of ``page_rows``-element pages and the
+    plan carries the page geometry (``page_rows``/``pool_pages``, the
+    pool sized for the prefetch depth plus one dispatch in flight).
+    The paged keys join the recorded inputs ONLY when the dimension is
+    engaged, so pre-paged sidecars replay digest-identical (the
+    tenant/shard scoping precedent in resilience.faults).
     """
     inputs = dict(pass_name=pass_name, chunk_rows=int(chunk_rows),
                   mesh_size=int(mesh_size), on_tpu=bool(on_tpu),
@@ -136,6 +156,15 @@ def decide_plan(*, pass_name: str, chunk_rows: int, mesh_size: int,
                       k: round(float(v), 1)
                       for k, v in sorted(ragged_rates.items())},
                   autotune=bool(autotune))
+    paged_engaged = bool(paged_capable) or layout == "paged" or \
+        page_rows is not None or pool_pages is not None
+    if paged_engaged:
+        # only-when-engaged: pre-paged sidecars must digest identically
+        inputs["paged_capable"] = bool(paged_capable)
+        inputs["page_rows"] = None if page_rows is None \
+            else int(page_rows)
+        inputs["pool_pages"] = None if pool_pages is None \
+            else int(pool_pages)
     # decide from the CANONICALIZED inputs (what the event records) —
     # deciding from the raw floats would let a rounding boundary make
     # the offline replay disagree with the recorded plan
@@ -143,7 +172,13 @@ def decide_plan(*, pass_name: str, chunk_rows: int, mesh_size: int,
     link_bytes_per_sec = inputs["link_bytes_per_sec"]
     reasons = []
     lay = "padded"
-    if inputs["layout"] == "ragged":
+    if inputs["layout"] == "paged":
+        if paged_engaged and inputs["paged_capable"]:
+            lay = "paged"
+            reasons.append("layout-pinned-paged")
+        else:
+            reasons.append("paged-pin-unsupported:padded")
+    elif inputs["layout"] == "ragged":
         if inputs["ragged_capable"]:
             lay = "ragged"
             reasons.append("layout-pinned-ragged")
@@ -179,15 +214,32 @@ def decide_plan(*, pass_name: str, chunk_rows: int, mesh_size: int,
     depth = prefetch_depth if prefetch_depth is not None else \
         (DEFAULT_PREFETCH_DEPTH if on_tpu else 0)
     do_donate = bool(on_tpu) if donate is None else bool(donate)
+    plan_page_rows = plan_pool_pages = None
+    if lay == "paged":
+        from .pagedbuf import DEFAULT_PAGE_ROWS
+        plan_page_rows = inputs.get("page_rows") or DEFAULT_PAGE_ROWS
+        # capacity is a whole number of pages; the pool holds the
+        # prefetch look-ahead plus the dispatch in flight
+        rows = max(-(-rows // plan_page_rows), 1) * plan_page_rows
+        per_dispatch = rows // plan_page_rows
+        # steady-state live set under a prefetched feed: depth queued
+        # chunks + the consumer's not-yet-freed chunk + the feeder's
+        # next alloc — depth + 2 dispatches' worth of pages
+        plan_pool_pages = inputs.get("pool_pages") or \
+            (int(depth) + 2) * per_dispatch
     ladder = row_bucket_ladder(rows, mult, base)
     digest = hashlib.sha256(
         json.dumps(inputs, sort_keys=True).encode()).hexdigest()[:16]
-    return dict(pass_name=pass_name, chunk_rows=rows,
+    plan = dict(pass_name=pass_name, chunk_rows=rows,
                 ladder_base=round(float(base), 6), ladder=list(ladder),
                 prefetch_depth=int(depth), donate=do_donate,
                 layout=lay,
                 reason=";".join(reasons) or "default",
                 inputs=inputs, input_digest=digest)
+    if lay == "paged":
+        plan["page_rows"] = int(plan_page_rows)
+        plan["pool_pages"] = int(plan_pool_pages)
+    return plan
 
 
 #: which ragged-race evidence keys back which streaming pass: the bench
@@ -278,6 +330,8 @@ class PassExecutor:
         self.prefetch_depth = plan["prefetch_depth"]
         self.donate = plan["donate"]
         self.layout = plan.get("layout", "padded")
+        self.page_rows = plan.get("page_rows")
+        self.pool_pages = plan.get("pool_pages")
         self.sync_every = max(int(sync_every), 1)
         self._shapes: set = set()
         self._lock = threading.Lock()   # pad_rows runs on pipelined
@@ -285,6 +339,8 @@ class PassExecutor:
         self._stall_s = 0.0
         self._inflight_peak = 0
         self._chunks = 0
+        self._h2d_bytes = 0
+        self._h2d_puts = 0
         self._finished = False
 
     # -- shape bucketing ---------------------------------------------------
@@ -360,10 +416,21 @@ class PassExecutor:
                 policy=self._parent.retry_policy, split=split,
                 fallback=fallback)
 
-    def dispatch_put(self, label: str, fn: Callable):
+    def dispatch_put(self, label: str, fn: Callable,
+                     nbytes: Optional[int] = None):
         """A host→device transfer under the same retry ladder (site
         ``device_put``; no split/fallback — a put either lands or the
-        run fails cleanly after the budget)."""
+        run fails cleanly after the budget).  ``nbytes`` — the host
+        bytes this put ships — feeds the ``h2d_bytes{pass=}`` counter,
+        so "transfer disappeared under paging" is a gated number
+        instead of a trace screenshot (docs/OBSERVABILITY.md); the
+        rollup lands as one ``h2d_bytes`` event at pass finish."""
+        if nbytes:
+            with self._lock:
+                self._h2d_bytes += int(nbytes)
+                self._h2d_puts += 1
+            obs.registry().counter(
+                "h2d_bytes", **{"pass": self.pass_name}).inc(int(nbytes))
         return dispatch_with_retry(
             fn, site="device_put", label=f"{self.pass_name}:{label}",
             policy=self._parent.retry_policy)
@@ -413,6 +480,10 @@ class PassExecutor:
                      chunks=self._chunks,
                      inflight_peak=self._inflight_peak,
                      depth=self.prefetch_depth)
+        if self._h2d_puts:
+            obs.emit("h2d_bytes", **{"pass": self.pass_name},
+                     bytes=int(self._h2d_bytes), puts=self._h2d_puts,
+                     layout=self.layout)
 
 
 class StreamExecutor:
@@ -427,6 +498,9 @@ class StreamExecutor:
                  prefetch_depth: Optional[int] = None,
                  donate: Optional[bool] = None,
                  ragged: Optional[bool] = None,
+                 paged: Optional[bool] = None,
+                 page_rows: Optional[int] = None,
+                 pool_pages: Optional[int] = None,
                  link_bytes_per_sec: Optional[float] = None,
                  retry_budget: Optional[int] = None):
         self.mesh_size = getattr(mesh, "size", None) or int(mesh or 1)
@@ -460,6 +534,26 @@ class StreamExecutor:
             self.layout_pin = resolve_ragged_env(env.get(RAGGED_ENV))
         else:
             self.layout_pin = "ragged" if ragged else "padded"
+        # paged pin outranks the ragged pin (paging is the ragged
+        # addressing scheme plus residency — an explicit -paged means
+        # "use the pool", not "also stay ragged")
+        from .pagedbuf import resolve_paged_env
+        if paged is None:
+            paged = resolve_paged_env(env.get(PAGED_ENV))
+        if paged:
+            self.layout_pin = "paged"
+        if page_rows is None and env.get(PAGE_ROWS_ENV):
+            try:
+                page_rows = int(env[PAGE_ROWS_ENV])
+            except ValueError:
+                page_rows = None
+        self.page_rows = page_rows
+        if pool_pages is None and env.get(POOL_PAGES_ENV):
+            try:
+                pool_pages = int(env[POOL_PAGES_ENV])
+            except ValueError:
+                pool_pages = None
+        self.pool_pages = pool_pages
         if link_bytes_per_sec is None and self.autotune and self.on_tpu:
             link_bytes_per_sec = _ledger_link_rate()
         self.link_bytes_per_sec = link_bytes_per_sec
@@ -493,6 +587,7 @@ class StreamExecutor:
     def begin_pass(self, pass_name: str, *,
                    bytes_per_row: Optional[float] = None,
                    ragged_capable: bool = False,
+                   paged_capable: bool = False,
                    sync_every: int = 1) -> PassExecutor:
         """Freeze the plan for one pass (the ONLY place decisions are
         made — never mid-pass) and emit it through obs.
@@ -504,6 +599,7 @@ class StreamExecutor:
         if self._current is not None:
             self._current.finish()
         capable = bool(ragged_capable) and self.mesh_size == 1
+        capable_paged = bool(paged_capable) and self.mesh_size == 1
         rates = None
         if capable and self.layout_pin is None and self.autotune:
             rates = ledger_ragged_rates(
@@ -516,12 +612,19 @@ class StreamExecutor:
             bytes_per_row=bytes_per_row, ladder_base=self.ladder_base,
             prefetch_depth=self.prefetch_depth, donate=self.donate,
             layout=self.layout_pin, ragged_capable=capable,
-            ragged_rates=rates, autotune=self.autotune)
+            ragged_rates=rates, paged_capable=capable_paged,
+            page_rows=self.page_rows if capable_paged else None,
+            pool_pages=self.pool_pages if capable_paged else None,
+            autotune=self.autotune)
         obs.registry().counter("executor_passes",
                                **{"pass": pass_name}).inc()
         obs.trace.instant(f"pass:{pass_name}",
                           chunk_rows=plan["chunk_rows"],
                           prefetch_depth=plan["prefetch_depth"])
+        extra = {}
+        if "page_rows" in plan:
+            extra = dict(page_rows=plan["page_rows"],
+                         pool_pages=plan["pool_pages"])
         obs.emit("executor_bucket_selected", **{"pass": pass_name},
                  chunk_rows=plan["chunk_rows"],
                  ladder=plan["ladder"], ladder_base=plan["ladder_base"],
@@ -529,7 +632,7 @@ class StreamExecutor:
                  donate=plan["donate"], layout=plan["layout"],
                  reason=plan["reason"],
                  inputs=plan["inputs"],
-                 input_digest=plan["input_digest"])
+                 input_digest=plan["input_digest"], **extra)
         pex = PassExecutor(self, plan, sync_every)
         self._current = pex
         return pex

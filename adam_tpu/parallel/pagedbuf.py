@@ -1,0 +1,336 @@
+"""Resident paged device buffers: continuous batching for the hot kernels.
+
+Every streaming pass used to pay a full host→device transfer per
+dispatch — the padded path re-ships each chunk's rung-padded wire, the
+ragged path re-ships the whole fixed-capacity concat buffer (slack
+included), and serve mode re-filled the shared wire buffer from scratch
+every packing round.  Ragged Paged Attention (PAPERS.md,
+arXiv:2604.15464) shows the TPU-native fix: keep ONE persistently
+resident device allocation per plane, organized as fixed-size pages
+with a page table, and let variable-length work stream *through*
+residency — new rows land in free pages (only the DELTA pages ever
+cross the link), finished work frees its pages without touching
+neighbors, and the kernels walk ``(page_table, row_offsets)`` instead
+of consuming a freshly concatenated buffer.
+
+Three pieces (docs/ARCHITECTURE.md §6l):
+
+1. **The pure allocator** — :func:`decide_pages` maps incoming rows
+   onto free pages, lowest-id-first (deterministic), and answers
+   ``fallback`` when the pool would thrash (fewer free pages than the
+   request needs).  It follows the ``decide_plan`` convention exactly:
+   a PURE function of keyword inputs, recorded in full (inputs +
+   digest) on every ``pages_selected`` event, replayed offline by
+   tools/check_executor.py.
+2. **The resident pool** — :class:`PagePool` owns one device array per
+   plane (``[pool_pages, page_rows]``), a host-side free list, and the
+   delta-only write path: a page write is a ``device_put`` of the new
+   pages plus a device-local scatter into the resident allocation
+   (pow2 batches — bounded compiled shapes, exactly the delta bytes;
+   never donating, so no failure or concurrent dispatch ever holds a
+   dead handle), so resident pages are never re-shipped over the link.
+   Writes route through the
+   executor's ``dispatch_put`` when bound (retry ladder + the
+   ``h2d_bytes{pass=}`` transfer accounting).
+3. **Page-table kernel twins** — the three hot kernels grew paged
+   entries (``ops/flagstat_pallas.flagstat_pallas_wire32_paged``,
+   ``bqsr/count_pallas.count_kernel_paged``,
+   ``realign/realigner.sweep_paged_xla``) that walk the page table via
+   scalar prefetch (XLA gather off-TPU), each bit-identical to its
+   ragged form: the gathered logical buffer IS the ragged concat, so
+   identity is structural, pinned by tests/test_paged.py.
+
+Pages are sized in FLAT elements (``page_rows``), a multiple of the
+128-lane tile — the ragged layout already flattened the length axis
+into the planes, so row-granular pages over flat planes are the
+"rows x length-rung" pages of the paper's layout.
+
+Knobs: ``-paged`` / ``ADAM_TPU_PAGED`` pins the layout (the
+``-ragged`` convention), ``ADAM_TPU_PAGE_ROWS`` / ``ADAM_TPU_POOL_PAGES``
+override the page geometry (docs/EXECUTOR.md §6).
+"""
+
+from __future__ import annotations
+
+import functools
+import hashlib
+import json
+import threading
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+try:  # keep importable without jax for host-only tooling
+    import jax
+    import jax.numpy as jnp
+    _HAVE_JAX = True
+except Exception:  # pragma: no cover
+    _HAVE_JAX = False
+
+from .. import obs
+
+#: layout pin (the RAGGED_ENV convention): 1 forces the paged layout on
+#: every paged-capable pass, 0/off forces it off, unset leaves the
+#: decision to the plan default (off — paging is an explicit opt-in
+#: until the bench gate arms it per platform)
+PAGED_ENV = "ADAM_TPU_PAGED"
+#: flat elements per page (every plane); must be a multiple of 1024 u32
+#: lanes for the Pallas wire sweep's block geometry
+PAGE_ROWS_ENV = "ADAM_TPU_PAGE_ROWS"
+#: pages in the resident pool (per plane)
+POOL_PAGES_ENV = "ADAM_TPU_POOL_PAGES"
+
+#: default page size for the flagstat wire plane: 32768 u32 words
+#: (128 KiB) — a multiple of the Pallas sweep's 8x1024 sublane tile, so
+#: a page maps onto whole kernel blocks
+DEFAULT_PAGE_ROWS = 1 << 15
+
+
+def resolve_paged_env(env_val: Optional[str]) -> Optional[bool]:
+    """ADAM_TPU_PAGED / flag string -> explicit pin or None (the
+    resolve_ragged_env convention: env resolution stays OUT of the pure
+    planners)."""
+    if env_val is None or env_val == "":
+        return None
+    if env_val in ("0", "off", "no", "padded"):
+        return False
+    return True
+
+
+def decide_pages(*, pass_name: str, need: int, free: Sequence[int],
+                 pool_pages: int, page_rows: int,
+                 tenant: Optional[str] = None) -> dict:
+    """The page allocator: map one request onto free pages, or fall
+    back.
+
+    PURE — the returned decision is a deterministic function of the
+    keyword inputs, which every ``pages_selected`` event records in
+    full (``inputs`` + ``input_digest``), so tools/check_executor.py
+    can replay a sidecar offline (the ``decide_plan`` contract).
+
+    Policy: lowest-id-first from the sorted free list — deterministic,
+    and it keeps the resident pool dense at the low end so a shrinking
+    working set strands no high pages.  ``need > len(free)`` answers
+    ``action="fallback"``: the caller routes this dispatch through the
+    existing concat path instead of thrashing the pool (evicting pages
+    a pending dispatch still reads would corrupt it; re-shipping them
+    would be the exact transfer the pool exists to kill).
+    """
+    inputs = dict(pass_name=pass_name, need=int(need),
+                  free=sorted(int(p) for p in free),
+                  pool_pages=int(pool_pages), page_rows=int(page_rows),
+                  tenant=tenant)
+    free_sorted = inputs["free"]
+    if inputs["need"] > len(free_sorted):
+        pages: List[int] = []
+        action = "fallback"
+        reason = (f"need {inputs['need']} > free {len(free_sorted)}"
+                  ":concat-fallback")
+    else:
+        pages = free_sorted[:inputs["need"]]
+        action = "alloc"
+        reason = (f"alloc {len(pages)}/{len(free_sorted)} free"
+                  + (f" tenant={tenant}" if tenant else ""))
+    digest = hashlib.sha256(
+        json.dumps(inputs, sort_keys=True).encode()).hexdigest()[:16]
+    return dict(pages=pages, action=action, reason=reason,
+                inputs=inputs, input_digest=digest)
+
+
+# ---------------------------------------------------------------------------
+# resident device pool
+# ---------------------------------------------------------------------------
+
+if _HAVE_JAX:
+    @jax.jit
+    def gather_pages(pool, page_table):
+        """``[P, page_rows]`` resident pool + ``[k]`` page table ->
+        ``[k * page_rows]`` logical flat buffer (the ragged concat, in
+        page-table order).  The paged kernels' off-TPU walk: one gather
+        replaces the host concatenation AND its host→device transfer."""
+        return jnp.take(pool, page_table, axis=0).reshape(-1)
+
+    @jax.jit
+    def _scatter_pages(pool, page_ids, pages):
+        """Land delta pages in the resident pool.  NON-donating on
+        purpose: donation would mark the host handle deleted the moment
+        the call dispatches, so a failed scatter (the retry ladder's
+        whole domain) or a concurrently-building gather dispatch would
+        hold a dead array and lose every resident page.  The scatter is
+        a device-local copy — the host→device link still ships only the
+        delta pages, which is the win this pool exists for."""
+        return pool.at[page_ids].set(pages)
+else:  # pragma: no cover - host-only tooling
+    gather_pages = None
+
+
+class PagePool:
+    """One resident device allocation per plane + the host-side free
+    list, fed by :func:`decide_pages`.
+
+    ``planes``: ``((name, dtype), ...)`` — every plane shares the page
+    geometry (``[pool_pages, page_rows]``).  ``put`` (optional, also
+    settable via :meth:`bind`) is the executor's ``dispatch_put``
+    (``put(label, fn, nbytes)``): page writes then ride the retry
+    ladder and the ``h2d_bytes{pass=}`` transfer accounting; unbound
+    pools charge the counter directly so the accounting never drops.
+
+    Thread-safe: alloc runs on the prefetch feeder thread while free
+    runs on the consumer (the ingest.prefetched split).
+    """
+
+    def __init__(self, pass_name: str, pool_pages: int, page_rows: int,
+                 planes: Sequence[Tuple[str, object]] = (("wire",
+                                                          np.uint32),),
+                 put: Optional[Callable] = None):
+        self.pass_name = pass_name
+        self.pool_pages = int(pool_pages)
+        self.page_rows = int(page_rows)
+        self.planes = tuple((str(n), np.dtype(d)) for n, d in planes)
+        self._put = put
+        self._lock = threading.Lock()
+        self._free: List[int] = list(range(self.pool_pages))
+        self._held: Dict[Optional[str], set] = {}
+        self._dev: Dict[str, object] = {}
+        self._h2d_bytes = 0
+        self._writes = 0
+
+    # -- device residency ---------------------------------------------------
+
+    def bind(self, put: Optional[Callable]) -> "PagePool":
+        """(Re)attach the executor put hook — a pool outliving one pass
+        (the serve server's cross-round pool) rebinds per pass."""
+        self._put = put
+        return self
+
+    def device(self, plane: str = "wire"):
+        """The resident ``[pool_pages, page_rows]`` device array for
+        ``plane`` (allocated zeroed on first touch — ONE allocation for
+        the pool's lifetime; every later write is a delta scatter)."""
+        with self._lock:
+            arr = self._dev.get(plane)
+            if arr is None:
+                dt = dict(self.planes)[plane]
+                arr = jnp.zeros((self.pool_pages, self.page_rows), dt)
+                self._dev[plane] = arr
+            return arr
+
+    # -- allocator ----------------------------------------------------------
+
+    def alloc(self, need: int,
+              tenant: Optional[str] = None) -> Optional[List[int]]:
+        """Claim ``need`` free pages (None = fallback: route this
+        dispatch through the concat path).  Emits the replayable
+        ``pages_selected`` event either way."""
+        with self._lock:
+            plan = decide_pages(pass_name=self.pass_name, need=need,
+                                free=tuple(self._free),
+                                pool_pages=self.pool_pages,
+                                page_rows=self.page_rows, tenant=tenant)
+            if plan["action"] == "alloc":
+                taken = set(plan["pages"])
+                self._free = [p for p in self._free if p not in taken]
+                self._held.setdefault(tenant, set()).update(taken)
+        obs.emit("pages_selected", **{"pass": self.pass_name},
+                 pages=plan["pages"], action=plan["action"],
+                 reason=plan["reason"], inputs=plan["inputs"],
+                 input_digest=plan["input_digest"])
+        if plan["action"] != "alloc":
+            obs.registry().counter("paged_fallbacks",
+                                   **{"pass": self.pass_name}).inc()
+            return None
+        return list(plan["pages"])
+
+    def free(self, page_ids: Sequence[int],
+             tenant: Optional[str] = None) -> None:
+        """Return pages to the free list — host bookkeeping only: the
+        resident data becomes garbage no page table references, so no
+        device work (and no transfer) happens on free."""
+        ids = set(int(p) for p in page_ids)
+        with self._lock:
+            for held in self._held.values():
+                held -= ids
+            self._free.extend(sorted(ids - set(self._free)))
+            self._free.sort()
+
+    def free_tenant(self, tenant: Optional[str]) -> int:
+        """Free every page a finished tenant holds — neighbors'
+        resident pages are untouched (the continuous-batching free
+        half).  Returns the number of pages released."""
+        with self._lock:
+            held = self._held.pop(tenant, set())
+            self._free.extend(sorted(held))
+            self._free.sort()
+            return len(held)
+
+    @property
+    def free_pages(self) -> int:
+        with self._lock:
+            return len(self._free)
+
+    @property
+    def h2d_bytes(self) -> int:
+        """Bytes this pool actually shipped host→device (delta pages
+        only) — the number the bench's transfer-reduction gate reads."""
+        return self._h2d_bytes
+
+    # -- delta write --------------------------------------------------------
+
+    def write(self, page_ids: Sequence[int], **plane_rows) -> int:
+        """Ship the DELTA pages and scatter them into the resident
+        pool.  ``plane_rows[name]`` is the new pages' data, flat
+        ``[k * page_rows]`` or ``[k, page_rows]``.  Returns the bytes
+        that crossed the link (page data only — resident pages never
+        re-ship).
+
+        The k pages decompose into power-of-two batches (largest
+        first), so the scatter compiles a bounded shape set per pool
+        and EXACTLY k pages cross the link — never a padded duplicate.
+        The scatter never donates (see :func:`_scatter_pages`), so a
+        retried batch refetches a still-valid resident array and the
+        pool survives any failed attempt."""
+        ids = [int(p) for p in page_ids]
+        if not ids:
+            return 0
+        k = len(ids)
+        nbytes = 0
+        for name, dt in self.planes:
+            rows = np.asarray(plane_rows[name], dt).reshape(
+                k, self.page_rows)
+            nbytes += rows.nbytes
+            off = 0
+            while off < k:
+                step = 1 << ((k - off).bit_length() - 1)
+                ids_arr = np.asarray(ids[off:off + step], np.int32)
+                sub = rows[off:off + step]
+
+                def _ship(attempt, ids_arr=ids_arr, sub=sub, name=name):
+                    return _scatter_pages(self.device(name),
+                                          jnp.asarray(ids_arr),
+                                          jax.device_put(sub))
+
+                if self._put is not None:
+                    new = self._put(f"page-{name}", _ship, sub.nbytes)
+                else:
+                    obs.registry().counter(
+                        "h2d_bytes", **{"pass": self.pass_name}
+                    ).inc(sub.nbytes)
+                    new = _ship(1)
+                with self._lock:
+                    self._dev[name] = new
+                off += step
+        self._h2d_bytes += nbytes
+        self._writes += 1
+        obs.registry().counter("paged_writes",
+                               **{"pass": self.pass_name}).inc()
+        return nbytes
+
+    def table(self, page_ids: Sequence[int],
+              table_len: Optional[int] = None) -> np.ndarray:
+        """int32 page table in logical order, padded to ``table_len``
+        by repeating the last id (rows past the positional bound are
+        dead, so any resident page is a legal pad entry)."""
+        ids = [int(p) for p in page_ids] or [0]
+        if table_len is not None and len(ids) < table_len:
+            ids = ids + [ids[-1]] * (table_len - len(ids))
+        return np.asarray(ids, np.int32)

@@ -117,12 +117,13 @@ def streaming_flagstat(path: str, *, mesh=None, chunk_rows: int = 1 << 22,
     # periodic int64 fold both bounds the in-flight queue and keeps the
     # int32 accumulation window small regardless of file size.
     pex = ex.begin_pass("flagstat", bytes_per_row=4.0,
-                        ragged_capable=True,
+                        ragged_capable=True, paged_capable=True,
                         sync_every=8 if on_tpu else 1)
     use_pallas = impl == "pallas" or (impl == "auto" and on_tpu)
+    paged_mode = pex.layout == "paged"
     ragged_mode = pex.layout == "ragged"
-    if ragged_mode:
-        kernel = None           # ragged dispatches are unsharded
+    if ragged_mode or paged_mode:
+        kernel = None           # ragged/paged dispatches are unsharded
     elif use_pallas:
         from ..ops.flagstat_pallas import flagstat_wire32_sharded_pallas
         kernel = flagstat_wire32_sharded_pallas(mesh,
@@ -168,7 +169,8 @@ def streaming_flagstat(path: str, *, mesh=None, chunk_rows: int = 1 << 22,
         rows = len(wire)
         wire = _pad_wire(wire)
         dev = pex.dispatch_put(
-            "wire", lambda attempt: jax.device_put(wire, sharding))
+            "wire", lambda attempt: jax.device_put(wire, sharding),
+            nbytes=wire.nbytes)
         return rows, wire, dev
 
     mesh_mult = max(getattr(mesh, "size", 1) or 1, 1)
@@ -273,16 +275,73 @@ def streaming_flagstat(path: str, *, mesh=None, chunk_rows: int = 1 << 22,
             buf[off:off + len(p)] = p
             off += len(p)
         dev = pex.dispatch_put(
-            "wire", lambda attempt: jax.device_put(buf, sharding))
+            "wire", lambda attempt: jax.device_put(buf, sharding),
+            nbytes=buf.nbytes)
         return total, buf, dev
 
-    if ragged_mode:
+    # -- paged layout: the resident page pool (docs/ARCHITECTURE §6l) --
+    # The ragged concat still re-ships the WHOLE fixed-capacity buffer
+    # per dispatch, slack included; here the buffer lives resident as
+    # pages (parallel/pagedbuf) and only the live pages of each round
+    # cross the link — the kernel walks (page_table, total) instead of
+    # a fresh concat.  Counters stay the same exact monoid, so paged
+    # runs are byte-identical to padded/ragged walks.
+    pool = None
+    if paged_mode:
+        from ..ops.flagstat_pallas import flagstat_paged_dispatch
+        from .pagedbuf import PagePool
+        pool = PagePool("flagstat", pex.pool_pages, pex.page_rows,
+                        planes=(("wire", np.uint32),),
+                        put=pex.dispatch_put)
+        table_len = pex.chunk_rows // pex.page_rows
+
+    def _paged_put(item):
+        parts, total = item
+        need = max(-(-total // pex.page_rows), 1)
+        ids = pool.alloc(need)
+        if ids is None:
+            # pool thrash (decide_pages' fallback answer): this round
+            # rides the concat path — identical bytes, full transfer
+            return _rag_put(item)
+        buf = np.empty(need * pex.page_rows, np.uint32)
+        off = 0
+        for p in parts:
+            buf[off:off + len(p)] = p
+            off += len(p)
+        # slack past ``total`` in the last page is garbage the
+        # positional bound never reads; resident pages never re-ship
+        pool.write(ids, wire=buf)
+        return total, buf, ("paged", pool.table(ids, table_len), ids)
+
+    if paged_mode:
+        fed = pex.feed(_rag_buffers(wire_chunks), _paged_put)
+    elif ragged_mode:
         fed = pex.feed(_rag_buffers(wire_chunks), _rag_put)
     else:
         fed = pex.feed(wire_chunks, _pad_put)
     for rows, wire_host, wire_dev in fed:
         t_chunk = _time.perf_counter()
-        if ragged_mode:
+        if paged_mode and isinstance(wire_dev, tuple) and \
+                wire_dev[0] == "paged":
+            _, ptable, ids = wire_dev
+            pex.note_ragged(rows, pex.chunk_rows)
+            counts = pex.dispatch(
+                "count",
+                lambda attempt, tab=ptable, host=wire_host, t=rows:
+                    flagstat_paged_dispatch(
+                        pool.device("wire"), tab, t,
+                        interpret=use_pallas and not on_tpu,
+                        use_pallas=use_pallas)
+                    if attempt == 1 else _rag_dispatch(host, t, 2),
+                split=lambda e, host=wire_host, t=rows:
+                    _rag_split(host[:t], e),
+                fallback=lambda e, host=wire_host, t=rows:
+                    _rag_host_counts(host, t))
+            # the dispatch is enqueued (single device stream = FIFO),
+            # so recycling the pages for the NEXT round's scatter is
+            # ordered after this count reads them
+            pool.free(ids)
+        elif paged_mode or ragged_mode:
             pex.note_ragged(rows, pex.chunk_rows)
             counts = pex.dispatch(
                 "count",
@@ -1316,7 +1375,7 @@ def streaming_transform(input_path: str, output_path: str, *,
             # every chunk keeps the stage report attribution exact.
             pex2 = ex.begin_pass(
                 "p2", bytes_per_row=2.0 * max(bucket_len, 1) + 64.0,
-                ragged_capable=True,
+                ragged_capable=True, paged_capable=True,
                 sync_every=4 if is_tpu_backend() else 1)
             rt = _count_stream(
                 pex2,
@@ -1524,6 +1583,13 @@ def _count_stream(pex, fed_iter, *, snp_table, n_rg_run, bucket_len,
     host_acc = None
     acc = None
     n_counted = 0
+    # paged layout: one resident plane pool shared by every chunk of
+    # this pass (parallel/pagedbuf; sized lazily by the first chunk's
+    # rung) — count_tables_device routes the flat planes through it and
+    # falls back to the ragged concat when the pool would thrash
+    paged_box = None
+    if pex.layout == "paged":
+        paged_box = {"pass": pex.pass_name, "put": pex.dispatch_put}
     for table, batch, dev_batch in fed_iter:
         md_info = None if md_info_fn is None else md_info_fn(table)
         will_sync = (n_counted + 1) % pex.sync_every == 0
@@ -1537,7 +1603,8 @@ def _count_stream(pex, fed_iter, *, snp_table, n_rg_run, bucket_len,
                         mesh=mesh,
                         device_batch=d if attempt == 1 else None,
                         donate=pex.donate and attempt == 1,
-                        md_info=mi, layout=pex.layout),
+                        md_info=mi, layout=pex.layout,
+                        paged_box=paged_box if attempt == 1 else None),
                 fallback=lambda e, t=table, b=batch, mi=md_info:
                     cpu_fallback(t, b, mi))
             if isinstance(out[0], np.ndarray):
@@ -1961,7 +2028,7 @@ def _fused_count_pass(*, ex, workdir, raw_path, plan, mesh, snp_table,
     wire = plan["wire_spill"]
     pex2 = ex.begin_pass(
         "s2", bytes_per_row=2.0 * max(bucket_len, 1) + 64.0,
-        ragged_capable=True,
+        ragged_capable=True, paged_capable=True,
         sync_every=4 if is_tpu_backend() else 1)
     scalar_cols = ["flags", "start", "recordGroupId", "cigar"]
     if snp_table is not None:

@@ -389,6 +389,113 @@ def sweep_dispatch_ragged(pairs: List[Tuple["_GroupState", "_SweepJob"]],
     return np.asarray(q), np.asarray(o), spans, stats
 
 
+#: the flat planes the paged sweep pages — int32 like the XLA ragged
+#: branch's planes (name, dtype)
+PAGED_SWEEP_PLANES = (("base", "int32"), ("w", "int32"),
+                      ("row_of", "int32"), ("pos_of", "int32"))
+
+
+def sweep_paged_xla(pools: dict, page_table, job_of_row, read_len_r,
+                    cons_b, cons_len_g):
+    """Paged entry of the consensus sweep — the ragged XLA form fed by
+    RESIDENT page pools instead of freshly concatenated flat planes
+    (docs/ARCHITECTURE.md §6l).
+
+    ``pools`` maps each :data:`PAGED_SWEEP_PLANES` name to its
+    ``[pool_pages, page_rows]`` device array; ``page_table`` lists this
+    dispatch's physical pages in logical order.  One gather per plane
+    reconstructs exactly the arrays :func:`_sweep_ragged_xla` consumes
+    — bit-identical per job to the ragged dispatch by construction
+    (tests/test_paged.py pins it against
+    :func:`sweep_dispatch_ragged`'s XLA branch)."""
+    from ..parallel.pagedbuf import gather_pages
+
+    pt = jnp.asarray(page_table, jnp.int32)
+    return _sweep_ragged_xla(
+        gather_pages(pools["base"], pt), gather_pages(pools["w"], pt),
+        gather_pages(pools["row_of"], pt),
+        gather_pages(pools["pos_of"], pt),
+        job_of_row, read_len_r, cons_b, cons_len_g)
+
+
+def sweep_dispatch_paged(pairs: List[Tuple["_GroupState", "_SweepJob"]],
+                         pool=None):
+    """One PAGED device dispatch over (group, consensus) jobs sharing a
+    CL rung — :func:`sweep_dispatch_ragged`'s paged twin: the flat
+    base/weight/walk planes ship page-granular through a resident
+    :class:`..parallel.pagedbuf.PagePool` (only live pages cross the
+    link; the rung slack past the last page never ships) and the kernel
+    walks the page table.  Returns the same ``(q, o, spans, stats)``
+    contract.  ``pool`` (optional) is a caller-held resident pool
+    reused across dispatches; a transient one is built otherwise.
+    Falls back to :func:`sweep_dispatch_ragged` when the pool would
+    thrash (decide_pages' fallback answer)."""
+    from ..parallel.pagedbuf import DEFAULT_PAGE_ROWS, PagePool
+
+    CL = pairs[0][1].shape[2]
+    assert all(job.shape[2] == CL for _, job in pairs), "one CL rung"
+    n_rows = [len(st.reads_to_clean) for st, _ in pairs]
+    t_rows = [int(st.lens[:r].sum()) for (st, _), r in zip(pairs, n_rows)]
+    Rt = sum(n_rows)
+    T = sum(t_rows)
+    G = 1 << max(len(pairs) - 1, 0).bit_length()
+    Rp = shape_rung(max(Rt, 1), _RAGGED_R_MULT)
+    job_of_row = np.zeros(Rp, np.int32)
+    read_len_r = np.full(Rp, CL, np.int32)
+    cons_b = np.zeros((G, CL), np.int32)
+    cons_len_g = np.zeros(G, np.int32)
+    r0 = 0
+    spans = []
+    for g, ((st, job), nr) in enumerate(zip(pairs, n_rows)):
+        job_of_row[r0:r0 + nr] = g
+        read_len_r[r0:r0 + nr] = st.lens[:nr]
+        cons_b[g, :len(job.cons_u8)] = job.cons_u8.astype(np.int32)
+        cons_len_g[g] = job.cons_len
+        spans.append((r0, r0 + nr))
+        r0 += nr
+    cons_b[len(pairs):] = cons_b[0]
+    cons_len_g[len(pairs):] = cons_len_g[0]
+
+    if pool is None:
+        page_rows = min(DEFAULT_PAGE_ROWS, _RAGGED_T_MULT)
+        n_pages = max(-(-max(T, 1) // page_rows) * 2, 2)
+        pool = PagePool("p4", n_pages, page_rows,
+                        planes=PAGED_SWEEP_PLANES)
+    page_rows = pool.page_rows
+    need = -(-max(T, 1) // page_rows)
+    ids = pool.alloc(need)
+    if ids is None:         # pool thrash: the concat path is the answer
+        return sweep_dispatch_ragged(pairs)
+    Tp = need * page_rows
+    base_flat = np.zeros(Tp, np.int32)
+    w_flat = np.zeros(Tp, np.int32)
+    row_of = np.zeros(Tp, np.int32)
+    pos_of = np.zeros(Tp, np.int32)
+    r0 = t0 = 0
+    for (st, _), nr, tr in zip(pairs, n_rows, t_rows):
+        lens = st.lens[:nr].astype(np.int64)
+        mask = np.arange(st.reads_u8.shape[1])[None, :] < lens[:, None]
+        base_flat[t0:t0 + tr] = st.reads_u8[:nr][mask]
+        w_flat[t0:t0 + tr] = st.quals_arr[:nr][mask]
+        row_of[t0:t0 + tr] = r0 + np.repeat(np.arange(nr), lens)
+        pos_of[t0:t0 + tr] = _pos_within(lens)
+        r0 += nr
+        t0 += tr
+    pool.write(ids, base=base_flat, w=w_flat, row_of=row_of,
+               pos_of=pos_of)
+    try:
+        q, o = sweep_paged_xla(
+            {n: pool.device(n) for n, _ in PAGED_SWEEP_PLANES},
+            pool.table(ids), job_of_row, read_len_r, cons_b, cons_len_g)
+        q, o = np.asarray(q)[:Rt], np.asarray(o)[:Rt]
+    finally:
+        pool.free(ids)
+    stats = dict(rows=Rt, rows_pad=Rp, bases=T, bases_pad=Tp,
+                 g=G, cl=CL,
+                 cons_true=int(cons_len_g[:len(pairs)].sum()))
+    return q, o, spans, stats
+
+
 def _pos_within(lens: np.ndarray) -> np.ndarray:
     """0..len_i-1 per read, concatenated (int32) — the shared
     prefix-sum walk primitive, narrowed for the device planes."""

@@ -48,7 +48,8 @@ class SharedDispatchError(RuntimeError):
 
 def packed_flagstat(specs: List[dict], *, chunk_rows: int = 1 << 22,
                     pack_segments: int = 8,
-                    executor_opts: Optional[dict] = None
+                    executor_opts: Optional[dict] = None,
+                    pool_holder: Optional[dict] = None
                     ) -> Tuple[Dict[str, Tuple[object, object]],
                                Dict[str, dict]]:
     """Run N flagstat jobs through shared fixed-capacity dispatches.
@@ -62,22 +63,50 @@ def packed_flagstat(specs: List[dict], *, chunk_rows: int = 1 << 22,
     — the per-tenant accounting contract).  One buffer capacity (the
     executor plan's chunk_rows) and one segment width = ONE compiled
     shape for the whole serve lifetime.
+
+    Under the PAGED layout (``-paged``/``ADAM_TPU_PAGED``,
+    docs/ARCHITECTURE.md §6l) the shared buffer becomes page-RESIDENT
+    continuous batching: tenants' rows land in free pages of one
+    persistent device pool, only the live pages of each round cross the
+    link (the unpaged path re-ships the full capacity, slack included),
+    the segmented kernel reads the page table, and a flushed round
+    frees its pages for the next tenant without touching neighbors.
+    ``pool_holder`` (the server's cross-round dict) keeps the pool
+    resident across packed_flagstat calls — the steady state where
+    host→device transfer between dispatches is only ever new rows.
     """
     import jax
     import jax.numpy as jnp
 
     from ..errors import malformed_count
     from ..ops.flagstat import (FlagStatMetrics,
-                                flagstat_kernel_wire32_segmented)
+                                flagstat_kernel_wire32_segmented,
+                                flagstat_kernel_wire32_segmented_paged)
     from ..parallel.executor import StreamExecutor
+    from ..parallel.pagedbuf import PagePool
     from ..parallel.pipeline import flagstat_wire_chunks
 
     ex = StreamExecutor(1, chunk_rows, **(executor_opts or {}))
     # the shared buffer is its own pass: one frozen plan, one
     # executor_bucket_selected event, one compiled (capacity, S) shape
-    pex = ex.begin_pass("serve_pack", bytes_per_row=4.0)
+    pex = ex.begin_pass("serve_pack", bytes_per_row=4.0,
+                        paged_capable=True)
     cap = pex.chunk_rows
     n_seg = max(int(pack_segments), 2)
+    paged = pex.layout == "paged"
+    pool = None
+    table_len = 0
+    if paged:
+        holder = pool_holder if pool_holder is not None else {}
+        pool = holder.get("serve_pack")
+        if pool is None or pool.page_rows != pex.page_rows or \
+                pool.pool_pages < cap // pex.page_rows + 1:
+            pool = holder["serve_pack"] = PagePool(
+                "serve_pack", max(pex.pool_pages,
+                                  cap // pex.page_rows + 1),
+                pex.page_rows, planes=(("wire", np.uint32),))
+        pool.bind(pex.dispatch_put)
+        table_len = cap // pool.page_rows
 
     totals = {s["job_id"]: np.zeros((18, 2), np.int64) for s in specs}
     stats = {s["job_id"]: {"rows": 0, "dropped": 0} for s in specs}
@@ -88,6 +117,50 @@ def packed_flagstat(specs: List[dict], *, chunk_rows: int = 1 << 22,
         with jax.default_device(jax.devices("cpu")[0]):
             return np.asarray(flagstat_kernel_wire32_segmented(
                 jnp.asarray(buf), jnp.asarray(bounds)))
+
+    shipped: List[int] = []     # paged: page ids shipped this round,
+    #                             in logical (fill) order
+
+    def _ship_upto(have: int, final: bool = False) -> None:
+        """Paged: ship every full page of the host mirror up to
+        ``have`` (and the partial tail page when ``final``) into free
+        pool pages — new rows cross the link AS THEY ARRIVE, page by
+        page, mid-stream; nothing re-ships at flush time."""
+        # page writes are SHARED infrastructure (like the unpaged
+        # flush transfer): a tenant-scoped fault must not fire on a
+        # write its neighbors ride in
+        prev = faults.current_tenant()
+        faults.set_tenant(None)
+        try:
+            while True:
+                n = have // pool.page_rows - len(shipped)
+                if n <= 0:
+                    # the partial tail ships one whole page at flush;
+                    # rows past the bound are garbage the segmented
+                    # fold never reads
+                    if not (final and
+                            len(shipped) * pool.page_rows < have):
+                        break
+                    n = 1
+                ids = pool.alloc(n)
+                if ids is None:     # misconfigured pool: the server
+                    #                 degrades the group to solo runs
+                    raise SharedDispatchError(RuntimeError(
+                        "page pool exhausted mid-round"))
+                lo = len(shipped) * pool.page_rows
+                try:
+                    pool.write(ids,
+                               wire=buf[lo:lo + n * pool.page_rows])
+                except BaseException:
+                    # a failed write must not leak pages from the
+                    # server's CROSS-ROUND pool (it is never resized on
+                    # free count — a leak would shrink packed capacity
+                    # for the server's remaining lifetime)
+                    pool.free(ids)
+                    raise
+                shipped.extend(ids)
+        finally:
+            faults.set_tenant(prev)
 
     def _flush(buf, segments):
         """Dispatch one filled buffer; fold each segment's [18, 2] block
@@ -107,26 +180,56 @@ def packed_flagstat(specs: List[dict], *, chunk_rows: int = 1 << 22,
         try:
             pex.note_ragged(live, cap)
             bounds_dev = jnp.asarray(bounds)
-            dev = pex.dispatch_put(
-                "pack-wire", lambda attempt: jax.device_put(buf))
-            counts_dev = pex.dispatch(
-                "pack-count",
-                lambda attempt, dev=dev, host=buf, b=bounds_dev:
-                    flagstat_kernel_wire32_segmented(
-                        dev if attempt == 1 else jnp.asarray(host), b),
-                fallback=lambda e, host=buf, b=bounds:
-                    _host_counts(host, b))
+            n_pages = 0
+            if paged:
+                _ship_upto(live, final=True)
+                n_pages = len(shipped)
+                ptable = pool.table(shipped, table_len)
+                counts_dev = pex.dispatch(
+                    "pack-count",
+                    lambda attempt, tab=ptable, host=buf, b=bounds_dev:
+                        flagstat_kernel_wire32_segmented_paged(
+                            pool.device("wire"), jnp.asarray(tab), b)
+                        if attempt == 1 else
+                        flagstat_kernel_wire32_segmented(
+                            jnp.asarray(host), b),
+                    fallback=lambda e, host=buf, b=bounds:
+                        _host_counts(host, b))
+            else:
+                dev = pex.dispatch_put(
+                    "pack-wire", lambda attempt: jax.device_put(buf),
+                    nbytes=buf.nbytes)
+                counts_dev = pex.dispatch(
+                    "pack-count",
+                    lambda attempt, dev=dev, host=buf, b=bounds_dev:
+                        flagstat_kernel_wire32_segmented(
+                            dev if attempt == 1 else jnp.asarray(host),
+                            b),
+                    fallback=lambda e, host=buf, b=bounds:
+                        _host_counts(host, b))
             out = np.asarray(counts_dev).astype(np.int64)
+        except SharedDispatchError:
+            raise
         except Exception as e:  # noqa: BLE001 — the server degrades
             raise SharedDispatchError(e) from e
         finally:
             faults.set_tenant(prev)
+            if paged and shipped:
+                # the flushed round's rows are consumed: its pages free
+                # for the NEXT tenant without touching neighbors (the
+                # dispatch is already enqueued — single-stream FIFO
+                # orders any recycling scatter after the fold)
+                pool.free(shipped)
+                shipped.clear()
         for s, (job_id, rows) in enumerate(segments):
             totals[job_id] += out[s]
         obs.chunk_processed("serve_pack", live, bytes_in=4 * live)
-        obs.emit("serve_pack_dispatch", capacity=int(cap),
-                 live_rows=live, segments=len(segments),
-                 jobs=sorted({j for j, _ in segments}))
+        fields = dict(capacity=int(cap), live_rows=live,
+                      segments=len(segments),
+                      jobs=sorted({j for j, _ in segments}))
+        if paged:
+            fields.update(paged=True, pages=n_pages)
+        obs.emit("serve_pack_dispatch", **fields)
 
     # sequential fill in admission order: job j's tail shares its last
     # buffer with job j+1's head — the capacity slack IS the next
@@ -142,41 +245,63 @@ def packed_flagstat(specs: List[dict], *, chunk_rows: int = 1 << 22,
         else:
             segments.append((job_id, rows))
 
-    for spec in specs:
-        job_id = spec["job_id"]
-        with obs.trace.span(f"tenant:{spec['tenant']}:{job_id}",
-                            cat="serve"):
-            faults.set_tenant(spec["tenant"])
-            dropped0 = malformed_count()
-            try:
-                chunks = flagstat_wire_chunks(
-                    spec["input"], chunk_rows=cap,
-                    io_procs=int(spec["args"].get("io_procs", 1)))
-                for w in chunks:
-                    w = np.asarray(w, np.uint32)
-                    stats[job_id]["rows"] += int(w.size)
-                    while w.size:
-                        # a full segment table flushes early even with
-                        # row capacity left: S is a compiled constant
-                        if have == cap or (len(segments) == n_seg and
-                                           segments[-1][0] != job_id):
-                            _flush(buf, segments)
-                            buf = np.empty(cap, np.uint32)
-                            have, segments = 0, []
-                        take = min(cap - have, int(w.size))
-                        buf[have:have + take] = w[:take]
-                        _seg_add(job_id, take)
-                        have += take
-                        w = w[take:]
-                        if have == cap:
-                            _flush(buf, segments)
-                            buf = np.empty(cap, np.uint32)
-                            have, segments = 0, []
-            finally:
-                faults.set_tenant(None)
-                stats[job_id]["dropped"] = malformed_count() - dropped0
-    if segments:
-        _flush(buf, segments)
+    def _ingest_all() -> None:
+        nonlocal buf, have, segments
+        for spec in specs:
+            job_id = spec["job_id"]
+            with obs.trace.span(f"tenant:{spec['tenant']}:{job_id}",
+                                cat="serve"):
+                faults.set_tenant(spec["tenant"])
+                dropped0 = malformed_count()
+                try:
+                    chunks = flagstat_wire_chunks(
+                        spec["input"], chunk_rows=cap,
+                        io_procs=int(spec["args"].get("io_procs", 1)))
+                    for w in chunks:
+                        w = np.asarray(w, np.uint32)
+                        stats[job_id]["rows"] += int(w.size)
+                        while w.size:
+                            # a full segment table flushes early even
+                            # with row capacity left: S is a compiled
+                            # constant
+                            if have == cap or \
+                                    (len(segments) == n_seg and
+                                     segments[-1][0] != job_id):
+                                _flush(buf, segments)
+                                buf = np.empty(cap, np.uint32)
+                                have, segments = 0, []
+                            take = min(cap - have, int(w.size))
+                            buf[have:have + take] = w[:take]
+                            _seg_add(job_id, take)
+                            have += take
+                            w = w[take:]
+                            if paged:
+                                # continuous batching: this tenant's
+                                # rows land in free pages AS THEY
+                                # ARRIVE — the flush dispatches
+                                # resident pages, it does not transfer
+                                # them
+                                _ship_upto(have)
+                            if have == cap:
+                                _flush(buf, segments)
+                                buf = np.empty(cap, np.uint32)
+                                have, segments = 0, []
+                finally:
+                    faults.set_tenant(None)
+                    stats[job_id]["dropped"] = \
+                        malformed_count() - dropped0
+        if segments:
+            _flush(buf, segments)
+
+    try:
+        _ingest_all()
+    finally:
+        if paged and shipped:
+            # an error path left pages allocated: release them so the
+            # server's persistent pool serves the next round at full
+            # capacity (the degrade-to-solo path re-streams anyway)
+            pool.free(shipped)
+            shipped.clear()
     ex.finish()
 
     out: Dict[str, Tuple[object, object]] = {}

@@ -135,6 +135,11 @@ class ServeServer:
         self.slo: Dict[str, dict] = {}
         self.slo_report = bool(slo_report)
         self._booted = False
+        #: the paged layout's cross-round page pool (packed_flagstat's
+        #: pool_holder): ONE resident device allocation for the serve
+        #: lifetime — steady state means only new tenants' rows ever
+        #: cross the link between dispatches (docs/ARCHITECTURE.md §6l)
+        self._pool_holder: Dict[str, object] = {}
 
     # -- boot ---------------------------------------------------------------
 
@@ -408,7 +413,8 @@ class ServeServer:
             results, stats = packed_flagstat(
                 specs, chunk_rows=self.chunk_rows,
                 pack_segments=self.pack_segments,
-                executor_opts=self.executor_opts)
+                executor_opts=self.executor_opts,
+                pool_holder=self._pool_holder)
         except (SharedDispatchError, FileNotFoundError,
                 IsADirectoryError, FormatError, InjectedFault,
                 ValueError, RuntimeError, OSError) as e:

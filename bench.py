@@ -1667,6 +1667,244 @@ def _worker(stages: list[str]) -> None:
         _worker_stages(stages)
 
 
+def _stage_paged_race(kind: str, is_tpu: bool):
+    """Resident paged buffers vs the refill-from-scratch paths
+    (ISSUE 13).  Two halves:
+
+    * **Kernel identity** — every paged kernel twin (flagstat wire
+      sweep, segmented serve fold, BQSR count, realign sweep)
+      bit-identical to its ragged form over the same logical rows, the
+      Mosaic interpreter included for the flagstat sweep
+      (``paged_*_matches_ragged`` keys, gated forever by bench_gate
+      gate 7).
+    * **The serve steady-state leg** — K tenant flagstat jobs through
+      in-process ``packed_flagstat`` with paging OFF vs ON, two rounds
+      each (round 2 is the steady state: the pool is resident, the
+      compiled shapes warm).  Gated numbers: ``paged_h2d_reduction``
+      (unpaged h2d bytes over paged h2d bytes on round 2 — the
+      ``h2d_bytes{pass=serve_pack}`` counter, so "transfer disappeared"
+      is a measured number), ``paged_identical`` (every tenant's
+      counters byte-identical to its solo run, both modes, both
+      rounds), and ``paged_steady_recompiles == 0`` (the paged round 2
+      reuses every compiled shape).  Process-internal by design —
+      ``is_tpu`` only stamps the platform."""
+    import shutil
+    import tempfile
+
+    import numpy as np
+    import pyarrow as pa
+
+    import jax
+    import jax.numpy as jnp
+
+    from adam_tpu import obs
+    from adam_tpu.ops import flagstat as F
+    from adam_tpu.ops import flagstat_pallas as FP
+    from adam_tpu.serve.packed import packed_flagstat
+
+    payload: dict = {"backend": jax.default_backend()}
+    rng = np.random.RandomState(23)
+
+    # ---- kernel identity: paged twins vs ragged forms ----------------
+    from adam_tpu.parallel.pagedbuf import PagePool
+
+    page_rows = 1 << 13
+    n_rows = int(2.6 * page_rows)           # a partial final page
+    wire = F.pack_flagstat_wire32(
+        rng.randint(0, 1 << 12, n_rows).astype(np.uint16),
+        rng.randint(0, 61, n_rows).astype(np.uint8),
+        rng.randint(0, 4, n_rows).astype(np.int16),
+        rng.randint(0, 4, n_rows).astype(np.int16),
+        np.ones(n_rows, bool))
+    pool = PagePool("paged_race", 8, page_rows)
+    need = -(-n_rows // page_rows)
+    ids = pool.alloc(need)
+    padded = np.zeros(need * page_rows, np.uint32)
+    padded[:n_rows] = wire
+    pool.write(ids, wire=padded)
+    ref = np.asarray(FP.flagstat_wire32_ragged_xla(
+        padded, np.array([0, n_rows], np.int32)))
+    got_xla = np.asarray(FP.flagstat_wire32_paged_xla(
+        pool.device("wire"), jnp.asarray(pool.table(ids), jnp.int32),
+        jnp.int32(n_rows)))
+    got_mosaic = np.asarray(FP.flagstat_pallas_wire32_paged(
+        pool.device("wire"), pool.table(ids), n_rows,
+        interpret=not is_tpu))
+    payload["paged_flagstat_matches_ragged"] = bool(
+        np.array_equal(ref, got_xla) and np.array_equal(ref, got_mosaic))
+    bounds = np.array([0, n_rows // 3, n_rows], np.int32)
+    seg_ref = np.asarray(F.flagstat_kernel_wire32_segmented(
+        jnp.asarray(padded), jnp.asarray(bounds)))
+    seg_paged = np.asarray(F.flagstat_kernel_wire32_segmented_paged(
+        pool.device("wire"), jnp.asarray(pool.table(ids), jnp.int32),
+        jnp.asarray(bounds)))
+    payload["paged_segmented_matches_ragged"] = bool(
+        np.array_equal(seg_ref, seg_paged))
+    pool.free(ids)
+
+    # BQSR count twin (the adversarial corpus rides tests/test_paged.py)
+    try:
+        from adam_tpu.bqsr.count_pallas import (BLOCK_ELEMS,
+                                                PAGED_COUNT_PLANES,
+                                                count_kernel_paged,
+                                                count_kernel_ragged,
+                                                flatten_state)
+        from adam_tpu.bqsr.table import RecalTable
+        from adam_tpu.packing import (ReadBatch, ragged_from_batch,
+                                      shape_rung)
+
+        N, L, n_rg = 64, 128, 2
+        lens = rng.randint(1, L + 1, N).astype(np.int32)
+        lane = np.arange(L)[None, :]
+        live = lane < lens[:, None]
+        batch = ReadBatch(
+            flags=rng.choice([0, 16, 129, 145], N).astype(np.int32),
+            refid=np.zeros(N, np.int32), start=np.zeros(N, np.int32),
+            mapq=np.zeros(N, np.int32),
+            mate_refid=np.zeros(N, np.int32),
+            mate_start=np.zeros(N, np.int32),
+            read_group=rng.randint(0, n_rg, N).astype(np.int32),
+            valid=np.ones(N, bool),
+            row_index=np.arange(N, dtype=np.int32), read_len=lens,
+            bases=np.where(live, rng.randint(0, 4, (N, L)),
+                           -1).astype(np.int8),
+            quals=np.where(live, rng.randint(2, 41, (N, L)),
+                           -1).astype(np.int8))
+        state = np.where(live, rng.randint(0, 2, (N, L)),
+                         2).astype(np.int8)
+        usable = np.ones(N, bool)
+        rt = RecalTable(n_read_groups=n_rg, max_read_len=L)
+        t_rung = shape_rung(max(int(lens.sum()), 1), BLOCK_ELEMS)
+        rb = ragged_from_batch(batch, pad_bases_to=t_rung)
+        state_flat = flatten_state(state, rb.read_len,
+                                   len(rb.bases_flat))
+        ref7 = count_kernel_ragged(
+            rb, state_flat, usable, n_qual_rg=rt.n_qual_rg,
+            n_cycle=rt.n_cycle, max_read_len=L, interpret=not is_tpu)
+        table_len = t_rung // BLOCK_ELEMS
+        cpool = PagePool("paged_race", max(table_len * 2, 2),
+                         BLOCK_ELEMS, planes=PAGED_COUNT_PLANES)
+        needc = -(-int(rb.n_bases) // BLOCK_ELEMS)
+        cids = cpool.alloc(needc)
+        liveT = needc * BLOCK_ELEMS
+        cpool.write(cids, bases=rb.bases_flat[:liveT],
+                    quals=rb.quals_flat[:liveT],
+                    state=state_flat[:liveT],
+                    row_of=rb.row_of[:liveT], pos_of=rb.pos_of[:liveT])
+        got7 = count_kernel_paged(
+            {nm: cpool.device(nm) for nm, _ in PAGED_COUNT_PLANES},
+            cpool.table(cids, table_len),
+            row_starts=rb.row_offsets[:-1], read_len=rb.read_len,
+            flags=rb.flags, read_group=rb.read_group, usable=usable,
+            n_bases=rb.n_bases, n_rows=rb.n_reads,
+            n_qual_rg=rt.n_qual_rg, n_cycle=rt.n_cycle,
+            max_read_len=L, interpret=not is_tpu)
+        payload["paged_bqsr_matches_ragged"] = all(
+            np.array_equal(np.asarray(a), np.asarray(b))
+            for a, b in zip(ref7, got7))
+    except Exception as e:  # noqa: BLE001 — record, race the rest
+        payload["paged_bqsr_error"] = f"{type(e).__name__}: {e}"[:160]
+
+    # realign sweep twin
+    try:
+        from adam_tpu.realign import realigner as R
+
+        pairs = _ragged_realign_pairs(16, True, seed=7)
+        buckets: dict = {}
+        for p in pairs:
+            buckets.setdefault(p[1].shape[2], []).append(p)
+        ok = True
+        for cl, members in buckets.items():
+            qr, orr, _spans, _ = R.sweep_dispatch_ragged(members)
+            qp, op, _spans2, _ = R.sweep_dispatch_paged(members)
+            ok = ok and np.array_equal(np.asarray(qr), qp) and \
+                np.array_equal(np.asarray(orr), op)
+        payload["paged_realign_matches_ragged"] = bool(ok)
+    except Exception as e:  # noqa: BLE001 — record, race the rest
+        payload["paged_realign_error"] = f"{type(e).__name__}: {e}"[:160]
+
+    # ---- the serve steady-state leg ----------------------------------
+    n = int(os.environ.get("ADAM_TPU_BENCH_PAGED_READS", 60_000))
+    k = max(int(os.environ.get("ADAM_TPU_BENCH_PAGED_JOBS", 4)), 2)
+    cap = 1 << 20
+    tmp = tempfile.mkdtemp(prefix="bench_paged_")
+    try:
+        from adam_tpu.io.parquet import DatasetWriter
+        from adam_tpu.ops.flagstat import format_report
+        from adam_tpu.parallel.pipeline import streaming_flagstat
+
+        inputs = []
+        for j in range(k):
+            d = os.path.join(tmp, f"reads{j}")
+            r2 = np.random.RandomState(100 + j)
+            m = n
+            with DatasetWriter(d, part_rows=1 << 18) as w:
+                w.write(pa.table({
+                    "flags": pa.array(r2.randint(
+                        0, 1 << 11, size=m).astype(np.uint32),
+                        pa.uint32()),
+                    "mapq": pa.array(r2.randint(0, 61, size=m),
+                                     pa.int32()),
+                    "referenceId": pa.array(r2.randint(0, 24, size=m),
+                                            pa.int32()),
+                    "mateReferenceId": pa.array(
+                        r2.randint(0, 24, size=m), pa.int32()),
+                }))
+            inputs.append(d)
+        solo = {p: format_report(*streaming_flagstat(p, chunk_rows=cap))
+                for p in inputs}
+        specs = [{"job_id": f"j{j}", "tenant": f"t{j}",
+                  "command": "flagstat", "input": p, "output": None,
+                  "args": {}} for j, p in enumerate(inputs)]
+
+        def h2d() -> int:
+            c = obs.registry().counter("h2d_bytes",
+                                       **{"pass": "serve_pack"})
+            return int(c.value)
+
+        def run_rounds(paged: bool):
+            holder: dict = {}
+            opts = {"paged": paged}
+            rounds = []
+            identical = True
+            for _ in range(2):
+                b0, t0 = h2d(), time.perf_counter()
+                results, _stats = packed_flagstat(
+                    specs, chunk_rows=cap, pack_segments=8,
+                    executor_opts=opts, pool_holder=holder)
+                wall = time.perf_counter() - t0
+                for s in specs:
+                    rep = format_report(*results[s["job_id"]])
+                    identical = identical and rep == solo[s["input"]]
+                rounds.append((h2d() - b0, wall))
+            return rounds, identical
+
+        rounds_un, ident_un = run_rounds(False)
+        rounds_pg, ident_pg = run_rounds(True)
+        payload["unpaged_h2d_bytes"] = rounds_un[1][0]
+        payload["paged_h2d_bytes"] = rounds_pg[1][0]
+        payload["unpaged_serve_wall_s"] = round(rounds_un[1][1], 4)
+        payload["paged_serve_wall_s"] = round(rounds_pg[1][1], 4)
+        payload["paged_h2d_reduction"] = round(
+            rounds_un[1][0] / max(rounds_pg[1][0], 1), 3)
+        payload["paged_identical"] = bool(ident_un and ident_pg)
+        payload["paged_n_jobs"] = k
+        payload["paged_n_reads"] = n
+        payload["paged_capacity_rows"] = cap
+        # steady-state recompiles: a further paged round (the compiled
+        # shapes and scatter/gather executables all warm) must compile
+        # nothing — the PR 10 zero-recompile pin re-run under paging
+        c0 = obs.registry().counter("compile_count").value
+        packed_flagstat(specs, chunk_rows=cap, pack_segments=8,
+                        executor_opts={"paged": True},
+                        pool_holder={})
+        payload["paged_steady_recompiles"] = int(
+            obs.registry().counter("compile_count").value - c0)
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+    _emit("paged_race", payload)
+
+
 _STAGE_BODIES = {"flagstat": _stage_flagstat, "transform": _stage_transform,
                  "bqsr_race": _stage_bqsr_race, "pallas": _stage_pallas,
                  "bqsr_race8": _stage_bqsr_race8,
@@ -1681,7 +1919,11 @@ _STAGE_BODIES = {"flagstat": _stage_flagstat, "transform": _stage_transform,
                  # fleet-serve scaling (ISSUE 12): process-level, not in
                  # the TPU capture order — run via --worker/--only
                  # fleet_serve
-                 "fleet_serve": _stage_fleet_serve}
+                 "fleet_serve": _stage_fleet_serve,
+                 # resident paged buffers (ISSUE 13): process-internal,
+                 # not in the TPU capture order — run via --worker/
+                 # --only paged_race
+                 "paged_race": _stage_paged_race}
 
 
 def _worker_stages(stages: list[str]) -> None:

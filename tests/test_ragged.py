@@ -583,6 +583,35 @@ def test_pad_waste_lane_axis():
     assert snap["histograms"]["pad_waste_frac{pass=px}"]["count"] == 1
 
 
+def test_ragged_device_put_sharded():
+    """RaggedBatch.device_put(sharding=): the sharded path places every
+    plane on EVERY mesh device (replicated — the one sharding legal for
+    the mixed [T]/[N]/[N+1] plane shapes) and the device values stay
+    bit-identical to the unsharded put."""
+    from dataclasses import fields as dc_fields
+
+    from adam_tpu.parallel.mesh import make_mesh, replicated
+
+    t = _reads_table(*_ADVERSARIAL[0])
+    rb = pack_reads_ragged(t, pad_rows_to=4, pad_bases_to=64)
+    mesh = make_mesh()
+    sh = replicated(mesh)
+    dev = rb.device_put(sharding=sh)
+    plain = rb.device_put()
+    n_dev = len(mesh.devices.ravel())
+    assert n_dev == 8           # the conftest virtual mesh
+    for f in dc_fields(rb):
+        host = getattr(rb, f.name)
+        if host is None:
+            continue
+        arr = getattr(dev, f.name)
+        assert arr.sharding.is_equivalent_to(sh, np.ndim(host)), f.name
+        assert len(arr.sharding.device_set) == n_dev, f.name
+        assert np.array_equal(np.asarray(arr), host), f.name
+        assert np.array_equal(np.asarray(arr),
+                              np.asarray(getattr(plain, f.name))), f.name
+
+
 def test_committed_ragged_artifact_holds():
     """BENCH_RAGGED.json (the committed length-skewed CPU artifact):
     the ragged realign sweep beats the 4-axis-padded form by >= 20%

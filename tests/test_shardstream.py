@@ -618,6 +618,42 @@ def test_fleet_empty_input_returns_empty_monoid(tmp_path):
     assert passed.total == 0 and failed.total == 0
 
 
+def test_heartbeat_batched_renewal(tmp_path, monkeypatch):
+    """ROADMAP item 3's data-plane slice: one renewal round costs ONE
+    fsync (the lease directory) instead of two per lease (tmp-file +
+    dir, the atomic_write discipline), the ``shard_lease`` fault site
+    still fires per round, and renewal visibility is immediate — the
+    lease file exists with the renewed doc the moment ``_beat``
+    returns, so the supervisor's mtime-based expiry detection latency
+    is unchanged (the chaos matrix's lease-expiry leg,
+    test_fleet_lease_expiry_fences_and_recovers, re-proves the
+    end-to-end behavior)."""
+    import time
+
+    fsyncs: list = []
+    monkeypatch.setattr(ss, "_fsync_dir", lambda d: fsyncs.append(d))
+    fired: list = []
+    real_fire = ss.faults.fire
+    monkeypatch.setattr(
+        ss.faults, "fire",
+        lambda site, **kw: (fired.append((site, kw.get("path"))),
+                            real_fire(site, **kw))[1])
+
+    lease = str(tmp_path / "leases" / "w0.lease")
+    hb = ss.Heartbeat(lease, heartbeat_s=60.0, incarnation=3)
+    t0 = time.time()
+    hb._beat()
+    # exactly one fsync for the round — the directory, never the file
+    assert fsyncs == [str(tmp_path / "leases")]
+    assert fired == [("shard_lease", lease)]
+    doc = json.loads(open(lease).read())
+    assert doc["seq"] == 1 and doc["incarnation"] == 3
+    assert os.path.getmtime(lease) >= t0 - 1.0     # visible NOW
+    hb._beat()
+    assert json.loads(open(lease).read())["seq"] == 2
+    assert fsyncs == [str(tmp_path / "leases")] * 2
+
+
 def test_fault_site_tables_stay_in_sync():
     """faults.SITES and check_metrics' literal mirror must agree, or a
     new site's events would fail schema validation (the drift this PR's

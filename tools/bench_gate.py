@@ -59,6 +59,16 @@ differ) with ``--threshold`` where applicable:
    from ``python bench.py --worker fleet_serve``) additionally diffs
    the 1/2-worker walls at the standard 10% threshold.
 
+7. **The resident-paging win is pinned.**  ``BENCH_PAGED.json`` (the
+   committed ``paged_race`` artifact, ISSUE 13) must show the paged
+   serve leg shipping >= 2x fewer host→device bytes than the unpaged
+   refill path on the steady-state round, every paged kernel twin
+   bit-identical to its ragged form, per-tenant counters byte-identical
+   to solo runs, and zero recompiles on a steady-state paged round —
+   identity and zero-recompile unconditional.  A fresh artifact
+   (``--paged NEW_P.json``, from ``python bench.py --worker
+   paged_race``) additionally diffs both serve walls at 10%.
+
 Usage::
 
     python tools/bench_gate.py                       # committed gates
@@ -67,6 +77,7 @@ Usage::
     python tools/bench_gate.py --shard NEW_S.json    # + fleet diff
     python tools/bench_gate.py --serve NEW_SV.json   # + serve diff
     python tools/bench_gate.py --fleet-serve NEW_FS.json  # + diff
+    python tools/bench_gate.py --paged NEW_P.json    # + paged diff
 
 Exit 0 when every gate holds; the first failing check's exit code
 otherwise.
@@ -162,6 +173,76 @@ SERVE_REQUIRED_SPEEDUP = 2.0
 
 #: the serve walls a fresh artifact is regression-diffed on
 SERVE_WALL_KEYS = ("serve_cold_job_wall_s", "serve_warm_job_wall_s")
+
+PAGED = os.path.join(ROOT, "BENCH_PAGED.json")
+
+#: the ISSUE 13 acceptance number: the paged serve leg must ship at
+#: least this factor fewer host→device bytes than the unpaged refill
+#: path on the steady-state round (round 2+, resident pool + warm
+#: shapes).  Identity and the zero-recompile pin are enforced
+#: unconditionally — the byte reduction is deterministic accounting
+#: (the h2d_bytes counter), not a wall-clock measurement, so the gate
+#: never disarms for box load.
+PAGED_REQUIRED_H2D_REDUCTION = 2.0
+
+#: the paged walls a fresh artifact is regression-diffed on
+PAGED_WALL_KEYS = ("unpaged_serve_wall_s", "paged_serve_wall_s")
+
+#: every kernel twin gate 7 requires — REQUIRED, not scanned: a twin
+#: that crashed outright records ``paged_*_error`` and omits its key,
+#: which must fail the gate, never pass it silently
+PAGED_TWIN_KEYS = ("paged_flagstat_matches_ragged",
+                   "paged_segmented_matches_ragged",
+                   "paged_bqsr_matches_ragged",
+                   "paged_realign_matches_ragged")
+
+
+def _check_paged_artifact(path: str) -> int:
+    """Gate 7's committed-artifact half: the >= 2x steady-state
+    h2d-byte reduction on the serve leg, kernel-twin bit-identity, and
+    the identity + zero-recompile pins (both unconditional)."""
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, ValueError) as e:
+        print(f"bench_gate: unreadable paged artifact {path}: {e}",
+              file=sys.stderr)
+        return 2
+    rc = 0
+    red = doc.get("paged_h2d_reduction")
+    if not isinstance(red, (int, float)) or \
+            red < PAGED_REQUIRED_H2D_REDUCTION:
+        print(f"bench_gate: paged h2d-byte reduction {red!r} in {path} "
+              f"is below the required {PAGED_REQUIRED_H2D_REDUCTION}x "
+              "on the steady-state serve leg — the resident-paging win "
+              "regressed", file=sys.stderr)
+        rc = 1
+    if doc.get("paged_identical") is not True:
+        print(f"bench_gate: paged_identical is not true in {path} — "
+              "paged serve counters no longer byte-identical to solo "
+              "runs", file=sys.stderr)
+        rc = 1
+    if doc.get("paged_steady_recompiles") != 0:
+        print(f"bench_gate: paged_steady_recompiles "
+              f"{doc.get('paged_steady_recompiles')!r} in {path} — a "
+              "steady-state paged round must reuse every compiled "
+              "shape (compile-count delta 0)", file=sys.stderr)
+        rc = 1
+    mism = [k for k in PAGED_TWIN_KEYS if doc.get(k) is not True]
+    mism += sorted(k for k in doc
+                   if k.startswith("paged_") and k.endswith("_error"))
+    if mism:
+        print("bench_gate: paged kernel twins no longer bit-identical "
+              f"to their ragged forms in {path}: {mism}",
+              file=sys.stderr)
+        rc = 1
+    if rc == 0:
+        print(f"paged gate: steady-state h2d bytes {red}x >= "
+              f"{PAGED_REQUIRED_H2D_REDUCTION}x reduction "
+              f"({doc.get('paged_n_jobs')} tenants x "
+              f"{doc.get('paged_n_reads')} reads), all twins "
+              "bit-identical, identity true, 0 steady recompiles")
+    return rc
 
 
 def _check_serve_artifact(path: str) -> int:
@@ -382,6 +463,15 @@ def main(argv=None) -> int:
                   file=sys.stderr)
             return 2
         del argv[i:i + 2]
+    fresh_paged = None
+    if "--paged" in argv:
+        i = argv.index("--paged")
+        try:
+            fresh_paged = argv[i + 1]
+        except IndexError:
+            print("bench_gate: --paged needs a path", file=sys.stderr)
+            return 2
+        del argv[i:i + 2]
     for path in (BASELINE, CURRENT):
         if not os.path.exists(path):
             print(f"bench_gate: missing committed artifact {path} "
@@ -406,6 +496,11 @@ def main(argv=None) -> int:
     if not os.path.exists(FLEET_SERVE):
         print(f"bench_gate: missing committed artifact {FLEET_SERVE} "
               "(regenerate with: python bench.py --worker fleet_serve "
+              "> out.jsonl on the CPU backend)", file=sys.stderr)
+        return 2
+    if not os.path.exists(PAGED):
+        print(f"bench_gate: missing committed artifact {PAGED} "
+              "(regenerate with: python bench.py --worker paged_race "
               "> out.jsonl on the CPU backend)", file=sys.stderr)
         return 2
 
@@ -516,6 +611,27 @@ def main(argv=None) -> int:
                                  "--threshold", "10"])
         if rc != 0:
             print("bench_gate: a fleet-serve wall regressed past 10% "
+                  "vs the committed artifact", file=sys.stderr)
+            return rc
+
+    print(f"\n== gate 7: paged serve leg h2d reduction >= "
+          f"{PAGED_REQUIRED_H2D_REDUCTION}x on the committed "
+          "paged_race artifact ==")
+    rc = _check_paged_artifact(PAGED)
+    if rc != 0:
+        return rc
+
+    if fresh_paged:
+        print(f"\n== gate 7b: {fresh_paged} vs committed {PAGED} "
+              "(10% regression threshold on the serve walls) ==")
+        rc = _check_paged_artifact(fresh_paged)
+        if rc != 0:
+            return rc
+        rc = compare_bench.main([PAGED, fresh_paged,
+                                 "--keys", ",".join(PAGED_WALL_KEYS),
+                                 "--threshold", "10"])
+        if rc != 0:
+            print("bench_gate: a paged serve wall regressed past 10% "
                   "vs the committed artifact", file=sys.stderr)
             return rc
 

@@ -51,7 +51,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
 #: ragged-vs-padded dimension — is compared only when the event carries
 #: it, so pre-layout sidecars still replay)
 PLAN_FIELDS = ("chunk_rows", "ladder", "ladder_base", "prefetch_depth",
-               "donate", "layout")
+               "donate", "layout", "page_rows", "pool_pages")
 
 #: the fused-transform plan fields a replay must reproduce exactly
 #: (pipeline.decide_fusion_plan; same purity contract)
@@ -71,6 +71,11 @@ SHARD_DEATH_FIELDS = ("action", "new_incarnation", "splits", "reason")
 SHARD_SPEC_FIELDS = ("action", "victim", "target", "tail_runs",
                      "reason")
 
+#: the page-allocator fields a replay must reproduce exactly
+#: (parallel/pagedbuf.decide_pages — the resident paged-buffer plane;
+#: same purity contract)
+PAGES_FIELDS = ("pages", "action", "reason")
+
 #: the serve admission fields a replay must reproduce exactly
 #: (serve/admission.decide_admission — which jobs run and which share
 #: dispatches; same purity contract)
@@ -85,7 +90,7 @@ REQUEUE_FIELDS = ("action", "reason")
 STEAL_FIELDS = ("action", "moves", "reason")
 
 #: fields absent from older sidecars: compared only when recorded
-_OPTIONAL_FIELDS = ("layout",)
+_OPTIONAL_FIELDS = ("layout", "page_rows", "pool_pages")
 
 #: event kinds whose canonicalized inputs grew layout keys in PR 8 —
 #: a pre-layout event's recorded inputs digest differently under the
@@ -96,7 +101,7 @@ _LAYOUT_KINDS = ("executor_bucket_selected", "realign_plan_selected")
 _REPLAYED = ("executor_bucket_selected", "fusion_plan_selected",
              "realign_plan_selected", "shard_plan_selected",
              "shard_reassigned", "admission_selected",
-             "placement_selected", "job_requeued")
+             "placement_selected", "job_requeued", "pages_selected")
 
 
 def _events(path: str, kinds=_REPLAYED) -> List[Tuple[int, dict]]:
@@ -123,6 +128,7 @@ def check(paths: List[str]) -> List[str]:
     from adam_tpu.parallel.shardstream import (decide_shard_plan,
                                                decide_shard_reassignment,
                                                decide_shard_speculation)
+    from adam_tpu.parallel.pagedbuf import decide_pages
     from adam_tpu.serve.admission import decide_admission
     from adam_tpu.serve.scheduler import (decide_placement,
                                           decide_requeue, decide_steal)
@@ -137,7 +143,8 @@ def check(paths: List[str]) -> List[str]:
                 "admission_selected": (decide_admission,
                                        ADMISSION_FIELDS),
                 "placement_selected": (decide_placement,
-                                       PLACEMENT_FIELDS)}
+                                       PLACEMENT_FIELDS),
+                "pages_selected": (decide_pages, PAGES_FIELDS)}
     errs: List[str] = []
     # digests are namespaced per event kind: the two deciders hash
     # different input tuples and must never cross-validate
@@ -184,11 +191,11 @@ def check(paths: List[str]) -> List[str]:
             for field in fields:
                 if field in _OPTIONAL_FIELDS and field not in ev:
                     continue        # pre-layout sidecar: nothing recorded
-                if ev.get(field) != plan[field]:
+                if ev.get(field) != plan.get(field):
                     errs.append(
                         f"{path}:{i}: non-deterministic {kind} — "
                         f"recorded {field}={ev.get(field)!r}, replay "
-                        f"yields {plan[field]!r}")
+                        f"yields {plan.get(field)!r}")
             pre_layout = kind in _LAYOUT_KINDS and "layout" not in inputs
             if not pre_layout and \
                     ev.get("input_digest") != plan["input_digest"]:

@@ -151,6 +151,7 @@ KNOWN_EVENTS = (
     "serve_boot", "serve_pack_dispatch", "serve_pack_degraded",
     "placement_selected", "job_requeued", "worker_lease_expired",
     "ledger_stage",
+    "pages_selected", "h2d_bytes",
 )
 
 #: mirror of adam_tpu.resilience.faults.SITES / FAULTS (kept literal so
@@ -297,9 +298,17 @@ def validate(path: str) -> List[str]:
                     all(c in "0123456789abcdef" for c in dig)):
                 err(i, "executor_bucket_selected missing hex "
                        "'input_digest'")
-            if "layout" in d and d["layout"] not in ("padded", "ragged"):
+            if "layout" in d and d["layout"] not in ("padded", "ragged",
+                                                     "paged"):
                 err(i, f"executor_bucket_selected unknown layout "
                        f"{d['layout']!r}")
+            if d.get("layout") == "paged":
+                for field in ("page_rows", "pool_pages"):
+                    v = d.get(field)
+                    if not (isinstance(v, int) and
+                            not isinstance(v, bool) and v > 0):
+                        err(i, f"executor_bucket_selected paged layout "
+                               f"missing positive int {field!r}")
         elif ev == "executor_recompile":
             if not isinstance(d.get("pass"), str):
                 err(i, "executor_recompile missing string 'pass'")
@@ -662,6 +671,38 @@ def validate(path: str) -> List[str]:
                        "'age_s'")
             if not (_is_num(d.get("ttl_s")) and d["ttl_s"] > 0):
                 err(i, "worker_lease_expired missing positive 'ttl_s'")
+        elif ev == "pages_selected":
+            if not isinstance(d.get("pass"), str):
+                err(i, "pages_selected missing string 'pass'")
+            if d.get("action") not in ("alloc", "fallback"):
+                err(i, f"pages_selected unknown action "
+                       f"{d.get('action')!r}")
+            pages = d.get("pages")
+            if not (isinstance(pages, list) and all(
+                    isinstance(p, int) and not isinstance(p, bool)
+                    and p >= 0 for p in pages)):
+                err(i, "pages_selected 'pages' is not a list of "
+                       "non-negative page ids")
+            elif d.get("action") == "fallback" and pages:
+                err(i, "pages_selected fallback must select no pages")
+            if not isinstance(d.get("reason"), str):
+                err(i, "pages_selected missing string 'reason'")
+            if not isinstance(d.get("inputs"), dict):
+                err(i, "pages_selected missing 'inputs' object "
+                       "(decision must be replayable)")
+            if not _is_hex(d.get("input_digest")):
+                err(i, "pages_selected missing hex 'input_digest'")
+        elif ev == "h2d_bytes":
+            if not isinstance(d.get("pass"), str):
+                err(i, "h2d_bytes missing string 'pass'")
+            b = d.get("bytes")
+            if not (isinstance(b, int) and not isinstance(b, bool)
+                    and b >= 0):
+                err(i, "h2d_bytes missing non-negative int 'bytes'")
+            p = d.get("puts")
+            if not (isinstance(p, int) and not isinstance(p, bool)
+                    and p >= 1):
+                err(i, "h2d_bytes missing int 'puts' >= 1")
         elif ev == "startup_seconds":
             for k, v in d.items():
                 if k in ("event", "t"):

@@ -8,7 +8,9 @@ compare an OLD artifact against a NEW one and exit nonzero when any
 tracked metric regressed past the threshold:
 
 * throughput metrics (``value``, ``*_reads_per_sec``,
-  ``transform_vs_target``, ``vs_baseline``) — HIGHER is better;
+  ``transform_vs_target``, ``vs_baseline``, ``paged_h2d_reduction`` —
+  the resident-paging transfer headline, BENCH_PAGED.json) — HIGHER is
+  better;
 * cost metrics (``*_stage_wall_s``, ``*_wall_s``, ``first_matmul_s``,
   ``*pad_waste*``, ``*spill_amplification*``) — LOWER is better (the
   last two are the executor's pad-tax and the I/O ledger's spill ratio,
@@ -43,7 +45,7 @@ _LOWER_BETTER = ("pad_waste", "spill_amplification", "_wall_s",
 _HIGHER_BETTER_SUFFIX = ("_reads_per_sec", "_tflops",
                          "_gbytes_per_sec")
 _HIGHER_BETTER_EXACT = ("value", "vs_baseline", "transform_vs_target",
-                        "mfu", "mfu_pct")
+                        "mfu", "mfu_pct", "paged_h2d_reduction")
 
 
 def load_doc(path: str) -> dict:
