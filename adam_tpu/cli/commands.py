@@ -712,15 +712,50 @@ class ServeCommand(Command):
         p.add_argument("-no_steal", action="store_true",
                        help="fleet mode: disable work stealing for "
                             "idle workers")
+        p.add_argument("-no_fair", action="store_true",
+                       help="disable deficit-round-robin tenant "
+                            "fairness (admission/placement fall back "
+                            "to pure FIFO — a burst tenant can starve "
+                            "the queue)")
+        p.add_argument("-backlog_cap", type=int, default=None,
+                       help="reject queued jobs past this total "
+                            "backlog with a typed rejected/ doc + "
+                            "retry_after_s (0/default: unbounded)")
+        p.add_argument("-tenant_quota", type=int, default=None,
+                       help="max queued jobs one tenant may hold; the "
+                            "excess is rejected typed (0/default: "
+                            "unlimited)")
+        p.add_argument("-tenant_slots", type=int, default=None,
+                       help="max admissions one tenant may take per "
+                            "round (the in-flight quota; over-slots "
+                            "jobs wait, they are not shed)")
+        p.add_argument("-backlog_hi", type=int, default=None,
+                       help="brownout ladder backlog high watermark "
+                            "(default: 8x max_concurrent; 0 disables "
+                            "the ladder — docs/ARCHITECTURE.md §6m)")
+        p.add_argument("-queue_p99_hi", type=float, default=None,
+                       help="brownout ladder queue-wait p99 high "
+                            "watermark in seconds (0/default: signal "
+                            "disabled)")
+        p.add_argument("-rss_budget_mb", type=float, default=None,
+                       help="brownout ladder RSS budget in MB "
+                            "(0/default: signal disabled)")
         add_executor_args(p)
 
     def run(self, args) -> int:
         from ..instrument import say
+        from ..serve.overload import (resolve_admission_limits,
+                                      resolve_overload_policy)
 
         if args.hosts < 1:
             print(f"serve: -hosts must be >= 1 (got {args.hosts})",
                   file=sys.stderr)
             return 2
+        limits = resolve_admission_limits(
+            fair=False if args.no_fair else None,
+            backlog_cap=args.backlog_cap,
+            tenant_quota=args.tenant_quota,
+            tenant_slots=args.tenant_slots)
         if args.hosts > 1:
             from ..serve.scheduler import FleetServeScheduler
 
@@ -734,7 +769,13 @@ class ServeCommand(Command):
                 worker_depth=args.worker_depth,
                 max_job_kills=args.max_job_kills,
                 shard_rows=args.shard_rows, steal=not args.no_steal,
-                executor_opts=executor_opts_from(args))
+                executor_opts=executor_opts_from(args),
+                limits=limits,
+                overload=resolve_overload_policy(
+                    backlog_hi=args.backlog_hi,
+                    queue_p99_hi_s=args.queue_p99_hi,
+                    rss_budget_mb=args.rss_budget_mb,
+                    max_concurrent=args.worker_depth * args.hosts))
             info = sched.boot()
             say(f"serve: fleet of {info.get('hosts')} always-warm "
                 f"worker(s); spool {args.spool}")
@@ -749,7 +790,13 @@ class ServeCommand(Command):
             max_concurrent=args.max_concurrent,
             pack=not args.no_pack, pack_segments=args.pack_segments,
             poll_s=args.poll_s, io_procs=args.io_procs,
-            executor_opts=executor_opts_from(args))
+            executor_opts=executor_opts_from(args),
+            limits=limits,
+            overload=resolve_overload_policy(
+                backlog_hi=args.backlog_hi,
+                queue_p99_hi_s=args.queue_p99_hi,
+                rss_budget_mb=args.rss_budget_mb,
+                max_concurrent=args.max_concurrent))
         info = server.boot()
         say(f"serve: warm on {info.get('backend')} "
             f"({info.get('n_devices')} device(s)); "
@@ -788,9 +835,26 @@ class SubmitCommand(Command):
                             "output is byte-identical to the solo CLI)")
         p.add_argument("-timeout", type=float, default=120.0,
                        help="-wait timeout in seconds")
+        p.add_argument("-priority", default="normal",
+                       choices=["low", "normal", "high"],
+                       help="admission priority — the brownout "
+                            "ladder's reject_low rung sheds 'low' "
+                            "first (docs/ARCHITECTURE.md §6m)")
+        p.add_argument("-deadline", type=float, default=None,
+                       metavar="S",
+                       help="cancel the job (typed DeadlineExceeded) "
+                            "if it is still QUEUED after this many "
+                            "seconds — a result nobody waits for must "
+                            "not occupy a warm worker")
+        p.add_argument("-no_retry", action="store_true",
+                       help="with -wait: surface a typed admission "
+                            "rejection immediately instead of honoring "
+                            "its retry_after_s with one transparent "
+                            "resubmit")
 
     def run(self, args) -> int:
         import json as _json
+        import time as _time
 
         from ..serve import jobspec
 
@@ -800,23 +864,63 @@ class SubmitCommand(Command):
         except ValueError as e:
             print(f"submit: bad -args JSON: {e}", file=sys.stderr)
             return 2
-        try:
-            job_id = jobspec.submit_job(args.spool, {
-                "job_id": args.job_id, "tenant": args.tenant,
+        spec = {"job_id": args.job_id, "tenant": args.tenant,
                 "command": args.job_command, "input": args.input,
-                "output": args.output, "args": job_args})
+                "output": args.output, "args": job_args,
+                "priority": args.priority,
+                "deadline_s": args.deadline}
+        try:
+            job_id = jobspec.submit_job(args.spool, spec)
         except ValueError as e:
             print(f"submit: {e}", file=sys.stderr)
             return 2
         if not args.wait:
             print(f"queued {job_id}")
             return 0
-        try:
-            doc = jobspec.wait_result(args.spool, job_id,
-                                      timeout_s=args.timeout)
-        except TimeoutError as e:
-            print(f"submit: {e}", file=sys.stderr)
-            return 4
+        resubmitted = False
+        deadline = _time.monotonic() + args.timeout
+        while True:
+            try:
+                doc = jobspec.wait_result(
+                    args.spool, job_id,
+                    timeout_s=max(deadline - _time.monotonic(), 0.01))
+            except TimeoutError as e:
+                print(f"submit: {e}", file=sys.stderr)
+                return 4
+            if doc.get("rejected") and not args.no_retry \
+                    and not resubmitted:
+                # honor the server's typed back-off hint ONCE: wait
+                # retry_after_s, resubmit transparently (fresh id — a
+                # rejected id keeps its doc), then poll the new job; a
+                # second rejection surfaces typed below
+                after = float(doc.get("retry_after_s") or 1.0)
+                after = min(after, max(deadline - _time.monotonic(),
+                                       0.0))
+                print(f"submit: job {job_id} rejected "
+                      f"[{doc.get('code')}] — resubmitting once after "
+                      f"{after:.1f}s", file=sys.stderr)
+                _time.sleep(after)
+                retry_spec = dict(spec)
+                retry_spec["job_id"] = f"{args.job_id}.r1" \
+                    if args.job_id else None
+                try:
+                    job_id = jobspec.submit_job(args.spool, retry_spec)
+                except ValueError:
+                    # the derived id can itself be unsubmittable (an
+                    # id near the 80-char bound, or a stale .r1 doc
+                    # from an earlier run) — degrade to an auto id
+                    # rather than turning a retryable rejection into
+                    # a hard failure
+                    retry_spec["job_id"] = None
+                    try:
+                        job_id = jobspec.submit_job(args.spool,
+                                                    retry_spec)
+                    except ValueError as e:
+                        print(f"submit: {e}", file=sys.stderr)
+                        return 2
+                resubmitted = True
+                continue
+            break
         if not doc.get("ok"):
             print(f"submit: job {job_id} failed "
                   f"[{doc.get('error_type')}]: {doc.get('error')}",

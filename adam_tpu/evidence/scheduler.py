@@ -70,7 +70,12 @@ STAGE_DEADLINES_S = {"probe": 150.0, "flagstat": 180.0, "transform": 280.0,
                      # the in-process serve steady-state h2d leg; never
                      # in the TPU capture order — reached only via
                      # --worker/--only paged_race
-                     "paged_race": 400.0}
+                     "paged_race": 400.0,
+                     # overload protection (ISSUE 14): two serve
+                     # processes driven at 2x accepted capacity; never
+                     # in the TPU capture order — reached only via
+                     # --worker/--only overload
+                     "overload": 600.0}
 
 TIMEOUTS_ENV = "ADAM_TPU_BENCH_STAGE_TIMEOUTS"
 

@@ -96,6 +96,15 @@ MIN_CHUNK_ROWS = 1 << 14
 #: default look-ahead of the device feed (double-buffered)
 DEFAULT_PREFETCH_DEPTH = 2
 
+#: evidence-armed paging (ROADMAP item-2 headroom): with no explicit
+#: layout pin, a paged-capable pass arms the resident pool only when
+#: the ledger's platform-matched ``paged_race`` record shows the
+#: steady-state h2d-byte reduction at or past this factor (the gate-7
+#: acceptance floor) AND the paged serve wall within this slack of the
+#: unpaged wall — a transfer win that costs wall is not a win here
+PAGED_EVIDENCE_MIN_REDUCTION = 2.0
+PAGED_EVIDENCE_WALL_SLACK = 1.05
+
 
 def decide_plan(*, pass_name: str, chunk_rows: int, mesh_size: int,
                 on_tpu: bool, waste_mean: Optional[float] = None,
@@ -108,6 +117,7 @@ def decide_plan(*, pass_name: str, chunk_rows: int, mesh_size: int,
                 ragged_capable: bool = False,
                 ragged_rates: Optional[dict] = None,
                 paged_capable: bool = False,
+                paged_rates: Optional[dict] = None,
                 page_rows: Optional[int] = None,
                 pool_pages: Optional[int] = None,
                 autotune: bool = True) -> dict:
@@ -137,9 +147,18 @@ def decide_plan(*, pass_name: str, chunk_rows: int, mesh_size: int,
     rounds up to a whole number of ``page_rows``-element pages and the
     plan carries the page geometry (``page_rows``/``pool_pages``, the
     pool sized for the prefetch depth plus one dispatch in flight).
-    The paged keys join the recorded inputs ONLY when the dimension is
-    engaged, so pre-paged sidecars replay digest-identical (the
-    tenant/shard scoping precedent in resilience.faults).
+    ``paged_rates`` is the raced bench evidence for the PAGED twin —
+    the ledger's ``paged_race`` record for the CURRENT platform
+    (:func:`ledger_paged_rates`): with no explicit pin, a
+    ``paged_capable`` pass arms the resident pool when the measured
+    steady-state h2d reduction clears
+    :data:`PAGED_EVIDENCE_MIN_REDUCTION` and the paged serve wall did
+    not regress past :data:`PAGED_EVIDENCE_WALL_SLACK` — paging stops
+    being explicit-opt-in-only, but stays a measured optimization,
+    never a guess (the ragged-evidence discipline).  The paged keys
+    join the recorded inputs ONLY when the dimension is engaged, so
+    pre-paged sidecars replay digest-identical (the tenant/shard
+    scoping precedent in resilience.faults).
     """
     inputs = dict(pass_name=pass_name, chunk_rows=int(chunk_rows),
                   mesh_size=int(mesh_size), on_tpu=bool(on_tpu),
@@ -165,6 +184,11 @@ def decide_plan(*, pass_name: str, chunk_rows: int, mesh_size: int,
             else int(page_rows)
         inputs["pool_pages"] = None if pool_pages is None \
             else int(pool_pages)
+        if paged_rates:
+            # only-when-present: pre-evidence sidecars keep digesting
+            inputs["paged_rates"] = {
+                k: round(float(v), 4)
+                for k, v in sorted(paged_rates.items())}
     # decide from the CANONICALIZED inputs (what the event records) —
     # deciding from the raw floats would let a rounding boundary make
     # the offline replay disagree with the recorded plan
@@ -186,6 +210,20 @@ def decide_plan(*, pass_name: str, chunk_rows: int, mesh_size: int,
             reasons.append("ragged-pin-unsupported:padded")
     elif inputs["layout"] == "padded":
         reasons.append("layout-pinned-padded")
+    elif autotune and paged_engaged and inputs.get("paged_capable") \
+            and inputs.get("paged_rates") and \
+            inputs["paged_rates"].get("h2d_reduction", 0) >= \
+            PAGED_EVIDENCE_MIN_REDUCTION and \
+            inputs["paged_rates"].get("paged_wall_s", float("inf")) <= \
+            PAGED_EVIDENCE_WALL_SLACK * \
+            inputs["paged_rates"].get("unpaged_wall_s", 0):
+        # evidence-armed residency: the measured h2d win outranks the
+        # ragged-evidence branch below (paging IS the ragged addressing
+        # scheme plus residency)
+        pr = inputs["paged_rates"]
+        lay = "paged"
+        reasons.append(
+            f"paged-evidence h2d {pr['h2d_reduction']:.1f}x")
     elif autotune and inputs["ragged_capable"] and inputs["ragged_rates"]:
         rr = inputs["ragged_rates"]
         if rr.get("ragged", 0) > rr.get("padded", 0) > 0:
@@ -286,6 +324,38 @@ def ledger_ragged_rates(kernel: str,
         r = payload.get(f"ragged_{kernel}_ragged_per_sec")
         if p and r:
             return {"padded": float(p), "ragged": float(r)}
+    except Exception:  # noqa: BLE001 — telemetry-grade, never fatal
+        pass
+    return None
+
+
+def ledger_paged_rates(platform: Optional[str] = None) -> Optional[dict]:
+    """The evidence ledger's raced paged-vs-unpaged record — the bench
+    ``paged_race`` stage's steady-state serve-leg numbers
+    (``{"h2d_reduction", "unpaged_wall_s", "paged_wall_s"}``), or None
+    when the ledger has no record FOR THE CURRENT PLATFORM or the
+    record's identity bit is not clean (cross-platform evidence must
+    never steer a layout; a twin mismatch disqualifies the whole
+    record).  Best-effort, like :func:`ledger_ragged_rates`."""
+    try:
+        import jax
+
+        from ..evidence.ledger import Ledger, default_path
+        from ..platform import is_tpu_backend
+
+        plat = platform or \
+            ("tpu" if is_tpu_backend() else jax.default_backend())
+        rec = Ledger(default_path()).record("paged_race")
+        if not rec or rec.get("platform") != plat:
+            return None
+        payload = rec.get("payload") or rec
+        red = payload.get("paged_h2d_reduction")
+        u = payload.get("unpaged_serve_wall_s")
+        p = payload.get("paged_serve_wall_s")
+        if red and u and p and payload.get("paged_identical") is True:
+            return {"h2d_reduction": float(red),
+                    "unpaged_wall_s": float(u),
+                    "paged_wall_s": float(p)}
     except Exception:  # noqa: BLE001 — telemetry-grade, never fatal
         pass
     return None
@@ -604,6 +674,11 @@ class StreamExecutor:
         if capable and self.layout_pin is None and self.autotune:
             rates = ledger_ragged_rates(
                 _RAGGED_KERNEL_OF_PASS.get(pass_name, pass_name))
+        prates = None
+        if capable_paged and self.layout_pin is None and self.autotune:
+            # raced evidence can arm the resident pool (ROADMAP item-2
+            # headroom); explicit pins above always win
+            prates = ledger_paged_rates()
         plan = decide_plan(
             pass_name=pass_name, chunk_rows=self.chunk_rows,
             mesh_size=self.mesh_size, on_tpu=self.on_tpu,
@@ -613,6 +688,7 @@ class StreamExecutor:
             prefetch_depth=self.prefetch_depth, donate=self.donate,
             layout=self.layout_pin, ragged_capable=capable,
             ragged_rates=rates, paged_capable=capable_paged,
+            paged_rates=prates,
             page_rows=self.page_rows if capable_paged else None,
             pool_pages=self.pool_pages if capable_paged else None,
             autotune=self.autotune)

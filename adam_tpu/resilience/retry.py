@@ -24,8 +24,24 @@ Every decision is :func:`decide_retry` — PURE, recorded in full in the
 recorded run's policy offline.  Degraded dispatches additionally emit
 ``degraded_dispatch`` and set the ``degraded`` gauge.
 
-Policy knobs: ``-retry_budget`` on the streaming CLI commands, and the
-``ADAM_TPU_RETRY_*`` envs (docs/RESILIENCE.md).
+Above the per-chunk ladder sits the **backend circuit breaker**
+(docs/ARCHITECTURE.md §6m): one transient-retry exhaustion is a bad
+chunk, N of them inside a sliding window is a backend STORM — and
+paying ``budget`` retries + backoff per chunk during a storm multiplies
+the outage.  Per dispatch site, the breaker counts exhaustions; past
+``threshold`` in ``window_s`` it TRIPS OPEN (``breaker_state`` event,
+``breaker_open`` gauge) and every subsequent dispatch short-circuits —
+straight to the byte-identical degraded-CPU fallback when the site has
+one, or a typed :class:`BreakerOpen` otherwise — with zero device
+attempts and zero backoff sleeps.  After ``cooldown_s`` it goes
+HALF-OPEN: exactly one probe dispatch is let through; success closes
+the breaker (counters reset), failure re-opens it for another cooldown.
+:func:`decide_breaker` is PURE and its transitions replay offline
+through tools/check_executor.py.
+
+Policy knobs: ``-retry_budget`` on the streaming CLI commands, the
+``ADAM_TPU_RETRY_*`` envs, and the ``ADAM_TPU_BREAKER*`` envs
+(docs/RESILIENCE.md).
 """
 
 from __future__ import annotations
@@ -33,6 +49,7 @@ from __future__ import annotations
 import hashlib
 import json
 import os
+import threading
 import time
 from dataclasses import dataclass
 from typing import Callable, Optional
@@ -50,6 +67,30 @@ RETRY_SEED_ENV = "ADAM_TPU_RETRY_SEED"
 DEFAULT_BUDGET = 3
 DEFAULT_BACKOFF_S = 0.05
 DEFAULT_BACKOFF_CAP_S = 2.0
+
+
+def env_int(explicit, name: str, default: int) -> int:
+    """Explicit-argument-wins / env-fills-unset / garbage-falls-to-
+    default int coercion — THE resolver rule, shared by every policy
+    resolver here and in serve/overload.py."""
+    if explicit is not None:
+        return int(explicit)
+    try:
+        return int(os.environ[name]) if os.environ.get(name) \
+            else default
+    except ValueError:
+        return default
+
+
+def env_float(explicit, name: str, default: float) -> float:
+    """:func:`env_int`'s float twin."""
+    if explicit is not None:
+        return float(explicit)
+    try:
+        return float(os.environ[name]) if os.environ.get(name) \
+            else default
+    except ValueError:
+        return default
 
 #: XLA status codes (and message substrings) worth re-dispatching: the
 #: transient set production TPU jobs see across preemption, interconnect
@@ -80,35 +121,20 @@ def resolve_retry_policy(budget: Optional[int] = None,
     whatever the caller left unset (the executor's flag/env convention)."""
     env = os.environ
 
-    def _int(v, name, default):
-        if v is not None:
-            return int(v)
-        try:
-            return int(env[name]) if env.get(name) else default
-        except ValueError:
-            return default
-
-    def _float(v, name, default):
-        if v is not None:
-            return float(v)
-        try:
-            return float(env[name]) if env.get(name) else default
-        except ValueError:
-            return default
-
     def _bool(v, name):
         if v is not None:
             return bool(v)
         return env.get(name, "1") not in ("0", "off")
 
     return RetryPolicy(
-        budget=max(_int(budget, RETRY_BUDGET_ENV, DEFAULT_BUDGET), 1),
-        backoff_s=max(_float(backoff_s, RETRY_BACKOFF_ENV,
-                             DEFAULT_BACKOFF_S), 0.0),
+        budget=max(env_int(budget, RETRY_BUDGET_ENV, DEFAULT_BUDGET),
+                   1),
+        backoff_s=max(env_float(backoff_s, RETRY_BACKOFF_ENV,
+                                DEFAULT_BACKOFF_S), 0.0),
         backoff_cap_s=DEFAULT_BACKOFF_CAP_S,
         split=_bool(split, RETRY_SPLIT_ENV),
         cpu_fallback=_bool(cpu_fallback, RETRY_FALLBACK_ENV),
-        seed=_int(seed, RETRY_SEED_ENV, 0))
+        seed=env_int(seed, RETRY_SEED_ENV, 0))
 
 
 # ---------------------------------------------------------------------------
@@ -161,22 +187,6 @@ def resolve_fleet_policy(max_restarts: Optional[int] = None,
     renewal never expires a healthy worker."""
     env = os.environ
 
-    def _int(v, name, default):
-        if v is not None:
-            return int(v)
-        try:
-            return int(env[name]) if env.get(name) else default
-        except ValueError:
-            return default
-
-    def _float(v, name, default):
-        if v is not None:
-            return float(v)
-        try:
-            return float(env[name]) if env.get(name) else default
-        except ValueError:
-            return default
-
     def _bool(v, name, default):
         if v is not None:
             return bool(v)
@@ -185,17 +195,251 @@ def resolve_fleet_policy(max_restarts: Optional[int] = None,
             return default
         return raw not in ("0", "off", "")
 
-    ttl = max(_float(lease_ttl_s, FLEET_LEASE_TTL_ENV, 10.0), 0.1)
-    hb = _float(heartbeat_s, FLEET_HEARTBEAT_ENV, ttl / 3.0)
+    ttl = max(env_float(lease_ttl_s, FLEET_LEASE_TTL_ENV, 10.0), 0.1)
+    hb = env_float(heartbeat_s, FLEET_HEARTBEAT_ENV, ttl / 3.0)
     return FleetPolicy(
-        max_restarts=max(_int(max_restarts, FLEET_RESTARTS_ENV, 2), 0),
+        max_restarts=max(env_int(max_restarts, FLEET_RESTARTS_ENV, 2),
+                         0),
         lease_ttl_s=ttl,
         heartbeat_s=min(max(hb, 0.05), ttl),
         redistribute=_bool(redistribute, FLEET_REDISTRIBUTE_ENV, True),
         speculate=_bool(speculate, FLEET_SPECULATE_ENV, False),
         speculate_factor=max(
-            _float(speculate_factor, FLEET_SPECULATE_FACTOR_ENV, 3.0),
+            env_float(speculate_factor, FLEET_SPECULATE_FACTOR_ENV,
+                      3.0),
             1.0))
+
+
+# ---------------------------------------------------------------------------
+# the backend circuit breaker
+# ---------------------------------------------------------------------------
+
+BREAKER_ENV = "ADAM_TPU_BREAKER"                    # 0/off disables
+BREAKER_THRESHOLD_ENV = "ADAM_TPU_BREAKER_THRESHOLD"
+BREAKER_WINDOW_ENV = "ADAM_TPU_BREAKER_WINDOW_S"
+BREAKER_COOLDOWN_ENV = "ADAM_TPU_BREAKER_COOLDOWN_S"
+
+#: exhaustions inside the window before the breaker trips — one bad
+#: chunk retries normally; a third budget-exhausted chunk in half a
+#: minute is a storm
+DEFAULT_BREAKER_THRESHOLD = 3
+DEFAULT_BREAKER_WINDOW_S = 30.0
+DEFAULT_BREAKER_COOLDOWN_S = 5.0
+
+BREAKER_STATES = ("closed", "open", "half_open")
+
+
+class BreakerOpen(RuntimeError):
+    """A dispatch was refused because the site's circuit breaker is
+    open (a transient-failure storm is in progress) and the site has no
+    byte-identical CPU fallback to degrade to.  Typed — the serve loop
+    writes it into ``failed/<job>.json`` as ``error_type: BreakerOpen``
+    and the client may retry after the cooldown."""
+
+    def __init__(self, site: str, cooldown_s: float):
+        self.site = site
+        self.cooldown_s = cooldown_s
+        super().__init__(
+            f"circuit breaker open for site {site!r} (transient-"
+            f"failure storm); retry after ~{cooldown_s}s")
+
+
+@dataclass(frozen=True)
+class BreakerPolicy:
+    """One resolved breaker policy per process (all sites share it;
+    state is per site)."""
+    enabled: bool = True
+    threshold: int = DEFAULT_BREAKER_THRESHOLD
+    window_s: float = DEFAULT_BREAKER_WINDOW_S
+    cooldown_s: float = DEFAULT_BREAKER_COOLDOWN_S
+
+
+def resolve_breaker_policy(enabled: Optional[bool] = None,
+                           threshold: Optional[int] = None,
+                           window_s: Optional[float] = None,
+                           cooldown_s: Optional[float] = None
+                           ) -> BreakerPolicy:
+    """Explicit arguments win; ``ADAM_TPU_BREAKER*`` envs fill whatever
+    the caller left unset (the resolve_retry_policy convention)."""
+    if enabled is None:
+        enabled = os.environ.get(BREAKER_ENV, "1") not in ("0", "off")
+    return BreakerPolicy(
+        enabled=bool(enabled),
+        threshold=max(env_int(threshold, BREAKER_THRESHOLD_ENV,
+                              DEFAULT_BREAKER_THRESHOLD), 1),
+        window_s=max(env_float(window_s, BREAKER_WINDOW_ENV,
+                               DEFAULT_BREAKER_WINDOW_S), 0.1),
+        cooldown_s=max(env_float(cooldown_s, BREAKER_COOLDOWN_ENV,
+                                 DEFAULT_BREAKER_COOLDOWN_S), 0.0))
+
+
+#: (env 4-tuple) -> resolved policy: the per-dispatch hot path pays
+#: four dict lookups and a tuple compare, not string parsing + a
+#: dataclass build per chunk (tests that monkeypatch the envs still
+#: see their change — the key is the env values themselves)
+_BREAKER_POLICY_CACHE: dict = {}
+
+
+def _breaker_policy_cached() -> BreakerPolicy:
+    key = (os.environ.get(BREAKER_ENV),
+           os.environ.get(BREAKER_THRESHOLD_ENV),
+           os.environ.get(BREAKER_WINDOW_ENV),
+           os.environ.get(BREAKER_COOLDOWN_ENV))
+    pol = _BREAKER_POLICY_CACHE.get(key)
+    if pol is None:
+        _BREAKER_POLICY_CACHE.clear()   # envs changed: one live entry
+        pol = _BREAKER_POLICY_CACHE[key] = resolve_breaker_policy()
+    return pol
+
+
+def decide_breaker(*, state: str, failures: int, threshold: int,
+                   open_elapsed_s: Optional[float] = None,
+                   cooldown_s: float = DEFAULT_BREAKER_COOLDOWN_S,
+                   probe_ok: Optional[bool] = None) -> dict:
+    """One breaker transition — PURE.
+
+    ``state`` is the current breaker state, ``failures`` the
+    exhaustions currently inside the sliding window (the caller prunes
+    the window — the one clock use, at the impure boundary),
+    ``open_elapsed_s`` how long the breaker has been open (None unless
+    open), ``probe_ok`` the half-open probe's outcome (None unless a
+    probe finished).  Returns the next state with the canonicalized
+    inputs + digest (``breaker_state`` event; tools/check_executor.py
+    replays it)."""
+    inputs = dict(state=str(state), failures=int(failures),
+                  threshold=int(threshold),
+                  open_elapsed_s=None if open_elapsed_s is None
+                  else round(float(open_elapsed_s), 3),
+                  cooldown_s=round(float(cooldown_s), 3),
+                  probe_ok=None if probe_ok is None else bool(probe_ok))
+    cur = inputs["state"]
+    new, reason = cur, f"steady:{cur}"
+    if cur == "closed":
+        if inputs["failures"] >= inputs["threshold"]:
+            new = "open"
+            reason = (f"tripped: {inputs['failures']} transient "
+                      f"exhaustion(s) >= threshold "
+                      f"{inputs['threshold']} in window — storm")
+    elif cur == "open":
+        if inputs["open_elapsed_s"] is not None and \
+                inputs["open_elapsed_s"] >= inputs["cooldown_s"]:
+            new = "half_open"
+            reason = (f"cooldown {inputs['cooldown_s']}s elapsed: "
+                      "probing")
+    elif cur == "half_open":
+        if inputs["probe_ok"] is True:
+            new = "closed"
+            reason = "probe succeeded: closing"
+        elif inputs["probe_ok"] is False:
+            new = "open"
+            reason = "probe failed: re-opening"
+    digest = hashlib.sha256(
+        json.dumps(inputs, sort_keys=True).encode()).hexdigest()[:16]
+    return dict(state=new, changed=new != cur, reason=reason,
+                inputs=inputs, input_digest=digest)
+
+
+class _Breaker:
+    """One site's breaker: the impure shell (clock, window pruning,
+    thread lock) around :func:`decide_breaker`."""
+
+    def __init__(self, site: str):
+        self.site = site
+        self.state = "closed"
+        self.fail_times: list = []
+        self.opened_at: Optional[float] = None
+        self.probing = False
+        self._lock = threading.Lock()
+
+    def _transition(self, policy: BreakerPolicy, **signals) -> None:
+        """Take one pure :func:`decide_breaker` decision from the
+        current state + ``signals``, record it, apply it (caller holds
+        the lock) — every state change is a ``breaker_state`` event."""
+        d = decide_breaker(state=self.state,
+                           failures=len(self.fail_times),
+                           threshold=policy.threshold,
+                           cooldown_s=policy.cooldown_s, **signals)
+        if not d["changed"]:
+            return
+        self.state = d["state"]
+        if d["state"] == "open":
+            self.opened_at = time.monotonic()
+            self.probing = False
+            obs.registry().counter("breaker_trips",
+                                   site=self.site).inc()
+            obs.registry().gauge("breaker_open", site=self.site).set(1)
+        elif d["state"] == "closed":
+            self.fail_times = []
+            self.opened_at = None
+            self.probing = False
+            obs.registry().gauge("breaker_open", site=self.site).set(0)
+        obs.emit("breaker_state", site=self.site, state=d["state"],
+                 failures=len(self.fail_times), reason=d["reason"],
+                 inputs=d["inputs"], input_digest=d["input_digest"])
+
+    def _prune(self, window_s: float) -> None:
+        cut = time.monotonic() - window_s
+        while self.fail_times and self.fail_times[0] < cut:
+            self.fail_times.pop(0)
+
+    def admit(self, policy: BreakerPolicy) -> str:
+        """Gate one dispatch: ``"pass"`` (closed), ``"probe"`` (this
+        dispatch is the half-open probe), or ``"open"`` (short-circuit
+        to fallback/typed-reject)."""
+        with self._lock:
+            if self.state == "closed":
+                return "pass"
+            if self.state == "open":
+                elapsed = None if self.opened_at is None else \
+                    time.monotonic() - self.opened_at
+                self._transition(policy, open_elapsed_s=elapsed)
+            if self.state == "half_open":
+                if not self.probing:
+                    self.probing = True
+                    return "probe"
+            return "open"
+
+    def record_exhaustion(self, policy: BreakerPolicy) -> None:
+        """One transient budget exhaustion at this site: count it and
+        maybe trip."""
+        with self._lock:
+            self.fail_times.append(time.monotonic())
+            self._prune(policy.window_s)
+            if self.state == "closed":
+                self._transition(policy)
+
+    def probe_result(self, ok: bool, policy: BreakerPolicy) -> None:
+        with self._lock:
+            if self.state != "half_open":
+                return
+            self._transition(policy, probe_ok=ok)
+
+
+#: per-site breakers (process-global: the storm is a property of the
+#: backend, not of one executor instance)
+_BREAKERS: dict = {}
+_BREAKERS_LOCK = threading.Lock()
+
+
+def breaker_for(site: str) -> _Breaker:
+    with _BREAKERS_LOCK:
+        b = _BREAKERS.get(site)
+        if b is None:
+            b = _BREAKERS[site] = _Breaker(site)
+        return b
+
+
+def reset_breakers() -> None:
+    """Forget all breaker state (tests; a fresh process starts clean
+    anyway)."""
+    with _BREAKERS_LOCK:
+        _BREAKERS.clear()
+
+
+def breaker_snapshot() -> dict:
+    """``{site: state}`` for observability/reporting (never throws)."""
+    with _BREAKERS_LOCK:
+        return {s: b.state for s, b in _BREAKERS.items()}
 
 
 # ---------------------------------------------------------------------------
@@ -311,6 +555,13 @@ def dispatch_with_retry(fn: Callable[[int], object], *,
 
     The fault-injection site fires inside the attempt, so injected
     faults traverse the identical recovery path real errors take.
+
+    The site's circuit breaker gates the whole ladder: while OPEN (a
+    transient storm tripped it) the dispatch short-circuits — the
+    byte-identical CPU fallback runs with zero device attempts when the
+    site has one, a typed :class:`BreakerOpen` raises otherwise.  A
+    half-open breaker lets exactly one probe dispatch through; its
+    outcome closes or re-opens the breaker.
     """
     if policy is None:
         policy = resolve_retry_policy()
@@ -318,12 +569,32 @@ def dispatch_with_retry(fn: Callable[[int], object], *,
         # every device dispatch funnels through here — the first one of
         # the process closes the cold-start window (obs.startup)
         obs.startup.mark_at("first_dispatch")
+    bpolicy = _breaker_policy_cached()
+    breaker = breaker_for(site) if bpolicy.enabled else None
+    probe = False
+    if breaker is not None:
+        gate = breaker.admit(bpolicy)
+        probe = gate == "probe"
+        if gate == "open":
+            exc = BreakerOpen(site, bpolicy.cooldown_s)
+            if fallback is not None and policy.cpu_fallback:
+                obs.registry().counter("degraded_dispatches",
+                                       site=site).inc()
+                obs.registry().gauge("degraded").set(1)
+                obs.emit("degraded_dispatch", site=site, label=label,
+                         attempt=1, error_kind="breaker_open",
+                         error=str(exc)[:200])
+                return fallback(exc)
+            raise exc
     attempt = 0
     while True:
         attempt += 1
         try:
             faults.fire(site)
-            return fn(attempt)
+            result = fn(attempt)
+            if probe:
+                breaker.probe_result(True, bpolicy)
+            return result
         except Exception as e:  # noqa: BLE001 — classified below
             kind = classify_error(e)
             d = decide_retry(
@@ -344,6 +615,15 @@ def dispatch_with_retry(fn: Callable[[int], object], *,
                 if d["delay_s"]:
                     time.sleep(d["delay_s"])
                 continue
+            if breaker is not None:
+                # a transient budget exhaustion is the breaker's storm
+                # signal (one bad chunk retries; N exhausted chunks in
+                # the window trip the site); a half-open probe that
+                # ends anywhere but success re-opens
+                if kind == "transient" and d["action"] != "retry":
+                    breaker.record_exhaustion(bpolicy)
+                if probe:
+                    breaker.probe_result(False, bpolicy)
             if d["action"] == "split":
                 return split(e)
             if d["action"] == "fallback_cpu":

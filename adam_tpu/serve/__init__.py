@@ -14,6 +14,11 @@ a process that *lives* across runs.  This package is that process:
   flagstat wire buffer packs many tenants' rows, segment prefix-sum
   bounds keep per-tenant counters exact (ops/flagstat.py's segmented
   kernel, the ragged-concat discipline of docs/ARCHITECTURE.md §6g);
+* :mod:`.overload`  — the brownout ladder (``decide_overload``): a pure
+  overload state machine over backlog depth / queue-wait p99 / RSS
+  watermarks that sheds work in deliberate rungs (stop packing →
+  reject low-priority → reject all) instead of letting tail latency
+  grow without bound (docs/ARCHITECTURE.md §6m);
 * :mod:`.server`    — the long-lived loop: warm the backend once
   (platform.warm), admit queued jobs, multiplex them onto one device
   with per-tenant isolation (obs labels, fault/retry scoping, malformed
@@ -24,4 +29,5 @@ docs/ARCHITECTURE.md §6i walks the dataflow.
 
 from .admission import decide_admission  # noqa: F401
 from .jobspec import submit_job, wait_result  # noqa: F401
+from .overload import decide_overload  # noqa: F401
 from .server import ServeServer  # noqa: F401

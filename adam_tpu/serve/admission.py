@@ -2,24 +2,48 @@
 scheduler.
 
 Each serve round, the server snapshots its queue and asks ONE pure
-function which jobs run now and which of them share dispatches:
+function which jobs run now, which of them share dispatches, and — the
+overload half (docs/ARCHITECTURE.md §6m) — which are shed before they
+ever occupy a warm worker:
 
 * **FIFO admission** bounded by ``max_concurrent`` — submit order is the
-  only fairness story that is both starvation-free and replayable (no
-  clocks, no sizes-as-priorities that would let a huge tenant starve a
-  small one at decision time);
+  default fairness story (no clocks, no sizes-as-priorities);
+* **deficit-round-robin across tenants** (``fair=True``, the serve
+  default) — a burst tenant's 50-job backlog no longer starves the
+  steady tenant behind it: tenants take turns (quantum = one job per
+  tenant per cycle, the DRR special case where every job costs one
+  slot), ordered by each tenant's earliest queued seq so the
+  interleave is deterministic and replayable;
+* **bounded admission** — ``backlog_cap`` caps the total queue a round
+  will retain and ``tenant_quota`` caps one tenant's queued share;
+  everything past a cap is REJECTED with a typed, durable
+  ``rejected/<job>.json`` carrying ``retry_after_s`` (never a silent
+  drop, never a torn spool), and ``tenant_slots`` caps one tenant's
+  admissions per round (the in-flight quota — over-slots jobs simply
+  wait, they are not shed);
+* **deadlines** — a queued job whose recorded wait exceeds its spec's
+  ``deadline_s`` is CANCELLED (typed ``DeadlineExceeded`` failure doc)
+  instead of wasting a warm dispatch on a result nobody is waiting
+  for;
+* **brownout shedding** — ``overload_level`` (serve/overload.py's pure
+  ladder) >= 2 rejects queued low-priority work, >= 3 rejects all
+  queued work; level 1 (cheaper rounds) is applied by the CALLER
+  passing ``pack=False``, so the recorded inputs show exactly what the
+  round did;
 * **cross-tenant pack groups** — admitted flagstat jobs co-dispatch
   through the shared fixed-capacity wire buffer (serve/packed.py), at
-  most ``pack_segments`` tenants per group (the segmented kernel's
-  compiled segment width); a lone flagstat job runs solo, since a
-  one-tenant "shared" buffer is just the ragged path with extra steps.
+  most ``pack_segments`` tenants per group.
 
 :func:`decide_admission` follows the ``decide_plan`` convention
 (parallel/executor.py): PURE, canonicalized inputs recorded verbatim in
 the ``admission_selected`` event plus their digest, replayed offline by
-tools/check_executor.py.  The queue snapshot it decides from carries
-only (job_id, tenant, command, seq) — admission never reads a byte of
-input data, so the decision is cheap and the replay needs no files.
+tools/check_executor.py.  Every overload-era input joins the recorded
+``inputs`` ONLY when engaged (the tenant/shard-scoping precedent in
+resilience.faults), so pre-overload sidecars replay digest-identical.
+The queue snapshot it decides from carries only (job_id, tenant,
+command, seq) plus — only when set — (priority, deadline_s, wait_s);
+admission never reads a byte of input data, so the decision is cheap
+and the replay needs no files.
 """
 
 from __future__ import annotations
@@ -38,36 +62,228 @@ DEFAULT_PACK_SEGMENTS = 8
 #: jobs, not inside a dispatch)
 PACKABLE_COMMANDS = ("flagstat",)
 
+#: typed rejection codes (the ``code`` field of ``rejected/<job>.json``
+#: and the ``admission_rejected`` event) with their ``retry_after_s``
+#: floors — each a pure function of the decision inputs below
+REJECT_CODES = ("over_backlog", "tenant_quota", "brownout_low",
+                "brownout_all")
+
+#: retry_after_s bounds: deterministic, pure, and bounded — a client
+#: must never be told to wait forever, and the hint scales with how
+#: far over the cap the queue sits so a storm naturally spreads out
+RETRY_AFTER_MIN_S = 1.0
+RETRY_AFTER_MAX_S = 30.0
+
+
+def _retry_after(code: str, excess: int) -> float:
+    """Pure ``retry_after_s`` hint for one rejection: scales with how
+    deep past the cap the queue sits (``excess`` = position beyond the
+    cap, 1-based), clipped to [RETRY_AFTER_MIN_S, RETRY_AFTER_MAX_S]."""
+    base = {"over_backlog": 1.0, "tenant_quota": 2.0,
+            "brownout_low": 5.0, "brownout_all": 10.0}[code]
+    return round(min(max(base + 0.5 * max(excess - 1, 0),
+                         RETRY_AFTER_MIN_S), RETRY_AFTER_MAX_S), 3)
+
+
+def _drr_order(jobs: list, slots: int, tenant_slots: int) -> list:
+    """Deficit-round-robin interleave: tenants (ordered by earliest
+    queued seq) take turns releasing their next job in seq order —
+    quantum one job per tenant per cycle, the DRR special case where
+    every job costs one admission slot.  ``tenant_slots`` > 0 caps one
+    tenant's take per round (the in-flight quota)."""
+    order: list = []
+    per: dict = {}
+    for q in jobs:                 # jobs arrive seq-sorted, so first
+        t = q["tenant"]            # sighting order == earliest-seq order
+        if t not in per:
+            per[t] = []
+            order.append(t)
+        per[t].append(q)
+    admit: list = []
+    idx = {t: 0 for t in order}
+    taken = {t: 0 for t in order}
+    while len(admit) < slots:
+        progressed = False
+        for t in order:
+            if len(admit) >= slots:
+                break
+            if tenant_slots and taken[t] >= tenant_slots:
+                continue
+            if idx[t] < len(per[t]):
+                admit.append(per[t][idx[t]])
+                idx[t] += 1
+                taken[t] += 1
+                progressed = True
+        if not progressed:
+            break
+    return admit
+
 
 def decide_admission(*, queued: Iterable[dict], running: int,
                      max_concurrent: int, pack: bool = True,
-                     pack_segments: int = DEFAULT_PACK_SEGMENTS) -> dict:
+                     pack_segments: int = DEFAULT_PACK_SEGMENTS,
+                     fair: bool = False, backlog_cap: int = 0,
+                     tenant_quota: int = 0, tenant_slots: int = 0,
+                     overload_level: int = 0) -> dict:
     """One serve round's admission plan — PURE.
 
     ``queued``: compact descriptors ``{"job_id", "tenant", "command",
-    "seq"}`` (any order; canonicalization sorts by ``seq``).
-    ``running``: jobs already executing (occupied slots).  Returns::
+    "seq"}`` (any order; canonicalization sorts by ``seq``), each
+    optionally carrying ``priority`` (recorded only when not
+    ``"normal"``) and ``deadline_s`` + ``wait_s`` (recorded only when
+    the spec set a deadline; ``wait_s`` is the caller's measured
+    submit→now wait — the one clock read, taken at the impure boundary
+    and recorded so the replay is exact).  ``running``: jobs already
+    executing (occupied slots).  Returns::
 
         {"admit": [job_id, ...],          # start these, in order
          "pack_groups": [[job_id, ...]],  # co-dispatched subsets
+         "cancel": [{job_id, tenant, wait_s, deadline_s}, ...],
+         "reject": [{job_id, tenant, code, retry_after_s}, ...],
          "reason": str,
          "inputs": {...}, "input_digest": hex}
 
-    Every ``pack_groups`` member also appears in ``admit``; groups hold
-    >= 2 jobs (singletons run solo).  The recorded inputs replay the
-    decision bit-for-bit (tools/check_executor.py).
+    ``cancel``/``reject`` list the jobs to retire from the queue with
+    typed docs BEFORE any admission happens (a cancelled or rejected
+    job never occupies a slot); both keys are present only when
+    non-empty, and every overload-era keyword joins the recorded
+    ``inputs`` only when engaged — with the defaults this function is
+    bit-for-bit the pre-overload FIFO decider, so old sidecars replay
+    digest-identical.  Every ``pack_groups`` member also appears in
+    ``admit``; groups hold >= 2 jobs (singletons run solo).
     """
-    canon = sorted((dict(job_id=str(q["job_id"]), tenant=str(q["tenant"]),
-                         command=str(q["command"]), seq=int(q["seq"]))
-                    for q in queued), key=lambda q: q["seq"])
+    canon = []
+    for q in queued:
+        c = dict(job_id=str(q["job_id"]), tenant=str(q["tenant"]),
+                 command=str(q["command"]), seq=int(q["seq"]))
+        # only-when-set: a descriptor without a deadline or a
+        # non-default priority canonicalizes exactly as it always did
+        if q.get("priority") not in (None, "normal"):
+            c["priority"] = str(q["priority"])
+        if q.get("deadline_s") is not None:
+            c["deadline_s"] = round(float(q["deadline_s"]), 3)
+            c["wait_s"] = round(float(q.get("wait_s") or 0.0), 3)
+        canon.append(c)
+    canon.sort(key=lambda q: q["seq"])
     inputs = dict(queued=canon, running=int(running),
                   max_concurrent=int(max_concurrent), pack=bool(pack),
                   pack_segments=int(pack_segments))
+    # only-when-engaged: pre-overload sidecars must digest identically
+    if fair:
+        inputs["fair"] = True
+    if backlog_cap:
+        inputs["backlog_cap"] = int(backlog_cap)
+    if tenant_quota:
+        inputs["tenant_quota"] = int(tenant_quota)
+    if tenant_slots:
+        inputs["tenant_slots"] = int(tenant_slots)
+    if overload_level:
+        inputs["overload_level"] = int(overload_level)
+
+    reasons = []
+    remaining = list(canon)
+
+    # 1. deadlines: a job that already waited past its deadline is
+    # cancelled, never dispatched
+    cancel = [dict(job_id=q["job_id"], tenant=q["tenant"],
+                   wait_s=q["wait_s"], deadline_s=q["deadline_s"])
+              for q in remaining
+              if "deadline_s" in q and q["wait_s"] > q["deadline_s"]]
+    if cancel:
+        gone = {c["job_id"] for c in cancel}
+        remaining = [q for q in remaining if q["job_id"] not in gone]
+        reasons.append(f"cancelled {len(cancel)} past-deadline job(s)")
+
+    # 2. shedding, harshest rung first: brownout-all > brownout-low >
+    # tenant quota > backlog cap
+    reject: list = []
+
+    def _shed(job, code, excess):
+        reject.append(dict(job_id=job["job_id"], tenant=job["tenant"],
+                           code=code,
+                           retry_after_s=_retry_after(code, excess)))
+
+    lvl = inputs.get("overload_level", 0)
+    if lvl >= 3:
+        for k, q in enumerate(remaining):
+            _shed(q, "brownout_all", k + 1)
+        remaining = []
+    elif lvl >= 2:
+        keep = []
+        shed_n = 0
+        for q in remaining:
+            if q.get("priority") == "low":
+                shed_n += 1
+                _shed(q, "brownout_low", shed_n)
+            else:
+                keep.append(q)
+        remaining = keep
+    quota = inputs.get("tenant_quota", 0)
+    if quota:
+        seen: dict = {}
+        keep = []
+        for q in remaining:
+            n = seen.get(q["tenant"], 0) + 1
+            seen[q["tenant"]] = n
+            if n > quota:
+                _shed(q, "tenant_quota", n - quota)
+            else:
+                keep.append(q)
+        remaining = keep
+    cap = inputs.get("backlog_cap", 0)
+    if cap and len(remaining) > cap:
+        if inputs.get("fair"):
+            # retain the capped backlog in DRR order, not seq order: a
+            # pure-FIFO cut would hand a burst tenant every retained
+            # slot and convert the steady tenant's new jobs into 100%
+            # typed rejections — the exact starvation the fairness
+            # rung exists to prevent, made worse
+            keep_ids = {q["job_id"]
+                        for q in _drr_order(remaining, cap, 0)}
+        else:
+            keep_ids = {q["job_id"] for q in remaining[:cap]}
+        shed_n = 0
+        keep = []
+        for q in remaining:
+            if q["job_id"] in keep_ids:
+                keep.append(q)
+            else:
+                shed_n += 1
+                _shed(q, "over_backlog", shed_n)
+        remaining = keep
+    if reject:
+        reasons.append(f"rejected {len(reject)} job(s) "
+                       f"({'+'.join(sorted({r['code'] for r in reject}))})")
+
+    # 3. admission into the free slots: DRR interleave when fair,
+    # plain FIFO otherwise (the pre-overload behavior, bit-for-bit);
+    # the per-round tenant cap applies to BOTH orders — a quota the
+    # operator set must never silently depend on the fairness flag
     slots = max(inputs["max_concurrent"] - inputs["running"], 0)
-    admitted = inputs["queued"][:slots]
+    t_slots = inputs.get("tenant_slots", 0)
+    if inputs.get("fair"):
+        admitted = _drr_order(remaining, slots, t_slots)
+        tenants = len({q["tenant"] for q in remaining})
+        reasons.append(f"drr {len(admitted)}/{len(remaining)} queued "
+                       f"into {slots} slot(s) across {tenants} "
+                       "tenant(s)")
+    elif t_slots:
+        admitted, taken = [], {}
+        for q in remaining:
+            if len(admitted) >= slots:
+                break
+            if taken.get(q["tenant"], 0) >= t_slots:
+                continue            # over-slots: waits, not shed
+            taken[q["tenant"]] = taken.get(q["tenant"], 0) + 1
+            admitted.append(q)
+        reasons.append(f"fifo {len(admitted)}/{len(canon)} queued into "
+                       f"{slots} slot(s) (tenant_slots {t_slots})")
+    else:
+        admitted = remaining[:slots]
+        reasons.append(f"fifo {len(admitted)}/{len(canon)} queued into "
+                       f"{slots} slot(s)")
     admit = [q["job_id"] for q in admitted]
-    reasons = [f"fifo {len(admit)}/{len(canon)} queued into "
-               f"{slots} slot(s)"]
+
     pack_groups: list = []
     if inputs["pack"]:
         packable = [q["job_id"] for q in admitted
@@ -84,6 +300,11 @@ def decide_admission(*, queued: Iterable[dict], running: int,
                 f"group(s)")
     digest = hashlib.sha256(
         json.dumps(inputs, sort_keys=True).encode()).hexdigest()[:16]
-    return dict(admit=admit, pack_groups=pack_groups,
-                reason="; ".join(reasons), inputs=inputs,
-                input_digest=digest)
+    out = dict(admit=admit, pack_groups=pack_groups,
+               reason="; ".join(reasons), inputs=inputs,
+               input_digest=digest)
+    if cancel:
+        out["cancel"] = cancel
+    if reject:
+        out["reject"] = reject
+    return out

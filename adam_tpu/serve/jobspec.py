@@ -15,13 +15,18 @@ Spool layout::
     SPOOL/running/<seq>-<job_id>.json  claimed by the server
     SPOOL/done/<job_id>.json           result document (ok)
     SPOOL/failed/<job_id>.json         result document (typed failure)
+    SPOOL/rejected/<job_id>.json       typed admission rejection
+                                       (over-quota / brownout shed;
+                                       carries ``retry_after_s``)
     SPOOL/serving.json                 server boot receipt (pid + warmup)
     SPOOL/stop                         sentinel: drain and exit
 
 Job spec (canonicalized by :func:`canon_spec`)::
 
     {"job_id": str, "tenant": str, "command": "flagstat" | "transform",
-     "input": str, "output": str | null, "args": {...}}
+     "input": str, "output": str | null, "args": {...},
+     "priority": "low" | "normal" | "high",   # admission shed order
+     "deadline_s": float | null}              # cancel if queued longer
 
 ``args`` forwards a whitelisted subset of the underlying streaming
 call's keywords (:data:`FLAGSTAT_ARGS` / :data:`TRANSFORM_ARGS`) — the
@@ -40,6 +45,11 @@ from typing import Iterator, Optional, Tuple
 from ..checkpoint import atomic_write
 
 QUEUE, RUNNING, DONE, FAILED = "queue", "running", "done", "failed"
+#: typed admission rejections (over-quota / brownout shed) — a result
+#: class of its own so a rejected job is never confused with a job that
+#: RAN and failed; docs carry ``retry_after_s`` and clients (``adam-tpu
+#: submit -wait``) may transparently resubmit once after that delay
+REJECTED = "rejected"
 STOP_SENTINEL = "stop"
 SERVING_MARKER = "serving.json"
 
@@ -72,9 +82,14 @@ _NAME_RE = re.compile(r"^(\d{8,})-(.+)\.json$")
 _SEQ_FILE = ".seq"
 
 
-def spool_dirs(spool: str) -> Tuple[str, str, str, str]:
+#: which priorities a spec may carry; the brownout ladder's level-2
+#: rung sheds ``low`` first (serve/overload.py)
+PRIORITIES = ("low", "normal", "high")
+
+
+def spool_dirs(spool: str) -> Tuple[str, ...]:
     return tuple(os.path.join(spool, d)
-                 for d in (QUEUE, RUNNING, DONE, FAILED))
+                 for d in (QUEUE, RUNNING, DONE, FAILED, REJECTED))
 
 
 def ensure_spool(spool: str) -> str:
@@ -136,9 +151,23 @@ def canon_spec(spec: dict) -> dict:
     sub_at = spec.get("submitted_at")
     sub_at = float(sub_at) if isinstance(sub_at, (int, float)) \
         and not isinstance(sub_at, bool) else None
+    priority = spec.get("priority", "normal")
+    if priority is None:
+        priority = "normal"
+    if priority not in PRIORITIES:
+        raise ValueError(f"job spec: bad priority {priority!r} "
+                         f"(want one of {', '.join(PRIORITIES)})")
+    deadline = spec.get("deadline_s")
+    if deadline is not None:
+        if not (isinstance(deadline, (int, float))
+                and not isinstance(deadline, bool) and deadline > 0):
+            raise ValueError(f"job spec: deadline_s must be a positive "
+                             f"number of seconds (got {deadline!r})")
+        deadline = float(deadline)
     return {"job_id": job_id, "tenant": tenant, "command": cmd,
             "input": inp, "output": output, "args": dict(args),
-            "submitted_at": sub_at}
+            "submitted_at": sub_at, "priority": priority,
+            "deadline_s": deadline}
 
 
 _AUTO_ID_RE = re.compile(r"^job(\d{8,})\.json$")
@@ -169,7 +198,7 @@ def _max_seq(spool: str) -> int:
     spools without a ``.seq`` hint; normal submits read the hint and
     scan only the in-flight dirs."""
     seq = _live_max_seq(spool)
-    for d in (DONE, FAILED):
+    for d in (DONE, FAILED, REJECTED):
         try:
             names = os.listdir(os.path.join(spool, d))
         except OSError:
@@ -207,7 +236,7 @@ def _write_seq_hint(spool: str, seq: int) -> None:
 
 def _result_exists(spool: str, job_id: str) -> bool:
     return any(os.path.exists(os.path.join(spool, d, f"{job_id}.json"))
-               for d in (DONE, FAILED))
+               for d in (DONE, FAILED, REJECTED))
 
 
 def _id_in_flight(spool: str, job_id: str) -> bool:
@@ -303,6 +332,139 @@ def iter_queue(spool: str) -> Iterator[Tuple[int, str, dict]]:
             yield int(m.group(1)), path, spec
 
 
+class QueueCursor:
+    """Parse-once queue scanner: the poll-loop twin of
+    :func:`iter_queue`.
+
+    Every serve/placement round snapshots the queue; a naive rescan
+    re-opens and re-parses EVERY queued spec each round, making round
+    cost O(backlog) precisely when the backlog is deepest (the overload
+    regime the brownout ladder exists for).  Queue files are immutable
+    once hard-linked (submit never rewrites; claims RENAME the file
+    away), so a name seen once never needs re-parsing: this cursor
+    keeps a name-keyed spec cache, parses only names it has not seen,
+    and evicts names that left the directory.  When the directory
+    mtime is unchanged (and old enough to be outside coarse-timestamp
+    races) the previous listing is reused wholesale.
+
+    ``parsed_total`` counts file parses since construction — the
+    flat-round-cost pin in tests/test_serve.py reads it.
+    """
+
+    #: reuse the cached listing only when the dir mtime is at least
+    #: this old — inside the window a same-ns submit could hide
+    _MTIME_SETTLE_S = 2.0
+
+    def __init__(self, spool: str):
+        self.spool = spool
+        self._specs: dict = {}          # name -> (seq, spec) | None (bad)
+        self._last_mtime_ns: Optional[int] = None
+        self._last_names: list = []
+        self.parsed_total = 0
+
+    def snapshot(self) -> list:
+        """Queued jobs in submit order: ``[(seq, path, spec), ...]`` —
+        the :func:`iter_queue` contract, amortized O(new entries)."""
+        import time as _time
+
+        qdir = os.path.join(self.spool, QUEUE)
+        try:
+            st = os.stat(qdir)
+        except OSError:
+            return []
+        if (self._last_mtime_ns is not None
+                and st.st_mtime_ns == self._last_mtime_ns):
+            names = self._last_names
+        else:
+            try:
+                names = os.listdir(qdir)
+            except OSError:
+                return []
+            # trust this listing for mtime-keyed reuse ONLY when it
+            # was taken outside the settle window: a listing taken
+            # moments after a submit could miss a second submit
+            # landing in the same coarse mtime tick, and the age test
+            # at reuse time cannot detect that — the listing, not the
+            # mtime, must be older than the window
+            self._last_mtime_ns = st.st_mtime_ns \
+                if _time.time() - st.st_mtime > self._MTIME_SETTLE_S \
+                else None
+            self._last_names = names
+            for gone in set(self._specs) - set(names):
+                del self._specs[gone]
+        out = []
+        for name in names:
+            m = _NAME_RE.match(name)
+            if not m:
+                continue
+            if name not in self._specs:
+                self.parsed_total += 1
+                try:
+                    with open(os.path.join(qdir, name)) as f:
+                        spec = json.load(f)
+                except OSError:
+                    # TRANSIENT (fd exhaustion, a racing claim): do
+                    # NOT cache — caching would starve an intact
+                    # queued job forever; the next round retries, the
+                    # iter_queue discipline
+                    continue
+                except ValueError:
+                    spec = None     # torn/tampered content: the file
+                #                     is immutable, so this is final
+                self._specs[name] = (int(m.group(1)), spec) \
+                    if isinstance(spec, dict) else None
+            ent = self._specs[name]
+            if ent is not None:
+                out.append((ent[0], os.path.join(qdir, name), ent[1]))
+        out.sort(key=lambda e: e[0])
+        return out
+
+
+def snapshot_canon(spool: str, cursor: QueueCursor,
+                   canon_cache: dict) -> list:
+    """Cursor-backed CANONICALIZED queue snapshot: ``[(seq, path,
+    canon), ...]`` with canonicalization paid once per immutable queue
+    file (``canon_cache``, name-keyed, evicted with the listing) and
+    hand-tampered bad specs retired in place with their own typed
+    failure doc — ONE implementation for the serve loop and the fleet
+    front door, so the bad-spec discipline can never skew between
+    them.
+
+    The failure doc keys by the FILENAME-derived id (via the name
+    regex — a fixed slice would mangle 9-digit seqs), never the file's
+    own ``job_id`` field: a filename cannot carry a path separator,
+    but a hand-written job_id like ``../../x`` could walk the result
+    write out of the spool."""
+    out = []
+    live = set()
+    for seq, path, spec in cursor.snapshot():
+        name = os.path.basename(path)
+        live.add(name)
+        if name not in canon_cache:
+            try:
+                canon_cache[name] = canon_spec(spec)
+            except ValueError as e:
+                m = _NAME_RE.match(name)
+                bad = {"job_id": m.group(2), "tenant": "default",
+                       "command": str(spec.get("command")),
+                       "input": "", "output": None, "args": {},
+                       "submitted_at": None, "priority": "normal",
+                       "deadline_s": None}
+                claimed = claim_job(spool, path)
+                write_result(spool, bad, ok=False, error=str(e),
+                             error_type="ValueError",
+                             running_path=claimed)
+                canon_cache[name] = {}
+                continue
+        canon = canon_cache[name]
+        if not canon:
+            continue            # failed canonicalization above
+        out.append((seq, path, dict(canon, seq=seq)))
+    for gone in [n for n in canon_cache if n not in live]:
+        del canon_cache[gone]
+    return out
+
+
 def claim_job(spool: str, queue_path: str) -> Optional[str]:
     """Move a queued job to ``running/`` (atomic rename).  Returns the
     running path, or None when another server instance claimed it
@@ -372,8 +534,32 @@ def write_result(spool: str, spec: dict, *, ok: bool,
     return dest
 
 
+def write_rejection(spool: str, spec: dict, *, code: str,
+                    retry_after_s: float, message: str,
+                    queue_path: Optional[str] = None) -> str:
+    """Publish one job's durable TYPED rejection (over-quota or
+    brownout shed — the job never ran) to ``rejected/<job>.json`` and
+    retire its claimed queue file.  Never a silent drop, never a torn
+    spool: the doc lands atomically BEFORE the queue entry goes away,
+    so a crash between the two leaves a duplicate doc, not a lost job."""
+    doc = {"job_id": spec["job_id"], "tenant": spec["tenant"],
+           "command": spec["command"], "ok": False, "rejected": True,
+           "code": str(code),
+           "retry_after_s": round(float(retry_after_s), 3),
+           "error": str(message)[:500],
+           "error_type": "AdmissionRejected"}
+    dest = os.path.join(spool, REJECTED, f"{spec['job_id']}.json")
+    atomic_write(dest, json.dumps(doc, sort_keys=True))
+    if queue_path:
+        try:
+            os.unlink(queue_path)
+        except OSError:
+            pass
+    return dest
+
+
 def read_result(spool: str, job_id: str) -> Optional[dict]:
-    for d in (DONE, FAILED):
+    for d in (DONE, FAILED, REJECTED):
         path = os.path.join(spool, d, f"{job_id}.json")
         try:
             with open(path) as f:
@@ -384,21 +570,33 @@ def read_result(spool: str, job_id: str) -> Optional[dict]:
 
 
 def wait_result(spool: str, job_id: str, timeout_s: float = 60.0,
-                poll_s: float = 0.05) -> dict:
+                poll_s: float = 0.05,
+                max_poll_s: Optional[float] = None) -> dict:
     """Poll for a job's result document; raises ``TimeoutError`` when
-    the server never publishes one in time."""
+    the server never publishes one in time.
+
+    The poll interval backs off exponentially from ``poll_s`` to
+    ``max_poll_s`` (default: 20x ``poll_s``, capped at 1 s) — a client
+    waiting on a deeply backlogged server must not hammer the result
+    directories at a fixed busy-poll rate, but the first few polls stay
+    tight so a warm fast job still returns promptly."""
     import time
 
+    if max_poll_s is None:
+        max_poll_s = min(max(poll_s * 20.0, poll_s), 1.0)
     deadline = time.monotonic() + timeout_s
+    delay = max(poll_s, 1e-4)
     while True:
         doc = read_result(spool, job_id)
         if doc is not None:
             return doc
-        if time.monotonic() >= deadline:
+        now = time.monotonic()
+        if now >= deadline:
             raise TimeoutError(
                 f"no result for job {job_id!r} within {timeout_s}s "
                 f"(is a server running on {spool!r}?)")
-        time.sleep(poll_s)
+        time.sleep(min(delay, max(deadline - now, 0.0)))
+        delay = min(delay * 2.0, max_poll_s)
 
 
 def set_active(spool: str, job_ids) -> None:

@@ -1,0 +1,324 @@
+"""The brownout ladder: a pure overload state machine for the serve
+plane (docs/ARCHITECTURE.md §6m).
+
+When offered load outruns warm capacity, the failure mode is not a
+crash — it is an unbounded backlog whose queue-wait tail grows without
+limit while every accepted job still "succeeds".  The ladder converts
+that into a sequence of deliberate, cheap degradations, walked one rung
+per decision and recorded as replayable events:
+
+====  ============  =====================================================
+rung  state         sheds
+====  ============  =====================================================
+0     ``normal``    nothing
+1     ``shed_batch``  shared-dispatch packing + fleet shard-splitting
+                    (cheaper, more predictable rounds; every accepted
+                    byte stays identical — packing is an optimization,
+                    never a semantic)
+2     ``reject_low``  new low-priority work (typed ``rejected/`` docs
+                    with ``retry_after_s``)
+3     ``reject_all``  all new work (existing claims still finish)
+====  ============  =====================================================
+
+:func:`decide_overload` is PURE (the ``decide_plan`` convention): the
+serving loop reads the impure signals ONCE per round — backlog depth,
+the recent accepted-job queue-wait p99 it already measures for the SLO
+report, and process RSS — and hands them in as plain numbers, so the
+recorded ``overload_state`` event replays bit-for-bit offline
+(tools/check_executor.py).  Pressure is the max ratio of any engaged
+signal over its high watermark; the ladder walks UP one rung when
+pressure crosses the next threshold (1x → rung 1, 2x → rung 2, 4x →
+rung 3) and walks DOWN one rung only after ``cool_rounds`` consecutive
+calm decisions — hysteresis, so a watermark-straddling backlog does not
+flap the ladder every round.
+
+The companion breaker for the *backend* half of overload (a storm of
+transient dispatch failures, not a deep queue) lives in
+resilience/retry.py (:class:`~adam_tpu.resilience.retry.BreakerPolicy`).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+from dataclasses import dataclass
+from typing import Optional
+
+#: ladder rung names, index == level
+LEVEL_NAMES = ("normal", "shed_batch", "reject_low", "reject_all")
+
+#: pressure thresholds: level n engages at PRESSURE_STEPS[n-1] times
+#: the high watermark (geometric — each rung means "twice as far past
+#: capacity as the last")
+PRESSURE_STEPS = (1.0, 2.0, 4.0)
+
+#: env knobs (serve CLI flags mirror these; docs/FLEET_SERVE.md)
+BACKLOG_HI_ENV = "ADAM_TPU_SERVE_BACKLOG_HI"
+QUEUE_P99_HI_ENV = "ADAM_TPU_SERVE_QUEUE_P99_HI_S"
+RSS_BUDGET_ENV = "ADAM_TPU_SERVE_RSS_BUDGET_MB"
+COOL_ROUNDS_ENV = "ADAM_TPU_SERVE_COOL_ROUNDS"
+FAIR_ENV = "ADAM_TPU_SERVE_FAIR"                    # 0/off disables
+BACKLOG_CAP_ENV = "ADAM_TPU_SERVE_BACKLOG_CAP"
+TENANT_QUOTA_ENV = "ADAM_TPU_SERVE_TENANT_QUOTA"
+TENANT_SLOTS_ENV = "ADAM_TPU_SERVE_TENANT_SLOTS"
+
+#: default backlog high watermark as a multiple of ``max_concurrent``
+#: when no explicit watermark is configured: eight full admission
+#: rounds of queue is "the backlog outran warm capacity"
+DEFAULT_BACKLOG_HI_ROUNDS = 8
+
+DEFAULT_COOL_ROUNDS = 3
+
+
+@dataclass(frozen=True)
+class OverloadPolicy:
+    """One resolved overload policy per serving loop.  ``backlog_hi``
+    <= 0 disables the ladder entirely (the zero-overhead off state);
+    ``queue_p99_hi_s``/``rss_budget_mb`` <= 0 disable that signal."""
+    backlog_hi: int = 0
+    queue_p99_hi_s: float = 0.0
+    rss_budget_mb: float = 0.0
+    cool_rounds: int = DEFAULT_COOL_ROUNDS
+
+
+def resolve_overload_policy(backlog_hi: Optional[int] = None,
+                            queue_p99_hi_s: Optional[float] = None,
+                            rss_budget_mb: Optional[float] = None,
+                            cool_rounds: Optional[int] = None,
+                            max_concurrent: int = 4) -> OverloadPolicy:
+    """Explicit arguments (CLI flags) win; ``ADAM_TPU_SERVE_*`` envs
+    fill whatever the caller left unset (the executor's flag/env
+    convention, via the shared retry.env_int/env_float coercers); the
+    backlog watermark defaults to ``DEFAULT_BACKLOG_HI_ROUNDS *
+    max_concurrent``."""
+    from ..resilience.retry import env_float, env_int
+
+    return OverloadPolicy(
+        backlog_hi=env_int(backlog_hi, BACKLOG_HI_ENV,
+                           DEFAULT_BACKLOG_HI_ROUNDS *
+                           max(max_concurrent, 1)),
+        queue_p99_hi_s=env_float(queue_p99_hi_s, QUEUE_P99_HI_ENV,
+                                 0.0),
+        rss_budget_mb=env_float(rss_budget_mb, RSS_BUDGET_ENV, 0.0),
+        cool_rounds=max(env_int(cool_rounds, COOL_ROUNDS_ENV,
+                                DEFAULT_COOL_ROUNDS), 1))
+
+
+@dataclass(frozen=True)
+class AdmissionLimits:
+    """The quota half of the overload plane (decide_admission's
+    keywords): ``fair`` = deficit-round-robin across tenants (on by
+    default), the caps each default 0 = unbounded."""
+    fair: bool = True
+    backlog_cap: int = 0
+    tenant_quota: int = 0
+    tenant_slots: int = 0
+
+
+def resolve_admission_limits(fair: Optional[bool] = None,
+                             backlog_cap: Optional[int] = None,
+                             tenant_quota: Optional[int] = None,
+                             tenant_slots: Optional[int] = None
+                             ) -> AdmissionLimits:
+    """Explicit arguments win; ``ADAM_TPU_SERVE_*`` envs fill the rest
+    (the resolve_retry_policy convention)."""
+    from ..resilience.retry import env_int
+
+    if fair is None:
+        fair = os.environ.get(FAIR_ENV, "1") not in ("0", "off")
+    return AdmissionLimits(
+        fair=bool(fair),
+        backlog_cap=max(env_int(backlog_cap, BACKLOG_CAP_ENV, 0), 0),
+        tenant_quota=max(env_int(tenant_quota, TENANT_QUOTA_ENV, 0),
+                         0),
+        tenant_slots=max(env_int(tenant_slots, TENANT_SLOTS_ENV, 0),
+                         0))
+
+
+def rss_mb() -> Optional[float]:
+    """This process's CURRENT resident set in MB — the one impure
+    memory read, taken by the serving loop at the round boundary and
+    handed to the pure decider.  Current, not peak: ``ru_maxrss``
+    never decreases, so a ladder driven by it could walk up on one
+    freed spike and never cool back down.  ``/proc/self/statm`` on
+    Linux; the peak (the only portable number) is the fallback where
+    /proc does not exist."""
+    try:
+        with open("/proc/self/statm") as f:
+            pages = int(f.read().split()[1])
+        return pages * (os.sysconf("SC_PAGE_SIZE") / (1 << 20))
+    except Exception:  # noqa: BLE001 — fall through to the peak
+        pass
+    try:
+        import resource
+        import sys
+
+        peak = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+        return peak / (1 << 20) if sys.platform == "darwin" \
+            else peak / 1024.0
+    except Exception:  # noqa: BLE001 — a signal, never a crash
+        return None
+
+
+def decide_overload(*, level: int, backlog: int,
+                    backlog_hi: int,
+                    queue_p99_s: Optional[float] = None,
+                    queue_p99_hi_s: float = 0.0,
+                    rss_mb: Optional[float] = None,
+                    rss_budget_mb: float = 0.0,
+                    calm_rounds: int = 0,
+                    cool_rounds: int = DEFAULT_COOL_ROUNDS) -> dict:
+    """One round's brownout decision — PURE.
+
+    ``level`` is the current rung, ``calm_rounds`` the consecutive
+    below-target decisions so far (both carried by the caller between
+    rounds and recorded, so the state machine replays).  Signals with
+    a <= 0 watermark (or a None reading) are disengaged.  Returns::
+
+        {"level": int, "state": name, "prev_level": int,
+         "changed": bool, "calm_rounds": int, "pressure": float,
+         "actions": {"pack": bool, "shard_split": bool,
+                     "admit_low": bool, "admit_any": bool},
+         "reason": str, "inputs": {...}, "input_digest": hex}
+
+    The ladder walks up at most ONE rung per decision and down one
+    rung only after ``cool_rounds`` consecutive decisions whose target
+    sat below the current rung (hysteresis).  Recorded in full by the
+    ``overload_state`` event; tools/check_executor.py replays it.
+    """
+    inputs = dict(level=int(level), backlog=int(backlog),
+                  backlog_hi=int(backlog_hi),
+                  queue_p99_s=None if queue_p99_s is None
+                  else round(float(queue_p99_s), 3),
+                  queue_p99_hi_s=round(float(queue_p99_hi_s), 3),
+                  rss_mb=None if rss_mb is None
+                  else round(float(rss_mb), 1),
+                  rss_budget_mb=round(float(rss_budget_mb), 1),
+                  calm_rounds=int(calm_rounds),
+                  cool_rounds=max(int(cool_rounds), 1))
+    ratios = []
+    if inputs["backlog_hi"] > 0:
+        ratios.append(("backlog", inputs["backlog"] /
+                       inputs["backlog_hi"]))
+    if inputs["queue_p99_hi_s"] > 0 and inputs["queue_p99_s"] is not None:
+        ratios.append(("queue_p99", inputs["queue_p99_s"] /
+                       inputs["queue_p99_hi_s"]))
+    if inputs["rss_budget_mb"] > 0 and inputs["rss_mb"] is not None:
+        ratios.append(("rss", inputs["rss_mb"] /
+                       inputs["rss_budget_mb"]))
+    signal, pressure = max(ratios, key=lambda r: r[1]) \
+        if ratios else ("none", 0.0)
+    pressure = round(pressure, 4)
+    target = 0
+    for step in PRESSURE_STEPS:
+        if pressure >= step:
+            target += 1
+    cur = max(min(inputs["level"], len(LEVEL_NAMES) - 1), 0)
+    calm = inputs["calm_rounds"]
+    if target > cur:
+        new, calm = cur + 1, 0          # walk up one rung at a time
+        reason = (f"{signal} pressure {pressure}x -> "
+                  f"{LEVEL_NAMES[new]} (target {LEVEL_NAMES[target]})")
+    elif target < cur:
+        calm += 1
+        if calm >= inputs["cool_rounds"]:
+            new, calm = cur - 1, 0      # cooled long enough: step down
+            reason = (f"calm {inputs['cool_rounds']} round(s) -> "
+                      f"{LEVEL_NAMES[new]}")
+        else:
+            new = cur
+            reason = (f"cooling {calm}/{inputs['cool_rounds']} at "
+                      f"{LEVEL_NAMES[cur]}")
+    else:
+        new, calm = cur, 0
+        reason = f"steady at {LEVEL_NAMES[cur]} (pressure {pressure}x)"
+    digest = hashlib.sha256(
+        json.dumps(inputs, sort_keys=True).encode()).hexdigest()[:16]
+    return dict(level=new, state=LEVEL_NAMES[new], prev_level=cur,
+                changed=new != cur, calm_rounds=calm,
+                pressure=pressure,
+                actions=dict(pack=new < 1, shard_split=new < 1,
+                             admit_low=new < 2, admit_any=new < 3),
+                reason=reason, inputs=inputs, input_digest=digest)
+
+
+class OverloadTracker:
+    """The impure shell around :func:`decide_overload`: holds the rung
+    + calm counter between rounds, keeps a bounded window of recent
+    accepted-job queue waits for the p99 signal, reads RSS, emits the
+    ``overload_state`` event on every rung change and keeps the
+    ``overload_level`` gauge current.  Shared by the single-host server
+    and the fleet scheduler (docs/FLEET_SERVE.md)."""
+
+    #: queue waits kept for the rolling p99 (enough for a stable tail,
+    #: small enough that an hour-old spike eventually ages out)
+    WINDOW = 64
+    #: samples also age out by TIME: at reject_all nothing new is
+    #: served, so a count-only window would freeze at the burst-era
+    #: p99 and the ladder could never cool back down — the signal must
+    #: decay while the server sheds
+    WINDOW_AGE_S = 60.0
+
+    def __init__(self, policy: OverloadPolicy):
+        self.policy = policy
+        self.level = 0
+        self.calm_rounds = 0
+        self._waits: list = []      # [(monotonic_ts, wait_s), ...]
+
+    @property
+    def engaged(self) -> bool:
+        return self.policy.backlog_hi > 0 or \
+            self.policy.queue_p99_hi_s > 0 or \
+            self.policy.rss_budget_mb > 0
+
+    def observe_wait(self, queue_s) -> None:
+        import time
+
+        if isinstance(queue_s, (int, float)) and \
+                not isinstance(queue_s, bool) and queue_s >= 0:
+            self._waits.append((time.monotonic(), float(queue_s)))
+            if len(self._waits) > self.WINDOW:
+                del self._waits[:len(self._waits) - self.WINDOW]
+
+    def _queue_p99(self) -> Optional[float]:
+        import time
+
+        cut = time.monotonic() - self.WINDOW_AGE_S
+        self._waits = [w for w in self._waits if w[0] >= cut]
+        if not self._waits:
+            return None
+        from .server import _pctl
+        return _pctl([w[1] for w in self._waits], 99)
+
+    def update(self, backlog: int) -> dict:
+        """One round's ladder step: read the signals, take the pure
+        decision, record it.  Returns the decision (callers read
+        ``actions``/``level``)."""
+        from .. import obs
+
+        pol = self.policy
+        d = decide_overload(
+            level=self.level, backlog=backlog,
+            backlog_hi=pol.backlog_hi,
+            queue_p99_s=self._queue_p99() if pol.queue_p99_hi_s > 0
+            else None,
+            queue_p99_hi_s=pol.queue_p99_hi_s,
+            rss_mb=rss_mb() if pol.rss_budget_mb > 0 else None,
+            rss_budget_mb=pol.rss_budget_mb,
+            calm_rounds=self.calm_rounds,
+            cool_rounds=pol.cool_rounds)
+        self.level = d["level"]
+        self.calm_rounds = d["calm_rounds"]
+        if d["changed"]:
+            obs.registry().counter(
+                "overload_transitions",
+                state=d["state"]).inc()
+            obs.registry().gauge("overload_level").set(d["level"])
+            obs.emit("overload_state", level=d["level"],
+                     state=d["state"], prev_level=d["prev_level"],
+                     changed=True, calm_rounds=d["calm_rounds"],
+                     pressure=d["pressure"], actions=d["actions"],
+                     reason=d["reason"], inputs=d["inputs"],
+                     input_digest=d["input_digest"])
+        return d

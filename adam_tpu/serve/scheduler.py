@@ -73,8 +73,11 @@ from .. import obs
 from ..checkpoint import atomic_write
 from ..resilience import faults
 from ..resilience.retry import (RETRY_SEED_ENV, FleetPolicy,
-                                resolve_fleet_policy)
+                                backoff_delay, resolve_fleet_policy)
 from . import jobspec
+from .admission import decide_admission
+from .overload import (AdmissionLimits, OverloadPolicy, OverloadTracker,
+                       resolve_admission_limits, resolve_overload_policy)
 
 #: fleet-dir layout (everything lives under ``SPOOL/fleet/``)
 FLEET_DIR = "fleet"
@@ -109,7 +112,8 @@ def _digest(inputs: dict) -> str:
 
 
 def decide_placement(*, queued: Sequence[dict], workers: Sequence[dict],
-                     depth: int) -> dict:
+                     depth: int, fair: bool = False,
+                     tenant_slots: int = 0) -> dict:
     """One scheduler round's placements — PURE.
 
     ``queued``: front-queue descriptors ``{"job_id", "tenant",
@@ -119,7 +123,17 @@ def decide_placement(*, queued: Sequence[dict], workers: Sequence[dict],
     order onto the least-loaded alive worker (ties → lowest id), at
     most ``depth`` jobs in flight per worker — jobs past every host's
     depth stay in the front queue (where stealing and later rounds can
-    still reorder them onto whoever drains first).  Returns::
+    still reorder them onto whoever drains first).  ``fair=True`` (the
+    fleet default) replaces the FIFO placement ORDER with the
+    deficit-round-robin tenant interleave
+    (serve/admission.``_drr_order``, quantum one job): a burst
+    tenant's backlog fills at most its round-robin share of the open
+    worker depth, so the steady tenant behind it still places this
+    round.  ``tenant_slots`` > 0 caps one tenant's placements per
+    round (the fleet's in-flight quota — over-slots jobs stay in the
+    front queue, they are not shed), in FIFO and DRR order alike.
+    Both keywords join the recorded inputs only when engaged, so
+    pre-fairness sidecars replay digest-identical.  Returns::
 
         {"place": [[job_id, worker], ...], "reason": str,
          "inputs": {...}, "input_digest": hex}
@@ -127,6 +141,8 @@ def decide_placement(*, queued: Sequence[dict], workers: Sequence[dict],
     Recorded in full by ``placement_selected``;
     tools/check_executor.py replays the decision offline.
     """
+    from .admission import _drr_order
+
     canon_q = sorted((dict(job_id=str(q["job_id"]),
                            tenant=str(q["tenant"]),
                            command=str(q["command"]), seq=int(q["seq"]))
@@ -136,17 +152,29 @@ def decide_placement(*, queued: Sequence[dict], workers: Sequence[dict],
                            alive=bool(w["alive"]))
                       for w in workers), key=lambda w: w["worker"])
     inputs = dict(queued=canon_q, workers=canon_w, depth=int(depth))
+    if fair:
+        inputs["fair"] = True
+    if tenant_slots:
+        inputs["tenant_slots"] = int(tenant_slots)
+    t_slots = inputs.get("tenant_slots", 0)
     load = {w["worker"]: w["inflight"] for w in canon_w if w["alive"]}
+    order = _drr_order(canon_q, len(canon_q), t_slots) \
+        if inputs.get("fair") else canon_q
     place: List[List] = []
-    for q in canon_q:
+    taken: Dict[str, int] = {}
+    for q in order:
         if not load:
             break
+        if t_slots and taken.get(q["tenant"], 0) >= t_slots:
+            continue            # over-slots: stays in the front queue
         w = min(load, key=lambda k: (load[k], k))
         if load[w] >= inputs["depth"]:
             break               # every alive worker is at depth
         place.append([q["job_id"], w])
+        taken[q["tenant"]] = taken.get(q["tenant"], 0) + 1
         load[w] += 1
-    reason = (f"fifo {len(place)}/{len(canon_q)} queued onto "
+    how = "drr" if inputs.get("fair") else "fifo"
+    reason = (f"{how} {len(place)}/{len(canon_q)} queued onto "
               f"{len(load)} worker(s) at depth {inputs['depth']}")
     return dict(place=place, reason=reason, inputs=inputs,
                 input_digest=_digest(inputs))
@@ -348,7 +376,16 @@ def worker_main(argv: Optional[List[str]] = None) -> int:
                 poll_s=float(cfg.get("poll_s", 0.05)),
                 io_procs=int(cfg.get("io_procs", 1)),
                 executor_opts=cfg.get("executor_opts") or {},
-                slo_report=False)
+                slo_report=False,
+                # the FRONT DOOR owns the overload plane: a worker
+                # re-resolving ADAM_TPU_SERVE_* from the inherited env
+                # would apply the caps a second time — typed-rejecting
+                # jobs the scheduler already admitted and placed.
+                # Workers keep only the fairness interleave (from the
+                # shared config), quotas and the ladder stay off
+                limits=AdmissionLimits(fair=bool(cfg.get("fair",
+                                                         True))),
+                overload=OverloadPolicy(backlog_hi=0))
             sched_pid = int(cfg.get("scheduler_pid") or 0)
             while not jobspec.stop_requested(wspool):
                 # short idle re-entries so the orphan check runs even
@@ -407,7 +444,9 @@ class FleetServeScheduler:
                  env: Optional[dict] = None,
                  executor_opts: Optional[dict] = None,
                  boot_grace_s: float = 60.0,
-                 drain_timeout_s: float = 60.0):
+                 drain_timeout_s: float = 60.0,
+                 limits: Optional[AdmissionLimits] = None,
+                 overload: Optional[OverloadPolicy] = None):
         self.spool = jobspec.ensure_spool(spool)
         self.fleet_dir = os.path.join(spool, FLEET_DIR)
         self.hosts = max(int(hosts), 1)
@@ -436,8 +475,22 @@ class FleetServeScheduler:
         #: drop, never relay as client-visible docs or count as served)
         self._retired_parents: set = set()
         self._row_counts: Dict[str, int] = {}
-        self._slo: Dict[str, Dict[str, List[float]]] = {}
+        self._slo: Dict[str, dict] = {}
         self._last_placement_digest: Optional[str] = None
+        self._last_admission_digest: Optional[str] = None
+        #: the overload plane at the FRONT DOOR (docs/ARCHITECTURE.md
+        #: §6m): quotas/deadlines/brownout shed jobs before placement
+        #: ever hands them to a warm worker; level >= 1 also stops
+        #: shard-splitting (cheaper rounds under pressure)
+        self.limits = limits if limits is not None \
+            else resolve_admission_limits()
+        self.overload = OverloadTracker(
+            overload if overload is not None
+            else resolve_overload_policy(
+                max_concurrent=self.worker_depth * self.hosts))
+        self._cursor = jobspec.QueueCursor(self.spool)
+        self._canon_cache: Dict[str, dict] = {}
+        self._poll_round = 0
         self._booted = False
 
     # -- boot ---------------------------------------------------------------
@@ -459,6 +512,7 @@ class FleetServeScheduler:
                          poll_s=self.poll_s, io_procs=self.io_procs,
                          executor_opts=self.executor_opts,
                          heartbeat_s=self.policy.heartbeat_s,
+                         fair=self.limits.fair,
                          scheduler_pid=os.getpid()), sort_keys=True))
         for w in range(self.hosts):
             st = _WorkerState(w)
@@ -523,7 +577,7 @@ class FleetServeScheduler:
                         n += 1
                     except OSError:
                         pass
-            for sub in (jobspec.DONE, jobspec.FAILED):
+            for sub in (jobspec.DONE, jobspec.FAILED, jobspec.REJECTED):
                 d = os.path.join(ws, sub)
                 for name in sorted(os.listdir(d)
                                    if os.path.isdir(d) else []):
@@ -627,27 +681,13 @@ class FleetServeScheduler:
     # -- placement ----------------------------------------------------------
 
     def _front_queue(self) -> List[Tuple[int, str, dict]]:
-        """Canonicalized front-queue snapshot; hand-tampered bad specs
-        fail themselves (the server loop's discipline), never the
-        scheduler."""
-        out = []
-        for seq, path, spec in jobspec.iter_queue(self.spool):
-            try:
-                canon = jobspec.canon_spec(spec)
-            except ValueError as e:
-                canon = {"job_id": os.path.basename(path)[9:-5],
-                         "tenant": "default",
-                         "command": str(spec.get("command")),
-                         "input": "", "output": None, "args": {},
-                         "submitted_at": None}
-                claimed = jobspec.claim_job(self.spool, path)
-                jobspec.write_result(
-                    self.spool, canon, ok=False, error=str(e),
-                    error_type="ValueError", running_path=claimed)
-                continue
-            canon["seq"] = seq
-            out.append((seq, path, canon))
-        return out
+        """Canonicalized front-queue snapshot — the shared
+        cursor-backed implementation (jobspec.snapshot_canon: parse +
+        canonicalization paid once per immutable queue file,
+        hand-tampered bad specs fail themselves, never the
+        scheduler)."""
+        return jobspec.snapshot_canon(self.spool, self._cursor,
+                                      self._canon_cache)
 
     def _input_rows(self, path: str) -> Optional[int]:
         """Row count for shard-eligibility (cached per input; the
@@ -719,13 +759,94 @@ class FleetServeScheduler:
         obs.registry().counter("fleet_jobs_sharded").inc()
         return True
 
+    def _shed_round(self, queued: List[Tuple[int, str, dict]]
+                    ) -> List[Tuple[int, str, dict]]:
+        """The front door's overload pass: run the SAME pure
+        ``decide_admission`` the single-host server runs — in
+        shed-only mode (every survivor "admits", placement decides who
+        actually runs where) — and retire the shed jobs with typed
+        docs.  Returns the surviving snapshot."""
+        if not (self.limits.backlog_cap or self.limits.tenant_quota
+                or self.overload.level >= 2
+                or any(c.get("deadline_s") is not None
+                       for _, _, c in queued)):
+            return queued
+        now = time.time()
+        desc = []
+        for seq, path, canon in queued:
+            m = _SUBJOB_RE.match(canon["job_id"])
+            if m and m.group(1) in self._shards:
+                # a live sharded parent's sub-job (requeued by a worker
+                # loss) is NOT new work — shedding it would stall the
+                # parent merge forever; the parent was already admitted
+                continue
+            d = {"job_id": canon["job_id"], "tenant": canon["tenant"],
+                 "command": canon["command"], "seq": seq}
+            if canon.get("priority") not in (None, "normal"):
+                d["priority"] = canon["priority"]
+            if canon.get("deadline_s") is not None:
+                d["deadline_s"] = canon["deadline_s"]
+                sub_at = canon.get("submitted_at")
+                d["wait_s"] = max(now - float(sub_at), 0.0) \
+                    if isinstance(sub_at, (int, float)) and \
+                    not isinstance(sub_at, bool) else 0.0
+            desc.append(d)
+        plan = decide_admission(
+            queued=desc, running=0, max_concurrent=len(desc),
+            pack=False, fair=self.limits.fair,
+            backlog_cap=self.limits.backlog_cap,
+            tenant_quota=self.limits.tenant_quota,
+            overload_level=self.overload.level)
+        if not plan.get("cancel") and not plan.get("reject"):
+            return queued
+        if plan["input_digest"] != self._last_admission_digest:
+            extra = {}
+            if plan.get("cancel"):
+                extra["cancel"] = plan["cancel"]
+            if plan.get("reject"):
+                extra["reject"] = plan["reject"]
+            obs.emit("admission_selected", admit=plan["admit"],
+                     pack_groups=plan["pack_groups"],
+                     reason=plan["reason"], inputs=plan["inputs"],
+                     input_digest=plan["input_digest"], **extra)
+            self._last_admission_digest = plan["input_digest"]
+        # ONE retirement implementation with the single-host loop
+        # (server.retire_*): doc shape, events, counters and SLO
+        # accounting can never skew between fleet and solo
+        from .server import retire_deadline, retire_rejected
+        by_id = {c["job_id"]: (path, c) for _, path, c in queued}
+        shed = set()
+        for c in plan.get("cancel") or ():
+            path, canon = by_id[c["job_id"]]
+            if retire_deadline(self.spool, self._slo, path, canon,
+                               c["wait_s"], c["deadline_s"]):
+                self.jobs_served += 1
+                shed.add(canon["job_id"])
+        for r in plan.get("reject") or ():
+            path, canon = by_id[r["job_id"]]
+            if retire_rejected(self.spool, self._slo, path, canon,
+                               r["code"], r["retry_after_s"]):
+                self.jobs_served += 1
+                shed.add(canon["job_id"])
+        return [(s, p, c) for s, p, c in queued
+                if c["job_id"] not in shed]
+
     def _place_round(self) -> int:
         queued = self._front_queue()
+        if self.overload.engaged:
+            self.overload.update(len(queued))
+        if not queued:
+            return 0
+        queued = self._shed_round(queued)
         if not queued:
             return 0
         alive = sum(1 for st in self.states.values()
                     if self._alive(st))
-        if alive and self.shard_rows > 0:
+        # brownout rung 1 stops shard-splitting: under pressure the
+        # fleet serves whole jobs (predictable rounds) instead of
+        # multiplying queue entries
+        if alive and self.shard_rows > 0 and \
+                self.overload.level < 1:
             remaining = []
             for seq, path, canon in queued:
                 if not self._maybe_shard(seq, path, canon, alive):
@@ -747,7 +868,9 @@ class FleetServeScheduler:
             queued=[dict(job_id=c["job_id"], tenant=c["tenant"],
                          command=c["command"], seq=c["seq"])
                     for _, _, c in queued],
-            workers=workers, depth=self.worker_depth)
+            workers=workers, depth=self.worker_depth,
+            fair=self.limits.fair,
+            tenant_slots=self.limits.tenant_slots)
         if not d["place"]:
             return 0
         # an unchanged queue/worker snapshot re-derives the identical
@@ -774,6 +897,9 @@ class FleetServeScheduler:
         from .server import slo_observe
         slo_observe(self._slo, doc.get("tenant") or "default",
                     doc.get("queue_s"), doc.get("service_s"))
+        # the ladder's queue-p99 signal reads the same relayed waits
+        # the SLO report does
+        self.overload.observe_wait(doc.get("queue_s"))
 
     def _relay_results(self) -> int:
         done = 0
@@ -785,7 +911,7 @@ class FleetServeScheduler:
     def _relay_worker(self, worker: int) -> int:
         ws = worker_spool(self.fleet_dir, worker)
         done = 0
-        for sub in (jobspec.DONE, jobspec.FAILED):
+        for sub in (jobspec.DONE, jobspec.FAILED, jobspec.REJECTED):
             d = os.path.join(ws, sub)
             for name in self._listdir(d):
                 if not name.endswith(".json"):
@@ -1195,7 +1321,13 @@ class FleetServeScheduler:
                 if idle_timeout_s is not None and \
                         time.monotonic() - idle_since >= idle_timeout_s:
                     break
-                time.sleep(self.poll_s)
+                # deterministic jitter, the serve loop's discipline: N
+                # schedulers sharing a filesystem must not poll in
+                # lockstep (seeded — replays identical)
+                self._poll_round += 1
+                time.sleep(backoff_delay(
+                    f"{self.spool}|sched-poll", 1, self.poll_s,
+                    self.poll_s, seed=self._poll_round))
         finally:
             self._drain()
             self.write_report()

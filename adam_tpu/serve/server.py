@@ -39,8 +39,11 @@ from ..checkpoint import atomic_write
 from ..errors import FormatError, malformed_count, reset_malformed
 from ..resilience import faults
 from ..resilience.faults import InjectedFault
+from ..resilience.retry import backoff_delay
 from . import jobspec
 from .admission import DEFAULT_PACK_SEGMENTS, decide_admission
+from .overload import (AdmissionLimits, OverloadPolicy, OverloadTracker,
+                       resolve_admission_limits, resolve_overload_policy)
 from .packed import SharedDispatchError, packed_flagstat
 
 #: the per-tenant SLO shutdown report file name (single-host serve
@@ -65,26 +68,92 @@ def slo_observe(slo: dict, tenant: str, queue_s, service_s) -> None:
     for key, v in (("queue_s", queue_s), ("service_s", service_s)):
         if isinstance(v, (int, float)) and not isinstance(v, bool) \
                 and v >= 0:
-            rec[key].append(float(v))
+            rec.setdefault(key, []).append(float(v))
             obs.registry().histogram(
                 f"serve_{key.replace('_s', '')}_seconds",
                 tenant=tenant).observe(float(v))
 
 
+#: the overload-outcome counters that join the per-tenant SLO report
+#: (docs/ARCHITECTURE.md §6m): deadline_hit = a deadlined job served in
+#: time, deadline_missed = cancelled queued past its deadline,
+#: rejected = shed by quota or brownout with a typed ``rejected/`` doc
+SLO_COUNT_KEYS = ("deadline_hit", "deadline_missed", "rejected")
+
+
+def slo_count(slo: dict, tenant: str, key: str, n: int = 1) -> None:
+    """Bump one per-tenant overload-outcome counter in the SLO
+    accumulator (``key`` ∈ :data:`SLO_COUNT_KEYS`)."""
+    rec = slo.setdefault(tenant, {"queue_s": [], "service_s": []})
+    rec[key] = rec.get(key, 0) + n
+
+
 def slo_summary(slo: dict) -> dict:
     """Per-tenant p50/p99 of queue-wait and service time — the gated
-    tail numbers, not a claim."""
+    tail numbers, not a claim — plus the overload-outcome counts
+    (deadline hits/misses, typed rejections) when any occurred."""
     out = {}
     for tenant in sorted(slo):
         rec = slo[tenant]
-        ten = {"jobs": max(len(rec["queue_s"]), len(rec["service_s"]))}
+        ten = {"jobs": max(len(rec.get("queue_s", ())),
+                           len(rec.get("service_s", ())))}
         for key in ("queue_s", "service_s"):
-            vs = rec[key]
+            vs = rec.get(key) or []
             if vs:
                 ten[key] = {"p50": round(_pctl(vs, 50), 6),
                             "p99": round(_pctl(vs, 99), 6)}
+        for key in SLO_COUNT_KEYS:
+            if rec.get(key):
+                ten[key] = int(rec[key])
         out[tenant] = ten
     return out
+
+
+def retire_deadline(spool: str, slo: dict, path: str, canon: dict,
+                    wait_s: float, deadline_s: float) -> bool:
+    """Retire one queued-past-deadline job with a typed
+    ``DeadlineExceeded`` failure doc (never dispatched — a result
+    nobody is waiting for must not occupy a warm worker).  One
+    implementation for the single-host loop AND the fleet front door:
+    the doc shape, event, counters and SLO accounting must never skew
+    between them."""
+    claimed = jobspec.claim_job(spool, path)
+    if claimed is None:
+        return False
+    obs.registry().counter("deadline_missed",
+                           tenant=canon["tenant"]).inc()
+    obs.emit("deadline_missed", job_id=canon["job_id"],
+             tenant=canon["tenant"], wait_s=round(wait_s, 3),
+             deadline_s=round(deadline_s, 3))
+    slo_count(slo, canon["tenant"], "deadline_missed")
+    jobspec.write_result(
+        spool, canon, ok=False,
+        error=(f"cancelled: queued {wait_s:.3f}s past its "
+               f"{deadline_s:.3f}s deadline"),
+        error_type="DeadlineExceeded", queue_s=wait_s,
+        running_path=claimed)
+    return True
+
+
+def retire_rejected(spool: str, slo: dict, path: str, canon: dict,
+                    code: str, retry_after_s: float) -> bool:
+    """Retire one over-quota/brownout-shed job with a typed, durable
+    ``rejected/<job>.json`` (never a silent drop) — the
+    :func:`retire_deadline` twin, shared for the same reason."""
+    claimed = jobspec.claim_job(spool, path)
+    if claimed is None:
+        return False
+    obs.registry().counter("admission_rejections",
+                           tenant=canon["tenant"], code=code).inc()
+    obs.emit("admission_rejected", job_id=canon["job_id"],
+             tenant=canon["tenant"], code=code,
+             retry_after_s=round(retry_after_s, 3))
+    slo_count(slo, canon["tenant"], "rejected")
+    jobspec.write_rejection(
+        spool, canon, code=code, retry_after_s=retry_after_s,
+        message=(f"admission rejected ({code}); retry after "
+                 f"{retry_after_s}s"), queue_path=claimed)
+    return True
 
 
 def write_slo_report(path: str, slo: dict, *, hosts: int,
@@ -119,7 +188,9 @@ class ServeServer:
                  pack_segments: int = DEFAULT_PACK_SEGMENTS,
                  poll_s: float = 0.05, io_procs: int = 1,
                  executor_opts: Optional[dict] = None,
-                 slo_report: bool = True):
+                 slo_report: bool = True,
+                 limits: Optional[AdmissionLimits] = None,
+                 overload: Optional[OverloadPolicy] = None):
         self.spool = jobspec.ensure_spool(spool)
         self.chunk_rows = int(chunk_rows)
         self.max_concurrent = max(int(max_concurrent), 1)
@@ -134,6 +205,22 @@ class ServeServer:
         #: the fleet-wide report, built from the relayed result docs
         self.slo: Dict[str, dict] = {}
         self.slo_report = bool(slo_report)
+        #: the overload plane (docs/ARCHITECTURE.md §6m): admission
+        #: quotas + DRR fairness (decide_admission's overload keywords)
+        #: and the brownout ladder (serve/overload.decide_overload)
+        self.limits = limits if limits is not None \
+            else resolve_admission_limits()
+        self.overload = OverloadTracker(
+            overload if overload is not None
+            else resolve_overload_policy(
+                max_concurrent=self.max_concurrent))
+        #: parse-once queue scanner: round cost stays flat as the
+        #: backlog deepens (jobspec.QueueCursor)
+        self._cursor = jobspec.QueueCursor(self.spool)
+        #: filename -> canonicalized spec (queue files are immutable,
+        #: so canonicalization — like parsing — is paid once per job)
+        self._canon_cache: Dict[str, dict] = {}
+        self._poll_round = 0
         self._booted = False
         #: the paged layout's cross-round page pool (packed_flagstat's
         #: pool_holder): ONE resident device allocation for the serve
@@ -191,57 +278,109 @@ class ServeServer:
                 if idle_timeout_s is not None and \
                         time.monotonic() - idle_since >= idle_timeout_s:
                     break
-                time.sleep(self.poll_s)
+                # deterministic jitter (the retry-backoff helper at
+                # exponent 0): many idle servers polling one shared
+                # filesystem must not stat it in lockstep, and a
+                # seeded delay stays replayable
+                self._poll_round += 1
+                time.sleep(backoff_delay(
+                    f"{self.spool}|idle-poll", 1, self.poll_s,
+                    self.poll_s, seed=self._poll_round))
         if self.slo_report and self.jobs_served:
             write_slo_report(
                 os.path.join(self.spool, SLO_REPORT_FILE), self.slo,
                 hosts=1, jobs=self.jobs_served)
         return self.jobs_served - served_at_entry
 
-    def _round(self, budget: Optional[int] = None) -> int:
-        """One admission round: snapshot the queue, take the pure
-        decision, claim and execute.  Returns jobs completed."""
+    def _snapshot_queue(self) -> tuple:
+        """Admission-ready queue snapshot: ``(descriptors, by_id)``
+        over the shared cursor-backed canonical snapshot
+        (jobspec.snapshot_canon — parse + canonicalization paid once
+        per immutable queue file, bad specs failed in place), with the
+        overload-era descriptor extras riding only-when-set so a
+        vanilla queue decides (and digests) exactly as before."""
         queued = []
         by_id: Dict[str, tuple] = {}
-        for seq, path, spec in jobspec.iter_queue(self.spool):
-            try:
-                canon = jobspec.canon_spec(spec)
-            except ValueError as e:
-                # a hand-written bad spec fails ITSELF, not the loop.
-                # The result doc keys by the FILENAME-derived id, never
-                # the file's own job_id field: a filename cannot carry
-                # a path separator, but a hand-written job_id like
-                # "../../x" could walk the result write out of the
-                # spool (and leave the failure doc unreadable besides)
-                canon = {"job_id": os.path.basename(path)[9:-5],
-                         "tenant": "default",
-                         "command": str(spec.get("command")),
-                         "input": "", "output": None, "args": {},
-                         "submitted_at": None}
-                claimed = jobspec.claim_job(self.spool, path)
-                jobspec.write_result(
-                    self.spool, canon, ok=False, error=str(e),
-                    error_type="ValueError", running_path=claimed)
-                continue
-            canon["seq"] = seq
-            queued.append({"job_id": canon["job_id"],
-                           "tenant": canon["tenant"],
-                           "command": canon["command"], "seq": seq})
+        now = time.time()
+        for seq, path, canon in jobspec.snapshot_canon(
+                self.spool, self._cursor, self._canon_cache):
+            desc = {"job_id": canon["job_id"],
+                    "tenant": canon["tenant"],
+                    "command": canon["command"], "seq": seq}
+            if canon.get("priority") not in (None, "normal"):
+                desc["priority"] = canon["priority"]
+            if canon.get("deadline_s") is not None:
+                desc["deadline_s"] = canon["deadline_s"]
+                sub_at = canon.get("submitted_at")
+                desc["wait_s"] = max(now - float(sub_at), 0.0) \
+                    if isinstance(sub_at, (int, float)) and \
+                    not isinstance(sub_at, bool) else 0.0
+            queued.append(desc)
             by_id[canon["job_id"]] = (path, canon)
+        return queued, by_id
+
+    def _cancel_deadline(self, path: str, canon: dict, wait_s: float,
+                         deadline_s: float) -> bool:
+        if retire_deadline(self.spool, self.slo, path, canon, wait_s,
+                           deadline_s):
+            self.jobs_served += 1
+            return True
+        return False
+
+    def _reject(self, path: str, canon: dict, code: str,
+                retry_after_s: float) -> bool:
+        if retire_rejected(self.spool, self.slo, path, canon, code,
+                           retry_after_s):
+            self.jobs_served += 1
+            return True
+        return False
+
+    def _round(self, budget: Optional[int] = None) -> int:
+        """One admission round: snapshot the queue, walk the brownout
+        ladder, take the pure admission decision (quotas, deadlines,
+        tenant fairness), claim and execute.  Returns jobs completed —
+        typed rejections and deadline cancellations included (each
+        leaves a durable doc a client is waiting on)."""
+        queued, by_id = self._snapshot_queue()
+        if self.overload.engaged:
+            self.overload.update(len(queued))
         if not queued:
             return 0
         max_c = self.max_concurrent if budget is None \
             else min(self.max_concurrent, max(budget, 0))
+        level = self.overload.level
         plan = decide_admission(
             queued=queued, running=0, max_concurrent=max_c,
-            pack=self.pack, pack_segments=self.pack_segments)
-        if not plan["admit"]:
+            pack=self.pack and level < 1,
+            pack_segments=self.pack_segments,
+            fair=self.limits.fair, backlog_cap=self.limits.backlog_cap,
+            tenant_quota=self.limits.tenant_quota,
+            tenant_slots=self.limits.tenant_slots,
+            overload_level=level)
+        done = 0
+        if not plan["admit"] and not plan.get("cancel") \
+                and not plan.get("reject"):
             return 0
         obs.registry().counter("serve_rounds").inc()
+        extra = {}
+        if plan.get("cancel"):
+            extra["cancel"] = plan["cancel"]
+        if plan.get("reject"):
+            extra["reject"] = plan["reject"]
         obs.emit("admission_selected", admit=plan["admit"],
                  pack_groups=plan["pack_groups"], reason=plan["reason"],
                  inputs=plan["inputs"],
-                 input_digest=plan["input_digest"])
+                 input_digest=plan["input_digest"], **extra)
+        for c in plan.get("cancel") or ():
+            path, canon = by_id[c["job_id"]]
+            if self._cancel_deadline(path, canon, c["wait_s"],
+                                     c["deadline_s"]):
+                done += 1
+        for r in plan.get("reject") or ():
+            path, canon = by_id[r["job_id"]]
+            if self._reject(path, canon, r["code"],
+                            r["retry_after_s"]):
+                done += 1
         # claim everything admitted up front (a submitter watching the
         # queue sees admission as one atomic batch)
         claimed: Dict[str, tuple] = {}
@@ -250,7 +389,6 @@ class ServeServer:
             running = jobspec.claim_job(self.spool, path)
             if running is not None:
                 claimed[job_id] = (running, canon)
-        done = 0
         packed_ids = {j for g in plan["pack_groups"] for j in g}
         for group in plan["pack_groups"]:
             members = [(claimed[j][0], claimed[j][1])
@@ -348,6 +486,11 @@ class ServeServer:
             "serve_jobs", tenant=spec["tenant"],
             status=fields["status"]).inc()
         slo_observe(self.slo, spec["tenant"], queue_s, seconds)
+        # the ladder's queue-p99 signal reads the same waits the SLO
+        # report does; a served deadlined job is a deadline HIT
+        self.overload.observe_wait(queue_s)
+        if ok and spec.get("deadline_s") is not None:
+            slo_count(self.slo, spec["tenant"], "deadline_hit")
         res = dict(result or {})
         if dropped:
             res["malformed_dropped"] = int(dropped)

@@ -1654,6 +1654,176 @@ def _stage_fleet_serve(kind: str, is_tpu: bool):
     _emit("fleet_serve", out)
 
 
+def _stage_overload(kind: str, is_tpu: bool):
+    """Overload protection (ISSUE 14): K flagstat jobs offered in one
+    burst at 2x the accepted backlog capacity, served by (a) a plain
+    warm server with the overload plane disabled — every job queues,
+    the tail grows with the backlog — and (b) the same server with the
+    brownout ladder + admission caps armed, which sheds the excess
+    with typed ``rejected/`` docs carrying ``retry_after_s`` and keeps
+    the accepted jobs' queue waits bounded.
+
+    Gated numbers (tools/bench_gate.py gate 8): ``overload_identical``
+    (every accepted report byte-identical to the solo oracle) and
+    ``overload_warm_recompiles`` == 0 enforced UNCONDITIONALLY, plus
+    ``overload_max_level`` >= 1 (the ladder must actually engage) and
+    ``overload_rejects_typed`` (every shed job left a typed doc with a
+    retry hint — never a silent drop).  The throughput halves —
+    ``overload_goodput_ratio`` >= 1.0 (accepted-jobs-per-second must
+    not regress vs the unprotected server) and
+    ``overload_queue_p99_ratio`` <= 1.0 (the accepted tail must not be
+    worse than the unprotected tail) — arm only when the box's own
+    ``host_parallel_capacity`` probe saw real parallelism, the gate-4/6
+    discipline.  Process-level by design — ``is_tpu`` only stamps the
+    platform."""
+    import shutil
+    import tempfile
+
+    import numpy as np
+    import pyarrow as pa
+
+    from adam_tpu.io.parquet import DatasetWriter
+    from adam_tpu.ops.flagstat import format_report
+    from adam_tpu.parallel.pipeline import streaming_flagstat
+    from adam_tpu.serve import jobspec
+    # the SAME nearest-rank percentile the server's SLO report uses —
+    # the gate compares bench-side p99s against server-side tails, so
+    # the formula must be shared, not copied
+    from adam_tpu.serve.server import _pctl
+
+    root = os.path.dirname(os.path.abspath(__file__))
+    n = int(os.environ.get("ADAM_TPU_BENCH_OVERLOAD_READS", 1_500_000))
+    cap = max(int(os.environ.get("ADAM_TPU_BENCH_OVERLOAD_CAP", 4)), 2)
+    k = 2 * cap                     # offered load: 2x accepted capacity
+    chunk = 1 << 19
+    rng = np.random.RandomState(31)
+    tmp = tempfile.mkdtemp(prefix="bench_overload_")
+    out: dict = {"platform": kind, "overload_n_reads": n,
+                 "overload_offered_jobs": k,
+                 "overload_backlog_cap": cap,
+                 "overload_offered_ratio": round(k / cap, 3),
+                 "cpu_count": os.cpu_count(),
+                 "host_parallel_capacity": _parallel_capacity()}
+    env = dict(os.environ)
+    env["PYTHONPATH"] = root + os.pathsep + env.get("PYTHONPATH", "")
+    try:
+        pq_dir = os.path.join(tmp, "reads")
+        part = 1 << 18
+        with DatasetWriter(pq_dir, part_rows=part) as w:
+            for lo in range(0, n, part):
+                m = min(part, n - lo)
+                w.write(pa.table({
+                    "flags": pa.array(rng.randint(
+                        0, 1 << 11, size=m).astype(np.uint32),
+                        pa.uint32()),
+                    "mapq": pa.array(rng.randint(0, 61, size=m),
+                                     pa.int32()),
+                    "referenceId": pa.array(rng.randint(0, 24, size=m),
+                                            pa.int32()),
+                    "mateReferenceId": pa.array(
+                        rng.randint(0, 24, size=m), pa.int32()),
+                }))
+        solo = format_report(*streaming_flagstat(pq_dir,
+                                                 chunk_rows=chunk))
+        identical = True
+        rejects_typed = True
+        recompiles = 0
+        max_level = 0
+        # -no_pack on BOTH legs: the recompile pin wants one kernel
+        # path per leg, and the ladder flipping packing mid-stream
+        # would otherwise charge the solo kernel's first compile to a
+        # warm job (the ladder's pack action is pinned functionally in
+        # tests/test_serve.py instead)
+        for leg, extra in (("baseline", ["-backlog_hi", "0",
+                                         "-no_fair"]),
+                           ("armed", ["-backlog_cap", str(cap),
+                                      "-backlog_hi", "2"])):
+            spool = os.path.join(tmp, f"spool_{leg}")
+            sidecar = os.path.join(tmp, f"{leg}.metrics.jsonl")
+            # the 2x-capacity burst is pre-loaded so round 1 sees the
+            # WHOLE offered backlog (deterministic shed count), then
+            # the clock runs submit->last-result; both legs pay the
+            # same warm boot inside their wall, so the gated numbers
+            # are ratios
+            ids = [jobspec.submit_job(spool, {
+                "job_id": f"{leg}{i}", "tenant": f"t{i % 4}",
+                "command": "flagstat", "input": pq_dir, "args": {}})
+                for i in range(k)]
+            server = subprocess.Popen(
+                [sys.executable, "-m", "adam_tpu", "serve", spool,
+                 "-max_jobs", str(k), "-idle_timeout", "240",
+                 "-poll_s", "0.01", "-chunk_rows", str(chunk),
+                 "-no_pack", "-metrics", sidecar] + extra,
+                cwd=root, env=env, stdout=subprocess.DEVNULL,
+                stderr=subprocess.DEVNULL)
+            # the wall starts when the server is WARM (serving marker
+            # written at boot end): goodput is a steady-state serving
+            # rate, and the armed leg must not be billed the shared
+            # boot cost over fewer accepted jobs
+            marker = os.path.join(spool, jobspec.SERVING_MARKER)
+            deadline = time.monotonic() + 120
+            while not os.path.exists(marker):
+                if time.monotonic() > deadline or \
+                        server.poll() is not None:
+                    raise RuntimeError(
+                        f"{leg} serve process never became ready")
+                time.sleep(0.01)
+            t0 = time.perf_counter()
+            docs = {j: jobspec.wait_result(spool, j, timeout_s=240.0,
+                                           poll_s=0.005)
+                    for j in ids}
+            wall = round(time.perf_counter() - t0, 3)
+            server.wait(timeout=60)
+            accepted = {j: d for j, d in docs.items() if d.get("ok")}
+            rejected = {j: d for j, d in docs.items()
+                        if d.get("rejected")}
+            for d in accepted.values():
+                rep = (d.get("result") or {}).get("report")
+                identical = identical and rep == solo
+            for d in rejected.values():
+                rejects_typed = rejects_typed and \
+                    d.get("error_type") == "AdmissionRejected" and \
+                    isinstance(d.get("retry_after_s"), (int, float))
+            waits = [d["queue_s"] for d in accepted.values()
+                     if isinstance(d.get("queue_s"), (int, float))]
+            out[f"overload_{leg}_wall_s"] = wall
+            out[f"overload_{leg}_accepted"] = len(accepted)
+            out[f"overload_{leg}_rejected"] = len(rejected)
+            out[f"overload_{leg}_goodput_jps"] = round(
+                len(accepted) / max(wall, 1e-9), 4)
+            out[f"overload_{leg}_queue_p99_s"] = round(
+                _pctl(waits, 99), 4) if waits else None
+            compiles = []
+            with open(sidecar) as f:
+                for ln in f:
+                    try:
+                        d = json.loads(ln)
+                    except ValueError:
+                        continue
+                    if d.get("event") == "tenant_job":
+                        compiles.append(int(d.get("compiles", 0)))
+                    elif d.get("event") == "overload_state":
+                        max_level = max(max_level,
+                                        int(d.get("level", 0)))
+            recompiles += sum(compiles[1:])
+        out["overload_identical"] = identical
+        out["overload_rejects_typed"] = rejects_typed
+        out["overload_warm_recompiles"] = recompiles
+        out["overload_max_level"] = max_level
+        out["overload_goodput_ratio"] = round(
+            out["overload_armed_goodput_jps"] /
+            max(out["overload_baseline_goodput_jps"], 1e-9), 3)
+        base_p99 = out["overload_baseline_queue_p99_s"]
+        armed_p99 = out["overload_armed_queue_p99_s"]
+        out["overload_queue_p99_ratio"] = round(
+            armed_p99 / max(base_p99, 1e-9), 3) \
+            if isinstance(base_p99, (int, float)) and \
+            isinstance(armed_p99, (int, float)) else None
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+    _emit("overload", out)
+
+
 def _worker(stages: list[str]) -> None:
     if os.environ.get("JAX_PLATFORMS") == "cpu":
         from adam_tpu.platform import force_cpu
@@ -1923,7 +2093,11 @@ _STAGE_BODIES = {"flagstat": _stage_flagstat, "transform": _stage_transform,
                  # resident paged buffers (ISSUE 13): process-internal,
                  # not in the TPU capture order — run via --worker/
                  # --only paged_race
-                 "paged_race": _stage_paged_race}
+                 "paged_race": _stage_paged_race,
+                 # overload protection (ISSUE 14): process-level, not
+                 # in the TPU capture order — run via --worker/--only
+                 # overload
+                 "overload": _stage_overload}
 
 
 def _worker_stages(stages: list[str]) -> None:

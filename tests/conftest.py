@@ -34,14 +34,20 @@ def _zeroed_telemetry():
     from adam_tpu.errors import reset_malformed
     from adam_tpu.instrument import report, set_sync_timing
     from adam_tpu.resilience import faults
+    from adam_tpu.resilience.retry import reset_breakers
 
     report().reset()
     obs.reset_all()
     set_sync_timing(False)
     faults.clear_plan()
     reset_malformed()
+    # circuit breakers are process-global by design (a storm belongs to
+    # the backend, not one executor) — tests must not inherit a breaker
+    # another test's injected storm tripped
+    reset_breakers()
     yield
     faults.clear_plan()
+    reset_breakers()
 
 
 def iter_mpileup_tokens(bases: str):

@@ -570,6 +570,155 @@ def test_worker_scoping_digest_compat():
     assert len({d["input_digest"] for d in (d_none, d_0, d_1)}) == 3
 
 
+def test_decide_placement_drr_fairness_and_digest_compat():
+    """``fair=True`` interleaves tenants DRR-style in the placement
+    order (a burst tenant cannot fill every open slot); the keyword
+    joins the recorded inputs only when engaged, so pre-fairness
+    sidecars replay digest-identical."""
+    queued = [dict(job_id=f"b{i}", tenant="burst", command="flagstat",
+                   seq=i) for i in range(1, 5)]
+    queued.append(dict(job_id="s1", tenant="steady",
+                       command="flagstat", seq=5))
+    workers = [dict(worker=0, inflight=0, alive=True),
+               dict(worker=1, inflight=0, alive=True)]
+    fifo = decide_placement(queued=queued, workers=workers, depth=2)
+    fair = decide_placement(queued=queued, workers=workers, depth=2,
+                            fair=True)
+    # 4 open slots: FIFO fills them all with the burst; DRR gives the
+    # steady tenant its round-robin share
+    assert [p[0] for p in fifo["place"]] == ["b1", "b2", "b3", "b4"]
+    assert [p[0] for p in fair["place"]] == ["b1", "s1", "b2", "b3"]
+    assert "fair" not in fifo["inputs"]
+    assert fair["inputs"]["fair"] is True
+    assert fifo["input_digest"] != fair["input_digest"]
+    # the in-flight quota binds at placement, fair or not: burst takes
+    # at most tenant_slots of the open depth, the rest stays queued
+    capped = decide_placement(queued=queued, workers=workers, depth=2,
+                              tenant_slots=1)
+    assert [p[0] for p in capped["place"]] == ["b1", "s1"]
+    assert capped["inputs"]["tenant_slots"] == 1
+    r = decide_placement(**capped["inputs"])
+    assert (r["place"], r["input_digest"]) == \
+        (capped["place"], capped["input_digest"])
+    # both replay exactly
+    for d in (fifo, fair):
+        r = decide_placement(**d["inputs"])
+        assert (r["place"], r["reason"], r["input_digest"]) == \
+            (d["place"], d["reason"], d["input_digest"])
+
+
+def test_fleet_front_door_shed_fairness_and_recovery(tmp_path):
+    """The fleet overload matrix: a burst tenant past the front-door
+    backlog cap sheds typed (rejected/ docs with retry_after_s) while
+    the steady tenant's job serves byte-identical; a crashed
+    scheduler's replacement recovers the rejected docs AND the
+    unserved queue without re-running or clobbering either."""
+    from adam_tpu.serve.overload import AdmissionLimits, OverloadPolicy
+
+    inp = _synth_reads(tmp_path / "r.reads", 8_000, 41)
+    solo = _solo_report(inp)
+    spool = str(tmp_path / "spool")
+    jobs = [(f"burst{i}", "burst", inp) for i in range(4)]
+    jobs.append(("steady0", "steady", inp))
+    _submit(spool, jobs)
+    sidecar = str(tmp_path / "m.jsonl")
+    with obs.metrics_run(sidecar, argv=["t"], config={}):
+        sched = FleetServeScheduler(
+            spool, hosts=1, chunk_rows=CHUNK, poll_s=0.02,
+            limits=AdmissionLimits(fair=True, tenant_quota=2),
+            overload=OverloadPolicy(backlog_hi=100))
+        # 5 offered, burst quota 2 -> 2 typed rejections + 3 served
+        assert sched.run(max_jobs=5, idle_timeout_s=60.0) == 5
+    served, rejected = [], []
+    for job_id, _, _ in jobs:
+        doc = jobspec.read_result(spool, job_id)
+        assert doc is not None, job_id
+        (rejected if doc.get("rejected") else served).append(job_id)
+    assert len(rejected) == 2
+    assert all(j.startswith("burst") for j in rejected)
+    assert "steady0" in served
+    for j in served:
+        doc = jobspec.read_result(spool, j)
+        assert doc["ok"] and doc["result"]["report"] == solo, j
+    for j in rejected:
+        doc = jobspec.read_result(spool, j)
+        assert doc["error_type"] == "AdmissionRejected"
+        assert doc["code"] == "tenant_quota"
+        assert doc["retry_after_s"] >= 1.0
+    events = _events(sidecar)
+    assert any(e["event"] == "admission_rejected" for e in events)
+    _run_validators(sidecar)
+
+    # crashed-scheduler recovery: a fresh fleet on the same spool must
+    # keep the typed docs (no re-run, no clobber) and serve new work
+    _submit(spool, [("after", "steady", inp)])
+    sched2 = FleetServeScheduler(spool, hosts=1, chunk_rows=CHUNK,
+                                 poll_s=0.02)
+    assert sched2.run(max_jobs=1, idle_timeout_s=60.0) == 1
+    assert jobspec.read_result(spool, "after")["ok"]
+    for j in rejected:
+        assert jobspec.read_result(spool, j)["rejected"] is True
+
+
+def test_fleet_workers_never_reapply_front_door_caps(tmp_path,
+                                                     monkeypatch):
+    """ADAM_TPU_SERVE_* envs configure the FRONT DOOR only: a worker
+    inheriting them must not run its own quota/brownout pass against
+    jobs the scheduler already admitted and placed (a second
+    application would typed-reject placed work)."""
+    monkeypatch.setenv("ADAM_TPU_SERVE_BACKLOG_CAP", "1")
+    monkeypatch.setenv("ADAM_TPU_SERVE_BACKLOG_HI", "1")
+    inp = _synth_reads(tmp_path / "r.reads", 6_000, 43)
+    solo = _solo_report(inp)
+    spool = str(tmp_path / "spool")
+    jobs = [(f"j{i}", "t", inp) for i in range(3)]
+    _submit(spool, jobs)
+    from adam_tpu.serve.overload import AdmissionLimits, OverloadPolicy
+    sched = FleetServeScheduler(
+        spool, hosts=1, chunk_rows=CHUNK, poll_s=0.02,
+        worker_depth=3,
+        # front door explicitly uncapped: every job places; only a
+        # worker wrongly re-resolving the envs could reject one
+        limits=AdmissionLimits(fair=True),
+        overload=OverloadPolicy(backlog_hi=0))
+    assert sched.run(max_jobs=3, idle_timeout_s=120.0) == 3
+    for job_id, _, _ in jobs:
+        doc = jobspec.read_result(spool, job_id)
+        assert doc["ok"] is True, (job_id, doc)
+        assert doc["result"]["report"] == solo
+
+
+def test_fleet_brownout_stops_shard_splitting(tmp_path, monkeypatch):
+    """Brownout rung 1 at the fleet front door: with the ladder
+    engaged past the watermark, big jobs stop splitting into shard
+    sub-jobs (cheaper rounds) and still serve byte-identical."""
+    from adam_tpu.serve.overload import OverloadPolicy
+
+    inp = _synth_reads(tmp_path / "r.reads", 12_000, 42)
+    solo = _solo_report(inp)
+    spool = str(tmp_path / "spool")
+    jobs = [(f"j{i}", "t", inp) for i in range(3)]
+    _submit(spool, jobs)
+    sidecar = str(tmp_path / "m.jsonl")
+    with obs.metrics_run(sidecar, argv=["t"], config={}):
+        sched = FleetServeScheduler(
+            spool, hosts=2, chunk_rows=CHUNK, poll_s=0.02,
+            shard_rows=1_000,       # every job would normally split
+            overload=OverloadPolicy(backlog_hi=1, cool_rounds=50))
+        assert sched.run(max_jobs=3, idle_timeout_s=120.0) == 3
+    events = _events(sidecar)
+    assert any(e["event"] == "overload_state" and e["level"] >= 1
+               for e in events)
+    # no shard plan was taken while shedding
+    assert not any(e["event"] == "shard_plan_selected"
+                   for e in events)
+    for job_id, _, _ in jobs:
+        doc = jobspec.read_result(spool, job_id)
+        assert doc["ok"] and doc["result"]["report"] == solo
+        assert "sharded" not in (doc.get("result") or {})
+    _run_validators(sidecar)
+
+
 def test_committed_fleet_serve_artifact_gates():
     """The committed BENCH_FLEET_SERVE.json must keep the gate-6
     numbers: identity + zero recompiles per worker unconditionally,

@@ -632,6 +632,114 @@ class TestPagedPlan:
         assert "page_rows" not in p["inputs"]
         assert "page_rows" not in p
 
+    def test_paged_evidence_arms_residency(self):
+        """ISSUE 14 satellite (ROADMAP item-2 headroom): raced
+        paged_race evidence arms ``layout=paged`` without an explicit
+        pin — when the h2d reduction clears the gate-7 floor and the
+        wall did not regress; rates join the recorded inputs
+        only-when-present so pre-evidence sidecars replay."""
+        from adam_tpu.parallel.executor import decide_plan
+
+        base = dict(pass_name="flagstat", chunk_rows=100_000,
+                    mesh_size=1, on_tpu=False, paged_capable=True)
+        good = {"h2d_reduction": 4.0, "unpaged_wall_s": 0.6,
+                "paged_wall_s": 0.57}
+        p = decide_plan(**base, paged_rates=good)
+        assert p["layout"] == "paged"
+        assert "paged-evidence h2d 4.0x" in p["reason"]
+        assert p["inputs"]["paged_rates"]["h2d_reduction"] == 4.0
+        assert decide_plan(**p["inputs"])["input_digest"] == \
+            p["input_digest"]
+        # a wall regression disqualifies the evidence (a transfer win
+        # that costs wall is not a win)
+        slow = dict(good, paged_wall_s=0.9)
+        assert decide_plan(**base, paged_rates=slow)["layout"] == \
+            "padded"
+        # an under-floor reduction disqualifies
+        weak = dict(good, h2d_reduction=1.5)
+        assert decide_plan(**base, paged_rates=weak)["layout"] == \
+            "padded"
+        # explicit pins always outrank evidence
+        pinned = decide_plan(**base, layout="padded",
+                             paged_rates=good)
+        assert pinned["layout"] == "padded"
+        # evidence-armed paged outranks evidence-armed ragged
+        # (residency IS the ragged addressing scheme plus the pool)
+        both = decide_plan(**base, ragged_capable=True,
+                           ragged_rates={"padded": 100.0,
+                                         "ragged": 300.0},
+                           paged_rates=good)
+        assert both["layout"] == "paged"
+        # no rates recorded when none supplied (digest compat)
+        bare = decide_plan(**base)
+        assert "paged_rates" not in bare["inputs"]
+        assert bare["layout"] == "padded"
+
+    def test_ledger_paged_rates_roundtrip(self, tmp_path, monkeypatch):
+        """ledger_paged_rates reads the serve-leg record back
+        platform-matched — and refuses cross-platform evidence or a
+        record whose identity bit is not clean."""
+        from adam_tpu.evidence.ledger import Ledger
+        from adam_tpu.parallel.executor import ledger_paged_rates
+
+        path = str(tmp_path / "EVIDENCE_LEDGER.json")
+        monkeypatch.setenv("ADAM_TPU_EVIDENCE_LEDGER", path)
+        led = Ledger(path)
+        led.record_stage("paged_race",
+                         {"paged_h2d_reduction": 4.0,
+                          "unpaged_serve_wall_s": 0.6,
+                          "paged_serve_wall_s": 0.57,
+                          "paged_identical": True},
+                         platform="cpu", window_id="w1")
+        led.save()
+        assert ledger_paged_rates(platform="cpu") == \
+            {"h2d_reduction": 4.0, "unpaged_wall_s": 0.6,
+             "paged_wall_s": 0.57}
+        # evidence captured on another platform never steers this one
+        assert ledger_paged_rates(platform="tpu") is None
+        # a dirty identity bit disqualifies the whole record (fresh
+        # ledger: the keep-best merge would never let it displace a
+        # clean one)
+        path2 = str(tmp_path / "LEDGER2.json")
+        monkeypatch.setenv("ADAM_TPU_EVIDENCE_LEDGER", path2)
+        led2 = Ledger(path2)
+        led2.record_stage("paged_race",
+                          {"paged_h2d_reduction": 4.0,
+                           "unpaged_serve_wall_s": 0.6,
+                           "paged_serve_wall_s": 0.57,
+                           "paged_identical": False},
+                          platform="cpu", window_id="w2")
+        led2.save()
+        assert ledger_paged_rates(platform="cpu") is None
+
+    def test_evidence_armed_paging_end_to_end(self, tmp_path,
+                                              monkeypatch):
+        """The armed layout flows through a real begin_pass: with a
+        platform-matched clean record in the ledger and NO pin, a
+        paged-capable pass runs paged."""
+        from adam_tpu.evidence.ledger import Ledger
+        from adam_tpu.parallel.executor import StreamExecutor
+
+        path = str(tmp_path / "EVIDENCE_LEDGER.json")
+        monkeypatch.setenv("ADAM_TPU_EVIDENCE_LEDGER", path)
+        led = Ledger(path)
+        led.record_stage("paged_race",
+                         {"paged_h2d_reduction": 4.0,
+                          "unpaged_serve_wall_s": 0.6,
+                          "paged_serve_wall_s": 0.57,
+                          "paged_identical": True},
+                         platform="cpu", window_id="w1")
+        led.save()
+        ex = StreamExecutor(1, 1 << 16, on_tpu=False)
+        pex = ex.begin_pass("flagstat", paged_capable=True)
+        assert pex.layout == "paged"
+        ex.finish()
+        # an explicit padded pin still wins over the evidence
+        ex2 = StreamExecutor(1, 1 << 16, on_tpu=False, ragged=False)
+        pex2 = ex2.begin_pass("flagstat", paged_capable=True)
+        assert pex2.layout == "padded"
+        ex2.finish()
+
     def test_env_pin_and_rank_over_ragged(self, monkeypatch):
         """ADAM_TPU_PAGED=1 pins the paged layout (outranking a ragged
         pin); =0 forces it off."""

@@ -488,6 +488,559 @@ def test_startup_seconds_in_cli_sidecar(tmp_path, resources, capsys):
     assert mod.validate(sidecar) == []
 
 
+# ---------------------------------------------------------------------------
+# overload plane: quotas, fairness, deadlines, brownout (ISSUE 14)
+# ---------------------------------------------------------------------------
+
+def test_decide_admission_drr_fairness_and_replay():
+    """Deficit-round-robin: a burst tenant's backlog no longer starves
+    the steady tenant queued behind it; tenant_slots caps one tenant's
+    take per round; the decision replays bit-for-bit."""
+    burst = [_q(f"b{i}", "burst", "flagstat", i) for i in range(1, 7)]
+    steady = [_q("s1", "steady", "flagstat", 7)]
+    plan = decide_admission(queued=burst + steady, running=0,
+                            max_concurrent=4, fair=True)
+    # round-robin interleave: steady's first job rides in slot 2
+    assert plan["admit"] == ["b1", "s1", "b2", "b3"]
+    assert "drr" in plan["reason"]
+    # per-round tenant cap (the in-flight quota): burst takes at most 2
+    plan2 = decide_admission(queued=burst + steady, running=0,
+                             max_concurrent=4, fair=True,
+                             tenant_slots=2)
+    assert plan2["admit"] == ["b1", "s1", "b2"]
+    # tenant_slots binds in FIFO order too — a quota the operator set
+    # must never silently depend on the fairness flag
+    plan_fifo = decide_admission(queued=burst + steady, running=0,
+                                 max_concurrent=4, tenant_slots=2)
+    assert plan_fifo["admit"] == ["b1", "b2", "s1"]
+    # replay reproduces the decision exactly
+    r = decide_admission(**plan["inputs"])
+    assert (r["admit"], r["input_digest"]) == \
+        (plan["admit"], plan["input_digest"])
+    # fair=False stays bit-for-bit the pre-overload FIFO decider: no
+    # new keys in inputs, identical digest either way it is spelled
+    old = decide_admission(queued=burst + steady, running=0,
+                           max_concurrent=4)
+    assert old["admit"] == ["b1", "b2", "b3", "b4"]
+    assert not set(old["inputs"]) - {"queued", "running",
+                                     "max_concurrent", "pack",
+                                     "pack_segments"}
+
+
+def test_decide_admission_quotas_deadlines_brownout():
+    """The shed ladder: deadline cancellation, per-tenant in-queue
+    quota, backlog cap, brownout rungs — every shed typed, every
+    retry_after_s pure, the decision replayable."""
+    q = [_q(f"j{i}", "t", "flagstat", i) for i in range(1, 6)]
+    q[0]["deadline_s"] = 1.0
+    q[0]["wait_s"] = 5.0
+    q[4]["priority"] = "low"
+    plan = decide_admission(queued=q, running=0, max_concurrent=8,
+                            tenant_quota=3, overload_level=2,
+                            fair=True)
+    assert [c["job_id"] for c in plan["cancel"]] == ["j1"]
+    assert {(r["job_id"], r["code"]) for r in plan["reject"]} == \
+        {("j5", "brownout_low")}
+    assert plan["admit"] == ["j2", "j3", "j4"]
+    # backlog cap rejects the deepest entries, with a bounded hint
+    plan2 = decide_admission(queued=q[1:4], running=0,
+                             max_concurrent=8, backlog_cap=1)
+    assert [r["code"] for r in plan2["reject"]] == ["over_backlog"] * 2
+    assert all(1.0 <= r["retry_after_s"] <= 30.0
+               for r in plan2["reject"])
+    # under fairness, backlog_cap retains the DRR share per tenant —
+    # a burst tenant's backlog must not convert the steady tenant's
+    # new jobs into 100% typed rejections
+    mixed = [_q(f"b{i}", "burst", "flagstat", i) for i in range(1, 6)]
+    mixed.append(_q("s1", "steady", "flagstat", 6))
+    fair_cap = decide_admission(queued=mixed, running=0,
+                                max_concurrent=8, fair=True,
+                                backlog_cap=2)
+    assert fair_cap["admit"] == ["b1", "s1"]
+    assert all(r["job_id"].startswith("b")
+               for r in fair_cap["reject"])
+    # brownout rung 3 rejects everything still queued
+    plan3 = decide_admission(queued=q[1:4], running=0,
+                             max_concurrent=8, overload_level=3)
+    assert plan3["admit"] == [] and len(plan3["reject"]) == 3
+    assert {r["code"] for r in plan3["reject"]} == {"brownout_all"}
+    for p in (plan, plan2, plan3):
+        r = decide_admission(**p["inputs"])
+        assert (r.get("reject"), r.get("cancel"), r["input_digest"]) \
+            == (p.get("reject"), p.get("cancel"), p["input_digest"])
+
+
+def test_decide_overload_ladder_walk_and_replay():
+    """The brownout ladder walks up one rung per decision under
+    pressure, holds with hysteresis, and steps down only after
+    cool_rounds calm decisions — pure and replayable."""
+    from adam_tpu.serve.overload import decide_overload
+
+    d = decide_overload(level=0, backlog=40, backlog_hi=10)
+    assert (d["level"], d["state"], d["changed"]) == \
+        (1, "shed_batch", True)
+    assert d["actions"] == {"pack": False, "shard_split": False,
+                            "admit_low": True, "admit_any": True}
+    d2 = decide_overload(level=1, backlog=40, backlog_hi=10)
+    assert (d2["level"], d2["state"]) == (2, "reject_low")
+    assert not d2["actions"]["admit_low"]
+    d3 = decide_overload(level=2, backlog=40, backlog_hi=10)
+    assert (d3["level"], d3["actions"]["admit_any"]) == (3, False)
+    # hysteresis: calm decisions accumulate before stepping down
+    calm1 = decide_overload(level=3, backlog=0, backlog_hi=10,
+                            calm_rounds=0, cool_rounds=3)
+    assert (calm1["level"], calm1["calm_rounds"]) == (3, 1)
+    calm3 = decide_overload(level=3, backlog=0, backlog_hi=10,
+                            calm_rounds=2, cool_rounds=3)
+    assert (calm3["level"], calm3["calm_rounds"]) == (2, 0)
+    # the queue-p99 and RSS signals engage only with a watermark
+    dq = decide_overload(level=0, backlog=0, backlog_hi=10,
+                         queue_p99_s=12.0, queue_p99_hi_s=6.0)
+    assert dq["level"] == 1 and "queue_p99" in dq["reason"]
+    # the tracker's p99 window decays by TIME: at reject_all nothing
+    # new is served, and a frozen burst-era tail would lock the
+    # ladder at the top forever
+    import time as _time
+
+    from adam_tpu.serve.overload import OverloadPolicy, OverloadTracker
+    tr = OverloadTracker(OverloadPolicy(backlog_hi=0,
+                                        queue_p99_hi_s=1.0))
+    tr.observe_wait(50.0)
+    assert tr._queue_p99() == 50.0
+    tr._waits = [(_time.monotonic() - tr.WINDOW_AGE_S - 1, 50.0)]
+    assert tr._queue_p99() is None      # the spike aged out
+    # replay
+    r = decide_overload(**dq["inputs"])
+    assert (r["level"], r["state"], r["actions"], r["input_digest"]) \
+        == (dq["level"], dq["state"], dq["actions"],
+            dq["input_digest"])
+
+
+def test_overquota_rejection_doc_roundtrip(tmp_path, resources):
+    """Over-cap submissions get a durable typed ``rejected/<job>.json``
+    with retry_after_s — never a silent drop — the sidecar validates
+    AND replays, and a fresh id may resubmit after the hint."""
+    from adam_tpu.serve.overload import AdmissionLimits, OverloadPolicy
+
+    src = str(resources / "small.sam")
+    spool = str(tmp_path / "spool")
+    for i in range(4):
+        jobspec.submit_job(spool, {"job_id": f"j{i}", "tenant": "t",
+                                   "command": "flagstat",
+                                   "input": src})
+    sidecar = str(tmp_path / "m.jsonl")
+    with obs.metrics_run(sidecar, argv=["t"], config={}):
+        srv = ServeServer(
+            spool, chunk_rows=CHUNK, poll_s=0.01,
+            limits=AdmissionLimits(fair=True, backlog_cap=2),
+            overload=OverloadPolicy(backlog_hi=100))
+        assert srv.run(max_jobs=4, idle_timeout_s=10.0) == 4
+    solo = _solo_report(src)
+    for i in (0, 1):
+        assert jobspec.read_result(
+            spool, f"j{i}")["result"]["report"] == solo
+    for i in (2, 3):
+        doc = jobspec.read_result(spool, f"j{i}")
+        assert doc["rejected"] is True and doc["ok"] is False
+        assert doc["error_type"] == "AdmissionRejected"
+        assert doc["code"] == "over_backlog"
+        assert doc["retry_after_s"] >= 1.0
+        # the doc is durable under rejected/, not failed/
+        assert os.path.exists(os.path.join(spool, jobspec.REJECTED,
+                                           f"j{i}.json"))
+        # the id is burned (results key by job_id) — resubmission uses
+        # a fresh id, the submit CLI's .r1 discipline
+        with pytest.raises(ValueError, match="already has a result"):
+            jobspec.submit_job(spool, {"job_id": f"j{i}",
+                                       "tenant": "t",
+                                       "command": "flagstat",
+                                       "input": src})
+        jobspec.submit_job(spool, {"job_id": f"j{i}.r1", "tenant": "t",
+                                   "command": "flagstat",
+                                   "input": src})
+    events = [json.loads(ln) for ln in open(sidecar)]
+    rej = [e for e in events if e["event"] == "admission_rejected"]
+    assert {e["job_id"] for e in rej} == {"j2", "j3"}
+    adm = [e for e in events if e["event"] == "admission_selected"]
+    assert any(e.get("reject") for e in adm)
+    _run_validators_on(sidecar)
+
+
+def test_queued_past_deadline_cancelled(tmp_path, resources):
+    """A job queued past its spec deadline is cancelled with a typed
+    ``DeadlineExceeded`` doc instead of occupying a warm worker, and
+    the hit/miss counts join the SLO report."""
+    import time as _time
+
+    src = str(resources / "small.sam")
+    spool = str(tmp_path / "spool")
+    jobspec.submit_job(spool, {"job_id": "fresh", "tenant": "a",
+                               "command": "flagstat", "input": src,
+                               "deadline_s": 300.0})
+    jobspec.submit_job(spool, {"job_id": "stale", "tenant": "a",
+                               "command": "flagstat", "input": src,
+                               "deadline_s": 0.05})
+    _time.sleep(0.1)    # stale's deadline expires in the queue
+    sidecar = str(tmp_path / "m.jsonl")
+    with obs.metrics_run(sidecar, argv=["t"], config={}):
+        srv = ServeServer(spool, chunk_rows=CHUNK, poll_s=0.01)
+        assert srv.run(max_jobs=2, idle_timeout_s=10.0) == 2
+    fresh = jobspec.read_result(spool, "fresh")
+    assert fresh["ok"] and fresh["result"]["report"] == \
+        _solo_report(src)
+    stale = jobspec.read_result(spool, "stale")
+    assert not stale["ok"]
+    assert stale["error_type"] == "DeadlineExceeded"
+    events = [json.loads(ln) for ln in open(sidecar)]
+    dm = [e for e in events if e["event"] == "deadline_missed"]
+    assert len(dm) == 1 and dm[0]["job_id"] == "stale"
+    assert dm[0]["wait_s"] > dm[0]["deadline_s"]
+    # hit/miss counts join the per-tenant SLO report
+    with open(os.path.join(spool, "serve_report.json")) as f:
+        report = json.load(f)
+    assert report["tenants"]["a"]["deadline_hit"] == 1
+    assert report["tenants"]["a"]["deadline_missed"] == 1
+    _run_validators_on(sidecar)
+
+
+def test_burst_tenant_fairness_steady_p99_bounded(tmp_path):
+    """THE fairness pin: a 6-job burst tenant ahead of a steady tenant
+    in the queue — DRR admission serves the steady tenant's job in the
+    FIRST round (its queue wait bounded by one round, not the whole
+    burst), where FIFO would have served it last."""
+    in_small = _synth_reads(tmp_path / "s.reads", 8_000, 11)
+    spool = str(tmp_path / "spool")
+    for i in range(6):
+        jobspec.submit_job(spool, {"job_id": f"burst{i}",
+                                   "tenant": "burst",
+                                   "command": "flagstat",
+                                   "input": in_small})
+    jobspec.submit_job(spool, {"job_id": "steady0",
+                               "tenant": "steady",
+                               "command": "flagstat",
+                               "input": in_small})
+    sidecar = str(tmp_path / "m.jsonl")
+    with obs.metrics_run(sidecar, argv=["t"], config={}):
+        srv = ServeServer(spool, chunk_rows=CHUNK, poll_s=0.01,
+                          max_concurrent=2, pack=False)
+        assert srv.run(max_jobs=7, idle_timeout_s=20.0) == 7
+    events = [json.loads(ln) for ln in open(sidecar)]
+    order = [e["job_id"] for e in events if e["event"] == "tenant_job"]
+    # round 1 is (burst0, steady0): the steady tenant never waits out
+    # the burst backlog
+    assert order[:2] == ["burst0", "steady0"], order
+    waits = {e["job_id"]: e.get("queue_s", 0.0) for e in events
+             if e["event"] == "tenant_job"}
+    # fairness as a number: the steady job's wait is bounded by round
+    # 1, strictly under the burst tail's wait
+    assert waits["steady0"] < waits["burst5"]
+    _run_validators_on(sidecar)
+
+
+def test_brownout_ladder_walkup_walkdown_under_backlog(tmp_path):
+    """Injected backlog past the watermark walks the ladder up
+    (overload_state events, packing disabled while shedding), and the
+    drained queue cools it back down to normal — on the live server,
+    not just the pure decider."""
+    from adam_tpu.serve.overload import OverloadPolicy
+
+    in_small = _synth_reads(tmp_path / "s.reads", 6_000, 12)
+    spool = str(tmp_path / "spool")
+    for i in range(8):
+        jobspec.submit_job(spool, {"job_id": f"j{i}", "tenant": "t",
+                                   "command": "flagstat",
+                                   "input": in_small})
+    sidecar = str(tmp_path / "m.jsonl")
+    with obs.metrics_run(sidecar, argv=["t"], config={}):
+        srv = ServeServer(
+            spool, chunk_rows=CHUNK, poll_s=0.01, max_concurrent=2,
+            overload=OverloadPolicy(backlog_hi=4, cool_rounds=2))
+        # idle rounds after the queue drains walk the ladder back down
+        srv.run(idle_timeout_s=1.5)
+        assert srv.overload.level == 0
+    events = [json.loads(ln) for ln in open(sidecar)]
+    states = [(e["prev_level"], e["level"]) for e in events
+              if e["event"] == "overload_state"]
+    assert states, "the ladder never moved"
+    # walked up one rung at a time, then back down to normal
+    assert states[0] == (0, 1)
+    assert all(abs(b - a) == 1 for a, b in states)
+    assert states[-1][1] == 0
+    # while shedding (level >= 1) admission recorded pack=False —
+    # cheaper rounds, byte-identical results
+    adm = [e for e in events if e["event"] == "admission_selected"]
+    lvl = {e["input_digest"]: e["inputs"].get("overload_level", 0)
+           for e in adm}
+    assert any(v >= 1 for v in lvl.values())
+    assert all(e["inputs"]["pack"] is False
+               for e in adm if e["inputs"].get("overload_level"))
+    _run_validators_on(sidecar)
+
+
+def test_queue_cursor_flat_round_cost(tmp_path, resources):
+    """Satellite pin: the queue scanner parses each spec ONCE — a 10x
+    deeper backlog costs later rounds zero additional parses (round
+    cost flat), and the snapshot stays correct as entries come and
+    go."""
+    src = str(resources / "small.sam")
+    spool = str(tmp_path / "spool")
+    for i in range(20):
+        jobspec.submit_job(spool, {"job_id": f"a{i}", "tenant": "t",
+                                   "command": "flagstat",
+                                   "input": src})
+    cur = jobspec.QueueCursor(spool)
+    snap1 = cur.snapshot()
+    assert len(snap1) == 20 and cur.parsed_total == 20
+    # rescan: zero parses
+    assert len(cur.snapshot()) == 20 and cur.parsed_total == 20
+    # 10x growth: only the NEW entries parse
+    for i in range(200):
+        jobspec.submit_job(spool, {"job_id": f"b{i}", "tenant": "t",
+                                   "command": "flagstat",
+                                   "input": src})
+    snap2 = cur.snapshot()
+    assert len(snap2) == 220 and cur.parsed_total == 220
+    assert len(cur.snapshot()) == 220 and cur.parsed_total == 220
+    # a claimed entry leaves the snapshot (and the cache)
+    _, path0, _ = snap2[0]
+    assert jobspec.claim_job(spool, path0)
+    snap3 = cur.snapshot()
+    assert len(snap3) == 219 and cur.parsed_total == 220
+    # submit order preserved across cache hits
+    assert [s for s, _, _ in snap3] == sorted(s for s, _, _ in snap3)
+
+
+def test_wait_result_exponential_backoff(tmp_path, monkeypatch):
+    """Satellite pin: wait_result's poll interval doubles to a cap
+    instead of hammering the result dirs at a fixed rate; the result
+    still returns promptly once published."""
+    import time as _time
+
+    spool = str(tmp_path / "spool")
+    jobspec.ensure_spool(spool)
+    sleeps = []
+    real_monotonic = _time.monotonic
+
+    def fake_sleep(s):
+        sleeps.append(s)
+        if len(sleeps) == 8:
+            jobspec.write_result(
+                spool, {"job_id": "x", "tenant": "t",
+                        "command": "flagstat"}, ok=True, result={})
+
+    monkeypatch.setattr(_time, "sleep", fake_sleep)
+    monkeypatch.setattr(_time, "monotonic", real_monotonic)
+    doc = jobspec.wait_result(spool, "x", timeout_s=60.0, poll_s=0.01)
+    assert doc["ok"] is True
+    # doubled each poll, capped at 20x base (and never above 1 s)
+    assert sleeps[0] == pytest.approx(0.01)
+    assert sleeps[1] == pytest.approx(0.02)
+    assert sleeps[2] == pytest.approx(0.04)
+    assert max(sleeps) <= 0.2 + 1e-9
+    assert sleeps[-1] == pytest.approx(0.2)
+
+
+def test_submit_cli_honors_retry_after(tmp_path, resources, capsys):
+    """Satellite pin: ``adam-tpu submit -wait`` transparently resubmits
+    ONCE after a typed rejection's retry_after_s, then surfaces the
+    second rejection typed (exit 3) instead of looping."""
+    import threading
+
+    from adam_tpu.cli.main import main
+
+    src = str(resources / "small.sam")
+    spool = str(tmp_path / "spool")
+    jobspec.ensure_spool(spool)
+    solo = _solo_report(src)
+    stop = threading.Event()
+
+    def fake_server(reject_first_n):
+        """Reject the first N queued jobs typed; serve the rest."""
+        rejected = 0
+        while not stop.is_set():
+            for _, path, spec in jobspec.iter_queue(spool):
+                canon = jobspec.canon_spec(spec)
+                canon["job_id"] = spec["job_id"]
+                claimed = jobspec.claim_job(spool, path)
+                if claimed is None:
+                    continue
+                if rejected < reject_first_n:
+                    rejected += 1
+                    jobspec.write_rejection(
+                        spool, canon, code="over_backlog",
+                        retry_after_s=0.05, message="full",
+                        queue_path=claimed)
+                else:
+                    jobspec.write_result(
+                        spool, canon, ok=True,
+                        result={"report": solo},
+                        running_path=claimed)
+            stop.wait(0.01)
+
+    t = threading.Thread(target=fake_server, args=(1,), daemon=True)
+    t.start()
+    try:
+        rc = main(["submit", spool, "flagstat", src, "-job_id", "one",
+                   "-wait", "-timeout", "30"])
+    finally:
+        stop.set()
+        t.join()
+    captured = capsys.readouterr()
+    assert rc == 0
+    assert captured.out.rstrip("\n") == solo.rstrip("\n")
+    assert "resubmitting once" in captured.err
+    # the resubmission rode a derived id, the original doc survives
+    assert jobspec.read_result(spool, "one")["rejected"] is True
+    assert jobspec.read_result(spool, "one.r1")["ok"] is True
+
+    # a server that keeps rejecting: ONE transparent retry, then the
+    # typed rejection surfaces
+    stop.clear()
+    t2 = threading.Thread(target=fake_server, args=(99,), daemon=True)
+    t2.start()
+    try:
+        rc2 = main(["submit", spool, "flagstat", src, "-job_id", "two",
+                    "-wait", "-timeout", "30"])
+    finally:
+        stop.set()
+        t2.join()
+    captured2 = capsys.readouterr()
+    assert rc2 == 3
+    assert "AdmissionRejected" in captured2.err
+
+
+def test_breaker_trips_half_opens_closes_byte_identical(tmp_path,
+                                                        monkeypatch):
+    """THE breaker pin: a persistent transient storm trips the site
+    open after the threshold (subsequent dispatches short-circuit to
+    the byte-identical CPU fallback with zero device attempts), the
+    cooldown half-opens it, a clean probe closes it — and every
+    transition replays offline."""
+    import time as _time
+
+    from adam_tpu.resilience.retry import (breaker_snapshot,
+                                           reset_breakers)
+
+    in_r = _synth_reads(tmp_path / "r.reads", 40_000, 13)
+    clean = streaming_flagstat(in_r, chunk_rows=1 << 12)
+    monkeypatch.setenv("ADAM_TPU_RETRY_BUDGET", "2")
+    monkeypatch.setenv("ADAM_TPU_RETRY_BACKOFF_S", "0.001")
+    monkeypatch.setenv("ADAM_TPU_BREAKER_COOLDOWN_S", "0.3")
+    reset_breakers()
+    sidecar = str(tmp_path / "m.jsonl")
+    faults.install_plan({"rules": [
+        {"site": "device_dispatch", "fault": "error",
+         "error": "UNAVAILABLE", "occurrence": "1+"}]})
+    try:
+        with obs.metrics_run(sidecar, argv=["t"], config={}):
+            stormy = streaming_flagstat(in_r, chunk_rows=1 << 12)
+            faults.clear_plan()       # the storm passes
+            _time.sleep(0.35)         # past the cooldown
+            healed = streaming_flagstat(in_r, chunk_rows=1 << 12)
+    finally:
+        faults.clear_plan()
+    # byte-identity through the storm AND through the healed probe
+    assert stormy[0].__dict__ == clean[0].__dict__
+    assert stormy[1].__dict__ == clean[1].__dict__
+    assert healed[0].__dict__ == clean[0].__dict__
+    assert breaker_snapshot()["device_dispatch"] == "closed"
+    events = [json.loads(ln) for ln in open(sidecar)]
+    trans = [e["state"] for e in events
+             if e["event"] == "breaker_state"
+             and e["site"] == "device_dispatch"]
+    assert trans == ["open", "half_open", "closed"]
+    # while open, dispatches short-circuited (no device attempt, no
+    # backoff): degraded_dispatch with error_kind breaker_open
+    sc = [e for e in events if e["event"] == "degraded_dispatch"
+          and e["error_kind"] == "breaker_open"]
+    assert sc, "no dispatch short-circuited while the breaker was open"
+    _run_validators_on(sidecar)
+
+
+def test_breaker_no_fallback_raises_typed(tmp_path, monkeypatch):
+    """A breaker-open site with no CPU fallback raises the typed
+    BreakerOpen instead of burning retries against a storming
+    backend."""
+    from adam_tpu.resilience.retry import (BreakerOpen,
+                                           dispatch_with_retry,
+                                           reset_breakers,
+                                           resolve_retry_policy)
+
+    monkeypatch.setenv("ADAM_TPU_BREAKER_THRESHOLD", "2")
+    monkeypatch.setenv("ADAM_TPU_BREAKER_COOLDOWN_S", "60")
+    reset_breakers()
+    policy = resolve_retry_policy(budget=1)
+    calls = []
+
+    def boom(attempt):
+        calls.append(attempt)
+        raise ConnectionError("backend storm")
+
+    for _ in range(2):      # two transient exhaustions: trip
+        with pytest.raises(ConnectionError):
+            dispatch_with_retry(boom, site="device_dispatch",
+                                policy=policy)
+    n_before = len(calls)
+    with pytest.raises(BreakerOpen, match="circuit breaker open"):
+        dispatch_with_retry(boom, site="device_dispatch",
+                            policy=policy)
+    assert len(calls) == n_before       # zero attempts while open
+    reset_breakers()
+
+
+def test_decide_breaker_pure_and_replayable():
+    from adam_tpu.resilience.retry import decide_breaker
+
+    d = decide_breaker(state="closed", failures=3, threshold=3)
+    assert d["state"] == "open" and d["changed"]
+    r = decide_breaker(**d["inputs"])
+    assert (r["state"], r["input_digest"]) == \
+        (d["state"], d["input_digest"])
+    assert decide_breaker(state="open", failures=3, threshold=3,
+                          open_elapsed_s=1.0,
+                          cooldown_s=5.0)["state"] == "open"
+    assert decide_breaker(state="open", failures=3, threshold=3,
+                          open_elapsed_s=5.0,
+                          cooldown_s=5.0)["state"] == "half_open"
+    assert decide_breaker(state="half_open", failures=0, threshold=3,
+                          probe_ok=False)["state"] == "open"
+
+
+def _run_validators_on(sidecar):
+    """check_metrics + check_executor round-trip on a live sidecar
+    (the warm-jobs test's loader, shared)."""
+    import importlib.util
+    for tool in ("check_metrics", "check_executor"):
+        spec = importlib.util.spec_from_file_location(
+            tool, os.path.join(os.path.dirname(__file__), "..",
+                               "tools", f"{tool}.py"))
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        if tool == "check_metrics":
+            assert mod.validate(sidecar) == [], tool
+        else:
+            assert mod.check([sidecar]) == [], tool
+
+
+def test_committed_overload_artifact_gates():
+    """The committed BENCH_OVERLOAD.json must keep the ISSUE 14
+    acceptance numbers (tools/bench_gate.py gate 8 enforces this
+    forever; this pin fails earlier and closer to the numbers)."""
+    root = os.path.join(os.path.dirname(__file__), "..")
+    with open(os.path.join(root, "BENCH_OVERLOAD.json")) as f:
+        doc = json.load(f)
+    assert doc["overload_identical"] is True
+    assert doc["overload_rejects_typed"] is True
+    assert doc["overload_warm_recompiles"] == 0
+    assert doc["overload_max_level"] >= 1
+    assert doc["overload_offered_ratio"] >= 2.0
+    assert doc["overload_goodput_ratio"] >= 0.35
+    cap = doc.get("host_parallel_capacity")
+    if isinstance(cap, (int, float)) and cap >= 1.2:
+        assert doc["overload_goodput_ratio"] >= 1.0
+        assert doc["overload_queue_p99_ratio"] <= 1.0
+
+
 def test_committed_serve_artifact_gates():
     """The committed BENCH_SERVE.json must keep the ISSUE 10 acceptance
     numbers: >= 2x warm-vs-cold on job 2+, identity on every leg, zero

@@ -198,8 +198,9 @@ def test_snptable_ingest_rss_stays_bounded(tmp_path):
     # child's allocator measured up to ~2 GB for the identical work —
     # ~2.65 GB once the shard_map compat let the whole suite actually
     # execute ahead of this test, ~3.21 GB with the PR 8 suite running
-    # ahead of it — so the bound is a gross-regression tripwire
+    # ahead of it, ~3.52 GB with the PR 14 overload suite ahead of it —
+    # so the bound is a gross-regression tripwire
     # (O(file) string churn, which lands >4 GB), not a pin on the
     # isolated number (~830 MB, unchanged — pinned by running this test
     # alone).
-    assert int(peak_kb) < 3_600_000, f"peak RSS {int(peak_kb)//1024} MB"
+    assert int(peak_kb) < 3_900_000, f"peak RSS {int(peak_kb)//1024} MB"

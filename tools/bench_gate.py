@@ -69,6 +69,19 @@ differ) with ``--threshold`` where applicable:
    (``--paged NEW_P.json``, from ``python bench.py --worker
    paged_race``) additionally diffs both serve walls at 10%.
 
+8. **The overload plane is pinned.**  ``BENCH_OVERLOAD.json`` (the
+   committed ``overload`` artifact, ISSUE 14) drives one warm server
+   at 2x its accepted backlog capacity, with and without the brownout
+   ladder + admission caps armed.  Unconditional: every ACCEPTED job's
+   report byte-identical to the solo oracle, zero warm recompiles,
+   the ladder actually engaged (``overload_max_level`` >= 1), and
+   every shed job left a typed ``rejected/`` doc with a
+   ``retry_after_s`` hint (never a silent drop).  Capacity-armed (the
+   gate-4/6 discipline): accepted-job goodput >= the unprotected
+   baseline and accepted-job queue p99 <= the unprotected tail.  A
+   fresh artifact (``--overload NEW_O.json``, from ``python bench.py
+   --worker overload``) additionally diffs both serve walls at 10%.
+
 Usage::
 
     python tools/bench_gate.py                       # committed gates
@@ -78,6 +91,7 @@ Usage::
     python tools/bench_gate.py --serve NEW_SV.json   # + serve diff
     python tools/bench_gate.py --fleet-serve NEW_FS.json  # + diff
     python tools/bench_gate.py --paged NEW_P.json    # + paged diff
+    python tools/bench_gate.py --overload NEW_O.json # + overload diff
 
 Exit 0 when every gate holds; the first failing check's exit code
 otherwise.
@@ -196,6 +210,30 @@ PAGED_TWIN_KEYS = ("paged_flagstat_matches_ragged",
                    "paged_bqsr_matches_ragged",
                    "paged_realign_matches_ragged")
 
+OVERLOAD = os.path.join(ROOT, "BENCH_OVERLOAD.json")
+
+#: the ISSUE 14 acceptance numbers.  Capacity-armed (gate-4/6
+#: discipline): under 2x-capacity offered load the armed server's
+#: accepted-job goodput must not fall below the unprotected baseline
+#: and its accepted-job queue p99 must not exceed the unprotected
+#: tail.  On a starved box both ratios are neighbor-noise — the
+#: committed container delivers ~0.8-1.3x of one core — so, like gates
+#: 4 and 6, they arm only when the artifact's own capacity probe saw
+#: real parallelism; identity, zero warm recompiles, ladder
+#: engagement, and typed rejections are enforced unconditionally.
+OVERLOAD_GOODPUT_FLOOR = 1.0
+OVERLOAD_QUEUE_P99_CEIL = 1.0
+OVERLOAD_CAPACITY_FLOOR = 1.2
+#: enforced unconditionally (the SHARD_MIN_SPEEDUP_ANY discipline):
+#: shedding half the offered load may buy nothing on a noisy box, but
+#: below this fraction of baseline goodput the overload machinery
+#: itself is eating throughput
+OVERLOAD_GOODPUT_MIN_ANY = 0.35
+
+#: the overload walls a fresh artifact is regression-diffed on
+OVERLOAD_WALL_KEYS = ("overload_baseline_wall_s",
+                      "overload_armed_wall_s")
+
 
 def _check_paged_artifact(path: str) -> int:
     """Gate 7's committed-artifact half: the >= 2x steady-state
@@ -242,6 +280,86 @@ def _check_paged_artifact(path: str) -> int:
               f"({doc.get('paged_n_jobs')} tenants x "
               f"{doc.get('paged_n_reads')} reads), all twins "
               "bit-identical, identity true, 0 steady recompiles")
+    return rc
+
+
+def _check_overload_artifact(path: str) -> int:
+    """Gate 8's committed-artifact half: accepted-job identity, zero
+    warm recompiles, ladder engagement, typed rejections
+    (unconditional); goodput floor + bounded accepted-job p99
+    (capacity-armed)."""
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, ValueError) as e:
+        print(f"bench_gate: unreadable overload artifact {path}: {e}",
+              file=sys.stderr)
+        return 2
+    rc = 0
+    if doc.get("overload_identical") is not True:
+        print(f"bench_gate: overload_identical is not true in {path} "
+              "— accepted jobs under overload no longer byte-identical "
+              "to the solo oracle", file=sys.stderr)
+        rc = 1
+    if doc.get("overload_warm_recompiles") != 0:
+        print(f"bench_gate: overload_warm_recompiles "
+              f"{doc.get('overload_warm_recompiles')!r} in {path} — "
+              "warm jobs 2+ must reuse the compiled shapes under "
+              "overload too", file=sys.stderr)
+        rc = 1
+    lvl = doc.get("overload_max_level")
+    if not (isinstance(lvl, int) and lvl >= 1):
+        print(f"bench_gate: overload_max_level {lvl!r} in {path} — "
+              "the brownout ladder never engaged; the artifact is not "
+              "measuring overload", file=sys.stderr)
+        rc = 1
+    if doc.get("overload_rejects_typed") is not True:
+        print(f"bench_gate: overload_rejects_typed is not true in "
+              f"{path} — a shed job left no typed rejected/ doc with "
+              "retry_after_s (a silent drop)", file=sys.stderr)
+        rc = 1
+    good = doc.get("overload_goodput_ratio")
+    p99r = doc.get("overload_queue_p99_ratio")
+    capacity = doc.get("host_parallel_capacity")
+    gated = isinstance(capacity, (int, float)) and \
+        capacity >= OVERLOAD_CAPACITY_FLOOR
+    if not isinstance(good, (int, float)):
+        print(f"bench_gate: overload artifact {path} carries no "
+              "overload_goodput_ratio", file=sys.stderr)
+        rc = 1
+    elif gated and good < OVERLOAD_GOODPUT_FLOOR:
+        print(f"bench_gate: overload goodput ratio {good!r} in {path} "
+              f"is below the required {OVERLOAD_GOODPUT_FLOOR}x on a "
+              f"box with measured parallel capacity {capacity}x — "
+              "shedding is eating accepted-job throughput",
+              file=sys.stderr)
+        rc = 1
+    elif good < OVERLOAD_GOODPUT_MIN_ANY:
+        print(f"bench_gate: overload goodput ratio {good!r} in {path} "
+              f"is below the unconditional floor "
+              f"{OVERLOAD_GOODPUT_MIN_ANY}x — the overload machinery "
+              "itself regressed (this floor applies even on a "
+              "capacity-limited box)", file=sys.stderr)
+        rc = 1
+    if gated and isinstance(p99r, (int, float)) and \
+            p99r > OVERLOAD_QUEUE_P99_CEIL:
+        print(f"bench_gate: overload accepted-job queue p99 ratio "
+              f"{p99r!r} in {path} exceeds {OVERLOAD_QUEUE_P99_CEIL} "
+              "— the armed tail is WORSE than the unprotected tail",
+              file=sys.stderr)
+        rc = 1
+    if rc == 0:
+        how = (f"goodput {good}x >= {OVERLOAD_GOODPUT_FLOOR}x, p99 "
+               f"ratio {p99r}"
+               if gated else
+               f"goodput {good}x / p99 ratio {p99r} reported, not "
+               f"gated — measured parallel capacity {capacity}x < "
+               f"{OVERLOAD_CAPACITY_FLOOR}x (capacity-limited box)")
+        print(f"overload gate: {doc.get('overload_offered_jobs')} "
+              f"jobs at {doc.get('overload_offered_ratio')}x "
+              f"capacity, ladder reached level {lvl}, "
+              f"{doc.get('overload_armed_rejected')} typed "
+              f"rejection(s), identity true, 0 warm recompiles; {how}")
     return rc
 
 
@@ -472,6 +590,16 @@ def main(argv=None) -> int:
             print("bench_gate: --paged needs a path", file=sys.stderr)
             return 2
         del argv[i:i + 2]
+    fresh_overload = None
+    if "--overload" in argv:
+        i = argv.index("--overload")
+        try:
+            fresh_overload = argv[i + 1]
+        except IndexError:
+            print("bench_gate: --overload needs a path",
+                  file=sys.stderr)
+            return 2
+        del argv[i:i + 2]
     for path in (BASELINE, CURRENT):
         if not os.path.exists(path):
             print(f"bench_gate: missing committed artifact {path} "
@@ -501,6 +629,11 @@ def main(argv=None) -> int:
     if not os.path.exists(PAGED):
         print(f"bench_gate: missing committed artifact {PAGED} "
               "(regenerate with: python bench.py --worker paged_race "
+              "> out.jsonl on the CPU backend)", file=sys.stderr)
+        return 2
+    if not os.path.exists(OVERLOAD):
+        print(f"bench_gate: missing committed artifact {OVERLOAD} "
+              "(regenerate with: python bench.py --worker overload "
               "> out.jsonl on the CPU backend)", file=sys.stderr)
         return 2
 
@@ -633,6 +766,28 @@ def main(argv=None) -> int:
         if rc != 0:
             print("bench_gate: a paged serve wall regressed past 10% "
                   "vs the committed artifact", file=sys.stderr)
+            return rc
+
+    print("\n== gate 8: overload plane — accepted-job identity + "
+          "typed shedding on the committed overload artifact ==")
+    rc = _check_overload_artifact(OVERLOAD)
+    if rc != 0:
+        return rc
+
+    if fresh_overload:
+        print(f"\n== gate 8b: {fresh_overload} vs committed "
+              f"{OVERLOAD} (10% regression threshold on the serve "
+              "walls) ==")
+        rc = _check_overload_artifact(fresh_overload)
+        if rc != 0:
+            return rc
+        rc = compare_bench.main([OVERLOAD, fresh_overload,
+                                 "--keys",
+                                 ",".join(OVERLOAD_WALL_KEYS),
+                                 "--threshold", "10"])
+        if rc != 0:
+            print("bench_gate: an overload serve wall regressed past "
+                  "10% vs the committed artifact", file=sys.stderr)
             return rc
 
     print("\nbench_gate: all gates hold")

@@ -15,10 +15,13 @@ and fails when:
   fleet's ``shard_plan_selected`` (decide_shard_plan) and
   ``shard_reassigned`` (decide_shard_reassignment /
   decide_shard_speculation, selected by the recorded ``cause``), the
-  serve front-end's ``admission_selected`` (decide_admission), and
-  the fleet-serve scheduler's ``placement_selected``
+  serve front-end's ``admission_selected`` (decide_admission), the
+  fleet-serve scheduler's ``placement_selected``
   (decide_placement) and ``job_requeued`` (decide_requeue /
-  decide_steal, selected by the recorded ``cause``);
+  decide_steal, selected by the recorded ``cause``), the overload
+  plane's ``overload_state`` (serve/overload.decide_overload) and the
+  backend circuit breaker's ``breaker_state``
+  (resilience/retry.decide_breaker);
 * the recorded ``input_digest`` does not match the digest of the
   recorded inputs (the event lied about what it decided from);
 * two events — within one file or across files — share an
@@ -77,9 +80,22 @@ SHARD_SPEC_FIELDS = ("action", "victim", "target", "tail_runs",
 PAGES_FIELDS = ("pages", "action", "reason")
 
 #: the serve admission fields a replay must reproduce exactly
-#: (serve/admission.decide_admission — which jobs run and which share
-#: dispatches; same purity contract)
-ADMISSION_FIELDS = ("admit", "pack_groups", "reason")
+#: (serve/admission.decide_admission — which jobs run, which share
+#: dispatches, and which are shed/cancelled; ``reject``/``cancel``
+#: joined in the overload era and are compared only when recorded)
+ADMISSION_FIELDS = ("admit", "pack_groups", "reason", "reject",
+                    "cancel")
+
+#: the brownout-ladder fields a replay must reproduce exactly
+#: (serve/overload.decide_overload — the overload state machine;
+#: same purity contract)
+OVERLOAD_FIELDS = ("level", "state", "actions", "calm_rounds",
+                   "reason")
+
+#: the circuit-breaker fields a replay must reproduce exactly
+#: (resilience/retry.decide_breaker; ``failures`` in the event is the
+#: host-side window count, not a decision output)
+BREAKER_FIELDS = ("state", "reason")
 
 #: the fleet-serve scheduler fields a replay must reproduce exactly
 #: (serve/scheduler.decide_placement / decide_requeue / decide_steal —
@@ -90,7 +106,8 @@ REQUEUE_FIELDS = ("action", "reason")
 STEAL_FIELDS = ("action", "moves", "reason")
 
 #: fields absent from older sidecars: compared only when recorded
-_OPTIONAL_FIELDS = ("layout", "page_rows", "pool_pages")
+_OPTIONAL_FIELDS = ("layout", "page_rows", "pool_pages", "reject",
+                    "cancel")
 
 #: event kinds whose canonicalized inputs grew layout keys in PR 8 —
 #: a pre-layout event's recorded inputs digest differently under the
@@ -101,7 +118,8 @@ _LAYOUT_KINDS = ("executor_bucket_selected", "realign_plan_selected")
 _REPLAYED = ("executor_bucket_selected", "fusion_plan_selected",
              "realign_plan_selected", "shard_plan_selected",
              "shard_reassigned", "admission_selected",
-             "placement_selected", "job_requeued", "pages_selected")
+             "placement_selected", "job_requeued", "pages_selected",
+             "overload_state", "breaker_state")
 
 
 def _events(path: str, kinds=_REPLAYED) -> List[Tuple[int, dict]]:
@@ -129,7 +147,9 @@ def check(paths: List[str]) -> List[str]:
                                                decide_shard_reassignment,
                                                decide_shard_speculation)
     from adam_tpu.parallel.pagedbuf import decide_pages
+    from adam_tpu.resilience.retry import decide_breaker
     from adam_tpu.serve.admission import decide_admission
+    from adam_tpu.serve.overload import decide_overload
     from adam_tpu.serve.scheduler import (decide_placement,
                                           decide_requeue, decide_steal)
 
@@ -144,7 +164,9 @@ def check(paths: List[str]) -> List[str]:
                                        ADMISSION_FIELDS),
                 "placement_selected": (decide_placement,
                                        PLACEMENT_FIELDS),
-                "pages_selected": (decide_pages, PAGES_FIELDS)}
+                "pages_selected": (decide_pages, PAGES_FIELDS),
+                "overload_state": (decide_overload, OVERLOAD_FIELDS),
+                "breaker_state": (decide_breaker, BREAKER_FIELDS)}
     errs: List[str] = []
     # digests are namespaced per event kind: the two deciders hash
     # different input tuples and must never cross-validate

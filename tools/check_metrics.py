@@ -105,6 +105,23 @@ elastic worker sidecars).  Contract checked here:
   the cold-start breakdown (backend init / first compile / first
   dispatch) every command stamps so the serve warmup win is measured
   against a recorded baseline;
+* ``overload_state`` events (the brownout ladder, serve/overload.py)
+  carry ``level`` (0-3) naming ``state``
+  (normal/shed_batch/reject_low/reject_all), the bool ``actions``
+  object, ``reason``, ``inputs`` + hex ``input_digest`` (replayed by
+  tools/check_executor.py);
+* ``admission_rejected`` events carry ``job_id``/``tenant``, a typed
+  ``code`` (over_backlog/tenant_quota/brownout_low/brownout_all) and a
+  non-negative ``retry_after_s`` — every shed job tells its client
+  when to come back;
+* ``deadline_missed`` events carry ``job_id``/``tenant``, ``wait_s``
+  (>= 0) and ``deadline_s`` (> 0) — a queued job cancelled past its
+  deadline instead of wasting a warm dispatch;
+* ``breaker_state`` events (the backend circuit breaker,
+  resilience/retry.py) carry ``site``, ``state``
+  (closed/open/half_open), ``failures`` (int >= 0), ``reason``,
+  ``inputs`` + hex ``input_digest`` (replayed by
+  tools/check_executor.py);
 * the last line is the ``summary``: ``wall_seconds``, ``ok``, and a
   ``metrics`` snapshot whose counters/gauges are numeric and whose
   histograms are internally consistent (count == sum of bucket counts);
@@ -152,6 +169,8 @@ KNOWN_EVENTS = (
     "placement_selected", "job_requeued", "worker_lease_expired",
     "ledger_stage",
     "pages_selected", "h2d_bytes",
+    "overload_state", "admission_rejected", "deadline_missed",
+    "breaker_state",
 )
 
 #: mirror of adam_tpu.resilience.faults.SITES / FAULTS (kept literal so
@@ -167,6 +186,14 @@ _SHARD_ACTIONS = ("none", "respawn", "redistribute", "fail",
                   "speculate")
 _REQUEUE_CAUSES = ("worker_death", "lease_expiry", "drain", "steal")
 _REQUEUE_ACTIONS = ("requeue", "quarantine", "steal")
+#: mirror of adam_tpu.serve.overload.LEVEL_NAMES /
+#: adam_tpu.serve.admission.REJECT_CODES /
+#: adam_tpu.resilience.retry.BREAKER_STATES (kept literal, like
+#: _FAULT_SITES above)
+_OVERLOAD_STATES = ("normal", "shed_batch", "reject_low", "reject_all")
+_REJECT_CODES = ("over_backlog", "tenant_quota", "brownout_low",
+                 "brownout_all")
+_BREAKER_STATES = ("closed", "open", "half_open")
 
 
 def _is_hex(v) -> bool:
@@ -595,6 +622,25 @@ def validate(path: str) -> List[str]:
                     err(i, f"admission_selected pack_groups members "
                            f"{stray} are not in 'admit' — a job cannot "
                            "co-dispatch without being admitted")
+            if "reject" in d:
+                rej = d["reject"]
+                if not (isinstance(rej, list) and all(
+                        isinstance(r, dict) and
+                        isinstance(r.get("job_id"), str) and
+                        r.get("code") in _REJECT_CODES and
+                        _is_num(r.get("retry_after_s")) and
+                        r["retry_after_s"] >= 0 for r in rej)):
+                    err(i, "admission_selected 'reject' is not a list "
+                           "of {job_id, code, retry_after_s} objects")
+            if "cancel" in d:
+                can = d["cancel"]
+                if not (isinstance(can, list) and all(
+                        isinstance(c, dict) and
+                        isinstance(c.get("job_id"), str) and
+                        _is_num(c.get("wait_s")) and
+                        _is_num(c.get("deadline_s")) for c in can)):
+                    err(i, "admission_selected 'cancel' is not a list "
+                           "of {job_id, wait_s, deadline_s} objects")
             if not isinstance(d.get("reason"), str):
                 err(i, "admission_selected missing string 'reason'")
             if not isinstance(d.get("inputs"), dict):
@@ -703,6 +749,74 @@ def validate(path: str) -> List[str]:
             if not (isinstance(p, int) and not isinstance(p, bool)
                     and p >= 1):
                 err(i, "h2d_bytes missing int 'puts' >= 1")
+        elif ev == "overload_state":
+            lvl = d.get("level")
+            if not (isinstance(lvl, int) and not isinstance(lvl, bool)
+                    and 0 <= lvl < len(_OVERLOAD_STATES)):
+                err(i, "overload_state missing int 'level' in "
+                       f"[0, {len(_OVERLOAD_STATES) - 1}]")
+            if d.get("state") not in _OVERLOAD_STATES:
+                err(i, f"overload_state unknown state "
+                       f"{d.get('state')!r}")
+            elif isinstance(lvl, int) and not isinstance(lvl, bool) \
+                    and 0 <= lvl < len(_OVERLOAD_STATES) and \
+                    d["state"] != _OVERLOAD_STATES[lvl]:
+                err(i, f"overload_state level {lvl} does not name "
+                       f"state {d.get('state')!r}")
+            acts = d.get("actions")
+            if not (isinstance(acts, dict) and
+                    all(isinstance(v, bool) for v in acts.values()) and
+                    {"pack", "shard_split", "admit_low",
+                     "admit_any"} <= set(acts)):
+                err(i, "overload_state missing bool 'actions' "
+                       "(pack/shard_split/admit_low/admit_any)")
+            if not isinstance(d.get("reason"), str):
+                err(i, "overload_state missing string 'reason'")
+            if not isinstance(d.get("inputs"), dict):
+                err(i, "overload_state missing 'inputs' object "
+                       "(decision must be replayable)")
+            if not _is_hex(d.get("input_digest")):
+                err(i, "overload_state missing hex 'input_digest'")
+        elif ev == "admission_rejected":
+            for field in ("job_id", "tenant"):
+                if not (isinstance(d.get(field), str) and d[field]):
+                    err(i, f"admission_rejected missing string "
+                           f"{field!r}")
+            if d.get("code") not in _REJECT_CODES:
+                err(i, f"admission_rejected unknown code "
+                       f"{d.get('code')!r}")
+            ra = d.get("retry_after_s")
+            if not (_is_num(ra) and ra >= 0):
+                err(i, "admission_rejected missing non-negative "
+                       "'retry_after_s' (a rejection must always tell "
+                       "the client when to come back)")
+        elif ev == "deadline_missed":
+            for field in ("job_id", "tenant"):
+                if not (isinstance(d.get(field), str) and d[field]):
+                    err(i, f"deadline_missed missing string {field!r}")
+            if not (_is_num(d.get("wait_s")) and d["wait_s"] >= 0):
+                err(i, "deadline_missed missing non-negative 'wait_s'")
+            if not (_is_num(d.get("deadline_s"))
+                    and d["deadline_s"] > 0):
+                err(i, "deadline_missed missing positive 'deadline_s'")
+        elif ev == "breaker_state":
+            if not (isinstance(d.get("site"), str) and d["site"]):
+                err(i, "breaker_state missing string 'site'")
+            if d.get("state") not in _BREAKER_STATES:
+                err(i, f"breaker_state unknown state "
+                       f"{d.get('state')!r}")
+            f_ = d.get("failures")
+            if not (isinstance(f_, int) and not isinstance(f_, bool)
+                    and f_ >= 0):
+                err(i, "breaker_state missing non-negative int "
+                       "'failures'")
+            if not isinstance(d.get("reason"), str):
+                err(i, "breaker_state missing string 'reason'")
+            if not isinstance(d.get("inputs"), dict):
+                err(i, "breaker_state missing 'inputs' object "
+                       "(decision must be replayable)")
+            if not _is_hex(d.get("input_digest")):
+                err(i, "breaker_state missing hex 'input_digest'")
         elif ev == "startup_seconds":
             for k, v in d.items():
                 if k in ("event", "t"):
