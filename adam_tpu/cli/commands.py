@@ -740,9 +740,14 @@ class ServeCommand(Command):
         p.add_argument("-rss_budget_mb", type=float, default=None,
                        help="brownout ladder RSS budget in MB "
                             "(0/default: signal disabled)")
+        p.add_argument("-no_series", action="store_true",
+                       help="disable the always-on time-series sampler "
+                            "(SPOOL/series.jsonl; 'adam-tpu status' "
+                            "renders its tail — docs/OBSERVABILITY.md)")
         add_executor_args(p)
 
     def run(self, args) -> int:
+        from .. import obs
         from ..instrument import say
         from ..serve.overload import (resolve_admission_limits,
                                       resolve_overload_policy)
@@ -769,6 +774,7 @@ class ServeCommand(Command):
                 worker_depth=args.worker_depth,
                 max_job_kills=args.max_job_kills,
                 shard_rows=args.shard_rows, steal=not args.no_steal,
+                series=not args.no_series,
                 executor_opts=executor_opts_from(args),
                 limits=limits,
                 overload=resolve_overload_policy(
@@ -779,8 +785,14 @@ class ServeCommand(Command):
             info = sched.boot()
             say(f"serve: fleet of {info.get('hosts')} always-warm "
                 f"worker(s); spool {args.spool}")
-            n = sched.run(max_jobs=args.max_jobs,
-                          idle_timeout_s=args.idle_timeout)
+            try:
+                n = sched.run(max_jobs=args.max_jobs,
+                              idle_timeout_s=args.idle_timeout)
+            finally:
+                # final sample + series_written receipt while the
+                # metrics sink is still open (the worker entry's
+                # discipline)
+                obs.series.stop_series()
             print(f"served {n} job(s) from {args.spool}")
             return 0
         from ..serve.server import ServeServer
@@ -790,6 +802,7 @@ class ServeCommand(Command):
             max_concurrent=args.max_concurrent,
             pack=not args.no_pack, pack_segments=args.pack_segments,
             poll_s=args.poll_s, io_procs=args.io_procs,
+            series=not args.no_series,
             executor_opts=executor_opts_from(args),
             limits=limits,
             overload=resolve_overload_policy(
@@ -801,8 +814,11 @@ class ServeCommand(Command):
         say(f"serve: warm on {info.get('backend')} "
             f"({info.get('n_devices')} device(s)); "
             f"spool {args.spool}")
-        n = server.run(max_jobs=args.max_jobs,
-                       idle_timeout_s=args.idle_timeout)
+        try:
+            n = server.run(max_jobs=args.max_jobs,
+                           idle_timeout_s=args.idle_timeout)
+        finally:
+            obs.series.stop_series()
         print(f"served {n} job(s) from {args.spool}")
         return 0
 
@@ -1577,3 +1593,135 @@ class ListDictCommand(Command):
         for rec in seq_dict:
             print(f"{rec.id}\t{rec.name}\t{rec.length}\t{rec.url or ''}")
         return 0
+
+
+@register
+class StatusCommand(Command):
+    name = "status"
+    help = ("Render a serve spool's durable status docs: liveness, "
+            "backlog, rung, tenants, workers (works live or crashed)")
+
+    def add_args(self, p: argparse.ArgumentParser) -> None:
+        p.add_argument("spool", help="the server's spool directory")
+        p.add_argument("-json", dest="as_json", action="store_true",
+                       help="print the joined view as JSON instead of "
+                            "the human rendering")
+        p.add_argument("-follow", action="store_true",
+                       help="re-render every -interval seconds until "
+                            "interrupted")
+        p.add_argument("-interval", type=float, default=2.0,
+                       help="-follow refresh cadence in seconds")
+        p.add_argument("-count", type=int, default=None, metavar="N",
+                       help="-follow: stop after N renders (default: "
+                            "until interrupted)")
+
+    def run(self, args) -> int:
+        import json as _json
+        import time as _time
+
+        from ..serve import status as status_mod
+
+        if not os.path.isdir(args.spool):
+            print(f"status: no such spool: {args.spool}",
+                  file=sys.stderr)
+            return 2
+        n = 0
+        while True:
+            view = status_mod.collect_status(args.spool)
+            if args.as_json:
+                print(_json.dumps(view, sort_keys=True, default=str))
+            else:
+                print(status_mod.render_status(view))
+            n += 1
+            if not args.follow or (args.count is not None
+                                   and n >= args.count):
+                return 0
+            try:
+                _time.sleep(max(args.interval, 0.05))
+            except KeyboardInterrupt:
+                return 0
+
+
+@register
+class TopCommand(Command):
+    name = "top"
+    help = ("Live-updating serve status (the -follow view with screen "
+            "refresh; rendered purely from durable docs)")
+
+    def add_args(self, p: argparse.ArgumentParser) -> None:
+        p.add_argument("spool", help="the server's spool directory")
+        p.add_argument("-interval", type=float, default=1.0,
+                       help="refresh cadence in seconds")
+        p.add_argument("-count", type=int, default=None, metavar="N",
+                       help="stop after N renders (default: until "
+                            "interrupted)")
+
+    def run(self, args) -> int:
+        import time as _time
+
+        from ..serve import status as status_mod
+
+        if not os.path.isdir(args.spool):
+            print(f"top: no such spool: {args.spool}", file=sys.stderr)
+            return 2
+        clear = sys.stdout.isatty()
+        n = 0
+        while True:
+            view = status_mod.collect_status(args.spool)
+            body = status_mod.render_status(view)
+            if clear:
+                # home + clear-below, not full clear: no flicker
+                sys.stdout.write("\x1b[H\x1b[J")
+            print(body)
+            sys.stdout.flush()
+            n += 1
+            if args.count is not None and n >= args.count:
+                return 0
+            try:
+                _time.sleep(max(args.interval, 0.05))
+            except KeyboardInterrupt:
+                return 0
+
+
+@register
+class ExplainCommand(Command):
+    name = "explain"
+    help = ("Reconstruct one served job's causal timeline (queue "
+            "position, admission/placement inputs, retries, requeues, "
+            "rung/breaker context) from durable artifacts alone")
+
+    def add_args(self, p: argparse.ArgumentParser) -> None:
+        p.add_argument("spool", help="the server's spool directory")
+        p.add_argument("job", help="job id (the result doc's stem, "
+                                   "e.g. 00000003-tenantA)")
+        # NOT -trace / -metrics: main() owns those for THIS process's
+        # own telemetry; these name artifacts a PAST run left behind
+        p.add_argument("-events", action="append", default=[],
+                       metavar="PATH",
+                       help="extra event sidecar(s) beyond spool "
+                            "auto-discovery (repeatable)")
+        p.add_argument("-series", action="append", default=[],
+                       metavar="PATH",
+                       help="extra series.jsonl file(s) (repeatable)")
+        p.add_argument("-timeline", action="append", default=[],
+                       metavar="PATH",
+                       help="extra .trace.json file(s) (repeatable)")
+        p.add_argument("-json", dest="as_json", action="store_true",
+                       help="print the full timeline doc as JSON")
+
+    def run(self, args) -> int:
+        import json as _json
+
+        from ..serve.explain import explain_job, render_timeline
+
+        if not os.path.isdir(args.spool):
+            print(f"explain: no such spool: {args.spool}",
+                  file=sys.stderr)
+            return 2
+        doc = explain_job(args.spool, args.job, events=args.events,
+                          series=args.series, timelines=args.timeline)
+        if args.as_json:
+            print(_json.dumps(doc, sort_keys=True, default=str))
+        else:
+            print(render_timeline(doc))
+        return 0 if doc["found"] else 3

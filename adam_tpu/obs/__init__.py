@@ -32,9 +32,10 @@ import os
 import time
 from typing import Iterator, Optional
 
-from . import events, ioledger, startup, trace  # noqa: F401  (planes)
+from . import events, ioledger, series, startup, trace  # noqa: F401 (planes)
 from .registry import (counter, gauge, histogram, registry,  # noqa: F401
                        reset_registry)
+from .series import SERIES_ENV, series_path_from  # noqa: F401
 from .trace import (TRACE_ENV, trace_path_from, trace_run)  # noqa: F401
 
 #: env fallback for the CLI flag — lets bench workers and elastic worker
@@ -50,6 +51,7 @@ def reset_all() -> None:
     events.discard_log()
     ioledger.reset()
     trace.discard_trace()
+    series.discard_series()
     startup.begin()
 
 

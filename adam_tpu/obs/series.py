@@ -1,0 +1,415 @@
+"""Live time-series plane: a daemon sampler over the metrics registry.
+
+The metrics plane so far is batch-shaped — the registry snapshot lands
+in a sidecar only when a run's ``metrics_run`` context closes, so a
+long-lived serve process (PRs 10-15) is a black box while it runs and a
+SIGKILL loses everything since boot.  This module is the live axis: a
+daemon thread snapshots the registry (counters/gauges/histograms, which
+by now carry every serve signal — ``serve_backlog``/``serve_inflight``
+gauges from the loop, ``overload_level``, ``breaker_open{site=}``,
+``h2d_bytes{pass=}``, the ``serve_queue_seconds``/``serve_service_seconds``
+tail histograms — plus an ``rss_mb`` gauge this sampler refreshes
+itself) into a bounded in-memory ring and flushes the rows to a durable
+``series.jsonl``.
+
+Contract (the obs no-op discipline, same as trace.py):
+
+* **zero overhead when off** — nothing is sampled, allocated, or
+  written until :func:`start_series` runs; ``active()`` is one
+  module-global read and no hot path ever calls in here.
+* **crash-durable rows** — the file publishes atomically ONCE (tmp +
+  fsync + rename, before the first sample row) and rows append to the
+  published inode line-at-a-time with per-flush fsync, so a SIGKILL'd
+  server keeps every row already flushed; readers skip a torn final
+  line (:func:`read_series`).
+* **bounded memory** — the pending ring holds at most ``max_rows``
+  samples (``ADAM_TPU_SERIES_MAX_ROWS``); when flushing cannot keep up
+  (an unwritable disk degrades to one stderr line, never a crash) the
+  oldest pending samples drop and the cumulative ``dropped`` count is
+  stamped on every later row and in the ``series_written`` receipt.
+* **rows are exact monoids** — each sample carries a full registry
+  snapshot (cumulative), so merging worker series follows the registry
+  merge law exactly: counters sum, gauges max, histograms bucket-add
+  (:func:`merge_snapshots`).  :func:`fold_rows` aligns rows from
+  different sources on time buckets — last row per source per bucket,
+  then the monoid across sources — which is how ``adam-tpu status``
+  folds a fleet's worker series and how tools/check_series.py verifies
+  the identity law on every written file.
+
+Wiring: the serve loop starts a sampler at ``SPOOL/series.jsonl`` on
+boot (serve/server.py, serve/scheduler.py — each fleet worker samples
+its own sub-spool), and shard-fleet workers inherit a per-incarnation
+path through ``ADAM_TPU_SERIES`` (parallel/shardstream.py), exactly
+like ``ADAM_TPU_METRICS``.  docs/OBSERVABILITY.md documents the row
+schema; tools/check_series.py validates written files.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import os
+import threading
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from . import events as _events
+from .registry import registry
+
+#: env fallback naming the output file — how spawned workers (shard
+#: fleet incarnations) get a per-process series without a CLI flag
+SERIES_ENV = "ADAM_TPU_SERIES"
+#: sampling cadence in seconds (default 1.0)
+SERIES_INTERVAL_ENV = "ADAM_TPU_SERIES_INTERVAL_S"
+#: pending-ring bound in rows (default 4096)
+SERIES_MAX_ROWS_ENV = "ADAM_TPU_SERIES_MAX_ROWS"
+
+SCHEMA_VERSION = 1
+DEFAULT_INTERVAL_S = 1.0
+DEFAULT_MAX_ROWS = 4096
+
+_SAMPLER: "Optional[SeriesSampler]" = None
+
+
+def _rss_mb() -> Optional[float]:
+    """Current resident set in MB (the serve ladder's memory signal,
+    re-read here so every sample row carries it as a gauge).  Local
+    /proc read — obs must not import the serve layer."""
+    try:
+        with open("/proc/self/statm") as f:
+            pages = int(f.read().split()[1])
+        return pages * (os.sysconf("SC_PAGE_SIZE") / (1 << 20))
+    except Exception:  # noqa: BLE001 — a signal, never a crash
+        return None
+
+
+class SeriesSampler:
+    """One process's live sampler: ring + file + daemon thread.
+
+    ``source`` labels every row (pid plus whatever the caller adds —
+    worker id, role) so folded fleet views can tell rows apart without
+    trusting filenames.
+    """
+
+    def __init__(self, path: str, *, interval_s: Optional[float] = None,
+                 max_rows: Optional[int] = None,
+                 source: Optional[dict] = None):
+        from ..resilience.retry import env_float, env_int
+
+        self.path = path
+        self.interval_s = max(env_float(interval_s, SERIES_INTERVAL_ENV,
+                                        DEFAULT_INTERVAL_S), 0.005)
+        self.max_rows = max(env_int(max_rows, SERIES_MAX_ROWS_ENV,
+                                    DEFAULT_MAX_ROWS), 1)
+        self.source = dict(source or {})
+        self.source.setdefault("pid", os.getpid())
+        self._lock = threading.Lock()
+        self._ring: "collections.deque" = collections.deque()
+        self._seq = 0
+        self.dropped = 0
+        self.rows_written = 0
+        self._f = None
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._warned = False
+
+    # -- sampling ----------------------------------------------------------
+
+    def sample_now(self) -> dict:
+        """Take one sample into the ring (drop-oldest past the bound)
+        and return the row.  Called by the daemon loop; callable
+        directly for deterministic tests."""
+        rss = _rss_mb()
+        if rss is not None:
+            registry().gauge("rss_mb").set(rss)
+        row = {"kind": "sample", "schema": SCHEMA_VERSION,
+               "t": round(time.time(), 6), "source": dict(self.source),
+               "metrics": registry().snapshot()}
+        with self._lock:
+            self._seq += 1
+            row["seq"] = self._seq
+            if len(self._ring) >= self.max_rows:
+                self._ring.popleft()
+                self.dropped += 1
+            row["dropped"] = self.dropped
+            self._ring.append(row)
+        return row
+
+    # -- durable file ------------------------------------------------------
+
+    def _publish(self) -> None:
+        """Create the durable file: manifest row into a tmp, fsync,
+        atomic rename, KEEP the handle — the rename moves the inode, so
+        later appends land on the published path while the publish
+        itself can never leave a torn file under the real name."""
+        tmp = self.path + ".tmp"
+        parent = os.path.dirname(os.path.abspath(self.path))
+        os.makedirs(parent, exist_ok=True)
+        f = open(tmp, "w")
+        f.write(json.dumps(
+            {"kind": "series_manifest", "schema": SCHEMA_VERSION,
+             "t0": round(time.time(), 6), "source": dict(self.source),
+             "interval_s": self.interval_s,
+             "max_rows": self.max_rows}, sort_keys=True) + "\n")
+        f.flush()
+        os.fsync(f.fileno())
+        os.replace(tmp, self.path)
+        self._f = f
+
+    def _flush(self, fsync: bool = True) -> None:
+        """Drain the pending ring to the file, one whole line per row.
+        Failures degrade (one stderr warning; rows stay ringed and the
+        bound drops the oldest) — telemetry never takes a server down."""
+        with self._lock:
+            rows = list(self._ring)
+            self._ring.clear()
+        if not rows:
+            return
+        try:
+            if self._f is None:
+                self._publish()
+            for row in rows:
+                self._f.write(json.dumps(row, sort_keys=True) + "\n")
+            self._f.flush()
+            if fsync:
+                os.fsync(self._f.fileno())
+            with self._lock:
+                self.rows_written += len(rows)
+        except (OSError, ValueError):
+            with self._lock:
+                # put the rows back (bounded) so a transient disk
+                # error loses nothing the ring can still hold
+                for row in rows:
+                    if len(self._ring) >= self.max_rows:
+                        self._ring.popleft()
+                        self.dropped += 1
+                    self._ring.append(row)
+            if not self._warned:
+                self._warned = True
+                import sys
+                print(f"adam-tpu: series not written to {self.path}",
+                      file=sys.stderr)
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> "SeriesSampler":
+        self.sample_now()
+        self._flush()
+        self._thread = threading.Thread(
+            target=self._run, name="series-sampler", daemon=True)
+        self._thread.start()
+        return self
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            self.sample_now()
+            self._flush()
+
+    def stop(self, publish: bool = True) -> Optional[dict]:
+        """Stop the daemon; with ``publish`` take one final sample,
+        flush, fsync and return the write receipt."""
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+        if not publish:
+            if self._f is not None:
+                try:
+                    self._f.close()
+                except OSError:
+                    pass
+                self._f = None
+            return None
+        self.sample_now()
+        self._flush()
+        if self._f is not None:
+            try:
+                self._f.close()
+            except OSError:
+                pass
+            self._f = None
+        return {"path": self.path, "rows": self.rows_written,
+                "dropped": self.dropped}
+
+
+# ---------------------------------------------------------------------------
+# the process-global sampler
+# ---------------------------------------------------------------------------
+
+def active() -> Optional[SeriesSampler]:
+    """``None`` (the default) means the plane is off: no thread, no
+    ring, no file — the zero-overhead state."""
+    return _SAMPLER
+
+
+def start_series(path: str, *, interval_s: Optional[float] = None,
+                 max_rows: Optional[int] = None,
+                 source: Optional[dict] = None) -> SeriesSampler:
+    """Install and start the process-global sampler (stopping any
+    previous one without a receipt — the caller owns lifecycle)."""
+    global _SAMPLER
+    if _SAMPLER is not None:
+        _SAMPLER.stop(publish=False)
+    _SAMPLER = SeriesSampler(path, interval_s=interval_s,
+                             max_rows=max_rows, source=source).start()
+    return _SAMPLER
+
+
+def stop_series() -> Optional[dict]:
+    """Stop + final flush; emits the ``series_written`` receipt through
+    the metrics plane (so a ``-metrics`` sidecar records where the
+    run's series went) and returns it."""
+    global _SAMPLER
+    s, _SAMPLER = _SAMPLER, None
+    if s is None:
+        return None
+    receipt = s.stop()
+    if receipt:
+        _events.emit("series_written", **receipt)
+    return receipt
+
+
+def discard_series() -> None:
+    """Drop an active sampler without a final sample/receipt (test
+    isolation — obs.reset_all)."""
+    global _SAMPLER
+    s, _SAMPLER = _SAMPLER, None
+    if s is not None:
+        s.stop(publish=False)
+
+
+def series_path_from(flag_value: Optional[str]) -> Optional[str]:
+    """The explicit path wins; ``ADAM_TPU_SERIES`` is the fallback (how
+    shard-fleet workers get a per-incarnation series)."""
+    return flag_value or os.environ.get(SERIES_ENV) or None
+
+
+def maybe_start_from_env() -> Optional[SeriesSampler]:
+    """Start a sampler iff ``ADAM_TPU_SERIES`` names a path and none is
+    active — the worker-process entry hook (parallel/shardstream.py)."""
+    path = series_path_from(None)
+    if not path or _SAMPLER is not None:
+        return None
+    return start_series(path)
+
+
+# ---------------------------------------------------------------------------
+# the monoid: snapshot merge + cross-source fold
+# ---------------------------------------------------------------------------
+
+def empty_snapshot() -> dict:
+    return {"counters": {}, "gauges": {}, "histograms": {}}
+
+
+def merge_snapshots(a: dict, b: dict) -> dict:
+    """PURE registry-snapshot merge — the exact law
+    ``MetricsRegistry.merge`` applies (counters sum, gauges max,
+    histograms bucket-add), on plain dicts so folds never touch the
+    process-global registry.  ``empty_snapshot()`` is the identity."""
+    out = {"counters": dict(a.get("counters") or {}),
+           "gauges": dict(a.get("gauges") or {}),
+           "histograms": {k: dict(v, buckets=dict(v.get("buckets") or {}))
+                          for k, v in (a.get("histograms") or {}).items()}}
+    for k, v in (b.get("counters") or {}).items():
+        out["counters"][k] = out["counters"].get(k, 0) + v
+    for k, v in (b.get("gauges") or {}).items():
+        out["gauges"][k] = max(out["gauges"].get(k, v), v)
+    for k, d in (b.get("histograms") or {}).items():
+        h = out["histograms"].get(k)
+        if h is None:
+            out["histograms"][k] = dict(d, buckets=dict(d.get("buckets")
+                                                        or {}))
+            continue
+        h["count"] = h.get("count", 0) + d.get("count", 0)
+        h["sum"] = h.get("sum", 0.0) + d.get("sum", 0.0)
+        for side, pick in (("min", min), ("max", max)):
+            if d.get(side) is not None:
+                h[side] = d[side] if h.get(side) is None \
+                    else pick(h[side], d[side])
+        buckets = h["buckets"]
+        for bk, n in (d.get("buckets") or {}).items():
+            buckets[bk] = buckets.get(bk, 0) + n
+    return out
+
+
+def _source_key(row: dict) -> str:
+    return json.dumps(row.get("source") or {}, sort_keys=True)
+
+
+def fold_rows(rows: Sequence[dict],
+              bucket_s: Optional[float] = None) -> List[dict]:
+    """Fold sample rows from ANY number of sources into one merged
+    series: per time bucket take each source's LAST row (cumulative
+    snapshots within one source supersede, they never add) then merge
+    across sources by the registry monoid.  A single-source series
+    folds to itself (the identity check in tools/check_series.py)."""
+    samples = [r for r in rows if isinstance(r, dict)
+               and r.get("kind") == "sample"]
+    if not samples:
+        return []
+    if bucket_s is None or bucket_s <= 0:
+        bucket_s = DEFAULT_INTERVAL_S
+    per: Dict[int, Dict[str, dict]] = {}
+    for r in samples:
+        t = r.get("t")
+        if not isinstance(t, (int, float)) or isinstance(t, bool):
+            continue
+        per.setdefault(int(t // bucket_s), {})[_source_key(r)] = r
+    out = []
+    for bucket in sorted(per):
+        by_src = per[bucket]
+        metrics = empty_snapshot()
+        for key in sorted(by_src):
+            metrics = merge_snapshots(metrics,
+                                      by_src[key].get("metrics") or {})
+        out.append({"kind": "sample", "schema": SCHEMA_VERSION,
+                    "t": max(r["t"] for r in by_src.values()),
+                    "sources": len(by_src), "metrics": metrics})
+    return out
+
+
+def read_series(path: str) -> Tuple[Optional[dict], List[dict]]:
+    """``(manifest, sample_rows)`` from a written series file.  A torn
+    final line (the crash case) is skipped; a missing/unreadable file
+    is ``(None, [])`` — readers (status/top/explain) degrade, never
+    crash."""
+    manifest = None
+    rows: List[dict] = []
+    try:
+        with open(path, encoding="utf-8", errors="replace") as f:
+            lines = f.readlines()
+    except OSError:
+        return None, []
+    for ln in lines:
+        ln = ln.strip()
+        if not ln:
+            continue
+        try:
+            doc = json.loads(ln)
+        except ValueError:
+            continue            # torn tail (or tampering): skip the line
+        if not isinstance(doc, dict):
+            continue
+        if doc.get("kind") == "series_manifest" and manifest is None:
+            manifest = doc
+        elif doc.get("kind") == "sample":
+            rows.append(doc)
+    return manifest, rows
+
+
+def fold_series_files(paths: Sequence[str],
+                      bucket_s: Optional[float] = None) -> List[dict]:
+    """Read + fold several series files (a fleet's workers) into one
+    merged series — the sidecar-merge twin of
+    ``obs.merge_metrics_file``, at every time bucket instead of once at
+    the end."""
+    rows: List[dict] = []
+    interval = None
+    for p in paths:
+        manifest, rs = read_series(p)
+        rows.extend(rs)
+        if manifest and isinstance(manifest.get("interval_s"),
+                                   (int, float)):
+            iv = float(manifest["interval_s"])
+            interval = iv if interval is None else max(interval, iv)
+    return fold_rows(rows, bucket_s=bucket_s if bucket_s else interval)

@@ -54,6 +54,13 @@ from . import events as _events
 #: env fallback for the CLI ``-trace`` flag — how bench workers and
 #: elastic worker subprocesses get a per-process timeline sidecar
 TRACE_ENV = "ADAM_TPU_TRACE"
+#: buffered-event cap — a batch transform never hits it, but an
+#: always-on server traced for days would otherwise grow the buffer
+#: unboundedly; past the cap the OLDEST events drop (the recent window
+#: is what you debug a live server with) and the count is stamped into
+#: the published doc (``droppedEvents``) and the write receipt
+TRACE_MAX_EVENTS_ENV = "ADAM_TPU_TRACE_MAX_EVENTS"
+DEFAULT_TRACE_MAX_EVENTS = 1_000_000
 
 _TRACE: "Optional[TraceCollector]" = None
 
@@ -67,10 +74,15 @@ class TraceCollector:
     :meth:`write`.
     """
 
-    def __init__(self, path: str):
+    def __init__(self, path: str, max_events: Optional[int] = None):
+        from ..resilience.retry import env_int
+
         self.path = path
         self._lock = threading.Lock()
         self._events: List[dict] = []
+        self.max_events = max(env_int(max_events, TRACE_MAX_EVENTS_ENV,
+                                      DEFAULT_TRACE_MAX_EVENTS), 1)
+        self.dropped = 0
         self._threads: dict = {}        # tid -> thread name (this process)
         self._pid = os.getpid()
         # wall-anchored clock: ts = wall0 + (perf_now - perf0), so spans
@@ -86,6 +98,16 @@ class TraceCollector:
         return (self._wall0 + (time.perf_counter() - self._perf0)) * 1e6
 
     # -- recording ---------------------------------------------------------
+
+    def _push(self, ev: dict) -> None:
+        """Ring-capped append — caller holds ``self._lock``.  Dropping
+        the oldest keeps the recent window, which is the debuggable one
+        on a long-lived server."""
+        if len(self._events) >= self.max_events:
+            overflow = len(self._events) - self.max_events + 1
+            del self._events[:overflow]
+            self.dropped += overflow
+        self._events.append(ev)
 
     def _note_thread(self) -> int:
         t = threading.current_thread()
@@ -103,7 +125,7 @@ class TraceCollector:
         if args:
             ev["args"] = args
         with self._lock:
-            self._events.append(ev)
+            self._push(ev)
 
     def instant(self, name: str, cat: str = "mark",
                 args: Optional[dict] = None) -> None:
@@ -113,14 +135,14 @@ class TraceCollector:
         if args:
             ev["args"] = args
         with self._lock:
-            self._events.append(ev)
+            self._push(ev)
 
     def counter(self, name: str, value: float) -> None:
         ev = {"name": name, "ph": "C", "cat": "counter",
               "ts": round(self.now_us(), 3), "pid": self._pid, "tid": 0,
               "args": {name: value}}
         with self._lock:
-            self._events.append(ev)
+            self._push(ev)
 
     # -- merge (workers -> coordinator) ------------------------------------
 
@@ -129,7 +151,8 @@ class TraceCollector:
         pid/tid lanes and wall-anchored timestamps)."""
         evs = [e for e in evs if isinstance(e, dict)]
         with self._lock:
-            self._events.extend(evs)
+            for e in evs:
+                self._push(e)
         return len(evs)
 
     def events(self) -> List[dict]:
@@ -148,13 +171,19 @@ class TraceCollector:
                          key=lambda e: (e.get("pid", 0), e.get("tid", 0),
                                         e.get("ts", 0.0)))
             threads = dict(self._threads)
+            dropped = self.dropped
         meta = [{"name": "process_name", "ph": "M", "pid": self._pid,
                  "tid": 0, "args": {"name": f"adam-tpu pid={self._pid}"}}]
         for tid, tname in sorted(threads.items()):
             meta.append({"name": "thread_name", "ph": "M",
                          "pid": self._pid, "tid": tid,
                          "args": {"name": tname}})
-        return {"traceEvents": meta + evs, "displayTimeUnit": "ms"}
+        doc = {"traceEvents": meta + evs, "displayTimeUnit": "ms"}
+        if dropped:
+            # the honesty stamp: a capped server trace is a WINDOW, and
+            # the doc says so (check_trace tolerates extra keys)
+            doc["droppedEvents"] = dropped
+        return doc
 
     def write(self) -> dict:
         """Atomic publish (tmp + fsync + rename via the one shared
@@ -168,10 +197,13 @@ class TraceCollector:
         atomic_write(self.path, json.dumps(doc, default=str))
         lanes = {(e.get("pid"), e.get("tid")) for e in doc["traceEvents"]
                  if e.get("ph") == "X"}
-        return {"path": self.path,
-                "events": sum(1 for e in doc["traceEvents"]
-                              if e.get("ph") != "M"),
-                "lanes": len(lanes)}
+        receipt = {"path": self.path,
+                   "events": sum(1 for e in doc["traceEvents"]
+                                 if e.get("ph") != "M"),
+                   "lanes": len(lanes)}
+        if doc.get("droppedEvents"):
+            receipt["dropped"] = doc["droppedEvents"]
+        return receipt
 
 
 # ---------------------------------------------------------------------------

@@ -909,7 +909,11 @@ def worker_main(argv: Optional[List[str]] = None) -> int:
                 argv=["shard-worker", fleet_dir, str(shard)],
                 config=dict(fleet_dir=fleet_dir, shard=shard),
                 command="shard-worker"):
-            return run_shard_worker(fleet_dir, shard)
+            obs.series.maybe_start_from_env()
+            try:
+                return run_shard_worker(fleet_dir, shard)
+            finally:
+                obs.series.stop_series()
     except faults.InjectedFault as e:
         print(f"shard-worker: {type(e).__name__}: {e}", file=sys.stderr)
         return 3
@@ -970,6 +974,13 @@ class ShardSupervisor:
         wenv[obs.METRICS_ENV] = os.path.join(
             self.fleet_dir, LOG_DIR,
             f"shard{shard}-inc{incarnation}.metrics.jsonl")
+        if obs.series.active() is not None:
+            # the live plane follows the supervisor's choice: when it
+            # samples, each incarnation writes its own series next to
+            # its metrics sidecar (fold_series_files merges them)
+            wenv[obs.SERIES_ENV] = os.path.join(
+                self.fleet_dir, LOG_DIR,
+                f"shard{shard}-inc{incarnation}.series.jsonl")
         wenv[faults.INCARNATION_ENV] = str(incarnation)
         wenv[faults.SHARD_ENV] = str(shard)
         # fleet-scoped retry policy: each host draws a DISTINCT

@@ -1,0 +1,464 @@
+"""Per-job causal timeline: join every durable artifact one job touched.
+
+The serve plane records its decisions piecemeal — the result doc says
+*what* happened, the event sidecars say *why* (``admission_selected`` /
+``placement_selected`` / ``job_requeued`` carry their pure deciders'
+full recorded inputs), the series says what the system looked like at
+the time, and the trace says where the wall went.  This module is the
+offline join: :func:`explain_job` reconstructs one job's causal
+timeline — submitted → queued behind N jobs of which tenants →
+admission/placement with recorded inputs → retries / degrades /
+requeues / steals → rung and breaker context at each step → finish —
+from the durable artifacts ALONE, so it works identically on a live
+fleet, a crashed one, or a spool copied off a shared filesystem.  The
+offline twin of the replay validators (tools/check_executor.py replays
+the decisions; ``explain`` narrates them).
+
+Attribution is honest about its certainty:
+
+* **job events** (``admission_selected``, ``placement_selected``,
+  ``job_requeued``, ``tenant_job``, ``deadline_missed``,
+  ``admission_rejected``, the ``tenant:<t>:<job>`` trace span) name the
+  job — exact;
+* **window events** (``retry_attempt``, ``degraded_dispatch``,
+  ``fault_injected`` carry a site, not a job) attach when they fall
+  inside the job's execution window *in the same sidecar*, tagged
+  ``attributed="window"`` — the honest ceiling for site-scoped events;
+* **context rows** (``overload_state``, ``breaker_state``, series
+  samples) describe the plane, not the job — tagged ``"context"``.
+
+Event times are wall-anchored through each sidecar's manifest (its
+``time`` stamp minus its relative ``t``), the same trick the trace
+plane uses, so rows from different processes land on one timeline.
+``adam-tpu explain SPOOL JOB`` and ``tools/explain_run.py`` are the
+entrypoints; docs/OBSERVABILITY.md has a worked example.
+"""
+
+from __future__ import annotations
+
+import datetime
+import glob as _glob
+import json
+import os
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from . import jobspec
+
+#: events that name their job directly (exact attribution)
+JOB_EVENTS = ("admission_selected", "placement_selected", "job_requeued",
+              "tenant_job", "deadline_missed", "admission_rejected",
+              "serve_pack_degraded")
+#: site-scoped events attributed by execution window (best effort)
+WINDOW_EVENTS = ("retry_attempt", "degraded_dispatch", "fault_injected")
+#: plane-state events shown as context around the job's window
+CONTEXT_EVENTS = ("overload_state", "breaker_state")
+
+#: slack around the job window for window/context attribution — event
+#: stamps and the derived submit time round independently
+WINDOW_SLOP_S = 0.25
+
+
+# ---------------------------------------------------------------------------
+# artifact readers (every one tolerates missing/torn files)
+# ---------------------------------------------------------------------------
+
+def _read_jsonl(path: str) -> List[dict]:
+    try:
+        with open(path, encoding="utf-8", errors="replace") as f:
+            lines = f.readlines()
+    except OSError:
+        return []
+    out = []
+    for ln in lines:
+        ln = ln.strip()
+        if not ln:
+            continue
+        try:
+            doc = json.loads(ln)
+        except ValueError:
+            continue            # torn tail of a crashed writer
+        if isinstance(doc, dict):
+            out.append(doc)
+    return out
+
+
+def _wall_anchor(rows: Sequence[dict]) -> Optional[float]:
+    """Wall time of a sidecar's t=0, from its manifest (``time`` is the
+    wall stamp at manifest emit, ``t`` the relative offset)."""
+    for r in rows:
+        if r.get("event") != "manifest" or not isinstance(
+                r.get("time"), str):
+            continue
+        t_rel = r.get("t") if isinstance(r.get("t"), (int, float)) \
+            else 0.0
+        for fmt in ("%Y-%m-%dT%H:%M:%S%z", "%Y-%m-%dT%H:%M:%S"):
+            try:
+                dt = datetime.datetime.strptime(r["time"], fmt)
+            except ValueError:
+                continue
+            if dt.tzinfo is None:
+                return time.mktime(dt.timetuple()) - t_rel
+            return dt.timestamp() - t_rel
+        return None
+    return None
+
+
+def discover_artifacts(spool: str) -> Dict[str, List[str]]:
+    """Every joinable durable artifact under a spool: event sidecars
+    (published AND in-flight ``.tmp`` — a live or crashed writer's
+    lines are exactly the interesting ones), series files (front spool
+    + fleet worker sub-spools + shard logs), and trace docs."""
+    fleet_logs = os.path.join(spool, "fleet", "logs")
+    events: List[str] = []
+    for pat in ("*.jsonl", "*.jsonl.tmp"):
+        events.extend(_glob.glob(os.path.join(spool, pat)))
+        events.extend(_glob.glob(os.path.join(fleet_logs, pat)))
+    events = [p for p in events
+              if not os.path.basename(p).startswith("series.jsonl")
+              and not p.endswith(".series.jsonl")
+              and not p.endswith(".series.jsonl.tmp")]
+    series = _glob.glob(os.path.join(spool, "series.jsonl"))
+    series.extend(_glob.glob(os.path.join(
+        spool, "fleet", "workers", "*", "spool", "series.jsonl")))
+    series.extend(_glob.glob(os.path.join(fleet_logs,
+                                          "*.series.jsonl")))
+    traces = _glob.glob(os.path.join(spool, "*.trace.json"))
+    traces.extend(_glob.glob(os.path.join(fleet_logs, "*.trace.json")))
+    return {"events": sorted(set(events)), "series": sorted(set(series)),
+            "traces": sorted(set(traces))}
+
+
+# ---------------------------------------------------------------------------
+# per-event narration
+# ---------------------------------------------------------------------------
+
+def _tenant_counts(descs: Sequence[dict]) -> str:
+    by: Dict[str, int] = {}
+    for d in descs:
+        t = str(d.get("tenant", "?"))
+        by[t] = by.get(t, 0) + 1
+    return ", ".join(f"{t}x{n}" for t, n in sorted(by.items()))
+
+
+def _narrate_admission(ev: dict, job_id: str) -> Optional[Tuple[str,
+                                                                str]]:
+    """(kind, summary) when this admission round touched the job."""
+    queued = (ev.get("inputs") or {}).get("queued") or []
+    mine = next((q for q in queued if q.get("job_id") == job_id), None)
+    for c in ev.get("cancel") or ():
+        if c.get("job_id") == job_id:
+            return ("deadline-cancel",
+                    f"admission cancelled it: queued "
+                    f"{c.get('wait_s')}s past its "
+                    f"{c.get('deadline_s')}s deadline "
+                    f"[{ev.get('reason')}]")
+    for r in ev.get("reject") or ():
+        if r.get("job_id") == job_id:
+            return ("admission-reject",
+                    f"admission rejected it [{r.get('code')}], retry "
+                    f"after {r.get('retry_after_s')}s "
+                    f"[{ev.get('reason')}]")
+    if job_id in (ev.get("admit") or ()):
+        ahead = [q for q in queued
+                 if mine is not None and q.get("seq", 0)
+                 < mine.get("seq", 0)]
+        packed = next((g for g in ev.get("pack_groups") or ()
+                       if job_id in g), None)
+        s = f"admitted behind {len(ahead)} queued job(s)"
+        if ahead:
+            s += f" ({_tenant_counts(ahead)})"
+        if packed:
+            s += f"; packed with {len(packed) - 1} other(s)"
+        return ("admission", s + f" [{ev.get('reason')}]")
+    if mine is not None:
+        return ("admission-skip",
+                f"seen queued but not admitted this round "
+                f"[{ev.get('reason')}]")
+    return None
+
+
+def _narrate_job_event(ev: dict, job_id: str) -> Optional[Tuple[str,
+                                                                str]]:
+    kind = ev.get("event")
+    if kind == "admission_selected":
+        return _narrate_admission(ev, job_id)
+    if kind == "placement_selected":
+        for jid, w in ev.get("place") or ():
+            if jid == job_id:
+                return ("placement",
+                        f"placed on worker w{w} [{ev.get('reason')}]")
+        return None
+    if kind == "job_requeued":
+        if ev.get("cause") == "steal":
+            for jid, src, dst in ev.get("moves") or ():
+                if jid == job_id:
+                    return ("steal",
+                            f"stolen from w{src} to idle w{dst} "
+                            f"[{ev.get('reason')}]")
+            return None
+        if ev.get("job_id") != job_id:
+            return None
+        return ("requeue",
+                f"{ev.get('action')} after {ev.get('cause')} at "
+                f"w{ev.get('worker', '?')} [{ev.get('reason')}]")
+    if kind == "tenant_job" and ev.get("job_id") == job_id:
+        s = (f"finished {ev.get('status')} in "
+             f"{ev.get('service_s')}s service")
+        if ev.get("queue_s") is not None:
+            s += f" after {ev.get('queue_s')}s queued"
+        if ev.get("compiles"):
+            s += f" ({ev.get('compiles')} compile(s))"
+        if ev.get("error_type"):
+            s += f" [{ev['error_type']}]"
+        return ("finish", s)
+    if kind == "deadline_missed" and ev.get("job_id") == job_id:
+        return ("deadline-cancel",
+                f"cancelled: queued {ev.get('wait_s')}s past its "
+                f"{ev.get('deadline_s')}s deadline")
+    if kind == "admission_rejected" and ev.get("job_id") == job_id:
+        return ("admission-reject",
+                f"rejected [{ev.get('code')}], retry after "
+                f"{ev.get('retry_after_s')}s")
+    if kind == "serve_pack_degraded" and job_id in (ev.get("jobs")
+                                                    or ()):
+        return ("pack-degrade",
+                f"shared dispatch failed ({ev.get('error')}); re-run "
+                "solo")
+    return None
+
+
+def _narrate_window(ev: dict) -> Tuple[str, str]:
+    kind = ev.get("event")
+    if kind == "retry_attempt":
+        return ("retry",
+                f"retry attempt {ev.get('attempt')} at "
+                f"{ev.get('site')} ({ev.get('error_kind')}) -> "
+                f"{ev.get('action')} [{ev.get('reason')}]")
+    if kind == "degraded_dispatch":
+        return ("degrade",
+                f"degraded dispatch at {ev.get('site')} after attempt "
+                f"{ev.get('attempt')} ({ev.get('error_kind')})")
+    return ("fault",
+            f"fault injected at {ev.get('site')} occurrence "
+            f"{ev.get('occurrence')}: {ev.get('fault')}")
+
+
+def _narrate_context(ev: dict) -> Tuple[str, str]:
+    if ev.get("event") == "overload_state":
+        return ("rung",
+                f"overload rung -> {ev.get('state')} "
+                f"(level {ev.get('prev_level')} -> {ev.get('level')}) "
+                f"[{ev.get('reason')}]")
+    return ("breaker",
+            f"breaker {ev.get('site')} -> {ev.get('state')} "
+            f"({ev.get('failures')} recent failure(s)) "
+            f"[{ev.get('reason')}]")
+
+
+# ---------------------------------------------------------------------------
+# the join
+# ---------------------------------------------------------------------------
+
+def _entry(t: Optional[float], source: str, kind: str, summary: str,
+           detail: dict, attributed: str = "job") -> dict:
+    return {"t": None if t is None else round(t, 6),
+            "source": source, "kind": kind, "summary": summary,
+            "attributed": attributed, "detail": detail}
+
+
+def _result_doc(spool: str, job_id: str
+                ) -> Tuple[Optional[dict], Optional[float]]:
+    """The job's durable result doc and its finish wall time (the doc
+    file's mtime — the only wall stamp a bare spool has)."""
+    doc = jobspec.read_result(spool, job_id)
+    if doc is None:
+        return None, None
+    for sub in (jobspec.DONE, jobspec.FAILED, jobspec.REJECTED):
+        p = os.path.join(spool, sub, f"{job_id}.json")
+        try:
+            return doc, os.path.getmtime(p)
+        except OSError:
+            continue
+    return doc, None
+
+
+def explain_job(spool: str, job_id: str, *,
+                events: Sequence[str] = (),
+                series: Sequence[str] = (),
+                timelines: Sequence[str] = ()) -> dict:
+    """One job's causal timeline from durable artifacts alone.
+
+    ``events``/``series``/``timelines`` ADD explicit files to the
+    spool auto-discovery (a sidecar written far from the spool via
+    ``-metrics PATH``).  Returns ``{"job_id", "found", "tenant",
+    "result", "timeline": [...]}`` with the timeline sorted by wall
+    time (un-anchorable rows sort last, in sidecar order).
+    """
+    arts = discover_artifacts(spool)
+    ev_paths = list(arts["events"]) + [p for p in events
+                                       if p not in arts["events"]]
+    se_paths = list(arts["series"]) + [p for p in series
+                                       if p not in arts["series"]]
+    tr_paths = list(arts["traces"]) + [p for p in timelines
+                                       if p not in arts["traces"]]
+
+    doc, finish_wall = _result_doc(spool, job_id)
+    tenant = (doc or {}).get("tenant")
+    out: List[dict] = []
+
+    # -- event sidecars: job events now, window/context after the
+    #    window is known
+    parsed = []
+    for p in ev_paths:
+        rows = _read_jsonl(p)
+        if rows:
+            parsed.append((os.path.basename(p), _wall_anchor(rows),
+                           rows))
+    for src, anchor, rows in parsed:
+        for ev in rows:
+            if ev.get("event") not in JOB_EVENTS:
+                continue
+            hit = _narrate_job_event(ev, job_id)
+            if hit is None:
+                continue
+            kind, summary = hit
+            t_rel = ev.get("t")
+            wall = anchor + t_rel if anchor is not None and isinstance(
+                t_rel, (int, float)) else None
+            out.append(_entry(wall, src, kind, summary, ev))
+            if kind == "finish" and wall is not None:
+                finish_wall = wall
+            if tenant is None and ev.get("tenant"):
+                tenant = ev.get("tenant")
+
+    # -- the job's execution window, for window/context attribution
+    submit_wall = None
+    queue_s = (doc or {}).get("queue_s")
+    service_s = (doc or {}).get("service_s") or (doc or {}).get(
+        "seconds")
+    if finish_wall is not None:
+        back = 0.0
+        for v in (queue_s, service_s):
+            if isinstance(v, (int, float)) and not isinstance(v, bool):
+                back += float(v)
+        submit_wall = finish_wall - back
+        out.append(_entry(submit_wall, "derived", "submit",
+                          f"submitted (derived: finish - "
+                          f"{round(back, 3)}s queue+service)",
+                          {"finish_wall": round(finish_wall, 6)}))
+    lo = None if submit_wall is None else submit_wall - WINDOW_SLOP_S
+    hi = None if finish_wall is None else finish_wall + WINDOW_SLOP_S
+
+    if lo is not None and hi is not None:
+        for src, anchor, rows in parsed:
+            if anchor is None:
+                continue
+            # window events only attach when THIS sidecar also ran the
+            # job (it holds the job's tenant_job/admission rows) — a
+            # neighbor worker's retries are not this job's story
+            ran_here = any(e.get("event") in ("tenant_job",
+                                              "admission_selected")
+                           and _narrate_job_event(e, job_id)
+                           for e in rows)
+            for ev in rows:
+                wall = None
+                if isinstance(ev.get("t"), (int, float)):
+                    wall = anchor + ev["t"]
+                if wall is None or not (lo <= wall <= hi):
+                    continue
+                if ev.get("event") in WINDOW_EVENTS and ran_here:
+                    kind, summary = _narrate_window(ev)
+                    out.append(_entry(wall, src, kind, summary, ev,
+                                      attributed="window"))
+                elif ev.get("event") in CONTEXT_EVENTS:
+                    kind, summary = _narrate_context(ev)
+                    out.append(_entry(wall, src, kind, summary, ev,
+                                      attributed="context"))
+
+    # -- series rows: the plane's shape while the job waited/ran —
+    #    only rows where the headline signals changed (the sampler
+    #    ticks every second; an unchanged row narrates nothing)
+    if lo is not None and hi is not None:
+        from ..obs import series as series_mod
+        prev = None
+        for p in se_paths:
+            _, rows = series_mod.read_series(p)
+            for r in rows:
+                t = r.get("t")
+                if not isinstance(t, (int, float)) or not (
+                        lo <= t <= hi):
+                    continue
+                g = (r.get("metrics") or {}).get("gauges") or {}
+                sig = (g.get("serve_backlog"), g.get("overload_level"),
+                       g.get("serve_inflight"))
+                if sig == prev:
+                    continue
+                prev = sig
+                out.append(_entry(
+                    t, os.path.basename(os.path.dirname(p)) or
+                    os.path.basename(p), "series",
+                    f"backlog={int(g.get('serve_backlog', 0))} "
+                    f"inflight={int(g.get('serve_inflight', 0))} "
+                    f"rung={int(g.get('overload_level', 0))} "
+                    f"rss_mb={round(g.get('rss_mb', 0))}",
+                    {"source": r.get("source")},
+                    attributed="context"))
+
+    # -- trace spans: the exact execution lane
+    span_name = None if tenant is None else f"tenant:{tenant}:{job_id}"
+    for p in tr_paths:
+        from ..obs import trace as trace_mod
+        evs = trace_mod.read_trace_events(p) or []
+        for ev in evs:
+            if ev.get("ph") != "X" or (span_name is not None
+                                       and ev.get("name") != span_name):
+                continue
+            if span_name is None and not str(ev.get("name", "")
+                                             ).endswith(f":{job_id}"):
+                continue
+            ts = ev.get("ts")
+            wall = ts / 1e6 if isinstance(ts, (int, float)) else None
+            out.append(_entry(
+                wall, os.path.basename(p), "execute",
+                f"executed {round(ev.get('dur', 0) / 1e6, 3)}s on "
+                f"pid {ev.get('pid')} lane {ev.get('tid')}", ev))
+
+    # -- the durable outcome
+    if doc is not None:
+        if doc.get("rejected"):
+            summary = (f"rejected doc [{doc.get('code')}]: retry "
+                       f"after {doc.get('retry_after_s')}s")
+        elif doc.get("ok"):
+            summary = f"result doc: ok in {doc.get('service_s')}s"
+        else:
+            summary = (f"result doc: failed "
+                       f"[{doc.get('error_type')}]: {doc.get('error')}")
+        out.append(_entry(finish_wall, "spool", "result", summary, doc))
+
+    out.sort(key=lambda e: (e["t"] is None, e["t"] or 0.0))
+    return {"job_id": job_id, "tenant": tenant,
+            "found": doc is not None or any(
+                e["attributed"] == "job" for e in out),
+            "result": doc, "timeline": out}
+
+
+def render_timeline(doc: dict) -> str:
+    """Human view: one line per step, wall-clocked, window/context
+    attribution marked (``~`` best-effort, ``·`` plane context)."""
+    lines = [f"job {doc['job_id']}"
+             + (f" (tenant {doc['tenant']})" if doc.get("tenant")
+                else "")
+             + (": no durable record found" if not doc["found"]
+                else "")]
+    mark = {"job": " ", "window": "~", "context": "·"}
+    for e in doc["timeline"]:
+        if e["t"] is not None:
+            stamp = time.strftime("%H:%M:%S",
+                                  time.localtime(e["t"]))
+            stamp += f".{int((e['t'] % 1) * 1000):03d}"
+        else:
+            stamp = "--:--:--.---"
+        lines.append(f"  {stamp} {mark.get(e['attributed'], ' ')}"
+                     f"[{e['source']}] {e['kind']}: {e['summary']}")
+    return "\n".join(lines)

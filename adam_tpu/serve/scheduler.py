@@ -74,10 +74,11 @@ from ..checkpoint import atomic_write
 from ..resilience import faults
 from ..resilience.retry import (RETRY_SEED_ENV, FleetPolicy,
                                 backoff_delay, resolve_fleet_policy)
-from . import jobspec
+from . import jobspec, status as status_mod
 from .admission import decide_admission
 from .overload import (AdmissionLimits, OverloadPolicy, OverloadTracker,
-                       resolve_admission_limits, resolve_overload_policy)
+                       resolve_admission_limits, resolve_overload_policy,
+                       rss_mb)
 
 #: fleet-dir layout (everything lives under ``SPOOL/fleet/``)
 FLEET_DIR = "fleet"
@@ -385,7 +386,8 @@ def worker_main(argv: Optional[List[str]] = None) -> int:
                 # shared config), quotas and the ladder stay off
                 limits=AdmissionLimits(fair=bool(cfg.get("fair",
                                                          True))),
-                overload=OverloadPolicy(backlog_hi=0))
+                overload=OverloadPolicy(backlog_hi=0),
+                series=bool(cfg.get("series", True)))
             sched_pid = int(cfg.get("scheduler_pid") or 0)
             while not jobspec.stop_requested(wspool):
                 # short idle re-entries so the orphan check runs even
@@ -401,6 +403,9 @@ def worker_main(argv: Optional[List[str]] = None) -> int:
                             "serve-worker: scheduler gone — exiting "
                             "orphaned loop\n")
                         break
+            # final sample + receipt into this worker's sidecar; a
+            # killed worker's series keeps its already-fsynced rows
+            obs.series.stop_series()
             return 0
     except faults.InjectedFault as e:
         print(f"serve-worker: {type(e).__name__}: {e}", file=sys.stderr)
@@ -446,7 +451,8 @@ class FleetServeScheduler:
                  boot_grace_s: float = 60.0,
                  drain_timeout_s: float = 60.0,
                  limits: Optional[AdmissionLimits] = None,
-                 overload: Optional[OverloadPolicy] = None):
+                 overload: Optional[OverloadPolicy] = None,
+                 series: bool = True):
         self.spool = jobspec.ensure_spool(spool)
         self.fleet_dir = os.path.join(spool, FLEET_DIR)
         self.hosts = max(int(hosts), 1)
@@ -492,6 +498,19 @@ class FleetServeScheduler:
         self._canon_cache: Dict[str, dict] = {}
         self._poll_round = 0
         self._booted = False
+        #: live telemetry (docs/OBSERVABILITY.md): the scheduler's own
+        #: series at SPOOL/series.jsonl (workers write theirs under
+        #: their sub-spools), a throttled fleet-wide status.json, and
+        #: periodic SLO-report checkpoints — a SIGKILL'd fleet keeps
+        #: the tails and the per-worker state it had already measured
+        self.series = bool(series)
+        self._status_every = status_mod.status_interval_s()
+        self._report_every = status_mod.report_interval_s()
+        self._last_status: Optional[float] = None
+        self._last_report: Optional[float] = None
+        self._reported_jobs = 0
+        self._last_backlog = 0
+        self._tenant_backlog: Dict[str, int] = {}
 
     # -- boot ---------------------------------------------------------------
 
@@ -513,6 +532,7 @@ class FleetServeScheduler:
                          executor_opts=self.executor_opts,
                          heartbeat_s=self.policy.heartbeat_s,
                          fair=self.limits.fair,
+                         series=self.series,
                          scheduler_pid=os.getpid()), sort_keys=True))
         for w in range(self.hosts):
             st = _WorkerState(w)
@@ -526,6 +546,10 @@ class FleetServeScheduler:
                                      requeued=requeued),
                                 sort_keys=True))
         self._booted = True
+        if self.series and obs.series.active() is None:
+            obs.series.start_series(
+                os.path.join(self.spool, "series.jsonl"),
+                source={"role": "scheduler"})
         return dict(hosts=self.hosts, requeued=requeued)
 
     def _recover_previous_fleet(self) -> int:
@@ -833,6 +857,14 @@ class FleetServeScheduler:
 
     def _place_round(self) -> int:
         queued = self._front_queue()
+        # live signals for the series sampler / status doc (front-door
+        # backlog only; worker sub-spool depths ride the status doc)
+        self._last_backlog = len(queued)
+        tb: Dict[str, int] = {}
+        for _, _, c in queued:
+            tb[c["tenant"]] = tb.get(c["tenant"], 0) + 1
+        self._tenant_backlog = tb
+        obs.registry().gauge("serve_backlog").set(len(queued))
         if self.overload.engaged:
             self.overload.update(len(queued))
         if not queued:
@@ -1287,13 +1319,91 @@ class FleetServeScheduler:
                     except OSError:
                         pass
 
-    def write_report(self) -> Optional[str]:
+    def write_report(self, *, quiet: bool = False) -> Optional[str]:
         # same file name as the single-host server's shutdown report —
         # clients poll one well-known path whatever the fleet size
         from .server import SLO_REPORT_FILE, write_slo_report
         return write_slo_report(
             os.path.join(self.spool, SLO_REPORT_FILE), self._slo,
-            hosts=self.hosts, jobs=self.jobs_served)
+            hosts=self.hosts, jobs=self.jobs_served, quiet=quiet)
+
+    # -- live status ---------------------------------------------------------
+
+    def _status_doc(self) -> dict:
+        """The fleet-wide durable live-state doc: the solo server's
+        rows plus per-worker lease health and the active jobs each
+        worker would be charged for on a kill
+        (docs/FLEET_SERVE.md)."""
+        from ..resilience.retry import breaker_snapshot
+
+        now = time.time()
+        workers = []
+        for w, st in sorted(self.states.items()):
+            q, r = self._worker_inflight(w)
+            try:
+                lease_age = round(now - os.path.getmtime(
+                    _lease_path(self.fleet_dir, w)), 3)
+            except OSError:
+                lease_age = None
+            workers.append({"worker": w, "alive": self._alive(st),
+                            "incarnation": st.incarnation,
+                            "restarts": st.restarts,
+                            "lease_age_s": lease_age,
+                            "queued": len(q), "running": len(r),
+                            "active": jobspec.read_active(
+                                worker_spool(self.fleet_dir, w))})
+        from .server import slo_summary
+        tenants: Dict[str, dict] = {}
+        for name, ten in slo_summary(self._slo).items():
+            tenants[name] = dict(ten)
+        # fresh front-queue count, not the round snapshot: the final
+        # exit-time doc must show the drained queue (per-tenant depth
+        # stays the snapshot — attribution needs the spec bodies)
+        try:
+            backlog = sum(
+                1 for n in os.listdir(os.path.join(self.spool,
+                                                   jobspec.QUEUE))
+                if n.endswith(".json"))
+        except OSError:
+            backlog = self._last_backlog
+        for name, depth in self._tenant_backlog.items():
+            tenants.setdefault(name, {})["queued"] = \
+                depth if backlog else 0
+        for ten in tenants.values():
+            ten.setdefault("queued", 0)
+        return {"mode": "fleet", "warm": self._booted,
+                "hosts": self.hosts,
+                "jobs_served": self.jobs_served,
+                "backlog": backlog,
+                "max_concurrent": self.max_concurrent,
+                "worker_depth": self.worker_depth,
+                "sharded": len(self._shards),
+                "overload": status_mod.overload_doc(self.overload),
+                "breakers": breaker_snapshot(),
+                "tenants": tenants, "workers": workers,
+                "rss_mb": rss_mb()}
+
+    def _tick_status(self) -> None:
+        """Once per scheduler round: the throttled status.json rewrite
+        and the periodic SLO-report checkpoint (the exit-only-report
+        fix — a SIGKILL now loses at most one interval of tails)."""
+        now = time.monotonic()
+        if self._status_every > 0 and (
+                self._last_status is None
+                or now - self._last_status >= self._status_every):
+            self._last_status = now
+            status_mod.write_status(self.spool, self._status_doc(),
+                                    interval_s=self._status_every)
+        if self._report_every > 0 and (
+                self._last_report is None
+                or now - self._last_report >= self._report_every):
+            self._last_report = now
+            if self.jobs_served != self._reported_jobs:
+                self._reported_jobs = self.jobs_served
+                path = self.write_report(quiet=True)
+                if path:
+                    obs.emit("serve_report_checkpoint", path=path,
+                             jobs=self.jobs_served, reason="periodic")
 
     def run(self, *, max_jobs: Optional[int] = None,
             idle_timeout_s: Optional[float] = None) -> int:
@@ -1318,6 +1428,7 @@ class FleetServeScheduler:
                 if self._place_round():
                     idle_since = time.monotonic()
                 self._steal_round()
+                self._tick_status()
                 if idle_timeout_s is not None and \
                         time.monotonic() - idle_since >= idle_timeout_s:
                     break
@@ -1330,7 +1441,13 @@ class FleetServeScheduler:
                     self.poll_s, seed=self._poll_round))
         finally:
             self._drain()
-            self.write_report()
+            path = self.write_report()
+            if path:
+                obs.emit("serve_report_checkpoint", path=path,
+                         jobs=self.jobs_served, reason="final")
+            if self._status_every > 0:
+                status_mod.write_status(self.spool, self._status_doc(),
+                                        interval_s=self._status_every)
         return self.jobs_served - served0
 
 

@@ -40,10 +40,11 @@ from ..errors import FormatError, malformed_count, reset_malformed
 from ..resilience import faults
 from ..resilience.faults import InjectedFault
 from ..resilience.retry import backoff_delay
-from . import jobspec
+from . import jobspec, status as status_mod
 from .admission import DEFAULT_PACK_SEGMENTS, decide_admission
 from .overload import (AdmissionLimits, OverloadPolicy, OverloadTracker,
-                       resolve_admission_limits, resolve_overload_policy)
+                       resolve_admission_limits, resolve_overload_policy,
+                       rss_mb)
 from .packed import SharedDispatchError, packed_flagstat
 
 #: the per-tenant SLO shutdown report file name (single-host serve
@@ -157,11 +158,12 @@ def retire_rejected(spool: str, slo: dict, path: str, canon: dict,
 
 
 def write_slo_report(path: str, slo: dict, *, hosts: int,
-                     jobs: int) -> Optional[str]:
-    """The serve shutdown report: per-tenant tail-latency percentiles,
-    written atomically next to the spool.  Telemetry discipline: a
-    failed write degrades to one stderr line, never fails a finished
-    serve run."""
+                     jobs: int, quiet: bool = False) -> Optional[str]:
+    """The serve SLO report: per-tenant tail-latency percentiles,
+    written atomically next to the spool — at shutdown AND as periodic
+    checkpoints (``quiet=True``: the checkpoint path must not narrate
+    every few seconds).  Telemetry discipline: a failed write degrades
+    to one stderr line, never fails a finished serve run."""
     doc = {"hosts": int(hosts), "jobs": int(jobs),
            "tenants": slo_summary(slo)}
     try:
@@ -170,6 +172,8 @@ def write_slo_report(path: str, slo: dict, *, hosts: int,
         import sys
         sys.stderr.write(f"serve: SLO report write failed: {e}\n")
         return None
+    if quiet:
+        return path
     from ..instrument import say
     for tenant, ten in doc["tenants"].items():
         q, s = ten.get("queue_s"), ten.get("service_s")
@@ -190,7 +194,8 @@ class ServeServer:
                  executor_opts: Optional[dict] = None,
                  slo_report: bool = True,
                  limits: Optional[AdmissionLimits] = None,
-                 overload: Optional[OverloadPolicy] = None):
+                 overload: Optional[OverloadPolicy] = None,
+                 series: bool = True):
         self.spool = jobspec.ensure_spool(spool)
         self.chunk_rows = int(chunk_rows)
         self.max_concurrent = max(int(max_concurrent), 1)
@@ -222,6 +227,18 @@ class ServeServer:
         self._canon_cache: Dict[str, dict] = {}
         self._poll_round = 0
         self._booted = False
+        #: the live telemetry plane (docs/OBSERVABILITY.md): an
+        #: obs/series sampler over SPOOL/series.jsonl plus a throttled
+        #: atomic SPOOL/status.json every round and periodic SLO-report
+        #: checkpoints — a SIGKILL'd server keeps what it measured
+        self.series = bool(series)
+        self._status_every = status_mod.status_interval_s()
+        self._report_every = status_mod.report_interval_s()
+        self._last_status: Optional[float] = None
+        self._last_report: Optional[float] = None
+        self._reported_jobs = 0
+        self._last_backlog = 0
+        self._tenant_backlog: Dict[str, int] = {}
         #: the paged layout's cross-round page pool (packed_flagstat's
         #: pool_holder): ONE resident device allocation for the serve
         #: lifetime — steady state means only new tenants' rows ever
@@ -250,6 +267,10 @@ class ServeServer:
                      json.dumps({"pid": os.getpid(), **info},
                                 sort_keys=True, default=str))
         self._booted = True
+        if self.series and obs.series.active() is None:
+            obs.series.start_series(
+                os.path.join(self.spool, "series.jsonl"),
+                source={"role": "serve"})
         return info
 
     # -- the loop -----------------------------------------------------------
@@ -269,6 +290,7 @@ class ServeServer:
                 None if max_jobs is None
                 else max(max_jobs - (self.jobs_served - served_at_entry),
                          0))
+            self._tick_status()
             if n:
                 idle_since = time.monotonic()
             if max_jobs is not None and \
@@ -286,11 +308,76 @@ class ServeServer:
                 time.sleep(backoff_delay(
                     f"{self.spool}|idle-poll", 1, self.poll_s,
                     self.poll_s, seed=self._poll_round))
+        if self._status_every > 0:
+            status_mod.write_status(self.spool, self._status_doc(),
+                                    interval_s=self._status_every)
         if self.slo_report and self.jobs_served:
-            write_slo_report(
+            path = write_slo_report(
                 os.path.join(self.spool, SLO_REPORT_FILE), self.slo,
                 hosts=1, jobs=self.jobs_served)
+            if path:
+                obs.emit("serve_report_checkpoint", path=path,
+                         jobs=self.jobs_served, reason="final")
         return self.jobs_served - served_at_entry
+
+    # -- live status --------------------------------------------------------
+
+    def _status_doc(self) -> dict:
+        """The durable live-state doc (serve/status.py owns the file
+        discipline; docs/FLEET_SERVE.md tabulates the rows)."""
+        from ..resilience.retry import breaker_snapshot
+
+        tenants: Dict[str, dict] = {}
+        for name, ten in slo_summary(self.slo).items():
+            tenants[name] = dict(ten)
+        # fresh queue-dir count, not the round snapshot: the final
+        # exit-time doc must show the drained queue, not the backlog
+        # the last round admitted FROM (per-tenant depth stays the
+        # round snapshot — attribution needs the spec bodies)
+        try:
+            backlog = sum(
+                1 for n in os.listdir(os.path.join(self.spool,
+                                                   jobspec.QUEUE))
+                if n.endswith(".json"))
+        except OSError:
+            backlog = self._last_backlog
+        for name, depth in self._tenant_backlog.items():
+            tenants.setdefault(name, {})["queued"] = \
+                depth if backlog else 0
+        for ten in tenants.values():
+            ten.setdefault("queued", 0)
+        return {"mode": "solo", "warm": self._booted,
+                "jobs_served": self.jobs_served,
+                "backlog": backlog,
+                "max_concurrent": self.max_concurrent,
+                "overload": status_mod.overload_doc(self.overload),
+                "breakers": breaker_snapshot(),
+                "tenants": tenants, "rss_mb": rss_mb()}
+
+    def _tick_status(self) -> None:
+        """Once per loop iteration: throttle the status.json rewrite
+        and the periodic SLO-report checkpoint (the fix for the
+        exit-only report — a kill now loses at most one interval)."""
+        now = time.monotonic()
+        if self._status_every > 0 and (
+                self._last_status is None
+                or now - self._last_status >= self._status_every):
+            self._last_status = now
+            status_mod.write_status(self.spool, self._status_doc(),
+                                    interval_s=self._status_every)
+        if self.slo_report and self._report_every > 0 and (
+                self._last_report is None
+                or now - self._last_report >= self._report_every):
+            self._last_report = now
+            if self.jobs_served != self._reported_jobs:
+                self._reported_jobs = self.jobs_served
+                path = write_slo_report(
+                    os.path.join(self.spool, SLO_REPORT_FILE),
+                    self.slo, hosts=1, jobs=self.jobs_served,
+                    quiet=True)
+                if path:
+                    obs.emit("serve_report_checkpoint", path=path,
+                             jobs=self.jobs_served, reason="periodic")
 
     def _snapshot_queue(self) -> tuple:
         """Admission-ready queue snapshot: ``(descriptors, by_id)``
@@ -342,6 +429,15 @@ class ServeServer:
         typed rejections and deadline cancellations included (each
         leaves a durable doc a client is waiting on)."""
         queued, by_id = self._snapshot_queue()
+        # live signals for the series sampler / status doc: gauges are
+        # max-merged across a fleet, so the fold reports the deepest
+        # worker backlog (the pressure signal, not the sum)
+        self._last_backlog = len(queued)
+        tb: Dict[str, int] = {}
+        for d in queued:
+            tb[d["tenant"]] = tb.get(d["tenant"], 0) + 1
+        self._tenant_backlog = tb
+        obs.registry().gauge("serve_backlog").set(len(queued))
         if self.overload.engaged:
             self.overload.update(len(queued))
         if not queued:
@@ -390,16 +486,22 @@ class ServeServer:
             if running is not None:
                 claimed[job_id] = (running, canon)
         packed_ids = {j for g in plan["pack_groups"] for j in g}
-        for group in plan["pack_groups"]:
-            members = [(claimed[j][0], claimed[j][1])
-                       for j in group if j in claimed]
-            done += self._run_packed(members)
-        for job_id in plan["admit"]:
-            if job_id in packed_ids or job_id not in claimed:
-                continue
-            running, canon = claimed[job_id]
-            self._run_solo(running, canon)
-            done += 1
+        # the in-flight gauge brackets execution so the sampler thread
+        # catches mid-dispatch rows; the loop itself is synchronous
+        obs.registry().gauge("serve_inflight").set(len(claimed))
+        try:
+            for group in plan["pack_groups"]:
+                members = [(claimed[j][0], claimed[j][1])
+                           for j in group if j in claimed]
+                done += self._run_packed(members)
+            for job_id in plan["admit"]:
+                if job_id in packed_ids or job_id not in claimed:
+                    continue
+                running, canon = claimed[job_id]
+                self._run_solo(running, canon)
+                done += 1
+        finally:
+            obs.registry().gauge("serve_inflight").set(0)
         return done
 
     # -- execution ----------------------------------------------------------

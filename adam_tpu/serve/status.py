@@ -1,0 +1,285 @@
+"""Durable live status for the serve plane: ``status.json`` + readers.
+
+Until this PR the serve stack's durable telemetry was all *post-mortem*
+(sidecars and ``serve_report.json`` publish at process exit), so a live
+or crashed server answered no question about its current state.  This
+module is the status half of the live plane (obs/series.py is the
+time-series half): every serve round the :class:`ServeServer` / fleet
+scheduler throttles an atomic ``status.json`` write into the spool —
+warm state, backlog, per-tenant queue depth and SLO window tails, the
+brownout rung, breaker states, and (fleet) per-worker lease health with
+the active jobs each worker would charge on a kill.
+
+The doc is the WHOLE interface: ``adam-tpu status|top`` and any shared-
+filesystem observer render purely from it (plus ``serving.json``, the
+report, dir counts and the series tail), so the same view works on a
+live fleet, a SIGKILL'd one, or from another host.  Writers degrade on
+error (telemetry never takes a server down); readers treat every file
+as possibly missing or stale and say so (:func:`liveness`).
+
+Knobs: ``ADAM_TPU_SERVE_STATUS_S`` (status cadence, default 1.0, <=0
+disables) and ``ADAM_TPU_SERVE_REPORT_S`` (the periodic
+``serve_report.json`` checkpoint cadence, default 5.0, <=0 restores the
+old exit-only behavior).  docs/FLEET_SERVE.md tabulates the doc rows.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Dict, List, Optional
+
+from ..checkpoint import atomic_write
+from ..resilience.retry import env_float
+from . import jobspec
+from .overload import LEVEL_NAMES
+
+STATUS_FILE = "status.json"
+SCHEMA_VERSION = 1
+STATUS_INTERVAL_ENV = "ADAM_TPU_SERVE_STATUS_S"
+REPORT_INTERVAL_ENV = "ADAM_TPU_SERVE_REPORT_S"
+DEFAULT_STATUS_S = 1.0
+DEFAULT_REPORT_S = 5.0
+
+#: a status doc older than this many write-intervals from a live pid
+#: renders STALE — the loop is wedged (or the clock skewed), either way
+#: the doc no longer describes "now"
+STALE_INTERVALS = 5.0
+
+#: the spool job-state dirs, in lifecycle order (jobspec owns the names)
+SPOOL_STATE_DIRS = (jobspec.QUEUE, jobspec.RUNNING, jobspec.DONE,
+                    jobspec.FAILED, jobspec.REJECTED)
+
+
+def status_interval_s(explicit: Optional[float] = None) -> float:
+    return env_float(explicit, STATUS_INTERVAL_ENV, DEFAULT_STATUS_S)
+
+
+def report_interval_s(explicit: Optional[float] = None) -> float:
+    return env_float(explicit, REPORT_INTERVAL_ENV, DEFAULT_REPORT_S)
+
+
+def overload_doc(tracker) -> dict:
+    """The rung as a doc row: numeric level + its name + how close the
+    ladder is to stepping down (serve/overload.LEVEL_NAMES)."""
+    level = int(getattr(tracker, "level", 0))
+    return {"level": level,
+            "state": LEVEL_NAMES[min(level, len(LEVEL_NAMES) - 1)],
+            "calm_rounds": int(getattr(tracker, "calm_rounds", 0))}
+
+
+def write_status(spool: str, doc: dict, *,
+                 interval_s: Optional[float] = None) -> Optional[str]:
+    """Atomically publish ``SPOOL/status.json``.  ``fsync=False``: the
+    doc is a freshness signal rewritten every second or so — the rename
+    still guarantees readers never see a torn doc, and skipping the
+    double fsync keeps the write off the round's critical path.  A
+    failed write degrades to one stderr line."""
+    out = dict(doc)
+    out.setdefault("schema", SCHEMA_VERSION)
+    out.setdefault("pid", os.getpid())
+    out["written_at"] = round(time.time(), 6)
+    if interval_s is not None:
+        out["interval_s"] = round(float(interval_s), 6)
+    path = os.path.join(spool, STATUS_FILE)
+    try:
+        atomic_write(path, json.dumps(out, sort_keys=True, default=str),
+                     fsync=False)
+    except OSError as e:
+        import sys
+        sys.stderr.write(f"serve: status write failed: {e}\n")
+        return None
+    return path
+
+
+def read_status(spool: str) -> Optional[dict]:
+    try:
+        with open(os.path.join(spool, STATUS_FILE)) as f:
+            doc = json.load(f)
+    except (OSError, ValueError):
+        return None
+    return doc if isinstance(doc, dict) else None
+
+
+def pid_alive(pid) -> bool:
+    if not isinstance(pid, int) or pid <= 0:
+        return False
+    try:
+        os.kill(pid, 0)
+    except ProcessLookupError:
+        return False
+    except PermissionError:
+        return True             # exists, just not ours
+    except OSError:
+        return False
+    return True
+
+
+def liveness(doc: Optional[dict],
+             now: Optional[float] = None) -> str:
+    """``LIVE`` / ``STALE`` / ``DEAD`` / ``UNKNOWN`` from the doc alone
+    — DEAD means the writing pid is gone (the SIGKILL case), STALE
+    means the pid exists but stopped refreshing the doc."""
+    if not doc:
+        return "UNKNOWN"
+    if not pid_alive(doc.get("pid")):
+        return "DEAD"
+    written = doc.get("written_at")
+    if not isinstance(written, (int, float)) or isinstance(written, bool):
+        return "STALE"
+    interval = doc.get("interval_s")
+    if not isinstance(interval, (int, float)) or interval <= 0:
+        interval = DEFAULT_STATUS_S
+    age = (time.time() if now is None else now) - written
+    return "LIVE" if age <= max(STALE_INTERVALS * interval, 5.0) \
+        else "STALE"
+
+
+def _read_json(path: str) -> Optional[dict]:
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, ValueError):
+        return None
+    return doc if isinstance(doc, dict) else None
+
+
+def _dir_counts(spool: str) -> Dict[str, int]:
+    out = {}
+    for d in SPOOL_STATE_DIRS:
+        try:
+            out[d] = sum(1 for n in os.listdir(os.path.join(spool, d))
+                         if n.endswith(".json"))
+        except OSError:
+            out[d] = 0
+    return out
+
+
+def _series_tail(spool: str) -> Optional[dict]:
+    """The last sample of the spool's series, reduced to the headline
+    gauges — what a crashed spool still knows about its final seconds
+    even when ``status.json`` never got written."""
+    from ..obs import series
+
+    _, rows = series.read_series(os.path.join(spool, "series.jsonl"))
+    if not rows:
+        return None
+    last = rows[-1]
+    gauges = (last.get("metrics") or {}).get("gauges") or {}
+    tail = {"t": last.get("t"), "rows": len(rows),
+            "dropped": last.get("dropped", 0)}
+    for g in ("serve_backlog", "serve_inflight", "overload_level",
+              "rss_mb"):
+        if g in gauges:
+            tail[g] = gauges[g]
+    return tail
+
+
+def collect_status(spool: str) -> dict:
+    """Everything the CLI views render, joined from durable artifacts
+    only: the status doc + liveness verdict, the boot receipt
+    (``serving.json``), the latest SLO report (exit doc or checkpoint),
+    spool dir counts, and the series tail."""
+    from .server import SLO_REPORT_FILE
+
+    doc = read_status(spool)
+    return {"spool": os.path.abspath(spool),
+            "status": doc,
+            "liveness": liveness(doc),
+            "serving": _read_json(os.path.join(spool,
+                                               jobspec.SERVING_MARKER)),
+            "report": _read_json(os.path.join(spool, SLO_REPORT_FILE)),
+            "counts": _dir_counts(spool),
+            "series": _series_tail(spool)}
+
+
+# ---------------------------------------------------------------------------
+# rendering (adam-tpu status / top)
+# ---------------------------------------------------------------------------
+
+def _fmt_pct(t: dict, key: str) -> str:
+    d = t.get(key)
+    if not isinstance(d, dict):
+        return "-"
+    return f"{d.get('p50', 0):.3f}/{d.get('p99', 0):.3f}"
+
+
+def _tenant_rows(tenants: Dict[str, dict]) -> List[str]:
+    lines = ["  tenant            queued  jobs  queue p50/p99     "
+             "service p50/p99   miss  rej"]
+    for name in sorted(tenants):
+        t = tenants[name] or {}
+        lines.append(
+            f"  {name:<17} {t.get('queued', 0):>6}  "
+            f"{t.get('jobs', 0):>4}  {_fmt_pct(t, 'queue_s'):<17} "
+            f"{_fmt_pct(t, 'service_s'):<17} "
+            f"{t.get('deadline_missed', 0):>4}  "
+            f"{t.get('rejected', 0):>3}")
+    return lines
+
+
+def render_status(view: dict) -> str:
+    """The human one-shot view — every number traceable to a durable
+    doc field (docs/OBSERVABILITY.md)."""
+    doc = view.get("status") or {}
+    live = view.get("liveness", "UNKNOWN")
+    lines = [f"spool: {view.get('spool')}"]
+    mode = doc.get("mode", "?")
+    pid = doc.get("pid", "?")
+    head = f"state: {live}  mode: {mode}  pid: {pid}"
+    if isinstance(doc.get("written_at"), (int, float)):
+        head += f"  status_age: {time.time() - doc['written_at']:.1f}s"
+    lines.append(head)
+    if not doc:
+        lines.append("  (no status.json — server never ticked; "
+                     "showing spool artifacts only)")
+    else:
+        ov = doc.get("overload") or {}
+        lines.append(
+            f"warm: {doc.get('warm')}  jobs_served: "
+            f"{doc.get('jobs_served', 0)}  backlog: "
+            f"{doc.get('backlog', 0)}  rung: "
+            f"{ov.get('state', 'normal')}({ov.get('level', 0)})  "
+            f"rss_mb: {round(doc.get('rss_mb') or 0, 1)}")
+        brk = doc.get("breakers") or {}
+        open_b = {k: v for k, v in brk.items() if v != "closed"}
+        if open_b:
+            lines.append("breakers: " + ", ".join(
+                f"{k}={v}" for k, v in sorted(open_b.items())))
+        tenants = doc.get("tenants") or {}
+        if tenants:
+            lines.extend(_tenant_rows(tenants))
+        workers = doc.get("workers")
+        if isinstance(workers, list):
+            lines.append("  worker  alive  inc  restarts  lease_age  "
+                         "queued  running  active")
+            for w in workers:
+                act = ",".join(w.get("active") or []) or "-"
+                lease = w.get("lease_age_s")
+                lease_s = f"{lease:.1f}s" if isinstance(
+                    lease, (int, float)) else "-"
+                lines.append(
+                    f"  {str(w.get('worker', '?')):<6}  "
+                    f"{str(bool(w.get('alive'))):<5}  "
+                    f"{w.get('incarnation', 0):>3}  "
+                    f"{w.get('restarts', 0):>8}  {lease_s:>9}  "
+                    f"{w.get('queued', 0):>6}  "
+                    f"{w.get('running', 0):>7}  {act}")
+    counts = view.get("counts") or {}
+    lines.append("spool: " + "  ".join(
+        f"{d}={counts.get(d, 0)}" for d in SPOOL_STATE_DIRS))
+    tail = view.get("series")
+    if tail:
+        age = time.time() - tail["t"] if isinstance(
+            tail.get("t"), (int, float)) else float("nan")
+        lines.append(
+            f"series: {tail['rows']} row(s), last {age:.1f}s ago"
+            + (f", dropped {tail['dropped']}" if tail.get("dropped")
+               else ""))
+    rep = view.get("report")
+    if rep:
+        lines.append(f"report: jobs={rep.get('jobs', 0)} "
+                     f"hosts={rep.get('hosts', 0)} "
+                     f"tenants={len(rep.get('tenants') or {})}")
+    return "\n".join(lines)

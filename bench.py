@@ -1500,6 +1500,59 @@ def _stage_serve_warm(kind: str, is_tpu: bool):
         out["serve_warm_recompiles"] = sum(compiles[1:]) \
             if len(compiles) == k else None
 
+        # -- telemetry-honesty leg: the SAME warm workload with the
+        # sampling plane fully off (-no_series + status writes
+        # disabled).  The warm leg above ran with series+status at
+        # default cadence, so the delta IS the sampler's cost — the
+        # gate pins it inside noise (an always-on plane that taxes the
+        # hot path would get turned off, and then it observes nothing)
+        out["serve_series_on_wall_s"] = out["serve_warm_job_wall_s"]
+        series_rows = 0
+        try:
+            with open(os.path.join(spool, "series.jsonl")) as f:
+                for ln in f:
+                    try:
+                        d = json.loads(ln)
+                    except ValueError:
+                        continue
+                    if d.get("kind") == "sample":
+                        series_rows += 1
+        except OSError:
+            pass
+        out["serve_series_rows"] = series_rows
+        spool_off = os.path.join(tmp, "spool_off")
+        env_off = dict(env, ADAM_TPU_SERVE_STATUS_S="0")
+        server = subprocess.Popen(
+            [sys.executable, "-m", "adam_tpu", "serve", spool_off,
+             "-max_jobs", str(k), "-idle_timeout", "240",
+             "-poll_s", "0.01", "-no_series"],
+            cwd=root, env=env_off, stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL)
+        marker = os.path.join(spool_off, jobspec.SERVING_MARKER)
+        deadline = time.monotonic() + 120
+        while not os.path.exists(marker):
+            if time.monotonic() > deadline or server.poll() is not None:
+                raise RuntimeError("no-series serve never became ready")
+            time.sleep(0.05)
+        off_walls = []
+        for i in range(k):
+            t0 = time.perf_counter()
+            job = jobspec.submit_job(spool_off, {
+                "tenant": f"t{i}", "command": "flagstat",
+                "input": pq_dir, "args": {}})
+            jobspec.wait_result(spool_off, job, timeout_s=240.0,
+                                poll_s=0.005)
+            off_walls.append(round(time.perf_counter() - t0, 3))
+        server.wait(timeout=60)
+        out["serve_series_off_wall_s"] = round(
+            statistics.median(off_walls[1:]), 3)
+        out["serve_series_overhead_s"] = round(
+            out["serve_series_on_wall_s"] -
+            out["serve_series_off_wall_s"], 3)
+        # the off leg must not have left a series behind
+        out["serve_series_off_inert"] = not os.path.exists(
+            os.path.join(spool_off, "series.jsonl"))
+
         # -- packed leg: two tenants co-submitted, admitted in one
         # round, counters folded from shared dispatches
         spool2 = os.path.join(tmp, "spool2")

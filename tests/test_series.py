@@ -1,0 +1,258 @@
+"""The time-series sampling plane (ISSUE 16 tentpole piece 1).
+
+Pins, per docs/OBSERVABILITY.md:
+
+* the sampler is inert when never started — no thread, no file, no
+  registry cost (always-on telemetry must be zero-overhead when off);
+* the in-memory ring is bounded: past ``max_rows`` the OLDEST samples
+  drop and the cumulative ``dropped`` count rides every later row (the
+  file never lies about its own completeness);
+* rows are exact monoid elements: ``merge_snapshots`` has
+  ``empty_snapshot()`` as identity and is associative, and
+  ``fold_series_files`` folds two fleet workers' series the same way
+  the metrics sidecar merge folds their counters (sum), gauges (max)
+  and histograms (bucket-add);
+* ``obs.reset_all()`` discards an active sampler (test isolation —
+  the autouse fixture must never leak a daemon thread across tests);
+* the trace ring cap (``ADAM_TPU_TRACE_MAX_EVENTS``) drops oldest
+  and stamps ``droppedEvents`` into the published doc;
+* tools/check_series.py accepts every published series and rejects
+  seq-regression / counter-decrease / mid-file corruption.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import json
+import os
+import pathlib
+import threading
+
+from adam_tpu import obs
+from adam_tpu.obs import series, trace
+
+ROOT = pathlib.Path(__file__).resolve().parents[1]
+
+_spec = importlib.util.spec_from_file_location(
+    "check_series", ROOT / "tools" / "check_series.py")
+check_series = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(check_series)
+
+
+def _rows(path):
+    with open(path) as f:
+        return [json.loads(ln) for ln in f if ln.strip()]
+
+
+# ---------------------------------------------------------------------------
+# off = inert
+# ---------------------------------------------------------------------------
+
+def test_sampler_off_is_inert(tmp_path):
+    """Never started: no global sampler, no file, stop is a no-op, and
+    registry traffic spawns no thread."""
+    assert series.active() is None
+    assert series.stop_series() is None
+    n0 = threading.active_count()
+    obs.registry().counter("x").inc()
+    obs.registry().gauge("g").set(1)
+    assert threading.active_count() == n0
+    assert series.active() is None
+    assert not list(tmp_path.glob("*.jsonl"))
+
+
+def test_maybe_start_from_env_requires_env(tmp_path, monkeypatch):
+    monkeypatch.delenv(series.SERIES_ENV, raising=False)
+    assert series.maybe_start_from_env() is None
+    p = tmp_path / "w.series.jsonl"
+    monkeypatch.setenv(series.SERIES_ENV, str(p))
+    s = series.maybe_start_from_env()
+    try:
+        assert s is series.active()
+    finally:
+        receipt = series.stop_series()
+    assert receipt["path"] == str(p)
+    assert os.path.exists(p)
+    # the stop emitted its receipt and cleared the global
+    assert series.active() is None
+
+
+# ---------------------------------------------------------------------------
+# bounded ring
+# ---------------------------------------------------------------------------
+
+def test_ring_drops_oldest_and_counts(tmp_path):
+    p = str(tmp_path / "series.jsonl")
+    s = series.SeriesSampler(p, interval_s=60.0, max_rows=3,
+                             source={"role": "t"})
+    for i in range(5):
+        obs.registry().counter("ticks").inc()
+        s.sample_now()
+    receipt = s.stop()          # final sample -> 6 total, ring of 3
+    rows = [r for r in _rows(p) if r.get("kind") == "sample"]
+    assert receipt["dropped"] == 3
+    assert len(rows) == 3
+    # survivors are the NEWEST; seq strictly increasing; every row
+    # carries the cumulative drop count known at its sample time
+    assert [r["seq"] for r in rows] == sorted(r["seq"] for r in rows)
+    assert rows[-1]["seq"] == 6         # 5 explicit + the stop() sample
+    assert rows[-1]["dropped"] == 3
+    # cumulative snapshots: the last row saw every inc
+    assert rows[-1]["metrics"]["counters"]["ticks"] == 5
+    assert check_series.validate(p) == []
+
+
+def test_published_file_survives_and_validates(tmp_path):
+    p = str(tmp_path / "series.jsonl")
+    s = series.start_series(p, interval_s=60.0, source={"role": "x"})
+    obs.registry().histogram("queue_s").observe(0.25)
+    obs.registry().histogram("queue_s").observe(0.75)
+    s.sample_now()
+    receipt = series.stop_series()
+    assert receipt["rows"] >= 2 and receipt["dropped"] == 0
+    manifest, rows = series.read_series(p)
+    assert manifest["kind"] == "series_manifest"
+    assert manifest["source"]["role"] == "x"
+    assert manifest["source"]["pid"] == os.getpid()
+    assert rows and rows[-1]["metrics"]["histograms"]["queue_s"][
+        "count"] == 2
+    assert check_series.validate(p) == []
+
+
+# ---------------------------------------------------------------------------
+# monoid laws + fleet fold
+# ---------------------------------------------------------------------------
+
+def _snap(counters=None, gauges=None):
+    return {"counters": counters or {}, "gauges": gauges or {},
+            "histograms": {}}
+
+
+def test_merge_identity_and_associativity():
+    a = _snap({"jobs": 3}, {"backlog": 5})
+    b = _snap({"jobs": 2, "other": 1}, {"backlog": 2, "rss": 100})
+    c = _snap({"other": 4})
+    e = series.empty_snapshot()
+    assert series.merge_snapshots(e, a) == a
+    assert series.merge_snapshots(a, e) == a
+    ab_c = series.merge_snapshots(series.merge_snapshots(a, b), c)
+    a_bc = series.merge_snapshots(a, series.merge_snapshots(b, c))
+    assert ab_c == a_bc
+    assert ab_c["counters"] == {"jobs": 5, "other": 5}
+    assert ab_c["gauges"] == {"backlog": 5, "rss": 100}
+
+
+def test_fold_two_worker_series(tmp_path):
+    """Two fleet workers' series fold like the sidecar metrics merge:
+    per bucket, each source's LAST (cumulative) row supersedes its
+    earlier ones, then sources merge by the registry monoid."""
+    paths = []
+    for w, (n_jobs, backlog) in enumerate([(3, 7), (5, 2)]):
+        p = str(tmp_path / f"w{w}.series.jsonl")
+        obs.reset_all()
+        s = series.SeriesSampler(p, interval_s=0.5,
+                                 source={"worker": w})
+        for i in range(n_jobs):
+            obs.registry().counter("tenant_jobs", tenant="a").inc()
+            s.sample_now()      # intermediate cumulative rows
+        obs.registry().gauge("serve_backlog").set(backlog)
+        obs.registry().histogram("service_s").observe(0.1 * (w + 1))
+        s.sample_now()
+        s.stop()
+        paths.append(p)
+    folded = series.fold_series_files(paths, bucket_s=1e9)
+    assert len(folded) == 1     # one giant bucket folds everything
+    m = folded[0]["metrics"]
+    assert m["counters"]["tenant_jobs{tenant=a}"] == 8   # 3 + 5 summed
+    assert m["gauges"]["serve_backlog"] == 7             # max, not sum
+    assert m["histograms"]["service_s"]["count"] == 2    # bucket-add
+    assert folded[0]["sources"] == 2
+    for p in paths:
+        assert check_series.validate(p) == []
+
+
+def test_reset_all_discards_active_sampler(tmp_path):
+    series.start_series(str(tmp_path / "series.jsonl"),
+                        interval_s=60.0)
+    assert series.active() is not None
+    obs.reset_all()
+    assert series.active() is None
+
+
+# ---------------------------------------------------------------------------
+# validator rejections
+# ---------------------------------------------------------------------------
+
+def test_check_series_rejects_corruption(tmp_path):
+    p = str(tmp_path / "series.jsonl")
+    s = series.SeriesSampler(p, interval_s=60.0, source={"r": "t"})
+    obs.registry().counter("jobs").inc(5)
+    s.sample_now()
+    obs.registry().counter("jobs").inc()
+    s.sample_now()
+    s.stop()
+    docs = _rows(p)
+
+    def rewrite(path, rows):
+        with open(path, "w") as f:
+            for d in rows:
+                f.write(json.dumps(d) + "\n")
+
+    # counter decrease (a non-cumulative row) is caught
+    bad = json.loads(json.dumps(docs))
+    bad[-1]["metrics"]["counters"]["jobs"] = 1
+    b1 = str(tmp_path / "bad1.series.jsonl")
+    rewrite(b1, bad)
+    assert any("decreases" in e for e in check_series.validate(b1))
+
+    # seq regression is caught
+    bad = json.loads(json.dumps(docs))
+    bad[-1]["seq"] = bad[-2]["seq"]
+    b2 = str(tmp_path / "bad2.series.jsonl")
+    rewrite(b2, bad)
+    assert any("seq" in e for e in check_series.validate(b2))
+
+    # a torn FINAL line is a crash artifact, not corruption...
+    b3 = str(tmp_path / "bad3.series.jsonl")
+    with open(b3, "w") as f:
+        for d in docs:
+            f.write(json.dumps(d) + "\n")
+        f.write('{"kind": "sample", "tor')
+    assert check_series.validate(b3) == []
+    # ...but a torn MIDDLE line is corruption
+    b4 = str(tmp_path / "bad4.series.jsonl")
+    with open(b4, "w") as f:
+        f.write(json.dumps(docs[0]) + "\n")
+        f.write('{"kind": "sample", "tor\n')
+        for d in docs[1:]:
+            f.write(json.dumps(d) + "\n")
+    assert any("mid-file" in e for e in check_series.validate(b4))
+
+
+# ---------------------------------------------------------------------------
+# trace ring cap (satellite: the OTHER unbounded buffer)
+# ---------------------------------------------------------------------------
+
+def test_trace_ring_cap_drops_oldest(tmp_path, monkeypatch):
+    monkeypatch.setenv(trace.TRACE_MAX_EVENTS_ENV, "4")
+    p = str(tmp_path / "run.trace.json")
+    tc = trace.TraceCollector(p)
+    assert tc.max_events == 4
+    for i in range(10):
+        tc.instant(f"e{i}")
+    receipt = tc.write()
+    assert receipt["dropped"] == 6
+    with open(p) as f:
+        doc = json.load(f)
+    assert doc["droppedEvents"] == 6
+    names = [e["name"] for e in doc["traceEvents"]
+             if e.get("ph") == "i"]
+    assert names == [f"e{i}" for i in range(6, 10)]   # newest survive
+
+
+def test_trace_uncapped_by_default(tmp_path):
+    tc = trace.TraceCollector(str(tmp_path / "t.trace.json"))
+    assert tc.max_events == trace.DEFAULT_TRACE_MAX_EVENTS
+    for i in range(100):
+        tc.instant(f"e{i}")
+    assert tc.dropped == 0

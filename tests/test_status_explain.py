@@ -1,0 +1,384 @@
+"""The durable status plane + the per-job run explainer (ISSUE 16).
+
+Pins, per docs/OBSERVABILITY.md and docs/FLEET_SERVE.md:
+
+* ``status.json`` is written every round (not only at exit), carries
+  the full schema (mode/warm/backlog/overload/breakers/tenants), and
+  the CLI's liveness verdict is honest: LIVE for a fresh doc from a
+  live pid, STALE for a wedged writer, DEAD after a SIGKILL;
+* the periodic ``serve_report.json`` checkpoint survives a SIGKILL'd
+  server — the cited regression: the report used to exist only if the
+  loop exited cleanly;
+* ``adam-tpu status`` renders correct state from durable docs alone,
+  live AND crashed (the same artifacts, no IPC);
+* ``explain_job`` reconstructs a chaos run's causal timeline —
+  queued-behind-N with tenants, admission/placement with recorded
+  inputs, window-attributed retries, requeues, rung changes — from a
+  scripted event sidecar + result doc, ordered by wall time.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from adam_tpu import obs
+from adam_tpu.serve import ServeServer, jobspec
+from adam_tpu.serve import status as status_mod
+from adam_tpu.serve.explain import (discover_artifacts, explain_job,
+                                    render_timeline)
+
+CHUNK = 1 << 14
+
+
+def _synth_reads(path, n=2048, seed=7):
+    from adam_tpu.io.parquet import DatasetWriter
+
+    rng = np.random.RandomState(seed)
+    with DatasetWriter(str(path), part_rows=1 << 15) as w:
+        w.write(pa.table({
+            "flags": pa.array(rng.randint(
+                0, 1 << 11, size=n).astype(np.uint32), pa.uint32()),
+            "mapq": pa.array(rng.randint(0, 61, size=n), pa.int32()),
+            "referenceId": pa.array(rng.randint(0, 24, size=n),
+                                    pa.int32()),
+            "mateReferenceId": pa.array(rng.randint(0, 24, size=n),
+                                        pa.int32()),
+        }))
+    return str(path)
+
+
+# ---------------------------------------------------------------------------
+# status doc mechanics (no server needed)
+# ---------------------------------------------------------------------------
+
+def test_write_read_status_roundtrip(tmp_path):
+    spool = str(tmp_path)
+    p = status_mod.write_status(spool, {"mode": "solo", "backlog": 2},
+                                interval_s=0.5)
+    assert p and os.path.exists(p)
+    doc = status_mod.read_status(spool)
+    assert doc["mode"] == "solo" and doc["backlog"] == 2
+    assert doc["schema"] == status_mod.SCHEMA_VERSION
+    assert doc["pid"] == os.getpid()
+    assert doc["interval_s"] == 0.5
+    assert isinstance(doc["written_at"], float)
+
+
+def test_liveness_verdicts(tmp_path):
+    assert status_mod.liveness(None) == "UNKNOWN"
+    now = time.time()
+    fresh = {"pid": os.getpid(), "written_at": now, "interval_s": 1.0}
+    assert status_mod.liveness(fresh, now=now) == "LIVE"
+    # wedged: pid alive but the doc stopped refreshing
+    old = dict(fresh, written_at=now - 60.0)
+    assert status_mod.liveness(old, now=now) == "STALE"
+    # SIGKILL'd: the writing pid is gone
+    dead = dict(fresh, pid=2 ** 22 - 17)
+    assert status_mod.liveness(dead, now=now) == "DEAD"
+
+
+def test_render_handles_empty_spool(tmp_path):
+    view = status_mod.collect_status(str(tmp_path))
+    out = status_mod.render_status(view)
+    assert "UNKNOWN" in out
+    assert "no status.json" in out
+
+
+# ---------------------------------------------------------------------------
+# in-process solo serve: the doc the loop actually writes
+# ---------------------------------------------------------------------------
+
+def test_solo_server_writes_status_and_series(tmp_path, monkeypatch):
+    monkeypatch.setenv(status_mod.STATUS_INTERVAL_ENV, "0.01")
+    ds = _synth_reads(tmp_path / "reads")
+    spool = str(tmp_path / "spool")
+    jobspec.submit_job(spool, {"job_id": "j1", "tenant": "acme",
+                               "command": "flagstat", "input": ds})
+    srv = ServeServer(spool, chunk_rows=CHUNK, poll_s=0.01)
+    srv.boot()
+    assert srv.run(max_jobs=1) == 1
+    obs.series.stop_series()    # publish the sampler the server started
+
+    doc = status_mod.read_status(spool)
+    assert doc["mode"] == "solo" and doc["warm"] is True
+    assert doc["jobs_served"] == 1
+    assert doc["backlog"] == 0          # exit doc shows the DRAINED queue
+    assert doc["overload"]["state"] == "normal"
+    assert isinstance(doc["breakers"], dict)
+    assert doc["tenants"]["acme"]["jobs"] == 1
+    assert doc["tenants"]["acme"]["queued"] == 0
+    assert status_mod.liveness(doc) == "LIVE"   # we ARE the pid
+
+    view = status_mod.collect_status(spool)
+    out = status_mod.render_status(view)
+    assert "mode: solo" in out and "jobs_served: 1" in out
+    assert "acme" in out and "done=1" in out
+
+    # the sampler the server booted published a durable series
+    assert view["series"] is not None and view["series"]["rows"] >= 1
+    assert os.path.exists(os.path.join(spool, "series.jsonl"))
+
+
+# ---------------------------------------------------------------------------
+# THE regression: a SIGKILL'd server leaves report + status behind
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_sigkill_leaves_durable_report_and_status(tmp_path):
+    """Serve one job with fast checkpoint cadence, SIGKILL the server,
+    and assert the durable plane answers for the corpse: status.json
+    (DEAD), the checkpointed serve_report.json, the series file, and
+    an `adam-tpu status` render — all without any live process."""
+    ds = _synth_reads(tmp_path / "reads")
+    spool = str(tmp_path / "spool")
+    jobspec.submit_job(spool, {"job_id": "jk", "tenant": "acme",
+                               "command": "flagstat", "input": ds})
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               ADAM_TPU_SERVE_STATUS_S="0.05",
+               ADAM_TPU_SERVE_REPORT_S="0.05",
+               ADAM_TPU_SERIES_INTERVAL_S="0.05")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "adam_tpu", "serve", spool,
+         "-metrics", os.path.join(spool, "serve.metrics.jsonl")],
+        env=env, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+    try:
+        report = os.path.join(spool, "serve_report.json")
+        deadline = time.monotonic() + 180
+        while time.monotonic() < deadline:
+            if jobspec.read_result(spool, "jk") and \
+                    os.path.exists(report):
+                break
+            if proc.poll() is not None:
+                pytest.fail("server exited before the kill")
+            time.sleep(0.05)
+        else:
+            pytest.fail("job/report never appeared")
+        time.sleep(0.3)         # a couple more status/series ticks
+        proc.send_signal(signal.SIGKILL)
+        proc.wait(timeout=30)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=30)
+
+    # the checkpointed report survived the kill (the cited bug: it
+    # used to be written only at clean loop exit)
+    with open(report) as f:
+        rep = json.load(f)
+    assert rep["jobs"] >= 1 and "acme" in rep["tenants"]
+
+    doc = status_mod.read_status(spool)
+    assert doc is not None and doc["jobs_served"] >= 1
+    assert status_mod.liveness(doc) == "DEAD"
+
+    out = subprocess.run(
+        [sys.executable, "-m", "adam_tpu", "status", spool],
+        env=env, capture_output=True, text=True)
+    assert out.returncode == 0
+    assert "DEAD" in out.stdout and "jobs_served: 1" in out.stdout
+
+    # the series file published and validates (torn tail tolerated)
+    sp = os.path.join(spool, "series.jsonl")
+    assert os.path.exists(sp)
+    chk = subprocess.run(
+        [sys.executable, os.path.join(os.path.dirname(__file__), "..",
+                                      "tools", "check_series.py"), sp],
+        capture_output=True, text=True)
+    assert chk.returncode == 0, chk.stderr
+
+    # and explain reconstructs the job from the corpse's artifacts —
+    # including the UNPUBLISHED .tmp sidecar the kill left behind
+    doc = explain_job(spool, "jk")
+    assert doc["found"]
+    kinds = {e["kind"] for e in doc["timeline"]}
+    assert "result" in kinds and "admission" in kinds
+
+
+# ---------------------------------------------------------------------------
+# the explainer against a scripted chaos run
+# ---------------------------------------------------------------------------
+
+def _manifest_row(wall0):
+    return {"event": "manifest", "t": 0.0, "schema": 1,
+            "time": time.strftime("%Y-%m-%dT%H:%M:%S%z",
+                                  time.localtime(wall0)),
+            "argv": ["serve"], "config": {},
+            "config_fingerprint": "ab12", "host": "h", "pid": 4242}
+
+
+def test_explain_scripted_chaos_timeline(tmp_path):
+    """A hand-scripted sidecar exercising every attribution rule: the
+    job queued behind two other-tenant jobs, admitted, placed, retried
+    (window), requeued after a worker death, finished — with a rung
+    change as context — must come back as one correctly ordered,
+    correctly attributed timeline."""
+    spool = str(tmp_path / "spool")
+    ds = _synth_reads(tmp_path / "reads", n=64)
+    J = "00000003-acme"
+    jobspec.submit_job(spool, {"job_id": J, "tenant": "acme",
+                               "command": "flagstat", "input": ds})
+    _, qpath, spec = next(jobspec.iter_queue(spool))
+    jobspec.claim_job(spool, qpath)
+    jobspec.write_result(spool, jobspec.canon_spec(spec), ok=True,
+                         result={"report": "x"}, seconds=1.0,
+                         queue_s=1.5, service_s=1.0)
+
+    wall0 = time.time() - 100.0
+    queued = [{"job_id": "00000001-b", "tenant": "beta", "seq": 1},
+              {"job_id": "00000002-b", "tenant": "beta", "seq": 2},
+              {"job_id": J, "tenant": "acme", "seq": 3}]
+    rows = [
+        _manifest_row(wall0),
+        {"event": "admission_selected", "t": 1.0, "admit": [J],
+         "pack_groups": [], "reason": "drr 3/3",
+         "inputs": {"queued": queued}, "input_digest": "ab"},
+        {"event": "placement_selected", "t": 1.2, "place": [[J, 1]],
+         "reason": "least-loaded", "inputs": {}, "input_digest": "cd"},
+        {"event": "overload_state", "t": 1.4, "level": 1,
+         "state": "shed_batch", "prev_level": 0, "changed": True,
+         "calm_rounds": 0, "pressure": {}, "actions": ["shed_batch"],
+         "reason": "backlog", "inputs": {}, "input_digest": "ee"},
+        {"event": "retry_attempt", "t": 2.0, "site": "device_dispatch",
+         "label": "flagstat", "attempt": 1, "error_kind": "transient",
+         "error": "boom", "action": "retry", "delay_s": 0.01,
+         "reason": "transient", "inputs": {}, "input_digest": "ff"},
+        {"event": "job_requeued", "t": 2.4, "cause": "worker_death",
+         "action": "requeue", "job_id": J, "worker": 1,
+         "reason": "worker 1 died", "inputs": {"job_id": J},
+         "input_digest": "aa"},
+        {"event": "tenant_job", "t": 3.0, "job_id": J,
+         "tenant": "acme", "command": "flagstat", "status": "ok",
+         "seconds": 1.0, "compiles": 1, "service_s": 1.0,
+         "queue_s": 1.5},
+    ]
+    side = os.path.join(spool, "chaos.metrics.jsonl")
+    with open(side, "w") as f:
+        for r in rows:
+            f.write(json.dumps(r) + "\n")
+
+    doc = explain_job(spool, J)
+    assert doc["found"] and doc["tenant"] == "acme"
+    by_kind = {e["kind"]: e for e in doc["timeline"]}
+
+    # queued-behind-N, with the blocking tenants named
+    adm = by_kind["admission"]
+    assert "behind 2 queued" in adm["summary"]
+    assert "betax2" in adm["summary"]
+    assert adm["attributed"] == "job"
+    # placement + requeue + finish are exact-attributed
+    assert "worker w1" in by_kind["placement"]["summary"]
+    assert "worker_death" in by_kind["requeue"]["summary"]
+    assert "finished ok" in by_kind["finish"]["summary"]
+    # the retry is honest best-effort: window attribution
+    assert by_kind["retry"]["attributed"] == "window"
+    assert "attempt 1" in by_kind["retry"]["summary"]
+    # the rung change is context, not blamed on the job
+    assert by_kind["rung"]["attributed"] == "context"
+    assert "shed_batch" in by_kind["rung"]["summary"]
+    # the result doc rides the timeline too
+    assert "result" in by_kind
+
+    # wall-ordered: every anchored step in sidecar order
+    ts = [e["t"] for e in doc["timeline"] if e["t"] is not None]
+    assert ts == sorted(ts)
+    order = [e["kind"] for e in doc["timeline"]
+             if e["kind"] in ("submit", "admission", "placement",
+                              "retry", "requeue", "finish")]
+    assert order == ["submit", "admission", "placement", "retry",
+                     "requeue", "finish"]
+
+    out = render_timeline(doc)
+    assert J in out and "admission" in out and "~" in out
+
+
+def test_explain_unknown_job(tmp_path):
+    spool = str(tmp_path / "spool")
+    jobspec.ensure_spool(spool)
+    doc = explain_job(spool, "nope")
+    assert not doc["found"] and doc["timeline"] == []
+    assert "no durable record" in render_timeline(doc)
+
+
+def test_discover_artifacts_shapes(tmp_path):
+    spool = str(tmp_path / "spool")
+    logs = os.path.join(spool, "fleet", "logs")
+    os.makedirs(logs)
+    open(os.path.join(spool, "a.metrics.jsonl"), "w").close()
+    open(os.path.join(spool, "b.metrics.jsonl.tmp"), "w").close()
+    open(os.path.join(spool, "series.jsonl"), "w").close()
+    open(os.path.join(logs, "w0-inc0.metrics.jsonl"), "w").close()
+    open(os.path.join(logs, "shard0-inc0.series.jsonl"), "w").close()
+    open(os.path.join(spool, "run.trace.json"), "w").close()
+    arts = discover_artifacts(spool)
+    names = [os.path.basename(p) for p in arts["events"]]
+    assert "a.metrics.jsonl" in names
+    assert "b.metrics.jsonl.tmp" in names       # crashed writers count
+    assert "w0-inc0.metrics.jsonl" in names
+    assert "series.jsonl" not in names          # routed to series, not events
+    assert "shard0-inc0.series.jsonl" not in names
+    series_names = [os.path.basename(p) for p in arts["series"]]
+    assert "series.jsonl" in series_names
+    assert "shard0-inc0.series.jsonl" in series_names
+    assert [os.path.basename(p) for p in arts["traces"]] == \
+        ["run.trace.json"]
+
+
+# ---------------------------------------------------------------------------
+# fleet: status doc with worker rows + the cross-worker series fold
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_fleet_status_and_worker_series_fold(tmp_path, monkeypatch):
+    """A real 2-worker fleet: the scheduler's status doc carries the
+    per-worker rows, each worker publishes its own series into its
+    sub-spool, and fold_series_files merges them by the registry
+    monoid (counters SUM across workers — the fleet-wide job count
+    falls out of the fold, not out of trusting any one worker)."""
+    import glob as _glob
+
+    from adam_tpu.obs import series
+    from adam_tpu.serve.scheduler import FleetServeScheduler
+
+    monkeypatch.setenv(status_mod.STATUS_INTERVAL_ENV, "0.01")
+    monkeypatch.setenv(series.SERIES_INTERVAL_ENV, "0.05")
+    ds = _synth_reads(tmp_path / "reads", n=4096)
+    spool = str(tmp_path / "spool")
+    for i in range(2):
+        jobspec.submit_job(spool, {"job_id": f"f{i}",
+                                   "tenant": f"t{i}",
+                                   "command": "flagstat", "input": ds})
+    sched = FleetServeScheduler(spool, hosts=2, chunk_rows=CHUNK,
+                                poll_s=0.02)
+    assert sched.run(max_jobs=2, idle_timeout_s=120.0) == 2
+    series.stop_series()        # the scheduler's own front-door sampler
+
+    doc = status_mod.read_status(spool)
+    assert doc["mode"] == "fleet" and doc["hosts"] == 2
+    assert doc["jobs_served"] == 2 and doc["backlog"] == 0
+    workers = doc["workers"]
+    assert [w["worker"] for w in workers] == [0, 1]
+    for w in workers:
+        assert {"alive", "incarnation", "restarts", "queued",
+                "running", "active"} <= set(w)
+    out = status_mod.render_status(status_mod.collect_status(spool))
+    assert "mode: fleet" in out and "worker" in out
+
+    wfiles = sorted(_glob.glob(os.path.join(
+        spool, "fleet", "workers", "*", "spool", "series.jsonl")))
+    assert len(wfiles) == 2, "every worker publishes its own series"
+    folded = series.fold_series_files(wfiles, bucket_s=1e9)
+    assert folded, "fold produced no rows"
+    counters = folded[-1]["metrics"]["counters"]
+    served = sum(v for k, v in counters.items()
+                 if k.startswith("serve_jobs"))
+    assert served == 2          # 1 + 1, summed across workers
+    assert folded[-1]["sources"] == 2

@@ -395,6 +395,33 @@ def _check_serve_artifact(path: str) -> int:
               "jobs 2+ must reuse the compiled shapes (compile-count "
               "delta 0)", file=sys.stderr)
         rc = 1
+    # telemetry-honesty pin (conditional: artifacts regenerated before
+    # the sampling plane existed carry no series fields): the always-on
+    # sampler must cost NOTHING measurable on the warm path, and must
+    # actually have sampled
+    on_w = doc.get("serve_series_on_wall_s")
+    off_w = doc.get("serve_series_off_wall_s")
+    if isinstance(on_w, (int, float)) and isinstance(off_w,
+                                                     (int, float)):
+        budget = max(1.5 * off_w, off_w + 0.5)
+        if on_w > budget:
+            print(f"bench_gate: series-on warm wall {on_w}s exceeds "
+                  f"{budget:.3f}s (series-off {off_w}s) in {path} — "
+                  "the always-on sampler is taxing the warm hot path",
+                  file=sys.stderr)
+            rc = 1
+        rows = doc.get("serve_series_rows")
+        if not (isinstance(rows, int) and rows >= 1):
+            print(f"bench_gate: serve_series_rows {rows!r} in {path} "
+                  "— the series-on leg never sampled (the plane was "
+                  "silently off, so the overhead pin proves nothing)",
+                  file=sys.stderr)
+            rc = 1
+        if doc.get("serve_series_off_inert") is not True:
+            print(f"bench_gate: serve_series_off_inert is not true in "
+                  f"{path} — '-no_series' still wrote a series file "
+                  "(off must mean OFF)", file=sys.stderr)
+            rc = 1
     if rc == 0:
         print(f"serve gate: warm job {speedup}x >= "
               f"{SERVE_REQUIRED_SPEEDUP}x cold (job 2+ medians, "

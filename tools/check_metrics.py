@@ -122,6 +122,12 @@ elastic worker sidecars).  Contract checked here:
   (closed/open/half_open), ``failures`` (int >= 0), ``reason``,
   ``inputs`` + hex ``input_digest`` (replayed by
   tools/check_executor.py);
+* ``series_written`` events carry ``path`` (str), ``rows`` (int >= 0)
+  and ``dropped`` (int >= 0) — the receipt for the run's time-series
+  file (validated separately by tools/check_series.py);
+* ``serve_report_checkpoint`` events carry ``path`` (str), ``jobs``
+  (int >= 0) and ``reason`` (periodic/final) — the SLO report was
+  checkpointed durably mid-serve, not only at exit;
 * the last line is the ``summary``: ``wall_seconds``, ``ok``, and a
   ``metrics`` snapshot whose counters/gauges are numeric and whose
   histograms are internally consistent (count == sum of bucket counts);
@@ -171,6 +177,7 @@ KNOWN_EVENTS = (
     "pages_selected", "h2d_bytes",
     "overload_state", "admission_rejected", "deadline_missed",
     "breaker_state",
+    "series_written", "serve_report_checkpoint",
 )
 
 #: mirror of adam_tpu.resilience.faults.SITES / FAULTS (kept literal so
@@ -529,6 +536,13 @@ def validate(path: str) -> List[str]:
                         and v >= 0):
                     err(i, f"trace_written missing non-negative int "
                            f"{field!r}")
+            dr = d.get("dropped")
+            if dr is not None and not (
+                    isinstance(dr, int) and not isinstance(dr, bool)
+                    and dr >= 1):
+                err(i, "trace_written 'dropped' must be a positive "
+                       "int when present (the ring-cap overflow "
+                       "count)")
         elif ev == "shard_plan_selected":
             for field in ("n_hosts", "n_units", "unit_rows"):
                 v = d.get(field)
@@ -817,6 +831,26 @@ def validate(path: str) -> List[str]:
                        "(decision must be replayable)")
             if not _is_hex(d.get("input_digest")):
                 err(i, "breaker_state missing hex 'input_digest'")
+        elif ev == "series_written":
+            if not isinstance(d.get("path"), str):
+                err(i, "series_written missing string 'path'")
+            for field in ("rows", "dropped"):
+                v = d.get(field)
+                if not (isinstance(v, int) and not isinstance(v, bool)
+                        and v >= 0):
+                    err(i, f"series_written missing non-negative int "
+                           f"{field!r}")
+        elif ev == "serve_report_checkpoint":
+            if not isinstance(d.get("path"), str):
+                err(i, "serve_report_checkpoint missing string 'path'")
+            jobs = d.get("jobs")
+            if not (isinstance(jobs, int) and not isinstance(jobs, bool)
+                    and jobs >= 0):
+                err(i, "serve_report_checkpoint missing non-negative "
+                       "int 'jobs'")
+            if d.get("reason") not in ("periodic", "final"):
+                err(i, f"serve_report_checkpoint unknown reason "
+                       f"{d.get('reason')!r} (periodic/final)")
         elif ev == "startup_seconds":
             for k, v in d.items():
                 if k in ("event", "t"):

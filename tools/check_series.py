@@ -1,0 +1,286 @@
+#!/usr/bin/env python3
+"""Validate an adam-tpu time-series file (``series.jsonl``, schema 1).
+
+The replay-validator convention of tools/check_metrics.py and
+tools/check_trace.py, applied to the sampling plane
+(adam_tpu/obs/series.py, docs/OBSERVABILITY.md): the rows a serve run
+sampled must be loadable AND obey the laws the fleet merge relies on —
+each row is a CUMULATIVE registry snapshot (an exact monoid element),
+so counters may never decrease, sequence numbers may never repeat, and
+folding a row into the empty snapshot must reproduce the row exactly.
+
+Contract checked:
+
+* line 1 is the ``series_manifest``: ``schema == 1``, numeric ``t0``,
+  ``interval_s > 0``, ``max_rows >= 1``, ``source`` an object;
+* every other line is a ``sample`` row: ``schema == 1``, numeric ``t``,
+  int ``seq >= 0``, int ``dropped >= 0``, and a ``metrics`` snapshot
+  object with ``counters``/``gauges``/``histograms`` maps;
+* per source, ``t`` is non-decreasing, ``seq`` strictly increasing and
+  ``dropped`` non-decreasing (rows drop oldest-first, never uncount);
+* counters are numeric and >= 0, and NON-DECREASING across a source's
+  rows (cumulative snapshots — the monoid law the sidecar merge
+  assumes); gauges are numeric;
+* histograms are internally consistent (``count`` == sum of bucket
+  counts, ``count``/``sum`` non-decreasing per source, ``min <= max``
+  when count > 0);
+* merging any row into the empty snapshot reproduces the row
+  (the monoid identity law, checked with a literal mirror of
+  ``obs.series.merge_snapshots`` — this file imports nothing from the
+  package, like every validator here);
+* a torn FINAL line is tolerated (a SIGKILL'd writer's tail is exactly
+  the artifact this plane exists to survive); a torn middle line is a
+  corruption error.
+
+Usage::
+
+    python tools/check_series.py SPOOL/series.jsonl [...]
+
+Exit 0 when every file validates; 1 otherwise, one error line per
+violation.  Used by tests/test_series.py so the documented schema and
+the produced schema cannot drift.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from typing import Dict, List
+
+_NUM = (int, float)
+
+
+def _is_num(v) -> bool:
+    return isinstance(v, _NUM) and not isinstance(v, bool)
+
+
+def _is_int(v) -> bool:
+    return isinstance(v, int) and not isinstance(v, bool)
+
+
+def _merge(a: dict, b: dict) -> dict:
+    """Literal mirror of adam_tpu.obs.series.merge_snapshots (counters
+    sum, gauges max, histograms fold) — kept import-free like
+    check_metrics' _FAULT_SITES mirror."""
+    out = json.loads(json.dumps(a))        # deep copy via round-trip
+    for name, v in (b.get("counters") or {}).items():
+        out.setdefault("counters", {})
+        out["counters"][name] = out["counters"].get(name, 0) + v
+    for name, v in (b.get("gauges") or {}).items():
+        out.setdefault("gauges", {})
+        prev = out["gauges"].get(name)
+        out["gauges"][name] = v if prev is None else max(prev, v)
+    for name, h in (b.get("histograms") or {}).items():
+        out.setdefault("histograms", {})
+        o = out["histograms"].get(name)
+        if o is None:
+            out["histograms"][name] = json.loads(json.dumps(h))
+            continue
+        o["count"] = o.get("count", 0) + h.get("count", 0)
+        o["sum"] = o.get("sum", 0) + h.get("sum", 0)
+        for k in ("min",):
+            if h.get(k) is not None:
+                o[k] = h[k] if o.get(k) is None else min(o[k], h[k])
+        for k in ("max",):
+            if h.get(k) is not None:
+                o[k] = h[k] if o.get(k) is None else max(o[k], h[k])
+        for bk, bc in (h.get("buckets") or {}).items():
+            o.setdefault("buckets", {})
+            o["buckets"][bk] = o["buckets"].get(bk, 0) + bc
+    return out
+
+
+def _check_snapshot(where: str, m, errs: List[str]) -> None:
+    if not isinstance(m, dict):
+        errs.append(f"{where}: 'metrics' is not a snapshot object")
+        return
+    for sect in ("counters", "gauges", "histograms"):
+        if not isinstance(m.get(sect), dict):
+            errs.append(f"{where}: snapshot missing {sect!r} map")
+            return
+    for name, v in m["counters"].items():
+        if not (_is_num(v) and v >= 0):
+            errs.append(f"{where}: counter {name!r} not a "
+                        "non-negative number")
+    for name, v in m["gauges"].items():
+        if not _is_num(v):
+            errs.append(f"{where}: gauge {name!r} not numeric")
+    for name, h in m["histograms"].items():
+        if not isinstance(h, dict):
+            errs.append(f"{where}: histogram {name!r} not an object")
+            continue
+        count = h.get("count")
+        if not (_is_int(count) and count >= 0):
+            errs.append(f"{where}: histogram {name!r} missing "
+                        "non-negative int 'count'")
+            continue
+        buckets = h.get("buckets") or {}
+        if isinstance(buckets, dict) and \
+                sum(buckets.values()) != count:
+            errs.append(f"{where}: histogram {name!r} count {count} "
+                        f"!= bucket total {sum(buckets.values())}")
+        if count > 0 and _is_num(h.get("min")) and \
+                _is_num(h.get("max")) and h["min"] > h["max"]:
+            errs.append(f"{where}: histogram {name!r} min > max")
+
+
+def validate(path: str) -> List[str]:
+    """Return human-readable violations (empty = valid series)."""
+    errs: List[str] = []
+    try:
+        with open(path, encoding="utf-8", errors="replace") as f:
+            lines = f.read().splitlines()
+    except OSError as e:
+        return [f"{path}: unreadable: {e}"]
+    lines = [ln for ln in lines if ln.strip()]
+    if not lines:
+        return [f"{path}: empty file — a published series always "
+                "holds its manifest row"]
+
+    docs: List[Dict] = []
+    for i, ln in enumerate(lines, 1):
+        try:
+            d = json.loads(ln)
+        except ValueError:
+            if i == len(lines):
+                continue        # torn tail of a killed writer: fine
+            errs.append(f"{path}:{i}: invalid JSON mid-file "
+                        "(corruption, not a crash tail)")
+            continue
+        if not isinstance(d, dict):
+            errs.append(f"{path}:{i}: line is not a JSON object")
+            continue
+        docs.append({"i": i, "d": d})
+
+    if not docs:
+        return errs or [f"{path}: no parseable rows"]
+    first = docs[0]["d"]
+    if first.get("kind") != "series_manifest":
+        errs.append(f"{path}:1: first row is {first.get('kind')!r}, "
+                    "not the 'series_manifest'")
+    else:
+        if first.get("schema") != 1:
+            errs.append(f"{path}:1: manifest schema "
+                        f"{first.get('schema')!r} != 1")
+        if not _is_num(first.get("t0")):
+            errs.append(f"{path}:1: manifest missing numeric 't0'")
+        if not (_is_num(first.get("interval_s"))
+                and first["interval_s"] > 0):
+            errs.append(f"{path}:1: manifest missing positive "
+                        "'interval_s'")
+        if not (_is_int(first.get("max_rows"))
+                and first["max_rows"] >= 1):
+            errs.append(f"{path}:1: manifest missing int "
+                        "'max_rows' >= 1")
+        if not isinstance(first.get("source"), dict):
+            errs.append(f"{path}:1: manifest missing 'source' object")
+        docs = docs[1:]
+
+    # per-source row laws: time/seq/dropped ordering + cumulative
+    # counters (the monoid law the fleet fold assumes)
+    last: Dict[str, dict] = {}
+    n_samples = 0
+    for rec in docs:
+        i, d = rec["i"], rec["d"]
+        where = f"{path}:{i}"
+        if d.get("kind") != "sample":
+            errs.append(f"{where}: unknown row kind {d.get('kind')!r}")
+            continue
+        n_samples += 1
+        if d.get("schema") != 1:
+            errs.append(f"{where}: sample schema "
+                        f"{d.get('schema')!r} != 1")
+        if not _is_num(d.get("t")):
+            errs.append(f"{where}: sample missing numeric 't'")
+            continue
+        if not (_is_int(d.get("seq")) and d["seq"] >= 0):
+            errs.append(f"{where}: sample missing non-negative int "
+                        "'seq'")
+            continue
+        if not (_is_int(d.get("dropped")) and d["dropped"] >= 0):
+            errs.append(f"{where}: sample missing non-negative int "
+                        "'dropped'")
+            continue
+        _check_snapshot(where, d.get("metrics"), errs)
+        m = d.get("metrics") if isinstance(d.get("metrics"), dict) \
+            else {"counters": {}, "gauges": {}, "histograms": {}}
+
+        src = json.dumps(d.get("source"), sort_keys=True)
+        prev = last.get(src)
+        if prev is not None:
+            if d["t"] < prev["t"]:
+                errs.append(f"{where}: time regresses ({d['t']} after "
+                            f"{prev['t']} for source {src})")
+            if d["seq"] <= prev["seq"]:
+                errs.append(f"{where}: seq not strictly increasing "
+                            f"({d['seq']} after {prev['seq']})")
+            if d["dropped"] < prev["dropped"]:
+                errs.append(f"{where}: 'dropped' decreases "
+                            f"({d['dropped']} after {prev['dropped']}"
+                            ") — drops are cumulative")
+            pm = prev["m"]
+            for name, v in (pm.get("counters") or {}).items():
+                cur = (m.get("counters") or {}).get(name)
+                if _is_num(cur) and _is_num(v) and cur < v:
+                    errs.append(
+                        f"{where}: counter {name!r} decreases "
+                        f"({cur} after {v}) — rows must be cumulative "
+                        "snapshots (the monoid law)")
+            for name, h in (pm.get("histograms") or {}).items():
+                cur = (m.get("histograms") or {}).get(name)
+                if isinstance(cur, dict) and isinstance(h, dict) and \
+                        _is_int(cur.get("count")) and \
+                        _is_int(h.get("count")) and \
+                        cur["count"] < h["count"]:
+                    errs.append(f"{where}: histogram {name!r} count "
+                                "decreases — rows must be cumulative")
+        last[src] = {"t": d["t"], "seq": d["seq"],
+                     "dropped": d["dropped"], "m": m}
+
+        # monoid identity: empty ∪ row == row
+        empty = {"counters": {}, "gauges": {}, "histograms": {}}
+        if json.dumps(_merge(empty, m), sort_keys=True) != \
+                json.dumps(m, sort_keys=True):
+            errs.append(f"{where}: merge(empty, row) != row — the "
+                        "snapshot violates the merge identity law")
+
+    if not errs and n_samples == 0:
+        errs.append(f"{path}: no sample rows — a published series "
+                    "holds at least its stop()-time sample")
+    return errs
+
+
+def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    if not argv:
+        print("usage: check_series.py SPOOL/series.jsonl [...]",
+              file=sys.stderr)
+        return 2
+    bad = 0
+    for path in argv:
+        errors = validate(path)
+        if errors:
+            bad += 1
+            for e in errors:
+                print(e, file=sys.stderr)
+        else:
+            n = 0
+            srcs = set()
+            with open(path, encoding="utf-8", errors="replace") as f:
+                for ln in f:
+                    try:
+                        d = json.loads(ln)
+                    except ValueError:
+                        continue
+                    if isinstance(d, dict) and d.get("kind") == \
+                            "sample":
+                        n += 1
+                        srcs.add(json.dumps(d.get("source"),
+                                            sort_keys=True))
+            print(f"{path}: ok ({n} sample(s) from {len(srcs)} "
+                  "source(s))")
+    return 1 if bad else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,67 @@
+#!/usr/bin/env python3
+"""Standalone per-job run explainer — ``adam-tpu explain`` without the
+package on PYTHONPATH.
+
+Joins a served job's durable artifacts (result doc, event sidecars,
+series.jsonl files, trace docs) into one causal timeline: submitted →
+queued behind N jobs of which tenants → admission/placement with the
+deciders' recorded inputs → retries / degrades / requeues / steals →
+rung and breaker context → finish.  Pure reader: never touches the
+spool, so it is safe against a live fleet or a spool copied off a
+shared filesystem.
+
+    python tools/explain_run.py SPOOL JOB_ID [--json]
+        [--events PATH]... [--series PATH]... [--timeline PATH]...
+
+Exit 0: job found; 3: no durable record of the job; 2: bad input.
+The join logic lives in adam_tpu/serve/explain.py (the CLI command and
+this script are the same engine); docs/OBSERVABILITY.md documents the
+attribution rules (exact vs window vs context).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+from adam_tpu.serve.explain import (explain_job,  # noqa: E402
+                                    render_timeline)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="reconstruct one served job's causal timeline "
+                    "from durable artifacts alone")
+    ap.add_argument("spool", help="the server's spool directory")
+    ap.add_argument("job", help="job id (the result doc's stem)")
+    ap.add_argument("--events", action="append", default=[],
+                    metavar="PATH", help="extra event sidecar(s)")
+    ap.add_argument("--series", action="append", default=[],
+                    metavar="PATH", help="extra series.jsonl file(s)")
+    ap.add_argument("--timeline", action="append", default=[],
+                    metavar="PATH", help="extra .trace.json file(s)")
+    ap.add_argument("--json", dest="as_json", action="store_true",
+                    help="print the full timeline doc as JSON")
+    args = ap.parse_args(argv)
+
+    if not os.path.isdir(args.spool):
+        print(f"explain_run: no such spool: {args.spool}",
+              file=sys.stderr)
+        return 2
+    doc = explain_job(args.spool, args.job, events=args.events,
+                      series=args.series, timelines=args.timeline)
+    if args.as_json:
+        print(json.dumps(doc, sort_keys=True, default=str))
+    else:
+        print(render_timeline(doc))
+    return 0 if doc["found"] else 3
+
+
+if __name__ == "__main__":
+    sys.exit(main())

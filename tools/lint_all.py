@@ -10,7 +10,8 @@ Runs, in order:
    (``EVIDENCE_LEDGER.json``), when one exists;
 4. any **sidecar paths passed as arguments**, routed by shape:
    ``*.trace.json`` -> check_trace, other ``*.json`` -> check_evidence,
-   ``*.jsonl`` -> check_metrics + check_executor + check_resilience.
+   ``*series.jsonl`` -> check_series, other ``*.jsonl`` ->
+   check_metrics + check_executor + check_resilience.
 
 This is the verify-flow entry: where ``python -m pytest tests/`` checks
 behavior, ``python -m tools.lint_all`` checks the conventions and the
@@ -88,6 +89,11 @@ def _steps(argv: Sequence[str]) -> List[Tuple[str, List[str]]]:
             steps.append((f"check_evidence {p}",
                           [py, os.path.join(tool_dir,
                                             "check_evidence.py"), p]))
+        elif p.endswith("series.jsonl"):
+            # the time-series plane has its own schema + monoid laws
+            steps.append((f"check_series {p}",
+                          [py, os.path.join(tool_dir,
+                                            "check_series.py"), p]))
         else:
             steps.append((f"check_metrics {p}",
                           [py, os.path.join(tool_dir,
