@@ -1,0 +1,18 @@
+"""Variant calling plane: batched pileup -> genotype -> VCF (ISSUE 17).
+
+The reference's back half — pileup aggregation
+(PileupAggregator.scala) and genotype/variant computation
+(GenotypesToVariantsConverter.scala) — as a fourth served workload.
+The streamed pass (``streaming_call``) drives position-binned pileup
+counting through the shape-bucketed executor, genotypes the merged
+count tensors with an integer device kernel, and emits VCF through
+``io.vcf.write_vcf``; a pure scalar oracle (``oracle_call``) replays
+the same integers read-by-read in Python and the two VCF byte streams
+must be identical (tests/test_call.py, docs/CALL.md).
+"""
+
+from .plan import decide_call_plan, resolve_call_knobs  # noqa: F401
+from .pipeline import streaming_call  # noqa: F401
+from .oracle import oracle_call, oracle_counts  # noqa: F401
+from .genotyper import (genotype_fields_kernel, genotype_site,  # noqa: F401
+                        build_call_tables, vcf_text)

@@ -1,0 +1,218 @@
+"""Biallelic SNP genotyping over pileup count tensors — integer-exact.
+
+The genotype-likelihood kernel is a pure function of the per-position
+count tensor (parallel/pileup.py channels), written ENTIRELY in int32
+arithmetic so the batched device kernel and the scalar Python oracle
+produce the same integers by construction — bit-identical VCF output is
+an arithmetic identity, not a tolerance (docs/CALL.md §oracle contract).
+
+Model (per position, per sample):
+
+* reference allele = plurality base among A/C/G/T counts (first max on
+  ties — ``argmax`` and ``list.index(max(...))`` agree on tie order);
+  the count tensor carries no reference sequence, so the plurality base
+  IS the site's reference hypothesis (mpileup's consensus fallback);
+* alt allele = plurality of the remaining three bases;
+* with ``r`` ref-supporting and ``a`` alt-supporting bases and
+  ``qavg = QUAL_SUM // COVERAGE`` the phred likelihoods are
+  ``PL(0/0) = a*qavg`` (every alt base a miscall),
+  ``PL(1/1) = r*qavg``, and
+  ``PL(0/1) = (30103*(r+a) + 5000) // 10000`` — the integer phred of
+  0.5^(r+a) (10*log10(2) = 3.0103, scaled to avoid floats);
+* genotype = first argmin of the PL triple, GQ = min(second-best PL
+  minus best PL, 99), reported PLs normalize to min 0 (VCF convention).
+
+Coverage per position must stay under ~71k (30103*(r+a) in int32) and
+channel sums under 2^31; both hold by orders of magnitude for any input
+the streamed pass admits.
+"""
+
+from __future__ import annotations
+
+import io as _io
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pyarrow as pa
+
+from .. import schema as S
+from ..converters.genotypes_to_variants import convert_genotypes
+from ..io.vcf import _rows_to_table, write_vcf
+from ..models.dictionary import SequenceDictionary, SequenceRecord
+from ..parallel.pileup import (CH_COVERAGE, CH_MAPQ, CH_QUAL, CH_REVERSE)
+
+#: genotype-field columns of the kernel output, in order
+GT_FIELDS = ("ref_code", "alt_code", "alt_count", "gt", "gq",
+             "pl_ref", "pl_het", "pl_alt", "depth", "qual_avg",
+             "mapq_avg", "fwd")
+(GF_REF, GF_ALT, GF_ALT_COUNT, GF_GT, GF_GQ, GF_PL0, GF_PL1, GF_PL2,
+ GF_DEPTH, GF_QAVG, GF_MAPQ, GF_FWD) = range(len(GT_FIELDS))
+
+#: 10000 * 10*log10(2) — the het PL slope, integer-scaled
+_PHRED_HALF_NUM = 30103
+_PHRED_SCALE = 10000
+_MAX_GQ = 99
+
+
+@jax.jit
+def genotype_fields_kernel(counts) -> jnp.ndarray:
+    """[span, N_CHANNELS] int32 counts -> [span, len(GT_FIELDS)] int32.
+
+    One compiled shape per stripe span; the fold over shards/tenants
+    happened BEFORE this kernel (counts are an exact monoid), so running
+    it once on the merged tensor is what makes solo/fleet/packed output
+    identical by construction.
+    """
+    c = counts.astype(jnp.int32)
+    bc = c[:, :4]                                   # A/C/G/T counts
+    cov = c[:, CH_COVERAGE]
+    covn = jnp.maximum(cov, 1)
+    qavg = c[:, CH_QUAL] // covn
+    mapq_avg = c[:, CH_MAPQ] // covn
+    fwd = cov - c[:, CH_REVERSE]
+    ref = jnp.argmax(bc, axis=1).astype(jnp.int32)
+    masked = jnp.where(jnp.arange(4)[None, :] == ref[:, None], -1, bc)
+    alt = jnp.argmax(masked, axis=1).astype(jnp.int32)
+    r = jnp.take_along_axis(bc, ref[:, None], axis=1)[:, 0]
+    a = jnp.take_along_axis(bc, alt[:, None], axis=1)[:, 0]
+    pl0 = a * qavg
+    pl2 = r * qavg
+    pl1 = (_PHRED_HALF_NUM * (r + a) + _PHRED_SCALE // 2) // _PHRED_SCALE
+    pls = jnp.stack([pl0, pl1, pl2], axis=1)
+    gt = jnp.argmin(pls, axis=1).astype(jnp.int32)
+    mn = jnp.min(pls, axis=1)
+    mx = jnp.max(pls, axis=1)
+    second = pl0 + pl1 + pl2 - mn - mx
+    gq = jnp.minimum(second - mn, _MAX_GQ)
+    return jnp.stack([ref, alt, a, gt, gq, pl0 - mn, pl1 - mn, pl2 - mn,
+                      cov, qavg, mapq_avg, fwd], axis=1)
+
+
+def genotype_site(c) -> dict:
+    """The kernel's scalar twin: one position's counts (12 ints) -> the
+    same GT_FIELDS integers in plain Python (the oracle's genotyper)."""
+    cov = int(c[CH_COVERAGE])
+    covn = max(cov, 1)
+    qavg = int(c[CH_QUAL]) // covn
+    mapq_avg = int(c[CH_MAPQ]) // covn
+    fwd = cov - int(c[CH_REVERSE])
+    bc = [int(c[0]), int(c[1]), int(c[2]), int(c[3])]
+    ref = bc.index(max(bc))
+    masked = list(bc)
+    masked[ref] = -1
+    alt = masked.index(max(masked))
+    r, a = bc[ref], bc[alt]
+    pl0, pl2 = a * qavg, r * qavg
+    pl1 = (_PHRED_HALF_NUM * (r + a) + _PHRED_SCALE // 2) // _PHRED_SCALE
+    pls = [pl0, pl1, pl2]
+    mn, mx = min(pls), max(pls)
+    gt = pls.index(mn)
+    gq = min(pl0 + pl1 + pl2 - mn - mx - mn, _MAX_GQ)
+    return dict(ref_code=ref, alt_code=alt, alt_count=a, gt=gt, gq=gq,
+                pl_ref=pl0 - mn, pl_het=pl1 - mn, pl_alt=pl2 - mn,
+                depth=cov, qual_avg=qavg, mapq_avg=mapq_avg, fwd=fwd)
+
+
+def should_emit(fields: dict, min_depth: int, min_alt: int) -> bool:
+    """The shared emission floor: a non-ref call with enough total and
+    alt-supporting evidence."""
+    return (fields["gt"] > 0 and fields["depth"] >= min_depth
+            and fields["alt_count"] >= min_alt)
+
+
+def calls_from_fields(out_np: np.ndarray, *, refid: int, refname: str,
+                      stripe_start: int, sample: str,
+                      min_depth: int, min_alt: int) -> List[dict]:
+    """Kernel output [span, GT_FIELDS] -> emitted call dicts (host side
+    of the device path; the oracle builds the same dicts from
+    :func:`genotype_site`)."""
+    emit = np.flatnonzero(
+        (out_np[:, GF_GT] > 0) & (out_np[:, GF_DEPTH] >= min_depth)
+        & (out_np[:, GF_ALT_COUNT] >= min_alt))
+    calls = []
+    for i in emit:
+        row = out_np[i]
+        calls.append(dict(
+            refid=int(refid), refname=refname,
+            pos=int(stripe_start + i), sample=sample,
+            fields={k: int(row[j]) for j, k in enumerate(GT_FIELDS)}))
+    return calls
+
+
+def build_call_tables(calls: List[dict],
+                      contigs: Dict[int, Tuple[str, Optional[int]]]
+                      ) -> Tuple[pa.Table, pa.Table, SequenceDictionary]:
+    """Emitted calls -> (variants, genotypes, seq_dict), shared by the
+    device and oracle paths: identical call sets in produce identical
+    tables (and so identical VCF bytes) out.
+
+    Diploid biallelic rows: GT=0/1 emits a ref and an alt haplotype row,
+    GT=1/1 two alt rows — the row shape io/vcf.py's reader produces, so
+    ``write_vcf`` round-trips the calls.
+
+    Site-reference consensus: each sample's count tensor infers its own
+    reference hypothesis (plurality base), so two samples overlapping
+    one site can disagree on REF — which a VCF line cannot represent
+    (one REF per site, and convert_genotypes rejects inconsistent
+    ``referenceAllele`` groups).  The site's reference is settled by
+    the heaviest total claimed depth per candidate (ties to the lower
+    base code) and calls contradicting it are dropped — a pure function
+    of the call set, so the device pass and the scalar oracle stay
+    byte-identical by construction (docs/CALL.md §limitations)."""
+    calls = sorted(calls, key=lambda cl: (cl["refname"], cl["pos"],
+                                          cl["sample"]))
+    by_site: Dict[Tuple[str, int], List[dict]] = {}
+    for cl in calls:
+        by_site.setdefault((cl["refname"], cl["pos"]), []).append(cl)
+    kept = []
+    for site in sorted(by_site):
+        cls = by_site[site]
+        weight: Dict[int, int] = {}
+        for cl in cls:
+            rc = cl["fields"]["ref_code"]
+            weight[rc] = weight.get(rc, 0) + cl["fields"]["depth"]
+        site_ref = min(weight, key=lambda rc: (-weight[rc], rc))
+        kept += [cl for cl in cls
+                 if cl["fields"]["ref_code"] == site_ref]
+    calls = kept
+    g_rows = []
+    for cl in calls:
+        f = cl["fields"]
+        ref_base = S.BASES[f["ref_code"]]
+        alt_base = S.BASES[f["alt_code"]]
+        pair = (ref_base, alt_base) if f["gt"] == 1 else \
+            (alt_base, alt_base)
+        pl_str = f"{f['pl_ref']},{f['pl_het']},{f['pl_alt']}"
+        for hap, allele in enumerate(pair):
+            g_rows.append({
+                "referenceId": cl["refid"],
+                "referenceName": cl["refname"],
+                "position": cl["pos"], "sampleId": cl["sample"],
+                "ploidy": 2, "haplotypeNumber": hap,
+                "allele": allele, "isReference": allele == ref_base,
+                "referenceAllele": ref_base,
+                "alleleVariantType": "SNP",
+                "genotypeQuality": f["gq"], "depth": f["depth"],
+                "phredLikelihoods": pl_str,
+                "rmsBaseQuality": f["qual_avg"],
+                "rmsMapQuality": f["mapq_avg"],
+                "readsMappedForwardStrand": f["fwd"],
+                "isPhased": False,
+            })
+    genotypes = _rows_to_table(g_rows, S.GENOTYPE_SCHEMA)
+    variants = convert_genotypes(genotypes)
+    seq_dict = SequenceDictionary(
+        SequenceRecord(rid, name, length or 0)
+        for rid, (name, length) in sorted(contigs.items()))
+    return variants, genotypes, seq_dict
+
+
+def vcf_text(variants: pa.Table, genotypes: pa.Table,
+             seq_dict: SequenceDictionary) -> str:
+    """The VCF byte stream as a string — what the identity comparison
+    (and the .vcf.gz/.bcf encoders) consume."""
+    buf = _io.StringIO()
+    write_vcf(variants, genotypes, buf, seq_dict)
+    return buf.getvalue()

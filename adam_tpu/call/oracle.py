@@ -1,0 +1,199 @@
+"""The scalar calling oracle: one read at a time, in plain Python.
+
+Independent re-derivation of the batched path's integers — a per-read
+CIGAR walk mirroring ``ops.pileup.pileup_walk``'s emission semantics and
+``parallel.pileup.pileup_count_kernel``'s channel arithmetic, followed
+by the SAME scalar genotyper (:func:`..call.genotyper.genotype_site`)
+and the SAME table builder.  The device pass must reproduce this
+byte-for-byte (tests/test_call.py); where the kernel has a sharp edge
+the oracle mirrors the edge rather than idealizing it:
+
+* base codes >= 4 (N and the IUPAC ambiguity codes) count ``N_OTHER``;
+  a byte outside the alphabet packs to -1 and the device scatter wraps
+  a -1 channel index to the LAST channel (MAPQ_SUM) — mirrored here;
+* qual bytes decode as int8(byte - 33), clamped at 0 (pad/underflow);
+* CIGAR ops past the packer's ``MAX_CIGAR_OPS`` budget raise in packing,
+  so the oracle never sees them; a read whose CIGAR consumes more read
+  bases than its sequence holds is rejected by both paths (the shared
+  :func:`admit_read` rule), which keeps identity invariant to the
+  executor's chunking and length buckets.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import pyarrow as pa
+
+from .. import schema as S
+from ..parallel.pileup import (CH_CLIP, CH_COVERAGE, CH_DEL, CH_INS,
+                               CH_MAPQ, CH_OTHER, CH_QUAL, CH_REVERSE,
+                               N_CHANNELS)
+from ..packing import MAX_CIGAR_OPS
+from .genotyper import (build_call_tables, genotype_site, should_emit,
+                        vcf_text)
+
+DEFAULT_SAMPLE = "sample"
+
+_READ_CONSUMING = {S.CIGAR_M, S.CIGAR_I, S.CIGAR_S, S.CIGAR_EQ,
+                   S.CIGAR_X}
+_MATCHISH = {S.CIGAR_M, S.CIGAR_EQ, S.CIGAR_X}
+
+
+def parse_cigar(cigar: Optional[str]) -> List[Tuple[int, int]]:
+    """CIGAR text -> [(op_code, length)]; None/'*' -> [] (contributes
+    nothing, the no-cigar rule)."""
+    if not cigar or cigar == "*":
+        return []
+    out, num = [], 0
+    for ch in cigar:
+        if ch.isdigit():
+            num = num * 10 + ord(ch) - 48
+        else:
+            out.append((S.CIGAR_CODE[ch], num))
+            num = 0
+    return out
+
+
+def admit_read(flags: Optional[int], refid: Optional[int],
+               start: Optional[int], ops: List[Tuple[int, int]],
+               seq_len: int) -> bool:
+    """The shared admission rule: mapped, placed on a real contig, and
+    the CIGAR's read-base consumption fits the sequence (otherwise the
+    kernel's length-bucket cap would make output depend on chunking)."""
+    if flags is None:
+        flags = 0
+    if (int(flags) & S.FLAG_UNMAPPED) or refid is None or refid < 0 \
+            or start is None or start < 0:
+        return False
+    if len(ops) > MAX_CIGAR_OPS:
+        return False
+    consumed = sum(ln for op, ln in ops if op in _READ_CONSUMING)
+    return consumed <= seq_len
+
+
+def _qual_at(qual: str, i: int) -> int:
+    """int8(byte-33) clamped at 0 — the packed decode, scalar."""
+    if i >= len(qual):
+        return 0
+    v = ord(qual[i]) - 33
+    v = ((v + 128) % 256) - 128          # int8 wrap, as the decode LUT
+    return max(v, 0)
+
+
+def _base_channel(ch: str) -> int:
+    code = S.BASE_CODE.get(ch, S.BASE_PAD)
+    if 0 <= code < 4:
+        return code
+    if code >= 4:
+        return CH_OTHER
+    # out-of-alphabet byte: the device scatter wraps channel -1 to the
+    # last channel — mirror the wrap, don't idealize it
+    return N_CHANNELS - 1
+
+
+def count_read(counts: Dict[int, List[int]], *, start: int, seq: str,
+               qual: str, mapq: Optional[int], flags: int,
+               ops: List[Tuple[int, int]]) -> None:
+    """Walk one admitted read into a position->channel-counts dict."""
+    mq = max(mapq if mapq is not None else -1, 0)
+    rev = (flags & S.FLAG_REVERSE) != 0
+    ref_pos, off = start, 0
+
+    def at(pos: int) -> List[int]:
+        row = counts.get(pos)
+        if row is None:
+            row = counts[pos] = [0] * N_CHANNELS
+        return row
+
+    for op, ln in ops:
+        if op in _MATCHISH:
+            for k in range(ln):
+                row = at(ref_pos + k)
+                row[_base_channel(seq[off])] += 1
+                row[CH_COVERAGE] += 1
+                row[CH_QUAL] += _qual_at(qual, off)
+                row[CH_MAPQ] += mq
+                if rev:
+                    row[CH_REVERSE] += 1
+                off += 1
+            ref_pos += ln
+        elif op == S.CIGAR_I:
+            at(ref_pos)[CH_INS] += ln
+            off += ln
+        elif op == S.CIGAR_S:
+            at(ref_pos)[CH_CLIP] += ln
+            off += ln
+        elif op == S.CIGAR_D:
+            for k in range(ln):
+                at(ref_pos + k)[CH_DEL] += 1
+            ref_pos += ln
+        elif op == S.CIGAR_N:
+            ref_pos += ln
+        # H / P: consume nothing, emit nothing
+
+
+def oracle_counts(table: pa.Table, *, default_sample: str = DEFAULT_SAMPLE
+                  ) -> Tuple[Dict[Tuple[str, int], Dict[int, List[int]]],
+                             Dict[int, Tuple[str, Optional[int]]]]:
+    """Reads table -> ({(sample, refid): {pos: [12 channel counts]}},
+    {refid: (name, length)})."""
+    counts: Dict[Tuple[str, int], Dict[int, List[int]]] = {}
+    contigs: Dict[int, Tuple[str, Optional[int]]] = {}
+    names = set(table.column_names)
+
+    def col(name):
+        if name in names:
+            return table.column(name).to_pylist()
+        return [None] * table.num_rows
+
+    flags_c, refid_c, start_c = col("flags"), col("referenceId"), \
+        col("start")
+    seq_c, qual_c, cigar_c = col("sequence"), col("qual"), col("cigar")
+    mapq_c, sample_c = col("mapq"), col("recordGroupSample")
+    refname_c, reflen_c = col("referenceName"), col("referenceLength")
+
+    for i in range(table.num_rows):
+        seq = seq_c[i] or ""
+        ops = parse_cigar(cigar_c[i])
+        if not admit_read(flags_c[i], refid_c[i], start_c[i], ops,
+                          len(seq)):
+            continue
+        refid = int(refid_c[i])
+        if refid not in contigs:
+            contigs[refid] = (refname_c[i] or str(refid),
+                              reflen_c[i])
+        sample = sample_c[i] or default_sample
+        key = (sample, refid)
+        count_read(counts.setdefault(key, {}), start=int(start_c[i]),
+                   seq=seq, qual=qual_c[i] or "", mapq=mapq_c[i],
+                   flags=int(flags_c[i] or 0), ops=ops)
+    return counts, contigs
+
+
+def oracle_call(table: pa.Table, *, min_depth: int, min_alt: int,
+                default_sample: str = DEFAULT_SAMPLE):
+    """The full scalar path: counts -> genotypes -> tables.
+
+    Returns (variants, genotypes, seq_dict, calls); ``vcf_text`` of the
+    tables is the byte stream the device pass must reproduce."""
+    counts, contigs = oracle_counts(table, default_sample=default_sample)
+    calls = []
+    for (sample, refid), by_pos in counts.items():
+        refname = contigs[refid][0]
+        for pos, row in by_pos.items():
+            fields = genotype_site(row)
+            if should_emit(fields, min_depth, min_alt):
+                calls.append(dict(refid=refid, refname=refname,
+                                  pos=pos, sample=sample,
+                                  fields=fields))
+    variants, genotypes, seq_dict = build_call_tables(calls, contigs)
+    return variants, genotypes, seq_dict, calls
+
+
+def oracle_vcf_text(table: pa.Table, *, min_depth: int, min_alt: int,
+                    default_sample: str = DEFAULT_SAMPLE) -> str:
+    variants, genotypes, seq_dict, _ = oracle_call(
+        table, min_depth=min_depth, min_alt=min_alt,
+        default_sample=default_sample)
+    return vcf_text(variants, genotypes, seq_dict)

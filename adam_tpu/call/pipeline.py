@@ -1,0 +1,307 @@
+"""The streamed variant-calling pass: reads -> stripes -> counts -> VCF.
+
+Dataflow (docs/CALL.md):
+
+1. reads stream in bounded chunks (io/stream.py) under the
+   shape-bucketed executor (``begin_pass("call")`` — ladder rungs,
+   prefetchable feed, retry/degrade ladder on every dispatch);
+2. each chunk packs once (``pack_reads``), its planes ship to the
+   device once, and ``route_reads_to_stripes`` assigns reads
+   (boundary-duplicated) to genome stripes; one
+   ``pileup_count_kernel`` dispatch per (stripe, sample) counts the
+   chunk's evidence into a [span, 12] int32 tensor — only the cheap
+   validity mask differs between dispatches, so the compiled shape set
+   is the chunk ladder x the length buckets;
+3. count tensors accumulate on host in int64 — an exact monoid, so
+   chunk order, chunking, sharding and co-tenant packing cannot change
+   the totals;
+4. after the stream drains, the merged tensor of every (sample, refid,
+   stripe) genotypes in one ``genotype_fields_kernel`` dispatch
+   (integer math, docs/CALL.md §oracle contract) and emitted calls
+   serialize through ``io.vcf.write_vcf``.
+
+The ``ragged`` layout reuses the padded kernel over one fixed-capacity
+buffer (rows live below the prefix bound, ``note_ragged`` accounting)
+instead of per-chunk ladder rungs — same counts, fewer compiled row
+shapes.  ``paged`` is not applicable: the page pool is the u32
+wire-plane's residency scheme and the call pass ships multi-plane
+batches.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import math
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.compute as pc
+
+from .. import obs
+from .. import schema as S
+from ..io.stream import open_read_stream
+from ..io.vcf import write_vcf
+from ..packing import MAX_CIGAR_OPS, len_bucket, pack_reads
+from ..parallel.mesh import make_mesh
+from ..parallel.pileup import (CH_COVERAGE, N_CHANNELS,
+                               pileup_count_kernel,
+                               route_reads_to_stripes)
+from .genotyper import (build_call_tables, calls_from_fields,
+                        genotype_fields_kernel, vcf_text)
+from .oracle import DEFAULT_SAMPLE, oracle_vcf_text
+from .plan import resolve_call_knobs
+
+#: columns the pass streams — the packing planes plus contig identity
+CALL_COLUMNS = ("referenceName", "referenceId", "start", "mapq",
+                "sequence", "qual", "cigar", "flags",
+                "recordGroupSample", "referenceLength")
+
+_CONSUMES_READ = np.array(S.CIGAR_CONSUMES_READ, np.int64)
+_CONSUMES_REF = np.array(S.CIGAR_CONSUMES_REF, np.int64)
+
+#: est. host bytes per read row shipped per chunk (bases+quals at ~150bp
+#: plus the scalar planes) — the executor's prefetch-depth sizing hint
+_BYTES_PER_ROW = 384.0
+
+
+def _drop_overbudget_cigars(tbl: pa.Table) -> pa.Table:
+    """Drop reads whose CIGAR has more ops than the packer's slot budget
+    (pack_cigars raises past MAX_CIGAR_OPS); the oracle's admit_read
+    rejects the same rows, so both paths see the same read set."""
+    cig = pc.fill_null(tbl.column("cigar"), "")
+    # op count == non-digit char count (CIGAR text is digit runs, each
+    # closed by one op letter)
+    n_ops = pc.subtract(
+        pc.binary_length(cig),
+        pc.binary_length(pc.replace_substring_regex(
+            cig, r"[^0-9]", "")))
+    keep = pc.less_equal(n_ops, MAX_CIGAR_OPS)
+    if pc.all(keep).as_py() is not False:
+        return tbl
+    return tbl.filter(keep)
+
+
+class _ChunkCounter:
+    """Per-run state of the counting stage: host int64 accumulators per
+    (sample, refid, stripe), contig identities, interned sample names."""
+
+    def __init__(self, pex, span: int,
+                 default_sample: str = DEFAULT_SAMPLE):
+        self.pex = pex
+        self.span = int(span)
+        self.default_sample = default_sample
+        self.accum: Dict[Tuple[str, int, int], np.ndarray] = {}
+        self.contigs: Dict[int, Tuple[str, Optional[int]]] = {}
+        self.reads = 0
+        self.admitted = 0
+        self.chunks = 0
+
+    def count_chunk(self, tbl: pa.Table) -> None:
+        import jax
+
+        self.reads += tbl.num_rows
+        self.chunks += 1
+        tbl = _drop_overbudget_cigars(tbl)
+        n = tbl.num_rows
+        if n == 0:
+            return
+        lens = pc.fill_null(pc.binary_length(tbl.column("sequence")), 0)
+        max_len = max(int(pc.max(lens).as_py() or 0), 1)
+        len_b = len_bucket(max_len)
+        pex = self.pex
+        if pex.layout == "ragged":
+            # fixed-capacity buffer: ONE compiled row count for the
+            # whole run, rows live below the prefix bound
+            n_pad = max(pex.chunk_rows, n)
+            pex.note_ragged(n, n_pad)
+        else:
+            n_pad = pex.pad_rows(n, len_b, max_len=max_len)
+        batch = pack_reads(tbl, bucket_len=len_b, pad_rows_to=n_pad)
+
+        flags = batch.flags.astype(np.int64)
+        consumed_read = (_CONSUMES_READ[batch.cigar_ops]
+                         * batch.cigar_lens).sum(axis=1)
+        ok = (batch.valid
+              & ((flags & S.FLAG_UNMAPPED) == 0)
+              & (batch.refid >= 0) & (batch.start >= 0)
+              & (consumed_read <= batch.read_len))
+        self.admitted += int(ok.sum())
+        if not ok.any():
+            return
+        ref_span = (_CONSUMES_REF[batch.cigar_ops]
+                    * batch.cigar_lens).sum(axis=1)
+        # +1: trailing soft-clip/insert events pin AT start+ref_span, so
+        # the routed span must include that position's stripe
+        ref_end = batch.start.astype(np.int64) + ref_span + 1
+
+        sample_col = tbl.column("recordGroupSample").to_pylist()
+        sample_of_row = np.full(n_pad, "", dtype=object)
+        sample_of_row[:n] = [sm or self.default_sample
+                             for sm in sample_col]
+        name_col = ref_len_col = None
+
+        planes_np = (batch.bases, batch.quals, batch.start, batch.flags,
+                     batch.mapq, batch.cigar_ops, batch.cigar_lens)
+        nbytes = sum(int(p.nbytes) for p in planes_np)
+        dev = pex.dispatch_put(
+            "planes", lambda attempt: jax.device_put(planes_np),
+            nbytes=nbytes)
+        (d_bases, d_quals, d_start, d_flags, d_mapq, d_ops,
+         d_lens) = dev
+
+        span = self.span
+        for rid in np.unique(batch.refid[ok]):
+            rid = int(rid)
+            rows_r = ok & (batch.refid == rid)
+            if rid not in self.contigs:
+                if name_col is None:
+                    name_col = tbl.column("referenceName").to_pylist()
+                    ref_len_col = tbl.column(
+                        "referenceLength").to_pylist()
+                first = int(np.flatnonzero(rows_r)[0])
+                self.contigs[rid] = (name_col[first] or str(rid),
+                                     ref_len_col[first])
+            k_lo = int(batch.start[rows_r].min()) // span
+            k_hi = int(ref_end[rows_r].max() - 1) // span
+            stripe_starts = (np.arange(k_lo, k_hi + 1)
+                             * span).astype(np.int64)
+            gather, stripe_of = route_reads_to_stripes(
+                batch.refid, batch.start, ref_end, rows_r, rows_r,
+                stripe_starts, span)
+            for j in np.unique(stripe_of):
+                rows_j = gather[stripe_of == j]
+                samp_j = sample_of_row[rows_j]
+                for sample in np.unique(samp_j):
+                    sel = rows_j[samp_j == sample]
+                    vmask = np.zeros(n_pad, bool)
+                    vmask[sel] = True
+                    bin_start = np.int32(stripe_starts[j])
+
+                    def run(attempt, vm=vmask, bs=bin_start):
+                        return np.asarray(pileup_count_kernel(
+                            d_bases, d_quals, d_start, d_flags, d_mapq,
+                            vm, d_ops, d_lens, bs,
+                            bin_span=span, max_len=len_b))
+
+                    def cpu(exc, vm=vmask, bs=bin_start):
+                        with jax.default_device(jax.devices("cpu")[0]):
+                            return np.asarray(pileup_count_kernel(
+                                batch.bases, batch.quals, batch.start,
+                                batch.flags, batch.mapq, vm,
+                                batch.cigar_ops, batch.cigar_lens, bs,
+                                bin_span=span, max_len=len_b))
+
+                    counts = pex.dispatch("pileup", run, fallback=cpu)
+                    key = (str(sample), rid, k_lo + int(j))
+                    acc = self.accum.get(key)
+                    if acc is None:
+                        self.accum[key] = counts.astype(np.int64)
+                    else:
+                        acc += counts
+
+
+def streaming_call(path: str, out_path: Optional[str] = None, *,
+                   chunk_rows: int = 1 << 18, io_procs: int = 1,
+                   stripe_span: Optional[int] = None,
+                   min_depth: Optional[int] = None,
+                   min_alt: Optional[int] = None,
+                   executor_opts: Optional[dict] = None,
+                   validate: bool = False,
+                   default_sample: str = DEFAULT_SAMPLE) -> dict:
+    """Chunked, executor-driven variant calling over any reads input.
+
+    Returns a result doc with the call counts, the VCF's sha256 (the
+    serve identity handle), and — under ``validate`` — the scalar-oracle
+    verdict plus the rods-plane coverage summary.  ``out_path`` (when
+    given) receives the VCF via the durable tmp+rename writer.
+    """
+    import jax  # noqa: F401  (device runtime; imported before dispatches)
+
+    from ..parallel.executor import StreamExecutor
+    from ..platform import is_tpu_backend
+
+    plan = resolve_call_knobs(stripe_span, min_depth, min_alt)
+    span, mdep, malt = (plan["stripe_span"], plan["min_depth"],
+                        plan["min_alt"])
+
+    mesh = make_mesh()
+    on_tpu = is_tpu_backend()
+    ex = StreamExecutor(mesh, chunk_rows, on_tpu=on_tpu,
+                        **(executor_opts or {}))
+    pex = ex.begin_pass("call", bytes_per_row=_BYTES_PER_ROW,
+                        ragged_capable=True, paged_capable=False,
+                        sync_every=1)
+    counter = _ChunkCounter(pex, span, default_sample)
+    with obs.ioledger.pass_scope("call"):
+        stream = open_read_stream(path, columns=list(CALL_COLUMNS),
+                                  chunk_rows=pex.chunk_rows,
+                                  io_procs=io_procs)
+        for tbl in stream:
+            counter.count_chunk(tbl)
+
+    # genotype stage: one dispatch per merged (sample, refid, stripe)
+    # tensor — post-monoid, so solo/fleet/packed runs genotype the same
+    # integers
+    calls: List[dict] = []
+    samples = set()
+    for key in sorted(counter.accum):
+        sample, rid, k = key
+        samples.add(sample)
+        counts32 = counter.accum[key].astype(np.int32)
+        out = pex.dispatch(
+            "genotype",
+            lambda attempt, c=counts32: np.asarray(
+                genotype_fields_kernel(c)))
+        stripe_calls = calls_from_fields(
+            out, refid=rid, refname=counter.contigs[rid][0],
+            stripe_start=k * span, sample=sample,
+            min_depth=mdep, min_alt=malt)
+        calls += stripe_calls
+        obs.emit("call_stripe", refid=int(rid),
+                 stripe_start=int(k * span), span=int(span),
+                 sample=str(sample),
+                 covered=int((counts32[:, CH_COVERAGE] > 0).sum()),
+                 called=len(stripe_calls))
+    ex.finish()
+
+    variants, genotypes, seq_dict = build_call_tables(
+        calls, counter.contigs)
+    text = vcf_text(variants, genotypes, seq_dict)
+    sha = hashlib.sha256(text.encode()).hexdigest()
+
+    identical = None
+    rod_cov = None
+    if validate:
+        # the validation leg: re-derive everything read-by-read in
+        # Python (call/oracle.py) and summarize depth through the rods
+        # plane (ops/rods.py) — RodView aggregation's production caller
+        from ..ops.rods import aggregate_rods, reads_to_rods, \
+            rod_coverage
+        # full column set: the rods plane reads the MD tag and sample
+        # metadata beyond the pass's streaming projection
+        full = pa.concat_tables(list(open_read_stream(
+            path, chunk_rows=chunk_rows, io_procs=io_procs)))
+        identical = text == oracle_vcf_text(
+            full, min_depth=mdep, min_alt=malt,
+            default_sample=default_sample)
+        # the rods plane packs CIGARs too — drop the over-budget rows
+        # it cannot represent, as the counting path did
+        rods = aggregate_rods(reads_to_rods(
+            _drop_overbudget_cigars(full)))
+        cov = rod_coverage(rods)
+        rod_cov = None if math.isnan(cov) else round(float(cov), 6)
+
+    if out_path:
+        write_vcf(variants, genotypes, out_path, seq_dict)
+    obs.emit("call_emit", path=out_path, reads=counter.reads,
+             admitted=counter.admitted, stripes=len(counter.accum),
+             calls=len(calls), variants=variants.num_rows,
+             genotypes=genotypes.num_rows, samples=len(samples),
+             vcf_sha256=sha, identical=identical, rod_coverage=rod_cov)
+    return dict(reads=counter.reads, admitted=counter.admitted,
+                stripes=len(counter.accum), calls=len(calls),
+                variants=variants.num_rows,
+                genotypes=genotypes.num_rows, samples=len(samples),
+                vcf=out_path, vcf_sha256=sha, identical=identical,
+                rod_coverage=rod_cov)

@@ -1,0 +1,114 @@
+"""The call plan: pure, replayable knob resolution (decide_plan convention).
+
+``decide_call_plan`` is the one place the calling pass's genome/genotype
+knobs are decided: stripe span (the genome-bin width each device counts),
+and the emission thresholds (min depth, min alt evidence).  PURE — the
+returned plan is a deterministic function of the keyword inputs, which
+the ``call_plan_selected`` event records in full (``inputs`` +
+``input_digest``), so a recorded sidecar can be replayed offline and the
+decision re-derived bit-for-bit (tools/check_executor.py).  Precedence
+is the executor's: explicit flags > environment > defaults.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+from typing import Optional
+
+#: default genome-stripe width: one [span, 12] int32 count tensor is
+#: ~1.5 MiB at 2^15 — small enough to keep many stripes resident, large
+#: enough that boundary-read duplication stays <1% at read length ~150
+DEFAULT_STRIPE_SPAN = 1 << 15
+#: emission floors: a biallelic SNP call needs this much total coverage
+#: and this many alt-supporting bases (mpileup-style evidence floor)
+DEFAULT_MIN_DEPTH = 2
+DEFAULT_MIN_ALT = 2
+#: stripes narrower than this make the boundary-duplication tax dominate
+MIN_STRIPE_SPAN = 1 << 10
+
+ENV_SPAN = "ADAM_TPU_CALL_SPAN"
+ENV_MIN_DEPTH = "ADAM_TPU_CALL_MIN_DEPTH"
+ENV_MIN_ALT = "ADAM_TPU_CALL_MIN_ALT"
+
+
+def _env_int(name: str) -> Optional[int]:
+    v = os.environ.get(name)
+    if v is None or v == "":
+        return None
+    try:
+        return int(v)
+    except ValueError:
+        raise ValueError(f"{name} must be an integer, got {v!r}")
+
+
+def resolve_call_knobs(stripe_span: Optional[int] = None,
+                       min_depth: Optional[int] = None,
+                       min_alt: Optional[int] = None) -> dict:
+    """Read the env half of the precedence ladder, hand decide_call_plan
+    its full keyword set (the only impure step, kept outside the decider
+    so the decision itself replays offline) and emit the decision's
+    ``call_plan_selected`` record — inputs + digest ride the event so
+    tools/check_executor.py can re-derive the plan bit-for-bit."""
+    from .. import obs
+
+    plan = decide_call_plan(
+        stripe_span=stripe_span, min_depth=min_depth, min_alt=min_alt,
+        env_stripe_span=_env_int(ENV_SPAN),
+        env_min_depth=_env_int(ENV_MIN_DEPTH),
+        env_min_alt=_env_int(ENV_MIN_ALT))
+    obs.emit("call_plan_selected", stripe_span=plan["stripe_span"],
+             min_depth=plan["min_depth"], min_alt=plan["min_alt"],
+             reason=plan["reason"], inputs=plan["inputs"],
+             input_digest=plan["input_digest"])
+    return plan
+
+
+def decide_call_plan(*, stripe_span: Optional[int] = None,
+                     min_depth: Optional[int] = None,
+                     min_alt: Optional[int] = None,
+                     env_stripe_span: Optional[int] = None,
+                     env_min_depth: Optional[int] = None,
+                     env_min_alt: Optional[int] = None) -> dict:
+    """The calling pass's frozen knob plan.
+
+    PURE — explicit flags outrank the (pre-read) environment values,
+    which outrank the defaults; out-of-range spans clamp with a recorded
+    reason rather than erroring, so a serve job with a bad span knob
+    degrades instead of failing admission-validated work.
+    """
+    inputs = dict(
+        stripe_span=None if stripe_span is None else int(stripe_span),
+        min_depth=None if min_depth is None else int(min_depth),
+        min_alt=None if min_alt is None else int(min_alt),
+        env_stripe_span=None if env_stripe_span is None
+        else int(env_stripe_span),
+        env_min_depth=None if env_min_depth is None else int(env_min_depth),
+        env_min_alt=None if env_min_alt is None else int(env_min_alt))
+    reasons = []
+
+    def pick(flag, env, default, label):
+        if flag is not None:
+            reasons.append(f"{label}-flag")
+            return flag
+        if env is not None:
+            reasons.append(f"{label}-env")
+            return env
+        return default
+
+    span = pick(inputs["stripe_span"], inputs["env_stripe_span"],
+                DEFAULT_STRIPE_SPAN, "span")
+    if span < MIN_STRIPE_SPAN:
+        reasons.append(f"span-clamped:{MIN_STRIPE_SPAN}")
+        span = MIN_STRIPE_SPAN
+    depth = max(pick(inputs["min_depth"], inputs["env_min_depth"],
+                     DEFAULT_MIN_DEPTH, "depth"), 1)
+    alt = max(pick(inputs["min_alt"], inputs["env_min_alt"],
+                   DEFAULT_MIN_ALT, "alt"), 1)
+    digest = hashlib.sha256(
+        json.dumps(inputs, sort_keys=True).encode()).hexdigest()[:16]
+    return dict(stripe_span=int(span), min_depth=int(depth),
+                min_alt=int(alt),
+                reason=";".join(reasons) or "default",
+                inputs=inputs, input_digest=digest)

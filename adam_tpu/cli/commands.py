@@ -314,6 +314,69 @@ class FlagStatCommand(Command):
 
 
 @register
+class CallCommand(Command):
+    name = "call"
+    help = ("Call biallelic SNPs: streamed pileup counts, the integer "
+            "device genotyper, VCF out (adam-tpu's fourth workload)")
+
+    def add_args(self, p: argparse.ArgumentParser) -> None:
+        p.add_argument("input", help="SAM/BAM file or ADAM Parquet dataset")
+        p.add_argument("output",
+                       help="output VCF (.vcf text, .vcf.gz/.bgz BGZF, "
+                            ".bcf binary)")
+        p.add_argument("-chunk_rows", type=int, default=1 << 18,
+                       help="reads per streamed chunk (bounds host "
+                            "memory)")
+        p.add_argument("-io_procs", type=int, default=1,
+                       help="BGZF inflate worker processes (>1 enables; "
+                            "byte-identical stream)")
+        p.add_argument("-stripe_span", type=int, default=None,
+                       help="genome-stripe width in bp (flag > "
+                            "ADAM_TPU_CALL_SPAN > 32768; "
+                            "decide_call_plan records the choice)")
+        p.add_argument("-min_depth", type=int, default=None,
+                       help="min total coverage to emit a call (flag > "
+                            "ADAM_TPU_CALL_MIN_DEPTH > 2)")
+        p.add_argument("-min_alt", type=int, default=None,
+                       help="min alt-supporting bases to emit a call "
+                            "(flag > ADAM_TPU_CALL_MIN_ALT > 2)")
+        p.add_argument("-sample", default=None,
+                       help="sample name for reads without "
+                            "recordGroupSample metadata")
+        p.add_argument("-validate", action="store_true",
+                       help="re-derive every call through the scalar "
+                            "oracle (call/oracle.py) and fail on any "
+                            "byte difference; also reports the rods-"
+                            "plane coverage summary")
+        add_executor_args(p)
+
+    def run(self, args) -> int:
+        from ..call.pipeline import streaming_call
+
+        kw = {}
+        if args.sample:
+            kw["default_sample"] = args.sample
+        res = streaming_call(
+            args.input, args.output, chunk_rows=args.chunk_rows,
+            io_procs=args.io_procs, stripe_span=args.stripe_span,
+            min_depth=args.min_depth, min_alt=args.min_alt,
+            executor_opts=executor_opts_from(args),
+            validate=args.validate, **kw)
+        print(f"{res['reads']} reads ({res['admitted']} admitted) -> "
+              f"{res['calls']} calls over {res['stripes']} stripes, "
+              f"{res['samples']} sample(s) -> {args.output}")
+        if res["rod_coverage"] is not None:
+            print(f"rod coverage {res['rod_coverage']:.4f}")
+        if args.validate:
+            if not res["identical"]:
+                print("call: device VCF differs from the scalar oracle",
+                      file=sys.stderr)
+                return 1
+            print("oracle: byte-identical")
+        return 0
+
+
+@register
 class Bam2AdamCommand(Command):
     name = "bam2adam"
     help = "Convert a SAM/BAM file to an ADAM Parquet dataset"

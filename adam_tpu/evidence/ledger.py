@@ -65,6 +65,7 @@ STAGE_SUCCESS_KEYS = {
                     "ragged_bqsr_ragged_per_sec",
                     "ragged_flagstat_ragged_per_sec"),
     "paged_race": ("paged_h2d_reduction",),
+    "call": ("call_reads_per_sec",),
 }
 
 #: pallas is special: the ok flags are present on failure too (False)

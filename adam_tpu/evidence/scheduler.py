@@ -75,7 +75,12 @@ STAGE_DEADLINES_S = {"probe": 150.0, "flagstat": 180.0, "transform": 280.0,
                      # processes driven at 2x accepted capacity; never
                      # in the TPU capture order — reached only via
                      # --worker/--only overload
-                     "overload": 600.0}
+                     "overload": 600.0,
+                     # variant-calling plane (ISSUE 17): solo call +
+                     # oracle differential + warm rerun + served
+                     # co-tenant leg; never in the TPU capture order —
+                     # reached only via --worker/--only call
+                     "call": 600.0}
 
 TIMEOUTS_ENV = "ADAM_TPU_BENCH_STAGE_TIMEOUTS"
 

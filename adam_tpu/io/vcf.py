@@ -317,8 +317,11 @@ def write_vcf(variants: pa.Table, genotypes: pa.Table, path_or_file,
     """Serialize variant/genotype tables to VCF text (adam2vcf path;
     header lines follow VcfHeaderUtils.scala:34-131).  ``.vcf.gz``/``.bgz``
     paths BGZF-compress; ``.bcf`` paths binary-encode (io/bcf.py) — export
-    forms the reference never had."""
-    close = False
+    forms the reference never had.
+
+    Path targets land durably (checkpoint.atomic_write tmp+fsync+rename,
+    GL003 discipline): a crash mid-emit leaves the old file or none, never
+    a torn VCF.  File-like targets are the caller's to make durable."""
     if hasattr(path_or_file, "write"):
         out = path_or_file
     elif str(path_or_file).endswith((".gz", ".bgz", ".bcf")):
@@ -330,26 +333,33 @@ def write_vcf(variants: pa.Table, genotypes: pa.Table, path_or_file,
             from .bcf import write_bcf
             write_bcf(buf.getvalue(), p)
         else:
+            from ..checkpoint import atomic_np_write
             from .bam import _BGZF_EOF, _bgzf_block
             data = buf.getvalue().encode()
-            with open(p, "wb") as fh:
+
+            def _write_bgzf(fh):
                 for i in range(0, len(data), 60000):
                     fh.write(_bgzf_block(data[i:i + 60000]))
                 fh.write(_BGZF_EOF)
+
+            atomic_np_write(p, _write_bgzf)
         return
     else:
-        out = open(path_or_file, "wt")
-        close = True
-    try:
-        sample_order: List[str] = []
-        for sid in genotypes.column("sampleId").to_pylist():
-            if sid not in sample_order:
-                sample_order.append(sid)
-        _write_vcf_header(out, variants, sample_order, seq_dict)
-        _write_vcf_records(out, variants, genotypes, sample_order)
-    finally:
-        if close:
-            out.close()
+        # durable-write discipline: buffer the text and land it with
+        # tmp+fsync+rename — a crash mid-emit never leaves a torn VCF
+        import io as _io
+
+        from ..checkpoint import atomic_write
+        buf = _io.StringIO()
+        write_vcf(variants, genotypes, buf, seq_dict)
+        atomic_write(str(path_or_file), buf.getvalue())
+        return
+    sample_order: List[str] = []
+    for sid in genotypes.column("sampleId").to_pylist():
+        if sid not in sample_order:
+            sample_order.append(sid)
+    _write_vcf_header(out, variants, sample_order, seq_dict)
+    _write_vcf_records(out, variants, genotypes, sample_order)
 
 
 def _write_vcf_header(out, variants: pa.Table, sample_order: List[str],

@@ -23,7 +23,8 @@ Spool layout::
 
 Job spec (canonicalized by :func:`canon_spec`)::
 
-    {"job_id": str, "tenant": str, "command": "flagstat" | "transform",
+    {"job_id": str, "tenant": str,
+     "command": "flagstat" | "transform" | "call",
      "input": str, "output": str | null, "args": {...},
      "priority": "low" | "normal" | "high",   # admission shed order
      "deadline_s": float | null}              # cancel if queued longer
@@ -60,13 +61,19 @@ SERVING_MARKER = "serving.json"
 #: jobs requeue innocently (serve/scheduler.py, the poison ladder)
 ACTIVE_MARKER = "active.json"
 
-COMMANDS = ("flagstat", "transform", "flagstat_range")
+COMMANDS = ("flagstat", "transform", "flagstat_range", "call")
 
 #: per-command arg whitelists — the spec's ``args`` may set only these
 #: (anything else is a validation error, not a silent drop)
 FLAGSTAT_ARGS = ("io_procs",)
 TRANSFORM_ARGS = ("markdup", "bqsr", "dbsnp_sites", "realign", "sort",
                   "io_procs", "io_threads")
+#: the variant-calling workload (call/pipeline.streaming_call): knob
+#: args only — the plan knobs ride the spec so ``decide_call_plan``
+#: runs server-side with the tenant's explicit values, while executor
+#: shape knobs stay server-owned like every other command
+CALL_ARGS = ("io_procs", "stripe_span", "min_depth", "min_alt",
+             "sample")
 #: ``flagstat_range`` is the fleet scheduler's shard sub-job (one unit
 #: range of a big input; serve/scheduler.py sums the exact counter
 #: monoid back into the parent's report) — first-class in the spool so
@@ -121,16 +128,17 @@ def canon_spec(spec: dict) -> dict:
     if not (isinstance(inp, str) and inp):
         raise ValueError("job spec: missing input path")
     output = spec.get("output")
-    if cmd == "transform":
+    if cmd in ("transform", "call"):
         if not (isinstance(output, str) and output):
-            raise ValueError("job spec: transform needs an output path")
+            raise ValueError(f"job spec: {cmd} needs an output path")
     elif output is not None:
         raise ValueError(f"job spec: {cmd} takes no output path")
     args = spec.get("args") or {}
     if not isinstance(args, dict):
         raise ValueError("job spec: args must be an object")
     allowed = {"flagstat": FLAGSTAT_ARGS, "transform": TRANSFORM_ARGS,
-               "flagstat_range": FLAGSTAT_RANGE_ARGS}[cmd]
+               "flagstat_range": FLAGSTAT_RANGE_ARGS,
+               "call": CALL_ARGS}[cmd]
     unknown = sorted(set(args) - set(allowed))
     if unknown:
         raise ValueError(f"job spec: unknown {cmd} args {unknown} "
@@ -146,6 +154,25 @@ def canon_spec(spec: dict) -> dict:
                 raise ValueError(
                     f"job spec: flagstat_range needs int arg "
                     f"{field!r} (got {v!r})")
+    if cmd == "call":
+        # knob args, when present, must be positive ints (sample a
+        # non-empty string) — a bad knob fails at submit time, never
+        # inside the serve loop
+        for field in ("io_procs", "stripe_span", "min_depth",
+                      "min_alt"):
+            v = args.get(field)
+            if v is not None and not (isinstance(v, int)
+                                      and not isinstance(v, bool)
+                                      and v >= 1):
+                raise ValueError(
+                    f"job spec: call arg {field!r} must be a "
+                    f"positive int (got {v!r})")
+        sample = args.get("sample")
+        if sample is not None and not (isinstance(sample, str)
+                                       and sample):
+            raise ValueError(
+                f"job spec: call arg 'sample' must be a non-empty "
+                f"string (got {sample!r})")
     # submit time rides the spec so the server can report queue-wait
     # per tenant; absent/garbage degrades to "unknown", never an error
     sub_at = spec.get("submitted_at")

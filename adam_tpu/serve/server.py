@@ -531,6 +531,28 @@ class ServeServer:
                 unit_rows=int(a["unit_rows"]),
                 io_procs=int(a.get("io_procs", self.io_procs)))
             return {"counts": counts.tolist(), "rows": rows}
+        if spec["command"] == "call":
+            # the variant-calling workload: same executor shape knobs
+            # as every co-tenant job (server-owned), plan knobs from
+            # the spec; the result doc carries the VCF's sha256 — the
+            # identity handle served-mode tests compare against solo
+            from ..call.pipeline import streaming_call
+
+            a = spec["args"]
+            kw = {}
+            if a.get("sample"):
+                kw["default_sample"] = str(a["sample"])
+            res = streaming_call(
+                spec["input"], spec["output"],
+                chunk_rows=self.chunk_rows,
+                io_procs=int(a.get("io_procs", self.io_procs)),
+                stripe_span=a.get("stripe_span"),
+                min_depth=a.get("min_depth"),
+                min_alt=a.get("min_alt"),
+                executor_opts=self.executor_opts, **kw)
+            return {k: res[k] for k in
+                    ("reads", "admitted", "stripes", "calls",
+                     "variants", "genotypes", "samples", "vcf_sha256")}
         return {"rows": self._execute_transform(spec)}
 
     def _execute_transform(self, spec: dict) -> int:

@@ -2128,6 +2128,154 @@ def _stage_paged_race(kind: str, is_tpu: bool):
     _emit("paged_race", payload)
 
 
+def _stage_call(kind: str, is_tpu: bool):
+    """The variant-calling plane (ISSUE 17): solo ``streaming_call``
+    throughput with the scalar-oracle identity check, a warm in-process
+    rerun (the zero-recompile pin + the warm throughput number), and a
+    served co-tenant leg — the same call job through an in-process
+    ``ServeServer`` next to a flagstat tenant, its VCF byte-identical
+    to the solo run.  Gated numbers (tools/bench_gate.py gate 9):
+    ``call_identical`` and ``call_served_identical`` true and
+    ``call_warm_recompiles`` == 0 unconditionally; the
+    ``call_reads_per_sec`` floor arms only when the box's own
+    ``host_parallel_capacity`` probe saw real parallelism (the gate-4/
+    6/8 discipline).  Process-internal by design — ``is_tpu`` only
+    stamps the platform."""
+    import shutil
+    import tempfile
+
+    import numpy as np
+    import pyarrow as pa
+
+    from adam_tpu import obs
+    from adam_tpu import schema as S
+    from adam_tpu.call.pipeline import streaming_call
+    from adam_tpu.io.parquet import DatasetWriter
+    from adam_tpu.serve import jobspec
+    from adam_tpu.serve.server import ServeServer
+
+    # sized for the committed sub-1-core container: the per-chunk cost
+    # is one pileup dispatch per (stripe, sample) over the whole padded
+    # chunk, so stripe count (contig_len / stripe_span), not read
+    # count, dominates CPU wall — a compact contig keeps the stage
+    # inside its deadline at ~8x coverage
+    n = int(os.environ.get("ADAM_TPU_BENCH_CALL_READS", 20_000))
+    L = 100
+    contig_len = 1 << 18
+    cap = 1 << 16
+    rng = np.random.RandomState(29)
+    tmp = tempfile.mkdtemp(prefix="bench_call_")
+    out: dict = {"platform": kind, "call_n_reads": n,
+                 "call_read_len": L, "cpu_count": os.cpu_count(),
+                 "host_parallel_capacity": _parallel_capacity()}
+    try:
+        pq_dir = os.path.join(tmp, "reads")
+        letters = np.frombuffer(b"ACGT", np.uint8)
+        # reference-derived reads: a random reference, ~1-per-1000
+        # planted het SNPs (alt on half the covering reads), 0.2%
+        # sequencing error — realistic call density, so the VCF build
+        # is proportionate and the wall measures the pileup/genotype
+        # plane, not a call-on-every-position pathology
+        ref_codes = rng.randint(0, 4, contig_len)
+        alt_codes = (ref_codes + rng.randint(1, 4, contig_len)) % 4
+        snp_mask = rng.rand(contig_len) < 1e-3
+        part = 1 << 17
+        with DatasetWriter(pq_dir, part_rows=part) as w:
+            for lo in range(0, n, part):
+                m = min(part, n - lo)
+                starts_np = rng.randint(0, contig_len - L, m)
+                idx = starts_np[:, None] + np.arange(L)[None, :]
+                bases = ref_codes[idx]
+                take_alt = snp_mask[idx] & (rng.rand(m, L) < 0.5)
+                bases = np.where(take_alt, alt_codes[idx], bases)
+                err = rng.rand(m, L) < 2e-3
+                bases = np.where(
+                    err, (bases + rng.randint(1, 4, (m, L))) % 4,
+                    bases)
+                seqs = letters[bases].view(f"S{L}").ravel()
+                quals = (rng.randint(30, 41, (m, L)) + 33).astype(
+                    np.uint8).view(f"S{L}").ravel()
+                data = {
+                    "readName": pa.array(
+                        [f"r{lo + i}" for i in range(m)]),
+                    "sequence": pa.array(seqs.astype(str)),
+                    "qual": pa.array(quals.astype(str)),
+                    "cigar": pa.array([f"{L}M"] * m),
+                    "mismatchingPositions": pa.array([str(L)] * m),
+                    "referenceId": pa.array(np.zeros(m, np.int32),
+                                            pa.int32()),
+                    "referenceName": pa.array(["chr1"] * m),
+                    "start": pa.array(starts_np.astype(np.int64),
+                                      pa.int64()),
+                    "mapq": pa.array(np.full(m, 60, np.int32),
+                                     pa.int32()),
+                    "flags": pa.array(
+                        rng.choice([0, 16], m).astype(np.int64),
+                        pa.int64()),
+                }
+                cols = {
+                    nm: data[nm].cast(S.READ_SCHEMA.field(nm).type)
+                    if nm in data
+                    else pa.nulls(m, S.READ_SCHEMA.field(nm).type)
+                    for nm in S.READ_SCHEMA.names}
+                w.write(pa.Table.from_pydict(cols,
+                                             schema=S.READ_SCHEMA))
+
+        # solo run WITH the oracle differential (the identity number)
+        solo_vcf = os.path.join(tmp, "solo.vcf")
+        t0 = time.perf_counter()
+        solo = streaming_call(pq_dir, solo_vcf, chunk_rows=cap,
+                              validate=True)
+        out["call_solo_wall_s"] = round(time.perf_counter() - t0, 3)
+        out["call_identical"] = bool(solo["identical"])
+        out["call_calls"] = solo["calls"]
+        out["call_vcf_sha256"] = solo["vcf_sha256"]
+
+        # warm rerun: every compiled shape must be reused (the PR 10
+        # zero-recompile discipline), and its wall is the throughput
+        # number — compile cost amortized, what a warm server delivers
+        c0 = obs.registry().counter("compile_count").value
+        t0 = time.perf_counter()
+        warm = streaming_call(pq_dir, os.path.join(tmp, "warm.vcf"),
+                              chunk_rows=cap)
+        warm_wall = time.perf_counter() - t0
+        out["call_warm_wall_s"] = round(warm_wall, 3)
+        out["call_warm_recompiles"] = int(
+            obs.registry().counter("compile_count").value - c0)
+        out["call_reads_per_sec"] = round(n / max(warm_wall, 1e-9))
+        out["call_warm_sha_matches"] = bool(
+            warm["vcf_sha256"] == solo["vcf_sha256"])
+
+        # served co-tenant leg: the call job next to a flagstat tenant
+        # through the real spool/admission path, in-process (warm)
+        spool = os.path.join(tmp, "spool")
+        served_vcf = os.path.join(tmp, "served.vcf")
+        jid = jobspec.submit_job(spool, {
+            "command": "call", "tenant": "t_call", "input": pq_dir,
+            "output": served_vcf, "args": {}})
+        jobspec.submit_job(spool, {
+            "command": "flagstat", "tenant": "t_flag",
+            "input": pq_dir, "args": {}})
+        srv = ServeServer(spool, chunk_rows=cap, poll_s=0.01)
+        t0 = time.perf_counter()
+        done = 0
+        while done < 2:
+            done += srv._round()
+        out["call_served_wall_s"] = round(time.perf_counter() - t0, 3)
+        doc = jobspec.read_result(spool, jid)
+        with open(solo_vcf, "rb") as f:
+            solo_bytes = f.read()
+        with open(served_vcf, "rb") as f:
+            served_bytes = f.read()
+        out["call_served_identical"] = bool(
+            doc and doc.get("ok")
+            and doc["result"]["vcf_sha256"] == solo["vcf_sha256"]
+            and served_bytes == solo_bytes)
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+    _emit("call", out)
+
+
 _STAGE_BODIES = {"flagstat": _stage_flagstat, "transform": _stage_transform,
                  "bqsr_race": _stage_bqsr_race, "pallas": _stage_pallas,
                  "bqsr_race8": _stage_bqsr_race8,
@@ -2150,7 +2298,11 @@ _STAGE_BODIES = {"flagstat": _stage_flagstat, "transform": _stage_transform,
                  # overload protection (ISSUE 14): process-level, not
                  # in the TPU capture order — run via --worker/--only
                  # overload
-                 "overload": _stage_overload}
+                 "overload": _stage_overload,
+                 # variant-calling plane (ISSUE 17): process-internal,
+                 # not in the TPU capture order — run via --worker/
+                 # --only call
+                 "call": _stage_call}
 
 
 def _worker_stages(stages: list[str]) -> None:

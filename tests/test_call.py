@@ -1,0 +1,518 @@
+"""Variant calling plane tests (ISSUE 17).
+
+Pins, per docs/CALL.md:
+
+* THE oracle differential: the batched device pass (pack -> stripe
+  routing -> pileup_count_kernel -> genotype_fields_kernel -> VCF)
+  reproduces the scalar Python oracle byte-for-byte over adversarial
+  inputs — deletions, clips (leading/trailing/hard), insertions, skip
+  ops, N/out-of-alphabet bases (the channel-wrap edge), qual underflow,
+  null mapq/cigar/sequence, multi-sample, multi-contig, stripe-boundary
+  reads — and the identity is invariant to chunking;
+* the kernel and its scalar twin (``genotype_site``) produce the same
+  GT_FIELDS integers, including argmax/argmin tie edges and zero
+  coverage;
+* layout byte-identity: the ragged executor layout produces the same
+  VCF bytes as padded;
+* serve identity: a ``call`` job through the warm serve plane — solo
+  AND co-tenant alongside packable flagstat jobs — lands the same
+  ``vcf_sha256`` and file bytes as the in-process run, with whitelisted
+  knob args honored;
+* fleet chaos: SIGKILL a fleet worker mid-call; the job requeues and
+  the output stays byte-identical (the durable tmp+rename VCF writer
+  never leaves a torn file);
+* warm reruns recompile nothing (compile_count delta 0);
+* ``decide_call_plan`` is pure/replayable (flag > env > default
+  precedence, span clamp with a recorded reason, digest-stable) and the
+  CLI round-trips the knobs into the ``call_plan_selected`` event;
+* every produced sidecar validates through tools/check_metrics.py and
+  replays through tools/check_executor.py.
+"""
+
+from __future__ import annotations
+
+import glob
+import hashlib
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from adam_tpu import obs
+from adam_tpu import schema as S
+from adam_tpu.call.genotyper import (GT_FIELDS, genotype_fields_kernel,
+                                     genotype_site)
+from adam_tpu.call.oracle import admit_read, parse_cigar
+from adam_tpu.call.pipeline import streaming_call
+from adam_tpu.call.plan import (DEFAULT_MIN_ALT, DEFAULT_MIN_DEPTH,
+                                DEFAULT_STRIPE_SPAN, MIN_STRIPE_SPAN,
+                                decide_call_plan, resolve_call_knobs)
+from adam_tpu.io.parquet import DatasetWriter
+from adam_tpu.parallel.pileup import N_CHANNELS
+from adam_tpu.serve import ServeServer, jobspec
+from adam_tpu.serve.scheduler import FleetServeScheduler
+
+from _synth_reads import random_reads_table
+
+ROOT = os.path.join(os.path.dirname(__file__), "..")
+CHUNK = 1 << 13
+
+
+def _reads_table(rows):
+    cols = {name: [] for name in S.READ_SCHEMA.names}
+    for row in rows:
+        for name in S.READ_SCHEMA.names:
+            cols[name].append(row.get(name))
+    return pa.Table.from_pydict(cols, schema=S.READ_SCHEMA)
+
+
+def _read(sequence="ACGTACGTAC", cigar="10M", start=100, mapq=50,
+          qv=35, qual=None, name="r", refid=0, refname="chr1",
+          reflen=2_000_000, flags=0, **kw):
+    if qual is None:
+        qual = "".join(chr(qv + 33) for _ in sequence)
+    return dict(readName=name, sequence=sequence, qual=qual,
+                cigar=cigar, start=start, mapq=mapq, flags=flags,
+                referenceId=refid, referenceName=refname,
+                referenceLength=reflen, **kw)
+
+
+def _adversarial_rows():
+    rows = []
+    # stacked het evidence (3 ref-ish + 3 alt-ish reads at one locus)
+    for i in range(3):
+        rows.append(_read(name=f"refA{i}", sequence="A" * 10, qv=34 + i))
+    for i in range(3):
+        rows.append(_read(name=f"altC{i}", sequence="C" * 10, qv=33 + i))
+    # one reverse-strand rider on the same locus
+    rows.append(_read(name="rev", sequence="A" * 10,
+                      flags=S.FLAG_REVERSE))
+    # CIGAR zoo (all read-consumption-consistent)
+    rows.append(_read(name="del", sequence="ACGTACGTAC" * 2,
+                      cigar="10M2D10M", start=105))
+    rows.append(_read(name="sclip", sequence="G" * 5 + "ACGTACGTAC"
+                      + "G" * 5, cigar="5S10M5S", start=100))
+    rows.append(_read(name="tclip", sequence="ACGTACGTAC",
+                      cigar="8M2S", start=300))
+    rows.append(_read(name="ins", sequence="ACGTAAACGTA",
+                      cigar="5M3I3M", start=100))
+    rows.append(_read(name="lins", sequence="ACGTACGTAC",
+                      cigar="3I7M", start=200))
+    rows.append(_read(name="skip", sequence="ACGTACGTAC",
+                      cigar="5M100N5M", start=100))
+    rows.append(_read(name="hard", sequence="ACGTACGTAC",
+                      cigar="2H10M3H", start=200))
+    # alphabet edges: N/ambiguity -> OTHER, out-of-alphabet byte wraps
+    # to the last channel, lowercase is out-of-alphabet too
+    rows.append(_read(name="nbase", sequence="ACGNNCGTNN", start=400))
+    rows.append(_read(name="wrap", sequence="AC*TACGTAC", start=420))
+    rows.append(_read(name="lower", sequence="acgtacgtac", start=440))
+    # qual edges: bytes below '!' decode negative and clamp at 0; '~'
+    # is the top of the sanger range
+    rows.append(_read(name="qlow", sequence="A" * 10,
+                      qual=chr(32) * 10, start=460))
+    rows.append(_read(name="qhigh", sequence="C" * 10,
+                      qual="~" * 10, start=460))
+    # null planes: no mapq, no cigar, empty sequence
+    rows.append(_read(name="nomapq", sequence="G" * 10, mapq=None,
+                      start=480))
+    rows.append(_read(name="starcig", cigar="*", start=500))
+    rows.append(_read(name="nullcig", cigar=None, start=500))
+    rows.append(_read(name="empty", sequence="", qual="", cigar=None,
+                      start=520))
+    # rejected by the shared admission rule (both paths)
+    rows.append(_read(name="unmapped", flags=S.FLAG_UNMAPPED))
+    rows.append(_read(name="badref", refid=-1, refname=None,
+                      reflen=None))
+    rows.append(_read(name="badstart", start=-5))
+    rows.append(_read(name="overbudget", sequence="A" * 17,
+                      cigar="1M" * 17))
+    rows.append(_read(name="overconsume", sequence="ACGTA",
+                      cigar="20M", start=50))
+    # second sample, second contig
+    for i in range(2):
+        rows.append(_read(name=f"sB{i}", sequence="T" * 10, start=205,
+                          recordGroupSample="sampleB"))
+    rows.append(_read(name="sB2", sequence="G" * 10, start=205,
+                      recordGroupSample="sampleB"))
+    rows.append(_read(name="c2a", sequence="A" * 10, refid=1,
+                      refname="chr2", reflen=500_000, start=50))
+    rows.append(_read(name="c2b", sequence="T" * 10, refid=1,
+                      refname="chr2", reflen=500_000, start=50))
+    # stripe-boundary straddlers (span 1024: positions 1019..1028)
+    for i in range(2):
+        rows.append(_read(name=f"bdryC{i}", sequence="C" * 10,
+                          start=1019))
+        rows.append(_read(name=f"bdryT{i}", sequence="T" * 10,
+                          start=1019))
+    return rows
+
+
+def _write_ds(path, tbl):
+    with DatasetWriter(str(path), part_rows=1 << 14) as w:
+        w.write(tbl)
+    return str(path)
+
+
+def _expected_admitted(tbl):
+    flags_c = tbl.column("flags").to_pylist()
+    refid_c = tbl.column("referenceId").to_pylist()
+    start_c = tbl.column("start").to_pylist()
+    seq_c = tbl.column("sequence").to_pylist()
+    cigar_c = tbl.column("cigar").to_pylist()
+    return sum(
+        admit_read(flags_c[i], refid_c[i], start_c[i],
+                   parse_cigar(cigar_c[i]), len(seq_c[i] or ""))
+        for i in range(tbl.num_rows))
+
+
+def _file_sha(path):
+    with open(path, "rb") as f:
+        return hashlib.sha256(f.read()).hexdigest()
+
+
+def _chaos_env(tmp_path, rules):
+    plan_path = str(tmp_path / "faults.json")
+    with open(plan_path, "w") as f:
+        json.dump({"rules": rules}, f)
+    env = dict(os.environ)
+    env["ADAM_TPU_FAULT_PLAN"] = plan_path
+    return env
+
+
+def _run_validators(*paths):
+    for tool in ("check_metrics", "check_executor"):
+        r = subprocess.run(
+            [sys.executable, os.path.join(ROOT, "tools", f"{tool}.py")]
+            + list(paths), capture_output=True, text=True)
+        assert r.returncode == 0, f"{tool}: {r.stdout}\n{r.stderr}"
+
+
+# ---------------------------------------------------------------------------
+# the oracle differential
+# ---------------------------------------------------------------------------
+
+def test_adversarial_reads_oracle_byte_identical(tmp_path):
+    """THE acceptance pin: the device pass over the full adversarial
+    zoo is byte-identical to the scalar oracle, both paths admit the
+    same read set, and the identity is chunking- and layout-invariant."""
+    tbl = _reads_table(_adversarial_rows())
+    inp = _write_ds(tmp_path / "reads", tbl)
+    out = str(tmp_path / "out.vcf")
+    res = streaming_call(inp, out, chunk_rows=4, stripe_span=1024,
+                         min_depth=1, min_alt=1, validate=True)
+    assert res["identical"] is True
+    assert res["reads"] == tbl.num_rows
+    assert res["admitted"] == _expected_admitted(tbl)
+    assert res["admitted"] < res["reads"]          # some really rejected
+    assert res["calls"] > 0 and res["samples"] == 2
+    assert res["stripes"] >= 3                     # boundary straddle
+    # the emitted file is the hashed byte stream, durably landed
+    assert _file_sha(out) == res["vcf_sha256"]
+    with open(out) as f:
+        assert f.readline() == "##fileformat=VCFv4.1\n"
+    # chunking cannot change the bytes (monoid fold)
+    big = streaming_call(inp, None, chunk_rows=1 << 14,
+                         stripe_span=1024, min_depth=1, min_alt=1)
+    assert big["vcf_sha256"] == res["vcf_sha256"]
+    # neither can the ragged layout
+    rag = streaming_call(inp, None, chunk_rows=1 << 14,
+                         stripe_span=1024, min_depth=1, min_alt=1,
+                         executor_opts={"ragged": True})
+    assert rag["vcf_sha256"] == res["vcf_sha256"]
+
+
+def test_random_reads_oracle_differential_and_rods(tmp_path):
+    """Bulk differential on random reads (two samples), plus the rods
+    validation leg: coverage is a recorded number."""
+    tbl = random_reads_table(1200, 100, seed=11, contig_len=120_000)
+    rng = np.random.RandomState(7)
+    samples = pa.array(
+        np.where(rng.randint(0, 2, tbl.num_rows) == 0, "sA", "sB"))
+    tbl = tbl.set_column(
+        tbl.column_names.index("recordGroupSample"),
+        "recordGroupSample", samples.cast(pa.string()))
+    inp = _write_ds(tmp_path / "reads", tbl)
+    res = streaming_call(inp, str(tmp_path / "out.vcf"),
+                         chunk_rows=CHUNK, min_depth=2, min_alt=1,
+                         validate=True)
+    assert res["identical"] is True
+    assert res["admitted"] == tbl.num_rows
+    assert res["samples"] == 2 and res["calls"] > 0
+    # diploid rows over the site-consensus survivors: always even,
+    # never more than two per emitted call (cross-sample REF conflicts
+    # drop deterministically — docs/CALL.md §limitations)
+    assert 0 < res["genotypes"] <= 2 * res["calls"]
+    assert res["genotypes"] % 2 == 0
+    assert res["rod_coverage"] is not None and res["rod_coverage"] > 0
+
+
+def test_ragged_layout_byte_identical_files(tmp_path):
+    """Padded and ragged layouts land byte-identical VCF files."""
+    inp = _write_ds(tmp_path / "reads",
+                    random_reads_table(800, 80, seed=3,
+                                       contig_len=40_000))
+    out_p, out_r = str(tmp_path / "p.vcf"), str(tmp_path / "r.vcf")
+    a = streaming_call(inp, out_p, chunk_rows=256, min_depth=1,
+                       min_alt=1)
+    b = streaming_call(inp, out_r, chunk_rows=256, min_depth=1,
+                       min_alt=1, executor_opts={"ragged": True})
+    assert a["vcf_sha256"] == b["vcf_sha256"]
+    with open(out_p, "rb") as fp, open(out_r, "rb") as fr:
+        assert fp.read() == fr.read()
+
+
+# ---------------------------------------------------------------------------
+# the kernel and its scalar twin
+# ---------------------------------------------------------------------------
+
+def test_genotype_kernel_matches_scalar_twin():
+    """The device genotyper and genotype_site produce the same GT_FIELDS
+    integers — random tensors plus the tie/zero edges."""
+    rng = np.random.RandomState(0)
+    counts = rng.randint(0, 200, size=(256, N_CHANNELS)).astype(np.int32)
+    # edges: zero coverage, four-way base tie, ref/alt tie, PL tie
+    counts[0] = 0
+    counts[1, :4] = 5
+    counts[2, :4] = (7, 7, 0, 0)
+    counts[3, :4] = (3, 3, 3, 0)
+    out = np.asarray(genotype_fields_kernel(counts))
+    assert out.dtype == np.int32
+    for i in range(counts.shape[0]):
+        f = genotype_site(counts[i])
+        assert [f[k] for k in GT_FIELDS] == out[i].tolist(), \
+            (i, counts[i].tolist())
+
+
+# ---------------------------------------------------------------------------
+# the pure plan
+# ---------------------------------------------------------------------------
+
+def test_decide_call_plan_pure_replayable():
+    d = decide_call_plan(stripe_span=4096, min_depth=3,
+                         env_stripe_span=8192, env_min_alt=5)
+    # flag > env > default, each knob independently
+    assert (d["stripe_span"], d["min_depth"], d["min_alt"]) == \
+        (4096, 3, 5)
+    for tag in ("span-flag", "depth-flag", "alt-env"):
+        assert tag in d["reason"]
+    # replaying the recorded inputs reproduces the decision exactly
+    assert decide_call_plan(**d["inputs"]) == d
+    # digest is input-stable and input-sensitive
+    assert decide_call_plan(**d["inputs"])["input_digest"] == \
+        d["input_digest"]
+    assert decide_call_plan(stripe_span=2048)["input_digest"] != \
+        d["input_digest"]
+    # defaults
+    base = decide_call_plan()
+    assert (base["stripe_span"], base["min_depth"], base["min_alt"]) == \
+        (DEFAULT_STRIPE_SPAN, DEFAULT_MIN_DEPTH, DEFAULT_MIN_ALT)
+    assert base["reason"] == "default"
+    # a bad span clamps with a recorded reason instead of erroring
+    c = decide_call_plan(stripe_span=16, min_depth=0, min_alt=-2)
+    assert c["stripe_span"] == MIN_STRIPE_SPAN
+    assert f"span-clamped:{MIN_STRIPE_SPAN}" in c["reason"]
+    assert c["min_depth"] == 1 and c["min_alt"] == 1
+
+
+def test_call_knob_env_round_trip(monkeypatch):
+    monkeypatch.setenv("ADAM_TPU_CALL_SPAN", "2048")
+    monkeypatch.setenv("ADAM_TPU_CALL_MIN_DEPTH", "5")
+    monkeypatch.setenv("ADAM_TPU_CALL_MIN_ALT", "4")
+    plan = resolve_call_knobs()
+    assert (plan["stripe_span"], plan["min_depth"], plan["min_alt"]) == \
+        (2048, 5, 4)
+    assert "span-env" in plan["reason"]
+    # explicit flags outrank the environment
+    assert resolve_call_knobs(stripe_span=4096)["stripe_span"] == 4096
+    monkeypatch.setenv("ADAM_TPU_CALL_SPAN", "not-a-number")
+    with pytest.raises(ValueError):
+        resolve_call_knobs()
+
+
+# ---------------------------------------------------------------------------
+# CLI round-trip + telemetry
+# ---------------------------------------------------------------------------
+
+def test_cli_round_trip_events_and_validators(tmp_path):
+    """adam-tpu call -validate round-trips the knobs into the
+    call_plan_selected event, the sidecar's stripe events sum to the
+    emitted calls, and the sidecar passes both offline validators."""
+    from adam_tpu.cli.main import main
+
+    inp = _write_ds(tmp_path / "reads",
+                    random_reads_table(900, 100, seed=9,
+                                       contig_len=60_000))
+    out = str(tmp_path / "cli.vcf")
+    sidecar = str(tmp_path / "call.metrics.jsonl")
+    rc = main(["call", inp, out, "-chunk_rows", str(CHUNK),
+               "-stripe_span", "4096", "-min_depth", "1",
+               "-min_alt", "1", "-validate", "-metrics", sidecar])
+    assert rc == 0 and os.path.exists(out)
+    events = [json.loads(ln) for ln in open(sidecar) if ln.strip()]
+    plan = [e for e in events if e["event"] == "call_plan_selected"]
+    assert plan and plan[0]["stripe_span"] == 4096
+    assert "span-flag" in plan[0]["reason"]
+    emit = [e for e in events if e["event"] == "call_emit"]
+    assert len(emit) == 1 and emit[0]["identical"] is True
+    assert emit[0]["vcf_sha256"] == _file_sha(out)
+    stripes = [e for e in events if e["event"] == "call_stripe"]
+    assert stripes
+    assert sum(e["called"] for e in stripes) == emit[0]["calls"]
+    _run_validators(sidecar)
+
+
+def test_cli_validate_fails_loud_on_mismatch(tmp_path, monkeypatch):
+    """-validate is a real gate: a forced oracle mismatch exits 1."""
+    from adam_tpu.cli.main import main
+    import adam_tpu.call.pipeline as pipeline
+
+    inp = _write_ds(tmp_path / "reads",
+                    random_reads_table(50, 50, seed=1,
+                                       contig_len=5_000))
+    monkeypatch.setattr(pipeline, "oracle_vcf_text",
+                        lambda *a, **k: "not the same bytes")
+    rc = main(["call", inp, str(tmp_path / "bad.vcf"), "-min_depth",
+               "1", "-min_alt", "1", "-validate"])
+    assert rc == 1
+
+
+# ---------------------------------------------------------------------------
+# serve identity: solo, served, packed
+# ---------------------------------------------------------------------------
+
+def test_serve_call_job_byte_identical_solo_and_packed(tmp_path):
+    """A call job through the warm serve plane — alone, then co-tenant
+    with packable flagstat jobs in one round — lands the same bytes as
+    the in-process run, with whitelisted knob args honored."""
+    tbl = random_reads_table(2_000, 100, seed=5, contig_len=100_000)
+    inp = _write_ds(tmp_path / "reads", tbl)
+    args = {"stripe_span": 4096, "min_depth": 1, "min_alt": 1}
+    solo_out = str(tmp_path / "solo.vcf")
+    solo = streaming_call(inp, solo_out, chunk_rows=CHUNK,
+                          stripe_span=4096, min_depth=1, min_alt=1)
+
+    spool = str(tmp_path / "spool")
+    out1 = str(tmp_path / "served.vcf")
+    j1 = jobspec.submit_job(spool, {"tenant": "a", "command": "call",
+                                    "input": inp, "output": out1,
+                                    "args": args})
+    srv = ServeServer(spool, chunk_rows=CHUNK, poll_s=0.01,
+                      max_concurrent=4)
+    assert srv.run(max_jobs=1, idle_timeout_s=60.0) == 1
+    doc = jobspec.read_result(spool, j1)
+    assert doc["ok"], doc
+    assert doc["result"]["vcf_sha256"] == solo["vcf_sha256"]
+    assert doc["result"]["calls"] == solo["calls"]
+    with open(solo_out, "rb") as fs, open(out1, "rb") as fo:
+        assert fs.read() == fo.read()
+
+    # co-tenant round: a call job next to two packable flagstat jobs
+    out2 = str(tmp_path / "packed.vcf")
+    j2 = jobspec.submit_job(spool, {"tenant": "b", "command": "call",
+                                    "input": inp, "output": out2,
+                                    "args": args})
+    for t in ("x", "y"):
+        jobspec.submit_job(spool, {"tenant": t, "command": "flagstat",
+                                   "input": inp})
+    assert srv.run(max_jobs=3, idle_timeout_s=60.0) == 3
+    doc2 = jobspec.read_result(spool, j2)
+    assert doc2["ok"], doc2
+    assert doc2["result"]["vcf_sha256"] == solo["vcf_sha256"]
+    with open(solo_out, "rb") as fs, open(out2, "rb") as fo:
+        assert fs.read() == fo.read()
+
+
+def test_serve_rejects_bad_call_specs(tmp_path):
+    """Admission-time spec validation: call needs an output path and
+    only whitelisted, well-typed args."""
+    spool = str(tmp_path / "spool")
+    with pytest.raises(ValueError):
+        jobspec.submit_job(spool, {"command": "call", "input": "x"})
+    with pytest.raises(ValueError):
+        jobspec.submit_job(spool, {"command": "call", "input": "x",
+                                   "output": "o.vcf",
+                                   "args": {"rm_rf": "/"}})
+    with pytest.raises(ValueError):
+        jobspec.submit_job(spool, {"command": "call", "input": "x",
+                                   "output": "o.vcf",
+                                   "args": {"min_depth": 0}})
+    with pytest.raises(ValueError):
+        jobspec.submit_job(spool, {"command": "call", "input": "x",
+                                   "output": "o.vcf",
+                                   "args": {"sample": ""}})
+
+
+# ---------------------------------------------------------------------------
+# warm reruns recompile nothing
+# ---------------------------------------------------------------------------
+
+def test_warm_rerun_recompiles_nothing(tmp_path):
+    inp = _write_ds(tmp_path / "reads",
+                    random_reads_table(1_000, 100, seed=13,
+                                       contig_len=80_000))
+    first = streaming_call(inp, None, chunk_rows=CHUNK, min_depth=1,
+                           min_alt=1)
+    before = obs.registry().snapshot()["counters"].get(
+        "compile_count", 0)
+    again = streaming_call(inp, None, chunk_rows=CHUNK, min_depth=1,
+                           min_alt=1)
+    after = obs.registry().snapshot()["counters"].get(
+        "compile_count", 0)
+    assert after == before
+    assert again["vcf_sha256"] == first["vcf_sha256"]
+
+
+# ---------------------------------------------------------------------------
+# fleet chaos: SIGKILL mid-call
+# ---------------------------------------------------------------------------
+
+def test_fleet_worker_sigkill_mid_call_byte_identical(tmp_path):
+    """SIGKILL fleet worker 1 mid-call (worker-scoped device_dispatch
+    kill, incarnation 0): the job requeues through decide_requeue and
+    every output file is byte-identical to the in-process run — the
+    durable VCF writer never leaves a torn file behind the kill."""
+    inp = _write_ds(tmp_path / "reads",
+                    random_reads_table(2_000, 100, seed=17,
+                                       contig_len=100_000))
+    args = {"min_depth": 1, "min_alt": 1}
+    solo_out = str(tmp_path / "solo.vcf")
+    solo = streaming_call(inp, solo_out, chunk_rows=CHUNK, min_depth=1,
+                          min_alt=1)
+
+    spool = str(tmp_path / "spool")
+    outs = {}
+    for i in range(2):
+        out = str(tmp_path / f"fleet{i}.vcf")
+        jobspec.submit_job(spool, {"job_id": f"c{i}",
+                                   "tenant": f"t{i}",
+                                   "command": "call", "input": inp,
+                                   "output": out, "args": args})
+        outs[f"c{i}"] = out
+    env = _chaos_env(tmp_path, [
+        {"site": "device_dispatch", "fault": "kill", "occurrence": 2,
+         "worker": 1, "incarnation": 0}])
+    sidecar = str(tmp_path / "sched.metrics.jsonl")
+    with obs.metrics_run(sidecar, argv=["fleet-call-kill"], config={}):
+        sched = FleetServeScheduler(spool, hosts=2, chunk_rows=CHUNK,
+                                    poll_s=0.02, env=env)
+        assert sched.run(max_jobs=2, idle_timeout_s=180.0) == 2
+    with open(solo_out, "rb") as f:
+        solo_bytes = f.read()
+    for jid, out in outs.items():
+        doc = jobspec.read_result(spool, jid)
+        assert doc["ok"], doc
+        assert doc["result"]["vcf_sha256"] == solo["vcf_sha256"]
+        with open(out, "rb") as f:
+            assert f.read() == solo_bytes
+    evs = [json.loads(ln) for ln in open(sidecar) if ln.strip()]
+    assert [e for e in evs if e["event"] == "job_requeued"
+            and e["cause"] == "worker_death"]
+    # worker 1 really died and respawned
+    assert glob.glob(os.path.join(spool, "fleet", "logs",
+                                  "w1-inc1.log"))
+    _run_validators(sidecar)

@@ -82,6 +82,16 @@ differ) with ``--threshold`` where applicable:
    fresh artifact (``--overload NEW_O.json``, from ``python bench.py
    --worker overload``) additionally diffs both serve walls at 10%.
 
+9. **The variant-calling plane is pinned.**  ``BENCH_CALL.json`` (the
+   committed ``call`` artifact, ISSUE 17) runs solo ``streaming_call``
+   with the scalar-oracle differential, a warm rerun, and the served
+   co-tenant leg.  Unconditional: the device VCF byte-identical to the
+   oracle, the served VCF byte-identical to solo, the warm rerun's sha
+   unchanged, zero warm recompiles.  Capacity-armed (the gate-4/6/8
+   discipline): the warm read-throughput floor.  A fresh artifact
+   (``--call NEW_C.json``, from ``python bench.py --worker call``)
+   additionally diffs the call walls at 10%.
+
 Usage::
 
     python tools/bench_gate.py                       # committed gates
@@ -92,6 +102,7 @@ Usage::
     python tools/bench_gate.py --fleet-serve NEW_FS.json  # + diff
     python tools/bench_gate.py --paged NEW_P.json    # + paged diff
     python tools/bench_gate.py --overload NEW_O.json # + overload diff
+    python tools/bench_gate.py --call NEW_C.json     # + call diff
 
 Exit 0 when every gate holds; the first failing check's exit code
 otherwise.
@@ -233,6 +244,95 @@ OVERLOAD_GOODPUT_MIN_ANY = 0.35
 #: the overload walls a fresh artifact is regression-diffed on
 OVERLOAD_WALL_KEYS = ("overload_baseline_wall_s",
                       "overload_armed_wall_s")
+
+CALL = os.path.join(ROOT, "BENCH_CALL.json")
+
+#: the ISSUE 17 acceptance numbers.  Unconditional: the device VCF
+#: byte-identical to the scalar oracle (``call_identical``), the
+#: served co-tenant VCF byte-identical to the solo run
+#: (``call_served_identical``), the warm rerun's sha unchanged, and
+#: zero warm recompiles.  Capacity-armed (the gate-4/6/8 discipline):
+#: the warm-run read throughput floor applies only when the artifact's
+#: own ``host_parallel_capacity`` probe saw real parallelism — the
+#: committed sub-1-core container delivers ~0.8-1.0x, so on it the
+#: rate is reported, not gated.
+CALL_READS_PER_SEC_FLOOR = 800
+CALL_CAPACITY_FLOOR = 1.2
+#: enforced unconditionally (the SHARD_MIN_SPEEDUP_ANY discipline):
+#: box load can halve the rate, but below this the calling machinery
+#: itself regressed
+CALL_READS_PER_SEC_MIN_ANY = 100
+
+#: the call walls a fresh artifact is regression-diffed on
+CALL_WALL_KEYS = ("call_solo_wall_s", "call_warm_wall_s",
+                  "call_served_wall_s")
+
+
+def _check_call_artifact(path: str) -> int:
+    """Gate 9's committed-artifact half: oracle identity, served
+    co-tenant identity, warm-rerun sha stability, zero warm recompiles
+    (unconditional); warm read throughput floor (capacity-armed)."""
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, ValueError) as e:
+        print(f"bench_gate: unreadable call artifact {path}: {e}",
+              file=sys.stderr)
+        return 2
+    rc = 0
+    if doc.get("call_identical") is not True:
+        print(f"bench_gate: call_identical is not true in {path} — "
+              "the device VCF is no longer byte-identical to the "
+              "scalar oracle", file=sys.stderr)
+        rc = 1
+    if doc.get("call_served_identical") is not True:
+        print(f"bench_gate: call_served_identical is not true in "
+              f"{path} — the served co-tenant VCF diverged from the "
+              "solo run", file=sys.stderr)
+        rc = 1
+    if doc.get("call_warm_sha_matches") is not True:
+        print(f"bench_gate: call_warm_sha_matches is not true in "
+              f"{path} — a warm rerun changed the VCF bytes",
+              file=sys.stderr)
+        rc = 1
+    if doc.get("call_warm_recompiles") != 0:
+        print(f"bench_gate: call_warm_recompiles "
+              f"{doc.get('call_warm_recompiles')!r} in {path} — a "
+              "warm call rerun must reuse every compiled shape "
+              "(compile-count delta 0)", file=sys.stderr)
+        rc = 1
+    rate = doc.get("call_reads_per_sec")
+    capacity = doc.get("host_parallel_capacity")
+    gated = isinstance(capacity, (int, float)) and \
+        capacity >= CALL_CAPACITY_FLOOR
+    if not isinstance(rate, (int, float)):
+        print(f"bench_gate: call artifact {path} carries no "
+              "call_reads_per_sec", file=sys.stderr)
+        rc = 1
+    elif gated and rate < CALL_READS_PER_SEC_FLOOR:
+        print(f"bench_gate: call throughput {rate!r} reads/s in "
+              f"{path} is below the required "
+              f"{CALL_READS_PER_SEC_FLOOR} on a box with measured "
+              f"parallel capacity {capacity}x — the calling plane "
+              "regressed", file=sys.stderr)
+        rc = 1
+    elif rate < CALL_READS_PER_SEC_MIN_ANY:
+        print(f"bench_gate: call throughput {rate!r} reads/s in "
+              f"{path} is below the unconditional floor "
+              f"{CALL_READS_PER_SEC_MIN_ANY} — the calling machinery "
+              "itself regressed (this floor applies even on a "
+              "capacity-limited box)", file=sys.stderr)
+        rc = 1
+    if rc == 0:
+        how = (f"{rate} reads/s >= {CALL_READS_PER_SEC_FLOOR}"
+               if gated else
+               f"{rate} reads/s reported, not gated — measured "
+               f"parallel capacity {capacity}x < "
+               f"{CALL_CAPACITY_FLOOR}x (capacity-limited box)")
+        print(f"call gate: {doc.get('call_n_reads')} reads -> "
+              f"{doc.get('call_calls')} calls, oracle byte-identical "
+              "solo AND served, 0 warm recompiles; " + how)
+    return rc
 
 
 def _check_paged_artifact(path: str) -> int:
@@ -627,6 +727,15 @@ def main(argv=None) -> int:
                   file=sys.stderr)
             return 2
         del argv[i:i + 2]
+    fresh_call = None
+    if "--call" in argv:
+        i = argv.index("--call")
+        try:
+            fresh_call = argv[i + 1]
+        except IndexError:
+            print("bench_gate: --call needs a path", file=sys.stderr)
+            return 2
+        del argv[i:i + 2]
     for path in (BASELINE, CURRENT):
         if not os.path.exists(path):
             print(f"bench_gate: missing committed artifact {path} "
@@ -661,6 +770,11 @@ def main(argv=None) -> int:
     if not os.path.exists(OVERLOAD):
         print(f"bench_gate: missing committed artifact {OVERLOAD} "
               "(regenerate with: python bench.py --worker overload "
+              "> out.jsonl on the CPU backend)", file=sys.stderr)
+        return 2
+    if not os.path.exists(CALL):
+        print(f"bench_gate: missing committed artifact {CALL} "
+              "(regenerate with: python bench.py --worker call "
               "> out.jsonl on the CPU backend)", file=sys.stderr)
         return 2
 
@@ -815,6 +929,26 @@ def main(argv=None) -> int:
         if rc != 0:
             print("bench_gate: an overload serve wall regressed past "
                   "10% vs the committed artifact", file=sys.stderr)
+            return rc
+
+    print("\n== gate 9: variant-calling plane — oracle + served "
+          "identity on the committed call artifact ==")
+    rc = _check_call_artifact(CALL)
+    if rc != 0:
+        return rc
+
+    if fresh_call:
+        print(f"\n== gate 9b: {fresh_call} vs committed {CALL} "
+              "(10% regression threshold on the call walls) ==")
+        rc = _check_call_artifact(fresh_call)
+        if rc != 0:
+            return rc
+        rc = compare_bench.main([CALL, fresh_call,
+                                 "--keys", ",".join(CALL_WALL_KEYS),
+                                 "--threshold", "10"])
+        if rc != 0:
+            print("bench_gate: a call wall regressed past 10% vs the "
+                  "committed artifact", file=sys.stderr)
             return rc
 
     print("\nbench_gate: all gates hold")

@@ -19,9 +19,10 @@ and fails when:
   fleet-serve scheduler's ``placement_selected``
   (decide_placement) and ``job_requeued`` (decide_requeue /
   decide_steal, selected by the recorded ``cause``), the overload
-  plane's ``overload_state`` (serve/overload.decide_overload) and the
+  plane's ``overload_state`` (serve/overload.decide_overload), the
   backend circuit breaker's ``breaker_state``
-  (resilience/retry.decide_breaker);
+  (resilience/retry.decide_breaker) and the variant-calling plane's
+  ``call_plan_selected`` (call/plan.decide_call_plan);
 * the recorded ``input_digest`` does not match the digest of the
   recorded inputs (the event lied about what it decided from);
 * two events — within one file or across files — share an
@@ -105,6 +106,10 @@ PLACEMENT_FIELDS = ("place", "reason")
 REQUEUE_FIELDS = ("action", "reason")
 STEAL_FIELDS = ("action", "moves", "reason")
 
+#: the variant-calling plan fields a replay must reproduce exactly
+#: (call/plan.decide_call_plan; same purity contract)
+CALL_FIELDS = ("stripe_span", "min_depth", "min_alt", "reason")
+
 #: fields absent from older sidecars: compared only when recorded
 _OPTIONAL_FIELDS = ("layout", "page_rows", "pool_pages", "reject",
                     "cancel")
@@ -119,7 +124,7 @@ _REPLAYED = ("executor_bucket_selected", "fusion_plan_selected",
              "realign_plan_selected", "shard_plan_selected",
              "shard_reassigned", "admission_selected",
              "placement_selected", "job_requeued", "pages_selected",
-             "overload_state", "breaker_state")
+             "overload_state", "breaker_state", "call_plan_selected")
 
 
 def _events(path: str, kinds=_REPLAYED) -> List[Tuple[int, dict]]:
@@ -146,6 +151,7 @@ def check(paths: List[str]) -> List[str]:
     from adam_tpu.parallel.shardstream import (decide_shard_plan,
                                                decide_shard_reassignment,
                                                decide_shard_speculation)
+    from adam_tpu.call.plan import decide_call_plan
     from adam_tpu.parallel.pagedbuf import decide_pages
     from adam_tpu.resilience.retry import decide_breaker
     from adam_tpu.serve.admission import decide_admission
@@ -166,7 +172,8 @@ def check(paths: List[str]) -> List[str]:
                                        PLACEMENT_FIELDS),
                 "pages_selected": (decide_pages, PAGES_FIELDS),
                 "overload_state": (decide_overload, OVERLOAD_FIELDS),
-                "breaker_state": (decide_breaker, BREAKER_FIELDS)}
+                "breaker_state": (decide_breaker, BREAKER_FIELDS),
+                "call_plan_selected": (decide_call_plan, CALL_FIELDS)}
     errs: List[str] = []
     # digests are namespaced per event kind: the two deciders hash
     # different input tuples and must never cross-validate

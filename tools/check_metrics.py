@@ -128,6 +128,18 @@ elastic worker sidecars).  Contract checked here:
 * ``serve_report_checkpoint`` events carry ``path`` (str), ``jobs``
   (int >= 0) and ``reason`` (periodic/final) — the SLO report was
   checkpointed durably mid-serve, not only at exit;
+* ``call_plan_selected`` events (the variant-calling plan,
+  call/plan.decide_call_plan) carry ``stripe_span`` (int >= 1),
+  ``min_depth``/``min_alt`` (int >= 1), ``reason``, ``inputs`` + hex
+  ``input_digest`` (replayed by tools/check_executor.py);
+* ``call_stripe`` events carry ``refid`` (int >= 0), ``stripe_start``
+  (int >= 0), ``span`` (int >= 1), ``sample`` (str), ``covered`` and
+  ``called`` (int >= 0) — one genotyped (stripe, sample) tile;
+* ``call_emit`` events carry ``path`` (str), ``reads``/``admitted``/
+  ``stripes``/``calls``/``variants``/``genotypes``/``samples`` (int
+  >= 0), hex ``vcf_sha256``, plus nullable ``identical`` (bool; the
+  oracle verdict, only under -validate) and nullable ``rod_coverage``
+  (number >= 0; the rods-plane summary) — the pass's output receipt;
 * the last line is the ``summary``: ``wall_seconds``, ``ok``, and a
   ``metrics`` snapshot whose counters/gauges are numeric and whose
   histograms are internally consistent (count == sum of bucket counts);
@@ -178,6 +190,7 @@ KNOWN_EVENTS = (
     "overload_state", "admission_rejected", "deadline_missed",
     "breaker_state",
     "series_written", "serve_report_checkpoint",
+    "call_plan_selected", "call_stripe", "call_emit",
 )
 
 #: mirror of adam_tpu.resilience.faults.SITES / FAULTS (kept literal so
@@ -851,6 +864,52 @@ def validate(path: str) -> List[str]:
             if d.get("reason") not in ("periodic", "final"):
                 err(i, f"serve_report_checkpoint unknown reason "
                        f"{d.get('reason')!r} (periodic/final)")
+        elif ev == "call_plan_selected":
+            for field in ("stripe_span", "min_depth", "min_alt"):
+                v = d.get(field)
+                if not (isinstance(v, int) and not isinstance(v, bool)
+                        and v >= 1):
+                    err(i, f"call_plan_selected missing positive int "
+                           f"{field!r}")
+            if not (isinstance(d.get("reason"), str) and d["reason"]):
+                err(i, "call_plan_selected missing string 'reason'")
+            if not isinstance(d.get("inputs"), dict):
+                err(i, "call_plan_selected missing 'inputs' object "
+                       "(decision must be replayable)")
+            if not _is_hex(d.get("input_digest")):
+                err(i, "call_plan_selected missing hex 'input_digest'")
+        elif ev == "call_stripe":
+            for field in ("refid", "stripe_start", "covered", "called"):
+                v = d.get(field)
+                if not (isinstance(v, int) and not isinstance(v, bool)
+                        and v >= 0):
+                    err(i, f"call_stripe missing non-negative int "
+                           f"{field!r}")
+            span = d.get("span")
+            if not (isinstance(span, int) and not isinstance(span, bool)
+                    and span >= 1):
+                err(i, "call_stripe missing positive int 'span'")
+            if not isinstance(d.get("sample"), str):
+                err(i, "call_stripe missing string 'sample'")
+        elif ev == "call_emit":
+            if not isinstance(d.get("path"), str):
+                err(i, "call_emit missing string 'path'")
+            for field in ("reads", "admitted", "stripes", "calls",
+                          "variants", "genotypes", "samples"):
+                v = d.get(field)
+                if not (isinstance(v, int) and not isinstance(v, bool)
+                        and v >= 0):
+                    err(i, f"call_emit missing non-negative int "
+                           f"{field!r}")
+            if not _is_hex(d.get("vcf_sha256")):
+                err(i, "call_emit missing hex 'vcf_sha256'")
+            ident = d.get("identical")
+            if ident is not None and not isinstance(ident, bool):
+                err(i, "call_emit 'identical' must be bool or null")
+            rc = d.get("rod_coverage")
+            if rc is not None and not (_is_num(rc) and rc >= 0):
+                err(i, "call_emit 'rod_coverage' must be a "
+                       "non-negative number or null")
         elif ev == "startup_seconds":
             for k, v in d.items():
                 if k in ("event", "t"):
