@@ -642,7 +642,8 @@ def _sharded_pallas_fn(mesh, n_qual_rg: int, n_cycle: int, variant: str,
                                 interpret=interpret)
 
 
-def _paged_count(box: dict, rb, state_flat, usable, rt, max_read_len):
+def _paged_count(box: dict, rb, state_flat, usable, rt, max_read_len,
+                 fused: bool = False):
     """One chunk's count through the RESIDENT plane pool
     (parallel/pagedbuf; docs/ARCHITECTURE.md §6l).
 
@@ -685,6 +686,21 @@ def _paged_count(box: dict, rb, state_flat, usable, rt, max_read_len):
                state=np.asarray(state_flat)[:live],
                row_of=rb.row_of[:live], pos_of=rb.pos_of[:live])
     try:
+        if fused:
+            # fused_device plan route: the mega-pass bqsr leg over the
+            # same resident pools (ops/megapass — one compiled program;
+            # the pack + fold jits inline under it unchanged)
+            from ..ops.megapass import megapass_bqsr_paged
+            return megapass_bqsr_paged(
+                {n: pool.device(n) for n, _ in PAGED_COUNT_PLANES},
+                pool.table(ids, table_len),
+                row_starts=rb.row_offsets[:-1], read_len=rb.read_len,
+                flags=rb.flags, read_group=rb.read_group,
+                usable=usable, n_bases=rb.n_bases, n_rows=rb.n_reads,
+                n_qual_rg=rt.n_qual_rg, n_cycle=rt.n_cycle,
+                max_read_len=max_read_len,
+                impl="pallas" if is_tpu_backend() else "xla",
+                interpret=not is_tpu_backend())
         return count_kernel_paged(
             {n: pool.device(n) for n, _ in PAGED_COUNT_PLANES},
             pool.table(ids, table_len),
@@ -709,7 +725,8 @@ def count_tables_device(table: pa.Table,
                         donate: bool = False,
                         md_info=None,
                         layout: str = "padded",
-                        paged_box: Optional[dict] = None):
+                        paged_box: Optional[dict] = None,
+                        fused: bool = False):
     """Pass-1 counting for one chunk, WITHOUT the host sync: returns the 7
     count tensors (qual_obs, qual_mm, cycle_obs, cycle_mm, ctx_obs,
     ctx_mm, qhist) still on device (numpy under the "host" impl — both add
@@ -730,6 +747,13 @@ def count_tables_device(table: pa.Table,
     churn the executor exists to kill), so it keeps the host batch.
     ``donate=True`` donates the kernel's per-chunk inputs (streaming
     path only; see `_sharded_count_fn`).
+
+    ``fused=True`` (the plan's ``fused_device`` dimension) routes the
+    unsharded count through the mega-pass bqsr leg (ops/megapass): the
+    SAME pack + fold jits composed under one program, so one device
+    dispatch replaces the pack/count pair — bit-identical by
+    construction.  Sharded meshes and the degraded "host" impl pin stay
+    on the unfused kernels.
     """
     n = table.num_rows
     if batch is None:
@@ -753,7 +777,8 @@ def count_tables_device(table: pa.Table,
                                     donate=donate,
                                     md_info=None if md_info is None
                                     else slice_md_info(md_info, s, e),
-                                    layout=lay, paged_box=paged_box)
+                                    layout=lay, paged_box=paged_box,
+                                    fused=fused)
             acc = out if acc is None else tuple(
                 a + b for a, b in zip(acc, out))
         return acc
@@ -761,7 +786,7 @@ def count_tables_device(table: pa.Table,
                              mesh if sharded else None,
                              device_batch=device_batch, donate=donate,
                              md_info=md_info, layout=lay,
-                             paged_box=paged_box)
+                             paged_box=paged_box, fused=fused)
 
 
 def _count_tables_one(table: pa.Table, batch: ReadBatch,
@@ -770,7 +795,8 @@ def _count_tables_one(table: pa.Table, batch: ReadBatch,
                       device_batch: Optional[ReadBatch] = None,
                       donate: bool = False,
                       md_info=None, layout: str = "padded",
-                      paged_box: Optional[dict] = None):
+                      paged_box: Optional[dict] = None,
+                      fused: bool = False):
     """One slab's pass-1 count (the pre-slab body of
     :func:`count_tables_device`)."""
     n = table.num_rows
@@ -817,9 +843,20 @@ def _count_tables_one(table: pa.Table, batch: ReadBatch,
                 # ship only this chunk's live pages; a thrashing pool
                 # answers None and the ragged concat runs instead
                 out = _paged_count(paged_box, rb, state_flat, usable,
-                                   rt, batch.max_len)
+                                   rt, batch.max_len, fused=fused)
                 if out is not None:
                     return out
+            if fused:
+                # fused_device plan route (ops/megapass): the ragged
+                # mega-pass with only the bqsr leg selected — the same
+                # flat pack + fold under one compiled program
+                from ..ops.megapass import megapass_from_ragged
+                return megapass_from_ragged(
+                    rb, want=("bqsr",), state_flat=state_flat,
+                    usable=usable, n_qual_rg=rt.n_qual_rg,
+                    n_cycle=rt.n_cycle, max_read_len=batch.max_len,
+                    impl="pallas" if is_tpu_backend() else "xla",
+                    interpret=not is_tpu_backend())["bqsr"]
             return count_kernel_ragged(
                 rb, state_flat, usable, n_qual_rg=rt.n_qual_rg,
                 n_cycle=rt.n_cycle, max_read_len=batch.max_len,
@@ -838,6 +875,22 @@ def _count_tables_one(table: pa.Table, batch: ReadBatch,
         impl = _tpu_auto_upgrade(impl, rt.n_qual_rg, rt.n_cycle,
                                  rt.n_read_groups,
                                  mesh if sharded else None)
+    if fused and not sharded and impl != "host":
+        # fused_device plan route, padded layout: the mega-pass bqsr
+        # leg (ops/megapass) — respects the degraded "host" env pin and
+        # the multi-shard demotion above
+        from ..ops.megapass import megapass_bqsr
+        from ..platform import is_tpu_backend
+        from .count_pallas import fits
+        if fits(rt.n_qual_rg, rt.n_cycle):
+            return megapass_bqsr(
+                jnp.asarray(dev.bases), jnp.asarray(dev.quals),
+                jnp.asarray(dev.read_len), jnp.asarray(dev.flags),
+                jnp.asarray(dev.read_group), jnp.asarray(state),
+                jnp.asarray(usable), n_qual_rg=rt.n_qual_rg,
+                n_cycle=rt.n_cycle,
+                impl="pallas" if is_tpu_backend() else "xla",
+                interpret=not is_tpu_backend())
     if impl == "host":
         out = _count_tables_host(batch, state, usable,
                                  n_qual_rg=rt.n_qual_rg,

@@ -93,6 +93,20 @@ def add_executor_args(p: argparse.ArgumentParser) -> None:
     gp.add_argument("-no_paged", action="store_true",
                     help="force the page pool off even when "
                          "ADAM_TPU_PAGED is set in the environment")
+    gm = p.add_mutually_exclusive_group()
+    gm.add_argument("-mega", action="store_true",
+                    help="route every mega-capable pass through the "
+                         "FUSED multi-output device kernel (one "
+                         "dispatch per chunk computes flagstat + "
+                         "markdup keys + BQSR covariates off one "
+                         "plane load; bit-identical by construction; "
+                         "docs/ARCHITECTURE.md §6p, ADAM_TPU_MEGA=1) "
+                         "— default: let raced mega_race ledger "
+                         "evidence decide, unfused without evidence")
+    gm.add_argument("-no_mega", action="store_true",
+                    help="force the unfused kernels even when "
+                         "ADAM_TPU_MEGA or ledger evidence would arm "
+                         "the fused route")
     p.add_argument("-page_rows", type=int, default=None, metavar="N",
                    help="flat elements per page (default 32768 for the "
                         "wire plane; ADAM_TPU_PAGE_ROWS)")
@@ -162,7 +176,7 @@ def fleet_worker_env(args) -> dict:
     that tunes the single-host path must not silently drop the moment
     ``-hosts`` is added."""
     from ..parallel.executor import (AUTOTUNE_ENV, LADDER_BASE_ENV,
-                                     PAGE_ROWS_ENV, PAGED_ENV,
+                                     MEGA_ENV, PAGE_ROWS_ENV, PAGED_ENV,
                                      POOL_PAGES_ENV, PREFETCH_ENV,
                                      RAGGED_ENV)
     from ..resilience.retry import RETRY_BUDGET_ENV
@@ -184,6 +198,10 @@ def fleet_worker_env(args) -> dict:
         env[PAGED_ENV] = "1"
     elif getattr(args, "no_paged", False):
         env[PAGED_ENV] = "0"
+    if getattr(args, "mega", False):
+        env[MEGA_ENV] = "1"
+    elif getattr(args, "no_mega", False):
+        env[MEGA_ENV] = "0"
     if getattr(args, "page_rows", None) is not None:
         env[PAGE_ROWS_ENV] = str(args.page_rows)
     if getattr(args, "pool_pages", None) is not None:
@@ -211,6 +229,10 @@ def executor_opts_from(args) -> dict:
         opts["paged"] = True
     elif getattr(args, "no_paged", False):
         opts["paged"] = False
+    if getattr(args, "mega", False):
+        opts["mega"] = True
+    elif getattr(args, "no_mega", False):
+        opts["mega"] = False
     if getattr(args, "page_rows", None) is not None:
         opts["page_rows"] = args.page_rows
     if getattr(args, "pool_pages", None) is not None:

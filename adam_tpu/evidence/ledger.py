@@ -66,6 +66,7 @@ STAGE_SUCCESS_KEYS = {
                     "ragged_flagstat_ragged_per_sec"),
     "paged_race": ("paged_h2d_reduction",),
     "call": ("call_reads_per_sec",),
+    "mega_race": ("mega_dispatch_reduction",),
 }
 
 #: pallas is special: the ok flags are present on failure too (False)

@@ -80,7 +80,12 @@ STAGE_DEADLINES_S = {"probe": 150.0, "flagstat": 180.0, "transform": 280.0,
                      # oracle differential + warm rerun + served
                      # co-tenant leg; never in the TPU capture order —
                      # reached only via --worker/--only call
-                     "call": 600.0}
+                     "call": 600.0,
+                     # fused mega-pass (ISSUE 18): kernel-twin identity
+                     # + the in-process combined dispatch-count leg;
+                     # never in the TPU capture order — reached only
+                     # via --worker/--only mega_race
+                     "mega_race": 400.0}
 
 TIMEOUTS_ENV = "ADAM_TPU_BENCH_STAGE_TIMEOUTS"
 

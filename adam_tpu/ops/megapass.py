@@ -1,0 +1,404 @@
+"""Fused mega-pass device kernel: one dispatch per chunk for flagstat
+counters + markdup key columns + BQSR covariate counts.
+
+``BENCH_TPU_EVIDENCE.json`` records the flagstat kernel at 0.06 GB/s of
+device bandwidth — ~0.01% of HBM peak — because the hot path is
+dispatch-latency-bound, not compute-bound: per chunk the product path
+compiles and launches up to THREE separate executables that all read
+the same wire planes (the flagstat indicator einsum; the markdup
+5'-position/score kernel; the BQSR covariate pack + count fold, itself
+two jit boundaries).  PR 7 collapsed the host-side re-streams of the
+same bytes; this module collapses the device side the same way, the
+ragged-paged-attention pattern (docs/ARCHITECTURE.md §6p): ONE jitted
+multi-output program per layout that loads the base/qual/flag/position
+planes once and emits
+
+  * ``flagstat`` — the [18, 2] counter block
+    (:func:`..ops.flagstat._flagstat_core`, the single indicator
+    definition every flagstat kernel shares);
+  * ``markdup`` — the per-read key columns ``(fp, score)``
+    (:func:`..ops.markdup._device_fiveprime_and_score`, inlined under
+    this jit);
+  * ``bqsr`` — the 7 covariate count tensors
+    (:func:`..bqsr.count_pallas._pack_words` /
+    :func:`.._pack_words_flat` + the XLA segment-sum or Mosaic
+    one-hot-matmul fold), sharing the ragged prefix-sum row walk with
+    the other legs.
+
+The composition is STRUCTURAL identity, never a re-implementation:
+every leg calls the exact jitted monoid the unfused pass dispatches, so
+fused results are bit-identical by construction (pinned over the
+adversarial corpus on both the XLA and Mosaic-interpreter routes by
+tests/test_megapass.py).  XLA fuses the shared plane loads across the
+legs inside the single program; on TPU the BQSR fold runs the same
+Mosaic kernel the unfused path runs (``impl="pallas"``).
+
+The static ``want`` tuple selects the outputs, so a pass that needs one
+leg compiles a program that computes one leg — arming the fused route
+never computes unconsumed outputs.  Layout twins mirror the PR 8/13
+machinery: ``megapass_padded`` ([N, L] planes), ``megapass_ragged``
+(flat [T] planes + the prefix-sum row walk), ``megapass_paged`` (the
+resident page pool; one gather reconstructs the ragged view, exactly
+:func:`..bqsr.count_pallas.count_kernel_paged`'s delegation), plus the
+wire32 entries for the streaming-flagstat product route.
+
+Plan integration: ``decide_plan``'s replayable ``fused_device``
+dimension (``-mega`` / ``ADAM_TPU_MEGA`` pin > ledger ``mega_race``
+evidence > off, parallel/executor.py) arms the route;
+``PassExecutor.dispatch`` counts every device dispatch per pass
+(``dispatch_count{pass=}``), so the collapse is a gated number
+(tools/bench_gate.py gate 10), not a story.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from ..packing import _round_up
+
+#: every output leg the mega-pass can emit, in canonical order
+WANT_ALL = ("flagstat", "markdup", "bqsr")
+
+
+def _check_want(want) -> None:
+    """Trace-time guard: ``want`` is a static tuple, so a typo'd leg
+    name fails loudly at the first call, never silently drops output."""
+    if not want or any(w not in WANT_ALL for w in want):
+        raise ValueError(
+            f"megapass want={want!r}: expected a non-empty subset of "
+            f"{WANT_ALL}")
+
+
+# ---------------------------------------------------------------------------
+# the three legs — each one IS the unfused kernel's monoid, shared by
+# reference so counter/key/count semantics cannot diverge from the
+# standalone dispatches
+# ---------------------------------------------------------------------------
+
+def _flagstat_leg(flags, mapq, refid, mate_refid, valid):
+    from .flagstat import _flagstat_core
+
+    # exactly flagstat_kernel's call: raw mapq (null -1 fails the >=5
+    # indicator the same way 0 does), cross bit from the refid compare
+    return _flagstat_core(flags.astype(jnp.int32),
+                          mapq.astype(jnp.int32),
+                          refid != mate_refid, valid)
+
+
+def _markdup_leg_padded(flags, start, cigar_ops, cigar_lens, n_cigar,
+                        quals):
+    from .markdup import _device_fiveprime_and_score
+
+    # the jitted key kernel inlines under the enclosing mega-pass jit:
+    # same 5'-position walk, same phred>=15 integer score sum
+    return _device_fiveprime_and_score(flags, start, cigar_ops,
+                                       cigar_lens, n_cigar, quals)
+
+
+def _markdup_leg_ragged(flags, start, cigar_ops, cigar_lens, n_cigar,
+                        quals_flat, row_of, n_bases, n_rows: int):
+    from . import cigar as C
+
+    fp = C.five_prime_position(start, flags, cigar_ops, cigar_lens,
+                               n_cigar)
+    # the padded leg's per-row sum as a segment reduction over the flat
+    # plane; slack past n_bases is excluded POSITIONALLY (the ragged
+    # contract) — the ragged batch's QUAL_PAD slack would fail the
+    # >= 15 test anyway, but a paged gather's slack can alias real
+    # pages, so the flat index is the only safe exclusion
+    live = jnp.arange(quals_flat.shape[0], dtype=jnp.int32) < n_bases
+    q = quals_flat
+    score = jax.ops.segment_sum(
+        jnp.where(live & (q >= 15), q, 0).astype(jnp.int32), row_of,
+        num_segments=n_rows)
+    return fp, score
+
+
+def _bqsr_fold(word3, wbits3, n_qual_rg: int, n_cycle: int, impl: str,
+               interpret: bool):
+    """Packed covariate words -> the 7 count tensors: the same fold the
+    unfused count dispatches (XLA segment-sum off-TPU, the Mosaic
+    one-hot-matmul sweep on TPU)."""
+    from ..bqsr.count_pallas import (_count_call, _count_flat_xla,
+                                     _unpack_tables)
+
+    if impl != "pallas":
+        return _count_flat_xla(word3, wbits3, n_qual_rg=n_qual_rg,
+                               n_cycle=n_cycle)
+    q_rows = _round_up(n_qual_rg, 8)
+    cyc_bins = _round_up(n_cycle, 128)
+    obs, mm, qh = _count_call(word3, wbits3, q_rows=q_rows,
+                              cyc_bins=cyc_bins, interpret=interpret)
+    return _unpack_tables(obs, mm, qh, n_qual_rg=n_qual_rg,
+                          n_cycle=n_cycle, cyc_bins=cyc_bins)
+
+
+# ---------------------------------------------------------------------------
+# layout entries: one jitted multi-output program per layout
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.jit, static_argnames=("want", "n_qual_rg",
+                                             "n_cycle", "impl",
+                                             "interpret"))
+def megapass_padded(flags, mapq, refid, mate_refid, valid, start,
+                    cigar_ops, cigar_lens, n_cigar, bases, quals,
+                    read_len, read_group, state, usable, *,
+                    want=WANT_ALL, n_qual_rg: int = 0, n_cycle: int = 0,
+                    impl: str = "xla", interpret: bool = True):
+    """The padded-layout mega-pass: one compiled program computing the
+    ``want`` legs off one set of [N]/[N, L] planes.  Unused inputs may
+    be None (an un-selected leg's planes are never traced)."""
+    from ..bqsr.count_pallas import _pack_words
+
+    _check_want(want)
+    out = {}
+    if "flagstat" in want:
+        out["flagstat"] = _flagstat_leg(flags, mapq, refid, mate_refid,
+                                        valid)
+    if "markdup" in want:
+        out["markdup"] = _markdup_leg_padded(flags, start, cigar_ops,
+                                             cigar_lens, n_cigar, quals)
+    if "bqsr" in want:
+        word3, wbits3 = _pack_words(bases, quals, read_len, flags,
+                                    read_group, state, usable,
+                                    n_qual_rg=n_qual_rg,
+                                    n_cycle=n_cycle)
+        out["bqsr"] = _bqsr_fold(word3, wbits3, n_qual_rg, n_cycle,
+                                 impl, interpret)
+    return out
+
+
+@functools.partial(jax.jit, static_argnames=("want", "n_rows",
+                                             "n_qual_rg", "n_cycle",
+                                             "max_read_len", "impl",
+                                             "interpret"))
+def megapass_ragged(flags, mapq, refid, mate_refid, valid, start,
+                    cigar_ops, cigar_lens, n_cigar, bases_flat,
+                    quals_flat, row_of, pos_of, row_starts, read_len,
+                    read_group, state_flat, usable, n_bases, *,
+                    want=WANT_ALL, n_rows: int = 0, n_qual_rg: int = 0,
+                    n_cycle: int = 0, max_read_len: int = 0,
+                    impl: str = "xla", interpret: bool = True):
+    """The ragged-layout twin: flat [T] planes + the prefix-sum row walk
+    (packing.RaggedBatch), shared across all selected legs — slack past
+    ``n_bases`` is excluded positionally, never by a valid bit."""
+    from ..bqsr.count_pallas import _pack_words_flat
+
+    _check_want(want)
+    out = {}
+    if "flagstat" in want:
+        out["flagstat"] = _flagstat_leg(flags, mapq, refid, mate_refid,
+                                        valid)
+    if "markdup" in want:
+        out["markdup"] = _markdup_leg_ragged(flags, start, cigar_ops,
+                                             cigar_lens, n_cigar,
+                                             quals_flat, row_of,
+                                             n_bases, n_rows)
+    if "bqsr" in want:
+        word3, wbits3 = _pack_words_flat(
+            bases_flat, quals_flat, row_of, pos_of, row_starts,
+            read_len, flags, read_group, state_flat, usable, n_bases,
+            n_rows=n_rows, n_qual_rg=n_qual_rg, n_cycle=n_cycle,
+            max_read_len=max_read_len)
+        out["bqsr"] = _bqsr_fold(word3, wbits3, n_qual_rg, n_cycle,
+                                 impl, interpret)
+    return out
+
+
+@functools.partial(jax.jit, static_argnames=("want", "n_rows",
+                                             "n_qual_rg", "n_cycle",
+                                             "max_read_len", "impl",
+                                             "interpret"))
+def megapass_paged(pools, page_table, flags, mapq, refid, mate_refid,
+                   valid, start, cigar_ops, cigar_lens, n_cigar,
+                   row_starts, read_len, read_group, usable, n_bases, *,
+                   want=WANT_ALL, n_rows: int = 0, n_qual_rg: int = 0,
+                   n_cycle: int = 0, max_read_len: int = 0,
+                   impl: str = "xla", interpret: bool = True):
+    """The paged-layout twin: the RESIDENT page pools + this chunk's
+    page table (parallel/pagedbuf).  One gather per plane reconstructs
+    exactly the flat arrays the ragged entry consumes — the page-table
+    walk IS the prefix-sum row walk relocated into residency, the
+    ``count_kernel_paged`` delegation pattern — then the ragged body
+    runs INSIDE the same compiled program, so paged results equal
+    ragged ones bit-for-bit over any page placement.
+
+    ``pools`` maps the :data:`..bqsr.count_pallas.PAGED_COUNT_PLANES`
+    names to their ``[pool_pages, page_rows]`` device arrays (the
+    ``bases``/``pos_of``/``state`` planes are only touched when the
+    bqsr leg is wanted)."""
+    from ..parallel.pagedbuf import gather_pages
+
+    _check_want(want)
+    pt = page_table.astype(jnp.int32)
+    quals_flat = gather_pages(pools["quals"], pt)
+    row_of = gather_pages(pools["row_of"], pt)
+    out = {}
+    if "flagstat" in want:
+        out["flagstat"] = _flagstat_leg(flags, mapq, refid, mate_refid,
+                                        valid)
+    if "markdup" in want:
+        out["markdup"] = _markdup_leg_ragged(flags, start, cigar_ops,
+                                             cigar_lens, n_cigar,
+                                             quals_flat, row_of,
+                                             n_bases, n_rows)
+    if "bqsr" in want:
+        from ..bqsr.count_pallas import _pack_words_flat
+
+        word3, wbits3 = _pack_words_flat(
+            gather_pages(pools["bases"], pt), quals_flat, row_of,
+            gather_pages(pools["pos_of"], pt), row_starts, read_len,
+            flags, read_group, gather_pages(pools["state"], pt),
+            usable, n_bases, n_rows=n_rows, n_qual_rg=n_qual_rg,
+            n_cycle=n_cycle, max_read_len=max_read_len)
+        out["bqsr"] = _bqsr_fold(word3, wbits3, n_qual_rg, n_cycle,
+                                 impl, interpret)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# wire32 entries — the streaming-flagstat product route (the flagstat
+# pass carries only the 26-bit projection word, not full batches)
+# ---------------------------------------------------------------------------
+
+@jax.jit
+def megapass_wire32(wire):
+    """Fused-route flagstat off one padded wire32 chunk: the same 26-bit
+    unpack + indicator einsum as ``flagstat_kernel_wire32``, housed in
+    the mega-pass program so the fused plan's one-dispatch accounting
+    holds on the flagstat-only pass too."""
+    from .flagstat import _flagstat_core
+
+    flags = (wire & 0xFFFF).astype(jnp.int32)
+    mapq = ((wire >> 16) & 0xFF).astype(jnp.int32)
+    valid = ((wire >> 24) & 1) != 0
+    cross = ((wire >> 25) & 1) != 0
+    return _flagstat_core(flags, mapq, cross, valid)
+
+
+@jax.jit
+def megapass_wire32_bounded(wire, total):
+    """The ragged-concat twin: fixed-capacity wire buffer with ``total``
+    live rows — validity is positional (slack past the bound may hold
+    garbage), exactly the ragged flagstat sweep's convention."""
+    from .flagstat import _flagstat_core
+
+    flags = (wire & 0xFFFF).astype(jnp.int32)
+    mapq = ((wire >> 16) & 0xFF).astype(jnp.int32)
+    valid = ((wire >> 24) & 1) != 0
+    cross = ((wire >> 25) & 1) != 0
+    live = jnp.arange(wire.shape[0], dtype=jnp.int32) < total
+    return _flagstat_core(flags, mapq, cross, valid & live)
+
+
+@jax.jit
+def megapass_wire32_paged(pool, page_table, total):
+    """The paged twin: gather the logical wire from the resident pool,
+    then the bounded sweep — one compiled program, only delta pages
+    ever crossed the link."""
+    from ..parallel.pagedbuf import gather_pages
+
+    wire = gather_pages(pool, page_table.astype(jnp.int32))
+    return megapass_wire32_bounded(wire, total)
+
+
+# ---------------------------------------------------------------------------
+# host conveniences — batch objects -> the jitted entries (tests/bench)
+# ---------------------------------------------------------------------------
+
+def megapass_from_batch(batch, *, want=WANT_ALL, state=None, usable=None,
+                        n_qual_rg: int = 0, n_cycle: int = 0,
+                        impl: str = "xla", interpret: bool = True):
+    """Run the padded mega-pass off a :class:`..packing.ReadBatch`.
+    ``state``/``usable``/table geometry are required only when ``want``
+    includes the bqsr leg."""
+    a = jnp.asarray
+    need_bqsr = "bqsr" in want
+    return megapass_padded(
+        a(batch.flags), a(batch.mapq), a(batch.refid),
+        a(batch.mate_refid), a(batch.valid), a(batch.start),
+        a(batch.cigar_ops), a(batch.cigar_lens), a(batch.n_cigar),
+        a(batch.bases) if need_bqsr else None, a(batch.quals),
+        a(batch.read_len) if need_bqsr else None,
+        a(batch.read_group) if need_bqsr else None,
+        None if state is None else a(state),
+        None if usable is None else a(usable),
+        want=tuple(want), n_qual_rg=n_qual_rg, n_cycle=n_cycle,
+        impl=impl, interpret=interpret)
+
+
+def megapass_from_ragged(rb, *, want=WANT_ALL, state_flat=None,
+                         usable=None, n_qual_rg: int = 0,
+                         n_cycle: int = 0, max_read_len: int = 0,
+                         impl: str = "xla", interpret: bool = True):
+    """Run the ragged mega-pass off a :class:`..packing.RaggedBatch`
+    (or the paged gather view, which carries the same fields)."""
+    a = jnp.asarray
+    need_bqsr = "bqsr" in want
+    return megapass_ragged(
+        a(rb.flags), a(rb.mapq), a(rb.refid), a(rb.mate_refid),
+        a(rb.valid), a(rb.start), a(rb.cigar_ops), a(rb.cigar_lens),
+        a(rb.n_cigar),
+        a(rb.bases_flat) if need_bqsr else None, a(rb.quals_flat),
+        a(rb.row_of),
+        a(rb.pos_of) if need_bqsr else None,
+        a(rb.row_offsets[:-1]),
+        a(rb.read_len) if need_bqsr else None,
+        a(rb.read_group) if need_bqsr else None,
+        None if state_flat is None else a(state_flat),
+        None if usable is None else a(usable),
+        jnp.int32(rb.n_bases),
+        want=tuple(want), n_rows=rb.n_reads, n_qual_rg=n_qual_rg,
+        n_cycle=n_cycle, max_read_len=max_read_len, impl=impl,
+        interpret=interpret)
+
+
+# ---------------------------------------------------------------------------
+# single-leg conveniences — the product wiring's fused routes call these
+# so a fused pass that only needs one leg compiles a one-leg program
+# ---------------------------------------------------------------------------
+
+def megapass_markdup(flags, start, cigar_ops, cigar_lens, n_cigar,
+                     quals):
+    """Fused-route markdup keys (stream 1): the mega-pass program with
+    ``want=("markdup",)`` — argument order matches
+    :func:`..ops.markdup._device_fiveprime_and_score` so the call site
+    swaps in place."""
+    return megapass_padded(
+        flags, None, None, None, None, start, cigar_ops, cigar_lens,
+        n_cigar, None, quals, None, None, None, None,
+        want=("markdup",))["markdup"]
+
+
+def megapass_bqsr(bases, quals, read_len, flags, read_group, state,
+                  usable, *, n_qual_rg: int, n_cycle: int,
+                  impl: str = "xla", interpret: bool = True):
+    """Fused-route padded BQSR counts (s2): the mega-pass program with
+    ``want=("bqsr",)`` — argument order matches
+    :func:`..bqsr.count_pallas.count_kernel_pallas`."""
+    return megapass_padded(
+        flags, None, None, None, None, None, None, None, None, bases,
+        quals, read_len, read_group, state, usable, want=("bqsr",),
+        n_qual_rg=n_qual_rg, n_cycle=n_cycle, impl=impl,
+        interpret=interpret)["bqsr"]
+
+
+def megapass_bqsr_paged(pools, page_table, *, row_starts, read_len,
+                        flags, read_group, usable, n_bases,
+                        n_rows: int, n_qual_rg: int, n_cycle: int,
+                        max_read_len: int, impl: str = "xla",
+                        interpret: bool = True):
+    """Fused-route paged BQSR counts: the paged mega-pass program with
+    ``want=("bqsr",)`` — keyword surface matches
+    :func:`..bqsr.count_pallas.count_kernel_paged` minus the delegated
+    knobs."""
+    return megapass_paged(
+        pools, page_table, flags, None, None, None, None, None, None,
+        None, None, row_starts, read_len, read_group, usable,
+        jnp.int32(n_bases), want=("bqsr",), n_rows=n_rows,
+        n_qual_rg=n_qual_rg, n_cycle=n_cycle,
+        max_read_len=max_read_len, impl=impl,
+        interpret=interpret)["bqsr"]

@@ -75,6 +75,11 @@ RAGGED_ENV = "ADAM_TPU_RAGGED"
 PAGED_ENV = "ADAM_TPU_PAGED"
 PAGE_ROWS_ENV = "ADAM_TPU_PAGE_ROWS"
 POOL_PAGES_ENV = "ADAM_TPU_POOL_PAGES"
+#: fused mega-pass pin (ops/megapass.py, docs/ARCHITECTURE.md §6p):
+#: ADAM_TPU_MEGA=1 routes every mega-capable pass through the fused
+#: multi-output kernel, 0 forces the unfused dispatches; unset leaves
+#: the decision to raced ``mega_race`` ledger evidence (off without it)
+MEGA_ENV = "ADAM_TPU_MEGA"
 
 #: the autotuner densifies the ladder once observed mean pad waste
 #: crosses this fraction (sqrt(2) rungs halve the worst-case waste of
@@ -105,6 +110,15 @@ DEFAULT_PREFETCH_DEPTH = 2
 PAGED_EVIDENCE_MIN_REDUCTION = 2.0
 PAGED_EVIDENCE_WALL_SLACK = 1.05
 
+#: evidence-armed mega-pass (ROADMAP item-6): with no explicit pin, a
+#: mega-capable pass arms the fused kernel only when the ledger's
+#: platform-matched ``mega_race`` record shows the per-chunk dispatch
+#: count reduced at or past this factor (the gate-10 acceptance floor)
+#: on the combined leg, with identity clean and no wall regression past
+#: the slack — the paged-evidence discipline applied to dispatch count
+MEGA_EVIDENCE_MIN_REDUCTION = 2.0
+MEGA_EVIDENCE_WALL_SLACK = 1.05
+
 
 def decide_plan(*, pass_name: str, chunk_rows: int, mesh_size: int,
                 on_tpu: bool, waste_mean: Optional[float] = None,
@@ -120,6 +134,9 @@ def decide_plan(*, pass_name: str, chunk_rows: int, mesh_size: int,
                 paged_rates: Optional[dict] = None,
                 page_rows: Optional[int] = None,
                 pool_pages: Optional[int] = None,
+                mega: Optional[bool] = None,
+                mega_capable: bool = False,
+                mega_rates: Optional[dict] = None,
                 autotune: bool = True) -> dict:
     """The autotuner: one pass's frozen execution plan.
 
@@ -159,6 +176,20 @@ def decide_plan(*, pass_name: str, chunk_rows: int, mesh_size: int,
     join the recorded inputs ONLY when the dimension is engaged, so
     pre-paged sidecars replay digest-identical (the tenant/shard
     scoping precedent in resilience.faults).
+
+    ``fused_device`` is the mega-pass dimension (ops/megapass.py,
+    docs/ARCHITECTURE.md §6p): ``mega_capable`` says this pass has a
+    fused multi-output route wired in; ``mega`` is the explicit
+    ``-mega``/``ADAM_TPU_MEGA`` pin (True/False; None leaves the
+    decision to evidence); ``mega_rates`` is the ledger's
+    platform-matched ``mega_race`` record
+    (:func:`ledger_mega_rates`) — the fused route arms when the
+    measured per-chunk dispatch reduction clears
+    :data:`MEGA_EVIDENCE_MIN_REDUCTION` with identity clean and the
+    fused wall within :data:`MEGA_EVIDENCE_WALL_SLACK` of the unfused
+    wall.  Off is the no-evidence default, and the mega keys join the
+    recorded inputs ONLY when the dimension is engaged, so pre-mega
+    sidecars replay digest-identical.
     """
     inputs = dict(pass_name=pass_name, chunk_rows=int(chunk_rows),
                   mesh_size=int(mesh_size), on_tpu=bool(on_tpu),
@@ -189,6 +220,16 @@ def decide_plan(*, pass_name: str, chunk_rows: int, mesh_size: int,
             inputs["paged_rates"] = {
                 k: round(float(v), 4)
                 for k, v in sorted(paged_rates.items())}
+    mega_engaged = bool(mega_capable) or mega is not None or \
+        bool(mega_rates)
+    if mega_engaged:
+        # only-when-engaged: pre-mega sidecars must digest identically
+        inputs["mega_capable"] = bool(mega_capable)
+        inputs["mega"] = None if mega is None else bool(mega)
+        if mega_rates:
+            inputs["mega_rates"] = {
+                k: round(float(v), 4)
+                for k, v in sorted(mega_rates.items())}
     # decide from the CANONICALIZED inputs (what the event records) —
     # deciding from the raw floats would let a rounding boundary make
     # the offline replay disagree with the recorded plan
@@ -230,6 +271,30 @@ def decide_plan(*, pass_name: str, chunk_rows: int, mesh_size: int,
             lay = "ragged"
             reasons.append(
                 f"ragged-evidence {rr['ragged']:.0f}>{rr['padded']:.0f}")
+    # the fused mega-pass dimension rides orthogonally to layout (every
+    # layout has a fused twin): explicit pin > ledger evidence > off
+    fused = False
+    if mega_engaged:
+        if inputs["mega"] is True:
+            if inputs["mega_capable"]:
+                fused = True
+                reasons.append("mega-pinned")
+            else:
+                reasons.append("mega-pin-unsupported:unfused")
+        elif inputs["mega"] is False:
+            reasons.append("mega-pinned-off")
+        elif autotune and inputs["mega_capable"] and \
+                inputs.get("mega_rates") and \
+                inputs["mega_rates"].get("dispatch_reduction", 0) >= \
+                MEGA_EVIDENCE_MIN_REDUCTION and \
+                inputs["mega_rates"].get("fused_wall_s",
+                                         float("inf")) <= \
+                MEGA_EVIDENCE_WALL_SLACK * \
+                inputs["mega_rates"].get("unfused_wall_s", 0):
+            mr = inputs["mega_rates"]
+            fused = True
+            reasons.append(
+                f"mega-evidence dispatch {mr['dispatch_reduction']:.1f}x")
     base = max(ladder_base, MIN_LADDER_BASE) if ladder_base \
         else LADDER_BASE_DEFAULT
     if autotune and not ladder_base and waste_mean is not None \
@@ -277,6 +342,11 @@ def decide_plan(*, pass_name: str, chunk_rows: int, mesh_size: int,
     if lay == "paged":
         plan["page_rows"] = int(plan_page_rows)
         plan["pool_pages"] = int(plan_pool_pages)
+    if mega_engaged:
+        # only-when-engaged, like the paged keys: pre-mega sidecars
+        # replay without the field and check_executor compares it only
+        # when recorded
+        plan["fused_device"] = bool(fused)
     return plan
 
 
@@ -294,6 +364,13 @@ def resolve_ragged_env(env_val: Optional[str]) -> Optional[str]:
     if env_val in ("0", "off", "padded", "no"):
         return "padded"
     return "ragged"
+
+
+def resolve_mega_env(env_val: Optional[str]) -> Optional[bool]:
+    """ADAM_TPU_MEGA / flag string -> explicit fused pin or None."""
+    if env_val is None or env_val == "":
+        return None
+    return env_val not in ("0", "off", "no")
 
 
 def ledger_ragged_rates(kernel: str,
@@ -361,6 +438,38 @@ def ledger_paged_rates(platform: Optional[str] = None) -> Optional[dict]:
     return None
 
 
+def ledger_mega_rates(platform: Optional[str] = None) -> Optional[dict]:
+    """The evidence ledger's raced fused-vs-unfused record — the bench
+    ``mega_race`` stage's combined-leg numbers
+    (``{"dispatch_reduction", "unfused_wall_s", "fused_wall_s"}``), or
+    None when the ledger has no record FOR THE CURRENT PLATFORM or the
+    record's identity bit is not clean (cross-platform evidence must
+    never arm the fused route; a twin mismatch disqualifies the whole
+    record).  Best-effort, like :func:`ledger_paged_rates`."""
+    try:
+        import jax
+
+        from ..evidence.ledger import Ledger, default_path
+        from ..platform import is_tpu_backend
+
+        plat = platform or \
+            ("tpu" if is_tpu_backend() else jax.default_backend())
+        rec = Ledger(default_path()).record("mega_race")
+        if not rec or rec.get("platform") != plat:
+            return None
+        payload = rec.get("payload") or rec
+        red = payload.get("mega_dispatch_reduction")
+        u = payload.get("mega_unfused_wall_s")
+        f = payload.get("mega_fused_wall_s")
+        if red and u and f and payload.get("mega_identical") is True:
+            return {"dispatch_reduction": float(red),
+                    "unfused_wall_s": float(u),
+                    "fused_wall_s": float(f)}
+    except Exception:  # noqa: BLE001 — telemetry-grade, never fatal
+        pass
+    return None
+
+
 def _ledger_link_rate() -> Optional[float]:
     """The evidence ledger's latest measured host→device link rate
     (bytes/s) — the probe writes it once per capture window; the
@@ -402,6 +511,7 @@ class PassExecutor:
         self.layout = plan.get("layout", "padded")
         self.page_rows = plan.get("page_rows")
         self.pool_pages = plan.get("pool_pages")
+        self.fused_device = bool(plan.get("fused_device", False))
         self.sync_every = max(int(sync_every), 1)
         self._shapes: set = set()
         self._lock = threading.Lock()   # pad_rows runs on pipelined
@@ -411,6 +521,7 @@ class PassExecutor:
         self._chunks = 0
         self._h2d_bytes = 0
         self._h2d_puts = 0
+        self._dispatches = 0
         self._finished = False
 
     # -- shape bucketing ---------------------------------------------------
@@ -476,7 +587,17 @@ class PassExecutor:
         the caller's per-chunk CPU ``fallback``.  ``fn(attempt)`` — the
         attempt number lets the caller re-transfer from host state and
         confine buffer donation to attempt 1.  The ``device_dispatch``
-        fault-injection site fires inside each attempt."""
+        fault-injection site fires inside each attempt.
+
+        Every call lands on the ``dispatch_count{pass=}`` counter — the
+        per-chunk dispatch accounting the fused mega-pass plan is gated
+        on (one ``dispatch_count`` rollup event per pass at finish;
+        docs/OBSERVABILITY.md) — so "three dispatches became one" is a
+        measured number, not a story."""
+        with self._lock:
+            self._dispatches += 1
+        obs.registry().counter("dispatch_count",
+                               **{"pass": self.pass_name}).inc()
         # trace.span is near-free when tracing is off (one global read
         # in __enter__) — and keeps ONE dispatch call site either way
         with obs.trace.span(f"{self.pass_name}:{label}", cat="dispatch"):
@@ -554,6 +675,11 @@ class PassExecutor:
             obs.emit("h2d_bytes", **{"pass": self.pass_name},
                      bytes=int(self._h2d_bytes), puts=self._h2d_puts,
                      layout=self.layout)
+        if self._dispatches:
+            obs.emit("dispatch_count", **{"pass": self.pass_name},
+                     dispatches=int(self._dispatches),
+                     chunks=self._chunks, layout=self.layout,
+                     fused_device=self.fused_device)
 
 
 class StreamExecutor:
@@ -571,6 +697,7 @@ class StreamExecutor:
                  paged: Optional[bool] = None,
                  page_rows: Optional[int] = None,
                  pool_pages: Optional[int] = None,
+                 mega: Optional[bool] = None,
                  link_bytes_per_sec: Optional[float] = None,
                  retry_budget: Optional[int] = None):
         self.mesh_size = getattr(mesh, "size", None) or int(mesh or 1)
@@ -624,6 +751,13 @@ class StreamExecutor:
             except ValueError:
                 pool_pages = None
         self.pool_pages = pool_pages
+        # fused mega-pass pin: the -mega/-no_mega flags win;
+        # ADAM_TPU_MEGA fills an unset flag; None leaves the decision
+        # to raced mega_race evidence (off without it)
+        if mega is None:
+            self.mega_pin = resolve_mega_env(env.get(MEGA_ENV))
+        else:
+            self.mega_pin = bool(mega)
         if link_bytes_per_sec is None and self.autotune and self.on_tpu:
             link_bytes_per_sec = _ledger_link_rate()
         self.link_bytes_per_sec = link_bytes_per_sec
@@ -658,6 +792,7 @@ class StreamExecutor:
                    bytes_per_row: Optional[float] = None,
                    ragged_capable: bool = False,
                    paged_capable: bool = False,
+                   mega_capable: bool = False,
                    sync_every: int = 1) -> PassExecutor:
         """Freeze the plan for one pass (the ONLY place decisions are
         made — never mid-pass) and emit it through obs.
@@ -665,11 +800,15 @@ class StreamExecutor:
         ``ragged_capable=True`` opens the layout dimension: the pass has
         a ragged kernel twin wired in for this run (the caller also
         requires ``mesh_size == 1`` — ragged dispatches are unsharded,
-        so a multi-shard mesh always stays padded)."""
+        so a multi-shard mesh always stays padded).
+        ``mega_capable=True`` opens the fused mega-pass dimension the
+        same way (the fused entries are unsharded multi-output jits, so
+        the same single-shard gate applies)."""
         if self._current is not None:
             self._current.finish()
         capable = bool(ragged_capable) and self.mesh_size == 1
         capable_paged = bool(paged_capable) and self.mesh_size == 1
+        capable_mega = bool(mega_capable) and self.mesh_size == 1
         rates = None
         if capable and self.layout_pin is None and self.autotune:
             rates = ledger_ragged_rates(
@@ -679,6 +818,11 @@ class StreamExecutor:
             # raced evidence can arm the resident pool (ROADMAP item-2
             # headroom); explicit pins above always win
             prates = ledger_paged_rates()
+        mrates = None
+        if capable_mega and self.mega_pin is None and self.autotune:
+            # raced evidence can arm the fused route (ROADMAP item-6);
+            # the explicit -mega/ADAM_TPU_MEGA pin always wins
+            mrates = ledger_mega_rates()
         plan = decide_plan(
             pass_name=pass_name, chunk_rows=self.chunk_rows,
             mesh_size=self.mesh_size, on_tpu=self.on_tpu,
@@ -691,6 +835,8 @@ class StreamExecutor:
             paged_rates=prates,
             page_rows=self.page_rows if capable_paged else None,
             pool_pages=self.pool_pages if capable_paged else None,
+            mega=self.mega_pin, mega_capable=capable_mega,
+            mega_rates=mrates,
             autotune=self.autotune)
         obs.registry().counter("executor_passes",
                                **{"pass": pass_name}).inc()
@@ -701,6 +847,14 @@ class StreamExecutor:
         if "page_rows" in plan:
             extra = dict(page_rows=plan["page_rows"],
                          pool_pages=plan["pool_pages"])
+        if "fused_device" in plan:
+            extra["fused_device"] = plan["fused_device"]
+            # lightweight companion event for dashboards/check_metrics:
+            # which passes armed the fused route and why (replayability
+            # lives in executor_bucket_selected's recorded inputs)
+            obs.emit("mega_plan_selected", **{"pass": pass_name},
+                     fused_device=plan["fused_device"],
+                     reason=plan["reason"])
         obs.emit("executor_bucket_selected", **{"pass": pass_name},
                  chunk_rows=plan["chunk_rows"],
                  ladder=plan["ladder"], ladder_base=plan["ladder_base"],

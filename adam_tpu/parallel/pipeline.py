@@ -50,14 +50,29 @@ def _wire32_from_table(table: pa.Table) -> np.ndarray:
 
 
 def flagstat_wire_chunks(path: str, *, chunk_rows: int,
-                         io_procs: int = 1):
+                         io_procs: int = 1, wire_cache=None):
     """Wire-word chunks for any reads input — the streaming flagstat
     front half, shared with the serve front-end's cross-tenant packer
     (adam_tpu/serve/packed.py).  BAM inputs take the native wire walk
     (no string decode; ``ADAM_TPU_FLAGSTAT_DECODE=arrow`` opts out),
     everything else packs the 4-column Arrow projection per chunk.  The
     I/O-ledger scope attributes the input's on-disk bytes to pass
-    ``flagstat`` at open, exactly like the solo path."""
+    ``flagstat`` at open, exactly like the solo path.
+
+    ``wire_cache`` (a :class:`..serve.wirecache.WireChunkCache`) makes
+    the pack once-per-input within its holder's lifetime: a second
+    consumer of the same (identity, chunk_rows) input in the same serve
+    round replays the packed host chunks — no file open, no decode (and
+    so no re-attributed ledger bytes)."""
+    if wire_cache is not None:
+        return wire_cache.chunks(
+            path, chunk_rows,
+            lambda: _flagstat_wire_chunks_raw(path, chunk_rows,
+                                              io_procs))
+    return _flagstat_wire_chunks_raw(path, chunk_rows, io_procs)
+
+
+def _flagstat_wire_chunks_raw(path: str, chunk_rows: int, io_procs: int):
     from ..io.dispatch import FLAGSTAT_COLUMNS
     from ..io.stream import open_read_stream
 
@@ -79,7 +94,8 @@ def flagstat_wire_chunks(path: str, *, chunk_rows: int,
 
 def streaming_flagstat(path: str, *, mesh=None, chunk_rows: int = 1 << 22,
                        io_threads: int = 1, io_procs: int = 1,
-                       executor_opts: Optional[dict] = None
+                       executor_opts: Optional[dict] = None,
+                       wire_cache=None
                        ) -> Tuple["FlagStatMetrics", "FlagStatMetrics"]:
     """Chunked, mesh-sharded flagstat over any reads input.
 
@@ -118,12 +134,23 @@ def streaming_flagstat(path: str, *, mesh=None, chunk_rows: int = 1 << 22,
     # int32 accumulation window small regardless of file size.
     pex = ex.begin_pass("flagstat", bytes_per_row=4.0,
                         ragged_capable=True, paged_capable=True,
+                        mega_capable=True,
                         sync_every=8 if on_tpu else 1)
     use_pallas = impl == "pallas" or (impl == "auto" and on_tpu)
     paged_mode = pex.layout == "paged"
     ragged_mode = pex.layout == "ragged"
+    # the fused mega-pass route (ops/megapass.py, plan dimension
+    # fused_device): the flagstat leg of the one-dispatch-per-chunk
+    # program — same 26-bit unpack + indicator einsum, housed in the
+    # mega jit so the dispatch_count accounting covers this pass too.
+    # The plan only arms it on a single-shard mesh (begin_pass's
+    # capable gate), so the unsharded jit IS the whole dispatch.
+    fused_mode = pex.fused_device
     if ragged_mode or paged_mode:
         kernel = None           # ragged/paged dispatches are unsharded
+    elif fused_mode:
+        from ..ops.megapass import megapass_wire32
+        kernel = megapass_wire32
     elif use_pallas:
         from ..ops.flagstat_pallas import flagstat_wire32_sharded_pallas
         kernel = flagstat_wire32_sharded_pallas(mesh,
@@ -142,7 +169,8 @@ def streaming_flagstat(path: str, *, mesh=None, chunk_rows: int = 1 << 22,
     # The I/O-ledger scope attributes the input's on-disk bytes (counted
     # by the stream openers) to this pass as decoded input.
     wire_chunks = flagstat_wire_chunks(path, chunk_rows=pex.chunk_rows,
-                                       io_procs=io_procs)
+                                       io_procs=io_procs,
+                                       wire_cache=wire_cache)
     if io_threads > 1:
         # decode (native wire walk / Arrow projection) moves to a reader
         # thread so it overlaps device dispatch; counter accumulation is
@@ -223,6 +251,11 @@ def streaming_flagstat(path: str, *, mesh=None, chunk_rows: int = 1 << 22,
         from ..ops.flagstat_pallas import flagstat_ragged_dispatch
         arr = dev_or_host if attempt == 1 else \
             jax.device_put(dev_or_host, sharding)
+        if fused_mode:
+            # fused route: the mega program's positional-bound twin —
+            # identical indicator monoid, one compiled dispatch
+            from ..ops.megapass import megapass_wire32_bounded
+            return megapass_wire32_bounded(arr, int(total))
         return flagstat_ragged_dispatch(
             arr, total, interpret=use_pallas and not on_tpu,
             use_pallas=use_pallas)
@@ -325,13 +358,21 @@ def streaming_flagstat(path: str, *, mesh=None, chunk_rows: int = 1 << 22,
                 wire_dev[0] == "paged":
             _, ptable, ids = wire_dev
             pex.note_ragged(rows, pex.chunk_rows)
+
+            def _paged_first(tab, t):
+                if fused_mode:
+                    from ..ops.megapass import megapass_wire32_paged
+                    return megapass_wire32_paged(pool.device("wire"),
+                                                 tab, t)
+                return flagstat_paged_dispatch(
+                    pool.device("wire"), tab, t,
+                    interpret=use_pallas and not on_tpu,
+                    use_pallas=use_pallas)
+
             counts = pex.dispatch(
                 "count",
                 lambda attempt, tab=ptable, host=wire_host, t=rows:
-                    flagstat_paged_dispatch(
-                        pool.device("wire"), tab, t,
-                        interpret=use_pallas and not on_tpu,
-                        use_pallas=use_pallas)
+                    _paged_first(tab, t)
                     if attempt == 1 else _rag_dispatch(host, t, 2),
                 split=lambda e, host=wire_host, t=rows:
                     _rag_split(host[:t], e),
@@ -595,15 +636,27 @@ class _MarkdupKeys:
         n = table.num_rows
         is_host = isinstance(batch.flags, np.ndarray)
 
+        # the fused mega-pass route (plan dimension fused_device, only
+        # armed on a single-shard mesh): the markdup leg of the
+        # multi-output program — the SAME jitted key kernel inlined
+        # under the mega jit, so keys are bit-identical by construction
+        fused = pex is not None and getattr(pex, "fused_device", False)
+
         def compute(b):
             # the executor's device feed may hand the batch in already
             # sharded (its transfer then overlapped the previous
             # chunk's key kernel); host batches take the put here
             sharded = b if not isinstance(b.flags, np.ndarray) \
                 else b.device_put(reads_sharding(self.mesh))
-            fp, score = _device_fiveprime_and_score(
-                sharded.flags, sharded.start, sharded.cigar_ops,
-                sharded.cigar_lens, sharded.n_cigar, sharded.quals)
+            if fused:
+                from ..ops.megapass import megapass_markdup
+                fp, score = megapass_markdup(
+                    sharded.flags, sharded.start, sharded.cigar_ops,
+                    sharded.cigar_lens, sharded.n_cigar, sharded.quals)
+            else:
+                fp, score = _device_fiveprime_and_score(
+                    sharded.flags, sharded.start, sharded.cigar_ops,
+                    sharded.cigar_lens, sharded.n_cigar, sharded.quals)
             # materialize BEFORE any accumulator mutates: a device
             # error must surface here, inside the retry ladder — never
             # between appends (a partial append would corrupt the keys)
@@ -1376,6 +1429,7 @@ def streaming_transform(input_path: str, output_path: str, *,
             pex2 = ex.begin_pass(
                 "p2", bytes_per_row=2.0 * max(bucket_len, 1) + 64.0,
                 ragged_capable=True, paged_capable=True,
+                mega_capable=True,
                 sync_every=4 if is_tpu_backend() else 1)
             rt = _count_stream(
                 pex2,
@@ -1590,6 +1644,11 @@ def _count_stream(pex, fed_iter, *, snp_table, n_rg_run, bucket_len,
     paged_box = None
     if pex.layout == "paged":
         paged_box = {"pass": pex.pass_name, "put": pex.dispatch_put}
+    # fused_device plan dimension: route the count through the mega-pass
+    # bqsr leg (ops/megapass — the SAME pack + fold jits, composed under
+    # one program).  Retries and the CPU fallback stay unfused: a chunk
+    # that failed under the fused program re-runs the plain kernels.
+    fused = pex.fused_device
     for table, batch, dev_batch in fed_iter:
         md_info = None if md_info_fn is None else md_info_fn(table)
         will_sync = (n_counted + 1) % pex.sync_every == 0
@@ -1604,7 +1663,8 @@ def _count_stream(pex, fed_iter, *, snp_table, n_rg_run, bucket_len,
                         device_batch=d if attempt == 1 else None,
                         donate=pex.donate and attempt == 1,
                         md_info=mi, layout=pex.layout,
-                        paged_box=paged_box if attempt == 1 else None),
+                        paged_box=paged_box if attempt == 1 else None,
+                        fused=fused and attempt == 1),
                 fallback=lambda e, t=table, b=batch, mi=md_info:
                     cpu_fallback(t, b, mi))
             if isinstance(out[0], np.ndarray):
@@ -1762,7 +1822,7 @@ def _fused_transform(input_path: str, output_path: str, *, plan: dict,
             if ck is not None:
                 ck.clean_unless("s1", "bin-*", "halo-*", "raw",
                                 "dup.npy", "mdinfo.npz")
-            pex1 = ex.begin_pass("s1")
+            pex1 = ex.begin_pass("s1", mega_capable=markdup)
             with obs.ioledger.pass_scope("s1"):
                 stream = open_read_stream(input_path,
                                           chunk_rows=pex1.chunk_rows,
@@ -2028,7 +2088,7 @@ def _fused_count_pass(*, ex, workdir, raw_path, plan, mesh, snp_table,
     wire = plan["wire_spill"]
     pex2 = ex.begin_pass(
         "s2", bytes_per_row=2.0 * max(bucket_len, 1) + 64.0,
-        ragged_capable=True, paged_capable=True,
+        ragged_capable=True, paged_capable=True, mega_capable=True,
         sync_every=4 if is_tpu_backend() else 1)
     scalar_cols = ["flags", "start", "recordGroupId", "cigar"]
     if snp_table is not None:

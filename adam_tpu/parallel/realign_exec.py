@@ -77,7 +77,8 @@ def decide_realign_plan(*, n_bins: int, on_tpu: bool,
                         depth: Optional[int] = None,
                         donate: Optional[bool] = None,
                         layout: Optional[str] = None,
-                        ragged_rates: Optional[dict] = None) -> dict:
+                        ragged_rates: Optional[dict] = None,
+                        paged_rates: Optional[dict] = None) -> dict:
     """The pass-4 plan: one frozen decision per transform run.
 
     PURE — the returned plan is a deterministic function of the keyword
@@ -89,9 +90,14 @@ def decide_realign_plan(*, n_bins: int, on_tpu: bool,
     ``layout`` picks the sweep dispatch form: ``padded`` buckets jobs on
     all four (R, L, CL, G) axes; ``ragged`` concatenates reads across
     jobs and buckets only on the (CL, G) rungs (docs/ARCHITECTURE.md
-    §6g).  Unpinned, the decision follows the bench ``ragged_race``
-    evidence the same way ``executor.decide_plan`` does — padded stays
-    the no-evidence default.
+    §6g); ``paged`` ships the ragged planes page-granular through a
+    resident pool (docs/ARCHITECTURE.md §6l,
+    ``realigner.sweep_dispatch_paged``).  Unpinned, the decision follows
+    the bench ``paged_race`` / ``ragged_race`` evidence the same way
+    ``executor.decide_plan`` does — padded stays the no-evidence
+    default.  The paged keys join ``inputs`` only when engaged (a pin
+    or evidence present), so pre-paged recorded plans replay
+    digest-identical.
     """
     inputs = dict(n_bins=int(n_bins), on_tpu=bool(on_tpu),
                   pipeline=None if pipeline is None else bool(pipeline),
@@ -101,14 +107,34 @@ def decide_realign_plan(*, n_bins: int, on_tpu: bool,
                   ragged_rates=None if not ragged_rates else {
                       k: round(float(v), 1)
                       for k, v in sorted(ragged_rates.items())})
+    paged_engaged = layout == "paged" or bool(paged_rates)
+    if paged_engaged:
+        inputs["paged_rates"] = None if not paged_rates else {
+            k: round(float(v), 4)
+            for k, v in sorted(paged_rates.items())}
     reasons = []
     lay = "padded"
-    if inputs["layout"] == "ragged":
+    if inputs["layout"] == "paged":
+        lay = "paged"
+        reasons.append("layout-pinned-paged")
+    elif inputs["layout"] == "ragged":
         lay = "ragged"
         reasons.append("layout-pinned-ragged")
     elif inputs["layout"] == "padded":
         reasons.append("layout-pinned-padded")
-    elif inputs["ragged_rates"]:
+    elif paged_engaged and inputs.get("paged_rates"):
+        # the executor's paged-evidence bar: measured h2d win over the
+        # reduction floor, serve wall inside the slack band
+        from .executor import (PAGED_EVIDENCE_MIN_REDUCTION,
+                               PAGED_EVIDENCE_WALL_SLACK)
+        pr = inputs["paged_rates"]
+        if pr.get("h2d_reduction", 0) >= PAGED_EVIDENCE_MIN_REDUCTION \
+                and pr.get("paged_wall_s", float("inf")) <= \
+                PAGED_EVIDENCE_WALL_SLACK * pr.get("unpaged_wall_s", 0):
+            lay = "paged"
+            reasons.append(
+                f"paged-evidence h2d {pr['h2d_reduction']:.1f}x")
+    if lay == "padded" and not reasons and inputs["ragged_rates"]:
         rr = inputs["ragged_rates"]
         if rr.get("ragged", 0) > rr.get("padded", 0) > 0:
             lay = "ragged"
@@ -140,10 +166,14 @@ def decide_realign_plan(*, n_bins: int, on_tpu: bool,
 
 def resolve_realign_opts(opts: Optional[dict] = None) -> dict:
     """CLI flags win; ``ADAM_TPU_REALIGN_*`` (and the shared
-    ``ADAM_TPU_RAGGED``) envs fill whatever the caller left unset (the
-    executor's flag/env convention).  An unpinned layout pulls the
-    raced bench evidence for the realign sweep from the PR 2 ledger."""
-    from .executor import RAGGED_ENV, ledger_ragged_rates, resolve_ragged_env
+    ``ADAM_TPU_RAGGED`` / ``ADAM_TPU_PAGED``) envs fill whatever the
+    caller left unset (the executor's flag/env convention).  An
+    unpinned layout pulls the raced bench evidence for the realign
+    sweep from the PR 2 ledger — the paged record first (residency
+    outranks the addressing scheme alone), then the ragged race."""
+    from .executor import (PAGED_ENV, RAGGED_ENV, ledger_paged_rates,
+                           ledger_ragged_rates, resolve_ragged_env)
+    from .pagedbuf import resolve_paged_env
 
     out = dict(opts or {})
     env = os.environ
@@ -157,9 +187,15 @@ def resolve_realign_opts(opts: Optional[dict] = None) -> dict:
     if "donate" not in out and env.get(REALIGN_DONATE_ENV) in ("0", "off"):
         out["donate"] = False
     if out.get("layout") is None:
-        out["layout"] = resolve_ragged_env(env.get(RAGGED_ENV))
+        if resolve_paged_env(env.get(PAGED_ENV)):
+            out["layout"] = "paged"
+        else:
+            out["layout"] = resolve_ragged_env(env.get(RAGGED_ENV))
     if out["layout"] is None:
         out.pop("layout")
+        prates = ledger_paged_rates()
+        if prates:
+            out["paged_rates"] = prates
         rates = ledger_ragged_rates("realign")
         if rates:
             out["ragged_rates"] = rates
@@ -220,12 +256,14 @@ class CrossBinSweepBatcher:
         self._results: Dict[tuple, tuple] = {}    # (uid,si,ji) -> (chunk,g)
         self._unit_shapes: Dict[tuple, set] = {}  # uid -> undispatched keys
         self._shapes_seen: set = set()            # (G, R, L, CL) sightings
+        self._pool = None                         # paged: resident pool
 
     def _key(self, job) -> tuple:
-        """Bucket key: the full padded (R, L, CL) shape, or — ragged —
-        the CL rung alone: concatenated reads make R and L per-dispatch
-        totals instead of per-job shape axes, so only the consensus
-        rung (and the padded lane count G) remain compiled axes."""
+        """Bucket key: the full padded (R, L, CL) shape, or — ragged
+        and paged — the CL rung alone: concatenated reads make R and L
+        per-dispatch totals instead of per-job shape axes, so only the
+        consensus rung (and the padded lane count G) remain compiled
+        axes."""
         return job.shape if self._layout == "padded" \
             else (job.shape[2],)
 
@@ -270,17 +308,19 @@ class CrossBinSweepBatcher:
         return out
 
     def _dispatch(self, shape: tuple, members: list) -> None:
-        if self._layout == "ragged":
+        if self._layout in ("ragged", "paged"):
             # chunk by cumulative flat bases so the [T, CLp] working set
             # stays under budget (realigner.ragged_chunk_jobs)
             t_of = [int(self._states[u][si].lens.sum())
                     for u, si, _ in members]
             splits = R.ragged_chunk_jobs(t_of, shape[0]) + [len(members)]
+            dispatch_one = self._dispatch_chunk_paged \
+                if self._layout == "paged" \
+                else self._dispatch_chunk_ragged
             lo = 0
             for hi in splits:
                 if hi > lo:
-                    self._dispatch_chunk_ragged(shape[0],
-                                                members[lo:hi])
+                    dispatch_one(shape[0], members[lo:hi])
                 lo = hi
             return
         Rr, L, CL = shape
@@ -402,6 +442,70 @@ class CrossBinSweepBatcher:
                  jobs=len(chunk), g=stats["g"],
                  units=len({u for u, _, _ in chunk}),
                  layout="ragged",
+                 waste_r=round(1 - stats["rows"] /
+                               max(stats["rows_pad"], 1), 4),
+                 waste_l=round(1 - stats["bases"] /
+                               max(stats["bases_pad"], 1), 4),
+                 waste_cl=round(1 - stats["cons_true"] /
+                                max(len(chunk) * cl, 1), 4),
+                 waste_g=round(1 - len(chunk) / stats["g"], 4))
+
+    def _dispatch_chunk_paged(self, cl: int, chunk: list) -> None:
+        """One PAGED device sweep batch: the ragged dispatch's flat
+        planes ship page-granular through a batcher-held resident
+        :class:`.pagedbuf.PagePool` reused across every dispatch of the
+        run (``realigner.sweep_dispatch_paged`` — only live pages cross
+        the link; a thrashing pool falls back to the ragged concat
+        inside the dispatch, identical bytes either way).  Same retry /
+        half-split discipline as the other layouts."""
+        pairs = [(self._states[u][si], self._states[u][si].jobs[ji])
+                 for u, si, ji in chunk]
+        if self._pool is None:
+            from ..realign.realigner import (PAGED_SWEEP_PLANES,
+                                             _RAGGED_T_MULT)
+            from .pagedbuf import DEFAULT_PAGE_ROWS, PagePool
+            page_rows = min(DEFAULT_PAGE_ROWS, _RAGGED_T_MULT)
+            t = sum(int(st.lens[:len(st.reads_to_clean)].sum())
+                    for st, _ in pairs)
+            self._pool = PagePool(
+                "p4", max(-(-max(t, 1) // page_rows) * 2, 2),
+                page_rows, planes=PAGED_SWEEP_PLANES)
+
+        def fn(attempt):
+            return R.sweep_dispatch_paged(pairs, pool=self._pool)
+
+        def split(err):
+            if len(chunk) <= 1:
+                raise err
+            mid = (len(chunk) + 1) // 2
+            self._dispatch_chunk_paged(cl, chunk[:mid])
+            self._dispatch_chunk_paged(cl, chunk[mid:])
+            return None
+
+        with obs.trace.span("realign:sweep", cat="dispatch",
+                            args={"shape": [cl], "jobs": len(chunk),
+                                  "layout": "paged"}):
+            out = dispatch_with_retry(fn, site="device_dispatch",
+                                      label="realign:sweep",
+                                      policy=self._retry, split=split)
+        if out is None:
+            return
+        q, o, spans, stats = out
+        cr = _ChunkResult(q, o)
+        for key, span in zip(chunk, spans):
+            self._results[key] = (cr, span)
+        r = obs.registry()
+        r.counter("realign_sweep_dispatches").inc()
+        r.counter("realign_sweep_jobs").inc(len(chunk))
+        sig = (stats["g"], stats["rows_pad"], stats["bases_pad"], cl)
+        if sig not in self._shapes_seen:
+            self._shapes_seen.add(sig)
+            r.counter("realign_shapes").inc()
+        obs.emit("realign_sweep_dispatch",
+                 shape=[stats["rows_pad"], stats["bases_pad"], cl],
+                 jobs=len(chunk), g=stats["g"],
+                 units=len({u for u, _, _ in chunk}),
+                 layout="paged",
                  waste_r=round(1 - stats["rows"] /
                                max(stats["rows_pad"], 1), 4),
                  waste_l=round(1 - stats["bases"] /

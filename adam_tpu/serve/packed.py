@@ -49,7 +49,8 @@ class SharedDispatchError(RuntimeError):
 def packed_flagstat(specs: List[dict], *, chunk_rows: int = 1 << 22,
                     pack_segments: int = 8,
                     executor_opts: Optional[dict] = None,
-                    pool_holder: Optional[dict] = None
+                    pool_holder: Optional[dict] = None,
+                    wire_cache=None
                     ) -> Tuple[Dict[str, Tuple[object, object]],
                                Dict[str, dict]]:
     """Run N flagstat jobs through shared fixed-capacity dispatches.
@@ -74,6 +75,12 @@ def packed_flagstat(specs: List[dict], *, chunk_rows: int = 1 << 22,
     ``pool_holder`` (the server's cross-round dict) keeps the pool
     resident across packed_flagstat calls — the steady state where
     host→device transfer between dispatches is only ever new rows.
+
+    ``wire_cache`` (the server's cross-round
+    :class:`.wirecache.WireChunkCache`) makes each tenant input's wire
+    pack once-per-round: a degrade-to-solo re-run, a duplicate job on
+    the same input, or the s2 count pass replaying the same round's
+    planes hits the packed host chunks instead of re-decoding the file.
     """
     import jax
     import jax.numpy as jnp
@@ -256,7 +263,8 @@ def packed_flagstat(specs: List[dict], *, chunk_rows: int = 1 << 22,
                 try:
                     chunks = flagstat_wire_chunks(
                         spec["input"], chunk_rows=cap,
-                        io_procs=int(spec["args"].get("io_procs", 1)))
+                        io_procs=int(spec["args"].get("io_procs", 1)),
+                        wire_cache=wire_cache)
                     for w in chunks:
                         w = np.asarray(w, np.uint32)
                         stats[job_id]["rows"] += int(w.size)

@@ -244,6 +244,13 @@ class ServeServer:
         #: lifetime — steady state means only new tenants' rows ever
         #: cross the link between dispatches (docs/ARCHITECTURE.md §6l)
         self._pool_holder: Dict[str, object] = {}
+        #: the cross-round wire-chunk cache (serve/wirecache.py): one
+        #: tenant input packs its flagstat projection once per serve
+        #: lifetime however many jobs — packed ingest, degrade-to-solo
+        #: re-runs, duplicate submissions — consume it; identity keys
+        #: (size + mtime) invalidate rewritten inputs
+        from .wirecache import WireChunkCache
+        self._wire_cache = WireChunkCache()
 
     # -- boot ---------------------------------------------------------------
 
@@ -516,7 +523,8 @@ class ServeServer:
                 spec["input"], chunk_rows=self.chunk_rows,
                 io_procs=int(spec["args"].get("io_procs",
                                               self.io_procs)),
-                executor_opts=self.executor_opts)
+                executor_opts=self.executor_opts,
+                wire_cache=self._wire_cache)
             return {"report": format_report(failed, passed)}
         if spec["command"] == "flagstat_range":
             # the fleet scheduler's shard sub-job: one unit range of a
@@ -681,7 +689,8 @@ class ServeServer:
                 specs, chunk_rows=self.chunk_rows,
                 pack_segments=self.pack_segments,
                 executor_opts=self.executor_opts,
-                pool_holder=self._pool_holder)
+                pool_holder=self._pool_holder,
+                wire_cache=self._wire_cache)
         except (SharedDispatchError, FileNotFoundError,
                 IsADirectoryError, FormatError, InjectedFault,
                 ValueError, RuntimeError, OSError) as e:

@@ -1,0 +1,143 @@
+"""Per-input wire-chunk cache: pack the flagstat projection once per
+serve round, however many jobs consume it.
+
+When a serve round runs streaming flagstat and the s2 BQSR count (or a
+packed ingest and its degrade-to-solo re-run) over the SAME tenant
+input, each consumer used to re-open the file and re-pack the 26-bit
+wire words chunk by chunk — the host-side twin of the device-side
+triple dispatch the mega-pass collapses (ops/megapass.py).  This module
+is the decode-side fix: a bounded, thread-safe cache of packed wire32
+chunks keyed by the input's IDENTITY (realpath, size, mtime_ns) plus
+the chunk geometry, so the second consumer replays host arrays instead
+of decoding bytes.
+
+Correctness discipline:
+
+* identity keys — a rewritten input (new size or mtime) misses and
+  re-decodes; stale chunks age out by LRU, they are never served for a
+  changed file;
+* complete-run gating — a producer that stops early (fault injection,
+  admission kill) never marks its entry complete, so partial streams
+  can't masquerade as the whole input;
+* bounded memory — entries evict LRU once the byte budget
+  (``ADAM_TPU_WIRE_CACHE_MB``, default 256; ``0`` disables) is
+  exceeded, and an input bigger than the whole budget is simply never
+  cached.
+
+Hits and misses are counters (``wire_cache_hits`` /
+``wire_cache_misses``, docs/OBSERVABILITY.md) so the collapse is
+observable, matching the dispatch_count contract on the device side.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from collections import OrderedDict
+from typing import Iterator, List, Optional, Tuple
+
+import numpy as np
+
+from .. import obs
+
+#: byte budget env (MiB); 0/off disables caching entirely
+WIRE_CACHE_MB_ENV = "ADAM_TPU_WIRE_CACHE_MB"
+DEFAULT_WIRE_CACHE_MB = 256
+
+
+def _budget_bytes() -> int:
+    raw = os.environ.get(WIRE_CACHE_MB_ENV, "")
+    try:
+        mb = int(raw) if raw else DEFAULT_WIRE_CACHE_MB
+    except ValueError:
+        mb = DEFAULT_WIRE_CACHE_MB
+    return max(mb, 0) << 20
+
+
+def input_identity(path: str) -> Optional[Tuple[str, int, int]]:
+    """(realpath, size, mtime_ns) — None when unstattable (pipes,
+    vanished files): such inputs are simply not cacheable."""
+    try:
+        st = os.stat(path)
+    except OSError:
+        return None
+    return (os.path.realpath(path), int(st.st_size),
+            int(st.st_mtime_ns))
+
+
+class WireChunkCache:
+    """LRU cache of complete packed wire-chunk runs, one entry per
+    (input identity, chunk_rows)."""
+
+    def __init__(self, max_bytes: Optional[int] = None):
+        self.max_bytes = _budget_bytes() if max_bytes is None \
+            else int(max_bytes)
+        self._lock = threading.Lock()
+        self._entries: "OrderedDict[tuple, List[np.ndarray]]" = \
+            OrderedDict()
+        self._bytes = 0
+
+    # -- internals ----------------------------------------------------------
+
+    def _evict_until(self, need: int) -> None:
+        # caller holds the lock
+        while self._entries and self._bytes + need > self.max_bytes:
+            _, old = self._entries.popitem(last=False)
+            self._bytes -= sum(c.nbytes for c in old)
+
+    def _get(self, key: tuple) -> Optional[List[np.ndarray]]:
+        with self._lock:
+            chunks = self._entries.get(key)
+            if chunks is not None:
+                self._entries.move_to_end(key)
+            return chunks
+
+    def _put(self, key: tuple, chunks: List[np.ndarray]) -> None:
+        size = sum(c.nbytes for c in chunks)
+        if size > self.max_bytes:
+            return                          # bigger than the whole budget
+        with self._lock:
+            if key in self._entries:
+                return
+            self._evict_until(size)
+            self._entries[key] = chunks
+            self._bytes += size
+
+    # -- the one public entry ----------------------------------------------
+
+    def chunks(self, path: str, chunk_rows: int,
+               produce) -> Iterator[np.ndarray]:
+        """Yield ``path``'s packed wire chunks, from cache when a
+        complete identical-geometry run is stored, else from
+        ``produce()`` (the real decode) while recording a copy.  The
+        entry is committed only after the producer is exhausted."""
+        ident = None if self.max_bytes <= 0 else input_identity(path)
+        if ident is None:
+            yield from produce()
+            return
+        key = ident + (int(chunk_rows),)
+        cached = self._get(key)
+        reg = obs.registry()
+        if cached is not None:
+            reg.counter("wire_cache_hits").inc()
+            yield from cached
+            return
+        reg.counter("wire_cache_misses").inc()
+        kept: List[np.ndarray] = []
+        keep = True
+        for w in produce():
+            w = np.asarray(w)
+            if keep:
+                kept.append(w)
+                if sum(c.nbytes for c in kept) > self.max_bytes:
+                    kept, keep = [], False  # over budget: stream through
+            yield w
+        if keep and input_identity(path) == ident:
+            # identity re-checked at commit: a file rewritten while we
+            # streamed it must not publish the torn read
+            self._put(key, kept)
+
+    @property
+    def stored_bytes(self) -> int:
+        with self._lock:
+            return self._bytes

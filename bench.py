@@ -2276,6 +2276,299 @@ def _stage_call(kind: str, is_tpu: bool):
     _emit("call", out)
 
 
+def _stage_mega_race(kind: str, is_tpu: bool):
+    """The fused mega-pass device kernel vs its three unfused twins
+    (ISSUE 18, ops/megapass.py).  Two halves:
+
+    * **Kernel identity** — one fused program bit-identical to the
+      unfused flagstat counter block + markdup key columns + packed
+      BQSR covariate tables over an adversarial batch, on the XLA
+      route AND the Mosaic-interpreter route, with ragged and paged
+      (scrambled-placement) layout twins
+      (``mega_*_matches_*`` keys; ``mega_identical`` rolls them up —
+      gated forever by bench_gate gate 10).
+    * **The combined dispatch-count leg** — the same chunk stream
+      through a real ``StreamExecutor`` twice: UNFUSED issues three
+      ``pex.dispatch`` calls per chunk (flagstat, markdup keys, BQSR
+      count — three plane loads), FUSED issues ONE ``megapass``
+      dispatch per chunk.  Gated numbers:
+      ``mega_dispatch_reduction`` (unfused over fused
+      ``dispatch_count{pass=}``, ≥ 2x), the folded results
+      byte-identical between routes (feeds ``mega_identical``),
+      ``mega_steady_recompiles == 0`` (a warm fused re-round compiles
+      nothing), and the round-2 walls (the capacity-armed floor).
+      Process-internal by design — ``is_tpu`` only stamps the
+      platform."""
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    from adam_tpu import obs
+    from adam_tpu.bqsr.table import RecalTable
+    from adam_tpu.ops import megapass as M
+    from adam_tpu.packing import ReadBatch, ragged_from_batch, shape_rung
+
+    payload: dict = {"backend": jax.default_backend()}
+    a = jnp.asarray
+
+    def batch_of(rng, N, L=64, C=4, n_rg=2):
+        # the adversarial mix tests/test_megapass.py pins: mixed flag
+        # words, null/extreme mapq and refids, invalid bases, negative
+        # quals, zero-length and unusable reads, ragged cigars
+        read_len = rng.choice([0, 1, 5, 30, L - 1, L], N).astype(np.int32)
+        lane = np.arange(L)[None, :]
+        live = lane < read_len[:, None]
+        batch = ReadBatch(
+            flags=rng.choice([0, 4, 16, 1 + 64, 1 + 128 + 16, 256, 512,
+                              1024, 2048, 1 + 2 + 32 + 64],
+                             N).astype(np.int32),
+            refid=rng.randint(-1, 3, N).astype(np.int32),
+            start=rng.randint(-1, 10000, N).astype(np.int32),
+            mapq=rng.choice([-1, 0, 29, 30, 60, 255], N).astype(np.int32),
+            mate_refid=rng.randint(-1, 3, N).astype(np.int32),
+            mate_start=rng.randint(-1, 10000, N).astype(np.int32),
+            read_group=rng.randint(-1, n_rg, N).astype(np.int32),
+            valid=rng.rand(N) < 0.85,
+            row_index=np.arange(N, dtype=np.int32),
+            read_len=read_len,
+            bases=np.where(live, rng.randint(-1, 5, (N, L)),
+                           -1).astype(np.int8),
+            quals=np.where(live, rng.randint(-1, 61, (N, L)),
+                           -1).astype(np.int8),
+            cigar_ops=rng.randint(-1, 9, (N, C)).astype(np.int8),
+            cigar_lens=rng.randint(0, 21, (N, C)).astype(np.int32),
+            n_cigar=rng.randint(0, C + 1, N).astype(np.int32))
+        state = rng.randint(0, 3, (N, L)).astype(np.int8)
+        usable = rng.rand(N) < 0.9
+        return batch, state, usable
+
+    def unfused(batch, state, usable, rt, impl):
+        from adam_tpu.bqsr.count_pallas import count_kernel_pallas
+        from adam_tpu.bqsr.recalibrate import _count_kernel
+        from adam_tpu.ops.flagstat import flagstat_kernel
+        from adam_tpu.ops.markdup import _device_fiveprime_and_score
+
+        fs = np.asarray(flagstat_kernel(
+            a(batch.flags), a(batch.mapq), a(batch.refid),
+            a(batch.mate_refid), a(batch.valid)))
+        fp, score = _device_fiveprime_and_score(
+            a(batch.flags), a(batch.start), a(batch.cigar_ops),
+            a(batch.cigar_lens), a(batch.n_cigar), a(batch.quals))
+        if impl == "pallas":
+            bq = count_kernel_pallas(
+                a(batch.bases), a(batch.quals), a(batch.read_len),
+                a(batch.flags), a(batch.read_group), a(state), a(usable),
+                n_qual_rg=rt.n_qual_rg, n_cycle=rt.n_cycle,
+                interpret=not is_tpu)
+        else:
+            bq = _count_kernel(
+                a(batch.bases), a(batch.quals), a(batch.read_len),
+                a(batch.flags), a(batch.read_group), a(state), a(usable),
+                n_qual_rg=rt.n_qual_rg, n_cycle=rt.n_cycle)
+        return fs, (np.asarray(fp), np.asarray(score)), \
+            [np.asarray(o) for o in bq]
+
+    def same(out, fs, mk, bq, n=None):
+        ok = np.array_equal(np.asarray(out["flagstat"]), fs)
+        got_fp = np.asarray(out["markdup"][0])
+        got_sc = np.asarray(out["markdup"][1])
+        if n is not None:
+            got_fp, got_sc = got_fp[:n], got_sc[:n]
+        ok = ok and np.array_equal(got_fp, mk[0]) and \
+            np.array_equal(got_sc, mk[1])
+        return ok and all(np.array_equal(np.asarray(x), y)
+                          for x, y in zip(out["bqsr"], bq))
+
+    # ---- kernel identity: fused twins vs unfused kernels -------------
+    rng = np.random.RandomState(29)
+    batch, state, usable = batch_of(rng, 257)
+    rt = RecalTable(n_read_groups=2, max_read_len=batch.max_len)
+    for impl in ("xla", "pallas"):
+        try:
+            fs, mk, bq = unfused(batch, state, usable, rt, impl)
+            out = M.megapass_from_batch(
+                batch, state=state, usable=usable, n_qual_rg=rt.n_qual_rg,
+                n_cycle=rt.n_cycle, impl=impl, interpret=not is_tpu)
+            payload[f"mega_padded_{impl}_matches_unfused"] = \
+                same(out, fs, mk, bq)
+        except Exception as e:  # noqa: BLE001 — record, race the rest
+            payload[f"mega_padded_{impl}_error"] = \
+                f"{type(e).__name__}: {e}"[:160]
+    try:
+        from adam_tpu.bqsr.count_pallas import BLOCK_ELEMS, flatten_state
+
+        fs, mk, bq = unfused(batch, state, usable, rt, "xla")
+        t_rung = shape_rung(max(int(batch.read_len.sum()), 1),
+                            BLOCK_ELEMS)
+        rb = ragged_from_batch(batch, pad_bases_to=t_rung)
+        sf = flatten_state(state, rb.read_len, len(rb.bases_flat))
+        rout = M.megapass_from_ragged(
+            rb, state_flat=sf, usable=usable, n_qual_rg=rt.n_qual_rg,
+            n_cycle=rt.n_cycle, max_read_len=batch.max_len)
+        payload["mega_ragged_matches_unfused"] = \
+            same(rout, fs, mk, bq, n=batch.n_reads)
+    except Exception as e:  # noqa: BLE001 — record, race the rest
+        payload["mega_ragged_error"] = f"{type(e).__name__}: {e}"[:160]
+    try:
+        from adam_tpu.bqsr.count_pallas import (BLOCK_ELEMS,
+                                                PAGED_COUNT_PLANES)
+        from adam_tpu.parallel.pagedbuf import PagePool
+
+        table_len = t_rung // BLOCK_ELEMS
+        pool = PagePool("mega_race", table_len + 3, BLOCK_ELEMS,
+                        planes=PAGED_COUNT_PLANES)
+        # scramble: burn the lowest ids so pages land off-origin
+        burn = pool.alloc(2)
+        need = -(-int(rb.n_bases) // BLOCK_ELEMS)
+        ids = pool.alloc(need)
+        pool.free(burn)
+        live = need * BLOCK_ELEMS
+        pool.write(ids, bases=rb.bases_flat[:live],
+                   quals=rb.quals_flat[:live], state=sf[:live],
+                   row_of=rb.row_of[:live], pos_of=rb.pos_of[:live])
+        pout = M.megapass_paged(
+            {n: pool.device(n) for n, _ in PAGED_COUNT_PLANES},
+            pool.table(ids, table_len), a(rb.flags), a(rb.mapq),
+            a(rb.refid), a(rb.mate_refid), a(rb.valid), a(rb.start),
+            a(rb.cigar_ops), a(rb.cigar_lens), a(rb.n_cigar),
+            a(rb.row_offsets[:-1]), a(rb.read_len), a(rb.read_group),
+            a(usable), jnp.int32(rb.n_bases), want=M.WANT_ALL,
+            n_rows=rb.n_reads, n_qual_rg=rt.n_qual_rg,
+            n_cycle=rt.n_cycle, max_read_len=batch.max_len)
+        ident = all(np.array_equal(np.asarray(x), np.asarray(y))
+                    for x, y in zip(pout["bqsr"], rout["bqsr"]))
+        ident = ident and np.array_equal(np.asarray(pout["flagstat"]),
+                                         np.asarray(rout["flagstat"]))
+        for j in range(2):
+            ident = ident and np.array_equal(
+                np.asarray(pout["markdup"][j]),
+                np.asarray(rout["markdup"][j]))
+        payload["mega_paged_matches_ragged"] = bool(ident)
+    except Exception as e:  # noqa: BLE001 — record, race the rest
+        payload["mega_paged_error"] = f"{type(e).__name__}: {e}"[:160]
+
+    # ---- the combined dispatch-count leg -----------------------------
+    from adam_tpu.parallel.executor import StreamExecutor
+
+    n_chunks = max(int(os.environ.get("ADAM_TPU_BENCH_MEGA_CHUNKS", 6)),
+                   2)
+    rows = int(os.environ.get("ADAM_TPU_BENCH_MEGA_ROWS", 4096))
+    chunks = [batch_of(np.random.RandomState(200 + i), rows)
+              for i in range(n_chunks)]
+    rt2 = RecalTable(n_read_groups=2, max_read_len=chunks[0][0].max_len)
+
+    def disp(pass_name: str) -> int:
+        return int(obs.registry().counter(
+            "dispatch_count", **{"pass": pass_name}).value)
+
+    def fold_unfused(pass_name: str):
+        from adam_tpu.bqsr.recalibrate import _count_kernel
+        from adam_tpu.ops.flagstat import flagstat_kernel
+        from adam_tpu.ops.markdup import _device_fiveprime_and_score
+
+        ex = StreamExecutor(1, rows, mega=False)
+        pex = ex.begin_pass(pass_name)
+        fs_acc, fps, scs, bq_acc = None, [], [], None
+        for b, st, us in chunks:
+            # three plane loads, three dispatches — the unfused tax
+            fs = pex.dispatch("flagstat", lambda _a, b=b: flagstat_kernel(
+                a(b.flags), a(b.mapq), a(b.refid), a(b.mate_refid),
+                a(b.valid)))
+            mk = pex.dispatch(
+                "markdup",
+                lambda _a, b=b: _device_fiveprime_and_score(
+                    a(b.flags), a(b.start), a(b.cigar_ops),
+                    a(b.cigar_lens), a(b.n_cigar), a(b.quals)))
+            bq = pex.dispatch(
+                "bqsr",
+                lambda _a, b=b, st=st, us=us: _count_kernel(
+                    a(b.bases), a(b.quals), a(b.read_len), a(b.flags),
+                    a(b.read_group), a(st), a(us),
+                    n_qual_rg=rt2.n_qual_rg, n_cycle=rt2.n_cycle))
+            fs = np.asarray(fs).astype(np.int64)
+            fs_acc = fs if fs_acc is None else fs_acc + fs
+            fps.append(np.asarray(mk[0]))
+            scs.append(np.asarray(mk[1]))
+            bq = [np.asarray(o).astype(np.int64) for o in bq]
+            bq_acc = bq if bq_acc is None else \
+                [x + y for x, y in zip(bq_acc, bq)]
+        ex.finish()
+        return fs_acc, np.concatenate(fps), np.concatenate(scs), bq_acc
+
+    def fold_fused(pass_name: str):
+        ex = StreamExecutor(1, rows, mega=True)
+        pex = ex.begin_pass(pass_name, mega_capable=True)
+        fused = bool(pex.plan.get("fused_device"))
+        fs_acc, fps, scs, bq_acc = None, [], [], None
+        for b, st, us in chunks:
+            # ONE dispatch: every leg off a single set of plane loads
+            out = pex.dispatch(
+                "mega",
+                lambda _a, b=b, st=st, us=us: M.megapass_from_batch(
+                    b, state=st, usable=us, n_qual_rg=rt2.n_qual_rg,
+                    n_cycle=rt2.n_cycle))
+            fs = np.asarray(out["flagstat"]).astype(np.int64)
+            fs_acc = fs if fs_acc is None else fs_acc + fs
+            fps.append(np.asarray(out["markdup"][0]))
+            scs.append(np.asarray(out["markdup"][1]))
+            bq = [np.asarray(o).astype(np.int64) for o in out["bqsr"]]
+            bq_acc = bq if bq_acc is None else \
+                [x + y for x, y in zip(bq_acc, bq)]
+        ex.finish()
+        return fused, (fs_acc, np.concatenate(fps), np.concatenate(scs),
+                       bq_acc)
+
+    # the compile listener backs the steady-state recompile pin below
+    try:
+        from adam_tpu.platform import install_compile_metrics
+
+        install_compile_metrics()
+    except Exception:  # noqa: BLE001 — the pin still reads as 0 vs 0
+        pass
+
+    # round 1 warms every compiled shape; round 2 is the raced number
+    walls_un, walls_fu = [], []
+    for rnd in range(2):
+        d0, t0 = disp(f"mega_unfused_r{rnd}"), time.perf_counter()
+        ref = fold_unfused(f"mega_unfused_r{rnd}")
+        walls_un.append(time.perf_counter() - t0)
+        un_disp = disp(f"mega_unfused_r{rnd}") - d0
+        d0, t0 = disp(f"mega_fused_r{rnd}"), time.perf_counter()
+        armed, got = fold_fused(f"mega_fused_r{rnd}")
+        walls_fu.append(time.perf_counter() - t0)
+        fu_disp = disp(f"mega_fused_r{rnd}") - d0
+    combined_ok = bool(
+        armed and np.array_equal(ref[0], got[0])
+        and np.array_equal(ref[1], got[1])
+        and np.array_equal(ref[2], got[2])
+        and all(np.array_equal(x, y) for x, y in zip(ref[3], got[3])))
+    payload["mega_combined_identical"] = combined_ok
+    payload["mega_plan_armed"] = bool(armed)
+    payload["mega_unfused_dispatches"] = int(un_disp)
+    payload["mega_fused_dispatches"] = int(fu_disp)
+    payload["mega_dispatch_reduction"] = round(
+        un_disp / max(fu_disp, 1), 3)
+    payload["mega_unfused_wall_s"] = round(walls_un[1], 4)
+    payload["mega_fused_wall_s"] = round(walls_fu[1], 4)
+    payload["mega_n_chunks"] = n_chunks
+    payload["mega_chunk_rows"] = rows
+    # steady-state recompiles: a further fused round (every shape warm)
+    # must compile nothing — the zero-recompile pin re-run fused
+    c0 = obs.registry().counter("compile_count").value
+    fold_fused("mega_fused_steady")
+    payload["mega_steady_recompiles"] = int(
+        obs.registry().counter("compile_count").value - c0)
+    payload["mega_identical"] = bool(
+        combined_ok
+        and payload.get("mega_padded_xla_matches_unfused") is True
+        and payload.get("mega_padded_pallas_matches_unfused") is True
+        and payload.get("mega_ragged_matches_unfused") is True
+        and payload.get("mega_paged_matches_ragged") is True)
+    payload["host_parallel_capacity"] = _parallel_capacity()
+    _emit("mega_race", payload)
+
+
 _STAGE_BODIES = {"flagstat": _stage_flagstat, "transform": _stage_transform,
                  "bqsr_race": _stage_bqsr_race, "pallas": _stage_pallas,
                  "bqsr_race8": _stage_bqsr_race8,
@@ -2302,7 +2595,11 @@ _STAGE_BODIES = {"flagstat": _stage_flagstat, "transform": _stage_transform,
                  # variant-calling plane (ISSUE 17): process-internal,
                  # not in the TPU capture order — run via --worker/
                  # --only call
-                 "call": _stage_call}
+                 "call": _stage_call,
+                 # fused mega-pass (ISSUE 18): process-internal, not in
+                 # the TPU capture order — run via --worker/--only
+                 # mega_race
+                 "mega_race": _stage_mega_race}
 
 
 def _worker_stages(stages: list[str]) -> None:

@@ -1,0 +1,825 @@
+"""The fused mega-pass device kernel (ISSUE 18, ops/megapass.py).
+
+Pins, per docs/ARCHITECTURE.md §6p:
+
+* every mega-pass leg is bit-identical to its unfused twin — the
+  flagstat counter block, the markdup key columns and the packed BQSR
+  covariate tables — across the padded, ragged and paged layouts, on
+  the XLA route AND the Mosaic-interpreter route, over an adversarial
+  corpus (invalid bases, negative quals, null refids/mapq/read groups,
+  zero-length reads, empty chunks);
+* the ``fused_device`` plan dimension is pure/replayable: explicit
+  ``-mega``/``ADAM_TPU_MEGA`` pin beats ledger evidence beats off,
+  multi-shard meshes demote to unfused, and pre-mega sidecars digest
+  identically (the only-when-engaged inputs contract);
+* streaming flagstat and the transform under the mega pin produce
+  identical results, record ``mega_plan_selected`` +
+  ``dispatch_count{pass=}`` receipts, recompile nothing on a warm
+  rerun, and their sidecars round-trip through tools/check_metrics.py
+  AND tools/check_executor.py;
+* injected faults on the fused route (transient retry, the
+  RESOURCE_EXHAUSTED split ladder, persistent loss degrading to the
+  CPU fallback) still land on the fault-free answer;
+* the satellites: the realign cross-bin batcher's paged route is
+  bit-identical to per-job serial sweeps, and the serve wire-chunk
+  cache replays identical chunks without re-decoding while never
+  serving a rewritten or partially-streamed input.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from adam_tpu import obs
+from adam_tpu.packing import ReadBatch, ragged_from_batch, shape_rung
+from adam_tpu.ops import megapass as M
+from adam_tpu.resilience import faults
+
+sys.path.insert(0, os.path.join(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))), "tools"))
+
+#: a fast retry policy for the chaos tests — same ladder, ms backoff
+FAST = dict(ADAM_TPU_RETRY_BACKOFF_S="0.001")
+
+
+def _validators():
+    import check_executor
+    import check_metrics
+    return check_metrics, check_executor
+
+
+def _rule(site, fault, occurrence=1, **kw):
+    return dict(site=site, fault=fault, occurrence=occurrence, **kw)
+
+
+def _counter(name, **labels):
+    return obs.registry().counter(name, **labels).value
+
+
+def _adversarial_batch(rng, N=257, L=96, C=4, n_rg=3):
+    """A full adversarial ReadBatch exercising every mega-pass leg:
+    mixed flag words (QC-fail, dup, secondary, unmapped, paired),
+    null/extreme mapq and refids, invalid bases, negative quals,
+    zero-length and unusable reads, ragged cigars."""
+    read_len = rng.choice([0, 1, 5, 30, 60, 95, L], N).astype(np.int32)
+    lane = np.arange(L)[None, :]
+    bases = np.where(lane < read_len[:, None],
+                     rng.randint(-1, 5, (N, L)), -1).astype(np.int8)
+    quals = np.where(lane < read_len[:, None],
+                     rng.randint(-1, 61, (N, L)), -1).astype(np.int8)
+    flags = rng.choice([0, 4, 16, 1 + 64, 1 + 128 + 16, 256, 512,
+                        1024, 2048, 1 + 2 + 32 + 64], N).astype(np.int32)
+    batch = ReadBatch(
+        flags=flags,
+        refid=rng.randint(-1, 3, N).astype(np.int32),
+        start=rng.randint(-1, 10000, N).astype(np.int32),
+        mapq=rng.choice([-1, 0, 1, 29, 30, 60, 255], N).astype(np.int32),
+        mate_refid=rng.randint(-1, 3, N).astype(np.int32),
+        mate_start=rng.randint(-1, 10000, N).astype(np.int32),
+        read_group=rng.randint(-1, n_rg, N).astype(np.int32),
+        valid=rng.rand(N) < 0.85,
+        row_index=np.arange(N, dtype=np.int32),
+        read_len=read_len, bases=bases, quals=quals,
+        cigar_ops=rng.randint(-1, 9, (N, C)).astype(np.int8),
+        cigar_lens=rng.randint(0, 21, (N, C)).astype(np.int32),
+        n_cigar=rng.randint(0, C + 1, N).astype(np.int32))
+    state = rng.randint(0, 3, (N, L)).astype(np.int8)
+    usable = rng.rand(N) < 0.9
+    return batch, state, usable
+
+
+def _unfused_padded(batch, state, usable, rt, impl):
+    """The three unfused twins the mega-pass must match bit-for-bit."""
+    from adam_tpu.bqsr.count_pallas import count_kernel_pallas
+    from adam_tpu.bqsr.recalibrate import _count_kernel
+    from adam_tpu.ops.flagstat import flagstat_kernel
+    from adam_tpu.ops.markdup import _device_fiveprime_and_score
+
+    a = jnp.asarray
+    fs = np.asarray(flagstat_kernel(a(batch.flags), a(batch.mapq),
+                                    a(batch.refid), a(batch.mate_refid),
+                                    a(batch.valid)))
+    fp, score = _device_fiveprime_and_score(
+        a(batch.flags), a(batch.start), a(batch.cigar_ops),
+        a(batch.cigar_lens), a(batch.n_cigar), a(batch.quals))
+    if impl == "pallas":
+        bq = count_kernel_pallas(
+            a(batch.bases), a(batch.quals), a(batch.read_len),
+            a(batch.flags), a(batch.read_group), a(state), a(usable),
+            n_qual_rg=rt.n_qual_rg, n_cycle=rt.n_cycle, interpret=True)
+    else:
+        bq = _count_kernel(
+            a(batch.bases), a(batch.quals), a(batch.read_len),
+            a(batch.flags), a(batch.read_group), a(state), a(usable),
+            n_qual_rg=rt.n_qual_rg, n_cycle=rt.n_cycle)
+    return fs, (np.asarray(fp), np.asarray(score)), \
+        [np.asarray(o) for o in bq]
+
+
+# ---------------------------------------------------------------------------
+# kernel identity: fused == unfused, every layout, every route
+# ---------------------------------------------------------------------------
+
+class TestMegapassIdentity:
+    @pytest.mark.parametrize("impl", ["xla", "pallas"])
+    def test_padded_all_legs_vs_unfused(self, impl):
+        """One fused program == the three unfused kernels bit for bit
+        on the adversarial corpus (XLA and Mosaic-interpreter)."""
+        from adam_tpu.bqsr.table import RecalTable
+
+        batch, state, usable = _adversarial_batch(np.random.RandomState(7))
+        rt = RecalTable(n_read_groups=3, max_read_len=batch.max_len)
+        fs, (fp, score), bq = _unfused_padded(batch, state, usable, rt,
+                                              impl)
+        out = M.megapass_from_batch(batch, state=state, usable=usable,
+                                    n_qual_rg=rt.n_qual_rg,
+                                    n_cycle=rt.n_cycle, impl=impl,
+                                    interpret=True)
+        assert np.array_equal(np.asarray(out["flagstat"]), fs)
+        assert np.array_equal(np.asarray(out["markdup"][0]), fp)
+        assert np.array_equal(np.asarray(out["markdup"][1]), score)
+        for i, (a, b) in enumerate(zip(out["bqsr"], bq)):
+            assert np.array_equal(np.asarray(a), b), f"bqsr tensor {i}"
+
+    @pytest.mark.parametrize("impl", ["xla", "pallas"])
+    def test_ragged_all_legs_vs_padded(self, impl):
+        """The ragged twin (flat planes + prefix-sum row walk) lands on
+        the padded answer for every leg."""
+        from adam_tpu.bqsr.count_pallas import flatten_state
+        from adam_tpu.bqsr.table import RecalTable
+
+        batch, state, usable = _adversarial_batch(np.random.RandomState(8))
+        N = batch.n_reads
+        rt = RecalTable(n_read_groups=3, max_read_len=batch.max_len)
+        fs, (fp, score), bq = _unfused_padded(batch, state, usable, rt,
+                                              impl)
+        rb = ragged_from_batch(batch, pad_bases_to=shape_rung(
+            max(int(batch.read_len.sum()), 1), 2048))
+        sf = flatten_state(state, rb.read_len, len(rb.bases_flat))
+        out = M.megapass_from_ragged(rb, state_flat=sf, usable=usable,
+                                     n_qual_rg=rt.n_qual_rg,
+                                     n_cycle=rt.n_cycle,
+                                     max_read_len=batch.max_len,
+                                     impl=impl, interpret=True)
+        assert np.array_equal(np.asarray(out["flagstat"]), fs)
+        assert np.array_equal(np.asarray(out["markdup"][0])[:N], fp)
+        assert np.array_equal(np.asarray(out["markdup"][1])[:N], score)
+        for i, (a, b) in enumerate(zip(out["bqsr"], bq)):
+            assert np.array_equal(np.asarray(a), b), f"bqsr tensor {i}"
+
+    def test_paged_all_legs_vs_ragged(self):
+        """The paged twin (resident pools + page-table gather) equals
+        the ragged answer over a scrambled physical placement."""
+        from adam_tpu.bqsr.count_pallas import (BLOCK_ELEMS,
+                                                PAGED_COUNT_PLANES,
+                                                flatten_state)
+        from adam_tpu.bqsr.table import RecalTable
+        from adam_tpu.parallel.pagedbuf import PagePool
+
+        batch, state, usable = _adversarial_batch(np.random.RandomState(9))
+        rt = RecalTable(n_read_groups=3, max_read_len=batch.max_len)
+        t_rung = shape_rung(max(int(batch.read_len.sum()), 1),
+                            BLOCK_ELEMS)
+        rb = ragged_from_batch(batch, pad_bases_to=t_rung)
+        sf = flatten_state(state, rb.read_len, len(rb.bases_flat))
+        ref = M.megapass_from_ragged(rb, state_flat=sf, usable=usable,
+                                     n_qual_rg=rt.n_qual_rg,
+                                     n_cycle=rt.n_cycle,
+                                     max_read_len=batch.max_len)
+        table_len = t_rung // BLOCK_ELEMS
+        pool = PagePool("mega", table_len + 3, BLOCK_ELEMS,
+                        planes=PAGED_COUNT_PLANES)
+        # scramble: burn the lowest page ids first so the chunk's pages
+        # land off-origin — the logical gather must not care
+        burn = pool.alloc(2)
+        need = -(-int(rb.n_bases) // BLOCK_ELEMS)
+        ids = pool.alloc(need)
+        pool.free(burn)
+        live = need * BLOCK_ELEMS
+        pool.write(ids, bases=rb.bases_flat[:live],
+                   quals=rb.quals_flat[:live], state=sf[:live],
+                   row_of=rb.row_of[:live], pos_of=rb.pos_of[:live])
+        a = jnp.asarray
+        out = M.megapass_paged(
+            {n: pool.device(n) for n, _ in PAGED_COUNT_PLANES},
+            pool.table(ids, table_len), a(rb.flags), a(rb.mapq),
+            a(rb.refid), a(rb.mate_refid), a(rb.valid), a(rb.start),
+            a(rb.cigar_ops), a(rb.cigar_lens), a(rb.n_cigar),
+            a(rb.row_offsets[:-1]), a(rb.read_len), a(rb.read_group),
+            a(usable), jnp.int32(rb.n_bases), want=M.WANT_ALL,
+            n_rows=rb.n_reads, n_qual_rg=rt.n_qual_rg,
+            n_cycle=rt.n_cycle, max_read_len=batch.max_len)
+        assert np.array_equal(np.asarray(out["flagstat"]),
+                              np.asarray(ref["flagstat"]))
+        for j in range(2):
+            assert np.array_equal(np.asarray(out["markdup"][j]),
+                                  np.asarray(ref["markdup"][j]))
+        for i, (x, y) in enumerate(zip(out["bqsr"], ref["bqsr"])):
+            assert np.array_equal(np.asarray(x), np.asarray(y)), \
+                f"bqsr tensor {i}"
+
+    def test_empty_chunk(self):
+        """A zero-row chunk folds to the identity of every monoid."""
+        from adam_tpu.bqsr.count_pallas import count_kernel_pallas
+
+        z = lambda *s, dt=np.int32: np.zeros(s, dt)  # noqa: E731
+        N, L, C = 0, 8, 2
+        out = M.megapass_padded(
+            z(N), z(N), z(N), z(N), z(N, dt=bool), z(N),
+            z(N, C, dt=np.int8), z(N, C), z(N), z(N, L, dt=np.int8),
+            z(N, L, dt=np.int8), z(N), z(N), z(N, L, dt=np.int8),
+            z(N, dt=bool), n_qual_rg=8, n_cycle=16)
+        assert np.asarray(out["flagstat"]).shape == (18, 2)
+        assert not np.asarray(out["flagstat"]).any()
+        assert np.asarray(out["markdup"][0]).shape == (0,)
+        ref = count_kernel_pallas(
+            jnp.asarray(z(N, L, dt=np.int8)),
+            jnp.asarray(z(N, L, dt=np.int8)), jnp.asarray(z(N)),
+            jnp.asarray(z(N)), jnp.asarray(z(N)),
+            jnp.asarray(z(N, L, dt=np.int8)), jnp.asarray(z(N, dt=bool)),
+            n_qual_rg=8, n_cycle=16, interpret=True)
+        for a, b in zip(out["bqsr"], ref):
+            assert np.array_equal(np.asarray(a), np.asarray(b))
+
+    def test_want_subsets_and_single_leg_conveniences(self):
+        """A one-leg program returns only that leg, the product's
+        single-leg entries equal the full fused outputs, and an unknown
+        leg is a loud error."""
+        from adam_tpu.bqsr.table import RecalTable
+
+        batch, state, usable = _adversarial_batch(
+            np.random.RandomState(10), N=63)
+        rt = RecalTable(n_read_groups=3, max_read_len=batch.max_len)
+        full = M.megapass_from_batch(batch, state=state, usable=usable,
+                                     n_qual_rg=rt.n_qual_rg,
+                                     n_cycle=rt.n_cycle)
+        only = M.megapass_from_batch(batch, want=("flagstat",))
+        assert set(only) == {"flagstat"}
+        assert np.array_equal(np.asarray(only["flagstat"]),
+                              np.asarray(full["flagstat"]))
+        a = jnp.asarray
+        fp, score = M.megapass_markdup(
+            a(batch.flags), a(batch.start), a(batch.cigar_ops),
+            a(batch.cigar_lens), a(batch.n_cigar), a(batch.quals))
+        assert np.array_equal(np.asarray(fp),
+                              np.asarray(full["markdup"][0]))
+        assert np.array_equal(np.asarray(score),
+                              np.asarray(full["markdup"][1]))
+        bq = M.megapass_bqsr(
+            a(batch.bases), a(batch.quals), a(batch.read_len),
+            a(batch.flags), a(batch.read_group), a(state), a(usable),
+            n_qual_rg=rt.n_qual_rg, n_cycle=rt.n_cycle)
+        for x, y in zip(bq, full["bqsr"]):
+            assert np.array_equal(np.asarray(x), np.asarray(y))
+        with pytest.raises(ValueError):
+            M.megapass_from_batch(batch, want=("flagstat", "coverage"))
+
+    def test_wire32_entries_vs_flagstat_kernel(self):
+        """The streaming-route wire32 entries (padded / bounded /
+        paged) equal flagstat_kernel_wire32, garbage slack and
+        scrambled pages included."""
+        from adam_tpu.ops.flagstat import (flagstat_kernel_wire32,
+                                           pack_flagstat_wire32)
+        from adam_tpu.parallel.pagedbuf import PagePool
+
+        rng = np.random.RandomState(11)
+        batch, _, _ = _adversarial_batch(rng, N=300)
+        mapq = np.maximum(batch.mapq, 0)    # the packer's 8-bit contract
+        wire = pack_flagstat_wire32(batch.flags, mapq, batch.refid,
+                                    batch.mate_refid, batch.valid)
+        ref = np.asarray(flagstat_kernel_wire32(jnp.asarray(wire)))
+        assert np.array_equal(
+            np.asarray(M.megapass_wire32(jnp.asarray(wire))), ref)
+        # bounded twin: garbage slack past the bound must not count
+        slack = rng.randint(0, 1 << 26, 212).astype(wire.dtype)
+        buf = np.concatenate([wire, slack])
+        assert np.array_equal(np.asarray(M.megapass_wire32_bounded(
+            jnp.asarray(buf), jnp.int32(len(wire)))), ref)
+        # paged twin: same bound off a scrambled resident placement
+        page_rows = 128
+        need = -(-len(buf) // page_rows)
+        pool = PagePool("megaw", need + 2, page_rows)
+        burn = pool.alloc(1)
+        ids = pool.alloc(need)
+        pool.free(burn)
+        padded = np.zeros(need * page_rows, buf.dtype)
+        padded[:len(buf)] = buf
+        pool.write(ids, wire=padded)
+        got = M.megapass_wire32_paged(pool.device("wire"),
+                                      pool.table(ids, need),
+                                      jnp.int32(len(wire)))
+        assert np.array_equal(np.asarray(got), ref)
+
+
+# ---------------------------------------------------------------------------
+# the pure plan dimension
+# ---------------------------------------------------------------------------
+
+def _plan(**kw):
+    from adam_tpu.parallel.executor import decide_plan
+    base = dict(pass_name="flagstat", chunk_rows=1 << 16, mesh_size=1,
+                on_tpu=False)
+    base.update(kw)
+    return decide_plan(**base)
+
+
+class TestMegaPlan:
+    def test_pin_beats_evidence_beats_off(self):
+        p = _plan(mega=True, mega_capable=True)
+        assert p["fused_device"] is True and "mega-pinned" in p["reason"]
+        off = _plan(mega=False, mega_capable=True,
+                    mega_rates={"dispatch_reduction": 9.0,
+                                "unfused_wall_s": 1.0,
+                                "fused_wall_s": 0.3})
+        assert off["fused_device"] is False
+        assert "mega-pinned-off" in off["reason"]
+        unsup = _plan(mega=True, mega_capable=False)
+        assert unsup["fused_device"] is False
+        assert "mega-pin-unsupported:unfused" in unsup["reason"]
+
+    def test_evidence_arms_only_when_fast_and_reducing(self):
+        good = {"dispatch_reduction": 3.0, "unfused_wall_s": 1.0,
+                "fused_wall_s": 0.9}
+        p = _plan(mega_capable=True, mega_rates=good)
+        assert p["fused_device"] is True and "mega-evidence" in p["reason"]
+        weak = dict(good, dispatch_reduction=1.5)
+        assert _plan(mega_capable=True,
+                     mega_rates=weak)["fused_device"] is False
+        slow = dict(good, fused_wall_s=1.2)
+        assert _plan(mega_capable=True,
+                     mega_rates=slow)["fused_device"] is False
+        frozen = _plan(mega_capable=True, mega_rates=good,
+                       autotune=False)
+        assert frozen["fused_device"] is False
+
+    def test_pre_mega_digest_stability(self):
+        """The mega keys join the recorded inputs ONLY when the
+        dimension is engaged — a pre-mega sidecar digests identically
+        under the current decider."""
+        pre = _plan()
+        engaged_off = _plan(mega_capable=False, mega=None,
+                            mega_rates=None)
+        assert "mega" not in pre["inputs"]
+        assert "fused_device" not in pre
+        assert engaged_off["input_digest"] == pre["input_digest"]
+        on = _plan(mega_capable=True)
+        assert on["inputs"]["mega_capable"] is True
+        assert on["fused_device"] is False      # no pin, no evidence
+        assert on["input_digest"] != pre["input_digest"]
+
+    def test_replay_determinism(self):
+        p = _plan(mega=True, mega_capable=True)
+        from adam_tpu.parallel.executor import decide_plan
+        q = decide_plan(**p["inputs"])
+        assert q["fused_device"] == p["fused_device"]
+        assert q["input_digest"] == p["input_digest"]
+
+    def test_resolve_mega_env(self):
+        from adam_tpu.parallel.executor import resolve_mega_env
+        assert resolve_mega_env(None) is None
+        assert resolve_mega_env("") is None
+        for off in ("0", "off", "no"):
+            assert resolve_mega_env(off) is False
+        for on in ("1", "on", "yes", "true"):
+            assert resolve_mega_env(on) is True
+
+    def test_multi_shard_mesh_demotes(self):
+        """begin_pass on a multi-shard mesh never arms the fused route
+        — the mega program has no cross-shard psum wiring."""
+        from adam_tpu.parallel.executor import StreamExecutor
+        ex = StreamExecutor(2, 1 << 12, mega=True)
+        pex = ex.begin_pass("flagstat", mega_capable=True)
+        assert pex.fused_device is False
+        assert "mega-pin-unsupported:unfused" in pex.plan["reason"]
+        ex.finish()
+
+    def test_ledger_mega_rates_roundtrip(self, tmp_path, monkeypatch):
+        """ledger_mega_rates reads the mega_race record back
+        platform-matched and refuses a dirty identity bit."""
+        from adam_tpu.evidence.ledger import Ledger
+        from adam_tpu.parallel.executor import ledger_mega_rates
+
+        path = str(tmp_path / "EVIDENCE_LEDGER.json")
+        monkeypatch.setenv("ADAM_TPU_EVIDENCE_LEDGER", path)
+        led = Ledger(path)
+        led.record_stage("mega_race",
+                         {"mega_dispatch_reduction": 3.0,
+                          "mega_unfused_wall_s": 0.9,
+                          "mega_fused_wall_s": 0.8,
+                          "mega_identical": True},
+                         platform="cpu", window_id="w1")
+        led.save()
+        assert ledger_mega_rates(platform="cpu") == \
+            {"dispatch_reduction": 3.0, "unfused_wall_s": 0.9,
+             "fused_wall_s": 0.8}
+        assert ledger_mega_rates(platform="tpu") is None
+        path2 = str(tmp_path / "LEDGER2.json")
+        monkeypatch.setenv("ADAM_TPU_EVIDENCE_LEDGER", path2)
+        led2 = Ledger(path2)
+        led2.record_stage("mega_race",
+                          {"mega_dispatch_reduction": 3.0,
+                           "mega_unfused_wall_s": 0.9,
+                           "mega_fused_wall_s": 0.8,
+                           "mega_identical": False},
+                          platform="cpu", window_id="w1")
+        led2.save()
+        assert ledger_mega_rates(platform="cpu") is None
+
+
+# ---------------------------------------------------------------------------
+# streaming integration: identity, receipts, zero recompiles, validators
+# ---------------------------------------------------------------------------
+
+def _src(tmp_path, n=2000, L=60, seed=3):
+    from adam_tpu.io.parquet import save_table
+    from tests._synth_reads import random_reads_table
+    t = random_reads_table(
+        n, L, seed=seed, n_rg=2,
+        flags=np.random.RandomState(seed).choice(
+            [0, 4, 16, 512, 1024, 1 + 64], n))
+    src = str(tmp_path / "reads.parquet")
+    save_table(t, src)
+    return src
+
+
+class TestMegaStreaming:
+    def test_flagstat_identity_receipts_zero_recompile(self, tmp_path):
+        """streaming_flagstat under -mega: identical metrics, the
+        fused receipts in the sidecar (mega_plan_selected,
+        dispatch_count at one dispatch per chunk, fused_device in the
+        plan event), zero recompiles on a warm rerun, both validators
+        green."""
+        from adam_tpu.parallel.mesh import make_mesh
+        from adam_tpu.parallel.pipeline import streaming_flagstat
+        from adam_tpu.platform import install_compile_metrics
+
+        src = _src(tmp_path)
+        ref = streaming_flagstat(src, chunk_rows=512)
+
+        install_compile_metrics()
+        opts = {"mega": True}
+        mpath = str(tmp_path / "mega.jsonl")
+        with obs.metrics_run(mpath, argv=["test"]):
+            got = streaming_flagstat(src, chunk_rows=512,
+                                     mesh=make_mesh(1),
+                                     executor_opts=opts)
+        assert got == ref
+        events = [json.loads(ln) for ln in open(mpath)]
+        plans = [e for e in events
+                 if e.get("event") == "executor_bucket_selected"]
+        assert plans and plans[0]["fused_device"] is True
+        assert "mega-pinned" in plans[0]["reason"]
+        megas = [e for e in events
+                 if e.get("event") == "mega_plan_selected"]
+        assert megas and megas[0]["fused_device"] is True
+        assert megas[0]["pass"] == "flagstat"
+        dcs = [e for e in events if e.get("event") == "dispatch_count"]
+        assert dcs and dcs[0]["fused_device"] is True
+        assert dcs[0]["dispatches"] == dcs[0]["chunks"] >= 2
+
+        compiles = obs.registry().snapshot()["counters"].get(
+            "compile_count", 0)
+        got2 = streaming_flagstat(src, chunk_rows=512,
+                                  mesh=make_mesh(1), executor_opts=opts)
+        assert got2 == ref
+        assert obs.registry().snapshot()["counters"].get(
+            "compile_count", 0) == compiles
+
+        check_metrics, check_executor = _validators()
+        assert check_metrics.validate(mpath) == []
+        assert check_executor.check([mpath]) == []
+
+    @pytest.mark.parametrize("layout_opts", [{"ragged": True},
+                                             {"paged": True}])
+    def test_flagstat_mega_over_layouts(self, tmp_path, layout_opts):
+        """The mega pin composes with the ragged and paged layouts:
+        identical metrics either way (the fused program's bounded and
+        paged twins)."""
+        from adam_tpu.parallel.mesh import make_mesh
+        from adam_tpu.parallel.pipeline import streaming_flagstat
+
+        src = _src(tmp_path, n=1500, seed=4)
+        ref = streaming_flagstat(src, chunk_rows=400)
+        got = streaming_flagstat(
+            src, chunk_rows=400, mesh=make_mesh(1),
+            executor_opts=dict(layout_opts, mega=True))
+        assert got == ref
+
+    def test_transform_mega_identity_and_receipts(self, tmp_path):
+        """The full transform (markdup + BQSR) under -mega lands on the
+        unfused output byte for byte; s1 and s2 arm the fused route
+        (mega-pinned), s3 stays honest (unsupported:unfused); the
+        sidecar validates."""
+        from adam_tpu.io.parquet import load_table
+        from adam_tpu.parallel.mesh import make_mesh
+        from adam_tpu.parallel.pipeline import streaming_transform
+
+        src = _src(tmp_path, n=800, L=48, seed=5)
+        out0 = str(tmp_path / "out0")
+        n0 = streaming_transform(src, out0, markdup=True, bqsr=True,
+                                 chunk_rows=256, mesh=make_mesh(1),
+                                 workdir=str(tmp_path / "wd0"))
+        ref = load_table(out0)
+
+        out1 = str(tmp_path / "out1")
+        mpath = str(tmp_path / "mega_tf.jsonl")
+        with obs.metrics_run(mpath, argv=["test"]):
+            n1 = streaming_transform(src, out1, markdup=True, bqsr=True,
+                                     chunk_rows=256, mesh=make_mesh(1),
+                                     workdir=str(tmp_path / "wd1"),
+                                     executor_opts={"mega": True})
+        assert n1 == n0
+        assert load_table(out1).equals(ref)
+        events = [json.loads(ln) for ln in open(mpath)]
+        megas = {e["pass"]: (e["fused_device"], e["reason"])
+                 for e in events if e.get("event") == "mega_plan_selected"}
+        assert megas["s1"][0] is True and "mega-pinned" in megas["s1"][1]
+        assert megas["s2"][0] is True and "mega-pinned" in megas["s2"][1]
+        assert megas["s3"][0] is False
+        dcs = {e["pass"]: e for e in events
+               if e.get("event") == "dispatch_count"}
+        assert dcs["s2"]["fused_device"] is True
+        assert dcs["s2"]["dispatches"] >= 1
+        check_metrics, check_executor = _validators()
+        assert check_metrics.validate(mpath) == []
+        assert check_executor.check([mpath]) == []
+
+    def test_mega_env_pin_round_trip(self, tmp_path, monkeypatch):
+        """ADAM_TPU_MEGA=1 arms the route without executor_opts — and
+        =0 holds it off even over strong ledger evidence."""
+        from adam_tpu.parallel.mesh import make_mesh
+        from adam_tpu.parallel.pipeline import streaming_flagstat
+
+        src = _src(tmp_path, n=600, seed=6)
+        ref = streaming_flagstat(src, chunk_rows=256)
+        monkeypatch.setenv("ADAM_TPU_MEGA", "1")
+        mpath = str(tmp_path / "env.jsonl")
+        with obs.metrics_run(mpath, argv=["test"]):
+            got = streaming_flagstat(src, chunk_rows=256,
+                                     mesh=make_mesh(1))
+        assert got == ref
+        events = [json.loads(ln) for ln in open(mpath)]
+        megas = [e for e in events
+                 if e.get("event") == "mega_plan_selected"]
+        assert megas and megas[0]["fused_device"] is True
+        monkeypatch.setenv("ADAM_TPU_MEGA", "0")
+        mpath2 = str(tmp_path / "env0.jsonl")
+        with obs.metrics_run(mpath2, argv=["test"]):
+            got0 = streaming_flagstat(src, chunk_rows=256,
+                                      mesh=make_mesh(1))
+        assert got0 == ref
+        events0 = [json.loads(ln) for ln in open(mpath2)]
+        megas0 = [e for e in events0
+                  if e.get("event") == "mega_plan_selected"]
+        assert megas0 and megas0[0]["fused_device"] is False
+        assert "mega-pinned-off" in megas0[0]["reason"]
+
+
+# ---------------------------------------------------------------------------
+# chaos: the fused route under injected faults
+# ---------------------------------------------------------------------------
+
+class TestMegaChaos:
+    @pytest.fixture(scope="class")
+    def corpus(self, tmp_path_factory):
+        faults.clear_plan()
+        tmp = tmp_path_factory.mktemp("mega_chaos")
+        src = _src(tmp, n=900, seed=12)
+        from adam_tpu.parallel.pipeline import streaming_flagstat
+        return src, streaming_flagstat(src, chunk_rows=256)
+
+    def _run(self, src, rules, monkeypatch):
+        from adam_tpu.parallel.mesh import make_mesh
+        from adam_tpu.parallel.pipeline import streaming_flagstat
+        for k, v in FAST.items():
+            monkeypatch.setenv(k, v)
+        faults.install_plan({"rules": rules})
+        try:
+            return streaming_flagstat(src, chunk_rows=256,
+                                      mesh=make_mesh(1),
+                                      executor_opts={"mega": True})
+        finally:
+            faults.clear_plan()
+
+    def test_transient_dispatch_retries_to_identity(self, corpus,
+                                                    monkeypatch):
+        src, ref = corpus
+        got = self._run(src, [_rule("device_dispatch", "error",
+                                    occurrence=2, error="DATA_LOSS")],
+                        monkeypatch)
+        assert got == ref
+        assert _counter("retry_attempts", site="device_dispatch") >= 1
+
+    def test_oom_splits_to_identity(self, corpus, monkeypatch):
+        src, ref = corpus
+        got = self._run(src, [_rule("device_dispatch", "error",
+                                    occurrence=1,
+                                    error="RESOURCE_EXHAUSTED")],
+                        monkeypatch)
+        assert got == ref
+
+    def test_persistent_loss_degrades_to_cpu_identity(self, corpus,
+                                                      monkeypatch):
+        src, ref = corpus
+        before = _counter("degraded_dispatches", site="device_dispatch")
+        got = self._run(src, [_rule("device_dispatch", "error",
+                                    occurrence="1+", error="DATA_LOSS")],
+                        monkeypatch)
+        assert got == ref
+        assert _counter("degraded_dispatches",
+                        site="device_dispatch") > before
+
+
+# ---------------------------------------------------------------------------
+# satellite: the realign cross-bin batcher's paged route
+# ---------------------------------------------------------------------------
+
+class TestRealignPagedBatcher:
+    def test_paged_batcher_matches_serial(self, tmp_path, monkeypatch):
+        """layout=paged cross-bin batching == per-job serial sweeps
+        (true rows compared, the ragged-result convention), with
+        layout=paged receipts in the sidecar."""
+        from adam_tpu.parallel.realign_exec import CrossBinSweepBatcher
+        from adam_tpu.realign import realigner as R
+        from adam_tpu.realign.realigner import sweep_dispatch
+        from tests.test_realign_exec import _states_for
+        from tests._synth_realign import synth_sam
+
+        monkeypatch.setattr(R, "_BATCH_ON_CPU", True)
+        works = []
+        for seed in (0, 1, 2):
+            _, work = _states_for(synth_sam(2, 8, seed=seed))
+            works.append(work)
+
+        mpath = tmp_path / "paged_sweep.jsonl"
+        with obs.metrics_run(str(mpath), argv=["test"]):
+            b = CrossBinSweepBatcher(layout="paged")
+            for uid, work in enumerate(works):
+                b.add_unit((uid,), work.states)
+            got = {uid: b.sweep_unit((uid,))
+                   for uid in range(len(works))}
+        for uid, work in enumerate(works):
+            for si, st in enumerate(work.states):
+                n = len(st.reads_to_clean)
+                for ji, job in enumerate(st.jobs):
+                    q, o = sweep_dispatch([(st, job)])
+                    gq, go = got[uid][si][ji]
+                    assert np.array_equal(np.asarray(gq)[:n],
+                                          np.asarray(q)[0][:n]), \
+                        f"unit {uid} state {si} job {ji}"
+                    assert np.array_equal(np.asarray(go)[:n],
+                                          np.asarray(o)[0][:n])
+        events = [json.loads(ln) for ln in open(mpath) if ln.strip()]
+        recs = [e for e in events
+                if e.get("event") == "realign_sweep_dispatch"]
+        assert recs and all(r["layout"] == "paged" for r in recs)
+        assert max(r["units"] for r in recs) >= 2   # cross-bin sharing
+
+    def test_decide_realign_plan_paged_dimension(self):
+        """Pin beats evidence beats off; weak paged evidence falls
+        through to the ragged decision; replay is deterministic."""
+        from adam_tpu.parallel.realign_exec import decide_realign_plan
+
+        base = dict(n_bins=64, on_tpu=False)
+        pin = decide_realign_plan(**base, layout="paged")
+        assert pin["layout"] == "paged"
+        assert "layout-pinned-paged" in pin["reason"]
+        ev = decide_realign_plan(**base, paged_rates={
+            "h2d_reduction": 3.0, "unpaged_wall_s": 1.0,
+            "paged_wall_s": 0.9})
+        assert ev["layout"] == "paged"
+        assert "paged-evidence" in ev["reason"]
+        weak = decide_realign_plan(**base, paged_rates={
+            "h2d_reduction": 1.2, "unpaged_wall_s": 1.0,
+            "paged_wall_s": 0.9})
+        assert weak["layout"] != "paged"
+        # pre-paged inputs digest identically (only-when-engaged)
+        pre = decide_realign_plan(**base)
+        off = decide_realign_plan(**base, paged_rates=None)
+        assert "paged_rates" not in pre["inputs"]
+        assert off["input_digest"] == pre["input_digest"]
+        replay = decide_realign_plan(**pin["inputs"])
+        assert replay["layout"] == "paged"
+        assert replay["input_digest"] == pin["input_digest"]
+
+    def test_resolve_realign_opts_paged_env(self, tmp_path, monkeypatch):
+        from adam_tpu.parallel.realign_exec import resolve_realign_opts
+        monkeypatch.setenv("ADAM_TPU_EVIDENCE_LEDGER",
+                           str(tmp_path / "none.json"))
+        monkeypatch.setenv("ADAM_TPU_PAGED", "1")
+        out = resolve_realign_opts({})
+        assert out.get("layout") == "paged"
+        monkeypatch.setenv("ADAM_TPU_PAGED", "0")
+        monkeypatch.delenv("ADAM_TPU_RAGGED", raising=False)
+        out0 = resolve_realign_opts({})
+        assert out0.get("layout") != "paged"
+
+
+# ---------------------------------------------------------------------------
+# satellite: the serve wire-chunk cache
+# ---------------------------------------------------------------------------
+
+class TestWireChunkCache:
+    def _chunks(self, n=3, rows=64, seed=0):
+        rng = np.random.RandomState(seed)
+        return [rng.randint(0, 1 << 26, rows).astype(np.uint32)
+                for _ in range(n)]
+
+    def test_hit_replays_identical_chunks(self, tmp_path):
+        from adam_tpu.serve.wirecache import WireChunkCache
+        p = str(tmp_path / "in.bin")
+        with open(p, "wb") as f:
+            f.write(b"x" * 100)
+        cache = WireChunkCache(max_bytes=1 << 20)
+        src = self._chunks()
+        calls = []
+        def produce():
+            calls.append(1)
+            yield from src
+        h0 = _counter("wire_cache_hits")
+        m0 = _counter("wire_cache_misses")
+        first = list(cache.chunks(p, 64, produce))
+        second = list(cache.chunks(p, 64, produce))
+        assert len(calls) == 1          # second run never re-decoded
+        assert _counter("wire_cache_misses") == m0 + 1
+        assert _counter("wire_cache_hits") == h0 + 1
+        for a, b in zip(first, second):
+            assert np.array_equal(a, b)
+        assert cache.stored_bytes == sum(c.nbytes for c in src)
+
+    def test_rewrite_invalidates(self, tmp_path):
+        from adam_tpu.serve.wirecache import WireChunkCache
+        p = str(tmp_path / "in.bin")
+        with open(p, "wb") as f:
+            f.write(b"x" * 100)
+        cache = WireChunkCache(max_bytes=1 << 20)
+        list(cache.chunks(p, 64, lambda: iter(self._chunks(seed=1))))
+        with open(p, "wb") as f:        # rewrite: new size + mtime
+            f.write(b"y" * 120)
+        fresh = self._chunks(seed=2)
+        got = list(cache.chunks(p, 64, lambda: iter(fresh)))
+        for a, b in zip(got, fresh):
+            assert np.array_equal(a, b)
+
+    def test_partial_stream_never_commits(self, tmp_path):
+        from adam_tpu.serve.wirecache import WireChunkCache
+        p = str(tmp_path / "in.bin")
+        with open(p, "wb") as f:
+            f.write(b"x" * 100)
+        cache = WireChunkCache(max_bytes=1 << 20)
+        gen = cache.chunks(p, 64, lambda: iter(self._chunks()))
+        next(gen)
+        gen.close()                     # consumer stopped early
+        assert cache.stored_bytes == 0
+        # the next consumer misses and decodes for real
+        calls = []
+        def produce():
+            calls.append(1)
+            yield from self._chunks()
+        list(cache.chunks(p, 64, produce))
+        assert calls
+
+    def test_budget_and_geometry_partition(self, tmp_path):
+        from adam_tpu.serve.wirecache import WireChunkCache
+        p = str(tmp_path / "in.bin")
+        with open(p, "wb") as f:
+            f.write(b"x" * 100)
+        # zero budget: pure passthrough, nothing stored
+        off = WireChunkCache(max_bytes=0)
+        list(off.chunks(p, 64, lambda: iter(self._chunks())))
+        assert off.stored_bytes == 0
+        # an input bigger than the whole budget is never cached
+        tiny = WireChunkCache(max_bytes=16)
+        list(tiny.chunks(p, 64, lambda: iter(self._chunks())))
+        assert tiny.stored_bytes == 0
+        # different chunk geometry is a different entry
+        cache = WireChunkCache(max_bytes=1 << 20)
+        list(cache.chunks(p, 64, lambda: iter(self._chunks(seed=3))))
+        calls = []
+        def produce():
+            calls.append(1)
+            yield from self._chunks(seed=4)
+        list(cache.chunks(p, 32, produce))
+        assert calls                    # chunk_rows=32 was a miss
+
+    def test_serve_round_shares_one_decode(self, tmp_path):
+        """The product seam: two streaming_flagstat runs over the same
+        input through one cache — the second is a cache hit and the
+        metrics are identical."""
+        from adam_tpu.parallel.pipeline import streaming_flagstat
+        from adam_tpu.serve.wirecache import WireChunkCache
+
+        src = _src(tmp_path, n=500, seed=13)
+        cache = WireChunkCache(max_bytes=1 << 24)
+        h0 = _counter("wire_cache_hits")
+        ref = streaming_flagstat(src, chunk_rows=128, wire_cache=cache)
+        got = streaming_flagstat(src, chunk_rows=128, wire_cache=cache)
+        assert got == ref
+        assert _counter("wire_cache_hits") == h0 + 1

@@ -92,6 +92,20 @@ differ) with ``--threshold`` where applicable:
    (``--call NEW_C.json``, from ``python bench.py --worker call``)
    additionally diffs the call walls at 10%.
 
+10. **The fused mega-pass is pinned.**  ``BENCH_MEGA.json`` (the
+    committed ``mega_race`` artifact, ISSUE 18) must show the fused
+    multi-output kernel issuing >= 2x fewer per-chunk device
+    dispatches than the three unfused kernels on the combined leg
+    (the ``dispatch_count{pass=}`` accounting), every fused leg —
+    flagstat block, markdup keys, BQSR covariates, across padded/
+    ragged/paged and the XLA + Mosaic-interpreter routes —
+    bit-identical to its unfused twin, and zero recompiles on a warm
+    fused round — all unconditional.  Capacity-armed (the gate-4/6/8/9
+    discipline): the fused wall must stay within slack of the unfused
+    wall.  A fresh artifact (``--mega NEW_M.json``, from
+    ``python bench.py --worker mega_race``) additionally diffs the
+    combined-leg walls at 10%.
+
 Usage::
 
     python tools/bench_gate.py                       # committed gates
@@ -103,6 +117,7 @@ Usage::
     python tools/bench_gate.py --paged NEW_P.json    # + paged diff
     python tools/bench_gate.py --overload NEW_O.json # + overload diff
     python tools/bench_gate.py --call NEW_C.json     # + call diff
+    python tools/bench_gate.py --mega NEW_M.json     # + mega diff
 
 Exit 0 when every gate holds; the first failing check's exit code
 otherwise.
@@ -267,6 +282,39 @@ CALL_READS_PER_SEC_MIN_ANY = 100
 CALL_WALL_KEYS = ("call_solo_wall_s", "call_warm_wall_s",
                   "call_served_wall_s")
 
+MEGA = os.path.join(ROOT, "BENCH_MEGA.json")
+
+#: the ISSUE 18 acceptance numbers.  Unconditional: every fused leg
+#: bit-identical to its unfused twin (``mega_identical`` + the twin
+#: keys below), zero recompiles on a warm fused round, and the
+#: per-chunk device-dispatch collapse — the combined leg's
+#: ``dispatch_count{pass=}`` ratio must show the fused route issuing
+#: at least this factor fewer dispatches than the three unfused
+#: kernels over the same chunks.  The reduction is deterministic
+#: accounting (the dispatch_count counter), not a wall measurement,
+#: so it never disarms for box load.
+MEGA_REQUIRED_DISPATCH_REDUCTION = 2.0
+#: capacity-armed (the gate-4/6/8/9 discipline): the fused wall must
+#: not fall behind the unfused wall beyond this slack — but only when
+#: the artifact's own ``host_parallel_capacity`` probe saw real
+#: parallelism; on the committed sub-1-core container the walls are
+#: neighbor-noise and are reported, not gated
+MEGA_WALL_SLACK = 1.05
+MEGA_CAPACITY_FLOOR = 1.2
+
+#: the mega walls a fresh artifact is regression-diffed on
+MEGA_WALL_KEYS = ("mega_unfused_wall_s", "mega_fused_wall_s")
+
+#: every kernel twin gate 10 requires — REQUIRED, not scanned: a twin
+#: that crashed outright records ``mega_*_error`` and omits its key,
+#: which must fail the gate, never pass it silently (the
+#: PAGED_TWIN_KEYS discipline)
+MEGA_TWIN_KEYS = ("mega_padded_xla_matches_unfused",
+                  "mega_padded_pallas_matches_unfused",
+                  "mega_ragged_matches_unfused",
+                  "mega_paged_matches_ragged",
+                  "mega_combined_identical")
+
 
 def _check_call_artifact(path: str) -> int:
     """Gate 9's committed-artifact half: oracle identity, served
@@ -380,6 +428,81 @@ def _check_paged_artifact(path: str) -> int:
               f"({doc.get('paged_n_jobs')} tenants x "
               f"{doc.get('paged_n_reads')} reads), all twins "
               "bit-identical, identity true, 0 steady recompiles")
+    return rc
+
+
+def _check_mega_artifact(path: str) -> int:
+    """Gate 10's committed-artifact half: the >= 2x per-chunk
+    dispatch-count collapse on the combined leg, every fused leg
+    bit-identical to its unfused twin, and the zero-recompile pin
+    (all unconditional); the fused-wall slack (capacity-armed)."""
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, ValueError) as e:
+        print(f"bench_gate: unreadable mega artifact {path}: {e}",
+              file=sys.stderr)
+        return 2
+    rc = 0
+    red = doc.get("mega_dispatch_reduction")
+    if not isinstance(red, (int, float)) or \
+            red < MEGA_REQUIRED_DISPATCH_REDUCTION:
+        print(f"bench_gate: mega dispatch reduction {red!r} in {path} "
+              f"is below the required "
+              f"{MEGA_REQUIRED_DISPATCH_REDUCTION}x on the combined "
+              "leg — the fused mega-pass no longer collapses the "
+              "per-chunk dispatches", file=sys.stderr)
+        rc = 1
+    if doc.get("mega_identical") is not True:
+        print(f"bench_gate: mega_identical is not true in {path} — a "
+              "fused mega-pass leg no longer byte-identical to its "
+              "unfused twin", file=sys.stderr)
+        rc = 1
+    if doc.get("mega_steady_recompiles") != 0:
+        print(f"bench_gate: mega_steady_recompiles "
+              f"{doc.get('mega_steady_recompiles')!r} in {path} — a "
+              "warm fused round must reuse every compiled shape "
+              "(compile-count delta 0)", file=sys.stderr)
+        rc = 1
+    mism = [k for k in MEGA_TWIN_KEYS if doc.get(k) is not True]
+    mism += sorted(k for k in doc
+                   if k.startswith("mega_") and k.endswith("_error"))
+    if mism:
+        print("bench_gate: mega-pass legs no longer bit-identical to "
+              f"their unfused twins in {path}: {mism}",
+              file=sys.stderr)
+        rc = 1
+    un = doc.get("mega_unfused_wall_s")
+    fu = doc.get("mega_fused_wall_s")
+    capacity = doc.get("host_parallel_capacity")
+    gated = isinstance(capacity, (int, float)) and \
+        capacity >= MEGA_CAPACITY_FLOOR
+    walls_ok = isinstance(un, (int, float)) and \
+        isinstance(fu, (int, float))
+    if not walls_ok:
+        print(f"bench_gate: mega artifact {path} carries no "
+              "mega_unfused_wall_s/mega_fused_wall_s pair",
+              file=sys.stderr)
+        rc = 1
+    elif gated and fu > MEGA_WALL_SLACK * un:
+        print(f"bench_gate: fused wall {fu}s exceeds "
+              f"{MEGA_WALL_SLACK}x the unfused wall {un}s in {path} "
+              f"on a box with measured parallel capacity {capacity}x "
+              "— one dispatch per chunk got slower than three",
+              file=sys.stderr)
+        rc = 1
+    if rc == 0:
+        how = (f"fused wall {fu}s within {MEGA_WALL_SLACK}x of "
+               f"unfused {un}s"
+               if gated else
+               f"walls {un}s unfused / {fu}s fused reported, not "
+               f"gated — measured parallel capacity {capacity}x < "
+               f"{MEGA_CAPACITY_FLOOR}x (capacity-limited box)")
+        print(f"mega gate: combined leg {red}x >= "
+              f"{MEGA_REQUIRED_DISPATCH_REDUCTION}x dispatch-count "
+              f"reduction ({doc.get('mega_n_chunks')} chunks x "
+              f"{doc.get('mega_chunk_rows')} rows), every leg "
+              f"bit-identical, 0 steady recompiles, {how}")
     return rc
 
 
@@ -736,6 +859,15 @@ def main(argv=None) -> int:
             print("bench_gate: --call needs a path", file=sys.stderr)
             return 2
         del argv[i:i + 2]
+    fresh_mega = None
+    if "--mega" in argv:
+        i = argv.index("--mega")
+        try:
+            fresh_mega = argv[i + 1]
+        except IndexError:
+            print("bench_gate: --mega needs a path", file=sys.stderr)
+            return 2
+        del argv[i:i + 2]
     for path in (BASELINE, CURRENT):
         if not os.path.exists(path):
             print(f"bench_gate: missing committed artifact {path} "
@@ -775,6 +907,11 @@ def main(argv=None) -> int:
     if not os.path.exists(CALL):
         print(f"bench_gate: missing committed artifact {CALL} "
               "(regenerate with: python bench.py --worker call "
+              "> out.jsonl on the CPU backend)", file=sys.stderr)
+        return 2
+    if not os.path.exists(MEGA):
+        print(f"bench_gate: missing committed artifact {MEGA} "
+              "(regenerate with: python bench.py --worker mega_race "
               "> out.jsonl on the CPU backend)", file=sys.stderr)
         return 2
 
@@ -949,6 +1086,27 @@ def main(argv=None) -> int:
         if rc != 0:
             print("bench_gate: a call wall regressed past 10% vs the "
                   "committed artifact", file=sys.stderr)
+            return rc
+
+    print(f"\n== gate 10: fused mega-pass dispatch collapse >= "
+          f"{MEGA_REQUIRED_DISPATCH_REDUCTION}x on the committed "
+          "mega_race artifact ==")
+    rc = _check_mega_artifact(MEGA)
+    if rc != 0:
+        return rc
+
+    if fresh_mega:
+        print(f"\n== gate 10b: {fresh_mega} vs committed {MEGA} "
+              "(10% regression threshold on the combined-leg walls) ==")
+        rc = _check_mega_artifact(fresh_mega)
+        if rc != 0:
+            return rc
+        rc = compare_bench.main([MEGA, fresh_mega,
+                                 "--keys", ",".join(MEGA_WALL_KEYS),
+                                 "--threshold", "10"])
+        if rc != 0:
+            print("bench_gate: a mega combined-leg wall regressed past "
+                  "10% vs the committed artifact", file=sys.stderr)
             return rc
 
     print("\nbench_gate: all gates hold")

@@ -52,10 +52,12 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 
 #: the plan fields a replay must reproduce exactly (``layout`` — the
-#: ragged-vs-padded dimension — is compared only when the event carries
-#: it, so pre-layout sidecars still replay)
+#: ragged-vs-padded dimension — and ``fused_device`` — the mega-pass
+#: dimension — are compared only when the event carries them, so
+#: pre-layout/pre-mega sidecars still replay)
 PLAN_FIELDS = ("chunk_rows", "ladder", "ladder_base", "prefetch_depth",
-               "donate", "layout", "page_rows", "pool_pages")
+               "donate", "layout", "page_rows", "pool_pages",
+               "fused_device")
 
 #: the fused-transform plan fields a replay must reproduce exactly
 #: (pipeline.decide_fusion_plan; same purity contract)
@@ -112,7 +114,7 @@ CALL_FIELDS = ("stripe_span", "min_depth", "min_alt", "reason")
 
 #: fields absent from older sidecars: compared only when recorded
 _OPTIONAL_FIELDS = ("layout", "page_rows", "pool_pages", "reject",
-                    "cancel")
+                    "cancel", "fused_device")
 
 #: event kinds whose canonicalized inputs grew layout keys in PR 8 —
 #: a pre-layout event's recorded inputs digest differently under the

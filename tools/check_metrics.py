@@ -15,8 +15,20 @@ elastic worker sidecars).  Contract checked here:
 * ``executor_bucket_selected`` events carry ``pass``, ``chunk_rows``
   (int > 0), a strictly ascending int ``ladder`` whose top rung equals
   ``chunk_rows``, ``ladder_base`` (> 1), ``inputs`` (object), a hex
-  ``input_digest`` (tools/check_executor.py replays the decision) and —
-  since the ragged-layout dimension — a ``layout`` of padded|ragged;
+  ``input_digest`` (tools/check_executor.py replays the decision), a
+  ``layout`` of padded|ragged|paged (paged adds positive ``page_rows``/
+  ``pool_pages``) and — since the fused mega-pass dimension — an
+  optional boolean ``fused_device``;
+* ``mega_plan_selected`` events carry ``pass`` (str), boolean
+  ``fused_device`` and ``reason`` (str) — the companion receipt for
+  the fused mega-pass decision (replayability lives in the matching
+  ``executor_bucket_selected`` event's recorded inputs);
+* ``dispatch_count`` events (one rollup per pass at finish, emitted
+  when the pass dispatched at all) carry ``pass`` (str),
+  ``dispatches`` (int >= 1), ``chunks`` (int >= 0), a ``layout`` of
+  padded|ragged|paged and boolean ``fused_device`` — the per-chunk
+  dispatch accounting the mega-pass win (three dispatches became one)
+  is gated on;
 * ``executor_recompile`` events carry ``pass``, ``rows`` (a member of
   that pass's announced ladder) and ``n_shapes`` (int >= 1 — counts
   (rows, len) pairs, so it may exceed the ROW ladder length when the
@@ -30,8 +42,9 @@ elastic worker sidecars).  Contract checked here:
   (tools/check_executor.py replays the decision); ``io_ledger``
   transform-pass rows must belong to an announced stream set;
 * ``realign_plan_selected`` events carry ``pipeline_depth`` (int >= 0),
-  boolean ``donate``, ``inputs`` (object) and a hex ``input_digest``
-  (the decision is pure and replayable, like the executor's);
+  boolean ``donate``, an optional ``layout`` of padded|ragged|paged,
+  ``inputs`` (object) and a hex ``input_digest`` (the decision is pure
+  and replayable, like the executor's);
 * ``realign_bin`` events carry ``bin``/``rows``/``groups``/``jobs``
   (non-negative ints) and non-negative per-stage walls
   (``load_s``/``prep_s``/``sweep_s``/``finish_s``/``emit_s``);
@@ -39,8 +52,8 @@ elastic worker sidecars).  Contract checked here:
   ints — padded (R, L, CL), or the ragged (rows_pad, bases_pad, CL)),
   ``jobs >= 1``, padded lane count ``g >= jobs``, ``units >= 1``
   (distinct bins sharing the dispatch), and — since the ragged layout —
-  a ``layout`` of padded|ragged plus the per-axis pad-waste fractions
-  ``waste_r``/``waste_l``/``waste_cl``/``waste_g`` in [0, 1];
+  a ``layout`` of padded|ragged|paged plus the per-axis pad-waste
+  fractions ``waste_r``/``waste_l``/``waste_cl``/``waste_g`` in [0, 1];
 * ``fault_injected`` events carry ``site`` (a known injection site),
   ``occurrence`` (int >= 1), ``fault`` (a known fault kind),
   ``inputs`` (object) and a hex ``input_digest``
@@ -187,6 +200,7 @@ KNOWN_EVENTS = (
     "placement_selected", "job_requeued", "worker_lease_expired",
     "ledger_stage",
     "pages_selected", "h2d_bytes",
+    "mega_plan_selected", "dispatch_count",
     "overload_state", "admission_rejected", "deadline_missed",
     "breaker_state",
     "series_written", "serve_report_checkpoint",
@@ -356,6 +370,10 @@ def validate(path: str) -> List[str]:
                             not isinstance(v, bool) and v > 0):
                         err(i, f"executor_bucket_selected paged layout "
                                f"missing positive int {field!r}")
+            if "fused_device" in d and \
+                    not isinstance(d["fused_device"], bool):
+                err(i, "executor_bucket_selected 'fused_device' is "
+                       "not a boolean")
         elif ev == "executor_recompile":
             if not isinstance(d.get("pass"), str):
                 err(i, "executor_recompile missing string 'pass'")
@@ -421,7 +439,8 @@ def validate(path: str) -> List[str]:
                        "'pipeline_depth'")
             if not isinstance(d.get("donate"), bool):
                 err(i, "realign_plan_selected missing boolean 'donate'")
-            if "layout" in d and d["layout"] not in ("padded", "ragged"):
+            if "layout" in d and d["layout"] not in ("padded", "ragged",
+                                                     "paged"):
                 err(i, f"realign_plan_selected unknown layout "
                        f"{d['layout']!r}")
             if not isinstance(d.get("inputs"), dict):
@@ -465,7 +484,8 @@ def validate(path: str) -> List[str]:
             if not (isinstance(units, int) and not isinstance(units, bool)
                     and units >= 1):
                 err(i, "realign_sweep_dispatch missing int 'units' >= 1")
-            if "layout" in d and d["layout"] not in ("padded", "ragged"):
+            if "layout" in d and d["layout"] not in ("padded", "ragged",
+                                                     "paged"):
                 err(i, f"realign_sweep_dispatch unknown layout "
                        f"{d['layout']!r}")
             for field in ("waste_r", "waste_l", "waste_cl", "waste_g"):
@@ -776,6 +796,31 @@ def validate(path: str) -> List[str]:
             if not (isinstance(p, int) and not isinstance(p, bool)
                     and p >= 1):
                 err(i, "h2d_bytes missing int 'puts' >= 1")
+        elif ev == "mega_plan_selected":
+            if not isinstance(d.get("pass"), str):
+                err(i, "mega_plan_selected missing string 'pass'")
+            if not isinstance(d.get("fused_device"), bool):
+                err(i, "mega_plan_selected missing boolean "
+                       "'fused_device'")
+            if not isinstance(d.get("reason"), str):
+                err(i, "mega_plan_selected missing string 'reason'")
+        elif ev == "dispatch_count":
+            if not isinstance(d.get("pass"), str):
+                err(i, "dispatch_count missing string 'pass'")
+            n = d.get("dispatches")
+            if not (isinstance(n, int) and not isinstance(n, bool)
+                    and n >= 1):
+                err(i, "dispatch_count missing int 'dispatches' >= 1")
+            c = d.get("chunks")
+            if not (isinstance(c, int) and not isinstance(c, bool)
+                    and c >= 0):
+                err(i, "dispatch_count missing non-negative int "
+                       "'chunks'")
+            if d.get("layout") not in ("padded", "ragged", "paged"):
+                err(i, f"dispatch_count unknown layout "
+                       f"{d.get('layout')!r}")
+            if not isinstance(d.get("fused_device"), bool):
+                err(i, "dispatch_count missing boolean 'fused_device'")
         elif ev == "overload_state":
             lvl = d.get("level")
             if not (isinstance(lvl, int) and not isinstance(lvl, bool)
