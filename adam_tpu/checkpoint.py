@@ -82,7 +82,8 @@ def atomic_write(path: str, payload: str, *,
         fsync_dir(parent)
 
 
-def atomic_np_write(path: str, writer: Callable) -> str:
+def atomic_np_write(path: str, writer: Callable, *,
+                    fsync: bool = True) -> str:
     """THE durable atomic binary-blob write — ``atomic_write``'s twin
     for np.save/np.savez payloads: tmp in the target dir + flush +
     fsync + rename + parent-dir fsync, ``writer(f)`` doing the save
@@ -93,17 +94,26 @@ def atomic_np_write(path: str, writer: Callable) -> str:
     matters most where a marker ordering rides on it: the fleet
     recovery contract is commit file FIRST, progress marker second — a
     marker whose dir entry survives a power loss while the commit's
-    does not would silently drop the unit from the merge."""
+    does not would silently drop the unit from the merge.
+
+    ``fsync=False`` keeps the tmp+rename atomicity but skips both the
+    file fsync and the parent-dir fsync — for writers that batch ONE
+    directory fsync per commit window themselves (the fleet's batched
+    spool, parallel/shardstream.py): under an ordered-journal
+    filesystem the renames still become durable in order, so the
+    commit-before-marker contract holds with a single sync."""
     parent = os.path.dirname(os.path.abspath(path)) or "."
     os.makedirs(parent, exist_ok=True)
     fd, tmp = tempfile.mkstemp(dir=parent, suffix=".tmp")
     try:
         with os.fdopen(fd, "wb") as f:
             writer(f)
-            f.flush()
-            os.fsync(f.fileno())
+            if fsync:
+                f.flush()
+                os.fsync(f.fileno())
         os.replace(tmp, path)
-        fsync_dir(parent)
+        if fsync:
+            fsync_dir(parent)
     finally:
         if os.path.exists(tmp):
             os.unlink(tmp)

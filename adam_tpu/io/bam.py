@@ -226,6 +226,217 @@ def iter_decompressed(path, chunk_bytes: int = 1 << 24, procs: int = 1):
                 yield chunk
 
 
+def _iter_bgzf_members(path, chunk_bytes: int = 1 << 24, start: int = 0):
+    """Yield ``(file_off, member_size, payload)`` per BGZF member from
+    byte ``start`` — members are self-delimiting, so a mid-file start
+    works as long as it lands ON a member boundary (a BGZF virtual
+    offset's file half).  Incomplete trailing bytes end the walk; the
+    record layer decides whether that is truncation."""
+    with open(path, "rb") as f:
+        if start:
+            f.seek(start)
+        buf = bytearray()
+        off = start
+        eof = False
+        while True:
+            size = _bgzf_member_size(buf, 0)
+            while not eof and (size is None or size > len(buf)):
+                raw = f.read(chunk_bytes)
+                if not raw:
+                    eof = True
+                else:
+                    buf += raw
+                    size = _bgzf_member_size(buf, 0)
+            if size is None or size > len(buf):
+                return
+            view = bytes(buf[:size])
+            xlen = view[10] | (view[11] << 8)
+            isize = int.from_bytes(view[-4:], "little")
+            yield off, size, zlib.decompress(view[12 + xlen:-8],
+                                             wbits=-15, bufsize=isize or 1)
+            del buf[:size]
+            off += size
+
+
+def scan_bam_units(path, unit_rows: Optional[int] = None):
+    """Length-walk a BGZF BAM — total rows plus the BGZF virtual offset
+    of each unit's first record — WITHOUT building Arrow rows.
+
+    The walk hops ``block_size`` fields (4 bytes read per record, no
+    field decode, no Python row objects), so counting a file costs one
+    inflate pass instead of a full decode.  With ``unit_rows`` set it
+    also emits ``voffs[k] = [member_file_off, intra_member_off]`` for
+    unit ``k`` — the seek target :func:`open_bam_stream_at` enters at,
+    which is what collapses a shard's re-decode bytes to ~0.
+
+    Returns ``None`` when the file is not BGZF (plain gzip / raw BAM
+    has no member boundaries to seek to); raises FormatError on the
+    same corrupt/truncated shapes the decoder would.
+    """
+    import bisect
+
+    from ..errors import FormatError
+    with open(path, "rb") as f:
+        head = f.read(18)
+    if head[:2] != b"\x1f\x8b" or _bgzf_member_size(head, 0) is None:
+        return None
+    gen = _iter_bgzf_members(path)
+    mem_starts: List[int] = []      # global decompressed start per member
+    mem_offs: List[int] = []        # file offset per member
+    buf = bytearray()
+    base = 0                        # global offset of buf[0]
+    eof = False
+
+    def fill(need_end: int) -> None:
+        nonlocal eof
+        while not eof and base + len(buf) < need_end:
+            got = next(gen, None)
+            if got is None:
+                eof = True
+            else:
+                foff, _size, payload = got
+                mem_starts.append(base + len(buf))
+                mem_offs.append(foff)
+                buf.extend(payload)
+
+    pos = None                      # global offset of the next record
+    while pos is None:
+        try:
+            if len(buf) >= 4:
+                _, _, first = parse_header(bytes(buf), path)
+                pos = first
+        except (struct.error, IndexError):
+            pass
+        if pos is None:
+            if eof:
+                raise FormatError(f"{path}: truncated BAM header")
+            fill(base + len(buf) + 1)
+
+    total = 0
+    voffs: List[List[int]] = []
+    while True:
+        fill(pos + 4)
+        end_g = base + len(buf)
+        if pos >= end_g:
+            if pos > end_g:
+                raise FormatError(
+                    f"{path}: {pos - end_g} byte(s) short of a complete "
+                    "record (truncated file?)")
+            break
+        if pos + 4 > end_g:
+            raise FormatError(
+                f"{path}: {end_g - pos} trailing bytes form no complete "
+                "record (truncated file?)")
+        block_size = struct.unpack_from("<i", buf, pos - base)[0]
+        if block_size < 32:
+            from ..errors import FormatError as _FE
+            raise _FE(f"corrupt BAM record: block_size {block_size} at "
+                      f"decompressed byte {pos}")
+        if unit_rows and total % unit_rows == 0:
+            i = bisect.bisect_right(mem_starts, pos) - 1
+            voffs.append([mem_offs[i], pos - mem_starts[i]])
+        total += 1
+        pos += 4 + block_size
+        # bound memory: drop members wholly behind the cursor
+        if pos - base > (1 << 25):
+            i = bisect.bisect_right(mem_starts, pos) - 1
+            if i > 0:
+                cut = mem_starts[i]
+                del buf[:cut - base]
+                base = cut
+                del mem_starts[:i]
+                del mem_offs[:i]
+    return dict(total_rows=total,
+                unit_rows=int(unit_rows) if unit_rows else None,
+                voffs=voffs if unit_rows else None)
+
+
+def open_bam_stream_at(path, member_off: int, intra_off: int, *,
+                       chunk_rows: int = 1 << 20,
+                       chunk_bytes: int = 1 << 24, io_procs: int = 1,
+                       on_bytes=None):
+    """:func:`open_bam_stream`, entered at a BGZF virtual offset.
+
+    The header still parses from byte 0 (seq/RG dictionaries live
+    there), then decoding seeks straight to ``member_off`` and skips
+    ``intra_off`` decompressed bytes — everything between the header
+    and the target member is never read, which is the entire point.
+    ``io_procs > 1`` inflates the seeked tail through the
+    ``io/bgzf_procs`` segment pool (member-aligned, byte-identical).
+    ``on_bytes`` (when given) receives the COMPRESSED size of every
+    member/segment actually inflated, so the I/O ledger can charge what
+    this reader truly cost instead of the whole file.
+    """
+    from ..errors import FormatError
+
+    hdr_iter = _iter_bgzf_members(path, chunk_bytes)
+    hbuf = bytearray()
+    seq_dict = rg_dict = None
+    for _foff, size, payload in hdr_iter:
+        hbuf += payload
+        if on_bytes is not None:
+            on_bytes(size)
+        try:
+            seq_dict, rg_dict, _first = parse_header(bytes(hbuf), path)
+            break
+        except (struct.error, IndexError):
+            continue
+    hdr_iter.close()
+    if seq_dict is None:
+        raise FormatError(f"{path}: truncated BAM header")
+
+    def pieces():
+        if io_procs > 1:
+            from .bgzf_procs import iter_decompressed_procs
+            yield from iter_decompressed_procs(
+                path, io_procs, chunk_bytes=chunk_bytes,
+                start=member_off, on_segment=on_bytes)
+            return
+        for _foff, size, payload in _iter_bgzf_members(
+                path, chunk_bytes, start=member_off):
+            if on_bytes is not None:
+                on_bytes(size)
+            yield payload
+
+    def gen():
+        from ..resilience import faults as _faults
+        it = pieces()
+        buf = bytearray()
+        off = intra_off
+        rows = []
+        exhausted = False
+        while True:
+            parsed = _parse_record(buf, off, seq_dict, rg_dict)
+            if parsed is None:
+                if exhausted:
+                    break
+                if off and off <= len(buf):
+                    del buf[:off]
+                    off = 0
+                got = next(it, None)
+                if got is None:
+                    exhausted = True
+                else:
+                    buf += got
+                continue
+            # same per-parsed-record injection discipline as the
+            # forward decoder; occurrences count from THIS entry point
+            _faults.fire("input_record")
+            row, off = parsed
+            rows.append(row)
+            if len(rows) >= chunk_rows:
+                yield _rows_to_table(rows)
+                rows = []
+        if off < len(buf):
+            raise FormatError(
+                f"{path}: {len(buf) - off} trailing bytes form no "
+                "complete record (truncated file?)")
+        if rows:
+            yield _rows_to_table(rows)
+
+    return seq_dict, rg_dict, gen()
+
+
 def parse_tag_region(data, p: int, end: int):
     """Walk a record's optional-field region -> (attr strings, MD, RG).
 

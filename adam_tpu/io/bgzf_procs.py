@@ -62,8 +62,8 @@ def _member_size(buf, off: int):
     return None
 
 
-def iter_segments(path: str, segment_bytes: int = SEGMENT_BYTES
-                  ) -> Iterator[Tuple[int, int]]:
+def iter_segments(path: str, segment_bytes: int = SEGMENT_BYTES,
+                  start: int = 0) -> Iterator[Tuple[int, int]]:
     """Member-aligned compressed (offset, size) segments of a BGZF file,
     yielded as the scan discovers them.
 
@@ -71,7 +71,10 @@ def iter_segments(path: str, segment_bytes: int = SEGMENT_BYTES
     each member header names its own size (BSIZE), so the scan hops
     header to header.  Lazy on purpose — on a multi-GB input the pool
     starts inflating the first segments while the tail is still being
-    scanned.  Raises ValueError on non-BGZF input (first yield) or a
+    scanned.  ``start`` (a member-aligned file offset — the file half of
+    a BGZF virtual offset) begins the walk mid-file: the index-assisted
+    shard entry (``io/bam.open_bam_stream_at``) never scans the bytes it
+    seeks past.  Raises ValueError on non-BGZF input (first yield) or a
     truncated trailing member (mid-iteration, like the sequential
     iterator's FormatError).
     """
@@ -79,9 +82,9 @@ def iter_segments(path: str, segment_bytes: int = SEGMENT_BYTES
     with open(path, "rb") as f:
         size = os.fstat(f.fileno()).st_size
         buf = b""
-        base = 0            # file offset of buf[0]
-        off = 0             # current member's file offset
-        seg_start = 0
+        base = start        # file offset of buf[0]
+        off = start         # current member's file offset
+        seg_start = start
         while off < size:
             # keep a full worst-case header (12 + xlen <= 64 KiB + slack)
             if off - base + (1 << 17) > len(buf) and base + len(buf) < size:
@@ -131,7 +134,9 @@ def _inflate_segment(path: str, off: int, size: int) -> bytes:
 def iter_decompressed_procs(path: str, procs: int,
                             segment_bytes: int = 0,
                             depth: int = 0,
-                            chunk_bytes: int = 1 << 24) -> Iterator[bytes]:
+                            chunk_bytes: int = 1 << 24,
+                            start: int = 0,
+                            on_segment=None) -> Iterator[bytes]:
     """Decompressed byte chunks of a BGZF file, inflated by ``procs``
     worker processes; concatenation is byte-identical to
     ``io/bam.iter_decompressed``.  Non-BGZF inputs (plain gzip, raw)
@@ -143,30 +148,53 @@ def iter_decompressed_procs(path: str, procs: int,
     ``depth`` (default ``procs + 2``) segments are in flight, so host
     RSS stays bounded by ~``depth x chunk_bytes`` regardless of how far
     inflate outruns the consumer.
+
+    ``start`` begins the walk at a member-aligned file offset (the
+    index-assisted shard entry); a non-zero start requires real BGZF —
+    there is no sequential fallback that could honor a seek.
+    ``on_segment`` (when given) receives each segment's COMPRESSED size
+    as it is yielded, so callers can charge the I/O ledger with bytes
+    actually inflated rather than the whole file.
     """
     from .bam import iter_decompressed
 
-    if procs <= 1:
+    if procs <= 1 and not start:
         yield from iter_decompressed(path, chunk_bytes)
         return
     if not segment_bytes:
         # module attr read at call time, so tests can shrink segments
         segment_bytes = min(SEGMENT_BYTES, max(1 << 16, chunk_bytes // 4))
-    it = iter_segments(path, segment_bytes)
+    it = iter_segments(path, segment_bytes, start=start)
     try:
         first = next(it, None)
     except ValueError:
+        if start:
+            raise       # a seek into a non-BGZF file has no fallback
         # not BGZF (plain gzip / raw): the sequential iterator handles it
         yield from iter_decompressed(path, chunk_bytes)
         return
     if first is None:
         return
 
+    if procs <= 1:
+        # seeked single-process walk: inflate segments inline
+        seg = first
+        while seg is not None:
+            data = _inflate_segment(path, *seg)
+            if on_segment is not None:
+                on_segment(seg[1])
+            if data:
+                yield data
+            seg = next(it, None)
+        return
+
     depth = depth or procs + 2
     ctx = mp.get_context("spawn")
     with ctx.Pool(processes=procs) as pool:
         pending: deque = deque()
-        pending.append(pool.apply_async(_inflate_segment, (path, *first)))
+        pending.append((first[1],
+                        pool.apply_async(_inflate_segment,
+                                         (path, *first))))
         try:
             # prime the window lazily: the scan overlaps the inflate pool
             while pending:
@@ -174,9 +202,13 @@ def iter_decompressed_procs(path: str, procs: int,
                     nxt = next(it, None)
                     if nxt is None:
                         break
-                    pending.append(pool.apply_async(_inflate_segment,
-                                                    (path, *nxt)))
-                data = pending.popleft().get()
+                    pending.append((nxt[1],
+                                    pool.apply_async(_inflate_segment,
+                                                     (path, *nxt))))
+                nbytes, fut = pending.popleft()
+                data = fut.get()
+                if on_segment is not None:
+                    on_segment(nbytes)
                 if data:
                     yield data
         finally:

@@ -174,6 +174,116 @@ def open_sam_stream(path_or_file, chunk_rows: int = 1 << 20,
     return seq_dict, rg_dict, gen()
 
 
+def scan_sam_units(path, unit_rows: Optional[int] = None):
+    """Byte-walk a SAM file — total body rows plus the byte offset of
+    each unit's first record — without building any row objects.
+
+    Also answers whether mid-file entry is SAFE: the body parser
+    lazily registers ``RG:Z:`` values missing from the header
+    (:func:`_parse_sam_line`), and lazy indices depend on encounter
+    order — a shard entering mid-file would assign different dense
+    ``recordGroupId``s than a forward decode.  ``safe`` is True only
+    when every body RG value is declared by a header ``@RG`` line, so
+    entry order cannot matter.  Callers treat ``safe=False`` as
+    index-unavailable and fall back to forward decode.
+    """
+    rg_ids = set()
+    total = 0
+    offsets: List[int] = []
+    safe = True
+    with open(path, "rb") as f:
+        off = 0
+        in_header = True
+        for line in f:
+            this_off = off
+            off += len(line)
+            if in_header:
+                if line.startswith(b"@"):
+                    if line.startswith(b"@RG"):
+                        for field in line.rstrip(b"\n").split(b"\t"):
+                            if field.startswith(b"ID:"):
+                                rg_ids.add(field[3:])
+                    continue
+                in_header = False
+            if not line.rstrip(b"\n"):
+                continue        # blank: the parser drops it too
+            if unit_rows and total % unit_rows == 0:
+                offsets.append(this_off)
+            tab_rg = line.find(b"\tRG:Z:")
+            if tab_rg >= 0:
+                rest = line[tab_rg + 6:]
+                end = len(rest)
+                for stop in (b"\t", b"\n"):
+                    cut = rest.find(stop)
+                    if 0 <= cut < end:
+                        end = cut
+                if rest[:end] not in rg_ids:
+                    safe = False
+            total += 1
+    return dict(total_rows=total,
+                unit_rows=int(unit_rows) if unit_rows else None,
+                offsets=offsets if unit_rows else None, safe=safe)
+
+
+def open_sam_stream_at(path, offset: int, *, chunk_rows: int = 1 << 20,
+                       stringency: str = "strict", on_bytes=None):
+    """:func:`open_sam_stream`, entered at a byte offset.
+
+    The header still parses from byte 0 (dictionaries live there);
+    body decoding seeks straight to ``offset`` — a line boundary from
+    :func:`scan_sam_units`.  Only call this when the scan reported
+    ``safe`` (no lazy RG registration in play).  ``on_bytes`` (when
+    given) receives the size of every line actually read, so the I/O
+    ledger charges what this reader truly cost, not the whole file.
+    """
+    from ..errors import ValidationStringency
+    if stringency not in (ValidationStringency.STRICT,
+                          ValidationStringency.LENIENT,
+                          ValidationStringency.SILENT):
+        raise ValueError(f"unknown validation stringency {stringency!r} "
+                         "(want strict/lenient/silent)")
+    header_lines: List[str] = []
+    hdr_bytes = 0
+    with open(path, "rb") as f:
+        for line in f:
+            if not line.startswith(b"@"):
+                break
+            header_lines.append(line.decode())
+            hdr_bytes += len(line)
+    if on_bytes is not None:
+        on_bytes(hdr_bytes)
+    seq_dict = SequenceDictionary.from_sam_header_lines(header_lines)
+    rg_dict = RecordGroupDictionary.from_sam_header_lines(header_lines)
+
+    def gen():
+        from ..errors import handle_malformed
+        rows: List[dict] = []
+        with open(path, "rb") as f:
+            f.seek(offset)
+            for bline in f:
+                if on_bytes is not None:
+                    on_bytes(len(bline))
+                line = bline.decode("utf-8", "replace")
+                try:
+                    row = _parse_sam_line(line, seq_dict, rg_dict)
+                except (ValueError, IndexError) as e:
+                    handle_malformed(
+                        stringency,
+                        f"malformed SAM record {line.rstrip()[:80]!r}: {e}",
+                        e)
+                    continue
+                if row is None:
+                    continue
+                rows.append(row)
+                if len(rows) >= chunk_rows:
+                    yield _rows_to_table(rows)
+                    rows = []
+        if rows:
+            yield _rows_to_table(rows)
+
+    return seq_dict, rg_dict, gen()
+
+
 def read_sam(path_or_file, stringency: str = "strict"
              ) -> Tuple[pa.Table, SequenceDictionary, RecordGroupDictionary]:
     """Parse a SAM text file into (reads table, seq dict, record groups)."""

@@ -1,0 +1,500 @@
+"""Zero-copy fleet data plane: the shared-memory ring transport, the
+unit claim table, and the broadcast-blob cache.
+
+ROADMAP item 3: ``BENCH_SHARD.json`` measured the fleet-dir transport
+(npz unit results at several fsyncs per commit) as real overhead, not
+neighbor noise.  This module is the same-box fast path the shard fleet
+(parallel/shardstream.py) rides when the pure, replayable
+:func:`decide_transport` selects it:
+
+* **ring transport** — each worker appends its unit results to a
+  fixed-capacity mmap'd ring file (``ring/shard{S}-inc{I}.ring``) as
+  Arrow-IPC-framed segments.  The file header carries a seqlock-guarded
+  commit cursor: the writer lays the whole segment down PAST the cursor,
+  then publishes it with an odd/even seqlock dance, so a reader never
+  observes a half-written segment as committed.  Every segment frame
+  records its payload length and CRC32 — a SIGKILL mid-write leaves a
+  *torn* segment beyond the cursor that the supervisor detects (length
+  or checksum mismatch) and ignores.  Readers and the writer share the
+  page cache (``MAP_SHARED`` on one file, one box), so publishing is a
+  memory write, not an fsync.
+
+  The ring is an ACCELERATOR, never the spine: the worker renames its
+  durable npz commit *before* publishing the same results to the ring,
+  so ring contents are always a subset of the filesystem spool and the
+  crash-recovery contract (commit file first, progress marker second)
+  is untouched.  The supervisor merges ring-delivered segments by the
+  same ``(incarnation, shard, seq)`` first-wins key as file commits —
+  a segment and its npz twin are ONE commit, not a duplicate.
+
+* **claim table** — ``claims/unit{U}.json`` created with ``O_EXCL``:
+  the structural exactly-once primitive behind unit-granular work
+  stealing.  Two idle workers racing for the same pending unit cannot
+  both win the create; the loser moves on.  Claims are advisory for
+  WORK (the merge's dedup remains the correctness backstop) and the
+  supervisor releases a dead claimant's claims so its victim recomputes.
+
+* **broadcast cache** — the per-task broadcast blobs (markdup dup bits,
+  hoisted MD events) are mapped read-only ONCE per worker process and
+  memoized by (path, mtime, size); N shard incarnations in one process
+  open the blob once (``broadcast_blob_opens`` counts real opens).
+
+Both deciders here are PURE and recorded in full (``inputs`` +
+``input_digest``) by their events (``transport_selected``,
+``shard_entry_selected``); tools/check_executor.py replays them offline
+exactly like ``decide_shard_plan``.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import mmap
+import os
+import struct
+import zlib
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .. import obs
+from ..resilience import faults
+
+#: fleet-dir subdirectories owned by this plane
+RING_DIR = "ring"
+CLAIM_DIR = "claims"
+
+#: knobs (the resolve-from-env convention of ADAM_TPU_FLEET_*)
+TRANSPORT_ENV = "ADAM_TPU_FLEET_TRANSPORT"     # auto | ring | fleet_dir
+SPOOL_SYNC_ENV = "ADAM_TPU_FLEET_SPOOL_SYNC"   # auto | batched | every
+ENTRY_ENV = "ADAM_TPU_FLEET_ENTRY"             # auto | index | forward
+RING_BYTES_ENV = "ADAM_TPU_RING_BYTES"
+
+DEFAULT_RING_BYTES = 8 << 20
+
+#: ring file header: magic, capacity, shard, incarnation live at fixed
+#: offsets; the committed cursor (u64 @24) and seqlock counter (u32 @32)
+#: are written independently by the publish dance
+_MAGIC = b"ATRING01"
+_HDR_CAP_OFF = 8
+_HDR_SHARD_OFF = 12
+_HDR_INC_OFF = 16
+_HDR_COMMIT_OFF = 24
+_HDR_SEQLOCK_OFF = 32
+HEADER_BYTES = 64
+
+#: segment frame: seg magic, commit seq, n_units, payload_len, crc32
+_SEG_MAGIC = 0x41544E52
+_SEG = struct.Struct("<IIIII")
+
+
+def _pad8(n: int) -> int:
+    return (n + 7) & ~7
+
+
+def _digest(inputs: dict) -> str:
+    return hashlib.sha256(
+        json.dumps(inputs, sort_keys=True).encode()).hexdigest()[:16]
+
+
+# ---------------------------------------------------------------------------
+# the pure decisions
+# ---------------------------------------------------------------------------
+
+def decide_transport(*, requested: str, same_box: bool,
+                     mmap_capable: bool, spool_requested: str) -> dict:
+    """Which data plane a fleet run uses — PURE.
+
+    ``transport`` ∈ ``ring`` (mmap ring segments + spool as durable
+    spine) / ``fleet_dir`` (spool only, the PR 9 plane).  The ring
+    engages only when workers share the supervisor's box (page-cache
+    coherence is the whole mechanism) and the fleet dir's filesystem
+    takes an mmap.  ``spool_sync`` ∈ ``batched`` (one directory fsync
+    per commit window) / ``every`` (the conservative per-file
+    discipline); ``auto`` resolves to batched.  Recorded in full by
+    ``transport_selected``; tools/check_executor.py replays it.
+    """
+    inputs = dict(requested=str(requested), same_box=bool(same_box),
+                  mmap_capable=bool(mmap_capable),
+                  spool_requested=str(spool_requested))
+    reasons = []
+    if inputs["requested"] == "fleet_dir":
+        transport, why = "fleet_dir", "forced"
+    elif not inputs["mmap_capable"]:
+        transport, why = "fleet_dir", "no-mmap"
+    elif inputs["requested"] == "ring":
+        transport, why = "ring", "forced"
+    elif not inputs["same_box"]:
+        # cross-box workers share no page cache: the spool (a shared
+        # filesystem) is the only coherent medium
+        transport, why = "fleet_dir", "cross-box"
+    else:
+        transport, why = "ring", "same-box"
+    reasons.append(why)
+    spool_sync = inputs["spool_requested"]
+    if spool_sync not in ("batched", "every"):
+        spool_sync = "batched"
+        reasons.append("spool-auto-batched")
+    return dict(transport=transport, spool_sync=spool_sync,
+                reason="+".join(reasons), inputs=inputs,
+                input_digest=_digest(inputs))
+
+
+def decide_shard_entry(*, kind: str, requested: str,
+                       index_available: bool) -> dict:
+    """How a shard's range reader enters the input — PURE.
+
+    ``entry`` ∈ ``rowgroup`` (Parquet native range skip) / ``index``
+    (SAM byte offsets / BAM BGZF virtual offsets: seek to the unit
+    range) / ``forward`` (decode from row 0 — the honest re-decode
+    fallback when no index exists or the caller forces it).  Recorded
+    in full by ``shard_entry_selected``; tools/check_executor.py
+    replays it.
+    """
+    inputs = dict(kind=str(kind), requested=str(requested),
+                  index_available=bool(index_available))
+    if inputs["kind"] not in ("sam", "bam"):
+        entry, reason = "rowgroup", "parquet-native-range"
+    elif inputs["requested"] == "forward":
+        entry, reason = "forward", "forced"
+    elif not inputs["index_available"]:
+        entry, reason = "forward", "no-index"
+    else:
+        entry, reason = "index", ("forced" if inputs["requested"]
+                                  == "index" else "index-available")
+    return dict(entry=entry, reason=reason, inputs=inputs,
+                input_digest=_digest(inputs))
+
+
+def probe_mmap(directory: str) -> bool:
+    """Whether ``directory``'s filesystem takes a shared writable mmap
+    (some network filesystems refuse) — the capability input
+    ``decide_transport`` consumes."""
+    path = os.path.join(directory, ".ring_probe")
+    try:
+        with open(path, "wb") as f:
+            f.truncate(mmap.PAGESIZE)
+        with open(path, "r+b") as f:
+            m = mmap.mmap(f.fileno(), mmap.PAGESIZE)
+            m[0:1] = b"\x01"
+            m.close()
+        return True
+    except (OSError, ValueError):
+        return False
+    finally:
+        try:
+            os.unlink(path)
+        except OSError:
+            pass
+
+
+# ---------------------------------------------------------------------------
+# Arrow-IPC segment payloads
+# ---------------------------------------------------------------------------
+
+def encode_unit_results(results: Sequence[Tuple[int, Dict[str, "np.ndarray"]]]
+                        ) -> bytes:
+    """Unit results -> one Arrow IPC stream: a ``units`` int64 column
+    plus one binary column per result key (raw array bytes; dtype and
+    shape ride the field metadata).  Keys sort so the frame layout is
+    deterministic for a given result set."""
+    import pyarrow as pa
+
+    fields = [pa.field("units", pa.int64())]
+    cols = [pa.array([int(u) for u, _ in results], pa.int64())]
+    for key in sorted(results[0][1]):
+        arrs = [np.ascontiguousarray(r[key]) for _, r in results]
+        meta = {b"dtype": str(arrs[0].dtype).encode(),
+                b"shape": json.dumps(list(arrs[0].shape)).encode()}
+        fields.append(pa.field(key, pa.binary(), metadata=meta))
+        cols.append(pa.array([a.tobytes() for a in arrs], pa.binary()))
+    batch = pa.record_batch(cols, schema=pa.schema(fields))
+    sink = pa.BufferOutputStream()
+    with pa.ipc.new_stream(sink, batch.schema) as w:
+        w.write_batch(batch)
+    return sink.getvalue().to_pybytes()
+
+
+def decode_unit_results(payload: bytes
+                        ) -> List[Tuple[int, Dict[str, "np.ndarray"]]]:
+    """Inverse of :func:`encode_unit_results`."""
+    import pyarrow as pa
+
+    with pa.ipc.open_stream(pa.py_buffer(payload)) as r:
+        table = r.read_all()
+    units = [int(u) for u in table.column("units").to_pylist()]
+    out: List[Tuple[int, Dict[str, np.ndarray]]] = \
+        [(u, {}) for u in units]
+    for field in table.schema:
+        if field.name == "units":
+            continue
+        dtype = np.dtype(field.metadata[b"dtype"].decode())
+        shape = tuple(json.loads(field.metadata[b"shape"].decode()))
+        for row, raw in enumerate(table.column(field.name).to_pylist()):
+            out[row][1][field.name] = np.frombuffer(
+                raw, dtype=dtype).reshape(shape)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# the ring itself
+# ---------------------------------------------------------------------------
+
+class RingWriter:
+    """Single-producer ring: the worker appends framed segments and
+    publishes them through the seqlock'd commit cursor.  A full ring
+    stops publishing (``full``; the ``ring_full`` counter records it) —
+    the durable spool carries everything regardless, so capacity is a
+    perf cliff, never a correctness one."""
+
+    def __init__(self, path: str, capacity: int, shard: int,
+                 incarnation: int):
+        self.path = path
+        self.capacity = max(int(capacity), HEADER_BYTES + _SEG.size)
+        self.full = False
+        self.bytes_written = 0
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(path, "wb") as f:
+            f.truncate(self.capacity)
+        self._f = open(path, "r+b")
+        self._m = mmap.mmap(self._f.fileno(), self.capacity)
+        self._m[0:8] = _MAGIC
+        struct.pack_into("<I", self._m, _HDR_CAP_OFF, self.capacity)
+        struct.pack_into("<I", self._m, _HDR_SHARD_OFF, int(shard))
+        struct.pack_into("<I", self._m, _HDR_INC_OFF, int(incarnation))
+        struct.pack_into("<Q", self._m, _HDR_COMMIT_OFF, HEADER_BYTES)
+        struct.pack_into("<I", self._m, _HDR_SEQLOCK_OFF, 0)
+        self._end = HEADER_BYTES
+
+    def publish(self, seq: int, results) -> bool:
+        """Append one segment; True when it landed in the ring."""
+        if self.full:
+            return False
+        payload = encode_unit_results(results)
+        need = _SEG.size + _pad8(len(payload))
+        if self._end + need > self.capacity:
+            self.full = True
+            obs.registry().counter("ring_full").inc()
+            return False
+        off = self._end
+        _SEG.pack_into(self._m, off, _SEG_MAGIC, int(seq),
+                       len(results), len(payload),
+                       zlib.crc32(payload) & 0xFFFFFFFF)
+        body = off + _SEG.size
+        half = len(payload) // 2
+        self._m[body:body + half] = payload[:half]
+        # the torn-segment chaos cell: a 'kill' fault here leaves the
+        # frame header claiming a length+crc the half-written payload
+        # cannot satisfy — exactly the torn state readers must detect
+        faults.fire("ring_write", path=self.path)
+        self._m[body + half:body + len(payload)] = payload[half:]
+        new_end = off + need
+        lock, = struct.unpack_from("<I", self._m, _HDR_SEQLOCK_OFF)
+        struct.pack_into("<I", self._m, _HDR_SEQLOCK_OFF, lock + 1)
+        struct.pack_into("<Q", self._m, _HDR_COMMIT_OFF, new_end)
+        struct.pack_into("<I", self._m, _HDR_SEQLOCK_OFF, lock + 2)
+        self._end = new_end
+        self.bytes_written += need
+        obs.registry().counter("ring_bytes").inc(need)
+        obs.registry().counter("ring_segments").inc()
+        return True
+
+    def close(self) -> None:
+        try:
+            self._m.close()
+            self._f.close()
+        except OSError:
+            pass
+
+
+class RingReader:
+    """The supervisor's side: poll for newly committed segments, and
+    probe past the cursor for the torn tail a killed writer leaves."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._f = open(path, "rb")
+        size = os.fstat(self._f.fileno()).st_size
+        self._m = mmap.mmap(self._f.fileno(), size,
+                            access=mmap.ACCESS_READ)
+        if self._m[0:8] != _MAGIC:
+            self.close()
+            raise ValueError(f"{path}: not a ring file")
+        self.capacity, = struct.unpack_from("<I", self._m, _HDR_CAP_OFF)
+        self.shard, = struct.unpack_from("<I", self._m, _HDR_SHARD_OFF)
+        self.incarnation, = struct.unpack_from("<I", self._m,
+                                               _HDR_INC_OFF)
+        self._pos = HEADER_BYTES
+        self.torn = 0
+
+    def _committed(self) -> int:
+        """Seqlock read: retry while the writer is mid-publish."""
+        for _ in range(64):
+            s1, = struct.unpack_from("<I", self._m, _HDR_SEQLOCK_OFF)
+            if s1 & 1:
+                continue
+            committed, = struct.unpack_from("<Q", self._m,
+                                            _HDR_COMMIT_OFF)
+            s2, = struct.unpack_from("<I", self._m, _HDR_SEQLOCK_OFF)
+            if s1 == s2:
+                return committed
+        return self._pos                    # writer died mid-publish
+
+    def _frame_at(self, off: int, limit: int):
+        """(seq, n_units, payload, end) for a VALID frame at ``off``,
+        else None (torn / not a frame)."""
+        if off + _SEG.size > limit:
+            return None
+        magic, seq, n_units, plen, crc = _SEG.unpack_from(self._m, off)
+        end = off + _SEG.size + _pad8(plen)
+        if magic != _SEG_MAGIC or end > limit:
+            return None
+        payload = self._m[off + _SEG.size:off + _SEG.size + plen]
+        if (zlib.crc32(payload) & 0xFFFFFFFF) != crc:
+            return None
+        return seq, n_units, payload, end
+
+    def poll(self) -> List[Tuple[int, int, bytes]]:
+        """New ``(seq, n_units, payload)`` entries committed since the
+        last poll.  A corrupt frame inside the committed region (never
+        produced by a correct writer) poisons the rest of this ring:
+        counted in ``torn`` and never re-read."""
+        out: List[Tuple[int, int, bytes]] = []
+        committed = min(self._committed(), self.capacity)
+        while self._pos < committed:
+            frame = self._frame_at(self._pos, committed)
+            if frame is None:
+                self.torn += 1
+                self._pos = committed
+                break
+            seq, n_units, payload, end = frame
+            out.append((seq, n_units, payload))
+            self._pos = end
+        return out
+
+    def scan_tail(self) -> int:
+        """1 when an unpublished/torn segment sits past the commit
+        cursor (the SIGKILL-mid-write residue), else 0.  Call after the
+        writer is known dead — a live writer's in-flight segment looks
+        identical, by design."""
+        committed = min(self._committed(), self.capacity)
+        if committed + _SEG.size > self.capacity:
+            return 0
+        magic, _, _, plen, _ = _SEG.unpack_from(self._m, committed)
+        return 1 if magic == _SEG_MAGIC else 0
+
+    def close(self) -> None:
+        try:
+            self._m.close()
+            self._f.close()
+        except OSError:
+            pass
+
+
+# ---------------------------------------------------------------------------
+# unit claim table (exactly-once stealing)
+# ---------------------------------------------------------------------------
+
+def claim_unit(fleet_dir: str, unit: int, shard: int,
+               incarnation: int) -> bool:
+    """Claim ``unit`` for ``shard`` — atomic via ``O_EXCL`` create, the
+    same one-winner primitive as the commit-file discipline.  False
+    when another worker already holds the claim.  The EXISTENCE of the
+    claim file is the decision; the owner doc inside is published by a
+    tmp+replace second step, so a crash between the two leaves an
+    empty claim that reads as unclaimed (``claim_owner`` -> None) —
+    the victim then recomputes the unit, which risks only duplicate
+    WORK; the merge's first-wins dedup keeps the count exact."""
+    path = os.path.join(fleet_dir, CLAIM_DIR, f"unit{unit}.json")
+    try:
+        fd = os.open(path, os.O_CREAT | os.O_EXCL | os.O_WRONLY, 0o644)
+    except FileExistsError:
+        return False
+    except OSError:
+        return False
+    os.close(fd)
+    tmp = f"{path}.tmp{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(dict(shard=int(shard),
+                       incarnation=int(incarnation)), f)
+    os.replace(tmp, path)
+    return True
+
+
+def claim_owner(fleet_dir: str, unit: int) -> Optional[dict]:
+    """The claim doc for ``unit`` (None = unclaimed or unreadable —
+    an in-flight create reads as unclaimed, which only risks duplicate
+    WORK, never a duplicate count)."""
+    path = os.path.join(fleet_dir, CLAIM_DIR, f"unit{unit}.json")
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, ValueError):
+        return None
+    return doc if isinstance(doc, dict) else None
+
+
+def release_shard_claims(fleet_dir: str, shard: int,
+                         keep_units) -> int:
+    """Drop every claim owned by ``shard`` whose unit is NOT in
+    ``keep_units`` (the committed set) — called by the supervisor when
+    a claimant dies, so its victims recompute the released units on
+    their next drain pass.  Returns claims released."""
+    import glob as _glob
+    n = 0
+    for path in _glob.glob(os.path.join(fleet_dir, CLAIM_DIR,
+                                        "unit*.json")):
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+            unit = int(os.path.basename(path)[4:-5])
+        except (OSError, ValueError):
+            continue
+        if isinstance(doc, dict) and int(doc.get("shard", -1)) == \
+                int(shard) and unit not in keep_units:
+            try:
+                os.unlink(path)
+                n += 1
+            except OSError:
+                pass
+    return n
+
+
+# ---------------------------------------------------------------------------
+# broadcast-blob cache (map once per worker process)
+# ---------------------------------------------------------------------------
+
+_BLOB_CACHE: Dict[Tuple[str, int, int], object] = {}
+
+
+def _blob_key(path: str) -> Tuple[str, int, int]:
+    st = os.stat(path)
+    return (os.path.abspath(path), st.st_mtime_ns, st.st_size)
+
+
+def load_broadcast_array(path: str) -> "np.ndarray":
+    """A broadcast ``.npy`` blob mapped read-only, memoized per process
+    by (path, mtime, size): N shard loads in one worker open (and map)
+    the file once.  ``broadcast_blob_opens`` counts REAL opens — the
+    open-once pin tests/test_shardstream.py holds."""
+    key = _blob_key(path)
+    got = _BLOB_CACHE.get(key)
+    if got is None:
+        obs.registry().counter("broadcast_blob_opens").inc()
+        got = np.load(path, mmap_mode="r")
+        _BLOB_CACHE[key] = got
+    return got
+
+
+def load_broadcast_npz(path: str) -> Dict[str, "np.ndarray"]:
+    """A broadcast ``.npz`` blob's arrays, memoized like
+    :func:`load_broadcast_array` (materialized once so the zip handle
+    closes; the arrays themselves are shared thereafter)."""
+    key = _blob_key(path)
+    got = _BLOB_CACHE.get(key)
+    if got is None:
+        obs.registry().counter("broadcast_blob_opens").inc()
+        with np.load(path) as z:
+            got = {k: z[k] for k in z.files}
+        _BLOB_CACHE[key] = got
+    return got

@@ -52,6 +52,28 @@ it must traverse to reach its remaining range, and those bytes land in
 the I/O ledger (per-worker sidecars; the supervisor's fold sums them)
 — never silently absorbed.
 
+The DATA PLANE (ROADMAP item 3's zero-copy slice) rides three pure,
+replayable decisions on top of that spine:
+
+* ``ringplane.decide_transport`` (event ``transport_selected``) —
+  same-box fleets carry unit results over a shared-memory mmap ring
+  (Arrow-IPC segments, seqlock commit cursor, torn-segment detection;
+  parallel/ringplane.py) while the filesystem spool REMAINS the
+  durable spine: the npz commit renames before the ring publish, so
+  ring contents are always a subset of the spool and the crash
+  contract is untouched.  ``spool_sync=batched`` drops the spool to
+  ONE directory fsync per commit window (ordered-journal rename
+  ordering keeps commit-before-marker durable).
+* ``ringplane.decide_shard_entry`` (event ``shard_entry_selected``) —
+  SAM byte offsets / BAM BGZF virtual offsets (``io/sam.scan_sam_units``
+  / ``io/bam.scan_bam_units``) let a shard SEEK to its unit range
+  instead of forward-decoding from row 0; the honest re-decode bytes
+  collapse to ~0 and the ledger charges only what was read.
+* unit-granular stealing (``FleetPolicy.steal``, event ``unit_stolen``)
+  — an idle survivor claims single pending units off the claim table
+  (``O_EXCL`` create, one winner) so a straggler's tail drains without
+  a lease expiry; the merge dedup stays the correctness backstop.
+
 tools/check_metrics.py validates the event schemas;
 tools/check_executor.py replays every plan/reassignment decision;
 tests/test_shardstream.py pins the chaos matrix (SIGKILL / latency /
@@ -79,6 +101,7 @@ from ..checkpoint import fsync_dir as _fsync_dir
 from ..resilience import faults
 from ..resilience.retry import (RETRY_SEED_ENV, FleetPolicy,
                                 resolve_fleet_policy)
+from . import ringplane
 
 #: fleet-dir layout (every path is relative to the fleet dir)
 PLAN_FILE = "plan.json"
@@ -119,10 +142,10 @@ def _from_runs(runs: Sequence[Sequence[int]]) -> List[int]:
     return out
 
 
-def _write_json(path: str, doc: dict, fault_site: Optional[str] = None
-                ) -> None:
+def _write_json(path: str, doc: dict, fault_site: Optional[str] = None,
+                fsync: bool = True) -> None:
     atomic_write(path, json.dumps(doc, sort_keys=True),
-                 fault_site=fault_site)
+                 fault_site=fault_site, fsync=fsync)
 
 
 def _read_json(path: str) -> Optional[dict]:
@@ -318,11 +341,23 @@ def _emit_reassigned(cause: str, d: dict, **extra) -> None:
 # input sizing + range readers (the locality-aware map side)
 # ---------------------------------------------------------------------------
 
+def _input_kind(path: str) -> str:
+    """'sam' / 'bam' / 'parquet' — the shard-entry taxonomy."""
+    p = str(path)
+    if p.endswith(".sam"):
+        return "sam"
+    if p.endswith(".bam"):
+        return "bam"
+    return "parquet"
+
+
 def count_input_rows(path: str) -> int:
     """Total reads in the input — exact.  Parquet: footer sums (free).
-    SAM: a byte scan counting record lines (no field parse).  BAM: a
-    full decode walk (documented cost; the fleet plan needs the row
-    count once, and the supervisor pays it, not every worker)."""
+    SAM: a byte scan counting record lines (no field parse).  BAM:
+    a BGZF length-walk (``io/bam.scan_bam_units`` — inflate + hop
+    ``block_size`` fields, no Arrow rows); non-BGZF BAM falls back to
+    the full decode walk (documented cost; the fleet plan needs the
+    row count once, and the supervisor pays it, not every worker)."""
     p = str(path)
     if p.endswith(".sam"):
         n = 0
@@ -332,6 +367,10 @@ def count_input_rows(path: str) -> int:
                     n += 1
         return n
     if p.endswith(".bam"):
+        from ..io.bam import scan_bam_units
+        scanned = scan_bam_units(p)
+        if scanned is not None:
+            return int(scanned["total_rows"])
         from ..io.stream import open_read_stream
         return sum(t.num_rows for t in
                    open_read_stream(p, columns=["flags"],
@@ -381,6 +420,36 @@ def unit_bins_for(path: str, unit_rows: int, n_units: int,
                                                np.maximum(starts, 0))]
     except Exception:  # noqa: BLE001 — locality is a hint, never fatal
         return None
+
+
+def build_unit_index(input_path: str, unit_rows: int) -> Optional[dict]:
+    """The shard-entry index for a SAM/BAM input: per-unit seek targets
+    (SAM byte offsets; BAM BGZF virtual offsets), built by one cheap
+    byte/length walk at plan time.  None when no index is possible —
+    non-BGZF BAM, a SAM whose body lazily registers record groups
+    (entry order would change ``recordGroupId`` assignment), or a
+    Parquet input (row-group skip needs no index)."""
+    p = str(input_path)
+    try:
+        if p.endswith(".sam"):
+            from ..io.sam import scan_sam_units
+            scanned = scan_sam_units(p, unit_rows)
+            if not scanned["safe"]:
+                return None
+            return dict(kind="sam", unit_rows=int(unit_rows),
+                        total_rows=int(scanned["total_rows"]),
+                        offsets=scanned["offsets"])
+        if p.endswith(".bam"):
+            from ..io.bam import scan_bam_units
+            scanned = scan_bam_units(p, unit_rows)
+            if scanned is None:
+                return None
+            return dict(kind="bam", unit_rows=int(unit_rows),
+                        total_rows=int(scanned["total_rows"]),
+                        voffs=scanned["voffs"])
+    except OSError:
+        return None
+    return None
 
 
 def _rebatch_units(tables, first_unit: int, unit_rows: int):
@@ -460,15 +529,20 @@ def _parquet_range_tables(path: str, row_lo: int, row_hi: int,
 
 def _unit_tables(path: str, units: Sequence[int], unit_rows: int,
                  columns: Optional[Sequence[str]], io_kind: str,
-                 io_pass: str, io_procs: int = 1):
+                 io_pass: str, io_procs: int = 1,
+                 entry: str = "forward", index: Optional[dict] = None):
     """(unit_id, table) pairs for the requested units, contiguous run
     by contiguous run.
 
-    Parquet: row-group skip — only overlapping groups decode.  SAM/BAM:
-    one forward stream per worker; rows before the shard's first unit
-    are decoded-and-skipped (there is no record index to seek by), and
-    that traversal is counted by the stream opener's ledger hook — the
-    honest re-decode cost of recovery on text/BGZF inputs."""
+    Parquet: row-group skip — only overlapping groups decode.  SAM/BAM
+    with ``entry="index"`` and a unit index (:func:`build_unit_index`):
+    the reader SEEKS to each run's first unit (SAM byte offset / BAM
+    BGZF virtual offset) and decodes only the run — the ledger charges
+    the bytes actually inflated, which is the ~0-re-decode payoff.
+    Otherwise one forward stream per worker: rows before the shard's
+    first unit are decoded-and-skipped, and that traversal is counted
+    by the stream opener's ledger hook — the honest re-decode cost of
+    recovery on unindexed text/BGZF inputs."""
     units = sorted(set(int(u) for u in units))
     if not units:
         return
@@ -480,6 +554,29 @@ def _unit_tables(path: str, units: Sequence[int], unit_rows: int,
                 _parquet_range_tables(p, lo * unit_rows, hi * unit_rows,
                                       columns, io_kind, io_pass),
                 lo, unit_rows)
+        return
+    if entry == "index" and index is not None:
+        def on_bytes(n: int) -> None:
+            obs.ioledger.record(io_kind, int(n), io_pass)
+
+        cols = list(columns) if columns else None
+        for lo, hi in runs:
+            if p.endswith(".sam"):
+                from ..io.sam import open_sam_stream_at
+                _sd, _rg, stream = open_sam_stream_at(
+                    p, int(index["offsets"][lo]), chunk_rows=unit_rows,
+                    on_bytes=on_bytes)
+            else:
+                from ..io.bam import open_bam_stream_at
+                moff, intra = index["voffs"][lo]
+                _sd, _rg, stream = open_bam_stream_at(
+                    p, int(moff), int(intra), chunk_rows=unit_rows,
+                    io_procs=io_procs, on_bytes=on_bytes)
+            projected = (t.select(cols) if cols else t for t in stream)
+            for unit, table in _rebatch_units(projected, lo, unit_rows):
+                yield unit, table
+                if unit >= hi - 1:
+                    break
         return
     from ..io.stream import open_read_stream
 
@@ -603,13 +700,17 @@ def _bqsr_runtime(spec: dict):
     unit_rows = int(spec["unit_rows"])
     fleet_dir = spec["fleet_dir"]
 
+    # broadcast blobs map ONCE per worker process (ringplane's memo):
+    # N shard incarnations in one process share the read-only mapping
+    # instead of re-reading the blob per shard
     dup = None
     if params.get("has_dup"):
-        dup = np.load(os.path.join(fleet_dir, "dup.npy"),
-                      mmap_mode="r")
+        dup = ringplane.load_broadcast_array(
+            os.path.join(fleet_dir, "dup.npy"))
     mdstore = None
     if params.get("has_md"):
-        z = np.load(os.path.join(fleet_dir, "md.npz"))
+        z = ringplane.load_broadcast_npz(
+            os.path.join(fleet_dir, "md.npz"))
         mdstore = _MdEventStore()
         mdstore.has_md = z["has_md"]
         mdstore.ev_rows = z["ev_rows"]
@@ -755,17 +856,21 @@ class Heartbeat:
 
 
 def _commit_unit_results(fleet_dir: str, shard: int, incarnation: int,
-                         seq: int, results: List[Tuple[int, dict]]
-                         ) -> str:
+                         seq: int, results: List[Tuple[int, dict]],
+                         fsync: bool = True) -> str:
     """One immutable commit file: unit ids + their result arrays,
-    written tmp+rename (never torn).  Returns the committed path."""
+    written tmp+rename (never torn).  ``fsync=False`` is the batched
+    spool: the caller fsyncs the commit DIRECTORY once per window
+    instead (see ``run_shard_worker.flush``).  Returns the committed
+    path."""
     arrays: Dict[str, np.ndarray] = {
         "units": np.array([u for u, _ in results], np.int64)}
     for key in results[0][1]:
         arrays[key] = np.stack([r[key] for _, r in results])
     path = os.path.join(fleet_dir, COMMIT_DIR,
                         f"shard{shard}-inc{incarnation}-{seq:06d}.npz")
-    return atomic_np_write(path, lambda f: np.savez(f, **arrays))
+    return atomic_np_write(path, lambda f: np.savez(f, **arrays),
+                           fsync=fsync)
 
 
 def run_shard_worker(fleet_dir: str, shard: int) -> int:
@@ -807,15 +912,45 @@ def run_shard_worker(fleet_dir: str, shard: int) -> int:
     columns, io_kind, io_pass = _task_io(spec)
     unit_rows = int(spec["unit_rows"])
     commit_every = max(int(spec.get("commit_every", 1)), 1)
+    entry = str(spec.get("entry", "forward"))
+    unit_index = spec.get("unit_index")
+    batched = spec.get("spool_sync") == "batched"
+    steal_on = bool(spec.get("policy", {}).get("steal"))
+    ring = None
+    if spec.get("transport") == "ring":
+        ring = ringplane.RingWriter(
+            os.path.join(fleet_dir, ringplane.RING_DIR,
+                         f"shard{shard}-inc{my_inc}.ring"),
+            int(spec.get("ring_bytes")
+                or ringplane.DEFAULT_RING_BYTES), shard, my_inc)
     seq = 0
     pending: List[Tuple[int, dict]] = []
+    mine = set(units)
 
     def flush() -> None:
         nonlocal seq
         if not pending:
             return
         seq += 1
-        _commit_unit_results(fleet_dir, shard, my_inc, seq, pending)
+        # the durable spine FIRST: the npz rename precedes the ring
+        # publish, so ring contents are always a subset of the spool.
+        # Batched spool: no per-file fsyncs; ONE commit-dir fsync per
+        # window (ordered-journal renames become durable in order, so
+        # commit-before-marker still holds), then the marker rename
+        # rides un-fsynced.  Per-flush fsyncs: 1 batched vs 4 every
+        # (commit file+dir, marker file+dir) — spool_fsyncs records it.
+        path = _commit_unit_results(fleet_dir, shard, my_inc, seq,
+                                    pending, fsync=not batched)
+        if batched:
+            _fsync_dir(os.path.join(fleet_dir, COMMIT_DIR))
+        obs.registry().counter("spool_fsyncs").inc(1 if batched else 4)
+        try:
+            obs.registry().counter("spool_bytes").inc(
+                os.path.getsize(path))
+        except OSError:
+            pass
+        if ring is not None:
+            ring.publish(seq, pending)
         done_units.update(u for u, _ in pending)
         pending.clear()
         # marker AFTER the commit file: a crash between them only
@@ -825,17 +960,58 @@ def run_shard_worker(fleet_dir: str, shard: int) -> int:
         _write_json(progress_path,
                     dict(done_runs=_to_runs(sorted(done_units)),
                          incarnation=my_inc),
-                    fault_site="checkpoint_write")
+                    fault_site="checkpoint_write", fsync=not batched)
+
+    def _claimed_elsewhere(unit: int) -> bool:
+        doc = ringplane.claim_owner(fleet_dir, unit)
+        return doc is not None and int(doc.get("shard", -1)) != shard
 
     def process(unit_ids: Sequence[int]) -> None:
         todo = [u for u in unit_ids if u not in done_units]
+        if steal_on:
+            # a thief already claimed these tail units; skipping them
+            # is advisory (merge dedup is the backstop) — the drain
+            # loop re-checks in case the thief dies and its claims are
+            # released by the supervisor
+            todo = [u for u in todo if not _claimed_elsewhere(u)]
         for unit, table in _unit_tables(
                 spec["input"], todo, unit_rows, columns, io_kind,
-                io_pass, io_procs=int(spec.get("io_procs", 1))):
+                io_pass, io_procs=int(spec.get("io_procs", 1)),
+                entry=entry, index=unit_index):
             pending.append((unit, unit_result(unit, table)))
             if len(pending) >= commit_every:
                 flush()
         flush()
+
+    def steal_once() -> Optional[int]:
+        """Claim ONE pending unit from another shard's tail (O_EXCL
+        create = one winner).  None when nothing is stealable."""
+        for apath in sorted(_glob.glob(os.path.join(
+                fleet_dir, ASSIGN_DIR, "shard*.json"))):
+            victim = int(os.path.basename(apath)[5:-5])
+            if victim == shard:
+                continue
+            a = _read_json(apath) or {}
+            theirs = set(_from_runs(a.get("runs", [])))
+            e = _read_json(os.path.join(fleet_dir, EXTRA_DIR,
+                                        f"shard{victim}.json")) or {}
+            theirs |= set(_from_runs(e.get("runs", [])))
+            vprog = _read_json(os.path.join(
+                fleet_dir, PROGRESS_DIR, f"shard{victim}.json")) or {}
+            theirs -= set(_from_runs(vprog.get("done_runs", [])))
+            theirs -= done_units
+            # tail first: the victim works head-first, so the tail is
+            # the least likely to be in flight on its side
+            for u in sorted(theirs, reverse=True):
+                if ringplane.claim_owner(fleet_dir, u) is not None:
+                    continue
+                if ringplane.claim_unit(fleet_dir, u, shard, my_inc):
+                    obs.registry().counter("unit_steals").inc()
+                    obs.emit("unit_stolen", unit=int(u),
+                             victim=victim, thief=shard,
+                             incarnation=my_inc)
+                    return u
+        return None
 
     try:
         process(units)
@@ -866,10 +1042,24 @@ def run_shard_worker(fleet_dir: str, shard: int) -> int:
             extra = _read_json(extra_path) or {}
             if int(extra.get("version", 0)) > seen_version:
                 seen_version = int(extra["version"])
-                process(_from_runs(extra.get("runs", [])))
+                new_units = _from_runs(extra.get("runs", []))
+                mine.update(new_units)
+                process(new_units)
+            if steal_on:
+                stolen = steal_once()
+                if stolen is not None:
+                    process([stolen])
+                    continue        # keep pulling while there is work
+                if ticks % 20 == 0:
+                    # a thief that claimed OUR tail may have died; the
+                    # supervisor releases its claims, and this sweep
+                    # recomputes whatever came back (no-op otherwise)
+                    process(sorted(mine - done_units))
             time.sleep(0.05)
     finally:
         hb.stop()
+        if ring is not None:
+            ring.close()
         ex.finish()
         obs.ioledger.emit_events()
     return 0
@@ -966,6 +1156,13 @@ class ShardSupervisor:
         self.all_units = list(range(plan["n_units"]))
         self._commit_units: Dict[str, List[int]] = {}
         self._dups = 0
+        #: ring transport state: one reader per ring file, decoded
+        #: segments keyed (incarnation, shard, seq) — the SAME key as
+        #: the npz commit files, because a segment and its npz twin are
+        #: one commit, not a duplicate
+        self._ring_readers: Dict[str, "ringplane.RingReader"] = {}
+        self._ring_results: Dict[Tuple[int, int, int],
+                                 List[Tuple[int, dict]]] = {}
 
     # -- spawn -------------------------------------------------------------
 
@@ -1022,15 +1219,55 @@ class ShardSupervisor:
 
     # -- commit scanning ---------------------------------------------------
 
+    def _poll_rings(self) -> None:
+        """Drain newly committed ring segments into ``_ring_results``.
+        A ring file that does not parse yet (the writer is mid-create)
+        is retried next poll; a payload that fails to decode counts as
+        torn and is skipped — the npz twin on the spool covers it."""
+        if self.spec.get("transport") != "ring":
+            return
+        for path in sorted(_glob.glob(os.path.join(
+                self.fleet_dir, ringplane.RING_DIR, "*.ring"))):
+            rd = self._ring_readers.get(path)
+            if rd is None:
+                try:
+                    rd = ringplane.RingReader(path)
+                except (OSError, ValueError):
+                    continue
+                self._ring_readers[path] = rd
+            for seq, _n, payload in rd.poll():
+                try:
+                    results = ringplane.decode_unit_results(payload)
+                except Exception:  # noqa: BLE001 — torn, spool covers
+                    obs.registry().counter("ring_torn_segments").inc()
+                    continue
+                self._ring_results[(rd.incarnation, rd.shard,
+                                    int(seq))] = results
+
     def _scan_commits(self) -> Dict[int, Tuple]:
         """unit -> (sort_key, path, row) for the winning commit of each
         unit (first by (incarnation, shard, seq) — deterministic, and
-        value-irrelevant: unit results are exact monoids).  Commit
-        files are immutable once renamed, so parses cache."""
+        value-irrelevant: unit results are exact monoids).  ``path`` is
+        None for a ring-delivered commit (its arrays sit decoded in
+        ``_ring_results``); a ring segment's npz twin shares its key
+        and is skipped WITHOUT an np.load — the zero-copy payoff on the
+        supervisor side.  Commit files are immutable once renamed, so
+        parses cache."""
+        self._poll_rings()
         best: Dict[int, Tuple] = {}
         self._dups = 0
+        entries: List[Tuple[Tuple[int, int, int], Optional[str],
+                            List[int]]] = []
+        for key, results in self._ring_results.items():
+            entries.append((key, None, [u for u, _ in results]))
+        ring_keys = set(self._ring_results)
         for path in sorted(_glob.glob(os.path.join(
                 self.fleet_dir, COMMIT_DIR, "*.npz"))):
+            name = os.path.basename(path)[:-4]
+            s, i, q = name.split("-")
+            key = (int(i[3:]), int(s[5:]), int(q))
+            if key in ring_keys:
+                continue        # the ring already delivered this commit
             if path not in self._commit_units:
                 try:
                     with np.load(path) as z:
@@ -1038,10 +1275,10 @@ class ShardSupervisor:
                             [int(u) for u in z["units"]]
                 except (OSError, ValueError, KeyError, EOFError):
                     continue        # in-flight or torn: ignore
-            name = os.path.basename(path)[:-4]
-            s, i, q = name.split("-")
-            key = (int(i[3:]), int(s[5:]), int(q))
-            for row, unit in enumerate(self._commit_units[path]):
+            entries.append((key, path, self._commit_units[path]))
+        for key, path, units in sorted(entries,
+                                       key=lambda e: e[0]):
+            for row, unit in enumerate(units):
                 if unit in best:
                     self._dups += 1
                     if key >= best[unit][0]:
@@ -1071,6 +1308,40 @@ class ShardSupervisor:
                 pass
         obs.registry().counter("shard_deaths",
                                code=error_code).inc()
+        if self.spec.get("transport") == "ring":
+            # the writer is dead (fenced above), so the tail is stable:
+            # drain what it committed, then count a torn in-flight
+            # segment if the kill landed mid-publish.  Torn segments
+            # are DETECTED AND IGNORED — the npz spool is the spine.
+            path = os.path.join(
+                self.fleet_dir, ringplane.RING_DIR,
+                f"shard{st.shard}-inc{st.incarnation}.ring")
+            rd = self._ring_readers.get(path)
+            if rd is None and os.path.exists(path):
+                try:
+                    rd = ringplane.RingReader(path)
+                    self._ring_readers[path] = rd
+                except (OSError, ValueError):
+                    rd = None
+            if rd is not None:
+                for seq, _n, payload in rd.poll():
+                    try:
+                        self._ring_results[
+                            (rd.incarnation, rd.shard, int(seq))] = \
+                            ringplane.decode_unit_results(payload)
+                    except Exception:  # noqa: BLE001
+                        obs.registry().counter(
+                            "ring_torn_segments").inc()
+                torn = rd.scan_tail()
+                if torn:
+                    obs.registry().counter(
+                        "ring_torn_segments").inc(torn)
+        if self.policy.steal:
+            # claims the dead shard took as a THIEF would otherwise pin
+            # their units forever (nobody else will touch a claimed
+            # unit while its owner's claim file exists)
+            ringplane.release_shard_claims(
+                self.fleet_dir, st.shard, set(committed))
         remaining = sorted(
             (set(_from_runs(st.runs)) | set(st.extra_units))
             - set(committed))
@@ -1195,8 +1466,13 @@ class ShardSupervisor:
                 f"fleet dir {self.fleet_dir!r} belongs to a different "
                 "run (input/unit plan changed); delete it or use "
                 "another -fleet_dir")
-        for d in (ASSIGN_DIR, EXTRA_DIR, LEASE_DIR, PROGRESS_DIR,
-                  COMMIT_DIR, LOG_DIR):
+        dirs = [ASSIGN_DIR, EXTRA_DIR, LEASE_DIR, PROGRESS_DIR,
+                COMMIT_DIR, LOG_DIR]
+        if self.spec.get("transport") == "ring":
+            dirs.append(ringplane.RING_DIR)
+        if self.policy.steal:
+            dirs.append(ringplane.CLAIM_DIR)
+        for d in dirs:
             os.makedirs(os.path.join(self.fleet_dir, d), exist_ok=True)
         _write_json(os.path.join(self.fleet_dir, PLAN_FILE),
                     dict(self.spec,
@@ -1259,6 +1535,8 @@ class ShardSupervisor:
             for st in self.states.values():
                 if st.proc is not None and st.proc.poll() is None:
                     st.proc.kill()
+            for rd in self._ring_readers.values():
+                rd.close()
 
     # -- sidecar fold ------------------------------------------------------
 
@@ -1317,7 +1595,10 @@ def run_fleet(task: str, input_path: str, *, hosts: int,
               timeout_s: float = 900.0,
               locality: bool = True,
               worker_cpus: Optional[int] = None,
-              seed: Optional[Callable[[str], None]] = None
+              seed: Optional[Callable[[str], None]] = None,
+              transport: Optional[str] = None,
+              spool_sync: Optional[str] = None,
+              entry: Optional[str] = None
               ) -> Dict[str, np.ndarray]:
     """Run one sharded MapReduce workload to completion and return the
     merged (monoid-reduced) result arrays.
@@ -1326,10 +1607,21 @@ def run_fleet(task: str, input_path: str, *, hosts: int,
     the caller's telemetry run); workers are separate processes.  The
     fleet dir defaults to a temp dir removed on success; pass one to
     keep the plan/commit/lease audit trail.  ``commit_every`` batches
-    units per durable commit (each commit is 3 fsyncs — on a slow
-    filesystem per-unit commits can dominate small units); a coarser
-    cadence only widens what a preempted worker recomputes, never what
-    the run returns."""
+    units per durable commit; a coarser cadence only widens what a
+    preempted worker recomputes, never what the run returns.
+
+    ``transport`` ("auto"/"ring"/"fleet_dir", env
+    ``ADAM_TPU_FLEET_TRANSPORT``) picks how unit results travel:
+    same-box fleets default to the shared-memory ring
+    (``ringplane``), with the npz spool kept as the durable spine.
+    ``spool_sync`` ("auto"/"batched"/"every", env
+    ``ADAM_TPU_FLEET_SPOOL_SYNC``) batches the spool's fsyncs to one
+    per commit window when the ring carries delivery.  ``entry``
+    ("auto"/"index"/"forward", env ``ADAM_TPU_FLEET_ENTRY``) lets
+    SAM/BAM shards seek straight to their unit range via a prescan
+    index instead of forward-decoding from byte zero.  All three are
+    pure replayable decisions (``decide_transport`` /
+    ``decide_shard_entry``)."""
     import shutil
 
     policy = policy or resolve_fleet_policy()
@@ -1351,13 +1643,51 @@ def run_fleet(task: str, input_path: str, *, hosts: int,
         if own_dir:
             shutil.rmtree(fleet_dir, ignore_errors=True)
         return {}
+    td = ringplane.decide_transport(
+        requested=str(transport or os.environ.get(
+            ringplane.TRANSPORT_ENV, "auto")),
+        same_box=True,      # workers are subprocesses of this host
+        mmap_capable=ringplane.probe_mmap(fleet_dir),
+        spool_requested=str(spool_sync or os.environ.get(
+            ringplane.SPOOL_SYNC_ENV, "auto")))
+    obs.registry().counter("transport_decisions").inc()
+    obs.emit("transport_selected", transport=td["transport"],
+             spool_sync=td["spool_sync"], reason=td["reason"],
+             inputs=td["inputs"], input_digest=td["input_digest"])
+    kind = _input_kind(input_path)
+    entry_requested = str(entry or os.environ.get(
+        ringplane.ENTRY_ENV, "auto"))
+    unit_index = None
+    if kind in ("sam", "bam"):
+        # only-when-engaged: parquet inputs read native row groups and
+        # never emit a shard_entry decision, so existing sidecars and
+        # replay baselines are untouched
+        if entry_requested != "forward":
+            unit_index = build_unit_index(input_path, unit_rows)
+        ed = ringplane.decide_shard_entry(
+            kind=kind, requested=entry_requested,
+            index_available=unit_index is not None)
+        obs.emit("shard_entry_selected", entry=ed["entry"],
+                 reason=ed["reason"], inputs=ed["inputs"],
+                 input_digest=ed["input_digest"])
+    else:
+        ed = dict(entry="forward")
     spec = dict(task=task, input=os.path.abspath(input_path),
                 unit_rows=unit_rows, n_units=plan["n_units"],
                 total_rows=total_rows, params=params or {},
                 commit_every=int(commit_every),
                 io_procs=int(io_procs),
+                transport=td["transport"],
+                spool_sync=td["spool_sync"],
+                entry=ed["entry"],
                 policy=dict(heartbeat_s=policy.heartbeat_s,
-                            lease_ttl_s=policy.lease_ttl_s))
+                            lease_ttl_s=policy.lease_ttl_s,
+                            steal=policy.steal))
+    if td["transport"] == "ring":
+        spec["ring_bytes"] = int(os.environ.get(
+            ringplane.RING_BYTES_ENV, ringplane.DEFAULT_RING_BYTES))
+    if ed["entry"] == "index":
+        spec["unit_index"] = unit_index
     sup = ShardSupervisor(spec, plan, fleet_dir, policy, env=env,
                           timeout_s=timeout_s, worker_cpus=worker_cpus)
     t0 = time.perf_counter()
@@ -1390,11 +1720,18 @@ def _merge_commits(winners: Dict[int, Tuple], sup: ShardSupervisor
                    ) -> Dict[str, np.ndarray]:
     """Reduce: sum each unit's winning result arrays (exact integer
     monoid — the same fold order-independence the single-host chunk
-    accumulators rely on)."""
+    accumulators rely on).  A winner with ``path is None`` arrived via
+    the shared-memory ring and merges from the decoded segment — no
+    disk read at all."""
     acc: Dict[str, np.ndarray] = {}
     loaded: Dict[str, "np.lib.npyio.NpzFile"] = {}
     for unit in sorted(winners):
-        _, path, row = winners[unit]
+        ckey, path, row = winners[unit]
+        if path is None:
+            for key, arr in sup._ring_results[ckey][row][1].items():
+                arr = arr.astype(np.int64)
+                acc[key] = arr if key not in acc else acc[key] + arr
+            continue
         if path not in loaded:
             loaded[path] = np.load(path)
         z = loaded[path]
@@ -1416,7 +1753,10 @@ def fleet_flagstat(path: str, *, hosts: int,
                    commit_every: int = 1,
                    io_procs: int = 1,
                    timeout_s: float = 900.0,
-                   worker_cpus: Optional[int] = None):
+                   worker_cpus: Optional[int] = None,
+                   transport: Optional[str] = None,
+                   spool_sync: Optional[str] = None,
+                   entry: Optional[str] = None):
     """Sharded streaming flagstat: per-unit 18x2 counter blocks from N
     worker processes, summed — byte-identical to the single-host
     :func:`parallel.pipeline.streaming_flagstat` (counters are an exact
@@ -1428,7 +1768,9 @@ def fleet_flagstat(path: str, *, hosts: int,
                        unit_rows=unit_rows, fleet_dir=fleet_dir,
                        policy=policy, env=env,
                        commit_every=commit_every, io_procs=io_procs,
-                       timeout_s=timeout_s, worker_cpus=worker_cpus)
+                       timeout_s=timeout_s, worker_cpus=worker_cpus,
+                       transport=transport, spool_sync=spool_sync,
+                       entry=entry)
     totals = merged.get("counts")
     if totals is None:
         totals = np.zeros((18, 2), np.int64)
@@ -1448,7 +1790,10 @@ def fleet_bqsr_count(path: str, *, hosts: int, n_rg_run: int,
                      policy: Optional[FleetPolicy] = None,
                      env: Optional[dict] = None,
                      commit_every: int = 1,
-                     timeout_s: float = 900.0):
+                     timeout_s: float = 900.0,
+                     transport: Optional[str] = None,
+                     spool_sync: Optional[str] = None,
+                     entry: Optional[str] = None):
     """Sharded fused stream 2: the RecalTable count over a Parquet
     reads dataset, distributed across hosts and merged through the
     RecalTable monoid — byte-identical to the single-host count (exact
@@ -1483,7 +1828,9 @@ def fleet_bqsr_count(path: str, *, hosts: int, n_rg_run: int,
                        unit_rows=unit_rows, params=params,
                        fleet_dir=fleet_dir, policy=policy, env=env,
                        commit_every=commit_every,
-                       timeout_s=timeout_s, seed=seed)
+                       timeout_s=timeout_s, seed=seed,
+                       transport=transport, spool_sync=spool_sync,
+                       entry=entry)
     if not merged:
         from ..bqsr.table import RecalTable
         return RecalTable(n_read_groups=max(n_rg_run, 1),

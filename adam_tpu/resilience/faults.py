@@ -53,7 +53,7 @@ from ..errors import FormatError
 #: loudly instead of never firing
 SITES = ("device_dispatch", "device_put", "spill_write",
          "checkpoint_write", "feeder_load", "worker_proc", "input_record",
-         "shard_lease")
+         "shard_lease", "ring_write")
 
 FAULTS = ("error", "latency", "truncate", "corrupt", "kill")
 

@@ -147,6 +147,7 @@ FLEET_HEARTBEAT_ENV = "ADAM_TPU_FLEET_HEARTBEAT_S"
 FLEET_REDISTRIBUTE_ENV = "ADAM_TPU_FLEET_REDISTRIBUTE"   # 0/off disables
 FLEET_SPECULATE_ENV = "ADAM_TPU_FLEET_SPECULATE"         # 1/on enables
 FLEET_SPECULATE_FACTOR_ENV = "ADAM_TPU_FLEET_SPECULATE_FACTOR"
+FLEET_STEAL_ENV = "ADAM_TPU_FLEET_STEAL"                 # 1/on enables
 
 
 @dataclass(frozen=True)
@@ -164,7 +165,12 @@ class FleetPolicy:
     ``speculate`` (off by default) enables deadline-based speculative
     reassignment of the slowest shard's tail range to an idle survivor;
     the per-unit commit merge deduplicates, so speculation can never
-    double-count.
+    double-count.  ``steal`` (off by default) enables unit-granular
+    work stealing: an idle worker pulls single pending units off the
+    claim table (parallel/ringplane.py, ``O_EXCL`` create = one winner)
+    instead of waiting for a lease expiry or a whole-shard speculative
+    copy — the straggler's tail drains across survivors while the
+    straggler still runs.  The same merge dedup backstops it.
     """
     max_restarts: int = 2
     lease_ttl_s: float = 10.0
@@ -172,6 +178,7 @@ class FleetPolicy:
     redistribute: bool = True
     speculate: bool = False
     speculate_factor: float = 3.0
+    steal: bool = False
 
 
 def resolve_fleet_policy(max_restarts: Optional[int] = None,
@@ -179,8 +186,8 @@ def resolve_fleet_policy(max_restarts: Optional[int] = None,
                          heartbeat_s: Optional[float] = None,
                          redistribute: Optional[bool] = None,
                          speculate: Optional[bool] = None,
-                         speculate_factor: Optional[float] = None
-                         ) -> FleetPolicy:
+                         speculate_factor: Optional[float] = None,
+                         steal: Optional[bool] = None) -> FleetPolicy:
     """Explicit arguments (CLI flags) win; ``ADAM_TPU_FLEET_*`` envs fill
     whatever the caller left unset (the executor's flag/env convention).
     The heartbeat defaults to a third of the lease TTL so one missed
@@ -207,7 +214,8 @@ def resolve_fleet_policy(max_restarts: Optional[int] = None,
         speculate_factor=max(
             env_float(speculate_factor, FLEET_SPECULATE_FACTOR_ENV,
                       3.0),
-            1.0))
+            1.0),
+        steal=_bool(steal, FLEET_STEAL_ENV, False))
 
 
 # ---------------------------------------------------------------------------

@@ -278,6 +278,47 @@ def _emit_requeued(cause: str, d: dict, **extra) -> None:
 # range execution (the sharded-big-job map function, run by workers)
 # ---------------------------------------------------------------------------
 
+#: per-worker unit-index cache: a warm serve worker ranges over the
+#: same input many times (the shard-split path cuts one big job into
+#: many range sub-jobs), so the prescan is paid once per (file state,
+#: unit_rows), not once per sub-job
+_UNIT_INDEX_CACHE: Dict[Tuple[str, int, int, int], Optional[dict]] = {}
+
+
+def _range_entry(path: str, unit_rows: int) -> Tuple[str, Optional[dict]]:
+    """(entry, unit_index) for a range sub-job over ``path`` — the same
+    pure ``decide_shard_entry`` the fleet plan runs, with the prescan
+    index memoized per worker process.  Emitted (and decided) only for
+    SAM/BAM inputs; Parquet ranges read native row groups."""
+    from ..parallel import shardstream
+    from ..parallel.ringplane import ENTRY_ENV, decide_shard_entry
+
+    kind = shardstream._input_kind(path)
+    if kind not in ("sam", "bam"):
+        return "forward", None
+    requested = str(os.environ.get(ENTRY_ENV, "auto"))
+    index = None
+    if requested != "forward":
+        try:
+            st = os.stat(path)
+            key = (os.path.abspath(path), st.st_mtime_ns, st.st_size,
+                   int(unit_rows))
+        except OSError:
+            key = None
+        if key is not None and key in _UNIT_INDEX_CACHE:
+            index = _UNIT_INDEX_CACHE[key]
+        else:
+            index = shardstream.build_unit_index(path, int(unit_rows))
+            if key is not None:
+                _UNIT_INDEX_CACHE[key] = index
+    d = decide_shard_entry(kind=kind, requested=requested,
+                           index_available=index is not None)
+    obs.emit("shard_entry_selected", entry=d["entry"],
+             reason=d["reason"], inputs=d["inputs"],
+             input_digest=d["input_digest"])
+    return d["entry"], index if d["entry"] == "index" else None
+
+
 def range_flagstat_counts(path: str, *, unit_lo: int, unit_hi: int,
                           unit_rows: int, io_procs: int = 1
                           ) -> Tuple[np.ndarray, int]:
@@ -286,11 +327,14 @@ def range_flagstat_counts(path: str, *, unit_lo: int, unit_hi: int,
     function (``shardstream._flagstat_runtime``: pad to the canonical
     rung, retry/split/CPU-degrade per unit) re-used inside a warm serve
     worker.  Parquet inputs read only the overlapping row groups;
-    counters are an exact integer monoid, so the scheduler's sum over
-    sub-jobs is byte-identical to one solo pass."""
+    SAM/BAM inputs seek to the range via the memoized unit index when
+    the shard-entry decision engages; counters are an exact integer
+    monoid, so the scheduler's sum over sub-jobs is byte-identical to
+    one solo pass."""
     from ..io.dispatch import FLAGSTAT_COLUMNS
     from ..parallel import shardstream
 
+    entry, index = _range_entry(path, int(unit_rows))
     unit_result, ex = shardstream._flagstat_runtime(
         {"unit_rows": int(unit_rows)})
     total = np.zeros((18, 2), np.int64)
@@ -299,7 +343,8 @@ def range_flagstat_counts(path: str, *, unit_lo: int, unit_hi: int,
         for unit, table in shardstream.unit_tables(
                 path, list(range(int(unit_lo), int(unit_hi))),
                 int(unit_rows), list(FLAGSTAT_COLUMNS), "decoded",
-                "flagstat", io_procs=int(io_procs)):
+                "flagstat", io_procs=int(io_procs),
+                entry=entry, index=index):
             total += unit_result(unit, table)["counts"]
             rows += table.num_rows
     finally:

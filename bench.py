@@ -1323,18 +1323,39 @@ def _stage_shard_scale(kind: str, is_tpu: bool):
     CPUs but delivers ~1.3), because that capacity, not the host
     count, is the ceiling any process-level scaling can reach here;
     hosts beyond it are reported (oversubscription data), never
-    gated."""
+    gated.
+
+    Data-plane legs (ISSUE 19, parallel/ringplane.py): the default
+    hosts=2 run rides the decided transport (ring + batched spool on
+    this box) and stamps its ring bytes/segments and spool fsyncs; a
+    forced ``fleet_dir`` + per-file-fsync leg measures the old plane on
+    the same input (``shard_fsync_reduction`` is the gated ratio).  A
+    synthetic BGZF BAM leg runs index-assisted vs forward shard entry:
+    the indexed fleet's ledger must decode ~1x the file where the
+    forward fleet pays the decode-from-zero tax
+    (``shard_entry_redecode_frac`` ~0 is the gated number)."""
     import shutil
     import tempfile
 
     import numpy as np
     import pyarrow as pa
 
+    from adam_tpu import obs
     from adam_tpu.io.parquet import DatasetWriter
     from adam_tpu.ops.flagstat import format_report
     from adam_tpu.parallel.pipeline import streaming_flagstat
     from adam_tpu.parallel.shardstream import fleet_flagstat
     from adam_tpu.resilience.retry import FleetPolicy
+
+    def _counters() -> dict:
+        return dict(obs.registry().snapshot()["counters"])
+
+    def _csum(snap: dict, name: str) -> float:
+        return sum(v for k, v in snap.items()
+                   if k == name or k.startswith(name + "{"))
+
+    def _delta(before: dict, after: dict, name: str) -> int:
+        return int(_csum(after, name) - _csum(before, name))
 
     n = int(os.environ.get("ADAM_TPU_BENCH_SHARD_READS", 48_000_000))
     rng = np.random.RandomState(11)
@@ -1366,21 +1387,122 @@ def _stage_shard_scale(kind: str, is_tpu: bool):
         pol = FleetPolicy(lease_ttl_s=60.0)
         reports = {}
         for hosts in (1, 2, 4):
+            c0 = _counters()
             t0 = time.perf_counter()
             reports[hosts] = format_report(*fleet_flagstat(
                 pq_dir, hosts=hosts, unit_rows=max(n // 16, 1),
                 policy=pol, commit_every=4, timeout_s=600.0))
             out[f"shard_hosts{hosts}_wall_s"] = round(
                 time.perf_counter() - t0, 3)
+            if hosts == 2:
+                c1 = _counters()
+                # the decided transport, proven by delivery (segments
+                # actually rode the ring), not just by the decision
+                ring_segs = _delta(c0, c1, "ring_segments")
+                out["shard_transport"] = "ring" if ring_segs else \
+                    "fleet_dir"
+                out["shard_spool_sync"] = "batched"
+                out["shard_ring_segments"] = ring_segs
+                out["shard_ring_bytes"] = _delta(c0, c1, "ring_bytes")
+                out["shard_fsyncs_ring"] = _delta(c0, c1, "spool_fsyncs")
+                out["shard_spool_bytes_ring"] = _delta(
+                    c0, c1, "spool_bytes")
         out["shard_scale_identical"] = all(
             r == single for r in reports.values())
         out["shard_speedup_2"] = round(
             out["shard_hosts1_wall_s"] / out["shard_hosts2_wall_s"], 3)
         out["shard_speedup_4"] = round(
             out["shard_hosts1_wall_s"] / out["shard_hosts4_wall_s"], 3)
+        out["shard_entry_parquet"] = "rowgroup"
+
+        # -- forced fleet_dir + per-file fsync: the PR 9 plane on the
+        # same input, same hosts — the fsync-reduction denominator
+        c0 = _counters()
+        t0 = time.perf_counter()
+        fdir = format_report(*fleet_flagstat(
+            pq_dir, hosts=2, unit_rows=max(n // 16, 1), policy=pol,
+            commit_every=4, timeout_s=600.0, transport="fleet_dir",
+            spool_sync="every"))
+        out["shard_hosts2_fleetdir_wall_s"] = round(
+            time.perf_counter() - t0, 3)
+        c1 = _counters()
+        out["shard_scale_fleetdir_identical"] = fdir == single
+        out["shard_fsyncs_fleetdir"] = _delta(c0, c1, "spool_fsyncs")
+        out["shard_spool_bytes_fleetdir"] = _delta(
+            c0, c1, "spool_bytes")
+        if out.get("shard_fsyncs_ring"):
+            out["shard_fsync_reduction"] = round(
+                out["shard_fsyncs_fleetdir"] /
+                max(out["shard_fsyncs_ring"], 1), 3)
+
+        # -- index-assisted BGZF shard entry: a synthetic BAM, indexed
+        # vs forward fleet, decoded bytes from the folded I/O ledger
+        n_bam = int(os.environ.get("ADAM_TPU_BENCH_SHARD_BAM_READS",
+                                   100_000))
+        bam_path = os.path.join(tmp, "reads.bam")
+        _write_synth_bam(bam_path, n_bam, rng)
+        out["shard_bam_n_reads"] = n_bam
+        out["shard_bam_file_bytes"] = os.path.getsize(bam_path)
+        bam_single = format_report(*streaming_flagstat(
+            bam_path, chunk_rows=1 << 15))
+        legs = {}
+        for entry in ("index", "forward"):
+            c0 = _counters()
+            t0 = time.perf_counter()
+            rep = format_report(*fleet_flagstat(
+                bam_path, hosts=2, unit_rows=max(n_bam // 16, 1),
+                policy=pol, commit_every=4, timeout_s=600.0,
+                entry=entry))
+            wall = round(time.perf_counter() - t0, 3)
+            c1 = _counters()
+            legs[entry] = rep
+            tag = "idx" if entry == "index" else "fwd"
+            out[f"shard_bam_{tag}_wall_s"] = wall
+            out[f"shard_bam_{tag}_decoded_bytes"] = _delta(
+                c0, c1, "io_bytes_decoded")
+        out["shard_bam_identical"] = all(
+            r == bam_single for r in legs.values())
+        out["shard_entry_bam"] = "index"
+        # bytes decoded BEYOND one pass over the file, per file byte:
+        # the recovery/entry re-decode tax the index exists to erase
+        fb = out["shard_bam_file_bytes"]
+        out["shard_entry_redecode_frac"] = round(max(
+            out["shard_bam_idx_decoded_bytes"] - fb, 0) / fb, 4)
+        out["shard_entry_forward_redecode_frac"] = round(max(
+            out["shard_bam_fwd_decoded_bytes"] - fb, 0) / fb, 4)
     finally:
         shutil.rmtree(tmp, ignore_errors=True)
     _emit("shard_scale", out)
+
+
+def _write_synth_bam(path: str, n: int, rng) -> None:
+    """A synthetic BGZF BAM for the shard-entry leg: random flagstat-
+    relevant fields over a 24-contig dictionary, short reads so the
+    file is many BGZF members (seekable at member grain)."""
+    import numpy as np
+    import pyarrow as pa
+
+    from adam_tpu.io.bam import write_bam
+    from adam_tpu.models.dictionary import (SequenceDictionary,
+                                            SequenceRecord)
+
+    seq_dict = SequenceDictionary(
+        [SequenceRecord(i, f"chr{i + 1}", 1 << 20) for i in range(24)])
+    table = pa.table({
+        "readName": pa.array([f"r{i}" for i in range(n)]),
+        "sequence": pa.array(["ACGTACGT"] * n),
+        "flags": pa.array(rng.randint(0, 1 << 11, size=n).astype(
+            np.uint32), pa.uint32()),
+        "mapq": pa.array(rng.randint(0, 61, size=n), pa.int32()),
+        "referenceId": pa.array(rng.randint(0, 24, size=n),
+                                pa.int32()),
+        "start": pa.array(rng.randint(0, 1 << 19, size=n), pa.int64()),
+        "mateReferenceId": pa.array(rng.randint(0, 24, size=n),
+                                    pa.int32()),
+        "mateAlignmentStart": pa.array(
+            rng.randint(0, 1 << 19, size=n), pa.int64()),
+    })
+    write_bam(table, seq_dict, path)
 
 
 def _stage_serve_warm(kind: str, is_tpu: bool):

@@ -690,3 +690,370 @@ def test_cli_transform_hosts_validation(tmp_path, capsys):
     assert rc == 2            # SAM input cannot shard the s2 count
     err = capsys.readouterr().err
     assert "-hosts" in err and "Parquet" in err
+
+
+# ---------------------------------------------------------------------------
+# zero-copy data plane: transport/entry decisions, ring, claims, spool
+# ---------------------------------------------------------------------------
+
+from adam_tpu.parallel import ringplane as rp  # noqa: E402
+
+
+def test_decide_transport_pure_and_digest_stable():
+    d = rp.decide_transport(requested="auto", same_box=True,
+                            mmap_capable=True, spool_requested="auto")
+    assert d["transport"] == "ring" and d["spool_sync"] == "batched"
+    assert rp.decide_transport(**d["inputs"]) == d
+    # every fallback edge is typed in the reason
+    assert rp.decide_transport(
+        requested="fleet_dir", same_box=True, mmap_capable=True,
+        spool_requested="every")["reason"].startswith("forced")
+    assert rp.decide_transport(
+        requested="auto", same_box=True, mmap_capable=False,
+        spool_requested="auto")["transport"] == "fleet_dir"
+    assert rp.decide_transport(
+        requested="auto", same_box=False, mmap_capable=True,
+        spool_requested="auto")["reason"].startswith("cross-box")
+    # a forced ring beats the cross-box heuristic (operator knows best)
+    assert rp.decide_transport(
+        requested="ring", same_box=False, mmap_capable=True,
+        spool_requested="every")["transport"] == "ring"
+
+
+def test_decide_shard_entry_pure():
+    d = rp.decide_shard_entry(kind="bam", requested="auto",
+                              index_available=True)
+    assert d["entry"] == "index" and d["reason"] == "index-available"
+    assert rp.decide_shard_entry(**d["inputs"]) == d
+    assert rp.decide_shard_entry(
+        kind="sam", requested="forward",
+        index_available=True)["entry"] == "forward"
+    assert rp.decide_shard_entry(
+        kind="bam", requested="auto",
+        index_available=False)["reason"] == "no-index"
+    assert rp.decide_shard_entry(
+        kind="parquet", requested="auto",
+        index_available=False)["entry"] == "rowgroup"
+
+
+def test_ring_roundtrip_and_torn_tail(tmp_path):
+    """Writer→reader roundtrip through the mmap ring, and the two torn
+    shapes: an unpublished tail past the cursor (SIGKILL mid-write) and
+    a corrupt committed frame (never writer-produced; poisons the
+    ring, the spool covers it)."""
+    path = str(tmp_path / "ring" / "shard0-inc0.ring")
+    w = rp.RingWriter(path, 1 << 16, shard=0, incarnation=0)
+    res1 = [(0, {"counts": np.arange(4, dtype=np.int64)}),
+            (1, {"counts": np.arange(4, 8, dtype=np.int64)})]
+    res2 = [(2, {"counts": np.full(4, 7, np.int64)})]
+    assert w.publish(1, res1) and w.publish(2, res2)
+    rd = rp.RingReader(path)
+    assert (rd.shard, rd.incarnation) == (0, 0)
+    got = rd.poll()
+    assert [(s, n) for s, n, _ in got] == [(1, 2), (2, 1)]
+    decoded = rp.decode_unit_results(got[0][2])
+    assert [u for u, _ in decoded] == [0, 1]
+    assert decoded[1][1]["counts"].tolist() == [4, 5, 6, 7]
+    assert rd.poll() == [] and rd.scan_tail() == 0
+    # SIGKILL mid-write residue: a frame header past the cursor whose
+    # payload never finished — detected, never delivered
+    end = w._end
+    rp._SEG.pack_into(w._m, end, rp._SEG_MAGIC, 3, 1, 64, 0xdead)
+    assert rd.scan_tail() == 1
+    assert rd.poll() == []          # still not committed -> not read
+    w.close()
+    rd.close()
+    # corrupt COMMITTED frame: poison-to-cursor, counted
+    w2 = rp.RingWriter(path, 1 << 16, shard=0, incarnation=1)
+    w2.publish(1, res1)
+    w2._m[rp.HEADER_BYTES + rp._SEG.size] ^= 0xFF
+    rd2 = rp.RingReader(path)
+    assert rd2.poll() == [] and rd2.torn == 1
+    w2.close()
+    rd2.close()
+
+
+def test_ring_full_stops_publishing_not_the_run(tmp_path):
+    path = str(tmp_path / "tiny.ring")
+    w = rp.RingWriter(path, 256, shard=0, incarnation=0)
+    res = [(0, {"counts": np.zeros(64, np.int64)})]
+    assert not w.publish(1, res)
+    assert w.full
+    # once full, stays full (the spool carries the rest)
+    assert not w.publish(2, res)
+    w.close()
+
+
+def test_claim_table_exactly_once_and_release(tmp_path):
+    fleet = str(tmp_path)
+    os.makedirs(os.path.join(fleet, rp.CLAIM_DIR))
+    assert rp.claim_unit(fleet, 7, shard=0, incarnation=1)
+    # the race loser: same unit, different claimant
+    assert not rp.claim_unit(fleet, 7, shard=1, incarnation=0)
+    assert rp.claim_owner(fleet, 7) == {"shard": 0, "incarnation": 1}
+    assert rp.claim_owner(fleet, 8) is None
+    rp.claim_unit(fleet, 9, shard=0, incarnation=1)
+    # release shard 0's claims except committed unit 9
+    assert rp.release_shard_claims(fleet, 0, {9}) == 1
+    assert rp.claim_owner(fleet, 7) is None
+    assert rp.claim_owner(fleet, 9) is not None
+    # other shards' claims survive a release
+    rp.claim_unit(fleet, 11, shard=2, incarnation=0)
+    assert rp.release_shard_claims(fleet, 0, set()) == 1  # unit 9 only
+    assert rp.claim_owner(fleet, 11) is not None
+
+
+def test_atomic_np_write_fsync_knob(tmp_path, monkeypatch):
+    """The batched-spool mechanism: ``fsync=False`` skips BOTH the file
+    fsync and the parent-dir fsync (the caller owes one directory fsync
+    per commit window instead), while the tmp+rename atomicity —
+    no torn file under the real name — is unchanged."""
+    from adam_tpu import checkpoint as cp
+
+    calls = []
+    real_fsync = os.fsync
+    monkeypatch.setattr(os, "fsync",
+                        lambda fd: (calls.append(fd), real_fsync(fd))[1])
+    p1 = str(tmp_path / "every.npz")
+    cp.atomic_np_write(p1, lambda f: np.savez(f, x=np.arange(3)))
+    n_every = len(calls)
+    assert n_every >= 2          # file + parent dir
+    calls.clear()
+    p2 = str(tmp_path / "batched.npz")
+    cp.atomic_np_write(p2, lambda f: np.savez(f, x=np.arange(3)),
+                       fsync=False)
+    assert calls == []
+    with np.load(p2) as z:
+        assert z["x"].tolist() == [0, 1, 2]
+    assert not glob.glob(str(tmp_path / "*.tmp*"))
+
+
+def test_broadcast_blob_maps_once_per_process(tmp_path):
+    from adam_tpu import obs
+
+    def opens():
+        return obs.registry().counter("broadcast_blob_opens").value
+
+    p = str(tmp_path / "dup.npy")
+    np.save(p, np.arange(16, dtype=np.uint8))
+    base = opens()
+    a = rp.load_broadcast_array(p)
+    b = rp.load_broadcast_array(p)
+    assert a is b                      # the memoized mmap, not a reopen
+    assert opens() == base + 1
+    # a CHANGED blob (new mtime/size) is a different broadcast: reopen
+    np.save(p, np.arange(32, dtype=np.uint8))
+    c = rp.load_broadcast_array(p)
+    assert len(c) == 32 and opens() == base + 2
+
+
+@pytest.fixture(scope="module")
+def bam_input(tmp_path_factory):
+    """A multi-member BGZF BAM + its forward-decode oracle counters."""
+    from adam_tpu.io.bam import write_bam
+    from adam_tpu.io.sam import read_sam
+    from adam_tpu.parallel.pipeline import streaming_flagstat
+
+    tmp = tmp_path_factory.mktemp("ringbam")
+    table, seq_dict, rg_dict = read_sam(os.path.join(
+        os.path.dirname(__file__), "resources", "unmapped.sam"))
+    big = pa.concat_tables([table] * 12)       # 2400 rows
+    path = str(tmp / "reads.bam")
+    write_bam(big, seq_dict, path, rg_dict)
+    failed, passed = streaming_flagstat(path, chunk_rows=256)
+    from adam_tpu.ops.flagstat import format_report
+    return dict(path=path, rows=big.num_rows,
+                oracle=format_report(failed, passed))
+
+
+def test_bam_unit_index_seeks_and_matches_forward(bam_input):
+    """Index-assisted BAM entry is byte-identical to the forward walk
+    AND charges the ledger only the members it actually inflates (the
+    ~0-re-decode acceptance pin, unit-table edition)."""
+    from adam_tpu import obs
+
+    path = bam_input["path"]
+    idx = ss.build_unit_index(path, 100)
+    assert idx is not None and idx["kind"] == "bam"
+    assert idx["total_rows"] == bam_input["rows"]
+    units = list(range(18, 24))        # the tail quarter of 24 units
+    fwd = list(ss.unit_tables(path, units, 100, None, "decoded",
+                              "fwd_leg"))
+    led0 = obs.ioledger.snapshot()
+    idxed = list(ss.unit_tables(path, units, 100, None, "decoded",
+                                "idx_leg", entry="index", index=idx))
+    led1 = obs.ioledger.snapshot()
+    assert [u for u, _ in idxed] == [u for u, _ in fwd] == units
+    for (_, a), (_, b) in zip(idxed, fwd):
+        assert a.to_pydict() == b.to_pydict()
+    # the forward leg charged the whole file; the indexed leg charged
+    # only the members from the seek point on — a strict subset
+    full = os.path.getsize(path)
+    idx_bytes = led1.get("idx_leg", {}).get("decoded", 0) - \
+        led0.get("idx_leg", {}).get("decoded", 0)
+    assert 0 < idx_bytes < full // 2
+    assert led1["fwd_leg"]["decoded"] >= full
+
+
+def test_sam_unit_index_seeks_and_matches_forward(tmp_path):
+    src = os.path.join(os.path.dirname(__file__), "resources",
+                       "unmapped.sam")
+    idx = ss.build_unit_index(src, 50)
+    assert idx is not None and idx["kind"] == "sam"
+    assert idx["total_rows"] == 200
+    fwd = list(ss.unit_tables(src, [2, 3], 50, None, "decoded", "sfwd"))
+    idxed = list(ss.unit_tables(src, [2, 3], 50, None, "decoded",
+                                "sidx", entry="index", index=idx))
+    assert [u for u, _ in idxed] == [2, 3]
+    for (_, a), (_, b) in zip(idxed, fwd):
+        assert a.to_pydict() == b.to_pydict()
+
+
+def test_fleet_ring_transport_beats_and_matches_fleet_dir(
+        fleet_input, tmp_path):
+    """Both transports, same bytes: the default (ring) leg and a forced
+    fleet_dir leg produce identical reports, and each stamps its
+    replayable transport_selected decision."""
+    m_ring = str(tmp_path / "ring.metrics.jsonl")
+    m_fdir = str(tmp_path / "fdir.metrics.jsonl")
+    from adam_tpu import obs
+
+    with obs.metrics_run(m_ring, argv=["test"], config={}):
+        out_r = ss.fleet_flagstat(
+            fleet_input["path"], hosts=2, unit_rows=100,
+            fleet_dir=str(tmp_path / "f1"), timeout_s=240)
+    with obs.metrics_run(m_fdir, argv=["test"], config={}):
+        out_f = ss.fleet_flagstat(
+            fleet_input["path"], hosts=2, unit_rows=100,
+            fleet_dir=str(tmp_path / "f2"), timeout_s=240,
+            transport="fleet_dir", spool_sync="every")
+    assert _report(out_r) == _report(out_f) == fleet_input["oracle"]
+    [tr] = [e for e in _events(m_ring)
+            if e["event"] == "transport_selected"]
+    assert tr["transport"] == "ring" and tr["spool_sync"] == "batched"
+    [tf] = [e for e in _events(m_fdir)
+            if e["event"] == "transport_selected"]
+    assert tf["transport"] == "fleet_dir" and tf["spool_sync"] == "every"
+    assert tf["reason"].startswith("forced")
+    # the ring leg really delivered segments (counters folded from the
+    # workers' sidecars into the supervisor summary)
+    summary = _events(m_ring)[-1]["metrics"]["counters"]
+    assert summary.get("ring_segments", 0) >= 1
+    assert summary.get("ring_bytes", 0) > 0
+    # batched spool: strictly fewer fsyncs than the per-file leg
+    f_batched = _events(m_ring)[-1]["metrics"]["counters"].get(
+        "spool_fsyncs", 0)
+    f_every = _events(m_fdir)[-1]["metrics"]["counters"].get(
+        "spool_fsyncs", 0)
+    assert 0 < f_batched <= f_every // 3
+    # ring files exist only on the ring leg
+    assert glob.glob(os.path.join(str(tmp_path / "f1"),
+                                  rp.RING_DIR, "*.ring"))
+    assert not glob.glob(os.path.join(str(tmp_path / "f2"),
+                                      rp.RING_DIR, "*.ring"))
+    _run_validators(m_ring, m_fdir)
+
+
+def test_fleet_sigkill_mid_ring_write_torn_segment_recovers(
+        fleet_input, tmp_path):
+    """THE torn-ring chaos cell: SIGKILL lands exactly mid-payload in
+    the ring publish (after the npz rename — the spool already has the
+    commit).  The supervisor detects the torn segment, ignores it, and
+    the run completes byte-identical off the durable spine."""
+    metrics = str(tmp_path / "sup.metrics.jsonl")
+    rules = [{"site": "ring_write", "fault": "kill",
+              "occurrence": 2, "incarnation": 0, "shard": 1}]
+    out, fleet_dir = _fleet(fleet_input, tmp_path, rules=rules,
+                            metrics=metrics)
+    assert _report(out) == fleet_input["oracle"]
+    evs = _events(metrics)
+    deaths = [e for e in evs if e["event"] == "shard_reassigned"
+              and e["inputs"].get("shard") == 1]
+    assert [(e["cause"], e["action"]) for e in deaths] == \
+        [("death", "respawn")]
+    # the torn segment was SEEN (detected+ignored), not silently lost
+    counters = evs[-1]["metrics"]["counters"]
+    assert counters.get("ring_torn_segments", 0) >= 1
+    # the interrupted publish's unit still merged exactly once — the
+    # npz twin on the spool is the spine
+    merge = [e for e in evs if e["event"] == "shard_merge"][0]
+    assert merge["units"] == 24
+    _run_validators(metrics)
+
+
+def test_fleet_unit_stealing_exactly_once_live(fleet_input, tmp_path):
+    """An idle worker steals single pending units off the straggler's
+    tail through the O_EXCL claim table: every stolen unit is claimed
+    by exactly one thief, totals stay byte-identical, and the steals
+    are visible as replayable unit_stolen events."""
+    metrics = str(tmp_path / "sup.metrics.jsonl")
+    rules = [{"site": "device_dispatch", "fault": "latency",
+              "latency_s": 1.0, "occurrence": "2+", "shard": 1}]
+    pol = FleetPolicy(max_restarts=2, lease_ttl_s=30, heartbeat_s=0.3,
+                      steal=True)
+    out, fleet_dir = _fleet(fleet_input, tmp_path, rules=rules,
+                            policy=pol, metrics=metrics)
+    assert _report(out) == fleet_input["oracle"]
+    sidecars = sorted(glob.glob(os.path.join(
+        fleet_dir, ss.LOG_DIR, "*.metrics.jsonl")))
+    stolen = []
+    for sc in sidecars:
+        stolen += [e for e in _events(sc) if e["event"] == "unit_stolen"]
+    assert stolen, "the idle worker must have stolen from the tail"
+    # exactly-once: no unit stolen twice, thief != victim, and every
+    # steal holds a claim file or a commit that won the merge
+    units = [e["unit"] for e in stolen]
+    assert len(units) == len(set(units))
+    assert all(e["thief"] != e["victim"] for e in stolen)
+    evs = _events(metrics)
+    merge = [e for e in evs if e["event"] == "shard_merge"][0]
+    assert merge["units"] == 24
+    counters = evs[-1]["metrics"]["counters"]
+    assert counters.get("unit_steals", 0) == len(stolen)
+    r = subprocess.run(
+        [sys.executable,
+         os.path.join(ROOT, "tools", "check_metrics.py")] + sidecars,
+        capture_output=True, text=True)
+    assert r.returncode == 0, f"{r.stdout}\n{r.stderr}"
+    _run_validators(metrics)
+
+
+def test_fleet_indexed_bam_entry_end_to_end(bam_input, tmp_path):
+    """A BGZF BAM fleet seeks each shard to its unit range: identical
+    report on both entries, shard_entry_selected recorded, and the
+    indexed leg's recovery re-decode is ~0 (strictly less input decoded
+    than the forward leg, which pays the decode-from-zero tax)."""
+    m_idx = str(tmp_path / "idx.metrics.jsonl")
+    m_fwd = str(tmp_path / "fwd.metrics.jsonl")
+    from adam_tpu import obs
+
+    with obs.metrics_run(m_idx, argv=["test"], config={}):
+        out_i = ss.fleet_flagstat(
+            bam_input["path"], hosts=2, unit_rows=100,
+            fleet_dir=str(tmp_path / "fi"), timeout_s=240)
+    with obs.metrics_run(m_fwd, argv=["test"], config={}):
+        out_f = ss.fleet_flagstat(
+            bam_input["path"], hosts=2, unit_rows=100,
+            fleet_dir=str(tmp_path / "ff"), timeout_s=240,
+            entry="forward")
+    assert _report(out_i) == _report(out_f) == bam_input["oracle"]
+    [ei] = [e for e in _events(m_idx)
+            if e["event"] == "shard_entry_selected"]
+    assert ei["entry"] == "index"
+    [ef] = [e for e in _events(m_fwd)
+            if e["event"] == "shard_entry_selected"]
+    assert ef["entry"] == "forward" and ef["reason"] == "forced"
+
+    def decoded(fleet_dir):
+        from adam_tpu.obs import read_snapshot_file
+        total = 0
+        for sc in glob.glob(os.path.join(str(fleet_dir), ss.LOG_DIR,
+                                         "*.metrics.jsonl")):
+            snap = read_snapshot_file(sc)
+            total += _decoded_bytes(snap)
+        return total
+
+    # forward: every worker decodes from byte 0 (shard 1 re-decodes
+    # shard 0's half).  indexed: each shard charges only its own range.
+    assert decoded(tmp_path / "fi") < decoded(tmp_path / "ff")
+    _run_validators(m_idx, m_fwd)

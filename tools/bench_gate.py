@@ -177,6 +177,18 @@ SHARD_MIN_SPEEDUP_ANY = 0.5
 #: the fleet walls a fresh artifact is regression-diffed on
 SHARD_WALL_KEYS = ("shard_hosts1_wall_s", "shard_hosts2_wall_s")
 
+#: ISSUE 19 data-plane acceptance, enforced when the artifact carries
+#: the keys (older artifacts predate the ring plane and still pass):
+#: the batched-spool ring leg must cut commit fsyncs >= 3x vs the
+#: forced fleet_dir + per-file-fsync leg on the same input, and the
+#: index-assisted BAM fleet's ledger must decode ~1x the file (the
+#: frac is bytes decoded BEYOND one pass, per file byte — BGZF member
+#: granularity and the per-shard header parse put the honest floor a
+#: few percent above zero, where the forward fleet pays ~1.0)
+SHARD_FSYNC_REDUCTION_FLOOR = 3.0
+SHARD_REDECODE_FRAC_MAX = 0.15
+SHARD_TRANSPORTS = ("ring", "fleet_dir")
+
 FLEET_SERVE = os.path.join(ROOT, "BENCH_FLEET_SERVE.json")
 
 #: the ISSUE 12 acceptance numbers, the gate-4 capacity discipline: the
@@ -749,15 +761,52 @@ def _check_shard_artifact(path: str) -> int:
               f"byte-identical to the single-host run in {path}",
               file=sys.stderr)
         rc = 1
+    # -- data-plane keys (ISSUE 19): enforced only when present, so
+    # pre-ring artifacts (and forced-fleet_dir regenerations, which
+    # simply skip the ring stamps) still pass
+    transport = doc.get("shard_transport")
+    if transport is not None and transport not in SHARD_TRANSPORTS:
+        print(f"bench_gate: unknown shard_transport {transport!r} in "
+              f"{path} (expected one of {SHARD_TRANSPORTS})",
+              file=sys.stderr)
+        rc = 1
+    for key in ("shard_scale_fleetdir_identical", "shard_bam_identical"):
+        if key in doc and doc[key] is not True:
+            print(f"bench_gate: {key} is not True in {path} — a "
+                  "data-plane leg no longer matches the single-host "
+                  "oracle", file=sys.stderr)
+            rc = 1
+    reduction = doc.get("shard_fsync_reduction")
+    if reduction is not None and reduction < SHARD_FSYNC_REDUCTION_FLOOR:
+        print(f"bench_gate: shard_fsync_reduction {reduction!r} in "
+              f"{path} is below the required "
+              f"{SHARD_FSYNC_REDUCTION_FLOOR}x — the batched spool no "
+              "longer amortizes commit fsyncs", file=sys.stderr)
+        rc = 1
+    frac = doc.get("shard_entry_redecode_frac")
+    if frac is not None and frac > SHARD_REDECODE_FRAC_MAX:
+        print(f"bench_gate: shard_entry_redecode_frac {frac!r} in "
+              f"{path} exceeds {SHARD_REDECODE_FRAC_MAX} — the "
+              "index-assisted BAM entry is re-decoding input it should "
+              "seek past", file=sys.stderr)
+        rc = 1
     if rc == 0:
         how = (f"speedup {speedup}x >= {SHARD_REQUIRED_SPEEDUP}x"
                if gated else
                f"speedup {speedup}x reported, not gated — measured "
                f"parallel capacity {capacity}x < "
                f"{SHARD_CAPACITY_FLOOR}x (capacity-limited box)")
+        plane = ""
+        if transport is not None:
+            bits = [f"transport={transport}"]
+            if reduction is not None:
+                bits.append(f"fsyncs cut {reduction}x")
+            if frac is not None:
+                bits.append(f"indexed-BAM re-decode {frac}")
+            plane = ", " + ", ".join(bits)
         print(f"shard gate: 2-host fleet {how} "
               f"({doc.get('cpu_count')} advertised cores), all legs "
-              "byte-identical")
+              f"byte-identical{plane}")
     return rc
 
 

@@ -21,8 +21,10 @@ and fails when:
   decide_steal, selected by the recorded ``cause``), the overload
   plane's ``overload_state`` (serve/overload.decide_overload), the
   backend circuit breaker's ``breaker_state``
-  (resilience/retry.decide_breaker) and the variant-calling plane's
-  ``call_plan_selected`` (call/plan.decide_call_plan);
+  (resilience/retry.decide_breaker), the variant-calling plane's
+  ``call_plan_selected`` (call/plan.decide_call_plan) and the fleet
+  data plane's ``transport_selected`` / ``shard_entry_selected``
+  (parallel/ringplane.decide_transport / decide_shard_entry);
 * the recorded ``input_digest`` does not match the digest of the
   recorded inputs (the event lied about what it decided from);
 * two events — within one file or across files — share an
@@ -112,6 +114,12 @@ STEAL_FIELDS = ("action", "moves", "reason")
 #: (call/plan.decide_call_plan; same purity contract)
 CALL_FIELDS = ("stripe_span", "min_depth", "min_alt", "reason")
 
+#: the fleet data-plane fields a replay must reproduce exactly
+#: (parallel/ringplane.decide_transport / decide_shard_entry — how
+#: unit results travel and where SAM/BAM shards enter the input)
+TRANSPORT_FIELDS = ("transport", "spool_sync", "reason")
+ENTRY_FIELDS = ("entry", "reason")
+
 #: fields absent from older sidecars: compared only when recorded
 _OPTIONAL_FIELDS = ("layout", "page_rows", "pool_pages", "reject",
                     "cancel", "fused_device")
@@ -126,7 +134,8 @@ _REPLAYED = ("executor_bucket_selected", "fusion_plan_selected",
              "realign_plan_selected", "shard_plan_selected",
              "shard_reassigned", "admission_selected",
              "placement_selected", "job_requeued", "pages_selected",
-             "overload_state", "breaker_state", "call_plan_selected")
+             "overload_state", "breaker_state", "call_plan_selected",
+             "transport_selected", "shard_entry_selected")
 
 
 def _events(path: str, kinds=_REPLAYED) -> List[Tuple[int, dict]]:
@@ -155,6 +164,8 @@ def check(paths: List[str]) -> List[str]:
                                                decide_shard_speculation)
     from adam_tpu.call.plan import decide_call_plan
     from adam_tpu.parallel.pagedbuf import decide_pages
+    from adam_tpu.parallel.ringplane import (decide_shard_entry,
+                                             decide_transport)
     from adam_tpu.resilience.retry import decide_breaker
     from adam_tpu.serve.admission import decide_admission
     from adam_tpu.serve.overload import decide_overload
@@ -175,7 +186,11 @@ def check(paths: List[str]) -> List[str]:
                 "pages_selected": (decide_pages, PAGES_FIELDS),
                 "overload_state": (decide_overload, OVERLOAD_FIELDS),
                 "breaker_state": (decide_breaker, BREAKER_FIELDS),
-                "call_plan_selected": (decide_call_plan, CALL_FIELDS)}
+                "call_plan_selected": (decide_call_plan, CALL_FIELDS),
+                "transport_selected": (decide_transport,
+                                       TRANSPORT_FIELDS),
+                "shard_entry_selected": (decide_shard_entry,
+                                         ENTRY_FIELDS)}
     errs: List[str] = []
     # digests are namespaced per event kind: the two deciders hash
     # different input tuples and must never cross-validate

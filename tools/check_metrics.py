@@ -153,6 +153,20 @@ elastic worker sidecars).  Contract checked here:
   >= 0), hex ``vcf_sha256``, plus nullable ``identical`` (bool; the
   oracle verdict, only under -validate) and nullable ``rod_coverage``
   (number >= 0; the rods-plane summary) — the pass's output receipt;
+* ``transport_selected`` events (the fleet data plane,
+  parallel/ringplane.decide_transport) carry ``transport``
+  (ring/fleet_dir), ``spool_sync`` (batched/every), ``reason``,
+  ``inputs`` + hex ``input_digest`` (replayed by
+  tools/check_executor.py);
+* ``shard_entry_selected`` events
+  (parallel/ringplane.decide_shard_entry — emitted only for SAM/BAM
+  fleet inputs, where the entry question exists) carry ``entry``
+  (index/forward/rowgroup), ``reason``, ``inputs`` + hex
+  ``input_digest`` (replayed by tools/check_executor.py);
+* ``unit_stolen`` events carry ``unit``/``victim``/``thief``/
+  ``incarnation`` (ints >= 0, victim != thief) — an idle fleet worker
+  claimed one pending unit off a straggler's tail (exactly-once via
+  the O_EXCL claim table);
 * the last line is the ``summary``: ``wall_seconds``, ``ok``, and a
   ``metrics`` snapshot whose counters/gauges are numeric and whose
   histograms are internally consistent (count == sum of bucket counts);
@@ -205,6 +219,7 @@ KNOWN_EVENTS = (
     "breaker_state",
     "series_written", "serve_report_checkpoint",
     "call_plan_selected", "call_stripe", "call_emit",
+    "transport_selected", "shard_entry_selected", "unit_stolen",
 )
 
 #: mirror of adam_tpu.resilience.faults.SITES / FAULTS (kept literal so
@@ -212,7 +227,7 @@ KNOWN_EVENTS = (
 #: this file's schema knowledge)
 _FAULT_SITES = ("device_dispatch", "device_put", "spill_write",
                 "checkpoint_write", "feeder_load", "worker_proc",
-                "input_record", "shard_lease")
+                "input_record", "shard_lease", "ring_write")
 _FAULT_KINDS = ("error", "latency", "truncate", "corrupt", "kill")
 _RETRY_ACTIONS = ("retry", "split", "fallback_cpu", "raise")
 _SHARD_CAUSES = ("death", "speculation")
@@ -225,6 +240,10 @@ _REQUEUE_ACTIONS = ("requeue", "quarantine", "steal")
 #: adam_tpu.resilience.retry.BREAKER_STATES (kept literal, like
 #: _FAULT_SITES above)
 _OVERLOAD_STATES = ("normal", "shed_batch", "reject_low", "reject_all")
+#: mirror of adam_tpu.parallel.ringplane's decision vocabularies
+_TRANSPORTS = ("ring", "fleet_dir")
+_SPOOL_SYNCS = ("batched", "every")
+_ENTRIES = ("index", "forward", "rowgroup")
 _REJECT_CODES = ("over_backlog", "tenant_quota", "brownout_low",
                  "brownout_all")
 _BREAKER_STATES = ("closed", "open", "half_open")
@@ -955,6 +974,44 @@ def validate(path: str) -> List[str]:
             if rc is not None and not (_is_num(rc) and rc >= 0):
                 err(i, "call_emit 'rod_coverage' must be a "
                        "non-negative number or null")
+        elif ev == "transport_selected":
+            if d.get("transport") not in _TRANSPORTS:
+                err(i, f"transport_selected unknown transport "
+                       f"{d.get('transport')!r}")
+            if d.get("spool_sync") not in _SPOOL_SYNCS:
+                err(i, f"transport_selected unknown spool_sync "
+                       f"{d.get('spool_sync')!r}")
+            if not (isinstance(d.get("reason"), str) and d["reason"]):
+                err(i, "transport_selected missing string 'reason'")
+            if not isinstance(d.get("inputs"), dict):
+                err(i, "transport_selected missing 'inputs' object "
+                       "(decision must be replayable)")
+            if not _is_hex(d.get("input_digest")):
+                err(i, "transport_selected missing hex 'input_digest'")
+        elif ev == "shard_entry_selected":
+            if d.get("entry") not in _ENTRIES:
+                err(i, f"shard_entry_selected unknown entry "
+                       f"{d.get('entry')!r}")
+            if not (isinstance(d.get("reason"), str) and d["reason"]):
+                err(i, "shard_entry_selected missing string 'reason'")
+            if not isinstance(d.get("inputs"), dict):
+                err(i, "shard_entry_selected missing 'inputs' object "
+                       "(decision must be replayable)")
+            if not _is_hex(d.get("input_digest")):
+                err(i, "shard_entry_selected missing hex "
+                       "'input_digest'")
+        elif ev == "unit_stolen":
+            for field in ("unit", "victim", "thief", "incarnation"):
+                v = d.get(field)
+                if not (isinstance(v, int) and not isinstance(v, bool)
+                        and v >= 0):
+                    err(i, f"unit_stolen missing non-negative int "
+                           f"{field!r}")
+            if isinstance(d.get("victim"), int) and \
+                    isinstance(d.get("thief"), int) and \
+                    d["victim"] == d["thief"]:
+                err(i, "unit_stolen victim equals thief — a shard "
+                       "cannot steal its own unit")
         elif ev == "startup_seconds":
             for k, v in d.items():
                 if k in ("event", "t"):
