@@ -61,7 +61,12 @@ def atomic_write(path: str, payload: str, *,
     the streaming checkpoint in parallel/pipeline.py, the evidence
     ledger) so the durability discipline cannot drift between copies.
     A fired fault leaves the torn tmp behind — that IS the post-crash
-    disk state the resume paths must tolerate.
+    disk state the resume paths must tolerate.  An OSError (a real or
+    injected disk-full, ENOSPC) is different: the writer is still
+    alive to clean up, so the tmp is removed before re-raising — a
+    disk-full run must not leave torn durable artifacts behind.  The
+    two contracts coexist because InjectedTornWrite is a
+    RuntimeError, never an OSError.
 
     ``fsync=False`` keeps the tmp+rename atomicity but skips BOTH
     syncs — for writers whose content durability is not load-bearing
@@ -70,14 +75,21 @@ def atomic_write(path: str, payload: str, *,
     parent = os.path.dirname(os.path.abspath(path)) or "."
     os.makedirs(parent, exist_ok=True)
     fd, tmp = tempfile.mkstemp(dir=parent, suffix=".tmp")
-    with os.fdopen(fd, "w") as f:
-        f.write(payload)
-        if fsync:
-            f.flush()
-            os.fsync(f.fileno())
-    if fault_site is not None:
-        _faults.fire(fault_site, path=tmp)
-    os.replace(tmp, path)
+    try:
+        with os.fdopen(fd, "w") as f:
+            f.write(payload)
+            if fsync:
+                f.flush()
+                os.fsync(f.fileno())
+        if fault_site is not None:
+            _faults.fire(fault_site, path=tmp)
+        os.replace(tmp, path)
+    except OSError:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
     if fsync:
         fsync_dir(parent)
 

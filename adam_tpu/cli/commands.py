@@ -1769,6 +1769,46 @@ class TopCommand(Command):
 
 
 @register
+class GcCommand(Command):
+    name = "gc"
+    help = ("Collect retired spool artifacts (result docs, claim "
+            "tables, ring files, rotated series) under the retention "
+            "floors; serve loops also sweep periodically")
+
+    def add_args(self, p: argparse.ArgumentParser) -> None:
+        from ..serve import retention
+
+        p.add_argument("spool", help="the spool (or fleet) directory")
+        p.add_argument("-min_age_s", type=float,
+                       default=retention.DEFAULT_MIN_AGE_S,
+                       help="age floor: never collect anything "
+                            "younger than this many seconds")
+        p.add_argument("-keep", type=int, metavar="N",
+                       default=retention.DEFAULT_KEEP_PER_KIND,
+                       help="count floor: the N newest of each "
+                            "artifact kind always survive")
+        p.add_argument("-dry_run", action="store_true",
+                       help="decide + print, delete nothing")
+
+    def run(self, args) -> int:
+        from ..serve import retention
+
+        if not os.path.isdir(args.spool):
+            print(f"gc: no such spool: {args.spool}", file=sys.stderr)
+            return 2
+        d = retention.sweep(args.spool, min_age_s=args.min_age_s,
+                            keep_per_kind=args.keep,
+                            dry_run=args.dry_run)
+        verb = "would collect" if args.dry_run else "removed"
+        print(f"gc: {verb} {len(d['collect'])} of "
+              f"{len(d['inputs']['candidates'])} candidate(s) "
+              f"({d['reason']})")
+        for rel in d["collect"]:
+            print(f"  - {rel}")
+        return 0
+
+
+@register
 class ExplainCommand(Command):
     name = "explain"
     help = ("Reconstruct one served job's causal timeline (queue "

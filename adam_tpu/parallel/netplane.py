@@ -1,0 +1,717 @@
+"""TCP data plane for cross-box fleets (the third transport).
+
+``decide_transport``'s cross-box leg was a fiction until this module:
+workers either mmap'd the supervisor's page cache (``ring``) or shared
+a filesystem (``fleet_dir``).  The net plane carries everything those
+two ship — unit-result segments, broadcast blobs (markdup dup bits +
+MD events), heartbeat leases, and the job/result relay (assignments,
+redistributed extras, the done signal) — over length-framed,
+CRC32-checked messages, so a fleet needs nothing but ``host:port``.
+
+Frame discipline mirrors the ring's (ringplane.py): fixed header
+``(magic, header_len, payload_len, crc32)``, JSON header, raw payload.
+A frame that fails magic/length/CRC is DETECTED AND NEVER TRUSTED —
+the receiver drops the connection (a byte stream cannot resync past
+garbage) and the sender reconnects and resends; the supervisor's
+first-wins merge dedup by ``(incarnation, shard, seq)`` absorbs the
+redelivery, so exactly-once stays structural, not protocol-dependent.
+
+Robustness contract:
+
+* per-connection deadlines (socket timeouts, ``ADAM_TPU_FLEET_NET_TIMEOUT_S``);
+* reconnect with exponential backoff and digest-deterministic jitter
+  (``resilience.retry.backoff_delay`` — replayable chaos);
+* the worker-local npz spool stays authoritative: every segment is
+  renamed into the local spool BEFORE it is sent, and the progress
+  marker lands only AFTER the supervisor acks, so a kill mid-send
+  recomputes (and the dedup absorbs) instead of losing work;
+* past the retry budget the worker degrades TYPED: fall back to the
+  shared spool (``ADAM_TPU_FLEET_SHARED_DIR``) when one is usable —
+  local commits are copied over and the worker re-enters the
+  ``fleet_dir`` plane — else it exits with a typed line and the
+  supervisor's ``decide_shard_reassignment`` redistributes the shard;
+* SIGKILL fencing runs on socket-level lease expiry (the supervisor
+  tracks lease *receipt* times), not filesystem mtimes.
+
+Fault sites: ``net_send`` fires MID-FRAME on the worker side (error =
+a dropped connection, truncate = half a frame then close, corrupt =
+garbage bytes on the wire, latency = a slow peer, kill = SIGKILL
+mid-send); ``net_recv`` fires before each server-side frame read;
+``net_accept`` fires per accepted connection.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import socket
+import struct
+import sys
+import threading
+import time
+import zlib
+from typing import Dict, List, Optional, Tuple
+
+from .. import obs
+from ..resilience import faults
+from ..resilience.retry import (DEFAULT_BACKOFF_CAP_S, DEFAULT_BACKOFF_S,
+                                RETRY_SEED_ENV, backoff_delay)
+from . import ringplane
+
+#: supervisor address handed to net workers (host:port) — its presence
+#: in a worker's env IS the transport switch
+NET_ENV = "ADAM_TPU_FLEET_NET"
+#: a worker's stable host identity; the boot handshake reports it and
+#: run_fleet compares supervisor vs worker identity to feed
+#: decide_transport a real ``same_box`` signal
+HOST_ID_ENV = "ADAM_TPU_FLEET_HOST_ID"
+#: the shared spool a net worker may degrade onto when the peer is
+#: unreachable past the retry budget; empty/unset = no shared
+#: filesystem exists, fail the shard typed instead
+SHARED_DIR_ENV = "ADAM_TPU_FLEET_SHARED_DIR"
+#: per-connection deadline (connect + each send/recv), seconds
+NET_TIMEOUT_ENV = "ADAM_TPU_FLEET_NET_TIMEOUT_S"
+#: reconnect budget per request (retries after the first attempt)
+NET_RETRIES_ENV = "ADAM_TPU_FLEET_NET_RETRIES"
+#: backoff base for reconnects (cap rides the retry default)
+NET_BACKOFF_ENV = "ADAM_TPU_FLEET_NET_BACKOFF_S"
+#: supervisor bind address (default loopback — the emulated pod)
+NET_BIND_ENV = "ADAM_TPU_FLEET_NET_BIND"
+
+DEFAULT_TIMEOUT_S = 10.0
+DEFAULT_RETRIES = 4
+
+#: frame header: magic, header_len, payload_len, crc32(header+payload)
+_MAGIC = 0x41544E50                     # "ATNP"
+_FRAME = struct.Struct("<IIII")
+#: bounded lengths: a garbage length field must not allocate the moon
+MAX_HEADER_BYTES = 8 << 20
+MAX_PAYLOAD_BYTES = 256 << 20
+
+
+class NetError(RuntimeError):
+    """Base of every net-plane failure (typed, like InjectedFault)."""
+
+
+class NetFrameError(NetError):
+    """A frame failed magic/length/CRC validation, or the stream ended
+    mid-frame — torn/garbage bytes, never trusted, never parsed."""
+
+
+class NetUnreachable(NetError):
+    """The peer stayed unreachable past the whole retry budget."""
+
+
+class NetDegraded(Exception):
+    """Raised by the worker plane after copying its local spool onto a
+    usable shared dir: the caller re-enters the ``fleet_dir`` plane
+    rooted there.  NOT a NetError — it is a handled transition, and
+    catching NetError must never swallow it."""
+
+    def __init__(self, shared_dir: str, cause: str):
+        self.shared_dir = shared_dir
+        self.cause = cause
+        super().__init__(
+            f"net plane degraded to shared spool {shared_dir!r}: {cause}")
+
+
+def host_identity(env: Optional[dict] = None) -> str:
+    """This process's (or a worker env's) stable host identity:
+    ``ADAM_TPU_FLEET_HOST_ID`` wins, else the hostname — how two
+    emulated 'hosts' on one box get distinct identities in tests and
+    how real hosts get real ones."""
+    env = os.environ if env is None else env
+    return str(env.get(HOST_ID_ENV) or "") or socket.gethostname()
+
+
+def probe_net() -> bool:
+    """Whether a loopback socket can be bound at all — the capability
+    input ``decide_transport`` consumes for its net leg (the net twin
+    of ringplane.probe_mmap)."""
+    try:
+        s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        try:
+            s.bind(("127.0.0.1", 0))
+        finally:
+            s.close()
+        return True
+    except OSError:
+        return False
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        v = os.environ.get(name)
+        return float(v) if v else default
+    except ValueError:
+        return default
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        v = os.environ.get(name)
+        return int(v) if v else default
+    except ValueError:
+        return default
+
+
+# ---------------------------------------------------------------------------
+# frame codec
+# ---------------------------------------------------------------------------
+
+def send_frame(sock: socket.socket, header: dict,
+               payload: bytes = b"", *,
+               fault_site: Optional[str] = None) -> None:
+    """Write one framed message.  With a fault site, the frame goes out
+    in two halves with the injection hook between them — an injected
+    kill IS a SIGKILL mid-frame, truncate closes the socket after half
+    a frame, corrupt puts garbage bytes on the wire; all three leave
+    the receiver a torn frame it must detect and drop."""
+    hb = json.dumps(header, sort_keys=True).encode()
+    if len(hb) > MAX_HEADER_BYTES or len(payload) > MAX_PAYLOAD_BYTES:
+        raise NetFrameError("frame exceeds protocol bounds")
+    crc = zlib.crc32(hb + payload) & 0xFFFFFFFF
+    buf = _FRAME.pack(_MAGIC, len(hb), len(payload), crc) + hb + payload
+    if fault_site is None:
+        sock.sendall(buf)
+    else:
+        half = max(len(buf) // 2, 1)
+        sock.sendall(buf[:half])
+        try:
+            faults.fire(fault_site)
+        except faults.InjectedTornWrite as e:
+            if getattr(e, "fault", "") == "corrupt":
+                try:
+                    sock.sendall(b"\xff" * 64)
+                except OSError:
+                    pass
+            raise
+        sock.sendall(buf[half:])
+    obs.registry().counter("net_frames_out").inc()
+    obs.registry().counter("net_bytes_out").inc(len(buf))
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    chunks = []
+    got = 0
+    while got < n:
+        chunk = sock.recv(min(n - got, 1 << 20))
+        if not chunk:
+            raise NetFrameError(
+                f"stream ended mid-frame ({got}/{n} bytes)")
+        chunks.append(chunk)
+        got += len(chunk)
+    return b"".join(chunks)
+
+
+def recv_frame(sock: socket.socket, *,
+               fault_site: Optional[str] = None) -> Tuple[dict, bytes]:
+    """Read one framed message, validating magic, bounds, and CRC —
+    garbage is detected and raised as :class:`NetFrameError`, never
+    parsed.  The caller's only safe recovery is dropping the
+    connection: a byte stream cannot resync past a torn frame."""
+    if fault_site is not None:
+        faults.fire(fault_site)
+    hdr = _recv_exact(sock, _FRAME.size)
+    magic, hlen, plen, crc = _FRAME.unpack(hdr)
+    if magic != _MAGIC:
+        raise NetFrameError(f"bad frame magic {magic:#010x}")
+    if hlen > MAX_HEADER_BYTES or plen > MAX_PAYLOAD_BYTES:
+        raise NetFrameError(
+            f"frame lengths out of bounds ({hlen}/{plen})")
+    body = _recv_exact(sock, hlen + plen)
+    if zlib.crc32(body) & 0xFFFFFFFF != crc:
+        raise NetFrameError("frame CRC mismatch")
+    try:
+        header = json.loads(body[:hlen])
+    except ValueError as e:
+        raise NetFrameError(f"frame header is not JSON: {e}") from e
+    if not isinstance(header, dict) or "t" not in header:
+        raise NetFrameError("frame header missing message type")
+    obs.registry().counter("net_frames_in").inc()
+    obs.registry().counter("net_bytes_in").inc(
+        _FRAME.size + hlen + plen)
+    return header, body[hlen:]
+
+
+# ---------------------------------------------------------------------------
+# client (worker side)
+# ---------------------------------------------------------------------------
+
+class NetClient:
+    """One worker's connection to the supervisor: synchronous framed
+    request/response with per-connection deadlines and deterministic
+    reconnect backoff.  Thread-safe (the lease thread and the worker
+    main loop share it); a request that fails mid-flight closes the
+    socket and RESENDS on a fresh connection — the server side dedups
+    by ``(incarnation, shard, seq)``, so resend-on-doubt is always the
+    right move."""
+
+    def __init__(self, address: str, shard: int):
+        host, _, port = address.rpartition(":")
+        self.host, self.port = host or "127.0.0.1", int(port)
+        self.shard = int(shard)
+        self.timeout_s = _env_float(NET_TIMEOUT_ENV, DEFAULT_TIMEOUT_S)
+        self.retries = _env_int(NET_RETRIES_ENV, DEFAULT_RETRIES)
+        self.backoff_s = _env_float(NET_BACKOFF_ENV, DEFAULT_BACKOFF_S)
+        self.seed = _env_int(RETRY_SEED_ENV, 0)
+        self._sock: Optional[socket.socket] = None
+        self._lock = threading.Lock()
+
+    def _connect(self) -> None:
+        s = socket.create_connection((self.host, self.port),
+                                     timeout=self.timeout_s)
+        s.settimeout(self.timeout_s)
+        try:
+            s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        except OSError:
+            pass
+        self._sock = s
+        obs.registry().counter("net_connects").inc()
+        obs.emit("net_connect", shard=self.shard, host=self.host,
+                 port=self.port)
+
+    def _drop(self) -> None:
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
+
+    def request(self, header: dict, payload: bytes = b"", *,
+                retries: Optional[int] = None) -> Tuple[dict, bytes]:
+        """Send one message and return the peer's reply, reconnecting
+        and resending on any failure until the retry budget runs out
+        (then :class:`NetUnreachable`).  Injected net faults — drops,
+        torn frames, latency — ride the same recovery as real ones."""
+        budget = self.retries if retries is None else int(retries)
+        kind = str(header.get("t", "?"))
+        last: Optional[BaseException] = None
+        with self._lock:
+            for attempt in range(1, budget + 2):
+                try:
+                    if self._sock is None:
+                        self._connect()
+                    send_frame(self._sock, header, payload,
+                               fault_site="net_send")
+                    reply, rp = recv_frame(self._sock)
+                    if reply.get("t") == "err":
+                        raise NetError(
+                            f"peer rejected {kind!r}: "
+                            f"{reply.get('msg')}")
+                    return reply, rp
+                except (OSError, NetFrameError,
+                        faults.InjectedDeviceError,
+                        faults.InjectedTornWrite) as e:
+                    # one recovery for real and injected failures:
+                    # drop the connection, back off, reconnect, resend
+                    self._drop()
+                    last = e
+                    if attempt > budget:
+                        break
+                    delay = backoff_delay(
+                        f"net:{self.shard}:{kind}", attempt,
+                        self.backoff_s, DEFAULT_BACKOFF_CAP_S,
+                        seed=self.seed)
+                    obs.registry().counter("net_retries").inc()
+                    obs.emit("net_retry", shard=self.shard, kind=kind,
+                             attempt=attempt, delay_s=delay,
+                             error=type(e).__name__)
+                    time.sleep(delay)
+        raise NetUnreachable(
+            f"peer {self.host}:{self.port} unreachable for {kind!r} "
+            f"after {budget} retries: {type(last).__name__}: {last}"
+        ) from last
+
+    def close(self) -> None:
+        with self._lock:
+            self._drop()
+
+
+class NetHeartbeat:
+    """The worker lease renewal loop over TCP — the net twin of
+    shardstream.Heartbeat, same fault site, same typed-death contract.
+    A renewal the supervisor never receives needs no local action:
+    socket-level lease expiry fences us from the supervisor side, the
+    safe direction (so net failures here are swallowed, not fatal)."""
+
+    def __init__(self, client: NetClient, shard: int, incarnation: int,
+                 heartbeat_s: float):
+        self.client = client
+        self.shard = shard
+        self.incarnation = incarnation
+        self.heartbeat_s = heartbeat_s
+        self._stop = threading.Event()
+        self._seq = 0
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="net-lease")
+
+    def start(self) -> "NetHeartbeat":
+        try:
+            self._beat()                # lease exists before any work
+        except NetError:
+            pass
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def _beat(self) -> None:
+        faults.fire("shard_lease")
+        self._seq += 1
+        self.client.request(
+            dict(t="lease", shard=self.shard,
+                 incarnation=self.incarnation, seq=self._seq),
+            retries=1)
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.heartbeat_s):
+            try:
+                self._beat()
+            except faults.InjectedFault as e:
+                sys.stderr.write(
+                    f"shard-worker: lease renewal failed (typed): "
+                    f"{type(e).__name__}: {e}\n")
+                sys.stderr.flush()
+                os._exit(13)
+            except NetError:
+                continue            # expiry fences us; keep trying
+            except OSError:
+                continue
+
+
+class NetWorkerPlane:
+    """The worker side of the net transport, presenting the same plane
+    surface shardstream's ``_FileWorkerPlane`` does: load (the boot
+    handshake + broadcast blob fetch), heartbeat, publish, poll.  The
+    local dir is this worker's authoritative spool — commits and the
+    progress marker live there; nothing is ever read from or written
+    to a shared filesystem unless the plane degrades."""
+
+    supports_steal = False
+
+    def __init__(self, address: str, local_dir: str, shard: int):
+        self.dir = local_dir
+        self.shard = int(shard)
+        self.client = NetClient(address, shard)
+        self.incarnation = 0
+
+    # -- handshake ---------------------------------------------------------
+
+    def load(self) -> Optional[dict]:
+        h, _ = self._rpc(dict(t="hello", shard=self.shard,
+                              pid=os.getpid(),
+                              host=host_identity()))
+        spec = h.get("spec")
+        if not isinstance(spec, dict):
+            return None
+        self.incarnation = int(h.get("incarnation", 0))
+        os.makedirs(self.dir, exist_ok=True)
+        for name in h.get("blobs", []):
+            self._fetch_blob(str(name))
+        # the worker's view of the fleet dir IS its local spool: the
+        # task runtimes load broadcast blobs from spec["fleet_dir"],
+        # which now points at the just-fetched local copies
+        return dict(spec=dict(spec, fleet_dir=self.dir),
+                    incarnation=self.incarnation,
+                    runs=list(h.get("runs", [])))
+
+    def _fetch_blob(self, name: str) -> None:
+        base = os.path.basename(name)
+        dst = os.path.join(self.dir, base)
+        if os.path.exists(dst):
+            return                  # a respawn re-uses its local copy
+        h, payload = self._rpc(dict(t="blob", shard=self.shard,
+                                    name=base))
+        # frame CRC already vouched for the bytes; tmp+rename so a
+        # kill mid-write leaves no torn blob for the next incarnation
+        tmp = dst + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(payload)
+        os.replace(tmp, dst)
+        obs.registry().counter("broadcast_blob_bytes_net").inc(
+            len(payload))
+
+    # -- plane surface -----------------------------------------------------
+
+    def prepare(self, spec: dict, incarnation: int) -> None:
+        self.incarnation = int(incarnation)
+
+    def heartbeat(self, heartbeat_s: float,
+                  incarnation: int) -> NetHeartbeat:
+        return NetHeartbeat(self.client, self.shard, incarnation,
+                            heartbeat_s).start()
+
+    def publish(self, seq: int,
+                results: List[Tuple[int, dict]]) -> None:
+        payload = ringplane.encode_unit_results(results)
+        self._rpc(dict(t="result", shard=self.shard,
+                       incarnation=self.incarnation, seq=int(seq),
+                       n=len(results)), payload)
+
+    def poll(self, incarnation: int, seen_version: int,
+             ticks: int) -> dict:
+        h, _ = self._rpc(dict(t="status", shard=self.shard,
+                              incarnation=int(incarnation),
+                              version=int(seen_version)))
+        out = dict(stop=bool(h.get("done")) or bool(h.get("fenced")),
+                   extra=None)
+        if int(h.get("version", 0)) > seen_version:
+            out["extra"] = (int(h["version"]), list(h.get("runs", [])))
+        return out
+
+    def close(self) -> None:
+        self.client.close()
+
+    # -- degradation -------------------------------------------------------
+
+    def _rpc(self, header: dict,
+             payload: bytes = b"") -> Tuple[dict, bytes]:
+        try:
+            return self.client.request(header, payload)
+        except NetUnreachable as e:
+            self._degrade_or_raise(e)
+            raise               # pragma: no cover — above always raises
+
+    def _degrade_or_raise(self, err: NetUnreachable) -> None:
+        """Peer gone past the retry budget: typed degradation.  A
+        usable shared spool (its plan file parses) absorbs this
+        worker's local commits and progress — duplicates are absorbed
+        by the supervisor's first-wins merge — and the caller re-enters
+        the fleet_dir plane there; no shared spool means the shard
+        fails cleanly typed and the supervisor redistributes it."""
+        shared = os.environ.get(SHARED_DIR_ENV) or ""
+        plan = None
+        if shared:
+            try:
+                with open(os.path.join(shared, "plan.json")) as f:
+                    plan = json.load(f)
+            except (OSError, ValueError):
+                plan = None
+        if not isinstance(plan, dict):
+            raise err
+        for sub in ("commits", "progress"):
+            src = os.path.join(self.dir, sub)
+            if not os.path.isdir(src):
+                continue
+            dstdir = os.path.join(shared, sub)
+            os.makedirs(dstdir, exist_ok=True)
+            for name in sorted(os.listdir(src)):
+                dst = os.path.join(dstdir, name)
+                if sub == "commits" and os.path.exists(dst):
+                    continue    # immutable once renamed; keep theirs
+                tmp = os.path.join(dstdir, f".{name}.net.tmp")
+                shutil.copyfile(os.path.join(src, name), tmp)
+                os.replace(tmp, dst)
+        obs.registry().counter("net_degradations").inc()
+        obs.emit("net_degraded", shard=self.shard,
+                 shared_dir=shared, error=str(err))
+        raise NetDegraded(shared, str(err))
+
+
+# ---------------------------------------------------------------------------
+# server (supervisor side)
+# ---------------------------------------------------------------------------
+
+class NetServer:
+    """The supervisor's end of the net plane: an accept loop plus one
+    handler thread per connection, serving the boot handshake
+    (spec + assignment + blob names), broadcast blob bytes, lease
+    receipt, the status relay (done/fenced/extra), and unit-result
+    ingestion.  Results are stashed raw under ``(incarnation, shard,
+    seq)`` and ACKED ONLY AFTER the stash — the client treats anything
+    unacked as unsent, and the supervisor's merge dedup makes the
+    resulting at-least-once delivery exactly-once.
+
+    All mutable state is instance-held behind one lock; the supervisor
+    main loop pushes assignment snapshots in (``update_state``) and
+    drains results out (``drain_results``), so handler threads never
+    touch supervisor internals."""
+
+    def __init__(self, plan_doc: dict, blobs: Dict[str, str],
+                 bind: Optional[str] = None):
+        self._plan_doc = plan_doc
+        self._blobs = dict(blobs)
+        host = bind or os.environ.get(NET_BIND_ENV) or "127.0.0.1"
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, 0))
+        self._sock.listen(64)
+        self._sock.settimeout(0.2)
+        self.host, self.port = self._sock.getsockname()[:2]
+        self.timeout_s = _env_float(NET_TIMEOUT_ENV, DEFAULT_TIMEOUT_S)
+        self._lock = threading.Lock()
+        self._results: Dict[Tuple[int, int, int], bytes] = {}
+        self._leases: Dict[int, Tuple[float, int]] = {}
+        self._hosts: Dict[int, str] = {}
+        self._state: Dict[int, dict] = {}
+        self._done = False
+        self._stop = threading.Event()
+        self._threads: List[threading.Thread] = []
+
+    def address(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    def start(self) -> "NetServer":
+        t = threading.Thread(target=self._accept_loop, daemon=True,
+                             name="netplane-accept")
+        t.start()
+        self._threads.append(t)
+        return self
+
+    def close(self) -> None:
+        self._stop.set()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        for t in self._threads:
+            t.join(timeout=2.0)
+
+    # -- supervisor-facing state -------------------------------------------
+
+    def update_state(self, shard: int, *, incarnation: int,
+                     runs: List[List[int]], extra_version: int,
+                     extra_runs: List[List[int]]) -> None:
+        with self._lock:
+            self._state[int(shard)] = dict(
+                incarnation=int(incarnation), runs=list(runs),
+                extra_version=int(extra_version),
+                extra_runs=list(extra_runs))
+
+    def set_done(self) -> None:
+        with self._lock:
+            self._done = True
+
+    def drain_results(self) -> List[Tuple[Tuple[int, int, int], bytes]]:
+        with self._lock:
+            out = sorted(self._results.items())
+            self._results.clear()
+        return out
+
+    def lease_age(self, shard: int,
+                  incarnation: int) -> Optional[float]:
+        """Seconds since the last lease RECEIVED from this shard's
+        current incarnation; None when none arrived yet (the boot
+        grace applies).  Receipt time is supervisor-local monotonic —
+        no clocks are compared across hosts."""
+        with self._lock:
+            ent = self._leases.get(int(shard))
+        if ent is None or ent[1] != int(incarnation):
+            return None
+        return time.monotonic() - ent[0]
+
+    def clear_lease(self, shard: int) -> None:
+        with self._lock:
+            self._leases.pop(int(shard), None)
+
+    def host_of(self, shard: int) -> Optional[str]:
+        with self._lock:
+            return self._hosts.get(int(shard))
+
+    # -- accept / handle ---------------------------------------------------
+
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, _addr = self._sock.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return              # socket closed: shutting down
+            try:
+                faults.fire("net_accept")
+            except faults.InjectedFault:
+                obs.registry().counter("net_accept_rejects").inc()
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+                continue
+            conn.settimeout(self.timeout_s)
+            t = threading.Thread(target=self._handle, args=(conn,),
+                                 daemon=True, name="netplane-conn")
+            t.start()
+            self._threads.append(t)
+
+    def _handle(self, conn: socket.socket) -> None:
+        try:
+            while not self._stop.is_set():
+                try:
+                    header, payload = recv_frame(
+                        conn, fault_site="net_recv")
+                except (NetFrameError, faults.InjectedFault) as e:
+                    # torn/garbage frame (or an injected recv fault):
+                    # detected, counted, connection dropped — the
+                    # sender reconnects and resends, dedup absorbs
+                    if isinstance(e, NetFrameError) and \
+                            "stream ended" not in str(e):
+                        obs.registry().counter(
+                            "net_garbage_frames").inc()
+                    return
+                except (socket.timeout, OSError):
+                    return          # idle past deadline or peer reset
+                try:
+                    reply, rp = self._dispatch(header, payload)
+                except faults.InjectedFault:
+                    return
+                try:
+                    send_frame(conn, reply, rp)
+                except (OSError, NetError):
+                    return
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def _dispatch(self, header: dict,
+                  payload: bytes) -> Tuple[dict, bytes]:
+        kind = header.get("t")
+        shard = int(header.get("shard", -1))
+        if kind == "hello":
+            with self._lock:
+                st = dict(self._state.get(shard) or {})
+                self._hosts[shard] = str(header.get("host", ""))
+            return (dict(t="ok", spec=self._plan_doc,
+                         incarnation=int(st.get("incarnation", 0)),
+                         runs=list(st.get("runs", [])),
+                         blobs=sorted(self._blobs)), b"")
+        if kind == "blob":
+            name = os.path.basename(str(header.get("name", "")))
+            path = self._blobs.get(name)
+            if path is None:
+                return dict(t="err", msg=f"unknown blob {name!r}"), b""
+            try:
+                with open(path, "rb") as f:
+                    blob = f.read()
+            except OSError as e:
+                return dict(t="err", msg=f"blob read failed: {e}"), b""
+            return dict(t="ok", name=name), blob
+        if kind == "lease":
+            with self._lock:
+                self._leases[shard] = (
+                    time.monotonic(), int(header.get("incarnation", 0)))
+            return dict(t="ok"), b""
+        if kind == "result":
+            key = (int(header.get("incarnation", 0)), shard,
+                   int(header.get("seq", 0)))
+            with self._lock:
+                self._results.setdefault(key, payload)
+            obs.registry().counter("net_segments").inc()
+            # the ack leaves only AFTER the stash: an acked segment can
+            # never be lost to a supervisor-side race
+            return dict(t="ok"), b""
+        if kind == "status":
+            with self._lock:
+                st = dict(self._state.get(shard) or {})
+                done = self._done
+            fenced = int(header.get("incarnation", -1)) != \
+                int(st.get("incarnation", 0))
+            return (dict(t="ok", done=done, fenced=fenced,
+                         version=int(st.get("extra_version", 0)),
+                         runs=list(st.get("extra_runs", []))), b"")
+        return dict(t="err", msg=f"unknown message type {kind!r}"), b""

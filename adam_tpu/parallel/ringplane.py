@@ -65,7 +65,7 @@ RING_DIR = "ring"
 CLAIM_DIR = "claims"
 
 #: knobs (the resolve-from-env convention of ADAM_TPU_FLEET_*)
-TRANSPORT_ENV = "ADAM_TPU_FLEET_TRANSPORT"     # auto | ring | fleet_dir
+TRANSPORT_ENV = "ADAM_TPU_FLEET_TRANSPORT"     # auto | ring | fleet_dir | net
 SPOOL_SYNC_ENV = "ADAM_TPU_FLEET_SPOOL_SYNC"   # auto | batched | every
 ENTRY_ENV = "ADAM_TPU_FLEET_ENTRY"             # auto | index | forward
 RING_BYTES_ENV = "ADAM_TPU_RING_BYTES"
@@ -102,32 +102,54 @@ def _digest(inputs: dict) -> str:
 # ---------------------------------------------------------------------------
 
 def decide_transport(*, requested: str, same_box: bool,
-                     mmap_capable: bool, spool_requested: str) -> dict:
+                     mmap_capable: bool, spool_requested: str,
+                     net_available=None) -> dict:
     """Which data plane a fleet run uses — PURE.
 
     ``transport`` ∈ ``ring`` (mmap ring segments + spool as durable
-    spine) / ``fleet_dir`` (spool only, the PR 9 plane).  The ring
-    engages only when workers share the supervisor's box (page-cache
-    coherence is the whole mechanism) and the fleet dir's filesystem
-    takes an mmap.  ``spool_sync`` ∈ ``batched`` (one directory fsync
-    per commit window) / ``every`` (the conservative per-file
-    discipline); ``auto`` resolves to batched.  Recorded in full by
-    ``transport_selected``; tools/check_executor.py replays it.
+    spine) / ``fleet_dir`` (spool only, the PR 9 plane) / ``net``
+    (length-framed TCP segments, parallel/netplane.py — the cross-box
+    plane that needs no shared filesystem).  The ring engages only
+    when workers share the supervisor's box (page-cache coherence is
+    the whole mechanism) and the fleet dir's filesystem takes an mmap;
+    cross-box workers get the net plane when a socket can be bound
+    (``net_available``, netplane.probe_net), else the shared-spool
+    fallback.  ``net_available`` joins the recorded inputs ONLY when
+    the caller supplies it (cross-box or explicit request), so
+    pre-net sidecars replay digest-identical.  ``spool_sync`` ∈
+    ``batched`` (one directory fsync per commit window) / ``every``
+    (the conservative per-file discipline); ``auto`` resolves to
+    batched.  Recorded in full by ``transport_selected``;
+    tools/check_executor.py replays it.
     """
     inputs = dict(requested=str(requested), same_box=bool(same_box),
                   mmap_capable=bool(mmap_capable),
                   spool_requested=str(spool_requested))
+    if net_available is not None:
+        inputs["net_available"] = bool(net_available)
+    net_cap = bool(inputs.get("net_available", False))
     reasons = []
     if inputs["requested"] == "fleet_dir":
         transport, why = "fleet_dir", "forced"
+    elif inputs["requested"] == "net":
+        transport, why = "net", "forced"
     elif not inputs["mmap_capable"]:
-        transport, why = "fleet_dir", "no-mmap"
+        if not inputs["same_box"] and net_cap:
+            # no mmap AND no page-cache coherence: TCP beats a shared
+            # spool that cannot even take the ring
+            transport, why = "net", "no-mmap-cross-box"
+        else:
+            transport, why = "fleet_dir", "no-mmap"
     elif inputs["requested"] == "ring":
         transport, why = "ring", "forced"
     elif not inputs["same_box"]:
-        # cross-box workers share no page cache: the spool (a shared
-        # filesystem) is the only coherent medium
-        transport, why = "fleet_dir", "cross-box"
+        # cross-box workers share no page cache: the net plane if a
+        # socket binds, else the spool (a shared filesystem) is the
+        # only coherent medium
+        if net_cap:
+            transport, why = "net", "cross-box-net"
+        else:
+            transport, why = "fleet_dir", "cross-box"
     else:
         transport, why = "ring", "same-box"
     reasons.append(why)

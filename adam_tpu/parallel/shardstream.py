@@ -82,6 +82,7 @@ torn-checkpoint × shard → byte-identical or cleanly typed).
 
 from __future__ import annotations
 
+import dataclasses
 import glob as _glob
 import hashlib
 import json
@@ -101,7 +102,7 @@ from ..checkpoint import fsync_dir as _fsync_dir
 from ..resilience import faults
 from ..resilience.retry import (RETRY_SEED_ENV, FleetPolicy,
                                 resolve_fleet_policy)
-from . import ringplane
+from . import netplane, ringplane
 
 #: fleet-dir layout (every path is relative to the fleet dir)
 PLAN_FILE = "plan.json"
@@ -112,6 +113,10 @@ LEASE_DIR = "leases"
 PROGRESS_DIR = "progress"
 COMMIT_DIR = "commits"
 LOG_DIR = "logs"
+#: net-transport worker-local spools (one per shard, under the
+#: supervisor's fleet dir only because the emulated pod shares a box —
+#: a real cross-box worker roots its local spool anywhere)
+LOCAL_DIR = "local"
 
 #: per-worker CPU budget (Arrow decode/IO pools), stamped by the
 #: supervisor when ``worker_cpus`` is set — hosts emulated on one box
@@ -873,30 +878,142 @@ def _commit_unit_results(fleet_dir: str, shard: int, incarnation: int,
                            fsync=fsync)
 
 
+class _FileWorkerPlane:
+    """The shared-filesystem worker plane: plan/assign/extra/done ride
+    files in the fleet dir, leases are mtime heartbeats, and delivery
+    is the spool itself (plus the mmap ring when the transport says
+    so).  ``netplane.NetWorkerPlane`` presents the same surface over
+    TCP; ``_run_worker_body`` is written against the surface, so the
+    worker loop cannot drift between transports."""
+
+    supports_steal = True
+
+    def __init__(self, fleet_dir: str, shard: int):
+        self.dir = fleet_dir
+        self.shard = shard
+        self._ring: Optional["ringplane.RingWriter"] = None
+        self._assign_path = os.path.join(fleet_dir, ASSIGN_DIR,
+                                         f"shard{shard}.json")
+        self._sup_pid = 0
+
+    def load(self) -> Optional[dict]:
+        spec = _read_json(os.path.join(self.dir, PLAN_FILE))
+        if spec is None:
+            return None
+        assign = _read_json(self._assign_path) or {}
+        self._sup_pid = int(spec.get("supervisor_pid") or 0)
+        return dict(spec=dict(spec, fleet_dir=self.dir),
+                    incarnation=int(assign.get("incarnation", 0)),
+                    runs=list(assign.get("runs", [])))
+
+    def prepare(self, spec: dict, incarnation: int) -> None:
+        if spec.get("transport") == "ring":
+            self._ring = ringplane.RingWriter(
+                os.path.join(self.dir, ringplane.RING_DIR,
+                             f"shard{self.shard}-inc{incarnation}.ring"),
+                int(spec.get("ring_bytes")
+                    or ringplane.DEFAULT_RING_BYTES),
+                self.shard, incarnation)
+
+    def heartbeat(self, heartbeat_s: float,
+                  incarnation: int) -> Heartbeat:
+        return Heartbeat(
+            os.path.join(self.dir, LEASE_DIR, f"shard{self.shard}.json"),
+            heartbeat_s, incarnation).start()
+
+    def publish(self, seq: int, results: List[Tuple[int, dict]]) -> None:
+        if self._ring is not None:
+            self._ring.publish(seq, results)
+
+    def poll(self, incarnation: int, seen_version: int,
+             ticks: int) -> dict:
+        """One drain tick: done file, incarnation fencing, orphan
+        detection (a hard-killed supervisor never writes the done
+        file), and the redistributed-extra relay."""
+        if os.path.exists(os.path.join(self.dir, DONE_FILE)):
+            return dict(stop=True, extra=None)
+        cur = _read_json(self._assign_path) or {}
+        if int(cur.get("incarnation", incarnation)) != incarnation:
+            return dict(stop=True, extra=None)  # fenced: newer owner
+        if self._sup_pid and ticks % 40 == 0:   # ~every 2 s
+            try:
+                os.kill(self._sup_pid, 0)
+            except OSError:
+                sys.stderr.write(
+                    "shard-worker: supervisor gone — exiting "
+                    "orphaned drain\n")
+                return dict(stop=True, extra=None)
+        extra = _read_json(os.path.join(
+            self.dir, EXTRA_DIR, f"shard{self.shard}.json")) or {}
+        out = dict(stop=False, extra=None)
+        if int(extra.get("version", 0)) > seen_version:
+            out["extra"] = (int(extra["version"]),
+                            list(extra.get("runs", [])))
+        return out
+
+    def close(self) -> None:
+        if self._ring is not None:
+            self._ring.close()
+
+
 def run_shard_worker(fleet_dir: str, shard: int) -> int:
-    """One fleet worker: read the plan + this shard's assignment,
-    stream the assigned unit ranges through the product executor,
-    commit each unit's result durably (commit file, then progress
-    marker), then drain — pick up redistributed / speculative extra
-    units until the supervisor writes the ``done`` file.
+    """One fleet worker: load the plan + this shard's assignment
+    (files, or the net boot handshake), stream the assigned unit
+    ranges through the product executor, commit each unit's result
+    durably (commit file, then progress marker), then drain — pick up
+    redistributed / speculative extra units until the supervisor says
+    done.
+
+    ``ADAM_TPU_FLEET_NET`` in the env selects the TCP plane:
+    ``fleet_dir`` is then this worker's LOCAL spool, and everything
+    shared rides netplane.  A net worker whose peer stays unreachable
+    past the retry budget degrades typed: onto the shared spool when
+    one is usable (NetDegraded — re-enter the file plane there), else
+    a clean typed exit that the supervisor redistributes."""
+    addr = os.environ.get(netplane.NET_ENV)
+    try:
+        if addr:
+            try:
+                return _run_worker_body(
+                    netplane.NetWorkerPlane(addr, fleet_dir, shard),
+                    shard)
+            except netplane.NetDegraded as e:
+                sys.stderr.write(
+                    f"shard-worker: {e}\n")
+                return _run_worker_body(
+                    _FileWorkerPlane(e.shared_dir, shard), shard)
+            except netplane.NetUnreachable as e:
+                sys.stderr.write(
+                    f"shard-worker: net plane unreachable (typed): "
+                    f"{type(e).__name__}: {e}\n")
+                return 15
+        return _run_worker_body(_FileWorkerPlane(fleet_dir, shard),
+                                shard)
+    finally:
+        obs.ioledger.emit_events()
+
+
+def _run_worker_body(plane, shard: int) -> int:
+    """The transport-agnostic worker loop (see run_shard_worker).
 
     Recovery contract: everything before the last progress marker is
     lost-proof; a respawned incarnation recomputes only uncommitted
     units (units any OTHER worker already committed are skipped too —
     the supervisor prunes them from the respawn assignment, and the
-    merge dedups regardless)."""
+    merge dedups regardless).  The marker lands only after
+    ``plane.publish`` returns — on the net plane that means after the
+    supervisor ACKED the segment, so a kill mid-send recomputes and
+    resends instead of losing the window."""
     faults.fire("worker_proc")
-    spec = _read_json(os.path.join(fleet_dir, PLAN_FILE))
-    if spec is None:
-        print(f"shard-worker: no readable plan in {fleet_dir}",
+    boot = plane.load()
+    if boot is None:
+        print(f"shard-worker: no readable plan via {plane.dir}",
               file=sys.stderr)
         return 2
-    spec = dict(spec, fleet_dir=fleet_dir)
-    assign_path = os.path.join(fleet_dir, ASSIGN_DIR,
-                               f"shard{shard}.json")
-    assign = _read_json(assign_path) or {}
-    my_inc = int(assign.get("incarnation", 0))
-    units = _from_runs(assign.get("runs", []))
+    spec = boot["spec"]
+    my_inc = int(boot["incarnation"])
+    units = _from_runs(boot["runs"])
+    fleet_dir = plane.dir
     progress_path = os.path.join(fleet_dir, PROGRESS_DIR,
                                  f"shard{shard}.json")
     prog = _read_json(progress_path) or {}
@@ -905,9 +1022,8 @@ def run_shard_worker(fleet_dir: str, shard: int) -> int:
     obs.registry().gauge("shard_id").set(shard)
     obs.registry().gauge("shard_incarnation").set(my_inc)
 
-    hb = Heartbeat(
-        os.path.join(fleet_dir, LEASE_DIR, f"shard{shard}.json"),
-        float(spec["policy"]["heartbeat_s"]), my_inc).start()
+    plane.prepare(spec, my_inc)
+    hb = plane.heartbeat(float(spec["policy"]["heartbeat_s"]), my_inc)
     unit_result, ex = _RUNTIMES[spec["task"]](spec)
     columns, io_kind, io_pass = _task_io(spec)
     unit_rows = int(spec["unit_rows"])
@@ -915,14 +1031,8 @@ def run_shard_worker(fleet_dir: str, shard: int) -> int:
     entry = str(spec.get("entry", "forward"))
     unit_index = spec.get("unit_index")
     batched = spec.get("spool_sync") == "batched"
-    steal_on = bool(spec.get("policy", {}).get("steal"))
-    ring = None
-    if spec.get("transport") == "ring":
-        ring = ringplane.RingWriter(
-            os.path.join(fleet_dir, ringplane.RING_DIR,
-                         f"shard{shard}-inc{my_inc}.ring"),
-            int(spec.get("ring_bytes")
-                or ringplane.DEFAULT_RING_BYTES), shard, my_inc)
+    steal_on = bool(spec.get("policy", {}).get("steal")) \
+        and plane.supports_steal
     seq = 0
     pending: List[Tuple[int, dict]] = []
     mine = set(units)
@@ -949,8 +1059,11 @@ def run_shard_worker(fleet_dir: str, shard: int) -> int:
                 os.path.getsize(path))
         except OSError:
             pass
-        if ring is not None:
-            ring.publish(seq, pending)
+        # delivery AFTER the local spool rename, BEFORE the marker:
+        # the ring's publish is advisory (the spool is shared), the
+        # net plane's blocks until the supervisor ACKS — either way a
+        # marker can only cover work the supervisor can reach
+        plane.publish(seq, pending)
         done_units.update(u for u, _ in pending)
         pending.clear()
         # marker AFTER the commit file: a crash between them only
@@ -1015,34 +1128,22 @@ def run_shard_worker(fleet_dir: str, shard: int) -> int:
 
     try:
         process(units)
-        # drain: redistributed/speculative extras arrive via the extra
-        # file; exit when the supervisor declares the fleet done — or
-        # when the supervisor itself is GONE (hard-killed: its cleanup
-        # never ran, the done file will never appear, and an orphaned
-        # worker spinning forever would leak a whole jax process)
-        extra_path = os.path.join(fleet_dir, EXTRA_DIR,
-                                  f"shard{shard}.json")
-        done_path = os.path.join(fleet_dir, DONE_FILE)
-        sup_pid = int(spec.get("supervisor_pid") or 0)
+        # drain: redistributed/speculative extras arrive via the
+        # plane's relay (extra file, or the net status poll); exit when
+        # the supervisor declares the fleet done — or when the plane
+        # says stop (fenced by a newer incarnation, or the supervisor
+        # itself is GONE and an orphaned worker spinning forever would
+        # leak a whole jax process)
         seen_version = 0
         ticks = 0
-        while not os.path.exists(done_path):
-            cur = _read_json(assign_path) or {}
-            if int(cur.get("incarnation", my_inc)) != my_inc:
-                break               # fenced: a newer incarnation owns us
+        while True:
             ticks += 1
-            if sup_pid and ticks % 40 == 0:     # ~every 2 s
-                try:
-                    os.kill(sup_pid, 0)
-                except OSError:
-                    sys.stderr.write(
-                        "shard-worker: supervisor gone — exiting "
-                        "orphaned drain\n")
-                    break
-            extra = _read_json(extra_path) or {}
-            if int(extra.get("version", 0)) > seen_version:
-                seen_version = int(extra["version"])
-                new_units = _from_runs(extra.get("runs", []))
+            p = plane.poll(my_inc, seen_version, ticks)
+            if p["stop"]:
+                break
+            if p["extra"] is not None:
+                seen_version, extra_runs = p["extra"]
+                new_units = _from_runs(extra_runs)
                 mine.update(new_units)
                 process(new_units)
             if steal_on:
@@ -1058,10 +1159,8 @@ def run_shard_worker(fleet_dir: str, shard: int) -> int:
             time.sleep(0.05)
     finally:
         hb.stop()
-        if ring is not None:
-            ring.close()
+        plane.close()
         ex.finish()
-        obs.ioledger.emit_events()
     return 0
 
 
@@ -1163,6 +1262,11 @@ class ShardSupervisor:
         self._ring_readers: Dict[str, "ringplane.RingReader"] = {}
         self._ring_results: Dict[Tuple[int, int, int],
                                  List[Tuple[int, dict]]] = {}
+        #: net transport state: the TCP server (started in run()) —
+        #: its drained segments land in _ring_results under the SAME
+        #: (incarnation, shard, seq) keys, so scan/merge/dedup are one
+        #: code path across all three transports
+        self.net: Optional["netplane.NetServer"] = None
 
     # -- spawn -------------------------------------------------------------
 
@@ -1189,6 +1293,12 @@ class ShardSupervisor:
         except ValueError:
             pass
         wenv[RETRY_SEED_ENV] = str(base + 1000 * (shard + 1))
+        if self.net is not None:
+            wenv[netplane.NET_ENV] = self.net.address()
+            # the degradation target: this fleet dir IS a usable shared
+            # spool on the emulated pod; a caller-provided env may
+            # override it (empty = no shared filesystem exists)
+            wenv.setdefault(netplane.SHARED_DIR_ENV, self.fleet_dir)
         root = _repo_root()
         wenv["PYTHONPATH"] = root + os.pathsep + \
             wenv.get("PYTHONPATH", "")
@@ -1205,11 +1315,26 @@ class ShardSupervisor:
                                    f"shard{st.shard}.json"))
         except OSError:
             pass
+        if self.net is not None:
+            self.net.clear_lease(st.shard)
+            # the boot handshake must see THIS incarnation's
+            # assignment, not a stale snapshot
+            self.net.update_state(
+                st.shard, incarnation=st.incarnation, runs=st.runs,
+                extra_version=st.extra_version,
+                extra_runs=_to_runs(st.extra_units))
         log_path = os.path.join(
             self.fleet_dir, LOG_DIR,
             f"shard{st.shard}-inc{st.incarnation}.log")
+        worker_dir = self.fleet_dir
+        if self.net is not None:
+            # net workers get NOTHING shared: their argv dir is a
+            # worker-local spool, everything else arrives over TCP
+            worker_dir = os.path.join(self.fleet_dir, LOCAL_DIR,
+                                      f"shard{st.shard}")
+            os.makedirs(worker_dir, exist_ok=True)
         argv = [sys.executable, "-m", "adam_tpu.parallel.shardstream",
-                self.fleet_dir, str(st.shard)]
+                worker_dir, str(st.shard)]
         with open(log_path, "w") as log:
             st.proc = subprocess.Popen(
                 argv, stdout=log, stderr=subprocess.STDOUT,
@@ -1244,6 +1369,21 @@ class ShardSupervisor:
                 self._ring_results[(rd.incarnation, rd.shard,
                                     int(seq))] = results
 
+    def _poll_net(self) -> None:
+        """Drain TCP-delivered segments into ``_ring_results``.  Every
+        payload already passed the frame CRC; one that still fails to
+        decode counts as torn and is skipped — the worker's LOCAL spool
+        has it, and the worker resends on reconnect."""
+        if self.net is None:
+            return
+        for key, payload in self.net.drain_results():
+            try:
+                results = ringplane.decode_unit_results(payload)
+            except Exception:  # noqa: BLE001 — torn, sender resends
+                obs.registry().counter("net_torn_segments").inc()
+                continue
+            self._ring_results[key] = results
+
     def _scan_commits(self) -> Dict[int, Tuple]:
         """unit -> (sort_key, path, row) for the winning commit of each
         unit (first by (incarnation, shard, seq) — deterministic, and
@@ -1254,6 +1394,7 @@ class ShardSupervisor:
         supervisor side.  Commit files are immutable once renamed, so
         parses cache."""
         self._poll_rings()
+        self._poll_net()
         best: Dict[int, Tuple] = {}
         self._dups = 0
         entries: List[Tuple[Tuple[int, int, int], Optional[str],
@@ -1336,6 +1477,13 @@ class ShardSupervisor:
                 if torn:
                     obs.registry().counter(
                         "ring_torn_segments").inc(torn)
+        if self.net is not None:
+            # drain anything the server acked before the death; a torn
+            # in-flight frame was already dropped at the connection
+            # (CRC/length validation), so there is no tail to scan —
+            # the respawned incarnation recomputes and resends it
+            self._poll_net()
+            self.net.clear_lease(st.shard)
         if self.policy.steal:
             # claims the dead shard took as a THIEF would otherwise pin
             # their units forever (nobody else will touch a claimed
@@ -1394,12 +1542,28 @@ class ShardSupervisor:
                  version=st.extra_version))
 
     def _check_lease(self, st: _ShardState, now: float) -> bool:
-        """True when the shard's lease has expired (stale heartbeat)."""
+        """True when the shard's lease has expired (stale heartbeat).
+
+        On the net transport the lease is socket-level: the age of the
+        last lease message RECEIVED from the shard's current
+        incarnation (supervisor-local monotonic clock — nothing is
+        compared across hosts).  The filesystem lease still counts as
+        a fallback: a worker that degraded onto the shared spool
+        renews there, and fencing it for using the sanctioned
+        degradation path would defeat the point."""
+        age: Optional[float] = None
+        if self.net is not None:
+            age = self.net.lease_age(st.shard, st.incarnation)
+        file_age: Optional[float] = None
         lease = os.path.join(self.fleet_dir, LEASE_DIR,
                              f"shard{st.shard}.json")
         try:
-            age = time.time() - os.path.getmtime(lease)
+            file_age = time.time() - os.path.getmtime(lease)
         except OSError:
+            pass
+        if file_age is not None and (age is None or file_age < age):
+            age = file_age
+        if age is None:
             # no lease yet: only the boot grace applies (jax import on
             # a cold worker takes seconds; a TTL-sized wait would
             # declare every healthy worker dead at startup)
@@ -1474,10 +1638,21 @@ class ShardSupervisor:
             dirs.append(ringplane.CLAIM_DIR)
         for d in dirs:
             os.makedirs(os.path.join(self.fleet_dir, d), exist_ok=True)
-        _write_json(os.path.join(self.fleet_dir, PLAN_FILE),
-                    dict(self.spec,
-                         plan_digest=self.plan["input_digest"],
-                         supervisor_pid=os.getpid()))
+        plan_doc = dict(self.spec,
+                        plan_digest=self.plan["input_digest"],
+                        supervisor_pid=os.getpid())
+        _write_json(os.path.join(self.fleet_dir, PLAN_FILE), plan_doc)
+        if self.spec.get("transport") == "net":
+            # broadcast blobs (task seed files at the fleet-dir root,
+            # e.g. dup.npy / md.npz) ship over TCP: workers never read
+            # the shared dir on this transport
+            blobs = {
+                name: os.path.join(self.fleet_dir, name)
+                for name in sorted(os.listdir(self.fleet_dir))
+                if not name.startswith(".")
+                and name not in (PLAN_FILE, DONE_FILE)
+                and os.path.isfile(os.path.join(self.fleet_dir, name))}
+            self.net = netplane.NetServer(plan_doc, blobs).start()
         for shard, (lo, hi) in enumerate(self.plan["assignments"]):
             st = _ShardState(shard, [[lo, hi]] if hi > lo else [])
             self.states[shard] = st
@@ -1489,6 +1664,7 @@ class ShardSupervisor:
         deadline = time.monotonic() + self.timeout_s
         try:
             while True:
+                self._sync_net_state()
                 committed = self._scan_commits()
                 obs.registry().gauge("shard_units_committed").set(
                     len(committed))
@@ -1517,7 +1693,12 @@ class ShardSupervisor:
                 if self.policy.speculate:
                     self._maybe_speculate(committed, time.monotonic())
                 time.sleep(0.1)
-            # release the drain loops, then collect workers
+            # release the drain loops, then collect workers (net
+            # workers poll the done flag over TCP; a degraded worker
+            # watches the file)
+            if self.net is not None:
+                self._sync_net_state()
+                self.net.set_done()
             with open(os.path.join(self.fleet_dir, DONE_FILE), "w") as f:
                 f.write("done\n")
             for st in self.states.values():
@@ -1537,6 +1718,20 @@ class ShardSupervisor:
                     st.proc.kill()
             for rd in self._ring_readers.values():
                 rd.close()
+            if self.net is not None:
+                self.net.close()
+
+    def _sync_net_state(self) -> None:
+        """Push each shard's assignment snapshot into the net server —
+        the status relay workers poll (extra runs, fencing incarnation,
+        done flag all ride it)."""
+        if self.net is None:
+            return
+        for s, st in self.states.items():
+            self.net.update_state(
+                s, incarnation=st.incarnation, runs=st.runs,
+                extra_version=st.extra_version,
+                extra_runs=_to_runs(st.extra_units))
 
     # -- sidecar fold ------------------------------------------------------
 
@@ -1643,17 +1838,33 @@ def run_fleet(task: str, input_path: str, *, hosts: int,
         if own_dir:
             shutil.rmtree(fleet_dir, ignore_errors=True)
         return {}
+    # a real same-box signal: the supervisor's host identity vs the
+    # identity the workers will boot with (their env's
+    # ADAM_TPU_FLEET_HOST_ID, reported back in the net handshake).
+    # net_available joins the decision inputs ONLY when the net leg is
+    # in play (cross-box, or explicitly requested) — pre-net sidecars
+    # replay digest-identical
+    requested = str(transport or os.environ.get(
+        ringplane.TRANSPORT_ENV, "auto"))
+    same_box = netplane.host_identity(env) == netplane.host_identity()
+    tkw = {}
+    if requested == "net" or not same_box:
+        tkw["net_available"] = netplane.probe_net()
     td = ringplane.decide_transport(
-        requested=str(transport or os.environ.get(
-            ringplane.TRANSPORT_ENV, "auto")),
-        same_box=True,      # workers are subprocesses of this host
+        requested=requested,
+        same_box=same_box,
         mmap_capable=ringplane.probe_mmap(fleet_dir),
         spool_requested=str(spool_sync or os.environ.get(
-            ringplane.SPOOL_SYNC_ENV, "auto")))
+            ringplane.SPOOL_SYNC_ENV, "auto")),
+        **tkw)
     obs.registry().counter("transport_decisions").inc()
     obs.emit("transport_selected", transport=td["transport"],
              spool_sync=td["spool_sync"], reason=td["reason"],
              inputs=td["inputs"], input_digest=td["input_digest"])
+    if td["transport"] == "net" and policy.steal:
+        # unit stealing rides a shared claim table (O_EXCL files) —
+        # exactly what net workers do not have
+        policy = dataclasses.replace(policy, steal=False)
     kind = _input_kind(input_path)
     entry_requested = str(entry or os.environ.get(
         ringplane.ENTRY_ENV, "auto"))

@@ -37,6 +37,7 @@ Faults:
 
 from __future__ import annotations
 
+import errno
 import hashlib
 import json
 import os
@@ -53,7 +54,7 @@ from ..errors import FormatError
 #: loudly instead of never firing
 SITES = ("device_dispatch", "device_put", "spill_write",
          "checkpoint_write", "feeder_load", "worker_proc", "input_record",
-         "shard_lease", "ring_write")
+         "shard_lease", "ring_write", "net_send", "net_recv", "net_accept")
 
 FAULTS = ("error", "latency", "truncate", "corrupt", "kill")
 
@@ -86,7 +87,7 @@ _TENANT: Optional[str] = None
 #: input error the CLI already turns into a clean one-line exit)
 ERROR_CODES = ("RESOURCE_EXHAUSTED", "DATA_LOSS", "UNAVAILABLE",
                "PREEMPTED", "DEADLINE_EXCEEDED", "ABORTED", "INTERNAL",
-               "FORMAT")
+               "FORMAT", "ENOSPC")
 
 
 class InjectedFault(RuntimeError):
@@ -111,9 +112,27 @@ class InjectedDeviceError(InjectedFault):
 
 class InjectedTornWrite(InjectedFault):
     """The write was torn (truncated/corrupted) and the writer 'died' —
-    what a crash mid-write looks like to the next process."""
+    what a crash mid-write looks like to the next process.  ``fault``
+    says which tear ("truncate" or "corrupt"): stream sites (the net
+    plane) map truncate to a mid-frame connection drop and corrupt to
+    garbage bytes on the wire."""
 
     code = "DATA_LOSS"
+    fault = "truncate"
+
+
+class InjectedDiskFull(OSError, InjectedFault):
+    """An injected ``OSError(ENOSPC)`` — the disk filled mid-write.
+    Subclasses OSError so the durable-write paths' cleanup (tmp-file
+    removal in checkpoint.atomic_write) sees exactly what a real
+    disk-full raises, and InjectedFault so workers die typed."""
+
+    code = "ENOSPC"
+
+    def __init__(self, site: str, occurrence: int):
+        super().__init__(
+            errno.ENOSPC,
+            f"injected disk full at site {site!r} occurrence {occurrence}")
 
 
 class InjectedFormatError(FormatError, InjectedFault):
@@ -413,6 +432,8 @@ def _apply(d: dict, site: str, occ: int, path: Optional[str]) -> None:
             raise InjectedFormatError(
                 f"injected malformed input at site {site!r} "
                 f"occurrence {occ}")
+        if code == "ENOSPC":
+            raise InjectedDiskFull(site, occ)
         raise InjectedDeviceError(code, site, occ)
     if fault == "kill":
         os.kill(os.getpid(), signal.SIGKILL)
@@ -431,6 +452,8 @@ def _apply(d: dict, site: str, occ: int, path: Optional[str]) -> None:
                     f.write(b"\xff" * n)
         except OSError:
             pass        # a missing/unwritable target still 'crashes'
-    raise InjectedTornWrite(
+    err = InjectedTornWrite(
         f"DATA_LOSS: injected {fault} at site {site!r} occurrence {occ}"
         + (f" ({path})" if path else ""))
+    err.fault = fault
+    raise err

@@ -1,0 +1,243 @@
+"""Spool retention GC — bounded disk for a weeks-long serve process.
+
+The spool is an append-mostly ledger: every served job leaves a result
+doc under ``done/``/``failed/``/``rejected/``, every fleet run retires
+claim tables (``fleet/claims/unit*.json``), ring files
+(``fleet/ring/*.ring``) and rotated per-incarnation series sidecars
+(``fleet/logs/*.series.jsonl``).  None of that is ever read again once
+the SLO report has folded it in — but nothing deleted it either, so a
+long-lived server grows without bound.  This module is the collector:
+
+* :func:`decide_retention` — PURE.  Given candidate ``(name, kind,
+  age_s)`` rows it returns which to collect, under two floors that make
+  the collector safe by construction: a per-kind **count floor** (the
+  ``keep_per_kind`` newest of each kind always survive — post-mortems
+  keep something to look at) and an **age floor** (nothing younger than
+  ``min_age_s`` goes).  Result docs carry two extra guards: a doc is
+  never collected unless it is OLDER than the last ``serve_report.json``
+  checkpoint (the report provably folded it in) and never while its job
+  id is still unacked (queued or running — a requeue may yet rewrite
+  it).  Recorded in full (``inputs`` + ``input_digest``) by the
+  ``spool_gc`` event; tools/check_executor.py replays it.
+
+* :func:`scan_spool` — enumerate candidates + the checkpoint age + the
+  unacked id set from a live spool.
+
+* :func:`sweep` — scan, decide, unlink, emit.  Wired behind
+  ``adam-tpu gc SPOOL`` (cli/commands.py) and the periodic serve-loop
+  sweeps (serve/server.py, serve/scheduler.py — throttled like the
+  status rewrite, ``ADAM_TPU_SERVE_GC_S``).
+
+Deleting is the easy half; the floors are the contract.  A crashed
+sweep is harmless: every artifact is independently deletable and the
+next sweep re-derives the same decision from what is left.
+"""
+
+from __future__ import annotations
+
+import glob as _glob
+import json
+import os
+import time
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from .. import obs
+
+#: sweep throttle for the periodic serve-loop GC (seconds; 0 disables)
+GC_INTERVAL_ENV = "ADAM_TPU_SERVE_GC_S"
+DEFAULT_GC_INTERVAL_S = 600.0
+#: age floor: nothing younger than this is ever collected
+GC_MIN_AGE_ENV = "ADAM_TPU_SERVE_GC_MIN_AGE_S"
+DEFAULT_MIN_AGE_S = 3600.0
+#: count floor: the N newest of each kind always survive
+GC_KEEP_ENV = "ADAM_TPU_SERVE_GC_KEEP"
+DEFAULT_KEEP_PER_KIND = 64
+
+#: candidate kinds, in scan order.  ``result`` rows get the checkpoint
+#: + unacked guards; the fleet debris kinds only the two floors.
+KINDS = ("result", "claim", "ring", "series")
+
+
+def _digest(inputs: dict) -> str:
+    import hashlib
+    return hashlib.sha256(
+        json.dumps(inputs, sort_keys=True).encode()).hexdigest()[:16]
+
+
+def gc_interval_s() -> float:
+    try:
+        return float(os.environ.get(GC_INTERVAL_ENV,
+                                    DEFAULT_GC_INTERVAL_S))
+    except ValueError:
+        return DEFAULT_GC_INTERVAL_S
+
+
+def _job_id(name: str) -> str:
+    """``<seq>-<id>.json`` -> ``<id>`` (jobspec result-doc naming)."""
+    base = name.rsplit("/", 1)[-1]
+    if base.endswith(".json"):
+        base = base[:-5]
+    _, _, jid = base.partition("-")
+    return jid or base
+
+
+def decide_retention(*, candidates: Sequence[Sequence],
+                     min_age_s: float, keep_per_kind: int,
+                     checkpoint_age_s: Optional[float],
+                     unacked: Sequence[str]) -> dict:
+    """Which spool artifacts a sweep may unlink — PURE.
+
+    ``candidates``: ``[name, kind, age_s]`` rows (kind ∈
+    :data:`KINDS`; ``age_s`` seconds since mtime, caller-rounded).
+    ``checkpoint_age_s``: age of the last ``serve_report.json``
+    checkpoint, or None when no report exists yet (then NO result doc
+    is collectable — nothing proves the report folded it in).
+    ``unacked``: job ids still queued or running.
+
+    Floors, in order: the ``keep_per_kind`` newest of each kind are
+    kept (count floor), anything with ``age_s <= min_age_s`` is kept
+    (age floor), and a ``result`` row additionally needs
+    ``age_s > checkpoint_age_s`` (older than the last report — the
+    checkpoint guard) and its job id absent from ``unacked``.
+    """
+    canon = sorted((str(n), str(k), float(a)) for n, k, a in candidates)
+    inputs = dict(candidates=[list(c) for c in canon],
+                  min_age_s=float(min_age_s),
+                  keep_per_kind=int(keep_per_kind),
+                  checkpoint_age_s=(None if checkpoint_age_s is None
+                                    else float(checkpoint_age_s)),
+                  unacked=sorted(str(u) for u in unacked))
+    unacked_set = set(inputs["unacked"])
+    # count floor: rank each kind newest-first (smallest age first;
+    # name breaks ties so the decision is total)
+    protected: Set[str] = set()
+    by_kind: Dict[str, List[Tuple[float, str]]] = {}
+    for name, kind, age in canon:
+        by_kind.setdefault(kind, []).append((age, name))
+    for rows in by_kind.values():
+        rows.sort()
+        protected.update(n for _, n in rows[:inputs["keep_per_kind"]])
+    collect, kept = [], []
+    for name, kind, age in canon:
+        keep_why = None
+        if name in protected:
+            keep_why = "count-floor"
+        elif age <= inputs["min_age_s"]:
+            keep_why = "age-floor"
+        elif kind == "result":
+            if inputs["checkpoint_age_s"] is None:
+                keep_why = "no-checkpoint"
+            elif age <= inputs["checkpoint_age_s"]:
+                keep_why = "newer-than-checkpoint"
+            elif _job_id(name) in unacked_set:
+                keep_why = "unacked"
+        if keep_why is None:
+            collect.append(name)
+        else:
+            kept.append([name, keep_why])
+    reason = (f"collect-{len(collect)}" if collect else "nothing-due")
+    return dict(collect=collect, kept=kept, reason=reason,
+                inputs=inputs, input_digest=_digest(inputs))
+
+
+def scan_spool(spool: str, *, now: Optional[float] = None) -> dict:
+    """Enumerate GC candidates + guards from a live spool.
+
+    Returns ``{"candidates": [[name, kind, age_s], ...],
+    "checkpoint_age_s": float|None, "unacked": [id, ...]}`` with names
+    spool-relative (the sweep joins them back).  Rows that vanish
+    mid-scan are simply skipped — the spool is live.
+    """
+    from . import jobspec
+    from .server import SLO_REPORT_FILE
+
+    now = time.time() if now is None else float(now)
+
+    def _age(path: str) -> Optional[float]:
+        try:
+            return round(max(now - os.path.getmtime(path), 0.0), 3)
+        except OSError:
+            return None
+
+    cands: List[List] = []
+
+    def _add(path: str, kind: str) -> None:
+        age = _age(path)
+        if age is not None:
+            cands.append([os.path.relpath(path, spool), kind, age])
+
+    for sub in (jobspec.DONE, jobspec.FAILED, jobspec.REJECTED):
+        for p in _glob.glob(os.path.join(spool, sub, "*.json")):
+            _add(p, "result")
+    fleet = os.path.join(spool, "fleet")
+    for p in _glob.glob(os.path.join(fleet, "claims", "unit*.json")):
+        _add(p, "claim")
+    for p in _glob.glob(os.path.join(fleet, "ring", "*.ring")):
+        _add(p, "ring")
+    for p in _glob.glob(os.path.join(fleet, "logs", "*.series.jsonl")):
+        _add(p, "series")
+    # a batch fleet spool (no serve dirs) keeps the same debris kinds
+    # directly at its root — the CLI may point ``gc`` at either layout
+    if not os.path.isdir(fleet):
+        for p in _glob.glob(os.path.join(spool, "claims",
+                                         "unit*.json")):
+            _add(p, "claim")
+        for p in _glob.glob(os.path.join(spool, "ring", "*.ring")):
+            _add(p, "ring")
+        for p in _glob.glob(os.path.join(spool, "logs",
+                                         "*.series.jsonl")):
+            _add(p, "series")
+
+    checkpoint_age = _age(os.path.join(spool, SLO_REPORT_FILE))
+    unacked: Set[str] = set()
+    for sub in (jobspec.QUEUE, jobspec.RUNNING):
+        for p in _glob.glob(os.path.join(spool, sub, "*.json")):
+            unacked.add(_job_id(os.path.basename(p)))
+    return dict(candidates=cands, checkpoint_age_s=checkpoint_age,
+                unacked=sorted(unacked))
+
+
+def sweep(spool: str, *, min_age_s: Optional[float] = None,
+          keep_per_kind: Optional[int] = None,
+          dry_run: bool = False,
+          now: Optional[float] = None) -> dict:
+    """One GC pass: scan, decide, unlink, emit ``spool_gc``.
+
+    Returns the decision dict plus ``removed`` (paths actually
+    unlinked — under ``dry_run`` always empty).  The event + the
+    ``spool_gc_removed`` counter fire even for an empty collection so
+    a quiet sweep is still visible in the ledger replay.
+    """
+    if min_age_s is None:
+        try:
+            min_age_s = float(os.environ.get(GC_MIN_AGE_ENV,
+                                             DEFAULT_MIN_AGE_S))
+        except ValueError:
+            min_age_s = DEFAULT_MIN_AGE_S
+    if keep_per_kind is None:
+        try:
+            keep_per_kind = int(os.environ.get(GC_KEEP_ENV,
+                                               DEFAULT_KEEP_PER_KIND))
+        except ValueError:
+            keep_per_kind = DEFAULT_KEEP_PER_KIND
+    scan = scan_spool(spool, now=now)
+    d = decide_retention(candidates=scan["candidates"],
+                         min_age_s=min_age_s,
+                         keep_per_kind=keep_per_kind,
+                         checkpoint_age_s=scan["checkpoint_age_s"],
+                         unacked=scan["unacked"])
+    removed: List[str] = []
+    if not dry_run:
+        for rel in d["collect"]:
+            try:
+                os.unlink(os.path.join(spool, rel))
+                removed.append(rel)
+            except OSError:
+                pass  # vanished mid-sweep — the spool is live
+    obs.emit("spool_gc", spool=spool, collect=len(d["collect"]),
+             removed=len(removed), kept=len(d["kept"]),
+             dry_run=bool(dry_run), reason=d["reason"],
+             inputs=d["inputs"], input_digest=d["input_digest"])
+    obs.registry().counter("spool_gc_removed").inc(len(removed))
+    d["removed"] = removed
+    return d

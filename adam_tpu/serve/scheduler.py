@@ -553,6 +553,11 @@ class FleetServeScheduler:
         self._report_every = status_mod.report_interval_s()
         self._last_status: Optional[float] = None
         self._last_report: Optional[float] = None
+        #: periodic spool retention GC (serve/retention.py) on the
+        #: status-rewrite throttle discipline
+        from .retention import gc_interval_s
+        self._gc_every = gc_interval_s()
+        self._last_gc: Optional[float] = None
         self._reported_jobs = 0
         self._last_backlog = 0
         self._tenant_backlog: Dict[str, int] = {}
@@ -688,6 +693,12 @@ class FleetServeScheduler:
             f"w{worker}-inc{incarnation}.metrics.jsonl")
         wenv[faults.INCARNATION_ENV] = str(incarnation)
         wenv[faults.WORKER_ENV] = str(worker)
+        # fleet-serve workers are THIS box's processes: stamp the
+        # scheduler's host identity so any shard fleet they spawn
+        # resolves same_box from the handshake, not an assumption
+        # (parallel/netplane.py; run_fleet's decide_transport inputs)
+        from ..parallel import netplane
+        wenv.setdefault(netplane.HOST_ID_ENV, netplane.host_identity())
         base = 0
         try:
             base = int(self.env.get(RETRY_SEED_ENV) or 0)
@@ -1449,6 +1460,15 @@ class FleetServeScheduler:
                 if path:
                     obs.emit("serve_report_checkpoint", path=path,
                              jobs=self.jobs_served, reason="periodic")
+        if self._gc_every > 0 and (
+                self._last_gc is None
+                or now - self._last_gc >= self._gc_every):
+            self._last_gc = now
+            from .retention import sweep
+            try:
+                sweep(self.spool)
+            except OSError:
+                pass  # a failed sweep never takes the fleet down
 
     def run(self, *, max_jobs: Optional[int] = None,
             idle_timeout_s: Optional[float] = None) -> int:

@@ -236,6 +236,12 @@ class ServeServer:
         self._report_every = status_mod.report_interval_s()
         self._last_status: Optional[float] = None
         self._last_report: Optional[float] = None
+        #: periodic spool retention GC (serve/retention.py): same
+        #: throttle discipline as the status rewrite — a weeks-long
+        #: server must not grow its spool without bound
+        from .retention import gc_interval_s
+        self._gc_every = gc_interval_s()
+        self._last_gc: Optional[float] = None
         self._reported_jobs = 0
         self._last_backlog = 0
         self._tenant_backlog: Dict[str, int] = {}
@@ -385,6 +391,15 @@ class ServeServer:
                 if path:
                     obs.emit("serve_report_checkpoint", path=path,
                              jobs=self.jobs_served, reason="periodic")
+        if self._gc_every > 0 and (
+                self._last_gc is None
+                or now - self._last_gc >= self._gc_every):
+            self._last_gc = now
+            from .retention import sweep
+            try:
+                sweep(self.spool)
+            except OSError:
+                pass  # a failed sweep never takes the serve loop down
 
     def _snapshot_queue(self) -> tuple:
         """Admission-ready queue snapshot: ``(descriptors, by_id)``

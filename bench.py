@@ -1435,6 +1435,30 @@ def _stage_shard_scale(kind: str, is_tpu: bool):
                 out["shard_fsyncs_fleetdir"] /
                 max(out["shard_fsyncs_ring"], 1), 3)
 
+        # -- loopback-TCP net plane (PR 20, parallel/netplane.py): the
+        # cross-box transport on the same input, same hosts — workers
+        # spool locally and ship unit segments over framed TCP, so the
+        # leg proves delivery (net segments + bytes) and prices the
+        # plane against ring/fleet_dir on identical work
+        from adam_tpu.parallel import netplane
+        c0 = _counters()
+        t0 = time.perf_counter()
+        nrep = format_report(*fleet_flagstat(
+            pq_dir, hosts=2, unit_rows=max(n // 16, 1), policy=pol,
+            commit_every=4, timeout_s=600.0, transport="net",
+            env={netplane.HOST_ID_ENV: "bench-remote-box"}))
+        out["shard_hosts2_net_wall_s"] = round(
+            time.perf_counter() - t0, 3)
+        c1 = _counters()
+        out["shard_net_identical"] = nrep == single
+        out["shard_transport_net"] = "net"
+        out["shard_net_segments"] = _delta(c0, c1, "net_segments")
+        out["shard_net_bytes_out"] = _delta(c0, c1, "net_bytes_out")
+        out["shard_net_bytes_in"] = _delta(c0, c1, "net_bytes_in")
+        out["shard_net_frames_out"] = _delta(c0, c1, "net_frames_out")
+        out["shard_net_retries"] = _delta(c0, c1, "net_retries")
+        out["shard_net_connects"] = _delta(c0, c1, "net_connects")
+
         # -- index-assisted BGZF shard entry: a synthetic BAM, indexed
         # vs forward fleet, decoded bytes from the folded I/O ledger
         n_bam = int(os.environ.get("ADAM_TPU_BENCH_SHARD_BAM_READS",

@@ -1,0 +1,573 @@
+"""The TCP data plane (parallel/netplane.py) + spool retention GC
+(serve/retention.py).
+
+Pins, extending the tests/test_shardstream.py fleet chaos conventions
+to the third transport:
+
+* the frame codec: roundtrip over a socketpair, garbage / bad magic /
+  bad CRC / mid-frame stream end all DETECTED and typed, never parsed;
+* ``decide_transport``'s net legs are pure and digest-stable, and a
+  pre-net sidecar (no ``net_available`` input recorded) still replays
+  digest-identical;
+* the chaos matrix over a 2-host fleet with NO shared filesystem
+  (``ADAM_TPU_FLEET_SHARED_DIR`` empty — unit results, broadcast
+  blobs, leases, and the status relay all ride TCP): SIGKILL
+  mid-frame, half-frame + reconnect, garbage bytes on the wire, a
+  slow peer whose socket-level lease expires — every cell completes
+  byte-identical to the single-host oracle;
+* typed degradation: a persistently unreachable peer falls back to
+  the shared spool when one is usable (``net_degraded``), else fails
+  the shard cleanly typed and the supervisor redistributes;
+* fleet worker ENOSPC (injected ``OSError`` at the progress-marker
+  publish) dies typed, is reassigned, and the respawn completes
+  byte-identical with no torn durable artifact;
+* ``decide_retention`` floors/guards, the sweep, and the ``adam-tpu
+  gc`` CLI;
+* validator round-trips: check_metrics schema + check_executor replay
+  on the supervisor sidecar, check_resilience replay on every sidecar
+  that recorded net-site firings.
+
+Multi-process by construction (real subprocess workers over real
+loopback TCP), no jax multiprocess collectives.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import socket
+import struct
+import subprocess
+import sys
+import time
+import zlib
+
+import pyarrow as pa
+import pytest
+
+from adam_tpu.parallel import netplane as netp
+from adam_tpu.parallel import shardstream as ss
+from adam_tpu.parallel.ringplane import decide_transport
+from adam_tpu.resilience.retry import FleetPolicy
+from adam_tpu.serve import retention
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# frame codec
+# ---------------------------------------------------------------------------
+
+def test_frame_roundtrip_over_socketpair():
+    a, b = socket.socketpair()
+    try:
+        netp.send_frame(a, {"t": "hello", "shard": 3}, b"payload-bytes")
+        header, payload = netp.recv_frame(b)
+        assert header["t"] == "hello" and header["shard"] == 3
+        assert payload == b"payload-bytes"
+        # empty payload is a frame too (leases, status polls)
+        netp.send_frame(a, {"t": "lease"})
+        header, payload = netp.recv_frame(b)
+        assert header["t"] == "lease" and payload == b""
+    finally:
+        a.close()
+        b.close()
+
+
+def _pair():
+    a, b = socket.socketpair()
+    b.settimeout(5.0)
+    return a, b
+
+
+def test_garbage_bytes_are_detected_not_parsed():
+    a, b = _pair()
+    a.sendall(b"\xff" * 64)
+    a.close()
+    with pytest.raises(netp.NetFrameError, match="magic"):
+        netp.recv_frame(b)
+    b.close()
+
+
+def test_crc_mismatch_is_detected():
+    hb = json.dumps({"t": "x"}).encode()
+    bad_crc = (zlib.crc32(hb) ^ 0xDEADBEEF) & 0xFFFFFFFF
+    a, b = _pair()
+    a.sendall(netp._FRAME.pack(netp._MAGIC, len(hb), 0, bad_crc) + hb)
+    a.close()
+    with pytest.raises(netp.NetFrameError, match="CRC"):
+        netp.recv_frame(b)
+    b.close()
+
+
+def test_stream_end_mid_frame_is_typed():
+    hb = json.dumps({"t": "x"}).encode()
+    crc = zlib.crc32(hb) & 0xFFFFFFFF
+    buf = netp._FRAME.pack(netp._MAGIC, len(hb), 0, crc) + hb
+    a, b = _pair()
+    a.sendall(buf[:len(buf) // 2])
+    a.close()
+    with pytest.raises(netp.NetFrameError, match="stream ended"):
+        netp.recv_frame(b)
+    b.close()
+
+
+def test_insane_lengths_never_allocate():
+    a, b = _pair()
+    a.sendall(struct.pack("<IIII", netp._MAGIC,
+                          netp.MAX_HEADER_BYTES + 1, 0, 0))
+    a.close()
+    with pytest.raises(netp.NetFrameError, match="bounds"):
+        netp.recv_frame(b)
+    b.close()
+
+
+def test_host_identity_env_wins_else_hostname():
+    assert netp.host_identity({netp.HOST_ID_ENV: "boxA"}) == "boxA"
+    assert netp.host_identity({}) == socket.gethostname()
+
+
+# ---------------------------------------------------------------------------
+# pure decisions
+# ---------------------------------------------------------------------------
+
+def test_transport_decision_net_legs():
+    kw = dict(requested="auto", mmap_capable=True,
+              spool_requested="auto")
+    d = decide_transport(same_box=False, net_available=True, **kw)
+    assert d["transport"] == "net" and "cross-box-net" in d["reason"]
+    d2 = decide_transport(same_box=False, net_available=False, **kw)
+    assert d2["transport"] == "fleet_dir" and "cross-box" in d2["reason"]
+    d3 = decide_transport(requested="auto", same_box=False,
+                          mmap_capable=False, spool_requested="auto",
+                          net_available=True)
+    assert d3["transport"] == "net"
+    assert "no-mmap-cross-box" in d3["reason"]
+    forced = decide_transport(requested="net", same_box=True,
+                              mmap_capable=True, spool_requested="auto")
+    assert forced["transport"] == "net" and "forced" in forced["reason"]
+    # replay: the recorded inputs reproduce decision + digest
+    r = decide_transport(**d["inputs"])
+    assert r["input_digest"] == d["input_digest"]
+    assert r["transport"] == d["transport"]
+
+
+def test_pre_net_sidecars_replay_digest_identical():
+    """``net_available`` joins the recorded inputs ONLY when engaged:
+    the 4-input decision a pre-net sidecar recorded must still digest
+    to the same value under the extended decider."""
+    old = decide_transport(requested="auto", same_box=True,
+                           mmap_capable=True, spool_requested="auto")
+    assert "net_available" not in old["inputs"]
+    assert old["input_digest"] == "f5ec3cefbf477333"
+    assert old["transport"] == "ring"
+
+
+def test_retention_floors_and_guards():
+    cands = [["done/1-a.json", "result", 7200.0],
+             ["done/2-b.json", "result", 30.0],
+             ["claims/unit1.json", "claim", 9999.0],
+             ["ring/x.ring", "ring", 9999.0],
+             ["logs/s.series.jsonl", "series", 100.0]]
+    d = retention.decide_retention(
+        candidates=cands, min_age_s=3600, keep_per_kind=1,
+        checkpoint_age_s=5000, unacked=["c"])
+    assert d["collect"] == ["done/1-a.json"]
+    kept = dict(d["kept"])
+    assert kept["done/2-b.json"] == "count-floor"
+    # result-doc guards: no checkpoint -> nothing provably folded in;
+    # unacked job id -> a requeue may yet rewrite the doc
+    nc = retention.decide_retention(
+        candidates=[["done/1-a.json", "result", 7200.0]],
+        min_age_s=10, keep_per_kind=0, checkpoint_age_s=None,
+        unacked=[])
+    assert nc["kept"] == [["done/1-a.json", "no-checkpoint"]]
+    un = retention.decide_retention(
+        candidates=[["done/1-a.json", "result", 7200.0]],
+        min_age_s=10, keep_per_kind=0, checkpoint_age_s=100,
+        unacked=["a"])
+    assert un["kept"] == [["done/1-a.json", "unacked"]]
+    newer = retention.decide_retention(
+        candidates=[["done/1-a.json", "result", 7200.0]],
+        min_age_s=10, keep_per_kind=0, checkpoint_age_s=8000,
+        unacked=[])
+    assert newer["kept"] == [["done/1-a.json", "newer-than-checkpoint"]]
+    # fleet debris needs only the two floors, never the checkpoint
+    ring = retention.decide_retention(
+        candidates=[["ring/x.ring", "ring", 7200.0]],
+        min_age_s=10, keep_per_kind=0, checkpoint_age_s=None,
+        unacked=[])
+    assert ring["collect"] == ["ring/x.ring"]
+    # pure + digest-stable: the recorded inputs replay exactly
+    r = retention.decide_retention(**d["inputs"])
+    assert r["input_digest"] == d["input_digest"]
+    assert r["collect"] == d["collect"] and r["kept"] == d["kept"]
+
+
+def _age(path, seconds):
+    t = time.time() - seconds
+    os.utime(path, (t, t))
+
+
+def _spool_with_debris(tmp_path):
+    from adam_tpu.serve import jobspec
+
+    spool = str(tmp_path / "spool")
+    jobspec.ensure_spool(spool)
+    for i in range(3):
+        p = os.path.join(spool, "done", f"0000000{i + 1}-t{i}.json")
+        with open(p, "w") as f:
+            f.write("{}")
+        _age(p, 7200)
+    rpt = os.path.join(spool, "serve_report.json")
+    with open(rpt, "w") as f:
+        f.write("{}")
+    _age(rpt, 60)
+    ring_dir = os.path.join(spool, "fleet", "ring")
+    os.makedirs(ring_dir)
+    ring = os.path.join(ring_dir, "shard0-inc0.ring")
+    with open(ring, "wb") as f:
+        f.write(b"\0" * 64)
+    _age(ring, 7200)
+    return spool
+
+
+def test_retention_sweep_unlinks_and_emits(tmp_path):
+    from adam_tpu import obs
+
+    spool = _spool_with_debris(tmp_path)
+    metrics = str(tmp_path / "gc.metrics.jsonl")
+    with obs.metrics_run(metrics, argv=["test"], config={}):
+        d = retention.sweep(spool, min_age_s=3600, keep_per_kind=1)
+    # keep_per_kind=1: the newest result doc and the only ring file
+    # survive the count floor; the two older docs are collected
+    assert len(d["removed"]) == 2
+    assert all(r.startswith("done/") for r in d["removed"])
+    assert len(os.listdir(os.path.join(spool, "done"))) == 1
+    evs = [json.loads(ln) for ln in open(metrics) if ln.strip()]
+    gc = [e for e in evs if e.get("event") == "spool_gc"]
+    assert gc and gc[0]["removed"] == 2 and not gc[0]["dry_run"]
+    assert isinstance(gc[0]["inputs"], dict)
+    _run_validators(metrics)
+
+
+def test_gc_cli_dry_run_then_collect(tmp_path):
+    spool = _spool_with_debris(tmp_path)
+    base = [sys.executable, "-m", "adam_tpu", "gc", spool,
+            "-min_age_s", "3600", "-keep", "1"]
+    dry = subprocess.run(base + ["-dry_run"], capture_output=True,
+                         text=True)
+    assert dry.returncode == 0, dry.stderr
+    assert "would collect 2" in dry.stdout
+    assert len(os.listdir(os.path.join(spool, "done"))) == 3
+    real = subprocess.run(base, capture_output=True, text=True)
+    assert real.returncode == 0, real.stderr
+    assert "removed 2" in real.stdout
+    assert len(os.listdir(os.path.join(spool, "done"))) == 1
+    missing = subprocess.run(
+        [sys.executable, "-m", "adam_tpu", "gc",
+         str(tmp_path / "nope")], capture_output=True, text=True)
+    assert missing.returncode == 2
+
+
+# ---------------------------------------------------------------------------
+# live fleet over loopback TCP
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def fleet_input(tmp_path_factory):
+    """A 2400-read Parquet dataset + the single-host oracle report."""
+    from adam_tpu.io.parquet import DatasetWriter
+    from adam_tpu.io.sam import read_sam
+    from adam_tpu.ops.flagstat import format_report
+    from adam_tpu.parallel.pipeline import streaming_flagstat
+
+    tmp = tmp_path_factory.mktemp("netplane")
+    pq_dir = str(tmp / "reads")
+    table, _, _ = read_sam(os.path.join(
+        os.path.dirname(__file__), "resources", "unmapped.sam"))
+    with DatasetWriter(pq_dir, part_rows=256) as w:
+        w.write(pa.concat_tables([table] * 12))
+    failed, passed = streaming_flagstat(pq_dir, chunk_rows=256)
+    return dict(path=pq_dir, oracle=format_report(failed, passed))
+
+
+def _report(out):
+    from adam_tpu.ops.flagstat import format_report
+    failed, passed = out
+    return format_report(failed, passed)
+
+
+def _net_fleet(fleet_input, tmp_path, *, rules=None, policy=None,
+               metrics=None, shared="", hosts=2):
+    """Run a 2-host fleet forced cross-box: worker env carries a
+    DIFFERENT host identity than the supervisor, so run_fleet's
+    handshake resolves ``same_box=False`` and the decided transport is
+    ``net``.  ``shared=""`` pins the no-shared-filesystem contract
+    (the worker env's SHARED_DIR stays empty, so degradation has
+    nowhere to go); ``shared=None`` leaves the supervisor default (its
+    own fleet dir), the degradation target."""
+    from adam_tpu import obs
+
+    env = dict(os.environ)
+    env[netp.HOST_ID_ENV] = "emulated-remote-box"
+    env[netp.NET_TIMEOUT_ENV] = "5"
+    env[netp.NET_RETRIES_ENV] = "2"
+    env[netp.NET_BACKOFF_ENV] = "0.02"
+    if shared is not None:
+        env[netp.SHARED_DIR_ENV] = shared
+    else:
+        env.pop(netp.SHARED_DIR_ENV, None)
+    if rules is not None:
+        plan_path = str(tmp_path / "faults.json")
+        with open(plan_path, "w") as f:
+            json.dump({"rules": rules}, f)
+        env["ADAM_TPU_FAULT_PLAN"] = plan_path
+    fleet_dir = str(tmp_path / "fleet")
+    kw = dict(hosts=hosts, unit_rows=100, fleet_dir=fleet_dir,
+              policy=policy, env=env, timeout_s=240)
+    if metrics is not None:
+        with obs.metrics_run(metrics, argv=["test"], config={}):
+            out = ss.fleet_flagstat(fleet_input["path"], **kw)
+    else:
+        out = ss.fleet_flagstat(fleet_input["path"], **kw)
+    return out, fleet_dir
+
+
+def _events(path):
+    with open(path) as f:
+        return [json.loads(ln) for ln in f if ln.strip()]
+
+
+def _summary_counter(evs, name):
+    snap = evs[-1]["metrics"]["counters"]
+    return sum(v for k, v in snap.items()
+               if k == name or k.startswith(name + "{"))
+
+
+def _run_validators(*paths):
+    for tool in ("check_metrics", "check_executor"):
+        r = subprocess.run(
+            [sys.executable, os.path.join(ROOT, "tools", f"{tool}.py")]
+            + list(paths), capture_output=True, text=True)
+        assert r.returncode == 0, f"{tool}: {r.stdout}\n{r.stderr}"
+
+
+def _run_resilience_validator(metrics, fleet_dir):
+    """check_resilience over every sidecar that recorded firings —
+    the supervisor's (net_recv/net_accept fire there) plus any worker
+    sidecar with fault events."""
+    paths = [p for p in [metrics] + sorted(glob.glob(
+        os.path.join(fleet_dir, ss.LOG_DIR, "*.metrics.jsonl")))
+        if os.path.exists(p) and any(
+            e.get("event") in ("fault_injected", "retry_attempt")
+            for e in _events(p))]
+    assert paths, "a chaos leg must record at least one firing"
+    r = subprocess.run(
+        [sys.executable,
+         os.path.join(ROOT, "tools", "check_resilience.py")] + paths,
+        capture_output=True, text=True)
+    assert r.returncode == 0, f"check_resilience: {r.stdout}\n{r.stderr}"
+
+
+def test_net_fleet_no_shared_fs_byte_identical(fleet_input, tmp_path):
+    """The tentpole contract: a 2-host fleet with NO shared filesystem
+    (empty SHARED_DIR) completes byte-identical to the single-host
+    oracle — results, leases, and the relay all rode TCP."""
+    metrics = str(tmp_path / "sup.metrics.jsonl")
+    out, fleet_dir = _net_fleet(fleet_input, tmp_path, metrics=metrics)
+    assert _report(out) == fleet_input["oracle"]
+    plan = json.load(open(os.path.join(fleet_dir, ss.PLAN_FILE)))
+    assert plan["transport"] == "net"
+    evs = _events(metrics)
+    sel = [e for e in evs if e["event"] == "transport_selected"]
+    assert sel and sel[0]["transport"] == "net"
+    assert sel[0]["inputs"]["same_box"] is False
+    assert sel[0]["inputs"]["net_available"] is True
+    # delivery proof: segments arrived over TCP, and the workers
+    # spooled locally (their npz commits live under local/, not the
+    # supervisor's commit dir)
+    assert _summary_counter(evs, "net_segments") >= 1
+    assert _summary_counter(evs, "net_frames_in") >= 1
+    for shard in (0, 1):
+        local = os.path.join(fleet_dir, ss.LOCAL_DIR, f"shard{shard}",
+                             ss.COMMIT_DIR)
+        assert glob.glob(os.path.join(local, "*.npz"))
+    _run_validators(metrics)
+
+
+def test_net_send_kill_mid_frame_recovers(fleet_input, tmp_path):
+    """SIGKILL mid-frame: the server sees a torn frame (detected,
+    dropped), the supervisor sees the death, the respawn resends —
+    first-wins dedup absorbs any redelivery; output byte-identical."""
+    metrics = str(tmp_path / "sup.metrics.jsonl")
+    rules = [{"site": "net_send", "fault": "kill", "occurrence": 2,
+              "incarnation": 0, "shard": 1}]
+    out, fleet_dir = _net_fleet(fleet_input, tmp_path, rules=rules,
+                                metrics=metrics)
+    assert _report(out) == fleet_input["oracle"]
+    evs = _events(metrics)
+    deaths = [e for e in evs if e["event"] == "shard_reassigned"
+              and e.get("cause") == "death"
+              and e["inputs"]["shard"] == 1]
+    assert deaths and deaths[0]["action"] == "respawn"
+    # no check_resilience here: a SIGKILL'd worker's event buffer dies
+    # with it (that IS the fault), so the firing leaves no sidecar —
+    # the surviving legs below pin the net-site replay instead
+    _run_validators(metrics)
+
+
+def test_net_send_truncate_reconnects_and_resends(fleet_input,
+                                                  tmp_path):
+    """Half a frame then a closed socket: the server drops the torn
+    connection, the client backs off (deterministic jitter),
+    reconnects, resends; byte-identical output and the retry is in
+    the worker's ledger."""
+    metrics = str(tmp_path / "sup.metrics.jsonl")
+    rules = [{"site": "net_send", "fault": "truncate", "occurrence": 2,
+              "incarnation": 0, "shard": 1}]
+    out, fleet_dir = _net_fleet(fleet_input, tmp_path, rules=rules,
+                                metrics=metrics)
+    assert _report(out) == fleet_input["oracle"]
+    evs = _events(metrics)
+    assert _summary_counter(evs, "net_retries") >= 1
+    retries = [e for p in glob.glob(os.path.join(
+        fleet_dir, ss.LOG_DIR, "*.metrics.jsonl"))
+        for e in _events(p) if e.get("event") == "net_retry"]
+    assert retries and retries[0]["attempt"] >= 1
+    assert retries[0]["delay_s"] >= 0
+    _run_validators(metrics)
+    _run_resilience_validator(metrics, fleet_dir)
+
+
+def test_net_send_corrupt_garbage_dropped(fleet_input, tmp_path):
+    """Garbage bytes on the wire: the server's CRC check catches the
+    torn frame, counts it, drops the connection — never parses it —
+    and the resend lands byte-identical."""
+    metrics = str(tmp_path / "sup.metrics.jsonl")
+    rules = [{"site": "net_send", "fault": "corrupt", "occurrence": 2,
+              "incarnation": 0, "shard": 0}]
+    out, fleet_dir = _net_fleet(fleet_input, tmp_path, rules=rules,
+                                metrics=metrics)
+    assert _report(out) == fleet_input["oracle"]
+    evs = _events(metrics)
+    assert _summary_counter(evs, "net_garbage_frames") >= 1
+    _run_validators(metrics)
+    _run_resilience_validator(metrics, fleet_dir)
+
+
+def test_net_lease_expiry_fences_slow_peer(fleet_input, tmp_path):
+    """A stalled worker renews no lease over the socket; the
+    supervisor's RECEIPT clock (not a filesystem mtime — there is no
+    shared filesystem) expires it, fences the incarnation, and the
+    respawn completes byte-identical."""
+    metrics = str(tmp_path / "sup.metrics.jsonl")
+    rules = [{"site": "shard_lease", "fault": "latency",
+              "latency_s": 60.0, "occurrence": "2+", "incarnation": 0,
+              "shard": 1},
+             {"site": "device_dispatch", "fault": "latency",
+              "latency_s": 1.0, "occurrence": "1+", "incarnation": 0,
+              "shard": 1}]
+    pol = FleetPolicy(max_restarts=2, lease_ttl_s=5.0, heartbeat_s=0.5)
+    out, fleet_dir = _net_fleet(fleet_input, tmp_path, rules=rules,
+                                policy=pol, metrics=metrics)
+    assert _report(out) == fleet_input["oracle"]
+    evs = _events(metrics)
+    expiries = [e for e in evs if e["event"] == "shard_lease_expired"
+                and e["shard"] == 1]
+    assert expiries, "the stalled worker's socket lease must expire"
+    assert expiries[0]["age_s"] > pol.lease_ttl_s
+    deaths = [e for e in evs if e["event"] == "shard_reassigned"
+              and e.get("cause") == "death"
+              and e["inputs"]["shard"] == 1]
+    assert deaths and \
+        deaths[0]["inputs"]["error_code"] == "DEADLINE_EXCEEDED"
+    _run_validators(metrics)
+
+
+def test_net_unreachable_degrades_to_shared_spool(fleet_input,
+                                                  tmp_path):
+    """Every send from shard 1 fails past the retry budget; a shared
+    spool IS available (the supervisor's fleet dir), so the worker
+    copies its local commits over, emits ``net_degraded``, and
+    finishes on the fleet_dir plane — byte-identical."""
+    metrics = str(tmp_path / "sup.metrics.jsonl")
+    rules = [{"site": "net_send", "fault": "error", "occurrence": "2+",
+              "incarnation": 0, "shard": 1}]
+    out, fleet_dir = _net_fleet(fleet_input, tmp_path, rules=rules,
+                                metrics=metrics, shared=None)
+    assert _report(out) == fleet_input["oracle"]
+    degraded = [e for p in glob.glob(os.path.join(
+        fleet_dir, ss.LOG_DIR, "*.metrics.jsonl"))
+        for e in _events(p) if e.get("event") == "net_degraded"]
+    assert degraded and degraded[0]["shard"] == 1
+    assert degraded[0]["shared_dir"] == fleet_dir
+    _run_validators(metrics)
+    _run_resilience_validator(metrics, fleet_dir)
+
+
+def test_net_unreachable_no_shared_fs_fails_typed_redistributes(
+        fleet_input, tmp_path):
+    """Same unreachable peer but NO shared filesystem: the worker
+    exits with the typed line, the supervisor redistributes the shard
+    to survivors, and the run still lands byte-identical."""
+    metrics = str(tmp_path / "sup.metrics.jsonl")
+    rules = [{"site": "net_send", "fault": "error", "occurrence": "2+",
+              "incarnation": 0, "shard": 1}]
+    pol = FleetPolicy(max_restarts=0, lease_ttl_s=30.0)
+    out, fleet_dir = _net_fleet(fleet_input, tmp_path, rules=rules,
+                                policy=pol, metrics=metrics, shared="")
+    assert _report(out) == fleet_input["oracle"]
+    evs = _events(metrics)
+    deaths = [e for e in evs if e["event"] == "shard_reassigned"
+              and e.get("cause") == "death"
+              and e["inputs"]["shard"] == 1]
+    assert deaths and deaths[0]["action"] == "redistribute"
+    logs = ""
+    for p in glob.glob(os.path.join(fleet_dir, ss.LOG_DIR,
+                                    "shard1-*.log")):
+        logs += open(p, errors="replace").read()
+    assert "net plane unreachable (typed)" in logs
+    _run_validators(metrics)
+
+
+def test_net_worker_enospc_reassigned_typed(fleet_input, tmp_path):
+    """Injected disk-full at the worker's progress-marker publish: the
+    tmp is removed (no torn durable artifact in the local spool), the
+    worker dies typed, the respawn recomputes — byte-identical."""
+    metrics = str(tmp_path / "sup.metrics.jsonl")
+    rules = [{"site": "checkpoint_write", "fault": "error",
+              "error": "ENOSPC", "occurrence": 2, "incarnation": 0,
+              "shard": 1}]
+    out, fleet_dir = _net_fleet(fleet_input, tmp_path, rules=rules,
+                                metrics=metrics)
+    assert _report(out) == fleet_input["oracle"]
+    evs = _events(metrics)
+    deaths = [e for e in evs if e["event"] == "shard_reassigned"
+              and e.get("cause") == "death"
+              and e["inputs"]["shard"] == 1]
+    assert deaths and deaths[0]["action"] == "respawn"
+    assert deaths[0]["inputs"]["error_code"] == "INTERNAL"
+    # no torn tmp anywhere under the dead worker's local spool
+    local = os.path.join(fleet_dir, ss.LOCAL_DIR, "shard1")
+    torn = [p for _, _, names in os.walk(local)
+            for p in names if p.endswith(".tmp")]
+    assert torn == []
+    _run_validators(metrics)
+    _run_resilience_validator(metrics, fleet_dir)
+
+
+def test_fault_site_tables_stay_in_sync():
+    """faults.SITES and check_metrics' literal mirror must agree, or
+    the net sites' events would fail schema validation."""
+    sys.path.insert(0, os.path.join(ROOT, "tools"))
+    try:
+        import check_metrics
+    finally:
+        sys.path.pop(0)
+    from adam_tpu.resilience.faults import SITES
+
+    assert set(check_metrics._FAULT_SITES) == set(SITES)
+    for site in ("net_send", "net_recv", "net_accept"):
+        assert site in SITES

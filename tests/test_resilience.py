@@ -590,6 +590,75 @@ class TestCheckpointDirTornWrite:
         assert ck2.completed == ["00-stage"]
 
 
+class TestDiskFullChaos:
+    """Injected ``OSError(ENOSPC)`` (fault="error", error="ENOSPC"):
+    unlike a torn write — where the process is DEAD and the tmp is the
+    post-crash disk state — a disk-full writer is still alive to clean
+    up, so the durable-write helpers must remove the in-flight tmp
+    before re-raising.  A full disk degrades a run; it must never
+    leave torn durable artifacts behind."""
+
+    def test_injected_enospc_is_oserror_and_typed(self):
+        err = faults.InjectedDiskFull("checkpoint_write", 1)
+        assert isinstance(err, OSError)
+        assert isinstance(err, InjectedFault)
+        import errno
+        assert err.errno == errno.ENOSPC
+        assert err.code == "ENOSPC"
+
+    def test_atomic_write_enospc_removes_tmp(self, tmp_path):
+        from adam_tpu.checkpoint import atomic_write
+
+        target = tmp_path / "doc.json"
+        atomic_write(str(target), '{"v": 1}',
+                     fault_site="checkpoint_write")
+        faults.install_plan({"rules": [_rule(
+            "checkpoint_write", "error", error="ENOSPC")]})
+        try:
+            with pytest.raises(OSError) as ei:
+                atomic_write(str(target), '{"v": 2}',
+                             fault_site="checkpoint_write")
+            assert isinstance(ei.value, faults.InjectedDiskFull)
+        finally:
+            faults.clear_plan()
+        # the published doc is the OLD one, and no tmp survived
+        assert json.loads(target.read_text()) == {"v": 1}
+        assert [p for p in os.listdir(tmp_path)
+                if p.endswith(".tmp")] == []
+
+    def test_spill_enospc_fails_typed_then_resumes_to_identity(
+            self, tmp_path, monkeypatch):
+        """The streaming spill path under ENOSPC: the run fails with
+        the typed OSError, every durable artifact left behind parses
+        (no torn tmp anywhere in the workdir), and once space 'comes
+        back' the resume lands on the byte-identical output."""
+        for k, v in FAST.items():
+            monkeypatch.setenv(k, v)
+        faults.clear_plan()
+        base = tmp_path / "base"
+        n0 = _transform(base)
+        ref = _load_sorted(base)
+        wd = tmp_path / "wd"
+        out = tmp_path / "out"
+        faults.install_plan({"rules": [_rule(
+            "spill_write", "error", error="ENOSPC", occurrence=2)]})
+        try:
+            with pytest.raises(OSError) as ei:
+                _transform(out, workdir=wd, resume=True)
+            assert isinstance(ei.value, faults.InjectedDiskFull)
+        finally:
+            faults.clear_plan()
+        torn = [p for _, _, names in os.walk(wd)
+                for p in names if p.endswith(".tmp")]
+        assert torn == []
+        manifest = wd / "stream_checkpoint.json"
+        if manifest.exists():
+            json.loads(manifest.read_text())     # parses — not torn
+        n = _transform(out, workdir=wd, resume=True)
+        assert n == n0
+        assert _load_sorted(out).equals(ref)
+
+
 # ---------------------------------------------------------------------------
 # satellites: malformed-warning cap, elastic backoff + worker kill
 # ---------------------------------------------------------------------------

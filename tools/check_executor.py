@@ -120,6 +120,12 @@ CALL_FIELDS = ("stripe_span", "min_depth", "min_alt", "reason")
 TRANSPORT_FIELDS = ("transport", "spool_sync", "reason")
 ENTRY_FIELDS = ("entry", "reason")
 
+#: the spool-retention fields a replay must reproduce exactly
+#: (serve/retention.decide_retention — what a GC sweep may unlink;
+#: the event records collect/kept as COUNTS, so the replay adapter
+#: below compares the recomputed list lengths plus the reason)
+RETENTION_FIELDS = ("collect", "kept", "reason")
+
 #: fields absent from older sidecars: compared only when recorded
 _OPTIONAL_FIELDS = ("layout", "page_rows", "pool_pages", "reject",
                     "cancel", "fused_device")
@@ -135,7 +141,7 @@ _REPLAYED = ("executor_bucket_selected", "fusion_plan_selected",
              "shard_reassigned", "admission_selected",
              "placement_selected", "job_requeued", "pages_selected",
              "overload_state", "breaker_state", "call_plan_selected",
-             "transport_selected", "shard_entry_selected")
+             "transport_selected", "shard_entry_selected", "spool_gc")
 
 
 def _events(path: str, kinds=_REPLAYED) -> List[Tuple[int, dict]]:
@@ -169,8 +175,16 @@ def check(paths: List[str]) -> List[str]:
     from adam_tpu.resilience.retry import decide_breaker
     from adam_tpu.serve.admission import decide_admission
     from adam_tpu.serve.overload import decide_overload
+    from adam_tpu.serve.retention import decide_retention
     from adam_tpu.serve.scheduler import (decide_placement,
                                           decide_requeue, decide_steal)
+
+    def replay_retention(**inputs):
+        # the spool_gc event records collect/kept as counts (the
+        # collected names are in the inputs already); reshape the
+        # replayed decision to the recorded shape
+        d = decide_retention(**inputs)
+        return dict(d, collect=len(d["collect"]), kept=len(d["kept"]))
 
     deciders = {"executor_bucket_selected": (decide_plan, PLAN_FIELDS),
                 "fusion_plan_selected": (decide_fusion_plan,
@@ -190,7 +204,8 @@ def check(paths: List[str]) -> List[str]:
                 "transport_selected": (decide_transport,
                                        TRANSPORT_FIELDS),
                 "shard_entry_selected": (decide_shard_entry,
-                                         ENTRY_FIELDS)}
+                                         ENTRY_FIELDS),
+                "spool_gc": (replay_retention, RETENTION_FIELDS)}
     errs: List[str] = []
     # digests are namespaced per event kind: the two deciders hash
     # different input tuples and must never cross-validate

@@ -220,6 +220,7 @@ KNOWN_EVENTS = (
     "series_written", "serve_report_checkpoint",
     "call_plan_selected", "call_stripe", "call_emit",
     "transport_selected", "shard_entry_selected", "unit_stolen",
+    "net_connect", "net_retry", "net_degraded", "spool_gc",
 )
 
 #: mirror of adam_tpu.resilience.faults.SITES / FAULTS (kept literal so
@@ -227,7 +228,8 @@ KNOWN_EVENTS = (
 #: this file's schema knowledge)
 _FAULT_SITES = ("device_dispatch", "device_put", "spill_write",
                 "checkpoint_write", "feeder_load", "worker_proc",
-                "input_record", "shard_lease", "ring_write")
+                "input_record", "shard_lease", "ring_write",
+                "net_send", "net_recv", "net_accept")
 _FAULT_KINDS = ("error", "latency", "truncate", "corrupt", "kill")
 _RETRY_ACTIONS = ("retry", "split", "fallback_cpu", "raise")
 _SHARD_CAUSES = ("death", "speculation")
@@ -241,7 +243,7 @@ _REQUEUE_ACTIONS = ("requeue", "quarantine", "steal")
 #: _FAULT_SITES above)
 _OVERLOAD_STATES = ("normal", "shed_batch", "reject_low", "reject_all")
 #: mirror of adam_tpu.parallel.ringplane's decision vocabularies
-_TRANSPORTS = ("ring", "fleet_dir")
+_TRANSPORTS = ("ring", "fleet_dir", "net")
 _SPOOL_SYNCS = ("batched", "every")
 _ENTRIES = ("index", "forward", "rowgroup")
 _REJECT_CODES = ("over_backlog", "tenant_quota", "brownout_low",
@@ -1012,6 +1014,61 @@ def validate(path: str) -> List[str]:
                     d["victim"] == d["thief"]:
                 err(i, "unit_stolen victim equals thief — a shard "
                        "cannot steal its own unit")
+        elif ev == "net_connect":
+            sh = d.get("shard")
+            if not (isinstance(sh, int) and not isinstance(sh, bool)
+                    and sh >= 0):
+                err(i, "net_connect missing non-negative int 'shard'")
+            if not (isinstance(d.get("host"), str) and d["host"]):
+                err(i, "net_connect missing string 'host'")
+            port = d.get("port")
+            if not (isinstance(port, int) and not isinstance(port, bool)
+                    and 0 < port < 65536):
+                err(i, "net_connect missing int 'port' in (0, 65536)")
+        elif ev == "net_retry":
+            sh = d.get("shard")
+            if not (isinstance(sh, int) and not isinstance(sh, bool)
+                    and sh >= 0):
+                err(i, "net_retry missing non-negative int 'shard'")
+            if not (isinstance(d.get("kind"), str) and d["kind"]):
+                err(i, "net_retry missing string 'kind' (the message "
+                       "type being retried)")
+            att = d.get("attempt")
+            if not (isinstance(att, int) and not isinstance(att, bool)
+                    and att >= 1):
+                err(i, "net_retry missing int 'attempt' >= 1")
+            if not (_is_num(d.get("delay_s")) and d["delay_s"] >= 0):
+                err(i, "net_retry missing non-negative 'delay_s'")
+            if not isinstance(d.get("error"), str):
+                err(i, "net_retry missing string 'error'")
+        elif ev == "net_degraded":
+            sh = d.get("shard")
+            if not (isinstance(sh, int) and not isinstance(sh, bool)
+                    and sh >= 0):
+                err(i, "net_degraded missing non-negative int 'shard'")
+            if not (isinstance(d.get("shared_dir"), str)
+                    and d["shared_dir"]):
+                err(i, "net_degraded missing string 'shared_dir'")
+            if not isinstance(d.get("error"), str):
+                err(i, "net_degraded missing string 'error'")
+        elif ev == "spool_gc":
+            if not (isinstance(d.get("spool"), str) and d["spool"]):
+                err(i, "spool_gc missing string 'spool'")
+            for field in ("collect", "removed", "kept"):
+                v = d.get(field)
+                if not (isinstance(v, int) and not isinstance(v, bool)
+                        and v >= 0):
+                    err(i, f"spool_gc missing non-negative int "
+                           f"{field!r}")
+            if not isinstance(d.get("dry_run"), bool):
+                err(i, "spool_gc missing boolean 'dry_run'")
+            if not (isinstance(d.get("reason"), str) and d["reason"]):
+                err(i, "spool_gc missing string 'reason'")
+            if not isinstance(d.get("inputs"), dict):
+                err(i, "spool_gc missing 'inputs' object "
+                       "(decision must be replayable)")
+            if not _is_hex(d.get("input_digest")):
+                err(i, "spool_gc missing hex 'input_digest'")
         elif ev == "startup_seconds":
             for k, v in d.items():
                 if k in ("event", "t"):
